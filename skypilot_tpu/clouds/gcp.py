@@ -1,0 +1,257 @@
+"""GCP cloud: TPU slices as the native accelerator.
+
+Reference: sky/clouds/gcp.py — but where the reference bolts TPUs onto
+a GPU-VM model (pseudo instance type 'TPU-VM', hardcoded host shapes,
+`:770-823`), here a TPU slice is the primary launchable unit: the
+catalog row carries chips/hosts/ICI topology and the deploy variables
+speak the TPU API natively (acceleratorType + topology +
+QueuedResources).
+"""
+from __future__ import annotations
+
+import os
+import typing
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from skypilot_tpu.catalog import gcp_catalog
+from skypilot_tpu.clouds import cloud
+from skypilot_tpu.utils import tpu_utils
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+# Default TPU software (runtime) version per generation, for JAX.
+_DEFAULT_RUNTIME_VERSION = {
+    'v2': 'tpu-ubuntu2204-base',
+    'v3': 'tpu-ubuntu2204-base',
+    'v4': 'tpu-ubuntu2204-base',
+    'v5e': 'v2-alpha-tpuv5-lite',
+    'v5p': 'v2-alpha-tpuv5',
+    'v6e': 'v2-alpha-tpuv6e',
+}
+
+
+@CLOUD_REGISTRY.register(default=True)
+class GCP(cloud.Cloud):
+    _REPR = 'GCP'
+
+    @classmethod
+    def max_cluster_name_length(cls) -> Optional[int]:
+        return 35
+
+    # ---- credentials ------------------------------------------------------
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        # Application-default credentials or gcloud auth.
+        adc = os.path.expanduser(
+            '~/.config/gcloud/application_default_credentials.json')
+        if os.environ.get('GOOGLE_APPLICATION_CREDENTIALS') or \
+                os.path.exists(adc):
+            return True, None
+        return False, ('GCP credentials not found. Run '
+                       '`gcloud auth application-default login`.')
+
+    @classmethod
+    def unsupported_features_for_resources(
+        cls, resources: 'resources_lib.Resources'
+    ) -> Dict[cloud.CloudImplementationFeatures, str]:
+        out = {}
+        if resources.is_tpu_slice:
+            spec = resources.slice_spec
+            assert spec is not None
+            if spec.is_pod_slice:
+                out[cloud.CloudImplementationFeatures.STOP] = (
+                    'Multi-host TPU pod slices cannot be stopped; only '
+                    'terminated (the TPU API has no stop for pods).')
+        return out
+
+    # ---- catalog ----------------------------------------------------------
+    def validate_region_zone(self, region: Optional[str],
+                             zone: Optional[str]):
+        return gcp_catalog.validate_region_zone(region, zone)
+
+    def get_hourly_cost(self, resources: 'resources_lib.Resources') -> float:
+        # TPU slice pricing covers the hosts (per-chip-hour includes VM).
+        if resources.is_tpu_slice:
+            acc = resources.tpu_accelerator_name
+            return gcp_catalog.get_accelerator_hourly_cost(
+                acc, 1, resources.use_spot, resources.region, resources.zone)
+        assert resources.instance_type is not None, resources
+        return gcp_catalog.get_hourly_cost(
+            resources.instance_type, resources.use_spot, resources.region,
+            resources.zone)
+
+    def get_egress_cost(self, num_gigabytes: float) -> float:
+        # Tiered internet egress (reference: sky/clouds/gcp.py egress table).
+        if num_gigabytes <= 0:
+            return 0.0
+        if num_gigabytes <= 1024:
+            return 0.12 * num_gigabytes
+        if num_gigabytes <= 10240:
+            return 0.12 * 1024 + 0.11 * (num_gigabytes - 1024)
+        return 0.12 * 1024 + 0.11 * 9216 + 0.08 * (num_gigabytes - 10240)
+
+    @classmethod
+    def get_default_instance_type(cls, cpus: Optional[str] = None,
+                                  memory: Optional[str] = None
+                                  ) -> Optional[str]:
+        return gcp_catalog.get_default_instance_type(cpus, memory)
+
+    @classmethod
+    def get_vcpus_mem_from_instance_type(
+            cls, instance_type: str
+    ) -> Tuple[Optional[float], Optional[float]]:
+        return gcp_catalog.get_vcpus_mem_from_instance_type(instance_type)
+
+    def instance_type_exists(self, instance_type: str) -> bool:
+        return gcp_catalog.get_vcpus_mem_from_instance_type(
+            instance_type)[0] is not None
+
+    # ---- feasibility ------------------------------------------------------
+    def get_feasible_launchable_resources(
+            self, resources: 'resources_lib.Resources',
+            num_nodes: int = 1) -> cloud.ResourcesFeasibility:
+        del num_nodes
+        accs = resources.accelerators
+        if resources.instance_type is not None:
+            if self.instance_type_exists(resources.instance_type):
+                return cloud.ResourcesFeasibility(
+                    [resources.copy(cloud=self)], [])
+            return cloud.ResourcesFeasibility([], [])
+
+        if accs is None:
+            # CPU-only: pick default instance type for cpus/mem.
+            instance_type = gcp_catalog.get_default_instance_type(
+                resources.cpus, resources.memory)
+            if instance_type is None:
+                return cloud.ResourcesFeasibility([], [])
+            return cloud.ResourcesFeasibility(
+                [resources.copy(cloud=self, instance_type=instance_type)], [])
+
+        acc_name, acc_count = next(iter(accs.items()))
+        if tpu_utils.is_tpu(acc_name):
+            zones = gcp_catalog.get_tpu_zones(acc_name)
+            if resources.region is not None:
+                zones = [z for z in zones
+                         if z.rsplit('-', 1)[0] == resources.region]
+            if resources.zone is not None:
+                zones = [z for z in zones if z == resources.zone]
+            if not zones:
+                fuzzy = self._fuzzy_tpu_candidates(acc_name)
+                return cloud.ResourcesFeasibility([], fuzzy)
+            # Slice is launchable as-is; host shape implied.
+            return cloud.ResourcesFeasibility(
+                [resources.copy(cloud=self)], [])
+
+        # GPU path: find host instance types carrying the accelerator.
+        instance_types = gcp_catalog.get_instance_type_for_accelerator(
+            acc_name, acc_count)
+        if not instance_types:
+            fuzzy_all = gcp_catalog.list_accelerators(
+                name_filter=acc_name.split('-')[0], case_sensitive=False)
+            fuzzy = sorted(f'{name}:{int(i.accelerator_count)}'
+                           for name, infos in fuzzy_all.items()
+                           for i in infos[:1])
+            return cloud.ResourcesFeasibility([], fuzzy)
+        return cloud.ResourcesFeasibility(
+            [resources.copy(cloud=self, instance_type=it)
+             for it in instance_types], [])
+
+    @staticmethod
+    def _fuzzy_tpu_candidates(acc_name: str) -> List[str]:
+        parsed = tpu_utils.parse_tpu_name(acc_name)
+        if parsed is None:
+            return []
+        version = parsed[0]
+        return [f'tpu-{version}-{s}'
+                for s in tpu_utils.standard_slice_sizes(version)]
+
+    # ---- failover iteration -----------------------------------------------
+    @classmethod
+    def regions_with_offering(cls, instance_type: Optional[str],
+                              accelerators: Optional[Dict[str, int]],
+                              use_spot: bool, region: Optional[str],
+                              zone: Optional[str]) -> List[cloud.Region]:
+        del use_spot
+        if accelerators:
+            acc_name = next(iter(accelerators))
+            if tpu_utils.is_tpu(acc_name):
+                zones = gcp_catalog.get_tpu_zones(acc_name)
+            else:
+                infos = gcp_catalog.list_accelerators(
+                    name_filter=f'^{acc_name}$').get(acc_name, [])
+                regions_set = {i.region for i in infos}
+                zones = [f'{r}-a' for r in sorted(regions_set)]
+        else:
+            zones = [f'{r}-a' for r in gcp_catalog.regions()]
+        by_region: Dict[str, List[cloud.Zone]] = {}
+        for z in zones:
+            r = z.rsplit('-', 1)[0]
+            by_region.setdefault(r, []).append(cloud.Zone(z))
+        out = []
+        for r, zs in sorted(by_region.items()):
+            if region is not None and r != region:
+                continue
+            if zone is not None:
+                zs = [z for z in zs if z.name == zone]
+                if not zs:
+                    continue
+            out.append(cloud.Region(r).set_zones(zs))
+        return out
+
+    @classmethod
+    def zones_provision_loop(cls, *, region: str, num_nodes: int,
+                             instance_type: Optional[str],
+                             accelerators: Optional[Dict[str, int]],
+                             use_spot: bool
+                             ) -> Iterator[Optional[List[cloud.Zone]]]:
+        # GCP provisions one zone at a time (reference behavior).
+        for r in cls.regions_with_offering(instance_type, accelerators,
+                                           use_spot, region, None):
+            for z in r.zones or []:
+                yield [z]
+
+    # ---- deploy variables -------------------------------------------------
+    def make_deploy_resources_variables(
+            self, resources: 'resources_lib.Resources',
+            cluster_name_on_cloud: str, region: cloud.Region,
+            zones: Optional[List[cloud.Zone]],
+            num_nodes: int) -> Dict[str, Any]:
+        zone = zones[0].name if zones else None
+        out: Dict[str, Any] = {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': region.name,
+            'zone': zone,
+            'num_nodes': num_nodes,
+            'use_spot': resources.use_spot,
+            'disk_size': resources.disk_size,
+            'disk_tier': resources.disk_tier or 'balanced',
+            'ports': resources.ports,
+            'labels': resources.labels or {},
+            'image_id': resources.image_id,
+        }
+        spec = resources.slice_spec
+        if spec is not None:
+            args = resources.accelerator_args
+            out.update({
+                'tpu_vm': True,
+                'tpu_version': spec.version,
+                'tpu_accelerator_type': spec.gcp_accelerator_type(),
+                'tpu_topology': args.get('topology', spec.topology_str),
+                'tpu_num_hosts': spec.num_hosts,
+                'tpu_chips_per_host': spec.chips_per_host,
+                'runtime_version': args.get(
+                    'runtime_version', _DEFAULT_RUNTIME_VERSION[spec.version]),
+                'tpu_reserved': bool(args.get('reserved', False)),
+                'tpu_use_queued_resources': bool(
+                    args.get('queued_resources',
+                             resources.use_spot or spec.is_pod_slice)),
+            })
+        else:
+            out.update({
+                'tpu_vm': False,
+                'instance_type': resources.instance_type,
+                'accelerators': resources.accelerators or {},
+            })
+        return out

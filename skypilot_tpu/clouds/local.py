@@ -1,0 +1,118 @@
+"""Local cloud: process-per-host fake for tests, dev, and CI.
+
+Plays the role the reference fills with `enable_all_clouds` fixtures +
+kind clusters (SURVEY §4): a fully functional cloud whose "hosts" are
+local directories + processes, so the whole launch pipeline (optimizer
+→ provisioner → agent bootstrap → gang exec) runs end-to-end with no
+cloud account. Also emulates TPU slices: a `tpu-v5e-16` on Local
+provisions `num_hosts` local "host" sandboxes so multi-host gang
+execution is exercised for real.
+"""
+from __future__ import annotations
+
+import multiprocessing
+import typing
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import psutil
+
+from skypilot_tpu.clouds import cloud
+from skypilot_tpu.utils import tpu_utils
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+_REGION = 'local'
+_ZONE = 'local-a'
+
+
+@CLOUD_REGISTRY.register()
+class Local(cloud.Cloud):
+    _REPR = 'Local'
+
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        return True, None
+
+    def validate_region_zone(self, region: Optional[str],
+                             zone: Optional[str]):
+        if region is not None and region != _REGION:
+            raise ValueError(f'Local cloud has a single region {_REGION!r}.')
+        if zone is not None and zone != _ZONE:
+            raise ValueError(f'Local cloud has a single zone {_ZONE!r}.')
+        return region, zone
+
+    def get_hourly_cost(self, resources: 'resources_lib.Resources') -> float:
+        return 0.0
+
+    @classmethod
+    def get_default_instance_type(cls, cpus: Optional[str] = None,
+                                  memory: Optional[str] = None
+                                  ) -> Optional[str]:
+        return 'local'
+
+    @classmethod
+    def get_vcpus_mem_from_instance_type(
+            cls, instance_type: str
+    ) -> Tuple[Optional[float], Optional[float]]:
+        return (float(multiprocessing.cpu_count()),
+                psutil.virtual_memory().total / (1024 ** 3))
+
+    def instance_type_exists(self, instance_type: str) -> bool:
+        return instance_type == 'local'
+
+    def get_feasible_launchable_resources(
+            self, resources: 'resources_lib.Resources',
+            num_nodes: int = 1) -> cloud.ResourcesFeasibility:
+        del num_nodes
+        accs = resources.accelerators
+        if accs is not None:
+            acc_name = next(iter(accs))
+            if not tpu_utils.is_tpu(acc_name):
+                return cloud.ResourcesFeasibility([], [])
+            # Emulated TPU slice: accepted; hosts become sandboxes.
+            return cloud.ResourcesFeasibility([resources.copy(cloud=self)], [])
+        return cloud.ResourcesFeasibility(
+            [resources.copy(cloud=self, instance_type='local')], [])
+
+    @classmethod
+    def regions_with_offering(cls, instance_type: Optional[str],
+                              accelerators: Optional[Dict[str, int]],
+                              use_spot: bool, region: Optional[str],
+                              zone: Optional[str]) -> List[cloud.Region]:
+        del instance_type, accelerators, use_spot
+        if region is not None and region != _REGION:
+            return []
+        if zone is not None and zone != _ZONE:
+            return []
+        return [cloud.Region(_REGION).set_zones([cloud.Zone(_ZONE)])]
+
+    @classmethod
+    def zones_provision_loop(cls, *, region: str, num_nodes: int,
+                             instance_type: Optional[str],
+                             accelerators: Optional[Dict[str, int]],
+                             use_spot: bool
+                             ) -> Iterator[Optional[List[cloud.Zone]]]:
+        del region, num_nodes, instance_type, accelerators, use_spot
+        yield [cloud.Zone(_ZONE)]
+
+    def make_deploy_resources_variables(
+            self, resources: 'resources_lib.Resources',
+            cluster_name_on_cloud: str, region: cloud.Region,
+            zones: Optional[List[cloud.Zone]],
+            num_nodes: int) -> Dict[str, Any]:
+        spec = resources.slice_spec
+        return {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': region.name,
+            'zone': zones[0].name if zones else _ZONE,
+            'num_nodes': num_nodes,
+            'use_spot': resources.use_spot,
+            'tpu_vm': spec is not None,
+            'tpu_num_hosts': spec.num_hosts if spec is not None else 1,
+            'tpu_accelerator_type': (spec.gcp_accelerator_type()
+                                     if spec is not None else None),
+            'tpu_chips_per_host': (spec.chips_per_host
+                                   if spec is not None else 0),
+        }

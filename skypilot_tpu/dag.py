@@ -1,0 +1,88 @@
+"""Dag: a graph of Tasks with `>>` chaining.
+
+Reference: sky/dag.py (228 LoC) — networkx-backed task graph, chain
+detection, thread-local dag context for `with Dag():` blocks.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+from skypilot_tpu import task as task_lib
+
+
+class Dag:
+
+    def __init__(self, name: Optional[str] = None) -> None:
+        self.name = name
+        self.tasks: List[task_lib.Task] = []
+        import networkx as nx  # lazy, like the reference
+        self.graph = nx.DiGraph()
+        self.policy_applied: bool = False
+
+    def add(self, task: task_lib.Task) -> None:
+        self.graph.add_node(task)
+        self.tasks.append(task)
+        task.dag = self
+
+    def remove(self, task: task_lib.Task) -> None:
+        self.tasks.remove(task)
+        self.graph.remove_node(task)
+        task.dag = None
+
+    def add_edge(self, op1: task_lib.Task, op2: task_lib.Task) -> None:
+        assert op1 in self.graph.nodes, 'Add tasks before adding edges.'
+        assert op2 in self.graph.nodes, 'Add tasks before adding edges.'
+        self.graph.add_edge(op1, op2)
+
+    def __len__(self) -> int:
+        return len(self.tasks)
+
+    def __enter__(self) -> 'Dag':
+        push_dag(self)
+        return self
+
+    def __exit__(self, *args) -> None:
+        pop_dag()
+
+    def is_chain(self) -> bool:
+        """True iff the graph is a linear chain (possibly a single task)."""
+        import networkx as nx
+        if len(self.tasks) <= 1:
+            return True
+        degrees = dict(self.graph.degree())
+        if any(d > 2 for d in degrees.values()):
+            return False
+        return (nx.is_weakly_connected(self.graph) and
+                nx.is_directed_acyclic_graph(self.graph) and
+                self.graph.number_of_edges() == len(self.tasks) - 1)
+
+    def get_sorted_tasks(self) -> List[task_lib.Task]:
+        import networkx as nx
+        return list(nx.topological_sort(self.graph))
+
+    def validate(self) -> None:
+        import networkx as nx
+        if not nx.is_directed_acyclic_graph(self.graph):
+            raise ValueError('DAG has a cycle.')
+
+    def __repr__(self) -> str:
+        return f'Dag({self.name!r}, {len(self.tasks)} tasks)'
+
+
+_LOCAL = threading.local()
+
+
+def push_dag(dag: Dag) -> None:
+    if not hasattr(_LOCAL, 'stack'):
+        _LOCAL.stack = []
+    _LOCAL.stack.append(dag)
+
+
+def pop_dag() -> Dag:
+    return _LOCAL.stack.pop()
+
+
+def get_current_dag() -> Optional[Dag]:
+    stack = getattr(_LOCAL, 'stack', None)
+    return stack[-1] if stack else None

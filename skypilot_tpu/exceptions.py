@@ -1,0 +1,276 @@
+"""Typed exceptions for skypilot_tpu.
+
+Mirrors the error taxonomy of the reference orchestrator
+(`sky/exceptions.py`) with the subset that matters for a TPU-first
+build: resource availability (carrying failover history), cluster
+lifecycle, job/serve state, and API-server request errors.
+"""
+from __future__ import annotations
+
+import enum
+from typing import Any, Dict, List, Optional
+
+
+class SkyError(Exception):
+    """Base class for all framework errors."""
+
+
+# ---------------------------------------------------------------------------
+# Resources / optimizer
+# ---------------------------------------------------------------------------
+class ResourcesUnavailableError(SkyError):
+    """No cloud/region/zone can satisfy the request.
+
+    Carries the failover history so callers (managed jobs, retrying
+    provisioner) can distinguish capacity errors from config errors.
+    Reference: sky/exceptions.py ResourcesUnavailableError.
+    """
+
+    def __init__(self,
+                 message: str,
+                 no_failover: bool = False,
+                 failover_history: Optional[List[Exception]] = None) -> None:
+        super().__init__(message)
+        self.no_failover = no_failover
+        self.failover_history: List[Exception] = failover_history or []
+
+    def with_failover_history(
+            self, failover_history: List[Exception]
+    ) -> 'ResourcesUnavailableError':
+        self.failover_history = failover_history
+        return self
+
+
+class ResourcesMismatchError(SkyError):
+    """Requested resources do not match the existing cluster."""
+
+
+class InvalidResourcesError(SkyError):
+    """The resources spec itself is invalid (bad accelerator, topology...)."""
+
+
+class NoCloudAccessError(SkyError):
+    """No cloud is enabled / credentials available."""
+
+
+class NotSupportedError(SkyError):
+    """Operation not supported (e.g. stop on a TPU pod slice)."""
+
+
+# ---------------------------------------------------------------------------
+# Cluster lifecycle
+# ---------------------------------------------------------------------------
+class ClusterNotUpError(SkyError):
+    """Cluster is not in UP status."""
+
+    def __init__(self, message: str, cluster_status: Any = None,
+                 handle: Any = None) -> None:
+        super().__init__(message)
+        self.cluster_status = cluster_status
+        self.handle = handle
+
+
+class ClusterDoesNotExist(SkyError):
+    """Cluster name not found in global state."""
+
+
+class ClusterOwnerIdentityMismatchError(SkyError):
+    """Current user identity does not own the cluster."""
+
+
+class ClusterSetUpError(SkyError):
+    """Runtime setup (agent bootstrap) on the cluster failed."""
+
+
+class ProvisionerError(SkyError):
+    """Low-level provision failure for one zone attempt."""
+
+    def __init__(self, message: str, errors: Optional[List[Dict[str, Any]]] = None):
+        super().__init__(message)
+        self.errors = errors or []
+
+
+class ProvisionPrechecksError(SkyError):
+    """Prechecks (quota, permissions) failed before provisioning."""
+
+    def __init__(self, reasons: List[Exception]) -> None:
+        super().__init__(str([str(r) for r in reasons]))
+        self.reasons = reasons
+
+
+class CommandError(SkyError):
+    """A remote command returned non-zero.
+
+    Reference: sky/exceptions.py CommandError.
+    """
+
+    def __init__(self, returncode: int, command: str, error_msg: str,
+                 detailed_reason: Optional[str] = None) -> None:
+        self.returncode = returncode
+        self.command = command
+        self.error_msg = error_msg
+        self.detailed_reason = detailed_reason
+        if len(command) > 100:
+            command = command[:100] + '...'
+        super().__init__(
+            f'Command {command} failed with return code {returncode}.'
+            f'\n{error_msg}')
+
+
+class FetchClusterInfoError(SkyError):
+    """Failed to query cluster info from the cloud."""
+
+    class Reason(enum.Enum):
+        HEAD = 'HEAD'
+        WORKER = 'WORKER'
+
+    def __init__(self, reason: 'FetchClusterInfoError.Reason') -> None:
+        super().__init__(f'Failed to fetch info for {reason.value} node(s).')
+        self.reason = reason
+
+
+# ---------------------------------------------------------------------------
+# Jobs
+# ---------------------------------------------------------------------------
+class JobNotFoundError(SkyError):
+    pass
+
+
+class ManagedJobReachedMaxRetriesError(SkyError):
+    """Managed job exhausted max_restarts_on_errors."""
+
+
+class ManagedJobStatusError(SkyError):
+    """Managed job in unexpected state."""
+
+
+class JobExitNonZeroError(SkyError):
+    """User job exited with a non-zero return code."""
+
+    def __init__(self, message: str, returncode: int) -> None:
+        super().__init__(message)
+        self.returncode = returncode
+
+
+# ---------------------------------------------------------------------------
+# Serve
+# ---------------------------------------------------------------------------
+class ServeUserTerminatedError(SkyError):
+    pass
+
+
+class ServiceNotFoundError(SkyError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Storage
+# ---------------------------------------------------------------------------
+class StorageError(SkyError):
+    pass
+
+
+class StorageSpecError(StorageError):
+    pass
+
+
+class StorageBucketCreateError(StorageError):
+    pass
+
+
+class StorageBucketGetError(StorageError):
+    pass
+
+
+class StorageModeError(StorageError):
+    pass
+
+
+class StorageUploadError(StorageError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# API server / requests
+# ---------------------------------------------------------------------------
+class ApiServerConnectionError(SkyError):
+    def __init__(self, server_url: str) -> None:
+        super().__init__(
+            f'Could not connect to API server at {server_url}. '
+            'Start one with `stpu api start`.')
+        self.server_url = server_url
+
+
+class RequestNotFoundError(SkyError):
+    pass
+
+
+class RequestCancelled(SkyError):
+    pass
+
+
+class ApiRequestError(SkyError):
+    """Server returned an error for a request; wraps the remote traceback."""
+
+    def __init__(self, message: str, remote_traceback: Optional[str] = None,
+                 error_type: Optional[str] = None) -> None:
+        super().__init__(message)
+        self.remote_traceback = remote_traceback
+        self.error_type = error_type
+
+
+# ---------------------------------------------------------------------------
+# Config / validation
+# ---------------------------------------------------------------------------
+class InvalidSkyPilotConfigError(SkyError):
+    pass
+
+
+class InvalidTaskYAMLError(SkyError):
+    pass
+
+
+class UserRequestRejectedByPolicy(SkyError):
+    """Admin policy rejected the request."""
+
+
+# ---------------------------------------------------------------------------
+# Serialization helpers (errors crossing the client/server HTTP boundary)
+# ---------------------------------------------------------------------------
+_EXC_REGISTRY: Dict[str, type] = {}
+
+
+def _register_all() -> None:
+    for obj in list(globals().values()):
+        if isinstance(obj, type) and issubclass(obj, Exception):
+            _EXC_REGISTRY[obj.__name__] = obj
+
+
+def serialize_exception(exc: BaseException) -> Dict[str, Any]:
+    """JSON-serializable form of an exception for the request DB."""
+    import traceback
+    return {
+        'type': type(exc).__name__,
+        'message': str(exc),
+        'traceback': ''.join(
+            traceback.format_exception(type(exc), exc, exc.__traceback__)),
+    }
+
+
+def deserialize_exception(payload: Dict[str, Any]) -> Exception:
+    exc_type = _EXC_REGISTRY.get(payload.get('type', ''), None)
+    msg = payload.get('message', '')
+    if exc_type is None:
+        return ApiRequestError(f"{payload.get('type')}: {msg}",
+                               remote_traceback=payload.get('traceback'),
+                               error_type=payload.get('type'))
+    try:
+        exc = exc_type(msg)
+    except TypeError:
+        exc = ApiRequestError(f"{payload.get('type')}: {msg}",
+                              remote_traceback=payload.get('traceback'),
+                              error_type=payload.get('type'))
+    return exc
+
+
+_register_all()

@@ -1,0 +1,540 @@
+"""Resources: the hardware request attached to a Task.
+
+Reference: sky/resources.py (3033 LoC) — cloud/region/zone, instance
+type, cpus/mem, accelerators, spot, disk, ports, labels, autostop.
+
+TPU-first differences from the reference:
+  - A TPU slice (`tpu-v5p-128`) is the primary unit. It implies the
+    host VM shape and host count via `utils/tpu_utils.py`; no
+    hardcoded 'TPU-VM' pseudo-instance-type
+    (cf. sky/clouds/gcp.py:770-823).
+  - `accelerator_args` carries TPU-specific knobs: `topology`
+    ("4x4x8" ICI torus), `runtime_version`, `reserved`, and
+    `spot_queued` (GCP QueuedResources).
+  - `slice_spec` exposes hosts/chips/ICI topology to the optimizer,
+    provisioner and gang executor.
+"""
+from __future__ import annotations
+
+import textwrap
+from typing import Any, Dict, List, Optional, Set, Tuple, Union
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import accelerator_registry
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import infra_utils
+from skypilot_tpu.utils import tpu_utils
+
+_DEFAULT_DISK_SIZE_GB = 256
+
+
+class Resources:
+    """An immutable-ish hardware request; use `.copy(**overrides)`."""
+
+    def __init__(
+        self,
+        cloud: Optional['clouds.Cloud'] = None,  # noqa: F821
+        instance_type: Optional[str] = None,
+        cpus: Union[None, int, float, str] = None,
+        memory: Union[None, int, float, str] = None,
+        accelerators: Union[None, str, Dict[str, int]] = None,
+        accelerator_args: Optional[Dict[str, Any]] = None,
+        infra: Optional[str] = None,
+        region: Optional[str] = None,
+        zone: Optional[str] = None,
+        use_spot: Optional[bool] = None,
+        job_recovery: Optional[Union[str, Dict[str, Any]]] = None,
+        disk_size: Optional[Union[int, str]] = None,
+        disk_tier: Optional[str] = None,
+        ports: Optional[Union[int, str, List[Union[int, str]]]] = None,
+        image_id: Optional[str] = None,
+        labels: Optional[Dict[str, str]] = None,
+        autostop: Optional[Union[bool, int, Dict[str, Any]]] = None,
+        priority: Optional[int] = None,
+        _cluster_config_overrides: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        self._version = 1
+
+        if infra is not None and (region is not None or zone is not None or
+                                  (cloud is not None and
+                                   not isinstance(cloud, str))):
+            raise ValueError('Specify either `infra` or '
+                             '`cloud`/`region`/`zone`, not both.')
+        if infra is not None:
+            info = infra_utils.InfraInfo.from_str(infra)
+            cloud, region, zone = info.cloud, info.region, info.zone
+
+        if isinstance(cloud, str):
+            from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+            import skypilot_tpu.clouds  # noqa: F401  (registers clouds)
+            cloud_cls = CLOUD_REGISTRY.from_str(cloud)
+            cloud = cloud_cls() if cloud_cls is not None else None
+
+        self._cloud = cloud
+        self._region: Optional[str] = None
+        self._zone: Optional[str] = None
+
+        self._instance_type = instance_type
+        self._cpus = None if cpus is None else str(cpus)
+        self._memory = None if memory is None else str(memory)
+
+        self._use_spot_specified = use_spot is not None
+        self._use_spot = bool(use_spot) if use_spot is not None else False
+        self._job_recovery = self._parse_job_recovery(job_recovery)
+
+        if disk_size is None:
+            self._disk_size = _DEFAULT_DISK_SIZE_GB
+        else:
+            self._disk_size = int(common_utils.parse_memory(disk_size))
+        self._disk_tier = disk_tier
+        self._ports = self._parse_ports(ports)
+        self._image_id = image_id
+        self._labels = dict(labels) if labels else None
+        self._autostop = self._parse_autostop(autostop)
+        self._priority = priority
+        self._cluster_config_overrides = _cluster_config_overrides or {}
+
+        self._accelerators = self._parse_accelerators(accelerators)
+        self._accelerator_args = dict(accelerator_args or {})
+
+        self._validate_and_set_region_zone(region, zone)
+        self._validate_accelerators()
+
+    # -- parsing helpers ----------------------------------------------------
+    @staticmethod
+    def _parse_accelerators(
+            accelerators: Union[None, str, Dict[str, int]]
+    ) -> Optional[Dict[str, int]]:
+        if accelerators is None:
+            return None
+        if isinstance(accelerators, str):
+            if ':' in accelerators:
+                name, count = accelerators.split(':', 1)
+                accelerators = {name.strip(): int(float(count))}
+            else:
+                accelerators = {accelerators.strip(): 1}
+        out = {}
+        for name, count in accelerators.items():
+            canonical = accelerator_registry.canonicalize_accelerator_name(
+                name)
+            out[canonical] = int(count)
+        if len(out) != 1:
+            raise exceptions.InvalidResourcesError(
+                f'Exactly one accelerator type per resource; got {out}.')
+        return out
+
+    @staticmethod
+    def _parse_job_recovery(
+            job_recovery: Optional[Union[str, Dict[str, Any]]]
+    ) -> Optional[Dict[str, Any]]:
+        if job_recovery is None:
+            return None
+        if isinstance(job_recovery, str):
+            return {'strategy': job_recovery.lower()}
+        out = dict(job_recovery)
+        if 'strategy' in out and isinstance(out['strategy'], str):
+            out['strategy'] = out['strategy'].lower()
+        return out
+
+    @staticmethod
+    def _parse_ports(
+            ports: Optional[Union[int, str, List[Union[int, str]]]]
+    ) -> Optional[List[str]]:
+        if ports is None:
+            return None
+        if not isinstance(ports, list):
+            ports = [ports]
+        out = []
+        for p in ports:
+            s = str(p)
+            if '-' in s:
+                lo, hi = s.split('-')
+                int(lo), int(hi)  # validate
+            else:
+                int(s)
+            out.append(s)
+        return sorted(set(out)) or None
+
+    @staticmethod
+    def _parse_autostop(
+            autostop: Optional[Union[bool, int, Dict[str, Any]]]
+    ) -> Optional[Dict[str, Any]]:
+        """Normalize to {'idle_minutes': int, 'down': bool} or None."""
+        if autostop is None or autostop is False:
+            return None
+        if autostop is True:
+            return {'idle_minutes': 5, 'down': False}
+        if isinstance(autostop, int):
+            if autostop < 0:
+                return None
+            return {'idle_minutes': autostop, 'down': False}
+        out = {'idle_minutes': int(autostop.get('idle_minutes', 5)),
+               'down': bool(autostop.get('down', False))}
+        return out
+
+    # -- validation ---------------------------------------------------------
+    def _validate_and_set_region_zone(self, region: Optional[str],
+                                      zone: Optional[str]) -> None:
+        if region is None and zone is None:
+            return
+        if self._cloud is None:
+            # Infer the cloud from region/zone across registered clouds.
+            from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+            import skypilot_tpu.clouds  # noqa: F401
+            candidates = []
+            for cloud_cls in CLOUD_REGISTRY.values():
+                cloud = cloud_cls()
+                try:
+                    cloud.validate_region_zone(region, zone)
+                    candidates.append(cloud)
+                except ValueError:
+                    continue
+            if not candidates:
+                raise ValueError(
+                    f'Invalid (region={region!r}, zone={zone!r}) for any '
+                    'registered cloud.')
+            if len(candidates) > 1:
+                raise ValueError(
+                    f'Multiple clouds match region={region!r}: '
+                    f'{candidates}; specify `infra: <cloud>/{region}`.')
+            self._cloud = candidates[0]
+            self._region, self._zone = self._cloud.validate_region_zone(
+                region, zone)
+        else:
+            self._region, self._zone = self._cloud.validate_region_zone(
+                region, zone)
+
+    def _validate_accelerators(self) -> None:
+        accs = self._accelerators
+        if accs is None:
+            return
+        acc_name = next(iter(accs))
+        if tpu_utils.is_tpu(acc_name):
+            topo = self._accelerator_args.get('topology')
+            # Raises on malformed names/topologies:
+            spec = tpu_utils.get_slice_spec(acc_name, topo)
+            if accs[acc_name] != 1:
+                raise exceptions.InvalidResourcesError(
+                    f'TPU slices are atomic; use a larger slice instead of '
+                    f'{accs[acc_name]}x {acc_name}.')
+            if self._instance_type is not None:
+                raise exceptions.InvalidResourcesError(
+                    'Do not set instance_type with a TPU slice; the slice '
+                    f'({spec.name}) determines the host VM shape.')
+
+    # -- properties ---------------------------------------------------------
+    @property
+    def cloud(self):
+        return self._cloud
+
+    @property
+    def region(self) -> Optional[str]:
+        return self._region
+
+    @property
+    def zone(self) -> Optional[str]:
+        return self._zone
+
+    @property
+    def instance_type(self) -> Optional[str]:
+        return self._instance_type
+
+    @property
+    def cpus(self) -> Optional[str]:
+        return self._cpus
+
+    @property
+    def memory(self) -> Optional[str]:
+        return self._memory
+
+    @property
+    def accelerators(self) -> Optional[Dict[str, int]]:
+        return self._accelerators
+
+    @property
+    def accelerator_args(self) -> Dict[str, Any]:
+        return self._accelerator_args
+
+    @property
+    def use_spot(self) -> bool:
+        return self._use_spot
+
+    @property
+    def use_spot_specified(self) -> bool:
+        return self._use_spot_specified
+
+    @property
+    def job_recovery(self) -> Optional[Dict[str, Any]]:
+        return self._job_recovery
+
+    @property
+    def disk_size(self) -> int:
+        return self._disk_size
+
+    @property
+    def disk_tier(self) -> Optional[str]:
+        return self._disk_tier
+
+    @property
+    def ports(self) -> Optional[List[str]]:
+        return self._ports
+
+    @property
+    def image_id(self) -> Optional[str]:
+        return self._image_id
+
+    @property
+    def labels(self) -> Optional[Dict[str, str]]:
+        return self._labels
+
+    @property
+    def autostop(self) -> Optional[Dict[str, Any]]:
+        return self._autostop
+
+    @property
+    def priority(self) -> Optional[int]:
+        return self._priority
+
+    @property
+    def cluster_config_overrides(self) -> Dict[str, Any]:
+        return self._cluster_config_overrides
+
+    @property
+    def infra(self) -> infra_utils.InfraInfo:
+        cloud = str(self._cloud).lower() if self._cloud else None
+        return infra_utils.InfraInfo(cloud, self._region, self._zone)
+
+    # -- TPU-specific -------------------------------------------------------
+    @property
+    def tpu_accelerator_name(self) -> Optional[str]:
+        if self._accelerators is None:
+            return None
+        name = next(iter(self._accelerators))
+        return name if tpu_utils.is_tpu(name) else None
+
+    @property
+    def is_tpu_slice(self) -> bool:
+        return self.tpu_accelerator_name is not None
+
+    @property
+    def slice_spec(self) -> Optional[tpu_utils.TpuSliceSpec]:
+        name = self.tpu_accelerator_name
+        if name is None:
+            return None
+        return tpu_utils.get_slice_spec(
+            name, self._accelerator_args.get('topology'))
+
+    @property
+    def hosts_per_node(self) -> int:
+        """How many VMs/processes one Task node maps to (1 unless a pod)."""
+        spec = self.slice_spec
+        return spec.num_hosts if spec is not None else 1
+
+    # -- queries ------------------------------------------------------------
+    def is_launchable(self) -> bool:
+        if self._cloud is None:
+            return False
+        if self.is_tpu_slice:
+            return True
+        return self._instance_type is not None
+
+    def assert_launchable(self) -> 'Resources':
+        assert self.is_launchable(), self
+        return self
+
+    def get_cost(self, seconds: float) -> float:
+        """Cost in $ for holding these resources for `seconds`."""
+        hours = seconds / 3600.0
+        assert self._cloud is not None, 'non-launchable resources have no cost'
+        hourly = self._cloud.get_hourly_cost(self)
+        return hourly * hours
+
+    def get_hourly_cost(self) -> float:
+        assert self._cloud is not None
+        return self._cloud.get_hourly_cost(self)
+
+    def less_demanding_than(self, other: 'Resources',
+                            requested_num_nodes: int = 1) -> bool:
+        """Can `other` (an existing cluster's resources) serve `self`?
+
+        Reference: sky/resources.py:1984.
+        """
+        if self._cloud is not None and not self._cloud.is_same_cloud(
+                other.cloud):
+            return False
+        if self._region is not None and self._region != other.region:
+            return False
+        if self._zone is not None and self._zone != other.zone:
+            return False
+        if (self._instance_type is not None and
+                self._instance_type != other.instance_type):
+            return False
+        if self._use_spot_specified and self._use_spot != other.use_spot:
+            return False
+        if self._accelerators is not None:
+            if other.accelerators is None:
+                return False
+            for acc, count in self._accelerators.items():
+                if other.accelerators.get(acc, 0) < count:
+                    return False
+            if self.is_tpu_slice:
+                topo = self._accelerator_args.get('topology')
+                if (topo is not None and
+                        topo != other.accelerator_args.get('topology')):
+                    return False
+        if self._ports is not None:
+            if other.ports is None:
+                return False
+            if not set(self._ports).issubset(set(other.ports)):
+                return False
+        return True
+
+    # -- copy / serialization ----------------------------------------------
+    def copy(self, **override) -> 'Resources':
+        current = dict(
+            cloud=self._cloud,
+            instance_type=self._instance_type,
+            cpus=self._cpus,
+            memory=self._memory,
+            accelerators=self._accelerators,
+            accelerator_args=self._accelerator_args,
+            region=self._region,
+            zone=self._zone,
+            use_spot=self._use_spot if self._use_spot_specified else None,
+            job_recovery=self._job_recovery,
+            disk_size=self._disk_size,
+            disk_tier=self._disk_tier,
+            ports=self._ports,
+            image_id=self._image_id,
+            labels=self._labels,
+            autostop=self._autostop,
+            priority=self._priority,
+            _cluster_config_overrides=self._cluster_config_overrides,
+        )
+        if 'infra' in override:
+            current.pop('cloud', None)
+            current.pop('region', None)
+            current.pop('zone', None)
+        current.update(override)
+        return Resources(**current)
+
+    @classmethod
+    def from_yaml_config(cls, config: Optional[Dict[str, Any]]) -> Set['Resources']:
+        """Parse the `resources:` section; may return multiple candidates.
+
+        Supports `any_of:` / `ordered:` lists like the reference
+        (sky/resources.py from_yaml_config).
+        """
+        if config is None:
+            return {cls()}
+        config = dict(config)
+        any_of = config.pop('any_of', None)
+        ordered = config.pop('ordered', None)
+        if any_of is not None and ordered is not None:
+            raise exceptions.InvalidTaskYAMLError(
+                'Specify any_of or ordered, not both.')
+        base = config
+
+        def make(override: Dict[str, Any]) -> 'Resources':
+            merged = {**base, **override}
+            return cls._from_flat_config(merged)
+
+        if any_of is not None:
+            return {make(o) for o in any_of}
+        if ordered is not None:
+            # Ordered preference encoded via descending priority.
+            out = set()
+            for i, o in enumerate(ordered):
+                r = make(o)
+                r._priority = len(ordered) - i  # pylint: disable=protected-access
+                out.add(r)
+            return out
+        return {make({})}
+
+    @classmethod
+    def _from_flat_config(cls, config: Dict[str, Any]) -> 'Resources':
+        known = dict(config)
+        kwargs: Dict[str, Any] = {}
+        for key in ('infra', 'instance_type', 'cpus', 'memory', 'accelerators',
+                    'accelerator_args', 'use_spot', 'job_recovery', 'disk_size',
+                    'disk_tier', 'ports', 'image_id', 'labels', 'autostop',
+                    'priority'):
+            if key in known:
+                kwargs[key] = known.pop(key)
+        # Back-compat: cloud/region/zone as separate keys.
+        for key in ('cloud', 'region', 'zone'):
+            if key in known:
+                kwargs[key] = known.pop(key)
+        overrides = known.pop('config_overrides', None)
+        if overrides is not None:
+            kwargs['_cluster_config_overrides'] = overrides
+        if known:
+            raise exceptions.InvalidTaskYAMLError(
+                f'Unknown resources fields: {sorted(known)}')
+        return cls(**kwargs)
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        config: Dict[str, Any] = {}
+
+        def add(key, value):
+            if value is not None:
+                config[key] = value
+
+        add('infra', self.infra.to_str())
+        add('instance_type', self._instance_type)
+        add('cpus', self._cpus)
+        add('memory', self._memory)
+        if self._accelerators is not None:
+            name, count = next(iter(self._accelerators.items()))
+            add('accelerators', f'{name}:{count}' if count != 1 else name)
+        if self._accelerator_args:
+            add('accelerator_args', self._accelerator_args)
+        if self._use_spot_specified:
+            config['use_spot'] = self._use_spot
+        add('job_recovery', self._job_recovery)
+        if self._disk_size != _DEFAULT_DISK_SIZE_GB:
+            add('disk_size', self._disk_size)
+        add('disk_tier', self._disk_tier)
+        add('ports', self._ports)
+        add('image_id', self._image_id)
+        add('labels', self._labels)
+        add('autostop', self._autostop)
+        add('priority', self._priority)
+        if self._cluster_config_overrides:
+            add('config_overrides', self._cluster_config_overrides)
+        return config
+
+    # -- misc ---------------------------------------------------------------
+    def __eq__(self, other: object) -> bool:
+        if not isinstance(other, Resources):
+            return NotImplemented
+        return self.to_yaml_config() == other.to_yaml_config()
+
+    def __hash__(self) -> int:
+        return hash(common_utils.json_dumps_compact(self.to_yaml_config()))
+
+    def __repr__(self) -> str:
+        parts = []
+        if self._cloud is not None:
+            parts.append(str(self._cloud))
+        if self._region is not None:
+            parts.append(self._region)
+        if self._zone is not None:
+            parts.append(self._zone)
+        hw = []
+        if self._instance_type:
+            hw.append(self._instance_type)
+        if self._accelerators:
+            name, cnt = next(iter(self._accelerators.items()))
+            hw.append(f'{name}' + (f':{cnt}' if cnt != 1 else ''))
+            spec = self.slice_spec
+            if spec is not None and spec.is_pod_slice:
+                hw.append(f'[{spec.num_hosts} hosts, {spec.topology_str}]')
+        if self._cpus:
+            hw.append(f'cpus={self._cpus}')
+        if self._memory:
+            hw.append(f'mem={self._memory}')
+        if self._use_spot:
+            hw.append('[spot]')
+        loc = '/'.join(parts) if parts else '-'
+        return f'Resources({loc}, {", ".join(hw) or "default"})'

@@ -1,0 +1,274 @@
+"""Task: the unit of work launched on a cluster.
+
+Reference: sky/task.py (2212 LoC) — setup/run commands, num_nodes,
+envs/secrets, workdir, file/storage mounts, resources set, service
+spec, YAML round-trip with validation and ${VAR} fill-in.
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Callable, Dict, List, Optional, Set, Union
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu.utils import common_utils
+
+_VAR_RE = re.compile(r'\$\{\s*([A-Za-z_][A-Za-z0-9_]*)\s*\}')
+
+CommandOrGen = Union[None, str, Callable[[int, List[str]], Optional[str]]]
+
+
+def _fill_in_env_vars(yaml_field: Any, env_vars: Dict[str, str]) -> Any:
+    """Substitute ${VAR} in strings recursively (reference: sky/task.py:83)."""
+    if isinstance(yaml_field, str):
+        return _VAR_RE.sub(
+            lambda m: env_vars.get(m.group(1), m.group(0)), yaml_field)
+    if isinstance(yaml_field, dict):
+        return {k: _fill_in_env_vars(v, env_vars) for k, v in yaml_field.items()}
+    if isinstance(yaml_field, list):
+        return [_fill_in_env_vars(v, env_vars) for v in yaml_field]
+    return yaml_field
+
+
+class Task:
+    """A coarse-grained unit of work: setup + run over N nodes."""
+
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        *,
+        setup: Optional[str] = None,
+        run: CommandOrGen = None,
+        envs: Optional[Dict[str, str]] = None,
+        secrets: Optional[Dict[str, str]] = None,
+        workdir: Optional[str] = None,
+        num_nodes: Optional[int] = None,
+        file_mounts: Optional[Dict[str, str]] = None,
+    ) -> None:
+        self.name = name
+        self.setup = setup
+        self.run = run
+        self.workdir = workdir
+        self._envs = dict(envs or {})
+        self._secrets = dict(secrets or {})
+        self.num_nodes = num_nodes if num_nodes is not None else 1
+        # file_mounts: {remote_path: local_path_or_cloud_uri}
+        self.file_mounts: Dict[str, str] = dict(file_mounts or {})
+        # storage_mounts: {remote_path: storage_lib.Storage}
+        self.storage_mounts: Dict[str, Any] = {}
+        self.resources: Set[resources_lib.Resources] = {
+            resources_lib.Resources()
+        }
+        self.service: Optional[Any] = None  # serve.S022erviceSpec
+        self.best_resources: Optional[resources_lib.Resources] = None
+        self.estimated_runtime: Optional[float] = None
+        # DAG wiring (set by Dag):
+        self.dag: Optional[Any] = None
+        self._validate()
+
+    def _validate(self) -> None:
+        if self.name is not None:
+            common_utils.check_cluster_name_is_valid(self.name.replace('_', '-')
+                                                     if self.name else None)
+        if self.num_nodes < 1:
+            raise exceptions.InvalidTaskYAMLError(
+                f'num_nodes must be >= 1, got {self.num_nodes}')
+        if self.setup is not None and not isinstance(self.setup, str):
+            raise exceptions.InvalidTaskYAMLError(
+                'setup must be a string of commands.')
+        if self.run is not None and not (isinstance(self.run, str) or
+                                         callable(self.run)):
+            raise exceptions.InvalidTaskYAMLError(
+                'run must be a string or a per-node command generator.')
+        for k in self._envs:
+            if not re.fullmatch(r'[A-Za-z_][A-Za-z0-9_]*', k):
+                raise exceptions.InvalidTaskYAMLError(
+                    f'Invalid env var name {k!r}.')
+        overlap = set(self._envs) & set(self._secrets)
+        if overlap:
+            raise exceptions.InvalidTaskYAMLError(
+                f'envs and secrets overlap: {sorted(overlap)}')
+
+    # -- envs ---------------------------------------------------------------
+    @property
+    def envs(self) -> Dict[str, str]:
+        return dict(self._envs)
+
+    @property
+    def secrets(self) -> Dict[str, str]:
+        return dict(self._secrets)
+
+    @property
+    def envs_and_secrets(self) -> Dict[str, str]:
+        out = dict(self._envs)
+        out.update(self._secrets)
+        return out
+
+    def update_envs(self, envs: Optional[Dict[str, str]]) -> 'Task':
+        if envs:
+            for k, v in envs.items():
+                self._envs[str(k)] = str(v)
+        self._validate()
+        return self
+
+    def update_secrets(self, secrets: Optional[Dict[str, str]]) -> 'Task':
+        if secrets:
+            for k, v in secrets.items():
+                self._secrets[str(k)] = str(v)
+        self._validate()
+        return self
+
+    # -- resources ----------------------------------------------------------
+    def set_resources(
+        self, resources: Union[resources_lib.Resources,
+                               Set[resources_lib.Resources],
+                               List[resources_lib.Resources]]
+    ) -> 'Task':
+        if isinstance(resources, resources_lib.Resources):
+            resources = {resources}
+        self.resources = set(resources)
+        return self
+
+    def set_service(self, service: Any) -> 'Task':
+        self.service = service
+        return self
+
+    def set_file_mounts(self, file_mounts: Optional[Dict[str, str]]) -> 'Task':
+        self.file_mounts = dict(file_mounts or {})
+        return self
+
+    def update_file_mounts(self, file_mounts: Dict[str, str]) -> 'Task':
+        self.file_mounts.update(file_mounts)
+        return self
+
+    # -- YAML round-trip ----------------------------------------------------
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any],
+                         env_overrides: Optional[Dict[str, str]] = None,
+                         secret_overrides: Optional[Dict[str, str]] = None
+                         ) -> 'Task':
+        config = dict(config or {})
+        envs = dict(config.get('envs') or {})
+        if env_overrides:
+            envs.update(env_overrides)
+        secrets = dict(config.get('secrets') or {})
+        if secret_overrides:
+            secrets.update(secret_overrides)
+        for k, v in list(envs.items()):
+            if v is None:
+                v = os.environ.get(k)
+                if v is None:
+                    raise exceptions.InvalidTaskYAMLError(
+                        f'Env var {k!r} declared with null value but not set '
+                        'in the caller environment; pass --env or export it.')
+                envs[k] = v
+            envs[k] = str(envs[k])
+        for k, v in list(secrets.items()):
+            if v is None:
+                v = os.environ.get(k)
+                if v is None:
+                    raise exceptions.InvalidTaskYAMLError(
+                        f'Secret {k!r} declared with null value but not set.')
+            secrets[k] = str(v)
+
+        # ${VAR} substitution over the whole config with envs+secrets.
+        config = _fill_in_env_vars(config, {**envs, **secrets})
+        config['envs'] = envs
+        config['secrets'] = secrets
+
+        task = cls(
+            name=config.pop('name', None),
+            setup=config.pop('setup', None),
+            run=config.pop('run', None),
+            envs=config.pop('envs', None),
+            secrets=config.pop('secrets', None),
+            workdir=config.pop('workdir', None),
+            num_nodes=config.pop('num_nodes', None),
+            file_mounts=None,
+        )
+        file_mounts = config.pop('file_mounts', None) or {}
+        plain: Dict[str, str] = {}
+        for dst, src in file_mounts.items():
+            if isinstance(src, dict):
+                # Inline storage spec: {name:, source:, mode:, store:}
+                from skypilot_tpu.data import storage as storage_lib
+                task.storage_mounts[dst] = storage_lib.Storage.from_yaml_config(
+                    src)
+            else:
+                plain[dst] = src
+        task.set_file_mounts(plain)
+
+        resources_config = config.pop('resources', None)
+        task.set_resources(
+            resources_lib.Resources.from_yaml_config(resources_config))
+
+        service = config.pop('service', None)
+        if service is not None:
+            from skypilot_tpu.serve import service_spec
+            task.set_service(service_spec.SkyServiceSpec.from_yaml_config(
+                service))
+        config.pop('config', None)  # per-task config overrides handled upstream
+        experimental = config.pop('experimental', None)
+        del experimental
+        if config:
+            raise exceptions.InvalidTaskYAMLError(
+                f'Unknown task fields: {sorted(config)}')
+        return task
+
+    @classmethod
+    def from_yaml(cls, yaml_path: str) -> 'Task':
+        configs = common_utils.read_yaml_all(os.path.expanduser(yaml_path))
+        configs = [c for c in configs if c is not None]
+        if not configs:
+            return cls()
+        if len(configs) > 1:
+            raise exceptions.InvalidTaskYAMLError(
+                'Multiple YAML documents: use Dag.from_yaml for chains.')
+        return cls.from_yaml_config(configs[0])
+
+    def to_yaml_config(self, redact_secrets: bool = False) -> Dict[str, Any]:
+        config: Dict[str, Any] = {}
+
+        def add(key, value):
+            if value is not None and value != {} and value != []:
+                config[key] = value
+
+        add('name', self.name)
+        if len(self.resources) == 1:
+            add('resources', next(iter(self.resources)).to_yaml_config())
+        else:
+            add('resources',
+                {'any_of': [r.to_yaml_config() for r in self.resources]})
+        if self.num_nodes != 1:
+            add('num_nodes', self.num_nodes)
+        add('workdir', self.workdir)
+        add('setup', self.setup)
+        add('run', self.run if isinstance(self.run, str) else None)
+        add('envs', self._envs or None)
+        if self._secrets:
+            add('secrets', {k: ('<redacted>' if redact_secrets else v)
+                            for k, v in self._secrets.items()})
+        mounts: Dict[str, Any] = dict(self.file_mounts)
+        for dst, store in self.storage_mounts.items():
+            mounts[dst] = store.to_yaml_config()
+        add('file_mounts', mounts or None)
+        if self.service is not None:
+            add('service', self.service.to_yaml_config())
+        return config
+
+    # -- misc ---------------------------------------------------------------
+    def __rshift__(self, other: 'Task') -> 'Task':
+        """task_a >> task_b adds an edge in the current Dag context."""
+        from skypilot_tpu import dag as dag_lib
+        dag = dag_lib.get_current_dag()
+        if dag is None:
+            raise RuntimeError('task_a >> task_b requires a `with Dag():` '
+                               'context.')
+        dag.add_edge(self, other)
+        return other
+
+    def __repr__(self) -> str:
+        label = self.name or 'unnamed'
+        r = next(iter(self.resources)) if self.resources else None
+        return f'Task({label!r}, num_nodes={self.num_nodes}, {r})'

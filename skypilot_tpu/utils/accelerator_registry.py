@@ -1,0 +1,39 @@
+"""Canonical accelerator names.
+
+Reference: sky/utils/accelerator_registry.py — canonicalizes user
+accelerator strings and marks "schedulable non-GPU accelerators"
+(TPUs) that must not be scheduled via GPU counts.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from skypilot_tpu.utils import tpu_utils
+
+# GPUs we keep in the catalog for cost comparison (GCP-first build).
+_CANONICAL_GPUS = {
+    'a100': 'A100',
+    'a100-80gb': 'A100-80GB',
+    'h100': 'H100',
+    'h200': 'H200',
+    'b200': 'B200',
+    'l4': 'L4',
+    't4': 'T4',
+    'v100': 'V100',
+    'p100': 'P100',
+}
+
+
+def canonicalize_accelerator_name(name: str) -> str:
+    lower = name.lower()
+    if tpu_utils.is_tpu(lower):
+        # normalize e.g. TPU-V5P-128 -> tpu-v5p-128
+        return lower
+    if lower in _CANONICAL_GPUS:
+        return _CANONICAL_GPUS[lower]
+    return name
+
+
+def is_schedulable_non_gpu_accelerator(name: Optional[str]) -> bool:
+    """TPUs occupy whole hosts; never count them as GPUs for scheduling."""
+    return tpu_utils.is_tpu(name)

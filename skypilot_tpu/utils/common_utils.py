@@ -1,0 +1,189 @@
+"""Small shared helpers: ids, user, validation, json/yaml dump.
+
+Reference analog: sky/utils/common_utils.py.
+"""
+from __future__ import annotations
+
+import getpass
+import hashlib
+import json
+import os
+import re
+import socket
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import yaml
+
+CLUSTER_NAME_VALID_REGEX = re.compile(r'^[a-zA-Z]([-a-zA-Z0-9]*[a-zA-Z0-9])?$')
+
+_usage_run_id: Optional[str] = None
+
+
+def get_usage_run_id() -> str:
+    global _usage_run_id
+    if _usage_run_id is None:
+        _usage_run_id = str(uuid.uuid4())
+    return _usage_run_id
+
+
+def get_user_hash() -> str:
+    """Stable 8-hex-char id of the local user (reference: user_hash)."""
+    env = os.environ.get('SKYPILOT_USER_ID')
+    if env:
+        return env
+    user = f'{getpass.getuser()}-{socket.gethostname()}'
+    return hashlib.md5(user.encode()).hexdigest()[:8]
+
+
+def get_user_name() -> str:
+    return os.environ.get('SKYPILOT_USER', None) or getpass.getuser()
+
+
+def base36(n: int) -> str:
+    chars = '0123456789abcdefghijklmnopqrstuvwxyz'
+    if n == 0:
+        return '0'
+    out = []
+    while n:
+        n, r = divmod(n, 36)
+        out.append(chars[r])
+    return ''.join(reversed(out))
+
+
+def fresh_cluster_name(prefix: str = 'sky') -> str:
+    return f'{prefix}-{base36(int(time.time()))[-4:]}{base36(uuid.uuid4().int)[:2]}'
+
+
+def check_cluster_name_is_valid(name: Optional[str]) -> None:
+    if name is None:
+        return
+    if not CLUSTER_NAME_VALID_REGEX.match(name):
+        raise ValueError(
+            f'Cluster name {name!r} is invalid: must match '
+            f'{CLUSTER_NAME_VALID_REGEX.pattern} (letters, digits, dashes; '
+            'start with a letter).')
+    if len(name) > 56:
+        raise ValueError(f'Cluster name {name!r} too long (max 56 chars).')
+
+
+def make_cluster_name_on_cloud(display_name: str, max_length: int = 35,
+                               add_user_hash: bool = True) -> str:
+    """Cloud-safe cluster name: lowercase, user-hash suffixed, truncated.
+
+    Reference: common_utils.make_cluster_name_on_cloud.
+    """
+    name = re.sub(r'[^a-z0-9-]', '-', display_name.lower())
+    suffix = f'-{get_user_hash()}' if add_user_hash else ''
+    if len(name) + len(suffix) > max_length:
+        digest = hashlib.md5(name.encode()).hexdigest()[:4]
+        name = name[:max_length - len(suffix) - 5] + '-' + digest
+    return name + suffix
+
+
+def read_yaml(path: str) -> Dict[str, Any]:
+    with open(path, 'r', encoding='utf-8') as f:
+        return yaml.safe_load(f)
+
+
+def read_yaml_all(path: str) -> List[Dict[str, Any]]:
+    with open(path, 'r', encoding='utf-8') as f:
+        return list(yaml.safe_load_all(f))
+
+
+def dump_yaml(path: str, config: Union[Dict, List[Dict]]) -> None:
+    with open(path, 'w', encoding='utf-8') as f:
+        f.write(dump_yaml_str(config))
+
+
+def dump_yaml_str(config: Union[Dict, List[Dict]]) -> str:
+
+    class _Dumper(yaml.SafeDumper):
+        pass
+
+    _Dumper.add_representer(
+        type(None),
+        lambda dumper, _: dumper.represent_scalar('tag:yaml.org,2002:null', 'null'))
+    if isinstance(config, list):
+        return yaml.dump_all(config, Dumper=_Dumper, sort_keys=False,
+                             default_flow_style=False)
+    return yaml.dump(config, Dumper=_Dumper, sort_keys=False,
+                     default_flow_style=False)
+
+
+def format_exception(e: BaseException, use_bracket: bool = False) -> str:
+    name = type(e).__name__
+    if use_bracket:
+        return f'[{name}] {e}'
+    return f'{name}: {e}'
+
+
+def class_fullname(cls: type) -> str:
+    return f'{cls.__module__}.{cls.__name__}'
+
+
+def remove_color(s: str) -> str:
+    return re.sub(r'\x1b\[\d+(;\d+)*m', '', s)
+
+
+def truncate_long_string(s: str, max_length: int = 35) -> str:
+    if len(s) <= max_length:
+        return s
+    return s[:max_length - 3] + '...'
+
+
+def parse_memory(mem: Union[str, int, float, None]) -> Optional[float]:
+    """'16', '16+', '16GB' → 16.0 (GiB). '+' handled by caller via str."""
+    if mem is None:
+        return None
+    s = str(mem).strip().rstrip('+').lower()
+    for suffix, mult in (('gb', 1), ('g', 1), ('tb', 1024), ('t', 1024)):
+        if s.endswith(suffix):
+            return float(s[:-len(suffix)]) * mult
+    return float(s)
+
+
+def retry(func: Optional[Callable] = None, *, max_retries: int = 3,
+          initial_backoff: float = 1.0) -> Callable:
+    """Simple exponential-backoff retry decorator."""
+
+    def wrap(f: Callable) -> Callable:
+
+        def inner(*args, **kwargs):
+            backoff = initial_backoff
+            for attempt in range(max_retries):
+                try:
+                    return f(*args, **kwargs)
+                except Exception:  # pylint: disable=broad-except
+                    if attempt == max_retries - 1:
+                        raise
+                    time.sleep(backoff)
+                    backoff *= 2
+
+        inner.__name__ = f.__name__
+        return inner
+
+    if func is not None:
+        return wrap(func)
+    return wrap
+
+
+def json_dumps_compact(obj: Any) -> str:
+    return json.dumps(obj, separators=(',', ':'), sort_keys=True)
+
+
+class Backoff:
+    """Exponential backoff with jitter-free cap (reference: common_utils.Backoff)."""
+
+    def __init__(self, initial: float = 5.0, max_backoff: float = 60.0,
+                 multiplier: float = 1.6):
+        self._initial = initial
+        self._max = max_backoff
+        self._mult = multiplier
+        self._current = initial
+
+    def current_backoff(self) -> float:
+        cur = self._current
+        self._current = min(self._current * self._mult, self._max)
+        return cur

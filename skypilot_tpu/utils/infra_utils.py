@@ -1,0 +1,47 @@
+"""Parse/format `infra: cloud/region/zone` strings.
+
+Reference: sky/utils/infra_utils.py (`gcp/us-central2/us-central2-b`;
+`k8s/context` for kubernetes).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class InfraInfo:
+    cloud: Optional[str] = None
+    region: Optional[str] = None
+    zone: Optional[str] = None
+
+    @classmethod
+    def from_str(cls, infra: Optional[str]) -> 'InfraInfo':
+        if infra is None or infra.strip() == '':
+            return cls()
+        parts = [p.strip() for p in infra.strip('/').split('/')]
+        wildcard = lambda s: None if s in ('*', '') else s
+        cloud = wildcard(parts[0]) if parts else None
+        if cloud is not None and cloud.lower() in ('k8s', 'kubernetes'):
+            # k8s/context-name — context may itself contain '/'
+            context = '/'.join(parts[1:]) if len(parts) > 1 else None
+            return cls(cloud='kubernetes', region=context, zone=None)
+        region = wildcard(parts[1]) if len(parts) > 1 else None
+        zone = wildcard(parts[2]) if len(parts) > 2 else None
+        if len(parts) > 3:
+            raise ValueError(f'Invalid infra string: {infra!r} '
+                             '(expect cloud[/region[/zone]])')
+        return cls(cloud=cloud, region=region, zone=zone)
+
+    def to_str(self) -> Optional[str]:
+        parts = []
+        for p in (self.cloud, self.region, self.zone):
+            parts.append(p if p is not None else '*')
+        while parts and parts[-1] == '*':
+            parts.pop()
+        if not parts:
+            return None
+        return '/'.join(parts)
+
+    def formatted_str(self) -> str:
+        return self.to_str() or '-'

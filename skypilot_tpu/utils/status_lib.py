@@ -1,0 +1,29 @@
+"""Status enums shared across layers.
+
+Reference: sky/utils/status_lib.py (ClusterStatus, StatusVersion).
+"""
+from __future__ import annotations
+
+import enum
+
+
+class ClusterStatus(enum.Enum):
+    """Status of a cluster, as recorded in global state."""
+    INIT = 'INIT'          # provisioning, or in an inconsistent state
+    UP = 'UP'              # all nodes up, runtime healthy
+    STOPPED = 'STOPPED'    # nodes stopped (disks kept)
+
+    def colored_str(self) -> str:
+        color = {
+            ClusterStatus.INIT: '\x1b[33m',     # yellow
+            ClusterStatus.UP: '\x1b[32m',       # green
+            ClusterStatus.STOPPED: '\x1b[90m',  # gray
+        }[self]
+        return f'{color}{self.value}\x1b[0m'
+
+
+class StorageStatus(enum.Enum):
+    INIT = 'INIT'
+    UPLOAD_FAILED = 'UPLOAD_FAILED'
+    READY = 'READY'
+    DELETED = 'DELETED'
