@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Flagship benchmark: GPT-2 124M training throughput (tokens/sec).
+
+Runs the recipe-model train step (skypilot_tpu/models/gpt.py via the
+sharded trainer) on whatever accelerator is present — the real TPU
+chip under the driver, CPU with --smoke. Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The reference orchestrator publishes no model-throughput numbers
+(BASELINE.md: "published": {}), so vs_baseline is measured against
+this repo's own recorded number in BENCH_BASELINE.json when present
+(ratio >1 = faster than the recorded baseline), else 1.0.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--smoke', action='store_true',
+                        help='tiny model + CPU-friendly shapes')
+    parser.add_argument('--steps', type=int, default=10)
+    parser.add_argument('--warmup', type=int, default=2)
+    parser.add_argument('--batch', type=int, default=0,
+                        help='global batch size (0 = auto)')
+    parser.add_argument('--seq', type=int, default=0)
+    args = parser.parse_args()
+
+    if args.smoke:
+        os.environ.setdefault(
+            'XLA_FLAGS', '--xla_force_host_platform_device_count=1')
+
+    import jax
+    if args.smoke:
+        jax.config.update('jax_platforms', 'cpu')
+    import jax.numpy as jnp
+
+    from skypilot_tpu.models.gpt import GPT, GPTConfig
+    from skypilot_tpu.parallel import mesh as mesh_lib
+    from skypilot_tpu.parallel.train import (ShardedTrainer,
+                                             default_optimizer, shard_batch)
+
+    devices = jax.devices()
+    n_dev = len(devices)
+    platform = devices[0].platform
+
+    if args.smoke:
+        cfg = GPTConfig.tiny()
+        batch = args.batch or 8
+        seq = args.seq or 128
+    else:
+        cfg = GPTConfig.gpt2_124m(remat=False)
+        batch = args.batch or 8 * n_dev
+        seq = args.seq or 1024
+
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshConfig.auto(n_dev))
+    model = GPT(cfg)
+    trainer = ShardedTrainer(model, mesh, tx=default_optimizer())
+
+    example = jnp.zeros((batch, seq), jnp.int32)
+    state = trainer.init(jax.random.PRNGKey(0), example)
+    step = trainer.make_train_step(example)
+
+    rng = jax.random.PRNGKey(1)
+    tokens = shard_batch(
+        jax.random.randint(rng, (batch, seq), 0, cfg.vocab_size, jnp.int32),
+        mesh)
+
+    for _ in range(args.warmup):
+        state, loss = step(state, tokens)
+    jax.block_until_ready(loss)
+
+    start = time.perf_counter()
+    for _ in range(args.steps):
+        state, loss = step(state, tokens)
+    jax.block_until_ready(loss)
+    elapsed = time.perf_counter() - start
+
+    tokens_per_sec = batch * seq * args.steps / elapsed
+    per_chip = tokens_per_sec / n_dev
+
+    # Model FLOPs utilization (6*N*T approximation for training).
+    n_params = cfg.num_params()
+    flops_per_token = 6 * n_params
+    achieved_tflops = tokens_per_sec * flops_per_token / 1e12
+
+    baseline = None
+    base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             'BENCH_BASELINE.json')
+    if os.path.exists(base_path):
+        with open(base_path, 'r', encoding='utf-8') as f:
+            recorded = json.load(f)
+            baseline = recorded.get('value')
+    vs_baseline = (per_chip / baseline) if baseline else 1.0
+
+    result = {
+        'metric': 'gpt2_124m_train_tokens_per_sec_per_chip',
+        'value': round(per_chip, 1),
+        'unit': 'tokens/s/chip',
+        'vs_baseline': round(vs_baseline, 3),
+    }
+    # Extra context on stderr (driver reads the stdout JSON line only).
+    print(f'# platform={platform} n_dev={n_dev} batch={batch} seq={seq} '
+          f'steps={args.steps} elapsed={elapsed:.2f}s '
+          f'loss={float(loss):.3f} ~{achieved_tflops:.1f} TFLOP/s total',
+          file=sys.stderr)
+    print(json.dumps(result))
+
+
+if __name__ == '__main__':
+    main()

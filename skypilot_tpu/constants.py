@@ -1,0 +1,71 @@
+"""Global constants and paths.
+
+Reference analog: sky/skylet/constants.py (the runtime contract).
+"""
+from __future__ import annotations
+
+import os
+
+# Base state directory (server-side). Overridable for test isolation.
+def sky_home() -> str:
+    return os.path.expanduser(
+        os.environ.get('SKYPILOT_TPU_HOME', '~/.sky-tpu'))
+
+
+def state_db_path() -> str:
+    return os.path.join(sky_home(), 'state.db')
+
+
+def cluster_yaml_dir() -> str:
+    return os.path.join(sky_home(), 'generated')
+
+
+def api_server_dir() -> str:
+    return os.path.join(sky_home(), 'api_server')
+
+
+def local_clusters_dir() -> str:
+    return os.path.join(sky_home(), 'local_clusters')
+
+
+def logs_dir() -> str:
+    return os.path.join(sky_home(), 'logs')
+
+
+# ---------------------------------------------------------------------------
+# Env var contract injected into every task (reference:
+# sky/skylet/constants.py:521-526 + JAX multi-host additions).
+# ---------------------------------------------------------------------------
+NODE_RANK_ENV_VAR = 'SKYPILOT_NODE_RANK'
+NODE_IPS_ENV_VAR = 'SKYPILOT_NODE_IPS'
+NUM_NODES_ENV_VAR = 'SKYPILOT_NUM_NODES'
+NUM_GPUS_PER_NODE_ENV_VAR = 'SKYPILOT_NUM_GPUS_PER_NODE'
+TASK_ID_ENV_VAR = 'SKYPILOT_TASK_ID'
+CLUSTER_INFO_ENV_VAR = 'SKYPILOT_CLUSTER_INFO'
+
+# JAX multi-host bootstrap (TPU-native additions; SURVEY §2.4):
+JAX_COORDINATOR_ADDR_ENV_VAR = 'JAX_COORDINATOR_ADDRESS'
+JAX_COORDINATOR_PORT = 8476
+JAX_NUM_PROCESSES_ENV_VAR = 'JAX_NUM_PROCESSES'
+JAX_PROCESS_ID_ENV_VAR = 'JAX_PROCESS_ID'
+TPU_WORKER_ID_ENV_VAR = 'TPU_WORKER_ID'
+TPU_WORKER_HOSTNAMES_ENV_VAR = 'TPU_WORKER_HOSTNAMES'
+TPU_ACCELERATOR_TYPE_ENV_VAR = 'SKYPILOT_TPU_ACCELERATOR_TYPE'
+TPU_NUM_SLICES_ENV_VAR = 'MEGASCALE_NUM_SLICES'
+TPU_SLICE_ID_ENV_VAR = 'MEGASCALE_SLICE_ID'
+MEGASCALE_COORDINATOR_ENV_VAR = 'MEGASCALE_COORDINATOR_ADDRESS'
+
+# On-cluster runtime layout (the agent's world).
+SKY_REMOTE_HOME = '~/.sky-tpu-agent'
+SKY_REMOTE_LOGS_ROOT = '~/sky_logs'
+SKY_REMOTE_WORKDIR = '~/sky_workdir'
+AGENT_PORT = 8477          # agent HTTP control port on the head host
+AGENT_VERSION = 1
+
+# API server defaults.
+API_SERVER_PORT = 46580
+API_SERVER_URL_ENV_VAR = 'SKYPILOT_API_SERVER_ENDPOINT'
+
+# Provisioning.
+PROVISION_TIMEOUT_SECONDS = 1800
+SSH_WAIT_TIMEOUT_SECONDS = 600

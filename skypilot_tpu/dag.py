@@ -50,8 +50,9 @@ class Dag:
         import networkx as nx
         if len(self.tasks) <= 1:
             return True
-        degrees = dict(self.graph.degree())
-        if any(d > 2 for d in degrees.values()):
+        if any(d > 1 for _, d in self.graph.in_degree()):
+            return False
+        if any(d > 1 for _, d in self.graph.out_degree()):
             return False
         return (nx.is_weakly_connected(self.graph) and
                 nx.is_directed_acyclic_graph(self.graph) and

@@ -1,0 +1,274 @@
+"""Server-side global state: clusters, history, events, storage.
+
+Reference: sky/global_user_state.py (3465 LoC, SQLAlchemy). Stdlib
+sqlite here (utils/db_utils.py); handles are pickled like the
+reference's ResourceHandle column.
+"""
+from __future__ import annotations
+
+import functools
+import json
+import pickle
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import constants
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import db_utils
+from skypilot_tpu.utils.status_lib import ClusterStatus
+
+_CREATE_SQL = """\
+CREATE TABLE IF NOT EXISTS clusters (
+    name TEXT PRIMARY KEY,
+    launched_at INTEGER,
+    handle BLOB,
+    last_use TEXT,
+    status TEXT,
+    autostop_minutes INTEGER DEFAULT -1,
+    autostop_down INTEGER DEFAULT 0,
+    owner TEXT,
+    cluster_hash TEXT,
+    resources_str TEXT,
+    workspace TEXT DEFAULT 'default'
+);
+CREATE TABLE IF NOT EXISTS cluster_history (
+    cluster_hash TEXT,
+    name TEXT,
+    launched_at INTEGER,
+    duration INTEGER,
+    resources_str TEXT,
+    num_nodes INTEGER,
+    cost REAL,
+    user TEXT,
+    last_status TEXT
+);
+CREATE TABLE IF NOT EXISTS cluster_events (
+    cluster_name TEXT,
+    timestamp REAL,
+    event_type TEXT,
+    message TEXT
+);
+CREATE TABLE IF NOT EXISTS storage (
+    name TEXT PRIMARY KEY,
+    launched_at INTEGER,
+    handle BLOB,
+    last_use TEXT,
+    status TEXT
+);
+CREATE TABLE IF NOT EXISTS volumes (
+    name TEXT PRIMARY KEY,
+    launched_at INTEGER,
+    config TEXT,
+    status TEXT
+);
+CREATE TABLE IF NOT EXISTS users (
+    user_hash TEXT PRIMARY KEY,
+    name TEXT,
+    created_at INTEGER
+);
+CREATE TABLE IF NOT EXISTS system_config (
+    key TEXT PRIMARY KEY,
+    value TEXT
+);
+"""
+
+
+@functools.lru_cache(maxsize=None)
+def _db_for(path: str) -> db_utils.SQLiteDB:
+    return db_utils.SQLiteDB(path, _CREATE_SQL)
+
+
+def _db() -> db_utils.SQLiteDB:
+    return _db_for(constants.state_db_path())
+
+
+# ---------------------------------------------------------------------------
+# Clusters
+# ---------------------------------------------------------------------------
+def add_or_update_cluster(cluster_name: str, cluster_handle: Any,
+                          requested_resources: Optional[set] = None,
+                          is_launch: bool = True,
+                          ready: bool = False) -> None:
+    """Reference: global_user_state.add_or_update_cluster (:668)."""
+    status = ClusterStatus.UP if ready else ClusterStatus.INIT
+    handle_blob = pickle.dumps(cluster_handle)
+    resources_str = ''
+    num_nodes = getattr(cluster_handle, 'launched_nodes', 1)
+    launched = getattr(cluster_handle, 'launched_resources', None)
+    if launched is not None:
+        resources_str = f'{num_nodes}x {launched}'
+    now = int(time.time())
+    row = _db().query_one('SELECT name, launched_at FROM clusters '
+                          'WHERE name=?', (cluster_name,))
+    launched_at = now if (row is None or is_launch) else row['launched_at']
+    cluster_hash = common_utils.get_user_hash() + '-' + cluster_name
+    _db().execute(
+        'INSERT INTO clusters (name, launched_at, handle, last_use, status, '
+        'owner, cluster_hash, resources_str) '
+        'VALUES (?,?,?,?,?,?,?,?) '
+        'ON CONFLICT(name) DO UPDATE SET launched_at=excluded.launched_at, '
+        'handle=excluded.handle, last_use=excluded.last_use, '
+        'status=excluded.status, resources_str=excluded.resources_str',
+        (cluster_name, launched_at, handle_blob, str(now), status.value,
+         common_utils.get_user_hash(), cluster_hash, resources_str))
+    add_cluster_event(cluster_name,
+                      'launched' if is_launch else 'updated',
+                      resources_str)
+
+
+def update_cluster_handle(cluster_name: str, cluster_handle: Any) -> None:
+    _db().execute('UPDATE clusters SET handle=? WHERE name=?',
+                  (pickle.dumps(cluster_handle), cluster_name))
+
+
+def set_cluster_status(cluster_name: str, status: ClusterStatus) -> None:
+    _db().execute('UPDATE clusters SET status=? WHERE name=?',
+                  (status.value, cluster_name))
+
+
+def update_last_use(cluster_name: str) -> None:
+    _db().execute('UPDATE clusters SET last_use=? WHERE name=?',
+                  (str(int(time.time())), cluster_name))
+
+
+def set_cluster_autostop(cluster_name: str, idle_minutes: int,
+                         down: bool) -> None:
+    _db().execute(
+        'UPDATE clusters SET autostop_minutes=?, autostop_down=? '
+        'WHERE name=?', (idle_minutes, int(down), cluster_name))
+
+
+def remove_cluster(cluster_name: str, terminate: bool) -> None:
+    row = get_cluster(cluster_name)
+    if row is None:
+        return
+    if terminate:
+        # Record history before deletion.
+        duration = int(time.time()) - (row['launched_at'] or 0)
+        handle = row['handle']
+        cost = 0.0
+        try:
+            launched = getattr(handle, 'launched_resources', None)
+            if launched is not None and launched.cloud is not None:
+                cost = launched.get_cost(duration) * getattr(
+                    handle, 'launched_nodes', 1)
+        except Exception:  # pylint: disable=broad-except
+            pass
+        _db().execute(
+            'INSERT INTO cluster_history (cluster_hash, name, launched_at, '
+            'duration, resources_str, num_nodes, cost, user, last_status) '
+            'VALUES (?,?,?,?,?,?,?,?,?)',
+            (row['cluster_hash'], cluster_name, row['launched_at'], duration,
+             row['resources_str'], getattr(handle, 'launched_nodes', 1),
+             cost, row['owner'], row['status'].value))
+        _db().execute('DELETE FROM clusters WHERE name=?', (cluster_name,))
+        _db().execute('DELETE FROM cluster_events WHERE cluster_name=?',
+                      (cluster_name,))
+    else:
+        _db().execute('UPDATE clusters SET status=?, handle=? WHERE name=?',
+                      (ClusterStatus.STOPPED.value,
+                       pickle.dumps(row['handle']), cluster_name))
+        add_cluster_event(cluster_name, 'stopped', '')
+
+
+def _deserialize(row: Dict[str, Any]) -> Dict[str, Any]:
+    out = dict(row)
+    if out.get('handle') is not None:
+        out['handle'] = pickle.loads(out['handle'])
+    if out.get('status') is not None:
+        out['status'] = ClusterStatus(out['status'])
+    return out
+
+
+def get_cluster(cluster_name: str) -> Optional[Dict[str, Any]]:
+    row = _db().query_one('SELECT * FROM clusters WHERE name=?',
+                          (cluster_name,))
+    return _deserialize(row) if row else None
+
+
+def get_clusters() -> List[Dict[str, Any]]:
+    rows = _db().query('SELECT * FROM clusters ORDER BY launched_at DESC')
+    return [_deserialize(r) for r in rows]
+
+
+def get_handle_from_cluster_name(cluster_name: str) -> Optional[Any]:
+    """Reference: global_user_state.get_handle_from_cluster_name (:1515)."""
+    row = get_cluster(cluster_name)
+    return row['handle'] if row else None
+
+
+def get_cluster_status(cluster_name: str) -> Optional[ClusterStatus]:
+    row = get_cluster(cluster_name)
+    return row['status'] if row else None
+
+
+def cluster_with_name_exists(cluster_name: str) -> bool:
+    return get_cluster(cluster_name) is not None
+
+
+# ---------------------------------------------------------------------------
+# Events / history
+# ---------------------------------------------------------------------------
+def add_cluster_event(cluster_name: str, event_type: str,
+                      message: str) -> None:
+    _db().execute(
+        'INSERT INTO cluster_events (cluster_name, timestamp, event_type, '
+        'message) VALUES (?,?,?,?)',
+        (cluster_name, time.time(), event_type, message))
+
+
+def get_cluster_events(cluster_name: str) -> List[Dict[str, Any]]:
+    return _db().query(
+        'SELECT * FROM cluster_events WHERE cluster_name=? ORDER BY timestamp',
+        (cluster_name,))
+
+
+def get_cluster_history() -> List[Dict[str, Any]]:
+    return _db().query(
+        'SELECT * FROM cluster_history ORDER BY launched_at DESC')
+
+
+# ---------------------------------------------------------------------------
+# Storage
+# ---------------------------------------------------------------------------
+def add_or_update_storage(name: str, handle: Any, status: str) -> None:
+    _db().execute(
+        'INSERT INTO storage (name, launched_at, handle, last_use, status) '
+        'VALUES (?,?,?,?,?) ON CONFLICT(name) DO UPDATE SET '
+        'handle=excluded.handle, status=excluded.status, '
+        'last_use=excluded.last_use',
+        (name, int(time.time()), pickle.dumps(handle),
+         str(int(time.time())), status))
+
+
+def get_storage(name: str) -> Optional[Dict[str, Any]]:
+    row = _db().query_one('SELECT * FROM storage WHERE name=?', (name,))
+    if row is None:
+        return None
+    out = dict(row)
+    out['handle'] = pickle.loads(out['handle'])
+    return out
+
+
+def get_storage_names() -> List[str]:
+    return [r['name'] for r in _db().query('SELECT name FROM storage')]
+
+
+def remove_storage(name: str) -> None:
+    _db().execute('DELETE FROM storage WHERE name=?', (name,))
+
+
+# ---------------------------------------------------------------------------
+# System config (key/value)
+# ---------------------------------------------------------------------------
+def get_system_config(key: str, default: Optional[str] = None
+                      ) -> Optional[str]:
+    row = _db().query_one('SELECT value FROM system_config WHERE key=?',
+                          (key,))
+    return row['value'] if row else default
+
+
+def set_system_config(key: str, value: str) -> None:
+    _db().execute(
+        'INSERT INTO system_config (key, value) VALUES (?,?) '
+        'ON CONFLICT(key) DO UPDATE SET value=excluded.value', (key, value))

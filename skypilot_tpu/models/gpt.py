@@ -1,0 +1,158 @@
+"""GPT-2 (nanoGPT-class) in flax.linen with logical sharding axes.
+
+Recipe model #1 (BASELINE.md config 1). Every parameter carries
+logical axis names (`embed`, `mlp`, `heads`, `vocab`, ...) via
+`nn.with_logical_partitioning`; `parallel/train.py` maps them onto a
+mesh (DP×FSDP×TP) with `parallel/mesh.py` rules. Compute is bf16,
+params f32 (standard mixed precision for the MXU).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.ops import attention as attention_ops
+
+Dtype = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50304          # nanoGPT's padded GPT-2 vocab
+    block_size: int = 1024
+    num_layers: int = 12
+    num_heads: int = 12
+    embed_dim: int = 768
+    dropout_rate: float = 0.0
+    dtype: Dtype = jnp.bfloat16
+    remat: bool = False
+
+    @classmethod
+    def gpt2_124m(cls, **kw) -> 'GPTConfig':
+        return cls(num_layers=12, num_heads=12, embed_dim=768, **kw)
+
+    @classmethod
+    def tiny(cls, **kw) -> 'GPTConfig':
+        return cls(vocab_size=512, block_size=128, num_layers=2,
+                   num_heads=4, embed_dim=128, **kw)
+
+    @property
+    def head_dim(self) -> int:
+        return self.embed_dim // self.num_heads
+
+    def num_params(self) -> int:
+        wpe = self.block_size * self.embed_dim
+        wte = self.vocab_size * self.embed_dim
+        per_layer = (12 * self.embed_dim ** 2 + 13 * self.embed_dim)
+        return wte + wpe + self.num_layers * per_layer + 2 * self.embed_dim
+
+
+def _dense(features: int, logical_axes, dtype, name: str,
+           use_bias: bool = True) -> nn.Dense:
+    return nn.Dense(
+        features, dtype=dtype, use_bias=use_bias, name=name,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.normal(stddev=0.02), logical_axes),
+        bias_init=nn.with_logical_partitioning(
+            nn.initializers.zeros_init(), (logical_axes[-1],)))
+
+
+class CausalSelfAttention(nn.Module):
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, deterministic: bool = True) -> jax.Array:
+        cfg = self.config
+        batch, seq, _ = x.shape
+        qkv = _dense(3 * cfg.embed_dim, ('embed', 'mlp'), cfg.dtype,
+                     'c_attn')(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (batch, seq, cfg.num_heads, cfg.head_dim)
+        q, k, v = (t.reshape(shape) for t in (q, k, v))
+        q = nn.with_logical_constraint(q, ('batch', 'seq', 'heads', 'kv'))
+        k = nn.with_logical_constraint(k, ('batch', 'seq', 'heads', 'kv'))
+        v = nn.with_logical_constraint(v, ('batch', 'seq', 'heads', 'kv'))
+        out = attention_ops.dot_product_attention(q, k, v, causal=True)
+        out = out.reshape((batch, seq, cfg.embed_dim))
+        out = _dense(cfg.embed_dim, ('mlp', 'embed'), cfg.dtype, 'c_proj')(out)
+        if cfg.dropout_rate > 0:
+            out = nn.Dropout(cfg.dropout_rate)(out, deterministic)
+        return out
+
+
+class MLP(nn.Module):
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, deterministic: bool = True) -> jax.Array:
+        cfg = self.config
+        h = _dense(4 * cfg.embed_dim, ('embed', 'mlp'), cfg.dtype, 'c_fc')(x)
+        h = nn.gelu(h)
+        h = nn.with_logical_constraint(h, ('batch', 'seq', 'mlp'))
+        h = _dense(cfg.embed_dim, ('mlp', 'embed'), cfg.dtype, 'c_proj')(h)
+        if cfg.dropout_rate > 0:
+            h = nn.Dropout(cfg.dropout_rate)(h, deterministic)
+        return h
+
+
+class Block(nn.Module):
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, deterministic: bool = True) -> jax.Array:
+        cfg = self.config
+        ln = lambda name: nn.LayerNorm(
+            dtype=cfg.dtype, name=name,
+            scale_init=nn.with_logical_partitioning(
+                nn.initializers.ones_init(), ('norm',)),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), ('norm',)))
+        x = x + CausalSelfAttention(cfg, name='attn')(
+            ln('ln_1')(x), deterministic)
+        x = x + MLP(cfg, name='mlp')(ln('ln_2')(x), deterministic)
+        return nn.with_logical_constraint(x, ('batch', 'seq', 'act_embed'))
+
+
+class GPT(nn.Module):
+    """GPT-2 decoder; __call__ returns logits [B, S, vocab]."""
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array,
+                 deterministic: bool = True) -> jax.Array:
+        cfg = self.config
+        _, seq = tokens.shape
+        assert seq <= cfg.block_size, (seq, cfg.block_size)
+        wte = self.param(
+            'wte',
+            nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ('vocab', 'embed')),
+            (cfg.vocab_size, cfg.embed_dim), jnp.float32)
+        wpe = self.param(
+            'wpe',
+            nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.01), ('seq', 'embed')),
+            (cfg.block_size, cfg.embed_dim), jnp.float32)
+        x = wte.astype(cfg.dtype)[tokens] + wpe.astype(cfg.dtype)[:seq]
+        x = nn.with_logical_constraint(x, ('batch', 'seq', 'act_embed'))
+
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block, prevent_cse=False,
+                             static_argnums=(2,))
+        for i in range(cfg.num_layers):
+            x = block(cfg, name=f'h_{i}')(x, deterministic)
+        x = nn.LayerNorm(
+            dtype=cfg.dtype, name='ln_f',
+            scale_init=nn.with_logical_partitioning(
+                nn.initializers.ones_init(), ('norm',)),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), ('norm',)))(x)
+        # Tied output head (nanoGPT style): logits = x @ wte^T in f32.
+        logits = jnp.einsum('bse,ve->bsv', x.astype(jnp.float32),
+                            wte.astype(jnp.float32))
+        return nn.with_logical_constraint(logits, ('batch', 'seq', 'vocab'))

@@ -1,0 +1,173 @@
+"""Llama-3-family decoder in flax.linen with logical sharding axes.
+
+Recipe model #2 (BASELINE.md configs 2/4): RMSNorm, rotary position
+embeddings, grouped-query attention, SwiGLU MLP, untied LM head.
+Same logical-axis scheme as models/gpt.py so one rules table drives
+DP×FSDP×TP for both.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.ops import attention as attention_ops
+
+Dtype = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    max_seq_len: int = 8192
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    embed_dim: int = 4096
+    mlp_dim: int = 14336
+    rope_theta: float = 500_000.0
+    norm_eps: float = 1e-5
+    dtype: Dtype = jnp.bfloat16
+    remat: bool = False
+
+    @classmethod
+    def llama3_8b(cls, **kw) -> 'LlamaConfig':
+        return cls(**kw)
+
+    @classmethod
+    def llama3_70b(cls, **kw) -> 'LlamaConfig':
+        return cls(num_layers=80, num_heads=64, num_kv_heads=8,
+                   embed_dim=8192, mlp_dim=28672, **kw)
+
+    @classmethod
+    def tiny(cls, **kw) -> 'LlamaConfig':
+        return cls(vocab_size=512, max_seq_len=256, num_layers=2,
+                   num_heads=4, num_kv_heads=2, embed_dim=128, mlp_dim=384,
+                   **kw)
+
+    @property
+    def head_dim(self) -> int:
+        return self.embed_dim // self.num_heads
+
+
+def apply_rope(x: jax.Array, positions: jax.Array,
+               theta: float) -> jax.Array:
+    """x: [B, S, H, D]; rotary embedding on the last dim."""
+    d_half = x.shape[-1] // 2
+    freqs = 1.0 / (theta ** (jnp.arange(d_half, dtype=jnp.float32) / d_half))
+    angles = positions[:, :, None, None].astype(jnp.float32) * freqs  # B,S,1,Dh
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        scale = self.param(
+            'scale',
+            nn.with_logical_partitioning(nn.initializers.ones_init(),
+                                         ('norm',)),
+            (x.shape[-1],), jnp.float32)
+        x32 = x.astype(jnp.float32)
+        var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+        out = x32 * jax.lax.rsqrt(var + self.eps) * scale
+        return out.astype(self.dtype)
+
+
+def _proj(features: int, axes, dtype, name: str) -> nn.Dense:
+    return nn.Dense(
+        features, use_bias=False, dtype=dtype, name=name,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.normal(stddev=0.02), axes))
+
+
+class Attention(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
+        cfg = self.config
+        batch, seq, _ = x.shape
+        hd = cfg.head_dim
+        q = _proj(cfg.num_heads * hd, ('embed', 'heads'), cfg.dtype,
+                  'wq')(x).reshape(batch, seq, cfg.num_heads, hd)
+        k = _proj(cfg.num_kv_heads * hd, ('embed', 'heads'), cfg.dtype,
+                  'wk')(x).reshape(batch, seq, cfg.num_kv_heads, hd)
+        v = _proj(cfg.num_kv_heads * hd, ('embed', 'heads'), cfg.dtype,
+                  'wv')(x).reshape(batch, seq, cfg.num_kv_heads, hd)
+        q = apply_rope(q, positions, cfg.rope_theta)
+        k = apply_rope(k, positions, cfg.rope_theta)
+        q = nn.with_logical_constraint(q, ('batch', 'seq', 'heads', 'kv'))
+        k = nn.with_logical_constraint(k, ('batch', 'seq', 'heads', 'kv'))
+        v = nn.with_logical_constraint(v, ('batch', 'seq', 'heads', 'kv'))
+        out = attention_ops.dot_product_attention(q, k, v, causal=True)
+        out = out.reshape(batch, seq, cfg.num_heads * hd)
+        return _proj(cfg.embed_dim, ('heads', 'embed'), cfg.dtype, 'wo')(out)
+
+
+class FeedForward(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.config
+        gate = _proj(cfg.mlp_dim, ('embed', 'mlp'), cfg.dtype, 'w_gate')(x)
+        up = _proj(cfg.mlp_dim, ('embed', 'mlp'), cfg.dtype, 'w_up')(x)
+        h = nn.silu(gate) * up
+        h = nn.with_logical_constraint(h, ('batch', 'seq', 'mlp'))
+        return _proj(cfg.embed_dim, ('mlp', 'embed'), cfg.dtype, 'w_down')(h)
+
+
+class Block(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
+        cfg = self.config
+        x = x + Attention(cfg, name='attn')(
+            RMSNorm(cfg.norm_eps, cfg.dtype, name='attn_norm')(x), positions)
+        x = x + FeedForward(cfg, name='mlp')(
+            RMSNorm(cfg.norm_eps, cfg.dtype, name='mlp_norm')(x))
+        return nn.with_logical_constraint(x, ('batch', 'seq', 'act_embed'))
+
+
+class Llama(nn.Module):
+    """Llama decoder; __call__ returns logits [B, S, vocab] (f32)."""
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array,
+                 positions: Optional[jax.Array] = None) -> jax.Array:
+        cfg = self.config
+        batch, seq = tokens.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(seq), (batch, seq))
+        embed = self.param(
+            'tok_embed',
+            nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ('vocab', 'embed')),
+            (cfg.vocab_size, cfg.embed_dim), jnp.float32)
+        x = embed.astype(cfg.dtype)[tokens]
+        x = nn.with_logical_constraint(x, ('batch', 'seq', 'act_embed'))
+
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block, prevent_cse=False)
+        for i in range(cfg.num_layers):
+            x = block(cfg, name=f'layer_{i}')(x, positions)
+        x = RMSNorm(cfg.norm_eps, cfg.dtype, name='final_norm')(x)
+        head = self.param(
+            'lm_head',
+            nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ('embed', 'vocab')),
+            (cfg.embed_dim, cfg.vocab_size), jnp.float32)
+        logits = jnp.einsum('bse,ev->bsv', x.astype(jnp.float32), head)
+        return nn.with_logical_constraint(logits, ('batch', 'seq', 'vocab'))

@@ -1,0 +1,64 @@
+"""Attention dispatch: pallas TPU flash attention when profitable.
+
+MXU-friendly attention for the recipe models. On TPU with long enough
+sequences, uses the pallas flash-attention kernel (blockwise softmax,
+O(S) memory, no S×S materialization in HBM); otherwise falls back to
+`jax.nn.dot_product_attention` (XLA fuses the mask+softmax chain).
+
+Layout convention: q/k/v are [batch, seq, heads, head_dim] (BSHD).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_FLASH_MIN_SEQ = 1024  # below this, XLA's fused attention wins on TPU
+
+
+@functools.lru_cache(maxsize=1)
+def _pallas_flash_available() -> bool:
+    if jax.default_backend() != 'tpu':
+        return False
+    try:
+        from jax.experimental.pallas.ops.tpu import flash_attention  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                          *, causal: bool = True,
+                          impl: str = 'auto') -> jax.Array:
+    """q: [B,S,H,D]; k/v: [B,S,Hkv,D] (GQA allowed). Returns [B,S,H,D]."""
+    assert q.ndim == 4 and k.ndim == 4 and v.ndim == 4, (q.shape, k.shape)
+    seq_len = q.shape[1]
+    use_flash = (impl == 'flash' or
+                 (impl == 'auto' and _pallas_flash_available() and
+                  seq_len >= _FLASH_MIN_SEQ))
+    if use_flash:
+        return _flash(q, k, v, causal=causal)
+    # GQA: expand kv heads to q heads for the XLA path.
+    num_q_heads, num_kv_heads = q.shape[2], k.shape[2]
+    if num_kv_heads != num_q_heads:
+        rep = num_q_heads // num_kv_heads
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    return jax.nn.dot_product_attention(q, k, v, is_causal=causal)
+
+
+def _flash(q: jax.Array, k: jax.Array, v: jax.Array, *,
+           causal: bool) -> jax.Array:
+    from jax.experimental.pallas.ops.tpu import flash_attention as fa
+    num_q_heads, num_kv_heads = q.shape[2], k.shape[2]
+    if num_kv_heads != num_q_heads:
+        rep = num_q_heads // num_kv_heads
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    # pallas kernel wants [B,H,S,D]
+    q_, k_, v_ = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+    sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    out = fa.flash_attention(q_, k_, v_, causal=causal, sm_scale=sm_scale)
+    return jnp.swapaxes(out, 1, 2)

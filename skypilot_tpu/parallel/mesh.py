@@ -1,0 +1,137 @@
+"""Device mesh construction and logical sharding rules.
+
+The TPU-native parallelism model: pick a `jax.sharding.Mesh` whose
+axes are the parallelism dimensions (data / fsdp / tensor / expert /
+seq), annotate model arrays with *logical* axis names, and map logical
+→ mesh axes with a rules table. XLA GSPMD then inserts the ICI/DCN
+collectives. (The reference orchestrator has no parallelism layer —
+SURVEY.md §2.4 — it launches user torchrun code; here the framework
+ships the recipe layer itself, jax-first.)
+
+Multislice: `make_mesh` uses a hybrid mesh when
+`jax.devices()` spans slices, putting DCN-parallel axes (data) on the
+outer (slice) dimension and ICI axes (fsdp/tensor) inside a slice.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+# Logical axis name -> mesh axis (or tuple of mesh axes) mapping.
+# Flax linen spmd consumes these as `rules`.
+DEFAULT_RULES: Tuple[Tuple[str, Optional[object]], ...] = (
+    ('batch', ('data', 'fsdp')),   # batch sharded over data- and fsdp-axes
+    ('seq', 'seq'),                # sequence (context) parallelism axis
+    ('act_embed', None),           # activations' embed dim stays unsharded
+    ('embed', 'fsdp'),             # FSDP: shard params' embed dim
+    ('heads', 'tensor'),           # TP: attention heads
+    ('kv', None),
+    ('mlp', 'tensor'),             # TP: MLP hidden
+    ('vocab', 'tensor'),           # TP: embedding/vocab
+    ('expert', 'expert'),          # MoE expert parallelism
+    ('norm', None),
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Named mesh axis sizes. Size 1 axes are kept (harmless to XLA)."""
+    data: int = 1
+    fsdp: int = 1
+    tensor: int = 1
+    expert: int = 1
+    seq: int = 1
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return ('data', 'fsdp', 'tensor', 'expert', 'seq')
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return (self.data, self.fsdp, self.tensor, self.expert, self.seq)
+
+    @property
+    def num_devices(self) -> int:
+        return math.prod(self.shape)
+
+    @classmethod
+    def auto(cls, num_devices: Optional[int] = None,
+             tensor: int = 1, expert: int = 1, seq: int = 1) -> 'MeshConfig':
+        """FSDP-first auto config: all remaining devices on the fsdp axis."""
+        if num_devices is None:
+            num_devices = len(jax.devices())
+        inner = tensor * expert * seq
+        if num_devices % inner != 0:
+            raise ValueError(
+                f'{num_devices} devices not divisible by '
+                f'tensor*expert*seq={inner}')
+        return cls(data=1, fsdp=num_devices // inner, tensor=tensor,
+                   expert=expert, seq=seq)
+
+
+def make_mesh(config: MeshConfig,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a Mesh, ICI-topology-aware within a slice, DCN-aware across.
+
+    Within one TPU slice, `mesh_utils.create_device_mesh` lays the mesh
+    onto the physical torus so that the innermost axes (tensor) ride
+    the shortest ICI paths. Across slices (or hosts without ICI), the
+    `data` axis is placed on DCN via the hybrid mesh helper.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    if config.num_devices != len(devices):
+        raise ValueError(
+            f'Mesh needs {config.num_devices} devices, got {len(devices)}.')
+
+    num_slices = len({getattr(d, 'slice_index', 0) for d in devices})
+    if num_slices > 1:
+        # Put data-parallel (the DCN-tolerant axis) across slices.
+        if config.data % num_slices != 0:
+            raise ValueError(
+                f'data axis ({config.data}) must be divisible by the '
+                f'number of slices ({num_slices}) for multislice meshes.')
+        dcn_shape = [num_slices] + [1] * (len(config.shape) - 1)
+        ici_shape = [config.data // num_slices, *config.shape[1:]]
+        device_array = mesh_utils.create_hybrid_device_mesh(
+            ici_shape, dcn_shape, devices=devices)
+    else:
+        try:
+            device_array = mesh_utils.create_device_mesh(
+                config.shape, devices=devices)
+        except (ValueError, AssertionError):
+            # Fallback (e.g. CPU device counts with no physical topology).
+            device_array = np.asarray(devices).reshape(config.shape)
+    return Mesh(device_array, config.axis_names)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for (batch, seq, ...) input arrays."""
+    return NamedSharding(mesh, P(('data', 'fsdp'), None))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def rules_with_overrides(
+        overrides: Optional[Dict[str, Optional[object]]] = None
+) -> Tuple[Tuple[str, Optional[object]], ...]:
+    rules = dict(DEFAULT_RULES)
+    if overrides:
+        rules.update(overrides)
+    return tuple(rules.items())
+
+
+def mesh_summary(mesh: Mesh) -> str:
+    parts = [f'{name}={size}' for name, size in
+             zip(mesh.axis_names, mesh.devices.shape) if size > 1]
+    return f'Mesh({", ".join(parts) or "single-device"})'

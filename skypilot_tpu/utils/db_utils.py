@@ -1,0 +1,68 @@
+"""Tiny sqlite helper: thread-local connections, dict rows, migrations.
+
+The reference uses SQLAlchemy (sky/global_user_state.py); this build
+uses stdlib sqlite3 with WAL mode — one writer, many readers — which
+matches the single-API-server deployment model.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import sqlite3
+import threading
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+
+class SQLiteDB:
+
+    def __init__(self, path: str, create_table_sql: str) -> None:
+        self.path = os.path.expanduser(path)
+        if self.path != ':memory:':
+            os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        self._local = threading.local()
+        self._create_sql = create_table_sql
+        with self.conn() as conn:
+            conn.executescript(create_table_sql)
+
+    def _get_conn(self) -> sqlite3.Connection:
+        conn = getattr(self._local, 'conn', None)
+        if conn is None:
+            conn = sqlite3.connect(self.path, timeout=30.0)
+            conn.row_factory = sqlite3.Row
+            with contextlib.suppress(sqlite3.OperationalError):
+                conn.execute('PRAGMA journal_mode=WAL')
+            conn.execute('PRAGMA synchronous=NORMAL')
+            self._local.conn = conn
+        return conn
+
+    @contextlib.contextmanager
+    def conn(self) -> Iterator[sqlite3.Connection]:
+        conn = self._get_conn()
+        try:
+            yield conn
+            conn.commit()
+        except Exception:
+            conn.rollback()
+            raise
+
+    def execute(self, sql: str, params: tuple = ()) -> None:
+        with self.conn() as conn:
+            conn.execute(sql, params)
+
+    def query(self, sql: str, params: tuple = ()) -> List[Dict[str, Any]]:
+        with self.conn() as conn:
+            rows = conn.execute(sql, params).fetchall()
+            return [dict(r) for r in rows]
+
+    def query_one(self, sql: str,
+                  params: tuple = ()) -> Optional[Dict[str, Any]]:
+        rows = self.query(sql, params)
+        return rows[0] if rows else None
+
+    def add_column_if_missing(self, table: str, column: str,
+                              decl: str) -> None:
+        with self.conn() as conn:
+            cols = [r[1] for r in
+                    conn.execute(f'PRAGMA table_info({table})').fetchall()]
+            if column not in cols:
+                conn.execute(f'ALTER TABLE {table} ADD COLUMN {column} {decl}')

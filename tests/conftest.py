@@ -1,0 +1,40 @@
+"""Test config: 8-device CPU mesh + isolated state dir.
+
+JAX note: this container routes JAX through the axon TPU plugin whose
+sitecustomize forces the axon platform; `jax.config.update` (not the
+JAX_PLATFORMS env var) is the reliable way to pin tests to CPU. Must
+happen before any backend initialization, hence at conftest import.
+"""
+import os
+import sys
+
+# 8 virtual CPU devices for sharding tests (must precede backend init).
+_flags = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in _flags:
+    os.environ['XLA_FLAGS'] = (
+        _flags + ' --xla_force_host_platform_device_count=8').strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update('jax_platforms', 'cpu')
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def isolated_state(tmp_path, monkeypatch):
+    """Point SKYPILOT_TPU_HOME at a fresh dir; clear db caches."""
+    home = tmp_path / 'sky-home'
+    monkeypatch.setenv('SKYPILOT_TPU_HOME', str(home))
+    from skypilot_tpu import global_state
+    global_state._db_for.cache_clear()  # pylint: disable=protected-access
+    yield str(home)
+    global_state._db_for.cache_clear()  # pylint: disable=protected-access
+
+
+@pytest.fixture(scope='session')
+def cpu_mesh8():
+    from skypilot_tpu.parallel import mesh as mesh_lib
+    return mesh_lib.make_mesh(mesh_lib.MeshConfig(data=2, fsdp=2, tensor=2))

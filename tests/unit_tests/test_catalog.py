@@ -1,0 +1,58 @@
+"""Catalog queries feeding the optimizer."""
+import pytest
+
+from skypilot_tpu.catalog import gcp_catalog
+
+
+def test_tpu_zones():
+    zones = gcp_catalog.get_tpu_zones('tpu-v5p-128')
+    assert zones, 'v5p must be offered somewhere'
+    assert all(z.count('-') >= 2 for z in zones)
+    # Huge pods only in big zones:
+    big = gcp_catalog.get_tpu_zones('tpu-v5p-3072')
+    assert set(big).issubset({'us-east5-a', 'us-central2-b'})
+
+
+def test_tpu_cost_scales_with_chips():
+    c16 = gcp_catalog.get_accelerator_hourly_cost('tpu-v5e-16', 1, False)
+    c32 = gcp_catalog.get_accelerator_hourly_cost('tpu-v5e-32', 1, False)
+    assert c32 == pytest.approx(2 * c16, rel=0.01)
+    spot = gcp_catalog.get_accelerator_hourly_cost('tpu-v5e-16', 1, True)
+    assert spot < c16
+
+
+def test_vm_selection():
+    it = gcp_catalog.get_instance_type_for_cpus_mem('8', None)
+    assert it is not None
+    vcpus, mem = gcp_catalog.get_vcpus_mem_from_instance_type(it)
+    assert vcpus == 8
+    # default: 8+ cpus, >=4GiB/cpu
+    default = gcp_catalog.get_default_instance_type()
+    vcpus, mem = gcp_catalog.get_vcpus_mem_from_instance_type(default)
+    assert vcpus >= 8 and mem >= vcpus * 4
+
+
+def test_gpu_instance_lookup():
+    its = gcp_catalog.get_instance_type_for_accelerator('A100', 8)
+    assert its == ['a2-highgpu-8g']
+    accs = gcp_catalog.get_accelerators_from_instance_type('a2-highgpu-8g')
+    assert accs == {'A100': 8}
+
+
+def test_list_accelerators_filter():
+    out = gcp_catalog.list_accelerators(name_filter='tpu-v6e')
+    assert all(k.startswith('tpu-v6e') for k in out)
+    assert 'tpu-v6e-8' in out
+
+
+def test_validate_region_zone():
+    region, zone = gcp_catalog.validate_region_zone(None, 'us-central2-b')
+    assert region == 'us-central2'
+    with pytest.raises(ValueError):
+        gcp_catalog.validate_region_zone('mars', None)
+
+
+def test_unknown_accelerator_pricing():
+    with pytest.raises(ValueError):
+        gcp_catalog.get_accelerator_hourly_cost('tpu-v5p-128', 1, False,
+                                                region='mars')

@@ -1,0 +1,50 @@
+"""Cluster state CRUD with isolated home dir."""
+from skypilot_tpu.utils.status_lib import ClusterStatus
+
+
+class FakeHandle:
+    def __init__(self):
+        self.cluster_name = 'c'
+        self.launched_nodes = 2
+        self.launched_resources = None
+
+
+def test_cluster_lifecycle(isolated_state):
+    from skypilot_tpu import global_state
+    handle = FakeHandle()
+    global_state.add_or_update_cluster('c1', handle, ready=False)
+    assert global_state.get_cluster_status('c1') == ClusterStatus.INIT
+    global_state.add_or_update_cluster('c1', handle, is_launch=False,
+                                       ready=True)
+    assert global_state.get_cluster_status('c1') == ClusterStatus.UP
+    h = global_state.get_handle_from_cluster_name('c1')
+    assert h.launched_nodes == 2
+
+    global_state.set_cluster_autostop('c1', 10, True)
+    row = global_state.get_cluster('c1')
+    assert row['autostop_minutes'] == 10 and row['autostop_down'] == 1
+
+    events = global_state.get_cluster_events('c1')
+    assert events and events[0]['event_type'] == 'launched'
+
+    global_state.remove_cluster('c1', terminate=False)
+    assert global_state.get_cluster_status('c1') == ClusterStatus.STOPPED
+
+    global_state.remove_cluster('c1', terminate=True)
+    assert global_state.get_cluster('c1') is None
+    hist = global_state.get_cluster_history()
+    assert hist and hist[0]['name'] == 'c1'
+
+
+def test_storage_and_config(isolated_state):
+    from skypilot_tpu import global_state
+    global_state.add_or_update_storage('bkt', {'url': 'gs://bkt'}, 'READY')
+    assert global_state.get_storage('bkt')['handle'] == {'url': 'gs://bkt'}
+    assert global_state.get_storage_names() == ['bkt']
+    global_state.remove_storage('bkt')
+    assert global_state.get_storage('bkt') is None
+
+    assert global_state.get_system_config('k', 'd') == 'd'
+    global_state.set_system_config('k', 'v1')
+    global_state.set_system_config('k', 'v2')
+    assert global_state.get_system_config('k') == 'v2'

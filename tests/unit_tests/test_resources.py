@@ -1,0 +1,110 @@
+"""Resources parsing/validation/cost (reference analog:
+tests/unit_tests/test_resources.py)."""
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.resources import Resources
+
+
+def test_tpu_slice_basic():
+    r = Resources(accelerators='tpu-v5p-128', infra='gcp')
+    assert r.is_tpu_slice
+    assert r.hosts_per_node == 16
+    assert r.is_launchable()
+    assert r.get_hourly_cost() == pytest.approx(4.20 * 64, rel=0.01)
+
+
+def test_spot_cheaper():
+    on_demand = Resources(accelerators='tpu-v5e-16', infra='gcp')
+    spot = Resources(accelerators='tpu-v5e-16', infra='gcp', use_spot=True)
+    assert spot.get_hourly_cost() < on_demand.get_hourly_cost()
+
+
+def test_accelerator_string_forms():
+    assert Resources(accelerators='tpu-v5e-8').accelerators == {
+        'tpu-v5e-8': 1}
+    assert Resources(accelerators='A100:4').accelerators == {'A100': 4}
+    assert Resources(accelerators={'a100': 8}).accelerators == {'A100': 8}
+
+
+def test_infra_parsing():
+    r = Resources(infra='gcp/us-central2/us-central2-b')
+    assert str(r.cloud) == 'GCP'
+    assert r.region == 'us-central2'
+    assert r.zone == 'us-central2-b'
+    r2 = Resources(infra='gcp/*/us-central1-a')
+    assert r2.region == 'us-central1'
+
+
+def test_zone_infers_region_and_cloud():
+    r = Resources(zone='us-central2-b')
+    assert r.region == 'us-central2'
+    assert str(r.cloud) == 'GCP'
+
+
+def test_copy_override():
+    r = Resources(accelerators='tpu-v5e-16', use_spot=True)
+    r2 = r.copy(use_spot=False)
+    assert r2.accelerators == {'tpu-v5e-16': 1}
+    assert not r2.use_spot
+    r3 = r.copy(infra='gcp/us-west4')
+    assert r3.region == 'us-west4'
+    assert r3.use_spot
+
+
+def test_yaml_round_trip():
+    cfgs = [
+        {'infra': 'gcp', 'accelerators': 'tpu-v5p-64',
+         'accelerator_args': {'runtime_version': 'v2-alpha-tpuv5'},
+         'use_spot': True, 'disk_size': 512},
+        {'cpus': '8+', 'memory': '32+'},
+        {'accelerators': 'H100:8', 'ports': ['8080', '9000-9010'],
+         'labels': {'team': 'ml'}},
+    ]
+    for cfg in cfgs:
+        rs = Resources.from_yaml_config(cfg)
+        assert len(rs) == 1
+        r = rs.pop()
+        again = Resources.from_yaml_config(r.to_yaml_config()).pop()
+        assert r == again
+
+
+def test_any_of():
+    rs = Resources.from_yaml_config({
+        'any_of': [{'accelerators': 'tpu-v5e-8'},
+                   {'accelerators': 'tpu-v6e-8'}],
+        'use_spot': True,
+    })
+    assert len(rs) == 2
+    assert all(r.use_spot for r in rs)
+
+
+def test_invalid():
+    with pytest.raises(exceptions.InvalidResourcesError):
+        Resources(accelerators={'tpu-v5e-8': 2})
+    with pytest.raises(exceptions.InvalidResourcesError):
+        Resources(accelerators='tpu-v5e-8', instance_type='n2-standard-8')
+    with pytest.raises(ValueError):
+        Resources(infra='gcp/nowhere')
+    with pytest.raises(exceptions.InvalidTaskYAMLError):
+        Resources.from_yaml_config({'bogus_field': 1})
+
+
+def test_autostop_forms():
+    assert Resources(autostop=True).autostop == {
+        'idle_minutes': 5, 'down': False}
+    assert Resources(autostop=10).autostop == {
+        'idle_minutes': 10, 'down': False}
+    assert Resources(autostop={'idle_minutes': 3, 'down': True}).autostop == {
+        'idle_minutes': 3, 'down': True}
+    assert Resources(autostop=False).autostop is None
+
+
+def test_less_demanding_than():
+    vague = Resources(accelerators='tpu-v5e-16')
+    pinned = Resources(accelerators='tpu-v5e-16', infra='gcp/us-west4',
+                       use_spot=True)
+    assert vague.less_demanding_than(pinned)
+    assert not pinned.less_demanding_than(vague)
+    other = Resources(accelerators='tpu-v6e-16')
+    assert not other.less_demanding_than(pinned)

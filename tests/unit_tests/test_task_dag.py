@@ -1,0 +1,68 @@
+"""Task YAML + Dag behavior."""
+import textwrap
+
+import pytest
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu.task import Task
+
+
+def test_from_yaml_config_full():
+    t = Task.from_yaml_config({
+        'name': 'train',
+        'resources': {'accelerators': 'tpu-v5e-16', 'infra': 'gcp'},
+        'num_nodes': 2,
+        'envs': {'LR': '3e-4'},
+        'secrets': {'TOKEN': 'abc'},
+        'setup': 'echo setup',
+        'run': 'python train.py --lr ${LR} --token ${TOKEN}',
+    })
+    assert t.run == 'python train.py --lr 3e-4 --token abc'
+    assert t.num_nodes == 2
+    assert t.envs == {'LR': '3e-4'}
+    assert t.secrets == {'TOKEN': 'abc'}
+
+
+def test_env_override_and_null(monkeypatch):
+    monkeypatch.setenv('FROM_CALLER', 'xyz')
+    t = Task.from_yaml_config({'envs': {'FROM_CALLER': None}, 'run': 'true'})
+    assert t.envs == {'FROM_CALLER': 'xyz'}
+    monkeypatch.delenv('FROM_CALLER')
+    with pytest.raises(exceptions.InvalidTaskYAMLError):
+        Task.from_yaml_config({'envs': {'FROM_CALLER': None}})
+
+
+def test_secrets_redacted():
+    t = Task.from_yaml_config({'secrets': {'K': 'v'}, 'run': 'true'})
+    assert t.to_yaml_config(redact_secrets=True)['secrets'] == {
+        'K': '<redacted>'}
+
+
+def test_dag_chain():
+    with dag_lib.Dag('pipeline') as d:
+        a = Task(name='a', run='true')
+        b = Task(name='b', run='true')
+        c = Task(name='c', run='true')
+        for t in (a, b, c):
+            d.add(t)
+        a >> b >> c
+    assert d.is_chain()
+    assert d.get_sorted_tasks() == [a, b, c]
+
+
+def test_dag_not_chain():
+    with dag_lib.Dag() as d:
+        a, b, c = (Task(name=n, run='true') for n in 'abc')
+        for t in (a, b, c):
+            d.add(t)
+        a >> c
+        b >> c
+    assert not d.is_chain()
+    d.validate()
+
+
+def test_rshift_outside_dag_raises():
+    a, b = Task(run='true'), Task(run='true')
+    with pytest.raises(RuntimeError):
+        a >> b

@@ -1,0 +1,68 @@
+"""Slice topology math: the TPU-first core must get host counts right."""
+import pytest
+
+from skypilot_tpu.utils import tpu_utils
+
+
+@pytest.mark.parametrize(
+    'name,chips,hosts,cph',
+    [
+        ('tpu-v5e-1', 1, 1, 1),
+        ('tpu-v5e-4', 4, 1, 4),
+        ('tpu-v5e-8', 8, 1, 8),
+        ('tpu-v5e-16', 16, 2, 8),
+        ('tpu-v5e-256', 256, 32, 8),
+        ('tpu-v5p-8', 4, 1, 4),
+        ('tpu-v5p-64', 32, 8, 4),
+        ('tpu-v5p-128', 64, 16, 4),
+        ('tpu-v5p-2048', 1024, 256, 4),
+        ('tpu-v4-8', 4, 1, 4),
+        ('tpu-v6e-8', 8, 1, 8),
+        ('tpu-v6e-256', 256, 32, 8),
+        ('tpu-v2-8', 4, 1, 4),
+    ])
+def test_slice_math(name, chips, hosts, cph):
+    spec = tpu_utils.get_slice_spec(name)
+    assert spec.num_chips == chips
+    assert spec.num_hosts == hosts
+    assert spec.chips_per_host == cph
+    assert spec.is_pod_slice == (hosts > 1)
+
+
+def test_topology_product_matches_chips():
+    for name in ('tpu-v5e-16', 'tpu-v5p-128', 'tpu-v6e-64', 'tpu-v4-512'):
+        spec = tpu_utils.get_slice_spec(name)
+        prod = 1
+        for d in spec.topology:
+            prod *= d
+        assert prod == spec.num_chips, (name, spec.topology)
+
+
+def test_explicit_topology():
+    spec = tpu_utils.get_slice_spec('tpu-v5p-128', topology='4x4x4')
+    assert spec.topology == (4, 4, 4)
+    with pytest.raises(ValueError):
+        tpu_utils.get_slice_spec('tpu-v5p-128', topology='4x4x2')
+
+
+def test_gcp_accelerator_type_naming():
+    assert tpu_utils.get_slice_spec(
+        'tpu-v5e-16').gcp_accelerator_type() == 'v5litepod-16'
+    assert tpu_utils.get_slice_spec(
+        'tpu-v5p-128').gcp_accelerator_type() == 'v5p-128'
+    assert tpu_utils.get_slice_spec(
+        'tpu-v6e-8').gcp_accelerator_type() == 'v6e-8'
+
+
+def test_is_tpu():
+    assert tpu_utils.is_tpu('tpu-v5e-8')
+    assert not tpu_utils.is_tpu('A100')
+    assert not tpu_utils.is_tpu(None)
+    assert not tpu_utils.is_tpu('tpu-v5e')  # missing size
+
+
+def test_bad_names():
+    with pytest.raises(ValueError):
+        tpu_utils.get_slice_spec('A100')
+    with pytest.raises(ValueError):
+        tpu_utils.parse_tpu_name('tpu-v99-8')
