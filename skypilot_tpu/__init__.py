@@ -1,0 +1,45 @@
+"""skypilot_tpu: a TPU-native sky orchestrator.
+
+SkyPilot-equivalent capability set (see SURVEY.md), rebuilt TPU-first:
+GCP TPU slices as native accelerators with ICI-topology-aware
+placement, agent-mesh gang execution with JAX multi-host bootstrap
+(no Ray), managed jobs with preemption recovery, and serving.
+
+Public API mirrors the reference's `sky/__init__.py` re-exports.
+"""
+from skypilot_tpu import exceptions
+from skypilot_tpu.dag import Dag
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+
+__version__ = '0.1.0'
+
+# Lazy server-side verbs (importing them pulls backends; keep import
+# light for client-only use).
+
+
+def __getattr__(name):
+    import importlib
+    if name in ('launch', 'exec'):
+        execution = importlib.import_module('skypilot_tpu.execution')
+        return getattr(execution, name)
+    if name in ('status', 'start', 'stop', 'down', 'autostop', 'queue',
+                'cancel', 'tail_logs', 'cost_report', 'storage_ls',
+                'storage_delete'):
+        core = importlib.import_module('skypilot_tpu.core')
+        return getattr(core, name)
+    if name == 'optimize':
+        optimizer = importlib.import_module('skypilot_tpu.optimizer')
+        return optimizer.optimize
+    if name == 'check':
+        # `sky.check` is the module (matching the reference); its main
+        # entry point is `sky.check.check()`.
+        return importlib.import_module('skypilot_tpu.check')
+    raise AttributeError(f'module {__name__!r} has no attribute {name!r}')
+
+
+__all__ = [
+    'Dag', 'Resources', 'Task', 'exceptions', 'launch', 'exec', 'status',
+    'start', 'stop', 'down', 'autostop', 'queue', 'cancel', 'tail_logs',
+    'cost_report', 'check', 'optimize',
+]

@@ -1,0 +1,84 @@
+"""Admin policy: pluggable request mutation/validation hook.
+
+Reference: sky/admin_policy.py — every launch passes a UserRequest
+through the configured AdminPolicy, which may mutate the dag/config
+or reject the request. Configured by import path in config:
+  admin_policy: mypkg.policies.MyPolicy
+"""
+from __future__ import annotations
+
+import dataclasses
+import importlib
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_config
+
+
+@dataclasses.dataclass
+class RequestOptions:
+    cluster_name: Optional[str] = None
+    idle_minutes_to_autostop: Optional[int] = None
+    down: bool = False
+    dryrun: bool = False
+
+
+@dataclasses.dataclass
+class UserRequest:
+    dag: dag_lib.Dag
+    skypilot_config: Dict[str, Any]
+    request_options: Optional[RequestOptions] = None
+    at_client_side: bool = False
+
+
+@dataclasses.dataclass
+class MutatedUserRequest:
+    dag: dag_lib.Dag
+    skypilot_config: Dict[str, Any]
+
+
+class AdminPolicy:
+    """Subclass and implement validate_and_mutate."""
+
+    @classmethod
+    def validate_and_mutate(cls,
+                            user_request: UserRequest) -> MutatedUserRequest:
+        raise NotImplementedError
+
+
+def load_policy() -> Optional[type]:
+    path = sky_config.get_nested(('admin_policy',))
+    if not path:
+        return None
+    module_path, class_name = path.rsplit('.', 1)
+    module = importlib.import_module(module_path)
+    policy_cls = getattr(module, class_name)
+    if not (isinstance(policy_cls, type) and
+            issubclass(policy_cls, AdminPolicy)):
+        raise exceptions.InvalidSkyPilotConfigError(
+            f'admin_policy {path!r} is not an AdminPolicy subclass.')
+    return policy_cls
+
+
+def apply(dag: dag_lib.Dag,
+          request_options: Optional[RequestOptions] = None) -> dag_lib.Dag:
+    """Apply the configured policy to a dag (no-op if none configured).
+
+    Reference: sky/utils/admin_policy_utils.py, applied at
+    sky/execution.py:299.
+    """
+    policy_cls = load_policy()
+    if policy_cls is None:
+        return dag
+    request = UserRequest(dag=dag, skypilot_config=sky_config.to_dict(),
+                          request_options=request_options)
+    try:
+        mutated = policy_cls.validate_and_mutate(request)
+    except exceptions.UserRequestRejectedByPolicy:
+        raise
+    except Exception as e:  # pylint: disable=broad-except
+        raise exceptions.UserRequestRejectedByPolicy(
+            f'Admin policy {policy_cls.__name__} failed: {e}') from e
+    mutated.dag.policy_applied = True
+    return mutated.dag

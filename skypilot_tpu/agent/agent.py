@@ -1,0 +1,409 @@
+"""The on-cluster agent: job queue + exec + autostop, one per host.
+
+Replaces the reference's skylet (sky/skylet/skylet.py) *and* its
+embedded Ray cluster (SURVEY §7: a TPU slice is already a gang, so
+gang exec is agent-to-agent fan-out, not Ray placement groups):
+
+  - every host of every slice runs one agent (HTTP, stdlib-only so a
+    bare TPU VM image can run it);
+  - the head host's agent additionally owns the cluster job queue
+    (job_lib), an event loop (scheduler step + autostop, reference
+    skylet events), and spawns one `job_driver` process per job;
+  - worker endpoints (/exec/*) run one rank's bash script with logs.
+
+Endpoints:
+  GET  /health                       liveness + version
+  POST /jobs/submit                  queue a job (head only)
+  GET  /jobs                         list jobs
+  GET  /jobs/<id>                    job record
+  POST /jobs/<id>/cancel             cancel pending/running job
+  GET  /jobs/<id>/logs?follow=1      combined log stream
+  POST /autostop                     set autostop policy
+  POST /exec                         run a rank script (worker-level)
+  GET  /exec/<id>/status             rank status
+  GET  /exec/<id>/logs?follow=1      rank log stream
+  POST /exec/<id>/cancel             kill rank process group
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+from urllib.parse import parse_qs, urlparse
+
+from skypilot_tpu import constants
+from skypilot_tpu.agent import job_lib
+from skypilot_tpu.agent import log_lib
+from skypilot_tpu.utils import subprocess_utils
+
+_EVENT_INTERVAL_SECONDS = 2.0
+
+
+class AgentState:
+
+    def __init__(self, home: str, cluster_name: str, is_head: bool) -> None:
+        self.home = os.path.abspath(os.path.expanduser(home))
+        os.makedirs(self.home, exist_ok=True)
+        self.cluster_name = cluster_name
+        self.is_head = is_head
+        self.jobs = job_lib.JobTable(self.home) if is_head else None
+        self.started_at = time.time()
+        # rank executions: job_id -> {'proc': Popen, 'rc': Optional[int]}
+        self.execs: Dict[int, Dict[str, Any]] = {}
+        self.execs_lock = threading.Lock()
+        self.autostop: Optional[Dict[str, Any]] = None
+        self._load_autostop()
+
+    # -- autostop persistence -------------------------------------------------
+    def _autostop_path(self) -> str:
+        return os.path.join(self.home, 'autostop.json')
+
+    def _load_autostop(self) -> None:
+        try:
+            with open(self._autostop_path(), 'r', encoding='utf-8') as f:
+                self.autostop = json.load(f)
+        except (OSError, ValueError):
+            self.autostop = None
+
+    def set_autostop(self, config: Optional[Dict[str, Any]]) -> None:
+        self.autostop = config
+        if config is None:
+            try:
+                os.remove(self._autostop_path())
+            except OSError:
+                pass
+        else:
+            with open(self._autostop_path(), 'w', encoding='utf-8') as f:
+                json.dump(config, f)
+
+    def exec_dir(self, job_id: int) -> str:
+        d = os.path.join(self.home, 'tasks', str(job_id))
+        os.makedirs(d, exist_ok=True)
+        return d
+
+
+STATE: Optional[AgentState] = None
+
+
+# ---------------------------------------------------------------------------
+# Event loop (reference: skylet events — JobSchedulerEvent, StopEvent)
+# ---------------------------------------------------------------------------
+def _scheduler_step(state: AgentState) -> None:
+    jobs = state.jobs
+    assert jobs is not None
+    jobs.reconcile()
+    if jobs.any_active():
+        return
+    job = jobs.next_pending()
+    if job is None:
+        return
+    jobs.set_status(job['job_id'], job_lib.JobStatus.INIT)
+    log_path = os.path.join(state.home, f'driver-{job["job_id"]}.log')
+    pid = subprocess_utils.launch_daemon(
+        [sys.executable, '-m', 'skypilot_tpu.agent.job_driver',
+         '--home', state.home, '--job-id', str(job['job_id'])],
+        log_path=log_path,
+        env=dict(os.environ))
+    jobs.set_pid(job['job_id'], pid)
+
+
+def _autostop_step(state: AgentState) -> None:
+    cfg = state.autostop
+    if not cfg or not state.is_head:
+        return
+    idle_minutes = cfg.get('idle_minutes', -1)
+    if idle_minutes is None or idle_minutes < 0:
+        return
+    assert state.jobs is not None
+    if state.jobs.any_active() or state.jobs.next_pending() is not None:
+        return
+    last = max(state.jobs.last_activity_time(), state.started_at)
+    if time.time() - last < idle_minutes * 60:
+        return
+    # Fire the stop/down hook: the cluster stops itself. The hook
+    # command is injected at provision time (reference:
+    # autostop_lib executes sky.stop from the cluster itself).
+    hook = cfg.get('hook')
+    marker = os.path.join(state.home, 'autostop_fired')
+    if os.path.exists(marker):
+        return
+    with open(marker, 'w', encoding='utf-8') as f:
+        f.write(str(time.time()))
+    if hook:
+        subprocess.Popen(['bash', '-c', hook], start_new_session=True)
+
+
+def _event_loop(state: AgentState) -> None:
+    while True:
+        try:
+            if state.is_head:
+                _scheduler_step(state)
+                _autostop_step(state)
+        except Exception as e:  # pylint: disable=broad-except
+            print(f'agent event loop error: {e!r}', file=sys.stderr)
+        time.sleep(_EVENT_INTERVAL_SECONDS)
+
+
+# ---------------------------------------------------------------------------
+# HTTP handler
+# ---------------------------------------------------------------------------
+class Handler(BaseHTTPRequestHandler):
+    protocol_version = 'HTTP/1.0'  # close-at-end simplifies log streaming
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    # -- helpers -------------------------------------------------------------
+    def _json(self, obj: Any, code: int = 200) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header('Content-Type', 'application/json')
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_body(self) -> Dict[str, Any]:
+        length = int(self.headers.get('Content-Length', 0))
+        if length == 0:
+            return {}
+        return json.loads(self.rfile.read(length))
+
+    # -- routing -------------------------------------------------------------
+    def do_GET(self):  # noqa: N802
+        try:
+            self._route('GET')
+        except BrokenPipeError:
+            pass
+        except Exception as e:  # pylint: disable=broad-except
+            self._safe_error(e)
+
+    def do_POST(self):  # noqa: N802
+        try:
+            self._route('POST')
+        except BrokenPipeError:
+            pass
+        except Exception as e:  # pylint: disable=broad-except
+            self._safe_error(e)
+
+    def _safe_error(self, e: Exception) -> None:
+        try:
+            self._json({'error': f'{type(e).__name__}: {e}'}, code=500)
+        except Exception:  # pylint: disable=broad-except
+            pass
+
+    def _route(self, method: str) -> None:
+        assert STATE is not None
+        url = urlparse(self.path)
+        parts = [p for p in url.path.split('/') if p]
+        query = {k: v[0] for k, v in parse_qs(url.query).items()}
+
+        if method == 'GET' and parts == ['health']:
+            self._json({
+                'status': 'ok',
+                'version': constants.AGENT_VERSION,
+                'cluster': STATE.cluster_name,
+                'is_head': STATE.is_head,
+                'uptime': time.time() - STATE.started_at,
+            })
+            return
+
+        if parts and parts[0] == 'jobs' and STATE.jobs is not None:
+            self._route_jobs(method, parts, query)
+            return
+        if parts and parts[0] == 'exec':
+            self._route_exec(method, parts, query)
+            return
+        if method == 'POST' and parts == ['autostop']:
+            body = self._read_body()
+            STATE.set_autostop(body or None)
+            self._json({'ok': True})
+            return
+        self._json({'error': f'no route {method} {url.path}'}, code=404)
+
+    # -- job queue (head) ------------------------------------------------------
+    def _route_jobs(self, method: str, parts, query) -> None:
+        assert STATE is not None and STATE.jobs is not None
+        jobs = STATE.jobs
+        if method == 'POST' and parts == ['jobs', 'submit']:
+            body = self._read_body()
+            log_dir = os.path.join(STATE.home, 'job_logs')
+            job_id = jobs.add_job(body.get('name'),
+                                  body.get('username', 'unknown'),
+                                  body['spec'], log_dir)
+            log_dir = os.path.join(log_dir, str(job_id))
+            with jobs._db.conn() as conn:  # pylint: disable=protected-access
+                conn.execute('UPDATE jobs SET log_dir=? WHERE job_id=?',
+                             (log_dir, job_id))
+            self._json({'job_id': job_id})
+            return
+        if method == 'GET' and parts == ['jobs']:
+            status = None
+            if 'status' in query:
+                status = [job_lib.JobStatus(s)
+                          for s in query['status'].split(',')]
+            rows = jobs.get_jobs(status=status,
+                                 limit=int(query.get('limit', 0)))
+            for r in rows:
+                r['status'] = r['status'].value
+            self._json({'jobs': rows})
+            return
+        if len(parts) >= 2 and parts[0] == 'jobs':
+            try:
+                job_id = int(parts[1])
+            except ValueError:
+                self._json({'error': f'bad job id {parts[1]}'}, code=400)
+                return
+            job = jobs.get_job(job_id)
+            if job is None:
+                self._json({'error': f'no job {job_id}'}, code=404)
+                return
+            if method == 'GET' and len(parts) == 2:
+                job['status'] = job['status'].value
+                self._json(job)
+                return
+            if method == 'POST' and parts[2:] == ['cancel']:
+                self._cancel_job(job)
+                self._json({'ok': True})
+                return
+            if method == 'GET' and parts[2:] == ['logs']:
+                self._stream_job_logs(job, query)
+                return
+        self._json({'error': 'bad jobs route'}, code=404)
+
+    def _cancel_job(self, job: Dict[str, Any]) -> None:
+        assert STATE is not None and STATE.jobs is not None
+        status: job_lib.JobStatus = job['status']
+        if status.is_terminal():
+            return
+        pid = job.get('pid') or -1
+        STATE.jobs.set_status(job['job_id'], job_lib.JobStatus.CANCELLED)
+        if pid > 0:
+            # Driver traps SIGTERM → cancels all rank execs.
+            subprocess_utils.kill_process_tree(pid, sig=signal.SIGTERM)
+
+    def _stream_job_logs(self, job: Dict[str, Any], query) -> None:
+        assert STATE is not None and STATE.jobs is not None
+        follow = query.get('follow', '0') == '1'
+        tail = int(query.get('tail', 0))
+        log_path = os.path.join(job['log_dir'], 'run.log')
+        self.send_response(200)
+        self.send_header('Content-Type', 'text/plain; charset=utf-8')
+        self.end_headers()
+        job_id = job['job_id']
+
+        def finished() -> bool:
+            j = STATE.jobs.get_job(job_id)
+            return j is None or j['status'].is_terminal()
+
+        for line in log_lib.tail_logs(log_path, follow=follow,
+                                      tail_lines=tail,
+                                      stop_condition=finished):
+            self.wfile.write(line.encode('utf-8', errors='replace'))
+            self.wfile.flush()
+
+    # -- rank exec (all hosts) --------------------------------------------------
+    def _route_exec(self, method: str, parts, query) -> None:
+        assert STATE is not None
+        if method == 'POST' and parts == ['exec']:
+            body = self._read_body()
+            job_id = int(body['job_id'])
+            d = STATE.exec_dir(job_id)
+            log_path = os.path.join(d, 'rank.log')
+            rc_path = os.path.join(d, 'rc')
+            try:
+                os.remove(rc_path)
+            except OSError:
+                pass
+            script = body['script']
+            wrapped = (f'{script}\nrc=$?\n'
+                       f'echo $rc > {rc_path}\nexit $rc')
+            proc = log_lib.run_bash_with_log(
+                wrapped, log_path, env=body.get('env'),
+                cwd=body.get('cwd'))
+            with STATE.execs_lock:
+                STATE.execs[job_id] = {'proc': proc, 'rc': None}
+
+            def reap():
+                rc = proc.wait()
+                with STATE.execs_lock:
+                    STATE.execs[job_id]['rc'] = rc
+
+            threading.Thread(target=reap, daemon=True).start()
+            self._json({'pid': proc.pid})
+            return
+
+        if len(parts) >= 2 and parts[0] == 'exec':
+            job_id = int(parts[1])
+            d = STATE.exec_dir(job_id)
+            if method == 'GET' and parts[2:] == ['status']:
+                rc = self._exec_rc(job_id)
+                self._json({'running': rc is None, 'rc': rc})
+                return
+            if method == 'POST' and parts[2:] == ['cancel']:
+                with STATE.execs_lock:
+                    entry = STATE.execs.get(job_id)
+                if entry and entry['rc'] is None:
+                    try:
+                        os.killpg(os.getpgid(entry['proc'].pid),
+                                  signal.SIGTERM)
+                    except (OSError, ProcessLookupError):
+                        pass
+                self._json({'ok': True})
+                return
+            if method == 'GET' and parts[2:] == ['logs']:
+                follow = query.get('follow', '0') == '1'
+                self.send_response(200)
+                self.send_header('Content-Type', 'text/plain; charset=utf-8')
+                self.end_headers()
+                done = lambda: self._exec_rc(job_id) is not None
+                for line in log_lib.tail_logs(
+                        os.path.join(d, 'rank.log'), follow=follow,
+                        stop_condition=done):
+                    self.wfile.write(line.encode('utf-8', errors='replace'))
+                    self.wfile.flush()
+                return
+        self._json({'error': 'bad exec route'}, code=404)
+
+    def _exec_rc(self, job_id: int) -> Optional[int]:
+        assert STATE is not None
+        with STATE.execs_lock:
+            entry = STATE.execs.get(job_id)
+        if entry is not None:
+            return entry['rc']
+        # Agent restarted: fall back to the rc file.
+        rc_path = os.path.join(STATE.exec_dir(job_id), 'rc')
+        try:
+            with open(rc_path, 'r', encoding='utf-8') as f:
+                return int(f.read().strip())
+        except (OSError, ValueError):
+            return None
+
+
+def main() -> None:
+    global STATE
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--port', type=int, default=constants.AGENT_PORT)
+    parser.add_argument('--home', default=constants.SKY_REMOTE_HOME)
+    parser.add_argument('--cluster', default='unknown')
+    parser.add_argument('--head', action='store_true')
+    parser.add_argument('--bind', default='0.0.0.0')
+    args = parser.parse_args()
+
+    STATE = AgentState(args.home, args.cluster, args.head)
+    threading.Thread(target=_event_loop, args=(STATE,), daemon=True).start()
+    server = ThreadingHTTPServer((args.bind, args.port), Handler)
+    print(f'agent listening on {args.bind}:{args.port} '
+          f'(head={args.head}, home={STATE.home})', flush=True)
+    server.serve_forever()
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,116 @@
+"""AgentClient: the API server's handle to a cluster's head agent.
+
+Reference analog: the SkyletClient gRPC wrapper
+(sky/backends/cloud_vm_ray_backend.py:2888-3086). Plain HTTP here; the
+transport address comes from the cluster handle (direct IP:port, or a
+localhost tunnel endpoint for SSH-only clusters).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+import requests
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.agent import job_lib
+
+
+class AgentClient:
+
+    def __init__(self, addr: str, timeout: float = 30.0) -> None:
+        self.base = f'http://{addr}'
+        self.timeout = timeout
+
+    def _get(self, path: str, **kw) -> Dict[str, Any]:
+        resp = requests.get(f'{self.base}{path}', timeout=self.timeout, **kw)
+        resp.raise_for_status()
+        return resp.json()
+
+    def _post(self, path: str, payload: Optional[Dict] = None
+              ) -> Dict[str, Any]:
+        resp = requests.post(f'{self.base}{path}', json=payload or {},
+                             timeout=self.timeout)
+        resp.raise_for_status()
+        return resp.json()
+
+    # -- health ---------------------------------------------------------------
+    def health(self) -> Dict[str, Any]:
+        return self._get('/health')
+
+    def wait_until_healthy(self, timeout: float = 60.0) -> bool:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                if self.health().get('status') == 'ok':
+                    return True
+            except requests.RequestException:
+                pass
+            time.sleep(1.0)
+        return False
+
+    # -- jobs -------------------------------------------------------------------
+    def submit_job(self, name: Optional[str], username: str,
+                   spec: Dict[str, Any]) -> int:
+        out = self._post('/jobs/submit', {
+            'name': name, 'username': username, 'spec': spec})
+        return int(out['job_id'])
+
+    def get_job(self, job_id: int) -> Optional[Dict[str, Any]]:
+        try:
+            out = self._get(f'/jobs/{job_id}')
+        except requests.HTTPError as e:
+            if e.response is not None and e.response.status_code == 404:
+                return None
+            raise
+        out['status'] = job_lib.JobStatus(out['status'])
+        return out
+
+    def get_jobs(self, status: Optional[List[job_lib.JobStatus]] = None,
+                 limit: int = 0) -> List[Dict[str, Any]]:
+        params = {}
+        if status:
+            params['status'] = ','.join(s.value for s in status)
+        if limit:
+            params['limit'] = str(limit)
+        out = self._get('/jobs', params=params)
+        rows = out['jobs']
+        for r in rows:
+            r['status'] = job_lib.JobStatus(r['status'])
+        return rows
+
+    def cancel_job(self, job_id: int) -> None:
+        self._post(f'/jobs/{job_id}/cancel')
+
+    def wait_job(self, job_id: int,
+                 timeout: Optional[float] = None) -> job_lib.JobStatus:
+        deadline = time.time() + timeout if timeout else None
+        while True:
+            job = self.get_job(job_id)
+            if job is None:
+                raise exceptions.JobNotFoundError(str(job_id))
+            if job['status'].is_terminal():
+                return job['status']
+            if deadline and time.time() > deadline:
+                raise TimeoutError(f'job {job_id} still {job["status"]}')
+            time.sleep(2.0)
+
+    def stream_job_logs(self, job_id: int, *, follow: bool = True,
+                        tail: int = 0) -> Iterator[str]:
+        params = {'follow': '1' if follow else '0'}
+        if tail:
+            params['tail'] = str(tail)
+        with requests.get(f'{self.base}/jobs/{job_id}/logs', params=params,
+                          stream=True, timeout=(30, None)) as resp:
+            resp.raise_for_status()
+            for line in resp.iter_lines(decode_unicode=True):
+                yield line + '\n'
+
+    # -- autostop ---------------------------------------------------------------
+    def set_autostop(self, idle_minutes: Optional[int], down: bool,
+                     hook: Optional[str] = None) -> None:
+        if idle_minutes is None or idle_minutes < 0:
+            self._post('/autostop', {})
+        else:
+            self._post('/autostop', {'idle_minutes': idle_minutes,
+                                     'down': down, 'hook': hook})

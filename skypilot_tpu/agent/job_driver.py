@@ -1,0 +1,217 @@
+"""Job driver: gang-execute one job's rank scripts on every host agent.
+
+This is the TPU-native replacement for the reference's generated Ray
+driver (sky/backends/task_codegen.py:301 RayCodeGen — placement group
+STRICT_SPREAD + get_or_fail kill-all-on-failure). A TPU slice is
+already gang-allocated by the TPU API, so "gang scheduling" reduces
+to: start the rank script on every host agent, watch all of them, and
+cancel everything if any rank fails (all-or-nothing semantics,
+reference task_codegen.py:363-411).
+
+Log fan-in: one thread per rank streams that host's log into
+`<job_log_dir>/rank-<i>.log` and the combined `run.log` (rank-prefixed
+when num_ranks > 1) — the reference's per-rank `{rank}-{node}.log`
+contract (task_codegen.py:640-650).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import requests
+
+from skypilot_tpu.agent import job_lib
+from skypilot_tpu.agent import log_lib
+
+_POLL_SECONDS = 1.0
+
+
+class RankExec:
+
+    def __init__(self, host: Dict[str, Any], job_id: int) -> None:
+        self.host = host          # {'addr': 'ip:port', 'rank': int, ...}
+        self.rank = int(host['rank'])
+        self.job_id = job_id
+        self.base = f'http://{host["addr"]}'
+        self.rc: Optional[int] = None
+
+    def start(self, script: str, env: Dict[str, str],
+              cwd: Optional[str]) -> None:
+        resp = requests.post(f'{self.base}/exec', json={
+            'job_id': self.job_id,
+            'script': script,
+            'env': env,
+            'cwd': cwd,
+        }, timeout=30)
+        resp.raise_for_status()
+
+    def poll(self) -> Optional[int]:
+        if self.rc is not None:
+            return self.rc
+        try:
+            resp = requests.get(f'{self.base}/exec/{self.job_id}/status',
+                                timeout=10)
+            resp.raise_for_status()
+            data = resp.json()
+            if not data['running']:
+                self.rc = data['rc'] if data['rc'] is not None else 255
+        except requests.RequestException:
+            # Host agent unreachable: count as failure after grace.
+            self.rc = 254
+        return self.rc
+
+    def cancel(self) -> None:
+        try:
+            requests.post(f'{self.base}/exec/{self.job_id}/cancel',
+                          timeout=10)
+        except requests.RequestException:
+            pass
+
+    def stream_logs(self, rank_log_path: str, combined, prefix: str,
+                    lock: threading.Lock) -> None:
+        os.makedirs(os.path.dirname(rank_log_path), exist_ok=True)
+        try:
+            with requests.get(f'{self.base}/exec/{self.job_id}/logs',
+                              params={'follow': '1'}, stream=True,
+                              timeout=(30, None)) as resp:
+                with open(rank_log_path, 'ab') as rank_file:
+                    for raw in resp.iter_lines(decode_unicode=False):
+                        rank_file.write(raw + b'\n')
+                        rank_file.flush()
+                        with lock:
+                            if prefix:
+                                combined.write(prefix.encode())
+                            combined.write(raw + b'\n')
+                            combined.flush()
+        except requests.RequestException as e:
+            with lock:
+                combined.write(
+                    f'[driver] log stream for rank {self.rank} ended: '
+                    f'{e}\n'.encode())
+                combined.flush()
+
+
+def run_job(home: str, job_id: int) -> job_lib.JobStatus:
+    jobs = job_lib.JobTable(home)
+    job = jobs.get_job(job_id)
+    assert job is not None, f'job {job_id} not found'
+    spec = job['spec']
+    log_dir = job['log_dir']
+    os.makedirs(log_dir, exist_ok=True)
+
+    hosts: List[Dict[str, Any]] = spec['hosts']
+    script: str = spec['script']
+    base_env: Dict[str, str] = spec.get('env', {})
+    per_rank_env: List[Dict[str, str]] = spec.get('per_rank_env',
+                                                  [{} for _ in hosts])
+    cwd = spec.get('cwd')
+
+    execs = [RankExec(h, job_id) for h in hosts]
+    combined_path = os.path.join(log_dir, 'run.log')
+    combined = open(combined_path, 'ab', buffering=0)
+    lock = threading.Lock()
+
+    cancelled = threading.Event()
+
+    def handle_term(signum, frame):  # noqa: ARG001
+        cancelled.set()
+
+    signal.signal(signal.SIGTERM, handle_term)
+
+    jobs.set_status(job_id, job_lib.JobStatus.RUNNING)
+    final = job_lib.JobStatus.SUCCEEDED
+    try:
+        # Start all ranks (any start failure → nothing proceeds).
+        for ex, extra in zip(execs, per_rank_env):
+            env = dict(base_env)
+            env.update(extra)
+            try:
+                ex.start(script, env, cwd)
+            except requests.RequestException as e:
+                detail = ''
+                resp = getattr(e, 'response', None)
+                if resp is not None:
+                    detail = f' ({resp.text[:500]})'
+                with lock:
+                    combined.write(
+                        f'[driver] failed to start rank {ex.rank}: '
+                        f'{e}{detail}\n'.encode())
+                for other in execs:
+                    other.cancel()
+                final = job_lib.JobStatus.FAILED
+                break
+
+        if final != job_lib.JobStatus.SUCCEEDED:
+            return final  # finally block records the status
+
+        # Fan in logs.
+        streamers = []
+        for ex in execs:
+            prefix = f'(rank{ex.rank}) ' if len(execs) > 1 else ''
+            t = threading.Thread(
+                target=ex.stream_logs,
+                args=(os.path.join(log_dir, f'rank-{ex.rank}.log'),
+                      combined, prefix, lock),
+                daemon=True)
+            t.start()
+            streamers.append(t)
+
+        # Watch all ranks; kill-all-on-any-failure.
+        pending = set(execs)
+        while pending:
+            if cancelled.is_set():
+                for ex in execs:
+                    ex.cancel()
+                final = job_lib.JobStatus.CANCELLED
+                break
+            done = {ex for ex in pending if ex.poll() is not None}
+            for ex in done:
+                with lock:
+                    combined.write(
+                        f'[driver] rank {ex.rank} exited rc={ex.rc}\n'
+                        .encode())
+                if ex.rc != 0:
+                    final = job_lib.JobStatus.FAILED
+                    for other in execs:
+                        if other is not ex and other.poll() is None:
+                            other.cancel()
+            pending -= done
+            if pending:
+                time.sleep(_POLL_SECONDS)
+
+        for t in streamers:
+            t.join(timeout=10)
+        return final
+    finally:
+        final = _finish(jobs, job_id, log_dir, final, combined)
+
+
+def _finish(jobs: job_lib.JobTable, job_id: int, log_dir: str,
+            status: job_lib.JobStatus, combined) -> job_lib.JobStatus:
+    with open(os.path.join(log_dir, 'driver_status'), 'w',
+              encoding='utf-8') as f:
+        f.write(status.value)
+    jobs.set_status(job_id, status)
+    combined.write(f'[driver] job {job_id} finished: {status.value}\n'
+                   .encode())
+    combined.close()
+    return status
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--home', required=True)
+    parser.add_argument('--job-id', type=int, required=True)
+    args = parser.parse_args()
+    status = run_job(args.home, args.job_id)
+    sys.exit(0 if status == job_lib.JobStatus.SUCCEEDED else 1)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,161 @@
+"""On-cluster job queue: sqlite table + FIFO scheduler.
+
+Reference: sky/skylet/job_lib.py (1459 LoC) — `jobs` + `pending_jobs`
+sqlite tables, JobStatus state machine INIT→SETTING_UP→PENDING→
+RUNNING→terminal, FIFOScheduler spawning queued driver processes.
+
+TPU-native difference: the driver program is not a Ray driver; it is
+`agent.job_driver`, which gang-executes the per-rank command on every
+host agent of the slice (all-or-nothing, kill-all-on-failure).
+"""
+from __future__ import annotations
+
+import enum
+import functools
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.utils import db_utils
+from skypilot_tpu.utils import subprocess_utils
+
+
+class JobStatus(enum.Enum):
+    INIT = 'INIT'
+    PENDING = 'PENDING'
+    SETTING_UP = 'SETTING_UP'
+    RUNNING = 'RUNNING'
+    SUCCEEDED = 'SUCCEEDED'
+    FAILED = 'FAILED'
+    FAILED_SETUP = 'FAILED_SETUP'
+    CANCELLED = 'CANCELLED'
+
+    def is_terminal(self) -> bool:
+        return self in (JobStatus.SUCCEEDED, JobStatus.FAILED,
+                        JobStatus.FAILED_SETUP, JobStatus.CANCELLED)
+
+    @classmethod
+    def terminal_statuses(cls) -> List['JobStatus']:
+        return [s for s in cls if s.is_terminal()]
+
+
+_CREATE_SQL = """\
+CREATE TABLE IF NOT EXISTS jobs (
+    job_id INTEGER PRIMARY KEY AUTOINCREMENT,
+    job_name TEXT,
+    username TEXT,
+    submitted_at REAL,
+    start_at REAL,
+    end_at REAL,
+    status TEXT,
+    run_timestamp TEXT,
+    resources TEXT,
+    pid INTEGER DEFAULT -1,
+    spec TEXT,
+    log_dir TEXT
+);
+"""
+
+
+class JobTable:
+    """One per agent home dir."""
+
+    def __init__(self, agent_home: str) -> None:
+        self._db = db_utils.SQLiteDB(
+            os.path.join(os.path.expanduser(agent_home), 'jobs.db'),
+            _CREATE_SQL)
+
+    # -- CRUD ---------------------------------------------------------------
+    def add_job(self, job_name: Optional[str], username: str,
+                spec: Dict[str, Any], log_dir: str) -> int:
+        run_timestamp = time.strftime('sky-%Y-%m-%d-%H-%M-%S-%f')
+        with self._db.conn() as conn:
+            cur = conn.execute(
+                'INSERT INTO jobs (job_name, username, submitted_at, status, '
+                'run_timestamp, spec, log_dir) VALUES (?,?,?,?,?,?,?)',
+                (job_name, username, time.time(), JobStatus.PENDING.value,
+                 run_timestamp, json.dumps(spec), log_dir))
+            return int(cur.lastrowid)
+
+    def get_job(self, job_id: int) -> Optional[Dict[str, Any]]:
+        row = self._db.query_one('SELECT * FROM jobs WHERE job_id=?',
+                                 (job_id,))
+        return self._decode(row) if row else None
+
+    def get_jobs(self, status: Optional[List[JobStatus]] = None,
+                 limit: int = 0) -> List[Dict[str, Any]]:
+        sql = 'SELECT * FROM jobs'
+        params: tuple = ()
+        if status:
+            marks = ','.join('?' * len(status))
+            sql += f' WHERE status IN ({marks})'
+            params = tuple(s.value for s in status)
+        sql += ' ORDER BY job_id DESC'
+        if limit:
+            sql += f' LIMIT {int(limit)}'
+        return [self._decode(r) for r in self._db.query(sql, params)]
+
+    @staticmethod
+    def _decode(row: Dict[str, Any]) -> Dict[str, Any]:
+        out = dict(row)
+        out['status'] = JobStatus(out['status'])
+        out['spec'] = json.loads(out['spec']) if out.get('spec') else {}
+        return out
+
+    # -- state transitions ----------------------------------------------------
+    def set_status(self, job_id: int, status: JobStatus) -> None:
+        sets = ['status=?']
+        params: List[Any] = [status.value]
+        if status == JobStatus.SETTING_UP:
+            sets.append('start_at=?')
+            params.append(time.time())
+        if status == JobStatus.RUNNING:
+            sets.append('start_at=COALESCE(start_at, ?)')
+            params.append(time.time())
+        if status.is_terminal():
+            sets.append('end_at=?')
+            params.append(time.time())
+        params.append(job_id)
+        self._db.execute(f'UPDATE jobs SET {", ".join(sets)} WHERE job_id=?',
+                         tuple(params))
+
+    def set_pid(self, job_id: int, pid: int) -> None:
+        self._db.execute('UPDATE jobs SET pid=? WHERE job_id=?',
+                         (pid, job_id))
+
+    # -- scheduling -----------------------------------------------------------
+    def next_pending(self) -> Optional[Dict[str, Any]]:
+        rows = self.get_jobs(status=[JobStatus.PENDING])
+        return rows[-1] if rows else None  # lowest job_id first
+
+    def any_active(self) -> bool:
+        return bool(self.get_jobs(status=[JobStatus.SETTING_UP,
+                                          JobStatus.RUNNING,
+                                          JobStatus.INIT]))
+
+    def reconcile(self) -> None:
+        """Fix statuses of jobs whose driver process died (crash safety)."""
+        for job in self.get_jobs(status=[JobStatus.SETTING_UP,
+                                         JobStatus.RUNNING]):
+            pid = job.get('pid') or -1
+            if pid > 0 and not subprocess_utils.process_alive(pid):
+                status_file = os.path.join(job['log_dir'], 'driver_status')
+                final = JobStatus.FAILED
+                try:
+                    with open(status_file, 'r', encoding='utf-8') as f:
+                        final = JobStatus(f.read().strip())
+                except (OSError, ValueError):
+                    pass
+                if not final.is_terminal():
+                    final = JobStatus.FAILED
+                self.set_status(job['job_id'], final)
+
+    def last_activity_time(self) -> float:
+        """Most recent job activity (for autostop idle tracking)."""
+        row = self._db.query_one(
+            'SELECT MAX(MAX(COALESCE(end_at,0), COALESCE(start_at,0), '
+            'submitted_at)) AS t FROM jobs')
+        if row is None or row['t'] is None:
+            return 0.0
+        return float(row['t'])

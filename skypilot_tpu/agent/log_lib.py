@@ -1,0 +1,88 @@
+"""Run-with-log + rotation-safe tail/follow.
+
+Reference: sky/skylet/log_lib.py (909 LoC): subprocess with
+tee-to-file + streaming; follow survives truncation/rotation.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import time
+from typing import Dict, Iterator, Optional, Tuple
+
+
+def run_bash_with_log(script: str, log_path: str,
+                      env: Optional[Dict[str, str]] = None,
+                      cwd: Optional[str] = None) -> subprocess.Popen:
+    """Spawn `bash -c script` with stdout+stderr appended to log_path."""
+    log_path = os.path.expanduser(log_path)
+    os.makedirs(os.path.dirname(log_path), exist_ok=True)
+    log_file = open(log_path, 'ab', buffering=0)
+    full_env = dict(os.environ)
+    if env:
+        full_env.update({k: str(v) for k, v in env.items()})
+    if cwd is not None:
+        cwd = os.path.expanduser(cwd)
+        os.makedirs(cwd, exist_ok=True)
+    proc = subprocess.Popen(
+        ['bash', '-c', script],
+        stdout=log_file,
+        stderr=subprocess.STDOUT,
+        stdin=subprocess.DEVNULL,
+        env=full_env,
+        cwd=cwd,
+        start_new_session=True,   # own process group: clean cancel
+    )
+    # The fd is inherited by the child; close our handle.
+    log_file.close()
+    return proc
+
+
+def tail_logs(log_path: str, *, follow: bool = False,
+              from_start: bool = True, tail_lines: int = 0,
+              stop_condition=None, poll_interval: float = 0.2
+              ) -> Iterator[str]:
+    """Yield log lines; with follow=True keep reading until
+    stop_condition() returns True and the file is drained. Reopens on
+    truncation (rotation-safe: reference log_lib.py:444-555)."""
+    log_path = os.path.expanduser(log_path)
+    # Wait briefly for the file to appear (job may still be starting).
+    deadline = time.time() + (30 if follow else 0)
+    while not os.path.exists(log_path):
+        if time.time() > deadline:
+            return
+        time.sleep(poll_interval)
+
+    f = open(log_path, 'r', encoding='utf-8', errors='replace')
+    try:
+        if tail_lines > 0:
+            lines = f.readlines()[-tail_lines:]
+            yield from lines
+        elif not from_start:
+            f.seek(0, os.SEEK_END)
+        while True:
+            pos = f.tell()
+            line = f.readline()
+            if line:
+                yield line
+                continue
+            if not follow:
+                break
+            # Detect truncation/rotation.
+            try:
+                size = os.path.getsize(log_path)
+            except OSError:
+                size = 0
+            if size < pos:
+                f.close()
+                f = open(log_path, 'r', encoding='utf-8', errors='replace')
+                continue
+            if stop_condition is not None and stop_condition():
+                # Drain whatever arrived in the race window.
+                rest = f.read()
+                if rest:
+                    yield rest
+                break
+            time.sleep(poll_interval)
+    finally:
+        f.close()

@@ -1,0 +1,74 @@
+"""Backend ABC: cluster lifecycle + job submission.
+
+Reference: sky/backends/backend.py:24,30 — provision / sync_workdir /
+sync_file_mounts / setup / execute / teardown with a per-backend
+ResourceHandle.
+"""
+from __future__ import annotations
+
+import typing
+from typing import Any, Dict, Generic, Optional, TypeVar
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu import task as task_lib
+
+
+class ResourceHandle:
+    """Opaque, picklable record of a provisioned cluster."""
+
+    def get_cluster_name(self) -> str:
+        raise NotImplementedError
+
+
+_HandleType = TypeVar('_HandleType', bound=ResourceHandle)
+
+
+class Backend(Generic[_HandleType]):
+    NAME = 'backend'
+
+    # --- lifecycle ----------------------------------------------------------
+    def check_resources_fit_cluster(self, handle: _HandleType,
+                                    task: 'task_lib.Task') -> None:
+        raise NotImplementedError
+
+    def provision(self, task: 'task_lib.Task',
+                  to_provision: Optional['resources_lib.Resources'],
+                  dryrun: bool, stream_logs: bool, cluster_name: str,
+                  retry_until_up: bool = False) -> Optional[_HandleType]:
+        raise NotImplementedError
+
+    def sync_workdir(self, handle: _HandleType, workdir: str) -> None:
+        raise NotImplementedError
+
+    def sync_file_mounts(self, handle: _HandleType,
+                         all_file_mounts: Optional[Dict[str, str]],
+                         storage_mounts: Optional[Dict[str, Any]]) -> None:
+        raise NotImplementedError
+
+    def setup(self, handle: _HandleType, task: 'task_lib.Task',
+              detach_setup: bool = False) -> None:
+        raise NotImplementedError
+
+    def execute(self, handle: _HandleType, task: 'task_lib.Task',
+                detach_run: bool = False,
+                dryrun: bool = False) -> Optional[int]:
+        """Submit the task; returns job_id (None for dryrun)."""
+        raise NotImplementedError
+
+    def post_execute(self, handle: _HandleType, down: bool) -> None:
+        pass
+
+    def teardown(self, handle: _HandleType, terminate: bool,
+                 purge: bool = False) -> None:
+        raise NotImplementedError
+
+    # --- jobs ---------------------------------------------------------------
+    def tail_logs(self, handle: _HandleType, job_id: Optional[int],
+                  follow: bool = True, tail: int = 0) -> int:
+        raise NotImplementedError
+
+    def cancel_jobs(self, handle: _HandleType,
+                    job_ids: Optional[list] = None,
+                    cancel_all: bool = False) -> None:
+        raise NotImplementedError

@@ -1,0 +1,147 @@
+"""Task codegen: turn a Task's run section into a gang-exec job spec.
+
+Reference: sky/backends/task_codegen.py (1068 LoC) generates a Ray
+driver (placement groups, per-node bash tasks, rank env export). The
+TPU-native codegen is declarative instead of generated-program: it
+produces the job spec the agent's job_driver consumes — one script +
+per-rank env for every host of every slice — because a TPU slice is
+already gang-allocated; no placement-group dance is needed.
+
+Env contract (reference sky/skylet/constants.py:521-526 + JAX
+multi-host additions, SURVEY §2.4):
+  SKYPILOT_NODE_RANK       global host rank (0 = head). For TPU pod
+                           slices there is one rank per *host*, the
+                           reference's `num_ips_per_node` behavior.
+  SKYPILOT_NODE_IPS        newline-separated host IPs in rank order
+  SKYPILOT_NUM_NODES       total number of hosts (ranks)
+  SKYPILOT_NUM_GPUS_PER_NODE  accelerator count visible per host
+  SKYPILOT_TASK_ID         unique id for this run
+  JAX_COORDINATOR_ADDRESS  rank-0 host ip:8476
+  JAX_NUM_PROCESSES / JAX_PROCESS_ID
+  TPU_WORKER_ID            host rank within its slice
+  TPU_WORKER_HOSTNAMES     comma-separated host IPs of this rank's slice
+  MEGASCALE_NUM_SLICES / MEGASCALE_SLICE_ID / MEGASCALE_COORDINATOR_ADDRESS
+                           multislice (DCN) bootstrap, set when a task
+                           spans >1 slice
+"""
+from __future__ import annotations
+
+import time
+import typing
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import constants
+from skypilot_tpu.provision import common as provision_common
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu import task as task_lib
+
+
+def make_task_id(task_name: Optional[str]) -> str:
+    ts = time.strftime('%Y%m%d-%H%M%S')
+    return f'{ts}_{task_name or "task"}'
+
+
+def build_job_spec(task: 'task_lib.Task',
+                   launched_resources: 'resources_lib.Resources',
+                   cluster_info: provision_common.ClusterInfo,
+                   task_id: Optional[str] = None,
+                   extra_env: Optional[Dict[str, str]] = None
+                   ) -> Dict[str, Any]:
+    """The spec consumed by agent.job_driver.run_job."""
+    assert isinstance(task.run, str) or task.run is None, (
+        'command generators resolved by caller')
+    instances = cluster_info.sorted_instances()
+    # Global rank order: (node_rank, host_rank); instances[0] is the head
+    # but ranks are topology order — recompute explicitly.
+    ordered = sorted(instances, key=lambda i: (i.node_rank, i.host_rank))
+    num_ranks = len(ordered)
+    head = ordered[0]
+    slice_spec = launched_resources.slice_spec
+    hosts_per_slice = (slice_spec.num_hosts if slice_spec is not None else 1)
+    num_slices = task.num_nodes
+    chips_per_host = (slice_spec.chips_per_host
+                      if slice_spec is not None else 0)
+
+    node_ips = '\n'.join(i.internal_ip for i in ordered)
+    task_id = task_id or make_task_id(task.name)
+
+    base_env: Dict[str, str] = {
+        constants.TASK_ID_ENV_VAR: task_id,
+        constants.NUM_NODES_ENV_VAR: str(num_ranks),
+        constants.NODE_IPS_ENV_VAR: node_ips,
+        constants.NUM_GPUS_PER_NODE_ENV_VAR: str(
+            _gpus_per_host(launched_resources)),
+        constants.JAX_COORDINATOR_ADDR_ENV_VAR:
+            f'{head.internal_ip}:{constants.JAX_COORDINATOR_PORT}',
+        constants.JAX_NUM_PROCESSES_ENV_VAR: str(num_ranks),
+    }
+    if slice_spec is not None:
+        base_env[constants.TPU_ACCELERATOR_TYPE_ENV_VAR] = (
+            slice_spec.gcp_accelerator_type())
+    if num_slices > 1:
+        base_env[constants.TPU_NUM_SLICES_ENV_VAR] = str(num_slices)
+        base_env[constants.MEGASCALE_COORDINATOR_ENV_VAR] = head.internal_ip
+    base_env.update(task.envs_and_secrets)
+    if extra_env:
+        base_env.update(extra_env)
+
+    per_rank_env: List[Dict[str, str]] = []
+    slice_hosts: Dict[int, List[str]] = {}
+    for inst in ordered:
+        slice_hosts.setdefault(inst.node_rank, []).append(inst.internal_ip)
+    for rank, inst in enumerate(ordered):
+        env = {
+            constants.NODE_RANK_ENV_VAR: str(rank),
+            constants.JAX_PROCESS_ID_ENV_VAR: str(rank),
+            constants.TPU_WORKER_ID_ENV_VAR: str(inst.host_rank),
+            constants.TPU_WORKER_HOSTNAMES_ENV_VAR: ','.join(
+                slice_hosts[inst.node_rank]),
+        }
+        if num_slices > 1:
+            env[constants.TPU_SLICE_ID_ENV_VAR] = str(inst.node_rank)
+        per_rank_env.append(env)
+
+    script = task.run or 'true'
+    return {
+        'task_id': task_id,
+        'script': script,
+        'env': base_env,
+        'per_rank_env': per_rank_env,
+        'cwd': constants.SKY_REMOTE_WORKDIR,
+        'hosts': [{
+            'addr': inst.agent_addr,
+            'rank': rank,
+            'instance_id': inst.instance_id,
+        } for rank, inst in enumerate(ordered)],
+        'num_slices': num_slices,
+        'hosts_per_slice': hosts_per_slice,
+        'chips_per_host': chips_per_host,
+    }
+
+
+def _gpus_per_host(resources: 'resources_lib.Resources') -> int:
+    """GPU count per host; TPUs excluded (schedulable non-GPU
+    accelerators, reference sky/utils/accelerator_registry.py:76-81)."""
+    if resources.is_tpu_slice or resources.accelerators is None:
+        return 0
+    return next(iter(resources.accelerators.values()))
+
+
+def resolve_run_command(task: 'task_lib.Task', num_ranks: int,
+                        ips: List[str]) -> Optional[str]:
+    """Resolve a callable run section (per-rank command generator)."""
+    if task.run is None or isinstance(task.run, str):
+        return task.run
+    # Callable: generate rank 0's command; per-rank generators are a
+    # reference feature used rarely — generate a dispatch script.
+    commands = []
+    for rank in range(num_ranks):
+        cmd = task.run(rank, ips)
+        commands.append(cmd if cmd else 'true')
+    lines = ['case "$SKYPILOT_NODE_RANK" in']
+    for rank, cmd in enumerate(commands):
+        lines.append(f'  {rank}) {cmd} ;;')
+    lines.append('esac')
+    return '\n'.join(lines)

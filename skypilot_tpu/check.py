@@ -1,0 +1,58 @@
+"""`check`: probe per-cloud credentials, cache enabled clouds.
+
+Reference: sky/check.py (:476-546 caches enabled clouds).
+"""
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_state
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+_CACHE_KEY = 'enabled_clouds'
+
+
+def check(quiet: bool = False) -> List[str]:
+    """Probe all registered clouds; persist and return the enabled set."""
+    import skypilot_tpu.clouds  # noqa: F401
+    enabled = []
+    details = {}
+    for cloud_cls in CLOUD_REGISTRY.values():
+        name = cloud_cls.canonical_name()
+        try:
+            ok, reason = cloud_cls.check_credentials()
+        except Exception as e:  # pylint: disable=broad-except
+            ok, reason = False, str(e)
+        details[name] = (ok, reason)
+        if ok:
+            enabled.append(name)
+    global_state.set_system_config(_CACHE_KEY, json.dumps(sorted(enabled)))
+    if not quiet:
+        for name, (ok, reason) in sorted(details.items()):
+            mark = '\x1b[32m✓\x1b[0m' if ok else '\x1b[31m✗\x1b[0m'
+            line = f'  {mark} {name}'
+            if not ok and reason:
+                line += f': {reason.splitlines()[0]}'
+            print(line)
+    return enabled
+
+
+def get_cached_enabled_clouds(refresh_if_empty: bool = True) -> List[str]:
+    cached = global_state.get_system_config(_CACHE_KEY)
+    if cached is None:
+        if not refresh_if_empty:
+            return []
+        return check(quiet=True)
+    return json.loads(cached)
+
+
+def get_cloud_or_raise(enabled: Optional[List[str]] = None):
+    if enabled is None:
+        enabled = get_cached_enabled_clouds()
+    if not enabled:
+        raise exceptions.NoCloudAccessError(
+            'No cloud is enabled. Run `stpu check` after configuring '
+            'credentials.')
+    return enabled

@@ -1,0 +1,159 @@
+"""Core non-launch verbs: status, start/stop/down, queue, logs, cost.
+
+Reference: sky/core.py (1967 LoC).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Union
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_state
+from skypilot_tpu import provision as provision_lib
+from skypilot_tpu.agent import job_lib
+from skypilot_tpu.backends import tpu_backend
+from skypilot_tpu.utils import ux_utils
+from skypilot_tpu.utils.status_lib import ClusterStatus
+
+
+def _get_handle(cluster_name: str) -> tpu_backend.TpuVmResourceHandle:
+    record = global_state.get_cluster(cluster_name)
+    if record is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} does not exist.')
+    return record['handle']
+
+
+def _refresh_one(record: Dict[str, Any]) -> Dict[str, Any]:
+    """Reconcile recorded status with the provisioner's live view.
+
+    Reference: backend_utils.refresh_cluster_status_handle — queries
+    provisioner `query_instances` and fixes drift (e.g. autostopped or
+    preempted clusters).
+    """
+    handle: tpu_backend.TpuVmResourceHandle = record['handle']
+    try:
+        statuses = provision_lib.query_instances(
+            handle.provider_name, handle.cluster_name_on_cloud,
+            handle.cluster_info.provider_config)
+    except Exception:  # pylint: disable=broad-except
+        return record
+    if not statuses:
+        # All instances gone: cluster was terminated externally.
+        global_state.remove_cluster(record['name'], terminate=True)
+        record['status'] = None
+        return record
+    values = set(statuses.values())
+    if values == {'running'} and len(statuses) >= handle.num_hosts:
+        new_status = ClusterStatus.UP
+    elif 'running' not in values:
+        new_status = ClusterStatus.STOPPED
+    else:
+        new_status = ClusterStatus.INIT
+    if new_status != record['status']:
+        global_state.set_cluster_status(record['name'], new_status)
+        record['status'] = new_status
+    return record
+
+
+def status(cluster_names: Optional[List[str]] = None,
+           refresh: bool = False) -> List[Dict[str, Any]]:
+    """Cluster records (reference: sky/core.py:112)."""
+    records = global_state.get_clusters()
+    if cluster_names:
+        records = [r for r in records if r['name'] in cluster_names]
+    if refresh:
+        records = [_refresh_one(r) for r in records]
+        records = [r for r in records if r['status'] is not None]
+    return records
+
+
+def start(cluster_name: str) -> None:
+    """Restart a STOPPED cluster (reference: sky/core.py start)."""
+    record = global_state.get_cluster(cluster_name)
+    if record is None:
+        raise exceptions.ClusterDoesNotExist(cluster_name)
+    handle: tpu_backend.TpuVmResourceHandle = record['handle']
+    from skypilot_tpu.provision import common as provision_common
+    from skypilot_tpu.backends.tpu_backend import TpuVmBackend
+    # Re-run the provisioner: run_instances resumes stopped nodes.
+    config = provision_common.ProvisionConfig(
+        provider_config=handle.cluster_info.provider_config,
+        authentication_config={},
+        count=handle.launched_nodes,
+        tags={})
+    provision_lib.run_instances(handle.provider_name,
+                                handle.launched_resources.region or '',
+                                handle.cluster_name_on_cloud, config)
+    cluster_info = provision_lib.get_cluster_info(
+        handle.provider_name, handle.launched_resources.region or '',
+        handle.cluster_name_on_cloud, handle.cluster_info.provider_config)
+    handle.cluster_info = cluster_info
+    backend = TpuVmBackend()
+    backend._bootstrap_runtime(handle)  # pylint: disable=protected-access
+    global_state.add_or_update_cluster(cluster_name, handle,
+                                       is_launch=False, ready=True)
+    ux_utils.log(f'Cluster {cluster_name!r} restarted.')
+
+
+def stop(cluster_name: str) -> None:
+    handle = _get_handle(cluster_name)
+    backend = tpu_backend.TpuVmBackend()
+    backend.teardown(handle, terminate=False)
+    ux_utils.log(f'Cluster {cluster_name!r} stopped.')
+
+
+def down(cluster_name: str, purge: bool = False) -> None:
+    handle = _get_handle(cluster_name)
+    backend = tpu_backend.TpuVmBackend()
+    backend.teardown(handle, terminate=True, purge=purge)
+    ux_utils.log(f'Cluster {cluster_name!r} terminated.')
+
+
+def autostop(cluster_name: str, idle_minutes: int,
+             down_on_idle: bool = False) -> None:
+    handle = _get_handle(cluster_name)
+    backend = tpu_backend.TpuVmBackend()
+    backend.set_autostop(handle,
+                         None if idle_minutes < 0 else idle_minutes,
+                         down_on_idle)
+
+
+def queue(cluster_name: str,
+          all_jobs: bool = False) -> List[Dict[str, Any]]:
+    handle = _get_handle(cluster_name)
+    jobs = handle.agent().get_jobs()
+    if not all_jobs:
+        jobs = jobs[:50]
+    for j in jobs:
+        j['status'] = j['status'].value
+    return jobs
+
+
+def cancel(cluster_name: str, job_ids: Optional[List[int]] = None,
+           all_jobs: bool = False) -> None:
+    handle = _get_handle(cluster_name)
+    backend = tpu_backend.TpuVmBackend()
+    backend.cancel_jobs(handle, job_ids, cancel_all=all_jobs)
+
+
+def tail_logs(cluster_name: str, job_id: Optional[int] = None,
+              follow: bool = True, tail: int = 0) -> int:
+    handle = _get_handle(cluster_name)
+    backend = tpu_backend.TpuVmBackend()
+    return backend.tail_logs(handle, job_id, follow=follow, tail=tail)
+
+
+def cost_report() -> List[Dict[str, Any]]:
+    """Terminated-cluster cost history (reference: sky/core.py:1256)."""
+    return global_state.get_cluster_history()
+
+
+def storage_ls() -> List[str]:
+    return global_state.get_storage_names()
+
+
+def storage_delete(name: str) -> None:
+    record = global_state.get_storage(name)
+    if record is None:
+        raise exceptions.StorageError(f'Storage {name!r} not found.')
+    global_state.remove_storage(name)

@@ -1,0 +1,188 @@
+"""Storage: bucket objects mounted or copied onto clusters. GCS-first.
+
+Reference: sky/data/storage.py (~5600 LoC, S3/GCS/Azure/R2/...). The
+TPU build is GCS-first (checkpoints + datasets live next to the TPUs;
+intra-GCP traffic is free): Storage wraps a gs:// bucket with three
+modes — MOUNT (gcsfuse), MOUNT_CACHED (rclone vfs cache), COPY
+(gcloud storage rsync to disk). S3 sources are supported as
+COPY-in via the s3 CLI when present.
+"""
+from __future__ import annotations
+
+import enum
+import os
+import shlex
+import typing
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_state
+from skypilot_tpu.utils import subprocess_utils
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu.utils import command_runner as runner_lib
+
+
+class StorageMode(enum.Enum):
+    MOUNT = 'MOUNT'
+    COPY = 'COPY'
+    MOUNT_CACHED = 'MOUNT_CACHED'
+
+
+class StoreType(enum.Enum):
+    GCS = 'GCS'
+    S3 = 'S3'
+
+    @classmethod
+    def from_url(cls, url: str) -> 'StoreType':
+        if url.startswith('gs://'):
+            return cls.GCS
+        if url.startswith('s3://'):
+            return cls.S3
+        raise exceptions.StorageSpecError(
+            f'Unsupported storage url {url!r} (gs:// or s3://).')
+
+
+class Storage:
+    """A named bucket + how to expose it on cluster hosts."""
+
+    def __init__(self, name: Optional[str] = None,
+                 source: Optional[str] = None,
+                 mode: StorageMode = StorageMode.MOUNT,
+                 store: Optional[StoreType] = None,
+                 persistent: bool = True) -> None:
+        if name is None and source is None:
+            raise exceptions.StorageSpecError(
+                'Storage needs a name (new bucket) or source (existing '
+                'bucket / local dir).')
+        self.name = name
+        self.source = source
+        self.mode = mode
+        self.persistent = persistent
+        if store is None and source is not None and '://' in source:
+            store = StoreType.from_url(source)
+        self.store = store or StoreType.GCS
+
+    # -- bucket url ------------------------------------------------------------
+    @property
+    def bucket_url(self) -> str:
+        if self.source and '://' in self.source:
+            return self.source.rstrip('/')
+        assert self.name, self
+        prefix = 'gs' if self.store == StoreType.GCS else 's3'
+        return f'{prefix}://{self.name}'
+
+    def is_local_source(self) -> bool:
+        return bool(self.source) and '://' not in str(self.source)
+
+    # -- yaml ---------------------------------------------------------------------
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any]) -> 'Storage':
+        config = dict(config)
+        mode = StorageMode(str(config.pop('mode', 'MOUNT')).upper())
+        store = config.pop('store', None)
+        out = cls(name=config.pop('name', None),
+                  source=config.pop('source', None),
+                  mode=mode,
+                  store=StoreType(store.upper()) if store else None,
+                  persistent=config.pop('persistent', True))
+        if config:
+            raise exceptions.StorageSpecError(
+                f'Unknown storage fields: {sorted(config)}')
+        return out
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        if self.name:
+            out['name'] = self.name
+        if self.source:
+            out['source'] = self.source
+        out['mode'] = self.mode.value
+        out['store'] = self.store.value
+        if not self.persistent:
+            out['persistent'] = False
+        return out
+
+    # -- server-side sync (local source -> bucket) ---------------------------------
+    def sync_local_source(self) -> None:
+        """Upload a local-dir source to the bucket before mounting."""
+        if not self.is_local_source():
+            return
+        assert self.name, 'local-source storage needs a bucket name'
+        src = os.path.expanduser(str(self.source))
+        url = self.bucket_url
+        if self.store == StoreType.GCS:
+            cmd = (f'gcloud storage rsync -r {shlex.quote(src)} '
+                   f'{shlex.quote(url)}')
+        else:
+            cmd = f'aws s3 sync {shlex.quote(src)} {shlex.quote(url)}'
+        rc = os.system(cmd)
+        if rc != 0:
+            raise exceptions.StorageUploadError(
+                f'Failed to sync {src} to {url} (rc={rc}).')
+        global_state.add_or_update_storage(self.name, self.to_yaml_config(),
+                                           'READY')
+
+    def __repr__(self) -> str:
+        return (f'Storage({self.bucket_url}, mode={self.mode.value})')
+
+
+# ---------------------------------------------------------------------------
+# On-host commands (reference: sky/data/mounting_utils.py)
+# ---------------------------------------------------------------------------
+def download_command(uri: str, dst: str) -> str:
+    """Shell command to copy a bucket (or https file) onto a host."""
+    q = shlex.quote
+    if uri.startswith('gs://'):
+        return (f'mkdir -p {q(dst)} && '
+                f'(gcloud storage rsync -r {q(uri)} {q(dst)} || '
+                f'gsutil -m rsync -r {q(uri)} {q(dst)})')
+    if uri.startswith('s3://'):
+        return f'mkdir -p {q(dst)} && aws s3 sync {q(uri)} {q(dst)}'
+    if uri.startswith('https://'):
+        return (f'mkdir -p $(dirname {q(dst)}) && '
+                f'curl -fsSL {q(uri)} -o {q(dst)}')
+    raise exceptions.StorageSpecError(f'Unsupported uri {uri!r}')
+
+
+def mount_command(storage: 'Storage', mount_path: str) -> str:
+    """Shell command mounting the bucket at mount_path on a host."""
+    q = shlex.quote
+    url = storage.bucket_url
+    bucket = url.split('://', 1)[1].split('/', 1)[0]
+    if storage.mode == StorageMode.COPY:
+        return download_command(url, mount_path)
+    if storage.store != StoreType.GCS:
+        raise exceptions.StorageModeError(
+            f'MOUNT modes are GCS-only in this build; got {url}.')
+    if storage.mode == StorageMode.MOUNT:
+        return (
+            f'mkdir -p {q(mount_path)} && '
+            f'(mountpoint -q {q(mount_path)} && echo already mounted) || '
+            f'gcsfuse --implicit-dirs '
+            f'--rename-dir-limit 10000 '
+            f'--stat-cache-ttl 10s --type-cache-ttl 10s '
+            f'{q(bucket)} {q(mount_path)}')
+    # MOUNT_CACHED: rclone VFS write-back cache — fast local writes,
+    # async upload; the checkpoint-friendly mode (reference
+    # mounting_utils.py:698).
+    return (
+        f'mkdir -p {q(mount_path)} ~/.cache/rclone && '
+        f'rclone mount :gcs:{q(bucket)} {q(mount_path)} '
+        f'--daemon --vfs-cache-mode writes '
+        f'--vfs-cache-max-size 10G --dir-cache-time 10s')
+
+
+def mount_storage_on_hosts(storage: 'Storage', mount_path: str,
+                           runners: List['runner_lib.CommandRunner']) -> None:
+    storage.sync_local_source()
+    cmd = mount_command(storage, mount_path)
+
+    def mount_one(runner) -> None:
+        rc = runner.run(cmd, stream_logs=False)
+        if rc != 0:
+            raise exceptions.StorageError(
+                f'Failed to mount {storage.bucket_url} at {mount_path} '
+                f'on {runner.node_id} (rc={rc}).')
+
+    subprocess_utils.run_in_parallel(mount_one, runners)

@@ -1,0 +1,179 @@
+"""Execution engine: the per-launch stage machine.
+
+Reference: sky/execution.py (1023 LoC) — stages
+OPTIMIZE→PROVISION→SYNC_WORKDIR→SYNC_FILE_MOUNTS→SETUP→EXEC→DOWN
+(`sky/execution.py:48-60`), admin policy applied first, then walked
+against the backend. `exec` is the fast path reusing an UP cluster.
+"""
+from __future__ import annotations
+
+import enum
+from typing import Any, List, Optional, Tuple
+
+from skypilot_tpu import admin_policy
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_state
+from skypilot_tpu import optimizer as optimizer_lib
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.backends import tpu_backend
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import timeline
+from skypilot_tpu.utils import ux_utils
+from skypilot_tpu.utils.status_lib import ClusterStatus
+
+
+class Stage(enum.Enum):
+    OPTIMIZE = enum.auto()
+    PROVISION = enum.auto()
+    SYNC_WORKDIR = enum.auto()
+    SYNC_FILE_MOUNTS = enum.auto()
+    SETUP = enum.auto()
+    EXEC = enum.auto()
+    DOWN = enum.auto()
+
+
+def _as_dag(task_or_dag) -> dag_lib.Dag:
+    if isinstance(task_or_dag, dag_lib.Dag):
+        return task_or_dag
+    dag = dag_lib.Dag()
+    dag.add(task_or_dag)
+    return dag
+
+
+@timeline.event
+def launch(
+    task_or_dag,
+    cluster_name: Optional[str] = None,
+    *,
+    dryrun: bool = False,
+    stream_logs: bool = True,
+    detach_run: bool = False,
+    idle_minutes_to_autostop: Optional[int] = None,
+    down: bool = False,
+    retry_until_up: bool = False,
+    no_setup: bool = False,
+    _quiet_optimizer: bool = False,
+    _is_launched_by_jobs_controller: bool = False,
+) -> Tuple[Optional[int], Optional[tpu_backend.TpuVmResourceHandle]]:
+    """Provision (if needed) + run a task. Returns (job_id, handle).
+
+    Reference: sky/execution.py:683 `launch`.
+    """
+    dag = _as_dag(task_or_dag)
+    if len(dag.tasks) != 1:
+        raise exceptions.NotSupportedError(
+            'launch() takes a single task; multi-task DAGs go through '
+            'managed jobs (`jobs launch`).')
+    if cluster_name is None:
+        cluster_name = common_utils.fresh_cluster_name()
+    common_utils.check_cluster_name_is_valid(cluster_name)
+
+    if not dag.policy_applied:
+        dag = admin_policy.apply(
+            dag, admin_policy.RequestOptions(
+                cluster_name=cluster_name,
+                idle_minutes_to_autostop=idle_minutes_to_autostop,
+                down=down, dryrun=dryrun))
+    task = dag.tasks[0]
+    backend = tpu_backend.TpuVmBackend()
+
+    # --- reuse or provision -------------------------------------------------
+    handle = None
+    existing = global_state.get_cluster(cluster_name)
+    if existing is not None and existing['status'] != ClusterStatus.STOPPED:
+        handle = existing['handle']
+
+    stages: List[Stage] = []
+    if handle is None:
+        stages.append(Stage.OPTIMIZE)
+        stages.append(Stage.PROVISION)
+    stages += [Stage.SYNC_WORKDIR, Stage.SYNC_FILE_MOUNTS]
+    if not no_setup:
+        stages.append(Stage.SETUP)
+    stages.append(Stage.EXEC)
+    if down and not detach_run:
+        stages.append(Stage.DOWN)
+
+    job_id: Optional[int] = None
+    for stage in stages:
+        if stage == Stage.OPTIMIZE:
+            if any(r.cloud is None or not r.is_launchable()
+                   for r in task.resources) or task.best_resources is None:
+                optimizer_lib.Optimizer.optimize(dag, quiet=_quiet_optimizer)
+        elif stage == Stage.PROVISION:
+            to_provision = task.best_resources
+            if to_provision is None:
+                # resources were already concrete; pick any
+                to_provision = next(iter(task.resources))
+                feas = to_provision.cloud.get_feasible_launchable_resources(
+                    to_provision, task.num_nodes)
+                if not feas.resources_list:
+                    raise exceptions.ResourcesUnavailableError(
+                        f'{to_provision} is not launchable.')
+                to_provision = feas.resources_list[0]
+            handle = backend.provision(task, to_provision, dryrun=dryrun,
+                                       stream_logs=stream_logs,
+                                       cluster_name=cluster_name,
+                                       retry_until_up=retry_until_up)
+            if dryrun:
+                return None, None
+            assert handle is not None
+            if idle_minutes_to_autostop is not None:
+                backend.set_autostop(handle, idle_minutes_to_autostop, down)
+        elif stage == Stage.SYNC_WORKDIR:
+            if dryrun:
+                continue
+            assert handle is not None
+            backend.check_resources_fit_cluster(handle, task)
+            if task.workdir is not None:
+                backend.sync_workdir(handle, task.workdir)
+        elif stage == Stage.SYNC_FILE_MOUNTS:
+            if dryrun:
+                continue
+            if task.file_mounts or task.storage_mounts:
+                backend.sync_file_mounts(handle, task.file_mounts,
+                                         task.storage_mounts)
+        elif stage == Stage.SETUP:
+            if dryrun:
+                continue
+            backend.setup(handle, task)
+        elif stage == Stage.EXEC:
+            job_id = backend.execute(handle, task, detach_run=detach_run,
+                                     dryrun=dryrun)
+        elif stage == Stage.DOWN:
+            backend.teardown(handle, terminate=True)
+    return job_id, handle
+
+
+@timeline.event
+def exec(  # pylint: disable=redefined-builtin
+    task_or_dag,
+    cluster_name: str,
+    *,
+    dryrun: bool = False,
+    detach_run: bool = False,
+) -> Tuple[Optional[int], Optional[tpu_backend.TpuVmResourceHandle]]:
+    """Fast path: run on an existing UP cluster, no provisioning.
+
+    Reference: sky/execution.py:918 `exec` — stages
+    [SYNC_WORKDIR, EXEC] against the cached handle.
+    """
+    dag = _as_dag(task_or_dag)
+    task = dag.tasks[0]
+    record = global_state.get_cluster(cluster_name)
+    if record is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} not found; `launch` it first.')
+    if record['status'] != ClusterStatus.UP:
+        raise exceptions.ClusterNotUpError(
+            f'Cluster {cluster_name!r} is {record["status"].value}; '
+            'exec needs an UP cluster.', cluster_status=record['status'])
+    handle = record['handle']
+    backend = tpu_backend.TpuVmBackend()
+    backend.check_resources_fit_cluster(handle, task)
+    if task.workdir is not None and not dryrun:
+        backend.sync_workdir(handle, task.workdir)
+    job_id = backend.execute(handle, task, detach_run=detach_run,
+                             dryrun=dryrun)
+    return job_id, handle

@@ -1,0 +1,54 @@
+"""Provisioner router: dispatch function calls to per-cloud modules.
+
+Reference: sky/provision/__init__.py — `_route_to_cloud_impl` looks up
+`sky.provision.<cloud>.<fn>`; clouds register by module presence.
+Interface (all take (provider_name-dispatched) positional args):
+
+  run_instances(region, cluster_name_on_cloud, config) -> ProvisionRecord
+  wait_instances(region, cluster_name_on_cloud, state) -> None
+  stop_instances(cluster_name_on_cloud, provider_config) -> None
+  terminate_instances(cluster_name_on_cloud, provider_config) -> None
+  query_instances(cluster_name_on_cloud, provider_config)
+      -> Dict[instance_id, status]
+  get_cluster_info(region, cluster_name_on_cloud, provider_config)
+      -> ClusterInfo
+  open_ports / cleanup_ports(cluster_name_on_cloud, ports, provider_config)
+"""
+from __future__ import annotations
+
+import functools
+import importlib
+from typing import Any
+
+from skypilot_tpu.utils import timeline
+
+
+def _route(provider_name: str, fn_name: str):
+    module_name = provider_name.lower()
+    module = importlib.import_module(
+        f'skypilot_tpu.provision.{module_name}.instance')
+    fn = getattr(module, fn_name, None)
+    if fn is None:
+        raise NotImplementedError(
+            f'{module_name} provisioner does not implement {fn_name}')
+    return fn
+
+
+def _make_router(fn_name: str):
+
+    @timeline.event
+    def router(provider_name: str, *args: Any, **kwargs: Any) -> Any:
+        return _route(provider_name, fn_name)(*args, **kwargs)
+
+    router.__name__ = fn_name
+    return router
+
+
+run_instances = _make_router('run_instances')
+wait_instances = _make_router('wait_instances')
+stop_instances = _make_router('stop_instances')
+terminate_instances = _make_router('terminate_instances')
+query_instances = _make_router('query_instances')
+get_cluster_info = _make_router('get_cluster_info')
+open_ports = _make_router('open_ports')
+cleanup_ports = _make_router('cleanup_ports')

@@ -1,0 +1,86 @@
+"""Provisioner data structures shared across clouds.
+
+Reference: sky/provision/common.py — ProvisionConfig/ProvisionRecord/
+ClusterInfo/InstanceInfo.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class ProvisionConfig:
+    provider_config: Dict[str, Any]      # deploy variables from the cloud
+    authentication_config: Dict[str, Any]
+    count: int                            # task num_nodes (slices for TPU)
+    tags: Dict[str, str]
+    resume_stopped_nodes: bool = True
+    ports_to_open: Optional[List[str]] = None
+
+
+@dataclasses.dataclass
+class ProvisionRecord:
+    provider_name: str
+    cluster_name: str
+    region: str
+    zone: Optional[str]
+    head_instance_id: str
+    created_instance_ids: List[str]
+    resumed_instance_ids: List[str] = dataclasses.field(default_factory=list)
+
+    def is_instance_just_booted(self, instance_id: str) -> bool:
+        return (instance_id in self.created_instance_ids or
+                instance_id in self.resumed_instance_ids)
+
+
+@dataclasses.dataclass
+class InstanceInfo:
+    """One host (a TPU-VM worker, a GCE VM, or a local sandbox)."""
+    instance_id: str
+    internal_ip: str
+    external_ip: Optional[str]
+    ssh_port: int = 22
+    agent_port: int = 0        # where this host's agent listens
+    tags: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # TPU topology coordinates:
+    node_rank: int = 0         # which Task node (slice) this host belongs to
+    host_rank: int = 0         # rank within the slice
+
+    def get_feasible_ip(self) -> str:
+        return self.external_ip or self.internal_ip
+
+    @property
+    def agent_addr(self) -> str:
+        """host:port reachable from *within* the cluster network."""
+        return f'{self.internal_ip}:{self.agent_port}'
+
+
+@dataclasses.dataclass
+class ClusterInfo:
+    instances: List[InstanceInfo]
+    head_instance_id: str
+    provider_name: str
+    provider_config: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    ssh_user: str = 'skypilot'
+    ssh_private_key: Optional[str] = None
+    # For Local clusters: sandbox dirs keyed by instance_id.
+    custom: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def get_head_instance(self) -> InstanceInfo:
+        for inst in self.instances:
+            if inst.instance_id == self.head_instance_id:
+                return inst
+        raise ValueError(f'head {self.head_instance_id} not in instances')
+
+    def sorted_instances(self) -> List[InstanceInfo]:
+        """Deterministic order: (node_rank, host_rank), head first overall."""
+        head = self.get_head_instance()
+        rest = [i for i in self.instances
+                if i.instance_id != self.head_instance_id]
+        rest.sort(key=lambda i: (i.node_rank, i.host_rank))
+        return [head] + rest
+
+    @property
+    def num_instances(self) -> int:
+        return len(self.instances)

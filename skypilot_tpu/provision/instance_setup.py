@@ -1,0 +1,67 @@
+"""Runtime bootstrap on freshly provisioned cloud hosts.
+
+Reference: sky/provision/instance_setup.py — install deps, start the
+runtime (there: ray head/workers + skylet; here: one agent per host).
+Used by the GCP/SSH paths; the Local provisioner starts agents itself.
+"""
+from __future__ import annotations
+
+import os
+from typing import List
+
+from skypilot_tpu import constants
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.utils import command_runner as runner_lib
+from skypilot_tpu.utils import subprocess_utils
+
+_PKG_REMOTE_DIR = '~/.sky-tpu-runtime/skypilot_tpu_pkg'
+_VENV_PY = 'python3'
+
+_AGENT_START_TEMPLATE = (
+    'mkdir -p {home} && cd {pkg_dir} && '
+    'pkill -f "skypilot_tpu.agent.agent --port {port}" || true; '
+    'PYTHONPATH={pkg_dir} nohup {python} -m skypilot_tpu.agent.agent '
+    '--port {port} --home {home} --cluster {cluster} {head_flag} '
+    '> {home}/agent.log 2>&1 & '
+    'sleep 1 && curl -sf http://localhost:{port}/health > /dev/null')
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def setup_agents(cluster_info: provision_common.ClusterInfo,
+                 runners: List[runner_lib.CommandRunner],
+                 cluster_name: str) -> None:
+    """Upload the package to every host and start its agent.
+
+    The package is rsynced from the server's own installation — the
+    reference builds+uploads a wheel so remote runtime matches server
+    code (sky/backends/wheel_utils.py); rsync of the package tree is
+    the same guarantee with less machinery.
+    """
+    src = os.path.join(_repo_root(), 'skypilot_tpu') + '/'
+    instances = cluster_info.sorted_instances()
+
+    def bootstrap(pair) -> None:
+        inst, runner = pair
+        runner.run(f'mkdir -p {_PKG_REMOTE_DIR}/skypilot_tpu')
+        runner.rsync(src, f'{_PKG_REMOTE_DIR}/skypilot_tpu/', up=True,
+                     excludes=['__pycache__'])
+        is_head = inst.instance_id == cluster_info.head_instance_id
+        cmd = _AGENT_START_TEMPLATE.format(
+            home=constants.SKY_REMOTE_HOME,
+            pkg_dir=_PKG_REMOTE_DIR,
+            python=_VENV_PY,
+            port=inst.agent_port or constants.AGENT_PORT,
+            cluster=cluster_name,
+            head_flag='--head' if is_head else '')
+        rc = runner.run(cmd, stream_logs=False)
+        if rc != 0:
+            raise exceptions.ClusterSetUpError(
+                f'Failed to start agent on {inst.instance_id} (rc={rc}).')
+
+    subprocess_utils.run_in_parallel(bootstrap,
+                                     list(zip(instances, runners)))

@@ -1,0 +1,230 @@
+"""Local provisioner: sandbox-dir "hosts" with real agent processes.
+
+Emulates a TPU slice's host layout: one Task node = `tpu_num_hosts`
+sandboxes, each with its own agent process on 127.0.0.1:<port>. The
+whole backend path (bootstrap, gang exec, logs, autostop, teardown)
+runs for real — the role the reference fills with mocked clouds +
+kind clusters (SURVEY §4).
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import socket
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import constants
+from skypilot_tpu.provision import common
+from skypilot_tpu.utils import subprocess_utils
+
+
+def _cluster_dir(cluster_name_on_cloud: str) -> str:
+    return os.path.join(constants.local_clusters_dir(), cluster_name_on_cloud)
+
+
+def _meta_path(cluster_name_on_cloud: str) -> str:
+    return os.path.join(_cluster_dir(cluster_name_on_cloud), 'meta.json')
+
+
+def _load_meta(cluster_name_on_cloud: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(_meta_path(cluster_name_on_cloud), 'r',
+                  encoding='utf-8') as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _save_meta(cluster_name_on_cloud: str, meta: Dict[str, Any]) -> None:
+    os.makedirs(_cluster_dir(cluster_name_on_cloud), exist_ok=True)
+    with open(_meta_path(cluster_name_on_cloud), 'w', encoding='utf-8') as f:
+        json.dump(meta, f, indent=1)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
+def _start_agent(host: Dict[str, Any], cluster: str) -> int:
+    agent_home = os.path.join(host['dir'], '.sky-tpu-agent')
+    cmd = [sys.executable, '-m', 'skypilot_tpu.agent.agent',
+           '--port', str(host['agent_port']),
+           '--home', agent_home,
+           '--cluster', cluster,
+           '--bind', '127.0.0.1']
+    if host['is_head']:
+        cmd.append('--head')
+    env = dict(os.environ)
+    env['HOME'] = host['dir']
+    env.setdefault('PYTHONPATH', '')
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    env['PYTHONPATH'] = f'{repo_root}:{env["PYTHONPATH"]}'
+    pid = subprocess_utils.launch_daemon(
+        cmd, log_path=os.path.join(host['dir'], 'agent.log'), env=env,
+        cwd=host['dir'])
+    return pid
+
+
+def run_instances(region: str, cluster_name_on_cloud: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    del region
+    meta = _load_meta(cluster_name_on_cloud)
+    hosts_per_node = int(config.provider_config.get('tpu_num_hosts') or 1)
+    num_nodes = config.count
+    created: List[str] = []
+    resumed: List[str] = []
+
+    if meta is None:
+        hosts = []
+        for node in range(num_nodes):
+            for hrank in range(hosts_per_node):
+                host_id = f'host-{node}-{hrank}'
+                host_dir = os.path.join(_cluster_dir(cluster_name_on_cloud),
+                                        host_id)
+                os.makedirs(host_dir, exist_ok=True)
+                hosts.append({
+                    'id': host_id,
+                    'dir': host_dir,
+                    'agent_port': _free_port(),
+                    'agent_pid': -1,
+                    'node_rank': node,
+                    'host_rank': hrank,
+                    'is_head': node == 0 and hrank == 0,
+                })
+        meta = {
+            'cluster': cluster_name_on_cloud,
+            'num_nodes': num_nodes,
+            'hosts_per_node': hosts_per_node,
+            'hosts': hosts,
+            'provider_config': config.provider_config,
+            'created_at': time.time(),
+        }
+        created = [h['id'] for h in hosts]
+    else:
+        if (meta['num_nodes'] != num_nodes or
+                meta['hosts_per_node'] != hosts_per_node):
+            raise RuntimeError(
+                f'Cluster {cluster_name_on_cloud} exists with different '
+                f'shape ({meta["num_nodes"]}x{meta["hosts_per_node"]}); '
+                f'requested {num_nodes}x{hosts_per_node}.')
+
+    # (Re)start dead agents — also the resume-stopped path.
+    for host in meta['hosts']:
+        if not subprocess_utils.process_alive(host['agent_pid']):
+            host['agent_pid'] = _start_agent(host, cluster_name_on_cloud)
+            if host['id'] not in created:
+                resumed.append(host['id'])
+    meta['status'] = 'running'
+    _save_meta(cluster_name_on_cloud, meta)
+
+    head = next(h for h in meta['hosts'] if h['is_head'])
+    return common.ProvisionRecord(
+        provider_name='local',
+        cluster_name=cluster_name_on_cloud,
+        region='local',
+        zone='local-a',
+        head_instance_id=head['id'],
+        created_instance_ids=created,
+        resumed_instance_ids=resumed,
+    )
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str,
+                   state: Optional[str] = None) -> None:
+    del region, state  # agents start instantly; health checked later
+
+
+def _kill_agents(meta: Dict[str, Any]) -> None:
+    for host in meta.get('hosts', []):
+        pid = host.get('agent_pid', -1)
+        if pid > 0:
+            subprocess_utils.kill_process_tree(pid)
+        host['agent_pid'] = -1
+
+
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   worker_only: bool = False) -> None:
+    del provider_config, worker_only
+    meta = _load_meta(cluster_name_on_cloud)
+    if meta is None:
+        return
+    _kill_agents(meta)
+    meta['status'] = 'stopped'
+    _save_meta(cluster_name_on_cloud, meta)
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Optional[Dict[str, Any]] = None,
+                        worker_only: bool = False) -> None:
+    del provider_config, worker_only
+    meta = _load_meta(cluster_name_on_cloud)
+    if meta is not None:
+        _kill_agents(meta)
+    shutil.rmtree(_cluster_dir(cluster_name_on_cloud), ignore_errors=True)
+
+
+def query_instances(cluster_name_on_cloud: str,
+                    provider_config: Optional[Dict[str, Any]] = None,
+                    non_terminated_only: bool = True
+                    ) -> Dict[str, Optional[str]]:
+    del provider_config
+    meta = _load_meta(cluster_name_on_cloud)
+    if meta is None:
+        return {}
+    out: Dict[str, Optional[str]] = {}
+    for host in meta['hosts']:
+        alive = subprocess_utils.process_alive(host.get('agent_pid', -1))
+        status = 'running' if alive else 'stopped'
+        if non_terminated_only and status is None:
+            continue
+        out[host['id']] = status
+    return out
+
+
+def get_cluster_info(region: str, cluster_name_on_cloud: str,
+                     provider_config: Optional[Dict[str, Any]] = None
+                     ) -> common.ClusterInfo:
+    del region, provider_config
+    meta = _load_meta(cluster_name_on_cloud)
+    if meta is None:
+        raise RuntimeError(f'Local cluster {cluster_name_on_cloud} not found')
+    instances = []
+    sandbox_dirs = {}
+    for host in meta['hosts']:
+        instances.append(common.InstanceInfo(
+            instance_id=host['id'],
+            internal_ip='127.0.0.1',
+            external_ip='127.0.0.1',
+            ssh_port=-1,
+            agent_port=host['agent_port'],
+            node_rank=host['node_rank'],
+            host_rank=host['host_rank'],
+        ))
+        sandbox_dirs[host['id']] = host['dir']
+    head = next(h for h in meta['hosts'] if h['is_head'])
+    return common.ClusterInfo(
+        instances=instances,
+        head_instance_id=head['id'],
+        provider_name='local',
+        provider_config=meta.get('provider_config', {}),
+        ssh_user=os.environ.get('USER', 'root'),
+        ssh_private_key=None,
+        custom={'sandbox_dirs': sandbox_dirs},
+    )
+
+
+def open_ports(cluster_name_on_cloud: str, ports: List[str],
+               provider_config: Optional[Dict[str, Any]] = None) -> None:
+    pass  # localhost: nothing to open
+
+
+def cleanup_ports(cluster_name_on_cloud: str, ports: List[str],
+                  provider_config: Optional[Dict[str, Any]] = None) -> None:
+    pass

@@ -1,0 +1,26 @@
+"""Autostop hook for Local clusters: the cluster stops/terminates itself.
+
+Reference pattern: sky/skylet/autostop_lib.py — the cluster executes
+the stop from within, using its own credentials. For local sandboxes
+that reduces to invoking the local provisioner.
+"""
+from __future__ import annotations
+
+import argparse
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--cluster', required=True)
+    parser.add_argument('--action', choices=['stop', 'terminate'],
+                        default='stop')
+    args = parser.parse_args()
+    from skypilot_tpu.provision.local import instance
+    if args.action == 'stop':
+        instance.stop_instances(args.cluster)
+    else:
+        instance.terminate_instances(args.cluster)
+
+
+if __name__ == '__main__':
+    main()

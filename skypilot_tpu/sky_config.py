@@ -1,0 +1,113 @@
+"""Layered YAML config.
+
+Reference: sky/skypilot_config.py — server config + user
+`~/.sky/config.yaml` + project `.sky.yaml` + per-task `config:`
+overrides, nested-key get, region-scoped lookups.
+
+Layers here (later overrides earlier):
+  1. server:   ~/.sky-tpu/config.yaml  (SKYPILOT_TPU_HOME aware)
+  2. user:     $SKYPILOT_TPU_CONFIG (path) if set
+  3. project:  ./.sky-tpu.yaml
+  4. runtime overrides pushed via `override()` (per-request).
+"""
+from __future__ import annotations
+
+import contextlib
+import copy
+import os
+import threading
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import yaml
+
+from skypilot_tpu import constants
+
+_local = threading.local()
+
+
+def _load_yaml(path: str) -> Dict[str, Any]:
+    path = os.path.expanduser(path)
+    if not os.path.exists(path):
+        return {}
+    with open(path, 'r', encoding='utf-8') as f:
+        out = yaml.safe_load(f) or {}
+    if not isinstance(out, dict):
+        raise ValueError(f'Config {path} must be a YAML mapping.')
+    return out
+
+
+def _deep_merge(base: Dict[str, Any], over: Dict[str, Any]) -> Dict[str, Any]:
+    out = dict(base)
+    for k, v in over.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def _layers() -> List[Dict[str, Any]]:
+    layers = [
+        _load_yaml(os.path.join(constants.sky_home(), 'config.yaml')),
+    ]
+    env_path = os.environ.get('SKYPILOT_TPU_CONFIG')
+    if env_path:
+        layers.append(_load_yaml(env_path))
+    layers.append(_load_yaml('.sky-tpu.yaml'))
+    layers.extend(getattr(_local, 'overrides', []))
+    return layers
+
+
+def to_dict() -> Dict[str, Any]:
+    merged: Dict[str, Any] = {}
+    for layer in _layers():
+        merged = _deep_merge(merged, layer)
+    return merged
+
+
+def get_nested(keys: Tuple[str, ...], default: Any = None,
+               override_configs: Optional[Dict[str, Any]] = None) -> Any:
+    config = to_dict()
+    if override_configs:
+        config = _deep_merge(config, override_configs)
+    cur: Any = config
+    for k in keys:
+        if not isinstance(cur, dict) or k not in cur:
+            return default
+        cur = cur[k]
+    return cur
+
+
+def get_effective_region_config(cloud: str, region: Optional[str],
+                                keys: Tuple[str, ...],
+                                default: Any = None) -> Any:
+    """cloud-scoped lookup with per-region override block.
+
+    config: {gcp: {labels: ..., regions: {us-central2: {labels: ...}}}}
+    Reference: skypilot_config.get_effective_region_config (:366).
+    """
+    base = get_nested((cloud,) + keys, default)
+    if region is not None:
+        regional = get_nested((cloud, 'regions', region) + keys, None)
+        if regional is not None:
+            if isinstance(base, dict) and isinstance(regional, dict):
+                return _deep_merge(base, regional)
+            return regional
+    return base
+
+
+@contextlib.contextmanager
+def override(config: Dict[str, Any]) -> Iterator[None]:
+    """Per-request config override (the executor wraps requests in this)."""
+    if not hasattr(_local, 'overrides'):
+        _local.overrides = []
+    _local.overrides.append(copy.deepcopy(config))
+    try:
+        yield
+    finally:
+        _local.overrides.pop()
+
+
+def loaded_config_path() -> Optional[str]:
+    path = os.path.join(constants.sky_home(), 'config.yaml')
+    return path if os.path.exists(os.path.expanduser(path)) else None

@@ -1,0 +1,79 @@
+"""Subprocess helpers: parallel fan-out, process-tree kill, daemonize.
+
+Reference: sky/utils/subprocess_utils.py.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import psutil
+
+
+def run_in_parallel(func: Callable, args: Sequence[Any],
+                    num_threads: Optional[int] = None) -> List[Any]:
+    """Apply func over args with a thread pool (SSH fan-out pattern)."""
+    if not args:
+        return []
+    if len(args) == 1:
+        return [func(args[0])]
+    workers = num_threads or min(32, len(args))
+    with ThreadPoolExecutor(max_workers=workers) as pool:
+        return list(pool.map(func, args))
+
+
+def kill_process_tree(pid: int, include_parent: bool = True,
+                      sig: int = signal.SIGTERM) -> None:
+    try:
+        parent = psutil.Process(pid)
+    except psutil.NoSuchProcess:
+        return
+    children = parent.children(recursive=True)
+    for proc in children:
+        try:
+            proc.send_signal(sig)
+        except psutil.NoSuchProcess:
+            pass
+    if include_parent:
+        try:
+            parent.send_signal(sig)
+        except psutil.NoSuchProcess:
+            pass
+
+
+def kill_children_processes(parent_pid: Optional[int] = None,
+                            force: bool = False) -> None:
+    kill_process_tree(parent_pid or os.getpid(), include_parent=False,
+                      sig=signal.SIGKILL if force else signal.SIGTERM)
+
+
+def launch_daemon(cmd: List[str], log_path: str,
+                  env: Optional[dict] = None,
+                  cwd: Optional[str] = None) -> int:
+    """Start a detached daemon process; returns pid."""
+    os.makedirs(os.path.dirname(os.path.abspath(log_path)), exist_ok=True)
+    with open(log_path, 'ab') as log_file:
+        proc = subprocess.Popen(
+            cmd,
+            stdout=log_file,
+            stderr=subprocess.STDOUT,
+            stdin=subprocess.DEVNULL,
+            env=env,
+            cwd=cwd,
+            start_new_session=True,
+        )
+    return proc.pid
+
+
+def process_alive(pid: int) -> bool:
+    if pid is None or pid <= 0:
+        return False
+    try:
+        proc = psutil.Process(pid)
+        return proc.is_running() and proc.status() != psutil.STATUS_ZOMBIE
+    except psutil.NoSuchProcess:
+        return False

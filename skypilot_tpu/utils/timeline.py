@@ -1,0 +1,93 @@
+"""Chrome trace-event tracing.
+
+Reference: sky/utils/timeline.py — JSON trace written when
+SKYPILOT_TIMELINE_FILE_PATH is set; `@timeline.event` marks hot
+functions. Load the output in chrome://tracing or Perfetto.
+"""
+from __future__ import annotations
+
+import atexit
+import functools
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, List, Optional, Union
+
+_events: List[dict] = []
+_lock = threading.Lock()
+_enabled_path: Optional[str] = None
+
+
+def _init() -> None:
+    global _enabled_path
+    _enabled_path = os.environ.get('SKYPILOT_TIMELINE_FILE_PATH')
+    if _enabled_path:
+        atexit.register(save)
+
+
+def enabled() -> bool:
+    return _enabled_path is not None
+
+
+class Event:
+    """Context manager emitting a complete ('X') trace event."""
+
+    def __init__(self, name: str, message: Optional[str] = None) -> None:
+        self._name = name
+        self._message = message
+        self._start = 0.0
+
+    def __enter__(self) -> 'Event':
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *args) -> None:
+        if _enabled_path is None:
+            return
+        end = time.perf_counter()
+        with _lock:
+            _events.append({
+                'name': self._name,
+                'cat': 'skypilot_tpu',
+                'ph': 'X',
+                'ts': self._start * 1e6,
+                'dur': (end - self._start) * 1e6,
+                'pid': os.getpid(),
+                'tid': threading.get_ident() % 100000,
+                'args': {'message': self._message} if self._message else {},
+            })
+
+
+def event(fn_or_name: Union[Callable, str]) -> Callable:
+    """Decorator form: @timeline.event or @timeline.event('name')."""
+
+    def decorate(fn: Callable, name: str) -> Callable:
+
+        @functools.wraps(fn)
+        def wrapper(*args: Any, **kwargs: Any) -> Any:
+            if _enabled_path is None:
+                return fn(*args, **kwargs)
+            with Event(name):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    if callable(fn_or_name):
+        return decorate(fn_or_name, getattr(fn_or_name, '__qualname__',
+                                            fn_or_name.__name__))
+    return lambda fn: decorate(fn, fn_or_name)
+
+
+def save() -> None:
+    if _enabled_path is None or not _events:
+        return
+    path = os.path.expanduser(_enabled_path)
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with _lock:
+        payload = {'traceEvents': list(_events)}
+    with open(path, 'w', encoding='utf-8') as f:
+        json.dump(payload, f)
+
+
+_init()

@@ -1,0 +1,39 @@
+"""User-facing output helpers (reference: sky/utils/rich_utils.py +
+ux_utils — spinners, consistent log prefix)."""
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import time
+from typing import Iterator, Optional
+
+_QUIET = os.environ.get('SKYPILOT_TPU_QUIET', '') == '1'
+
+
+def log(message: str) -> None:
+    if not _QUIET:
+        print(f'\x1b[36m»\x1b[0m {message}', file=sys.stderr, flush=True)
+
+
+def error(message: str) -> None:
+    print(f'\x1b[31m✗\x1b[0m {message}', file=sys.stderr, flush=True)
+
+
+@contextlib.contextmanager
+def status(message: str) -> Iterator[None]:
+    """Spinner-ish status (plain lines when not a tty)."""
+    start = time.time()
+    log(f'{message}...')
+    try:
+        yield
+        log(f'{message} done ({time.time() - start:.1f}s).')
+    except BaseException:
+        error(f'{message} failed ({time.time() - start:.1f}s).')
+        raise
+
+
+@contextlib.contextmanager
+def print_exception_no_traceback() -> Iterator[None]:
+    """Raise user errors without the scary traceback (CLI layer)."""
+    yield

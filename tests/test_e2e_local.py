@@ -1,0 +1,114 @@
+"""End-to-end pipeline on the Local cloud (no cloud account).
+
+The analog of the reference's dryrun + kind-cluster strategy
+(SURVEY §4), upgraded: the Local provisioner runs real agents and
+real gang execution, so launch→exec→cancel→autostop→down are
+exercised against live processes.
+"""
+import time
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import core
+from skypilot_tpu.agent import job_lib
+from skypilot_tpu.utils.status_lib import ClusterStatus
+
+
+@pytest.fixture()
+def local_cluster(isolated_state):
+    """A 2-host (emulated tpu-v5e-16) Local cluster named t-e2e."""
+    from skypilot_tpu import check
+    check.check(quiet=True)
+    task = sky.Task(name='boot', run='true')
+    task.set_resources(sky.Resources(infra='local',
+                                     accelerators='tpu-v5e-16'))
+    job_id, handle = sky.launch(task, cluster_name='t-e2e',
+                                _quiet_optimizer=True)
+    assert job_id == 1
+    yield handle
+    try:
+        core.down('t-e2e')
+    except Exception:  # pylint: disable=broad-except
+        pass
+
+
+@pytest.mark.slow
+def test_launch_gang_env(local_cluster):
+    handle = local_cluster
+    assert handle.num_hosts == 2
+
+    task = sky.Task(run='echo "R$SKYPILOT_NODE_RANK/$SKYPILOT_NUM_NODES '
+                        'J$JAX_PROCESS_ID W$TPU_WORKER_ID '
+                        'C=$JAX_COORDINATOR_ADDRESS"')
+    job_id, _ = sky.exec(task, 't-e2e')
+    agent = handle.agent()
+    status = agent.wait_job(job_id, timeout=60)
+    assert status == job_lib.JobStatus.SUCCEEDED
+    logs = ''.join(agent.stream_job_logs(job_id, follow=False))
+    assert 'R0/2' in logs and 'R1/2' in logs
+    assert 'J0 W0' in logs and 'J1 W1' in logs
+    assert 'C=127.0.0.1:8476' in logs
+
+
+@pytest.mark.slow
+def test_gang_failure_cancels_all(local_cluster):
+    handle = local_cluster
+    bad = sky.Task(run='if [ "$SKYPILOT_NODE_RANK" = "1" ]; then exit 3; '
+                       'else sleep 120; fi')
+    job_id, _ = sky.exec(bad, 't-e2e', detach_run=True)
+    status = handle.agent().wait_job(job_id, timeout=60)
+    assert status == job_lib.JobStatus.FAILED
+
+
+@pytest.mark.slow
+def test_queue_cancel_and_status(local_cluster):
+    handle = local_cluster
+    job_id, _ = sky.exec(sky.Task(run='sleep 300'), 't-e2e', detach_run=True)
+    # wait until running
+    agent = handle.agent()
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        job = agent.get_job(job_id)
+        if job['status'] == job_lib.JobStatus.RUNNING:
+            break
+        time.sleep(1)
+    core.cancel('t-e2e', [job_id])
+    status = agent.wait_job(job_id, timeout=30)
+    assert status == job_lib.JobStatus.CANCELLED
+
+    records = core.status(refresh=True)
+    assert records[0]['name'] == 't-e2e'
+    assert records[0]['status'] == ClusterStatus.UP
+
+
+@pytest.mark.slow
+def test_stop_refresh_down(local_cluster):
+    core.stop('t-e2e')
+    records = core.status(refresh=True)
+    assert records[0]['status'] == ClusterStatus.STOPPED
+    core.start('t-e2e')
+    records = core.status(refresh=True)
+    assert records[0]['status'] == ClusterStatus.UP
+    core.down('t-e2e')
+    assert core.status() == []
+    # history recorded
+    hist = core.cost_report()
+    assert hist and hist[0]['name'] == 't-e2e'
+
+
+@pytest.mark.slow
+def test_exec_on_missing_cluster(isolated_state):
+    with pytest.raises(sky.exceptions.ClusterDoesNotExist):
+        sky.exec(sky.Task(run='true'), 'nope')
+
+
+def test_launch_dryrun(isolated_state):
+    from skypilot_tpu import check
+    check.check(quiet=True)
+    task = sky.Task(name='d', run='true')
+    task.set_resources(sky.Resources(infra='local'))
+    job_id, handle = sky.launch(task, cluster_name='t-dry', dryrun=True,
+                                _quiet_optimizer=True)
+    assert job_id is None and handle is None
+    assert core.status() == []
