@@ -1,0 +1,437 @@
+"""CLI: `stpu` — thin wrappers that build Tasks, call the SDK, and
+poll request ids.
+
+Reference: sky/client/cli/command.py (8468 LoC, 105 commands). Core
+command set here; jobs/serve groups register from their modules.
+"""
+from __future__ import annotations
+
+import datetime
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+import click
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.client import sdk
+from skypilot_tpu.utils import common_utils
+
+
+def _err(message: str) -> None:
+    click.secho(f'Error: {message}', fg='red', err=True)
+    sys.exit(1)
+
+
+def _parse_env(env: List[str]) -> Dict[str, str]:
+    out = {}
+    for item in env:
+        if '=' in item:
+            k, v = item.split('=', 1)
+            out[k] = v
+        else:
+            v = os.environ.get(item)
+            if v is None:
+                _err(f'--env {item}: not set in the caller environment')
+            out[item] = v
+    return out
+
+
+def _build_task(entrypoint, name, workdir, infra, gpus, cpus, memory,
+                num_nodes, use_spot, env, cmd=None):
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu import task as task_lib
+    env_overrides = _parse_env(list(env or []))
+    if entrypoint and entrypoint.endswith(('.yaml', '.yml')):
+        config = common_utils.read_yaml(os.path.expanduser(entrypoint))
+        task = task_lib.Task.from_yaml_config(config, env_overrides)
+    else:
+        run_cmd = cmd or entrypoint
+        task = task_lib.Task(run=run_cmd, envs=env_overrides)
+    if name:
+        task.name = name
+    if workdir:
+        task.workdir = workdir
+    if num_nodes:
+        task.num_nodes = num_nodes
+    overrides: Dict[str, Any] = {}
+    if infra:
+        overrides['infra'] = infra
+    if gpus:
+        overrides['accelerators'] = gpus
+    if cpus:
+        overrides['cpus'] = cpus
+    if memory:
+        overrides['memory'] = memory
+    if use_spot is not None:
+        overrides['use_spot'] = use_spot
+    if overrides:
+        task.set_resources({r.copy(**overrides) for r in task.resources})
+    return task
+
+
+@click.group()
+@click.version_option('0.1.0', prog_name='stpu')
+def cli() -> None:
+    """stpu: TPU-native sky orchestrator."""
+
+
+# ---------------------------------------------------------------------------
+# launch / exec
+# ---------------------------------------------------------------------------
+_task_options = [
+    click.option('--name', '-n', default=None, help='Task name.'),
+    click.option('--workdir', default=None,
+                 help='Directory synced to ~/sky_workdir.'),
+    click.option('--infra', default=None,
+                 help='cloud[/region[/zone]], e.g. gcp/us-central2.'),
+    click.option('--gpus', '--tpus', 'gpus', default=None,
+                 help='Accelerator, e.g. tpu-v5p-128 or A100:8.'),
+    click.option('--cpus', default=None),
+    click.option('--memory', default=None),
+    click.option('--num-nodes', type=int, default=None,
+                 help='Number of nodes (TPU: slices).'),
+    click.option('--use-spot/--no-use-spot', default=None),
+    click.option('--env', multiple=True,
+                 help='KEY=VAL or KEY (inherit).'),
+]
+
+
+def _add_options(options):
+
+    def wrap(f):
+        for opt in reversed(options):
+            f = opt(f)
+        return f
+
+    return wrap
+
+
+@cli.command()
+@click.argument('entrypoint', required=False)
+@click.option('--cluster', '-c', default=None, help='Cluster name.')
+@_add_options(_task_options)
+@click.option('--idle-minutes-to-autostop', '-i', type=int, default=None)
+@click.option('--down', is_flag=True, default=False,
+              help='Autodown after the job finishes / on idle.')
+@click.option('--retry-until-up', '-r', is_flag=True, default=False)
+@click.option('--dryrun', is_flag=True, default=False)
+@click.option('--detach-run', '-d', is_flag=True, default=False)
+@click.option('--no-setup', is_flag=True, default=False)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def launch(entrypoint, cluster, name, workdir, infra, gpus, cpus, memory,
+           num_nodes, use_spot, env, idle_minutes_to_autostop, down,
+           retry_until_up, dryrun, detach_run, no_setup, yes) -> None:
+    """Launch a task from YAML or a command (provisions a cluster)."""
+    task = _build_task(entrypoint, name, workdir, infra, gpus, cpus, memory,
+                       num_nodes, use_spot, env)
+    if not yes and not dryrun:
+        r = sorted(str(x) for x in task.resources)
+        click.echo(f'Launching {task.name or "task"} on {cluster or "new "
+                   "cluster"}: {r}')
+        click.confirm('Proceed?', default=True, abort=True)
+    request_id = sdk.launch(
+        task, cluster_name=cluster, dryrun=dryrun,
+        detach_run=True,
+        idle_minutes_to_autostop=idle_minutes_to_autostop, down=down,
+        retry_until_up=retry_until_up, no_setup=no_setup)
+    result = sdk.stream_and_get(request_id)
+    if result and result.get('job_id') is not None and not detach_run:
+        cname = (result.get('handle') or {}).get('cluster_name') or cluster
+        sdk.tail_logs(cname, result['job_id'])
+
+
+@cli.command(name='exec')
+@click.argument('cluster')
+@click.argument('entrypoint')
+@_add_options(_task_options)
+@click.option('--detach-run', '-d', is_flag=True, default=False)
+def exec_cmd(cluster, entrypoint, name, workdir, infra, gpus, cpus, memory,
+             num_nodes, use_spot, env, detach_run) -> None:
+    """Run a task on an existing cluster (no provisioning)."""
+    task = _build_task(entrypoint, name, workdir, infra, gpus, cpus, memory,
+                       num_nodes, use_spot, env)
+    request_id = sdk.exec(task, cluster, detach_run=True)
+    result = sdk.stream_and_get(request_id)
+    if result.get('job_id') is not None and not detach_run:
+        sdk.tail_logs(cluster, result['job_id'])
+
+
+# ---------------------------------------------------------------------------
+# status & lifecycle
+# ---------------------------------------------------------------------------
+@cli.command()
+@click.argument('clusters', nargs=-1)
+@click.option('--refresh', '-r', is_flag=True, default=False)
+def status(clusters, refresh) -> None:
+    """Show clusters."""
+    request_id = sdk.status(list(clusters) or None, refresh=refresh)
+    records = sdk.get(request_id)
+    if not records:
+        click.echo('No existing clusters.')
+        return
+    from rich.console import Console
+    from rich.table import Table
+    table = Table(box=None)
+    for col in ('NAME', 'LAUNCHED', 'RESOURCES', 'STATUS', 'AUTOSTOP'):
+        table.add_column(col)
+    for r in records:
+        launched = datetime.datetime.fromtimestamp(
+            r['launched_at']).strftime('%Y-%m-%d %H:%M')
+        autostop = (f'{r["autostop"]}m'
+                    f'{" (down)" if r["autostop_down"] else ""}'
+                    if r['autostop'] is not None and r['autostop'] >= 0
+                    else '-')
+        table.add_row(r['name'], launched, r['resources_str'] or '-',
+                      r['status'], autostop)
+    Console().print(table)
+
+
+@cli.command()
+@click.argument('cluster')
+def start(cluster) -> None:
+    """Restart a stopped cluster."""
+    sdk.stream_and_get(sdk.start(cluster))
+    click.echo(f'Cluster {cluster} started.')
+
+
+@cli.command()
+@click.argument('clusters', nargs=-1, required=True)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def stop(clusters, yes) -> None:
+    """Stop cluster(s) (keep disks)."""
+    if not yes:
+        click.confirm(f'Stop {", ".join(clusters)}?', abort=True)
+    for c in clusters:
+        sdk.stream_and_get(sdk.stop(c))
+        click.echo(f'Cluster {c} stopped.')
+
+
+@cli.command()
+@click.argument('clusters', nargs=-1, required=True)
+@click.option('--yes', '-y', is_flag=True, default=False)
+@click.option('--purge', is_flag=True, default=False,
+              help='Remove from state even if cloud cleanup fails.')
+def down(clusters, yes, purge) -> None:
+    """Terminate cluster(s)."""
+    if not yes:
+        click.confirm(f'Terminate {", ".join(clusters)}?', abort=True)
+    for c in clusters:
+        sdk.stream_and_get(sdk.down(c, purge=purge))
+        click.echo(f'Cluster {c} terminated.')
+
+
+@cli.command()
+@click.argument('cluster')
+@click.option('--idle-minutes', '-i', type=int, required=True,
+              help='-1 cancels autostop.')
+@click.option('--down', is_flag=True, default=False)
+def autostop(cluster, idle_minutes, down) -> None:
+    """Set autostop/autodown on a cluster."""
+    sdk.get(sdk.autostop(cluster, idle_minutes, down))
+    click.echo(f'Autostop set on {cluster}: {idle_minutes}m '
+               f'({"down" if down else "stop"}).')
+
+
+# ---------------------------------------------------------------------------
+# jobs on clusters
+# ---------------------------------------------------------------------------
+@cli.command()
+@click.argument('cluster')
+@click.option('--all-jobs', '-a', is_flag=True, default=False)
+def queue(cluster, all_jobs) -> None:
+    """Show a cluster's job queue."""
+    jobs = sdk.get(sdk.queue(cluster, all_jobs))
+    from rich.console import Console
+    from rich.table import Table
+    table = Table(box=None)
+    for col in ('ID', 'NAME', 'USER', 'SUBMITTED', 'STATUS'):
+        table.add_column(col)
+    for j in jobs:
+        ts = datetime.datetime.fromtimestamp(
+            j['submitted_at']).strftime('%H:%M:%S')
+        table.add_row(str(j['job_id']), j.get('job_name') or '-',
+                      j.get('username') or '-', ts, j['status'])
+    Console().print(table)
+
+
+@cli.command()
+@click.argument('cluster')
+@click.argument('job_ids', nargs=-1, type=int)
+@click.option('--all', 'all_jobs', is_flag=True, default=False)
+def cancel(cluster, job_ids, all_jobs) -> None:
+    """Cancel job(s) on a cluster."""
+    if not job_ids and not all_jobs:
+        _err('specify job ids or --all')
+    sdk.get(sdk.cancel(cluster, list(job_ids) or None, all_jobs))
+    click.echo('Cancelled.')
+
+
+@cli.command()
+@click.argument('cluster')
+@click.argument('job_id', required=False, type=int)
+@click.option('--no-follow', is_flag=True, default=False)
+@click.option('--tail', type=int, default=0)
+def logs(cluster, job_id, no_follow, tail) -> None:
+    """Tail a job's logs."""
+    try:
+        sdk.tail_logs(cluster, job_id, follow=not no_follow, tail=tail)
+    except exceptions.ClusterDoesNotExist as e:
+        _err(str(e))
+
+
+# ---------------------------------------------------------------------------
+# info
+# ---------------------------------------------------------------------------
+@cli.command()
+def check() -> None:
+    """Probe cloud credentials; cache enabled clouds."""
+    enabled = sdk.get(sdk.check())
+    click.echo(f'Enabled clouds: {", ".join(enabled) or "none"}')
+
+
+@cli.command(name='gpus')
+@click.argument('accelerator', required=False)
+@click.option('--region', default=None)
+def gpus(accelerator, region) -> None:
+    """List TPU/GPU offerings and prices (`stpu gpus tpu-v5p`)."""
+    result = sdk.get(sdk.list_accelerators(accelerator, region))
+    from rich.console import Console
+    from rich.table import Table
+    table = Table(box=None)
+    for col in ('ACCELERATOR', 'REGION', '$/hr', '$/hr (spot)', 'HOSTS',
+                'TOPOLOGY'):
+        table.add_column(col)
+    from skypilot_tpu.utils import tpu_utils
+    for acc in sorted(result):
+        infos = result[acc]
+        regions_seen = set()
+        for info in infos:
+            if info['region'] in regions_seen:
+                continue
+            regions_seen.add(info['region'])
+            hosts = topo = '-'
+            if tpu_utils.is_tpu(acc):
+                spec = tpu_utils.get_slice_spec(acc)
+                hosts, topo = str(spec.num_hosts), spec.topology_str
+            table.add_row(acc, info['region'], f"{info['price']:.2f}",
+                          f"{info['spot_price']:.2f}", hosts, topo)
+    Console().print(table)
+
+
+@cli.command(name='cost-report')
+def cost_report() -> None:
+    """Show cost of terminated clusters."""
+    rows = sdk.get(sdk.cost_report())
+    from rich.console import Console
+    from rich.table import Table
+    table = Table(box=None)
+    for col in ('NAME', 'RESOURCES', 'DURATION', 'COST ($)'):
+        table.add_column(col)
+    for r in rows:
+        mins = (r['duration'] or 0) / 60
+        table.add_row(r['name'], r['resources_str'] or '-',
+                      f'{mins:.0f}m', f"{r['cost'] or 0:.2f}")
+    Console().print(table)
+
+
+# ---------------------------------------------------------------------------
+# storage group
+# ---------------------------------------------------------------------------
+@cli.group()
+def storage() -> None:
+    """Manage storage objects."""
+
+
+@storage.command(name='ls')
+def storage_ls() -> None:
+    names = sdk.get(sdk.storage_ls())
+    for n in names:
+        click.echo(n)
+
+
+@storage.command(name='delete')
+@click.argument('name')
+@click.option('--yes', '-y', is_flag=True, default=False)
+def storage_delete(name, yes) -> None:
+    if not yes:
+        click.confirm(f'Delete storage {name}?', abort=True)
+    sdk.get(sdk.storage_delete(name))
+
+
+# ---------------------------------------------------------------------------
+# api group
+# ---------------------------------------------------------------------------
+@cli.group()
+def api() -> None:
+    """Manage the API server."""
+
+
+@api.command(name='start')
+@click.option('--host', default='127.0.0.1')
+@click.option('--port', type=int, default=None)
+@click.option('--foreground', is_flag=True, default=False)
+def api_start(host, port, foreground) -> None:
+    url = sdk.api_start(host=host, port=port, foreground=foreground)
+    click.echo(f'API server running at {url}')
+
+
+@api.command(name='stop')
+def api_stop() -> None:
+    if sdk.api_stop():
+        click.echo('API server stopped.')
+    else:
+        click.echo('No local API server found.')
+
+
+@api.command(name='info')
+def api_info_cmd() -> None:
+    info = sdk.api_info()
+    if info is None:
+        click.echo(f'API server at {sdk.api_server_url()}: unreachable')
+    else:
+        click.echo(f'API server at {sdk.api_server_url()}: {info}')
+
+
+@api.command(name='status')
+@click.option('--limit', type=int, default=30)
+def api_status(limit) -> None:
+    rows = sdk.api_status(limit)
+    from rich.console import Console
+    from rich.table import Table
+    table = Table(box=None)
+    for col in ('REQUEST', 'NAME', 'USER', 'STATUS'):
+        table.add_column(col)
+    for r in rows:
+        table.add_row(r['request_id'], r['name'], r.get('user') or '-',
+                      r['status'])
+    Console().print(table)
+
+
+@api.command(name='logs')
+@click.argument('request_id')
+def api_logs(request_id) -> None:
+    try:
+        sdk.stream_and_get(request_id)
+    except exceptions.SkyError as e:
+        _err(str(e))
+
+
+@api.command(name='cancel')
+@click.argument('request_id')
+def api_cancel(request_id) -> None:
+    if sdk.api_cancel(request_id):
+        click.echo('Cancelled.')
+    else:
+        click.echo('Request already finished.')
+
+
+def main() -> None:
+    try:
+        cli()
+    except exceptions.SkyError as e:
+        _err(str(e))
+
+
+if __name__ == '__main__':
+    main()

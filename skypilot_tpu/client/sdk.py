@@ -1,0 +1,294 @@
+"""Python SDK: thin HTTP client over the API server.
+
+Reference: sky/client/sdk.py (3405 LoC) — every call POSTs to the
+server and returns a `request_id` future resolved with `get()` /
+`stream_and_get()`. A local API server is auto-started on first use
+(`sky api start` behavior).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import typing
+from typing import Any, Dict, List, Optional
+
+import requests
+
+from skypilot_tpu import constants
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import subprocess_utils
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import task as task_lib
+
+
+def api_server_url() -> str:
+    env = os.environ.get(constants.API_SERVER_URL_ENV_VAR)
+    if env:
+        return env.rstrip('/')
+    from skypilot_tpu import sky_config
+    cfg = sky_config.get_nested(('api_server', 'endpoint'))
+    if cfg:
+        return str(cfg).rstrip('/')
+    return f'http://127.0.0.1:{constants.API_SERVER_PORT}'
+
+
+def _headers() -> Dict[str, str]:
+    return {'X-Skypilot-User': common_utils.get_user_name()}
+
+
+def api_info(server_url: Optional[str] = None) -> Optional[Dict[str, Any]]:
+    url = (server_url or api_server_url()) + '/api/health'
+    try:
+        resp = requests.get(url, timeout=5)
+        resp.raise_for_status()
+        return resp.json()
+    except requests.RequestException:
+        return None
+
+
+def api_start(host: str = '127.0.0.1',
+              port: Optional[int] = None,
+              foreground: bool = False) -> str:
+    """Start a local API server if not already running."""
+    port = port or constants.API_SERVER_PORT
+    url = f'http://{host}:{port}'
+    if api_info(url) is not None:
+        return url
+    if foreground:
+        from skypilot_tpu.server import server
+        server.run(host, port)
+        return url
+    log_path = os.path.join(constants.api_server_dir(), 'server.log')
+    env = dict(os.environ)
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env['PYTHONPATH'] = f'{repo_root}:{env.get("PYTHONPATH", "")}'
+    pid = subprocess_utils.launch_daemon(
+        [sys.executable, '-m', 'skypilot_tpu.server.server',
+         '--host', host, '--port', str(port)],
+        log_path=log_path, env=env)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if api_info(url) is not None:
+            _write_server_pid(pid)
+            return url
+        time.sleep(0.5)
+    raise exceptions.ApiServerConnectionError(url)
+
+
+def _server_pid_path() -> str:
+    return os.path.join(constants.api_server_dir(), 'server.pid')
+
+
+def _write_server_pid(pid: int) -> None:
+    os.makedirs(constants.api_server_dir(), exist_ok=True)
+    with open(_server_pid_path(), 'w', encoding='utf-8') as f:
+        f.write(str(pid))
+
+
+def api_stop() -> bool:
+    try:
+        with open(_server_pid_path(), 'r', encoding='utf-8') as f:
+            pid = int(f.read().strip())
+    except (OSError, ValueError):
+        return False
+    subprocess_utils.kill_process_tree(pid)
+    try:
+        os.remove(_server_pid_path())
+    except OSError:
+        pass
+    return True
+
+
+def _ensure_server() -> str:
+    url = api_server_url()
+    if api_info(url) is None:
+        if url.startswith(('http://127.0.0.1', 'http://localhost')):
+            port = int(url.rsplit(':', 1)[1])
+            return api_start(port=port)
+        raise exceptions.ApiServerConnectionError(url)
+    return url
+
+
+def _post(path: str, payload: Dict[str, Any]) -> str:
+    url = _ensure_server()
+    resp = requests.post(f'{url}{path}', json=payload, headers=_headers(),
+                         timeout=30)
+    resp.raise_for_status()
+    return resp.json()['request_id']
+
+
+# ---------------------------------------------------------------------------
+# Request futures
+# ---------------------------------------------------------------------------
+def get(request_id: str, timeout: Optional[float] = None) -> Any:
+    """Block until the request finishes; return its value or raise."""
+    url = api_server_url()
+    deadline = time.time() + timeout if timeout else None
+    while True:
+        resp = requests.get(f'{url}/api/get',
+                            params={'request_id': request_id, 'timeout': 10},
+                            timeout=40)
+        if resp.status_code == 404:
+            raise exceptions.RequestNotFoundError(request_id)
+        resp.raise_for_status()
+        body = resp.json()
+        status = body['status']
+        if status == 'SUCCEEDED':
+            return body.get('return_value')
+        if status == 'FAILED':
+            raise exceptions.deserialize_exception(body.get('error') or {})
+        if status == 'CANCELLED':
+            raise exceptions.RequestCancelled(request_id)
+        if deadline and time.time() > deadline:
+            raise TimeoutError(f'request {request_id} still {status}')
+
+
+def stream_and_get(request_id: str, output=None) -> Any:
+    """Stream the request's log, then return its value (reference:
+    sdk.stream_and_get)."""
+    url = api_server_url()
+    out = output or sys.stderr
+    try:
+        with requests.get(f'{url}/api/stream',
+                          params={'request_id': request_id, 'follow': '1'},
+                          stream=True, timeout=(30, None)) as resp:
+            resp.raise_for_status()
+            for line in resp.iter_lines(decode_unicode=True):
+                print(line, file=out, flush=True)
+    except KeyboardInterrupt:
+        print(f'\nDetached from request {request_id}; '
+              f'`stpu api logs {request_id}` to re-attach.', file=out)
+        raise
+    return get(request_id)
+
+
+def api_cancel(request_id: str) -> bool:
+    url = api_server_url()
+    resp = requests.post(f'{url}/api/cancel',
+                         json={'request_id': request_id}, timeout=30)
+    resp.raise_for_status()
+    return resp.json().get('cancelled', False)
+
+
+def api_status(limit: int = 100) -> List[Dict[str, Any]]:
+    url = _ensure_server()
+    resp = requests.get(f'{url}/api/status', params={'limit': limit},
+                        timeout=30)
+    resp.raise_for_status()
+    return resp.json()['requests']
+
+
+# ---------------------------------------------------------------------------
+# Verbs (all return request_id)
+# ---------------------------------------------------------------------------
+def launch(task: 'task_lib.Task', cluster_name: Optional[str] = None,
+           *, dryrun: bool = False, detach_run: bool = True,
+           idle_minutes_to_autostop: Optional[int] = None,
+           down: bool = False, retry_until_up: bool = False,
+           no_setup: bool = False,
+           env_overrides: Optional[Dict[str, str]] = None) -> str:
+    return _post('/launch', {
+        'task_config': task.to_yaml_config(),
+        'cluster_name': cluster_name,
+        'dryrun': dryrun,
+        'detach_run': detach_run,
+        'idle_minutes_to_autostop': idle_minutes_to_autostop,
+        'down': down,
+        'retry_until_up': retry_until_up,
+        'no_setup': no_setup,
+        'env_overrides': env_overrides,
+    })
+
+
+def exec(task: 'task_lib.Task', cluster_name: str,  # pylint: disable=redefined-builtin
+         *, dryrun: bool = False, detach_run: bool = True,
+         env_overrides: Optional[Dict[str, str]] = None) -> str:
+    return _post('/exec', {
+        'task_config': task.to_yaml_config(),
+        'cluster_name': cluster_name,
+        'dryrun': dryrun,
+        'detach_run': detach_run,
+        'env_overrides': env_overrides,
+    })
+
+
+def status(cluster_names: Optional[List[str]] = None,
+           refresh: bool = False) -> str:
+    return _post('/status', {'cluster_names': cluster_names,
+                             'refresh': refresh})
+
+
+def start(cluster_name: str) -> str:
+    return _post('/start', {'cluster_name': cluster_name})
+
+
+def stop(cluster_name: str) -> str:
+    return _post('/stop', {'cluster_name': cluster_name})
+
+
+def down(cluster_name: str, purge: bool = False) -> str:
+    return _post('/down', {'cluster_name': cluster_name, 'purge': purge})
+
+
+def autostop(cluster_name: str, idle_minutes: int,
+             down_on_idle: bool = False) -> str:
+    return _post('/autostop', {'cluster_name': cluster_name,
+                               'idle_minutes': idle_minutes,
+                               'down_on_idle': down_on_idle})
+
+
+def queue(cluster_name: str, all_jobs: bool = False) -> str:
+    return _post('/queue', {'cluster_name': cluster_name,
+                            'all_jobs': all_jobs})
+
+
+def cancel(cluster_name: str, job_ids: Optional[List[int]] = None,
+           all_jobs: bool = False) -> str:
+    return _post('/cancel', {'cluster_name': cluster_name,
+                             'job_ids': job_ids, 'all_jobs': all_jobs})
+
+
+def cost_report() -> str:
+    return _post('/cost_report', {})
+
+
+def check() -> str:
+    return _post('/check', {})
+
+
+def list_accelerators(name_filter: Optional[str] = None,
+                      region_filter: Optional[str] = None) -> str:
+    return _post('/accelerators', {'name_filter': name_filter,
+                                   'region_filter': region_filter})
+
+
+def storage_ls() -> str:
+    return _post('/storage/ls', {})
+
+
+def storage_delete(name: str) -> str:
+    return _post('/storage/delete', {'name': name})
+
+
+def tail_logs(cluster_name: str, job_id: Optional[int] = None,
+              follow: bool = True, tail: int = 0, output=None) -> None:
+    """Stream job logs through the server proxy."""
+    url = _ensure_server()
+    out = output or sys.stdout
+    params = {'cluster': cluster_name, 'follow': '1' if follow else '0'}
+    if job_id is not None:
+        params['job_id'] = str(job_id)
+    if tail:
+        params['tail'] = str(tail)
+    with requests.get(f'{url}/logs', params=params, stream=True,
+                      timeout=(30, None)) as resp:
+        if resp.status_code == 404:
+            raise exceptions.ClusterDoesNotExist(cluster_name)
+        resp.raise_for_status()
+        for line in resp.iter_lines(decode_unicode=True):
+            print(line, file=out, flush=True)

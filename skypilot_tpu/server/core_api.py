@@ -1,0 +1,130 @@
+"""Server-side request entrypoints: JSON payload → core functions.
+
+Reference analog: the functions named in
+`executor.schedule_request_async(..., func=execution.launch)` — here
+they take JSON-serializable args (task config dicts) since payloads
+cross the HTTP + process boundary.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import core
+from skypilot_tpu import execution
+from skypilot_tpu import task as task_lib
+
+
+def launch(task_config: Dict[str, Any],
+           cluster_name: Optional[str] = None,
+           dryrun: bool = False,
+           detach_run: bool = True,
+           idle_minutes_to_autostop: Optional[int] = None,
+           down: bool = False,
+           retry_until_up: bool = False,
+           no_setup: bool = False,
+           env_overrides: Optional[Dict[str, str]] = None,
+           secret_overrides: Optional[Dict[str, str]] = None
+           ) -> Dict[str, Any]:
+    task = task_lib.Task.from_yaml_config(task_config, env_overrides,
+                                          secret_overrides)
+    job_id, handle = execution.launch(
+        task, cluster_name=cluster_name, dryrun=dryrun,
+        detach_run=detach_run,
+        idle_minutes_to_autostop=idle_minutes_to_autostop, down=down,
+        retry_until_up=retry_until_up, no_setup=no_setup)
+    return {
+        'job_id': job_id,
+        'cluster_name': cluster_name,
+        'handle': None if handle is None else {
+            'cluster_name': handle.cluster_name,
+            'num_hosts': handle.num_hosts,
+            'head_agent_addr': handle.head_agent_addr,
+            'resources': str(handle.launched_resources),
+        },
+    }
+
+
+def exec(task_config: Dict[str, Any],  # pylint: disable=redefined-builtin
+         cluster_name: str,
+         dryrun: bool = False,
+         detach_run: bool = True,
+         env_overrides: Optional[Dict[str, str]] = None
+         ) -> Dict[str, Any]:
+    task = task_lib.Task.from_yaml_config(task_config, env_overrides)
+    job_id, _ = execution.exec(task, cluster_name, dryrun=dryrun,
+                               detach_run=detach_run)
+    return {'job_id': job_id, 'cluster_name': cluster_name}
+
+
+def status(cluster_names: Optional[List[str]] = None,
+           refresh: bool = False) -> List[Dict[str, Any]]:
+    records = core.status(cluster_names, refresh=refresh)
+    out = []
+    for r in records:
+        handle = r['handle']
+        out.append({
+            'name': r['name'],
+            'status': r['status'].value,
+            'launched_at': r['launched_at'],
+            'resources_str': r['resources_str'],
+            'autostop': r['autostop_minutes'],
+            'autostop_down': bool(r['autostop_down']),
+            'user': r.get('owner'),
+            'num_hosts': getattr(handle, 'num_hosts', None),
+            'head_agent_addr': getattr(handle, 'head_agent_addr', None),
+        })
+    return out
+
+
+def start(cluster_name: str) -> None:
+    core.start(cluster_name)
+
+
+def stop(cluster_name: str) -> None:
+    core.stop(cluster_name)
+
+
+def down(cluster_name: str, purge: bool = False) -> None:
+    core.down(cluster_name, purge=purge)
+
+
+def autostop(cluster_name: str, idle_minutes: int,
+             down_on_idle: bool = False) -> None:
+    core.autostop(cluster_name, idle_minutes, down_on_idle)
+
+
+def queue(cluster_name: str, all_jobs: bool = False) -> List[Dict[str, Any]]:
+    return core.queue(cluster_name, all_jobs)
+
+
+def cancel(cluster_name: str, job_ids: Optional[List[int]] = None,
+           all_jobs: bool = False) -> None:
+    core.cancel(cluster_name, job_ids, all_jobs)
+
+
+def cost_report() -> List[Dict[str, Any]]:
+    return core.cost_report()
+
+
+def storage_ls() -> List[str]:
+    return core.storage_ls()
+
+
+def storage_delete(name: str) -> None:
+    core.storage_delete(name)
+
+
+def check() -> List[str]:
+    from skypilot_tpu import check as check_lib
+    return check_lib.check(quiet=True)
+
+
+def list_accelerators(name_filter: Optional[str] = None,
+                      region_filter: Optional[str] = None
+                      ) -> Dict[str, List[Dict[str, Any]]]:
+    from skypilot_tpu.catalog import gcp_catalog
+    out = gcp_catalog.list_accelerators(name_filter, region_filter)
+    result: Dict[str, List[Dict[str, Any]]] = {}
+    for acc, infos in out.items():
+        result[acc] = [i._asdict() for i in infos]
+    return result

@@ -1,0 +1,278 @@
+"""Request DB + process executor for the API server.
+
+Reference: sky/server/requests/executor.py (1208 LoC) — requests
+persisted in a DB, LONG/SHORT queues, a process pool of disposable
+workers, per-request log files, env/config isolation, kill-on-cancel.
+
+This build: every request is one forked process (cancellation = kill
+process group; memory returned to the OS when it exits — the
+reference's BurstableExecutor "disposable worker" behavior), with a
+semaphore per queue bounding concurrency.
+"""
+from __future__ import annotations
+
+import enum
+import functools
+import importlib
+import json
+import multiprocessing
+import os
+import pickle
+import signal
+import threading
+import time
+import traceback
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+from skypilot_tpu import constants
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import db_utils
+from skypilot_tpu.utils import subprocess_utils
+
+_CREATE_SQL = """\
+CREATE TABLE IF NOT EXISTS requests (
+    request_id TEXT PRIMARY KEY,
+    name TEXT,
+    entrypoint TEXT,
+    payload TEXT,
+    status TEXT,
+    created_at REAL,
+    started_at REAL,
+    finished_at REAL,
+    pid INTEGER DEFAULT -1,
+    return_value BLOB,
+    error TEXT,
+    log_path TEXT,
+    user TEXT,
+    schedule_type TEXT
+);
+"""
+
+# queue name -> max concurrent request processes
+_CONCURRENCY = {'long': 4, 'short': 16}
+
+
+class RequestStatus(enum.Enum):
+    PENDING = 'PENDING'
+    RUNNING = 'RUNNING'
+    SUCCEEDED = 'SUCCEEDED'
+    FAILED = 'FAILED'
+    CANCELLED = 'CANCELLED'
+
+    def is_terminal(self) -> bool:
+        return self in (RequestStatus.SUCCEEDED, RequestStatus.FAILED,
+                        RequestStatus.CANCELLED)
+
+
+@functools.lru_cache(maxsize=None)
+def _db_for(path: str) -> db_utils.SQLiteDB:
+    return db_utils.SQLiteDB(path, _CREATE_SQL)
+
+
+def _db() -> db_utils.SQLiteDB:
+    return _db_for(os.path.join(constants.api_server_dir(), 'requests.db'))
+
+
+def _log_path(request_id: str) -> str:
+    d = os.path.join(constants.api_server_dir(), 'requests')
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, f'{request_id}.log')
+
+
+# ---------------------------------------------------------------------------
+# Submission
+# ---------------------------------------------------------------------------
+def schedule_request(name: str, entrypoint: str, payload: Dict[str, Any],
+                     schedule_type: str = 'long',
+                     user: str = 'unknown') -> str:
+    """Persist a request; the scheduler thread picks it up."""
+    request_id = uuid.uuid4().hex[:16]
+    _db().execute(
+        'INSERT INTO requests (request_id, name, entrypoint, payload, '
+        'status, created_at, log_path, user, schedule_type) '
+        'VALUES (?,?,?,?,?,?,?,?,?)',
+        (request_id, name, entrypoint, json.dumps(payload),
+         RequestStatus.PENDING.value, time.time(), _log_path(request_id),
+         user, schedule_type))
+    return request_id
+
+
+def get_request(request_id: str) -> Optional[Dict[str, Any]]:
+    row = _db().query_one('SELECT * FROM requests WHERE request_id=?',
+                          (request_id,))
+    if row is None:
+        return None
+    out = dict(row)
+    out['status'] = RequestStatus(out['status'])
+    out['payload'] = json.loads(out['payload']) if out['payload'] else {}
+    if out.get('return_value') is not None:
+        out['return_value'] = pickle.loads(out['return_value'])
+    if out.get('error'):
+        out['error'] = json.loads(out['error'])
+    return out
+
+
+def list_requests(limit: int = 100) -> List[Dict[str, Any]]:
+    rows = _db().query(
+        'SELECT request_id, name, status, created_at, finished_at, user '
+        'FROM requests ORDER BY created_at DESC LIMIT ?', (limit,))
+    return rows
+
+
+def cancel_request(request_id: str) -> bool:
+    row = _db().query_one('SELECT pid, status FROM requests '
+                          'WHERE request_id=?', (request_id,))
+    if row is None:
+        raise exceptions.RequestNotFoundError(request_id)
+    status = RequestStatus(row['status'])
+    if status.is_terminal():
+        return False
+    _set_status(request_id, RequestStatus.CANCELLED)
+    if row['pid'] and row['pid'] > 0:
+        subprocess_utils.kill_process_tree(row['pid'])
+    return True
+
+
+def _set_status(request_id: str, status: RequestStatus,
+                **extra: Any) -> None:
+    sets = ['status=?']
+    params: List[Any] = [status.value]
+    for k, v in extra.items():
+        sets.append(f'{k}=?')
+        params.append(v)
+    if status == RequestStatus.RUNNING:
+        sets.append('started_at=?')
+        params.append(time.time())
+    if status.is_terminal():
+        sets.append('finished_at=?')
+        params.append(time.time())
+    params.append(request_id)
+    _db().execute(f'UPDATE requests SET {", ".join(sets)} '
+                  'WHERE request_id=?', tuple(params))
+
+
+# ---------------------------------------------------------------------------
+# Execution (worker process)
+# ---------------------------------------------------------------------------
+def _resolve_entrypoint(entrypoint: str) -> Callable:
+    module_name, fn_name = entrypoint.rsplit('.', 1)
+    module = importlib.import_module(module_name)
+    return getattr(module, fn_name)
+
+
+def _request_worker_main(request_id: str, entrypoint: str,
+                         payload_json: str, log_path: str,
+                         db_path: str) -> None:
+    """Runs in the forked worker process (reference:
+    _request_execution_wrapper, executor.py:670)."""
+    os.setpgrp()  # own process group: cancel kills the whole tree
+    db = _db_for(db_path)
+    import sys
+    log_file = open(log_path, 'ab', buffering=0)
+    os.dup2(log_file.fileno(), sys.stdout.fileno())
+    os.dup2(log_file.fileno(), sys.stderr.fileno())
+    try:
+        fn = _resolve_entrypoint(entrypoint)
+        payload = json.loads(payload_json)
+        result = fn(**payload)
+        db.execute(
+            'UPDATE requests SET status=?, return_value=?, finished_at=? '
+            'WHERE request_id=?',
+            (RequestStatus.SUCCEEDED.value, pickle.dumps(result),
+             time.time(), request_id))
+    except BaseException as e:  # pylint: disable=broad-except
+        traceback.print_exc()
+        db.execute(
+            'UPDATE requests SET status=?, error=?, finished_at=? '
+            'WHERE request_id=?',
+            (RequestStatus.FAILED.value,
+             json.dumps(exceptions.serialize_exception(e)), time.time(),
+             request_id))
+
+
+class RequestWorkerLoop:
+    """Scheduler thread: spawns worker processes for pending requests."""
+
+    def __init__(self) -> None:
+        self._running: Dict[str, multiprocessing.Process] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        # Recover orphaned requests from a previous server run.
+        for row in _db().query(
+                'SELECT request_id, pid, status FROM requests WHERE '
+                'status IN (?, ?)', (RequestStatus.RUNNING.value,
+                                     RequestStatus.PENDING.value)):
+            if RequestStatus(row['status']) == RequestStatus.RUNNING and \
+                    not subprocess_utils.process_alive(row['pid']):
+                _set_status(row['request_id'], RequestStatus.FAILED,
+                            error=json.dumps({
+                                'type': 'ApiRequestError',
+                                'message': 'server restarted mid-request',
+                            }))
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._step()
+            except Exception:  # pylint: disable=broad-except
+                traceback.print_exc()
+            time.sleep(0.2)
+
+    def _step(self) -> None:
+        # Reap finished processes.
+        for rid, proc in list(self._running.items()):
+            if not proc.is_alive():
+                proc.join()
+                row = _db().query_one(
+                    'SELECT status FROM requests WHERE request_id=?', (rid,))
+                if row and not RequestStatus(row['status']).is_terminal():
+                    # Worker died without recording a result.
+                    _set_status(rid, RequestStatus.FAILED, error=json.dumps({
+                        'type': 'ApiRequestError',
+                        'message': f'worker exited rc={proc.exitcode} '
+                                   'without result',
+                    }))
+                del self._running[rid]
+
+        # Count running per queue.
+        counts: Dict[str, int] = {'long': 0, 'short': 0}
+        rows = _db().query(
+            'SELECT request_id, schedule_type FROM requests WHERE status=?',
+            (RequestStatus.RUNNING.value,))
+        for r in rows:
+            counts[r['schedule_type'] or 'long'] = counts.get(
+                r['schedule_type'] or 'long', 0) + 1
+
+        pending = _db().query(
+            'SELECT * FROM requests WHERE status=? ORDER BY created_at',
+            (RequestStatus.PENDING.value,))
+        for req in pending:
+            queue = req['schedule_type'] or 'long'
+            if counts.get(queue, 0) >= _CONCURRENCY.get(queue, 4):
+                continue
+            self._spawn(req)
+            counts[queue] = counts.get(queue, 0) + 1
+
+    def _spawn(self, req: Dict[str, Any]) -> None:
+        ctx = multiprocessing.get_context('fork')
+        # daemon=True: workers die with the server (in-flight requests
+        # are marked FAILED on restart by start()'s recovery scan);
+        # workers only spawn subprocess.Popen children, which daemonic
+        # processes are allowed to do.
+        proc = ctx.Process(
+            target=_request_worker_main,
+            args=(req['request_id'], req['entrypoint'], req['payload'],
+                  req['log_path'],
+                  os.path.join(constants.api_server_dir(), 'requests.db')),
+            daemon=True)
+        proc.start()
+        _set_status(req['request_id'], RequestStatus.RUNNING, pid=proc.pid)
+        self._running[req['request_id']] = proc

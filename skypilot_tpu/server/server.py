@@ -1,0 +1,246 @@
+"""API server: aiohttp app fronting the request executor.
+
+Reference: sky/server/server.py (3607 LoC, FastAPI, 62 routes). Every
+mutating endpoint schedules an async request and returns
+`request_id`; `/api/get` resolves it, `/api/stream` tails its log
+(the reference contract at sky/server/server.py:1771-1786).
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import pickle
+from typing import Any, Dict, Optional
+
+from aiohttp import web
+
+from skypilot_tpu import constants
+from skypilot_tpu import exceptions
+from skypilot_tpu.agent import log_lib
+from skypilot_tpu.server.requests import executor
+
+API_VERSION = 1
+
+routes = web.RouteTableDef()
+
+
+def _user(request: web.Request) -> str:
+    return request.headers.get('X-Skypilot-User', 'unknown')
+
+
+async def _schedule(request: web.Request, name: str, entrypoint: str,
+                    schedule_type: str = 'long') -> web.Response:
+    payload = await request.json() if request.can_read_body else {}
+    request_id = executor.schedule_request(
+        name, entrypoint, payload, schedule_type=schedule_type,
+        user=_user(request))
+    return web.json_response({'request_id': request_id})
+
+
+def _mutating(name: str, entrypoint: str, schedule_type: str = 'long'):
+
+    async def handler(request: web.Request) -> web.Response:
+        return await _schedule(request, name, entrypoint, schedule_type)
+
+    return handler
+
+
+# -- async request endpoints (reference: /launch, /exec, ...) ----------------
+_API = 'skypilot_tpu.server.core_api'
+_ENDPOINTS = {
+    '/launch': ('launch', f'{_API}.launch', 'long'),
+    '/exec': ('exec', f'{_API}.exec', 'long'),
+    '/start': ('start', f'{_API}.start', 'long'),
+    '/stop': ('stop', f'{_API}.stop', 'long'),
+    '/down': ('down', f'{_API}.down', 'long'),
+    '/autostop': ('autostop', f'{_API}.autostop', 'short'),
+    '/status': ('status', f'{_API}.status', 'short'),
+    '/queue': ('queue', f'{_API}.queue', 'short'),
+    '/cancel': ('cancel', f'{_API}.cancel', 'short'),
+    '/cost_report': ('cost_report', f'{_API}.cost_report', 'short'),
+    '/storage/ls': ('storage_ls', f'{_API}.storage_ls', 'short'),
+    '/storage/delete': ('storage_delete', f'{_API}.storage_delete', 'long'),
+    '/check': ('check', f'{_API}.check', 'short'),
+    '/accelerators': ('list_accelerators', f'{_API}.list_accelerators',
+                      'short'),
+    # managed jobs + serve are registered by their own modules below
+}
+
+
+# -- request lifecycle --------------------------------------------------------
+async def api_get(request: web.Request) -> web.Response:
+    request_id = request.query.get('request_id', '')
+    timeout = float(request.query.get('timeout', 0) or 0)
+    deadline = asyncio.get_event_loop().time() + timeout if timeout else None
+    while True:
+        record = executor.get_request(request_id)
+        if record is None:
+            return web.json_response({'error': 'request not found'},
+                                     status=404)
+        if record['status'].is_terminal():
+            break
+        if deadline and asyncio.get_event_loop().time() > deadline:
+            break
+        await asyncio.sleep(0.3)
+    body: Dict[str, Any] = {
+        'request_id': request_id,
+        'name': record['name'],
+        'status': record['status'].value,
+    }
+    if record['status'] == executor.RequestStatus.SUCCEEDED:
+        # Pickle-over-JSON for rich return values (handles are not
+        # shipped to clients; core_api returns plain data).
+        body['return_value'] = record['return_value']
+    elif record['status'] == executor.RequestStatus.FAILED:
+        body['error'] = record['error']
+    return web.json_response(body)
+
+
+async def api_stream(request: web.Request) -> web.StreamResponse:
+    request_id = request.query.get('request_id', '')
+    follow = request.query.get('follow', '1') == '1'
+    record = executor.get_request(request_id)
+    if record is None:
+        return web.json_response({'error': 'request not found'}, status=404)
+    resp = web.StreamResponse()
+    resp.content_type = 'text/plain'
+    await resp.prepare(request)
+
+    def finished() -> bool:
+        rec = executor.get_request(request_id)
+        return rec is None or rec['status'].is_terminal()
+
+    loop = asyncio.get_event_loop()
+    queue: asyncio.Queue = asyncio.Queue(maxsize=1000)
+
+    def pump() -> None:
+        try:
+            for line in log_lib.tail_logs(record['log_path'], follow=follow,
+                                          stop_condition=finished):
+                asyncio.run_coroutine_threadsafe(queue.put(line),
+                                                 loop).result()
+        finally:
+            asyncio.run_coroutine_threadsafe(queue.put(None), loop).result()
+
+    import threading
+    threading.Thread(target=pump, daemon=True).start()
+    while True:
+        line = await queue.get()
+        if line is None:
+            break
+        await resp.write(line.encode('utf-8', errors='replace'))
+    await resp.write_eof()
+    return resp
+
+
+async def api_cancel(request: web.Request) -> web.Response:
+    body = await request.json()
+    request_id = body.get('request_id', '')
+    try:
+        cancelled = executor.cancel_request(request_id)
+    except exceptions.RequestNotFoundError:
+        return web.json_response({'error': 'request not found'}, status=404)
+    return web.json_response({'cancelled': cancelled})
+
+
+async def api_status(request: web.Request) -> web.Response:
+    limit = int(request.query.get('limit', 100))
+    return web.json_response({'requests': executor.list_requests(limit)})
+
+
+async def api_health(request: web.Request) -> web.Response:
+    return web.json_response({
+        'status': 'healthy',
+        'api_version': API_VERSION,
+        'commit': os.environ.get('SKYPILOT_COMMIT', 'dev'),
+    })
+
+
+async def cluster_job_logs(request: web.Request) -> web.StreamResponse:
+    """Proxy job logs from a cluster's head agent (keeps clients thin)."""
+    from skypilot_tpu import global_state
+    cluster = request.query.get('cluster', '')
+    job_id = request.query.get('job_id')
+    follow = request.query.get('follow', '1') == '1'
+    tail = int(request.query.get('tail', 0))
+    record = global_state.get_cluster(cluster)
+    if record is None:
+        return web.json_response({'error': f'no cluster {cluster}'},
+                                 status=404)
+    handle = record['handle']
+    agent = handle.agent()
+    if job_id is None:
+        jobs = agent.get_jobs(limit=1)
+        if not jobs:
+            return web.json_response({'error': 'no jobs'}, status=404)
+        job_id = jobs[0]['job_id']
+    resp = web.StreamResponse()
+    resp.content_type = 'text/plain'
+    await resp.prepare(request)
+    loop = asyncio.get_event_loop()
+    queue: asyncio.Queue = asyncio.Queue(maxsize=1000)
+
+    def pump() -> None:
+        try:
+            for line in agent.stream_job_logs(int(job_id), follow=follow,
+                                              tail=tail):
+                asyncio.run_coroutine_threadsafe(queue.put(line),
+                                                 loop).result()
+        except Exception as e:  # pylint: disable=broad-except
+            asyncio.run_coroutine_threadsafe(
+                queue.put(f'[server] log stream error: {e}\n'), loop).result()
+        finally:
+            asyncio.run_coroutine_threadsafe(queue.put(None), loop).result()
+
+    import threading
+    threading.Thread(target=pump, daemon=True).start()
+    while True:
+        line = await queue.get()
+        if line is None:
+            break
+        await resp.write(line.encode('utf-8', errors='replace'))
+    await resp.write_eof()
+    return resp
+
+
+def create_app() -> web.Application:
+    app = web.Application()
+    for path, (name, entrypoint, schedule_type) in _ENDPOINTS.items():
+        app.router.add_post(path, _mutating(name, entrypoint, schedule_type))
+    app.router.add_get('/api/get', api_get)
+    app.router.add_get('/api/stream', api_stream)
+    app.router.add_post('/api/cancel', api_cancel)
+    app.router.add_get('/api/status', api_status)
+    app.router.add_get('/api/health', api_health)
+    app.router.add_get('/logs', cluster_job_logs)
+    # Managed jobs + serve route groups:
+    try:
+        from skypilot_tpu.jobs import server as jobs_server
+        jobs_server.register(app)
+    except ImportError:
+        pass
+    try:
+        from skypilot_tpu.serve import server as serve_server
+        serve_server.register(app)
+    except ImportError:
+        pass
+    return app
+
+
+def run(host: str = '127.0.0.1',
+        port: int = constants.API_SERVER_PORT) -> None:
+    worker_loop = executor.RequestWorkerLoop()
+    worker_loop.start()
+    app = create_app()
+    web.run_app(app, host=host, port=port, print=None)
+
+
+if __name__ == '__main__':
+    import argparse
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--host', default='127.0.0.1')
+    parser.add_argument('--port', type=int,
+                        default=constants.API_SERVER_PORT)
+    args = parser.parse_args()
+    run(args.host, args.port)
