@@ -116,6 +116,7 @@ class RetryingProvisioner:
         self, task: 'task_lib.Task',
         to_provision: 'resources_lib.Resources',
         cluster_name: str, cluster_name_on_cloud: str,
+        blocked_resources: Optional[Set['resources_lib.Resources']] = None,
     ) -> Tuple[provision_common.ProvisionRecord,
                'resources_lib.Resources', cloud_lib.Region]:
         cloud = to_provision.cloud
@@ -123,6 +124,8 @@ class RetryingProvisioner:
         regions = cloud.regions_with_offering(
             to_provision.instance_type, to_provision.accelerators,
             to_provision.use_spot, to_provision.region, to_provision.zone)
+        regions = [r for r in regions
+                   if not self._region_blocked(cloud, r, blocked_resources)]
         if not regions:
             raise exceptions.ResourcesUnavailableError(
                 f'No region of {cloud} offers {to_provision}.',
@@ -166,6 +169,18 @@ class RetryingProvisioner:
             f'locations of {cloud}.',
             failover_history=self.failover_history)
 
+    @staticmethod
+    def _region_blocked(cloud, region: cloud_lib.Region,
+                        blocked_resources) -> bool:
+        """A blocked resource with a region pins out that whole region
+        (the EAGER_NEXT_REGION contract)."""
+        for b in blocked_resources or ():
+            if b.cloud is not None and not b.cloud.is_same_cloud(cloud):
+                continue
+            if b.region is not None and b.region == region.name:
+                return True
+        return False
+
     def _provision_once(self, task: 'task_lib.Task',
                         to_provision: 'resources_lib.Resources',
                         cluster_name_on_cloud: str,
@@ -205,7 +220,9 @@ class TpuVmBackend(backend_lib.Backend[TpuVmResourceHandle]):
     def provision(self, task: 'task_lib.Task',
                   to_provision: Optional['resources_lib.Resources'],
                   dryrun: bool, stream_logs: bool, cluster_name: str,
-                  retry_until_up: bool = False
+                  retry_until_up: bool = False,
+                  blocked_resources: Optional[
+                      Set['resources_lib.Resources']] = None
                   ) -> Optional[TpuVmResourceHandle]:
         del stream_logs
         assert to_provision is not None, 'optimizer must fill best_resources'
@@ -228,7 +245,8 @@ class TpuVmBackend(backend_lib.Backend[TpuVmResourceHandle]):
                 record, resolved, region = \
                     provisioner.provision_with_retries(
                         task, to_provision, cluster_name,
-                        cluster_name_on_cloud)
+                        cluster_name_on_cloud,
+                        blocked_resources=blocked_resources)
                 break
             except exceptions.ResourcesUnavailableError:
                 if not retry_until_up:

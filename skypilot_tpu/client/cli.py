@@ -435,3 +435,156 @@ def main() -> None:
 
 if __name__ == '__main__':
     main()
+
+
+# ---------------------------------------------------------------------------
+# jobs group (managed jobs)
+# ---------------------------------------------------------------------------
+@cli.group()
+def jobs() -> None:
+    """Managed jobs: auto-recovering jobs on (preemptible) clusters."""
+
+
+@jobs.command(name='launch')
+@click.argument('entrypoint', required=False)
+@_add_options(_task_options)
+@click.option('--detach-run', '-d', is_flag=True, default=False)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def jobs_launch_cmd(entrypoint, name, workdir, infra, gpus, cpus, memory,
+                    num_nodes, use_spot, env, detach_run, yes) -> None:
+    """Launch a managed job (survives preemption via auto-recovery)."""
+    task = _build_task(entrypoint, name, workdir, infra, gpus, cpus, memory,
+                       num_nodes, use_spot, env)
+    if not yes:
+        click.confirm(f'Launch managed job {task.name or "task"}?',
+                      default=True, abort=True)
+    result = sdk.get(sdk.jobs_launch(task, name=task.name))
+    job_id = result['job_id']
+    click.echo(f'Managed job {job_id} submitted.')
+    if not detach_run:
+        sdk.jobs_logs(job_id)
+
+
+@jobs.command(name='queue')
+@click.option('--refresh', '-r', is_flag=True, default=False)
+@click.option('--skip-finished', '-s', is_flag=True, default=False)
+def jobs_queue_cmd(refresh, skip_finished) -> None:
+    """Show managed jobs."""
+    rows = sdk.get(sdk.jobs_queue(refresh, skip_finished))
+    from rich.console import Console
+    from rich.table import Table
+    table = Table(box=None)
+    for col in ('ID', 'NAME', 'CLUSTER', 'STATUS', 'RECOVERIES', 'ERROR'):
+        table.add_column(col)
+    for j in rows:
+        table.add_row(str(j['job_id']), j.get('name') or '-',
+                      j.get('cluster_name') or '-', j['status'],
+                      str(j['recovery_count']),
+                      (j.get('last_error') or '')[:40])
+    Console().print(table)
+
+
+@jobs.command(name='cancel')
+@click.argument('job_ids', nargs=-1, type=int)
+@click.option('--all', 'all_jobs', is_flag=True, default=False)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def jobs_cancel_cmd(job_ids, all_jobs, yes) -> None:
+    """Cancel managed job(s)."""
+    if not job_ids and not all_jobs:
+        _err('specify job ids or --all')
+    if not yes:
+        click.confirm('Cancel?', abort=True)
+    cancelled = sdk.get(sdk.jobs_cancel(list(job_ids) or None, all_jobs))
+    click.echo(f'Cancelled: {cancelled}')
+
+
+@jobs.command(name='logs')
+@click.argument('job_id', type=int)
+@click.option('--no-follow', is_flag=True, default=False)
+def jobs_logs_cmd(job_id, no_follow) -> None:
+    """Stream a managed job's controller log."""
+    sdk.jobs_logs(job_id, follow=not no_follow)
+
+
+# ---------------------------------------------------------------------------
+# serve group
+# ---------------------------------------------------------------------------
+@cli.group()
+def serve() -> None:
+    """Serving: replicated services with load balancing + autoscaling."""
+
+
+@serve.command(name='up')
+@click.argument('entrypoint')
+@click.option('--service-name', '-n', default=None)
+@_add_options(_task_options)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def serve_up_cmd(entrypoint, service_name, name, workdir, infra, gpus, cpus,
+                 memory, num_nodes, use_spot, env, yes) -> None:
+    """Bring up a service from a task YAML with a service: section."""
+    task = _build_task(entrypoint, name, workdir, infra, gpus, cpus, memory,
+                       num_nodes, use_spot, env)
+    service_name = service_name or task.name or 'service'
+    if not yes:
+        click.confirm(f'Bring up service {service_name}?', default=True,
+                      abort=True)
+    result = sdk.get(sdk.serve_up(task, service_name))
+    click.echo(f'Service {service_name} starting; endpoint: '
+               f'{result["endpoint"]}')
+
+
+@serve.command(name='status')
+@click.argument('services', nargs=-1)
+def serve_status_cmd(services) -> None:
+    """Show services and their replicas."""
+    rows = sdk.get(sdk.serve_status(list(services) or None))
+    from rich.console import Console
+    from rich.table import Table
+    table = Table(box=None)
+    for col in ('NAME', 'VERSION', 'STATUS', 'ENDPOINT', 'REPLICAS'):
+        table.add_column(col)
+    for s in rows:
+        ready = sum(1 for r in s['replicas'] if r['status'] == 'READY')
+        table.add_row(s['name'], str(s['version']), s['status'],
+                      s['endpoint'] or '-',
+                      f"{ready}/{len(s['replicas'])}")
+    Console().print(table)
+    for s in rows:
+        if s['replicas']:
+            rep_table = Table(box=None, title=f"{s['name']} replicas")
+            for col in ('ID', 'STATUS', 'ENDPOINT', 'CLUSTER'):
+                rep_table.add_column(col)
+            for r in s['replicas']:
+                rep_table.add_row(str(r['replica_id']), r['status'],
+                                  r.get('endpoint') or '-',
+                                  r['cluster_name'])
+            Console().print(rep_table)
+
+
+@serve.command(name='update')
+@click.argument('service_name')
+@click.argument('entrypoint')
+@_add_options(_task_options)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def serve_update_cmd(service_name, entrypoint, name, workdir, infra, gpus,
+                     cpus, memory, num_nodes, use_spot, env, yes) -> None:
+    """Update a service to a new task version."""
+    task = _build_task(entrypoint, name, workdir, infra, gpus, cpus, memory,
+                       num_nodes, use_spot, env)
+    if not yes:
+        click.confirm(f'Update service {service_name}?', abort=True)
+    result = sdk.get(sdk.serve_update(task, service_name))
+    click.echo(f'Service {service_name} updated to v{result["version"]}.')
+
+
+@serve.command(name='down')
+@click.argument('service_names', nargs=-1, required=True)
+@click.option('--yes', '-y', is_flag=True, default=False)
+@click.option('--purge', is_flag=True, default=False)
+def serve_down_cmd(service_names, yes, purge) -> None:
+    """Tear down service(s)."""
+    if not yes:
+        click.confirm(f'Tear down {", ".join(service_names)}?', abort=True)
+    for s in service_names:
+        sdk.get(sdk.serve_down(s, purge=purge))
+        click.echo(f'Service {s} torn down.')

@@ -292,3 +292,65 @@ def tail_logs(cluster_name: str, job_id: Optional[int] = None,
         resp.raise_for_status()
         for line in resp.iter_lines(decode_unicode=True):
             print(line, file=out, flush=True)
+
+
+# ---------------------------------------------------------------------------
+# Managed jobs
+# ---------------------------------------------------------------------------
+def jobs_launch(task: 'task_lib.Task', name: Optional[str] = None) -> str:
+    return _post('/jobs/launch', {
+        'task_config': task.to_yaml_config(),
+        'name': name,
+        'user': common_utils.get_user_name(),
+    })
+
+
+def jobs_queue(refresh: bool = False, skip_finished: bool = False) -> str:
+    return _post('/jobs/queue', {'refresh': refresh,
+                                 'skip_finished': skip_finished})
+
+
+def jobs_cancel(job_ids: Optional[List[int]] = None,
+                all_jobs: bool = False) -> str:
+    return _post('/jobs/cancel', {'job_ids': job_ids, 'all_jobs': all_jobs})
+
+
+def jobs_logs(job_id: int, follow: bool = True, output=None) -> None:
+    url = _ensure_server()
+    out = output or sys.stdout
+    with requests.get(f'{url}/jobs/logs',
+                      params={'job_id': str(job_id),
+                              'follow': '1' if follow else '0'},
+                      stream=True, timeout=(30, None)) as resp:
+        if resp.status_code == 404:
+            raise exceptions.JobNotFoundError(f'managed job {job_id}')
+        resp.raise_for_status()
+        for line in resp.iter_lines(decode_unicode=True):
+            print(line, file=out, flush=True)
+
+
+# ---------------------------------------------------------------------------
+# Serve
+# ---------------------------------------------------------------------------
+def serve_up(task: 'task_lib.Task', service_name: str) -> str:
+    return _post('/serve/up', {
+        'task_config': task.to_yaml_config(),
+        'service_name': service_name,
+        'user': common_utils.get_user_name(),
+    })
+
+
+def serve_update(task: 'task_lib.Task', service_name: str) -> str:
+    return _post('/serve/update', {
+        'task_config': task.to_yaml_config(),
+        'service_name': service_name,
+    })
+
+
+def serve_status(service_names: Optional[List[str]] = None) -> str:
+    return _post('/serve/status', {'service_names': service_names})
+
+
+def serve_down(service_name: str, purge: bool = False) -> str:
+    return _post('/serve/down', {'service_name': service_name,
+                                 'purge': purge})

@@ -55,6 +55,7 @@ def launch(
     no_setup: bool = False,
     _quiet_optimizer: bool = False,
     _is_launched_by_jobs_controller: bool = False,
+    _blocked_resources: Optional[set] = None,
 ) -> Tuple[Optional[int], Optional[tpu_backend.TpuVmResourceHandle]]:
     """Provision (if needed) + run a task. Returns (job_id, handle).
 
@@ -115,7 +116,8 @@ def launch(
             handle = backend.provision(task, to_provision, dryrun=dryrun,
                                        stream_logs=stream_logs,
                                        cluster_name=cluster_name,
-                                       retry_until_up=retry_until_up)
+                                       retry_until_up=retry_until_up,
+                                       blocked_resources=_blocked_resources)
             if dryrun:
                 return None, None
             assert handle is not None

@@ -1,0 +1,1 @@
+"""Managed jobs: preemption-recovering job layer (reference: sky/jobs/)."""

@@ -1,0 +1,189 @@
+"""Managed-job controller: one process per job; launches, monitors,
+recovers.
+
+Reference: sky/jobs/controller.py (JobController :152) — builds the
+task, launches the user cluster via execution.launch
+(_is_launched_by_jobs_controller=True), monitors job status via the
+cluster's agent, and on preemption drives the recovery strategy. The
+checkpoint contract is the reference's (SURVEY §2.6): the task mounts
+a bucket (MOUNT/MOUNT_CACHED); recovery re-launches the cluster and
+re-mounts it; the app resumes from its checkpoint.
+"""
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import time
+import traceback
+from typing import Optional
+
+import requests
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import execution
+from skypilot_tpu import global_state
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.agent import job_lib as agent_job_lib
+from skypilot_tpu.jobs import recovery_strategy
+from skypilot_tpu.jobs import state
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import ux_utils
+
+import os
+
+_POLL_SECONDS = float(os.environ.get('SKYPILOT_JOBS_POLL_SECONDS', '5'))
+_UNREACHABLE_GRACE_SECONDS = float(
+    os.environ.get('SKYPILOT_JOBS_UNREACHABLE_GRACE_SECONDS', '30'))
+
+
+class JobCancelled(Exception):
+    pass
+
+
+class JobController:
+
+    def __init__(self, job_id: int) -> None:
+        self.job_id = job_id
+        record = state.get_job(job_id)
+        assert record is not None, job_id
+        self.record = record
+        self.cluster_name = record['cluster_name']
+        self.task = task_lib.Task.from_yaml_config(record['task_config'])
+        self.executor = recovery_strategy.StrategyExecutor.make(
+            self.cluster_name, self.task)
+        self._cancelled = False
+        signal.signal(signal.SIGTERM, self._handle_term)
+
+    def _handle_term(self, signum, frame):  # noqa: ARG002
+        self._cancelled = True
+
+    # ------------------------------------------------------------------
+    def run(self) -> state.ManagedJobStatus:
+        job_id = self.job_id
+        try:
+            state.set_status(job_id, state.ManagedJobStatus.STARTING)
+            agent_job_id = self._launch(first=True)
+            final = self._monitor_loop(agent_job_id)
+        except JobCancelled:
+            self._cleanup(cancel_job=True)
+            state.set_status(job_id, state.ManagedJobStatus.CANCELLED)
+            return state.ManagedJobStatus.CANCELLED
+        except exceptions.ResourcesUnavailableError as e:
+            state.set_status(job_id, state.ManagedJobStatus.FAILED_NO_RESOURCE,
+                             last_error=str(e))
+            return state.ManagedJobStatus.FAILED_NO_RESOURCE
+        except Exception as e:  # pylint: disable=broad-except
+            traceback.print_exc()
+            self._cleanup(cancel_job=False)
+            state.set_status(job_id, state.ManagedJobStatus.FAILED_CONTROLLER,
+                             last_error=common_utils.format_exception(e))
+            return state.ManagedJobStatus.FAILED_CONTROLLER
+        state.set_status(job_id, final)
+        return final
+
+    # ------------------------------------------------------------------
+    def _launch(self, first: bool) -> int:
+        """(Re)launch cluster + submit the job; returns agent job id.
+
+        The strategy executor's launch performs the full stage walk
+        (for an existing cluster it skips provision but re-syncs and
+        re-mounts checkpoint buckets) and submits the job once.
+        """
+        del first
+        return self.executor.launch()
+
+    def _agent(self):
+        record = global_state.get_cluster(self.cluster_name)
+        if record is None:
+            return None
+        return record['handle'].agent()
+
+    def _monitor_loop(self, agent_job_id: int) -> state.ManagedJobStatus:
+        job_id = self.job_id
+        unreachable_since: Optional[float] = None
+        state.set_status(job_id, state.ManagedJobStatus.RUNNING)
+        while True:
+            if self._cancelled:
+                raise JobCancelled()
+            time.sleep(_POLL_SECONDS)
+            agent = self._agent()
+            status: Optional[agent_job_lib.JobStatus] = None
+            if agent is not None:
+                try:
+                    job = agent.get_job(agent_job_id)
+                    status = job['status'] if job else None
+                    unreachable_since = None
+                except requests.RequestException:
+                    pass
+            if agent is None or (status is None and
+                                 unreachable_since is None):
+                unreachable_since = unreachable_since or time.time()
+            if unreachable_since is not None:
+                if time.time() - unreachable_since < \
+                        _UNREACHABLE_GRACE_SECONDS and agent is not None:
+                    continue
+                # Preemption / cluster loss → recover.
+                agent_job_id = self._recover()
+                unreachable_since = None
+                continue
+
+            if status is None or not status.is_terminal():
+                continue
+            if status == agent_job_lib.JobStatus.SUCCEEDED:
+                self._cleanup(cancel_job=False)
+                return state.ManagedJobStatus.SUCCEEDED
+            if status == agent_job_lib.JobStatus.CANCELLED:
+                return state.ManagedJobStatus.CANCELLED
+            # User-code failure: restart if budget remains, else fail.
+            restarts = state.bump_recovery(job_id)
+            max_restarts = self.record['max_restarts_on_errors']
+            if restarts <= max_restarts:
+                ux_utils.log(
+                    f'Managed job {job_id}: user failure; restart '
+                    f'{restarts}/{max_restarts}.')
+                agent_job_id = self._launch(first=False)
+                state.set_status(job_id, state.ManagedJobStatus.RUNNING)
+                continue
+            self._cleanup(cancel_job=False)
+            return (state.ManagedJobStatus.FAILED_SETUP
+                    if status == agent_job_lib.JobStatus.FAILED_SETUP
+                    else state.ManagedJobStatus.FAILED)
+
+    def _recover(self) -> int:
+        job_id = self.job_id
+        state.set_status(job_id, state.ManagedJobStatus.RECOVERING)
+        state.bump_recovery(job_id)
+        ux_utils.log(f'Managed job {job_id}: cluster lost; recovering.')
+        agent_job_id = self.executor.recover()
+        state.set_status(job_id, state.ManagedJobStatus.RUNNING)
+        return agent_job_id
+
+    def _cleanup(self, cancel_job: bool) -> None:
+        if cancel_job:
+            agent = self._agent()
+            if agent is not None:
+                try:
+                    jobs = agent.get_jobs()
+                    for j in jobs:
+                        if not j['status'].is_terminal():
+                            agent.cancel_job(j['job_id'])
+                except requests.RequestException:
+                    pass
+        self.executor.terminate_cluster()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--job-id', type=int, required=True)
+    args = parser.parse_args()
+    controller = JobController(args.job_id)
+    final = controller.run()
+    # Wake the scheduler for the next pending job.
+    from skypilot_tpu.jobs import scheduler
+    scheduler.maybe_schedule_next_jobs()
+    sys.exit(0 if final == state.ManagedJobStatus.SUCCEEDED else 1)
+
+
+if __name__ == '__main__':
+    main()

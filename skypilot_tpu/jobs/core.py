@@ -1,0 +1,79 @@
+"""Managed-jobs verbs (server-side entrypoints).
+
+Reference: sky/jobs/server/core.py — launch/queue/cancel/logs.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.jobs import scheduler
+from skypilot_tpu.jobs import state
+
+
+def launch(task_config: Dict[str, Any], name: Optional[str] = None,
+           user: str = 'unknown') -> Dict[str, Any]:
+    """Submit a managed job; returns its id immediately."""
+    # Validate the task config early (fail fast in the request).
+    from skypilot_tpu import task as task_lib
+    task = task_lib.Task.from_yaml_config(dict(task_config))
+    max_restarts = 0
+    strategy = 'default'
+    for r in task.resources:
+        if r.job_recovery:
+            max_restarts = int(r.job_recovery.get('max_restarts_on_errors',
+                                                  0))
+            strategy = r.job_recovery.get('strategy') or strategy
+    job_id = state.submit_job(name or task.name, task_config, strategy,
+                              max_restarts, user)
+    scheduler.maybe_schedule_next_jobs()
+    return {'job_id': job_id, 'controller': 'local'}
+
+
+def queue(refresh: bool = False,
+          skip_finished: bool = False) -> List[Dict[str, Any]]:
+    if refresh:
+        scheduler.maybe_schedule_next_jobs()
+    jobs = state.get_jobs()
+    if skip_finished:
+        jobs = [j for j in jobs if not j['status'].is_terminal()]
+    out = []
+    for j in jobs:
+        out.append({
+            'job_id': j['job_id'],
+            'name': j['name'],
+            'status': j['status'].value,
+            'cluster_name': j['cluster_name'],
+            'submitted_at': j['submitted_at'],
+            'started_at': j['started_at'],
+            'ended_at': j['ended_at'],
+            'recovery_count': j['recovery_count'],
+            'strategy': j['strategy'],
+            'last_error': j['last_error'],
+            'user': j['user'],
+        })
+    return out
+
+
+def cancel(job_ids: Optional[List[int]] = None,
+           all_jobs: bool = False) -> List[int]:
+    if all_jobs:
+        job_ids = [j['job_id'] for j in state.get_jobs()
+                   if not j['status'].is_terminal()]
+    cancelled = []
+    for job_id in job_ids or []:
+        if scheduler.cancel_job(int(job_id)):
+            cancelled.append(int(job_id))
+    return cancelled
+
+
+def get_log_path(job_id: int) -> str:
+    job = state.get_job(job_id)
+    if job is None:
+        raise exceptions.JobNotFoundError(f'managed job {job_id}')
+    return job['log_path']
+
+
+def is_terminal(job_id: int) -> bool:
+    job = state.get_job(job_id)
+    return job is None or job['status'].is_terminal()

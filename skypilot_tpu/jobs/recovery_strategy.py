@@ -1,0 +1,153 @@
+"""Recovery strategies: how a managed job's cluster is (re)launched.
+
+Reference: sky/jobs/recovery_strategy.py (1107 LoC) —
+`JOBS_RECOVERY_STRATEGY_REGISTRY` with FAILOVER (:896) and
+EAGER_NEXT_REGION (:1017); `StrategyExecutor` (:81) wraps
+launch/recover with retries.
+
+TPU-specific: preemptions cluster by zone-capacity, so
+EAGER_NEXT_REGION (jump to a different region immediately on
+preemption) is the default for spot TPU slices, FAILOVER (retry the
+same zone first — best for reserved capacity) otherwise.
+"""
+from __future__ import annotations
+
+import time
+import typing
+from typing import Any, Dict, Optional, Set
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import execution
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import ux_utils
+from skypilot_tpu.utils.registry import JOBS_RECOVERY_STRATEGY_REGISTRY
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.backends import tpu_backend
+
+_MAX_LAUNCH_ATTEMPTS = 3
+_RETRY_GAP_SECONDS = 5
+
+
+class StrategyExecutor:
+    """Launch/recover a managed job's cluster under a strategy."""
+
+    def __init__(self, cluster_name: str, task: 'task_lib.Task') -> None:
+        self.cluster_name = cluster_name
+        self.task = task
+        self.blocked_resources: Set[Any] = set()
+
+    @classmethod
+    def make(cls, cluster_name: str,
+             task: 'task_lib.Task') -> 'StrategyExecutor':
+        strategy = None
+        for r in task.resources:
+            if r.job_recovery:
+                strategy = r.job_recovery.get('strategy')
+                break
+        if strategy is None:
+            any_spot_tpu = any(r.use_spot and r.is_tpu_slice
+                               for r in task.resources)
+            strategy = ('eager_next_region' if any_spot_tpu else 'failover')
+        strategy_cls = JOBS_RECOVERY_STRATEGY_REGISTRY.from_str(strategy)
+        return strategy_cls(cluster_name, task)
+
+    # -- operations -----------------------------------------------------------
+    def launch(self) -> int:
+        """Initial launch + job submission: returns the agent job id."""
+        return self._launch_with_retries(first_launch=True)
+
+    def recover(self) -> int:
+        """Relaunch after a preemption/failure; returns new agent job
+        id (strategy-specific)."""
+        raise NotImplementedError
+
+    def terminate_cluster(self) -> None:
+        from skypilot_tpu import core
+        try:
+            core.down(self.cluster_name)
+        except exceptions.ClusterDoesNotExist:
+            pass
+        except Exception as e:  # pylint: disable=broad-except
+            ux_utils.error(f'Failed to clean up {self.cluster_name}: {e}')
+
+    # -- helpers ---------------------------------------------------------------
+    def _launch_with_retries(self, first_launch: bool,
+                             max_attempts: int = _MAX_LAUNCH_ATTEMPTS
+                             ) -> int:
+        backoff = common_utils.Backoff(_RETRY_GAP_SECONDS)
+        last_exc: Optional[Exception] = None
+        for attempt in range(max_attempts):
+            try:
+                job_id, handle = execution.launch(
+                    self.task,
+                    cluster_name=self.cluster_name,
+                    detach_run=True,
+                    _quiet_optimizer=True,
+                    _is_launched_by_jobs_controller=True,
+                    _blocked_resources=self.blocked_resources or None)
+                assert handle is not None and job_id is not None
+                return job_id
+            except (exceptions.ResourcesUnavailableError,
+                    exceptions.ClusterSetUpError) as e:
+                last_exc = e
+                if first_launch and isinstance(
+                        e, exceptions.ResourcesUnavailableError) and \
+                        e.no_failover:
+                    raise
+                ux_utils.log(
+                    f'Launch attempt {attempt + 1}/{max_attempts} for '
+                    f'{self.cluster_name} failed: '
+                    f'{common_utils.format_exception(e)}')
+                time.sleep(backoff.current_backoff())
+        raise exceptions.ResourcesUnavailableError(
+            f'Failed to launch cluster {self.cluster_name} after '
+            f'{max_attempts} attempts.',
+        ) if last_exc is None else last_exc
+
+
+@JOBS_RECOVERY_STRATEGY_REGISTRY.register(name='failover', default=True)
+class FailoverStrategyExecutor(StrategyExecutor):
+    """Retry the same location first, then fail over elsewhere.
+
+    Reference: recovery_strategy.py:896.
+    """
+
+    def recover(self) -> int:
+        self.terminate_cluster()
+        # Same resources, same preference order: the retrying
+        # provisioner already walks zones/regions in order.
+        return self._launch_with_retries(first_launch=False,
+                                         max_attempts=10)
+
+
+@JOBS_RECOVERY_STRATEGY_REGISTRY.register(name='eager_next_region')
+class EagerNextRegionStrategyExecutor(StrategyExecutor):
+    """Skip the preempted region immediately (spot TPU default).
+
+    Reference: recovery_strategy.py:1017 — on preemption the same
+    region's capacity is likely still tight; block it and move on.
+    """
+
+    def recover(self) -> int:
+        from skypilot_tpu import global_state
+        record = global_state.get_cluster(self.cluster_name)
+        if record is not None:
+            handle = record['handle']
+            launched = handle.launched_resources
+            if launched is not None and launched.region is not None:
+                self.blocked_resources.add(
+                    launched.copy(zone=None))
+        self.terminate_cluster()
+        # Prefer a different region; if nothing else has capacity (or
+        # the cloud has a single region), fall back to the full set.
+        try:
+            return self._launch_with_retries(first_launch=False,
+                                             max_attempts=3)
+        except exceptions.ResourcesUnavailableError:
+            if not self.blocked_resources:
+                raise
+            self.blocked_resources.clear()
+            return self._launch_with_retries(first_launch=False,
+                                             max_attempts=10)

@@ -1,0 +1,93 @@
+"""Managed-jobs scheduler: not a daemon — called on every state change.
+
+Reference: sky/jobs/scheduler.py docstring (:1-43): scheduling
+decisions happen in `maybe_schedule_next_jobs()`, invoked at submit
+time and when a controller finishes; limits bound concurrently
+launching and running jobs. State lives only in the DB.
+"""
+from __future__ import annotations
+
+import os
+import sys
+from typing import Optional
+
+from skypilot_tpu import constants
+from skypilot_tpu.jobs import state
+from skypilot_tpu.utils import locks
+from skypilot_tpu.utils import subprocess_utils
+
+MAX_STARTING_JOBS = 4
+MAX_RUNNING_JOBS = 200
+
+
+def maybe_schedule_next_jobs() -> None:
+    """Spawn controllers for PENDING jobs within limits."""
+    with locks.FileLock(os.path.join(constants.sky_home(),
+                                     'jobs_scheduler.lock')):
+        _reconcile_dead_controllers()
+        starting = len(state.get_jobs(status=[
+            state.ManagedJobStatus.SUBMITTED,
+            state.ManagedJobStatus.STARTING,
+            state.ManagedJobStatus.RECOVERING]))
+        running = len(state.get_jobs(status=[
+            state.ManagedJobStatus.RUNNING]))
+        pending = state.get_jobs(status=[state.ManagedJobStatus.PENDING])
+        for job in pending:
+            if starting >= MAX_STARTING_JOBS or \
+                    starting + running >= MAX_RUNNING_JOBS:
+                break
+            _spawn_controller(job)
+            starting += 1
+
+
+def _spawn_controller(job) -> None:
+    job_id = job['job_id']
+    state.set_status(job_id, state.ManagedJobStatus.SUBMITTED)
+    env = dict(os.environ)
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env['PYTHONPATH'] = f'{repo_root}:{env.get("PYTHONPATH", "")}'
+    pid = subprocess_utils.launch_daemon(
+        [sys.executable, '-m', 'skypilot_tpu.jobs.controller',
+         '--job-id', str(job_id)],
+        log_path=job['log_path'] or os.path.join(
+            constants.sky_home(), f'managed-{job_id}.log'),
+        env=env)
+    state.set_controller_pid(job_id, pid)
+
+
+def _reconcile_dead_controllers() -> None:
+    """Controller crash safety: dead controller + live status → failed.
+
+    Reference: HA recovery (sky/jobs/ controller crash recovery).
+    """
+    active = state.get_jobs(status=[
+        state.ManagedJobStatus.SUBMITTED, state.ManagedJobStatus.STARTING,
+        state.ManagedJobStatus.RUNNING, state.ManagedJobStatus.RECOVERING,
+        state.ManagedJobStatus.CANCELLING])
+    for job in active:
+        pid = job.get('controller_pid') or -1
+        if pid > 0 and not subprocess_utils.process_alive(pid):
+            state.set_status(job['job_id'],
+                             state.ManagedJobStatus.FAILED_CONTROLLER,
+                             last_error='controller process died')
+
+
+def cancel_job(job_id: int) -> bool:
+    job = state.get_job(job_id)
+    if job is None or job['status'].is_terminal():
+        return False
+    if job['status'] == state.ManagedJobStatus.PENDING:
+        state.set_status(job_id, state.ManagedJobStatus.CANCELLED)
+        return True
+    state.set_status(job_id, state.ManagedJobStatus.CANCELLING)
+    pid = job.get('controller_pid') or -1
+    if pid > 0:
+        # SIGTERM only the controller itself: its handler cancels the
+        # agent job and tears the cluster down gracefully.
+        import signal
+        try:
+            os.kill(pid, signal.SIGTERM)
+        except ProcessLookupError:
+            state.set_status(job_id, state.ManagedJobStatus.CANCELLED)
+    return True

@@ -1,0 +1,73 @@
+"""Managed-jobs API routes (mounted by server/server.py).
+
+Reference: sky/jobs/server/ (REST under /jobs/*).
+"""
+from __future__ import annotations
+
+import asyncio
+import threading
+
+from aiohttp import web
+
+from skypilot_tpu.agent import log_lib
+from skypilot_tpu.server.requests import executor
+
+_API = 'skypilot_tpu.jobs.core'
+
+
+def _schedule(name: str, entrypoint: str, schedule_type: str = 'short'):
+
+    async def handler(request: web.Request) -> web.Response:
+        payload = await request.json() if request.can_read_body else {}
+        request_id = executor.schedule_request(
+            name, entrypoint, payload, schedule_type=schedule_type,
+            user=request.headers.get('X-Skypilot-User', 'unknown'))
+        return web.json_response({'request_id': request_id})
+
+    return handler
+
+
+async def jobs_logs(request: web.Request) -> web.StreamResponse:
+    """Stream a managed job's controller log."""
+    from skypilot_tpu.jobs import core
+    job_id = int(request.query.get('job_id', 0))
+    follow = request.query.get('follow', '1') == '1'
+    try:
+        log_path = core.get_log_path(job_id)
+    except Exception:  # pylint: disable=broad-except
+        return web.json_response({'error': f'no managed job {job_id}'},
+                                 status=404)
+    resp = web.StreamResponse()
+    resp.content_type = 'text/plain'
+    await resp.prepare(request)
+    loop = asyncio.get_event_loop()
+    queue: asyncio.Queue = asyncio.Queue(maxsize=1000)
+
+    def pump() -> None:
+        try:
+            for line in log_lib.tail_logs(
+                    log_path, follow=follow,
+                    stop_condition=lambda: core.is_terminal(job_id)):
+                asyncio.run_coroutine_threadsafe(queue.put(line),
+                                                 loop).result()
+        finally:
+            asyncio.run_coroutine_threadsafe(queue.put(None), loop).result()
+
+    threading.Thread(target=pump, daemon=True).start()
+    while True:
+        line = await queue.get()
+        if line is None:
+            break
+        await resp.write(line.encode('utf-8', errors='replace'))
+    await resp.write_eof()
+    return resp
+
+
+def register(app: web.Application) -> None:
+    app.router.add_post('/jobs/launch',
+                        _schedule('jobs.launch', f'{_API}.launch', 'long'))
+    app.router.add_post('/jobs/queue',
+                        _schedule('jobs.queue', f'{_API}.queue'))
+    app.router.add_post('/jobs/cancel',
+                        _schedule('jobs.cancel', f'{_API}.cancel'))
+    app.router.add_get('/jobs/logs', jobs_logs)

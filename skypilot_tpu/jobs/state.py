@@ -1,0 +1,160 @@
+"""Managed-jobs state: sqlite table + status machine.
+
+Reference: sky/jobs/state.py (3621 LoC) — ManagedJobStatus enum
+(:467) and the `spot`/`job_info` tables. One table here; the schedule
+state is a column, not a daemon (reference scheduler docstring,
+sky/jobs/scheduler.py:1-43).
+"""
+from __future__ import annotations
+
+import enum
+import functools
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import constants
+from skypilot_tpu.utils import db_utils
+
+
+class ManagedJobStatus(enum.Enum):
+    PENDING = 'PENDING'
+    SUBMITTED = 'SUBMITTED'
+    STARTING = 'STARTING'
+    RUNNING = 'RUNNING'
+    RECOVERING = 'RECOVERING'
+    CANCELLING = 'CANCELLING'
+    SUCCEEDED = 'SUCCEEDED'
+    FAILED = 'FAILED'
+    FAILED_SETUP = 'FAILED_SETUP'
+    FAILED_PRECHECKS = 'FAILED_PRECHECKS'
+    FAILED_NO_RESOURCE = 'FAILED_NO_RESOURCE'
+    FAILED_CONTROLLER = 'FAILED_CONTROLLER'
+    CANCELLED = 'CANCELLED'
+
+    def is_terminal(self) -> bool:
+        return self in _TERMINAL
+
+    def is_failed(self) -> bool:
+        return self in (ManagedJobStatus.FAILED,
+                        ManagedJobStatus.FAILED_SETUP,
+                        ManagedJobStatus.FAILED_PRECHECKS,
+                        ManagedJobStatus.FAILED_NO_RESOURCE,
+                        ManagedJobStatus.FAILED_CONTROLLER)
+
+
+_TERMINAL = {
+    ManagedJobStatus.SUCCEEDED, ManagedJobStatus.FAILED,
+    ManagedJobStatus.FAILED_SETUP, ManagedJobStatus.FAILED_PRECHECKS,
+    ManagedJobStatus.FAILED_NO_RESOURCE, ManagedJobStatus.FAILED_CONTROLLER,
+    ManagedJobStatus.CANCELLED,
+}
+
+_CREATE_SQL = """\
+CREATE TABLE IF NOT EXISTS managed_jobs (
+    job_id INTEGER PRIMARY KEY AUTOINCREMENT,
+    name TEXT,
+    task_config TEXT,
+    status TEXT,
+    cluster_name TEXT,
+    submitted_at REAL,
+    started_at REAL,
+    ended_at REAL,
+    recovery_count INTEGER DEFAULT 0,
+    max_restarts_on_errors INTEGER DEFAULT 0,
+    strategy TEXT,
+    last_error TEXT,
+    controller_pid INTEGER DEFAULT -1,
+    user TEXT,
+    log_path TEXT
+);
+"""
+
+
+@functools.lru_cache(maxsize=None)
+def _db_for(path: str) -> db_utils.SQLiteDB:
+    return db_utils.SQLiteDB(path, _CREATE_SQL)
+
+
+def _db() -> db_utils.SQLiteDB:
+    return _db_for(os.path.join(constants.sky_home(), 'managed_jobs.db'))
+
+
+def submit_job(name: Optional[str], task_config: Dict[str, Any],
+               strategy: str, max_restarts_on_errors: int,
+               user: str) -> int:
+    with _db().conn() as conn:
+        cur = conn.execute(
+            'INSERT INTO managed_jobs (name, task_config, status, '
+            'submitted_at, strategy, max_restarts_on_errors, user) '
+            'VALUES (?,?,?,?,?,?,?)',
+            (name, json.dumps(task_config),
+             ManagedJobStatus.PENDING.value, time.time(), strategy,
+             max_restarts_on_errors, user))
+        job_id = int(cur.lastrowid)
+    log_dir = os.path.join(constants.sky_home(), 'managed_jobs_logs')
+    os.makedirs(log_dir, exist_ok=True)
+    log_path = os.path.join(log_dir, f'{job_id}.log')
+    _db().execute('UPDATE managed_jobs SET log_path=?, cluster_name=? '
+                  'WHERE job_id=?',
+                  (log_path, f'managed-{job_id}', job_id))
+    return job_id
+
+
+def _decode(row: Dict[str, Any]) -> Dict[str, Any]:
+    out = dict(row)
+    out['status'] = ManagedJobStatus(out['status'])
+    out['task_config'] = (json.loads(out['task_config'])
+                          if out['task_config'] else {})
+    return out
+
+
+def get_job(job_id: int) -> Optional[Dict[str, Any]]:
+    row = _db().query_one('SELECT * FROM managed_jobs WHERE job_id=?',
+                          (job_id,))
+    return _decode(row) if row else None
+
+
+def get_jobs(status: Optional[List[ManagedJobStatus]] = None
+             ) -> List[Dict[str, Any]]:
+    sql = 'SELECT * FROM managed_jobs'
+    params: tuple = ()
+    if status:
+        marks = ','.join('?' * len(status))
+        sql += f' WHERE status IN ({marks})'
+        params = tuple(s.value for s in status)
+    sql += ' ORDER BY job_id'
+    return [_decode(r) for r in _db().query(sql, params)]
+
+
+def set_status(job_id: int, status: ManagedJobStatus,
+               last_error: Optional[str] = None) -> None:
+    sets = ['status=?']
+    params: List[Any] = [status.value]
+    if status == ManagedJobStatus.RUNNING:
+        sets.append('started_at=COALESCE(started_at, ?)')
+        params.append(time.time())
+    if status.is_terminal():
+        sets.append('ended_at=?')
+        params.append(time.time())
+    if last_error is not None:
+        sets.append('last_error=?')
+        params.append(last_error[-2000:])
+    params.append(job_id)
+    _db().execute(
+        f'UPDATE managed_jobs SET {", ".join(sets)} WHERE job_id=?',
+        tuple(params))
+
+
+def set_controller_pid(job_id: int, pid: int) -> None:
+    _db().execute('UPDATE managed_jobs SET controller_pid=? WHERE job_id=?',
+                  (pid, job_id))
+
+
+def bump_recovery(job_id: int) -> int:
+    _db().execute('UPDATE managed_jobs SET recovery_count=recovery_count+1 '
+                  'WHERE job_id=?', (job_id,))
+    row = _db().query_one('SELECT recovery_count FROM managed_jobs '
+                          'WHERE job_id=?', (job_id,))
+    return int(row['recovery_count']) if row else 0

@@ -1,0 +1,5 @@
+"""SkyServe-equivalent: multi-replica serving with autoscaling
+(reference: sky/serve/)."""
+from skypilot_tpu.serve.service_spec import SkyServiceSpec
+
+__all__ = ['SkyServiceSpec']

@@ -1,0 +1,135 @@
+"""Autoscalers: decide target replica count from load signals.
+
+Reference: sky/serve/autoscalers.py (1310 LoC) —
+RequestRateAutoscaler (:479) with upscale/downscale hysteresis
+(:393), QueueLengthAutoscaler (:1094). Decisions are pure functions
+of (spec, signal history, time) so they unit-test without clusters.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+import math
+import time
+from typing import List, Optional
+
+from skypilot_tpu.serve import service_spec as spec_lib
+from skypilot_tpu.utils.registry import AUTOSCALER_REGISTRY
+
+
+class AutoscalerDecisionOperator(enum.Enum):
+    SCALE_UP = 'scale_up'
+    SCALE_DOWN = 'scale_down'
+    NO_OP = 'no_op'
+
+
+@dataclasses.dataclass
+class AutoscalerDecision:
+    operator: AutoscalerDecisionOperator
+    target_num_replicas: int
+
+
+class Autoscaler:
+    """Base: fixed replica count (no autoscaling)."""
+
+    def __init__(self, spec: 'spec_lib.SkyServiceSpec') -> None:
+        self.spec = spec
+        self.target_num_replicas = spec.min_replicas
+
+    @classmethod
+    def make(cls, spec: 'spec_lib.SkyServiceSpec') -> 'Autoscaler':
+        if spec.autoscaling_enabled:
+            return RequestRateAutoscaler(spec)
+        return Autoscaler(spec)
+
+    def collect_request_information(self, num_requests: int,
+                                    timestamp: Optional[float] = None
+                                    ) -> None:
+        pass
+
+    def evaluate(self, num_ready: int,
+                 num_launching: int) -> AutoscalerDecision:
+        total = num_ready + num_launching
+        if total < self.target_num_replicas:
+            return AutoscalerDecision(AutoscalerDecisionOperator.SCALE_UP,
+                                      self.target_num_replicas)
+        if total > self.target_num_replicas:
+            return AutoscalerDecision(AutoscalerDecisionOperator.SCALE_DOWN,
+                                      self.target_num_replicas)
+        return AutoscalerDecision(AutoscalerDecisionOperator.NO_OP, total)
+
+
+@AUTOSCALER_REGISTRY.register(name='request_rate', default=True)
+class RequestRateAutoscaler(Autoscaler):
+    """Scale on QPS per ready replica, with hysteresis delays.
+
+    Reference: autoscalers.py:479 — target =
+    ceil(qps / target_qps_per_replica), clamped to [min, max]; an
+    up/down move only commits after the signal has persisted for
+    upscale_delay / downscale_delay seconds.
+    """
+
+    _QPS_WINDOW_SECONDS = 60.0
+
+    def __init__(self, spec: 'spec_lib.SkyServiceSpec') -> None:
+        super().__init__(spec)
+        self._request_timestamps: List[float] = []
+        self._upscale_candidate_since: Optional[float] = None
+        self._downscale_candidate_since: Optional[float] = None
+
+    # -- signal -----------------------------------------------------------
+    def collect_request_information(self, num_requests: int,
+                                    timestamp: Optional[float] = None
+                                    ) -> None:
+        now = timestamp if timestamp is not None else time.time()
+        self._request_timestamps.extend([now] * num_requests)
+        self._trim(now)
+
+    def _trim(self, now: float) -> None:
+        cutoff = now - self._QPS_WINDOW_SECONDS
+        self._request_timestamps = [t for t in self._request_timestamps
+                                    if t >= cutoff]
+
+    def current_qps(self, now: Optional[float] = None) -> float:
+        now = now if now is not None else time.time()
+        self._trim(now)
+        return len(self._request_timestamps) / self._QPS_WINDOW_SECONDS
+
+    # -- decision ----------------------------------------------------------
+    def evaluate(self, num_ready: int, num_launching: int,
+                 now: Optional[float] = None) -> AutoscalerDecision:
+        now = now if now is not None else time.time()
+        qps = self.current_qps(now)
+        assert self.spec.target_qps_per_replica is not None
+        desired = math.ceil(qps / self.spec.target_qps_per_replica)
+        desired = max(self.spec.min_replicas,
+                      min(self.spec.max_replicas, desired))
+        total = num_ready + num_launching
+
+        if desired > self.target_num_replicas:
+            self._downscale_candidate_since = None
+            if self._upscale_candidate_since is None:
+                self._upscale_candidate_since = now
+            if now - self._upscale_candidate_since >= \
+                    self.spec.upscale_delay_seconds:
+                self.target_num_replicas = desired
+                self._upscale_candidate_since = None
+        elif desired < self.target_num_replicas:
+            self._upscale_candidate_since = None
+            if self._downscale_candidate_since is None:
+                self._downscale_candidate_since = now
+            if now - self._downscale_candidate_since >= \
+                    self.spec.downscale_delay_seconds:
+                self.target_num_replicas = desired
+                self._downscale_candidate_since = None
+        else:
+            self._upscale_candidate_since = None
+            self._downscale_candidate_since = None
+
+        if total < self.target_num_replicas:
+            return AutoscalerDecision(AutoscalerDecisionOperator.SCALE_UP,
+                                      self.target_num_replicas)
+        if total > self.target_num_replicas:
+            return AutoscalerDecision(AutoscalerDecisionOperator.SCALE_DOWN,
+                                      self.target_num_replicas)
+        return AutoscalerDecision(AutoscalerDecisionOperator.NO_OP, total)

@@ -1,0 +1,127 @@
+"""Serve verbs (server-side entrypoints): up / status / down / update.
+
+Reference: sky/serve/server/core.py.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve import service_spec as spec_lib
+from skypilot_tpu.utils import subprocess_utils
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
+def up(task_config: Dict[str, Any], service_name: str,
+       user: str = 'unknown') -> Dict[str, Any]:
+    task = task_lib.Task.from_yaml_config(dict(task_config))
+    if task.service is None:
+        raise exceptions.InvalidTaskYAMLError(
+            'Task YAML needs a `service:` section for `serve up`.')
+    if serve_state.get_service(service_name) is not None:
+        raise exceptions.ServiceNotFoundError(
+            f'Service {service_name!r} already exists; use `serve update`.')
+    spec = task.service.to_yaml_config()
+    serve_state.add_service(service_name, task_config, spec, user)
+    record = serve_state.get_service(service_name)
+    assert record is not None
+    controller_port, lb_port = _free_port(), _free_port()
+
+    env = dict(os.environ)
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env['PYTHONPATH'] = f'{repo_root}:{env.get("PYTHONPATH", "")}'
+    pid = subprocess_utils.launch_daemon(
+        [sys.executable, '-m', 'skypilot_tpu.serve.service',
+         '--service', service_name,
+         '--controller-port', str(controller_port),
+         '--lb-port', str(lb_port)],
+        log_path=record['log_path'], env=env)
+    serve_state.set_service_controller(service_name, pid, controller_port,
+                                       lb_port)
+    return {
+        'service_name': service_name,
+        'endpoint': f'http://127.0.0.1:{lb_port}',
+        'lb_port': lb_port,
+    }
+
+
+def update(task_config: Dict[str, Any], service_name: str) -> Dict[str, Any]:
+    """Rolling update: bump version; controller replaces replicas.
+
+    Round-1 semantics: restart the controller with the new config; new
+    replicas launch before old ones are culled by the autoscaler
+    target (blue/green-ish). Full rolling logic tracked for later.
+    """
+    record = serve_state.get_service(service_name)
+    if record is None:
+        raise exceptions.ServiceNotFoundError(service_name)
+    task = task_lib.Task.from_yaml_config(dict(task_config))
+    if task.service is None:
+        raise exceptions.InvalidTaskYAMLError('`service:` section required.')
+    version = serve_state.bump_service_version(
+        service_name, task_config, task.service.to_yaml_config())
+    return {'service_name': service_name, 'version': version}
+
+
+def status(service_names: Optional[List[str]] = None
+           ) -> List[Dict[str, Any]]:
+    services = serve_state.get_services()
+    if service_names:
+        services = [s for s in services if s['name'] in service_names]
+    out = []
+    for s in services:
+        replicas = serve_state.get_replicas(s['name'])
+        out.append({
+            'name': s['name'],
+            'status': s['status'].value,
+            'version': s['version'],
+            'endpoint': (f'http://127.0.0.1:{s["lb_port"]}'
+                         if s['lb_port'] else None),
+            'replicas': [{
+                'replica_id': r['replica_id'],
+                'status': r['status'].value,
+                'endpoint': r.get('endpoint'),
+                'cluster_name': r['cluster_name'],
+            } for r in replicas],
+        })
+    return out
+
+
+def down(service_name: str, purge: bool = False) -> None:
+    record = serve_state.get_service(service_name)
+    if record is None:
+        raise exceptions.ServiceNotFoundError(service_name)
+    pid = record.get('controller_pid') or -1
+    if pid > 0 and subprocess_utils.process_alive(pid):
+        os.kill(pid, signal.SIGTERM)
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            current = serve_state.get_service(service_name)
+            if current is None or current['status'].is_terminal():
+                break
+            if not subprocess_utils.process_alive(pid):
+                break
+            time.sleep(1)
+    else:
+        # Controller already dead: clean up replicas directly.
+        from skypilot_tpu import core as sky_core
+        for replica in serve_state.get_replicas(service_name):
+            try:
+                sky_core.down(replica['cluster_name'])
+            except exceptions.SkyError:
+                if not purge:
+                    raise
+    serve_state.remove_service(service_name)

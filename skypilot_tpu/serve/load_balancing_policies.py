@@ -1,0 +1,77 @@
+"""Load balancing policies.
+
+Reference: sky/serve/load_balancing_policies.py — RoundRobin (:88),
+LeastLoad (:114).
+"""
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Dict, List, Optional
+
+from skypilot_tpu.utils.registry import LB_POLICY_REGISTRY
+
+
+class LoadBalancingPolicy:
+
+    def __init__(self) -> None:
+        self.ready_replicas: List[str] = []
+        self._lock = threading.Lock()
+
+    def set_ready_replicas(self, replicas: List[str]) -> None:
+        with self._lock:
+            if set(replicas) != set(self.ready_replicas):
+                self._on_replicas_changed(replicas)
+            self.ready_replicas = list(replicas)
+
+    def _on_replicas_changed(self, replicas: List[str]) -> None:
+        pass
+
+    def select_replica(self) -> Optional[str]:
+        raise NotImplementedError
+
+    def request_done(self, replica: str) -> None:
+        pass
+
+
+@LB_POLICY_REGISTRY.register(name='round_robin', default=True)
+class RoundRobinPolicy(LoadBalancingPolicy):
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._index = 0
+
+    def _on_replicas_changed(self, replicas: List[str]) -> None:
+        self._index = 0
+
+    def select_replica(self) -> Optional[str]:
+        with self._lock:
+            if not self.ready_replicas:
+                return None
+            replica = self.ready_replicas[self._index %
+                                          len(self.ready_replicas)]
+            self._index += 1
+            return replica
+
+
+@LB_POLICY_REGISTRY.register(name='least_load')
+class LeastLoadPolicy(LoadBalancingPolicy):
+    """Route to the replica with fewest in-flight requests."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._in_flight: Dict[str, int] = collections.defaultdict(int)
+
+    def select_replica(self) -> Optional[str]:
+        with self._lock:
+            if not self.ready_replicas:
+                return None
+            replica = min(self.ready_replicas,
+                          key=lambda r: self._in_flight[r])
+            self._in_flight[replica] += 1
+            return replica
+
+    def request_done(self, replica: str) -> None:
+        with self._lock:
+            self._in_flight[replica] = max(
+                0, self._in_flight[replica] - 1)

@@ -1,0 +1,194 @@
+"""Serve state: services + replicas tables.
+
+Reference: sky/serve/serve_state.py (918 LoC).
+"""
+from __future__ import annotations
+
+import enum
+import functools
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import constants
+from skypilot_tpu.utils import db_utils
+
+
+class ServiceStatus(enum.Enum):
+    CONTROLLER_INIT = 'CONTROLLER_INIT'
+    REPLICA_INIT = 'REPLICA_INIT'
+    READY = 'READY'
+    SHUTTING_DOWN = 'SHUTTING_DOWN'
+    SHUTDOWN = 'SHUTDOWN'
+    FAILED = 'FAILED'
+
+    def is_terminal(self) -> bool:
+        return self in (ServiceStatus.SHUTDOWN, ServiceStatus.FAILED)
+
+
+class ReplicaStatus(enum.Enum):
+    PENDING = 'PENDING'
+    PROVISIONING = 'PROVISIONING'
+    STARTING = 'STARTING'
+    READY = 'READY'
+    NOT_READY = 'NOT_READY'
+    SHUTTING_DOWN = 'SHUTTING_DOWN'
+    PREEMPTED = 'PREEMPTED'
+    FAILED = 'FAILED'
+    SHUTDOWN = 'SHUTDOWN'
+
+    def is_terminal(self) -> bool:
+        return self in (ReplicaStatus.FAILED, ReplicaStatus.SHUTDOWN)
+
+    @property
+    def is_serving(self) -> bool:
+        return self == ReplicaStatus.READY
+
+
+_CREATE_SQL = """\
+CREATE TABLE IF NOT EXISTS services (
+    name TEXT PRIMARY KEY,
+    status TEXT,
+    task_config TEXT,
+    spec TEXT,
+    controller_pid INTEGER DEFAULT -1,
+    controller_port INTEGER DEFAULT 0,
+    lb_port INTEGER DEFAULT 0,
+    created_at REAL,
+    version INTEGER DEFAULT 1,
+    log_path TEXT,
+    user TEXT
+);
+CREATE TABLE IF NOT EXISTS replicas (
+    service TEXT,
+    replica_id INTEGER,
+    cluster_name TEXT,
+    status TEXT,
+    version INTEGER,
+    endpoint TEXT,
+    launched_at REAL,
+    PRIMARY KEY (service, replica_id)
+);
+"""
+
+
+@functools.lru_cache(maxsize=None)
+def _db_for(path: str) -> db_utils.SQLiteDB:
+    return db_utils.SQLiteDB(path, _CREATE_SQL)
+
+
+def _db() -> db_utils.SQLiteDB:
+    return _db_for(os.path.join(constants.sky_home(), 'serve.db'))
+
+
+# -- services ---------------------------------------------------------------
+def add_service(name: str, task_config: Dict[str, Any],
+                spec: Dict[str, Any], user: str) -> None:
+    log_dir = os.path.join(constants.sky_home(), 'serve_logs')
+    os.makedirs(log_dir, exist_ok=True)
+    _db().execute(
+        'INSERT INTO services (name, status, task_config, spec, created_at, '
+        'log_path, user) VALUES (?,?,?,?,?,?,?)',
+        (name, ServiceStatus.CONTROLLER_INIT.value, json.dumps(task_config),
+         json.dumps(spec), time.time(),
+         os.path.join(log_dir, f'{name}.log'), user))
+
+
+def _decode_service(row: Dict[str, Any]) -> Dict[str, Any]:
+    out = dict(row)
+    out['status'] = ServiceStatus(out['status'])
+    out['task_config'] = json.loads(out['task_config'] or '{}')
+    out['spec'] = json.loads(out['spec'] or '{}')
+    return out
+
+
+def get_service(name: str) -> Optional[Dict[str, Any]]:
+    row = _db().query_one('SELECT * FROM services WHERE name=?', (name,))
+    return _decode_service(row) if row else None
+
+
+def get_services() -> List[Dict[str, Any]]:
+    return [_decode_service(r)
+            for r in _db().query('SELECT * FROM services ORDER BY name')]
+
+
+def set_service_status(name: str, status: ServiceStatus) -> None:
+    _db().execute('UPDATE services SET status=? WHERE name=?',
+                  (status.value, name))
+
+
+def set_service_controller(name: str, pid: int, controller_port: int,
+                           lb_port: int) -> None:
+    _db().execute(
+        'UPDATE services SET controller_pid=?, controller_port=?, lb_port=? '
+        'WHERE name=?', (pid, controller_port, lb_port, name))
+
+
+def bump_service_version(name: str, task_config: Dict[str, Any],
+                         spec: Dict[str, Any]) -> int:
+    _db().execute(
+        'UPDATE services SET version=version+1, task_config=?, spec=? '
+        'WHERE name=?', (json.dumps(task_config), json.dumps(spec), name))
+    row = _db().query_one('SELECT version FROM services WHERE name=?',
+                          (name,))
+    return int(row['version'])
+
+
+def remove_service(name: str) -> None:
+    _db().execute('DELETE FROM services WHERE name=?', (name,))
+    _db().execute('DELETE FROM replicas WHERE service=?', (name,))
+
+
+# -- replicas ---------------------------------------------------------------
+def add_replica(service: str, replica_id: int, cluster_name: str,
+                version: int) -> None:
+    _db().execute(
+        'INSERT OR REPLACE INTO replicas (service, replica_id, cluster_name, '
+        'status, version, launched_at) VALUES (?,?,?,?,?,?)',
+        (service, replica_id, cluster_name,
+         ReplicaStatus.PROVISIONING.value, version, time.time()))
+
+
+def _decode_replica(row: Dict[str, Any]) -> Dict[str, Any]:
+    out = dict(row)
+    out['status'] = ReplicaStatus(out['status'])
+    return out
+
+
+def get_replicas(service: str,
+                 statuses: Optional[List[ReplicaStatus]] = None
+                 ) -> List[Dict[str, Any]]:
+    rows = _db().query(
+        'SELECT * FROM replicas WHERE service=? ORDER BY replica_id',
+        (service,))
+    out = [_decode_replica(r) for r in rows]
+    if statuses:
+        out = [r for r in out if r['status'] in statuses]
+    return out
+
+
+def set_replica_status(service: str, replica_id: int,
+                       status: ReplicaStatus,
+                       endpoint: Optional[str] = None) -> None:
+    if endpoint is not None:
+        _db().execute(
+            'UPDATE replicas SET status=?, endpoint=? '
+            'WHERE service=? AND replica_id=?',
+            (status.value, endpoint, service, replica_id))
+    else:
+        _db().execute(
+            'UPDATE replicas SET status=? WHERE service=? AND replica_id=?',
+            (status.value, service, replica_id))
+
+
+def remove_replica(service: str, replica_id: int) -> None:
+    _db().execute('DELETE FROM replicas WHERE service=? AND replica_id=?',
+                  (service, replica_id))
+
+
+def next_replica_id(service: str) -> int:
+    row = _db().query_one(
+        'SELECT MAX(replica_id) AS m FROM replicas WHERE service=?',
+        (service,))
+    return int(row['m'] or 0) + 1

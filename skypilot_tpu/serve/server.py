@@ -1,0 +1,34 @@
+"""Serve API routes (mounted by server/server.py).
+
+Reference: sky/serve/server/ (REST under /serve/*).
+"""
+from __future__ import annotations
+
+from aiohttp import web
+
+from skypilot_tpu.server.requests import executor
+
+_API = 'skypilot_tpu.serve.core'
+
+
+def _schedule(name: str, entrypoint: str, schedule_type: str = 'long'):
+
+    async def handler(request: web.Request) -> web.Response:
+        payload = await request.json() if request.can_read_body else {}
+        request_id = executor.schedule_request(
+            name, entrypoint, payload, schedule_type=schedule_type,
+            user=request.headers.get('X-Skypilot-User', 'unknown'))
+        return web.json_response({'request_id': request_id})
+
+    return handler
+
+
+def register(app: web.Application) -> None:
+    app.router.add_post('/serve/up',
+                        _schedule('serve.up', f'{_API}.up'))
+    app.router.add_post('/serve/update',
+                        _schedule('serve.update', f'{_API}.update'))
+    app.router.add_post('/serve/status',
+                        _schedule('serve.status', f'{_API}.status', 'short'))
+    app.router.add_post('/serve/down',
+                        _schedule('serve.down', f'{_API}.down'))

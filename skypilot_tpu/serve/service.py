@@ -1,0 +1,337 @@
+"""Serve controller process: replica manager + autoscaler + LB.
+
+Reference: sky/serve/service.py spawns controller.py (autoscaler loop,
+replica manager) and load_balancer.py as processes; here both run in
+one process — a reconcile thread and an aiohttp reverse proxy — since
+the controller is itself cheap.
+
+Replica contract: each replica is a normal cluster named
+`<service>-rep<N>`; its task gets `SKYPILOT_SERVE_PORT` injected and
+must serve HTTP on it. Readiness = spec's probe against
+`http://<head_ip>:<port><readiness_path>`.
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import signal
+import socket
+import sys
+import threading
+import time
+import traceback
+from typing import Dict, List, Optional
+
+import requests as requests_lib
+from aiohttp import ClientSession, ClientTimeout, web
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import execution
+from skypilot_tpu import global_state
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.serve import autoscalers
+from skypilot_tpu.serve import load_balancing_policies as lb_policies
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve import service_spec as spec_lib
+from skypilot_tpu.utils import ux_utils
+from skypilot_tpu.utils.registry import LB_POLICY_REGISTRY
+
+_RECONCILE_SECONDS = float(os.environ.get('SKYPILOT_SERVE_RECONCILE_SECONDS',
+                                          '5'))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
+class ServeController:
+
+    def __init__(self, service_name: str) -> None:
+        record = serve_state.get_service(service_name)
+        assert record is not None, service_name
+        self.name = service_name
+        self.task_config = record['task_config']
+        self.spec = spec_lib.SkyServiceSpec.from_yaml_config(record['spec'])
+        self.version = record['version']
+        self.autoscaler = autoscalers.Autoscaler.make(self.spec)
+        policy_cls = LB_POLICY_REGISTRY.from_str(
+            self.spec.load_balancing_policy)
+        self.policy: lb_policies.LoadBalancingPolicy = policy_cls()
+        self._shutdown = threading.Event()
+        self._launching: Dict[int, threading.Thread] = {}
+        self._replica_ports: Dict[int, int] = {}
+
+    # -- replica lifecycle ---------------------------------------------------
+    def _replica_cluster(self, replica_id: int) -> str:
+        return f'{self.name}-rep{replica_id}'
+
+    def _launch_replica(self, replica_id: int, version: int) -> None:
+        del version
+        cluster = self._replica_cluster(replica_id)
+        port = self.spec.port or _free_port()
+        self._replica_ports[replica_id] = port
+        task = task_lib.Task.from_yaml_config(dict(self.task_config))
+        task.service = None
+        task.update_envs({'SKYPILOT_SERVE_PORT': str(port)})
+        try:
+            _, handle = execution.launch(task, cluster_name=cluster,
+                                         detach_run=True,
+                                         _quiet_optimizer=True)
+            assert handle is not None
+            head = handle.cluster_info.get_head_instance()
+            endpoint = f'{head.get_feasible_ip()}:{port}'
+            serve_state.set_replica_status(self.name, replica_id,
+                                           serve_state.ReplicaStatus.STARTING,
+                                           endpoint=endpoint)
+        except Exception as e:  # pylint: disable=broad-except
+            ux_utils.error(f'Replica {replica_id} launch failed: {e}')
+            serve_state.set_replica_status(self.name, replica_id,
+                                           serve_state.ReplicaStatus.FAILED)
+
+    def _terminate_replica(self, replica_id: int, preempted: bool = False
+                           ) -> None:
+        cluster = self._replica_cluster(replica_id)
+        serve_state.set_replica_status(
+            self.name, replica_id, serve_state.ReplicaStatus.SHUTTING_DOWN)
+        from skypilot_tpu import core
+        try:
+            core.down(cluster)
+        except exceptions.ClusterDoesNotExist:
+            pass
+        except Exception as e:  # pylint: disable=broad-except
+            ux_utils.error(f'Replica {replica_id} teardown failed: {e}')
+        if preempted:
+            serve_state.remove_replica(self.name, replica_id)
+        else:
+            serve_state.set_replica_status(
+                self.name, replica_id, serve_state.ReplicaStatus.SHUTDOWN)
+
+    # -- probing ----------------------------------------------------------------
+    def _probe_replica(self, replica: Dict) -> bool:
+        endpoint = replica.get('endpoint')
+        if not endpoint:
+            return False
+        url = f'http://{endpoint}{self.spec.readiness_path}'
+        try:
+            if self.spec.post_data is not None:
+                resp = requests_lib.post(
+                    url, json=self.spec.post_data,
+                    timeout=self.spec.readiness_timeout_seconds)
+            else:
+                resp = requests_lib.get(
+                    url, timeout=self.spec.readiness_timeout_seconds)
+            return resp.status_code == 200
+        except requests_lib.RequestException:
+            return False
+
+    # -- reconcile loop ----------------------------------------------------------
+    def reconcile_once(self) -> None:
+        replicas = serve_state.get_replicas(self.name)
+        S = serve_state.ReplicaStatus
+
+        # Reap finished launch threads.
+        for rid, thread in list(self._launching.items()):
+            if not thread.is_alive():
+                del self._launching[rid]
+
+        ready: List[Dict] = []
+        launching = 0
+        for replica in replicas:
+            rid = replica['replica_id']
+            status: serve_state.ReplicaStatus = replica['status']
+            if status in (S.SHUTTING_DOWN, S.SHUTDOWN, S.FAILED):
+                continue
+            if status in (S.PENDING, S.PROVISIONING):
+                launching += 1
+                continue
+            # STARTING / READY / NOT_READY: check cluster + probe.
+            cluster_record = global_state.get_cluster(
+                self._replica_cluster(rid))
+            if cluster_record is None and rid not in self._launching:
+                # Preempted / externally killed: relaunch as new replica.
+                ux_utils.log(f'Replica {rid} lost (preemption); replacing.')
+                serve_state.set_replica_status(self.name, rid, S.PREEMPTED)
+                serve_state.remove_replica(self.name, rid)
+                continue
+            if self._probe_replica(replica):
+                if status != S.READY:
+                    serve_state.set_replica_status(self.name, rid, S.READY)
+                ready.append(replica)
+            else:
+                age = time.time() - (replica.get('launched_at') or 0)
+                if status == S.READY:
+                    serve_state.set_replica_status(self.name, rid,
+                                                   S.NOT_READY)
+                elif status == S.STARTING and \
+                        age > self.spec.initial_delay_seconds:
+                    ux_utils.error(
+                        f'Replica {rid} failed readiness within '
+                        f'{self.spec.initial_delay_seconds}s; replacing.')
+                    self._terminate_replica(rid, preempted=True)
+                else:
+                    launching += 1
+
+        # Autoscale.
+        decision = self.autoscaler.evaluate(len(ready), launching)
+        if decision.operator == \
+                autoscalers.AutoscalerDecisionOperator.SCALE_UP:
+            want = decision.target_num_replicas - len(ready) - launching
+            for _ in range(max(0, want)):
+                rid = serve_state.next_replica_id(self.name)
+                thread = threading.Thread(target=self._launch_replica,
+                                          args=(rid, self.version),
+                                          daemon=True)
+                serve_state.add_replica(self.name, rid,
+                                        self._replica_cluster(rid),
+                                        self.version)
+                self._launching[rid] = thread
+                thread.start()
+        elif decision.operator == \
+                autoscalers.AutoscalerDecisionOperator.SCALE_DOWN:
+            excess = len(ready) + launching - decision.target_num_replicas
+            victims = sorted(
+                (r for r in replicas
+                 if not r['status'].is_terminal() and
+                 r['status'] != S.SHUTTING_DOWN),
+                key=lambda r: (r['status'] == S.READY, -r['replica_id']))
+            for replica in victims[:max(0, excess)]:
+                threading.Thread(target=self._terminate_replica,
+                                 args=(replica['replica_id'],),
+                                 daemon=True).start()
+
+        # Update LB + service status.
+        self.policy.set_ready_replicas(
+            [r['endpoint'] for r in ready if r.get('endpoint')])
+        service = serve_state.get_service(self.name)
+        if service and not service['status'].is_terminal():
+            new_status = (serve_state.ServiceStatus.READY if ready
+                          else serve_state.ServiceStatus.REPLICA_INIT)
+            if service['status'] != new_status:
+                serve_state.set_service_status(self.name, new_status)
+
+    def reconcile_loop(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                self.reconcile_once()
+            except Exception:  # pylint: disable=broad-except
+                traceback.print_exc()
+            self._shutdown.wait(_RECONCILE_SECONDS)
+
+    # -- teardown -------------------------------------------------------------
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        serve_state.set_service_status(
+            self.name, serve_state.ServiceStatus.SHUTTING_DOWN)
+        for replica in serve_state.get_replicas(self.name):
+            if not replica['status'].is_terminal():
+                self._terminate_replica(replica['replica_id'])
+        serve_state.set_service_status(self.name,
+                                       serve_state.ServiceStatus.SHUTDOWN)
+
+    # -- load balancer ------------------------------------------------------------
+    def make_lb_app(self) -> web.Application:
+        controller = self
+
+        async def proxy(request: web.Request) -> web.StreamResponse:
+            replica = controller.policy.select_replica()
+            controller.autoscaler.collect_request_information(1)
+            if replica is None:
+                return web.json_response(
+                    {'error': 'no ready replicas'}, status=503)
+            url = f'http://{replica}{request.rel_url}'
+            try:
+                timeout = ClientTimeout(total=300)
+                async with ClientSession(timeout=timeout) as session:
+                    body = await request.read()
+                    async with session.request(
+                            request.method, url, data=body,
+                            headers={k: v for k, v in request.headers.items()
+                                     if k.lower() not in ('host',)},
+                    ) as upstream:
+                        resp = web.StreamResponse(
+                            status=upstream.status,
+                            headers={k: v
+                                     for k, v in upstream.headers.items()
+                                     if k.lower() not in
+                                     ('transfer-encoding',)})
+                        await resp.prepare(request)
+                        async for chunk in upstream.content.iter_chunked(
+                                64 * 1024):
+                            await resp.write(chunk)
+                        await resp.write_eof()
+                        return resp
+            except Exception as e:  # pylint: disable=broad-except
+                return web.json_response(
+                    {'error': f'upstream {replica}: {e}'}, status=502)
+            finally:
+                controller.policy.request_done(replica)
+
+        app = web.Application()
+        app.router.add_route('*', '/{tail:.*}', proxy)
+        return app
+
+    def make_controller_app(self) -> web.Application:
+        controller = self
+
+        async def info(request: web.Request) -> web.Response:
+            del request
+            replicas = serve_state.get_replicas(controller.name)
+            return web.json_response({
+                'service': controller.name,
+                'target_num_replicas':
+                    controller.autoscaler.target_num_replicas,
+                'replicas': [{
+                    'replica_id': r['replica_id'],
+                    'status': r['status'].value,
+                    'endpoint': r.get('endpoint'),
+                } for r in replicas],
+            })
+
+        app = web.Application()
+        app.router.add_get('/controller/info', info)
+        return app
+
+
+async def _run_async(controller: ServeController, controller_port: int,
+                     lb_port: int) -> None:
+    lb_runner = web.AppRunner(controller.make_lb_app())
+    await lb_runner.setup()
+    await web.TCPSite(lb_runner, '0.0.0.0', lb_port).start()
+    ctl_runner = web.AppRunner(controller.make_controller_app())
+    await ctl_runner.setup()
+    await web.TCPSite(ctl_runner, '127.0.0.1', controller_port).start()
+    while not controller._shutdown.is_set():  # pylint: disable=protected-access
+        await asyncio.sleep(0.5)
+    await lb_runner.cleanup()
+    await ctl_runner.cleanup()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--service', required=True)
+    parser.add_argument('--controller-port', type=int, required=True)
+    parser.add_argument('--lb-port', type=int, required=True)
+    args = parser.parse_args()
+
+    controller = ServeController(args.service)
+
+    def handle_term(signum, frame):  # noqa: ARG001
+        threading.Thread(target=controller.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, handle_term)
+    reconcile = threading.Thread(target=controller.reconcile_loop,
+                                 daemon=True)
+    reconcile.start()
+    try:
+        asyncio.run(_run_async(controller, args.controller_port,
+                               args.lb_port))
+    finally:
+        if not controller._shutdown.is_set():  # pylint: disable=protected-access
+            controller.shutdown()
+
+
+if __name__ == '__main__':
+    main()

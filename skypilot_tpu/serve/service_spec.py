@@ -1,0 +1,129 @@
+"""Service spec: the `service:` section of a task YAML.
+
+Reference: sky/serve/service_spec.py (735 LoC) — readiness probe,
+replica policy (min/max, target qps), rolling-update knobs.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import exceptions
+
+
+class SkyServiceSpec:
+
+    def __init__(self,
+                 readiness_path: str = '/',
+                 initial_delay_seconds: int = 60,
+                 readiness_timeout_seconds: int = 15,
+                 post_data: Optional[Any] = None,
+                 min_replicas: int = 1,
+                 max_replicas: Optional[int] = None,
+                 target_qps_per_replica: Optional[float] = None,
+                 upscale_delay_seconds: int = 60,
+                 downscale_delay_seconds: int = 120,
+                 port: Optional[int] = None,
+                 load_balancing_policy: str = 'round_robin') -> None:
+        if not readiness_path.startswith('/'):
+            raise exceptions.InvalidTaskYAMLError(
+                f'readiness path must start with /: {readiness_path!r}')
+        self.readiness_path = readiness_path
+        self.initial_delay_seconds = initial_delay_seconds
+        self.readiness_timeout_seconds = readiness_timeout_seconds
+        self.post_data = post_data
+        self.min_replicas = min_replicas
+        self.max_replicas = max_replicas if max_replicas is not None else \
+            min_replicas
+        self.target_qps_per_replica = target_qps_per_replica
+        self.upscale_delay_seconds = upscale_delay_seconds
+        self.downscale_delay_seconds = downscale_delay_seconds
+        self.port = port
+        self.load_balancing_policy = load_balancing_policy
+        if self.max_replicas < self.min_replicas:
+            raise exceptions.InvalidTaskYAMLError(
+                'max_replicas < min_replicas')
+        if (self.target_qps_per_replica is not None and
+                self.target_qps_per_replica <= 0):
+            raise exceptions.InvalidTaskYAMLError(
+                'target_qps_per_replica must be positive')
+
+    @property
+    def autoscaling_enabled(self) -> bool:
+        return (self.max_replicas > self.min_replicas and
+                self.target_qps_per_replica is not None)
+
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any]) -> 'SkyServiceSpec':
+        config = dict(config)
+        readiness = config.pop('readiness_probe', '/')
+        kwargs: Dict[str, Any] = {}
+        if isinstance(readiness, str):
+            kwargs['readiness_path'] = readiness
+        else:
+            readiness = dict(readiness)
+            kwargs['readiness_path'] = readiness.pop('path', '/')
+            if 'initial_delay_seconds' in readiness:
+                kwargs['initial_delay_seconds'] = readiness.pop(
+                    'initial_delay_seconds')
+            if 'timeout_seconds' in readiness:
+                kwargs['readiness_timeout_seconds'] = readiness.pop(
+                    'timeout_seconds')
+            if 'post_data' in readiness:
+                kwargs['post_data'] = readiness.pop('post_data')
+            if readiness:
+                raise exceptions.InvalidTaskYAMLError(
+                    f'Unknown readiness_probe fields: {sorted(readiness)}')
+        policy = config.pop('replica_policy', None)
+        if policy is None:
+            count = config.pop('replicas', 1)
+            kwargs['min_replicas'] = kwargs['max_replicas'] = int(count)
+        else:
+            policy = dict(policy)
+            kwargs['min_replicas'] = int(policy.pop('min_replicas', 1))
+            if 'max_replicas' in policy:
+                kwargs['max_replicas'] = int(policy.pop('max_replicas'))
+            if 'target_qps_per_replica' in policy:
+                kwargs['target_qps_per_replica'] = float(
+                    policy.pop('target_qps_per_replica'))
+            for key in ('upscale_delay_seconds', 'downscale_delay_seconds'):
+                if key in policy:
+                    kwargs[key] = int(policy.pop(key))
+            if policy:
+                raise exceptions.InvalidTaskYAMLError(
+                    f'Unknown replica_policy fields: {sorted(policy)}')
+        if 'port' in config:
+            kwargs['port'] = int(config.pop('port'))
+        if 'load_balancing_policy' in config:
+            kwargs['load_balancing_policy'] = config.pop(
+                'load_balancing_policy')
+        if config:
+            raise exceptions.InvalidTaskYAMLError(
+                f'Unknown service fields: {sorted(config)}')
+        return cls(**kwargs)
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            'readiness_probe': {
+                'path': self.readiness_path,
+                'initial_delay_seconds': self.initial_delay_seconds,
+                'timeout_seconds': self.readiness_timeout_seconds,
+            },
+            'replica_policy': {
+                'min_replicas': self.min_replicas,
+                'max_replicas': self.max_replicas,
+            },
+        }
+        if self.post_data is not None:
+            out['readiness_probe']['post_data'] = self.post_data
+        if self.target_qps_per_replica is not None:
+            out['replica_policy']['target_qps_per_replica'] = \
+                self.target_qps_per_replica
+            out['replica_policy']['upscale_delay_seconds'] = \
+                self.upscale_delay_seconds
+            out['replica_policy']['downscale_delay_seconds'] = \
+                self.downscale_delay_seconds
+        if self.port is not None:
+            out['port'] = self.port
+        if self.load_balancing_policy != 'round_robin':
+            out['load_balancing_policy'] = self.load_balancing_policy
+        return out

@@ -1,0 +1,22 @@
+"""File locks (reference: sky/utils/locks.py — file + DB locks)."""
+from __future__ import annotations
+
+import os
+
+import filelock
+
+
+class FileLock:
+    """filelock wrapper that creates parent dirs."""
+
+    def __init__(self, path: str, timeout: float = 60.0) -> None:
+        path = os.path.expanduser(path)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        self._lock = filelock.FileLock(path, timeout=timeout)
+
+    def __enter__(self):
+        self._lock.acquire()
+        return self
+
+    def __exit__(self, *args) -> None:
+        self._lock.release()

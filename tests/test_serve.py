@@ -1,0 +1,182 @@
+"""Serve on the Local cloud: replicas, LB, readiness, autoscaler units.
+
+Reference strategy: unit tests for autoscaler/policies
+(tests/unit_tests/test_serve_autoscaler.py) + smoke tests on real
+clouds; here the smoke equivalent runs real replica clusters
+(sandbox hosts) behind a real aiohttp LB.
+"""
+import time
+
+import pytest
+import requests
+
+from skypilot_tpu.serve import autoscalers
+from skypilot_tpu.serve import core as serve_core
+from skypilot_tpu.serve import load_balancing_policies as lb
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.service_spec import SkyServiceSpec
+
+# ---------------------------------------------------------------------------
+# Pure-unit: autoscaler + policies
+# ---------------------------------------------------------------------------
+
+
+def _spec(**kw):
+    return SkyServiceSpec(min_replicas=1, max_replicas=4,
+                          target_qps_per_replica=2.0,
+                          upscale_delay_seconds=10,
+                          downscale_delay_seconds=20, **kw)
+
+
+def test_request_rate_autoscaler_hysteresis():
+    a = autoscalers.RequestRateAutoscaler(_spec())
+    t0 = 1000.0
+    # 300 requests over the 60s window = 5 qps -> desired ceil(5/2)=3,
+    # committed only after upscale_delay.
+    for i in range(30):
+        a.collect_request_information(10, timestamp=t0 + i)
+    d = a.evaluate(num_ready=1, num_launching=0, now=t0 + 5)
+    assert a.target_num_replicas == 1  # delay not yet passed
+    d = a.evaluate(num_ready=1, num_launching=0, now=t0 + 31)
+    assert a.target_num_replicas == 3
+    assert d.operator == autoscalers.AutoscalerDecisionOperator.SCALE_UP
+
+    # Load vanishes: downscale only after downscale_delay.
+    t1 = t0 + 200
+    a.collect_request_information(0, timestamp=t1)
+    a.evaluate(num_ready=3, num_launching=0, now=t1)
+    assert a.target_num_replicas == 3
+    d = a.evaluate(num_ready=3, num_launching=0, now=t1 + 21)
+    assert a.target_num_replicas == 1
+    assert d.operator == autoscalers.AutoscalerDecisionOperator.SCALE_DOWN
+
+
+def test_fixed_autoscaler():
+    spec = SkyServiceSpec(min_replicas=2, max_replicas=2)
+    a = autoscalers.Autoscaler.make(spec)
+    assert type(a) is autoscalers.Autoscaler
+    d = a.evaluate(num_ready=0, num_launching=1)
+    assert d.operator == autoscalers.AutoscalerDecisionOperator.SCALE_UP
+    assert d.target_num_replicas == 2
+
+
+def test_round_robin_policy():
+    p = lb.RoundRobinPolicy()
+    assert p.select_replica() is None
+    p.set_ready_replicas(['a:1', 'b:2'])
+    picks = [p.select_replica() for _ in range(4)]
+    assert picks == ['a:1', 'b:2', 'a:1', 'b:2']
+
+
+def test_least_load_policy():
+    p = lb.LeastLoadPolicy()
+    p.set_ready_replicas(['a:1', 'b:2'])
+    r1 = p.select_replica()
+    r2 = p.select_replica()
+    assert {r1, r2} == {'a:1', 'b:2'}  # spreads while both in flight
+    p.request_done(r1)
+    assert p.select_replica() == r1
+
+
+def test_service_spec_yaml_round_trip():
+    spec = SkyServiceSpec.from_yaml_config({
+        'readiness_probe': {'path': '/health',
+                            'initial_delay_seconds': 30},
+        'replica_policy': {'min_replicas': 1, 'max_replicas': 3,
+                           'target_qps_per_replica': 5},
+        'port': 9000,
+    })
+    again = SkyServiceSpec.from_yaml_config(spec.to_yaml_config())
+    assert again.readiness_path == '/health'
+    assert again.max_replicas == 3
+    assert again.port == 9000
+    assert again.autoscaling_enabled
+
+
+# ---------------------------------------------------------------------------
+# E2E on Local cloud
+# ---------------------------------------------------------------------------
+_SERVER_RUN = (
+    'python3 -c "'
+    "import http.server, os, json\n"
+    "class H(http.server.BaseHTTPRequestHandler):\n"
+    "    def do_GET(self):\n"
+    "        body = json.dumps({'rank': os.environ.get("
+    "'SKYPILOT_NODE_RANK'), 'pid': os.getpid()}).encode()\n"
+    "        self.send_response(200)\n"
+    "        self.send_header('Content-Length', str(len(body)))\n"
+    "        self.end_headers()\n"
+    "        self.wfile.write(body)\n"
+    "    def log_message(self, *a):\n"
+    "        pass\n"
+    "http.server.HTTPServer(('127.0.0.1', "
+    "int(os.environ['SKYPILOT_SERVE_PORT'])), H).serve_forever()\n"
+    '"')
+
+
+@pytest.fixture()
+def serve_env(isolated_state, monkeypatch):
+    monkeypatch.setenv('SKYPILOT_SERVE_RECONCILE_SECONDS', '2')
+    from skypilot_tpu import check
+    check.check(quiet=True)
+    yield isolated_state
+    for s in serve_state.get_services():
+        try:
+            serve_core.down(s['name'], purge=True)
+        except Exception:  # pylint: disable=broad-except
+            pass
+
+
+def _task_config(replicas=2):
+    return {
+        'name': 'echo',
+        'resources': {'infra': 'local'},
+        'run': _SERVER_RUN,
+        'service': {
+            'readiness_probe': {'path': '/', 'initial_delay_seconds': 60},
+            'replicas': replicas,
+        },
+    }
+
+
+def _wait_ready(name, want, timeout=150):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        rows = serve_core.status([name])
+        if rows:
+            ready = [r for r in rows[0]['replicas']
+                     if r['status'] == 'READY']
+            if len(ready) >= want:
+                return rows[0]
+        time.sleep(2)
+    raise TimeoutError(f'service {name} never got {want} ready replicas: '
+                       f'{serve_core.status([name])}')
+
+
+@pytest.mark.slow
+def test_serve_up_lb_down(serve_env):
+    result = serve_core.up(_task_config(replicas=2), 'svc1', user='t')
+    endpoint = result['endpoint']
+    row = _wait_ready('svc1', 2)
+    assert row['status'] == 'READY'
+
+    # LB round-robins across both replicas.
+    seen_pids = set()
+    for _ in range(6):
+        resp = requests.get(endpoint + '/', timeout=10)
+        assert resp.status_code == 200
+        seen_pids.add(resp.json()['pid'])
+    assert len(seen_pids) == 2, seen_pids
+
+    # Replica loss is replaced (self-healing).
+    from skypilot_tpu import core as sky_core
+    victims = row['replicas']
+    sky_core.down(victims[0]['cluster_name'])
+    _wait_ready('svc1', 2, timeout=150)
+
+    serve_core.down('svc1')
+    assert serve_core.status(['svc1']) == []
+    # All replica clusters cleaned up.
+    from skypilot_tpu import global_state
+    names = [c['name'] for c in global_state.get_clusters()]
+    assert not any(n.startswith('svc1-') for n in names), names
