@@ -1,0 +1,242 @@
+// token_loader: memory-mapped token-shard reader with prefetch.
+//
+// The native data path for training recipes (the role the reference
+// delegates to Ray/torch dataloaders; here a small C++ core feeds the
+// JAX input pipeline). Shards are flat binary files of uint16 or
+// uint32 token ids (nanoGPT's .bin format). The loader memory-maps
+// every shard, and worker threads fill a ring of pinned host buffers
+// with deterministic pseudo-random (or sequential) windows so
+// `next_batch` never blocks on disk in steady state.
+//
+// Multi-host contract: pass (rank, world_size) and every host draws a
+// disjoint deterministic stream — the same (seed, step) schedule the
+// JAX data-parallel axis expects.
+//
+// C ABI (ctypes-consumed; see skypilot_tpu/data/token_loader.py):
+//   tl_open(paths, n, dtype_bytes)            -> handle
+//   tl_total_tokens(handle)                   -> u64
+//   tl_start(handle, batch, seq, seed, rank, world, shuffle, nthreads)
+//   tl_next(handle, out_u32)                  -> step index (or -1)
+//   tl_close(handle)
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <fcntl.h>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+struct Shard {
+  const uint8_t* data = nullptr;
+  size_t bytes = 0;
+  uint64_t tokens = 0;
+};
+
+// splitmix64: tiny deterministic PRNG good enough for window sampling.
+inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ULL;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+  return x ^ (x >> 31);
+}
+
+struct Loader {
+  std::vector<Shard> shards;
+  std::vector<uint64_t> cum_tokens;  // prefix sums for global indexing
+  uint64_t total_tokens = 0;
+  int dtype_bytes = 2;
+
+  // iteration config
+  int batch = 0, seq = 0;
+  uint64_t seed = 0;
+  int rank = 0, world = 1;
+  bool shuffle = true;
+
+  // prefetch ring
+  std::vector<std::vector<uint32_t>> ring;
+  std::queue<int> free_slots, ready_slots;
+  std::vector<int64_t> slot_step;
+  std::mutex mu;
+  std::condition_variable cv_free, cv_ready;
+  std::vector<std::thread> workers;
+  std::atomic<int64_t> next_step{0};
+  std::atomic<bool> stop{false};
+  int64_t consumer_slot = -1;
+
+  ~Loader() { shutdown(); }
+
+  void shutdown() {
+    stop.store(true);
+    cv_free.notify_all();
+    cv_ready.notify_all();
+    for (auto& t : workers)
+      if (t.joinable()) t.join();
+    workers.clear();
+    for (auto& s : shards)
+      if (s.data) munmap(const_cast<uint8_t*>(s.data), s.bytes);
+    shards.clear();
+  }
+
+  uint32_t token_at(uint64_t global_idx) const {
+    // binary search shard
+    size_t lo = 0, hi = shards.size();
+    while (lo + 1 < hi) {
+      size_t mid = (lo + hi) / 2;
+      if (cum_tokens[mid] <= global_idx) lo = mid; else hi = mid;
+    }
+    uint64_t off = global_idx - cum_tokens[lo];
+    const uint8_t* p = shards[lo].data + off * dtype_bytes;
+    if (dtype_bytes == 2) {
+      uint16_t v;
+      std::memcpy(&v, p, 2);
+      return v;
+    }
+    uint32_t v;
+    std::memcpy(&v, p, 4);
+    return v;
+  }
+
+  void fill_window(uint64_t start, uint32_t* out, int count) const {
+    // Fast path: window within one shard → single memcpy-ish loop.
+    for (int i = 0; i < count; ++i)
+      out[i] = token_at(start + i);
+  }
+
+  void fill_batch(int64_t step, uint32_t* out) const {
+    const uint64_t n_windows = total_tokens / (uint64_t)seq;
+    for (int b = 0; b < batch; ++b) {
+      uint64_t start;
+      if (shuffle) {
+        uint64_t key = splitmix64(
+            seed ^ (uint64_t)step * 0x10001ULL ^
+            ((uint64_t)rank << 40) ^ (uint64_t)b);
+        start = key % (total_tokens - (uint64_t)seq - 1);
+      } else {
+        uint64_t window =
+            ((uint64_t)step * (uint64_t)world + (uint64_t)rank) *
+                (uint64_t)batch + (uint64_t)b;
+        start = (window % n_windows) * (uint64_t)seq;
+        if (start + seq + 1 > total_tokens)
+          start = total_tokens - seq - 1;
+      }
+      // +1: targets are inputs shifted by one (LM objective).
+      fill_window(start, out + (size_t)b * (seq + 1), seq + 1);
+    }
+  }
+
+  void worker_loop() {
+    while (!stop.load()) {
+      int slot;
+      {
+        std::unique_lock<std::mutex> lock(mu);
+        cv_free.wait(lock, [&] { return stop.load() || !free_slots.empty(); });
+        if (stop.load()) return;
+        slot = free_slots.front();
+        free_slots.pop();
+      }
+      int64_t step = next_step.fetch_add(1);
+      fill_batch(step, ring[slot].data());
+      {
+        std::unique_lock<std::mutex> lock(mu);
+        slot_step[slot] = step;
+        ready_slots.push(slot);
+      }
+      cv_ready.notify_one();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* tl_open(const char** paths, int n, int dtype_bytes) {
+  auto* loader = new Loader();
+  loader->dtype_bytes = dtype_bytes;
+  loader->cum_tokens.push_back(0);
+  for (int i = 0; i < n; ++i) {
+    int fd = ::open(paths[i], O_RDONLY);
+    if (fd < 0) {
+      delete loader;
+      return nullptr;
+    }
+    struct stat st;
+    fstat(fd, &st);
+    Shard shard;
+    shard.bytes = (size_t)st.st_size;
+    shard.tokens = shard.bytes / dtype_bytes;
+    shard.data = (const uint8_t*)mmap(nullptr, shard.bytes, PROT_READ,
+                                      MAP_PRIVATE, fd, 0);
+    ::close(fd);
+    if (shard.data == MAP_FAILED) {
+      delete loader;
+      return nullptr;
+    }
+    madvise(const_cast<uint8_t*>(shard.data), shard.bytes, MADV_RANDOM);
+    loader->total_tokens += shard.tokens;
+    loader->shards.push_back(shard);
+    loader->cum_tokens.push_back(loader->total_tokens);
+  }
+  return loader;
+}
+
+uint64_t tl_total_tokens(void* handle) {
+  return ((Loader*)handle)->total_tokens;
+}
+
+int tl_start(void* handle, int batch, int seq, uint64_t seed, int rank,
+             int world, int shuffle, int nthreads, int ring_slots) {
+  auto* loader = (Loader*)handle;
+  if ((uint64_t)(seq + 1) >= loader->total_tokens) return -1;
+  loader->batch = batch;
+  loader->seq = seq;
+  loader->seed = seed;
+  loader->rank = rank;
+  loader->world = world;
+  loader->shuffle = shuffle != 0;
+  if (ring_slots < 2) ring_slots = 2;
+  loader->ring.assign(ring_slots,
+                      std::vector<uint32_t>((size_t)batch * (seq + 1)));
+  loader->slot_step.assign(ring_slots, -1);
+  for (int i = 0; i < ring_slots; ++i) loader->free_slots.push(i);
+  if (nthreads < 1) nthreads = 1;
+  for (int i = 0; i < nthreads; ++i)
+    loader->workers.emplace_back([loader] { loader->worker_loop(); });
+  return 0;
+}
+
+int64_t tl_next(void* handle, uint32_t* out) {
+  auto* loader = (Loader*)handle;
+  int slot;
+  {
+    std::unique_lock<std::mutex> lock(loader->mu);
+    // Return the previous slot to the free pool.
+    if (loader->consumer_slot >= 0) {
+      loader->free_slots.push((int)loader->consumer_slot);
+      loader->cv_free.notify_one();
+    }
+    loader->cv_ready.wait(lock, [&] {
+      return loader->stop.load() || !loader->ready_slots.empty();
+    });
+    if (loader->stop.load()) return -1;
+    slot = loader->ready_slots.front();
+    loader->ready_slots.pop();
+    loader->consumer_slot = slot;
+  }
+  std::memcpy(out, loader->ring[slot].data(),
+              loader->ring[slot].size() * sizeof(uint32_t));
+  return loader->slot_step[slot];
+}
+
+void tl_close(void* handle) { delete (Loader*)handle; }
+
+}  // extern "C"

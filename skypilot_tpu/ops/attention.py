@@ -34,6 +34,19 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                           impl: str = 'auto') -> jax.Array:
     """q: [B,S,H,D]; k/v: [B,S,Hkv,D] (GQA allowed). Returns [B,S,H,D]."""
     assert q.ndim == 4 and k.ndim == 4 and v.ndim == 4, (q.shape, k.shape)
+    # Context parallelism: a seq-sharded mesh switches to ring attention.
+    from skypilot_tpu.parallel import context as cp_context
+    seq_mesh = cp_context.active_seq_mesh()
+    if seq_mesh is not None and impl in ('auto', 'ring'):
+        from skypilot_tpu.ops import ring_attention as ra
+        num_q_heads, num_kv_heads = q.shape[2], k.shape[2]
+        if num_kv_heads != num_q_heads:
+            rep = num_q_heads // num_kv_heads
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        heads_axis = 'tensor' if seq_mesh.shape.get('tensor', 1) > 1 else None
+        return ra.ring_attention(q, k, v, mesh=seq_mesh, causal=causal,
+                                 heads_axis=heads_axis)
     seq_len = q.shape[1]
     use_flash = (impl == 'flash' or
                  (impl == 'auto' and _pallas_flash_available() and

@@ -1,0 +1,59 @@
+"""Checkpoint manager: async orbax save/restore of sharded TrainState.
+
+The training half of the managed-jobs recovery contract (SURVEY §2.6):
+the job writes checkpoints to a GCS bucket mounted/addressed at
+`ckpt_dir` (orbax/tensorstore writes gs:// URIs directly); after a
+preemption the controller re-launches the cluster and the recipe
+resumes from `latest_step()`. Async saves overlap the device→storage
+copy with the next training steps (HBM is snapshotted synchronously,
+upload happens in the background).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+
+class CheckpointManager:
+
+    def __init__(self, ckpt_dir: str, max_to_keep: int = 3,
+                 save_interval_steps: int = 1) -> None:
+        if not ckpt_dir.startswith(('gs://', 's3://')):
+            ckpt_dir = os.path.abspath(os.path.expanduser(ckpt_dir))
+            os.makedirs(ckpt_dir, exist_ok=True)
+        self.ckpt_dir = ckpt_dir
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            save_interval_steps=save_interval_steps,
+            enable_async_checkpointing=True)
+        self._manager = ocp.CheckpointManager(ckpt_dir, options=options)
+
+    def save(self, step: int, state: Any, force: bool = False) -> bool:
+        """Async save; returns whether a save was started."""
+        return self._manager.save(
+            step, args=ocp.args.StandardSave(state), force=force)
+
+    def restore(self, state_template: Any,
+                step: Optional[int] = None) -> Any:
+        """Restore into the template's shardings (abstract or concrete)."""
+        if step is None:
+            step = self.latest_step()
+        assert step is not None, 'no checkpoint to restore'
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=getattr(
+                x, 'sharding', None)) if hasattr(x, 'shape') else x,
+            state_template)
+        return self._manager.restore(
+            step, args=ocp.args.StandardRestore(abstract))
+
+    def latest_step(self) -> Optional[int]:
+        return self._manager.latest_step()
+
+    def wait_until_finished(self) -> None:
+        self._manager.wait_until_finished()
+
+    def close(self) -> None:
+        self._manager.close()

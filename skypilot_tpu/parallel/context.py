@@ -1,0 +1,37 @@
+"""Context-parallel (sequence-sharded) execution context.
+
+When the active mesh has a `seq` axis > 1, the attention dispatch
+(ops/attention.py) switches to ring attention so k/v never
+materialize globally — long-context training where sequence length
+scales with the number of devices on the `seq` axis.
+"""
+from __future__ import annotations
+
+import contextlib
+from contextvars import ContextVar
+from typing import Iterator, Optional
+
+from jax.sharding import Mesh
+
+_ACTIVE_MESH: ContextVar[Optional[Mesh]] = ContextVar(
+    'skypilot_tpu_context_parallel_mesh', default=None)
+
+
+@contextlib.contextmanager
+def context_parallel(mesh: Mesh) -> Iterator[None]:
+    token = _ACTIVE_MESH.set(mesh)
+    try:
+        yield
+    finally:
+        _ACTIVE_MESH.reset(token)
+
+
+def active_seq_mesh() -> Optional[Mesh]:
+    """The mesh to ring-attend over, if sequence parallelism is on."""
+    mesh = _ACTIVE_MESH.get()
+    if mesh is None:
+        return None
+    if 'seq' not in mesh.axis_names:
+        return None
+    size = mesh.shape['seq']
+    return mesh if size > 1 else None

@@ -100,11 +100,10 @@ class ShardedTrainer:
                 is_leaf=lambda x: isinstance(x, nn.Partitioned))
             return TrainState.create(params, self.tx)
 
-        unboxed_sharding = jax.tree.map(
-            lambda s: s, sharding)
-        with self.mesh:
+        from skypilot_tpu.parallel import context as cp_context
+        with self.mesh, cp_context.context_parallel(self.mesh):
             with nn.logical_axis_rules(self.rules):
-                return jax.jit(_init, out_shardings=unboxed_sharding)()
+                return jax.jit(_init, out_shardings=sharding)()
 
     # -- step ---------------------------------------------------------------
     def make_train_step(self, example_tokens: jax.Array,
@@ -132,7 +131,8 @@ class ShardedTrainer:
             donate_argnums=(0,) if donate else ())
 
         def wrapped(state, tokens):
-            with self.mesh:
+            from skypilot_tpu.parallel import context as cp_context
+            with self.mesh, cp_context.context_parallel(self.mesh):
                 with nn.logical_axis_rules(self.rules):
                     return step(state, tokens)
 
@@ -151,7 +151,8 @@ class ShardedTrainer:
                        out_shardings=NamedSharding(self.mesh, P()))
 
         def wrapped(state, tokens):
-            with self.mesh:
+            from skypilot_tpu.parallel import context as cp_context
+            with self.mesh, cp_context.context_parallel(self.mesh):
                 with nn.logical_axis_rules(self.rules):
                     return step(state, tokens)
 

@@ -56,3 +56,55 @@ def test_gqa_shapes():
     logits = model.apply(params, tokens)
     assert logits.shape == (2, 16, cfg.vocab_size)
     assert logits.dtype == jnp.float32
+
+
+@pytest.mark.slow
+def test_mixtral_expert_parallel_trains():
+    from skypilot_tpu.models.mixtral import (Mixtral, MixtralConfig,
+                                             moe_next_token_loss)
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshConfig(data=2, expert=4))
+    cfg = MixtralConfig.tiny()
+    model = Mixtral(cfg)
+    tokens = jnp.ones((8, 64), jnp.int32)
+    trainer = ShardedTrainer(model, mesh, loss_fn=moe_next_token_loss)
+    state = trainer.init(jax.random.PRNGKey(0), tokens)
+    # Expert weights actually sharded over the expert axis.
+    w_gate = state.params['layer_0']['moe']['w_gate']
+    assert 'expert' in str(w_gate.sharding.spec), w_gate.sharding
+    step = trainer.make_train_step(tokens)
+    batch = shard_batch(
+        jax.random.randint(jax.random.PRNGKey(1), (8, 64), 0,
+                           cfg.vocab_size, jnp.int32), mesh)
+    state, l1 = step(state, batch)
+    state, l2 = step(state, batch)
+    state, l3 = step(state, batch)
+    assert float(l3) < float(l1)
+
+
+@pytest.mark.slow
+def test_checkpoint_save_restore(cpu_mesh8, tmp_path):
+    from skypilot_tpu.parallel.checkpoints import CheckpointManager
+    model = GPT(GPTConfig.tiny())
+    tokens = jnp.ones((8, 64), jnp.int32)
+    trainer = ShardedTrainer(model, cpu_mesh8)
+    state = trainer.init(jax.random.PRNGKey(0), tokens)
+    step = trainer.make_train_step(tokens)
+    batch = shard_batch(tokens, cpu_mesh8)
+    state, _ = step(state, batch)
+
+    mgr = CheckpointManager(str(tmp_path / 'ckpt'))
+    assert mgr.latest_step() is None
+    mgr.save(int(state.step), state)
+    mgr.wait_until_finished()
+    assert mgr.latest_step() == 1
+
+    restored = mgr.restore(state)
+    assert int(restored.step) == int(state.step)
+    import numpy as np
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(restored.params['wte'])),
+        np.asarray(jax.device_get(state.params['wte'])))
+    # Restored state keeps the mesh shardings (resume training works).
+    state2, loss = step(restored, batch)
+    assert float(loss) > 0
+    mgr.close()

@@ -1,0 +1,80 @@
+"""Native + fallback token loader: determinism, sharding, shapes."""
+import numpy as np
+import pytest
+
+from skypilot_tpu.data import token_loader
+
+
+@pytest.fixture(scope='module')
+def shards(tmp_path_factory):
+    d = tmp_path_factory.mktemp('tokens')
+    paths = []
+    offset = 0
+    for i in range(3):
+        n = 5000 + i * 1000
+        arr = (np.arange(offset, offset + n) % 50257).astype(np.uint16)
+        p = d / f'shard{i}.bin'
+        arr.tofile(p)
+        paths.append(str(p))
+        offset += n
+    return paths
+
+
+def test_native_builds_and_loads(shards):
+    assert token_loader.native_available(), 'C++ loader must build'
+    loader = token_loader.TokenLoader(shards, batch=4, seq=32, seed=1)
+    assert loader.total_tokens == 5000 + 6000 + 7000
+    batch = loader.next_batch()
+    assert batch.shape == (4, 33)
+    assert batch.dtype == np.uint32
+    assert batch.max() < 50257
+    loader.close()
+
+
+def test_sequential_crosses_shard_boundaries(shards):
+    # Tokens were written as consecutive integers (mod 50257) across
+    # shards, so any window must be consecutive — including windows
+    # spanning shard boundaries.
+    loader = token_loader.TokenLoader(shards, batch=2, seq=128, seed=0,
+                                      shuffle=False)
+    for _ in range(40):
+        batch = loader.next_batch()
+        for row in batch:
+            diffs = np.diff(row.astype(np.int64)) % 50257
+            assert (diffs == 1).all(), row[:5]
+    loader.close()
+
+
+def test_native_matches_fallback_sequential(shards):
+    native = token_loader.TokenLoader(shards, batch=2, seq=16,
+                                      shuffle=False, use_native=True)
+    fallback = token_loader.TokenLoader(shards, batch=2, seq=16,
+                                        shuffle=False, use_native=False)
+    # Native prefetches asynchronously but steps are deterministic;
+    # collect a few batches and compare as sets of rows.
+    n_batches = 5
+    native_rows = sorted(tuple(r) for _ in range(n_batches)
+                         for r in native.next_batch())
+    fallback_rows = sorted(tuple(r) for _ in range(n_batches)
+                           for r in fallback.next_batch())
+    assert native_rows == fallback_rows
+    native.close()
+
+
+def test_rank_disjoint_streams(shards):
+    a = token_loader.TokenLoader(shards, batch=2, seq=16, shuffle=False,
+                                 rank=0, world=2)
+    b = token_loader.TokenLoader(shards, batch=2, seq=16, shuffle=False,
+                                 rank=1, world=2)
+    rows_a = {tuple(r) for _ in range(3) for r in a.next_batch()}
+    rows_b = {tuple(r) for _ in range(3) for r in b.next_batch()}
+    assert not rows_a & rows_b
+    a.close()
+    b.close()
+
+
+def test_too_small_dataset(tmp_path):
+    p = tmp_path / 'tiny.bin'
+    np.arange(10, dtype=np.uint16).tofile(p)
+    with pytest.raises(ValueError):
+        token_loader.TokenLoader([str(p)], batch=1, seq=32)
