@@ -1,0 +1,33 @@
+"""SSH keypair management for cluster access.
+
+Reference: sky/authentication.py (557 LoC) — generates the sky key
+once and registers it per-cloud; TPU-VMs take it via instance
+metadata (provision/gcp/instance.py).
+"""
+from __future__ import annotations
+
+import os
+import stat
+import subprocess
+from typing import Tuple
+
+from skypilot_tpu.utils import locks
+
+PRIVATE_KEY_PATH = '~/.ssh/sky-key'
+PUBLIC_KEY_PATH = '~/.ssh/sky-key.pub'
+
+
+def get_or_generate_keys() -> Tuple[str, str]:
+    """Returns (private_key_path, public_key_contents)."""
+    private = os.path.expanduser(PRIVATE_KEY_PATH)
+    public = os.path.expanduser(PUBLIC_KEY_PATH)
+    with locks.FileLock(private + '.lock'):
+        if not os.path.exists(private):
+            os.makedirs(os.path.dirname(private), exist_ok=True)
+            subprocess.run(
+                ['ssh-keygen', '-t', 'ed25519', '-N', '', '-q',
+                 '-f', private, '-C', 'skypilot_tpu'],
+                check=True, capture_output=True)
+            os.chmod(private, stat.S_IRUSR | stat.S_IWUSR)
+    with open(public, 'r', encoding='utf-8') as f:
+        return private, f.read().strip()

@@ -32,9 +32,13 @@ class CheckpointManager:
         self._manager = ocp.CheckpointManager(ckpt_dir, options=options)
 
     def save(self, step: int, state: Any, force: bool = False) -> bool:
-        """Async save; returns whether a save was started."""
-        return self._manager.save(
-            step, args=ocp.args.StandardSave(state), force=force)
+        """Async save; returns whether a save was started. Saving a step
+        that already exists is a no-op (resume-safe)."""
+        try:
+            return self._manager.save(
+                step, args=ocp.args.StandardSave(state), force=force)
+        except ocp.checkpoint_manager.StepAlreadyExistsError:
+            return False
 
     def restore(self, state_template: Any,
                 step: Optional[int] = None) -> Any:

@@ -1,0 +1,207 @@
+"""Thin client for the GCP TPU API (tpu.googleapis.com, v2).
+
+Reference analog: sky/provision/gcp/instance_utils.py GCPTPUVMInstance
+(:1258) — but the reference drives TPUs through discovery-client
+googleapiclient; this build speaks REST directly (google.auth token +
+requests), with QueuedResources for spot/pod capacity.
+
+All HTTP goes through `_request()` so tests can fake the API surface
+(the reference's fake-cloud strategy, SURVEY §4).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import requests
+
+from skypilot_tpu import exceptions
+
+_TPU_API = 'https://tpu.googleapis.com/v2'
+_SCOPES = ['https://www.googleapis.com/auth/cloud-platform']
+
+_session: Optional[Any] = None
+
+
+def _get_session():
+    """AuthorizedSession via application-default credentials."""
+    global _session
+    if _session is None:
+        import google.auth
+        import google.auth.transport.requests
+        credentials, _ = google.auth.default(scopes=_SCOPES)
+        _session = google.auth.transport.requests.AuthorizedSession(
+            credentials)
+    return _session
+
+
+def default_project() -> str:
+    import google.auth
+    _, project = google.auth.default(scopes=_SCOPES)
+    if project is None:
+        raise exceptions.NoCloudAccessError(
+            'No GCP project configured; set gcp.project_id in config or '
+            'run `gcloud config set project`.')
+    return project
+
+
+def _request(method: str, path: str, *, json_body: Optional[Dict] = None,
+             params: Optional[Dict] = None) -> Dict[str, Any]:
+    """Single HTTP call to the TPU API; raises ProvisionerError on 4xx/5xx."""
+    session = _get_session()
+    url = f'{_TPU_API}/{path}'
+    resp = session.request(method, url, json=json_body, params=params,
+                           timeout=60)
+    if resp.status_code == 404:
+        raise exceptions.FetchClusterInfoError(
+            exceptions.FetchClusterInfoError.Reason.HEAD)
+    if resp.status_code >= 400:
+        raise exceptions.ProvisionerError(
+            f'TPU API {method} {path} -> {resp.status_code}: '
+            f'{resp.text[:500]}')
+    return resp.json() if resp.text else {}
+
+
+# ---------------------------------------------------------------------------
+# Nodes
+# ---------------------------------------------------------------------------
+def create_node(project: str, zone: str, node_id: str,
+                accelerator_type: str, runtime_version: str,
+                *, topology: Optional[str] = None,
+                spot: bool = False, labels: Optional[Dict] = None,
+                ssh_pub_key: Optional[str] = None,
+                startup_script: Optional[str] = None,
+                data_disk_gb: Optional[int] = None) -> Dict[str, Any]:
+    parent = f'projects/{project}/locations/{zone}'
+    body: Dict[str, Any] = {
+        'runtimeVersion': runtime_version,
+        'labels': labels or {},
+        'networkConfig': {'enableExternalIps': True},
+    }
+    if topology:
+        body['acceleratorConfig'] = {
+            'type': _accel_config_type(accelerator_type),
+            'topology': topology,
+        }
+    else:
+        body['acceleratorType'] = accelerator_type
+    if spot:
+        body['schedulingConfig'] = {'preemptible': True, 'spot': True}
+    metadata = {}
+    if ssh_pub_key:
+        metadata['ssh-keys'] = f'skypilot:{ssh_pub_key}'
+    if startup_script:
+        metadata['startup-script'] = startup_script
+    if metadata:
+        body['metadata'] = metadata
+    return _request('POST', f'{parent}/nodes', json_body=body,
+                    params={'nodeId': node_id})
+
+
+def _accel_config_type(accelerator_type: str) -> str:
+    # 'v5litepod-16' -> 'V5LITE_POD'; 'v5p-128' -> 'V5P'; 'v4-8' -> 'V4'
+    prefix = accelerator_type.split('-')[0]
+    return {'v2': 'V2', 'v3': 'V3', 'v4': 'V4', 'v5litepod': 'V5LITE_POD',
+            'v5p': 'V5P', 'v6e': 'V6E'}.get(prefix, prefix.upper())
+
+
+def get_node(project: str, zone: str, node_id: str) -> Dict[str, Any]:
+    return _request(
+        'GET', f'projects/{project}/locations/{zone}/nodes/{node_id}')
+
+
+def list_nodes(project: str, zone: str) -> List[Dict[str, Any]]:
+    out = _request('GET', f'projects/{project}/locations/{zone}/nodes')
+    return out.get('nodes', [])
+
+
+def delete_node(project: str, zone: str, node_id: str) -> Dict[str, Any]:
+    return _request(
+        'DELETE', f'projects/{project}/locations/{zone}/nodes/{node_id}')
+
+
+def stop_node(project: str, zone: str, node_id: str) -> Dict[str, Any]:
+    return _request(
+        'POST',
+        f'projects/{project}/locations/{zone}/nodes/{node_id}:stop')
+
+
+def start_node(project: str, zone: str, node_id: str) -> Dict[str, Any]:
+    return _request(
+        'POST',
+        f'projects/{project}/locations/{zone}/nodes/{node_id}:start')
+
+
+# ---------------------------------------------------------------------------
+# Queued resources (spot + large pods)
+# ---------------------------------------------------------------------------
+def create_queued_resource(project: str, zone: str, qr_id: str,
+                           node_id: str, accelerator_type: str,
+                           runtime_version: str, *,
+                           spot: bool = False,
+                           topology: Optional[str] = None,
+                           ssh_pub_key: Optional[str] = None,
+                           valid_until_seconds: int = 3600
+                           ) -> Dict[str, Any]:
+    parent = f'projects/{project}/locations/{zone}'
+    node: Dict[str, Any] = {
+        'runtimeVersion': runtime_version,
+        'networkConfig': {'enableExternalIps': True},
+    }
+    if topology:
+        node['acceleratorConfig'] = {
+            'type': _accel_config_type(accelerator_type),
+            'topology': topology,
+        }
+    else:
+        node['acceleratorType'] = accelerator_type
+    if ssh_pub_key:
+        node['metadata'] = {'ssh-keys': f'skypilot:{ssh_pub_key}'}
+    body: Dict[str, Any] = {
+        'tpu': {'nodeSpec': [{'parent': parent, 'nodeId': node_id,
+                              'node': node}]},
+        'queueingPolicy': {
+            'validUntilDuration': {'seconds': valid_until_seconds},
+        },
+    }
+    if spot:
+        body['spot'] = {}
+    return _request('POST', f'{parent}/queuedResources', json_body=body,
+                    params={'queuedResourceId': qr_id})
+
+
+def get_queued_resource(project: str, zone: str,
+                        qr_id: str) -> Dict[str, Any]:
+    return _request(
+        'GET',
+        f'projects/{project}/locations/{zone}/queuedResources/{qr_id}')
+
+
+def delete_queued_resource(project: str, zone: str,
+                           qr_id: str) -> Dict[str, Any]:
+    return _request(
+        'DELETE',
+        f'projects/{project}/locations/{zone}/queuedResources/{qr_id}',
+        params={'force': 'true'})
+
+
+# ---------------------------------------------------------------------------
+# Waiting
+# ---------------------------------------------------------------------------
+def wait_node_state(project: str, zone: str, node_id: str,
+                    target_states=('READY',), timeout: float = 1800,
+                    poll: float = 10) -> Dict[str, Any]:
+    deadline = time.time() + timeout
+    while True:
+        node = get_node(project, zone, node_id)
+        state = node.get('state')
+        if state in target_states:
+            return node
+        if state in ('PREEMPTED', 'TERMINATED'):
+            raise exceptions.ProvisionerError(
+                f'TPU node {node_id} entered state {state}.')
+        if time.time() > deadline:
+            raise exceptions.ProvisionerError(
+                f'Timed out waiting for TPU node {node_id} '
+                f'(state={state}).')
+        time.sleep(poll)

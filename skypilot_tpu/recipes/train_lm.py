@@ -1,0 +1,173 @@
+"""Runnable LM-training recipe: the payload of the example task YAMLs.
+
+Consumes the gang-exec env contract (backends/task_codegen.py):
+`jax.distributed.initialize` bootstraps from JAX_COORDINATOR_ADDRESS /
+JAX_NUM_PROCESSES / JAX_PROCESS_ID, so `stpu launch` of this script on
+a multi-host TPU slice (or multislice) just works. Checkpoints go
+through parallel/checkpoints.py (async orbax, GCS-capable) — the
+managed-jobs preemption-recovery contract: on relaunch the script
+resumes from the latest step in --ckpt-dir.
+
+Usage (see examples/*.yaml):
+  python -m skypilot_tpu.recipes.train_lm --model gpt2-124m \
+      --steps 100 --seq 1024 --ckpt-dir gs://bucket/ckpts
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import time
+
+
+def _maybe_init_distributed() -> None:
+    num = int(os.environ.get('JAX_NUM_PROCESSES', '1'))
+    if num <= 1:
+        return
+    import jax
+    jax.distributed.initialize(
+        coordinator_address=os.environ['JAX_COORDINATOR_ADDRESS'],
+        num_processes=num,
+        process_id=int(os.environ['JAX_PROCESS_ID']))
+
+
+def _build_model(name: str, seq: int, remat: bool):
+    import jax.numpy as jnp
+    if name == 'gpt2-124m':
+        from skypilot_tpu.models.gpt import GPT, GPTConfig
+        cfg = GPTConfig.gpt2_124m(remat=remat)
+        return GPT(cfg), cfg.vocab_size, None
+    if name == 'tiny':
+        from skypilot_tpu.models.gpt import GPT, GPTConfig
+        cfg = GPTConfig.tiny(remat=remat)
+        return GPT(cfg), cfg.vocab_size, None
+    if name == 'llama3-8b':
+        from skypilot_tpu.models.llama import Llama, LlamaConfig
+        cfg = LlamaConfig.llama3_8b(max_seq_len=max(seq, 2048), remat=remat)
+        return Llama(cfg), cfg.vocab_size, None
+    if name == 'llama-tiny':
+        from skypilot_tpu.models.llama import Llama, LlamaConfig
+        cfg = LlamaConfig.tiny(remat=remat)
+        return Llama(cfg), cfg.vocab_size, None
+    if name == 'mixtral-8x7b':
+        from skypilot_tpu.models.mixtral import (Mixtral, MixtralConfig,
+                                                 moe_next_token_loss)
+        cfg = MixtralConfig.mixtral_8x7b(remat=remat)
+        return Mixtral(cfg), cfg.vocab_size, moe_next_token_loss
+    if name == 'mixtral-tiny':
+        from skypilot_tpu.models.mixtral import (Mixtral, MixtralConfig,
+                                                 moe_next_token_loss)
+        cfg = MixtralConfig.tiny(remat=remat)
+        return Mixtral(cfg), cfg.vocab_size, moe_next_token_loss
+    raise ValueError(f'unknown model {name!r}')
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--model', default='gpt2-124m')
+    parser.add_argument('--steps', type=int, default=100)
+    parser.add_argument('--seq', type=int, default=1024)
+    parser.add_argument('--global-batch', type=int, default=0,
+                        help='0 = 8 per device')
+    parser.add_argument('--data', default='synthetic',
+                        help='"synthetic" or a dir/glob of token .bin '
+                             'shards')
+    parser.add_argument('--ckpt-dir', default=None)
+    parser.add_argument('--ckpt-every', type=int, default=50)
+    parser.add_argument('--lr', type=float, default=3e-4)
+    parser.add_argument('--tensor', type=int, default=1,
+                        help='tensor-parallel mesh axis size')
+    parser.add_argument('--expert', type=int, default=1)
+    parser.add_argument('--seq-parallel', type=int, default=1,
+                        help='context-parallel mesh axis size '
+                             '(ring attention)')
+    parser.add_argument('--remat', action='store_true')
+    parser.add_argument('--log-every', type=int, default=10)
+    args = parser.parse_args()
+
+    _maybe_init_distributed()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from skypilot_tpu.parallel import mesh as mesh_lib
+    from skypilot_tpu.parallel.train import (ShardedTrainer,
+                                             default_optimizer, shard_batch)
+
+    n_dev = len(jax.devices())
+    proc_id = jax.process_index()
+    mesh_cfg = mesh_lib.MeshConfig.auto(n_dev, tensor=args.tensor,
+                                        expert=args.expert,
+                                        seq=args.seq_parallel)
+    mesh = mesh_lib.make_mesh(mesh_cfg)
+    if proc_id == 0:
+        print(f'devices={n_dev} {mesh_lib.mesh_summary(mesh)}', flush=True)
+
+    model, vocab_size, loss_fn = _build_model(args.model, args.seq,
+                                              args.remat)
+    batch = args.global_batch or 8 * n_dev
+    tx = default_optimizer(learning_rate=args.lr, warmup_steps=10,
+                           total_steps=max(args.steps, 20))
+    kwargs = {} if loss_fn is None else {'loss_fn': loss_fn}
+    trainer = ShardedTrainer(model, mesh, tx=tx, **kwargs)
+
+    example = jnp.zeros((batch, args.seq), jnp.int32)
+    state = trainer.init(jax.random.PRNGKey(0), example)
+    step_fn = trainer.make_train_step(example)
+
+    # Checkpoint resume (preemption recovery path).
+    mgr = None
+    if args.ckpt_dir:
+        from skypilot_tpu.parallel.checkpoints import CheckpointManager
+        mgr = CheckpointManager(args.ckpt_dir,
+                                save_interval_steps=args.ckpt_every)
+        latest = mgr.latest_step()
+        if latest is not None:
+            state = mgr.restore(state, latest)
+            print(f'resumed from checkpoint step {latest}', flush=True)
+
+    # Data.
+    loader = None
+    if args.data != 'synthetic':
+        import glob
+        paths = sorted(glob.glob(os.path.join(args.data, '*.bin'))
+                       if os.path.isdir(args.data) else glob.glob(args.data))
+        from skypilot_tpu.data.token_loader import TokenLoader
+        loader = TokenLoader(paths, batch=batch, seq=args.seq,
+                             rank=proc_id, world=jax.process_count())
+
+    rng = np.random.default_rng(0)
+
+    def next_tokens():
+        if loader is not None:
+            arr = loader.next_batch()[:, :-1].astype(np.int32)
+        else:
+            arr = rng.integers(0, vocab_size, (batch, args.seq),
+                               dtype=np.int32)
+        return shard_batch(jnp.asarray(arr), mesh)
+
+    start_step = int(state.step)
+    t0 = time.perf_counter()
+    window_tokens = 0
+    for step in range(start_step, args.steps):
+        state, loss = step_fn(state, next_tokens())
+        window_tokens += batch * args.seq
+        if mgr is not None:
+            mgr.save(step + 1, state)
+        if (step + 1) % args.log_every == 0 and proc_id == 0:
+            jax.block_until_ready(loss)
+            dt = time.perf_counter() - t0
+            print(f'step {step + 1}/{args.steps} '
+                  f'loss={float(loss):.4f} '
+                  f'tokens/s={window_tokens / dt:,.0f}', flush=True)
+            t0 = time.perf_counter()
+            window_tokens = 0
+    if mgr is not None:
+        mgr.save(args.steps, state, force=True)
+        mgr.wait_until_finished()
+        mgr.close()
+    if proc_id == 0:
+        print('training done', flush=True)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,184 @@
+"""GCP TPU provisioner against a fake TPU API.
+
+SURVEY §4 strategy: an in-memory tpu.googleapis.com emulating node
+lifecycle + multi-host slice topologies, so create/wait/query/
+get_cluster_info/terminate run without a cloud account.
+"""
+import re
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.gcp import instance as gcp_instance
+from skypilot_tpu.provision.gcp import tpu_api
+
+
+class FakeTpuService:
+    """Emulates the node/queuedResource endpoints of the TPU API."""
+
+    def __init__(self, hosts_per_node=2, fail_zones=()):
+        self.nodes = {}
+        self.queued = {}
+        self.hosts_per_node = hosts_per_node
+        self.fail_zones = set(fail_zones)
+
+    def request(self, method, path, json_body=None, params=None):
+        params = params or {}
+        m = re.match(r'projects/([^/]+)/locations/([^/]+)/(.*)', path)
+        assert m, path
+        _, zone, rest = m.groups()
+        if method == 'POST' and rest == 'nodes':
+            if zone in self.fail_zones:
+                raise exceptions.ProvisionerError(
+                    f'TPU API POST {path} -> 429: no capacity in {zone}')
+            name = params['nodeId']
+            self.nodes[(zone, name)] = self._new_node(zone, name, json_body)
+            return {'name': f'operations/create-{name}'}
+        if method == 'POST' and rest == 'queuedResources':
+            name = json_body['tpu']['nodeSpec'][0]['nodeId']
+            node = json_body['tpu']['nodeSpec'][0]['node']
+            self.nodes[(zone, name)] = self._new_node(zone, name, node)
+            self.queued[(zone, params['queuedResourceId'])] = {
+                'state': {'state': 'ACTIVE'}}
+            return {'name': f'operations/qr-{name}'}
+        if rest.startswith('nodes'):
+            parts = rest.split('/')
+            if len(parts) == 1 and method == 'GET':  # list
+                return {'nodes': [n for (z, _), n in self.nodes.items()
+                                  if z == zone]}
+            name = parts[1].split(':')[0]
+            node = self.nodes.get((zone, name))
+            if node is None:
+                raise exceptions.FetchClusterInfoError(
+                    exceptions.FetchClusterInfoError.Reason.HEAD)
+            if method == 'GET':
+                # Nodes become READY on second poll.
+                if node['state'] == 'CREATING':
+                    node['_polls'] = node.get('_polls', 0) + 1
+                    if node['_polls'] >= 2:
+                        node['state'] = 'READY'
+                return node
+            if rest.endswith(':stop'):
+                node['state'] = 'STOPPED'
+                return {}
+            if rest.endswith(':start'):
+                node['state'] = 'READY'
+                return {}
+            if method == 'DELETE':
+                del self.nodes[(zone, name)]
+                return {}
+        if rest.startswith('queuedResources'):
+            key = (zone, rest.split('/')[1])
+            if method == 'DELETE':
+                self.queued.pop(key, None)
+                return {}
+            if key not in self.queued:
+                raise exceptions.FetchClusterInfoError(
+                    exceptions.FetchClusterInfoError.Reason.HEAD)
+            return self.queued[key]
+        raise AssertionError(f'unhandled {method} {path}')
+
+    def _new_node(self, zone, name, body):
+        endpoints = []
+        for h in range(self.hosts_per_node):
+            endpoints.append({
+                'ipAddress': f'10.0.{len(self.nodes)}.{h + 2}',
+                'accessConfig': {'externalIp': f'34.1.{len(self.nodes)}.{h + 2}'},
+            })
+        return {
+            'name': f'projects/p/locations/{zone}/nodes/{name}',
+            'state': 'CREATING',
+            'acceleratorType': body.get('acceleratorType'),
+            'runtimeVersion': body.get('runtimeVersion'),
+            'networkEndpoints': endpoints,
+            'metadata': body.get('metadata', {}),
+        }
+
+
+@pytest.fixture()
+def fake_api(monkeypatch):
+    fake = FakeTpuService(hosts_per_node=2)
+    monkeypatch.setattr(tpu_api, '_request',
+                        lambda method, path, json_body=None, params=None:
+                        fake.request(method, path, json_body, params))
+    monkeypatch.setattr(gcp_instance, '_project', lambda *a, **k: 'p')
+    monkeypatch.setattr(gcp_instance, '_ssh_pub_key',
+                        lambda: 'ssh-ed25519 AAAA test')
+    monkeypatch.setattr(tpu_api, 'wait_node_state',
+                        lambda p, z, n, **kw: fake.request(
+                            'GET', f'projects/{p}/locations/{z}/nodes/{n}')
+                        and fake.request(
+                            'GET', f'projects/{p}/locations/{z}/nodes/{n}'))
+    return fake
+
+
+def _config(zone='us-east5-a', count=1, spot=False, qr=False):
+    return common.ProvisionConfig(
+        provider_config={
+            'zone': zone,
+            'tpu_vm': True,
+            'tpu_accelerator_type': 'v5litepod-16',
+            'tpu_topology': '4x4',
+            'runtime_version': 'v2-alpha-tpuv5-lite',
+            'use_spot': spot,
+            'tpu_use_queued_resources': qr,
+            'num_nodes': count,
+        },
+        authentication_config={}, count=count, tags={})
+
+
+def test_create_single_slice(fake_api):
+    record = gcp_instance.run_instances('us-east5', 'c1', _config())
+    assert record.created_instance_ids == ['c1']
+    assert record.head_instance_id == 'c1'
+    gcp_instance.wait_instances('us-east5', 'c1',
+                                provider_config=_config().provider_config)
+    info = gcp_instance.get_cluster_info(
+        'us-east5', 'c1', _config().provider_config)
+    # One v5e-16 slice = 2 hosts, ranks 0/1.
+    assert info.num_instances == 2
+    ranks = [(i.node_rank, i.host_rank) for i in info.sorted_instances()]
+    assert ranks == [(0, 0), (0, 1)]
+    assert info.get_head_instance().external_ip.startswith('34.')
+
+
+def test_multislice_creates_n_nodes(fake_api):
+    record = gcp_instance.run_instances('us-east5', 'c2',
+                                        _config(count=2))
+    assert record.created_instance_ids == ['c2-0', 'c2-1']
+    info = gcp_instance.get_cluster_info(
+        'us-east5', 'c2', _config(count=2).provider_config)
+    assert info.num_instances == 4  # 2 slices x 2 hosts
+    node_ranks = {i.node_rank for i in info.instances}
+    assert node_ranks == {0, 1}
+
+
+def test_spot_uses_queued_resources(fake_api):
+    gcp_instance.run_instances('us-east5', 'c3',
+                               _config(spot=True, qr=True))
+    assert ('us-east5-a', 'c3-qr') in fake_api.queued
+    # terminate removes both QR and node
+    gcp_instance.terminate_instances(
+        'c3', _config(spot=True, qr=True).provider_config)
+    assert not fake_api.nodes
+    assert not fake_api.queued
+
+
+def test_stop_resume_and_query(fake_api):
+    cfg = _config()
+    gcp_instance.run_instances('us-east5', 'c4', cfg)
+    gcp_instance.stop_instances('c4', cfg.provider_config)
+    statuses = gcp_instance.query_instances('c4', cfg.provider_config)
+    assert statuses == {'c4': 'stopped'}
+    record = gcp_instance.run_instances('us-east5', 'c4', cfg)
+    assert record.resumed_instance_ids == ['c4']
+    statuses = gcp_instance.query_instances('c4', cfg.provider_config)
+    assert statuses == {'c4': 'running'}
+
+
+def test_capacity_error_classified(fake_api):
+    fake_api.fail_zones.add('us-central2-b')
+    with pytest.raises(exceptions.ProvisionerError, match='no capacity'):
+        gcp_instance.run_instances('us-central2', 'c5',
+                                   _config(zone='us-central2-b'))
