@@ -140,10 +140,13 @@ class RetryingProvisioner:
                 if to_provision.zone is not None and zones and \
                         zones[0].name != to_provision.zone:
                     continue
+                deploy_vars = cloud.make_deploy_resources_variables(
+                    to_provision, cluster_name_on_cloud, region, zones,
+                    task.num_nodes)
                 try:
                     record = self._provision_once(
                         task, to_provision, cluster_name_on_cloud, region,
-                        zones)
+                        zones, deploy_vars)
                     resolved = to_provision.copy(
                         infra=f'{cloud.canonical_name()}/{region.name}'
                               f'/{zones[0].name if zones else "*"}')
@@ -155,12 +158,13 @@ class RetryingProvisioner:
                         f'{common_utils.format_exception(e)}; '
                         'trying next location.')
                     self.failover_history.append(e)
-                    # Best-effort cleanup of partial creations.
+                    # Best-effort cleanup of partial creations (deploy
+                    # vars carry the zone the attempt targeted).
                     try:
                         provider = cloud.provisioner_module()
                         provision_lib.terminate_instances(
                             provider, cluster_name_on_cloud,
-                            provider_config=None)
+                            provider_config=deploy_vars)
                     except Exception:  # pylint: disable=broad-except
                         pass
                     continue
@@ -185,13 +189,11 @@ class RetryingProvisioner:
                         to_provision: 'resources_lib.Resources',
                         cluster_name_on_cloud: str,
                         region: cloud_lib.Region,
-                        zones: Optional[List[cloud_lib.Zone]]
+                        zones: Optional[List[cloud_lib.Zone]],
+                        deploy_vars: Dict[str, Any]
                         ) -> provision_common.ProvisionRecord:
         cloud = to_provision.cloud
         assert cloud is not None
-        deploy_vars = cloud.make_deploy_resources_variables(
-            to_provision, cluster_name_on_cloud, region, zones,
-            task.num_nodes)
         config = provision_common.ProvisionConfig(
             provider_config=deploy_vars,
             authentication_config={},
@@ -202,8 +204,11 @@ class RetryingProvisioner:
         provider = cloud.provisioner_module()
         record = provision_lib.run_instances(provider, region.name,
                                              cluster_name_on_cloud, config)
+        if not record.provider_config:
+            record.provider_config = deploy_vars
         provision_lib.wait_instances(provider, region.name,
-                                     cluster_name_on_cloud, 'running')
+                                     cluster_name_on_cloud, 'running',
+                                     provider_config=record.provider_config)
         if to_provision.ports:
             provision_lib.open_ports(provider, cluster_name_on_cloud,
                                      to_provision.ports, deploy_vars)
@@ -259,7 +264,7 @@ class TpuVmBackend(backend_lib.Backend[TpuVmResourceHandle]):
         provider = cloud.provisioner_module()
         cluster_info = provision_lib.get_cluster_info(
             provider, region.name, cluster_name_on_cloud,
-            record.__dict__.get('provider_config'))
+            record.provider_config)
         handle = TpuVmResourceHandle(
             cluster_name=cluster_name,
             cluster_name_on_cloud=cluster_name_on_cloud,

@@ -426,15 +426,6 @@ def api_cancel(request_id) -> None:
         click.echo('Request already finished.')
 
 
-def main() -> None:
-    try:
-        cli()
-    except exceptions.SkyError as e:
-        _err(str(e))
-
-
-if __name__ == '__main__':
-    main()
 
 
 # ---------------------------------------------------------------------------
@@ -588,3 +579,108 @@ def serve_down_cmd(service_names, yes, purge) -> None:
     for s in service_names:
         sdk.get(sdk.serve_down(s, purge=purge))
         click.echo(f'Service {s} torn down.')
+
+
+# ---------------------------------------------------------------------------
+# recipes / volumes / debug
+# ---------------------------------------------------------------------------
+@cli.group()
+def recipes() -> None:
+    """Curated runnable recipes (bundled example YAMLs)."""
+
+
+@recipes.command(name='list')
+def recipes_list() -> None:
+    from skypilot_tpu.recipes import core as recipes_core
+    from rich.console import Console
+    from rich.table import Table
+    table = Table(box=None)
+    for col in ('NAME', 'ACCELERATOR', 'DESCRIPTION'):
+        table.add_column(col)
+    for r in recipes_core.list_recipes():
+        table.add_row(r['name'], r['accelerator'], r['description'][:70])
+    Console().print(table)
+
+
+@recipes.command(name='show')
+@click.argument('name')
+def recipes_show(name) -> None:
+    from skypilot_tpu.recipes import core as recipes_core
+    try:
+        path = recipes_core.get_recipe_path(name)
+    except FileNotFoundError as e:
+        _err(str(e))
+    with open(path, 'r', encoding='utf-8') as f:
+        click.echo(f.read())
+
+
+@cli.group()
+def volumes() -> None:
+    """Persistent volumes."""
+
+
+@volumes.command(name='apply')
+@click.argument('name')
+@click.option('--size', type=int, required=True, help='Size in GB.')
+@click.option('--infra', default=None)
+@click.option('--type', 'volume_type', default='pd-balanced')
+def volumes_apply(name, size, infra, volume_type) -> None:
+    from skypilot_tpu.volumes import core as volumes_core
+    cfg = volumes_core.apply(name, size, infra, volume_type)
+    click.echo(f'Volume {name} ({cfg["size_gb"]}GB {cfg["type"]}) ready.')
+
+
+@volumes.command(name='ls')
+def volumes_ls() -> None:
+    from skypilot_tpu.volumes import core as volumes_core
+    from rich.console import Console
+    from rich.table import Table
+    table = Table(box=None)
+    for col in ('NAME', 'SIZE', 'TYPE', 'INFRA', 'STATUS'):
+        table.add_column(col)
+    for v in volumes_core.ls():
+        table.add_row(v['name'], f"{v['size_gb']}GB", v['type'],
+                      v['infra'], v['status'])
+    Console().print(table)
+
+
+@volumes.command(name='delete')
+@click.argument('name')
+@click.option('--yes', '-y', is_flag=True, default=False)
+def volumes_delete(name, yes) -> None:
+    if not yes:
+        click.confirm(f'Delete volume {name}?', abort=True)
+    from skypilot_tpu.volumes import core as volumes_core
+    volumes_core.delete(name)
+    click.echo(f'Volume {name} deleted.')
+
+
+@cli.command(name='debug-dump')
+@click.option('--output', '-o', default='skypilot-debug.tar.gz')
+def debug_dump(output) -> None:
+    """Bundle local state + logs for a bug report (secrets redacted:
+    the state DBs carry no credential material)."""
+    import tarfile
+    from skypilot_tpu import constants as const
+    home = const.sky_home()
+    if not os.path.isdir(home):
+        _err(f'No state at {home}.')
+    with tarfile.open(output, 'w:gz') as tar:
+        for sub in ('state.db', 'managed_jobs.db', 'serve.db',
+                    'api_server/requests.db', 'api_server/server.log',
+                    'managed_jobs_logs', 'serve_logs', 'usage'):
+            path = os.path.join(home, sub)
+            if os.path.exists(path):
+                tar.add(path, arcname=sub)
+    click.echo(f'Wrote {output}.')
+
+
+def main() -> None:
+    try:
+        cli()
+    except exceptions.SkyError as e:
+        _err(str(e))
+
+
+if __name__ == '__main__':
+    main()

@@ -28,6 +28,9 @@ class ProvisionRecord:
     head_instance_id: str
     created_instance_ids: List[str]
     resumed_instance_ids: List[str] = dataclasses.field(default_factory=list)
+    # Deploy variables the instance was created with; threaded back into
+    # wait/query/terminate/get_cluster_info calls.
+    provider_config: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     def is_instance_just_booted(self, instance_id: str) -> bool:
         return (instance_id in self.created_instance_ids or

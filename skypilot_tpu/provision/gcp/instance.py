@@ -70,7 +70,18 @@ def run_instances(region: str, cluster_name_on_cloud: str,
             if state == 'STOPPED':
                 tpu_api.start_node(project, zone, name)
                 resumed.append(name)
-            continue  # exists
+                continue
+            if state in ('PREEMPTED', 'TERMINATED', 'FAILED'):
+                # Dead node with the name we need: replace it.
+                try:
+                    tpu_api.delete_queued_resource(project, zone,
+                                                   f'{name}-qr')
+                except (exceptions.ProvisionerError,
+                        exceptions.FetchClusterInfoError):
+                    pass
+                tpu_api.delete_node(project, zone, name)
+            else:
+                continue  # exists and healthy/creating
         except exceptions.FetchClusterInfoError:
             pass  # create below
         if use_qr:
@@ -96,6 +107,7 @@ def run_instances(region: str, cluster_name_on_cloud: str,
         head_instance_id=names[0],
         created_instance_ids=created,
         resumed_instance_ids=resumed,
+        provider_config=dict(pc),
     )
 
 
@@ -105,13 +117,15 @@ def wait_instances(region: str, cluster_name_on_cloud: str,
     del region, state
     pc = provider_config or {}
     zone = pc.get('zone')
-    project = _project(pc)
     if zone is None:
-        # Zone travels in provider_config; router calls pass it.
-        return
+        raise exceptions.ProvisionerError(
+            'wait_instances needs provider_config with a zone.')
+    project = _project(pc)
     count = int(pc.get('num_nodes', 1))
     for name in _node_names(cluster_name_on_cloud, count):
-        tpu_api.wait_node_state(project, zone, name)
+        qr_id = (f'{name}-qr'
+                 if pc.get('tpu_use_queued_resources') else None)
+        tpu_api.wait_node_state(project, zone, name, qr_id=qr_id)
 
 
 def _iter_cluster_nodes(project: str, zone: str,
@@ -158,6 +172,9 @@ def terminate_instances(cluster_name_on_cloud: str,
             pass
 
 
+# Unknown/transient states (REPAIRING, HIDING, ...) map to 'pending'
+# so a live-but-in-maintenance cluster is never reported as terminated.
+_TERMINAL_STATES = {'PREEMPTED', 'TERMINATED', 'DELETING', 'FAILED'}
 _STATE_MAP = {
     'READY': 'running',
     'CREATING': 'pending',
@@ -165,9 +182,6 @@ _STATE_MAP = {
     'RESTARTING': 'pending',
     'STOPPED': 'stopped',
     'STOPPING': 'stopping',
-    'PREEMPTED': None,
-    'TERMINATED': None,
-    'DELETING': None,
 }
 
 
@@ -179,7 +193,9 @@ def query_instances(cluster_name_on_cloud: str,
     zone, project = pc['zone'], _project(pc)
     out: Dict[str, Optional[str]] = {}
     for node in _iter_cluster_nodes(project, zone, cluster_name_on_cloud):
-        status = _STATE_MAP.get(node.get('state'), None)
+        state = node.get('state')
+        status = (None if state in _TERMINAL_STATES
+                  else _STATE_MAP.get(state, 'pending'))
         if non_terminated_only and status is None:
             continue
         out[node['_short_name']] = status

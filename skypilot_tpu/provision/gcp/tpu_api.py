@@ -190,16 +190,35 @@ def delete_queued_resource(project: str, zone: str,
 # ---------------------------------------------------------------------------
 def wait_node_state(project: str, zone: str, node_id: str,
                     target_states=('READY',), timeout: float = 1800,
-                    poll: float = 10) -> Dict[str, Any]:
+                    poll: float = 10,
+                    qr_id: Optional[str] = None) -> Dict[str, Any]:
+    """Poll until the node reaches a target state.
+
+    A 404 is NOT fatal: a queued resource may not have materialized the
+    node yet — keep polling (and fail fast if the QR itself failed).
+    """
     deadline = time.time() + timeout
     while True:
-        node = get_node(project, zone, node_id)
-        state = node.get('state')
-        if state in target_states:
-            return node
-        if state in ('PREEMPTED', 'TERMINATED'):
-            raise exceptions.ProvisionerError(
-                f'TPU node {node_id} entered state {state}.')
+        state = None
+        try:
+            node = get_node(project, zone, node_id)
+            state = node.get('state')
+            if state in target_states:
+                return node
+            if state in ('PREEMPTED', 'TERMINATED', 'FAILED'):
+                raise exceptions.ProvisionerError(
+                    f'TPU node {node_id} entered state {state}.')
+        except exceptions.FetchClusterInfoError:
+            if qr_id is not None:
+                try:
+                    qr = get_queued_resource(project, zone, qr_id)
+                    qr_state = (qr.get('state') or {}).get('state')
+                    if qr_state in ('FAILED', 'SUSPENDED'):
+                        raise exceptions.ProvisionerError(
+                            f'Queued resource {qr_id} entered state '
+                            f'{qr_state}.')
+                except exceptions.FetchClusterInfoError:
+                    pass
         if time.time() > deadline:
             raise exceptions.ProvisionerError(
                 f'Timed out waiting for TPU node {node_id} '

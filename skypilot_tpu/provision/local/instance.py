@@ -136,8 +136,9 @@ def run_instances(region: str, cluster_name_on_cloud: str,
 
 
 def wait_instances(region: str, cluster_name_on_cloud: str,
-                   state: Optional[str] = None) -> None:
-    del region, state  # agents start instantly; health checked later
+                   state: Optional[str] = None,
+                   provider_config: Optional[Dict[str, Any]] = None) -> None:
+    del region, state, provider_config  # agents start instantly
 
 
 def _kill_agents(meta: Dict[str, Any]) -> None:

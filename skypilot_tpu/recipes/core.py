@@ -1,0 +1,53 @@
+"""Recipe registry: curated runnable task YAMLs.
+
+Reference: sky/recipes/core.py (`sky recipes`). Recipes are the
+bundled examples/ YAMLs; `stpu recipes list|show` browses them.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List
+
+import yaml
+
+_EXAMPLES_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), 'examples')
+
+
+def list_recipes() -> List[Dict[str, str]]:
+    out = []
+    if not os.path.isdir(_EXAMPLES_DIR):
+        return out
+    for fname in sorted(os.listdir(_EXAMPLES_DIR)):
+        if not fname.endswith(('.yaml', '.yml')):
+            continue
+        path = os.path.join(_EXAMPLES_DIR, fname)
+        description = ''
+        with open(path, 'r', encoding='utf-8') as f:
+            for line in f:
+                if line.startswith('#'):
+                    description = line.lstrip('# ').strip()
+                    break
+        try:
+            with open(path, 'r', encoding='utf-8') as f:
+                config = yaml.safe_load(f)
+            accelerator = ((config.get('resources') or {})
+                           .get('accelerators', '-'))
+        except yaml.YAMLError:
+            accelerator = '?'
+        out.append({
+            'name': fname.rsplit('.', 1)[0],
+            'path': path,
+            'description': description,
+            'accelerator': str(accelerator),
+        })
+    return out
+
+
+def get_recipe_path(name: str) -> str:
+    for recipe in list_recipes():
+        if recipe['name'] == name:
+            return recipe['path']
+    raise FileNotFoundError(
+        f'Recipe {name!r} not found; `stpu recipes list`.')

@@ -39,13 +39,20 @@ class Autoscaler:
     @classmethod
     def make(cls, spec: 'spec_lib.SkyServiceSpec') -> 'Autoscaler':
         if spec.autoscaling_enabled:
-            return RequestRateAutoscaler(spec)
+            chosen = AUTOSCALER_REGISTRY.get(
+                getattr(spec, 'autoscaler', 'request_rate'))
+            if chosen is None:
+                chosen = RequestRateAutoscaler
+            return chosen(spec)
         return Autoscaler(spec)
 
     def collect_request_information(self, num_requests: int,
                                     timestamp: Optional[float] = None
                                     ) -> None:
-        pass
+        """Called on request *arrival*."""
+
+    def request_done(self, count: int = 1) -> None:
+        """Called on request *completion* (queue-based scalers use it)."""
 
     def evaluate(self, num_ready: int,
                  num_launching: int) -> AutoscalerDecision:
@@ -126,6 +133,62 @@ class RequestRateAutoscaler(Autoscaler):
             self._upscale_candidate_since = None
             self._downscale_candidate_since = None
 
+        if total < self.target_num_replicas:
+            return AutoscalerDecision(AutoscalerDecisionOperator.SCALE_UP,
+                                      self.target_num_replicas)
+        if total > self.target_num_replicas:
+            return AutoscalerDecision(AutoscalerDecisionOperator.SCALE_DOWN,
+                                      self.target_num_replicas)
+        return AutoscalerDecision(AutoscalerDecisionOperator.NO_OP, total)
+
+
+@AUTOSCALER_REGISTRY.register(name='queue_length')
+class QueueLengthAutoscaler(Autoscaler):
+    """Scale on in-flight (queued) requests per ready replica.
+
+    Reference: autoscalers.py:1094 — better signal than QPS for
+    long-generation LLM serving where request cost varies wildly.
+    """
+
+    def __init__(self, spec: 'spec_lib.SkyServiceSpec',
+                 target_queue_per_replica: float = 4.0) -> None:
+        super().__init__(spec)
+        self.target_queue_per_replica = target_queue_per_replica
+        self._in_flight = 0
+        self._upscale_since: Optional[float] = None
+        self._downscale_since: Optional[float] = None
+
+    def collect_request_information(self, num_requests: int,
+                                    timestamp: Optional[float] = None
+                                    ) -> None:
+        del timestamp
+        self._in_flight += num_requests
+
+    def request_done(self, count: int = 1) -> None:
+        self._in_flight = max(0, self._in_flight - count)
+
+    def evaluate(self, num_ready: int, num_launching: int,
+                 now: Optional[float] = None) -> AutoscalerDecision:
+        now = now if now is not None else time.time()
+        desired = math.ceil(self._in_flight / self.target_queue_per_replica)
+        desired = max(self.spec.min_replicas,
+                      min(self.spec.max_replicas, desired))
+        total = num_ready + num_launching
+        if desired > self.target_num_replicas:
+            self._downscale_since = None
+            self._upscale_since = self._upscale_since or now
+            if now - self._upscale_since >= self.spec.upscale_delay_seconds:
+                self.target_num_replicas = desired
+                self._upscale_since = None
+        elif desired < self.target_num_replicas:
+            self._upscale_since = None
+            self._downscale_since = self._downscale_since or now
+            if now - self._downscale_since >= \
+                    self.spec.downscale_delay_seconds:
+                self.target_num_replicas = desired
+                self._downscale_since = None
+        else:
+            self._upscale_since = self._downscale_since = None
         if total < self.target_num_replicas:
             return AutoscalerDecision(AutoscalerDecisionOperator.SCALE_UP,
                                       self.target_num_replicas)

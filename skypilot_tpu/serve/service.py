@@ -268,6 +268,7 @@ class ServeController:
                     {'error': f'upstream {replica}: {e}'}, status=502)
             finally:
                 controller.policy.request_done(replica)
+                controller.autoscaler.request_done()
 
         app = web.Application()
         app.router.add_route('*', '/{tail:.*}', proxy)

@@ -23,7 +23,9 @@ class SkyServiceSpec:
                  upscale_delay_seconds: int = 60,
                  downscale_delay_seconds: int = 120,
                  port: Optional[int] = None,
-                 load_balancing_policy: str = 'round_robin') -> None:
+                 load_balancing_policy: str = 'round_robin',
+                 autoscaler: str = 'request_rate') -> None:
+        self.autoscaler = autoscaler
         if not readiness_path.startswith('/'):
             raise exceptions.InvalidTaskYAMLError(
                 f'readiness path must start with /: {readiness_path!r}')
@@ -96,6 +98,8 @@ class SkyServiceSpec:
         if 'load_balancing_policy' in config:
             kwargs['load_balancing_policy'] = config.pop(
                 'load_balancing_policy')
+        if 'autoscaler' in config:
+            kwargs['autoscaler'] = str(config.pop('autoscaler')).lower()
         if config:
             raise exceptions.InvalidTaskYAMLError(
                 f'Unknown service fields: {sorted(config)}')
@@ -126,4 +130,6 @@ class SkyServiceSpec:
             out['port'] = self.port
         if self.load_balancing_policy != 'round_robin':
             out['load_balancing_policy'] = self.load_balancing_policy
+        if self.autoscaler != 'request_rate':
+            out['autoscaler'] = self.autoscaler
         return out

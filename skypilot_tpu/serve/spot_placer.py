@@ -1,0 +1,71 @@
+"""Spot placer: choose spot-replica locations from preemption history.
+
+Reference: sky/serve/spot_placer.py — DynamicFallbackSpotPlacer (:254)
+tracks per-(cloud, region, zone) preemption events and steers new spot
+replicas toward locations that have not recently preempted, falling
+back to on-demand when every candidate is hot.
+"""
+from __future__ import annotations
+
+import collections
+import time
+from typing import Dict, List, Optional, Tuple
+
+Location = Tuple[str, str, Optional[str]]  # (cloud, region, zone)
+
+_PREEMPTION_COOLDOWN_SECONDS = 30 * 60
+
+
+class SpotPlacer:
+
+    def __init__(self, candidates: List[Location]) -> None:
+        assert candidates, 'need at least one candidate location'
+        self.candidates = list(candidates)
+
+    def select(self) -> Location:
+        raise NotImplementedError
+
+    def handle_preemption(self, location: Location) -> None:
+        pass
+
+    def handle_active(self, location: Location) -> None:
+        pass
+
+
+class DynamicFallbackSpotPlacer(SpotPlacer):
+    """Prefer locations with no recent preemptions; round-robin among
+    equally-cold ones; report when all are hot (caller falls back to
+    on-demand)."""
+
+    def __init__(self, candidates: List[Location]) -> None:
+        super().__init__(candidates)
+        self._last_preempted: Dict[Location, float] = {}
+        self._active_counts: Dict[Location, int] = collections.defaultdict(
+            int)
+
+    def _is_cold(self, location: Location, now: float) -> bool:
+        last = self._last_preempted.get(location)
+        return last is None or now - last > _PREEMPTION_COOLDOWN_SECONDS
+
+    def select(self, now: Optional[float] = None) -> Location:
+        now = now if now is not None else time.time()
+        cold = [c for c in self.candidates if self._is_cold(c, now)]
+        pool = cold or self.candidates
+        # Spread active replicas: fewest active first, then least
+        # recently preempted.
+        choice = min(pool, key=lambda c: (
+            self._active_counts[c], self._last_preempted.get(c, 0.0)))
+        return choice
+
+    def all_hot(self, now: Optional[float] = None) -> bool:
+        """True when every candidate preempted recently → use on-demand."""
+        now = now if now is not None else time.time()
+        return not any(self._is_cold(c, now) for c in self.candidates)
+
+    def handle_preemption(self, location: Location) -> None:
+        self._last_preempted[location] = time.time()
+        self._active_counts[location] = max(
+            0, self._active_counts[location] - 1)
+
+    def handle_active(self, location: Location) -> None:
+        self._active_counts[location] += 1

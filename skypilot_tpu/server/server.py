@@ -157,6 +157,45 @@ async def api_health(request: web.Request) -> web.Response:
     })
 
 
+_SERVER_START_TIME = None  # set in run()
+
+
+async def api_metrics(request: web.Request) -> web.Response:
+    """Prometheus-format metrics (reference: sky/server/metrics.py —
+    per-request counters + process RSS gauges)."""
+    del request
+    import time as _time
+    import psutil
+    lines = [
+        '# TYPE skypilot_requests_total counter',
+    ]
+    counts: Dict[str, int] = {}
+    for row in executor.list_requests(limit=10000):
+        counts[row['status']] = counts.get(row['status'], 0) + 1
+    for status, count in sorted(counts.items()):
+        lines.append(
+            f'skypilot_requests_total{{status="{status.lower()}"}} {count}')
+    proc = psutil.Process()
+    rss = proc.memory_info().rss
+    lines.append('# TYPE skypilot_server_rss_bytes gauge')
+    lines.append(f'skypilot_server_rss_bytes {rss}')
+    children_rss = 0
+    for child in proc.children(recursive=True):
+        try:
+            children_rss += child.memory_info().rss
+        except (psutil.NoSuchProcess, psutil.AccessDenied):
+            pass  # worker exited between snapshot and read
+    lines.append('# TYPE skypilot_workers_rss_bytes gauge')
+    lines.append(f'skypilot_workers_rss_bytes {children_rss}')
+    if _SERVER_START_TIME is not None:
+        lines.append('# TYPE skypilot_server_uptime_seconds gauge')
+        lines.append(
+            f'skypilot_server_uptime_seconds '
+            f'{_time.time() - _SERVER_START_TIME:.0f}')
+    return web.Response(text='\n'.join(lines) + '\n',
+                        content_type='text/plain')
+
+
 async def cluster_job_logs(request: web.Request) -> web.StreamResponse:
     """Proxy job logs from a cluster's head agent (keeps clients thin)."""
     from skypilot_tpu import global_state
@@ -213,6 +252,7 @@ def create_app() -> web.Application:
     app.router.add_post('/api/cancel', api_cancel)
     app.router.add_get('/api/status', api_status)
     app.router.add_get('/api/health', api_health)
+    app.router.add_get('/api/metrics', api_metrics)
     app.router.add_get('/logs', cluster_job_logs)
     # Managed jobs + serve route groups:
     try:
@@ -230,6 +270,9 @@ def create_app() -> web.Application:
 
 def run(host: str = '127.0.0.1',
         port: int = constants.API_SERVER_PORT) -> None:
+    global _SERVER_START_TIME
+    import time as _time
+    _SERVER_START_TIME = _time.time()
     worker_loop = executor.RequestWorkerLoop()
     worker_loop.start()
     app = create_app()

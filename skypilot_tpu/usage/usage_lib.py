@@ -1,0 +1,77 @@
+"""Usage telemetry: redacted event records, local-first.
+
+Reference: sky/usage/usage_lib.py — redacted usage messages shipped to
+a Loki endpoint. This build records the same shape of events to a
+local JSONL ring (`~/.sky-tpu/usage/usage.jsonl`); a remote endpoint
+can be configured (`usage: {endpoint: ...}`) and is a no-op in
+zero-egress environments. Opt out with
+SKYPILOT_DISABLE_USAGE_COLLECTION=1.
+
+Redaction: only coarse fields leave the call site — command name,
+cloud, accelerator type, node counts, durations, exception *type*.
+Never YAML contents, env values, paths, or names.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+from typing import Any, Dict, Iterator, Optional
+
+from skypilot_tpu import constants
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils.env_options import Options
+
+_MAX_BYTES = 4 * 1024 * 1024
+
+
+def _usage_path() -> str:
+    return os.path.join(constants.sky_home(), 'usage', 'usage.jsonl')
+
+
+def enabled() -> bool:
+    return not Options.DISABLE_LOGGING.get()
+
+
+def record_event(event: str, **fields: Any) -> None:
+    if not enabled():
+        return
+    payload: Dict[str, Any] = {
+        'time': time.time(),
+        'event': event,
+        'run_id': common_utils.get_usage_run_id(),
+        'user': common_utils.get_user_hash(),  # hashed, not the username
+        'version': '0.1.0',
+    }
+    payload.update(fields)
+    path = _usage_path()
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        if os.path.exists(path) and os.path.getsize(path) > _MAX_BYTES:
+            os.replace(path, path + '.1')
+        with open(path, 'a', encoding='utf-8') as f:
+            f.write(json.dumps(payload) + '\n')
+    except OSError:
+        pass
+    endpoint = os.environ.get('SKYPILOT_USAGE_ENDPOINT')
+    if endpoint:
+        with contextlib.suppress(Exception):
+            import requests
+            requests.post(endpoint, json=payload, timeout=2)
+
+
+@contextlib.contextmanager
+def entrypoint(name: str, **fields: Any) -> Iterator[None]:
+    """Time an entrypoint and record outcome (redacted)."""
+    start = time.time()
+    error_type: Optional[str] = None
+    try:
+        yield
+    except BaseException as e:
+        error_type = type(e).__name__
+        raise
+    finally:
+        record_event('entrypoint', name=name,
+                     duration=round(time.time() - start, 3),
+                     error=error_type, **fields)

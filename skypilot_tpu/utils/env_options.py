@@ -1,0 +1,20 @@
+"""Environment-variable toggles (reference: sky/utils/env_options.py)."""
+from __future__ import annotations
+
+import enum
+import os
+
+
+class Options(enum.Enum):
+    IS_DEVELOPER = 'SKYPILOT_DEV'
+    SHOW_DEBUG_INFO = 'SKYPILOT_DEBUG'
+    DISABLE_LOGGING = 'SKYPILOT_DISABLE_USAGE_COLLECTION'
+    MINIMIZE_LOGGING = 'SKYPILOT_MINIMIZE_LOGGING'
+    SUPPRESS_SENSITIVE_LOG = 'SKYPILOT_SUPPRESS_SENSITIVE_LOG'
+
+    def get(self) -> bool:
+        return os.environ.get(self.value, 'False').lower() in (
+            '1', 'true', 'yes')
+
+    def __bool__(self) -> bool:
+        return self.get()

@@ -1,0 +1,53 @@
+"""Volumes: named persistent disks as first-class objects.
+
+Reference: sky/volumes/ — network/instance volumes (k8s PVC, GCP PD)
+with CRUD via the API server. Round-1 scope: registry CRUD + GCP PD
+deploy-variable plumbing; actual disk attach lands with the GCE VM
+path.
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_state
+
+
+def apply(name: str, size_gb: int, infra: Optional[str] = None,
+          volume_type: str = 'pd-balanced') -> Dict[str, Any]:
+    config = {
+        'name': name,
+        'size_gb': int(size_gb),
+        'infra': infra or 'gcp',
+        'type': volume_type,
+        'created_at': time.time(),
+    }
+    with global_state._db().conn() as conn:  # pylint: disable=protected-access
+        conn.execute(
+            'INSERT INTO volumes (name, launched_at, config, status) '
+            'VALUES (?,?,?,?) ON CONFLICT(name) DO UPDATE SET '
+            'config=excluded.config',
+            (name, int(time.time()), json.dumps(config), 'READY'))
+    return config
+
+
+def ls() -> List[Dict[str, Any]]:
+    rows = global_state._db().query(  # pylint: disable=protected-access
+        'SELECT * FROM volumes ORDER BY name')
+    out = []
+    for r in rows:
+        cfg = json.loads(r['config'] or '{}')
+        cfg['status'] = r['status']
+        out.append(cfg)
+    return out
+
+
+def delete(name: str) -> None:
+    row = global_state._db().query_one(  # pylint: disable=protected-access
+        'SELECT name FROM volumes WHERE name=?', (name,))
+    if row is None:
+        raise exceptions.SkyError(f'Volume {name!r} not found.')
+    global_state._db().execute(  # pylint: disable=protected-access
+        'DELETE FROM volumes WHERE name=?', (name,))

@@ -1,0 +1,48 @@
+"""Workspaces: named multi-tenant namespaces.
+
+Reference: sky/workspaces/core.py — per-workspace enabled clouds and
+config overlays; clusters are tagged with their workspace. Round-1
+scope: workspace registry in config + the active-workspace selector;
+per-workspace cloud filtering hooks into check.get_cached_enabled_clouds.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_config
+
+_ENV = 'SKYPILOT_WORKSPACE'
+DEFAULT = 'default'
+
+
+def active_workspace() -> str:
+    return os.environ.get(_ENV) or str(
+        sky_config.get_nested(('active_workspace',), DEFAULT))
+
+
+def get_workspaces() -> Dict[str, Dict[str, Any]]:
+    out = sky_config.get_nested(('workspaces',), {}) or {}
+    if DEFAULT not in out:
+        out = {DEFAULT: {}, **out}
+    return out
+
+
+def get_workspace(name: Optional[str] = None) -> Dict[str, Any]:
+    name = name or active_workspace()
+    workspaces = get_workspaces()
+    if name not in workspaces:
+        raise exceptions.SkyError(
+            f'Workspace {name!r} not defined; configure `workspaces:` in '
+            'config. Known: ' + ', '.join(sorted(workspaces)))
+    return workspaces[name] or {}
+
+
+def allowed_clouds(name: Optional[str] = None) -> Optional[List[str]]:
+    """None = all enabled clouds; else the workspace's allow-list."""
+    ws = get_workspace(name)
+    allowed = ws.get('allowed_clouds')
+    if allowed is None:
+        return None
+    return [str(c).lower() for c in allowed]

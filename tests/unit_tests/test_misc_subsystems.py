@@ -1,0 +1,151 @@
+"""Smaller subsystems: spot placer, queue autoscaler, usage, volumes,
+workspaces, recipes, config layering, timeline."""
+import json
+import os
+import time
+
+import pytest
+
+from skypilot_tpu.serve.spot_placer import DynamicFallbackSpotPlacer
+
+
+def test_spot_placer_avoids_hot_locations():
+    locations = [('gcp', 'us-east5', 'us-east5-a'),
+                 ('gcp', 'us-central2', 'us-central2-b'),
+                 ('gcp', 'europe-west4', 'europe-west4-b')]
+    placer = DynamicFallbackSpotPlacer(locations)
+    first = placer.select(now=0)
+    placer.handle_active(first)
+    placer.handle_preemption(first)
+    nxt = placer.select(now=time.time())
+    assert nxt != first
+    assert not placer.all_hot()
+    for loc in locations:
+        placer.handle_preemption(loc)
+    assert placer.all_hot()
+    # Still returns *something* (caller decides on-demand fallback).
+    assert placer.select() in locations
+
+
+def test_queue_length_autoscaler():
+    from skypilot_tpu.serve.autoscalers import (
+        AutoscalerDecisionOperator, QueueLengthAutoscaler)
+    from skypilot_tpu.serve.service_spec import SkyServiceSpec
+    spec = SkyServiceSpec(min_replicas=1, max_replicas=5,
+                          target_qps_per_replica=1,
+                          upscale_delay_seconds=0,
+                          downscale_delay_seconds=0)
+    a = QueueLengthAutoscaler(spec, target_queue_per_replica=2)
+    a.collect_request_information(10)
+    d = a.evaluate(num_ready=1, num_launching=0, now=100)
+    assert d.operator == AutoscalerDecisionOperator.SCALE_UP
+    assert a.target_num_replicas == 5
+    for _ in range(10):
+        a.request_done()
+    d = a.evaluate(num_ready=5, num_launching=0, now=200)
+    assert d.operator == AutoscalerDecisionOperator.SCALE_DOWN
+    assert a.target_num_replicas == 1
+
+
+def test_usage_records_redacted_events(isolated_state, monkeypatch):
+    monkeypatch.delenv('SKYPILOT_DISABLE_USAGE_COLLECTION', raising=False)
+    from skypilot_tpu.usage import usage_lib
+    with usage_lib.entrypoint('launch', cloud='gcp',
+                              accelerator='tpu-v5e-16'):
+        pass
+    with pytest.raises(ValueError):
+        with usage_lib.entrypoint('launch'):
+            raise ValueError('secret path /home/x')
+    path = os.path.join(isolated_state, 'usage', 'usage.jsonl')
+    with open(path, 'r', encoding='utf-8') as f:
+        events = [json.loads(line) for line in f]
+    assert len(events) == 2
+    assert events[0]['name'] == 'launch'
+    assert events[1]['error'] == 'ValueError'
+    # Redaction: the message (with its path) is NOT recorded.
+    assert 'secret' not in json.dumps(events)
+
+
+def test_usage_opt_out(isolated_state, monkeypatch):
+    monkeypatch.setenv('SKYPILOT_DISABLE_USAGE_COLLECTION', '1')
+    from skypilot_tpu.usage import usage_lib
+    usage_lib.record_event('x')
+    assert not os.path.exists(
+        os.path.join(isolated_state, 'usage', 'usage.jsonl'))
+
+
+def test_volumes_crud(isolated_state):
+    from skypilot_tpu import exceptions
+    from skypilot_tpu.volumes import core as volumes_core
+    volumes_core.apply('data', 500, 'gcp', 'pd-ssd')
+    rows = volumes_core.ls()
+    assert rows[0]['name'] == 'data' and rows[0]['size_gb'] == 500
+    volumes_core.delete('data')
+    assert volumes_core.ls() == []
+    with pytest.raises(exceptions.SkyError):
+        volumes_core.delete('data')
+
+
+def test_workspaces(isolated_state, monkeypatch, tmp_path):
+    cfg = tmp_path / 'cfg.yaml'
+    cfg.write_text(
+        'workspaces:\n'
+        '  ml-team:\n'
+        '    allowed_clouds: [GCP]\n')
+    monkeypatch.setenv('SKYPILOT_TPU_CONFIG', str(cfg))
+    from skypilot_tpu.workspaces import core as ws
+    assert ws.active_workspace() == 'default'
+    assert ws.allowed_clouds('default') is None
+    assert ws.allowed_clouds('ml-team') == ['gcp']
+    monkeypatch.setenv('SKYPILOT_WORKSPACE', 'ml-team')
+    assert ws.active_workspace() == 'ml-team'
+    import skypilot_tpu.exceptions as exc
+    with pytest.raises(exc.SkyError):
+        ws.get_workspace('nope')
+
+
+def test_recipes_registry():
+    from skypilot_tpu.recipes import core as recipes_core
+    names = {r['name'] for r in recipes_core.list_recipes()}
+    assert {'nanogpt', 'llama3_8b_fsdp', 'mixtral_ep',
+            'managed_job_checkpoint'}.issubset(names)
+    path = recipes_core.get_recipe_path('nanogpt')
+    assert os.path.exists(path)
+    with pytest.raises(FileNotFoundError):
+        recipes_core.get_recipe_path('nope')
+
+
+def test_config_layering(isolated_state, monkeypatch, tmp_path):
+    from skypilot_tpu import sky_config
+    server_cfg = os.path.join(isolated_state, 'config.yaml')
+    os.makedirs(isolated_state, exist_ok=True)
+    with open(server_cfg, 'w', encoding='utf-8') as f:
+        f.write('gcp:\n  project_id: base\n  labels: {team: a}\n')
+    user_cfg = tmp_path / 'user.yaml'
+    user_cfg.write_text('gcp:\n  project_id: override\n')
+    monkeypatch.setenv('SKYPILOT_TPU_CONFIG', str(user_cfg))
+    assert sky_config.get_nested(('gcp', 'project_id')) == 'override'
+    assert sky_config.get_nested(('gcp', 'labels')) == {'team': 'a'}
+    with sky_config.override({'gcp': {'project_id': 'runtime'}}):
+        assert sky_config.get_nested(('gcp', 'project_id')) == 'runtime'
+    assert sky_config.get_nested(('gcp', 'project_id')) == 'override'
+
+
+def test_timeline_tracing(tmp_path, monkeypatch):
+    from skypilot_tpu.utils import timeline
+    out = tmp_path / 'trace.json'
+    monkeypatch.setattr(timeline, '_enabled_path', str(out))
+    monkeypatch.setattr(timeline, '_events', [])
+
+    @timeline.event
+    def traced():
+        time.sleep(0.01)
+
+    traced()
+    with timeline.Event('manual', 'note'):
+        pass
+    timeline.save()
+    data = json.loads(out.read_text())
+    names = {e['name'] for e in data['traceEvents']}
+    assert any('traced' in n for n in names), names  # qualname form
+    assert 'manual' in names
