@@ -93,7 +93,8 @@ class Attention(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array, positions: jax.Array,
+                 decode: bool = False) -> jax.Array:
         cfg = self.config
         batch, seq, _ = x.shape
         hd = cfg.head_dim
@@ -105,10 +106,46 @@ class Attention(nn.Module):
                   'wv')(x).reshape(batch, seq, cfg.num_kv_heads, hd)
         q = apply_rope(q, positions, cfg.rope_theta)
         k = apply_rope(k, positions, cfg.rope_theta)
-        q = nn.with_logical_constraint(q, ('batch', 'seq', 'heads', 'kv'))
-        k = nn.with_logical_constraint(k, ('batch', 'seq', 'heads', 'kv'))
-        v = nn.with_logical_constraint(v, ('batch', 'seq', 'heads', 'kv'))
-        out = attention_ops.dot_product_attention(q, k, v, causal=True)
+
+        if decode:
+            # Incremental decoding: one token in, KV cache carried as
+            # flax 'cache' variables (serving path; models/generate.py).
+            assert seq == 1, f'decode mode feeds one token, got {seq}'
+            cached_k = self.variable(
+                'cache', 'cached_key', jnp.zeros,
+                (batch, cfg.max_seq_len, cfg.num_kv_heads, hd), cfg.dtype)
+            cached_v = self.variable(
+                'cache', 'cached_value', jnp.zeros,
+                (batch, cfg.max_seq_len, cfg.num_kv_heads, hd), cfg.dtype)
+            cache_index = self.variable(
+                'cache', 'cache_index',
+                lambda: jnp.zeros((), jnp.int32))
+            idx = cache_index.value
+            cached_k.value = jax.lax.dynamic_update_slice(
+                cached_k.value, k.astype(cfg.dtype), (0, idx, 0, 0))
+            cached_v.value = jax.lax.dynamic_update_slice(
+                cached_v.value, v.astype(cfg.dtype), (0, idx, 0, 0))
+            cache_index.value = idx + 1
+            k_all = jnp.repeat(cached_k.value,
+                               cfg.num_heads // cfg.num_kv_heads, axis=2)
+            v_all = jnp.repeat(cached_v.value,
+                               cfg.num_heads // cfg.num_kv_heads, axis=2)
+            scale = 1.0 / (hd ** 0.5)
+            s = jnp.einsum('bqhd,bkhd->bhqk', q.astype(jnp.float32),
+                           k_all.astype(jnp.float32)) * scale
+            mask = (jnp.arange(cfg.max_seq_len) <= idx)[None, None, None, :]
+            s = jnp.where(mask, s, -jnp.inf)
+            p = jax.nn.softmax(s, axis=-1)
+            out = jnp.einsum('bhqk,bkhd->bqhd', p,
+                             v_all.astype(jnp.float32)).astype(cfg.dtype)
+        else:
+            q = nn.with_logical_constraint(q,
+                                           ('batch', 'seq', 'heads', 'kv'))
+            k = nn.with_logical_constraint(k,
+                                           ('batch', 'seq', 'heads', 'kv'))
+            v = nn.with_logical_constraint(v,
+                                           ('batch', 'seq', 'heads', 'kv'))
+            out = attention_ops.dot_product_attention(q, k, v, causal=True)
         out = out.reshape(batch, seq, cfg.num_heads * hd)
         return _proj(cfg.embed_dim, ('heads', 'embed'), cfg.dtype, 'wo')(out)
 
@@ -130,10 +167,12 @@ class Block(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array, positions: jax.Array,
+                 decode: bool = False) -> jax.Array:
         cfg = self.config
         x = x + Attention(cfg, name='attn')(
-            RMSNorm(cfg.norm_eps, cfg.dtype, name='attn_norm')(x), positions)
+            RMSNorm(cfg.norm_eps, cfg.dtype, name='attn_norm')(x), positions,
+            decode)
         x = x + FeedForward(cfg, name='mlp')(
             RMSNorm(cfg.norm_eps, cfg.dtype, name='mlp_norm')(x))
         return nn.with_logical_constraint(x, ('batch', 'seq', 'act_embed'))
@@ -145,7 +184,8 @@ class Llama(nn.Module):
 
     @nn.compact
     def __call__(self, tokens: jax.Array,
-                 positions: Optional[jax.Array] = None) -> jax.Array:
+                 positions: Optional[jax.Array] = None,
+                 decode: bool = False) -> jax.Array:
         cfg = self.config
         batch, seq = tokens.shape
         if positions is None:
@@ -160,9 +200,10 @@ class Llama(nn.Module):
 
         block = Block
         if cfg.remat:
-            block = nn.remat(Block, prevent_cse=False)
+            block = nn.remat(Block, prevent_cse=False,
+                             static_argnums=(3,))
         for i in range(cfg.num_layers):
-            x = block(cfg, name=f'layer_{i}')(x, positions)
+            x = block(cfg, name=f'layer_{i}')(x, positions, decode)
         x = RMSNorm(cfg.norm_eps, cfg.dtype, name='final_norm')(x)
         head = self.param(
             'lm_head',

@@ -1,0 +1,121 @@
+"""In-framework LM inference server: the payload of serve replicas.
+
+A minimal JetStream-shaped HTTP server over models/generate.py's
+KV-cache engine: GET / (readiness), POST /generate
+{"tokens": [[...]], "max_new_tokens": N, "temperature": t} →
+{"tokens": [[...]]}. Listens on SKYPILOT_SERVE_PORT (injected by the
+serve controller). Continuous batching is a later-round upgrade; this
+serves one request at a time with a jitted fixed-shape generate fn per
+(batch, total_len) bucket.
+
+  stpu serve up -y -n llama task.yaml   # run: python -m
+      skypilot_tpu.recipes.serve_lm --model llama-tiny
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Tuple
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--model', default='llama-tiny')
+    parser.add_argument('--ckpt-dir', default=None,
+                        help='orbax checkpoint to load weights from')
+    parser.add_argument('--max-total-len', type=int, default=256)
+    parser.add_argument('--port', type=int,
+                        default=int(os.environ.get('SKYPILOT_SERVE_PORT',
+                                                   8000)))
+    args = parser.parse_args()
+
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from skypilot_tpu.models import generate as gen
+    from skypilot_tpu.recipes.train_lm import _build_model
+
+    model, vocab_size, _ = _build_model(args.model, args.max_total_len,
+                                        remat=False)
+    params = nn.meta.unbox(model.init(
+        jax.random.PRNGKey(0),
+        jnp.ones((1, 8), jnp.int32))['params'])
+    if args.ckpt_dir:
+        from skypilot_tpu.parallel.checkpoints import CheckpointManager
+        mgr = CheckpointManager(args.ckpt_dir)
+        if mgr.latest_step() is not None:
+            from skypilot_tpu.parallel.train import TrainState
+            import optax
+            template = TrainState.create(params, optax.sgd(1e-3))
+            params = mgr.restore(template).params
+            print(f'loaded checkpoint step {mgr.latest_step()}', flush=True)
+
+    # One jitted fn per (batch, temperature) bucket.
+    fns: Dict[Tuple[int, float], object] = {}
+    lock = threading.Lock()
+
+    def get_fn(batch: int, temperature: float):
+        key = (batch, temperature)
+        with lock:
+            if key not in fns:
+                fns[key] = gen.make_generate_fn(
+                    model, args.max_total_len, temperature=temperature)
+            return fns[key]
+
+    rng_holder = {'rng': jax.random.PRNGKey(0)}
+
+    class Handler(BaseHTTPRequestHandler):
+
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _json(self, obj, code=200):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header('Content-Type', 'application/json')
+            self.send_header('Content-Length', str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802
+            self._json({'status': 'ok', 'model': args.model,
+                        'vocab_size': vocab_size,
+                        'max_total_len': args.max_total_len})
+
+        def do_POST(self):  # noqa: N802
+            if self.path not in ('/generate', '/v1/generate'):
+                self._json({'error': 'POST /generate'}, 404)
+                return
+            try:
+                length = int(self.headers.get('Content-Length', 0))
+                req = json.loads(self.rfile.read(length))
+                tokens = req['tokens']
+                temperature = float(req.get('temperature', 0.0))
+                prompt = jnp.asarray(tokens, jnp.int32)
+                if prompt.ndim != 2:
+                    raise ValueError('tokens must be [batch, prompt_len]')
+                if prompt.shape[1] >= args.max_total_len:
+                    raise ValueError(
+                        f'prompt len {prompt.shape[1]} >= max_total_len '
+                        f'{args.max_total_len}')
+                fn = get_fn(prompt.shape[0], temperature)
+                with lock:
+                    rng_holder['rng'], sub = jax.random.split(
+                        rng_holder['rng'])
+                out = fn(params, prompt, sub)
+                self._json({'tokens': jax.device_get(out).tolist()})
+            except Exception as e:  # pylint: disable=broad-except
+                self._json({'error': f'{type(e).__name__}: {e}'}, 400)
+
+    server = ThreadingHTTPServer(('0.0.0.0', args.port), Handler)
+    print(f'serve_lm listening on :{args.port} model={args.model}',
+          flush=True)
+    server.serve_forever()
+
+
+if __name__ == '__main__':
+    main()
