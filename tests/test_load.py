@@ -1,0 +1,51 @@
+"""API server load test: concurrency + memory envelope.
+
+Reference: tests/load_tests/ + test_api_server_benchmark.py:16-39 —
+the server must handle concurrent requests and keep peak RSS bounded.
+Our envelope: 50 concurrent short requests complete correctly and
+server+workers RSS stays under 2 GB (reference baseline allows ~3 GB
+idle on a 16 GB host).
+"""
+import concurrent.futures
+import time
+
+import pytest
+
+from skypilot_tpu.client import sdk
+
+from tests.test_api_server import api_server  # fixture reuse  # noqa: F401
+
+
+@pytest.mark.slow
+def test_concurrent_requests_and_rss(api_server):  # noqa: F811
+    import requests as req
+
+    sdk.get(sdk.check())
+
+    def one_status(i):
+        rid = sdk.status(refresh=False)
+        out = sdk.get(rid)
+        return i, out
+
+    start = time.time()
+    with concurrent.futures.ThreadPoolExecutor(max_workers=16) as pool:
+        results = list(pool.map(one_status, range(50)))
+    elapsed = time.time() - start
+    assert len(results) == 50
+    assert all(out == [] for _, out in results)
+
+    # Requests all recorded and succeeded.
+    rows = sdk.api_status(limit=200)
+    succeeded = [r for r in rows if r['status'] == 'SUCCEEDED']
+    assert len(succeeded) >= 51  # 50 status + check
+
+    # Memory envelope from the server's own metrics.
+    metrics = req.get(f'{api_server}/api/metrics', timeout=10).text
+    rss = 0
+    for line in metrics.splitlines():
+        if line.startswith(('skypilot_server_rss_bytes',
+                            'skypilot_workers_rss_bytes')):
+            rss += float(line.split()[-1])
+    assert rss < 2 * 1024 ** 3, f'RSS {rss / 1e9:.2f} GB exceeds envelope'
+    # Throughput sanity: 50 round-tripped requests shouldn't crawl.
+    assert elapsed < 120, f'50 requests took {elapsed:.0f}s'
