@@ -1,0 +1,22 @@
+"""Batch request entrypoints (JSON-payload wrappers)."""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.batch import core
+
+
+def launch(task_config: Dict[str, Any], name: str, input_path: str,
+           output_dir: str, num_workers: int = 2,
+           num_shards: Optional[int] = None,
+           user: str = 'unknown') -> Dict[str, Any]:
+    return core.launch(task_config, name, input_path, output_dir,
+                       num_workers, num_shards, user)
+
+
+def ls() -> List[Dict[str, Any]]:
+    return core.ls()
+
+
+def cancel(name: str) -> bool:
+    return core.cancel(name)

@@ -1,0 +1,155 @@
+"""Batch coordinator daemon: shard queue → worker-cluster pool.
+
+Reference: sky/batch/coordinator.py. One process per batch job:
+provisions `num_workers` clusters, then streams shards through them —
+each assignment submits an agent job on a free worker with the shard
+env injected; failures requeue (up to _MAX_SHARD_RETRIES); workers are
+torn down when the queue drains.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import queue
+import signal
+import threading
+import time
+import traceback
+from typing import Dict, List, Optional
+
+from skypilot_tpu import constants
+from skypilot_tpu import execution
+from skypilot_tpu import global_state
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.agent import job_lib as agent_job_lib
+from skypilot_tpu.batch import core
+from skypilot_tpu.utils import ux_utils
+
+_MAX_SHARD_RETRIES = 2
+
+
+class Coordinator:
+
+    def __init__(self, name: str) -> None:
+        record = core.get(name)
+        assert record is not None, name
+        self.name = name
+        self.record = record
+        self.task_config = record['task_config']
+        self.cancelled = threading.Event()
+        signal.signal(signal.SIGTERM,
+                      lambda *a: self.cancelled.set())
+        self.shard_queue: 'queue.Queue' = queue.Queue()
+        self.done = 0
+        self.failed_shards: List[str] = []
+        self.lock = threading.Lock()
+
+    def _worker_cluster(self, idx: int) -> str:
+        return f'batch-{self.name}-w{idx}'
+
+    # ------------------------------------------------------------------
+    def run(self) -> core.BatchStatus:
+        record = self.record
+        shard_dir = os.path.join(constants.sky_home(), 'batch_shards',
+                                 self.name)
+        shards = core.split_jsonl(record['input_path'], shard_dir,
+                                  record['num_shards'])
+        os.makedirs(os.path.expanduser(record['output_dir']), exist_ok=True)
+        for shard in shards:
+            self.shard_queue.put((shard, 0))
+        core.set_status(self.name, core.BatchStatus.RUNNING)
+
+        num_workers = min(record['num_workers'], len(shards))
+        threads = []
+        for idx in range(num_workers):
+            t = threading.Thread(target=self._worker_loop, args=(idx,),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+
+        self._teardown_workers(num_workers)
+        if self.cancelled.is_set():
+            final = core.BatchStatus.CANCELLED
+        elif self.failed_shards:
+            final = core.BatchStatus.FAILED
+        else:
+            final = core.BatchStatus.SUCCEEDED
+        core.set_status(self.name, final)
+        ux_utils.log(f'Batch {self.name}: {final.value} '
+                     f'({self.done}/{len(shards)} shards).')
+        return final
+
+    # ------------------------------------------------------------------
+    def _worker_loop(self, idx: int) -> None:
+        cluster = self._worker_cluster(idx)
+        launched = False
+        while not self.cancelled.is_set():
+            try:
+                shard, attempt = self.shard_queue.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                if not launched:
+                    # First assignment provisions the worker (with the
+                    # first shard's env — launch = provision+exec).
+                    launched = True
+                rc = self._run_shard(cluster, shard)
+                if rc:
+                    with self.lock:
+                        self.done += 1
+                else:
+                    raise RuntimeError(f'shard failed: {shard}')
+            except Exception as e:  # pylint: disable=broad-except
+                ux_utils.error(f'Batch {self.name} worker {idx}: {e}')
+                if attempt + 1 <= _MAX_SHARD_RETRIES:
+                    self.shard_queue.put((shard, attempt + 1))
+                else:
+                    with self.lock:
+                        self.failed_shards.append(shard)
+            finally:
+                with self.lock:
+                    core.set_progress(self.name, self.done,
+                                      len(self.failed_shards))
+                self.shard_queue.task_done()
+
+    def _run_shard(self, cluster: str, shard: str) -> bool:
+        out_path = os.path.join(
+            os.path.expanduser(self.record['output_dir']),
+            os.path.basename(shard).replace('shard-', 'out-'))
+        task = task_lib.Task.from_yaml_config(dict(self.task_config))
+        task.update_envs({
+            'SKYPILOT_BATCH_SHARD': shard,
+            'SKYPILOT_BATCH_OUTPUT': out_path,
+            'SKYPILOT_BATCH_NAME': self.name,
+        })
+        job_id, handle = execution.launch(task, cluster_name=cluster,
+                                          detach_run=True,
+                                          _quiet_optimizer=True)
+        assert job_id is not None and handle is not None
+        status = handle.agent().wait_job(job_id)
+        return status == agent_job_lib.JobStatus.SUCCEEDED
+
+    def _teardown_workers(self, num_workers: int) -> None:
+        from skypilot_tpu import core as sky_core
+        for idx in range(num_workers):
+            cluster = self._worker_cluster(idx)
+            if global_state.get_cluster(cluster) is not None:
+                try:
+                    sky_core.down(cluster)
+                except Exception:  # pylint: disable=broad-except
+                    traceback.print_exc()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--name', required=True)
+    args = parser.parse_args()
+    coordinator = Coordinator(args.name)
+    final = coordinator.run()
+    raise SystemExit(0 if final == core.BatchStatus.SUCCEEDED else 1)
+
+
+if __name__ == '__main__':
+    main()

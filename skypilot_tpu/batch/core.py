@@ -1,0 +1,159 @@
+"""Batch: map a task over dataset shards on a pool of worker clusters.
+
+Reference: sky/batch/ (coordinator + workers over JSONL on object
+storage, README.md:1-35). TPU-native shape: the coordinator is a
+controller daemon (like managed jobs); it splits the input JSONL into
+shards, provisions a pool of worker clusters, and streams shards
+through them — each assignment is one agent job with
+SKYPILOT_BATCH_SHARD / SKYPILOT_BATCH_OUTPUT env injected. Failed
+shards requeue (bounded retries); workers tear down when the queue
+drains.
+"""
+from __future__ import annotations
+
+import enum
+import functools
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import constants
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import db_utils
+from skypilot_tpu.utils import subprocess_utils
+
+
+class BatchStatus(enum.Enum):
+    PENDING = 'PENDING'
+    RUNNING = 'RUNNING'
+    SUCCEEDED = 'SUCCEEDED'
+    FAILED = 'FAILED'
+    CANCELLED = 'CANCELLED'
+
+    def is_terminal(self) -> bool:
+        return self in (BatchStatus.SUCCEEDED, BatchStatus.FAILED,
+                        BatchStatus.CANCELLED)
+
+
+_CREATE_SQL = """\
+CREATE TABLE IF NOT EXISTS batch_jobs (
+    name TEXT PRIMARY KEY,
+    status TEXT,
+    task_config TEXT,
+    input_path TEXT,
+    output_dir TEXT,
+    num_workers INTEGER,
+    num_shards INTEGER,
+    shards_done INTEGER DEFAULT 0,
+    shards_failed INTEGER DEFAULT 0,
+    controller_pid INTEGER DEFAULT -1,
+    created_at REAL,
+    log_path TEXT
+);
+"""
+
+
+@functools.lru_cache(maxsize=None)
+def _db_for(path: str) -> db_utils.SQLiteDB:
+    return db_utils.SQLiteDB(path, _CREATE_SQL)
+
+
+def _db() -> db_utils.SQLiteDB:
+    return _db_for(os.path.join(constants.sky_home(), 'batch.db'))
+
+
+def split_jsonl(input_path: str, shard_dir: str,
+                num_shards: int) -> List[str]:
+    """Round-robin split of a JSONL file into shard files."""
+    input_path = os.path.expanduser(input_path)
+    os.makedirs(shard_dir, exist_ok=True)
+    paths = [os.path.join(shard_dir, f'shard-{i:05d}.jsonl')
+             for i in range(num_shards)]
+    files = [open(p, 'w', encoding='utf-8') for p in paths]
+    try:
+        with open(input_path, 'r', encoding='utf-8') as f:
+            for i, line in enumerate(f):
+                if line.strip():
+                    files[i % num_shards].write(line)
+    finally:
+        for f in files:
+            f.close()
+    return paths
+
+
+def launch(task_config: Dict[str, Any], name: str, input_path: str,
+           output_dir: str, num_workers: int = 2,
+           num_shards: Optional[int] = None,
+           user: str = 'unknown') -> Dict[str, Any]:
+    """Register the batch job and spawn its coordinator daemon."""
+    if _db().query_one('SELECT name FROM batch_jobs WHERE name=?',
+                       (name,)) is not None:
+        raise exceptions.SkyError(f'Batch job {name!r} already exists.')
+    num_shards = num_shards or num_workers * 4
+    log_dir = os.path.join(constants.sky_home(), 'batch_logs')
+    os.makedirs(log_dir, exist_ok=True)
+    log_path = os.path.join(log_dir, f'{name}.log')
+    _db().execute(
+        'INSERT INTO batch_jobs (name, status, task_config, input_path, '
+        'output_dir, num_workers, num_shards, created_at, log_path) '
+        'VALUES (?,?,?,?,?,?,?,?,?)',
+        (name, BatchStatus.PENDING.value, json.dumps(task_config),
+         input_path, output_dir, num_workers, num_shards, time.time(),
+         log_path))
+    env = dict(os.environ)
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env['PYTHONPATH'] = f'{repo_root}:{env.get("PYTHONPATH", "")}'
+    pid = subprocess_utils.launch_daemon(
+        [sys.executable, '-m', 'skypilot_tpu.batch.coordinator',
+         '--name', name],
+        log_path=log_path, env=env)
+    _db().execute('UPDATE batch_jobs SET controller_pid=? WHERE name=?',
+                  (pid, name))
+    del user
+    return {'name': name, 'num_shards': num_shards,
+            'num_workers': num_workers}
+
+
+def get(name: str) -> Optional[Dict[str, Any]]:
+    row = _db().query_one('SELECT * FROM batch_jobs WHERE name=?', (name,))
+    if row is None:
+        return None
+    out = dict(row)
+    out['status'] = BatchStatus(out['status'])
+    out['task_config'] = json.loads(out['task_config'] or '{}')
+    return out
+
+
+def ls() -> List[Dict[str, Any]]:
+    rows = _db().query('SELECT name, status, num_shards, shards_done, '
+                       'shards_failed, num_workers, created_at '
+                       'FROM batch_jobs ORDER BY created_at')
+    return [dict(r) for r in rows]
+
+
+def cancel(name: str) -> bool:
+    row = get(name)
+    if row is None or row['status'].is_terminal():
+        return False
+    pid = row.get('controller_pid') or -1
+    set_status(name, BatchStatus.CANCELLED)
+    if pid > 0:
+        import signal
+        try:
+            os.kill(pid, signal.SIGTERM)
+        except ProcessLookupError:
+            pass
+    return True
+
+
+def set_status(name: str, status: BatchStatus) -> None:
+    _db().execute('UPDATE batch_jobs SET status=? WHERE name=?',
+                  (status.value, name))
+
+
+def set_progress(name: str, done: int, failed: int) -> None:
+    _db().execute('UPDATE batch_jobs SET shards_done=?, shards_failed=? '
+                  'WHERE name=?', (done, failed, name))

@@ -1,0 +1,29 @@
+"""Batch API routes (mounted by server/server.py)."""
+from __future__ import annotations
+
+from aiohttp import web
+
+from skypilot_tpu.server.requests import executor
+
+_API = 'skypilot_tpu.batch.api'
+
+
+def _schedule(name: str, entrypoint: str, schedule_type: str = 'short'):
+
+    async def handler(request: web.Request) -> web.Response:
+        payload = await request.json() if request.can_read_body else {}
+        request_id = executor.schedule_request(
+            name, entrypoint, payload, schedule_type=schedule_type,
+            user=request.headers.get('X-Skypilot-User', 'unknown'))
+        return web.json_response({'request_id': request_id})
+
+    return handler
+
+
+def register(app: web.Application) -> None:
+    app.router.add_post('/batch/launch',
+                        _schedule('batch.launch', f'{_API}.launch', 'long'))
+    app.router.add_post('/batch/ls',
+                        _schedule('batch.ls', f'{_API}.ls'))
+    app.router.add_post('/batch/cancel',
+                        _schedule('batch.cancel', f'{_API}.cancel'))

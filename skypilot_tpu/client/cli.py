@@ -675,6 +675,63 @@ def debug_dump(output) -> None:
     click.echo(f'Wrote {output}.')
 
 
+@cli.group()
+def batch() -> None:
+    """Batch: map a task over dataset shards on a worker pool."""
+
+
+@batch.command(name='launch')
+@click.argument('entrypoint')
+@click.option('--batch-name', '-n', 'batch_name', required=True)
+@click.option('--input', 'input_path', required=True,
+              help='JSONL input file.')
+@click.option('--output-dir', required=True)
+@click.option('--workers', type=int, default=2)
+@click.option('--shards', type=int, default=None)
+@_add_options(_task_options)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def batch_launch_cmd(entrypoint, batch_name, input_path, output_dir,
+                     workers, shards, name, workdir, infra, gpus, cpus,
+                     memory, num_nodes, use_spot, env, yes) -> None:
+    """Launch a batch job over a JSONL dataset."""
+    task = _build_task(entrypoint, name, workdir, infra, gpus, cpus, memory,
+                       num_nodes, use_spot, env)
+    if not yes:
+        click.confirm(f'Launch batch {batch_name} ({workers} workers)?',
+                      default=True, abort=True)
+    result = sdk.get(sdk.batch_launch(task, batch_name, input_path,
+                                      output_dir, workers, shards))
+    click.echo(f'Batch {batch_name}: {result["num_shards"]} shards on '
+               f'{result["num_workers"]} workers.')
+
+
+@batch.command(name='ls')
+def batch_ls_cmd() -> None:
+    rows = sdk.get(sdk.batch_ls())
+    from rich.console import Console
+    from rich.table import Table
+    table = Table(box=None)
+    for col in ('NAME', 'STATUS', 'SHARDS', 'FAILED', 'WORKERS'):
+        table.add_column(col)
+    for r in rows:
+        table.add_row(r['name'], r['status'],
+                      f"{r['shards_done']}/{r['num_shards']}",
+                      str(r['shards_failed']), str(r['num_workers']))
+    Console().print(table)
+
+
+@batch.command(name='cancel')
+@click.argument('batch_name')
+@click.option('--yes', '-y', is_flag=True, default=False)
+def batch_cancel_cmd(batch_name, yes) -> None:
+    if not yes:
+        click.confirm(f'Cancel batch {batch_name}?', abort=True)
+    if sdk.get(sdk.batch_cancel(batch_name)):
+        click.echo('Cancelled.')
+    else:
+        click.echo('Already finished or not found.')
+
+
 def main() -> None:
     try:
         cli()

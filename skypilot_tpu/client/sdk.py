@@ -37,7 +37,14 @@ def api_server_url() -> str:
 
 
 def _headers() -> Dict[str, str]:
-    return {'X-Skypilot-User': common_utils.get_user_name()}
+    headers = {'X-Skypilot-User': common_utils.get_user_name()}
+    token = os.environ.get('SKYPILOT_API_TOKEN')
+    if not token:
+        from skypilot_tpu import sky_config
+        token = sky_config.get_nested(('api_server', 'auth_token'))
+    if token:
+        headers['Authorization'] = f'Bearer {token}'
+    return headers
 
 
 def api_info(server_url: Optional[str] = None) -> Optional[Dict[str, Any]]:
@@ -132,7 +139,7 @@ def get(request_id: str, timeout: Optional[float] = None) -> Any:
     while True:
         resp = requests.get(f'{url}/api/get',
                             params={'request_id': request_id, 'timeout': 10},
-                            timeout=40)
+                            headers=_headers(), timeout=40)
         if resp.status_code == 404:
             raise exceptions.RequestNotFoundError(request_id)
         resp.raise_for_status()
@@ -156,7 +163,8 @@ def stream_and_get(request_id: str, output=None) -> Any:
     try:
         with requests.get(f'{url}/api/stream',
                           params={'request_id': request_id, 'follow': '1'},
-                          stream=True, timeout=(30, None)) as resp:
+                          headers=_headers(), stream=True,
+                          timeout=(30, None)) as resp:
             resp.raise_for_status()
             for line in resp.iter_lines(decode_unicode=True):
                 print(line, file=out, flush=True)
@@ -170,7 +178,8 @@ def stream_and_get(request_id: str, output=None) -> Any:
 def api_cancel(request_id: str) -> bool:
     url = api_server_url()
     resp = requests.post(f'{url}/api/cancel',
-                         json={'request_id': request_id}, timeout=30)
+                         json={'request_id': request_id},
+                         headers=_headers(), timeout=30)
     resp.raise_for_status()
     return resp.json().get('cancelled', False)
 
@@ -178,7 +187,7 @@ def api_cancel(request_id: str) -> bool:
 def api_status(limit: int = 100) -> List[Dict[str, Any]]:
     url = _ensure_server()
     resp = requests.get(f'{url}/api/status', params={'limit': limit},
-                        timeout=30)
+                        headers=_headers(), timeout=30)
     resp.raise_for_status()
     return resp.json()['requests']
 
@@ -285,8 +294,8 @@ def tail_logs(cluster_name: str, job_id: Optional[int] = None,
         params['job_id'] = str(job_id)
     if tail:
         params['tail'] = str(tail)
-    with requests.get(f'{url}/logs', params=params, stream=True,
-                      timeout=(30, None)) as resp:
+    with requests.get(f'{url}/logs', params=params, headers=_headers(),
+                      stream=True, timeout=(30, None)) as resp:
         if resp.status_code == 404:
             raise exceptions.ClusterDoesNotExist(cluster_name)
         resp.raise_for_status()
@@ -321,7 +330,8 @@ def jobs_logs(job_id: int, follow: bool = True, output=None) -> None:
     with requests.get(f'{url}/jobs/logs',
                       params={'job_id': str(job_id),
                               'follow': '1' if follow else '0'},
-                      stream=True, timeout=(30, None)) as resp:
+                      headers=_headers(), stream=True,
+                      timeout=(30, None)) as resp:
         if resp.status_code == 404:
             raise exceptions.JobNotFoundError(f'managed job {job_id}')
         resp.raise_for_status()
@@ -354,3 +364,28 @@ def serve_status(service_names: Optional[List[str]] = None) -> str:
 def serve_down(service_name: str, purge: bool = False) -> str:
     return _post('/serve/down', {'service_name': service_name,
                                  'purge': purge})
+
+
+# ---------------------------------------------------------------------------
+# Batch
+# ---------------------------------------------------------------------------
+def batch_launch(task: 'task_lib.Task', name: str, input_path: str,
+                 output_dir: str, num_workers: int = 2,
+                 num_shards: Optional[int] = None) -> str:
+    return _post('/batch/launch', {
+        'task_config': task.to_yaml_config(),
+        'name': name,
+        'input_path': input_path,
+        'output_dir': output_dir,
+        'num_workers': num_workers,
+        'num_shards': num_shards,
+        'user': common_utils.get_user_name(),
+    })
+
+
+def batch_ls() -> str:
+    return _post('/batch/ls', {})
+
+
+def batch_cancel(name: str) -> str:
+    return _post('/batch/cancel', {'name': name})

@@ -68,6 +68,26 @@ class ServeController:
     def _replica_cluster(self, replica_id: int) -> str:
         return f'{self.name}-rep{replica_id}'
 
+    def _refresh_service_record(self) -> None:
+        """Pick up `serve update`s: version bump → new task/spec.
+
+        Rolling semantics (reference: replica_managers.py:1528): new
+        replicas launch at the new version; old-version replicas are
+        culled only once enough new-version replicas are READY.
+        """
+        record = serve_state.get_service(self.name)
+        if record is None:
+            return
+        if record['version'] != self.version:
+            ux_utils.log(f'Service {self.name}: rolling to '
+                         f'v{record["version"]}.')
+            self.version = record['version']
+            self.task_config = record['task_config']
+            self.spec = spec_lib.SkyServiceSpec.from_yaml_config(
+                record['spec'])
+            # Autoscaler target carries over; spec swap re-reads limits.
+            self.autoscaler.spec = self.spec
+
     def _launch_replica(self, replica_id: int, version: int) -> None:
         del version
         cluster = self._replica_cluster(replica_id)
@@ -129,6 +149,7 @@ class ServeController:
 
     # -- reconcile loop ----------------------------------------------------------
     def reconcile_once(self) -> None:
+        self._refresh_service_record()
         replicas = serve_state.get_replicas(self.name)
         S = serve_state.ReplicaStatus
 
@@ -174,11 +195,28 @@ class ServeController:
                 else:
                     launching += 1
 
-        # Autoscale.
-        decision = self.autoscaler.evaluate(len(ready), launching)
+        # Rolling update: old-version replicas don't count toward the
+        # target (forcing new-version launches), and each old replica is
+        # culled once a same-count of new-version replicas is READY.
+        ready_ids = {r['replica_id'] for r in ready}
+        ready_new = [r for r in ready if r['version'] == self.version]
+        old_active = [r for r in replicas
+                      if r['version'] != self.version and
+                      not r['status'].is_terminal() and
+                      r['status'] != S.SHUTTING_DOWN]
+        launching_new = sum(
+            1 for r in replicas
+            if r['version'] == self.version and
+            not r['status'].is_terminal() and
+            r['status'] != S.SHUTTING_DOWN and
+            r['replica_id'] not in ready_ids)
+
+        # Autoscale against the current version only.
+        decision = self.autoscaler.evaluate(len(ready_new), launching_new)
         if decision.operator == \
                 autoscalers.AutoscalerDecisionOperator.SCALE_UP:
-            want = decision.target_num_replicas - len(ready) - launching
+            want = (decision.target_num_replicas - len(ready_new) -
+                    launching_new)
             for _ in range(max(0, want)):
                 rid = serve_state.next_replica_id(self.name)
                 thread = threading.Thread(target=self._launch_replica,
@@ -191,16 +229,29 @@ class ServeController:
                 thread.start()
         elif decision.operator == \
                 autoscalers.AutoscalerDecisionOperator.SCALE_DOWN:
-            excess = len(ready) + launching - decision.target_num_replicas
+            excess = (len(ready_new) + launching_new -
+                      decision.target_num_replicas)
             victims = sorted(
                 (r for r in replicas
-                 if not r['status'].is_terminal() and
+                 if r['version'] == self.version and
+                 not r['status'].is_terminal() and
                  r['status'] != S.SHUTTING_DOWN),
                 key=lambda r: (r['status'] == S.READY, -r['replica_id']))
             for replica in victims[:max(0, excess)]:
                 threading.Thread(target=self._terminate_replica,
                                  args=(replica['replica_id'],),
                                  daemon=True).start()
+
+        # Cull old-version replicas as new ones come up (1:1, keeping
+        # capacity: never drop below target while rolling).
+        cullable = min(len(ready_new), len(old_active))
+        for replica in sorted(old_active,
+                              key=lambda r: r['replica_id'])[:cullable]:
+            ux_utils.log(f'Rolling update: retiring v{replica["version"]} '
+                         f'replica {replica["replica_id"]}.')
+            threading.Thread(target=self._terminate_replica,
+                             args=(replica['replica_id'],),
+                             daemon=True).start()
 
         # Update LB + service status.
         self.policy.set_ready_replicas(

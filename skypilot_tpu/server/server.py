@@ -244,7 +244,7 @@ async def cluster_job_logs(request: web.Request) -> web.StreamResponse:
 
 
 def create_app() -> web.Application:
-    app = web.Application()
+    app = web.Application(middlewares=[auth_middleware])
     for path, (name, entrypoint, schedule_type) in _ENDPOINTS.items():
         app.router.add_post(path, _mutating(name, entrypoint, schedule_type))
     app.router.add_get('/api/get', api_get)
@@ -265,7 +265,29 @@ def create_app() -> web.Application:
         serve_server.register(app)
     except ImportError:
         pass
+    try:
+        from skypilot_tpu.batch import server as batch_server
+        batch_server.register(app)
+    except ImportError:
+        pass
     return app
+
+
+@web.middleware
+async def auth_middleware(request: web.Request, handler):
+    """Static-token auth (reference analog: service-account tokens,
+    sky/server/auth/). Enabled when `api_server.auth_token` is set in
+    config or SKYPILOT_API_TOKEN in the server's env; /api/health stays
+    open for probes."""
+    import os as _os
+    from skypilot_tpu import sky_config
+    token = _os.environ.get('SKYPILOT_API_TOKEN') or sky_config.get_nested(
+        ('api_server', 'auth_token'))
+    if token and request.path != '/api/health':
+        supplied = request.headers.get('Authorization', '')
+        if supplied != f'Bearer {token}':
+            return web.json_response({'error': 'unauthorized'}, status=401)
+    return await handler(request)
 
 
 def run(host: str = '127.0.0.1',

@@ -1,0 +1,80 @@
+"""Batch map-over-dataset on the Local cloud."""
+import json
+import os
+import time
+
+import pytest
+
+from skypilot_tpu.batch import core as batch_core
+
+
+@pytest.fixture()
+def batch_env(isolated_state):
+    from skypilot_tpu import check
+    check.check(quiet=True)
+    yield isolated_state
+    for row in batch_core.ls():
+        batch_core.cancel(row['name'])
+
+
+@pytest.mark.slow
+def test_batch_maps_shards_to_outputs(batch_env, tmp_path):
+    # Input: 20 JSONL rows with integers; task doubles them.
+    input_path = tmp_path / 'input.jsonl'
+    with open(input_path, 'w') as f:
+        for i in range(20):
+            f.write(json.dumps({'x': i}) + '\n')
+    output_dir = tmp_path / 'out'
+
+    task_config = {
+        'name': 'double',
+        'resources': {'infra': 'local'},
+        'run': ('python3 -c "'
+                "import json, os\n"
+                "rows = [json.loads(l) for l in "
+                "open(os.environ['SKYPILOT_BATCH_SHARD'])]\n"
+                "with open(os.environ['SKYPILOT_BATCH_OUTPUT'], 'w') as f:\n"
+                "    for r in rows:\n"
+                "        f.write(json.dumps({'y': r['x'] * 2}) + '\\n')\n"
+                '"'),
+    }
+    batch_core.launch(task_config, 'b1', str(input_path), str(output_dir),
+                      num_workers=2, num_shards=4)
+    deadline = time.time() + 240
+    while time.time() < deadline:
+        row = batch_core.get('b1')
+        if row['status'].is_terminal():
+            break
+        time.sleep(2)
+    assert row['status'] == batch_core.BatchStatus.SUCCEEDED, row
+    assert row['shards_done'] == 4
+
+    # All 20 rows doubled across output shards.
+    ys = []
+    for fname in os.listdir(output_dir):
+        with open(output_dir / fname) as f:
+            ys += [json.loads(l)['y'] for l in f]
+    assert sorted(ys) == [i * 2 for i in range(20)]
+
+    # Workers torn down.
+    from skypilot_tpu import global_state
+    names = [c['name'] for c in global_state.get_clusters()]
+    assert not any(n.startswith('batch-b1') for n in names), names
+
+
+def test_batch_split_and_registry(batch_env, tmp_path):
+    input_path = tmp_path / 'in.jsonl'
+    with open(input_path, 'w') as f:
+        for i in range(7):
+            f.write(json.dumps({'i': i}) + '\n')
+    paths = batch_core.split_jsonl(str(input_path), str(tmp_path / 's'), 3)
+    counts = [len(open(p).readlines()) for p in paths]
+    assert sum(counts) == 7 and max(counts) - min(counts) <= 1
+
+    cfg = {'resources': {'infra': 'local'}, 'run': 'true'}
+    batch_core.launch(cfg, 'bx', str(input_path), str(tmp_path / 'o'),
+                      num_workers=1, num_shards=1)
+    with pytest.raises(Exception, match='already exists'):
+        batch_core.launch(cfg, 'bx', str(input_path), str(tmp_path / 'o'))
+    assert [r['name'] for r in batch_core.ls()] == ['bx']
+    batch_core.cancel('bx')

@@ -180,3 +180,67 @@ def test_serve_up_lb_down(serve_env):
     from skypilot_tpu import global_state
     names = [c['name'] for c in global_state.get_clusters()]
     assert not any(n.startswith('svc1-') for n in names), names
+
+
+_VERSIONED_RUN = (
+    'python3 -c "'
+    "import http.server, os, json\n"
+    "class H(http.server.BaseHTTPRequestHandler):\n"
+    "    def do_GET(self):\n"
+    "        body = json.dumps({'version': os.environ.get('APP_VERSION'),"
+    " 'pid': os.getpid()}).encode()\n"
+    "        self.send_response(200)\n"
+    "        self.send_header('Content-Length', str(len(body)))\n"
+    "        self.end_headers()\n"
+    "        self.wfile.write(body)\n"
+    "    def log_message(self, *a):\n"
+    "        pass\n"
+    "http.server.HTTPServer(('127.0.0.1', "
+    "int(os.environ['SKYPILOT_SERVE_PORT'])), H).serve_forever()\n"
+    '"')
+
+
+def _versioned_config(app_version: str):
+    return {
+        'name': 'echo',
+        'resources': {'infra': 'local'},
+        'envs': {'APP_VERSION': app_version},
+        'run': _VERSIONED_RUN,
+        'service': {
+            'readiness_probe': {'path': '/', 'initial_delay_seconds': 60},
+            'replicas': 2,
+        },
+    }
+
+
+@pytest.mark.slow
+def test_serve_rolling_update(serve_env):
+    result = serve_core.up(_versioned_config('v1'), 'svc2', user='t')
+    endpoint = result['endpoint']
+    _wait_ready('svc2', 2)
+    resp = requests.get(endpoint + '/', timeout=10)
+    assert resp.json()['version'] == 'v1'
+
+    serve_core.update(_versioned_config('v2'), 'svc2')
+    # Roll completes: all traffic moves to v2 while the service stays up.
+    deadline = time.time() + 240
+    while time.time() < deadline:
+        versions = set()
+        try:
+            for _ in range(4):
+                r = requests.get(endpoint + '/', timeout=10)
+                if r.status_code == 200:
+                    versions.add(r.json()['version'])
+        except requests.RequestException:
+            pass
+        if versions == {'v2'}:
+            break
+        time.sleep(3)
+    assert versions == {'v2'}, versions
+
+    # Old replicas culled: exactly the target count remains active.
+    rows = serve_core.status(['svc2'])[0]
+    active = [r for r in rows['replicas']
+              if r['status'] not in ('SHUTDOWN', 'FAILED')]
+    assert len(active) == 2, rows['replicas']
+    serve_core.down('svc2')

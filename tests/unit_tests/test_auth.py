@@ -1,0 +1,50 @@
+"""API token auth middleware."""
+import pytest
+
+from tests.test_api_server import _free_port
+
+
+@pytest.mark.slow
+def test_token_auth(isolated_state, monkeypatch):
+    import os
+    import subprocess
+    import sys
+    import time
+
+    import requests
+
+    port = _free_port()
+    url = f'http://127.0.0.1:{port}'
+    env = dict(os.environ)
+    env['SKYPILOT_TPU_HOME'] = isolated_state
+    env['SKYPILOT_API_TOKEN'] = 'sekrit'
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env['PYTHONPATH'] = f"{repo_root}:{env.get('PYTHONPATH', '')}"
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.server.server',
+         '--port', str(port)], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    try:
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                if requests.get(f'{url}/api/health', timeout=2).ok:
+                    break
+            except requests.RequestException:
+                time.sleep(0.3)
+        # Health open; everything else gated.
+        assert requests.get(f'{url}/api/health', timeout=5).status_code == 200
+        assert requests.post(f'{url}/check', json={},
+                             timeout=5).status_code == 401
+        assert requests.post(
+            f'{url}/check', json={},
+            headers={'Authorization': 'Bearer wrong'},
+            timeout=5).status_code == 401
+        ok = requests.post(f'{url}/check', json={},
+                           headers={'Authorization': 'Bearer sekrit'},
+                           timeout=5)
+        assert ok.status_code == 200 and 'request_id' in ok.json()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
