@@ -2,9 +2,10 @@
 from skypilot_tpu.clouds.cloud import (Cloud, CloudImplementationFeatures,
                                        Region, ResourcesFeasibility, Zone)
 from skypilot_tpu.clouds.gcp import GCP
+from skypilot_tpu.clouds.kubernetes import Kubernetes
 from skypilot_tpu.clouds.local import Local
 
 __all__ = [
     'Cloud', 'CloudImplementationFeatures', 'Region', 'ResourcesFeasibility',
-    'Zone', 'GCP', 'Local',
+    'Zone', 'GCP', 'Kubernetes', 'Local',
 ]

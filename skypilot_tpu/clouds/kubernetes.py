@@ -1,0 +1,120 @@
+"""Kubernetes cloud: GKE TPU pod slices + generic CPU pods.
+
+Reference: sky/clouds/kubernetes.py — region == kubeconfig context
+(`infra: k8s/<context>`); feasibility is optimistic (the scheduler
+owns placement), pricing is zero (BYO cluster).
+"""
+from __future__ import annotations
+
+import typing
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from skypilot_tpu.clouds import cloud
+from skypilot_tpu.utils import kubeconfig
+from skypilot_tpu.utils import tpu_utils
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+
+@CLOUD_REGISTRY.register(aliases=['k8s'])
+class Kubernetes(cloud.Cloud):
+    _REPR = 'Kubernetes'
+
+    @classmethod
+    def max_cluster_name_length(cls) -> Optional[int]:
+        return 40  # pod-name suffixes must stay under the 63-char cap
+
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        contexts = kubeconfig.load_contexts()
+        if not contexts:
+            return False, 'No kubeconfig contexts found (~/.kube/config).'
+        return True, None
+
+    def validate_region_zone(self, region: Optional[str],
+                             zone: Optional[str]):
+        if zone is not None:
+            raise ValueError('Kubernetes has no zones; use '
+                             'infra: k8s/<context>.')
+        if region is not None:
+            contexts = kubeconfig.load_contexts()
+            if contexts and region not in contexts:
+                raise ValueError(
+                    f'Context {region!r} not in kubeconfig; known: '
+                    f'{contexts}')
+        return region, zone
+
+    def get_hourly_cost(self, resources: 'resources_lib.Resources') -> float:
+        return 0.0  # BYO cluster
+
+    @classmethod
+    def get_default_instance_type(cls, cpus: Optional[str] = None,
+                                  memory: Optional[str] = None
+                                  ) -> Optional[str]:
+        return 'pod'
+
+    @classmethod
+    def get_vcpus_mem_from_instance_type(
+            cls, instance_type: str
+    ) -> Tuple[Optional[float], Optional[float]]:
+        return None, None
+
+    def instance_type_exists(self, instance_type: str) -> bool:
+        return instance_type == 'pod'
+
+    def get_feasible_launchable_resources(
+            self, resources: 'resources_lib.Resources',
+            num_nodes: int = 1) -> cloud.ResourcesFeasibility:
+        del num_nodes
+        accs = resources.accelerators
+        if accs is not None:
+            acc_name = next(iter(accs))
+            if not tpu_utils.is_tpu(acc_name):
+                return cloud.ResourcesFeasibility([], [])
+        return cloud.ResourcesFeasibility([resources.copy(cloud=self)], [])
+
+    @classmethod
+    def regions_with_offering(cls, instance_type, accelerators, use_spot,
+                              region, zone) -> List[cloud.Region]:
+        del instance_type, accelerators, use_spot, zone
+        contexts = kubeconfig.load_contexts()
+        if region is not None:
+            contexts = [c for c in contexts if c == region]
+        return [cloud.Region(c) for c in contexts]
+
+    @classmethod
+    def zones_provision_loop(cls, *, region: str, num_nodes: int,
+                             instance_type, accelerators, use_spot
+                             ) -> Iterator[Optional[List[cloud.Zone]]]:
+        del num_nodes, instance_type, accelerators, use_spot
+        yield None  # context-level provisioning, no zones
+
+    def make_deploy_resources_variables(
+            self, resources: 'resources_lib.Resources',
+            cluster_name_on_cloud: str, region: cloud.Region,
+            zones: Optional[List[cloud.Zone]],
+            num_nodes: int) -> Dict[str, Any]:
+        del zones
+        spec = resources.slice_spec
+        out: Dict[str, Any] = {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'context': region.name or None,
+            'namespace': None,  # default from kubeconfig
+            'num_nodes': num_nodes,
+            'image_id': resources.image_id,
+            'cpus': resources.cpus.rstrip('+') if resources.cpus else None,
+            'memory': (resources.memory.rstrip('+')
+                       if resources.memory else None),
+            'tpu_vm': spec is not None,
+        }
+        if spec is not None:
+            out.update({
+                'tpu_accelerator_type': spec.gcp_accelerator_type(),
+                'tpu_topology': resources.accelerator_args.get(
+                    'topology', spec.topology_str),
+                'tpu_num_hosts': spec.num_hosts,
+                'tpu_chips_per_host': spec.chips_per_host,
+            })
+        return out

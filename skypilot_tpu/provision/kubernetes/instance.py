@@ -1,0 +1,318 @@
+"""Kubernetes provisioner: pods-as-hosts, GKE TPU pod slices.
+
+Reference: sky/provision/kubernetes/ (the largest reference cloud).
+TPU-first shape: one Task node = one GKE TPU slice = `tpu_num_hosts`
+pods scheduled onto that slice's node pool via the GKE TPU selectors
+(cloud.google.com/gke-tpu-accelerator + gke-tpu-topology) with
+`google.com/tpu` chip limits; a headless Service gives pods stable
+DNS for the agent mesh. CPU tasks are plain pods. All HTTP goes
+through `_request()` (fake-API-testable, same pattern as
+provision/gcp/).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import requests as requests_lib
+
+from skypilot_tpu import constants
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.utils import kubeconfig
+
+_AGENT_IMAGE_DEFAULT = 'python:3.11-slim'
+
+
+def _ctx(provider_config: Optional[Dict[str, Any]]) -> kubeconfig.KubeContext:
+    pc = provider_config or {}
+    ctx = kubeconfig.load_context(pc.get('context'))
+    if ctx is None:
+        raise exceptions.NoCloudAccessError(
+            'No kubeconfig context available for the kubernetes cloud.')
+    if pc.get('namespace'):
+        ctx.namespace = pc['namespace']
+    return ctx
+
+
+def _request(ctx: kubeconfig.KubeContext, method: str, path: str,
+             json_body: Optional[Dict] = None) -> Dict[str, Any]:
+    url = f'{ctx.server}{path}'
+    resp = requests_lib.request(method, url, json=json_body, timeout=60,
+                                **ctx.request_kwargs())
+    if resp.status_code == 404:
+        raise exceptions.FetchClusterInfoError(
+            exceptions.FetchClusterInfoError.Reason.HEAD)
+    if resp.status_code >= 400:
+        raise exceptions.ProvisionerError(
+            f'k8s API {method} {path} -> {resp.status_code}: '
+            f'{resp.text[:500]}')
+    return resp.json() if resp.text else {}
+
+
+# ---------------------------------------------------------------------------
+# Manifests
+# ---------------------------------------------------------------------------
+def _pod_manifest(cluster: str, pod_name: str, pc: Dict[str, Any],
+                  node_rank: int, host_rank: int) -> Dict[str, Any]:
+    tpu = bool(pc.get('tpu_vm'))
+    container: Dict[str, Any] = {
+        'name': 'sky',
+        'image': pc.get('image_id') or _AGENT_IMAGE_DEFAULT,
+        'command': ['/bin/sh', '-c',
+                    'sleep infinity'],  # runtime bootstrapped by setup
+        'ports': [{'containerPort': constants.AGENT_PORT}],
+        'env': [
+            {'name': 'SKYPILOT_CLUSTER', 'value': cluster},
+            {'name': 'TPU_WORKER_ID', 'value': str(host_rank)},
+        ],
+    }
+    if tpu:
+        chips = int(pc.get('tpu_chips_per_host') or 4)
+        container['resources'] = {
+            'limits': {'google.com/tpu': chips},
+            'requests': {'google.com/tpu': chips},
+        }
+    else:
+        requests_map = {}
+        if pc.get('cpus'):
+            requests_map['cpu'] = str(pc['cpus'])
+        if pc.get('memory'):
+            requests_map['memory'] = f"{pc['memory']}Gi"
+        if requests_map:
+            container['resources'] = {'requests': requests_map}
+    spec: Dict[str, Any] = {
+        'restartPolicy': 'Never',
+        'containers': [container],
+        'hostname': pod_name,
+        'subdomain': cluster,
+    }
+    if tpu:
+        spec['nodeSelector'] = {
+            'cloud.google.com/gke-tpu-accelerator':
+                pc.get('gke_tpu_accelerator',
+                       _gke_accelerator(pc.get('tpu_accelerator_type', ''))),
+            'cloud.google.com/gke-tpu-topology':
+                pc.get('tpu_topology', ''),
+        }
+    return {
+        'apiVersion': 'v1',
+        'kind': 'Pod',
+        'metadata': {
+            'name': pod_name,
+            'labels': {
+                'skypilot-cluster': cluster,
+                'skypilot-node-rank': str(node_rank),
+                'skypilot-host-rank': str(host_rank),
+            },
+        },
+        'spec': spec,
+    }
+
+
+def _gke_accelerator(accelerator_type: str) -> str:
+    """'v5litepod-16' -> 'tpu-v5-lite-podslice'; 'v5p-128' -> 'tpu-v5p-slice'."""
+    prefix = accelerator_type.split('-')[0]
+    return {
+        'v4': 'tpu-v4-podslice',
+        'v5litepod': 'tpu-v5-lite-podslice',
+        'v5p': 'tpu-v5p-slice',
+        'v6e': 'tpu-v6e-slice',
+    }.get(prefix, 'tpu-v5-lite-podslice')
+
+
+def _service_manifest(cluster: str) -> Dict[str, Any]:
+    return {
+        'apiVersion': 'v1',
+        'kind': 'Service',
+        'metadata': {'name': cluster,
+                     'labels': {'skypilot-cluster': cluster}},
+        'spec': {
+            'clusterIP': 'None',  # headless: per-pod DNS
+            'selector': {'skypilot-cluster': cluster},
+            'ports': [{'port': constants.AGENT_PORT}],
+        },
+    }
+
+
+def _pod_names(cluster: str, num_nodes: int,
+               hosts_per_node: int) -> List[Dict[str, Any]]:
+    out = []
+    for node in range(num_nodes):
+        for host in range(hosts_per_node):
+            out.append({'name': f'{cluster}-{node}-{host}',
+                        'node_rank': node, 'host_rank': host})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Interface
+# ---------------------------------------------------------------------------
+def run_instances(region: str, cluster_name_on_cloud: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    del region
+    pc = dict(config.provider_config)
+    ctx = _ctx(pc)
+    ns = ctx.namespace
+    hosts_per_node = int(pc.get('tpu_num_hosts') or 1)
+    names = _pod_names(cluster_name_on_cloud, config.count, hosts_per_node)
+
+    try:
+        _request(ctx, 'GET',
+                 f'/api/v1/namespaces/{ns}/services/'
+                 f'{cluster_name_on_cloud}')
+    except exceptions.FetchClusterInfoError:
+        _request(ctx, 'POST', f'/api/v1/namespaces/{ns}/services',
+                 json_body=_service_manifest(cluster_name_on_cloud))
+
+    created = []
+    for entry in names:
+        try:
+            _request(ctx, 'GET',
+                     f'/api/v1/namespaces/{ns}/pods/{entry["name"]}')
+            continue  # exists
+        except exceptions.FetchClusterInfoError:
+            pass
+        _request(ctx, 'POST', f'/api/v1/namespaces/{ns}/pods',
+                 json_body=_pod_manifest(cluster_name_on_cloud,
+                                         entry['name'], pc,
+                                         entry['node_rank'],
+                                         entry['host_rank']))
+        created.append(entry['name'])
+
+    pc['namespace'] = ns
+    return common.ProvisionRecord(
+        provider_name='kubernetes',
+        cluster_name=cluster_name_on_cloud,
+        region=ctx.name,
+        zone=None,
+        head_instance_id=names[0]['name'],
+        created_instance_ids=created,
+        provider_config=pc,
+    )
+
+
+def _list_pods(ctx: kubeconfig.KubeContext,
+               cluster: str) -> List[Dict[str, Any]]:
+    out = _request(
+        ctx, 'GET',
+        f'/api/v1/namespaces/{ctx.namespace}/pods'
+        f'?labelSelector=skypilot-cluster%3D{cluster}')
+    return out.get('items', [])
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str,
+                   state: Optional[str] = None,
+                   provider_config: Optional[Dict[str, Any]] = None) -> None:
+    del region, state
+    ctx = _ctx(provider_config)
+    deadline = time.time() + constants.PROVISION_TIMEOUT_SECONDS
+    while True:
+        pods = _list_pods(ctx, cluster_name_on_cloud)
+        phases = [p.get('status', {}).get('phase') for p in pods]
+        if pods and all(ph == 'Running' for ph in phases):
+            return
+        if any(ph == 'Failed' for ph in phases):
+            raise exceptions.ProvisionerError(
+                f'Pod(s) failed for {cluster_name_on_cloud}: {phases}')
+        if time.time() > deadline:
+            raise exceptions.ProvisionerError(
+                f'Timed out waiting for pods of {cluster_name_on_cloud} '
+                f'({phases}).')
+        time.sleep(5)
+
+
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   worker_only: bool = False) -> None:
+    raise exceptions.NotSupportedError(
+        'Kubernetes pods cannot be stopped; use down.')
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Optional[Dict[str, Any]] = None,
+                        worker_only: bool = False) -> None:
+    del worker_only
+    try:
+        ctx = _ctx(provider_config)
+    except exceptions.NoCloudAccessError:
+        return
+    ns = ctx.namespace
+    for pod in _list_pods(ctx, cluster_name_on_cloud):
+        name = pod['metadata']['name']
+        try:
+            _request(ctx, 'DELETE', f'/api/v1/namespaces/{ns}/pods/{name}')
+        except exceptions.FetchClusterInfoError:
+            pass
+    try:
+        _request(ctx, 'DELETE',
+                 f'/api/v1/namespaces/{ns}/services/{cluster_name_on_cloud}')
+    except exceptions.FetchClusterInfoError:
+        pass
+
+
+_PHASE_MAP = {
+    'Running': 'running',
+    'Pending': 'pending',
+    'Succeeded': None,
+    'Failed': None,
+    'Unknown': 'pending',
+}
+
+
+def query_instances(cluster_name_on_cloud: str,
+                    provider_config: Optional[Dict[str, Any]] = None,
+                    non_terminated_only: bool = True
+                    ) -> Dict[str, Optional[str]]:
+    ctx = _ctx(provider_config)
+    out: Dict[str, Optional[str]] = {}
+    for pod in _list_pods(ctx, cluster_name_on_cloud):
+        status = _PHASE_MAP.get(pod.get('status', {}).get('phase'),
+                                'pending')
+        if non_terminated_only and status is None:
+            continue
+        out[pod['metadata']['name']] = status
+    return out
+
+
+def get_cluster_info(region: str, cluster_name_on_cloud: str,
+                     provider_config: Optional[Dict[str, Any]] = None
+                     ) -> common.ClusterInfo:
+    del region
+    ctx = _ctx(provider_config)
+    pods = sorted(_list_pods(ctx, cluster_name_on_cloud),
+                  key=lambda p: p['metadata']['name'])
+    if not pods:
+        raise exceptions.FetchClusterInfoError(
+            exceptions.FetchClusterInfoError.Reason.HEAD)
+    instances = []
+    for pod in pods:
+        meta = pod['metadata']
+        labels = meta.get('labels', {})
+        instances.append(common.InstanceInfo(
+            instance_id=meta['name'],
+            internal_ip=pod.get('status', {}).get('podIP', ''),
+            external_ip=None,
+            ssh_port=-1,
+            agent_port=constants.AGENT_PORT,
+            node_rank=int(labels.get('skypilot-node-rank', 0)),
+            host_rank=int(labels.get('skypilot-host-rank', 0)),
+        ))
+    return common.ClusterInfo(
+        instances=instances,
+        head_instance_id=instances[0].instance_id,
+        provider_name='kubernetes',
+        provider_config=dict(provider_config or {}),
+        ssh_user='root',
+        custom={'namespace': ctx.namespace, 'context': ctx.name},
+    )
+
+
+def open_ports(cluster_name_on_cloud: str, ports: List[str],
+               provider_config: Optional[Dict[str, Any]] = None) -> None:
+    pass  # service/ingress exposure lands with the full k8s backend
+
+
+def cleanup_ports(cluster_name_on_cloud: str, ports: List[str],
+                  provider_config: Optional[Dict[str, Any]] = None) -> None:
+    pass

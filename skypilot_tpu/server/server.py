@@ -270,6 +270,8 @@ def create_app() -> web.Application:
         batch_server.register(app)
     except ImportError:
         pass
+    from skypilot_tpu.server import dashboard
+    dashboard.register(app)
     return app
 
 

@@ -1,0 +1,128 @@
+"""Minimal kubeconfig loader: enough to call the k8s REST API.
+
+Supports bearer-token and client-certificate auth entries plus CA /
+insecure-skip-verify; exec-plugin credentials (gke-gcloud-auth-plugin)
+are resolved by running the plugin once. The reference uses the
+official client (sky/adaptors/kubernetes.py); this build keeps the
+dependency surface to requests.
+"""
+from __future__ import annotations
+
+import base64
+import json
+import os
+import subprocess
+import tempfile
+from typing import Any, Dict, List, Optional, Tuple
+
+import yaml
+
+DEFAULT_PATH = '~/.kube/config'
+
+
+class KubeContext:
+
+    def __init__(self, name: str, server: str,
+                 token: Optional[str] = None,
+                 ca_data: Optional[bytes] = None,
+                 client_cert: Optional[bytes] = None,
+                 client_key: Optional[bytes] = None,
+                 insecure: bool = False,
+                 namespace: str = 'default') -> None:
+        self.name = name
+        self.server = server.rstrip('/')
+        self.token = token
+        self.insecure = insecure
+        self.namespace = namespace
+        self._ca_file = self._tmp(ca_data, '.ca.crt')
+        self._cert_file = self._tmp(client_cert, '.client.crt')
+        self._key_file = self._tmp(client_key, '.client.key')
+
+    @staticmethod
+    def _tmp(data: Optional[bytes], suffix: str) -> Optional[str]:
+        if not data:
+            return None
+        f = tempfile.NamedTemporaryFile(suffix=suffix, delete=False)
+        f.write(data)
+        f.close()
+        return f.name
+
+    # -- requests kwargs -----------------------------------------------------
+    def request_kwargs(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        headers = {}
+        if self.token:
+            headers['Authorization'] = f'Bearer {self.token}'
+        out['headers'] = headers
+        if self.insecure:
+            out['verify'] = False
+        elif self._ca_file:
+            out['verify'] = self._ca_file
+        if self._cert_file and self._key_file:
+            out['cert'] = (self._cert_file, self._key_file)
+        return out
+
+
+def _b64(field: Optional[str]) -> Optional[bytes]:
+    return base64.b64decode(field) if field else None
+
+
+def _resolve_exec_token(exec_spec: Dict[str, Any]) -> Optional[str]:
+    cmd = [exec_spec['command'], *exec_spec.get('args', [])]
+    env = dict(os.environ)
+    for item in exec_spec.get('env') or []:
+        env[item['name']] = item['value']
+    try:
+        out = subprocess.run(cmd, env=env, capture_output=True, check=True,
+                             timeout=30).stdout
+        cred = json.loads(out)
+        return cred.get('status', {}).get('token')
+    except (subprocess.SubprocessError, OSError, ValueError):
+        return None
+
+
+def load_contexts(path: str = DEFAULT_PATH) -> List[str]:
+    path = os.path.expanduser(path)
+    if not os.path.exists(path):
+        return []
+    with open(path, 'r', encoding='utf-8') as f:
+        config = yaml.safe_load(f) or {}
+    return [c['name'] for c in config.get('contexts', [])]
+
+
+def load_context(context_name: Optional[str] = None,
+                 path: str = DEFAULT_PATH) -> Optional[KubeContext]:
+    path = os.path.expanduser(path)
+    if not os.path.exists(path):
+        return None
+    with open(path, 'r', encoding='utf-8') as f:
+        config = yaml.safe_load(f) or {}
+    context_name = context_name or config.get('current-context')
+    if not context_name:
+        return None
+    ctx_entry = next((c for c in config.get('contexts', [])
+                      if c['name'] == context_name), None)
+    if ctx_entry is None:
+        return None
+    cluster_name = ctx_entry['context']['cluster']
+    user_name = ctx_entry['context']['user']
+    namespace = ctx_entry['context'].get('namespace', 'default')
+    cluster = next((c['cluster'] for c in config.get('clusters', [])
+                    if c['name'] == cluster_name), None)
+    user = next((u['user'] for u in config.get('users', [])
+                 if u['name'] == user_name), {})
+    if cluster is None:
+        return None
+    token = user.get('token')
+    if token is None and 'exec' in user:
+        token = _resolve_exec_token(user['exec'])
+    return KubeContext(
+        name=context_name,
+        server=cluster['server'],
+        token=token,
+        ca_data=_b64(cluster.get('certificate-authority-data')),
+        client_cert=_b64(user.get('client-certificate-data')),
+        client_key=_b64(user.get('client-key-data')),
+        insecure=bool(cluster.get('insecure-skip-tls-verify')),
+        namespace=namespace,
+    )

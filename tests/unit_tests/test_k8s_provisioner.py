@@ -1,0 +1,160 @@
+"""Kubernetes provisioner against a fake k8s API server (in-memory)."""
+import re
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.kubernetes import instance as k8s_instance
+from skypilot_tpu.utils import kubeconfig
+
+
+class FakeK8s:
+    """Emulates the pods/services endpoints used by the provisioner."""
+
+    def __init__(self):
+        self.pods = {}
+        self.services = {}
+        self._ip = 10
+
+    def request(self, method, path, json_body=None):
+        m = re.match(r'/api/v1/namespaces/([^/]+)/(pods|services)'
+                     r'(?:/([^?]+))?(?:\?labelSelector=(.*))?$', path)
+        assert m, path
+        ns, kind, name, selector = m.groups()
+        store = self.pods if kind == 'pods' else self.services
+        if method == 'POST':
+            manifest = dict(json_body)
+            pod_name = manifest['metadata']['name']
+            if kind == 'pods':
+                manifest['status'] = {
+                    'phase': 'Pending', '_polls': 0,
+                    'podIP': f'10.0.0.{self._ip}'}
+                self._ip += 1
+            store[(ns, pod_name)] = manifest
+            return manifest
+        if method == 'GET' and name:
+            item = store.get((ns, name))
+            if item is None:
+                raise exceptions.FetchClusterInfoError(
+                    exceptions.FetchClusterInfoError.Reason.HEAD)
+            return item
+        if method == 'GET':
+            items = list(store.values())
+            if selector:
+                key, value = selector.replace('%3D', '=').split('=')
+                items = [i for i in items
+                         if i['metadata'].get('labels', {}).get(key) ==
+                         value]
+                # pods become Running on second list
+                for i in items:
+                    st = i.get('status')
+                    if st and st['phase'] == 'Pending':
+                        st['_polls'] += 1
+                        if st['_polls'] >= 2:
+                            st['phase'] = 'Running'
+            return {'items': items}
+        if method == 'DELETE':
+            if (ns, name) not in store:
+                raise exceptions.FetchClusterInfoError(
+                    exceptions.FetchClusterInfoError.Reason.HEAD)
+            del store[(ns, name)]
+            return {}
+        raise AssertionError(f'unhandled {method} {path}')
+
+
+@pytest.fixture()
+def fake_k8s(monkeypatch):
+    fake = FakeK8s()
+    ctx = kubeconfig.KubeContext('gke_test', 'https://fake')
+    monkeypatch.setattr(k8s_instance, '_ctx', lambda pc: ctx)
+    monkeypatch.setattr(
+        k8s_instance, '_request',
+        lambda ctx_, method, path, json_body=None:
+        fake.request(method, path, json_body))
+    import skypilot_tpu.provision.kubernetes.instance as mod
+    monkeypatch.setattr(mod.time, 'sleep', lambda s: None)
+    return fake
+
+
+def _config(count=1):
+    return common.ProvisionConfig(
+        provider_config={
+            'context': 'gke_test',
+            'tpu_vm': True,
+            'tpu_accelerator_type': 'v5litepod-16',
+            'tpu_topology': '4x4',
+            'tpu_num_hosts': 2,
+            'tpu_chips_per_host': 8,
+            'num_nodes': count,
+        },
+        authentication_config={}, count=count, tags={})
+
+
+def test_create_slice_pods_and_service(fake_k8s):
+    record = k8s_instance.run_instances('gke_test', 'kc1', _config())
+    assert record.created_instance_ids == ['kc1-0-0', 'kc1-0-1']
+    assert ('default', 'kc1') in fake_k8s.services
+    pod = fake_k8s.pods[('default', 'kc1-0-0')]
+    sel = pod['spec']['nodeSelector']
+    assert sel['cloud.google.com/gke-tpu-accelerator'] == \
+        'tpu-v5-lite-podslice'
+    assert sel['cloud.google.com/gke-tpu-topology'] == '4x4'
+    limits = pod['spec']['containers'][0]['resources']['limits']
+    assert limits['google.com/tpu'] == 8
+
+    k8s_instance.wait_instances('gke_test', 'kc1',
+                                provider_config=_config().provider_config)
+    info = k8s_instance.get_cluster_info('gke_test', 'kc1',
+                                         _config().provider_config)
+    assert info.num_instances == 2
+    assert [(i.node_rank, i.host_rank) for i in info.sorted_instances()] \
+        == [(0, 0), (0, 1)]
+    assert info.get_head_instance().internal_ip.startswith('10.0.0.')
+
+
+def test_query_and_terminate(fake_k8s):
+    cfg = _config(count=2)
+    k8s_instance.run_instances('gke_test', 'kc2', cfg)
+    statuses = k8s_instance.query_instances('kc2', cfg.provider_config)
+    assert len(statuses) == 4
+    k8s_instance.terminate_instances('kc2', cfg.provider_config)
+    assert not fake_k8s.pods
+    assert not fake_k8s.services
+    with pytest.raises(exceptions.FetchClusterInfoError):
+        k8s_instance.get_cluster_info('gke_test', 'kc2',
+                                      cfg.provider_config)
+
+
+def test_stop_unsupported(fake_k8s):
+    with pytest.raises(exceptions.NotSupportedError):
+        k8s_instance.stop_instances('kc3', {})
+
+
+def test_kubeconfig_parsing(tmp_path):
+    import base64
+    cfg = tmp_path / 'config'
+    ca = base64.b64encode(b'CERT').decode()
+    cfg.write_text(f"""
+apiVersion: v1
+current-context: ctx-a
+contexts:
+- name: ctx-a
+  context: {{cluster: c1, user: u1, namespace: ml}}
+clusters:
+- name: c1
+  cluster:
+    server: https://1.2.3.4:6443
+    certificate-authority-data: {ca}
+users:
+- name: u1
+  user:
+    token: tok123
+""")
+    assert kubeconfig.load_contexts(str(cfg)) == ['ctx-a']
+    ctx = kubeconfig.load_context(path=str(cfg))
+    assert ctx.server == 'https://1.2.3.4:6443'
+    assert ctx.namespace == 'ml'
+    kwargs = ctx.request_kwargs()
+    assert kwargs['headers']['Authorization'] == 'Bearer tok123'
+    assert kwargs['verify'].endswith('.ca.crt')
