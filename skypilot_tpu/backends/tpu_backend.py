@@ -136,7 +136,10 @@ class RetryingProvisioner:
                 instance_type=to_provision.instance_type,
                 accelerators=to_provision.accelerators,
                 use_spot=to_provision.use_spot)
+            skip_region = False
             for zones in zone_iter:
+                if skip_region:
+                    break
                 if to_provision.zone is not None and zones and \
                         zones[0].name != to_provision.zone:
                     continue
@@ -153,10 +156,11 @@ class RetryingProvisioner:
                     return record, resolved, region
                 except Exception as e:  # pylint: disable=broad-except
                     zone_str = zones[0].name if zones else region.name
+                    category = getattr(e, 'category', 'transient')
                     ux_utils.log(
-                        f'Provisioning in {zone_str} failed: '
-                        f'{common_utils.format_exception(e)}; '
-                        'trying next location.')
+                        f'Provisioning in {zone_str} failed '
+                        f'[{category}]: '
+                        f'{common_utils.format_exception(e)}')
                     self.failover_history.append(e)
                     # Best-effort cleanup of partial creations (deploy
                     # vars carry the zone the attempt targeted).
@@ -167,6 +171,20 @@ class RetryingProvisioner:
                             provider_config=deploy_vars)
                     except Exception:  # pylint: disable=broad-except
                         pass
+                    # Category-directed failover (reference:
+                    # FailoverCloudErrorHandlerV2 blocklist semantics).
+                    if getattr(e, 'no_failover', False):
+                        raise exceptions.ResourcesUnavailableError(
+                            f'Non-retryable provisioning error in '
+                            f'{zone_str}: '
+                            f'{common_utils.format_exception(e)}',
+                            no_failover=True,
+                            failover_history=self.failover_history)
+                    if getattr(e, 'blocks_region', False):
+                        ux_utils.log(
+                            f'Quota exhausted in region {region.name}; '
+                            'skipping its remaining zones.')
+                        skip_region = True
                     continue
         raise exceptions.ResourcesUnavailableError(
             f'Failed to provision {to_provision} in all candidate '

@@ -83,11 +83,37 @@ class ClusterSetUpError(SkyError):
 
 
 class ProvisionerError(SkyError):
-    """Low-level provision failure for one zone attempt."""
+    """Low-level provision failure for one zone attempt.
 
-    def __init__(self, message: str, errors: Optional[List[Dict[str, Any]]] = None):
+    `category` steers the failover engine (reference:
+    FailoverCloudErrorHandlerV2's error→blocklist mapping):
+      capacity   → block this zone, try the next one
+      quota      → block the whole region (quotas are regional)
+      permission → non-retryable: no location will fix credentials
+      config     → non-retryable: the request itself is invalid
+      transient  → retry the same zone is fine; we still move on
+    """
+
+    CAPACITY = 'capacity'
+    QUOTA = 'quota'
+    PERMISSION = 'permission'
+    CONFIG = 'config'
+    TRANSIENT = 'transient'
+
+    def __init__(self, message: str,
+                 errors: Optional[List[Dict[str, Any]]] = None,
+                 category: str = 'transient'):
         super().__init__(message)
         self.errors = errors or []
+        self.category = category
+
+    @property
+    def no_failover(self) -> bool:
+        return self.category in (self.PERMISSION, self.CONFIG)
+
+    @property
+    def blocks_region(self) -> bool:
+        return self.category == self.QUOTA
 
 
 class ProvisionPrechecksError(SkyError):

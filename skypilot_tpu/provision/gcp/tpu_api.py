@@ -58,8 +58,28 @@ def _request(method: str, path: str, *, json_body: Optional[Dict] = None,
     if resp.status_code >= 400:
         raise exceptions.ProvisionerError(
             f'TPU API {method} {path} -> {resp.status_code}: '
-            f'{resp.text[:500]}')
+            f'{resp.text[:500]}',
+            category=_classify_error(resp.status_code, resp.text))
     return resp.json() if resp.text else {}
+
+
+def _classify_error(status_code: int, text: str) -> str:
+    """Map a TPU API error to a failover category (reference:
+    FailoverCloudErrorHandlerV2, cloud_vm_ray_backend.py:522 — the
+    error→blocklist mapping that decides what a failure blocks)."""
+    lower = text.lower()
+    if 'quota' in lower or 'rate limit' in lower:
+        return exceptions.ProvisionerError.QUOTA
+    if status_code == 429 or 'no more capacity' in lower or \
+            'resource_exhausted' in lower or 'stockout' in lower or \
+            'not enough resources' in lower or \
+            'currently unavailable' in lower:
+        return exceptions.ProvisionerError.CAPACITY
+    if status_code in (401, 403):
+        return exceptions.ProvisionerError.PERMISSION
+    if status_code == 400 or 'invalid' in lower:
+        return exceptions.ProvisionerError.CONFIG
+    return exceptions.ProvisionerError.TRANSIENT
 
 
 # ---------------------------------------------------------------------------

@@ -182,3 +182,68 @@ def test_capacity_error_classified(fake_api):
     with pytest.raises(exceptions.ProvisionerError, match='no capacity'):
         gcp_instance.run_instances('us-central2', 'c5',
                                    _config(zone='us-central2-b'))
+
+
+def test_error_classification():
+    from skypilot_tpu.provision.gcp.tpu_api import _classify_error
+    P = exceptions.ProvisionerError
+    assert _classify_error(429, 'no more capacity in zone') == P.CAPACITY
+    assert _classify_error(403, 'Quota TPUS_PER_PROJECT exceeded') == P.QUOTA
+    assert _classify_error(403, 'caller lacks permission') == P.PERMISSION
+    assert _classify_error(400, 'Invalid acceleratorType') == P.CONFIG
+    assert _classify_error(503, 'backend error') == P.TRANSIENT
+    assert P('x', category=P.PERMISSION).no_failover
+    assert P('x', category=P.QUOTA).blocks_region
+    assert not P('x', category=P.CAPACITY).no_failover
+
+
+def test_failover_engine_honors_categories(fake_api, monkeypatch):
+    """Permission errors abort failover; capacity errors keep walking."""
+    from skypilot_tpu.backends.tpu_backend import RetryingProvisioner
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu import resources as resources_lib
+
+    task = task_lib.Task(run='true')
+    r = resources_lib.Resources(infra='gcp', accelerators='tpu-v5e-16')
+    task.set_resources(r)
+
+    # All zones fail with capacity -> walks every candidate, then raises
+    # a retryable ResourcesUnavailableError with full history.
+    from skypilot_tpu.provision.gcp import tpu_api
+    calls = []
+
+    def cap_fail(method, path, json_body=None, params=None):
+        if method == 'POST' and ('nodes' in path or
+                                 'queuedResources' in path):
+            calls.append(path)
+            raise exceptions.ProvisionerError(
+                'no more capacity',
+                category=exceptions.ProvisionerError.CAPACITY)
+        return fake_api.request(method, path, json_body, params)
+
+    monkeypatch.setattr(tpu_api, '_request', cap_fail)
+    prov = RetryingProvisioner()
+    with pytest.raises(exceptions.ResourcesUnavailableError) as exc_info:
+        prov.provision_with_retries(task, r, 'cf', 'cf')
+    assert not exc_info.value.no_failover
+    assert len(calls) >= 2  # tried multiple zones
+    assert len(prov.failover_history) == len(calls)
+
+    # Permission error -> immediate no-failover abort after 1 attempt.
+    calls.clear()
+
+    def perm_fail(method, path, json_body=None, params=None):
+        if method == 'POST' and ('nodes' in path or
+                                 'queuedResources' in path):
+            calls.append(path)
+            raise exceptions.ProvisionerError(
+                'permission denied',
+                category=exceptions.ProvisionerError.PERMISSION)
+        return fake_api.request(method, path, json_body, params)
+
+    monkeypatch.setattr(tpu_api, '_request', perm_fail)
+    prov = RetryingProvisioner()
+    with pytest.raises(exceptions.ResourcesUnavailableError) as exc_info:
+        prov.provision_with_retries(task, r, 'pf', 'pf')
+    assert exc_info.value.no_failover
+    assert len(calls) == 1
