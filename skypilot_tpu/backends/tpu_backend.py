@@ -136,10 +136,7 @@ class RetryingProvisioner:
                 instance_type=to_provision.instance_type,
                 accelerators=to_provision.accelerators,
                 use_spot=to_provision.use_spot)
-            skip_region = False
             for zones in zone_iter:
-                if skip_region:
-                    break
                 if to_provision.zone is not None and zones and \
                         zones[0].name != to_provision.zone:
                     continue
@@ -184,7 +181,7 @@ class RetryingProvisioner:
                         ux_utils.log(
                             f'Quota exhausted in region {region.name}; '
                             'skipping its remaining zones.')
-                        skip_region = True
+                        break
                     continue
         raise exceptions.ResourcesUnavailableError(
             f'Failed to provision {to_provision} in all candidate '
@@ -271,8 +268,8 @@ class TpuVmBackend(backend_lib.Backend[TpuVmResourceHandle]):
                         cluster_name_on_cloud,
                         blocked_resources=blocked_resources)
                 break
-            except exceptions.ResourcesUnavailableError:
-                if not retry_until_up:
+            except exceptions.ResourcesUnavailableError as e:
+                if e.no_failover or not retry_until_up:
                     raise
                 wait = backoff.current_backoff()
                 ux_utils.log(f'Retrying provisioning in {wait:.0f}s '

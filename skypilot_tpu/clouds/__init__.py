@@ -4,8 +4,9 @@ from skypilot_tpu.clouds.cloud import (Cloud, CloudImplementationFeatures,
 from skypilot_tpu.clouds.gcp import GCP
 from skypilot_tpu.clouds.kubernetes import Kubernetes
 from skypilot_tpu.clouds.local import Local
+from skypilot_tpu.clouds.ssh import SSH
 
 __all__ = [
     'Cloud', 'CloudImplementationFeatures', 'Region', 'ResourcesFeasibility',
-    'Zone', 'GCP', 'Kubernetes', 'Local',
+    'Zone', 'GCP', 'Kubernetes', 'Local', 'SSH',
 ]

@@ -1,0 +1,141 @@
+"""SSH cloud: bring-your-own machines as a provisioning target.
+
+Reference: sky/ssh_node_pools/ + the `ssh` cloud — machines declared
+in `~/.sky-tpu/ssh_node_pools.yaml` become schedulable hosts:
+
+    pools:
+      my-pool:
+        user: ubuntu
+        identity_file: ~/.ssh/id_ed25519
+        hosts:
+          - 10.0.0.1
+          - ip: 10.0.0.2
+            user: other
+            port: 2222
+
+A "region" is a pool name (`infra: ssh/my-pool`); provisioning
+allocates free hosts from the pool (bookkeeping in the state dir) and
+bootstraps agents over SSH like any cloud host.
+"""
+from __future__ import annotations
+
+import os
+import typing
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import yaml
+
+from skypilot_tpu import constants
+from skypilot_tpu.clouds import cloud
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+POOLS_PATH = '~/.sky-tpu/ssh_node_pools.yaml'
+
+
+def load_pools(path: Optional[str] = None) -> Dict[str, Dict[str, Any]]:
+    path = os.path.expanduser(path or POOLS_PATH)
+    if not os.path.exists(path):
+        return {}
+    with open(path, 'r', encoding='utf-8') as f:
+        config = yaml.safe_load(f) or {}
+    pools = config.get('pools', config) or {}
+    out: Dict[str, Dict[str, Any]] = {}
+    for name, pool in pools.items():
+        pool = dict(pool or {})
+        default_user = pool.get('user', 'root')
+        default_key = pool.get('identity_file', '~/.ssh/id_ed25519')
+        hosts = []
+        for h in pool.get('hosts', []):
+            if isinstance(h, str):
+                h = {'ip': h}
+            hosts.append({
+                'ip': h['ip'],
+                'user': h.get('user', default_user),
+                'identity_file': h.get('identity_file', default_key),
+                'port': int(h.get('port', 22)),
+            })
+        out[name] = {'hosts': hosts}
+    return out
+
+
+@CLOUD_REGISTRY.register()
+class SSH(cloud.Cloud):
+    _REPR = 'SSH'
+
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        pools = load_pools()
+        if not pools:
+            return False, (f'No SSH node pools at {POOLS_PATH}.')
+        return True, None
+
+    def validate_region_zone(self, region: Optional[str],
+                             zone: Optional[str]):
+        if zone is not None:
+            raise ValueError('SSH pools have no zones.')
+        if region is not None and region not in load_pools():
+            raise ValueError(
+                f'SSH pool {region!r} not found; known: '
+                f'{sorted(load_pools())}')
+        return region, zone
+
+    def get_hourly_cost(self, resources: 'resources_lib.Resources') -> float:
+        return 0.0  # BYO hardware
+
+    @classmethod
+    def get_default_instance_type(cls, cpus=None, memory=None):
+        return 'ssh-host'
+
+    @classmethod
+    def get_vcpus_mem_from_instance_type(cls, instance_type):
+        return None, None
+
+    def instance_type_exists(self, instance_type: str) -> bool:
+        return instance_type == 'ssh-host'
+
+    def get_feasible_launchable_resources(
+            self, resources: 'resources_lib.Resources',
+            num_nodes: int = 1) -> cloud.ResourcesFeasibility:
+        if resources.accelerators is not None and \
+                not resources.is_tpu_slice:
+            return cloud.ResourcesFeasibility([], [])
+        pools = load_pools()
+        candidates = pools
+        if resources.region is not None:
+            candidates = {k: v for k, v in pools.items()
+                          if k == resources.region}
+        for pool in candidates.values():
+            if len(pool['hosts']) >= num_nodes:
+                return cloud.ResourcesFeasibility(
+                    [resources.copy(cloud=self)], [])
+        return cloud.ResourcesFeasibility([], [])
+
+    @classmethod
+    def regions_with_offering(cls, instance_type, accelerators, use_spot,
+                              region, zone) -> List[cloud.Region]:
+        del instance_type, accelerators, use_spot, zone
+        pools = load_pools()
+        names = [region] if region else sorted(pools)
+        return [cloud.Region(n) for n in names if n in pools]
+
+    @classmethod
+    def zones_provision_loop(cls, *, region, num_nodes, instance_type,
+                             accelerators, use_spot):
+        del num_nodes, instance_type, accelerators, use_spot
+        yield None
+
+    def make_deploy_resources_variables(
+            self, resources: 'resources_lib.Resources',
+            cluster_name_on_cloud: str, region: cloud.Region,
+            zones, num_nodes: int) -> Dict[str, Any]:
+        del zones
+        return {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'pool': region.name,
+            'num_nodes': num_nodes,
+            'tpu_vm': False,
+            'tpu_num_hosts': 1,
+        }

@@ -97,8 +97,12 @@ class Optimizer:
                 if cloud_cls is None:
                     continue
                 cloud = cloud_cls()
-                feasibility = cloud.get_feasible_launchable_resources(
-                    requested, task.num_nodes)
+                try:
+                    feasibility = cloud.get_feasible_launchable_resources(
+                        requested, task.num_nodes)
+                except (ValueError, exceptions.InvalidResourcesError,
+                        exceptions.InvalidTaskYAMLError):
+                    continue  # request not expressible on this cloud
                 for cand in feasibility.resources_list:
                     if cls._is_blocked(cand, blocked_resources):
                         continue
@@ -134,8 +138,18 @@ class Optimizer:
                 cloud_cls = CLOUD_REGISTRY.get(cloud_name)
                 if cloud_cls is None:
                     continue
-                feasibility = cloud_cls().get_feasible_launchable_resources(
-                    requested, task.num_nodes)
+                if requested.cloud is not None and \
+                        requested.cloud.canonical_name() != cloud_name:
+                    continue
+                try:
+                    feasibility = \
+                        cloud_cls().get_feasible_launchable_resources(
+                            requested, task.num_nodes)
+                except (ValueError, exceptions.InvalidResourcesError,
+                        exceptions.InvalidTaskYAMLError):
+                    # A request pinned to another cloud's region/pool is
+                    # simply infeasible here, not an error.
+                    continue
                 out.extend(feasibility.fuzzy_candidate_list)
         return sorted(set(out))
 

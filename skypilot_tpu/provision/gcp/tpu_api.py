@@ -68,16 +68,21 @@ def _classify_error(status_code: int, text: str) -> str:
     FailoverCloudErrorHandlerV2, cloud_vm_ray_backend.py:522 — the
     error→blocklist mapping that decides what a failure blocks)."""
     lower = text.lower()
-    if 'quota' in lower or 'rate limit' in lower:
-        return exceptions.ProvisionerError.QUOTA
-    if status_code == 429 or 'no more capacity' in lower or \
-            'resource_exhausted' in lower or 'stockout' in lower or \
-            'not enough resources' in lower or \
+    if status_code == 429:
+        # API rate throttles ('per minute' quota metrics) are transient;
+        # anything else at 429 is a capacity signal.
+        if 'rate limit' in lower or 'per minute' in lower:
+            return exceptions.ProvisionerError.TRANSIENT
+        return exceptions.ProvisionerError.CAPACITY
+    if 'no more capacity' in lower or 'resource_exhausted' in lower or \
+            'stockout' in lower or 'not enough resources' in lower or \
             'currently unavailable' in lower:
         return exceptions.ProvisionerError.CAPACITY
+    if status_code == 403 and 'quota' in lower:
+        return exceptions.ProvisionerError.QUOTA
     if status_code in (401, 403):
         return exceptions.ProvisionerError.PERMISSION
-    if status_code == 400 or 'invalid' in lower:
+    if status_code == 400:
         return exceptions.ProvisionerError.CONFIG
     return exceptions.ProvisionerError.TRANSIENT
 

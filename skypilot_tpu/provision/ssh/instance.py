@@ -1,0 +1,152 @@
+"""SSH-pool provisioner: allocate BYO hosts, bookkeeping in state dir.
+
+"Provisioning" = claiming free pool hosts for a cluster (allocations
+persisted as JSON under the state dir with a file lock); teardown
+releases them. Runtime bootstrap happens through the normal
+instance_setup SSH path.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import constants
+from skypilot_tpu import exceptions
+from skypilot_tpu.clouds import ssh as ssh_cloud
+from skypilot_tpu.provision import common
+from skypilot_tpu.utils import locks
+
+
+def _alloc_path() -> str:
+    return os.path.join(constants.sky_home(), 'ssh_allocations.json')
+
+
+def _load_allocations() -> Dict[str, Any]:
+    try:
+        with open(_alloc_path(), 'r', encoding='utf-8') as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _save_allocations(alloc: Dict[str, Any]) -> None:
+    os.makedirs(os.path.dirname(_alloc_path()), exist_ok=True)
+    with open(_alloc_path(), 'w', encoding='utf-8') as f:
+        json.dump(alloc, f, indent=1)
+
+
+def run_instances(region: str, cluster_name_on_cloud: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    pc = dict(config.provider_config)
+    pool_name = pc.get('pool') or region
+    pools = ssh_cloud.load_pools()
+    if pool_name not in pools:
+        raise exceptions.ProvisionerError(
+            f'SSH pool {pool_name!r} not found.',
+            category=exceptions.ProvisionerError.CONFIG)
+    hosts = pools[pool_name]['hosts']
+
+    with locks.FileLock(_alloc_path() + '.lock'):
+        alloc = _load_allocations()
+        mine = alloc.get(cluster_name_on_cloud)
+        if mine is None:
+            taken = {h['ip'] for entry in alloc.values()
+                     for h in entry['hosts']}
+            free = [h for h in hosts if h['ip'] not in taken]
+            if len(free) < config.count:
+                raise exceptions.ProvisionerError(
+                    f'Pool {pool_name!r} has {len(free)} free hosts; '
+                    f'need {config.count}.',
+                    category=exceptions.ProvisionerError.CAPACITY)
+            mine = {'pool': pool_name, 'hosts': free[:config.count],
+                    'created_at': time.time()}
+            alloc[cluster_name_on_cloud] = mine
+            _save_allocations(alloc)
+        created = [h['ip'] for h in mine['hosts']]
+
+    pc['pool'] = pool_name
+    return common.ProvisionRecord(
+        provider_name='ssh',
+        cluster_name=cluster_name_on_cloud,
+        region=pool_name,
+        zone=None,
+        head_instance_id=created[0],
+        created_instance_ids=created,
+        provider_config=pc,
+    )
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str,
+                   state: Optional[str] = None,
+                   provider_config: Optional[Dict[str, Any]] = None) -> None:
+    del region, cluster_name_on_cloud, state, provider_config
+    # Hosts already exist; reachability is validated by agent bootstrap.
+
+
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   worker_only: bool = False) -> None:
+    raise exceptions.NotSupportedError(
+        'BYO SSH hosts cannot be stopped; use down to release them.')
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Optional[Dict[str, Any]] = None,
+                        worker_only: bool = False) -> None:
+    del provider_config, worker_only
+    with locks.FileLock(_alloc_path() + '.lock'):
+        alloc = _load_allocations()
+        if cluster_name_on_cloud in alloc:
+            del alloc[cluster_name_on_cloud]
+            _save_allocations(alloc)
+
+
+def query_instances(cluster_name_on_cloud: str,
+                    provider_config: Optional[Dict[str, Any]] = None,
+                    non_terminated_only: bool = True
+                    ) -> Dict[str, Optional[str]]:
+    del provider_config, non_terminated_only
+    alloc = _load_allocations().get(cluster_name_on_cloud)
+    if alloc is None:
+        return {}
+    return {h['ip']: 'running' for h in alloc['hosts']}
+
+
+def get_cluster_info(region: str, cluster_name_on_cloud: str,
+                     provider_config: Optional[Dict[str, Any]] = None
+                     ) -> common.ClusterInfo:
+    del region
+    alloc = _load_allocations().get(cluster_name_on_cloud)
+    if alloc is None:
+        raise exceptions.FetchClusterInfoError(
+            exceptions.FetchClusterInfoError.Reason.HEAD)
+    instances = []
+    for rank, host in enumerate(alloc['hosts']):
+        instances.append(common.InstanceInfo(
+            instance_id=host['ip'],
+            internal_ip=host['ip'],
+            external_ip=host['ip'],
+            ssh_port=host.get('port', 22),
+            agent_port=constants.AGENT_PORT,
+            node_rank=rank,
+            host_rank=0,
+        ))
+    first = alloc['hosts'][0]
+    return common.ClusterInfo(
+        instances=instances,
+        head_instance_id=instances[0].instance_id,
+        provider_name='ssh',
+        provider_config=dict(provider_config or {}),
+        ssh_user=first.get('user', 'root'),
+        ssh_private_key=first.get('identity_file'),
+    )
+
+
+def open_ports(cluster_name_on_cloud, ports, provider_config=None):
+    pass  # user-managed network
+
+
+def cleanup_ports(cluster_name_on_cloud, ports, provider_config=None):
+    pass

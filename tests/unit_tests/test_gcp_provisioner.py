@@ -188,9 +188,12 @@ def test_error_classification():
     from skypilot_tpu.provision.gcp.tpu_api import _classify_error
     P = exceptions.ProvisionerError
     assert _classify_error(429, 'no more capacity in zone') == P.CAPACITY
+    assert _classify_error(429, 'Quota exceeded for quota metric '
+                           'requests per minute') == P.TRANSIENT
     assert _classify_error(403, 'Quota TPUS_PER_PROJECT exceeded') == P.QUOTA
     assert _classify_error(403, 'caller lacks permission') == P.PERMISSION
     assert _classify_error(400, 'Invalid acceleratorType') == P.CONFIG
+    assert _classify_error(503, 'invalid state, please retry') == P.TRANSIENT
     assert _classify_error(503, 'backend error') == P.TRANSIENT
     assert P('x', category=P.PERMISSION).no_failover
     assert P('x', category=P.QUOTA).blocks_region

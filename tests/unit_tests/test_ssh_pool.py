@@ -1,0 +1,79 @@
+"""SSH node pools: parsing, allocation bookkeeping, release."""
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.clouds import ssh as ssh_cloud
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.ssh import instance as ssh_instance
+
+
+@pytest.fixture()
+def pool_file(isolated_state, tmp_path, monkeypatch):
+    path = tmp_path / 'pools.yaml'
+    path.write_text("""
+pools:
+  lab:
+    user: ubuntu
+    identity_file: ~/.ssh/lab_key
+    hosts:
+      - 10.9.0.1
+      - 10.9.0.2
+      - ip: 10.9.0.3
+        user: admin
+        port: 2222
+""")
+    monkeypatch.setattr(ssh_cloud, 'POOLS_PATH', str(path))
+    return str(path)
+
+
+def test_pool_parsing(pool_file):
+    pools = ssh_cloud.load_pools(pool_file)
+    hosts = pools['lab']['hosts']
+    assert len(hosts) == 3
+    assert hosts[0] == {'ip': '10.9.0.1', 'user': 'ubuntu',
+                        'identity_file': '~/.ssh/lab_key', 'port': 22}
+    assert hosts[2]['user'] == 'admin' and hosts[2]['port'] == 2222
+
+
+def _config(count):
+    return common.ProvisionConfig(provider_config={'pool': 'lab'},
+                                  authentication_config={}, count=count,
+                                  tags={})
+
+
+def test_allocation_and_release(pool_file):
+    rec = ssh_instance.run_instances('lab', 'c1', _config(2))
+    assert rec.created_instance_ids == ['10.9.0.1', '10.9.0.2']
+    info = ssh_instance.get_cluster_info('lab', 'c1', rec.provider_config)
+    assert info.num_instances == 2
+    assert info.ssh_user == 'ubuntu'
+    assert info.get_head_instance().ssh_port == 22
+
+    # Second cluster gets the remaining host; a third request overflows.
+    rec2 = ssh_instance.run_instances('lab', 'c2', _config(1))
+    assert rec2.created_instance_ids == ['10.9.0.3']
+    with pytest.raises(exceptions.ProvisionerError) as exc_info:
+        ssh_instance.run_instances('lab', 'c3', _config(1))
+    assert exc_info.value.category == exceptions.ProvisionerError.CAPACITY
+
+    # Idempotent re-run returns the same allocation.
+    again = ssh_instance.run_instances('lab', 'c1', _config(2))
+    assert again.created_instance_ids == rec.created_instance_ids
+
+    # Release frees capacity.
+    ssh_instance.terminate_instances('c1')
+    rec3 = ssh_instance.run_instances('lab', 'c3', _config(2))
+    assert set(rec3.created_instance_ids) == {'10.9.0.1', '10.9.0.2'}
+    assert ssh_instance.query_instances('c2') == {'10.9.0.3': 'running'}
+
+
+def test_feasibility_respects_pool_size(pool_file):
+    cloud = ssh_cloud.SSH()
+    from skypilot_tpu.resources import Resources
+    r = Resources()
+    feas = cloud.get_feasible_launchable_resources(r, num_nodes=3)
+    assert feas.resources_list
+    feas = cloud.get_feasible_launchable_resources(r, num_nodes=4)
+    assert not feas.resources_list
+    with pytest.raises(ValueError):
+        cloud.validate_region_zone('nope', None)
