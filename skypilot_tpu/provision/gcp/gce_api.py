@@ -1,0 +1,167 @@
+"""Thin client for the GCE compute API (CPU/GPU host VMs).
+
+Completes the GCP provisioner beyond TPU slices: plain VMs for
+controllers, CPU tasks, and GPU hosts (a2/g2 families from the
+catalog). Same `_request()` seam as tpu_api for fake-API tests; the
+error classifier is shared.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision.gcp import tpu_api
+
+_COMPUTE_API = 'https://compute.googleapis.com/compute/v1'
+
+_DEFAULT_IMAGE = ('projects/ubuntu-os-cloud/global/images/family/'
+                  'ubuntu-2204-lts')
+
+# GPU accelerator name -> GCE acceleratorType resource name
+_GPU_TYPES = {
+    'A100': 'nvidia-tesla-a100',
+    'A100-80GB': 'nvidia-a100-80gb',
+    'H100': 'nvidia-h100-80gb',
+    'L4': 'nvidia-l4',
+    'T4': 'nvidia-tesla-t4',
+    'V100': 'nvidia-tesla-v100',
+    'P100': 'nvidia-tesla-p100',
+}
+
+
+def _request(method: str, path: str, *, json_body: Optional[Dict] = None,
+             params: Optional[Dict] = None) -> Dict[str, Any]:
+    session = tpu_api._get_session()  # pylint: disable=protected-access
+    url = f'{_COMPUTE_API}/{path}'
+    resp = session.request(method, url, json=json_body, params=params,
+                           timeout=60)
+    if resp.status_code == 404:
+        raise exceptions.FetchClusterInfoError(
+            exceptions.FetchClusterInfoError.Reason.HEAD)
+    if resp.status_code >= 400:
+        raise exceptions.ProvisionerError(
+            f'GCE API {method} {path} -> {resp.status_code}: '
+            f'{resp.text[:500]}',
+            category=tpu_api._classify_error(  # pylint: disable=protected-access
+                resp.status_code, resp.text))
+    return resp.json() if resp.text else {}
+
+
+def create_instance(project: str, zone: str, name: str,
+                    machine_type: str, *,
+                    accelerators: Optional[Dict[str, int]] = None,
+                    spot: bool = False,
+                    disk_size_gb: int = 256,
+                    image: Optional[str] = None,
+                    ssh_pub_key: Optional[str] = None,
+                    labels: Optional[Dict[str, str]] = None
+                    ) -> Dict[str, Any]:
+    body: Dict[str, Any] = {
+        'name': name,
+        'machineType': f'zones/{zone}/machineTypes/{machine_type}',
+        'disks': [{
+            'boot': True,
+            'autoDelete': True,
+            'initializeParams': {
+                'sourceImage': image or _DEFAULT_IMAGE,
+                'diskSizeGb': str(disk_size_gb),
+            },
+        }],
+        'networkInterfaces': [{
+            'network': 'global/networks/default',
+            'accessConfigs': [{'type': 'ONE_TO_ONE_NAT',
+                               'name': 'External NAT'}],
+        }],
+        'labels': labels or {},
+    }
+    if accelerators:
+        acc_name, count = next(iter(accelerators.items()))
+        gce_type = _GPU_TYPES.get(acc_name)
+        if gce_type is None:
+            raise exceptions.ProvisionerError(
+                f'Unknown GPU type {acc_name!r} for GCE.',
+                category=exceptions.ProvisionerError.CONFIG)
+        body['guestAccelerators'] = [{
+            'acceleratorType':
+                f'zones/{zone}/acceleratorTypes/{gce_type}',
+            'acceleratorCount': count,
+        }]
+        body['scheduling'] = {'onHostMaintenance': 'TERMINATE'}
+    if spot:
+        body.setdefault('scheduling', {}).update({
+            'provisioningModel': 'SPOT',
+            'instanceTerminationAction': 'DELETE',
+        })
+    if ssh_pub_key:
+        body['metadata'] = {'items': [
+            {'key': 'ssh-keys', 'value': f'skypilot:{ssh_pub_key}'}]}
+    return _request('POST', f'projects/{project}/zones/{zone}/instances',
+                    json_body=body)
+
+
+def get_instance(project: str, zone: str, name: str) -> Dict[str, Any]:
+    return _request('GET',
+                    f'projects/{project}/zones/{zone}/instances/{name}')
+
+
+def list_instances(project: str, zone: str,
+                   label_filter: Optional[str] = None
+                   ) -> List[Dict[str, Any]]:
+    params = {}
+    if label_filter:
+        params['filter'] = f'labels.skypilot-cluster={label_filter}'
+    out = _request('GET', f'projects/{project}/zones/{zone}/instances',
+                   params=params)
+    return out.get('items', [])
+
+
+def delete_instance(project: str, zone: str, name: str) -> Dict[str, Any]:
+    return _request('DELETE',
+                    f'projects/{project}/zones/{zone}/instances/{name}')
+
+
+def stop_instance(project: str, zone: str, name: str) -> Dict[str, Any]:
+    return _request(
+        'POST', f'projects/{project}/zones/{zone}/instances/{name}/stop')
+
+
+def start_instance(project: str, zone: str, name: str) -> Dict[str, Any]:
+    return _request(
+        'POST', f'projects/{project}/zones/{zone}/instances/{name}/start')
+
+
+def wait_instance_status(project: str, zone: str, name: str,
+                         target=('RUNNING',), timeout: float = 900,
+                         poll: float = 5) -> Dict[str, Any]:
+    deadline = time.time() + timeout
+    while True:
+        try:
+            inst = get_instance(project, zone, name)
+            status = inst.get('status')
+            if status in target:
+                return inst
+            if status in ('TERMINATED', 'SUSPENDED') and \
+                    'TERMINATED' not in target:
+                raise exceptions.ProvisionerError(
+                    f'GCE instance {name} entered {status}.')
+        except exceptions.FetchClusterInfoError:
+            status = None  # creation op may not have materialized yet
+        if time.time() > deadline:
+            raise exceptions.ProvisionerError(
+                f'Timed out waiting for GCE instance {name} '
+                f'(status={status}).')
+        time.sleep(poll)
+
+
+def external_ip(instance: Dict[str, Any]) -> Optional[str]:
+    for nic in instance.get('networkInterfaces', []):
+        for ac in nic.get('accessConfigs', []):
+            if ac.get('natIP'):
+                return ac['natIP']
+    return None
+
+
+def internal_ip(instance: Dict[str, Any]) -> str:
+    nics = instance.get('networkInterfaces', [])
+    return nics[0].get('networkIP', '') if nics else ''

@@ -51,9 +51,9 @@ def run_instances(region: str, cluster_name_on_cloud: str,
     pc = config.provider_config
     zone = pc['zone']
     project = _project(pc)
-    assert pc.get('tpu_vm'), (
-        'GCP provisioner currently provisions TPU slices; request a '
-        'tpu-* accelerator (GCE VM path lands in a later round).')
+    if not pc.get('tpu_vm'):
+        return _run_gce_instances(project, zone, cluster_name_on_cloud,
+                                  config)
     accelerator_type = pc['tpu_accelerator_type']
     runtime_version = pc['runtime_version']
     use_qr = bool(pc.get('tpu_use_queued_resources'))
@@ -122,6 +122,11 @@ def wait_instances(region: str, cluster_name_on_cloud: str,
             'wait_instances needs provider_config with a zone.')
     project = _project(pc)
     count = int(pc.get('num_nodes', 1))
+    if not pc.get('tpu_vm'):
+        from skypilot_tpu.provision.gcp import gce_api
+        for name in _gce_names(cluster_name_on_cloud, count):
+            gce_api.wait_instance_status(project, zone, name)
+        return
     for name in _node_names(cluster_name_on_cloud, count):
         qr_id = (f'{name}-qr'
                  if pc.get('tpu_use_queued_resources') else None)
@@ -146,6 +151,12 @@ def stop_instances(cluster_name_on_cloud: str,
     del worker_only
     pc = provider_config or {}
     zone, project = pc['zone'], _project(pc)
+    if not pc.get('tpu_vm'):
+        from skypilot_tpu.provision.gcp import gce_api
+        for inst in gce_api.list_instances(project, zone,
+                                           cluster_name_on_cloud):
+            gce_api.stop_instance(project, zone, inst['name'])
+        return
     for node in _iter_cluster_nodes(project, zone, cluster_name_on_cloud):
         tpu_api.stop_node(project, zone, node['_short_name'])
 
@@ -159,6 +170,15 @@ def terminate_instances(cluster_name_on_cloud: str,
     if zone is None:
         return
     project = _project(pc)
+    if not pc.get('tpu_vm'):
+        from skypilot_tpu.provision.gcp import gce_api
+        for inst in gce_api.list_instances(project, zone,
+                                           cluster_name_on_cloud):
+            try:
+                gce_api.delete_instance(project, zone, inst['name'])
+            except exceptions.FetchClusterInfoError:
+                pass
+        return
     for node in _iter_cluster_nodes(project, zone, cluster_name_on_cloud):
         name = node['_short_name']
         try:
@@ -191,6 +211,9 @@ def query_instances(cluster_name_on_cloud: str,
                     ) -> Dict[str, Optional[str]]:
     pc = provider_config or {}
     zone, project = pc['zone'], _project(pc)
+    if not pc.get('tpu_vm'):
+        return _gce_query(project, zone, cluster_name_on_cloud,
+                          non_terminated_only)
     out: Dict[str, Optional[str]] = {}
     for node in _iter_cluster_nodes(project, zone, cluster_name_on_cloud):
         state = node.get('state')
@@ -208,6 +231,8 @@ def get_cluster_info(region: str, cluster_name_on_cloud: str,
     del region
     pc = provider_config or {}
     zone, project = pc['zone'], _project(pc)
+    if not pc.get('tpu_vm'):
+        return _gce_cluster_info(project, zone, cluster_name_on_cloud, pc)
     from skypilot_tpu import constants
     instances: List[common.InstanceInfo] = []
     nodes = sorted(_iter_cluster_nodes(project, zone, cluster_name_on_cloud),
@@ -249,3 +274,108 @@ def open_ports(cluster_name_on_cloud: str, ports: List[str],
 def cleanup_ports(cluster_name_on_cloud: str, ports: List[str],
                   provider_config: Optional[Dict[str, Any]] = None) -> None:
     del cluster_name_on_cloud, ports, provider_config
+
+
+# ---------------------------------------------------------------------------
+# GCE (CPU/GPU VM) path
+# ---------------------------------------------------------------------------
+_GCE_STATUS_MAP = {
+    'RUNNING': 'running',
+    'PROVISIONING': 'pending',
+    'STAGING': 'pending',
+    'REPAIRING': 'pending',
+    'STOPPING': 'stopping',
+    'SUSPENDED': 'stopped',
+    'TERMINATED': 'stopped',  # GCE TERMINATED == stopped-but-exists
+}
+
+
+def _gce_names(cluster_name_on_cloud: str, count: int) -> List[str]:
+    return _node_names(cluster_name_on_cloud, count)
+
+
+def _run_gce_instances(project: str, zone: str, cluster_name_on_cloud: str,
+                       config: common.ProvisionConfig
+                       ) -> common.ProvisionRecord:
+    from skypilot_tpu.provision.gcp import gce_api
+    pc = config.provider_config
+    machine_type = pc.get('instance_type')
+    if not machine_type:
+        raise exceptions.ProvisionerError(
+            'GCE path needs an instance_type.',
+            category=exceptions.ProvisionerError.CONFIG)
+    names = _gce_names(cluster_name_on_cloud, config.count)
+    pub_key = _ssh_pub_key()
+    created, resumed = [], []
+    for name in names:
+        try:
+            inst = gce_api.get_instance(project, zone, name)
+            if inst.get('status') in ('TERMINATED', 'SUSPENDED'):
+                gce_api.start_instance(project, zone, name)
+                resumed.append(name)
+            continue
+        except exceptions.FetchClusterInfoError:
+            pass
+        gce_api.create_instance(
+            project, zone, name, machine_type,
+            accelerators=pc.get('accelerators') or None,
+            spot=bool(pc.get('use_spot')),
+            disk_size_gb=int(pc.get('disk_size') or 256),
+            image=pc.get('image_id'),
+            ssh_pub_key=pub_key,
+            labels={'skypilot-cluster': cluster_name_on_cloud})
+        created.append(name)
+    return common.ProvisionRecord(
+        provider_name='gcp',
+        cluster_name=cluster_name_on_cloud,
+        region=zone.rsplit('-', 1)[0],
+        zone=zone,
+        head_instance_id=names[0],
+        created_instance_ids=created,
+        resumed_instance_ids=resumed,
+        provider_config=dict(pc),
+    )
+
+
+def _gce_query(project: str, zone: str, cluster_name_on_cloud: str,
+               non_terminated_only: bool) -> Dict[str, Optional[str]]:
+    from skypilot_tpu.provision.gcp import gce_api
+    out: Dict[str, Optional[str]] = {}
+    for inst in gce_api.list_instances(project, zone,
+                                       cluster_name_on_cloud):
+        status = _GCE_STATUS_MAP.get(inst.get('status'), 'pending')
+        if non_terminated_only and status is None:
+            continue
+        out[inst['name']] = status
+    return out
+
+
+def _gce_cluster_info(project: str, zone: str, cluster_name_on_cloud: str,
+                      pc: Dict[str, Any]) -> common.ClusterInfo:
+    from skypilot_tpu import constants
+    from skypilot_tpu.provision.gcp import gce_api
+    instances = []
+    items = sorted(gce_api.list_instances(project, zone,
+                                          cluster_name_on_cloud),
+                   key=lambda i: i['name'])
+    if not items:
+        raise exceptions.FetchClusterInfoError(
+            exceptions.FetchClusterInfoError.Reason.HEAD)
+    for rank, inst in enumerate(items):
+        instances.append(common.InstanceInfo(
+            instance_id=inst['name'],
+            internal_ip=gce_api.internal_ip(inst),
+            external_ip=gce_api.external_ip(inst),
+            ssh_port=22,
+            agent_port=constants.AGENT_PORT,
+            node_rank=rank,
+            host_rank=0,
+        ))
+    return common.ClusterInfo(
+        instances=instances,
+        head_instance_id=instances[0].instance_id,
+        provider_name='gcp',
+        provider_config=dict(pc),
+        ssh_user='skypilot',
+        ssh_private_key='~/.ssh/sky-key',
+    )

@@ -1,0 +1,30 @@
+"""Every bundled example YAML must parse into a valid Task."""
+import glob
+import os
+
+import pytest
+
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.utils import common_utils
+
+_EXAMPLES = sorted(glob.glob(os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), 'examples', '*.yaml')))
+
+
+@pytest.mark.parametrize('path', _EXAMPLES,
+                         ids=[os.path.basename(p) for p in _EXAMPLES])
+def test_example_parses(path, monkeypatch):
+    monkeypatch.setenv('CKPT_DIR', '/tmp/x')
+    monkeypatch.setenv('CKPT_BUCKET', 'gs://x')
+    config = common_utils.read_yaml(path)
+    task = task_lib.Task.from_yaml_config(config)
+    assert task.run, path
+    resources = next(iter(task.resources))
+    assert resources.cloud is not None
+    if 'serve' in os.path.basename(path):
+        assert task.service is not None
+
+
+def test_examples_exist():
+    assert len(_EXAMPLES) >= 6

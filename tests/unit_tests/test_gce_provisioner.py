@@ -1,0 +1,133 @@
+"""GCE VM path of the GCP provisioner against a fake compute API."""
+import re
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.gcp import gce_api
+from skypilot_tpu.provision.gcp import instance as gcp_instance
+
+
+class FakeGce:
+
+    def __init__(self):
+        self.instances = {}
+        self._ip = 5
+
+    def request(self, method, path, json_body=None, params=None):
+        m = re.match(r'projects/([^/]+)/zones/([^/]+)/instances'
+                     r'(?:/([^/]+))?(?:/(\w+))?$', path)
+        assert m, path
+        _, zone, name, action = m.groups()
+        if method == 'POST' and name is None:
+            n = json_body['name']
+            self.instances[(zone, n)] = {
+                'name': n,
+                'status': 'PROVISIONING',
+                '_polls': 0,
+                'machineType': json_body['machineType'],
+                'labels': json_body.get('labels', {}),
+                'guestAccelerators': json_body.get('guestAccelerators'),
+                'scheduling': json_body.get('scheduling', {}),
+                'networkInterfaces': [{
+                    'networkIP': f'10.1.0.{self._ip}',
+                    'accessConfigs': [{'natIP': f'34.9.0.{self._ip}'}],
+                }],
+            }
+            self._ip += 1
+            return {'name': f'op-{n}'}
+        if name and action == 'stop':
+            self.instances[(zone, name)]['status'] = 'TERMINATED'
+            return {}
+        if name and action == 'start':
+            self.instances[(zone, name)]['status'] = 'RUNNING'
+            return {}
+        if method == 'GET' and name:
+            inst = self.instances.get((zone, name))
+            if inst is None:
+                raise exceptions.FetchClusterInfoError(
+                    exceptions.FetchClusterInfoError.Reason.HEAD)
+            if inst['status'] == 'PROVISIONING':
+                inst['_polls'] += 1
+                if inst['_polls'] >= 2:
+                    inst['status'] = 'RUNNING'
+            return inst
+        if method == 'GET':
+            items = [i for (z, _), i in self.instances.items() if z == zone]
+            if params and params.get('filter'):
+                label = params['filter'].split('=')[-1]
+                items = [i for i in items
+                         if i['labels'].get('skypilot-cluster') == label]
+            return {'items': items}
+        if method == 'DELETE' and name:
+            if (zone, name) not in self.instances:
+                raise exceptions.FetchClusterInfoError(
+                    exceptions.FetchClusterInfoError.Reason.HEAD)
+            del self.instances[(zone, name)]
+            return {}
+        raise AssertionError(f'unhandled {method} {path}')
+
+
+@pytest.fixture()
+def fake_gce(monkeypatch):
+    fake = FakeGce()
+    monkeypatch.setattr(gce_api, '_request',
+                        lambda method, path, json_body=None, params=None:
+                        fake.request(method, path, json_body=json_body,
+                                     params=params))
+    monkeypatch.setattr(gcp_instance, '_project', lambda *a, **k: 'p')
+    monkeypatch.setattr(gcp_instance, '_ssh_pub_key', lambda: 'ssh-ed x')
+    import skypilot_tpu.provision.gcp.gce_api as mod
+    monkeypatch.setattr(mod.time, 'sleep', lambda s: None)
+    return fake
+
+
+def _config(count=1, accelerators=None, spot=False):
+    return common.ProvisionConfig(
+        provider_config={
+            'zone': 'us-central1-a',
+            'tpu_vm': False,
+            'instance_type': 'n2-standard-8',
+            'accelerators': accelerators or {},
+            'use_spot': spot,
+            'num_nodes': count,
+            'disk_size': 100,
+        },
+        authentication_config={}, count=count, tags={})
+
+
+def test_gce_create_wait_info(fake_gce):
+    cfg = _config(count=2)
+    record = gcp_instance.run_instances('us-central1', 'g1', cfg)
+    assert record.created_instance_ids == ['g1-0', 'g1-1']
+    gcp_instance.wait_instances('us-central1', 'g1',
+                                provider_config=cfg.provider_config)
+    info = gcp_instance.get_cluster_info('us-central1', 'g1',
+                                         cfg.provider_config)
+    assert info.num_instances == 2
+    head = info.get_head_instance()
+    assert head.external_ip.startswith('34.9.')
+    assert head.internal_ip.startswith('10.1.')
+
+
+def test_gce_gpu_and_spot_flags(fake_gce):
+    cfg = _config(accelerators={'A100': 8}, spot=True)
+    gcp_instance.run_instances('us-central1', 'g2', cfg)
+    inst = fake_gce.instances[('us-central1-a', 'g2')]
+    acc = inst['guestAccelerators'][0]
+    assert acc['acceleratorType'].endswith('nvidia-tesla-a100')
+    assert acc['acceleratorCount'] == 8
+    assert inst['scheduling']['provisioningModel'] == 'SPOT'
+
+
+def test_gce_stop_resume_query_terminate(fake_gce):
+    cfg = _config()
+    gcp_instance.run_instances('us-central1', 'g3', cfg)
+    gcp_instance.stop_instances('g3', cfg.provider_config)
+    assert gcp_instance.query_instances('g3', cfg.provider_config) == {
+        'g3': 'stopped'}
+    record = gcp_instance.run_instances('us-central1', 'g3', cfg)
+    assert record.resumed_instance_ids == ['g3']
+    gcp_instance.terminate_instances('g3', cfg.provider_config)
+    assert not fake_gce.instances
