@@ -60,20 +60,30 @@ def main() -> None:
 
     mesh = mesh_lib.make_mesh(mesh_lib.MeshConfig.auto(n_dev))
     model = GPT(cfg)
-    trainer = ShardedTrainer(model, mesh, tx=default_optimizer())
 
-    example = jnp.zeros((batch, seq), jnp.int32)
-    state = trainer.init(jax.random.PRNGKey(0), example)
-    step = trainer.make_train_step(example)
-
+    # OOM-resilient warmup: halve the batch until the step fits (the
+    # driver runs this unattended on whatever chip is present).
     rng = jax.random.PRNGKey(1)
-    tokens = shard_batch(
-        jax.random.randint(rng, (batch, seq), 0, cfg.vocab_size, jnp.int32),
-        mesh)
-
-    for _ in range(args.warmup):
-        state, loss = step(state, tokens)
-    jax.block_until_ready(loss)
+    while True:
+        try:
+            trainer = ShardedTrainer(model, mesh, tx=default_optimizer())
+            example = jnp.zeros((batch, seq), jnp.int32)
+            state = trainer.init(jax.random.PRNGKey(0), example)
+            step = trainer.make_train_step(example)
+            tokens = shard_batch(
+                jax.random.randint(rng, (batch, seq), 0, cfg.vocab_size,
+                                   jnp.int32), mesh)
+            for _ in range(args.warmup):
+                state, loss = step(state, tokens)
+            jax.block_until_ready(loss)
+            break
+        except Exception as e:  # pylint: disable=broad-except
+            if 'RESOURCE_EXHAUSTED' in str(e) and batch > n_dev:
+                batch = max(n_dev, batch // 2)
+                print(f'# OOM; retrying with batch={batch}',
+                      file=sys.stderr)
+                continue
+            raise
 
     start = time.perf_counter()
     for _ in range(args.steps):
