@@ -439,21 +439,73 @@ def jobs() -> None:
 @jobs.command(name='launch')
 @click.argument('entrypoint', required=False)
 @_add_options(_task_options)
+@click.option('--pool', default=None,
+              help='Run on a pre-provisioned worker pool.')
 @click.option('--detach-run', '-d', is_flag=True, default=False)
 @click.option('--yes', '-y', is_flag=True, default=False)
 def jobs_launch_cmd(entrypoint, name, workdir, infra, gpus, cpus, memory,
-                    num_nodes, use_spot, env, detach_run, yes) -> None:
+                    num_nodes, use_spot, env, pool, detach_run, yes) -> None:
     """Launch a managed job (survives preemption via auto-recovery)."""
     task = _build_task(entrypoint, name, workdir, infra, gpus, cpus, memory,
                        num_nodes, use_spot, env)
     if not yes:
         click.confirm(f'Launch managed job {task.name or "task"}?',
                       default=True, abort=True)
-    result = sdk.get(sdk.jobs_launch(task, name=task.name))
+    result = sdk.get(sdk.jobs_launch(task, name=task.name, pool=pool))
     job_id = result['job_id']
     click.echo(f'Managed job {job_id} submitted.')
     if not detach_run:
         sdk.jobs_logs(job_id)
+
+
+@jobs.group(name='pool')
+def jobs_pool() -> None:
+    """Worker pools that managed jobs reuse (skip provisioning)."""
+
+
+@jobs_pool.command(name='apply')
+@click.argument('entrypoint', required=False)
+@click.option('--pool-name', '-n', 'pool_name', required=True)
+@click.option('--workers', type=int, default=1)
+@_add_options(_task_options)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def jobs_pool_apply_cmd(entrypoint, pool_name, workers, name, workdir,
+                        infra, gpus, cpus, memory, num_nodes, use_spot,
+                        env, yes) -> None:
+    """Provision a pool of worker clusters from a resources template."""
+    task = _build_task(entrypoint, name, workdir, infra, gpus, cpus, memory,
+                       num_nodes, use_spot, env, cmd='true')
+    task.run = None
+    if not yes:
+        click.confirm(f'Provision pool {pool_name} ({workers} workers)?',
+                      default=True, abort=True)
+    result = sdk.stream_and_get(sdk.jobs_pool_apply(task, pool_name,
+                                                    workers))
+    click.echo(f'Pool {pool_name} ready: {result["workers"]}')
+
+
+@jobs_pool.command(name='ls')
+def jobs_pool_ls_cmd() -> None:
+    rows = sdk.get(sdk.jobs_pool_ls())
+    from rich.console import Console
+    from rich.table import Table
+    table = Table(box=None)
+    for col in ('NAME', 'WORKERS', 'BUSY'):
+        table.add_column(col)
+    for r in rows:
+        table.add_row(r['name'], str(r['num_workers']),
+                      str(r['busy_workers']))
+    Console().print(table)
+
+
+@jobs_pool.command(name='down')
+@click.argument('pool_name')
+@click.option('--yes', '-y', is_flag=True, default=False)
+def jobs_pool_down_cmd(pool_name, yes) -> None:
+    if not yes:
+        click.confirm(f'Tear down pool {pool_name}?', abort=True)
+    sdk.stream_and_get(sdk.jobs_pool_down(pool_name))
+    click.echo(f'Pool {pool_name} torn down.')
 
 
 @jobs.command(name='queue')

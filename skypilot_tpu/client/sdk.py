@@ -306,11 +306,13 @@ def tail_logs(cluster_name: str, job_id: Optional[int] = None,
 # ---------------------------------------------------------------------------
 # Managed jobs
 # ---------------------------------------------------------------------------
-def jobs_launch(task: 'task_lib.Task', name: Optional[str] = None) -> str:
+def jobs_launch(task: 'task_lib.Task', name: Optional[str] = None,
+                pool: Optional[str] = None) -> str:
     return _post('/jobs/launch', {
         'task_config': task.to_yaml_config(),
         'name': name,
         'user': common_utils.get_user_name(),
+        'pool': pool,
     })
 
 
@@ -389,3 +391,23 @@ def batch_ls() -> str:
 
 def batch_cancel(name: str) -> str:
     return _post('/batch/cancel', {'name': name})
+
+
+# ---------------------------------------------------------------------------
+# Managed-job pools
+# ---------------------------------------------------------------------------
+def jobs_pool_apply(task: 'task_lib.Task', pool_name: str,
+                    num_workers: int = 1) -> str:
+    return _post('/jobs/pool/apply', {
+        'task_config': task.to_yaml_config(),
+        'pool_name': pool_name,
+        'num_workers': num_workers,
+    })
+
+
+def jobs_pool_ls() -> str:
+    return _post('/jobs/pool/ls', {})
+
+
+def jobs_pool_down(pool_name: str) -> str:
+    return _post('/jobs/pool/down', {'pool_name': pool_name})

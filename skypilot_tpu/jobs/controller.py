@@ -49,6 +49,7 @@ class JobController:
         assert record is not None, job_id
         self.record = record
         self.cluster_name = record['cluster_name']
+        self.pooled = bool(record.get('pool'))
         self.task = task_lib.Task.from_yaml_config(record['task_config'])
         self.executor = recovery_strategy.StrategyExecutor.make(
             self.cluster_name, self.task)
@@ -170,6 +171,11 @@ class JobController:
                             agent.cancel_job(j['job_id'])
                 except requests.RequestException:
                     pass
+        if self.pooled:
+            # Pool workers are released, not destroyed — the whole point
+            # of the pool is cluster reuse across jobs.
+            ux_utils.log(f'Releasing pool worker {self.cluster_name}.')
+            return
         self.executor.terminate_cluster()
 
 

@@ -12,8 +12,16 @@ from skypilot_tpu.jobs import state
 
 
 def launch(task_config: Dict[str, Any], name: Optional[str] = None,
-           user: str = 'unknown') -> Dict[str, Any]:
-    """Submit a managed job; returns its id immediately."""
+           user: str = 'unknown',
+           pool: Optional[str] = None) -> Dict[str, Any]:
+    """Submit a managed job; returns its id immediately. With `pool`,
+    the job borrows a pre-provisioned pool worker instead of
+    cold-launching a cluster."""
+    if pool is not None:
+        from skypilot_tpu.jobs import pools as pools_lib
+        if pools_lib.get(pool) is None:
+            raise exceptions.SkyError(
+                f'Pool {pool!r} not found; `stpu jobs pool apply` first.')
     # Validate the task config early (fail fast in the request).
     from skypilot_tpu import task as task_lib
     task = task_lib.Task.from_yaml_config(dict(task_config))
@@ -25,9 +33,9 @@ def launch(task_config: Dict[str, Any], name: Optional[str] = None,
                                                   0))
             strategy = r.job_recovery.get('strategy') or strategy
     job_id = state.submit_job(name or task.name, task_config, strategy,
-                              max_restarts, user)
+                              max_restarts, user, pool=pool)
     scheduler.maybe_schedule_next_jobs()
-    return {'job_id': job_id, 'controller': 'local'}
+    return {'job_id': job_id, 'controller': 'local', 'pool': pool}
 
 
 def queue(refresh: bool = False,
@@ -51,8 +59,26 @@ def queue(refresh: bool = False,
             'strategy': j['strategy'],
             'last_error': j['last_error'],
             'user': j['user'],
+            'pool': j.get('pool'),
+            'pool_worker': j.get('pool_worker'),
         })
     return out
+
+
+def pool_apply(task_config: Dict[str, Any], pool_name: str,
+               num_workers: int = 1) -> Dict[str, Any]:
+    from skypilot_tpu.jobs import pools as pools_lib
+    return pools_lib.apply(pool_name, task_config, num_workers)
+
+
+def pool_ls() -> List[Dict[str, Any]]:
+    from skypilot_tpu.jobs import pools as pools_lib
+    return pools_lib.ls()
+
+
+def pool_down(pool_name: str) -> None:
+    from skypilot_tpu.jobs import pools as pools_lib
+    pools_lib.down(pool_name)
 
 
 def cancel(job_ids: Optional[List[int]] = None,

@@ -1,0 +1,140 @@
+"""Worker pools for managed jobs.
+
+Reference: `sky jobs pool apply` (sky/jobs/ + shared pool code in
+sky/serve/replica_managers.py:610) — pre-provisioned clusters that
+managed jobs borrow instead of cold-launching: a pooled job skips
+provisioning latency entirely, and the cluster is released back (not
+torn down) when the job finishes.
+
+Pool workers are ordinary clusters named `pool-<name>-w<i>`.
+Assignment bookkeeping lives in the managed-jobs DB so the scheduler
+can hand free workers to pending pooled jobs.
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import execution
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.jobs import state
+from skypilot_tpu.utils import ux_utils
+
+_CREATE_SQL = """\
+CREATE TABLE IF NOT EXISTS job_pools (
+    name TEXT PRIMARY KEY,
+    task_config TEXT,
+    num_workers INTEGER,
+    created_at REAL
+);
+"""
+
+
+def _db():
+    db = state._db()  # pylint: disable=protected-access
+    with db.conn() as conn:
+        conn.executescript(_CREATE_SQL)
+    db.add_column_if_missing('managed_jobs', 'pool', 'TEXT')
+    db.add_column_if_missing('managed_jobs', 'pool_worker', 'TEXT')
+    return db
+
+
+def worker_cluster(pool: str, idx: int) -> str:
+    return f'pool-{pool}-w{idx}'
+
+
+def apply(pool_name: str, task_config: Dict[str, Any],
+          num_workers: int) -> Dict[str, Any]:
+    """Create/resize a pool: provision its worker clusters now."""
+    db = _db()
+    # Validate the template (resources only; run/setup optional).
+    template = task_lib.Task.from_yaml_config(dict(task_config))
+    del template
+    db.execute(
+        'INSERT INTO job_pools (name, task_config, num_workers, created_at) '
+        'VALUES (?,?,?,?) ON CONFLICT(name) DO UPDATE SET '
+        'task_config=excluded.task_config, '
+        'num_workers=excluded.num_workers',
+        (pool_name, json.dumps(task_config), num_workers, time.time()))
+    provisioned = []
+    for idx in range(num_workers):
+        cluster = worker_cluster(pool_name, idx)
+        boot = task_lib.Task.from_yaml_config(dict(task_config))
+        boot.run = None  # provision + setup only
+        _, handle = execution.launch(boot, cluster_name=cluster,
+                                     detach_run=True, _quiet_optimizer=True)
+        assert handle is not None
+        provisioned.append(cluster)
+        ux_utils.log(f'Pool {pool_name}: worker {cluster} ready.')
+    return {'name': pool_name, 'workers': provisioned}
+
+
+def get(pool_name: str) -> Optional[Dict[str, Any]]:
+    row = _db().query_one('SELECT * FROM job_pools WHERE name=?',
+                          (pool_name,))
+    if row is None:
+        return None
+    out = dict(row)
+    out['task_config'] = json.loads(out['task_config'] or '{}')
+    return out
+
+
+def ls() -> List[Dict[str, Any]]:
+    out = []
+    for row in _db().query('SELECT * FROM job_pools ORDER BY name'):
+        pool = dict(row)
+        pool['task_config'] = json.loads(pool['task_config'] or '{}')
+        pool['busy_workers'] = len(_busy_workers(pool['name']))
+        out.append(pool)
+    return out
+
+
+def down(pool_name: str) -> None:
+    pool = get(pool_name)
+    if pool is None:
+        raise exceptions.SkyError(f'Pool {pool_name!r} not found.')
+    busy = _busy_workers(pool_name)
+    if busy:
+        raise exceptions.SkyError(
+            f'Pool {pool_name!r} has active jobs on {sorted(busy)}; '
+            'cancel them first.')
+    from skypilot_tpu import core as sky_core
+    for idx in range(pool['num_workers']):
+        try:
+            sky_core.down(worker_cluster(pool_name, idx))
+        except exceptions.ClusterDoesNotExist:
+            pass
+    _db().execute('DELETE FROM job_pools WHERE name=?', (pool_name,))
+
+
+# ---------------------------------------------------------------------------
+# Assignment (called under the scheduler lock)
+# ---------------------------------------------------------------------------
+def _busy_workers(pool_name: str) -> List[str]:
+    rows = _db().query(
+        'SELECT pool_worker FROM managed_jobs WHERE pool=? AND status '
+        'NOT IN (?,?,?,?,?,?,?) AND pool_worker IS NOT NULL',
+        (pool_name,
+         state.ManagedJobStatus.SUCCEEDED.value,
+         state.ManagedJobStatus.FAILED.value,
+         state.ManagedJobStatus.FAILED_SETUP.value,
+         state.ManagedJobStatus.FAILED_PRECHECKS.value,
+         state.ManagedJobStatus.FAILED_NO_RESOURCE.value,
+         state.ManagedJobStatus.FAILED_CONTROLLER.value,
+         state.ManagedJobStatus.CANCELLED.value))
+    return [r['pool_worker'] for r in rows]
+
+
+def assign_worker(pool_name: str) -> Optional[str]:
+    """A free worker cluster name, or None if the pool is saturated."""
+    pool = get(pool_name)
+    if pool is None:
+        raise exceptions.SkyError(f'Pool {pool_name!r} not found.')
+    busy = set(_busy_workers(pool_name))
+    for idx in range(pool['num_workers']):
+        cluster = worker_cluster(pool_name, idx)
+        if cluster not in busy:
+            return cluster
+    return None

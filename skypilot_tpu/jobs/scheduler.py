@@ -36,6 +36,12 @@ def maybe_schedule_next_jobs() -> None:
             if starting >= MAX_STARTING_JOBS or \
                     starting + running >= MAX_RUNNING_JOBS:
                 break
+            if job.get('pool'):
+                from skypilot_tpu.jobs import pools as pools_lib
+                worker = pools_lib.assign_worker(job['pool'])
+                if worker is None:
+                    continue  # pool saturated; stays PENDING
+                state.assign_pool_worker(job['job_id'], worker)
             _spawn_controller(job)
             starting += 1
 

@@ -70,4 +70,12 @@ def register(app: web.Application) -> None:
                         _schedule('jobs.queue', f'{_API}.queue'))
     app.router.add_post('/jobs/cancel',
                         _schedule('jobs.cancel', f'{_API}.cancel'))
+    app.router.add_post('/jobs/pool/apply',
+                        _schedule('jobs.pool_apply', f'{_API}.pool_apply',
+                                  'long'))
+    app.router.add_post('/jobs/pool/ls',
+                        _schedule('jobs.pool_ls', f'{_API}.pool_ls'))
+    app.router.add_post('/jobs/pool/down',
+                        _schedule('jobs.pool_down', f'{_API}.pool_down',
+                                  'long'))
     app.router.add_get('/jobs/logs', jobs_logs)

@@ -83,23 +83,32 @@ def _db() -> db_utils.SQLiteDB:
 
 def submit_job(name: Optional[str], task_config: Dict[str, Any],
                strategy: str, max_restarts_on_errors: int,
-               user: str) -> int:
-    with _db().conn() as conn:
+               user: str, pool: Optional[str] = None) -> int:
+    db = _db()
+    db.add_column_if_missing('managed_jobs', 'pool', 'TEXT')
+    db.add_column_if_missing('managed_jobs', 'pool_worker', 'TEXT')
+    with db.conn() as conn:
         cur = conn.execute(
             'INSERT INTO managed_jobs (name, task_config, status, '
-            'submitted_at, strategy, max_restarts_on_errors, user) '
-            'VALUES (?,?,?,?,?,?,?)',
+            'submitted_at, strategy, max_restarts_on_errors, user, pool) '
+            'VALUES (?,?,?,?,?,?,?,?)',
             (name, json.dumps(task_config),
              ManagedJobStatus.PENDING.value, time.time(), strategy,
-             max_restarts_on_errors, user))
+             max_restarts_on_errors, user, pool))
         job_id = int(cur.lastrowid)
     log_dir = os.path.join(constants.sky_home(), 'managed_jobs_logs')
     os.makedirs(log_dir, exist_ok=True)
     log_path = os.path.join(log_dir, f'{job_id}.log')
-    _db().execute('UPDATE managed_jobs SET log_path=?, cluster_name=? '
-                  'WHERE job_id=?',
-                  (log_path, f'managed-{job_id}', job_id))
+    db.execute('UPDATE managed_jobs SET log_path=?, cluster_name=? '
+               'WHERE job_id=?',
+               (log_path, f'managed-{job_id}', job_id))
     return job_id
+
+
+def assign_pool_worker(job_id: int, worker_cluster: str) -> None:
+    _db().execute(
+        'UPDATE managed_jobs SET pool_worker=?, cluster_name=? '
+        'WHERE job_id=?', (worker_cluster, worker_cluster, job_id))
 
 
 def _decode(row: Dict[str, Any]) -> Dict[str, Any]:

@@ -1,0 +1,70 @@
+"""Managed-job pools on the Local cloud: reuse, saturation, release."""
+import time
+
+import pytest
+
+from skypilot_tpu.jobs import core as jobs_core
+from skypilot_tpu.jobs import pools
+from skypilot_tpu.jobs import state
+
+
+@pytest.fixture()
+def pool_env(isolated_state, monkeypatch):
+    monkeypatch.setenv('SKYPILOT_JOBS_POLL_SECONDS', '1')
+    monkeypatch.setenv('SKYPILOT_JOBS_UNREACHABLE_GRACE_SECONDS', '5')
+    from skypilot_tpu import check
+    check.check(quiet=True)
+    yield isolated_state
+    for j in state.get_jobs():
+        jobs_core.cancel([j['job_id']])
+
+
+def _wait(job_id, statuses, timeout=120):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        job = state.get_job(job_id)
+        if job['status'] in statuses:
+            return job['status']
+        time.sleep(1)
+    raise TimeoutError(f'job {job_id}: {state.get_job(job_id)["status"]}')
+
+
+@pytest.mark.slow
+def test_pool_reuse_and_saturation(pool_env):
+    template = {'name': 'w', 'resources': {'infra': 'local'}}
+    result = pools.apply('p1', template, num_workers=1)
+    assert result['workers'] == ['pool-p1-w0']
+    from skypilot_tpu import global_state
+    assert global_state.get_cluster('pool-p1-w0') is not None
+
+    job_cfg = {'name': 'j', 'resources': {'infra': 'local'},
+               'run': 'sleep 3; echo done'}
+    r1 = jobs_core.launch(dict(job_cfg), user='t', pool='p1')
+    r2 = jobs_core.launch(dict(job_cfg), user='t', pool='p1')
+
+    # Both jobs run on the SAME worker, serialized by pool capacity.
+    s1 = _wait(r1['job_id'], [state.ManagedJobStatus.SUCCEEDED])
+    assert s1 == state.ManagedJobStatus.SUCCEEDED
+    # Trigger scheduling for the queued second job.
+    from skypilot_tpu.jobs import scheduler
+    scheduler.maybe_schedule_next_jobs()
+    s2 = _wait(r2['job_id'], [state.ManagedJobStatus.SUCCEEDED])
+    assert s2 == state.ManagedJobStatus.SUCCEEDED
+    j1, j2 = state.get_job(r1['job_id']), state.get_job(r2['job_id'])
+    assert j1['pool_worker'] == j2['pool_worker'] == 'pool-p1-w0'
+
+    # Worker survives both jobs (released, not destroyed).
+    assert global_state.get_cluster('pool-p1-w0') is not None
+
+    rows = pools.ls()
+    assert rows[0]['name'] == 'p1' and rows[0]['busy_workers'] == 0
+
+    pools.down('p1')
+    assert global_state.get_cluster('pool-p1-w0') is None
+    assert pools.get('p1') is None
+
+
+def test_pool_missing_rejected(pool_env):
+    with pytest.raises(Exception, match='not found'):
+        jobs_core.launch({'resources': {'infra': 'local'}, 'run': 'true'},
+                         pool='nope')
