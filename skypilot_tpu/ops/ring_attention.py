@@ -55,17 +55,17 @@ def _ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
     scale = 1.0 / (head_dim ** 0.5)
     q32 = q.astype(jnp.float32) * scale
 
-    # pvary: mark accumulators device-varying over every axis the
-    # inputs vary on, so the fori_loop carry type stays stable once
-    # they mix with per-shard data.
-    o = lax.pvary(
-        jnp.zeros((batch, s_local, num_heads, head_dim), jnp.float32),
-        vary_axes)
-    m = lax.pvary(
-        jnp.full((batch, s_local, num_heads), -jnp.inf, jnp.float32),
-        vary_axes)
-    l = lax.pvary(
-        jnp.zeros((batch, s_local, num_heads), jnp.float32), vary_axes)
+    # Mark accumulators device-varying over every axis the inputs vary
+    # on, so the fori_loop carry type stays stable once they mix with
+    # per-shard data (jax>=0.9 spells pvary as pcast(to='varying')).
+    def _vary(x):
+        if hasattr(lax, 'pcast'):
+            return lax.pcast(x, vary_axes, to='varying')
+        return lax.pvary(x, vary_axes)
+
+    o = _vary(jnp.zeros((batch, s_local, num_heads, head_dim), jnp.float32))
+    m = _vary(jnp.full((batch, s_local, num_heads), -jnp.inf, jnp.float32))
+    l = _vary(jnp.zeros((batch, s_local, num_heads), jnp.float32))
 
     if causal:
         tri = jnp.tril(jnp.ones((s_local, s_local), bool))  # [Sq,Sk]
