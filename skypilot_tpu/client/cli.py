@@ -784,6 +784,28 @@ def batch_cancel_cmd(batch_name, yes) -> None:
         click.echo('Already finished or not found.')
 
 
+
+
+@cli.command(name='users')
+def users_cmd() -> None:
+    """Show users seen by the API server."""
+    import requests as _requests
+    url = sdk._ensure_server()
+    rows = _requests.get(f'{url}/users', headers=sdk._headers(),
+                         timeout=30).json()['users']
+    from rich.console import Console
+    from rich.table import Table
+    table = Table(box=None)
+    for col in ('USER', 'ROLE', 'REQUESTS', 'LAST SEEN'):
+        table.add_column(col)
+    for r in rows:
+        last = datetime.datetime.fromtimestamp(
+            r['last_seen']).strftime('%m-%d %H:%M') if r['last_seen'] else '-'
+        table.add_row(r['name'], r.get('role') or 'user',
+                      str(r['request_count']), last)
+    Console().print(table)
+
+
 def main() -> None:
     try:
         cli()

@@ -272,6 +272,13 @@ def create_app() -> web.Application:
         pass
     from skypilot_tpu.server import dashboard
     dashboard.register(app)
+
+    async def users_ls(request: web.Request) -> web.Response:
+        del request
+        from skypilot_tpu.users import core as users_core
+        return web.json_response({'users': users_core.ls()})
+
+    app.router.add_get('/users', users_ls)
     return app
 
 
@@ -289,6 +296,13 @@ async def auth_middleware(request: web.Request, handler):
         supplied = request.headers.get('Authorization', '')
         if supplied != f'Bearer {token}':
             return web.json_response({'error': 'unauthorized'}, status=401)
+    user = request.headers.get('X-Skypilot-User')
+    if user:
+        try:
+            from skypilot_tpu.users import core as users_core
+            users_core.record_request(user)
+        except Exception:  # pylint: disable=broad-except
+            pass  # registry is best-effort
     return await handler(request)
 
 

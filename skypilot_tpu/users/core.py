@@ -1,0 +1,49 @@
+"""User registry: who has touched this API server.
+
+Reference: sky/users/ (2.6k LoC with casbin RBAC). Round-1 scope:
+the server records every requesting user (name + first/last seen +
+request count) and exposes the registry; role-based enforcement is a
+round-2 item layered on the same table.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List
+
+from skypilot_tpu import global_state
+
+
+def _db():
+    db = global_state._db()  # pylint: disable=protected-access
+    db.add_column_if_missing('users', 'last_seen', 'REAL')
+    db.add_column_if_missing('users', 'request_count',
+                             'INTEGER DEFAULT 0')
+    db.add_column_if_missing('users', 'role', "TEXT DEFAULT 'user'")
+    return db
+
+
+def record_request(user_name: str) -> None:
+    """Upsert the user and bump activity (called per API request)."""
+    if not user_name or user_name == 'unknown':
+        return
+    db = _db()
+    now = time.time()
+    db.execute(
+        'INSERT INTO users (user_hash, name, created_at, last_seen, '
+        'request_count) VALUES (?,?,?,?,1) '
+        'ON CONFLICT(user_hash) DO UPDATE SET last_seen=excluded.last_seen, '
+        'request_count=request_count+1',
+        (user_name, user_name, int(now), now))
+
+
+def ls() -> List[Dict[str, Any]]:
+    return _db().query(
+        'SELECT name, role, created_at, last_seen, request_count '
+        'FROM users ORDER BY last_seen DESC')
+
+
+def set_role(user_name: str, role: str) -> None:
+    if role not in ('admin', 'user'):
+        raise ValueError(f'Unknown role {role!r} (admin|user).')
+    _db().execute('UPDATE users SET role=? WHERE user_hash=?',
+                  (role, user_name))

@@ -149,3 +149,21 @@ def test_timeline_tracing(tmp_path, monkeypatch):
     names = {e['name'] for e in data['traceEvents']}
     assert any('traced' in n for n in names), names  # qualname form
     assert 'manual' in names
+
+
+def test_user_registry(isolated_state):
+    from skypilot_tpu.users import core as users_core
+    users_core.record_request('alice')
+    users_core.record_request('alice')
+    users_core.record_request('bob')
+    users_core.record_request('unknown')  # ignored
+    rows = {r['name']: r for r in users_core.ls()}
+    assert set(rows) == {'alice', 'bob'}
+    assert rows['alice']['request_count'] == 2
+    assert rows['alice']['role'] == 'user'
+    users_core.set_role('alice', 'admin')
+    rows = {r['name']: r for r in users_core.ls()}
+    assert rows['alice']['role'] == 'admin'
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        users_core.set_role('bob', 'root')
