@@ -29,6 +29,8 @@ def main() -> None:
     parser.add_argument('--batch', type=int, default=0,
                         help='global batch size (0 = auto)')
     parser.add_argument('--seq', type=int, default=0)
+    parser.add_argument('--retries', type=int, default=4,
+                        help='accelerator-init retries before CPU fallback')
     args = parser.parse_args()
 
     if args.smoke:
@@ -45,7 +47,27 @@ def main() -> None:
     from skypilot_tpu.parallel.train import (ShardedTrainer,
                                              default_optimizer, shard_batch)
 
-    devices = jax.devices()
+    # The axon TPU relay is flaky/single-session: retry backend init with
+    # backoff before giving up and falling back to CPU so the driver always
+    # records *a* number (platform is reported alongside the metric).
+    devices = None
+    for attempt in range(args.retries + 1):
+        try:
+            devices = jax.devices()
+            break
+        except Exception as e:  # pylint: disable=broad-except
+            if attempt == args.retries:
+                print(f'# accelerator init failed after {attempt+1} tries '
+                      f'({type(e).__name__}: {e}); falling back to CPU',
+                      file=sys.stderr)
+                jax.config.update('jax_platforms', 'cpu')
+                devices = jax.devices()
+                break
+            delay = min(60, 5 * 2**attempt)
+            print(f'# accelerator init failed ({type(e).__name__}); '
+                  f'retry {attempt+1}/{args.retries} in {delay}s',
+                  file=sys.stderr)
+            time.sleep(delay)
     n_dev = len(devices)
     platform = devices[0].platform
 
@@ -73,7 +95,10 @@ def main() -> None:
             tokens = shard_batch(
                 jax.random.randint(rng, (batch, seq), 0, cfg.vocab_size,
                                    jnp.int32), mesh)
-            for _ in range(args.warmup):
+            # At least one untimed step always runs: it both compiles the
+            # step and surfaces OOM before the timed section (--warmup 0
+            # must not leave `loss` unbound).
+            for _ in range(max(1, args.warmup)):
                 state, loss = step(state, tokens)
             jax.block_until_ready(loss)
             break
@@ -94,17 +119,31 @@ def main() -> None:
     tokens_per_sec = batch * seq * args.steps / elapsed
     per_chip = tokens_per_sec / n_dev
 
-    # Model FLOPs utilization (6*N*T approximation for training).
+    # Training FLOPs/token: 6*N for the weights plus the attention
+    # quadratic term 12 * layers * embed * seq (fwd QK^T+AV and their
+    # backward, per the PaLM appendix accounting).
     n_params = cfg.num_params()
-    flops_per_token = 6 * n_params
-    achieved_tflops = tokens_per_sec * flops_per_token / 1e12
+    flops_per_token = 6 * n_params + 12 * cfg.num_layers * cfg.embed_dim * seq
+    achieved_tflops_chip = per_chip * flops_per_token / 1e12
 
-    baseline = None
+    # bf16 peak per chip by TPU generation; MFU is only meaningful on TPU.
+    peaks = {'v4': 275., 'v5 lite': 197., 'v5e': 197., 'v5p': 459.,
+             'v6e': 918., 'v6 lite': 918.}
+    mfu = None
+    if platform == 'tpu':
+        kind = devices[0].device_kind.lower()
+        peak = next((v for k, v in peaks.items() if k in kind), 197.)
+        mfu = achieved_tflops_chip / peak
+
     base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              'BENCH_BASELINE.json')
+    baseline = None
     if os.path.exists(base_path):
         with open(base_path, 'r', encoding='utf-8') as f:
             recorded = json.load(f)
+        # Only compare like with like: a CPU smoke number must not be
+        # scored against a recorded TPU baseline.
+        if recorded.get('platform') == platform:
             baseline = recorded.get('value')
     vs_baseline = (per_chip / baseline) if baseline else 1.0
 
@@ -114,10 +153,18 @@ def main() -> None:
         'unit': 'tokens/s/chip',
         'vs_baseline': round(vs_baseline, 3),
     }
+    # First successful real-TPU run becomes the recorded baseline that
+    # later rounds are scored against.
+    if platform == 'tpu' and baseline is None:
+        with open(base_path, 'w', encoding='utf-8') as f:
+            json.dump({**result, 'platform': platform,
+                       'mfu': round(mfu, 4) if mfu is not None else None,
+                       'batch': batch, 'seq': seq}, f, indent=1)
     # Extra context on stderr (driver reads the stdout JSON line only).
     print(f'# platform={platform} n_dev={n_dev} batch={batch} seq={seq} '
           f'steps={args.steps} elapsed={elapsed:.2f}s '
-          f'loss={float(loss):.3f} ~{achieved_tflops:.1f} TFLOP/s total',
+          f'loss={float(loss):.3f} {achieved_tflops_chip:.1f} TFLOP/s/chip'
+          + (f' MFU={mfu:.1%}' if mfu is not None else ''),
           file=sys.stderr)
     print(json.dumps(result))
 

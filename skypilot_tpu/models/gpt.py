@@ -130,12 +130,12 @@ class GPT(nn.Module):
         wte = self.param(
             'wte',
             nn.with_logical_partitioning(
-                nn.initializers.normal(stddev=0.02), ('vocab', 'embed')),
+                nn.initializers.normal(stddev=0.02), ('vocab', 'table_embed')),
             (cfg.vocab_size, cfg.embed_dim), jnp.float32)
         wpe = self.param(
             'wpe',
             nn.with_logical_partitioning(
-                nn.initializers.normal(stddev=0.01), ('seq', 'embed')),
+                nn.initializers.normal(stddev=0.01), ('seq', 'table_embed')),
             (cfg.block_size, cfg.embed_dim), jnp.float32)
         x = wte.astype(cfg.dtype)[tokens] + wpe.astype(cfg.dtype)[:seq]
         x = nn.with_logical_constraint(x, ('batch', 'seq', 'act_embed'))

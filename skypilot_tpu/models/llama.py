@@ -193,7 +193,7 @@ class Llama(nn.Module):
         embed = self.param(
             'tok_embed',
             nn.with_logical_partitioning(
-                nn.initializers.normal(stddev=0.02), ('vocab', 'embed')),
+                nn.initializers.normal(stddev=0.02), ('vocab', 'table_embed')),
             (cfg.vocab_size, cfg.embed_dim), jnp.float32)
         x = embed.astype(cfg.dtype)[tokens]
         x = nn.with_logical_constraint(x, ('batch', 'seq', 'act_embed'))

@@ -31,6 +31,13 @@ DEFAULT_RULES: Tuple[Tuple[str, Optional[object]], ...] = (
     ('seq', 'seq'),                # sequence (context) parallelism axis
     ('act_embed', None),           # activations' embed dim stays unsharded
     ('embed', 'fsdp'),             # FSDP: shard params' embed dim
+    # Embedding-*table* embed dim stays unsharded: the scatter-add grad of
+    # a gather forces GSPMD to reshard the residual-stream cotangent from
+    # batch-sharded to embed-over-fsdp with batch replicated — an
+    # "involuntary full rematerialization" (replicate-then-repartition).
+    # Tables shard over vocab->tensor instead; dense kernels keep
+    # embed->fsdp where the backward is a matmul (reduce-scatter-able).
+    ('table_embed', None),
     ('heads', 'tensor'),           # TP: attention heads
     ('kv', None),
     ('mlp', 'tensor'),             # TP: MLP hidden
