@@ -27,6 +27,7 @@ Endpoints:
 from __future__ import annotations
 
 import argparse
+import hmac
 import json
 import os
 import re
@@ -47,6 +48,19 @@ from skypilot_tpu.utils import subprocess_utils
 _EVENT_INTERVAL_SECONDS = 2.0
 
 
+def secret_path(home: str) -> str:
+    return os.path.join(home, 'agent_secret')
+
+
+def read_secret(home: str) -> Optional[str]:
+    try:
+        with open(secret_path(home), 'r', encoding='utf-8') as f:
+            value = f.read().strip()
+            return value or None
+    except OSError:
+        return None
+
+
 class AgentState:
 
     def __init__(self, home: str, cluster_name: str, is_head: bool) -> None:
@@ -54,6 +68,11 @@ class AgentState:
         os.makedirs(self.home, exist_ok=True)
         self.cluster_name = cluster_name
         self.is_head = is_head
+        # Per-cluster shared secret, written at provision time. When
+        # present, every endpoint except GET /health requires it (the
+        # reference only reaches skylet over SSH/authed gRPC — an open
+        # /exec port would be remote code execution).
+        self.secret = read_secret(self.home)
         self.jobs = job_lib.JobTable(self.home) if is_head else None
         self.started_at = time.time()
         # rank executions: job_id -> {'proc': Popen, 'rc': Optional[int]}
@@ -199,11 +218,23 @@ class Handler(BaseHTTPRequestHandler):
         except Exception:  # pylint: disable=broad-except
             pass
 
+    def _authorized(self, method: str, parts) -> bool:
+        if STATE.secret is None:
+            return True
+        if method == 'GET' and parts == ['health']:
+            return True  # liveness probes stay secretless
+        presented = self.headers.get('X-Agent-Token', '')
+        return hmac.compare_digest(presented, STATE.secret)
+
     def _route(self, method: str) -> None:
         assert STATE is not None
         url = urlparse(self.path)
         parts = [p for p in url.path.split('/') if p]
         query = {k: v[0] for k, v in parse_qs(url.query).items()}
+
+        if not self._authorized(method, parts):
+            self._json({'error': 'missing or bad X-Agent-Token'}, code=401)
+            return
 
         if method == 'GET' and parts == ['health']:
             self._json({

@@ -8,7 +8,7 @@ localhost tunnel endpoint for SSH-only clusters).
 from __future__ import annotations
 
 import time
-from typing import Any, Dict, Iterator, List, Optional
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Union
 
 import requests
 
@@ -17,20 +17,59 @@ from skypilot_tpu.agent import job_lib
 
 
 class AgentClient:
+    """Talks to one agent.
 
-    def __init__(self, addr: str, timeout: float = 30.0) -> None:
-        self.base = f'http://{addr}'
+    `addr` may be a list of candidate `host:port` endpoints tried in
+    order (internal IP first, external as fallback) — the first one
+    that answers is cached for the rest of the client's life. `secret`
+    is the per-cluster token sent as X-Agent-Token on every request.
+    """
+
+    def __init__(self, addr: Union[str, Sequence[str]],
+                 timeout: float = 30.0,
+                 secret: Optional[str] = None) -> None:
+        addrs = [addr] if isinstance(addr, str) else list(addr)
+        # De-dup, preserving order (internal == external on localhost).
+        self.candidates = list(dict.fromkeys(a for a in addrs if a))
+        if not self.candidates:
+            raise ValueError('AgentClient needs at least one address')
+        self.base = f'http://{self.candidates[0]}'
+        self._probed = len(self.candidates) == 1
         self.timeout = timeout
+        self.headers = {'X-Agent-Token': secret} if secret else {}
+
+    def _probe(self) -> None:
+        """Pick the first reachable candidate (short connect timeout).
+
+        If nothing answers (agent still booting), stays unprobed so the
+        next call re-tries — a boot-time failure must not pin a dead
+        endpoint for the client's lifetime.
+        """
+        if self._probed:
+            return
+        for cand in self.candidates:
+            try:
+                resp = requests.get(f'http://{cand}/health', timeout=(3, 5))
+                if resp.status_code != 200:
+                    continue  # some other service answered on this port
+                self.base = f'http://{cand}'
+                self._probed = True
+                return
+            except requests.RequestException:
+                continue
 
     def _get(self, path: str, **kw) -> Dict[str, Any]:
-        resp = requests.get(f'{self.base}{path}', timeout=self.timeout, **kw)
+        self._probe()
+        resp = requests.get(f'{self.base}{path}', timeout=self.timeout,
+                            headers=self.headers, **kw)
         resp.raise_for_status()
         return resp.json()
 
     def _post(self, path: str, payload: Optional[Dict] = None
               ) -> Dict[str, Any]:
+        self._probe()
         resp = requests.post(f'{self.base}{path}', json=payload or {},
-                             timeout=self.timeout)
+                             timeout=self.timeout, headers=self.headers)
         resp.raise_for_status()
         return resp.json()
 
@@ -100,8 +139,10 @@ class AgentClient:
         params = {'follow': '1' if follow else '0'}
         if tail:
             params['tail'] = str(tail)
+        self._probe()
         with requests.get(f'{self.base}/jobs/{job_id}/logs', params=params,
-                          stream=True, timeout=(30, None)) as resp:
+                          stream=True, timeout=(30, None),
+                          headers=self.headers) as resp:
             resp.raise_for_status()
             for line in resp.iter_lines(decode_unicode=True):
                 yield line + '\n'

@@ -34,11 +34,14 @@ _POLL_SECONDS = 1.0
 
 class RankExec:
 
-    def __init__(self, host: Dict[str, Any], job_id: int) -> None:
+    def __init__(self, host: Dict[str, Any], job_id: int,
+                 secret: Optional[str] = None) -> None:
         self.host = host          # {'addr': 'ip:port', 'rank': int, ...}
         self.rank = int(host['rank'])
         self.job_id = job_id
         self.base = f'http://{host["addr"]}'
+        # All agents of one cluster share the head's secret.
+        self.headers = {'X-Agent-Token': secret} if secret else {}
         self.rc: Optional[int] = None
 
     def start(self, script: str, env: Dict[str, str],
@@ -48,7 +51,7 @@ class RankExec:
             'script': script,
             'env': env,
             'cwd': cwd,
-        }, timeout=30)
+        }, timeout=30, headers=self.headers)
         resp.raise_for_status()
 
     def poll(self) -> Optional[int]:
@@ -56,7 +59,7 @@ class RankExec:
             return self.rc
         try:
             resp = requests.get(f'{self.base}/exec/{self.job_id}/status',
-                                timeout=10)
+                                timeout=10, headers=self.headers)
             resp.raise_for_status()
             data = resp.json()
             if not data['running']:
@@ -69,7 +72,7 @@ class RankExec:
     def cancel(self) -> None:
         try:
             requests.post(f'{self.base}/exec/{self.job_id}/cancel',
-                          timeout=10)
+                          timeout=10, headers=self.headers)
         except requests.RequestException:
             pass
 
@@ -79,7 +82,8 @@ class RankExec:
         try:
             with requests.get(f'{self.base}/exec/{self.job_id}/logs',
                               params={'follow': '1'}, stream=True,
-                              timeout=(30, None)) as resp:
+                              timeout=(30, None),
+                              headers=self.headers) as resp:
                 with open(rank_log_path, 'ab') as rank_file:
                     for raw in resp.iter_lines(decode_unicode=False):
                         rank_file.write(raw + b'\n')
@@ -112,7 +116,9 @@ def run_job(home: str, job_id: int) -> job_lib.JobStatus:
                                                   [{} for _ in hosts])
     cwd = spec.get('cwd')
 
-    execs = [RankExec(h, job_id) for h in hosts]
+    from skypilot_tpu.agent import agent as agent_lib
+    secret = agent_lib.read_secret(home)
+    execs = [RankExec(h, job_id, secret) for h in hosts]
     combined_path = os.path.join(log_dir, 'run.log')
     combined = open(combined_path, 'ab', buffering=0)
     lock = threading.Lock()

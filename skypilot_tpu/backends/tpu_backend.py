@@ -45,12 +45,17 @@ class TpuVmResourceHandle(backend_lib.ResourceHandle):
     def __init__(self, *, cluster_name: str, cluster_name_on_cloud: str,
                  launched_nodes: int,
                  launched_resources: 'resources_lib.Resources',
-                 cluster_info: provision_common.ClusterInfo) -> None:
+                 cluster_info: provision_common.ClusterInfo,
+                 agent_secret: Optional[str] = None) -> None:
         self.cluster_name = cluster_name
         self.cluster_name_on_cloud = cluster_name_on_cloud
         self.launched_nodes = launched_nodes
         self.launched_resources = launched_resources
         self.cluster_info = cluster_info
+        # Per-cluster agent auth token; lives on the handle so a
+        # cluster_info refresh (core.start) does not lose it.
+        self.agent_secret = (agent_secret or
+                             cluster_info.custom.get('agent_secret'))
 
     def get_cluster_name(self) -> str:
         return self.cluster_name
@@ -60,13 +65,27 @@ class TpuVmResourceHandle(backend_lib.ResourceHandle):
         return self.cluster_info.provider_name
 
     @property
-    def head_agent_addr(self) -> str:
+    def head_agent_addrs(self) -> List[str]:
+        """Candidate head-agent endpoints, internal IP first.
+
+        Internal is preferred (traffic stays in the VPC); external is
+        the fallback when the API server sits outside the network.
+        """
         head = self.cluster_info.get_head_instance()
-        ip = head.external_ip or head.internal_ip
-        return f'{ip}:{head.agent_port or constants.AGENT_PORT}'
+        port = head.agent_port or constants.AGENT_PORT
+        addrs = [f'{head.internal_ip}:{port}']
+        if head.external_ip and head.external_ip != head.internal_ip:
+            addrs.append(f'{head.external_ip}:{port}')
+        return addrs
+
+    @property
+    def head_agent_addr(self) -> str:
+        return self.head_agent_addrs[0]
 
     def agent(self) -> agent_client.AgentClient:
-        return agent_client.AgentClient(self.head_agent_addr)
+        return agent_client.AgentClient(
+            self.head_agent_addrs,
+            secret=getattr(self, 'agent_secret', None))
 
     @property
     def num_hosts(self) -> int:
@@ -160,14 +179,30 @@ class RetryingProvisioner:
                         f'{common_utils.format_exception(e)}')
                     self.failover_history.append(e)
                     # Best-effort cleanup of partial creations (deploy
-                    # vars carry the zone the attempt targeted).
+                    # vars carry the zone the attempt targeted). A failed
+                    # cleanup leaks billable resources — surface it in the
+                    # cluster events so `status -v`/debug-dump show it
+                    # instead of swallowing silently.
                     try:
                         provider = cloud.provisioner_module()
                         provision_lib.terminate_instances(
                             provider, cluster_name_on_cloud,
                             provider_config=deploy_vars)
-                    except Exception:  # pylint: disable=broad-except
-                        pass
+                    except Exception as cleanup_err:  # pylint: disable=broad-except
+                        msg = (
+                            f'Cleanup after failed provision in {zone_str} '
+                            f'did not complete: '
+                            f'{common_utils.format_exception(cleanup_err)}. '
+                            f'Resources named {cluster_name_on_cloud!r} may '
+                            f'be LEAKED in {zone_str}; verify in the cloud '
+                            f'console.')
+                        ux_utils.log(msg)
+                        try:
+                            global_state.add_cluster_event(
+                                cluster_name, 'provision_cleanup_failed',
+                                msg)
+                        except Exception:  # pylint: disable=broad-except
+                            pass  # event logging must not mask failover
                     # Category-directed failover (reference:
                     # FailoverCloudErrorHandlerV2 blocklist semantics).
                     if getattr(e, 'no_failover', False):
@@ -280,12 +315,20 @@ class TpuVmBackend(backend_lib.Backend[TpuVmResourceHandle]):
         cluster_info = provision_lib.get_cluster_info(
             provider, region.name, cluster_name_on_cloud,
             record.provider_config)
+        # Per-cluster agent secret: the local provisioner mints its own
+        # (exposed via cluster_info.custom); cloud paths mint one here
+        # and instance_setup installs it on every host.
+        agent_secret = cluster_info.custom.get('agent_secret')
+        if agent_secret is None:
+            import secrets as secrets_lib
+            agent_secret = secrets_lib.token_hex(16)
         handle = TpuVmResourceHandle(
             cluster_name=cluster_name,
             cluster_name_on_cloud=cluster_name_on_cloud,
             launched_nodes=task.num_nodes,
             launched_resources=resolved,
-            cluster_info=cluster_info)
+            cluster_info=cluster_info,
+            agent_secret=agent_secret)
         global_state.add_or_update_cluster(cluster_name, handle,
                                            requested_resources=task.resources,
                                            ready=False)
@@ -307,7 +350,9 @@ class TpuVmBackend(backend_lib.Backend[TpuVmResourceHandle]):
             from skypilot_tpu.provision import instance_setup
             instance_setup.setup_agents(handle.cluster_info,
                                         handle.get_command_runners(),
-                                        handle.cluster_name)
+                                        handle.cluster_name,
+                                        secret=getattr(handle,
+                                                       'agent_secret', None))
         if not handle.agent().wait_until_healthy(timeout=120):
             raise exceptions.ClusterSetUpError(
                 f'Agent on {handle.head_agent_addr} did not become healthy.')

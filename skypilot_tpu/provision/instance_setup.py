@@ -7,7 +7,8 @@ Used by the GCP/SSH paths; the Local provisioner starts agents itself.
 from __future__ import annotations
 
 import os
-from typing import List
+import tempfile
+from typing import List, Optional
 
 from skypilot_tpu import constants
 from skypilot_tpu import exceptions
@@ -34,25 +35,40 @@ def _repo_root() -> str:
 
 def setup_agents(cluster_info: provision_common.ClusterInfo,
                  runners: List[runner_lib.CommandRunner],
-                 cluster_name: str) -> None:
+                 cluster_name: str,
+                 secret: Optional[str] = None) -> None:
     """Upload the package to every host and start its agent.
 
     The package is rsynced from the server's own installation — the
     reference builds+uploads a wheel so remote runtime matches server
     code (sky/backends/wheel_utils.py); rsync of the package tree is
-    the same guarantee with less machinery.
+    the same guarantee with less machinery. The per-cluster `secret`
+    is rsynced (not passed via argv, which would leak through `ps`) to
+    `<home>/agent_secret` before the agent starts; the agent then
+    rejects any request without the matching X-Agent-Token.
     """
     src = os.path.join(_repo_root(), 'skypilot_tpu') + '/'
     instances = cluster_info.sorted_instances()
 
+    secret_src = None
+    if secret is not None:
+        fd, secret_src = tempfile.mkstemp(prefix='agent_secret_')
+        with os.fdopen(fd, 'w', encoding='utf-8') as f:
+            f.write(secret)
+        os.chmod(secret_src, 0o600)
+
     def bootstrap(pair) -> None:
         inst, runner = pair
-        runner.run(f'mkdir -p {_PKG_REMOTE_DIR}/skypilot_tpu')
+        home = constants.SKY_REMOTE_HOME
+        runner.run(f'mkdir -p {_PKG_REMOTE_DIR}/skypilot_tpu '
+                   f'&& mkdir -p {home} && chmod 700 {home}')
         runner.rsync(src, f'{_PKG_REMOTE_DIR}/skypilot_tpu/', up=True,
                      excludes=['__pycache__'])
+        if secret_src is not None:
+            runner.rsync(secret_src, f'{home}/agent_secret', up=True)
         is_head = inst.instance_id == cluster_info.head_instance_id
         cmd = _AGENT_START_TEMPLATE.format(
-            home=constants.SKY_REMOTE_HOME,
+            home=home,
             pkg_dir=_PKG_REMOTE_DIR,
             python=_VENV_PY,
             port=inst.agent_port or constants.AGENT_PORT,
@@ -63,5 +79,12 @@ def setup_agents(cluster_info: provision_common.ClusterInfo,
             raise exceptions.ClusterSetUpError(
                 f'Failed to start agent on {inst.instance_id} (rc={rc}).')
 
-    subprocess_utils.run_in_parallel(bootstrap,
-                                     list(zip(instances, runners)))
+    try:
+        subprocess_utils.run_in_parallel(bootstrap,
+                                         list(zip(instances, runners)))
+    finally:
+        if secret_src is not None:
+            try:
+                os.remove(secret_src)
+            except OSError:
+                pass

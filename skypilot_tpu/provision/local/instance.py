@@ -50,8 +50,15 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _start_agent(host: Dict[str, Any], cluster: str) -> int:
+def _start_agent(host: Dict[str, Any], cluster: str,
+                 secret: Optional[str] = None) -> int:
     agent_home = os.path.join(host['dir'], '.sky-tpu-agent')
+    if secret is not None:
+        os.makedirs(agent_home, exist_ok=True)
+        sp = os.path.join(agent_home, 'agent_secret')
+        with open(sp, 'w', encoding='utf-8') as f:
+            f.write(secret)
+        os.chmod(sp, 0o600)
     cmd = [sys.executable, '-m', 'skypilot_tpu.agent.agent',
            '--port', str(host['agent_port']),
            '--home', agent_home,
@@ -97,6 +104,7 @@ def run_instances(region: str, cluster_name_on_cloud: str,
                     'host_rank': hrank,
                     'is_head': node == 0 and hrank == 0,
                 })
+        import secrets as secrets_lib
         meta = {
             'cluster': cluster_name_on_cloud,
             'num_nodes': num_nodes,
@@ -104,6 +112,7 @@ def run_instances(region: str, cluster_name_on_cloud: str,
             'hosts': hosts,
             'provider_config': config.provider_config,
             'created_at': time.time(),
+            'agent_secret': secrets_lib.token_hex(16),
         }
         created = [h['id'] for h in hosts]
     else:
@@ -117,7 +126,8 @@ def run_instances(region: str, cluster_name_on_cloud: str,
     # (Re)start dead agents — also the resume-stopped path.
     for host in meta['hosts']:
         if not subprocess_utils.process_alive(host['agent_pid']):
-            host['agent_pid'] = _start_agent(host, cluster_name_on_cloud)
+            host['agent_pid'] = _start_agent(host, cluster_name_on_cloud,
+                                             meta.get('agent_secret'))
             if host['id'] not in created:
                 resumed.append(host['id'])
     meta['status'] = 'running'
@@ -217,7 +227,8 @@ def get_cluster_info(region: str, cluster_name_on_cloud: str,
         provider_config=meta.get('provider_config', {}),
         ssh_user=os.environ.get('USER', 'root'),
         ssh_private_key=None,
-        custom={'sandbox_dirs': sandbox_dirs},
+        custom={'sandbox_dirs': sandbox_dirs,
+                'agent_secret': meta.get('agent_secret')},
     )
 
 

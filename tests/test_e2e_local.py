@@ -112,3 +112,42 @@ def test_launch_dryrun(isolated_state):
                                 _quiet_optimizer=True)
     assert job_id is None and handle is None
     assert core.status() == []
+
+
+@pytest.mark.slow
+def test_agent_rejects_unauthenticated(local_cluster):
+    """Every mutating agent endpoint requires the per-cluster secret.
+
+    Reference posture: skylet is only reachable over SSH/authed gRPC
+    (sky/backends/cloud_vm_ray_backend.py:2888-3086); our HTTP agent
+    must therefore reject token-less requests outright.
+    """
+    import requests as req
+
+    handle = local_cluster
+    addr = handle.head_agent_addr
+    assert getattr(handle, 'agent_secret', None), 'cluster has no secret'
+
+    # Liveness probe stays open (provision wait loops use it).
+    r = req.get(f'http://{addr}/health', timeout=5)
+    assert r.status_code == 200
+
+    # No token -> 401 on every sensitive route, and nothing executes.
+    r = req.post(f'http://{addr}/exec',
+                 json={'job_id': 999, 'script': 'touch /tmp/pwned'},
+                 timeout=5)
+    assert r.status_code == 401
+    r = req.post(f'http://{addr}/jobs/submit',
+                 json={'name': 'x', 'spec': {}}, timeout=5)
+    assert r.status_code == 401
+    r = req.get(f'http://{addr}/jobs', timeout=5)
+    assert r.status_code == 401
+
+    # Wrong token -> 401 too.
+    r = req.get(f'http://{addr}/jobs', timeout=5,
+                headers={'X-Agent-Token': 'not-the-secret'})
+    assert r.status_code == 401
+
+    # The authed client path still works.
+    assert handle.agent().health()['status'] == 'ok'
+    assert isinstance(handle.agent().get_jobs(), list)
