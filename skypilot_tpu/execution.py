@@ -53,6 +53,8 @@ def launch(
     down: bool = False,
     retry_until_up: bool = False,
     no_setup: bool = False,
+    optimize_target: 'optimizer_lib.OptimizeTarget' = (
+        optimizer_lib.OptimizeTarget.COST),
     _quiet_optimizer: bool = False,
     _is_launched_by_jobs_controller: bool = False,
     _blocked_resources: Optional[set] = None,
@@ -101,7 +103,9 @@ def launch(
         if stage == Stage.OPTIMIZE:
             if any(r.cloud is None or not r.is_launchable()
                    for r in task.resources) or task.best_resources is None:
-                optimizer_lib.Optimizer.optimize(dag, quiet=_quiet_optimizer)
+                optimizer_lib.Optimizer.optimize(dag,
+                                                 minimize=optimize_target,
+                                                 quiet=_quiet_optimizer)
         elif stage == Stage.PROVISION:
             to_provision = task.best_resources
             if to_provision is None:

@@ -63,9 +63,24 @@ class Task:
         self.service: Optional[Any] = None  # serve.S022erviceSpec
         self.best_resources: Optional[resources_lib.Resources] = None
         self.estimated_runtime: Optional[float] = None
+        # Optional per-candidate runtime model for minimize=TIME
+        # (reference: sky/task.py set_time_estimator — fn(Resources)->s).
+        self.time_estimator_fn: Optional[Any] = None
+        # Size of this task's inputs, for inter-cloud egress costing.
+        self.estimated_inputs_gigabytes: Optional[float] = None
         # DAG wiring (set by Dag):
         self.dag: Optional[Any] = None
         self._validate()
+
+    def set_time_estimator(self, fn) -> 'Task':
+        """fn(resources) -> estimated seconds on that hardware."""
+        self.time_estimator_fn = fn
+        return self
+
+    def estimate_runtime(self, resources: 'resources_lib.Resources') -> float:
+        if self.time_estimator_fn is not None:
+            return float(self.time_estimator_fn(resources))
+        return float(self.estimated_runtime or 3600.0)
 
     def _validate(self) -> None:
         if self.name is not None:
