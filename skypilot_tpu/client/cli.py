@@ -786,13 +786,13 @@ def batch_cancel_cmd(batch_name, yes) -> None:
 
 
 
-@cli.command(name='users')
-def users_cmd() -> None:
-    """Show users seen by the API server."""
-    import requests as _requests
-    url = sdk._ensure_server()
-    rows = _requests.get(f'{url}/users', headers=sdk._headers(),
-                         timeout=30).json()['users']
+@cli.group(name='users', invoke_without_command=True)
+@click.pass_context
+def users_cmd(ctx) -> None:
+    """Users, roles, and service-account tokens (admin)."""
+    if ctx.invoked_subcommand is not None:
+        return
+    rows = sdk.users_ls()
     from rich.console import Console
     from rich.table import Table
     table = Table(box=None)
@@ -804,6 +804,63 @@ def users_cmd() -> None:
         table.add_row(r['name'], r.get('role') or 'user',
                       str(r['request_count']), last)
     Console().print(table)
+
+
+@users_cmd.command(name='role')
+@click.argument('user')
+@click.argument('role', type=click.Choice(['admin', 'user']))
+def users_role_cmd(user: str, role: str) -> None:
+    """Set USER's role (admin only)."""
+    sdk.users_set_role(user, role)
+    click.echo(f'{user}: role={role}')
+
+
+@users_cmd.group(name='token')
+def users_token_cmd() -> None:
+    """Service-account tokens: server-derived identity for the API."""
+
+
+@users_token_cmd.command(name='issue')
+@click.argument('user')
+@click.option('--role', default='user',
+              type=click.Choice(['admin', 'user']))
+def token_issue_cmd(user: str, role: str) -> None:
+    """Mint a token for USER; the cleartext is printed ONCE."""
+    out = sdk.token_issue(user, role)
+    click.echo(f'token_id: {out["token_id"]}')
+    click.echo(f'token:    {out["token"]}')
+    click.echo('Store it now — it is not retrievable later. Clients '
+               'present it via SKYPILOT_API_TOKEN or '
+               'api_server.auth_token in config.')
+
+
+@users_token_cmd.command(name='ls')
+def token_ls_cmd() -> None:
+    """List issued tokens (hashes only)."""
+    from rich.console import Console
+    from rich.table import Table
+    table = Table(box=None)
+    for col in ('TOKEN ID', 'USER', 'CREATED', 'LAST USED', 'REVOKED'):
+        table.add_column(col)
+    for t in sdk.token_ls():
+        created = datetime.datetime.fromtimestamp(
+            t['created_at']).strftime('%m-%d %H:%M')
+        last = (datetime.datetime.fromtimestamp(
+            t['last_used_at']).strftime('%m-%d %H:%M')
+            if t['last_used_at'] else '-')
+        table.add_row(t['token_id'], t['user_hash'], created, last,
+                      'yes' if t['revoked'] else '')
+    Console().print(table)
+
+
+@users_token_cmd.command(name='revoke')
+@click.argument('token_id')
+def token_revoke_cmd(token_id: str) -> None:
+    """Revoke a token by its id."""
+    if sdk.token_revoke(token_id):
+        click.echo('Revoked.')
+    else:
+        click.echo('No such token.', err=True)
 
 
 def main() -> None:

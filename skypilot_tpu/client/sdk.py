@@ -125,6 +125,9 @@ def _post(path: str, payload: Dict[str, Any]) -> str:
     url = _ensure_server()
     resp = requests.post(f'{url}{path}', json=payload, headers=_headers(),
                          timeout=30)
+    if resp.status_code in (401, 403):
+        raise exceptions.PermissionDeniedError(
+            resp.json().get('error', 'permission denied'))
     resp.raise_for_status()
     return resp.json()['request_id']
 
@@ -411,3 +414,45 @@ def jobs_pool_ls() -> str:
 
 def jobs_pool_down(pool_name: str) -> str:
     return _post('/jobs/pool/down', {'pool_name': pool_name})
+
+
+# ---------------------------------------------------------------------------
+# Users / RBAC / service-account tokens (reference: sky/client/
+# service_account_auth.py + `sky api` auth commands). These routes
+# return JSON directly (no request future).
+# ---------------------------------------------------------------------------
+def _direct(method: str, path: str,
+            payload: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    url = _ensure_server()
+    if method == 'GET':
+        resp = requests.get(f'{url}{path}', headers=_headers(), timeout=30)
+    else:
+        resp = requests.post(f'{url}{path}', json=payload or {},
+                             headers=_headers(), timeout=30)
+    if resp.status_code in (401, 403):
+        raise exceptions.PermissionDeniedError(
+            resp.json().get('error', 'permission denied'))
+    resp.raise_for_status()
+    return resp.json()
+
+
+def users_ls() -> List[Dict[str, Any]]:
+    return _direct('GET', '/users')['users']
+
+
+def users_set_role(user: str, role: str) -> None:
+    _direct('POST', '/users/role', {'user': user, 'role': role})
+
+
+def token_issue(user: str, role: str = 'user') -> Dict[str, str]:
+    """Mint a service-account token (admin only). Shown once."""
+    return _direct('POST', '/users/tokens', {'user': user, 'role': role})
+
+
+def token_ls() -> List[Dict[str, Any]]:
+    return _direct('GET', '/users/tokens')['tokens']
+
+
+def token_revoke(token_id: str) -> bool:
+    return _direct('POST', '/users/tokens/revoke',
+                   {'token_id': token_id})['revoked']

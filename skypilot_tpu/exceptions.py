@@ -231,6 +231,10 @@ class RequestNotFoundError(SkyError):
     pass
 
 
+class PermissionDeniedError(SkyError):
+    """401/403 from the API server (RBAC or bad/missing token)."""
+
+
 class RequestCancelled(SkyError):
     pass
 

@@ -101,7 +101,11 @@ def add_or_update_cluster(cluster_name: str, cluster_handle: Any,
     row = _db().query_one('SELECT name, launched_at FROM clusters '
                           'WHERE name=?', (cluster_name,))
     launched_at = now if (row is None or is_launch) else row['launched_at']
-    cluster_hash = common_utils.get_user_hash() + '-' + cluster_name
+    # Owner: the API request's server-derived identity when running in
+    # an executor worker; the local OS user otherwise.
+    from skypilot_tpu.utils import request_context
+    owner = request_context.get_request_user() or common_utils.get_user_hash()
+    cluster_hash = owner + '-' + cluster_name
     _db().execute(
         'INSERT INTO clusters (name, launched_at, handle, last_use, status, '
         'owner, cluster_hash, resources_str) '
@@ -110,7 +114,7 @@ def add_or_update_cluster(cluster_name: str, cluster_handle: Any,
         'handle=excluded.handle, last_use=excluded.last_use, '
         'status=excluded.status, resources_str=excluded.resources_str',
         (cluster_name, launched_at, handle_blob, str(now), status.value,
-         common_utils.get_user_hash(), cluster_hash, resources_str))
+         owner, cluster_hash, resources_str))
     add_cluster_event(cluster_name,
                       'launched' if is_launch else 'updated',
                       resources_str)

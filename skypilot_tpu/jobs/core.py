@@ -12,7 +12,7 @@ from skypilot_tpu.jobs import state
 
 
 def launch(task_config: Dict[str, Any], name: Optional[str] = None,
-           user: str = 'unknown',
+           user: Optional[str] = None,
            pool: Optional[str] = None) -> Dict[str, Any]:
     """Submit a managed job; returns its id immediately. With `pool`,
     the job borrows a pre-provisioned pool worker instead of
@@ -32,6 +32,10 @@ def launch(task_config: Dict[str, Any], name: Optional[str] = None,
             max_restarts = int(r.job_recovery.get('max_restarts_on_errors',
                                                   0))
             strategy = r.job_recovery.get('strategy') or strategy
+    # Identity: prefer the server-derived request user over any
+    # payload-supplied name (the payload is client-controlled).
+    from skypilot_tpu.utils import request_context
+    user = request_context.get_request_user() or user or 'unknown'
     job_id = state.submit_job(name or task.name, task_config, strategy,
                               max_restarts, user, pool=pool)
     scheduler.maybe_schedule_next_jobs()
@@ -81,8 +85,10 @@ def pool_down(pool_name: str) -> None:
     pools_lib.down(pool_name)
 
 
-def cancel(job_ids: Optional[List[int]] = None,
+def cancel(job_ids: Optional[List[int]] = None,  # noqa: D401
            all_jobs: bool = False) -> List[int]:
+    """Cancel jobs by id (RBAC: users/permission.py gates non-owners
+    at the HTTP boundary under the payload key `job_ids`/`all_jobs`)."""
     if all_jobs:
         job_ids = [j['job_id'] for j in state.get_jobs()
                    if not j['status'].is_terminal()]

@@ -38,6 +38,7 @@ def _db():
         conn.executescript(_CREATE_SQL)
     db.add_column_if_missing('managed_jobs', 'pool', 'TEXT')
     db.add_column_if_missing('managed_jobs', 'pool_worker', 'TEXT')
+    db.add_column_if_missing('job_pools', 'user', 'TEXT')
     return db
 
 
@@ -47,17 +48,42 @@ def worker_cluster(pool: str, idx: int) -> str:
 
 def apply(pool_name: str, task_config: Dict[str, Any],
           num_workers: int) -> Dict[str, Any]:
-    """Create/resize a pool: provision its worker clusters now."""
+    """Create/resize a pool: provision its worker clusters now.
+
+    Shrinking tears down the surplus workers (idx >= new size) —
+    refusing if any of them is busy — so no cluster keeps billing
+    outside the pool record.
+    """
     db = _db()
     # Validate the template (resources only; run/setup optional).
     template = task_lib.Task.from_yaml_config(dict(task_config))
     del template
+
+    prev = get(pool_name)
+    if prev is not None and prev['num_workers'] > num_workers:
+        surplus = [worker_cluster(pool_name, idx)
+                   for idx in range(num_workers, prev['num_workers'])]
+        busy = set(_busy_workers(pool_name)) & set(surplus)
+        if busy:
+            raise exceptions.SkyError(
+                f'Cannot shrink pool {pool_name!r}: {sorted(busy)} still '
+                'run jobs; cancel them first.')
+        from skypilot_tpu import core as sky_core
+        for cluster in surplus:
+            try:
+                sky_core.down(cluster)
+                ux_utils.log(f'Pool {pool_name}: released {cluster}.')
+            except exceptions.ClusterDoesNotExist:
+                pass
+
+    from skypilot_tpu.utils import request_context
     db.execute(
-        'INSERT INTO job_pools (name, task_config, num_workers, created_at) '
-        'VALUES (?,?,?,?) ON CONFLICT(name) DO UPDATE SET '
+        'INSERT INTO job_pools (name, task_config, num_workers, created_at, '
+        'user) VALUES (?,?,?,?,?) ON CONFLICT(name) DO UPDATE SET '
         'task_config=excluded.task_config, '
         'num_workers=excluded.num_workers',
-        (pool_name, json.dumps(task_config), num_workers, time.time()))
+        (pool_name, json.dumps(task_config), num_workers, time.time(),
+         request_context.get_request_user() or 'unknown'))
     provisioned = []
     for idx in range(num_workers):
         cluster = worker_cluster(pool_name, idx)

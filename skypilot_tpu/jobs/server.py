@@ -10,21 +10,13 @@ import threading
 from aiohttp import web
 
 from skypilot_tpu.agent import log_lib
-from skypilot_tpu.server.requests import executor
+from skypilot_tpu.server.route_utils import scheduled_handler
 
 _API = 'skypilot_tpu.jobs.core'
 
 
 def _schedule(name: str, entrypoint: str, schedule_type: str = 'short'):
-
-    async def handler(request: web.Request) -> web.Response:
-        payload = await request.json() if request.can_read_body else {}
-        request_id = executor.schedule_request(
-            name, entrypoint, payload, schedule_type=schedule_type,
-            user=request.headers.get('X-Skypilot-User', 'unknown'))
-        return web.json_response({'request_id': request_id})
-
-    return handler
+    return scheduled_handler(name, entrypoint, schedule_type)
 
 
 async def jobs_logs(request: web.Request) -> web.StreamResponse:

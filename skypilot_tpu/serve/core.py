@@ -25,7 +25,11 @@ def _free_port() -> int:
 
 
 def up(task_config: Dict[str, Any], service_name: str,
-       user: str = 'unknown') -> Dict[str, Any]:
+       user: Optional[str] = None) -> Dict[str, Any]:
+    # Identity comes from the request context (server-derived), not the
+    # client-controlled payload.
+    from skypilot_tpu.utils import request_context
+    user = request_context.get_request_user() or user or 'unknown'
     task = task_lib.Task.from_yaml_config(dict(task_config))
     if task.service is None:
         raise exceptions.InvalidTaskYAMLError(

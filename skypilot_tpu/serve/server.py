@@ -6,21 +6,13 @@ from __future__ import annotations
 
 from aiohttp import web
 
-from skypilot_tpu.server.requests import executor
+from skypilot_tpu.server.route_utils import scheduled_handler
 
 _API = 'skypilot_tpu.serve.core'
 
 
 def _schedule(name: str, entrypoint: str, schedule_type: str = 'long'):
-
-    async def handler(request: web.Request) -> web.Response:
-        payload = await request.json() if request.can_read_body else {}
-        request_id = executor.schedule_request(
-            name, entrypoint, payload, schedule_type=schedule_type,
-            user=request.headers.get('X-Skypilot-User', 'unknown'))
-        return web.json_response({'request_id': request_id})
-
-    return handler
+    return scheduled_handler(name, entrypoint, schedule_type)
 
 
 def register(app: web.Application) -> None:

@@ -163,7 +163,7 @@ def _resolve_entrypoint(entrypoint: str) -> Callable:
 
 def _request_worker_main(request_id: str, entrypoint: str,
                          payload_json: str, log_path: str,
-                         db_path: str) -> None:
+                         db_path: str, user: str = 'unknown') -> None:
     """Runs in the forked worker process (reference:
     _request_execution_wrapper, executor.py:670)."""
     os.setpgrp()  # own process group: cancel kills the whole tree
@@ -172,6 +172,8 @@ def _request_worker_main(request_id: str, entrypoint: str,
     log_file = open(log_path, 'ab', buffering=0)
     os.dup2(log_file.fileno(), sys.stdout.fileno())
     os.dup2(log_file.fileno(), sys.stderr.fileno())
+    from skypilot_tpu.utils import request_context
+    request_context.set_request_user(user)
     try:
         fn = _resolve_entrypoint(entrypoint)
         payload = json.loads(payload_json)
@@ -271,7 +273,8 @@ class RequestWorkerLoop:
             target=_request_worker_main,
             args=(req['request_id'], req['entrypoint'], req['payload'],
                   req['log_path'],
-                  os.path.join(constants.api_server_dir(), 'requests.db')),
+                  os.path.join(constants.api_server_dir(), 'requests.db'),
+                  req['user'] or 'unknown'),
             daemon=True)
         proc.start()
         _set_status(req['request_id'], RequestStatus.RUNNING, pid=proc.pid)

@@ -1,0 +1,40 @@
+"""Shared request-scheduling helper for all API route groups.
+
+Every mutating route — core, jobs, serve, batch — funnels through
+`schedule()` so that (a) the request's identity is the middleware's
+server-derived `sky_user` (NOT the spoofable X-Skypilot-User header)
+and (b) the RBAC policy (users/permission.py) is applied uniformly
+before anything is enqueued.
+"""
+from __future__ import annotations
+
+import asyncio
+
+from aiohttp import web
+
+from skypilot_tpu.server.requests import executor
+
+
+async def schedule(request: web.Request, name: str, entrypoint: str,
+                   schedule_type: str = 'long') -> web.Response:
+    from skypilot_tpu.users import permission
+    payload = await request.json() if request.can_read_body else {}
+    user = request.get('sky_user', 'unknown')
+    role = request.get('sky_role', 'admin')
+    try:
+        await asyncio.get_event_loop().run_in_executor(
+            None, permission.check_request, name, payload, user, role)
+    except permission.PermissionDeniedError as e:
+        return web.json_response({'error': str(e)}, status=403)
+    request_id = executor.schedule_request(
+        name, entrypoint, payload, schedule_type=schedule_type, user=user)
+    return web.json_response({'request_id': request_id})
+
+
+def scheduled_handler(name: str, entrypoint: str,
+                      schedule_type: str = 'long'):
+
+    async def handler(request: web.Request) -> web.Response:
+        return await schedule(request, name, entrypoint, schedule_type)
+
+    return handler

@@ -26,24 +26,15 @@ routes = web.RouteTableDef()
 
 
 def _user(request: web.Request) -> str:
-    return request.headers.get('X-Skypilot-User', 'unknown')
+    """Server-derived identity set by auth_middleware."""
+    return request.get('sky_user', 'unknown')
 
 
-async def _schedule(request: web.Request, name: str, entrypoint: str,
-                    schedule_type: str = 'long') -> web.Response:
-    payload = await request.json() if request.can_read_body else {}
-    request_id = executor.schedule_request(
-        name, entrypoint, payload, schedule_type=schedule_type,
-        user=_user(request))
-    return web.json_response({'request_id': request_id})
+def _role(request: web.Request) -> str:
+    return request.get('sky_role', 'admin')
 
 
-def _mutating(name: str, entrypoint: str, schedule_type: str = 'long'):
-
-    async def handler(request: web.Request) -> web.Response:
-        return await _schedule(request, name, entrypoint, schedule_type)
-
-    return handler
+from skypilot_tpu.server.route_utils import scheduled_handler as _mutating
 
 
 # -- async request endpoints (reference: /launch, /exec, ...) ----------------
@@ -135,8 +126,15 @@ async def api_stream(request: web.Request) -> web.StreamResponse:
 
 
 async def api_cancel(request: web.Request) -> web.Response:
+    from skypilot_tpu.users import permission
     body = await request.json()
     request_id = body.get('request_id', '')
+    record = executor.get_request(request_id)
+    try:
+        permission.check_request_cancel(record, _user(request),
+                                        _role(request))
+    except permission.PermissionDeniedError as e:
+        return web.json_response({'error': str(e)}, status=403)
     try:
         cancelled = executor.cancel_request(request_id)
     except exceptions.RequestNotFoundError:
@@ -273,34 +271,130 @@ def create_app() -> web.Application:
     from skypilot_tpu.server import dashboard
     dashboard.register(app)
 
+    from skypilot_tpu.users import core as users_core
+    from skypilot_tpu.users import tokens as tokens_lib
+
+    def _admin_only(request: web.Request) -> Optional[web.Response]:
+        if _role(request) != 'admin':
+            return web.json_response(
+                {'error': f'admin role required (you are '
+                          f'{_user(request)!r}, role {_role(request)!r})'},
+                status=403)
+        return None
+
     async def users_ls(request: web.Request) -> web.Response:
         del request
-        from skypilot_tpu.users import core as users_core
-        return web.json_response({'users': users_core.ls()})
+        loop = asyncio.get_event_loop()
+        return web.json_response(
+            {'users': await loop.run_in_executor(None, users_core.ls)})
+
+    async def users_set_role(request: web.Request) -> web.Response:
+        denied = _admin_only(request)
+        if denied:
+            return denied
+        body = await request.json()
+        user = body.get('user')
+        if not user:
+            return web.json_response({'error': 'missing user'}, status=400)
+        try:
+            await asyncio.get_event_loop().run_in_executor(
+                None, users_core.set_role, user, body.get('role', 'user'))
+        except KeyError as e:
+            return web.json_response({'error': str(e)}, status=404)
+        except ValueError as e:
+            return web.json_response({'error': str(e)}, status=400)
+        return web.json_response({'ok': True})
+
+    async def tokens_issue(request: web.Request) -> web.Response:
+        denied = _admin_only(request)
+        if denied:
+            return denied
+        body = await request.json()
+        try:
+            out = await asyncio.get_event_loop().run_in_executor(
+                None, tokens_lib.issue, body['user'],
+                body.get('role', 'user'))
+        except (KeyError, ValueError) as e:
+            return web.json_response({'error': str(e)}, status=400)
+        return web.json_response(out)
+
+    async def tokens_ls(request: web.Request) -> web.Response:
+        denied = _admin_only(request)
+        if denied:
+            return denied
+        loop = asyncio.get_event_loop()
+        return web.json_response(
+            {'tokens': await loop.run_in_executor(None, tokens_lib.ls)})
+
+    async def tokens_revoke(request: web.Request) -> web.Response:
+        denied = _admin_only(request)
+        if denied:
+            return denied
+        body = await request.json()
+        ok = await asyncio.get_event_loop().run_in_executor(
+            None, tokens_lib.revoke, body.get('token_id', ''))
+        return web.json_response({'revoked': ok})
 
     app.router.add_get('/users', users_ls)
+    app.router.add_post('/users/role', users_set_role)
+    app.router.add_post('/users/tokens', tokens_issue)
+    app.router.add_get('/users/tokens', tokens_ls)
+    app.router.add_post('/users/tokens/revoke', tokens_revoke)
     return app
 
 
 @web.middleware
 async def auth_middleware(request: web.Request, handler):
-    """Static-token auth (reference analog: service-account tokens,
-    sky/server/auth/). Enabled when `api_server.auth_token` is set in
-    config or SKYPILOT_API_TOKEN in the server's env; /api/health stays
-    open for probes."""
+    """Identity + auth (reference: sky/server/auth/, sky/users/).
+
+    Three postures, decided per request:
+      - per-user service tokens exist → every request (except
+        /api/health) must present one; identity/role come from the
+        token, *not* the spoofable X-Skypilot-User header;
+      - only a static bootstrap token is configured
+        (SKYPILOT_API_TOKEN / api_server.auth_token) → it must be
+        presented; the bearer is treated as admin and identity falls
+        back to the header;
+      - neither → open local mode: header identity, admin role.
+
+    All sqlite work runs off the event loop (ADVICE r1: the per-request
+    user upsert was a synchronous write inside async middleware).
+    """
     import os as _os
     from skypilot_tpu import sky_config
-    token = _os.environ.get('SKYPILOT_API_TOKEN') or sky_config.get_nested(
-        ('api_server', 'auth_token'))
-    if token and request.path != '/api/health':
-        supplied = request.headers.get('Authorization', '')
-        if supplied != f'Bearer {token}':
-            return web.json_response({'error': 'unauthorized'}, status=401)
-    user = request.headers.get('X-Skypilot-User')
-    if user:
+    from skypilot_tpu.users import core as users_core
+    from skypilot_tpu.users import tokens as tokens_lib
+
+    loop = asyncio.get_event_loop()
+    supplied = request.headers.get('Authorization', '')
+    bearer = supplied[7:] if supplied.startswith('Bearer ') else ''
+    static_token = (_os.environ.get('SKYPILOT_API_TOKEN') or
+                    sky_config.get_nested(('api_server', 'auth_token')))
+
+    user = request.headers.get('X-Skypilot-User') or 'unknown'
+    role = 'admin'
+    if request.path != '/api/health':
+        tokens_on = await loop.run_in_executor(None,
+                                               tokens_lib.auth_required)
+        if tokens_on:
+            if static_token and bearer == static_token:
+                pass  # bootstrap admin keeps header identity
+            else:
+                ident = await loop.run_in_executor(
+                    None, tokens_lib.authenticate, bearer)
+                if ident is None:
+                    return web.json_response({'error': 'unauthorized'},
+                                             status=401)
+                user, role = ident['user'], ident['role']
+        elif static_token:
+            if bearer != static_token:
+                return web.json_response({'error': 'unauthorized'},
+                                         status=401)
+    request['sky_user'] = user
+    request['sky_role'] = role
+    if user and user != 'unknown':
         try:
-            from skypilot_tpu.users import core as users_core
-            users_core.record_request(user)
+            await loop.run_in_executor(None, users_core.record_request, user)
         except Exception:  # pylint: disable=broad-except
             pass  # registry is best-effort
     return await handler(request)

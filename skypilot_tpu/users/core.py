@@ -36,6 +36,25 @@ def record_request(user_name: str) -> None:
         (user_name, user_name, int(now), now))
 
 
+def ensure_user(user_name: str, role: str = 'user') -> None:
+    """Create the user if absent; update role if it already exists."""
+    if role not in ('admin', 'user'):
+        raise ValueError(f'Unknown role {role!r} (admin|user).')
+    db = _db()
+    now = time.time()
+    db.execute(
+        'INSERT INTO users (user_hash, name, created_at, role) '
+        'VALUES (?,?,?,?) '
+        'ON CONFLICT(user_hash) DO UPDATE SET role=excluded.role',
+        (user_name, user_name, int(now), role))
+
+
+def get_role(user_name: str) -> str:
+    row = _db().query_one('SELECT role FROM users WHERE user_hash=?',
+                          (user_name,))
+    return (row or {}).get('role') or 'user'
+
+
 def ls() -> List[Dict[str, Any]]:
     return _db().query(
         'SELECT name, role, created_at, last_seen, request_count '
@@ -43,7 +62,14 @@ def ls() -> List[Dict[str, Any]]:
 
 
 def set_role(user_name: str, role: str) -> None:
+    """Update an existing user's role; unknown users are an error (a
+    typo must not mint a phantom identity)."""
     if role not in ('admin', 'user'):
         raise ValueError(f'Unknown role {role!r} (admin|user).')
-    _db().execute('UPDATE users SET role=? WHERE user_hash=?',
-                  (role, user_name))
+    db = _db()
+    row = db.query_one('SELECT user_hash FROM users WHERE user_hash=?',
+                       (user_name,))
+    if row is None:
+        raise KeyError(f'Unknown user {user_name!r}.')
+    db.execute('UPDATE users SET role=? WHERE user_hash=?',
+               (role, user_name))

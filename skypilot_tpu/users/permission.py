@@ -1,0 +1,130 @@
+"""RBAC policy: what a request may do, checked before scheduling.
+
+Reference: sky/users/permission.py (casbin model) — roles `admin` and
+`user`. Here the policy is code, not a casbin DSL:
+
+  - admin: everything.
+  - user: reads, creating own resources, and mutating resources they
+    own; mutating someone else's cluster/request → PermissionError.
+
+Ownership comes from the clusters table (`owner`, recorded from the
+server-derived request identity at launch) and the requests table
+(`user`).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import global_state
+
+
+class PermissionDeniedError(Exception):
+    """403 at the HTTP boundary."""
+
+
+# Endpoint name -> payload key naming the target cluster.
+_CLUSTER_MUTATIONS = {
+    'launch': 'cluster_name',
+    'exec': 'cluster_name',
+    'start': 'cluster_name',
+    'stop': 'cluster_name',
+    'down': 'cluster_name',
+    'autostop': 'cluster_name',
+    'cancel': 'cluster_name',
+}
+
+
+# Serve mutations keyed by service name; jobs by job id list; pools by
+# pool name — all owner-or-admin.
+_SERVICE_MUTATIONS = {'serve.update': 'service_name',
+                      'serve.down': 'service_name'}
+_POOL_MUTATIONS = {'jobs.pool_down': 'pool_name',
+                   'jobs.pool_apply': 'pool_name'}
+
+
+def check_request(name: str, payload: Dict[str, Any], user: str,
+                  role: str) -> None:
+    """Raise PermissionDeniedError if (user, role) may not run `name`."""
+    if role == 'admin':
+        return
+    key = _CLUSTER_MUTATIONS.get(name)
+    if key is not None:
+        cluster_name = payload.get(key)
+        if cluster_name:  # launch on a fresh auto-named cluster is fine
+            _check_cluster_owner(cluster_name, user)
+        return
+    key = _SERVICE_MUTATIONS.get(name)
+    if key is not None:
+        _check_service_owner(payload.get(key), user)
+        return
+    key = _POOL_MUTATIONS.get(name)
+    if key is not None:
+        _check_pool_owner(payload.get(key), user)
+        return
+    if name == 'jobs.cancel':
+        _check_managed_jobs_owner(payload, user)
+        return
+    # Reads and remaining non-owned ops are open to every user.
+
+
+def _check_cluster_owner(cluster_name: str, user: str) -> None:
+    record = global_state.get_cluster(cluster_name)
+    if record is None:
+        return  # creating a new cluster under this name
+    owner = record.get('owner')
+    if owner and owner != user:
+        raise PermissionDeniedError(
+            f'Cluster {cluster_name!r} belongs to {owner!r}; role `user` '
+            f'may only mutate their own clusters (ask an admin).')
+
+
+def _check_service_owner(service_name: Optional[str], user: str) -> None:
+    if not service_name:
+        return
+    from skypilot_tpu.serve import serve_state
+    record = serve_state.get_service(service_name)
+    if record is None:
+        return
+    owner = record.get('user')
+    if owner and owner not in ('unknown', user):
+        raise PermissionDeniedError(
+            f'Service {service_name!r} belongs to {owner!r}.')
+
+
+def _check_pool_owner(pool_name: Optional[str], user: str) -> None:
+    if not pool_name:
+        return
+    from skypilot_tpu.jobs import pools
+    record = pools.get(pool_name)
+    if record is None:
+        return  # creating a new pool
+    owner = record.get('user')
+    if owner and owner not in ('unknown', user):
+        raise PermissionDeniedError(
+            f'Pool {pool_name!r} belongs to {owner!r}.')
+
+
+def _check_managed_jobs_owner(payload: Dict[str, Any], user: str) -> None:
+    from skypilot_tpu.jobs import state as jobs_state
+    job_ids = payload.get('job_ids') or []
+    if payload.get('all_jobs'):
+        raise PermissionDeniedError(
+            'Cancelling ALL managed jobs requires the admin role.')
+    for job_id in job_ids:
+        record = jobs_state.get_job(int(job_id))
+        if record is None:
+            continue
+        owner = record.get('user')
+        if owner and owner not in ('unknown', user):
+            raise PermissionDeniedError(
+                f'Managed job {job_id} belongs to {owner!r}.')
+
+
+def check_request_cancel(record: Optional[Dict[str, Any]], user: str,
+                         role: str) -> None:
+    if role == 'admin' or record is None:
+        return
+    if record.get('user') and record['user'] != user:
+        raise PermissionDeniedError(
+            f'Request {record.get("request_id")} belongs to '
+            f'{record["user"]!r}.')

@@ -1,0 +1,136 @@
+"""Service-account tokens: server-derived identity for API requests.
+
+Reference: sky/server/auth/ + sky/client/service_account_auth.py —
+tokens minted by an admin, presented as `Authorization: Bearer`, and
+resolved server-side to a user identity + role. Round-1's identity was
+the client-chosen X-Skypilot-User header (spoofable — ADVICE r1);
+with tokens, identity comes from the secret the client *holds*, not a
+name it *claims*.
+
+Only SHA-256 hashes are stored; the cleartext token is shown once at
+issue time. Issuing the first token flips the server into
+auth-required mode (see server.auth_middleware).
+"""
+from __future__ import annotations
+
+import hashlib
+import secrets
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+_CREATE_SQL = """\
+CREATE TABLE IF NOT EXISTS service_tokens (
+    token_id TEXT PRIMARY KEY,
+    user_hash TEXT,
+    token_hash TEXT,
+    created_at REAL,
+    last_used_at REAL,
+    revoked INTEGER DEFAULT 0
+);
+"""
+
+
+_schema_ready: set = set()
+
+
+def _db():
+    from skypilot_tpu.users import core as users_core
+    db = users_core._db()  # pylint: disable=protected-access
+    # DDL only once per (process, db) — auth_middleware hits this on
+    # every request and must not take the sqlite write lock each time.
+    key = id(db)
+    if key not in _schema_ready:
+        with db.conn() as conn:
+            conn.executescript(_CREATE_SQL)
+        _schema_ready.add(key)
+    return db
+
+
+def _hash(token: str) -> str:
+    return hashlib.sha256(token.encode()).hexdigest()
+
+
+def issue(user_name: str, role: str = 'user') -> Dict[str, str]:
+    """Mint a token for `user_name` (creating the user if needed).
+
+    Returns {'token_id', 'token'} — the cleartext token appears only
+    here. `role` applies only when the user is being created: minting
+    a second token with the default role must never demote an existing
+    admin (use `users role` to change roles).
+    """
+    from skypilot_tpu.users import core as users_core
+    if role not in ('admin', 'user'):
+        raise ValueError(f'Unknown role {role!r} (admin|user).')
+    db = _db()
+    existing = db.query_one('SELECT user_hash FROM users WHERE user_hash=?',
+                            (user_name,))
+    if existing is None:
+        users_core.ensure_user(user_name, role)
+    token_id = uuid.uuid4().hex[:12]
+    token = f'sky-{token_id}-{secrets.token_urlsafe(24)}'
+    db.execute(
+        'INSERT INTO service_tokens (token_id, user_hash, token_hash, '
+        'created_at) VALUES (?,?,?,?)',
+        (token_id, user_name, _hash(token), time.time()))
+    global _auth_required_cache
+    _auth_required_cache = True
+    return {'token_id': token_id, 'token': token}
+
+
+def authenticate(token: str) -> Optional[Dict[str, Any]]:
+    """Resolve a presented token → {'user', 'role', 'token_id'} or None."""
+    if not token:
+        return None
+    row = _db().query_one(
+        'SELECT token_id, user_hash FROM service_tokens '
+        'WHERE token_hash=? AND revoked=0', (_hash(token),))
+    if row is None:
+        return None
+    db = _db()
+    db.execute('UPDATE service_tokens SET last_used_at=? WHERE token_id=?',
+               (time.time(), row['token_id']))
+    user = db.query_one('SELECT user_hash, role FROM users WHERE user_hash=?',
+                        (row['user_hash'],))
+    role = (user or {}).get('role') or 'user'
+    return {'user': row['user_hash'], 'role': role,
+            'token_id': row['token_id']}
+
+
+_auth_required_cache = False
+_auth_required_checked = False
+
+
+def auth_required() -> bool:
+    """True once ANY token has ever been issued.
+
+    Deliberately counts revoked tokens too: revoking the last leaked
+    token must lock the server down, not silently reopen it to
+    unauthenticated requests. The transition is one-way and issue()
+    (same process) flips the cache, so after the first check no DB
+    query runs on the request hot path in either mode.
+    """
+    global _auth_required_cache, _auth_required_checked
+    if _auth_required_cache or _auth_required_checked:
+        return _auth_required_cache
+    row = _db().query_one('SELECT COUNT(*) AS n FROM service_tokens', ())
+    _auth_required_cache = bool(row and row['n'])
+    _auth_required_checked = True
+    return _auth_required_cache
+
+
+def ls() -> List[Dict[str, Any]]:
+    return _db().query(
+        'SELECT token_id, user_hash, created_at, last_used_at, revoked '
+        'FROM service_tokens ORDER BY created_at DESC')
+
+
+def revoke(token_id: str) -> bool:
+    db = _db()
+    row = db.query_one('SELECT token_id FROM service_tokens WHERE token_id=?',
+                       (token_id,))
+    if row is None:
+        return False
+    db.execute('UPDATE service_tokens SET revoked=1 WHERE token_id=?',
+               (token_id,))
+    return True

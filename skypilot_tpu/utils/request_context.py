@@ -1,0 +1,21 @@
+"""Per-request identity context inside executor worker processes.
+
+Reference analog: sky/utils/context.py's request context. Each API
+request runs in its own forked worker (server/requests/executor.py),
+so a module global is a faithful per-request scope — no contextvars
+or async propagation needed.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+_request_user: Optional[str] = None
+
+
+def set_request_user(user: Optional[str]) -> None:
+    global _request_user
+    _request_user = user if user and user != 'unknown' else None
+
+
+def get_request_user() -> Optional[str]:
+    return _request_user

@@ -136,3 +136,57 @@ def test_request_cancel(api_server):
         sdk.get(sdk.down('srv2'))
     except exceptions.SkyError:
         pass
+
+
+@pytest.mark.slow
+def test_rbac_tokens_and_enforcement(api_server, monkeypatch):
+    """Service-token identity + role enforcement (reference:
+    sky/users/permission.py, sky/server/auth/). Issuing the first token
+    flips auth on; identity is derived from the token, not the header;
+    `user` role cannot mutate another user's cluster or admin routes."""
+    url = api_server
+
+    # Open mode: no tokens yet, anyone is admin — mint alice (admin)
+    # and bob (user).
+    alice = sdk.token_issue('alice', role='admin')
+    # First token exists -> unauthenticated requests are now rejected.
+    r = requests.get(f'{url}/users', timeout=10)
+    assert r.status_code == 401
+    with pytest.raises(exceptions.PermissionDeniedError):
+        sdk.token_ls()
+
+    monkeypatch.setenv('SKYPILOT_API_TOKEN', alice['token'])
+    bob = sdk.token_issue('bob', role='user')
+    assert {t['user_hash'] for t in sdk.token_ls()} == {'alice', 'bob'}
+
+    # Alice launches a cluster; identity must come from her token even
+    # though the spoofable header says otherwise.
+    monkeypatch.setenv('SKYPILOT_USER', 'mallory')
+    task = Task(run='true')
+    task.set_resources(skypilot_tpu.Resources(infra='local'))
+    sdk.get(sdk.launch(task, cluster_name='rbac-c'))
+    recs = sdk.get(sdk.status())
+    rec = next(r for r in recs if r['name'] == 'rbac-c')
+    assert rec['user'] == 'alice'
+
+    # Bob (role user) may not down alice's cluster: 403 at scheduling.
+    monkeypatch.setenv('SKYPILOT_API_TOKEN', bob['token'])
+    with pytest.raises(exceptions.PermissionDeniedError):
+        sdk.down('rbac-c')
+    # ...nor touch admin-only routes.
+    with pytest.raises(exceptions.PermissionDeniedError):
+        sdk.token_issue('eve', role='admin')
+    with pytest.raises(exceptions.PermissionDeniedError):
+        sdk.users_set_role('bob', 'admin')
+    # Bob can read and manage his own things.
+    assert any(r['name'] == 'rbac-c' for r in sdk.get(sdk.status()))
+    sdk.get(sdk.launch(Task(run='true'), cluster_name='rbac-bob'))
+
+    # Alice (admin) downs everything, then revokes bob's token.
+    monkeypatch.setenv('SKYPILOT_API_TOKEN', alice['token'])
+    sdk.get(sdk.down('rbac-bob'))
+    sdk.get(sdk.down('rbac-c'))
+    assert sdk.token_revoke(bob['token_id'])
+    monkeypatch.setenv('SKYPILOT_API_TOKEN', bob['token'])
+    with pytest.raises(exceptions.PermissionDeniedError):
+        sdk.token_ls()

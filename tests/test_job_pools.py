@@ -68,3 +68,20 @@ def test_pool_missing_rejected(pool_env):
     with pytest.raises(Exception, match='not found'):
         jobs_core.launch({'resources': {'infra': 'local'}, 'run': 'true'},
                          pool='nope')
+
+
+@pytest.mark.slow
+def test_pool_shrink_tears_down_surplus(pool_env):
+    """apply() with a smaller size must release the surplus workers
+    (ADVICE round 1: shrinking leaked clusters that kept billing)."""
+    from skypilot_tpu import global_state
+    template = {'name': 'w', 'resources': {'infra': 'local'}}
+    pools.apply('p2', template, num_workers=2)
+    assert global_state.get_cluster('pool-p2-w0') is not None
+    assert global_state.get_cluster('pool-p2-w1') is not None
+
+    pools.apply('p2', template, num_workers=1)
+    assert global_state.get_cluster('pool-p2-w0') is not None
+    assert global_state.get_cluster('pool-p2-w1') is None
+    assert pools.get('p2')['num_workers'] == 1
+    pools.down('p2')
