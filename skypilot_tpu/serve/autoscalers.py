@@ -38,12 +38,22 @@ class Autoscaler:
 
     @classmethod
     def make(cls, spec: 'spec_lib.SkyServiceSpec') -> 'Autoscaler':
+        # Spot-fallback fields imply the spot-aware scaler: a YAML with
+        # base_ondemand_fallback_replicas but the default autoscaler
+        # must not silently ignore its on-demand floor.
+        wants_spot_mix = bool(
+            getattr(spec, 'base_ondemand_fallback_replicas', 0) or
+            getattr(spec, 'dynamic_ondemand_fallback', False))
         if spec.autoscaling_enabled:
             chosen = AUTOSCALER_REGISTRY.get(
                 getattr(spec, 'autoscaler', 'request_rate'))
             if chosen is None:
                 chosen = RequestRateAutoscaler
+            if wants_spot_mix and chosen is RequestRateAutoscaler:
+                chosen = SpotRequestRateAutoscaler
             return chosen(spec)
+        if wants_spot_mix:
+            return SpotRequestRateAutoscaler(spec)
         return Autoscaler(spec)
 
     def collect_request_information(self, num_requests: int,
@@ -196,3 +206,41 @@ class QueueLengthAutoscaler(Autoscaler):
             return AutoscalerDecision(AutoscalerDecisionOperator.SCALE_DOWN,
                                       self.target_num_replicas)
         return AutoscalerDecision(AutoscalerDecisionOperator.NO_OP, total)
+
+
+@dataclasses.dataclass
+class ReplicaMix:
+    """How many replicas of each procurement class the controller
+    should be running right now."""
+    spot: int
+    ondemand: int
+
+
+@AUTOSCALER_REGISTRY.register(name='spot_request_rate')
+class SpotRequestRateAutoscaler(RequestRateAutoscaler):
+    """Request-rate scaling for spot serving with on-demand fallback.
+
+    Reference: sky/serve/autoscalers.py:933 — the target count is met
+    with spot replicas; `base_ondemand_fallback_replicas` are always
+    on-demand (steady floor while spot churns), and with
+    `dynamic_ondemand_fallback` any spot shortfall (preemptions, no
+    capacity) is temporarily back-filled with on-demand replicas that
+    retire as spot recovers.
+    """
+
+    def evaluate(self, num_ready: int, num_launching: int,
+                 now: Optional[float] = None) -> AutoscalerDecision:
+        # Fixed-count specs (no target_qps) still use the spot mix:
+        # fall back to the base fixed-target decision.
+        if self.spec.target_qps_per_replica is None:
+            return Autoscaler.evaluate(self, num_ready, num_launching)
+        return super().evaluate(num_ready, num_launching, now)
+
+    def desired_mix(self, num_ready_spot: int) -> ReplicaMix:
+        target = self.target_num_replicas
+        base_od = min(self.spec.base_ondemand_fallback_replicas, target)
+        spot_target = target - base_od
+        od_target = base_od
+        if self.spec.dynamic_ondemand_fallback:
+            od_target += max(0, spot_target - num_ready_spot)
+        return ReplicaMix(spot=spot_target, ondemand=od_target)

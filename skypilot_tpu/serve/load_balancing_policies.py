@@ -75,3 +75,33 @@ class LeastLoadPolicy(LoadBalancingPolicy):
         with self._lock:
             self._in_flight[replica] = max(
                 0, self._in_flight[replica] - 1)
+
+
+@LB_POLICY_REGISTRY.register(name='instance_aware')
+class InstanceAwareLeastLoadPolicy(LeastLoadPolicy):
+    """Least-load weighted by each replica's hardware capacity.
+
+    Reference: the instance-aware policy in sky/serve — heterogeneous
+    replica pools (e.g. a v5e-8 next to a v5e-4 during a rolling
+    resize) should not receive equal traffic. The controller sets a
+    capacity weight per endpoint (chips per replica); selection
+    minimizes in_flight / weight.
+    """
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._weights: Dict[str, float] = {}
+
+    def set_replica_weights(self, weights: Dict[str, float]) -> None:
+        with self._lock:
+            self._weights = {k: max(v, 1e-6) for k, v in weights.items()}
+
+    def select_replica(self) -> Optional[str]:
+        with self._lock:
+            if not self.ready_replicas:
+                return None
+            replica = min(
+                self.ready_replicas,
+                key=lambda r: self._in_flight[r] / self._weights.get(r, 1.0))
+            self._in_flight[replica] += 1
+            return replica

@@ -187,6 +187,31 @@ def remove_replica(service: str, replica_id: int) -> None:
                   (service, replica_id))
 
 
+def set_replica_meta(service: str, replica_id: int,
+                     meta: Dict[str, Any]) -> None:
+    """Persist controller-side replica metadata (procurement class,
+    spot location, LB weight) so a restarted controller rebuilds its
+    spot/on-demand accounting instead of double-launching."""
+    db = _db()
+    db.add_column_if_missing('replicas', 'meta', 'TEXT')
+    db.execute('UPDATE replicas SET meta=? WHERE service=? AND replica_id=?',
+               (json.dumps(meta), service, replica_id))
+
+
+def get_replica_meta(service: str) -> Dict[int, Dict[str, Any]]:
+    db = _db()
+    db.add_column_if_missing('replicas', 'meta', 'TEXT')
+    out: Dict[int, Dict[str, Any]] = {}
+    for row in db.query('SELECT replica_id, meta FROM replicas '
+                        'WHERE service=?', (service,)):
+        if row['meta']:
+            meta = json.loads(row['meta'])
+            if meta.get('location') is not None:
+                meta['location'] = tuple(meta['location'])
+            out[int(row['replica_id'])] = meta
+    return out
+
+
 def next_replica_id(service: str) -> int:
     row = _db().query_one(
         'SELECT MAX(replica_id) AS m FROM replicas WHERE service=?',

@@ -63,6 +63,57 @@ class ServeController:
         self._shutdown = threading.Event()
         self._launching: Dict[int, threading.Thread] = {}
         self._replica_ports: Dict[int, int] = {}
+        # Spot serving: per-replica procurement metadata and the
+        # preemption-history placer (reference: spot_placer.py:254,
+        # wired through replica_managers.py:610). Rebuilt from the
+        # serve DB so a controller restart keeps its spot/on-demand
+        # accounting instead of double-launching.
+        self._replica_meta: Dict[int, Dict] = {}
+        try:
+            live = {r['replica_id']
+                    for r in serve_state.get_replicas(service_name)
+                    if not r['status'].is_terminal()}
+            self._replica_meta = {
+                rid: m
+                for rid, m in serve_state.get_replica_meta(
+                    service_name).items() if rid in live}
+        except Exception:  # pylint: disable=broad-except
+            pass
+        self._spot_placer = None
+        self._spot_requested = self._task_wants_spot()
+
+    def _task_wants_spot(self) -> bool:
+        try:
+            task = task_lib.Task.from_yaml_config(dict(self.task_config))
+            return any(r.use_spot for r in task.resources)
+        except Exception:  # pylint: disable=broad-except
+            return False
+
+    def _placer(self):
+        """Lazily build the spot placer from the launchable candidates."""
+        if not self._spot_requested:
+            return None
+        if self._spot_placer is None:
+            from skypilot_tpu import optimizer as optimizer_lib
+            from skypilot_tpu.serve import spot_placer as placer_lib
+            task = task_lib.Task.from_yaml_config(dict(self.task_config))
+            locations = []
+            try:
+                cands = optimizer_lib.Optimizer._enumerate_candidates(  # pylint: disable=protected-access
+                    task, None)
+                for cand, _cost, _secs in cands:
+                    if not cand.use_spot or cand.cloud is None:
+                        continue
+                    loc = (cand.cloud.canonical_name(), cand.region or '',
+                           cand.zone)
+                    if loc not in locations:
+                        locations.append(loc)
+            except Exception:  # pylint: disable=broad-except
+                pass
+            if locations:
+                self._spot_placer = placer_lib.DynamicFallbackSpotPlacer(
+                    locations[:16])
+        return self._spot_placer
 
     # -- replica lifecycle ---------------------------------------------------
     def _replica_cluster(self, replica_id: int) -> str:
@@ -88,7 +139,26 @@ class ServeController:
             # Autoscaler target carries over; spec swap re-reads limits.
             self.autoscaler.spec = self.spec
 
-    def _launch_replica(self, replica_id: int, version: int) -> None:
+    def _spawn_launch(self, force_ondemand: bool) -> int:
+        """Allocate a replica id + record meta synchronously, then
+        launch in a thread (the synchronous meta insert keeps the
+        spot/on-demand accounting race-free within one reconcile)."""
+        rid = serve_state.next_replica_id(self.name)
+        self._replica_meta[rid] = {
+            'use_spot': self._spot_requested and not force_ondemand,
+            'location': None, 'weight': 1.0, 'counted_active': False}
+        thread = threading.Thread(target=self._launch_replica,
+                                  args=(rid, self.version, force_ondemand),
+                                  daemon=True)
+        serve_state.add_replica(self.name, rid,
+                                self._replica_cluster(rid), self.version)
+        serve_state.set_replica_meta(self.name, rid, self._replica_meta[rid])
+        self._launching[rid] = thread
+        thread.start()
+        return rid
+
+    def _launch_replica(self, replica_id: int, version: int,
+                        force_ondemand: bool = False) -> None:
         del version
         cluster = self._replica_cluster(replica_id)
         port = self.spec.port or _free_port()
@@ -96,6 +166,34 @@ class ServeController:
         task = task_lib.Task.from_yaml_config(dict(self.task_config))
         task.service = None
         task.update_envs({'SKYPILOT_SERVE_PORT': str(port)})
+
+        # Spot placement: steer toward locations without recent
+        # preemptions; when all candidates are hot (or the autoscaler
+        # asked for an on-demand replica), drop use_spot.
+        location = None
+        use_spot = self._spot_requested and not force_ondemand
+        placer = self._placer() if use_spot else None
+        if use_spot and placer is not None:
+            if placer.all_hot():
+                ux_utils.log(
+                    f'Replica {replica_id}: every spot location preempted '
+                    'recently; launching on-demand instead.')
+                use_spot = False
+            else:
+                location = placer.select()
+                cloud, region, zone = location
+                task.set_resources({
+                    r.copy(infra='/'.join(
+                        p for p in (cloud, region, zone or '') if p))
+                    for r in task.resources})
+        if self._spot_requested and not use_spot:
+            task.set_resources({r.copy(use_spot=False)
+                                for r in task.resources})
+        self._replica_meta[replica_id] = {
+            'use_spot': use_spot, 'location': location, 'weight': 1.0,
+            'counted_active': False}
+        serve_state.set_replica_meta(self.name, replica_id,
+                                     self._replica_meta[replica_id])
         try:
             _, handle = execution.launch(task, cluster_name=cluster,
                                          detach_run=True,
@@ -103,16 +201,29 @@ class ServeController:
             assert handle is not None
             head = handle.cluster_info.get_head_instance()
             endpoint = f'{head.get_feasible_ip()}:{port}'
+            meta = self._replica_meta[replica_id]
+            meta['weight'] = float(handle.num_hosts)
+            meta['endpoint'] = endpoint
+            serve_state.set_replica_meta(self.name, replica_id, meta)
             serve_state.set_replica_status(self.name, replica_id,
                                            serve_state.ReplicaStatus.STARTING,
                                            endpoint=endpoint)
         except Exception as e:  # pylint: disable=broad-except
             ux_utils.error(f'Replica {replica_id} launch failed: {e}')
+            if location is not None and placer is not None:
+                placer.handle_preemption(location)
+            # Drop the meta entry: a FAILED replica must not count
+            # toward the spot/on-demand mix accounting.
+            self._replica_meta.pop(replica_id, None)
             serve_state.set_replica_status(self.name, replica_id,
                                            serve_state.ReplicaStatus.FAILED)
 
     def _terminate_replica(self, replica_id: int, preempted: bool = False
                            ) -> None:
+        meta = self._replica_meta.pop(replica_id, None)
+        if meta and meta.get('location') and meta['counted_active'] and \
+                self._spot_placer is not None and not preempted:
+            self._spot_placer.handle_release(meta['location'])
         cluster = self._replica_cluster(replica_id)
         serve_state.set_replica_status(
             self.name, replica_id, serve_state.ReplicaStatus.SHUTTING_DOWN)
@@ -174,12 +285,22 @@ class ServeController:
             if cluster_record is None and rid not in self._launching:
                 # Preempted / externally killed: relaunch as new replica.
                 ux_utils.log(f'Replica {rid} lost (preemption); replacing.')
+                meta = self._replica_meta.pop(rid, None)
+                if meta and meta.get('location') and \
+                        self._spot_placer is not None:
+                    self._spot_placer.handle_preemption(meta['location'])
                 serve_state.set_replica_status(self.name, rid, S.PREEMPTED)
                 serve_state.remove_replica(self.name, rid)
                 continue
             if self._probe_replica(replica):
                 if status != S.READY:
                     serve_state.set_replica_status(self.name, rid, S.READY)
+                    meta = self._replica_meta.get(rid)
+                    if meta and meta.get('location') and \
+                            not meta['counted_active'] and \
+                            self._spot_placer is not None:
+                        self._spot_placer.handle_active(meta['location'])
+                        meta['counted_active'] = True
                 ready.append(replica)
             else:
                 age = time.time() - (replica.get('launched_at') or 0)
@@ -217,30 +338,75 @@ class ServeController:
                 autoscalers.AutoscalerDecisionOperator.SCALE_UP:
             want = (decision.target_num_replicas - len(ready_new) -
                     launching_new)
+            # Spot/on-demand mix: launch on-demand replicas first until
+            # the fallback floor (+ dynamic back-fill) is met, spot for
+            # the rest (reference: autoscalers.py:933).
+            od_deficit = 0
+            if isinstance(self.autoscaler,
+                          autoscalers.SpotRequestRateAutoscaler):
+                active_od = sum(
+                    1 for m in self._replica_meta.values()
+                    if not m['use_spot'])
+                active_spot = sum(
+                    1 for m in self._replica_meta.values() if m['use_spot'])
+                mix = self.autoscaler.desired_mix(active_spot)
+                od_deficit = max(0, mix.ondemand - active_od)
             for _ in range(max(0, want)):
-                rid = serve_state.next_replica_id(self.name)
-                thread = threading.Thread(target=self._launch_replica,
-                                          args=(rid, self.version),
-                                          daemon=True)
-                serve_state.add_replica(self.name, rid,
-                                        self._replica_cluster(rid),
-                                        self.version)
-                self._launching[rid] = thread
-                thread.start()
+                force_od = od_deficit > 0
+                od_deficit -= 1
+                self._spawn_launch(force_ondemand=force_od)
         elif decision.operator == \
                 autoscalers.AutoscalerDecisionOperator.SCALE_DOWN:
             excess = (len(ready_new) + launching_new -
                       decision.target_num_replicas)
+
+            # Dynamic on-demand back-fills retire first once spot has
+            # recovered (reference: autoscalers.py:933) — but only up to
+            # the actual surplus, never the configured on-demand floor.
+            surplus_od_ids: set = set()
+            if isinstance(self.autoscaler,
+                          autoscalers.SpotRequestRateAutoscaler):
+                od_replicas = [
+                    rid for rid, m in self._replica_meta.items()
+                    if not m['use_spot']]
+                active_spot = sum(1 for m in self._replica_meta.values()
+                                  if m['use_spot'])
+                od_surplus = max(0, len(od_replicas) -
+                                 self.autoscaler.desired_mix(
+                                     active_spot).ondemand)
+                # Newest back-fills go first.
+                surplus_od_ids = set(
+                    sorted(od_replicas, reverse=True)[:od_surplus])
+
             victims = sorted(
                 (r for r in replicas
                  if r['version'] == self.version and
                  not r['status'].is_terminal() and
                  r['status'] != S.SHUTTING_DOWN),
-                key=lambda r: (r['status'] == S.READY, -r['replica_id']))
+                key=lambda r: (r['replica_id'] not in surplus_od_ids,
+                               r['status'] == S.READY, -r['replica_id']))
             for replica in victims[:max(0, excess)]:
                 threading.Thread(target=self._terminate_replica,
                                  args=(replica['replica_id'],),
                                  daemon=True).start()
+
+        # Spot recovery: while dynamic on-demand back-fills serve in
+        # place of preempted spot capacity, keep probing for spot.
+        # Recovery replicas launch *over* the target; once READY the
+        # scale-down path retires the on-demand surplus first — the
+        # reference's "back-fills retire as spot recovers" behavior
+        # (autoscalers.py:933).
+        if isinstance(self.autoscaler,
+                      autoscalers.SpotRequestRateAutoscaler) and \
+                self.spec.dynamic_ondemand_fallback and self._spot_requested:
+            active_spot = sum(1 for m in self._replica_meta.values()
+                              if m['use_spot'])
+            spot_deficit = self.autoscaler.desired_mix(
+                active_spot).spot - active_spot
+            placer = self._placer()
+            if spot_deficit > 0 and (placer is None or not placer.all_hot()):
+                for _ in range(spot_deficit):
+                    self._spawn_launch(force_ondemand=False)
 
         # Cull old-version replicas as new ones come up (1:1, keeping
         # capacity: never drop below target while rolling).
@@ -256,6 +422,11 @@ class ServeController:
         # Update LB + service status.
         self.policy.set_ready_replicas(
             [r['endpoint'] for r in ready if r.get('endpoint')])
+        if hasattr(self.policy, 'set_replica_weights'):
+            self.policy.set_replica_weights({
+                m['endpoint']: m.get('weight', 1.0)
+                for m in self._replica_meta.values()
+                if m.get('endpoint')})
         service = serve_state.get_service(self.name)
         if service and not service['status'].is_terminal():
             new_status = (serve_state.ServiceStatus.READY if ready

@@ -24,8 +24,15 @@ class SkyServiceSpec:
                  downscale_delay_seconds: int = 120,
                  port: Optional[int] = None,
                  load_balancing_policy: str = 'round_robin',
-                 autoscaler: str = 'request_rate') -> None:
+                 autoscaler: str = 'request_rate',
+                 base_ondemand_fallback_replicas: int = 0,
+                 dynamic_ondemand_fallback: bool = False) -> None:
         self.autoscaler = autoscaler
+        # Spot serving (reference: autoscalers.py:933 fallback logic):
+        # keep N always-on-demand replicas, and optionally back-fill
+        # preempted spot capacity with on-demand until spot recovers.
+        self.base_ondemand_fallback_replicas = base_ondemand_fallback_replicas
+        self.dynamic_ondemand_fallback = dynamic_ondemand_fallback
         if not readiness_path.startswith('/'):
             raise exceptions.InvalidTaskYAMLError(
                 f'readiness path must start with /: {readiness_path!r}')
@@ -87,9 +94,13 @@ class SkyServiceSpec:
             if 'target_qps_per_replica' in policy:
                 kwargs['target_qps_per_replica'] = float(
                     policy.pop('target_qps_per_replica'))
-            for key in ('upscale_delay_seconds', 'downscale_delay_seconds'):
+            for key in ('upscale_delay_seconds', 'downscale_delay_seconds',
+                        'base_ondemand_fallback_replicas'):
                 if key in policy:
                     kwargs[key] = int(policy.pop(key))
+            if 'dynamic_ondemand_fallback' in policy:
+                kwargs['dynamic_ondemand_fallback'] = bool(
+                    policy.pop('dynamic_ondemand_fallback'))
             if policy:
                 raise exceptions.InvalidTaskYAMLError(
                     f'Unknown replica_policy fields: {sorted(policy)}')
@@ -132,4 +143,9 @@ class SkyServiceSpec:
             out['load_balancing_policy'] = self.load_balancing_policy
         if self.autoscaler != 'request_rate':
             out['autoscaler'] = self.autoscaler
+        if self.base_ondemand_fallback_replicas:
+            out['replica_policy']['base_ondemand_fallback_replicas'] = \
+                self.base_ondemand_fallback_replicas
+        if self.dynamic_ondemand_fallback:
+            out['replica_policy']['dynamic_ondemand_fallback'] = True
         return out

@@ -31,6 +31,9 @@ class SpotPlacer:
     def handle_active(self, location: Location) -> None:
         pass
 
+    def handle_release(self, location: Location) -> None:
+        pass
+
 
 class DynamicFallbackSpotPlacer(SpotPlacer):
     """Prefer locations with no recent preemptions; round-robin among
@@ -69,3 +72,8 @@ class DynamicFallbackSpotPlacer(SpotPlacer):
 
     def handle_active(self, location: Location) -> None:
         self._active_counts[location] += 1
+
+    def handle_release(self, location: Location) -> None:
+        """Voluntary scale-down: free the slot, no preemption mark."""
+        self._active_counts[location] = max(
+            0, self._active_counts[location] - 1)

@@ -244,3 +244,58 @@ def test_serve_rolling_update(serve_env):
               if r['status'] not in ('SHUTDOWN', 'FAILED')]
     assert len(active) == 2, rows['replicas']
     serve_core.down('svc2')
+
+
+def test_spot_placer_steers_replica_launch(isolated_state, monkeypatch):
+    """Preemption history shifts where the next spot replica lands, and
+    all-hot falls back to on-demand (reference: spot_placer.py:254
+    wired via replica_managers.py:610)."""
+    from skypilot_tpu.serve import service as service_mod
+    from skypilot_tpu.serve import spot_placer as placer_lib
+
+    task_config = {
+        'name': 'sp', 'run': 'true',
+        'resources': {'cloud': 'gcp', 'accelerators': 'tpu-v5e-8',
+                      'use_spot': True},
+    }
+    spec = SkyServiceSpec(min_replicas=1, max_replicas=2).to_yaml_config()
+    serve_state.add_service('sp', task_config, spec, user='t')
+
+    controller = service_mod.ServeController('sp')
+    assert controller._spot_requested
+
+    locs = [('gcp', 'us-central1', 'us-central1-a'),
+            ('gcp', 'us-east5', 'us-east5-b')]
+    placer = placer_lib.DynamicFallbackSpotPlacer(locs)
+    controller._spot_placer = placer
+
+    launched = []
+
+    def fake_launch(task, cluster_name=None, **kw):
+        launched.append({r for r in task.resources})
+        raise RuntimeError('stop after recording')  # no real provisioning
+
+    monkeypatch.setattr(service_mod.execution, 'launch', fake_launch)
+
+    # First replica goes to some location; mark it preempted.
+    serve_state.add_replica('sp', 1, 'sp-rep1', version=1)
+    controller._launch_replica(1, 1)
+    (res1,) = launched[-1]
+    first_zone = res1.zone
+    assert res1.use_spot and first_zone is not None
+    placer.handle_preemption(
+        next(l for l in locs if l[2] == first_zone))
+
+    # Next replica avoids the preempted zone.
+    serve_state.add_replica('sp', 2, 'sp-rep2', version=1)
+    controller._launch_replica(2, 1)
+    (res2,) = launched[-1]
+    assert res2.use_spot and res2.zone != first_zone
+
+    # Every candidate hot -> on-demand fallback.
+    for loc in locs:
+        placer.handle_preemption(loc)
+    serve_state.add_replica('sp', 3, 'sp-rep3', version=1)
+    controller._launch_replica(3, 1)
+    (res3,) = launched[-1]
+    assert not res3.use_spot

@@ -167,3 +167,63 @@ def test_user_registry(isolated_state):
     import pytest as _pytest
     with _pytest.raises(ValueError):
         users_core.set_role('bob', 'root')
+
+
+def test_spot_autoscaler_mix_and_fallback():
+    """SpotRequestRateAutoscaler splits the target into spot + on-demand
+    (base floor + dynamic back-fill; reference autoscalers.py:933)."""
+    from skypilot_tpu.serve import autoscalers
+    from skypilot_tpu.serve import service_spec as spec_lib
+
+    spec = spec_lib.SkyServiceSpec(
+        min_replicas=4, max_replicas=4,
+        base_ondemand_fallback_replicas=1,
+        dynamic_ondemand_fallback=True,
+        autoscaler='spot_request_rate')
+    scaler = autoscalers.SpotRequestRateAutoscaler(spec)
+    scaler.target_num_replicas = 4
+
+    # Healthy: 3 spot up -> 3 spot + 1 base on-demand.
+    mix = scaler.desired_mix(num_ready_spot=3)
+    assert (mix.spot, mix.ondemand) == (3, 1)
+    # Two spot replicas preempted -> back-fill with on-demand.
+    mix = scaler.desired_mix(num_ready_spot=1)
+    assert (mix.spot, mix.ondemand) == (3, 3)
+    # Spot fully recovered -> back-fills retire, floor remains.
+    mix = scaler.desired_mix(num_ready_spot=3)
+    assert mix.ondemand == 1
+
+    # Without dynamic fallback: floor only, no back-fill.
+    spec2 = spec_lib.SkyServiceSpec(
+        min_replicas=4, max_replicas=4,
+        base_ondemand_fallback_replicas=2)
+    scaler2 = autoscalers.SpotRequestRateAutoscaler(spec2)
+    scaler2.target_num_replicas = 4
+    mix = scaler2.desired_mix(num_ready_spot=0)
+    assert (mix.spot, mix.ondemand) == (2, 2)
+
+
+def test_instance_aware_lb_weights():
+    """instance_aware LB sends traffic proportional to capacity."""
+    from skypilot_tpu.serve.load_balancing_policies import (
+        InstanceAwareLeastLoadPolicy)
+    lb = InstanceAwareLeastLoadPolicy()
+    lb.set_ready_replicas(['big:80', 'small:80'])
+    lb.set_replica_weights({'big:80': 4.0, 'small:80': 1.0})
+    picks = [lb.select_replica() for _ in range(10)]  # no completions
+    # With 4x the capacity, 'big' should absorb ~4x the in-flight load.
+    assert picks.count('big:80') == 8 and picks.count('small:80') == 2
+
+
+def test_spot_placer_full_cycle_release():
+    """handle_release frees capacity without marking preemption."""
+    from skypilot_tpu.serve.spot_placer import DynamicFallbackSpotPlacer
+    locs = [('gcp', 'us-central1', 'a'), ('gcp', 'us-central1', 'b')]
+    placer = DynamicFallbackSpotPlacer(locs)
+    first = placer.select()
+    placer.handle_active(first)
+    # Next selection balances onto the other location.
+    second = placer.select()
+    assert second != first
+    placer.handle_release(first)
+    assert not placer.all_hot()
