@@ -6,9 +6,9 @@ API (the gang, SURVEY §2.4); multi-slice tasks create N nodes named
 `<cluster>-<i>`. QueuedResources is used for spot and pod slices
 (capacity-queued creation), plain nodes otherwise.
 
-CPU/GPU VM support on GCP (GCE path) is routed to the TPU-host
-fallback for now: TPU slices are the native target; GCE VMs land in a
-later round.
+CPU/GPU hosts on GCP are served by the GCE VM path (`gce_api.py`):
+requests without a TPU accelerator route to instances.insert-based
+provisioning, sharing this module's wait/query/terminate plumbing.
 """
 from __future__ import annotations
 
