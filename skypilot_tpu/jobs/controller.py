@@ -43,13 +43,15 @@ class JobCancelled(Exception):
 
 class JobController:
 
-    def __init__(self, job_id: int) -> None:
+    def __init__(self, job_id: int, adopt: bool = False) -> None:
         self.job_id = job_id
         record = state.get_job(job_id)
         assert record is not None, job_id
         self.record = record
+        self.adopt = adopt
         self.cluster_name = record['cluster_name']
         self.pooled = bool(record.get('pool'))
+        self.group = record.get('job_group')
         self.task = task_lib.Task.from_yaml_config(record['task_config'])
         self.executor = recovery_strategy.StrategyExecutor.make(
             self.cluster_name, self.task)
@@ -63,8 +65,11 @@ class JobController:
     def run(self) -> state.ManagedJobStatus:
         job_id = self.job_id
         try:
-            state.set_status(job_id, state.ManagedJobStatus.STARTING)
-            agent_job_id = self._launch(first=True)
+            if self.adopt:
+                agent_job_id = self._adopt()
+            else:
+                state.set_status(job_id, state.ManagedJobStatus.STARTING)
+                agent_job_id = self._launch(first=True)
             final = self._monitor_loop(agent_job_id)
         except JobCancelled:
             self._cleanup(cancel_job=True)
@@ -84,14 +89,78 @@ class JobController:
         return final
 
     # ------------------------------------------------------------------
+    def _adopt(self) -> int:
+        """Resume watching a job whose previous controller died.
+
+        HA contract (reference: sky/jobs/managed_job_refresh_thread.py):
+        the DB carries the controller intent (cluster + agent job id);
+        if the cluster and on-cluster job are still alive we simply
+        re-enter the monitor loop — the user job never notices. If the
+        job was mid-cancel, finish the cancel. Otherwise fall through
+        to recovery (relaunch), which the checkpoint contract makes
+        safe.
+        """
+        job_id = self.job_id
+        record = state.get_job(job_id)
+        assert record is not None
+        agent_job_id = record.get('agent_job_id') or -1
+        if record['status'] == state.ManagedJobStatus.CANCELLING:
+            ux_utils.log(f'Adopted job {job_id} mid-cancel; finishing.')
+            raise JobCancelled()
+        ux_utils.log(f'Adopting managed job {job_id} '
+                     f'(cluster {self.cluster_name}, '
+                     f'agent job {agent_job_id}).')
+        agent = self._agent()
+        if agent is not None and agent_job_id > 0:
+            try:
+                job = agent.get_job(agent_job_id)
+            except Exception:  # pylint: disable=broad-except
+                job = None
+            if job is not None:
+                # Only *consecutive* failed adoptions count: a clean
+                # re-attach resets the give-up counter.
+                state.reset_adopt_attempts(job_id)
+                return agent_job_id  # cluster + job alive: just watch
+        # Cluster or job gone while unwatched → normal recovery path.
+        agent_job_id = self._recover()
+        state.reset_adopt_attempts(job_id)
+        return agent_job_id
+
     def _launch(self, first: bool) -> int:
         """(Re)launch cluster + submit the job; returns agent job id.
 
         The strategy executor's launch performs the full stage walk
         (for an existing cluster it skips provision but re-syncs and
         re-mounts checkpoint buckets) and submits the job once.
+        For job-group members the launch is two-phase: provision first,
+        publish this cluster's head address, wait for every peer, then
+        submit with the peer addresses injected
+        (reference: sky/jobs/job_group_networking.py:1-21).
         """
         del first
+        if self.group:
+            agent_job_id = self._launch_group_member()
+        else:
+            agent_job_id = self.executor.launch()
+        state.set_agent_job_id(self.job_id, agent_job_id)
+        return agent_job_id
+
+    def _launch_group_member(self) -> int:
+        from skypilot_tpu.jobs import groups
+        # Phase 1: provision + setup only (run=None boot task).
+        boot = task_lib.Task.from_yaml_config(self.record['task_config'])
+        boot.run = None
+        execution.launch(boot, cluster_name=self.cluster_name,
+                         detach_run=True, _quiet_optimizer=True,
+                         _is_launched_by_jobs_controller=True)
+        record = global_state.get_cluster(self.cluster_name)
+        assert record is not None
+        head = record['handle'].cluster_info.get_head_instance()
+        groups.publish_address(self.job_id, head.internal_ip)
+        # Phase 2: exchange addresses, then submit the real job.
+        addrs = groups.wait_peer_addresses(self.group, self.job_id)
+        self.task.update_envs({'SKYPILOT_JOBGROUP': self.group, **addrs})
+        self.executor.task = self.task
         return self.executor.launch()
 
     def _agent(self):
@@ -157,6 +226,15 @@ class JobController:
         state.bump_recovery(job_id)
         ux_utils.log(f'Managed job {job_id}: cluster lost; recovering.')
         agent_job_id = self.executor.recover()
+        state.set_agent_job_id(job_id, agent_job_id)
+        if self.group:
+            # Re-publish the (possibly new) head address for peers that
+            # re-resolve on reconnect.
+            record = global_state.get_cluster(self.cluster_name)
+            if record is not None:
+                from skypilot_tpu.jobs import groups
+                head = record['handle'].cluster_info.get_head_instance()
+                groups.publish_address(job_id, head.internal_ip)
         state.set_status(job_id, state.ManagedJobStatus.RUNNING)
         return agent_job_id
 
@@ -182,8 +260,10 @@ class JobController:
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument('--job-id', type=int, required=True)
+    parser.add_argument('--adopt', action='store_true',
+                        help='re-attach to a job whose controller died')
     args = parser.parse_args()
-    controller = JobController(args.job_id)
+    controller = JobController(args.job_id, adopt=args.adopt)
     final = controller.run()
     # Wake the scheduler for the next pending job.
     from skypilot_tpu.jobs import scheduler

@@ -109,3 +109,37 @@ def get_log_path(job_id: int) -> str:
 def is_terminal(job_id: int) -> bool:
     job = state.get_job(job_id)
     return job is None or job['status'].is_terminal()
+
+
+# -- job groups (reference: sky/jobs/job_group_networking.py) ---------------
+def group_launch(group_name: str, task_configs: List[Dict[str, Any]],
+                 user: Optional[str] = None,
+                 strategy: Optional[str] = None,
+                 max_restarts_on_errors: int = 0) -> Dict[str, Any]:
+    from skypilot_tpu.jobs import groups
+    from skypilot_tpu.utils import request_context
+    user = request_context.get_request_user() or user or 'unknown'
+    job_ids = groups.launch_group(group_name, task_configs, user,
+                                  strategy, max_restarts_on_errors)
+    return {'group': group_name, 'job_ids': job_ids}
+
+
+def group_status(group_name: str) -> List[Dict[str, Any]]:
+    from skypilot_tpu.jobs import groups
+    out = []
+    for j in groups.members(group_name):
+        out.append({
+            'job_id': j['job_id'],
+            'name': j['name'],
+            'status': j['status'].value,
+            'cluster_name': j['cluster_name'],
+            'head_ip': j.get('head_ip'),
+            'recovery_count': j['recovery_count'],
+            'last_error': j['last_error'],
+        })
+    return out
+
+
+def group_cancel(group_name: str) -> List[int]:
+    from skypilot_tpu.jobs import groups
+    return groups.cancel_group(group_name)

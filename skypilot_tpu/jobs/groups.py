@@ -1,0 +1,138 @@
+"""Job groups: co-scheduled managed jobs that can reach each other.
+
+Reference: sky/jobs/job_group_networking.py:1-21 + the job-group
+co-optimization hook (sky/optimizer.py:1796) — N tasks submitted as
+one unit (RL actor/learner pairs, disaggregated serving), scheduled
+all-or-nothing, each task's env carrying every peer's head address.
+
+Mechanics here: members share a `job_group` tag in the managed-jobs
+DB. The scheduler admits the whole group or none. Each member's
+controller provisions its cluster, publishes its head's internal IP
+to the DB, waits for all peers to publish, then injects
+
+    SKYPILOT_JOBGROUP=<group>
+    SKYPILOT_JOBGROUP_ADDR_<TASKNAME>=<ip>   (one per member)
+
+into the task env and submits the user job. On recovery the new
+address is re-published; peers observe it by re-resolving at
+reconnect time.
+"""
+from __future__ import annotations
+
+import re
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.jobs import state
+
+_PUBLISH_TIMEOUT_SECONDS = 900.0
+
+
+def _db():
+    # job_group/head_ip columns are migrated once in state._db().
+    return state._db()  # pylint: disable=protected-access
+
+
+def launch_group(group_name: str, task_configs: List[Dict[str, Any]],
+                 user: str, strategy: Optional[str] = None,
+                 max_restarts_on_errors: int = 0) -> List[int]:
+    """Atomically submit one managed job per task config.
+
+    Every task needs a unique `name` (it keys the peer-address env
+    var). Returns the managed-job ids, all PENDING until the scheduler
+    can admit the entire group.
+    """
+    if not task_configs:
+        raise exceptions.SkyError('Job group needs at least one task.')
+    names = [cfg.get('name') for cfg in task_configs]
+    if None in names or len(set(names)) != len(names):
+        raise exceptions.SkyError(
+            'Every task in a job group needs a unique name; got '
+            f'{names}.')
+    from skypilot_tpu.jobs import scheduler
+    if len(task_configs) > scheduler.MAX_STARTING_JOBS:
+        raise exceptions.SkyError(
+            f'Job group {group_name!r} has {len(task_configs)} tasks; '
+            f'all-or-nothing admission caps groups at '
+            f'{scheduler.MAX_STARTING_JOBS} (the concurrent-start limit).')
+    if _db().query_one(
+            'SELECT job_id FROM managed_jobs WHERE job_group=? AND status '
+            f'NOT IN ({",".join("?" * len(state._TERMINAL))})',  # pylint: disable=protected-access
+            (group_name, *(s.value for s in state._TERMINAL))):  # pylint: disable=protected-access
+        raise exceptions.SkyError(
+            f'Job group {group_name!r} already has active jobs.')
+    # Insert + tag under the scheduler lock: a concurrent scheduler pass
+    # must never observe a member as a plain group-less PENDING job (it
+    # would spawn it solo, skipping peer-address injection).
+    job_ids = []
+    with scheduler.scheduler_lock():
+        for cfg in task_configs:
+            job_id = state.submit_job(cfg.get('name'), cfg,
+                                      strategy or 'failover',
+                                      max_restarts_on_errors, user)
+            _db().execute(
+                'UPDATE managed_jobs SET job_group=? WHERE job_id=?',
+                (group_name, job_id))
+            job_ids.append(job_id)
+    scheduler.maybe_schedule_next_jobs()
+    return job_ids
+
+
+def members(group_name: str) -> List[Dict[str, Any]]:
+    rows = _db().query(
+        'SELECT * FROM managed_jobs WHERE job_group=? ORDER BY job_id',
+        (group_name,))
+    return [state._decode(r) for r in rows]  # pylint: disable=protected-access
+
+
+def publish_address(job_id: int, head_ip: str) -> None:
+    _db().execute('UPDATE managed_jobs SET head_ip=? WHERE job_id=?',
+                  (head_ip, job_id))
+
+
+def _env_var_for(task_name: str) -> str:
+    return ('SKYPILOT_JOBGROUP_ADDR_' +
+            re.sub(r'[^A-Za-z0-9]', '_', task_name).upper())
+
+
+def wait_peer_addresses(group_name: str, my_job_id: int,
+                        timeout: float = _PUBLISH_TIMEOUT_SECONDS
+                        ) -> Dict[str, str]:
+    """Block until every *live* member of the group published an
+    address; returns {env_var_name: ip} including our own entry.
+
+    A peer that already failed terminally (e.g. could not get
+    capacity) fails the whole group — that is the all-or-nothing
+    contract.
+    """
+    deadline = time.time() + timeout
+    while True:
+        rows = members(group_name)
+        failed = [r for r in rows
+                  if r['status'].is_terminal() and
+                  r['job_id'] != my_job_id]
+        if failed:
+            raise exceptions.SkyError(
+                f'Job group {group_name!r}: peer '
+                f'{failed[0]["name"]!r} already ended '
+                f'({failed[0]["status"].value}); aborting group.')
+        missing = [r for r in rows if not r.get('head_ip')]
+        if not missing:
+            return {_env_var_for(r['name']): r['head_ip'] for r in rows}
+        if time.time() > deadline:
+            raise exceptions.SkyError(
+                f'Job group {group_name!r}: peers '
+                f'{[r["name"] for r in missing]} did not publish an '
+                f'address within {timeout:.0f}s.')
+        time.sleep(2.0)
+
+
+def cancel_group(group_name: str) -> List[int]:
+    from skypilot_tpu.jobs import scheduler
+    cancelled = []
+    for r in members(group_name):
+        if not r['status'].is_terminal():
+            scheduler.cancel_job(r['job_id'])
+            cancelled.append(r['job_id'])
+    return cancelled

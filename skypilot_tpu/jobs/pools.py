@@ -32,13 +32,16 @@ CREATE TABLE IF NOT EXISTS job_pools (
 """
 
 
+_schema_ready: set = set()
+
+
 def _db():
     db = state._db()  # pylint: disable=protected-access
-    with db.conn() as conn:
-        conn.executescript(_CREATE_SQL)
-    db.add_column_if_missing('managed_jobs', 'pool', 'TEXT')
-    db.add_column_if_missing('managed_jobs', 'pool_worker', 'TEXT')
-    db.add_column_if_missing('job_pools', 'user', 'TEXT')
+    if id(db) not in _schema_ready:  # one-time per process
+        with db.conn() as conn:
+            conn.executescript(_CREATE_SQL)
+        db.add_column_if_missing('job_pools', 'user', 'TEXT')
+        _schema_ready.add(id(db))
     return db
 
 

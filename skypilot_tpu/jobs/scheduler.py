@@ -20,10 +20,22 @@ MAX_STARTING_JOBS = 4
 MAX_RUNNING_JOBS = 200
 
 
+_MAX_ADOPT_ATTEMPTS = 3
+
+
+def scheduler_lock() -> locks.FileLock:
+    return locks.FileLock(os.path.join(constants.sky_home(),
+                                       'jobs_scheduler.lock'))
+
+
 def maybe_schedule_next_jobs() -> None:
-    """Spawn controllers for PENDING jobs within limits."""
-    with locks.FileLock(os.path.join(constants.sky_home(),
-                                     'jobs_scheduler.lock')):
+    """Spawn controllers for PENDING jobs within limits.
+
+    Job groups are admitted all-or-nothing: either every PENDING
+    member of a group fits in the remaining start budget and they all
+    spawn together, or none do (reference: job-group co-scheduling,
+    sky/optimizer.py:1796)."""
+    with scheduler_lock():
         _reconcile_dead_controllers()
         starting = len(state.get_jobs(status=[
             state.ManagedJobStatus.SUBMITTED,
@@ -32,10 +44,26 @@ def maybe_schedule_next_jobs() -> None:
         running = len(state.get_jobs(status=[
             state.ManagedJobStatus.RUNNING]))
         pending = state.get_jobs(status=[state.ManagedJobStatus.PENDING])
+        skipped_groups = set()
         for job in pending:
-            if starting >= MAX_STARTING_JOBS or \
-                    starting + running >= MAX_RUNNING_JOBS:
+            budget = min(MAX_STARTING_JOBS - starting,
+                         MAX_RUNNING_JOBS - starting - running)
+            if budget <= 0:
                 break
+            group = job.get('job_group')
+            if group:
+                if group in skipped_groups:
+                    continue
+                members = [j for j in pending
+                           if j.get('job_group') == group]
+                if len(members) > budget:
+                    skipped_groups.add(group)
+                    continue  # group doesn't fit yet: all-or-nothing
+                for member in members:
+                    _spawn_controller(member)
+                    starting += 1
+                skipped_groups.add(group)  # spawned; don't revisit
+                continue
             if job.get('pool'):
                 from skypilot_tpu.jobs import pools as pools_lib
                 worker = pools_lib.assign_worker(job['pool'])
@@ -46,16 +74,20 @@ def maybe_schedule_next_jobs() -> None:
             starting += 1
 
 
-def _spawn_controller(job) -> None:
+def _spawn_controller(job, adopt: bool = False) -> None:
     job_id = job['job_id']
-    state.set_status(job_id, state.ManagedJobStatus.SUBMITTED)
+    if not adopt:
+        state.set_status(job_id, state.ManagedJobStatus.SUBMITTED)
     env = dict(os.environ)
     repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     env['PYTHONPATH'] = f'{repo_root}:{env.get("PYTHONPATH", "")}'
+    cmd = [sys.executable, '-m', 'skypilot_tpu.jobs.controller',
+           '--job-id', str(job_id)]
+    if adopt:
+        cmd.append('--adopt')
     pid = subprocess_utils.launch_daemon(
-        [sys.executable, '-m', 'skypilot_tpu.jobs.controller',
-         '--job-id', str(job_id)],
+        cmd,
         log_path=job['log_path'] or os.path.join(
             constants.sky_home(), f'managed-{job_id}.log'),
         env=env)
@@ -63,9 +95,11 @@ def _spawn_controller(job) -> None:
 
 
 def _reconcile_dead_controllers() -> None:
-    """Controller crash safety: dead controller + live status → failed.
+    """HA: a dead controller with a live job is re-adopted, not failed.
 
-    Reference: HA recovery (sky/jobs/ controller crash recovery).
+    A fresh controller re-attaches to the recorded (cluster, agent job)
+    and resumes monitoring; only after repeated adoption failures does
+    the job fail (reference: sky/jobs/managed_job_refresh_thread.py).
     """
     active = state.get_jobs(status=[
         state.ManagedJobStatus.SUBMITTED, state.ManagedJobStatus.STARTING,
@@ -74,9 +108,18 @@ def _reconcile_dead_controllers() -> None:
     for job in active:
         pid = job.get('controller_pid') or -1
         if pid > 0 and not subprocess_utils.process_alive(pid):
-            state.set_status(job['job_id'],
-                             state.ManagedJobStatus.FAILED_CONTROLLER,
-                             last_error='controller process died')
+            attempts = state.bump_adopt_attempts(job['job_id'])
+            if attempts > _MAX_ADOPT_ATTEMPTS:
+                state.set_status(
+                    job['job_id'], state.ManagedJobStatus.FAILED_CONTROLLER,
+                    last_error=f'controller died {attempts} times; '
+                               'giving up re-adoption')
+                continue
+            from skypilot_tpu.utils import ux_utils
+            ux_utils.log(f'Managed job {job["job_id"]}: controller '
+                         f'(pid {pid}) died; re-adopting '
+                         f'(attempt {attempts}/{_MAX_ADOPT_ATTEMPTS}).')
+            _spawn_controller(job, adopt=True)
 
 
 def cancel_job(job_id: int) -> bool:

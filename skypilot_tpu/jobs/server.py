@@ -70,4 +70,13 @@ def register(app: web.Application) -> None:
     app.router.add_post('/jobs/pool/down',
                         _schedule('jobs.pool_down', f'{_API}.pool_down',
                                   'long'))
+    app.router.add_post('/jobs/group/launch',
+                        _schedule('jobs.group_launch',
+                                  f'{_API}.group_launch', 'long'))
+    app.router.add_post('/jobs/group/status',
+                        _schedule('jobs.group_status',
+                                  f'{_API}.group_status'))
+    app.router.add_post('/jobs/group/cancel',
+                        _schedule('jobs.group_cancel',
+                                  f'{_API}.group_cancel', 'long'))
     app.router.add_get('/jobs/logs', jobs_logs)

@@ -77,16 +77,34 @@ def _db_for(path: str) -> db_utils.SQLiteDB:
     return db_utils.SQLiteDB(path, _CREATE_SQL)
 
 
+@functools.lru_cache(maxsize=None)
+def _migrated_db_for(path: str) -> db_utils.SQLiteDB:
+    """One-time-per-process schema migration (controllers poll state
+    every few seconds; PRAGMA scans must not run per query)."""
+    db = _db_for(path)
+    for column, decl in (
+            # HA columns (controller crash recovery):
+            ('agent_job_id', 'INTEGER DEFAULT -1'),
+            ('adopt_attempts', 'INTEGER DEFAULT 0'),
+            # Job groups:
+            ('job_group', 'TEXT'),
+            ('head_ip', 'TEXT'),
+            # Pools:
+            ('pool', 'TEXT'),
+            ('pool_worker', 'TEXT')):
+        db.add_column_if_missing('managed_jobs', column, decl)
+    return db
+
+
 def _db() -> db_utils.SQLiteDB:
-    return _db_for(os.path.join(constants.sky_home(), 'managed_jobs.db'))
+    return _migrated_db_for(os.path.join(constants.sky_home(),
+                                         'managed_jobs.db'))
 
 
 def submit_job(name: Optional[str], task_config: Dict[str, Any],
                strategy: str, max_restarts_on_errors: int,
                user: str, pool: Optional[str] = None) -> int:
     db = _db()
-    db.add_column_if_missing('managed_jobs', 'pool', 'TEXT')
-    db.add_column_if_missing('managed_jobs', 'pool_worker', 'TEXT')
     with db.conn() as conn:
         cur = conn.execute(
             'INSERT INTO managed_jobs (name, task_config, status, '
@@ -159,6 +177,32 @@ def set_status(job_id: int, status: ManagedJobStatus,
 def set_controller_pid(job_id: int, pid: int) -> None:
     _db().execute('UPDATE managed_jobs SET controller_pid=? WHERE job_id=?',
                   (pid, job_id))
+
+
+def set_agent_job_id(job_id: int, agent_job_id: int) -> None:
+    """Persist the controller's intent: which on-cluster job it watches.
+
+    This is what lets a respawned controller re-adopt a running job
+    after a crash instead of relaunching it (reference:
+    sky/jobs/managed_job_refresh_thread.py)."""
+    _db().execute('UPDATE managed_jobs SET agent_job_id=? WHERE job_id=?',
+                  (agent_job_id, job_id))
+
+
+def bump_adopt_attempts(job_id: int) -> int:
+    _db().execute('UPDATE managed_jobs SET adopt_attempts='
+                  'adopt_attempts+1 WHERE job_id=?', (job_id,))
+    row = _db().query_one('SELECT adopt_attempts FROM managed_jobs '
+                          'WHERE job_id=?', (job_id,))
+    return int(row['adopt_attempts']) if row else 0
+
+
+def reset_adopt_attempts(job_id: int) -> None:
+    """Called after a SUCCESSFUL re-adoption: only consecutive failed
+    adoptions count toward giving up, not controller deaths spread over
+    a long job's lifetime."""
+    _db().execute('UPDATE managed_jobs SET adopt_attempts=0 '
+                  'WHERE job_id=?', (job_id,))
 
 
 def bump_recovery(job_id: int) -> int:

@@ -144,3 +144,89 @@ def test_queue_and_cancel_pending(jobs_env, monkeypatch):
     assert jobs_core.cancel([job_id]) == [job_id]
     assert state.get_job(job_id)['status'] == \
         state.ManagedJobStatus.CANCELLED
+
+
+@pytest.mark.slow
+def test_controller_crash_readopts_running_job(jobs_env):
+    """HA: kill -9 the controller mid-job; the scheduler re-adopts the
+    running on-cluster job and it completes without relaunching
+    (reference: sky/jobs/managed_job_refresh_thread.py)."""
+    import signal
+    from skypilot_tpu.jobs import scheduler
+    from skypilot_tpu.utils import subprocess_utils
+
+    result = jobs_core.launch(_task_config('sleep 12; echo survived'),
+                              user='t')
+    job_id = result['job_id']
+    _wait_status(job_id, [state.ManagedJobStatus.RUNNING])
+    job = state.get_job(job_id)
+    # Wait until the controller has recorded its intent (agent job id).
+    deadline = time.time() + 30
+    while time.time() < deadline and \
+            (state.get_job(job_id).get('agent_job_id') or -1) <= 0:
+        time.sleep(0.5)
+    job = state.get_job(job_id)
+    assert (job.get('agent_job_id') or -1) > 0
+    pid = job['controller_pid']
+    assert pid > 0 and subprocess_utils.process_alive(pid)
+
+    os.kill(pid, signal.SIGKILL)  # hard crash, no cleanup
+    deadline = time.time() + 15
+    while time.time() < deadline and subprocess_utils.process_alive(pid):
+        time.sleep(0.2)
+
+    # The scheduler notices the dead controller and re-adopts.
+    scheduler.maybe_schedule_next_jobs()
+    job = state.get_job(job_id)
+    assert job['controller_pid'] != pid  # a new controller took over
+    final = _wait_status(job_id, [state.ManagedJobStatus.SUCCEEDED,
+                                  state.ManagedJobStatus.FAILED,
+                                  state.ManagedJobStatus.FAILED_CONTROLLER],
+                         timeout=120)
+    assert final == state.ManagedJobStatus.SUCCEEDED
+    # Adoption, not relaunch: no recovery was needed.
+    assert state.get_job(job_id)['recovery_count'] == 0
+
+
+@pytest.mark.slow
+def test_job_group_atomic_launch_and_peer_addresses(jobs_env):
+    """A 2-task group launches atomically and each task's env carries
+    the other's head address (reference:
+    sky/jobs/job_group_networking.py:1-21)."""
+    from skypilot_tpu.jobs import groups
+
+    def member(name):
+        return {'name': name, 'resources': {'infra': 'local'},
+                'run': ('echo '
+                        'actor=$SKYPILOT_JOBGROUP_ADDR_ACTOR '
+                        'learner=$SKYPILOT_JOBGROUP_ADDR_LEARNER '
+                        'group=$SKYPILOT_JOBGROUP '
+                        f'> /tmp/rl1-{name}.out')}
+
+    out = jobs_core.group_launch('rl1', [member('actor'),
+                                         member('learner')], user='t')
+    assert len(out['job_ids']) == 2
+    for job_id in out['job_ids']:
+        final = _wait_status(job_id,
+                             [state.ManagedJobStatus.SUCCEEDED,
+                              state.ManagedJobStatus.FAILED,
+                              state.ManagedJobStatus.FAILED_CONTROLLER],
+                             timeout=240)
+        assert final == state.ManagedJobStatus.SUCCEEDED, \
+            state.get_job(job_id)
+
+    # Both members published addresses; each task saw the peer's.
+    members = groups.members('rl1')
+    assert all(m['head_ip'] for m in members)
+    for name in ('actor', 'learner'):
+        with open(f'/tmp/rl1-{name}.out', 'r', encoding='utf-8') as f:
+            seen = f.read()
+        assert 'actor=127.0.0.1' in seen and 'learner=127.0.0.1' in seen, \
+            seen
+        os.remove(f'/tmp/rl1-{name}.out')
+    # Group status + duplicate-name rejection.
+    rows = jobs_core.group_status('rl1')
+    assert {r['name'] for r in rows} == {'actor', 'learner'}
+    with pytest.raises(Exception):
+        jobs_core.group_launch('rlx', [member('a'), member('a')],
+                               user='t')
