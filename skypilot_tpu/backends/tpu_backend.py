@@ -410,6 +410,70 @@ class TpuVmBackend(backend_lib.Backend[TpuVmResourceHandle]):
             from skypilot_tpu.data import storage as storage_lib
             storage_lib.mount_storage_on_hosts(store, dst, runners)
 
+    @timeline.event
+    def mount_volumes(self, handle: TpuVmResourceHandle,
+                      volumes: Optional[Dict[str, str]]) -> None:
+        """Attach + mount named volumes (reference: the provisioner
+        volume ops, sky/provision/__init__.py:235-310).
+
+        GCP: the PD attaches read-write to the head host and mounts at
+        the requested path (mkfs on first use). Local: the volume dir
+        is symlinked into every sandbox — the shared-disk emulation the
+        e2e tests exercise.
+        """
+        if not volumes:
+            return
+        from skypilot_tpu.volumes import core as volumes_core
+        provider = handle.provider_name
+        runners = handle.get_command_runners()
+        instances = handle.cluster_info.sorted_instances()
+        for mount_path, name in volumes.items():
+            record = volumes_core.get(name)
+            if record is None:
+                raise exceptions.SkyError(
+                    f'Volume {name!r} not found; create it with '
+                    f'`stpu volumes apply {name} --size <gb>` first.')
+            # Relative mount paths anchor at the job's working dir
+            # (where `run` commands execute); absolute/~ paths as-is.
+            if not mount_path.startswith(('/', '~')):
+                mount_path = f'{constants.SKY_REMOTE_WORKDIR}/{mount_path}'
+            if provider == 'local':
+                for runner in runners:
+                    parent = os.path.dirname(mount_path)
+                    pre = f'mkdir -p {parent} && ' if parent else ''
+                    device = provision_lib.attach_volume(
+                        provider, record, instances[0].instance_id)
+                    rc = runner.run(
+                        f'{pre}ln -sfn {device} {mount_path}',
+                        stream_logs=False)
+                    if rc != 0:
+                        raise exceptions.SkyError(
+                            f'Failed to link volume {name} at '
+                            f'{mount_path} (rc={rc}).')
+            else:
+                head_inst, head_runner = instances[0], runners[0]
+                device = provision_lib.attach_volume(
+                    provider, record, head_inst.instance_id)
+                cmd = (
+                    # attachDisk is async: wait for the device node.
+                    f'for i in $(seq 1 60); do '
+                    f'[ -e {device} ] && break; sleep 2; done; '
+                    f'[ -e {device} ] || {{ echo "device {device} never '
+                    f'appeared" >&2; exit 1; }}; '
+                    f'sudo blkid {device} >/dev/null 2>&1 || '
+                    f'sudo mkfs.ext4 -m 0 -F {device}; '
+                    f'sudo mkdir -p {mount_path} && '
+                    f'sudo mount -o discard,defaults {device} {mount_path} '
+                    f'&& sudo chmod 777 {mount_path}')
+                rc = head_runner.run(cmd, stream_logs=False)
+                if rc != 0:
+                    raise exceptions.SkyError(
+                        f'Failed to mount volume {name} ({device}) at '
+                        f'{mount_path} (rc={rc}).')
+            global_state.add_cluster_event(
+                handle.cluster_name, 'volume_mounted',
+                f'{name} -> {mount_path}')
+
     @staticmethod
     def _download_cloud_uri_on_hosts(runners, uri: str, dst: str) -> None:
         from skypilot_tpu.data import storage as storage_lib

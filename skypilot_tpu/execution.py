@@ -137,6 +137,8 @@ def launch(
         elif stage == Stage.SYNC_FILE_MOUNTS:
             if dryrun:
                 continue
+            if task.volumes:
+                backend.mount_volumes(handle, task.volumes)
             if task.file_mounts or task.storage_mounts:
                 backend.sync_file_mounts(handle, task.file_mounts,
                                          task.storage_mounts)

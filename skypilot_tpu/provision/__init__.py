@@ -45,6 +45,10 @@ def _make_router(fn_name: str):
 
 
 run_instances = _make_router('run_instances')
+# Volume ops (reference: sky/provision/__init__.py:235-310):
+apply_volume = _make_router('apply_volume')
+delete_volume = _make_router('delete_volume')
+attach_volume = _make_router('attach_volume')
 wait_instances = _make_router('wait_instances')
 stop_instances = _make_router('stop_instances')
 terminate_instances = _make_router('terminate_instances')

@@ -165,3 +165,48 @@ def external_ip(instance: Dict[str, Any]) -> Optional[str]:
 def internal_ip(instance: Dict[str, Any]) -> str:
     nics = instance.get('networkInterfaces', [])
     return nics[0].get('networkIP', '') if nics else ''
+
+
+# -- persistent disks (volume ops; reference: sky/provision/__init__.py
+# apply_volume/delete_volume routed to sky/provision/gcp) ------------------
+def create_disk(project: str, zone: str, name: str, size_gb: int,
+                disk_type: str = 'pd-balanced',
+                labels: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
+    body = {
+        'name': name,
+        'sizeGb': str(int(size_gb)),
+        'type': f'projects/{project}/zones/{zone}/diskTypes/{disk_type}',
+        'labels': dict(labels or {}),
+    }
+    return _request('POST', f'projects/{project}/zones/{zone}/disks',
+                    json_body=body)
+
+
+def get_disk(project: str, zone: str, name: str) -> Dict[str, Any]:
+    return _request('GET', f'projects/{project}/zones/{zone}/disks/{name}')
+
+
+def delete_disk(project: str, zone: str, name: str) -> Dict[str, Any]:
+    return _request('DELETE',
+                    f'projects/{project}/zones/{zone}/disks/{name}')
+
+
+def attach_disk(project: str, zone: str, instance: str, disk_name: str,
+                device_name: Optional[str] = None) -> Dict[str, Any]:
+    body = {
+        'source': f'projects/{project}/zones/{zone}/disks/{disk_name}',
+        'deviceName': device_name or disk_name,
+        'mode': 'READ_WRITE',
+    }
+    return _request(
+        'POST',
+        f'projects/{project}/zones/{zone}/instances/{instance}/attachDisk',
+        json_body=body)
+
+
+def detach_disk(project: str, zone: str, instance: str,
+                device_name: str) -> Dict[str, Any]:
+    return _request(
+        'POST',
+        f'projects/{project}/zones/{zone}/instances/{instance}/detachDisk',
+        params={'deviceName': device_name})

@@ -18,6 +18,7 @@ from typing import Any, Dict, List, Optional
 from skypilot_tpu import exceptions
 from skypilot_tpu import sky_config
 from skypilot_tpu.provision import common
+from skypilot_tpu.provision.gcp import gce_api
 from skypilot_tpu.provision.gcp import tpu_api
 
 
@@ -379,3 +380,64 @@ def _gce_cluster_info(project: str, zone: str, cluster_name_on_cloud: str,
         ssh_user='skypilot',
         ssh_private_key='~/.ssh/sky-key',
     )
+
+
+# -- volume ops (reference: sky/provision/__init__.py:235-310) --------------
+def apply_volume(config: Dict[str, Any]) -> Dict[str, Any]:
+    """Create (or adopt) a GCP persistent disk for a named volume."""
+    pc = dict(config)
+    project = _project(pc)
+    zone = pc.get('zone') or sky_config.get_nested(('gcp', 'zone'))
+    if not zone:
+        raise exceptions.ProvisionerError(
+            'GCP volumes need a zone (volume config or gcp.zone).')
+    name = pc['name']
+    try:
+        disk = gce_api.get_disk(project, zone, name)
+    except exceptions.FetchClusterInfoError:
+        gce_api.create_disk(project, zone, name,
+                            size_gb=int(pc.get('size_gb', 100)),
+                            disk_type=pc.get('type', 'pd-balanced'),
+                            labels={'skypilot-volume': name})
+        disk = _wait_disk_ready(project, zone, name)
+    return {'name': name, 'zone': zone, 'project': project,
+            'size_gb': int(disk.get('sizeGb', pc.get('size_gb', 0))),
+            'status': disk.get('status', 'READY')}
+
+
+def _wait_disk_ready(project: str, zone: str, name: str,
+                     timeout: float = 180.0) -> Dict[str, Any]:
+    """disks.insert is an async zonal operation: poll until READY
+    (tolerating the eventually-consistent 404 right after create)."""
+    deadline = time.time() + timeout
+    while True:
+        try:
+            disk = gce_api.get_disk(project, zone, name)
+            if disk.get('status') == 'READY':
+                return disk
+        except exceptions.FetchClusterInfoError:
+            pass
+        if time.time() > deadline:
+            raise exceptions.ProvisionerError(
+                f'Disk {name} in {zone} not READY after {timeout:.0f}s.')
+        time.sleep(2)
+
+
+def delete_volume(config: Dict[str, Any]) -> None:
+    pc = dict(config)
+    project = _project(pc)
+    zone = pc.get('zone') or sky_config.get_nested(('gcp', 'zone'))
+    try:
+        gce_api.delete_disk(project, zone, pc['name'])
+    except exceptions.FetchClusterInfoError:
+        pass  # already gone
+
+
+def attach_volume(config: Dict[str, Any], instance_id: str) -> str:
+    """Attach the volume's disk to a GCE instance; returns the device
+    path the mount command should use."""
+    pc = dict(config)
+    project = _project(pc)
+    zone = pc.get('zone') or sky_config.get_nested(('gcp', 'zone'))
+    gce_api.attach_disk(project, zone, instance_id, pc['name'])
+    return f'/dev/disk/by-id/google-{pc["name"]}'

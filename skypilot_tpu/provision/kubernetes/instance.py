@@ -316,3 +316,48 @@ def open_ports(cluster_name_on_cloud: str, ports: List[str],
 def cleanup_ports(cluster_name_on_cloud: str, ports: List[str],
                   provider_config: Optional[Dict[str, Any]] = None) -> None:
     pass
+
+
+# -- volume ops: PersistentVolumeClaims (reference:
+# sky/provision/kubernetes volume support) ----------------------------------
+def _pvc_manifest(name: str, size_gb: int,
+                  storage_class: Optional[str] = None) -> Dict[str, Any]:
+    spec: Dict[str, Any] = {
+        'accessModes': ['ReadWriteOnce'],
+        'resources': {'requests': {'storage': f'{int(size_gb)}Gi'}},
+    }
+    if storage_class:
+        spec['storageClassName'] = storage_class
+    return {
+        'apiVersion': 'v1',
+        'kind': 'PersistentVolumeClaim',
+        'metadata': {'name': name,
+                     'labels': {'skypilot-volume': name}},
+        'spec': spec,
+    }
+
+
+def apply_volume(config: Dict[str, Any]) -> Dict[str, Any]:
+    ctx = _ctx(config.get('provider_config'))
+    name = config['name']
+    path = f'/api/v1/namespaces/{ctx.namespace}/persistentvolumeclaims'
+    try:
+        pvc = _request(ctx, 'GET', f'{path}/{name}')
+    except exceptions.FetchClusterInfoError:
+        _request(ctx, 'POST', path,
+                 json_body=_pvc_manifest(name,
+                                         int(config.get('size_gb', 100)),
+                                         config.get('storage_class')))
+        pvc = _request(ctx, 'GET', f'{path}/{name}')
+    return {'name': name, 'namespace': ctx.namespace,
+            'status': pvc.get('status', {}).get('phase', 'Pending')}
+
+
+def delete_volume(config: Dict[str, Any]) -> None:
+    ctx = _ctx(config.get('provider_config'))
+    path = (f'/api/v1/namespaces/{ctx.namespace}/'
+            f'persistentvolumeclaims/{config["name"]}')
+    try:
+        _request(ctx, 'DELETE', path)
+    except exceptions.FetchClusterInfoError:
+        pass

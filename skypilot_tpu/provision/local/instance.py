@@ -240,3 +240,27 @@ def open_ports(cluster_name_on_cloud: str, ports: List[str],
 def cleanup_ports(cluster_name_on_cloud: str, ports: List[str],
                   provider_config: Optional[Dict[str, Any]] = None) -> None:
     pass
+
+
+# -- volume ops: host directories (the dev analog of a PD/PVC) --------------
+def _volume_dir(name: str) -> str:
+    return os.path.join(constants.sky_home(), 'local_volumes', name)
+
+
+def apply_volume(config: Dict[str, Any]) -> Dict[str, Any]:
+    d = _volume_dir(config['name'])
+    os.makedirs(d, exist_ok=True)
+    return {'name': config['name'], 'path': d, 'status': 'READY'}
+
+
+def delete_volume(config: Dict[str, Any]) -> None:
+    shutil.rmtree(_volume_dir(config['name']), ignore_errors=True)
+
+
+def attach_volume(config: Dict[str, Any], instance_id: str) -> str:
+    """Local volumes 'attach' by path: the backend symlinks the volume
+    dir to the task's mount path inside each sandbox."""
+    del instance_id
+    d = _volume_dir(config['name'])
+    os.makedirs(d, exist_ok=True)
+    return d

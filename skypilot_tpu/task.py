@@ -55,6 +55,9 @@ class Task:
         self.num_nodes = num_nodes if num_nodes is not None else 1
         # file_mounts: {remote_path: local_path_or_cloud_uri}
         self.file_mounts: Dict[str, str] = dict(file_mounts or {})
+        # volumes: {mount_path: volume_name} — named persistent volumes
+        # (reference: sky/volumes/), attached+mounted at file-mount time.
+        self.volumes: Dict[str, str] = {}
         # storage_mounts: {remote_path: storage_lib.Storage}
         self.storage_mounts: Dict[str, Any] = {}
         self.resources: Set[resources_lib.Resources] = {
@@ -164,6 +167,11 @@ class Task:
                          secret_overrides: Optional[Dict[str, str]] = None
                          ) -> 'Task':
         config = dict(config or {})
+        # Outer schema validation: path-annotated errors with hints
+        # before the strict field-by-field parse (reference:
+        # sky/utils/schemas.py at the API boundary).
+        from skypilot_tpu.utils import schemas
+        schemas.validate_task_config(config)
         envs = dict(config.get('envs') or {})
         if env_overrides:
             envs.update(env_overrides)
@@ -218,6 +226,14 @@ class Task:
         task.set_resources(
             resources_lib.Resources.from_yaml_config(resources_config))
 
+        volumes = config.pop('volumes', None) or {}
+        if not isinstance(volumes, dict) or not all(
+                isinstance(k, str) and isinstance(v, str)
+                for k, v in volumes.items()):
+            raise exceptions.InvalidTaskYAMLError(
+                'volumes must map mount_path -> volume name.')
+        task.volumes = dict(volumes)
+
         service = config.pop('service', None)
         if service is not None:
             from skypilot_tpu.serve import service_spec
@@ -268,6 +284,7 @@ class Task:
         for dst, store in self.storage_mounts.items():
             mounts[dst] = store.to_yaml_config()
         add('file_mounts', mounts or None)
+        add('volumes', dict(self.volumes) or None)
         if self.service is not None:
             add('service', self.service.to_yaml_config())
         return config

@@ -1,9 +1,11 @@
 """Volumes: named persistent disks as first-class objects.
 
-Reference: sky/volumes/ — network/instance volumes (k8s PVC, GCP PD)
-with CRUD via the API server. Round-1 scope: registry CRUD + GCP PD
-deploy-variable plumbing; actual disk attach lands with the GCE VM
-path.
+Reference: sky/volumes/ + the provisioner volume ops
+(sky/provision/__init__.py:235-310). `apply` really creates the
+backing store (GCP PD / k8s PVC / Local host dir) through the routed
+provisioner interface; `delete` destroys it; tasks mount volumes via
+the `volumes: {mount_path: name}` YAML field (backend attach+mount at
+file-mount time).
 """
 from __future__ import annotations
 
@@ -13,24 +15,43 @@ from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import exceptions
 from skypilot_tpu import global_state
+from skypilot_tpu import provision as provision_lib
 
 
 def apply(name: str, size_gb: int, infra: Optional[str] = None,
-          volume_type: str = 'pd-balanced') -> Dict[str, Any]:
+          volume_type: str = 'pd-balanced',
+          zone: Optional[str] = None) -> Dict[str, Any]:
+    """Create (or adopt) the backing volume and register it."""
+    provider = (infra or 'gcp').split('/')[0].lower()
     config = {
         'name': name,
         'size_gb': int(size_gb),
-        'infra': infra or 'gcp',
+        'infra': provider,
         'type': volume_type,
         'created_at': time.time(),
     }
+    if zone or (infra and '/' in infra):
+        config['zone'] = zone or infra.split('/')[-1]
+    result = provision_lib.apply_volume(provider, config)
+    config.update({k: v for k, v in result.items() if k != 'status'})
     with global_state._db().conn() as conn:  # pylint: disable=protected-access
         conn.execute(
             'INSERT INTO volumes (name, launched_at, config, status) '
             'VALUES (?,?,?,?) ON CONFLICT(name) DO UPDATE SET '
-            'config=excluded.config',
-            (name, int(time.time()), json.dumps(config), 'READY'))
-    return config
+            'config=excluded.config, status=excluded.status',
+            (name, int(time.time()), json.dumps(config),
+             result.get('status', 'READY')))
+    return {**config, 'status': result.get('status', 'READY')}
+
+
+def get(name: str) -> Optional[Dict[str, Any]]:
+    row = global_state._db().query_one(  # pylint: disable=protected-access
+        'SELECT * FROM volumes WHERE name=?', (name,))
+    if row is None:
+        return None
+    cfg = json.loads(row['config'] or '{}')
+    cfg['status'] = row['status']
+    return cfg
 
 
 def ls() -> List[Dict[str, Any]]:
@@ -45,9 +66,10 @@ def ls() -> List[Dict[str, Any]]:
 
 
 def delete(name: str) -> None:
-    row = global_state._db().query_one(  # pylint: disable=protected-access
-        'SELECT name FROM volumes WHERE name=?', (name,))
-    if row is None:
+    record = get(name)
+    if record is None:
         raise exceptions.SkyError(f'Volume {name!r} not found.')
+    provider = record.get('infra', 'gcp')
+    provision_lib.delete_volume(provider, record)
     global_state._db().execute(  # pylint: disable=protected-access
         'DELETE FROM volumes WHERE name=?', (name,))

@@ -151,3 +151,37 @@ def test_agent_rejects_unauthenticated(local_cluster):
     # The authed client path still works.
     assert handle.agent().health()['status'] == 'ok'
     assert isinstance(handle.agent().get_jobs(), list)
+
+
+@pytest.mark.slow
+def test_volume_mounts_and_persists(isolated_state):
+    """A Local volume attaches into the sandbox, survives cluster
+    teardown, and carries data to the next cluster (the dev analog of
+    GCP PD attach, reference sky/provision/__init__.py:235-310)."""
+    from skypilot_tpu import check
+    from skypilot_tpu.volumes import core as volumes_core
+    check.check(quiet=True)
+
+    vol = volumes_core.apply('vol1', 1, infra='local')
+    assert vol['status'] == 'READY'
+
+    writer = sky.Task(run='echo persisted-data > data/out.txt')
+    writer.set_resources(sky.Resources(infra='local'))
+    writer.volumes = {'data': 'vol1'}
+    sky.launch(writer, cluster_name='t-vol-w', _quiet_optimizer=True)
+    agent = core._get_handle('t-vol-w').agent()
+    assert agent.wait_job(1, timeout=60) == job_lib.JobStatus.SUCCEEDED
+    core.down('t-vol-w')
+
+    reader = sky.Task(run='cat data/out.txt')
+    reader.set_resources(sky.Resources(infra='local'))
+    reader.volumes = {'data': 'vol1'}
+    _, handle = sky.launch(reader, cluster_name='t-vol-r',
+                           _quiet_optimizer=True)
+    agent = handle.agent()
+    assert agent.wait_job(1, timeout=60) == job_lib.JobStatus.SUCCEEDED
+    logs = ''.join(agent.stream_job_logs(1, follow=False))
+    assert 'persisted-data' in logs
+    core.down('t-vol-r')
+    volumes_core.delete('vol1')
+    assert volumes_core.ls() == []

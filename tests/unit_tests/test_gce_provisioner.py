@@ -131,3 +131,86 @@ def test_gce_stop_resume_query_terminate(fake_gce):
     assert record.resumed_instance_ids == ['g3']
     gcp_instance.terminate_instances('g3', cfg.provider_config)
     assert not fake_gce.instances
+
+
+class FakeDisks:
+    """Fake compute API slice for the disk/volume lifecycle."""
+
+    def __init__(self):
+        self.disks = {}
+        self.attached = {}  # instance -> [device names]
+
+    def request(self, method, path, json_body=None, params=None):
+        m = re.match(r'projects/([^/]+)/zones/([^/]+)/disks'
+                     r'(?:/([^/]+))?$', path)
+        if m:
+            _, zone, name = m.groups()
+            if method == 'POST':
+                n = json_body['name']
+                self.disks[(zone, n)] = {
+                    'name': n, 'sizeGb': json_body['sizeGb'],
+                    'type': json_body['type'], 'status': 'READY'}
+                return {'name': f'op-{n}'}
+            if method == 'GET':
+                disk = self.disks.get((zone, name))
+                if disk is None:
+                    raise exceptions.FetchClusterInfoError(
+                        exceptions.FetchClusterInfoError.Reason.HEAD)
+                return disk
+            if method == 'DELETE':
+                if (zone, name) not in self.disks:
+                    raise exceptions.FetchClusterInfoError(
+                        exceptions.FetchClusterInfoError.Reason.HEAD)
+                del self.disks[(zone, name)]
+                return {}
+        m = re.match(r'projects/([^/]+)/zones/([^/]+)/instances/([^/]+)/'
+                     r'(attachDisk|detachDisk)$', path)
+        assert m, path
+        _, _zone, inst, action = m.groups()
+        if action == 'attachDisk':
+            self.attached.setdefault(inst, []).append(
+                json_body['deviceName'])
+        else:
+            self.attached.get(inst, []).remove(params['deviceName'])
+        return {}
+
+
+def test_gcp_volume_lifecycle(isolated_state, monkeypatch):
+    """PD create -> adopt (idempotent apply) -> attach -> delete via the
+    routed volume ops (reference: sky/provision/__init__.py:235-310)."""
+    from skypilot_tpu.volumes import core as volumes_core
+    fake = FakeDisks()
+    monkeypatch.setattr(gce_api, '_request',
+                        lambda m, p, json_body=None, params=None:
+                        fake.request(m, p, json_body, params))
+    monkeypatch.setattr(gcp_instance, '_project', lambda *a, **k: 'p')
+
+    vol = volumes_core.apply('ckpt', 200, infra='gcp/us-central2-b',
+                             volume_type='pd-ssd')
+    assert vol['status'] == 'READY' and vol['size_gb'] == 200
+    assert ('us-central2-b', 'ckpt') in fake.disks
+    # Idempotent re-apply adopts the existing disk.
+    vol2 = volumes_core.apply('ckpt', 200, infra='gcp/us-central2-b')
+    assert vol2['size_gb'] == 200
+    assert len(fake.disks) == 1
+    assert any(v['name'] == 'ckpt' for v in volumes_core.ls())
+
+    # Attach returns the mountable device path.
+    from skypilot_tpu import provision as provision_lib
+    device = provision_lib.attach_volume('gcp', volumes_core.get('ckpt'),
+                                         'vm-0')
+    assert device == '/dev/disk/by-id/google-ckpt'
+    assert fake.attached['vm-0'] == ['ckpt']
+
+    volumes_core.delete('ckpt')
+    assert fake.disks == {}
+    assert volumes_core.get('ckpt') is None
+
+
+def test_k8s_pvc_manifest():
+    from skypilot_tpu.provision.kubernetes import instance as k8s
+    pvc = k8s._pvc_manifest('ckpt', 50, storage_class='fast')
+    assert pvc['kind'] == 'PersistentVolumeClaim'
+    assert pvc['spec']['resources']['requests']['storage'] == '50Gi'
+    assert pvc['spec']['storageClassName'] == 'fast'
+    assert pvc['metadata']['labels']['skypilot-volume'] == 'ckpt'
