@@ -33,6 +33,11 @@ def _load_yaml(path: str) -> Dict[str, Any]:
         out = yaml.safe_load(f) or {}
     if not isinstance(out, dict):
         raise ValueError(f'Config {path} must be a YAML mapping.')
+    from skypilot_tpu.utils import schemas
+    try:
+        schemas.validate_config(out)
+    except Exception as e:  # pylint: disable=broad-except
+        raise ValueError(f'{path}: {e}') from e
     return out
 
 

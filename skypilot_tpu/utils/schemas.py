@@ -1,0 +1,165 @@
+"""JSON-schema validation for task YAML and config files.
+
+Reference: sky/utils/schemas.py (2742 LoC of get_*_schema builders).
+Role here: a friendly outer validation layer at the API boundary —
+clear, path-annotated error messages before the strict Python parsers
+(Task/Resources/ServiceSpec) run. The strict parsers remain the inner
+source of truth; the schema catches shape errors (wrong types, unknown
+fields, malformed nesting) with actionable hints.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import exceptions
+
+_RESOURCES_FIELDS: Dict[str, Any] = {
+    'cloud': {'type': 'string'},
+    'infra': {'type': 'string'},
+    'region': {'type': 'string'},
+    'zone': {'type': 'string'},
+    'accelerators': {'type': ['string', 'object']},
+    'accelerator_args': {'type': 'object'},
+    'instance_type': {'type': 'string'},
+    'cpus': {'type': ['string', 'number']},
+    'memory': {'type': ['string', 'number']},
+    'use_spot': {'type': 'boolean'},
+    'disk_size': {'type': ['integer', 'string']},
+    'ports': {'type': ['array', 'integer', 'string']},
+    'labels': {'type': 'object'},
+    'job_recovery': {'type': ['object', 'string']},
+    'image_id': {'type': 'string'},
+    'priority': {'type': ['integer', 'number']},
+    'disk_tier': {'type': 'string'},
+    'autostop': {'type': ['integer', 'boolean', 'object', 'string']},
+    'config_overrides': {'type': 'object'},
+}
+
+_RESOURCES_SCHEMA: Dict[str, Any] = {
+    'type': 'object',
+    'properties': {
+        **_RESOURCES_FIELDS,
+        'any_of': {'type': 'array',
+                   'items': {'type': 'object',
+                             'properties': _RESOURCES_FIELDS,
+                             'additionalProperties': False}},
+        'ordered': {'type': 'array',
+                    'items': {'type': 'object',
+                              'properties': _RESOURCES_FIELDS,
+                              'additionalProperties': False}},
+    },
+    'additionalProperties': False,
+}
+
+_SERVICE_SCHEMA: Dict[str, Any] = {
+    'type': 'object',
+    'properties': {
+        'readiness_probe': {'type': ['string', 'object']},
+        'replicas': {'type': 'integer'},
+        'replica_policy': {
+            'type': 'object',
+            'properties': {
+                'min_replicas': {'type': 'integer', 'minimum': 0},
+                'max_replicas': {'type': 'integer', 'minimum': 0},
+                'target_qps_per_replica': {'type': 'number',
+                                           'exclusiveMinimum': 0},
+                'upscale_delay_seconds': {'type': 'integer'},
+                'downscale_delay_seconds': {'type': 'integer'},
+                'base_ondemand_fallback_replicas': {'type': 'integer',
+                                                    'minimum': 0},
+                'dynamic_ondemand_fallback': {'type': 'boolean'},
+            },
+            'additionalProperties': False,
+        },
+        'port': {'type': ['integer', 'string']},
+        'load_balancing_policy': {'type': 'string'},
+        'autoscaler': {'type': 'string'},
+    },
+    'additionalProperties': False,
+}
+
+TASK_SCHEMA: Dict[str, Any] = {
+    'type': 'object',
+    'properties': {
+        'name': {'type': ['string', 'null']},
+        'workdir': {'type': 'string'},
+        'setup': {'type': 'string'},
+        'run': {'type': ['string', 'null']},
+        'num_nodes': {'type': 'integer', 'minimum': 1},
+        'envs': {'type': 'object'},
+        'secrets': {'type': 'object'},
+        'file_mounts': {'type': 'object'},
+        'volumes': {
+            'type': 'object',
+            'additionalProperties': {'type': 'string'},
+        },
+        'resources': _RESOURCES_SCHEMA,
+        'service': _SERVICE_SCHEMA,
+        'config': {'type': 'object'},
+        'experimental': {'type': 'object'},
+    },
+    'additionalProperties': False,
+}
+
+CONFIG_SCHEMA: Dict[str, Any] = {
+    'type': 'object',
+    'properties': {
+        'api_server': {'type': 'object'},
+        'gcp': {'type': 'object'},
+        'kubernetes': {'type': 'object'},
+        'ssh': {'type': 'object'},
+        'jobs': {'type': 'object'},
+        'serve': {'type': 'object'},
+        'admin_policy': {'type': 'string'},
+        'allowed_clouds': {'type': 'array', 'items': {'type': 'string'}},
+        'workspaces': {'type': 'object'},
+        'active_workspace': {'type': 'string'},
+        'usage': {'type': 'object'},
+        'logs': {'type': 'object'},
+    },
+    'additionalProperties': False,
+}
+
+# Common mistakes -> hints (reference: schemas.py error prettifiers).
+_FIELD_HINTS = {
+    'accelerator': "did you mean 'accelerators'?",
+    'resource': "did you mean 'resources'?",
+    'env': "did you mean 'envs'?",
+    'mounts': "did you mean 'file_mounts'?",
+    'node': "did you mean 'num_nodes'?",
+    'nodes': "did you mean 'num_nodes'?",
+}
+
+
+def _format_error(err, what: str) -> str:
+    path = '.'.join(str(p) for p in err.absolute_path) or '<top level>'
+    msg = f'Invalid {what}: at `{path}`: {err.message}'
+    if err.validator == 'additionalProperties':
+        # Pull the offending key out of the message for a hint.
+        import re
+        m = re.search(r"'([^']+)' (?:was|were) unexpected", err.message)
+        if m and m.group(1) in _FIELD_HINTS:
+            msg += f' ({_FIELD_HINTS[m.group(1)]})'
+    return msg
+
+
+def _validate(config: Dict[str, Any], schema: Dict[str, Any],
+              what: str) -> None:
+    try:
+        import jsonschema
+    except ImportError:  # stripped-down image: strict parser still runs
+        return
+    validator = jsonschema.Draft7Validator(schema)
+    errors = sorted(validator.iter_errors(config or {}),
+                    key=lambda e: list(e.absolute_path))
+    if errors:
+        raise exceptions.InvalidTaskYAMLError(
+            '\n'.join(_format_error(e, what) for e in errors[:5]))
+
+
+def validate_task_config(config: Optional[Dict[str, Any]]) -> None:
+    _validate(config or {}, TASK_SCHEMA, 'task YAML')
+
+
+def validate_config(config: Optional[Dict[str, Any]]) -> None:
+    _validate(config or {}, CONFIG_SCHEMA, 'config file')

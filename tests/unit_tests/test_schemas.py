@@ -1,0 +1,56 @@
+"""Schema-validation layer: actionable errors at the API boundary
+(reference: sky/utils/schemas.py)."""
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.utils import schemas
+
+
+def test_valid_task_passes():
+    schemas.validate_task_config({
+        'name': 't', 'run': 'echo hi', 'num_nodes': 2,
+        'resources': {'accelerators': 'tpu-v5e-8', 'use_spot': True},
+        'volumes': {'/data': 'vol1'},
+        'service': {'replica_policy': {'min_replicas': 1,
+                                       'max_replicas': 3,
+                                       'target_qps_per_replica': 2.5}},
+    })
+
+
+def test_typo_field_gets_hint():
+    with pytest.raises(exceptions.InvalidTaskYAMLError) as e:
+        task_lib.Task.from_yaml_config({'run': 'x',
+                                        'accelerator': 'tpu-v5e-8'})
+    msg = str(e.value)
+    assert 'accelerator' in msg and "did you mean 'accelerators'?" in msg
+
+
+def test_error_names_the_path():
+    with pytest.raises(exceptions.InvalidTaskYAMLError) as e:
+        schemas.validate_task_config({
+            'resources': {'any_of': [{'use_spot': 'yes-please'}]}})
+    msg = str(e.value)
+    assert 'resources.any_of.0.use_spot' in msg
+    assert 'boolean' in msg
+
+
+def test_wrong_type_rejected_before_parse():
+    with pytest.raises(exceptions.InvalidTaskYAMLError) as e:
+        task_lib.Task.from_yaml_config({'run': 'x', 'num_nodes': 'two'})
+    assert 'num_nodes' in str(e.value)
+
+
+def test_volumes_shape_checked():
+    with pytest.raises(exceptions.InvalidTaskYAMLError):
+        schemas.validate_task_config({'volumes': {'/data': 5}})
+
+
+def test_config_schema_rejects_unknown_section(tmp_path, monkeypatch):
+    from skypilot_tpu import sky_config
+    bad = tmp_path / 'bad.yaml'
+    bad.write_text('gpc:\n  project_id: x\n')  # typo'd section
+    monkeypatch.setenv('SKYPILOT_TPU_CONFIG', str(bad))
+    with pytest.raises(ValueError) as e:
+        sky_config.get_nested(('gcp', 'project_id'))
+    assert 'gpc' in str(e.value)
