@@ -350,6 +350,27 @@ def storage_ls() -> None:
         click.echo(n)
 
 
+@storage.command(name='transfer')
+@click.argument('src')
+@click.argument('dst')
+@click.option('--size-gb', type=float, default=None,
+              help='estimated size; large S3->GCS jobs use the '
+                   'server-side Storage Transfer Service')
+@click.option('--dryrun', is_flag=True, default=False,
+              help='print the transfer plan without executing')
+def storage_transfer(src, dst, size_gb, dryrun) -> None:
+    """Move bucket contents across clouds (gs:// <-> s3://)."""
+    from skypilot_tpu import sky_config
+    from skypilot_tpu.data import transfer as transfer_lib
+    plan = transfer_lib.transfer(
+        src, dst, size_gigabytes=size_gb,
+        project_id=sky_config.get_nested(('gcp', 'project_id')),
+        run=not dryrun)
+    click.echo(f'method: {plan["method"]}')
+    if 'command' in plan:
+        click.echo(plan['command'])
+
+
 @storage.command(name='delete')
 @click.argument('name')
 @click.option('--yes', '-y', is_flag=True, default=False)
@@ -506,6 +527,49 @@ def jobs_pool_down_cmd(pool_name, yes) -> None:
         click.confirm(f'Tear down pool {pool_name}?', abort=True)
     sdk.stream_and_get(sdk.jobs_pool_down(pool_name))
     click.echo(f'Pool {pool_name} torn down.')
+
+
+@jobs.group(name='group')
+def jobs_group() -> None:
+    """Co-scheduled job groups (RL actor/learner, disaggregated serve)."""
+
+
+@jobs_group.command(name='launch')
+@click.argument('yaml_files', nargs=-1, required=True)
+@click.option('--group-name', '-n', 'group_name', required=True)
+def jobs_group_launch_cmd(yaml_files, group_name) -> None:
+    """Launch one managed job per YAML, atomically, with each task's
+    env carrying every peer's head address."""
+    from skypilot_tpu import task as task_lib
+    tasks = [task_lib.Task.from_yaml(f) for f in yaml_files]
+    result = sdk.get(sdk.jobs_group_launch(tasks, group_name))
+    click.echo(f'Group {group_name}: jobs {result["job_ids"]} submitted.')
+
+
+@jobs_group.command(name='status')
+@click.argument('group_name')
+def jobs_group_status_cmd(group_name) -> None:
+    rows = sdk.get(sdk.jobs_group_status(group_name))
+    from rich.console import Console
+    from rich.table import Table
+    table = Table(box=None)
+    for col in ('ID', 'NAME', 'CLUSTER', 'ADDR', 'STATUS'):
+        table.add_column(col)
+    for r in rows:
+        table.add_row(str(r['job_id']), r['name'] or '-',
+                      r['cluster_name'] or '-', r['head_ip'] or '-',
+                      r['status'])
+    Console().print(table)
+
+
+@jobs_group.command(name='cancel')
+@click.argument('group_name')
+@click.option('--yes', '-y', is_flag=True, default=False)
+def jobs_group_cancel_cmd(group_name, yes) -> None:
+    if not yes:
+        click.confirm(f'Cancel all jobs in group {group_name}?', abort=True)
+    cancelled = sdk.get(sdk.jobs_group_cancel(group_name))
+    click.echo(f'Cancelled jobs: {cancelled}')
 
 
 @jobs.command(name='queue')

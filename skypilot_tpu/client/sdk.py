@@ -37,7 +37,9 @@ def api_server_url() -> str:
 
 
 def _headers() -> Dict[str, str]:
-    headers = {'X-Skypilot-User': common_utils.get_user_name()}
+    from skypilot_tpu.server import versions
+    headers = {'X-Skypilot-User': common_utils.get_user_name(),
+               versions.HEADER: str(versions.API_VERSION)}
     token = os.environ.get('SKYPILOT_API_TOKEN')
     if not token:
         from skypilot_tpu import sky_config
@@ -48,13 +50,23 @@ def _headers() -> Dict[str, str]:
 
 
 def api_info(server_url: Optional[str] = None) -> Optional[Dict[str, Any]]:
+    """Health + version handshake (reference: sky/server/versions.py).
+
+    Raises ApiVersionMismatchError when the server is older than this
+    client can speak to; returns None when unreachable."""
+    from skypilot_tpu.server import versions
     url = (server_url or api_server_url()) + '/api/health'
     try:
-        resp = requests.get(url, timeout=5)
+        resp = requests.get(url, timeout=5, headers=_headers())
         resp.raise_for_status()
-        return resp.json()
+        info = resp.json()
     except requests.RequestException:
         return None
+    _negotiated, err = versions.check_compatibility(
+        info.get('api_version'), remote_side='API server')
+    if err:
+        raise exceptions.ApiVersionMismatchError(err)
+    return info
 
 
 def api_start(host: str = '127.0.0.1',
@@ -113,40 +125,75 @@ def api_stop() -> bool:
 
 def _ensure_server() -> str:
     url = api_server_url()
-    if api_info(url) is None:
-        if url.startswith(('http://127.0.0.1', 'http://localhost')):
-            port = int(url.rsplit(':', 1)[1])
-            return api_start(port=port)
-        raise exceptions.ApiServerConnectionError(url)
-    return url
+    # Probe more than once: a single dropped connection (flaky network,
+    # chaos proxy) must not be mistaken for a dead server — that would
+    # try to bind a fresh local server on the same port.
+    for attempt in range(5):
+        if api_info(url) is not None:
+            return url
+        time.sleep(0.2 * (attempt + 1))
+    if url.startswith(('http://127.0.0.1', 'http://localhost')):
+        port = int(url.rsplit(':', 1)[1])
+        return api_start(port=port)
+    raise exceptions.ApiServerConnectionError(url)
 
 
-def _post(path: str, payload: Dict[str, Any]) -> str:
+def _post(path: str, payload: Dict[str, Any], retries: int = 4) -> str:
+    """Schedule a request; retries ride out flaky networks safely.
+
+    Each attempt carries the same client-generated request id, so a
+    retry after a lost response re-joins the already-scheduled request
+    instead of double-running it (chaos-proxy tested)."""
+    import uuid as _uuid
     url = _ensure_server()
-    resp = requests.post(f'{url}{path}', json=payload, headers=_headers(),
-                         timeout=30)
-    if resp.status_code in (401, 403):
-        raise exceptions.PermissionDeniedError(
-            resp.json().get('error', 'permission denied'))
-    resp.raise_for_status()
-    return resp.json()['request_id']
+    headers = _headers()
+    headers['X-Skypilot-Request-ID'] = _uuid.uuid4().hex[:16]
+    for attempt in range(retries + 1):
+        try:
+            resp = requests.post(f'{url}{path}', json=payload,
+                                 headers=headers, timeout=30)
+            if resp.status_code in (401, 403):
+                raise exceptions.PermissionDeniedError(
+                    resp.json().get('error', 'permission denied'))
+            resp.raise_for_status()
+            return resp.json()['request_id']
+        except (requests.ConnectionError, requests.Timeout,
+                requests.exceptions.ChunkedEncodingError, ValueError):
+            if attempt == retries:
+                raise
+            time.sleep(min(2.0, 0.2 * 2**attempt))
+    raise AssertionError('unreachable')  # pragma: no cover
 
 
 # ---------------------------------------------------------------------------
 # Request futures
 # ---------------------------------------------------------------------------
 def get(request_id: str, timeout: Optional[float] = None) -> Any:
-    """Block until the request finishes; return its value or raise."""
+    """Block until the request finishes; return its value or raise.
+
+    Polling GETs are idempotent, so transient connection failures are
+    retried (bounded) instead of surfacing to the caller."""
     url = api_server_url()
     deadline = time.time() + timeout if timeout else None
+    transient_failures = 0
     while True:
-        resp = requests.get(f'{url}/api/get',
-                            params={'request_id': request_id, 'timeout': 10},
-                            headers=_headers(), timeout=40)
-        if resp.status_code == 404:
-            raise exceptions.RequestNotFoundError(request_id)
-        resp.raise_for_status()
-        body = resp.json()
+        try:
+            resp = requests.get(
+                f'{url}/api/get',
+                params={'request_id': request_id, 'timeout': 10},
+                headers=_headers(), timeout=40)
+            if resp.status_code == 404:
+                raise exceptions.RequestNotFoundError(request_id)
+            resp.raise_for_status()
+            body = resp.json()  # truncated body (reset) raises too
+            transient_failures = 0
+        except (requests.ConnectionError, requests.Timeout,
+                requests.exceptions.ChunkedEncodingError, ValueError):
+            transient_failures += 1
+            if transient_failures > 8:
+                raise
+            time.sleep(min(2.0, 0.2 * 2**transient_failures))
+            continue
         status = body['status']
         if status == 'SUCCEEDED':
             return body.get('return_value')
@@ -456,3 +503,23 @@ def token_ls() -> List[Dict[str, Any]]:
 def token_revoke(token_id: str) -> bool:
     return _direct('POST', '/users/tokens/revoke',
                    {'token_id': token_id})['revoked']
+
+
+# -- job groups --------------------------------------------------------------
+def jobs_group_launch(tasks: List['task_lib.Task'], group_name: str,
+                      strategy: Optional[str] = None) -> str:
+    """Co-scheduled managed jobs; each task's env gets every peer's
+    address (reference: sky/jobs/job_group_networking.py)."""
+    return _post('/jobs/group/launch', {
+        'group_name': group_name,
+        'task_configs': [t.to_yaml_config() for t in tasks],
+        'strategy': strategy,
+    })
+
+
+def jobs_group_status(group_name: str) -> str:
+    return _post('/jobs/group/status', {'group_name': group_name})
+
+
+def jobs_group_cancel(group_name: str) -> str:
+    return _post('/jobs/group/cancel', {'group_name': group_name})

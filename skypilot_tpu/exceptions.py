@@ -235,6 +235,10 @@ class PermissionDeniedError(SkyError):
     """401/403 from the API server (RBAC or bad/missing token)."""
 
 
+class ApiVersionMismatchError(SkyError):
+    """Client and server API versions cannot interoperate."""
+
+
 class RequestCancelled(SkyError):
     pass
 

@@ -1,102 +1,120 @@
-"""Minimal web dashboard: one server-rendered page.
+"""Interactive web dashboard: a vanilla-JS SPA over JSON endpoints.
 
-Reference: sky/dashboard/ (a 42k-LoC Next.js app). Round-1 scope is a
-zero-dependency status page at `/dashboard` showing clusters, managed
-jobs, services, and recent requests — the full SPA is a later-round
-deliverable.
+Reference: sky/dashboard/ (a 42k-LoC Next.js app). Same data, no build
+chain: `dashboard_static/` ships index.html + app.js; the SPA polls
+`/dashboard/api/summary` for live clusters/jobs/services/requests/
+users tables and streams log tails through the server's existing
+`/logs` and `/jobs/*/logs` endpoints.
 """
 from __future__ import annotations
 
-import datetime
-import html
-from typing import Any, Dict, List
+import asyncio
+import os
+from typing import Any, Dict
 
 from aiohttp import web
 
-_STYLE = """
-body { font-family: -apple-system, system-ui, sans-serif; margin: 2rem;
-       color: #1a1a1a; background: #fafafa; }
-h1 { font-size: 1.4rem; } h2 { font-size: 1.05rem; margin-top: 2rem; }
-table { border-collapse: collapse; width: 100%; background: white;
-        box-shadow: 0 1px 2px rgba(0,0,0,.08); }
-th, td { text-align: left; padding: .45rem .8rem; font-size: .85rem;
-         border-bottom: 1px solid #eee; }
-th { background: #f0f0f2; font-weight: 600; }
-.status-UP, .status-READY, .status-RUNNING, .status-SUCCEEDED
-  { color: #0a7d33; font-weight: 600; }
-.status-INIT, .status-PENDING, .status-STARTING, .status-RECOVERING
-  { color: #b07d00; font-weight: 600; }
-.status-STOPPED { color: #666; }
-.status-FAILED, .status-FAILED_SETUP, .status-FAILED_NO_RESOURCE
-  { color: #c22; font-weight: 600; }
-.empty { color: #999; font-style: italic; padding: .6rem; }
-"""
+_STATIC_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           'dashboard_static')
 
 
-def _table(headers: List[str], rows: List[List[str]]) -> str:
-    if not rows:
-        return '<div class="empty">none</div>'
-    head = ''.join(f'<th>{html.escape(h)}</th>' for h in headers)
-    body = ''
-    for row in rows:
-        cells = ''
-        for cell in row:
-            text = html.escape(str(cell))
-            cls = (f' class="status-{text}"'
-                   if text.isupper() and len(text) < 20 else '')
-            cells += f'<td{cls}>{text}</td>'
-        body += f'<tr>{cells}</tr>'
-    return f'<table><tr>{head}</tr>{body}</table>'
-
-
-def _ts(value) -> str:
-    if not value:
-        return '-'
-    try:
-        return datetime.datetime.fromtimestamp(float(value)).strftime(
-            '%m-%d %H:%M')
-    except (ValueError, OSError):
-        return '-'
-
-
-async def dashboard(request: web.Request) -> web.Response:
-    del request
+def _summary() -> Dict[str, Any]:
+    """Collect every table the SPA renders (runs in a worker thread)."""
     from skypilot_tpu import global_state
     from skypilot_tpu.jobs import state as jobs_state
     from skypilot_tpu.serve import serve_state
+    from skypilot_tpu.server import server as server_mod
     from skypilot_tpu.server.requests import executor
+    from skypilot_tpu.users import core as users_core
 
-    clusters = [[r['name'], r['resources_str'] or '-',
-                 _ts(r['launched_at']), r['status'].value]
-                for r in global_state.get_clusters()]
-    jobs = [[j['job_id'], j['name'] or '-', j['cluster_name'],
-             j['recovery_count'], j['status'].value]
-            for j in jobs_state.get_jobs()]
-    services: List[List[Any]] = []
+    clusters = []
+    for r in global_state.get_clusters():
+        handle = r.get('handle')
+        clusters.append({
+            'name': r['name'],
+            'resources_str': r.get('resources_str'),
+            'owner': r.get('owner'),
+            'launched_at': r.get('launched_at'),
+            'autostop': r.get('autostop_minutes', -1),
+            'autostop_down': bool(r.get('autostop_down')),
+            'status': r['status'].value,
+            'num_hosts': getattr(handle, 'num_hosts', None),
+            'head_agent_addr': getattr(handle, 'head_agent_addr', None),
+            'events': global_state.get_cluster_events(r['name'])[-15:],
+        })
+
+    jobs = []
+    for j in jobs_state.get_jobs():
+        jobs.append({
+            'job_id': j['job_id'],
+            'name': j.get('name'),
+            'job_group': j.get('job_group'),
+            'cluster_name': j.get('cluster_name'),
+            'recovery_count': j.get('recovery_count', 0),
+            'submitted_at': j.get('submitted_at'),
+            'strategy': j.get('strategy'),
+            'last_error': j.get('last_error'),
+            'status': j['status'].value,
+        })
+
+    services = []
     for s in serve_state.get_services():
         replicas = serve_state.get_replicas(s['name'])
         ready = sum(1 for r in replicas if r['status'].is_serving)
-        services.append([s['name'], f'v{s["version"]}',
-                         f'{ready}/{len(replicas)}', s['status'].value])
-    requests_rows = [[r['request_id'][:8], r['name'], r['user'] or '-',
-                      _ts(r['created_at']), r['status']]
-                     for r in executor.list_requests(limit=20)]
+        services.append({
+            'name': s['name'],
+            'version': s['version'],
+            'ready': ready,
+            'total': len(replicas),
+            'endpoint': (f'127.0.0.1:{s["lb_port"]}'
+                         if s.get('lb_port') else None),
+            'status': s['status'].value,
+        })
 
-    page = f"""<!doctype html>
-<html><head><title>skypilot_tpu</title><style>{_STYLE}</style>
-<meta http-equiv="refresh" content="10"></head><body>
-<h1>skypilot_tpu</h1>
-<h2>Clusters</h2>
-{_table(['name', 'resources', 'launched', 'status'], clusters)}
-<h2>Managed jobs</h2>
-{_table(['id', 'name', 'cluster', 'recoveries', 'status'], jobs)}
-<h2>Services</h2>
-{_table(['name', 'version', 'ready', 'status'], services)}
-<h2>Recent requests</h2>
-{_table(['id', 'name', 'user', 'created', 'status'], requests_rows)}
-</body></html>"""
-    return web.Response(text=page, content_type='text/html')
+    requests_rows = executor.list_requests(limit=50)
+    users = users_core.ls()
+    return {
+        'server': {
+            'api_version': server_mod.API_VERSION,
+            'commit': os.environ.get('SKYPILOT_COMMIT', 'dev'),
+        },
+        'counts': {
+            'clusters': len(clusters),
+            'jobs': len(jobs),
+            'services': len(services),
+            'requests': len(requests_rows),
+            'users': len(users),
+        },
+        'clusters': clusters,
+        'jobs': jobs,
+        'services': services,
+        'requests': requests_rows,
+        'users': users,
+    }
+
+
+async def summary(request: web.Request) -> web.Response:
+    del request
+    data = await asyncio.get_event_loop().run_in_executor(None, _summary)
+    return web.json_response(data)
+
+
+async def index(request: web.Request) -> web.Response:
+    del request
+    with open(os.path.join(_STATIC_DIR, 'index.html'), 'r',
+              encoding='utf-8') as f:
+        return web.Response(text=f.read(), content_type='text/html')
+
+
+async def app_js(request: web.Request) -> web.Response:
+    del request
+    with open(os.path.join(_STATIC_DIR, 'app.js'), 'r',
+              encoding='utf-8') as f:
+        return web.Response(text=f.read(),
+                            content_type='application/javascript')
 
 
 def register(app: web.Application) -> None:
-    app.router.add_get('/dashboard', dashboard)
+    app.router.add_get('/dashboard', index)
+    app.router.add_get('/dashboard/app.js', app_js)
+    app.router.add_get('/dashboard/api/summary', summary)

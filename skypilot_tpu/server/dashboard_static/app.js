@@ -1,0 +1,189 @@
+/* Dashboard SPA: live tables over /dashboard/api/summary + log tails
+ * over the server's existing streaming endpoints. Vanilla JS — the
+ * reference ships a 42k-LoC Next.js app; the data is the same. */
+'use strict';
+
+const TABS = ['Clusters', 'Jobs', 'Services', 'Requests', 'Users'];
+let active = 'Clusters';
+let data = null;
+let logAbort = null;
+
+const $ = (id) => document.getElementById(id);
+
+/* Auth: once a service-account token is issued the server requires it
+ * everywhere; the SPA keeps one in sessionStorage and prompts on 401. */
+function authHeaders() {
+  const t = sessionStorage.getItem('sky_token');
+  return t ? { Authorization: `Bearer ${t}` } : {};
+}
+
+function promptToken() {
+  const t = window.prompt(
+    'This API server requires a service-account token\n' +
+    '(mint one with: stpu users token issue <user>).\nToken:');
+  if (t) { sessionStorage.setItem('sky_token', t.trim()); return true; }
+  return false;
+}
+
+async function authFetch(url, opts) {
+  let resp = await fetch(url, { ...(opts || {}), headers: authHeaders() });
+  if (resp.status === 401 && promptToken()) {
+    resp = await fetch(url, { ...(opts || {}), headers: authHeaders() });
+  }
+  return resp;
+}
+
+function statusClass(s) {
+  if (!s) return 's-muted';
+  if (/^(UP|READY|RUNNING|SUCCEEDED|ALIVE)$/.test(s)) return 's-ok';
+  if (/^(INIT|PENDING|STARTING|RECOVERING|SUBMITTED|PROVISIONING|CANCELLING|NOT_READY)$/.test(s)) return 's-warn';
+  if (/FAIL|ERROR|SHUTTING/.test(s)) return 's-bad';
+  return 's-muted';
+}
+
+function ts(v) {
+  if (!v) return '-';
+  const d = new Date(v * 1000);
+  return `${String(d.getMonth() + 1).padStart(2, '0')}-${String(d.getDate()).padStart(2, '0')} ` +
+         `${String(d.getHours()).padStart(2, '0')}:${String(d.getMinutes()).padStart(2, '0')}`;
+}
+
+function table(headers, rows, onClick) {
+  if (!rows.length) return '<div class="empty">none</div>';
+  const head = headers.map((h) => `<th>${h}</th>`).join('');
+  const body = rows.map((r, i) => {
+    const cells = r.map((c) => {
+      const text = String(c == null ? '-' : c);
+      const cls = /^[A-Z_]{2,20}$/.test(text) ? ` class="${statusClass(text)}"` : '';
+      return `<td${cls}>${text.replace(/</g, '&lt;')}</td>`;
+    }).join('');
+    const rowCls = onClick ? ' class="row"' : '';
+    return `<tr${rowCls} data-i="${i}">${cells}</tr>`;
+  }).join('');
+  return `<table><tr>${head}</tr>${body}</table>`;
+}
+
+function renderTabs() {
+  $('tabs').innerHTML = TABS.map((t) => {
+    const n = data ? data.counts[t.toLowerCase()] : '';
+    return `<button class="${t === active ? 'active' : ''}" data-tab="${t}">` +
+           `${t}${n ? `<span class="pill">${n}</span>` : ''}</button>`;
+  }).join('');
+  document.querySelectorAll('#tabs button').forEach((b) => {
+    b.onclick = () => { active = b.dataset.tab; closeDetail(); render(); };
+  });
+}
+
+function render() {
+  renderTabs();
+  if (!data) { $('view').innerHTML = '<div class="empty">loading…</div>'; return; }
+  const v = $('view');
+  if (active === 'Clusters') {
+    v.innerHTML = table(
+      ['name', 'resources', 'owner', 'launched', 'autostop', 'status'],
+      data.clusters.map((c) => [c.name, c.resources_str, c.owner, ts(c.launched_at),
+                                c.autostop >= 0 ? `${c.autostop}m${c.autostop_down ? ' (down)' : ''}` : '-',
+                                c.status]),
+      true);
+    bindRows((i) => showClusterDetail(data.clusters[i]));
+  } else if (active === 'Jobs') {
+    v.innerHTML = table(
+      ['id', 'name', 'group', 'cluster', 'recoveries', 'submitted', 'status'],
+      data.jobs.map((j) => [j.job_id, j.name, j.job_group, j.cluster_name,
+                            j.recovery_count, ts(j.submitted_at), j.status]),
+      true);
+    bindRows((i) => showJobDetail(data.jobs[i]));
+  } else if (active === 'Services') {
+    v.innerHTML = table(
+      ['name', 'version', 'replicas (ready/total)', 'endpoint', 'status'],
+      data.services.map((s) => [s.name, `v${s.version}`, `${s.ready}/${s.total}`,
+                                s.endpoint, s.status]));
+  } else if (active === 'Requests') {
+    v.innerHTML = table(
+      ['id', 'name', 'user', 'created', 'status'],
+      data.requests.map((r) => [r.request_id.slice(0, 8), r.name, r.user,
+                                ts(r.created_at), r.status]));
+  } else if (active === 'Users') {
+    v.innerHTML = table(
+      ['user', 'role', 'requests', 'last seen'],
+      data.users.map((u) => [u.name, u.role || 'user', u.request_count,
+                             ts(u.last_seen)]));
+  }
+}
+
+function bindRows(fn) {
+  document.querySelectorAll('#view tr.row').forEach((tr) => {
+    tr.onclick = () => fn(Number(tr.dataset.i));
+  });
+}
+
+function closeDetail() {
+  if (logAbort) { logAbort.abort(); logAbort = null; }
+  $('detail').innerHTML = '';
+}
+
+function detailShell(title, bodyHtml) {
+  $('detail').innerHTML =
+    `<div class="detail"><button class="close" id="dclose">✕ close</button>` +
+    `<h3>${title}</h3>${bodyHtml}</div>`;
+  $('dclose').onclick = closeDetail;
+}
+
+function showClusterDetail(c) {
+  closeDetail();
+  const events = (c.events || []).map((e) => [ts(e.timestamp), e.event_type, e.message]);
+  detailShell(`Cluster ${c.name}`,
+    `<div>${c.resources_str || ''} · ${c.num_hosts || '?'} host(s) · ` +
+    `agent ${c.head_agent_addr || '-'}</div>` +
+    `<h4>Events</h4>${table(['time', 'event', 'detail'], events)}` +
+    `<h4>Latest job log</h4><pre class="logs" id="logbox">…</pre>`);
+  streamLogs(`/logs?cluster=${encodeURIComponent(c.name)}&follow=0&tail=200`);
+}
+
+function showJobDetail(j) {
+  closeDetail();
+  detailShell(`Managed job ${j.job_id} — ${j.name || ''}`,
+    `<div>cluster ${j.cluster_name} · strategy ${j.strategy || '-'} · ` +
+    `recoveries ${j.recovery_count}` +
+    (j.last_error ? `<div class="err">${String(j.last_error).replace(/</g, '&lt;')}</div>` : '') +
+    `</div><h4>Log</h4><pre class="logs" id="logbox">…</pre>`);
+  streamLogs(`/jobs/logs?job_id=${j.job_id}&follow=0`);
+}
+
+async function streamLogs(url) {
+  const box = $('logbox');
+  box.textContent = '';
+  logAbort = new AbortController();
+  try {
+    const resp = await fetch(url, { signal: logAbort.signal,
+                                    headers: authHeaders() });
+    if (!resp.ok) { box.textContent = `(${resp.status}: no logs)`; return; }
+    const reader = resp.body.getReader();
+    const dec = new TextDecoder();
+    for (;;) {
+      const { done, value } = await reader.read();
+      if (done) break;
+      box.textContent += dec.decode(value, { stream: true });
+      box.scrollTop = box.scrollHeight;
+    }
+  } catch (e) { /* aborted or stream ended */ }
+}
+
+async function refresh() {
+  try {
+    const resp = await authFetch('/dashboard/api/summary');
+    if (!resp.ok) throw new Error(`${resp.status}`);
+    data = await resp.json();
+    $('meta').textContent =
+      `${data.server.commit || 'dev'} · api v${data.server.api_version} · ` +
+      `refreshed ${new Date().toLocaleTimeString()}`;
+    render();
+  } catch (e) {
+    $('meta').textContent = `disconnected (${e.message})`;
+  }
+}
+
+renderTabs();
+render();
+refresh();
+setInterval(refresh, 5000);

@@ -85,12 +85,19 @@ def _log_path(request_id: str) -> str:
 # ---------------------------------------------------------------------------
 def schedule_request(name: str, entrypoint: str, payload: Dict[str, Any],
                      schedule_type: str = 'long',
-                     user: str = 'unknown') -> str:
-    """Persist a request; the scheduler thread picks it up."""
-    request_id = uuid.uuid4().hex[:16]
+                     user: str = 'unknown',
+                     request_id: Optional[str] = None) -> str:
+    """Persist a request; the scheduler thread picks it up.
+
+    A client-supplied `request_id` makes scheduling idempotent: a
+    retried POST (lost response over a flaky network) re-inserts
+    nothing and returns the same id, so network-level retries can
+    never double-run a launch.
+    """
+    request_id = request_id or uuid.uuid4().hex[:16]
     _db().execute(
-        'INSERT INTO requests (request_id, name, entrypoint, payload, '
-        'status, created_at, log_path, user, schedule_type) '
+        'INSERT OR IGNORE INTO requests (request_id, name, entrypoint, '
+        'payload, status, created_at, log_path, user, schedule_type) '
         'VALUES (?,?,?,?,?,?,?,?,?)',
         (request_id, name, entrypoint, json.dumps(payload),
          RequestStatus.PENDING.value, time.time(), _log_path(request_id),
@@ -167,6 +174,11 @@ def _request_worker_main(request_id: str, entrypoint: str,
     """Runs in the forked worker process (reference:
     _request_execution_wrapper, executor.py:670)."""
     os.setpgrp()  # own process group: cancel kills the whole tree
+    # The fork inherits aiohttp's asyncio signal handlers, which are
+    # no-ops without the parent's event loop — a worker would silently
+    # IGNORE SIGTERM (cancel, chaos kill). Restore default dispositions.
+    signal.signal(signal.SIGTERM, signal.SIG_DFL)
+    signal.signal(signal.SIGINT, signal.SIG_DFL)
     db = _db_for(db_path)
     import sys
     log_file = open(log_path, 'ab', buffering=0)

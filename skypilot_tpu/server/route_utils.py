@@ -26,8 +26,13 @@ async def schedule(request: web.Request, name: str, entrypoint: str,
             None, permission.check_request, name, payload, user, role)
     except permission.PermissionDeniedError as e:
         return web.json_response({'error': str(e)}, status=403)
+    # Client-supplied id (X-Skypilot-Request-ID) dedupes retried POSTs.
+    supplied = request.headers.get('X-Skypilot-Request-ID') or None
+    if supplied is not None and not supplied.isalnum():
+        supplied = None
     request_id = executor.schedule_request(
-        name, entrypoint, payload, schedule_type=schedule_type, user=user)
+        name, entrypoint, payload, schedule_type=schedule_type, user=user,
+        request_id=supplied)
     return web.json_response({'request_id': request_id})
 
 

@@ -18,9 +18,10 @@ from aiohttp import web
 from skypilot_tpu import constants
 from skypilot_tpu import exceptions
 from skypilot_tpu.agent import log_lib
+from skypilot_tpu.server import versions
 from skypilot_tpu.server.requests import executor
 
-API_VERSION = 1
+API_VERSION = versions.API_VERSION
 
 routes = web.RouteTableDef()
 
@@ -365,6 +366,16 @@ async def auth_middleware(request: web.Request, handler):
     from skypilot_tpu.users import core as users_core
     from skypilot_tpu.users import tokens as tokens_lib
 
+    # Version negotiation (reference: sky/server/versions.py): reject
+    # clients below the minimum compatible version with an actionable
+    # message; absent header = legacy v1, still in range.
+    _negotiated, version_err = versions.check_compatibility(
+        request.headers.get(versions.HEADER), remote_side='client')
+    if version_err:
+        return web.json_response({'error': version_err}, status=400,
+                                 headers={versions.HEADER:
+                                          str(versions.API_VERSION)})
+
     loop = asyncio.get_event_loop()
     supplied = request.headers.get('Authorization', '')
     bearer = supplied[7:] if supplied.startswith('Bearer ') else ''
@@ -373,7 +384,11 @@ async def auth_middleware(request: web.Request, handler):
 
     user = request.headers.get('X-Skypilot-User') or 'unknown'
     role = 'admin'
-    if request.path != '/api/health':
+    # Open paths: liveness probe + the dashboard's static shell (no
+    # data; the SPA's own /dashboard/api calls DO require the token,
+    # which the page prompts for).
+    open_paths = ('/api/health', '/dashboard', '/dashboard/app.js')
+    if request.path not in open_paths:
         tokens_on = await loop.run_in_executor(None,
                                                tokens_lib.auth_required)
         if tokens_on:
@@ -397,7 +412,12 @@ async def auth_middleware(request: web.Request, handler):
             await loop.run_in_executor(None, users_core.record_request, user)
         except Exception:  # pylint: disable=broad-except
             pass  # registry is best-effort
-    return await handler(request)
+    response = await handler(request)
+    try:
+        response.headers[versions.HEADER] = str(versions.API_VERSION)
+    except Exception:  # pylint: disable=broad-except
+        pass  # streamed responses may already have headers sent
+    return response
 
 
 def run(host: str = '127.0.0.1',
@@ -407,6 +427,14 @@ def run(host: str = '127.0.0.1',
     _SERVER_START_TIME = _time.time()
     worker_loop = executor.RequestWorkerLoop()
     worker_loop.start()
+    # HA: re-adopt managed jobs orphaned by a previous server/controller
+    # crash (reference: sky/jobs/managed_job_refresh_thread.py).
+    try:
+        from skypilot_tpu.jobs import scheduler as jobs_scheduler
+        jobs_scheduler.maybe_schedule_next_jobs()
+    except Exception:  # pylint: disable=broad-except
+        import traceback
+        traceback.print_exc()
     app = create_app()
     web.run_app(app, host=host, port=port, print=None)
 

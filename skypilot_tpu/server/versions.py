@@ -1,0 +1,39 @@
+"""API version negotiation between SDK and server.
+
+Reference: sky/server/versions.py — client and server each carry an
+integer API version plus the minimum they can still talk to; every
+request/response carries the version header and both ends fail fast
+with an actionable message instead of mis-parsing payloads.
+
+The negotiated capability level is min(local, remote): new fields are
+additive, so the older party's schema is always a subset.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+# Bump API_VERSION on any wire-format change; raise MIN_COMPATIBLE
+# only when a change cannot be expressed additively.
+API_VERSION = 2
+MIN_COMPATIBLE_API_VERSION = 1
+
+HEADER = 'X-Skypilot-Api-Version'
+
+
+def check_compatibility(remote_version: Optional[int],
+                        remote_side: str = 'client'
+                        ) -> Tuple[Optional[int], Optional[str]]:
+    """(negotiated_version, error). remote_version None → legacy v1."""
+    if remote_version is None:
+        remote_version = 1
+    try:
+        remote_version = int(remote_version)
+    except (TypeError, ValueError):
+        return None, f'Unparseable {HEADER}: {remote_version!r}'
+    if remote_version < MIN_COMPATIBLE_API_VERSION:
+        upgrade = ('upgrade the client'
+                   if remote_side == 'client' else 'upgrade the API server')
+        return None, (
+            f'{remote_side} API version {remote_version} is older than the '
+            f'minimum supported {MIN_COMPATIBLE_API_VERSION}; {upgrade}.')
+    return min(remote_version, API_VERSION), None

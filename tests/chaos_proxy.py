@@ -1,0 +1,99 @@
+"""A TCP chaos proxy: forwards to a target, randomly killing flows.
+
+Reference: tests/chaos/chaos_proxy.py — sits between the SDK and the
+API server and injects the failures a flaky network would: refused
+connects (drop-on-accept) and mid-stream resets. Deterministic via
+`seed` so failures reproduce.
+"""
+from __future__ import annotations
+
+import random
+import socket
+import threading
+from typing import Optional
+
+
+class ChaosProxy:
+
+    def __init__(self, target_host: str, target_port: int,
+                 drop_prob: float = 0.3, reset_prob: float = 0.1,
+                 seed: int = 0) -> None:
+        self.target = (target_host, target_port)
+        self.drop_prob = drop_prob
+        self.reset_prob = reset_prob
+        self.rng = random.Random(seed)
+        self.listener = socket.socket()
+        self.listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.listener.bind(('127.0.0.1', 0))
+        self.listener.listen(64)
+        self.port = self.listener.getsockname()[1]
+        self._stop = threading.Event()
+        self.stats = {'accepted': 0, 'dropped': 0, 'reset': 0}
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self.listener.close()
+        except OSError:
+            pass
+
+    # -- internals -----------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                client, _ = self.listener.accept()
+            except OSError:
+                return
+            self.stats['accepted'] += 1
+            if self.rng.random() < self.drop_prob:
+                # Refused-connection flavor: close before any bytes.
+                self.stats['dropped'] += 1
+                client.close()
+                continue
+            reset_at: Optional[int] = None
+            if self.rng.random() < self.reset_prob:
+                reset_at = self.rng.randint(1, 2048)
+                self.stats['reset'] += 1
+            threading.Thread(target=self._pipe_pair,
+                             args=(client, reset_at), daemon=True).start()
+
+    def _pipe_pair(self, client: socket.socket,
+                   reset_at: Optional[int]) -> None:
+        try:
+            upstream = socket.create_connection(self.target, timeout=10)
+        except OSError:
+            client.close()
+            return
+
+        budget = [reset_at]  # shared mid-stream reset byte budget
+
+        def pipe(src: socket.socket, dst: socket.socket) -> None:
+            try:
+                while True:
+                    data = src.recv(65536)
+                    if not data:
+                        break
+                    if budget[0] is not None:
+                        if len(data) >= budget[0]:
+                            raise OSError('chaos reset')
+                        budget[0] -= len(data)
+                    dst.sendall(data)
+            except OSError:
+                pass
+            finally:
+                for s in (src, dst):
+                    try:
+                        s.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+
+        threading.Thread(target=pipe, args=(client, upstream),
+                         daemon=True).start()
+        pipe(upstream, client)

@@ -1,0 +1,224 @@
+"""Chaos + robustness harness for the API server.
+
+Reference strategy: tests/chaos/chaos_proxy.py (SDK↔server TCP-drop
+proxy), tests/smoke_tests/backward_compat (client/server version
+skew), and the executor's restart-recovery scan.
+"""
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+import requests
+
+from skypilot_tpu import constants
+from skypilot_tpu import exceptions
+from skypilot_tpu.client import sdk
+
+from tests.chaos_proxy import ChaosProxy
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
+def _start_server(home: str, port: int) -> subprocess.Popen:
+    env = dict(os.environ)
+    env['SKYPILOT_TPU_HOME'] = home
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env['PYTHONPATH'] = f"{repo_root}:{env.get('PYTHONPATH', '')}"
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.server.server',
+         '--port', str(port)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    deadline = time.time() + 30
+    url = f'http://127.0.0.1:{port}'
+    while time.time() < deadline:
+        try:
+            if requests.get(f'{url}/api/health', timeout=2).ok:
+                return proc
+        except requests.RequestException:
+            pass
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f'server died: {proc.stdout.read().decode()[-1500:]}')
+        time.sleep(0.3)
+    raise RuntimeError('server did not come up')
+
+
+@pytest.fixture()
+def chaos_server(isolated_state, monkeypatch):
+    port = _free_port()
+    proc = _start_server(isolated_state, port)
+    yield isolated_state, port, proc
+    if proc.poll() is None:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+@pytest.mark.slow
+def test_sdk_survives_chaos_proxy(chaos_server, monkeypatch):
+    """Requests through a connection-dropping proxy still complete:
+    the SDK's retry loop rides out refused connects and mid-stream
+    resets (reference: tests/chaos/chaos_proxy.py)."""
+    _home, port, _proc = chaos_server
+    proxy = ChaosProxy('127.0.0.1', port, drop_prob=0.3, reset_prob=0.15,
+                       seed=7)
+    monkeypatch.setenv(constants.API_SERVER_URL_ENV_VAR,
+                       f'http://127.0.0.1:{proxy.port}')
+    try:
+        ok = 0
+        for _ in range(10):
+            rid = sdk.check()          # schedules through the proxy
+            assert sdk.get(rid) == ['local']
+            ok += 1
+        assert ok == 10
+        # The proxy really did inject failures we rode out.
+        assert proxy.stats['dropped'] + proxy.stats['reset'] > 0
+    finally:
+        proxy.close()
+
+
+@pytest.mark.slow
+def test_executor_restart_fails_inflight_requests(chaos_server,
+                                                  monkeypatch):
+    """A server killed mid-request marks the orphaned request FAILED on
+    restart instead of leaving it RUNNING forever (executor.py start()
+    recovery scan; reference: sky/server/requests/executor.py)."""
+    home, port, proc = chaos_server
+    url = f'http://127.0.0.1:{port}'
+    monkeypatch.setenv(constants.API_SERVER_URL_ENV_VAR, url)
+
+    # A request that stays in-flight (detach_run=False waits on the
+    # 300s job) until we crash the whole server host-side.
+    rid = requests.post(f'{url}/launch', json={
+        'task_config': {'run': 'sleep 300',
+                        'resources': {'infra': 'local'}},
+        'cluster_name': 'chaos-c',
+        'detach_run': False,
+    }, timeout=10).json()['request_id']
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        rec = requests.get(f'{url}/api/get',
+                           params={'request_id': rid, 'timeout': 0.1},
+                           timeout=10).json()
+        if rec['status'] == 'RUNNING':
+            break
+        time.sleep(0.5)
+    assert rec['status'] == 'RUNNING'
+
+    # Crash the server AND its worker (workers run in their own process
+    # group and deliberately survive a server-only crash — that is the
+    # in-flight-request-completes path; here we simulate host loss).
+    import sqlite3
+    db = sqlite3.connect(os.path.join(home, 'api_server', 'requests.db'))
+    worker_pid = db.execute(
+        'SELECT pid FROM requests WHERE request_id=?', (rid,)).fetchone()[0]
+    db.close()
+    proc.send_signal(signal.SIGKILL)
+    proc.wait(timeout=10)
+    from skypilot_tpu.utils import subprocess_utils
+    subprocess_utils.kill_process_tree(worker_pid)
+    deadline = time.time() + 10
+    while time.time() < deadline and \
+            subprocess_utils.process_alive(worker_pid):
+        time.sleep(0.2)
+
+    port2 = _free_port()
+    proc2 = _start_server(home, port2)
+    try:
+        url2 = f'http://127.0.0.1:{port2}'
+        rec = requests.get(f'{url2}/api/get',
+                           params={'request_id': rid, 'timeout': 0.1},
+                           timeout=10).json()
+        assert rec['status'] == 'FAILED'
+        assert 'restarted' in json.dumps(rec.get('error', ''))
+        # Best-effort teardown of the half-launched cluster.
+        try:
+            cleanup = requests.post(
+                f'{url2}/down',
+                json={'cluster_name': 'chaos-c', 'purge': True},
+                timeout=10).json()
+            requests.get(f'{url2}/api/get',
+                         params={'request_id': cleanup['request_id'],
+                                 'timeout': 30}, timeout=40)
+        except Exception:  # pylint: disable=broad-except
+            pass
+    finally:
+        proc2.terminate()
+        proc2.wait(timeout=10)
+
+
+@pytest.mark.slow
+def test_api_version_negotiation(chaos_server, monkeypatch):
+    """Version skew contract (reference: sky/server/versions.py):
+    in-range versions negotiate, below-minimum clients get an
+    actionable 400, and responses advertise the server version."""
+    from skypilot_tpu.server import versions
+    _home, port, _proc = chaos_server
+    url = f'http://127.0.0.1:{port}'
+
+    # Matching client: fine, response carries the server version.
+    resp = requests.get(f'{url}/api/health',
+                        headers={versions.HEADER:
+                                 str(versions.API_VERSION)},
+                        timeout=10)
+    assert resp.ok
+    assert resp.headers[versions.HEADER] == str(versions.API_VERSION)
+    # Legacy client without the header: still in range (v1).
+    assert requests.get(f'{url}/api/status', timeout=10).ok
+    # Ancient client below the minimum: rejected with guidance.
+    resp = requests.post(f'{url}/check', json={},
+                         headers={versions.HEADER: '0'}, timeout=10)
+    assert resp.status_code == 400
+    assert 'upgrade the client' in resp.json()['error']
+    # SDK-side check: a too-old server raises.
+    monkeypatch.setattr(versions, 'MIN_COMPATIBLE_API_VERSION', 99)
+    with pytest.raises(exceptions.ApiVersionMismatchError):
+        sdk.api_info(url)
+
+
+@pytest.mark.slow
+def test_dashboard_spa_serves_live_data(chaos_server, monkeypatch):
+    """The dashboard SPA assets load and /dashboard/api/summary carries
+    live cluster data (reference: sky/dashboard)."""
+    home, port, _proc = chaos_server
+    url = f'http://127.0.0.1:{port}'
+    monkeypatch.setenv(constants.API_SERVER_URL_ENV_VAR, url)
+
+    rid = requests.post(f'{url}/launch', json={
+        'task_config': {'run': 'true', 'resources': {'infra': 'local'}},
+        'cluster_name': 'dash-c',
+    }, timeout=10).json()['request_id']
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        rec = requests.get(f'{url}/api/get',
+                           params={'request_id': rid, 'timeout': 5},
+                           timeout=30).json()
+        if rec['status'] in ('SUCCEEDED', 'FAILED'):
+            break
+    assert rec['status'] == 'SUCCEEDED', rec
+
+    page = requests.get(f'{url}/dashboard', timeout=10)
+    assert page.ok and 'app.js' in page.text
+    js = requests.get(f'{url}/dashboard/app.js', timeout=10)
+    assert js.ok and 'summary' in js.text
+    summary = requests.get(f'{url}/dashboard/api/summary',
+                           timeout=10).json()
+    names = [c['name'] for c in summary['clusters']]
+    assert 'dash-c' in names
+    cluster = summary['clusters'][names.index('dash-c')]
+    assert cluster['status'] == 'UP' and cluster['events']
+    assert summary['counts']['clusters'] >= 1
+
+    requests.post(f'{url}/down', json={'cluster_name': 'dash-c'},
+                  timeout=10)
