@@ -146,15 +146,26 @@ def download_command(uri: str, dst: str) -> str:
 
 
 def mount_command(storage: 'Storage', mount_path: str) -> str:
-    """Shell command mounting the bucket at mount_path on a host."""
+    """Shell command mounting the bucket at mount_path on a host.
+
+    GCS mounts via gcsfuse (or rclone for the cached mode); S3 mounts
+    via rclone's :s3: backend with env-provided AWS credentials —
+    the reference uses goofys/mount-s3 for the same role
+    (sky/data/mounting_utils.py)."""
     q = shlex.quote
     url = storage.bucket_url
     bucket = url.split('://', 1)[1].split('/', 1)[0]
     if storage.mode == StorageMode.COPY:
         return download_command(url, mount_path)
-    if storage.store != StoreType.GCS:
-        raise exceptions.StorageModeError(
-            f'MOUNT modes are GCS-only in this build; got {url}.')
+    if storage.store == StoreType.S3:
+        remote = f':s3,env_auth=true:{bucket}'
+        cache = ('--vfs-cache-mode writes --vfs-cache-max-size 10G '
+                 if storage.mode == StorageMode.MOUNT_CACHED else '')
+        return (
+            f'mkdir -p {q(mount_path)} ~/.cache/rclone && '
+            f'(mountpoint -q {q(mount_path)} && echo already mounted) || '
+            f'rclone mount {q(remote)} {q(mount_path)} '
+            f'--daemon {cache}--dir-cache-time 10s')
     if storage.mode == StorageMode.MOUNT:
         return (
             f'mkdir -p {q(mount_path)} && '

@@ -75,13 +75,17 @@ def test_usage_opt_out(isolated_state, monkeypatch):
 
 
 def test_volumes_crud(isolated_state):
+    """Registry CRUD on the Local provider (real backing dir; the GCP
+    PD path is fake-API-tested in test_gce_provisioner)."""
     from skypilot_tpu import exceptions
     from skypilot_tpu.volumes import core as volumes_core
-    volumes_core.apply('data', 500, 'gcp', 'pd-ssd')
+    vol = volumes_core.apply('data', 500, 'local')
+    assert vol['status'] == 'READY' and os.path.isdir(vol['path'])
     rows = volumes_core.ls()
     assert rows[0]['name'] == 'data' and rows[0]['size_gb'] == 500
     volumes_core.delete('data')
     assert volumes_core.ls() == []
+    assert not os.path.isdir(vol['path'])
     with pytest.raises(exceptions.SkyError):
         volumes_core.delete('data')
 
@@ -227,3 +231,46 @@ def test_spot_placer_full_cycle_release():
     assert second != first
     placer.handle_release(first)
     assert not placer.all_hot()
+
+
+def test_cross_cloud_transfer_plans():
+    """Transfer planning (reference: sky/data/data_transfer.py:40-194):
+    small jobs stream via CLI, big S3->GCS jobs become server-side
+    Storage Transfer Service requests."""
+    from skypilot_tpu.data import transfer as transfer_lib
+
+    plan = transfer_lib.transfer('s3://src-b', 'gs://dst-b',
+                                 size_gigabytes=1, run=False)
+    assert plan['method'] == 'stream'
+    assert 'gcloud storage rsync' in plan['command']
+
+    plan = transfer_lib.transfer('s3://src-b', 'gs://dst-b',
+                                 size_gigabytes=500, project_id='proj',
+                                 run=False)
+    assert plan['method'] == 'sts'
+    body = plan['request_body']
+    assert body['transferSpec']['awsS3DataSource']['bucketName'] == 'src-b'
+    assert body['transferSpec']['gcsDataSink']['bucketName'] == 'dst-b'
+    assert body['projectId'] == 'proj'
+
+    # gs->s3 always streams (STS pulls INTO GCS only).
+    plan = transfer_lib.transfer('gs://a', 's3://b', size_gigabytes=500,
+                                 project_id='proj', run=False)
+    assert plan['method'] == 'stream'
+
+    import pytest as _pytest
+    from skypilot_tpu import exceptions as exc
+    with _pytest.raises(exc.StorageSpecError):
+        transfer_lib.transfer('ftp://x', 'gs://y', run=False)
+
+
+def test_s3_mount_commands():
+    from skypilot_tpu.data import storage as storage_lib
+    st = storage_lib.Storage(source='s3://datasets',
+                             mode=storage_lib.StorageMode.MOUNT)
+    cmd = storage_lib.mount_command(st, '/data')
+    assert 'rclone mount' in cmd and ':s3,env_auth=true:datasets' in cmd
+    cached = storage_lib.Storage(
+        source='s3://datasets', mode=storage_lib.StorageMode.MOUNT_CACHED)
+    cmd = storage_lib.mount_command(cached, '/data')
+    assert '--vfs-cache-mode writes' in cmd
