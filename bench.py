@@ -29,8 +29,12 @@ def main() -> None:
     parser.add_argument('--batch', type=int, default=0,
                         help='global batch size (0 = auto)')
     parser.add_argument('--seq', type=int, default=0)
-    parser.add_argument('--retries', type=int, default=4,
-                        help='accelerator-init retries before CPU fallback')
+    parser.add_argument('--retries', type=int, default=1,
+                        help='accelerator probe retries before CPU fallback')
+    parser.add_argument('--init-timeout', type=float, default=420.0,
+                        help='seconds to wait for accelerator backend init '
+                             '(probed in a subprocess: a wedged TPU relay '
+                             'HANGS instead of raising)')
     args = parser.parse_args()
 
     if args.smoke:
@@ -47,29 +51,62 @@ def main() -> None:
     from skypilot_tpu.parallel.train import (ShardedTrainer,
                                              default_optimizer, shard_batch)
 
-    # The axon TPU relay is flaky/single-session: retry backend init with
-    # backoff before giving up and falling back to CPU so the driver always
-    # records *a* number (platform is reported alongside the metric).
-    devices = None
-    for attempt in range(args.retries + 1):
-        try:
-            devices = jax.devices()
-            break
-        except Exception as e:  # pylint: disable=broad-except
-            if attempt == args.retries:
-                print(f'# accelerator init failed after {attempt+1} tries '
-                      f'({type(e).__name__}: {e}); falling back to CPU',
-                      file=sys.stderr)
-                jax.config.update('jax_platforms', 'cpu')
-                devices = jax.devices()
-                break
-            delay = min(60, 5 * 2**attempt)
-            print(f'# accelerator init failed ({type(e).__name__}); '
-                  f'retry {attempt+1}/{args.retries} in {delay}s',
+    # The TPU relay can WEDGE (hang in backend init without raising), so
+    # the probe runs in a killable subprocess with a hard timeout. Only
+    # after the probe proves the backend answers does this process touch
+    # it; otherwise we pin CPU so the driver always gets a JSON line.
+    if not args.smoke:
+        import subprocess
+        probe_ok = False
+        for attempt in range(args.retries + 1):
+            try:
+                probe = subprocess.run(
+                    [sys.executable, '-c',
+                     'import jax; d = jax.devices(); '
+                     'print(d[0].platform, len(d))'],
+                    capture_output=True, text=True,
+                    timeout=args.init_timeout, check=False)
+                if probe.returncode == 0:
+                    print(f'# accelerator probe: {probe.stdout.strip()}',
+                          file=sys.stderr)
+                    probe_ok = True
+                    break
+                print(f'# accelerator probe rc={probe.returncode}: '
+                      f'{probe.stderr[-300:]}', file=sys.stderr)
+            except subprocess.TimeoutExpired:
+                print(f'# accelerator probe hung >{args.init_timeout:.0f}s '
+                      f'(attempt {attempt + 1})', file=sys.stderr)
+            if attempt < args.retries:
+                # A killed mid-claim probe wedges the single-session
+                # relay for minutes; wait it out before re-probing.
+                time.sleep(90)
+        if not probe_ok:
+            print('# accelerator unavailable; falling back to CPU',
                   file=sys.stderr)
-            time.sleep(delay)
+            jax.config.update('jax_platforms', 'cpu')
+        else:
+            # Last line of defense: if the relay wedges BETWEEN the
+            # probe and our own init, re-exec into CPU smoke mode so
+            # the driver still gets a JSON line (execv replaces the
+            # process even while the main thread is stuck in C++).
+            import threading
+
+            def _cpu_reexec():
+                print('# backend init wedged after a healthy probe; '
+                      're-exec in CPU smoke mode', file=sys.stderr)
+                sys.stderr.flush()
+                os.execv(sys.executable,
+                         [sys.executable, os.path.abspath(__file__),
+                          '--smoke', '--steps', str(args.steps)])
+
+            watchdog = threading.Timer(args.init_timeout, _cpu_reexec)
+            watchdog.daemon = True
+            watchdog.start()
+    devices = jax.devices()
     n_dev = len(devices)
     platform = devices[0].platform
+    if not args.smoke and probe_ok:
+        watchdog.cancel()
 
     if args.smoke:
         cfg = GPTConfig.tiny()
