@@ -67,6 +67,12 @@ class AgentState:
         self.home = os.path.abspath(os.path.expanduser(home))
         os.makedirs(self.home, exist_ok=True)
         self.cluster_name = cluster_name
+        try:  # visible to job_driver (log shipping paths)
+            with open(os.path.join(self.home, 'cluster_name'), 'w',
+                      encoding='utf-8') as f:
+                f.write(cluster_name)
+        except OSError:
+            pass
         self.is_head = is_head
         # Per-cluster shared secret, written at provision time. When
         # present, every endpoint except GET /health requires it (the

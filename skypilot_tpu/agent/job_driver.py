@@ -207,7 +207,46 @@ def _finish(jobs: job_lib.JobTable, job_id: int, log_dir: str,
     combined.write(f'[driver] job {job_id} finished: {status.value}\n'
                    .encode())
     combined.close()
+    _ship_logs(os.path.dirname(os.path.dirname(log_dir)), job_id, log_dir)
     return status
+
+
+def _ship_logs(home: str, job_id: int, log_dir: str) -> None:
+    """External log shipping (reference: sky/logs/__init__.py:11-21 —
+    fluentbit/gcp aggregators): when the cluster was provisioned with
+    `logs.store` configured, every finished job's log dir is shipped to
+    `<store>/<cluster>/<job_id>/`. Bucket URLs use the cloud CLI; plain
+    paths copy locally (the e2e substrate)."""
+    store_path = os.path.join(home, 'log_store')
+    try:
+        with open(store_path, 'r', encoding='utf-8') as f:
+            store = f.read().strip()
+    except OSError:
+        return
+    if not store:
+        return
+    try:
+        with open(os.path.join(home, 'cluster_name'), 'r',
+                  encoding='utf-8') as f:
+            cluster = f.read().strip() or 'cluster'
+    except OSError:
+        cluster = os.path.basename(home.rstrip('/')) or 'cluster'
+    dest = f'{store.rstrip("/")}/{cluster}/{job_id}'
+    import shlex
+    import subprocess
+    q = shlex.quote
+    if store.startswith('gs://'):
+        cmd = f'gcloud storage rsync -r {q(log_dir)} {q(dest)}'
+    elif store.startswith('s3://'):
+        cmd = f'aws s3 sync {q(log_dir)} {q(dest)}'
+    else:
+        cmd = f'mkdir -p {q(dest)} && cp -r {q(log_dir)}/. {q(dest)}/'
+    proc = subprocess.run(['bash', '-c', cmd], capture_output=True,
+                          text=True, check=False)
+    if proc.returncode != 0:
+        print(f'[driver] log shipping to {dest} failed '
+              f'(rc={proc.returncode}): {proc.stderr[-300:]}',
+              file=sys.stderr)
 
 
 def main() -> None:

@@ -244,6 +244,22 @@ class RetryingProvisioner:
                         ) -> provision_common.ProvisionRecord:
         cloud = to_provision.cloud
         assert cloud is not None
+        if task.volumes:
+            # Validate volumes BEFORE any cloud call: a typo'd name must
+            # fail with a friendly message, not a provision timeout on
+            # an unresolvable claim.
+            from skypilot_tpu.volumes import core as volumes_core
+            for vol_name in task.volumes.values():
+                if volumes_core.get(vol_name) is None:
+                    raise exceptions.SkyError(
+                        f'Volume {vol_name!r} not found; create it with '
+                        f'`stpu volumes apply {vol_name} --size <gb>` '
+                        'first.')
+            if cloud.canonical_name() == 'kubernetes':
+                # k8s volumes attach at POD CREATION (PVC volumeMounts
+                # in the pod spec), unlike GCP/Local runtime attach.
+                deploy_vars = {**deploy_vars,
+                               'volumes': dict(task.volumes)}
         config = provision_common.ProvisionConfig(
             provider_config=deploy_vars,
             authentication_config={},
@@ -437,6 +453,10 @@ class TpuVmBackend(backend_lib.Backend[TpuVmResourceHandle]):
             # (where `run` commands execute); absolute/~ paths as-is.
             if not mount_path.startswith(('/', '~')):
                 mount_path = f'{constants.SKY_REMOTE_WORKDIR}/{mount_path}'
+            if provider == 'kubernetes':
+                # Already attached at pod creation (PVC volumeMounts in
+                # the pod spec); nothing to do at runtime.
+                continue
             if provider == 'local':
                 for runner in runners:
                     parent = os.path.dirname(mount_path)

@@ -118,10 +118,14 @@ def _add_options(options):
 @click.option('--dryrun', is_flag=True, default=False)
 @click.option('--detach-run', '-d', is_flag=True, default=False)
 @click.option('--no-setup', is_flag=True, default=False)
+@click.option('--optimize-target', type=click.Choice(['cost', 'time']),
+              default='cost', help='Rank candidate hardware by $ or by '
+                                   'estimated runtime.')
 @click.option('--yes', '-y', is_flag=True, default=False)
 def launch(entrypoint, cluster, name, workdir, infra, gpus, cpus, memory,
            num_nodes, use_spot, env, idle_minutes_to_autostop, down,
-           retry_until_up, dryrun, detach_run, no_setup, yes) -> None:
+           retry_until_up, dryrun, detach_run, no_setup, optimize_target,
+           yes) -> None:
     """Launch a task from YAML or a command (provisions a cluster)."""
     task = _build_task(entrypoint, name, workdir, infra, gpus, cpus, memory,
                        num_nodes, use_spot, env)
@@ -134,7 +138,8 @@ def launch(entrypoint, cluster, name, workdir, infra, gpus, cpus, memory,
         task, cluster_name=cluster, dryrun=dryrun,
         detach_run=True,
         idle_minutes_to_autostop=idle_minutes_to_autostop, down=down,
-        retry_until_up=retry_until_up, no_setup=no_setup)
+        retry_until_up=retry_until_up, no_setup=no_setup,
+        optimize_target=optimize_target)
     result = sdk.stream_and_get(request_id)
     if result and result.get('job_id') is not None and not detach_run:
         cname = (result.get('handle') or {}).get('cluster_name') or cluster
@@ -682,6 +687,15 @@ def serve_update_cmd(service_name, entrypoint, name, workdir, infra, gpus,
         click.confirm(f'Update service {service_name}?', abort=True)
     result = sdk.get(sdk.serve_update(task, service_name))
     click.echo(f'Service {service_name} updated to v{result["version"]}.')
+
+
+@serve.command(name='logs')
+@click.argument('service_name')
+@click.option('--no-follow', is_flag=True, default=False)
+def serve_logs_cmd(service_name, no_follow) -> None:
+    """Stream a service's controller log."""
+    sdk.serve_logs(service_name, follow=not no_follow,
+                   output=sys.stdout)
 
 
 @serve.command(name='down')

@@ -250,6 +250,7 @@ def launch(task: 'task_lib.Task', cluster_name: Optional[str] = None,
            idle_minutes_to_autostop: Optional[int] = None,
            down: bool = False, retry_until_up: bool = False,
            no_setup: bool = False,
+           optimize_target: str = 'cost',
            env_overrides: Optional[Dict[str, str]] = None) -> str:
     return _post('/launch', {
         'task_config': task.to_yaml_config(),
@@ -257,6 +258,7 @@ def launch(task: 'task_lib.Task', cluster_name: Optional[str] = None,
         'dryrun': dryrun,
         'detach_run': detach_run,
         'idle_minutes_to_autostop': idle_minutes_to_autostop,
+        'optimize_target': optimize_target,
         'down': down,
         'retry_until_up': retry_until_up,
         'no_setup': no_setup,
@@ -523,3 +525,20 @@ def jobs_group_status(group_name: str) -> str:
 
 def jobs_group_cancel(group_name: str) -> str:
     return _post('/jobs/group/cancel', {'group_name': group_name})
+
+
+def serve_logs(service_name: str, follow: bool = True,
+               output=None) -> None:
+    """Stream a service's controller log."""
+    url = _ensure_server()
+    out = output or sys.stderr
+    with requests.get(f'{url}/serve/logs',
+                      params={'service': service_name,
+                              'follow': '1' if follow else '0'},
+                      headers=_headers(), stream=True,
+                      timeout=(30, None)) as resp:
+        if resp.status_code == 404:
+            raise exceptions.ServiceNotFoundError(service_name)
+        resp.raise_for_status()
+        for line in resp.iter_lines(decode_unicode=True):
+            print(line, file=out, flush=True)

@@ -4,13 +4,10 @@ Reference: sky/jobs/server/ (REST under /jobs/*).
 """
 from __future__ import annotations
 
-import asyncio
-import threading
-
 from aiohttp import web
 
 from skypilot_tpu.agent import log_lib
-from skypilot_tpu.server.route_utils import scheduled_handler
+from skypilot_tpu.server.route_utils import scheduled_handler, stream_lines
 
 _API = 'skypilot_tpu.jobs.core'
 
@@ -29,30 +26,11 @@ async def jobs_logs(request: web.Request) -> web.StreamResponse:
     except Exception:  # pylint: disable=broad-except
         return web.json_response({'error': f'no managed job {job_id}'},
                                  status=404)
-    resp = web.StreamResponse()
-    resp.content_type = 'text/plain'
-    await resp.prepare(request)
-    loop = asyncio.get_event_loop()
-    queue: asyncio.Queue = asyncio.Queue(maxsize=1000)
-
-    def pump() -> None:
-        try:
-            for line in log_lib.tail_logs(
-                    log_path, follow=follow,
-                    stop_condition=lambda: core.is_terminal(job_id)):
-                asyncio.run_coroutine_threadsafe(queue.put(line),
-                                                 loop).result()
-        finally:
-            asyncio.run_coroutine_threadsafe(queue.put(None), loop).result()
-
-    threading.Thread(target=pump, daemon=True).start()
-    while True:
-        line = await queue.get()
-        if line is None:
-            break
-        await resp.write(line.encode('utf-8', errors='replace'))
-    await resp.write_eof()
-    return resp
+    return await stream_lines(
+        request,
+        lambda: log_lib.tail_logs(
+            log_path, follow=follow,
+            stop_condition=lambda: core.is_terminal(job_id)))
 
 
 def register(app: web.Application) -> None:

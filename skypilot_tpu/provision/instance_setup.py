@@ -57,6 +57,16 @@ def setup_agents(cluster_info: provision_common.ClusterInfo,
             f.write(secret)
         os.chmod(secret_src, 0o600)
 
+    # External log shipping destination (reference: sky/logs): agents
+    # ship finished jobs' logs to `logs.store` when configured.
+    from skypilot_tpu import sky_config
+    log_store = sky_config.get_nested(('logs', 'store'))
+    log_store_src = None
+    if log_store:
+        fd, log_store_src = tempfile.mkstemp(prefix='log_store_')
+        with os.fdopen(fd, 'w', encoding='utf-8') as f:
+            f.write(str(log_store))
+
     def bootstrap(pair) -> None:
         inst, runner = pair
         home = constants.SKY_REMOTE_HOME
@@ -66,6 +76,8 @@ def setup_agents(cluster_info: provision_common.ClusterInfo,
                      excludes=['__pycache__'])
         if secret_src is not None:
             runner.rsync(secret_src, f'{home}/agent_secret', up=True)
+        if log_store_src is not None:
+            runner.rsync(log_store_src, f'{home}/log_store', up=True)
         is_head = inst.instance_id == cluster_info.head_instance_id
         cmd = _AGENT_START_TEMPLATE.format(
             home=home,
@@ -83,8 +95,9 @@ def setup_agents(cluster_info: provision_common.ClusterInfo,
         subprocess_utils.run_in_parallel(bootstrap,
                                          list(zip(instances, runners)))
     finally:
-        if secret_src is not None:
-            try:
-                os.remove(secret_src)
-            except OSError:
-                pass
+        for tmp in (secret_src, log_store_src):
+            if tmp is not None:
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass

@@ -87,6 +87,28 @@ def _pod_manifest(cluster: str, pod_name: str, pc: Dict[str, Any],
         'hostname': pod_name,
         'subdomain': cluster,
     }
+    # Named volumes ride the pod spec as PVC mounts (created by
+    # apply_volume below; reference: sky/provision/kubernetes volumes).
+    # Head pod only: the claims are ReadWriteOnce, so mounting them in
+    # every pod of a multi-node cluster would wedge scheduling — this
+    # mirrors the GCP path, which attaches the disk to the head host.
+    volumes = pc.get('volumes') or {}
+    if volumes and node_rank == 0 and host_rank == 0:
+        # The agent bootstrap runs as root in the default image, so the
+        # job workdir (constants.SKY_REMOTE_WORKDIR, '~/...') is under
+        # /root; k8s mountPath must be absolute.
+        workdir = constants.SKY_REMOTE_WORKDIR.replace('~', '/root', 1)
+        spec['volumes'] = []
+        container['volumeMounts'] = []
+        for i, (mount_path, claim) in enumerate(sorted(volumes.items())):
+            if not mount_path.startswith('/'):
+                mount_path = f'{workdir}/{mount_path}'
+            spec['volumes'].append({
+                'name': f'skyvol-{i}',
+                'persistentVolumeClaim': {'claimName': claim},
+            })
+            container['volumeMounts'].append({
+                'name': f'skyvol-{i}', 'mountPath': mount_path})
     if tpu:
         spec['nodeSelector'] = {
             'cloud.google.com/gke-tpu-accelerator':

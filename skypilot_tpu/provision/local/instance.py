@@ -59,6 +59,13 @@ def _start_agent(host: Dict[str, Any], cluster: str,
         with open(sp, 'w', encoding='utf-8') as f:
             f.write(secret)
         os.chmod(sp, 0o600)
+    from skypilot_tpu import sky_config
+    log_store = sky_config.get_nested(('logs', 'store'))
+    if log_store:
+        os.makedirs(agent_home, exist_ok=True)
+        with open(os.path.join(agent_home, 'log_store'), 'w',
+                  encoding='utf-8') as f:
+            f.write(str(log_store))
     cmd = [sys.executable, '-m', 'skypilot_tpu.agent.agent',
            '--port', str(host['agent_port']),
            '--home', agent_home,

@@ -6,13 +6,34 @@ from __future__ import annotations
 
 from aiohttp import web
 
-from skypilot_tpu.server.route_utils import scheduled_handler
+from skypilot_tpu.agent import log_lib
+from skypilot_tpu.server.route_utils import scheduled_handler, stream_lines
 
 _API = 'skypilot_tpu.serve.core'
 
 
 def _schedule(name: str, entrypoint: str, schedule_type: str = 'long'):
     return scheduled_handler(name, entrypoint, schedule_type)
+
+
+async def serve_logs(request: web.Request) -> web.StreamResponse:
+    """Stream a service's controller log (reference: `sky serve logs`)."""
+    from skypilot_tpu.serve import serve_state
+    name = request.query.get('service', '')
+    follow = request.query.get('follow', '1') == '1'
+    record = serve_state.get_service(name)
+    if record is None or not record.get('log_path'):
+        return web.json_response({'error': f'no service {name}'},
+                                 status=404)
+
+    def finished() -> bool:
+        rec = serve_state.get_service(name)
+        return rec is None or rec['status'].is_terminal()
+
+    return await stream_lines(
+        request,
+        lambda: log_lib.tail_logs(record['log_path'], follow=follow,
+                                  stop_condition=finished))
 
 
 def register(app: web.Application) -> None:
@@ -24,3 +45,4 @@ def register(app: web.Application) -> None:
                         _schedule('serve.status', f'{_API}.status', 'short'))
     app.router.add_post('/serve/down',
                         _schedule('serve.down', f'{_API}.down'))
+    app.router.add_get('/serve/logs', serve_logs)

@@ -22,16 +22,27 @@ def launch(task_config: Dict[str, Any],
            down: bool = False,
            retry_until_up: bool = False,
            no_setup: bool = False,
+           optimize_target: str = 'cost',
            env_overrides: Optional[Dict[str, str]] = None,
            secret_overrides: Optional[Dict[str, str]] = None
            ) -> Dict[str, Any]:
+    from skypilot_tpu import exceptions
+    from skypilot_tpu import optimizer as optimizer_lib
+    try:
+        optimizer_lib.OptimizeTarget(optimize_target)
+    except ValueError as e:
+        raise exceptions.InvalidTaskYAMLError(
+            f'optimize_target must be one of '
+            f'{[t.value for t in optimizer_lib.OptimizeTarget]}; '
+            f'got {optimize_target!r}.') from e
     task = task_lib.Task.from_yaml_config(task_config, env_overrides,
                                           secret_overrides)
     job_id, handle = execution.launch(
         task, cluster_name=cluster_name, dryrun=dryrun,
         detach_run=detach_run,
         idle_minutes_to_autostop=idle_minutes_to_autostop, down=down,
-        retry_until_up=retry_until_up, no_setup=no_setup)
+        retry_until_up=retry_until_up, no_setup=no_setup,
+        optimize_target=optimizer_lib.OptimizeTarget(optimize_target))
     return {
         'job_id': job_id,
         'cluster_name': cluster_name,

@@ -9,10 +9,62 @@ before anything is enqueued.
 from __future__ import annotations
 
 import asyncio
+import threading
+from typing import Callable, Iterator
 
 from aiohttp import web
 
 from skypilot_tpu.server.requests import executor
+
+
+async def stream_lines(request: web.Request,
+                       make_lines: Callable[[], Iterator[str]]
+                       ) -> web.StreamResponse:
+    """Stream a blocking line iterator to an HTTP response.
+
+    Disconnect-safe: when the client goes away, the pump thread is
+    signalled and its queue drained so it can never block forever on a
+    full queue (a leaked thread + open fd per disconnected follower).
+    """
+    resp = web.StreamResponse()
+    resp.content_type = 'text/plain'
+    await resp.prepare(request)
+    loop = asyncio.get_event_loop()
+    queue: asyncio.Queue = asyncio.Queue(maxsize=1000)
+    closed = threading.Event()
+
+    def pump() -> None:
+        try:
+            for line in make_lines():
+                if closed.is_set():
+                    break
+                try:
+                    asyncio.run_coroutine_threadsafe(
+                        queue.put(line), loop).result(timeout=60)
+                except Exception:  # pylint: disable=broad-except
+                    break
+        finally:
+            try:
+                asyncio.run_coroutine_threadsafe(
+                    queue.put(None), loop).result(timeout=5)
+            except Exception:  # pylint: disable=broad-except
+                pass
+
+    threading.Thread(target=pump, daemon=True).start()
+    try:
+        while True:
+            line = await queue.get()
+            if line is None:
+                break
+            await resp.write(line.encode('utf-8', errors='replace'))
+        await resp.write_eof()
+    except (ConnectionResetError, asyncio.CancelledError):
+        pass
+    finally:
+        closed.set()
+        while not queue.empty():  # unblock a mid-put pump
+            queue.get_nowait()
+    return resp
 
 
 async def schedule(request: web.Request, name: str, entrypoint: str,

@@ -90,40 +90,21 @@ async def api_get(request: web.Request) -> web.Response:
 
 
 async def api_stream(request: web.Request) -> web.StreamResponse:
+    from skypilot_tpu.server.route_utils import stream_lines
     request_id = request.query.get('request_id', '')
     follow = request.query.get('follow', '1') == '1'
     record = executor.get_request(request_id)
     if record is None:
         return web.json_response({'error': 'request not found'}, status=404)
-    resp = web.StreamResponse()
-    resp.content_type = 'text/plain'
-    await resp.prepare(request)
 
     def finished() -> bool:
         rec = executor.get_request(request_id)
         return rec is None or rec['status'].is_terminal()
 
-    loop = asyncio.get_event_loop()
-    queue: asyncio.Queue = asyncio.Queue(maxsize=1000)
-
-    def pump() -> None:
-        try:
-            for line in log_lib.tail_logs(record['log_path'], follow=follow,
-                                          stop_condition=finished):
-                asyncio.run_coroutine_threadsafe(queue.put(line),
-                                                 loop).result()
-        finally:
-            asyncio.run_coroutine_threadsafe(queue.put(None), loop).result()
-
-    import threading
-    threading.Thread(target=pump, daemon=True).start()
-    while True:
-        line = await queue.get()
-        if line is None:
-            break
-        await resp.write(line.encode('utf-8', errors='replace'))
-    await resp.write_eof()
-    return resp
+    return await stream_lines(
+        request,
+        lambda: log_lib.tail_logs(record['log_path'], follow=follow,
+                                  stop_condition=finished))
 
 
 async def api_cancel(request: web.Request) -> web.Response:
@@ -213,33 +194,16 @@ async def cluster_job_logs(request: web.Request) -> web.StreamResponse:
         if not jobs:
             return web.json_response({'error': 'no jobs'}, status=404)
         job_id = jobs[0]['job_id']
-    resp = web.StreamResponse()
-    resp.content_type = 'text/plain'
-    await resp.prepare(request)
-    loop = asyncio.get_event_loop()
-    queue: asyncio.Queue = asyncio.Queue(maxsize=1000)
 
-    def pump() -> None:
+    def lines():
         try:
-            for line in agent.stream_job_logs(int(job_id), follow=follow,
-                                              tail=tail):
-                asyncio.run_coroutine_threadsafe(queue.put(line),
-                                                 loop).result()
+            yield from agent.stream_job_logs(int(job_id), follow=follow,
+                                             tail=tail)
         except Exception as e:  # pylint: disable=broad-except
-            asyncio.run_coroutine_threadsafe(
-                queue.put(f'[server] log stream error: {e}\n'), loop).result()
-        finally:
-            asyncio.run_coroutine_threadsafe(queue.put(None), loop).result()
+            yield f'[server] log stream error: {e}\n'
 
-    import threading
-    threading.Thread(target=pump, daemon=True).start()
-    while True:
-        line = await queue.get()
-        if line is None:
-            break
-        await resp.write(line.encode('utf-8', errors='replace'))
-    await resp.write_eof()
-    return resp
+    from skypilot_tpu.server.route_utils import stream_lines
+    return await stream_lines(request, lines)
 
 
 def create_app() -> web.Application:

@@ -190,3 +190,10 @@ def test_rbac_tokens_and_enforcement(api_server, monkeypatch):
     monkeypatch.setenv('SKYPILOT_API_TOKEN', bob['token'])
     with pytest.raises(exceptions.PermissionDeniedError):
         sdk.token_ls()
+
+
+@pytest.mark.slow
+def test_serve_logs_route_404(api_server):
+    r = requests.get(f'{api_server}/serve/logs',
+                     params={'service': 'nope'}, timeout=10)
+    assert r.status_code == 404

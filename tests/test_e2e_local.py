@@ -185,3 +185,34 @@ def test_volume_mounts_and_persists(isolated_state):
     core.down('t-vol-r')
     volumes_core.delete('vol1')
     assert volumes_core.ls() == []
+
+
+@pytest.mark.slow
+def test_log_shipping_to_store(isolated_state, monkeypatch, tmp_path):
+    """logs.store config ships finished jobs' logs off-cluster
+    (reference: sky/logs/__init__.py aggregators)."""
+    from skypilot_tpu import check
+    store = tmp_path / 'logstore'
+    cfg = tmp_path / 'cfg.yaml'
+    cfg.write_text(f'logs:\n  store: {store}\n')
+    monkeypatch.setenv('SKYPILOT_TPU_CONFIG', str(cfg))
+    check.check(quiet=True)
+
+    task = sky.Task(run='echo shipped-line')
+    task.set_resources(sky.Resources(infra='local'))
+    _, handle = sky.launch(task, cluster_name='t-ship',
+                           _quiet_optimizer=True)
+    assert handle.agent().wait_job(1, timeout=60) == \
+        job_lib.JobStatus.SUCCEEDED
+    # Driver ships at job finish; give it a beat.
+    deadline = time.time() + 15
+    shipped = None
+    while time.time() < deadline:
+        hits = list(store.glob('*/1/run.log'))
+        if hits:
+            shipped = hits[0]
+            break
+        time.sleep(0.5)
+    assert shipped is not None, list(store.rglob('*'))
+    assert 'shipped-line' in shipped.read_text()
+    core.down('t-ship')

@@ -158,3 +158,19 @@ users:
     kwargs = ctx.request_kwargs()
     assert kwargs['headers']['Authorization'] == 'Bearer tok123'
     assert kwargs['verify'].endswith('.ca.crt')
+
+
+def test_pod_manifest_mounts_pvc_volumes():
+    """Named volumes ride the pod spec as PVC volumeMounts (k8s attach
+    happens at provision, not at runtime)."""
+    from skypilot_tpu.provision.kubernetes import instance as k8s
+    pc = {'tpu_vm': False, 'cpus': 2,
+          'volumes': {'ckpts': 'vol-ckpt', '/abs/data': 'vol-data'}}
+    pod = k8s._pod_manifest('c1', 'c1-pod-0', pc, 0, 0)
+    spec = pod['spec']
+    claims = {v['persistentVolumeClaim']['claimName']
+              for v in spec['volumes']}
+    assert claims == {'vol-ckpt', 'vol-data'}
+    mounts = {m['mountPath'] for m in spec['containers'][0]['volumeMounts']}
+    assert '/abs/data' in mounts
+    assert '/root/sky_workdir/ckpts' in mounts  # relative path anchored
