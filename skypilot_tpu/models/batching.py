@@ -1,0 +1,256 @@
+"""Continuous batching: a slot-based decode engine for LM serving.
+
+JetStream-shaped, TPU-first: all device work is fixed-shape jitted
+functions. A fixed pool of `num_slots` decode slots shares one KV
+cache; requests prefill into a free slot (prompt lengths bucketed to
+limit recompiles) and then ride the shared one-token-per-step decode
+loop, leaving as they finish — new requests join WITHOUT waiting for
+the batch to drain, which is what lifts serving throughput under
+ragged request lengths (the reference orchestrates external engines
+with this property; here the engine is in-framework, over
+models/llama.py's per-row-position KV cache).
+
+Use via `ContinuousBatchingEngine.submit(prompt) -> Future`, or the
+HTTP server in recipes/serve_lm.py (--continuous-batching).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _bucket(n: int, cap: int) -> int:
+    """Next power of two >= n (bounded): limits prefill recompiles."""
+    b = 8
+    while b < n:
+        b *= 2
+    return min(b, cap)
+
+
+class ContinuousBatchingEngine:
+
+    def __init__(self, model, params, *, num_slots: int = 8,
+                 max_total_len: int = 256, temperature: float = 0.0,
+                 eos_id: Optional[int] = None) -> None:
+        assert max_total_len <= model.config.max_seq_len
+        self.model = model
+        self.params = params
+        self.num_slots = num_slots
+        self.max_total_len = max_total_len
+        self.temperature = temperature
+        self.eos_id = eos_id
+
+        import flax.linen as nn
+        cache = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((num_slots, 1), jnp.int32),
+            positions=jnp.zeros((num_slots, 1), jnp.int32), decode=True,
+        )['cache']
+        # init *ran* a step; zero it (same contract as generate.py).
+        self.cache = jax.tree.map(jnp.zeros_like, nn.meta.unbox(cache))
+
+        # Host-side slot bookkeeping (device work stays fixed-shape).
+        self.cur_token = np.zeros((num_slots,), np.int32)
+        self.pos = np.zeros((num_slots,), np.int32)
+        self.active = np.zeros((num_slots,), bool)
+        self.outputs: List[List[int]] = [[] for _ in range(num_slots)]
+        self.futures: List[Optional[Future]] = [None] * num_slots
+        self.limits = np.zeros((num_slots,), np.int32)
+        self.temps = np.zeros((num_slots,), np.float32)
+
+        self._queue: 'queue.Queue' = queue.Queue()
+        self._rng = jax.random.PRNGKey(0)
+        self._prefill_fns: Dict[int, Any] = {}
+        self._decode = self._make_decode_fn()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    # -- jitted device fns --------------------------------------------------
+    def _make_decode_fn(self):
+        model = self.model
+
+        @jax.jit
+        def decode(params, cache, cur_token, pos, temps, rng):
+            logits, mutated = model.apply(
+                {'params': params, 'cache': cache},
+                cur_token[:, None], positions=pos[:, None], decode=True,
+                mutable=['cache'])
+            logits = logits[:, 0]
+            # Per-slot temperature: sampled where temp>0, greedy else.
+            scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+            sampled = jax.random.categorical(rng, scaled, axis=-1)
+            greedy = jnp.argmax(logits, axis=-1)
+            out = jnp.where(temps > 0, sampled, greedy)
+            return mutated['cache'], out.astype(jnp.int32)
+
+        return decode
+
+    def _prefill_fn(self, bucket_len: int):
+        """fn(params, cache, slot, prompt[P], plen) -> (cache, next_tok).
+
+        Scans the (padded) prompt through the model on a batch-1 slice
+        of the slot's cache rows, then scatters the rows back — other
+        slots' caches are untouched, so prefill can interleave with the
+        shared decode loop.
+        """
+        if bucket_len in self._prefill_fns:
+            return self._prefill_fns[bucket_len]
+        model = self.model
+
+        @jax.jit
+        def prefill(params, cache, slot, prompt, plen):
+            row = jax.tree.map(
+                lambda c: jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=0)
+                if c.ndim else c, cache)
+            row = jax.tree.map(
+                lambda c: jnp.zeros_like(c) if c.ndim else c, row)
+
+            def step(row, t):
+                # Steps past the real prompt write junk K/V at
+                # positions >= plen; harmless — each later decode step
+                # overwrites its own position before the mask exposes
+                # it (mask is k_idx <= current pos).
+                tok = jax.lax.dynamic_index_in_dim(
+                    prompt, jnp.minimum(t, plen - 1), keepdims=False)
+                logits, mutated = model.apply(
+                    {'params': params, 'cache': row},
+                    tok[None, None], positions=jnp.full((1, 1), t,
+                                                        jnp.int32),
+                    decode=True, mutable=['cache'])
+                return mutated['cache'], logits[0, 0].astype(jnp.float32)
+
+            row, all_logits = jax.lax.scan(step, row,
+                                           jnp.arange(bucket_len))
+            # The continuation comes from the LAST REAL prompt position
+            # (plen-1), not the padded tail; the caller samples from
+            # these logits so temperature applies to the first
+            # generated token too.
+            last = jax.lax.dynamic_index_in_dim(all_logits, plen - 1,
+                                                axis=0, keepdims=False)
+            cache = jax.tree.map(
+                lambda big, small:
+                jax.lax.dynamic_update_slice_in_dim(big, small, slot,
+                                                    axis=0)
+                if big.ndim else small, cache, row)
+            return cache, last
+
+        self._prefill_fns[bucket_len] = prefill
+        return prefill
+
+    # -- public API ---------------------------------------------------------
+    def submit(self, prompt: List[int],
+               max_new_tokens: int = 64,
+               temperature: Optional[float] = None) -> 'Future':
+        """Queue a request; the Future resolves to the full token list
+        (prompt ++ generated). `temperature` overrides the engine
+        default per request (0 = greedy)."""
+        if len(prompt) >= self.max_total_len:
+            raise ValueError(
+                f'prompt len {len(prompt)} >= max_total_len '
+                f'{self.max_total_len}')
+        temp = self.temperature if temperature is None else temperature
+        fut: Future = Future()
+        self._queue.put((list(prompt), int(max_new_tokens),
+                         float(temp), fut))
+        return fut
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=10)
+
+    # -- scheduler loop -----------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                progressed = self._admit()
+                if self.active.any():
+                    self._decode_step()
+                    progressed = True
+                if not progressed and self._queue.empty():
+                    # Idle: block briefly for the next request.
+                    try:
+                        item = self._queue.get(timeout=0.05)
+                        self._queue.put(item)
+                    except queue.Empty:
+                        pass
+            except Exception as e:  # pylint: disable=broad-except
+                # A device error must not wedge every future forever:
+                # fail the in-flight and queued requests loudly, reset
+                # the slots, keep serving.
+                import traceback
+                traceback.print_exc()
+                for slot in range(self.num_slots):
+                    fut = self.futures[slot]
+                    self.futures[slot] = None
+                    self.active[slot] = False
+                    if fut is not None:
+                        fut.set_exception(e)
+                while not self._queue.empty():
+                    try:
+                        *_rest, fut = self._queue.get_nowait()
+                        fut.set_exception(e)
+                    except queue.Empty:
+                        break
+
+    def _admit(self) -> bool:
+        admitted = False
+        while not self._queue.empty() and not self.active.all():
+            try:
+                prompt, max_new, temp, fut = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            slot = int(np.argmin(self.active))  # first free slot
+            plen = len(prompt)
+            bucket = _bucket(plen, self.max_total_len)
+            prefill = self._prefill_fn(bucket)
+            padded = jnp.asarray(
+                prompt + [0] * (bucket - plen), jnp.int32)
+            self.cache, last_logits = prefill(
+                self.params, self.cache, jnp.int32(slot), padded,
+                jnp.int32(plen))
+            if temp > 0:
+                self._rng, sub = jax.random.split(self._rng)
+                first = jax.random.categorical(sub, last_logits / temp)
+            else:
+                first = jnp.argmax(last_logits)
+            self.cur_token[slot] = int(jax.device_get(first))
+            self.pos[slot] = plen
+            self.outputs[slot] = list(prompt)
+            self.futures[slot] = fut
+            self.limits[slot] = min(plen + max_new, self.max_total_len)
+            self.temps[slot] = temp
+            self.active[slot] = True
+            admitted = True
+        return admitted
+
+    def _decode_step(self) -> None:
+        self._rng, sub = jax.random.split(self._rng)
+        # Inactive slots decode at position 0 as a no-op (their cache
+        # row gets scribbled at position 0; it is zeroed on prefill).
+        self.cache, sampled = self._decode(
+            self.params, self.cache,
+            jnp.asarray(self.cur_token), jnp.asarray(self.pos),
+            jnp.asarray(self.temps), sub)
+        sampled = np.asarray(jax.device_get(sampled))
+        for slot in range(self.num_slots):
+            if not self.active[slot]:
+                continue
+            tok = int(self.cur_token[slot])
+            self.outputs[slot].append(tok)
+            self.pos[slot] += 1
+            self.cur_token[slot] = int(sampled[slot])
+            done = len(self.outputs[slot]) >= int(self.limits[slot])
+            if self.eos_id is not None and tok == self.eos_id:
+                done = True
+            if done:
+                fut = self.futures[slot]
+                self.futures[slot] = None
+                self.active[slot] = False
+                if fut is not None:
+                    fut.set_result(list(self.outputs[slot]))

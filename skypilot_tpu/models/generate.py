@@ -33,7 +33,7 @@ def make_generate_fn(model, max_total_len: int,
             positions=jnp.zeros((batch, 1), jnp.int32), decode=True,
         )['cache']
         import flax.linen as nn
-        # init *ran* a step (cache_index=1, junk at position 0): reset.
+        # init *ran* a step (junk K/V at position 0): reset.
         cache = jax.tree.map(jnp.zeros_like, nn.meta.unbox(cache))
 
         def step(carry, t):

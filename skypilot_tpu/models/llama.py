@@ -110,6 +110,9 @@ class Attention(nn.Module):
         if decode:
             # Incremental decoding: one token in, KV cache carried as
             # flax 'cache' variables (serving path; models/generate.py).
+            # The write index and mask are PER ROW (positions[:, 0]), so
+            # continuous batching can decode slots at different depths
+            # in one step (models/batching.py).
             assert seq == 1, f'decode mode feeds one token, got {seq}'
             cached_k = self.variable(
                 'cache', 'cached_key', jnp.zeros,
@@ -117,15 +120,16 @@ class Attention(nn.Module):
             cached_v = self.variable(
                 'cache', 'cached_value', jnp.zeros,
                 (batch, cfg.max_seq_len, cfg.num_kv_heads, hd), cfg.dtype)
-            cache_index = self.variable(
-                'cache', 'cache_index',
-                lambda: jnp.zeros((), jnp.int32))
-            idx = cache_index.value
-            cached_k.value = jax.lax.dynamic_update_slice(
-                cached_k.value, k.astype(cfg.dtype), (0, idx, 0, 0))
-            cached_v.value = jax.lax.dynamic_update_slice(
-                cached_v.value, v.astype(cfg.dtype), (0, idx, 0, 0))
-            cache_index.value = idx + 1
+            pos = positions[:, 0]  # [B] per-row write index
+
+            def write_row(cache_row, kv_row, p):
+                return jax.lax.dynamic_update_slice(cache_row, kv_row,
+                                                    (p, 0, 0))
+
+            cached_k.value = jax.vmap(write_row)(
+                cached_k.value, k.astype(cfg.dtype), pos)
+            cached_v.value = jax.vmap(write_row)(
+                cached_v.value, v.astype(cfg.dtype), pos)
             k_all = jnp.repeat(cached_k.value,
                                cfg.num_heads // cfg.num_kv_heads, axis=2)
             v_all = jnp.repeat(cached_v.value,
@@ -133,7 +137,8 @@ class Attention(nn.Module):
             scale = 1.0 / (hd ** 0.5)
             s = jnp.einsum('bqhd,bkhd->bhqk', q.astype(jnp.float32),
                            k_all.astype(jnp.float32)) * scale
-            mask = (jnp.arange(cfg.max_seq_len) <= idx)[None, None, None, :]
+            mask = (jnp.arange(cfg.max_seq_len)[None, :] <=
+                    pos[:, None])[:, None, None, :]
             s = jnp.where(mask, s, -jnp.inf)
             p = jax.nn.softmax(s, axis=-1)
             out = jnp.einsum('bhqk,bkhd->bqhd', p,

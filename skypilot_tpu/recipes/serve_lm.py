@@ -1,12 +1,16 @@
 """In-framework LM inference server: the payload of serve replicas.
 
-A minimal JetStream-shaped HTTP server over models/generate.py's
-KV-cache engine: GET / (readiness), POST /generate
+A JetStream-shaped HTTP server: GET / (readiness), POST /generate
 {"tokens": [[...]], "max_new_tokens": N, "temperature": t} →
 {"tokens": [[...]]}. Listens on SKYPILOT_SERVE_PORT (injected by the
-serve controller). Continuous batching is a later-round upgrade; this
-serves one request at a time with a jitted fixed-shape generate fn per
-(batch, total_len) bucket.
+serve controller). Two engines:
+
+  - default: one jitted fixed-shape generate fn per batch bucket
+    (models/generate.py) — simplest, one request at a time;
+  - --continuous-batching: the slot-based engine
+    (models/batching.py) — concurrent requests share the decode
+    loop, joining and leaving without draining the batch (the
+    throughput mode under ragged request lengths).
 
   stpu serve up -y -n llama task.yaml   # run: python -m
       skypilot_tpu.recipes.serve_lm --model llama-tiny
@@ -27,6 +31,10 @@ def main() -> None:
     parser.add_argument('--ckpt-dir', default=None,
                         help='orbax checkpoint to load weights from')
     parser.add_argument('--max-total-len', type=int, default=256)
+    parser.add_argument('--continuous-batching', action='store_true',
+                        help='slot-based engine: concurrent requests '
+                             'share the decode loop')
+    parser.add_argument('--num-slots', type=int, default=8)
     parser.add_argument('--port', type=int,
                         default=int(os.environ.get('SKYPILOT_SERVE_PORT',
                                                    8000)))
@@ -53,6 +61,13 @@ def main() -> None:
             template = TrainState.create(params, optax.sgd(1e-3))
             params = mgr.restore(template).params
             print(f'loaded checkpoint step {mgr.latest_step()}', flush=True)
+
+    engine = None
+    if args.continuous_batching:
+        from skypilot_tpu.models.batching import ContinuousBatchingEngine
+        engine = ContinuousBatchingEngine(
+            model, params, num_slots=args.num_slots,
+            max_total_len=args.max_total_len)
 
     # One jitted fn per (batch, temperature) bucket.
     fns: Dict[Tuple[int, float], object] = {}
@@ -95,6 +110,23 @@ def main() -> None:
                 req = json.loads(self.rfile.read(length))
                 tokens = req['tokens']
                 temperature = float(req.get('temperature', 0.0))
+                if engine is not None:
+                    # Ragged rows welcome: each joins the shared decode
+                    # loop independently, honoring its temperature.
+                    max_new = int(req.get('max_new_tokens',
+                                          args.max_total_len))
+                    for row in tokens:
+                        if len(row) >= args.max_total_len:
+                            raise ValueError(
+                                f'prompt len {len(row)} >= max_total_len '
+                                f'{args.max_total_len}')
+                    futs = [engine.submit([int(t) for t in row],
+                                          max_new_tokens=max_new,
+                                          temperature=temperature)
+                            for row in tokens]
+                    self._json({'tokens':
+                                [f.result(timeout=600) for f in futs]})
+                    return
                 prompt = jnp.asarray(tokens, jnp.int32)
                 if prompt.ndim != 2:
                     raise ValueError('tokens must be [batch, prompt_len]')

@@ -236,6 +236,24 @@ def create_app() -> web.Application:
     from skypilot_tpu.server import dashboard
     dashboard.register(app)
 
+    # Server plugins (reference: sky/server/plugin_hooks.py): modules
+    # named in `api_server.plugins` may register extra routes/hooks.
+    from skypilot_tpu import sky_config
+    import importlib as _importlib
+    for plugin_path in sky_config.get_nested(('api_server',
+                                              'plugins')) or []:
+        try:
+            module = _importlib.import_module(str(plugin_path))
+            register_fn = getattr(module, 'register', None)
+            if register_fn is None:
+                raise AttributeError(
+                    f'plugin {plugin_path} has no register(app)')
+            register_fn(app)
+            print(f'Loaded server plugin {plugin_path}.')
+        except Exception as e:  # pylint: disable=broad-except
+            # A broken plugin must not take the whole server down.
+            print(f'Failed to load server plugin {plugin_path!r}: {e!r}')
+
     from skypilot_tpu.users import core as users_core
     from skypilot_tpu.users import tokens as tokens_lib
 

@@ -197,3 +197,46 @@ def test_serve_logs_route_404(api_server):
     r = requests.get(f'{api_server}/serve/logs',
                      params={'service': 'nope'}, timeout=10)
     assert r.status_code == 404
+
+
+@pytest.mark.slow
+def test_server_plugin_routes(isolated_state, monkeypatch, tmp_path):
+    """api_server.plugins modules get register(app) called at startup
+    (reference: sky/server/plugin_hooks.py)."""
+    plug_dir = tmp_path / 'plugins'
+    plug_dir.mkdir()
+    (plug_dir / 'my_plugin.py').write_text(
+        'from aiohttp import web\n'
+        'async def hello(request):\n'
+        "    return web.json_response({'plugin': 'alive'})\n"
+        'def register(app):\n'
+        "    app.router.add_get('/plugin/hello', hello)\n")
+    cfg = tmp_path / 'cfg.yaml'
+    cfg.write_text('api_server:\n  plugins: [my_plugin]\n')
+
+    port = _free_port()
+    env = dict(os.environ)
+    env['SKYPILOT_TPU_HOME'] = isolated_state
+    env['SKYPILOT_TPU_CONFIG'] = str(cfg)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env['PYTHONPATH'] = f"{repo_root}:{plug_dir}:{env.get('PYTHONPATH', '')}"
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.server.server',
+         '--port', str(port)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    try:
+        url = f'http://127.0.0.1:{port}'
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                if requests.get(f'{url}/api/health', timeout=2).ok:
+                    break
+            except requests.RequestException:
+                pass
+            assert proc.poll() is None, proc.stdout.read().decode()[-1500:]
+            time.sleep(0.3)
+        resp = requests.get(f'{url}/plugin/hello', timeout=10)
+        assert resp.json() == {'plugin': 'alive'}
+    finally:
+        proc.terminate()
+        proc.wait(timeout=15)
