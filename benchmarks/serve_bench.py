@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""Serving benchmark: req/s + TTFT through the LM inference server.
+
+BASELINE.md north-star #4 ('SkyServe req/s + p50 TTFT'). Drives
+recipes/serve_lm.py over HTTP with concurrent closed-loop clients and
+reports request throughput and time-to-first-token percentiles, for
+both engines:
+
+  python benchmarks/serve_bench.py --engine continuous --requests 64
+  python benchmarks/serve_bench.py --engine simple --requests 64
+
+On CPU this exercises the full serving stack with llama-tiny; on a
+TPU host pass --model llama3-8b (weights via --ckpt-dir). Prints one
+JSON line per run.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import socket
+import statistics
+import subprocess
+import sys
+import threading
+import time
+
+import requests
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--engine', choices=['continuous', 'simple'],
+                        default='continuous')
+    parser.add_argument('--model', default='llama-tiny')
+    parser.add_argument('--requests', type=int, default=64)
+    parser.add_argument('--concurrency', type=int, default=8)
+    parser.add_argument('--max-total-len', type=int, default=64)
+    parser.add_argument('--max-new-tokens', type=int, default=24)
+    parser.add_argument('--num-slots', type=int, default=8)
+    parser.add_argument('--ckpt-dir', default=None)
+    args = parser.parse_args()
+
+    port = _free_port()
+    cmd = [sys.executable, '-m', 'skypilot_tpu.recipes.serve_lm',
+           '--model', args.model, '--port', str(port),
+           '--max-total-len', str(args.max_total_len)]
+    if args.engine == 'continuous':
+        cmd += ['--continuous-batching', '--num-slots',
+                str(args.num_slots)]
+    if args.ckpt_dir:
+        cmd += ['--ckpt-dir', args.ckpt_dir]
+    env = dict(os.environ)
+    env['PYTHONPATH'] = f"{REPO}:{env.get('PYTHONPATH', '')}"
+    server = subprocess.Popen(cmd, env=env, stdout=subprocess.DEVNULL,
+                              stderr=subprocess.STDOUT)
+    url = f'http://127.0.0.1:{port}'
+    try:
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            try:
+                info = requests.get(url, timeout=2).json()
+                break
+            except requests.RequestException:
+                time.sleep(1)
+                if server.poll() is not None:
+                    raise RuntimeError('serve_lm died')
+        vocab = int(info['vocab_size'])
+
+        rng = random.Random(0)
+        prompts = [[rng.randrange(1, vocab)
+                    for _ in range(rng.randrange(4, 16))]
+                   for _ in range(args.requests)]
+        # Warm the compile caches (both prefill buckets + decode).
+        requests.post(f'{url}/generate', json={
+            'tokens': [prompts[0]], 'max_new_tokens': 2}, timeout=600)
+
+        latencies = []
+        lock = threading.Lock()
+        queue = list(enumerate(prompts))
+
+        def client() -> None:
+            while True:
+                with lock:
+                    if not queue:
+                        return
+                    _idx, prompt = queue.pop()
+                t0 = time.perf_counter()
+                # TTFT proxy: a 1-token generation round-trip.
+                requests.post(f'{url}/generate', json={
+                    'tokens': [prompt], 'max_new_tokens': 1},
+                    timeout=600).raise_for_status()
+                ttft = time.perf_counter() - t0
+                requests.post(f'{url}/generate', json={
+                    'tokens': [prompt],
+                    'max_new_tokens': args.max_new_tokens},
+                    timeout=600).raise_for_status()
+                total = time.perf_counter() - t0
+                with lock:
+                    latencies.append((ttft, total))
+
+        start = time.perf_counter()
+        threads = [threading.Thread(target=client)
+                   for _ in range(args.concurrency)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - start
+
+        ttfts = sorted(l[0] for l in latencies)
+        print(json.dumps({
+            'engine': args.engine,
+            'model': args.model,
+            'requests': len(latencies),
+            'concurrency': args.concurrency,
+            'req_per_sec': round(len(latencies) / elapsed, 2),
+            'p50_ttft_ms': round(
+                1000 * statistics.median(ttfts), 1),
+            'p95_ttft_ms': round(
+                1000 * ttfts[int(0.95 * (len(ttfts) - 1))], 1),
+        }))
+    finally:
+        server.terminate()
+        try:
+            server.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            server.kill()
+
+
+if __name__ == '__main__':
+    main()

@@ -15,6 +15,7 @@ HTTP server in recipes/serve_lm.py (--continuous-batching).
 """
 from __future__ import annotations
 
+import functools
 import queue
 import threading
 from concurrent.futures import Future
@@ -75,7 +76,10 @@ class ContinuousBatchingEngine:
     def _make_decode_fn(self):
         model = self.model
 
-        @jax.jit
+        # Donate the cache: the caller always replaces self.cache with
+        # the result, so XLA updates in place instead of copying the
+        # full KV cache every token (no-op on CPU, vital on TPU).
+        @functools.partial(jax.jit, donate_argnums=(1,))
         def decode(params, cache, cur_token, pos, temps, rng):
             logits, mutated = model.apply(
                 {'params': params, 'cache': cache},
@@ -103,7 +107,7 @@ class ContinuousBatchingEngine:
             return self._prefill_fns[bucket_len]
         model = self.model
 
-        @jax.jit
+        @functools.partial(jax.jit, donate_argnums=(1,))
         def prefill(params, cache, slot, prompt, plen):
             row = jax.tree.map(
                 lambda c: jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=0)
@@ -205,7 +209,14 @@ class ContinuousBatchingEngine:
                 prompt, max_new, temp, fut = self._queue.get_nowait()
             except queue.Empty:
                 break
+            if max_new <= 0:
+                fut.set_result(list(prompt))  # nothing to generate
+                continue
             slot = int(np.argmin(self.active))  # first free slot
+            # Claim the slot BEFORE any device work: if prefill raises,
+            # the loop's exception handler finds (and fails) this
+            # future instead of leaving the client hanging.
+            self.futures[slot] = fut
             plen = len(prompt)
             bucket = _bucket(plen, self.max_total_len)
             prefill = self._prefill_fn(bucket)
@@ -222,7 +233,6 @@ class ContinuousBatchingEngine:
             self.cur_token[slot] = int(jax.device_get(first))
             self.pos[slot] = plen
             self.outputs[slot] = list(prompt)
-            self.futures[slot] = fut
             self.limits[slot] = min(plen + max_new, self.max_total_len)
             self.temps[slot] = temp
             self.active[slot] = True
