@@ -66,6 +66,7 @@ def main() -> None:
     url = f'http://127.0.0.1:{port}'
     try:
         deadline = time.time() + 300
+        info = None
         while time.time() < deadline:
             try:
                 info = requests.get(url, timeout=2).json()
@@ -74,6 +75,8 @@ def main() -> None:
                 time.sleep(1)
                 if server.poll() is not None:
                     raise RuntimeError('serve_lm died')
+        if info is None:
+            raise RuntimeError('serve_lm not ready within 300s')
         vocab = int(info['vocab_size'])
 
         rng = random.Random(0)

@@ -471,7 +471,43 @@ def jobs() -> None:
 @click.option('--yes', '-y', is_flag=True, default=False)
 def jobs_launch_cmd(entrypoint, name, workdir, infra, gpus, cpus, memory,
                     num_nodes, use_spot, env, pool, detach_run, yes) -> None:
-    """Launch a managed job (survives preemption via auto-recovery)."""
+    """Launch a managed job (survives preemption via auto-recovery).
+
+    A YAML with multiple documents is a PIPELINE: stages run
+    sequentially, one cluster each, with per-stage recovery."""
+    stages = None
+    if entrypoint and (entrypoint.endswith(('.yaml', '.yml')) and
+                       os.path.exists(os.path.expanduser(entrypoint))):
+        docs = [c for c in common_utils.read_yaml_all(
+            os.path.expanduser(entrypoint)) if c]
+        if len(docs) > 1:
+            # Per-stage resources come from the YAML; resource flags
+            # would be ambiguous (which stage?) — reject instead of
+            # silently ignoring them. --env applies to every stage.
+            if any(v for v in (workdir, infra, gpus, cpus, memory,
+                               num_nodes)) or use_spot is not None:
+                raise click.UsageError(
+                    'Pipelines take per-stage resources from the YAML; '
+                    '--workdir/--infra/--gpus/--cpus/--memory/'
+                    '--num-nodes/--use-spot do not apply.')
+            env_overrides = _parse_env(list(env or []))
+            from skypilot_tpu import task as task_lib
+            stages = [task_lib.Task.from_yaml_config(d, env_overrides)
+                      for d in docs]
+    if stages is not None:
+        if not yes:
+            click.confirm(
+                f'Launch {len(stages)}-stage pipeline '
+                f'({", ".join(t.name or "?" for t in stages)})?',
+                default=True, abort=True)
+        result = sdk.get(sdk.jobs_launch(
+            stages, name=name or stages[0].name, pool=pool))
+        job_id = result['job_id']
+        click.echo(f'Managed pipeline {job_id} submitted '
+                   f'({len(stages)} stages).')
+        if not detach_run:
+            sdk.jobs_logs(job_id)
+        return
     task = _build_task(entrypoint, name, workdir, infra, gpus, cpus, memory,
                        num_nodes, use_spot, env)
     if not yes:

@@ -358,10 +358,16 @@ def tail_logs(cluster_name: str, job_id: Optional[int] = None,
 # ---------------------------------------------------------------------------
 # Managed jobs
 # ---------------------------------------------------------------------------
-def jobs_launch(task: 'task_lib.Task', name: Optional[str] = None,
+def jobs_launch(task, name: Optional[str] = None,
                 pool: Optional[str] = None) -> str:
+    """`task` may be a single Task or a LIST of Tasks (a pipeline:
+    stages run sequentially, one cluster each)."""
+    if isinstance(task, (list, tuple)):
+        config = [t.to_yaml_config() for t in task]
+    else:
+        config = task.to_yaml_config()
     return _post('/jobs/launch', {
-        'task_config': task.to_yaml_config(),
+        'task_config': config,
         'name': name,
         'user': common_utils.get_user_name(),
         'pool': pool,

@@ -49,14 +49,39 @@ class JobController:
         assert record is not None, job_id
         self.record = record
         self.adopt = adopt
-        self.cluster_name = record['cluster_name']
+        self.base_cluster_name = record['cluster_name']
         self.pooled = bool(record.get('pool'))
         self.group = record.get('job_group')
-        self.task = task_lib.Task.from_yaml_config(record['task_config'])
-        self.executor = recovery_strategy.StrategyExecutor.make(
-            self.cluster_name, self.task)
+        # Pipelines (reference: `sky jobs launch pipeline.yaml`): a
+        # list task_config runs stages sequentially, one cluster each.
+        cfg = record['task_config']
+        self.stage_configs = cfg if isinstance(cfg, list) else [cfg]
+        self._enter_stage(int(record.get('stage') or 0))
         self._cancelled = False
         signal.signal(signal.SIGTERM, self._handle_term)
+
+    def _enter_stage(self, stage: int) -> None:
+        self.stage = stage
+        cfg = self.stage_configs[stage]
+        self.cluster_name = (
+            self.base_cluster_name if len(self.stage_configs) == 1
+            else f'{self.base_cluster_name}-s{stage}')
+        if self.pooled:
+            self.cluster_name = self.base_cluster_name  # pool worker
+        self.task = task_lib.Task.from_yaml_config(cfg)
+        self.executor = recovery_strategy.StrategyExecutor.make(
+            self.cluster_name, self.task)
+        # Per-stage restart budget: each stage's own
+        # job_recovery.max_restarts_on_errors governs it (a pipeline's
+        # later stages must not inherit stage 0's setting or pay for
+        # restarts an earlier stage consumed).
+        self.stage_max_restarts = self.record['max_restarts_on_errors']
+        for r in self.task.resources:
+            if r.job_recovery:
+                self.stage_max_restarts = int(
+                    r.job_recovery.get('max_restarts_on_errors', 0))
+                break
+        self._stage_restarts = 0
 
     def _handle_term(self, signum, frame):  # noqa: ARG002
         self._cancelled = True
@@ -67,10 +92,11 @@ class JobController:
         try:
             if self.adopt:
                 agent_job_id = self._adopt()
+                final = self._monitor_loop(agent_job_id)
+                if final == state.ManagedJobStatus.SUCCEEDED:
+                    final = self._run_stages(self.stage + 1)
             else:
-                state.set_status(job_id, state.ManagedJobStatus.STARTING)
-                agent_job_id = self._launch(first=True)
-            final = self._monitor_loop(agent_job_id)
+                final = self._run_stages(self.stage)
         except JobCancelled:
             self._cleanup(cancel_job=True)
             state.set_status(job_id, state.ManagedJobStatus.CANCELLED)
@@ -87,6 +113,25 @@ class JobController:
             return state.ManagedJobStatus.FAILED_CONTROLLER
         state.set_status(job_id, final)
         return final
+
+    def _run_stages(self, start_stage: int) -> state.ManagedJobStatus:
+        """Execute stages sequentially from `start_stage`; each stage
+        gets its own cluster, recovery budget, and cleanup."""
+        for stage in range(start_stage, len(self.stage_configs)):
+            if stage != self.stage:
+                self._enter_stage(stage)
+            state.set_stage(self.job_id, stage)
+            if len(self.stage_configs) > 1:
+                ux_utils.log(
+                    f'Managed job {self.job_id}: stage '
+                    f'{stage + 1}/{len(self.stage_configs)} '
+                    f'({self.task.name or "unnamed"}).')
+            state.set_status(self.job_id, state.ManagedJobStatus.STARTING)
+            agent_job_id = self._launch(first=True)
+            final = self._monitor_loop(agent_job_id)
+            if final != state.ManagedJobStatus.SUCCEEDED:
+                return final
+        return state.ManagedJobStatus.SUCCEEDED
 
     # ------------------------------------------------------------------
     def _adopt(self) -> int:
@@ -201,17 +246,26 @@ class JobController:
             if status is None or not status.is_terminal():
                 continue
             if status == agent_job_lib.JobStatus.SUCCEEDED:
+                # Pipelines: persist the advance BEFORE cleanup — a
+                # controller crash in between must make the adopted
+                # controller resume at the NEXT stage, never re-run a
+                # succeeded stage's side effects.
+                if self.stage + 1 < len(self.stage_configs):
+                    state.set_stage(job_id, self.stage + 1)
+                    state.set_agent_job_id(job_id, -1)
                 self._cleanup(cancel_job=False)
                 return state.ManagedJobStatus.SUCCEEDED
             if status == agent_job_lib.JobStatus.CANCELLED:
                 return state.ManagedJobStatus.CANCELLED
-            # User-code failure: restart if budget remains, else fail.
-            restarts = state.bump_recovery(job_id)
-            max_restarts = self.record['max_restarts_on_errors']
-            if restarts <= max_restarts:
+            # User-code failure: restart if this STAGE's budget remains
+            # (recovery_count stays the job-wide visible total).
+            state.bump_recovery(job_id)
+            self._stage_restarts += 1
+            max_restarts = self.stage_max_restarts
+            if self._stage_restarts <= max_restarts:
                 ux_utils.log(
                     f'Managed job {job_id}: user failure; restart '
-                    f'{restarts}/{max_restarts}.')
+                    f'{self._stage_restarts}/{max_restarts}.')
                 agent_job_id = self._launch(first=False)
                 state.set_status(job_id, state.ManagedJobStatus.RUNNING)
                 continue

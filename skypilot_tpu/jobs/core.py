@@ -11,20 +11,31 @@ from skypilot_tpu.jobs import scheduler
 from skypilot_tpu.jobs import state
 
 
-def launch(task_config: Dict[str, Any], name: Optional[str] = None,
+def launch(task_config, name: Optional[str] = None,
            user: Optional[str] = None,
            pool: Optional[str] = None) -> Dict[str, Any]:
     """Submit a managed job; returns its id immediately. With `pool`,
     the job borrows a pre-provisioned pool worker instead of
-    cold-launching a cluster."""
+    cold-launching a cluster. A LIST of task configs is a pipeline
+    (reference: `sky jobs launch pipeline.yaml`): stages run
+    sequentially, one cluster each, with per-stage recovery."""
     if pool is not None:
         from skypilot_tpu.jobs import pools as pools_lib
         if pools_lib.get(pool) is None:
             raise exceptions.SkyError(
                 f'Pool {pool!r} not found; `stpu jobs pool apply` first.')
-    # Validate the task config early (fail fast in the request).
+        if isinstance(task_config, list) and len(task_config) > 1:
+            raise exceptions.SkyError(
+                'Pipelines and pools do not combine: each stage needs '
+                'its own cluster lifecycle.')
+    # Validate every stage config early (fail fast in the request).
     from skypilot_tpu import task as task_lib
-    task = task_lib.Task.from_yaml_config(dict(task_config))
+    stages = (task_config if isinstance(task_config, list)
+              else [task_config])
+    if not stages:
+        raise exceptions.SkyError('Pipeline needs at least one task.')
+    tasks = [task_lib.Task.from_yaml_config(dict(cfg)) for cfg in stages]
+    task = tasks[0]
     max_restarts = 0
     strategy = 'default'
     for r in task.resources:
@@ -65,6 +76,9 @@ def queue(refresh: bool = False,
             'user': j['user'],
             'pool': j.get('pool'),
             'pool_worker': j.get('pool_worker'),
+            'stage': (f"{int(j.get('stage') or 0) + 1}"
+                      f"/{len(j['task_config'])}"
+                      if isinstance(j['task_config'], list) else None),
         })
     return out
 

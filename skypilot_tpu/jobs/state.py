@@ -89,6 +89,8 @@ def _migrated_db_for(path: str) -> db_utils.SQLiteDB:
             # Job groups:
             ('job_group', 'TEXT'),
             ('head_ip', 'TEXT'),
+            # Pipelines (multi-stage managed jobs):
+            ('stage', 'INTEGER DEFAULT 0'),
             # Pools:
             ('pool', 'TEXT'),
             ('pool_worker', 'TEXT')):
@@ -195,6 +197,13 @@ def bump_adopt_attempts(job_id: int) -> int:
     row = _db().query_one('SELECT adopt_attempts FROM managed_jobs '
                           'WHERE job_id=?', (job_id,))
     return int(row['adopt_attempts']) if row else 0
+
+
+def set_stage(job_id: int, stage: int) -> None:
+    """Pipelines: persist which stage the controller is executing so a
+    re-adopted controller resumes mid-pipeline."""
+    _db().execute('UPDATE managed_jobs SET stage=? WHERE job_id=?',
+                  (stage, job_id))
 
 
 def reset_adopt_attempts(job_id: int) -> None:

@@ -47,13 +47,7 @@ class ContinuousBatchingEngine:
         self.temperature = temperature
         self.eos_id = eos_id
 
-        import flax.linen as nn
-        cache = model.init(
-            jax.random.PRNGKey(0), jnp.zeros((num_slots, 1), jnp.int32),
-            positions=jnp.zeros((num_slots, 1), jnp.int32), decode=True,
-        )['cache']
-        # init *ran* a step; zero it (same contract as generate.py).
-        self.cache = jax.tree.map(jnp.zeros_like, nn.meta.unbox(cache))
+        self.cache = self._fresh_cache()
 
         # Host-side slot bookkeeping (device work stays fixed-shape).
         self.cur_token = np.zeros((num_slots,), np.int32)
@@ -71,6 +65,19 @@ class ContinuousBatchingEngine:
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
+
+    def _fresh_cache(self):
+        """Zeroed KV cache for the slot pool. Also the recovery path:
+        prefill/decode DONATE the cache buffer, so after a failed
+        device execution the old buffer is gone and must be rebuilt."""
+        import flax.linen as nn
+        cache = self.model.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((self.num_slots, 1), jnp.int32),
+            positions=jnp.zeros((self.num_slots, 1), jnp.int32),
+            decode=True)['cache']
+        # init *ran* a step; zero it (same contract as generate.py).
+        return jax.tree.map(jnp.zeros_like, nn.meta.unbox(cache))
 
     # -- jitted device fns --------------------------------------------------
     def _make_decode_fn(self):
@@ -186,15 +193,23 @@ class ContinuousBatchingEngine:
             except Exception as e:  # pylint: disable=broad-except
                 # A device error must not wedge every future forever:
                 # fail the in-flight and queued requests loudly, reset
-                # the slots, keep serving.
+                # the slots AND the (donated, now-invalid) cache, keep
+                # serving.
                 import traceback
                 traceback.print_exc()
+                try:
+                    self.cache = self._fresh_cache()
+                except Exception:  # pylint: disable=broad-except
+                    traceback.print_exc()  # device truly gone
                 for slot in range(self.num_slots):
                     fut = self.futures[slot]
                     self.futures[slot] = None
                     self.active[slot] = False
                     if fut is not None:
                         fut.set_exception(e)
+                self.pos[:] = 0
+                self.cur_token[:] = 0
+                self.temps[:] = 0
                 while not self._queue.empty():
                     try:
                         *_rest, fut = self._queue.get_nowait()

@@ -155,13 +155,13 @@ class Block(nn.Module):
     config: MixtralConfig
 
     @nn.compact
-    def __call__(self, x: jax.Array,
-                 positions: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    def __call__(self, x: jax.Array, positions: jax.Array,
+                 decode: bool = False) -> Tuple[jax.Array, jax.Array]:
         cfg = self.config
         lcfg = cfg.as_llama()
         x = x + llama_lib.Attention(lcfg, name='attn')(
             llama_lib.RMSNorm(cfg.norm_eps, cfg.dtype, name='attn_norm')(x),
-            positions)
+            positions, decode=decode)
         moe_out, aux = MoEFeedForward(cfg, name='moe')(
             llama_lib.RMSNorm(cfg.norm_eps, cfg.dtype, name='moe_norm')(x))
         x = x + moe_out
@@ -175,8 +175,11 @@ class Mixtral(nn.Module):
 
     @nn.compact
     def __call__(self, tokens: jax.Array,
-                 positions: Optional[jax.Array] = None
-                 ) -> Tuple[jax.Array, jax.Array]:
+                 positions: Optional[jax.Array] = None,
+                 decode: bool = False):
+        """Training: (logits, aux_loss). decode=True (serving): logits
+        only — the KV-cache path of the shared llama attention, so the
+        generate/continuous-batching engines drive Mixtral unchanged."""
         cfg = self.config
         batch, seq = tokens.shape
         if positions is None:
@@ -191,10 +194,12 @@ class Mixtral(nn.Module):
 
         block = Block
         if cfg.remat:
+            assert not decode, 'remat is a training-path option'
             block = nn.remat(Block, prevent_cse=False)
         total_aux = jnp.zeros((), jnp.float32)
         for i in range(cfg.num_layers):
-            x, aux = block(cfg, name=f'layer_{i}')(x, positions)
+            x, aux = block(cfg, name=f'layer_{i}')(x, positions,
+                                                   decode=decode)
             total_aux = total_aux + aux
         x = llama_lib.RMSNorm(cfg.norm_eps, cfg.dtype, name='final_norm')(x)
         head = self.param(
@@ -205,6 +210,8 @@ class Mixtral(nn.Module):
         logits = jnp.einsum('bse,ev->bsv', x.astype(jnp.float32), head)
         logits = nn.with_logical_constraint(logits,
                                             ('batch', 'seq', 'vocab'))
+        if decode:
+            return logits  # aux loss is a training-only signal
         aux_loss = cfg.router_aux_loss_weight * total_aux / cfg.num_layers
         return logits, aux_loss
 

@@ -230,3 +230,53 @@ def test_job_group_atomic_launch_and_peer_addresses(jobs_env):
     with pytest.raises(Exception):
         jobs_core.group_launch('rlx', [member('a'), member('a')],
                                user='t')
+
+
+@pytest.mark.slow
+def test_pipeline_runs_stages_sequentially(jobs_env, tmp_path):
+    """A list task_config is a pipeline: stages run in order, each on
+    its own cluster, and stage N+1 only starts after N succeeds
+    (reference: `sky jobs launch pipeline.yaml`)."""
+    marker = tmp_path / 'order.txt'
+
+    def stage(name, line):
+        return {'name': name, 'resources': {'infra': 'local'},
+                'run': f'echo {line} >> {marker}'}
+
+    result = jobs_core.launch(
+        [stage('prep', 'one'), stage('train', 'two'),
+         stage('eval', 'three')], user='t')
+    job_id = result['job_id']
+    final = _wait_status(job_id, [state.ManagedJobStatus.SUCCEEDED,
+                                  state.ManagedJobStatus.FAILED,
+                                  state.ManagedJobStatus.FAILED_CONTROLLER],
+                         timeout=240)
+    assert final == state.ManagedJobStatus.SUCCEEDED
+    assert marker.read_text().split() == ['one', 'two', 'three']
+    job = state.get_job(job_id)
+    assert int(job['stage']) == 2  # finished on the last stage
+    # Every stage cluster cleaned up.
+    from skypilot_tpu import global_state
+    assert all(global_state.get_cluster(f'managed-{job_id}-s{k}') is None
+               for k in range(3))
+
+
+@pytest.mark.slow
+def test_pipeline_stops_at_failing_stage(jobs_env, tmp_path):
+    marker = tmp_path / 'failorder.txt'
+    stages = [
+        {'name': 'ok', 'resources': {'infra': 'local'},
+         'run': f'echo ran >> {marker}'},
+        {'name': 'boom', 'resources': {'infra': 'local'}, 'run': 'exit 3'},
+        {'name': 'never', 'resources': {'infra': 'local'},
+         'run': f'echo never >> {marker}'},
+    ]
+    result = jobs_core.launch(stages, user='t')
+    final = _wait_status(result['job_id'],
+                         [state.ManagedJobStatus.SUCCEEDED,
+                          state.ManagedJobStatus.FAILED,
+                          state.ManagedJobStatus.FAILED_CONTROLLER],
+                         timeout=240)
+    assert final == state.ManagedJobStatus.FAILED
+    assert marker.read_text().split() == ['ran']  # stage 3 never ran
+    assert int(state.get_job(result['job_id'])['stage']) == 1

@@ -17,13 +17,17 @@ _EXAMPLES = sorted(glob.glob(os.path.join(
 def test_example_parses(path, monkeypatch):
     monkeypatch.setenv('CKPT_DIR', '/tmp/x')
     monkeypatch.setenv('CKPT_BUCKET', 'gs://x')
-    config = common_utils.read_yaml(path)
-    task = task_lib.Task.from_yaml_config(config)
-    assert task.run, path
-    resources = next(iter(task.resources))
-    assert resources.cloud is not None
-    if 'serve' in os.path.basename(path):
-        assert task.service is not None
+    # Multi-document YAML = a managed-job pipeline: every stage must
+    # parse as its own task.
+    configs = [c for c in common_utils.read_yaml_all(path) if c]
+    assert configs, path
+    for config in configs:
+        task = task_lib.Task.from_yaml_config(config)
+        assert task.run, path
+        resources = next(iter(task.resources))
+        assert resources.cloud is not None
+        if 'serve' in os.path.basename(path):
+            assert task.service is not None
 
 
 def test_examples_exist():

@@ -109,3 +109,31 @@ def test_continuous_batching_matches_batch_generate():
         # Compare the generated continuation (engine stops at
         # max_total; scan engine pads to max_total identically).
         assert got == want[:len(got)], (p, got, want)
+
+
+@pytest.mark.slow
+def test_mixtral_kv_decode_matches_full_forward():
+    """Mixtral serving path: incremental KV-cache decode must produce
+    the same greedy tokens as re-running the full (training-path)
+    forward over the growing prefix."""
+    from skypilot_tpu.models.mixtral import Mixtral, MixtralConfig
+
+    cfg = MixtralConfig.tiny(dtype=jnp.float32)
+    model = Mixtral(cfg)
+    params = nn.meta.unbox(model.init(
+        jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))['params'])
+
+    prompt = [7, 3, 11, 42]
+    max_total = 12
+
+    # Reference rollout: full forward (decode=False) per step.
+    seq = list(prompt)
+    for _ in range(max_total - len(prompt)):
+        logits, _aux = model.apply(
+            {'params': params}, jnp.asarray([seq], jnp.int32))
+        seq.append(int(jnp.argmax(logits[0, -1])))
+
+    fn = gen.make_generate_fn(model, max_total, temperature=0.0)
+    out = fn(params, jnp.asarray([prompt], jnp.int32),
+             jax.random.PRNGKey(1))
+    assert np.asarray(out)[0].tolist() == seq
