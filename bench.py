@@ -188,6 +188,7 @@ def main() -> None:
     base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              'BENCH_BASELINE.json')
     baseline = None
+    recorded = {}
     if os.path.exists(base_path):
         with open(base_path, 'r', encoding='utf-8') as f:
             recorded = json.load(f)
@@ -203,13 +204,18 @@ def main() -> None:
         'unit': 'tokens/s/chip',
         'vs_baseline': round(vs_baseline, 3),
     }
-    # First successful real-TPU run becomes the recorded baseline that
-    # later rounds are scored against.
-    if platform == 'tpu' and baseline is None:
-        with open(base_path, 'w', encoding='utf-8') as f:
-            json.dump({**result, 'platform': platform,
-                       'mfu': round(mfu, 4) if mfu is not None else None,
-                       'batch': batch, 'seq': seq}, f, indent=1)
+    # First successful run on each platform becomes the recorded
+    # baseline later rounds are scored against (comparisons are
+    # platform-matched above; a TPU run REPLACES a CPU-only baseline).
+    if baseline is None:
+        recorded_platform = recorded.get('platform')
+        if recorded_platform is None or (platform == 'tpu' and
+                                         recorded_platform != 'tpu'):
+            with open(base_path, 'w', encoding='utf-8') as f:
+                json.dump({**result, 'platform': platform,
+                           'mfu': round(mfu, 4) if mfu is not None
+                           else None,
+                           'batch': batch, 'seq': seq}, f, indent=1)
     # Extra context on stderr (driver reads the stdout JSON line only).
     print(f'# platform={platform} n_dev={n_dev} batch={batch} seq={seq} '
           f'steps={args.steps} elapsed={elapsed:.2f}s '

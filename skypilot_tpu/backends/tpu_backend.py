@@ -457,6 +457,13 @@ class TpuVmBackend(backend_lib.Backend[TpuVmResourceHandle]):
                 # Already attached at pod creation (PVC volumeMounts in
                 # the pod spec); nothing to do at runtime.
                 continue
+            if provider == 'gcp' and \
+                    handle.cluster_info.provider_config.get('tpu_vm'):
+                raise exceptions.SkyError(
+                    'TPU slices take disks at node creation, not at '
+                    'runtime — use a GCS bucket mount (file_mounts with '
+                    'gs://...) for checkpoints on TPU clusters; named '
+                    'volumes attach to GCE VM and Kubernetes clusters.')
             if provider == 'local':
                 for runner in runners:
                     parent = os.path.dirname(mount_path)

@@ -622,11 +622,13 @@ def jobs_queue_cmd(refresh, skip_finished) -> None:
     from rich.console import Console
     from rich.table import Table
     table = Table(box=None)
-    for col in ('ID', 'NAME', 'CLUSTER', 'STATUS', 'RECOVERIES', 'ERROR'):
+    for col in ('ID', 'NAME', 'CLUSTER', 'STAGE', 'STATUS', 'RECOVERIES',
+                'ERROR'):
         table.add_column(col)
     for j in rows:
         table.add_row(str(j['job_id']), j.get('name') or '-',
-                      j.get('cluster_name') or '-', j['status'],
+                      j.get('cluster_name') or '-',
+                      j.get('stage') or '-', j['status'],
                       str(j['recovery_count']),
                       (j.get('last_error') or '')[:40])
     Console().print(table)

@@ -74,8 +74,11 @@ class JobController:
         # Per-stage restart budget: each stage's own
         # job_recovery.max_restarts_on_errors governs it (a pipeline's
         # later stages must not inherit stage 0's setting or pay for
-        # restarts an earlier stage consumed).
-        self.stage_max_restarts = self.record['max_restarts_on_errors']
+        # restarts an earlier stage consumed). The job-record value
+        # only applies to single-task jobs.
+        self.stage_max_restarts = (
+            self.record['max_restarts_on_errors']
+            if len(self.stage_configs) == 1 else 0)
         for r in self.task.resources:
             if r.job_recovery:
                 self.stage_max_restarts = int(
@@ -90,6 +93,7 @@ class JobController:
     def run(self) -> state.ManagedJobStatus:
         job_id = self.job_id
         try:
+            self._reap_stale_stage_clusters(self.stage)
             if self.adopt:
                 agent_job_id = self._adopt()
                 final = self._monitor_loop(agent_job_id)
@@ -132,6 +136,25 @@ class JobController:
             if final != state.ManagedJobStatus.SUCCEEDED:
                 return final
         return state.ManagedJobStatus.SUCCEEDED
+
+    def _reap_stale_stage_clusters(self, current_stage: int) -> None:
+        """The stage pointer advances BEFORE the finished stage's
+        cluster teardown (crash-safety for side effects); if the
+        controller died inside that window, the finished stage's
+        cluster is still up — tear it down here on resume."""
+        if len(self.stage_configs) == 1 or self.pooled:
+            return
+        from skypilot_tpu import core as sky_core
+        for k in range(current_stage):
+            stale = f'{self.base_cluster_name}-s{k}'
+            if global_state.get_cluster(stale) is None:
+                continue
+            ux_utils.log(f'Managed job {self.job_id}: reaping stale '
+                         f'stage-{k} cluster {stale}.')
+            try:
+                sky_core.down(stale)
+            except Exception as e:  # pylint: disable=broad-except
+                ux_utils.error(f'Failed to reap {stale}: {e}')
 
     # ------------------------------------------------------------------
     def _adopt(self) -> int:

@@ -49,6 +49,9 @@ def _summary() -> Dict[str, Any]:
             'job_id': j['job_id'],
             'name': j.get('name'),
             'job_group': j.get('job_group'),
+            'stage': (f"{int(j.get('stage') or 0) + 1}"
+                      f"/{len(j['task_config'])}"
+                      if isinstance(j.get('task_config'), list) else None),
             'cluster_name': j.get('cluster_name'),
             'recovery_count': j.get('recovery_count', 0),
             'submitted_at': j.get('submitted_at'),

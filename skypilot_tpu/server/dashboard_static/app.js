@@ -88,9 +88,11 @@ function render() {
     bindRows((i) => showClusterDetail(data.clusters[i]));
   } else if (active === 'Jobs') {
     v.innerHTML = table(
-      ['id', 'name', 'group', 'cluster', 'recoveries', 'submitted', 'status'],
-      data.jobs.map((j) => [j.job_id, j.name, j.job_group, j.cluster_name,
-                            j.recovery_count, ts(j.submitted_at), j.status]),
+      ['id', 'name', 'group', 'stage', 'cluster', 'recoveries',
+       'submitted', 'status'],
+      data.jobs.map((j) => [j.job_id, j.name, j.job_group, j.stage,
+                            j.cluster_name, j.recovery_count,
+                            ts(j.submitted_at), j.status]),
       true);
     bindRows((i) => showJobDetail(data.jobs[i]));
   } else if (active === 'Services') {
