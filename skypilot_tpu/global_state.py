@@ -276,3 +276,10 @@ def set_system_config(key: str, value: str) -> None:
     _db().execute(
         'INSERT INTO system_config (key, value) VALUES (?,?) '
         'ON CONFLICT(key) DO UPDATE SET value=excluded.value', (key, value))
+
+
+def cluster_status_counts() -> Dict[str, int]:
+    """{status: count} without unpickling any handles (metrics path)."""
+    rows = _db().query(
+        'SELECT status, COUNT(*) AS n FROM clusters GROUP BY status')
+    return {r['status'].lower(): int(r['n']) for r in rows if r['status']}

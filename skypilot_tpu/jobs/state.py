@@ -220,3 +220,10 @@ def bump_recovery(job_id: int) -> int:
     row = _db().query_one('SELECT recovery_count FROM managed_jobs '
                           'WHERE job_id=?', (job_id,))
     return int(row['recovery_count']) if row else 0
+
+
+def status_counts() -> Dict[str, int]:
+    """{status: count} aggregate (metrics path)."""
+    rows = _db().query(
+        'SELECT status, COUNT(*) AS n FROM managed_jobs GROUP BY status')
+    return {r['status'].lower(): int(r['n']) for r in rows if r['status']}

@@ -217,3 +217,24 @@ def next_replica_id(service: str) -> int:
         'SELECT MAX(replica_id) AS m FROM replicas WHERE service=?',
         (service,))
     return int(row['m'] or 0) + 1
+
+
+def count_services() -> int:
+    row = _db().query_one('SELECT COUNT(*) AS n FROM services', ())
+    return int(row['n']) if row else 0
+
+
+def count_ready_replicas(service: Optional[str] = None) -> int:
+    """Replicas in serving states — the single definition shared by the
+    dashboard and /api/metrics (one query, no per-service fan-out)."""
+    serving = [s.value for s in ReplicaStatus if s.is_serving]
+    marks = ','.join('?' * len(serving))
+    if service is None:
+        row = _db().query_one(
+            f'SELECT COUNT(*) AS n FROM replicas WHERE status IN ({marks})',
+            tuple(serving))
+    else:
+        row = _db().query_one(
+            f'SELECT COUNT(*) AS n FROM replicas WHERE service=? '
+            f'AND status IN ({marks})', (service, *serving))
+    return int(row['n']) if row else 0

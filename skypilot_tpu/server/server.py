@@ -140,6 +140,47 @@ async def api_health(request: web.Request) -> web.Response:
 _SERVER_START_TIME = None  # set in run()
 
 
+def _orchestration_gauge_lines() -> list:
+    import traceback
+    lines: list = []
+
+    def section(fn) -> None:
+        try:
+            lines.extend(fn())
+        except Exception:  # pylint: disable=broad-except
+            traceback.print_exc()  # lose one section, not the scrape
+
+    def clusters():
+        from skypilot_tpu import global_state
+        out = ['# TYPE skypilot_clusters gauge']
+        for status, count in sorted(
+                global_state.cluster_status_counts().items()):
+            out.append(f'skypilot_clusters{{status="{status}"}} {count}')
+        return out
+
+    def jobs():
+        from skypilot_tpu.jobs import state as jobs_state
+        out = ['# TYPE skypilot_managed_jobs gauge']
+        for status, count in sorted(jobs_state.status_counts().items()):
+            out.append(
+                f'skypilot_managed_jobs{{status="{status}"}} {count}')
+        return out
+
+    def serve():
+        from skypilot_tpu.serve import serve_state
+        return [
+            '# TYPE skypilot_services gauge',
+            f'skypilot_services {serve_state.count_services()}',
+            '# TYPE skypilot_service_replicas_ready gauge',
+            f'skypilot_service_replicas_ready '
+            f'{serve_state.count_ready_replicas()}',
+        ]
+
+    for fn in (clusters, jobs, serve):
+        section(fn)
+    return lines
+
+
 async def api_metrics(request: web.Request) -> web.Response:
     """Prometheus-format metrics (reference: sky/server/metrics.py —
     per-request counters + process RSS gauges)."""
@@ -155,6 +196,11 @@ async def api_metrics(request: web.Request) -> web.Response:
     for status, count in sorted(counts.items()):
         lines.append(
             f'skypilot_requests_total{{status="{status.lower()}"}} {count}')
+    # Orchestration gauges (reference: sky/server/metrics.py): pure
+    # aggregate queries (no handle unpickling), collected off the event
+    # loop; one broken table loses only its own section, loudly.
+    lines.extend(await asyncio.get_event_loop().run_in_executor(
+        None, _orchestration_gauge_lines))
     proc = psutil.Process()
     rss = proc.memory_info().rss
     lines.append('# TYPE skypilot_server_rss_bytes gauge')

@@ -240,3 +240,17 @@ def test_server_plugin_routes(isolated_state, monkeypatch, tmp_path):
     finally:
         proc.terminate()
         proc.wait(timeout=15)
+
+
+@pytest.mark.slow
+def test_metrics_orchestration_gauges(api_server):
+    sdk.get(sdk.check())
+    task = Task(run='true')
+    task.set_resources(skypilot_tpu.Resources(infra='local'))
+    sdk.get(sdk.launch(task, cluster_name='met-c'))
+    text = requests.get(f'{api_server}/api/metrics', timeout=10).text
+    assert 'skypilot_clusters{status="up"} 1' in text
+    assert 'skypilot_managed_jobs' in text
+    assert 'skypilot_services 0' in text
+    assert 'skypilot_server_rss_bytes' in text
+    sdk.get(sdk.down('met-c'))
