@@ -44,6 +44,12 @@ class GPTConfig:
     def head_dim(self) -> int:
         return self.embed_dim // self.num_heads
 
+    @property
+    def max_seq_len(self) -> int:
+        """Alias matching the llama/mixtral configs (serving engines
+        read model.config.max_seq_len)."""
+        return self.block_size
+
     def num_params(self) -> int:
         wpe = self.block_size * self.embed_dim
         wte = self.vocab_size * self.embed_dim
@@ -65,7 +71,9 @@ class CausalSelfAttention(nn.Module):
     config: GPTConfig
 
     @nn.compact
-    def __call__(self, x: jax.Array, deterministic: bool = True) -> jax.Array:
+    def __call__(self, x: jax.Array, deterministic: bool = True,
+                 positions: Optional[jax.Array] = None,
+                 decode: bool = False) -> jax.Array:
         cfg = self.config
         batch, seq, _ = x.shape
         qkv = _dense(3 * cfg.embed_dim, ('embed', 'mlp'), cfg.dtype,
@@ -73,10 +81,34 @@ class CausalSelfAttention(nn.Module):
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shape = (batch, seq, cfg.num_heads, cfg.head_dim)
         q, k, v = (t.reshape(shape) for t in (q, k, v))
-        q = nn.with_logical_constraint(q, ('batch', 'seq', 'heads', 'kv'))
-        k = nn.with_logical_constraint(k, ('batch', 'seq', 'heads', 'kv'))
-        v = nn.with_logical_constraint(v, ('batch', 'seq', 'heads', 'kv'))
-        out = attention_ops.dot_product_attention(q, k, v, causal=True)
+        if decode:
+            # One token in, KV cache with a PER-ROW write index
+            # (positions[:, 0]) — the shared serving-cache contract
+            # (ops.attention.cached_decode_attention), so the generate
+            # and continuous-batching engines drive GPT unchanged.
+            assert seq == 1, f'decode mode feeds one token, got {seq}'
+            assert positions is not None
+            cached_k = self.variable(
+                'cache', 'cached_key', jnp.zeros,
+                (batch, cfg.block_size, cfg.num_heads, cfg.head_dim),
+                cfg.dtype)
+            cached_v = self.variable(
+                'cache', 'cached_value', jnp.zeros,
+                (batch, cfg.block_size, cfg.num_heads, cfg.head_dim),
+                cfg.dtype)
+            out, cached_k.value, cached_v.value = \
+                attention_ops.cached_decode_attention(
+                    q, k, v, cached_k.value, cached_v.value,
+                    positions[:, 0])
+            out = out.astype(cfg.dtype)
+        else:
+            q = nn.with_logical_constraint(q,
+                                           ('batch', 'seq', 'heads', 'kv'))
+            k = nn.with_logical_constraint(k,
+                                           ('batch', 'seq', 'heads', 'kv'))
+            v = nn.with_logical_constraint(v,
+                                           ('batch', 'seq', 'heads', 'kv'))
+            out = attention_ops.dot_product_attention(q, k, v, causal=True)
         out = out.reshape((batch, seq, cfg.embed_dim))
         out = _dense(cfg.embed_dim, ('mlp', 'embed'), cfg.dtype, 'c_proj')(out)
         if cfg.dropout_rate > 0:
@@ -103,7 +135,9 @@ class Block(nn.Module):
     config: GPTConfig
 
     @nn.compact
-    def __call__(self, x: jax.Array, deterministic: bool = True) -> jax.Array:
+    def __call__(self, x: jax.Array, deterministic: bool = True,
+                 positions: Optional[jax.Array] = None,
+                 decode: bool = False) -> jax.Array:
         cfg = self.config
         ln = lambda name: nn.LayerNorm(
             dtype=cfg.dtype, name=name,
@@ -112,7 +146,8 @@ class Block(nn.Module):
             bias_init=nn.with_logical_partitioning(
                 nn.initializers.zeros_init(), ('norm',)))
         x = x + CausalSelfAttention(cfg, name='attn')(
-            ln('ln_1')(x), deterministic)
+            ln('ln_1')(x), deterministic, positions=positions,
+            decode=decode)
         x = x + MLP(cfg, name='mlp')(ln('ln_2')(x), deterministic)
         return nn.with_logical_constraint(x, ('batch', 'seq', 'act_embed'))
 
@@ -123,10 +158,15 @@ class GPT(nn.Module):
 
     @nn.compact
     def __call__(self, tokens: jax.Array,
-                 deterministic: bool = True) -> jax.Array:
+                 deterministic: bool = True,
+                 positions: Optional[jax.Array] = None,
+                 decode: bool = False) -> jax.Array:
         cfg = self.config
-        _, seq = tokens.shape
+        batch, seq = tokens.shape
         assert seq <= cfg.block_size, (seq, cfg.block_size)
+        explicit_positions = positions is not None
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(seq), (batch, seq))
         wte = self.param(
             'wte',
             nn.with_logical_partitioning(
@@ -137,15 +177,26 @@ class GPT(nn.Module):
             nn.with_logical_partitioning(
                 nn.initializers.normal(stddev=0.01), ('seq', 'table_embed')),
             (cfg.block_size, cfg.embed_dim), jnp.float32)
-        x = wte.astype(cfg.dtype)[tokens] + wpe.astype(cfg.dtype)[:seq]
+        # Training fast path: the default positions are a broadcast
+        # arange — slice wpe instead of a batch-sized gather.
+        pos_embed = (wpe.astype(cfg.dtype)[positions] if explicit_positions
+                     else wpe.astype(cfg.dtype)[:seq])
+        x = wte.astype(cfg.dtype)[tokens] + pos_embed
         x = nn.with_logical_constraint(x, ('batch', 'seq', 'act_embed'))
 
-        block = Block
         if cfg.remat:
+            assert not decode, 'remat is a training-path option'
+            # decode stays OUT of the remat arg list: jax.checkpoint
+            # would trace the bool and break Python-level branching.
             block = nn.remat(Block, prevent_cse=False,
                              static_argnums=(2,))
-        for i in range(cfg.num_layers):
-            x = block(cfg, name=f'h_{i}')(x, deterministic)
+            for i in range(cfg.num_layers):
+                x = block(cfg, name=f'h_{i}')(x, deterministic, positions)
+        else:
+            for i in range(cfg.num_layers):
+                x = Block(cfg, name=f'h_{i}')(x, deterministic,
+                                              positions=positions,
+                                              decode=decode)
         x = nn.LayerNorm(
             dtype=cfg.dtype, name='ln_f',
             scale_init=nn.with_logical_partitioning(

@@ -108,10 +108,10 @@ class Attention(nn.Module):
         k = apply_rope(k, positions, cfg.rope_theta)
 
         if decode:
-            # Incremental decoding: one token in, KV cache carried as
-            # flax 'cache' variables (serving path; models/generate.py).
-            # The write index and mask are PER ROW (positions[:, 0]), so
-            # continuous batching can decode slots at different depths
+            # Incremental decoding: one token in, KV cache with PER-ROW
+            # write positions — the shared serving-cache contract
+            # (ops.attention.cached_decode_attention), which is what
+            # lets continuous batching decode slots at different depths
             # in one step (models/batching.py).
             assert seq == 1, f'decode mode feeds one token, got {seq}'
             cached_k = self.variable(
@@ -120,29 +120,11 @@ class Attention(nn.Module):
             cached_v = self.variable(
                 'cache', 'cached_value', jnp.zeros,
                 (batch, cfg.max_seq_len, cfg.num_kv_heads, hd), cfg.dtype)
-            pos = positions[:, 0]  # [B] per-row write index
-
-            def write_row(cache_row, kv_row, p):
-                return jax.lax.dynamic_update_slice(cache_row, kv_row,
-                                                    (p, 0, 0))
-
-            cached_k.value = jax.vmap(write_row)(
-                cached_k.value, k.astype(cfg.dtype), pos)
-            cached_v.value = jax.vmap(write_row)(
-                cached_v.value, v.astype(cfg.dtype), pos)
-            k_all = jnp.repeat(cached_k.value,
-                               cfg.num_heads // cfg.num_kv_heads, axis=2)
-            v_all = jnp.repeat(cached_v.value,
-                               cfg.num_heads // cfg.num_kv_heads, axis=2)
-            scale = 1.0 / (hd ** 0.5)
-            s = jnp.einsum('bqhd,bkhd->bhqk', q.astype(jnp.float32),
-                           k_all.astype(jnp.float32)) * scale
-            mask = (jnp.arange(cfg.max_seq_len)[None, :] <=
-                    pos[:, None])[:, None, None, :]
-            s = jnp.where(mask, s, -jnp.inf)
-            p = jax.nn.softmax(s, axis=-1)
-            out = jnp.einsum('bhqk,bkhd->bqhd', p,
-                             v_all.astype(jnp.float32)).astype(cfg.dtype)
+            out, cached_k.value, cached_v.value = \
+                attention_ops.cached_decode_attention(
+                    q, k, v, cached_k.value, cached_v.value,
+                    positions[:, 0])
+            out = out.astype(cfg.dtype)
         else:
             q = nn.with_logical_constraint(q,
                                            ('batch', 'seq', 'heads', 'kv'))

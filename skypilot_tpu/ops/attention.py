@@ -75,3 +75,42 @@ def _flash(q: jax.Array, k: jax.Array, v: jax.Array, *,
     sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     out = fa.flash_attention(q_, k_, v_, causal=causal, sm_scale=sm_scale)
     return jnp.swapaxes(out, 1, 2)
+
+
+def cached_decode_attention(q: jax.Array, k_new: jax.Array,
+                            v_new: jax.Array, cached_k: jax.Array,
+                            cached_v: jax.Array, pos: jax.Array):
+    """One-token KV-cache attention with PER-ROW write positions.
+
+    The single serving-cache contract shared by every model family
+    (llama/mixtral/gpt): write this step's k/v at `pos[b]` in row b's
+    cache, attend q over the cache masked to `k_idx <= pos[b]`
+    (f32 softmax), with GQA expansion when q has more heads than the
+    cache. Rows at different depths decode in one step — what the
+    continuous-batching engine (models/batching.py) relies on.
+
+    q/k_new/v_new: [B, 1, H|Hkv, D]; cached_k/v: [B, T, Hkv, D];
+    pos: [B]. Returns (out [B, 1, H, D], cached_k, cached_v).
+    """
+    dtype = cached_k.dtype
+    max_len = cached_k.shape[1]
+
+    def write_row(cache_row, kv_row, p):
+        return jax.lax.dynamic_update_slice(cache_row, kv_row, (p, 0, 0))
+
+    cached_k = jax.vmap(write_row)(cached_k, k_new.astype(dtype), pos)
+    cached_v = jax.vmap(write_row)(cached_v, v_new.astype(dtype), pos)
+    num_q_heads, num_kv_heads = q.shape[2], cached_k.shape[2]
+    k_all, v_all = cached_k, cached_v
+    if num_kv_heads != num_q_heads:
+        rep = num_q_heads // num_kv_heads
+        k_all = jnp.repeat(k_all, rep, axis=2)
+        v_all = jnp.repeat(v_all, rep, axis=2)
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum('bqhd,bkhd->bhqk', q.astype(jnp.float32),
+                   k_all.astype(jnp.float32)) * scale
+    mask = (jnp.arange(max_len)[None, :] <= pos[:, None])[:, None, None, :]
+    s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum('bhqk,bkhd->bqhd', p, v_all.astype(jnp.float32))
+    return out.astype(q.dtype), cached_k, cached_v

@@ -63,7 +63,7 @@ def _summary() -> Dict[str, Any]:
     services = []
     for s in serve_state.get_services():
         replicas = serve_state.get_replicas(s['name'])
-        ready = serve_state.count_ready_replicas(s['name'])
+        ready = sum(1 for r in replicas if r['status'].is_serving)
         services.append({
             'name': s['name'],
             'version': s['version'],

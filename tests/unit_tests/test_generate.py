@@ -137,3 +137,29 @@ def test_mixtral_kv_decode_matches_full_forward():
     out = fn(params, jnp.asarray([prompt], jnp.int32),
              jax.random.PRNGKey(1))
     assert np.asarray(out)[0].tolist() == seq
+
+
+@pytest.mark.slow
+def test_gpt_kv_decode_matches_full_forward():
+    """GPT serving path: the KV-cache decode (absolute position
+    embeddings + per-row cache) must match the full-forward greedy
+    rollout — all three model families share the serving engines."""
+    from skypilot_tpu.models.gpt import GPT, GPTConfig
+
+    cfg = GPTConfig.tiny(dtype=jnp.float32)
+    model = GPT(cfg)
+    params = nn.meta.unbox(model.init(
+        jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))['params'])
+
+    prompt = [9, 1, 33]
+    max_total = 10
+    seq = list(prompt)
+    for _ in range(max_total - len(prompt)):
+        logits = model.apply({'params': params},
+                             jnp.asarray([seq], jnp.int32))
+        seq.append(int(jnp.argmax(logits[0, -1])))
+
+    fn = gen.make_generate_fn(model, max_total, temperature=0.0)
+    out = fn(params, jnp.asarray([prompt], jnp.int32),
+             jax.random.PRNGKey(1))
+    assert np.asarray(out)[0].tolist() == seq
