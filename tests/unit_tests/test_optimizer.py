@@ -186,3 +186,75 @@ def test_time_objective_picks_faster_hardware(all_clouds):
     Optimizer.optimize(_dag(time_task), minimize=OptimizeTarget.TIME,
                        quiet=True)
     assert time_task.best_resources.tpu_accelerator_name == 'tpu-v5p-64'
+
+
+def test_variable_elimination_matches_brute_force():
+    """Fuzz the exact solver: on random small DAGs with random costs
+    and pairwise egress, min-sum variable elimination must equal
+    exhaustive enumeration (the property the reference buys with CBC
+    ILP, sky/optimizer.py:490)."""
+    import itertools
+    import random
+
+    from skypilot_tpu import dag as dag_lib
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.optimizer import Optimizer, OptimizeTarget
+
+    rng = random.Random(42)
+    for trial in range(40):
+        n = rng.randint(1, 6)
+        tasks = [task_lib.Task(name=f't{i}', run='x') for i in range(n)]
+        g = dag_lib.Dag()
+        for t in tasks:
+            g.add(t)
+        for i in range(n):
+            for j in range(i + 1, n):
+                if rng.random() < 0.45:
+                    g.add_edge(tasks[i], tasks[j])
+
+        per_task = {}
+        for t in tasks:
+            k = rng.randint(1, 4)
+            per_task[t] = [(f'cand-{t.name}-{c}',
+                            round(rng.uniform(0, 10), 3),
+                            round(rng.uniform(0, 10), 3))
+                           for c in range(k)]
+        # Random pairwise egress per edge x cand pair. Candidate names
+        # are globally unique, so (src_name, dst_name) keys an edge
+        # entry unambiguously.
+        edge_cost = {}
+        by_name = {}
+        for u, v in g.graph.edges:
+            for ui, ucand in enumerate(per_task[u]):
+                for vi, vcand in enumerate(per_task[v]):
+                    c = (round(rng.uniform(0, 5), 3)
+                         if rng.random() < 0.6 else 0.0)
+                    edge_cost[(u, v, ui, vi)] = c
+                    by_name[(ucand[0], vcand[0])] = c
+
+        def fake_egress(src, dst, task, use_time, _lookup=by_name):
+            return _lookup.get((src, dst), 0.0)
+
+        class _Opt(Optimizer):
+            _egress = staticmethod(fake_egress)
+
+        choice = _Opt._optimize_exact(
+            g, {t: list(c) for t, c in per_task.items()},
+            OptimizeTarget.COST)
+
+        # Brute force over the full joint assignment space.
+        best = None
+        tlist = list(tasks)
+        for assign in itertools.product(
+                *(range(len(per_task[t])) for t in tlist)):
+            idx = dict(zip(tlist, assign))
+            total = sum(per_task[t][idx[t]][1] for t in tlist)
+            for u, v in g.graph.edges:
+                total += edge_cost.get((u, v, idx[u], idx[v]), 0.0)
+            if best is None or total < best:
+                best = total
+
+        got = sum(choice[t][1] for t in tlist)
+        for u, v in g.graph.edges:
+            got += by_name.get((choice[u][0], choice[v][0]), 0.0)
+        assert abs(got - best) < 1e-6, (trial, got, best)
