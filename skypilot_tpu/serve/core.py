@@ -24,6 +24,83 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _spawn_controller(service_name: str, controller_port: int,
+                      lb_port: int, log_path: str) -> int:
+    env = dict(os.environ)
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env['PYTHONPATH'] = f'{repo_root}:{env.get("PYTHONPATH", "")}'
+    pid = subprocess_utils.launch_daemon(
+        [sys.executable, '-m', 'skypilot_tpu.serve.service',
+         '--service', service_name,
+         '--controller-port', str(controller_port),
+         '--lb-port', str(lb_port)],
+        log_path=log_path, env=env)
+    serve_state.set_service_controller(service_name, pid, controller_port,
+                                       lb_port)
+    return pid
+
+
+def reconcile_controllers() -> int:
+    """HA: respawn serve controllers whose process died.
+
+    The managed-jobs analog of controller re-adoption: a non-terminal
+    service with a dead controller gets a fresh one on the SAME ports
+    (the LB endpoint clients hold stays valid); the new controller
+    rebuilds its replica accounting from the serve DB (replica rows +
+    persisted procurement meta). Called at API-server startup.
+    """
+    from skypilot_tpu.utils import ux_utils
+    respawned = 0
+    for record in serve_state.get_services():
+        if record['status'].is_terminal():
+            continue
+        pid = record.get('controller_pid') or -1
+        if pid > 0 and subprocess_utils.process_alive(pid):
+            continue
+        name = record['name']
+        if record['status'] == serve_state.ServiceStatus.SHUTTING_DOWN:
+            # The controller died mid-teardown: FINISH the teardown —
+            # respawning would resurrect a service the user was
+            # removing.
+            ux_utils.log(f'Service {name}: controller died mid-teardown; '
+                         'completing it.')
+            try:
+                down(name, purge=True)
+            except Exception as e:  # pylint: disable=broad-except
+                ux_utils.error(f'Teardown completion for {name}: {e}')
+            continue
+        if not record.get('lb_port'):
+            # Crashed between add_service and the first controller
+            # spawn: no ports were ever recorded, so no client holds an
+            # endpoint — allocate fresh ones.
+            record['controller_port'] = _free_port()
+            record['lb_port'] = _free_port()
+        # Replica rows stuck in PENDING/PROVISIONING belong to launch
+        # threads that died with the controller; drop them so the new
+        # controller's autoscaler launches replacements instead of
+        # counting phantoms as in-flight forever.
+        for replica in serve_state.get_replicas(name):
+            if replica['status'] in (serve_state.ReplicaStatus.PENDING,
+                                     serve_state.ReplicaStatus.PROVISIONING):
+                ux_utils.log(
+                    f'Service {name}: dropping orphaned replica '
+                    f'{replica["replica_id"]} '
+                    f'({replica["status"].value}).')
+                from skypilot_tpu import core as sky_core
+                try:
+                    sky_core.down(replica['cluster_name'])
+                except Exception:  # pylint: disable=broad-except
+                    pass  # half-created at most
+                serve_state.remove_replica(name, replica['replica_id'])
+        ux_utils.log(f'Service {name}: controller (pid {pid}) dead; '
+                     'respawning on the same ports.')
+        _spawn_controller(name, record['controller_port'],
+                         record['lb_port'], record['log_path'])
+        respawned += 1
+    return respawned
+
+
 def up(task_config: Dict[str, Any], service_name: str,
        user: Optional[str] = None) -> Dict[str, Any]:
     # Identity comes from the request context (server-derived), not the
@@ -42,19 +119,8 @@ def up(task_config: Dict[str, Any], service_name: str,
     record = serve_state.get_service(service_name)
     assert record is not None
     controller_port, lb_port = _free_port(), _free_port()
-
-    env = dict(os.environ)
-    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
-    env['PYTHONPATH'] = f'{repo_root}:{env.get("PYTHONPATH", "")}'
-    pid = subprocess_utils.launch_daemon(
-        [sys.executable, '-m', 'skypilot_tpu.serve.service',
-         '--service', service_name,
-         '--controller-port', str(controller_port),
-         '--lb-port', str(lb_port)],
-        log_path=record['log_path'], env=env)
-    serve_state.set_service_controller(service_name, pid, controller_port,
-                                       lb_port)
+    _spawn_controller(service_name, controller_port, lb_port,
+                      record['log_path'])
     return {
         'service_name': service_name,
         'endpoint': f'http://127.0.0.1:{lb_port}',

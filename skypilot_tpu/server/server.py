@@ -456,10 +456,17 @@ def run(host: str = '127.0.0.1',
     worker_loop = executor.RequestWorkerLoop()
     worker_loop.start()
     # HA: re-adopt managed jobs orphaned by a previous server/controller
-    # crash (reference: sky/jobs/managed_job_refresh_thread.py).
+    # crash (reference: sky/jobs/managed_job_refresh_thread.py), and
+    # respawn dead serve controllers on their recorded ports.
     try:
         from skypilot_tpu.jobs import scheduler as jobs_scheduler
         jobs_scheduler.maybe_schedule_next_jobs()
+    except Exception:  # pylint: disable=broad-except
+        import traceback
+        traceback.print_exc()
+    try:
+        from skypilot_tpu.serve import core as serve_core
+        serve_core.reconcile_controllers()
     except Exception:  # pylint: disable=broad-except
         import traceback
         traceback.print_exc()

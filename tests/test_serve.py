@@ -299,3 +299,48 @@ def test_spot_placer_steers_replica_launch(isolated_state, monkeypatch):
     controller._launch_replica(3, 1)
     (res3,) = launched[-1]
     assert not res3.use_spot
+
+
+@pytest.mark.slow
+def test_serve_controller_crash_respawns(serve_env):
+    """HA for serve: a kill -9'd controller is respawned on the SAME
+    ports (clients keep their endpoint) and the service keeps serving —
+    the serve analog of managed-jobs re-adoption."""
+    import os
+    import signal
+    from skypilot_tpu.utils import subprocess_utils
+
+    result = serve_core.up(_task_config(replicas=1), 'svc-ha', user='t')
+    endpoint = result['endpoint']
+    _wait_ready('svc-ha', 1)
+    assert requests.get(endpoint + '/', timeout=10).status_code == 200
+
+    record = serve_state.get_service('svc-ha')
+    pid = record['controller_pid']
+    assert pid > 0 and subprocess_utils.process_alive(pid)
+    os.kill(pid, signal.SIGKILL)
+    deadline = time.time() + 15
+    while time.time() < deadline and subprocess_utils.process_alive(pid):
+        time.sleep(0.2)
+
+    # Reconcile (what API-server startup runs) respawns it.
+    assert serve_core.reconcile_controllers() == 1
+    new_record = serve_state.get_service('svc-ha')
+    assert new_record['controller_pid'] != pid
+    assert new_record['lb_port'] == record['lb_port']
+
+    # Same endpoint serves again (LB restarts within the new process).
+    deadline = time.time() + 90
+    ok = False
+    while time.time() < deadline:
+        try:
+            if requests.get(endpoint + '/', timeout=5).status_code == 200:
+                ok = True
+                break
+        except requests.RequestException:
+            pass
+        time.sleep(2)
+    assert ok
+    # A second reconcile is a no-op (controller alive).
+    assert serve_core.reconcile_controllers() == 0
+    serve_core.down('svc-ha')
