@@ -1,0 +1,148 @@
+"""AWS catalog: EC2 instance types, GPU/Trainium accelerators, prices.
+
+Reference: sky/catalog/aws_catalog.py — pandas over the hosted CSV
+mirror. Same shape as `gcp_catalog` minus the TPU table; the bundled
+snapshot covers the GPU training/serving families (p3/p4/p5/g4/g5),
+Trainium/Inferentia, and the m6i/c6i/r6i CPU ladder.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import pandas as pd
+
+from skypilot_tpu.catalog import common
+
+
+def _vm_df() -> pd.DataFrame:
+    return common.read_catalog('aws_vms.csv')
+
+
+def list_accelerators(
+        name_filter: Optional[str] = None,
+        region_filter: Optional[str] = None,
+        case_sensitive: bool = False,
+) -> Dict[str, List[common.InstanceTypeInfo]]:
+    df = _vm_df()
+    acc_df = df[df['AcceleratorName'].notna()]
+    if name_filter is not None:
+        acc_df = acc_df[acc_df['AcceleratorName'].str.contains(
+            name_filter, case=case_sensitive, regex=True)]
+    if region_filter is not None:
+        acc_df = acc_df[acc_df['Region'] == region_filter]
+    result: Dict[str, List[common.InstanceTypeInfo]] = {}
+    for _, row in acc_df.iterrows():
+        info = common.InstanceTypeInfo(
+            cloud='AWS',
+            instance_type=row['InstanceType'],
+            accelerator_name=row['AcceleratorName'],
+            accelerator_count=float(row['AcceleratorCount']),
+            cpu_count=row['vCPUs'],
+            memory=row['MemoryGiB'],
+            price=float(row['Price']),
+            spot_price=float(row['SpotPrice']),
+            region=row['Region'],
+        )
+        result.setdefault(row['AcceleratorName'], []).append(info)
+    return result
+
+
+def get_hourly_cost(instance_type: str, use_spot: bool,
+                    region: Optional[str] = None,
+                    zone: Optional[str] = None) -> float:
+    df = _vm_df()
+    df = df[df['InstanceType'] == instance_type]
+    if region is not None:
+        df = df[df['Region'] == region]
+    if zone is not None:
+        df = df[df['AvailabilityZone'] == zone]
+    if df.empty:
+        raise ValueError(f'Unknown AWS instance type {instance_type!r} '
+                         f'in region={region}.')
+    col = 'SpotPrice' if use_spot else 'Price'
+    return float(df[col].dropna().min())
+
+
+def get_vcpus_mem_from_instance_type(
+        instance_type: str) -> Tuple[Optional[float], Optional[float]]:
+    df = _vm_df()
+    df = df[df['InstanceType'] == instance_type]
+    if df.empty:
+        return None, None
+    return float(df['vCPUs'].iloc[0]), float(df['MemoryGiB'].iloc[0])
+
+
+def get_instance_type_for_cpus_mem(
+        cpus: Optional[str], memory: Optional[str]) -> Optional[str]:
+    # CPU-only choices: exclude accelerator hosts.
+    df = _vm_df()
+    df = df[df['AcceleratorName'].isna()]
+    return common.get_instance_type_for_cpus_mem_impl(df, cpus, memory)
+
+
+def get_default_instance_type(cpus: Optional[str] = None,
+                              memory: Optional[str] = None) -> Optional[str]:
+    if cpus is None and memory is None:
+        cpus = '8+'
+        memory = 'x4'
+    return get_instance_type_for_cpus_mem(cpus, memory)
+
+
+def get_accelerators_from_instance_type(
+        instance_type: str) -> Optional[Dict[str, int]]:
+    df = _vm_df()
+    df = df[(df['InstanceType'] == instance_type)
+            & df['AcceleratorName'].notna()]
+    if df.empty:
+        return None
+    row = df.iloc[0]
+    return {row['AcceleratorName']: int(row['AcceleratorCount'])}
+
+
+def get_instance_type_for_accelerator(
+        acc_name: str, acc_count: int) -> Optional[List[str]]:
+    df = _vm_df()
+    df = df[(df['AcceleratorName'] == acc_name)
+            & (df['AcceleratorCount'] == acc_count)
+            & df['InstanceType'].notna()]
+    if df.empty:
+        return None
+    return sorted(df['InstanceType'].unique())
+
+
+def regions_for_instance_type(instance_type: str) -> List[str]:
+    df = _vm_df()
+    df = df[df['InstanceType'] == instance_type]
+    return sorted(df['Region'].unique())
+
+
+def zones_for_instance_type(instance_type: str,
+                            region: Optional[str] = None) -> List[str]:
+    df = _vm_df()
+    df = df[df['InstanceType'] == instance_type]
+    if region is not None:
+        df = df[df['Region'] == region]
+    return sorted(df['AvailabilityZone'].unique())
+
+
+def validate_region_zone(region: Optional[str], zone: Optional[str]):
+    # AWS zones are `<region><letter>` (us-east-1a), so the generic
+    # `rsplit('-')` region inference doesn't apply; validate against
+    # the catalog's (Region, AvailabilityZone) pairs directly.
+    df = _vm_df()
+    if region is not None and region not in set(df['Region']):
+        raise ValueError(f'Invalid region {region!r} for AWS; valid: '
+                         f'{sorted(df["Region"].unique())}')
+    if zone is not None:
+        zdf = df[df['AvailabilityZone'] == zone]
+        if zdf.empty:
+            raise ValueError(f'Invalid zone {zone!r} for AWS.')
+        zone_region = zdf['Region'].iloc[0]
+        if region is not None and zone_region != region:
+            raise ValueError(f'Zone {zone!r} is not in region {region!r}.')
+        region = zone_region
+    return region, zone
+
+
+def regions() -> List[str]:
+    return sorted(_vm_df()['Region'].unique())

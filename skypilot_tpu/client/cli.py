@@ -979,6 +979,236 @@ def token_revoke_cmd(token_id: str) -> None:
         click.echo('No such token.', err=True)
 
 
+# ---------------------------------------------------------------------------
+# config / workspaces / ssh-node-pool / dashboard
+# ---------------------------------------------------------------------------
+@cli.group()
+def config() -> None:
+    """View and edit the layered config (server < user < project)."""
+
+
+@config.command(name='list')
+def config_list() -> None:
+    """Dump the effective merged config as YAML."""
+    import yaml as yaml_lib
+    from skypilot_tpu import sky_config
+    merged = sky_config.to_dict()
+    if not merged:
+        click.echo('# (empty config)')
+        return
+    click.echo(yaml_lib.safe_dump(merged, default_flow_style=False,
+                                  sort_keys=False).rstrip())
+
+
+@config.command(name='get')
+@click.argument('key')
+def config_get(key) -> None:
+    """Read a dotted key, e.g. `stpu config get gcp.project_id`."""
+    import yaml as yaml_lib
+    from skypilot_tpu import sky_config
+    sentinel = object()
+    value = sky_config.get_nested(tuple(key.split('.')), sentinel)
+    if value is sentinel:
+        _err(f'{key}: not set')
+    if isinstance(value, (dict, list)):
+        click.echo(yaml_lib.safe_dump(value, default_flow_style=False,
+                                      sort_keys=False).rstrip())
+    else:
+        click.echo(value)
+
+
+@config.command(name='set')
+@click.argument('key')
+@click.argument('value')
+def config_set(key, value) -> None:
+    """Set a dotted key in the user config file (YAML-parsed value)."""
+    import yaml as yaml_lib
+    from skypilot_tpu import sky_config
+    try:
+        parsed = yaml_lib.safe_load(value)
+    except yaml_lib.YAMLError:
+        parsed = value
+    try:
+        path = sky_config.set_nested(tuple(key.split('.')), parsed)
+    except Exception as e:  # pylint: disable=broad-except
+        _err(f'rejected: {e}')
+    click.echo(f'{key} = {parsed!r}  ({path})')
+
+
+@config.command(name='unset')
+@click.argument('key')
+def config_unset(key) -> None:
+    """Remove a dotted key from the user config file."""
+    from skypilot_tpu import sky_config
+    path = sky_config.set_nested(tuple(key.split('.')), None)
+    click.echo(f'{key} removed  ({path})')
+
+
+@cli.group()
+def workspaces() -> None:
+    """Multi-tenant namespaces with per-workspace cloud allow-lists."""
+
+
+@workspaces.command(name='ls')
+def workspaces_ls() -> None:
+    from skypilot_tpu.workspaces import core as ws_core
+    from rich.console import Console
+    from rich.table import Table
+    active = ws_core.active_workspace()
+    table = Table(box=None)
+    for col in ('NAME', 'ACTIVE', 'ALLOWED CLOUDS'):
+        table.add_column(col)
+    for name, ws in sorted(ws_core.get_workspaces().items()):
+        allowed = (ws or {}).get('allowed_clouds')
+        table.add_row(name, '*' if name == active else '',
+                      ', '.join(allowed) if allowed else '(all)')
+    Console().print(table)
+
+
+@workspaces.command(name='show')
+@click.argument('name', required=False)
+def workspaces_show(name) -> None:
+    import yaml as yaml_lib
+    from skypilot_tpu.workspaces import core as ws_core
+    try:
+        ws = ws_core.get_workspace(name)
+    except exceptions.SkyError as e:
+        _err(str(e))
+    click.echo(yaml_lib.safe_dump(
+        {name or ws_core.active_workspace(): ws or {}},
+        default_flow_style=False).rstrip())
+
+
+@workspaces.command(name='switch')
+@click.argument('name')
+def workspaces_switch(name) -> None:
+    """Make NAME the active workspace (persisted in user config)."""
+    from skypilot_tpu import sky_config
+    from skypilot_tpu.workspaces import core as ws_core
+    try:
+        ws_core.get_workspace(name)
+    except exceptions.SkyError as e:
+        _err(str(e))
+    sky_config.set_nested(('active_workspace',), name)
+    click.echo(f'Active workspace: {name}')
+
+
+@cli.group(name='ssh-node-pool')
+def ssh_node_pool() -> None:
+    """Bring-your-own machines declared in ssh_node_pools.yaml."""
+
+
+@ssh_node_pool.command(name='ls')
+def ssh_node_pool_ls() -> None:
+    from skypilot_tpu.clouds import ssh as ssh_cloud
+    from rich.console import Console
+    from rich.table import Table
+    pools = ssh_cloud.load_pools()
+    if not pools:
+        click.echo(f'No pools declared ({ssh_cloud.POOLS_PATH}).')
+        return
+    table = Table(box=None)
+    for col in ('POOL', 'HOSTS', 'USER', 'IDENTITY'):
+        table.add_column(col)
+    for name, pool in sorted(pools.items()):
+        hosts = pool.get('hosts', [])
+        users = {h.get('user') for h in hosts}
+        keys = {h.get('identity_file') for h in hosts}
+        table.add_row(
+            name, str(len(hosts)),
+            users.pop() if len(users) == 1 else '(mixed)',
+            keys.pop() if len(keys) == 1 else '(mixed)')
+    Console().print(table)
+
+
+@ssh_node_pool.command(name='check')
+@click.argument('pool', required=False)
+@click.option('--timeout', type=float, default=10.0)
+def ssh_node_pool_check(pool, timeout) -> None:
+    """SSH-probe every host of a pool (`true` over the declared auth)."""
+    from skypilot_tpu.clouds import ssh as ssh_cloud
+    from skypilot_tpu.utils import command_runner
+    from skypilot_tpu.utils import subprocess_utils
+    pools = ssh_cloud.load_pools()
+    if pool is not None:
+        if pool not in pools:
+            _err(f'pool {pool!r} not declared; known: '
+                 + ', '.join(sorted(pools)))
+        pools = {pool: pools[pool]}
+
+    def _probe(host):
+        runner = command_runner.SSHCommandRunner(
+            (host['ip'], host.get('port', 22)), host.get('user', 'root'),
+            host.get('identity_file', '~/.ssh/id_ed25519'))
+        rc, _, err = runner.run('true', stream_logs=False,
+                                require_outputs=True, timeout=timeout)
+        return rc, (err or '').strip()
+
+    for name, p in sorted(pools.items()):
+        hosts = p.get('hosts', [])
+        results = subprocess_utils.run_in_parallel(_probe, hosts)
+        for host, (rc, err) in zip(hosts, results):
+            ok = 'OK' if rc == 0 else f'FAIL ({err[:60]})'
+            click.echo(f'{name}\t{host["ip"]}\t{ok}')
+
+
+@cli.command()
+@click.option('--no-open', is_flag=True, default=False,
+              help='Print the URL instead of opening a browser.')
+def dashboard(no_open) -> None:
+    """Open the live web dashboard served by the API server."""
+    url = sdk.api_server_url().rstrip('/') + '/dashboard'
+    click.echo(url)
+    if not no_open:
+        import webbrowser
+        webbrowser.open(url)
+
+
+@api.command(name='login')
+@click.option('--endpoint', '-e', required=True,
+              help='API server URL, e.g. http://host:46580')
+@click.option('--token', default=None,
+              help='Service-account token (or set SKYPILOT_API_TOKEN).')
+def api_login(endpoint, token) -> None:
+    """Point this client at a remote API server (persisted in config)."""
+    from skypilot_tpu import sky_config
+    endpoint = endpoint.rstrip('/')
+    sky_config.set_nested(('api_server', 'endpoint'), endpoint)
+    if token:
+        sky_config.set_nested(('api_server', 'auth_token'), token)
+    info = sdk.api_info(endpoint)
+    if info is None:
+        click.secho(f'Warning: {endpoint} is not reachable right now.',
+                    fg='yellow', err=True)
+    click.echo(f'Logged in to {endpoint}.')
+
+
+@recipes.command(name='launch')
+@click.argument('name')
+@click.option('--cluster', '-c', default=None)
+@click.option('--env', multiple=True, help='KEY=VAL or KEY (inherit).')
+@click.option('--yes', '-y', is_flag=True, default=False)
+def recipes_launch(name, cluster, env, yes) -> None:
+    """Launch a bundled recipe by name (see `stpu recipes list`)."""
+    from skypilot_tpu.recipes import core as recipes_core
+    try:
+        path = recipes_core.get_recipe_path(name)
+    except FileNotFoundError as e:
+        _err(str(e))
+    from skypilot_tpu import task as task_lib
+    task = task_lib.Task.from_yaml_config(
+        common_utils.read_yaml(path), _parse_env(list(env or [])))
+    if not yes:
+        r = sorted(str(x) for x in task.resources)
+        click.confirm(f'Launch recipe {name} on {r}?', default=True,
+                      abort=True)
+    request_id = sdk.launch(task, cluster_name=cluster, detach_run=True)
+    result = sdk.stream_and_get(request_id)
+    if result and result.get('job_id') is not None:
+        cname = (result.get('handle') or {}).get('cluster_name') or cluster
+        sdk.tail_logs(cname, result['job_id'])
+
+
 def main() -> None:
     try:
         cli()

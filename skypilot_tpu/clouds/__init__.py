@@ -1,4 +1,5 @@
 """Cloud implementations. Importing this package registers all clouds."""
+from skypilot_tpu.clouds.aws import AWS
 from skypilot_tpu.clouds.cloud import (Cloud, CloudImplementationFeatures,
                                        Region, ResourcesFeasibility, Zone)
 from skypilot_tpu.clouds.gcp import GCP
@@ -7,6 +8,6 @@ from skypilot_tpu.clouds.local import Local
 from skypilot_tpu.clouds.ssh import SSH
 
 __all__ = [
-    'Cloud', 'CloudImplementationFeatures', 'Region', 'ResourcesFeasibility',
-    'Zone', 'GCP', 'Kubernetes', 'Local', 'SSH',
+    'AWS', 'Cloud', 'CloudImplementationFeatures', 'Region',
+    'ResourcesFeasibility', 'Zone', 'GCP', 'Kubernetes', 'Local', 'SSH',
 ]

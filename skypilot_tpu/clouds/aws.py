@@ -1,0 +1,190 @@
+"""AWS cloud: EC2 GPU/Trainium/CPU hosts as a second public cloud.
+
+Reference: sky/clouds/aws.py — the TPU-native build keeps GCP primary
+(TPU slices) and adds AWS for the multi-cloud optimizer story: GPU
+training/serving families, spot failover, cross-cloud egress costs.
+Provisioning goes through `provision/aws/` (SigV4 Query API, no SDK).
+"""
+from __future__ import annotations
+
+import os
+import typing
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from skypilot_tpu.catalog import aws_catalog
+from skypilot_tpu.clouds import cloud
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+
+@CLOUD_REGISTRY.register()
+class AWS(cloud.Cloud):
+    _REPR = 'AWS'
+
+    @classmethod
+    def max_cluster_name_length(cls) -> Optional[int]:
+        # Name tag limit is generous; keep parity with the reference's
+        # practical bound for hostname-safe names.
+        return 50
+
+    # ---- credentials ------------------------------------------------------
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.provision.aws import ec2_api
+        if ec2_api.load_credentials() is not None:
+            return True, None
+        return False, ('AWS credentials not found. Set AWS_ACCESS_KEY_ID/'
+                       'AWS_SECRET_ACCESS_KEY or populate '
+                       '~/.aws/credentials.')
+
+    @classmethod
+    def unsupported_features_for_resources(
+        cls, resources: 'resources_lib.Resources'
+    ) -> Dict[cloud.CloudImplementationFeatures, str]:
+        out = {}
+        if resources.is_tpu_slice:
+            out[cloud.CloudImplementationFeatures.MULTI_NODE] = (
+                'TPU slices are GCP-only; AWS offers GPU/Trainium '
+                'instances instead.')
+        return out
+
+    # ---- catalog ----------------------------------------------------------
+    def validate_region_zone(self, region: Optional[str],
+                             zone: Optional[str]):
+        return aws_catalog.validate_region_zone(region, zone)
+
+    def get_hourly_cost(self, resources: 'resources_lib.Resources') -> float:
+        assert resources.instance_type is not None, resources
+        return aws_catalog.get_hourly_cost(
+            resources.instance_type, resources.use_spot, resources.region,
+            resources.zone)
+
+    def get_egress_cost(self, num_gigabytes: float) -> float:
+        # Tiered internet egress (reference: sky/clouds/aws.py).
+        if num_gigabytes <= 0:
+            return 0.0
+        if num_gigabytes <= 10240:
+            return 0.09 * num_gigabytes
+        if num_gigabytes <= 51200:
+            return 0.09 * 10240 + 0.085 * (num_gigabytes - 10240)
+        return 0.09 * 10240 + 0.085 * 40960 + 0.07 * (num_gigabytes - 51200)
+
+    @classmethod
+    def get_default_instance_type(cls, cpus: Optional[str] = None,
+                                  memory: Optional[str] = None
+                                  ) -> Optional[str]:
+        return aws_catalog.get_default_instance_type(cpus, memory)
+
+    @classmethod
+    def get_vcpus_mem_from_instance_type(
+            cls, instance_type: str
+    ) -> Tuple[Optional[float], Optional[float]]:
+        return aws_catalog.get_vcpus_mem_from_instance_type(instance_type)
+
+    def instance_type_exists(self, instance_type: str) -> bool:
+        return aws_catalog.get_vcpus_mem_from_instance_type(
+            instance_type)[0] is not None
+
+    # ---- feasibility ------------------------------------------------------
+    def get_feasible_launchable_resources(
+            self, resources: 'resources_lib.Resources',
+            num_nodes: int = 1) -> cloud.ResourcesFeasibility:
+        del num_nodes
+        if resources.is_tpu_slice:
+            return cloud.ResourcesFeasibility([], [])
+        if resources.instance_type is not None:
+            if self.instance_type_exists(resources.instance_type):
+                return cloud.ResourcesFeasibility(
+                    [resources.copy(cloud=self)], [])
+            return cloud.ResourcesFeasibility([], [])
+        accs = resources.accelerators
+        if accs is None:
+            instance_type = aws_catalog.get_default_instance_type(
+                resources.cpus, resources.memory)
+            if instance_type is None:
+                return cloud.ResourcesFeasibility([], [])
+            return cloud.ResourcesFeasibility(
+                [resources.copy(cloud=self, instance_type=instance_type)],
+                [])
+        acc_name, acc_count = next(iter(accs.items()))
+        instance_types = aws_catalog.get_instance_type_for_accelerator(
+            acc_name, acc_count)
+        if not instance_types:
+            fuzzy_all = aws_catalog.list_accelerators(
+                name_filter=acc_name.split('-')[0], case_sensitive=False)
+            fuzzy = sorted(f'{name}:{int(i.accelerator_count)}'
+                           for name, infos in fuzzy_all.items()
+                           for i in infos[:1])
+            return cloud.ResourcesFeasibility([], fuzzy)
+        return cloud.ResourcesFeasibility(
+            [resources.copy(cloud=self, instance_type=it)
+             for it in instance_types], [])
+
+    # ---- failover iteration -----------------------------------------------
+    @classmethod
+    def regions_with_offering(cls, instance_type: Optional[str],
+                              accelerators: Optional[Dict[str, int]],
+                              use_spot: bool, region: Optional[str],
+                              zone: Optional[str]) -> List[cloud.Region]:
+        del use_spot
+        if instance_type is not None:
+            region_names = aws_catalog.regions_for_instance_type(
+                instance_type)
+        elif accelerators:
+            acc_name = next(iter(accelerators))
+            infos = aws_catalog.list_accelerators(
+                name_filter=f'^{acc_name}$').get(acc_name, [])
+            region_names = sorted({i.region for i in infos})
+        else:
+            region_names = aws_catalog.regions()
+        out = []
+        for r in region_names:
+            if region is not None and r != region:
+                continue
+            zones = [cloud.Zone(z) for z in
+                     aws_catalog.zones_for_instance_type(
+                         instance_type, r)] if instance_type else []
+            if zone is not None:
+                zones = [z for z in zones if z.name == zone]
+                if not zones:
+                    continue
+            out.append(cloud.Region(r).set_zones(zones or None))
+        return out
+
+    @classmethod
+    def zones_provision_loop(cls, *, region: str, num_nodes: int,
+                             instance_type: Optional[str],
+                             accelerators: Optional[Dict[str, int]],
+                             use_spot: bool
+                             ) -> Iterator[Optional[List[cloud.Zone]]]:
+        del num_nodes, accelerators, use_spot
+        zones = (aws_catalog.zones_for_instance_type(instance_type, region)
+                 if instance_type else [])
+        if not zones:
+            yield None  # region-level: EC2 picks the AZ
+            return
+        for z in zones:
+            yield [cloud.Zone(z)]
+
+    # ---- deploy variables -------------------------------------------------
+    def make_deploy_resources_variables(
+            self, resources: 'resources_lib.Resources',
+            cluster_name_on_cloud: str, region: cloud.Region,
+            zones: Optional[List[cloud.Zone]],
+            num_nodes: int) -> Dict[str, Any]:
+        return {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': region.name,
+            'zone': zones[0].name if zones else None,
+            'num_nodes': num_nodes,
+            'use_spot': resources.use_spot,
+            'disk_size': resources.disk_size,
+            'ports': resources.ports,
+            'labels': resources.labels or {},
+            'image_id': resources.image_id,
+            'instance_type': resources.instance_type,
+            'accelerators': resources.accelerators or {},
+            'tpu_vm': False,
+        }

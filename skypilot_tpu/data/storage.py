@@ -32,15 +32,23 @@ class StorageMode(enum.Enum):
 class StoreType(enum.Enum):
     GCS = 'GCS'
     S3 = 'S3'
+    AZURE = 'AZURE'
+    R2 = 'R2'
 
     @classmethod
     def from_url(cls, url: str) -> 'StoreType':
-        if url.startswith('gs://'):
-            return cls.GCS
-        if url.startswith('s3://'):
-            return cls.S3
+        for prefix, store in (('gs://', cls.GCS), ('s3://', cls.S3),
+                              ('az://', cls.AZURE), ('r2://', cls.R2)):
+            if url.startswith(prefix):
+                return store
         raise exceptions.StorageSpecError(
-            f'Unsupported storage url {url!r} (gs:// or s3://).')
+            f'Unsupported storage url {url!r} '
+            '(gs://, s3://, az://, or r2://).')
+
+    @property
+    def url_prefix(self) -> str:
+        return {StoreType.GCS: 'gs', StoreType.S3: 's3',
+                StoreType.AZURE: 'az', StoreType.R2: 'r2'}[self]
 
 
 class Storage:
@@ -69,8 +77,7 @@ class Storage:
         if self.source and '://' in self.source:
             return self.source.rstrip('/')
         assert self.name, self
-        prefix = 'gs' if self.store == StoreType.GCS else 's3'
-        return f'{prefix}://{self.name}'
+        return f'{self.store.url_prefix}://{self.name}'
 
     def is_local_source(self) -> bool:
         return bool(self.source) and '://' not in str(self.source)
@@ -114,6 +121,18 @@ class Storage:
         if self.store == StoreType.GCS:
             cmd = (f'gcloud storage rsync -r {shlex.quote(src)} '
                    f'{shlex.quote(url)}')
+        elif self.store == StoreType.AZURE:
+            container, _, subpath = url.split('://', 1)[1].partition('/')
+            cmd = (f'az storage blob sync -s {shlex.quote(src)} '
+                   f'-c {shlex.quote(container)}')
+            if subpath:
+                cmd += f' -d {shlex.quote(subpath)}'
+        elif self.store == StoreType.R2:
+            bucket_path = url.split('://', 1)[1]
+            cmd = (f'aws s3 sync {shlex.quote(src)} '
+                   f's3://{shlex.quote(bucket_path)} '
+                   f'--endpoint-url {shlex.quote(_r2_endpoint())}'
+                   f'{_r2_profile_flag()}')
         else:
             cmd = f'aws s3 sync {shlex.quote(src)} {shlex.quote(url)}'
         rc = os.system(cmd)
@@ -130,6 +149,30 @@ class Storage:
 # ---------------------------------------------------------------------------
 # On-host commands (reference: sky/data/mounting_utils.py)
 # ---------------------------------------------------------------------------
+def _r2_endpoint() -> str:
+    """Cloudflare R2 S3-compatible endpoint from config/env."""
+    from skypilot_tpu import sky_config
+    account = sky_config.get_nested(('r2', 'account_id')) or \
+        os.environ.get('R2_ACCOUNT_ID')
+    if not account:
+        raise exceptions.StorageSpecError(
+            'R2 storage needs an account id: set r2.account_id in '
+            'config or the R2_ACCOUNT_ID env var.')
+    return f'https://{account}.r2.cloudflarestorage.com'
+
+
+def _r2_profile_flag() -> str:
+    """` --profile <name>` when r2.profile is configured, else ''.
+
+    Default is env credentials (matching the rclone env_auth mount
+    path); a dedicated AWS-CLI profile for R2 keys is opt-in via
+    config, not hardcoded.
+    """
+    from skypilot_tpu import sky_config
+    profile = sky_config.get_nested(('r2', 'profile'))
+    return f' --profile {shlex.quote(str(profile))}' if profile else ''
+
+
 def download_command(uri: str, dst: str) -> str:
     """Shell command to copy a bucket (or https file) onto a host."""
     q = shlex.quote
@@ -139,6 +182,18 @@ def download_command(uri: str, dst: str) -> str:
                 f'gsutil -m rsync -r {q(uri)} {q(dst)})')
     if uri.startswith('s3://'):
         return f'mkdir -p {q(dst)} && aws s3 sync {q(uri)} {q(dst)}'
+    if uri.startswith('az://'):
+        container, _, subpath = uri.split('://', 1)[1].partition('/')
+        pattern = f' --pattern {q(subpath + "/*")}' if subpath else ''
+        return (f'mkdir -p {q(dst)} && '
+                f'az storage blob download-batch -s {q(container)} '
+                f'-d {q(dst)}{pattern}')
+    if uri.startswith('r2://'):
+        bucket_path = uri.split('://', 1)[1]
+        return (f'mkdir -p {q(dst)} && '
+                f'aws s3 sync s3://{q(bucket_path)} {q(dst)} '
+                f'--endpoint-url {q(_r2_endpoint())}'
+                f'{_r2_profile_flag()}')
     if uri.startswith('https://'):
         return (f'mkdir -p $(dirname {q(dst)}) && '
                 f'curl -fsSL {q(uri)} -o {q(dst)}')
@@ -157,8 +212,19 @@ def mount_command(storage: 'Storage', mount_path: str) -> str:
     bucket = url.split('://', 1)[1].split('/', 1)[0]
     if storage.mode == StorageMode.COPY:
         return download_command(url, mount_path)
-    if storage.store == StoreType.S3:
-        remote = f':s3,env_auth=true:{bucket}'
+    if storage.store in (StoreType.S3, StoreType.AZURE, StoreType.R2):
+        # Non-GCS stores all mount via rclone backends with env auth
+        # (the reference's goofys/blobfuse2 role,
+        # sky/data/mounting_utils.py:297-698).
+        if storage.store == StoreType.S3:
+            remote = f':s3,env_auth=true:{bucket}'
+        elif storage.store == StoreType.R2:
+            # rclone connection-string values containing ':' must be
+            # quoted, or parsing stops at 'https'.
+            remote = (f':s3,env_auth=true,'
+                      f'endpoint="{_r2_endpoint()}":{bucket}')
+        else:
+            remote = f':azureblob,env_auth=true:{bucket}'
         cache = ('--vfs-cache-mode writes --vfs-cache-max-size 10G '
                  if storage.mode == StorageMode.MOUNT_CACHED else '')
         return (

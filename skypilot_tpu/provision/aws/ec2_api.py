@@ -1,0 +1,347 @@
+"""Thin EC2 Query API client with stdlib SigV4 signing.
+
+The second real public cloud next to GCP. Where the reference wraps
+boto3 (sky/adaptors/aws.py, sky/provision/aws/instance.py), this
+build signs the EC2 Query API directly — no SDK dependency, the same
+zero-dependency stance as the GCP REST client (`tpu_api.py`), and the
+same `_request()` seam so fake-API tests drive the whole provisioner
+without the network.
+
+Credentials: AWS_ACCESS_KEY_ID / AWS_SECRET_ACCESS_KEY (+ optional
+AWS_SESSION_TOKEN) from env, else the `default` profile of
+~/.aws/credentials.
+"""
+from __future__ import annotations
+
+import configparser
+import datetime
+import hashlib
+import hmac
+import os
+import urllib.error
+import urllib.parse
+import urllib.request
+import xml.etree.ElementTree as ET
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+
+_API_VERSION = '2016-11-15'
+_CREDENTIALS_PATH = '~/.aws/credentials'
+
+
+def load_credentials() -> Optional[Tuple[str, str, Optional[str]]]:
+    """(access_key, secret_key, session_token) or None."""
+    access = os.environ.get('AWS_ACCESS_KEY_ID')
+    secret = os.environ.get('AWS_SECRET_ACCESS_KEY')
+    if access and secret:
+        return access, secret, os.environ.get('AWS_SESSION_TOKEN')
+    path = os.path.expanduser(_CREDENTIALS_PATH)
+    if not os.path.exists(path):
+        return None
+    parser = configparser.ConfigParser()
+    try:
+        parser.read(path)
+    except configparser.Error:
+        return None
+    profile = os.environ.get('AWS_PROFILE', 'default')
+    if profile not in parser:
+        return None
+    section = parser[profile]
+    access = section.get('aws_access_key_id')
+    secret = section.get('aws_secret_access_key')
+    if not access or not secret:
+        return None
+    return access, secret, section.get('aws_session_token')
+
+
+def _sign(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+def _sigv4_headers(region: str, host: str, body: str,
+                   creds: Tuple[str, str, Optional[str]]) -> Dict[str, str]:
+    """AWS Signature Version 4 for a POST to the EC2 Query endpoint."""
+    access, secret, token = creds
+    now = datetime.datetime.now(datetime.timezone.utc)
+    amz_date = now.strftime('%Y%m%dT%H%M%SZ')
+    date_stamp = now.strftime('%Y%m%d')
+    service = 'ec2'
+    payload_hash = hashlib.sha256(body.encode()).hexdigest()
+
+    canonical_headers = (f'content-type:application/x-www-form-urlencoded; '
+                         f'charset=utf-8\nhost:{host}\n'
+                         f'x-amz-date:{amz_date}\n')
+    signed_headers = 'content-type;host;x-amz-date'
+    if token:
+        canonical_headers += f'x-amz-security-token:{token}\n'
+        signed_headers += ';x-amz-security-token'
+    canonical_request = '\n'.join([
+        'POST', '/', '', canonical_headers, signed_headers, payload_hash])
+
+    scope = f'{date_stamp}/{region}/{service}/aws4_request'
+    string_to_sign = '\n'.join([
+        'AWS4-HMAC-SHA256', amz_date, scope,
+        hashlib.sha256(canonical_request.encode()).hexdigest()])
+
+    k = _sign(f'AWS4{secret}'.encode(), date_stamp)
+    k = _sign(k, region)
+    k = _sign(k, service)
+    k = _sign(k, 'aws4_request')
+    signature = hmac.new(k, string_to_sign.encode(),
+                         hashlib.sha256).hexdigest()
+
+    headers = {
+        'Content-Type':
+            'application/x-www-form-urlencoded; charset=utf-8',
+        'X-Amz-Date': amz_date,
+        'Authorization':
+            (f'AWS4-HMAC-SHA256 Credential={access}/{scope}, '
+             f'SignedHeaders={signed_headers}, Signature={signature}'),
+    }
+    if token:
+        headers['X-Amz-Security-Token'] = token
+    return headers
+
+
+def _classify_error(code: str, message: str) -> str:
+    """EC2 error code → failover category (reference:
+    FailoverCloudErrorHandlerV1's _aws_handler blocklist mapping)."""
+    lower = code.lower()
+    # Throttling first: RequestLimitExceeded would otherwise
+    # pattern-match the quota branch.
+    if 'requestlimitexceeded' in lower or 'throttl' in lower or \
+            'unavailable' in lower or 'internalerror' in lower:
+        return exceptions.ProvisionerError.TRANSIENT
+    if 'insufficientinstancecapacity' in lower or \
+            'spotmaxpricetoolow' in lower or \
+            'insufficientcapacity' in lower or \
+            'unsupported' == lower:
+        return exceptions.ProvisionerError.CAPACITY
+    if 'limitexceeded' in lower or 'countexceeded' in lower or \
+            'quota' in lower:
+        # Vcpu/Instance/MaxSpotInstanceCount limits are regional.
+        return exceptions.ProvisionerError.QUOTA
+    if lower in ('unauthorizedoperation', 'authfailure',
+                 'invalidclienttokenid', 'optinrequired',
+                 'pendingverification'):
+        return exceptions.ProvisionerError.PERMISSION
+    if lower.startswith('invalid') or lower.startswith('missing'):
+        return exceptions.ProvisionerError.CONFIG
+    del message
+    return exceptions.ProvisionerError.TRANSIENT
+
+
+def _strip_ns(tag: str) -> str:
+    return tag.rsplit('}', 1)[-1]
+
+
+def _xml_to_obj(elem: ET.Element) -> Any:
+    """EC2 XML → dict/list: <item> children fold into lists."""
+    children = list(elem)
+    if not children:
+        return elem.text or ''
+    items = [c for c in children if _strip_ns(c.tag) == 'item']
+    if items and len(items) == len(children):
+        return [_xml_to_obj(c) for c in items]
+    out: Dict[str, Any] = {}
+    for c in children:
+        out[_strip_ns(c.tag)] = _xml_to_obj(c)
+    return out
+
+
+def _request(region: str, action: str,
+             params: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
+    """One signed EC2 Query API call; XML response parsed to dicts.
+
+    This is the seam the fake-API tests monkeypatch.
+    """
+    creds = load_credentials()
+    if creds is None:
+        raise exceptions.NoCloudAccessError(
+            'AWS credentials not found (env or ~/.aws/credentials).')
+    host = f'ec2.{region}.amazonaws.com'
+    form = {'Action': action, 'Version': _API_VERSION}
+    form.update(params or {})
+    body = urllib.parse.urlencode(sorted(form.items()))
+    headers = _sigv4_headers(region, host, body, creds)
+    req = urllib.request.Request(f'https://{host}/', data=body.encode(),
+                                 headers=headers, method='POST')
+    try:
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            text = resp.read().decode()
+    except urllib.error.HTTPError as e:
+        text = e.read().decode(errors='replace')
+        code, message = 'Unknown', text[:300]
+        try:
+            root = ET.fromstring(text)
+            err = root.find('.//{*}Error')
+            if err is None:
+                err = root.find('.//Error')
+            if err is not None:
+                code = (err.findtext('{*}Code') or
+                        err.findtext('Code') or 'Unknown')
+                message = (err.findtext('{*}Message') or
+                           err.findtext('Message') or message)
+        except ET.ParseError:
+            pass
+        if code in ('InvalidInstanceID.NotFound',
+                    'InvalidGroup.NotFound'):
+            raise exceptions.FetchClusterInfoError(
+                exceptions.FetchClusterInfoError.Reason.HEAD) from e
+        raise exceptions.ProvisionerError(
+            f'EC2 {action} in {region} -> {code}: {message[:300]}',
+            category=_classify_error(code, message)) from e
+    except OSError as e:
+        raise exceptions.ProvisionerError(
+            f'EC2 {action} in {region}: network error {e}',
+            category=exceptions.ProvisionerError.TRANSIENT) from e
+    root = ET.fromstring(text)
+    obj = _xml_to_obj(root)
+    return obj if isinstance(obj, dict) else {'result': obj}
+
+
+def _flatten(prefix: str, values: List[str]) -> Dict[str, str]:
+    return {f'{prefix}.{i + 1}': v for i, v in enumerate(values)}
+
+
+def _filter_params(filters: Dict[str, List[str]]) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for i, (name, values) in enumerate(sorted(filters.items()), start=1):
+        out[f'Filter.{i}.Name'] = name
+        for j, v in enumerate(values, start=1):
+            out[f'Filter.{i}.Value.{j}'] = v
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Instances
+# ---------------------------------------------------------------------------
+def run_instances(region: str, *, count: int, instance_type: str,
+                  image_id: str, cluster_name: str, node_name: str,
+                  zone: Optional[str] = None, spot: bool = False,
+                  disk_size_gb: int = 256,
+                  ssh_pub_key: Optional[str] = None,
+                  security_group_ids: Optional[List[str]] = None,
+                  extra_tags: Optional[Dict[str, str]] = None
+                  ) -> List[Dict[str, Any]]:
+    """RunInstances; returns the instancesSet items."""
+    params: Dict[str, str] = {
+        'MinCount': str(count),
+        'MaxCount': str(count),
+        'InstanceType': instance_type,
+        'ImageId': image_id,
+        ('BlockDeviceMapping.1.DeviceName'): '/dev/sda1',
+        ('BlockDeviceMapping.1.Ebs.VolumeSize'): str(int(disk_size_gb)),
+        ('BlockDeviceMapping.1.Ebs.VolumeType'): 'gp3',
+        ('BlockDeviceMapping.1.Ebs.DeleteOnTermination'): 'true',
+    }
+    if zone:
+        params['Placement.AvailabilityZone'] = zone
+    if spot:
+        params['InstanceMarketOptions.MarketType'] = 'spot'
+        params[('InstanceMarketOptions.SpotOptions.'
+                'InstanceInterruptionBehavior')] = 'terminate'
+    if ssh_pub_key:
+        # cloud-init user-data injects the key: no KeyPair lifecycle to
+        # manage or leak (reference manages named key pairs instead).
+        import base64
+        user_data = ('#cloud-config\n'
+                     'users:\n'
+                     '  - name: skypilot\n'
+                     '    sudo: ALL=(ALL) NOPASSWD:ALL\n'
+                     '    shell: /bin/bash\n'
+                     '    ssh_authorized_keys:\n'
+                     f'      - {ssh_pub_key}\n')
+        params['UserData'] = base64.b64encode(user_data.encode()).decode()
+    if security_group_ids:
+        params.update(_flatten('SecurityGroupId', security_group_ids))
+    tags = {'Name': node_name, 'skypilot-cluster': cluster_name}
+    tags.update(extra_tags or {})
+    params['TagSpecification.1.ResourceType'] = 'instance'
+    for i, (k, v) in enumerate(sorted(tags.items()), start=1):
+        params[f'TagSpecification.1.Tag.{i}.Key'] = k
+        params[f'TagSpecification.1.Tag.{i}.Value'] = v
+    out = _request(region, 'RunInstances', params)
+    instances = out.get('instancesSet', [])
+    if isinstance(instances, dict):
+        instances = [instances]
+    return instances
+
+
+def describe_instances(region: str, cluster_name: str,
+                       include_terminated: bool = False
+                       ) -> List[Dict[str, Any]]:
+    filters = {'tag:skypilot-cluster': [cluster_name]}
+    if not include_terminated:
+        filters['instance-state-name'] = [
+            'pending', 'running', 'stopping', 'stopped', 'shutting-down']
+    out = _request(region, 'DescribeInstances', _filter_params(filters))
+    reservations = out.get('reservationSet', [])
+    if isinstance(reservations, dict):
+        reservations = [reservations]
+    instances: List[Dict[str, Any]] = []
+    for r in reservations:
+        items = r.get('instancesSet', [])
+        if isinstance(items, dict):
+            items = [items]
+        instances.extend(items)
+    return instances
+
+
+def terminate_instances(region: str, instance_ids: List[str]) -> None:
+    if not instance_ids:
+        return
+    _request(region, 'TerminateInstances',
+             _flatten('InstanceId', instance_ids))
+
+
+def stop_instances(region: str, instance_ids: List[str]) -> None:
+    if not instance_ids:
+        return
+    _request(region, 'StopInstances', _flatten('InstanceId', instance_ids))
+
+
+def start_instances(region: str, instance_ids: List[str]) -> None:
+    if not instance_ids:
+        return
+    _request(region, 'StartInstances', _flatten('InstanceId', instance_ids))
+
+
+def authorize_ingress(region: str, group_id: str, ports: List[str]) -> None:
+    """Open TCP ports on a security group, one call per port.
+
+    Per-port (not batched) on purpose: AuthorizeSecurityGroupIngress is
+    atomic, so a batch containing one already-authorized rule rejects
+    the WHOLE call and new ports would silently never open. Duplicate
+    errors on a single port are the idempotent success case.
+    """
+    for port in ports:
+        lo, _, hi = str(port).partition('-')
+        params = {
+            'GroupId': group_id,
+            'IpPermissions.1.IpProtocol': 'tcp',
+            'IpPermissions.1.FromPort': lo,
+            'IpPermissions.1.ToPort': hi or lo,
+            'IpPermissions.1.IpRanges.1.CidrIp': '0.0.0.0/0',
+        }
+        try:
+            _request(region, 'AuthorizeSecurityGroupIngress', params)
+        except exceptions.ProvisionerError as e:
+            if 'Duplicate' not in str(e):
+                raise
+
+
+# State helpers -------------------------------------------------------------
+def instance_state(instance: Dict[str, Any]) -> str:
+    state = instance.get('instanceState', {})
+    if isinstance(state, dict):
+        return str(state.get('name', 'pending'))
+    return str(state)
+
+
+def instance_tags(instance: Dict[str, Any]) -> Dict[str, str]:
+    tags = instance.get('tagSet', [])
+    if isinstance(tags, dict):
+        tags = [tags]
+    return {t.get('key', ''): t.get('value', '') for t in tags}

@@ -1,0 +1,232 @@
+"""AWS provisioner: EC2 instances via the routed interface.
+
+Reference: sky/provision/aws/instance.py (boto3) — same contract
+(run/wait/stop/terminate/query/get_cluster_info/open_ports), driven
+here by the SigV4 Query client (`ec2_api.py`). Nodes are named
+`<cluster>-<i>` via the Name tag and discovered by the
+`skypilot-cluster` tag, so every verb works from the tag filter alone.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.aws import ec2_api
+
+# Canonical (Ubuntu 22.04 LTS amd64 hvm:ebs-ssd) AMIs per region —
+# snapshot table, overridable per-request via resources.image_id.
+_DEFAULT_AMIS = {
+    'us-east-1': 'ami-0e2512bd9da751ea8',
+    'us-east-2': 'ami-0862be96e41dcbf74',
+    'us-west-2': 'ami-03f65b8614a860c29',
+    'eu-west-1': 'ami-0905a3c97561e0b69',
+    'ap-northeast-1': 'ami-07c589821f2b353aa',
+}
+
+_STATE_MAP = {
+    'running': 'running',
+    'pending': 'pending',
+    'stopping': 'stopping',
+    'stopped': 'stopped',
+    'shutting-down': None,
+    'terminated': None,
+}
+
+
+def _node_names(cluster_name_on_cloud: str, count: int) -> List[str]:
+    if count == 1:
+        return [cluster_name_on_cloud]
+    return [f'{cluster_name_on_cloud}-{i}' for i in range(count)]
+
+
+def _ssh_pub_key() -> Optional[str]:
+    from skypilot_tpu import authentication
+    try:
+        _, pub = authentication.get_or_generate_keys()
+        return pub
+    except Exception:  # pylint: disable=broad-except
+        return None
+
+
+def _by_name(region: str, cluster_name_on_cloud: str
+             ) -> Dict[str, Dict[str, Any]]:
+    out: Dict[str, Dict[str, Any]] = {}
+    for inst in ec2_api.describe_instances(region, cluster_name_on_cloud):
+        name = ec2_api.instance_tags(inst).get('Name', '')
+        out[name] = inst
+    return out
+
+
+def run_instances(region: str, cluster_name_on_cloud: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    pc = config.provider_config
+    region = pc.get('region', region)
+    zone = pc.get('zone')
+    instance_type = pc.get('instance_type')
+    if not instance_type:
+        raise exceptions.ProvisionerError(
+            'AWS path needs an instance_type.',
+            category=exceptions.ProvisionerError.CONFIG)
+    image_id = pc.get('image_id') or _DEFAULT_AMIS.get(region)
+    if not image_id:
+        raise exceptions.ProvisionerError(
+            f'No default AMI known for {region}; set image_id.',
+            category=exceptions.ProvisionerError.CONFIG)
+    names = _node_names(cluster_name_on_cloud, config.count)
+    existing = _by_name(region, cluster_name_on_cloud)
+    pub_key = _ssh_pub_key()
+    created, resumed = [], []
+    for name in names:
+        inst = existing.get(name)
+        if inst is not None:
+            state = ec2_api.instance_state(inst)
+            if state == 'stopped':
+                ec2_api.start_instances(region, [inst['instanceId']])
+                resumed.append(name)
+            continue  # running/pending: reuse
+        ec2_api.run_instances(
+            region, count=1, instance_type=instance_type,
+            image_id=image_id, cluster_name=cluster_name_on_cloud,
+            node_name=name, zone=zone, spot=bool(pc.get('use_spot')),
+            disk_size_gb=int(pc.get('disk_size') or 256),
+            ssh_pub_key=pub_key)
+        created.append(name)
+    return common.ProvisionRecord(
+        provider_name='aws',
+        cluster_name=cluster_name_on_cloud,
+        region=region,
+        zone=zone,
+        head_instance_id=names[0],
+        created_instance_ids=created,
+        resumed_instance_ids=resumed,
+        provider_config=dict(pc),
+    )
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str,
+                   state: Optional[str] = None,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   timeout: float = 600, poll: float = 5) -> None:
+    del state
+    pc = provider_config or {}
+    region = pc.get('region', region)
+    count = int(pc.get('num_nodes', 1))
+    names = set(_node_names(cluster_name_on_cloud, count))
+    deadline = time.time() + timeout
+    while True:
+        running = set()
+        for name, inst in _by_name(region, cluster_name_on_cloud).items():
+            st = ec2_api.instance_state(inst)
+            if st == 'running' and name in names:
+                running.add(name)
+            elif st in ('terminated', 'shutting-down') and name in names:
+                raise exceptions.ProvisionerError(
+                    f'EC2 instance {name} entered {st} while waiting.',
+                    category=exceptions.ProvisionerError.CAPACITY)
+        if running == names:
+            return
+        if time.time() > deadline:
+            raise exceptions.ProvisionerError(
+                f'Timed out waiting for {sorted(names - running)} '
+                f'in {region}.')
+        time.sleep(poll)
+
+
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   worker_only: bool = False) -> None:
+    del worker_only
+    pc = provider_config or {}
+    region = pc['region']
+    ids = [inst['instanceId']
+           for inst in ec2_api.describe_instances(region,
+                                                  cluster_name_on_cloud)
+           if ec2_api.instance_state(inst) in ('running', 'pending')]
+    ec2_api.stop_instances(region, ids)
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Optional[Dict[str, Any]] = None,
+                        worker_only: bool = False) -> None:
+    del worker_only
+    pc = provider_config or {}
+    region = pc.get('region')
+    if not region:
+        return
+    ids = [inst['instanceId']
+           for inst in ec2_api.describe_instances(region,
+                                                  cluster_name_on_cloud)]
+    ec2_api.terminate_instances(region, ids)
+
+
+def query_instances(cluster_name_on_cloud: str,
+                    provider_config: Optional[Dict[str, Any]] = None,
+                    non_terminated_only: bool = True
+                    ) -> Dict[str, Optional[str]]:
+    pc = provider_config or {}
+    region = pc['region']
+    out: Dict[str, Optional[str]] = {}
+    for name, inst in _by_name(region, cluster_name_on_cloud).items():
+        status = _STATE_MAP.get(ec2_api.instance_state(inst), 'pending')
+        if non_terminated_only and status is None:
+            continue
+        out[name] = status
+    return out
+
+
+def get_cluster_info(region: str, cluster_name_on_cloud: str,
+                     provider_config: Optional[Dict[str, Any]] = None
+                     ) -> common.ClusterInfo:
+    from skypilot_tpu import constants
+    pc = provider_config or {}
+    region = pc.get('region', region)
+    by_name = _by_name(region, cluster_name_on_cloud)
+    live = {n: i for n, i in sorted(by_name.items())
+            if ec2_api.instance_state(i) not in ('terminated',
+                                                 'shutting-down')}
+    if not live:
+        raise exceptions.FetchClusterInfoError(
+            exceptions.FetchClusterInfoError.Reason.HEAD)
+    instances = []
+    for rank, (name, inst) in enumerate(live.items()):
+        instances.append(common.InstanceInfo(
+            instance_id=name,
+            internal_ip=str(inst.get('privateIpAddress', '')),
+            external_ip=(str(inst['ipAddress'])
+                         if inst.get('ipAddress') else None),
+            ssh_port=22,
+            agent_port=constants.AGENT_PORT,
+            node_rank=rank,
+            host_rank=0,
+        ))
+    return common.ClusterInfo(
+        instances=instances,
+        head_instance_id=instances[0].instance_id,
+        provider_name='aws',
+        provider_config=dict(pc),
+        ssh_user='skypilot',
+        ssh_private_key='~/.ssh/sky-key',
+    )
+
+
+def open_ports(cluster_name_on_cloud: str, ports: List[str],
+               provider_config: Optional[Dict[str, Any]] = None) -> None:
+    pc = provider_config or {}
+    region = pc['region']
+    groups = set()
+    for inst in ec2_api.describe_instances(region, cluster_name_on_cloud):
+        gset = inst.get('groupSet', [])
+        if isinstance(gset, dict):
+            gset = [gset]
+        for g in gset:
+            if g.get('groupId'):
+                groups.add(g['groupId'])
+    for gid in sorted(groups):
+        ec2_api.authorize_ingress(region, gid, ports)
+
+
+def cleanup_ports(cluster_name_on_cloud: str, ports: List[str],
+                  provider_config: Optional[Dict[str, Any]] = None) -> None:
+    del cluster_name_on_cloud, ports, provider_config

@@ -133,9 +133,12 @@ def check() -> List[str]:
 def list_accelerators(name_filter: Optional[str] = None,
                       region_filter: Optional[str] = None
                       ) -> Dict[str, List[Dict[str, Any]]]:
+    from skypilot_tpu.catalog import aws_catalog
     from skypilot_tpu.catalog import gcp_catalog
-    out = gcp_catalog.list_accelerators(name_filter, region_filter)
     result: Dict[str, List[Dict[str, Any]]] = {}
-    for acc, infos in out.items():
-        result[acc] = [i._asdict() for i in infos]
+    for catalog in (gcp_catalog, aws_catalog):
+        for acc, infos in catalog.list_accelerators(
+                name_filter, region_filter).items():
+            result.setdefault(acc, []).extend(
+                i._asdict() for i in infos)
     return result

@@ -116,3 +116,43 @@ def override(config: Dict[str, Any]) -> Iterator[None]:
 def loaded_config_path() -> Optional[str]:
     path = os.path.join(constants.sky_home(), 'config.yaml')
     return path if os.path.exists(os.path.expanduser(path)) else None
+
+
+def user_config_path() -> str:
+    """The writable config layer (`stpu config set` / workspace switch)."""
+    return os.path.expanduser(
+        os.path.join(constants.sky_home(), 'config.yaml'))
+
+
+def set_nested(keys: Tuple[str, ...], value: Any) -> str:
+    """Set (or delete, with value=None) a nested key in the user config.
+
+    Read-modify-write of the file layer only; runtime overrides and the
+    project layer are untouched. Returns the path written. The result
+    must still pass schema validation — a bad value is rejected before
+    the file changes.
+    """
+    path = user_config_path()
+    config = {}
+    if os.path.exists(path):
+        with open(path, 'r', encoding='utf-8') as f:
+            config = yaml.safe_load(f) or {}
+    cur = config
+    for k in keys[:-1]:
+        nxt = cur.get(k)
+        if not isinstance(nxt, dict):
+            nxt = {}
+            cur[k] = nxt
+        cur = nxt
+    if value is None:
+        cur.pop(keys[-1], None)
+    else:
+        cur[keys[-1]] = value
+    from skypilot_tpu.utils import schemas
+    schemas.validate_config(config)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + '.tmp'
+    with open(tmp, 'w', encoding='utf-8') as f:
+        yaml.safe_dump(config, f, default_flow_style=False, sort_keys=False)
+    os.replace(tmp, path)
+    return path

@@ -105,6 +105,9 @@ CONFIG_SCHEMA: Dict[str, Any] = {
     'type': 'object',
     'properties': {
         'api_server': {'type': 'object'},
+        'aws': {'type': 'object'},
+        'azure': {'type': 'object'},
+        'r2': {'type': 'object'},
         'gcp': {'type': 'object'},
         'kubernetes': {'type': 'object'},
         'ssh': {'type': 'object'},

@@ -1,0 +1,99 @@
+"""AWS cloud class + catalog: feasibility, pricing, failover iteration."""
+import pytest
+
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu.catalog import aws_catalog
+from skypilot_tpu.clouds import AWS
+
+
+@pytest.fixture()
+def aws():
+    return AWS()
+
+
+def test_accelerator_to_instance_type(aws):
+    r = resources_lib.Resources(accelerators='A100:8')
+    feas = aws.get_feasible_launchable_resources(r)
+    assert [x.instance_type for x in feas.resources_list] == \
+        ['p4d.24xlarge']
+
+
+def test_cpu_default_instance_type(aws):
+    r = resources_lib.Resources(cpus='8+')
+    feas = aws.get_feasible_launchable_resources(r)
+    assert len(feas.resources_list) == 1
+    it = feas.resources_list[0].instance_type
+    vcpus, _ = aws_catalog.get_vcpus_mem_from_instance_type(it)
+    assert vcpus >= 8
+
+
+def test_tpu_request_infeasible_with_fuzzy_none(aws):
+    r = resources_lib.Resources(accelerators='tpu-v5e-8')
+    feas = aws.get_feasible_launchable_resources(r)
+    assert feas.resources_list == []
+
+
+def test_unknown_gpu_gives_fuzzy_candidates(aws):
+    r = resources_lib.Resources(accelerators='A100:3')
+    feas = aws.get_feasible_launchable_resources(r)
+    assert feas.resources_list == []
+    assert any('A100' in c for c in feas.fuzzy_candidate_list)
+
+
+def test_hourly_cost_spot_cheaper(aws):
+    r = resources_lib.Resources(accelerators='A100:8').copy(
+        cloud=aws, instance_type='p4d.24xlarge')
+    on_demand = aws.get_hourly_cost(r)
+    spot = aws.get_hourly_cost(r.copy(use_spot=True))
+    assert 0 < spot < on_demand
+
+
+def test_regions_with_offering_gpu(aws):
+    regions = AWS.regions_with_offering('p4d.24xlarge', {'A100': 8},
+                                        False, None, None)
+    names = [r.name for r in regions]
+    assert 'us-east-1' in names and 'us-west-2' in names
+    # H100 is narrower:
+    h100 = AWS.regions_with_offering('p5.48xlarge', {'H100': 8},
+                                     False, None, None)
+    assert {r.name for r in h100} == {'us-east-1', 'us-west-2'}
+
+
+def test_zones_provision_loop(aws):
+    batches = list(AWS.zones_provision_loop(
+        region='us-east-1', num_nodes=1, instance_type='p4d.24xlarge',
+        accelerators={'A100': 8}, use_spot=False))
+    assert batches and batches[0][0].name == 'us-east-1a'
+
+
+def test_deploy_variables(aws):
+    from skypilot_tpu.clouds import cloud as cloud_lib
+    r = resources_lib.Resources(accelerators='A100:8').copy(
+        cloud=aws, instance_type='p4d.24xlarge')
+    vars_ = aws.make_deploy_resources_variables(
+        r, 'c-on-cloud', cloud_lib.Region('us-east-1'),
+        [cloud_lib.Zone('us-east-1a')], 2)
+    assert vars_['instance_type'] == 'p4d.24xlarge'
+    assert vars_['region'] == 'us-east-1'
+    assert vars_['zone'] == 'us-east-1a'
+    assert vars_['num_nodes'] == 2
+    assert vars_['tpu_vm'] is False
+
+
+def test_egress_tiers(aws):
+    assert aws.get_egress_cost(0) == 0.0
+    assert aws.get_egress_cost(100) == pytest.approx(9.0)
+    assert aws.get_egress_cost(20480) == pytest.approx(
+        0.09 * 10240 + 0.085 * 10240)
+
+
+def test_validate_region_zone():
+    aws_catalog.validate_region_zone('us-east-1', 'us-east-1a')
+    with pytest.raises(ValueError):
+        aws_catalog.validate_region_zone('mars-central-1', None)
+
+
+def test_trainium_listed():
+    accs = aws_catalog.list_accelerators(name_filter='Trainium')
+    assert 'Trainium' in accs
+    assert accs['Trainium'][0].instance_type == 'trn1.32xlarge'

@@ -1,0 +1,278 @@
+"""AWS EC2 provisioner against a fake Query API.
+
+Mirrors test_gce_provisioner.py: the fake patches the `_request` seam
+(post-XML dict shapes), so run/wait/query/terminate/get_cluster_info
+and the error classifier are exercised without the network.
+"""
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.aws import ec2_api
+from skypilot_tpu.provision.aws import instance as aws_instance
+
+
+class FakeEc2:
+
+    def __init__(self):
+        self.instances = {}  # id -> record
+        self._n = 0
+        self.ingress_calls = []
+        self.fail_run_with = None  # (Code, Message)
+
+    def request(self, region, action, params=None):
+        params = params or {}
+        if action == 'RunInstances':
+            if self.fail_run_with:
+                code, msg = self.fail_run_with
+                raise exceptions.ProvisionerError(
+                    f'EC2 RunInstances in {region} -> {code}: {msg}',
+                    category=ec2_api._classify_error(code, msg))
+            self._n += 1
+            iid = f'i-{self._n:08x}'
+            tags = {}
+            i = 1
+            while f'TagSpecification.1.Tag.{i}.Key' in params:
+                tags[params[f'TagSpecification.1.Tag.{i}.Key']] = \
+                    params[f'TagSpecification.1.Tag.{i}.Value']
+                i += 1
+            rec = {
+                'instanceId': iid,
+                'instanceType': params['InstanceType'],
+                'imageId': params['ImageId'],
+                'instanceState': {'code': '0', 'name': 'pending'},
+                '_polls': 0,
+                'privateIpAddress': f'172.31.0.{self._n}',
+                'ipAddress': f'54.1.0.{self._n}',
+                'tagSet': [{'key': k, 'value': v}
+                           for k, v in tags.items()],
+                'groupSet': [{'groupId': 'sg-123', 'groupName': 'default'}],
+                '_spot': params.get(
+                    'InstanceMarketOptions.MarketType') == 'spot',
+                '_zone': params.get('Placement.AvailabilityZone'),
+                '_user_data': params.get('UserData'),
+            }
+            self.instances[iid] = rec
+            return {'instancesSet': [rec]}
+        if action == 'DescribeInstances':
+            cluster = None
+            i = 1
+            while f'Filter.{i}.Name' in params:
+                if params[f'Filter.{i}.Name'] == 'tag:skypilot-cluster':
+                    cluster = params[f'Filter.{i}.Value.1']
+                i += 1
+            items = []
+            for rec in self.instances.values():
+                tags = {t['key']: t['value'] for t in rec['tagSet']}
+                if cluster and tags.get('skypilot-cluster') != cluster:
+                    continue
+                # Simulate boot: two polls of pending, then running.
+                if rec['instanceState']['name'] == 'pending':
+                    rec['_polls'] += 1
+                    if rec['_polls'] >= 2:
+                        rec['instanceState']['name'] = 'running'
+                items.append(rec)
+            return {'reservationSet': [{'instancesSet': items}]}
+        if action == 'TerminateInstances':
+            for iid in self._ids(params):
+                if iid in self.instances:
+                    self.instances[iid]['instanceState']['name'] = \
+                        'terminated'
+            return {}
+        if action == 'StopInstances':
+            for iid in self._ids(params):
+                self.instances[iid]['instanceState']['name'] = 'stopped'
+            return {}
+        if action == 'StartInstances':
+            for iid in self._ids(params):
+                self.instances[iid]['instanceState']['name'] = 'running'
+                self.instances[iid]['_polls'] = 9
+            return {}
+        if action == 'AuthorizeSecurityGroupIngress':
+            self.ingress_calls.append(params)
+            return {}
+        raise AssertionError(f'unhandled {action}')
+
+    @staticmethod
+    def _ids(params):
+        out = []
+        i = 1
+        while f'InstanceId.{i}' in params:
+            out.append(params[f'InstanceId.{i}'])
+            i += 1
+        return out
+
+
+@pytest.fixture()
+def fake_ec2(monkeypatch):
+    fake = FakeEc2()
+    monkeypatch.setattr(ec2_api, '_request', fake.request)
+    monkeypatch.setattr(aws_instance, '_ssh_pub_key',
+                        lambda: 'ssh-ed25519 AAAA test')
+    return fake
+
+
+def _config(count=1, **pc):
+    base = {'region': 'us-east-1', 'zone': 'us-east-1a',
+            'instance_type': 'p4d.24xlarge', 'num_nodes': count,
+            'use_spot': False, 'disk_size': 100}
+    base.update(pc)
+    return common.ProvisionConfig(provider_config=base,
+                                  authentication_config={}, count=count,
+                                  tags={})
+
+
+def test_run_wait_query_lifecycle(fake_ec2):
+    record = aws_instance.run_instances('us-east-1', 'c1', _config(2))
+    assert record.provider_name == 'aws'
+    assert record.created_instance_ids == ['c1-0', 'c1-1']
+    aws_instance.wait_instances('us-east-1', 'c1',
+                                provider_config=record.provider_config,
+                                poll=0)
+    status = aws_instance.query_instances(
+        'c1', provider_config=record.provider_config)
+    assert status == {'c1-0': 'running', 'c1-1': 'running'}
+
+    info = aws_instance.get_cluster_info(
+        'us-east-1', 'c1', provider_config=record.provider_config)
+    assert info.head_instance_id == 'c1-0'
+    assert len(info.instances) == 2
+    assert info.instances[0].internal_ip.startswith('172.31.')
+    assert info.instances[0].external_ip.startswith('54.')
+    # User-data cloud-init injected the ssh key (no key pairs).
+    rec = next(iter(fake_ec2.instances.values()))
+    assert rec['_user_data'] is not None
+
+
+def test_stop_resume(fake_ec2):
+    record = aws_instance.run_instances('us-east-1', 'c2', _config(1))
+    aws_instance.wait_instances('us-east-1', 'c2',
+                                provider_config=record.provider_config,
+                                poll=0)
+    aws_instance.stop_instances('c2',
+                                provider_config=record.provider_config)
+    assert aws_instance.query_instances(
+        'c2', provider_config=record.provider_config) == {'c2': 'stopped'}
+    # Re-running resumes the stopped node instead of creating a new one.
+    record2 = aws_instance.run_instances('us-east-1', 'c2', _config(1))
+    assert record2.resumed_instance_ids == ['c2']
+    assert record2.created_instance_ids == []
+    assert len(fake_ec2.instances) == 1
+
+
+def test_terminate_then_cluster_info_raises(fake_ec2):
+    record = aws_instance.run_instances('us-east-1', 'c3', _config(1))
+    aws_instance.terminate_instances(
+        'c3', provider_config=record.provider_config)
+    with pytest.raises(exceptions.FetchClusterInfoError):
+        aws_instance.get_cluster_info(
+            'us-east-1', 'c3', provider_config=record.provider_config)
+
+
+def test_open_ports_authorizes_group(fake_ec2):
+    record = aws_instance.run_instances('us-east-1', 'c4', _config(1))
+    aws_instance.open_ports('c4', ['8080', '9000-9010'],
+                            provider_config=record.provider_config)
+    # One call per port: a batched call is atomic, so one duplicate
+    # rule would reject the whole batch and silently skip new ports.
+    assert len(fake_ec2.ingress_calls) == 2
+    first, second = fake_ec2.ingress_calls
+    assert first['GroupId'] == 'sg-123'
+    assert first['IpPermissions.1.FromPort'] == '8080'
+    assert second['IpPermissions.1.FromPort'] == '9000'
+    assert second['IpPermissions.1.ToPort'] == '9010'
+
+
+def test_capacity_error_category(fake_ec2):
+    fake_ec2.fail_run_with = ('InsufficientInstanceCapacity',
+                              'No capacity in us-east-1a')
+    with pytest.raises(exceptions.ProvisionerError) as e:
+        aws_instance.run_instances('us-east-1', 'c5', _config(1))
+    assert e.value.category == exceptions.ProvisionerError.CAPACITY
+    assert not e.value.no_failover
+
+
+def test_quota_error_blocks_region(fake_ec2):
+    fake_ec2.fail_run_with = ('VcpuLimitExceeded', 'limit 0 vCPUs')
+    with pytest.raises(exceptions.ProvisionerError) as e:
+        aws_instance.run_instances('us-east-1', 'c6', _config(1))
+    assert e.value.blocks_region
+
+
+def test_auth_error_no_failover(fake_ec2):
+    fake_ec2.fail_run_with = ('UnauthorizedOperation', 'nope')
+    with pytest.raises(exceptions.ProvisionerError) as e:
+        aws_instance.run_instances('us-east-1', 'c7', _config(1))
+    assert e.value.no_failover
+
+
+def test_classify_error_table():
+    cases = {
+        'InsufficientInstanceCapacity':
+            exceptions.ProvisionerError.CAPACITY,
+        'SpotMaxPriceTooLow': exceptions.ProvisionerError.CAPACITY,
+        'InstanceLimitExceeded': exceptions.ProvisionerError.QUOTA,
+        'MaxSpotInstanceCountExceeded': exceptions.ProvisionerError.QUOTA,
+        'AuthFailure': exceptions.ProvisionerError.PERMISSION,
+        'InvalidParameterValue': exceptions.ProvisionerError.CONFIG,
+        'RequestLimitExceeded': exceptions.ProvisionerError.TRANSIENT,
+        'InternalError': exceptions.ProvisionerError.TRANSIENT,
+    }
+    for code, want in cases.items():
+        assert ec2_api._classify_error(code, '') == want, code
+
+
+def test_xml_to_obj_folds_items():
+    xml = '''<DescribeInstancesResponse xmlns="http://ec2.amazonaws.com/">
+      <reservationSet>
+        <item>
+          <instancesSet>
+            <item><instanceId>i-1</instanceId>
+              <instanceState><name>running</name></instanceState>
+              <tagSet><item><key>Name</key><value>n</value></item></tagSet>
+            </item>
+          </instancesSet>
+        </item>
+      </reservationSet>
+    </DescribeInstancesResponse>'''
+    obj = ec2_api._xml_to_obj(ET.fromstring(xml))
+    inst = obj['reservationSet'][0]['instancesSet'][0]
+    assert inst['instanceId'] == 'i-1'
+    assert ec2_api.instance_state(inst) == 'running'
+    assert ec2_api.instance_tags(inst) == {'Name': 'n'}
+
+
+def test_sigv4_headers_shape():
+    headers = ec2_api._sigv4_headers(
+        'us-east-1', 'ec2.us-east-1.amazonaws.com', 'Action=DescribeRegions',
+        ('AKIDEXAMPLE', 'secret', None))
+    auth = headers['Authorization']
+    assert auth.startswith('AWS4-HMAC-SHA256 Credential=AKIDEXAMPLE/')
+    assert 'SignedHeaders=content-type;host;x-amz-date' in auth
+    assert 'Signature=' in auth
+    assert 'X-Amz-Date' in headers
+    # Session tokens add the header and the signed-headers entry.
+    headers = ec2_api._sigv4_headers(
+        'us-east-1', 'ec2.us-east-1.amazonaws.com', 'x',
+        ('AKIDEXAMPLE', 'secret', 'TOKEN'))
+    assert headers['X-Amz-Security-Token'] == 'TOKEN'
+    assert 'x-amz-security-token' in headers['Authorization']
+
+
+def test_load_credentials_env(monkeypatch):
+    monkeypatch.setenv('AWS_ACCESS_KEY_ID', 'AK')
+    monkeypatch.setenv('AWS_SECRET_ACCESS_KEY', 'SK')
+    monkeypatch.delenv('AWS_SESSION_TOKEN', raising=False)
+    assert ec2_api.load_credentials() == ('AK', 'SK', None)
+
+
+def test_load_credentials_file(monkeypatch, tmp_path):
+    monkeypatch.delenv('AWS_ACCESS_KEY_ID', raising=False)
+    monkeypatch.delenv('AWS_SECRET_ACCESS_KEY', raising=False)
+    creds = tmp_path / 'credentials'
+    creds.write_text('[default]\naws_access_key_id = FK\n'
+                     'aws_secret_access_key = FS\n')
+    monkeypatch.setattr(ec2_api, '_CREDENTIALS_PATH', str(creds))
+    assert ec2_api.load_credentials() == ('FK', 'FS', None)

@@ -1,0 +1,125 @@
+"""CLI tests for the offline command surface (no API server needed).
+
+Reference analog: tests/test_cli.py drives sky's click app with
+CliRunner; same pattern here for config/workspaces/ssh-node-pool/
+recipes/dashboard.
+"""
+import os
+
+import pytest
+import yaml
+from click.testing import CliRunner
+
+from skypilot_tpu.client import cli
+
+
+@pytest.fixture()
+def runner(isolated_state):  # pylint: disable=unused-argument
+    return CliRunner()
+
+
+def test_config_set_get_list_unset(runner, isolated_state):
+    r = runner.invoke(cli.cli, ['config', 'set', 'gcp.project_id', 'proj-1'])
+    assert r.exit_code == 0, r.output
+    path = os.path.join(isolated_state, 'config.yaml')
+    assert os.path.exists(path)
+    with open(path, 'r', encoding='utf-8') as f:
+        assert yaml.safe_load(f) == {'gcp': {'project_id': 'proj-1'}}
+
+    r = runner.invoke(cli.cli, ['config', 'get', 'gcp.project_id'])
+    assert r.exit_code == 0
+    assert 'proj-1' in r.output
+
+    r = runner.invoke(cli.cli, ['config', 'list'])
+    assert r.exit_code == 0
+    assert 'project_id' in r.output
+
+    r = runner.invoke(cli.cli, ['config', 'unset', 'gcp.project_id'])
+    assert r.exit_code == 0
+    r = runner.invoke(cli.cli, ['config', 'get', 'gcp.project_id'])
+    assert r.exit_code != 0
+
+
+def test_config_set_rejects_schema_violation(runner):
+    # `workspaces` must be a mapping; a scalar must be rejected before
+    # the file is written.
+    r = runner.invoke(cli.cli, ['config', 'set', 'workspaces', 'nope'])
+    assert r.exit_code != 0
+    assert 'rejected' in r.output
+
+
+def test_config_set_parses_yaml_values(runner, isolated_state):
+    r = runner.invoke(cli.cli,
+                      ['config', 'set', 'api_server.port', '46581'])
+    assert r.exit_code == 0
+    with open(os.path.join(isolated_state, 'config.yaml'),
+              encoding='utf-8') as f:
+        assert yaml.safe_load(f)['api_server']['port'] == 46581
+
+
+def test_workspaces_ls_and_switch(runner, isolated_state):
+    runner.invoke(cli.cli, ['config', 'set', 'workspaces',
+                            '{team-a: {allowed_clouds: [gcp]}}'])
+    r = runner.invoke(cli.cli, ['workspaces', 'ls'])
+    assert r.exit_code == 0, r.output
+    assert 'team-a' in r.output and 'default' in r.output
+
+    r = runner.invoke(cli.cli, ['workspaces', 'switch', 'team-a'])
+    assert r.exit_code == 0
+    from skypilot_tpu.workspaces import core as ws_core
+    assert ws_core.active_workspace() == 'team-a'
+
+    r = runner.invoke(cli.cli, ['workspaces', 'switch', 'nope'])
+    assert r.exit_code != 0
+
+
+def test_workspaces_show(runner, isolated_state):
+    runner.invoke(cli.cli, ['config', 'set', 'workspaces',
+                            '{team-a: {allowed_clouds: [gcp]}}'])
+    r = runner.invoke(cli.cli, ['workspaces', 'show', 'team-a'])
+    assert r.exit_code == 0
+    assert 'gcp' in r.output
+
+
+def test_ssh_node_pool_ls(runner, tmp_path, monkeypatch):
+    from skypilot_tpu.clouds import ssh as ssh_cloud
+    pools_file = tmp_path / 'pools.yaml'
+    pools_file.write_text(yaml.safe_dump({
+        'pools': {'lab': {'user': 'ubuntu',
+                          'identity_file': '~/.ssh/k',
+                          'hosts': ['10.0.0.1', '10.0.0.2']}}}))
+    monkeypatch.setattr(ssh_cloud, 'POOLS_PATH', str(pools_file))
+    r = runner.invoke(cli.cli, ['ssh-node-pool', 'ls'])
+    assert r.exit_code == 0, r.output
+    assert 'lab' in r.output and '2' in r.output
+
+
+def test_ssh_node_pool_check_unknown_pool(runner, tmp_path, monkeypatch):
+    from skypilot_tpu.clouds import ssh as ssh_cloud
+    monkeypatch.setattr(ssh_cloud, 'POOLS_PATH',
+                        str(tmp_path / 'none.yaml'))
+    r = runner.invoke(cli.cli, ['ssh-node-pool', 'check', 'nope'])
+    assert r.exit_code != 0
+
+
+def test_dashboard_prints_url(runner):
+    r = runner.invoke(cli.cli, ['dashboard', '--no-open'])
+    assert r.exit_code == 0
+    assert '/dashboard' in r.output
+
+
+def test_recipes_list_and_show(runner):
+    r = runner.invoke(cli.cli, ['recipes', 'list'])
+    assert r.exit_code == 0, r.output
+    r = runner.invoke(cli.cli, ['recipes', 'show', 'nope-recipe'])
+    assert r.exit_code != 0
+
+
+def test_api_login_writes_endpoint(runner, isolated_state):
+    r = runner.invoke(cli.cli, ['api', 'login', '-e',
+                                'http://127.0.0.1:1'])
+    assert r.exit_code == 0, r.output
+    with open(os.path.join(isolated_state, 'config.yaml'),
+              encoding='utf-8') as f:
+        cfg = yaml.safe_load(f)
+    assert cfg['api_server']['endpoint'] == 'http://127.0.0.1:1'

@@ -274,3 +274,59 @@ def test_s3_mount_commands():
         source='s3://datasets', mode=storage_lib.StorageMode.MOUNT_CACHED)
     cmd = storage_lib.mount_command(cached, '/data')
     assert '--vfs-cache-mode writes' in cmd
+
+
+def test_azure_store_commands():
+    from skypilot_tpu.data import storage as storage_lib
+    st = storage_lib.Storage(source='az://ckpts',
+                             mode=storage_lib.StorageMode.MOUNT)
+    assert st.store == storage_lib.StoreType.AZURE
+    cmd = storage_lib.mount_command(st, '/data')
+    assert ':azureblob,env_auth=true:ckpts' in cmd
+    copy = storage_lib.Storage(source='az://ckpts',
+                               mode=storage_lib.StorageMode.COPY)
+    cmd = storage_lib.mount_command(copy, '/data')
+    assert 'az storage blob download-batch' in cmd
+    # Sub-path urls: the az CLI takes a bare container name; the
+    # sub-path must become a --pattern filter, not part of -s.
+    sub = storage_lib.Storage(source='az://ckpts/run1',
+                              mode=storage_lib.StorageMode.COPY)
+    cmd = storage_lib.mount_command(sub, '/data')
+    assert '-s ckpts ' in cmd and "--pattern 'run1/*'" in cmd
+
+
+def test_r2_store_commands(monkeypatch):
+    from skypilot_tpu.data import storage as storage_lib
+    monkeypatch.setenv('R2_ACCOUNT_ID', 'acct123')
+    st = storage_lib.Storage(source='r2://models',
+                             mode=storage_lib.StorageMode.MOUNT)
+    assert st.store == storage_lib.StoreType.R2
+    cmd = storage_lib.mount_command(st, '/models')
+    # rclone connection-string values with ':' must be quoted.
+    assert 'endpoint="https://acct123.r2.cloudflarestorage.com"' in cmd
+    copy = storage_lib.Storage(source='r2://models',
+                               mode=storage_lib.StorageMode.COPY)
+    cmd = storage_lib.mount_command(copy, '/models')
+    assert '--endpoint-url' in cmd and 'aws s3 sync' in cmd
+    # No hardcoded profile: env credentials by default, profile opt-in.
+    assert '--profile' not in cmd
+
+
+def test_r2_requires_account_id(monkeypatch):
+    import pytest as _pytest
+    from skypilot_tpu import exceptions as exc
+    from skypilot_tpu.data import storage as storage_lib
+    monkeypatch.delenv('R2_ACCOUNT_ID', raising=False)
+    st = storage_lib.Storage(source='r2://models',
+                             mode=storage_lib.StorageMode.MOUNT)
+    with _pytest.raises(exc.StorageSpecError):
+        storage_lib.mount_command(st, '/models')
+
+
+def test_storage_yaml_roundtrip_new_stores():
+    from skypilot_tpu.data import storage as storage_lib
+    for url, store in (('az://c1', 'AZURE'), ('r2://b1', 'R2')):
+        st = storage_lib.Storage.from_yaml_config({'source': url})
+        assert st.store.value == store
+        assert storage_lib.Storage.from_yaml_config(
+            st.to_yaml_config()).bucket_url == url
