@@ -1165,22 +1165,51 @@ def dashboard(no_open) -> None:
 
 
 @api.command(name='login')
-@click.option('--endpoint', '-e', required=True,
+@click.option('--endpoint', '-e', default=None,
               help='API server URL, e.g. http://host:46580')
 @click.option('--token', default=None,
               help='Service-account token (or set SKYPILOT_API_TOKEN).')
-def api_login(endpoint, token) -> None:
+@click.option('--oauth', 'use_oauth', is_flag=True, default=False,
+              help='Browser OIDC login (needs oauth.issuer/client_id).')
+@click.option('--issuer', default=None, help='Override oauth.issuer.')
+@click.option('--client-id', default=None,
+              help='Override oauth.client_id.')
+@click.option('--no-browser', is_flag=True, default=False,
+              help='Print the authorize URL instead of opening it.')
+def api_login(endpoint, token, use_oauth, issuer, client_id,
+              no_browser) -> None:
     """Point this client at a remote API server (persisted in config)."""
     from skypilot_tpu import sky_config
-    endpoint = endpoint.rstrip('/')
-    sky_config.set_nested(('api_server', 'endpoint'), endpoint)
+    if not endpoint and not use_oauth:
+        raise click.UsageError('pass --endpoint and/or --oauth')
+    if endpoint:
+        endpoint = endpoint.rstrip('/')
+        sky_config.set_nested(('api_server', 'endpoint'), endpoint)
     if token:
         sky_config.set_nested(('api_server', 'auth_token'), token)
-    info = sdk.api_info(endpoint)
-    if info is None:
-        click.secho(f'Warning: {endpoint} is not reachable right now.',
-                    fg='yellow', err=True)
-    click.echo(f'Logged in to {endpoint}.')
+    if use_oauth:
+        import requests as _requests
+        from skypilot_tpu.client import oauth as oauth_lib
+        try:
+            oauth_lib.login(issuer=issuer, client_id=client_id,
+                            open_browser=not no_browser)
+        except (exceptions.SkyError, _requests.RequestException) as e:
+            _err(f'OAuth login failed: {e}')
+        click.echo('OAuth login complete; token cached.')
+    if endpoint:
+        info = sdk.api_info(endpoint)
+        if info is None:
+            click.secho(f'Warning: {endpoint} is not reachable right now.',
+                        fg='yellow', err=True)
+        click.echo(f'Logged in to {endpoint}.')
+
+
+@api.command(name='logout')
+def api_logout() -> None:
+    """Drop the cached OAuth token."""
+    from skypilot_tpu.client import oauth as oauth_lib
+    click.echo('Logged out.' if oauth_lib.logout()
+               else 'No cached OAuth token.')
 
 
 @recipes.command(name='launch')

@@ -1,0 +1,218 @@
+"""OIDC login for the CLI/SDK: authorization-code flow with PKCE.
+
+Reference: sky/client/oauth.py — browser login against the operator's
+IdP; the resulting JWT rides every API request as a Bearer token and
+the server verifies it offline (users/oidc.py). Tokens are cached at
+~/.sky-tpu/oauth_token.json and refreshed with the refresh token.
+
+Config:
+  oauth:
+    issuer: https://idp.example.com
+    client_id: stpu-cli
+"""
+from __future__ import annotations
+
+import base64
+import hashlib
+import http.server
+import json
+import os
+import secrets
+import threading
+import time
+import urllib.parse
+from typing import Any, Dict, Optional
+
+import requests
+
+from skypilot_tpu import constants
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_config
+
+
+def _token_path() -> str:
+    return os.path.join(constants.sky_home(), 'oauth_token.json')
+
+
+def _save_tokens(tokens: Dict[str, Any]) -> None:
+    path = _token_path()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, 'w', encoding='utf-8') as f:
+        json.dump(tokens, f)
+
+
+def _load_tokens() -> Optional[Dict[str, Any]]:
+    try:
+        with open(_token_path(), 'r', encoding='utf-8') as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def logout() -> bool:
+    try:
+        os.remove(_token_path())
+        return True
+    except OSError:
+        return False
+
+
+def _discover(issuer: str) -> Dict[str, Any]:
+    url = issuer.rstrip('/') + '/.well-known/openid-configuration'
+    resp = requests.get(url, timeout=10)
+    resp.raise_for_status()
+    return resp.json()
+
+
+class _CallbackHandler(http.server.BaseHTTPRequestHandler):
+    code: Optional[str] = None
+    state_expected: str = ''
+    error: Optional[str] = None
+
+    def do_GET(self):  # noqa: N802
+        parsed = urllib.parse.urlparse(self.path)
+        if parsed.path != '/callback':
+            # Browsers also fetch /favicon.ico etc.; those must not
+            # count as a state mismatch against a successful login.
+            self.send_response(404)
+            self.end_headers()
+            return
+        query = urllib.parse.parse_qs(parsed.query)
+        cls = type(self)
+        if query.get('state', [''])[0] != cls.state_expected:
+            cls.error = 'state mismatch'
+        elif 'error' in query:
+            cls.error = query['error'][0]
+        else:
+            cls.code = query.get('code', [None])[0]
+        self.send_response(200)
+        self.send_header('Content-Type', 'text/html')
+        self.end_headers()
+        self.wfile.write(b'<html><body>Login complete; you can close '
+                         b'this tab and return to the terminal.'
+                         b'</body></html>')
+
+    def log_message(self, *args):  # silence
+        del args
+
+
+def login(issuer: Optional[str] = None,
+          client_id: Optional[str] = None,
+          open_browser: bool = True,
+          timeout: float = 300.0) -> Dict[str, Any]:
+    """Run the PKCE authorization-code flow; cache and return tokens."""
+    issuer = issuer or sky_config.get_nested(('oauth', 'issuer'))
+    client_id = client_id or sky_config.get_nested(('oauth', 'client_id'))
+    if not issuer or not client_id:
+        raise exceptions.SkyError(
+            'OAuth login needs oauth.issuer and oauth.client_id in '
+            'config (or pass --issuer/--client-id).')
+    meta = _discover(issuer)
+
+    verifier = secrets.token_urlsafe(48)
+    challenge = base64.urlsafe_b64encode(
+        hashlib.sha256(verifier.encode()).digest()).decode().rstrip('=')
+    state = secrets.token_urlsafe(16)
+
+    _CallbackHandler.code = None
+    _CallbackHandler.error = None
+    _CallbackHandler.state_expected = state
+    server = http.server.HTTPServer(('127.0.0.1', 0), _CallbackHandler)
+    port = server.server_address[1]
+    redirect_uri = f'http://127.0.0.1:{port}/callback'
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+
+    params = {
+        'response_type': 'code',
+        'client_id': client_id,
+        'redirect_uri': redirect_uri,
+        'scope': 'openid email profile offline_access',
+        'state': state,
+        'code_challenge': challenge,
+        'code_challenge_method': 'S256',
+    }
+    authorize_url = (meta['authorization_endpoint'] + '?' +
+                     urllib.parse.urlencode(params))
+    print(f'Open this URL to log in:\n  {authorize_url}')
+    if open_browser:
+        import webbrowser
+        webbrowser.open(authorize_url)
+
+    deadline = time.time() + timeout
+    try:
+        while _CallbackHandler.code is None and \
+                _CallbackHandler.error is None:
+            if time.time() > deadline:
+                raise exceptions.SkyError('OAuth login timed out.')
+            time.sleep(0.2)
+    finally:
+        server.shutdown()
+        thread.join(timeout=5)
+    if _CallbackHandler.error:
+        raise exceptions.SkyError(
+            f'OAuth login failed: {_CallbackHandler.error}')
+
+    resp = requests.post(meta['token_endpoint'], data={
+        'grant_type': 'authorization_code',
+        'code': _CallbackHandler.code,
+        'redirect_uri': redirect_uri,
+        'client_id': client_id,
+        'code_verifier': verifier,
+    }, timeout=30)
+    resp.raise_for_status()
+    tokens = resp.json()
+    tokens['issuer'] = issuer
+    tokens['client_id'] = client_id
+    tokens['expires_at'] = time.time() + float(
+        tokens.get('expires_in', 3600))
+    _save_tokens(tokens)
+    return tokens
+
+
+def _refresh(tokens: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    refresh_token = tokens.get('refresh_token')
+    if not refresh_token:
+        return None
+    try:
+        meta = _discover(tokens['issuer'])
+        resp = requests.post(meta['token_endpoint'], data={
+            'grant_type': 'refresh_token',
+            'refresh_token': refresh_token,
+            'client_id': tokens.get('client_id', ''),
+        }, timeout=30)
+        resp.raise_for_status()
+        new = resp.json()
+    except (requests.RequestException, KeyError, ValueError):
+        return None
+    tokens = {**tokens, **new}
+    tokens['expires_at'] = time.time() + float(new.get('expires_in', 3600))
+    _save_tokens(tokens)
+    return tokens
+
+
+# Failed-refresh backoff: without it, an expired token + unreachable
+# IdP would add discovery+refresh timeouts to EVERY SDK/CLI call.
+_refresh_failed_at = 0.0
+_REFRESH_RETRY_INTERVAL = 60.0
+
+
+def get_access_token() -> Optional[str]:
+    """The cached (auto-refreshed) access token, or None if not
+    logged in. Used by sdk._headers as the Bearer fallback."""
+    global _refresh_failed_at
+    tokens = _load_tokens()
+    if tokens is None:
+        return None
+    if time.time() >= float(tokens.get('expires_at', 0)) - 30:
+        if time.time() - _refresh_failed_at < _REFRESH_RETRY_INTERVAL:
+            return None
+        tokens = _refresh(tokens)
+        if tokens is None:
+            _refresh_failed_at = time.time()
+            return None
+        _refresh_failed_at = 0.0
+    # id_token carries the identity claims the server verifies;
+    # fall back to access_token for IdPs that make it a JWT too.
+    return tokens.get('id_token') or tokens.get('access_token')

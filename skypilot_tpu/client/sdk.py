@@ -44,6 +44,10 @@ def _headers() -> Dict[str, str]:
     if not token:
         from skypilot_tpu import sky_config
         token = sky_config.get_nested(('api_server', 'auth_token'))
+    if not token:
+        # OIDC login fallback (client/oauth.py): cached, auto-refreshed.
+        from skypilot_tpu.client import oauth
+        token = oauth.get_access_token()
     if token:
         headers['Authorization'] = f'Bearer {token}'
     return headers

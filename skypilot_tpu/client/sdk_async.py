@@ -1,0 +1,241 @@
+"""Async SDK: the sdk.py verbs as coroutines over one aiohttp session.
+
+Reference: sky/client/sdk_async.py — same surface as the sync SDK,
+returning request ids awaitable via `get`/`stream_and_get`. Shares the
+sync module's endpoint resolution, auth headers, and version handshake
+so the two clients cannot drift; transport is aiohttp so callers can
+fan out many control-plane calls concurrently (e.g. launching N
+clusters from one coroutine).
+
+Usage:
+    async with AsyncClient() as client:
+        rid = await client.launch(task, cluster_name='c1')
+        result = await client.get(rid)
+"""
+from __future__ import annotations
+
+import asyncio
+import sys
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+import aiohttp
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.client import sdk as sync_sdk
+
+
+class AsyncClient:
+    """One aiohttp session over the configured API server."""
+
+    def __init__(self, server_url: Optional[str] = None) -> None:
+        self._url = (server_url or sync_sdk.api_server_url()).rstrip('/')
+        self._session: Optional[aiohttp.ClientSession] = None
+
+    async def __aenter__(self) -> 'AsyncClient':
+        self._session = aiohttp.ClientSession()
+        return self
+
+    async def __aexit__(self, *exc: Any) -> None:
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+    @property
+    def session(self) -> aiohttp.ClientSession:
+        assert self._session is not None, \
+            'use `async with AsyncClient() as client:`'
+        return self._session
+
+    # -- plumbing -----------------------------------------------------------
+    @staticmethod
+    async def _headers() -> Dict[str, str]:
+        # sync_sdk._headers() reads config YAML from disk and may do
+        # network I/O (OAuth token refresh) — off the event loop.
+        loop = asyncio.get_event_loop()
+        return await loop.run_in_executor(
+            None, sync_sdk._headers)  # pylint: disable=protected-access
+
+    async def _post(self, path: str, payload: Dict[str, Any],
+                    retries: int = 4) -> str:
+        headers = await self._headers()
+        # Same idempotency contract as the sync SDK: one client id per
+        # logical request, so retries re-join instead of double-run.
+        headers['X-Skypilot-Request-ID'] = uuid.uuid4().hex[:16]
+        for attempt in range(retries + 1):
+            try:
+                async with self.session.post(
+                        f'{self._url}{path}', json=payload,
+                        headers=headers,
+                        timeout=aiohttp.ClientTimeout(total=30)) as resp:
+                    if resp.status in (401, 403):
+                        body = await resp.json()
+                        raise exceptions.PermissionDeniedError(
+                            body.get('error', 'permission denied'))
+                    resp.raise_for_status()
+                    body = await resp.json()
+                    return body['request_id']
+            except (aiohttp.ClientConnectionError,
+                    asyncio.TimeoutError) as e:
+                if attempt == retries:
+                    raise exceptions.ApiServerConnectionError(
+                        f'{self._url}: {e}') from e
+                await asyncio.sleep(min(2.0, 0.2 * 2**attempt))
+        raise AssertionError('unreachable')  # pragma: no cover
+
+    async def get(self, request_id: str,
+                  timeout: Optional[float] = None) -> Any:
+        """Await a request's result (long-poll loop, like sdk.get)."""
+        deadline = time.time() + timeout if timeout else None
+        transient = 0
+        headers = await self._headers()
+        while True:
+            try:
+                async with self.session.get(
+                        f'{self._url}/api/get',
+                        params={'request_id': request_id, 'timeout': 10},
+                        headers=headers,
+                        timeout=aiohttp.ClientTimeout(total=40)) as resp:
+                    if resp.status == 404:
+                        raise exceptions.RequestNotFoundError(request_id)
+                    resp.raise_for_status()
+                    body = await resp.json()
+                transient = 0
+            except (aiohttp.ClientConnectionError,
+                    asyncio.TimeoutError):
+                transient += 1
+                if transient > 8:
+                    raise
+                await asyncio.sleep(min(2.0, 0.2 * 2**transient))
+                continue
+            status = body['status']
+            if status == 'SUCCEEDED':
+                return body.get('return_value')
+            if status == 'FAILED':
+                raise exceptions.deserialize_exception(
+                    body.get('error') or {})
+            if status == 'CANCELLED':
+                raise exceptions.RequestCancelled(request_id)
+            if deadline and time.time() > deadline:
+                raise TimeoutError(f'request {request_id} still {status}')
+
+    async def stream_and_get(self, request_id: str, output=None) -> Any:
+        """Stream the request's log lines, then return its value."""
+        out = output or sys.stderr
+        headers = await self._headers()
+        async with self.session.get(
+                f'{self._url}/api/stream',
+                params={'request_id': request_id, 'follow': '1'},
+                headers=headers,
+                timeout=aiohttp.ClientTimeout(total=None,
+                                              sock_connect=30)) as resp:
+            resp.raise_for_status()
+            async for raw in resp.content:
+                print(raw.decode(errors='replace').rstrip('\n'),
+                      file=out, flush=True)
+        return await self.get(request_id)
+
+    async def api_cancel(self, request_id: str) -> bool:
+        headers = await self._headers()
+        async with self.session.post(
+                f'{self._url}/api/cancel',
+                json={'request_id': request_id}, headers=headers,
+                timeout=aiohttp.ClientTimeout(total=30)) as resp:
+            resp.raise_for_status()
+            return (await resp.json()).get('cancelled', False)
+
+    # -- verbs (same payloads as sdk.py) ------------------------------------
+    async def launch(self, task, cluster_name: Optional[str] = None, *,
+                     dryrun: bool = False, detach_run: bool = True,
+                     idle_minutes_to_autostop: Optional[int] = None,
+                     down: bool = False, retry_until_up: bool = False,
+                     no_setup: bool = False, optimize_target: str = 'cost',
+                     env_overrides: Optional[Dict[str, str]] = None) -> str:
+        return await self._post('/launch', {
+            'task_config': task.to_yaml_config(),
+            'cluster_name': cluster_name,
+            'dryrun': dryrun,
+            'detach_run': detach_run,
+            'idle_minutes_to_autostop': idle_minutes_to_autostop,
+            'optimize_target': optimize_target,
+            'down': down,
+            'retry_until_up': retry_until_up,
+            'no_setup': no_setup,
+            'env_overrides': env_overrides,
+        })
+
+    async def exec(self, task, cluster_name: str, *,  # pylint: disable=redefined-builtin
+                   dryrun: bool = False, detach_run: bool = True,
+                   env_overrides: Optional[Dict[str, str]] = None) -> str:
+        return await self._post('/exec', {
+            'task_config': task.to_yaml_config(),
+            'cluster_name': cluster_name,
+            'dryrun': dryrun,
+            'detach_run': detach_run,
+            'env_overrides': env_overrides,
+        })
+
+    async def status(self, cluster_names: Optional[List[str]] = None,
+                     refresh: bool = False) -> str:
+        return await self._post('/status',
+                                {'cluster_names': cluster_names,
+                                 'refresh': refresh})
+
+    async def start(self, cluster_name: str) -> str:
+        return await self._post('/start', {'cluster_name': cluster_name})
+
+    async def stop(self, cluster_name: str) -> str:
+        return await self._post('/stop', {'cluster_name': cluster_name})
+
+    async def down(self, cluster_name: str, purge: bool = False) -> str:
+        return await self._post('/down', {'cluster_name': cluster_name,
+                                          'purge': purge})
+
+    async def autostop(self, cluster_name: str, idle_minutes: int,
+                       down_on_idle: bool = False) -> str:
+        return await self._post('/autostop',
+                                {'cluster_name': cluster_name,
+                                 'idle_minutes': idle_minutes,
+                                 'down_on_idle': down_on_idle})
+
+    async def queue(self, cluster_name: str, all_jobs: bool = False) -> str:
+        return await self._post('/queue', {'cluster_name': cluster_name,
+                                           'all_jobs': all_jobs})
+
+    async def cancel(self, cluster_name: str,
+                     job_ids: Optional[List[int]] = None,
+                     all_jobs: bool = False) -> str:
+        return await self._post('/cancel', {'cluster_name': cluster_name,
+                                            'job_ids': job_ids,
+                                            'all_jobs': all_jobs})
+
+    async def cost_report(self) -> str:
+        return await self._post('/cost_report', {})
+
+    async def check(self) -> str:
+        return await self._post('/check', {})
+
+    async def list_accelerators(
+            self, name_filter: Optional[str] = None,
+            region_filter: Optional[str] = None) -> str:
+        return await self._post('/accelerators',
+                                {'name_filter': name_filter,
+                                 'region_filter': region_filter})
+
+    async def storage_ls(self) -> str:
+        return await self._post('/storage/ls', {})
+
+    async def storage_delete(self, name: str) -> str:
+        return await self._post('/storage/delete', {'name': name})
+
+    async def jobs_queue(self, refresh: bool = False,
+                         skip_finished: bool = False) -> str:
+        return await self._post('/jobs/queue',
+                                {'refresh': refresh,
+                                 'skip_finished': skip_finished})
+
+    async def serve_status(
+            self, service_names: Optional[List[str]] = None) -> str:
+        return await self._post('/serve/status',
+                                {'service_names': service_names})

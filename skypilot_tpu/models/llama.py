@@ -197,5 +197,9 @@ class Llama(nn.Module):
             nn.with_logical_partitioning(
                 nn.initializers.normal(stddev=0.02), ('embed', 'vocab')),
             (cfg.embed_dim, cfg.vocab_size), jnp.float32)
-        logits = jnp.einsum('bse,ev->bsv', x.astype(jnp.float32), head)
+        # bf16 operands + f32 accumulation: MXU-native rate, f32-safe
+        # softmax numerics (same treatment as models/gpt.py).
+        logits = jnp.einsum('bse,ev->bsv', x.astype(cfg.dtype),
+                            head.astype(cfg.dtype),
+                            preferred_element_type=jnp.float32)
         return nn.with_logical_constraint(logits, ('batch', 'seq', 'vocab'))

@@ -33,12 +33,18 @@ class TrainState(struct.PyTreeNode):
 
 
 def next_token_loss(logits: jax.Array, tokens: jax.Array) -> jax.Array:
-    """Causal LM loss: predict tokens[:, 1:] from logits[:, :-1]."""
+    """Causal LM loss: predict tokens[:, 1:] from logits[:, :-1].
+
+    logsumexp form: only the [B,S] target logits and the [B,S]
+    normalizer survive — no second [B,S,V] log-prob array in HBM
+    (the [B,S,V] logits are already the memory high-water mark).
+    """
     logits = logits[:, :-1]
     targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+    target_logit = jnp.take_along_axis(
+        logits, targets[..., None], axis=-1)[..., 0]
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    return jnp.mean(lse - target_logit)
 
 
 def default_optimizer(learning_rate: float = 3e-4,

@@ -417,9 +417,20 @@ async def auth_middleware(request: web.Request, handler):
     # which the page prompts for).
     open_paths = ('/api/health', '/dashboard', '/dashboard/app.js')
     if request.path not in open_paths:
+        from skypilot_tpu.users import oidc
         tokens_on = await loop.run_in_executor(None,
                                                tokens_lib.auth_required)
-        if tokens_on:
+        oidc_on = oidc.enabled()
+        if oidc_on and bearer and oidc.looks_like_jwt(bearer):
+            # OIDC bearer JWTs: identity from verified claims
+            # (reference: sky/server/auth/ OAuth middleware).
+            ident = await loop.run_in_executor(None, oidc.verify_jwt,
+                                               bearer)
+            if ident is None:
+                return web.json_response({'error': 'unauthorized'},
+                                         status=401)
+            user, role = ident['user'], ident['role']
+        elif tokens_on:
             if static_token and bearer == static_token:
                 pass  # bootstrap admin keeps header identity
             else:
@@ -433,6 +444,10 @@ async def auth_middleware(request: web.Request, handler):
             if bearer != static_token:
                 return web.json_response({'error': 'unauthorized'},
                                          status=401)
+        elif oidc_on:
+            # OIDC configured and nothing else matched: JWT required.
+            return web.json_response({'error': 'unauthorized'},
+                                     status=401)
     request['sky_user'] = user
     request['sky_role'] = role
     if user and user != 'unknown':

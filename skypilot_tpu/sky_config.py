@@ -113,6 +113,13 @@ def override(config: Dict[str, Any]) -> Iterator[None]:
         _local.overrides.pop()
 
 
+def has_overrides() -> bool:
+    """True while a runtime `override()` context is active (per-request
+    config, tests) — callers that cache file-layer reads must bypass
+    their cache then."""
+    return bool(getattr(_local, 'overrides', []))
+
+
 def loaded_config_path() -> Optional[str]:
     path = os.path.join(constants.sky_home(), 'config.yaml')
     return path if os.path.exists(os.path.expanduser(path)) else None

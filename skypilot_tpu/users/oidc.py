@@ -1,0 +1,177 @@
+"""OIDC bearer-token verification for the API server.
+
+Reference: sky/server/auth/ + sky/users/token_service.py — OAuth/OIDC
+login where identity comes from a signed JWT instead of a stored
+service token. Zero-egress friendly: the verification keys come from
+config (`oauth.jwks` inline, or `oauth.jwks_path` file — e.g. synced
+from the IdP by the operator); no JWKS fetch is required at request
+time. RS256 via `cryptography`; no external JWT package.
+
+Config (api server):
+  oauth:
+    issuer: https://idp.example.com
+    client_id: stpu-cli
+    jwks_path: /etc/stpu/jwks.json       # or `jwks: {keys: [...]}`
+    admin_users: [alice@example.com]
+"""
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import os
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from skypilot_tpu import sky_config
+
+
+def _b64url_decode(data: str) -> bytes:
+    pad = '=' * (-len(data) % 4)
+    return base64.urlsafe_b64decode(data + pad)
+
+
+def _b64url_encode(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).decode().rstrip('=')
+
+
+# The oauth config block is read per request in the server's auth
+# middleware; sky_config rebuilds (and schema-validates) every YAML
+# layer per get_nested call, so snapshot it with a short TTL.
+_cfg_cache: Tuple[float, Optional[Dict[str, Any]]] = (0.0, None)
+_CFG_TTL = 5.0
+
+
+def _oauth_cfg() -> Dict[str, Any]:
+    global _cfg_cache
+    if sky_config.has_overrides():
+        # Runtime overrides (per-request config, tests) must never be
+        # served from — or poison — the file-layer snapshot.
+        return sky_config.get_nested(('oauth',), {}) or {}
+    now = time.time()
+    ts, cached = _cfg_cache
+    if cached is None or now - ts > _CFG_TTL:
+        cached = sky_config.get_nested(('oauth',), {}) or {}
+        _cfg_cache = (now, cached)
+    return cached
+
+
+def enabled() -> bool:
+    return bool(_oauth_cfg().get('issuer'))
+
+
+def _load_jwks() -> Dict[str, Any]:
+    jwks = _oauth_cfg().get('jwks')
+    if jwks:
+        return jwks
+    path = _oauth_cfg().get('jwks_path')
+    if path and os.path.exists(os.path.expanduser(str(path))):
+        with open(os.path.expanduser(str(path)), 'r',
+                  encoding='utf-8') as f:
+            return json.load(f)
+    return {'keys': []}
+
+
+def _rsa_key_for(kid: Optional[str]):
+    """Public key object for a JWKS entry (by kid; else the only key)."""
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    keys = [k for k in _load_jwks().get('keys', [])
+            if k.get('kty') == 'RSA']
+    if kid is not None:
+        keys = [k for k in keys if k.get('kid') == kid] or keys
+    if not keys:
+        return None
+    k = keys[0]
+    n = int.from_bytes(_b64url_decode(k['n']), 'big')
+    e = int.from_bytes(_b64url_decode(k['e']), 'big')
+    return rsa.RSAPublicNumbers(e, n).public_key()
+
+
+def _verify_signature(signing_input: bytes, signature: bytes,
+                      alg: str, kid: Optional[str]) -> bool:
+    if alg == 'RS256':
+        key = _rsa_key_for(kid)
+        if key is None:
+            return False
+        from cryptography.exceptions import InvalidSignature
+        from cryptography.hazmat.primitives import hashes
+        from cryptography.hazmat.primitives.asymmetric import padding
+        try:
+            key.verify(signature, signing_input, padding.PKCS1v15(),
+                       hashes.SHA256())
+            return True
+        except InvalidSignature:
+            return False
+    if alg == 'HS256':
+        # Symmetric mode for self-hosted IdPs / tests: shared secret in
+        # config (`oauth.hs256_secret`).
+        secret = _oauth_cfg().get('hs256_secret')
+        if not secret:
+            return False
+        expected = hmac.new(str(secret).encode(), signing_input,
+                            hashlib.sha256).digest()
+        return hmac.compare_digest(expected, signature)
+    return False
+
+
+def verify_jwt(token: str) -> Optional[Dict[str, str]]:
+    """Verify an OIDC JWT; return {'user','role'} or None.
+
+    Checks: structure, signature (RS256/HS256), exp/nbf, iss, aud
+    (when a client_id is configured).
+    """
+    parts = token.split('.')
+    if len(parts) != 3:
+        return None
+    try:
+        header = json.loads(_b64url_decode(parts[0]))
+        claims = json.loads(_b64url_decode(parts[1]))
+        signature = _b64url_decode(parts[2])
+    except (ValueError, json.JSONDecodeError):
+        return None
+    signing_input = f'{parts[0]}.{parts[1]}'.encode()
+    if not _verify_signature(signing_input, signature,
+                             header.get('alg', ''), header.get('kid')):
+        return None
+    now = time.time()
+    # exp is REQUIRED: a signed token without one would be valid
+    # forever and unrevocable (this is the server's only expiry
+    # control for OIDC bearers).
+    if claims.get('exp') is None or now >= float(claims['exp']):
+        return None
+    if claims.get('nbf') is not None and now < float(claims['nbf']):
+        return None
+    issuer = _oauth_cfg().get('issuer')
+    if issuer and claims.get('iss') != issuer:
+        return None
+    client_id = _oauth_cfg().get('client_id')
+    if client_id:
+        aud = claims.get('aud')
+        auds = aud if isinstance(aud, list) else [aud]
+        if client_id not in auds:
+            return None
+    user = claims.get('email') or claims.get('preferred_username') or \
+        claims.get('sub')
+    if not user:
+        return None
+    admins = _oauth_cfg().get('admin_users') or []
+    role = 'admin' if user in admins else 'user'
+    return {'user': str(user), 'role': role}
+
+
+def looks_like_jwt(token: str) -> bool:
+    """Cheap dispatch: JWTs are three dot-separated b64url segments;
+    service-account tokens are flat hex."""
+    return token.count('.') == 2
+
+
+# -- test/dev helper --------------------------------------------------------
+def make_hs256_jwt(claims: Dict[str, Any], secret: str) -> str:
+    """Mint an HS256 JWT (tests and self-hosted dev IdPs)."""
+    header = _b64url_encode(json.dumps({'alg': 'HS256',
+                                        'typ': 'JWT'}).encode())
+    payload = _b64url_encode(json.dumps(claims).encode())
+    sig = hmac.new(secret.encode(), f'{header}.{payload}'.encode(),
+                   hashlib.sha256).digest()
+    return f'{header}.{payload}.{_b64url_encode(sig)}'

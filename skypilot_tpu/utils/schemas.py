@@ -114,6 +114,7 @@ CONFIG_SCHEMA: Dict[str, Any] = {
         'jobs': {'type': 'object'},
         'serve': {'type': 'object'},
         'admin_policy': {'type': 'string'},
+        'oauth': {'type': 'object'},
         'allowed_clouds': {'type': 'array', 'items': {'type': 'string'}},
         'workspaces': {'type': 'object'},
         'active_workspace': {'type': 'string'},

@@ -1,0 +1,75 @@
+"""Async SDK against a real API server process.
+
+Reference analog: sky/client/sdk_async.py tests — same verb surface as
+the sync SDK; here we prove coroutines can fan out concurrent
+control-plane calls over one session.
+"""
+import asyncio
+
+import pytest
+
+from skypilot_tpu.client.sdk_async import AsyncClient
+from skypilot_tpu.task import Task
+
+from test_api_server import api_server  # noqa: F401  (fixture)
+
+
+def _run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def test_async_status_roundtrip(api_server):  # noqa: F811
+
+    async def main():
+        async with AsyncClient(api_server) as client:
+            rid = await client.status()
+            assert isinstance(rid, str)
+            records = await client.get(rid)
+            assert records == []
+
+    _run(main())
+
+
+def test_async_dryrun_launch_and_stream(api_server):  # noqa: F811
+
+    async def main():
+        async with AsyncClient(api_server) as client:
+            task = Task(run='echo hi', name='async-dry')
+            rid = await client.launch(task, cluster_name='async-c',
+                                      dryrun=True)
+            result = await client.stream_and_get(rid)
+            assert result is None or isinstance(result, dict)
+
+    _run(main())
+
+
+def test_async_concurrent_fanout(api_server):  # noqa: F811
+    """Many verbs in flight at once over one session."""
+
+    async def main():
+        async with AsyncClient(api_server) as client:
+            rids = await asyncio.gather(
+                client.status(),
+                client.cost_report(),
+                client.list_accelerators(name_filter='tpu-v5e'),
+                client.storage_ls(),
+                client.jobs_queue(),
+                client.serve_status(),
+            )
+            assert len(set(rids)) == len(rids)
+            results = await asyncio.gather(*[client.get(r) for r in rids])
+            accs = results[2]
+            assert any('tpu-v5e' in name for name in accs)
+
+    _run(main())
+
+
+def test_async_get_unknown_request_404(api_server):  # noqa: F811
+    from skypilot_tpu import exceptions
+
+    async def main():
+        async with AsyncClient(api_server) as client:
+            with pytest.raises(exceptions.RequestNotFoundError):
+                await client.get('nonexistent-request-id')
+
+    _run(main())

@@ -48,3 +48,69 @@ def test_token_auth(isolated_state, monkeypatch):
     finally:
         proc.terminate()
         proc.wait(timeout=10)
+
+
+@pytest.mark.slow
+def test_oidc_auth(isolated_state):
+    """OIDC posture end to end: JWT-bearing requests pass, others 401."""
+    import os
+    import subprocess
+    import sys
+    import time
+
+    import requests
+    import yaml
+
+    from skypilot_tpu.users import oidc
+
+    os.makedirs(isolated_state, exist_ok=True)
+    with open(os.path.join(isolated_state, 'config.yaml'), 'w',
+              encoding='utf-8') as f:
+        yaml.safe_dump({'oauth': {'issuer': 'https://idp.test',
+                                  'client_id': 'stpu-cli',
+                                  'hs256_secret': 'jwtsecret',
+                                  'admin_users': ['root@test']}}, f)
+    port = _free_port()
+    url = f'http://127.0.0.1:{port}'
+    env = dict(os.environ)
+    env['SKYPILOT_TPU_HOME'] = isolated_state
+    env.pop('SKYPILOT_API_TOKEN', None)
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env['PYTHONPATH'] = f"{repo_root}:{env.get('PYTHONPATH', '')}"
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.server.server',
+         '--port', str(port)], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    try:
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                if requests.get(f'{url}/api/health', timeout=2).ok:
+                    break
+            except requests.RequestException:
+                time.sleep(0.3)
+        # No bearer -> 401 (OIDC configured means auth required).
+        assert requests.post(f'{url}/check', json={},
+                             timeout=5).status_code == 401
+        claims = {'iss': 'https://idp.test', 'aud': 'stpu-cli',
+                  'email': 'alice@test', 'exp': time.time() + 600}
+        good = oidc.make_hs256_jwt(claims, 'jwtsecret')
+        ok = requests.post(f'{url}/check', json={},
+                           headers={'Authorization': f'Bearer {good}'},
+                           timeout=5)
+        assert ok.status_code == 200 and 'request_id' in ok.json()
+        bad = oidc.make_hs256_jwt(claims, 'wrong-secret')
+        assert requests.post(
+            f'{url}/check', json={},
+            headers={'Authorization': f'Bearer {bad}'},
+            timeout=5).status_code == 401
+        expired = oidc.make_hs256_jwt(
+            {**claims, 'exp': time.time() - 10}, 'jwtsecret')
+        assert requests.post(
+            f'{url}/check', json={},
+            headers={'Authorization': f'Bearer {expired}'},
+            timeout=5).status_code == 401
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)

@@ -1,0 +1,279 @@
+"""OIDC verification (server) + OAuth login flow (client).
+
+Reference analog: sky/server/auth tests — JWT validation paths, and
+the PKCE code flow driven against a fake in-process IdP.
+"""
+import base64
+import hashlib
+import http.server
+import json
+import threading
+import time
+import urllib.parse
+
+import pytest
+
+from skypilot_tpu import sky_config
+from skypilot_tpu.users import oidc
+
+
+@pytest.fixture()
+def oauth_config(isolated_state):  # pylint: disable=unused-argument
+    cfg = {'oauth': {'issuer': 'https://idp.test',
+                     'client_id': 'stpu-cli',
+                     'hs256_secret': 'topsecret',
+                     'admin_users': ['root@test']}}
+    with sky_config.override(cfg):
+        yield cfg
+
+
+def _claims(**over):
+    out = {'iss': 'https://idp.test', 'aud': 'stpu-cli',
+           'email': 'alice@test', 'exp': time.time() + 600}
+    out.update(over)
+    return out
+
+
+def test_hs256_roundtrip(oauth_config):
+    token = oidc.make_hs256_jwt(_claims(), 'topsecret')
+    assert oidc.looks_like_jwt(token)
+    ident = oidc.verify_jwt(token)
+    assert ident == {'user': 'alice@test', 'role': 'user'}
+
+
+def test_admin_mapping(oauth_config):
+    token = oidc.make_hs256_jwt(_claims(email='root@test'), 'topsecret')
+    assert oidc.verify_jwt(token)['role'] == 'admin'
+
+
+def test_wrong_secret_rejected(oauth_config):
+    token = oidc.make_hs256_jwt(_claims(), 'not-the-secret')
+    assert oidc.verify_jwt(token) is None
+
+
+def test_expired_rejected(oauth_config):
+    token = oidc.make_hs256_jwt(_claims(exp=time.time() - 10),
+                                'topsecret')
+    assert oidc.verify_jwt(token) is None
+
+
+def test_wrong_issuer_and_audience_rejected(oauth_config):
+    bad_iss = oidc.make_hs256_jwt(_claims(iss='https://evil.test'),
+                                  'topsecret')
+    assert oidc.verify_jwt(bad_iss) is None
+    bad_aud = oidc.make_hs256_jwt(_claims(aud='other-app'), 'topsecret')
+    assert oidc.verify_jwt(bad_aud) is None
+
+
+def test_tampered_payload_rejected(oauth_config):
+    token = oidc.make_hs256_jwt(_claims(), 'topsecret')
+    header, payload, sig = token.split('.')
+    forged = json.loads(
+        base64.urlsafe_b64decode(payload + '=' * (-len(payload) % 4)))
+    forged['email'] = 'root@test'
+    payload2 = base64.urlsafe_b64encode(
+        json.dumps(forged).encode()).decode().rstrip('=')
+    assert oidc.verify_jwt(f'{header}.{payload2}.{sig}') is None
+
+
+def test_rs256_roundtrip(isolated_state):
+    from cryptography.hazmat.primitives.asymmetric import padding, rsa
+    from cryptography.hazmat.primitives import hashes
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    pub = key.public_key().public_numbers()
+
+    def b64url_uint(n):
+        raw = n.to_bytes((n.bit_length() + 7) // 8, 'big')
+        return base64.urlsafe_b64encode(raw).decode().rstrip('=')
+
+    jwks = {'keys': [{'kty': 'RSA', 'kid': 'k1', 'alg': 'RS256',
+                      'n': b64url_uint(pub.n), 'e': b64url_uint(pub.e)}]}
+    header = base64.urlsafe_b64encode(json.dumps(
+        {'alg': 'RS256', 'kid': 'k1'}).encode()).decode().rstrip('=')
+    payload = base64.urlsafe_b64encode(json.dumps(
+        _claims()).encode()).decode().rstrip('=')
+    sig = key.sign(f'{header}.{payload}'.encode(), padding.PKCS1v15(),
+                   hashes.SHA256())
+    sig_b64 = base64.urlsafe_b64encode(sig).decode().rstrip('=')
+    token = f'{header}.{payload}.{sig_b64}'
+
+    with sky_config.override({'oauth': {'issuer': 'https://idp.test',
+                                        'client_id': 'stpu-cli',
+                                        'jwks': jwks}}):
+        ident = oidc.verify_jwt(token)
+        assert ident == {'user': 'alice@test', 'role': 'user'}
+        # Flipping one signature byte must fail.
+        bad = sig_b64[:-2] + ('AA' if not sig_b64.endswith('AA') else 'BB')
+        assert oidc.verify_jwt(f'{header}.{payload}.{bad}') is None
+
+
+# ---------------------------------------------------------------------------
+# Client PKCE flow against a fake IdP
+# ---------------------------------------------------------------------------
+class FakeIdp(http.server.BaseHTTPRequestHandler):
+    issued_code = 'authcode-123'
+    seen_verifier = None
+    refresh_count = 0
+
+    def _json(self, obj, status=200):
+        body = json.dumps(obj).encode()
+        self.send_response(status)
+        self.send_header('Content-Type', 'application/json')
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802
+        base = f'http://127.0.0.1:{self.server.server_address[1]}'
+        if self.path == '/.well-known/openid-configuration':
+            self._json({
+                'issuer': base,
+                'authorization_endpoint': f'{base}/authorize',
+                'token_endpoint': f'{base}/token',
+            })
+        else:
+            self._json({'error': 'not found'}, 404)
+
+    def do_POST(self):  # noqa: N802
+        length = int(self.headers.get('Content-Length', 0))
+        form = urllib.parse.parse_qs(self.rfile.read(length).decode())
+        cls = type(self)
+        if self.path == '/token':
+            grant = form.get('grant_type', [''])[0]
+            if grant == 'authorization_code':
+                if form.get('code', [''])[0] != cls.issued_code:
+                    self._json({'error': 'invalid_grant'}, 400)
+                    return
+                cls.seen_verifier = form.get('code_verifier', [''])[0]
+                self._json({'access_token': 'at-1', 'id_token': 'h.i.d',
+                            'refresh_token': 'rt-1', 'expires_in': 3600})
+            elif grant == 'refresh_token':
+                cls.refresh_count += 1
+                self._json({'access_token': f'at-{1 + cls.refresh_count}',
+                            'id_token': 'h.i.d2', 'expires_in': 3600})
+            else:
+                self._json({'error': 'unsupported_grant_type'}, 400)
+        else:
+            self._json({'error': 'not found'}, 404)
+
+    def log_message(self, *args):
+        del args
+
+
+@pytest.fixture()
+def fake_idp():
+    server = http.server.HTTPServer(('127.0.0.1', 0), FakeIdp)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield f'http://127.0.0.1:{server.server_address[1]}'
+    server.shutdown()
+    thread.join(timeout=5)
+
+
+def test_pkce_login_flow(isolated_state, fake_idp, monkeypatch):
+    from skypilot_tpu.client import oauth as oauth_client
+    FakeIdp.seen_verifier = None
+
+    def fake_browser(url):
+        """Play the IdP's role: redirect straight back with a code."""
+        q = urllib.parse.parse_qs(urllib.parse.urlparse(url).query)
+        assert q['code_challenge_method'] == ['S256']
+        redirect = q['redirect_uri'][0]
+        import requests as _requests
+        _requests.get(redirect, params={
+            'code': FakeIdp.issued_code, 'state': q['state'][0]},
+            timeout=10)
+        return True
+
+    monkeypatch.setattr('webbrowser.open', fake_browser)
+    tokens = oauth_client.login(issuer=fake_idp, client_id='stpu-cli',
+                                timeout=30)
+    assert tokens['access_token'] == 'at-1'
+    # The token exchange proved possession of the PKCE verifier.
+    assert FakeIdp.seen_verifier
+    challenge = base64.urlsafe_b64encode(hashlib.sha256(
+        FakeIdp.seen_verifier.encode()).digest()).decode().rstrip('=')
+    assert challenge  # S256(verifier) was sent in the authorize URL
+    # Cached token is served without refresh while fresh.
+    assert oauth_client.get_access_token() == 'h.i.d'
+
+
+def test_token_refresh(isolated_state, fake_idp):
+    from skypilot_tpu.client import oauth as oauth_client
+    FakeIdp.refresh_count = 0
+    oauth_client._save_tokens({
+        'access_token': 'stale', 'id_token': 'stale.i.d',
+        'refresh_token': 'rt-1', 'issuer': fake_idp,
+        'client_id': 'stpu-cli', 'expires_at': time.time() - 10})
+    token = oauth_client.get_access_token()
+    assert token == 'h.i.d2'
+    assert FakeIdp.refresh_count == 1
+
+
+def test_state_mismatch_rejected(isolated_state, fake_idp, monkeypatch):
+    from skypilot_tpu import exceptions
+    from skypilot_tpu.client import oauth as oauth_client
+
+    def evil_browser(url):
+        q = urllib.parse.parse_qs(urllib.parse.urlparse(url).query)
+        import requests as _requests
+        _requests.get(q['redirect_uri'][0], params={
+            'code': 'stolen', 'state': 'wrong-state'}, timeout=10)
+        return True
+
+    monkeypatch.setattr('webbrowser.open', evil_browser)
+    with pytest.raises(exceptions.SkyError):
+        oauth_client.login(issuer=fake_idp, client_id='stpu-cli',
+                           timeout=10)
+
+
+def test_missing_exp_rejected(oauth_config):
+    claims = _claims()
+    del claims['exp']
+    token = oidc.make_hs256_jwt(claims, 'topsecret')
+    assert oidc.verify_jwt(token) is None
+
+
+def test_stray_request_does_not_fail_login(isolated_state, fake_idp,
+                                           monkeypatch):
+    """A favicon fetch hitting the callback server must not poison the
+    flow with a state-mismatch error."""
+    from skypilot_tpu.client import oauth as oauth_client
+
+    def browser_with_favicon(url):
+        q = urllib.parse.parse_qs(urllib.parse.urlparse(url).query)
+        redirect = q['redirect_uri'][0]
+        base = redirect.rsplit('/', 1)[0]
+        import requests as _requests
+        _requests.get(f'{base}/favicon.ico', timeout=10)
+        _requests.get(redirect, params={
+            'code': FakeIdp.issued_code, 'state': q['state'][0]},
+            timeout=10)
+        return True
+
+    monkeypatch.setattr('webbrowser.open', browser_with_favicon)
+    tokens = oauth_client.login(issuer=fake_idp, client_id='stpu-cli',
+                                timeout=30)
+    assert tokens['access_token'] == 'at-1'
+
+
+def test_refresh_failure_backoff(isolated_state, monkeypatch):
+    """An unreachable IdP must not add timeouts to every call."""
+    import requests as _requests
+    from skypilot_tpu.client import oauth as oauth_client
+    oauth_client._refresh_failed_at = 0.0
+    oauth_client._save_tokens({
+        'access_token': 'stale', 'refresh_token': 'rt',
+        'issuer': 'http://127.0.0.1:1', 'client_id': 'x',
+        'expires_at': time.time() - 10})
+    calls = []
+
+    def failing_get(*a, **k):
+        calls.append(1)
+        raise _requests.ConnectionError('down')
+
+    monkeypatch.setattr(_requests, 'get', failing_get)
+    assert oauth_client.get_access_token() is None
+    assert oauth_client.get_access_token() is None  # backoff: no retry
+    assert len(calls) == 1
+    oauth_client._refresh_failed_at = 0.0
