@@ -37,11 +37,30 @@ def _parse_env(env: List[str]) -> Dict[str, str]:
     return out
 
 
+def _parse_env_file(path: str) -> Dict[str, str]:
+    """dotenv-style KEY=VAL lines; '#' comments and blanks skipped."""
+    out: Dict[str, str] = {}
+    try:
+        with open(os.path.expanduser(path), 'r', encoding='utf-8') as f:
+            for raw in f:
+                line = raw.strip()
+                if not line or line.startswith('#') or '=' not in line:
+                    continue
+                k, v = line.split('=', 1)
+                out[k.strip()] = v.strip().strip('"').strip("'")
+    except OSError as e:
+        _err(f'--env-file {path}: {e}')
+    return out
+
+
 def _build_task(entrypoint, name, workdir, infra, gpus, cpus, memory,
-                num_nodes, use_spot, env, cmd=None):
+                num_nodes, use_spot, env, cmd=None, env_file=None):
     from skypilot_tpu import resources as resources_lib
     from skypilot_tpu import task as task_lib
-    env_overrides = _parse_env(list(env or []))
+    env_overrides = {}
+    if env_file:
+        env_overrides.update(_parse_env_file(env_file))
+    env_overrides.update(_parse_env(list(env or [])))
     if entrypoint and entrypoint.endswith(('.yaml', '.yml')):
         config = common_utils.read_yaml(os.path.expanduser(entrypoint))
         task = task_lib.Task.from_yaml_config(config, env_overrides)
@@ -94,6 +113,8 @@ _task_options = [
     click.option('--use-spot/--no-use-spot', default=None),
     click.option('--env', multiple=True,
                  help='KEY=VAL or KEY (inherit).'),
+    click.option('--env-file', default=None,
+                 help='dotenv file; --env flags override its entries.'),
 ]
 
 
@@ -123,12 +144,12 @@ def _add_options(options):
                                    'estimated runtime.')
 @click.option('--yes', '-y', is_flag=True, default=False)
 def launch(entrypoint, cluster, name, workdir, infra, gpus, cpus, memory,
-           num_nodes, use_spot, env, idle_minutes_to_autostop, down,
+           num_nodes, use_spot, env, env_file, idle_minutes_to_autostop, down,
            retry_until_up, dryrun, detach_run, no_setup, optimize_target,
            yes) -> None:
     """Launch a task from YAML or a command (provisions a cluster)."""
     task = _build_task(entrypoint, name, workdir, infra, gpus, cpus, memory,
-                       num_nodes, use_spot, env)
+                       num_nodes, use_spot, env, env_file=env_file)
     if not yes and not dryrun:
         r = sorted(str(x) for x in task.resources)
         click.echo(f'Launching {task.name or "task"} on {cluster or "new "
@@ -152,10 +173,10 @@ def launch(entrypoint, cluster, name, workdir, infra, gpus, cpus, memory,
 @_add_options(_task_options)
 @click.option('--detach-run', '-d', is_flag=True, default=False)
 def exec_cmd(cluster, entrypoint, name, workdir, infra, gpus, cpus, memory,
-             num_nodes, use_spot, env, detach_run) -> None:
+             num_nodes, use_spot, env, env_file, detach_run) -> None:
     """Run a task on an existing cluster (no provisioning)."""
     task = _build_task(entrypoint, name, workdir, infra, gpus, cpus, memory,
-                       num_nodes, use_spot, env)
+                       num_nodes, use_spot, env, env_file=env_file)
     request_id = sdk.exec(task, cluster, detach_run=True)
     result = sdk.stream_and_get(request_id)
     if result.get('job_id') is not None and not detach_run:
@@ -193,18 +214,47 @@ def status(clusters, refresh) -> None:
 
 
 @cli.command()
-@click.argument('cluster')
-def start(cluster) -> None:
-    """Restart a stopped cluster."""
-    sdk.stream_and_get(sdk.start(cluster))
-    click.echo(f'Cluster {cluster} started.')
+@click.argument('clusters', nargs=-1)
+@click.option('--all', '-a', 'all_clusters', is_flag=True, default=False,
+              help='Start every STOPPED cluster.')
+@click.option('--yes', '-y', is_flag=True, default=False)
+def start(clusters, all_clusters, yes) -> None:
+    """Restart stopped cluster(s)."""
+    clusters = _resolve_cluster_args(clusters, all_clusters, 'start',
+                                     status_filter='STOPPED')
+    if all_clusters and not yes:
+        click.confirm(f'Start {", ".join(clusters)}?', abort=True)
+    for c in clusters:
+        sdk.stream_and_get(sdk.start(c))
+        click.echo(f'Cluster {c} started.')
+
+
+def _resolve_cluster_args(clusters, all_clusters: bool, verb: str,
+                          status_filter: Optional[str] = None
+                          ) -> List[str]:
+    if all_clusters:
+        records = sdk.get(sdk.status())
+        names = [r['name'] for r in records
+                 if status_filter is None or r['status'] == status_filter]
+        if not names:
+            noun = (f'{status_filter} clusters'.lower()
+                    if status_filter else 'existing clusters')
+            click.echo(f'No {noun}.')
+            sys.exit(0)
+        return names
+    if not clusters:
+        raise click.UsageError(f'specify cluster name(s) or --all to '
+                               f'{verb} every cluster')
+    return list(clusters)
 
 
 @cli.command()
-@click.argument('clusters', nargs=-1, required=True)
+@click.argument('clusters', nargs=-1)
+@click.option('--all', '-a', 'all_clusters', is_flag=True, default=False)
 @click.option('--yes', '-y', is_flag=True, default=False)
-def stop(clusters, yes) -> None:
+def stop(clusters, all_clusters, yes) -> None:
     """Stop cluster(s) (keep disks)."""
+    clusters = _resolve_cluster_args(clusters, all_clusters, 'stop')
     if not yes:
         click.confirm(f'Stop {", ".join(clusters)}?', abort=True)
     for c in clusters:
@@ -213,12 +263,14 @@ def stop(clusters, yes) -> None:
 
 
 @cli.command()
-@click.argument('clusters', nargs=-1, required=True)
+@click.argument('clusters', nargs=-1)
+@click.option('--all', '-a', 'all_clusters', is_flag=True, default=False)
 @click.option('--yes', '-y', is_flag=True, default=False)
 @click.option('--purge', is_flag=True, default=False,
               help='Remove from state even if cloud cleanup fails.')
-def down(clusters, yes, purge) -> None:
+def down(clusters, all_clusters, yes, purge) -> None:
     """Terminate cluster(s)."""
+    clusters = _resolve_cluster_args(clusters, all_clusters, 'terminate')
     if not yes:
         click.confirm(f'Terminate {", ".join(clusters)}?', abort=True)
     for c in clusters:
@@ -277,9 +329,22 @@ def cancel(cluster, job_ids, all_jobs) -> None:
 @click.argument('job_id', required=False, type=int)
 @click.option('--no-follow', is_flag=True, default=False)
 @click.option('--tail', type=int, default=0)
-def logs(cluster, job_id, no_follow, tail) -> None:
-    """Tail a job's logs."""
+@click.option('--sync-down', is_flag=True, default=False,
+              help='Download the log to ~/sky_logs_download/ instead '
+                   'of streaming it.')
+def logs(cluster, job_id, no_follow, tail, sync_down) -> None:
+    """Tail a job's logs (or download them with --sync-down)."""
     try:
+        if sync_down:
+            dst_dir = os.path.expanduser(
+                os.path.join('~/sky_logs_download', cluster))
+            os.makedirs(dst_dir, exist_ok=True)
+            dst = os.path.join(dst_dir, f'job-{job_id or "latest"}.log')
+            with open(dst, 'w', encoding='utf-8') as f:
+                sdk.tail_logs(cluster, job_id, follow=False, tail=0,
+                              output=f)
+            click.echo(f'Log synced to {dst}')
+            return
         sdk.tail_logs(cluster, job_id, follow=not no_follow, tail=tail)
     except exceptions.ClusterDoesNotExist as e:
         _err(str(e))
@@ -289,9 +354,17 @@ def logs(cluster, job_id, no_follow, tail) -> None:
 # info
 # ---------------------------------------------------------------------------
 @cli.command()
-def check() -> None:
-    """Probe cloud credentials; cache enabled clouds."""
+@click.argument('clouds', nargs=-1)
+def check(clouds) -> None:
+    """Probe cloud credentials; cache enabled clouds.
+
+    With CLOUD args, reports just those clouds' status."""
     enabled = sdk.get(sdk.check())
+    if clouds:
+        for c in clouds:
+            mark = 'enabled' if c.lower() in enabled else 'disabled'
+            click.echo(f'{c.lower()}: {mark}')
+        return
     click.echo(f'Enabled clouds: {", ".join(enabled) or "none"}')
 
 
@@ -470,7 +543,8 @@ def jobs() -> None:
 @click.option('--detach-run', '-d', is_flag=True, default=False)
 @click.option('--yes', '-y', is_flag=True, default=False)
 def jobs_launch_cmd(entrypoint, name, workdir, infra, gpus, cpus, memory,
-                    num_nodes, use_spot, env, pool, detach_run, yes) -> None:
+                    num_nodes, use_spot, env, env_file, pool, detach_run,
+                    yes) -> None:
     """Launch a managed job (survives preemption via auto-recovery).
 
     A YAML with multiple documents is a PIPELINE: stages run
@@ -490,7 +564,10 @@ def jobs_launch_cmd(entrypoint, name, workdir, infra, gpus, cpus, memory,
                     'Pipelines take per-stage resources from the YAML; '
                     '--workdir/--infra/--gpus/--cpus/--memory/'
                     '--num-nodes/--use-spot do not apply.')
-            env_overrides = _parse_env(list(env or []))
+            env_overrides = {}
+            if env_file:
+                env_overrides.update(_parse_env_file(env_file))
+            env_overrides.update(_parse_env(list(env or [])))
             from skypilot_tpu import task as task_lib
             stages = [task_lib.Task.from_yaml_config(d, env_overrides)
                       for d in docs]
@@ -509,7 +586,7 @@ def jobs_launch_cmd(entrypoint, name, workdir, infra, gpus, cpus, memory,
             sdk.jobs_logs(job_id)
         return
     task = _build_task(entrypoint, name, workdir, infra, gpus, cpus, memory,
-                       num_nodes, use_spot, env)
+                       num_nodes, use_spot, env, env_file=env_file)
     if not yes:
         click.confirm(f'Launch managed job {task.name or "task"}?',
                       default=True, abort=True)
@@ -533,10 +610,11 @@ def jobs_pool() -> None:
 @click.option('--yes', '-y', is_flag=True, default=False)
 def jobs_pool_apply_cmd(entrypoint, pool_name, workers, name, workdir,
                         infra, gpus, cpus, memory, num_nodes, use_spot,
-                        env, yes) -> None:
+                        env, env_file, yes) -> None:
     """Provision a pool of worker clusters from a resources template."""
     task = _build_task(entrypoint, name, workdir, infra, gpus, cpus, memory,
-                       num_nodes, use_spot, env, cmd='true')
+                       num_nodes, use_spot, env, cmd='true',
+                       env_file=env_file)
     task.run = None
     if not yes:
         click.confirm(f'Provision pool {pool_name} ({workers} workers)?',
@@ -568,6 +646,22 @@ def jobs_pool_down_cmd(pool_name, yes) -> None:
         click.confirm(f'Tear down pool {pool_name}?', abort=True)
     sdk.stream_and_get(sdk.jobs_pool_down(pool_name))
     click.echo(f'Pool {pool_name} torn down.')
+
+
+@jobs_pool.command(name='status')
+@click.argument('pool_name')
+def jobs_pool_status_cmd(pool_name) -> None:
+    """Per-worker view: cluster status + the job each worker runs."""
+    rows = sdk.get(sdk.jobs_pool_status(pool_name))
+    from rich.console import Console
+    from rich.table import Table
+    table = Table(box=None)
+    for col in ('WORKER', 'STATUS', 'JOB'):
+        table.add_column(col)
+    for r in rows:
+        table.add_row(r['worker'], r['status'],
+                      str(r['job_id']) if r['job_id'] is not None else '-')
+    Console().print(table)
 
 
 @jobs.group(name='group')
@@ -670,10 +764,10 @@ def serve() -> None:
 @_add_options(_task_options)
 @click.option('--yes', '-y', is_flag=True, default=False)
 def serve_up_cmd(entrypoint, service_name, name, workdir, infra, gpus, cpus,
-                 memory, num_nodes, use_spot, env, yes) -> None:
+                 memory, num_nodes, use_spot, env, env_file, yes) -> None:
     """Bring up a service from a task YAML with a service: section."""
     task = _build_task(entrypoint, name, workdir, infra, gpus, cpus, memory,
-                       num_nodes, use_spot, env)
+                       num_nodes, use_spot, env, env_file=env_file)
     service_name = service_name or task.name or 'service'
     if not yes:
         click.confirm(f'Bring up service {service_name}?', default=True,
@@ -717,10 +811,11 @@ def serve_status_cmd(services) -> None:
 @_add_options(_task_options)
 @click.option('--yes', '-y', is_flag=True, default=False)
 def serve_update_cmd(service_name, entrypoint, name, workdir, infra, gpus,
-                     cpus, memory, num_nodes, use_spot, env, yes) -> None:
+                     cpus, memory, num_nodes, use_spot, env, env_file,
+                     yes) -> None:
     """Update a service to a new task version."""
     task = _build_task(entrypoint, name, workdir, infra, gpus, cpus, memory,
-                       num_nodes, use_spot, env)
+                       num_nodes, use_spot, env, env_file=env_file)
     if not yes:
         click.confirm(f'Update service {service_name}?', abort=True)
     result = sdk.get(sdk.serve_update(task, service_name))
@@ -737,16 +832,37 @@ def serve_logs_cmd(service_name, no_follow) -> None:
 
 
 @serve.command(name='down')
-@click.argument('service_names', nargs=-1, required=True)
+@click.argument('service_names', nargs=-1)
+@click.option('--all', '-a', 'all_services', is_flag=True, default=False)
 @click.option('--yes', '-y', is_flag=True, default=False)
 @click.option('--purge', is_flag=True, default=False)
-def serve_down_cmd(service_names, yes, purge) -> None:
+def serve_down_cmd(service_names, all_services, yes, purge) -> None:
     """Tear down service(s)."""
+    if all_services:
+        service_names = [s['name'] for s in sdk.get(sdk.serve_status())]
+        if not service_names:
+            click.echo('No services.')
+            return
+    if not service_names:
+        raise click.UsageError('specify service name(s) or --all')
     if not yes:
         click.confirm(f'Tear down {", ".join(service_names)}?', abort=True)
     for s in service_names:
         sdk.get(sdk.serve_down(s, purge=purge))
         click.echo(f'Service {s} torn down.')
+
+
+@serve.command(name='sync-down-logs')
+@click.argument('service_name')
+def serve_sync_down_logs_cmd(service_name) -> None:
+    """Download a service's controller log to ~/sky_logs_download/."""
+    dst_dir = os.path.expanduser(
+        os.path.join('~/sky_logs_download', 'serve'))
+    os.makedirs(dst_dir, exist_ok=True)
+    dst = os.path.join(dst_dir, f'{service_name}.log')
+    with open(dst, 'w', encoding='utf-8') as f:
+        sdk.serve_logs(service_name, follow=False, output=f)
+    click.echo(f'Log synced to {dst}')
 
 
 # ---------------------------------------------------------------------------
@@ -860,10 +976,11 @@ def batch() -> None:
 @click.option('--yes', '-y', is_flag=True, default=False)
 def batch_launch_cmd(entrypoint, batch_name, input_path, output_dir,
                      workers, shards, name, workdir, infra, gpus, cpus,
-                     memory, num_nodes, use_spot, env, yes) -> None:
+                     memory, num_nodes, use_spot, env, env_file,
+                     yes) -> None:
     """Launch a batch job over a JSONL dataset."""
     task = _build_task(entrypoint, name, workdir, infra, gpus, cpus, memory,
-                       num_nodes, use_spot, env)
+                       num_nodes, use_spot, env, env_file=env_file)
     if not yes:
         click.confirm(f'Launch batch {batch_name} ({workers} workers)?',
                       default=True, abort=True)

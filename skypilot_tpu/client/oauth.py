@@ -187,6 +187,11 @@ def _refresh(tokens: Dict[str, Any]) -> Optional[Dict[str, Any]]:
     except (requests.RequestException, KeyError, ValueError):
         return None
     tokens = {**tokens, **new}
+    if 'id_token' not in new:
+        # Refresh grants may return only an access token; keeping the
+        # old (expired) id_token would make get_access_token serve a
+        # JWT the server rejects while the client thinks it's fresh.
+        tokens.pop('id_token', None)
     tokens['expires_at'] = time.time() + float(new.get('expires_in', 3600))
     _save_tokens(tokens)
     return tokens

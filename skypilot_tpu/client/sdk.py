@@ -475,6 +475,10 @@ def jobs_pool_down(pool_name: str) -> str:
     return _post('/jobs/pool/down', {'pool_name': pool_name})
 
 
+def jobs_pool_status(pool_name: str) -> str:
+    return _post('/jobs/pool/status', {'pool_name': pool_name})
+
+
 # ---------------------------------------------------------------------------
 # Users / RBAC / service-account tokens (reference: sky/client/
 # service_account_auth.py + `sky api` auth commands). These routes

@@ -77,7 +77,11 @@ class AsyncClient:
                     body = await resp.json()
                     return body['request_id']
             except (aiohttp.ClientConnectionError,
-                    asyncio.TimeoutError) as e:
+                    aiohttp.ClientPayloadError,
+                    asyncio.TimeoutError, ValueError) as e:
+                # ClientPayloadError/ValueError: reset-mid-body or a
+                # truncated JSON — the same transient class the sync
+                # SDK retries (chaos-proxy contract).
                 if attempt == retries:
                     raise exceptions.ApiServerConnectionError(
                         f'{self._url}: {e}') from e
@@ -103,7 +107,8 @@ class AsyncClient:
                     body = await resp.json()
                 transient = 0
             except (aiohttp.ClientConnectionError,
-                    asyncio.TimeoutError):
+                    aiohttp.ClientPayloadError,
+                    asyncio.TimeoutError, ValueError):
                 transient += 1
                 if transient > 8:
                     raise

@@ -99,6 +99,11 @@ def pool_down(pool_name: str) -> None:
     pools_lib.down(pool_name)
 
 
+def pool_status(pool_name: str) -> List[Dict[str, Any]]:
+    from skypilot_tpu.jobs import pools as pools_lib
+    return pools_lib.status(pool_name)
+
+
 def cancel(job_ids: Optional[List[int]] = None,  # noqa: D401
            all_jobs: bool = False) -> List[int]:
     """Cancel jobs by id (RBAC: users/permission.py gates non-owners

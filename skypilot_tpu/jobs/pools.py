@@ -120,6 +120,27 @@ def ls() -> List[Dict[str, Any]]:
     return out
 
 
+def status(pool_name: str) -> List[Dict[str, Any]]:
+    """Per-worker rows: cluster name, cluster status, running job."""
+    pool = get(pool_name)
+    if pool is None:
+        raise exceptions.SkyError(f'Pool {pool_name!r} not found.')
+    from skypilot_tpu import global_state
+    job_by_worker = dict(_active_jobs_by_worker(pool_name))
+    out = []
+    for idx in range(pool['num_workers']):
+        cname = worker_cluster(pool_name, idx)
+        record = global_state.get_cluster(cname)
+        cluster_status = record['status'] if record else 'NOT_FOUND'
+        out.append({
+            'worker': cname,
+            # Enum -> str: rows cross the HTTP boundary as JSON.
+            'status': getattr(cluster_status, 'value', cluster_status),
+            'job_id': job_by_worker.get(cname),
+        })
+    return out
+
+
 def down(pool_name: str) -> None:
     pool = get(pool_name)
     if pool is None:
@@ -141,19 +162,22 @@ def down(pool_name: str) -> None:
 # ---------------------------------------------------------------------------
 # Assignment (called under the scheduler lock)
 # ---------------------------------------------------------------------------
-def _busy_workers(pool_name: str) -> List[str]:
+def _active_jobs_by_worker(pool_name: str) -> List[tuple]:
+    """(worker, job_id) for every non-terminal job in the pool —
+    the single definition of 'busy' (terminal set from state._TERMINAL
+    so new terminal statuses can't drift out of sync here)."""
+    terminal = sorted(st.value for st in state._TERMINAL)  # pylint: disable=protected-access
+    placeholders = ','.join('?' * len(terminal))
     rows = _db().query(
-        'SELECT pool_worker FROM managed_jobs WHERE pool=? AND status '
-        'NOT IN (?,?,?,?,?,?,?) AND pool_worker IS NOT NULL',
-        (pool_name,
-         state.ManagedJobStatus.SUCCEEDED.value,
-         state.ManagedJobStatus.FAILED.value,
-         state.ManagedJobStatus.FAILED_SETUP.value,
-         state.ManagedJobStatus.FAILED_PRECHECKS.value,
-         state.ManagedJobStatus.FAILED_NO_RESOURCE.value,
-         state.ManagedJobStatus.FAILED_CONTROLLER.value,
-         state.ManagedJobStatus.CANCELLED.value))
-    return [r['pool_worker'] for r in rows]
+        f'SELECT pool_worker, job_id FROM managed_jobs WHERE pool=? '
+        f'AND status NOT IN ({placeholders}) '
+        f'AND pool_worker IS NOT NULL',
+        (pool_name, *terminal))
+    return [(r['pool_worker'], r['job_id']) for r in rows]
+
+
+def _busy_workers(pool_name: str) -> List[str]:
+    return [w for w, _ in _active_jobs_by_worker(pool_name)]
 
 
 def assign_worker(pool_name: str) -> Optional[str]:

@@ -48,6 +48,9 @@ def register(app: web.Application) -> None:
     app.router.add_post('/jobs/pool/down',
                         _schedule('jobs.pool_down', f'{_API}.pool_down',
                                   'long'))
+    app.router.add_post('/jobs/pool/status',
+                        _schedule('jobs.pool_status',
+                                  f'{_API}.pool_status'))
     app.router.add_post('/jobs/group/launch',
                         _schedule('jobs.group_launch',
                                   f'{_API}.group_launch', 'long'))

@@ -73,36 +73,38 @@ def _load_jwks() -> Dict[str, Any]:
     return {'keys': []}
 
 
-def _rsa_key_for(kid: Optional[str]):
-    """Public key object for a JWKS entry (by kid; else the only key)."""
+def _rsa_keys_for(kid: Optional[str]):
+    """Candidate public keys: the kid match first, else every RSA key
+    (key rotation: a JWKS holds old+new; tokens without a kid must be
+    tried against each)."""
     from cryptography.hazmat.primitives.asymmetric import rsa
     keys = [k for k in _load_jwks().get('keys', [])
             if k.get('kty') == 'RSA']
     if kid is not None:
-        keys = [k for k in keys if k.get('kid') == kid] or keys
-    if not keys:
-        return None
-    k = keys[0]
-    n = int.from_bytes(_b64url_decode(k['n']), 'big')
-    e = int.from_bytes(_b64url_decode(k['e']), 'big')
-    return rsa.RSAPublicNumbers(e, n).public_key()
+        matched = [k for k in keys if k.get('kid') == kid]
+        keys = matched or keys
+    out = []
+    for k in keys:
+        n = int.from_bytes(_b64url_decode(k['n']), 'big')
+        e = int.from_bytes(_b64url_decode(k['e']), 'big')
+        out.append(rsa.RSAPublicNumbers(e, n).public_key())
+    return out
 
 
 def _verify_signature(signing_input: bytes, signature: bytes,
                       alg: str, kid: Optional[str]) -> bool:
     if alg == 'RS256':
-        key = _rsa_key_for(kid)
-        if key is None:
-            return False
         from cryptography.exceptions import InvalidSignature
         from cryptography.hazmat.primitives import hashes
         from cryptography.hazmat.primitives.asymmetric import padding
-        try:
-            key.verify(signature, signing_input, padding.PKCS1v15(),
-                       hashes.SHA256())
-            return True
-        except InvalidSignature:
-            return False
+        for key in _rsa_keys_for(kid):
+            try:
+                key.verify(signature, signing_input, padding.PKCS1v15(),
+                           hashes.SHA256())
+                return True
+            except InvalidSignature:
+                continue
+        return False
     if alg == 'HS256':
         # Symmetric mode for self-hosted IdPs / tests: shared secret in
         # config (`oauth.hs256_secret`).

@@ -59,6 +59,11 @@ def test_pool_reuse_and_saturation(pool_env):
     rows = pools.ls()
     assert rows[0]['name'] == 'p1' and rows[0]['busy_workers'] == 0
 
+    # Per-worker status view (CLI `stpu jobs pool status`).
+    st = pools.status('p1')
+    assert st == [{'worker': 'pool-p1-w0', 'status': 'UP',
+                   'job_id': None}]
+
     pools.down('p1')
     assert global_state.get_cluster('pool-p1-w0') is None
     assert pools.get('p1') is None

@@ -123,3 +123,30 @@ def test_api_login_writes_endpoint(runner, isolated_state):
               encoding='utf-8') as f:
         cfg = yaml.safe_load(f)
     assert cfg['api_server']['endpoint'] == 'http://127.0.0.1:1'
+
+
+def test_env_file_parsing(tmp_path):
+    from skypilot_tpu.client.cli import _parse_env_file
+    env_file = tmp_path / '.env'
+    env_file.write_text(
+        '# comment\n\nFOO=bar\nQUOTED="with spaces"\n'
+        "SINGLE='sq'\nNOEQ\nKEY=has=equals\n")
+    out = _parse_env_file(str(env_file))
+    assert out == {'FOO': 'bar', 'QUOTED': 'with spaces',
+                   'SINGLE': 'sq', 'KEY': 'has=equals'}
+
+
+def test_stop_requires_name_or_all(runner):
+    r = runner.invoke(cli.cli, ['stop', '-y'])
+    assert r.exit_code != 0
+    assert '--all' in r.output
+
+
+def test_down_requires_name_or_all(runner):
+    r = runner.invoke(cli.cli, ['down', '-y'])
+    assert r.exit_code != 0
+
+
+def test_serve_down_requires_name_or_all(runner):
+    r = runner.invoke(cli.cli, ['serve', 'down', '-y'])
+    assert r.exit_code != 0

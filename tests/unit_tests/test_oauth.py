@@ -277,3 +277,63 @@ def test_refresh_failure_backoff(isolated_state, monkeypatch):
     assert oauth_client.get_access_token() is None  # backoff: no retry
     assert len(calls) == 1
     oauth_client._refresh_failed_at = 0.0
+
+
+def test_rs256_key_rotation_no_kid(isolated_state):
+    """Token signed with the NEWER key, no kid header, JWKS holding
+    [old, new] — must verify against every candidate key."""
+    from cryptography.hazmat.primitives.asymmetric import padding, rsa
+    from cryptography.hazmat.primitives import hashes
+
+    def b64url_uint(n):
+        raw = n.to_bytes((n.bit_length() + 7) // 8, 'big')
+        return base64.urlsafe_b64encode(raw).decode().rstrip('=')
+
+    old_key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    new_key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    jwks = {'keys': []}
+    for kid, key in (('old', old_key), ('new', new_key)):
+        pub = key.public_key().public_numbers()
+        jwks['keys'].append({'kty': 'RSA', 'kid': kid,
+                             'n': b64url_uint(pub.n),
+                             'e': b64url_uint(pub.e)})
+    header = base64.urlsafe_b64encode(
+        json.dumps({'alg': 'RS256'}).encode()).decode().rstrip('=')
+    payload = base64.urlsafe_b64encode(json.dumps(
+        _claims()).encode()).decode().rstrip('=')
+    sig = new_key.sign(f'{header}.{payload}'.encode(), padding.PKCS1v15(),
+                       hashes.SHA256())
+    token = (f'{header}.{payload}.'
+             f'{base64.urlsafe_b64encode(sig).decode().rstrip("=")}')
+    with sky_config.override({'oauth': {'issuer': 'https://idp.test',
+                                        'client_id': 'stpu-cli',
+                                        'jwks': jwks}}):
+        assert oidc.verify_jwt(token) == {'user': 'alice@test',
+                                          'role': 'user'}
+
+
+def test_refresh_drops_stale_id_token(isolated_state, fake_idp,
+                                      monkeypatch):
+    """A refresh response without id_token must not leave the old
+    (expired) id_token looking fresh."""
+    import requests as _requests
+    from skypilot_tpu.client import oauth as oauth_client
+    oauth_client._refresh_failed_at = 0.0
+    oauth_client._save_tokens({
+        'access_token': 'stale-at', 'id_token': 'stale.id.tok',
+        'refresh_token': 'rt-1', 'issuer': fake_idp,
+        'client_id': 'stpu-cli', 'expires_at': time.time() - 10})
+
+    real_post = _requests.post
+
+    def no_id_token_post(url, **kw):
+        resp = real_post(url, **kw)
+        if kw.get('data', {}).get('grant_type') == 'refresh_token':
+            body = resp.json()
+            body.pop('id_token', None)
+            resp.json = lambda: body
+        return resp
+
+    monkeypatch.setattr(_requests, 'post', no_id_token_post)
+    token = oauth_client.get_access_token()
+    assert token is not None and token != 'stale.id.tok'
