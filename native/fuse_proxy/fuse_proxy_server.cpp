@@ -1,0 +1,300 @@
+// fuse-proxy server: privileged side of rootless FUSE mounting.
+//
+// Reference analog: addons/fuse-proxy cmd/fusermount-server (Go,
+// runs as a privileged daemonset). Accepts fusermount calls forwarded
+// by the shim, translates the client's container-local mountpoint into
+// this namespace via /proc/<peer pid>/root (SO_PEERCRED; needs
+// hostPID in the daemonset), validates it against an allow-list root,
+// runs the REAL fusermount with _FUSE_COMMFD wired to a socketpair,
+// captures the opened /dev/fuse fd and ships it back to the shim via
+// SCM_RIGHTS.
+//
+// Mountpoint handling is race-hardened: after validation the
+// mountpoint is pinned with an O_PATH|O_NOFOLLOW fd, re-checked
+// through /proc/self/fd (check-after-open on a stable fd), and
+// fusermount receives the /proc/self/fd/N path — a client swapping
+// path components for symlinks between check and mount cannot
+// redirect the mount outside the allow-list.
+//
+// Env:
+//   FUSE_PROXY_SOCKET        listen path (default /run/fuse-proxy/..)
+//   FUSE_PROXY_ALLOWED_ROOT  mountpoints must resolve under this
+//                            (default "/", i.e. allow all)
+//   FUSE_PROXY_FUSERMOUNT    real fusermount binary; default tries
+//                            fusermount3 then fusermount — tests
+//                            point this at a fake to exercise the
+//                            protocol without privileges.
+#include <climits>
+#include <csignal>
+#include <cstdio>
+#include <cstdlib>
+#include <fcntl.h>
+#include <string>
+#include <sys/stat.h>
+#include <sys/wait.h>
+#include <thread>
+#include <vector>
+
+#include "proto.h"
+
+using fuse_proxy::kStatusBadRequest;
+using fuse_proxy::kStatusForbidden;
+using fuse_proxy::kStatusInternal;
+using fuse_proxy::recv_fd;
+using fuse_proxy::recv_strings;
+using fuse_proxy::send_fd;
+using fuse_proxy::write_full;
+
+namespace {
+
+std::string g_allowed_root = "/";
+std::string g_fusermount;  // empty = default chain
+
+// The client may live in another mount namespace (a task pod); its
+// paths are only meaningful through /proc/<pid>/root. With hostPID
+// (daemonset) this translates container paths to host paths; for a
+// same-namespace client the prefix resolves to "/" and is a no-op.
+std::string proc_root_prefix(int client_sock) {
+  struct ucred cred = {};
+  socklen_t len = sizeof(cred);
+  if (getsockopt(client_sock, SOL_SOCKET, SO_PEERCRED, &cred, &len) != 0 ||
+      cred.pid <= 0) {
+    return "";
+  }
+  return "/proc/" + std::to_string(cred.pid) + "/root";
+}
+
+std::string realpath_str(const std::string& p) {
+  char resolved[PATH_MAX];
+  if (realpath(p.c_str(), resolved) == nullptr) return "";
+  return resolved;
+}
+
+// Resolve the client's mountpoint into THIS namespace. For unmounts
+// the mountpoint itself may be a dead FUSE endpoint (ENOTCONN), so
+// only the parent directory is resolved and the leaf is re-joined.
+std::string resolve_mountpoint(const std::string& proc_root,
+                               const std::string& cwd,
+                               const std::string& arg, bool is_unmount) {
+  std::string joined = arg;
+  if (!arg.empty() && arg[0] != '/') {
+    joined = cwd + "/" + arg;
+  }
+  joined = proc_root + joined;
+  if (!is_unmount) return realpath_str(joined);
+  size_t slash = joined.find_last_of('/');
+  if (slash == std::string::npos || slash + 1 >= joined.size()) return "";
+  std::string leaf = joined.substr(slash + 1);
+  if (leaf == "." || leaf == "..") return "";
+  std::string parent = realpath_str(joined.substr(0, slash));
+  if (parent.empty()) return "";
+  return parent == "/" ? parent + leaf : parent + "/" + leaf;
+}
+
+bool under_allowed_root(const std::string& path) {
+  if (g_allowed_root == "/") return true;
+  if (path == g_allowed_root) return true;
+  return path.rfind(g_allowed_root + "/", 0) == 0;
+}
+
+// The mountpoint is the last non-option argument (after `--` if
+// present). Returns its index in argv or -1.
+int find_mountpoint_index(const std::vector<std::string>& argv) {
+  bool after_dashes = false;
+  int last = -1;
+  for (size_t i = 0; i < argv.size(); ++i) {
+    const std::string& a = argv[i];
+    if (!after_dashes && a == "--") {
+      after_dashes = true;
+      continue;
+    }
+    if (!after_dashes && !a.empty() && a[0] == '-') {
+      if (a == "-o" && i + 1 < argv.size()) ++i;  // skip option value
+      continue;
+    }
+    last = static_cast<int>(i);
+  }
+  return last;
+}
+
+// Run the real fusermount; on success for mounts, *fuse_fd holds the
+// captured /dev/fuse fd. `inherit_fd` (if >= 0) is kept open across
+// the exec so /proc/self/fd/N mountpoint paths stay valid in the
+// child. Returns the child's exit code (or 2xx).
+uint32_t run_fusermount(std::vector<std::string> argv, bool is_mount,
+                        int* fuse_fd, int inherit_fd) {
+  int sp[2] = {-1, -1};
+  if (is_mount &&
+      socketpair(AF_UNIX, SOCK_STREAM, 0, sp) != 0) {
+    return kStatusInternal;
+  }
+  pid_t pid = fork();
+  if (pid < 0) {
+    if (is_mount) {
+      close(sp[0]);
+      close(sp[1]);
+    }
+    return kStatusInternal;
+  }
+  if (pid == 0) {  // child: exec fusermount
+    if (is_mount) {
+      close(sp[0]);
+      char buf[16];
+      std::snprintf(buf, sizeof(buf), "%d", sp[1]);
+      setenv("_FUSE_COMMFD", buf, 1);
+    } else {
+      unsetenv("_FUSE_COMMFD");
+    }
+    if (inherit_fd >= 0) {
+      // Drop CLOEXEC so the /proc/self/fd/N path survives exec.
+      int flags = fcntl(inherit_fd, F_GETFD);
+      if (flags >= 0) fcntl(inherit_fd, F_SETFD, flags & ~FD_CLOEXEC);
+    }
+    std::vector<char*> cargv;
+    cargv.push_back(nullptr);  // argv[0], patched per attempt
+    for (auto& a : argv) cargv.push_back(const_cast<char*>(a.c_str()));
+    cargv.push_back(nullptr);
+    if (!g_fusermount.empty()) {
+      cargv[0] = const_cast<char*>(g_fusermount.c_str());
+      execvp(g_fusermount.c_str(), cargv.data());
+    } else {
+      // Default chain: fuse3's binary first, fuse2's as fallback.
+      cargv[0] = const_cast<char*>("fusermount3");
+      execvp("fusermount3", cargv.data());
+      cargv[0] = const_cast<char*>("fusermount");
+      execvp("fusermount", cargv.data());
+    }
+    std::fprintf(stderr, "fuse-proxy: exec fusermount: %s\n",
+                 std::strerror(errno));
+    _exit(127);
+  }
+  // parent
+  if (is_mount) {
+    close(sp[1]);
+    *fuse_fd = recv_fd(sp[0]);  // blocks until fusermount sends it
+    close(sp[0]);
+  }
+  int wstatus = 0;
+  while (waitpid(pid, &wstatus, 0) < 0 && errno == EINTR) {
+  }
+  uint32_t code = WIFEXITED(wstatus)
+                      ? static_cast<uint32_t>(WEXITSTATUS(wstatus))
+                      : kStatusInternal;
+  if (code == 0 && is_mount && *fuse_fd < 0) code = kStatusInternal;
+  return code;
+}
+
+void handle_client(int client) {
+  std::vector<std::string> frame;
+  uint32_t status = kStatusBadRequest;
+  if (!recv_strings(client, &frame) || frame.size() < 2) {
+    write_full(client, &status, sizeof(status));
+    return;
+  }
+  const std::string cwd = frame[0];
+  std::vector<std::string> argv(frame.begin() + 1, frame.end());
+
+  bool is_unmount = false;
+  for (const auto& a : argv) {
+    if (a == "-u") is_unmount = true;
+  }
+  int mp_idx = find_mountpoint_index(argv);
+  if (mp_idx < 0) {
+    write_full(client, &status, sizeof(status));
+    return;
+  }
+  std::string proc_root = proc_root_prefix(client);
+  std::string resolved = resolve_mountpoint(proc_root, cwd, argv[mp_idx],
+                                            is_unmount);
+  if (resolved.empty() || !under_allowed_root(resolved)) {
+    status = kStatusForbidden;
+    std::fprintf(stderr, "fuse-proxy: refused mountpoint %s "
+                         "(allowed root %s)\n",
+                 argv[mp_idx].c_str(), g_allowed_root.c_str());
+    write_full(client, &status, sizeof(status));
+    return;
+  }
+
+  int pin_fd = -1;
+  if (!is_unmount) {
+    // Pin the validated directory, then re-check what we actually
+    // opened — a client swapping components for symlinks after the
+    // realpath cannot move the mount target (TOCTOU).
+    pin_fd = open(resolved.c_str(),
+                  O_PATH | O_DIRECTORY | O_NOFOLLOW | O_CLOEXEC);
+    std::string via_fd =
+        pin_fd >= 0
+            ? realpath_str("/proc/self/fd/" + std::to_string(pin_fd))
+            : "";
+    if (pin_fd < 0 || via_fd.empty() || !under_allowed_root(via_fd)) {
+      status = kStatusForbidden;
+      std::fprintf(stderr, "fuse-proxy: mountpoint %s changed during "
+                           "validation\n", resolved.c_str());
+      write_full(client, &status, sizeof(status));
+      if (pin_fd >= 0) close(pin_fd);
+      return;
+    }
+    argv[mp_idx] = "/proc/self/fd/" + std::to_string(pin_fd);
+  } else {
+    argv[mp_idx] = resolved;
+  }
+
+  int fuse_fd = -1;
+  status = run_fusermount(argv, /*is_mount=*/!is_unmount, &fuse_fd,
+                          pin_fd);
+  if (pin_fd >= 0) close(pin_fd);
+  write_full(client, &status, sizeof(status));
+  if (status == 0 && !is_unmount && fuse_fd >= 0) {
+    send_fd(client, fuse_fd);
+  }
+  if (fuse_fd >= 0) close(fuse_fd);
+}
+
+}  // namespace
+
+int main() {
+  signal(SIGPIPE, SIG_IGN);
+  const char* sock_path = std::getenv("FUSE_PROXY_SOCKET");
+  if (sock_path == nullptr) sock_path = fuse_proxy::kDefaultSocket;
+  const char* root = std::getenv("FUSE_PROXY_ALLOWED_ROOT");
+  if (root != nullptr) g_allowed_root = root;
+  const char* fm = std::getenv("FUSE_PROXY_FUSERMOUNT");
+  if (fm != nullptr) g_fusermount = fm;
+
+  unlink(sock_path);
+  int listener = socket(AF_UNIX, SOCK_STREAM, 0);
+  if (listener < 0) {
+    std::perror("fuse-proxy: socket");
+    return 1;
+  }
+  struct sockaddr_un addr = {};
+  addr.sun_family = AF_UNIX;
+  std::strncpy(addr.sun_path, sock_path, sizeof(addr.sun_path) - 1);
+  if (bind(listener, reinterpret_cast<struct sockaddr*>(&addr),
+           sizeof(addr)) != 0 ||
+      listen(listener, 16) != 0) {
+    std::perror("fuse-proxy: bind/listen");
+    return 1;
+  }
+  chmod(sock_path, 0666);  // task pods run as arbitrary uids
+  std::fprintf(stderr, "fuse-proxy: listening on %s (root %s, "
+                       "fusermount %s)\n",
+               sock_path, g_allowed_root.c_str(),
+               g_fusermount.empty() ? "fusermount3|fusermount"
+                                    : g_fusermount.c_str());
+
+  for (;;) {
+    int client = accept(listener, nullptr, nullptr);
+    if (client < 0) {
+      if (errno == EINTR) continue;
+      std::perror("fuse-proxy: accept");
+      return 1;
+    }
+    // One thread per client: a hung fusermount (or a client stalled
+    // mid-frame) must not block other pods' mounts on this node.
+    std::thread([client] {
+      handle_client(client);
+      close(client);
+    }).detach();
+  }
+}

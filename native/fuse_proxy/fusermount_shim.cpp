@@ -1,0 +1,89 @@
+// fusermount shim: drop-in `fusermount`/`fusermount3` for
+// unprivileged containers.
+//
+// Reference analog: addons/fuse-proxy cmd/fusermount-shim (Go).
+// libfuse execs `fusermount3 -o <opts> -- <mountpoint>` with
+// _FUSE_COMMFD pointing at a socketpair and expects the opened
+// /dev/fuse fd back over it. This shim has no privileges; it forwards
+// the whole call (argv + cwd) to the fuse-proxy server's unix socket,
+// receives the fuse fd via SCM_RIGHTS, and relays it to libfuse over
+// _FUSE_COMMFD — indistinguishable from real fusermount to the caller.
+//
+// Env:
+//   FUSE_PROXY_SOCKET  server socket (default /run/fuse-proxy/...)
+//   _FUSE_COMMFD       set by libfuse for mounts; absent for -u.
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+#include <vector>
+
+#include "proto.h"
+
+using fuse_proxy::connect_unix;
+using fuse_proxy::kDefaultSocket;
+using fuse_proxy::read_full;
+using fuse_proxy::recv_fd;
+using fuse_proxy::recv_strings;
+using fuse_proxy::send_fd;
+using fuse_proxy::send_strings;
+
+int main(int argc, char** argv) {
+  const char* sock_path = std::getenv("FUSE_PROXY_SOCKET");
+  if (sock_path == nullptr) sock_path = kDefaultSocket;
+
+  int server = connect_unix(sock_path);
+  if (server < 0) {
+    std::fprintf(stderr,
+                 "fusermount-shim: cannot reach fuse-proxy at %s: %s\n",
+                 sock_path, std::strerror(errno));
+    return 1;
+  }
+
+  char cwd_buf[4096];
+  if (getcwd(cwd_buf, sizeof(cwd_buf)) == nullptr) cwd_buf[0] = '\0';
+
+  std::vector<std::string> frame;
+  frame.emplace_back(cwd_buf);
+  for (int i = 1; i < argc; ++i) frame.emplace_back(argv[i]);
+  if (!send_strings(server, frame)) {
+    std::fprintf(stderr, "fusermount-shim: send failed\n");
+    return 1;
+  }
+
+  uint32_t status = fuse_proxy::kStatusInternal;
+  if (!read_full(server, &status, sizeof(status))) {
+    std::fprintf(stderr, "fusermount-shim: server hung up\n");
+    return 1;
+  }
+  if (status != 0) {
+    std::fprintf(stderr, "fusermount-shim: proxy status %u\n", status);
+    return status >= 200 ? 1 : static_cast<int>(status);
+  }
+
+  // Mounts carry the fuse fd back; unmounts don't (no _FUSE_COMMFD).
+  const char* commfd_env = std::getenv("_FUSE_COMMFD");
+  bool expect_fd = commfd_env != nullptr;
+  for (int i = 1; i < argc; ++i) {
+    if (std::string(argv[i]) == "-u") expect_fd = false;
+  }
+  if (!expect_fd) {
+    close(server);
+    return 0;
+  }
+
+  int fuse_fd = recv_fd(server);
+  close(server);
+  if (fuse_fd < 0) {
+    std::fprintf(stderr, "fusermount-shim: no fd from proxy\n");
+    return 1;
+  }
+  int commfd = std::atoi(commfd_env);
+  if (!send_fd(commfd, fuse_fd)) {
+    std::fprintf(stderr, "fusermount-shim: relay to _FUSE_COMMFD=%d "
+                         "failed: %s\n", commfd, std::strerror(errno));
+    close(fuse_fd);
+    return 1;
+  }
+  close(fuse_fd);
+  return 0;
+}

@@ -1,0 +1,157 @@
+// Wire protocol shared by fusermount_shim and fuse_proxy_server.
+//
+// Reference analog: addons/fuse-proxy (Go) — an unprivileged pod's
+// `fusermount` calls are forwarded over a unix socket to a privileged
+// daemonset which performs the real mount and hands the opened
+// /dev/fuse fd back via SCM_RIGHTS, exactly like setuid fusermount
+// hands the fd to libfuse over _FUSE_COMMFD.
+//
+// Framing (both directions, little-endian):
+//   request:  u32 nstrings, then nstrings x (u32 len, bytes) —
+//             strings[0] = client cwd, strings[1..] = fusermount argv
+//             (without argv[0]).
+//   response: u32 status (fusermount exit code, or 200+ for proxy
+//             errors); when status == 0 and the operation was a mount,
+//             a 1-byte message with the fuse fd attached via
+//             SCM_RIGHTS follows.
+#pragma once
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+#include <vector>
+
+namespace fuse_proxy {
+
+constexpr uint32_t kStatusBadRequest = 200;
+constexpr uint32_t kStatusForbidden = 201;
+constexpr uint32_t kStatusInternal = 202;
+constexpr const char* kDefaultSocket = "/run/fuse-proxy/fuse-proxy.sock";
+
+inline bool read_full(int fd, void* buf, size_t n) {
+  auto* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = read(fd, p, n);
+    if (r < 0 && errno == EINTR) continue;
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+inline bool write_full(int fd, const void* buf, size_t n) {
+  const auto* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = write(fd, p, n);
+    if (r < 0 && errno == EINTR) continue;
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+inline bool send_strings(int fd, const std::vector<std::string>& strs) {
+  uint32_t n = static_cast<uint32_t>(strs.size());
+  if (!write_full(fd, &n, sizeof(n))) return false;
+  for (const auto& s : strs) {
+    uint32_t len = static_cast<uint32_t>(s.size());
+    if (!write_full(fd, &len, sizeof(len))) return false;
+    if (len > 0 && !write_full(fd, s.data(), len)) return false;
+  }
+  return true;
+}
+
+inline bool recv_strings(int fd, std::vector<std::string>* out,
+                         uint32_t max_strings = 256,
+                         uint32_t max_len = 1 << 16) {
+  uint32_t n = 0;
+  if (!read_full(fd, &n, sizeof(n))) return false;
+  if (n > max_strings) return false;
+  out->clear();
+  for (uint32_t i = 0; i < n; ++i) {
+    uint32_t len = 0;
+    if (!read_full(fd, &len, sizeof(len))) return false;
+    if (len > max_len) return false;
+    std::string s(len, '\0');
+    if (len > 0 && !read_full(fd, s.data(), len)) return false;
+    out->push_back(std::move(s));
+  }
+  return true;
+}
+
+// Send one byte with an fd attached (SCM_RIGHTS).
+inline bool send_fd(int sock, int fd_to_send) {
+  char data = 'F';
+  struct iovec iov = {&data, 1};
+  char ctrl[CMSG_SPACE(sizeof(int))];
+  std::memset(ctrl, 0, sizeof(ctrl));
+  struct msghdr msg = {};
+  msg.msg_iov = &iov;
+  msg.msg_iovlen = 1;
+  msg.msg_control = ctrl;
+  msg.msg_controllen = sizeof(ctrl);
+  struct cmsghdr* cmsg = CMSG_FIRSTHDR(&msg);
+  cmsg->cmsg_level = SOL_SOCKET;
+  cmsg->cmsg_type = SCM_RIGHTS;
+  cmsg->cmsg_len = CMSG_LEN(sizeof(int));
+  std::memcpy(CMSG_DATA(cmsg), &fd_to_send, sizeof(int));
+  for (;;) {
+    ssize_t r = sendmsg(sock, &msg, 0);
+    if (r < 0 && errno == EINTR) continue;
+    return r == 1;
+  }
+}
+
+// Receive one byte + attached fd; returns fd or -1.
+inline int recv_fd(int sock) {
+  char data = 0;
+  struct iovec iov = {&data, 1};
+  char ctrl[CMSG_SPACE(sizeof(int))];
+  std::memset(ctrl, 0, sizeof(ctrl));
+  struct msghdr msg = {};
+  msg.msg_iov = &iov;
+  msg.msg_iovlen = 1;
+  msg.msg_control = ctrl;
+  msg.msg_controllen = sizeof(ctrl);
+  for (;;) {
+    ssize_t r = recvmsg(sock, &msg, 0);
+    if (r < 0 && errno == EINTR) continue;
+    if (r <= 0) return -1;
+    break;
+  }
+  for (struct cmsghdr* cmsg = CMSG_FIRSTHDR(&msg); cmsg != nullptr;
+       cmsg = CMSG_NXTHDR(&msg, cmsg)) {
+    if (cmsg->cmsg_level == SOL_SOCKET && cmsg->cmsg_type == SCM_RIGHTS) {
+      int fd = -1;
+      std::memcpy(&fd, CMSG_DATA(cmsg), sizeof(int));
+      return fd;
+    }
+  }
+  return -1;
+}
+
+inline int connect_unix(const std::string& path) {
+  int sock = socket(AF_UNIX, SOCK_STREAM, 0);
+  if (sock < 0) return -1;
+  struct sockaddr_un addr = {};
+  addr.sun_family = AF_UNIX;
+  if (path.size() >= sizeof(addr.sun_path)) {
+    close(sock);
+    return -1;
+  }
+  std::strncpy(addr.sun_path, path.c_str(), sizeof(addr.sun_path) - 1);
+  if (connect(sock, reinterpret_cast<struct sockaddr*>(&addr),
+              sizeof(addr)) != 0) {
+    close(sock);
+    return -1;
+  }
+  return sock;
+}
+
+}  // namespace fuse_proxy

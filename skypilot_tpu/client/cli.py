@@ -53,14 +53,20 @@ def _parse_env_file(path: str) -> Dict[str, str]:
     return out
 
 
+def _merged_env(env, env_file) -> Dict[str, str]:
+    """--env-file entries with --env flags overriding on conflict."""
+    out: Dict[str, str] = {}
+    if env_file:
+        out.update(_parse_env_file(env_file))
+    out.update(_parse_env(list(env or [])))
+    return out
+
+
 def _build_task(entrypoint, name, workdir, infra, gpus, cpus, memory,
                 num_nodes, use_spot, env, cmd=None, env_file=None):
     from skypilot_tpu import resources as resources_lib
     from skypilot_tpu import task as task_lib
-    env_overrides = {}
-    if env_file:
-        env_overrides.update(_parse_env_file(env_file))
-    env_overrides.update(_parse_env(list(env or [])))
+    env_overrides = _merged_env(env, env_file)
     if entrypoint and entrypoint.endswith(('.yaml', '.yml')):
         config = common_utils.read_yaml(os.path.expanduser(entrypoint))
         task = task_lib.Task.from_yaml_config(config, env_overrides)
@@ -564,10 +570,7 @@ def jobs_launch_cmd(entrypoint, name, workdir, infra, gpus, cpus, memory,
                     'Pipelines take per-stage resources from the YAML; '
                     '--workdir/--infra/--gpus/--cpus/--memory/'
                     '--num-nodes/--use-spot do not apply.')
-            env_overrides = {}
-            if env_file:
-                env_overrides.update(_parse_env_file(env_file))
-            env_overrides.update(_parse_env(list(env or [])))
+            env_overrides = _merged_env(env, env_file)
             from skypilot_tpu import task as task_lib
             stages = [task_lib.Task.from_yaml_config(d, env_overrides)
                       for d in docs]

@@ -1,0 +1,206 @@
+"""fuse-proxy protocol tests (no privileges / no real FUSE needed).
+
+Reference analog: addons/fuse-proxy (Go) tests. The real fusermount is
+replaced by a fake that sends back an fd to a regular file, so the
+whole chain — shim -> unix socket -> server -> fusermount(_FUSE_COMMFD,
+SCM_RIGHTS) -> server -> shim -> libfuse(_FUSE_COMMFD) — runs as the
+test user. Receiving the fake's fd and reading its content through it
+proves fd identity end to end.
+"""
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), 'native')
+
+FAKE_FUSERMOUNT = r'''#!/usr/bin/env python3
+"""Fake fusermount: mount -> send an fd over _FUSE_COMMFD;
+-u -> write an unmount marker."""
+import os
+import socket
+import sys
+import array
+
+args = sys.argv[1:]
+if '-u' in args:
+    mountpoint = args[-1]
+    with open(os.environ['FAKE_MARKER'], 'w') as f:
+        f.write('unmounted ' + mountpoint)
+    sys.exit(0)
+mountpoint = args[-1]
+# The server passes a pinned /proc/self/fd/N path (TOCTOU hardening);
+# realpath() through it proves the fd points at the validated dir.
+with open(os.environ['FAKE_MARKER'], 'w') as f:
+    f.write('mounted ' + os.path.realpath(mountpoint))
+payload = os.environ['FAKE_PAYLOAD']
+fd = os.open(payload, os.O_RDONLY)
+comm = socket.socket(fileno=int(os.environ['_FUSE_COMMFD']))
+comm.sendmsg([b'F'], [(socket.SOL_SOCKET, socket.SCM_RIGHTS,
+                       array.array('i', [fd]).tobytes())])
+comm.close()
+sys.exit(0)
+'''
+
+
+@pytest.fixture(scope='module')
+def binaries():
+    if shutil.which('g++') is None:
+        pytest.skip('no g++')
+    subprocess.run(['make', '-s', 'fusermount-shim', 'fuse-proxy-server'],
+                   cwd=NATIVE_DIR, check=True)
+    return {
+        'shim': os.path.join(NATIVE_DIR, 'fusermount-shim'),
+        'server': os.path.join(NATIVE_DIR, 'fuse-proxy-server'),
+    }
+
+
+@pytest.fixture()
+def proxy(binaries, tmp_path):
+    fake = tmp_path / 'fake_fusermount.py'
+    fake.write_text(FAKE_FUSERMOUNT)
+    fake.chmod(0o755)
+    payload = tmp_path / 'payload.txt'
+    payload.write_text('hello-through-the-fd')
+    marker = tmp_path / 'marker.txt'
+    sock = tmp_path / 'proxy.sock'
+    allowed = tmp_path / 'mounts'
+    allowed.mkdir()
+    env = dict(os.environ)
+    env.update({
+        'FUSE_PROXY_SOCKET': str(sock),
+        'FUSE_PROXY_ALLOWED_ROOT': str(allowed),
+        'FUSE_PROXY_FUSERMOUNT': str(fake),
+        'FAKE_PAYLOAD': str(payload),
+        'FAKE_MARKER': str(marker),
+    })
+    proc = subprocess.Popen([binaries['server']], env=env,
+                            stderr=subprocess.PIPE)
+    deadline = time.time() + 10
+    while not sock.exists():
+        if time.time() > deadline or proc.poll() is not None:
+            raise RuntimeError('fuse-proxy server did not start')
+        time.sleep(0.05)
+    yield {'sock': str(sock), 'allowed': str(allowed),
+           'marker': str(marker), 'env': env, 'shim': binaries['shim']}
+    proc.terminate()
+    proc.wait(timeout=10)
+
+
+def _run_shim(proxy, args, with_commfd=True):
+    env = dict(proxy['env'])
+    pass_fds = ()
+    ours = None
+    if with_commfd:
+        ours, theirs = socket.socketpair()
+        env['_FUSE_COMMFD'] = str(theirs.fileno())
+        pass_fds = (theirs.fileno(),)
+    proc = subprocess.run([proxy['shim']] + args, env=env,
+                          pass_fds=pass_fds, capture_output=True,
+                          timeout=30)
+    if with_commfd:
+        theirs.close()
+    return proc, ours
+
+
+def test_mount_fd_relay(proxy):
+    mountpoint = os.path.join(proxy['allowed'], 'bucket')
+    os.makedirs(mountpoint, exist_ok=True)
+    proc, ours = _run_shim(
+        proxy, ['-o', 'rw,nosuid,nodev', '--', mountpoint])
+    assert proc.returncode == 0, proc.stderr.decode()
+    # libfuse's side: the fd must arrive over _FUSE_COMMFD…
+    msg, fds, _flags, _addr = socket.recv_fds(ours, 16, 1)
+    ours.close()
+    assert msg == b'F' and len(fds) == 1
+    # …and be THE fake's payload fd (content readable through it).
+    with os.fdopen(fds[0], 'r') as f:
+        assert f.read() == 'hello-through-the-fd'
+    # The server resolved the mountpoint before exec'ing fusermount.
+    with open(proxy['marker'], 'r', encoding='utf-8') as f:
+        assert f.read() == f'mounted {os.path.realpath(mountpoint)}'
+
+
+def test_mountpoint_outside_allowed_root_refused(proxy, tmp_path):
+    outside = tmp_path / 'not-allowed'
+    outside.mkdir()
+    proc, ours = _run_shim(proxy, ['--', str(outside)])
+    assert proc.returncode != 0
+    assert b'proxy status 201' in proc.stderr
+    ours.close()
+    assert not os.path.exists(proxy['marker'])
+
+
+def test_relative_mountpoint_resolved_against_client_cwd(proxy):
+    sub = os.path.join(proxy['allowed'], 'rel')
+    os.makedirs(sub, exist_ok=True)
+    env = dict(proxy['env'])
+    ours, theirs = socket.socketpair()
+    env['_FUSE_COMMFD'] = str(theirs.fileno())
+    proc = subprocess.run([proxy['shim'], '--', 'rel'], env=env,
+                          cwd=proxy['allowed'],
+                          pass_fds=(theirs.fileno(),),
+                          capture_output=True, timeout=30)
+    theirs.close()
+    assert proc.returncode == 0, proc.stderr.decode()
+    _msg, fds, _f, _a = socket.recv_fds(ours, 16, 1)
+    ours.close()
+    for fd in fds:
+        os.close(fd)
+    with open(proxy['marker'], 'r', encoding='utf-8') as f:
+        assert f.read() == f'mounted {os.path.realpath(sub)}'
+
+
+def test_unmount_no_fd(proxy):
+    mountpoint = os.path.join(proxy['allowed'], 'bucket2')
+    os.makedirs(mountpoint, exist_ok=True)
+    proc, _ = _run_shim(proxy, ['-u', mountpoint], with_commfd=False)
+    assert proc.returncode == 0, proc.stderr.decode()
+    with open(proxy['marker'], 'r', encoding='utf-8') as f:
+        assert f.read().startswith('unmounted ')
+
+
+def test_missing_mountpoint_bad_request(proxy):
+    proc, ours = _run_shim(proxy, ['-o', 'rw'])
+    assert proc.returncode != 0
+    assert b'proxy status 200' in proc.stderr
+    if ours:
+        ours.close()
+
+
+def test_server_unreachable(binaries, tmp_path):
+    env = dict(os.environ)
+    env['FUSE_PROXY_SOCKET'] = str(tmp_path / 'nope.sock')
+    proc = subprocess.run(
+        [binaries['shim'], '--', str(tmp_path)], env=env,
+        capture_output=True, timeout=30)
+    assert proc.returncode != 0
+    assert b'cannot reach fuse-proxy' in proc.stderr
+
+
+def test_symlink_escape_refused(proxy, tmp_path):
+    """A symlink under the allowed root pointing outside must not be
+    mountable (realpath-based validation)."""
+    link = os.path.join(proxy['allowed'], 'escape')
+    os.symlink(str(tmp_path), link)
+    proc, ours = _run_shim(proxy, ['--', link])
+    assert proc.returncode != 0
+    assert b'proxy status 201' in proc.stderr
+    if ours:
+        ours.close()
+
+
+def test_unmount_dead_mountpoint(proxy):
+    """Unmounting a mountpoint that cannot be stat'ed (dead FUSE
+    endpoint) must still reach fusermount -u: only the PARENT dir is
+    resolved for unmounts."""
+    ghost = os.path.join(proxy['allowed'], 'ghost')  # does not exist
+    proc, _ = _run_shim(proxy, ['-u', ghost], with_commfd=False)
+    assert proc.returncode == 0, proc.stderr.decode()
+    with open(proxy['marker'], 'r', encoding='utf-8') as f:
+        assert f.read() == f'unmounted {os.path.realpath(ghost)}'
