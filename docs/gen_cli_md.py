@@ -1,0 +1,51 @@
+"""Generate docs/cli.md from the click command tree.
+
+Usage: python docs/gen_cli_md.py > docs/cli.md
+"""
+import click
+
+from skypilot_tpu.client import cli as cli_mod
+
+
+def walk(cmd, path):
+    ctx = click.Context(cmd, info_name=path)
+    if isinstance(cmd, click.Group):
+        if path != 'stpu':
+            print(f'## `{path}`')
+            print()
+            if cmd.help:
+                print(cmd.help.strip())
+                print()
+        for name in sorted(cmd.commands):
+            walk(cmd.commands[name], f'{path} {name}')
+    else:
+        print(f'### `{path}`')
+        print()
+        print('```')
+        print(cmd.get_help(ctx))
+        print('```')
+        print()
+
+
+def main():
+    print('# `stpu` CLI reference')
+    print()
+    print('Auto-generated from the click command tree '
+          '(`python docs/gen_cli_md.py > docs/cli.md`). '
+          'Reference analog: `sky --help` (sky/client/cli/command.py).')
+    print()
+    print('## Top-level commands')
+    print()
+    group = cli_mod.cli
+    for name in sorted(group.commands):
+        sub = group.commands[name]
+        if not isinstance(sub, click.Group):
+            walk(sub, f'stpu {name}')
+    for name in sorted(group.commands):
+        sub = group.commands[name]
+        if isinstance(sub, click.Group):
+            walk(sub, f'stpu {name}')
+
+
+if __name__ == '__main__':
+    main()

@@ -195,12 +195,33 @@ def exec_cmd(cluster, entrypoint, name, workdir, infra, gpus, cpus, memory,
 @cli.command()
 @click.argument('clusters', nargs=-1)
 @click.option('--refresh', '-r', is_flag=True, default=False)
-def status(clusters, refresh) -> None:
+@click.option('--endpoints', is_flag=True, default=False,
+              help='Show head IP and opened-port URLs instead.')
+def status(clusters, refresh, endpoints) -> None:
     """Show clusters."""
     request_id = sdk.status(list(clusters) or None, refresh=refresh)
     records = sdk.get(request_id)
     if not records:
         click.echo('No existing clusters.')
+        return
+    if endpoints:
+        for r in records:
+            ip = r.get('head_ip')
+            # A stopped cluster's handle keeps its last IPs — showing
+            # them as live endpoints would point at released addresses.
+            if not ip or r['status'] != 'UP':
+                click.echo(f'{r["name"]}: (no endpoint — '
+                           f'status {r["status"]})')
+                continue
+            ports = r.get('ports') or []
+            if ports:
+                for p in ports:
+                    if '-' in str(p):
+                        click.echo(f'{r["name"]}: {ip} ports {p}')
+                    else:
+                        click.echo(f'{r["name"]}: http://{ip}:{p}')
+            else:
+                click.echo(f'{r["name"]}: {ip} (no ports opened)')
         return
     from rich.console import Console
     from rich.table import Table
@@ -607,7 +628,7 @@ def jobs_pool() -> None:
 
 @jobs_pool.command(name='apply')
 @click.argument('entrypoint', required=False)
-@click.option('--pool-name', '-n', 'pool_name', required=True)
+@click.option('--pool-name', '-p', 'pool_name', required=True)
 @click.option('--workers', type=int, default=1)
 @_add_options(_task_options)
 @click.option('--yes', '-y', is_flag=True, default=False)
@@ -763,7 +784,7 @@ def serve() -> None:
 
 @serve.command(name='up')
 @click.argument('entrypoint')
-@click.option('--service-name', '-n', default=None)
+@click.option('--service-name', '-s', default=None)
 @_add_options(_task_options)
 @click.option('--yes', '-y', is_flag=True, default=False)
 def serve_up_cmd(entrypoint, service_name, name, workdir, infra, gpus, cpus,
@@ -828,8 +849,27 @@ def serve_update_cmd(service_name, entrypoint, name, workdir, infra, gpus,
 @serve.command(name='logs')
 @click.argument('service_name')
 @click.option('--no-follow', is_flag=True, default=False)
-def serve_logs_cmd(service_name, no_follow) -> None:
-    """Stream a service's controller log."""
+@click.option('--replica', type=int, default=None,
+              help='Stream this replica\'s job log instead of the '
+                   'controller log.')
+def serve_logs_cmd(service_name, no_follow, replica) -> None:
+    """Stream a service's controller log (or one replica's job log)."""
+    if replica is not None:
+        rows = sdk.get(sdk.serve_status([service_name]))
+        if not rows:
+            _err(f'service {service_name!r} not found')
+        match = [r for r in rows[0]['replicas']
+                 if r['replica_id'] == replica]
+        if not match:
+            known = sorted(r['replica_id'] for r in rows[0]['replicas'])
+            _err(f'no replica {replica} (known: {known})')
+        try:
+            sdk.tail_logs(match[0]['cluster_name'], None,
+                          follow=not no_follow)
+        except exceptions.ClusterDoesNotExist:
+            _err(f'replica {replica} has no live cluster '
+                 f'({match[0]["status"]})')
+        return
     sdk.serve_logs(service_name, follow=not no_follow,
                    output=sys.stdout)
 
@@ -969,7 +1009,7 @@ def batch() -> None:
 
 @batch.command(name='launch')
 @click.argument('entrypoint')
-@click.option('--batch-name', '-n', 'batch_name', required=True)
+@click.option('--batch-name', '-b', 'batch_name', required=True)
 @click.option('--input', 'input_path', required=True,
               help='JSONL input file.')
 @click.option('--output-dir', required=True)

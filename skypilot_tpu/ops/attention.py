@@ -52,7 +52,9 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                  (impl == 'auto' and _pallas_flash_available() and
                   seq_len >= _FLASH_MIN_SEQ))
     if use_flash:
-        return _flash(q, k, v, causal=causal)
+        out = _flash(q, k, v, causal=causal)
+        if out is not None:
+            return out
     # GQA: expand kv heads to q heads for the XLA path.
     num_q_heads, num_kv_heads = q.shape[2], k.shape[2]
     if num_kv_heads != num_q_heads:
@@ -62,19 +64,68 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return jax.nn.dot_product_attention(q, k, v, is_causal=causal)
 
 
-def _flash(q: jax.Array, k: jax.Array, v: jax.Array, *,
-           causal: bool) -> jax.Array:
+def _pallas_flash_kernel(q: jax.Array, k: jax.Array, v: jax.Array,
+                         causal: bool) -> jax.Array:
+    """Single-shard pallas flash attention ([B,S,H,D] in/out)."""
     from jax.experimental.pallas.ops.tpu import flash_attention as fa
-    num_q_heads, num_kv_heads = q.shape[2], k.shape[2]
-    if num_kv_heads != num_q_heads:
-        rep = num_q_heads // num_kv_heads
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
     # pallas kernel wants [B,H,S,D]
     q_, k_, v_ = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
     sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     out = fa.flash_attention(q_, k_, v_, causal=causal, sm_scale=sm_scale)
     return jnp.swapaxes(out, 1, 2)
+
+
+def _active_mesh():
+    """The `with mesh:` context's mesh, or None.
+
+    jax.interpreters.pxla.thread_resources is deprecated (0.8.2) with
+    no public replacement for reading the context mesh yet; go through
+    the underlying module directly.
+    """
+    try:
+        from jax._src import mesh as mesh_mod
+        mesh = mesh_mod.thread_resources.env.physical_mesh
+    except (ImportError, AttributeError):  # jax internals moved
+        from jax.interpreters import pxla
+        mesh = pxla.thread_resources.env.physical_mesh
+    return None if mesh.empty else mesh
+
+
+def _flash(q: jax.Array, k: jax.Array, v: jax.Array, *,
+           causal: bool,
+           kernel=_pallas_flash_kernel) -> Optional[jax.Array]:
+    """Sharding-safe flash attention; returns None when the operands
+    cannot be cleanly shard_mapped (caller falls back to XLA)."""
+    num_q_heads, num_kv_heads = q.shape[2], k.shape[2]
+    if num_kv_heads != num_q_heads:
+        rep = num_q_heads // num_kv_heads
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    mesh = _active_mesh()
+    if mesh is None or mesh.size == 1:
+        return kernel(q, k, v, causal)
+    # A pallas call is opaque to GSPMD: under a sharded jit it would be
+    # REPLICATED onto every chip. shard_map it over the mesh instead —
+    # batch rides the data/fsdp axes, heads ride tensor; causal masking
+    # is per (batch, head) so shards are independent.
+    batch_shards = 1
+    batch_axes = []
+    for a in ('data', 'fsdp'):
+        if mesh.shape.get(a, 1) > 1:
+            batch_axes.append(a)
+            batch_shards *= mesh.shape[a]
+    if q.shape[0] % batch_shards != 0:
+        return None  # caller falls back to the (GSPMD-native) XLA path
+    heads_axis = ('tensor' if mesh.shape.get('tensor', 1) > 1 and
+                  num_q_heads % mesh.shape['tensor'] == 0 else None)
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+    spec = P(tuple(batch_axes) if batch_axes else None, None, heads_axis,
+             None)
+    return shard_map(
+        functools.partial(kernel, causal=causal), mesh=mesh,
+        in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)(q, k, v)
 
 
 def cached_decode_attention(q: jax.Array, k_new: jax.Array,

@@ -73,6 +73,15 @@ def status(cluster_names: Optional[List[str]] = None,
     out = []
     for r in records:
         handle = r['handle']
+        head_ip = None
+        ports = None
+        info = getattr(handle, 'cluster_info', None)
+        if info is not None and info.instances:
+            try:
+                head_ip = info.get_head_instance().get_feasible_ip()
+            except ValueError:
+                pass
+            ports = info.provider_config.get('ports') or None
         out.append({
             'name': r['name'],
             'status': r['status'].value,
@@ -83,6 +92,8 @@ def status(cluster_names: Optional[List[str]] = None,
             'user': r.get('owner'),
             'num_hosts': getattr(handle, 'num_hosts', None),
             'head_agent_addr': getattr(handle, 'head_agent_addr', None),
+            'head_ip': head_ip,
+            'ports': ports,
         })
     return out
 
