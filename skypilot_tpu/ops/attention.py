@@ -97,25 +97,28 @@ def _flash(q: jax.Array, k: jax.Array, v: jax.Array, *,
     """Sharding-safe flash attention; returns None when the operands
     cannot be cleanly shard_mapped (caller falls back to XLA)."""
     num_q_heads, num_kv_heads = q.shape[2], k.shape[2]
+    mesh = _active_mesh()
+    # Feasibility checks BEFORE the GQA expansion so the bail-out path
+    # doesn't materialize a repeat the XLA fallback then redoes.
+    batch_shards = 1
+    batch_axes = []
+    if mesh is not None and mesh.size > 1:
+        for a in ('data', 'fsdp'):
+            if mesh.shape.get(a, 1) > 1:
+                batch_axes.append(a)
+                batch_shards *= mesh.shape[a]
+        if q.shape[0] % batch_shards != 0:
+            return None  # caller falls back to the GSPMD-native XLA path
     if num_kv_heads != num_q_heads:
         rep = num_q_heads // num_kv_heads
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
-    mesh = _active_mesh()
     if mesh is None or mesh.size == 1:
         return kernel(q, k, v, causal)
     # A pallas call is opaque to GSPMD: under a sharded jit it would be
     # REPLICATED onto every chip. shard_map it over the mesh instead —
     # batch rides the data/fsdp axes, heads ride tensor; causal masking
     # is per (batch, head) so shards are independent.
-    batch_shards = 1
-    batch_axes = []
-    for a in ('data', 'fsdp'):
-        if mesh.shape.get(a, 1) > 1:
-            batch_axes.append(a)
-            batch_shards *= mesh.shape[a]
-    if q.shape[0] % batch_shards != 0:
-        return None  # caller falls back to the (GSPMD-native) XLA path
     heads_axis = ('tensor' if mesh.shape.get('tensor', 1) > 1 and
                   num_q_heads % mesh.shape['tensor'] == 0 else None)
     from jax import shard_map

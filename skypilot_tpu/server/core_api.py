@@ -74,14 +74,16 @@ def status(cluster_names: Optional[List[str]] = None,
     for r in records:
         handle = r['handle']
         head_ip = None
-        ports = None
         info = getattr(handle, 'cluster_info', None)
         if info is not None and info.instances:
             try:
                 head_ip = info.get_head_instance().get_feasible_ip()
             except ValueError:
                 pass
-            ports = info.provider_config.get('ports') or None
+        # Ports from the launched Resources (cloud-agnostic), not the
+        # deploy vars (only gcp/aws emit a 'ports' key there).
+        launched = getattr(handle, 'launched_resources', None)
+        ports = getattr(launched, 'ports', None) or None
         out.append({
             'name': r['name'],
             'status': r['status'].value,

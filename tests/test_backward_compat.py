@@ -1,0 +1,102 @@
+"""Backward compatibility: OLD clients from git history drive the
+CURRENT server.
+
+Reference analog: tests/smoke_tests/backward_compat/ — pins an old
+released client against the new server to catch wire-format breaks.
+Here the old client is exported straight from git history (the
+round-1 client speaks legacy v1 with no version header; a mid-round-2
+client speaks v2 with idempotent POSTs), so any non-additive change to
+the request/response schemas fails this suite.
+"""
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from test_api_server import api_server  # noqa: F401  (fixture)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# (label, revision) — revisions are permanent history of this repo.
+OLD_REVISIONS = [
+    ('round1-final-v1-client', '6b40257'),
+    ('round2-mid-v2-client', 'aa15745'),
+]
+
+
+@pytest.fixture(scope='module', params=OLD_REVISIONS,
+                ids=[r[0] for r in OLD_REVISIONS])
+def old_client_tree(request, tmp_path_factory):
+    label, rev = request.param
+    dest = tmp_path_factory.mktemp(f'oldclient-{label}')
+    archive = subprocess.run(
+        ['git', 'archive', rev, 'skypilot_tpu'],
+        cwd=REPO, capture_output=True)
+    if archive.returncode != 0:
+        pytest.skip(f'git archive {rev} failed: '
+                    f'{archive.stderr.decode()[:200]}')
+    tar = subprocess.run(['tar', '-x', '-C', str(dest)],
+                         input=archive.stdout, capture_output=True)
+    assert tar.returncode == 0, tar.stderr.decode()
+    return str(dest)
+
+
+def _run_old_client(tree, server_url, code):
+    env = dict(os.environ)
+    env['PYTHONPATH'] = tree
+    env['SKYPILOT_API_SERVER_ENDPOINT'] = server_url
+    proc = subprocess.run(
+        [sys.executable, '-c', textwrap.dedent(code)],
+        env=env, capture_output=True, text=True, timeout=120)
+    return proc
+
+
+def test_old_client_status(api_server, old_client_tree):  # noqa: F811
+    proc = _run_old_client(old_client_tree, api_server, '''
+        from skypilot_tpu.client import sdk
+        records = sdk.get(sdk.status())
+        assert records == [], records
+        print('STATUS_OK')
+    ''')
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert 'STATUS_OK' in proc.stdout
+
+
+def test_old_client_dryrun_launch(api_server, old_client_tree):  # noqa: F811
+    proc = _run_old_client(old_client_tree, api_server, '''
+        from skypilot_tpu import task as task_lib
+        from skypilot_tpu.client import sdk
+        task = task_lib.Task(run='echo hi', name='compat')
+        rid = sdk.launch(task, cluster_name='compat-c', dryrun=True)
+        result = sdk.get(rid)
+        assert result is None or isinstance(result, dict), result
+        print('LAUNCH_OK')
+    ''')
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert 'LAUNCH_OK' in proc.stdout
+
+
+def test_old_client_accelerators(api_server, old_client_tree):  # noqa: F811
+    proc = _run_old_client(old_client_tree, api_server, '''
+        from skypilot_tpu.client import sdk
+        accs = sdk.get(sdk.list_accelerators('tpu-v5e'))
+        assert any('tpu-v5e' in a for a in accs), list(accs)[:5]
+        print('ACCS_OK')
+    ''')
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert 'ACCS_OK' in proc.stdout
+
+
+def test_too_old_client_is_rejected_cleanly(api_server):  # noqa: F811
+    """A client below MIN_COMPATIBLE must get an actionable 400, not a
+    mis-parse."""
+    import requests
+
+    from skypilot_tpu.server import versions
+    resp = requests.post(
+        f'{api_server}/status', json={},
+        headers={versions.HEADER: '0'}, timeout=10)
+    assert resp.status_code == 400
+    assert 'version' in resp.json()['error'].lower()

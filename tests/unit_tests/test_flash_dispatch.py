@@ -8,11 +8,9 @@ data/fsdp, heads over tensor) and match the XLA reference exactly.
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from skypilot_tpu.ops import attention as attn
 from skypilot_tpu.parallel import mesh as mesh_lib
-from skypilot_tpu.parallel.train import shard_batch
 
 
 def _plain_kernel(q, k, v, causal):
