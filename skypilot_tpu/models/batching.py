@@ -15,6 +15,7 @@ HTTP server in recipes/serve_lm.py (--continuous-batching).
 """
 from __future__ import annotations
 
+import collections
 import functools
 import queue
 import threading
@@ -38,7 +39,8 @@ class ContinuousBatchingEngine:
 
     def __init__(self, model, params, *, num_slots: int = 8,
                  max_total_len: int = 256, temperature: float = 0.0,
-                 eos_id: Optional[int] = None) -> None:
+                 eos_id: Optional[int] = None,
+                 paged: Optional[bool] = None) -> None:
         assert max_total_len <= model.config.max_seq_len
         self.model = model
         self.params = params
@@ -47,6 +49,22 @@ class ContinuousBatchingEngine:
         self.temperature = temperature
         self.eos_id = eos_id
 
+        # Paged KV cache (vLLM-style; ops/paged_attention.py): K/V live
+        # in a shared physical page pool sized for the AGGREGATE live
+        # tokens instead of num_slots * max_total_len, with host-side
+        # incremental page allocation. Auto-on for models that declare
+        # kv_page_size/kv_total_pages (llama).
+        if paged is None:
+            paged = (getattr(model.config, 'kv_page_size', 0) > 0 and
+                     getattr(model.config, 'kv_total_pages', 0) > 0)
+        self.paged = paged
+        if self.paged:
+            self.page_size = model.config.kv_page_size
+            self.total_pages = model.config.kv_total_pages
+            self.pages_per_seq = -(-max_total_len // self.page_size)
+
+        # _fresh_cache is the single paging-reset point (also the
+        # error-recovery path).
         self.cache = self._fresh_cache()
 
         # Host-side slot bookkeeping (device work stays fixed-shape).
@@ -59,6 +77,11 @@ class ContinuousBatchingEngine:
         self.temps = np.zeros((num_slots,), np.float32)
 
         self._queue: 'queue.Queue' = queue.Queue()
+        # FCFS admission order, owned by the scheduler thread: requests
+        # drain from _queue into _ready; a stalled (page-pressure) or
+        # preempted request returns to the HEAD so later arrivals can't
+        # starve it (vLLM-style head-of-line blocking).
+        self._ready: 'collections.deque' = collections.deque()
         self._rng = jax.random.PRNGKey(0)
         self._prefill_fns: Dict[int, Any] = {}
         self._decode = self._make_decode_fn()
@@ -66,16 +89,36 @@ class ContinuousBatchingEngine:
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
+    def _reset_paging(self) -> None:
+        from skypilot_tpu.ops import paged_attention as paged_ops
+        self.allocator = paged_ops.PageAllocator(self.total_pages,
+                                                 self.pages_per_seq)
+        # Physical page 0 is the TRASH page: unallocated table entries
+        # point at it, so junk writes (inactive slots, padded prefill
+        # tails, exhausted slots) can never corrupt a live page.
+        trash = self.allocator.allocate(1)
+        assert trash == [0], trash
+        self.page_table = np.zeros((self.num_slots, self.pages_per_seq),
+                                   np.int32)
+        self.owned_pages: List[List[int]] = [
+            [] for _ in range(self.num_slots)]
+        self.allocated_tokens = np.zeros((self.num_slots,), np.int32)
+
     def _fresh_cache(self):
         """Zeroed KV cache for the slot pool. Also the recovery path:
         prefill/decode DONATE the cache buffer, so after a failed
         device execution the old buffer is gone and must be rebuilt."""
         import flax.linen as nn
+        kwargs = {}
+        if self.paged:
+            self._reset_paging()
+            kwargs['page_indices'] = jnp.zeros(
+                (self.num_slots, self.pages_per_seq), jnp.int32)
         cache = self.model.init(
             jax.random.PRNGKey(0),
             jnp.zeros((self.num_slots, 1), jnp.int32),
             positions=jnp.zeros((self.num_slots, 1), jnp.int32),
-            decode=True)['cache']
+            decode=True, **kwargs)['cache']
         # init *ran* a step; zero it (same contract as generate.py).
         return jax.tree.map(jnp.zeros_like, nn.meta.unbox(cache))
 
@@ -86,12 +129,16 @@ class ContinuousBatchingEngine:
         # Donate the cache: the caller always replaces self.cache with
         # the result, so XLA updates in place instead of copying the
         # full KV cache every token (no-op on CPU, vital on TPU).
+        paged = self.paged
+
         @functools.partial(jax.jit, donate_argnums=(1,))
-        def decode(params, cache, cur_token, pos, temps, rng):
+        def decode(params, cache, cur_token, pos, temps, rng,
+                   page_indices=None):
+            extra = {'page_indices': page_indices} if paged else {}
             logits, mutated = model.apply(
                 {'params': params, 'cache': cache},
                 cur_token[:, None], positions=pos[:, None], decode=True,
-                mutable=['cache'])
+                mutable=['cache'], **extra)
             logits = logits[:, 0]
             # Per-slot temperature: sampled where temp>0, greedy else.
             scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
@@ -105,14 +152,42 @@ class ContinuousBatchingEngine:
     def _prefill_fn(self, bucket_len: int):
         """fn(params, cache, slot, prompt[P], plen) -> (cache, next_tok).
 
-        Scans the (padded) prompt through the model on a batch-1 slice
-        of the slot's cache rows, then scatters the rows back — other
-        slots' caches are untouched, so prefill can interleave with the
-        shared decode loop.
+        Dense: scans the (padded) prompt through the model on a
+        batch-1 slice of the slot's cache rows, then scatters the rows
+        back. Paged: the cache has no slot dimension — the scan runs
+        on the full (donated) pool and writes only the slot's own
+        pages via its page-table row; the padded tail writes land in
+        the trash page. Either way other slots are untouched, so
+        prefill interleaves with the shared decode loop.
         """
         if bucket_len in self._prefill_fns:
             return self._prefill_fns[bucket_len]
         model = self.model
+        if self.paged:
+
+            @functools.partial(jax.jit, donate_argnums=(1,))
+            def prefill_paged(params, cache, prompt, plen, page_row):
+
+                def step(cache, t):
+                    tok = jax.lax.dynamic_index_in_dim(
+                        prompt, jnp.minimum(t, plen - 1), keepdims=False)
+                    logits, mutated = model.apply(
+                        {'params': params, 'cache': cache},
+                        tok[None, None],
+                        positions=jnp.full((1, 1), t, jnp.int32),
+                        decode=True, mutable=['cache'],
+                        page_indices=page_row)
+                    return mutated['cache'], \
+                        logits[0, 0].astype(jnp.float32)
+
+                cache, all_logits = jax.lax.scan(
+                    step, cache, jnp.arange(bucket_len))
+                last = jax.lax.dynamic_index_in_dim(
+                    all_logits, plen - 1, axis=0, keepdims=False)
+                return cache, last
+
+            self._prefill_fns[bucket_len] = prefill_paged
+            return prefill_paged
 
         @functools.partial(jax.jit, donate_argnums=(1,))
         def prefill(params, cache, slot, prompt, plen):
@@ -183,7 +258,8 @@ class ContinuousBatchingEngine:
                 if self.active.any():
                     self._decode_step()
                     progressed = True
-                if not progressed and self._queue.empty():
+                if not progressed and self._queue.empty() and \
+                        not self._ready:
                     # Idle: block briefly for the next request.
                     try:
                         item = self._queue.get(timeout=0.05)
@@ -210,6 +286,9 @@ class ContinuousBatchingEngine:
                 self.pos[:] = 0
                 self.cur_token[:] = 0
                 self.temps[:] = 0
+                while self._ready:
+                    *_rest, fut = self._ready.popleft()
+                    fut.set_exception(e)
                 while not self._queue.empty():
                     try:
                         *_rest, fut = self._queue.get_nowait()
@@ -219,27 +298,59 @@ class ContinuousBatchingEngine:
 
     def _admit(self) -> bool:
         admitted = False
-        while not self._queue.empty() and not self.active.all():
+        while True:
             try:
-                prompt, max_new, temp, fut = self._queue.get_nowait()
+                self._ready.append(self._queue.get_nowait())
             except queue.Empty:
                 break
+        while self._ready and not self.active.all():
+            prompt, max_new, temp, fut = self._ready.popleft()
             if max_new <= 0:
                 fut.set_result(list(prompt))  # nothing to generate
                 continue
             slot = int(np.argmin(self.active))  # first free slot
+            plen = len(prompt)
+            bucket = _bucket(plen, self.max_total_len)
+            if self.paged:
+                # The prefill scan writes positions [0, bucket): the
+                # real prompt needs pages; the padded tail hits trash
+                # only where the table row is unallocated, so allocate
+                # for plen (+1 for the first generated token).
+                need = self.allocator.pages_needed(plen + 1,
+                                                   self.page_size)
+                usable_tokens = (self.total_pages - 1) * self.page_size
+                if plen + 1 > usable_tokens:
+                    # Can never fit, even alone: fail loudly.
+                    fut.set_exception(MemoryError(
+                        f'prompt needs {need} KV pages but the '
+                        f'pool has {self.total_pages - 1} usable'))
+                    continue
+                if not self.allocator.can_allocate(need):
+                    # Pool exhausted: back to the HEAD and stop
+                    # admitting until a sequence releases pages —
+                    # later arrivals must not starve this one.
+                    self._ready.appendleft((prompt, max_new, temp, fut))
+                    break
+                pages = self.allocator.allocate(need)
+                self.owned_pages[slot] = pages
+                self.page_table[slot, :] = 0
+                self.page_table[slot, :need] = pages
+                self.allocated_tokens[slot] = need * self.page_size
             # Claim the slot BEFORE any device work: if prefill raises,
             # the loop's exception handler finds (and fails) this
             # future instead of leaving the client hanging.
             self.futures[slot] = fut
-            plen = len(prompt)
-            bucket = _bucket(plen, self.max_total_len)
             prefill = self._prefill_fn(bucket)
             padded = jnp.asarray(
                 prompt + [0] * (bucket - plen), jnp.int32)
-            self.cache, last_logits = prefill(
-                self.params, self.cache, jnp.int32(slot), padded,
-                jnp.int32(plen))
+            if self.paged:
+                self.cache, last_logits = prefill(
+                    self.params, self.cache, padded, jnp.int32(plen),
+                    jnp.asarray(self.page_table[slot:slot + 1]))
+            else:
+                self.cache, last_logits = prefill(
+                    self.params, self.cache, jnp.int32(slot), padded,
+                    jnp.int32(plen))
             if temp > 0:
                 self._rng, sub = jax.random.split(self._rng)
                 first = jax.random.categorical(sub, last_logits / temp)
@@ -248,20 +359,71 @@ class ContinuousBatchingEngine:
             self.cur_token[slot] = int(jax.device_get(first))
             self.pos[slot] = plen
             self.outputs[slot] = list(prompt)
-            self.limits[slot] = min(plen + max_new, self.max_total_len)
+            limit = min(plen + max_new, self.max_total_len)
+            if self.paged:
+                # The pool bounds the deepest any sequence can get;
+                # admission would otherwise hand out a limit the
+                # allocator can never satisfy even running alone.
+                limit = min(limit,
+                            (self.total_pages - 1) * self.page_size)
+            self.limits[slot] = limit
             self.temps[slot] = temp
             self.active[slot] = True
             admitted = True
         return admitted
 
+    def _grow_pages(self) -> None:
+        """Before a decode step: every active slot about to write past
+        its allocated tokens gets one more page. On pool exhaustion
+        the slot is PREEMPTED vLLM-style: its pages are released and
+        the request re-queued with everything generated so far as the
+        new prompt (recompute on re-admission), so page pressure
+        stalls work instead of failing it. Requests that can never fit
+        the pool fail loudly at admission. Sampled (temperature>0)
+        requests may diverge across a preemption (fresh RNG);
+        greedy decoding is unaffected."""
+        for slot in range(self.num_slots):
+            if not self.active[slot]:
+                continue
+            if int(self.pos[slot]) < int(self.allocated_tokens[slot]):
+                continue
+            logical = int(self.pos[slot]) // self.page_size
+            if self.allocator.can_allocate(1):
+                page = self.allocator.allocate(1)[0]
+                self.owned_pages[slot].append(page)
+                self.page_table[slot, logical] = page
+                self.allocated_tokens[slot] += self.page_size
+                continue
+            # Preempt: outputs-so-far become the prompt; the pending
+            # cur_token is regenerated by the re-prefill.
+            fut = self.futures[slot]
+            remaining = int(self.limits[slot]) - len(self.outputs[slot])
+            self.futures[slot] = None
+            self.active[slot] = False
+            self.allocator.release(self.owned_pages[slot])
+            self.owned_pages[slot] = []
+            self.page_table[slot, :] = 0
+            self.allocated_tokens[slot] = 0
+            if fut is not None:
+                self._ready.appendleft((list(self.outputs[slot]),
+                                        max(remaining, 1),
+                                        float(self.temps[slot]), fut))
+
     def _decode_step(self) -> None:
         self._rng, sub = jax.random.split(self._rng)
-        # Inactive slots decode at position 0 as a no-op (their cache
-        # row gets scribbled at position 0; it is zeroed on prefill).
+        extra = ()
+        if self.paged:
+            self._grow_pages()
+            if not self.active.any():
+                return  # _grow_pages may have failed the last slot
+            extra = (jnp.asarray(self.page_table),)
+        # Inactive slots decode at position 0 as a no-op: dense caches
+        # get their row scribbled at position 0 (zeroed on prefill);
+        # paged writes land in the trash page.
         self.cache, sampled = self._decode(
             self.params, self.cache,
             jnp.asarray(self.cur_token), jnp.asarray(self.pos),
-            jnp.asarray(self.temps), sub)
+            jnp.asarray(self.temps), sub, *extra)
         sampled = np.asarray(jax.device_get(sampled))
         for slot in range(self.num_slots):
             if not self.active[slot]:
@@ -277,5 +439,10 @@ class ContinuousBatchingEngine:
                 fut = self.futures[slot]
                 self.futures[slot] = None
                 self.active[slot] = False
+                if self.paged:
+                    self.allocator.release(self.owned_pages[slot])
+                    self.owned_pages[slot] = []
+                    self.page_table[slot, :] = 0
+                    self.allocated_tokens[slot] = 0
                 if fut is not None:
                     fut.set_result(list(self.outputs[slot]))

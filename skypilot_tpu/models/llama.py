@@ -32,6 +32,11 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: Dtype = jnp.bfloat16
     remat: bool = False
+    # Paged KV cache (serving): page size in tokens and the physical
+    # page-pool size. Used only when decode calls pass `page_indices`;
+    # page 0 is the engine's trash page for unallocated table entries.
+    kv_page_size: int = 16
+    kv_total_pages: int = 128
 
     @classmethod
     def llama3_8b(cls, **kw) -> 'LlamaConfig':
@@ -94,7 +99,8 @@ class Attention(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array, positions: jax.Array,
-                 decode: bool = False) -> jax.Array:
+                 decode: bool = False,
+                 page_indices: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.config
         batch, seq, _ = x.shape
         hd = cfg.head_dim
@@ -114,17 +120,41 @@ class Attention(nn.Module):
             # lets continuous batching decode slots at different depths
             # in one step (models/batching.py).
             assert seq == 1, f'decode mode feeds one token, got {seq}'
-            cached_k = self.variable(
-                'cache', 'cached_key', jnp.zeros,
-                (batch, cfg.max_seq_len, cfg.num_kv_heads, hd), cfg.dtype)
-            cached_v = self.variable(
-                'cache', 'cached_value', jnp.zeros,
-                (batch, cfg.max_seq_len, cfg.num_kv_heads, hd), cfg.dtype)
-            out, cached_k.value, cached_v.value = \
-                attention_ops.cached_decode_attention(
-                    q, k, v, cached_k.value, cached_v.value,
-                    positions[:, 0])
-            out = out.astype(cfg.dtype)
+            if page_indices is not None:
+                # Paged KV (vLLM-style): K/V live in a shared physical
+                # page pool; this sequence's pages come from the
+                # engine-provided table (ops/paged_attention.py).
+                from skypilot_tpu.ops import paged_attention as paged_ops
+                k_pages = self.variable(
+                    'cache', 'k_pages', jnp.zeros,
+                    (cfg.num_kv_heads, cfg.kv_total_pages,
+                     cfg.kv_page_size, hd), cfg.dtype)
+                v_pages = self.variable(
+                    'cache', 'v_pages', jnp.zeros,
+                    (cfg.num_kv_heads, cfg.kv_total_pages,
+                     cfg.kv_page_size, hd), cfg.dtype)
+                k_pages.value, v_pages.value = paged_ops.write_kv(
+                    k_pages.value, v_pages.value, k[:, 0], v[:, 0],
+                    positions[:, 0], page_indices)
+                out = paged_ops.paged_decode_attention(
+                    q[:, 0], k_pages.value, v_pages.value,
+                    lengths=positions[:, 0] + 1,
+                    page_indices=page_indices)
+                out = out[:, None].astype(cfg.dtype)
+            else:
+                cached_k = self.variable(
+                    'cache', 'cached_key', jnp.zeros,
+                    (batch, cfg.max_seq_len, cfg.num_kv_heads, hd),
+                    cfg.dtype)
+                cached_v = self.variable(
+                    'cache', 'cached_value', jnp.zeros,
+                    (batch, cfg.max_seq_len, cfg.num_kv_heads, hd),
+                    cfg.dtype)
+                out, cached_k.value, cached_v.value = \
+                    attention_ops.cached_decode_attention(
+                        q, k, v, cached_k.value, cached_v.value,
+                        positions[:, 0])
+                out = out.astype(cfg.dtype)
         else:
             q = nn.with_logical_constraint(q,
                                            ('batch', 'seq', 'heads', 'kv'))
@@ -155,11 +185,12 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array, positions: jax.Array,
-                 decode: bool = False) -> jax.Array:
+                 decode: bool = False,
+                 page_indices: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.config
         x = x + Attention(cfg, name='attn')(
             RMSNorm(cfg.norm_eps, cfg.dtype, name='attn_norm')(x), positions,
-            decode)
+            decode, page_indices)
         x = x + FeedForward(cfg, name='mlp')(
             RMSNorm(cfg.norm_eps, cfg.dtype, name='mlp_norm')(x))
         return nn.with_logical_constraint(x, ('batch', 'seq', 'act_embed'))
@@ -172,7 +203,8 @@ class Llama(nn.Module):
     @nn.compact
     def __call__(self, tokens: jax.Array,
                  positions: Optional[jax.Array] = None,
-                 decode: bool = False) -> jax.Array:
+                 decode: bool = False,
+                 page_indices: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.config
         batch, seq = tokens.shape
         if positions is None:
@@ -190,7 +222,8 @@ class Llama(nn.Module):
             block = nn.remat(Block, prevent_cse=False,
                              static_argnums=(3,))
         for i in range(cfg.num_layers):
-            x = block(cfg, name=f'layer_{i}')(x, positions, decode)
+            x = block(cfg, name=f'layer_{i}')(x, positions, decode,
+                                              page_indices)
         x = RMSNorm(cfg.norm_eps, cfg.dtype, name='final_norm')(x)
         head = self.param(
             'lm_head',

@@ -38,10 +38,16 @@ def main() -> None:
     parser.add_argument('--port', type=int,
                         default=int(os.environ.get('SKYPILOT_SERVE_PORT',
                                                    8000)))
+    parser.add_argument('--cpu', action='store_true',
+                        help='pin the CPU backend (smoke/dev runs; the '
+                             'JAX_PLATFORMS env var is overridden by '
+                             'some TPU plugins, jax.config is not)')
     args = parser.parse_args()
 
     import flax.linen as nn
     import jax
+    if args.cpu:
+        jax.config.update('jax_platforms', 'cpu')
     import jax.numpy as jnp
 
     from skypilot_tpu.models import generate as gen

@@ -87,12 +87,17 @@ def test_default_render():
     assert container['ports'][0]['containerPort'] == 46580
     env_names = [e['name'] for e in container['env']]
     assert 'SKYPILOT_API_TOKEN' not in env_names  # empty token -> off
+    # Baked-image default: no empty /app volume shadowing the code.
+    mounts = [m['name'] for m in container['volumeMounts']]
+    assert 'app' not in mounts
+    assert 'PYTHONPATH' not in env_names
 
 
 def test_overridden_render():
     docs = _load_chart({'fuseProxy.enabled': True,
                         'apiServer.port': 50000,
-                        'apiServer.authToken': 'tok123',
+                        'apiServer.authToken': 123456,
+                        'apiServer.codeVolume': True,
                         'namespace': 'custom-ns'})
     kinds = [d['kind'] for d in docs]
     assert 'DaemonSet' in kinds
@@ -101,7 +106,14 @@ def test_overridden_render():
     container = deploy['spec']['template']['spec']['containers'][0]
     assert container['ports'][0]['containerPort'] == 50000
     env = {e['name']: e.get('value') for e in container['env']}
-    assert env['SKYPILOT_API_TOKEN'] == 'tok123'
+    # Digits-only tokens must render as STRINGS (quoted interpolation)
+    # or `kubectl apply` rejects the EnvVar.
+    assert env['SKYPILOT_API_TOKEN'] == '123456'
+    assert env['PYTHONPATH'] == '/app'
+    assert 'app' in [m['name'] for m in container['volumeMounts']]
+    volumes = [v['name']
+               for v in deploy['spec']['template']['spec']['volumes']]
+    assert 'app' in volumes
     svc = next(d for d in docs if d['kind'] == 'Service')
     assert svc['spec']['ports'][0]['port'] == 50000
     ds = next(d for d in docs if d['kind'] == 'DaemonSet')
