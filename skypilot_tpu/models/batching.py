@@ -54,13 +54,27 @@ class ContinuousBatchingEngine:
         # tokens instead of num_slots * max_total_len, with host-side
         # incremental page allocation. Auto-on for models that declare
         # kv_page_size/kv_total_pages (llama).
+        cfg_page = getattr(model.config, 'kv_page_size', 0)
+        cfg_pool = getattr(model.config, 'kv_total_pages', 0)
+        pool_ok = (cfg_page > 0 and cfg_pool > 0 and
+                   (cfg_pool - 1) * cfg_page >= max_total_len)
         if paged is None:
-            paged = (getattr(model.config, 'kv_page_size', 0) > 0 and
-                     getattr(model.config, 'kv_total_pages', 0) > 0)
+            # Auto-on only when the pool can hold at least ONE
+            # full-depth sequence — a small default pool must not
+            # silently cap servable lengths below max_total_len (the
+            # dense path has no such cap).
+            paged = pool_ok
+        elif paged and not pool_ok:
+            raise ValueError(
+                f'paged=True but kv_total_pages={cfg_pool} x '
+                f'kv_page_size={cfg_page} cannot hold one '
+                f'max_total_len={max_total_len} sequence '
+                f'(usable {(max(cfg_pool - 1, 0)) * cfg_page} tokens; '
+                f'page 0 is reserved).')
         self.paged = paged
         if self.paged:
-            self.page_size = model.config.kv_page_size
-            self.total_pages = model.config.kv_total_pages
+            self.page_size = cfg_page
+            self.total_pages = cfg_pool
             self.pages_per_seq = -(-max_total_len // self.page_size)
 
         # _fresh_cache is the single paging-reset point (also the
@@ -382,6 +396,7 @@ class ContinuousBatchingEngine:
         the pool fail loudly at admission. Sampled (temperature>0)
         requests may diverge across a preemption (fresh RNG);
         greedy decoding is unaffected."""
+        preempted = []
         for slot in range(self.num_slots):
             if not self.active[slot]:
                 continue
@@ -405,9 +420,12 @@ class ContinuousBatchingEngine:
             self.page_table[slot, :] = 0
             self.allocated_tokens[slot] = 0
             if fut is not None:
-                self._ready.appendleft((list(self.outputs[slot]),
-                                        max(remaining, 1),
-                                        float(self.temps[slot]), fut))
+                preempted.append((list(self.outputs[slot]),
+                                  max(remaining, 1),
+                                  float(self.temps[slot]), fut))
+        # Back to the HEAD preserving pass order (repeated appendleft
+        # would reverse it — an FCFS fairness inversion).
+        self._ready.extendleft(reversed(preempted))
 
     def _decode_step(self) -> None:
         self._rng, sub = jax.random.split(self._rng)

@@ -30,6 +30,9 @@ class GPTConfig:
     dropout_rate: float = 0.0
     dtype: Dtype = jnp.bfloat16
     remat: bool = False
+    # Paged KV cache for serving (see llama.LlamaConfig).
+    kv_page_size: int = 16
+    kv_total_pages: int = 128
 
     @classmethod
     def gpt2_124m(cls, **kw) -> 'GPTConfig':
@@ -73,7 +76,8 @@ class CausalSelfAttention(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array, deterministic: bool = True,
                  positions: Optional[jax.Array] = None,
-                 decode: bool = False) -> jax.Array:
+                 decode: bool = False,
+                 page_indices: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.config
         batch, seq, _ = x.shape
         qkv = _dense(3 * cfg.embed_dim, ('embed', 'mlp'), cfg.dtype,
@@ -88,19 +92,39 @@ class CausalSelfAttention(nn.Module):
             # and continuous-batching engines drive GPT unchanged.
             assert seq == 1, f'decode mode feeds one token, got {seq}'
             assert positions is not None
-            cached_k = self.variable(
-                'cache', 'cached_key', jnp.zeros,
-                (batch, cfg.block_size, cfg.num_heads, cfg.head_dim),
-                cfg.dtype)
-            cached_v = self.variable(
-                'cache', 'cached_value', jnp.zeros,
-                (batch, cfg.block_size, cfg.num_heads, cfg.head_dim),
-                cfg.dtype)
-            out, cached_k.value, cached_v.value = \
-                attention_ops.cached_decode_attention(
-                    q, k, v, cached_k.value, cached_v.value,
-                    positions[:, 0])
-            out = out.astype(cfg.dtype)
+            if page_indices is not None:
+                # Paged KV (same contract as models/llama.py).
+                from skypilot_tpu.ops import paged_attention as paged_ops
+                k_pages = self.variable(
+                    'cache', 'k_pages', jnp.zeros,
+                    (cfg.num_heads, cfg.kv_total_pages,
+                     cfg.kv_page_size, cfg.head_dim), cfg.dtype)
+                v_pages = self.variable(
+                    'cache', 'v_pages', jnp.zeros,
+                    (cfg.num_heads, cfg.kv_total_pages,
+                     cfg.kv_page_size, cfg.head_dim), cfg.dtype)
+                k_pages.value, v_pages.value = paged_ops.write_kv(
+                    k_pages.value, v_pages.value, k[:, 0], v[:, 0],
+                    positions[:, 0], page_indices)
+                out = paged_ops.paged_decode_attention(
+                    q[:, 0], k_pages.value, v_pages.value,
+                    lengths=positions[:, 0] + 1,
+                    page_indices=page_indices)
+                out = out[:, None].astype(cfg.dtype)
+            else:
+                cached_k = self.variable(
+                    'cache', 'cached_key', jnp.zeros,
+                    (batch, cfg.block_size, cfg.num_heads, cfg.head_dim),
+                    cfg.dtype)
+                cached_v = self.variable(
+                    'cache', 'cached_value', jnp.zeros,
+                    (batch, cfg.block_size, cfg.num_heads, cfg.head_dim),
+                    cfg.dtype)
+                out, cached_k.value, cached_v.value = \
+                    attention_ops.cached_decode_attention(
+                        q, k, v, cached_k.value, cached_v.value,
+                        positions[:, 0])
+                out = out.astype(cfg.dtype)
         else:
             q = nn.with_logical_constraint(q,
                                            ('batch', 'seq', 'heads', 'kv'))
@@ -137,7 +161,8 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array, deterministic: bool = True,
                  positions: Optional[jax.Array] = None,
-                 decode: bool = False) -> jax.Array:
+                 decode: bool = False,
+                 page_indices: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.config
         ln = lambda name: nn.LayerNorm(
             dtype=cfg.dtype, name=name,
@@ -147,7 +172,7 @@ class Block(nn.Module):
                 nn.initializers.zeros_init(), ('norm',)))
         x = x + CausalSelfAttention(cfg, name='attn')(
             ln('ln_1')(x), deterministic, positions=positions,
-            decode=decode)
+            decode=decode, page_indices=page_indices)
         x = x + MLP(cfg, name='mlp')(ln('ln_2')(x), deterministic)
         return nn.with_logical_constraint(x, ('batch', 'seq', 'act_embed'))
 
@@ -160,7 +185,8 @@ class GPT(nn.Module):
     def __call__(self, tokens: jax.Array,
                  deterministic: bool = True,
                  positions: Optional[jax.Array] = None,
-                 decode: bool = False) -> jax.Array:
+                 decode: bool = False,
+                 page_indices: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.config
         batch, seq = tokens.shape
         assert seq <= cfg.block_size, (seq, cfg.block_size)
@@ -196,7 +222,8 @@ class GPT(nn.Module):
             for i in range(cfg.num_layers):
                 x = Block(cfg, name=f'h_{i}')(x, deterministic,
                                               positions=positions,
-                                              decode=decode)
+                                              decode=decode,
+                                              page_indices=page_indices)
         x = nn.LayerNorm(
             dtype=cfg.dtype, name='ln_f',
             scale_init=nn.with_logical_partitioning(

@@ -39,6 +39,9 @@ class MixtralConfig:
     norm_eps: float = 1e-5
     dtype: Dtype = jnp.bfloat16
     remat: bool = False
+    # Paged KV cache for serving (see llama.LlamaConfig).
+    kv_page_size: int = 16
+    kv_total_pages: int = 128
 
     @classmethod
     def mixtral_8x7b(cls, **kw) -> 'MixtralConfig':
@@ -60,7 +63,9 @@ class MixtralConfig:
             num_layers=self.num_layers, num_heads=self.num_heads,
             num_kv_heads=self.num_kv_heads, embed_dim=self.embed_dim,
             mlp_dim=self.mlp_dim, rope_theta=self.rope_theta,
-            norm_eps=self.norm_eps, dtype=self.dtype, remat=self.remat)
+            norm_eps=self.norm_eps, dtype=self.dtype, remat=self.remat,
+            kv_page_size=self.kv_page_size,
+            kv_total_pages=self.kv_total_pages)
 
 
 class MoEFeedForward(nn.Module):
@@ -156,12 +161,14 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array, positions: jax.Array,
-                 decode: bool = False) -> Tuple[jax.Array, jax.Array]:
+                 decode: bool = False,
+                 page_indices: Optional[jax.Array] = None
+                 ) -> Tuple[jax.Array, jax.Array]:
         cfg = self.config
         lcfg = cfg.as_llama()
         x = x + llama_lib.Attention(lcfg, name='attn')(
             llama_lib.RMSNorm(cfg.norm_eps, cfg.dtype, name='attn_norm')(x),
-            positions, decode=decode)
+            positions, decode=decode, page_indices=page_indices)
         moe_out, aux = MoEFeedForward(cfg, name='moe')(
             llama_lib.RMSNorm(cfg.norm_eps, cfg.dtype, name='moe_norm')(x))
         x = x + moe_out
@@ -176,7 +183,8 @@ class Mixtral(nn.Module):
     @nn.compact
     def __call__(self, tokens: jax.Array,
                  positions: Optional[jax.Array] = None,
-                 decode: bool = False):
+                 decode: bool = False,
+                 page_indices: Optional[jax.Array] = None):
         """Training: (logits, aux_loss). decode=True (serving): logits
         only — the KV-cache path of the shared llama attention, so the
         generate/continuous-batching engines drive Mixtral unchanged."""
@@ -199,7 +207,8 @@ class Mixtral(nn.Module):
         total_aux = jnp.zeros((), jnp.float32)
         for i in range(cfg.num_layers):
             x, aux = block(cfg, name=f'layer_{i}')(x, positions,
-                                                   decode=decode)
+                                                   decode=decode,
+                                                   page_indices=page_indices)
             total_aux = total_aux + aux
         x = llama_lib.RMSNorm(cfg.norm_eps, cfg.dtype, name='final_norm')(x)
         head = self.param(

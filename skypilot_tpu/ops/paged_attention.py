@@ -64,7 +64,12 @@ def paged_decode_attention(q: jax.Array, k_pages: jax.Array,
         block = min(8, pages_per_seq)
         while pages_per_seq % block != 0:
             block -= 1
-        return paged_attention(q, k_pages, v_pages, lengths, page_indices,
+        # The pallas kernel applies NO attention scaling internally
+        # (its qk is a raw einsum) — pre-scale q to match the
+        # reference semantics (MaxText does the same).
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+        return paged_attention(q * scale, k_pages, v_pages, lengths,
+                               page_indices,
                                pages_per_compute_block=block)
     return _reference_paged_attention(q, k_pages, v_pages, lengths,
                                       page_indices)

@@ -276,3 +276,76 @@ def test_load_credentials_file(monkeypatch, tmp_path):
                      'aws_secret_access_key = FS\n')
     monkeypatch.setattr(ec2_api, '_CREDENTIALS_PATH', str(creds))
     assert ec2_api.load_credentials() == ('FK', 'FS', None)
+
+
+def test_failover_engine_walks_aws_zones(fake_ec2, monkeypatch,
+                                         isolated_state):
+    """Capacity in one AZ -> next AZ; quota -> whole region blocked;
+    mirrors the GCP failover test with the AWS classifier."""
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.backends.tpu_backend import RetryingProvisioner
+
+    task = task_lib.Task(run='true')
+    # Pin the region: the walk orders regions alphabetically, so the
+    # zone-walk assertion needs a known starting point.
+    r = resources_lib.Resources(infra='aws/us-east-1',
+                                accelerators='A100:8').copy(
+        instance_type='p4d.24xlarge')
+    task.set_resources(r)
+
+    real_request = fake_ec2.request
+    failed_zones = []
+
+    def capacity_in_1a(region, action, params=None):
+        if action == 'RunInstances' and \
+                params.get('Placement.AvailabilityZone') == 'us-east-1a':
+            failed_zones.append('us-east-1a')
+            raise exceptions.ProvisionerError(
+                'EC2 RunInstances -> InsufficientInstanceCapacity',
+                category=exceptions.ProvisionerError.CAPACITY)
+        return real_request(region, action, params)
+
+    monkeypatch.setattr(ec2_api, '_request', capacity_in_1a)
+    # Skip the SSH/agent setup: only the provisioning walk is under
+    # test (instances reach 'running' via the fake's poll model).
+    prov = RetryingProvisioner()
+    record, resolved, region = prov.provision_with_retries(
+        task, r, 'awsf', 'awsf')
+    assert failed_zones == ['us-east-1a']
+    assert record.zone == 'us-east-1b'
+    assert resolved.zone == 'us-east-1b'
+    assert region.name == 'us-east-1'
+    assert len(prov.failover_history) == 1
+
+    # Quota error blocks the whole region: us-east-1b is never tried;
+    # with the region unpinned the walk moves on past every quota-
+    # blocked region (alphabetical order: ap-northeast-1 first).
+    fake_ec2.instances.clear()
+    r_any = resources_lib.Resources(infra='aws',
+                                    accelerators='A100:8').copy(
+        instance_type='p4d.24xlarge')
+    task.set_resources(r_any)
+    tried = []
+
+    def quota_in_east(region, action, params=None):
+        if action == 'RunInstances':
+            tried.append((region,
+                          params.get('Placement.AvailabilityZone')))
+            if region in ('ap-northeast-1', 'eu-west-1', 'us-east-1'):
+                raise exceptions.ProvisionerError(
+                    'EC2 RunInstances -> VcpuLimitExceeded',
+                    category=exceptions.ProvisionerError.QUOTA)
+        return real_request(region, action, params)
+
+    monkeypatch.setattr(ec2_api, '_request', quota_in_east)
+    prov = RetryingProvisioner()
+    record, resolved, region = prov.provision_with_retries(
+        task, r_any, 'awsq', 'awsq')
+    # One attempt per quota-blocked region (us-east-1b skipped), then
+    # success in us-west-2.
+    assert tried == [('ap-northeast-1', 'ap-northeast-1a'),
+                     ('eu-west-1', 'eu-west-1a'),
+                     ('us-east-1', 'us-east-1a'),
+                     ('us-west-2', 'us-west-2a')]
+    assert region.name == 'us-west-2'

@@ -203,10 +203,11 @@ def test_paged_engine_under_page_pressure():
 
 
 @pytest.mark.slow
-def test_paged_engine_rejects_oversized_prompt():
-    """A prompt that cannot ever fit the pool fails loudly instead of
-    spinning in the admission queue."""
-    import numpy as np
+def test_paged_pool_too_small():
+    """An explicit paged=True with a pool that cannot hold one
+    full-depth sequence fails FAST at construction; with paged=None
+    the engine silently falls back to dense (no servable-length
+    regression vs the dense path)."""
     from skypilot_tpu.models.batching import ContinuousBatchingEngine
     from skypilot_tpu.models.llama import Llama, LlamaConfig
 
@@ -215,13 +216,74 @@ def test_paged_engine_rejects_oversized_prompt():
     model = Llama(cfg)
     params = nn.meta.unbox(model.init(
         jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))['params'])
+    with pytest.raises(ValueError, match='kv_total_pages'):
+        ContinuousBatchingEngine(model, params, num_slots=2,
+                                 max_total_len=32, paged=True)
     engine = ContinuousBatchingEngine(model, params, num_slots=2,
-                                      max_total_len=32, temperature=0.0)
+                                      max_total_len=32)
     try:
-        prompt = list(np.random.RandomState(0).randint(
-            1, cfg.vocab_size, size=20))  # needs 3 pages; 2 usable
-        fut = engine.submit(prompt, max_new_tokens=4)
-        with pytest.raises(MemoryError):
-            fut.result(timeout=120)
+        assert not engine.paged  # auto-detect refuses the small pool
     finally:
         engine.stop()
+
+
+def _paged_vs_dense_decode(model_ctor, cfg, two_outputs=False):
+    """Teacher-force tokens through dense and paged decode paths with
+    identical params; logits must match."""
+    import numpy as np
+    model = model_ctor(cfg)
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(1, cfg.vocab_size, (2, 1)),
+        jnp.int32)
+    params = nn.meta.unbox(model.init(
+        jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))['params'])
+
+    def init_cache(**kw):
+        cache = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((2, 1), jnp.int32),
+                           positions=jnp.zeros((2, 1), jnp.int32),
+                           decode=True, **kw)['cache']
+        return jax.tree.map(jnp.zeros_like, nn.meta.unbox(cache))
+
+    pages_per_seq = -(-32 // cfg.kv_page_size)
+    page_indices = jnp.asarray(
+        [[1 + i for i in range(pages_per_seq)],
+         [1 + pages_per_seq + i for i in range(pages_per_seq)]],
+        jnp.int32)
+    dense_cache = init_cache()
+    paged_cache = init_cache(page_indices=page_indices)
+    rs = np.random.RandomState(1)
+    for t in range(10):
+        tok = jnp.asarray(rs.randint(1, cfg.vocab_size, (2, 1)),
+                          jnp.int32)
+        pos = jnp.full((2, 1), t, jnp.int32)
+        dense_out, mut_d = model.apply(
+            {'params': params, 'cache': dense_cache}, tok,
+            positions=pos, decode=True, mutable=['cache'])
+        paged_out, mut_p = model.apply(
+            {'params': params, 'cache': paged_cache}, tok,
+            positions=pos, decode=True, mutable=['cache'],
+            page_indices=page_indices)
+        dense_cache, paged_cache = mut_d['cache'], mut_p['cache']
+        if two_outputs:
+            dense_out, paged_out = dense_out[0], paged_out[0]
+        np.testing.assert_allclose(np.asarray(paged_out),
+                                   np.asarray(dense_out),
+                                   atol=2e-2, rtol=2e-2,
+                                   err_msg=f'step {t}')
+
+
+@pytest.mark.slow
+def test_gpt_paged_decode_matches_dense():
+    from skypilot_tpu.models.gpt import GPT, GPTConfig
+    _paged_vs_dense_decode(GPT, GPTConfig.tiny(kv_page_size=8,
+                                               kv_total_pages=16))
+
+
+@pytest.mark.slow
+def test_mixtral_paged_decode_matches_dense():
+    from skypilot_tpu.models.mixtral import Mixtral, MixtralConfig
+    _paged_vs_dense_decode(Mixtral,
+                           MixtralConfig.tiny(kv_page_size=8,
+                                              kv_total_pages=16),
+                           two_outputs=False)

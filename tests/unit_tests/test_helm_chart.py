@@ -87,17 +87,18 @@ def test_default_render():
     assert container['ports'][0]['containerPort'] == 46580
     env_names = [e['name'] for e in container['env']]
     assert 'SKYPILOT_API_TOKEN' not in env_names  # empty token -> off
-    # Baked-image default: no empty /app volume shadowing the code.
+    # Default workflow: operator-populated /app volume + PYTHONPATH
+    # (the default image carries no repo code).
     mounts = [m['name'] for m in container['volumeMounts']]
-    assert 'app' not in mounts
-    assert 'PYTHONPATH' not in env_names
+    assert 'app' in mounts
+    assert 'PYTHONPATH' in env_names
 
 
 def test_overridden_render():
     docs = _load_chart({'fuseProxy.enabled': True,
                         'apiServer.port': 50000,
                         'apiServer.authToken': 123456,
-                        'apiServer.codeVolume': True,
+                        'apiServer.codeVolume': False,
                         'namespace': 'custom-ns'})
     kinds = [d['kind'] for d in docs]
     assert 'DaemonSet' in kinds
@@ -109,11 +110,12 @@ def test_overridden_render():
     # Digits-only tokens must render as STRINGS (quoted interpolation)
     # or `kubectl apply` rejects the EnvVar.
     assert env['SKYPILOT_API_TOKEN'] == '123456'
-    assert env['PYTHONPATH'] == '/app'
-    assert 'app' in [m['name'] for m in container['volumeMounts']]
+    # Baked-image override: no empty /app mount shadowing the code.
+    assert 'PYTHONPATH' not in env
+    assert 'app' not in [m['name'] for m in container['volumeMounts']]
     volumes = [v['name']
                for v in deploy['spec']['template']['spec']['volumes']]
-    assert 'app' in volumes
+    assert 'app' not in volumes
     svc = next(d for d in docs if d['kind'] == 'Service')
     assert svc['spec']['ports'][0]['port'] == 50000
     ds = next(d for d in docs if d['kind'] == 'DaemonSet')
