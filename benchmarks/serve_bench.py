@@ -48,6 +48,8 @@ def main() -> None:
     parser.add_argument('--max-new-tokens', type=int, default=24)
     parser.add_argument('--num-slots', type=int, default=8)
     parser.add_argument('--ckpt-dir', default=None)
+    parser.add_argument('--cpu', action='store_true',
+                        help='pin the server to the CPU backend')
     args = parser.parse_args()
 
     port = _free_port()
@@ -59,6 +61,8 @@ def main() -> None:
                 str(args.num_slots)]
     if args.ckpt_dir:
         cmd += ['--ckpt-dir', args.ckpt_dir]
+    if args.cpu:
+        cmd += ['--cpu']
     env = dict(os.environ)
     env['PYTHONPATH'] = f"{REPO}:{env.get('PYTHONPATH', '')}"
     server = subprocess.Popen(cmd, env=env, stdout=subprocess.DEVNULL,

@@ -53,7 +53,8 @@ class ContinuousBatchingEngine:
         # in a shared physical page pool sized for the AGGREGATE live
         # tokens instead of num_slots * max_total_len, with host-side
         # incremental page allocation. Auto-on for models that declare
-        # kv_page_size/kv_total_pages (llama).
+        # kv_page_size/kv_total_pages (llama/gpt/mixtral) when the
+        # pool can hold a full-depth sequence.
         cfg_page = getattr(model.config, 'kv_page_size', 0)
         cfg_pool = getattr(model.config, 'kv_total_pages', 0)
         pool_ok = (cfg_page > 0 and cfg_pool > 0 and
@@ -166,39 +167,40 @@ class ContinuousBatchingEngine:
     def _prefill_fn(self, bucket_len: int):
         """fn(params, cache, slot, prompt[P], plen) -> (cache, next_tok).
 
-        Dense: scans the (padded) prompt through the model on a
-        batch-1 slice of the slot's cache rows, then scatters the rows
-        back. Paged: the cache has no slot dimension — the scan runs
-        on the full (donated) pool and writes only the slot's own
-        pages via its page-table row; the padded tail writes land in
-        the trash page. Either way other slots are untouched, so
-        prefill interleaves with the shared decode loop.
+        CHUNKED prefill: ONE forward pass over the padded prompt
+        that also writes every position's K/V (the model's
+        decode-with-seq>1 mode) — not a per-token scan. Dense: runs on
+        a batch-1 slice of the slot's cache rows, then scatters the
+        rows back. Paged: the cache has no slot dimension — the pass
+        runs on the full (donated) pool and writes only the slot's own
+        pages via its page-table row; padded-tail writes land in
+        allocated-but-masked slots or the trash page. Either way other
+        slots are untouched, so prefill interleaves with the shared
+        decode loop.
         """
         if bucket_len in self._prefill_fns:
             return self._prefill_fns[bucket_len]
         model = self.model
+        positions = jnp.arange(bucket_len, dtype=jnp.int32)[None, :]
         if self.paged:
 
             @functools.partial(jax.jit, donate_argnums=(1,))
             def prefill_paged(params, cache, prompt, plen, page_row):
-
-                def step(cache, t):
-                    tok = jax.lax.dynamic_index_in_dim(
-                        prompt, jnp.minimum(t, plen - 1), keepdims=False)
-                    logits, mutated = model.apply(
-                        {'params': params, 'cache': cache},
-                        tok[None, None],
-                        positions=jnp.full((1, 1), t, jnp.int32),
-                        decode=True, mutable=['cache'],
-                        page_indices=page_row)
-                    return mutated['cache'], \
-                        logits[0, 0].astype(jnp.float32)
-
-                cache, all_logits = jax.lax.scan(
-                    step, cache, jnp.arange(bucket_len))
+                # CHUNKED prefill: the whole (padded) prompt in ONE
+                # forward pass; the model writes K/V for every
+                # position (write_kv_chunk). Junk past plen lands in
+                # allocated-but-masked slots or the trash page.
+                logits, mutated = model.apply(
+                    {'params': params, 'cache': cache},
+                    prompt[None, :], positions=positions,
+                    decode=True, mutable=['cache'],
+                    page_indices=page_row)
+                # The continuation samples from the LAST REAL prompt
+                # position, not the padded tail.
                 last = jax.lax.dynamic_index_in_dim(
-                    all_logits, plen - 1, axis=0, keepdims=False)
-                return cache, last
+                    logits[0].astype(jnp.float32), plen - 1, axis=0,
+                    keepdims=False)
+                return mutated['cache'], last
 
             self._prefill_fns[bucket_len] = prefill_paged
             return prefill_paged
@@ -210,29 +212,17 @@ class ContinuousBatchingEngine:
                 if c.ndim else c, cache)
             row = jax.tree.map(
                 lambda c: jnp.zeros_like(c) if c.ndim else c, row)
-
-            def step(row, t):
-                # Steps past the real prompt write junk K/V at
-                # positions >= plen; harmless — each later decode step
-                # overwrites its own position before the mask exposes
-                # it (mask is k_idx <= current pos).
-                tok = jax.lax.dynamic_index_in_dim(
-                    prompt, jnp.minimum(t, plen - 1), keepdims=False)
-                logits, mutated = model.apply(
-                    {'params': params, 'cache': row},
-                    tok[None, None], positions=jnp.full((1, 1), t,
-                                                        jnp.int32),
-                    decode=True, mutable=['cache'])
-                return mutated['cache'], logits[0, 0].astype(jnp.float32)
-
-            row, all_logits = jax.lax.scan(step, row,
-                                           jnp.arange(bucket_len))
-            # The continuation comes from the LAST REAL prompt position
-            # (plen-1), not the padded tail; the caller samples from
-            # these logits so temperature applies to the first
-            # generated token too.
-            last = jax.lax.dynamic_index_in_dim(all_logits, plen - 1,
-                                                axis=0, keepdims=False)
+            # CHUNKED prefill on the batch-1 row (junk K/V past plen is
+            # overwritten by later decode steps before the mask exposes
+            # it), then scatter the row back.
+            logits, mutated = model.apply(
+                {'params': params, 'cache': row},
+                prompt[None, :], positions=positions,
+                decode=True, mutable=['cache'])
+            row = mutated['cache']
+            last = jax.lax.dynamic_index_in_dim(
+                logits[0].astype(jnp.float32), plen - 1, axis=0,
+                keepdims=False)
             cache = jax.tree.map(
                 lambda big, small:
                 jax.lax.dynamic_update_slice_in_dim(big, small, slot,
@@ -332,13 +322,10 @@ class ContinuousBatchingEngine:
                 # for plen (+1 for the first generated token).
                 need = self.allocator.pages_needed(plen + 1,
                                                    self.page_size)
-                usable_tokens = (self.total_pages - 1) * self.page_size
-                if plen + 1 > usable_tokens:
-                    # Can never fit, even alone: fail loudly.
-                    fut.set_exception(MemoryError(
-                        f'prompt needs {need} KV pages but the '
-                        f'pool has {self.total_pages - 1} usable'))
-                    continue
+                # Construction guarantees the pool holds one
+                # full-depth sequence and submit() bounds plen below
+                # max_total_len, so a lone sequence always fits.
+                assert plen + 1 <= (self.total_pages - 1) * self.page_size
                 if not self.allocator.can_allocate(need):
                     # Pool exhausted: back to the HEAD and stop
                     # admitting until a sequence releases pages —

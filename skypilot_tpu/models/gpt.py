@@ -85,24 +85,50 @@ class CausalSelfAttention(nn.Module):
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shape = (batch, seq, cfg.num_heads, cfg.head_dim)
         q, k, v = (t.reshape(shape) for t in (q, k, v))
-        if decode:
+        def _page_vars():
+            shape = (cfg.num_heads, cfg.kv_total_pages,
+                     cfg.kv_page_size, cfg.head_dim)
+            return (self.variable('cache', 'k_pages', jnp.zeros, shape,
+                                  cfg.dtype),
+                    self.variable('cache', 'v_pages', jnp.zeros, shape,
+                                  cfg.dtype))
+
+        if decode and seq > 1:
+            # CHUNKED PREFILL (same contract as models/llama.py):
+            # empty sequence, positions = arange per row; causal
+            # attention over the chunk, K/V written for every position.
+            assert positions is not None
+            if page_indices is not None:
+                from skypilot_tpu.ops import paged_attention as paged_ops
+                k_pages, v_pages = _page_vars()
+                k_pages.value, v_pages.value = paged_ops.write_kv_chunk(
+                    k_pages.value, v_pages.value, k, v, positions,
+                    page_indices)
+            else:
+                cached_k = self.variable(
+                    'cache', 'cached_key', jnp.zeros,
+                    (batch, cfg.block_size, cfg.num_heads, cfg.head_dim),
+                    cfg.dtype)
+                cached_v = self.variable(
+                    'cache', 'cached_value', jnp.zeros,
+                    (batch, cfg.block_size, cfg.num_heads, cfg.head_dim),
+                    cfg.dtype)
+                cached_k.value = cached_k.value.at[:, :seq].set(
+                    k.astype(cfg.dtype))
+                cached_v.value = cached_v.value.at[:, :seq].set(
+                    v.astype(cfg.dtype))
+            out = attention_ops.dot_product_attention(q, k, v,
+                                                      causal=True)
+        elif decode:
             # One token in, KV cache with a PER-ROW write index
             # (positions[:, 0]) — the shared serving-cache contract
             # (ops.attention.cached_decode_attention), so the generate
             # and continuous-batching engines drive GPT unchanged.
-            assert seq == 1, f'decode mode feeds one token, got {seq}'
             assert positions is not None
             if page_indices is not None:
                 # Paged KV (same contract as models/llama.py).
                 from skypilot_tpu.ops import paged_attention as paged_ops
-                k_pages = self.variable(
-                    'cache', 'k_pages', jnp.zeros,
-                    (cfg.num_heads, cfg.kv_total_pages,
-                     cfg.kv_page_size, cfg.head_dim), cfg.dtype)
-                v_pages = self.variable(
-                    'cache', 'v_pages', jnp.zeros,
-                    (cfg.num_heads, cfg.kv_total_pages,
-                     cfg.kv_page_size, cfg.head_dim), cfg.dtype)
+                k_pages, v_pages = _page_vars()
                 k_pages.value, v_pages.value = paged_ops.write_kv(
                     k_pages.value, v_pages.value, k[:, 0], v[:, 0],
                     positions[:, 0], page_indices)

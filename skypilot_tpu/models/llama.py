@@ -113,26 +113,53 @@ class Attention(nn.Module):
         q = apply_rope(q, positions, cfg.rope_theta)
         k = apply_rope(k, positions, cfg.rope_theta)
 
-        if decode:
+        def _page_vars():
+            shape = (cfg.num_kv_heads, cfg.kv_total_pages,
+                     cfg.kv_page_size, hd)
+            return (self.variable('cache', 'k_pages', jnp.zeros, shape,
+                                  cfg.dtype),
+                    self.variable('cache', 'v_pages', jnp.zeros, shape,
+                                  cfg.dtype))
+
+        if decode and seq > 1:
+            # CHUNKED PREFILL: the whole prompt in one forward pass —
+            # causal attention over the chunk, K/V written for every
+            # position (vs one sequential model step per token).
+            # Contract: the sequence starts empty and positions are
+            # arange(seq) per row (engine admission guarantees both).
+            if page_indices is not None:
+                from skypilot_tpu.ops import paged_attention as paged_ops
+                k_pages, v_pages = _page_vars()
+                k_pages.value, v_pages.value = paged_ops.write_kv_chunk(
+                    k_pages.value, v_pages.value, k, v, positions,
+                    page_indices)
+            else:
+                cached_k = self.variable(
+                    'cache', 'cached_key', jnp.zeros,
+                    (batch, cfg.max_seq_len, cfg.num_kv_heads, hd),
+                    cfg.dtype)
+                cached_v = self.variable(
+                    'cache', 'cached_value', jnp.zeros,
+                    (batch, cfg.max_seq_len, cfg.num_kv_heads, hd),
+                    cfg.dtype)
+                cached_k.value = cached_k.value.at[:, :seq].set(
+                    k.astype(cfg.dtype))
+                cached_v.value = cached_v.value.at[:, :seq].set(
+                    v.astype(cfg.dtype))
+            out = attention_ops.dot_product_attention(q, k, v,
+                                                      causal=True)
+        elif decode:
             # Incremental decoding: one token in, KV cache with PER-ROW
             # write positions — the shared serving-cache contract
             # (ops.attention.cached_decode_attention), which is what
             # lets continuous batching decode slots at different depths
             # in one step (models/batching.py).
-            assert seq == 1, f'decode mode feeds one token, got {seq}'
             if page_indices is not None:
                 # Paged KV (vLLM-style): K/V live in a shared physical
                 # page pool; this sequence's pages come from the
                 # engine-provided table (ops/paged_attention.py).
                 from skypilot_tpu.ops import paged_attention as paged_ops
-                k_pages = self.variable(
-                    'cache', 'k_pages', jnp.zeros,
-                    (cfg.num_kv_heads, cfg.kv_total_pages,
-                     cfg.kv_page_size, hd), cfg.dtype)
-                v_pages = self.variable(
-                    'cache', 'v_pages', jnp.zeros,
-                    (cfg.num_kv_heads, cfg.kv_total_pages,
-                     cfg.kv_page_size, hd), cfg.dtype)
+                k_pages, v_pages = _page_vars()
                 k_pages.value, v_pages.value = paged_ops.write_kv(
                     k_pages.value, v_pages.value, k[:, 0], v[:, 0],
                     positions[:, 0], page_indices)

@@ -136,6 +136,32 @@ def write_kv(k_pages: jax.Array, v_pages: jax.Array, k_new: jax.Array,
     return write_one(k_pages, k_new), write_one(v_pages, v_new)
 
 
+def write_kv_chunk(k_pages: jax.Array, v_pages: jax.Array,
+                   k_new: jax.Array, v_new: jax.Array,
+                   positions: jax.Array, page_indices: jax.Array
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """Chunked-prefill write: S tokens per row in one scatter.
+
+    k_new/v_new: [B, S, num_kv_heads, head_dim]; positions: i32[B, S].
+    Within a row positions are distinct; padded-tail positions map to
+    unallocated table entries, i.e. the trash page (duplicate writes
+    there are benign).
+    """
+    batch, chunk = positions.shape
+    page_size = k_pages.shape[2]
+    logical = positions // page_size                       # [B, S]
+    slot = (positions % page_size).reshape(-1)             # [B*S]
+    physical = jnp.take_along_axis(page_indices, logical,
+                                   axis=1).reshape(-1)     # [B*S]
+
+    def write_one(pages, new):
+        flat = new.reshape(batch * chunk, *new.shape[2:])  # [BS, Hkv, D]
+        return pages.at[:, physical, slot, :].set(
+            jnp.swapaxes(flat, 0, 1))
+
+    return write_one(k_pages, k_new), write_one(v_pages, v_new)
+
+
 class PageAllocator:
     """Host-side free-list over the fixed physical page pool.
 

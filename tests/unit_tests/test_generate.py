@@ -227,14 +227,11 @@ def test_paged_pool_too_small():
         engine.stop()
 
 
-def _paged_vs_dense_decode(model_ctor, cfg, two_outputs=False):
+def _paged_vs_dense_decode(model_ctor, cfg):
     """Teacher-force tokens through dense and paged decode paths with
     identical params; logits must match."""
     import numpy as np
     model = model_ctor(cfg)
-    tokens = jnp.asarray(
-        np.random.RandomState(0).randint(1, cfg.vocab_size, (2, 1)),
-        jnp.int32)
     params = nn.meta.unbox(model.init(
         jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))['params'])
 
@@ -265,8 +262,6 @@ def _paged_vs_dense_decode(model_ctor, cfg, two_outputs=False):
             positions=pos, decode=True, mutable=['cache'],
             page_indices=page_indices)
         dense_cache, paged_cache = mut_d['cache'], mut_p['cache']
-        if two_outputs:
-            dense_out, paged_out = dense_out[0], paged_out[0]
         np.testing.assert_allclose(np.asarray(paged_out),
                                    np.asarray(dense_out),
                                    atol=2e-2, rtol=2e-2,
@@ -285,5 +280,4 @@ def test_mixtral_paged_decode_matches_dense():
     from skypilot_tpu.models.mixtral import Mixtral, MixtralConfig
     _paged_vs_dense_decode(Mixtral,
                            MixtralConfig.tiny(kv_page_size=8,
-                                              kv_total_pages=16),
-                           two_outputs=False)
+                                              kv_total_pages=16))
