@@ -29,6 +29,12 @@ class GPTConfig:
     embed_dim: int = 768
     dropout_rate: float = 0.0
     dtype: Dtype = jnp.bfloat16
+    # Logits match the compute dtype unless overridden. bf16 logits
+    # halve the LM head's HBM traffic — at GPT-2 scale the [B,S,50k]
+    # logits are the largest array in the step. The loss upcasts to f32
+    # inside its logsumexp fusion (parallel/train.py), so softmax
+    # numerics stay f32 without an f32 array in HBM.
+    logits_dtype: Optional[Dtype] = None
     remat: bool = False
     # Paged KV cache for serving (see llama.LlamaConfig).
     kv_page_size: int = 16
@@ -257,10 +263,11 @@ class GPT(nn.Module):
             bias_init=nn.with_logical_partitioning(
                 nn.initializers.zeros_init(), ('norm',)))(x)
         # Tied output head (nanoGPT style): logits = x @ wte^T. bf16
-        # operands with f32 accumulation keep the matmul on the MXU's
-        # native bf16 path (~4-8x the f32 rate) without giving up f32
-        # softmax numerics downstream.
+        # operands keep the matmul on the MXU's native bf16 path
+        # (~4-8x the f32 rate); cfg.logits_dtype picks the output
+        # precision (bf16 default — see GPTConfig).
         logits = jnp.einsum('bse,ve->bsv', x.astype(cfg.dtype),
                             wte.astype(cfg.dtype),
-                            preferred_element_type=jnp.float32)
+                            preferred_element_type=(cfg.logits_dtype or
+                                                    cfg.dtype))
         return nn.with_logical_constraint(logits, ('batch', 'seq', 'vocab'))

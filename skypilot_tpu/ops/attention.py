@@ -21,7 +21,11 @@ import jax.numpy as jnp
 # 103 ms — 24% vs 35% MFU); its O(S) memory only pays off once the S×S
 # scores stop fitting in VMEM-friendly fusions. Dispatch to pallas only
 # from 2k context up; override via SKYPILOT_TPU_FLASH_MIN_SEQ.
-_FLASH_MIN_SEQ = int(os.environ.get('SKYPILOT_TPU_FLASH_MIN_SEQ', 2048))
+try:
+    _FLASH_MIN_SEQ = int(
+        os.environ.get('SKYPILOT_TPU_FLASH_MIN_SEQ') or 2048)
+except ValueError:
+    _FLASH_MIN_SEQ = 2048
 
 
 @functools.lru_cache(maxsize=1)

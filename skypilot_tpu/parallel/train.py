@@ -39,7 +39,10 @@ def next_token_loss(logits: jax.Array, tokens: jax.Array) -> jax.Array:
     normalizer survive — no second [B,S,V] log-prob array in HBM
     (the [B,S,V] logits are already the memory high-water mark).
     """
-    logits = logits[:, :-1]
+    # Upcast once: bf16 logits (the memory-lean LM-head option) get an
+    # f32 logsumexp; XLA fuses the convert into the reduction, so no
+    # f32 [B,S,V] array ever lands in HBM.
+    logits = logits[:, :-1].astype(jnp.float32)
     targets = tokens[:, 1:]
     target_logit = jnp.take_along_axis(
         logits, targets[..., None], axis=-1)[..., 0]
@@ -112,30 +115,20 @@ class ShardedTrainer:
                 return jax.jit(_init, out_shardings=sharding)()
 
     # -- step ---------------------------------------------------------------
-    def make_train_step(self, example_tokens: jax.Array,
-                        donate: bool = True) -> Callable:
-        sharding = self.state_sharding(example_tokens)
+    def _step_body(self, state: TrainState, tokens: jax.Array
+                   ) -> Tuple[TrainState, jax.Array]:
+        def compute_loss(params):
+            logits = self.model.apply({'params': params}, tokens)
+            return self.loss_fn(logits, tokens)
 
-        def _step(state: TrainState, tokens: jax.Array
-                  ) -> Tuple[TrainState, jax.Array]:
+        loss, grads = jax.value_and_grad(compute_loss)(state.params)
+        updates, opt_state = self.tx.update(grads, state.opt_state,
+                                            state.params)
+        params = optax.apply_updates(state.params, updates)
+        return state.replace(step=state.step + 1, params=params,
+                             opt_state=opt_state), loss
 
-            def compute_loss(params):
-                logits = self.model.apply({'params': params}, tokens)
-                return self.loss_fn(logits, tokens)
-
-            loss, grads = jax.value_and_grad(compute_loss)(state.params)
-            updates, opt_state = self.tx.update(grads, state.opt_state,
-                                                state.params)
-            params = optax.apply_updates(state.params, updates)
-            return state.replace(step=state.step + 1, params=params,
-                                 opt_state=opt_state), loss
-
-        step = jax.jit(
-            _step,
-            in_shardings=(sharding, self.batch_sharding),
-            out_shardings=(sharding, NamedSharding(self.mesh, P())),
-            donate_argnums=(0,) if donate else ())
-
+    def _wrap(self, step: Callable) -> Callable:
         def wrapped(state, tokens):
             from skypilot_tpu.parallel import context as cp_context
             with self.mesh, cp_context.context_parallel(self.mesh):
@@ -144,6 +137,45 @@ class ShardedTrainer:
 
         wrapped.lower = lambda s, t: step.lower(s, t)  # type: ignore
         return wrapped
+
+    def make_train_step(self, example_tokens: jax.Array,
+                        donate: bool = True) -> Callable:
+        sharding = self.state_sharding(example_tokens)
+        step = jax.jit(
+            self._step_body,
+            in_shardings=(sharding, self.batch_sharding),
+            out_shardings=(sharding, NamedSharding(self.mesh, P())),
+            donate_argnums=(0,) if donate else ())
+        return self._wrap(step)
+
+    def make_multi_step(self, example_tokens: jax.Array,
+                        inner_steps: int,
+                        donate: bool = True) -> Callable:
+        """`inner_steps` optimizer steps inside ONE jitted call.
+
+        `lax.scan` keeps the whole inner loop on-device: one dispatch,
+        one executable, N steps — amortizing host->device dispatch
+        latency (dominant under remote-relay/RPC device access, and a
+        free win on directly-attached chips too). Takes tokens stacked
+        [inner_steps, B, S]; returns (state, losses[inner_steps]).
+        """
+        sharding = self.state_sharding(example_tokens)
+        stacked = NamedSharding(
+            self.mesh, P(None, *self.batch_sharding.spec))
+
+        def _multi(state: TrainState, tokens_stack: jax.Array
+                   ) -> Tuple[TrainState, jax.Array]:
+            assert tokens_stack.shape[0] == inner_steps, (
+                f'tokens stack has {tokens_stack.shape[0]} steps, '
+                f'trainer was built for {inner_steps}')
+            return jax.lax.scan(self._step_body, state, tokens_stack)
+
+        step = jax.jit(
+            _multi,
+            in_shardings=(sharding, stacked),
+            out_shardings=(sharding, NamedSharding(self.mesh, P())),
+            donate_argnums=(0,) if donate else ())
+        return self._wrap(step)
 
     def make_eval_step(self, example_tokens: jax.Array) -> Callable:
         sharding = self.state_sharding(example_tokens)
@@ -167,3 +199,11 @@ class ShardedTrainer:
 
 def shard_batch(tokens: jax.Array, mesh: Mesh) -> jax.Array:
     return jax.device_put(tokens, mesh_lib.batch_sharding(mesh))
+
+
+def shard_batch_stack(tokens_stack: jax.Array, mesh: Mesh) -> jax.Array:
+    """Places a [inner_steps, B, S] stack for `make_multi_step`: the
+    leading scan axis replicated, each [B, S] slice batch-sharded."""
+    spec = mesh_lib.batch_sharding(mesh).spec
+    return jax.device_put(tokens_stack,
+                          NamedSharding(mesh, P(None, *spec)))

@@ -114,3 +114,29 @@ def test_checkpoint_save_restore(cpu_mesh8, tmp_path):
     state2, loss = step(restored, batch)
     assert float(loss) > 0
     mgr.close()
+
+
+@pytest.mark.slow
+def test_multi_step_matches_sequential(cpu_mesh8):
+    """make_multi_step (lax.scan inner loop) == N make_train_step calls."""
+    from skypilot_tpu.parallel.train import shard_batch_stack
+    model = GPT(GPTConfig.tiny())
+    example = jnp.ones((8, 32), jnp.int32)
+    data = jax.random.randint(jax.random.PRNGKey(3), (3, 8, 32), 0, 512,
+                              jnp.int32)
+
+    trainer = ShardedTrainer(model, cpu_mesh8)
+    state = trainer.init(jax.random.PRNGKey(0), example)
+    step = trainer.make_train_step(example, donate=False)
+    seq_losses = []
+    for i in range(3):
+        state, loss = step(state, shard_batch(data[i], cpu_mesh8))
+        seq_losses.append(float(loss))
+
+    state2 = trainer.init(jax.random.PRNGKey(0), example)
+    mstep = trainer.make_multi_step(example, 3, donate=False)
+    state2, losses = mstep(state2, shard_batch_stack(data, cpu_mesh8))
+    assert int(state2.step) == 3
+    assert losses.shape == (3,)
+    for a, b in zip(seq_losses, losses):
+        assert a == pytest.approx(float(b), rel=1e-5)
