@@ -1,0 +1,129 @@
+"""Azure catalog: VM sizes, GPU accelerators, prices.
+
+Reference: sky/catalog/azure_catalog.py — pandas over the hosted CSV
+mirror. Same shape as `aws_catalog`; Azure availability zones are
+numeric ('1'/'2'/'3') per region and allocation is region-level here,
+so the snapshot carries no zone column.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import pandas as pd
+
+from skypilot_tpu.catalog import common
+
+
+def _vm_df() -> pd.DataFrame:
+    return common.read_catalog('azure_vms.csv')
+
+
+def list_accelerators(
+        name_filter: Optional[str] = None,
+        region_filter: Optional[str] = None,
+        case_sensitive: bool = False,
+) -> Dict[str, List[common.InstanceTypeInfo]]:
+    df = _vm_df()
+    acc_df = df[df['AcceleratorName'].notna()]
+    if name_filter is not None:
+        acc_df = acc_df[acc_df['AcceleratorName'].str.contains(
+            name_filter, case=case_sensitive, regex=True)]
+    if region_filter is not None:
+        acc_df = acc_df[acc_df['Region'] == region_filter]
+    result: Dict[str, List[common.InstanceTypeInfo]] = {}
+    for _, row in acc_df.iterrows():
+        info = common.InstanceTypeInfo(
+            cloud='Azure',
+            instance_type=row['InstanceType'],
+            accelerator_name=row['AcceleratorName'],
+            accelerator_count=float(row['AcceleratorCount']),
+            cpu_count=row['vCPUs'],
+            memory=row['MemoryGiB'],
+            price=float(row['Price']),
+            spot_price=float(row['SpotPrice']),
+            region=row['Region'],
+        )
+        result.setdefault(row['AcceleratorName'], []).append(info)
+    return result
+
+
+def get_hourly_cost(instance_type: str, use_spot: bool,
+                    region: Optional[str] = None,
+                    zone: Optional[str] = None) -> float:
+    del zone  # allocation is region-level on Azure
+    df = _vm_df()
+    df = df[df['InstanceType'] == instance_type]
+    if region is not None:
+        df = df[df['Region'] == region]
+    if df.empty:
+        raise ValueError(f'Unknown Azure instance type {instance_type!r} '
+                         f'in region={region}.')
+    col = 'SpotPrice' if use_spot else 'Price'
+    return float(df[col].dropna().min())
+
+
+def get_vcpus_mem_from_instance_type(
+        instance_type: str) -> Tuple[Optional[float], Optional[float]]:
+    df = _vm_df()
+    df = df[df['InstanceType'] == instance_type]
+    if df.empty:
+        return None, None
+    return float(df['vCPUs'].iloc[0]), float(df['MemoryGiB'].iloc[0])
+
+
+def get_instance_type_for_cpus_mem(
+        cpus: Optional[str], memory: Optional[str]) -> Optional[str]:
+    df = _vm_df()
+    df = df[df['AcceleratorName'].isna()]
+    return common.get_instance_type_for_cpus_mem_impl(df, cpus, memory)
+
+
+def get_default_instance_type(cpus: Optional[str] = None,
+                              memory: Optional[str] = None) -> Optional[str]:
+    if cpus is None and memory is None:
+        cpus = '8+'
+        memory = 'x4'
+    return get_instance_type_for_cpus_mem(cpus, memory)
+
+
+def get_accelerators_from_instance_type(
+        instance_type: str) -> Optional[Dict[str, int]]:
+    df = _vm_df()
+    df = df[(df['InstanceType'] == instance_type)
+            & df['AcceleratorName'].notna()]
+    if df.empty:
+        return None
+    row = df.iloc[0]
+    return {row['AcceleratorName']: int(row['AcceleratorCount'])}
+
+
+def get_instance_type_for_accelerator(
+        acc_name: str, acc_count: int) -> Optional[List[str]]:
+    df = _vm_df()
+    df = df[(df['AcceleratorName'] == acc_name)
+            & (df['AcceleratorCount'] == acc_count)
+            & df['InstanceType'].notna()]
+    if df.empty:
+        return None
+    return sorted(df['InstanceType'].unique())
+
+
+def regions_for_instance_type(instance_type: str) -> List[str]:
+    df = _vm_df()
+    df = df[df['InstanceType'] == instance_type]
+    return sorted(df['Region'].unique())
+
+
+def validate_region_zone(region: Optional[str], zone: Optional[str]):
+    df = _vm_df()
+    if region is not None and region not in set(df['Region']):
+        raise ValueError(f'Invalid region {region!r} for Azure; valid: '
+                         f'{sorted(df["Region"].unique())}')
+    if zone is not None and str(zone) not in ('1', '2', '3'):
+        raise ValueError(
+            f'Invalid zone {zone!r} for Azure: zones are 1/2/3.')
+    return region, zone
+
+
+def regions() -> List[str]:
+    return sorted(_vm_df()['Region'].unique())

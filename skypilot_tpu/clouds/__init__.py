@@ -1,5 +1,6 @@
 """Cloud implementations. Importing this package registers all clouds."""
 from skypilot_tpu.clouds.aws import AWS
+from skypilot_tpu.clouds.azure import Azure
 from skypilot_tpu.clouds.cloud import (Cloud, CloudImplementationFeatures,
                                        Region, ResourcesFeasibility, Zone)
 from skypilot_tpu.clouds.gcp import GCP
@@ -8,6 +9,6 @@ from skypilot_tpu.clouds.local import Local
 from skypilot_tpu.clouds.ssh import SSH
 
 __all__ = [
-    'AWS', 'Cloud', 'CloudImplementationFeatures', 'Region',
+    'AWS', 'Azure', 'Cloud', 'CloudImplementationFeatures', 'Region',
     'ResourcesFeasibility', 'Zone', 'GCP', 'Kubernetes', 'Local', 'SSH',
 ]

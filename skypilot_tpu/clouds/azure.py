@@ -1,0 +1,180 @@
+"""Azure cloud: ARM VMs (GPU/CPU) as a third public cloud.
+
+Reference: sky/clouds/azure.py — the TPU-native build keeps GCP
+primary (TPU slices) and adds Azure alongside AWS for the multi-cloud
+optimizer story: V100/A100/H100 GPU families and the D/E/F CPU
+ladder, spot (Spot VMs with Delete eviction), cross-cloud egress.
+Provisioning goes through `provision/azure/` (ARM REST, no SDK).
+"""
+from __future__ import annotations
+
+import typing
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from skypilot_tpu.catalog import azure_catalog
+from skypilot_tpu.clouds import cloud
+from skypilot_tpu.utils.registry import CLOUD_REGISTRY
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+
+@CLOUD_REGISTRY.register()
+class Azure(cloud.Cloud):
+    _REPR = 'Azure'
+
+    @classmethod
+    def max_cluster_name_length(cls) -> Optional[int]:
+        # Resource-group names allow 90 chars but VM computerName is
+        # capped at 64; keep hostname-safe parity with AWS.
+        return 42
+
+    # ---- credentials ------------------------------------------------------
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.provision.azure import arm_api
+        if arm_api.load_credentials() is not None:
+            return True, None
+        return False, ('Azure credentials not found. Set '
+                       'AZURE_SUBSCRIPTION_ID/AZURE_TENANT_ID/'
+                       'AZURE_CLIENT_ID/AZURE_CLIENT_SECRET or populate '
+                       '~/.azure/skypilot.json.')
+
+    @classmethod
+    def unsupported_features_for_resources(
+        cls, resources: 'resources_lib.Resources'
+    ) -> Dict[cloud.CloudImplementationFeatures, str]:
+        out = {}
+        if resources.is_tpu_slice:
+            out[cloud.CloudImplementationFeatures.MULTI_NODE] = (
+                'TPU slices are GCP-only; Azure offers GPU '
+                'instances instead.')
+        return out
+
+    # ---- catalog ----------------------------------------------------------
+    def validate_region_zone(self, region: Optional[str],
+                             zone: Optional[str]):
+        return azure_catalog.validate_region_zone(region, zone)
+
+    def get_hourly_cost(self, resources: 'resources_lib.Resources') -> float:
+        assert resources.instance_type is not None, resources
+        return azure_catalog.get_hourly_cost(
+            resources.instance_type, resources.use_spot, resources.region,
+            resources.zone)
+
+    def get_egress_cost(self, num_gigabytes: float) -> float:
+        # Tiered internet egress (reference: sky/clouds/azure.py).
+        if num_gigabytes <= 0:
+            return 0.0
+        if num_gigabytes <= 10240:
+            return 0.0875 * num_gigabytes
+        if num_gigabytes <= 51200:
+            return 0.0875 * 10240 + 0.083 * (num_gigabytes - 10240)
+        return (0.0875 * 10240 + 0.083 * 40960 +
+                0.07 * (num_gigabytes - 51200))
+
+    @classmethod
+    def get_default_instance_type(cls, cpus: Optional[str] = None,
+                                  memory: Optional[str] = None
+                                  ) -> Optional[str]:
+        return azure_catalog.get_default_instance_type(cpus, memory)
+
+    @classmethod
+    def get_vcpus_mem_from_instance_type(
+            cls, instance_type: str
+    ) -> Tuple[Optional[float], Optional[float]]:
+        return azure_catalog.get_vcpus_mem_from_instance_type(instance_type)
+
+    def instance_type_exists(self, instance_type: str) -> bool:
+        return azure_catalog.get_vcpus_mem_from_instance_type(
+            instance_type)[0] is not None
+
+    # ---- feasibility ------------------------------------------------------
+    def get_feasible_launchable_resources(
+            self, resources: 'resources_lib.Resources',
+            num_nodes: int = 1) -> cloud.ResourcesFeasibility:
+        del num_nodes
+        if resources.is_tpu_slice:
+            return cloud.ResourcesFeasibility([], [])
+        if resources.instance_type is not None:
+            if self.instance_type_exists(resources.instance_type):
+                return cloud.ResourcesFeasibility(
+                    [resources.copy(cloud=self)], [])
+            return cloud.ResourcesFeasibility([], [])
+        accs = resources.accelerators
+        if accs is None:
+            instance_type = azure_catalog.get_default_instance_type(
+                resources.cpus, resources.memory)
+            if instance_type is None:
+                return cloud.ResourcesFeasibility([], [])
+            return cloud.ResourcesFeasibility(
+                [resources.copy(cloud=self, instance_type=instance_type)],
+                [])
+        acc_name, acc_count = next(iter(accs.items()))
+        instance_types = azure_catalog.get_instance_type_for_accelerator(
+            acc_name, acc_count)
+        if not instance_types:
+            fuzzy_all = azure_catalog.list_accelerators(
+                name_filter=acc_name.split('-')[0], case_sensitive=False)
+            fuzzy = sorted(f'{name}:{int(i.accelerator_count)}'
+                           for name, infos in fuzzy_all.items()
+                           for i in infos[:1])
+            return cloud.ResourcesFeasibility([], fuzzy)
+        return cloud.ResourcesFeasibility(
+            [resources.copy(cloud=self, instance_type=it)
+             for it in instance_types], [])
+
+    # ---- failover iteration -----------------------------------------------
+    @classmethod
+    def regions_with_offering(cls, instance_type: Optional[str],
+                              accelerators: Optional[Dict[str, int]],
+                              use_spot: bool, region: Optional[str],
+                              zone: Optional[str]) -> List[cloud.Region]:
+        del use_spot
+        if instance_type is not None:
+            region_names = azure_catalog.regions_for_instance_type(
+                instance_type)
+        elif accelerators:
+            acc_name = next(iter(accelerators))
+            infos = azure_catalog.list_accelerators(
+                name_filter=f'^{acc_name}$').get(acc_name, [])
+            region_names = sorted({i.region for i in infos})
+        else:
+            region_names = azure_catalog.regions()
+        out = []
+        for r in region_names:
+            if region is not None and r != region:
+                continue
+            zones = [cloud.Zone(zone)] if zone is not None else None
+            out.append(cloud.Region(r).set_zones(zones))
+        return out
+
+    @classmethod
+    def zones_provision_loop(cls, *, region: str, num_nodes: int,
+                             instance_type: Optional[str],
+                             accelerators: Optional[Dict[str, int]],
+                             use_spot: bool
+                             ) -> Iterator[Optional[List[cloud.Zone]]]:
+        del region, num_nodes, instance_type, accelerators, use_spot
+        yield None  # region-level: ARM picks placement
+
+    # ---- deploy variables -------------------------------------------------
+    def make_deploy_resources_variables(
+            self, resources: 'resources_lib.Resources',
+            cluster_name_on_cloud: str, region: cloud.Region,
+            zones: Optional[List[cloud.Zone]],
+            num_nodes: int) -> Dict[str, Any]:
+        return {
+            'cluster_name_on_cloud': cluster_name_on_cloud,
+            'region': region.name,
+            'zone': zones[0].name if zones else None,
+            'num_nodes': num_nodes,
+            'use_spot': resources.use_spot,
+            'disk_size': resources.disk_size,
+            'ports': resources.ports,
+            'labels': resources.labels or {},
+            'image_id': resources.image_id,
+            'instance_type': resources.instance_type,
+            'accelerators': resources.accelerators or {},
+            'tpu_vm': False,
+        }

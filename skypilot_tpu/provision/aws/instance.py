@@ -183,14 +183,20 @@ def get_cluster_info(region: str, cluster_name_on_cloud: str,
     pc = provider_config or {}
     region = pc.get('region', region)
     by_name = _by_name(region, cluster_name_on_cloud)
-    live = {n: i for n, i in sorted(by_name.items())
+    live = {n: i for n, i in by_name.items()
             if ec2_api.instance_state(i) not in ('terminated',
                                                  'shutting-down')}
     if not live:
         raise exceptions.FetchClusterInfoError(
             exceptions.FetchClusterInfoError.Reason.HEAD)
+    def _rank_key(name):
+        # Numeric-aware: 'c-2' before 'c-10' for stable node ranks.
+        base, _, idx = name.rpartition('-')
+        return (base, int(idx)) if idx.isdigit() else (name, -1)
+
     instances = []
-    for rank, (name, inst) in enumerate(live.items()):
+    for rank, (name, inst) in enumerate(
+            sorted(live.items(), key=lambda kv: _rank_key(kv[0]))):
         instances.append(common.InstanceInfo(
             instance_id=name,
             internal_ip=str(inst.get('privateIpAddress', '')),

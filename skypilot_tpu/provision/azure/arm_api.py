@@ -1,0 +1,444 @@
+"""Thin Azure Resource Manager REST client (stdlib OAuth2 + JSON).
+
+Third public cloud next to GCP and AWS. Where the reference wraps the
+azure SDK (sky/adaptors/azure.py, sky/provision/azure/instance.py),
+this build calls ARM REST directly: a client-credentials token from
+login.microsoftonline.com, then JSON PUT/GET/POST/DELETE under
+management.azure.com — the same zero-dependency stance and the same
+`_request()` seam as `aws/ec2_api.py` / `gcp/tpu_api.py`, so fake-API
+tests drive the whole provisioner without the network.
+
+Credentials: AZURE_SUBSCRIPTION_ID + AZURE_TENANT_ID + AZURE_CLIENT_ID
++ AZURE_CLIENT_SECRET from env (the standard service-principal
+contract), else the same four keys in ~/.azure/skypilot.json.
+
+Resource model: one resource group per cluster+region
+(`sky-<cluster>-<region>`)
+holding vnet/subnet/NSG/NICs/IPs/VMs — teardown is a single
+resource-group DELETE, the canonical Azure cleanup (nothing to leak).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+
+_MGMT = 'https://management.azure.com'
+_LOGIN = 'https://login.microsoftonline.com'
+_COMPUTE_API = '2024-03-01'
+_NETWORK_API = '2023-09-01'
+_RG_API = '2022-09-01'
+_CREDENTIALS_PATH = '~/.azure/skypilot.json'
+
+_token_cache: Dict[str, Any] = {}
+
+
+def load_credentials() -> Optional[Dict[str, str]]:
+    """{subscription_id, tenant_id, client_id, client_secret} or None."""
+    keys = ('subscription_id', 'tenant_id', 'client_id', 'client_secret')
+    env = {k: os.environ.get(f'AZURE_{k.upper()}') for k in keys}
+    if all(env.values()):
+        return env  # type: ignore
+    path = os.path.expanduser(_CREDENTIALS_PATH)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, 'r', encoding='utf-8') as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if all(data.get(k) for k in keys):
+        return {k: str(data[k]) for k in keys}
+    return None
+
+
+def _get_token(creds: Dict[str, str]) -> str:
+    """Client-credentials bearer token, cached until ~5 min pre-expiry."""
+    now = time.time()
+    cached = _token_cache.get(creds['client_id'])
+    if cached and cached['expires'] > now + 300:
+        return cached['token']
+    body = urllib.parse.urlencode({
+        'grant_type': 'client_credentials',
+        'client_id': creds['client_id'],
+        'client_secret': creds['client_secret'],
+        'scope': f'{_MGMT}/.default',
+    }).encode()
+    url = f'{_LOGIN}/{creds["tenant_id"]}/oauth2/v2.0/token'
+    req = urllib.request.Request(url, data=body, method='POST')
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            out = json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        detail = e.read().decode(errors='replace')[:300]
+        raise exceptions.ProvisionerError(
+            f'Azure token request failed: {detail}',
+            category=exceptions.ProvisionerError.PERMISSION) from e
+    except OSError as e:
+        raise exceptions.ProvisionerError(
+            f'Azure token request: network error {e}',
+            category=exceptions.ProvisionerError.TRANSIENT) from e
+    _token_cache[creds['client_id']] = {
+        'token': out['access_token'],
+        'expires': now + float(out.get('expires_in', 3600)),
+    }
+    return out['access_token']
+
+
+def _classify_error(code: str, message: str) -> str:
+    """ARM error code → failover category (reference:
+    FailoverCloudErrorHandlerV2's _azure_handler mapping)."""
+    lower = code.lower()
+    if lower in ('skunotavailable', 'zonalallocationfailed',
+                 'allocationfailed', 'overconstrainedallocation',
+                 'overconstrainedzonalallocationrequest',
+                 'spotevictednotavailable'):
+        return exceptions.ProvisionerError.CAPACITY
+    if 'quota' in lower or lower == 'operationnotallowed' and \
+            'quota' in message.lower():
+        return exceptions.ProvisionerError.QUOTA
+    if lower in ('authorizationfailed', 'invalidauthenticationtoken',
+                 'expiredauthenticationtoken', 'authenticationfailed',
+                 'subscriptionnotfound', 'disallowedprovider'):
+        return exceptions.ProvisionerError.PERMISSION
+    if lower.startswith('invalid') or lower in ('badrequest',
+                                                'resourcenotfound',
+                                                'imagenotfound'):
+        return exceptions.ProvisionerError.CONFIG
+    if lower in ('toomanyrequests', 'internalservererror',
+                 'serviceunavailable', 'gatewaytimeout'):
+        return exceptions.ProvisionerError.TRANSIENT
+    return exceptions.ProvisionerError.TRANSIENT
+
+
+def _request(method: str, path: str, body: Optional[Dict[str, Any]] = None,
+             api_version: str = _COMPUTE_API) -> Dict[str, Any]:
+    """One authenticated ARM call; JSON in/out.
+
+    `path` is subscription-relative or absolute under management.azure.com
+    (leading '/subscriptions/...'). This is the fake-API test seam.
+    """
+    creds = load_credentials()
+    if creds is None:
+        raise exceptions.NoCloudAccessError(
+            'Azure credentials not found (AZURE_* env or '
+            '~/.azure/skypilot.json).')
+    token = _get_token(creds)
+    sep = '&' if '?' in path else '?'
+    url = f'{_MGMT}{path}{sep}api-version={api_version}'
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method, headers={
+        'Authorization': f'Bearer {token}',
+        'Content-Type': 'application/json',
+    })
+    try:
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            text = resp.read().decode()
+    except urllib.error.HTTPError as e:
+        text = e.read().decode(errors='replace')
+        code, message = str(e.code), text[:300]
+        try:
+            err = json.loads(text).get('error', {})
+            code = err.get('code', code)
+            message = err.get('message', message)
+        except ValueError:
+            pass
+        if e.code == 404 and method in ('GET', 'DELETE'):
+            # GET: caller treats {} as absent. DELETE: already gone is
+            # the idempotent success case (teardown retries, failover
+            # cleanup before the RG ever existed).
+            return {}
+        raise exceptions.ProvisionerError(
+            f'Azure {method} {path.rsplit("/", 1)[-1]} -> {code}: '
+            f'{message[:300]}',
+            category=_classify_error(code, message)) from e
+    except OSError as e:
+        raise exceptions.ProvisionerError(
+            f'Azure {method} {path}: network error {e}',
+            category=exceptions.ProvisionerError.TRANSIENT) from e
+    if not text:
+        return {}
+    return json.loads(text)
+
+
+def _subscription() -> str:
+    creds = load_credentials()
+    if creds is None:
+        raise exceptions.NoCloudAccessError('Azure credentials not found.')
+    return creds['subscription_id']
+
+
+def _rg_path(rg: str) -> str:
+    return f'/subscriptions/{_subscription()}/resourceGroups/{rg}'
+
+
+def resource_group_name(cluster_name: str, region: str) -> str:
+    # Region-qualified: resource-group deletion is async (202 + minutes
+    # of teardown), so a region-failover relaunch must land in a FRESH
+    # group — PUTting a name that is mid-deletion is rejected by ARM.
+    return f'sky-{cluster_name}-{region}'
+
+
+# ---------------------------------------------------------------------------
+# Resource group + network bootstrap
+# ---------------------------------------------------------------------------
+def ensure_resource_group(rg: str, region: str,
+                          cluster_name: str) -> None:
+    _request('PUT', _rg_path(rg),
+             {'location': region,
+              'tags': {'skypilot-cluster': cluster_name}},
+             api_version=_RG_API)
+
+
+def ensure_network(rg: str, region: str) -> str:
+    """VNet + subnet + SSH-open NSG; returns the subnet resource id.
+
+    Create-if-absent, never overwrite: an ARM PUT REPLACES the whole
+    resource, so re-PUTting the NSG on a relaunch would wipe any
+    port rules `open_ports` added since the first launch.
+    """
+    base = f'{_rg_path(rg)}/providers/Microsoft.Network'
+    nsg_id = f'{base}/networkSecurityGroups/sky-nsg'
+    if not _request('GET', nsg_id, api_version=_NETWORK_API):
+        _request('PUT', nsg_id, {
+            'location': region,
+            'properties': {'securityRules': [{
+                'name': 'ssh',
+                'properties': {
+                    'priority': 1000, 'direction': 'Inbound',
+                    'access': 'Allow', 'protocol': 'Tcp',
+                    'sourceAddressPrefix': '*', 'sourcePortRange': '*',
+                    'destinationAddressPrefix': '*',
+                    'destinationPortRange': '22',
+                },
+            }]},
+        }, api_version=_NETWORK_API)
+    vnet_id = f'{base}/virtualNetworks/sky-vnet'
+    subnet_id = f'{vnet_id}/subnets/default'
+    if not _request('GET', vnet_id, api_version=_NETWORK_API):
+        _request('PUT', vnet_id, {
+            'location': region,
+            'properties': {
+                'addressSpace': {'addressPrefixes': ['10.20.0.0/16']},
+                'subnets': [{'name': 'default', 'properties': {
+                    'addressPrefix': '10.20.0.0/20',
+                    'networkSecurityGroup': {'id': nsg_id},
+                }}],
+            },
+        }, api_version=_NETWORK_API)
+    return subnet_id
+
+
+# ---------------------------------------------------------------------------
+# VMs
+# ---------------------------------------------------------------------------
+def create_vm(rg: str, region: str, *, node_name: str, cluster_name: str,
+              instance_type: str, subnet_id: str,
+              ssh_pub_key: Optional[str], spot: bool = False,
+              disk_size_gb: int = 256, zone: Optional[str] = None,
+              image: Optional[Dict[str, str]] = None) -> None:
+    """Public IP + NIC + VM for one node. Every attached resource is
+    created with deleteOption=Delete so a VM (or resource-group)
+    delete leaves nothing behind."""
+    net = f'{_rg_path(rg)}/providers/Microsoft.Network'
+    pip_id = f'{net}/publicIPAddresses/{node_name}-ip'
+    _request('PUT', pip_id, {
+        'location': region,
+        'sku': {'name': 'Standard'},
+        'properties': {'publicIPAllocationMethod': 'Static'},
+    }, api_version=_NETWORK_API)
+    nic_id = f'{net}/networkInterfaces/{node_name}-nic'
+    _request('PUT', nic_id, {
+        'location': region,
+        'properties': {'ipConfigurations': [{
+            'name': 'ipconfig1',
+            'properties': {
+                'subnet': {'id': subnet_id},
+                'publicIPAddress': {
+                    'id': pip_id,
+                    'properties': {'deleteOption': 'Delete'},
+                },
+            },
+        }]},
+    }, api_version=_NETWORK_API)
+    if isinstance(image, str):
+        # Marketplace URN form: publisher:offer:sku:version.
+        parts = image.split(':')
+        if len(parts) != 4:
+            raise exceptions.ProvisionerError(
+                f'Azure image_id must be publisher:offer:sku:version, '
+                f'got {image!r}.',
+                category=exceptions.ProvisionerError.CONFIG)
+        image = dict(zip(('publisher', 'offer', 'sku', 'version'), parts))
+    image = image or {
+        'publisher': 'Canonical',
+        'offer': '0001-com-ubuntu-server-jammy',
+        'sku': '22_04-lts-gen2',
+        'version': 'latest',
+    }
+    vm_body: Dict[str, Any] = {
+        'location': region,
+        'tags': {'skypilot-cluster': cluster_name, 'Name': node_name},
+        'properties': {
+            'hardwareProfile': {'vmSize': instance_type},
+            'storageProfile': {
+                'imageReference': image,
+                'osDisk': {
+                    'createOption': 'FromImage',
+                    'deleteOption': 'Delete',
+                    'diskSizeGB': int(disk_size_gb),
+                    'managedDisk':
+                        {'storageAccountType': 'Premium_LRS'},
+                },
+            },
+            'osProfile': {
+                'computerName': node_name[:63],
+                'adminUsername': 'skypilot',
+                'linuxConfiguration': {
+                    'disablePasswordAuthentication': True,
+                    'ssh': {'publicKeys': [{
+                        'path':
+                            '/home/skypilot/.ssh/authorized_keys',
+                        'keyData': ssh_pub_key or '',
+                    }]},
+                },
+            },
+            'networkProfile': {'networkInterfaces': [{
+                'id': nic_id,
+                'properties': {'deleteOption': 'Delete'},
+            }]},
+        },
+    }
+    if spot:
+        vm_body['properties']['priority'] = 'Spot'
+        vm_body['properties']['evictionPolicy'] = 'Delete'
+        vm_body['properties']['billingProfile'] = {'maxPrice': -1}
+    if zone:
+        vm_body['zones'] = [str(zone)]
+    _request(
+        'PUT',
+        f'{_rg_path(rg)}/providers/Microsoft.Compute'
+        f'/virtualMachines/{node_name}', vm_body)
+
+
+def list_vms(rg: str) -> List[Dict[str, Any]]:
+    out = _request(
+        'GET',
+        f'{_rg_path(rg)}/providers/Microsoft.Compute'
+        f'/virtualMachines?$expand=instanceView')
+    return list(out.get('value', []))
+
+
+def vm_power_state(vm: Dict[str, Any]) -> str:
+    """'running' | 'pending' | 'stopping' | 'stopped' | 'unknown'."""
+    statuses = (vm.get('properties', {}).get('instanceView', {})
+                .get('statuses', []))
+    for s in statuses:
+        code = s.get('code', '')
+        if not code.startswith('PowerState/'):
+            continue
+        state = code.split('/', 1)[1]
+        return {
+            'running': 'running',
+            'starting': 'pending',
+            'creating': 'pending',
+            'deallocating': 'stopping',
+            'stopping': 'stopping',
+            'deallocated': 'stopped',
+            'stopped': 'stopped',
+        }.get(state, 'unknown')
+    return 'pending'  # instanceView not populated yet
+
+
+def vm_tags(vm: Dict[str, Any]) -> Dict[str, str]:
+    return dict(vm.get('tags', {}))
+
+
+def _vm_action(rg: str, vm_name: str, action: str) -> None:
+    _request(
+        'POST',
+        f'{_rg_path(rg)}/providers/Microsoft.Compute'
+        f'/virtualMachines/{vm_name}/{action}')
+
+
+def deallocate_vm(rg: str, vm_name: str) -> None:
+    _vm_action(rg, vm_name, 'deallocate')
+
+
+def start_vm(rg: str, vm_name: str) -> None:
+    _vm_action(rg, vm_name, 'start')
+
+
+def delete_resource_group(rg: str) -> None:
+    """Async 202: ARM tears down every resource in the group."""
+    _request('DELETE', f'{_rg_path(rg)}?forceDeletionTypes='
+                       'Microsoft.Compute%2FvirtualMachines',
+             api_version=_RG_API)
+
+
+# ---------------------------------------------------------------------------
+# Networking detail + ports
+# ---------------------------------------------------------------------------
+def list_nics(rg: str) -> List[Dict[str, Any]]:
+    out = _request(
+        'GET',
+        f'{_rg_path(rg)}/providers/Microsoft.Network/networkInterfaces',
+        api_version=_NETWORK_API)
+    return list(out.get('value', []))
+
+
+def list_public_ips(rg: str) -> Dict[str, str]:
+    """public-ip resource id -> address."""
+    out = _request(
+        'GET',
+        f'{_rg_path(rg)}/providers/Microsoft.Network/publicIPAddresses',
+        api_version=_NETWORK_API)
+    return {p.get('id', ''): p.get('properties', {}).get('ipAddress', '')
+            for p in out.get('value', [])}
+
+
+def node_addresses(rg: str) -> Dict[str, Dict[str, Optional[str]]]:
+    """node name ('<x>-nic' stripped) -> {internal_ip, external_ip}."""
+    pips = list_public_ips(rg)
+    out: Dict[str, Dict[str, Optional[str]]] = {}
+    for nic in list_nics(rg):
+        name = nic.get('name', '')
+        node = name[:-4] if name.endswith('-nic') else name
+        configs = nic.get('properties', {}).get('ipConfigurations', [])
+        internal, external = None, None
+        for c in configs:
+            p = c.get('properties', {})
+            internal = internal or p.get('privateIPAddress')
+            pip = p.get('publicIPAddress', {})
+            if pip.get('id') in pips:
+                external = pips[pip['id']] or None
+        out[node] = {'internal_ip': internal, 'external_ip': external}
+    return out
+
+
+def authorize_ingress(rg: str, ports: List[str]) -> None:
+    """One NSG rule per port range on the cluster's shared NSG."""
+    base = (f'{_rg_path(rg)}/providers/Microsoft.Network'
+            f'/networkSecurityGroups/sky-nsg')
+    for port in ports:
+        lo, _, hi = str(port).partition('-')
+        port_range = f'{lo}-{hi}' if hi else lo
+        # Priority derived from the port, not the call index: rules
+        # from separate open_ports calls must not collide (ARM rejects
+        # duplicate priorities within an NSG).
+        _request('PUT', f'{base}/securityRules/sky-port-{lo}', {
+            'properties': {
+                'priority': 1100 + int(lo) % 2900,
+                'direction': 'Inbound', 'access': 'Allow',
+                'protocol': 'Tcp',
+                'sourceAddressPrefix': '*', 'sourcePortRange': '*',
+                'destinationAddressPrefix': '*',
+                'destinationPortRange': port_range,
+            },
+        }, api_version=_NETWORK_API)

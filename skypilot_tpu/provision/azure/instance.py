@@ -1,0 +1,223 @@
+"""Azure provisioner: ARM VMs via the routed interface.
+
+Reference: sky/provision/azure/instance.py (azure SDK) — same contract
+(run/wait/stop/terminate/query/get_cluster_info/open_ports), driven
+here by the ARM REST client (`arm_api.py`). All of a cluster's
+resources live in one resource group (`sky-<cluster>-<region>`,
+region-qualified so failover relaunches never collide with an
+async-deleting group); nodes are
+named `<cluster>-<i>` and discovered by the `skypilot-cluster` tag.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.azure import arm_api
+
+
+def _node_names(cluster_name_on_cloud: str, count: int) -> List[str]:
+    if count == 1:
+        return [cluster_name_on_cloud]
+    return [f'{cluster_name_on_cloud}-{i}' for i in range(count)]
+
+
+def _ssh_pub_key() -> Optional[str]:
+    from skypilot_tpu import authentication
+    try:
+        _, pub = authentication.get_or_generate_keys()
+        return pub
+    except Exception:  # pylint: disable=broad-except
+        return None
+
+
+def _by_name(rg: str) -> Dict[str, Dict[str, Any]]:
+    return {vm.get('name', ''): vm for vm in arm_api.list_vms(rg)}
+
+
+def _rank_key(name: str):
+    """Numeric-aware sort: 'c-2' before 'c-10' (lexicographic order
+    would misassign node ranks on 10+-node clusters)."""
+    base, _, idx = name.rpartition('-')
+    if idx.isdigit():
+        return (base, int(idx))
+    return (name, -1)
+
+
+def run_instances(region: str, cluster_name_on_cloud: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    pc = config.provider_config
+    region = pc.get('region', region)
+    zone = pc.get('zone')
+    instance_type = pc.get('instance_type')
+    if not instance_type:
+        raise exceptions.ProvisionerError(
+            'Azure path needs an instance_type.',
+            category=exceptions.ProvisionerError.CONFIG)
+    rg = arm_api.resource_group_name(cluster_name_on_cloud, region)
+    arm_api.ensure_resource_group(rg, region, cluster_name_on_cloud)
+    subnet_id = arm_api.ensure_network(rg, region)
+    names = _node_names(cluster_name_on_cloud, config.count)
+    existing = _by_name(rg)
+    pub_key = _ssh_pub_key()
+    created, resumed = [], []
+    for name in names:
+        vm = existing.get(name)
+        if vm is not None:
+            if arm_api.vm_power_state(vm) == 'stopped':
+                arm_api.start_vm(rg, name)
+                resumed.append(name)
+            continue  # running/pending: reuse
+        arm_api.create_vm(
+            rg, region, node_name=name,
+            cluster_name=cluster_name_on_cloud,
+            instance_type=instance_type, subnet_id=subnet_id,
+            ssh_pub_key=pub_key, spot=bool(pc.get('use_spot')),
+            disk_size_gb=int(pc.get('disk_size') or 256), zone=zone,
+            image=pc.get('image_id'))
+        created.append(name)
+    return common.ProvisionRecord(
+        provider_name='azure',
+        cluster_name=cluster_name_on_cloud,
+        region=region,
+        zone=zone,
+        head_instance_id=names[0],
+        created_instance_ids=created,
+        resumed_instance_ids=resumed,
+        provider_config=dict(pc),
+    )
+
+
+def wait_instances(region: str, cluster_name_on_cloud: str,
+                   state: Optional[str] = None,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   timeout: float = 600, poll: float = 5) -> None:
+    del state
+    pc = provider_config or {}
+    region = pc.get('region', region)
+    count = int(pc.get('num_nodes', 1))
+    rg = arm_api.resource_group_name(cluster_name_on_cloud, region)
+    names = set(_node_names(cluster_name_on_cloud, count))
+    deadline = time.time() + timeout
+    while True:
+        running = set()
+        by_name = _by_name(rg)
+        # A node that vanishes mid-wait was evicted/deleted (spot VMs
+        # use evictionPolicy=Delete) — fail fast as CAPACITY so the
+        # failover engine moves on instead of burning the timeout.
+        missing = names - set(by_name)
+        if missing:
+            raise exceptions.ProvisionerError(
+                f'Azure VM(s) {sorted(missing)} disappeared while '
+                f'waiting (evicted or failed to allocate).',
+                category=exceptions.ProvisionerError.CAPACITY)
+        for name, vm in by_name.items():
+            if name in names and arm_api.vm_power_state(vm) == 'running':
+                running.add(name)
+        if running == names:
+            return
+        if time.time() > deadline:
+            raise exceptions.ProvisionerError(
+                f'Timed out waiting for {sorted(names - running)} '
+                f'in resource group {rg}.')
+        time.sleep(poll)
+
+
+def stop_instances(cluster_name_on_cloud: str,
+                   provider_config: Optional[Dict[str, Any]] = None,
+                   worker_only: bool = False) -> None:
+    del worker_only
+    pc = provider_config or {}
+    rg = arm_api.resource_group_name(cluster_name_on_cloud, pc['region'])
+    for name, vm in _by_name(rg).items():
+        if arm_api.vm_power_state(vm) in ('running', 'pending'):
+            arm_api.deallocate_vm(rg, name)
+
+
+def terminate_instances(cluster_name_on_cloud: str,
+                        provider_config: Optional[Dict[str, Any]] = None,
+                        worker_only: bool = False) -> None:
+    del worker_only
+    pc = provider_config or {}
+    region = pc.get('region')
+    if not region:
+        return
+    # One async DELETE tears down VMs/NICs/IPs/disks/vnet together
+    # (idempotent: a 404 on an already-gone group is success).
+    arm_api.delete_resource_group(
+        arm_api.resource_group_name(cluster_name_on_cloud, region))
+
+
+_STATE_MAP = {
+    'running': 'running',
+    'pending': 'pending',
+    'stopping': 'stopping',
+    'stopped': 'stopped',
+    'unknown': 'pending',
+}
+
+
+def query_instances(cluster_name_on_cloud: str,
+                    provider_config: Optional[Dict[str, Any]] = None,
+                    non_terminated_only: bool = True
+                    ) -> Dict[str, Optional[str]]:
+    del non_terminated_only
+    pc = provider_config or {}
+    rg = arm_api.resource_group_name(cluster_name_on_cloud, pc['region'])
+    out: Dict[str, Optional[str]] = {}
+    for name, vm in _by_name(rg).items():
+        if arm_api.vm_tags(vm).get('skypilot-cluster') != \
+                cluster_name_on_cloud:
+            continue
+        out[name] = _STATE_MAP.get(arm_api.vm_power_state(vm), 'pending')
+    return out
+
+
+def get_cluster_info(region: str, cluster_name_on_cloud: str,
+                     provider_config: Optional[Dict[str, Any]] = None
+                     ) -> common.ClusterInfo:
+    from skypilot_tpu import constants
+    pc = provider_config or {}
+    region = pc.get('region', region)
+    rg = arm_api.resource_group_name(cluster_name_on_cloud, region)
+    by_name = _by_name(rg)
+    if not by_name:
+        raise exceptions.FetchClusterInfoError(
+            exceptions.FetchClusterInfoError.Reason.HEAD)
+    addrs = arm_api.node_addresses(rg)
+    instances = []
+    for rank, (name, _vm) in enumerate(
+            sorted(by_name.items(), key=lambda kv: _rank_key(kv[0]))):
+        addr = addrs.get(name, {})
+        instances.append(common.InstanceInfo(
+            instance_id=name,
+            internal_ip=str(addr.get('internal_ip') or ''),
+            external_ip=addr.get('external_ip'),
+            ssh_port=22,
+            agent_port=constants.AGENT_PORT,
+            node_rank=rank,
+            host_rank=0,
+        ))
+    return common.ClusterInfo(
+        instances=instances,
+        head_instance_id=instances[0].instance_id,
+        provider_name='azure',
+        provider_config=dict(pc),
+        ssh_user='skypilot',
+        ssh_private_key='~/.ssh/sky-key',
+    )
+
+
+def open_ports(cluster_name_on_cloud: str, ports: List[str],
+               provider_config: Optional[Dict[str, Any]] = None) -> None:
+    pc = provider_config or {}
+    arm_api.authorize_ingress(
+        arm_api.resource_group_name(cluster_name_on_cloud, pc['region']),
+        ports)
+
+
+def cleanup_ports(cluster_name_on_cloud: str, ports: List[str],
+                  provider_config: Optional[Dict[str, Any]] = None) -> None:
+    del cluster_name_on_cloud, ports, provider_config

@@ -1,0 +1,91 @@
+"""Azure cloud class + catalog: feasibility, pricing, failover."""
+import pytest
+
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu.catalog import azure_catalog
+from skypilot_tpu.clouds import Azure
+
+
+@pytest.fixture()
+def azure():
+    return Azure()
+
+
+def test_accelerator_to_instance_type(azure):
+    r = resources_lib.Resources(accelerators='A100-80GB:4')
+    feas = azure.get_feasible_launchable_resources(r)
+    assert [x.instance_type for x in feas.resources_list] == \
+        ['Standard_NC96ads_A100_v4']
+
+
+def test_cpu_default_instance_type(azure):
+    r = resources_lib.Resources(cpus='8+')
+    feas = azure.get_feasible_launchable_resources(r)
+    assert len(feas.resources_list) == 1
+    it = feas.resources_list[0].instance_type
+    vcpus, _ = azure_catalog.get_vcpus_mem_from_instance_type(it)
+    assert vcpus >= 8
+
+
+def test_tpu_request_infeasible(azure):
+    r = resources_lib.Resources(accelerators='tpu-v5e-8')
+    feas = azure.get_feasible_launchable_resources(r)
+    assert feas.resources_list == []
+
+
+def test_unknown_gpu_gives_fuzzy_candidates(azure):
+    r = resources_lib.Resources(accelerators='A100-80GB:3')
+    feas = azure.get_feasible_launchable_resources(r)
+    assert feas.resources_list == []
+    assert any('A100' in c for c in feas.fuzzy_candidate_list)
+
+
+def test_hourly_cost_spot_cheaper(azure):
+    r = resources_lib.Resources(accelerators='H100:8').copy(
+        cloud=azure, instance_type='Standard_ND96isr_H100_v5')
+    on_demand = azure.get_hourly_cost(r)
+    spot = azure.get_hourly_cost(r.copy(use_spot=True))
+    assert 0 < spot < on_demand
+
+
+def test_regions_with_offering_gpu(azure):
+    regions = Azure.regions_with_offering(
+        'Standard_NC24ads_A100_v4', {'A100-80GB': 1}, False, None, None)
+    names = [r.name for r in regions]
+    assert 'eastus' in names and 'westus2' in names
+
+
+def test_zones_provision_loop_region_level(azure):
+    batches = list(Azure.zones_provision_loop(
+        region='eastus', num_nodes=1,
+        instance_type='Standard_NC24ads_A100_v4',
+        accelerators={'A100-80GB': 1}, use_spot=False))
+    assert batches == [None]  # ARM picks placement within the region
+
+
+def test_validate_region_zone():
+    azure_catalog.validate_region_zone('eastus', None)
+    azure_catalog.validate_region_zone('eastus', '2')
+    with pytest.raises(ValueError):
+        azure_catalog.validate_region_zone('mars-east', None)
+    with pytest.raises(ValueError):
+        azure_catalog.validate_region_zone('eastus', 'a')
+
+
+def test_deploy_variables(azure):
+    from skypilot_tpu.clouds import cloud as cloud_lib
+    r = resources_lib.Resources(accelerators='A100-80GB:1').copy(
+        cloud=azure, instance_type='Standard_NC24ads_A100_v4')
+    vars_ = azure.make_deploy_resources_variables(
+        r, 'c-on-cloud', cloud_lib.Region('eastus'), None, 2)
+    assert vars_['instance_type'] == 'Standard_NC24ads_A100_v4'
+    assert vars_['region'] == 'eastus'
+    assert vars_['zone'] is None
+    assert vars_['num_nodes'] == 2
+    assert vars_['tpu_vm'] is False
+
+
+def test_egress_cost_tiers(azure):
+    assert azure.get_egress_cost(0) == 0.0
+    assert azure.get_egress_cost(100) == pytest.approx(8.75)
+    assert azure.get_egress_cost(20000) > azure.get_egress_cost(10000)

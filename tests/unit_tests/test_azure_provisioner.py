@@ -1,0 +1,261 @@
+"""Azure ARM provisioner against a fake ARM REST API.
+
+Mirrors test_aws_provisioner.py: the fake patches the `_request` seam
+(JSON dict shapes), so run/wait/query/stop/terminate/get_cluster_info
+and the error classifier are exercised without the network.
+"""
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.azure import arm_api
+from skypilot_tpu.provision.azure import instance as az_instance
+
+
+class FakeArm:
+
+    def __init__(self):
+        self.resources = {}  # normalized path -> body
+        self.vm_state = {}   # vm name -> {'state', 'polls'}
+        self.fail_vm_with = None  # (Code, Message)
+        self.deleted_rgs = []
+        self.port_rules = []
+        self._n = 0
+
+    def request(self, method, path, body=None, api_version=None):
+        del api_version
+        path_only, _, _query = path.partition('?')
+        if method == 'PUT':
+            return self._put(path_only, body or {})
+        if method == 'GET':
+            return self._get(path_only)
+        if method == 'POST':
+            _, vm_name, action = path_only.rsplit('/', 2)
+            if action == 'deallocate':
+                self.vm_state[vm_name]['state'] = 'stopped'
+            elif action == 'start':
+                self.vm_state[vm_name].update(state='running', polls=9)
+            return {}
+        if method == 'DELETE':
+            rg = path_only.split('/resourceGroups/')[1].split('/')[0]
+            self.deleted_rgs.append(rg)
+            keep = {}
+            for p, b in self.resources.items():
+                if f'/resourceGroups/{rg}/' in p or \
+                        p.endswith(f'/resourceGroups/{rg}'):
+                    if '/virtualMachines/' in p:
+                        self.vm_state.pop(p.rsplit('/', 1)[-1], None)
+                    continue
+                keep[p] = b
+            self.resources = keep
+            return {}
+        raise AssertionError(f'unhandled {method} {path}')
+
+    def _put(self, path, body):
+        name = path.rsplit('/', 1)[-1]
+        body = dict(body)
+        body['id'] = path
+        body['name'] = name
+        if '/securityRules/' in path:
+            self.port_rules.append(body)
+        elif '/virtualMachines/' in path:
+            if self.fail_vm_with:
+                code, msg = self.fail_vm_with
+                raise exceptions.ProvisionerError(
+                    f'Azure PUT {name} -> {code}: {msg}',
+                    category=arm_api._classify_error(code, msg))
+            self.vm_state[name] = {'state': 'creating', 'polls': 0}
+        elif '/publicIPAddresses/' in path:
+            self._n += 1
+            body.setdefault('properties', {})['ipAddress'] = \
+                f'20.1.0.{self._n}'
+        elif '/networkInterfaces/' in path:
+            self._n += 1
+            cfg = body['properties']['ipConfigurations'][0]
+            cfg['properties']['privateIPAddress'] = f'10.20.0.{self._n}'
+        self.resources[path] = body
+        return body
+
+    def _in_rg(self, path, kind):
+        rg = path.split('/resourceGroups/')[1].split('/')[0]
+        return [b for p, b in sorted(self.resources.items())
+                if f'/resourceGroups/{rg}/' in p and f'/{kind}/' in p
+                and '/securityRules/' not in p and '/subnets/' not in p]
+
+    def _get(self, path):
+        if path.endswith('/virtualMachines'):
+            items = []
+            for b in self._in_rg(path, 'virtualMachines'):
+                st = self.vm_state[b['name']]
+                if st['state'] == 'creating':
+                    st['polls'] += 1
+                    if st['polls'] >= 2:
+                        st['state'] = 'running'
+                code = {'creating': 'PowerState/creating',
+                        'running': 'PowerState/running',
+                        'stopped': 'PowerState/deallocated'}[st['state']]
+                item = dict(b)
+                item['properties'] = dict(b.get('properties', {}))
+                item['properties']['instanceView'] = {
+                    'statuses': [{'code': code}]}
+                items.append(item)
+            return {'value': items}
+        if path.endswith('/networkInterfaces'):
+            return {'value': self._in_rg(path, 'networkInterfaces')}
+        if path.endswith('/publicIPAddresses'):
+            return {'value': self._in_rg(path, 'publicIPAddresses')}
+        return self.resources.get(path, {})
+
+
+@pytest.fixture()
+def fake_arm(monkeypatch):
+    fake = FakeArm()
+    monkeypatch.setattr(arm_api, '_request', fake.request)
+    monkeypatch.setattr(arm_api, '_subscription', lambda: 'sub-1')
+    monkeypatch.setattr(az_instance, '_ssh_pub_key',
+                        lambda: 'ssh-ed25519 AAAA test')
+    return fake
+
+
+def _config(count=1, **pc):
+    base = {'region': 'eastus', 'zone': None,
+            'instance_type': 'Standard_NC24ads_A100_v4',
+            'num_nodes': count, 'use_spot': False, 'disk_size': 100}
+    base.update(pc)
+    return common.ProvisionConfig(provider_config=base,
+                                  authentication_config={}, count=count,
+                                  tags={})
+
+
+def test_run_wait_query_lifecycle(fake_arm):
+    record = az_instance.run_instances('eastus', 'c1', _config(2))
+    assert record.provider_name == 'azure'
+    assert record.created_instance_ids == ['c1-0', 'c1-1']
+    az_instance.wait_instances('eastus', 'c1',
+                               provider_config=record.provider_config,
+                               poll=0)
+    status = az_instance.query_instances(
+        'c1', provider_config=record.provider_config)
+    assert status == {'c1-0': 'running', 'c1-1': 'running'}
+
+    info = az_instance.get_cluster_info(
+        'eastus', 'c1', provider_config=record.provider_config)
+    assert info.head_instance_id == 'c1-0'
+    assert len(info.instances) == 2
+    assert info.instances[0].internal_ip.startswith('10.20.')
+    assert info.instances[0].external_ip.startswith('20.')
+    assert info.ssh_user == 'skypilot'
+    # VM carries the ssh key and delete-with-VM resource options.
+    vm = fake_arm.resources[
+        '/subscriptions/sub-1/resourceGroups/sky-c1-eastus/providers'
+        '/Microsoft.Compute/virtualMachines/c1-0']
+    os_prof = vm['properties']['osProfile']
+    assert 'test' in \
+        os_prof['linuxConfiguration']['ssh']['publicKeys'][0]['keyData']
+    assert vm['properties']['storageProfile']['osDisk']['deleteOption'] \
+        == 'Delete'
+
+
+def test_stop_resume(fake_arm):
+    record = az_instance.run_instances('eastus', 'c2', _config(1))
+    az_instance.wait_instances('eastus', 'c2',
+                               provider_config=record.provider_config,
+                               poll=0)
+    az_instance.stop_instances('c2',
+                               provider_config=record.provider_config)
+    assert az_instance.query_instances(
+        'c2', provider_config=record.provider_config) == {'c2': 'stopped'}
+    record2 = az_instance.run_instances('eastus', 'c2', _config(1))
+    assert record2.resumed_instance_ids == ['c2']
+    assert record2.created_instance_ids == []
+
+
+def test_terminate_deletes_resource_group(fake_arm):
+    record = az_instance.run_instances('eastus', 'c3', _config(1))
+    az_instance.terminate_instances(
+        'c3', provider_config=record.provider_config)
+    assert fake_arm.deleted_rgs == ['sky-c3-eastus']
+    with pytest.raises(exceptions.FetchClusterInfoError):
+        az_instance.get_cluster_info(
+            'eastus', 'c3', provider_config=record.provider_config)
+
+
+def test_spot_priority_in_vm_body(fake_arm):
+    az_instance.run_instances('c4s', 'c4s', _config(1, use_spot=True))
+    vm = fake_arm.resources[
+        '/subscriptions/sub-1/resourceGroups/sky-c4s-eastus/providers'
+        '/Microsoft.Compute/virtualMachines/c4s']
+    assert vm['properties']['priority'] == 'Spot'
+    assert vm['properties']['evictionPolicy'] == 'Delete'
+
+
+def test_open_ports_adds_nsg_rules(fake_arm):
+    record = az_instance.run_instances('eastus', 'c5', _config(1))
+    az_instance.open_ports('c5', ['8080', '9000-9010'],
+                           provider_config=record.provider_config)
+    ranges = [r['properties']['destinationPortRange']
+              for r in fake_arm.port_rules]
+    assert ranges == ['8080', '9000-9010']
+
+
+def test_capacity_error_category(fake_arm):
+    fake_arm.fail_vm_with = ('SkuNotAvailable',
+                             'The requested size is not available')
+    with pytest.raises(exceptions.ProvisionerError) as e:
+        az_instance.run_instances('eastus', 'c6', _config(1))
+    assert e.value.category == exceptions.ProvisionerError.CAPACITY
+    assert not e.value.no_failover
+
+
+def test_quota_error_category(fake_arm):
+    fake_arm.fail_vm_with = ('QuotaExceeded', 'Family vCPU quota 0')
+    with pytest.raises(exceptions.ProvisionerError) as e:
+        az_instance.run_instances('eastus', 'c7', _config(1))
+    assert e.value.category == exceptions.ProvisionerError.QUOTA
+
+
+def test_auth_error_category():
+    assert arm_api._classify_error('AuthorizationFailed', 'no role') == \
+        exceptions.ProvisionerError.PERMISSION
+    assert arm_api._classify_error('InvalidParameter', 'bad') == \
+        exceptions.ProvisionerError.CONFIG
+    assert arm_api._classify_error('TooManyRequests', 'throttle') == \
+        exceptions.ProvisionerError.TRANSIENT
+
+
+def test_failover_engine_walks_azure_regions(fake_arm, monkeypatch,
+                                             isolated_state):
+    """Azure allocation is region-level (no zone walk): SkuNotAvailable
+    in the first region moves the walk to the next offering region."""
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.backends.tpu_backend import RetryingProvisioner
+
+    task = task_lib.Task(run='true')
+    r = resources_lib.Resources(infra='azure',
+                                accelerators='A100-80GB:1').copy(
+        instance_type='Standard_NC24ads_A100_v4')
+    task.set_resources(r)
+
+    real_request = fake_arm.request
+    failed_regions = []
+
+    def capacity_in_eastus(method, path, body=None, api_version=None):
+        if method == 'PUT' and '/virtualMachines/' in path and \
+                body and body.get('location') == 'eastus':
+            failed_regions.append('eastus')
+            raise exceptions.ProvisionerError(
+                'Azure PUT vm -> SkuNotAvailable: not available',
+                category=exceptions.ProvisionerError.CAPACITY)
+        return real_request(method, path, body, api_version)
+
+    monkeypatch.setattr(arm_api, '_request', capacity_in_eastus)
+    prov = RetryingProvisioner()
+    record, resolved, region = prov.provision_with_retries(
+        task, r, 'azf', 'azf')
+    assert failed_regions == ['eastus']
+    # Alphabetical offering walk: eastus -> westeurope.
+    assert region.name == 'westeurope'
+    assert record.region == 'westeurope'
+    assert resolved.region == 'westeurope'
+    assert len(prov.failover_history) == 1
