@@ -197,8 +197,26 @@ def exec_cmd(cluster, entrypoint, name, workdir, infra, gpus, cpus, memory,
 @click.option('--refresh', '-r', is_flag=True, default=False)
 @click.option('--endpoints', is_flag=True, default=False,
               help='Show head IP and opened-port URLs instead.')
-def status(clusters, refresh, endpoints) -> None:
-    """Show clusters."""
+@click.option('--kubernetes', '--k8s', 'kubernetes', is_flag=True,
+              default=False,
+              help='List ALL framework-managed pods in the current '
+                   'kube context instead of this server\'s clusters.')
+def status(clusters, refresh, endpoints, kubernetes) -> None:
+    """Show clusters (or, with --kubernetes, every managed pod)."""
+    if kubernetes:
+        from rich.console import Console
+        from rich.table import Table
+        from skypilot_tpu.provision.kubernetes import instance as k8s_inst
+        pods = k8s_inst.list_skypilot_pods()
+        table = Table(box=None)
+        for col in ('CLUSTER', 'POD', 'RANK', 'PHASE', 'NODE'):
+            table.add_column(col)
+        for pod in sorted(pods, key=lambda x: (x['cluster'],
+                                               int(x['node_rank']))):
+            table.add_row(pod['cluster'], pod['name'], pod['node_rank'],
+                          pod['phase'], pod['node'])
+        Console().print(table)
+        return
     request_id = sdk.status(list(clusters) or None, refresh=refresh)
     records = sdk.get(request_id)
     if not records:
@@ -1396,6 +1414,141 @@ def recipes_launch(name, cluster, env, yes) -> None:
     if result and result.get('job_id') is not None:
         cname = (result.get('handle') or {}).get('cluster_name') or cluster
         sdk.tail_logs(cname, result['job_id'])
+
+
+# ---------------------------------------------------------------------------
+# long-tail commands (reference: sky local up/down, sky ssh up/down,
+# shell completion install, jobs pool logs)
+# ---------------------------------------------------------------------------
+_LOCAL_DEV_CLUSTER = 'stpu-local'
+
+
+@cli.group()
+def local() -> None:
+    """Manage the local dev cluster (sandbox hosts, no cloud)."""
+
+
+@local.command(name='up')
+@click.option('--nodes', type=int, default=1,
+              help='Number of sandbox hosts.')
+def local_up(nodes) -> None:
+    """Provision the local dev cluster (`stpu-local`) for fast
+    iteration: later `stpu exec stpu-local ...` runs skip provisioning
+    (reference: `sky local up` kind cluster)."""
+    from skypilot_tpu import task as task_lib
+    task = task_lib.Task(run='true', num_nodes=nodes)
+    from skypilot_tpu import resources as resources_lib
+    task.set_resources(resources_lib.Resources(infra='local'))
+    request_id = sdk.launch(task, cluster_name=_LOCAL_DEV_CLUSTER,
+                            detach_run=True)
+    sdk.stream_and_get(request_id)
+    click.echo(f'Local dev cluster {_LOCAL_DEV_CLUSTER!r} is up '
+               f'({nodes} host(s)).')
+
+
+@local.command(name='down')
+def local_down() -> None:
+    """Tear down the local dev cluster."""
+    sdk.get(sdk.down(_LOCAL_DEV_CLUSTER))
+    click.echo(f'Local dev cluster {_LOCAL_DEV_CLUSTER!r} removed.')
+
+
+@ssh_node_pool.command(name='up')
+@click.argument('pool')
+def ssh_node_pool_up(pool) -> None:
+    """Pre-deploy the runtime to every pool host (warms launches:
+    the per-launch package rsync becomes a no-op delta)."""
+    from skypilot_tpu.clouds import ssh as ssh_cloud
+    from skypilot_tpu.provision import instance_setup
+    from skypilot_tpu.utils import command_runner
+    from skypilot_tpu.utils import subprocess_utils
+    pools = ssh_cloud.load_pools()
+    if pool not in pools:
+        _err(f'pool {pool!r} not declared; known: '
+             + ', '.join(sorted(pools)))
+    hosts = pools[pool]['hosts']
+
+    def deploy(host):
+        runner = command_runner.SSHCommandRunner(
+            (host['ip'], host['port']), host['user'],
+            host['identity_file'])
+        rc = runner.run('python3 --version', stream_logs=False)
+        if rc != 0:
+            return f'FAIL (no python3, rc={rc})'
+        instance_setup.deploy_package(runner)
+        return 'OK'
+
+    results = subprocess_utils.run_in_parallel(deploy, hosts)
+    for host, outcome in zip(hosts, results):
+        click.echo(f'{pool}\t{host["ip"]}\t{outcome}')
+
+
+@ssh_node_pool.command(name='down')
+@click.argument('pool')
+@click.option('--yes', '-y', is_flag=True, default=False)
+def ssh_node_pool_down(pool, yes) -> None:
+    """Stop agents and remove the deployed runtime from pool hosts."""
+    from skypilot_tpu.clouds import ssh as ssh_cloud
+    from skypilot_tpu.provision.ssh import instance as ssh_instance
+    from skypilot_tpu.utils import command_runner
+    from skypilot_tpu.utils import subprocess_utils
+    pools = ssh_cloud.load_pools()
+    if pool not in pools:
+        _err(f'pool {pool!r} not declared; known: '
+             + ', '.join(sorted(pools)))
+    busy = [cluster for cluster, entry in
+            ssh_instance._load_allocations().items()
+            if entry.get('pool') == pool]
+    if busy:
+        _err(f'pool {pool!r} still hosts cluster(s) {sorted(busy)}; '
+             'run `stpu down` on them first.')
+    if not yes:
+        click.confirm(f'Remove the runtime from all hosts of {pool!r}?',
+                      default=True, abort=True)
+    from skypilot_tpu.provision import instance_setup
+    pkg_dir = instance_setup._PKG_REMOTE_DIR
+
+    def teardown(host):
+        runner = command_runner.SSHCommandRunner(
+            (host['ip'], host['port']), host['user'],
+            host['identity_file'])
+        runner.run('pkill -f skypilot_tpu.agent.agent || true; '
+                   f'rm -rf {pkg_dir}', stream_logs=False)
+        return 'OK'
+
+    results = subprocess_utils.run_in_parallel(
+        teardown, pools[pool]['hosts'])
+    for host, outcome in zip(pools[pool]['hosts'], results):
+        click.echo(f'{pool}\t{host["ip"]}\t{outcome}')
+
+
+@jobs_pool.command(name='logs')
+@click.argument('pool_name')
+@click.option('--worker', '-w', type=int, default=0,
+              help='Worker index within the pool.')
+@click.option('--job-id', type=int, default=None,
+              help='Job id on that worker (default: latest).')
+def jobs_pool_logs_cmd(pool_name, worker, job_id) -> None:
+    """Tail a pool worker's job log (workers are ordinary clusters
+    named pool-<name>-w<i>)."""
+    from skypilot_tpu.jobs import pools as pools_lib
+    cluster = pools_lib.worker_cluster(pool_name, worker)
+    sdk.tail_logs(cluster, job_id)
+
+
+@cli.command()
+@click.argument('shell', type=click.Choice(['bash', 'zsh', 'fish']))
+def completion(shell) -> None:
+    """Print the shell-completion script (add to your rc file):
+
+    bash: eval "$(stpu completion bash)"
+    """
+    from click.shell_completion import get_completion_class
+    comp_cls = get_completion_class(shell)
+    if comp_cls is None:
+        _err(f'No completion support for {shell!r}.')
+    comp = comp_cls(cli, {}, 'stpu', '_STPU_COMPLETE')
+    click.echo(comp.source())
 
 
 def main() -> None:

@@ -37,14 +37,24 @@ _RG_API = '2022-09-01'
 _CREDENTIALS_PATH = '~/.azure/skypilot.json'
 
 _token_cache: Dict[str, Any] = {}
+_creds_cache: Optional[Dict[str, str]] = None
 
 
 def load_credentials() -> Optional[Dict[str, str]]:
-    """{subscription_id, tenant_id, client_id, client_secret} or None."""
+    """{subscription_id, tenant_id, client_id, client_secret} or None.
+
+    Cached after the first hit: every ARM call resolves credentials
+    (URL + auth), and polling loops would otherwise re-read the
+    credentials file several times per second.
+    """
+    global _creds_cache
+    if _creds_cache is not None:
+        return _creds_cache
     keys = ('subscription_id', 'tenant_id', 'client_id', 'client_secret')
     env = {k: os.environ.get(f'AZURE_{k.upper()}') for k in keys}
     if all(env.values()):
-        return env  # type: ignore
+        _creds_cache = env  # type: ignore
+        return _creds_cache
     path = os.path.expanduser(_CREDENTIALS_PATH)
     if not os.path.exists(path):
         return None
@@ -54,7 +64,8 @@ def load_credentials() -> Optional[Dict[str, str]]:
     except (OSError, ValueError):
         return None
     if all(data.get(k) for k in keys):
-        return {k: str(data[k]) for k in keys}
+        _creds_cache = {k: str(data[k]) for k in keys}
+        return _creds_cache
     return None
 
 
@@ -423,18 +434,29 @@ def node_addresses(rg: str) -> Dict[str, Dict[str, Optional[str]]]:
 
 
 def authorize_ingress(rg: str, ports: List[str]) -> None:
-    """One NSG rule per port range on the cluster's shared NSG."""
+    """One NSG rule per port range on the cluster's shared NSG.
+
+    Rule names encode the FULL range (so '100' never replaces
+    '100-200') and priorities are allocated from the live rule set
+    (ARM rejects duplicate priorities within an NSG).
+    """
     base = (f'{_rg_path(rg)}/providers/Microsoft.Network'
             f'/networkSecurityGroups/sky-nsg')
+    nsg = _request('GET', base, api_version=_NETWORK_API)
+    existing_rules = nsg.get('properties', {}).get('securityRules', [])
+    existing_names = {r.get('name') for r in existing_rules}
+    next_priority = 1 + max(
+        [1099] + [int(r.get('properties', {}).get('priority', 0))
+                  for r in existing_rules])
     for port in ports:
         lo, _, hi = str(port).partition('-')
         port_range = f'{lo}-{hi}' if hi else lo
-        # Priority derived from the port, not the call index: rules
-        # from separate open_ports calls must not collide (ARM rejects
-        # duplicate priorities within an NSG).
-        _request('PUT', f'{base}/securityRules/sky-port-{lo}', {
+        name = f'sky-port-{port_range.replace("-", "-to-")}'
+        if name in existing_names:
+            continue  # idempotent: rule already present
+        _request('PUT', f'{base}/securityRules/{name}', {
             'properties': {
-                'priority': 1100 + int(lo) % 2900,
+                'priority': next_priority,
                 'direction': 'Inbound', 'access': 'Allow',
                 'protocol': 'Tcp',
                 'sourceAddressPrefix': '*', 'sourcePortRange': '*',
@@ -442,3 +464,5 @@ def authorize_ingress(rg: str, ports: List[str]) -> None:
                 'destinationPortRange': port_range,
             },
         }, api_version=_NETWORK_API)
+        existing_names.add(name)
+        next_priority += 1

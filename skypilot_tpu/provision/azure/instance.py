@@ -66,7 +66,18 @@ def run_instances(region: str, cluster_name_on_cloud: str,
     for name in names:
         vm = existing.get(name)
         if vm is not None:
-            if arm_api.vm_power_state(vm) == 'stopped':
+            state = arm_api.vm_power_state(vm)
+            if state == 'stopping':
+                # Launch raced a deallocate: wait for it to settle,
+                # then restart — otherwise the node would sit in
+                # 'stopped' until the wait timeout.
+                deadline = time.time() + 300
+                while state == 'stopping' and time.time() < deadline:
+                    time.sleep(5)
+                    cur = _by_name(rg).get(name)
+                    state = (arm_api.vm_power_state(cur)
+                             if cur is not None else 'stopped')
+            if state == 'stopped':
                 arm_api.start_vm(rg, name)
                 resumed.append(name)
             continue  # running/pending: reuse
@@ -130,7 +141,10 @@ def stop_instances(cluster_name_on_cloud: str,
                    worker_only: bool = False) -> None:
     del worker_only
     pc = provider_config or {}
-    rg = arm_api.resource_group_name(cluster_name_on_cloud, pc['region'])
+    region = pc.get('region')
+    if not region:
+        return
+    rg = arm_api.resource_group_name(cluster_name_on_cloud, region)
     for name, vm in _by_name(rg).items():
         if arm_api.vm_power_state(vm) in ('running', 'pending'):
             arm_api.deallocate_vm(rg, name)
@@ -165,7 +179,10 @@ def query_instances(cluster_name_on_cloud: str,
                     ) -> Dict[str, Optional[str]]:
     del non_terminated_only
     pc = provider_config or {}
-    rg = arm_api.resource_group_name(cluster_name_on_cloud, pc['region'])
+    region = pc.get('region')
+    if not region:
+        return {}
+    rg = arm_api.resource_group_name(cluster_name_on_cloud, region)
     out: Dict[str, Optional[str]] = {}
     for name, vm in _by_name(rg).items():
         if arm_api.vm_tags(vm).get('skypilot-cluster') != \
@@ -213,8 +230,11 @@ def get_cluster_info(region: str, cluster_name_on_cloud: str,
 def open_ports(cluster_name_on_cloud: str, ports: List[str],
                provider_config: Optional[Dict[str, Any]] = None) -> None:
     pc = provider_config or {}
+    region = pc.get('region')
+    if not region:
+        return
     arm_api.authorize_ingress(
-        arm_api.resource_group_name(cluster_name_on_cloud, pc['region']),
+        arm_api.resource_group_name(cluster_name_on_cloud, region),
         ports)
 
 

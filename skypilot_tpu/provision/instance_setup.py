@@ -33,6 +33,17 @@ def _repo_root() -> str:
         os.path.abspath(__file__))))
 
 
+def deploy_package(runner) -> None:
+    """Rsync this installation's package tree to a host (the runtime-
+    matches-server guarantee). Shared by per-launch bootstrap and
+    `stpu ssh-node-pool up` pre-warming."""
+    src = os.path.join(_repo_root(), 'skypilot_tpu') + '/'
+    runner.run(f'mkdir -p {_PKG_REMOTE_DIR}/skypilot_tpu',
+               stream_logs=False)
+    runner.rsync(src, f'{_PKG_REMOTE_DIR}/skypilot_tpu/', up=True,
+                 excludes=['__pycache__'])
+
+
 def setup_agents(cluster_info: provision_common.ClusterInfo,
                  runners: List[runner_lib.CommandRunner],
                  cluster_name: str,
@@ -47,7 +58,6 @@ def setup_agents(cluster_info: provision_common.ClusterInfo,
     `<home>/agent_secret` before the agent starts; the agent then
     rejects any request without the matching X-Agent-Token.
     """
-    src = os.path.join(_repo_root(), 'skypilot_tpu') + '/'
     instances = cluster_info.sorted_instances()
 
     secret_src = None
@@ -70,10 +80,8 @@ def setup_agents(cluster_info: provision_common.ClusterInfo,
     def bootstrap(pair) -> None:
         inst, runner = pair
         home = constants.SKY_REMOTE_HOME
-        runner.run(f'mkdir -p {_PKG_REMOTE_DIR}/skypilot_tpu '
-                   f'&& mkdir -p {home} && chmod 700 {home}')
-        runner.rsync(src, f'{_PKG_REMOTE_DIR}/skypilot_tpu/', up=True,
-                     excludes=['__pycache__'])
+        runner.run(f'mkdir -p {home} && chmod 700 {home}')
+        deploy_package(runner)
         if secret_src is not None:
             runner.rsync(secret_src, f'{home}/agent_secret', up=True)
         if log_store_src is not None:

@@ -383,3 +383,36 @@ def delete_volume(config: Dict[str, Any]) -> None:
         _request(ctx, 'DELETE', path)
     except exceptions.FetchClusterInfoError:
         pass
+
+
+def list_skypilot_pods(context: Optional[str] = None,
+                       namespace: Optional[str] = None
+                       ) -> List[Dict[str, Any]]:
+    """All pods this framework manages in a context (any cluster) —
+    backs `stpu status --kubernetes` (reference: status_kubernetes in
+    sky/client/cli/command.py)."""
+    ctx = _ctx({'context': context, 'namespace': namespace})
+    try:
+        # Cluster-scope list covers pods in every namespace.
+        out = _request(ctx, 'GET',
+                       '/api/v1/pods?labelSelector=skypilot-cluster')
+    except exceptions.ProvisionerError:
+        # RBAC may deny cluster-scope listing; fall back to the
+        # context's namespace.
+        out = _request(
+            ctx, 'GET',
+            f'/api/v1/namespaces/{ctx.namespace}/pods'
+            f'?labelSelector=skypilot-cluster')
+    pods = []
+    for pod in out.get('items', []):
+        meta = pod.get('metadata', {})
+        labels = meta.get('labels', {})
+        pods.append({
+            'name': meta.get('name', ''),
+            'cluster': labels.get('skypilot-cluster', ''),
+            'node_rank': labels.get('skypilot-node-rank', '0'),
+            'phase': pod.get('status', {}).get('phase', 'Unknown'),
+            'node': pod.get('spec', {}).get('nodeName', ''),
+            'namespace': meta.get('namespace', ctx.namespace),
+        })
+    return pods

@@ -150,3 +150,40 @@ def test_down_requires_name_or_all(runner):
 def test_serve_down_requires_name_or_all(runner):
     r = runner.invoke(cli.cli, ['serve', 'down', '-y'])
     assert r.exit_code != 0
+
+
+def test_completion_emits_script(runner):
+    r = runner.invoke(cli.cli, ['completion', 'bash'])
+    assert r.exit_code == 0, r.output
+    assert '_STPU_COMPLETE=bash_complete' in r.output
+    r = runner.invoke(cli.cli, ['completion', 'zsh'])
+    assert r.exit_code == 0
+    r = runner.invoke(cli.cli, ['completion', 'tcsh'])
+    assert r.exit_code != 0
+
+
+def test_ssh_node_pool_up_down_validate_pool(runner):
+    r = runner.invoke(cli.cli, ['ssh-node-pool', 'up', 'nope'])
+    assert r.exit_code != 0
+    assert 'not declared' in r.output
+    r = runner.invoke(cli.cli, ['ssh-node-pool', 'down', 'nope', '-y'])
+    assert r.exit_code != 0
+    assert 'not declared' in r.output
+
+
+def test_local_group_and_pool_logs_registered(runner):
+    r = runner.invoke(cli.cli, ['local', '--help'])
+    assert r.exit_code == 0 and 'up' in r.output and 'down' in r.output
+    r = runner.invoke(cli.cli, ['jobs', 'pool', '--help'])
+    assert r.exit_code == 0 and 'logs' in r.output
+
+
+def test_status_kubernetes_flag_no_context(runner, monkeypatch):
+    from skypilot_tpu.provision.kubernetes import instance as k8s_inst
+    monkeypatch.setattr(k8s_inst, 'list_skypilot_pods', lambda **kw: [
+        {'name': 'c1-0', 'cluster': 'c1', 'node_rank': '0',
+         'phase': 'Running', 'node': 'gke-n1', 'namespace': 'default'},
+    ])
+    r = runner.invoke(cli.cli, ['status', '--kubernetes'])
+    assert r.exit_code == 0, r.output
+    assert 'c1-0' in r.output and 'Running' in r.output
