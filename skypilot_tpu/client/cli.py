@@ -1472,10 +1472,15 @@ def ssh_node_pool_up(pool) -> None:
         runner = command_runner.SSHCommandRunner(
             (host['ip'], host['port']), host['user'],
             host['identity_file'])
-        rc = runner.run('python3 --version', stream_logs=False)
-        if rc != 0:
-            return f'FAIL (no python3, rc={rc})'
-        instance_setup.deploy_package(runner)
+        try:
+            rc = runner.run('python3 --version', stream_logs=False)
+            if rc != 0:
+                return f'FAIL (no python3, rc={rc})'
+            instance_setup.deploy_package(runner)
+        except Exception as e:  # pylint: disable=broad-except
+            # Per-host outcome rows: one bad host must not abort (or
+            # hide) the rest of the fan-out.
+            return f'FAIL ({str(e)[:80]})'
         return 'OK'
 
     results = subprocess_utils.run_in_parallel(deploy, hosts)
@@ -1497,7 +1502,7 @@ def ssh_node_pool_down(pool, yes) -> None:
         _err(f'pool {pool!r} not declared; known: '
              + ', '.join(sorted(pools)))
     busy = [cluster for cluster, entry in
-            ssh_instance._load_allocations().items()
+            ssh_instance.list_allocations().items()
             if entry.get('pool') == pool]
     if busy:
         _err(f'pool {pool!r} still hosts cluster(s) {sorted(busy)}; '
@@ -1506,15 +1511,18 @@ def ssh_node_pool_down(pool, yes) -> None:
         click.confirm(f'Remove the runtime from all hosts of {pool!r}?',
                       default=True, abort=True)
     from skypilot_tpu.provision import instance_setup
-    pkg_dir = instance_setup._PKG_REMOTE_DIR
+    pkg_dir = instance_setup.remote_pkg_dir()
 
     def teardown(host):
         runner = command_runner.SSHCommandRunner(
             (host['ip'], host['port']), host['user'],
             host['identity_file'])
-        runner.run('pkill -f skypilot_tpu.agent.agent || true; '
-                   f'rm -rf {pkg_dir}', stream_logs=False)
-        return 'OK'
+        try:
+            rc = runner.run('pkill -f skypilot_tpu.agent.agent || true; '
+                            f'rm -rf {pkg_dir}', stream_logs=False)
+        except Exception as e:  # pylint: disable=broad-except
+            return f'FAIL ({str(e)[:80]})'
+        return 'OK' if rc == 0 else f'FAIL (rc={rc})'
 
     results = subprocess_utils.run_in_parallel(
         teardown, pools[pool]['hosts'])

@@ -34,21 +34,24 @@ class StoreType(enum.Enum):
     S3 = 'S3'
     AZURE = 'AZURE'
     R2 = 'R2'
+    HF = 'HF'  # HuggingFace Hub, download-only (models/datasets)
 
     @classmethod
     def from_url(cls, url: str) -> 'StoreType':
         for prefix, store in (('gs://', cls.GCS), ('s3://', cls.S3),
-                              ('az://', cls.AZURE), ('r2://', cls.R2)):
+                              ('az://', cls.AZURE), ('r2://', cls.R2),
+                              ('hf://', cls.HF)):
             if url.startswith(prefix):
                 return store
         raise exceptions.StorageSpecError(
             f'Unsupported storage url {url!r} '
-            '(gs://, s3://, az://, or r2://).')
+            '(gs://, s3://, az://, r2://, or hf://).')
 
     @property
     def url_prefix(self) -> str:
         return {StoreType.GCS: 'gs', StoreType.S3: 's3',
-                StoreType.AZURE: 'az', StoreType.R2: 'r2'}[self]
+                StoreType.AZURE: 'az', StoreType.R2: 'r2',
+                StoreType.HF: 'hf'}[self]
 
 
 class Storage:
@@ -70,6 +73,17 @@ class Storage:
         if store is None and source is not None and '://' in source:
             store = StoreType.from_url(source)
         self.store = store or StoreType.GCS
+        if self.store == StoreType.HF:
+            # The Hub is a snapshot source, not a filesystem
+            # (reference: HuggingFaceStore, sky/data/storage.py:5383).
+            if mode != StorageMode.COPY:
+                raise exceptions.StorageSpecError(
+                    'hf:// sources are download-only: use mode: COPY '
+                    f'(got {mode.value}).')
+            if self.source is None or '://' not in str(self.source):
+                raise exceptions.StorageSpecError(
+                    'hf:// storage needs a source like '
+                    'hf://org/model or hf://datasets/org/name.')
 
     # -- bucket url ------------------------------------------------------------
     @property
@@ -116,6 +130,9 @@ class Storage:
         if not self.is_local_source():
             return
         assert self.name, 'local-source storage needs a bucket name'
+        if self.store == StoreType.HF:
+            raise exceptions.StorageSpecError(
+                'Cannot upload to hf:// (download-only store).')
         src = os.path.expanduser(str(self.source))
         url = self.bucket_url
         if self.store == StoreType.GCS:
@@ -194,6 +211,16 @@ def download_command(uri: str, dst: str) -> str:
                 f'aws s3 sync s3://{q(bucket_path)} {q(dst)} '
                 f'--endpoint-url {q(_r2_endpoint())}'
                 f'{_r2_profile_flag()}')
+    if uri.startswith('hf://'):
+        # hf CLI ships with huggingface_hub; snapshots resume on retry.
+        repo = uri[len('hf://'):].strip('/')
+        repo_type = ''
+        if repo.startswith('datasets/'):
+            repo = repo[len('datasets/'):]
+            repo_type = ' --repo-type dataset'
+        return (f'mkdir -p {q(dst)} && '
+                f'huggingface-cli download {q(repo)}{repo_type} '
+                f'--local-dir {q(dst)}')
     if uri.startswith('https://'):
         return (f'mkdir -p $(dirname {q(dst)}) && '
                 f'curl -fsSL {q(uri)} -o {q(dst)}')

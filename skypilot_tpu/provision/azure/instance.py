@@ -143,7 +143,10 @@ def stop_instances(cluster_name_on_cloud: str,
     pc = provider_config or {}
     region = pc.get('region')
     if not region:
-        return
+        raise exceptions.ProvisionerError(
+            f'Azure cluster {cluster_name_on_cloud!r} has no region in '
+            'its provider config; cannot stop instances.',
+            category=exceptions.ProvisionerError.CONFIG)
     rg = arm_api.resource_group_name(cluster_name_on_cloud, region)
     for name, vm in _by_name(rg).items():
         if arm_api.vm_power_state(vm) in ('running', 'pending'):
@@ -181,7 +184,13 @@ def query_instances(cluster_name_on_cloud: str,
     pc = provider_config or {}
     region = pc.get('region')
     if not region:
-        return {}
+        # Never return {}: status refresh reads an empty result as
+        # "terminated externally" and deletes the cluster record while
+        # the VMs keep billing.
+        raise exceptions.ProvisionerError(
+            f'Azure cluster {cluster_name_on_cloud!r} has no region in '
+            'its provider config; cannot query instances.',
+            category=exceptions.ProvisionerError.CONFIG)
     rg = arm_api.resource_group_name(cluster_name_on_cloud, region)
     out: Dict[str, Optional[str]] = {}
     for name, vm in _by_name(rg).items():
@@ -232,7 +241,10 @@ def open_ports(cluster_name_on_cloud: str, ports: List[str],
     pc = provider_config or {}
     region = pc.get('region')
     if not region:
-        return
+        raise exceptions.ProvisionerError(
+            f'Azure cluster {cluster_name_on_cloud!r} has no region in '
+            'its provider config; cannot open ports.',
+            category=exceptions.ProvisionerError.CONFIG)
     arm_api.authorize_ingress(
         arm_api.resource_group_name(cluster_name_on_cloud, region),
         ports)

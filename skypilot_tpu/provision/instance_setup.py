@@ -17,6 +17,12 @@ from skypilot_tpu.utils import command_runner as runner_lib
 from skypilot_tpu.utils import subprocess_utils
 
 _PKG_REMOTE_DIR = '~/.sky-tpu-runtime/skypilot_tpu_pkg'
+
+
+def remote_pkg_dir() -> str:
+    """Where the package tree lives on hosts (public: the CLI's
+    ssh-node-pool teardown removes it)."""
+    return _PKG_REMOTE_DIR
 _VENV_PY = 'python3'
 
 _AGENT_START_TEMPLATE = (

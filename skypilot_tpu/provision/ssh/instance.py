@@ -37,6 +37,12 @@ def _save_allocations(alloc: Dict[str, Any]) -> None:
         json.dump(alloc, f, indent=1)
 
 
+def list_allocations() -> Dict[str, Any]:
+    """Public read view of cluster->hosts allocations (CLI uses this
+    for the pool busy-check)."""
+    return _load_allocations()
+
+
 def run_instances(region: str, cluster_name_on_cloud: str,
                   config: common.ProvisionConfig) -> common.ProvisionRecord:
     pc = dict(config.provider_config)

@@ -330,3 +330,28 @@ def test_storage_yaml_roundtrip_new_stores():
         assert st.store.value == store
         assert storage_lib.Storage.from_yaml_config(
             st.to_yaml_config()).bucket_url == url
+
+
+def test_hf_store_download_only():
+    from skypilot_tpu.data import storage as storage_lib
+    st = storage_lib.Storage(source='hf://meta-llama/Llama-3-8B',
+                             mode=storage_lib.StorageMode.COPY)
+    assert st.store == storage_lib.StoreType.HF
+    cmd = storage_lib.mount_command(st, '/models/llama')
+    assert 'huggingface-cli download' in cmd
+    assert 'meta-llama/Llama-3-8B' in cmd
+    assert '--repo-type dataset' not in cmd
+
+    ds = storage_lib.Storage(source='hf://datasets/allenai/c4',
+                             mode=storage_lib.StorageMode.COPY)
+    dcmd = storage_lib.mount_command(ds, '/data/c4')
+    assert '--repo-type dataset' in dcmd and 'allenai/c4' in dcmd
+
+    import pytest as _pytest
+    from skypilot_tpu import exceptions as exc
+    with _pytest.raises(exc.StorageSpecError):
+        storage_lib.Storage(source='hf://org/model')  # MOUNT default
+    with _pytest.raises(exc.StorageSpecError):
+        storage_lib.Storage(name='only-name',
+                            store=storage_lib.StoreType.HF,
+                            mode=storage_lib.StorageMode.COPY)
