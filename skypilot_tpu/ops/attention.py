@@ -44,6 +44,12 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                           impl: str = 'auto') -> jax.Array:
     """q: [B,S,H,D]; k/v: [B,S,Hkv,D] (GQA allowed). Returns [B,S,H,D]."""
     assert q.ndim == 4 and k.ndim == 4 and v.ndim == 4, (q.shape, k.shape)
+    if v.shape[-1] != q.shape[-1]:
+        # Mismatched value dim (MLA: qk_head_dim != v_head_dim). Must
+        # be decided BEFORE the ring/flash dispatch: both kernels
+        # require equal q/k/v dims. einsum + f32 softmax fuses fine
+        # under XLA.
+        return _unequal_dims_attention(q, k, v, causal=causal)
     # Context parallelism: a seq-sharded mesh switches to ring attention.
     from skypilot_tpu.parallel import context as cp_context
     seq_mesh = cp_context.active_seq_mesh()
@@ -72,6 +78,27 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
     return jax.nn.dot_product_attention(q, k, v, is_causal=causal)
+
+
+def _unequal_dims_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                            *, causal: bool) -> jax.Array:
+    """Generic attention for v_head_dim != qk_head_dim (MLA)."""
+    num_q_heads, num_kv_heads = q.shape[2], k.shape[2]
+    if num_kv_heads != num_q_heads:
+        rep = num_q_heads // num_kv_heads
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum('bqhd,bkhd->bhqk', q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        seq_q, seq_k = q.shape[1], k.shape[1]
+        mask = (jnp.arange(seq_k)[None, :]
+                <= jnp.arange(seq_q)[:, None])
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum('bhqk,bkhv->bqhv', p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
 
 
 def _pallas_flash_kernel(q: jax.Array, k: jax.Array, v: jax.Array,

@@ -58,6 +58,15 @@ def _build_model(name: str, seq: int, remat: bool):
                                                  moe_next_token_loss)
         cfg = MixtralConfig.tiny(remat=remat)
         return Mixtral(cfg), cfg.vocab_size, moe_next_token_loss
+    if name == 'deepseek-v2-lite':
+        from skypilot_tpu.models.deepseek import Deepseek, DeepseekConfig
+        cfg = DeepseekConfig.v2_lite(max_seq_len=max(seq, 4096),
+                                     remat=remat)
+        return Deepseek(cfg), cfg.vocab_size, None
+    if name == 'deepseek-tiny':
+        from skypilot_tpu.models.deepseek import Deepseek, DeepseekConfig
+        cfg = DeepseekConfig.tiny(remat=remat)
+        return Deepseek(cfg), cfg.vocab_size, None
     raise ValueError(f'unknown model {name!r}')
 
 
@@ -82,8 +91,15 @@ def main() -> None:
                              '(ring attention)')
     parser.add_argument('--remat', action='store_true')
     parser.add_argument('--log-every', type=int, default=10)
+    parser.add_argument('--cpu', action='store_true',
+                        help='pin the CPU backend (smoke/dev runs; the '
+                             'JAX_PLATFORMS env var is overridden by '
+                             'some TPU plugins, jax.config is not)')
     args = parser.parse_args()
 
+    if args.cpu:
+        import jax
+        jax.config.update('jax_platforms', 'cpu')
     _maybe_init_distributed()
     import jax
     import jax.numpy as jnp

@@ -140,3 +140,36 @@ def test_multi_step_matches_sequential(cpu_mesh8):
     assert losses.shape == (3,)
     for a, b in zip(seq_losses, losses):
         assert a == pytest.approx(float(b), rel=1e-5)
+
+
+@pytest.mark.slow
+def test_deepseek_mla_trains_on_mesh(cpu_mesh8):
+    from skypilot_tpu.models.deepseek import Deepseek, DeepseekConfig
+    model = Deepseek(DeepseekConfig.tiny())
+    tokens = jnp.ones((8, 64), jnp.int32)
+    trainer = ShardedTrainer(model, cpu_mesh8)
+    state = trainer.init(jax.random.PRNGKey(0), tokens)
+    step = trainer.make_train_step(tokens)
+    batch = shard_batch(tokens, cpu_mesh8)
+    state, l1 = step(state, batch)
+    state, l2 = step(state, batch)
+    assert float(l2) < float(l1)
+
+
+def test_deepseek_latent_cache_is_compressed():
+    """The whole point of MLA: cached dims/token = kv_lora_rank +
+    rope_head_dim, independent of heads."""
+    from skypilot_tpu.models.deepseek import Deepseek, DeepseekConfig
+    cfg = DeepseekConfig.tiny(dtype=jnp.float32)
+    model = Deepseek(cfg)
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((2, 1), jnp.int32),
+        positions=jnp.zeros((2, 1), jnp.int32), decode=True)
+    cache = variables['cache']
+    lat = cache['layer_0']['attn']['latent_cache']
+    rope = cache['layer_0']['attn']['rope_cache']
+    assert lat.shape == (2, cfg.max_seq_len, cfg.kv_lora_rank)
+    assert rope.shape == (2, cfg.max_seq_len, cfg.rope_head_dim)
+    cached_dims = lat.shape[-1] + rope.shape[-1]
+    full_kv_dims = 2 * cfg.num_heads * cfg.v_head_dim
+    assert cached_dims < full_kv_dims / 2
