@@ -135,63 +135,54 @@ class MLAttention(nn.Module):
         w_uk = wkv_b[..., :cfg.nope_head_dim]       # [d_c, H, d_n]
         w_uv = wkv_b[..., cfg.nope_head_dim:]       # [d_c, H, d_v]
 
-        if decode and seq == 1:
-            # ABSORBED decode against the latent cache.
+        if decode:
+            # ABSORBED attention against the latent cache, for any
+            # chunk size: S=1 incremental decode, S=P chunked prefill,
+            # S=k+1 speculative verification. The chunk's latents are
+            # written at per-row offsets BEFORE attending, so stale
+            # entries from rejected drafts are always overwritten
+            # first (same contract as ops.chunked_cache_attention).
             latent = self.variable(
                 'cache', 'latent_cache', jnp.zeros,
                 (batch, cfg.max_seq_len, cfg.kv_lora_rank), cfg.dtype)
             ropes = self.variable(
                 'cache', 'rope_cache', jnp.zeros,
                 (batch, cfg.max_seq_len, cfg.rope_head_dim), cfg.dtype)
-            pos = positions[:, 0]                                # [B]
+            start = positions[:, 0]                              # [B]
 
-            def write_row(cache_row, new_row, p):
+            def write_rows(cache_row, new_rows, p):
                 return jax.lax.dynamic_update_slice(
-                    cache_row, new_row, (p, 0))
+                    cache_row, new_rows, (p, 0))
 
-            latent.value = jax.vmap(write_row)(
-                latent.value, c_kv.astype(cfg.dtype), pos)
-            ropes.value = jax.vmap(write_row)(
-                ropes.value, k_rope.astype(cfg.dtype), pos)
-            # q absorbed into latent space: [B,H,d_c]
-            q_eff = jnp.einsum('bhn,chn->bhc',
-                               q_nope[:, 0].astype(jnp.float32),
+            latent.value = jax.vmap(write_rows)(
+                latent.value, c_kv.astype(cfg.dtype), start)
+            ropes.value = jax.vmap(write_rows)(
+                ropes.value, k_rope.astype(cfg.dtype), start)
+            # q absorbed into latent space: [B,S,H,d_c]
+            q_eff = jnp.einsum('bshn,chn->bshc',
+                               q_nope.astype(jnp.float32),
                                w_uk.astype(jnp.float32))
             scores = (
-                jnp.einsum('bhc,btc->bht', q_eff,
+                jnp.einsum('bshc,btc->bhst', q_eff,
                            latent.value.astype(jnp.float32)) +
-                jnp.einsum('bhr,btr->bht',
-                           q_rope[:, 0].astype(jnp.float32),
+                jnp.einsum('bshr,btr->bhst',
+                           q_rope.astype(jnp.float32),
                            ropes.value.astype(jnp.float32))
             ) / jnp.sqrt(float(cfg.qk_head_dim))
-            mask = (jnp.arange(cfg.max_seq_len)[None, :]
-                    <= pos[:, None])[:, None, :]
+            mask = (jnp.arange(cfg.max_seq_len)[None, None, :]
+                    <= positions[:, :, None])[:, None]    # [B,1,S,T]
             scores = jnp.where(mask, scores, -jnp.inf)
             probs = jax.nn.softmax(scores, axis=-1)
             # Context in latent space, decompressed once per head.
-            ctx_lat = jnp.einsum('bht,btc->bhc', probs,
+            ctx_lat = jnp.einsum('bhst,btc->bshc', probs,
                                  latent.value.astype(jnp.float32))
-            out = jnp.einsum('bhc,chv->bhv', ctx_lat,
+            out = jnp.einsum('bshc,chv->bshv', ctx_lat,
                              w_uv.astype(jnp.float32))
-            out = out[:, None].astype(cfg.dtype)     # [B,1,H,d_v]
+            out = out.astype(cfg.dtype)              # [B,S,H,d_v]
         else:
-            # Training / chunked prefill: decompress K and V from the
-            # chunk's latents (for prefill the sequence starts empty,
-            # so the chunk IS the whole history) and run standard
-            # causal attention at qk_head_dim.
-            if decode:
-                latent = self.variable(
-                    'cache', 'latent_cache', jnp.zeros,
-                    (batch, cfg.max_seq_len, cfg.kv_lora_rank),
-                    cfg.dtype)
-                ropes = self.variable(
-                    'cache', 'rope_cache', jnp.zeros,
-                    (batch, cfg.max_seq_len, cfg.rope_head_dim),
-                    cfg.dtype)
-                latent.value = latent.value.at[:, :seq].set(
-                    c_kv.astype(cfg.dtype))
-                ropes.value = ropes.value.at[:, :seq].set(
-                    k_rope.astype(cfg.dtype))
+            # Training: decompress K and V from the chunk's latents
+            # (no cache) and run standard causal attention at
+            # qk_head_dim.
             k_nope = jnp.einsum('btc,chn->bthn', c_kv, w_uk)
             v = jnp.einsum('btc,chv->bthv', c_kv, w_uv)
             k = jnp.concatenate([

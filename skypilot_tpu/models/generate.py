@@ -113,3 +113,128 @@ def teacher_forced_logits(model, params, tokens: jax.Array
     _, decoded = jax.lax.scan(step, cache, jnp.arange(seq))
     decoded = jnp.swapaxes(decoded, 0, 1)
     return full, decoded
+
+
+def make_speculative_generate_fn(model, max_total_len: int,
+                                 draft_k: int = 4, ngram: int = 2,
+                                 eos_id: Optional[int] = None):
+    """Greedy prompt-lookup speculative decoding.
+
+    Drafts `draft_k` tokens per step by matching the last `ngram`
+    generated tokens against earlier context (self-drafting — no draft
+    model) and verifies the whole guess in ONE chunked forward pass
+    through the cache (ops.chunked_cache_attention / the MLA absorbed
+    chunk path). Accepted-prefix semantics make the output EXACTLY the
+    greedy tokens of `make_generate_fn`, in between 1 and draft_k+1
+    tokens per model call — large speedups on structured/repetitive
+    text, never slower than +1 token per call. Greedy only (verification
+    compares argmax); dense-cache models (paged pools not used here).
+
+    Returns jitted fn(params, prompt [B, P], rng) -> tokens [B, T].
+    """
+    assert draft_k >= 1 and ngram >= 1
+    # The verify chunk may write up to draft_k past the last kept token.
+    assert max_total_len + draft_k + 1 <= model.config.max_seq_len + 1, (
+        max_total_len, draft_k, model.config.max_seq_len)
+
+    pad = draft_k + 1  # scratch tail so chunk writes stay in-bounds
+
+    @jax.jit
+    def generate(params, prompt: jax.Array, rng: jax.Array) -> jax.Array:
+        del rng  # greedy
+        batch, prompt_len = prompt.shape
+        total = max_total_len + pad
+        cache = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((batch, 1), jnp.int32),
+            positions=jnp.zeros((batch, 1), jnp.int32), decode=True,
+        )['cache']
+        import flax.linen as nn
+        cache = jax.tree.map(jnp.zeros_like, nn.meta.unbox(cache))
+
+        tokens = jnp.zeros((batch, total), jnp.int32)
+        tokens = jax.lax.dynamic_update_slice(tokens, prompt, (0, 0))
+
+        # PREFILL: the whole prompt in one chunk; its last logits give
+        # the first generated token.
+        positions = jnp.broadcast_to(jnp.arange(prompt_len),
+                                     (batch, prompt_len))
+        logits, mutated = model.apply(
+            {'params': params, 'cache': cache}, prompt,
+            positions=positions, decode=True, mutable=['cache'])
+        cache = mutated['cache']
+        first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        tokens = jax.vmap(
+            lambda row, t: row.at[prompt_len].set(t))(tokens, first)
+        length = jnp.full((batch,), prompt_len + 1, jnp.int32)
+
+        # Sliding n-gram windows are recomputed per step from the
+        # token buffer; windows fully inside the generated region only.
+        n_windows = total - ngram  # window w covers [w, w+ngram)
+
+        def draft(tokens_row, length_row):
+            """Propose draft_k tokens following the most recent earlier
+            occurrence of the row's trailing n-gram."""
+            pattern = jax.lax.dynamic_slice(
+                tokens_row, (length_row - ngram,), (ngram,))
+            idx = jnp.arange(n_windows)
+            windows = jnp.stack(
+                [tokens_row[i:i + n_windows] for i in range(ngram)], -1)
+            match = jnp.all(windows == pattern[None, :], axis=-1)
+            # Only windows whose continuation starts before the tail:
+            # w + ngram < length (strictly earlier occurrence).
+            match &= idx + ngram < length_row
+            any_match = jnp.any(match)
+            w = jnp.where(match, idx, -1).max()
+            src = jnp.where(any_match, w + ngram, length_row - 1)
+            guess = jax.lax.dynamic_slice(tokens_row, (src,), (draft_k,))
+            # No match: repeat the last token (worst case: 1 accept).
+            last = tokens_row[length_row - 1]
+            return jnp.where(any_match, guess,
+                             jnp.full((draft_k,), last, jnp.int32))
+
+        def cond(carry):
+            tokens, cache, length = carry
+            return jnp.any(length < max_total_len)
+
+        def body(carry):
+            tokens, cache, length = carry
+            drafts = jax.vmap(draft)(tokens, length)        # [B, k]
+            tokens = jax.vmap(
+                lambda row, d, p: jax.lax.dynamic_update_slice(
+                    row, d, (p,)))(tokens, drafts, length)
+            # Verify chunk: [x_{L-1}, d_1..d_k] at positions L-1..L+k-1
+            chunk = jax.vmap(
+                lambda row, p: jax.lax.dynamic_slice(
+                    row, (p - 1,), (draft_k + 1,)))(tokens, length)
+            positions = (length - 1)[:, None] + jnp.arange(draft_k + 1)
+            logits, mutated = model.apply(
+                {'params': params, 'cache': cache}, chunk,
+                positions=positions, decode=True, mutable=['cache'])
+            cache = mutated['cache']
+            y = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B,k+1]
+            # Leading drafts matching the model's own greedy choice.
+            accept = jnp.cumprod(
+                (drafts == y[:, :-1]).astype(jnp.int32), axis=1)
+            n_accept = accept.sum(axis=1)                       # [B]
+            # Write the model's tokens (accepted prefix == drafts;
+            # the first correction lands at L + n_accept).
+            tokens = jax.vmap(
+                lambda row, yy, p: jax.lax.dynamic_update_slice(
+                    row, yy, (p,)))(tokens, y, length)
+            advance = jnp.where(length < max_total_len,
+                                n_accept + 1, 0)
+            length = jnp.minimum(length + advance, max_total_len)
+            return tokens, cache, length
+
+        tokens, cache, length = jax.lax.while_loop(
+            cond, body, (tokens, cache, length))
+        out = tokens[:, :max_total_len]
+        if eos_id is not None:
+            positions = jnp.arange(max_total_len)[None, :]
+            gen = positions >= prompt_len
+            hit = jnp.cumsum((out == eos_id) & gen, axis=1)
+            keep = hit - ((out == eos_id) & gen).astype(hit.dtype) == 0
+            out = jnp.where(keep, out, eos_id)
+        return out
+
+    return generate

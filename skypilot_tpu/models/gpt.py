@@ -100,9 +100,10 @@ class CausalSelfAttention(nn.Module):
                                   cfg.dtype))
 
         if decode and seq > 1:
-            # CHUNKED PREFILL (same contract as models/llama.py):
-            # empty sequence, positions = arange per row; causal
-            # attention over the chunk, K/V written for every position.
+            # CHUNKED decode (same contract as models/llama.py): paged
+            # path = chunked prefill (empty sequence, arange positions);
+            # dense path = chunked attention at arbitrary per-row
+            # offsets (prefill + speculative verification chunks).
             assert positions is not None
             if page_indices is not None:
                 from skypilot_tpu.ops import paged_attention as paged_ops
@@ -110,6 +111,8 @@ class CausalSelfAttention(nn.Module):
                 k_pages.value, v_pages.value = paged_ops.write_kv_chunk(
                     k_pages.value, v_pages.value, k, v, positions,
                     page_indices)
+                out = attention_ops.dot_product_attention(q, k, v,
+                                                          causal=True)
             else:
                 cached_k = self.variable(
                     'cache', 'cached_key', jnp.zeros,
@@ -119,12 +122,11 @@ class CausalSelfAttention(nn.Module):
                     'cache', 'cached_value', jnp.zeros,
                     (batch, cfg.block_size, cfg.num_heads, cfg.head_dim),
                     cfg.dtype)
-                cached_k.value = cached_k.value.at[:, :seq].set(
-                    k.astype(cfg.dtype))
-                cached_v.value = cached_v.value.at[:, :seq].set(
-                    v.astype(cfg.dtype))
-            out = attention_ops.dot_product_attention(q, k, v,
-                                                      causal=True)
+                out, cached_k.value, cached_v.value = \
+                    attention_ops.chunked_cache_attention(
+                        q, k, v, cached_k.value, cached_v.value,
+                        positions)
+                out = out.astype(cfg.dtype)
         elif decode:
             # One token in, KV cache with a PER-ROW write index
             # (positions[:, 0]) — the shared serving-cache contract

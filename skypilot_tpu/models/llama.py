@@ -122,17 +122,19 @@ class Attention(nn.Module):
                                   cfg.dtype))
 
         if decode and seq > 1:
-            # CHUNKED PREFILL: the whole prompt in one forward pass —
-            # causal attention over the chunk, K/V written for every
-            # position (vs one sequential model step per token).
-            # Contract: the sequence starts empty and positions are
-            # arange(seq) per row (engine admission guarantees both).
+            # CHUNKED decode: many tokens in one forward pass. Paged
+            # path = chunked PREFILL only (contract: sequence starts
+            # empty, positions arange per row). Dense path = chunked
+            # attention at arbitrary per-row offsets — prefill AND
+            # speculative-decoding verification chunks.
             if page_indices is not None:
                 from skypilot_tpu.ops import paged_attention as paged_ops
                 k_pages, v_pages = _page_vars()
                 k_pages.value, v_pages.value = paged_ops.write_kv_chunk(
                     k_pages.value, v_pages.value, k, v, positions,
                     page_indices)
+                out = attention_ops.dot_product_attention(q, k, v,
+                                                          causal=True)
             else:
                 cached_k = self.variable(
                     'cache', 'cached_key', jnp.zeros,
@@ -142,12 +144,11 @@ class Attention(nn.Module):
                     'cache', 'cached_value', jnp.zeros,
                     (batch, cfg.max_seq_len, cfg.num_kv_heads, hd),
                     cfg.dtype)
-                cached_k.value = cached_k.value.at[:, :seq].set(
-                    k.astype(cfg.dtype))
-                cached_v.value = cached_v.value.at[:, :seq].set(
-                    v.astype(cfg.dtype))
-            out = attention_ops.dot_product_attention(q, k, v,
-                                                      causal=True)
+                out, cached_k.value, cached_v.value = \
+                    attention_ops.chunked_cache_attention(
+                        q, k, v, cached_k.value, cached_v.value,
+                        positions)
+                out = out.astype(cfg.dtype)
         elif decode:
             # Incremental decoding: one token in, KV cache with PER-ROW
             # write positions — the shared serving-cache contract

@@ -168,6 +168,60 @@ def _flash(q: jax.Array, k: jax.Array, v: jax.Array, *,
         check_vma=False)(q, k, v)
 
 
+def chunked_cache_attention(q: jax.Array, k_new: jax.Array,
+                            v_new: jax.Array, cached_k: jax.Array,
+                            cached_v: jax.Array, positions: jax.Array,
+                            *, chunk_only: bool = False):
+    """Multi-token cache attention at arbitrary PER-ROW offsets.
+
+    Generalizes `cached_decode_attention` to S>=1 query chunks: writes
+    this chunk's K/V at `positions[b, s]` (contiguous per row, starting
+    at positions[:, 0]) and attends each query over every cache entry
+    with index <= its absolute position. One op drives both chunked
+    prefill (offset 0 — the old empty-cache special case) and
+    speculative-decoding verification chunks (offset = current length),
+    because the chunk is written BEFORE attending: any stale cache
+    entries from a previous step's rejected drafts are overwritten
+    before the mask can expose them. `chunk_only=True` is the prefill
+    fast path: the caller guarantees the cache holds nothing below the
+    offset, so attention stays chunk-local (S x S, flash-eligible)
+    instead of scanning all T cache slots.
+
+    q/k_new/v_new: [B, S, H|Hkv, D]; cached_k/v: [B, T, Hkv, D];
+    positions: [B, S]. Returns (out [B,S,H,D], cached_k, cached_v).
+    """
+    dtype = cached_k.dtype
+    max_len = cached_k.shape[1]
+    start = positions[:, 0]
+
+    def write_rows(cache_row, kv_rows, p):
+        return jax.lax.dynamic_update_slice(cache_row, kv_rows, (p, 0, 0))
+
+    cached_k = jax.vmap(write_rows)(cached_k, k_new.astype(dtype), start)
+    cached_v = jax.vmap(write_rows)(cached_v, v_new.astype(dtype), start)
+    if chunk_only:
+        # PREFILL fast path (contract: nothing live in the cache below
+        # the offset): attend only within the chunk — S x S, flash-
+        # dispatchable — instead of S x T over the whole cache.
+        out = dot_product_attention(q, k_new, v_new, causal=True)
+        return out, cached_k, cached_v
+    num_q_heads, num_kv_heads = q.shape[2], cached_k.shape[2]
+    k_all, v_all = cached_k, cached_v
+    if num_kv_heads != num_q_heads:
+        rep = num_q_heads // num_kv_heads
+        k_all = jnp.repeat(k_all, rep, axis=2)
+        v_all = jnp.repeat(v_all, rep, axis=2)
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum('bshd,bthd->bhst', q.astype(jnp.float32),
+                   k_all.astype(jnp.float32)) * scale
+    mask = (jnp.arange(max_len)[None, None, :]
+            <= positions[:, :, None])[:, None]          # [B,1,S,T]
+    s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum('bhst,bthd->bshd', p, v_all.astype(jnp.float32))
+    return out.astype(q.dtype), cached_k, cached_v
+
+
 def cached_decode_attention(q: jax.Array, k_new: jax.Array,
                             v_new: jax.Array, cached_k: jax.Array,
                             cached_v: jax.Array, pos: jax.Array):

@@ -35,6 +35,11 @@ def main() -> None:
                         help='slot-based engine: concurrent requests '
                              'share the decode loop')
     parser.add_argument('--num-slots', type=int, default=8)
+    parser.add_argument('--speculative', type=int, default=0,
+                        metavar='K',
+                        help='greedy prompt-lookup speculative decoding '
+                             'with K drafted tokens per step (one-shot '
+                             'engine only; exact greedy outputs)')
     parser.add_argument('--port', type=int,
                         default=int(os.environ.get('SKYPILOT_SERVE_PORT',
                                                    8000)))
@@ -83,8 +88,14 @@ def main() -> None:
         key = (batch, temperature)
         with lock:
             if key not in fns:
-                fns[key] = gen.make_generate_fn(
-                    model, args.max_total_len, temperature=temperature)
+                if args.speculative > 0 and temperature == 0.0:
+                    fns[key] = gen.make_speculative_generate_fn(
+                        model, args.max_total_len,
+                        draft_k=args.speculative)
+                else:
+                    fns[key] = gen.make_generate_fn(
+                        model, args.max_total_len,
+                        temperature=temperature)
             return fns[key]
 
     rng_holder = {'rng': jax.random.PRNGKey(0)}

@@ -334,3 +334,39 @@ def test_deepseek_continuous_batching_smoke():
     for p, got in zip(prompts, results):
         assert got[:len(p)] == list(p)
         assert len(got) > len(p)
+
+
+@pytest.mark.parametrize('family', ['llama', 'gpt', 'deepseek'])
+def test_speculative_matches_greedy(family):
+    """Prompt-lookup speculative decoding must produce EXACTLY the
+    greedy tokens of the plain scan engine, for every model family,
+    on a repetitive prompt (exercises multi-token accepts) and a
+    random one (exercises rejects)."""
+    from skypilot_tpu.models.generate import (make_generate_fn,
+                                              make_speculative_generate_fn)
+    if family == 'llama':
+        from skypilot_tpu.models.llama import Llama, LlamaConfig
+        cfg = LlamaConfig.tiny(dtype=jnp.float32)
+        model = Llama(cfg)
+    elif family == 'gpt':
+        from skypilot_tpu.models.gpt import GPT, GPTConfig
+        cfg = GPTConfig.tiny(dtype=jnp.float32)
+        model = GPT(cfg)
+    else:
+        from skypilot_tpu.models.deepseek import Deepseek, DeepseekConfig
+        cfg = DeepseekConfig.tiny(dtype=jnp.float32)
+        model = Deepseek(cfg)
+    params = nn.meta.unbox(model.init(
+        jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))['params'])
+
+    repetitive = jnp.asarray(
+        [[5, 9, 2, 5, 9, 2, 5, 9], [3, 3, 3, 3, 3, 3, 3, 3]], jnp.int32)
+    random_p = jax.random.randint(jax.random.PRNGKey(5), (2, 8), 0,
+                                  cfg.vocab_size, jnp.int32)
+    for prompt in (repetitive, random_p):
+        want = make_generate_fn(model, 24)(params, prompt,
+                                           jax.random.PRNGKey(0))
+        got = make_speculative_generate_fn(model, 24, draft_k=4,
+                                           ngram=2)(
+            params, prompt, jax.random.PRNGKey(0))
+        assert jnp.array_equal(got, want), (family, got, want)
