@@ -214,11 +214,13 @@ class ContinuousBatchingEngine:
                 lambda c: jnp.zeros_like(c) if c.ndim else c, row)
             # CHUNKED prefill on the batch-1 row (junk K/V past plen is
             # overwritten by later decode steps before the mask exposes
-            # it), then scatter the row back.
+            # it), then scatter the row back. prefill=True: the row is
+            # zeroed, so attention stays chunk-local (S x S,
+            # flash-eligible) instead of S x max_seq_len scores.
             logits, mutated = model.apply(
                 {'params': params, 'cache': row},
                 prompt[None, :], positions=positions,
-                decode=True, mutable=['cache'])
+                decode=True, mutable=['cache'], prefill=True)
             row = mutated['cache']
             last = jax.lax.dynamic_index_in_dim(
                 logits[0].astype(jnp.float32), plen - 1, axis=0,

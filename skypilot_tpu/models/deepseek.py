@@ -48,6 +48,8 @@ class DeepseekConfig:
     rope_theta: float = 10_000.0
     norm_eps: float = 1e-6
     dtype: Dtype = jnp.bfloat16
+    # LM-head logits precision; None = f32 (see llama.LlamaConfig).
+    logits_dtype: Optional[Dtype] = None
     remat: bool = False
 
     @classmethod
@@ -121,7 +123,8 @@ class MLAttention(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array, positions: jax.Array,
                  decode: bool = False,
-                 page_indices: Optional[jax.Array] = None) -> jax.Array:
+                 page_indices: Optional[jax.Array] = None,
+                 prefill: bool = False) -> jax.Array:
         assert page_indices is None, (
             'MLA caches latents, not K/V pages; paged serving of the '
             'deepseek family uses the dense latent cache (it is already '
@@ -162,20 +165,28 @@ class MLAttention(nn.Module):
             q_eff = jnp.einsum('bshn,chn->bshc',
                                q_nope.astype(jnp.float32),
                                w_uk.astype(jnp.float32))
+            if prefill:
+                # PREFILL fast path (static; empty-cache contract):
+                # attend only within the chunk — S x S instead of
+                # S x max_seq_len f32 scores.
+                k_lat = c_kv.astype(jnp.float32)
+                k_rop = k_rope.astype(jnp.float32)
+                mask = (jnp.arange(seq)[None, :]
+                        <= jnp.arange(seq)[:, None])[None, None]
+            else:
+                k_lat = latent.value.astype(jnp.float32)
+                k_rop = ropes.value.astype(jnp.float32)
+                mask = (jnp.arange(cfg.max_seq_len)[None, None, :]
+                        <= positions[:, :, None])[:, None]  # [B,1,S,T]
             scores = (
-                jnp.einsum('bshc,btc->bhst', q_eff,
-                           latent.value.astype(jnp.float32)) +
+                jnp.einsum('bshc,btc->bhst', q_eff, k_lat) +
                 jnp.einsum('bshr,btr->bhst',
-                           q_rope.astype(jnp.float32),
-                           ropes.value.astype(jnp.float32))
+                           q_rope.astype(jnp.float32), k_rop)
             ) / jnp.sqrt(float(cfg.qk_head_dim))
-            mask = (jnp.arange(cfg.max_seq_len)[None, None, :]
-                    <= positions[:, :, None])[:, None]    # [B,1,S,T]
             scores = jnp.where(mask, scores, -jnp.inf)
             probs = jax.nn.softmax(scores, axis=-1)
             # Context in latent space, decompressed once per head.
-            ctx_lat = jnp.einsum('bhst,btc->bshc', probs,
-                                 latent.value.astype(jnp.float32))
+            ctx_lat = jnp.einsum('bhst,btc->bshc', probs, k_lat)
             out = jnp.einsum('bshc,chv->bshv', ctx_lat,
                              w_uv.astype(jnp.float32))
             out = out.astype(cfg.dtype)              # [B,S,H,d_v]
@@ -209,11 +220,12 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array, positions: jax.Array,
                  decode: bool = False,
-                 page_indices: Optional[jax.Array] = None) -> jax.Array:
+                 page_indices: Optional[jax.Array] = None,
+                 prefill: bool = False) -> jax.Array:
         cfg = self.config
         x = x + MLAttention(cfg, name='attn')(
             RMSNorm(cfg.norm_eps, cfg.dtype, name='attn_norm')(x),
-            positions, decode, page_indices)
+            positions, decode, page_indices, prefill)
         # llama's SwiGLU block is duck-typed on mlp_dim/embed_dim/dtype
         # (same reuse as mixtral.py).
         x = x + SwiGLU(cfg, name='mlp')(
@@ -229,7 +241,8 @@ class Deepseek(nn.Module):
     def __call__(self, tokens: jax.Array,
                  positions: Optional[jax.Array] = None,
                  decode: bool = False,
-                 page_indices: Optional[jax.Array] = None) -> jax.Array:
+                 page_indices: Optional[jax.Array] = None,
+                 prefill: bool = False) -> jax.Array:
         cfg = self.config
         batch, seq = tokens.shape
         if positions is None:
@@ -245,10 +258,11 @@ class Deepseek(nn.Module):
 
         block = Block
         if cfg.remat:
-            block = nn.remat(Block, prevent_cse=False, static_argnums=(3,))
+            block = nn.remat(Block, prevent_cse=False,
+                             static_argnums=(3, 5))
         for i in range(cfg.num_layers):
             x = block(cfg, name=f'layer_{i}')(x, positions, decode,
-                                              page_indices)
+                                              page_indices, prefill)
         x = RMSNorm(cfg.norm_eps, cfg.dtype, name='final_norm')(x)
         head = self.param(
             'lm_head',
@@ -257,5 +271,6 @@ class Deepseek(nn.Module):
             (cfg.embed_dim, cfg.vocab_size), jnp.float32)
         logits = jnp.einsum('bse,ev->bsv', x.astype(cfg.dtype),
                             head.astype(cfg.dtype),
-                            preferred_element_type=jnp.float32)
+                            preferred_element_type=(cfg.logits_dtype or
+                                                    jnp.float32))
         return nn.with_logical_constraint(logits, ('batch', 'seq', 'vocab'))

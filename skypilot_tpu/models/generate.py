@@ -155,12 +155,14 @@ def make_speculative_generate_fn(model, max_total_len: int,
         tokens = jax.lax.dynamic_update_slice(tokens, prompt, (0, 0))
 
         # PREFILL: the whole prompt in one chunk; its last logits give
-        # the first generated token.
+        # the first generated token. prefill=True: the cache is empty,
+        # so attention stays chunk-local (flash-eligible).
         positions = jnp.broadcast_to(jnp.arange(prompt_len),
                                      (batch, prompt_len))
         logits, mutated = model.apply(
             {'params': params, 'cache': cache}, prompt,
-            positions=positions, decode=True, mutable=['cache'])
+            positions=positions, decode=True, mutable=['cache'],
+            prefill=True)
         cache = mutated['cache']
         first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
         tokens = jax.vmap(

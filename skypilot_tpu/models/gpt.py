@@ -83,7 +83,8 @@ class CausalSelfAttention(nn.Module):
     def __call__(self, x: jax.Array, deterministic: bool = True,
                  positions: Optional[jax.Array] = None,
                  decode: bool = False,
-                 page_indices: Optional[jax.Array] = None) -> jax.Array:
+                 page_indices: Optional[jax.Array] = None,
+                 prefill: bool = False) -> jax.Array:
         cfg = self.config
         batch, seq, _ = x.shape
         qkv = _dense(3 * cfg.embed_dim, ('embed', 'mlp'), cfg.dtype,
@@ -122,10 +123,13 @@ class CausalSelfAttention(nn.Module):
                     'cache', 'cached_value', jnp.zeros,
                     (batch, cfg.block_size, cfg.num_heads, cfg.head_dim),
                     cfg.dtype)
+                # `prefill` (static): empty-cache contract — attention
+                # stays chunk-local (S x S, flash-eligible) instead of
+                # S x block_size f32 scores.
                 out, cached_k.value, cached_v.value = \
                     attention_ops.chunked_cache_attention(
                         q, k, v, cached_k.value, cached_v.value,
-                        positions)
+                        positions, chunk_only=prefill)
                 out = out.astype(cfg.dtype)
         elif decode:
             # One token in, KV cache with a PER-ROW write index
@@ -196,7 +200,8 @@ class Block(nn.Module):
     def __call__(self, x: jax.Array, deterministic: bool = True,
                  positions: Optional[jax.Array] = None,
                  decode: bool = False,
-                 page_indices: Optional[jax.Array] = None) -> jax.Array:
+                 page_indices: Optional[jax.Array] = None,
+                 prefill: bool = False) -> jax.Array:
         cfg = self.config
         ln = lambda name: nn.LayerNorm(
             dtype=cfg.dtype, name=name,
@@ -206,7 +211,7 @@ class Block(nn.Module):
                 nn.initializers.zeros_init(), ('norm',)))
         x = x + CausalSelfAttention(cfg, name='attn')(
             ln('ln_1')(x), deterministic, positions=positions,
-            decode=decode, page_indices=page_indices)
+            decode=decode, page_indices=page_indices, prefill=prefill)
         x = x + MLP(cfg, name='mlp')(ln('ln_2')(x), deterministic)
         return nn.with_logical_constraint(x, ('batch', 'seq', 'act_embed'))
 
@@ -220,7 +225,8 @@ class GPT(nn.Module):
                  deterministic: bool = True,
                  positions: Optional[jax.Array] = None,
                  decode: bool = False,
-                 page_indices: Optional[jax.Array] = None) -> jax.Array:
+                 page_indices: Optional[jax.Array] = None,
+                 prefill: bool = False) -> jax.Array:
         cfg = self.config
         batch, seq = tokens.shape
         assert seq <= cfg.block_size, (seq, cfg.block_size)
@@ -257,7 +263,8 @@ class GPT(nn.Module):
                 x = Block(cfg, name=f'h_{i}')(x, deterministic,
                                               positions=positions,
                                               decode=decode,
-                                              page_indices=page_indices)
+                                              page_indices=page_indices,
+                                              prefill=prefill)
         x = nn.LayerNorm(
             dtype=cfg.dtype, name='ln_f',
             scale_init=nn.with_logical_partitioning(

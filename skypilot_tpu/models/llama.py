@@ -31,6 +31,10 @@ class LlamaConfig:
     rope_theta: float = 500_000.0
     norm_eps: float = 1e-5
     dtype: Dtype = jnp.bfloat16
+    # LM-head logits precision. None = f32 (the safe default for this
+    # family; GPT defaults to bf16 — see GPTConfig.logits_dtype for the
+    # HBM-traffic rationale). Set jnp.bfloat16 to halve logits traffic.
+    logits_dtype: Optional[Dtype] = None
     remat: bool = False
     # Paged KV cache (serving): page size in tokens and the physical
     # page-pool size. Used only when decode calls pass `page_indices`;
@@ -100,7 +104,8 @@ class Attention(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array, positions: jax.Array,
                  decode: bool = False,
-                 page_indices: Optional[jax.Array] = None) -> jax.Array:
+                 page_indices: Optional[jax.Array] = None,
+                 prefill: bool = False) -> jax.Array:
         cfg = self.config
         batch, seq, _ = x.shape
         hd = cfg.head_dim
@@ -144,10 +149,14 @@ class Attention(nn.Module):
                     'cache', 'cached_value', jnp.zeros,
                     (batch, cfg.max_seq_len, cfg.num_kv_heads, hd),
                     cfg.dtype)
+                # `prefill` (static): the caller guarantees the cache
+                # holds nothing below this chunk, so attention stays
+                # chunk-local (S x S, flash-eligible) instead of
+                # materializing S x max_seq_len f32 scores.
                 out, cached_k.value, cached_v.value = \
                     attention_ops.chunked_cache_attention(
                         q, k, v, cached_k.value, cached_v.value,
-                        positions)
+                        positions, chunk_only=prefill)
                 out = out.astype(cfg.dtype)
         elif decode:
             # Incremental decoding: one token in, KV cache with PER-ROW
@@ -214,11 +223,12 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array, positions: jax.Array,
                  decode: bool = False,
-                 page_indices: Optional[jax.Array] = None) -> jax.Array:
+                 page_indices: Optional[jax.Array] = None,
+                 prefill: bool = False) -> jax.Array:
         cfg = self.config
         x = x + Attention(cfg, name='attn')(
             RMSNorm(cfg.norm_eps, cfg.dtype, name='attn_norm')(x), positions,
-            decode, page_indices)
+            decode, page_indices, prefill)
         x = x + FeedForward(cfg, name='mlp')(
             RMSNorm(cfg.norm_eps, cfg.dtype, name='mlp_norm')(x))
         return nn.with_logical_constraint(x, ('batch', 'seq', 'act_embed'))
@@ -232,7 +242,8 @@ class Llama(nn.Module):
     def __call__(self, tokens: jax.Array,
                  positions: Optional[jax.Array] = None,
                  decode: bool = False,
-                 page_indices: Optional[jax.Array] = None) -> jax.Array:
+                 page_indices: Optional[jax.Array] = None,
+                 prefill: bool = False) -> jax.Array:
         cfg = self.config
         batch, seq = tokens.shape
         if positions is None:
@@ -248,19 +259,20 @@ class Llama(nn.Module):
         block = Block
         if cfg.remat:
             block = nn.remat(Block, prevent_cse=False,
-                             static_argnums=(3,))
+                             static_argnums=(3, 5))
         for i in range(cfg.num_layers):
             x = block(cfg, name=f'layer_{i}')(x, positions, decode,
-                                              page_indices)
+                                              page_indices, prefill)
         x = RMSNorm(cfg.norm_eps, cfg.dtype, name='final_norm')(x)
         head = self.param(
             'lm_head',
             nn.with_logical_partitioning(
                 nn.initializers.normal(stddev=0.02), ('embed', 'vocab')),
             (cfg.embed_dim, cfg.vocab_size), jnp.float32)
-        # bf16 operands + f32 accumulation: MXU-native rate, f32-safe
-        # softmax numerics (same treatment as models/gpt.py).
+        # bf16 operands, accumulation dtype from cfg.logits_dtype
+        # (None = f32: MXU-native rate, f32-safe softmax numerics).
         logits = jnp.einsum('bse,ev->bsv', x.astype(cfg.dtype),
                             head.astype(cfg.dtype),
-                            preferred_element_type=jnp.float32)
+                            preferred_element_type=(cfg.logits_dtype or
+                                                    jnp.float32))
         return nn.with_logical_constraint(logits, ('batch', 'seq', 'vocab'))

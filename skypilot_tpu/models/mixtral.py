@@ -38,6 +38,8 @@ class MixtralConfig:
     rope_theta: float = 1_000_000.0
     norm_eps: float = 1e-5
     dtype: Dtype = jnp.bfloat16
+    # LM-head logits precision; None = f32 (see llama.LlamaConfig).
+    logits_dtype: Optional[Dtype] = None
     remat: bool = False
     # Paged KV cache for serving (see llama.LlamaConfig).
     kv_page_size: int = 16
@@ -162,13 +164,15 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array, positions: jax.Array,
                  decode: bool = False,
-                 page_indices: Optional[jax.Array] = None
+                 page_indices: Optional[jax.Array] = None,
+                 prefill: bool = False
                  ) -> Tuple[jax.Array, jax.Array]:
         cfg = self.config
         lcfg = cfg.as_llama()
         x = x + llama_lib.Attention(lcfg, name='attn')(
             llama_lib.RMSNorm(cfg.norm_eps, cfg.dtype, name='attn_norm')(x),
-            positions, decode=decode, page_indices=page_indices)
+            positions, decode=decode, page_indices=page_indices,
+            prefill=prefill)
         moe_out, aux = MoEFeedForward(cfg, name='moe')(
             llama_lib.RMSNorm(cfg.norm_eps, cfg.dtype, name='moe_norm')(x))
         x = x + moe_out
@@ -184,7 +188,8 @@ class Mixtral(nn.Module):
     def __call__(self, tokens: jax.Array,
                  positions: Optional[jax.Array] = None,
                  decode: bool = False,
-                 page_indices: Optional[jax.Array] = None):
+                 page_indices: Optional[jax.Array] = None,
+                 prefill: bool = False):
         """Training: (logits, aux_loss). decode=True (serving): logits
         only — the KV-cache path of the shared llama attention, so the
         generate/continuous-batching engines drive Mixtral unchanged."""
@@ -208,7 +213,8 @@ class Mixtral(nn.Module):
         for i in range(cfg.num_layers):
             x, aux = block(cfg, name=f'layer_{i}')(x, positions,
                                                    decode=decode,
-                                                   page_indices=page_indices)
+                                                   page_indices=page_indices,
+                                                   prefill=prefill)
             total_aux = total_aux + aux
         x = llama_lib.RMSNorm(cfg.norm_eps, cfg.dtype, name='final_norm')(x)
         head = self.param(
@@ -216,10 +222,12 @@ class Mixtral(nn.Module):
             nn.with_logical_partitioning(
                 nn.initializers.normal(stddev=0.02), ('embed', 'vocab')),
             (cfg.embed_dim, cfg.vocab_size), jnp.float32)
-        # bf16 operands + f32 accumulation (same as models/gpt.py).
+        # bf16 operands; accumulation dtype from cfg.logits_dtype
+        # (None = f32 — same knob as the other families).
         logits = jnp.einsum('bse,ev->bsv', x.astype(cfg.dtype),
                             head.astype(cfg.dtype),
-                            preferred_element_type=jnp.float32)
+                            preferred_element_type=(cfg.logits_dtype or
+                                                    jnp.float32))
         logits = nn.with_logical_constraint(logits,
                                             ('batch', 'seq', 'vocab'))
         if decode:

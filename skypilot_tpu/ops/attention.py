@@ -48,7 +48,18 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         # Mismatched value dim (MLA: qk_head_dim != v_head_dim). Must
         # be decided BEFORE the ring/flash dispatch: both kernels
         # require equal q/k/v dims. einsum + f32 softmax fuses fine
-        # under XLA.
+        # under XLA — but on a seq-sharded mesh this forfeits the ring
+        # path's O(S/shards) memory guarantee, so say so (trace-time).
+        from skypilot_tpu.parallel import context as cp_context
+        if cp_context.active_seq_mesh() is not None:
+            import warnings
+            warnings.warn(
+                'context parallelism requested (seq-sharded mesh) but '
+                f'v_head_dim={v.shape[-1]} != qk_head_dim={q.shape[-1]} '
+                '(MLA): ring attention does not support unequal dims, '
+                'falling back to materialized S x S scores under GSPMD '
+                '— results are correct but per-shard attention memory '
+                'is O(S), not O(S/shards).', stacklevel=2)
         return _unequal_dims_attention(q, k, v, causal=causal)
     # Context parallelism: a seq-sharded mesh switches to ring attention.
     from skypilot_tpu.parallel import context as cp_context

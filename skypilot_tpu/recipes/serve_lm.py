@@ -60,6 +60,28 @@ def main() -> None:
 
     model, vocab_size, _ = _build_model(args.model, args.max_total_len,
                                         remat=False)
+    # Speculative decoding writes its verify chunk up to K tokens past
+    # the last kept one; fail fast / clamp at STARTUP instead of
+    # erroring inside every request handler
+    # (models/generate.py make_speculative_generate_fn asserts
+    # max_total_len + K <= model.config.max_seq_len).
+    spec_total = args.max_total_len
+    if args.speculative > 0:
+        spec_total = min(args.max_total_len,
+                         model.config.max_seq_len - args.speculative)
+        if spec_total <= 1:
+            parser.error(
+                f'--speculative {args.speculative} needs headroom in '
+                f'the model context: max_seq_len='
+                f'{model.config.max_seq_len} leaves no room for the '
+                f'verify chunk. Use a smaller K or a longer-context '
+                f'model.')
+        if spec_total < args.max_total_len:
+            print(f'speculative decoding: clamping max_total_len '
+                  f'{args.max_total_len} -> {spec_total} (verify chunk '
+                  f'needs K={args.speculative} tokens of headroom '
+                  f'below max_seq_len={model.config.max_seq_len})',
+                  flush=True)
     params = nn.meta.unbox(model.init(
         jax.random.PRNGKey(0),
         jnp.ones((1, 8), jnp.int32))['params'])
@@ -90,7 +112,7 @@ def main() -> None:
             if key not in fns:
                 if args.speculative > 0 and temperature == 0.0:
                     fns[key] = gen.make_speculative_generate_fn(
-                        model, args.max_total_len,
+                        model, spec_total,
                         draft_k=args.speculative)
                 else:
                     fns[key] = gen.make_generate_fn(
@@ -114,9 +136,12 @@ def main() -> None:
             self.wfile.write(body)
 
         def do_GET(self):  # noqa: N802
+            # Advertise the SPECULATIVE capacity when that engine will
+            # serve greedy requests — clients size prompts off this.
             self._json({'status': 'ok', 'model': args.model,
                         'vocab_size': vocab_size,
-                        'max_total_len': args.max_total_len})
+                        'max_total_len': spec_total
+                        if args.speculative > 0 else args.max_total_len})
 
         def do_POST(self):  # noqa: N802
             if self.path not in ('/generate', '/v1/generate'):
@@ -147,10 +172,16 @@ def main() -> None:
                 prompt = jnp.asarray(tokens, jnp.int32)
                 if prompt.ndim != 2:
                     raise ValueError('tokens must be [batch, prompt_len]')
-                if prompt.shape[1] >= args.max_total_len:
+                # The speculative engine serves greedy requests with a
+                # clamped total length; validate against what will
+                # actually run, not the CLI flag.
+                limit = (spec_total
+                         if args.speculative > 0 and temperature == 0.0
+                         else args.max_total_len)
+                if prompt.shape[1] >= limit:
                     raise ValueError(
                         f'prompt len {prompt.shape[1]} >= max_total_len '
-                        f'{args.max_total_len}')
+                        f'{limit}')
                 fn = get_fn(prompt.shape[0], temperature)
                 with lock:
                     rng_holder['rng'], sub = jax.random.split(

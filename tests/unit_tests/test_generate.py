@@ -370,3 +370,89 @@ def test_speculative_matches_greedy(family):
                                            ngram=2)(
             params, prompt, jax.random.PRNGKey(0))
         assert jnp.array_equal(got, want), (family, got, want)
+
+
+@pytest.mark.parametrize('family', ['llama', 'gpt', 'deepseek', 'mixtral'])
+def test_prefill_chunk_only_matches_full_cache_path(family):
+    """The prefill fast path (chunk-local S x S attention,
+    flax kwarg prefill=True) must produce the same logits and the same
+    cache contents as the general chunked path — the empty-cache
+    contract makes them mathematically identical, and subsequent
+    decode steps must continue correctly off the prefill'd cache."""
+    if family == 'llama':
+        from skypilot_tpu.models.llama import Llama, LlamaConfig
+        model = Llama(LlamaConfig.tiny(dtype=jnp.float32))
+    elif family == 'gpt':
+        from skypilot_tpu.models.gpt import GPT, GPTConfig
+        model = GPT(GPTConfig.tiny(dtype=jnp.float32))
+    elif family == 'mixtral':
+        from skypilot_tpu.models.mixtral import Mixtral, MixtralConfig
+        model = Mixtral(MixtralConfig.tiny(dtype=jnp.float32))
+    else:
+        from skypilot_tpu.models.deepseek import Deepseek, DeepseekConfig
+        model = Deepseek(DeepseekConfig.tiny(dtype=jnp.float32))
+    params = nn.meta.unbox(model.init(
+        jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))['params'])
+    prompt = jax.random.randint(jax.random.PRNGKey(7), (2, 8), 0,
+                                model.config.vocab_size, jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(8), (2, 8))
+
+    def fresh_cache():
+        cache = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((2, 1), jnp.int32),
+            positions=jnp.zeros((2, 1), jnp.int32), decode=True)['cache']
+        return jax.tree.map(jnp.zeros_like, nn.meta.unbox(cache))
+
+    logits_fast, mut_fast = model.apply(
+        {'params': params, 'cache': fresh_cache()}, prompt,
+        positions=positions, decode=True, mutable=['cache'],
+        prefill=True)
+    logits_slow, mut_slow = model.apply(
+        {'params': params, 'cache': fresh_cache()}, prompt,
+        positions=positions, decode=True, mutable=['cache'])
+    np.testing.assert_allclose(np.asarray(logits_fast),
+                               np.asarray(logits_slow),
+                               rtol=2e-4, atol=2e-4)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4),
+        mut_fast['cache'], mut_slow['cache'])
+    if family == 'mixtral':
+        # MoE expert capacity scales with seq, so decode-mode logits
+        # differ from the training forward by capacity drops — greedy-
+        # token parity is covered by
+        # test_mixtral_kv_decode_matches_full_forward; the fast-vs-slow
+        # prefill equivalence above is the contract under test here.
+        return
+    # One more decode step off the prefill'd cache matches the full
+    # forward's next-position logits.
+    nxt = jnp.full((2, 1), 3, jnp.int32)
+    step_logits, _ = model.apply(
+        {'params': params, 'cache': mut_fast['cache']}, nxt,
+        positions=jnp.full((2, 1), 8, jnp.int32), decode=True,
+        mutable=['cache'])
+    full = model.apply({'params': params},
+                       jnp.concatenate([prompt, nxt], axis=1))
+    np.testing.assert_allclose(np.asarray(step_logits[:, 0]),
+                               np.asarray(full[:, -1]),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_speculative_total_len_contract():
+    """make_speculative_generate_fn needs K tokens of headroom below
+    max_seq_len; serve_lm clamps at startup — this pins the contract
+    both ways (builds at max_seq_len - K, refuses at max_seq_len)."""
+    from skypilot_tpu.models.generate import make_speculative_generate_fn
+    from skypilot_tpu.models.llama import Llama, LlamaConfig
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    model = Llama(cfg)
+    params = nn.meta.unbox(model.init(
+        jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))['params'])
+    k = 4
+    fn = make_speculative_generate_fn(
+        model, cfg.max_seq_len - k, draft_k=k)
+    prompt = jnp.asarray([[5, 9, 2, 5, 9, 2, 5, 9]], jnp.int32)
+    out = fn(params, prompt, jax.random.PRNGKey(0))
+    assert out.shape == (1, cfg.max_seq_len - k)
+    with pytest.raises(AssertionError):
+        make_speculative_generate_fn(model, cfg.max_seq_len, draft_k=k)
