@@ -28,6 +28,9 @@ class GPTConfig:
     num_heads: int = 12
     embed_dim: int = 768
     dropout_rate: float = 0.0
+    # GPT-2's LayerNorm epsilon (HF layer_norm_epsilon); flax's default
+    # is 1e-6 — matching 1e-5 matters for HF-checkpoint parity.
+    norm_eps: float = 1e-5
     dtype: Dtype = jnp.bfloat16
     # Logits match the compute dtype unless overridden. bf16 logits
     # halve the LM head's HBM traffic — at GPT-2 scale the [B,S,50k]
@@ -204,7 +207,7 @@ class Block(nn.Module):
                  prefill: bool = False) -> jax.Array:
         cfg = self.config
         ln = lambda name: nn.LayerNorm(
-            dtype=cfg.dtype, name=name,
+            epsilon=cfg.norm_eps, dtype=cfg.dtype, name=name,
             scale_init=nn.with_logical_partitioning(
                 nn.initializers.ones_init(), ('norm',)),
             bias_init=nn.with_logical_partitioning(
@@ -266,7 +269,7 @@ class GPT(nn.Module):
                                               page_indices=page_indices,
                                               prefill=prefill)
         x = nn.LayerNorm(
-            dtype=cfg.dtype, name='ln_f',
+            epsilon=cfg.norm_eps, dtype=cfg.dtype, name='ln_f',
             scale_init=nn.with_logical_partitioning(
                 nn.initializers.ones_init(), ('norm',)),
             bias_init=nn.with_logical_partitioning(

@@ -1,0 +1,384 @@
+"""HuggingFace checkpoint import: safetensors -> flax params.
+
+Loads a locally-downloaded HF repo directory (e.g. the target of an
+`hf://` Storage COPY, `data/storage.py`) and produces (model, params)
+for the in-framework model families, so the serving stack
+(`recipes/serve_lm.py --hf`, continuous batching, speculative
+decoding) and the finetune recipes (`recipes/train_lm.py
+--init-from-hf`) run REAL checkpoints — the gap the reference fills
+with its `llm/` recipe set (reference: `llm/llama-3_1-finetuning/`,
+`llm/mixtral/`, `llm/deepseek-r1/` serve real weights; here the
+conversion is in-framework).
+
+Supported `model_type`s (config.json): `llama`, `gpt2`, `mixtral`,
+`deepseek_v2` (dense-MLP checkpoints; MoE-layer DeepSeek V2 rejects
+with a clear error). Weights are read from *.safetensors (sharded via
+model.safetensors.index.json) or pytorch_model.bin, converted to f32
+numpy (our params are f32 masters; compute casts to bf16).
+
+Convention notes (verified by logit-parity tests against the
+torch/transformers implementations, tests/unit_tests/test_hf_import.py):
+- llama/mixtral/gpt2 rope + head layouts match ours directly: HF
+  stores q/k projections pre-permuted for the half-split rotate_half
+  convention, which is what ops-level `apply_rope` implements.
+- deepseek_v2 applies INTERLEAVED rope (complex pairs (x_{2i},
+  x_{2i+1})); our `apply_rope` is half-split ((x_i, x_{i+d/2})). The
+  rope rows of `kv_a_proj_with_mqa` and of each head of the q
+  projection are permuted even-then-odd at conversion time so the
+  numerics match exactly.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+
+class HfImportError(Exception):
+    """Unsupported or malformed HF checkpoint."""
+
+
+def read_config(model_dir: str) -> Dict[str, Any]:
+    path = os.path.join(model_dir, 'config.json')
+    if not os.path.exists(path):
+        raise HfImportError(f'no config.json under {model_dir!r} — '
+                            'is this a downloaded HF model repo?')
+    with open(path, 'r', encoding='utf-8') as f:
+        return json.load(f)
+
+
+def load_state_dict(model_dir: str) -> Dict[str, np.ndarray]:
+    """All tensors as f32 numpy, from safetensors (single or sharded
+    via the index file) or a pytorch_model.bin fallback."""
+    index = os.path.join(model_dir, 'model.safetensors.index.json')
+    single = os.path.join(model_dir, 'model.safetensors')
+    out: Dict[str, np.ndarray] = {}
+    if os.path.exists(index):
+        with open(index, 'r', encoding='utf-8') as f:
+            weight_map = json.load(f)['weight_map']
+        for shard in sorted(set(weight_map.values())):
+            out.update(_load_safetensors(os.path.join(model_dir, shard)))
+        return out
+    if os.path.exists(single):
+        return _load_safetensors(single)
+    torch_bin = os.path.join(model_dir, 'pytorch_model.bin')
+    if os.path.exists(torch_bin):
+        import torch
+        sd = torch.load(torch_bin, map_location='cpu',
+                        weights_only=True)
+        return {k: v.to(torch.float32).numpy() for k, v in sd.items()}
+    raise HfImportError(
+        f'no model.safetensors[.index.json] or pytorch_model.bin '
+        f'under {model_dir!r}')
+
+
+def _load_safetensors(path: str) -> Dict[str, np.ndarray]:
+    # safetensors.numpy cannot represent bf16; go through torch (cpu).
+    from safetensors import torch as st_torch
+    import torch
+    return {k: v.to(torch.float32).numpy()
+            for k, v in st_torch.load_file(path).items()}
+
+
+def _deinterleave_rope_rows(w: np.ndarray, rope_dim: int) -> np.ndarray:
+    """Permute the LAST `rope_dim` output rows of a [out, in] weight
+    from interleaved pairs ((x0,x1),(x2,x3),...) to the half-split
+    layout ((x0,x2,...),(x1,x3,...)) our `apply_rope` expects."""
+    head, rope = w[:-rope_dim], w[-rope_dim:]
+    perm = np.concatenate([np.arange(0, rope_dim, 2),
+                           np.arange(1, rope_dim, 2)])
+    return np.concatenate([head, rope[perm]], axis=0)
+
+
+def _t(w: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(w.T)
+
+
+# ---------------------------------------------------------------------------
+# Per-family conversion. Each returns (flax module, params pytree).
+
+
+def _convert_llama_like(cfg_json: Dict[str, Any],
+                        sd: Dict[str, np.ndarray],
+                        max_seq_len: Optional[int],
+                        moe: bool, **config_overrides):
+    """Shared body for llama and mixtral (same backbone)."""
+    num_layers = cfg_json['num_hidden_layers']
+    common = dict(
+        vocab_size=cfg_json['vocab_size'],
+        max_seq_len=max_seq_len or cfg_json['max_position_embeddings'],
+        num_layers=num_layers,
+        num_heads=cfg_json['num_attention_heads'],
+        num_kv_heads=cfg_json.get('num_key_value_heads',
+                                  cfg_json['num_attention_heads']),
+        embed_dim=cfg_json['hidden_size'],
+        mlp_dim=cfg_json['intermediate_size'],
+        rope_theta=float(cfg_json.get('rope_theta', 10000.0)),
+        norm_eps=float(cfg_json.get('rms_norm_eps', 1e-5)),
+    )
+    common.update(config_overrides)
+    params: Dict[str, Any] = {
+        'tok_embed': sd['model.embed_tokens.weight'],
+        'final_norm': {'scale': sd['model.norm.weight']},
+    }
+    if cfg_json.get('tie_word_embeddings'):
+        params['lm_head'] = _t(sd['model.embed_tokens.weight'])
+    else:
+        params['lm_head'] = _t(sd['lm_head.weight'])
+    for i in range(num_layers):
+        p = f'model.layers.{i}.'
+        layer: Dict[str, Any] = {
+            'attn': {
+                'wq': {'kernel': _t(sd[p + 'self_attn.q_proj.weight'])},
+                'wk': {'kernel': _t(sd[p + 'self_attn.k_proj.weight'])},
+                'wv': {'kernel': _t(sd[p + 'self_attn.v_proj.weight'])},
+                'wo': {'kernel': _t(sd[p + 'self_attn.o_proj.weight'])},
+            },
+            'attn_norm': {'scale': sd[p + 'input_layernorm.weight']},
+        }
+        post_norm = sd[p + 'post_attention_layernorm.weight']
+        if moe:
+            n_exp = cfg_json['num_local_experts']
+            ep = p + 'block_sparse_moe.'
+            layer['moe'] = {
+                'router': {'kernel': _t(sd[ep + 'gate.weight'])},
+                # HF per-expert Linears -> stacked [E, in, out]: w1 =
+                # gate, w3 = up (both [F, D]), w2 = down ([D, F]).
+                'w_gate': np.stack([
+                    _t(sd[f'{ep}experts.{j}.w1.weight'])
+                    for j in range(n_exp)]),
+                'w_up': np.stack([
+                    _t(sd[f'{ep}experts.{j}.w3.weight'])
+                    for j in range(n_exp)]),
+                'w_down': np.stack([
+                    _t(sd[f'{ep}experts.{j}.w2.weight'])
+                    for j in range(n_exp)]),
+            }
+            layer['moe_norm'] = {'scale': post_norm}
+        else:
+            layer['mlp'] = {
+                'w_gate': {'kernel': _t(sd[p + 'mlp.gate_proj.weight'])},
+                'w_up': {'kernel': _t(sd[p + 'mlp.up_proj.weight'])},
+                'w_down': {'kernel': _t(sd[p + 'mlp.down_proj.weight'])},
+            }
+            layer['mlp_norm'] = {'scale': post_norm}
+        params[f'layer_{i}'] = layer
+    if moe:
+        from skypilot_tpu.models.mixtral import Mixtral, MixtralConfig
+        cfg = MixtralConfig(
+            num_experts=cfg_json['num_local_experts'],
+            experts_per_token=cfg_json['num_experts_per_tok'],
+            **common)
+        return Mixtral(cfg), params
+    from skypilot_tpu.models.llama import Llama, LlamaConfig
+    return Llama(LlamaConfig(**common)), params
+
+
+def _convert_llama(cfg_json, sd, max_seq_len, **overrides):
+    return _convert_llama_like(cfg_json, sd, max_seq_len, moe=False,
+                               **overrides)
+
+
+def _convert_mixtral(cfg_json, sd, max_seq_len, **overrides):
+    return _convert_llama_like(cfg_json, sd, max_seq_len, moe=True,
+                               **overrides)
+
+
+def _convert_gpt2(cfg_json, sd, max_seq_len, **overrides):
+    from skypilot_tpu.models.gpt import GPT, GPTConfig
+    num_layers = cfg_json['n_layer']
+    cfg = GPTConfig(
+        vocab_size=cfg_json['vocab_size'],
+        block_size=max_seq_len or cfg_json['n_positions'],
+        num_layers=num_layers,
+        num_heads=cfg_json['n_head'],
+        embed_dim=cfg_json['n_embd'],
+        norm_eps=float(cfg_json.get('layer_norm_epsilon', 1e-5)),
+        **overrides)
+
+    def g(key: str) -> np.ndarray:
+        # Some exports keep the 'transformer.' prefix, some drop it.
+        return sd.get('transformer.' + key, sd.get(key))
+
+    params: Dict[str, Any] = {
+        'wte': g('wte.weight'),
+        'wpe': g('wpe.weight')[:cfg.block_size],
+        'ln_f': {'scale': g('ln_f.weight'), 'bias': g('ln_f.bias')},
+    }
+    for i in range(num_layers):
+        p = f'h.{i}.'
+        # HF GPT-2 uses Conv1D ([in, out] weights) — no transpose.
+        params[f'h_{i}'] = {
+            'ln_1': {'scale': g(p + 'ln_1.weight'),
+                     'bias': g(p + 'ln_1.bias')},
+            'ln_2': {'scale': g(p + 'ln_2.weight'),
+                     'bias': g(p + 'ln_2.bias')},
+            'attn': {
+                'c_attn': {'kernel': g(p + 'attn.c_attn.weight'),
+                           'bias': g(p + 'attn.c_attn.bias')},
+                'c_proj': {'kernel': g(p + 'attn.c_proj.weight'),
+                           'bias': g(p + 'attn.c_proj.bias')},
+            },
+            'mlp': {
+                'c_fc': {'kernel': g(p + 'mlp.c_fc.weight'),
+                         'bias': g(p + 'mlp.c_fc.bias')},
+                'c_proj': {'kernel': g(p + 'mlp.c_proj.weight'),
+                           'bias': g(p + 'mlp.c_proj.bias')},
+            },
+        }
+    return GPT(cfg), params
+
+
+def _convert_deepseek(cfg_json, sd, max_seq_len, **overrides):
+    from skypilot_tpu.models.deepseek import Deepseek, DeepseekConfig
+    # (MoE DeepSeek V2 is rejected in load_hf_checkpoint, before the
+    # state dict is read.)
+    num_layers = cfg_json['num_hidden_layers']
+    rope_dim = cfg_json['qk_rope_head_dim']
+    nope_dim = cfg_json['qk_nope_head_dim']
+    num_heads = cfg_json['num_attention_heads']
+    q_lora = cfg_json.get('q_lora_rank') or 0
+    cfg = DeepseekConfig(
+        vocab_size=cfg_json['vocab_size'],
+        max_seq_len=max_seq_len or cfg_json['max_position_embeddings'],
+        num_layers=num_layers,
+        num_heads=num_heads,
+        embed_dim=cfg_json['hidden_size'],
+        mlp_dim=cfg_json['intermediate_size'],
+        kv_lora_rank=cfg_json['kv_lora_rank'],
+        q_lora_rank=q_lora,
+        rope_head_dim=rope_dim,
+        nope_head_dim=nope_dim,
+        v_head_dim=cfg_json['v_head_dim'],
+        rope_theta=float(cfg_json.get('rope_theta', 10000.0)),
+        norm_eps=float(cfg_json.get('rms_norm_eps', 1e-6)),
+        **overrides)
+
+    def fix_q(w: np.ndarray) -> np.ndarray:
+        """De-interleave the rope rows of EACH HEAD of a q projection
+        ([H * (nope+rope), in])."""
+        w = w.reshape(num_heads, nope_dim + rope_dim, -1)
+        w = np.stack([_deinterleave_rope_rows(h, rope_dim) for h in w])
+        return w.reshape(num_heads * (nope_dim + rope_dim), -1)
+
+    params: Dict[str, Any] = {
+        'tok_embed': sd['model.embed_tokens.weight'],
+        'final_norm': {'scale': sd['model.norm.weight']},
+    }
+    if cfg_json.get('tie_word_embeddings'):
+        params['lm_head'] = _t(sd['model.embed_tokens.weight'])
+    else:
+        params['lm_head'] = _t(sd['lm_head.weight'])
+    for i in range(num_layers):
+        p = f'model.layers.{i}.'
+        attn: Dict[str, Any] = {
+            # kv_a rope rows live at the END of the output: same
+            # de-interleave, on the joint [d_c + d_rope, D] weight.
+            'wkv_a': {'kernel': _t(_deinterleave_rope_rows(
+                sd[p + 'self_attn.kv_a_proj_with_mqa.weight'],
+                rope_dim))},
+            'kv_norm': {'scale': sd[p + 'self_attn.kv_a_layernorm.weight']},
+            # [H*(nope+v), d_c] -> [d_c, H, nope+v]
+            'wkv_b': _t(sd[p + 'self_attn.kv_b_proj.weight']).reshape(
+                cfg.kv_lora_rank, num_heads, nope_dim + cfg.v_head_dim),
+            'wo': {'kernel': _t(sd[p + 'self_attn.o_proj.weight'])},
+        }
+        if q_lora:
+            attn['wq_a'] = {'kernel': _t(sd[p + 'self_attn.q_a_proj.weight'])}
+            attn['q_norm'] = {'scale': sd[p + 'self_attn.q_a_layernorm.weight']}
+            attn['wq_b'] = {'kernel': _t(fix_q(
+                sd[p + 'self_attn.q_b_proj.weight']))}
+        else:
+            attn['wq'] = {'kernel': _t(fix_q(
+                sd[p + 'self_attn.q_proj.weight']))}
+        params[f'layer_{i}'] = {
+            'attn': attn,
+            'attn_norm': {'scale': sd[p + 'input_layernorm.weight']},
+            'mlp': {
+                'w_gate': {'kernel': _t(sd[p + 'mlp.gate_proj.weight'])},
+                'w_up': {'kernel': _t(sd[p + 'mlp.up_proj.weight'])},
+                'w_down': {'kernel': _t(sd[p + 'mlp.down_proj.weight'])},
+            },
+            'mlp_norm': {'scale': sd[p + 'post_attention_layernorm.weight']},
+        }
+    return Deepseek(cfg), params
+
+
+_CONVERTERS: Dict[str, Callable] = {
+    'llama': _convert_llama,
+    'mixtral': _convert_mixtral,
+    'gpt2': _convert_gpt2,
+    'deepseek_v2': _convert_deepseek,
+}
+
+
+def supported_model_types() -> Tuple[str, ...]:
+    return tuple(sorted(_CONVERTERS))
+
+
+def load_hf_checkpoint(model_dir: str, *,
+                       max_seq_len: Optional[int] = None,
+                       **config_overrides):
+    """(flax module, params) from a local HF model directory.
+
+    `max_seq_len` overrides the checkpoint's max_position_embeddings —
+    serving allocates caches of this size per slot, so clamp it to
+    what you actually serve (e.g. serve_lm passes its --max-total-len
+    budget). `config_overrides` go into the model config (e.g.
+    `dtype=jnp.float32` for CPU parity runs, `capacity_factor=...`
+    for mixtral routing capacity).
+    """
+    cfg_json = read_config(model_dir)
+    model_type = cfg_json.get('model_type')
+    conv = _CONVERTERS.get(model_type)
+    if conv is None:
+        raise HfImportError(
+            f'unsupported model_type {model_type!r}; supported: '
+            f'{", ".join(supported_model_types())}')
+    if model_type == 'deepseek_v2' and cfg_json.get('n_routed_experts'):
+        # Reject BEFORE reading gigabytes of weights.
+        raise HfImportError(
+            'DeepSeek V2 checkpoints with routed-expert (MoE) layers '
+            'are not supported yet — the in-framework deepseek family '
+            'is MLA + dense SwiGLU. Use a dense-MLP export, or the '
+            'mixtral family for MoE serving.')
+    sd = load_state_dict(model_dir)
+    model, params = conv(cfg_json, sd, max_seq_len, **config_overrides)
+    _validate_against_init(model, params)
+    return model, params
+
+
+def _validate_against_init(model, params) -> None:
+    """Converted tree must match the model's own init tree exactly
+    (same leaves, same shapes) — catches mapping drift loudly instead
+    of at apply time."""
+    import jax
+    import jax.numpy as jnp
+    import flax.linen as nn
+    ref = nn.meta.unbox(jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0),
+                           jnp.ones((1, 8), jnp.int32)))['params'])
+    ref_paths = {tuple(k.key for k in p): leaf.shape for p, leaf in
+                 jax.tree_util.tree_flatten_with_path(ref)[0]}
+    got_paths = {tuple(k.key for k in p): np.shape(leaf) for p, leaf in
+                 jax.tree_util.tree_flatten_with_path(params)[0]}
+    missing = sorted(set(ref_paths) - set(got_paths))
+    extra = sorted(set(got_paths) - set(ref_paths))
+    bad_shape = sorted(
+        (k, got_paths[k], ref_paths[k])
+        for k in set(ref_paths) & set(got_paths)
+        if tuple(got_paths[k]) != tuple(ref_paths[k]))
+    if missing or extra or bad_shape:
+        raise HfImportError(
+            f'converted params do not match the model: '
+            f'missing={missing[:5]} extra={extra[:5]} '
+            f'shape-mismatches={bad_shape[:5]}')
+
+
+def load_tokenizer(model_dir: str):
+    """transformers AutoTokenizer over the local files (no network)."""
+    from transformers import AutoTokenizer
+    return AutoTokenizer.from_pretrained(model_dir, local_files_only=True)

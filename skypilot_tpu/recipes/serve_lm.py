@@ -28,6 +28,14 @@ from typing import Dict, Tuple
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument('--model', default='llama-tiny')
+    parser.add_argument('--hf', default=None, metavar='DIR',
+                        help='serve a HuggingFace checkpoint from a '
+                             'local directory (e.g. the target of an '
+                             'hf:// storage COPY): weights are '
+                             'converted in-process '
+                             '(models/hf_import.py) and --model is '
+                             'ignored; if tokenizer files are present, '
+                             'POST /generate_text serves text in/out')
     parser.add_argument('--ckpt-dir', default=None,
                         help='orbax checkpoint to load weights from')
     parser.add_argument('--max-total-len', type=int, default=256)
@@ -58,8 +66,24 @@ def main() -> None:
     from skypilot_tpu.models import generate as gen
     from skypilot_tpu.recipes.train_lm import _build_model
 
-    model, vocab_size, _ = _build_model(args.model, args.max_total_len,
-                                        remat=False)
+    tokenizer_dir = None
+    hf_params = None
+    if args.hf:
+        from skypilot_tpu.models import hf_import
+        model, hf_params = hf_import.load_hf_checkpoint(
+            args.hf, max_seq_len=args.max_total_len)
+        hf_params = jax.tree.map(jnp.asarray, hf_params)
+        vocab_size = model.config.vocab_size
+        print(f'loaded HF checkpoint from {args.hf} '
+              f'({type(model).__name__}, vocab={vocab_size})', flush=True)
+        if any(os.path.exists(os.path.join(args.hf, f))
+               for f in ('tokenizer.json', 'tokenizer_config.json',
+                         'tokenizer.model')):
+            tokenizer_dir = args.hf
+    else:
+        model, vocab_size, _ = _build_model(args.model,
+                                            args.max_total_len,
+                                            remat=False)
     # Speculative decoding writes its verify chunk up to K tokens past
     # the last kept one; fail fast / clamp at STARTUP instead of
     # erroring inside every request handler
@@ -67,6 +91,12 @@ def main() -> None:
     # max_total_len + K <= model.config.max_seq_len).
     spec_total = args.max_total_len
     if args.speculative > 0:
+        if args.continuous_batching:
+            # The slot engine decodes one token per step; speculation
+            # does not reach it yet. Fail fast instead of silently
+            # ignoring the flag (and serving two different capacities).
+            parser.error('--speculative is not supported together with '
+                         '--continuous-batching; drop one of the flags.')
         spec_total = min(args.max_total_len,
                          model.config.max_seq_len - args.speculative)
         if spec_total <= 1:
@@ -82,9 +112,12 @@ def main() -> None:
                   f'needs K={args.speculative} tokens of headroom '
                   f'below max_seq_len={model.config.max_seq_len})',
                   flush=True)
-    params = nn.meta.unbox(model.init(
-        jax.random.PRNGKey(0),
-        jnp.ones((1, 8), jnp.int32))['params'])
+    if hf_params is not None:
+        params = hf_params
+    else:
+        params = nn.meta.unbox(model.init(
+            jax.random.PRNGKey(0),
+            jnp.ones((1, 8), jnp.int32))['params'])
     if args.ckpt_dir:
         from skypilot_tpu.parallel.checkpoints import CheckpointManager
         mgr = CheckpointManager(args.ckpt_dir)
@@ -94,6 +127,22 @@ def main() -> None:
             template = TrainState.create(params, optax.sgd(1e-3))
             params = mgr.restore(template).params
             print(f'loaded checkpoint step {mgr.latest_step()}', flush=True)
+
+    # Tokenizer, loaded lazily on the first /generate_text request.
+    tok_holder: Dict[str, object] = {}
+    tok_lock = threading.Lock()
+
+    def get_tokenizer():
+        with tok_lock:
+            if 'tok' not in tok_holder:
+                if tokenizer_dir is None:
+                    raise ValueError(
+                        'no tokenizer available: /generate_text needs '
+                        'a --hf checkpoint with tokenizer files; use '
+                        '/generate with token ids instead')
+                from skypilot_tpu.models.hf_import import load_tokenizer
+                tok_holder['tok'] = load_tokenizer(tokenizer_dir)
+            return tok_holder['tok']
 
     engine = None
     if args.continuous_batching:
@@ -106,18 +155,24 @@ def main() -> None:
     fns: Dict[Tuple[int, float], object] = {}
     lock = threading.Lock()
 
-    def get_fn(batch: int, temperature: float):
-        key = (batch, temperature)
+    def get_fn(batch: int, temperature: float, total: int = 0):
+        """One jitted fn per (batch, temperature, total-length) bucket.
+        `total` defaults to the engine's full capacity; /generate_text
+        passes a smaller bucket so a 4-token completion does not pay
+        for a full-buffer decode scan."""
+        if total <= 0:
+            total = (spec_total
+                     if args.speculative > 0 and temperature == 0.0
+                     else args.max_total_len)
+        key = (batch, temperature, total)
         with lock:
             if key not in fns:
                 if args.speculative > 0 and temperature == 0.0:
                     fns[key] = gen.make_speculative_generate_fn(
-                        model, spec_total,
-                        draft_k=args.speculative)
+                        model, total, draft_k=args.speculative)
                 else:
                     fns[key] = gen.make_generate_fn(
-                        model, args.max_total_len,
-                        temperature=temperature)
+                        model, total, temperature=temperature)
             return fns[key]
 
     rng_holder = {'rng': jax.random.PRNGKey(0)}
@@ -136,16 +191,22 @@ def main() -> None:
             self.wfile.write(body)
 
         def do_GET(self):  # noqa: N802
-            # Advertise the SPECULATIVE capacity when that engine will
-            # serve greedy requests — clients size prompts off this.
+            # Advertise the MINIMUM capacity across request classes
+            # (greedy requests run through the speculative engine at
+            # spec_total; sampled ones at max_total_len) — clients
+            # sizing prompts off this can never be rejected.
             self._json({'status': 'ok', 'model': args.model,
                         'vocab_size': vocab_size,
                         'max_total_len': spec_total
                         if args.speculative > 0 else args.max_total_len})
 
         def do_POST(self):  # noqa: N802
+            if self.path in ('/generate_text', '/v1/generate_text'):
+                self._generate_text()
+                return
             if self.path not in ('/generate', '/v1/generate'):
-                self._json({'error': 'POST /generate'}, 404)
+                self._json({'error': 'POST /generate or '
+                                     'POST /generate_text'}, 404)
                 return
             try:
                 length = int(self.headers.get('Content-Length', 0))
@@ -188,6 +249,62 @@ def main() -> None:
                         rng_holder['rng'])
                 out = fn(params, prompt, sub)
                 self._json({'tokens': jax.device_get(out).tolist()})
+            except Exception as e:  # pylint: disable=broad-except
+                self._json({'error': f'{type(e).__name__}: {e}'}, 400)
+
+        def _generate_text(self):
+            """Text in / text out, via the --hf checkpoint's tokenizer:
+            {"prompts": ["..."], "max_new_tokens": N, "temperature": t}
+            -> {"texts": ["..."]}. Each prompt runs independently
+            (continuous-batching engine when enabled, else batch-1
+            one-shot calls)."""
+            try:
+                tok = get_tokenizer()
+                length = int(self.headers.get('Content-Length', 0))
+                req = json.loads(self.rfile.read(length))
+                prompts = req['prompts']
+                if isinstance(prompts, str):
+                    prompts = [prompts]
+                temperature = float(req.get('temperature', 0.0))
+                max_new = int(req.get('max_new_tokens', 64))
+                encoded = [tok(p)['input_ids'] for p in prompts]
+                limit = (spec_total
+                         if args.speculative > 0 and temperature == 0.0
+                         else args.max_total_len)
+                for ids in encoded:
+                    if len(ids) >= limit:
+                        raise ValueError(
+                            f'prompt tokenizes to {len(ids)} >= '
+                            f'max_total_len {limit}')
+                if engine is not None:
+                    futs = [engine.submit(ids, max_new_tokens=max_new,
+                                          temperature=temperature)
+                            for ids in encoded]
+                    rows = [f.result(timeout=600) for f in futs]
+                else:
+                    rows = []
+                    for ids in encoded:
+                        # Power-of-two total-length bucket: a 4-token
+                        # completion must not pay a full-buffer decode
+                        # scan; bounded bucket count limits recompiles.
+                        want = len(ids) + max_new
+                        bucket = 8
+                        while bucket < want:
+                            bucket *= 2
+                        bucket = min(bucket, limit)
+                        fn = get_fn(1, temperature, bucket)
+                        with lock:
+                            rng_holder['rng'], sub = jax.random.split(
+                                rng_holder['rng'])
+                        out = fn(params,
+                                 jnp.asarray([ids], jnp.int32), sub)
+                        stop = min(want, bucket)
+                        rows.append(jax.device_get(out)[0][:stop]
+                                    .tolist())
+                texts = [tok.decode(row[len(ids):],
+                                    skip_special_tokens=True)
+                         for ids, row in zip(encoded, rows)]
+                self._json({'texts': texts})
             except Exception as e:  # pylint: disable=broad-except
                 self._json({'error': f'{type(e).__name__}: {e}'}, 400)
 

@@ -81,6 +81,14 @@ def main() -> None:
                         help='"synthetic" or a dir/glob of token .bin '
                              'shards')
     parser.add_argument('--ckpt-dir', default=None)
+    parser.add_argument('--init-from-hf', default=None, metavar='DIR',
+                        help='initialize weights from a local '
+                             'HuggingFace checkpoint directory (e.g. '
+                             'the target of an hf:// storage COPY) — '
+                             'the finetuning path; --model is ignored '
+                             'and the architecture comes from the '
+                             "checkpoint's config.json "
+                             '(models/hf_import.py)')
     parser.add_argument('--ckpt-every', type=int, default=50)
     parser.add_argument('--lr', type=float, default=3e-4)
     parser.add_argument('--tensor', type=int, default=1,
@@ -118,8 +126,24 @@ def main() -> None:
     if proc_id == 0:
         print(f'devices={n_dev} {mesh_lib.mesh_summary(mesh)}', flush=True)
 
-    model, vocab_size, loss_fn = _build_model(args.model, args.seq,
-                                              args.remat)
+    hf_params = None
+    if args.init_from_hf:
+        from skypilot_tpu.models import hf_import
+        model, hf_params = hf_import.load_hf_checkpoint(
+            args.init_from_hf, max_seq_len=max(args.seq, 128),
+            remat=args.remat)
+        vocab_size = model.config.vocab_size
+        from skypilot_tpu.models.mixtral import (Mixtral,
+                                                 moe_next_token_loss)
+        loss_fn = (moe_next_token_loss if isinstance(model, Mixtral)
+                   else None)
+        if proc_id == 0:
+            print(f'initializing from HF checkpoint {args.init_from_hf} '
+                  f'({type(model).__name__}, vocab={vocab_size})',
+                  flush=True)
+    else:
+        model, vocab_size, loss_fn = _build_model(args.model, args.seq,
+                                                  args.remat)
     batch = args.global_batch or 8 * n_dev
     tx = default_optimizer(learning_rate=args.lr, warmup_steps=10,
                            total_steps=max(args.steps, 20))
@@ -128,6 +152,16 @@ def main() -> None:
 
     example = jnp.zeros((batch, args.seq), jnp.int32)
     state = trainer.init(jax.random.PRNGKey(0), example)
+    if hf_params is not None:
+        # Replace the random init with the imported weights, placed
+        # with the SAME shardings the trainer chose (device_put against
+        # the initialized leaves' shardings — fsdp/tp-safe). Fresh
+        # optimizer moments are correct for a finetune start.
+        state = state.replace(params=jax.tree.map(
+            lambda init_leaf, w: jax.device_put(
+                jnp.asarray(w, init_leaf.dtype), init_leaf.sharding),
+            state.params, hf_params))
+        del hf_params
     step_fn = trainer.make_train_step(example)
 
     # Checkpoint resume (preemption recovery path).
