@@ -338,6 +338,22 @@ def load_hf_checkpoint(model_dir: str, *,
         raise HfImportError(
             f'unsupported model_type {model_type!r}; supported: '
             f'{", ".join(supported_model_types())}')
+    trained_ctx = cfg_json.get('n_positions') or cfg_json.get(
+        'max_position_embeddings')
+    if max_seq_len is not None and trained_ctx \
+            and max_seq_len > trained_ctx:
+        if model_type == 'gpt2':
+            raise HfImportError(
+                f'max_seq_len={max_seq_len} exceeds the checkpoint\'s '
+                f'trained context (n_positions={trained_ctx}) — GPT-2\'s '
+                f'absolute position embeddings cannot extrapolate. '
+                f'Serve with --max-total-len <= {trained_ctx}.')
+        import warnings
+        warnings.warn(
+            f'max_seq_len={max_seq_len} exceeds the checkpoint\'s '
+            f'trained context ({trained_ctx}): rope positions beyond '
+            f'it are untrained extrapolation — expect degraded output '
+            f'past {trained_ctx} tokens.', stacklevel=2)
     if model_type == 'deepseek_v2' and cfg_json.get('n_routed_experts'):
         # Reject BEFORE reading gigabytes of weights.
         raise HfImportError(

@@ -70,27 +70,49 @@ class MeshConfig:
 
     @classmethod
     def auto(cls, num_devices: Optional[int] = None,
-             tensor: int = 1, expert: int = 1, seq: int = 1) -> 'MeshConfig':
-        """FSDP-first auto config: all remaining devices on the fsdp axis."""
+             tensor: int = 1, expert: int = 1, seq: int = 1,
+             num_slices: int = 0) -> 'MeshConfig':
+        """FSDP-first auto config: all remaining devices on the fsdp
+        axis — except on multislice, where the data axis takes one
+        dimension per slice (dp is the DCN-tolerant axis; make_mesh
+        lays data rows onto slices). Slice count is detected from the
+        devices' slice_index when the full device set is used;
+        `num_slices` overrides."""
+        devices = jax.devices()
         if num_devices is None:
-            num_devices = len(jax.devices())
-        inner = tensor * expert * seq
+            num_devices = len(devices)
+        if not num_slices:
+            if num_devices == len(devices):
+                num_slices = len(
+                    {getattr(d, 'slice_index', 0) or 0 for d in devices})
+            else:
+                num_slices = 1
+        inner = tensor * expert * seq * num_slices
         if num_devices % inner != 0:
             raise ValueError(
                 f'{num_devices} devices not divisible by '
-                f'tensor*expert*seq={inner}')
-        return cls(data=1, fsdp=num_devices // inner, tensor=tensor,
-                   expert=expert, seq=seq)
+                f'slices*tensor*expert*seq={inner}')
+        return cls(data=num_slices, fsdp=num_devices // inner,
+                   tensor=tensor, expert=expert, seq=seq)
 
 
 def make_mesh(config: MeshConfig,
-              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+              devices: Optional[Sequence[jax.Device]] = None,
+              slice_ids: Optional[Sequence[int]] = None) -> Mesh:
     """Build a Mesh, ICI-topology-aware within a slice, DCN-aware across.
 
     Within one TPU slice, `mesh_utils.create_device_mesh` lays the mesh
     onto the physical torus so that the innermost axes (tensor) ride
     the shortest ICI paths. Across slices (or hosts without ICI), the
-    `data` axis is placed on DCN via the hybrid mesh helper.
+    `data` axis is placed on DCN: the first data-axis dimension
+    enumerates slices, so data-parallel gradient psums are the ONLY
+    collectives crossing DCN — fsdp/tensor/expert/seq all stay inside
+    a slice on ICI.
+
+    `slice_ids` (parallel to `devices`) overrides slice membership —
+    the multislice-without-multislice-hardware test path (the driver's
+    dryrun fakes two slices over CPU devices); on real TPU the
+    devices' own `slice_index` attribute is used.
     """
     if devices is None:
         devices = jax.devices()
@@ -99,17 +121,43 @@ def make_mesh(config: MeshConfig,
         raise ValueError(
             f'Mesh needs {config.num_devices} devices, got {len(devices)}.')
 
-    num_slices = len({getattr(d, 'slice_index', 0) for d in devices})
+    if slice_ids is not None:
+        if len(slice_ids) != len(devices):
+            raise ValueError(
+                f'slice_ids ({len(slice_ids)}) must parallel devices '
+                f'({len(devices)}).')
+    else:
+        slice_ids = [getattr(d, 'slice_index', 0) or 0 for d in devices]
+    num_slices = len(set(slice_ids))
     if num_slices > 1:
         # Put data-parallel (the DCN-tolerant axis) across slices.
         if config.data % num_slices != 0:
             raise ValueError(
                 f'data axis ({config.data}) must be divisible by the '
                 f'number of slices ({num_slices}) for multislice meshes.')
-        dcn_shape = [num_slices] + [1] * (len(config.shape) - 1)
+        per_slice = len(devices) // num_slices
         ici_shape = [config.data // num_slices, *config.shape[1:]]
-        device_array = mesh_utils.create_hybrid_device_mesh(
-            ici_shape, dcn_shape, devices=devices)
+        groups: Dict[int, List[jax.Device]] = {}
+        for d, sid in zip(devices, slice_ids):
+            groups.setdefault(sid, []).append(d)
+        if any(len(g) != per_slice for g in groups.values()):
+            raise ValueError(
+                f'uneven slices: {[len(g) for g in groups.values()]} '
+                f'devices per slice (need {per_slice} each).')
+        # Hybrid layout by hand (create_hybrid_device_mesh requires the
+        # real slice_index attribute, which faked slices lack): each
+        # slice gets its own ICI-aware sub-mesh, then slices stack
+        # along the leading data axis (= DCN).
+        sub_arrays = []
+        for sid in sorted(groups):
+            try:
+                sub = mesh_utils.create_device_mesh(
+                    ici_shape, devices=groups[sid])
+            except (ValueError, AssertionError):
+                sub = np.asarray(groups[sid],
+                                 dtype=object).reshape(ici_shape)
+            sub_arrays.append(sub)
+        device_array = np.concatenate(sub_arrays, axis=0)
     else:
         try:
             device_array = mesh_utils.create_device_mesh(

@@ -151,8 +151,8 @@ def main() -> None:
             model, params, num_slots=args.num_slots,
             max_total_len=args.max_total_len)
 
-    # One jitted fn per (batch, temperature) bucket.
-    fns: Dict[Tuple[int, float], object] = {}
+    # One jitted fn per (batch, temperature, total-length) bucket.
+    fns: Dict[Tuple[int, float, int], object] = {}
     lock = threading.Lock()
 
     def get_fn(batch: int, temperature: float, total: int = 0):
@@ -195,7 +195,9 @@ def main() -> None:
             # (greedy requests run through the speculative engine at
             # spec_total; sampled ones at max_total_len) — clients
             # sizing prompts off this can never be rejected.
-            self._json({'status': 'ok', 'model': args.model,
+            self._json({'status': 'ok',
+                        'model': (f'hf:{os.path.basename(args.hf)}'
+                                  if args.hf else args.model),
                         'vocab_size': vocab_size,
                         'max_total_len': spec_total
                         if args.speculative > 0 else args.max_total_len})

@@ -216,3 +216,37 @@ def test_log_shipping_to_store(isolated_state, monkeypatch, tmp_path):
     assert shipped is not None, list(store.rglob('*'))
     assert 'shipped-line' in shipped.read_text()
     core.down('t-ship')
+
+
+@pytest.mark.slow
+def test_multislice_megascale_env(isolated_state):
+    """A num_nodes=2 (two-slice) launch injects the MEGASCALE/DCN
+    bootstrap env into every host: slice count, per-host slice id,
+    and the shared coordinator address (SURVEY §2.4 megascale rows)."""
+    from skypilot_tpu import check
+    check.check(quiet=True)
+    task = sky.Task(
+        name='ms',
+        run='echo "S$MEGASCALE_SLICE_ID/N$MEGASCALE_NUM_SLICES '
+            'W$TPU_WORKER_ID C=$MEGASCALE_COORDINATOR_ADDRESS '
+            'R$SKYPILOT_NODE_RANK/$SKYPILOT_NUM_NODES"',
+        num_nodes=2)
+    task.set_resources(sky.Resources(infra='local',
+                                     accelerators='tpu-v5e-8'))
+    job_id, handle = sky.launch(task, cluster_name='t-ms',
+                                _quiet_optimizer=True)
+    try:
+        agent = handle.agent()
+        status = agent.wait_job(job_id, timeout=120)
+        assert status == job_lib.JobStatus.SUCCEEDED
+        logs = ''.join(agent.stream_job_logs(job_id, follow=False))
+        # Both slices report, worker id restarts per slice, one shared
+        # coordinator, global ranks span the slices.
+        assert 'S0/N2 W0 C=127.0.0.1' in logs, logs
+        assert 'S1/N2 W0 C=127.0.0.1' in logs, logs
+        assert 'R0/2' in logs and 'R1/2' in logs, logs
+    finally:
+        try:
+            core.down('t-ms')
+        except Exception:  # pylint: disable=broad-except
+            pass

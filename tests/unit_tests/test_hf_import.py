@@ -181,3 +181,25 @@ def test_sharded_safetensors(tmp_path, tokens):
     np.testing.assert_allclose(
         _logits_ours(model, params, tokens), _logits_torch(tmodel, tokens),
         rtol=2e-4, atol=2e-4)
+
+
+def test_max_seq_len_exceeding_trained_context(tmp_path):
+    """GPT-2 (absolute positions) refuses an oversized override with a
+    clear message; rope families warn about extrapolation."""
+    cfg = transformers.GPT2Config(
+        vocab_size=128, n_positions=64, n_embd=64, n_layer=1, n_head=4)
+    transformers.GPT2LMHeadModel(cfg).eval().save_pretrained(
+        tmp_path, safe_serialization=True)
+    with pytest.raises(hf_import.HfImportError, match='cannot extrapolate'):
+        hf_import.load_hf_checkpoint(str(tmp_path), max_seq_len=128)
+
+    llama_dir = tmp_path / 'llama'
+    cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=1, num_attention_heads=4,
+        num_key_value_heads=4, max_position_embeddings=64,
+        tie_word_embeddings=False)
+    transformers.LlamaForCausalLM(cfg).eval().save_pretrained(
+        llama_dir, safe_serialization=True)
+    with pytest.warns(UserWarning, match='untrained extrapolation'):
+        hf_import.load_hf_checkpoint(str(llama_dir), max_seq_len=128)

@@ -1,0 +1,150 @@
+"""Multislice: hybrid DCN mesh layout + the MEGASCALE env contract.
+
+SURVEY §2.4 (megascale rows): cross-slice gang = N slices created
+together; collective bootstrap across slices rides MEGASCALE_* env
+over DCN. These tests pin (a) the mesh layout invariant — the data
+axis enumerates slices so dp gradient psums are the only DCN
+collectives — and (b) the codegen env contract every host of every
+slice receives.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.parallel import mesh as mesh_lib
+
+
+def _devices(n):
+    return jax.devices('cpu')[:n]
+
+
+def test_faked_slices_layout():
+    devices = _devices(8)
+    mesh = mesh_lib.make_mesh(
+        mesh_lib.MeshConfig(data=2, fsdp=4), devices=devices,
+        slice_ids=[0, 0, 0, 0, 1, 1, 1, 1])
+    assert mesh.devices.shape == (2, 4, 1, 1, 1)
+    # data row r == slice r, exactly.
+    assert set(mesh.devices[0].flatten()) == set(devices[:4])
+    assert set(mesh.devices[1].flatten()) == set(devices[4:])
+
+
+def test_faked_slices_interleaved_ids():
+    """Slice membership comes from the ids, not device order."""
+    devices = _devices(8)
+    mesh = mesh_lib.make_mesh(
+        mesh_lib.MeshConfig(data=2, fsdp=4), devices=devices,
+        slice_ids=[0, 1, 0, 1, 0, 1, 0, 1])
+    assert set(mesh.devices[0].flatten()) == set(devices[0::2])
+    assert set(mesh.devices[1].flatten()) == set(devices[1::2])
+
+
+def test_data_axis_must_cover_slices():
+    with pytest.raises(ValueError, match='divisible by the number'):
+        mesh_lib.make_mesh(
+            mesh_lib.MeshConfig(data=1, fsdp=8), devices=_devices(8),
+            slice_ids=[0] * 4 + [1] * 4)
+
+
+def test_uneven_slices_rejected():
+    with pytest.raises(ValueError, match='uneven'):
+        mesh_lib.make_mesh(
+            mesh_lib.MeshConfig(data=2, fsdp=4), devices=_devices(8),
+            slice_ids=[0] * 6 + [1] * 2)
+
+
+def test_multislice_train_step_runs():
+    """A dp(dcn) x fsdp train step executes on the hybrid mesh and
+    matches the single-slice loss (same devices, same math)."""
+    from skypilot_tpu.models.gpt import GPT, GPTConfig
+    from skypilot_tpu.parallel.train import ShardedTrainer, shard_batch
+    devices = _devices(8)
+    tokens = jnp.ones((8, 32), jnp.int32)
+    losses = []
+    for slice_ids in (None, [0] * 4 + [1] * 4):
+        mesh = mesh_lib.make_mesh(
+            mesh_lib.MeshConfig(data=2, fsdp=4), devices=devices,
+            slice_ids=slice_ids)
+        trainer = ShardedTrainer(GPT(GPTConfig.tiny()), mesh)
+        state = trainer.init(jax.random.PRNGKey(0), tokens)
+        _, loss = trainer.make_train_step(tokens)(
+            state, shard_batch(tokens, mesh))
+        losses.append(float(loss))
+    np.testing.assert_allclose(losses[0], losses[1], rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Codegen: the per-host MEGASCALE/JAX env contract for a 2-slice task.
+
+
+def _fake_cluster_info(num_slices, hosts_per_slice):
+    from skypilot_tpu.provision import common
+    instances = [
+        common.InstanceInfo(
+            instance_id=f'i-{node}-{h}', internal_ip=f'10.0.{node}.{h}',
+            external_ip=None, node_rank=node, host_rank=h)
+        for node in range(num_slices) for h in range(hosts_per_slice)]
+    return common.ClusterInfo(
+        instances=instances, head_instance_id='i-0-0',
+        provider_name='local')
+
+
+def test_codegen_megascale_env_contract():
+    import skypilot_tpu as sky
+    from skypilot_tpu.backends import task_codegen
+
+    task = sky.Task(run='echo hi', num_nodes=2)
+    res = sky.Resources(infra='gcp', accelerators='tpu-v5e-16')
+    spec = task_codegen.build_job_spec(
+        task, res, _fake_cluster_info(num_slices=2, hosts_per_slice=2))
+
+    env = spec['env']
+    # Multislice bootstrap: every host learns the slice count and the
+    # DCN coordinator (rank-0 host of slice 0).
+    assert env['MEGASCALE_NUM_SLICES'] == '2'
+    assert env['MEGASCALE_COORDINATOR_ADDRESS'] == '10.0.0.0'
+    # Global JAX process world spans all hosts of all slices.
+    assert env['SKYPILOT_NUM_NODES'] == '4'
+    assert env['JAX_NUM_PROCESSES'] == '4'
+    assert env['JAX_COORDINATOR_ADDRESS'].startswith('10.0.0.0:')
+
+    per_rank = spec['per_rank_env']
+    assert len(per_rank) == 4
+    for rank, rank_env in enumerate(per_rank):
+        node, host = divmod(rank, 2)
+        assert rank_env['SKYPILOT_NODE_RANK'] == str(rank)
+        assert rank_env['JAX_PROCESS_ID'] == str(rank)
+        # Slice-local identity: worker id restarts per slice; the
+        # slice id is the MEGASCALE coordinate.
+        assert rank_env['TPU_WORKER_ID'] == str(host)
+        assert rank_env['MEGASCALE_SLICE_ID'] == str(node)
+        hostnames = rank_env['TPU_WORKER_HOSTNAMES'].split(',')
+        assert hostnames == [f'10.0.{node}.0', f'10.0.{node}.1']
+
+
+def test_codegen_single_slice_has_no_megascale_env():
+    import skypilot_tpu as sky
+    from skypilot_tpu.backends import task_codegen
+
+    task = sky.Task(run='echo hi', num_nodes=1)
+    res = sky.Resources(infra='gcp', accelerators='tpu-v5e-16')
+    spec = task_codegen.build_job_spec(
+        task, res, _fake_cluster_info(num_slices=1, hosts_per_slice=2))
+    assert 'MEGASCALE_NUM_SLICES' not in spec['env']
+    for rank_env in spec['per_rank_env']:
+        assert 'MEGASCALE_SLICE_ID' not in rank_env
+
+
+def test_auto_config_multislice():
+    """MeshConfig.auto puts one data dimension per slice (dp over DCN,
+    the rest FSDP inside a slice)."""
+    cfg = mesh_lib.MeshConfig.auto(8, num_slices=2)
+    assert (cfg.data, cfg.fsdp) == (2, 4)
+    cfg = mesh_lib.MeshConfig.auto(8, tensor=2, num_slices=2)
+    assert (cfg.data, cfg.fsdp, cfg.tensor) == (2, 2, 2)
+    # Single-slice behavior unchanged.
+    cfg = mesh_lib.MeshConfig.auto(8)
+    assert (cfg.data, cfg.fsdp) == (1, 8)
+    with pytest.raises(ValueError, match='not divisible'):
+        mesh_lib.MeshConfig.auto(8, num_slices=3)
