@@ -47,6 +47,14 @@ def main() -> None:
     parser.add_argument('--max-total-len', type=int, default=64)
     parser.add_argument('--max-new-tokens', type=int, default=24)
     parser.add_argument('--num-slots', type=int, default=8)
+    parser.add_argument('--speculative', type=int, default=0,
+                        metavar='K', help='prompt-lookup speculation '
+                        '(works with both engines)')
+    parser.add_argument('--repetitive', action='store_true',
+                        help='structured (repeated-trigram) prompts — '
+                             'the regime speculation accelerates')
+    parser.add_argument('--hf', default=None,
+                        help='serve a local HF checkpoint directory')
     parser.add_argument('--ckpt-dir', default=None)
     parser.add_argument('--cpu', action='store_true',
                         help='pin the server to the CPU backend')
@@ -59,6 +67,10 @@ def main() -> None:
     if args.engine == 'continuous':
         cmd += ['--continuous-batching', '--num-slots',
                 str(args.num_slots)]
+    if args.speculative:
+        cmd += ['--speculative', str(args.speculative)]
+    if args.hf:
+        cmd += ['--hf', args.hf]
     if args.ckpt_dir:
         cmd += ['--ckpt-dir', args.ckpt_dir]
     if args.cpu:
@@ -84,9 +96,19 @@ def main() -> None:
         vocab = int(info['vocab_size'])
 
         rng = random.Random(0)
-        prompts = [[rng.randrange(1, vocab)
-                    for _ in range(rng.randrange(4, 16))]
-                   for _ in range(args.requests)]
+        if args.repetitive:
+            # Structured prompts (repeated trigrams): the shape
+            # prompt-lookup speculation exploits — code, templated
+            # text, retrieval contexts.
+            def rep_prompt():
+                gram = [rng.randrange(1, vocab) for _ in range(3)]
+                n = rng.randrange(4, 16)
+                return (gram * ((n + 2) // 3))[:n]
+            prompts = [rep_prompt() for _ in range(args.requests)]
+        else:
+            prompts = [[rng.randrange(1, vocab)
+                        for _ in range(rng.randrange(4, 16))]
+                       for _ in range(args.requests)]
         # Warm the compile caches (both prefill buckets + decode).
         requests.post(f'{url}/generate', json={
             'tokens': [prompts[0]], 'max_new_tokens': 2}, timeout=600)
@@ -127,7 +149,8 @@ def main() -> None:
         ttfts = sorted(l[0] for l in latencies)
         print(json.dumps({
             'engine': args.engine,
-            'model': args.model,
+            'speculative': args.speculative,
+            'model': info['model'],   # server-reported (handles --hf)
             'requests': len(latencies),
             'concurrency': args.concurrency,
             'req_per_sec': round(len(latencies) / elapsed, 2),

@@ -3,12 +3,15 @@
 JetStream-shaped, TPU-first: all device work is fixed-shape jitted
 functions. A fixed pool of `num_slots` decode slots shares one KV
 cache; requests prefill into a free slot (prompt lengths bucketed to
-limit recompiles) and then ride the shared one-token-per-step decode
-loop, leaving as they finish — new requests join WITHOUT waiting for
-the batch to drain, which is what lifts serving throughput under
-ragged request lengths (the reference orchestrates external engines
-with this property; here the engine is in-framework, over
-models/llama.py's per-row-position KV cache).
+limit recompiles) and then ride the shared decode loop, leaving as
+they finish — new requests join WITHOUT waiting for the batch to
+drain, which is what lifts serving throughput under ragged request
+lengths (the reference orchestrates external engines with this
+property; here the engine is in-framework, over models/llama.py's
+per-row-position KV cache). With `speculative_k > 0` the loop runs
+prompt-lookup verify chunks instead of single tokens: every slot
+(greedy and sampled, paged and dense) commits 1..K+1 tokens per model
+call, exactly preserving the non-speculative output distribution.
 
 Use via `ContinuousBatchingEngine.submit(prompt) -> Future`, or the
 HTTP server in recipes/serve_lm.py (--continuous-batching).
@@ -40,14 +43,26 @@ class ContinuousBatchingEngine:
     def __init__(self, model, params, *, num_slots: int = 8,
                  max_total_len: int = 256, temperature: float = 0.0,
                  eos_id: Optional[int] = None,
-                 paged: Optional[bool] = None) -> None:
+                 paged: Optional[bool] = None,
+                 speculative_k: int = 0, spec_ngram: int = 2) -> None:
         assert max_total_len <= model.config.max_seq_len
+        if speculative_k:
+            # Verification chunks write up to K past the last kept
+            # token — same headroom contract as the one-shot
+            # speculative engine (models/generate.py).
+            assert max_total_len + speculative_k <= \
+                model.config.max_seq_len, (
+                    f'speculative_k={speculative_k} needs headroom: '
+                    f'max_total_len({max_total_len}) + K must be <= '
+                    f'max_seq_len({model.config.max_seq_len})')
         self.model = model
         self.params = params
         self.num_slots = num_slots
         self.max_total_len = max_total_len
         self.temperature = temperature
         self.eos_id = eos_id
+        self.spec_k = speculative_k
+        self.spec_ngram = spec_ngram
 
         # Paged KV cache (vLLM-style; ops/paged_attention.py): K/V live
         # in a shared physical page pool sized for the AGGREGATE live
@@ -57,8 +72,11 @@ class ContinuousBatchingEngine:
         # pool can hold a full-depth sequence.
         cfg_page = getattr(model.config, 'kv_page_size', 0)
         cfg_pool = getattr(model.config, 'kv_total_pages', 0)
+        # Speculative chunks write K tokens past the last committed
+        # one: the pool and each row's page table carry that headroom.
         pool_ok = (cfg_page > 0 and cfg_pool > 0 and
-                   (cfg_pool - 1) * cfg_page >= max_total_len)
+                   (cfg_pool - 1) * cfg_page >=
+                   max_total_len + self.spec_k)
         if paged is None:
             # Auto-on only when the pool can hold at least ONE
             # full-depth sequence — a small default pool must not
@@ -70,13 +88,15 @@ class ContinuousBatchingEngine:
                 f'paged=True but kv_total_pages={cfg_pool} x '
                 f'kv_page_size={cfg_page} cannot hold one '
                 f'max_total_len={max_total_len} sequence '
-                f'(usable {(max(cfg_pool - 1, 0)) * cfg_page} tokens; '
+                f'(+{self.spec_k} speculative headroom; usable '
+                f'{(max(cfg_pool - 1, 0)) * cfg_page} tokens; '
                 f'page 0 is reserved).')
         self.paged = paged
         if self.paged:
             self.page_size = cfg_page
             self.total_pages = cfg_pool
-            self.pages_per_seq = -(-max_total_len // self.page_size)
+            self.pages_per_seq = -(
+                -(max_total_len + self.spec_k) // self.page_size)
 
         # _fresh_cache is the single paging-reset point (also the
         # error-recovery path).
@@ -91,6 +111,11 @@ class ContinuousBatchingEngine:
         self.limits = np.zeros((num_slots,), np.int32)
         self.temps = np.zeros((num_slots,), np.float32)
 
+        # Observability: model calls vs tokens committed (speculation
+        # quality = tokens_committed / decode_calls, 1.0..K+1).
+        self.decode_calls = 0
+        self.tokens_committed = 0
+
         self._queue: 'queue.Queue' = queue.Queue()
         # FCFS admission order, owned by the scheduler thread: requests
         # drain from _queue into _ready; a stalled (page-pressure) or
@@ -99,7 +124,8 @@ class ContinuousBatchingEngine:
         self._ready: 'collections.deque' = collections.deque()
         self._rng = jax.random.PRNGKey(0)
         self._prefill_fns: Dict[int, Any] = {}
-        self._decode = self._make_decode_fn()
+        self._decode = (self._make_spec_decode_fn() if self.spec_k
+                        else self._make_decode_fn())
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
@@ -164,6 +190,70 @@ class ContinuousBatchingEngine:
 
         return decode
 
+    def _make_spec_decode_fn(self):
+        """Verification step for prompt-lookup speculation: a
+        [slots, K+1] chunk ([current, draft_1..draft_K] per row) runs
+        through the model's chunked decode path in ONE call (paged:
+        write_kv_chunk + paged_chunk_attention; dense:
+        chunked_cache_attention) — between 1 and K+1 tokens commit per
+        model call. Returns the model's own next-token choice at every
+        chunk position; acceptance is computed host-side.
+
+        Sampling stays EXACT: position t's token is sampled from
+        p(. | prefix, draft_<t), and the host only commits it while
+        every earlier draft matched the model's choice — so each
+        committed token was sampled from the true conditional of the
+        committed prefix (greedy is the temperature-0 special case).
+        """
+        model = self.model
+        paged = self.paged
+        k = self.spec_k
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def spec_decode(params, cache, chunk, pos, temps, rng,
+                        page_indices=None):
+            positions = pos[:, None] + jnp.arange(k + 1)[None, :]
+            extra = {'page_indices': page_indices} if paged else {}
+            logits, mutated = model.apply(
+                {'params': params, 'cache': cache}, chunk,
+                positions=positions, decode=True, mutable=['cache'],
+                **extra)                                   # [B, K+1, V]
+            scaled = logits / jnp.maximum(temps, 1e-6)[:, None, None]
+            sampled = jax.random.categorical(rng, scaled, axis=-1)
+            greedy = jnp.argmax(logits, axis=-1)
+            out = jnp.where(temps[:, None] > 0, sampled, greedy)
+            return mutated['cache'], out.astype(jnp.int32)
+
+        return spec_decode
+
+    def _draft(self) -> 'np.ndarray':
+        """Host-side prompt-lookup drafts [slots, K]: for each active
+        slot, the K tokens that followed the most recent earlier
+        occurrence of the trailing `spec_ngram` (context = committed
+        output ++ pending current token); no match (or inactive) =
+        repeat the last token (worst case: 1 commit per step, same as
+        plain decode)."""
+        k, ngram = self.spec_k, self.spec_ngram
+        drafts = np.zeros((self.num_slots, k), np.int32)
+        for slot in range(self.num_slots):
+            if not self.active[slot]:
+                continue
+            ctx = self.outputs[slot] + [int(self.cur_token[slot])]
+            last = ctx[-1]
+            drafts[slot, :] = last
+            if len(ctx) <= ngram:
+                continue
+            pattern = ctx[-ngram:]
+            # Most recent strictly-earlier occurrence of the pattern.
+            for start in range(len(ctx) - ngram - 1, -1, -1):
+                if ctx[start:start + ngram] == pattern:
+                    cont = ctx[start + ngram:start + ngram + k]
+                    if cont:
+                        drafts[slot, :len(cont)] = cont
+                        drafts[slot, len(cont):] = cont[-1]
+                    break
+        return drafts
+
     def _prefill_fn(self, bucket_len: int):
         """fn(params, cache, slot, prompt[P], plen) -> (cache, next_tok).
 
@@ -190,11 +280,13 @@ class ContinuousBatchingEngine:
                 # forward pass; the model writes K/V for every
                 # position (write_kv_chunk). Junk past plen lands in
                 # allocated-but-masked slots or the trash page.
+                # prefill=True: the sequence starts empty, attention
+                # stays chunk-local.
                 logits, mutated = model.apply(
                     {'params': params, 'cache': cache},
                     prompt[None, :], positions=positions,
                     decode=True, mutable=['cache'],
-                    page_indices=page_row)
+                    page_indices=page_row, prefill=True)
                 # The continuation samples from the LAST REAL prompt
                 # position, not the padded tail.
                 last = jax.lax.dynamic_index_in_dim(
@@ -364,39 +456,48 @@ class ContinuousBatchingEngine:
             self.outputs[slot] = list(prompt)
             limit = min(plen + max_new, self.max_total_len)
             if self.paged:
-                # The pool bounds the deepest any sequence can get;
-                # admission would otherwise hand out a limit the
-                # allocator can never satisfy even running alone.
-                limit = min(limit,
-                            (self.total_pages - 1) * self.page_size)
+                # The pool bounds the deepest any sequence can get
+                # (minus speculative lookahead writes); admission would
+                # otherwise hand out a limit the allocator can never
+                # satisfy even running alone.
+                limit = min(limit, (self.total_pages - 1) *
+                            self.page_size - self.spec_k)
             self.limits[slot] = limit
             self.temps[slot] = temp
             self.active[slot] = True
             admitted = True
         return admitted
 
-    def _grow_pages(self) -> None:
+    def _grow_pages(self, lookahead: int = 1) -> None:
         """Before a decode step: every active slot about to write past
-        its allocated tokens gets one more page. On pool exhaustion
-        the slot is PREEMPTED vLLM-style: its pages are released and
-        the request re-queued with everything generated so far as the
-        new prompt (recompute on re-admission), so page pressure
-        stalls work instead of failing it. Requests that can never fit
-        the pool fail loudly at admission. Sampled (temperature>0)
-        requests may diverge across a preemption (fresh RNG);
-        greedy decoding is unaffected."""
+        its allocated tokens gets more pages (speculative chunks write
+        `lookahead` tokens at once). On pool exhaustion the slot is
+        PREEMPTED vLLM-style: its pages are released and the request
+        re-queued with everything generated so far as the new prompt
+        (recompute on re-admission), so page pressure stalls work
+        instead of failing it. Requests that can never fit the pool
+        fail loudly at admission. Sampled (temperature>0) requests may
+        diverge across a preemption (fresh RNG); greedy decoding is
+        unaffected."""
         preempted = []
         for slot in range(self.num_slots):
             if not self.active[slot]:
                 continue
-            if int(self.pos[slot]) < int(self.allocated_tokens[slot]):
-                continue
-            logical = int(self.pos[slot]) // self.page_size
-            if self.allocator.can_allocate(1):
+            need_tokens = int(self.pos[slot]) + lookahead
+            exhausted = False
+            while int(self.allocated_tokens[slot]) < need_tokens:
+                # Allocation is logically contiguous: the next logical
+                # page index == pages already allocated.
+                logical = int(self.allocated_tokens[slot]) \
+                    // self.page_size
+                if not self.allocator.can_allocate(1):
+                    exhausted = True
+                    break
                 page = self.allocator.allocate(1)[0]
                 self.owned_pages[slot].append(page)
                 self.page_table[slot, logical] = page
                 self.allocated_tokens[slot] += self.page_size
+            if not exhausted:
                 continue
             # Preempt: outputs-so-far become the prompt; the pending
             # cur_token is regenerated by the re-prefill.
@@ -416,7 +517,22 @@ class ContinuousBatchingEngine:
         # would reverse it — an FCFS fairness inversion).
         self._ready.extendleft(reversed(preempted))
 
+    def _finish_slot(self, slot: int) -> None:
+        fut = self.futures[slot]
+        self.futures[slot] = None
+        self.active[slot] = False
+        if self.paged:
+            self.allocator.release(self.owned_pages[slot])
+            self.owned_pages[slot] = []
+            self.page_table[slot, :] = 0
+            self.allocated_tokens[slot] = 0
+        if fut is not None:
+            fut.set_result(list(self.outputs[slot]))
+
     def _decode_step(self) -> None:
+        if self.spec_k:
+            self._spec_decode_step()
+            return
         self._rng, sub = jax.random.split(self._rng)
         extra = ()
         if self.paged:
@@ -432,24 +548,65 @@ class ContinuousBatchingEngine:
             jnp.asarray(self.cur_token), jnp.asarray(self.pos),
             jnp.asarray(self.temps), sub, *extra)
         sampled = np.asarray(jax.device_get(sampled))
+        self.decode_calls += 1
         for slot in range(self.num_slots):
             if not self.active[slot]:
                 continue
             tok = int(self.cur_token[slot])
             self.outputs[slot].append(tok)
+            self.tokens_committed += 1
             self.pos[slot] += 1
             self.cur_token[slot] = int(sampled[slot])
             done = len(self.outputs[slot]) >= int(self.limits[slot])
             if self.eos_id is not None and tok == self.eos_id:
                 done = True
             if done:
-                fut = self.futures[slot]
-                self.futures[slot] = None
-                self.active[slot] = False
-                if self.paged:
-                    self.allocator.release(self.owned_pages[slot])
-                    self.owned_pages[slot] = []
-                    self.page_table[slot, :] = 0
-                    self.allocated_tokens[slot] = 0
-                if fut is not None:
-                    fut.set_result(list(self.outputs[slot]))
+                self._finish_slot(slot)
+
+    def _spec_decode_step(self) -> None:
+        """One speculative round: draft K tokens per slot (host-side
+        prompt lookup), verify the whole [current ++ drafts] chunk in
+        ONE model call, commit the model-confirmed prefix — 1..K+1
+        tokens per call. Rejected drafts leave stale cache entries
+        above the new position; the next chunk overwrites them before
+        attending (the chunked-attention write-before-read contract)."""
+        k = self.spec_k
+        drafts = self._draft()                         # [slots, K]
+        extra = ()
+        if self.paged:
+            # The chunk writes positions pos..pos+K: allocate K+1 ahead.
+            self._grow_pages(lookahead=k + 1)
+            if not self.active.any():
+                return
+            extra = (jnp.asarray(self.page_table),)
+        chunk = np.concatenate([self.cur_token[:, None], drafts], axis=1)
+        self._rng, sub = jax.random.split(self._rng)
+        self.cache, y = self._decode(
+            self.params, self.cache, jnp.asarray(chunk),
+            jnp.asarray(self.pos), jnp.asarray(self.temps), sub, *extra)
+        y = np.asarray(jax.device_get(y))              # [slots, K+1]
+        self.decode_calls += 1
+        for slot in range(self.num_slots):
+            if not self.active[slot]:
+                continue
+            accept = 0
+            while (accept < k and
+                   int(drafts[slot, accept]) == int(y[slot, accept])):
+                accept += 1
+            # Commit: the pending current token, then every accepted
+            # draft; each commit's successor is the model's own token
+            # for that position (y), so the final pending token is the
+            # first correction.
+            commits = [int(self.cur_token[slot])]
+            commits += [int(t) for t in drafts[slot, :accept]]
+            for tok, nxt in zip(commits, y[slot, :accept + 1]):
+                self.outputs[slot].append(tok)
+                self.tokens_committed += 1
+                self.pos[slot] += 1
+                self.cur_token[slot] = int(nxt)
+                done = len(self.outputs[slot]) >= int(self.limits[slot])
+                if self.eos_id is not None and tok == self.eos_id:
+                    done = True
+                if done:
+                    self._finish_slot(slot)
+                    break

@@ -104,10 +104,9 @@ class CausalSelfAttention(nn.Module):
                                   cfg.dtype))
 
         if decode and seq > 1:
-            # CHUNKED decode (same contract as models/llama.py): paged
-            # path = chunked prefill (empty sequence, arange positions);
-            # dense path = chunked attention at arbitrary per-row
-            # offsets (prefill + speculative verification chunks).
+            # CHUNKED decode (same contract as models/llama.py):
+            # `prefill` (static) = chunk-local attention; otherwise the
+            # chunk attends the full history (speculative verification).
             assert positions is not None
             if page_indices is not None:
                 from skypilot_tpu.ops import paged_attention as paged_ops
@@ -115,8 +114,13 @@ class CausalSelfAttention(nn.Module):
                 k_pages.value, v_pages.value = paged_ops.write_kv_chunk(
                     k_pages.value, v_pages.value, k, v, positions,
                     page_indices)
-                out = attention_ops.dot_product_attention(q, k, v,
-                                                          causal=True)
+                if prefill:
+                    out = attention_ops.dot_product_attention(
+                        q, k, v, causal=True)
+                else:
+                    out = paged_ops.paged_chunk_attention(
+                        q, k_pages.value, v_pages.value, positions,
+                        page_indices).astype(cfg.dtype)
             else:
                 cached_k = self.variable(
                     'cache', 'cached_key', jnp.zeros,

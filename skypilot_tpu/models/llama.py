@@ -127,19 +127,24 @@ class Attention(nn.Module):
                                   cfg.dtype))
 
         if decode and seq > 1:
-            # CHUNKED decode: many tokens in one forward pass. Paged
-            # path = chunked PREFILL only (contract: sequence starts
-            # empty, positions arange per row). Dense path = chunked
-            # attention at arbitrary per-row offsets — prefill AND
-            # speculative-decoding verification chunks.
+            # CHUNKED decode: many tokens in one forward pass, both
+            # paged and dense — `prefill` (static) selects chunk-local
+            # attention (empty-cache contract, flash-eligible);
+            # otherwise the chunk attends the full history (speculative
+            # verification chunks at arbitrary per-row offsets).
             if page_indices is not None:
                 from skypilot_tpu.ops import paged_attention as paged_ops
                 k_pages, v_pages = _page_vars()
                 k_pages.value, v_pages.value = paged_ops.write_kv_chunk(
                     k_pages.value, v_pages.value, k, v, positions,
                     page_indices)
-                out = attention_ops.dot_product_attention(q, k, v,
-                                                          causal=True)
+                if prefill:
+                    out = attention_ops.dot_product_attention(
+                        q, k, v, causal=True)
+                else:
+                    out = paged_ops.paged_chunk_attention(
+                        q, k_pages.value, v_pages.value, positions,
+                        page_indices).astype(cfg.dtype)
             else:
                 cached_k = self.variable(
                     'cache', 'cached_key', jnp.zeros,

@@ -75,16 +75,16 @@ def paged_decode_attention(q: jax.Array, k_pages: jax.Array,
                                       page_indices)
 
 
-def _reference_paged_attention(q: jax.Array, k_pages: jax.Array,
-                               v_pages: jax.Array, lengths: jax.Array,
-                               page_indices: jax.Array) -> jax.Array:
-    """Pure-XLA semantics: gather each row's pages, masked softmax."""
+def _gather_kv(q_heads: int, k_pages: jax.Array, v_pages: jax.Array,
+               page_indices: jax.Array
+               ) -> Tuple[jax.Array, jax.Array]:
+    """Per-row page gather + GQA head expansion: the shared read side
+    of every XLA paged-attention path. Returns k/v as [B, T, Hq, D]
+    where T = pages_per_seq * page_size."""
     num_kv_heads, _, page_size, head_dim = k_pages.shape
-    batch, num_q_heads, _ = q.shape
-    pages_per_seq = page_indices.shape[1]
-    max_len = pages_per_seq * page_size
+    max_len = page_indices.shape[1] * page_size
 
-    # [B, Hkv, pages, page, D] -> [B, T, Hkv, D]
+    # [Hkv, pages, page, D] -> [T, Hkv, D], per row.
     def gather_row(pages, idx):
         g = pages[:, idx]                       # [Hkv, pages, page, D]
         g = jnp.swapaxes(g, 0, 1)               # [pages, Hkv, page, D]
@@ -93,11 +93,20 @@ def _reference_paged_attention(q: jax.Array, k_pages: jax.Array,
 
     k_all = jax.vmap(gather_row, in_axes=(None, 0))(k_pages, page_indices)
     v_all = jax.vmap(gather_row, in_axes=(None, 0))(v_pages, page_indices)
-
-    if num_q_heads != num_kv_heads:
-        rep = num_q_heads // num_kv_heads
+    if q_heads != num_kv_heads:
+        rep = q_heads // num_kv_heads
         k_all = jnp.repeat(k_all, rep, axis=2)
         v_all = jnp.repeat(v_all, rep, axis=2)
+    return k_all, v_all
+
+
+def _reference_paged_attention(q: jax.Array, k_pages: jax.Array,
+                               v_pages: jax.Array, lengths: jax.Array,
+                               page_indices: jax.Array) -> jax.Array:
+    """Pure-XLA semantics: gather each row's pages, masked softmax."""
+    head_dim = k_pages.shape[-1]
+    max_len = page_indices.shape[1] * k_pages.shape[2]
+    k_all, v_all = _gather_kv(q.shape[1], k_pages, v_pages, page_indices)
 
     scale = 1.0 / (head_dim ** 0.5)
     s = jnp.einsum('bhd,bkhd->bhk', q.astype(jnp.float32),
@@ -202,3 +211,33 @@ def init_pages(num_kv_heads: int, total_pages: int, page_size: int,
                ) -> Tuple[jax.Array, jax.Array]:
     shape = (num_kv_heads, total_pages, page_size, head_dim)
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def paged_chunk_attention(q: jax.Array, k_pages: jax.Array,
+                          v_pages: jax.Array, positions: jax.Array,
+                          page_indices: jax.Array) -> jax.Array:
+    """S queries per row over the row's FULL paged history.
+
+    The paged analog of ops.attention.chunked_cache_attention's read
+    side: query s of row b attends every cache index <= positions[b, s]
+    — what speculative-decoding verification chunks need (the chunk's
+    K/V must already be written via `write_kv_chunk`). Chunk sizes are
+    small (draft_k + 1), so the gather-based XLA path is the right
+    shape everywhere; the pallas decode kernel stays the S=1 fast path.
+
+    q: [B, S, num_q_heads, head_dim]; positions: i32[B, S].
+    Returns [B, S, num_q_heads, head_dim] (q.dtype).
+    """
+    head_dim = k_pages.shape[-1]
+    max_len = page_indices.shape[1] * k_pages.shape[2]
+    k_all, v_all = _gather_kv(q.shape[2], k_pages, v_pages, page_indices)
+
+    scale = 1.0 / (head_dim ** 0.5)
+    s = jnp.einsum('bshd,bthd->bhst', q.astype(jnp.float32),
+                   k_all.astype(jnp.float32)) * scale
+    mask = (jnp.arange(max_len)[None, None, :]
+            <= positions[:, :, None])[:, None]              # [B,1,S,T]
+    s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum('bhst,bthd->bshd', p, v_all.astype(jnp.float32))
+    return out.astype(q.dtype)

@@ -45,9 +45,12 @@ def main() -> None:
     parser.add_argument('--num-slots', type=int, default=8)
     parser.add_argument('--speculative', type=int, default=0,
                         metavar='K',
-                        help='greedy prompt-lookup speculative decoding '
-                             'with K drafted tokens per step (one-shot '
-                             'engine only; exact greedy outputs)')
+                        help='prompt-lookup speculative decoding with K '
+                             'drafted tokens per step. One-shot engine: '
+                             'greedy requests, exact greedy outputs. '
+                             'Continuous batching: every slot rides '
+                             'verify chunks (greedy exact; sampled '
+                             'stays unbiased via match-acceptance)')
     parser.add_argument('--port', type=int,
                         default=int(os.environ.get('SKYPILOT_SERVE_PORT',
                                                    8000)))
@@ -91,12 +94,6 @@ def main() -> None:
     # max_total_len + K <= model.config.max_seq_len).
     spec_total = args.max_total_len
     if args.speculative > 0:
-        if args.continuous_batching:
-            # The slot engine decodes one token per step; speculation
-            # does not reach it yet. Fail fast instead of silently
-            # ignoring the flag (and serving two different capacities).
-            parser.error('--speculative is not supported together with '
-                         '--continuous-batching; drop one of the flags.')
         spec_total = min(args.max_total_len,
                          model.config.max_seq_len - args.speculative)
         if spec_total <= 1:
@@ -144,12 +141,18 @@ def main() -> None:
                 tok_holder['tok'] = load_tokenizer(tokenizer_dir)
             return tok_holder['tok']
 
+    # The engine serves every request class at ONE capacity: the
+    # speculative-clamped total when speculation is on (spec rounds
+    # drive greedy AND sampled slots in the same verify chunk).
+    engine_total = spec_total if args.speculative > 0 \
+        else args.max_total_len
     engine = None
     if args.continuous_batching:
         from skypilot_tpu.models.batching import ContinuousBatchingEngine
         engine = ContinuousBatchingEngine(
             model, params, num_slots=args.num_slots,
-            max_total_len=args.max_total_len)
+            max_total_len=engine_total,
+            speculative_k=args.speculative)
 
     # One jitted fn per (batch, temperature, total-length) bucket.
     fns: Dict[Tuple[int, float, int], object] = {}
@@ -219,12 +222,12 @@ def main() -> None:
                     # Ragged rows welcome: each joins the shared decode
                     # loop independently, honoring its temperature.
                     max_new = int(req.get('max_new_tokens',
-                                          args.max_total_len))
+                                          engine_total))
                     for row in tokens:
-                        if len(row) >= args.max_total_len:
+                        if len(row) >= engine_total:
                             raise ValueError(
                                 f'prompt len {len(row)} >= max_total_len '
-                                f'{args.max_total_len}')
+                                f'{engine_total}')
                     futs = [engine.submit([int(t) for t in row],
                                           max_new_tokens=max_new,
                                           temperature=temperature)
@@ -270,9 +273,10 @@ def main() -> None:
                 temperature = float(req.get('temperature', 0.0))
                 max_new = int(req.get('max_new_tokens', 64))
                 encoded = [tok(p)['input_ids'] for p in prompts]
-                limit = (spec_total
-                         if args.speculative > 0 and temperature == 0.0
-                         else args.max_total_len)
+                limit = (engine_total if engine is not None else
+                         (spec_total
+                          if args.speculative > 0 and temperature == 0.0
+                          else args.max_total_len))
                 for ids in encoded:
                     if len(ids) >= limit:
                         raise ValueError(

@@ -1,0 +1,136 @@
+"""Speculative decoding inside the continuous-batching engine.
+
+The flagship serving path (paged KV + slot engine) now rides
+prompt-lookup verify chunks: greedy outputs must be EXACTLY the
+non-speculative engine's outputs (which are themselves pinned to the
+full-forward greedy rollout by test_generate.py), sampled slots stay
+valid, and the vLLM-style page-pressure preemption keeps working with
+chunk lookahead allocation.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import flax.linen as nn
+
+from skypilot_tpu.models.batching import ContinuousBatchingEngine
+
+
+def _build(family, **cfg_kw):
+    if family == 'llama':
+        from skypilot_tpu.models.llama import Llama, LlamaConfig
+        cfg = LlamaConfig.tiny(dtype=jnp.float32, **cfg_kw)
+        model = Llama(cfg)
+    elif family == 'gpt':
+        from skypilot_tpu.models.gpt import GPT, GPTConfig
+        cfg = GPTConfig.tiny(dtype=jnp.float32, **cfg_kw)
+        model = GPT(cfg)
+    else:
+        from skypilot_tpu.models.deepseek import Deepseek, DeepseekConfig
+        cfg = DeepseekConfig.tiny(dtype=jnp.float32, **cfg_kw)
+        model = Deepseek(cfg)
+    params = nn.meta.unbox(model.init(
+        jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))['params'])
+    return model, params
+
+
+_PROMPTS = [
+    [5, 9, 2, 5, 9, 2, 5, 9],       # repetitive: multi-token accepts
+    [3, 3, 3, 3],
+    [17, 41, 7, 99, 23, 5],          # random-ish: rejects
+]
+
+
+def _run_engine(model, params, *, spec_k, paged=None, temps=None,
+                max_new=16):
+    engine = ContinuousBatchingEngine(
+        model, params, num_slots=4, max_total_len=48,
+        paged=paged, speculative_k=spec_k)
+    try:
+        temps = temps or [0.0] * len(_PROMPTS)
+        futs = [engine.submit(p, max_new_tokens=max_new, temperature=t)
+                for p, t in zip(_PROMPTS, temps)]
+        return [f.result(timeout=300) for f in futs]
+    finally:
+        engine.stop()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize('family,paged', [
+    ('llama', None),      # paged auto-on (the flagship path)
+    ('llama', False),     # dense per-slot cache
+    ('gpt', None),
+    ('deepseek', None),   # MLA latent cache (dense-only family)
+])
+def test_spec_engine_matches_plain_greedy(family, paged):
+    model, params = _build(family)
+    want = _run_engine(model, params, spec_k=0, paged=paged)
+    got = _run_engine(model, params, spec_k=4, paged=paged)
+    assert got == want
+    for prompt, row in zip(_PROMPTS, got):
+        assert row[:len(prompt)] == prompt
+        assert len(row) == len(prompt) + 16
+
+
+@pytest.mark.slow
+def test_spec_engine_sampled_slots():
+    """Sampled slots ride the same verify chunks: outputs are valid
+    (right lengths, prompt preserved, tokens in-vocab) and greedy
+    slots in the same batch stay exactly greedy."""
+    model, params = _build('llama')
+    temps = [0.0, 1.0, 0.7]
+    got = _run_engine(model, params, spec_k=4, temps=temps)
+    greedy = _run_engine(model, params, spec_k=0,
+                         temps=[0.0] * 3)
+    for prompt, row in zip(_PROMPTS, got):
+        assert row[:len(prompt)] == prompt
+        assert len(row) == len(prompt) + 16
+        assert all(0 <= t < model.config.vocab_size for t in row)
+    # The greedy slot is unaffected by its sampled neighbors.
+    assert got[0] == greedy[0]
+
+
+@pytest.mark.slow
+def test_spec_engine_eos_truncation():
+    """EOS committed mid-chunk finishes the request exactly where the
+    one-token engine would."""
+    model, params = _build('llama')
+    base = _run_engine(model, params, spec_k=0)[0]
+    eos = base[len(_PROMPTS[0]) + 3]   # a token the model WILL emit
+    for spec_k in (0, 4):
+        engine = ContinuousBatchingEngine(
+            model, params, num_slots=2, max_total_len=48,
+            eos_id=eos, speculative_k=spec_k)
+        try:
+            out = engine.submit(_PROMPTS[0],
+                                max_new_tokens=16).result(timeout=300)
+        finally:
+            engine.stop()
+        if spec_k == 0:
+            want = out
+        else:
+            assert out == want
+    assert want[-1] == eos or len(want) == len(_PROMPTS[0]) + 16
+
+
+@pytest.mark.slow
+def test_spec_engine_page_pressure_preemption():
+    """A pool too small for all slots at once still serves every
+    request with speculation on (chunk-lookahead allocation preempts
+    instead of failing)."""
+    # 15 usable pages x 4 tokens = 60 tokens live; 3 requests needing
+    # ~28 tokens each can't all fit -> preemption must kick in.
+    model, params = _build('llama', kv_page_size=4, kv_total_pages=16)
+    engine = ContinuousBatchingEngine(
+        model, params, num_slots=3, max_total_len=28,
+        speculative_k=4)
+    assert engine.paged
+    try:
+        futs = [engine.submit(p, max_new_tokens=20) for p in _PROMPTS]
+        rows = [f.result(timeout=300) for f in futs]
+    finally:
+        engine.stop()
+    for prompt, row in zip(_PROMPTS, rows):
+        assert row[:len(prompt)] == prompt
+        assert len(row) == len(prompt) + 20
