@@ -44,7 +44,8 @@ class ContinuousBatchingEngine:
                  max_total_len: int = 256, temperature: float = 0.0,
                  eos_id: Optional[int] = None,
                  paged: Optional[bool] = None,
-                 speculative_k: int = 0, spec_ngram: int = 2) -> None:
+                 speculative_k: int = 0, spec_ngram: int = 2,
+                 spec_lookback: int = 512) -> None:
         assert max_total_len <= model.config.max_seq_len
         if speculative_k:
             # Verification chunks write up to K past the last kept
@@ -63,6 +64,7 @@ class ContinuousBatchingEngine:
         self.eos_id = eos_id
         self.spec_k = speculative_k
         self.spec_ngram = spec_ngram
+        self.spec_lookback = spec_lookback
 
         # Paged KV cache (vLLM-style; ops/paged_attention.py): K/V live
         # in a shared physical page pool sized for the AGGREGATE live
@@ -232,7 +234,12 @@ class ContinuousBatchingEngine:
         occurrence of the trailing `spec_ngram` (context = committed
         output ++ pending current token); no match (or inactive) =
         repeat the last token (worst case: 1 commit per step, same as
-        plain decode)."""
+        plain decode).
+
+        The backward scan is bounded to the trailing `spec_lookback`
+        tokens so host-side draft cost per decode round stays O(1) in
+        the generation length (unbounded it is O(output_len) per round
+        — quadratic overall — on the single scheduler thread)."""
         k, ngram = self.spec_k, self.spec_ngram
         drafts = np.zeros((self.num_slots, k), np.int32)
         for slot in range(self.num_slots):
@@ -244,8 +251,9 @@ class ContinuousBatchingEngine:
             if len(ctx) <= ngram:
                 continue
             pattern = ctx[-ngram:]
+            floor = max(0, len(ctx) - self.spec_lookback)
             # Most recent strictly-earlier occurrence of the pattern.
-            for start in range(len(ctx) - ngram - 1, -1, -1):
+            for start in range(len(ctx) - ngram - 1, floor - 1, -1):
                 if ctx[start:start + ngram] == pattern:
                     cont = ctx[start + ngram:start + ngram + k]
                     if cont:

@@ -97,6 +97,43 @@ def _t(w: np.ndarray) -> np.ndarray:
     return np.ascontiguousarray(w.T)
 
 
+def _parse_rope_scaling(cfg_json: Dict[str, Any]):
+    """config.json `rope_scaling` -> models.llama.RopeScaling (or None).
+
+    Llama 3.1/3.2 ship `{'rope_type': 'llama3', ...}` (older exports
+    use the key `type`); importing those without rescaling inv_freq
+    would silently corrupt logits at every position, so unsupported
+    schemes (yarn, dynamic, longrope) raise instead of being ignored.
+    """
+    rs = cfg_json.get('rope_scaling')
+    if rs is None:
+        return None
+    rope_type = rs.get('rope_type') or rs.get('type')
+    if rope_type in (None, 'default'):
+        return None
+    from skypilot_tpu.models.llama import RopeScaling
+    try:
+        if rope_type == 'llama3':
+            return RopeScaling(
+                rope_type='llama3',
+                factor=float(rs['factor']),
+                low_freq_factor=float(rs.get('low_freq_factor', 1.0)),
+                high_freq_factor=float(rs.get('high_freq_factor', 4.0)),
+                original_max_position_embeddings=int(
+                    rs['original_max_position_embeddings']))
+        if rope_type == 'linear':
+            return RopeScaling(rope_type='linear',
+                               factor=float(rs['factor']))
+    except KeyError as e:
+        raise HfImportError(
+            f'rope_scaling block is missing required key {e} for '
+            f'rope_type {rope_type!r}: {rs!r}') from e
+    raise HfImportError(
+        f'rope_scaling type {rope_type!r} is not supported (supported: '
+        f'llama3, linear) — importing this checkpoint without its '
+        f'frequency rescaling would produce silently wrong logits.')
+
+
 # ---------------------------------------------------------------------------
 # Per-family conversion. Each returns (flax module, params pytree).
 
@@ -117,6 +154,7 @@ def _convert_llama_like(cfg_json: Dict[str, Any],
         embed_dim=cfg_json['hidden_size'],
         mlp_dim=cfg_json['intermediate_size'],
         rope_theta=float(cfg_json.get('rope_theta', 10000.0)),
+        rope_scaling=_parse_rope_scaling(cfg_json),
         norm_eps=float(cfg_json.get('rms_norm_eps', 1e-5)),
     )
     common.update(config_overrides)
@@ -168,6 +206,16 @@ def _convert_llama_like(cfg_json: Dict[str, Any],
         params[f'layer_{i}'] = layer
     if moe:
         from skypilot_tpu.models.mixtral import Mixtral, MixtralConfig
+        # Inference default: capacity_factor = E/K makes per-expert
+        # capacity = seq — the worst case (every token routing its K
+        # distinct experts to one queue) — so NO routed tokens are
+        # dropped and outputs match the checkpoint's reference
+        # implementation exactly (the training default of 1.25
+        # silently drops prefill tokens). Finetuning can pass a
+        # tighter capacity_factor override explicitly.
+        common.setdefault('capacity_factor',
+                          float(cfg_json['num_local_experts']) /
+                          float(cfg_json['num_experts_per_tok']))
         cfg = MixtralConfig(
             num_experts=cfg_json['num_local_experts'],
             experts_per_token=cfg_json['num_experts_per_tok'],
@@ -201,7 +249,12 @@ def _convert_gpt2(cfg_json, sd, max_seq_len, **overrides):
 
     def g(key: str) -> np.ndarray:
         # Some exports keep the 'transformer.' prefix, some drop it.
-        return sd.get('transformer.' + key, sd.get(key))
+        val = sd.get('transformer.' + key, sd.get(key))
+        if val is None:
+            raise HfImportError(
+                f'checkpoint is missing tensor {key!r} (tried '
+                f'"transformer.{key}" and "{key}")')
+        return val
 
     params: Dict[str, Any] = {
         'wte': g('wte.weight'),
@@ -234,6 +287,13 @@ def _convert_gpt2(cfg_json, sd, max_seq_len, **overrides):
 
 def _convert_deepseek(cfg_json, sd, max_seq_len, **overrides):
     from skypilot_tpu.models.deepseek import Deepseek, DeepseekConfig
+    if _parse_rope_scaling(cfg_json) is not None:
+        # Real DeepSeek V2 long-context checkpoints ship yarn scaling
+        # (rejected in _parse_rope_scaling); llama3/linear scaling is
+        # not wired into the MLA rope path either — refuse rather than
+        # import with silently wrong positional frequencies.
+        raise HfImportError(
+            'rope_scaling is not supported for deepseek_v2 imports yet')
     # (MoE DeepSeek V2 is rejected in load_hf_checkpoint, before the
     # state dict is read.)
     num_layers = cfg_json['num_hidden_layers']
@@ -354,6 +414,9 @@ def load_hf_checkpoint(model_dir: str, *,
             f'trained context ({trained_ctx}): rope positions beyond '
             f'it are untrained extrapolation — expect degraded output '
             f'past {trained_ctx} tokens.', stacklevel=2)
+    # Validate rope_scaling BEFORE reading gigabytes of weights
+    # (raises for unsupported schemes like yarn/dynamic/longrope).
+    _parse_rope_scaling(cfg_json)
     if model_type == 'deepseek_v2' and cfg_json.get('n_routed_experts'):
         # Reject BEFORE reading gigabytes of weights.
         raise HfImportError(

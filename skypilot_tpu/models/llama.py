@@ -20,6 +20,24 @@ Dtype = Any
 
 
 @dataclasses.dataclass(frozen=True)
+class RopeScaling:
+    """RoPE frequency rescaling (HF config.json `rope_scaling`).
+
+    `llama3` is the Llama 3.1/3.2 long-context rule: frequencies whose
+    wavelength exceeds the original context are divided by `factor`,
+    high frequencies are kept, and a smooth ramp interpolates between
+    `low_freq_factor` and `high_freq_factor` (reference recipes:
+    `llm/llama-3_1-finetuning/` serve these checkpoints). `linear` is
+    classic position-interpolation (all frequencies / factor).
+    """
+    rope_type: str = 'llama3'
+    factor: float = 8.0
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+    original_max_position_embeddings: int = 8192
+
+
+@dataclasses.dataclass(frozen=True)
 class LlamaConfig:
     vocab_size: int = 128256
     max_seq_len: int = 8192
@@ -29,6 +47,7 @@ class LlamaConfig:
     embed_dim: int = 4096
     mlp_dim: int = 14336
     rope_theta: float = 500_000.0
+    rope_scaling: Optional[RopeScaling] = None
     norm_eps: float = 1e-5
     dtype: Dtype = jnp.bfloat16
     # LM-head logits precision. None = f32 (the safe default for this
@@ -62,11 +81,33 @@ class LlamaConfig:
         return self.embed_dim // self.num_heads
 
 
-def apply_rope(x: jax.Array, positions: jax.Array,
-               theta: float) -> jax.Array:
+def rope_inv_freq(d_half: int, theta: float,
+                  scaling: Optional[RopeScaling] = None) -> jax.Array:
+    """Per-pair inverse frequencies [d_half], with optional rescaling."""
+    freqs = 1.0 / (theta ** (jnp.arange(d_half, dtype=jnp.float32) / d_half))
+    if scaling is None:
+        return freqs
+    if scaling.rope_type == 'linear':
+        return freqs / scaling.factor
+    if scaling.rope_type != 'llama3':
+        raise ValueError(f'unsupported rope_type {scaling.rope_type!r}')
+    old_ctx = float(scaling.original_max_position_embeddings)
+    low_wavelen = old_ctx / scaling.low_freq_factor
+    high_wavelen = old_ctx / scaling.high_freq_factor
+    wavelen = 2.0 * jnp.pi / freqs
+    smooth = (old_ctx / wavelen - scaling.low_freq_factor) / (
+        scaling.high_freq_factor - scaling.low_freq_factor)
+    interp = ((1.0 - smooth) * freqs / scaling.factor + smooth * freqs)
+    scaled = jnp.where(wavelen > low_wavelen, freqs / scaling.factor,
+                       jnp.where(wavelen < high_wavelen, freqs, interp))
+    return scaled
+
+
+def apply_rope(x: jax.Array, positions: jax.Array, theta: float,
+               scaling: Optional[RopeScaling] = None) -> jax.Array:
     """x: [B, S, H, D]; rotary embedding on the last dim."""
     d_half = x.shape[-1] // 2
-    freqs = 1.0 / (theta ** (jnp.arange(d_half, dtype=jnp.float32) / d_half))
+    freqs = rope_inv_freq(d_half, theta, scaling)
     angles = positions[:, :, None, None].astype(jnp.float32) * freqs  # B,S,1,Dh
     cos, sin = jnp.cos(angles), jnp.sin(angles)
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
@@ -115,8 +156,8 @@ class Attention(nn.Module):
                   'wk')(x).reshape(batch, seq, cfg.num_kv_heads, hd)
         v = _proj(cfg.num_kv_heads * hd, ('embed', 'heads'), cfg.dtype,
                   'wv')(x).reshape(batch, seq, cfg.num_kv_heads, hd)
-        q = apply_rope(q, positions, cfg.rope_theta)
-        k = apply_rope(k, positions, cfg.rope_theta)
+        q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
+        k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
 
         def _page_vars():
             shape = (cfg.num_kv_heads, cfg.kv_total_pages,

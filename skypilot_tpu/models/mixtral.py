@@ -36,6 +36,7 @@ class MixtralConfig:
     capacity_factor: float = 1.25
     router_aux_loss_weight: float = 0.02
     rope_theta: float = 1_000_000.0
+    rope_scaling: Optional[llama_lib.RopeScaling] = None
     norm_eps: float = 1e-5
     dtype: Dtype = jnp.bfloat16
     # LM-head logits precision; None = f32 (see llama.LlamaConfig).
@@ -65,6 +66,7 @@ class MixtralConfig:
             num_layers=self.num_layers, num_heads=self.num_heads,
             num_kv_heads=self.num_kv_heads, embed_dim=self.embed_dim,
             mlp_dim=self.mlp_dim, rope_theta=self.rope_theta,
+            rope_scaling=self.rope_scaling,
             norm_eps=self.norm_eps, dtype=self.dtype, remat=self.remat,
             kv_page_size=self.kv_page_size,
             kv_total_pages=self.kv_total_pages)

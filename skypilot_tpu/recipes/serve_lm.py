@@ -54,6 +54,15 @@ def main() -> None:
     parser.add_argument('--port', type=int,
                         default=int(os.environ.get('SKYPILOT_SERVE_PORT',
                                                    8000)))
+    parser.add_argument('--param-dtype', choices=['bf16', 'f32'],
+                        default='bf16',
+                        help='on-device dtype for --hf weights. bf16 '
+                             '(default) halves HBM vs f32; compute '
+                             'already runs in bf16 either way. The '
+                             'model + KV cache must fit ONE chip '
+                             '(serving is single-device): an 8B '
+                             'checkpoint needs a v5p-class chip even '
+                             'in bf16. f32 is for CPU parity runs')
     parser.add_argument('--cpu', action='store_true',
                         help='pin the CPU backend (smoke/dev runs; the '
                              'JAX_PLATFORMS env var is overridden by '
@@ -75,7 +84,14 @@ def main() -> None:
         from skypilot_tpu.models import hf_import
         model, hf_params = hf_import.load_hf_checkpoint(
             args.hf, max_seq_len=args.max_total_len)
-        hf_params = jax.tree.map(jnp.asarray, hf_params)
+        # Cast DURING host->device transfer (f32 numpy -> bf16 via
+        # ml_dtypes on host): peak HBM is the bf16 footprint, not the
+        # f32 one — serving is single-device, so this is what lets a
+        # big checkpoint fit the chip at all.
+        serve_dtype = (jnp.bfloat16 if args.param_dtype == 'bf16'
+                       else jnp.float32)
+        hf_params = jax.tree.map(
+            lambda x: jnp.asarray(x, serve_dtype), hf_params)
         vocab_size = model.config.vocab_size
         print(f'loaded HF checkpoint from {args.hf} '
               f'({type(model).__name__}, vocab={vocab_size})', flush=True)

@@ -78,6 +78,55 @@ def test_llama_tied_embeddings(tmp_path, tokens):
         rtol=2e-4, atol=2e-4)
 
 
+def test_llama3_rope_scaling_parity(tmp_path, tokens):
+    """Llama 3.1-style rope_scaling rescales inv_freq; logits must
+    match transformers' llama3 rule exactly (ADVICE r3: previously
+    the scaling block was silently ignored)."""
+    cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=160,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=64,
+        rope_theta=10000.0, tie_word_embeddings=False,
+        rope_scaling={'rope_type': 'llama3', 'factor': 8.0,
+                      'low_freq_factor': 1.0, 'high_freq_factor': 4.0,
+                      'original_max_position_embeddings': 8})
+    tmodel = transformers.LlamaForCausalLM(cfg).eval()
+    _save(tmodel, tmp_path)
+    model, params = hf_import.load_hf_checkpoint(
+        str(tmp_path), dtype=jnp.float32)
+    assert model.config.rope_scaling is not None
+    assert model.config.rope_scaling.rope_type == 'llama3'
+    np.testing.assert_allclose(
+        _logits_ours(model, params, tokens), _logits_torch(tmodel, tokens),
+        rtol=2e-4, atol=2e-4)
+
+
+def test_linear_rope_scaling_parity(tmp_path, tokens):
+    cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=160,
+        num_hidden_layers=1, num_attention_heads=4,
+        num_key_value_heads=4, max_position_embeddings=64,
+        tie_word_embeddings=False,
+        rope_scaling={'rope_type': 'linear', 'factor': 4.0})
+    tmodel = transformers.LlamaForCausalLM(cfg).eval()
+    _save(tmodel, tmp_path)
+    model, params = hf_import.load_hf_checkpoint(
+        str(tmp_path), dtype=jnp.float32)
+    np.testing.assert_allclose(
+        _logits_ours(model, params, tokens), _logits_torch(tmodel, tokens),
+        rtol=2e-4, atol=2e-4)
+
+
+def test_unsupported_rope_scaling_rejected(tmp_path):
+    """yarn (and other unimplemented schemes) must raise, not import
+    with silently wrong frequencies — and BEFORE weights are read."""
+    (tmp_path / 'config.json').write_text(json.dumps({
+        'model_type': 'llama', 'rope_scaling': {
+            'rope_type': 'yarn', 'factor': 4.0}}))
+    with pytest.raises(hf_import.HfImportError, match='yarn'):
+        hf_import.load_hf_checkpoint(str(tmp_path))
+
+
 def test_gpt2_parity(tmp_path, tokens):
     cfg = transformers.GPT2Config(
         vocab_size=128, n_positions=64, n_embd=64, n_layer=2, n_head=4,
