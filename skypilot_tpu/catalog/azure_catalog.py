@@ -108,11 +108,6 @@ def get_instance_type_for_accelerator(
     return sorted(df['InstanceType'].unique())
 
 
-def regions_for_instance_type(instance_type: str) -> List[str]:
-    df = _vm_df()
-    df = df[df['InstanceType'] == instance_type]
-    return sorted(df['Region'].unique())
-
 
 def validate_region_zone(region: Optional[str], zone: Optional[str]):
     df = _vm_df()
@@ -125,5 +120,11 @@ def validate_region_zone(region: Optional[str], zone: Optional[str]):
     return region, zone
 
 
-def regions() -> List[str]:
-    return sorted(_vm_df()['Region'].unique())
+
+def regions_by_price(use_spot: bool = False,
+                     instance_type: Optional[str] = None,
+                     acc_name: Optional[str] = None) -> List[str]:
+    """Regions with the offering, cheapest first (failover walk order)."""
+    return common.regions_by_price_impl(_vm_df(), use_spot,
+                                        instance_type=instance_type,
+                                        acc_name=acc_name)

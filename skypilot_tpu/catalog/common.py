@@ -1,25 +1,121 @@
-"""Catalog infrastructure: pandas over bundled data.
+"""Catalog infrastructure: pandas over bundled data + mirror refresh.
 
-Reference pattern: sky/catalog/common.py — pandas DataFrames loaded
-from CSVs fetched from a hosted mirror with local caching. This build
-bundles a pricing/region snapshot in-package (zero-egress environment);
-the hosted-mirror refresh hook is `fetch_remote_catalog`, gated on
-network availability.
+Reference pattern: sky/catalog/common.py:245 — pandas DataFrames
+loaded from CSVs fetched from a hosted mirror with a local TTL cache.
+This build bundles a pricing/region snapshot in-package (zero-egress
+environments keep working); `fetch_remote_catalog` refreshes a CSV
+from the mirror into `~/.sky-tpu/catalogs/<schema>/`, and
+`read_catalog` prefers a refreshed copy over the bundled snapshot.
+`stpu check` triggers a best-effort refresh of every bundled catalog.
 """
 from __future__ import annotations
 
-import dataclasses
+import io
 import os
+import sys
+import time
 from typing import Callable, Dict, List, NamedTuple, Optional
 
 import pandas as pd
 
 _CATALOG_DIR = os.path.join(os.path.dirname(__file__), 'data')
-_HOSTED_CATALOG_URL = os.environ.get(
-    'SKYPILOT_CATALOG_MIRROR',
-    'https://raw.githubusercontent.com/skypilot-org/skypilot-catalog/master/catalogs')
+# Bump when a catalog's column contract changes: refreshed copies are
+# namespaced per schema so an old cache can never poison a new binary.
+_SCHEMA_VERSION = 'v1'
 
 _df_cache: Dict[str, pd.DataFrame] = {}
+
+
+def _mirror_url() -> Optional[str]:
+    """Refresh is opt-in: unset SKYPILOT_CATALOG_MIRROR disables it.
+
+    The bundled snapshot uses this project's own filenames/columns, so
+    pointing at a mirror means hosting files as <mirror>/<schema>/
+    <filename> (any static file server works). There is no default
+    mirror — a hardcoded URL that does not actually carry our layout
+    would just generate doomed 404 requests on every `stpu check`.
+    """
+    return os.environ.get('SKYPILOT_CATALOG_MIRROR') or None
+
+
+def _cache_dir() -> str:
+    return os.path.expanduser(
+        os.environ.get('SKYPILOT_CATALOG_CACHE', '~/.sky-tpu/catalogs'))
+
+
+def _refreshed_path(filename: str) -> str:
+    return os.path.join(_cache_dir(), _SCHEMA_VERSION, filename)
+
+
+def fetch_remote_catalog(filename: str, *, ttl_hours: float = 24.0,
+                         timeout: float = 10.0,
+                         verbose: bool = False) -> Optional[str]:
+    """Refresh one catalog CSV from the configured mirror.
+
+    Returns the local cached path on success (or when a fresh-enough
+    copy already exists), None when no mirror is configured, the
+    mirror is unreachable, or the payload fails schema validation —
+    callers fall back to the bundled snapshot either way, so this is
+    always safe to attempt. Failures are silent unless `verbose`.
+    """
+    def _log(msg: str) -> None:
+        if verbose:
+            print(f'catalog refresh: {filename}: {msg}', file=sys.stderr)
+
+    mirror = _mirror_url()
+    if mirror is None:
+        return None
+    dest = _refreshed_path(filename)
+    if os.path.exists(dest) and \
+            time.time() - os.path.getmtime(dest) < ttl_hours * 3600:
+        return dest
+    url = f'{mirror}/{_SCHEMA_VERSION}/{filename}'
+    import urllib.request
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            data = resp.read().decode('utf-8')
+    except Exception as e:  # pylint: disable=broad-except
+        _log(str(e))
+        return None
+    # Schema gate: a refreshed file must carry at least the bundled
+    # snapshot's columns, or every consumer downstream breaks.
+    try:
+        new_df = pd.read_csv(io.StringIO(data))
+    except Exception as e:  # pylint: disable=broad-except
+        _log(f'unparsable payload ({e})')
+        return None
+    bundled = os.path.join(_CATALOG_DIR, filename)
+    if os.path.exists(bundled):
+        need = set(pd.read_csv(bundled, nrows=0).columns)
+        if not need <= set(new_df.columns):
+            _log(f'mirror copy is missing columns '
+                 f'{sorted(need - set(new_df.columns))}; keeping the '
+                 f'bundled snapshot')
+            return None
+    os.makedirs(os.path.dirname(dest), exist_ok=True)
+    tmp = f'{dest}.tmp.{os.getpid()}'
+    with open(tmp, 'w', encoding='utf-8') as f:
+        f.write(data)
+    os.replace(tmp, dest)
+    _df_cache.pop(filename, None)
+    return dest
+
+
+def refresh_catalogs(*, ttl_hours: float = 24.0, timeout: float = 10.0,
+                     verbose: bool = False) -> List[str]:
+    """Best-effort refresh of every bundled catalog; returns the
+    filenames actually refreshed (or already fresh). No-op (empty
+    list) when SKYPILOT_CATALOG_MIRROR is unset."""
+    if _mirror_url() is None:
+        return []
+    refreshed = []
+    for filename in sorted(os.listdir(_CATALOG_DIR)):
+        if not filename.endswith('.csv'):
+            continue
+        if fetch_remote_catalog(filename, ttl_hours=ttl_hours,
+                                timeout=timeout, verbose=verbose):
+            refreshed.append(filename)
+    return refreshed
 
 
 class InstanceTypeInfo(NamedTuple):
@@ -41,11 +137,19 @@ class InstanceTypeInfo(NamedTuple):
 def read_catalog(filename: str,
                  generator: Optional[Callable[[], pd.DataFrame]] = None
                  ) -> pd.DataFrame:
-    """Load a catalog DataFrame from the bundled CSV or a generator."""
+    """Load a catalog DataFrame: a mirror-refreshed copy when present
+    AND newer than the bundled CSV (so a package upgrade's corrected
+    snapshot beats a stale cache from a dead mirror), else the bundled
+    CSV, else a generator."""
     if filename in _df_cache:
         return _df_cache[filename]
+    refreshed = _refreshed_path(filename)
     path = os.path.join(_CATALOG_DIR, filename)
-    if os.path.exists(path):
+    if os.path.exists(refreshed) and (
+            not os.path.exists(path) or
+            os.path.getmtime(refreshed) >= os.path.getmtime(path)):
+        df = pd.read_csv(refreshed)
+    elif os.path.exists(path):
         df = pd.read_csv(path)
     elif generator is not None:
         df = generator()
@@ -88,6 +192,25 @@ def get_instance_type_for_cpus_mem_impl(
         return None
     df = df.sort_values(by=['Price', 'vCPUs'])
     return df['InstanceType'].iloc[0]
+
+
+def regions_by_price_impl(df: pd.DataFrame, use_spot: bool,
+                          instance_type: Optional[str] = None,
+                          acc_name: Optional[str] = None) -> List[str]:
+    """Regions carrying the offering, CHEAPEST FIRST (ties break by
+    name). Failover loops walk this order so the first successful
+    provision is also the cheapest available one — the reference gets
+    this from its price-sorted candidate list."""
+    if instance_type is not None:
+        df = df[df['InstanceType'] == instance_type]
+    if acc_name is not None:
+        df = df[df['AcceleratorName'] == acc_name]
+    col = 'SpotPrice' if use_spot else 'Price'
+    df = df.dropna(subset=[col])
+    if df.empty:
+        return []
+    grouped = df.groupby('Region')[col].min()
+    return sorted(grouped.index, key=lambda r: (grouped[r], r))
 
 
 def validate_region_zone_impl(df: pd.DataFrame, cloud_name: str,

@@ -128,6 +128,33 @@ def get_tpu_zones(acc_name: str) -> List[str]:
     return sorted(df['AvailabilityZone'].unique())
 
 
+def get_vm_zones(instance_type: Optional[str] = None,
+                 acc_name: Optional[str] = None,
+                 region: Optional[str] = None) -> List[str]:
+    """Zones (from the catalog, not synthesized) carrying a VM/GPU
+    offering, optionally filtered to one region."""
+    df = _vm_df()
+    if instance_type is not None:
+        df = df[df['InstanceType'] == instance_type]
+    if acc_name is not None:
+        df = df[df['AcceleratorName'] == acc_name]
+    if region is not None:
+        df = df[df['Region'] == region]
+    return sorted(df['AvailabilityZone'].dropna().unique())
+
+
+def regions_by_price(use_spot: bool = False,
+                     instance_type: Optional[str] = None,
+                     acc_name: Optional[str] = None) -> List[str]:
+    """Regions with the offering, cheapest first (TPU or VM table)."""
+    if acc_name is not None and tpu_utils.is_tpu(acc_name):
+        return common.regions_by_price_impl(_tpu_df(), use_spot,
+                                            acc_name=acc_name)
+    return common.regions_by_price_impl(_vm_df(), use_spot,
+                                        instance_type=instance_type,
+                                        acc_name=acc_name)
+
+
 def get_accelerator_hourly_cost(acc_name: str, count: int, use_spot: bool,
                                 region: Optional[str] = None,
                                 zone: Optional[str] = None) -> float:

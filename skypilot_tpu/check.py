@@ -36,6 +36,20 @@ def check(quiet: bool = False) -> List[str]:
             if not ok and reason:
                 line += f': {reason.splitlines()[0]}'
             print(line)
+    # Best-effort pricing refresh from the configured mirror
+    # (SKYPILOT_CATALOG_MIRROR; TTL-cached; no-op when unset, so
+    # zero-egress environments keep the bundled snapshot silently).
+    # Reference: sky/catalog/common.py:245 refreshes at read time; here
+    # `check` is the explicit refresh point so launches never block on
+    # a slow mirror.
+    try:
+        from skypilot_tpu.catalog import common as catalog_common
+        refreshed = catalog_common.refresh_catalogs(timeout=5.0,
+                                                    verbose=not quiet)
+        if refreshed and not quiet:
+            print(f'  catalog: {len(refreshed)} file(s) fresh from mirror')
+    except Exception:  # pylint: disable=broad-except
+        pass
     return enabled
 
 

@@ -130,17 +130,16 @@ class Azure(cloud.Cloud):
                               accelerators: Optional[Dict[str, int]],
                               use_spot: bool, region: Optional[str],
                               zone: Optional[str]) -> List[cloud.Region]:
-        del use_spot
+        # Cheapest-region-first walk order (ties break by name).
         if instance_type is not None:
-            region_names = azure_catalog.regions_for_instance_type(
-                instance_type)
+            region_names = azure_catalog.regions_by_price(
+                use_spot, instance_type=instance_type)
         elif accelerators:
             acc_name = next(iter(accelerators))
-            infos = azure_catalog.list_accelerators(
-                name_filter=f'^{acc_name}$').get(acc_name, [])
-            region_names = sorted({i.region for i in infos})
+            region_names = azure_catalog.regions_by_price(
+                use_spot, acc_name=acc_name)
         else:
-            region_names = azure_catalog.regions()
+            region_names = azure_catalog.regions_by_price(use_spot)
         out = []
         for r in region_names:
             if region is not None and r != region:

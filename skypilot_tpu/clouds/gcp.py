@@ -173,24 +173,27 @@ class GCP(cloud.Cloud):
                               accelerators: Optional[Dict[str, int]],
                               use_spot: bool, region: Optional[str],
                               zone: Optional[str]) -> List[cloud.Region]:
-        del use_spot
-        if accelerators:
-            acc_name = next(iter(accelerators))
-            if tpu_utils.is_tpu(acc_name):
-                zones = gcp_catalog.get_tpu_zones(acc_name)
-            else:
-                infos = gcp_catalog.list_accelerators(
-                    name_filter=f'^{acc_name}$').get(acc_name, [])
-                regions_set = {i.region for i in infos}
-                zones = [f'{r}-a' for r in sorted(regions_set)]
+        """Regions carrying the offering, CHEAPEST FIRST, with the
+        zones the catalog actually lists (no synthesized '-a')."""
+        acc_name = next(iter(accelerators)) if accelerators else None
+        if acc_name is not None and tpu_utils.is_tpu(acc_name):
+            zones = gcp_catalog.get_tpu_zones(acc_name)
+        elif acc_name is not None or instance_type is not None:
+            zones = gcp_catalog.get_vm_zones(instance_type=instance_type,
+                                             acc_name=acc_name)
         else:
-            zones = [f'{r}-a' for r in gcp_catalog.regions()]
+            zones = gcp_catalog.get_vm_zones()
+        price_order = {
+            r: i for i, r in enumerate(gcp_catalog.regions_by_price(
+                use_spot, instance_type=instance_type, acc_name=acc_name))}
         by_region: Dict[str, List[cloud.Zone]] = {}
         for z in zones:
             r = z.rsplit('-', 1)[0]
             by_region.setdefault(r, []).append(cloud.Zone(z))
         out = []
-        for r, zs in sorted(by_region.items()):
+        for r, zs in sorted(by_region.items(),
+                            key=lambda kv: (price_order.get(kv[0], 1 << 30),
+                                            kv[0])):
             if region is not None and r != region:
                 continue
             if zone is not None:

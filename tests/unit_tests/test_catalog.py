@@ -1,6 +1,12 @@
 """Catalog queries feeding the optimizer."""
+import http.server
+import os
+import threading
+import time
+
 import pytest
 
+from skypilot_tpu.catalog import common as catalog_common
 from skypilot_tpu.catalog import gcp_catalog
 
 
@@ -56,3 +62,153 @@ def test_unknown_accelerator_pricing():
     with pytest.raises(ValueError):
         gcp_catalog.get_accelerator_hourly_cost('tpu-v5p-128', 1, False,
                                                 region='mars')
+
+
+def test_vm_zones_are_real_multi_zone():
+    """VM zone enumeration reads the catalog (multi-zone regions), not
+    a synthesized '<region>-a'."""
+    zones = gcp_catalog.get_vm_zones(instance_type='n2-standard-8',
+                                     region='us-central1')
+    assert set(zones) == {'us-central1-a', 'us-central1-b',
+                          'us-central1-c'}
+
+
+def test_regions_by_price_cheapest_first():
+    regions = gcp_catalog.regions_by_price(instance_type='n2-standard-8')
+    # 0.388 group (us-central1/2, us-east1/5) before the pricier
+    # regions; asia-northeast1 (0.5005) last.
+    assert regions[0] == 'us-central1'
+    assert regions[-1] == 'asia-northeast1'
+    assert regions.index('us-west4') > regions.index('us-east5')
+
+    # TPU table routes through the same interface (v5e list price is
+    # uniform across regions, so the order is just deterministic).
+    tpu_regions = gcp_catalog.regions_by_price(acc_name='tpu-v5e-16')
+    assert 'us-central1' in tpu_regions and len(tpu_regions) >= 4
+
+
+def test_failover_walk_is_price_ordered_with_real_zones():
+    from skypilot_tpu.clouds.gcp import GCP
+    regions = GCP.regions_with_offering('n2-standard-8', None, False,
+                                        None, None)
+    assert regions[0].name == 'us-central1'
+    assert [z.name for z in regions[0].zones] == [
+        'us-central1-a', 'us-central1-b', 'us-central1-c']
+    assert regions[-1].name == 'asia-northeast1'
+
+
+# ---------------------------------------------------------------------------
+# Hosted-mirror refresh (fetch_remote_catalog)
+
+
+class _MirrorHandler(http.server.BaseHTTPRequestHandler):
+    files = {}
+    hits = []
+
+    def do_GET(self):  # noqa: N802
+        self.__class__.hits.append(self.path)
+        body = self.files.get(self.path)
+        if body is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.end_headers()
+        self.wfile.write(body.encode())
+
+    def log_message(self, *args):
+        pass
+
+
+@pytest.fixture()
+def mirror(tmp_path, monkeypatch):
+    server = http.server.HTTPServer(('127.0.0.1', 0), _MirrorHandler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    _MirrorHandler.files = {}
+    _MirrorHandler.hits = []
+    monkeypatch.setenv('SKYPILOT_CATALOG_MIRROR',
+                       f'http://127.0.0.1:{server.server_port}')
+    monkeypatch.setenv('SKYPILOT_CATALOG_CACHE', str(tmp_path / 'cache'))
+    catalog_common.clear_cache()
+    yield _MirrorHandler
+    server.shutdown()
+    catalog_common.clear_cache()
+
+
+def test_fetch_remote_catalog_refresh_and_ttl(mirror):
+    # Mirror carries a changed price for n2-standard-8 in us-central1-a.
+    bundled = os.path.join(catalog_common._CATALOG_DIR, 'gcp_vms.csv')
+    with open(bundled, 'r', encoding='utf-8') as f:
+        content = f.read()
+    changed = content.replace(
+        'n2-standard-8,,,8,32,0.388,0.1164,us-central1,us-central1-a',
+        'n2-standard-8,,,8,32,0.111,0.0333,us-central1,us-central1-a')
+    assert changed != content
+    mirror.files['/v1/gcp_vms.csv'] = changed
+
+    path = catalog_common.fetch_remote_catalog('gcp_vms.csv')
+    assert path is not None and os.path.exists(path)
+    assert len(mirror.hits) == 1
+
+    # read_catalog now serves the refreshed copy.
+    df = catalog_common.read_catalog('gcp_vms.csv')
+    row = df[(df['InstanceType'] == 'n2-standard-8')
+             & (df['AvailabilityZone'] == 'us-central1-a')]
+    assert float(row['Price'].iloc[0]) == pytest.approx(0.111)
+
+    # Within the TTL the mirror is NOT re-contacted.
+    assert catalog_common.fetch_remote_catalog('gcp_vms.csv') == path
+    assert len(mirror.hits) == 1
+
+    # Expired TTL refetches.
+    old = time.time() - 100 * 3600
+    os.utime(path, (old, old))
+    assert catalog_common.fetch_remote_catalog('gcp_vms.csv') == path
+    assert len(mirror.hits) == 2
+
+
+def test_fetch_remote_catalog_rejects_bad_schema(mirror):
+    mirror.files['/v1/gcp_vms.csv'] = 'InstanceType,Price\nn2,1.0\n'
+    assert catalog_common.fetch_remote_catalog('gcp_vms.csv') is None
+    # Bundled snapshot still serves.
+    df = catalog_common.read_catalog('gcp_vms.csv')
+    assert 'AvailabilityZone' in df.columns
+
+
+def test_fetch_remote_catalog_offline_graceful(monkeypatch, tmp_path):
+    monkeypatch.setenv('SKYPILOT_CATALOG_MIRROR',
+                       'http://127.0.0.1:9')  # discard port: refused
+    monkeypatch.setenv('SKYPILOT_CATALOG_CACHE', str(tmp_path))
+    catalog_common.clear_cache()
+    assert catalog_common.fetch_remote_catalog('gcp_vms.csv',
+                                               timeout=0.5) is None
+    assert catalog_common.read_catalog('gcp_vms.csv') is not None
+    catalog_common.clear_cache()
+
+
+def test_no_mirror_configured_is_a_noop(monkeypatch):
+    monkeypatch.delenv('SKYPILOT_CATALOG_MIRROR', raising=False)
+    assert catalog_common.fetch_remote_catalog('gcp_vms.csv') is None
+    assert catalog_common.refresh_catalogs() == []
+
+
+def test_newer_bundled_snapshot_beats_stale_cache(mirror):
+    """A package upgrade (bundled file newer than the cached mirror
+    copy) must win over a stale refresh from a dead mirror."""
+    bundled = os.path.join(catalog_common._CATALOG_DIR, 'gcp_vms.csv')
+    with open(bundled, 'r', encoding='utf-8') as f:
+        content = f.read()
+    mirror.files['/v1/gcp_vms.csv'] = content.replace(
+        'n2-standard-8,,,8,32,0.388,0.1164,us-central1,us-central1-a',
+        'n2-standard-8,,,8,32,0.222,0.0666,us-central1,us-central1-a')
+    path = catalog_common.fetch_remote_catalog('gcp_vms.csv')
+    assert path is not None
+    # Make the cached copy look months older than the bundled file.
+    old = os.path.getmtime(bundled) - 90 * 86400
+    os.utime(path, (old, old))
+    catalog_common.clear_cache()
+    df = catalog_common.read_catalog('gcp_vms.csv')
+    row = df[(df['InstanceType'] == 'n2-standard-8')
+             & (df['AvailabilityZone'] == 'us-central1-a')]
+    assert float(row['Price'].iloc[0]) == pytest.approx(0.388)  # bundled

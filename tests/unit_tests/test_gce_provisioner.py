@@ -214,3 +214,38 @@ def test_k8s_pvc_manifest():
     assert pvc['spec']['resources']['requests']['storage'] == '50Gi'
     assert pvc['spec']['storageClassName'] == 'fast'
     assert pvc['metadata']['labels']['skypilot-volume'] == 'ckpt'
+
+
+def test_vm_zone_walk_failover(fake_gce, monkeypatch, isolated_state):
+    """A GCE VM stockout in the first (cheapest) zone fails over to the
+    NEXT CATALOG ZONE of the same region — the zone walk runs on real
+    multi-zone catalog data, price-ordered (us-central1 a -> b)."""
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.backends.tpu_backend import RetryingProvisioner
+
+    real_request = fake_gce.request
+
+    def stockout_in_a(method, path, json_body=None, params=None):
+        if method == 'POST' and path.endswith('/instances') and \
+                '/zones/us-central1-a/' in path:
+            raise exceptions.ProvisionerError(
+                'The zone does not have enough resources',
+                category=exceptions.ProvisionerError.CAPACITY)
+        return real_request(method, path, json_body=json_body,
+                            params=params)
+
+    monkeypatch.setattr(gce_api, '_request', stockout_in_a)
+
+    task = task_lib.Task(run='true')
+    r = resources_lib.Resources(infra='gcp', instance_type='n2-standard-8')
+    task.set_resources(r)
+    prov = RetryingProvisioner()
+    record, resolved, region = prov.provision_with_retries(
+        task, r, 'vmwalk', 'vmwalk')
+    # Cheapest region first (us-central1), then its next real zone.
+    assert region.name == 'us-central1'
+    assert resolved.zone == 'us-central1-b'
+    assert len(prov.failover_history) == 1
+    assert ('us-central1-b', 'vmwalk-0') in fake_gce.instances or \
+           ('us-central1-b', 'vmwalk') in fake_gce.instances

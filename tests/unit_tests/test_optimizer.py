@@ -258,3 +258,21 @@ def test_variable_elimination_matches_brute_force():
         for u, v in g.graph.edges:
             got += by_name.get((choice[u][0], choice[v][0]), 0.0)
         assert abs(got - best) < 1e-6, (trial, got, best)
+
+
+def test_vm_cross_region_pricing(all_clouds):
+    """With the multi-region VM catalog, an unpinned request prices at
+    the cheapest region; pinning a pricier region costs more."""
+    free = sky.Task(run='true')
+    free.set_resources(sky.Resources(cloud='gcp',
+                                     instance_type='n2-standard-8'))
+    pinned = sky.Task(run='true')
+    pinned.set_resources(sky.Resources(cloud='gcp',
+                                       instance_type='n2-standard-8',
+                                       region='asia-northeast1'))
+    Optimizer.optimize(_dag(free), quiet=True)
+    Optimizer.optimize(_dag(pinned), quiet=True)
+    assert free.best_resources.get_hourly_cost() == pytest.approx(0.388)
+    assert pinned.best_resources.get_hourly_cost() == pytest.approx(0.5005)
+    assert (pinned.best_resources.get_hourly_cost() >
+            free.best_resources.get_hourly_cost())
