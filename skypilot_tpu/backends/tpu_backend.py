@@ -212,6 +212,18 @@ class RetryingProvisioner:
                             f'{common_utils.format_exception(e)}',
                             no_failover=True,
                             failover_history=self.failover_history)
+                    if getattr(e, 'blocks_cloud', False):
+                        # Account-level problem (credentials, billing,
+                        # TOS, global VPC): no location on THIS cloud
+                        # will differ, but the request may succeed on
+                        # another cloud — blocked_cloud lets re-
+                        # optimizing callers (managed jobs) exclude it.
+                        raise exceptions.ResourcesUnavailableError(
+                            f'{cloud} cannot serve this request '
+                            f'(account-level error in {zone_str}): '
+                            f'{common_utils.format_exception(e)}',
+                            failover_history=self.failover_history,
+                            blocked_cloud=cloud.canonical_name())
                     if getattr(e, 'blocks_region', False):
                         ux_utils.log(
                             f'Quota exhausted in region {region.name}; '
@@ -320,7 +332,10 @@ class TpuVmBackend(backend_lib.Backend[TpuVmResourceHandle]):
                         blocked_resources=blocked_resources)
                 break
             except exceptions.ResourcesUnavailableError as e:
-                if e.no_failover or not retry_until_up:
+                # blocked_cloud: the request is pinned to this cloud at
+                # this layer, so spinning on it cannot succeed — raise
+                # and let a re-optimizing caller pick another cloud.
+                if e.no_failover or e.blocked_cloud or not retry_until_up:
                     raise
                 wait = backoff.current_backoff()
                 ux_utils.log(f'Retrying provisioning in {wait:.0f}s '

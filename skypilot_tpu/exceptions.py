@@ -29,10 +29,16 @@ class ResourcesUnavailableError(SkyError):
     def __init__(self,
                  message: str,
                  no_failover: bool = False,
-                 failover_history: Optional[List[Exception]] = None) -> None:
+                 failover_history: Optional[List[Exception]] = None,
+                 blocked_cloud: Optional[str] = None) -> None:
         super().__init__(message)
         self.no_failover = no_failover
         self.failover_history: List[Exception] = failover_history or []
+        # Set when an account-level (scope='cloud') error stopped the
+        # walk: retrying THIS cloud is pointless, but a caller that can
+        # re-optimize (managed jobs) may succeed on another cloud by
+        # blocking this one.
+        self.blocked_cloud = blocked_cloud
 
     def with_failover_history(
             self, failover_history: List[Exception]
@@ -85,13 +91,17 @@ class ClusterSetUpError(SkyError):
 class ProvisionerError(SkyError):
     """Low-level provision failure for one zone attempt.
 
-    `category` steers the failover engine (reference:
-    FailoverCloudErrorHandlerV2's error→blocklist mapping):
-      capacity   → block this zone, try the next one
-      quota      → block the whole region (quotas are regional)
-      permission → non-retryable: no location will fix credentials
-      config     → non-retryable: the request itself is invalid
-      transient  → retry the same zone is fine; we still move on
+    `category` + `scope` steer the failover engine (reference:
+    FailoverCloudErrorHandlerV2's error→blocklist mapping,
+    cloud_vm_ray_backend.py:522). `scope` is the blast radius of the
+    block — 'zone' | 'region' | 'cloud' | 'abort' — normally supplied
+    by the per-cloud pattern table (provision/failover_patterns.py);
+    when omitted it derives from the category:
+      capacity   → zone   (stockout: try the next zone)
+      transient  → zone   (hiccup: walking on is safe)
+      quota      → region (quotas are regional)
+      permission → abort  (no location fixes credentials)
+      config     → abort  (the request itself is invalid)
     """
 
     CAPACITY = 'capacity'
@@ -100,20 +110,34 @@ class ProvisionerError(SkyError):
     CONFIG = 'config'
     TRANSIENT = 'transient'
 
+    _DEFAULT_SCOPE = {
+        CAPACITY: 'zone',
+        TRANSIENT: 'zone',
+        QUOTA: 'region',
+        PERMISSION: 'abort',
+        CONFIG: 'abort',
+    }
+
     def __init__(self, message: str,
                  errors: Optional[List[Dict[str, Any]]] = None,
-                 category: str = 'transient'):
+                 category: str = 'transient',
+                 scope: Optional[str] = None):
         super().__init__(message)
         self.errors = errors or []
         self.category = category
+        self.scope = scope or self._DEFAULT_SCOPE.get(category, 'zone')
 
     @property
     def no_failover(self) -> bool:
-        return self.category in (self.PERMISSION, self.CONFIG)
+        return self.scope == 'abort'
 
     @property
     def blocks_region(self) -> bool:
-        return self.category == self.QUOTA
+        return self.scope == 'region'
+
+    @property
+    def blocks_cloud(self) -> bool:
+        return self.scope == 'cloud'
 
 
 class ProvisionPrechecksError(SkyError):

@@ -96,6 +96,23 @@ class StrategyExecutor:
                         e, exceptions.ResourcesUnavailableError) and \
                         e.no_failover:
                     raise
+                if isinstance(e, exceptions.ResourcesUnavailableError) \
+                        and e.blocked_cloud:
+                    # Account-level failure on that cloud: exclude it
+                    # so the next attempt's optimizer picks elsewhere
+                    # (or proves nothing else can serve the request).
+                    from skypilot_tpu import resources as resources_mod
+                    blocked = resources_mod.Resources(
+                        cloud=e.blocked_cloud)
+                    if any(b.cloud is not None and
+                           b.cloud.canonical_name() == e.blocked_cloud and
+                           b.region is None and b.zone is None
+                           for b in self.blocked_resources):
+                        # Already blocked and it failed again: every
+                        # other option is exhausted too — give up
+                        # instead of burning the remaining attempts.
+                        raise
+                    self.blocked_resources.add(blocked)
                 ux_utils.log(
                     f'Launch attempt {attempt + 1}/{max_attempts} for '
                     f'{self.cluster_name} failed: '

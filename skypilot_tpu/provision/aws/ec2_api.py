@@ -104,32 +104,19 @@ def _sigv4_headers(region: str, host: str, body: str,
     return headers
 
 
-def _classify_error(code: str, message: str) -> str:
-    """EC2 error code → failover category (reference:
+def _classify_error(code: str, message: str) -> tuple:
+    """EC2 error code → (category, scope) via the per-cloud pattern
+    table (provision/failover_patterns.py; reference:
     FailoverCloudErrorHandlerV1's _aws_handler blocklist mapping)."""
+    from skypilot_tpu.provision import failover_patterns
+    pat = failover_patterns.classify('aws', code, message)
+    if pat is not None:
+        return pat.category, pat.scope
+    # Status-family fallbacks for codes no pattern knows.
     lower = code.lower()
-    # Throttling first: RequestLimitExceeded would otherwise
-    # pattern-match the quota branch.
-    if 'requestlimitexceeded' in lower or 'throttl' in lower or \
-            'unavailable' in lower or 'internalerror' in lower:
-        return exceptions.ProvisionerError.TRANSIENT
-    if 'insufficientinstancecapacity' in lower or \
-            'spotmaxpricetoolow' in lower or \
-            'insufficientcapacity' in lower or \
-            'unsupported' == lower:
-        return exceptions.ProvisionerError.CAPACITY
-    if 'limitexceeded' in lower or 'countexceeded' in lower or \
-            'quota' in lower:
-        # Vcpu/Instance/MaxSpotInstanceCount limits are regional.
-        return exceptions.ProvisionerError.QUOTA
-    if lower in ('unauthorizedoperation', 'authfailure',
-                 'invalidclienttokenid', 'optinrequired',
-                 'pendingverification'):
-        return exceptions.ProvisionerError.PERMISSION
     if lower.startswith('invalid') or lower.startswith('missing'):
-        return exceptions.ProvisionerError.CONFIG
-    del message
-    return exceptions.ProvisionerError.TRANSIENT
+        return exceptions.ProvisionerError.CONFIG, None
+    return exceptions.ProvisionerError.TRANSIENT, None
 
 
 def _strip_ns(tag: str) -> str:
@@ -189,9 +176,10 @@ def _request(region: str, action: str,
                     'InvalidGroup.NotFound'):
             raise exceptions.FetchClusterInfoError(
                 exceptions.FetchClusterInfoError.Reason.HEAD) from e
+        category, scope = _classify_error(code, message)
         raise exceptions.ProvisionerError(
             f'EC2 {action} in {region} -> {code}: {message[:300]}',
-            category=_classify_error(code, message)) from e
+            category=category, scope=scope) from e
     except OSError as e:
         raise exceptions.ProvisionerError(
             f'EC2 {action} in {region}: network error {e}',

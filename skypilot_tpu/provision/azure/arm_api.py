@@ -102,30 +102,18 @@ def _get_token(creds: Dict[str, str]) -> str:
     return out['access_token']
 
 
-def _classify_error(code: str, message: str) -> str:
-    """ARM error code → failover category (reference:
+def _classify_error(code: str, message: str) -> tuple:
+    """ARM error code → (category, scope) via the per-cloud pattern
+    table (provision/failover_patterns.py; reference:
     FailoverCloudErrorHandlerV2's _azure_handler mapping)."""
+    from skypilot_tpu.provision import failover_patterns
+    pat = failover_patterns.classify('azure', code, message)
+    if pat is not None:
+        return pat.category, pat.scope
     lower = code.lower()
-    if lower in ('skunotavailable', 'zonalallocationfailed',
-                 'allocationfailed', 'overconstrainedallocation',
-                 'overconstrainedzonalallocationrequest',
-                 'spotevictednotavailable'):
-        return exceptions.ProvisionerError.CAPACITY
-    if 'quota' in lower or lower == 'operationnotallowed' and \
-            'quota' in message.lower():
-        return exceptions.ProvisionerError.QUOTA
-    if lower in ('authorizationfailed', 'invalidauthenticationtoken',
-                 'expiredauthenticationtoken', 'authenticationfailed',
-                 'subscriptionnotfound', 'disallowedprovider'):
-        return exceptions.ProvisionerError.PERMISSION
-    if lower.startswith('invalid') or lower in ('badrequest',
-                                                'resourcenotfound',
-                                                'imagenotfound'):
-        return exceptions.ProvisionerError.CONFIG
-    if lower in ('toomanyrequests', 'internalservererror',
-                 'serviceunavailable', 'gatewaytimeout'):
-        return exceptions.ProvisionerError.TRANSIENT
-    return exceptions.ProvisionerError.TRANSIENT
+    if lower.startswith('invalid'):
+        return exceptions.ProvisionerError.CONFIG, None
+    return exceptions.ProvisionerError.TRANSIENT, None
 
 
 def _request(method: str, path: str, body: Optional[Dict[str, Any]] = None,
@@ -165,10 +153,10 @@ def _request(method: str, path: str, body: Optional[Dict[str, Any]] = None,
             # the idempotent success case (teardown retries, failover
             # cleanup before the RG ever existed).
             return {}
+        category, scope = _classify_error(code, message)
         raise exceptions.ProvisionerError(
             f'Azure {method} {path.rsplit("/", 1)[-1]} -> {code}: '
-            f'{message[:300]}',
-            category=_classify_error(code, message)) from e
+            f'{message[:300]}', category=category, scope=scope) from e
     except OSError as e:
         raise exceptions.ProvisionerError(
             f'Azure {method} {path}: network error {e}',

@@ -1,0 +1,266 @@
+"""Per-cloud provisioning-error pattern library → (category, scope).
+
+This is the declarative form of what SURVEY.md calls "the real IP of
+SkyPilot": the mapping from raw cloud error text to a failover
+decision. Reference: sky/backends/cloud_vm_ray_backend.py:395
+(FailoverCloudErrorHandlerV1) and :522 (FailoverCloudErrorHandlerV2),
+whose per-cloud handlers encode which errors block a zone, a region,
+the whole cloud, or abort failover outright. Here each cloud gets a
+first-match-wins ordered table of regex patterns over the error code
++ message, so the knowledge is data, unit-testable row by row, and
+extensible without touching engine code.
+
+Scopes (consumed by backends.tpu_backend.RetryingProvisioner):
+  zone   — block this zone, keep walking (stockouts, transient).
+  region — block the region's remaining zones (quotas are regional;
+           subnet/opt-in problems are regional).
+  cloud  — stop walking this cloud entirely, but the request could
+           succeed elsewhere (credentials, billing, TOS, global VPC).
+  abort  — non-retryable anywhere: the request itself is broken.
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Optional, Tuple  # noqa: F401 (Tuple: table types)
+
+from skypilot_tpu import exceptions
+
+_P = exceptions.ProvisionerError
+
+ZONE = 'zone'
+REGION = 'region'
+CLOUD = 'cloud'
+ABORT = 'abort'
+
+
+@dataclasses.dataclass(frozen=True)
+class ErrorPattern:
+    """One classified cloud-error shape.
+
+    `pattern` is a case-insensitive regex, matched (re.search) against
+    `"{code}: {message}"` — cloud API error codes and free-text
+    messages both participate, so 'QUOTA_EXCEEDED' and 'Quota ...
+    exceeded in region us-west1' are both expressible.
+    """
+    pattern: str
+    category: str
+    scope: str
+    note: str = ''
+
+    def matches(self, text: str) -> bool:
+        return re.search(self.pattern, text, re.IGNORECASE) is not None
+
+
+# ---------------------------------------------------------------------------
+# GCP: GCE VM + TPU API (REST error codes and message fragments).
+# Code provenance: cloud.google.com/compute/docs/troubleshooting +
+# the TPU API's numeric gRPC codes observed via the reference's
+# handler (cloud_vm_ray_backend.py:554-707).
+GCP_PATTERNS: Tuple[ErrorPattern, ...] = (
+    # -- API throttling first: would otherwise match the quota rows.
+    ErrorPattern(r'rate.?limit|per minute|RESOURCE_OPERATION_RATE_EXCEEDED',
+                 _P.TRANSIENT, ZONE, 'API throttle, not capacity'),
+    # -- capacity / stockout: block the zone, keep walking.
+    ErrorPattern(r'ZONE_RESOURCE_POOL_EXHAUSTED', _P.CAPACITY, ZONE,
+                 'GCE stockout (with or without _WITH_DETAILS)'),
+    ErrorPattern(r'insufficientCapacity|does not have enough resources',
+                 _P.CAPACITY, ZONE),
+    ErrorPattern(r'no more capacity in the zone', _P.CAPACITY, ZONE,
+                 'TPU API code 8'),
+    ErrorPattern(r'Insufficient reserved capacity', _P.CAPACITY, ZONE,
+                 'TPU API code 9'),
+    ErrorPattern(r'not enough resources|stockout|currently unavailable',
+                 _P.CAPACITY, ZONE),
+    ErrorPattern(r'update is not supported while in state PREEMPTED',
+                 _P.CAPACITY, ZONE, 'TPU preempted mid-creation (code 3)'),
+    ErrorPattern(r'UNSUPPORTED_OPERATION', _P.CAPACITY, ZONE,
+                 'empirically: VM preempted during creation'),
+    ErrorPattern(r'RESOURCE_NOT_READY', _P.TRANSIENT, ZONE,
+                 'VM still STOPPING; zone is busy'),
+    ErrorPattern(r'RESOURCE_EXHAUSTED', _P.CAPACITY, ZONE),
+    # -- quota: regional unless explicitly global.
+    ErrorPattern(r"GPUS_ALL_REGIONS.{0,20}exceeded", _P.QUOTA, CLOUD,
+                 'global GPU quota: no region will differ'),
+    ErrorPattern(r'QuotaFailure.*in zone|exhausted.*in zone', _P.QUOTA,
+                 ZONE, 'TPU per-zone quota'),
+    ErrorPattern(r'QUOTA_EXCEEDED|quotaExceeded|Quota .{0,60}exceeded',
+                 _P.QUOTA, REGION),
+    # -- config: scope depends on what is misconfigured.
+    ErrorPattern(r'VPC_NOT_FOUND', _P.CONFIG, CLOUD,
+                 'GCP VPCs are global: skip the whole cloud'),
+    ErrorPattern(r'SUBNET_NOT_FOUND_FOR_VPC', _P.CONFIG, REGION,
+                 'subnets are regional'),
+    ErrorPattern(r'disk size cannot be smaller than the image size',
+                 _P.CONFIG, ABORT, 'same request fails everywhere'),
+    # Zone-coverage miss BEFORE the generic invalid-field abort row:
+    # the real GCE 400 reads "Invalid value for field
+    # 'resource.machineType': ... Machine type X does not exist in
+    # zone Y." and must stay zone-scoped.
+    ErrorPattern(r'Machine type .{0,80} does not exist in zone',
+                 _P.CONFIG, ZONE, 'family coverage varies by zone'),
+    ErrorPattern(r'Invalid (value for field|acceleratorType|machine type)',
+                 _P.CONFIG, ABORT),
+    ErrorPattern(r'(acceleratorType|runtime_version).{0,60}not '
+                 r'(available|found|supported)', _P.CONFIG, ZONE),
+    # -- permission / account state.
+    ErrorPattern(r'Policy update access denied|IAM_PERMISSION_DENIED',
+                 _P.PERMISSION, CLOUD,
+                 'service-account misconfiguration is project-wide'),
+    ErrorPattern(r'is not found or access is unauthorized', _P.PERMISSION,
+                 ZONE, 'location-restricted project'),
+    ErrorPattern(r'billing (account|to be enabled|is disabled)'
+                 r'|Billing must be enabled', _P.PERMISSION, CLOUD),
+    ErrorPattern(r'Terms of Service|has not accepted', _P.PERMISSION, CLOUD),
+    ErrorPattern(r'caller lacks permission|PERMISSION_DENIED|'
+                 r'Request had insufficient authentication',
+                 _P.PERMISSION, CLOUD),
+    ErrorPattern(r'ACCESS_TOKEN_EXPIRED|invalid_grant', _P.PERMISSION,
+                 CLOUD, 'credentials fixable only by the user'),
+    # -- transient backend hiccups: retry elsewhere, zone-scoped.
+    ErrorPattern(r'backendError|internal error|INTERNAL_ERROR',
+                 _P.TRANSIENT, ZONE),
+    ErrorPattern(r'RESOURCE_NOT_FOUND', _P.CAPACITY, ZONE,
+                 'post-retry disappearance == likely stockout (ref #1797)'),
+    ErrorPattern(r'invalid state, please retry|serviceUnavailable|'
+                 r'temporarily unavailable', _P.TRANSIENT, ZONE),
+)
+
+# ---------------------------------------------------------------------------
+# AWS: EC2 API error codes (docs.aws.amazon.com/AWSEC2/latest/APIReference
+# /errors-overview.html); scope notes follow the reference's
+# _aws_handler + the per-code semantics.
+AWS_PATTERNS: Tuple[ErrorPattern, ...] = (
+    # -- throttling first (RequestLimitExceeded would match 'limit').
+    ErrorPattern(r'RequestLimitExceeded|Throttling|ThrottlingException',
+                 _P.TRANSIENT, ZONE),
+    # -- capacity.
+    ErrorPattern(r'InsufficientInstanceCapacity', _P.CAPACITY, ZONE),
+    ErrorPattern(r'InsufficientHostCapacity', _P.CAPACITY, ZONE),
+    ErrorPattern(r'InsufficientReservedInstanceCapacity', _P.CAPACITY, ZONE),
+    ErrorPattern(r'InsufficientCapacityOnOutpost', _P.CAPACITY, ZONE),
+    ErrorPattern(r'UnfulfillableCapacity', _P.CAPACITY, ZONE),
+    ErrorPattern(r'SpotMaxPriceTooLow', _P.CAPACITY, ZONE,
+                 'spot market price above bid'),
+    ErrorPattern(r'MarketCapacityOversubscribed', _P.CAPACITY, ZONE),
+    ErrorPattern(r'^Unsupported$|not supported in your requested '
+                 r'Availability Zone', _P.CAPACITY, ZONE,
+                 'instance family absent from this AZ'),
+    # -- quota (regional).
+    ErrorPattern(r'MaxSpotInstanceCountExceeded', _P.QUOTA, REGION),
+    ErrorPattern(r'InstanceLimitExceeded', _P.QUOTA, REGION),
+    ErrorPattern(r'VcpuLimitExceeded', _P.QUOTA, REGION),
+    ErrorPattern(r'VolumeLimitExceeded|MaxIOPSLimitExceeded', _P.QUOTA,
+                 REGION),
+    ErrorPattern(r'AddressLimitExceeded|RouteLimitExceeded', _P.QUOTA,
+                 REGION),
+    # Transient count-exceeded shapes BEFORE the quota catch-all, or
+    # they would region-block on a retryable error.
+    ErrorPattern(r'ResourceCountExceeded', _P.TRANSIENT, ZONE,
+                 'API-side concurrent-mutation throttle'),
+    ErrorPattern(r'LimitExceeded|CountExceeded|quota', _P.QUOTA, REGION,
+                 'catch-all for the *LimitExceeded family'),
+    # -- account / permission.
+    ErrorPattern(r'OptInRequired', _P.PERMISSION, REGION,
+                 'region not opted in; other regions may be'),
+    ErrorPattern(r'PendingVerification', _P.PERMISSION, CLOUD,
+                 'account under review'),
+    ErrorPattern(r'UnauthorizedOperation', _P.PERMISSION, CLOUD,
+                 'IAM policy gap is account-wide'),
+    ErrorPattern(r'AuthFailure|InvalidClientTokenId|ExpiredToken|'
+                 r'IncompleteSignature|SignatureDoesNotMatch',
+                 _P.PERMISSION, CLOUD, 'credential problem'),
+    # -- config.
+    ErrorPattern(r'InvalidAMIID|InvalidImageID', _P.CONFIG, REGION,
+                 'AMIs are regional'),
+    ErrorPattern(r'InvalidSubnetID|InvalidGroup\.NotFound|'
+                 r'InvalidSecurityGroupID|InvalidVpcID', _P.CONFIG, REGION,
+                 'network objects are regional'),
+    ErrorPattern(r'InvalidKeyPair', _P.CONFIG, REGION),
+    ErrorPattern(r'Unsupported.*instance type|InvalidInstanceType',
+                 _P.CONFIG, ABORT),
+    ErrorPattern(r'InvalidParameter|MissingParameter|ValidationError',
+                 _P.CONFIG, ABORT),
+    # -- transient.
+    ErrorPattern(r'InternalError|InternalFailure|ServiceUnavailable|'
+                 r'^Unavailable$', _P.TRANSIENT, ZONE),
+    ErrorPattern(r'InsufficientAddressCapacity', _P.TRANSIENT, ZONE),
+)
+
+# ---------------------------------------------------------------------------
+# Azure: ARM deployment/compute error codes (reference _azure_handler
+# plus learn.microsoft.com/azure/azure-resource-manager/troubleshooting
+# /common-deployment-errors); Azure zones are '1'/'2'/'3' within a
+# region, so zone-scoped rows matter when zonal placement is pinned.
+AZURE_PATTERNS: Tuple[ErrorPattern, ...] = (
+    # -- capacity.
+    ErrorPattern(r'ZonalAllocationFailed|'
+                 r'OverconstrainedZonalAllocationRequest',
+                 _P.CAPACITY, ZONE),
+    ErrorPattern(r'SkuNotAvailable', _P.CAPACITY, REGION,
+                 'SKU restricted/out of stock for the subscription here'),
+    ErrorPattern(r'AllocationFailed|OverconstrainedAllocation',
+                 _P.CAPACITY, REGION),
+    ErrorPattern(r'SpotEvictedNotAvailable|EvictionPolicyViolation',
+                 _P.CAPACITY, REGION),
+    ErrorPattern(r'VMStartTimedOut', _P.CAPACITY, REGION),
+    # -- quota.
+    ErrorPattern(r'LowPriorityQuotaExceeded|SpotQuotaExceeded', _P.QUOTA,
+                 REGION, 'spot core quota'),
+    ErrorPattern(r'QuotaExceeded|exceeding( approved)? quota', _P.QUOTA,
+                 REGION),
+    ErrorPattern(r'OperationNotAllowed.*quota|quota.*OperationNotAllowed',
+                 _P.QUOTA, REGION),
+    # -- subscription / account state.
+    ErrorPattern(r'ReadOnlyDisabledSubscription', _P.PERMISSION, CLOUD,
+                 'subscription disabled (reference blocks all of Azure)'),
+    ErrorPattern(r'SubscriptionNotRegistered', _P.PERMISSION, CLOUD,
+                 'resource provider not registered'),
+    ErrorPattern(r'SubscriptionNotFound', _P.PERMISSION, CLOUD),
+    ErrorPattern(r'ResourcePurchaseValidationFailed', _P.PERMISSION, CLOUD,
+                 'billing/offer cannot purchase this SKU'),
+    ErrorPattern(r'RequestDisallowedByPolicy|DisallowedProvider',
+                 _P.PERMISSION, CLOUD, 'org policy forbids the request'),
+    ErrorPattern(r'ClientAuthenticationError|AuthorizationFailed|'
+                 r'AuthenticationFailed', _P.PERMISSION, CLOUD),
+    ErrorPattern(r'InvalidAuthenticationToken|ExpiredAuthenticationToken',
+                 _P.PERMISSION, CLOUD),
+    ErrorPattern(r'ProvisioningDisabled', _P.PERMISSION, REGION),
+    # -- config.
+    ErrorPattern(r'ImageNotFound|PlatformImageNotFound|'
+                 r'InvalidImageReference', _P.CONFIG, ABORT),
+    ErrorPattern(r'InvalidTemplateDeployment|InvalidTemplate', _P.CONFIG,
+                 ABORT),
+    ErrorPattern(r'InvalidParameter|BadRequest', _P.CONFIG, ABORT),
+    ErrorPattern(r'ResourceGroupNotFound', _P.CONFIG, REGION,
+                 'resource groups live in one region'),
+    ErrorPattern(r'ResourceNotFound', _P.CONFIG, REGION),
+    ErrorPattern(r'VMMarketplaceInvalidInput', _P.CONFIG, ABORT),
+    # -- transient.
+    ErrorPattern(r'TooManyRequests|RetryableError', _P.TRANSIENT, ZONE),
+    ErrorPattern(r'InternalServerError|ServerTimeout|ServiceUnavailable|'
+                 r'GatewayTimeout|InternalExecutionError',
+                 _P.TRANSIENT, ZONE),
+)
+
+_TABLES = {
+    'gcp': GCP_PATTERNS,
+    'aws': AWS_PATTERNS,
+    'azure': AZURE_PATTERNS,
+}
+
+
+def classify(cloud: str, code: str, message: str = ''
+             ) -> Optional[ErrorPattern]:
+    """First matching pattern for `"{code}: {message}"`, or None.
+
+    This is the library's ONLY entry point: each cloud's
+    `_classify_error` consults it first and applies its own
+    status-code fallback on a miss (an unmatched error must degrade to
+    TRANSIENT/zone — walk on — rather than guess a broader block).
+    """
+    text = f'{code}: {message}' if message else str(code)
+    for pat in _TABLES[cloud]:
+        if pat.matches(text):
+            return pat
+    return None

@@ -40,11 +40,11 @@ def _request(method: str, path: str, *, json_body: Optional[Dict] = None,
         raise exceptions.FetchClusterInfoError(
             exceptions.FetchClusterInfoError.Reason.HEAD)
     if resp.status_code >= 400:
+        category, scope = tpu_api._classify_error(  # pylint: disable=protected-access
+            resp.status_code, resp.text)
         raise exceptions.ProvisionerError(
             f'GCE API {method} {path} -> {resp.status_code}: '
-            f'{resp.text[:500]}',
-            category=tpu_api._classify_error(  # pylint: disable=protected-access
-                resp.status_code, resp.text))
+            f'{resp.text[:500]}', category=category, scope=scope)
     return resp.json() if resp.text else {}
 
 

@@ -56,35 +56,35 @@ def _request(method: str, path: str, *, json_body: Optional[Dict] = None,
         raise exceptions.FetchClusterInfoError(
             exceptions.FetchClusterInfoError.Reason.HEAD)
     if resp.status_code >= 400:
+        category, scope = _classify_error(resp.status_code, resp.text)
         raise exceptions.ProvisionerError(
             f'TPU API {method} {path} -> {resp.status_code}: '
-            f'{resp.text[:500]}',
-            category=_classify_error(resp.status_code, resp.text))
+            f'{resp.text[:500]}', category=category, scope=scope)
     return resp.json() if resp.text else {}
 
 
-def _classify_error(status_code: int, text: str) -> str:
-    """Map a TPU API error to a failover category (reference:
-    FailoverCloudErrorHandlerV2, cloud_vm_ray_backend.py:522 — the
-    error→blocklist mapping that decides what a failure blocks)."""
+def _classify_error(status_code: int, text: str) -> tuple:
+    """(category, scope) for a TPU/GCE API error.
+
+    The per-cloud pattern table (provision/failover_patterns.py — the
+    declarative form of the reference's FailoverCloudErrorHandlerV2,
+    cloud_vm_ray_backend.py:522) is consulted first; HTTP-status
+    heuristics catch whatever no pattern knows."""
+    from skypilot_tpu.provision import failover_patterns
+    pat = failover_patterns.classify('gcp', str(status_code), text)
+    if pat is not None:
+        return pat.category, pat.scope
     lower = text.lower()
     if status_code == 429:
-        # API rate throttles ('per minute' quota metrics) are transient;
-        # anything else at 429 is a capacity signal.
-        if 'rate limit' in lower or 'per minute' in lower:
-            return exceptions.ProvisionerError.TRANSIENT
-        return exceptions.ProvisionerError.CAPACITY
-    if 'no more capacity' in lower or 'resource_exhausted' in lower or \
-            'stockout' in lower or 'not enough resources' in lower or \
-            'currently unavailable' in lower:
-        return exceptions.ProvisionerError.CAPACITY
+        # Unmatched 429s (no 'per minute' throttle text) are capacity.
+        return exceptions.ProvisionerError.CAPACITY, None
     if status_code == 403 and 'quota' in lower:
-        return exceptions.ProvisionerError.QUOTA
+        return exceptions.ProvisionerError.QUOTA, None
     if status_code in (401, 403):
-        return exceptions.ProvisionerError.PERMISSION
+        return exceptions.ProvisionerError.PERMISSION, None
     if status_code == 400:
-        return exceptions.ProvisionerError.CONFIG
-    return exceptions.ProvisionerError.TRANSIENT
+        return exceptions.ProvisionerError.CONFIG, None
+    return exceptions.ProvisionerError.TRANSIENT, None
 
 
 # ---------------------------------------------------------------------------

@@ -27,9 +27,10 @@ class FakeEc2:
         if action == 'RunInstances':
             if self.fail_run_with:
                 code, msg = self.fail_run_with
+                category, scope = ec2_api._classify_error(code, msg)
                 raise exceptions.ProvisionerError(
                     f'EC2 RunInstances in {region} -> {code}: {msg}',
-                    category=ec2_api._classify_error(code, msg))
+                    category=category, scope=scope)
             self._n += 1
             iid = f'i-{self._n:08x}'
             tags = {}
@@ -201,11 +202,13 @@ def test_quota_error_blocks_region(fake_ec2):
     assert e.value.blocks_region
 
 
-def test_auth_error_no_failover(fake_ec2):
+def test_auth_error_blocks_cloud(fake_ec2):
+    # IAM/credential problems are account-wide for THIS cloud but
+    # retryable elsewhere: scope=cloud, not abort (pattern library).
     fake_ec2.fail_run_with = ('UnauthorizedOperation', 'nope')
     with pytest.raises(exceptions.ProvisionerError) as e:
         aws_instance.run_instances('us-east-1', 'c7', _config(1))
-    assert e.value.no_failover
+    assert e.value.blocks_cloud and not e.value.no_failover
 
 
 def test_classify_error_table():
@@ -221,7 +224,7 @@ def test_classify_error_table():
         'InternalError': exceptions.ProvisionerError.TRANSIENT,
     }
     for code, want in cases.items():
-        assert ec2_api._classify_error(code, '') == want, code
+        assert ec2_api._classify_error(code, '')[0] == want, code
 
 
 def test_xml_to_obj_folds_items():
@@ -287,7 +290,7 @@ def test_failover_engine_walks_aws_zones(fake_ec2, monkeypatch,
     from skypilot_tpu.backends.tpu_backend import RetryingProvisioner
 
     task = task_lib.Task(run='true')
-    # Pin the region: the walk orders regions alphabetically, so the
+    # Pin the region: the walk orders regions cheapest-first, so the
     # zone-walk assertion needs a known starting point.
     r = resources_lib.Resources(infra='aws/us-east-1',
                                 accelerators='A100:8').copy(
@@ -318,9 +321,11 @@ def test_failover_engine_walks_aws_zones(fake_ec2, monkeypatch,
     assert region.name == 'us-east-1'
     assert len(prov.failover_history) == 1
 
-    # Quota error blocks the whole region: us-east-1b is never tried;
-    # with the region unpinned the walk moves on past every quota-
-    # blocked region (alphabetical order: ap-northeast-1 first).
+    # Quota error blocks the whole region: the next zone of the same
+    # region is never tried; with the region unpinned the walk moves on
+    # past every quota-blocked region in PRICE order (p4d: us-east-1 ==
+    # us-west-2 at 32.77, name tie-break -> us-east-1 first; then
+    # eu-west-1 and ap-northeast-1 at 35.40).
     fake_ec2.instances.clear()
     r_any = resources_lib.Resources(infra='aws',
                                     accelerators='A100:8').copy(
@@ -344,8 +349,6 @@ def test_failover_engine_walks_aws_zones(fake_ec2, monkeypatch,
         task, r_any, 'awsq', 'awsq')
     # One attempt per quota-blocked region (us-east-1b skipped), then
     # success in us-west-2.
-    assert tried == [('ap-northeast-1', 'ap-northeast-1a'),
-                     ('eu-west-1', 'eu-west-1a'),
-                     ('us-east-1', 'us-east-1a'),
+    assert tried == [('us-east-1', 'us-east-1a'),
                      ('us-west-2', 'us-west-2a')]
     assert region.name == 'us-west-2'

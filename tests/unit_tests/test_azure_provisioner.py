@@ -61,9 +61,10 @@ class FakeArm:
         elif '/virtualMachines/' in path:
             if self.fail_vm_with:
                 code, msg = self.fail_vm_with
+                category, scope = arm_api._classify_error(code, msg)
                 raise exceptions.ProvisionerError(
                     f'Azure PUT {name} -> {code}: {msg}',
-                    category=arm_api._classify_error(code, msg))
+                    category=category, scope=scope)
             self.vm_state[name] = {'state': 'creating', 'polls': 0}
         elif '/publicIPAddresses/' in path:
             self._n += 1
@@ -215,11 +216,11 @@ def test_quota_error_category(fake_arm):
 
 
 def test_auth_error_category():
-    assert arm_api._classify_error('AuthorizationFailed', 'no role') == \
+    assert arm_api._classify_error('AuthorizationFailed', 'no role')[0] == \
         exceptions.ProvisionerError.PERMISSION
-    assert arm_api._classify_error('InvalidParameter', 'bad') == \
+    assert arm_api._classify_error('InvalidParameter', 'bad')[0] == \
         exceptions.ProvisionerError.CONFIG
-    assert arm_api._classify_error('TooManyRequests', 'throttle') == \
+    assert arm_api._classify_error('TooManyRequests', 'throttle')[0] == \
         exceptions.ProvisionerError.TRANSIENT
 
 
@@ -254,8 +255,8 @@ def test_failover_engine_walks_azure_regions(fake_arm, monkeypatch,
     record, resolved, region = prov.provision_with_retries(
         task, r, 'azf', 'azf')
     assert failed_regions == ['eastus']
-    # Alphabetical offering walk: eastus -> westeurope.
-    assert region.name == 'westeurope'
-    assert record.region == 'westeurope'
-    assert resolved.region == 'westeurope'
+    # Price-ordered offering walk: eastus (cheapest) -> westus2.
+    assert region.name == 'westus2'
+    assert record.region == 'westus2'
+    assert resolved.region == 'westus2'
     assert len(prov.failover_history) == 1

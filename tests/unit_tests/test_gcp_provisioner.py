@@ -187,17 +187,22 @@ def test_capacity_error_classified(fake_api):
 def test_error_classification():
     from skypilot_tpu.provision.gcp.tpu_api import _classify_error
     P = exceptions.ProvisionerError
-    assert _classify_error(429, 'no more capacity in zone') == P.CAPACITY
+    assert _classify_error(429, 'no more capacity in zone')[0] == P.CAPACITY
     assert _classify_error(429, 'Quota exceeded for quota metric '
-                           'requests per minute') == P.TRANSIENT
-    assert _classify_error(403, 'Quota TPUS_PER_PROJECT exceeded') == P.QUOTA
-    assert _classify_error(403, 'caller lacks permission') == P.PERMISSION
-    assert _classify_error(400, 'Invalid acceleratorType') == P.CONFIG
-    assert _classify_error(503, 'invalid state, please retry') == P.TRANSIENT
-    assert _classify_error(503, 'backend error') == P.TRANSIENT
+                           'requests per minute')[0] == P.TRANSIENT
+    assert _classify_error(403,
+                           'Quota TPUS_PER_PROJECT exceeded')[0] == P.QUOTA
+    assert _classify_error(403, 'caller lacks permission')[0] == P.PERMISSION
+    assert _classify_error(400, 'Invalid acceleratorType')[0] == P.CONFIG
+    assert _classify_error(503,
+                           'invalid state, please retry')[0] == P.TRANSIENT
+    assert _classify_error(503, 'backend error')[0] == P.TRANSIENT
     assert P('x', category=P.PERMISSION).no_failover
     assert P('x', category=P.QUOTA).blocks_region
     assert not P('x', category=P.CAPACITY).no_failover
+    # Explicit scope overrides the category default.
+    assert P('x', category=P.PERMISSION, scope='cloud').blocks_cloud
+    assert not P('x', category=P.PERMISSION, scope='cloud').no_failover
 
 
 def test_failover_engine_honors_categories(fake_api, monkeypatch):
@@ -250,3 +255,69 @@ def test_failover_engine_honors_categories(fake_api, monkeypatch):
         prov.provision_with_retries(task, r, 'pf', 'pf')
     assert exc_info.value.no_failover
     assert len(calls) == 1
+
+
+def test_failover_engine_cloud_scope_stops_walk(fake_api, monkeypatch):
+    """A cloud-scoped error (e.g. billing disabled) stops the walk
+    after ONE attempt but stays retryable on other clouds
+    (no_failover=False) — unlike abort-scope config errors."""
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.backends.tpu_backend import RetryingProvisioner
+    from skypilot_tpu.provision.gcp import tpu_api
+
+    task = task_lib.Task(run='true')
+    r = resources_lib.Resources(infra='gcp', accelerators='tpu-v5e-16')
+    task.set_resources(r)
+    calls = []
+
+    def billing_fail(method, path, json_body=None, params=None):
+        if method == 'POST' and ('nodes' in path or
+                                 'queuedResources' in path):
+            calls.append(path)
+            raise exceptions.ProvisionerError(
+                'Billing must be enabled for activation',
+                category=exceptions.ProvisionerError.PERMISSION,
+                scope='cloud')
+        return fake_api.request(method, path, json_body, params)
+
+    monkeypatch.setattr(tpu_api, '_request', billing_fail)
+    prov = RetryingProvisioner()
+    with pytest.raises(exceptions.ResourcesUnavailableError) as exc_info:
+        prov.provision_with_retries(task, r, 'bf', 'bf')
+    assert not exc_info.value.no_failover  # other clouds may work
+    assert len(calls) == 1                 # but THIS cloud stopped cold
+    assert 'account-level' in str(exc_info.value)
+
+
+def test_blocked_cloud_surfaces_to_callers(fake_api, monkeypatch):
+    """provision(retry_until_up=True) must NOT spin on a cloud-scoped
+    error; the raised ResourcesUnavailableError names the blocked
+    cloud so re-optimizing callers (managed jobs) can exclude it."""
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.backends.tpu_backend import TpuVmBackend
+    from skypilot_tpu.provision.gcp import tpu_api
+
+    task = task_lib.Task(run='true')
+    r = resources_lib.Resources(infra='gcp', accelerators='tpu-v5e-16')
+    task.set_resources(r)
+    calls = []
+
+    def billing_fail(method, path, json_body=None, params=None):
+        if method == 'POST' and ('nodes' in path or
+                                 'queuedResources' in path):
+            calls.append(path)
+            raise exceptions.ProvisionerError(
+                'Billing must be enabled',
+                category=exceptions.ProvisionerError.PERMISSION,
+                scope='cloud')
+        return fake_api.request(method, path, json_body, params)
+
+    monkeypatch.setattr(tpu_api, '_request', billing_fail)
+    with pytest.raises(exceptions.ResourcesUnavailableError) as exc_info:
+        TpuVmBackend().provision(task, r, dryrun=False, stream_logs=False,
+                                 cluster_name='bc',
+                                 retry_until_up=True)
+    assert exc_info.value.blocked_cloud == 'gcp'
+    assert len(calls) == 1  # no retry-until-up spin on a dead cloud
