@@ -239,10 +239,13 @@ class RetryingProvisioner:
     def _region_blocked(cloud, region: cloud_lib.Region,
                         blocked_resources) -> bool:
         """A blocked resource with a region pins out that whole region
-        (the EAGER_NEXT_REGION contract)."""
+        (the EAGER_NEXT_REGION contract); one with NO region/zone pins
+        out the whole cloud (blocked_cloud account-level failures)."""
         for b in blocked_resources or ():
             if b.cloud is not None and not b.cloud.is_same_cloud(cloud):
                 continue
+            if b.region is None and b.zone is None and b.cloud is not None:
+                return True
             if b.region is not None and b.region == region.name:
                 return True
         return False

@@ -102,10 +102,16 @@ def launch(
     for stage in stages:
         if stage == Stage.OPTIMIZE:
             if any(r.cloud is None or not r.is_launchable()
-                   for r in task.resources) or task.best_resources is None:
-                optimizer_lib.Optimizer.optimize(dag,
-                                                 minimize=optimize_target,
-                                                 quiet=_quiet_optimizer)
+                   for r in task.resources) or task.best_resources is None \
+                    or _blocked_resources:
+                # A caller-supplied blocklist (managed-jobs recovery)
+                # must re-run the optimizer even when best_resources
+                # is already set: the previous pick may be exactly
+                # what got blocked (e.g. a blocked_cloud failure).
+                optimizer_lib.Optimizer.optimize(
+                    dag, minimize=optimize_target,
+                    blocked_resources=_blocked_resources,
+                    quiet=_quiet_optimizer)
         elif stage == Stage.PROVISION:
             to_provision = task.best_resources
             if to_provision is None:

@@ -78,14 +78,18 @@ GCP_PATTERNS: Tuple[ErrorPattern, ...] = (
                  'empirically: VM preempted during creation'),
     ErrorPattern(r'RESOURCE_NOT_READY', _P.TRANSIENT, ZONE,
                  'VM still STOPPING; zone is busy'),
-    ErrorPattern(r'RESOURCE_EXHAUSTED', _P.CAPACITY, ZONE),
-    # -- quota: regional unless explicitly global.
+    # -- quota: regional unless explicitly global. These rows MUST
+    # precede the bare RESOURCE_EXHAUSTED capacity row: real Google
+    # quota bodies carry status 'RESOURCE_EXHAUSTED' alongside the
+    # 'Quota ... exceeded' message.
     ErrorPattern(r"GPUS_ALL_REGIONS.{0,20}exceeded", _P.QUOTA, CLOUD,
                  'global GPU quota: no region will differ'),
     ErrorPattern(r'QuotaFailure.*in zone|exhausted.*in zone', _P.QUOTA,
                  ZONE, 'TPU per-zone quota'),
     ErrorPattern(r'QUOTA_EXCEEDED|quotaExceeded|Quota .{0,60}exceeded',
                  _P.QUOTA, REGION),
+    ErrorPattern(r'RESOURCE_EXHAUSTED', _P.CAPACITY, ZONE,
+                 'bare gRPC status with no quota text'),
     # -- config: scope depends on what is misconfigured.
     ErrorPattern(r'VPC_NOT_FOUND', _P.CONFIG, CLOUD,
                  'GCP VPCs are global: skip the whole cloud'),

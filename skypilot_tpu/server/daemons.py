@@ -1,0 +1,103 @@
+"""API-server background maintenance daemons.
+
+Reference: sky/server/daemons.py:1-40 — the reference runs periodic
+internal request daemons (cluster-status refresh, managed-jobs status
+refresh, volume refresh) with log rotation. Here a single maintenance
+thread multiplexes the periodic work (one thread, monotonic next-due
+bookkeeping) so the API server converges on reality even when nobody
+polls:
+
+- **cluster status reconcile** (`core.status(refresh=True)`): a
+  cluster preempted/stopped/terminated behind our back flips out of
+  UP in the DB without anyone calling `stpu status --refresh`.
+- **controller liveness sweep**: re-runs the jobs scheduler kick and
+  the serve controller reconcile normally done at server startup, so
+  controllers that die mid-flight are respawned within one tick.
+- **request GC**: terminal request rows + their log files are dropped
+  after a retention window, bounding requests.db and the log dir.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+from skypilot_tpu.utils import ux_utils
+
+DEFAULT_STATUS_INTERVAL = 300.0
+DEFAULT_LIVENESS_INTERVAL = 120.0
+DEFAULT_GC_INTERVAL = 3600.0
+DEFAULT_REQUEST_RETENTION = 3 * 24 * 3600.0
+
+
+def _refresh_cluster_status() -> None:
+    from skypilot_tpu import core
+    core.status(refresh=True)
+
+
+def _sweep_controllers() -> None:
+    from skypilot_tpu.jobs import scheduler as jobs_scheduler
+    from skypilot_tpu.serve import core as serve_core
+    jobs_scheduler.maybe_schedule_next_jobs()
+    serve_core.reconcile_controllers()
+
+
+class ServerDaemons:
+    """One maintenance thread running each periodic job on its own
+    interval. Job failures are logged and never kill the thread."""
+
+    def __init__(self,
+                 status_interval: float = DEFAULT_STATUS_INTERVAL,
+                 liveness_interval: float = DEFAULT_LIVENESS_INTERVAL,
+                 gc_interval: float = DEFAULT_GC_INTERVAL,
+                 request_retention: float = DEFAULT_REQUEST_RETENTION,
+                 poll: float = 1.0) -> None:
+        from skypilot_tpu.server.requests import executor
+        self._poll = poll
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # [name, interval, fn, next_due] (mutable: next_due advances).
+        # First run happens one full interval after start — startup
+        # already did a reconcile pass. An interval <= 0 disables that
+        # job alone (the others keep running).
+        now = time.monotonic()
+        self._jobs: List[list] = [
+            ['cluster-status-refresh', status_interval,
+             _refresh_cluster_status, now + status_interval],
+            ['controller-liveness', liveness_interval, _sweep_controllers,
+             now + liveness_interval],
+            ['request-gc', gc_interval,
+             lambda: executor.gc_requests(request_retention),
+             now + gc_interval],
+        ]
+        self._jobs = [j for j in self._jobs if j[1] > 0]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop,
+                                        name='server-daemons', daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def tick_all(self) -> None:
+        """Run every job once, now (tests + `stpu api sweep`)."""
+        for job in self._jobs:
+            self._run_one(job)
+
+    def _run_one(self, job) -> None:
+        name, interval, fn = job[0], job[1], job[2]
+        try:
+            fn()
+        except Exception as e:  # pylint: disable=broad-except
+            ux_utils.log(f'daemon {name} failed: {e!r}')
+        job[3] = time.monotonic() + interval
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._poll):
+            now = time.monotonic()
+            for job in self._jobs:
+                if now >= job[3]:
+                    self._run_one(job)

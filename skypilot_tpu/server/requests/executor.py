@@ -141,6 +141,30 @@ def cancel_request(request_id: str) -> bool:
     return True
 
 
+def gc_requests(retention_seconds: float) -> int:
+    """Drop terminal requests that finished more than
+    `retention_seconds` ago, along with their log files; returns how
+    many rows were removed. Reference: sky/server/daemons.py's
+    request-log maintenance; bounds requests.db + the log dir on a
+    long-lived server."""
+    cutoff = time.time() - retention_seconds
+    terminal = tuple(s.value for s in RequestStatus if s.is_terminal())
+    marks = ','.join('?' * len(terminal))
+    rows = _db().query(
+        f'SELECT request_id, log_path FROM requests '
+        f'WHERE status IN ({marks}) AND finished_at IS NOT NULL '
+        f'AND finished_at < ?', terminal + (cutoff,))
+    for row in rows:
+        if row.get('log_path'):
+            try:
+                os.unlink(row['log_path'])
+            except OSError:
+                pass
+        _db().execute('DELETE FROM requests WHERE request_id=?',
+                      (row['request_id'],))
+    return len(rows)
+
+
 def _set_status(request_id: str, status: RequestStatus,
                 **extra: Any) -> None:
     sets = ['status=?']

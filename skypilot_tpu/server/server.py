@@ -485,6 +485,25 @@ def run(host: str = '127.0.0.1',
     except Exception:  # pylint: disable=broad-except
         import traceback
         traceback.print_exc()
+    # Periodic maintenance (reference: sky/server/daemons.py): status
+    # reconcile + controller liveness + request GC keep the DB honest
+    # even when nobody polls. Each interval is env-tunable and <= 0
+    # disables THAT job only.
+    from skypilot_tpu.server import daemons as daemons_lib
+    daemons = daemons_lib.ServerDaemons(
+        status_interval=float(os.environ.get(
+            'SKYPILOT_STATUS_REFRESH_INTERVAL',
+            daemons_lib.DEFAULT_STATUS_INTERVAL)),
+        liveness_interval=float(os.environ.get(
+            'SKYPILOT_LIVENESS_SWEEP_INTERVAL',
+            daemons_lib.DEFAULT_LIVENESS_INTERVAL)),
+        gc_interval=float(os.environ.get(
+            'SKYPILOT_REQUEST_GC_INTERVAL',
+            daemons_lib.DEFAULT_GC_INTERVAL)),
+        request_retention=float(os.environ.get(
+            'SKYPILOT_REQUEST_RETENTION',
+            daemons_lib.DEFAULT_REQUEST_RETENTION)))
+    daemons.start()
     app = create_app()
     web.run_app(app, host=host, port=port, print=None)
 

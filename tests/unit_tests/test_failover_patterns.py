@@ -210,3 +210,17 @@ def test_scope_drives_error_flags():
     assert P('x', category=P.CONFIG, scope=fp.REGION).blocks_region
     assert not P('x', category=P.CONFIG, scope=fp.REGION).no_failover
     assert P('x', category=P.CONFIG).no_failover  # default abort
+
+
+def test_quota_body_with_resource_exhausted_status_region_blocks():
+    """Real Google quota bodies carry status RESOURCE_EXHAUSTED next to
+    the quota message — the quota row must win (region scope), not the
+    bare capacity row."""
+    body = ('{"error": {"code": 429, "message": "Quota '
+            "'TPUSPerProjectPerRegion' exceeded. Limit: 32 in region "
+            'europe-west4.", "status": "RESOURCE_EXHAUSTED"}}')
+    pat = fp.classify('gcp', '429', body)
+    assert (pat.category, pat.scope) == (P.QUOTA, fp.REGION)
+    # The bare status with no quota text stays capacity/zone.
+    pat = fp.classify('gcp', '429', 'RESOURCE_EXHAUSTED')
+    assert (pat.category, pat.scope) == (P.CAPACITY, fp.ZONE)
