@@ -8,7 +8,7 @@ against the backend. `exec` is the fast path reusing an UP cluster.
 from __future__ import annotations
 
 import enum
-from typing import Any, List, Optional, Tuple
+from typing import Any, Callable, List, Optional, Tuple
 
 from skypilot_tpu import admin_policy
 from skypilot_tpu import dag as dag_lib
@@ -58,6 +58,8 @@ def launch(
     _quiet_optimizer: bool = False,
     _is_launched_by_jobs_controller: bool = False,
     _blocked_resources: Optional[set] = None,
+    _pre_exec_hook: Optional[Callable[
+        [tpu_backend.TpuVmResourceHandle], None]] = None,
 ) -> Tuple[Optional[int], Optional[tpu_backend.TpuVmResourceHandle]]:
     """Provision (if needed) + run a task. Returns (job_id, handle).
 
@@ -153,6 +155,15 @@ def launch(
                 continue
             backend.setup(handle, task)
         elif stage == Stage.EXEC:
+            if _pre_exec_hook is not None and not dryrun:
+                # Job-group members prepare the (possibly fresh)
+                # cluster — peer hostname block, address publish —
+                # BEFORE the user job starts, so a job resolving
+                # peers at startup never races the injection
+                # (matters on the recovery path, where provision and
+                # submit happen inside one launch call).
+                assert handle is not None
+                _pre_exec_hook(handle)
             job_id = backend.execute(handle, task, detach_run=detach_run,
                                      dryrun=dryrun)
         elif stage == Stage.DOWN:

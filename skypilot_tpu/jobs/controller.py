@@ -71,6 +71,11 @@ class JobController:
         self.task = task_lib.Task.from_yaml_config(cfg)
         self.executor = recovery_strategy.StrategyExecutor.make(
             self.cluster_name, self.task)
+        if self.group:
+            # Set at construction (not only in _launch_group_member):
+            # an adopted controller that goes straight into recovery
+            # must still install the peer-hostname block pre-submit.
+            self.executor.pre_exec_hook = self._group_pre_exec
         # Per-stage restart budget: each stage's own
         # job_recovery.max_restarts_on_errors governs it (a pipeline's
         # later stages must not inherit stage 0's setting or pay for
@@ -225,11 +230,39 @@ class JobController:
         assert record is not None
         head = record['handle'].cluster_info.get_head_instance()
         groups.publish_address(self.job_id, head.internal_ip)
-        # Phase 2: exchange addresses, then submit the real job.
+        # Phase 2: exchange addresses, then submit the real job. The
+        # hostname block is installed via the pre-exec hook — between
+        # (re)provision and job submission — so jobs that resolve
+        # peers at startup never race it, on launch OR recovery.
         addrs = groups.wait_peer_addresses(self.group, self.job_id)
-        self.task.update_envs({'SKYPILOT_JOBGROUP': self.group, **addrs})
+        self.task.update_envs({
+            'SKYPILOT_JOBGROUP': self.group,
+            'SKYPILOT_JOBGROUP_HOSTS_FILE':
+                f'/tmp/skypilot-jobgroup-{self.group}.hosts',
+            **addrs,
+        })
         self.executor.task = self.task
+        self.executor.pre_exec_hook = self._group_pre_exec
         return self.executor.launch()
+
+    def _group_pre_exec(self, handle) -> None:
+        """Pre-submission cluster prep for a group member: publish the
+        (possibly new) head address, install the peer hostname block.
+        Hostname injection failures DEGRADE (warn) rather than fail the
+        member — the peer-address env vars remain the source of truth,
+        and failing here would abort the whole group."""
+        from skypilot_tpu.jobs import groups
+        head = handle.cluster_info.get_head_instance()
+        if head is not None:
+            groups.publish_address(self.job_id, head.internal_ip)
+        try:
+            hosts_path = groups.install_hosts_entries(handle, self.group)
+            self.task.update_envs(
+                {'SKYPILOT_JOBGROUP_HOSTS_FILE': hosts_path})
+        except Exception as e:  # pylint: disable=broad-except
+            ux_utils.log(
+                f'Job group {self.group!r}: hostname injection failed '
+                f'({e}); continuing with env addresses only.')
 
     def _agent(self):
         record = global_state.get_cluster(self.cluster_name)
@@ -305,13 +338,26 @@ class JobController:
         agent_job_id = self.executor.recover()
         state.set_agent_job_id(job_id, agent_job_id)
         if self.group:
-            # Re-publish the (possibly new) head address for peers that
-            # re-resolve on reconnect.
-            record = global_state.get_cluster(self.cluster_name)
-            if record is not None:
-                from skypilot_tpu.jobs import groups
-                head = record['handle'].cluster_info.get_head_instance()
-                groups.publish_address(job_id, head.internal_ip)
+            # Own publish + own-cluster hosts install already happened
+            # pre-submit (the executor's _group_pre_exec hook). Here:
+            # refresh the hosts block on every PEER cluster so their
+            # stable hostnames point at this member's new head.
+            from skypilot_tpu.jobs import groups
+            for member in groups.members(self.group):
+                if member['job_id'] == job_id:
+                    continue
+                peer_cluster = member.get('cluster_name')
+                peer_record = (global_state.get_cluster(peer_cluster)
+                               if peer_cluster else None)
+                if peer_record is None:
+                    continue
+                try:
+                    groups.install_hosts_entries(
+                        peer_record['handle'], self.group)
+                except Exception as e:  # pylint: disable=broad-except
+                    ux_utils.log(
+                        f'Job group {self.group!r}: hosts refresh on '
+                        f'{peer_cluster!r} failed: {e}')
         state.set_status(job_id, state.ManagedJobStatus.RUNNING)
         return agent_job_id
 
@@ -326,6 +372,18 @@ class JobController:
                             agent.cancel_job(j['job_id'])
                 except requests.RequestException:
                     pass
+        if self.group and self.pooled:
+            # Strip the group's hostname block before the worker is
+            # RELEASED for reuse: a later job on it must not resolve
+            # 'actor'/'learner' to IPs the cloud may have reassigned
+            # to strangers. (Non-pooled clusters are terminated, so
+            # there is nothing to strip — and stripping would race
+            # still-running peers on shared-host setups like the
+            # Local cloud.)
+            record = global_state.get_cluster(self.cluster_name)
+            if record is not None:
+                from skypilot_tpu.jobs import groups
+                groups.remove_hosts_entries(record['handle'], self.group)
         if self.pooled:
             # Pool workers are released, not destroyed — the whole point
             # of the pool is cluster reuse across jobs.

@@ -25,8 +25,14 @@ from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import exceptions
 from skypilot_tpu.jobs import state
+from skypilot_tpu.utils import ux_utils
 
 _PUBLISH_TIMEOUT_SECONDS = 900.0
+
+# Managed /etc/hosts block markers (idempotent re-injection on
+# recovery republish).
+_HOSTS_BEGIN = '# >>> skypilot-jobgroup >>>'
+_HOSTS_END = '# <<< skypilot-jobgroup <<<'
 
 
 def _db():
@@ -62,6 +68,7 @@ def launch_group(group_name: str, task_configs: List[Dict[str, Any]],
             (group_name, *(s.value for s in state._TERMINAL))):  # pylint: disable=protected-access
         raise exceptions.SkyError(
             f'Job group {group_name!r} already has active jobs.')
+    task_configs = _pin_joint_placement(group_name, task_configs)
     # Insert + tag under the scheduler lock: a concurrent scheduler pass
     # must never observe a member as a plain group-less PENDING job (it
     # would spawn it solo, skipping peer-address injection).
@@ -77,6 +84,45 @@ def launch_group(group_name: str, task_configs: List[Dict[str, Any]],
             job_ids.append(job_id)
     scheduler.maybe_schedule_next_jobs()
     return job_ids
+
+
+def _pin_joint_placement(group_name: str,
+                         task_configs: List[Dict[str, Any]]
+                         ) -> List[Dict[str, Any]]:
+    """ONE placement decision for the whole group: pin every member's
+    resources to a common cloud+region (reference: sky/optimizer.py:
+    1037 SAME_INFRA). Falls back to independent placement (unchanged
+    configs) when no common infra exists — the reference's fallback —
+    or when the optimizer cannot evaluate the configs (e.g. a pool
+    target resolved later)."""
+    import copy as copy_lib
+
+    from skypilot_tpu import optimizer as optimizer_lib
+    from skypilot_tpu import task as task_lib
+    try:
+        tasks = [task_lib.Task.from_yaml_config(copy_lib.deepcopy(cfg))
+                 for cfg in task_configs]
+        infra = optimizer_lib.Optimizer.optimize_group(tasks)
+    except Exception as e:  # pylint: disable=broad-except
+        ux_utils.log(f'Job group {group_name!r}: joint placement '
+                     f'skipped ({e}); members place independently.')
+        return task_configs
+    if infra is None:
+        ux_utils.log(f'Job group {group_name!r}: no common cloud/region '
+                     'across members; placing independently.')
+        return task_configs
+    pinned = []
+    for cfg, task in zip(task_configs, tasks):
+        cfg = copy_lib.deepcopy(cfg)
+        # Replace the member's resources with the CONCRETE joint
+        # choice (serialized best_resources): this cleanly collapses
+        # any_of/ordered sets to the decided candidate instead of
+        # bolting cloud/region keys onto a config that may carry its
+        # own 'infra' (which would fail validation at controller
+        # start).
+        cfg['resources'] = task.best_resources.to_yaml_config()
+        pinned.append(cfg)
+    return pinned
 
 
 def members(group_name: str) -> List[Dict[str, Any]]:
@@ -126,6 +172,125 @@ def wait_peer_addresses(group_name: str, my_job_id: int,
                 f'{[r["name"] for r in missing]} did not publish an '
                 f'address within {timeout:.0f}s.')
         time.sleep(2.0)
+
+
+def hosts_block(group_name: str) -> str:
+    """/etc/hosts-format block mapping every published member to the
+    stable names `<task>.<group>` and `<task>` (reference:
+    sky/jobs/job_group_networking.py:1-21 — address resolution via
+    /etc/hosts injection or native DNS)."""
+    lines = [_HOSTS_BEGIN]
+    for r in members(group_name):
+        if r.get('head_ip'):
+            lines.append(f'{r["head_ip"]} {r["name"]}.{group_name} '
+                         f'{r["name"]}')
+    lines.append(_HOSTS_END)
+    return '\n'.join(lines) + '\n'
+
+
+def _hosts_update_script(block_b64: str, group_name: str) -> str:
+    """Shell that installs (or, with an empty block, removes) the
+    managed block on one host.
+
+    - The fixed-path file `/tmp/skypilot-jobgroup-<group>.hosts` is
+      ALWAYS written — it is the same absolute path on every host, so
+      one cluster-wide SKYPILOT_JOBGROUP_HOSTS_FILE value is valid
+      everywhere (per-host /etc/hosts writability can differ).
+    - /etc/hosts additionally gets the block when writable (cloud VMs
+      run as a sudoer; k8s pods are root in-container), giving real
+      resolver-level hostnames.
+    - SKYPILOT_HOSTS_FILE overrides the /etc/hosts target (tests).
+    - Updates are serialized via flock and rewrite CONTENT (cat >),
+      never the inode — /etc/hosts is a bind mount in containers and
+      mv would break it; unlocked read-modify-write from two
+      concurrently recovering controllers could tear the block.
+    """
+    begin = _HOSTS_BEGIN.replace('/', '\\/')
+    end = _HOSTS_END.replace('/', '\\/')
+    return f'''
+set -e
+b64='{block_b64}'
+update() {{
+  f="$1"
+  [ -e "$f" ] || touch "$f" 2>/dev/null || return 1
+  [ -w "$f" ] || return 1
+  awk '/{begin}/{{skip=1}} !skip{{print}} /{end}/{{skip=0}}' "$f" > "$f.skytmp" || return 1
+  if [ -n "$b64" ]; then printf %s "$b64" | base64 -d >> "$f.skytmp"; fi
+  cat "$f.skytmp" > "$f" && rm -f "$f.skytmp"
+}}
+run_locked() {{
+  if command -v flock >/dev/null 2>&1; then
+    flock 9
+  fi
+  fixed='/tmp/skypilot-jobgroup-{group_name}.hosts'
+  if [ -n "$b64" ]; then
+    update "$fixed"
+    echo "installed:$fixed"
+  else
+    rm -f "$fixed"
+  fi
+  target="${{SKYPILOT_HOSTS_FILE:-/etc/hosts}}"
+  if update "$target"; then echo "installed:$target"; fi
+  true
+}}
+run_locked 9> /tmp/.skypilot-jobgroup-hosts.lock
+'''
+
+
+def install_hosts_entries(handle, group_name: str,
+                          max_attempts: int = 3) -> str:
+    """Install the group's hosts block on every host of a member
+    cluster (parallel fan-out, per-host retries); returns the
+    cluster-wide path for SKYPILOT_JOBGROUP_HOSTS_FILE.
+
+    Raises only after `max_attempts` failures on some host — callers
+    on the launch path degrade gracefully (peer env addresses remain
+    the source of truth; hostnames are convenience).
+    """
+    import base64
+
+    from skypilot_tpu.utils import subprocess_utils
+    block_b64 = base64.b64encode(
+        hosts_block(group_name).encode()).decode()
+    script = _hosts_update_script(block_b64, group_name)
+    landing = f'/tmp/skypilot-jobgroup-{group_name}.hosts'
+
+    def _one(runner) -> None:
+        last_err = ''
+        for attempt in range(max_attempts):
+            rc, _, err = runner.run(script, require_outputs=True)
+            if rc == 0:
+                return
+            last_err = err[-300:]
+            time.sleep(1.0 * (attempt + 1))
+        raise exceptions.SkyError(
+            f'Job group {group_name!r}: hosts injection failed on '
+            f'{runner!r} after {max_attempts} attempts: {last_err}')
+
+    subprocess_utils.run_in_parallel(_one, handle.get_command_runners())
+    # The fixed path is the cluster-wide contract: same absolute path
+    # on every host regardless of per-host /etc/hosts writability.
+    return landing
+
+
+def remove_hosts_entries(handle, group_name: str) -> None:
+    """Best-effort removal of the managed block + fixed-path file on
+    every host (cleanup when a member ends; pool workers are REUSED,
+    so stale name->IP mappings must not leak into the next job)."""
+    from skypilot_tpu.utils import subprocess_utils
+    script = _hosts_update_script('', group_name)
+
+    def _one(runner) -> None:
+        try:
+            runner.run(script, require_outputs=True)
+        except Exception:  # pylint: disable=broad-except
+            pass
+
+    try:
+        subprocess_utils.run_in_parallel(_one,
+                                         handle.get_command_runners())
+    except Exception:  # pylint: disable=broad-except
+        pass
 
 
 def cancel_group(group_name: str) -> List[int]:

@@ -37,6 +37,10 @@ class StrategyExecutor:
         self.cluster_name = cluster_name
         self.task = task
         self.blocked_resources: Set[Any] = set()
+        # Job-group members set this (controller): runs on the cluster
+        # handle between provision/setup and job submission, so peer
+        # hostname injection precedes the user job even on recovery.
+        self.pre_exec_hook = None
 
     @classmethod
     def make(cls, cluster_name: str,
@@ -86,7 +90,8 @@ class StrategyExecutor:
                     detach_run=True,
                     _quiet_optimizer=True,
                     _is_launched_by_jobs_controller=True,
-                    _blocked_resources=self.blocked_resources or None)
+                    _blocked_resources=self.blocked_resources or None,
+                    _pre_exec_hook=self.pre_exec_hook)
                 assert handle is not None and job_id is not None
                 return job_id
             except (exceptions.ResourcesUnavailableError,

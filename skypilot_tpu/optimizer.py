@@ -30,6 +30,7 @@ from skypilot_tpu import dag as dag_lib
 from skypilot_tpu import exceptions
 from skypilot_tpu import resources as resources_lib
 from skypilot_tpu import task as task_lib
+from skypilot_tpu.utils import ux_utils
 from skypilot_tpu.utils.registry import CLOUD_REGISTRY
 
 
@@ -75,6 +76,81 @@ class Optimizer:
         if not quiet:
             cls._print_table(dag, per_task, choice)
         return dag
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def optimize_group(
+        cls, tasks: List[task_lib.Task],
+        minimize: OptimizeTarget = OptimizeTarget.COST,
+        blocked_resources: Optional[Set[resources_lib.Resources]] = None,
+        quiet: bool = False,
+    ) -> Optional[Tuple[str, str]]:
+        """ONE joint placement for a job group: the same cloud+region
+        for every member (reference: sky/optimizer.py:1037
+        optimize_job_group / _optimize_same_infra — the SAME_INFRA
+        constraint keeps RL actor/learner pairs and disaggregated
+        serving on intra-region links).
+
+        Pins each task's best_resources to the chosen (cloud, region)
+        and returns it; returns None when no common infra exists
+        (caller falls back to independent placement, matching the
+        reference's fallback).
+        """
+        # task -> {(cloud, region): (candidate_pinned_to_region, objective)}
+        per_task: List[Tuple[task_lib.Task, Dict]] = []
+        for task in tasks:
+            candidates = cls._enumerate_candidates(task, blocked_resources)
+            if not candidates:
+                fuzzy = cls._fuzzy_candidates(task)
+                hint = (f' Try: {", ".join(fuzzy[:6])}.' if fuzzy else '')
+                raise exceptions.ResourcesUnavailableError(
+                    f'No launchable resources satisfy the request for '
+                    f'group member {task.name or "<unnamed>"}.{hint}')
+            infra_map: Dict[Tuple[str, str],
+                            Tuple[resources_lib.Resources, float]] = {}
+            for cand, _, seconds in candidates:
+                cloud = cand.cloud
+                try:
+                    regions = cloud.regions_with_offering(
+                        cand.instance_type, cand.accelerators,
+                        cand.use_spot, cand.region, cand.zone)
+                except Exception:  # pylint: disable=broad-except
+                    continue
+                for region in regions:
+                    pinned = cand.copy(region=region.name)
+                    try:
+                        hourly = pinned.get_hourly_cost()
+                    except ValueError:
+                        continue
+                    if minimize == OptimizeTarget.TIME:
+                        objective = seconds
+                    else:
+                        objective = (hourly * task.num_nodes *
+                                     seconds / 3600.0)
+                    key = (cloud.canonical_name(), region.name)
+                    if key not in infra_map or \
+                            objective < infra_map[key][1]:
+                        infra_map[key] = (pinned, objective)
+            per_task.append((task, infra_map))
+
+        common = set(per_task[0][1])
+        for _, infra_map in per_task[1:]:
+            common &= set(infra_map)
+        if not common:
+            return None
+        best = min(common,
+                   key=lambda k: (sum(m[k][1] for _, m in per_task), k))
+        for task, infra_map in per_task:
+            task.best_resources = infra_map[best][0]
+        if not quiet:
+            total = sum(m[best][1] for _, m in per_task)
+            unit = 'h' if minimize == OptimizeTarget.TIME else '$'
+            names = ', '.join(t.name or '<unnamed>' for t, _ in per_task)
+            ux_utils.log(
+                f'Job group placement: {best[0]}/{best[1]} for all '
+                f'{len(per_task)} members ({names}) — joint estimate '
+                f'{total:.2f}{unit}.')
+        return best
 
     # ------------------------------------------------------------------
     @classmethod

@@ -189,19 +189,32 @@ def test_controller_crash_readopts_running_job(jobs_env):
 
 
 @pytest.mark.slow
-def test_job_group_atomic_launch_and_peer_addresses(jobs_env):
-    """A 2-task group launches atomically and each task's env carries
-    the other's head address (reference:
+def test_job_group_atomic_launch_and_peer_addresses(jobs_env, monkeypatch,
+                                                    tmp_path):
+    """A 2-task group launches atomically; each task's env carries the
+    other's head address AND each member cluster gets stable peer
+    hostnames `<task>.<group>` via the managed hosts block (reference:
     sky/jobs/job_group_networking.py:1-21)."""
     from skypilot_tpu.jobs import groups
 
+    # Local cloud: route the hosts injection into a temp file instead
+    # of the real /etc/hosts (same script path, different target).
+    hosts_file = tmp_path / 'hosts'
+    monkeypatch.setenv('SKYPILOT_HOSTS_FILE', str(hosts_file))
+
     def member(name):
+        peer = 'learner' if name == 'actor' else 'actor'
         return {'name': name, 'resources': {'infra': 'local'},
                 'run': ('echo '
                         'actor=$SKYPILOT_JOBGROUP_ADDR_ACTOR '
                         'learner=$SKYPILOT_JOBGROUP_ADDR_LEARNER '
                         'group=$SKYPILOT_JOBGROUP '
-                        f'> /tmp/rl1-{name}.out')}
+                        f'> /tmp/rl1-{name}.out; '
+                        # Resolve the PEER by its stable name from the
+                        # injected hosts block.
+                        f'awk \'/ {peer}.rl1 /{{print "peer="$1}}\' '
+                        '"$SKYPILOT_JOBGROUP_HOSTS_FILE" '
+                        f'>> /tmp/rl1-{name}.out')}
 
     out = jobs_core.group_launch('rl1', [member('actor'),
                                          member('learner')], user='t')
@@ -223,7 +236,15 @@ def test_job_group_atomic_launch_and_peer_addresses(jobs_env):
             seen = f.read()
         assert 'actor=127.0.0.1' in seen and 'learner=127.0.0.1' in seen, \
             seen
+        # The job resolved its PEER's stable hostname from the block.
+        assert 'peer=127.0.0.1' in seen, seen
         os.remove(f'/tmp/rl1-{name}.out')
+    # The injected block carries both stable names (non-pooled members
+    # keep it — their clusters are terminated whole; pooled workers
+    # strip it on release, covered by the unit test).
+    injected = hosts_file.read_text()
+    assert 'actor.rl1 actor' in injected and 'learner.rl1 learner' in \
+        injected, injected
     # Group status + duplicate-name rejection.
     rows = jobs_core.group_status('rl1')
     assert {r['name'] for r in rows} == {'actor', 'learner'}

@@ -355,3 +355,64 @@ def test_hf_store_download_only():
         storage_lib.Storage(name='only-name',
                             store=storage_lib.StoreType.HF,
                             mode=storage_lib.StorageMode.COPY)
+
+
+def test_jobgroup_hosts_block_and_injection(isolated_state, monkeypatch,
+                                            tmp_path):
+    """The managed hosts block is idempotent (marker replacement) and
+    lands in SKYPILOT_HOSTS_FILE when /etc/hosts is not the target."""
+    from skypilot_tpu.jobs import groups, state
+
+    jid_a = state.submit_job('actor', {'name': 'actor'}, 'failover', 0, 'u')
+    jid_b = state.submit_job('learner', {'name': 'learner'}, 'failover',
+                             0, 'u')
+    db = groups._db()
+    for jid in (jid_a, jid_b):
+        db.execute('UPDATE managed_jobs SET job_group=? WHERE job_id=?',
+                   ('rl', jid))
+    groups.publish_address(jid_a, '10.0.0.5')
+    groups.publish_address(jid_b, '10.0.0.9')
+
+    block = groups.hosts_block('rl')
+    assert '10.0.0.5 actor.rl actor' in block
+    assert '10.0.0.9 learner.rl learner' in block
+
+    hosts = tmp_path / 'hosts'
+    hosts.write_text('127.0.0.1 localhost\n')
+    monkeypatch.setenv('SKYPILOT_HOSTS_FILE', str(hosts))
+
+    class FakeRunner:
+        def run(self, cmd, require_outputs=False, **kw):
+            import subprocess
+            p = subprocess.run(['bash', '-c', cmd], capture_output=True,
+                               text=True)
+            return p.returncode, p.stdout, p.stderr
+
+    class FakeHandle:
+        def get_command_runners(self):
+            return [FakeRunner()]
+
+    landed = groups.install_hosts_entries(FakeHandle(), 'rl')
+    # The env-var contract is the fixed absolute path (valid on every
+    # host); the SKYPILOT_HOSTS_FILE target ALSO gets the block.
+    assert landed == '/tmp/skypilot-jobgroup-rl.hosts'
+    assert 'actor.rl' in open(landed, encoding='utf-8').read()
+    content = hosts.read_text()
+    assert content.startswith('127.0.0.1 localhost')
+    assert content.count('actor.rl') == 1
+
+    # Recovery republish: new IP replaces the block, no duplication.
+    groups.publish_address(jid_a, '10.0.0.77')
+    groups.install_hosts_entries(FakeHandle(), 'rl')
+    content = hosts.read_text()
+    assert '10.0.0.77 actor.rl actor' in content
+    assert '10.0.0.5' not in content
+    assert content.count('actor.rl') == 1
+    assert content.count('localhost') == 1
+
+    # Cleanup strips the block and the fixed-path file (pool workers
+    # are reused; stale name->IP mappings must not leak).
+    groups.remove_hosts_entries(FakeHandle(), 'rl')
+    assert not os.path.exists(landed)
+    after = hosts.read_text()
+    assert 'actor.rl' not in after and 'localhost' in after

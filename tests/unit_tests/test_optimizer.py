@@ -276,3 +276,45 @@ def test_vm_cross_region_pricing(all_clouds):
     assert pinned.best_resources.get_hourly_cost() == pytest.approx(0.5005)
     assert (pinned.best_resources.get_hourly_cost() >
             free.best_resources.get_hourly_cost())
+
+
+def test_group_joint_placement_same_infra(all_clouds):
+    """One placement decision per group (reference: sky/optimizer.py
+    :1037 SAME_INFRA): members land on ONE common cloud+region, chosen
+    to minimize the group SUM, honoring per-member region pins."""
+    from skypilot_tpu.optimizer import Optimizer as Opt
+
+    # Unpinned members: joint choice is the cheapest common region.
+    a = sky.Task(name='a', run='true')
+    a.set_resources(sky.Resources(cloud='gcp',
+                                  instance_type='n2-standard-8'))
+    b = sky.Task(name='b', run='true')
+    b.set_resources(sky.Resources(cloud='gcp',
+                                  instance_type='e2-standard-8'))
+    infra = Opt.optimize_group([a, b], quiet=True)
+    assert infra == ('gcp', 'us-central1')
+    assert a.best_resources.region == 'us-central1'
+    assert b.best_resources.region == 'us-central1'
+
+    # One member pinned to a pricier region drags the whole group
+    # there (SAME_INFRA beats per-member cheapest).
+    c = sky.Task(name='c', run='true')
+    c.set_resources(sky.Resources(cloud='gcp',
+                                  instance_type='n2-standard-8',
+                                  region='asia-northeast1'))
+    d = sky.Task(name='d', run='true')
+    d.set_resources(sky.Resources(cloud='gcp',
+                                  instance_type='e2-standard-8'))
+    infra = Opt.optimize_group([c, d], quiet=True)
+    assert infra == ('gcp', 'asia-northeast1')
+    assert d.best_resources.region == 'asia-northeast1'
+
+
+def test_group_no_common_infra_returns_none(all_clouds):
+    from skypilot_tpu.optimizer import Optimizer as Opt
+    a = sky.Task(name='a', run='true')
+    a.set_resources(sky.Resources(cloud='gcp',
+                                  instance_type='n2-standard-8'))
+    b = sky.Task(name='b', run='true')
+    b.set_resources(sky.Resources(infra='local'))
+    assert Opt.optimize_group([a, b], quiet=True) is None
