@@ -234,31 +234,31 @@ class JobController:
         # hostname block is installed via the pre-exec hook — between
         # (re)provision and job submission — so jobs that resolve
         # peers at startup never race it, on launch OR recovery.
-        addrs = groups.wait_peer_addresses(self.group, self.job_id)
-        self.task.update_envs({
-            'SKYPILOT_JOBGROUP': self.group,
-            'SKYPILOT_JOBGROUP_HOSTS_FILE':
-                f'/tmp/skypilot-jobgroup-{self.group}.hosts',
-            **addrs,
-        })
+        groups.wait_peer_addresses(self.group, self.job_id)
         self.executor.task = self.task
         self.executor.pre_exec_hook = self._group_pre_exec
         return self.executor.launch()
 
     def _group_pre_exec(self, handle) -> None:
         """Pre-submission cluster prep for a group member: publish the
-        (possibly new) head address, install the peer hostname block.
-        Hostname injection failures DEGRADE (warn) rather than fail the
-        member — the peer-address env vars remain the source of truth,
-        and failing here would abort the whole group."""
+        (possibly new) head address, refresh the peer-address env vars
+        from the DB (an ADOPTED controller's task was rebuilt from the
+        stored config and has none), and install the peer hostname
+        block. Hostname injection failures DEGRADE (warn) rather than
+        fail the member — the peer-address env vars remain the source
+        of truth, and failing here would abort the whole group."""
         from skypilot_tpu.jobs import groups
         head = handle.cluster_info.get_head_instance()
         if head is not None:
             groups.publish_address(self.job_id, head.internal_ip)
+        self.task.update_envs({
+            'SKYPILOT_JOBGROUP': self.group,
+            'SKYPILOT_JOBGROUP_HOSTS_FILE':
+                groups.hosts_file_path(self.group),
+            **groups.peer_addresses(self.group),
+        })
         try:
-            hosts_path = groups.install_hosts_entries(handle, self.group)
-            self.task.update_envs(
-                {'SKYPILOT_JOBGROUP_HOSTS_FILE': hosts_path})
+            groups.install_hosts_entries(handle, self.group)
         except Exception as e:  # pylint: disable=broad-except
             ux_utils.log(
                 f'Job group {self.group!r}: hostname injection failed '

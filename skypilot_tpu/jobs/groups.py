@@ -29,10 +29,33 @@ from skypilot_tpu.utils import ux_utils
 
 _PUBLISH_TIMEOUT_SECONDS = 900.0
 
-# Managed /etc/hosts block markers (idempotent re-injection on
-# recovery republish).
-_HOSTS_BEGIN = '# >>> skypilot-jobgroup >>>'
-_HOSTS_END = '# <<< skypilot-jobgroup <<<'
+# Group/task names end up in hostnames, shell scripts, and file
+# paths: restrict to hostname-safe tokens (also prevents shell
+# injection via the remote hosts-update script).
+_NAME_RE = re.compile(r'^[A-Za-z0-9][A-Za-z0-9_.-]{0,63}$')
+
+
+def _validate_name(kind: str, name: str) -> None:
+    if not _NAME_RE.match(name or ''):
+        raise exceptions.SkyError(
+            f'{kind} {name!r} must be hostname-safe: start with an '
+            f'alphanumeric, then [A-Za-z0-9_.-], max 64 chars.')
+
+
+def hosts_file_path(group_name: str) -> str:
+    """The fixed-path hosts file: same absolute path on every host of
+    every member cluster (the SKYPILOT_JOBGROUP_HOSTS_FILE value)."""
+    return f'/tmp/skypilot-jobgroup-{group_name}.hosts'
+
+
+def _hosts_begin(group_name: str) -> str:
+    # GROUP-SCOPED markers: two groups sharing one /etc/hosts (Local
+    # cloud; any shared host) must not wipe each other's blocks.
+    return f'# >>> skypilot-jobgroup {group_name} >>>'
+
+
+def _hosts_end(group_name: str) -> str:
+    return f'# <<< skypilot-jobgroup {group_name} <<<'
 
 
 def _db():
@@ -51,11 +74,14 @@ def launch_group(group_name: str, task_configs: List[Dict[str, Any]],
     """
     if not task_configs:
         raise exceptions.SkyError('Job group needs at least one task.')
+    _validate_name('Job group name', group_name)
     names = [cfg.get('name') for cfg in task_configs]
     if None in names or len(set(names)) != len(names):
         raise exceptions.SkyError(
             'Every task in a job group needs a unique name; got '
             f'{names}.')
+    for name in names:
+        _validate_name('Group task name', name)
     from skypilot_tpu.jobs import scheduler
     if len(task_configs) > scheduler.MAX_STARTING_JOBS:
         raise exceptions.SkyError(
@@ -179,13 +205,21 @@ def hosts_block(group_name: str) -> str:
     stable names `<task>.<group>` and `<task>` (reference:
     sky/jobs/job_group_networking.py:1-21 — address resolution via
     /etc/hosts injection or native DNS)."""
-    lines = [_HOSTS_BEGIN]
+    lines = [_hosts_begin(group_name)]
     for r in members(group_name):
         if r.get('head_ip'):
             lines.append(f'{r["head_ip"]} {r["name"]}.{group_name} '
                          f'{r["name"]}')
-    lines.append(_HOSTS_END)
+    lines.append(_hosts_end(group_name))
     return '\n'.join(lines) + '\n'
+
+
+def peer_addresses(group_name: str) -> Dict[str, str]:
+    """{env_var: ip} for every member that has published — the
+    non-blocking form of wait_peer_addresses (adopted controllers
+    rebuild the env from here; the DB survives controller death)."""
+    return {_env_var_for(r['name']): r['head_ip']
+            for r in members(group_name) if r.get('head_ip')}
 
 
 def _hosts_update_script(block_b64: str, group_name: str) -> str:
@@ -205,8 +239,10 @@ def _hosts_update_script(block_b64: str, group_name: str) -> str:
       mv would break it; unlocked read-modify-write from two
       concurrently recovering controllers could tear the block.
     """
-    begin = _HOSTS_BEGIN.replace('/', '\\/')
-    end = _HOSTS_END.replace('/', '\\/')
+    # group_name is validated hostname-safe (launch_group), so the
+    # f-string interpolations below cannot break out of the script.
+    begin = _hosts_begin(group_name).replace('/', '\\/')
+    end = _hosts_end(group_name).replace('/', '\\/')
     return f'''
 set -e
 b64='{block_b64}'
@@ -222,7 +258,7 @@ run_locked() {{
   if command -v flock >/dev/null 2>&1; then
     flock 9
   fi
-  fixed='/tmp/skypilot-jobgroup-{group_name}.hosts'
+  fixed='{hosts_file_path(group_name)}'
   if [ -n "$b64" ]; then
     update "$fixed"
     echo "installed:$fixed"
@@ -253,7 +289,7 @@ def install_hosts_entries(handle, group_name: str,
     block_b64 = base64.b64encode(
         hosts_block(group_name).encode()).decode()
     script = _hosts_update_script(block_b64, group_name)
-    landing = f'/tmp/skypilot-jobgroup-{group_name}.hosts'
+    landing = hosts_file_path(group_name)
 
     def _one(runner) -> None:
         last_err = ''

@@ -44,6 +44,11 @@ class Autoscaler:
         wants_spot_mix = bool(
             getattr(spec, 'base_ondemand_fallback_replicas', 0) or
             getattr(spec, 'dynamic_ondemand_fallback', False))
+        # A dict target_qps_per_replica ({accelerator: qps}) selects
+        # the instance-aware scaler (mixed v5e/v5p fleets), which also
+        # carries the spot floor/backfill mix.
+        if isinstance(spec.target_qps_per_replica, dict):
+            return InstanceAwareRequestRateAutoscaler(spec)
         if spec.autoscaling_enabled:
             chosen = AUTOSCALER_REGISTRY.get(
                 getattr(spec, 'autoscaler', 'request_rate'))
@@ -64,8 +69,11 @@ class Autoscaler:
     def request_done(self, count: int = 1) -> None:
         """Called on request *completion* (queue-based scalers use it)."""
 
-    def evaluate(self, num_ready: int,
-                 num_launching: int) -> AutoscalerDecision:
+    def evaluate(self, num_ready: int, num_launching: int,
+                 now: Optional[float] = None,
+                 ready_capacities: Optional[List[float]] = None
+                 ) -> AutoscalerDecision:
+        del now, ready_capacities  # fixed target ignores load signals
         total = num_ready + num_launching
         if total < self.target_num_replicas:
             return AutoscalerDecision(AutoscalerDecisionOperator.SCALE_UP,
@@ -113,16 +121,9 @@ class RequestRateAutoscaler(Autoscaler):
         return len(self._request_timestamps) / self._QPS_WINDOW_SECONDS
 
     # -- decision ----------------------------------------------------------
-    def evaluate(self, num_ready: int, num_launching: int,
-                 now: Optional[float] = None) -> AutoscalerDecision:
-        now = now if now is not None else time.time()
-        qps = self.current_qps(now)
-        assert self.spec.target_qps_per_replica is not None
-        desired = math.ceil(qps / self.spec.target_qps_per_replica)
-        desired = max(self.spec.min_replicas,
-                      min(self.spec.max_replicas, desired))
-        total = num_ready + num_launching
-
+    def _apply_hysteresis(self, desired: int, now: float) -> None:
+        """Commit a target move only after it persisted for the
+        upscale/downscale delay (shared by every rate scaler)."""
         if desired > self.target_num_replicas:
             self._downscale_candidate_since = None
             if self._upscale_candidate_since is None:
@@ -143,6 +144,7 @@ class RequestRateAutoscaler(Autoscaler):
             self._upscale_candidate_since = None
             self._downscale_candidate_since = None
 
+    def _decide(self, total: int) -> AutoscalerDecision:
         if total < self.target_num_replicas:
             return AutoscalerDecision(AutoscalerDecisionOperator.SCALE_UP,
                                       self.target_num_replicas)
@@ -150,6 +152,20 @@ class RequestRateAutoscaler(Autoscaler):
             return AutoscalerDecision(AutoscalerDecisionOperator.SCALE_DOWN,
                                       self.target_num_replicas)
         return AutoscalerDecision(AutoscalerDecisionOperator.NO_OP, total)
+
+    def evaluate(self, num_ready: int, num_launching: int,
+                 now: Optional[float] = None,
+                 ready_capacities: Optional[List[float]] = None
+                 ) -> AutoscalerDecision:
+        del ready_capacities  # uniform fleet: every replica equal
+        now = now if now is not None else time.time()
+        qps = self.current_qps(now)
+        assert self.spec.target_qps_per_replica is not None
+        desired = math.ceil(qps / self.spec.target_qps_per_replica)
+        desired = max(self.spec.min_replicas,
+                      min(self.spec.max_replicas, desired))
+        self._apply_hysteresis(desired, now)
+        return self._decide(num_ready + num_launching)
 
 
 @AUTOSCALER_REGISTRY.register(name='queue_length')
@@ -178,7 +194,10 @@ class QueueLengthAutoscaler(Autoscaler):
         self._in_flight = max(0, self._in_flight - count)
 
     def evaluate(self, num_ready: int, num_launching: int,
-                 now: Optional[float] = None) -> AutoscalerDecision:
+                 now: Optional[float] = None,
+                 ready_capacities: Optional[List[float]] = None
+                 ) -> AutoscalerDecision:
+        del ready_capacities
         now = now if now is not None else time.time()
         desired = math.ceil(self._in_flight / self.target_queue_per_replica)
         desired = max(self.spec.min_replicas,
@@ -229,12 +248,15 @@ class SpotRequestRateAutoscaler(RequestRateAutoscaler):
     """
 
     def evaluate(self, num_ready: int, num_launching: int,
-                 now: Optional[float] = None) -> AutoscalerDecision:
+                 now: Optional[float] = None,
+                 ready_capacities: Optional[List[float]] = None
+                 ) -> AutoscalerDecision:
         # Fixed-count specs (no target_qps) still use the spot mix:
         # fall back to the base fixed-target decision.
         if self.spec.target_qps_per_replica is None:
             return Autoscaler.evaluate(self, num_ready, num_launching)
-        return super().evaluate(num_ready, num_launching, now)
+        return super().evaluate(num_ready, num_launching, now,
+                                ready_capacities)
 
     def desired_mix(self, num_ready_spot: int) -> ReplicaMix:
         target = self.target_num_replicas
@@ -244,3 +266,76 @@ class SpotRequestRateAutoscaler(RequestRateAutoscaler):
         if self.spec.dynamic_ondemand_fallback:
             od_target += max(0, spot_target - num_ready_spot)
         return ReplicaMix(spot=spot_target, ondemand=od_target)
+
+
+@AUTOSCALER_REGISTRY.register(name='instance_aware')
+class InstanceAwareRequestRateAutoscaler(SpotRequestRateAutoscaler):
+    """Request-rate scaling over a MIXED fleet: per-accelerator QPS
+    capacity, load normalized by what each ready replica can actually
+    serve.
+
+    Reference: sky/serve/autoscalers.py:605
+    (InstanceAwareRequestRateAutoscaler) — selected when
+    `target_qps_per_replica` is a dict {accelerator: qps}, e.g.
+    {'tpu-v5e-8': 4, 'tpu-v5p-8': 10} for a v5e+v5p fleet where one
+    v5p replica replaces ~2.5 v5e replicas. Subclasses the spot-mix
+    scaler, so the on-demand floor + dynamic backfill compose with
+    capacity normalization (the reference keeps these as separate
+    classes; here mixed fleets get both).
+
+    Scaling rule (matching the reference's):
+    - qps >= sum(ready capacities): scale up by
+      ceil(overflow / max capacity) above the current count (the
+      largest replica class is what launches next).
+    - qps < sum: walk ready capacities LARGEST FIRST until they cover
+      the qps; that count is the target (retire small replicas first).
+    - no ready replicas: min_replicas.
+    Hysteresis delays apply as in the base scaler.
+    """
+
+    def __init__(self, spec: 'spec_lib.SkyServiceSpec') -> None:
+        super().__init__(spec)
+        assert isinstance(spec.target_qps_per_replica, dict), (
+            'instance_aware autoscaler needs a {accelerator: qps} dict')
+        self.qps_map = {str(k): float(v)
+                        for k, v in spec.target_qps_per_replica.items()}
+
+    def capacity_of(self, accelerator: Optional[str]) -> float:
+        """QPS capacity of one replica by its accelerator; unknown
+        hardware is assumed as capable as the best known class (the
+        conservative choice against over-scaling)."""
+        if accelerator is not None and accelerator in self.qps_map:
+            return self.qps_map[accelerator]
+        return max(self.qps_map.values())
+
+    def evaluate(self, num_ready: int, num_launching: int,
+                 now: Optional[float] = None,
+                 ready_capacities: Optional[List[float]] = None
+                 ) -> AutoscalerDecision:
+        now = now if now is not None else time.time()
+        qps = self.current_qps(now)
+        max_cap = max(self.qps_map.values())
+        # Launching replicas are CREDITED at the largest-class capacity
+        # — otherwise every evaluation during a long TPU provision
+        # re-counts the same overflow and ratchets desired up to
+        # max_replicas before the first launch turns ready.
+        caps = sorted(list(ready_capacities or []) +
+                      [max_cap] * num_launching, reverse=True)
+        total_cap = sum(caps)
+        if not caps:
+            desired = self.spec.min_replicas
+        elif qps >= total_cap:
+            overflow = qps - total_cap
+            desired = len(caps) + math.ceil(overflow / max_cap)
+        else:
+            desired = 0
+            covered = 0.0
+            for cap in caps:
+                desired += 1
+                covered += cap
+                if covered > qps:
+                    break
+        desired = max(self.spec.min_replicas,
+                      min(self.spec.max_replicas, desired))
+        self._apply_hysteresis(desired, now)
+        return self._decide(num_ready + num_launching)

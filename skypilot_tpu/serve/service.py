@@ -204,6 +204,13 @@ class ServeController:
             meta = self._replica_meta[replica_id]
             meta['weight'] = float(handle.num_hosts)
             meta['endpoint'] = endpoint
+            # Hardware class for the instance-aware autoscaler (mixed
+            # fleets normalize load by per-replica QPS capacity).
+            launched = handle.launched_resources
+            if launched is not None and launched.accelerators:
+                meta['accelerator'] = next(iter(launched.accelerators))
+            elif launched is not None and launched.instance_type:
+                meta['accelerator'] = launched.instance_type
             serve_state.set_replica_meta(self.name, replica_id, meta)
             serve_state.set_replica_status(self.name, replica_id,
                                            serve_state.ReplicaStatus.STARTING,
@@ -332,8 +339,20 @@ class ServeController:
             r['status'] != S.SHUTTING_DOWN and
             r['replica_id'] not in ready_ids)
 
-        # Autoscale against the current version only.
-        decision = self.autoscaler.evaluate(len(ready_new), launching_new)
+        # Autoscale against the current version only. Mixed fleets
+        # (instance-aware scaler) get each ready replica's QPS
+        # capacity so load is normalized by hardware.
+        ready_capacities = None
+        if isinstance(self.autoscaler,
+                      autoscalers.InstanceAwareRequestRateAutoscaler):
+            ready_capacities = [
+                self.autoscaler.capacity_of(
+                    self._replica_meta.get(r['replica_id'],
+                                           {}).get('accelerator'))
+                for r in ready_new]
+        decision = self.autoscaler.evaluate(
+            len(ready_new), launching_new,
+            ready_capacities=ready_capacities)
         if decision.operator == \
                 autoscalers.AutoscalerDecisionOperator.SCALE_UP:
             want = (decision.target_num_replicas - len(ready_new) -

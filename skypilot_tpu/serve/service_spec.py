@@ -19,7 +19,10 @@ class SkyServiceSpec:
                  post_data: Optional[Any] = None,
                  min_replicas: int = 1,
                  max_replicas: Optional[int] = None,
-                 target_qps_per_replica: Optional[float] = None,
+                 # float (uniform fleet) or {accelerator: qps} dict —
+                 # the dict selects the instance-aware autoscaler
+                 # (reference: sky/serve/autoscalers.py:605).
+                 target_qps_per_replica: Optional[Any] = None,
                  upscale_delay_seconds: int = 60,
                  downscale_delay_seconds: int = 120,
                  port: Optional[int] = None,
@@ -51,7 +54,14 @@ class SkyServiceSpec:
         if self.max_replicas < self.min_replicas:
             raise exceptions.InvalidTaskYAMLError(
                 'max_replicas < min_replicas')
-        if (self.target_qps_per_replica is not None and
+        if isinstance(self.target_qps_per_replica, dict):
+            if not self.target_qps_per_replica or any(
+                    float(v) <= 0
+                    for v in self.target_qps_per_replica.values()):
+                raise exceptions.InvalidTaskYAMLError(
+                    'target_qps_per_replica accelerator map needs at '
+                    'least one entry and all-positive qps values')
+        elif (self.target_qps_per_replica is not None and
                 self.target_qps_per_replica <= 0):
             raise exceptions.InvalidTaskYAMLError(
                 'target_qps_per_replica must be positive')
@@ -92,8 +102,10 @@ class SkyServiceSpec:
             if 'max_replicas' in policy:
                 kwargs['max_replicas'] = int(policy.pop('max_replicas'))
             if 'target_qps_per_replica' in policy:
-                kwargs['target_qps_per_replica'] = float(
-                    policy.pop('target_qps_per_replica'))
+                raw = policy.pop('target_qps_per_replica')
+                kwargs['target_qps_per_replica'] = (
+                    {str(k): float(v) for k, v in raw.items()}
+                    if isinstance(raw, dict) else float(raw))
             for key in ('upscale_delay_seconds', 'downscale_delay_seconds',
                         'base_ondemand_fallback_replicas'):
                 if key in policy:

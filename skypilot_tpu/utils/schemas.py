@@ -61,8 +61,15 @@ _SERVICE_SCHEMA: Dict[str, Any] = {
             'properties': {
                 'min_replicas': {'type': 'integer', 'minimum': 0},
                 'max_replicas': {'type': 'integer', 'minimum': 0},
-                'target_qps_per_replica': {'type': 'number',
-                                           'exclusiveMinimum': 0},
+                # Number (uniform fleet) or {accelerator: qps} map
+                # (mixed fleet -> instance-aware autoscaler).
+                'target_qps_per_replica': {
+                    'anyOf': [
+                        {'type': 'number', 'exclusiveMinimum': 0},
+                        {'type': 'object', 'minProperties': 1,
+                         'additionalProperties': {
+                             'type': 'number', 'exclusiveMinimum': 0}},
+                    ]},
                 'upscale_delay_seconds': {'type': 'integer'},
                 'downscale_delay_seconds': {'type': 'integer'},
                 'base_ondemand_fallback_replicas': {'type': 'integer',

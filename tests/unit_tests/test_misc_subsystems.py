@@ -416,3 +416,172 @@ def test_jobgroup_hosts_block_and_injection(isolated_state, monkeypatch,
     assert not os.path.exists(landed)
     after = hosts.read_text()
     assert 'actor.rl' not in after and 'localhost' in after
+
+
+def test_instance_aware_autoscaler_mixed_fleet():
+    """Mixed v5e+v5p fleet scales on NORMALIZED QPS (reference:
+    sky/serve/autoscalers.py:605): capacity comes from the
+    per-accelerator map, upscale sizes by the largest class, and
+    downscale covers the load with the biggest replicas first."""
+    from skypilot_tpu.serve.autoscalers import (
+        Autoscaler, AutoscalerDecisionOperator,
+        InstanceAwareRequestRateAutoscaler)
+    from skypilot_tpu.serve.service_spec import SkyServiceSpec
+    spec = SkyServiceSpec(min_replicas=1, max_replicas=10,
+                          target_qps_per_replica={'tpu-v5e-8': 4.0,
+                                                  'tpu-v5p-8': 10.0},
+                          upscale_delay_seconds=0,
+                          downscale_delay_seconds=0)
+    a = Autoscaler.make(spec)
+    assert isinstance(a, InstanceAwareRequestRateAutoscaler)
+    assert a.capacity_of('tpu-v5e-8') == 4.0
+    assert a.capacity_of('tpu-v5p-8') == 10.0
+    assert a.capacity_of('unknown-hw') == 10.0  # best-known class
+
+    # 17.5 QPS against one ready v5e (4 qps): overflow 13.5 sized by
+    # the LARGEST class (10) -> +2 replicas above the current 1.
+    now = 1000.0
+    a.collect_request_information(
+        int(17.5 * a._QPS_WINDOW_SECONDS), timestamp=now)
+    d = a.evaluate(num_ready=1, num_launching=0, now=now,
+                   ready_capacities=[4.0])
+    assert d.operator == AutoscalerDecisionOperator.SCALE_UP
+    assert a.target_num_replicas == 3
+
+    # Same 17.5 QPS with [10, 4, 4, 4] ready: 10+4+4 > 17.5 -> 3
+    # replicas cover it (largest first); the 4th is surplus.
+    d = a.evaluate(num_ready=4, num_launching=0, now=now,
+                   ready_capacities=[4.0, 10.0, 4.0, 4.0])
+    assert d.operator == AutoscalerDecisionOperator.SCALE_DOWN
+    assert a.target_num_replicas == 3
+
+    # A uniform v5p fleet needs only 2 replicas for the same load.
+    d = a.evaluate(num_ready=4, num_launching=0, now=now,
+                   ready_capacities=[10.0, 10.0, 10.0, 10.0])
+    assert a.target_num_replicas == 2
+
+    # No ready replicas: fall to min_replicas.
+    d = a.evaluate(num_ready=0, num_launching=0, now=now,
+                   ready_capacities=[])
+    assert a.target_num_replicas == 1
+
+
+def test_instance_aware_composes_with_spot_mix():
+    """The instance-aware scaler inherits the spot floor/backfill mix
+    (unified, where the reference keeps separate classes)."""
+    from skypilot_tpu.serve.autoscalers import (
+        Autoscaler, InstanceAwareRequestRateAutoscaler)
+    from skypilot_tpu.serve.service_spec import SkyServiceSpec
+    spec = SkyServiceSpec(min_replicas=2, max_replicas=8,
+                          target_qps_per_replica={'tpu-v5e-8': 4.0},
+                          base_ondemand_fallback_replicas=1,
+                          dynamic_ondemand_fallback=True,
+                          upscale_delay_seconds=0,
+                          downscale_delay_seconds=0)
+    a = Autoscaler.make(spec)
+    assert isinstance(a, InstanceAwareRequestRateAutoscaler)
+    a.target_num_replicas = 4
+    mix = a.desired_mix(num_ready_spot=1)
+    # 1 on-demand floor + (3 spot target - 1 ready) dynamic backfill.
+    assert mix.spot == 3 and mix.ondemand == 3
+
+
+def test_service_spec_qps_map_roundtrip_and_validation():
+    import pytest as _pytest
+
+    from skypilot_tpu import exceptions
+    from skypilot_tpu.serve.service_spec import SkyServiceSpec
+    spec = SkyServiceSpec.from_yaml_config({
+        'readiness_probe': '/health',
+        'replica_policy': {
+            'min_replicas': 1, 'max_replicas': 4,
+            'target_qps_per_replica': {'tpu-v5e-8': 4,
+                                       'tpu-v5p-8': '10'},
+        }})
+    assert spec.target_qps_per_replica == {'tpu-v5e-8': 4.0,
+                                           'tpu-v5p-8': 10.0}
+    assert spec.autoscaling_enabled
+    round_tripped = SkyServiceSpec.from_yaml_config(spec.to_yaml_config())
+    assert round_tripped.target_qps_per_replica == \
+        spec.target_qps_per_replica
+    with _pytest.raises(exceptions.InvalidTaskYAMLError):
+        SkyServiceSpec(target_qps_per_replica={'v5e': -1})
+    with _pytest.raises(exceptions.InvalidTaskYAMLError):
+        SkyServiceSpec(target_qps_per_replica={})
+
+
+def test_instance_aware_no_ratchet_while_launching():
+    """In-flight launches are credited at the largest-class capacity:
+    repeated evaluations during a slow provision must NOT ratchet the
+    target toward max_replicas."""
+    from skypilot_tpu.serve.autoscalers import Autoscaler
+    from skypilot_tpu.serve.service_spec import SkyServiceSpec
+    spec = SkyServiceSpec(min_replicas=1, max_replicas=10,
+                          target_qps_per_replica={'tpu-v5e-8': 4.0,
+                                                  'tpu-v5p-8': 10.0},
+                          upscale_delay_seconds=0,
+                          downscale_delay_seconds=0)
+    a = Autoscaler.make(spec)
+    now = 1000.0
+    a.collect_request_information(int(20 * a._QPS_WINDOW_SECONDS),
+                                  timestamp=now)
+    a.evaluate(num_ready=1, num_launching=0, now=now,
+               ready_capacities=[4.0])
+    first_target = a.target_num_replicas  # 1 + ceil(16/10) = 3
+    assert first_target == 3
+    # The two launches are now in flight; the target must hold.
+    for _ in range(5):
+        a.collect_request_information(0, timestamp=now)
+        a.evaluate(num_ready=1, num_launching=2, now=now,
+                   ready_capacities=[4.0])
+    assert a.target_num_replicas == first_target
+
+
+def test_group_name_validation(isolated_state):
+    from skypilot_tpu import exceptions
+    from skypilot_tpu.jobs import groups
+    bad = "x'; rm -rf $HOME; echo '"
+    with pytest.raises(exceptions.SkyError, match='hostname-safe'):
+        groups.launch_group(bad, [{'name': 'a', 'run': 'true'}], user='u')
+    with pytest.raises(exceptions.SkyError, match='hostname-safe'):
+        groups.launch_group('ok', [{'name': 'has space', 'run': 'true'}],
+                            user='u')
+
+
+def test_hosts_markers_are_group_scoped(isolated_state, monkeypatch,
+                                        tmp_path):
+    """Two groups sharing one hosts file must not wipe each other."""
+    from skypilot_tpu.jobs import groups, state
+    for grp, nm, ip in (('g1', 'actor', '10.0.0.1'),
+                        ('g2', 'worker', '10.0.0.2')):
+        jid = state.submit_job(nm, {'name': nm}, 'failover', 0, 'u')
+        groups._db().execute(
+            'UPDATE managed_jobs SET job_group=? WHERE job_id=?',
+            (grp, jid))
+        groups.publish_address(jid, ip)
+
+    hosts = tmp_path / 'hosts'
+    hosts.write_text('127.0.0.1 localhost\n')
+    monkeypatch.setenv('SKYPILOT_HOSTS_FILE', str(hosts))
+
+    class FakeRunner:
+        def run(self, cmd, require_outputs=False, **kw):
+            import subprocess
+            p = subprocess.run(['bash', '-c', cmd], capture_output=True,
+                               text=True)
+            return p.returncode, p.stdout, p.stderr
+
+    class FakeHandle:
+        def get_command_runners(self):
+            return [FakeRunner()]
+
+    groups.install_hosts_entries(FakeHandle(), 'g1')
+    groups.install_hosts_entries(FakeHandle(), 'g2')
+    content = hosts.read_text()
+    assert 'actor.g1' in content and 'worker.g2' in content
+    groups.remove_hosts_entries(FakeHandle(), 'g2')
+    content = hosts.read_text()
+    assert 'actor.g1' in content          # g1 untouched
+    assert 'worker.g2' not in content
+    os.path.exists(groups.hosts_file_path('g1')) and \
+        os.remove(groups.hosts_file_path('g1'))
