@@ -330,7 +330,20 @@ class Handler(BaseHTTPRequestHandler):
         assert STATE is not None and STATE.jobs is not None
         follow = query.get('follow', '0') == '1'
         tail = int(query.get('tail', 0))
-        log_path = os.path.join(job['log_dir'], 'run.log')
+        # ?rank=i streams one rank's own file (job_driver writes
+        # rank-<i>.log per rank + the combined run.log).
+        rank = query.get('rank')
+        if rank not in (None, ''):
+            if not str(rank).isdigit():
+                self._json({'error': f'bad rank {rank!r}'}, code=400)
+                return
+            filename = f'rank-{int(rank)}.log'
+        else:
+            filename = 'run.log'
+        log_path = os.path.join(job['log_dir'], filename)
+        if filename != 'run.log' and not os.path.exists(log_path):
+            self._json({'error': f'no log for rank {rank}'}, code=404)
+            return
         self.send_response(200)
         self.send_header('Content-Type', 'text/plain; charset=utf-8')
         self.end_headers()

@@ -135,10 +135,13 @@ class AgentClient:
             time.sleep(2.0)
 
     def stream_job_logs(self, job_id: int, *, follow: bool = True,
-                        tail: int = 0) -> Iterator[str]:
+                        tail: int = 0,
+                        rank: Optional[int] = None) -> Iterator[str]:
         params = {'follow': '1' if follow else '0'}
         if tail:
             params['tail'] = str(tail)
+        if rank is not None:
+            params['rank'] = str(rank)
         self._probe()
         with requests.get(f'{self.base}/jobs/{job_id}/logs', params=params,
                           stream=True, timeout=(30, None),

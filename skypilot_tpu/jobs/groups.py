@@ -238,6 +238,10 @@ def _hosts_update_script(block_b64: str, group_name: str) -> str:
       never the inode — /etc/hosts is a bind mount in containers and
       mv would break it; unlocked read-modify-write from two
       concurrently recovering controllers could tear the block.
+    - The awk also strips blocks under the LEGACY unscoped markers
+      ('# >>> skypilot-jobgroup >>>') so a pre-scoping block cannot
+      shadow refreshed entries (the resolver returns the first
+      /etc/hosts match).
     """
     # group_name is validated hostname-safe (launch_group), so the
     # f-string interpolations below cannot break out of the script.
@@ -250,7 +254,7 @@ update() {{
   f="$1"
   [ -e "$f" ] || touch "$f" 2>/dev/null || return 1
   [ -w "$f" ] || return 1
-  awk '/{begin}/{{skip=1}} !skip{{print}} /{end}/{{skip=0}}' "$f" > "$f.skytmp" || return 1
+  awk '/{begin}/{{skip=1}} /# >>> skypilot-jobgroup >>>/{{skip=1}} !skip{{print}} /{end}/{{skip=0}}  /# <<< skypilot-jobgroup <<</{{skip=0}}' "$f" > "$f.skytmp" || return 1
   if [ -n "$b64" ]; then printf %s "$b64" | base64 -d >> "$f.skytmp"; fi
   cat "$f.skytmp" > "$f" && rm -f "$f.skytmp"
 }}

@@ -323,7 +323,10 @@ class InstanceAwareRequestRateAutoscaler(SpotRequestRateAutoscaler):
                       [max_cap] * num_launching, reverse=True)
         total_cap = sum(caps)
         if not caps:
-            desired = self.spec.min_replicas
+            # Cold start from zero replicas: observed load must still
+            # produce a target (min_replicas may be 0).
+            desired = max(self.spec.min_replicas,
+                          math.ceil(qps / max_cap))
         elif qps >= total_cap:
             overflow = qps - total_cap
             desired = len(caps) + math.ceil(overflow / max_cap)

@@ -397,13 +397,29 @@ class ServeController:
                 surplus_od_ids = set(
                     sorted(od_replicas, reverse=True)[:od_surplus])
 
+            # Capacity-aware victim order for mixed fleets: the
+            # instance-aware target assumes the LARGEST replicas stay
+            # (its cover walk is largest-first), so retire the
+            # smallest-capacity ones first — otherwise killing the one
+            # v5p a 3-replica target depends on under-provisions the
+            # service and oscillates terminate/launch.
+            def _cap(r) -> float:
+                if not isinstance(
+                        self.autoscaler,
+                        autoscalers.InstanceAwareRequestRateAutoscaler):
+                    return 0.0
+                return self.autoscaler.capacity_of(
+                    self._replica_meta.get(r['replica_id'],
+                                           {}).get('accelerator'))
+
             victims = sorted(
                 (r for r in replicas
                  if r['version'] == self.version and
                  not r['status'].is_terminal() and
                  r['status'] != S.SHUTTING_DOWN),
                 key=lambda r: (r['replica_id'] not in surplus_od_ids,
-                               r['status'] == S.READY, -r['replica_id']))
+                               r['status'] == S.READY, _cap(r),
+                               -r['replica_id']))
             for replica in victims[:max(0, excess)]:
                 threading.Thread(target=self._terminate_replica,
                                  args=(replica['replica_id'],),

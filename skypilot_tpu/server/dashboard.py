@@ -96,10 +96,84 @@ def _summary() -> Dict[str, Any]:
     }
 
 
+def _cluster_detail(name: str) -> Dict[str, Any]:
+    """Per-cluster drill-down: full events + the agent's job queue
+    (reference: the dashboard's clusters/[cluster] page)."""
+    from skypilot_tpu import global_state
+    record = global_state.get_cluster(name)
+    if record is None:
+        return {'error': f'no cluster {name!r}'}
+    handle = record['handle']
+    jobs = []
+    try:
+        for j in handle.agent().get_jobs():
+            jobs.append({
+                'job_id': j['job_id'],
+                'name': j.get('name'),
+                'status': j['status'].value,
+                'submitted_at': j.get('submitted_at'),
+                'num_ranks': j.get('num_ranks'),
+            })
+    except Exception as e:  # pylint: disable=broad-except
+        jobs = [{'error': str(e)}]
+    return {
+        'name': name,
+        'num_hosts': getattr(handle, 'num_hosts', None),
+        'events': global_state.get_cluster_events(name)[-50:],
+        'jobs': jobs,
+    }
+
+
+def _service_detail(name: str) -> Dict[str, Any]:
+    """Per-service drill-down: replica table with hardware/procurement
+    metadata (reference: the dashboard's serve/[service] page)."""
+    from skypilot_tpu.serve import serve_state
+    record = serve_state.get_service(name)
+    if record is None:
+        return {'error': f'no service {name!r}'}
+    metas = serve_state.get_replica_meta(name)
+    replicas = []
+    for r in serve_state.get_replicas(name):
+        meta = metas.get(r['replica_id'], {}) if isinstance(metas, dict) \
+            else {}
+        replicas.append({
+            'replica_id': r['replica_id'],
+            'version': r['version'],
+            'endpoint': r.get('endpoint'),
+            'status': r['status'].value,
+            'use_spot': meta.get('use_spot'),
+            'accelerator': meta.get('accelerator'),
+            'weight': meta.get('weight'),
+            'location': meta.get('location'),
+        })
+    return {
+        'name': name,
+        'version': record['version'],
+        'status': record['status'].value,
+        'lb_port': record.get('lb_port'),
+        'controller_pid': record.get('controller_pid'),
+        'replicas': replicas,
+    }
+
+
 async def summary(request: web.Request) -> web.Response:
     del request
     data = await asyncio.get_event_loop().run_in_executor(None, _summary)
     return web.json_response(data)
+
+
+async def cluster_detail(request: web.Request) -> web.Response:
+    name = request.match_info['name']
+    data = await asyncio.get_event_loop().run_in_executor(
+        None, _cluster_detail, name)
+    return web.json_response(data, status=404 if 'error' in data else 200)
+
+
+async def service_detail(request: web.Request) -> web.Response:
+    name = request.match_info['name']
+    data = await asyncio.get_event_loop().run_in_executor(
+        None, _service_detail, name)
+    return web.json_response(data, status=404 if 'error' in data else 200)
 
 
 async def index(request: web.Request) -> web.Response:
@@ -121,3 +195,5 @@ def register(app: web.Application) -> None:
     app.router.add_get('/dashboard', index)
     app.router.add_get('/dashboard/app.js', app_js)
     app.router.add_get('/dashboard/api/summary', summary)
+    app.router.add_get('/dashboard/api/cluster/{name}', cluster_detail)
+    app.router.add_get('/dashboard/api/service/{name}', service_detail)

@@ -1,6 +1,6 @@
-/* Dashboard SPA: live tables over /dashboard/api/summary + log tails
- * over the server's existing streaming endpoints. Vanilla JS — the
- * reference ships a 42k-LoC Next.js app; the data is the same. */
+/* Dashboard SPA: live tables, per-entity detail pages, and actions
+ * over the server's JSON API. Vanilla JS — the reference ships a
+ * 42k-LoC Next.js app; the data and verbs are the same. */
 'use strict';
 
 const TABS = ['Clusters', 'Jobs', 'Services', 'Requests', 'Users'];
@@ -9,12 +9,15 @@ let data = null;
 let logAbort = null;
 
 const $ = (id) => document.getElementById(id);
+const esc = (s) => String(s == null ? '-' : s).replace(/&/g, '&amp;').replace(/</g, '&lt;');
 
 /* Auth: once a service-account token is issued the server requires it
  * everywhere; the SPA keeps one in sessionStorage and prompts on 401. */
-function authHeaders() {
+function authHeaders(json) {
   const t = sessionStorage.getItem('sky_token');
-  return t ? { Authorization: `Bearer ${t}` } : {};
+  const h = t ? { Authorization: `Bearer ${t}` } : {};
+  if (json) h['Content-Type'] = 'application/json';
+  return h;
 }
 
 function promptToken() {
@@ -26,11 +29,34 @@ function promptToken() {
 }
 
 async function authFetch(url, opts) {
-  let resp = await fetch(url, { ...(opts || {}), headers: authHeaders() });
-  if (resp.status === 401 && promptToken()) {
-    resp = await fetch(url, { ...(opts || {}), headers: authHeaders() });
-  }
+  const mk = () => ({ ...(opts || {}),
+                      headers: { ...authHeaders(opts && opts.body),
+                                 ...((opts || {}).headers || {}) } });
+  let resp = await fetch(url, mk());
+  if (resp.status === 401 && promptToken()) resp = await fetch(url, mk());
   return resp;
+}
+
+/* Actions: every mutating route returns {request_id}; the result shows
+ * up via the 5s refresh, so we just confirm + toast. */
+async function act(label, url, payload) {
+  if (!window.confirm(`${label}?`)) return;
+  try {
+    const resp = await authFetch(url, { method: 'POST',
+                                        body: JSON.stringify(payload || {}) });
+    const body = await resp.json().catch(() => ({}));
+    if (!resp.ok) throw new Error(body.error || resp.status);
+    toast(`${label}: submitted (${(body.request_id || '').slice(0, 8)})`);
+  } catch (e) { toast(`${label} failed: ${e.message}`, true); }
+  setTimeout(refresh, 800);
+}
+
+function toast(msg, bad) {
+  const el = $('toast');
+  el.textContent = msg;
+  el.className = bad ? 'toast bad show' : 'toast show';
+  clearTimeout(toast._t);
+  toast._t = setTimeout(() => { el.className = 'toast'; }, 4000);
 }
 
 function statusClass(s) {
@@ -48,19 +74,34 @@ function ts(v) {
          `${String(d.getHours()).padStart(2, '0')}:${String(d.getMinutes()).padStart(2, '0')}`;
 }
 
+/* rows: array of arrays; a cell may be {html: '...'} to opt out of
+ * escaping (used for action buttons only — never for server data). */
 function table(headers, rows, onClick) {
   if (!rows.length) return '<div class="empty">none</div>';
   const head = headers.map((h) => `<th>${h}</th>`).join('');
   const body = rows.map((r, i) => {
     const cells = r.map((c) => {
+      if (c && typeof c === 'object' && 'html' in c) return `<td class="act">${c.html}</td>`;
       const text = String(c == null ? '-' : c);
       const cls = /^[A-Z_]{2,20}$/.test(text) ? ` class="${statusClass(text)}"` : '';
-      return `<td${cls}>${text.replace(/</g, '&lt;')}</td>`;
+      return `<td${cls}>${esc(text)}</td>`;
     }).join('');
     const rowCls = onClick ? ' class="row"' : '';
     return `<tr${rowCls} data-i="${i}">${cells}</tr>`;
   }).join('');
   return `<table><tr>${head}</tr>${body}</table>`;
+}
+
+function btn(label, cls, id) {
+  return `<button class="abtn ${cls || ''}" data-act="${id}">${label}</button>`;
+}
+
+/* After innerHTML, wire data-act buttons to handlers by id. */
+function bindActs(handlers) {
+  document.querySelectorAll('[data-act]').forEach((b) => {
+    const h = handlers[b.dataset.act];
+    if (h) b.onclick = (ev) => { ev.stopPropagation(); h(); };
+  });
 }
 
 function renderTabs() {
@@ -78,39 +119,65 @@ function render() {
   renderTabs();
   if (!data) { $('view').innerHTML = '<div class="empty">loading…</div>'; return; }
   const v = $('view');
+  const acts = {};
   if (active === 'Clusters') {
     v.innerHTML = table(
-      ['name', 'resources', 'owner', 'launched', 'autostop', 'status'],
-      data.clusters.map((c) => [c.name, c.resources_str, c.owner, ts(c.launched_at),
-                                c.autostop >= 0 ? `${c.autostop}m${c.autostop_down ? ' (down)' : ''}` : '-',
-                                c.status]),
+      ['name', 'resources', 'owner', 'launched', 'autostop', 'status', ''],
+      data.clusters.map((c, i) => {
+        acts[`stop${i}`] = () => act(`Stop cluster ${c.name}`, '/stop',
+                                     { cluster_name: c.name });
+        acts[`down${i}`] = () => act(`Down (terminate) cluster ${c.name}`,
+                                     '/down', { cluster_name: c.name });
+        return [c.name, c.resources_str, c.owner, ts(c.launched_at),
+                c.autostop >= 0 ? `${c.autostop}m${c.autostop_down ? ' (down)' : ''}` : '-',
+                c.status,
+                { html: btn('stop', '', `stop${i}`) + btn('down', 'danger', `down${i}`) }];
+      }),
       true);
     bindRows((i) => showClusterDetail(data.clusters[i]));
   } else if (active === 'Jobs') {
     v.innerHTML = table(
       ['id', 'name', 'group', 'stage', 'cluster', 'recoveries',
-       'submitted', 'status'],
-      data.jobs.map((j) => [j.job_id, j.name, j.job_group, j.stage,
-                            j.cluster_name, j.recovery_count,
-                            ts(j.submitted_at), j.status]),
+       'submitted', 'status', ''],
+      data.jobs.map((j, i) => {
+        const live = !/SUCCEEDED|FAILED|CANCELLED/.test(j.status);
+        acts[`jcancel${i}`] = () => act(`Cancel managed job ${j.job_id}`,
+                                        '/jobs/cancel', { job_ids: [j.job_id] });
+        return [j.job_id, j.name, j.job_group, j.stage, j.cluster_name,
+                j.recovery_count, ts(j.submitted_at), j.status,
+                { html: live ? btn('cancel', 'danger', `jcancel${i}`) : '' }];
+      }),
       true);
     bindRows((i) => showJobDetail(data.jobs[i]));
   } else if (active === 'Services') {
     v.innerHTML = table(
-      ['name', 'version', 'replicas (ready/total)', 'endpoint', 'status'],
-      data.services.map((s) => [s.name, `v${s.version}`, `${s.ready}/${s.total}`,
-                                s.endpoint, s.status]));
+      ['name', 'version', 'replicas (ready/total)', 'endpoint', 'status', ''],
+      data.services.map((s, i) => {
+        acts[`sdown${i}`] = () => act(`Tear down service ${s.name}`,
+                                      '/serve/down', { service_name: s.name });
+        return [s.name, `v${s.version}`, `${s.ready}/${s.total}`,
+                s.endpoint, s.status,
+                { html: btn('down', 'danger', `sdown${i}`) }];
+      }),
+      true);
+    bindRows((i) => showServiceDetail(data.services[i].name));
   } else if (active === 'Requests') {
     v.innerHTML = table(
-      ['id', 'name', 'user', 'created', 'status'],
-      data.requests.map((r) => [r.request_id.slice(0, 8), r.name, r.user,
-                                ts(r.created_at), r.status]));
+      ['id', 'name', 'user', 'created', 'status', ''],
+      data.requests.map((r, i) => {
+        const live = /PENDING|RUNNING/.test(r.status);
+        acts[`rcancel${i}`] = () => act(`Cancel request ${r.request_id.slice(0, 8)}`,
+                                        '/api/cancel', { request_id: r.request_id });
+        return [r.request_id.slice(0, 8), r.name, r.user, ts(r.created_at),
+                r.status, { html: live ? btn('cancel', 'danger', `rcancel${i}`) : '' }];
+      }));
   } else if (active === 'Users') {
     v.innerHTML = table(
       ['user', 'role', 'requests', 'last seen'],
       data.users.map((u) => [u.name, u.role || 'user', u.request_count,
                              ts(u.last_seen)]));
   }
+  bindActs(acts);
 }
 
 function bindRows(fn) {
@@ -127,32 +194,72 @@ function closeDetail() {
 function detailShell(title, bodyHtml) {
   $('detail').innerHTML =
     `<div class="detail"><button class="close" id="dclose">✕ close</button>` +
-    `<h3>${title}</h3>${bodyHtml}</div>`;
+    `<h3>${esc(title)}</h3>${bodyHtml}</div>`;
   $('dclose').onclick = closeDetail;
 }
 
-function showClusterDetail(c) {
+async function showClusterDetail(c) {
   closeDetail();
-  const events = (c.events || []).map((e) => [ts(e.timestamp), e.event_type, e.message]);
+  let detail = { events: c.events || [], jobs: [], num_hosts: c.num_hosts };
+  try {
+    const resp = await authFetch(`/dashboard/api/cluster/${encodeURIComponent(c.name)}`);
+    if (resp.ok) detail = await resp.json();
+  } catch (e) { /* fall back to summary data */ }
+  const events = (detail.events || []).map((e) => [ts(e.timestamp), e.event_type, e.message]);
+  const jobs = (detail.jobs || []).map((j) => [j.job_id, j.name, j.status, ts(j.submitted_at)]);
+  const nHosts = detail.num_hosts || c.num_hosts || 1;
+  const rankOpts = ['<option value="">combined</option>'];
+  for (let r = 0; r < nHosts; r += 1) rankOpts.push(`<option value="${r}">rank ${r}</option>`);
   detailShell(`Cluster ${c.name}`,
-    `<div>${c.resources_str || ''} · ${c.num_hosts || '?'} host(s) · ` +
-    `agent ${c.head_agent_addr || '-'}</div>` +
+    `<div>${esc(c.resources_str || '')} · ${nHosts} host(s) · ` +
+    `agent ${esc(c.head_agent_addr || '-')}</div>` +
+    `<h4>Jobs on cluster</h4>${table(['id', 'name', 'status', 'submitted'], jobs)}` +
     `<h4>Events</h4>${table(['time', 'event', 'detail'], events)}` +
-    `<h4>Latest job log</h4><pre class="logs" id="logbox">…</pre>`);
-  streamLogs(`/logs?cluster=${encodeURIComponent(c.name)}&follow=0&tail=200`);
+    `<h4>Log <select id="rank">${rankOpts.join('')}</select></h4>` +
+    `<pre class="logs" id="logbox">…</pre>`);
+  const load = () => {
+    const rank = $('rank').value;
+    streamLogs(`/logs?cluster=${encodeURIComponent(c.name)}&follow=0&tail=200` +
+               (rank === '' ? '' : `&rank=${rank}`));
+  };
+  $('rank').onchange = load;
+  load();
 }
 
 function showJobDetail(j) {
   closeDetail();
   detailShell(`Managed job ${j.job_id} — ${j.name || ''}`,
-    `<div>cluster ${j.cluster_name} · strategy ${j.strategy || '-'} · ` +
+    `<div>cluster ${esc(j.cluster_name)} · strategy ${esc(j.strategy || '-')} · ` +
     `recoveries ${j.recovery_count}` +
-    (j.last_error ? `<div class="err">${String(j.last_error).replace(/</g, '&lt;')}</div>` : '') +
+    (j.last_error ? `<div class="err">${esc(j.last_error)}</div>` : '') +
     `</div><h4>Log</h4><pre class="logs" id="logbox">…</pre>`);
   streamLogs(`/jobs/logs?job_id=${j.job_id}&follow=0`);
 }
 
+async function showServiceDetail(name) {
+  closeDetail();
+  let d = null;
+  try {
+    const resp = await authFetch(`/dashboard/api/service/${encodeURIComponent(name)}`);
+    d = await resp.json();
+    if (!resp.ok) throw new Error(d.error || resp.status);
+  } catch (e) { toast(`service detail: ${e.message}`, true); return; }
+  const reps = (d.replicas || []).map((r) => [
+    r.replica_id, `v${r.version}`, r.endpoint,
+    r.use_spot == null ? '-' : (r.use_spot ? 'spot' : 'on-demand'),
+    r.accelerator, r.weight, r.status]);
+  detailShell(`Service ${d.name}`,
+    `<div>v${d.version} · ${esc(d.status)} · LB :${d.lb_port || '-'} · ` +
+    `controller pid ${d.controller_pid || '-'}</div>` +
+    `<h4>Replicas</h4>` +
+    table(['id', 'version', 'endpoint', 'procurement', 'accelerator',
+           'weight', 'status'], reps) +
+    `<h4>Controller log</h4><pre class="logs" id="logbox">…</pre>`);
+  streamLogs(`/serve/logs?service_name=${encodeURIComponent(name)}&follow=0`);
+}
+
 async function streamLogs(url) {
+  if (logAbort) logAbort.abort();
   const box = $('logbox');
   box.textContent = '';
   logAbort = new AbortController();

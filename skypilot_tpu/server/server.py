@@ -229,6 +229,14 @@ async def cluster_job_logs(request: web.Request) -> web.StreamResponse:
     job_id = request.query.get('job_id')
     follow = request.query.get('follow', '1') == '1'
     tail = int(request.query.get('tail', 0))
+    rank_q = request.query.get('rank')
+    rank = None
+    if rank_q not in (None, ''):
+        if not rank_q.isdigit():
+            return web.json_response(
+                {'error': f'rank must be a non-negative integer, '
+                          f'got {rank_q!r}'}, status=400)
+        rank = int(rank_q)
     record = global_state.get_cluster(cluster)
     if record is None:
         return web.json_response({'error': f'no cluster {cluster}'},
@@ -244,7 +252,7 @@ async def cluster_job_logs(request: web.Request) -> web.StreamResponse:
     def lines():
         try:
             yield from agent.stream_job_logs(int(job_id), follow=follow,
-                                             tail=tail)
+                                             tail=tail, rank=rank)
         except Exception as e:  # pylint: disable=broad-except
             yield f'[server] log stream error: {e}\n'
 

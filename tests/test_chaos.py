@@ -220,5 +220,42 @@ def test_dashboard_spa_serves_live_data(chaos_server, monkeypatch):
     assert cluster['status'] == 'UP' and cluster['events']
     assert summary['counts']['clusters'] >= 1
 
+    # Per-entity drill-down endpoints (detail pages).
+    detail = requests.get(f'{url}/dashboard/api/cluster/dash-c',
+                          timeout=10).json()
+    assert detail['num_hosts'] >= 1 and detail['events']
+    assert any(j.get('job_id') for j in detail['jobs'])
+    assert requests.get(f'{url}/dashboard/api/cluster/nope',
+                        timeout=10).status_code == 404
+    assert requests.get(f'{url}/dashboard/api/service/nope',
+                        timeout=10).status_code == 404
+
+    # Per-rank log streaming (the detail page's rank selector).
+    combined = requests.get(
+        f'{url}/logs', params={'cluster': 'dash-c', 'follow': '0'},
+        timeout=15)
+    assert combined.ok
+    rank0 = requests.get(
+        f'{url}/logs', params={'cluster': 'dash-c', 'follow': '0',
+                               'rank': '0'}, timeout=15)
+    assert rank0.ok and '(rank' not in rank0.text  # un-prefixed own file
+
+    # Action round-trip: the SPA's stop button POSTs /stop.
+    rid = requests.post(f'{url}/stop', json={'cluster_name': 'dash-c'},
+                        timeout=10).json()['request_id']
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        rec = requests.get(f'{url}/api/get',
+                           params={'request_id': rid, 'timeout': 5},
+                           timeout=30).json()
+        if rec['status'] in ('SUCCEEDED', 'FAILED'):
+            break
+    assert rec['status'] == 'SUCCEEDED', rec
+    summary = requests.get(f'{url}/dashboard/api/summary',
+                           timeout=10).json()
+    names = [c['name'] for c in summary['clusters']]
+    assert summary['clusters'][names.index('dash-c')]['status'] == \
+        'STOPPED'
+
     requests.post(f'{url}/down', json={'cluster_name': 'dash-c'},
                   timeout=10)

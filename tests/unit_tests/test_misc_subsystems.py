@@ -460,10 +460,11 @@ def test_instance_aware_autoscaler_mixed_fleet():
                    ready_capacities=[10.0, 10.0, 10.0, 10.0])
     assert a.target_num_replicas == 2
 
-    # No ready replicas: fall to min_replicas.
+    # No ready replicas but live load: size by the largest class
+    # (ceil(17.5/10) = 2), never stall at zero.
     d = a.evaluate(num_ready=0, num_launching=0, now=now,
                    ready_capacities=[])
-    assert a.target_num_replicas == 1
+    assert a.target_num_replicas == 2
 
 
 def test_instance_aware_composes_with_spot_mix():
@@ -585,3 +586,60 @@ def test_hosts_markers_are_group_scoped(isolated_state, monkeypatch,
     assert 'worker.g2' not in content
     os.path.exists(groups.hosts_file_path('g1')) and \
         os.remove(groups.hosts_file_path('g1'))
+
+
+def test_instance_aware_cold_start_from_zero():
+    """min_replicas=0 + traffic: the instance-aware scaler must still
+    produce a nonzero target with no ready/launching replicas."""
+    from skypilot_tpu.serve.autoscalers import (Autoscaler,
+                                                AutoscalerDecisionOperator)
+    from skypilot_tpu.serve.service_spec import SkyServiceSpec
+    spec = SkyServiceSpec(min_replicas=0, max_replicas=5,
+                          target_qps_per_replica={'tpu-v5e-8': 4.0},
+                          upscale_delay_seconds=0,
+                          downscale_delay_seconds=0)
+    a = Autoscaler.make(spec)
+    a.target_num_replicas = 0
+    now = 1000.0
+    a.collect_request_information(int(6 * a._QPS_WINDOW_SECONDS),
+                                  timestamp=now)
+    d = a.evaluate(num_ready=0, num_launching=0, now=now,
+                   ready_capacities=[])
+    assert d.operator == AutoscalerDecisionOperator.SCALE_UP
+    assert a.target_num_replicas == 2  # ceil(6/4)
+
+
+def test_hosts_legacy_unscoped_block_is_migrated(isolated_state,
+                                                 monkeypatch, tmp_path):
+    """Blocks written under the pre-scoping markers are stripped on the
+    first scoped install (they would shadow refreshed entries)."""
+    from skypilot_tpu.jobs import groups, state
+    jid = state.submit_job('actor', {'name': 'actor'}, 'failover', 0, 'u')
+    groups._db().execute(
+        'UPDATE managed_jobs SET job_group=? WHERE job_id=?', ('g1', jid))
+    groups.publish_address(jid, '10.0.0.9')
+
+    hosts = tmp_path / 'hosts'
+    hosts.write_text('127.0.0.1 localhost\n'
+                     '# >>> skypilot-jobgroup >>>\n'
+                     '10.0.0.1 actor.g1 actor\n'
+                     '# <<< skypilot-jobgroup <<<\n')
+    monkeypatch.setenv('SKYPILOT_HOSTS_FILE', str(hosts))
+
+    class FakeRunner:
+        def run(self, cmd, require_outputs=False, **kw):
+            import subprocess
+            p = subprocess.run(['bash', '-c', cmd], capture_output=True,
+                               text=True)
+            return p.returncode, p.stdout, p.stderr
+
+    class FakeHandle:
+        def get_command_runners(self):
+            return [FakeRunner()]
+
+    groups.install_hosts_entries(FakeHandle(), 'g1')
+    content = hosts.read_text()
+    assert '10.0.0.1' not in content        # legacy block gone
+    assert '10.0.0.9 actor.g1 actor' in content
+    assert content.count('actor.g1') == 1
+    os.remove(groups.hosts_file_path('g1'))
