@@ -75,7 +75,7 @@ CREATE TABLE IF NOT EXISTS system_config (
 
 @functools.lru_cache(maxsize=None)
 def _db_for(path: str) -> db_utils.SQLiteDB:
-    return db_utils.SQLiteDB(path, _CREATE_SQL)
+    return db_utils.open_db(path, _CREATE_SQL)
 
 
 def _db() -> db_utils.SQLiteDB:

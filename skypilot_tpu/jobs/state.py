@@ -74,7 +74,7 @@ CREATE TABLE IF NOT EXISTS managed_jobs (
 
 @functools.lru_cache(maxsize=None)
 def _db_for(path: str) -> db_utils.SQLiteDB:
-    return db_utils.SQLiteDB(path, _CREATE_SQL)
+    return db_utils.open_db(path, _CREATE_SQL)
 
 
 @functools.lru_cache(maxsize=None)

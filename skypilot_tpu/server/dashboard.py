@@ -105,6 +105,7 @@ def _cluster_detail(name: str) -> Dict[str, Any]:
         return {'error': f'no cluster {name!r}'}
     handle = record['handle']
     jobs = []
+    jobs_error = None
     try:
         for j in handle.agent().get_jobs():
             jobs.append({
@@ -115,12 +116,15 @@ def _cluster_detail(name: str) -> Dict[str, Any]:
                 'num_ranks': j.get('num_ranks'),
             })
     except Exception as e:  # pylint: disable=broad-except
-        jobs = [{'error': str(e)}]
+        # Distinct key, NOT a fake job row — the SPA surfaces it as a
+        # banner instead of a row of dashes.
+        jobs_error = str(e)
     return {
         'name': name,
         'num_hosts': getattr(handle, 'num_hosts', None),
         'events': global_state.get_cluster_events(name)[-50:],
         'jobs': jobs,
+        'jobs_error': jobs_error,
     }
 
 

@@ -213,7 +213,9 @@ async function showClusterDetail(c) {
   detailShell(`Cluster ${c.name}`,
     `<div>${esc(c.resources_str || '')} · ${nHosts} host(s) · ` +
     `agent ${esc(c.head_agent_addr || '-')}</div>` +
-    `<h4>Jobs on cluster</h4>${table(['id', 'name', 'status', 'submitted'], jobs)}` +
+    `<h4>Jobs on cluster</h4>` +
+    (detail.jobs_error ? `<div class="err">agent unreachable: ${esc(detail.jobs_error)}</div>` : '') +
+    `${table(['id', 'name', 'status', 'submitted'], jobs)}` +
     `<h4>Events</h4>${table(['time', 'event', 'detail'], events)}` +
     `<h4>Log <select id="rank">${rankOpts.join('')}</select></h4>` +
     `<pre class="logs" id="logbox">…</pre>`);
@@ -255,7 +257,7 @@ async function showServiceDetail(name) {
     table(['id', 'version', 'endpoint', 'procurement', 'accelerator',
            'weight', 'status'], reps) +
     `<h4>Controller log</h4><pre class="logs" id="logbox">…</pre>`);
-  streamLogs(`/serve/logs?service_name=${encodeURIComponent(name)}&follow=0`);
+  streamLogs(`/serve/logs?service=${encodeURIComponent(name)}&follow=0`);
 }
 
 async function streamLogs(url) {

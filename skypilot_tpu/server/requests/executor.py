@@ -67,7 +67,7 @@ class RequestStatus(enum.Enum):
 
 @functools.lru_cache(maxsize=None)
 def _db_for(path: str) -> db_utils.SQLiteDB:
-    return db_utils.SQLiteDB(path, _CREATE_SQL)
+    return db_utils.open_db(path, _CREATE_SQL)
 
 
 def _db() -> db_utils.SQLiteDB:

@@ -1,16 +1,24 @@
-"""Tiny sqlite helper: thread-local connections, dict rows, migrations.
+"""DB helpers: sqlite (default) or Postgres behind one interface.
 
-The reference uses SQLAlchemy (sky/global_user_state.py); this build
-uses stdlib sqlite3 with WAL mode — one writer, many readers — which
-matches the single-API-server deployment model.
+The reference uses SQLAlchemy with a sqlite default and a Postgres
+option for shared/HA API servers (sky/global_user_state.py:68-331).
+Here the same dual-backend seam is stdlib-first: `SQLiteDB` (WAL mode
+— one writer, many readers, matching the single-server deployment)
+and `PostgresDB` (psycopg2/pg8000, selected by SKYPILOT_DB_URL) share
+the execute/query/conn interface, with a small SQL translator mapping
+the sqlite dialect the call sites speak (qmark params,
+INSERT OR IGNORE/REPLACE, AUTOINCREMENT, BLOB) onto Postgres. Server
+subsystems open their stores through `open_db`; on-cluster agent
+state stays sqlite always.
 """
 from __future__ import annotations
 
 import contextlib
 import os
+import re
 import sqlite3
 import threading
-from typing import Any, Callable, Dict, Iterator, List, Optional
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 
 class SQLiteDB:
@@ -25,14 +33,18 @@ class SQLiteDB:
             conn.executescript(create_table_sql)
 
     def _get_conn(self) -> sqlite3.Connection:
+        # The pid guard makes cached connections fork-safe: a worker
+        # process forked after the parent opened a connection must NOT
+        # reuse the inherited handle (shared fd/socket corruption).
         conn = getattr(self._local, 'conn', None)
-        if conn is None:
+        if conn is None or getattr(self._local, 'pid', None) != os.getpid():
             conn = sqlite3.connect(self.path, timeout=30.0)
             conn.row_factory = sqlite3.Row
             with contextlib.suppress(sqlite3.OperationalError):
                 conn.execute('PRAGMA journal_mode=WAL')
             conn.execute('PRAGMA synchronous=NORMAL')
             self._local.conn = conn
+            self._local.pid = os.getpid()
         return conn
 
     @contextlib.contextmanager
@@ -66,3 +78,232 @@ class SQLiteDB:
                     conn.execute(f'PRAGMA table_info({table})').fetchall()]
             if column not in cols:
                 conn.execute(f'ALTER TABLE {table} ADD COLUMN {column} {decl}')
+
+
+# ---------------------------------------------------------------------------
+# Postgres backend (reference: sky/global_user_state.py dual-backend).
+
+
+def parse_schema(create_sql: str) -> Tuple[Dict[str, List[str]],
+                                           Dict[str, str]]:
+    """(primary_keys, autoinc_id_column) per table, parsed from the
+    sqlite CREATE script — what the translator needs for
+    INSERT OR REPLACE (conflict target) and lastrowid (RETURNING)."""
+    pks: Dict[str, List[str]] = {}
+    autoinc: Dict[str, str] = {}
+    for m in re.finditer(
+            r'CREATE TABLE IF NOT EXISTS\s+(\w+)\s*\((.*?)\);',
+            create_sql, re.S | re.I):
+        table, body = m.group(1), m.group(2)
+        # Table-level composite key first (parens would confuse a
+        # naive comma split), then column-level declarations per line.
+        tm = re.search(r'PRIMARY KEY\s*\(([^)]+)\)', body, re.I)
+        if tm:
+            pks[table] = [c.strip() for c in tm.group(1).split(',')]
+            continue
+        for line in body.splitlines():
+            line = line.strip().rstrip(',')
+            cm = re.match(r'(\w+)\s+\w+.*PRIMARY KEY', line, re.I)
+            if cm:
+                pks[table] = [cm.group(1)]
+                if 'AUTOINCREMENT' in line.upper():
+                    autoinc[table] = cm.group(1)
+                break
+    return pks, autoinc
+
+
+def translate_create_sql(create_sql: str) -> str:
+    """sqlite CREATE script → Postgres dialect."""
+    sql = re.sub(r'INTEGER PRIMARY KEY AUTOINCREMENT',
+                 'BIGSERIAL PRIMARY KEY', create_sql, flags=re.I)
+    sql = re.sub(r'\bBLOB\b', 'BYTEA', sql, flags=re.I)
+    # sqlite REAL is 8-byte; Postgres REAL is float4, which quantizes
+    # epoch timestamps to ~128s — FIFO ordering and retention math
+    # would silently break.
+    sql = re.sub(r'\bREAL\b', 'DOUBLE PRECISION', sql, flags=re.I)
+    return sql
+
+
+def translate_sql(sql: str, pks: Dict[str, List[str]]) -> str:
+    """One sqlite-dialect statement → Postgres.
+
+    Covers what the call sites actually use: qmark params,
+    INSERT OR IGNORE, INSERT OR REPLACE (upsert via the table's
+    primary key), and PRAGMA (dropped). None of our statements carry
+    literal '?' in strings, so the param swap is a plain replace.
+    """
+    s = sql.strip()
+    if s.upper().startswith('PRAGMA'):
+        return ''
+    m = re.match(r'INSERT OR IGNORE INTO\s+(.+)', s, re.I | re.S)
+    if m:
+        s = f'INSERT INTO {m.group(1)} ON CONFLICT DO NOTHING'
+    m = re.match(r'INSERT OR REPLACE INTO\s+(\w+)\s*\(([^)]*)\)(.*)', s,
+                 re.I | re.S)
+    if m:
+        table, cols_str, rest = m.groups()
+        pk = pks.get(table)
+        if pk is None:
+            raise ValueError(
+                f'INSERT OR REPLACE into {table!r} needs a PRIMARY KEY '
+                f'for the Postgres upsert translation')
+        cols = [c.strip() for c in cols_str.split(',')]
+        updates = ', '.join(f'{c} = EXCLUDED.{c}' for c in cols
+                            if c not in pk)
+        s = (f'INSERT INTO {table} ({cols_str}){rest} '
+             f'ON CONFLICT ({", ".join(pk)}) DO UPDATE SET {updates}')
+    return s.replace('?', '%s')
+
+
+class _PgCursor:
+    """Minimal sqlite-cursor lookalike over a psycopg/pg8000 cursor."""
+
+    def __init__(self, cur, lastrowid: Optional[int]) -> None:
+        self._cur = cur
+        self.lastrowid = lastrowid
+
+    def fetchall(self):
+        return self._cur.fetchall()
+
+    def fetchone(self):
+        return self._cur.fetchone()
+
+    @property
+    def description(self):
+        return self._cur.description
+
+
+class _PgConn:
+    """Connection wrapper translating sqlite-dialect statements, so
+    call sites using `with db.conn() as conn: conn.execute(...)` work
+    unchanged against Postgres."""
+
+    def __init__(self, raw, db: 'PostgresDB') -> None:
+        self._raw = raw
+        self._db = db
+
+    def execute(self, sql: str, params: tuple = ()) -> _PgCursor:
+        translated = translate_sql(sql, self._db.pks)
+        cur = self._raw.cursor()
+        if not translated:
+            return _PgCursor(cur, None)
+        lastrowid = None
+        m = re.match(r'INSERT INTO\s+(\w+)', translated, re.I)
+        if m and m.group(1) in self._db.autoinc and \
+                'RETURNING' not in translated.upper():
+            translated += f' RETURNING {self._db.autoinc[m.group(1)]}'
+            cur.execute(translated, params)
+            row = cur.fetchone()
+            lastrowid = int(row[0]) if row else None
+        else:
+            cur.execute(translated, params)
+        return _PgCursor(cur, lastrowid)
+
+    def executescript(self, script: str) -> None:
+        for stmt in script.split(';'):
+            if stmt.strip():
+                self.execute(stmt)
+
+    def commit(self) -> None:
+        self._raw.commit()
+
+    def rollback(self) -> None:
+        self._raw.rollback()
+
+
+class PostgresDB:
+    """Same interface as SQLiteDB over a postgres:// URL.
+
+    Reference: sky/global_user_state.py:68-331 — sqlite default with a
+    Postgres option so several API-server replicas can share state.
+    Driver: psycopg2 if importable, else pg8000 (both pure-API uses).
+    """
+
+    def __init__(self, url: str, create_table_sql: str) -> None:
+        self.url = url
+        self.pks, self.autoinc = parse_schema(create_table_sql)
+        self._local = threading.local()
+        self._migrated: set = set()
+        self._create_sql = translate_create_sql(create_table_sql)
+        with self.conn() as conn:
+            conn.executescript(self._create_sql)
+
+    @staticmethod
+    def _connect(url: str):
+        try:
+            import psycopg2  # type: ignore
+            return psycopg2.connect(url)
+        except ImportError:
+            pass
+        try:
+            import pg8000.dbapi  # type: ignore
+            import urllib.parse as up
+            parsed = up.urlparse(url)
+            return pg8000.dbapi.Connection(
+                user=parsed.username or 'postgres',
+                password=parsed.password,
+                host=parsed.hostname or 'localhost',
+                port=parsed.port or 5432,
+                database=(parsed.path or '/postgres').lstrip('/'))
+        except ImportError as e:
+            raise RuntimeError(
+                'SKYPILOT_DB_URL points at Postgres but neither '
+                'psycopg2 nor pg8000 is installed. `pip install '
+                'psycopg2-binary` on the API server.') from e
+
+    def _get_conn(self) -> _PgConn:
+        # pid guard: a forked worker must open its OWN socket — parent
+        # and child interleaving libpq bytes on one inherited socket
+        # corrupts both sessions.
+        conn = getattr(self._local, 'conn', None)
+        if conn is None or getattr(self._local, 'pid', None) != os.getpid():
+            conn = _PgConn(self._connect(self.url), self)
+            self._local.conn = conn
+            self._local.pid = os.getpid()
+        return conn
+
+    @contextlib.contextmanager
+    def conn(self) -> Iterator[_PgConn]:
+        conn = self._get_conn()
+        try:
+            yield conn
+            conn.commit()
+        except Exception:
+            conn.rollback()
+            raise
+
+    def execute(self, sql: str, params: tuple = ()) -> None:
+        with self.conn() as conn:
+            conn.execute(sql, params)
+
+    def query(self, sql: str, params: tuple = ()) -> List[Dict[str, Any]]:
+        with self.conn() as conn:
+            cur = conn.execute(sql, params)
+            names = [d[0] for d in cur.description]
+            return [dict(zip(names, row)) for row in cur.fetchall()]
+
+    def query_one(self, sql: str,
+                  params: tuple = ()) -> Optional[Dict[str, Any]]:
+        rows = self.query(sql, params)
+        return rows[0] if rows else None
+
+    def add_column_if_missing(self, table: str, column: str,
+                              decl: str) -> None:
+        # Memoized: hot paths call this per operation; on Postgres an
+        # unconditional ALTER takes ACCESS EXCLUSIVE every time.
+        key = (table, column)
+        if key in self._migrated:
+            return
+        decl = translate_create_sql(decl)
+        self.execute(
+            f'ALTER TABLE {table} ADD COLUMN IF NOT EXISTS {column} {decl}')
+        self._migrated.add(key)
+
+
+def open_db(path: str, create_table_sql: str):
+    """The dual-backend seam: SKYPILOT_DB_URL=postgres://... routes a
+    server-side store to Postgres; default is sqlite at `path`."""
+    url = os.environ.get('SKYPILOT_DB_URL')
+    if url and url.startswith(('postgres://', 'postgresql://')):
+        return PostgresDB(url, create_table_sql)
+    return SQLiteDB(path, create_table_sql)

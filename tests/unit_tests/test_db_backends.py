@@ -1,0 +1,106 @@
+"""Dual DB backend seam (reference: sky/global_user_state.py:68-331,
+sqlite default + Postgres option). The translation layer is fully
+unit-tested here; end-to-end Postgres coverage runs when a live server
+is provided via SKYPILOT_TEST_PG_URL (deploy/docker-compose.pg.yaml).
+"""
+import os
+
+import pytest
+
+from skypilot_tpu.utils import db_utils
+
+CREATE = """\
+CREATE TABLE IF NOT EXISTS jobs (
+    job_id INTEGER PRIMARY KEY AUTOINCREMENT,
+    name TEXT,
+    payload BLOB,
+    score REAL
+);
+CREATE TABLE IF NOT EXISTS replicas (
+    service TEXT,
+    replica_id INTEGER,
+    status TEXT,
+    PRIMARY KEY (service, replica_id)
+);
+CREATE TABLE IF NOT EXISTS kv (
+    k TEXT PRIMARY KEY,
+    v TEXT
+);
+"""
+
+
+def test_parse_schema():
+    pks, autoinc = db_utils.parse_schema(CREATE)
+    assert pks == {'jobs': ['job_id'],
+                   'replicas': ['service', 'replica_id'],
+                   'kv': ['k']}
+    assert autoinc == {'jobs': 'job_id'}
+
+
+def test_translate_create_sql():
+    out = db_utils.translate_create_sql(CREATE)
+    assert 'BIGSERIAL PRIMARY KEY' in out
+    assert 'AUTOINCREMENT' not in out
+    assert 'BYTEA' in out and 'BLOB' not in out
+
+
+def test_translate_statements():
+    pks, _ = db_utils.parse_schema(CREATE)
+    t = lambda s: db_utils.translate_sql(s, pks)  # noqa: E731
+    assert t('SELECT * FROM jobs WHERE job_id=?') == \
+        'SELECT * FROM jobs WHERE job_id=%s'
+    assert t('PRAGMA journal_mode=WAL') == ''
+    assert t('INSERT OR IGNORE INTO kv (k, v) VALUES (?,?)') == \
+        'INSERT INTO kv (k, v) VALUES (%s,%s) ON CONFLICT DO NOTHING'
+    up = t('INSERT OR REPLACE INTO replicas (service, replica_id, '
+           'status) VALUES (?,?,?)')
+    assert up.startswith('INSERT INTO replicas')
+    assert 'ON CONFLICT (service, replica_id) DO UPDATE SET ' in up
+    assert 'status = EXCLUDED.status' in up
+    assert 'service = EXCLUDED.service' not in up  # pk cols not updated
+    with pytest.raises(ValueError, match='PRIMARY KEY'):
+        t('INSERT OR REPLACE INTO nopk (a) VALUES (?)')
+
+
+def test_open_db_routes_on_env(monkeypatch, tmp_path):
+    monkeypatch.delenv('SKYPILOT_DB_URL', raising=False)
+    db = db_utils.open_db(str(tmp_path / 'x.db'), CREATE)
+    assert isinstance(db, db_utils.SQLiteDB)
+    # A postgres URL selects the PG backend (which then fails fast and
+    # clearly without a driver in this image).
+    monkeypatch.setenv('SKYPILOT_DB_URL', 'postgresql://u@127.0.0.1/db')
+    with pytest.raises(Exception) as exc_info:
+        db_utils.open_db(str(tmp_path / 'y.db'), CREATE)
+    assert 'psycopg2' in str(exc_info.value) or 'pg8000' in \
+        str(exc_info.value) or 'connect' in str(exc_info.value).lower()
+
+
+@pytest.mark.skipif(not os.environ.get('SKYPILOT_TEST_PG_URL'),
+                    reason='set SKYPILOT_TEST_PG_URL to a live Postgres '
+                           '(deploy/docker-compose.pg.yaml) to run')
+def test_postgres_end_to_end():
+    """Same operations the server stores perform, against live PG:
+    create, upsert, lastrowid via RETURNING, blob round-trip."""
+    url = os.environ['SKYPILOT_TEST_PG_URL']
+    db = db_utils.PostgresDB(url, CREATE)
+    db.execute('DELETE FROM replicas')
+    db.execute('DELETE FROM jobs')
+    with db.conn() as conn:
+        cur = conn.execute(
+            'INSERT INTO jobs (name, payload, score) VALUES (?,?,?)',
+            ('a', b'\x00\x01', 1.5))
+        first = cur.lastrowid
+        cur = conn.execute(
+            'INSERT INTO jobs (name, payload, score) VALUES (?,?,?)',
+            ('b', b'\x02', 2.5))
+        assert cur.lastrowid == first + 1
+    db.execute('INSERT OR REPLACE INTO replicas (service, replica_id, '
+               'status) VALUES (?,?,?)', ('svc', 1, 'STARTING'))
+    db.execute('INSERT OR REPLACE INTO replicas (service, replica_id, '
+               'status) VALUES (?,?,?)', ('svc', 1, 'READY'))
+    rows = db.query('SELECT * FROM replicas WHERE service=?', ('svc',))
+    assert len(rows) == 1 and rows[0]['status'] == 'READY'
+    row = db.query_one('SELECT payload FROM jobs WHERE name=?', ('a',))
+    assert bytes(row['payload']) == b'\x00\x01'
+    db.add_column_if_missing('kv', 'extra', 'TEXT')
+    db.add_column_if_missing('kv', 'extra', 'TEXT')  # idempotent
