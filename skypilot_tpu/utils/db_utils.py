@@ -200,6 +200,13 @@ class _PgConn:
         return _PgCursor(cur, lastrowid)
 
     def executescript(self, script: str) -> None:
+        # Call sites run their own CREATE scripts through this
+        # (pools, tokens): apply the DDL dialect mapping and absorb
+        # any new tables' keys so later upserts translate too.
+        script = translate_create_sql(script)
+        new_pks, new_autoinc = parse_schema(script)
+        self._db.pks.update(new_pks)
+        self._db.autoinc.update(new_autoinc)
         for stmt in script.split(';'):
             if stmt.strip():
                 self.execute(stmt)

@@ -51,11 +51,40 @@ def kill_children_processes(parent_pid: Optional[int] = None,
                       sig=signal.SIGKILL if force else signal.SIGTERM)
 
 
+# Resolved at import, NOT inside preexec_fn: the child of a fork from
+# a multi-threaded launcher must not import (import-lock deadlock).
+try:
+    import ctypes as _ctypes
+    _libc = _ctypes.CDLL('libc.so.6', use_errno=True)
+except OSError:  # non-glibc platform
+    _libc = None
+
+
+def _pdeathsig_preexec() -> None:
+    """PR_SET_PDEATHSIG(SIGTERM): die when the parent does. Test-only:
+    a killed pytest run must not leak agents/controllers/replica
+    servers; production daemons must SURVIVE their launcher, so this
+    is never the default."""
+    if _libc is not None:
+        _libc.prctl(1, signal.SIGTERM)  # PR_SET_PDEATHSIG == 1
+
+
 def launch_daemon(cmd: List[str], log_path: str,
                   env: Optional[dict] = None,
                   cwd: Optional[str] = None) -> int:
-    """Start a detached daemon process; returns pid."""
+    """Start a detached daemon process; returns pid.
+
+    SKYPILOT_DAEMON_PDEATHSIG holds the PID of the process daemons
+    should die with (the test runner sets it to its own pid). The
+    parent-death tie applies ONLY when the CURRENT process is that
+    pid: daemons launched by intermediaries — ephemeral request
+    workers, controllers, the API server — must keep production
+    semantics (a cluster agent must survive its launch request; a
+    kill-9'd controller's cluster must still be adoptable)."""
     os.makedirs(os.path.dirname(os.path.abspath(log_path)), exist_ok=True)
+    preexec = (_pdeathsig_preexec
+               if os.environ.get('SKYPILOT_DAEMON_PDEATHSIG') ==
+               str(os.getpid()) else None)
     with open(log_path, 'ab') as log_file:
         proc = subprocess.Popen(
             cmd,
@@ -65,6 +94,7 @@ def launch_daemon(cmd: List[str], log_path: str,
             env=env,
             cwd=cwd,
             start_new_session=True,
+            preexec_fn=preexec,
         )
     return proc.pid
 

@@ -112,6 +112,7 @@ def fake_ec2(monkeypatch):
     monkeypatch.setattr(ec2_api, '_request', fake.request)
     monkeypatch.setattr(aws_instance, '_ssh_pub_key',
                         lambda: 'ssh-ed25519 AAAA test')
+    monkeypatch.setattr(aws_instance.time, 'sleep', lambda s: None)
     return fake
 
 

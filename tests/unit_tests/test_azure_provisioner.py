@@ -115,6 +115,7 @@ def fake_arm(monkeypatch):
     monkeypatch.setattr(arm_api, '_subscription', lambda: 'sub-1')
     monkeypatch.setattr(az_instance, '_ssh_pub_key',
                         lambda: 'ssh-ed25519 AAAA test')
+    monkeypatch.setattr(az_instance.time, 'sleep', lambda s: None)
     return fake
 
 

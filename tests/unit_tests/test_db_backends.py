@@ -66,13 +66,16 @@ def test_open_db_routes_on_env(monkeypatch, tmp_path):
     monkeypatch.delenv('SKYPILOT_DB_URL', raising=False)
     db = db_utils.open_db(str(tmp_path / 'x.db'), CREATE)
     assert isinstance(db, db_utils.SQLiteDB)
-    # A postgres URL selects the PG backend (which then fails fast and
-    # clearly without a driver in this image).
+    # A postgres URL selects the PG backend (routing asserted without
+    # a live connection — error text varies by driver/environment).
+    created = {}
+    monkeypatch.setattr(
+        db_utils.PostgresDB, '__init__',
+        lambda self, url, sql: created.update(url=url) or None)
     monkeypatch.setenv('SKYPILOT_DB_URL', 'postgresql://u@127.0.0.1/db')
-    with pytest.raises(Exception) as exc_info:
-        db_utils.open_db(str(tmp_path / 'y.db'), CREATE)
-    assert 'psycopg2' in str(exc_info.value) or 'pg8000' in \
-        str(exc_info.value) or 'connect' in str(exc_info.value).lower()
+    db = db_utils.open_db(str(tmp_path / 'y.db'), CREATE)
+    assert isinstance(db, db_utils.PostgresDB)
+    assert created['url'] == 'postgresql://u@127.0.0.1/db'
 
 
 @pytest.mark.skipif(not os.environ.get('SKYPILOT_TEST_PG_URL'),

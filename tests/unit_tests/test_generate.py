@@ -283,6 +283,7 @@ def test_mixtral_paged_decode_matches_dense():
                                               kv_total_pages=16))
 
 
+@pytest.mark.slow
 def test_deepseek_absorbed_decode_matches_full_forward():
     """Greedy rollout through the absorbed latent-cache decode path
     must reproduce the full-forward logits path token-for-token."""
@@ -309,6 +310,7 @@ def test_deepseek_absorbed_decode_matches_full_forward():
         assert jnp.array_equal(expect, out[:, t + 1]), t
 
 
+@pytest.mark.slow
 def test_deepseek_continuous_batching_smoke():
     """MLA's latent cache rides the engine's dense (non-paged) path —
     DeepseekConfig declares no page pool, so paged auto-disables."""
@@ -336,6 +338,7 @@ def test_deepseek_continuous_batching_smoke():
         assert len(got) > len(p)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize('family', ['llama', 'gpt', 'deepseek'])
 def test_speculative_matches_greedy(family):
     """Prompt-lookup speculative decoding must produce EXACTLY the
@@ -372,6 +375,7 @@ def test_speculative_matches_greedy(family):
         assert jnp.array_equal(got, want), (family, got, want)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize('family', ['llama', 'gpt', 'deepseek', 'mixtral'])
 def test_prefill_chunk_only_matches_full_cache_path(family):
     """The prefill fast path (chunk-local S x S attention,

@@ -47,6 +47,7 @@ def tokens():
     return rng.integers(0, 120, size=(2, 12), dtype=np.int64)
 
 
+@pytest.mark.slow
 def test_llama_parity(tmp_path, tokens):
     cfg = transformers.LlamaConfig(
         vocab_size=128, hidden_size=64, intermediate_size=160,

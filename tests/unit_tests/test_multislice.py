@@ -54,6 +54,7 @@ def test_uneven_slices_rejected():
             slice_ids=[0] * 6 + [1] * 2)
 
 
+@pytest.mark.slow
 def test_multislice_train_step_runs():
     """A dp(dcn) x fsdp train step executes on the hybrid mesh and
     matches the single-slice loss (same devices, same math)."""
