@@ -194,10 +194,20 @@ def main() -> None:
         # platform at hand; on TPU the relay's ~80ms/dispatch overhead
         # makes this the dominant term).
         for inner_v in (1, 2, 4, 8):
-            s_state, s_step, s_tokens = build_step(batch, inner_v)
-            _, s_state, _ = timed_run(s_state, s_step, s_tokens, 1)
-            sweep_elapsed, _, _ = timed_run(s_state, s_step, s_tokens,
-                                            max(1, args.steps // inner_v))
+            try:
+                s_state, s_step, s_tokens = build_step(batch, inner_v)
+                _, s_state, _ = timed_run(s_state, s_step, s_tokens, 1)
+                sweep_elapsed, _, _ = timed_run(
+                    s_state, s_step, s_tokens,
+                    max(1, args.steps // inner_v))
+            except Exception as e:  # pylint: disable=broad-except
+                # The sweep must never kill the headline run (which
+                # has its own OOM-halving loop below).
+                print(f'# sweep inner={inner_v}: skipped '
+                      f'({type(e).__name__})', file=sys.stderr)
+                if 'RESOURCE_EXHAUSTED' not in str(e):
+                    break
+                continue
             tps = (batch * seq * max(1, args.steps // inner_v) * inner_v
                    / sweep_elapsed)
             print(f'# sweep inner={inner_v}: {tps / n_dev:.1f} '

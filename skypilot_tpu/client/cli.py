@@ -395,6 +395,23 @@ def logs(cluster, job_id, no_follow, tail, sync_down) -> None:
         _err(str(e))
 
 
+@cli.command()
+@click.argument('cluster')
+@click.option('--node', type=int, default=0,
+              help='Host index to attach to (0 = head).')
+def attach(cluster, node) -> None:
+    """Interactive shell on a cluster host via the API server's
+    websocket PTY bridge (reference: the server-side SSH tunnel —
+    no direct network path to the cluster needed)."""
+    from skypilot_tpu.server import attach as attach_mod
+    token = None
+    auth = sdk._headers().get('Authorization', '')  # pylint: disable=protected-access
+    if auth.startswith('Bearer '):
+        token = auth[len('Bearer '):]
+    raise SystemExit(attach_mod.run_client(
+        sdk.api_server_url(), cluster, node=node, token=token))
+
+
 # ---------------------------------------------------------------------------
 # info
 # ---------------------------------------------------------------------------

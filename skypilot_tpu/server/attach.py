@@ -1,0 +1,223 @@
+"""Interactive attach: a websocket PTY bridge to a cluster's head.
+
+Reference: sky/server/server.py's websocket SSH tunnel
+(websocket_utils) — `ssh <cluster>` rides a WS through the API server
+so clients need no direct network path to the cluster. Here the
+server runs the head host's interactive shell (the command runner's
+`interactive_shell_argv`: `ssh -tt` for cloud hosts, a sandbox bash
+for the Local cloud) under a PTY pair and bridges:
+
+- binary WS frames  <->  raw PTY bytes (both directions)
+- text WS frames carrying `{"resize": [rows, cols]}` set the PTY
+  window size (TIOCSWINSZ), so curses/vim work.
+
+The session ends when either side closes; the shell's process group
+gets SIGTERM on disconnect (no orphaned shells).
+"""
+from __future__ import annotations
+
+import asyncio
+import fcntl
+import json
+import os
+import signal
+import struct
+import subprocess
+import termios
+from typing import Optional
+
+from aiohttp import WSMsgType, web
+
+
+def _set_winsize(fd: int, rows: int, cols: int) -> None:
+    fcntl.ioctl(fd, termios.TIOCSWINSZ,
+                struct.pack('HHHH', rows, cols, 0, 0))
+
+
+async def attach(request: web.Request) -> web.StreamResponse:
+    from skypilot_tpu import global_state
+    from skypilot_tpu.users import permission
+    cluster = request.query.get('cluster', '')
+    # A shell is strictly more powerful than any mutating endpoint:
+    # apply the same per-cluster ownership gate (`stop` shares the
+    # cluster_name-keyed rule).
+    try:
+        await asyncio.get_event_loop().run_in_executor(
+            None, permission.check_request, 'stop',
+            {'cluster_name': cluster}, request.get('sky_user', 'unknown'),
+            request.get('sky_role', 'admin'))
+    except permission.PermissionDeniedError as e:
+        return web.json_response({'error': str(e)}, status=403)
+    record = global_state.get_cluster(cluster)
+    if record is None:
+        return web.json_response({'error': f'no cluster {cluster!r}'},
+                                 status=404)
+    runners = record['handle'].get_command_runners()
+    node_q = request.query.get('node', '0')
+    if not node_q.isdigit():
+        return web.json_response(
+            {'error': f'node must be a non-negative integer, '
+                      f'got {node_q!r}'}, status=400)
+    node = int(node_q)
+    if not node < len(runners):
+        return web.json_response(
+            {'error': f'node must be in [0, {len(runners)})'}, status=400)
+    try:
+        argv, env, cwd = runners[node].interactive_shell_argv()
+    except NotImplementedError:
+        return web.json_response(
+            {'error': 'this cluster type has no interactive shell'},
+            status=501)
+
+    ws = web.WebSocketResponse(heartbeat=30)
+    await ws.prepare(request)
+
+    master, slave = os.openpty()
+    proc = subprocess.Popen(argv, stdin=slave, stdout=slave, stderr=slave,
+                            env=env, cwd=cwd, start_new_session=True)
+    os.close(slave)
+    loop = asyncio.get_event_loop()
+
+    async def pty_to_ws() -> None:
+        while True:
+            try:
+                data = await loop.run_in_executor(
+                    None, os.read, master, 65536)
+            except OSError:
+                break
+            if not data:
+                break
+            try:
+                await ws.send_bytes(data)
+            except ConnectionError:
+                break
+        if not ws.closed:
+            await ws.close()
+
+    reader = asyncio.ensure_future(pty_to_ws())
+    try:
+        async for msg in ws:
+            if msg.type == WSMsgType.BINARY:
+                try:
+                    # Executor thread: a client outpacing the shell
+                    # fills the small PTY buffer, and a blocking write
+                    # here would wedge the whole event loop.
+                    await loop.run_in_executor(None, os.write, master,
+                                               msg.data)
+                except OSError:
+                    break
+            elif msg.type == WSMsgType.TEXT:
+                try:
+                    body = json.loads(msg.data)
+                    if not isinstance(body, dict):
+                        continue
+                    rows, cols = body.get('resize', (None, None))
+                    if rows and cols:
+                        _set_winsize(master, int(rows), int(cols))
+                except (ValueError, TypeError, OSError):
+                    pass
+            elif msg.type in (WSMsgType.CLOSE, WSMsgType.ERROR):
+                break
+    finally:
+        for sig in (signal.SIGTERM, signal.SIGKILL):
+            try:
+                os.killpg(proc.pid, sig)
+            except (ProcessLookupError, PermissionError):
+                break
+            try:
+                # Off-loop: interactive bash can ignore SIGTERM and a
+                # synchronous wait would block every other request.
+                await asyncio.wait_for(
+                    loop.run_in_executor(None, proc.wait, 5), timeout=6)
+                break
+            except (asyncio.TimeoutError, subprocess.TimeoutExpired):
+                continue
+        # The child held the last slave fd: its exit raises EIO in the
+        # reader's blocked os.read, so waiting here (instead of closing
+        # `master` under it) prevents a stale thread from stealing
+        # bytes off a REUSED fd number in a later session.
+        try:
+            await asyncio.wait_for(reader, timeout=5)
+        except (asyncio.TimeoutError, asyncio.CancelledError):
+            reader.cancel()
+        try:
+            os.close(master)
+        except OSError:
+            pass
+    return ws
+
+
+def register(app: web.Application) -> None:
+    app.router.add_get('/attach', attach)
+
+
+# ---------------------------------------------------------------------------
+# Client side (stpu attach): terminal <-> WS pump.
+
+
+def run_client(server_url: str, cluster: str, node: int = 0,
+               token: Optional[str] = None) -> int:
+    """Raw-mode terminal bridge; returns an exit code. aiohttp is a
+    server-side dependency — if the client environment lacks it, point
+    the user at ssh directly."""
+    try:
+        import aiohttp
+    except ImportError:
+        print('stpu attach needs the aiohttp package on the client '
+              '(pip install aiohttp), or ssh to the host directly.')
+        return 1
+    import sys
+    import termios as _termios
+    import tty
+
+    url = (f'{server_url.rstrip("/")}/attach'
+           f'?cluster={cluster}&node={node}')
+    if url.startswith('http'):
+        url = 'ws' + url[len('http'):]
+    headers = {'Authorization': f'Bearer {token}'} if token else {}
+
+    async def _pump() -> int:
+        stdin_fd = sys.stdin.fileno()
+        loop = asyncio.get_event_loop()
+        async with aiohttp.ClientSession() as session:
+            async with session.ws_connect(url, headers=headers,
+                                          max_msg_size=0) as ws:
+                # Initial window size, then raw mode.
+                try:
+                    import shutil
+                    size = shutil.get_terminal_size()
+                    await ws.send_str(json.dumps(
+                        {'resize': [size.lines, size.columns]}))
+                except (OSError, ValueError):
+                    pass
+
+                async def stdin_to_ws() -> None:
+                    while True:
+                        data = await loop.run_in_executor(
+                            None, os.read, stdin_fd, 4096)
+                        if not data:
+                            break
+                        await ws.send_bytes(data)
+
+                sender = asyncio.ensure_future(stdin_to_ws())
+                try:
+                    async for msg in ws:
+                        if msg.type == WSMsgType.BINARY:
+                            os.write(sys.stdout.fileno(), msg.data)
+                        elif msg.type in (WSMsgType.CLOSE,
+                                          WSMsgType.ERROR):
+                            break
+                finally:
+                    sender.cancel()
+        return 0
+
+    interactive = sys.stdin.isatty()
+    saved = _termios.tcgetattr(sys.stdin.fileno()) if interactive else None
+    try:
+        if interactive:
+            tty.setraw(sys.stdin.fileno())
+        return asyncio.new_event_loop().run_until_complete(_pump())
+    finally:
+        if saved is not None:
+            _termios.tcsetattr(sys.stdin.fileno(), _termios.TCSADRAIN,
+                               saved)

@@ -289,6 +289,8 @@ def create_app() -> web.Application:
         pass
     from skypilot_tpu.server import dashboard
     dashboard.register(app)
+    from skypilot_tpu.server import attach as attach_mod
+    attach_mod.register(app)
 
     # Server plugins (reference: sky/server/plugin_hooks.py): modules
     # named in `api_server.plugins` may register extra routes/hooks.

@@ -63,6 +63,14 @@ class CommandRunner:
         except Exception:  # pylint: disable=broad-except
             return False
 
+    def interactive_shell_argv(self) -> Tuple[List[str],
+                                              Optional[Dict[str, str]],
+                                              Optional[str]]:
+        """(argv, env, cwd) for an interactive login shell on this
+        host — what the websocket attach endpoint runs under a PTY
+        (reference: the server's websocket SSH tunnel)."""
+        raise NotImplementedError
+
     # -- helpers -------------------------------------------------------------
     @staticmethod
     def _exec(cmd: List[str], *, require_outputs: bool, stream_logs: bool,
@@ -142,6 +150,11 @@ class SSHCommandRunner(CommandRunner):
                           stream_logs=stream_logs, log_path=log_path,
                           timeout=timeout)
 
+    def interactive_shell_argv(self):
+        # -tt forces a remote PTY even though our side is a PTY pair,
+        # giving the user job control/sigwinch on the remote shell.
+        return self._ssh_base() + ['-tt'], None, None
+
     def rsync(self, source: str, target: str, *, up: bool, excludes=None):
         ssh_cmd = ' '.join(self._ssh_base()[:-1])
         rsync_cmd = ['rsync', '-az', '--delete-excluded']
@@ -190,6 +203,9 @@ class LocalSandboxRunner(CommandRunner):
                           stream_logs=stream_logs, log_path=log_path,
                           timeout=timeout, env=self._env(env),
                           cwd=self.sandbox_dir)
+
+    def interactive_shell_argv(self):
+        return ['bash', '-i'], self._env(None), self.sandbox_dir
 
     def rsync(self, source: str, target: str, *, up: bool, excludes=None):
         if not up:

@@ -254,3 +254,63 @@ def test_metrics_orchestration_gauges(api_server):
     assert 'skypilot_services 0' in text
     assert 'skypilot_server_rss_bytes' in text
     sdk.get(sdk.down('met-c'))
+
+
+@pytest.mark.slow
+def test_websocket_attach_interactive_shell(api_server):
+    """The /attach websocket bridges a PTY shell on the cluster head
+    (reference: the server-side websocket SSH tunnel): commands typed
+    over the WS execute in the sandbox and output streams back."""
+    import asyncio
+    import json as json_lib
+
+    import aiohttp
+
+    url = api_server
+    rid = requests.post(f'{url}/launch', json={
+        'task_config': {'run': 'true', 'resources': {'infra': 'local'}},
+        'cluster_name': 'att-c',
+    }, timeout=10).json()['request_id']
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        rec = requests.get(f'{url}/api/get',
+                           params={'request_id': rid, 'timeout': 5},
+                           timeout=30).json()
+        if rec['status'] in ('SUCCEEDED', 'FAILED'):
+            break
+    assert rec['status'] == 'SUCCEEDED', rec
+
+    ws_url = 'ws' + url[len('http'):] + '/attach?cluster=att-c&node=0'
+
+    async def drive() -> str:
+        out = b''
+        async with aiohttp.ClientSession() as session:
+            async with session.ws_connect(ws_url, max_msg_size=0) as ws:
+                await ws.send_str(json_lib.dumps({'resize': [24, 80]}))
+                await ws.send_bytes(b'echo at$((40+2))tach\n')
+                deadline2 = time.time() + 30
+                while time.time() < deadline2:
+                    try:
+                        msg = await ws.receive(timeout=5)
+                    except asyncio.TimeoutError:
+                        continue
+                    if msg.type == aiohttp.WSMsgType.BINARY:
+                        out += msg.data
+                        if b'at42tach' in out:
+                            break
+                    elif msg.type in (aiohttp.WSMsgType.CLOSED,
+                                      aiohttp.WSMsgType.ERROR):
+                        break
+                await ws.send_bytes(b'exit\n')
+        return out.decode(errors='replace')
+
+    out = asyncio.new_event_loop().run_until_complete(drive())
+    assert 'at42tach' in out, out
+
+    # Unknown cluster -> 404, not a ws upgrade.
+    resp = requests.get(f'{url}/attach', params={'cluster': 'nope'},
+                        timeout=10)
+    assert resp.status_code == 404
+
+    requests.post(f'{url}/down', json={'cluster_name': 'att-c'},
+                  timeout=10)
