@@ -1,0 +1,44 @@
+"""External log shipping: streaming aggregators + bucket archives.
+
+Reference: sky/logs/__init__.py:11-21 — `logs.store` selects a
+fluent-bit-based agent (GCP Cloud Logging / AWS CloudWatch) installed
+on every host at provision time. This build supports BOTH forms under
+one config key:
+
+    logs:
+      store: gcp            # stream to Cloud Logging (fluent-bit)
+      # store: aws          # stream to CloudWatch Logs
+      # store: gs://bucket  # archive finished jobs' log dirs (rsync)
+
+Bucket/path stores are handled by the job driver after each job
+(`agent/job_driver._ship_logs`); `gcp`/`aws` install a fluent-bit
+tail -> cloud-logging pipeline via `get_aggregator()` at instance
+setup, so logs stream live, survive host loss, and land in the
+cloud's native log explorer with cluster/job/rank labels.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_config
+from skypilot_tpu.logs.aggregator import (CloudwatchAggregator,
+                                          LoggingAggregator,
+                                          StackdriverAggregator)
+
+AGGREGATOR_STORES = ('gcp', 'aws')
+
+
+def get_aggregator() -> Optional[LoggingAggregator]:
+    """The configured streaming aggregator, or None (bucket stores and
+    unset config both return None — the driver handles buckets)."""
+    store = sky_config.get_nested(('logs', 'store'))
+    if store is None or str(store) not in AGGREGATOR_STORES:
+        return None
+    if store == 'gcp':
+        return StackdriverAggregator(
+            sky_config.get_nested(('logs', 'gcp')) or {})
+    if store == 'aws':
+        return CloudwatchAggregator(
+            sky_config.get_nested(('logs', 'aws')) or {})
+    raise exceptions.SkyError(f'invalid logs.store {store!r}')

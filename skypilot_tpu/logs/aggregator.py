@@ -1,0 +1,140 @@
+"""Fluent-bit log aggregators: tail agent job logs → cloud logging.
+
+Reference: sky/logs/agent.py (FluentbitAgent) + gcp.py/aws.py. The
+TPU-native differences:
+- the tail glob covers BOTH the combined `run.log` and the per-rank
+  `rank-<i>.log` files the gang driver writes, and the path regex
+  lifts (job_id, rank) into log labels — a 64-host slice's logs
+  arrive queryable by rank;
+- setup is idempotent and runs as one command list through the
+  ordinary command runners (no separate credential mount machinery:
+  TPU VMs authenticate Cloud Logging via the metadata server by
+  default, a service-account key file is the explicit override).
+"""
+from __future__ import annotations
+
+import shlex
+from typing import Any, Dict, List
+
+from skypilot_tpu import constants
+
+_CONF_DIR = '~/.sky-tpu-agent/fluentbit'
+# run.log + rank-N.log under <home>/job_logs/<job_id>/
+_LOG_GLOB = f'{constants.SKY_REMOTE_HOME}/job_logs/*/*.log'
+_TAG_REGEX = (r'/job_logs/(?<job_id>\d+)/'
+              r'(?<file>(run|rank-\d+))\.log$')
+
+_INSTALL_FLUENTBIT = (
+    'command -v fluent-bit >/dev/null 2>&1 || '
+    '[ -x /opt/fluent-bit/bin/fluent-bit ] || '
+    '(curl -fsSL https://raw.githubusercontent.com/fluent/fluent-bit/'
+    'master/install.sh | sh) || '
+    '(sudo apt-get update -y && sudo apt-get install -y fluent-bit)')
+
+_FLUENTBIT_BIN = ('$(command -v fluent-bit || '
+                  'echo /opt/fluent-bit/bin/fluent-bit)')
+
+
+class LoggingAggregator:
+    """Base: the INPUT/parser half is shared; outputs differ."""
+
+    def __init__(self, config: Dict[str, Any]) -> None:
+        self.config = dict(config or {})
+
+    # -- per-store ----------------------------------------------------------
+    def output_config(self, cluster_name: str) -> str:
+        raise NotImplementedError
+
+    def precheck_command(self) -> str:
+        """Fails fast with a clear message when credentials are
+        impossible (better than fluent-bit retry loops)."""
+        return 'true'
+
+    # -- shared -------------------------------------------------------------
+    def fluentbit_config(self, cluster_name: str) -> str:
+        """Classic-mode fluent-bit config: tail + path-label lifting +
+        the store's OUTPUT section."""
+        return f"""\
+[SERVICE]
+    flush        5
+    daemon       off
+    parsers_file parsers.conf
+
+[INPUT]
+    name             tail
+    path             {_LOG_GLOB}
+    tag_regex        {_TAG_REGEX}
+    tag              job.<job_id>.<file>
+    refresh_interval 5
+    skip_long_lines  on
+
+[FILTER]
+    name   modify
+    match  job.*
+    add    cluster {cluster_name}
+
+{self.output_config(cluster_name)}
+"""
+
+    def setup_commands(self, cluster_name: str) -> List[str]:
+        """Idempotent: install, write config, (re)start the shipper."""
+        conf = self.fluentbit_config(cluster_name)
+        return [
+            self.precheck_command(),
+            _INSTALL_FLUENTBIT,
+            f'mkdir -p {_CONF_DIR}',
+            f'printf %s {shlex.quote(conf)} > {_CONF_DIR}/fluentbit.conf',
+            # Resolve ~ (fluent-bit does not) and restart the daemon.
+            f'sed -i "s|~|$HOME|g" {_CONF_DIR}/fluentbit.conf',
+            f'pkill -f "fluent-bit.*{_CONF_DIR}" 2>/dev/null || true',
+            f'nohup {_FLUENTBIT_BIN} -c {_CONF_DIR}/fluentbit.conf '
+            f'> {_CONF_DIR}/fluentbit.log 2>&1 &',
+        ]
+
+
+class StackdriverAggregator(LoggingAggregator):
+    """GCP Cloud Logging (reference: sky/logs/gcp.py). TPU VMs carry
+    metadata-server credentials; `credentials_file` overrides for
+    hosts outside GCP."""
+
+    def precheck_command(self) -> str:
+        cred = self.config.get('credentials_file')
+        if cred:
+            return (f'export GOOGLE_APPLICATION_CREDENTIALS={cred}; '
+                    f'grep -q service_account {cred} || '
+                    f'(echo "logs.gcp.credentials_file must be a '
+                    f'service-account key" && exit 1)')
+        return ('curl -s -m 2 http://metadata.google.internal '
+                '>/dev/null || (echo "no GCP metadata server; set '
+                'logs.gcp.credentials_file to a service-account key" '
+                '&& exit 1)')
+
+    def output_config(self, cluster_name: str) -> str:
+        project = self.config.get('project_id', '')
+        project_line = f'\n    export_to_project_id {project}' \
+            if project else ''
+        return f"""\
+[OUTPUT]
+    name      stackdriver
+    match     job.*
+    resource  global
+    severity_key severity
+    labels    cluster={cluster_name}{project_line}"""
+
+
+class CloudwatchAggregator(LoggingAggregator):
+    """AWS CloudWatch Logs (reference: sky/logs/aws.py)."""
+
+    def output_config(self, cluster_name: str) -> str:
+        region = self.config.get('region', 'us-east-1')
+        group = self.config.get('log_group_name', 'skypilot-logs')
+        stream_prefix = self.config.get('log_stream_prefix',
+                                        f'{cluster_name}-')
+        return f"""\
+[OUTPUT]
+    name              cloudwatch_logs
+    match             job.*
+    region            {region}
+    log_group_name    {group}
+    log_stream_prefix {stream_prefix}
+    auto_create_group true"""

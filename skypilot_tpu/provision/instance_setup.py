@@ -104,6 +104,20 @@ def setup_agents(cluster_info: provision_common.ClusterInfo,
         if rc != 0:
             raise exceptions.ClusterSetUpError(
                 f'Failed to start agent on {inst.instance_id} (rc={rc}).')
+        # Streaming aggregator (logs.store: gcp|aws): fluent-bit tails
+        # the job logs on every host (reference: sky/logs). Best-effort
+        # — a logging outage must not fail provisioning.
+        from skypilot_tpu import logs as logs_lib
+        aggregator = logs_lib.get_aggregator()
+        if aggregator is not None:
+            setup = ' && '.join(
+                aggregator.setup_commands(cluster_name))
+            rc, _, err = runner.run(setup, require_outputs=True)
+            if rc != 0:
+                ux_utils.log(
+                    f'Log aggregator setup failed on '
+                    f'{inst.instance_id} (rc={rc}): {err[-300:]}; '
+                    f'continuing without streaming logs there.')
 
     try:
         subprocess_utils.run_in_parallel(bootstrap,

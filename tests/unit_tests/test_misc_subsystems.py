@@ -677,3 +677,42 @@ def test_launch_daemon_pdeathsig_reaps_on_parent_kill(tmp_path):
     while time.time() < deadline and process_alive(daemon_pid):
         time.sleep(0.2)
     assert not process_alive(daemon_pid)
+
+
+def test_log_aggregator_selection_and_config(isolated_state, monkeypatch,
+                                             tmp_path):
+    """logs.store gcp/aws selects a streaming aggregator with a
+    fluent-bit pipeline tailing run.log AND per-rank logs; bucket URLs
+    keep the driver's archive path (None here)."""
+    from skypilot_tpu import logs as logs_lib
+
+    cfg = tmp_path / 'cfg.yaml'
+    monkeypatch.setenv('SKYPILOT_TPU_CONFIG', str(cfg))
+
+    cfg.write_text('logs:\n  store: gs://bucket/logs\n')
+    assert logs_lib.get_aggregator() is None  # driver handles buckets
+
+    cfg.write_text('logs:\n  store: gcp\n  gcp:\n    project_id: p1\n')
+    agg = logs_lib.get_aggregator()
+    assert isinstance(agg, logs_lib.StackdriverAggregator)
+    conf = agg.fluentbit_config('my-cluster')
+    assert 'job_logs/*/*.log' in conf          # run.log + rank-N.log
+    assert 'job_id' in conf and 'rank' in conf  # labels lifted from path
+    assert 'stackdriver' in conf
+    assert 'export_to_project_id p1' in conf
+    assert 'cluster my-cluster' in conf
+    cmds = agg.setup_commands('my-cluster')
+    assert any('fluent-bit' in c for c in cmds)
+    assert any('metadata.google.internal' in c or
+               'GOOGLE_APPLICATION_CREDENTIALS' in c for c in cmds)
+
+    cfg.write_text('logs:\n  store: aws\n  aws:\n    region: eu-west-1\n'
+                   '    log_group_name: tpu-logs\n')
+    agg = logs_lib.get_aggregator()
+    assert isinstance(agg, logs_lib.CloudwatchAggregator)
+    conf = agg.fluentbit_config('c2')
+    assert 'cloudwatch_logs' in conf and 'eu-west-1' in conf
+    assert 'tpu-logs' in conf
+
+    cfg.write_text('logs: {}\n')
+    assert logs_lib.get_aggregator() is None
