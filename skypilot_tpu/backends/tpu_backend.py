@@ -118,6 +118,36 @@ class TpuVmResourceHandle(backend_lib.ResourceHandle):
 # ---------------------------------------------------------------------------
 # Provision with failover
 # ---------------------------------------------------------------------------
+def _render_provision_artifact(cluster_name_on_cloud: str, cloud,
+                               region, zones, config) -> None:
+    """Write the exact request each provision attempt sends to
+    `~/.sky-tpu/generated/<cluster>.yaml` — the debug-inspectable
+    artifact filling the role of the reference's rendered cluster YAML
+    (sky/backends/backend_utils.py write_cluster_config): when a
+    launch misbehaves, `stpu debug-dump` and a human can read what was
+    actually requested, per attempt, without a debugger."""
+    import yaml
+    try:
+        out_dir = os.path.join(constants.sky_home(), 'generated')
+        os.makedirs(out_dir, exist_ok=True)
+        path = os.path.join(out_dir, f'{cluster_name_on_cloud}.yaml')
+        doc = {
+            'rendered_at': time.strftime('%Y-%m-%dT%H:%M:%S%z'),
+            'cloud': cloud.canonical_name(),
+            'region': region.name,
+            'zones': [z.name for z in zones] if zones else None,
+            'count': config.count,
+            'tags': config.tags,
+            'ports_to_open': config.ports_to_open,
+            'provider_config': config.provider_config,
+        }
+        with open(path, 'a', encoding='utf-8') as f:
+            f.write('---\n')
+            yaml.safe_dump(doc, f, sort_keys=False)
+    except Exception:  # pylint: disable=broad-except
+        pass  # a debug artifact must never fail a launch
+
+
 class RetryingProvisioner:
     """Iterate candidate zones/regions; classify errors; fail over.
 
@@ -282,6 +312,8 @@ class RetryingProvisioner:
             tags={'skypilot-cluster': cluster_name_on_cloud},
             ports_to_open=to_provision.ports,
         )
+        _render_provision_artifact(cluster_name_on_cloud, cloud, region,
+                                   zones, config)
         provider = cloud.provisioner_module()
         record = provision_lib.run_instances(provider, region.name,
                                              cluster_name_on_cloud, config)

@@ -4,6 +4,7 @@ SURVEY §4 strategy: an in-memory tpu.googleapis.com emulating node
 lifecycle + multi-host slice topologies, so create/wait/query/
 get_cluster_info/terminate run without a cloud account.
 """
+import os
 import re
 
 import pytest
@@ -321,3 +322,26 @@ def test_blocked_cloud_surfaces_to_callers(fake_api, monkeypatch):
                                  retry_until_up=True)
     assert exc_info.value.blocked_cloud == 'gcp'
     assert len(calls) == 1  # no retry-until-up spin on a dead cloud
+
+
+def test_provision_renders_debug_artifact(fake_api, isolated_state):
+    """Each provision attempt appends its exact request to
+    ~/.sky-tpu/generated/<cluster>.yaml (the debug-inspectable
+    equivalent of the reference's rendered cluster YAML)."""
+    import yaml
+
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.backends.tpu_backend import RetryingProvisioner
+
+    task = task_lib.Task(run='true')
+    r = resources_lib.Resources(infra='gcp', accelerators='tpu-v5e-16')
+    task.set_resources(r)
+    RetryingProvisioner().provision_with_retries(task, r, 'art', 'art')
+    path = os.path.join(isolated_state, 'generated', 'art.yaml')
+    assert os.path.exists(path)
+    docs = list(yaml.safe_load_all(open(path, encoding='utf-8')))
+    assert docs and docs[0]['cloud'] == 'gcp'
+    assert docs[0]['provider_config']['tpu_accelerator_type'] == \
+        'v5litepod-16'
+    assert docs[0]['zones']
