@@ -38,12 +38,94 @@ def _bucket(n: int, cap: int) -> int:
     return min(b, cap)
 
 
+class PrefixCache:
+    """Content-addressed KV page reuse across requests (the vLLM
+    automatic-prefix-caching idea, TPU-paged form).
+
+    Every FULL page of a prompt gets a chain key (hash of all tokens
+    up to and including that page), so two requests sharing a system
+    prompt map their common full pages to the SAME physical pages —
+    admission skips recomputing them (prefill runs only the suffix)
+    and the pool holds one copy. Pages of finished prompts stay
+    RESIDENT but unreferenced (LRU), evicted back to the allocator
+    only under pool pressure. Shared pages are never written: suffix
+    prefill and decode both write at positions past the cached
+    region, and the masked tail of a padded chunk lands in the trash
+    page (the paged-KV contract, docs/internals.md §4).
+    """
+
+    def __init__(self, page_size: int) -> None:
+        self.page_size = page_size
+        self.by_key: Dict[bytes, int] = {}
+        self.key_of: Dict[int, bytes] = {}
+        self.refs: Dict[int, int] = {}
+        # Resident-but-unreferenced pages, oldest first (evictable).
+        self.lru: 'collections.OrderedDict[int, None]' = \
+            collections.OrderedDict()
+        self.hits = 0    # pages served from cache
+        self.misses = 0  # full prompt pages that had to be computed
+
+    @staticmethod
+    def chain_keys(tokens, page_size: int) -> List[bytes]:
+        """One key per FULL page; key_i commits to ALL tokens through
+        page i, so equal keys imply equal attention history."""
+        import hashlib
+        keys = []
+        h = hashlib.sha256()
+        for i in range(len(tokens) // page_size):
+            chunk = tokens[i * page_size:(i + 1) * page_size]
+            h.update(np.asarray(chunk, np.int32).tobytes())
+            keys.append(h.digest())
+        return keys
+
+    def lookup_acquire(self, keys: List[bytes]) -> List[int]:
+        """Longest cached prefix of `keys`; takes a reference on each
+        returned page (pinned against eviction)."""
+        pages = []
+        for key in keys:
+            page = self.by_key.get(key)
+            if page is None:
+                break
+            pages.append(page)
+            self.refs[page] = self.refs.get(page, 0) + 1
+            self.lru.pop(page, None)
+        self.hits += len(pages)
+        self.misses += len(keys) - len(pages)
+        return pages
+
+    def release(self, pages: List[int]) -> None:
+        for page in pages:
+            self.refs[page] -= 1
+            if self.refs[page] == 0:
+                del self.refs[page]
+                self.lru[page] = None  # newest evictable
+
+    def insert(self, key: bytes, page: int) -> bool:
+        """Adopt ownership of `page` under `key`; False = key already
+        cached (caller keeps the page and releases it normally)."""
+        if key in self.by_key:
+            return False
+        self.by_key[key] = page
+        self.key_of[page] = key
+        self.lru[page] = None
+        return True
+
+    def evict_into(self, allocator, need: int) -> None:
+        """Return unreferenced cached pages to the allocator until it
+        can serve `need` pages (or the evictable set is dry)."""
+        while not allocator.can_allocate(need) and self.lru:
+            page, _ = self.lru.popitem(last=False)
+            del self.by_key[self.key_of.pop(page)]
+            allocator.release([page])
+
+
 class ContinuousBatchingEngine:
 
     def __init__(self, model, params, *, num_slots: int = 8,
                  max_total_len: int = 256, temperature: float = 0.0,
                  eos_id: Optional[int] = None,
                  paged: Optional[bool] = None,
+                 prefix_caching: bool = True,
                  speculative_k: int = 0, spec_ngram: int = 2,
                  spec_lookback: int = 512) -> None:
         assert max_total_len <= model.config.max_seq_len
@@ -99,6 +181,8 @@ class ContinuousBatchingEngine:
             self.total_pages = cfg_pool
             self.pages_per_seq = -(
                 -(max_total_len + self.spec_k) // self.page_size)
+        self.prefix_caching = bool(prefix_caching and self.paged)
+        self.prefix_cache: Optional[PrefixCache] = None  # set per reset
 
         # _fresh_cache is the single paging-reset point (also the
         # error-recovery path).
@@ -146,6 +230,14 @@ class ContinuousBatchingEngine:
         self.owned_pages: List[List[int]] = [
             [] for _ in range(self.num_slots)]
         self.allocated_tokens = np.zeros((self.num_slots,), np.int32)
+        # Prefix caching (vLLM APC): per-slot shared (read-only) page
+        # refs + the prompt's chain keys for promotion on completion.
+        self.prefix_cache = (PrefixCache(self.page_size)
+                             if self.prefix_caching else None)
+        self.shared_pages: List[List[int]] = [
+            [] for _ in range(self.num_slots)]
+        self.slot_keys: List[List[bytes]] = [
+            [] for _ in range(self.num_slots)]
 
     def _fresh_cache(self):
         """Zeroed KV cache for the slot pool. Also the recovery path:
@@ -335,6 +427,37 @@ class ContinuousBatchingEngine:
         self._prefill_fns[bucket_len] = prefill
         return prefill
 
+    def _prefill_suffix_fn(self, bucket_len: int):
+        """fn(params, cache, suffix[P], suffix_len, offset, page_row)
+        -> (cache, last_logits): chunked prefill of a prompt SUFFIX
+        whose first `offset` tokens are already resident in (shared)
+        KV pages. prefill=False — the chunk attends the FULL history
+        through the page table (the speculative-verify attention
+        path), and its writes land only at positions >= offset, i.e.
+        never in a shared page."""
+        key = ('suffix', bucket_len)
+        if key in self._prefill_fns:
+            return self._prefill_fns[key]
+        model = self.model
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def prefill_suffix(params, cache, suffix, suffix_len, offset,
+                           page_row):
+            positions = (offset +
+                         jnp.arange(bucket_len, dtype=jnp.int32))[None, :]
+            logits, mutated = model.apply(
+                {'params': params, 'cache': cache},
+                suffix[None, :], positions=positions,
+                decode=True, mutable=['cache'],
+                page_indices=page_row, prefill=False)
+            last = jax.lax.dynamic_index_in_dim(
+                logits[0].astype(jnp.float32), suffix_len - 1, axis=0,
+                keepdims=False)
+            return mutated['cache'], last
+
+        self._prefill_fns[key] = prefill_suffix
+        return prefill_suffix
+
     # -- public API ---------------------------------------------------------
     def submit(self, prompt: List[int],
                max_new_tokens: int = 64,
@@ -416,41 +539,86 @@ class ContinuousBatchingEngine:
                 continue
             slot = int(np.argmin(self.active))  # first free slot
             plen = len(prompt)
-            bucket = _bucket(plen, self.max_total_len)
+            shared: List[int] = []
+            keys: List[bytes] = []
             if self.paged:
-                # The prefill scan writes positions [0, bucket): the
-                # real prompt needs pages; the padded tail hits trash
-                # only where the table row is unallocated, so allocate
-                # for plen (+1 for the first generated token).
+                # Prefix cache: map the prompt's cached full pages to
+                # their existing physical pages; prefill computes only
+                # the suffix. At least ONE token must prefill (the
+                # continuation samples from its logits), so a fully
+                # cached prompt drops its last shared page.
+                if self.prefix_cache is not None:
+                    keys = PrefixCache.chain_keys(prompt, self.page_size)
+                    shared = self.prefix_cache.lookup_acquire(keys)
+                    if len(shared) * self.page_size >= plen:
+                        self.prefix_cache.release([shared.pop()])
+                n_cached = len(shared) * self.page_size
+                # The prefill scan writes positions [n_cached, bucket):
+                # the real suffix needs pages; the padded tail hits
+                # trash only where the table row is unallocated, so
+                # allocate for plen (+1 for the first generated token).
                 need = self.allocator.pages_needed(plen + 1,
-                                                   self.page_size)
+                                                   self.page_size) \
+                    - len(shared)
                 # Construction guarantees the pool holds one
                 # full-depth sequence and submit() bounds plen below
                 # max_total_len, so a lone sequence always fits.
                 assert plen + 1 <= (self.total_pages - 1) * self.page_size
+                if self.prefix_cache is not None:
+                    self.prefix_cache.evict_into(self.allocator, need)
                 if not self.allocator.can_allocate(need):
                     # Pool exhausted: back to the HEAD and stop
                     # admitting until a sequence releases pages —
                     # later arrivals must not starve this one.
+                    if self.prefix_cache is not None:
+                        self.prefix_cache.release(shared)
                     self._ready.appendleft((prompt, max_new, temp, fut))
                     break
                 pages = self.allocator.allocate(need)
                 self.owned_pages[slot] = pages
+                self.shared_pages[slot] = shared
+                self.slot_keys[slot] = keys
                 self.page_table[slot, :] = 0
-                self.page_table[slot, :need] = pages
-                self.allocated_tokens[slot] = need * self.page_size
+                self.page_table[slot, :len(shared)] = shared
+                self.page_table[slot, len(shared):len(shared) + need] = \
+                    pages
+                self.allocated_tokens[slot] = (len(shared) + need) * \
+                    self.page_size
+            else:
+                n_cached = 0
+            suffix_len = plen - n_cached
+            bucket = _bucket(suffix_len, self.max_total_len)
+            if self.paged and n_cached:
+                # The suffix chunk writes positions [n_cached,
+                # n_cached + bucket): cap the bucket so the padded
+                # tail cannot run past the page-table row —
+                # take_along_axis CLAMPS an out-of-range logical page
+                # to the last column, which is a REAL page holding the
+                # prompt tail, and the scatter would shred it.
+                bucket = min(bucket,
+                             self.pages_per_seq * self.page_size -
+                             n_cached)
+                assert bucket >= suffix_len
             # Claim the slot BEFORE any device work: if prefill raises,
             # the loop's exception handler finds (and fails) this
             # future instead of leaving the client hanging.
             self.futures[slot] = fut
-            prefill = self._prefill_fn(bucket)
+            suffix = prompt[n_cached:]
             padded = jnp.asarray(
-                prompt + [0] * (bucket - plen), jnp.int32)
-            if self.paged:
+                suffix + [0] * (bucket - suffix_len), jnp.int32)
+            if self.paged and n_cached:
+                prefill = self._prefill_suffix_fn(bucket)
+                self.cache, last_logits = prefill(
+                    self.params, self.cache, padded,
+                    jnp.int32(suffix_len), jnp.int32(n_cached),
+                    jnp.asarray(self.page_table[slot:slot + 1]))
+            elif self.paged:
+                prefill = self._prefill_fn(bucket)
                 self.cache, last_logits = prefill(
                     self.params, self.cache, padded, jnp.int32(plen),
                     jnp.asarray(self.page_table[slot:slot + 1]))
             else:
+                prefill = self._prefill_fn(bucket)
                 self.cache, last_logits = prefill(
                     self.params, self.cache, jnp.int32(slot), padded,
                     jnp.int32(plen))
@@ -498,6 +666,11 @@ class ContinuousBatchingEngine:
                 # page index == pages already allocated.
                 logical = int(self.allocated_tokens[slot]) \
                     // self.page_size
+                if not self.allocator.can_allocate(1) and \
+                        self.prefix_cache is not None:
+                    # Unreferenced cached prefixes yield before any
+                    # live sequence gets preempted.
+                    self.prefix_cache.evict_into(self.allocator, 1)
                 if not self.allocator.can_allocate(1):
                     exhausted = True
                     break
@@ -513,10 +686,7 @@ class ContinuousBatchingEngine:
             remaining = int(self.limits[slot]) - len(self.outputs[slot])
             self.futures[slot] = None
             self.active[slot] = False
-            self.allocator.release(self.owned_pages[slot])
-            self.owned_pages[slot] = []
-            self.page_table[slot, :] = 0
-            self.allocated_tokens[slot] = 0
+            self._release_slot_pages(slot, promote=False)
             if fut is not None:
                 preempted.append((list(self.outputs[slot]),
                                   max(remaining, 1),
@@ -525,15 +695,47 @@ class ContinuousBatchingEngine:
         # would reverse it — an FCFS fairness inversion).
         self._ready.extendleft(reversed(preempted))
 
+    def _release_slot_pages(self, slot: int, promote: bool) -> None:
+        """Return a slot's pages: shared refs drop (page stays cached),
+        own PROMPT-full pages are promoted into the prefix cache when
+        `promote` (completion — their contents are final), the rest go
+        back to the allocator. Preemption never promotes: its pages
+        may hold half-written junk past the committed position."""
+        cache = self.prefix_cache
+        if cache is not None:
+            own = self.owned_pages[slot]
+            # Promote own pages BEFORE releasing the shared prefix
+            # refs: LRU eviction pops oldest-first, and a chain is
+            # only useful leaf-to-root — inserting leaves first makes
+            # them evict before their prefixes (a prefix evicted
+            # under a live suffix would orphan the suffix pages:
+            # unreachable but resident).
+            if promote and own:
+                keys = self.slot_keys[slot]
+                n_shared = len(self.shared_pages[slot])
+                for i, page in enumerate(reversed(own)):
+                    logical = n_shared + len(own) - 1 - i
+                    if logical < len(keys) and \
+                            cache.insert(keys[logical], page):
+                        continue  # cache owns it now
+                    self.allocator.release([page])
+            else:
+                self.allocator.release(own)
+            cache.release(self.shared_pages[slot])
+            self.shared_pages[slot] = []
+            self.slot_keys[slot] = []
+        else:
+            self.allocator.release(self.owned_pages[slot])
+        self.owned_pages[slot] = []
+        self.page_table[slot, :] = 0
+        self.allocated_tokens[slot] = 0
+
     def _finish_slot(self, slot: int) -> None:
         fut = self.futures[slot]
         self.futures[slot] = None
         self.active[slot] = False
         if self.paged:
-            self.allocator.release(self.owned_pages[slot])
-            self.owned_pages[slot] = []
-            self.page_table[slot, :] = 0
-            self.allocated_tokens[slot] = 0
+            self._release_slot_pages(slot, promote=True)
         if fut is not None:
             fut.set_result(list(self.outputs[slot]))
 

@@ -54,6 +54,11 @@ def main() -> None:
     parser.add_argument('--port', type=int,
                         default=int(os.environ.get('SKYPILOT_SERVE_PORT',
                                                    8000)))
+    parser.add_argument('--no-prefix-caching', action='store_true',
+                        help='disable shared-prefix KV page reuse '
+                             '(vLLM-style APC; on by default with the '
+                             'paged cache — repeated system prompts '
+                             'skip recomputation and share pool pages)')
     parser.add_argument('--param-dtype', choices=['bf16', 'f32'],
                         default='bf16',
                         help='on-device dtype for --hf weights. bf16 '
@@ -168,6 +173,7 @@ def main() -> None:
         engine = ContinuousBatchingEngine(
             model, params, num_slots=args.num_slots,
             max_total_len=engine_total,
+            prefix_caching=not args.no_prefix_caching,
             speculative_k=args.speculative)
 
     # One jitted fn per (batch, temperature, total-length) bucket.
