@@ -53,6 +53,13 @@ def main() -> None:
     parser.add_argument('--repetitive', action='store_true',
                         help='structured (repeated-trigram) prompts — '
                              'the regime speculation accelerates')
+    parser.add_argument('--shared-prefix', type=int, default=0,
+                        metavar='N',
+                        help='prepend one shared N-token system '
+                             'prompt to every request — the regime '
+                             'prefix caching accelerates (chatbots, '
+                             'few-shot templates)')
+    parser.add_argument('--no-prefix-caching', action='store_true')
     parser.add_argument('--hf', default=None,
                         help='serve a local HF checkpoint directory')
     parser.add_argument('--ckpt-dir', default=None)
@@ -67,6 +74,8 @@ def main() -> None:
     if args.engine == 'continuous':
         cmd += ['--continuous-batching', '--num-slots',
                 str(args.num_slots)]
+    if args.no_prefix_caching:
+        cmd += ['--no-prefix-caching']
     if args.speculative:
         cmd += ['--speculative', str(args.speculative)]
     if args.hf:
@@ -109,9 +118,23 @@ def main() -> None:
             prompts = [[rng.randrange(1, vocab)
                         for _ in range(rng.randrange(4, 16))]
                        for _ in range(args.requests)]
-        # Warm the compile caches (both prefill buckets + decode).
-        requests.post(f'{url}/generate', json={
-            'tokens': [prompts[0]], 'max_new_tokens': 2}, timeout=600)
+        if args.shared_prefix:
+            system = [rng.randrange(1, vocab)
+                      for _ in range(args.shared_prefix)]
+            prompts = [system + p for p in prompts]
+        # Warm the compile caches (prefill buckets + decode). With
+        # prefix caching the SECOND pass over a prompt takes the
+        # suffix-prefill path (different bucket shapes) — warm the
+        # shortest and longest so the timed section measures serving,
+        # not XLA compiles.
+        warm = [prompts[0]]
+        if args.shared_prefix:
+            warm.append(min(prompts, key=len))
+            warm.append(max(prompts, key=len))
+        for p in warm:
+            for _ in range(2):
+                requests.post(f'{url}/generate', json={
+                    'tokens': [p], 'max_new_tokens': 2}, timeout=600)
 
         latencies = []
         lock = threading.Lock()
@@ -150,6 +173,8 @@ def main() -> None:
         print(json.dumps({
             'engine': args.engine,
             'speculative': args.speculative,
+            'shared_prefix': args.shared_prefix,
+            'prefix_caching': not args.no_prefix_caching,
             'model': info['model'],   # server-reported (handles --hf)
             'requests': len(latencies),
             'concurrency': args.concurrency,
