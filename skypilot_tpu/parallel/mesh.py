@@ -49,8 +49,14 @@ DEFAULT_RULES: Tuple[Tuple[str, Optional[object]], ...] = (
 
 @dataclasses.dataclass(frozen=True)
 class MeshConfig:
-    """Named mesh axis sizes. Size 1 axes are kept (harmless to XLA)."""
+    """Named mesh axis sizes. Size 1 axes are kept (harmless to XLA).
+
+    `stage` is the pipeline-parallel axis (parallel/pipeline.py):
+    placed OUTERMOST after data so stage boundaries ride long ICI
+    paths (activations cross a stage boundary once per microbatch
+    tick, far less often than fsdp/tensor collectives fire)."""
     data: int = 1
+    stage: int = 1
     fsdp: int = 1
     tensor: int = 1
     expert: int = 1
@@ -58,11 +64,12 @@ class MeshConfig:
 
     @property
     def axis_names(self) -> Tuple[str, ...]:
-        return ('data', 'fsdp', 'tensor', 'expert', 'seq')
+        return ('data', 'stage', 'fsdp', 'tensor', 'expert', 'seq')
 
     @property
     def shape(self) -> Tuple[int, ...]:
-        return (self.data, self.fsdp, self.tensor, self.expert, self.seq)
+        return (self.data, self.stage, self.fsdp, self.tensor,
+                self.expert, self.seq)
 
     @property
     def num_devices(self) -> int:

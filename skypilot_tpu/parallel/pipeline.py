@@ -1,0 +1,244 @@
+"""Pipeline parallelism: GPipe over a `stage` mesh axis via shard_map.
+
+The TPU-native formulation (scaling-book recipe, not a port of the
+reference's NCCL send/recv schedules): layer parameters are STACKED
+([L, ...] leaves) and sharded over the mesh's `stage` axis, the whole
+GPipe schedule — microbatch ingestion, per-stage layer application,
+activation hand-off — is ONE `lax.scan` inside ONE `shard_map`, and
+stage-to-stage transfer is `lax.ppermute` (XLA collective-permute on
+ICI). Backward needs nothing hand-written: `jax.grad` differentiates
+through the scan and the ppermutes (a ppermute's transpose is the
+reverse ppermute), so the 1F1B-ish backward schedule falls out of AD.
+
+Schedule: M microbatches over S stages take M + S - 1 ticks; each
+tick every stage applies its layers to the microbatch it currently
+holds (bubble ticks process garbage that is masked out of the loss).
+Utilization is M / (M + S - 1) — pick num_microbatches >= 4 * stages.
+
+v1 scope: the GPT family (the flagship trainer model), composing with
+data parallelism (`data` axis; batch microbatches are sharded over
+it). tensor/fsdp compose in principle (they shard WITHIN a stage) but
+are not exercised here.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from skypilot_tpu.parallel.train import TrainState, next_token_loss
+
+
+def stack_layer_params(params: Dict[str, Any], prefix: str,
+                       num_layers: int) -> Tuple[Any, Dict[str, Any]]:
+    """Split a model's params into (stacked block leaves [L, ...],
+    everything else). The stacked tree's structure is ONE block's."""
+    layers = [params[f'{prefix}{i}'] for i in range(num_layers)]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
+    rest = {k: v for k, v in params.items()
+            if not (k.startswith(prefix) and
+                    k[len(prefix):].isdigit())}
+    return stacked, rest
+
+
+def unstack_layer_params(stacked: Any, rest: Dict[str, Any],
+                         prefix: str, num_layers: int) -> Dict[str, Any]:
+    """Inverse of stack_layer_params (checkpoint interop)."""
+    out = dict(rest)
+    for i in range(num_layers):
+        out[f'{prefix}{i}'] = jax.tree.map(lambda x, i=i: x[i], stacked)
+    return out
+
+
+class PipelinedGPT:
+    """GPipe-parallel training step for the GPT family.
+
+    Usage:
+        pp = PipelinedGPT(model, mesh, num_microbatches=8)
+        stacked, rest = pp.split_params(params)
+        loss = pp.loss(stacked, rest, tokens)          # jittable
+        step = pp.make_train_step(tx)                  # optimizer step
+    """
+
+    def __init__(self, model, mesh: Mesh,
+                 num_microbatches: int = 8) -> None:
+        from skypilot_tpu.models import gpt as gpt_lib
+        self.model = model
+        self.cfg = model.config
+        self.mesh = mesh
+        self.num_stages = mesh.shape['stage']
+        self.num_microbatches = num_microbatches
+        if self.cfg.num_layers % self.num_stages:
+            raise ValueError(
+                f'num_layers={self.cfg.num_layers} must divide evenly '
+                f'into {self.num_stages} pipeline stages')
+        if getattr(self.cfg, 'dropout_rate', 0.0):
+            raise ValueError(
+                'PipelinedGPT v1 runs blocks deterministically; '
+                'dropout_rate > 0 would be silently ignored — train '
+                'without dropout or use ShardedTrainer.')
+        if getattr(self.cfg, 'remat', False):
+            raise ValueError(
+                'PipelinedGPT v1 does not rematerialize blocks; set '
+                'remat=False (pipeline microbatching already bounds '
+                'live activations to one microbatch per stage).')
+        self.layers_per_stage = self.cfg.num_layers // self.num_stages
+        self._block = gpt_lib.Block(self.cfg)
+
+    # -- params -------------------------------------------------------------
+    def split_params(self, params: Dict[str, Any]) -> Tuple[Any, Any]:
+        return stack_layer_params(params, 'h_', self.cfg.num_layers)
+
+    def merge_params(self, stacked: Any, rest: Any) -> Dict[str, Any]:
+        return unstack_layer_params(stacked, rest, 'h_',
+                                    self.cfg.num_layers)
+
+    def param_shardings(self, stacked: Any, rest: Any):
+        """(stacked, rest) NamedShardings: layer dim over `stage`."""
+        s_stage = jax.tree.map(
+            lambda x: NamedSharding(self.mesh,
+                                    P('stage', *([None] * (x.ndim - 1)))),
+            stacked)
+        s_rest = jax.tree.map(
+            lambda x: NamedSharding(self.mesh, P()), rest)
+        return s_stage, s_rest
+
+    # -- forward ------------------------------------------------------------
+    def _embed(self, rest: Dict[str, Any], tokens: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        wte = rest['wte'].astype(cfg.dtype)
+        wpe = rest['wpe'].astype(cfg.dtype)
+        return wte[tokens] + wpe[:tokens.shape[1]]
+
+    def _head_loss(self, rest: Dict[str, Any], x: jax.Array,
+                   tokens: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        scale = rest['ln_f']['scale'].astype(jnp.float32)
+        bias = rest['ln_f']['bias'].astype(jnp.float32)
+        x32 = x.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.var(x32, axis=-1, keepdims=True)
+        x32 = (x32 - mean) * jax.lax.rsqrt(var + cfg.norm_eps)
+        x_n = (x32 * scale + bias).astype(cfg.dtype)
+        logits = jnp.einsum('bse,ve->bsv', x_n,
+                            rest['wte'].astype(cfg.dtype),
+                            preferred_element_type=(cfg.logits_dtype or
+                                                    cfg.dtype))
+        return next_token_loss(logits, tokens)
+
+    def loss(self, stacked: Any, rest: Any,
+             tokens: jax.Array) -> jax.Array:
+        """Mean LM loss over the global batch, pipeline-parallel.
+
+        tokens: [global_batch, seq]; global_batch must divide into
+        num_microbatches x data-axis size.
+        """
+        S = self.num_stages
+        M = self.num_microbatches
+        d = self.mesh.shape['data']
+        B, seq_len = tokens.shape
+        if B % (M * d):
+            raise ValueError(f'batch {B} must divide into '
+                             f'{M} microbatches x data={d}')
+        mb = B // (M * d)
+        tokens_mb = tokens.reshape(M, d * mb, seq_len)
+
+        block_apply = self._block.apply
+        embed = self._embed
+        head_loss = self._head_loss
+        lps = self.layers_per_stage
+
+        def pipeline(stacked_local, rest_rep, tokens_local):
+            # stacked_local: [layers_per_stage, ...] (stage shard);
+            # tokens_local: [M, mb, seq] (data shard).
+            stage = jax.lax.axis_index('stage')
+
+            def apply_stage(x):
+                def one_layer(h, layer_params):
+                    return block_apply({'params': layer_params}, h,
+                                       True), None
+                x, _ = jax.lax.scan(one_layer, x, stacked_local)
+                return x
+
+            def tick(carry, t):
+                buf = carry
+                in_idx = jnp.clip(t, 0, M - 1)
+                # cond, not where: only stage 0 pays for the embedding
+                # gather (mirrors the last-stage head cond below).
+                x = jax.lax.cond(
+                    stage == 0,
+                    lambda: embed(rest_rep,
+                                  tokens_local[in_idx]).astype(buf.dtype),
+                    lambda: buf)
+                y = apply_stage(x)
+                out_idx = t - (S - 1)
+                is_out = jnp.logical_and(stage == S - 1,
+                                         jnp.logical_and(out_idx >= 0,
+                                                         out_idx < M))
+                # Head+loss only on the LAST stage's live ticks (cond
+                # skips the vocab matmul on every other stage/tick).
+                loss_mb = jax.lax.cond(
+                    is_out,
+                    lambda: head_loss(
+                        rest_rep, y,
+                        tokens_local[jnp.clip(out_idx, 0, M - 1)]),
+                    lambda: jnp.zeros((), jnp.float32))
+                nxt = jax.lax.ppermute(
+                    y, 'stage', [(i, (i + 1) % S) for i in range(S)])
+                return nxt, loss_mb
+
+            buf0 = jnp.zeros((tokens_local.shape[1], seq_len,
+                              self.cfg.embed_dim), self.cfg.dtype)
+            _, losses = jax.lax.scan(tick, buf0,
+                                     jnp.arange(M + S - 1))
+            # Only the last stage produced nonzero loss terms; psum
+            # broadcasts the sum to every stage, pmean averages over
+            # data shards.
+            total = jax.lax.psum(jnp.sum(losses), 'stage')
+            return jax.lax.pmean(total / M, 'data')
+
+        fn = shard_map(
+            pipeline, mesh=self.mesh,
+            in_specs=(P('stage'), P(), P(None, 'data', None)),
+            out_specs=P(),
+            check_rep=False)
+        return fn(stacked, rest, tokens_mb)
+
+    # -- training -----------------------------------------------------------
+    def init(self, rng: jax.Array, example: jax.Array,
+             tx: optax.GradientTransformation) -> TrainState:
+        """TrainState whose params are the (stacked, rest) pair, laid
+        out with stage-sharded block leaves."""
+        import flax.linen as nn
+        params = nn.meta.unbox(
+            self.model.init(rng, example[:1])['params'])
+        stacked, rest = self.split_params(params)
+        s_stage, s_rest = self.param_shardings(stacked, rest)
+        stacked = jax.tree.map(jax.device_put, stacked, s_stage)
+        rest = jax.tree.map(jax.device_put, rest, s_rest)
+        return TrainState.create((stacked, rest), tx)
+
+    def make_train_step(self, tx: optax.GradientTransformation):
+
+        @jax.jit
+        def train_step(state: TrainState, tokens: jax.Array
+                       ) -> Tuple[TrainState, jax.Array]:
+            stacked, rest = state.params
+
+            def loss_fn(s, r):
+                return self.loss(s, r, tokens)
+
+            loss, grads = jax.value_and_grad(loss_fn,
+                                             argnums=(0, 1))(stacked,
+                                                             rest)
+            updates, opt_state = tx.update(grads, state.opt_state,
+                                           state.params)
+            params = optax.apply_updates(state.params, updates)
+            return state.replace(step=state.step + 1, params=params,
+                                 opt_state=opt_state), loss
+
+        return train_step

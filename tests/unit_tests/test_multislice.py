@@ -24,7 +24,7 @@ def test_faked_slices_layout():
     mesh = mesh_lib.make_mesh(
         mesh_lib.MeshConfig(data=2, fsdp=4), devices=devices,
         slice_ids=[0, 0, 0, 0, 1, 1, 1, 1])
-    assert mesh.devices.shape == (2, 4, 1, 1, 1)
+    assert mesh.devices.shape == (2, 1, 4, 1, 1, 1)  # incl. stage axis
     # data row r == slice r, exactly.
     assert set(mesh.devices[0].flatten()) == set(devices[:4])
     assert set(mesh.devices[1].flatten()) == set(devices[4:])
