@@ -223,6 +223,31 @@ class Block(nn.Module):
         return nn.with_logical_constraint(x, ('batch', 'seq', 'act_embed'))
 
 
+def embed_tokens(params, tokens: jax.Array, cfg: GPTConfig) -> jax.Array:
+    """Functional form of GPT's input embedding (wte + wpe over
+    training positions). Shared with the pipeline trainer's stage-0 op
+    (parallel/pipeline.py) so head/embedding changes cannot silently
+    diverge between the sequential and pipelined paths."""
+    wte = params['wte'].astype(cfg.dtype)
+    wpe = params['wpe'].astype(cfg.dtype)
+    return wte[tokens] + wpe[:tokens.shape[1]]
+
+
+def final_norm_logits(params, x: jax.Array, cfg: GPTConfig) -> jax.Array:
+    """Functional form of GPT's ln_f + tied LM head (the pipeline
+    trainer's last-stage op)."""
+    scale = params['ln_f']['scale'].astype(jnp.float32)
+    bias = params['ln_f']['bias'].astype(jnp.float32)
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    x_n = ((x32 - mean) * jax.lax.rsqrt(var + cfg.norm_eps) * scale +
+           bias).astype(cfg.dtype)
+    return jnp.einsum('bse,ve->bsv', x_n, params['wte'].astype(cfg.dtype),
+                      preferred_element_type=(cfg.logits_dtype or
+                                              cfg.dtype))
+
+
 class GPT(nn.Module):
     """GPT-2 decoder; __call__ returns logits [B, S, vocab]."""
     config: GPTConfig

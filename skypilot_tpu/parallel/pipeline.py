@@ -22,6 +22,7 @@ are not exercised here.
 """
 from __future__ import annotations
 
+import functools
 from typing import Any, Dict, Tuple
 
 import jax
@@ -109,26 +110,14 @@ class PipelinedGPT:
 
     # -- forward ------------------------------------------------------------
     def _embed(self, rest: Dict[str, Any], tokens: jax.Array) -> jax.Array:
-        cfg = self.cfg
-        wte = rest['wte'].astype(cfg.dtype)
-        wpe = rest['wpe'].astype(cfg.dtype)
-        return wte[tokens] + wpe[:tokens.shape[1]]
+        from skypilot_tpu.models.gpt import embed_tokens
+        return embed_tokens(rest, tokens, self.cfg)
 
     def _head_loss(self, rest: Dict[str, Any], x: jax.Array,
                    tokens: jax.Array) -> jax.Array:
-        cfg = self.cfg
-        scale = rest['ln_f']['scale'].astype(jnp.float32)
-        bias = rest['ln_f']['bias'].astype(jnp.float32)
-        x32 = x.astype(jnp.float32)
-        mean = jnp.mean(x32, axis=-1, keepdims=True)
-        var = jnp.var(x32, axis=-1, keepdims=True)
-        x32 = (x32 - mean) * jax.lax.rsqrt(var + cfg.norm_eps)
-        x_n = (x32 * scale + bias).astype(cfg.dtype)
-        logits = jnp.einsum('bse,ve->bsv', x_n,
-                            rest['wte'].astype(cfg.dtype),
-                            preferred_element_type=(cfg.logits_dtype or
-                                                    cfg.dtype))
-        return next_token_loss(logits, tokens)
+        from skypilot_tpu.models.gpt import final_norm_logits
+        return next_token_loss(final_norm_logits(rest, x, self.cfg),
+                               tokens)
 
     def loss(self, stacked: Any, rest: Any,
              tokens: jax.Array) -> jax.Array:
@@ -214,17 +203,36 @@ class PipelinedGPT:
         """TrainState whose params are the (stacked, rest) pair, laid
         out with stage-sharded block leaves."""
         import flax.linen as nn
-        params = nn.meta.unbox(
-            self.model.init(rng, example[:1])['params'])
-        stacked, rest = self.split_params(params)
-        s_stage, s_rest = self.param_shardings(stacked, rest)
-        stacked = jax.tree.map(jax.device_put, stacked, s_stage)
-        rest = jax.tree.map(jax.device_put, rest, s_rest)
-        return TrainState.create((stacked, rest), tx)
+
+        def _init():
+            params = nn.meta.unbox(
+                self.model.init(rng, example[:1])['params'])
+            return self.split_params(params)
+
+        # Born-sharded (the ShardedTrainer pattern): a model big
+        # enough to NEED pipeline stages must never materialize whole
+        # on one device.
+        shapes = jax.eval_shape(_init)
+        shardings = self.param_shardings(*shapes)
+        stacked, rest = jax.jit(_init, out_shardings=shardings)()
+        state = TrainState.create((stacked, rest), tx)
+        # The scalar step (and any opt-state scalar, e.g. the schedule
+        # count) must be MESH-replicated, not single-device: a
+        # checkpoint restore follows this template's shardings, and
+        # jit rejects mixed device sets.
+        rep = NamedSharding(self.mesh, P())
+        return state.replace(
+            step=jax.device_put(state.step, rep),
+            opt_state=jax.tree.map(
+                lambda x: jax.device_put(x, rep)
+                if getattr(x, 'ndim', None) == 0 else x,
+                state.opt_state))
 
     def make_train_step(self, tx: optax.GradientTransformation):
 
-        @jax.jit
+        # Donating the state halves peak HBM (params + Adam moments
+        # would otherwise be live twice per step).
+        @functools.partial(jax.jit, donate_argnums=(0,))
         def train_step(state: TrainState, tokens: jax.Array
                        ) -> Tuple[TrainState, jax.Array]:
             stacked, rest = state.params

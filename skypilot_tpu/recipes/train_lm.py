@@ -94,6 +94,15 @@ def main() -> None:
     parser.add_argument('--tensor', type=int, default=1,
                         help='tensor-parallel mesh axis size')
     parser.add_argument('--expert', type=int, default=1)
+    parser.add_argument('--pipeline-stages', type=int, default=1,
+                        help='GPipe pipeline parallelism over a stage '
+                             'mesh axis (parallel/pipeline.py; GPT '
+                             'family, v1: composes with data '
+                             'parallelism only). num_layers must '
+                             'divide evenly into stages')
+    parser.add_argument('--microbatches', type=int, default=0,
+                        help='pipeline microbatches (0 = 4 x stages; '
+                             'utilization = M / (M + stages - 1))')
     parser.add_argument('--seq-parallel', type=int, default=1,
                         help='context-parallel mesh axis size '
                              '(ring attention)')
@@ -119,9 +128,24 @@ def main() -> None:
 
     n_dev = len(jax.devices())
     proc_id = jax.process_index()
-    mesh_cfg = mesh_lib.MeshConfig.auto(n_dev, tensor=args.tensor,
-                                        expert=args.expert,
-                                        seq=args.seq_parallel)
+    if args.microbatches and args.pipeline_stages <= 1:
+        raise SystemExit('--microbatches only applies with '
+                         '--pipeline-stages > 1')
+    if args.pipeline_stages > 1:
+        if (args.tensor, args.expert, args.seq_parallel) != (1, 1, 1):
+            raise SystemExit('--pipeline-stages composes with data '
+                             'parallelism only (v1); drop '
+                             '--tensor/--expert/--seq-parallel')
+        if n_dev % args.pipeline_stages:
+            raise SystemExit(f'{n_dev} devices not divisible by '
+                             f'{args.pipeline_stages} pipeline stages')
+        mesh_cfg = mesh_lib.MeshConfig(
+            data=n_dev // args.pipeline_stages,
+            stage=args.pipeline_stages)
+    else:
+        mesh_cfg = mesh_lib.MeshConfig.auto(n_dev, tensor=args.tensor,
+                                            expert=args.expert,
+                                            seq=args.seq_parallel)
     mesh = mesh_lib.make_mesh(mesh_cfg)
     if proc_id == 0:
         print(f'devices={n_dev} {mesh_lib.mesh_summary(mesh)}', flush=True)
@@ -147,22 +171,44 @@ def main() -> None:
     batch = args.global_batch or 8 * n_dev
     tx = default_optimizer(learning_rate=args.lr, warmup_steps=10,
                            total_steps=max(args.steps, 20))
-    kwargs = {} if loss_fn is None else {'loss_fn': loss_fn}
-    trainer = ShardedTrainer(model, mesh, tx=tx, **kwargs)
+    if args.pipeline_stages > 1:
+        from skypilot_tpu.models.gpt import GPT
+        from skypilot_tpu.parallel.pipeline import PipelinedGPT
+        if not isinstance(model, GPT):
+            raise SystemExit('--pipeline-stages supports the GPT '
+                             'family (v1)')
+        microbatches = args.microbatches or 4 * args.pipeline_stages
+        denom = microbatches * mesh_cfg.data
+        if batch % denom:
+            batch = max(denom, (batch // denom) * denom)
+            if proc_id == 0:
+                print(f'pipeline: rounding global batch to {batch} '
+                      f'({microbatches} microbatches x '
+                      f'data={mesh_cfg.data})', flush=True)
+        pp = PipelinedGPT(model, mesh, num_microbatches=microbatches)
+        example = jnp.zeros((batch, args.seq), jnp.int32)
+        state = pp.init(jax.random.PRNGKey(0), example, tx)
+        if hf_params is not None:
+            hf_params = pp.split_params(hf_params)
+        step_fn = pp.make_train_step(tx)
+    else:
+        kwargs = {} if loss_fn is None else {'loss_fn': loss_fn}
+        trainer = ShardedTrainer(model, mesh, tx=tx, **kwargs)
 
-    example = jnp.zeros((batch, args.seq), jnp.int32)
-    state = trainer.init(jax.random.PRNGKey(0), example)
+        example = jnp.zeros((batch, args.seq), jnp.int32)
+        state = trainer.init(jax.random.PRNGKey(0), example)
+        step_fn = trainer.make_train_step(example)
     if hf_params is not None:
         # Replace the random init with the imported weights, placed
-        # with the SAME shardings the trainer chose (device_put against
-        # the initialized leaves' shardings — fsdp/tp-safe). Fresh
-        # optimizer moments are correct for a finetune start.
+        # with the SAME shardings the trainer chose (device_put
+        # against the initialized leaves' shardings — fsdp/tp/stage-
+        # safe). Fresh optimizer moments are correct for a finetune
+        # start.
         state = state.replace(params=jax.tree.map(
             lambda init_leaf, w: jax.device_put(
                 jnp.asarray(w, init_leaf.dtype), init_leaf.sharding),
             state.params, hf_params))
         del hf_params
-    step_fn = trainer.make_train_step(example)
 
     # Checkpoint resume (preemption recovery path).
     mgr = None

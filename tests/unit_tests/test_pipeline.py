@@ -105,3 +105,30 @@ def test_uneven_layers_rejected():
     mesh = mesh_lib.make_mesh(mesh_lib.MeshConfig(stage=8))
     with pytest.raises(ValueError, match='divide evenly'):
         PipelinedGPT(model, mesh)
+
+
+@pytest.mark.slow
+def test_train_lm_pipeline_cli(tmp_path):
+    """The product surface: train_lm --pipeline-stages runs end-to-end
+    on a stage x data mesh, checkpoints the (stacked, rest) state, and
+    RESUMES from it."""
+    import os
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env = dict(os.environ)
+    env['XLA_FLAGS'] = '--xla_force_host_platform_device_count=8'
+    env['PYTHONPATH'] = f"{repo}:{env.get('PYTHONPATH', '')}"
+    base = [sys.executable, '-m', 'skypilot_tpu.recipes.train_lm',
+            '--cpu', '--model', 'tiny', '--pipeline-stages', '2',
+            '--seq', '64', '--global-batch', '32', '--log-every', '2',
+            '--ckpt-dir', str(tmp_path / 'ckpt'), '--ckpt-every', '2']
+    out = subprocess.run(base + ['--steps', '2'], capture_output=True,
+                         text=True, env=env, timeout=420)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert 'stage=2' in out.stdout
+    out = subprocess.run(base + ['--steps', '4'], capture_output=True,
+                         text=True, env=env, timeout=420)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert 'resumed from checkpoint step 2' in out.stdout
