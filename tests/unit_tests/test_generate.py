@@ -198,8 +198,11 @@ def test_paged_engine_under_page_pressure():
     for p, got in zip(prompts, results):
         assert got[:len(p)] == list(p)
         assert len(got) > len(p)  # actually generated
-    # Every page was released (4 usable pages; page 0 is trash).
-    assert engine.allocator.free_pages == 4
+    # No page leaked: every usable page (4; page 0 is trash) is either
+    # free or resident-evictable in the prefix cache (completed
+    # prompts' full pages stay cached for reuse).
+    cached = len(engine.prefix_cache.lru) if engine.prefix_cache else 0
+    assert engine.allocator.free_pages + cached == 4
 
 
 @pytest.mark.slow
