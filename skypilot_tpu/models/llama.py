@@ -280,6 +280,26 @@ class Block(nn.Module):
         return nn.with_logical_constraint(x, ('batch', 'seq', 'act_embed'))
 
 
+def embed_tokens(params, tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
+    """Functional input embedding (shared with the pipeline trainer's
+    stage-0 op, parallel/pipeline.py — mirrors gpt.embed_tokens)."""
+    return params['tok_embed'].astype(cfg.dtype)[tokens]
+
+
+def final_norm_logits(params, x: jax.Array, cfg: LlamaConfig) -> jax.Array:
+    """Functional final RMSNorm + untied LM head (the pipeline
+    trainer's last-stage op; numerics mirror Llama.__call__)."""
+    scale = params['final_norm']['scale'].astype(jnp.float32)
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    x_n = (x32 * jax.lax.rsqrt(var + cfg.norm_eps) * scale).astype(
+        cfg.dtype)
+    return jnp.einsum('bse,ev->bsv', x_n,
+                      params['lm_head'].astype(cfg.dtype),
+                      preferred_element_type=(cfg.logits_dtype or
+                                              jnp.float32))
+
+
 class Llama(nn.Module):
     """Llama decoder; __call__ returns logits [B, S, vocab] (f32)."""
     config: LlamaConfig

@@ -15,10 +15,10 @@ tick every stage applies its layers to the microbatch it currently
 holds (bubble ticks process garbage that is masked out of the loss).
 Utilization is M / (M + S - 1) — pick num_microbatches >= 4 * stages.
 
-v1 scope: the GPT family (the flagship trainer model), composing with
-data parallelism (`data` axis; batch microbatches are sharded over
-it). tensor/fsdp compose in principle (they shard WITHIN a stage) but
-are not exercised here.
+v1 scope: the GPT and Llama families, composing with data
+parallelism (`data` axis; batch microbatches are sharded over it).
+tensor/fsdp compose in principle (they shard WITHIN a stage) but are
+not exercised here.
 """
 from __future__ import annotations
 
@@ -55,11 +55,28 @@ def unstack_layer_params(stacked: Any, rest: Dict[str, Any],
     return out
 
 
-class PipelinedGPT:
-    """GPipe-parallel training step for the GPT family.
+def _family_of(model):
+    """(layer prefix, Block module, embed fn, head-logits fn,
+    block-wants-positions) for a supported model family."""
+    from skypilot_tpu.models import gpt as gpt_lib
+    from skypilot_tpu.models import llama as llama_lib
+    if isinstance(model, gpt_lib.GPT):
+        return ('h_', gpt_lib.Block(model.config),
+                gpt_lib.embed_tokens, gpt_lib.final_norm_logits, False)
+    if isinstance(model, llama_lib.Llama):
+        return ('layer_', llama_lib.Block(model.config),
+                llama_lib.embed_tokens, llama_lib.final_norm_logits,
+                True)
+    raise ValueError(
+        f'Pipeline parallelism supports the GPT and Llama families; '
+        f'got {type(model).__name__}')
+
+
+class PipelinedLM:
+    """GPipe-parallel training step for the GPT/Llama families.
 
     Usage:
-        pp = PipelinedGPT(model, mesh, num_microbatches=8)
+        pp = PipelinedLM(model, mesh, num_microbatches=8)
         stacked, rest = pp.split_params(params)
         loss = pp.loss(stacked, rest, tokens)          # jittable
         step = pp.make_train_step(tx)                  # optimizer step
@@ -67,35 +84,36 @@ class PipelinedGPT:
 
     def __init__(self, model, mesh: Mesh,
                  num_microbatches: int = 8) -> None:
-        from skypilot_tpu.models import gpt as gpt_lib
         self.model = model
         self.cfg = model.config
         self.mesh = mesh
         self.num_stages = mesh.shape['stage']
         self.num_microbatches = num_microbatches
+        (self._prefix, self._block, self._embed_fn, self._head_fn,
+         self._block_takes_positions) = _family_of(model)
         if self.cfg.num_layers % self.num_stages:
             raise ValueError(
                 f'num_layers={self.cfg.num_layers} must divide evenly '
                 f'into {self.num_stages} pipeline stages')
         if getattr(self.cfg, 'dropout_rate', 0.0):
             raise ValueError(
-                'PipelinedGPT v1 runs blocks deterministically; '
+                'PipelinedLM v1 runs blocks deterministically; '
                 'dropout_rate > 0 would be silently ignored — train '
                 'without dropout or use ShardedTrainer.')
         if getattr(self.cfg, 'remat', False):
             raise ValueError(
-                'PipelinedGPT v1 does not rematerialize blocks; set '
+                'PipelinedLM v1 does not rematerialize blocks; set '
                 'remat=False (pipeline microbatching already bounds '
                 'live activations to one microbatch per stage).')
         self.layers_per_stage = self.cfg.num_layers // self.num_stages
-        self._block = gpt_lib.Block(self.cfg)
 
     # -- params -------------------------------------------------------------
     def split_params(self, params: Dict[str, Any]) -> Tuple[Any, Any]:
-        return stack_layer_params(params, 'h_', self.cfg.num_layers)
+        return stack_layer_params(params, self._prefix,
+                                  self.cfg.num_layers)
 
     def merge_params(self, stacked: Any, rest: Any) -> Dict[str, Any]:
-        return unstack_layer_params(stacked, rest, 'h_',
+        return unstack_layer_params(stacked, rest, self._prefix,
                                     self.cfg.num_layers)
 
     def param_shardings(self, stacked: Any, rest: Any):
@@ -110,14 +128,11 @@ class PipelinedGPT:
 
     # -- forward ------------------------------------------------------------
     def _embed(self, rest: Dict[str, Any], tokens: jax.Array) -> jax.Array:
-        from skypilot_tpu.models.gpt import embed_tokens
-        return embed_tokens(rest, tokens, self.cfg)
+        return self._embed_fn(rest, tokens, self.cfg)
 
     def _head_loss(self, rest: Dict[str, Any], x: jax.Array,
                    tokens: jax.Array) -> jax.Array:
-        from skypilot_tpu.models.gpt import final_norm_logits
-        return next_token_loss(final_norm_logits(rest, x, self.cfg),
-                               tokens)
+        return next_token_loss(self._head_fn(rest, x, self.cfg), tokens)
 
     def loss(self, stacked: Any, rest: Any,
              tokens: jax.Array) -> jax.Array:
@@ -137,9 +152,9 @@ class PipelinedGPT:
         tokens_mb = tokens.reshape(M, d * mb, seq_len)
 
         block_apply = self._block.apply
+        takes_positions = self._block_takes_positions
         embed = self._embed
         head_loss = self._head_loss
-        lps = self.layers_per_stage
 
         def pipeline(stacked_local, rest_rep, tokens_local):
             # stacked_local: [layers_per_stage, ...] (stage shard);
@@ -147,9 +162,19 @@ class PipelinedGPT:
             stage = jax.lax.axis_index('stage')
 
             def apply_stage(x):
-                def one_layer(h, layer_params):
-                    return block_apply({'params': layer_params}, h,
-                                       True), None
+                if takes_positions:
+                    # Llama-family blocks take (x, positions).
+                    positions = jnp.broadcast_to(
+                        jnp.arange(x.shape[1]), x.shape[:2])
+
+                    def one_layer(h, layer_params):
+                        return block_apply({'params': layer_params}, h,
+                                           positions), None
+                else:
+                    # GPT-family blocks take (x, deterministic).
+                    def one_layer(h, layer_params):
+                        return block_apply({'params': layer_params}, h,
+                                           True), None
                 x, _ = jax.lax.scan(one_layer, x, stacked_local)
                 return x
 
@@ -250,3 +275,7 @@ class PipelinedGPT:
                                  opt_state=opt_state), loss
 
         return train_step
+
+
+# Back-compat alias (the class predates Llama support).
+PipelinedGPT = PipelinedLM

@@ -96,9 +96,9 @@ def main() -> None:
     parser.add_argument('--expert', type=int, default=1)
     parser.add_argument('--pipeline-stages', type=int, default=1,
                         help='GPipe pipeline parallelism over a stage '
-                             'mesh axis (parallel/pipeline.py; GPT '
-                             'family, v1: composes with data '
-                             'parallelism only). num_layers must '
+                             'mesh axis (parallel/pipeline.py; '
+                             'GPT/Llama families, v1: composes with '
+                             'data parallelism only). num_layers must '
                              'divide evenly into stages')
     parser.add_argument('--microbatches', type=int, default=0,
                         help='pipeline microbatches (0 = 4 x stages; '
@@ -173,10 +173,11 @@ def main() -> None:
                            total_steps=max(args.steps, 20))
     if args.pipeline_stages > 1:
         from skypilot_tpu.models.gpt import GPT
-        from skypilot_tpu.parallel.pipeline import PipelinedGPT
-        if not isinstance(model, GPT):
-            raise SystemExit('--pipeline-stages supports the GPT '
-                             'family (v1)')
+        from skypilot_tpu.models.llama import Llama
+        from skypilot_tpu.parallel.pipeline import PipelinedLM
+        if not isinstance(model, (GPT, Llama)):
+            raise SystemExit('--pipeline-stages supports the GPT and '
+                             'Llama families (v1)')
         microbatches = args.microbatches or 4 * args.pipeline_stages
         denom = microbatches * mesh_cfg.data
         if batch % denom:
@@ -185,7 +186,7 @@ def main() -> None:
                 print(f'pipeline: rounding global batch to {batch} '
                       f'({microbatches} microbatches x '
                       f'data={mesh_cfg.data})', flush=True)
-        pp = PipelinedGPT(model, mesh, num_microbatches=microbatches)
+        pp = PipelinedLM(model, mesh, num_microbatches=microbatches)
         example = jnp.zeros((batch, args.seq), jnp.int32)
         state = pp.init(jax.random.PRNGKey(0), example, tx)
         if hf_params is not None:

@@ -132,3 +132,47 @@ def test_train_lm_pipeline_cli(tmp_path):
                          text=True, env=env, timeout=420)
     assert out.returncode == 0, out.stdout + out.stderr
     assert 'resumed from checkpoint step 2' in out.stdout
+
+
+@pytest.mark.slow
+def test_pipeline_llama_matches_sequential():
+    """The Llama family pipelines too: loss AND grads match the
+    sequential model (rope/GQA blocks, untied head, RMSNorm)."""
+    from skypilot_tpu.models.llama import Llama, LlamaConfig
+    from skypilot_tpu.parallel.pipeline import PipelinedLM
+    cfg = LlamaConfig(vocab_size=256, max_seq_len=64, num_layers=4,
+                      num_heads=4, num_kv_heads=2, embed_dim=64,
+                      mlp_dim=128, dtype=jnp.float32,
+                      logits_dtype=jnp.float32)
+    model = Llama(cfg)
+    params = nn.meta.unbox(model.init(
+        jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))['params'])
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshConfig(stage=4, data=2))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (16, 32), 0,
+                                cfg.vocab_size, jnp.int32)
+    pp = PipelinedLM(model, mesh, num_microbatches=4)
+    stacked, rest = pp.split_params(params)
+    ref = next_token_loss(model.apply({'params': params}, tokens), tokens)
+    got = pp.loss(stacked, rest, tokens)
+    np.testing.assert_allclose(float(got), float(ref), rtol=2e-5)
+
+    ref_grads = jax.grad(lambda p: next_token_loss(
+        model.apply({'params': p}, tokens), tokens))(params)
+    ref_stacked, ref_rest = stack_layer_params(ref_grads, 'layer_', 4)
+    g_stacked, g_rest = jax.grad(
+        lambda s, r: pp.loss(s, r, tokens), argnums=(0, 1))(stacked, rest)
+    for a, b in zip(jax.tree.leaves(ref_stacked),
+                    jax.tree.leaves(g_stacked)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=3e-4, atol=3e-5)
+    for a, b in zip(jax.tree.leaves(ref_rest), jax.tree.leaves(g_rest)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=3e-4, atol=3e-5)
+
+
+def test_pipeline_rejects_unsupported_family():
+    from skypilot_tpu.models.deepseek import Deepseek, DeepseekConfig
+    from skypilot_tpu.parallel.pipeline import PipelinedLM
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshConfig(stage=2, data=4))
+    with pytest.raises(ValueError, match='GPT and Llama'):
+        PipelinedLM(Deepseek(DeepseekConfig.tiny()), mesh)
