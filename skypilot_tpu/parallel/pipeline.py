@@ -83,12 +83,19 @@ class PipelinedLM:
     """
 
     def __init__(self, model, mesh: Mesh,
-                 num_microbatches: int = 8) -> None:
+                 num_microbatches: int = 8,
+                 remat_ticks: bool = True) -> None:
         self.model = model
         self.cfg = model.config
         self.mesh = mesh
         self.num_stages = mesh.shape['stage']
         self.num_microbatches = num_microbatches
+        # Rematerialize each schedule tick: backward recomputes the
+        # tick's layer forwards instead of keeping every tick's
+        # intermediate activations live — the memory profile pipeline
+        # training needs (activations scale with ticks = M + S - 1
+        # otherwise). Equality-tested on, off in test_pipeline.py.
+        self.remat_ticks = remat_ticks
         (self._prefix, self._block, self._embed_fn, self._head_fn,
          self._block_takes_positions) = _family_of(model)
         if self.cfg.num_layers % self.num_stages:
@@ -155,6 +162,7 @@ class PipelinedLM:
         takes_positions = self._block_takes_positions
         embed = self._embed
         head_loss = self._head_loss
+        remat_ticks = self.remat_ticks
 
         def pipeline(stacked_local, rest_rep, tokens_local):
             # stacked_local: [layers_per_stage, ...] (stage shard);
@@ -207,7 +215,9 @@ class PipelinedLM:
 
             buf0 = jnp.zeros((tokens_local.shape[1], seq_len,
                               self.cfg.embed_dim), self.cfg.dtype)
-            _, losses = jax.lax.scan(tick, buf0,
+            body = (jax.checkpoint(tick, prevent_cse=False)
+                    if remat_ticks else tick)
+            _, losses = jax.lax.scan(body, buf0,
                                      jnp.arange(M + S - 1))
             # Only the last stage produced nonzero loss terms; psum
             # broadcasts the sum to every stage, pmean averages over
@@ -220,7 +230,9 @@ class PipelinedLM:
             in_specs=(P('stage'), P(), P(None, 'data', None)),
             out_specs=P(),
             check_rep=False)
-        return fn(stacked, rest, tokens_mb)
+        # jit (inlined when already inside a jit): jax.checkpoint in
+        # the tick body cannot be evaluated under an EAGER shard_map.
+        return jax.jit(fn)(stacked, rest, tokens_mb)
 
     # -- training -----------------------------------------------------------
     def init(self, rng: jax.Array, example: jax.Array,
