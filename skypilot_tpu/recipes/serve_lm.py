@@ -54,6 +54,13 @@ def main() -> None:
     parser.add_argument('--port', type=int,
                         default=int(os.environ.get('SKYPILOT_SERVE_PORT',
                                                    8000)))
+    parser.add_argument('--tensor', type=int, default=1,
+                        help='tensor-parallel serving over N devices: '
+                             'params shard per the training rules '
+                             '(heads/mlp/vocab over the tensor axis) '
+                             'and XLA propagates the sharding through '
+                             'every serving fn — models bigger than '
+                             'one chip serve across the slice')
     parser.add_argument('--no-prefix-caching', action='store_true',
                         help='disable shared-prefix KV page reuse '
                              '(vLLM-style APC; on by default with the '
@@ -63,11 +70,10 @@ def main() -> None:
                         default='bf16',
                         help='on-device dtype for --hf weights. bf16 '
                              '(default) halves HBM vs f32; compute '
-                             'already runs in bf16 either way. The '
-                             'model + KV cache must fit ONE chip '
-                             '(serving is single-device): an 8B '
-                             'checkpoint needs a v5p-class chip even '
-                             'in bf16. f32 is for CPU parity runs')
+                             'already runs in bf16 either way. Models '
+                             'bigger than one chip serve with '
+                             '--tensor N (sharded across the slice). '
+                             'f32 is for CPU parity runs')
     parser.add_argument('--cpu', action='store_true',
                         help='pin the CPU backend (smoke/dev runs; the '
                              'JAX_PLATFORMS env var is overridden by '
@@ -89,14 +95,13 @@ def main() -> None:
         from skypilot_tpu.models import hf_import
         model, hf_params = hf_import.load_hf_checkpoint(
             args.hf, max_seq_len=args.max_total_len)
-        # Cast DURING host->device transfer (f32 numpy -> bf16 via
-        # ml_dtypes on host): peak HBM is the bf16 footprint, not the
-        # f32 one — serving is single-device, so this is what lets a
-        # big checkpoint fit the chip at all.
-        serve_dtype = (jnp.bfloat16 if args.param_dtype == 'bf16'
-                       else jnp.float32)
-        hf_params = jax.tree.map(
-            lambda x: jnp.asarray(x, serve_dtype), hf_params)
+        # Raw f32 numpy here; the cast (bf16 via ml_dtypes) happens
+        # PER LEAF at placement time below — host transient is one
+        # leaf, device footprint is the bf16 shards.
+        import ml_dtypes
+        import numpy as _np
+        serve_cast = (ml_dtypes.bfloat16 if args.param_dtype == 'bf16'
+                      else _np.float32)
         vocab_size = model.config.vocab_size
         print(f'loaded HF checkpoint from {args.hf} '
               f'({type(model).__name__}, vocab={vocab_size})', flush=True)
@@ -133,9 +138,27 @@ def main() -> None:
     if hf_params is not None:
         params = hf_params
     else:
+        serve_cast = None  # init params stay f32 masters
         params = nn.meta.unbox(model.init(
             jax.random.PRNGKey(0),
             jnp.ones((1, 8), jnp.int32))['params'])
+    # ONE placement block for both param sources: TP-shard over the
+    # mesh (per-leaf cast, shard-only transfers) or single-device.
+    if args.tensor > 1:
+        from skypilot_tpu.parallel import mesh as mesh_lib
+        from skypilot_tpu.parallel.serving import shard_params_for_serving
+        mesh = mesh_lib.make_mesh(
+            mesh_lib.MeshConfig(tensor=args.tensor),
+            devices=jax.devices()[:args.tensor])
+        params = shard_params_for_serving(model, params, mesh,
+                                          dtype=serve_cast)
+        print(f'tensor-parallel serving over {args.tensor} devices',
+              flush=True)
+    elif serve_cast is not None:
+        import numpy as _np
+        params = jax.tree.map(
+            lambda x: jnp.asarray(_np.asarray(x).astype(serve_cast)),
+            params)
     if args.ckpt_dir:
         from skypilot_tpu.parallel.checkpoints import CheckpointManager
         mgr = CheckpointManager(args.ckpt_dir)
