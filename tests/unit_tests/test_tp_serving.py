@@ -66,3 +66,35 @@ def test_continuous_engine_identical(setup):
     finally:
         e_ref.stop()
         e_tp.stop()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize('family', ['gpt', 'mixtral'])
+def test_other_families_identical(family):
+    """GPT (tied head) and Mixtral (expert einsums) also serve
+    identically TP-sharded."""
+    if family == 'gpt':
+        from skypilot_tpu.models.gpt import GPT, GPTConfig
+        model = GPT(GPTConfig.tiny(dtype=jnp.float32,
+                                   logits_dtype=jnp.float32))
+    else:
+        from skypilot_tpu.models.mixtral import Mixtral, MixtralConfig
+        model = Mixtral(MixtralConfig.tiny(dtype=jnp.float32,
+                                           logits_dtype=jnp.float32))
+    params = nn.meta.unbox(model.init(
+        jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))['params'])
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshConfig(tensor=2),
+                              devices=jax.devices()[:2])
+    tp = shard_params_for_serving(model, params, mesh)
+    e_ref = ContinuousBatchingEngine(model, params, num_slots=2,
+                                     max_total_len=48)
+    e_tp = ContinuousBatchingEngine(model, tp, num_slots=2,
+                                    max_total_len=48)
+    try:
+        for p in ([5, 9, 2, 17], [30, 31, 32]):
+            a = e_ref.submit(p, max_new_tokens=6).result(timeout=180)
+            b = e_tp.submit(p, max_new_tokens=6).result(timeout=180)
+            assert a == b
+    finally:
+        e_ref.stop()
+        e_tp.stop()
