@@ -15,8 +15,11 @@ tick every stage applies its layers to the microbatch it currently
 holds (bubble ticks process garbage that is masked out of the loss).
 Utilization is M / (M + S - 1) — pick num_microbatches >= 4 * stages.
 
-v1 scope: the GPT and Llama families, composing with data
-parallelism (`data` axis; batch microbatches are sharded over it).
+v1 scope: the GPT, Llama, and Mixtral families (Mixtral's router
+aux loss is accumulated across stages with live-tick masking; its
+batch-mean products make the faithful reference the mean of
+per-microbatch losses), composing with data parallelism (`data`
+axis; batch microbatches are sharded over it).
 tensor/fsdp compose in principle (they shard WITHIN a stage) but are
 not exercised here.
 """
@@ -57,23 +60,35 @@ def unstack_layer_params(stacked: Any, rest: Dict[str, Any],
 
 def _family_of(model):
     """(layer prefix, Block module, embed fn, head-logits fn,
-    block-wants-positions) for a supported model family."""
+    block-wants-positions, block-returns-aux) for a supported family.
+
+    Mixtral reuses the Llama embed/head helpers (identical param
+    names/shapes: tok_embed, final_norm, untied lm_head); its blocks
+    additionally return a router aux loss, accumulated across stages
+    with live-tick masking and scaled exactly as the sequential model
+    does (weight * total / num_layers)."""
     from skypilot_tpu.models import gpt as gpt_lib
     from skypilot_tpu.models import llama as llama_lib
+    from skypilot_tpu.models import mixtral as mixtral_lib
     if isinstance(model, gpt_lib.GPT):
         return ('h_', gpt_lib.Block(model.config),
-                gpt_lib.embed_tokens, gpt_lib.final_norm_logits, False)
+                gpt_lib.embed_tokens, gpt_lib.final_norm_logits,
+                False, False)
     if isinstance(model, llama_lib.Llama):
         return ('layer_', llama_lib.Block(model.config),
                 llama_lib.embed_tokens, llama_lib.final_norm_logits,
-                True)
+                True, False)
+    if isinstance(model, mixtral_lib.Mixtral):
+        return ('layer_', mixtral_lib.Block(model.config),
+                llama_lib.embed_tokens, llama_lib.final_norm_logits,
+                True, True)
     raise ValueError(
-        f'Pipeline parallelism supports the GPT and Llama families; '
-        f'got {type(model).__name__}')
+        f'Pipeline parallelism supports the GPT, Llama, and Mixtral '
+        f'families; got {type(model).__name__}')
 
 
 class PipelinedLM:
-    """GPipe-parallel training step for the GPT/Llama families.
+    """GPipe-parallel training step (GPT/Llama/Mixtral).
 
     Usage:
         pp = PipelinedLM(model, mesh, num_microbatches=8)
@@ -97,7 +112,8 @@ class PipelinedLM:
         # otherwise). Equality-tested on, off in test_pipeline.py.
         self.remat_ticks = remat_ticks
         (self._prefix, self._block, self._embed_fn, self._head_fn,
-         self._block_takes_positions) = _family_of(model)
+         self._block_takes_positions,
+         self._block_returns_aux) = _family_of(model)
         if self.cfg.num_layers % self.num_stages:
             raise ValueError(
                 f'num_layers={self.cfg.num_layers} must divide evenly '
@@ -160,9 +176,12 @@ class PipelinedLM:
 
         block_apply = self._block.apply
         takes_positions = self._block_takes_positions
+        returns_aux = self._block_returns_aux
         embed = self._embed
         head_loss = self._head_loss
         remat_ticks = self.remat_ticks
+        aux_scale = (self.cfg.router_aux_loss_weight /
+                     self.cfg.num_layers) if returns_aux else 0.0
 
         def pipeline(stacked_local, rest_rep, tokens_local):
             # stacked_local: [layers_per_stage, ...] (stage shard);
@@ -170,21 +189,30 @@ class PipelinedLM:
             stage = jax.lax.axis_index('stage')
 
             def apply_stage(x):
+                aux0 = jnp.zeros((), jnp.float32)
                 if takes_positions:
-                    # Llama-family blocks take (x, positions).
+                    # Llama/Mixtral blocks take (x, positions); the
+                    # Mixtral block also returns a router aux term.
                     positions = jnp.broadcast_to(
                         jnp.arange(x.shape[1]), x.shape[:2])
 
-                    def one_layer(h, layer_params):
-                        return block_apply({'params': layer_params}, h,
-                                           positions), None
+                    def one_layer(carry, layer_params):
+                        h, aux = carry
+                        out = block_apply({'params': layer_params}, h,
+                                          positions)
+                        if returns_aux:
+                            h, a = out
+                            return (h, aux + a), None
+                        return (out, aux), None
                 else:
                     # GPT-family blocks take (x, deterministic).
-                    def one_layer(h, layer_params):
-                        return block_apply({'params': layer_params}, h,
-                                           True), None
-                x, _ = jax.lax.scan(one_layer, x, stacked_local)
-                return x
+                    def one_layer(carry, layer_params):
+                        h, aux = carry
+                        return (block_apply({'params': layer_params}, h,
+                                            True), aux), None
+                (x, aux), _ = jax.lax.scan(one_layer, (x, aux0),
+                                           stacked_local)
+                return x, aux
 
             def tick(carry, t):
                 buf = carry
@@ -196,7 +224,13 @@ class PipelinedLM:
                     lambda: embed(rest_rep,
                                   tokens_local[in_idx]).astype(buf.dtype),
                     lambda: buf)
-                y = apply_stage(x)
+                y, aux = apply_stage(x)
+                # A stage's tick is LIVE when it holds microbatch
+                # t - stage in [0, M): bubble ticks process garbage
+                # whose aux must not count.
+                mb_idx = t - stage
+                live = jnp.logical_and(mb_idx >= 0, mb_idx < M)
+                aux = jnp.where(live, aux, 0.0)
                 out_idx = t - (S - 1)
                 is_out = jnp.logical_and(stage == S - 1,
                                          jnp.logical_and(out_idx >= 0,
@@ -211,18 +245,23 @@ class PipelinedLM:
                     lambda: jnp.zeros((), jnp.float32))
                 nxt = jax.lax.ppermute(
                     y, 'stage', [(i, (i + 1) % S) for i in range(S)])
-                return nxt, loss_mb
+                return nxt, (loss_mb, aux)
 
             buf0 = jnp.zeros((tokens_local.shape[1], seq_len,
                               self.cfg.embed_dim), self.cfg.dtype)
             body = (jax.checkpoint(tick, prevent_cse=False)
                     if remat_ticks else tick)
-            _, losses = jax.lax.scan(body, buf0,
-                                     jnp.arange(M + S - 1))
-            # Only the last stage produced nonzero loss terms; psum
-            # broadcasts the sum to every stage, pmean averages over
-            # data shards.
+            _, (losses, auxes) = jax.lax.scan(body, buf0,
+                                              jnp.arange(M + S - 1))
+            # Only the last stage produced nonzero CE terms; every
+            # stage contributed aux for its own layers' live ticks.
+            # psum broadcasts the sums, pmean averages data shards.
+            # Aux scaling matches the sequential model exactly
+            # (weight * total_layers_aux / num_layers, averaged over
+            # the M microbatches).
             total = jax.lax.psum(jnp.sum(losses), 'stage')
+            total = total + aux_scale * jax.lax.psum(jnp.sum(auxes),
+                                                     'stage')
             return jax.lax.pmean(total / M, 'data')
 
         fn = shard_map(

@@ -97,9 +97,9 @@ def main() -> None:
     parser.add_argument('--pipeline-stages', type=int, default=1,
                         help='GPipe pipeline parallelism over a stage '
                              'mesh axis (parallel/pipeline.py; '
-                             'GPT/Llama families, v1: composes with '
-                             'data parallelism only). num_layers must '
-                             'divide evenly into stages')
+                             'GPT/Llama/Mixtral families, v1: composes '
+                             'with data parallelism only). '
+                             'num_layers must divide into stages')
     parser.add_argument('--microbatches', type=int, default=0,
                         help='pipeline microbatches (0 = 4 x stages; '
                              'utilization = M / (M + stages - 1))')
@@ -174,10 +174,11 @@ def main() -> None:
     if args.pipeline_stages > 1:
         from skypilot_tpu.models.gpt import GPT
         from skypilot_tpu.models.llama import Llama
+        from skypilot_tpu.models.mixtral import Mixtral
         from skypilot_tpu.parallel.pipeline import PipelinedLM
-        if not isinstance(model, (GPT, Llama)):
-            raise SystemExit('--pipeline-stages supports the GPT and '
-                             'Llama families (v1)')
+        if not isinstance(model, (GPT, Llama, Mixtral)):
+            raise SystemExit('--pipeline-stages supports the GPT, '
+                             'Llama, and Mixtral families (v1)')
         microbatches = args.microbatches or 4 * args.pipeline_stages
         denom = microbatches * mesh_cfg.data
         if batch % denom:

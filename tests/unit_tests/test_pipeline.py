@@ -174,7 +174,7 @@ def test_pipeline_rejects_unsupported_family():
     from skypilot_tpu.models.deepseek import Deepseek, DeepseekConfig
     from skypilot_tpu.parallel.pipeline import PipelinedLM
     mesh = mesh_lib.make_mesh(mesh_lib.MeshConfig(stage=2, data=4))
-    with pytest.raises(ValueError, match='GPT and Llama'):
+    with pytest.raises(ValueError, match='GPT, Llama, and Mixtral'):
         PipelinedLM(Deepseek(DeepseekConfig.tiny()), mesh)
 
 
@@ -196,3 +196,50 @@ def test_tick_remat_preserves_loss_and_grads(setup):
     for a, b in zip(jax.tree.leaves(g_on), jax.tree.leaves(g_off)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-5, atol=1e-7)
+
+
+@pytest.mark.slow
+def test_pipeline_mixtral_matches_per_microbatch_reference():
+    """Mixtral pipelines with exact equality to the sequential model
+    evaluated per microbatch (the router aux is a product of
+    batch-means, so the faithful reference is the mean of per-
+    microbatch losses; with M=1 this IS the full-batch loss)."""
+    from skypilot_tpu.models.mixtral import (Mixtral, MixtralConfig,
+                                             moe_next_token_loss)
+    from skypilot_tpu.parallel.pipeline import PipelinedLM
+    cfg = MixtralConfig(vocab_size=256, max_seq_len=64, num_layers=4,
+                        num_heads=4, num_kv_heads=2, embed_dim=64,
+                        mlp_dim=96, num_experts=4, experts_per_token=2,
+                        dtype=jnp.float32, logits_dtype=jnp.float32)
+    model = Mixtral(cfg)
+    params = nn.meta.unbox(model.init(
+        jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))['params'])
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshConfig(stage=4, data=2))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0,
+                                cfg.vocab_size, jnp.int32)
+
+    # M=1: pipeline loss == sequential full-batch loss EXACTLY.
+    pp1 = PipelinedLM(model, mesh, num_microbatches=1)
+    stacked, rest = pp1.split_params(params)
+    ref_full = moe_next_token_loss(
+        model.apply({'params': params}, tokens), tokens)
+    np.testing.assert_allclose(float(pp1.loss(stacked, rest, tokens)),
+                               float(ref_full), rtol=3e-4)
+
+    # M=4: pipeline == mean of per-microbatch sequential losses.
+    pp4 = PipelinedLM(model, mesh, num_microbatches=4)
+    mbs = tokens.reshape(4, 2, 32)
+    ref_mb = np.mean([float(moe_next_token_loss(
+        model.apply({'params': params}, mb), mb)) for mb in mbs])
+    np.testing.assert_allclose(float(pp4.loss(stacked, rest, tokens)),
+                               ref_mb, rtol=3e-4)
+
+    # Gradients flow (router included): one step descends.
+    from skypilot_tpu.parallel.train import default_optimizer
+    tx = default_optimizer()
+    state = pp4.init(jax.random.PRNGKey(0), tokens, tx)
+    step = pp4.make_train_step(tx)
+    state, l0 = step(state, tokens)
+    for _ in range(3):
+        state, l1 = step(state, tokens)
+    assert float(l1) < float(l0)
