@@ -98,3 +98,24 @@ def test_other_families_identical(family):
     finally:
         e_ref.stop()
         e_tp.stop()
+
+
+@pytest.mark.slow
+def test_deepseek_mla_identical():
+    """DeepSeek's MLA serving path (latent KV cache, absorbed decode)
+    also serves identically off TP-sharded params."""
+    from skypilot_tpu.models.deepseek import Deepseek, DeepseekConfig
+    from skypilot_tpu.models import generate as gen
+    model = Deepseek(DeepseekConfig.tiny(dtype=jnp.float32,
+                                         logits_dtype=jnp.float32))
+    params = nn.meta.unbox(model.init(
+        jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))['params'])
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshConfig(tensor=2),
+                              devices=jax.devices()[:2])
+    tp = shard_params_for_serving(model, params, mesh)
+    prompt = jnp.asarray([[5, 9, 2, 17]], jnp.int32)
+    ref = np.asarray(gen.make_generate_fn(model, 8)(
+        params, prompt, jax.random.PRNGKey(0)))
+    got = np.asarray(gen.make_generate_fn(model, 8)(
+        tp, prompt, jax.random.PRNGKey(0)))
+    np.testing.assert_array_equal(ref, got)
