@@ -196,6 +196,8 @@ class ContinuousBatchingEngine:
         self.futures: List[Optional[Future]] = [None] * num_slots
         self.limits = np.zeros((num_slots,), np.int32)
         self.temps = np.zeros((num_slots,), np.float32)
+        self.top_ks = np.zeros((num_slots,), np.int32)   # 0 = off
+        self.top_ps = np.ones((num_slots,), np.float32)  # 1 = off
 
         # Observability: model calls vs tokens committed (speculation
         # quality = tokens_committed / decode_calls, 1.0..K+1).
@@ -267,20 +269,18 @@ class ContinuousBatchingEngine:
         paged = self.paged
 
         @functools.partial(jax.jit, donate_argnums=(1,))
-        def decode(params, cache, cur_token, pos, temps, rng,
-                   page_indices=None):
+        def decode(params, cache, cur_token, pos, temps, top_ks,
+                   top_ps, rng, page_indices=None):
+            from skypilot_tpu.models.generate import sample_tokens
             extra = {'page_indices': page_indices} if paged else {}
             logits, mutated = model.apply(
                 {'params': params, 'cache': cache},
                 cur_token[:, None], positions=pos[:, None], decode=True,
                 mutable=['cache'], **extra)
-            logits = logits[:, 0]
-            # Per-slot temperature: sampled where temp>0, greedy else.
-            scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
-            sampled = jax.random.categorical(rng, scaled, axis=-1)
-            greedy = jnp.argmax(logits, axis=-1)
-            out = jnp.where(temps > 0, sampled, greedy)
-            return mutated['cache'], out.astype(jnp.int32)
+            # Per-slot temperature/top-k/top-p: greedy where temp==0.
+            out = sample_tokens(rng, logits[:, 0], temps, top_ks,
+                                top_ps)
+            return mutated['cache'], out
 
         return decode
 
@@ -304,19 +304,17 @@ class ContinuousBatchingEngine:
         k = self.spec_k
 
         @functools.partial(jax.jit, donate_argnums=(1,))
-        def spec_decode(params, cache, chunk, pos, temps, rng,
-                        page_indices=None):
+        def spec_decode(params, cache, chunk, pos, temps, top_ks,
+                        top_ps, rng, page_indices=None):
             positions = pos[:, None] + jnp.arange(k + 1)[None, :]
             extra = {'page_indices': page_indices} if paged else {}
             logits, mutated = model.apply(
                 {'params': params, 'cache': cache}, chunk,
                 positions=positions, decode=True, mutable=['cache'],
                 **extra)                                   # [B, K+1, V]
-            scaled = logits / jnp.maximum(temps, 1e-6)[:, None, None]
-            sampled = jax.random.categorical(rng, scaled, axis=-1)
-            greedy = jnp.argmax(logits, axis=-1)
-            out = jnp.where(temps[:, None] > 0, sampled, greedy)
-            return mutated['cache'], out.astype(jnp.int32)
+            from skypilot_tpu.models.generate import sample_tokens
+            out = sample_tokens(rng, logits, temps, top_ks, top_ps)
+            return mutated['cache'], out
 
         return spec_decode
 
@@ -461,18 +459,24 @@ class ContinuousBatchingEngine:
     # -- public API ---------------------------------------------------------
     def submit(self, prompt: List[int],
                max_new_tokens: int = 64,
-               temperature: Optional[float] = None) -> 'Future':
+               temperature: Optional[float] = None,
+               top_k: int = 0, top_p: float = 1.0) -> 'Future':
         """Queue a request; the Future resolves to the full token list
         (prompt ++ generated). `temperature` overrides the engine
-        default per request (0 = greedy)."""
+        default per request (0 = greedy); `top_k`/`top_p` filter the
+        sampled distribution (0 / 1.0 = off)."""
         if len(prompt) >= self.max_total_len:
             raise ValueError(
                 f'prompt len {len(prompt)} >= max_total_len '
                 f'{self.max_total_len}')
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f'top_p must be in (0, 1], got {top_p}')
+        if top_k < 0:
+            raise ValueError(f'top_k must be >= 0, got {top_k}')
         temp = self.temperature if temperature is None else temperature
         fut: Future = Future()
         self._queue.put((list(prompt), int(max_new_tokens),
-                         float(temp), fut))
+                         float(temp), int(top_k), float(top_p), fut))
         return fut
 
     def stop(self) -> None:
@@ -515,6 +519,8 @@ class ContinuousBatchingEngine:
                 self.pos[:] = 0
                 self.cur_token[:] = 0
                 self.temps[:] = 0
+                self.top_ks[:] = 0
+                self.top_ps[:] = 1.0
                 while self._ready:
                     *_rest, fut = self._ready.popleft()
                     fut.set_exception(e)
@@ -533,7 +539,8 @@ class ContinuousBatchingEngine:
             except queue.Empty:
                 break
         while self._ready and not self.active.all():
-            prompt, max_new, temp, fut = self._ready.popleft()
+            prompt, max_new, temp, top_k, top_p, fut = \
+                self._ready.popleft()
             if max_new <= 0:
                 fut.set_result(list(prompt))  # nothing to generate
                 continue
@@ -572,7 +579,8 @@ class ContinuousBatchingEngine:
                     # later arrivals must not starve this one.
                     if self.prefix_cache is not None:
                         self.prefix_cache.release(shared)
-                    self._ready.appendleft((prompt, max_new, temp, fut))
+                    self._ready.appendleft(
+                        (prompt, max_new, temp, top_k, top_p, fut))
                     break
                 pages = self.allocator.allocate(need)
                 self.owned_pages[slot] = pages
@@ -623,8 +631,13 @@ class ContinuousBatchingEngine:
                     self.params, self.cache, jnp.int32(slot), padded,
                     jnp.int32(plen))
             if temp > 0:
+                from skypilot_tpu.models.generate import sample_tokens
                 self._rng, sub = jax.random.split(self._rng)
-                first = jax.random.categorical(sub, last_logits / temp)
+                first = sample_tokens(
+                    sub, last_logits[None, :],
+                    jnp.full((1,), temp, jnp.float32),
+                    jnp.full((1,), top_k, jnp.int32),
+                    jnp.full((1,), top_p, jnp.float32))[0]
             else:
                 first = jnp.argmax(last_logits)
             self.cur_token[slot] = int(jax.device_get(first))
@@ -640,6 +653,8 @@ class ContinuousBatchingEngine:
                             self.page_size - self.spec_k)
             self.limits[slot] = limit
             self.temps[slot] = temp
+            self.top_ks[slot] = top_k
+            self.top_ps[slot] = top_p
             self.active[slot] = True
             admitted = True
         return admitted
@@ -690,7 +705,9 @@ class ContinuousBatchingEngine:
             if fut is not None:
                 preempted.append((list(self.outputs[slot]),
                                   max(remaining, 1),
-                                  float(self.temps[slot]), fut))
+                                  float(self.temps[slot]),
+                                  int(self.top_ks[slot]),
+                                  float(self.top_ps[slot]), fut))
         # Back to the HEAD preserving pass order (repeated appendleft
         # would reverse it — an FCFS fairness inversion).
         self._ready.extendleft(reversed(preempted))
@@ -756,7 +773,8 @@ class ContinuousBatchingEngine:
         self.cache, sampled = self._decode(
             self.params, self.cache,
             jnp.asarray(self.cur_token), jnp.asarray(self.pos),
-            jnp.asarray(self.temps), sub, *extra)
+            jnp.asarray(self.temps), jnp.asarray(self.top_ks),
+            jnp.asarray(self.top_ps), sub, *extra)
         sampled = np.asarray(jax.device_get(sampled))
         self.decode_calls += 1
         for slot in range(self.num_slots):
@@ -793,7 +811,9 @@ class ContinuousBatchingEngine:
         self._rng, sub = jax.random.split(self._rng)
         self.cache, y = self._decode(
             self.params, self.cache, jnp.asarray(chunk),
-            jnp.asarray(self.pos), jnp.asarray(self.temps), sub, *extra)
+            jnp.asarray(self.pos), jnp.asarray(self.temps),
+            jnp.asarray(self.top_ks), jnp.asarray(self.top_ps), sub,
+            *extra)
         y = np.asarray(jax.device_get(y))              # [slots, K+1]
         self.decode_calls += 1
         for slot in range(self.num_slots):

@@ -16,6 +16,50 @@ import jax
 import jax.numpy as jnp
 
 
+def filter_logits(logits: jax.Array, top_k: jax.Array,
+                  top_p: jax.Array) -> jax.Array:
+    """Per-row top-k / nucleus (top-p) filtering, fixed-shape.
+
+    logits: [..., V]; top_k int32 [...] (0 = off); top_p f32 [...]
+    (1.0 = off). Filtered entries become -inf. Standard caveats: ties
+    at the k-th logit all survive; the nucleus always keeps at least
+    the argmax."""
+    vocab = logits.shape[-1]
+    while top_k.ndim < logits.ndim - 1:
+        top_k = top_k[..., None]
+        top_p = top_p[..., None]
+    sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+    kth = jnp.take_along_axis(
+        sorted_desc, jnp.clip(top_k - 1, 0, vocab - 1)[..., None],
+        axis=-1)
+    keep_k = jnp.where((top_k > 0)[..., None], logits >= kth, True)
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # Nucleus: keep a sorted token while the cumulative mass BEFORE it
+    # is < p (the argmax always qualifies).
+    sorted_keep = (cum - probs) < top_p[..., None]
+    min_kept = jnp.min(jnp.where(sorted_keep, sorted_desc, jnp.inf),
+                       axis=-1, keepdims=True)
+    keep_p = jnp.where((top_p < 1.0)[..., None], logits >= min_kept,
+                       True)
+    return jnp.where(keep_k & keep_p, logits, -jnp.inf)
+
+
+def sample_tokens(rng: jax.Array, logits: jax.Array, temps: jax.Array,
+                  top_k: jax.Array, top_p: jax.Array) -> jax.Array:
+    """Per-row sampling: greedy where temps == 0, else categorical
+    over temperature-scaled, top-k/top-p-filtered logits. With
+    top_k=0 and top_p=1 this consumes the SAME rng stream as plain
+    categorical (no behavior change for existing callers)."""
+    while temps.ndim < logits.ndim - 1:
+        temps = temps[..., None]
+    filtered = filter_logits(logits, top_k, top_p)
+    scaled = filtered / jnp.maximum(temps, 1e-6)[..., None]
+    sampled = jax.random.categorical(rng, scaled, axis=-1)
+    greedy = jnp.argmax(logits, axis=-1)
+    return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+
+
 def make_generate_fn(model, max_total_len: int,
                      temperature: float = 0.0,
                      eos_id: Optional[int] = None):

@@ -1,7 +1,8 @@
 """In-framework LM inference server: the payload of serve replicas.
 
 A JetStream-shaped HTTP server: GET / (readiness), POST /generate
-{"tokens": [[...]], "max_new_tokens": N, "temperature": t} →
+{"tokens": [[...]], "max_new_tokens": N, "temperature": t,
+ "top_k": k, "top_p": p} →
 {"tokens": [[...]]}. Listens on SKYPILOT_SERVE_PORT (injected by the
 serve controller). Two engines:
 
@@ -263,6 +264,8 @@ def main() -> None:
                 req = json.loads(self.rfile.read(length))
                 tokens = req['tokens']
                 temperature = float(req.get('temperature', 0.0))
+                top_k = int(req.get('top_k', 0))
+                top_p = float(req.get('top_p', 1.0))
                 if engine is not None:
                     # Ragged rows welcome: each joins the shared decode
                     # loop independently, honoring its temperature.
@@ -275,7 +278,8 @@ def main() -> None:
                                 f'{engine_total}')
                     futs = [engine.submit([int(t) for t in row],
                                           max_new_tokens=max_new,
-                                          temperature=temperature)
+                                          temperature=temperature,
+                                          top_k=top_k, top_p=top_p)
                             for row in tokens]
                     self._json({'tokens':
                                 [f.result(timeout=600) for f in futs]})
@@ -316,6 +320,8 @@ def main() -> None:
                 if isinstance(prompts, str):
                     prompts = [prompts]
                 temperature = float(req.get('temperature', 0.0))
+                top_k = int(req.get('top_k', 0))
+                top_p = float(req.get('top_p', 1.0))
                 max_new = int(req.get('max_new_tokens', 64))
                 encoded = [tok(p)['input_ids'] for p in prompts]
                 limit = (engine_total if engine is not None else
@@ -329,7 +335,8 @@ def main() -> None:
                             f'max_total_len {limit}')
                 if engine is not None:
                     futs = [engine.submit(ids, max_new_tokens=max_new,
-                                          temperature=temperature)
+                                          temperature=temperature,
+                                          top_k=top_k, top_p=top_p)
                             for ids in encoded]
                     rows = [f.result(timeout=600) for f in futs]
                 else:

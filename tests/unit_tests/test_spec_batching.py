@@ -134,3 +134,64 @@ def test_spec_engine_page_pressure_preemption():
     for prompt, row in zip(_PROMPTS, rows):
         assert row[:len(prompt)] == prompt
         assert len(row) == len(prompt) + 20
+
+
+def test_filter_logits_topk_topp():
+    from skypilot_tpu.models.generate import filter_logits
+    logits = jnp.asarray([[1.0, 3.0, 2.0, -1.0]])
+    # top_k=2 keeps exactly the 2 largest.
+    out = filter_logits(logits, jnp.asarray([2]), jnp.asarray([1.0]))
+    assert np.isfinite(np.asarray(out[0, [1, 2]])).all()
+    assert np.isneginf(np.asarray(out[0, [0, 3]])).all()
+    # top_k=0 / top_p=1: untouched.
+    out = filter_logits(logits, jnp.asarray([0]), jnp.asarray([1.0]))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(logits))
+    # top_p tiny: only the argmax survives.
+    out = filter_logits(logits, jnp.asarray([0]), jnp.asarray([1e-6]))
+    assert np.isfinite(np.asarray(out[0, 1]))
+    assert np.isneginf(np.asarray(out)[0, [0, 2, 3]]).all()
+    # Per-row independence.
+    two = jnp.tile(logits, (2, 1))
+    out = filter_logits(two, jnp.asarray([1, 0]),
+                        jnp.asarray([1.0, 1.0]))
+    assert np.isneginf(np.asarray(out)[0, [0, 2, 3]]).all()
+    assert np.isfinite(np.asarray(out)[1]).all()
+
+
+def test_sample_tokens_default_matches_plain_categorical():
+    """top_k=0/top_p=1 consumes the identical rng stream as plain
+    categorical — the no-filter path is bit-compatible."""
+    from skypilot_tpu.models.generate import sample_tokens
+    rng = jax.random.PRNGKey(7)
+    logits = jax.random.normal(jax.random.PRNGKey(1), (4, 32))
+    temps = jnp.asarray([0.7, 1.3, 0.0, 1.0])
+    want = jax.random.categorical(rng, logits / temps[:, None]
+                                  .clip(1e-6), axis=-1)
+    got = sample_tokens(rng, logits, temps, jnp.zeros((4,), jnp.int32),
+                        jnp.ones((4,)))
+    # temp==0 row is greedy; others match categorical exactly.
+    np.testing.assert_array_equal(np.asarray(got[2]),
+                                  np.argmax(np.asarray(logits[2])))
+    np.testing.assert_array_equal(np.asarray(got)[[0, 1, 3]],
+                                  np.asarray(want)[[0, 1, 3]])
+
+
+@pytest.mark.slow
+def test_engine_topk1_equals_greedy():
+    """top_k=1 with temperature > 0 must reproduce the greedy rollout
+    (only the argmax survives the filter) — on the plain AND the
+    speculative engine."""
+    model, params = _build('llama')
+    for spec_k in (0, 3):
+        eng = ContinuousBatchingEngine(model, params, num_slots=2,
+                                       max_total_len=64,
+                                       speculative_k=spec_k)
+        try:
+            for p in ([5, 9, 2, 17], [30, 31, 32]):
+                greedy = eng.submit(p, max_new_tokens=8,
+                                    temperature=0.0).result(timeout=180)
+                k1 = eng.submit(p, max_new_tokens=8, temperature=0.9,
+                                top_k=1).result(timeout=180)
+                assert greedy == k1
+        finally:
+            eng.stop()
