@@ -198,6 +198,7 @@ class ContinuousBatchingEngine:
         self.temps = np.zeros((num_slots,), np.float32)
         self.top_ks = np.zeros((num_slots,), np.int32)   # 0 = off
         self.top_ps = np.ones((num_slots,), np.float32)  # 1 = off
+        self.stop_ids: List[frozenset] = [frozenset()] * num_slots
 
         # Observability: model calls vs tokens committed (speculation
         # quality = tokens_committed / decode_calls, 1.0..K+1).
@@ -460,11 +461,14 @@ class ContinuousBatchingEngine:
     def submit(self, prompt: List[int],
                max_new_tokens: int = 64,
                temperature: Optional[float] = None,
-               top_k: int = 0, top_p: float = 1.0) -> 'Future':
+               top_k: int = 0, top_p: float = 1.0,
+               stop_token_ids: Optional[List[int]] = None) -> 'Future':
         """Queue a request; the Future resolves to the full token list
         (prompt ++ generated). `temperature` overrides the engine
         default per request (0 = greedy); `top_k`/`top_p` filter the
-        sampled distribution (0 / 1.0 = off)."""
+        sampled distribution (0 / 1.0 = off); `stop_token_ids` end
+        THIS request on any listed token (in addition to the engine's
+        eos_id), with the stop token included in the output."""
         if len(prompt) >= self.max_total_len:
             raise ValueError(
                 f'prompt len {len(prompt)} >= max_total_len '
@@ -476,7 +480,8 @@ class ContinuousBatchingEngine:
         temp = self.temperature if temperature is None else temperature
         fut: Future = Future()
         self._queue.put((list(prompt), int(max_new_tokens),
-                         float(temp), int(top_k), float(top_p), fut))
+                         float(temp), int(top_k), float(top_p),
+                         frozenset(stop_token_ids or ()), fut))
         return fut
 
     def stop(self) -> None:
@@ -539,8 +544,8 @@ class ContinuousBatchingEngine:
             except queue.Empty:
                 break
         while self._ready and not self.active.all():
-            prompt, max_new, temp, top_k, top_p, fut = \
-                self._ready.popleft()
+            (prompt, max_new, temp, top_k, top_p, stops,
+             fut) = self._ready.popleft()
             if max_new <= 0:
                 fut.set_result(list(prompt))  # nothing to generate
                 continue
@@ -580,7 +585,8 @@ class ContinuousBatchingEngine:
                     if self.prefix_cache is not None:
                         self.prefix_cache.release(shared)
                     self._ready.appendleft(
-                        (prompt, max_new, temp, top_k, top_p, fut))
+                        (prompt, max_new, temp, top_k, top_p, stops,
+                         fut))
                     break
                 pages = self.allocator.allocate(need)
                 self.owned_pages[slot] = pages
@@ -655,6 +661,7 @@ class ContinuousBatchingEngine:
             self.temps[slot] = temp
             self.top_ks[slot] = top_k
             self.top_ps[slot] = top_p
+            self.stop_ids[slot] = stops
             self.active[slot] = True
             admitted = True
         return admitted
@@ -707,7 +714,8 @@ class ContinuousBatchingEngine:
                                   max(remaining, 1),
                                   float(self.temps[slot]),
                                   int(self.top_ks[slot]),
-                                  float(self.top_ps[slot]), fut))
+                                  float(self.top_ps[slot]),
+                                  self.stop_ids[slot], fut))
         # Back to the HEAD preserving pass order (repeated appendleft
         # would reverse it — an FCFS fairness inversion).
         self._ready.extendleft(reversed(preempted))
@@ -788,6 +796,8 @@ class ContinuousBatchingEngine:
             done = len(self.outputs[slot]) >= int(self.limits[slot])
             if self.eos_id is not None and tok == self.eos_id:
                 done = True
+            if tok in self.stop_ids[slot]:
+                done = True
             if done:
                 self._finish_slot(slot)
 
@@ -836,6 +846,8 @@ class ContinuousBatchingEngine:
                 self.cur_token[slot] = int(nxt)
                 done = len(self.outputs[slot]) >= int(self.limits[slot])
                 if self.eos_id is not None and tok == self.eos_id:
+                    done = True
+                if tok in self.stop_ids[slot]:
                     done = True
                 if done:
                     self._finish_slot(slot)

@@ -266,6 +266,8 @@ def main() -> None:
                 temperature = float(req.get('temperature', 0.0))
                 top_k = int(req.get('top_k', 0))
                 top_p = float(req.get('top_p', 1.0))
+                stop_ids = [int(t) for t in
+                            req.get('stop_token_ids', [])]
                 if engine is not None:
                     # Ragged rows welcome: each joins the shared decode
                     # loop independently, honoring its temperature.
@@ -279,7 +281,8 @@ def main() -> None:
                     futs = [engine.submit([int(t) for t in row],
                                           max_new_tokens=max_new,
                                           temperature=temperature,
-                                          top_k=top_k, top_p=top_p)
+                                          top_k=top_k, top_p=top_p,
+                                          stop_token_ids=stop_ids)
                             for row in tokens]
                     self._json({'tokens':
                                 [f.result(timeout=600) for f in futs]})

@@ -195,3 +195,30 @@ def test_engine_topk1_equals_greedy():
                 assert greedy == k1
         finally:
             eng.stop()
+
+
+@pytest.mark.slow
+def test_per_request_stop_tokens():
+    """A request ends on any of ITS stop tokens (stop included in the
+    output), independent of other slots — plain and speculative."""
+    model, params = _build('llama')
+    for spec_k in (0, 3):
+        eng = ContinuousBatchingEngine(model, params, num_slots=2,
+                                       max_total_len=64,
+                                       speculative_k=spec_k)
+        try:
+            p = [5, 9, 2, 17]
+            full = eng.submit(p, max_new_tokens=10).result(timeout=180)
+            generated = full[len(p):]
+            assert len(generated) == 10
+            stop = generated[3]  # stop at the 4th generated token
+            stopped = eng.submit(p, max_new_tokens=10,
+                                 stop_token_ids=[stop]).result(
+                timeout=180)
+            idx = generated.index(stop)
+            assert stopped == p + generated[:idx + 1]
+            # A concurrent request WITHOUT the stop id runs to limit.
+            again = eng.submit(p, max_new_tokens=10).result(timeout=180)
+            assert again == full
+        finally:
+            eng.stop()
