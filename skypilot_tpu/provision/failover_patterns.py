@@ -247,10 +247,49 @@ AZURE_PATTERNS: Tuple[ErrorPattern, ...] = (
                  _P.TRANSIENT, ZONE),
 )
 
+# ---------------------------------------------------------------------------
+# Kubernetes: API error bodies + pod/scheduler condition messages.
+# A k8s "zone" is the cluster's node pool (zones_provision_loop yields
+# None); capacity blocks let the caller fail over to another context
+# or cloud. Reference: the k8s paths of FailoverCloudErrorHandlerV2.
+K8S_PATTERNS: Tuple[ErrorPattern, ...] = (
+    # -- capacity / scheduling.
+    ErrorPattern(r'Unschedulable|FailedScheduling', _P.CAPACITY, ZONE),
+    ErrorPattern(r'Insufficient (cpu|memory|ephemeral-storage|'
+                 r'[\w./-]*tpu[\w./-]*|nvidia\.com/gpu)',
+                 _P.CAPACITY, ZONE),
+    ErrorPattern(r'No nodes are available|nodes? didn.t match',
+                 _P.CAPACITY, ZONE),
+    ErrorPattern(r'Preempting|preempted|Evicted', _P.CAPACITY, ZONE),
+    # -- quota.
+    ErrorPattern(r'exceeded quota|ResourceQuota', _P.QUOTA, REGION),
+    ErrorPattern(r'LimitRange|maximum.{0,40}limit', _P.QUOTA, REGION),
+    # -- permission (cluster-scoped: another context/cloud may work).
+    ErrorPattern(r'Forbidden|forbidden', _P.PERMISSION, CLOUD),
+    ErrorPattern(r'Unauthorized|cannot (create|get|list|delete) '
+                 r'resource|RBAC', _P.PERMISSION, CLOUD),
+    # -- config.
+    ErrorPattern(r'InvalidImageName|invalid reference format',
+                 _P.CONFIG, ABORT),
+    ErrorPattern(r'admission webhook.{0,80}denied', _P.CONFIG, CLOUD),
+    ErrorPattern(r'Invalid value|unknown field|BadRequest|'
+                 r'is invalid', _P.CONFIG, ABORT),
+    # -- transient.
+    ErrorPattern(r'ImagePullBackOff|ErrImagePull', _P.TRANSIENT, ZONE,
+                 'registry hiccup (a WRONG image matches the config '
+                 'rows above)'),
+    ErrorPattern(r'TooManyRequests|etcdserver|leader changed',
+                 _P.TRANSIENT, ZONE),
+    ErrorPattern(r'timeout|timed out|connection refused|'
+                 r'ServiceUnavailable|InternalError',
+                 _P.TRANSIENT, ZONE),
+)
+
 _TABLES = {
     'gcp': GCP_PATTERNS,
     'aws': AWS_PATTERNS,
     'azure': AZURE_PATTERNS,
+    'kubernetes': K8S_PATTERNS,
 }
 
 

@@ -44,9 +44,15 @@ def _request(ctx: kubeconfig.KubeContext, method: str, path: str,
         raise exceptions.FetchClusterInfoError(
             exceptions.FetchClusterInfoError.Reason.HEAD)
     if resp.status_code >= 400:
+        from skypilot_tpu.provision import failover_patterns
+        pat = failover_patterns.classify('kubernetes',
+                                         str(resp.status_code),
+                                         resp.text)
+        kwargs = ({'category': pat.category, 'scope': pat.scope}
+                  if pat is not None else {})
         raise exceptions.ProvisionerError(
             f'k8s API {method} {path} -> {resp.status_code}: '
-            f'{resp.text[:500]}')
+            f'{resp.text[:500]}', **kwargs)
     return resp.json() if resp.text else {}
 
 
@@ -237,10 +243,34 @@ def wait_instances(region: str, cluster_name_on_cloud: str,
         if any(ph == 'Failed' for ph in phases):
             raise exceptions.ProvisionerError(
                 f'Pod(s) failed for {cluster_name_on_cloud}: {phases}')
+        # Unschedulable pods (stockout / no fitting node) fail over
+        # instead of burning the whole provision timeout: classify the
+        # scheduler's condition message through the pattern table.
+        messages = '; '.join(
+            f"{c.get('reason', '')}: {c.get('message', '')}"
+            for p in pods
+            for c in p.get('status', {}).get('conditions', []) or []
+            if c.get('reason'))
+        if 'Unschedulable' in messages and \
+                time.time() > deadline - constants.\
+                PROVISION_TIMEOUT_SECONDS + 60:
+            from skypilot_tpu.provision import failover_patterns
+            pat = failover_patterns.classify('kubernetes', '',
+                                             messages)
+            raise exceptions.ProvisionerError(
+                f'Pod(s) unschedulable for {cluster_name_on_cloud}: '
+                f'{messages[:400]}',
+                category=(pat.category if pat else
+                          exceptions.ProvisionerError.CAPACITY),
+                scope=pat.scope if pat else None)
         if time.time() > deadline:
+            from skypilot_tpu.provision import failover_patterns
+            pat = failover_patterns.classify('kubernetes', '', messages)
+            kwargs = ({'category': pat.category, 'scope': pat.scope}
+                      if pat is not None else {})
             raise exceptions.ProvisionerError(
                 f'Timed out waiting for pods of {cluster_name_on_cloud} '
-                f'({phases}).')
+                f'({phases}; {messages[:300]}).', **kwargs)
         time.sleep(5)
 
 

@@ -224,3 +224,35 @@ def test_quota_body_with_resource_exhausted_status_region_blocks():
     # The bare status with no quota text stays capacity/zone.
     pat = fp.classify('gcp', '429', 'RESOURCE_EXHAUSTED')
     assert (pat.category, pat.scope) == (P.CAPACITY, fp.ZONE)
+
+
+K8S_CASES = [
+    ('', '0/12 nodes are available: 12 Insufficient google.com/tpu. '
+     'Unschedulable', P.CAPACITY, fp.ZONE),
+    ('', 'FailedScheduling: No nodes are available', P.CAPACITY,
+     fp.ZONE),
+    ('', 'Pod was Evicted', P.CAPACITY, fp.ZONE),
+    ('403', 'pods is forbidden: User cannot create resource',
+     P.PERMISSION, fp.CLOUD),
+    ('401', 'Unauthorized', P.PERMISSION, fp.CLOUD),
+    ('403', 'exceeded quota: team-quota, requested: requests.cpu=64',
+     P.QUOTA, fp.REGION),
+    ('422', "Pod 'x' is invalid: spec.containers[0].image: "
+     'Invalid value', P.CONFIG, fp.ABORT),
+    ('400', 'admission webhook "policy.example.com" denied the request',
+     P.CONFIG, fp.CLOUD),
+    ('', 'Back-off pulling image: ImagePullBackOff', P.TRANSIENT,
+     fp.ZONE),
+    ('', 'InvalidImageName: invalid reference format', P.CONFIG,
+     fp.ABORT),
+    ('429', 'TooManyRequests: rate limited', P.TRANSIENT, fp.ZONE),
+    ('500', 'etcdserver: request timed out', P.TRANSIENT, fp.ZONE),
+]
+
+
+@pytest.mark.parametrize('code,message,category,scope', K8S_CASES,
+                         ids=[f'k8s-{i}' for i in range(len(K8S_CASES))])
+def test_k8s_pattern_classification(code, message, category, scope):
+    pat = fp.classify('kubernetes', code, message)
+    assert pat is not None
+    assert (pat.category, pat.scope) == (category, scope)
