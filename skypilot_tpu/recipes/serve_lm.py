@@ -240,6 +240,9 @@ def main() -> None:
             self.wfile.write(body)
 
         def do_GET(self):  # noqa: N802
+            if self.path in ('/stats', '/v1/stats'):
+                self._stats()
+                return
             # Advertise the MINIMUM capacity across request classes
             # (greedy requests run through the speculative engine at
             # spec_total; sampled ones at max_total_len) — clients
@@ -250,6 +253,41 @@ def main() -> None:
                         'vocab_size': vocab_size,
                         'max_total_len': spec_total
                         if args.speculative > 0 else args.max_total_len})
+
+        def _stats(self):
+            """Engine observability (the vLLM /metrics idea, JSON):
+            slot occupancy, page pool, prefix-cache hit rate, and
+            speculation quality (tokens committed per model call)."""
+            if engine is None:
+                self._json({'engine': 'simple'})
+                return
+            body = {
+                'engine': 'continuous',
+                'num_slots': engine.num_slots,
+                'active_slots': int(engine.active.sum()),
+                'queued': engine._queue.qsize() + len(engine._ready),
+                'decode_calls': engine.decode_calls,
+                'tokens_committed': engine.tokens_committed,
+                'tokens_per_call': round(
+                    engine.tokens_committed /
+                    max(engine.decode_calls, 1), 3),
+                'speculative_k': engine.spec_k,
+            }
+            if engine.paged:
+                body['page_pool'] = {
+                    'total': engine.total_pages,
+                    'free': engine.allocator.free_pages,
+                }
+                if engine.prefix_cache is not None:
+                    pc = engine.prefix_cache
+                    body['prefix_cache'] = {
+                        'hits': pc.hits,
+                        'misses': pc.misses,
+                        'hit_rate': round(
+                            pc.hits / max(pc.hits + pc.misses, 1), 3),
+                        'resident_unreferenced': len(pc.lru),
+                    }
+            self._json(body)
 
         def do_POST(self):  # noqa: N802
             if self.path in ('/generate_text', '/v1/generate_text'):
@@ -325,6 +363,9 @@ def main() -> None:
                 temperature = float(req.get('temperature', 0.0))
                 top_k = int(req.get('top_k', 0))
                 top_p = float(req.get('top_p', 1.0))
+                stop_strings = req.get('stop') or []
+                if isinstance(stop_strings, str):
+                    stop_strings = [stop_strings]
                 max_new = int(req.get('max_new_tokens', 64))
                 encoded = [tok(p)['input_ids'] for p in prompts]
                 limit = (engine_total if engine is not None else
@@ -365,6 +406,18 @@ def main() -> None:
                 texts = [tok.decode(row[len(ids):],
                                     skip_special_tokens=True)
                          for ids, row in zip(encoded, rows)]
+                if stop_strings:
+                    # Trim each completion at the FIRST occurrence of
+                    # any stop string (the string itself excluded —
+                    # the OpenAI-style `stop` contract).
+                    def trim(text):
+                        cut = len(text)
+                        for ss in stop_strings:
+                            i = text.find(ss)
+                            if i != -1:
+                                cut = min(cut, i)
+                        return text[:cut]
+                    texts = [trim(t) for t in texts]
                 self._json({'texts': texts})
             except Exception as e:  # pylint: disable=broad-except
                 self._json({'error': f'{type(e).__name__}: {e}'}, 400)

@@ -177,9 +177,12 @@ class QueueLengthAutoscaler(Autoscaler):
     """
 
     def __init__(self, spec: 'spec_lib.SkyServiceSpec',
-                 target_queue_per_replica: float = 4.0) -> None:
+                 target_queue_per_replica: Optional[float] = None) -> None:
         super().__init__(spec)
-        self.target_queue_per_replica = target_queue_per_replica
+        self.target_queue_per_replica = (
+            target_queue_per_replica if target_queue_per_replica
+            is not None else getattr(spec, 'target_queue_per_replica',
+                                     4.0))
         self._in_flight = 0
         self._upscale_since: Optional[float] = None
         self._downscale_since: Optional[float] = None

@@ -29,13 +29,16 @@ class SkyServiceSpec:
                  load_balancing_policy: str = 'round_robin',
                  autoscaler: str = 'request_rate',
                  base_ondemand_fallback_replicas: int = 0,
-                 dynamic_ondemand_fallback: bool = False) -> None:
+                 dynamic_ondemand_fallback: bool = False,
+                 target_queue_per_replica: float = 4.0) -> None:
         self.autoscaler = autoscaler
         # Spot serving (reference: autoscalers.py:933 fallback logic):
         # keep N always-on-demand replicas, and optionally back-fill
         # preempted spot capacity with on-demand until spot recovers.
         self.base_ondemand_fallback_replicas = base_ondemand_fallback_replicas
         self.dynamic_ondemand_fallback = dynamic_ondemand_fallback
+        # queue_length autoscaler target (in-flight requests/replica).
+        self.target_queue_per_replica = float(target_queue_per_replica)
         if not readiness_path.startswith('/'):
             raise exceptions.InvalidTaskYAMLError(
                 f'readiness path must start with /: {readiness_path!r}')
@@ -106,6 +109,9 @@ class SkyServiceSpec:
                 kwargs['target_qps_per_replica'] = (
                     {str(k): float(v) for k, v in raw.items()}
                     if isinstance(raw, dict) else float(raw))
+            if 'target_queue_per_replica' in policy:
+                kwargs['target_queue_per_replica'] = float(
+                    policy.pop('target_queue_per_replica'))
             for key in ('upscale_delay_seconds', 'downscale_delay_seconds',
                         'base_ondemand_fallback_replicas'):
                 if key in policy:

@@ -75,6 +75,8 @@ _SERVICE_SCHEMA: Dict[str, Any] = {
                 'base_ondemand_fallback_replicas': {'type': 'integer',
                                                     'minimum': 0},
                 'dynamic_ondemand_fallback': {'type': 'boolean'},
+                'target_queue_per_replica': {'type': 'number',
+                                             'exclusiveMinimum': 0},
             },
             'additionalProperties': False,
         },

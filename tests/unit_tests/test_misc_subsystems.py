@@ -716,3 +716,20 @@ def test_log_aggregator_selection_and_config(isolated_state, monkeypatch,
 
     cfg.write_text('logs: {}\n')
     assert logs_lib.get_aggregator() is None
+
+
+def test_queue_autoscaler_target_from_spec():
+    """target_queue_per_replica flows YAML -> spec -> autoscaler."""
+    from skypilot_tpu.serve.autoscalers import QueueLengthAutoscaler
+    from skypilot_tpu.serve.service_spec import SkyServiceSpec
+    spec = SkyServiceSpec.from_yaml_config({
+        'readiness_probe': '/',
+        'autoscaler': 'queue_length',
+        'replica_policy': {'min_replicas': 1, 'max_replicas': 5,
+                           'target_qps_per_replica': 1,
+                           'target_queue_per_replica': 9}})
+    a = QueueLengthAutoscaler(spec)
+    assert a.target_queue_per_replica == 9.0
+    # Explicit constructor arg still overrides.
+    assert QueueLengthAutoscaler(
+        spec, target_queue_per_replica=2).target_queue_per_replica == 2
