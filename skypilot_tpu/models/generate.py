@@ -53,7 +53,14 @@ def sample_tokens(rng: jax.Array, logits: jax.Array, temps: jax.Array,
     categorical (no behavior change for existing callers)."""
     while temps.ndim < logits.ndim - 1:
         temps = temps[..., None]
-    filtered = filter_logits(logits, top_k, top_p)
+    # The filter costs a vocab sort per step: cond skips it at runtime
+    # whenever NO live slot uses top-k/top-p (the common case), so the
+    # unfiltered path stays as fast as plain categorical.
+    need_filter = jnp.logical_or(jnp.any(top_k > 0),
+                                 jnp.any(top_p < 1.0))
+    filtered = jax.lax.cond(
+        need_filter, lambda: filter_logits(logits, top_k, top_p),
+        lambda: logits)
     scaled = filtered / jnp.maximum(temps, 1e-6)[..., None]
     sampled = jax.random.categorical(rng, scaled, axis=-1)
     greedy = jnp.argmax(logits, axis=-1)
