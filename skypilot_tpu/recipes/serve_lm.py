@@ -290,12 +290,15 @@ def main() -> None:
             self._json(body)
 
         def do_POST(self):  # noqa: N802
+            if self.path == '/v1/completions':
+                self._openai_completions()
+                return
             if self.path in ('/generate_text', '/v1/generate_text'):
                 self._generate_text()
                 return
             if self.path not in ('/generate', '/v1/generate'):
-                self._json({'error': 'POST /generate or '
-                                     'POST /generate_text'}, 404)
+                self._json({'error': 'POST /generate, /generate_text, '
+                                     'or /v1/completions'}, 404)
                 return
             try:
                 length = int(self.headers.get('Content-Length', 0))
@@ -346,6 +349,93 @@ def main() -> None:
                 self._json({'tokens': jax.device_get(out).tolist()})
             except Exception as e:  # pylint: disable=broad-except
                 self._json({'error': f'{type(e).__name__}: {e}'}, 400)
+
+        def _openai_completions(self):
+            """OpenAI-compatible completions shim: the de-facto
+            client contract (the reference's llm/ recipes serve vLLM,
+            whose clients speak this). Maps prompt/max_tokens/
+            temperature/top_p/stop onto the engine and returns the
+            OpenAI response shape (choices/usage). Requires tokenizer
+            files (--hf with a full checkpoint repo)."""
+            try:
+                tok = get_tokenizer()
+                length = int(self.headers.get('Content-Length', 0))
+                req = json.loads(self.rfile.read(length))
+                prompts = req.get('prompt', '')
+                if isinstance(prompts, str):
+                    prompts = [prompts]
+                if int(req.get('n', 1)) != 1:
+                    raise ValueError('n > 1 is not supported')
+                if req.get('stream'):
+                    raise ValueError('stream=true is not supported')
+                max_new = int(req.get('max_tokens', 16))
+                temperature = float(req.get('temperature', 1.0))
+                top_p = float(req.get('top_p', 1.0))
+                stop_strings = req.get('stop') or []
+                if isinstance(stop_strings, str):
+                    stop_strings = [stop_strings]
+                encoded = [tok(p)['input_ids'] for p in prompts]
+                limit = (engine_total if engine is not None
+                         else args.max_total_len)
+                for ids in encoded:
+                    if len(ids) >= limit:
+                        raise ValueError(
+                            f'prompt tokenizes to {len(ids)} >= '
+                            f'max_total_len {limit}')
+                rows = []
+                if engine is not None:
+                    futs = [engine.submit(ids, max_new_tokens=max_new,
+                                          temperature=temperature,
+                                          top_p=top_p)
+                            for ids in encoded]
+                    rows = [f.result(timeout=600) for f in futs]
+                else:
+                    for ids in encoded:
+                        want = len(ids) + max_new
+                        bucket = 8
+                        while bucket < want:
+                            bucket *= 2
+                        bucket = min(bucket, limit)
+                        fn = get_fn(1, temperature, bucket)
+                        with lock:
+                            rng_holder['rng'], sub = jax.random.split(
+                                rng_holder['rng'])
+                        out = fn(params,
+                                 jnp.asarray([ids], jnp.int32), sub)
+                        rows.append(jax.device_get(out)[0]
+                                    [:min(want, bucket)].tolist())
+                choices = []
+                total_completion = 0
+                for i, (ids, row) in enumerate(zip(encoded, rows)):
+                    text = tok.decode(row[len(ids):],
+                                      skip_special_tokens=True)
+                    finish = ('length' if len(row) - len(ids) >= max_new
+                              else 'stop')
+                    for ss in stop_strings:
+                        cut = text.find(ss)
+                        if cut != -1:
+                            text = text[:cut]
+                            finish = 'stop'
+                    total_completion += len(row) - len(ids)
+                    choices.append({'index': i, 'text': text,
+                                    'finish_reason': finish,
+                                    'logprobs': None})
+                total_prompt = sum(len(ids) for ids in encoded)
+                self._json({
+                    'object': 'text_completion',
+                    'model': (f'hf:{os.path.basename(args.hf)}'
+                              if args.hf else args.model),
+                    'choices': choices,
+                    'usage': {
+                        'prompt_tokens': total_prompt,
+                        'completion_tokens': total_completion,
+                        'total_tokens': total_prompt + total_completion,
+                    },
+                })
+            except Exception as e:  # pylint: disable=broad-except
+                self._json({'error': {
+                    'message': f'{type(e).__name__}: {e}',
+                    'type': 'invalid_request_error'}}, 400)
 
         def _generate_text(self):
             """Text in / text out, via the --hf checkpoint's tokenizer:

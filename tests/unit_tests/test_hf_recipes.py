@@ -96,6 +96,32 @@ def test_serve_lm_hf_checkpoint(hf_ckpt):
                     {'prompts': ['hello world the tpu'],
                      'max_new_tokens': 4})
         assert isinstance(out['texts'][0], str), out
+
+        # OpenAI-compatible completions shim (the contract vLLM
+        # clients speak): choices/usage shape, greedy determinism,
+        # stop strings, and proper 400s on unsupported options.
+        body = {'prompt': 'hello world the tpu', 'max_tokens': 4,
+                'temperature': 0}
+        out = _post(f'http://127.0.0.1:{port}/v1/completions', body)
+        assert out['object'] == 'text_completion'
+        choice = out['choices'][0]
+        assert choice['finish_reason'] == 'length'
+        assert out['usage']['prompt_tokens'] == 4
+        assert out['usage']['completion_tokens'] == 4
+        again = _post(f'http://127.0.0.1:{port}/v1/completions', body)
+        assert again['choices'][0]['text'] == choice['text']
+        words = choice['text'].split()
+        if len(words) > 1:
+            stopped = _post(f'http://127.0.0.1:{port}/v1/completions',
+                            {**body, 'stop': [words[1]]})
+            assert words[1] not in stopped['choices'][0]['text']
+        from urllib.error import HTTPError
+        try:
+            _post(f'http://127.0.0.1:{port}/v1/completions',
+                  {**body, 'stream': True})
+            raise AssertionError('stream=true must 400')
+        except HTTPError as e:
+            assert e.code == 400
     finally:
         proc.terminate()
         proc.wait(timeout=10)
