@@ -293,6 +293,9 @@ def main() -> None:
             if self.path == '/v1/completions':
                 self._openai_completions()
                 return
+            if self.path == '/v1/chat/completions':
+                self._openai_chat()
+                return
             if self.path in ('/generate_text', '/v1/generate_text'):
                 self._generate_text()
                 return
@@ -350,6 +353,115 @@ def main() -> None:
             except Exception as e:  # pylint: disable=broad-except
                 self._json({'error': f'{type(e).__name__}: {e}'}, 400)
 
+        def _openai_chat(self):
+            """OpenAI chat completions: renders `messages` through the
+            tokenizer's chat template when the checkpoint ships one,
+            else a plain `role: content` fallback template, then runs
+            the completions path and wraps the answer as an assistant
+            message."""
+            try:
+                tok = get_tokenizer()
+                length = int(self.headers.get('Content-Length', 0))
+                req = json.loads(self.rfile.read(length))
+                messages = req['messages']
+                try:
+                    prompt = tok.apply_chat_template(
+                        messages, tokenize=False,
+                        add_generation_prompt=True)
+                except Exception:  # pylint: disable=broad-except
+                    # No template in the checkpoint: a transparent
+                    # fallback beats a 400 for base models.
+                    prompt = '\n'.join(
+                        f"{m['role']}: {m['content']}"
+                        for m in messages) + '\nassistant:'
+                out = self._complete(
+                    prompts=[prompt],
+                    max_new=int(req.get('max_tokens', 16)),
+                    temperature=float(req.get('temperature', 1.0)),
+                    top_p=float(req.get('top_p', 1.0)),
+                    stop_strings=req.get('stop') or [],
+                    n=int(req.get('n', 1)),
+                    stream=bool(req.get('stream')))
+                out['object'] = 'chat.completion'
+                for c in out['choices']:
+                    c['message'] = {'role': 'assistant',
+                                    'content': c.pop('text')}
+                self._json(out)
+            except Exception as e:  # pylint: disable=broad-except
+                self._json({'error': {
+                    'message': f'{type(e).__name__}: {e}',
+                    'type': 'invalid_request_error'}}, 400)
+
+        def _complete(self, prompts, max_new, temperature, top_p,
+                      stop_strings, n, stream):
+            """Shared body of the OpenAI shims: run the prompts,
+            return the completions-shaped response dict."""
+            tok = get_tokenizer()
+            if n != 1:
+                raise ValueError('n > 1 is not supported')
+            if stream:
+                raise ValueError('stream=true is not supported')
+            if isinstance(stop_strings, str):
+                stop_strings = [stop_strings]
+            encoded = [tok(p)['input_ids'] for p in prompts]
+            limit = (engine_total if engine is not None
+                     else args.max_total_len)
+            for ids in encoded:
+                if len(ids) >= limit:
+                    raise ValueError(
+                        f'prompt tokenizes to {len(ids)} >= '
+                        f'max_total_len {limit}')
+            rows = []
+            if engine is not None:
+                futs = [engine.submit(ids, max_new_tokens=max_new,
+                                      temperature=temperature,
+                                      top_p=top_p)
+                        for ids in encoded]
+                rows = [f.result(timeout=600) for f in futs]
+            else:
+                for ids in encoded:
+                    want = len(ids) + max_new
+                    bucket = 8
+                    while bucket < want:
+                        bucket *= 2
+                    bucket = min(bucket, limit)
+                    fn = get_fn(1, temperature, bucket)
+                    with lock:
+                        rng_holder['rng'], sub = jax.random.split(
+                            rng_holder['rng'])
+                    out = fn(params,
+                             jnp.asarray([ids], jnp.int32), sub)
+                    rows.append(jax.device_get(out)[0]
+                                [:min(want, bucket)].tolist())
+            choices = []
+            total_completion = 0
+            for i, (ids, row) in enumerate(zip(encoded, rows)):
+                text = tok.decode(row[len(ids):],
+                                  skip_special_tokens=True)
+                finish = ('length' if len(row) - len(ids) >= max_new
+                          else 'stop')
+                for ss in stop_strings:
+                    cut = text.find(ss)
+                    if cut != -1:
+                        text = text[:cut]
+                        finish = 'stop'
+                total_completion += len(row) - len(ids)
+                choices.append({'index': i, 'text': text,
+                                'finish_reason': finish,
+                                'logprobs': None})
+            total_prompt = sum(len(ids) for ids in encoded)
+            return {
+                'object': 'text_completion',
+                'model': (f'hf:{os.path.basename(args.hf)}'
+                          if args.hf else args.model),
+                'choices': choices,
+                'usage': {
+                    'prompt_tokens': total_prompt,
+                    'completion_tokens': total_completion,
+                    'total_tokens': total_prompt + total_completion,
+                },
+            }
+
         def _openai_completions(self):
             """OpenAI-compatible completions shim: the de-facto
             client contract (the reference's llm/ recipes serve vLLM,
@@ -358,80 +470,19 @@ def main() -> None:
             OpenAI response shape (choices/usage). Requires tokenizer
             files (--hf with a full checkpoint repo)."""
             try:
-                tok = get_tokenizer()
                 length = int(self.headers.get('Content-Length', 0))
                 req = json.loads(self.rfile.read(length))
                 prompts = req.get('prompt', '')
                 if isinstance(prompts, str):
                     prompts = [prompts]
-                if int(req.get('n', 1)) != 1:
-                    raise ValueError('n > 1 is not supported')
-                if req.get('stream'):
-                    raise ValueError('stream=true is not supported')
-                max_new = int(req.get('max_tokens', 16))
-                temperature = float(req.get('temperature', 1.0))
-                top_p = float(req.get('top_p', 1.0))
-                stop_strings = req.get('stop') or []
-                if isinstance(stop_strings, str):
-                    stop_strings = [stop_strings]
-                encoded = [tok(p)['input_ids'] for p in prompts]
-                limit = (engine_total if engine is not None
-                         else args.max_total_len)
-                for ids in encoded:
-                    if len(ids) >= limit:
-                        raise ValueError(
-                            f'prompt tokenizes to {len(ids)} >= '
-                            f'max_total_len {limit}')
-                rows = []
-                if engine is not None:
-                    futs = [engine.submit(ids, max_new_tokens=max_new,
-                                          temperature=temperature,
-                                          top_p=top_p)
-                            for ids in encoded]
-                    rows = [f.result(timeout=600) for f in futs]
-                else:
-                    for ids in encoded:
-                        want = len(ids) + max_new
-                        bucket = 8
-                        while bucket < want:
-                            bucket *= 2
-                        bucket = min(bucket, limit)
-                        fn = get_fn(1, temperature, bucket)
-                        with lock:
-                            rng_holder['rng'], sub = jax.random.split(
-                                rng_holder['rng'])
-                        out = fn(params,
-                                 jnp.asarray([ids], jnp.int32), sub)
-                        rows.append(jax.device_get(out)[0]
-                                    [:min(want, bucket)].tolist())
-                choices = []
-                total_completion = 0
-                for i, (ids, row) in enumerate(zip(encoded, rows)):
-                    text = tok.decode(row[len(ids):],
-                                      skip_special_tokens=True)
-                    finish = ('length' if len(row) - len(ids) >= max_new
-                              else 'stop')
-                    for ss in stop_strings:
-                        cut = text.find(ss)
-                        if cut != -1:
-                            text = text[:cut]
-                            finish = 'stop'
-                    total_completion += len(row) - len(ids)
-                    choices.append({'index': i, 'text': text,
-                                    'finish_reason': finish,
-                                    'logprobs': None})
-                total_prompt = sum(len(ids) for ids in encoded)
-                self._json({
-                    'object': 'text_completion',
-                    'model': (f'hf:{os.path.basename(args.hf)}'
-                              if args.hf else args.model),
-                    'choices': choices,
-                    'usage': {
-                        'prompt_tokens': total_prompt,
-                        'completion_tokens': total_completion,
-                        'total_tokens': total_prompt + total_completion,
-                    },
-                })
+                self._json(self._complete(
+                    prompts=prompts,
+                    max_new=int(req.get('max_tokens', 16)),
+                    temperature=float(req.get('temperature', 1.0)),
+                    top_p=float(req.get('top_p', 1.0)),
+                    stop_strings=req.get('stop') or [],
+                    n=int(req.get('n', 1)),
+                    stream=bool(req.get('stream'))))
             except Exception as e:  # pylint: disable=broad-except
                 self._json({'error': {
                     'message': f'{type(e).__name__}: {e}',

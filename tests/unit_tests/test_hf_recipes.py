@@ -122,6 +122,20 @@ def test_serve_lm_hf_checkpoint(hf_ckpt):
             raise AssertionError('stream=true must 400')
         except HTTPError as e:
             assert e.code == 400
+
+        # Chat shim: messages render through the chat template (plain
+        # role fallback for template-less checkpoints like this one)
+        # and the answer comes back as an assistant message.
+        out = _post(f'http://127.0.0.1:{port}/v1/chat/completions',
+                    {'messages': [
+                        {'role': 'system', 'content': 'hello world'},
+                        {'role': 'user', 'content': 'the tpu'}],
+                     'max_tokens': 4, 'temperature': 0})
+        assert out['object'] == 'chat.completion'
+        msg = out['choices'][0]['message']
+        assert msg['role'] == 'assistant'
+        assert isinstance(msg['content'], str)
+        assert out['usage']['completion_tokens'] == 4
     finally:
         proc.terminate()
         proc.wait(timeout=10)
