@@ -225,6 +225,10 @@ def main() -> None:
             return fns[key]
 
     rng_holder = {'rng': jax.random.PRNGKey(0)}
+    # Live POSTs (graceful drain waits on this, covering the window
+    # between accept and engine submit and the one-shot engine).
+    _inflight = {'n': 0}
+    _inflight_lock = threading.Lock()
 
     class Handler(BaseHTTPRequestHandler):
 
@@ -290,6 +294,15 @@ def main() -> None:
             self._json(body)
 
         def do_POST(self):  # noqa: N802
+            with _inflight_lock:
+                _inflight['n'] += 1
+            try:
+                self._do_post()
+            finally:
+                with _inflight_lock:
+                    _inflight['n'] -= 1
+
+        def _do_post(self):
             if self.path == '/v1/completions':
                 self._openai_completions()
                 return
@@ -564,6 +577,52 @@ def main() -> None:
                 self._json({'error': f'{type(e).__name__}: {e}'}, 400)
 
     server = ThreadingHTTPServer(('0.0.0.0', args.port), Handler)
+
+    def _drain(signum, frame):  # noqa: ARG001
+        """Graceful drain on SIGTERM (rolling updates / replica
+        replacement): stop accepting, let in-flight requests finish
+        (bounded), then exit 0 — a mid-generation client must not see
+        a reset because the controller culled this replica."""
+        print('serve_lm: SIGTERM — draining in-flight requests',
+              flush=True)
+
+        def _stop():
+            server.shutdown()  # stops accepting; handlers keep running
+            # Accept stragglers already in the listen backlog (under
+            # GIL pressure the accept loop can lag the client's
+            # connect by hundreds of ms): each spawns a normal handler
+            # thread that the in-flight drain below waits for.
+            import select as select_lib
+            server.socket.setblocking(False)
+            backlog_end = time.time() + 1.0
+            while time.time() < backlog_end:
+                ready, _, _ = select_lib.select([server.socket], [], [],
+                                                0.1)
+                if not ready:
+                    continue
+                try:
+                    conn, addr = server.socket.accept()
+                except OSError:
+                    break
+                server.process_request(conn, addr)
+            # Drain = no in-flight HTTP requests (covers the window
+            # between accept and engine submit, and the one-shot
+            # engine), bounded.
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                with _inflight_lock:
+                    if _inflight['n'] == 0:
+                        break
+                time.sleep(0.2)
+            if engine is not None:
+                engine.stop()
+            os._exit(0)
+
+        threading.Thread(target=_stop, daemon=True).start()
+
+    import signal
+    import time
+    signal.signal(signal.SIGTERM, _drain)
     print(f'serve_lm listening on :{args.port} model={args.model}',
           flush=True)
     server.serve_forever()
