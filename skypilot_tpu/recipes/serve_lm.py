@@ -578,51 +578,36 @@ def main() -> None:
 
     server = ThreadingHTTPServer(('0.0.0.0', args.port), Handler)
 
-    def _drain(signum, frame):  # noqa: ARG001
+    _term = threading.Event()
+
+    def _drain_loop():
         """Graceful drain on SIGTERM (rolling updates / replica
-        replacement): stop accepting, let in-flight requests finish
-        (bounded), then exit 0 — a mid-generation client must not see
-        a reset because the controller culled this replica."""
+        replacement): let the accept loop pick up stragglers briefly,
+        stop accepting, wait for in-flight POSTs (bounded), exit 0 —
+        a mid-generation client must not see a reset because the
+        controller culled this replica. All work happens on this
+        pre-started thread; the signal handler only sets an event
+        (anything heavier in the signal frame proved crash-prone
+        against the XLA runtime's own thread machinery)."""
+        _term.wait()
         print('serve_lm: SIGTERM — draining in-flight requests',
               flush=True)
-
-        def _stop():
-            server.shutdown()  # stops accepting; handlers keep running
-            # Accept stragglers already in the listen backlog (under
-            # GIL pressure the accept loop can lag the client's
-            # connect by hundreds of ms): each spawns a normal handler
-            # thread that the in-flight drain below waits for.
-            import select as select_lib
-            server.socket.setblocking(False)
-            backlog_end = time.time() + 1.0
-            while time.time() < backlog_end:
-                ready, _, _ = select_lib.select([server.socket], [], [],
-                                                0.1)
-                if not ready:
-                    continue
-                try:
-                    conn, addr = server.socket.accept()
-                except OSError:
+        time.sleep(0.5)     # stragglers: normal accept loop gets them
+        server.shutdown()   # stops accepting; handlers keep running
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            with _inflight_lock:
+                if _inflight['n'] == 0:
                     break
-                server.process_request(conn, addr)
-            # Drain = no in-flight HTTP requests (covers the window
-            # between accept and engine submit, and the one-shot
-            # engine), bounded.
-            deadline = time.time() + 60
-            while time.time() < deadline:
-                with _inflight_lock:
-                    if _inflight['n'] == 0:
-                        break
-                time.sleep(0.2)
-            if engine is not None:
-                engine.stop()
-            os._exit(0)
-
-        threading.Thread(target=_stop, daemon=True).start()
+            time.sleep(0.2)
+        if engine is not None:
+            engine.stop()
+        os._exit(0)
 
     import signal
     import time
-    signal.signal(signal.SIGTERM, _drain)
+    threading.Thread(target=_drain_loop, daemon=True).start()
+    signal.signal(signal.SIGTERM, lambda *_: _term.set())
     print(f'serve_lm listening on :{args.port} model={args.model}',
           flush=True)
     server.serve_forever()
