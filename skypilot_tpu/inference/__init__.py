@@ -1,0 +1,17 @@
+"""In-framework LM inference serving (the payload of serve replicas).
+
+Split of the former monolithic recipes/serve_lm.py:
+
+  - runtime.py       — model/params/engine construction + the request
+                       execution surface (one-shot buckets, continuous
+                       engine, streaming, TTFT metrics);
+  - openai_compat.py — /v1/completions + /v1/chat/completions shims,
+                       SSE chunk schemas, incremental detokenization,
+                       stop-string scanning, n>1 fan-out;
+  - http_server.py   — the HTTP handler (native /generate,
+                       /generate_text, /stats) + graceful SIGTERM
+                       drain.
+
+`python -m skypilot_tpu.recipes.serve_lm` remains the entry point
+(the recipe file is now a thin CLI wrapper over this package).
+"""

@@ -1,0 +1,425 @@
+"""HTTP front of the inference runtime.
+
+JetStream-shaped native endpoints + OpenAI shims:
+
+  GET  /                       readiness + capacity
+  GET  /stats                  engine + serving metrics (incl. TTFT)
+  POST /generate               token ids in/out; `stream` = SSE of
+                               {"index", "token"} events
+  POST /generate_text          text in/out via the --hf tokenizer;
+                               `stream` = SSE of {"index", "delta"}
+  POST /v1/completions         OpenAI completions (+SSE, n>1)
+  POST /v1/chat/completions    OpenAI chat (+SSE, n>1)
+
+Graceful drain on SIGTERM (rolling updates / replica replacement):
+stop accepting, wait out in-flight requests up to --drain-grace
+seconds, exit 0 via os._exit (skipping the XLA C++ teardown, which is
+crash-prone under signal-interleaved shutdown).
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List
+
+from skypilot_tpu.inference import openai_compat as oai
+from skypilot_tpu.inference.runtime import (InferenceRuntime,
+                                            iter_interleaved)
+
+
+def serve(rt: InferenceRuntime, port: int,
+          drain_grace: float = 630.0) -> None:
+    """Run the HTTP server until killed. `drain_grace` bounds the
+    SIGTERM drain wait; it defaults ABOVE the 600s request future
+    timeout so a worst-case in-flight generation still completes —
+    requests longer than the grace window are dropped at exit."""
+
+    # Live POSTs (graceful drain waits on this, covering the window
+    # between accept and engine submit and the one-shot engine).
+    _inflight = {'n': 0}
+    _inflight_lock = threading.Lock()
+
+    class Handler(BaseHTTPRequestHandler):
+
+        def log_message(self, *a):  # quiet
+            pass
+
+        # -- writer surface (also used by openai_compat) ------------
+        def _json(self, obj, code=200):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header('Content-Type', 'application/json')
+            self.send_header('Content-Length', str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def send_json(self, obj, code=200):
+            self._json(obj, code)
+
+        def sse_start(self):
+            self.send_response(200)
+            self.send_header('Content-Type', 'text/event-stream')
+            self.send_header('Cache-Control', 'no-cache')
+            self.send_header('Connection', 'close')
+            self.end_headers()
+            self._sse_open = True
+
+        def sse_send(self, obj):
+            self.wfile.write(b'data: ' + json.dumps(obj).encode() +
+                             b'\n\n')
+            self.wfile.flush()
+
+        def sse_done(self):
+            self.wfile.write(b'data: [DONE]\n\n')
+            self.wfile.flush()
+
+        # -- GET ----------------------------------------------------
+        def do_GET(self):  # noqa: N802
+            if self.path in ('/stats', '/v1/stats'):
+                self._stats()
+                return
+            # Advertise the MINIMUM capacity across request classes
+            # (greedy requests may run through the speculative engine
+            # at spec_total) — clients sizing prompts off this can
+            # never be rejected.
+            self._json({'status': 'ok',
+                        'model': rt.model_name,
+                        'vocab_size': rt.vocab_size,
+                        'max_total_len': rt.spec_total
+                        if rt.speculative > 0 else rt.max_total_len})
+
+        def _stats(self):
+            """Engine observability (the vLLM /metrics idea, JSON):
+            slot occupancy, page pool, prefix-cache hit rate,
+            speculation quality, and serving latency percentiles
+            (TTFT from streamed requests)."""
+            engine = rt.engine
+            body = {'serving': rt.metrics.snapshot()}
+            if engine is None:
+                body['engine'] = 'simple'
+                self._json(body)
+                return
+            body.update({
+                'engine': 'continuous',
+                'num_slots': engine.num_slots,
+                'active_slots': int(engine.active.sum()),
+                'queued': engine._queue.qsize() + len(engine._ready),
+                'decode_calls': engine.decode_calls,
+                'tokens_committed': engine.tokens_committed,
+                'tokens_per_call': round(
+                    engine.tokens_committed /
+                    max(engine.decode_calls, 1), 3),
+                'speculative_k': engine.spec_k,
+            })
+            if engine.paged:
+                body['page_pool'] = {
+                    'total': engine.total_pages,
+                    'free': engine.allocator.free_pages,
+                }
+                if engine.prefix_cache is not None:
+                    pc = engine.prefix_cache
+                    body['prefix_cache'] = {
+                        'hits': pc.hits,
+                        'misses': pc.misses,
+                        'hit_rate': round(
+                            pc.hits / max(pc.hits + pc.misses, 1), 3),
+                        'resident_unreferenced': len(pc.lru),
+                    }
+            self._json(body)
+
+        # -- POST ---------------------------------------------------
+        def do_POST(self):  # noqa: N802
+            with _inflight_lock:
+                _inflight['n'] += 1
+            try:
+                self._do_post()
+            finally:
+                with _inflight_lock:
+                    _inflight['n'] -= 1
+
+        def _read_body(self):
+            length = int(self.headers.get('Content-Length', 0))
+            return json.loads(self.rfile.read(length))
+
+        def _do_post(self):
+            if self.path == '/v1/completions':
+                self._openai_completions()
+                return
+            if self.path == '/v1/chat/completions':
+                self._openai_chat()
+                return
+            if self.path in ('/generate_text', '/v1/generate_text'):
+                self._generate_text()
+                return
+            if self.path not in ('/generate', '/v1/generate'):
+                self._json({'error': 'POST /generate, /generate_text, '
+                                     'or /v1/completions'}, 404)
+                return
+            self._generate()
+
+        def _generate(self):
+            try:
+                req = self._read_body()
+                tokens = req['tokens']
+                temperature = float(req.get('temperature', 0.0))
+                top_k = int(req.get('top_k', 0))
+                top_p = float(req.get('top_p', 1.0))
+                stop_ids = [int(t) for t in
+                            req.get('stop_token_ids', [])]
+                stream = bool(req.get('stream'))
+                limit = rt.limit_for(temperature, streaming=stream)
+                for row in tokens:
+                    if len(row) >= limit:
+                        raise ValueError(
+                            f'prompt len {len(row)} >= max_total_len '
+                            f'{limit}')
+                max_new = int(req.get('max_new_tokens',
+                                      rt.engine_total))
+                if stream:
+                    self._generate_stream(tokens, max_new, temperature,
+                                          top_k, top_p, stop_ids)
+                    return
+                t0 = time.monotonic()
+                if rt.engine is not None:
+                    # Ragged rows welcome: each joins the shared
+                    # decode loop independently.
+                    futs = [rt.engine.submit(
+                        [int(t) for t in row], max_new_tokens=max_new,
+                        temperature=temperature, top_k=top_k,
+                        top_p=top_p, stop_token_ids=stop_ids)
+                        for row in tokens]
+                    rows = [f.result(timeout=600) for f in futs]
+                else:
+                    import jax
+                    import jax.numpy as jnp
+                    prompt = jnp.asarray(tokens, jnp.int32)
+                    if prompt.ndim != 2:
+                        raise ValueError(
+                            'tokens must be [batch, prompt_len]')
+                    fn = rt.get_fn(prompt.shape[0], temperature)
+                    out = fn(rt.params, prompt, rt.split_rng())
+                    rows = jax.device_get(out).tolist()
+                # One-shot rows come back padded to the full jit
+                # bucket: the DECODED count is bounded by max_new,
+                # not the buffer tail (metrics feed /stats tok/s).
+                n_gen = sum(min(max(len(r) - len(p), 0), max_new)
+                            for r, p in zip(rows, tokens))
+                rt.metrics.record(time.monotonic() - t0, n_gen)
+                self._json({'tokens': rows})
+            except Exception as e:  # pylint: disable=broad-except
+                self._plain_error(e)
+
+        def _plain_error(self, e: Exception):
+            if getattr(self, '_sse_open', False):
+                # Mid-stream failure: headers are out; close the
+                # stream (the client sees truncation, not a reset).
+                try:
+                    self.sse_done()
+                except Exception:  # pylint: disable=broad-except
+                    pass
+                return
+            self._json({'error': f'{type(e).__name__}: {e}'}, 400)
+
+        def _generate_stream(self, tokens, max_new, temperature,
+                             top_k, top_p, stop_ids):
+            """SSE of {"index": row, "token": id} events, one per
+            committed token across all rows, interleaved by arrival."""
+            t0 = time.monotonic()
+            handles = [rt.submit_stream(
+                [int(t) for t in row], max_new, temperature,
+                top_k=top_k, top_p=top_p, stop_token_ids=stop_ids)
+                for row in tokens]
+            self.sse_start()
+            n_gen = 0
+            ttft = None
+            for i, t in iter_interleaved(handles):
+                if ttft is None:
+                    ttft = time.monotonic() - t0
+                n_gen += 1
+                self.sse_send({'index': i, 'token': t})
+            # Full rows in the terminal event: stream consumers get
+            # the same payload the non-streaming endpoint returns.
+            self.sse_send({'done': True,
+                           'tokens': [h.future.result()
+                                      for h in handles]})
+            self.sse_done()
+            rt.metrics.record(time.monotonic() - t0, n_gen,
+                              ttft_s=ttft)
+
+        def _openai_completions(self):
+            try:
+                body = self._read_body()
+                prompts = body.get('prompt', '')
+                if isinstance(prompts, str):
+                    prompts = [prompts]
+                req = oai.CompletionRequest(
+                    prompts=prompts,
+                    max_new=int(body.get('max_tokens', 16)),
+                    temperature=float(body.get('temperature', 1.0)),
+                    top_p=float(body.get('top_p', 1.0)),
+                    stop_strings=body.get('stop') or [],
+                    n=int(body.get('n', 1)),
+                    stream=bool(body.get('stream')))
+                if req.stream:
+                    oai.stream_completion(rt, req, self)
+                else:
+                    self._json(oai.run_completion(rt, req))
+            except Exception as e:  # pylint: disable=broad-except
+                self._oai_error(e)
+
+        def _openai_chat(self):
+            try:
+                body = self._read_body()
+                prompt = oai.render_chat_prompt(rt, body['messages'])
+                req = oai.CompletionRequest(
+                    prompts=[prompt],
+                    max_new=int(body.get('max_tokens', 16)),
+                    temperature=float(body.get('temperature', 1.0)),
+                    top_p=float(body.get('top_p', 1.0)),
+                    stop_strings=body.get('stop') or [],
+                    n=int(body.get('n', 1)),
+                    stream=bool(body.get('stream')))
+                if req.stream:
+                    oai.stream_completion(rt, req, self, chat=True)
+                else:
+                    self._json(oai.to_chat_response(
+                        oai.run_completion(rt, req)))
+            except Exception as e:  # pylint: disable=broad-except
+                self._oai_error(e)
+
+        def _oai_error(self, e: Exception):
+            if getattr(self, '_sse_open', False):
+                # Headers already sent: the OpenAI stream contract has
+                # no in-band error frame; close the stream.
+                try:
+                    self.sse_done()
+                except Exception:  # pylint: disable=broad-except
+                    pass
+                return
+            self._json({'error': {
+                'message': f'{type(e).__name__}: {e}',
+                'type': 'invalid_request_error'}}, 400)
+
+        def _generate_text(self):
+            try:
+                tok = rt.get_tokenizer()
+                req = self._read_body()
+                prompts = req['prompts']
+                if isinstance(prompts, str):
+                    prompts = [prompts]
+                temperature = float(req.get('temperature', 0.0))
+                top_k = int(req.get('top_k', 0))
+                top_p = float(req.get('top_p', 1.0))
+                stop_strings = req.get('stop') or []
+                if isinstance(stop_strings, str):
+                    stop_strings = [stop_strings]
+                max_new = int(req.get('max_new_tokens', 64))
+                stream = bool(req.get('stream'))
+                encoded = [tok(p)['input_ids'] for p in prompts]
+                limit = rt.limit_for(temperature, streaming=stream)
+                for ids in encoded:
+                    if len(ids) >= limit:
+                        raise ValueError(
+                            f'prompt tokenizes to {len(ids)} >= '
+                            f'max_total_len {limit}')
+                if stream:
+                    self._generate_text_stream(
+                        encoded, max_new, temperature, top_k, top_p,
+                        stop_strings)
+                    return
+                t0 = time.monotonic()
+                if rt.engine is not None:
+                    futs = [rt.engine.submit(
+                        ids, max_new_tokens=max_new,
+                        temperature=temperature, top_k=top_k,
+                        top_p=top_p) for ids in encoded]
+                    rows = [f.result(timeout=600) for f in futs]
+                else:
+                    rows = rt.one_shot_rows(encoded, max_new,
+                                            temperature)
+                texts = [tok.decode(row[len(ids):],
+                                    skip_special_tokens=True)
+                         for ids, row in zip(encoded, rows)]
+                texts = [oai.trim_stops(t, stop_strings)[0]
+                         for t in texts]
+                n_gen = sum(len(r) - len(p)
+                            for r, p in zip(rows, encoded))
+                rt.metrics.record(time.monotonic() - t0, n_gen)
+                self._json({'texts': texts})
+            except Exception as e:  # pylint: disable=broad-except
+                self._plain_error(e)
+
+        def _generate_text_stream(self, encoded: List[List[int]],
+                                  max_new, temperature, top_k, top_p,
+                                  stop_strings):
+            """SSE of {"index": i, "delta": text} events (incremental
+            detokenization + stop-string holdback per row)."""
+            tok = rt.get_tokenizer()
+            t0 = time.monotonic()
+            handles = [rt.submit_stream(ids, max_new, temperature,
+                                        top_k=top_k, top_p=top_p)
+                       for ids in encoded]
+            self.sse_start()
+            decs = [oai.IncrementalDecoder(tok) for _ in encoded]
+            scans = [oai.StopStringScanner(stop_strings)
+                     for _ in encoded]
+            n_gen = 0
+            ttft = None
+            for i, t in iter_interleaved(handles):
+                if ttft is None:
+                    ttft = time.monotonic() - t0
+                n_gen += 1
+                if scans[i].hit:
+                    continue
+                out = scans[i].push(decs[i].push(t))
+                if out:
+                    self.sse_send({'index': i, 'delta': out})
+            for i in range(len(handles)):
+                if not scans[i].hit:
+                    out = (scans[i].push(decs[i].flush()) +
+                           scans[i].flush())
+                    if out:
+                        self.sse_send({'index': i, 'delta': out})
+            self.sse_done()
+            rt.metrics.record(time.monotonic() - t0, n_gen,
+                              ttft_s=ttft)
+
+    server = ThreadingHTTPServer(('0.0.0.0', port), Handler)
+
+    _term = threading.Event()
+
+    def _drain_loop():
+        """Graceful drain on SIGTERM: let the accept loop pick up
+        stragglers briefly, stop accepting, wait for in-flight POSTs
+        (bounded by drain_grace), exit 0 — a mid-generation client
+        must not see a reset because the controller culled this
+        replica. All work happens on this pre-started thread; the
+        signal handler only sets an event (anything heavier in the
+        signal frame proved crash-prone against the XLA runtime's own
+        thread machinery)."""
+        _term.wait()
+        print('serve_lm: SIGTERM — draining in-flight requests',
+              flush=True)
+        time.sleep(0.5)     # stragglers: normal accept loop gets them
+        server.shutdown()   # stops accepting; handlers keep running
+        deadline = time.time() + drain_grace
+        while time.time() < deadline:
+            with _inflight_lock:
+                if _inflight['n'] == 0:
+                    break
+            time.sleep(0.2)
+        rt.stop()
+        # Skip the XLA C++ teardown entirely: destructor ordering
+        # under an in-flight device stream SIGABRTs nondeterministically
+        # (the drain is complete; there is nothing left to clean up).
+        os._exit(0)
+
+    threading.Thread(target=_drain_loop, daemon=True).start()
+    signal.signal(signal.SIGTERM, lambda *_: _term.set())
+    print(f'serve_lm listening on :{port} model={rt.model_name}',
+          flush=True)
+    server.serve_forever()

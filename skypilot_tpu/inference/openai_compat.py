@@ -1,0 +1,305 @@
+"""OpenAI-compatible completions/chat shims + SSE streaming.
+
+The de-facto client contract: the reference's llm/ recipes serve vLLM
+(/root/reference/llm/vllm/README.md:74,159 drives /v1/completions and
+/v1/chat/completions), whose clients stream by default. Implements:
+
+  - non-streaming completions with `n >= 1` (one-shot path batches
+    the n samples into a single [n, P] generate call; the continuous
+    engine fans out n slot submissions that decode concurrently);
+  - SSE streaming (`stream: true`) with the OpenAI chunk schemas
+    (`text_completion` chunks; `chat.completion.chunk` deltas), tokens
+    flushed as the engine commits them;
+  - incremental detokenization (UTF-8-safe: a token ending in a
+    partial multi-byte sequence is held until complete);
+  - stop-string scanning with holdback (text that could be the prefix
+    of a stop string is not emitted until disambiguated).
+
+Requests are executed through `InferenceRuntime`; HTTP writing goes
+through the handler's small writer surface (send_json / sse_*).
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu.inference.runtime import (InferenceRuntime,
+                                            iter_interleaved)
+
+
+class IncrementalDecoder:
+    """Streamed token ids -> text deltas.
+
+    Decodes the full generated-id prefix each push (O(n) per token —
+    fine at serving lengths; HF's streamer uses the same shape) and
+    emits only the new suffix. A trailing U+FFFD means the byte-level
+    BPE stream ends mid-codepoint: hold until the next token completes
+    it."""
+
+    def __init__(self, tok) -> None:
+        self.tok = tok
+        self.ids: List[int] = []
+        self.text = ''
+
+    def push(self, tok_id: int) -> str:
+        self.ids.append(tok_id)
+        full = self.tok.decode(self.ids, skip_special_tokens=True)
+        if full.endswith('�'):
+            return ''
+        delta = full[len(self.text):]
+        self.text = full
+        return delta
+
+    def flush(self) -> str:
+        """Final delta (drops an unresolved partial codepoint)."""
+        full = self.tok.decode(self.ids, skip_special_tokens=True)
+        if full.endswith('�'):
+            full = full[:-1]
+        delta = full[len(self.text):]
+        self.text = full
+        return delta
+
+
+class StopStringScanner:
+    """Emit-safe streaming with OpenAI `stop` semantics: the completion
+    ends BEFORE the first occurrence of any stop string, and no text
+    that might turn out to be part of one is ever emitted early."""
+
+    def __init__(self, stops: List[str]) -> None:
+        self.stops = [s for s in stops if s]
+        self.buf = ''
+        self.emitted = 0
+        self.hit = False
+
+    def _holdback(self) -> int:
+        """Length of the longest buffer suffix that is a proper prefix
+        of some stop string (must not be emitted yet)."""
+        hold = 0
+        for s in self.stops:
+            for k in range(min(len(s) - 1, len(self.buf)), 0, -1):
+                if self.buf.endswith(s[:k]):
+                    hold = max(hold, k)
+                    break
+        return hold
+
+    def push(self, delta: str) -> str:
+        """Returns the newly emittable text; sets `hit` when a stop
+        string landed (emittable text ends right before it)."""
+        if self.hit:
+            return ''
+        self.buf += delta
+        cut = -1
+        for s in self.stops:
+            i = self.buf.find(s)
+            if i != -1:
+                cut = i if cut == -1 else min(cut, i)
+        if cut != -1:
+            self.hit = True
+            out = self.buf[self.emitted:cut]
+            self.emitted = cut
+            return out
+        safe = len(self.buf) - self._holdback()
+        out = self.buf[self.emitted:safe]
+        self.emitted = max(self.emitted, safe)
+        return out
+
+    def flush(self) -> str:
+        if self.hit:
+            return ''
+        out = self.buf[self.emitted:]
+        self.emitted = len(self.buf)
+        return out
+
+
+def trim_stops(text: str, stops: List[str]) -> Tuple[str, bool]:
+    cut = -1
+    for s in stops:
+        if not s:
+            continue
+        i = text.find(s)
+        if i != -1:
+            cut = i if cut == -1 else min(cut, i)
+    if cut != -1:
+        return text[:cut], True
+    return text, False
+
+
+class CompletionRequest:
+    """Validated, normalized body shared by both /v1 endpoints."""
+
+    def __init__(self, prompts: List[str], max_new: int,
+                 temperature: float, top_p: float,
+                 stop_strings, n: int, stream: bool) -> None:
+        if isinstance(stop_strings, str):
+            stop_strings = [stop_strings]
+        if n < 1 or n > 16:
+            raise ValueError(f'n must be in [1, 16], got {n}')
+        if stream and len(prompts) != 1:
+            raise ValueError(
+                'stream=true supports a single prompt per request')
+        self.prompts = prompts
+        self.max_new = max_new
+        self.temperature = temperature
+        self.top_p = top_p
+        self.stop_strings = list(stop_strings or [])
+        self.n = n
+        self.stream = stream
+
+
+def run_completion(rt: InferenceRuntime, req: CompletionRequest
+                   ) -> Dict[str, object]:
+    """Non-streaming completions: returns the OpenAI response dict.
+    Each prompt yields `n` choices (indices p*n..p*n+n-1, the OpenAI
+    layout for multi-prompt + n)."""
+    tok = rt.get_tokenizer()
+    t0 = time.monotonic()
+    encoded = [tok(p)['input_ids'] for p in req.prompts]
+    limit = rt.limit_for(req.temperature)
+    for ids in encoded:
+        if len(ids) >= limit:
+            raise ValueError(f'prompt tokenizes to {len(ids)} >= '
+                             f'max_total_len {limit}')
+    rows: List[List[int]] = []
+    row_prompt: List[List[int]] = []  # prompt ids per output row
+    if rt.engine is not None:
+        futs = []
+        for ids in encoded:
+            for _ in range(req.n):
+                futs.append(rt.engine.submit(
+                    ids, max_new_tokens=req.max_new,
+                    temperature=req.temperature, top_p=req.top_p))
+                row_prompt.append(ids)
+        rows = [f.result(timeout=600) for f in futs]
+    else:
+        import jax
+        import jax.numpy as jnp
+        for ids in encoded:
+            # The n samples batch into ONE [n, P] generate call —
+            # categorical sampling is independent per row, so this is
+            # the n>1 fan-out at full MXU utilization (greedy rows are
+            # identical by definition, as in the OpenAI contract).
+            want = len(ids) + req.max_new
+            bucket = 8
+            while bucket < want:
+                bucket *= 2
+            bucket = min(bucket, limit)
+            fn = rt.get_fn(req.n, req.temperature, bucket)
+            out = fn(rt.params,
+                     jnp.asarray([ids] * req.n, jnp.int32),
+                     rt.split_rng())
+            got = jax.device_get(out)
+            for r in range(req.n):
+                rows.append(got[r][:min(want, bucket)].tolist())
+                row_prompt.append(ids)
+
+    choices = []
+    total_completion = 0
+    for i, (ids, row) in enumerate(zip(row_prompt, rows)):
+        text = tok.decode(row[len(ids):], skip_special_tokens=True)
+        n_gen = len(row) - len(ids)
+        finish = 'length' if n_gen >= req.max_new else 'stop'
+        text, hit = trim_stops(text, req.stop_strings)
+        if hit:
+            finish = 'stop'
+        total_completion += n_gen
+        choices.append({'index': i, 'text': text,
+                        'finish_reason': finish, 'logprobs': None})
+    total_prompt = sum(len(ids) for ids in row_prompt)
+    rt.metrics.record(time.monotonic() - t0, total_completion)
+    return {
+        'object': 'text_completion',
+        'model': rt.model_name,
+        'choices': choices,
+        'usage': {
+            'prompt_tokens': total_prompt,
+            'completion_tokens': total_completion,
+            'total_tokens': total_prompt + total_completion,
+        },
+    }
+
+
+def stream_completion(rt: InferenceRuntime, req: CompletionRequest,
+                      writer, chat: bool = False) -> None:
+    """SSE streaming for one prompt x n choices.
+
+    Chunks follow the OpenAI schemas: `text_completion` chunks with
+    incremental `text`, or `chat.completion.chunk` deltas ({'role'}
+    first, then {'content': ...}) when `chat`. The n choices decode
+    CONCURRENTLY (engine slots); their chunks interleave by arrival,
+    each tagged with its choice index. Ends with per-choice
+    finish_reason chunks and `data: [DONE]`."""
+    tok = rt.get_tokenizer()
+    ids = tok(req.prompts[0])['input_ids']
+    limit = rt.limit_for(req.temperature, streaming=True)
+    if len(ids) >= limit:
+        raise ValueError(f'prompt tokenizes to {len(ids)} >= '
+                         f'max_total_len {limit}')
+    t0 = time.monotonic()
+    handles = [rt.submit_stream(ids, req.max_new, req.temperature,
+                                top_p=req.top_p)
+               for _ in range(req.n)]
+    writer.sse_start()
+    obj = 'chat.completion.chunk' if chat else 'text_completion'
+
+    def chunk(index: int, text: Optional[str],
+              finish: Optional[str] = None) -> Dict[str, object]:
+        c: Dict[str, object] = {'index': index,
+                                'finish_reason': finish}
+        if chat:
+            c['delta'] = {} if text is None else {'content': text}
+        else:
+            c['text'] = text or ''
+            c['logprobs'] = None
+        return {'object': obj, 'model': rt.model_name,
+                'choices': [c]}
+
+    if chat:
+        for i in range(req.n):
+            writer.sse_send({'object': obj, 'model': rt.model_name,
+                             'choices': [{'index': i,
+                                          'delta': {'role': 'assistant'},
+                                          'finish_reason': None}]})
+
+    decs = [IncrementalDecoder(tok) for _ in range(req.n)]
+    scans = [StopStringScanner(req.stop_strings) for _ in range(req.n)]
+    n_gen = [0] * req.n
+    ttft: Optional[float] = None
+
+    for i, t in iter_interleaved(handles):
+        if ttft is None:
+            ttft = time.monotonic() - t0
+        n_gen[i] += 1
+        if scans[i].hit:
+            continue  # post-stop tokens: drop
+        out = scans[i].push(decs[i].push(t))
+        if out:
+            writer.sse_send(chunk(i, out))
+    for i in range(req.n):
+        if not scans[i].hit:
+            out = scans[i].push(decs[i].flush()) + scans[i].flush()
+            if out:
+                writer.sse_send(chunk(i, out))
+        finish = ('stop' if scans[i].hit
+                  else 'length' if n_gen[i] >= req.max_new else 'stop')
+        writer.sse_send(chunk(i, None, finish))
+    writer.sse_done()
+    rt.metrics.record(time.monotonic() - t0, sum(n_gen), ttft_s=ttft)
+
+
+def render_chat_prompt(rt: InferenceRuntime, messages) -> str:
+    """Chat template when the checkpoint ships one, else a transparent
+    `role: content` fallback (beats a 400 for base models)."""
+    tok = rt.get_tokenizer()
+    try:
+        return tok.apply_chat_template(messages, tokenize=False,
+                                       add_generation_prompt=True)
+    except Exception:  # pylint: disable=broad-except
+        return '\n'.join(f"{m['role']}: {m['content']}"
+                         for m in messages) + '\nassistant:'
+
+
+def to_chat_response(out: Dict[str, object]) -> Dict[str, object]:
+    out['object'] = 'chat.completion'
+    for c in out['choices']:
+        c['message'] = {'role': 'assistant', 'content': c.pop('text')}
+    return out

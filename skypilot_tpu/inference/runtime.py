@@ -1,0 +1,384 @@
+"""Inference runtime: model loading, request execution, metrics.
+
+Owns everything the HTTP layer needs to run a request: the model +
+placed params, the per-(batch, temperature, length) one-shot jit
+buckets, the optional continuous-batching engine, a streaming path
+(engine token callbacks; a small lazy engine backs streaming when the
+server runs in one-shot mode), and serving metrics (TTFT / e2e
+latency percentiles surfaced by /stats — the BASELINE.md north-star
+"p50 TTFT" is measured here).
+"""
+from __future__ import annotations
+
+import collections
+import os
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Tuple
+
+
+class ServingMetrics:
+    """Rolling request metrics, thread-safe. TTFT is recorded at the
+    first streamed token (only streaming requests observe one); e2e
+    latency + completion tokens for every request."""
+
+    def __init__(self, window: int = 1024) -> None:
+        self._lock = threading.Lock()
+        self.ttft_ms: 'collections.deque' = collections.deque(
+            maxlen=window)
+        self.latency_ms: 'collections.deque' = collections.deque(
+            maxlen=window)
+        self.completion_tokens: 'collections.deque' = collections.deque(
+            maxlen=window)
+        self.requests = 0
+
+    def record(self, latency_s: float, n_tokens: int,
+               ttft_s: Optional[float] = None) -> None:
+        with self._lock:
+            self.requests += 1
+            self.latency_ms.append(latency_s * 1000.0)
+            self.completion_tokens.append(n_tokens)
+            if ttft_s is not None:
+                self.ttft_ms.append(ttft_s * 1000.0)
+
+    @staticmethod
+    def _pct(values: List[float], q: float) -> Optional[float]:
+        if not values:
+            return None
+        s = sorted(values)
+        idx = min(len(s) - 1, int(round(q * (len(s) - 1))))
+        return round(s[idx], 2)
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            lat = list(self.latency_ms)
+            ttft = list(self.ttft_ms)
+            toks = list(self.completion_tokens)
+            n = self.requests
+        total_s = sum(lat) / 1000.0
+        return {
+            'requests': n,
+            'ttft_ms_p50': self._pct(ttft, 0.50),
+            'ttft_ms_p95': self._pct(ttft, 0.95),
+            'latency_ms_p50': self._pct(lat, 0.50),
+            'latency_ms_p95': self._pct(lat, 0.95),
+            'completion_tokens_total': sum(toks),
+            'gen_tokens_per_sec': round(sum(toks) / total_s, 2)
+            if total_s > 0 else None,
+        }
+
+
+class StreamHandle:
+    """Consumer side of one streaming request: committed tokens arrive
+    on `q` (pushed from the engine scheduler thread); `future` resolves
+    to the full prompt++generated list when the request finishes.
+    `first_token_s` latches the TTFT instant. Constructed BEFORE the
+    engine submit so the very first committed token always finds the
+    queue (the scheduler thread races the submitting thread)."""
+
+    def __init__(self) -> None:
+        self.q: 'queue.Queue' = queue.Queue()
+        self.future: Optional['Future'] = None  # set right after submit
+        self.t0 = time.monotonic()
+        self.first_token_s: Optional[float] = None
+
+    def on_token(self, tok: int) -> None:
+        if self.first_token_s is None:
+            self.first_token_s = time.monotonic() - self.t0
+        self.q.put(tok)
+
+
+def iter_interleaved(handles: List[StreamHandle]):
+    """Yield (choice_index, token) across streams in arrival order
+    until every stream completes — one slow choice must not stall its
+    siblings' chunks. Re-raises the engine's exception on failure.
+    The shared poll loop behind every SSE endpoint (done-detection
+    order matters: Empty -> future.done() -> q.empty() re-check closes
+    the commit/resolve race window)."""
+    done = [False] * len(handles)
+    while not all(done):
+        progressed = False
+        for i, h in enumerate(handles):
+            if done[i]:
+                continue
+            try:
+                tok = h.q.get_nowait()
+            except queue.Empty:
+                if h.future.done() and h.q.empty():
+                    h.future.result()  # raise to the caller on error
+                    done[i] = True
+                    progressed = True
+                continue
+            progressed = True
+            yield i, int(tok)
+        if not progressed:
+            time.sleep(0.005)
+
+
+class InferenceRuntime:
+    """Everything needed to execute generation requests.
+
+    `engine` is the continuous-batching engine when the server runs in
+    that mode, else None; `stream_engine()` always returns an engine
+    (lazily building a small one in one-shot mode) because streaming
+    needs per-token commit callbacks, which only the slot engine has.
+    """
+
+    def __init__(self, *, model, params, vocab_size: int,
+                 model_name: str, max_total_len: int, spec_total: int,
+                 speculative: int, engine=None,
+                 tokenizer_dir: Optional[str] = None,
+                 stream_slots: int = 2) -> None:
+        import jax
+        self.model = model
+        self.params = params
+        self.vocab_size = vocab_size
+        self.model_name = model_name
+        self.max_total_len = max_total_len
+        self.spec_total = spec_total
+        self.speculative = speculative
+        self.engine = engine
+        self.engine_total = (spec_total if speculative > 0
+                             else max_total_len)
+        self.tokenizer_dir = tokenizer_dir
+        self.metrics = ServingMetrics()
+
+        self._fns: Dict[Tuple[int, float, int], object] = {}
+        self._lock = threading.Lock()
+        self._rng = jax.random.PRNGKey(0)
+        self._tok_holder: Dict[str, object] = {}
+        self._tok_lock = threading.Lock()
+        self._stream_engine = None
+        self._stream_engine_lock = threading.Lock()
+        self._stream_slots = stream_slots
+
+    # -- capacity -----------------------------------------------------------
+    def limit_for(self, temperature: float,
+                  streaming: bool = False) -> int:
+        """Max total length the request class will actually run at.
+        Streaming always runs through a slot engine built at
+        engine_total — validate against THAT capacity, not the
+        one-shot bucket's (they differ in one-shot+speculative mode)."""
+        if self.engine is not None or streaming:
+            return self.engine_total
+        if self.speculative > 0 and temperature == 0.0:
+            return self.spec_total
+        return self.max_total_len
+
+    # -- tokenizer ----------------------------------------------------------
+    def get_tokenizer(self):
+        with self._tok_lock:
+            if 'tok' not in self._tok_holder:
+                if self.tokenizer_dir is None:
+                    raise ValueError(
+                        'no tokenizer available: text endpoints need '
+                        'a --hf checkpoint with tokenizer files; use '
+                        '/generate with token ids instead')
+                from skypilot_tpu.models.hf_import import load_tokenizer
+                self._tok_holder['tok'] = load_tokenizer(
+                    self.tokenizer_dir)
+            return self._tok_holder['tok']
+
+    # -- one-shot path ------------------------------------------------------
+    def get_fn(self, batch: int, temperature: float, total: int = 0):
+        """One jitted fn per (batch, temperature, total-length) bucket.
+        `total` defaults to the engine's full capacity; text endpoints
+        pass a smaller bucket so a 4-token completion does not pay for
+        a full-buffer decode scan."""
+        from skypilot_tpu.models import generate as gen
+        if total <= 0:
+            total = self.limit_for(temperature)
+        key = (batch, temperature, total)
+        with self._lock:
+            if key not in self._fns:
+                if self.speculative > 0 and temperature == 0.0:
+                    self._fns[key] = gen.make_speculative_generate_fn(
+                        self.model, total, draft_k=self.speculative)
+                else:
+                    self._fns[key] = gen.make_generate_fn(
+                        self.model, total, temperature=temperature)
+            return self._fns[key]
+
+    def split_rng(self):
+        import jax
+        with self._lock:
+            self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def one_shot_rows(self, rows: List[List[int]], max_new: int,
+                      temperature: float) -> List[List[int]]:
+        """Run ragged rows through power-of-two one-shot buckets and
+        return each row trimmed to prompt + max_new. Rows sharing a
+        bucket could batch; they arrive per-request here, so each runs
+        alone (the continuous engine is the batching mode)."""
+        import jax
+        import jax.numpy as jnp
+        limit = self.limit_for(temperature)
+        out_rows = []
+        for ids in rows:
+            want = len(ids) + max_new
+            bucket = 8
+            while bucket < want:
+                bucket *= 2
+            bucket = min(bucket, limit)
+            fn = self.get_fn(1, temperature, bucket)
+            out = fn(self.params, jnp.asarray([ids], jnp.int32),
+                     self.split_rng())
+            out_rows.append(
+                jax.device_get(out)[0][:min(want, bucket)].tolist())
+        return out_rows
+
+    # -- streaming path -----------------------------------------------------
+    def stream_engine(self):
+        """The engine that backs streaming requests: the main engine
+        in continuous mode; else a small lazily-built one (shares
+        params — HBM cost is its slot KV cache only)."""
+        if self.engine is not None:
+            return self.engine
+        with self._stream_engine_lock:
+            if self._stream_engine is None:
+                from skypilot_tpu.models.batching import \
+                    ContinuousBatchingEngine
+                self._stream_engine = ContinuousBatchingEngine(
+                    self.model, self.params,
+                    num_slots=self._stream_slots,
+                    max_total_len=self.engine_total,
+                    speculative_k=self.speculative)
+            return self._stream_engine
+
+    def submit_stream(self, ids: List[int], max_new: int,
+                      temperature: float, top_k: int = 0,
+                      top_p: float = 1.0,
+                      stop_token_ids: Optional[List[int]] = None
+                      ) -> StreamHandle:
+        eng = self.stream_engine()
+        handle = StreamHandle()  # queue must exist before submit
+        handle.future = eng.submit(
+            ids, max_new_tokens=max_new, temperature=temperature,
+            top_k=top_k, top_p=top_p, stop_token_ids=stop_token_ids,
+            on_token=handle.on_token)
+        return handle
+
+    def stop(self) -> None:
+        if self.engine is not None:
+            self.engine.stop()
+        if self._stream_engine is not None:
+            self._stream_engine.stop()
+
+
+def build_runtime(args) -> InferenceRuntime:
+    """Construct the runtime from serve_lm CLI args: load the model
+    (registry or HF checkpoint), place params (TP-sharded over the
+    mesh or single-device, bf16 by default), restore a checkpoint if
+    given, and build the continuous engine when enabled."""
+    import flax.linen as nn
+    import jax
+    if args.cpu:
+        jax.config.update('jax_platforms', 'cpu')
+    import jax.numpy as jnp
+
+    from skypilot_tpu.recipes.train_lm import _build_model
+
+    tokenizer_dir = None
+    hf_params = None
+    serve_cast = None
+    if args.hf:
+        from skypilot_tpu.models import hf_import
+        model, hf_params = hf_import.load_hf_checkpoint(
+            args.hf, max_seq_len=args.max_total_len)
+        # Raw f32 numpy here; the cast (bf16 via ml_dtypes) happens
+        # PER LEAF at placement time below — host transient is one
+        # leaf, device footprint is the bf16 shards.
+        import ml_dtypes
+        import numpy as _np
+        serve_cast = (ml_dtypes.bfloat16 if args.param_dtype == 'bf16'
+                      else _np.float32)
+        vocab_size = model.config.vocab_size
+        print(f'loaded HF checkpoint from {args.hf} '
+              f'({type(model).__name__}, vocab={vocab_size})',
+              flush=True)
+        if any(os.path.exists(os.path.join(args.hf, f))
+               for f in ('tokenizer.json', 'tokenizer_config.json',
+                         'tokenizer.model')):
+            tokenizer_dir = args.hf
+    else:
+        model, vocab_size, _ = _build_model(args.model,
+                                            args.max_total_len,
+                                            remat=False)
+
+    # Speculative decoding writes its verify chunk up to K tokens past
+    # the last kept one; fail fast / clamp at STARTUP instead of
+    # erroring inside every request handler.
+    spec_total = args.max_total_len
+    if args.speculative > 0:
+        spec_total = min(args.max_total_len,
+                         model.config.max_seq_len - args.speculative)
+        if spec_total <= 1:
+            raise SystemExit(
+                f'--speculative {args.speculative} needs headroom in '
+                f'the model context: max_seq_len='
+                f'{model.config.max_seq_len} leaves no room for the '
+                f'verify chunk. Use a smaller K or a longer-context '
+                f'model.')
+        if spec_total < args.max_total_len:
+            print(f'speculative decoding: clamping max_total_len '
+                  f'{args.max_total_len} -> {spec_total} (verify chunk '
+                  f'needs K={args.speculative} tokens of headroom '
+                  f'below max_seq_len={model.config.max_seq_len})',
+                  flush=True)
+
+    if hf_params is not None:
+        params = hf_params
+    else:
+        params = nn.meta.unbox(model.init(
+            jax.random.PRNGKey(0),
+            jnp.ones((1, 8), jnp.int32))['params'])
+    # ONE placement block for both param sources: TP-shard over the
+    # mesh (per-leaf cast, shard-only transfers) or single-device.
+    if args.tensor > 1:
+        from skypilot_tpu.parallel import mesh as mesh_lib
+        from skypilot_tpu.parallel.serving import \
+            shard_params_for_serving
+        mesh = mesh_lib.make_mesh(
+            mesh_lib.MeshConfig(tensor=args.tensor),
+            devices=jax.devices()[:args.tensor])
+        params = shard_params_for_serving(model, params, mesh,
+                                          dtype=serve_cast)
+        print(f'tensor-parallel serving over {args.tensor} devices',
+              flush=True)
+    elif serve_cast is not None:
+        import numpy as _np
+        params = jax.tree.map(
+            lambda x: jnp.asarray(_np.asarray(x).astype(serve_cast)),
+            params)
+    if args.ckpt_dir:
+        from skypilot_tpu.parallel.checkpoints import CheckpointManager
+        mgr = CheckpointManager(args.ckpt_dir)
+        if mgr.latest_step() is not None:
+            from skypilot_tpu.parallel.train import TrainState
+            import optax
+            template = TrainState.create(params, optax.sgd(1e-3))
+            params = mgr.restore(template).params
+            print(f'loaded checkpoint step {mgr.latest_step()}',
+                  flush=True)
+
+    engine_total = (spec_total if args.speculative > 0
+                    else args.max_total_len)
+    engine = None
+    if args.continuous_batching:
+        from skypilot_tpu.models.batching import ContinuousBatchingEngine
+        engine = ContinuousBatchingEngine(
+            model, params, num_slots=args.num_slots,
+            max_total_len=engine_total,
+            prefix_caching=not args.no_prefix_caching,
+            speculative_k=args.speculative)
+
+    return InferenceRuntime(
+        model=model, params=params, vocab_size=vocab_size,
+        model_name=(f'hf:{os.path.basename(args.hf)}'
+                    if args.hf else args.model),
+        max_total_len=args.max_total_len, spec_total=spec_total,
+        speculative=args.speculative, engine=engine,
+        tokenizer_dir=tokenizer_dir)

@@ -244,9 +244,15 @@ def _hosts_update_script(block_b64: str, group_name: str) -> str:
       /etc/hosts match).
     """
     # group_name is validated hostname-safe (launch_group), so the
-    # f-string interpolations below cannot break out of the script.
-    begin = _hosts_begin(group_name).replace('/', '\\/')
-    end = _hosts_end(group_name).replace('/', '\\/')
+    # f-string interpolations below cannot break out of the script —
+    # but '.' and '-' are legal in names and '.' is a regex wildcard,
+    # so every ERE metacharacter must be escaped or group 'a.b' would
+    # also strip group 'aXb''s managed block.
+    def awk_escape(s: str) -> str:
+        return re.sub(r'([\\/.\[\](){}*+?|^$])', r'\\\1', s)
+
+    begin = awk_escape(_hosts_begin(group_name))
+    end = awk_escape(_hosts_end(group_name))
     return f'''
 set -e
 b64='{block_b64}'

@@ -23,7 +23,7 @@ import functools
 import queue
 import threading
 from concurrent.futures import Future
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -199,6 +199,8 @@ class ContinuousBatchingEngine:
         self.top_ks = np.zeros((num_slots,), np.int32)   # 0 = off
         self.top_ps = np.ones((num_slots,), np.float32)  # 1 = off
         self.stop_ids: List[frozenset] = [frozenset()] * num_slots
+        self.on_tokens: List[Optional[Callable[[int], None]]] = \
+            [None] * num_slots
 
         # Observability: model calls vs tokens committed (speculation
         # quality = tokens_committed / decode_calls, 1.0..K+1).
@@ -462,13 +464,21 @@ class ContinuousBatchingEngine:
                max_new_tokens: int = 64,
                temperature: Optional[float] = None,
                top_k: int = 0, top_p: float = 1.0,
-               stop_token_ids: Optional[List[int]] = None) -> 'Future':
+               stop_token_ids: Optional[List[int]] = None,
+               on_token: Optional[Callable[[int], None]] = None
+               ) -> 'Future':
         """Queue a request; the Future resolves to the full token list
         (prompt ++ generated). `temperature` overrides the engine
         default per request (0 = greedy); `top_k`/`top_p` filter the
         sampled distribution (0 / 1.0 = off); `stop_token_ids` end
         THIS request on any listed token (in addition to the engine's
-        eos_id), with the stop token included in the output."""
+        eos_id), with the stop token included in the output.
+
+        `on_token` streams: called once per COMMITTED generated token,
+        in order, on the scheduler thread — before the Future resolves
+        — so it must be fast and non-blocking (push to a queue; don't
+        do I/O). Tokens regenerated after a page-pressure preemption
+        are not re-delivered (they became prompt on re-admission)."""
         if len(prompt) >= self.max_total_len:
             raise ValueError(
                 f'prompt len {len(prompt)} >= max_total_len '
@@ -481,7 +491,8 @@ class ContinuousBatchingEngine:
         fut: Future = Future()
         self._queue.put((list(prompt), int(max_new_tokens),
                          float(temp), int(top_k), float(top_p),
-                         frozenset(stop_token_ids or ()), fut))
+                         frozenset(stop_token_ids or ()), on_token,
+                         fut))
         return fut
 
     def stop(self) -> None:
@@ -519,6 +530,7 @@ class ContinuousBatchingEngine:
                     fut = self.futures[slot]
                     self.futures[slot] = None
                     self.active[slot] = False
+                    self.on_tokens[slot] = None
                     if fut is not None:
                         fut.set_exception(e)
                 self.pos[:] = 0
@@ -544,7 +556,7 @@ class ContinuousBatchingEngine:
             except queue.Empty:
                 break
         while self._ready and not self.active.all():
-            (prompt, max_new, temp, top_k, top_p, stops,
+            (prompt, max_new, temp, top_k, top_p, stops, on_token,
              fut) = self._ready.popleft()
             if max_new <= 0:
                 fut.set_result(list(prompt))  # nothing to generate
@@ -586,7 +598,7 @@ class ContinuousBatchingEngine:
                         self.prefix_cache.release(shared)
                     self._ready.appendleft(
                         (prompt, max_new, temp, top_k, top_p, stops,
-                         fut))
+                         on_token, fut))
                     break
                 pages = self.allocator.allocate(need)
                 self.owned_pages[slot] = pages
@@ -662,6 +674,7 @@ class ContinuousBatchingEngine:
             self.top_ks[slot] = top_k
             self.top_ps[slot] = top_p
             self.stop_ids[slot] = stops
+            self.on_tokens[slot] = on_token
             self.active[slot] = True
             admitted = True
         return admitted
@@ -715,7 +728,8 @@ class ContinuousBatchingEngine:
                                   float(self.temps[slot]),
                                   int(self.top_ks[slot]),
                                   float(self.top_ps[slot]),
-                                  self.stop_ids[slot], fut))
+                                  self.stop_ids[slot],
+                                  self.on_tokens[slot], fut))
         # Back to the HEAD preserving pass order (repeated appendleft
         # would reverse it — an FCFS fairness inversion).
         self._ready.extendleft(reversed(preempted))
@@ -755,10 +769,24 @@ class ContinuousBatchingEngine:
         self.page_table[slot, :] = 0
         self.allocated_tokens[slot] = 0
 
+    def _emit(self, slot: int, tok: int) -> None:
+        """Streaming callback for one committed token. A broken
+        consumer (e.g. client hung up mid-stream) must not take down
+        the shared scheduler loop: its callback is dropped and the
+        request finishes normally."""
+        cb = self.on_tokens[slot]
+        if cb is None:
+            return
+        try:
+            cb(tok)
+        except Exception:  # pylint: disable=broad-except
+            self.on_tokens[slot] = None
+
     def _finish_slot(self, slot: int) -> None:
         fut = self.futures[slot]
         self.futures[slot] = None
         self.active[slot] = False
+        self.on_tokens[slot] = None
         if self.paged:
             self._release_slot_pages(slot, promote=True)
         if fut is not None:
@@ -790,6 +818,7 @@ class ContinuousBatchingEngine:
                 continue
             tok = int(self.cur_token[slot])
             self.outputs[slot].append(tok)
+            self._emit(slot, tok)
             self.tokens_committed += 1
             self.pos[slot] += 1
             self.cur_token[slot] = int(sampled[slot])
@@ -841,6 +870,7 @@ class ContinuousBatchingEngine:
             commits += [int(t) for t in drafts[slot, :accept]]
             for tok, nxt in zip(commits, y[slot, :accept + 1]):
                 self.outputs[slot].append(tok)
+                self._emit(slot, tok)
                 self.tokens_committed += 1
                 self.pos[slot] += 1
                 self.cur_token[slot] = int(nxt)

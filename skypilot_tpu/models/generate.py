@@ -58,11 +58,14 @@ def sample_tokens(rng: jax.Array, logits: jax.Array, temps: jax.Array,
     # unfiltered path stays as fast as plain categorical.
     need_filter = jnp.logical_or(jnp.any(top_k > 0),
                                  jnp.any(top_p < 1.0))
+    # Temperature FIRST, then nucleus (the HF/vLLM/OpenAI order): the
+    # nucleus is computed over the temperature-scaled distribution, so
+    # low temperature narrows the kept set. Top-k is scale-invariant.
+    scaled = logits / jnp.maximum(temps, 1e-6)[..., None]
     filtered = jax.lax.cond(
-        need_filter, lambda: filter_logits(logits, top_k, top_p),
-        lambda: logits)
-    scaled = filtered / jnp.maximum(temps, 1e-6)[..., None]
-    sampled = jax.random.categorical(rng, scaled, axis=-1)
+        need_filter, lambda: filter_logits(scaled, top_k, top_p),
+        lambda: scaled)
+    sampled = jax.random.categorical(rng, filtered, axis=-1)
     greedy = jnp.argmax(logits, axis=-1)
     return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
 

@@ -1,13 +1,18 @@
 """In-framework LM inference server: the payload of serve replicas.
 
-A JetStream-shaped HTTP server: GET / (readiness), POST /generate
-{"tokens": [[...]], "max_new_tokens": N, "temperature": t,
- "top_k": k, "top_p": p} →
-{"tokens": [[...]]}. Listens on SKYPILOT_SERVE_PORT (injected by the
-serve controller). Two engines:
+Thin CLI over `skypilot_tpu.inference` (runtime construction in
+inference/runtime.py, HTTP + SSE streaming in inference/http_server.py,
+OpenAI shims in inference/openai_compat.py). A JetStream-shaped HTTP
+server: GET / (readiness), POST /generate {"tokens": [[...]],
+"max_new_tokens": N, "temperature": t, "top_k": k, "top_p": p} →
+{"tokens": [[...]]}, plus /generate_text and OpenAI-compatible
+/v1/completions + /v1/chat/completions with SSE streaming
+(`"stream": true`) and n>1. Listens on SKYPILOT_SERVE_PORT (injected
+by the serve controller). Two engines:
 
   - default: one jitted fixed-shape generate fn per batch bucket
-    (models/generate.py) — simplest, one request at a time;
+    (models/generate.py) — simplest, one request at a time (streaming
+    requests ride a small lazily-built slot engine);
   - --continuous-batching: the slot-based engine
     (models/batching.py) — concurrent requests share the decode
     loop, joining and leaving without draining the batch (the
@@ -19,11 +24,7 @@ serve controller). Two engines:
 from __future__ import annotations
 
 import argparse
-import json
 import os
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, Tuple
 
 
 def main() -> None:
@@ -75,542 +76,23 @@ def main() -> None:
                              'bigger than one chip serve with '
                              '--tensor N (sharded across the slice). '
                              'f32 is for CPU parity runs')
+    parser.add_argument('--drain-grace', type=float, default=630.0,
+                        help='SIGTERM drain: seconds to wait for '
+                             'in-flight requests before exiting. The '
+                             'default exceeds the 600s request future '
+                             'timeout so a worst-case generation still '
+                             'completes; requests outliving the grace '
+                             'window are dropped at exit')
     parser.add_argument('--cpu', action='store_true',
                         help='pin the CPU backend (smoke/dev runs; the '
                              'JAX_PLATFORMS env var is overridden by '
                              'some TPU plugins, jax.config is not)')
     args = parser.parse_args()
 
-    import flax.linen as nn
-    import jax
-    if args.cpu:
-        jax.config.update('jax_platforms', 'cpu')
-    import jax.numpy as jnp
-
-    from skypilot_tpu.models import generate as gen
-    from skypilot_tpu.recipes.train_lm import _build_model
-
-    tokenizer_dir = None
-    hf_params = None
-    if args.hf:
-        from skypilot_tpu.models import hf_import
-        model, hf_params = hf_import.load_hf_checkpoint(
-            args.hf, max_seq_len=args.max_total_len)
-        # Raw f32 numpy here; the cast (bf16 via ml_dtypes) happens
-        # PER LEAF at placement time below — host transient is one
-        # leaf, device footprint is the bf16 shards.
-        import ml_dtypes
-        import numpy as _np
-        serve_cast = (ml_dtypes.bfloat16 if args.param_dtype == 'bf16'
-                      else _np.float32)
-        vocab_size = model.config.vocab_size
-        print(f'loaded HF checkpoint from {args.hf} '
-              f'({type(model).__name__}, vocab={vocab_size})', flush=True)
-        if any(os.path.exists(os.path.join(args.hf, f))
-               for f in ('tokenizer.json', 'tokenizer_config.json',
-                         'tokenizer.model')):
-            tokenizer_dir = args.hf
-    else:
-        model, vocab_size, _ = _build_model(args.model,
-                                            args.max_total_len,
-                                            remat=False)
-    # Speculative decoding writes its verify chunk up to K tokens past
-    # the last kept one; fail fast / clamp at STARTUP instead of
-    # erroring inside every request handler
-    # (models/generate.py make_speculative_generate_fn asserts
-    # max_total_len + K <= model.config.max_seq_len).
-    spec_total = args.max_total_len
-    if args.speculative > 0:
-        spec_total = min(args.max_total_len,
-                         model.config.max_seq_len - args.speculative)
-        if spec_total <= 1:
-            parser.error(
-                f'--speculative {args.speculative} needs headroom in '
-                f'the model context: max_seq_len='
-                f'{model.config.max_seq_len} leaves no room for the '
-                f'verify chunk. Use a smaller K or a longer-context '
-                f'model.')
-        if spec_total < args.max_total_len:
-            print(f'speculative decoding: clamping max_total_len '
-                  f'{args.max_total_len} -> {spec_total} (verify chunk '
-                  f'needs K={args.speculative} tokens of headroom '
-                  f'below max_seq_len={model.config.max_seq_len})',
-                  flush=True)
-    if hf_params is not None:
-        params = hf_params
-    else:
-        serve_cast = None  # init params stay f32 masters
-        params = nn.meta.unbox(model.init(
-            jax.random.PRNGKey(0),
-            jnp.ones((1, 8), jnp.int32))['params'])
-    # ONE placement block for both param sources: TP-shard over the
-    # mesh (per-leaf cast, shard-only transfers) or single-device.
-    if args.tensor > 1:
-        from skypilot_tpu.parallel import mesh as mesh_lib
-        from skypilot_tpu.parallel.serving import shard_params_for_serving
-        mesh = mesh_lib.make_mesh(
-            mesh_lib.MeshConfig(tensor=args.tensor),
-            devices=jax.devices()[:args.tensor])
-        params = shard_params_for_serving(model, params, mesh,
-                                          dtype=serve_cast)
-        print(f'tensor-parallel serving over {args.tensor} devices',
-              flush=True)
-    elif serve_cast is not None:
-        import numpy as _np
-        params = jax.tree.map(
-            lambda x: jnp.asarray(_np.asarray(x).astype(serve_cast)),
-            params)
-    if args.ckpt_dir:
-        from skypilot_tpu.parallel.checkpoints import CheckpointManager
-        mgr = CheckpointManager(args.ckpt_dir)
-        if mgr.latest_step() is not None:
-            from skypilot_tpu.parallel.train import TrainState
-            import optax
-            template = TrainState.create(params, optax.sgd(1e-3))
-            params = mgr.restore(template).params
-            print(f'loaded checkpoint step {mgr.latest_step()}', flush=True)
-
-    # Tokenizer, loaded lazily on the first /generate_text request.
-    tok_holder: Dict[str, object] = {}
-    tok_lock = threading.Lock()
-
-    def get_tokenizer():
-        with tok_lock:
-            if 'tok' not in tok_holder:
-                if tokenizer_dir is None:
-                    raise ValueError(
-                        'no tokenizer available: /generate_text needs '
-                        'a --hf checkpoint with tokenizer files; use '
-                        '/generate with token ids instead')
-                from skypilot_tpu.models.hf_import import load_tokenizer
-                tok_holder['tok'] = load_tokenizer(tokenizer_dir)
-            return tok_holder['tok']
-
-    # The engine serves every request class at ONE capacity: the
-    # speculative-clamped total when speculation is on (spec rounds
-    # drive greedy AND sampled slots in the same verify chunk).
-    engine_total = spec_total if args.speculative > 0 \
-        else args.max_total_len
-    engine = None
-    if args.continuous_batching:
-        from skypilot_tpu.models.batching import ContinuousBatchingEngine
-        engine = ContinuousBatchingEngine(
-            model, params, num_slots=args.num_slots,
-            max_total_len=engine_total,
-            prefix_caching=not args.no_prefix_caching,
-            speculative_k=args.speculative)
-
-    # One jitted fn per (batch, temperature, total-length) bucket.
-    fns: Dict[Tuple[int, float, int], object] = {}
-    lock = threading.Lock()
-
-    def get_fn(batch: int, temperature: float, total: int = 0):
-        """One jitted fn per (batch, temperature, total-length) bucket.
-        `total` defaults to the engine's full capacity; /generate_text
-        passes a smaller bucket so a 4-token completion does not pay
-        for a full-buffer decode scan."""
-        if total <= 0:
-            total = (spec_total
-                     if args.speculative > 0 and temperature == 0.0
-                     else args.max_total_len)
-        key = (batch, temperature, total)
-        with lock:
-            if key not in fns:
-                if args.speculative > 0 and temperature == 0.0:
-                    fns[key] = gen.make_speculative_generate_fn(
-                        model, total, draft_k=args.speculative)
-                else:
-                    fns[key] = gen.make_generate_fn(
-                        model, total, temperature=temperature)
-            return fns[key]
-
-    rng_holder = {'rng': jax.random.PRNGKey(0)}
-    # Live POSTs (graceful drain waits on this, covering the window
-    # between accept and engine submit and the one-shot engine).
-    _inflight = {'n': 0}
-    _inflight_lock = threading.Lock()
-
-    class Handler(BaseHTTPRequestHandler):
-
-        def log_message(self, *a):  # quiet
-            pass
-
-        def _json(self, obj, code=200):
-            body = json.dumps(obj).encode()
-            self.send_response(code)
-            self.send_header('Content-Type', 'application/json')
-            self.send_header('Content-Length', str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
-
-        def do_GET(self):  # noqa: N802
-            if self.path in ('/stats', '/v1/stats'):
-                self._stats()
-                return
-            # Advertise the MINIMUM capacity across request classes
-            # (greedy requests run through the speculative engine at
-            # spec_total; sampled ones at max_total_len) — clients
-            # sizing prompts off this can never be rejected.
-            self._json({'status': 'ok',
-                        'model': (f'hf:{os.path.basename(args.hf)}'
-                                  if args.hf else args.model),
-                        'vocab_size': vocab_size,
-                        'max_total_len': spec_total
-                        if args.speculative > 0 else args.max_total_len})
-
-        def _stats(self):
-            """Engine observability (the vLLM /metrics idea, JSON):
-            slot occupancy, page pool, prefix-cache hit rate, and
-            speculation quality (tokens committed per model call)."""
-            if engine is None:
-                self._json({'engine': 'simple'})
-                return
-            body = {
-                'engine': 'continuous',
-                'num_slots': engine.num_slots,
-                'active_slots': int(engine.active.sum()),
-                'queued': engine._queue.qsize() + len(engine._ready),
-                'decode_calls': engine.decode_calls,
-                'tokens_committed': engine.tokens_committed,
-                'tokens_per_call': round(
-                    engine.tokens_committed /
-                    max(engine.decode_calls, 1), 3),
-                'speculative_k': engine.spec_k,
-            }
-            if engine.paged:
-                body['page_pool'] = {
-                    'total': engine.total_pages,
-                    'free': engine.allocator.free_pages,
-                }
-                if engine.prefix_cache is not None:
-                    pc = engine.prefix_cache
-                    body['prefix_cache'] = {
-                        'hits': pc.hits,
-                        'misses': pc.misses,
-                        'hit_rate': round(
-                            pc.hits / max(pc.hits + pc.misses, 1), 3),
-                        'resident_unreferenced': len(pc.lru),
-                    }
-            self._json(body)
-
-        def do_POST(self):  # noqa: N802
-            with _inflight_lock:
-                _inflight['n'] += 1
-            try:
-                self._do_post()
-            finally:
-                with _inflight_lock:
-                    _inflight['n'] -= 1
-
-        def _do_post(self):
-            if self.path == '/v1/completions':
-                self._openai_completions()
-                return
-            if self.path == '/v1/chat/completions':
-                self._openai_chat()
-                return
-            if self.path in ('/generate_text', '/v1/generate_text'):
-                self._generate_text()
-                return
-            if self.path not in ('/generate', '/v1/generate'):
-                self._json({'error': 'POST /generate, /generate_text, '
-                                     'or /v1/completions'}, 404)
-                return
-            try:
-                length = int(self.headers.get('Content-Length', 0))
-                req = json.loads(self.rfile.read(length))
-                tokens = req['tokens']
-                temperature = float(req.get('temperature', 0.0))
-                top_k = int(req.get('top_k', 0))
-                top_p = float(req.get('top_p', 1.0))
-                stop_ids = [int(t) for t in
-                            req.get('stop_token_ids', [])]
-                if engine is not None:
-                    # Ragged rows welcome: each joins the shared decode
-                    # loop independently, honoring its temperature.
-                    max_new = int(req.get('max_new_tokens',
-                                          engine_total))
-                    for row in tokens:
-                        if len(row) >= engine_total:
-                            raise ValueError(
-                                f'prompt len {len(row)} >= max_total_len '
-                                f'{engine_total}')
-                    futs = [engine.submit([int(t) for t in row],
-                                          max_new_tokens=max_new,
-                                          temperature=temperature,
-                                          top_k=top_k, top_p=top_p,
-                                          stop_token_ids=stop_ids)
-                            for row in tokens]
-                    self._json({'tokens':
-                                [f.result(timeout=600) for f in futs]})
-                    return
-                prompt = jnp.asarray(tokens, jnp.int32)
-                if prompt.ndim != 2:
-                    raise ValueError('tokens must be [batch, prompt_len]')
-                # The speculative engine serves greedy requests with a
-                # clamped total length; validate against what will
-                # actually run, not the CLI flag.
-                limit = (spec_total
-                         if args.speculative > 0 and temperature == 0.0
-                         else args.max_total_len)
-                if prompt.shape[1] >= limit:
-                    raise ValueError(
-                        f'prompt len {prompt.shape[1]} >= max_total_len '
-                        f'{limit}')
-                fn = get_fn(prompt.shape[0], temperature)
-                with lock:
-                    rng_holder['rng'], sub = jax.random.split(
-                        rng_holder['rng'])
-                out = fn(params, prompt, sub)
-                self._json({'tokens': jax.device_get(out).tolist()})
-            except Exception as e:  # pylint: disable=broad-except
-                self._json({'error': f'{type(e).__name__}: {e}'}, 400)
-
-        def _openai_chat(self):
-            """OpenAI chat completions: renders `messages` through the
-            tokenizer's chat template when the checkpoint ships one,
-            else a plain `role: content` fallback template, then runs
-            the completions path and wraps the answer as an assistant
-            message."""
-            try:
-                tok = get_tokenizer()
-                length = int(self.headers.get('Content-Length', 0))
-                req = json.loads(self.rfile.read(length))
-                messages = req['messages']
-                try:
-                    prompt = tok.apply_chat_template(
-                        messages, tokenize=False,
-                        add_generation_prompt=True)
-                except Exception:  # pylint: disable=broad-except
-                    # No template in the checkpoint: a transparent
-                    # fallback beats a 400 for base models.
-                    prompt = '\n'.join(
-                        f"{m['role']}: {m['content']}"
-                        for m in messages) + '\nassistant:'
-                out = self._complete(
-                    prompts=[prompt],
-                    max_new=int(req.get('max_tokens', 16)),
-                    temperature=float(req.get('temperature', 1.0)),
-                    top_p=float(req.get('top_p', 1.0)),
-                    stop_strings=req.get('stop') or [],
-                    n=int(req.get('n', 1)),
-                    stream=bool(req.get('stream')))
-                out['object'] = 'chat.completion'
-                for c in out['choices']:
-                    c['message'] = {'role': 'assistant',
-                                    'content': c.pop('text')}
-                self._json(out)
-            except Exception as e:  # pylint: disable=broad-except
-                self._json({'error': {
-                    'message': f'{type(e).__name__}: {e}',
-                    'type': 'invalid_request_error'}}, 400)
-
-        def _complete(self, prompts, max_new, temperature, top_p,
-                      stop_strings, n, stream):
-            """Shared body of the OpenAI shims: run the prompts,
-            return the completions-shaped response dict."""
-            tok = get_tokenizer()
-            if n != 1:
-                raise ValueError('n > 1 is not supported')
-            if stream:
-                raise ValueError('stream=true is not supported')
-            if isinstance(stop_strings, str):
-                stop_strings = [stop_strings]
-            encoded = [tok(p)['input_ids'] for p in prompts]
-            limit = (engine_total if engine is not None
-                     else args.max_total_len)
-            for ids in encoded:
-                if len(ids) >= limit:
-                    raise ValueError(
-                        f'prompt tokenizes to {len(ids)} >= '
-                        f'max_total_len {limit}')
-            rows = []
-            if engine is not None:
-                futs = [engine.submit(ids, max_new_tokens=max_new,
-                                      temperature=temperature,
-                                      top_p=top_p)
-                        for ids in encoded]
-                rows = [f.result(timeout=600) for f in futs]
-            else:
-                for ids in encoded:
-                    want = len(ids) + max_new
-                    bucket = 8
-                    while bucket < want:
-                        bucket *= 2
-                    bucket = min(bucket, limit)
-                    fn = get_fn(1, temperature, bucket)
-                    with lock:
-                        rng_holder['rng'], sub = jax.random.split(
-                            rng_holder['rng'])
-                    out = fn(params,
-                             jnp.asarray([ids], jnp.int32), sub)
-                    rows.append(jax.device_get(out)[0]
-                                [:min(want, bucket)].tolist())
-            choices = []
-            total_completion = 0
-            for i, (ids, row) in enumerate(zip(encoded, rows)):
-                text = tok.decode(row[len(ids):],
-                                  skip_special_tokens=True)
-                finish = ('length' if len(row) - len(ids) >= max_new
-                          else 'stop')
-                for ss in stop_strings:
-                    cut = text.find(ss)
-                    if cut != -1:
-                        text = text[:cut]
-                        finish = 'stop'
-                total_completion += len(row) - len(ids)
-                choices.append({'index': i, 'text': text,
-                                'finish_reason': finish,
-                                'logprobs': None})
-            total_prompt = sum(len(ids) for ids in encoded)
-            return {
-                'object': 'text_completion',
-                'model': (f'hf:{os.path.basename(args.hf)}'
-                          if args.hf else args.model),
-                'choices': choices,
-                'usage': {
-                    'prompt_tokens': total_prompt,
-                    'completion_tokens': total_completion,
-                    'total_tokens': total_prompt + total_completion,
-                },
-            }
-
-        def _openai_completions(self):
-            """OpenAI-compatible completions shim: the de-facto
-            client contract (the reference's llm/ recipes serve vLLM,
-            whose clients speak this). Maps prompt/max_tokens/
-            temperature/top_p/stop onto the engine and returns the
-            OpenAI response shape (choices/usage). Requires tokenizer
-            files (--hf with a full checkpoint repo)."""
-            try:
-                length = int(self.headers.get('Content-Length', 0))
-                req = json.loads(self.rfile.read(length))
-                prompts = req.get('prompt', '')
-                if isinstance(prompts, str):
-                    prompts = [prompts]
-                self._json(self._complete(
-                    prompts=prompts,
-                    max_new=int(req.get('max_tokens', 16)),
-                    temperature=float(req.get('temperature', 1.0)),
-                    top_p=float(req.get('top_p', 1.0)),
-                    stop_strings=req.get('stop') or [],
-                    n=int(req.get('n', 1)),
-                    stream=bool(req.get('stream'))))
-            except Exception as e:  # pylint: disable=broad-except
-                self._json({'error': {
-                    'message': f'{type(e).__name__}: {e}',
-                    'type': 'invalid_request_error'}}, 400)
-
-        def _generate_text(self):
-            """Text in / text out, via the --hf checkpoint's tokenizer:
-            {"prompts": ["..."], "max_new_tokens": N, "temperature": t}
-            -> {"texts": ["..."]}. Each prompt runs independently
-            (continuous-batching engine when enabled, else batch-1
-            one-shot calls)."""
-            try:
-                tok = get_tokenizer()
-                length = int(self.headers.get('Content-Length', 0))
-                req = json.loads(self.rfile.read(length))
-                prompts = req['prompts']
-                if isinstance(prompts, str):
-                    prompts = [prompts]
-                temperature = float(req.get('temperature', 0.0))
-                top_k = int(req.get('top_k', 0))
-                top_p = float(req.get('top_p', 1.0))
-                stop_strings = req.get('stop') or []
-                if isinstance(stop_strings, str):
-                    stop_strings = [stop_strings]
-                max_new = int(req.get('max_new_tokens', 64))
-                encoded = [tok(p)['input_ids'] for p in prompts]
-                limit = (engine_total if engine is not None else
-                         (spec_total
-                          if args.speculative > 0 and temperature == 0.0
-                          else args.max_total_len))
-                for ids in encoded:
-                    if len(ids) >= limit:
-                        raise ValueError(
-                            f'prompt tokenizes to {len(ids)} >= '
-                            f'max_total_len {limit}')
-                if engine is not None:
-                    futs = [engine.submit(ids, max_new_tokens=max_new,
-                                          temperature=temperature,
-                                          top_k=top_k, top_p=top_p)
-                            for ids in encoded]
-                    rows = [f.result(timeout=600) for f in futs]
-                else:
-                    rows = []
-                    for ids in encoded:
-                        # Power-of-two total-length bucket: a 4-token
-                        # completion must not pay a full-buffer decode
-                        # scan; bounded bucket count limits recompiles.
-                        want = len(ids) + max_new
-                        bucket = 8
-                        while bucket < want:
-                            bucket *= 2
-                        bucket = min(bucket, limit)
-                        fn = get_fn(1, temperature, bucket)
-                        with lock:
-                            rng_holder['rng'], sub = jax.random.split(
-                                rng_holder['rng'])
-                        out = fn(params,
-                                 jnp.asarray([ids], jnp.int32), sub)
-                        stop = min(want, bucket)
-                        rows.append(jax.device_get(out)[0][:stop]
-                                    .tolist())
-                texts = [tok.decode(row[len(ids):],
-                                    skip_special_tokens=True)
-                         for ids, row in zip(encoded, rows)]
-                if stop_strings:
-                    # Trim each completion at the FIRST occurrence of
-                    # any stop string (the string itself excluded —
-                    # the OpenAI-style `stop` contract).
-                    def trim(text):
-                        cut = len(text)
-                        for ss in stop_strings:
-                            i = text.find(ss)
-                            if i != -1:
-                                cut = min(cut, i)
-                        return text[:cut]
-                    texts = [trim(t) for t in texts]
-                self._json({'texts': texts})
-            except Exception as e:  # pylint: disable=broad-except
-                self._json({'error': f'{type(e).__name__}: {e}'}, 400)
-
-    server = ThreadingHTTPServer(('0.0.0.0', args.port), Handler)
-
-    _term = threading.Event()
-
-    def _drain_loop():
-        """Graceful drain on SIGTERM (rolling updates / replica
-        replacement): let the accept loop pick up stragglers briefly,
-        stop accepting, wait for in-flight POSTs (bounded), exit 0 —
-        a mid-generation client must not see a reset because the
-        controller culled this replica. All work happens on this
-        pre-started thread; the signal handler only sets an event
-        (anything heavier in the signal frame proved crash-prone
-        against the XLA runtime's own thread machinery)."""
-        _term.wait()
-        print('serve_lm: SIGTERM — draining in-flight requests',
-              flush=True)
-        time.sleep(0.5)     # stragglers: normal accept loop gets them
-        server.shutdown()   # stops accepting; handlers keep running
-        deadline = time.time() + 60
-        while time.time() < deadline:
-            with _inflight_lock:
-                if _inflight['n'] == 0:
-                    break
-            time.sleep(0.2)
-        if engine is not None:
-            engine.stop()
-        os._exit(0)
-
-    import signal
-    import time
-    threading.Thread(target=_drain_loop, daemon=True).start()
-    signal.signal(signal.SIGTERM, lambda *_: _term.set())
-    print(f'serve_lm listening on :{args.port} model={args.model}',
-          flush=True)
-    server.serve_forever()
+    from skypilot_tpu.inference.http_server import serve
+    from skypilot_tpu.inference.runtime import build_runtime
+    serve(build_runtime(args), args.port,
+          drain_grace=args.drain_grace)
 
 
 if __name__ == '__main__':

@@ -333,6 +333,10 @@ class InstanceAwareRequestRateAutoscaler(SpotRequestRateAutoscaler):
         elif qps >= total_cap:
             overflow = qps - total_cap
             desired = len(caps) + math.ceil(overflow / max_cap)
+        elif qps <= 0:
+            # Idle: honor min_replicas=0 scale-to-zero like the scalar
+            # RequestRateAutoscaler's ceil(0/x) == 0 path.
+            desired = 0
         else:
             desired = 0
             covered = 0.0

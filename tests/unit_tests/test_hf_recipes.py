@@ -34,10 +34,14 @@ def hf_ckpt(tmp_path_factory):
         tie_word_embeddings=False)
     transformers.LlamaForCausalLM(cfg).eval().save_pretrained(
         path, safe_serialization=True)
-    # A real (fast) tokenizer with ids inside the model vocab.
+    # A real (fast) tokenizer covering the FULL model vocab: the
+    # randomly-initialized checkpoint can emit any id, and an id
+    # outside the tokenizer vocab decodes to '' (which would make
+    # text-streaming assertions vacuous/flaky).
     from tokenizers import Tokenizer, models, pre_tokenizers
     vocab = {'<unk>': 0, 'hello': 1, 'world': 2, 'the': 3, 'tpu': 4,
              'flies': 5, 'fast': 6, '.': 7}
+    vocab.update({f'w{i}': i for i in range(8, 128)})
     tok = Tokenizer(models.WordLevel(vocab, unk_token='<unk>'))
     tok.pre_tokenizer = pre_tokenizers.Whitespace()
     fast = transformers.PreTrainedTokenizerFast(
@@ -115,11 +119,19 @@ def test_serve_lm_hf_checkpoint(hf_ckpt):
             stopped = _post(f'http://127.0.0.1:{port}/v1/completions',
                             {**body, 'stop': [words[1]]})
             assert words[1] not in stopped['choices'][0]['text']
+        # n>1 fan-out: n greedy samples are distinct choices with
+        # correct indices (identical text — greedy by definition).
+        multi = _post(f'http://127.0.0.1:{port}/v1/completions',
+                      {**body, 'n': 3})
+        assert [c['index'] for c in multi['choices']] == [0, 1, 2]
+        assert all(c['text'] == choice['text']
+                   for c in multi['choices'])
+        assert multi['usage']['completion_tokens'] == 12
         from urllib.error import HTTPError
         try:
             _post(f'http://127.0.0.1:{port}/v1/completions',
-                  {**body, 'stream': True})
-            raise AssertionError('stream=true must 400')
+                  {**body, 'n': 99})
+            raise AssertionError('n=99 must 400')
         except HTTPError as e:
             assert e.code == 400
 
@@ -136,6 +148,136 @@ def test_serve_lm_hf_checkpoint(hf_ckpt):
         assert msg['role'] == 'assistant'
         assert isinstance(msg['content'], str)
         assert out['usage']['completion_tokens'] == 4
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def _post_sse(url, payload, timeout=300):
+    """POST expecting an SSE response; returns (events, wall_times)
+    — one wall-clock stamp per data frame, [DONE] excluded from
+    events but stamped."""
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={'Content-Type': 'application/json'})
+    events, times = [], []
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        ctype = resp.headers.get('Content-Type', '')
+        assert ctype.startswith('text/event-stream'), ctype
+        for raw in resp:
+            line = raw.decode().rstrip('\n')
+            if not line.startswith('data: '):
+                continue
+            times.append(time.time())
+            data = line[len('data: '):]
+            if data == '[DONE]':
+                break
+            events.append(json.loads(data))
+    return events, times
+
+
+@pytest.mark.slow
+def test_serve_lm_streaming(hf_ckpt):
+    """SSE streaming: chunks arrive incrementally (first chunk well
+    before completion — the p50-TTFT north-star measured e2e), OpenAI
+    chunk schemas hold for completions and chat, and /stats records
+    TTFT percentiles."""
+    port = _free_port()
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.recipes.serve_lm',
+         '--cpu', '--hf', hf_ckpt, '--max-total-len', '64',
+         '--port', str(port)],
+        cwd=_REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    try:
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            try:
+                urllib.request.urlopen(f'http://127.0.0.1:{port}/',
+                                       timeout=5)
+                break
+            except OSError:
+                assert proc.poll() is None, proc.stdout.read()
+                time.sleep(1.0)
+
+        base = f'http://127.0.0.1:{port}'
+        # Warmup: the first streaming request builds the lazy stream
+        # engine + compiles prefill/decode; timing asserts come after.
+        warm, _ = _post_sse(f'{base}/v1/completions',
+                            {'prompt': 'hello world', 'max_tokens': 4,
+                             'temperature': 0, 'stream': True})
+        assert warm, 'no stream chunks'
+
+        # Completions chunks: OpenAI schema, incremental arrival.
+        t0 = time.time()
+        events, times = _post_sse(
+            f'{base}/v1/completions',
+            {'prompt': 'hello world the tpu', 'max_tokens': 40,
+             'temperature': 0, 'stream': True})
+        text_chunks = [e for e in events
+                       if e['choices'][0]['finish_reason'] is None]
+        finals = [e for e in events
+                  if e['choices'][0]['finish_reason'] is not None]
+        assert text_chunks and len(finals) == 1
+        assert all(e['object'] == 'text_completion' for e in events)
+        assert finals[0]['choices'][0]['finish_reason'] == 'length'
+        # Incrementality: the first chunk lands well before the
+        # stream completes (non-streaming would deliver everything
+        # at completion time).
+        t_first, t_done = times[0] - t0, times[-1] - t0
+        assert t_first < 0.6 * t_done, (t_first, t_done)
+
+        # Streamed text == non-streaming text (same greedy path).
+        whole = _post(f'{base}/v1/completions',
+                      {'prompt': 'hello world the tpu',
+                       'max_tokens': 40, 'temperature': 0})
+        streamed = ''.join(e['choices'][0]['text']
+                           for e in text_chunks)
+        assert streamed == whole['choices'][0]['text']
+
+        # Chat chunks: role delta first, then content deltas.
+        events, _ = _post_sse(
+            f'{base}/v1/chat/completions',
+            {'messages': [{'role': 'user', 'content': 'hello world'}],
+             'max_tokens': 6, 'temperature': 0, 'stream': True})
+        assert events[0]['choices'][0]['delta'] == {'role': 'assistant'}
+        assert all(e['object'] == 'chat.completion.chunk'
+                   for e in events)
+        content = ''.join(
+            e['choices'][0]['delta'].get('content', '')
+            for e in events)
+        assert isinstance(content, str)
+
+        # n>1 streaming: chunks carry choice indices 0 and 1.
+        events, _ = _post_sse(
+            f'{base}/v1/completions',
+            {'prompt': 'hello world', 'max_tokens': 5,
+             'temperature': 0, 'stream': True, 'n': 2})
+        idx = {e['choices'][0]['index'] for e in events}
+        assert idx == {0, 1}
+
+        # Native token-stream endpoint.
+        events, _ = _post_sse(
+            f'{base}/generate',
+            {'tokens': [[1, 2, 3]], 'max_new_tokens': 6,
+             'stream': True})
+        toks = [e['token'] for e in events if 'token' in e]
+        final = [e for e in events if e.get('done')]
+        assert len(toks) == 6 and len(final) == 1
+        assert final[0]['tokens'][0][:3] == [1, 2, 3]
+
+        # Text-stream endpoint: deltas concatenate to the full text.
+        events, _ = _post_sse(
+            f'{base}/generate_text',
+            {'prompts': ['hello world'], 'max_new_tokens': 6,
+             'stream': True})
+        assert all('delta' in e for e in events)
+
+        # TTFT percentiles landed in /stats.
+        with urllib.request.urlopen(f'{base}/stats', timeout=5) as r:
+            stats = json.loads(r.read())
+        assert stats['serving']['ttft_ms_p50'] is not None
+        assert stats['serving']['requests'] >= 6
     finally:
         proc.terminate()
         proc.wait(timeout=10)
@@ -158,14 +300,6 @@ def test_train_lm_init_from_hf(hf_ckpt):
 
 
 @pytest.mark.slow
-@pytest.mark.xfail(
-    strict=False,
-    reason='this container\'s axon-wrapped XLA runtime intermittently '
-           'SIGABRTs in C++ teardown (~1 in 5) when the process '
-           'handles SIGTERM — "FATAL: exception not rethrown" from a '
-           'runtime thread, after the drain has already begun. The '
-           'drain logic itself passes repeatedly; the abort is '
-           'environmental (no such wrapper on real serving hosts).')
 def test_serve_lm_graceful_drain():
     """SIGTERM (rolling update / replica cull) drains: the in-flight
     generation completes and the process exits 0 — no client resets."""

@@ -588,6 +588,48 @@ def test_hosts_markers_are_group_scoped(isolated_state, monkeypatch,
         os.remove(groups.hosts_file_path('g1'))
 
 
+def test_hosts_markers_dotted_group_name(isolated_state, monkeypatch,
+                                         tmp_path):
+    """'.' is legal in group names and a regex wildcard: removing
+    group 'a.b' must not strip group 'aXb''s managed block (the awk
+    marker patterns escape ERE metacharacters)."""
+    from skypilot_tpu.jobs import groups, state
+    for grp, nm, ip in (('a.b', 'actor', '10.0.0.1'),
+                        ('aXb', 'worker', '10.0.0.2')):
+        jid = state.submit_job(nm, {'name': nm}, 'failover', 0, 'u')
+        groups._db().execute(
+            'UPDATE managed_jobs SET job_group=? WHERE job_id=?',
+            (grp, jid))
+        groups.publish_address(jid, ip)
+
+    hosts = tmp_path / 'hosts'
+    hosts.write_text('127.0.0.1 localhost\n')
+    monkeypatch.setenv('SKYPILOT_HOSTS_FILE', str(hosts))
+
+    class FakeRunner:
+        def run(self, cmd, require_outputs=False, **kw):
+            import subprocess
+            p = subprocess.run(['bash', '-c', cmd], capture_output=True,
+                               text=True)
+            return p.returncode, p.stdout, p.stderr
+
+    class FakeHandle:
+        def get_command_runners(self):
+            return [FakeRunner()]
+
+    groups.install_hosts_entries(FakeHandle(), 'aXb')
+    groups.install_hosts_entries(FakeHandle(), 'a.b')
+    content = hosts.read_text()
+    assert 'worker.aXb' in content and 'actor.a.b' in content
+    groups.remove_hosts_entries(FakeHandle(), 'a.b')
+    content = hosts.read_text()
+    assert 'worker.aXb' in content        # aXb untouched
+    assert 'actor.a.b' not in content
+    for g in ('a.b', 'aXb'):
+        if os.path.exists(groups.hosts_file_path(g)):
+            os.remove(groups.hosts_file_path(g))
+
+
 def test_instance_aware_cold_start_from_zero():
     """min_replicas=0 + traffic: the instance-aware scaler must still
     produce a nonzero target with no ready/launching replicas."""
@@ -607,6 +649,26 @@ def test_instance_aware_cold_start_from_zero():
                    ready_capacities=[])
     assert d.operator == AutoscalerDecisionOperator.SCALE_UP
     assert a.target_num_replicas == 2  # ceil(6/4)
+
+
+def test_instance_aware_scales_to_zero_when_idle():
+    """min_replicas=0 + NO traffic: the cover walk must not pin one
+    ready replica alive forever (parity with the scalar scaler's
+    ceil(0/x) == 0 path)."""
+    from skypilot_tpu.serve.autoscalers import (Autoscaler,
+                                                AutoscalerDecisionOperator)
+    from skypilot_tpu.serve.service_spec import SkyServiceSpec
+    spec = SkyServiceSpec(min_replicas=0, max_replicas=5,
+                          target_qps_per_replica={'tpu-v5e-8': 4.0},
+                          upscale_delay_seconds=0,
+                          downscale_delay_seconds=0)
+    a = Autoscaler.make(spec)
+    a.target_num_replicas = 1
+    now = 1000.0  # no requests collected: qps == 0
+    d = a.evaluate(num_ready=1, num_launching=0, now=now,
+                   ready_capacities=[4.0])
+    assert d.operator == AutoscalerDecisionOperator.SCALE_DOWN
+    assert a.target_num_replicas == 0
 
 
 def test_hosts_legacy_unscoped_block_is_migrated(isolated_state,

@@ -158,6 +158,23 @@ def test_filter_logits_topk_topp():
     assert np.isfinite(np.asarray(out)[1]).all()
 
 
+def test_sample_tokens_temperature_before_top_p():
+    """The HF/vLLM/OpenAI order: logits are temperature-scaled FIRST,
+    then the nucleus is computed — low temperature sharpens the
+    distribution, narrowing the kept set. (The reverse order samples
+    from a broader nucleus than requested.)"""
+    from skypilot_tpu.models.generate import sample_tokens
+    # probs ~ [0.5, 0.3, 0.15, 0.05]; at temperature 0.3 the scaled
+    # probs put > 0.6 mass on token 0 alone, so top_p=0.6 keeps ONLY
+    # token 0. Unscaled, the nucleus would keep {0, 1}.
+    logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.15, 0.05]]))
+    temps = jnp.asarray([0.3])
+    out = [int(sample_tokens(jax.random.PRNGKey(s), logits, temps,
+                             jnp.asarray([0]), jnp.asarray([0.6]))[0])
+           for s in range(64)]
+    assert set(out) == {0}, set(out)
+
+
 def test_sample_tokens_default_matches_plain_categorical():
     """top_k=0/top_p=1 consumes the identical rng stream as plain
     categorical — the no-filter path is bit-compatible."""

@@ -1,0 +1,79 @@
+"""Pure-Python streaming helpers: stop-string holdback + metrics.
+
+(The end-to-end SSE path — engine callbacks, chunk schemas, TTFT —
+is exercised in test_hf_recipes.py::test_serve_lm_streaming.)
+"""
+from skypilot_tpu.inference.openai_compat import (StopStringScanner,
+                                                  trim_stops)
+from skypilot_tpu.inference.runtime import ServingMetrics
+
+
+def test_scanner_no_stops_passthrough():
+    s = StopStringScanner([])
+    assert s.push('hello ') == 'hello '
+    assert s.push('world') == 'world'
+    assert not s.hit
+    assert s.flush() == ''
+
+
+def test_scanner_cuts_at_stop():
+    s = StopStringScanner(['END'])
+    assert s.push('abc') == 'abc'
+    assert s.push('dENDxyz') == 'd'
+    assert s.hit
+    assert s.push('more') == ''  # post-stop: nothing
+    assert s.flush() == ''
+
+
+def test_scanner_holds_back_possible_prefix():
+    """Text that might be the start of a stop string is withheld
+    until disambiguated — a client must never see part of a stop."""
+    s = StopStringScanner(['END'])
+    assert s.push('abcE') == 'abc'      # 'E' could start 'END'
+    assert s.push('N') == ''            # 'EN' still ambiguous
+    assert s.push('Dtail') == ''        # 'END' found: cut before it
+    assert s.hit
+
+
+def test_scanner_prefix_resolves_negative():
+    s = StopStringScanner(['END'])
+    assert s.push('abcE') == 'abc'
+    assert s.push('xyz') == 'Exyz'      # 'Ex' != 'EN': release
+    assert not s.hit
+
+
+def test_scanner_stop_split_across_many_pushes():
+    s = StopStringScanner(['<|eot|>'])
+    out = ''
+    for ch in 'hi there<|eot|>IGNORED':
+        out += s.push(ch)
+    assert out == 'hi there'
+    assert s.hit
+
+
+def test_scanner_earliest_of_multiple_stops_wins():
+    s = StopStringScanner(['YY', 'XX'])
+    assert s.push('aXXbYYc') == 'a'
+    assert s.hit
+
+
+def test_trim_stops():
+    assert trim_stops('a b c', []) == ('a b c', False)
+    assert trim_stops('a b c', ['b']) == ('a ', True)
+    assert trim_stops('a b c', ['z']) == ('a b c', False)
+    assert trim_stops('a b c', ['c', 'b']) == ('a ', True)
+
+
+def test_metrics_percentiles():
+    m = ServingMetrics()
+    assert m.snapshot()['ttft_ms_p50'] is None
+    for i in range(100):
+        m.record(latency_s=(i + 1) / 1000.0, n_tokens=10,
+                 ttft_s=(i + 1) / 10000.0)
+    snap = m.snapshot()
+    assert snap['requests'] == 100
+    assert abs(snap['latency_ms_p50'] - 50) <= 2
+    assert abs(snap['latency_ms_p95'] - 95) <= 2
+    assert abs(snap['ttft_ms_p50'] - 5.0) <= 0.3
+    assert snap['completion_tokens_total'] == 1000
+    assert snap['gen_tokens_per_sec'] > 0
