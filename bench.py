@@ -27,7 +27,8 @@ def _smoke_argv(args) -> list:
     # the platform-independent evidence of the multi-step win.
     argv = [sys.executable, os.path.abspath(__file__), '--smoke',
             '--sweep-inner',
-            '--steps', str(args.steps), '--warmup', str(args.warmup)]
+            '--steps', str(args.steps), '--warmup', str(args.warmup),
+            '--repeats', str(args.repeats)]
     if args.batch:
         argv += ['--batch', str(args.batch)]
     if args.seq:
@@ -43,6 +44,13 @@ def main() -> None:
                         help='tiny model + CPU-friendly shapes')
     parser.add_argument('--steps', type=int, default=10)
     parser.add_argument('--warmup', type=int, default=2)
+    parser.add_argument('--repeats', type=int, default=3,
+                        help='timed repeats of the --steps window; the '
+                             'JSON line reports the MEDIAN (and stdev) '
+                             'so a one-off host stall cannot read as a '
+                             'regression — or mask one (the 8% '
+                             'unexplained r03->r04 CPU drift was '
+                             'single-shot noise)')
     parser.add_argument('--batch', type=int, default=0,
                         help='global batch size (0 = auto)')
     parser.add_argument('--seq', type=int, default=0)
@@ -233,10 +241,22 @@ def main() -> None:
                 continue
             raise
 
-    elapsed, state, loss = timed_run(state, step, tokens, args.steps)
-
-    tokens_per_sec = batch * seq * args.steps * inner / elapsed
-    per_chip = tokens_per_sec / n_dev
+    # >=1 timed repeats of the same window: median defeats one-off
+    # host stalls; stdev quantifies whether a cross-round delta is
+    # signal (a 5% regression is only detectable if spread << 5%).
+    import statistics
+    per_chip_runs = []
+    elapsed = None
+    for r in range(max(1, args.repeats)):
+        elapsed, state, loss = timed_run(state, step, tokens,
+                                         args.steps)
+        run_tps = batch * seq * args.steps * inner / elapsed / n_dev
+        per_chip_runs.append(run_tps)
+        print(f'# repeat {r + 1}/{args.repeats}: {run_tps:.1f} '
+              f'tokens/s/chip ({elapsed:.2f}s)', file=sys.stderr)
+    per_chip = statistics.median(per_chip_runs)
+    spread = (statistics.stdev(per_chip_runs)
+              if len(per_chip_runs) > 1 else 0.0)
 
     # Training FLOPs/token: 6*N for the weights plus the attention
     # quadratic term 12 * layers * embed * seq (fwd QK^T+AV and their
@@ -272,6 +292,9 @@ def main() -> None:
         'value': round(per_chip, 1),
         'unit': 'tokens/s/chip',
         'vs_baseline': round(vs_baseline, 3),
+        'median': round(per_chip, 1),
+        'stdev': round(spread, 1),
+        'repeats': len(per_chip_runs),
     }
     # First successful run on each platform becomes the recorded
     # baseline later rounds are scored against (comparisons are
