@@ -15,34 +15,53 @@ tick every stage applies its layers to the microbatch it currently
 holds (bubble ticks process garbage that is masked out of the loss).
 Utilization is M / (M + S - 1) — pick num_microbatches >= 4 * stages.
 
-v1 scope: the GPT, Llama, and Mixtral families (Mixtral's router
-aux loss is accumulated across stages with live-tick masking; its
-batch-mean products make the faithful reference the mean of
-per-microbatch losses), composing with data parallelism (`data`
-axis; batch microbatches are sharded over it).
-tensor/fsdp compose in principle (they shard WITHIN a stage) but are
-not exercised here.
+v2 (closes the v1 composition gaps):
+  - tensor/fsdp/expert COMPOSE WITHIN STAGES: only `stage` and `data`
+    are manual shard_map axes (`axis_names`); the rest stay under
+    GSPMD, so stacked block leaves carry their usual logical-rule
+    shardings (heads/mlp→tensor, embed→fsdp, expert→expert) on their
+    inner dims and XLA inserts the within-stage collectives.
+  - the embedding table and LM head are STAGE-SHARDED over the vocab
+    dim (no longer replicated on every stage — the HBM that matters
+    at 70B scale): embedding is a masked local gather + psum;
+    the head is a vocab-parallel matmul with a psum/pmax logsumexp
+    cross-entropy, which also spreads the head FLOPs across all
+    stages instead of serializing them on the last one.
+  - `num_layers % stages != 0` is allowed: the stack is zero-padded
+    and padded slots are masked to identity in the per-stage scan.
+
+Families: GPT, Llama, Mixtral (Mixtral's router aux loss is
+accumulated across stages with live-tick masking; its batch-mean
+products make the faithful reference the mean of per-microbatch
+losses). Dropout is rejected (blocks run deterministically).
 """
 from __future__ import annotations
 
 import functools
-from typing import Any, Dict, Tuple
+from typing import Any, Callable, Dict, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
 import optax
-from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from skypilot_tpu.parallel.train import TrainState, next_token_loss
+from skypilot_tpu.parallel.train import TrainState
 
 
 def stack_layer_params(params: Dict[str, Any], prefix: str,
-                       num_layers: int) -> Tuple[Any, Dict[str, Any]]:
+                       num_layers: int,
+                       pad_to: int = 0) -> Tuple[Any, Dict[str, Any]]:
     """Split a model's params into (stacked block leaves [L, ...],
-    everything else). The stacked tree's structure is ONE block's."""
+    everything else). The stacked tree's structure is ONE block's.
+    `pad_to > num_layers` zero-pads the stack (padded slots are
+    masked to identity in the pipeline's per-stage scan)."""
     layers = [params[f'{prefix}{i}'] for i in range(num_layers)]
     stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
+    if pad_to > num_layers:
+        pad = pad_to - num_layers
+        stacked = jax.tree.map(
+            lambda x: jnp.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1)),
+            stacked)
     rest = {k: v for k, v in params.items()
             if not (k.startswith(prefix) and
                     k[len(prefix):].isdigit())}
@@ -51,37 +70,113 @@ def stack_layer_params(params: Dict[str, Any], prefix: str,
 
 def unstack_layer_params(stacked: Any, rest: Dict[str, Any],
                          prefix: str, num_layers: int) -> Dict[str, Any]:
-    """Inverse of stack_layer_params (checkpoint interop)."""
+    """Inverse of stack_layer_params (checkpoint interop); ignores
+    padded tail slots."""
     out = dict(rest)
     for i in range(num_layers):
         out[f'{prefix}{i}'] = jax.tree.map(lambda x, i=i: x[i], stacked)
     return out
 
 
-def _family_of(model):
-    """(layer prefix, Block module, embed fn, head-logits fn,
-    block-wants-positions, block-returns-aux) for a supported family.
+def _vp_next_token_loss(local_logits: jax.Array, tokens: jax.Array,
+                        stage: jax.Array, vshard: int,
+                        vocab: int) -> jax.Array:
+    """Vocab-parallel causal LM loss over the `stage` axis.
 
-    Mixtral reuses the Llama embed/head helpers (identical param
-    names/shapes: tok_embed, final_norm, untied lm_head); its blocks
-    additionally return a router aux loss, accumulated across stages
-    with live-tick masking and scaled exactly as the sequential model
-    does (weight * total / num_layers)."""
+    local_logits: [B, S, vshard] — this stage's vocab shard (global
+    column range [stage*vshard, (stage+1)*vshard), columns >= vocab
+    are padding). Mirrors train.next_token_loss numerics: f32
+    logsumexp with global-max subtraction (pmax), target logit via
+    masked local gather + psum."""
+    logits = local_logits[:, :-1].astype(jnp.float32)
+    targets = tokens[:, 1:]
+    off = stage * vshard
+    # Padded vocab columns must not contribute mass.
+    valid = off + jnp.arange(vshard) < vocab
+    logits = jnp.where(valid[None, None, :], logits, -jnp.inf)
+    lid = targets - off
+    ok = jnp.logical_and(lid >= 0, lid < vshard)
+    tl = jnp.take_along_axis(
+        logits, jnp.clip(lid, 0, vshard - 1)[..., None], axis=-1)[..., 0]
+    target_logit = jax.lax.psum(jnp.where(ok, tl, 0.0), 'stage')
+    # Global max: any m makes lse exact; stop_gradient keeps AD on the
+    # softmax path (d lse/d logits = softmax regardless of m).
+    # all_gather + max, not pmax: pmax has no differentiation rule
+    # (even a zero tangent must flow through the primitive).
+    m = jax.lax.stop_gradient(jnp.max(
+        jax.lax.all_gather(jnp.max(logits, axis=-1), 'stage'), axis=0))
+    se = jax.lax.psum(
+        jnp.sum(jnp.exp(logits - m[..., None]), axis=-1), 'stage')
+    lse = m + jnp.log(se)
+    return jnp.mean(lse - target_logit)
+
+
+class _Family(NamedTuple):
+    """Per-model-family pipeline adapter.
+
+    vocab_dims maps rest-leaf name -> the dim carrying the vocab
+    (stage-sharded + padded to stages * vshard). embed_vp returns the
+    (psum-combined) input embedding from the LOCAL vocab shard;
+    head_local returns this stage's [B, S, vshard] logits slice."""
+    prefix: str
+    block: Any
+    takes_positions: bool
+    returns_aux: bool
+    vocab_dims: Dict[str, int]
+    embed_vp: Callable
+    head_local: Callable
+
+
+def _stage_psum(x: jax.Array) -> jax.Array:
+    """psum over `stage`, carried in f32. Every caller has exactly ONE
+    nonzero contributor (masked gather / masked broadcast), so the
+    f32 round-trip is exact for bf16 inputs. Uniform f32 also keeps
+    XLA's all-reduce combiner away from mixed bf16/f32 tuple
+    all-reduces, whose dtype-rewrite pass crashes on CPU."""
+    return jax.lax.psum(x.astype(jnp.float32), 'stage').astype(x.dtype)
+
+
+def _vp_gather(table: jax.Array, tokens: jax.Array, stage: jax.Array,
+               vshard: int) -> jax.Array:
+    """Embedding lookup against this stage's vocab shard: gather the
+    locally-owned rows (others masked to 0) and psum — exactly one
+    stage owns each id, so the sum reassembles the global gather."""
+    lid = tokens - stage * vshard
+    ok = jnp.logical_and(lid >= 0, lid < vshard)
+    x = table[jnp.clip(lid, 0, vshard - 1)]
+    return _stage_psum(jnp.where(ok[..., None], x, 0))
+
+
+def _gpt_embed_vp(rest, tokens, cfg, stage, vshard):
+    x = _vp_gather(rest['wte'].astype(cfg.dtype), tokens, stage, vshard)
+    return x + rest['wpe'].astype(cfg.dtype)[:tokens.shape[1]]
+
+
+def _llama_embed_vp(rest, tokens, cfg, stage, vshard):
+    return _vp_gather(rest['tok_embed'].astype(cfg.dtype), tokens,
+                      stage, vshard)
+
+
+def _family_of(model) -> _Family:
+    # head_local reuses the models' own final_norm_logits helpers
+    # unchanged: the vocab dim is only the einsum OUTPUT dim, so they
+    # work on a local vocab shard as-is — and head/norm changes in the
+    # model files cannot silently diverge from the pipelined path.
     from skypilot_tpu.models import gpt as gpt_lib
     from skypilot_tpu.models import llama as llama_lib
     from skypilot_tpu.models import mixtral as mixtral_lib
     if isinstance(model, gpt_lib.GPT):
-        return ('h_', gpt_lib.Block(model.config),
-                gpt_lib.embed_tokens, gpt_lib.final_norm_logits,
-                False, False)
+        return _Family('h_', gpt_lib.Block(model.config), False, False,
+                       {'wte': 0}, _gpt_embed_vp,
+                       gpt_lib.final_norm_logits)
     if isinstance(model, llama_lib.Llama):
-        return ('layer_', llama_lib.Block(model.config),
-                llama_lib.embed_tokens, llama_lib.final_norm_logits,
-                True, False)
+        return _Family('layer_', llama_lib.Block(model.config), True,
+                       False, {'tok_embed': 0, 'lm_head': 1},
+                       _llama_embed_vp, llama_lib.final_norm_logits)
     if isinstance(model, mixtral_lib.Mixtral):
-        return ('layer_', mixtral_lib.Block(model.config),
-                llama_lib.embed_tokens, llama_lib.final_norm_logits,
-                True, True)
+        return _Family('layer_', mixtral_lib.Block(model.config), True,
+                       True, {'tok_embed': 0, 'lm_head': 1},
+                       _llama_embed_vp, llama_lib.final_norm_logits)
     raise ValueError(
         f'Pipeline parallelism supports the GPT, Llama, and Mixtral '
         f'families; got {type(model).__name__}')
@@ -111,52 +206,120 @@ class PipelinedLM:
         # training needs (activations scale with ticks = M + S - 1
         # otherwise). Equality-tested on, off in test_pipeline.py.
         self.remat_ticks = remat_ticks
-        (self._prefix, self._block, self._embed_fn, self._head_fn,
-         self._block_takes_positions,
-         self._block_returns_aux) = _family_of(model)
-        if self.cfg.num_layers % self.num_stages:
-            raise ValueError(
-                f'num_layers={self.cfg.num_layers} must divide evenly '
-                f'into {self.num_stages} pipeline stages')
+        self.family = _family_of(model)
+        self._prefix = self.family.prefix
         if getattr(self.cfg, 'dropout_rate', 0.0):
             raise ValueError(
-                'PipelinedLM v1 runs blocks deterministically; '
+                'PipelinedLM runs blocks deterministically; '
                 'dropout_rate > 0 would be silently ignored — train '
                 'without dropout or use ShardedTrainer.')
         if getattr(self.cfg, 'remat', False):
             raise ValueError(
-                'PipelinedLM v1 does not rematerialize blocks; set '
-                'remat=False (pipeline microbatching already bounds '
-                'live activations to one microbatch per stage).')
-        self.layers_per_stage = self.cfg.num_layers // self.num_stages
+                'PipelinedLM does not rematerialize blocks; set '
+                'remat=False (per-tick remat already bounds live '
+                'activations — see remat_ticks).')
+        S = self.num_stages
+        # Uneven layer counts pad the stack with masked identity slots
+        # (the padded blocks' zero params stay zero: grads are masked,
+        # so adamw never moves them).
+        self.layers_per_stage = -(-self.cfg.num_layers // S)
+        self.padded_layers = self.layers_per_stage * S
+        # Vocab is stage-sharded for the embedding/head; pad to S.
+        self.vshard = -(-self.cfg.vocab_size // S)
+        self.padded_vocab = self.vshard * S
 
     # -- params -------------------------------------------------------------
+    def _pad_vocab(self, rest: Dict[str, Any]) -> Dict[str, Any]:
+        out = dict(rest)
+        for name, dim in self.family.vocab_dims.items():
+            leaf = out[name]
+            pad = self.padded_vocab - leaf.shape[dim]
+            if pad:
+                widths = [(0, 0)] * leaf.ndim
+                widths[dim] = (0, pad)
+                out[name] = jnp.pad(leaf, widths)
+        return out
+
+    def _unpad_vocab(self, rest: Dict[str, Any]) -> Dict[str, Any]:
+        out = dict(rest)
+        for name, dim in self.family.vocab_dims.items():
+            out[name] = jax.lax.slice_in_dim(
+                out[name], 0, self.cfg.vocab_size, axis=dim)
+        return out
+
     def split_params(self, params: Dict[str, Any]) -> Tuple[Any, Any]:
-        return stack_layer_params(params, self._prefix,
-                                  self.cfg.num_layers)
+        stacked, rest = stack_layer_params(params, self._prefix,
+                                           self.cfg.num_layers,
+                                           pad_to=self.padded_layers)
+        return stacked, self._pad_vocab(rest)
 
     def merge_params(self, stacked: Any, rest: Any) -> Dict[str, Any]:
-        return unstack_layer_params(stacked, rest, self._prefix,
-                                    self.cfg.num_layers)
+        return unstack_layer_params(stacked, self._unpad_vocab(rest),
+                                    self._prefix, self.cfg.num_layers)
+
+    def _rest_specs(self, rest: Dict[str, Any]) -> Dict[str, Any]:
+        """Per-leaf PartitionSpecs for `rest`: vocab-dim leaves shard
+        over `stage`; everything else (norm scales, wpe) replicates."""
+        def spec_for(path, leaf):
+            name = path[0].key if path else None
+            if name in self.family.vocab_dims:
+                dim = self.family.vocab_dims[name]
+                entries = [None] * leaf.ndim
+                entries[dim] = 'stage'
+                return P(*entries)
+            return P()
+
+        return jax.tree_util.tree_map_with_path(spec_for, rest)
+
+    def _block_mesh_specs(self, stacked: Any) -> Any:
+        """Mesh-axis specs for stacked block leaves: 'stage' on the
+        stack dim + the model's own logical rules (heads/mlp→tensor,
+        embed→fsdp, expert→expert) on the inner dims — the
+        within-stage sharding GSPMD executes under the auto axes."""
+        import flax.linen as nn
+        from flax import traverse_util
+        from skypilot_tpu.parallel import mesh as mesh_lib
+        rules = dict(mesh_lib.DEFAULT_RULES)
+
+        abstract = jax.eval_shape(
+            lambda: self.model.init(
+                jax.random.PRNGKey(0),
+                jnp.ones((1, 8), jnp.int32))['params'])
+        logical = nn.get_partition_spec(abstract)
+        block0 = traverse_util.flatten_dict(
+            logical[f'{self._prefix}0'], sep='/')
+
+        def map_axes(spec):
+            entries = []
+            for name in (spec or ()):
+                ax = rules.get(name)
+                axes = ax if isinstance(ax, tuple) else \
+                    (ax,) if ax else ()
+                axes = tuple(a for a in axes
+                             if a in self.mesh.shape and a != 'stage')
+                entries.append(axes if len(axes) > 1 else
+                               (axes[0] if axes else None))
+            return entries
+
+        flat = traverse_util.flatten_dict(stacked, sep='/')
+        out = {k: P('stage', *map_axes(block0.get(k)))
+               for k in flat}
+        return traverse_util.unflatten_dict(out, sep='/')
 
     def param_shardings(self, stacked: Any, rest: Any):
-        """(stacked, rest) NamedShardings: layer dim over `stage`."""
+        """(stacked, rest) NamedShardings: layer dim over `stage` plus
+        logical-rule inner-dim axes; rest vocab leaves over `stage`."""
         s_stage = jax.tree.map(
-            lambda x: NamedSharding(self.mesh,
-                                    P('stage', *([None] * (x.ndim - 1)))),
-            stacked)
+            lambda spec: NamedSharding(self.mesh, spec),
+            self._block_mesh_specs(stacked),
+            is_leaf=lambda x: isinstance(x, P))
         s_rest = jax.tree.map(
-            lambda x: NamedSharding(self.mesh, P()), rest)
+            lambda spec: NamedSharding(self.mesh, spec),
+            self._rest_specs(rest),
+            is_leaf=lambda x: isinstance(x, P))
         return s_stage, s_rest
 
     # -- forward ------------------------------------------------------------
-    def _embed(self, rest: Dict[str, Any], tokens: jax.Array) -> jax.Array:
-        return self._embed_fn(rest, tokens, self.cfg)
-
-    def _head_loss(self, rest: Dict[str, Any], x: jax.Array,
-                   tokens: jax.Array) -> jax.Array:
-        return next_token_loss(self._head_fn(rest, x, self.cfg), tokens)
-
     def loss(self, stacked: Any, rest: Any,
              tokens: jax.Array) -> jax.Array:
         """Mean LM loss over the global batch, pipeline-parallel.
@@ -174,56 +337,62 @@ class PipelinedLM:
         mb = B // (M * d)
         tokens_mb = tokens.reshape(M, d * mb, seq_len)
 
-        block_apply = self._block.apply
-        takes_positions = self._block_takes_positions
-        returns_aux = self._block_returns_aux
-        embed = self._embed
-        head_loss = self._head_loss
+        cfg = self.cfg
+        fam = self.family
+        block_apply = fam.block.apply
+        lps = self.layers_per_stage
+        true_layers = cfg.num_layers
+        vshard = self.vshard
         remat_ticks = self.remat_ticks
-        aux_scale = (self.cfg.router_aux_loss_weight /
-                     self.cfg.num_layers) if returns_aux else 0.0
+        aux_scale = (cfg.router_aux_loss_weight /
+                     cfg.num_layers) if fam.returns_aux else 0.0
 
-        def pipeline(stacked_local, rest_rep, tokens_local):
+        def pipeline(stacked_local, rest_local, tokens_local):
             # stacked_local: [layers_per_stage, ...] (stage shard);
+            # rest_local: vocab leaves are this stage's shard;
             # tokens_local: [M, mb, seq] (data shard).
             stage = jax.lax.axis_index('stage')
 
             def apply_stage(x):
                 aux0 = jnp.zeros((), jnp.float32)
-                if takes_positions:
-                    # Llama/Mixtral blocks take (x, positions); the
-                    # Mixtral block also returns a router aux term.
+                gidx = stage * lps + jnp.arange(lps)
+                if fam.takes_positions:
                     positions = jnp.broadcast_to(
                         jnp.arange(x.shape[1]), x.shape[:2])
 
-                    def one_layer(carry, layer_params):
-                        h, aux = carry
+                def one_layer(carry, xs):
+                    layer_params, li = xs
+                    h, aux = carry
+                    if fam.takes_positions:
                         out = block_apply({'params': layer_params}, h,
                                           positions)
-                        if returns_aux:
-                            h, a = out
-                            return (h, aux + a), None
-                        return (out, aux), None
-                else:
-                    # GPT-family blocks take (x, deterministic).
-                    def one_layer(carry, layer_params):
-                        h, aux = carry
-                        return (block_apply({'params': layer_params}, h,
-                                            True), aux), None
+                    else:
+                        out = block_apply({'params': layer_params}, h,
+                                          True)
+                    if fam.returns_aux:
+                        h2, a = out
+                    else:
+                        h2, a = out, jnp.zeros((), jnp.float32)
+                    # Padded slots are identity (their zero params
+                    # would not be, e.g. biased blocks) and aux-free.
+                    real = li < true_layers
+                    h2 = jnp.where(real, h2, h)
+                    a = jnp.where(real, a, 0.0)
+                    return (h2, aux + a), None
+
                 (x, aux), _ = jax.lax.scan(one_layer, (x, aux0),
-                                           stacked_local)
+                                           (stacked_local, gidx))
                 return x, aux
 
             def tick(carry, t):
                 buf = carry
                 in_idx = jnp.clip(t, 0, M - 1)
-                # cond, not where: only stage 0 pays for the embedding
-                # gather (mirrors the last-stage head cond below).
-                x = jax.lax.cond(
-                    stage == 0,
-                    lambda: embed(rest_rep,
-                                  tokens_local[in_idx]).astype(buf.dtype),
-                    lambda: buf)
+                # Stage-sharded embedding: every stage gathers its
+                # vocab shard and a psum assembles the row (exact —
+                # one shard owns each id). Only stage 0 consumes it.
+                emb = fam.embed_vp(rest_local, tokens_local[in_idx],
+                                   cfg, stage, vshard)
+                x = jnp.where(stage == 0, emb.astype(buf.dtype), buf)
                 y, aux = apply_stage(x)
                 # A stage's tick is LIVE when it holds microbatch
                 # t - stage in [0, M): bubble ticks process garbage
@@ -232,43 +401,49 @@ class PipelinedLM:
                 live = jnp.logical_and(mb_idx >= 0, mb_idx < M)
                 aux = jnp.where(live, aux, 0.0)
                 out_idx = t - (S - 1)
-                is_out = jnp.logical_and(stage == S - 1,
-                                         jnp.logical_and(out_idx >= 0,
-                                                         out_idx < M))
-                # Head+loss only on the LAST stage's live ticks (cond
-                # skips the vocab matmul on every other stage/tick).
-                loss_mb = jax.lax.cond(
-                    is_out,
-                    lambda: head_loss(
-                        rest_rep, y,
-                        tokens_local[jnp.clip(out_idx, 0, M - 1)]),
-                    lambda: jnp.zeros((), jnp.float32))
+                live_out = jnp.logical_and(out_idx >= 0, out_idx < M)
+                # Stage-sharded head: broadcast the last stage's
+                # output (one psum), then every stage computes its
+                # [.., vshard] logits slice — the head matmul runs
+                # S-way parallel instead of serializing on the last
+                # stage. Collectives run every tick (they cannot sit
+                # under a per-stage cond); masking is via `where`.
+                y_last = _stage_psum(
+                    jnp.where(stage == S - 1, y, jnp.zeros_like(y)))
+                local_logits = fam.head_local(rest_local, y_last, cfg)
+                ce = _vp_next_token_loss(
+                    local_logits,
+                    tokens_local[jnp.clip(out_idx, 0, M - 1)],
+                    stage, vshard, cfg.vocab_size)
+                loss_mb = jnp.where(live_out, ce, 0.0)
                 nxt = jax.lax.ppermute(
                     y, 'stage', [(i, (i + 1) % S) for i in range(S)])
                 return nxt, (loss_mb, aux)
 
             buf0 = jnp.zeros((tokens_local.shape[1], seq_len,
-                              self.cfg.embed_dim), self.cfg.dtype)
+                              cfg.embed_dim), cfg.dtype)
             body = (jax.checkpoint(tick, prevent_cse=False)
                     if remat_ticks else tick)
             _, (losses, auxes) = jax.lax.scan(body, buf0,
                                               jnp.arange(M + S - 1))
-            # Only the last stage produced nonzero CE terms; every
-            # stage contributed aux for its own layers' live ticks.
-            # psum broadcasts the sums, pmean averages data shards.
+            # The CE terms are already psum-combined (identical on
+            # every stage); aux is per-stage and must be summed.
             # Aux scaling matches the sequential model exactly
             # (weight * total_layers_aux / num_layers, averaged over
             # the M microbatches).
-            total = jax.lax.psum(jnp.sum(losses), 'stage')
+            total = jnp.sum(losses)
             total = total + aux_scale * jax.lax.psum(jnp.sum(auxes),
                                                      'stage')
             return jax.lax.pmean(total / M, 'data')
 
-        fn = shard_map(
+        fn = jax.shard_map(
             pipeline, mesh=self.mesh,
-            in_specs=(P('stage'), P(), P(None, 'data', None)),
+            in_specs=(jax.tree.map(lambda _: P('stage'), stacked),
+                      self._rest_specs(rest),
+                      P(None, 'data', None)),
             out_specs=P(),
-            check_rep=False)
+            axis_names={'stage', 'data'},
+            check_vma=False)
         # jit (inlined when already inside a jit): jax.checkpoint in
         # the tick body cannot be evaluated under an EAGER shard_map.
         return jax.jit(fn)(stacked, rest, tokens_mb)
@@ -277,7 +452,8 @@ class PipelinedLM:
     def init(self, rng: jax.Array, example: jax.Array,
              tx: optax.GradientTransformation) -> TrainState:
         """TrainState whose params are the (stacked, rest) pair, laid
-        out with stage-sharded block leaves."""
+        out with stage-sharded block leaves (+ logical-rule inner-dim
+        shardings) and stage-sharded vocab tables."""
         import flax.linen as nn
 
         def _init():
