@@ -15,6 +15,16 @@ polls:
   controllers that die mid-flight are respawned within one tick.
 - **request GC**: terminal request rows + their log files are dropped
   after a retention window, bounding requests.db and the log dir.
+- **stale-request requeue**: requests claimed by a replica that
+  stopped heartbeating go back to PENDING for a live replica.
+
+Multi-replica: the jobs are LEADER-ONLY, gated by an advisory lock
+(Postgres pg_try_advisory_lock across hosts; flock on the single-host
+sqlite deployment) — two replicas must not double-reconcile clusters
+or double-GC. A non-leader keeps retrying acquisition each poll, so
+leadership fails over within one tick of the leader dying (both lock
+flavors release on process exit). Beats the reference's
+charts/skypilot/values.yaml:22-23 "replicas > 1 is not well tested".
 """
 from __future__ import annotations
 
@@ -28,6 +38,7 @@ DEFAULT_STATUS_INTERVAL = 300.0
 DEFAULT_LIVENESS_INTERVAL = 120.0
 DEFAULT_GC_INTERVAL = 3600.0
 DEFAULT_REQUEST_RETENTION = 3 * 24 * 3600.0
+DEFAULT_STALE_REQUEUE_INTERVAL = 15.0
 
 
 def _refresh_cluster_status() -> None:
@@ -51,11 +62,17 @@ class ServerDaemons:
                  liveness_interval: float = DEFAULT_LIVENESS_INTERVAL,
                  gc_interval: float = DEFAULT_GC_INTERVAL,
                  request_retention: float = DEFAULT_REQUEST_RETENTION,
-                 poll: float = 1.0) -> None:
+                 stale_requeue_interval: float =
+                 DEFAULT_STALE_REQUEUE_INTERVAL,
+                 poll: float = 1.0,
+                 leader_lock=None) -> None:
         from skypilot_tpu.server.requests import executor
         self._poll = poll
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # Leader election across API-server replicas: only the lock
+        # holder runs the jobs. None (tests/legacy) = always leader.
+        self._leader_lock = leader_lock
         # [name, interval, fn, next_due] (mutable: next_due advances).
         # First run happens one full interval after start — startup
         # already did a reconcile pass. An interval <= 0 disables that
@@ -69,8 +86,17 @@ class ServerDaemons:
             ['request-gc', gc_interval,
              lambda: executor.gc_requests(request_retention),
              now + gc_interval],
+            ['stale-request-requeue', stale_requeue_interval,
+             executor.requeue_stale_requests,
+             now + stale_requeue_interval],
         ]
         self._jobs = [j for j in self._jobs if j[1] > 0]
+
+    @property
+    def is_leader(self) -> bool:
+        if self._leader_lock is None:
+            return True
+        return self._leader_lock.try_acquire()
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._loop,
@@ -97,6 +123,21 @@ class ServerDaemons:
 
     def _loop(self) -> None:
         while not self._stop.wait(self._poll):
+            try:
+                leader = self.is_leader
+            except Exception as e:  # pylint: disable=broad-except
+                # A leadership-check failure (DB outage) must not kill
+                # the maintenance thread; treat as not-leader.
+                ux_utils.log(f'daemon leader check failed: {e!r}')
+                leader = False
+            if not leader:
+                # Keep next_dues advancing so a fresh leader does not
+                # immediately fire every job at once.
+                now = time.monotonic()
+                for job in self._jobs:
+                    if now >= job[3]:
+                        job[3] = now + job[1]
+                continue
             now = time.monotonic()
             for job in self._jobs:
                 if now >= job[3]:

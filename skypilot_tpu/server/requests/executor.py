@@ -45,12 +45,43 @@ CREATE TABLE IF NOT EXISTS requests (
     error TEXT,
     log_path TEXT,
     user TEXT,
-    schedule_type TEXT
+    schedule_type TEXT,
+    server_id TEXT
+);
+CREATE TABLE IF NOT EXISTS server_heartbeats (
+    server_id TEXT PRIMARY KEY,
+    last_seen REAL
 );
 """
 
-# queue name -> max concurrent request processes
+# queue name -> max concurrent request processes (per server replica)
 _CONCURRENCY = {'long': 4, 'short': 16}
+
+# Multi-replica liveness: each server's worker loop heartbeats; the
+# leader's stale sweep re-queues requests claimed by servers that
+# stopped heartbeating (crashed replica -> another replica reruns the
+# request; entrypoints are idempotent by construction — launches go
+# through the failover provisioner, schedule_request dedups by id).
+HEARTBEAT_INTERVAL = 5.0
+DEFAULT_STALE_AFTER = 30.0
+
+_SERVER_ID = os.environ.get('SKYPILOT_API_SERVER_ID')
+
+
+def set_server_id(server_id: str) -> None:
+    """Identity of this API-server replica (host:port by default,
+    set at server startup). Scopes restart recovery to our own rows
+    and lets peers attribute ours to us."""
+    global _SERVER_ID
+    if not os.environ.get('SKYPILOT_API_SERVER_ID'):
+        _SERVER_ID = server_id
+
+
+def get_server_id() -> str:
+    if _SERVER_ID:
+        return _SERVER_ID
+    import socket
+    return socket.gethostname()
 
 
 class RequestStatus(enum.Enum):
@@ -67,7 +98,9 @@ class RequestStatus(enum.Enum):
 
 @functools.lru_cache(maxsize=None)
 def _db_for(path: str) -> db_utils.SQLiteDB:
-    return db_utils.open_db(path, _CREATE_SQL)
+    db = db_utils.open_db(path, _CREATE_SQL)
+    db.add_column_if_missing('requests', 'server_id', 'TEXT')
+    return db
 
 
 def _db() -> db_utils.SQLiteDB:
@@ -128,7 +161,7 @@ def list_requests(limit: int = 100) -> List[Dict[str, Any]]:
 
 
 def cancel_request(request_id: str) -> bool:
-    row = _db().query_one('SELECT pid, status FROM requests '
+    row = _db().query_one('SELECT pid, status, server_id FROM requests '
                           'WHERE request_id=?', (request_id,))
     if row is None:
         raise exceptions.RequestNotFoundError(request_id)
@@ -136,9 +169,46 @@ def cancel_request(request_id: str) -> bool:
     if status.is_terminal():
         return False
     _set_status(request_id, RequestStatus.CANCELLED)
-    if row['pid'] and row['pid'] > 0:
+    # Kill only a process WE own: a replica-local pid belonging to a
+    # peer server is someone else's process. The owning replica's
+    # worker loop notices the CANCELLED status and kills its own tree.
+    if row['pid'] and row['pid'] > 0 and \
+            row.get('server_id') in (None, get_server_id()):
         subprocess_utils.kill_process_tree(row['pid'])
     return True
+
+
+def requeue_stale_requests(stale_after: Optional[float] = None) -> int:
+    """Re-queue RUNNING requests claimed by replicas that stopped
+    heartbeating (crashed/partitioned server): back to PENDING so a
+    live replica reruns them — at-least-once semantics; entrypoints
+    are idempotent (launches ride the failover provisioner, and
+    schedule_request dedups on request_id). Leader-only daemon job."""
+    if stale_after is None:
+        stale_after = float(os.environ.get('SKYPILOT_STALE_AFTER',
+                                           DEFAULT_STALE_AFTER))
+    now = time.time()
+    # Heartbeat rows of long-dead replicas are useless after every
+    # stale judgment that could involve them; without GC the table
+    # grows one row per pod restart forever.
+    _db().execute('DELETE FROM server_heartbeats WHERE last_seen < ?',
+                  (now - max(10 * stale_after, 3600.0),))
+    live = {r['server_id'] for r in _db().query(
+        'SELECT server_id FROM server_heartbeats WHERE last_seen > ?',
+        (now - stale_after,))}
+    rows = _db().query(
+        'SELECT request_id, server_id FROM requests WHERE status=? '
+        'AND server_id IS NOT NULL', (RequestStatus.RUNNING.value,))
+    n = 0
+    for row in rows:
+        if row['server_id'] in live:
+            continue
+        n += _db().execute_rowcount(
+            'UPDATE requests SET status=?, server_id=NULL, pid=-1 '
+            'WHERE request_id=? AND status=? AND server_id=?',
+            (RequestStatus.PENDING.value, row['request_id'],
+             RequestStatus.RUNNING.value, row['server_id']))
+    return n
 
 
 def gc_requests(retention_seconds: float) -> int:
@@ -236,13 +306,35 @@ class RequestWorkerLoop:
         self._running: Dict[str, multiprocessing.Process] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._last_heartbeat = 0.0
+
+    def _heartbeat(self) -> None:
+        now = time.time()
+        if now - self._last_heartbeat < HEARTBEAT_INTERVAL:
+            return
+        _db().execute(
+            'INSERT OR REPLACE INTO server_heartbeats '
+            '(server_id, last_seen) VALUES (?, ?)',
+            (get_server_id(), now))
+        self._last_heartbeat = now
 
     def start(self) -> None:
-        # Recover orphaned requests from a previous server run.
+        # Recover orphaned requests from a previous SAME-HOST server
+        # run (legacy NULL-server rows too): pids are host-scoped, so
+        # a dead pid here proves the worker is gone — fail fast, the
+        # single-server restart contract. Rows claimed on OTHER hosts
+        # are left alone: their liveness is judged by heartbeat
+        # (requeue_stale_requests), not by our local pid table.
+        import socket
+        host_prefix = f'{socket.gethostname()}:'
         for row in _db().query(
-                'SELECT request_id, pid, status FROM requests WHERE '
-                'status IN (?, ?)', (RequestStatus.RUNNING.value,
-                                     RequestStatus.PENDING.value)):
+                'SELECT request_id, pid, status, server_id FROM requests '
+                'WHERE status IN (?, ?)', (RequestStatus.RUNNING.value,
+                                           RequestStatus.PENDING.value)):
+            sid = row.get('server_id')
+            if sid is not None and sid != get_server_id() and \
+                    not sid.startswith(host_prefix):
+                continue
             if RequestStatus(row['status']) == RequestStatus.RUNNING and \
                     not subprocess_utils.process_alive(row['pid']):
                 _set_status(row['request_id'], RequestStatus.FAILED,
@@ -250,6 +342,7 @@ class RequestWorkerLoop:
                                 'type': 'ApiRequestError',
                                 'message': 'server restarted mid-request',
                             }))
+        self._heartbeat()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
@@ -265,26 +358,53 @@ class RequestWorkerLoop:
             time.sleep(0.2)
 
     def _step(self) -> None:
-        # Reap finished processes.
+        # Liveness first: a replica must be visibly alive BEFORE it
+        # claims work, or the leader's stale sweep could re-queue a
+        # request this replica just started.
+        self._heartbeat()
+
+        # Reap finished processes; kill local trees whose request was
+        # CANCELLED on a peer replica (the peer cannot reach our pid).
+        # ONE batched status query per poll, not one per worker.
+        rows_by_id: Dict[str, Dict[str, Any]] = {}
+        if self._running:
+            marks = ','.join('?' * len(self._running))
+            rows_by_id = {
+                r['request_id']: r
+                for r in _db().query(
+                    f'SELECT request_id, status, server_id FROM requests '
+                    f'WHERE request_id IN ({marks})',
+                    tuple(self._running))}
         for rid, proc in list(self._running.items()):
-            if not proc.is_alive():
-                proc.join()
-                row = _db().query_one(
-                    'SELECT status FROM requests WHERE request_id=?', (rid,))
-                if row and not RequestStatus(row['status']).is_terminal():
-                    # Worker died without recording a result.
-                    _set_status(rid, RequestStatus.FAILED, error=json.dumps({
+            row = rows_by_id.get(rid)
+            status = RequestStatus(row['status']) if row else None
+            if proc.is_alive():
+                if status == RequestStatus.CANCELLED:
+                    subprocess_utils.kill_process_tree(proc.pid)
+                continue
+            proc.join()
+            if status is not None and not status.is_terminal() and \
+                    row.get('server_id') == get_server_id():
+                # Worker died without recording a result. Guarded on
+                # server_id: a stale-requeued row re-claimed by a peer
+                # is the PEER's run now — not ours to fail.
+                _db().execute(
+                    'UPDATE requests SET status=?, error=?, finished_at=? '
+                    'WHERE request_id=? AND server_id=? AND status=?',
+                    (RequestStatus.FAILED.value, json.dumps({
                         'type': 'ApiRequestError',
                         'message': f'worker exited rc={proc.exitcode} '
                                    'without result',
-                    }))
-                del self._running[rid]
+                    }), time.time(), rid, get_server_id(),
+                     row['status']))
+            del self._running[rid]
 
-        # Count running per queue.
+        # Concurrency is per replica: count OUR running requests.
         counts: Dict[str, int] = {'long': 0, 'short': 0}
         rows = _db().query(
-            'SELECT request_id, schedule_type FROM requests WHERE status=?',
-            (RequestStatus.RUNNING.value,))
+            'SELECT request_id, schedule_type FROM requests '
+            'WHERE status=? AND server_id=?',
+            (RequestStatus.RUNNING.value, get_server_id()))
         for r in rows:
             counts[r['schedule_type'] or 'long'] = counts.get(
                 r['schedule_type'] or 'long', 0) + 1
@@ -296,8 +416,20 @@ class RequestWorkerLoop:
             queue = req['schedule_type'] or 'long'
             if counts.get(queue, 0) >= _CONCURRENCY.get(queue, 4):
                 continue
+            if not self._claim(req['request_id']):
+                continue  # a peer replica won the row
             self._spawn(req)
             counts[queue] = counts.get(queue, 0) + 1
+
+    def _claim(self, request_id: str) -> bool:
+        """Atomic multi-replica claim: exactly one server flips the
+        row PENDING -> RUNNING (conditional UPDATE; the rowcount says
+        who won)."""
+        return _db().execute_rowcount(
+            'UPDATE requests SET status=?, server_id=?, started_at=? '
+            'WHERE request_id=? AND status=?',
+            (RequestStatus.RUNNING.value, get_server_id(), time.time(),
+             request_id, RequestStatus.PENDING.value)) == 1
 
     def _spawn(self, req: Dict[str, Any]) -> None:
         ctx = multiprocessing.get_context('fork')
@@ -312,6 +444,24 @@ class RequestWorkerLoop:
                   os.path.join(constants.api_server_dir(), 'requests.db'),
                   req['user'] or 'unknown'),
             daemon=True)
-        proc.start()
-        _set_status(req['request_id'], RequestStatus.RUNNING, pid=proc.pid)
+        # Both post-claim writes are guarded on (server_id, status):
+        # if this replica stalled past the stale window and the leader
+        # re-queued + a peer re-claimed the row, a late unguarded
+        # UPDATE would clobber the peer's attribution (and a wrong pid
+        # is a wrong kill target on the peer's host).
+        guard = ('AND server_id=? AND status=?',
+                 (get_server_id(), RequestStatus.RUNNING.value))
+        try:
+            proc.start()
+        except Exception:
+            # Spawn failed after the claim: give the row back.
+            _db().execute(
+                f'UPDATE requests SET status=?, server_id=NULL, pid=-1 '
+                f'WHERE request_id=? {guard[0]}',
+                (RequestStatus.PENDING.value, req['request_id']) +
+                guard[1])
+            raise
+        _db().execute(
+            f'UPDATE requests SET pid=? WHERE request_id=? {guard[0]}',
+            (proc.pid, req['request_id']) + guard[1])
         self._running[req['request_id']] = proc

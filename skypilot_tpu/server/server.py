@@ -20,6 +20,7 @@ from skypilot_tpu import exceptions
 from skypilot_tpu.agent import log_lib
 from skypilot_tpu.server import versions
 from skypilot_tpu.server.requests import executor
+from skypilot_tpu.utils import db_utils
 
 API_VERSION = versions.API_VERSION
 
@@ -79,6 +80,8 @@ async def api_get(request: web.Request) -> web.Response:
         'request_id': request_id,
         'name': record['name'],
         'status': record['status'].value,
+        # Which replica ran/owns it (multi-server deployments).
+        'server_id': record.get('server_id'),
     }
     if record['status'] == executor.RequestStatus.SUCCEEDED:
         # Pickle-over-JSON for rich return values (handles are not
@@ -478,6 +481,12 @@ def run(host: str = '127.0.0.1',
     global _SERVER_START_TIME
     import time as _time
     _SERVER_START_TIME = _time.time()
+    # Replica identity: scopes restart recovery to our own request
+    # rows and keys the heartbeat peers judge our liveness by.
+    # Stable across restarts of the same replica (host:port);
+    # SKYPILOT_API_SERVER_ID overrides (k8s pod name).
+    import socket as _socket
+    executor.set_server_id(f'{_socket.gethostname()}:{port}')
     worker_loop = executor.RequestWorkerLoop()
     worker_loop.start()
     # HA: re-adopt managed jobs orphaned by a previous server/controller
@@ -512,7 +521,14 @@ def run(host: str = '127.0.0.1',
             daemons_lib.DEFAULT_GC_INTERVAL)),
         request_retention=float(os.environ.get(
             'SKYPILOT_REQUEST_RETENTION',
-            daemons_lib.DEFAULT_REQUEST_RETENTION)))
+            daemons_lib.DEFAULT_REQUEST_RETENTION)),
+        stale_requeue_interval=float(os.environ.get(
+            'SKYPILOT_STALE_REQUEUE_INTERVAL',
+            daemons_lib.DEFAULT_STALE_REQUEUE_INTERVAL)),
+        # Leader-only across replicas: pg advisory lock when the state
+        # layer is Postgres, flock on the single-host sqlite default.
+        leader_lock=db_utils.AdvisoryLock(
+            'server-daemons', constants.api_server_dir()))
     daemons.start()
     app = create_app()
     web.run_app(app, host=host, port=port, print=None)

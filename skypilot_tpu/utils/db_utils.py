@@ -61,6 +61,13 @@ class SQLiteDB:
         with self.conn() as conn:
             conn.execute(sql, params)
 
+    def execute_rowcount(self, sql: str, params: tuple = ()) -> int:
+        """Execute and return the affected-row count — the atomic
+        claim primitive (UPDATE ... WHERE status='PENDING' wins on
+        exactly one replica)."""
+        with self.conn() as conn:
+            return conn.execute(sql, params).rowcount
+
     def query(self, sql: str, params: tuple = ()) -> List[Dict[str, Any]]:
         with self.conn() as conn:
             rows = conn.execute(sql, params).fetchall()
@@ -171,6 +178,10 @@ class _PgCursor:
     @property
     def description(self):
         return self._cur.description
+
+    @property
+    def rowcount(self):
+        return self._cur.rowcount
 
 
 class _PgConn:
@@ -283,6 +294,10 @@ class PostgresDB:
         with self.conn() as conn:
             conn.execute(sql, params)
 
+    def execute_rowcount(self, sql: str, params: tuple = ()) -> int:
+        with self.conn() as conn:
+            return conn.execute(sql, params).rowcount
+
     def query(self, sql: str, params: tuple = ()) -> List[Dict[str, Any]]:
         with self.conn() as conn:
             cur = conn.execute(sql, params)
@@ -314,3 +329,108 @@ def open_db(path: str, create_table_sql: str):
     if url and url.startswith(('postgres://', 'postgresql://')):
         return PostgresDB(url, create_table_sql)
     return SQLiteDB(path, create_table_sql)
+
+
+# ---------------------------------------------------------------------------
+# Cross-replica advisory lock (multi-server leader election).
+
+
+class AdvisoryLock:
+    """Best-effort cross-replica mutex, for leader-electing singleton
+    work (server maintenance daemons) across API-server replicas.
+
+    Postgres (SKYPILOT_DB_URL set): `pg_try_advisory_lock` on a
+    DEDICATED session — the lock lives exactly as long as this
+    process's connection, so a crashed leader releases it
+    automatically. sqlite deployments are single-host by construction
+    (a shared sqlite file over the network is unsupported), so an
+    exclusive flock on a sibling lockfile gives the same
+    crash-release semantics between processes on that host.
+    """
+
+    def __init__(self, name: str, lock_dir: str) -> None:
+        self.name = name
+        self._lock_dir = lock_dir
+        self._url = os.environ.get('SKYPILOT_DB_URL')
+        self._pg_conn = None
+        self._fd: Optional[int] = None
+        self._held = False
+
+    @property
+    def held(self) -> bool:
+        return self._held
+
+    def _pg_key(self) -> int:
+        import zlib
+        return zlib.crc32(self.name.encode())  # stable bigint key
+
+    def _pg_drop_conn(self) -> None:
+        if self._pg_conn is not None:
+            try:
+                self._pg_conn.close()
+            except Exception:  # pylint: disable=broad-except
+                pass
+            self._pg_conn = None
+        self._held = False
+
+    def try_acquire(self) -> bool:
+        """Non-blocking; revalidated while held. Returns whether this
+        process holds the lock RIGHT NOW. Never raises — a DB outage
+        reads as not-leader (and drops the cached session so the next
+        call reconnects); a dropped session also drops leadership,
+        because Postgres released the server-side lock with it (a
+        stale `held` here would mean two leaders)."""
+        if self._url and self._url.startswith(('postgres://',
+                                               'postgresql://')):
+            if self._held:
+                # The server-side lock lives exactly as long as the
+                # session: probe it instead of trusting _held.
+                try:
+                    cur = self._pg_conn.cursor()
+                    cur.execute('SELECT 1')
+                    cur.fetchone()
+                    self._pg_conn.commit()
+                    return True
+                except Exception:  # pylint: disable=broad-except
+                    self._pg_drop_conn()
+            try:
+                if self._pg_conn is None:
+                    self._pg_conn = PostgresDB._connect(self._url)
+                cur = self._pg_conn.cursor()
+                cur.execute('SELECT pg_try_advisory_lock(%s)',
+                            (self._pg_key(),))
+                self._held = bool(cur.fetchone()[0])
+                self._pg_conn.commit()
+            except Exception:  # pylint: disable=broad-except
+                self._pg_drop_conn()
+            return self._held
+        if self._held:
+            return True
+        import fcntl
+        os.makedirs(self._lock_dir, exist_ok=True)
+        if self._fd is None:
+            self._fd = os.open(
+                os.path.join(self._lock_dir, f'{self.name}.lock'),
+                os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            fcntl.flock(self._fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            self._held = True
+        except OSError:
+            self._held = False
+        return self._held
+
+    def release(self) -> None:
+        if not self._held:
+            return
+        if self._pg_conn is not None:
+            try:
+                cur = self._pg_conn.cursor()
+                cur.execute('SELECT pg_advisory_unlock(%s)',
+                            (self._pg_key(),))
+                self._pg_conn.commit()
+            except Exception:  # pylint: disable=broad-except
+                self._pg_drop_conn()  # session death released it anyway
+        elif self._fd is not None:
+            import fcntl
+            fcntl.flock(self._fd, fcntl.LOCK_UN)
+        self._held = False

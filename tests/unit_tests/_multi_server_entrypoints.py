@@ -1,0 +1,10 @@
+"""Request entrypoints for the multi-replica API-server tests.
+
+Importable by server worker processes (the tests put this directory
+on the servers' PYTHONPATH)."""
+import time
+
+
+def slow_echo(seconds: float, value: str) -> str:
+    time.sleep(seconds)
+    return value

@@ -2,9 +2,10 @@
 
 No helm binary in this environment; the chart's templates are
 restricted (by policy, stated in the templates) to `{{ .Values.* }}`
-interpolation and `{{- if .Values.* }}` / `{{- end }}` blocks, which
-this renderer implements — enough to prove every manifest is valid
-YAML with the right structure under default and overridden values.
+interpolation and `{{- if .Values.* }}` / `{{- else }}` / `{{- end }}`
+blocks, which this renderer implements — enough to prove every
+manifest is valid YAML with the right structure under default and
+overridden values.
 """
 import os
 import re
@@ -28,12 +29,16 @@ def render(template_text, values):
     skip_stack = []
     for line in template_text.splitlines():
         m_if = re.match(r'\s*\{\{-? if (.+?) \}\}\s*$', line)
+        m_else = re.match(r'\s*\{\{-? else \}\}\s*$', line)
         m_end = re.match(r'\s*\{\{-? end \}\}\s*$', line)
         if m_if:
             expr = m_if.group(1).strip()
             assert expr.startswith('.Values.'), f'unsupported if: {expr}'
             val = _lookup(values, expr[len('.Values.'):])
             skip_stack.append(not bool(val))
+            continue
+        if m_else:
+            skip_stack[-1] = not skip_stack[-1]
             continue
         if m_end:
             skip_stack.pop()
@@ -92,6 +97,27 @@ def test_default_render():
     mounts = [m['name'] for m in container['volumeMounts']]
     assert 'app' in mounts
     assert 'PYTHONPATH' in env_names
+
+
+def test_multi_replica_render():
+    """replicas > 1 + dbUrl + statePvc: false — the HA shape: no PVC
+    object, /state on emptyDir, SKYPILOT_DB_URL + per-pod server id."""
+    docs = _load_chart({'apiServer.replicas': 2,
+                        'apiServer.dbUrl':
+                            'postgresql://u:p@pg:5432/sky',
+                        'apiServer.statePvc': False})
+    kinds = [d['kind'] for d in docs]
+    assert 'PersistentVolumeClaim' not in kinds
+    deploy = next(d for d in docs if d['kind'] == 'Deployment')
+    assert deploy['spec']['replicas'] == 2
+    spec = deploy['spec']['template']['spec']
+    state = next(v for v in spec['volumes'] if v['name'] == 'state')
+    assert 'emptyDir' in state and 'persistentVolumeClaim' not in state
+    env = {e['name']: e for e in spec['containers'][0]['env']}
+    assert env['SKYPILOT_DB_URL']['value'] == \
+        'postgresql://u:p@pg:5432/sky'
+    assert env['SKYPILOT_API_SERVER_ID']['valueFrom']['fieldRef'][
+        'fieldPath'] == 'metadata.name'
 
 
 def test_overridden_render():
