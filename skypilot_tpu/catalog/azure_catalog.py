@@ -2,8 +2,10 @@
 
 Reference: sky/catalog/azure_catalog.py — pandas over the hosted CSV
 mirror. Same shape as `aws_catalog`; Azure availability zones are
-numeric ('1'/'2'/'3') per region and allocation is region-level here,
-so the snapshot carries no zone column.
+numeric ('1'/'2'/'3') per region and the snapshot carries zonal rows
+(prices are uniform across a region's zones), so zone-scoped failover
+patterns (provision/failover_patterns.py ZonalAllocationFailed etc.)
+have real zones to walk.
 """
 from __future__ import annotations
 
@@ -24,6 +26,8 @@ def list_accelerators(
         case_sensitive: bool = False,
 ) -> Dict[str, List[common.InstanceTypeInfo]]:
     df = _vm_df()
+    # Zonal rows duplicate (type, region): one entry per pair.
+    df = df.drop_duplicates(subset=['InstanceType', 'Region'])
     acc_df = df[df['AcceleratorName'].notna()]
     if name_filter is not None:
         acc_df = acc_df[acc_df['AcceleratorName'].str.contains(
@@ -50,11 +54,12 @@ def list_accelerators(
 def get_hourly_cost(instance_type: str, use_spot: bool,
                     region: Optional[str] = None,
                     zone: Optional[str] = None) -> float:
-    del zone  # allocation is region-level on Azure
     df = _vm_df()
     df = df[df['InstanceType'] == instance_type]
     if region is not None:
         df = df[df['Region'] == region]
+    if zone is not None:
+        df = df[df['AvailabilityZone'].astype(str) == str(zone)]
     if df.empty:
         raise ValueError(f'Unknown Azure instance type {instance_type!r} '
                          f'in region={region}.')
@@ -114,10 +119,28 @@ def validate_region_zone(region: Optional[str], zone: Optional[str]):
     if region is not None and region not in set(df['Region']):
         raise ValueError(f'Invalid region {region!r} for Azure; valid: '
                          f'{sorted(df["Region"].unique())}')
-    if zone is not None and str(zone) not in ('1', '2', '3'):
-        raise ValueError(
-            f'Invalid zone {zone!r} for Azure: zones are 1/2/3.')
+    if zone is not None:
+        zdf = df
+        if region is not None:
+            zdf = df[df['Region'] == region]
+        valid = set(zdf['AvailabilityZone'].dropna().astype(str))
+        if str(zone) not in valid:
+            raise ValueError(
+                f'Invalid zone {zone!r} for Azure'
+                f'{f" region {region}" if region else ""}: valid zones '
+                f'are {sorted(valid)}.')
     return region, zone
+
+
+def get_zones(region: str, instance_type: Optional[str] = None
+              ) -> List[str]:
+    """Zones of `region` carrying the offering, sorted — the zonal
+    failover walk order."""
+    df = _vm_df()
+    df = df[df['Region'] == region]
+    if instance_type is not None:
+        df = df[df['InstanceType'] == instance_type]
+    return sorted(df['AvailabilityZone'].dropna().astype(str).unique())
 
 
 

@@ -144,7 +144,12 @@ class Azure(cloud.Cloud):
         for r in region_names:
             if region is not None and r != region:
                 continue
-            zones = [cloud.Zone(zone)] if zone is not None else None
+            if zone is not None:
+                zones = [cloud.Zone(zone)]
+            else:
+                zones = [cloud.Zone(z) for z in
+                         azure_catalog.get_zones(
+                             r, instance_type=instance_type)] or None
             out.append(cloud.Region(r).set_zones(zones))
         return out
 
@@ -154,8 +159,16 @@ class Azure(cloud.Cloud):
                              accelerators: Optional[Dict[str, int]],
                              use_spot: bool
                              ) -> Iterator[Optional[List[cloud.Zone]]]:
-        del region, num_nodes, instance_type, accelerators, use_spot
-        yield None  # region-level: ARM picks placement
+        # Zone-by-zone (GCP-style): a ZonalAllocationFailed-class error
+        # (failover_patterns.py AZURE_PATTERNS, ZONE scope) advances to
+        # the region's next zone instead of abandoning the region.
+        del num_nodes, accelerators, use_spot
+        zones = azure_catalog.get_zones(region,
+                                        instance_type=instance_type)
+        for z in zones:
+            yield [cloud.Zone(z)]
+        if not zones:
+            yield None  # region-level: ARM picks placement
 
     # ---- deploy variables -------------------------------------------------
     def make_deploy_resources_variables(

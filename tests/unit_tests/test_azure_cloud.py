@@ -55,12 +55,21 @@ def test_regions_with_offering_gpu(azure):
     assert 'eastus' in names and 'westus2' in names
 
 
-def test_zones_provision_loop_region_level(azure):
+def test_zones_provision_loop_walks_zones(azure):
+    """Zonal rows in the catalog: the loop offers each zone in turn
+    (GCP-style), so ZONE-scoped failover patterns have zones to walk."""
     batches = list(Azure.zones_provision_loop(
         region='eastus', num_nodes=1,
         instance_type='Standard_NC24ads_A100_v4',
         accelerators={'A100-80GB': 1}, use_spot=False))
-    assert batches == [None]  # ARM picks placement within the region
+    assert [[z.name for z in b] for b in batches] == [['1'], ['2'],
+                                                      ['3']]
+
+
+def test_validate_zone_is_region_scoped():
+    with pytest.raises(ValueError, match='valid zones'):
+        azure_catalog.validate_region_zone('eastus', '9')
+    assert azure_catalog.get_zones('eastus') == ['1', '2', '3']
 
 
 def test_validate_region_zone():

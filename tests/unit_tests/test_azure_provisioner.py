@@ -225,10 +225,50 @@ def test_auth_error_category():
         exceptions.ProvisionerError.TRANSIENT
 
 
+def test_failover_engine_walks_azure_zones(fake_arm, monkeypatch,
+                                           isolated_state):
+    """ZonalAllocationFailed is ZONE-scoped: zones 1 and 2 of eastus
+    fail, the walk stays in the region and lands in zone 3."""
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.backends.tpu_backend import RetryingProvisioner
+
+    task = task_lib.Task(run='true')
+    r = resources_lib.Resources(infra='azure',
+                                accelerators='A100-80GB:1').copy(
+        instance_type='Standard_NC24ads_A100_v4')
+    task.set_resources(r)
+
+    real_request = fake_arm.request
+    failed_zones = []
+
+    def exhausted_zones_1_2(method, path, body=None, api_version=None):
+        if method == 'PUT' and '/virtualMachines/' in path and body:
+            zones = body.get('zones') or []
+            if body.get('location') == 'eastus' and \
+                    zones and zones[0] in ('1', '2'):
+                failed_zones.append(zones[0])
+                raise exceptions.ProvisionerError(
+                    'Azure PUT vm -> ZonalAllocationFailed: cannot '
+                    'allocate in the requested zone',
+                    category=exceptions.ProvisionerError.CAPACITY)
+        return real_request(method, path, body, api_version)
+
+    monkeypatch.setattr(arm_api, '_request', exhausted_zones_1_2)
+    prov = RetryingProvisioner()
+    record, resolved, region = prov.provision_with_retries(
+        task, r, 'azz', 'azz')
+    assert failed_zones == ['1', '2']
+    assert region.name == 'eastus'          # same region throughout
+    assert resolved.zone == '3'
+    assert record.region == 'eastus'
+    assert len(prov.failover_history) == 2
+
+
 def test_failover_engine_walks_azure_regions(fake_arm, monkeypatch,
                                              isolated_state):
-    """Azure allocation is region-level (no zone walk): SkuNotAvailable
-    in the first region moves the walk to the next offering region."""
+    """SkuNotAvailable is REGION-scoped: the walk skips eastus's
+    remaining zones and moves to the next offering region."""
     from skypilot_tpu import resources as resources_lib
     from skypilot_tpu import task as task_lib
     from skypilot_tpu.backends.tpu_backend import RetryingProvisioner
@@ -246,9 +286,16 @@ def test_failover_engine_walks_azure_regions(fake_arm, monkeypatch,
         if method == 'PUT' and '/virtualMachines/' in path and \
                 body and body.get('location') == 'eastus':
             failed_regions.append('eastus')
+            # Mirror arm_api's real classification: SkuNotAvailable is
+            # REGION-scoped in the pattern table (pinned by
+            # test_failover_patterns), so eastus's other zones are
+            # skipped, not walked.
+            from skypilot_tpu.provision import failover_patterns
+            pat = failover_patterns.classify(
+                'azure', 'SkuNotAvailable', 'not available')
             raise exceptions.ProvisionerError(
                 'Azure PUT vm -> SkuNotAvailable: not available',
-                category=exceptions.ProvisionerError.CAPACITY)
+                category=pat.category, scope=pat.scope)
         return real_request(method, path, body, api_version)
 
     monkeypatch.setattr(arm_api, '_request', capacity_in_eastus)
