@@ -180,6 +180,23 @@ async def service_detail(request: web.Request) -> web.Response:
     return web.json_response(data, status=404 if 'error' in data else 200)
 
 
+def _workspaces() -> dict:
+    from skypilot_tpu.workspaces import core as ws_core
+    out = {}
+    for name in ws_core.get_workspaces():
+        out[name] = {
+            'allowed_clouds': ws_core.allowed_clouds(name),  # None=all
+        }
+    return {'active': ws_core.active_workspace(), 'workspaces': out}
+
+
+async def workspaces(request: web.Request) -> web.Response:
+    del request
+    data = await asyncio.get_event_loop().run_in_executor(
+        None, _workspaces)
+    return web.json_response(data)
+
+
 async def index(request: web.Request) -> web.Response:
     del request
     with open(os.path.join(_STATIC_DIR, 'index.html'), 'r',
@@ -201,3 +218,4 @@ def register(app: web.Application) -> None:
     app.router.add_get('/dashboard/api/summary', summary)
     app.router.add_get('/dashboard/api/cluster/{name}', cluster_detail)
     app.router.add_get('/dashboard/api/service/{name}', service_detail)
+    app.router.add_get('/dashboard/api/workspaces', workspaces)

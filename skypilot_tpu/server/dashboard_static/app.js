@@ -3,9 +3,12 @@
  * 42k-LoC Next.js app; the data and verbs are the same. */
 'use strict';
 
-const TABS = ['Clusters', 'Jobs', 'Services', 'Requests', 'Users'];
+const TABS = ['Clusters', 'Jobs', 'Services', 'Requests', 'Users',
+              'Workspaces'];
 let active = 'Clusters';
 let data = null;
+let tokens = null;       // /users/tokens (admin); null = not loaded
+let workspaces = null;   // /dashboard/api/workspaces
 let logAbort = null;
 
 const $ = (id) => document.getElementById(id);
@@ -172,12 +175,115 @@ function render() {
                 r.status, { html: live ? btn('cancel', 'danger', `rcancel${i}`) : '' }];
       }));
   } else if (active === 'Users') {
-    v.innerHTML = table(
-      ['user', 'role', 'requests', 'last seen'],
-      data.users.map((u) => [u.name, u.role || 'user', u.request_count,
-                             ts(u.last_seen)]));
+    renderUsers(v, acts);
+  } else if (active === 'Workspaces') {
+    renderWorkspaces(v);
   }
   bindActs(acts);
+}
+
+/* Users admin: set role, issue service-account tokens, revoke them —
+ * the management surface behind `stpu users ...` (admin-only routes;
+ * non-admin tokens get a 403 toast). */
+function renderUsers(v, acts) {
+  const userRows = data.users.map((u, i) => {
+    acts[`role${i}`] = () => {
+      const sel = $(`rolesel${i}`);
+      act(`Set ${u.name} role to ${sel.value}`, '/users/role',
+          { user: u.name, role: sel.value });
+    };
+    acts[`tok${i}`] = () => issueToken(u.name, u.role || 'user');
+    const roleSel =
+      `<select id="rolesel${i}">` +
+      ['user', 'admin'].map((r) =>
+        `<option${r === (u.role || 'user') ? ' selected' : ''}>${r}</option>`)
+        .join('') + '</select>';
+    return [u.name, { html: roleSel + btn('set role', '', `role${i}`) },
+            u.request_count, ts(u.last_seen),
+            { html: btn('issue token', '', `tok${i}`) }];
+  });
+  let html = '<h4>Users</h4>' +
+    table(['user', 'role', 'requests', 'last seen', ''], userRows);
+  html += '<h4>Service-account tokens</h4>';
+  if (tokens === null) {
+    html += '<div class="empty">loading…</div>';
+    loadTokens();
+  } else if (tokens.error) {
+    html += `<div class="err">${esc(tokens.error)}</div>`;
+  } else {
+    const tokRows = tokens.map((t, i) => {
+      acts[`trev${i}`] = () => {
+        act(`Revoke token ${t.token_id}`, '/users/tokens/revoke',
+            { token_id: t.token_id });
+        tokens = null;  // reload after the revoke lands
+      };
+      return [t.token_id, t.user_hash, ts(t.created_at),
+              ts(t.last_used_at), t.revoked ? 'REVOKED' : 'active',
+              { html: t.revoked ? '' : btn('revoke', 'danger', `trev${i}`) }];
+    });
+    html += table(['id', 'user', 'created', 'last used', 'state', ''],
+                  tokRows);
+  }
+  v.innerHTML = html;
+}
+
+async function loadTokens() {
+  try {
+    const resp = await authFetch('/users/tokens');
+    const body = await resp.json();
+    if (!resp.ok) throw new Error(body.error || resp.status);
+    tokens = body.tokens || [];
+  } catch (e) { tokens = { error: `tokens: ${e.message}` }; }
+  if (active === 'Users') render();
+}
+
+async function issueToken(user, role) {
+  if (!window.confirm(`Issue a ${role} token for ${user}?`)) return;
+  try {
+    const resp = await authFetch('/users/tokens', {
+      method: 'POST', body: JSON.stringify({ user, role }) });
+    const body = await resp.json();
+    if (!resp.ok) throw new Error(body.error || resp.status);
+    /* The secret is shown ONCE (the server stores only its hash) —
+     * same contract as `stpu users token issue`. */
+    window.prompt(`Token for ${user} — copy it now (not shown again):`,
+                  body.token);
+    tokens = null;  // reload the token list
+    render();
+  } catch (e) { toast(`issue token failed: ${e.message}`, true); }
+}
+
+/* Workspaces: registry + per-workspace cloud allow-list (config-
+ * driven; edited via the server's config YAML, viewable here). */
+function renderWorkspaces(v) {
+  if (workspaces === null) {
+    v.innerHTML = '<div class="empty">loading…</div>';
+    loadWorkspaces();
+    return;
+  }
+  if (workspaces.error) {
+    v.innerHTML = `<div class="err">${esc(workspaces.error)}</div>`;
+    return;
+  }
+  const names = Object.keys(workspaces.workspaces || {});
+  v.innerHTML = table(
+    ['workspace', 'allowed clouds', 'active'],
+    names.map((n) => {
+      const ws = workspaces.workspaces[n];
+      const clouds = ws.allowed_clouds === null ? 'all clouds'
+        : (ws.allowed_clouds || []).join(', ') || 'none';
+      return [n, clouds, n === workspaces.active ? '✓' : ''];
+    }));
+}
+
+async function loadWorkspaces() {
+  try {
+    const resp = await authFetch('/dashboard/api/workspaces');
+    const body = await resp.json();
+    if (!resp.ok) throw new Error(body.error || resp.status);
+    workspaces = body;
+  } catch (e) { workspaces = { error: `workspaces: ${e.message}` }; }
+  if (active === 'Workspaces') render();
 }
 
 function bindRows(fn) {
@@ -228,14 +334,41 @@ async function showClusterDetail(c) {
   load();
 }
 
-function showJobDetail(j) {
+async function showJobDetail(j) {
   closeDetail();
+  /* Per-rank logs: the job's cluster knows its host count; rank N
+   * streams that host's file via the cluster log endpoint (the
+   * controller view stays the default — recovery context lives
+   * there). */
+  let nHosts = 0;
+  if (j.cluster_name) {
+    try {
+      const resp = await authFetch(
+        `/dashboard/api/cluster/${encodeURIComponent(j.cluster_name)}`);
+      if (resp.ok) nHosts = (await resp.json()).num_hosts || 0;
+    } catch (e) { /* cluster may be torn down between recoveries */ }
+  }
+  const srcOpts = ['<option value="">controller</option>'];
+  for (let r = 0; r < nHosts; r += 1) {
+    srcOpts.push(`<option value="${r}">rank ${r}</option>`);
+  }
   detailShell(`Managed job ${j.job_id} — ${j.name || ''}`,
     `<div>cluster ${esc(j.cluster_name)} · strategy ${esc(j.strategy || '-')} · ` +
     `recoveries ${j.recovery_count}` +
     (j.last_error ? `<div class="err">${esc(j.last_error)}</div>` : '') +
-    `</div><h4>Log</h4><pre class="logs" id="logbox">…</pre>`);
-  streamLogs(`/jobs/logs?job_id=${j.job_id}&follow=0`);
+    `</div><h4>Log <select id="jsrc">${srcOpts.join('')}</select></h4>` +
+    `<pre class="logs" id="logbox">…</pre>`);
+  const load = () => {
+    const src = $('jsrc').value;
+    if (src === '') {
+      streamLogs(`/jobs/logs?job_id=${j.job_id}&follow=0`);
+    } else {
+      streamLogs(`/logs?cluster=${encodeURIComponent(j.cluster_name)}` +
+                 `&follow=0&tail=200&rank=${src}`);
+    }
+  };
+  $('jsrc').onchange = load;
+  load();
 }
 
 async function showServiceDetail(name) {

@@ -188,6 +188,55 @@ def test_api_version_negotiation(chaos_server, monkeypatch):
 
 
 @pytest.mark.slow
+def test_dashboard_admin_surfaces(chaos_server, monkeypatch):
+    """Users/tokens are manageable and workspaces viewable from the
+    SPA's API surface: set role, issue + revoke a service token, list
+    workspaces with their cloud allow-lists."""
+    home, port, _proc = chaos_server
+    url = f'http://127.0.0.1:{port}'
+    monkeypatch.setenv(constants.API_SERVER_URL_ENV_VAR, url)
+
+    # Workspaces view (registry + allow-list; default always present).
+    ws = requests.get(f'{url}/dashboard/api/workspaces', timeout=10)
+    assert ws.ok
+    body = ws.json()
+    assert 'default' in body['workspaces']
+    assert body['active']
+
+    # Seed a user, set their role from the admin surface.
+    requests.get(f'{url}/api/status', timeout=10,
+                 headers={'X-Skypilot-User': 'dash-admin'})
+    r = requests.post(f'{url}/users/role',
+                      json={'user': 'dash-admin', 'role': 'admin'},
+                      timeout=10)
+    assert r.ok, r.text
+    users = requests.get(f'{url}/users', timeout=10).json()['users']
+    by_name = {u['name']: u for u in users}
+    assert by_name['dash-admin']['role'] == 'admin'
+
+    # Token lifecycle: issue (secret shown once), list, revoke.
+    tok = requests.post(f'{url}/users/tokens',
+                        json={'user': 'dash-admin', 'role': 'admin'},
+                        timeout=10)
+    assert tok.ok, tok.text
+    secret = tok.json()['token']
+    assert secret
+    auth = {'Authorization': f'Bearer {secret}'}
+    listed = requests.get(f'{url}/users/tokens', timeout=10,
+                          headers=auth).json()['tokens']
+    token_id = next(t['token_id'] for t in listed
+                    if t['user_hash'] == 'dash-admin')
+    rev = requests.post(f'{url}/users/tokens/revoke',
+                        json={'token_id': token_id}, timeout=10,
+                        headers=auth)
+    assert rev.ok and rev.json()['revoked'] is True
+    # The revoked token no longer authenticates (tokens now exist, so
+    # auth is required and the stale secret is rejected).
+    denied = requests.get(f'{url}/users/tokens', timeout=10,
+                          headers=auth)
+    assert denied.status_code in (401, 403)
+
+
 def test_dashboard_spa_serves_live_data(chaos_server, monkeypatch):
     """The dashboard SPA assets load and /dashboard/api/summary carries
     live cluster data (reference: sky/dashboard)."""
