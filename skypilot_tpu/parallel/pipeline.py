@@ -177,9 +177,18 @@ def _family_of(model) -> _Family:
         return _Family('layer_', mixtral_lib.Block(model.config), True,
                        True, {'tok_embed': 0, 'lm_head': 1},
                        _llama_embed_vp, llama_lib.final_norm_logits)
+    from skypilot_tpu.models import deepseek as deepseek_lib
+    if isinstance(model, deepseek_lib.Deepseek):
+        # MLA blocks are llama-shaped at the pipeline seam (same
+        # (x, positions) signature, same tok_embed/final_norm/lm_head
+        # param layout, RMSNorm shared with llama) — the latent-KV
+        # machinery is internal to the block.
+        return _Family('layer_', deepseek_lib.Block(model.config), True,
+                       False, {'tok_embed': 0, 'lm_head': 1},
+                       _llama_embed_vp, llama_lib.final_norm_logits)
     raise ValueError(
-        f'Pipeline parallelism supports the GPT, Llama, and Mixtral '
-        f'families; got {type(model).__name__}')
+        f'Pipeline parallelism supports the GPT, Llama, Mixtral, and '
+        f'DeepSeek families; got {type(model).__name__}')
 
 
 class PipelinedLM:
