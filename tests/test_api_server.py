@@ -312,5 +312,16 @@ def test_websocket_attach_interactive_shell(api_server):
                         timeout=10)
     assert resp.status_code == 404
 
-    requests.post(f'{url}/down', json={'cluster_name': 'att-c'},
-                  timeout=10)
+    # WAIT for the down to finish: firing it and tearing the server
+    # down kills the worker mid-terminate and leaks the cluster's
+    # agent process (observed: one orphaned agent per run).
+    rid = requests.post(f'{url}/down', json={'cluster_name': 'att-c'},
+                        timeout=10).json()['request_id']
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        rec = requests.get(f'{url}/api/get',
+                           params={'request_id': rid, 'timeout': 5},
+                           timeout=30).json()
+        if rec['status'] in ('SUCCEEDED', 'FAILED', 'CANCELLED'):
+            break
+    assert rec['status'] == 'SUCCEEDED', rec

@@ -306,5 +306,14 @@ def test_dashboard_spa_serves_live_data(chaos_server, monkeypatch):
     assert summary['clusters'][names.index('dash-c')]['status'] == \
         'STOPPED'
 
-    requests.post(f'{url}/down', json={'cluster_name': 'dash-c'},
-                  timeout=10)
+    # Wait out the down: a worker killed mid-terminate at fixture
+    # teardown can leak cluster processes/state.
+    rid = requests.post(f'{url}/down', json={'cluster_name': 'dash-c'},
+                        timeout=10).json()['request_id']
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        rec = requests.get(f'{url}/api/get',
+                           params={'request_id': rid, 'timeout': 5},
+                           timeout=30).json()
+        if rec['status'] in ('SUCCEEDED', 'FAILED'):
+            break
