@@ -500,7 +500,11 @@ class ServeController:
                     {'error': 'no ready replicas'}, status=503)
             url = f'http://{replica}{request.rel_url}'
             try:
-                timeout = ClientTimeout(total=300)
+                # Above the replica's 600s request-future timeout (and
+                # the 630s drain grace): a long STREAMED generation
+                # must not be cut mid-flight by the proxy while the
+                # replica is still committing tokens.
+                timeout = ClientTimeout(total=660)
                 async with ClientSession(timeout=timeout) as session:
                     body = await request.read()
                     async with session.request(

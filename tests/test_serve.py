@@ -182,6 +182,68 @@ def test_serve_up_lb_down(serve_env):
     assert not any(n.startswith('svc1-') for n in names), names
 
 
+_SSE_RUN = (
+    'python3 -c "'
+    "import http.server, os, time, json\n"
+    "class H(http.server.BaseHTTPRequestHandler):\n"
+    "    def do_GET(self):\n"
+    "        if self.path != '/sse':\n"
+    "            body = json.dumps({'pid': os.getpid()}).encode()\n"
+    "            self.send_response(200)\n"
+    "            self.send_header('Content-Length', str(len(body)))\n"
+    "            self.end_headers()\n"
+    "            self.wfile.write(body)\n"
+    "            return\n"
+    "        self.send_response(200)\n"
+    "        self.send_header('Content-Type', 'text/event-stream')\n"
+    "        self.end_headers()\n"
+    "        for i in range(5):\n"
+    "            self.wfile.write(f'data: {i}\\n\\n'.encode())\n"
+    "            self.wfile.flush()\n"
+    "            time.sleep(0.5)\n"
+    "    def log_message(self, *a):\n"
+    "        pass\n"
+    "http.server.HTTPServer(('127.0.0.1', "
+    "int(os.environ['SKYPILOT_SERVE_PORT'])), H).serve_forever()\n"
+    '"')
+
+
+@pytest.mark.slow
+def test_serve_lb_streams_sse(serve_env):
+    """The LB proxy must PASS SSE THROUGH incrementally (StreamResponse
+    + chunked relay), not buffer the body: first frame arrives well
+    before the stream completes — the property token streaming from
+    serve_lm replicas depends on."""
+    cfg = {
+        'name': 'sse',
+        'resources': {'infra': 'local'},
+        'run': _SSE_RUN,
+        'service': {
+            'readiness_probe': {'path': '/',
+                                'initial_delay_seconds': 60},
+            'replicas': 1,
+        },
+    }
+    result = serve_core.up(cfg, 'svc-sse', user='t')
+    endpoint = result['endpoint']
+    _wait_ready('svc-sse', 1)
+    t0 = time.time()
+    stamps = []
+    with requests.get(endpoint + '/sse', stream=True,
+                      timeout=60) as resp:
+        assert resp.status_code == 200
+        assert resp.headers['Content-Type'].startswith(
+            'text/event-stream')
+        for line in resp.iter_lines():
+            if line.startswith(b'data: '):
+                stamps.append(time.time() - t0)
+    assert len(stamps) == 5, stamps
+    # Frames arrived over ~2s of wall time, not in one burst at the
+    # end (allow generous slack for a loaded 1-core host).
+    assert stamps[0] < 0.5 * stamps[-1], stamps
+    serve_core.down('svc-sse')
+
+
 _VERSIONED_RUN = (
     'python3 -c "'
     "import http.server, os, json\n"
