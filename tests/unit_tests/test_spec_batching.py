@@ -239,3 +239,61 @@ def test_per_request_stop_tokens():
             assert again == full
         finally:
             eng.stop()
+
+
+@pytest.mark.slow
+def test_on_token_streams_commits_in_order():
+    """The engine's on_token callback (the SSE streaming feed)
+    delivers exactly the generated tokens, in commit order, BEFORE the
+    future resolves — for both the plain and speculative decode loops
+    (verify chunks commit 1..K+1 tokens per call)."""
+    import threading
+    model, params = _build('llama')
+    for spec_k in (0, 3):
+        eng = ContinuousBatchingEngine(model, params, num_slots=2,
+                                       max_total_len=64,
+                                       speculative_k=spec_k)
+        try:
+            p = [5, 9, 2, 5, 9, 2, 5, 9]
+            streamed = []
+            resolved = threading.Event()
+
+            def on_token(tok, streamed=streamed, resolved=resolved):
+                # Every token must arrive before the future resolves.
+                assert not resolved.is_set()
+                streamed.append(tok)
+
+            fut = eng.submit(p, max_new_tokens=12, on_token=on_token)
+            full = fut.result(timeout=180)
+            resolved.set()
+            assert streamed == full[len(p):]
+            # The callback is per-request: a plain submit streams none.
+            assert eng.submit(p, max_new_tokens=4).result(
+                timeout=180) == full[:len(p) + 4]
+        finally:
+            eng.stop()
+
+
+def test_on_token_exception_does_not_kill_request():
+    """A broken stream consumer (client hung up) must not fail the
+    request or the shared scheduler loop."""
+    model, params = _build('llama')
+    eng = ContinuousBatchingEngine(model, params, num_slots=2,
+                                   max_total_len=64)
+    try:
+        calls = []
+
+        def bad(tok):
+            calls.append(tok)
+            raise RuntimeError('client gone')
+
+        p = [5, 9, 2]
+        full = eng.submit(p, max_new_tokens=6, on_token=bad).result(
+            timeout=180)
+        assert len(full) == len(p) + 6      # request completed
+        assert len(calls) == 1              # callback dropped after 1
+        # The engine still serves subsequent requests.
+        again = eng.submit(p, max_new_tokens=2).result(timeout=180)
+        assert again == full[:len(p) + 2]
+    finally:
+        eng.stop()
