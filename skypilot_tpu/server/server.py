@@ -100,6 +100,17 @@ async def api_stream(request: web.Request) -> web.StreamResponse:
     if record is None:
         return web.json_response({'error': 'request not found'}, status=404)
 
+    # Multi-replica: request logs are REPLICA-LOCAL files. A request
+    # that ran on a peer streams from that peer (server_id is
+    # host:port, directly dialable inside the deployment) — clients
+    # can hit any replica behind one Service and still get logs.
+    owner = record.get('server_id')
+    if owner and owner != executor.get_server_id() and \
+            not os.path.exists(record['log_path']) and \
+            request.query.get('noproxy') != '1':
+        return await _proxy_peer_stream(request, owner, request_id,
+                                        follow)
+
     def finished() -> bool:
         rec = executor.get_request(request_id)
         return rec is None or rec['status'].is_terminal()
@@ -108,6 +119,39 @@ async def api_stream(request: web.Request) -> web.StreamResponse:
         request,
         lambda: log_lib.tail_logs(record['log_path'], follow=follow,
                                   stop_condition=finished))
+
+
+async def _proxy_peer_stream(request: web.Request, owner: str,
+                             request_id: str,
+                             follow: bool) -> web.StreamResponse:
+    """Relay /api/stream from the replica that ran the request.
+    `noproxy=1` on the hop prevents a loop if the peer's log file is
+    also gone (it then serves its own empty answer)."""
+    import aiohttp
+    url = (f'http://{owner}/api/stream?request_id={request_id}'
+           f'&follow={"1" if follow else "0"}&noproxy=1')
+    headers = {}
+    auth = request.headers.get('Authorization')
+    if auth:
+        headers['Authorization'] = auth
+    try:
+        timeout = aiohttp.ClientTimeout(total=None, sock_connect=5)
+        async with aiohttp.ClientSession(timeout=timeout) as session:
+            async with session.get(url, headers=headers) as upstream:
+                resp = web.StreamResponse(
+                    status=upstream.status,
+                    headers={'Content-Type':
+                             upstream.headers.get('Content-Type',
+                                                  'text/plain')})
+                await resp.prepare(request)
+                async for chunk in upstream.content.iter_chunked(8192):
+                    await resp.write(chunk)
+                await resp.write_eof()
+                return resp
+    except Exception as e:  # pylint: disable=broad-except
+        return web.json_response(
+            {'error': f'request ran on replica {owner}, which is not '
+                      f'reachable from here: {e}'}, status=502)
 
 
 async def api_cancel(request: web.Request) -> web.Response:

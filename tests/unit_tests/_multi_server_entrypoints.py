@@ -8,3 +8,8 @@ import time
 def slow_echo(seconds: float, value: str) -> str:
     time.sleep(seconds)
     return value
+
+
+def chatty(message: str) -> str:
+    print(f'chatty says: {message}', flush=True)
+    return message

@@ -83,13 +83,19 @@ def test_oidc_auth(isolated_state):
          '--port', str(port)], env=env,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
     try:
-        deadline = time.time() + 30
+        # Generous readiness window: server startup imports are slow
+        # under a loaded host (parallel test runs on 1 core).
+        deadline = time.time() + 120
+        ready = False
         while time.time() < deadline:
             try:
                 if requests.get(f'{url}/api/health', timeout=2).ok:
+                    ready = True
                     break
             except requests.RequestException:
+                assert proc.poll() is None, proc.stdout.read()
                 time.sleep(0.3)
+        assert ready, 'server never became healthy'
         # No bearer -> 401 (OIDC configured means auth required).
         assert requests.post(f'{url}/check', json={},
                              timeout=5).status_code == 401

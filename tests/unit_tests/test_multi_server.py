@@ -246,7 +246,11 @@ def test_two_server_failover_chaos(tmp_path):
             timeout=60)
         worker_pid = int(pid_q.stdout.strip().splitlines()[-1])
         if worker_pid > 0:
-            os.kill(worker_pid, signal.SIGKILL)
+            import contextlib
+            with contextlib.suppress(ProcessLookupError):
+                # Already gone is fine (died with the server, or the
+                # request finished under a slow, loaded host).
+                os.kill(worker_pid, signal.SIGKILL)
 
         # The survivor re-queues (heartbeat stale after 6s), re-claims
         # and reruns; the ORIGINAL request id resolves SUCCEEDED.
@@ -259,6 +263,105 @@ def test_two_server_failover_chaos(tmp_path):
         assert rec and rec['status'] == 'SUCCEEDED', rec
         assert rec['return_value'] == 'survived'
         assert rec['server_id'].endswith(f':{survivor}')
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)
+
+
+@pytest.mark.slow
+@pytest.mark.e2e
+def test_request_log_streams_from_owning_replica(tmp_path):
+    """Request logs are replica-local files: a replica that does NOT
+    have the file proxies /api/stream from the owner (server_id is
+    host:port). Simulated by giving replica B a request row whose
+    log_path does not exist locally and whose server_id names A."""
+    env_base = {
+        'PYTHONPATH': f"{_REPO}:"
+                      f"{os.path.join(_REPO, 'tests', 'unit_tests')}:"
+                      f"{os.environ.get('PYTHONPATH', '')}",
+        'SKYPILOT_STATUS_REFRESH_INTERVAL': '0',
+        'SKYPILOT_LIVENESS_SWEEP_INTERVAL': '0',
+        'SKYPILOT_REQUEST_GC_INTERVAL': '0',
+        'SKYPILOT_STALE_REQUEUE_INTERVAL': '0',
+    }
+    homes = [str(tmp_path / 'a'), str(tmp_path / 'b')]
+    ports = [_free_port(), _free_port()]
+    procs = []
+    try:
+        for home, port in zip(homes, ports):
+            env = {**os.environ, **env_base, 'SKYPILOT_TPU_HOME': home}
+            procs.append(subprocess.Popen(
+                [sys.executable, '-m', 'skypilot_tpu.server.server',
+                 '--port', str(port)],
+                cwd=_REPO, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True))
+        for port, proc in zip(ports, procs):
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                try:
+                    urllib.request.urlopen(
+                        f'http://127.0.0.1:{port}/api/health',
+                        timeout=2)
+                    break
+                except OSError:
+                    assert proc.poll() is None, proc.stdout.read()
+                    time.sleep(0.5)
+
+        # Run a real request on A (its log lands in A's home).
+        env_a = {**os.environ, **env_base,
+                 'SKYPILOT_TPU_HOME': homes[0]}
+        ins = subprocess.run(
+            [sys.executable, '-c',
+             'from skypilot_tpu.server.requests import executor;'
+             "print(executor.schedule_request('chk', "
+             "'_multi_server_entrypoints.chatty', "
+             "{'message': 'from-replica-a'}))"],
+            cwd=_REPO, env=env_a, capture_output=True, text=True,
+            timeout=60)
+        assert ins.returncode == 0, ins.stdout + ins.stderr
+        rid = ins.stdout.strip().splitlines()[-1]
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            with urllib.request.urlopen(
+                    f'http://127.0.0.1:{ports[0]}/api/get?request_id='
+                    f'{rid}&timeout=5', timeout=30) as r:
+                rec = json.loads(r.read())
+            if rec['status'] in ('SUCCEEDED', 'FAILED'):
+                break
+        assert rec['status'] == 'SUCCEEDED', rec
+        owner = rec['server_id']
+
+        # Replant the row in B's DB with A as owner and a log path
+        # that does not exist on "B" — the cross-host shape.
+        plant = subprocess.run(
+            [sys.executable, '-c', f'''
+from skypilot_tpu.server.requests import executor
+rid = executor.schedule_request('chk', 'noop', {{}})
+executor._db().execute(
+    "UPDATE requests SET request_id=?, server_id=?, status=?, "
+    "log_path=? WHERE request_id=?",
+    ("{rid}", "{owner}", "SUCCEEDED", "/nonexistent/{rid}.log", rid))
+print("ok")
+'''],
+            cwd=_REPO,
+            env={**os.environ, **env_base,
+                 'SKYPILOT_TPU_HOME': homes[1]},
+            capture_output=True, text=True, timeout=60)
+        assert plant.returncode == 0, plant.stdout + plant.stderr
+
+        # Streaming from B transparently serves A's log content.
+        with urllib.request.urlopen(
+                f'http://127.0.0.1:{ports[1]}/api/stream?request_id='
+                f'{rid}&follow=0', timeout=30) as r:
+            body = r.read().decode()
+        assert 'chatty says: from-replica-a' in body, body
+        with urllib.request.urlopen(
+                f'http://127.0.0.1:{ports[0]}/api/stream?request_id='
+                f'{rid}&follow=0', timeout=30) as r:
+            direct = r.read().decode()
+        assert body == direct
     finally:
         for proc in procs:
             if proc.poll() is None:
