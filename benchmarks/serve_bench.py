@@ -55,6 +55,11 @@ def main() -> None:
     parser.add_argument('--speculative', type=int, default=0,
                         metavar='K', help='prompt-lookup speculation '
                         '(works with both engines)')
+    parser.add_argument('--decode-chunk', type=int, default=1,
+                        metavar='N',
+                        help='continuous engine: N decode steps per '
+                             'dispatch (dispatch-overhead '
+                             'amortization)')
     parser.add_argument('--repetitive', action='store_true',
                         help='structured (repeated-trigram) prompts — '
                              'the regime speculation accelerates')
@@ -71,6 +76,10 @@ def main() -> None:
     parser.add_argument('--cpu', action='store_true',
                         help='pin the server to the CPU backend')
     args = parser.parse_args()
+    if args.decode_chunk > 1 and args.engine != 'continuous':
+        parser.error('--decode-chunk is a continuous-engine knob; '
+                     'the one-shot engine would silently ignore it '
+                     '(and the A/B record would lie)')
 
     port = _free_port()
     cmd = [sys.executable, '-m', 'skypilot_tpu.recipes.serve_lm',
@@ -83,6 +92,8 @@ def main() -> None:
         cmd += ['--no-prefix-caching']
     if args.speculative:
         cmd += ['--speculative', str(args.speculative)]
+    if args.decode_chunk > 1:
+        cmd += ['--decode-chunk', str(args.decode_chunk)]
     if args.hf:
         cmd += ['--hf', args.hf]
     if args.ckpt_dir:
@@ -194,6 +205,7 @@ def main() -> None:
         print(json.dumps({
             'engine': args.engine,
             'speculative': args.speculative,
+            'decode_chunk': args.decode_chunk,
             'shared_prefix': args.shared_prefix,
             'prefix_caching': not args.no_prefix_caching,
             'model': info['model'],   # server-reported (handles --hf)

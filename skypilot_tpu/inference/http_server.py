@@ -83,14 +83,13 @@ def serve(rt: InferenceRuntime, port: int,
                 self._stats()
                 return
             # Advertise the MINIMUM capacity across request classes
-            # (greedy requests may run through the speculative engine
-            # at spec_total) — clients sizing prompts off this can
-            # never be rejected.
+            # (speculative clamp, decode-chunk clamp) — clients sizing
+            # prompts off this can never be rejected.
             self._json({'status': 'ok',
                         'model': rt.model_name,
                         'vocab_size': rt.vocab_size,
-                        'max_total_len': rt.spec_total
-                        if rt.speculative > 0 else rt.max_total_len})
+                        'max_total_len': min(rt.limit_for(0.0),
+                                             rt.limit_for(1.0))})
 
         def _stats(self):
             """Engine observability (the vLLM /metrics idea, JSON):

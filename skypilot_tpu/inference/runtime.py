@@ -129,6 +129,7 @@ class InferenceRuntime:
     def __init__(self, *, model, params, vocab_size: int,
                  model_name: str, max_total_len: int, spec_total: int,
                  speculative: int, engine=None,
+                 engine_total: Optional[int] = None,
                  tokenizer_dir: Optional[str] = None,
                  stream_slots: int = 2) -> None:
         import jax
@@ -140,8 +141,12 @@ class InferenceRuntime:
         self.spec_total = spec_total
         self.speculative = speculative
         self.engine = engine
-        self.engine_total = (spec_total if speculative > 0
-                             else max_total_len)
+        # engine_total overrides when the constructed engine's
+        # capacity differs from the derived default (decode-chunk
+        # clamp) — limit_for/advertised capacity must match what
+        # engine.submit actually accepts.
+        self.engine_total = engine_total if engine_total is not None \
+            else (spec_total if speculative > 0 else max_total_len)
         self.tokenizer_dir = tokenizer_dir
         self.metrics = ServingMetrics()
 
@@ -369,11 +374,27 @@ def build_runtime(args) -> InferenceRuntime:
     engine = None
     if args.continuous_batching:
         from skypilot_tpu.models.batching import ContinuousBatchingEngine
+        decode_chunk = getattr(args, 'decode_chunk', 1)
+        if decode_chunk > 1:
+            # The chunk writes past a finishing request; clamp like
+            # the speculative engine does (fail fast at startup) and
+            # ADVERTISE the clamped capacity (limit_for must match
+            # what engine.submit accepts).
+            clamped = min(engine_total,
+                          model.config.max_seq_len - decode_chunk)
+            if clamped < engine_total:
+                print(f'decode chunking: clamping max_total_len '
+                      f'{engine_total} -> {clamped} (chunk writes '
+                      f'need N={decode_chunk} tokens of headroom '
+                      f'below max_seq_len='
+                      f'{model.config.max_seq_len})', flush=True)
+            engine_total = clamped
         engine = ContinuousBatchingEngine(
             model, params, num_slots=args.num_slots,
             max_total_len=engine_total,
             prefix_caching=not args.no_prefix_caching,
-            speculative_k=args.speculative)
+            speculative_k=args.speculative,
+            decode_chunk=decode_chunk)
 
     return InferenceRuntime(
         model=model, params=params, vocab_size=vocab_size,
@@ -381,4 +402,5 @@ def build_runtime(args) -> InferenceRuntime:
                     if args.hf else args.model),
         max_total_len=args.max_total_len, spec_total=spec_total,
         speculative=args.speculative, engine=engine,
+        engine_total=engine_total if engine is not None else None,
         tokenizer_dir=tokenizer_dir)

@@ -127,8 +127,32 @@ class ContinuousBatchingEngine:
                  paged: Optional[bool] = None,
                  prefix_caching: bool = True,
                  speculative_k: int = 0, spec_ngram: int = 2,
-                 spec_lookback: int = 512) -> None:
+                 spec_lookback: int = 512,
+                 decode_chunk: int = 1) -> None:
         assert max_total_len <= model.config.max_seq_len
+        # Chunked decode: N single-token steps in ONE jitted lax.scan
+        # dispatch (the serving analog of the trainer's multi-step) —
+        # outputs are BIT-IDENTICAL to step-by-step because the rng
+        # split chain is the same, and post-limit/post-eos junk writes
+        # follow the speculative write-before-read contract. Pays on
+        # dispatch-overhead-bound hosts (TPU-over-relay: ~100ms per
+        # dispatch vs ~ms of decode compute); costs up to N-1 wasted
+        # steps per finishing request and batches admission at chunk
+        # boundaries. Mutually exclusive with speculation (verify
+        # chunks already amortize dispatches).
+        assert decode_chunk >= 1
+        assert not (decode_chunk > 1 and speculative_k), (
+            'decode_chunk composes with the plain decode loop only; '
+            'speculative verify chunks already commit multiple tokens '
+            'per dispatch')
+        self.decode_chunk = decode_chunk
+        if decode_chunk > 1:
+            assert max_total_len + decode_chunk <= \
+                model.config.max_seq_len, (
+                    f'decode_chunk={decode_chunk} writes up to that '
+                    f'many positions past a finishing request: '
+                    f'max_total_len({max_total_len}) + chunk must be '
+                    f'<= max_seq_len({model.config.max_seq_len})')
         if speculative_k:
             # Verification chunks write up to K past the last kept
             # token — same headroom contract as the one-shot
@@ -156,11 +180,13 @@ class ContinuousBatchingEngine:
         # pool can hold a full-depth sequence.
         cfg_page = getattr(model.config, 'kv_page_size', 0)
         cfg_pool = getattr(model.config, 'kv_total_pages', 0)
-        # Speculative chunks write K tokens past the last committed
-        # one: the pool and each row's page table carry that headroom.
+        # Speculative verify chunks write K tokens — and decode chunks
+        # N-1 tokens — past the last committed one: the pool and each
+        # row's page table carry that headroom.
+        self._write_lookahead = max(self.spec_k, self.decode_chunk - 1)
         pool_ok = (cfg_page > 0 and cfg_pool > 0 and
                    (cfg_pool - 1) * cfg_page >=
-                   max_total_len + self.spec_k)
+                   max_total_len + self._write_lookahead)
         if paged is None:
             # Auto-on only when the pool can hold at least ONE
             # full-depth sequence — a small default pool must not
@@ -172,15 +198,16 @@ class ContinuousBatchingEngine:
                 f'paged=True but kv_total_pages={cfg_pool} x '
                 f'kv_page_size={cfg_page} cannot hold one '
                 f'max_total_len={max_total_len} sequence '
-                f'(+{self.spec_k} speculative headroom; usable '
-                f'{(max(cfg_pool - 1, 0)) * cfg_page} tokens; '
+                f'(+{self._write_lookahead} chunk-write headroom; '
+                f'usable {(max(cfg_pool - 1, 0)) * cfg_page} tokens; '
                 f'page 0 is reserved).')
         self.paged = paged
         if self.paged:
             self.page_size = cfg_page
             self.total_pages = cfg_pool
             self.pages_per_seq = -(
-                -(max_total_len + self.spec_k) // self.page_size)
+                -(max_total_len + self._write_lookahead)
+                // self.page_size)
         self.prefix_caching = bool(prefix_caching and self.paged)
         self.prefix_cache: Optional[PrefixCache] = None  # set per reset
 
@@ -207,6 +234,8 @@ class ContinuousBatchingEngine:
         self.decode_calls = 0
         self.tokens_committed = 0
 
+        self._chunk_decode = (self._make_chunk_decode_fn()
+                              if self.decode_chunk > 1 else None)
         self._queue: 'queue.Queue' = queue.Queue()
         # FCFS admission order, owned by the scheduler thread: requests
         # drain from _queue into _ready; a stalled (page-pressure) or
@@ -286,6 +315,42 @@ class ContinuousBatchingEngine:
             return mutated['cache'], out
 
         return decode
+
+    def _make_chunk_decode_fn(self):
+        """N single-token decode steps in ONE jitted dispatch: the
+        whole chunk is a lax.scan whose carry is (cache, token, pos,
+        rng). The rng chain is jax.random.split exactly as the
+        step-by-step loop performs it, so sampled outputs are
+        bit-identical; the host commits tokens afterwards, truncating
+        at each slot's limit/eos/stop (post-finish writes are junk the
+        next chunk or prefill overwrites before attending — the
+        write-before-read contract shared with speculation)."""
+        model = self.model
+        paged = self.paged
+        n = self.decode_chunk
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def chunk_decode(params, cache, cur_token, pos, temps, top_ks,
+                         top_ps, rng, page_indices=None):
+            from skypilot_tpu.models.generate import sample_tokens
+            extra = {'page_indices': page_indices} if paged else {}
+
+            def step(carry, _):
+                cache, tok, pos, rng = carry
+                logits, mutated = model.apply(
+                    {'params': params, 'cache': cache},
+                    tok[:, None], positions=pos[:, None], decode=True,
+                    mutable=['cache'], **extra)
+                rng, sub = jax.random.split(rng)
+                out = sample_tokens(sub, logits[:, 0], temps, top_ks,
+                                    top_ps)
+                return (mutated['cache'], out, pos + 1, rng), out
+
+            (cache, _, _, rng), toks = jax.lax.scan(
+                step, (cache, cur_token, pos, rng), None, length=n)
+            return cache, toks, rng            # toks: [n, slots]
+
+        return chunk_decode
 
     def _make_spec_decode_fn(self):
         """Verification step for prompt-lookup speculation: a
@@ -664,11 +729,11 @@ class ContinuousBatchingEngine:
             limit = min(plen + max_new, self.max_total_len)
             if self.paged:
                 # The pool bounds the deepest any sequence can get
-                # (minus speculative lookahead writes); admission would
+                # (minus chunk-write lookahead); admission would
                 # otherwise hand out a limit the allocator can never
                 # satisfy even running alone.
                 limit = min(limit, (self.total_pages - 1) *
-                            self.page_size - self.spec_k)
+                            self.page_size - self._write_lookahead)
             self.limits[slot] = limit
             self.temps[slot] = temp
             self.top_ks[slot] = top_k
@@ -792,9 +857,33 @@ class ContinuousBatchingEngine:
         if fut is not None:
             fut.set_result(list(self.outputs[slot]))
 
+    def _commit_token(self, slot: int, next_tok: int) -> bool:
+        """Commit the slot's pending cur_token (append + stream +
+        advance) and install `next_tok` as the new pending token;
+        finish the slot (returning True) on limit/eos/stop. The ONE
+        copy of the commit contract, shared by the plain, chunked,
+        and speculative decode loops."""
+        tok = int(self.cur_token[slot])
+        self.outputs[slot].append(tok)
+        self._emit(slot, tok)
+        self.tokens_committed += 1
+        self.pos[slot] += 1
+        self.cur_token[slot] = int(next_tok)
+        done = len(self.outputs[slot]) >= int(self.limits[slot])
+        if self.eos_id is not None and tok == self.eos_id:
+            done = True
+        if tok in self.stop_ids[slot]:
+            done = True
+        if done:
+            self._finish_slot(slot)
+        return done
+
     def _decode_step(self) -> None:
         if self.spec_k:
             self._spec_decode_step()
+            return
+        if self.decode_chunk > 1:
+            self._chunk_decode_step()
             return
         self._rng, sub = jax.random.split(self._rng)
         extra = ()
@@ -816,19 +905,36 @@ class ContinuousBatchingEngine:
         for slot in range(self.num_slots):
             if not self.active[slot]:
                 continue
-            tok = int(self.cur_token[slot])
-            self.outputs[slot].append(tok)
-            self._emit(slot, tok)
-            self.tokens_committed += 1
-            self.pos[slot] += 1
-            self.cur_token[slot] = int(sampled[slot])
-            done = len(self.outputs[slot]) >= int(self.limits[slot])
-            if self.eos_id is not None and tok == self.eos_id:
-                done = True
-            if tok in self.stop_ids[slot]:
-                done = True
-            if done:
-                self._finish_slot(slot)
+            self._commit_token(slot, int(sampled[slot]))
+
+    def _chunk_decode_step(self) -> None:
+        """One chunked round: decode_chunk tokens for every active
+        slot in ONE dispatch; commit host-side, truncating each slot
+        at its limit/eos/stop (a finished slot's remaining chunk
+        tokens are discarded — up to N-1 wasted steps, the price of
+        amortizing dispatch overhead)."""
+        n = self.decode_chunk
+        extra = ()
+        if self.paged:
+            # The chunk writes positions pos..pos+n-1 (+1 commit room).
+            self._grow_pages(lookahead=n)
+            if not self.active.any():
+                return
+            extra = (jnp.asarray(self.page_table),)
+        was_active = self.active.copy()
+        self.cache, toks, self._rng = self._chunk_decode(
+            self.params, self.cache, jnp.asarray(self.cur_token),
+            jnp.asarray(self.pos), jnp.asarray(self.temps),
+            jnp.asarray(self.top_ks), jnp.asarray(self.top_ps),
+            self._rng, *extra)
+        toks = np.asarray(jax.device_get(toks))       # [n, slots]
+        self.decode_calls += 1
+        for slot in range(self.num_slots):
+            if not was_active[slot]:
+                continue
+            for i in range(n):
+                if self._commit_token(slot, int(toks[i, slot])):
+                    break  # finished: discard the chunk's tail
 
     def _spec_decode_step(self) -> None:
         """One speculative round: draft K tokens per slot (host-side
@@ -865,20 +971,9 @@ class ContinuousBatchingEngine:
             # Commit: the pending current token, then every accepted
             # draft; each commit's successor is the model's own token
             # for that position (y), so the final pending token is the
-            # first correction.
-            commits = [int(self.cur_token[slot])]
-            commits += [int(t) for t in drafts[slot, :accept]]
-            for tok, nxt in zip(commits, y[slot, :accept + 1]):
-                self.outputs[slot].append(tok)
-                self._emit(slot, tok)
-                self.tokens_committed += 1
-                self.pos[slot] += 1
-                self.cur_token[slot] = int(nxt)
-                done = len(self.outputs[slot]) >= int(self.limits[slot])
-                if self.eos_id is not None and tok == self.eos_id:
-                    done = True
-                if tok in self.stop_ids[slot]:
-                    done = True
-                if done:
-                    self._finish_slot(slot)
+            # first correction. (The accepted-prefix invariant makes
+            # cur_token equal the next commit at every step, so the
+            # shared _commit_token applies unchanged.)
+            for nxt in y[slot, :accept + 1]:
+                if self._commit_token(slot, int(nxt)):
                     break

@@ -45,6 +45,16 @@ def main() -> None:
                         help='slot-based engine: concurrent requests '
                              'share the decode loop')
     parser.add_argument('--num-slots', type=int, default=8)
+    parser.add_argument('--decode-chunk', type=int, default=1,
+                        metavar='N',
+                        help='continuous engine: N decode steps per '
+                             'jitted dispatch (lax.scan) — outputs '
+                             'identical to step-by-step; amortizes '
+                             'per-dispatch host overhead (the serving '
+                             'analog of the trainer multi-step). '
+                             'Trade-off: up to N-1 wasted steps per '
+                             'finishing request, admission at chunk '
+                             'boundaries. Exclusive with --speculative')
     parser.add_argument('--speculative', type=int, default=0,
                         metavar='K',
                         help='prompt-lookup speculative decoding with K '
