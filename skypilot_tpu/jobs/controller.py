@@ -278,6 +278,19 @@ class JobController:
             if self._cancelled:
                 raise JobCancelled()
             time.sleep(_POLL_SECONDS)
+            # External failure sources (health monitors, maintenance
+            # schedulers) short-circuit the probe/grace machinery:
+            # a reported failure recovers NOW.
+            from skypilot_tpu.jobs import failure_sources
+            ext_reason = failure_sources.check_failed(self.cluster_name)
+            if ext_reason is not None:
+                ux_utils.log(
+                    f'Managed job {job_id}: external failure source '
+                    f'reports cluster {self.cluster_name} failed '
+                    f'({ext_reason}); recovering.')
+                agent_job_id = self._recover()
+                unreachable_since = None
+                continue
             agent = self._agent()
             status: Optional[agent_job_lib.JobStatus] = None
             if agent is not None:

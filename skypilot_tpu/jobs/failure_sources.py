@@ -1,0 +1,82 @@
+"""External cluster-failure sources for managed jobs.
+
+Reference: sky/utils/plugin_extensions ExternalClusterFailure,
+imported by sky/jobs/controller.py:54-55 — external systems (cloud
+health monitors, maintenance schedulers, capacity brokers) declare a
+cluster failed so the controller recovers IMMEDIATELY instead of
+waiting out probe timeouts and the unreachable grace window.
+
+Config:
+
+    jobs:
+      failure_sources:
+        - my_plugin.module.check   # importable callable
+
+Each callable takes no arguments and returns an iterable of failed
+clusters — either names or {'cluster': name, 'reason': text} dicts.
+Sources are polled every monitor tick; a broken source is logged and
+isolated (it must never take the controller down), and a source that
+fails repeatedly keeps being retried (the external system may be
+restarting).
+"""
+from __future__ import annotations
+
+import importlib
+import threading
+from typing import Callable, Dict, List, Optional
+
+from skypilot_tpu.utils import ux_utils
+
+_lock = threading.Lock()
+_cache: Optional[List[Callable]] = None
+
+
+def _load_sources() -> List[Callable]:
+    """Resolve configured source callables (memoized; a controller is
+    one process per job, so config changes apply on its next spawn)."""
+    global _cache
+    with _lock:
+        if _cache is not None:
+            return _cache
+        from skypilot_tpu import sky_config
+        paths = sky_config.get_nested(('jobs', 'failure_sources'),
+                                      []) or []
+        sources: List[Callable] = []
+        for path in paths:
+            try:
+                module_name, attr = str(path).rsplit('.', 1)
+                fn = getattr(importlib.import_module(module_name), attr)
+                if not callable(fn):
+                    raise TypeError(f'{path} is not callable')
+                sources.append(fn)
+            except Exception as e:  # pylint: disable=broad-except
+                ux_utils.log(f'jobs.failure_sources: skipping '
+                             f'{path!r}: {e!r}')
+        _cache = sources
+        return sources
+
+
+def reset() -> None:
+    """Drop the memoized sources (tests)."""
+    global _cache
+    with _lock:
+        _cache = None
+
+
+def check_failed(cluster_name: str) -> Optional[str]:
+    """Ask every configured source whether `cluster_name` is failed;
+    returns the first reported reason, else None. Never raises."""
+    for fn in _load_sources():
+        try:
+            for item in (fn() or ()):
+                if isinstance(item, dict):
+                    name = item.get('cluster')
+                    reason = item.get('reason', 'external source')
+                else:
+                    name, reason = item, 'external source'
+                if name == cluster_name:
+                    return str(reason)
+        except Exception as e:  # pylint: disable=broad-except
+            ux_utils.log(f'jobs.failure_sources: source {fn!r} '
+                         f'failed: {e!r}')
+    return None

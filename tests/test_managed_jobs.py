@@ -301,3 +301,107 @@ def test_pipeline_stops_at_failing_stage(jobs_env, tmp_path):
     assert final == state.ManagedJobStatus.FAILED
     assert marker.read_text().split() == ['ran']  # stage 3 never ran
     assert int(state.get_job(result['job_id'])['stage']) == 1
+
+
+def test_failure_sources_module(monkeypatch):
+    """Source loading + isolation: broken paths/sources are skipped,
+    reports match by name or dict, nothing ever raises."""
+    from skypilot_tpu.jobs import failure_sources
+    from skypilot_tpu import sky_config
+    calls = {'n': 0}
+
+    def fake_get_nested(keys, default=None, **kw):
+        if keys == ('jobs', 'failure_sources'):
+            return ['tests_fake_mod.nope', 'os.path.join',  # join(!) -> TypeError on call
+                    'test_managed_jobs._fake_source']
+        return default
+
+    monkeypatch.setattr(sky_config, 'get_nested', fake_get_nested)
+    failure_sources.reset()
+    try:
+        global _fake_source_reports
+        _fake_source_reports = [{'cluster': 'c1', 'reason': 'maint'},
+                                'c2']
+        assert failure_sources.check_failed('c1') == 'maint'
+        assert failure_sources.check_failed('c2') == 'external source'
+        assert failure_sources.check_failed('c3') is None
+        _fake_source_reports = []
+        assert failure_sources.check_failed('c1') is None
+    finally:
+        failure_sources.reset()
+
+
+_fake_source_reports = []
+
+
+def _fake_source():
+    return list(_fake_source_reports)
+
+
+@pytest.mark.slow
+def test_managed_job_recovers_on_external_failure_report(jobs_env,
+                                                         monkeypatch):
+    """An external failure source (jobs.failure_sources plugin)
+    reporting the job's cluster triggers IMMEDIATE recovery — no probe
+    timeout, no unreachable grace (the cluster's agents are still
+    alive and healthy)."""
+    import sys
+    import yaml
+    # Plugin module + its report file live in the isolated home; the
+    # controller subprocess imports it via PYTHONPATH.
+    plugin = os.path.join(jobs_env, 'ext_fail_plugin.py')
+    report = os.path.join(jobs_env, 'failed_clusters.txt')
+    with open(plugin, 'w', encoding='utf-8') as f:
+        f.write(
+            'import os\n'
+            f'_REPORT = {report!r}\n'
+            'def failed():\n'
+            '    if not os.path.exists(_REPORT):\n'
+            '        return []\n'
+            '    with open(_REPORT) as f:\n'
+            '        return [l.strip() for l in f if l.strip()]\n')
+    with open(os.path.join(jobs_env, 'config.yaml'), 'w',
+              encoding='utf-8') as f:
+        yaml.safe_dump(
+            {'jobs': {'failure_sources': ['ext_fail_plugin.failed']}},
+            f)
+    monkeypatch.setenv(
+        'PYTHONPATH', f"{jobs_env}:{os.environ.get('PYTHONPATH', '')}")
+
+    marker = os.path.join(jobs_env, 'mj-ext')
+    run = f'echo started >> {marker}; sleep 300'
+    result = jobs_core.launch(_task_config(run), user='t')
+    job_id = result['job_id']
+    _wait_status(job_id, [state.ManagedJobStatus.RUNNING], timeout=90)
+    deadline = time.time() + 60
+    while time.time() < deadline and not os.path.exists(marker):
+        time.sleep(1)
+    assert os.path.exists(marker)
+
+    # The external system declares the cluster failed (agents are
+    # still perfectly healthy — only the report drives recovery).
+    with open(report, 'w', encoding='utf-8') as f:
+        f.write(f'managed-{job_id}\n')
+    # Recovery observably started (the status may transit RECOVERING
+    # -> RUNNING between polls; the bump is the durable signal)...
+    deadline = time.time() + 60
+    while time.time() < deadline and \
+            state.get_job(job_id)['recovery_count'] < 1:
+        time.sleep(0.5)
+    assert state.get_job(job_id)['recovery_count'] >= 1
+    # ...then clear the report so the recovered cluster isn't
+    # immediately re-reported.
+    os.unlink(report)
+    _wait_status(job_id, [state.ManagedJobStatus.RUNNING], timeout=120)
+
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        with open(marker, 'r', encoding='utf-8') as f:
+            if len(f.readlines()) >= 2:
+                break
+        time.sleep(1)
+    with open(marker, 'r', encoding='utf-8') as f:
+        assert len(f.readlines()) >= 2
+
+    jobs_core.cancel([job_id])
+    _wait_status(job_id, [state.ManagedJobStatus.CANCELLED], timeout=60)
