@@ -82,6 +82,14 @@ def serve(rt: InferenceRuntime, port: int,
             if self.path in ('/stats', '/v1/stats'):
                 self._stats()
                 return
+            if self.path == '/v1/models':
+                # OpenAI client bootstrap: most SDKs list models
+                # before first use.
+                self._json({'object': 'list',
+                            'data': [{'id': rt.model_name,
+                                      'object': 'model',
+                                      'owned_by': 'skypilot-tpu'}]})
+                return
             # Advertise the MINIMUM capacity across request classes
             # (speculative clamp, decode-chunk clamp) — clients sizing
             # prompts off this can never be rejected.
