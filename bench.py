@@ -64,6 +64,10 @@ def main() -> None:
                              'amortization) before the headline run; '
                              'results go to stderr, the JSON line is '
                              'unchanged')
+    parser.add_argument('--profile', default=None, metavar='DIR',
+                        help='jax.profiler trace of the FIRST timed '
+                             'repeat into DIR (TensorBoard/Perfetto) — '
+                             'the MFU triage artifact')
     parser.add_argument('--retries', type=int, default=1,
                         help='accelerator probe retries before CPU fallback')
     parser.add_argument('--init-timeout', type=float, default=300.0,
@@ -248,8 +252,14 @@ def main() -> None:
     per_chip_runs = []
     elapsed = None
     for r in range(max(1, args.repeats)):
+        if args.profile and r == 0:
+            jax.profiler.start_trace(args.profile)
         elapsed, state, loss = timed_run(state, step, tokens,
                                          args.steps)
+        if args.profile and r == 0:
+            jax.profiler.stop_trace()
+            print(f'# profile trace -> {args.profile}',
+                  file=sys.stderr)
         run_tps = batch * seq * args.steps * inner / elapsed / n_dev
         per_chip_runs.append(run_tps)
         print(f'# repeat {r + 1}/{args.repeats}: {run_tps:.1f} '

@@ -108,6 +108,17 @@ def main() -> None:
                              '(ring attention)')
     parser.add_argument('--remat', action='store_true')
     parser.add_argument('--log-every', type=int, default=10)
+    parser.add_argument('--profile', default=None, metavar='DIR',
+                        help='capture a jax.profiler trace '
+                             '(TensorBoard/Perfetto-readable) of a few '
+                             'steady-state steps into DIR — the MFU '
+                             'triage tool: fusion gaps, transfer '
+                             'stalls, collective overlap all show up '
+                             'in the trace')
+    parser.add_argument('--profile-steps', default='4:8',
+                        metavar='START:STOP',
+                        help='step window to trace (after compile; '
+                             'default 4:8)')
     parser.add_argument('--cpu', action='store_true',
                         help='pin the CPU backend (smoke/dev runs; the '
                              'JAX_PLATFORMS env var is overridden by '
@@ -243,11 +254,25 @@ def main() -> None:
                                dtype=np.int32)
         return shard_batch(jnp.asarray(arr), mesh)
 
+    prof_start = prof_stop = -1
+    if args.profile and proc_id == 0:
+        prof_start, prof_stop = (int(x) for x in
+                                 args.profile_steps.split(':'))
+
     start_step = int(state.step)
     t0 = time.perf_counter()
     window_tokens = 0
     for step in range(start_step, args.steps):
+        if step == prof_start:
+            jax.profiler.start_trace(args.profile)
         state, loss = step_fn(state, next_tokens())
+        if step + 1 == prof_stop:
+            # Block so the trace holds COMPLETE device timelines for
+            # the window, not just dispatches.
+            jax.block_until_ready(loss)
+            jax.profiler.stop_trace()
+            print(f'profile: steps {prof_start}..{prof_stop} traced '
+                  f'to {args.profile}', flush=True)
         window_tokens += batch * args.seq
         if mgr is not None:
             mgr.save(step + 1, state)

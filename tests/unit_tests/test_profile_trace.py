@@ -1,0 +1,49 @@
+"""--profile: jax.profiler traces from the training entrypoints.
+
+The MFU triage loop (BASELINE.md north-star #1) starts from a trace;
+these pin that both drivers actually produce TensorBoard/Perfetto
+artifacts (xplane.pb + trace.json.gz) for the requested step window.
+"""
+import glob
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _trace_files(root):
+    return (glob.glob(os.path.join(root, 'plugins', 'profile', '*',
+                                   '*.xplane.pb')) +
+            glob.glob(os.path.join(root, 'plugins', 'profile', '*',
+                                   '*.trace.json.gz')))
+
+
+@pytest.mark.slow
+def test_train_lm_profile_trace(tmp_path):
+    prof = str(tmp_path / 'trace')
+    out = subprocess.run(
+        [sys.executable, '-m', 'skypilot_tpu.recipes.train_lm',
+         '--cpu', '--model', 'tiny', '--steps', '10', '--seq', '32',
+         '--global-batch', '8', '--log-every', '5',
+         '--profile', prof, '--profile-steps', '4:7'],
+        cwd=_REPO, capture_output=True, text=True, timeout=420)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert 'profile: steps 4..7 traced' in out.stdout
+    files = _trace_files(prof)
+    assert any(f.endswith('.xplane.pb') for f in files), files
+    assert any(f.endswith('.trace.json.gz') for f in files), files
+
+
+@pytest.mark.slow
+def test_bench_profile_trace(tmp_path):
+    prof = str(tmp_path / 'trace')
+    out = subprocess.run(
+        [sys.executable, 'bench.py', '--smoke', '--repeats', '1',
+         '--steps', '4', '--profile', prof],
+        cwd=_REPO, capture_output=True, text=True, timeout=420)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert _trace_files(prof), os.listdir(prof)
