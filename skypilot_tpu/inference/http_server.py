@@ -270,7 +270,9 @@ def serve(rt: InferenceRuntime, port: int,
                     top_p=float(body.get('top_p', 1.0)),
                     stop_strings=body.get('stop') or [],
                     n=int(body.get('n', 1)),
-                    stream=bool(body.get('stream')))
+                    stream=bool(body.get('stream')),
+                    logprobs=body.get('logprobs'),
+                    echo=bool(body.get('echo')))
                 if req.stream:
                     oai.stream_completion(rt, req, self)
                 else:

@@ -129,7 +129,9 @@ class CompletionRequest:
 
     def __init__(self, prompts: List[str], max_new: int,
                  temperature: float, top_p: float,
-                 stop_strings, n: int, stream: bool) -> None:
+                 stop_strings, n: int, stream: bool,
+                 logprobs: Optional[int] = None,
+                 echo: bool = False) -> None:
         if isinstance(stop_strings, str):
             stop_strings = [stop_strings]
         if n < 1 or n > 16:
@@ -137,6 +139,16 @@ class CompletionRequest:
         if stream and len(prompts) != 1:
             raise ValueError(
                 'stream=true supports a single prompt per request')
+        if logprobs is not None:
+            logprobs = int(logprobs)
+            if not 0 <= logprobs <= 5:
+                raise ValueError(
+                    f'logprobs must be in [0, 5], got {logprobs}')
+            if stream:
+                raise ValueError(
+                    'logprobs with stream=true is not supported')
+        if echo and logprobs is None:
+            raise ValueError('echo requires logprobs')
         self.prompts = prompts
         self.max_new = max_new
         self.temperature = temperature
@@ -144,6 +156,42 @@ class CompletionRequest:
         self.stop_strings = list(stop_strings or [])
         self.n = n
         self.stream = stream
+        self.logprobs = logprobs
+        self.echo = echo
+
+
+def _logprobs_block(rt: InferenceRuntime, tok, row: List[int],
+                    n_top: int, echo: bool,
+                    prompt_len: int) -> Dict[str, object]:
+    """The OpenAI completions `logprobs` object for one choice:
+    per-token logprob + top-N alternatives + text offsets, computed
+    by ONE teacher-forced scoring pass (deterministic model — the
+    values equal what decode produced). With `echo`, prompt tokens
+    are covered too (position 0 scores as null)."""
+    import numpy as np
+    lp = rt.score_logprobs(row)                  # [T, vocab]
+    start = 0 if echo else prompt_len
+    tokens, token_logprobs, top_logprobs, offsets = [], [], [], []
+    offset = 0
+    for i in range(start, len(row)):
+        piece = tok.decode([row[i]])
+        tokens.append(piece)
+        offsets.append(offset)
+        offset += len(piece)
+        if i == 0:
+            token_logprobs.append(None)
+            top_logprobs.append(None)
+            continue
+        token_logprobs.append(round(float(lp[i - 1, row[i]]), 5))
+        if n_top > 0:
+            idx = np.argsort(lp[i - 1])[::-1][:n_top]
+            top_logprobs.append(
+                {tok.decode([int(t)]): round(float(lp[i - 1, t]), 5)
+                 for t in idx})
+        else:
+            top_logprobs.append({})
+    return {'tokens': tokens, 'token_logprobs': token_logprobs,
+            'top_logprobs': top_logprobs, 'text_offset': offsets}
 
 
 def run_completion(rt: InferenceRuntime, req: CompletionRequest
@@ -161,7 +209,14 @@ def run_completion(rt: InferenceRuntime, req: CompletionRequest
                              f'max_total_len {limit}')
     rows: List[List[int]] = []
     row_prompt: List[List[int]] = []  # prompt ids per output row
-    if rt.engine is not None:
+    if req.max_new <= 0:
+        # Scoring mode (echo + logprobs + max_tokens=0 — the eval-
+        # harness contract): no generation at all.
+        for ids in encoded:
+            for _ in range(req.n):
+                rows.append(list(ids))
+                row_prompt.append(ids)
+    elif rt.engine is not None:
         futs = []
         for ids in encoded:
             for _ in range(req.n):
@@ -202,8 +257,15 @@ def run_completion(rt: InferenceRuntime, req: CompletionRequest
         if hit:
             finish = 'stop'
         total_completion += n_gen
+        lp_block = None
+        if req.logprobs is not None:
+            lp_block = _logprobs_block(rt, tok, row, req.logprobs,
+                                       req.echo, len(ids))
+        if req.echo:
+            text = tok.decode(ids, skip_special_tokens=True) + text
         choices.append({'index': i, 'text': text,
-                        'finish_reason': finish, 'logprobs': None})
+                        'finish_reason': finish,
+                        'logprobs': lp_block})
     total_prompt = sum(len(ids) for ids in row_prompt)
     rt.metrics.record(time.monotonic() - t0, total_completion)
     return {

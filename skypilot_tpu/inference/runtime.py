@@ -212,6 +212,42 @@ class InferenceRuntime:
             self._rng, sub = jax.random.split(self._rng)
         return sub
 
+    def _score_fn(self, bucket: int):
+        """Jitted full-sequence log-softmax over a padded bucket
+        (teacher-forced scoring — the /v1/completions logprobs/echo
+        contract eval harnesses drive)."""
+        import jax
+        import jax.numpy as jnp
+        key = ('score', bucket)
+        with self._lock:
+            if key not in self._fns:
+                model = self.model
+
+                @jax.jit
+                def score(params, tokens):
+                    logits = model.apply({'params': params}, tokens)
+                    return jax.nn.log_softmax(
+                        logits.astype(jnp.float32), axis=-1)
+
+                self._fns[key] = score
+            return self._fns[key]
+
+    def score_logprobs(self, ids: List[int]):
+        """log P(token_i | tokens_<i) for the whole row: returns a
+        [len(ids), vocab] numpy array of log-probs (row i scores
+        position i+1's candidates)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        bucket = 8
+        while bucket < len(ids):
+            bucket *= 2
+        bucket = min(bucket, self.max_total_len)
+        fn = self._score_fn(bucket)
+        padded = list(ids) + [0] * (bucket - len(ids))
+        lp = fn(self.params, jnp.asarray([padded], jnp.int32))
+        return np.asarray(jax.device_get(lp))[0, :len(ids)]
+
     def one_shot_rows(self, rows: List[List[int]], max_new: int,
                       temperature: float) -> List[List[int]]:
         """Run ragged rows through power-of-two one-shot buckets and

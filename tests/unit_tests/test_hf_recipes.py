@@ -135,6 +135,30 @@ def test_serve_lm_hf_checkpoint(hf_ckpt):
         except HTTPError as e:
             assert e.code == 400
 
+        # logprobs + echo (the lm-eval scoring contract): max_tokens=0
+        # returns the prompt's per-token logprobs with no generation.
+        scored = _post(f'http://127.0.0.1:{port}/v1/completions',
+                       {'prompt': 'hello world the tpu',
+                        'max_tokens': 0, 'echo': True, 'logprobs': 2})
+        lp = scored['choices'][0]['logprobs']
+        assert scored['usage']['completion_tokens'] == 0
+        assert len(lp['tokens']) == 4
+        assert lp['token_logprobs'][0] is None       # no prefix
+        assert all(isinstance(v, float) and v <= 0
+                   for v in lp['token_logprobs'][1:])
+        assert all(len(t) == 2 for t in lp['top_logprobs'][1:])
+        assert lp['text_offset'][0] == 0
+
+        # Generated-token logprobs: greedy decoding means every
+        # generated token is its position's argmax — its logprob must
+        # equal the max of the reported top alternatives.
+        gen = _post(f'http://127.0.0.1:{port}/v1/completions',
+                    {**body, 'logprobs': 3})
+        glp = gen['choices'][0]['logprobs']
+        assert len(glp['tokens']) == 4               # completion only
+        for got, top in zip(glp['token_logprobs'], glp['top_logprobs']):
+            assert got == pytest.approx(max(top.values()), abs=1e-4)
+
         # Chat shim: messages render through the chat template (plain
         # role fallback for template-less checkpoints like this one)
         # and the answer comes back as an assistant message.
