@@ -284,6 +284,11 @@ def serve(rt: InferenceRuntime, port: int,
             try:
                 body = self._read_body()
                 prompt = oai.render_chat_prompt(rt, body['messages'])
+                # Modern chat knobs: logprobs is a bool +
+                # top_logprobs count (clamped to the engine's 5).
+                chat_lp = None
+                if body.get('logprobs'):
+                    chat_lp = min(int(body.get('top_logprobs', 0)), 5)
                 req = oai.CompletionRequest(
                     prompts=[prompt],
                     max_new=int(body.get('max_tokens', 16)),
@@ -291,7 +296,8 @@ def serve(rt: InferenceRuntime, port: int,
                     top_p=float(body.get('top_p', 1.0)),
                     stop_strings=body.get('stop') or [],
                     n=int(body.get('n', 1)),
-                    stream=bool(body.get('stream')))
+                    stream=bool(body.get('stream')),
+                    logprobs=chat_lp)
                 if req.stream:
                     oai.stream_completion(rt, req, self, chat=True)
                 else:

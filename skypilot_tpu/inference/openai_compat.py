@@ -364,4 +364,23 @@ def to_chat_response(out: Dict[str, object]) -> Dict[str, object]:
     out['object'] = 'chat.completion'
     for c in out['choices']:
         c['message'] = {'role': 'assistant', 'content': c.pop('text')}
+        lp = c.get('logprobs')
+        if lp:
+            # Legacy completions block -> modern chat format
+            # ({content: [{token, logprob, bytes, top_logprobs}]}).
+            content = []
+            for token, logprob, top in zip(lp['tokens'],
+                                           lp['token_logprobs'],
+                                           lp['top_logprobs']):
+                content.append({
+                    'token': token,
+                    'logprob': logprob,
+                    'bytes': list(token.encode()),
+                    'top_logprobs': [
+                        {'token': t, 'logprob': v,
+                         'bytes': list(t.encode())}
+                        for t, v in sorted((top or {}).items(),
+                                           key=lambda kv: -kv[1])],
+                })
+            c['logprobs'] = {'content': content}
     return out

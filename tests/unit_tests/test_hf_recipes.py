@@ -172,6 +172,23 @@ def test_serve_lm_hf_checkpoint(hf_ckpt):
         assert msg['role'] == 'assistant'
         assert isinstance(msg['content'], str)
         assert out['usage']['completion_tokens'] == 4
+
+        # Modern chat logprobs format: per-token content entries with
+        # sorted top_logprobs.
+        out = _post(f'http://127.0.0.1:{port}/v1/chat/completions',
+                    {'messages': [{'role': 'user', 'content':
+                                   'hello world'}],
+                     'max_tokens': 3, 'temperature': 0,
+                     'logprobs': True, 'top_logprobs': 2})
+        content = out['choices'][0]['logprobs']['content']
+        assert len(content) == 3
+        for entry in content:
+            assert isinstance(entry['token'], str)
+            assert entry['logprob'] <= 0
+            assert len(entry['top_logprobs']) == 2
+            # Greedy: chosen token's logprob == the best alternative.
+            assert entry['logprob'] == pytest.approx(
+                entry['top_logprobs'][0]['logprob'], abs=1e-4)
     finally:
         proc.terminate()
         proc.wait(timeout=10)
