@@ -97,9 +97,11 @@ def main() -> None:
     parser.add_argument('--pipeline-stages', type=int, default=1,
                         help='GPipe pipeline parallelism over a stage '
                              'mesh axis (parallel/pipeline.py; '
-                             'GPT/Llama/Mixtral families, v1: composes '
-                             'with data parallelism only). '
-                             'num_layers must divide into stages')
+                             'GPT/Llama/Mixtral/DeepSeek). Composes '
+                             'with --tensor/--expert (sharded WITHIN '
+                             'each stage) and data parallelism; '
+                             'uneven num_layers pads with masked '
+                             'identity slots')
     parser.add_argument('--microbatches', type=int, default=0,
                         help='pipeline microbatches (0 = 4 x stages; '
                              'utilization = M / (M + stages - 1))')
@@ -143,16 +145,22 @@ def main() -> None:
         raise SystemExit('--microbatches only applies with '
                          '--pipeline-stages > 1')
     if args.pipeline_stages > 1:
-        if (args.tensor, args.expert, args.seq_parallel) != (1, 1, 1):
-            raise SystemExit('--pipeline-stages composes with data '
-                             'parallelism only (v1); drop '
-                             '--tensor/--expert/--seq-parallel')
-        if n_dev % args.pipeline_stages:
-            raise SystemExit(f'{n_dev} devices not divisible by '
-                             f'{args.pipeline_stages} pipeline stages')
+        # v2: tensor and expert shard WITHIN each pipeline stage
+        # (shard_map auto axes — GSPMD inserts the within-stage
+        # collectives); sequence parallelism stays exclusive (the
+        # ring-attention dispatch assumes the non-pipeline trainer).
+        if args.seq_parallel != 1:
+            raise SystemExit('--pipeline-stages does not compose with '
+                             '--seq-parallel; drop one')
+        inner = args.pipeline_stages * args.tensor * args.expert
+        if n_dev % inner:
+            raise SystemExit(
+                f'{n_dev} devices not divisible by stages x tensor x '
+                f'expert = {inner}')
         mesh_cfg = mesh_lib.MeshConfig(
-            data=n_dev // args.pipeline_stages,
-            stage=args.pipeline_stages)
+            data=n_dev // inner,
+            stage=args.pipeline_stages,
+            tensor=args.tensor, expert=args.expert)
     else:
         mesh_cfg = mesh_lib.MeshConfig.auto(n_dev, tensor=args.tensor,
                                             expert=args.expert,

@@ -149,6 +149,28 @@ def test_train_lm_pipeline_cli(tmp_path):
 
 
 @pytest.mark.slow
+def test_train_lm_pipeline_with_tensor_cli(tmp_path):
+    """dp x pp x tp from the CLI: v2 shards tensor WITHIN stages."""
+    import os
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env = dict(os.environ)
+    env['XLA_FLAGS'] = '--xla_force_host_platform_device_count=8'
+    env['PYTHONPATH'] = f"{repo}:{env.get('PYTHONPATH', '')}"
+    out = subprocess.run(
+        [sys.executable, '-m', 'skypilot_tpu.recipes.train_lm',
+         '--cpu', '--model', 'tiny', '--pipeline-stages', '2',
+         '--tensor', '2', '--seq', '64', '--global-batch', '32',
+         '--log-every', '2', '--steps', '2'],
+        capture_output=True, text=True, env=env, timeout=420)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert 'stage=2, tensor=2' in out.stdout
+    assert 'training done' in out.stdout
+
+
+@pytest.mark.slow
 def test_pipeline_llama_matches_sequential():
     """The Llama family pipelines too: loss AND grads match the
     sequential model (rope/GQA blocks, untied head, RMSNorm)."""
