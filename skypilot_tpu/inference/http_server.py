@@ -243,11 +243,14 @@ def serve(rt: InferenceRuntime, port: int,
             self.sse_start()
             n_gen = 0
             ttft = None
-            for i, t in iter_interleaved(handles):
-                if ttft is None:
-                    ttft = time.monotonic() - t0
-                n_gen += 1
-                self.sse_send({'index': i, 'token': t})
+            try:
+                for i, t in iter_interleaved(handles):
+                    if ttft is None:
+                        ttft = time.monotonic() - t0
+                    n_gen += 1
+                    self.sse_send({'index': i, 'token': t})
+            finally:
+                rt.cancel_streams(handles)  # no-op when completed
             # Full rows in the terminal event: stream consumers get
             # the same payload the non-streaming endpoint returns.
             self.sse_send({'done': True,
@@ -384,15 +387,18 @@ def serve(rt: InferenceRuntime, port: int,
                      for _ in encoded]
             n_gen = 0
             ttft = None
-            for i, t in iter_interleaved(handles):
-                if ttft is None:
-                    ttft = time.monotonic() - t0
-                n_gen += 1
-                if scans[i].hit:
-                    continue
-                out = scans[i].push(decs[i].push(t))
-                if out:
-                    self.sse_send({'index': i, 'delta': out})
+            try:
+                for i, t in iter_interleaved(handles):
+                    if ttft is None:
+                        ttft = time.monotonic() - t0
+                    n_gen += 1
+                    if scans[i].hit:
+                        continue
+                    out = scans[i].push(decs[i].push(t))
+                    if out:
+                        self.sse_send({'index': i, 'delta': out})
+            finally:
+                rt.cancel_streams(handles)  # no-op when completed
             for i in range(len(handles)):
                 if not scans[i].hit:
                     out = (scans[i].push(decs[i].flush()) +

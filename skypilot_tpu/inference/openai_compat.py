@@ -327,15 +327,20 @@ def stream_completion(rt: InferenceRuntime, req: CompletionRequest,
     n_gen = [0] * req.n
     ttft: Optional[float] = None
 
-    for i, t in iter_interleaved(handles):
-        if ttft is None:
-            ttft = time.monotonic() - t0
-        n_gen[i] += 1
-        if scans[i].hit:
-            continue  # post-stop tokens: drop
-        out = scans[i].push(decs[i].push(t))
-        if out:
-            writer.sse_send(chunk(i, out))
+    try:
+        for i, t in iter_interleaved(handles):
+            if ttft is None:
+                ttft = time.monotonic() - t0
+            n_gen[i] += 1
+            if scans[i].hit:
+                continue  # post-stop tokens: drop
+            out = scans[i].push(decs[i].push(t))
+            if out:
+                writer.sse_send(chunk(i, out))
+    finally:
+        # Disconnected consumer: free the slots NOW instead of
+        # decoding tokens nobody reads (no-op on normal completion).
+        rt.cancel_streams(handles)
     for i in range(req.n):
         if not scans[i].hit:
             out = scans[i].push(decs[i].flush()) + scans[i].flush()

@@ -302,6 +302,19 @@ class InferenceRuntime:
             on_token=handle.on_token)
         return handle
 
+    def cancel_streams(self, handles: List[StreamHandle]) -> None:
+        """Abandon streamed requests whose consumer disconnected: the
+        engine frees their slots instead of generating unread tokens.
+        No-op for handles that already completed."""
+        futs = [h.future for h in handles
+                if h.future is not None and not h.future.done()]
+        if not futs:
+            return
+        eng = self.engine if self.engine is not None \
+            else self._stream_engine
+        if eng is not None:
+            eng.cancel(futs)
+
     def stop(self) -> None:
         if self.engine is not None:
             self.engine.stop()

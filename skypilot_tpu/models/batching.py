@@ -236,6 +236,10 @@ class ContinuousBatchingEngine:
 
         self._chunk_decode = (self._make_chunk_decode_fn()
                               if self.decode_chunk > 1 else None)
+        # Client-abandoned requests (disconnected stream consumers):
+        # applied on the scheduler thread between rounds.
+        self._cancel_requests: set = set()
+        self._cancel_lock = threading.Lock()
         self._queue: 'queue.Queue' = queue.Queue()
         # FCFS admission order, owned by the scheduler thread: requests
         # drain from _queue into _ready; a stalled (page-pressure) or
@@ -560,6 +564,33 @@ class ContinuousBatchingEngine:
                          fut))
         return fut
 
+    def cancel(self, futs) -> None:
+        """Best-effort cancel of submitted requests (the client hung
+        up mid-stream): an active slot finishes NOW with its output so
+        far (freeing the slot instead of decoding tokens nobody will
+        read); a queued request resolves without running. Thread-safe;
+        applied by the scheduler between decode rounds."""
+        with self._cancel_lock:
+            self._cancel_requests.update(futs)
+
+    def _apply_cancellations(self) -> None:
+        with self._cancel_lock:
+            if not self._cancel_requests:
+                return
+            cancels = self._cancel_requests
+            self._cancel_requests = set()
+        for slot in range(self.num_slots):
+            if self.active[slot] and self.futures[slot] in cancels:
+                self._finish_slot(slot)
+        keep: 'collections.deque' = collections.deque()
+        while self._ready:
+            item = self._ready.popleft()
+            if item[-1] in cancels:
+                item[-1].set_result(list(item[0]))  # prompt only
+            else:
+                keep.append(item)
+        self._ready = keep
+
     def stop(self) -> None:
         self._stop.set()
         self._thread.join(timeout=10)
@@ -569,15 +600,18 @@ class ContinuousBatchingEngine:
         while not self._stop.is_set():
             try:
                 progressed = self._admit()
+                self._apply_cancellations()
                 if self.active.any():
                     self._decode_step()
                     progressed = True
                 if not progressed and self._queue.empty() and \
                         not self._ready:
-                    # Idle: block briefly for the next request.
+                    # Idle: block briefly for the next request. The
+                    # item goes straight into _ready — a get+put-back
+                    # would rotate the queue head to the TAIL,
+                    # inverting FCFS admission order.
                     try:
-                        item = self._queue.get(timeout=0.05)
-                        self._queue.put(item)
+                        self._ready.append(self._queue.get(timeout=0.05))
                     except queue.Empty:
                         pass
             except Exception as e:  # pylint: disable=broad-except

@@ -98,6 +98,10 @@ def main() -> None:
                              'JAX_PLATFORMS env var is overridden by '
                              'some TPU plugins, jax.config is not)')
     args = parser.parse_args()
+    if args.decode_chunk > 1 and not args.continuous_batching:
+        parser.error('--decode-chunk is a continuous-engine knob; '
+                     'add --continuous-batching (the one-shot engine '
+                     'would silently ignore it)')
 
     from skypilot_tpu.inference.http_server import serve
     from skypilot_tpu.inference.runtime import build_runtime

@@ -266,24 +266,36 @@ def main() -> None:
     if args.profile and proc_id == 0:
         prof_start, prof_stop = (int(x) for x in
                                  args.profile_steps.split(':'))
+    tracing = False
 
     start_step = int(state.step)
     t0 = time.perf_counter()
     window_tokens = 0
     for step in range(start_step, args.steps):
-        if step == prof_start:
+        # >= not ==: a checkpoint resume may land past prof_start.
+        if not tracing and prof_start >= 0 and \
+                prof_start <= step < prof_stop:
             jax.profiler.start_trace(args.profile)
+            tracing = True
         state, loss = step_fn(state, next_tokens())
-        if step + 1 == prof_stop:
+        if tracing and step + 1 >= prof_stop:
             # Block so the trace holds COMPLETE device timelines for
             # the window, not just dispatches.
             jax.block_until_ready(loss)
             jax.profiler.stop_trace()
+            tracing = False
             print(f'profile: steps {prof_start}..{prof_stop} traced '
                   f'to {args.profile}', flush=True)
         window_tokens += batch * args.seq
         if mgr is not None:
             mgr.save(step + 1, state)
+        if tracing and step + 1 >= args.steps:
+            # Window ran past the final step: still flush the trace.
+            jax.block_until_ready(loss)
+            jax.profiler.stop_trace()
+            tracing = False
+            print(f'profile: traced through final step {step + 1} '
+                  f'to {args.profile}', flush=True)
         if (step + 1) % args.log_every == 0 and proc_id == 0:
             jax.block_until_ready(loss)
             dt = time.perf_counter() - t0

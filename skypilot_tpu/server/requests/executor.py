@@ -264,7 +264,8 @@ def _resolve_entrypoint(entrypoint: str) -> Callable:
 
 def _request_worker_main(request_id: str, entrypoint: str,
                          payload_json: str, log_path: str,
-                         db_path: str, user: str = 'unknown') -> None:
+                         db_path: str, user: str = 'unknown',
+                         server_id: Optional[str] = None) -> None:
     """Runs in the forked worker process (reference:
     _request_execution_wrapper, executor.py:670)."""
     os.setpgrp()  # own process group: cancel kills the whole tree
@@ -280,23 +281,32 @@ def _request_worker_main(request_id: str, entrypoint: str,
     os.dup2(log_file.fileno(), sys.stderr.fileno())
     from skypilot_tpu.utils import request_context
     request_context.set_request_user(user)
+    # Terminal writes are guarded on (server_id, RUNNING) like every
+    # other post-claim write: an ORPHANED worker (its server crashed,
+    # the leader re-queued the row, a peer re-claimed it) must not
+    # clobber the rerun's row — and a finished worker must not flip a
+    # CANCELLED row back to a terminal result.
+    guard = ' AND status=?' + (' AND server_id=?' if server_id else '')
+    gparams: tuple = (RequestStatus.RUNNING.value,)
+    if server_id:
+        gparams += (server_id,)
     try:
         fn = _resolve_entrypoint(entrypoint)
         payload = json.loads(payload_json)
         result = fn(**payload)
         db.execute(
-            'UPDATE requests SET status=?, return_value=?, finished_at=? '
-            'WHERE request_id=?',
+            f'UPDATE requests SET status=?, return_value=?, '
+            f'finished_at=? WHERE request_id=?{guard}',
             (RequestStatus.SUCCEEDED.value, pickle.dumps(result),
-             time.time(), request_id))
+             time.time(), request_id) + gparams)
     except BaseException as e:  # pylint: disable=broad-except
         traceback.print_exc()
         db.execute(
-            'UPDATE requests SET status=?, error=?, finished_at=? '
-            'WHERE request_id=?',
+            f'UPDATE requests SET status=?, error=?, finished_at=? '
+            f'WHERE request_id=?{guard}',
             (RequestStatus.FAILED.value,
              json.dumps(exceptions.serialize_exception(e)), time.time(),
-             request_id))
+             request_id) + gparams)
 
 
 class RequestWorkerLoop:
@@ -334,6 +344,14 @@ class RequestWorkerLoop:
             sid = row.get('server_id')
             if sid is not None and sid != get_server_id() and \
                     not sid.startswith(host_prefix):
+                continue
+            if sid is not None and sid != get_server_id() and \
+                    not (row['pid'] and row['pid'] > 0):
+                # A same-host PEER's row with no pid yet is MID-CLAIM
+                # (pid lands after proc.start()): not provably dead —
+                # leave it to the peer (or, if the peer is gone, to
+                # the heartbeat stale sweep). Our OWN rows have no
+                # such grace: nothing of ours runs at our startup.
                 continue
             if RequestStatus(row['status']) == RequestStatus.RUNNING and \
                     not subprocess_utils.process_alive(row['pid']):
@@ -442,7 +460,7 @@ class RequestWorkerLoop:
             args=(req['request_id'], req['entrypoint'], req['payload'],
                   req['log_path'],
                   os.path.join(constants.api_server_dir(), 'requests.db'),
-                  req['user'] or 'unknown'),
+                  req['user'] or 'unknown', get_server_id()),
             daemon=True)
         # Both post-claim writes are guarded on (server_id, status):
         # if this replica stalled past the stale window and the leader

@@ -526,11 +526,15 @@ def run(host: str = '127.0.0.1',
     import time as _time
     _SERVER_START_TIME = _time.time()
     # Replica identity: scopes restart recovery to our own request
-    # rows and keys the heartbeat peers judge our liveness by.
-    # Stable across restarts of the same replica (host:port);
-    # SKYPILOT_API_SERVER_ID overrides (k8s pod name).
+    # rows, keys the heartbeat peers judge our liveness by, AND is a
+    # dialable host:port (cross-replica log streaming connects to it).
+    # SKYPILOT_API_SERVER_HOST overrides the host part (k8s: the pod
+    # IP — pod names don't resolve under a non-headless Service);
+    # SKYPILOT_API_SERVER_ID overrides the whole identity.
     import socket as _socket
-    executor.set_server_id(f'{_socket.gethostname()}:{port}')
+    host = os.environ.get('SKYPILOT_API_SERVER_HOST') or \
+        _socket.gethostname()
+    executor.set_server_id(f'{host}:{port}')
     worker_loop = executor.RequestWorkerLoop()
     worker_loop.start()
     # HA: re-adopt managed jobs orphaned by a previous server/controller

@@ -237,6 +237,7 @@ def test_dashboard_admin_surfaces(chaos_server, monkeypatch):
     assert denied.status_code in (401, 403)
 
 
+@pytest.mark.slow
 def test_dashboard_spa_serves_live_data(chaos_server, monkeypatch):
     """The dashboard SPA assets load and /dashboard/api/summary carries
     live cluster data (reference: sky/dashboard)."""

@@ -116,8 +116,10 @@ def test_multi_replica_render():
     env = {e['name']: e for e in spec['containers'][0]['env']}
     assert env['SKYPILOT_DB_URL']['value'] == \
         'postgresql://u:p@pg:5432/sky'
-    assert env['SKYPILOT_API_SERVER_ID']['valueFrom']['fieldRef'][
-        'fieldPath'] == 'metadata.name'
+    # Identity HOST = pod IP (dialable by peers for cross-replica log
+    # streaming); the server composes host:port itself.
+    assert env['SKYPILOT_API_SERVER_HOST']['valueFrom']['fieldRef'][
+        'fieldPath'] == 'status.podIP'
 
 
 def test_overridden_render():

@@ -374,3 +374,33 @@ def test_chunk_decode_rejects_speculation():
     with pytest.raises(AssertionError, match='decode_chunk'):
         ContinuousBatchingEngine(model, params, max_total_len=48,
                                  speculative_k=2, decode_chunk=4)
+
+
+@pytest.mark.slow
+def test_cancel_frees_slots_mid_generation():
+    """Abandoned streams (client disconnect) cancel: the active slot
+    resolves NOW with its partial output, a queued request resolves
+    unrun, and the engine keeps serving."""
+    model, params = _build('llama')
+    eng = ContinuousBatchingEngine(model, params, num_slots=1,
+                                   max_total_len=256)
+    try:
+        import threading
+        first_token = threading.Event()
+        p = [5, 9, 2, 17]
+        # A LONG generation so the cancel deterministically lands
+        # mid-run (decode is ~ms/token once compiled).
+        fut = eng.submit(p, max_new_tokens=240,
+                         on_token=lambda t: first_token.set())
+        queued = eng.submit(p, max_new_tokens=240)  # waits for a slot
+        assert first_token.wait(timeout=120)
+        eng.cancel([fut, queued])
+        out = fut.result(timeout=60)
+        assert out[:len(p)] == p
+        assert len(p) < len(out) < len(p) + 240  # partial
+        assert queued.result(timeout=60) == p    # never ran
+        # The slot is free again: a fresh request completes fully.
+        full = eng.submit(p, max_new_tokens=6).result(timeout=120)
+        assert len(full) == len(p) + 6
+    finally:
+        eng.stop()
