@@ -4,11 +4,12 @@
 'use strict';
 
 const TABS = ['Clusters', 'Jobs', 'Services', 'Requests', 'Users',
-              'Workspaces'];
+              'Workspaces', 'Costs'];
 let active = 'Clusters';
 let data = null;
 let tokens = null;       // /users/tokens (admin); null = not loaded
 let workspaces = null;   // /dashboard/api/workspaces
+let costs = null;        // /cost_report (async request round-trip)
 let logAbort = null;
 
 const $ = (id) => document.getElementById(id);
@@ -178,8 +179,62 @@ function render() {
     renderUsers(v, acts);
   } else if (active === 'Workspaces') {
     renderWorkspaces(v);
+  } else if (active === 'Costs') {
+    renderCosts(v);
   }
   bindActs(acts);
+}
+
+/* Cost report: terminated-cluster history with accrued cost (the
+ * CLI's `stpu cost-report`). The verb is an async request: POST
+ * /cost_report -> request_id -> poll /api/get for the result. */
+function renderCosts(v) {
+  if (costs === null) {
+    v.innerHTML = '<div class="empty">loading…</div>';
+    loadCosts();
+    return;
+  }
+  if (costs.error) {
+    v.innerHTML = `<div class="err">${esc(costs.error)}</div>`;
+    return;
+  }
+  const total = costs.reduce((s, r) => s + (r.cost || 0), 0);
+  v.innerHTML =
+    `<div class="empty">lifetime total: $${total.toFixed(2)}</div>` +
+    table(
+      ['cluster', 'resources', 'nodes', 'user', 'launched',
+       'duration', 'cost', 'final status'],
+      costs.map((r) => [
+        r.name, r.resources_str, r.num_nodes, r.user,
+        ts(r.launched_at),
+        r.duration ? `${Math.round(r.duration / 60)}m` : '-',
+        r.cost != null ? `$${r.cost.toFixed(2)}` : '-',
+        r.last_status]));
+}
+
+async function loadCosts() {
+  try {
+    const sub = await authFetch('/cost_report',
+                                { method: 'POST', body: '{}' });
+    const body = await sub.json();
+    if (!sub.ok) throw new Error(body.error || sub.status);
+    const rid = body.request_id;
+    for (let i = 0; i < 30; i += 1) {
+      const resp = await authFetch(
+        `/api/get?request_id=${rid}&timeout=2`);
+      const rec = await resp.json();
+      if (!resp.ok) throw new Error(rec.error || resp.status);
+      if (rec.status === 'SUCCEEDED') {
+        costs = rec.return_value || [];
+        break;
+      }
+      if (rec.status === 'FAILED' || rec.status === 'CANCELLED') {
+        throw new Error(`cost report ${rec.status}`);
+      }
+    }
+    if (costs === null) throw new Error('timed out');
+  } catch (e) { costs = { error: `cost report: ${e.message}` }; }
+  if (active === 'Costs') render();
 }
 
 /* Users admin: set role, issue service-account tokens, revoke them —

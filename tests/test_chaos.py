@@ -318,3 +318,24 @@ def test_dashboard_spa_serves_live_data(chaos_server, monkeypatch):
                            timeout=30).json()
         if rec['status'] in ('SUCCEEDED', 'FAILED'):
             break
+
+    # Costs tab data path: the async /cost_report round-trip the SPA
+    # performs — the downed cluster appears in the history with an
+    # accrued cost field.
+    rid = requests.post(f'{url}/cost_report', json={},
+                        timeout=10).json()['request_id']
+    deadline = time.time() + 60
+    rows = None
+    while time.time() < deadline:
+        rec = requests.get(f'{url}/api/get',
+                           params={'request_id': rid, 'timeout': 2},
+                           timeout=30).json()
+        if rec['status'] == 'SUCCEEDED':
+            rows = rec['return_value']
+            break
+        assert rec['status'] in ('PENDING', 'RUNNING'), rec
+    assert rows is not None
+    names = [r['name'] for r in rows]
+    assert 'dash-c' in names
+    row = rows[names.index('dash-c')]
+    assert 'cost' in row and 'duration' in row
