@@ -10,11 +10,13 @@ with its `llm/` recipe set (reference: `llm/llama-3_1-finetuning/`,
 `llm/mixtral/`, `llm/deepseek-r1/` serve real weights; here the
 conversion is in-framework).
 
-Supported `model_type`s (config.json): `llama`, `gpt2`, `mixtral`,
-`deepseek_v2` (dense-MLP checkpoints; MoE-layer DeepSeek V2 rejects
-with a clear error). Weights are read from *.safetensors (sharded via
-model.safetensors.index.json) or pytorch_model.bin, converted to f32
-numpy (our params are f32 masters; compute casts to bf16).
+Supported `model_type`s (config.json): `llama`, `qwen2` (Qwen2/2.5 —
+the llama backbone + q/k/v biases + tied embeddings), `mistral`,
+`gpt2`, `mixtral`, `deepseek_v2` (dense-MLP checkpoints; MoE-layer
+DeepSeek V2 rejects with a clear error). Weights are read from
+*.safetensors (sharded via model.safetensors.index.json) or
+pytorch_model.bin, converted to f32 numpy (our params are f32
+masters; compute casts to bf16).
 
 Convention notes (verified by logit-parity tests against the
 torch/transformers implementations, tests/unit_tests/test_hf_import.py):
@@ -166,6 +168,11 @@ def _convert_llama_like(cfg_json: Dict[str, Any],
         params['lm_head'] = _t(sd['model.embed_tokens.weight'])
     else:
         params['lm_head'] = _t(sd['lm_head.weight'])
+    # Qwen2-family variant: q/k/v carry biases (detected from the
+    # checkpoint, so 'qwen2' and biased llama-likes both work).
+    qkv_bias = 'model.layers.0.self_attn.q_proj.bias' in sd
+    if qkv_bias and not moe:
+        common['qkv_bias'] = True
     for i in range(num_layers):
         p = f'model.layers.{i}.'
         layer: Dict[str, Any] = {
@@ -177,6 +184,11 @@ def _convert_llama_like(cfg_json: Dict[str, Any],
             },
             'attn_norm': {'scale': sd[p + 'input_layernorm.weight']},
         }
+        if qkv_bias and not moe:
+            for w, hf in (('wq', 'q_proj'), ('wk', 'k_proj'),
+                          ('wv', 'v_proj')):
+                layer['attn'][w]['bias'] = \
+                    sd[p + f'self_attn.{hf}.bias']
         post_norm = sd[p + 'post_attention_layernorm.weight']
         if moe:
             n_exp = cfg_json['num_local_experts']
@@ -369,6 +381,12 @@ def _convert_deepseek(cfg_json, sd, max_seq_len, **overrides):
 
 _CONVERTERS: Dict[str, Callable] = {
     'llama': _convert_llama,
+    # Qwen2/2.5 = the llama backbone + q/k/v biases (auto-detected
+    # from the checkpoint) + usually tied embeddings.
+    'qwen2': _convert_llama,
+    # Mistral's config is llama-shaped (sliding_window unset/ignored
+    # at the context lengths we serve).
+    'mistral': _convert_llama,
     'mixtral': _convert_mixtral,
     'gpt2': _convert_gpt2,
     'deepseek_v2': _convert_deepseek,

@@ -60,6 +60,10 @@ class LlamaConfig:
     # page 0 is the engine's trash page for unallocated table entries.
     kv_page_size: int = 16
     kv_total_pages: int = 128
+    # Qwen2-family variant: biases on the q/k/v projections (the only
+    # architectural delta from Llama; o_proj and the MLP stay
+    # bias-free).
+    qkv_bias: bool = False
 
     @classmethod
     def llama3_8b(cls, **kw) -> 'LlamaConfig':
@@ -132,11 +136,14 @@ class RMSNorm(nn.Module):
         return out.astype(self.dtype)
 
 
-def _proj(features: int, axes, dtype, name: str) -> nn.Dense:
+def _proj(features: int, axes, dtype, name: str,
+          use_bias: bool = False) -> nn.Dense:
     return nn.Dense(
-        features, use_bias=False, dtype=dtype, name=name,
+        features, use_bias=use_bias, dtype=dtype, name=name,
         kernel_init=nn.with_logical_partitioning(
-            nn.initializers.normal(stddev=0.02), axes))
+            nn.initializers.normal(stddev=0.02), axes),
+        bias_init=nn.with_logical_partitioning(
+            nn.initializers.zeros_init(), (axes[-1],)))
 
 
 class Attention(nn.Module):
@@ -151,11 +158,14 @@ class Attention(nn.Module):
         batch, seq, _ = x.shape
         hd = cfg.head_dim
         q = _proj(cfg.num_heads * hd, ('embed', 'heads'), cfg.dtype,
-                  'wq')(x).reshape(batch, seq, cfg.num_heads, hd)
+                  'wq', cfg.qkv_bias)(x).reshape(
+                      batch, seq, cfg.num_heads, hd)
         k = _proj(cfg.num_kv_heads * hd, ('embed', 'heads'), cfg.dtype,
-                  'wk')(x).reshape(batch, seq, cfg.num_kv_heads, hd)
+                  'wk', cfg.qkv_bias)(x).reshape(
+                      batch, seq, cfg.num_kv_heads, hd)
         v = _proj(cfg.num_kv_heads * hd, ('embed', 'heads'), cfg.dtype,
-                  'wv')(x).reshape(batch, seq, cfg.num_kv_heads, hd)
+                  'wv', cfg.qkv_bias)(x).reshape(
+                      batch, seq, cfg.num_kv_heads, hd)
         q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
         k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
 

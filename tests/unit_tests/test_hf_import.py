@@ -253,3 +253,68 @@ def test_max_seq_len_exceeding_trained_context(tmp_path):
         llama_dir, safe_serialization=True)
     with pytest.warns(UserWarning, match='untrained extrapolation'):
         hf_import.load_hf_checkpoint(str(llama_dir), max_seq_len=128)
+
+
+@pytest.mark.slow
+def test_qwen2_parity(tmp_path, tokens):
+    """Qwen2/2.5 (llama backbone + q/k/v biases, tied embeddings —
+    the 0.5B/1.5B shape): teacher-forced logit parity vs torch."""
+    cfg = transformers.Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=160,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=64,
+        rope_theta=10000.0, tie_word_embeddings=True)
+    tmodel = transformers.Qwen2ForCausalLM(cfg).eval()
+    _save(tmodel, tmp_path)
+    # save_pretrained writes model_type=qwen2 in config.json.
+    with open(os.path.join(tmp_path, 'config.json'),
+              encoding='utf-8') as f:
+        assert json.load(f)['model_type'] == 'qwen2'
+    model, params = hf_import.load_hf_checkpoint(
+        str(tmp_path), dtype=jnp.float32)
+    assert model.config.qkv_bias is True
+    assert 'bias' in params['layer_0']['attn']['wq']
+    np.testing.assert_allclose(
+        _logits_ours(model, params, tokens),
+        _logits_torch(tmodel, tokens), rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.slow
+def test_mistral_parity(tmp_path, tokens):
+    """Mistral config.json is llama-shaped; the shared converter
+    handles it."""
+    cfg = transformers.MistralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=160,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=64,
+        sliding_window=None, tie_word_embeddings=False)
+    tmodel = transformers.MistralForCausalLM(cfg).eval()
+    _save(tmodel, tmp_path)
+    model, params = hf_import.load_hf_checkpoint(
+        str(tmp_path), dtype=jnp.float32)
+    assert model.config.qkv_bias is False
+    np.testing.assert_allclose(
+        _logits_ours(model, params, tokens),
+        _logits_torch(tmodel, tokens), rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.slow
+def test_qwen2_cached_decode_matches_full_forward(tmp_path, tokens):
+    """The serving path (KV-cache incremental decode) is exact for the
+    biased-attention variant too."""
+    cfg = transformers.Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=160,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=64,
+        tie_word_embeddings=True)
+    _save(transformers.Qwen2ForCausalLM(cfg).eval(), tmp_path)
+    model, params = hf_import.load_hf_checkpoint(
+        str(tmp_path), dtype=jnp.float32)
+    # Device placement, as serving does (the importer hands back
+    # numpy f32 masters; traced code needs jax arrays).
+    params = jax.tree.map(jnp.asarray, params)
+    from skypilot_tpu.models.generate import teacher_forced_logits
+    full, decoded = teacher_forced_logits(
+        model, params, jnp.asarray(tokens[:, :8], jnp.int32))
+    np.testing.assert_allclose(np.asarray(decoded), np.asarray(full),
+                               rtol=2e-4, atol=2e-4)
