@@ -67,6 +67,19 @@ def _build_model(name: str, seq: int, remat: bool):
         from skypilot_tpu.models.deepseek import Deepseek, DeepseekConfig
         cfg = DeepseekConfig.tiny(remat=remat)
         return Deepseek(cfg), cfg.vocab_size, None
+    if name == 'qwen2-7b':
+        from skypilot_tpu.models.llama import Llama, LlamaConfig
+        cfg = LlamaConfig(vocab_size=152064, num_layers=28,
+                          num_heads=28, num_kv_heads=4,
+                          embed_dim=3584, mlp_dim=18944,
+                          rope_theta=1e6, norm_eps=1e-6,
+                          max_seq_len=max(seq, 2048),
+                          qkv_bias=True, remat=remat)
+        return Llama(cfg), cfg.vocab_size, None
+    if name == 'qwen-tiny':
+        from skypilot_tpu.models.llama import Llama, LlamaConfig
+        cfg = LlamaConfig.tiny(qkv_bias=True, remat=remat)
+        return Llama(cfg), cfg.vocab_size, None
     raise ValueError(f'unknown model {name!r}')
 
 
