@@ -119,3 +119,31 @@ def test_deepseek_mla_identical():
     got = np.asarray(gen.make_generate_fn(model, 8)(
         tp, prompt, jax.random.PRNGKey(0)))
     np.testing.assert_array_equal(ref, got)
+
+
+@pytest.mark.slow
+def test_streaming_and_chunked_decode_identical_under_tp(setup):
+    """Round-5 serving features ride tensor parallelism unchanged:
+    on_token streaming delivers the SAME tokens in the same order, and
+    chunked decode keeps its bit-identity, with TP-sharded params."""
+    model, params, mesh = setup
+    tp = shard_params_for_serving(model, params, mesh)
+    p = [5, 9, 2, 17]
+
+    def run(engine_params, chunk):
+        streamed = []
+        eng = ContinuousBatchingEngine(model, engine_params,
+                                       num_slots=2, max_total_len=48,
+                                       decode_chunk=chunk)
+        try:
+            out = eng.submit(p, max_new_tokens=12,
+                             on_token=streamed.append).result(
+                timeout=300)
+        finally:
+            eng.stop()
+        assert streamed == out[len(p):]
+        return out
+
+    ref = run(params, 1)
+    assert run(tp, 1) == ref      # TP streaming == single-device
+    assert run(tp, 4) == ref      # TP + chunked decode == same
