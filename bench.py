@@ -26,9 +26,11 @@ def _smoke_argv(args) -> list:
     # TPU record is unreachable, the dispatch-amortization curve is
     # the platform-independent evidence of the multi-step win.
     argv = [sys.executable, os.path.abspath(__file__), '--smoke',
-            '--sweep-inner',
+            '--sweep-inner', '--sweep-xent',
             '--steps', str(args.steps), '--warmup', str(args.warmup),
             '--repeats', str(args.repeats)]
+    if args.no_fused_xent:
+        argv += ['--no-fused-xent']
     if args.batch:
         argv += ['--batch', str(args.batch)]
     if args.seq:
@@ -48,7 +50,7 @@ def main() -> None:
                         help='timed repeats of the --steps window; the '
                              'JSON line reports the MEDIAN (and stdev) '
                              'so a one-off host stall cannot read as a '
-                             'regression — or mask one (the 8% '
+                             'regression — or mask one (the 8%% '
                              'unexplained r03->r04 CPU drift was '
                              'single-shot noise)')
     parser.add_argument('--batch', type=int, default=0,
@@ -64,6 +66,18 @@ def main() -> None:
                              'amortization) before the headline run; '
                              'results go to stderr, the JSON line is '
                              'unchanged')
+    parser.add_argument('--sweep-xent', action='store_true',
+                        help='compare the fused blockwise LM-head '
+                             'cross-entropy (ops/fused_xent.py) '
+                             'against the naive [B,S,V]-materializing '
+                             'path on the qwen-tiny config: peak temp '
+                             'memory from compiled memory_analysis() '
+                             'plus tokens/s for loss+backward, to '
+                             'stderr; the JSON line is unchanged')
+    parser.add_argument('--no-fused-xent', action='store_true',
+                        help='run the headline trainer with the naive '
+                             'dense LM-head loss instead of the fused '
+                             'blockwise path (A/B escape hatch)')
     parser.add_argument('--profile', default=None, metavar='DIR',
                         help='jax.profiler trace of the FIRST timed '
                              'repeat into DIR (TensorBoard/Perfetto) — '
@@ -174,7 +188,12 @@ def main() -> None:
     inner = args.inner or (1 if platform == 'cpu' else 8)
 
     def build_step(batch_, inner_):
-        trainer = ShardedTrainer(model, mesh, tx=default_optimizer())
+        # fused_xent=None → auto (on): --smoke defaults through the
+        # fused blockwise loss, so BENCH rounds track the shipping
+        # training hot path; --no-fused-xent pins the naive one.
+        trainer = ShardedTrainer(
+            model, mesh, tx=default_optimizer(),
+            fused_xent=False if args.no_fused_xent else None)
         example = jnp.zeros((batch_, seq), jnp.int32)
         state_ = trainer.init(jax.random.PRNGKey(0), example)
         data = jax.random.randint(jax.random.PRNGKey(1),
@@ -199,6 +218,62 @@ def main() -> None:
             state_, loss_ = step_(state_, tokens_)
         jax.block_until_ready(loss_)
         return time.perf_counter() - start_, state_, loss_
+
+    if args.sweep_xent:
+        # Fused-vs-naive LM-head loss evidence on the qwen-tiny config
+        # (the Qwen2 family is where the [B,S,V] logits hurt most at
+        # scale: 152k vocab). Reports XLA's own peak-temp accounting
+        # (compiled memory_analysis) and loss+backward throughput.
+        import flax.linen as fnn
+        from skypilot_tpu.models.llama import Llama, LlamaConfig
+        from skypilot_tpu.ops import fused_xent as fx
+        from skypilot_tpu.parallel.train import next_token_loss
+        qcfg = LlamaConfig.tiny(qkv_bias=True)
+        qmodel = Llama(qcfg)
+        xb, xs = (4, 128) if args.smoke else (8, 256)
+        xtok = jax.random.randint(jax.random.PRNGKey(2), (xb, xs), 0,
+                                  qcfg.vocab_size, jnp.int32)
+        qparams = fnn.meta.unbox(
+            qmodel.init(jax.random.PRNGKey(0), xtok)['params'])
+        xhid = qmodel.apply({'params': qparams}, xtok,
+                            return_hidden=True)
+        xhead = qparams['lm_head']
+        xblk = max(64, qcfg.vocab_size // 4)
+
+        def _naive_loss(h, w, t):
+            logits = jnp.einsum(
+                'bse,ev->bsv', h.astype(qcfg.dtype),
+                w.astype(qcfg.dtype),
+                preferred_element_type=jnp.float32)
+            return next_token_loss(logits, t)
+
+        def _fused_loss(h, w, t):
+            return fx.fused_next_token_loss(
+                h, w, t, vocab_in_rows=False, block_size=xblk)
+
+        for xname, xfn in (('naive', _naive_loss),
+                           (f'fused[block={xblk}]', _fused_loss)):
+            try:
+                xjit = jax.jit(jax.value_and_grad(xfn, argnums=(0, 1)))
+                xmem = xjit.lower(xhid, xhead, xtok).compile() \
+                    .memory_analysis()
+                xtemp = getattr(xmem, 'temp_size_in_bytes', None)
+                xloss, xg = xjit(xhid, xhead, xtok)
+                jax.block_until_ready(xg)
+                xt0 = time.perf_counter()
+                for _ in range(max(1, args.steps)):
+                    xloss, xg = xjit(xhid, xhead, xtok)
+                jax.block_until_ready(xg)
+                xdt = time.perf_counter() - xt0
+                xtps = xb * xs * max(1, args.steps) / xdt / n_dev
+                print(f'# sweep-xent {xname}: peak_temp_bytes={xtemp} '
+                      f'loss={float(xloss):.4f} '
+                      f'loss+bwd tokens/s/chip={xtps:,.0f}',
+                      file=sys.stderr)
+            except Exception as e:  # pylint: disable=broad-except
+                # Evidence-only: never kill the headline run.
+                print(f'# sweep-xent {xname}: skipped '
+                      f'({type(e).__name__}: {e})', file=sys.stderr)
 
     if args.sweep_inner:
         # Dispatch-amortization evidence (per VERDICT r3: when the TPU

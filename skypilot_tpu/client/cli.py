@@ -158,8 +158,8 @@ def launch(entrypoint, cluster, name, workdir, infra, gpus, cpus, memory,
                        num_nodes, use_spot, env, env_file=env_file)
     if not yes and not dryrun:
         r = sorted(str(x) for x in task.resources)
-        click.echo(f'Launching {task.name or "task"} on {cluster or "new "
-                   "cluster"}: {r}')
+        target = cluster or 'new cluster'
+        click.echo(f'Launching {task.name or "task"} on {target}: {r}')
         click.confirm('Proceed?', default=True, abort=True)
     request_id = sdk.launch(
         task, cluster_name=cluster, dryrun=dryrun,

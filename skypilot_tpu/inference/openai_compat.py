@@ -266,7 +266,10 @@ def run_completion(rt: InferenceRuntime, req: CompletionRequest
         choices.append({'index': i, 'text': text,
                         'finish_reason': finish,
                         'logprobs': lp_block})
-    total_prompt = sum(len(ids) for ids in row_prompt)
+    # Usage counts each PROMPT once (the OpenAI contract): row_prompt
+    # holds one entry per choice, so summing it would over-report the
+    # prompt n× under n>1.
+    total_prompt = sum(len(ids) for ids in encoded)
     rt.metrics.record(time.monotonic() - t0, total_completion)
     return {
         'object': 'text_completion',

@@ -582,6 +582,16 @@ class ContinuousBatchingEngine:
         for slot in range(self.num_slots):
             if self.active[slot] and self.futures[slot] in cancels:
                 self._finish_slot(slot)
+        # Requests still sitting in _queue (submitted after the last
+        # _admit drain) must be swept too, or a disconnected client's
+        # queued request is later admitted and decoded to completion.
+        # Drain into _ready first — the same FCFS append _admit does —
+        # then one sweep covers both.
+        while True:
+            try:
+                self._ready.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
         keep: 'collections.deque' = collections.deque()
         while self._ready:
             item = self._ready.popleft()

@@ -234,7 +234,12 @@ class Block(nn.Module):
 
 
 class Deepseek(nn.Module):
-    """DeepSeek decoder; __call__ returns logits [B, S, vocab]."""
+    """DeepSeek decoder; __call__ returns logits [B, S, vocab].
+
+    `return_hidden=True` returns the post-final_norm hidden states
+    (the fused blockwise-loss path, ops/fused_xent.py — at DeepSeek's
+    102k vocab the skipped [B, S, V] logits dominate training HBM).
+    """
     config: DeepseekConfig
 
     @nn.compact
@@ -242,7 +247,8 @@ class Deepseek(nn.Module):
                  positions: Optional[jax.Array] = None,
                  decode: bool = False,
                  page_indices: Optional[jax.Array] = None,
-                 prefill: bool = False) -> jax.Array:
+                 prefill: bool = False,
+                 return_hidden: bool = False) -> jax.Array:
         cfg = self.config
         batch, seq = tokens.shape
         if positions is None:
@@ -269,6 +275,9 @@ class Deepseek(nn.Module):
             nn.with_logical_partitioning(
                 nn.initializers.normal(stddev=0.02), ('embed', 'vocab')),
             (cfg.embed_dim, cfg.vocab_size), jnp.float32)
+        if return_hidden:
+            return nn.with_logical_constraint(
+                x, ('batch', 'seq', 'act_embed'))
         logits = jnp.einsum('bse,ev->bsv', x.astype(cfg.dtype),
                             head.astype(cfg.dtype),
                             preferred_element_type=(cfg.logits_dtype or

@@ -249,7 +249,14 @@ def final_norm_logits(params, x: jax.Array, cfg: GPTConfig) -> jax.Array:
 
 
 class GPT(nn.Module):
-    """GPT-2 decoder; __call__ returns logits [B, S, vocab]."""
+    """GPT-2 decoder; __call__ returns logits [B, S, vocab].
+
+    `return_hidden=True` returns the post-ln_f hidden states
+    [B, S, embed] instead, skipping the LM-head matmul entirely — the
+    trainer's fused blockwise cross-entropy (ops/fused_xent.py) takes
+    it from there against the tied `wte` without ever materializing
+    [B, S, vocab].
+    """
     config: GPTConfig
 
     @nn.compact
@@ -258,7 +265,8 @@ class GPT(nn.Module):
                  positions: Optional[jax.Array] = None,
                  decode: bool = False,
                  page_indices: Optional[jax.Array] = None,
-                 prefill: bool = False) -> jax.Array:
+                 prefill: bool = False,
+                 return_hidden: bool = False) -> jax.Array:
         cfg = self.config
         batch, seq = tokens.shape
         assert seq <= cfg.block_size, (seq, cfg.block_size)
@@ -303,6 +311,9 @@ class GPT(nn.Module):
                 nn.initializers.ones_init(), ('norm',)),
             bias_init=nn.with_logical_partitioning(
                 nn.initializers.zeros_init(), ('norm',)))(x)
+        if return_hidden:
+            return nn.with_logical_constraint(
+                x, ('batch', 'seq', 'act_embed'))
         # Tied output head (nanoGPT style): logits = x @ wte^T. bf16
         # operands keep the matmul on the MXU's native bf16 path
         # (~4-8x the f32 rate); cfg.logits_dtype picks the output

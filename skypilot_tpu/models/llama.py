@@ -311,7 +311,14 @@ def final_norm_logits(params, x: jax.Array, cfg: LlamaConfig) -> jax.Array:
 
 
 class Llama(nn.Module):
-    """Llama decoder; __call__ returns logits [B, S, vocab] (f32)."""
+    """Llama decoder; __call__ returns logits [B, S, vocab] (f32).
+
+    `return_hidden=True` returns the post-final_norm hidden states
+    [B, S, embed] instead — the trainer's fused blockwise loss
+    (ops/fused_xent.py) consumes them against `lm_head` directly, so
+    the [B, S, vocab] logits (the HBM high-water mark at 128k+
+    vocabs) are never formed.
+    """
     config: LlamaConfig
 
     @nn.compact
@@ -319,7 +326,8 @@ class Llama(nn.Module):
                  positions: Optional[jax.Array] = None,
                  decode: bool = False,
                  page_indices: Optional[jax.Array] = None,
-                 prefill: bool = False) -> jax.Array:
+                 prefill: bool = False,
+                 return_hidden: bool = False) -> jax.Array:
         cfg = self.config
         batch, seq = tokens.shape
         if positions is None:
@@ -345,6 +353,11 @@ class Llama(nn.Module):
             nn.with_logical_partitioning(
                 nn.initializers.normal(stddev=0.02), ('embed', 'vocab')),
             (cfg.embed_dim, cfg.vocab_size), jnp.float32)
+        if return_hidden:
+            # Head param is registered above so init() is identical
+            # with or without the fused-loss path.
+            return nn.with_logical_constraint(
+                x, ('batch', 'seq', 'act_embed'))
         # bf16 operands, accumulation dtype from cfg.logits_dtype
         # (None = f32: MXU-native rate, f32-safe softmax numerics).
         logits = jnp.einsum('bse,ev->bsv', x.astype(cfg.dtype),

@@ -191,10 +191,13 @@ class Mixtral(nn.Module):
                  positions: Optional[jax.Array] = None,
                  decode: bool = False,
                  page_indices: Optional[jax.Array] = None,
-                 prefill: bool = False):
+                 prefill: bool = False,
+                 return_hidden: bool = False):
         """Training: (logits, aux_loss). decode=True (serving): logits
         only — the KV-cache path of the shared llama attention, so the
-        generate/continuous-batching engines drive Mixtral unchanged."""
+        generate/continuous-batching engines drive Mixtral unchanged.
+        `return_hidden=True` swaps logits for the post-final_norm
+        hidden states (the fused-loss path, ops/fused_xent.py)."""
         cfg = self.config
         batch, seq = tokens.shape
         if positions is None:
@@ -224,6 +227,14 @@ class Mixtral(nn.Module):
             nn.with_logical_partitioning(
                 nn.initializers.normal(stddev=0.02), ('embed', 'vocab')),
             (cfg.embed_dim, cfg.vocab_size), jnp.float32)
+        if return_hidden:
+            hidden = nn.with_logical_constraint(
+                x, ('batch', 'seq', 'act_embed'))
+            if decode:
+                return hidden
+            aux_loss = (cfg.router_aux_loss_weight * total_aux /
+                        cfg.num_layers)
+            return hidden, aux_loss
         # bf16 operands; accumulation dtype from cfg.logits_dtype
         # (None = f32 — same knob as the other families).
         logits = jnp.einsum('bse,ev->bsv', x.astype(cfg.dtype),
@@ -243,3 +254,9 @@ def moe_next_token_loss(outputs, tokens: jax.Array) -> jax.Array:
     from skypilot_tpu.parallel.train import next_token_loss
     logits, aux_loss = outputs
     return next_token_loss(logits, tokens) + aux_loss
+
+
+# The fused blockwise-xent trainer path handles the (hidden, aux)
+# tuple generically — flag this loss as fused-compatible so
+# ShardedTrainer's auto-detection keeps Mixtral on the fast path.
+moe_next_token_loss.fused_ok = True

@@ -169,7 +169,7 @@ def _flash(q: jax.Array, k: jax.Array, v: jax.Array, *,
     # is per (batch, head) so shards are independent.
     heads_axis = ('tensor' if mesh.shape.get('tensor', 1) > 1 and
                   num_q_heads % mesh.shape['tensor'] == 0 else None)
-    from jax import shard_map
+    from skypilot_tpu.utils.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
     spec = P(tuple(batch_axes) if batch_axes else None, None, heads_axis,
              None)

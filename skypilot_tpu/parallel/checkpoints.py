@@ -42,7 +42,17 @@ class CheckpointManager:
 
     def restore(self, state_template: Any,
                 step: Optional[int] = None) -> Any:
-        """Restore into the template's shardings (abstract or concrete)."""
+        """Restore into the template's shardings (abstract or concrete).
+
+        Sharding-agnostic: orbax reshards on read, so a checkpoint
+        written with one optimizer-state layout restores into another
+        (e.g. a replicated-moments checkpoint into a ZeRO-1 trainer's
+        data-sharded template after flipping `--zero1`, or vice
+        versa). If the direct sharded read still fails — layout
+        metadata mismatches across orbax versions — fall back to an
+        unconstrained read followed by a device_put onto the
+        template's shardings.
+        """
         if step is None:
             step = self.latest_step()
         assert step is not None, 'no checkpoint to restore'
@@ -50,8 +60,20 @@ class CheckpointManager:
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=getattr(
                 x, 'sharding', None)) if hasattr(x, 'shape') else x,
             state_template)
-        return self._manager.restore(
-            step, args=ocp.args.StandardRestore(abstract))
+        try:
+            return self._manager.restore(
+                step, args=ocp.args.StandardRestore(abstract))
+        except Exception:  # pylint: disable=broad-except
+            plain = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
+                if hasattr(x, 'shape') else x,
+                state_template)
+            restored = self._manager.restore(
+                step, args=ocp.args.StandardRestore(plain))
+            return jax.tree.map(
+                lambda tpl, val: jax.device_put(val, tpl.sharding)
+                if getattr(tpl, 'sharding', None) is not None else val,
+                state_template, restored)
 
     def latest_step(self) -> Optional[int]:
         return self._manager.latest_step()

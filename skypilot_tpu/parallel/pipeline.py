@@ -445,7 +445,8 @@ class PipelinedLM:
                                                      'stage')
             return jax.lax.pmean(total / M, 'data')
 
-        fn = jax.shard_map(
+        from skypilot_tpu.utils.jax_compat import shard_map
+        fn = shard_map(
             pipeline, mesh=self.mesh,
             in_specs=(jax.tree.map(lambda _: P('stage'), stacked),
                       self._rest_specs(rest),

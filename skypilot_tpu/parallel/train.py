@@ -8,6 +8,7 @@ collectives. No hand-written collectives in the train loop.
 from __future__ import annotations
 
 import functools
+import inspect
 from typing import Any, Callable, Optional, Tuple
 
 import flax.linen as nn
@@ -17,6 +18,7 @@ import optax
 from flax import struct
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from skypilot_tpu.ops import fused_xent
 from skypilot_tpu.parallel import mesh as mesh_lib
 
 
@@ -68,19 +70,63 @@ def default_optimizer(learning_rate: float = 3e-4,
     )
 
 
+def _supports_fused(model: nn.Module, loss_fn: Callable) -> bool:
+    """Can this (model, loss) pair ride the fused blockwise xent path?
+
+    The model must expose `return_hidden` in its apply signature and
+    the loss must be the stock next-token CE (or flagged `fused_ok`,
+    e.g. mixtral's CE + aux-loss wrapper) — a custom logits-space loss
+    needs the logits and stays on the naive path.
+    """
+    try:
+        sig = inspect.signature(type(model).__call__)
+    except (TypeError, ValueError):  # builtins / exotic callables
+        return False
+    if 'return_hidden' not in sig.parameters:
+        return False
+    return loss_fn is next_token_loss or bool(
+        getattr(loss_fn, 'fused_ok', False))
+
+
 class ShardedTrainer:
-    """Builds sharded init/step functions for a flax LM over a mesh."""
+    """Builds sharded init/step functions for a flax LM over a mesh.
+
+    `fused_xent` (None = auto) routes the loss through the blockwise
+    LM-head cross-entropy (ops/fused_xent.py): the model returns final
+    hidden states and the [B, S, V] logits tensor — the training
+    memory high-water mark — is never materialized in either pass.
+    Auto enables it whenever the model supports `return_hidden` and
+    the loss is the stock CE; `False` forces the naive path.
+
+    `zero1` shards the optimizer moments (ZeRO-1, Xu et al.
+    arXiv:2004.13336) over the mesh's `data` axis on top of whatever
+    fsdp/tensor layout the params already use: each data replica
+    keeps 1/data of the Adam m/v state, GSPMD reduce-scatters the
+    grads into the shards and all-gathers the updated params — the
+    step math (and loss curve) is unchanged.
+    """
 
     def __init__(self, model: nn.Module, mesh: Mesh,
                  tx: Optional[optax.GradientTransformation] = None,
                  rules=mesh_lib.DEFAULT_RULES,
                  loss_fn: Callable[[jax.Array, jax.Array],
-                                   jax.Array] = next_token_loss) -> None:
+                                   jax.Array] = next_token_loss,
+                 fused_xent: Optional[bool] = None,
+                 zero1: bool = False) -> None:
         self.model = model
         self.mesh = mesh
         self.tx = tx if tx is not None else default_optimizer()
         self.rules = rules
         self.loss_fn = loss_fn
+        self.zero1 = zero1
+        supported = _supports_fused(model, loss_fn)
+        if fused_xent and not supported:
+            raise ValueError(
+                f'fused_xent=True but {type(model).__name__} has no '
+                f'return_hidden apply path or the loss_fn is not '
+                f'fused-compatible')
+        self.fused_xent = supported if fused_xent is None else bool(
+            fused_xent)
         self.batch_sharding = mesh_lib.batch_sharding(mesh)
         self._state_sharding: Optional[Any] = None
 
@@ -93,9 +139,55 @@ class ShardedTrainer:
                     ['params'],
                     self.tx))
             specs = nn.get_partition_spec(abstract)
-            self._state_sharding = nn.logical_to_mesh_sharding(
+            sharding = nn.logical_to_mesh_sharding(
                 specs, self.mesh, self.rules)
+            if self.zero1:
+                shapes = jax.tree.map(
+                    lambda x: x.unbox() if isinstance(x, nn.Partitioned)
+                    else x,
+                    abstract.opt_state,
+                    is_leaf=lambda x: isinstance(x, nn.Partitioned))
+                sharding = sharding.replace(
+                    opt_state=self._zero1_opt_sharding(
+                        sharding.opt_state, shapes))
+            self._state_sharding = sharding
         return self._state_sharding
+
+    def _zero1_opt_sharding(self, opt_sharding: Any, opt_shapes: Any
+                            ) -> Any:
+        """ZeRO-1: layer the `data` mesh axis onto each optimizer-state
+        leaf's sharding. Picks the first dim whose size the combined
+        (existing axes x data) factor divides; leaves that fit nowhere
+        (scalars like Adam's `count`, odd-sized vectors) stay as-is —
+        they are noise next to the m/v moments."""
+        sizes = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+        data = sizes.get('data', 1)
+        if data <= 1:
+            return opt_sharding
+
+        def _axes(entry):
+            if entry is None:
+                return ()
+            return entry if isinstance(entry, tuple) else (entry,)
+
+        def shard_leaf(s, shape_leaf):
+            shape = getattr(shape_leaf, 'shape', ())
+            if not isinstance(s, NamedSharding) or len(shape) == 0:
+                return s
+            spec = list(s.spec) + [None] * (len(shape) - len(s.spec))
+            if any('data' in _axes(e) for e in spec):
+                return s
+            for dim, entry in enumerate(spec):
+                axes = _axes(entry)
+                cur = 1
+                for a in axes:
+                    cur *= sizes.get(a, 1)
+                if shape[dim] % (cur * data) == 0:
+                    spec[dim] = (*axes, 'data') if axes else 'data'
+                    return NamedSharding(self.mesh, P(*spec))
+            return s
+
+        return jax.tree.map(shard_leaf, opt_sharding, opt_shapes)
 
     # -- init ---------------------------------------------------------------
     def init(self, rng: jax.Array, example_tokens: jax.Array) -> TrainState:
@@ -115,15 +207,35 @@ class ShardedTrainer:
                 return jax.jit(_init, out_shardings=sharding)()
 
     # -- step ---------------------------------------------------------------
+    def _compute_loss(self, params: Any, tokens: jax.Array) -> jax.Array:
+        if self.fused_xent:
+            out = self.model.apply({'params': params}, tokens,
+                                   return_hidden=True)
+            aux = None
+            if isinstance(out, (tuple, list)):
+                out, aux = out
+            head, vocab_in_rows = fused_xent.find_lm_head(params)
+            loss = fused_xent.fused_next_token_loss(
+                out, head, tokens, vocab_in_rows=vocab_in_rows)
+            return loss if aux is None else loss + aux
+        outputs = self.model.apply({'params': params}, tokens)
+        return self.loss_fn(outputs, tokens)
+
     def _step_body(self, state: TrainState, tokens: jax.Array
                    ) -> Tuple[TrainState, jax.Array]:
-        def compute_loss(params):
-            logits = self.model.apply({'params': params}, tokens)
-            return self.loss_fn(logits, tokens)
-
-        loss, grads = jax.value_and_grad(compute_loss)(state.params)
+        loss, grads = jax.value_and_grad(self._compute_loss)(
+            state.params, tokens)
         updates, opt_state = self.tx.update(grads, state.opt_state,
                                             state.params)
+        if self.zero1 and self._state_sharding is not None:
+            # Pin the moment update to the ZeRO-1 layout *inside* the
+            # step (the jit out_shardings only constrain the final
+            # carry — this keeps every lax.scan iteration of the
+            # multi-step path sharded too, so GSPMD reduce-scatters
+            # grads into the moment shards instead of materializing
+            # replicated Adam state between inner steps).
+            opt_state = jax.lax.with_sharding_constraint(
+                opt_state, self._state_sharding.opt_state)
         params = optax.apply_updates(state.params, updates)
         return state.replace(step=state.step + 1, params=params,
                              opt_state=opt_state), loss
@@ -181,8 +293,7 @@ class ShardedTrainer:
         sharding = self.state_sharding(example_tokens)
 
         def _eval(state: TrainState, tokens: jax.Array) -> jax.Array:
-            logits = self.model.apply({'params': state.params}, tokens)
-            return self.loss_fn(logits, tokens)
+            return self._compute_loss(state.params, tokens)
 
         step = jax.jit(_eval,
                        in_shardings=(sharding, self.batch_sharding),

@@ -121,6 +121,20 @@ def main() -> None:
     parser.add_argument('--seq-parallel', type=int, default=1,
                         help='context-parallel mesh axis size '
                              '(ring attention)')
+    parser.add_argument('--no-fused-xent', action='store_true',
+                        help='disable the fused blockwise LM-head '
+                             'cross-entropy (ops/fused_xent.py) and '
+                             'materialize the full [B,S,V] logits — '
+                             'the escape hatch; fused is the default '
+                             'whenever the model supports it')
+    parser.add_argument('--zero1', action='store_true',
+                        help='ZeRO-1: shard optimizer moments (Adam '
+                             'm/v) over the data mesh axis — cuts '
+                             'per-chip optimizer HBM by the data-'
+                             'parallel degree with step-identical '
+                             'math (GSPMD reduce-scatters grads into '
+                             'the shards and all-gathers updated '
+                             'params)')
     parser.add_argument('--remat', action='store_true')
     parser.add_argument('--log-every', type=int, default=10)
     parser.add_argument('--profile', default=None, metavar='DIR',
@@ -219,6 +233,10 @@ def main() -> None:
                 print(f'pipeline: rounding global batch to {batch} '
                       f'({microbatches} microbatches x '
                       f'data={mesh_cfg.data})', flush=True)
+        if (args.no_fused_xent or args.zero1) and proc_id == 0:
+            print('pipeline trainer: --no-fused-xent/--zero1 ignored '
+                  '(the GPipe path computes its head per-stage and '
+                  'keeps per-stage opt state)', flush=True)
         pp = PipelinedLM(model, mesh, num_microbatches=microbatches)
         example = jnp.zeros((batch, args.seq), jnp.int32)
         state = pp.init(jax.random.PRNGKey(0), example, tx)
@@ -227,7 +245,16 @@ def main() -> None:
         step_fn = pp.make_train_step(tx)
     else:
         kwargs = {} if loss_fn is None else {'loss_fn': loss_fn}
-        trainer = ShardedTrainer(model, mesh, tx=tx, **kwargs)
+        trainer = ShardedTrainer(
+            model, mesh, tx=tx,
+            # None = auto: fused whenever the model supports it (all
+            # bundled families do; an hf-imported exotic module
+            # without return_hidden falls back to the naive path).
+            fused_xent=False if args.no_fused_xent else None,
+            zero1=args.zero1, **kwargs)
+        if proc_id == 0:
+            print(f'fused_xent={trainer.fused_xent} zero1={args.zero1}',
+                  flush=True)
 
         example = jnp.zeros((batch, args.seq), jnp.int32)
         state = trainer.init(jax.random.PRNGKey(0), example)

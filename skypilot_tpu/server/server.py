@@ -530,11 +530,16 @@ def run(host: str = '127.0.0.1',
     # dialable host:port (cross-replica log streaming connects to it).
     # SKYPILOT_API_SERVER_HOST overrides the host part (k8s: the pod
     # IP — pod names don't resolve under a non-headless Service);
-    # SKYPILOT_API_SERVER_ID overrides the whole identity.
+    # SKYPILOT_API_SERVER_ID overrides the whole identity. The
+    # identity host is NOT the bind host: the server must still bind
+    # the caller-supplied address (loopback by default — on hosts
+    # whose hostname resolves off-loopback, binding the identity would
+    # silently expose an intended-local server, or refuse local
+    # clients).
     import socket as _socket
-    host = os.environ.get('SKYPILOT_API_SERVER_HOST') or \
+    id_host = os.environ.get('SKYPILOT_API_SERVER_HOST') or \
         _socket.gethostname()
-    executor.set_server_id(f'{host}:{port}')
+    executor.set_server_id(f'{id_host}:{port}')
     worker_loop = executor.RequestWorkerLoop()
     worker_loop.start()
     # HA: re-adopt managed jobs orphaned by a previous server/controller

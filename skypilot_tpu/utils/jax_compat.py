@@ -1,0 +1,71 @@
+"""JAX version compatibility shims.
+
+`shard_map` moved from `jax.experimental.shard_map` (<= 0.4.x, with a
+`check_rep` flag and an `auto` axis set) to top-level `jax.shard_map`
+(>= 0.5, `check_vma` flag and an `axis_names` manual-axis set). The
+ops/parallel layers call this one wrapper with the NEW spelling and it
+translates for whichever jax is installed — the container images pin
+different jax versions per accelerator generation.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs,
+              check_vma: Optional[bool] = None,
+              axis_names: Optional[set] = None):
+    """`jax.shard_map` with graceful fallback to the experimental API.
+
+    axis_names: the MANUAL mesh axes (new-API semantics); every other
+    mesh axis stays auto/GSPMD-managed. None = all axes manual.
+    """
+    new_sm = getattr(jax, 'shard_map', None)
+    if new_sm is not None:
+        kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+        if check_vma is not None:
+            kwargs['check_vma'] = check_vma
+        if axis_names is not None:
+            kwargs['axis_names'] = set(axis_names)
+        return new_sm(f, **kwargs)
+    from jax.experimental.shard_map import shard_map as old_sm
+    kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    if check_vma is not None:
+        kwargs['check_rep'] = check_vma
+    if axis_names is not None:
+        kwargs['auto'] = frozenset(mesh.axis_names) - set(axis_names)
+    return old_sm(f, **kwargs)
+
+
+def supports_partial_manual_axes() -> bool:
+    """Whether shard_map can leave some mesh axes auto/GSPMD-managed
+    (`axis_names` on new jax, `auto=` on old). Old XLA's SPMD
+    partitioner rejects the PartitionId ops this produces
+    ("PartitionId instruction is not supported for SPMD
+    partitioning"), so partial-manual callers — pipeline-with-tensor-
+    within-stages — must gate on this and fall back or skip."""
+    return hasattr(jax, 'shard_map')
+
+
+def axis_size(axis_name) -> 'jax.Array':
+    """`lax.axis_size` (jax >= 0.5); psum(1) under a manual axis
+    otherwise — same value, trace-time constant either way."""
+    from jax import lax
+    if hasattr(lax, 'axis_size'):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
+def pvary(x, axis_names):
+    """Mark `x` device-varying over `axis_names` (jax >= 0.7 vma
+    tracking; >= 0.9 spells it pcast(to='varying')). A no-op on older
+    jax, which has no varying-axes type system — callers run those
+    shard_maps with check_vma=False."""
+    from jax import lax
+    if hasattr(lax, 'pcast'):
+        return lax.pcast(x, axis_names, to='varying')
+    if hasattr(lax, 'pvary'):
+        return lax.pvary(x, axis_names)
+    return x

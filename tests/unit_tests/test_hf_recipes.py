@@ -127,6 +127,14 @@ def test_serve_lm_hf_checkpoint(hf_ckpt):
         assert all(c['text'] == choice['text']
                    for c in multi['choices'])
         assert multi['usage']['completion_tokens'] == 12
+        # The prompt is counted ONCE regardless of n (OpenAI usage
+        # contract — it used to be summed per choice).
+        assert multi['usage']['prompt_tokens'] == 4
+        assert multi['usage']['total_tokens'] == 16
+        n2 = _post(f'http://127.0.0.1:{port}/v1/completions',
+                   {**body, 'n': 2})
+        assert n2['usage']['prompt_tokens'] == 4
+        assert n2['usage']['completion_tokens'] == 8
         from urllib.error import HTTPError
         try:
             _post(f'http://127.0.0.1:{port}/v1/completions',

@@ -1,0 +1,67 @@
+"""run() must bind the caller-supplied host, not the replica identity.
+
+Regression for the high-severity ADVICE.md finding: run() used to
+overwrite its `host` parameter with SKYPILOT_API_SERVER_HOST /
+gethostname() before web.run_app, so `run(host='127.0.0.1')` bound
+whatever the hostname resolved to (a LAN IP on many distros) —
+exposing an intended-loopback server, or refusing local clients. The
+identity host must flow ONLY into executor.set_server_id().
+"""
+import pytest
+
+
+class _Dummy:
+    def __init__(self, *a, **kw):
+        pass
+
+    def start(self):
+        pass
+
+
+@pytest.fixture()
+def quiet_run(monkeypatch, isolated_state):
+    """Neutralize run()'s side-effecting collaborators and capture the
+    bind host + replica identity."""
+    from skypilot_tpu.server import server as server_mod
+    from skypilot_tpu.server import daemons as daemons_lib
+    from skypilot_tpu.server.requests import executor
+    from skypilot_tpu.jobs import scheduler as jobs_scheduler
+    from skypilot_tpu.serve import core as serve_core
+
+    seen = {}
+    monkeypatch.setattr(
+        server_mod.web, 'run_app',
+        lambda app, host=None, port=None, **kw: seen.update(
+            bind_host=host, bind_port=port))
+    monkeypatch.setattr(
+        executor, 'set_server_id',
+        lambda server_id: seen.update(server_id=server_id))
+    monkeypatch.setattr(executor, 'RequestWorkerLoop', _Dummy)
+    monkeypatch.setattr(daemons_lib, 'ServerDaemons', _Dummy)
+    monkeypatch.setattr(jobs_scheduler, 'maybe_schedule_next_jobs',
+                        lambda: None)
+    monkeypatch.setattr(serve_core, 'reconcile_controllers',
+                        lambda: None)
+    monkeypatch.setattr(server_mod, 'create_app', lambda: object())
+    return seen
+
+
+def test_run_binds_loopback_despite_identity_env(quiet_run,
+                                                 monkeypatch):
+    from skypilot_tpu.server import server as server_mod
+    monkeypatch.setenv('SKYPILOT_API_SERVER_HOST', '10.11.12.13')
+    server_mod.run(host='127.0.0.1', port=45799)
+    # The env var shapes the replica IDENTITY only...
+    assert quiet_run['server_id'] == '10.11.12.13:45799'
+    # ...while the socket binds the caller-supplied loopback.
+    assert quiet_run['bind_host'] == '127.0.0.1'
+    assert quiet_run['bind_port'] == 45799
+
+
+def test_run_identity_defaults_to_hostname(quiet_run, monkeypatch):
+    import socket
+    from skypilot_tpu.server import server as server_mod
+    monkeypatch.delenv('SKYPILOT_API_SERVER_HOST', raising=False)
+    server_mod.run(host='0.0.0.0', port=45798)
+    assert quiet_run['server_id'] == f'{socket.gethostname()}:45798'
+    assert quiet_run['bind_host'] == '0.0.0.0'

@@ -404,3 +404,24 @@ def test_cancel_frees_slots_mid_generation():
         assert len(full) == len(p) + 6
     finally:
         eng.stop()
+
+
+@pytest.mark.slow
+def test_cancel_sweeps_request_still_in_queue():
+    """A cancelled request that the scheduler has NOT yet drained out
+    of _queue must resolve unrun (it used to be admitted later and
+    decoded to completion). Deterministic: stop the scheduler thread
+    so the request provably sits in _queue, then apply cancellations
+    directly."""
+    model, params = _build('llama')
+    eng = ContinuousBatchingEngine(model, params, num_slots=1,
+                                   max_total_len=48)
+    eng.stop()  # freeze the scheduler: nothing drains _queue
+    prompt = [5, 9, 2]
+    fut = eng.submit(prompt, max_new_tokens=8)
+    assert not eng._queue.empty()  # still queued, never admitted
+    eng.cancel([fut])
+    eng._apply_cancellations()
+    assert fut.result(timeout=5) == prompt  # resolved unrun
+    assert eng._queue.empty()
+    assert not eng._ready

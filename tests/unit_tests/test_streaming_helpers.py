@@ -77,3 +77,51 @@ def test_metrics_percentiles():
     assert abs(snap['ttft_ms_p50'] - 5.0) <= 0.3
     assert snap['completion_tokens_total'] == 1000
     assert snap['gen_tokens_per_sec'] > 0
+
+
+def test_usage_counts_prompt_once_for_n():
+    """usage.prompt_tokens counts each prompt ONCE regardless of n
+    (OpenAI contract) — row_prompt holds one entry per CHOICE, so
+    summing it over-reported the prompt n-fold."""
+    from skypilot_tpu.inference.openai_compat import (CompletionRequest,
+                                                      run_completion)
+
+    class _Tok:
+        def __call__(self, prompt):
+            return {'input_ids': [1, 2, 3, 4]}
+
+        def decode(self, ids, skip_special_tokens=True):
+            return 'x' * len(ids)
+
+    class _Metrics:
+        def record(self, *args):
+            pass
+
+    class _RT:
+        engine = None
+        model_name = 'stub'
+        metrics = _Metrics()
+
+        def get_tokenizer(self):
+            return _Tok()
+
+        def limit_for(self, temperature, streaming=False):
+            return 64
+
+    # max_new=0 scoring mode: no generation, usage still reported.
+    req = CompletionRequest(prompts=['hello'], max_new=0,
+                            temperature=0.0, top_p=1.0,
+                            stop_strings=None, n=2, stream=False)
+    out = run_completion(_RT(), req)
+    assert len(out['choices']) == 2
+    assert out['usage']['prompt_tokens'] == 4      # once, not 2 x 4
+    assert out['usage']['completion_tokens'] == 0
+    assert out['usage']['total_tokens'] == 4
+
+    # Two prompts x n=2: both prompts counted, each once.
+    req2 = CompletionRequest(prompts=['a', 'b'], max_new=0,
+                             temperature=0.0, top_p=1.0,
+                             stop_strings=None, n=2, stream=False)
+    out2 = run_completion(_RT(), req2)
+    assert len(out2['choices']) == 4
+    assert out2['usage']['prompt_tokens'] == 8
