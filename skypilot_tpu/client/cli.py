@@ -475,6 +475,44 @@ def cost_report() -> None:
     Console().print(table)
 
 
+@cli.command(name='metrics')
+@click.option('--url', default=None, metavar='URL',
+              help='Scrape this URL instead of the API server '
+                   '(e.g. an inference replica: '
+                   'http://HOST:PORT/metrics).')
+@click.option('--stats', is_flag=True, default=False,
+              help='Fetch the JSON /stats snapshot from an inference '
+                   'server instead of Prometheus text (requires '
+                   '--url or defaults to the replica root of URL).')
+def metrics_cmd(url: Optional[str], stats: bool) -> None:
+    """One metrics scrape: the API server's /api/metrics by default,
+    or any replica's /metrics (--url) / JSON /stats (--stats).
+    Prometheus text goes to stdout — pipe into grep/promtool."""
+    import json as _json
+
+    import requests as _requests
+    if stats:
+        if not url:
+            _err('--stats needs --url http://HOST:PORT '
+                 '(an inference replica)')
+            return
+        base = url.rstrip('/')
+        if base.endswith('/metrics'):
+            base = base[:-len('/metrics')]
+        if not base.endswith('/stats'):
+            base = base + '/stats'
+        resp = _requests.get(base, timeout=15)
+        resp.raise_for_status()
+        click.echo(_json.dumps(resp.json(), indent=2))
+        return
+    if url:
+        resp = _requests.get(url, timeout=15)
+        resp.raise_for_status()
+        click.echo(resp.text, nl=False)
+        return
+    click.echo(sdk.api_metrics(), nl=False)
+
+
 # ---------------------------------------------------------------------------
 # storage group
 # ---------------------------------------------------------------------------

@@ -246,6 +246,17 @@ def api_status(limit: int = 100) -> List[Dict[str, Any]]:
     return resp.json()['requests']
 
 
+def api_metrics() -> str:
+    """One Prometheus scrape of the API server's /api/metrics
+    (orchestration gauges, per-route request histograms, process
+    RSS). Returns the raw text exposition."""
+    url = _ensure_server()
+    resp = requests.get(f'{url}/api/metrics', headers=_headers(),
+                        timeout=30)
+    resp.raise_for_status()
+    return resp.text
+
+
 # ---------------------------------------------------------------------------
 # Verbs (all return request_id)
 # ---------------------------------------------------------------------------

@@ -3,7 +3,15 @@
 JetStream-shaped native endpoints + OpenAI shims:
 
   GET  /                       readiness + capacity
-  GET  /stats                  engine + serving metrics (incl. TTFT)
+  GET  /stats                  engine + serving metrics, JSON
+                               (rolling-window percentiles)
+  GET  /metrics                Prometheus text exposition of the
+                               process registry: engine internals
+                               (queue depth, slots, page pool,
+                               prefix cache, preemptions) + request
+                               path (TTFT/ITL/e2e histograms, token
+                               counters) — see docs/guides.md for
+                               the metric catalog
   POST /generate               token ids in/out; `stream` = SSE of
                                {"index", "token"} events
   POST /generate_text          text in/out via the --hf tokenizer;
@@ -29,14 +37,17 @@ from typing import List
 from skypilot_tpu.inference import openai_compat as oai
 from skypilot_tpu.inference.runtime import (InferenceRuntime,
                                             iter_interleaved)
+from skypilot_tpu.observability import REGISTRY
+from skypilot_tpu.observability import catalog as obs_catalog
 
 
-def serve(rt: InferenceRuntime, port: int,
-          drain_grace: float = 630.0) -> None:
-    """Run the HTTP server until killed. `drain_grace` bounds the
-    SIGTERM drain wait; it defaults ABOVE the 600s request future
-    timeout so a worst-case in-flight generation still completes —
-    requests longer than the grace window are dropped at exit."""
+def make_server(rt: InferenceRuntime,
+                port: int) -> ThreadingHTTPServer:
+    """Build the (not yet serving) HTTP server for `rt`. Split from
+    `serve()` so tests can run it on an ephemeral port from a thread
+    (serve() additionally installs the SIGTERM drain, which only
+    works on the main thread). The in-flight POST count rides on the
+    returned server as `.inflight`/`.inflight_lock`."""
 
     # Live POSTs (graceful drain waits on this, covering the window
     # between accept and engine submit and the one-shot engine).
@@ -82,6 +93,9 @@ def serve(rt: InferenceRuntime, port: int,
             if self.path in ('/stats', '/v1/stats'):
                 self._stats()
                 return
+            if self.path in ('/metrics', '/v1/metrics'):
+                self._prometheus_metrics()
+                return
             if self.path == '/v1/models':
                 # OpenAI client bootstrap: most SDKs list models
                 # before first use.
@@ -99,17 +113,34 @@ def serve(rt: InferenceRuntime, port: int,
                         'max_total_len': min(rt.limit_for(0.0),
                                              rt.limit_for(1.0))})
 
+        def _prometheus_metrics(self):
+            """Prometheus text exposition of the process registry.
+            Snapshot gauges (queue depth, slot occupancy, page pool)
+            refresh from live engine state at scrape time; counters
+            and histograms tick at their event sites."""
+            for eng in rt.live_engines():
+                eng.update_metric_gauges()
+            body = REGISTRY.render().encode()
+            self.send_response(200)
+            self.send_header('Content-Type', REGISTRY.CONTENT_TYPE)
+            self.send_header('Content-Length', str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
         def _stats(self):
             """Engine observability (the vLLM /metrics idea, JSON):
             slot occupancy, page pool, prefix-cache hit rate,
             speculation quality, and serving latency percentiles
-            (TTFT from streamed requests)."""
+            over the rolling window documented by the `window` key
+            (GET /metrics carries the same signals as lifetime
+            Prometheus series)."""
             engine = rt.engine
             body = {'serving': rt.metrics.snapshot()}
             if engine is None:
                 body['engine'] = 'simple'
                 self._json(body)
                 return
+            engine.update_metric_gauges()
             body.update({
                 'engine': 'continuous',
                 'num_slots': engine.num_slots,
@@ -121,11 +152,17 @@ def serve(rt: InferenceRuntime, port: int,
                     engine.tokens_committed /
                     max(engine.decode_calls, 1), 3),
                 'speculative_k': engine.spec_k,
+                'preemptions': engine.preemptions,
             })
             if engine.paged:
+                free = int(engine.allocator.free_pages)
                 body['page_pool'] = {
                     'total': engine.total_pages,
-                    'free': engine.allocator.free_pages,
+                    'free': free,
+                    'used': engine.total_pages - free,
+                    'utilization': round(
+                        (engine.total_pages - free) /
+                        max(engine.total_pages, 1), 3),
                 }
                 if engine.prefix_cache is not None:
                     pc = engine.prefix_cache
@@ -134,6 +171,7 @@ def serve(rt: InferenceRuntime, port: int,
                         'misses': pc.misses,
                         'hit_rate': round(
                             pc.hits / max(pc.hits + pc.misses, 1), 3),
+                        'evictions': pc.evictions,
                         'resident_unreferenced': len(pc.lru),
                     }
             self._json(body)
@@ -191,15 +229,22 @@ def serve(rt: InferenceRuntime, port: int,
                                           top_k, top_p, stop_ids)
                     return
                 t0 = time.monotonic()
+                ttft = None
                 if rt.engine is not None:
                     # Ragged rows welcome: each joins the shared
-                    # decode loop independently.
+                    # decode loop independently. The shared latch
+                    # records TTFT at the request's FIRST committed
+                    # token (any row) — non-streaming requests get
+                    # real TTFT too, not just streamed ones.
+                    latch = obs_catalog.FirstTokenLatch()
                     futs = [rt.engine.submit(
                         [int(t) for t in row], max_new_tokens=max_new,
                         temperature=temperature, top_k=top_k,
-                        top_p=top_p, stop_token_ids=stop_ids)
+                        top_p=top_p, stop_token_ids=stop_ids,
+                        on_token=latch)
                         for row in tokens]
                     rows = [f.result(timeout=600) for f in futs]
+                    ttft = latch.first_token_s
                 else:
                     import jax
                     import jax.numpy as jnp
@@ -215,7 +260,10 @@ def serve(rt: InferenceRuntime, port: int,
                 # not the buffer tail (metrics feed /stats tok/s).
                 n_gen = sum(min(max(len(r) - len(p), 0), max_new)
                             for r, p in zip(rows, tokens))
-                rt.metrics.record(time.monotonic() - t0, n_gen)
+                rt.metrics.record(time.monotonic() - t0, n_gen,
+                                  ttft_s=ttft,
+                                  n_prompt_tokens=sum(
+                                      len(p) for p in tokens))
                 self._json({'tokens': rows})
             except Exception as e:  # pylint: disable=broad-except
                 self._plain_error(e)
@@ -243,10 +291,15 @@ def serve(rt: InferenceRuntime, port: int,
             self.sse_start()
             n_gen = 0
             ttft = None
+            last_t = {}  # per-row previous-token instant (ITL)
             try:
                 for i, t in iter_interleaved(handles):
+                    now = time.monotonic()
                     if ttft is None:
-                        ttft = time.monotonic() - t0
+                        ttft = now - t0
+                    if i in last_t:
+                        rt.metrics.record_inter_token(now - last_t[i])
+                    last_t[i] = now
                     n_gen += 1
                     self.sse_send({'index': i, 'token': t})
             finally:
@@ -258,7 +311,9 @@ def serve(rt: InferenceRuntime, port: int,
                                       for h in handles]})
             self.sse_done()
             rt.metrics.record(time.monotonic() - t0, n_gen,
-                              ttft_s=ttft)
+                              ttft_s=ttft,
+                              n_prompt_tokens=sum(
+                                  len(row) for row in tokens))
 
         def _openai_completions(self):
             try:
@@ -350,12 +405,16 @@ def serve(rt: InferenceRuntime, port: int,
                         stop_strings)
                     return
                 t0 = time.monotonic()
+                ttft = None
                 if rt.engine is not None:
+                    latch = obs_catalog.FirstTokenLatch()
                     futs = [rt.engine.submit(
                         ids, max_new_tokens=max_new,
                         temperature=temperature, top_k=top_k,
-                        top_p=top_p) for ids in encoded]
+                        top_p=top_p, on_token=latch)
+                        for ids in encoded]
                     rows = [f.result(timeout=600) for f in futs]
+                    ttft = latch.first_token_s
                 else:
                     rows = rt.one_shot_rows(encoded, max_new,
                                             temperature)
@@ -366,7 +425,10 @@ def serve(rt: InferenceRuntime, port: int,
                          for t in texts]
                 n_gen = sum(len(r) - len(p)
                             for r, p in zip(rows, encoded))
-                rt.metrics.record(time.monotonic() - t0, n_gen)
+                rt.metrics.record(time.monotonic() - t0, n_gen,
+                                  ttft_s=ttft,
+                                  n_prompt_tokens=sum(
+                                      len(p) for p in encoded))
                 self._json({'texts': texts})
             except Exception as e:  # pylint: disable=broad-except
                 self._plain_error(e)
@@ -387,10 +449,15 @@ def serve(rt: InferenceRuntime, port: int,
                      for _ in encoded]
             n_gen = 0
             ttft = None
+            last_t = {}  # per-row previous-token instant (ITL)
             try:
                 for i, t in iter_interleaved(handles):
+                    now = time.monotonic()
                     if ttft is None:
-                        ttft = time.monotonic() - t0
+                        ttft = now - t0
+                    if i in last_t:
+                        rt.metrics.record_inter_token(now - last_t[i])
+                    last_t[i] = now
                     n_gen += 1
                     if scans[i].hit:
                         continue
@@ -407,9 +474,23 @@ def serve(rt: InferenceRuntime, port: int,
                         self.sse_send({'index': i, 'delta': out})
             self.sse_done()
             rt.metrics.record(time.monotonic() - t0, n_gen,
-                              ttft_s=ttft)
+                              ttft_s=ttft,
+                              n_prompt_tokens=sum(
+                                  len(ids) for ids in encoded))
 
     server = ThreadingHTTPServer(('0.0.0.0', port), Handler)
+    server.inflight = _inflight            # type: ignore[attr-defined]
+    server.inflight_lock = _inflight_lock  # type: ignore[attr-defined]
+    return server
+
+
+def serve(rt: InferenceRuntime, port: int,
+          drain_grace: float = 630.0) -> None:
+    """Run the HTTP server until killed. `drain_grace` bounds the
+    SIGTERM drain wait; it defaults ABOVE the 600s request future
+    timeout so a worst-case in-flight generation still completes —
+    requests longer than the grace window are dropped at exit."""
+    server = make_server(rt, port)
 
     _term = threading.Event()
 
@@ -429,8 +510,8 @@ def serve(rt: InferenceRuntime, port: int,
         server.shutdown()   # stops accepting; handlers keep running
         deadline = time.time() + drain_grace
         while time.time() < deadline:
-            with _inflight_lock:
-                if _inflight['n'] == 0:
+            with server.inflight_lock:
+                if server.inflight['n'] == 0:
                     break
             time.sleep(0.2)
         rt.stop()

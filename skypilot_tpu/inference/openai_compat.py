@@ -209,6 +209,7 @@ def run_completion(rt: InferenceRuntime, req: CompletionRequest
                              f'max_total_len {limit}')
     rows: List[List[int]] = []
     row_prompt: List[List[int]] = []  # prompt ids per output row
+    ttft: Optional[float] = None      # engine path latches first commit
     if req.max_new <= 0:
         # Scoring mode (echo + logprobs + max_tokens=0 — the eval-
         # harness contract): no generation at all.
@@ -217,14 +218,18 @@ def run_completion(rt: InferenceRuntime, req: CompletionRequest
                 rows.append(list(ids))
                 row_prompt.append(ids)
     elif rt.engine is not None:
+        from skypilot_tpu.observability.catalog import FirstTokenLatch
+        latch = FirstTokenLatch()  # non-streaming TTFT: first commit
         futs = []
         for ids in encoded:
             for _ in range(req.n):
                 futs.append(rt.engine.submit(
                     ids, max_new_tokens=req.max_new,
-                    temperature=req.temperature, top_p=req.top_p))
+                    temperature=req.temperature, top_p=req.top_p,
+                    on_token=latch))
                 row_prompt.append(ids)
         rows = [f.result(timeout=600) for f in futs]
+        ttft = latch.first_token_s
     else:
         import jax
         import jax.numpy as jnp
@@ -270,7 +275,8 @@ def run_completion(rt: InferenceRuntime, req: CompletionRequest
     # holds one entry per choice, so summing it would over-report the
     # prompt n× under n>1.
     total_prompt = sum(len(ids) for ids in encoded)
-    rt.metrics.record(time.monotonic() - t0, total_completion)
+    rt.metrics.record(time.monotonic() - t0, total_completion,
+                      ttft_s=ttft, n_prompt_tokens=total_prompt)
     return {
         'object': 'text_completion',
         'model': rt.model_name,
@@ -329,11 +335,16 @@ def stream_completion(rt: InferenceRuntime, req: CompletionRequest,
     scans = [StopStringScanner(req.stop_strings) for _ in range(req.n)]
     n_gen = [0] * req.n
     ttft: Optional[float] = None
+    last_t: Dict[int, float] = {}  # per-choice previous token (ITL)
 
     try:
         for i, t in iter_interleaved(handles):
+            now = time.monotonic()
             if ttft is None:
-                ttft = time.monotonic() - t0
+                ttft = now - t0
+            if i in last_t:
+                rt.metrics.record_inter_token(now - last_t[i])
+            last_t[i] = now
             n_gen[i] += 1
             if scans[i].hit:
                 continue  # post-stop tokens: drop
@@ -353,7 +364,8 @@ def stream_completion(rt: InferenceRuntime, req: CompletionRequest,
                   else 'length' if n_gen[i] >= req.max_new else 'stop')
         writer.sse_send(chunk(i, None, finish))
     writer.sse_done()
-    rt.metrics.record(time.monotonic() - t0, sum(n_gen), ttft_s=ttft)
+    rt.metrics.record(time.monotonic() - t0, sum(n_gen), ttft_s=ttft,
+                      n_prompt_tokens=len(ids))
 
 
 def render_chat_prompt(rt: InferenceRuntime, messages) -> str:

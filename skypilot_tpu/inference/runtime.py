@@ -20,28 +20,58 @@ from typing import Dict, List, Optional, Tuple
 
 
 class ServingMetrics:
-    """Rolling request metrics, thread-safe. TTFT is recorded at the
-    first streamed token (only streaming requests observe one); e2e
-    latency + completion tokens for every request."""
+    """Request metrics, thread-safe, dual-exported:
+
+      - JSON `/stats` percentiles over a ROLLING WINDOW of the last
+        `window` (default 1024) requests — `*_p50`/`*_p95` keys move
+        as old requests age out;
+      - Prometheus histograms/counters on `GET /metrics` covering the
+        WHOLE process lifetime (observability/catalog.py).
+
+    TTFT is the first COMMITTED token: streamed requests latch it at
+    the first streamed token, non-streaming engine-backed requests at
+    the first decode-step commit (catalog.FirstTokenLatch). One-shot
+    (non-engine, non-streaming) requests have no per-token signal and
+    record no TTFT. Inter-token gaps come from streamed requests
+    only, measured per request row."""
 
     def __init__(self, window: int = 1024) -> None:
+        from skypilot_tpu.observability import catalog as obs_catalog
         self._lock = threading.Lock()
+        self.window = window
         self.ttft_ms: 'collections.deque' = collections.deque(
+            maxlen=window)
+        self.itl_ms: 'collections.deque' = collections.deque(
             maxlen=window)
         self.latency_ms: 'collections.deque' = collections.deque(
             maxlen=window)
         self.completion_tokens: 'collections.deque' = collections.deque(
             maxlen=window)
         self.requests = 0
+        self.prom = obs_catalog.RequestMetrics()
 
     def record(self, latency_s: float, n_tokens: int,
-               ttft_s: Optional[float] = None) -> None:
+               ttft_s: Optional[float] = None,
+               n_prompt_tokens: int = 0) -> None:
         with self._lock:
             self.requests += 1
             self.latency_ms.append(latency_s * 1000.0)
             self.completion_tokens.append(n_tokens)
             if ttft_s is not None:
                 self.ttft_ms.append(ttft_s * 1000.0)
+        self.prom.requests.inc()
+        self.prom.e2e_latency_seconds.observe(latency_s)
+        self.prom.completion_tokens.inc(max(n_tokens, 0))
+        self.prom.prompt_tokens.inc(max(n_prompt_tokens, 0))
+        if ttft_s is not None:
+            self.prom.ttft_seconds.observe(ttft_s)
+
+    def record_inter_token(self, gap_s: float) -> None:
+        """One gap between consecutive streamed tokens of a request
+        row (called from the SSE pump loops)."""
+        with self._lock:
+            self.itl_ms.append(gap_s * 1000.0)
+        self.prom.inter_token_seconds.observe(gap_s)
 
     @staticmethod
     def _pct(values: List[float], q: float) -> Optional[float]:
@@ -52,16 +82,25 @@ class ServingMetrics:
         return round(s[idx], 2)
 
     def snapshot(self) -> Dict[str, object]:
+        """JSON stats. Window semantics: every `*_p50`/`*_p95` key and
+        `gen_tokens_per_sec` cover the last `window` requests (see
+        `window` key); `requests` counts the process lifetime. TTFT
+        keys cover streamed + engine-backed non-streaming requests;
+        `itl_ms_*` cover streamed requests only."""
         with self._lock:
             lat = list(self.latency_ms)
             ttft = list(self.ttft_ms)
+            itl = list(self.itl_ms)
             toks = list(self.completion_tokens)
             n = self.requests
         total_s = sum(lat) / 1000.0
         return {
             'requests': n,
+            'window': self.window,
             'ttft_ms_p50': self._pct(ttft, 0.50),
             'ttft_ms_p95': self._pct(ttft, 0.95),
+            'itl_ms_p50': self._pct(itl, 0.50),
+            'itl_ms_p95': self._pct(itl, 0.95),
             'latency_ms_p50': self._pct(lat, 0.50),
             'latency_ms_p95': self._pct(lat, 0.95),
             'completion_tokens_total': sum(toks),
@@ -301,6 +340,12 @@ class InferenceRuntime:
             top_k=top_k, top_p=top_p, stop_token_ids=stop_token_ids,
             on_token=handle.on_token)
         return handle
+
+    def live_engines(self) -> List[object]:
+        """Engines constructed so far (main and/or lazy stream engine)
+        — the scrape handlers refresh each one's gauges."""
+        return [e for e in (self.engine, self._stream_engine)
+                if e is not None]
 
     def cancel_streams(self, handles: List[StreamHandle]) -> None:
         """Abandon streamed requests whose consumer disconnected: the

@@ -20,14 +20,18 @@ from __future__ import annotations
 
 import collections
 import functools
+import itertools
 import queue
 import threading
+import time
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from skypilot_tpu.observability import catalog as _obs
 
 
 def _bucket(n: int, cap: int) -> int:
@@ -54,7 +58,8 @@ class PrefixCache:
     page (the paged-KV contract, docs/internals.md §4).
     """
 
-    def __init__(self, page_size: int) -> None:
+    def __init__(self, page_size: int,
+                 metrics: Optional['_obs.EngineMetrics'] = None) -> None:
         self.page_size = page_size
         self.by_key: Dict[bytes, int] = {}
         self.key_of: Dict[int, bytes] = {}
@@ -62,8 +67,10 @@ class PrefixCache:
         # Resident-but-unreferenced pages, oldest first (evictable).
         self.lru: 'collections.OrderedDict[int, None]' = \
             collections.OrderedDict()
-        self.hits = 0    # pages served from cache
-        self.misses = 0  # full prompt pages that had to be computed
+        self.hits = 0       # pages served from cache
+        self.misses = 0     # full prompt pages that had to be computed
+        self.evictions = 0  # cached pages returned under pool pressure
+        self._metrics = metrics  # owning engine's Prometheus bundle
 
     @staticmethod
     def chain_keys(tokens, page_size: int) -> List[bytes]:
@@ -91,6 +98,9 @@ class PrefixCache:
             self.lru.pop(page, None)
         self.hits += len(pages)
         self.misses += len(keys) - len(pages)
+        if self._metrics is not None:
+            self._metrics.prefix_hits.inc(len(pages))
+            self._metrics.prefix_misses.inc(len(keys) - len(pages))
         return pages
 
     def release(self, pages: List[int]) -> None:
@@ -117,9 +127,17 @@ class PrefixCache:
             page, _ = self.lru.popitem(last=False)
             del self.by_key[self.key_of.pop(page)]
             allocator.release([page])
+            self.evictions += 1
+            if self._metrics is not None:
+                self._metrics.prefix_evictions.inc()
 
 
 class ContinuousBatchingEngine:
+
+    # Prometheus `engine` label values: one per engine instance in
+    # this process (the serving runtime may run two — the main engine
+    # plus the lazy stream engine).
+    _instance_ids = itertools.count()
 
     def __init__(self, model, params, *, num_slots: int = 8,
                  max_total_len: int = 256, temperature: float = 0.0,
@@ -211,6 +229,13 @@ class ContinuousBatchingEngine:
         self.prefix_caching = bool(prefix_caching and self.paged)
         self.prefix_cache: Optional[PrefixCache] = None  # set per reset
 
+        # Prometheus instruments (observability/catalog.py), labeled
+        # by engine instance; counters tick at the event sites below,
+        # gauges refresh in update_metric_gauges() at scrape time.
+        self.engine_id = str(next(self._instance_ids))
+        self.metrics = _obs.EngineMetrics(self.engine_id)
+        self.metrics.num_slots.set(num_slots)
+
         # _fresh_cache is the single paging-reset point (also the
         # error-recovery path).
         self.cache = self._fresh_cache()
@@ -230,9 +255,12 @@ class ContinuousBatchingEngine:
             [None] * num_slots
 
         # Observability: model calls vs tokens committed (speculation
-        # quality = tokens_committed / decode_calls, 1.0..K+1).
+        # quality = tokens_committed / decode_calls, 1.0..K+1), and
+        # page-pressure preemptions (the /stats + /metrics signal that
+        # the pool is undersized for the offered load).
         self.decode_calls = 0
         self.tokens_committed = 0
+        self.preemptions = 0
 
         self._chunk_decode = (self._make_chunk_decode_fn()
                               if self.decode_chunk > 1 else None)
@@ -270,7 +298,8 @@ class ContinuousBatchingEngine:
         self.allocated_tokens = np.zeros((self.num_slots,), np.int32)
         # Prefix caching (vLLM APC): per-slot shared (read-only) page
         # refs + the prompt's chain keys for promotion on completion.
-        self.prefix_cache = (PrefixCache(self.page_size)
+        self.prefix_cache = (PrefixCache(self.page_size,
+                                         metrics=self.metrics)
                              if self.prefix_caching else None)
         self.shared_pages: List[List[int]] = [
             [] for _ in range(self.num_slots)]
@@ -605,6 +634,20 @@ class ContinuousBatchingEngine:
         self._stop.set()
         self._thread.join(timeout=10)
 
+    def update_metric_gauges(self) -> None:
+        """Refresh the snapshot-style Prometheus gauges from live
+        engine state. Called by the scrape handlers (/metrics and
+        /stats) — reads race the scheduler thread harmlessly (numpy
+        scalar reads; a stale value is one round old at worst)."""
+        self.metrics.queue_depth.set(self._queue.qsize() +
+                                     len(self._ready))
+        self.metrics.active_slots.set(int(self.active.sum()))
+        self.metrics.num_slots.set(self.num_slots)
+        if self.paged:
+            free = int(self.allocator.free_pages)
+            self.metrics.pages_free.set(free)
+            self.metrics.pages_used.set(self.total_pages - free)
+
     # -- scheduler loop -----------------------------------------------------
     def _loop(self) -> None:
         while not self._stop.is_set():
@@ -612,7 +655,10 @@ class ContinuousBatchingEngine:
                 progressed = self._admit()
                 self._apply_cancellations()
                 if self.active.any():
+                    t_step = time.perf_counter()
                     self._decode_step()
+                    self.metrics.decode_step_seconds.observe(
+                        time.perf_counter() - t_step)
                     progressed = True
                 if not progressed and self._queue.empty() and \
                         not self._ready:
@@ -739,6 +785,7 @@ class ContinuousBatchingEngine:
             # future instead of leaving the client hanging.
             self.futures[slot] = fut
             suffix = prompt[n_cached:]
+            t_prefill = time.perf_counter()
             padded = jnp.asarray(
                 suffix + [0] * (bucket - suffix_len), jnp.int32)
             if self.paged and n_cached:
@@ -768,6 +815,9 @@ class ContinuousBatchingEngine:
             else:
                 first = jnp.argmax(last_logits)
             self.cur_token[slot] = int(jax.device_get(first))
+            self.metrics.prefill_seconds.observe(
+                time.perf_counter() - t_prefill)
+            self.metrics.admissions.inc()
             self.pos[slot] = plen
             self.outputs[slot] = list(prompt)
             limit = min(plen + max_new, self.max_total_len)
@@ -830,6 +880,8 @@ class ContinuousBatchingEngine:
             remaining = int(self.limits[slot]) - len(self.outputs[slot])
             self.futures[slot] = None
             self.active[slot] = False
+            self.preemptions += 1
+            self.metrics.preemptions.inc()
             self._release_slot_pages(slot, promote=False)
             if fut is not None:
                 preempted.append((list(self.outputs[slot]),
@@ -911,6 +963,7 @@ class ContinuousBatchingEngine:
         self.outputs[slot].append(tok)
         self._emit(slot, tok)
         self.tokens_committed += 1
+        self.metrics.tokens_committed.inc()
         self.pos[slot] += 1
         self.cur_token[slot] = int(next_tok)
         done = len(self.outputs[slot]) >= int(self.limits[slot])
@@ -946,6 +999,7 @@ class ContinuousBatchingEngine:
             jnp.asarray(self.top_ps), sub, *extra)
         sampled = np.asarray(jax.device_get(sampled))
         self.decode_calls += 1
+        self.metrics.decode_steps.inc()
         for slot in range(self.num_slots):
             if not self.active[slot]:
                 continue
@@ -973,6 +1027,7 @@ class ContinuousBatchingEngine:
             self._rng, *extra)
         toks = np.asarray(jax.device_get(toks))       # [n, slots]
         self.decode_calls += 1
+        self.metrics.decode_steps.inc()
         for slot in range(self.num_slots):
             if not was_active[slot]:
                 continue
@@ -1005,6 +1060,7 @@ class ContinuousBatchingEngine:
             *extra)
         y = np.asarray(jax.device_get(y))              # [slots, K+1]
         self.decode_calls += 1
+        self.metrics.decode_steps.inc()
         for slot in range(self.num_slots):
             if not self.active[slot]:
                 continue

@@ -1,0 +1,14 @@
+"""Unified observability layer: metrics registry + Prometheus text
+exposition (metrics.py), the process-wide metric catalog (catalog.py),
+and the trainer's JSONL step-metrics emitter (step_metrics.py).
+
+Scrape points:
+  - API server:        GET /api/metrics   (server/server.py)
+  - inference server:  GET /metrics       (inference/http_server.py)
+  - trainer:           --metrics-file out.jsonl (recipes/train_lm.py)
+"""
+from skypilot_tpu.observability.metrics import (Counter, Gauge,
+                                                Histogram, REGISTRY,
+                                                Registry)
+
+__all__ = ['Counter', 'Gauge', 'Histogram', 'REGISTRY', 'Registry']

@@ -1,0 +1,224 @@
+"""The metric catalog: every Prometheus metric this codebase exports.
+
+One table, three consumers:
+  - the instrumentation sites (`counter()`/`gauge()`/`histogram()`
+    get-or-create against the default REGISTRY from these specs);
+  - the docs metric table (docs/guides.md — kept in sync by
+    tests/unit_tests/test_metric_catalog.py);
+  - the CI name checker (snake_case, `skypilot_` prefix, documented).
+
+Adding a metric = adding a row here + a line in the docs table; the
+checker fails the build on drift.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional, Tuple
+
+from skypilot_tpu.observability import metrics as m
+
+# Latency buckets, seconds. Step/prefill: device dispatches (ms..s);
+# request path: whole generations (up to minutes).
+STEP_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                0.5, 1.0, 2.5, 5.0, 10.0)
+REQUEST_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                   10.0, 30.0, 60.0, 120.0)
+TOKEN_GAP_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                     0.25, 0.5, 1.0, 2.5)
+
+# name -> (kind, help, labelnames[, options])
+#   kind: counter | gauge | histogram | gauge_as_counter
+#   options: {'buckets': (...)} for histograms
+SPECS: Dict[str, Tuple] = {
+    # -- serving engine (models/batching.py); label engine = instance id
+    'skypilot_serving_queue_depth': (
+        'gauge', 'Requests waiting for a decode slot (queued + ready)',
+        ('engine',)),
+    'skypilot_serving_active_slots': (
+        'gauge', 'Decode slots currently running a request',
+        ('engine',)),
+    'skypilot_serving_num_slots': (
+        'gauge', 'Decode slot pool size', ('engine',)),
+    'skypilot_serving_admissions_total': (
+        'counter', 'Requests admitted into a decode slot (prefilled)',
+        ('engine',)),
+    'skypilot_serving_preemptions_total': (
+        'counter', 'Requests preempted by KV page-pool pressure '
+                   '(re-queued for recompute)', ('engine',)),
+    'skypilot_serving_decode_steps_total': (
+        'counter', 'Jitted decode dispatches (plain, chunked, or '
+                   'speculative-verify rounds)', ('engine',)),
+    'skypilot_serving_tokens_committed_total': (
+        'counter', 'Generated tokens committed across all slots',
+        ('engine',)),
+    'skypilot_serving_decode_step_seconds': (
+        'histogram', 'Wall time of one decode round (dispatch + '
+                     'host commit)', ('engine',),
+        {'buckets': STEP_BUCKETS}),
+    'skypilot_serving_prefill_seconds': (
+        'histogram', 'Wall time of one admission prefill (bucketed '
+                     'prompt forward pass)', ('engine',),
+        {'buckets': STEP_BUCKETS}),
+    'skypilot_serving_pages_free': (
+        'gauge', 'Free pages in the shared KV page pool', ('engine',)),
+    'skypilot_serving_pages_used': (
+        'gauge', 'Allocated pages in the shared KV page pool '
+                 '(incl. prefix-cache residents)', ('engine',)),
+    'skypilot_serving_prefix_cache_hits_total': (
+        'counter', 'Prompt pages served from the prefix cache '
+                   '(prefill skipped)', ('engine',)),
+    'skypilot_serving_prefix_cache_misses_total': (
+        'counter', 'Full prompt pages that had to be computed',
+        ('engine',)),
+    'skypilot_serving_prefix_cache_evictions_total': (
+        'counter', 'Cached pages evicted back to the allocator under '
+                   'pool pressure', ('engine',)),
+    # -- serving request path (inference/runtime.py + http_server.py)
+    'skypilot_serving_requests_total': (
+        'counter', 'Completed generation requests', ()),
+    'skypilot_serving_prompt_tokens_total': (
+        'counter', 'Prompt tokens across completed requests', ()),
+    'skypilot_serving_completion_tokens_total': (
+        'counter', 'Generated tokens across completed requests', ()),
+    'skypilot_serving_ttft_seconds': (
+        'histogram', 'Time to first token: first committed token for '
+                     'engine-backed requests (streaming and not)',
+        (), {'buckets': REQUEST_BUCKETS}),
+    'skypilot_serving_inter_token_seconds': (
+        'histogram', 'Gap between consecutive streamed tokens of one '
+                     'request row', (),
+        {'buckets': TOKEN_GAP_BUCKETS}),
+    'skypilot_serving_e2e_latency_seconds': (
+        'histogram', 'End-to-end request latency', (),
+        {'buckets': REQUEST_BUCKETS}),
+    # -- API server (server/server.py)
+    'skypilot_api_requests_total': (
+        'counter', 'API server HTTP requests', ('route', 'method',
+                                                'code')),
+    'skypilot_api_request_seconds': (
+        'histogram', 'API server HTTP request latency',
+        ('route', 'method'), {'buckets': STEP_BUCKETS}),
+    'skypilot_api_requests_in_flight': (
+        'gauge', 'API server HTTP requests currently being handled',
+        ()),
+    'skypilot_requests_total': (
+        'gauge_as_counter', 'Async request records by status '
+                            '(DB-derived at scrape)', ('status',)),
+    'skypilot_clusters': (
+        'gauge', 'Clusters by status', ('status',)),
+    'skypilot_managed_jobs': (
+        'gauge', 'Managed jobs by status', ('status',)),
+    'skypilot_services': ('gauge', 'SkyServe services', ()),
+    'skypilot_service_replicas_ready': (
+        'gauge', 'Ready replicas across services', ()),
+    'skypilot_server_rss_bytes': (
+        'gauge', 'API server process RSS', ()),
+    'skypilot_workers_rss_bytes': (
+        'gauge', 'Combined RSS of API server child processes', ()),
+    'skypilot_server_uptime_seconds': (
+        'gauge', 'Seconds since the API server started', ()),
+    'skypilot_scrape_errors_total': (
+        'counter', 'Orchestration-gauge sections that failed to '
+                   'collect (see server log)', ('section',)),
+}
+
+_KINDS = {'counter': m.Counter, 'gauge': m.Gauge,
+          'histogram': m.Histogram, 'gauge_as_counter': m.Gauge}
+
+
+def _create(name: str,
+            registry: Optional[m.Registry] = None) -> m._Metric:
+    spec = SPECS[name]
+    kind, help_text, labelnames = spec[0], spec[1], spec[2]
+    options = spec[3] if len(spec) > 3 else {}
+    registry = registry or m.REGISTRY
+    kwargs = dict(options)
+    if kind == 'gauge_as_counter':
+        kwargs['expose_type'] = 'counter'
+    return registry.get_or_create(_KINDS[kind], name, help_text,
+                                  labelnames, **kwargs)
+
+
+def counter(name: str) -> m.Counter:
+    return _create(name)
+
+
+def gauge(name: str) -> m.Gauge:
+    return _create(name)
+
+
+def histogram(name: str) -> m.Histogram:
+    return _create(name)
+
+
+class EngineMetrics:
+    """The continuous-batching engine's instrument bundle, one labeled
+    child set per engine instance (label engine="0", "1", ...)."""
+
+    def __init__(self, engine_label: str) -> None:
+        lab = {'engine': engine_label}
+        self.queue_depth = gauge(
+            'skypilot_serving_queue_depth').labels(**lab)
+        self.active_slots = gauge(
+            'skypilot_serving_active_slots').labels(**lab)
+        self.num_slots = gauge(
+            'skypilot_serving_num_slots').labels(**lab)
+        self.admissions = counter(
+            'skypilot_serving_admissions_total').labels(**lab)
+        self.preemptions = counter(
+            'skypilot_serving_preemptions_total').labels(**lab)
+        self.decode_steps = counter(
+            'skypilot_serving_decode_steps_total').labels(**lab)
+        self.tokens_committed = counter(
+            'skypilot_serving_tokens_committed_total').labels(**lab)
+        self.decode_step_seconds = histogram(
+            'skypilot_serving_decode_step_seconds').labels(**lab)
+        self.prefill_seconds = histogram(
+            'skypilot_serving_prefill_seconds').labels(**lab)
+        self.pages_free = gauge(
+            'skypilot_serving_pages_free').labels(**lab)
+        self.pages_used = gauge(
+            'skypilot_serving_pages_used').labels(**lab)
+        self.prefix_hits = counter(
+            'skypilot_serving_prefix_cache_hits_total').labels(**lab)
+        self.prefix_misses = counter(
+            'skypilot_serving_prefix_cache_misses_total').labels(**lab)
+        self.prefix_evictions = counter(
+            'skypilot_serving_prefix_cache_evictions_total').labels(
+                **lab)
+
+
+class RequestMetrics:
+    """The inference request path's instrument bundle (process-global,
+    shared by every runtime in the process)."""
+
+    def __init__(self) -> None:
+        self.requests = counter('skypilot_serving_requests_total')
+        self.prompt_tokens = counter(
+            'skypilot_serving_prompt_tokens_total')
+        self.completion_tokens = counter(
+            'skypilot_serving_completion_tokens_total')
+        self.ttft_seconds = histogram('skypilot_serving_ttft_seconds')
+        self.inter_token_seconds = histogram(
+            'skypilot_serving_inter_token_seconds')
+        self.e2e_latency_seconds = histogram(
+            'skypilot_serving_e2e_latency_seconds')
+
+
+class FirstTokenLatch:
+    """TTFT for non-streaming engine requests: passed as the engine's
+    `on_token` callback, latches the wall-clock instant of the FIRST
+    decode-step commit (streaming requests latch in their own
+    StreamHandle). Thread-safe by construction: the latch is written
+    only by the engine scheduler thread."""
+
+    __slots__ = ('t0', 'first_token_s')
+
+    def __init__(self) -> None:
+        self.t0 = time.monotonic()
+        self.first_token_s: Optional[float] = None
+
+    def __call__(self, tok: int) -> None:
+        del tok
+        if self.first_token_s is None:
+            self.first_token_s = time.monotonic() - self.t0

@@ -1,0 +1,120 @@
+"""Trainer step telemetry: one JSONL record per logged step window.
+
+`train_lm.py --metrics-file out.jsonl` constructs a StepMetrics and
+calls `log()` at every `--log-every` boundary. Each record carries
+the TPU-pod vital signs (step time, tokens/s, loss, grad norm) plus
+an achieved-MFU estimate against the device's peak FLOPs — the
+"are we running as fast as the hardware allows" number every perf PR
+is judged by. Records are flushed line-by-line so a preempted run's
+file is still valid JSONL up to the last completed window.
+
+MFU model: achieved = 6 * n_params * tokens/s (the standard dense-
+transformer train-FLOPs estimate, fwd+bwd); peak comes from
+SKYPILOT_DEVICE_PEAK_FLOPS (per device, bf16) or a small device-kind
+table. Unknown hardware (CPU smoke runs) reports mfu = null rather
+than a made-up number.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+# Peak bf16 FLOPs per chip (marketing numbers; the MFU denominator).
+# device_kind substrings, checked in order.
+_PEAK_FLOPS_BY_KIND = (
+    ('v5p', 459e12),
+    ('v5e', 197e12),  # v5 litepod
+    ('v6e', 918e12),
+    ('v4', 275e12),
+    ('v3', 123e12),
+    ('v2', 45e12),
+)
+
+
+def peak_flops_per_device() -> Optional[float]:
+    """Per-device peak FLOPs: env override first, then the device-kind
+    table; None when neither matches (e.g. CPU)."""
+    env = os.environ.get('SKYPILOT_DEVICE_PEAK_FLOPS')
+    if env:
+        return float(env)
+    try:
+        import jax
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:  # pylint: disable=broad-except
+        return None
+    for sub, flops in _PEAK_FLOPS_BY_KIND:
+        if sub in kind:
+            return flops
+    return None
+
+
+class StepMetrics:
+    """JSONL step-metrics emitter. Construct once per run; `log()`
+    per logged window; `close()` at the end (also flushes)."""
+
+    def __init__(self, path: str, *, n_params: Optional[int] = None,
+                 n_devices: int = 1,
+                 peak_flops: Optional[float] = None) -> None:
+        self.path = os.path.expanduser(path)
+        parent = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(parent, exist_ok=True)
+        self.n_params = n_params
+        self.n_devices = max(n_devices, 1)
+        self.peak_flops = (peak_flops if peak_flops is not None
+                           else peak_flops_per_device())
+        self._f = open(self.path, 'a', encoding='utf-8')
+
+    def mfu(self, tokens_per_sec: float) -> Optional[float]:
+        """Achieved-MFU estimate: 6 * N * tok/s over the slice's
+        aggregate peak. None without a param count or a known peak."""
+        if not self.n_params or not self.peak_flops:
+            return None
+        achieved = 6.0 * self.n_params * tokens_per_sec
+        return round(achieved / (self.peak_flops * self.n_devices), 4)
+
+    def log(self, step: int, *, step_time_s: float, tokens: int,
+            loss: float, grad_norm: Optional[float] = None,
+            extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        """Write one record covering a window that ended at `step`:
+        `step_time_s` is the mean per-step wall time over the window,
+        `tokens` the tokens consumed by ONE step."""
+        tokens_per_sec = (tokens / step_time_s if step_time_s > 0
+                          else 0.0)
+        record: Dict[str, Any] = {
+            'step': int(step),
+            'time': time.time(),
+            'step_time_s': round(float(step_time_s), 6),
+            'tokens_per_sec': round(tokens_per_sec, 2),
+            'loss': float(loss),
+            'grad_norm': (None if grad_norm is None
+                          else float(grad_norm)),
+            'mfu': self.mfu(tokens_per_sec),
+        }
+        if extra:
+            record.update(extra)
+        self._f.write(json.dumps(record) + '\n')
+        self._f.flush()
+        return record
+
+    def close(self) -> None:
+        if not self._f.closed:
+            self._f.close()
+
+    def __enter__(self) -> 'StepMetrics':
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def read_jsonl(path: str) -> List[Dict[str, Any]]:
+    """Parse a --metrics-file back into records (analysis + tests)."""
+    records = []
+    with open(os.path.expanduser(path), 'r', encoding='utf-8') as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                records.append(json.loads(line))
+    return records

@@ -112,13 +112,19 @@ class ShardedTrainer:
                  loss_fn: Callable[[jax.Array, jax.Array],
                                    jax.Array] = next_token_loss,
                  fused_xent: Optional[bool] = None,
-                 zero1: bool = False) -> None:
+                 zero1: bool = False,
+                 collect_grad_norm: bool = False) -> None:
         self.model = model
         self.mesh = mesh
         self.tx = tx if tx is not None else default_optimizer()
         self.rules = rules
         self.loss_fn = loss_fn
         self.zero1 = zero1
+        # Step metrics (`train_lm --metrics-file`): the step returns
+        # (loss, grad_norm) instead of a bare loss. The norm is
+        # computed from grads already in registers — free next to the
+        # step itself.
+        self.collect_grad_norm = collect_grad_norm
         supported = _supports_fused(model, loss_fn)
         if fused_xent and not supported:
             raise ValueError(
@@ -222,9 +228,11 @@ class ShardedTrainer:
         return self.loss_fn(outputs, tokens)
 
     def _step_body(self, state: TrainState, tokens: jax.Array
-                   ) -> Tuple[TrainState, jax.Array]:
+                   ) -> Tuple[TrainState, Any]:
         loss, grads = jax.value_and_grad(self._compute_loss)(
             state.params, tokens)
+        aux = (loss if not self.collect_grad_norm
+               else (loss, optax.global_norm(grads)))
         updates, opt_state = self.tx.update(grads, state.opt_state,
                                             state.params)
         if self.zero1 and self._state_sharding is not None:
@@ -238,7 +246,7 @@ class ShardedTrainer:
                 opt_state, self._state_sharding.opt_state)
         params = optax.apply_updates(state.params, updates)
         return state.replace(step=state.step + 1, params=params,
-                             opt_state=opt_state), loss
+                             opt_state=opt_state), aux
 
     def _wrap(self, step: Callable) -> Callable:
         def wrapped(state, tokens):

@@ -7,7 +7,10 @@ server: GET / (readiness), POST /generate {"tokens": [[...]],
 "max_new_tokens": N, "temperature": t, "top_k": k, "top_p": p} →
 {"tokens": [[...]]}, plus /generate_text and OpenAI-compatible
 /v1/completions + /v1/chat/completions with SSE streaming
-(`"stream": true`) and n>1. Listens on SKYPILOT_SERVE_PORT (injected
+(`"stream": true`) and n>1, plus observability endpoints: GET /stats
+(JSON rolling-window snapshot) and GET /metrics (Prometheus text —
+engine internals + request-path histograms; metric catalog in
+docs/guides.md). Listens on SKYPILOT_SERVE_PORT (injected
 by the serve controller). Two engines:
 
   - default: one jitted fixed-shape generate fn per batch bucket

@@ -137,6 +137,20 @@ def main() -> None:
                              'params)')
     parser.add_argument('--remat', action='store_true')
     parser.add_argument('--log-every', type=int, default=10)
+    parser.add_argument('--metrics-file', default=None, metavar='PATH',
+                        help='append one JSONL record per --log-every '
+                             'window: step, step_time_s, '
+                             'tokens_per_sec, loss, grad_norm, and an '
+                             'achieved-MFU estimate '
+                             '(observability/step_metrics.py) — the '
+                             'machine-readable twin of the printed '
+                             'log line')
+    parser.add_argument('--trace-file', default=None, metavar='PATH',
+                        help='write a Chrome-trace timeline (load in '
+                             'Perfetto) with per-phase spans — init, '
+                             'data, step, checkpoint — same format as '
+                             'SKYPILOT_TIMELINE_FILE_PATH, enabled '
+                             'from the CLI')
     parser.add_argument('--profile', default=None, metavar='DIR',
                         help='capture a jax.profiler trace '
                              '(TensorBoard/Perfetto-readable) of a few '
@@ -161,6 +175,10 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
     import numpy as np
+
+    from skypilot_tpu.utils import timeline
+    if args.trace_file:
+        timeline.enable(args.trace_file)
 
     from skypilot_tpu.parallel import mesh as mesh_lib
     from skypilot_tpu.parallel.train import (ShardedTrainer,
@@ -251,13 +269,17 @@ def main() -> None:
             # bundled families do; an hf-imported exotic module
             # without return_hidden falls back to the naive path).
             fused_xent=False if args.no_fused_xent else None,
-            zero1=args.zero1, **kwargs)
+            zero1=args.zero1,
+            # --metrics-file wants grad_norm in every record.
+            collect_grad_norm=args.metrics_file is not None,
+            **kwargs)
         if proc_id == 0:
             print(f'fused_xent={trainer.fused_xent} zero1={args.zero1}',
                   flush=True)
 
         example = jnp.zeros((batch, args.seq), jnp.int32)
-        state = trainer.init(jax.random.PRNGKey(0), example)
+        with timeline.Event('train/init'):
+            state = trainer.init(jax.random.PRNGKey(0), example)
         step_fn = trainer.make_train_step(example)
     if hf_params is not None:
         # Replace the random init with the imported weights, placed
@@ -308,16 +330,36 @@ def main() -> None:
                                  args.profile_steps.split(':'))
     tracing = False
 
+    # Step telemetry (--metrics-file): one JSONL record per logged
+    # window. The GPipe path keeps its per-stage step fn (no grad
+    # norm); the sharded trainer returns (loss, grad_norm).
+    has_gnorm = (args.metrics_file is not None and
+                 args.pipeline_stages <= 1)
+    emitter = None
+    if args.metrics_file and proc_id == 0:
+        from skypilot_tpu.observability.step_metrics import StepMetrics
+        n_params = sum(int(np.prod(x.shape))
+                       for x in jax.tree.leaves(state.params))
+        emitter = StepMetrics(args.metrics_file, n_params=n_params,
+                              n_devices=n_dev)
+        print(f'step metrics -> {args.metrics_file} '
+              f'(n_params={n_params:,})', flush=True)
+
     start_step = int(state.step)
     t0 = time.perf_counter()
     window_tokens = 0
+    window_steps = 0
     for step in range(start_step, args.steps):
         # >= not ==: a checkpoint resume may land past prof_start.
         if not tracing and prof_start >= 0 and \
                 prof_start <= step < prof_stop:
             jax.profiler.start_trace(args.profile)
             tracing = True
-        state, loss = step_fn(state, next_tokens())
+        with timeline.Event('train/data'):
+            tokens = next_tokens()
+        with timeline.Event('train/step', f'step {step}'):
+            state, aux = step_fn(state, tokens)
+        loss, gnorm = aux if has_gnorm else (aux, None)
         if tracing and step + 1 >= prof_stop:
             # Block so the trace holds COMPLETE device timelines for
             # the window, not just dispatches.
@@ -327,8 +369,10 @@ def main() -> None:
             print(f'profile: steps {prof_start}..{prof_stop} traced '
                   f'to {args.profile}', flush=True)
         window_tokens += batch * args.seq
+        window_steps += 1
         if mgr is not None:
-            mgr.save(step + 1, state)
+            with timeline.Event('train/checkpoint', f'step {step + 1}'):
+                mgr.save(step + 1, state)
         if tracing and step + 1 >= args.steps:
             # Window ran past the final step: still flush the trace.
             jax.block_until_ready(loss)
@@ -342,14 +386,28 @@ def main() -> None:
             print(f'step {step + 1}/{args.steps} '
                   f'loss={float(loss):.4f} '
                   f'tokens/s={window_tokens / dt:,.0f}', flush=True)
+            if emitter is not None:
+                emitter.log(
+                    step + 1,
+                    step_time_s=dt / max(window_steps, 1),
+                    tokens=batch * args.seq,
+                    loss=float(loss),
+                    grad_norm=(float(gnorm) if gnorm is not None
+                               else None))
             t0 = time.perf_counter()
             window_tokens = 0
+            window_steps = 0
     if mgr is not None:
-        mgr.save(args.steps, state, force=True)
-        mgr.wait_until_finished()
-        mgr.close()
+        with timeline.Event('train/checkpoint', 'final'):
+            mgr.save(args.steps, state, force=True)
+            mgr.wait_until_finished()
+            mgr.close()
+    if emitter is not None:
+        emitter.close()
     if proc_id == 0:
         print('training done', flush=True)
+    if args.trace_file:
+        timeline.save()
 
 
 if __name__ == '__main__':

@@ -9,8 +9,10 @@ from __future__ import annotations
 
 import asyncio
 import json
+import logging
 import os
 import pickle
+import time
 from typing import Any, Dict, Optional
 
 from aiohttp import web
@@ -18,11 +20,15 @@ from aiohttp import web
 from skypilot_tpu import constants
 from skypilot_tpu import exceptions
 from skypilot_tpu.agent import log_lib
+from skypilot_tpu.observability import REGISTRY
+from skypilot_tpu.observability import catalog as obs_catalog
 from skypilot_tpu.server import versions
 from skypilot_tpu.server.requests import executor
 from skypilot_tpu.utils import db_utils
 
 API_VERSION = versions.API_VERSION
+
+logger = logging.getLogger(__name__)
 
 routes = web.RouteTableDef()
 
@@ -187,85 +193,89 @@ async def api_health(request: web.Request) -> web.Response:
 _SERVER_START_TIME = None  # set in run()
 
 
-def _orchestration_gauge_lines() -> list:
-    import traceback
-    lines: list = []
+def _refresh_orchestration_gauges() -> None:
+    """Populate the registry's orchestration gauges (clusters, managed
+    jobs, services, request records) from the DB aggregates. Pure
+    aggregate queries (no handle unpickling), run off the event loop;
+    a broken table loses only its own section — loudly, via the
+    logger and the skypilot_scrape_errors_total counter (the old
+    traceback.print_exc-to-stdout was invisible to log shippers)."""
+    errors = obs_catalog.counter('skypilot_scrape_errors_total')
 
-    def section(fn) -> None:
+    def section(name, fn) -> None:
         try:
-            lines.extend(fn())
+            fn()
         except Exception:  # pylint: disable=broad-except
-            traceback.print_exc()  # lose one section, not the scrape
+            errors.labels(section=name).inc()
+            logger.exception('metrics scrape: %s section failed '
+                             '(losing the section, not the scrape)',
+                             name)
 
     def clusters():
         from skypilot_tpu import global_state
-        out = ['# TYPE skypilot_clusters gauge']
+        gauge = obs_catalog.gauge('skypilot_clusters')
+        gauge.clear()  # a status that emptied must not linger
         for status, count in sorted(
                 global_state.cluster_status_counts().items()):
-            out.append(f'skypilot_clusters{{status="{status}"}} {count}')
-        return out
+            gauge.labels(status=status).set(count)
 
     def jobs():
         from skypilot_tpu.jobs import state as jobs_state
-        out = ['# TYPE skypilot_managed_jobs gauge']
+        gauge = obs_catalog.gauge('skypilot_managed_jobs')
+        gauge.clear()
         for status, count in sorted(jobs_state.status_counts().items()):
-            out.append(
-                f'skypilot_managed_jobs{{status="{status}"}} {count}')
-        return out
+            gauge.labels(status=status).set(count)
 
     def serve():
         from skypilot_tpu.serve import serve_state
-        return [
-            '# TYPE skypilot_services gauge',
-            f'skypilot_services {serve_state.count_services()}',
-            '# TYPE skypilot_service_replicas_ready gauge',
-            f'skypilot_service_replicas_ready '
-            f'{serve_state.count_ready_replicas()}',
-        ]
+        obs_catalog.gauge('skypilot_services').set(
+            serve_state.count_services())
+        obs_catalog.gauge('skypilot_service_replicas_ready').set(
+            serve_state.count_ready_replicas())
 
-    for fn in (clusters, jobs, serve):
-        section(fn)
-    return lines
+    def requests_by_status():
+        counts: Dict[str, int] = {}
+        for row in executor.list_requests(limit=10000):
+            counts[row['status']] = counts.get(row['status'], 0) + 1
+        # Running totals recomputed from the source of truth each
+        # scrape (exposed under TYPE counter: catalog gauge_as_counter).
+        gauge = obs_catalog.gauge('skypilot_requests_total')
+        gauge.clear()
+        for status, count in sorted(counts.items()):
+            gauge.labels(status=status.lower()).set(count)
+
+    for name, fn in (('clusters', clusters), ('jobs', jobs),
+                     ('serve', serve),
+                     ('requests', requests_by_status)):
+        section(name, fn)
 
 
-async def api_metrics(request: web.Request) -> web.Response:
-    """Prometheus-format metrics (reference: sky/server/metrics.py —
-    per-request counters + process RSS gauges)."""
-    del request
-    import time as _time
+def _refresh_process_gauges() -> None:
     import psutil
-    lines = [
-        '# TYPE skypilot_requests_total counter',
-    ]
-    counts: Dict[str, int] = {}
-    for row in executor.list_requests(limit=10000):
-        counts[row['status']] = counts.get(row['status'], 0) + 1
-    for status, count in sorted(counts.items()):
-        lines.append(
-            f'skypilot_requests_total{{status="{status.lower()}"}} {count}')
-    # Orchestration gauges (reference: sky/server/metrics.py): pure
-    # aggregate queries (no handle unpickling), collected off the event
-    # loop; one broken table loses only its own section, loudly.
-    lines.extend(await asyncio.get_event_loop().run_in_executor(
-        None, _orchestration_gauge_lines))
     proc = psutil.Process()
-    rss = proc.memory_info().rss
-    lines.append('# TYPE skypilot_server_rss_bytes gauge')
-    lines.append(f'skypilot_server_rss_bytes {rss}')
+    obs_catalog.gauge('skypilot_server_rss_bytes').set(
+        proc.memory_info().rss)
     children_rss = 0
     for child in proc.children(recursive=True):
         try:
             children_rss += child.memory_info().rss
         except (psutil.NoSuchProcess, psutil.AccessDenied):
             pass  # worker exited between snapshot and read
-    lines.append('# TYPE skypilot_workers_rss_bytes gauge')
-    lines.append(f'skypilot_workers_rss_bytes {children_rss}')
+    obs_catalog.gauge('skypilot_workers_rss_bytes').set(children_rss)
     if _SERVER_START_TIME is not None:
-        lines.append('# TYPE skypilot_server_uptime_seconds gauge')
-        lines.append(
-            f'skypilot_server_uptime_seconds '
-            f'{_time.time() - _SERVER_START_TIME:.0f}')
-    return web.Response(text='\n'.join(lines) + '\n',
+        obs_catalog.gauge('skypilot_server_uptime_seconds').set(
+            round(time.time() - _SERVER_START_TIME))
+
+
+async def api_metrics(request: web.Request) -> web.Response:
+    """Prometheus text exposition of the process registry (reference:
+    sky/server/metrics.py): orchestration gauges + per-route request
+    counters/latency histograms (metrics_middleware) + process RSS."""
+    del request
+    await asyncio.get_event_loop().run_in_executor(
+        None, _refresh_orchestration_gauges)
+    _refresh_process_gauges()
+    return web.Response(text=REGISTRY.render(),
                         content_type='text/plain')
 
 
@@ -307,8 +317,41 @@ async def cluster_job_logs(request: web.Request) -> web.StreamResponse:
     return await stream_lines(request, lines)
 
 
+@web.middleware
+async def metrics_middleware(request: web.Request, handler):
+    """Per-route request count / latency / in-flight — outermost, so
+    auth rejections and 404s are counted too. The route label is the
+    matched route template (bounded cardinality), never the raw
+    path."""
+    in_flight = obs_catalog.gauge('skypilot_api_requests_in_flight')
+    start = time.perf_counter()
+    in_flight.inc()
+    code = 500  # an escaped non-HTTP exception is a server error
+    try:
+        response = await handler(request)
+        code = response.status
+        return response
+    except web.HTTPException as e:
+        code = e.status
+        raise
+    finally:
+        in_flight.dec()
+        try:
+            resource = request.match_info.route.resource
+        except Exception:  # pylint: disable=broad-except
+            resource = None
+        route = (resource.canonical if resource is not None
+                 else 'unmatched')
+        obs_catalog.counter('skypilot_api_requests_total').labels(
+            route=route, method=request.method, code=str(code)).inc()
+        obs_catalog.histogram('skypilot_api_request_seconds').labels(
+            route=route, method=request.method).observe(
+                time.perf_counter() - start)
+
+
 def create_app() -> web.Application:
-    app = web.Application(middlewares=[auth_middleware])
+    app = web.Application(middlewares=[metrics_middleware,
+                                       auth_middleware])
     for path, (name, entrypoint, schedule_type) in _ENDPOINTS.items():
         app.router.add_post(path, _mutating(name, entrypoint, schedule_type))
     app.router.add_get('/api/get', api_get)

@@ -73,6 +73,30 @@ def _load_jwks() -> Dict[str, Any]:
     return {'keys': []}
 
 
+_crypto_warned = False
+
+
+def _require_cryptography() -> bool:
+    """RS256 needs the `cryptography` package; it is an OPTIONAL
+    dependency (HS256 and service tokens are pure stdlib). Missing →
+    verification fails closed with ONE loud, actionable log line
+    instead of an ImportError mid-request."""
+    global _crypto_warned
+    try:
+        import cryptography  # noqa: F401  pylint: disable=unused-import
+        return True
+    except ImportError:
+        if not _crypto_warned:
+            _crypto_warned = True
+            import logging
+            logging.getLogger(__name__).error(
+                'RS256 JWT presented but the "cryptography" package '
+                'is not installed — rejecting. Install it (pip '
+                'install cryptography) or configure HS256 '
+                '(oauth.hs256_secret).')
+        return False
+
+
 def _rsa_keys_for(kid: Optional[str]):
     """Candidate public keys: the kid match first, else every RSA key
     (key rotation: a JWKS holds old+new; tokens without a kid must be
@@ -94,6 +118,8 @@ def _rsa_keys_for(kid: Optional[str]):
 def _verify_signature(signing_input: bytes, signature: bytes,
                       alg: str, kid: Optional[str]) -> bool:
     if alg == 'RS256':
+        if not _require_cryptography():
+            return False
         from cryptography.exceptions import InvalidSignature
         from cryptography.hazmat.primitives import hashes
         from cryptography.hazmat.primitives.asymmetric import padding

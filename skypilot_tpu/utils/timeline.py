@@ -30,6 +30,17 @@ def enabled() -> bool:
     return _enabled_path is not None
 
 
+def enable(path: str) -> None:
+    """Programmatic enable (e.g. `train_lm --trace-file`): same effect
+    as exporting SKYPILOT_TIMELINE_FILE_PATH before launch — events
+    collect from now on and flush to `path` at exit (or on save())."""
+    global _enabled_path
+    already = _enabled_path is not None
+    _enabled_path = path
+    if not already:
+        atexit.register(save)
+
+
 class Event:
     """Context manager emitting a complete ('X') trace event."""
 

@@ -1,0 +1,72 @@
+"""CI checker for the metric catalog: every exported Prometheus
+metric name is snake_case, `skypilot_`-prefixed, and listed in the
+docs metric-catalog table — and the docs list nothing stale. Keeps
+`observability/catalog.py` and `docs/guides.md` from drifting."""
+import os
+import re
+
+from skypilot_tpu.observability import catalog
+from skypilot_tpu.observability import metrics as m
+
+_DOCS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     '..', '..', 'docs', 'guides.md')
+
+_SNAKE = re.compile(r'^[a-z][a-z0-9_]*$')
+
+
+def _docs_table_names():
+    """Metric names from the docs catalog table (backticked first
+    column of `| \\`skypilot_...\\` | ... |` rows)."""
+    with open(_DOCS, 'r', encoding='utf-8') as f:
+        text = f.read()
+    return set(re.findall(r'^\|\s*`(skypilot_[a-z0-9_]+)`\s*\|',
+                          text, re.MULTILINE))
+
+
+def test_metric_names_are_snake_case_and_prefixed():
+    for name in catalog.SPECS:
+        assert _SNAKE.match(name), f'{name} is not snake_case'
+        assert name.startswith('skypilot_'), \
+            f'{name} lacks the skypilot_ prefix'
+
+
+def test_counter_names_end_in_total():
+    """Prometheus convention: counters (and counter-exposed totals)
+    end in _total; non-counters must not."""
+    for name, spec in catalog.SPECS.items():
+        if spec[0] in ('counter', 'gauge_as_counter'):
+            assert name.endswith('_total'), name
+        else:
+            assert not name.endswith('_total'), name
+
+
+def test_every_metric_is_documented():
+    documented = _docs_table_names()
+    exported = set(catalog.SPECS)
+    missing = exported - documented
+    assert not missing, (
+        f'metrics missing from the docs/guides.md catalog table: '
+        f'{sorted(missing)}')
+    stale = documented - exported
+    assert not stale, (
+        f'docs/guides.md lists metrics no longer in '
+        f'observability/catalog.py: {sorted(stale)}')
+
+
+def test_label_names_are_snake_case():
+    for name, spec in catalog.SPECS.items():
+        for label in spec[2]:
+            assert _SNAKE.match(label), f'{name} label {label!r}'
+
+
+def test_registry_contains_only_cataloged_skypilot_metrics():
+    """Ad-hoc families must not sneak into the default registry under
+    the skypilot_ prefix without a catalog row (test-local registries
+    are exempt — they are not scraped)."""
+    for name in catalog.SPECS:
+        catalog._create(name)  # materialize the full catalog
+    for name in m.REGISTRY.names():
+        if name.startswith('skypilot_'):
+            assert name in catalog.SPECS, (
+                f'{name} is registered in the default registry but '
+                f'not cataloged in observability/catalog.py')

@@ -76,7 +76,28 @@ def test_tampered_payload_rejected(oauth_config):
     assert oidc.verify_jwt(f'{header}.{payload2}.{sig}') is None
 
 
+def test_rs256_without_cryptography_fails_closed(isolated_state,
+                                                 monkeypatch):
+    """No `cryptography` installed → an RS256 bearer is REJECTED
+    (None), never an ImportError escaping into the request path."""
+    monkeypatch.setattr(oidc, '_require_cryptography', lambda: False)
+    header = base64.urlsafe_b64encode(json.dumps(
+        {'alg': 'RS256', 'kid': 'k1'}).encode()).decode().rstrip('=')
+    payload = base64.urlsafe_b64encode(json.dumps(
+        _claims()).encode()).decode().rstrip('=')
+    sig = base64.urlsafe_b64encode(b'not-a-signature')\
+        .decode().rstrip('=')
+    with sky_config.override({'oauth': {'issuer': 'https://idp.test',
+                                        'client_id': 'stpu-cli',
+                                        'jwks': {'keys': []}}}):
+        assert oidc.verify_jwt(f'{header}.{payload}.{sig}') is None
+
+
 def test_rs256_roundtrip(isolated_state):
+    # `cryptography` is an OPTIONAL dependency (users/oidc.py fails
+    # RS256 closed without it); environments without it skip rather
+    # than fail.
+    pytest.importorskip('cryptography')
     from cryptography.hazmat.primitives.asymmetric import padding, rsa
     from cryptography.hazmat.primitives import hashes
     key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
@@ -282,6 +303,7 @@ def test_refresh_failure_backoff(isolated_state, monkeypatch):
 def test_rs256_key_rotation_no_kid(isolated_state):
     """Token signed with the NEWER key, no kid header, JWKS holding
     [old, new] — must verify against every candidate key."""
+    pytest.importorskip('cryptography')
     from cryptography.hazmat.primitives.asymmetric import padding, rsa
     from cryptography.hazmat.primitives import hashes
 

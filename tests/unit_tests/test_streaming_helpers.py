@@ -94,7 +94,7 @@ def test_usage_counts_prompt_once_for_n():
             return 'x' * len(ids)
 
     class _Metrics:
-        def record(self, *args):
+        def record(self, *args, **kwargs):
             pass
 
     class _RT:
