@@ -1,0 +1,31 @@
+"""`stpu check`: project-specific AST static analysis.
+
+Rules:
+  SKY001  blocking call inside `async def` (event-loop stall)
+  SKY002  jit-purity / retrace hazards in jitted functions
+  SKY003  lock discipline: unlocked mutation of shared instance state
+  SKY004  metric-name hygiene: names must come from the catalog
+  SKY005  swallowed exceptions in control planes
+
+See docs/internals.md §10 for the rule book and suppression story.
+"""
+from skypilot_tpu.analysis.core import (
+    Baseline,
+    Checker,
+    DEFAULT_BASELINE,
+    Finding,
+    all_checkers,
+    register,
+    render_json,
+    render_text,
+    resolve_select,
+    run_file,
+    run_paths,
+    run_source,
+)
+
+__all__ = [
+    'Baseline', 'Checker', 'DEFAULT_BASELINE', 'Finding', 'all_checkers',
+    'register', 'render_json', 'render_text', 'resolve_select',
+    'run_file', 'run_paths', 'run_source',
+]

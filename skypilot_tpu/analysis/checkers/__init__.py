@@ -1,0 +1,6 @@
+"""Built-in checkers. Importing this package registers every rule."""
+from skypilot_tpu.analysis.checkers import async_blocking  # noqa: F401
+from skypilot_tpu.analysis.checkers import exception_hygiene  # noqa: F401
+from skypilot_tpu.analysis.checkers import jit_purity  # noqa: F401
+from skypilot_tpu.analysis.checkers import lock_discipline  # noqa: F401
+from skypilot_tpu.analysis.checkers import metric_names  # noqa: F401

@@ -1,0 +1,120 @@
+"""SKY001: blocking calls inside `async def` bodies.
+
+One synchronous `open()`/`requests.get()`/`time.sleep()` in a handler
+stalls EVERY in-flight request on the event loop — the failure mode
+only shows up under load, which is exactly when it hurts. The fix is
+`await asyncio.to_thread(...)` / `loop.run_in_executor(...)` (or an
+async-native client).
+
+Calls inside a nested synchronous `def` are not flagged: that function
+runs wherever it is invoked — typically handed to an executor.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from skypilot_tpu.analysis import core
+
+# Exact dotted names that block.
+_BLOCKING_EXACT = {
+    'open',
+    'input',
+    'time.sleep',
+    'sqlite3.connect',
+    'socket.create_connection',
+    'socket.getaddrinfo',
+    'urllib.request.urlopen',
+    'os.system',
+    'os.wait',
+    'os.waitpid',
+}
+# Any attribute of these modules blocks (requests.get/post/...,
+# subprocess.run/check_output/Popen/...).
+_BLOCKING_MODULES = {'subprocess', 'requests'}
+# shutil is mixed: get_terminal_size/which are ioctl/stat-cheap, the
+# tree operations genuinely block — list those explicitly.
+_BLOCKING_EXACT.update({
+    'shutil.copy', 'shutil.copy2', 'shutil.copyfile', 'shutil.copytree',
+    'shutil.rmtree', 'shutil.move', 'shutil.make_archive',
+    'shutil.unpack_archive',
+})
+# Method names that block regardless of receiver (pathlib file IO,
+# DB cursors, socket receive).
+_BLOCKING_METHODS = {
+    'read_text', 'write_text', 'read_bytes', 'write_bytes',
+    'executemany', 'executescript', 'fetchall', 'fetchone',
+}
+# Receiver-qualified: `.execute`/`.commit` block on sqlite/DB
+# connections but are too generic alone (aiosqlite, executors, ...);
+# only flag them on receivers whose name says "db"/"conn"/"cursor".
+_DB_METHODS = {'execute', 'commit'}
+_DB_RECEIVER_HINTS = ('db', 'conn', 'cursor', 'sqlite')
+
+
+@core.register
+class AsyncBlockingChecker(core.Checker):
+    rule = 'SKY001'
+    name = 'blocking-call-in-async'
+    description = ('Blocking call inside an async def; wrap in '
+                   'asyncio.to_thread()/run_in_executor().')
+
+    def __init__(self, ctx: core.FileContext) -> None:
+        super().__init__(ctx)
+        # Stack of (function node, is_async); the INNERMOST frame
+        # decides whether a call runs on the event loop.
+        self._func_stack: List[ast.AST] = []
+
+    # -- scope tracking -----------------------------------------------------
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._func_stack.append(node)
+        self.generic_visit(node)
+        self._func_stack.pop()
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._func_stack.append(node)
+        self.generic_visit(node)
+        self._func_stack.pop()
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        # A lambda body runs when called — usually from an executor.
+        self._func_stack.append(node)
+        self.generic_visit(node)
+        self._func_stack.pop()
+
+    def _in_async_frame(self) -> Optional[ast.AsyncFunctionDef]:
+        if self._func_stack and isinstance(self._func_stack[-1],
+                                           ast.AsyncFunctionDef):
+            return self._func_stack[-1]
+        return None
+
+    # -- the check ----------------------------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        frame = self._in_async_frame()
+        if frame is not None:
+            blocked = self._blocking_reason(node)
+            if blocked:
+                self.add(node,
+                         f'blocking call {blocked}() inside '
+                         f'async def {frame.name}; use '
+                         f'asyncio.to_thread()/run_in_executor()')
+        self.generic_visit(node)
+
+    def _blocking_reason(self, node: ast.Call) -> Optional[str]:
+        name = core.dotted_name(node.func)
+        if name is not None:
+            if name in _BLOCKING_EXACT:
+                return name
+            parts = name.split('.')
+            if parts[0] in _BLOCKING_MODULES and len(parts) > 1:
+                return name
+        if isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+            if attr in _BLOCKING_METHODS:
+                return f'.{attr}'
+            if attr in _DB_METHODS:
+                recv = core.dotted_name(node.func.value) or ''
+                low = recv.lower()
+                if any(h in low for h in _DB_RECEIVER_HINTS):
+                    return f'{recv}.{attr}'
+        return None

@@ -1,0 +1,87 @@
+"""SKY005: swallowed exceptions in the control planes.
+
+Scoped to `server/`, `jobs/`, `serve/`, `inference/` — the layers
+where a silently dropped error turns into a cluster stuck in a
+phantom state with nothing in any log. A broad handler
+(`except Exception` / bare `except`) must do at least one of:
+
+  - re-raise (bare `raise` or `raise X`),
+  - log (any `logger.*`/`logging.*`/`*.exception()` call,
+    `traceback.print_exc/format_exc`, `ux_utils.log`, click stderr),
+  - or USE the bound exception (`except ... as e` where `e` is
+    referenced) — surfacing the error in a response/result counts as
+    handling it.
+
+`except Exception: pass` in a control plane is always a finding:
+best-effort cleanup that is genuinely fine gets an inline
+`# stpu: ignore[SKY005]` with the reviewer's eyes on it.
+"""
+from __future__ import annotations
+
+import ast
+
+
+from skypilot_tpu.analysis import core
+
+_SCOPES = ('server/', 'jobs/', 'serve/', 'inference/')
+
+_BROAD = {'Exception', 'BaseException'}
+_LOG_ROOTS = {'logger', 'logging', 'log', 'ux_utils', 'traceback'}
+_LOG_METHODS = {'debug', 'info', 'warning', 'warn', 'error',
+                'exception', 'critical', 'log', 'print_exc',
+                'format_exc', 'secho', 'echo'}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:
+        return True
+    types = (handler.type.elts if isinstance(handler.type, ast.Tuple)
+             else [handler.type])
+    for t in types:
+        name = core.dotted_name(t)
+        if name is not None and name.split('.')[-1] in _BROAD:
+            return True
+    return False
+
+
+def _handles(handler: ast.ExceptHandler) -> bool:
+    """True when the handler re-raises, logs, or uses the exception."""
+    bound = handler.name
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if bound and isinstance(node, ast.Name) and node.id == bound \
+                and isinstance(node.ctx, ast.Load):
+            return True
+        if isinstance(node, ast.Call):
+            name = core.dotted_name(node.func)
+            if name is None:
+                continue
+            parts = name.split('.')
+            if parts[0] in _LOG_ROOTS or \
+                    parts[0].endswith(('logger', '_log')):
+                return True
+            if len(parts) > 1 and parts[-1] in _LOG_METHODS:
+                return True
+    return False
+
+
+@core.register
+class ExceptionHygieneChecker(core.Checker):
+    rule = 'SKY005'
+    name = 'swallowed-exception'
+    description = ('Broad except in a control plane must log, '
+                   're-raise, or use the exception.')
+
+    @classmethod
+    def applies_to(cls, path: str) -> bool:
+        return any(scope in path for scope in _SCOPES)
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if _is_broad(node) and not _handles(node):
+            what = ('bare except' if node.type is None
+                    else 'except Exception')
+            self.add(node,
+                     f'{what} swallows the error: log it, re-raise, '
+                     f'or use the bound exception')
+        self.generic_visit(node)

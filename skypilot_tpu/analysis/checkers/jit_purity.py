@@ -1,0 +1,189 @@
+"""SKY002: jit-purity / retrace hazards inside jitted functions.
+
+Python side effects inside `jax.jit`/`pjit`/`shard_map`-wrapped
+functions either crash at trace time (`.item()`, `float()` on a
+tracer), silently run once per TRACE instead of once per CALL
+(`print`, global/attribute mutation), or force retraces that cap
+throughput (the concurrency ceiling: one retrace stalls every queued
+dispatch). The rule book:
+
+  - `.item()` / `float(arg)` / `int(arg)` / `bool(arg)` / `np.*(arg)`
+    on a traced argument: concretization — host sync or TracerError.
+  - `print(...)`: runs at trace time only; use `jax.debug.print`.
+  - `global` statements and writes to `self.*`/module attributes:
+    side effects invisible to the tracer (stale after the first call).
+  - `static_argnums`/`static_argnames` given a set/dict literal:
+    static args must be hashable, and the spec is an int/str sequence.
+
+A function counts as jitted when decorated with jit/pjit/shard_map
+(directly or through functools.partial), or when the module wraps it
+by name: `step = jax.jit(step_fn, ...)`.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from skypilot_tpu.analysis import core
+
+_JIT_NAMES = {'jit', 'pjit', 'shard_map'}
+_CONCRETIZERS = {'float', 'int', 'bool'}
+_NUMPY_ROOTS = {'np', 'numpy', 'onp'}
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """`jax.jit` / `pjit` / `shard_map` / partial(jax.jit, ...) /
+    jax.jit(...)-with-options, as a decorator or wrapper callee."""
+    name = core.dotted_name(node)
+    if name is not None:
+        return name.split('.')[-1] in _JIT_NAMES
+    if isinstance(node, ast.Call):
+        callee = core.dotted_name(node.func)
+        if callee is not None and callee.split('.')[-1] == 'partial':
+            return bool(node.args) and _is_jit_expr(node.args[0])
+        # jax.jit(static_argnums=...) used as a decorator factory.
+        return _is_jit_expr(node.func)
+    return False
+
+
+class _BodyScan(ast.NodeVisitor):
+    """Scans one jitted function body, nested closures included
+    (inner defs trace together with the parent frame)."""
+
+    def __init__(self, checker: 'JitPurityChecker',
+                 fn: ast.AST, params: Set[str]) -> None:
+        self.checker = checker
+        self.fn = fn
+        self.params = set(params)
+        self.locals: Set[str] = set(params)
+        self._depth = 0
+
+    # Nested function defs: their bodies trace too (closures inside a
+    # jitted step), so keep visiting — but track locals per frame is
+    # overkill; tolerate the small chance of FP there.
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for target in node.targets:
+            self._check_store(target, node)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._check_store(node.target, node)
+        self.generic_visit(node)
+
+    def _check_store(self, target: ast.AST, node: ast.AST) -> None:
+        if isinstance(target, ast.Name):
+            self.locals.add(target.id)
+            return
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._check_store(elt, node)
+            return
+        if isinstance(target, ast.Attribute):
+            root = target
+            while isinstance(root, (ast.Attribute, ast.Subscript)):
+                root = root.value
+            if isinstance(root, ast.Name):
+                if root.id == 'self' or root.id not in self.locals:
+                    self.checker.add(
+                        node,
+                        f'attribute mutation '
+                        f'{core.dotted_name(target) or root.id + ".*"}'
+                        f' inside jitted function '
+                        f'{getattr(self.fn, "name", "<lambda>")}: side '
+                        f'effect runs at trace time only')
+
+    def visit_Global(self, node: ast.Global) -> None:
+        self.checker.add(
+            node, f'global statement inside jitted function '
+                  f'{getattr(self.fn, "name", "<lambda>")}: mutation '
+                  f'is a trace-time side effect')
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = core.dotted_name(node.func)
+        if name == 'print':
+            self.checker.add(
+                node, 'print() inside a jitted function runs at trace '
+                      'time only; use jax.debug.print()')
+        elif (isinstance(node.func, ast.Attribute) and
+              node.func.attr == 'item'):
+            self.checker.add(
+                node, '.item() inside a jitted function concretizes a '
+                      'traced value (TracerError / host sync)')
+        elif (name in _CONCRETIZERS and len(node.args) == 1 and
+              isinstance(node.args[0], ast.Name) and
+              node.args[0].id in self.params):
+            self.checker.add(
+                node, f'{name}() on traced argument '
+                      f'{node.args[0].id!r} concretizes it; hoist out '
+                      f'of the jitted function or mark it static')
+        elif name is not None and name.split('.')[0] in _NUMPY_ROOTS:
+            if any(isinstance(a, ast.Name) and a.id in self.params
+                   for a in node.args):
+                self.checker.add(
+                    node, f'{name}() on a traced argument runs on host '
+                          f'at trace time; use jnp instead')
+        self.generic_visit(node)
+
+
+@core.register
+class JitPurityChecker(core.Checker):
+    rule = 'SKY002'
+    name = 'jit-purity'
+    description = ('Side effects / concretization / retrace hazards '
+                   'inside jax.jit|pjit|shard_map functions.')
+
+    def check(self, tree: ast.Module) -> List[core.Finding]:
+        defs: Dict[str, ast.AST] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs[node.name] = node
+        scanned: Set[int] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if any(_is_jit_expr(d) for d in node.decorator_list):
+                    self._scan(node, scanned)
+            elif isinstance(node, ast.Call) and _is_jit_expr(node):
+                # Covers decorator calls too: ast.walk reaches every
+                # Call node, including those in decorator_list.
+                self._check_static_argnums(node)
+                # Wrapper form: step = jax.jit(step_fn, ...)
+                if node.args:
+                    target = node.args[0]
+                    fn = None
+                    if isinstance(target, ast.Name):
+                        fn = defs.get(target.id)
+                    elif isinstance(target, ast.Lambda):
+                        fn = target
+                    if fn is not None:
+                        self._scan(fn, scanned)
+        return self.findings
+
+    def _scan(self, fn: ast.AST, scanned: Set[int]) -> None:
+        if id(fn) in scanned:
+            return
+        scanned.add(id(fn))
+        params: Set[str] = set()
+        args = fn.args
+        for a in (list(args.posonlyargs) + list(args.args) +
+                  list(args.kwonlyargs)):
+            params.add(a.arg)
+        if args.vararg:
+            params.add(args.vararg.arg)
+        if args.kwarg:
+            params.add(args.kwarg.arg)
+        params.discard('self')
+        scan = _BodyScan(self, fn, params)
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in body:
+            scan.visit(stmt)
+
+    def _check_static_argnums(self, node: ast.AST) -> None:
+        if not isinstance(node, ast.Call) or not _is_jit_expr(node):
+            return
+        for kw in node.keywords:
+            if kw.arg in ('static_argnums', 'static_argnames'):
+                if isinstance(kw.value, (ast.Set, ast.Dict)):
+                    self.add(kw.value,
+                             f'{kw.arg} takes an int/str sequence; a '
+                             f'{type(kw.value).__name__.lower()} '
+                             f'literal is unhashable/unordered')

@@ -1,0 +1,158 @@
+"""SKY003: lock discipline on classes that declare a lock.
+
+A class that creates a `threading.Lock`/`RLock`/`Condition` (or
+`asyncio.Lock`) has announced that its instance state is shared.
+Every method that MUTATES state assigned in `__init__` must then hold
+one of the class's locks — a method that writes `self.x` or calls
+`self.queue.append(...)` without `with self._lock:` is a data race
+waiting for load (the serving engine's batching plane and the agent's
+exec table are exactly where these bite).
+
+Conventions honored to keep noise down:
+  - `__init__`/`__new__`/`__del__` and `_locked`-suffixed methods are
+    exempt (construction happens-before sharing; `*_locked` documents
+    "caller holds the lock").
+  - a method that acquires ANY declared lock anywhere in its body is
+    considered disciplined (granularity is method-level on purpose —
+    the goal is catching methods nobody ever thought about locking).
+  - only attributes assigned in `__init__` count as shared state.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from skypilot_tpu.analysis import core
+
+_LOCK_TYPES = {'Lock', 'RLock', 'Condition', 'Semaphore',
+               'BoundedSemaphore'}
+_MUTATORS = {'append', 'appendleft', 'extend', 'extendleft', 'insert',
+             'pop', 'popleft', 'popitem', 'remove', 'discard', 'clear',
+             'add', 'update', 'setdefault', 'sort', 'reverse'}
+_EXEMPT_METHODS = {'__init__', '__new__', '__del__', '__post_init__'}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """'x' for a bare `self.x` expression, else None."""
+    if (isinstance(node, ast.Attribute) and
+            isinstance(node.value, ast.Name) and
+            node.value.id == 'self'):
+        return node.attr
+    return None
+
+
+class _ClassScan:
+
+    def __init__(self, checker: 'LockDisciplineChecker',
+                 node: ast.ClassDef) -> None:
+        self.checker = checker
+        self.node = node
+        self.locks: Set[str] = set()
+        self.shared: Set[str] = set()
+
+    def run(self) -> None:
+        methods = [n for n in self.node.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]
+        for m in methods:
+            self._collect_attrs(m)
+        if not self.locks:
+            return
+        self.shared -= self.locks
+        for m in methods:
+            if (m.name in _EXEMPT_METHODS or
+                    m.name.endswith('_locked')):
+                continue
+            if self._acquires_lock(m):
+                continue
+            self._flag_mutations(m)
+
+    def _collect_attrs(self, method: ast.AST) -> None:
+        init = method.name == '__init__'
+        for node in ast.walk(method):
+            if isinstance(node, ast.Assign):
+                for target in node.targets:
+                    attr = _self_attr(target)
+                    if attr is None:
+                        continue
+                    name = core.dotted_name(node.value.func) \
+                        if isinstance(node.value, ast.Call) else None
+                    if (name is not None and
+                            name.split('.')[-1] in _LOCK_TYPES):
+                        self.locks.add(attr)
+                    elif init:
+                        self.shared.add(attr)
+
+    def _acquires_lock(self, method: ast.AST) -> bool:
+        for node in ast.walk(method):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    expr = item.context_expr
+                    # with self._lock:  |  with self._cv:
+                    if _self_attr(expr) in self.locks:
+                        return True
+                    # with self._lock.something(...) (Condition waits)
+                    if (isinstance(expr, ast.Call) and
+                            isinstance(expr.func, ast.Attribute) and
+                            _self_attr(expr.func.value) in self.locks):
+                        return True
+            if isinstance(node, ast.Call):
+                # self._lock.acquire()
+                if (isinstance(node.func, ast.Attribute) and
+                        node.func.attr in ('acquire', 'wait',
+                                           'notify', 'notify_all') and
+                        _self_attr(node.func.value) in self.locks):
+                    return True
+        return False
+
+    def _flag_mutations(self, method: ast.AST) -> None:
+        lock_names = ', '.join(f'self.{l}' for l in sorted(self.locks))
+        for node in ast.walk(method):
+            target_attr = None
+            if isinstance(node, ast.Assign):
+                for target in node.targets:
+                    target_attr = target_attr or self._store_attr(target)
+            elif isinstance(node, ast.AugAssign):
+                target_attr = self._store_attr(node.target)
+            elif isinstance(node, ast.Call):
+                # self.queue.append(...) and friends
+                if (isinstance(node.func, ast.Attribute) and
+                        node.func.attr in _MUTATORS):
+                    target_attr = _self_attr(node.func.value)
+                    if target_attr not in self.shared:
+                        target_attr = None
+            if target_attr is not None:
+                self.checker.add(
+                    node,
+                    f'{self.node.name}.{method.name} mutates shared '
+                    f'attribute self.{target_attr} without holding '
+                    f'{lock_names}')
+
+    def _store_attr(self, target: ast.AST) -> Optional[str]:
+        """Shared attr written by an assignment target (also catches
+        `self.x[k] = v` subscript stores)."""
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                attr = self._store_attr(elt)
+                if attr is not None:
+                    return attr
+            return None
+        if isinstance(target, ast.Subscript):
+            target = target.value
+        attr = _self_attr(target)
+        if attr is not None and attr in self.shared:
+            return attr
+        return None
+
+
+@core.register
+class LockDisciplineChecker(core.Checker):
+    rule = 'SKY003'
+    name = 'lock-discipline'
+    description = ('Classes declaring a Lock must hold it in methods '
+                   'that mutate shared instance state.')
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        _ClassScan(self, node).run()
+        # Nested classes still get their own scan.
+        self.generic_visit(node)

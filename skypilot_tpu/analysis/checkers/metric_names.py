@@ -1,0 +1,162 @@
+"""SKY004: metric-name hygiene, at the AST level.
+
+Every Prometheus metric this codebase exports is declared once in
+`observability/catalog.py` (SPECS). PR 2 enforced that with a
+string-level CI checker; this rule promotes it to the AST so that
+DYNAMICALLY BUILT names — f-strings, concatenation, variables passed
+to `counter()`/`gauge()`/`histogram()`/`get_or_create()` — are caught
+too, not just misspelled literals.
+
+Import tracking keeps it precise: bare `counter(...)` is only policed
+when the file imported it from the catalog, `m.Counter(...)` only when
+`m` is the observability.metrics module, and `.get_or_create(...)`
+only on receivers that look like a registry. `collections.Counter`
+never trips it.
+
+Catalog keys are read by PARSING catalog.py (no import): the linter
+stays runnable on a tree that does not import.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Optional, Set
+
+from skypilot_tpu.analysis import core
+
+# The declaration points themselves build names from variables.
+_EXEMPT_FILES = ('observability/catalog.py', 'observability/metrics.py')
+
+_CATALOG_MOD = 'skypilot_tpu.observability.catalog'
+_METRICS_MOD = 'skypilot_tpu.observability.metrics'
+_FACTORIES = {'counter', 'gauge', 'histogram'}
+_CLASSES = {'Counter', 'Gauge', 'Histogram'}
+
+_catalog_cache: Optional[Set[str]] = None
+
+
+def catalog_names(catalog_path: Optional[str] = None) -> Set[str]:
+    """SPECS keys parsed from observability/catalog.py's AST."""
+    global _catalog_cache
+    if catalog_path is None and _catalog_cache is not None:
+        return _catalog_cache
+    path = catalog_path or os.path.join(core._PKG_DIR, 'observability',
+                                        'catalog.py')
+    names: Set[str] = set()
+    try:
+        with open(path, 'r', encoding='utf-8') as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return names
+    for node in ast.walk(tree):
+        target = None
+        if isinstance(node, ast.AnnAssign):
+            target, value = node.target, node.value
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target, value = node.targets[0], node.value
+        else:
+            continue
+        if (isinstance(target, ast.Name) and target.id == 'SPECS' and
+                isinstance(value, ast.Dict)):
+            for key in value.keys:
+                if isinstance(key, ast.Constant) and \
+                        isinstance(key.value, str):
+                    names.add(key.value)
+    if catalog_path is None:
+        _catalog_cache = names
+    return names
+
+
+@core.register
+class MetricNameChecker(core.Checker):
+    rule = 'SKY004'
+    name = 'metric-name-hygiene'
+    description = ('Metric names must be literals declared in '
+                   'observability/catalog.py (no dynamic names).')
+
+    def __init__(self, ctx: core.FileContext) -> None:
+        super().__init__(ctx)
+        # local alias -> ('factory'|'class'|'catalog'|'metrics')
+        self._aliases: Dict[str, str] = {}
+
+    @classmethod
+    def applies_to(cls, path: str) -> bool:
+        return not path.endswith(_EXEMPT_FILES)
+
+    # -- import tracking ----------------------------------------------------
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            local = alias.asname or alias.name.split('.')[0]
+            if alias.name == _CATALOG_MOD and alias.asname:
+                self._aliases[local] = 'catalog'
+            elif alias.name == _METRICS_MOD and alias.asname:
+                self._aliases[local] = 'metrics'
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        mod = node.module or ''
+        for alias in node.names:
+            local = alias.asname or alias.name
+            if mod == _CATALOG_MOD and alias.name in _FACTORIES:
+                self._aliases[local] = 'factory'
+            elif mod == _METRICS_MOD and alias.name in _CLASSES:
+                self._aliases[local] = 'class'
+            elif mod.endswith('observability') and \
+                    alias.name == 'catalog':
+                self._aliases[local] = 'catalog'
+            elif mod.endswith('observability') and \
+                    alias.name == 'metrics':
+                self._aliases[local] = 'metrics'
+
+    # -- the check ----------------------------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        spec = self._name_arg_spec(node)
+        if spec is not None:
+            func_label, arg_idx = spec
+            self._check_name_arg(node, func_label, arg_idx)
+        self.generic_visit(node)
+
+    def _name_arg_spec(self, node: ast.Call):
+        """-> (label, name-arg index) when this call takes a metric
+        name we should police, else None."""
+        func = node.func
+        if isinstance(func, ast.Name):
+            kind = self._aliases.get(func.id)
+            if kind == 'factory' and func.id in _FACTORIES:
+                return func.id, 0
+            if kind == 'class' and func.id in _CLASSES:
+                return func.id, 0
+            return None
+        if isinstance(func, ast.Attribute):
+            recv = core.dotted_name(func.value)
+            if recv is not None:
+                kind = self._aliases.get(recv.split('.')[0])
+                if kind == 'catalog' and func.attr in _FACTORIES:
+                    return f'{recv}.{func.attr}', 0
+                if kind == 'metrics' and func.attr in _CLASSES:
+                    return f'{recv}.{func.attr}', 0
+            if func.attr == 'get_or_create' and recv is not None and \
+                    'registr' in recv.lower():
+                return f'{recv}.get_or_create', 1
+        return None
+
+    def _check_name_arg(self, node: ast.Call, func: str,
+                        arg_idx: int) -> None:
+        arg: Optional[ast.AST] = None
+        if len(node.args) > arg_idx:
+            arg = node.args[arg_idx]
+        else:
+            for kw in node.keywords:
+                if kw.arg == 'name':
+                    arg = kw.value
+        if arg is None:
+            return
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            if arg.value not in catalog_names():
+                self.add(node,
+                         f'metric name {arg.value!r} is not declared '
+                         f'in observability/catalog.py SPECS')
+            return
+        self.add(node,
+                 f'{func}() called with a dynamically built metric '
+                 f'name; declare a literal from '
+                 f'observability/catalog.py instead')

@@ -1,0 +1,311 @@
+"""The `stpu check` lint framework: AST visitors, zero dependencies.
+
+This is project-specific static analysis — the rules encode contracts
+unit tests catch late or never (blocking calls stalling the asyncio
+event loop, retrace hazards in jitted step functions, unlocked shared
+state on the serving/scheduling hot paths, metric names drifting from
+the catalog, control-plane exceptions vanishing without a log line).
+
+Pieces:
+
+  Finding            one (rule, path, line, col, message) diagnostic
+  Checker            ast.NodeVisitor base; subclasses register with
+                     @register and carry `rule` (SKYxxx) + description
+  run_file/run_paths per-file runner: parse once, run every selected
+                     checker, drop `# stpu: ignore[SKYxxx]` lines
+  Baseline           committed grandfather list (analysis/baseline.json)
+                     keyed (path, rule, line), each entry justified
+  render_text/json   reporters for the CLI and the CI gate
+
+Suppression: append `# stpu: ignore[SKY001]` (or a bare
+`# stpu: ignore` for every rule) to the flagged line.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple, Type
+
+# Repo root = the directory holding the `skypilot_tpu` package; paths
+# in findings and the baseline are stored relative to it so runs from
+# any cwd agree.
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REPO_ROOT = os.path.dirname(_PKG_DIR)
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                'baseline.json')
+
+_SUPPRESS_RE = re.compile(
+    r'#\s*stpu:\s*ignore(?:\[\s*([A-Za-z0-9_,\s]+?)\s*\])?')
+
+_SKIP_DIRS = {'__pycache__', 'dashboard_static', 'node_modules',
+              '.git', '.eggs'}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def key(self) -> Tuple[str, str, int]:
+        return (self.path, self.rule, self.line)
+
+    def to_dict(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        return f'{self.path}:{self.line}:{self.col}: {self.rule} ' \
+               f'{self.message}'
+
+
+class FileContext:
+    """Everything a checker may need about the file under analysis."""
+
+    def __init__(self, path: str, source: str) -> None:
+        self.abs_path = os.path.abspath(path)
+        self.path = display_path(path)
+        self.source = source
+        self.lines = source.splitlines()
+
+
+class Checker(ast.NodeVisitor):
+    """Base class: subclass, set `rule`/`name`/`description`, override
+    visit_* methods, call `self.add(node, message)` per diagnostic."""
+
+    rule: str = 'SKY000'
+    name: str = 'base'
+    description: str = ''
+
+    def __init__(self, ctx: FileContext) -> None:
+        self.ctx = ctx
+        self.findings: List[Finding] = []
+
+    @classmethod
+    def applies_to(cls, path: str) -> bool:
+        """Override to scope a rule to a subtree (posix relpath in)."""
+        del path
+        return True
+
+    def add(self, node: ast.AST, message: str) -> None:
+        self.findings.append(Finding(
+            self.rule, self.ctx.path, getattr(node, 'lineno', 1),
+            getattr(node, 'col_offset', 0), message))
+
+    def check(self, tree: ast.Module) -> List[Finding]:
+        self.visit(tree)
+        return self.findings
+
+
+_CHECKERS: Dict[str, Type[Checker]] = {}
+
+
+def register(cls: Type[Checker]) -> Type[Checker]:
+    if cls.rule in _CHECKERS:
+        raise ValueError(f'duplicate checker rule {cls.rule}')
+    _CHECKERS[cls.rule] = cls
+    return cls
+
+
+def all_checkers() -> Dict[str, Type[Checker]]:
+    _load_builtin_checkers()
+    return dict(_CHECKERS)
+
+
+def _load_builtin_checkers() -> None:
+    # Import side effect registers each @register'd class exactly once.
+    from skypilot_tpu.analysis import checkers  # noqa: F401  pylint: disable=unused-import,cyclic-import
+
+
+def resolve_select(select: Optional[str]) -> Set[str]:
+    """`--select SKY001,SKY003` -> validated rule set (all if None)."""
+    checkers = all_checkers()
+    if not select:
+        return set(checkers)
+    rules = {r.strip().upper() for r in select.split(',') if r.strip()}
+    unknown = rules - set(checkers)
+    if unknown:
+        raise ValueError(
+            f'unknown rule(s) {sorted(unknown)}; available: '
+            f'{sorted(checkers)}')
+    return rules
+
+
+def display_path(path: str) -> str:
+    """Repo-relative posix path when under the repo, else as given."""
+    abs_path = os.path.abspath(path)
+    if abs_path.startswith(REPO_ROOT + os.sep):
+        return os.path.relpath(abs_path, REPO_ROOT).replace(os.sep, '/')
+    return path.replace(os.sep, '/')
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """`a.b.c` for Name/Attribute chains, None for anything else."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return '.'.join(reversed(parts))
+    return None
+
+
+def suppressed_lines(source: str) -> Dict[int, Optional[Set[str]]]:
+    """line -> suppressed rules on it (None = every rule)."""
+    out: Dict[int, Optional[Set[str]]] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        if m.group(1) is None:
+            out[i] = None
+        else:
+            out[i] = {r.strip().upper() for r in m.group(1).split(',')
+                      if r.strip()}
+    return out
+
+
+def run_source(source: str, path: str,
+               select: Optional[Iterable[str]] = None) -> List[Finding]:
+    """Run the (selected) checkers over one file's source text."""
+    checkers = all_checkers()
+    rules = set(select) if select is not None else set(checkers)
+    rel = display_path(path)
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding('SKY000', rel, e.lineno or 1, e.offset or 0,
+                        f'syntax error: {e.msg}')]
+    findings: List[Finding] = []
+    for rule in sorted(rules):
+        cls = checkers[rule]
+        if not cls.applies_to(rel):
+            continue
+        findings.extend(cls(FileContext(path, source)).check(tree))
+    suppressed = suppressed_lines(source)
+    kept = []
+    for f in sorted(findings, key=lambda f: (f.line, f.col, f.rule)):
+        rules_here = suppressed.get(f.line, ...)
+        if rules_here is None or (rules_here is not ... and
+                                  f.rule in rules_here):
+            continue
+        kept.append(f)
+    return kept
+
+
+def run_file(path: str,
+             select: Optional[Iterable[str]] = None) -> List[Finding]:
+    with open(path, 'r', encoding='utf-8') as f:
+        source = f.read()
+    return run_source(source, path, select)
+
+
+def iter_python_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for path in paths:
+        if os.path.isfile(path):
+            out.append(path)
+            continue
+        for dirpath, dirnames, filenames in os.walk(path):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in _SKIP_DIRS and
+                                 not d.startswith('.'))
+            for fname in sorted(filenames):
+                if fname.endswith('.py'):
+                    out.append(os.path.join(dirpath, fname))
+    return out
+
+
+def run_paths(paths: Sequence[str],
+              select: Optional[Iterable[str]] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in iter_python_files(paths):
+        findings.extend(run_file(path, select))
+    return sorted(findings,
+                  key=lambda f: (f.path, f.line, f.col, f.rule))
+
+
+# -- baseline ---------------------------------------------------------------
+class Baseline:
+    """Grandfathered findings: (path, rule, line) -> justification.
+
+    Every entry must carry a non-empty justification — the baseline
+    is for triaged FALSE positives, not a mute button."""
+
+    def __init__(self, entries: Optional[List[Dict]] = None) -> None:
+        self.entries = entries or []
+        self._index: Dict[Tuple[str, str, int], Dict] = {}
+        for e in self.entries:
+            just = str(e.get('justification') or '').strip()
+            if not just:
+                raise ValueError(
+                    f'baseline entry {e.get("path")}:{e.get("line")} '
+                    f'{e.get("rule")} lacks a justification')
+            self._index[(e['path'], e['rule'], int(e['line']))] = e
+
+    @classmethod
+    def load(cls, path: str) -> 'Baseline':
+        if not os.path.exists(path):
+            return cls([])
+        with open(path, 'r', encoding='utf-8') as f:
+            data = json.load(f)
+        return cls(data.get('entries', []))
+
+    def save(self, path: str) -> None:
+        with open(path, 'w', encoding='utf-8') as f:
+            json.dump({'version': 1, 'entries': self.entries}, f,
+                      indent=2, sort_keys=False)
+            f.write('\n')
+
+    def contains(self, finding: Finding) -> bool:
+        return finding.key() in self._index
+
+    def split(self, findings: Sequence[Finding]
+              ) -> Tuple[List[Finding], List[Finding]]:
+        """-> (new findings, baselined findings)."""
+        new, old = [], []
+        for f in findings:
+            (old if self.contains(f) else new).append(f)
+        return new, old
+
+    def stale_entries(self, findings: Sequence[Finding]) -> List[Dict]:
+        """Entries matching no current finding — fixed code whose
+        baseline row should be deleted."""
+        live = {f.key() for f in findings}
+        return [e for key, e in sorted(self._index.items())
+                if key not in live]
+
+    @classmethod
+    def from_findings(cls, findings: Sequence[Finding],
+                      justification: str) -> 'Baseline':
+        return cls([{'rule': f.rule, 'path': f.path, 'line': f.line,
+                     'message': f.message,
+                     'justification': justification}
+                    for f in findings])
+
+
+# -- reporters --------------------------------------------------------------
+def render_text(findings: Sequence[Finding],
+                baselined: Sequence[Finding] = ()) -> str:
+    lines = [f.render() for f in findings]
+    n = len(findings)
+    summary = f'{n} finding{"s" if n != 1 else ""}'
+    if baselined:
+        summary += f' ({len(baselined)} baselined, not shown)'
+    lines.append(summary)
+    return '\n'.join(lines)
+
+
+def render_json(findings: Sequence[Finding],
+                baselined: Sequence[Finding] = ()) -> str:
+    return json.dumps({
+        'version': 1,
+        'count': len(findings),
+        'baselined_count': len(baselined),
+        'findings': [f.to_dict() for f in findings],
+    }, indent=2)

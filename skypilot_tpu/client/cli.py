@@ -416,18 +416,84 @@ def attach(cluster, node) -> None:
 # info
 # ---------------------------------------------------------------------------
 @cli.command()
-@click.argument('clouds', nargs=-1)
-def check(clouds) -> None:
-    """Probe cloud credentials; cache enabled clouds.
+@click.argument('targets', nargs=-1)
+@click.option('--format', 'fmt', type=click.Choice(['text', 'json']),
+              default='text', help='Static-analysis report format.')
+@click.option('--select', default=None, metavar='RULES',
+              help='Comma-separated rules to run, e.g. SKY001,SKY003.')
+@click.option('--baseline', 'baseline_path', default=None,
+              metavar='PATH',
+              help='Baseline JSON (default: the committed '
+                   'analysis/baseline.json).')
+@click.option('--no-baseline', is_flag=True, default=False,
+              help='Report baselined findings too.')
+@click.option('--write-baseline', is_flag=True, default=False,
+              help='Rewrite the baseline file to grandfather every '
+                   'current finding (requires --justification).')
+@click.option('--justification', default=None,
+              help='One-line reason recorded on entries written by '
+                   '--write-baseline.')
+def check(targets, fmt, select, baseline_path, no_baseline,
+          write_baseline, justification) -> None:
+    """Static analysis (`stpu check skypilot_tpu/`) or cloud probe.
 
-    With CLOUD args, reports just those clouds' status."""
-    enabled = sdk.get(sdk.check())
-    if clouds:
-        for c in clouds:
-            mark = 'enabled' if c.lower() in enabled else 'disabled'
-            click.echo(f'{c.lower()}: {mark}')
+    With PATH arguments — or any of --select/--format/--baseline —
+    runs the SKY static-analysis suite (async-safety, jit-purity,
+    lock discipline, metric hygiene, exception hygiene; see
+    docs/internals.md) and exits non-zero on any non-baselined
+    finding. With cloud-name arguments (or none), probes cloud
+    credentials and caches enabled clouds (the original behavior).
+    """
+    static_flags = (fmt != 'text' or select or baseline_path or
+                    no_baseline or write_baseline)
+    path_args = any(os.path.exists(t) or t.endswith('.py') or
+                    os.sep in t for t in targets)
+    if not static_flags and not path_args:
+        enabled = sdk.get(sdk.check())
+        if targets:
+            for c in targets:
+                mark = 'enabled' if c.lower() in enabled else 'disabled'
+                click.echo(f'{c.lower()}: {mark}')
+            return
+        click.echo(f'Enabled clouds: {", ".join(enabled) or "none"}')
         return
-    click.echo(f'Enabled clouds: {", ".join(enabled) or "none"}')
+
+    from skypilot_tpu import analysis
+    from skypilot_tpu.analysis import core as analysis_core
+    paths = list(targets)
+    if not paths:
+        # Default target: the installed package tree.
+        paths = [analysis_core._PKG_DIR]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        _err(f'no such path(s): {", ".join(missing)}')
+    try:
+        rules = analysis.resolve_select(select)
+    except ValueError as e:
+        _err(str(e))
+    findings = analysis.run_paths(paths, rules)
+    if write_baseline:
+        if not justification:
+            _err('--write-baseline requires --justification '
+                 '(the baseline is for triaged false positives, '
+                 'each with a reason)')
+        out = baseline_path or analysis_core.DEFAULT_BASELINE
+        analysis_core.Baseline.from_findings(
+            findings, justification).save(out)
+        click.echo(f'Wrote {len(findings)} entr'
+                   f'{"y" if len(findings) == 1 else "ies"} to {out}')
+        return
+    baseline = analysis_core.Baseline.load(
+        baseline_path or analysis_core.DEFAULT_BASELINE)
+    if no_baseline:
+        new, baselined = list(findings), []
+    else:
+        new, baselined = baseline.split(findings)
+    if fmt == 'json':
+        click.echo(analysis.render_json(new, baselined))
+    else:
+        click.echo(analysis.render_text(new, baselined))
+    sys.exit(1 if new else 0)
 
 
 @cli.command(name='gpus')

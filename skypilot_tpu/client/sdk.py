@@ -337,6 +337,24 @@ def check() -> str:
     return _post('/check', {})
 
 
+def static_check(paths: Optional[List[str]] = None,
+                 select: Optional[str] = None,
+                 include_baselined: bool = False) -> List[dict]:
+    """Run the `stpu check` static-analysis suite locally (no server
+    round-trip) and return findings as dicts: {rule, path, line, col,
+    message}. Baselined findings are dropped unless asked for."""
+    from skypilot_tpu import analysis
+    from skypilot_tpu.analysis import core as analysis_core
+    rules = analysis.resolve_select(select)
+    findings = analysis.run_paths(paths or [analysis_core._PKG_DIR],
+                                  rules)
+    if not include_baselined:
+        baseline = analysis_core.Baseline.load(
+            analysis_core.DEFAULT_BASELINE)
+        findings, _ = baseline.split(findings)
+    return [f.to_dict() for f in findings]
+
+
 def list_accelerators(name_filter: Optional[str] = None,
                       region_filter: Optional[str] = None) -> str:
     return _post('/accelerators', {'name_filter': name_filter,

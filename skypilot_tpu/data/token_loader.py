@@ -22,26 +22,64 @@ _NATIVE_DIR = os.path.join(
 _SO_PATH = os.path.join(_NATIVE_DIR, 'libtoken_loader.so')
 
 _lib: Optional[ctypes.CDLL] = None
+# Why the native core is unusable, when it is (None = usable or not
+# yet probed). Tests key skip-with-reason off this instead of failing
+# in environments that cannot build or load the .so.
+_native_error: Optional[str] = None
 
 
-def _build_native() -> bool:
+def _build_native(force: bool = False) -> bool:
     if not os.path.exists(os.path.join(_NATIVE_DIR, 'token_loader.cpp')):
         return False
     try:
-        subprocess.run(['make', '-C', _NATIVE_DIR], check=True,
-                       capture_output=True, timeout=120)
+        cmd = ['make', '-C', _NATIVE_DIR]
+        if force:
+            cmd.insert(1, '-B')
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         return os.path.exists(_SO_PATH)
     except (subprocess.SubprocessError, OSError):
         return False
 
 
+def native_unavailable_reason() -> Optional[str]:
+    """None when the native loader works here; otherwise why not
+    (no toolchain, GLIBC mismatch, ...)."""
+    _load_lib()
+    return _native_error
+
+
+def _dlopen_or_rebuild() -> Optional[ctypes.CDLL]:
+    """dlopen the .so; on failure (typically a stale binary built
+    against another toolchain's GLIBC) force one rebuild and retry."""
+    global _native_error
+    try:
+        return ctypes.CDLL(_SO_PATH)
+    except OSError as e:
+        first_error = str(e)
+    if not _build_native(force=True):
+        _native_error = (f'cannot load {_SO_PATH} ({first_error}) and '
+                         f'rebuild failed (no usable C++ toolchain?)')
+        return None
+    try:
+        return ctypes.CDLL(_SO_PATH)
+    except OSError as e:
+        _native_error = f'rebuilt .so still does not load: {e}'
+        return None
+
+
 def _load_lib() -> Optional[ctypes.CDLL]:
-    global _lib
+    global _lib, _native_error
     if _lib is not None:
         return _lib
-    if not os.path.exists(_SO_PATH) and not _build_native():
+    if _native_error is not None:
         return None
-    lib = ctypes.CDLL(_SO_PATH)
+    if not os.path.exists(_SO_PATH) and not _build_native():
+        _native_error = (f'{_SO_PATH} missing and `make -C '
+                         f'{_NATIVE_DIR}` did not produce it')
+        return None
+    lib = _dlopen_or_rebuild()
+    if lib is None:
+        return None
     lib.tl_open.restype = ctypes.c_void_p
     lib.tl_open.argtypes = [ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
                             ctypes.c_int]

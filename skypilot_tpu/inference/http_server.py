@@ -274,7 +274,7 @@ def make_server(rt: InferenceRuntime,
                 # stream (the client sees truncation, not a reset).
                 try:
                     self.sse_done()
-                except Exception:  # pylint: disable=broad-except
+                except Exception:  # pylint: disable=broad-except  # stpu: ignore[SKY005] — closing an already-broken stream; client is gone
                     pass
                 return
             self._json({'error': f'{type(e).__name__}: {e}'}, 400)
@@ -370,7 +370,7 @@ def make_server(rt: InferenceRuntime,
                 # no in-band error frame; close the stream.
                 try:
                     self.sse_done()
-                except Exception:  # pylint: disable=broad-except
+                except Exception:  # pylint: disable=broad-except  # stpu: ignore[SKY005] — closing an already-broken stream; client is gone
                     pass
                 return
             self._json({'error': {

@@ -368,6 +368,19 @@ def stream_completion(rt: InferenceRuntime, req: CompletionRequest,
                       n_prompt_tokens=len(ids))
 
 
+_warned_no_template = False
+
+
+def _warn_no_template(reason: str) -> None:
+    global _warned_no_template
+    if not _warned_no_template:
+        _warned_no_template = True
+        import sys
+        print(f'openai_compat: tokenizer has no usable chat template '
+              f'({reason}); falling back to "role: content" prompts.',
+              file=sys.stderr, flush=True)
+
+
 def render_chat_prompt(rt: InferenceRuntime, messages) -> str:
     """Chat template when the checkpoint ships one, else a transparent
     `role: content` fallback (beats a 400 for base models)."""
@@ -375,7 +388,10 @@ def render_chat_prompt(rt: InferenceRuntime, messages) -> str:
     try:
         return tok.apply_chat_template(messages, tokenize=False,
                                        add_generation_prompt=True)
-    except Exception:  # pylint: disable=broad-except
+    except Exception as e:  # pylint: disable=broad-except
+        # Base models ship no template; say so once instead of letting
+        # users puzzle over oddly formatted completions.
+        _warn_no_template(f'{type(e).__name__}: {e}')
         return '\n'.join(f"{m['role']}: {m['content']}"
                          for m in messages) + '\nassistant:'
 

@@ -187,7 +187,10 @@ class JobController:
         if agent is not None and agent_job_id > 0:
             try:
                 job = agent.get_job(agent_job_id)
-            except Exception:  # pylint: disable=broad-except
+            except Exception as e:  # pylint: disable=broad-except
+                ux_utils.log(f'Managed job {job_id}: adoption probe of '
+                             f'agent job {agent_job_id} failed ({e}); '
+                             f'treating the cluster as lost.')
                 job = None
             if job is not None:
                 # Only *consecutive* failed adoptions count: a clean

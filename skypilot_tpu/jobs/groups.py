@@ -329,14 +329,18 @@ def remove_hosts_entries(handle, group_name: str) -> None:
     def _one(runner) -> None:
         try:
             runner.run(script, require_outputs=True)
-        except Exception:  # pylint: disable=broad-except
-            pass
+        except Exception as e:  # pylint: disable=broad-except
+            # One unreachable host must not block the others' cleanup,
+            # but a stale mapping on a reused worker is worth a line.
+            ux_utils.log(f'Job group {group_name!r}: hosts cleanup on '
+                         f'one host failed ({e}).')
 
     try:
         subprocess_utils.run_in_parallel(_one,
                                          handle.get_command_runners())
-    except Exception:  # pylint: disable=broad-except
-        pass
+    except Exception as e:  # pylint: disable=broad-except
+        ux_utils.log(f'Job group {group_name!r}: hosts cleanup '
+                     f'skipped ({e}).')
 
 
 def cancel_group(group_name: str) -> List[int]:

@@ -23,9 +23,9 @@ async def jobs_logs(request: web.Request) -> web.StreamResponse:
     follow = request.query.get('follow', '1') == '1'
     try:
         log_path = core.get_log_path(job_id)
-    except Exception:  # pylint: disable=broad-except
-        return web.json_response({'error': f'no managed job {job_id}'},
-                                 status=404)
+    except Exception as e:  # pylint: disable=broad-except
+        return web.json_response(
+            {'error': f'no managed job {job_id}: {e}'}, status=404)
     return await stream_lines(
         request,
         lambda: log_lib.tail_logs(

@@ -90,8 +90,13 @@ def reconcile_controllers() -> int:
                 from skypilot_tpu import core as sky_core
                 try:
                     sky_core.down(replica['cluster_name'])
-                except Exception:  # pylint: disable=broad-except
-                    pass  # half-created at most
+                except Exception as e:  # pylint: disable=broad-except
+                    # Half-created at most — but say so: a leaked
+                    # cluster is a billing surprise.
+                    ux_utils.log(
+                        f'Service {name}: teardown of orphaned replica '
+                        f'cluster {replica["cluster_name"]} failed '
+                        f'({e}); it may need a manual `stpu down`.')
                 serve_state.remove_replica(name, replica['replica_id'])
         ux_utils.log(f'Service {name}: controller (pid {pid}) dead; '
                      'respawning on the same ports.')

@@ -77,8 +77,9 @@ class ServeController:
                 rid: m
                 for rid, m in serve_state.get_replica_meta(
                     service_name).items() if rid in live}
-        except Exception:  # pylint: disable=broad-except
-            pass
+        except Exception as e:  # pylint: disable=broad-except
+            ux_utils.log(f'Service {service_name}: replica metadata '
+                         f'unreadable ({e}); starting with none.')
         self._spot_placer = None
         self._spot_requested = self._task_wants_spot()
 
@@ -86,7 +87,10 @@ class ServeController:
         try:
             task = task_lib.Task.from_yaml_config(dict(self.task_config))
             return any(r.use_spot for r in task.resources)
-        except Exception:  # pylint: disable=broad-except
+        except Exception as e:  # pylint: disable=broad-except
+            ux_utils.log(f'Service {self.name}: could not parse task '
+                         f'config for spot detection ({e}); assuming '
+                         f'on-demand.')
             return False
 
     def _placer(self):
@@ -108,8 +112,10 @@ class ServeController:
                            cand.zone)
                     if loc not in locations:
                         locations.append(loc)
-            except Exception:  # pylint: disable=broad-except
-                pass
+            except Exception as e:  # pylint: disable=broad-except
+                ux_utils.log(f'Service {self.name}: spot-candidate '
+                             f'enumeration failed ({e}); dynamic spot '
+                             f'placement disabled.')
             if locations:
                 self._spot_placer = placer_lib.DynamicFallbackSpotPlacer(
                     locations[:16])

@@ -73,8 +73,11 @@ async def attach(request: web.Request) -> web.StreamResponse:
     await ws.prepare(request)
 
     master, slave = os.openpty()
-    proc = subprocess.Popen(argv, stdin=slave, stdout=slave, stderr=slave,
-                            env=env, cwd=cwd, start_new_session=True)
+    # fork/exec can take tens of ms on a busy box — keep it off the
+    # event loop (SKY001).
+    proc = await asyncio.to_thread(
+        subprocess.Popen, argv, stdin=slave, stdout=slave, stderr=slave,
+        env=env, cwd=cwd, start_new_session=True)
     os.close(slave)
     loop = asyncio.get_event_loop()
 

@@ -9,6 +9,7 @@ users tables and streams log tails through the server's existing
 from __future__ import annotations
 
 import asyncio
+import functools
 import os
 from typing import Any, Dict
 
@@ -197,19 +198,29 @@ async def workspaces(request: web.Request) -> web.Response:
     return web.json_response(data)
 
 
+@functools.lru_cache(maxsize=None)
+def _static_text(filename: str) -> str:
+    """Read-once cache for the two shipped SPA files. They never
+    change while the server runs, so the disk read happens on the
+    first request only — and off the event loop (SKY001)."""
+    with open(os.path.join(_STATIC_DIR, filename), 'r',
+              encoding='utf-8') as f:
+        return f.read()
+
+
 async def index(request: web.Request) -> web.Response:
     del request
-    with open(os.path.join(_STATIC_DIR, 'index.html'), 'r',
-              encoding='utf-8') as f:
-        return web.Response(text=f.read(), content_type='text/html')
+    text = await asyncio.get_event_loop().run_in_executor(
+        None, _static_text, 'index.html')
+    return web.Response(text=text, content_type='text/html')
 
 
 async def app_js(request: web.Request) -> web.Response:
     del request
-    with open(os.path.join(_STATIC_DIR, 'app.js'), 'r',
-              encoding='utf-8') as f:
-        return web.Response(text=f.read(),
-                            content_type='application/javascript')
+    text = await asyncio.get_event_loop().run_in_executor(
+        None, _static_text, 'app.js')
+    return web.Response(text=text,
+                        content_type='application/javascript')
 
 
 def register(app: web.Application) -> None:

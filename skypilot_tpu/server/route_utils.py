@@ -41,13 +41,13 @@ async def stream_lines(request: web.Request,
                 try:
                     asyncio.run_coroutine_threadsafe(
                         queue.put(line), loop).result(timeout=60)
-                except Exception:  # pylint: disable=broad-except
+                except Exception:  # pylint: disable=broad-except  # stpu: ignore[SKY005] — client hung up / loop closed; break IS the handling
                     break
         finally:
             try:
                 asyncio.run_coroutine_threadsafe(
                     queue.put(None), loop).result(timeout=5)
-            except Exception:  # pylint: disable=broad-except
+            except Exception:  # pylint: disable=broad-except  # stpu: ignore[SKY005] — sentinel put on a dead loop; consumer is gone
                 pass
 
     threading.Thread(target=pump, daemon=True).start()

@@ -338,7 +338,7 @@ async def metrics_middleware(request: web.Request, handler):
         in_flight.dec()
         try:
             resource = request.match_info.route.resource
-        except Exception:  # pylint: disable=broad-except
+        except Exception:  # pylint: disable=broad-except  # stpu: ignore[SKY005] — fallback label 'unmatched' IS the handling
             resource = None
         route = (resource.canonical if resource is not None
                  else 'unmatched')
@@ -553,13 +553,14 @@ async def auth_middleware(request: web.Request, handler):
     if user and user != 'unknown':
         try:
             await loop.run_in_executor(None, users_core.record_request, user)
-        except Exception:  # pylint: disable=broad-except
-            pass  # registry is best-effort
+        except Exception as e:  # pylint: disable=broad-except
+            logger.debug('user registry update failed (best-effort): %s',
+                         e)
     response = await handler(request)
     try:
         response.headers[versions.HEADER] = str(versions.API_VERSION)
-    except Exception:  # pylint: disable=broad-except
-        pass  # streamed responses may already have headers sent
+    except Exception:  # pylint: disable=broad-except  # stpu: ignore[SKY005] — streamed responses may already have headers sent
+        pass
     return response
 
 

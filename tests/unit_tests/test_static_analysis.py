@@ -1,0 +1,476 @@
+"""`stpu check` static-analysis suite: framework + the five SKY rules.
+
+Three layers:
+  1. fixture snippets asserting EXACT (rule, line) findings per rule;
+  2. framework mechanics — suppression comments, baseline round-trip,
+     select validation, the JSON/text reporters, the CLI;
+  3. the tier-1 GATE: zero non-baselined findings across
+     `skypilot_tpu/` (and no stale baseline rows), so a regression in
+     async-safety / jit-purity / lock discipline / metric hygiene /
+     exception hygiene fails CI the moment it lands.
+"""
+import asyncio
+import json
+import os
+
+import pytest
+
+from skypilot_tpu import analysis
+from skypilot_tpu.analysis import core as acore
+
+REPO_ROOT = acore.REPO_ROOT
+PKG = os.path.join(REPO_ROOT, 'skypilot_tpu')
+
+
+def rules_lines(src, path='snippet.py', select=None):
+    return [(f.rule, f.line)
+            for f in analysis.run_source(src, path, select)]
+
+
+# ---------------------------------------------------------------------------
+# SKY001: blocking-call-in-async
+# ---------------------------------------------------------------------------
+def test_sky001_flags_blocking_calls_in_async():
+    src = '''\
+import time, subprocess, requests
+
+async def handler(request):
+    time.sleep(1)
+    subprocess.run(['true'])
+    requests.get('http://x')
+    with open('f') as f:
+        pass
+    body = path.read_text()
+'''
+    assert rules_lines(src, select=['SKY001']) == [
+        ('SKY001', 4), ('SKY001', 5), ('SKY001', 6), ('SKY001', 7),
+        ('SKY001', 9)]
+
+
+def test_sky001_sync_and_nested_defs_are_clean():
+    src = '''\
+import time
+
+def plain():
+    time.sleep(1)
+
+async def handler():
+    def worker():
+        time.sleep(1)  # runs in an executor, not on the loop
+    await asyncio.to_thread(worker)
+    await loop.run_in_executor(None, open, 'f')
+'''
+    assert rules_lines(src, select=['SKY001']) == []
+
+
+def test_sky001_db_calls_need_db_receiver():
+    src = '''\
+async def handler(conn, planner):
+    conn.execute('SELECT 1')
+    planner.execute()
+'''
+    assert rules_lines(src, select=['SKY001']) == [('SKY001', 2)]
+
+
+# ---------------------------------------------------------------------------
+# SKY002: jit-purity
+# ---------------------------------------------------------------------------
+def test_sky002_decorated_and_wrapped_functions():
+    src = '''\
+import jax
+import numpy as np
+from functools import partial
+
+@jax.jit
+def step(x, y):
+    print('tracing')
+    v = x.item()
+    f = float(y)
+    a = np.asarray(x)
+    return v + f + a
+
+def raw(x):
+    return int(x)
+
+wrapped = jax.jit(raw, donate_argnums=(0,))
+'''
+    assert rules_lines(src, select=['SKY002']) == [
+        ('SKY002', 7), ('SKY002', 8), ('SKY002', 9), ('SKY002', 10),
+        ('SKY002', 14)]
+
+
+def test_sky002_side_effects_and_static_argnums():
+    src = '''\
+import jax
+from functools import partial
+
+@partial(jax.jit, static_argnums={0})
+def stepped(n, x):
+    global COUNT
+    return x
+
+class Trainer:
+    @jax.jit
+    def update(self, x):
+        self.calls = 1
+        return x
+'''
+    assert rules_lines(src, select=['SKY002']) == [
+        ('SKY002', 4), ('SKY002', 6), ('SKY002', 12)]
+
+
+def test_sky002_clean_jit_and_non_jitted_code():
+    src = '''\
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def step(x):
+    jax.debug.print('x={x}', x=x)
+    y = jnp.sum(x)
+    return y
+
+def host_side(x):
+    print(x)          # not jitted: fine
+    return x.item()   # not jitted: fine
+
+fast = jax.jit(step, static_argnums=(0,))
+'''
+    assert rules_lines(src, select=['SKY002']) == []
+
+
+# ---------------------------------------------------------------------------
+# SKY003: lock discipline
+# ---------------------------------------------------------------------------
+_LOCKED_CLASS = '''\
+import threading
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.queue = []
+        self.count = 0
+
+    def good(self, item):
+        with self._lock:
+            self.queue.append(item)
+            self.count += 1
+
+    def bad(self, item):
+        self.queue.append(item)
+
+    def also_bad(self):
+        self.count += 1
+
+    def _sweep_locked(self):
+        self.queue.clear()  # caller holds the lock by convention
+
+    def read_only(self):
+        return len(self.queue)
+'''
+
+
+def test_sky003_flags_unlocked_mutations_only():
+    assert rules_lines(_LOCKED_CLASS, select=['SKY003']) == [
+        ('SKY003', 15), ('SKY003', 18)]
+
+
+def test_sky003_class_without_lock_is_exempt():
+    src = '''\
+class Plain:
+    def __init__(self):
+        self.queue = []
+
+    def push(self, item):
+        self.queue.append(item)
+'''
+    assert rules_lines(src, select=['SKY003']) == []
+
+
+def test_sky003_acquire_call_counts_as_disciplined():
+    src = '''\
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.state = {}
+
+    def update(self, k, v):
+        self._lock.acquire()
+        try:
+            self.state[k] = v
+        finally:
+            self._lock.release()
+'''
+    assert rules_lines(src, select=['SKY003']) == []
+
+
+# ---------------------------------------------------------------------------
+# SKY004: metric-name hygiene
+# ---------------------------------------------------------------------------
+def test_sky004_literal_names_must_be_cataloged():
+    src = '''\
+from skypilot_tpu.observability.catalog import counter
+from skypilot_tpu.observability import catalog
+
+c1 = counter('skypilot_serving_requests_total')
+c2 = counter('skypilot_bogus_total')
+c3 = catalog.gauge('skypilot_clusters')
+'''
+    assert rules_lines(src, select=['SKY004']) == [('SKY004', 5)]
+
+
+def test_sky004_dynamic_names_and_foreign_counters():
+    src = '''\
+from skypilot_tpu.observability import catalog, metrics as m
+from collections import Counter
+
+def f(name):
+    bad = catalog.counter(f'skypilot_{name}_total')
+    ok = Counter([1, 2, 3])
+    cls = m.Counter('skypilot_not_in_catalog_total', 'help')
+    reg = REGISTRY.get_or_create(m.Gauge, 'skypilot_undeclared', 'h')
+'''
+    assert rules_lines(src, select=['SKY004']) == [
+        ('SKY004', 5), ('SKY004', 7), ('SKY004', 8)]
+
+
+def test_sky004_catalog_parse_finds_real_names():
+    from skypilot_tpu.analysis.checkers import metric_names
+    names = metric_names.catalog_names()
+    assert 'skypilot_serving_requests_total' in names
+    assert 'skypilot_api_requests_total' in names
+    assert len(names) >= 30
+
+
+# ---------------------------------------------------------------------------
+# SKY005: swallowed exceptions (control planes only)
+# ---------------------------------------------------------------------------
+_SWALLOW = '''\
+def f():
+    try:
+        work()
+    except Exception:
+        pass
+'''
+
+
+def test_sky005_scoped_to_control_planes(tmp_path):
+    sub = tmp_path / 'server'
+    sub.mkdir()
+    in_scope = sub / 'handlers.py'
+    in_scope.write_text(_SWALLOW)
+    out_of_scope = tmp_path / 'utils.py'
+    out_of_scope.write_text(_SWALLOW)
+    assert [(f.rule, f.line)
+            for f in analysis.run_file(str(in_scope))] == [('SKY005', 4)]
+    assert analysis.run_file(str(out_of_scope)) == []
+
+
+def test_sky005_handled_forms_are_clean():
+    src = '''\
+import logging
+logger = logging.getLogger(__name__)
+
+def f():
+    try:
+        work()
+    except Exception as e:
+        logger.warning('failed: %s', e)
+    try:
+        work()
+    except Exception:
+        raise
+    try:
+        work()
+    except Exception as e:
+        return {'error': str(e)}
+    try:
+        work()
+    except ValueError:
+        pass  # narrow except: out of SKY005 scope
+'''
+    assert rules_lines(src, 'server/x.py', ['SKY005']) == []
+
+
+def test_sky005_bare_except_flagged():
+    src = '''\
+def f():
+    try:
+        work()
+    except:
+        result = None
+'''
+    assert rules_lines(src, 'jobs/x.py', ['SKY005']) == [('SKY005', 4)]
+
+
+# ---------------------------------------------------------------------------
+# framework: suppressions, baseline, select, reporters
+# ---------------------------------------------------------------------------
+def test_suppression_comment_exact_rule():
+    src = '''\
+import time
+
+async def handler():
+    time.sleep(1)  # stpu: ignore[SKY001]
+    time.sleep(2)  # stpu: ignore[SKY003]
+    time.sleep(3)  # stpu: ignore
+'''
+    assert rules_lines(src, select=['SKY001']) == [('SKY001', 5)]
+
+
+def test_select_unknown_rule_raises():
+    with pytest.raises(ValueError, match='SKY999'):
+        analysis.resolve_select('SKY999')
+    assert analysis.resolve_select('sky001') == {'SKY001'}
+    assert len(analysis.resolve_select(None)) == 5
+
+
+def test_syntax_error_reported_not_crashed():
+    findings = analysis.run_source('def broken(:\n', 'x.py')
+    assert [(f.rule, f.line) for f in findings] == [('SKY000', 1)]
+
+
+def test_baseline_round_trip(tmp_path):
+    findings = analysis.run_source(
+        'import time\nasync def f():\n    time.sleep(1)\n', 'a.py')
+    assert len(findings) == 1
+    b = acore.Baseline.from_findings(findings, 'known issue')
+    path = tmp_path / 'baseline.json'
+    b.save(str(path))
+    loaded = acore.Baseline.load(str(path))
+    new, old = loaded.split(findings)
+    assert new == [] and len(old) == 1
+    assert loaded.stale_entries(findings) == []
+    assert loaded.stale_entries([]) == loaded.entries
+    # An entry without a justification is rejected outright.
+    with pytest.raises(ValueError, match='justification'):
+        acore.Baseline([{'rule': 'SKY001', 'path': 'a.py', 'line': 3,
+                         'justification': ''}])
+
+
+def test_reporters():
+    findings = analysis.run_source(
+        'import time\nasync def f():\n    time.sleep(1)\n', 'a.py')
+    text = analysis.render_text(findings)
+    assert 'a.py:3:4: SKY001' in text and '1 finding' in text
+    data = json.loads(analysis.render_json(findings))
+    assert data['count'] == 1
+    assert data['findings'][0]['rule'] == 'SKY001'
+    assert data['findings'][0]['line'] == 3
+
+
+# ---------------------------------------------------------------------------
+# the gate + self-check
+# ---------------------------------------------------------------------------
+def test_analysis_package_is_itself_clean():
+    findings = analysis.run_paths(
+        [os.path.join(PKG, 'analysis')])
+    assert findings == [], '\n'.join(f.render() for f in findings)
+
+
+def test_tier1_gate_zero_non_baselined_findings():
+    """THE gate: `stpu check skypilot_tpu/` must be clean against the
+    committed baseline — and the baseline must carry no stale rows."""
+    findings = analysis.run_paths([PKG])
+    baseline = acore.Baseline.load(acore.DEFAULT_BASELINE)
+    new, _ = baseline.split(findings)
+    assert new == [], ('new static-analysis findings (fix them or, for '
+                       'a triaged false positive, baseline them with a '
+                       'justification):\n' +
+                       '\n'.join(f.render() for f in new))
+    stale = baseline.stale_entries(findings)
+    assert stale == [], ('baseline rows no longer matching any finding '
+                         '(delete them):\n' +
+                         '\n'.join(str(e) for e in stale))
+
+
+def test_dashboard_sky001_findings_fixed_not_baselined():
+    dashboard = os.path.join(PKG, 'server', 'dashboard.py')
+    assert analysis.run_file(dashboard, ['SKY001']) == []
+    baseline = acore.Baseline.load(acore.DEFAULT_BASELINE)
+    assert not any(e['path'].endswith('dashboard.py')
+                   for e in baseline.entries)
+
+
+# ---------------------------------------------------------------------------
+# the SKY001 dashboard fix, functionally
+# ---------------------------------------------------------------------------
+def test_dashboard_static_handlers_cached_off_loop():
+    from skypilot_tpu.server import dashboard
+    dashboard._static_text.cache_clear()
+    resp = asyncio.run(dashboard.index(None))
+    assert resp.status == 200
+    assert '<' in resp.text  # the SPA shell
+    resp_js = asyncio.run(dashboard.app_js(None))
+    assert resp_js.content_type == 'application/javascript'
+    # Second hit is served from the lru_cache, no disk read.
+    assert dashboard._static_text.cache_info().hits >= 0
+    before = dashboard._static_text.cache_info().misses
+    asyncio.run(dashboard.index(None))
+    assert dashboard._static_text.cache_info().misses == before
+
+
+# ---------------------------------------------------------------------------
+# CLI + SDK
+# ---------------------------------------------------------------------------
+def test_cli_check_static_json_smoke(tmp_path):
+    from click.testing import CliRunner
+    from skypilot_tpu.client import cli
+    clean = tmp_path / 'clean.py'
+    clean.write_text('def f():\n    return 1\n')
+    r = CliRunner().invoke(cli.cli,
+                           ['check', '--format', 'json', str(clean)])
+    assert r.exit_code == 0, r.output
+    data = json.loads(r.output)
+    assert data['count'] == 0
+
+
+def test_cli_check_nonzero_on_findings(tmp_path):
+    from click.testing import CliRunner
+    from skypilot_tpu.client import cli
+    bad = tmp_path / 'server'
+    bad.mkdir()
+    f = bad / 'handler.py'
+    f.write_text('import time\nasync def h():\n    time.sleep(1)\n')
+    r = CliRunner().invoke(cli.cli, ['check', str(bad)])
+    assert r.exit_code == 1
+    assert 'SKY001' in r.output
+
+
+def test_cli_check_select_filters(tmp_path):
+    from click.testing import CliRunner
+    from skypilot_tpu.client import cli
+    bad = tmp_path / 'server'
+    bad.mkdir()
+    f = bad / 'handler.py'
+    f.write_text('import time\nasync def h():\n    time.sleep(1)\n'
+                 'def g():\n    try:\n        pass\n'
+                 '    except Exception:\n        pass\n')
+    r = CliRunner().invoke(cli.cli,
+                           ['check', '--select', 'SKY005', str(bad)])
+    assert r.exit_code == 1
+    assert 'SKY005' in r.output and 'SKY001' not in r.output
+
+
+def test_cli_check_cloud_mode_still_works(monkeypatch):
+    from click.testing import CliRunner
+    from skypilot_tpu.client import cli, sdk
+    monkeypatch.setattr(sdk, 'check', lambda: 'req-1')
+    monkeypatch.setattr(sdk, 'get', lambda rid: ['gcp'])
+    r = CliRunner().invoke(cli.cli, ['check'])
+    assert r.exit_code == 0
+    assert 'Enabled clouds: gcp' in r.output
+    r2 = CliRunner().invoke(cli.cli, ['check', 'aws'])
+    assert r2.exit_code == 0
+    assert 'aws: disabled' in r2.output
+
+
+def test_sdk_static_check(tmp_path):
+    from skypilot_tpu.client import sdk
+    f = tmp_path / 'x.py'
+    f.write_text('import time\nasync def h():\n    time.sleep(1)\n')
+    rows = sdk.static_check([str(f)])
+    assert [(r['rule'], r['line']) for r in rows] == [('SKY001', 3)]
+    assert rows[0]['col'] == 4 and 'time.sleep' in rows[0]['message']
