@@ -60,6 +60,25 @@ def main() -> None:
                         help='continuous engine: N decode steps per '
                              'dispatch (dispatch-overhead '
                              'amortization)')
+    parser.add_argument('--long-prompt-frac', type=float, default=0.0,
+                        metavar='F',
+                        help='fraction of requests carrying a LONG '
+                             'prompt (near max-total-len minus the '
+                             'generation budget) mixed into the short '
+                             'workload — the regime where whole-'
+                             'prompt prefill stalls inter-token '
+                             'latency and chunked prefill should not')
+    parser.add_argument('--prefill-chunk', type=int, default=None,
+                        metavar='C',
+                        help='forwarded to serve_lm --prefill-chunk '
+                             '(0 disables chunked prefill for A/B '
+                             'runs; default: server default)')
+    parser.add_argument('--prefill-budget', type=int, default=None,
+                        metavar='T',
+                        help='forwarded to serve_lm --prefill-budget')
+    parser.add_argument('--no-pipeline-decode', action='store_true',
+                        help='forwarded to serve_lm (disables '
+                             'host/device decode pipelining)')
     parser.add_argument('--repetitive', action='store_true',
                         help='structured (repeated-trigram) prompts — '
                              'the regime speculation accelerates')
@@ -94,6 +113,12 @@ def main() -> None:
         cmd += ['--speculative', str(args.speculative)]
     if args.decode_chunk > 1:
         cmd += ['--decode-chunk', str(args.decode_chunk)]
+    if args.prefill_chunk is not None:
+        cmd += ['--prefill-chunk', str(args.prefill_chunk)]
+    if args.prefill_budget is not None:
+        cmd += ['--prefill-budget', str(args.prefill_budget)]
+    if args.no_pipeline_decode:
+        cmd += ['--no-pipeline-decode']
     if args.hf:
         cmd += ['--hf', args.hf]
     if args.ckpt_dir:
@@ -134,6 +159,19 @@ def main() -> None:
             prompts = [[rng.randrange(1, vocab)
                         for _ in range(rng.randrange(4, 16))]
                        for _ in range(args.requests)]
+        if args.long_prompt_frac > 0:
+            # Long prompts leave room to generate the full
+            # max_new_tokens below max_total_len (submit requires
+            # prompt_len < max_total_len).
+            long_len = max(16, args.max_total_len -
+                           args.max_new_tokens - 2)
+            n_long = int(round(args.long_prompt_frac * len(prompts)))
+            # Deterministic spread through the workload (not a
+            # front-loaded burst).
+            for i in range(n_long):
+                idx = (i * len(prompts)) // max(n_long, 1)
+                prompts[idx] = [rng.randrange(1, vocab)
+                                for _ in range(long_len)]
         if args.shared_prefix:
             system = [rng.randrange(1, vocab)
                       for _ in range(args.shared_prefix)]
@@ -144,7 +182,7 @@ def main() -> None:
         # shortest and longest so the timed section measures serving,
         # not XLA compiles.
         warm = [prompts[0]]
-        if args.shared_prefix:
+        if args.shared_prefix or args.long_prompt_frac > 0:
             warm.append(min(prompts, key=len))
             warm.append(max(prompts, key=len))
         for p in warm:
@@ -159,6 +197,7 @@ def main() -> None:
             'stream': True}, timeout=600)
 
         latencies = []
+        itl_gaps = []    # inter-token gaps across ALL requests (s)
         lock = threading.Lock()
         queue = list(enumerate(prompts))
 
@@ -169,11 +208,14 @@ def main() -> None:
                         return
                     _idx, prompt = queue.pop()
                 t0 = time.perf_counter()
-                # REAL TTFT: stream the request (SSE) and stamp the
-                # first token frame — one request measures both TTFT
-                # and completion latency, exactly what a streaming
-                # client experiences.
+                # REAL TTFT + ITL: stream the request (SSE), stamp the
+                # first token frame and every gap between consecutive
+                # token frames — one request measures TTFT, ITL, and
+                # completion latency, exactly what a streaming client
+                # experiences.
                 ttft = None
+                last_tok_t = None
+                gaps = []
                 with requests.post(f'{url}/generate', json={
                         'tokens': [prompt],
                         'max_new_tokens': args.max_new_tokens,
@@ -183,14 +225,20 @@ def main() -> None:
                     for raw in resp.iter_lines():
                         if not raw.startswith(b'data: '):
                             continue
-                        if ttft is None and b'"token"' in raw:
-                            ttft = time.perf_counter() - t0
+                        if b'"token"' in raw:
+                            now = time.perf_counter()
+                            if ttft is None:
+                                ttft = now - t0
+                            if last_tok_t is not None:
+                                gaps.append(now - last_tok_t)
+                            last_tok_t = now
                         if raw == b'data: [DONE]':
                             break
                 total = time.perf_counter() - t0
                 with lock:
                     latencies.append((ttft if ttft is not None
                                       else total, total))
+                    itl_gaps.extend(gaps)
 
         start = time.perf_counter()
         threads = [threading.Thread(target=client)
@@ -202,11 +250,29 @@ def main() -> None:
         elapsed = time.perf_counter() - start
 
         ttfts = sorted(l[0] for l in latencies)
+        gaps = sorted(itl_gaps)
+        # Server-side ITL percentiles (/stats): gaps measured at the
+        # engine's token COMMIT, the signal chunked prefill targets —
+        # client-side SSE gap timing rides TCP flush batching and
+        # client GIL scheduling, which can swamp ms-scale effects.
+        serving = requests.get(f'{url}/stats',
+                               timeout=30).json()['serving']
+
+        def pct(sorted_vals, q):
+            if not sorted_vals:
+                return None
+            return round(1000 * sorted_vals[
+                int(q * (len(sorted_vals) - 1))], 2)
+
         print(json.dumps({
             'engine': args.engine,
             'speculative': args.speculative,
             'decode_chunk': args.decode_chunk,
+            'prefill_chunk': args.prefill_chunk,
+            'prefill_budget': args.prefill_budget,
+            'pipeline_decode': not args.no_pipeline_decode,
             'shared_prefix': args.shared_prefix,
+            'long_prompt_frac': args.long_prompt_frac,
             'prefix_caching': not args.no_prefix_caching,
             'model': info['model'],   # server-reported (handles --hf)
             'requests': len(latencies),
@@ -216,6 +282,11 @@ def main() -> None:
                 1000 * statistics.median(ttfts), 1),
             'p95_ttft_ms': round(
                 1000 * ttfts[int(0.95 * (len(ttfts) - 1))], 1),
+            'p99_ttft_ms': pct(ttfts, 0.99),
+            'itl_ms_p50': serving.get('itl_ms_p50'),
+            'itl_ms_p99': serving.get('itl_ms_p99'),
+            'sse_itl_ms_p50': pct(gaps, 0.50),
+            'sse_itl_ms_p99': pct(gaps, 0.99),
         }))
     finally:
         server.terminate()

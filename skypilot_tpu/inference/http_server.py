@@ -153,6 +153,15 @@ def make_server(rt: InferenceRuntime,
                     max(engine.decode_calls, 1), 3),
                 'speculative_k': engine.spec_k,
                 'preemptions': engine.preemptions,
+                # Stall-free scheduler: chunked-prefill + pipelining
+                # health (docs/guides.md serving-tuning section).
+                'prefill_chunk': engine.prefill_chunk,
+                'prefill_token_budget': engine.prefill_budget,
+                'pipeline_decode': engine.pipeline_decode,
+                'prefill_chunks_run': engine.prefill_chunks_run,
+                'prefill_backlog_tokens':
+                    engine.prefill_backlog_tokens(),
+                'decode_stall_s': round(engine.decode_stall_s, 4),
             })
             if engine.paged:
                 free = int(engine.allocator.free_pages)
@@ -291,15 +300,12 @@ def make_server(rt: InferenceRuntime,
             self.sse_start()
             n_gen = 0
             ttft = None
-            last_t = {}  # per-row previous-token instant (ITL)
+            # ITL is recorded at engine commit time by the handles'
+            # on_token (StreamHandle), not at SSE delivery.
             try:
                 for i, t in iter_interleaved(handles):
-                    now = time.monotonic()
                     if ttft is None:
-                        ttft = now - t0
-                    if i in last_t:
-                        rt.metrics.record_inter_token(now - last_t[i])
-                    last_t[i] = now
+                        ttft = time.monotonic() - t0
                     n_gen += 1
                     self.sse_send({'index': i, 'token': t})
             finally:
@@ -449,15 +455,10 @@ def make_server(rt: InferenceRuntime,
                      for _ in encoded]
             n_gen = 0
             ttft = None
-            last_t = {}  # per-row previous-token instant (ITL)
             try:
                 for i, t in iter_interleaved(handles):
-                    now = time.monotonic()
                     if ttft is None:
-                        ttft = now - t0
-                    if i in last_t:
-                        rt.metrics.record_inter_token(now - last_t[i])
-                    last_t[i] = now
+                        ttft = time.monotonic() - t0
                     n_gen += 1
                     if scans[i].hit:
                         continue

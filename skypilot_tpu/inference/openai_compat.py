@@ -335,16 +335,12 @@ def stream_completion(rt: InferenceRuntime, req: CompletionRequest,
     scans = [StopStringScanner(req.stop_strings) for _ in range(req.n)]
     n_gen = [0] * req.n
     ttft: Optional[float] = None
-    last_t: Dict[int, float] = {}  # per-choice previous token (ITL)
+    # ITL records at engine commit time (StreamHandle.on_token).
 
     try:
         for i, t in iter_interleaved(handles):
-            now = time.monotonic()
             if ttft is None:
-                ttft = now - t0
-            if i in last_t:
-                rt.metrics.record_inter_token(now - last_t[i])
-            last_t[i] = now
+                ttft = time.monotonic() - t0
             n_gen[i] += 1
             if scans[i].hit:
                 continue  # post-stop tokens: drop

@@ -68,7 +68,10 @@ class ServingMetrics:
 
     def record_inter_token(self, gap_s: float) -> None:
         """One gap between consecutive streamed tokens of a request
-        row (called from the SSE pump loops)."""
+        row, measured at ENGINE COMMIT time (StreamHandle.on_token on
+        the scheduler thread) — not at SSE frame delivery, which rides
+        pump-thread scheduling and TCP flush batching and can inflate
+        tail gaps by an order of magnitude under load."""
         with self._lock:
             self.itl_ms.append(gap_s * 1000.0)
         self.prom.inter_token_seconds.observe(gap_s)
@@ -99,8 +102,10 @@ class ServingMetrics:
             'window': self.window,
             'ttft_ms_p50': self._pct(ttft, 0.50),
             'ttft_ms_p95': self._pct(ttft, 0.95),
+            'ttft_ms_p99': self._pct(ttft, 0.99),
             'itl_ms_p50': self._pct(itl, 0.50),
             'itl_ms_p95': self._pct(itl, 0.95),
+            'itl_ms_p99': self._pct(itl, 0.99),
             'latency_ms_p50': self._pct(lat, 0.50),
             'latency_ms_p95': self._pct(lat, 0.95),
             'completion_tokens_total': sum(toks),
@@ -113,19 +118,28 @@ class StreamHandle:
     """Consumer side of one streaming request: committed tokens arrive
     on `q` (pushed from the engine scheduler thread); `future` resolves
     to the full prompt++generated list when the request finishes.
-    `first_token_s` latches the TTFT instant. Constructed BEFORE the
+    `first_token_s` latches the TTFT instant and consecutive commits
+    record inter-token gaps (the serving ITL signal, measured at the
+    commit itself rather than at SSE delivery). Constructed BEFORE the
     engine submit so the very first committed token always finds the
     queue (the scheduler thread races the submitting thread)."""
 
-    def __init__(self) -> None:
+    def __init__(self, metrics: Optional[ServingMetrics] = None
+                 ) -> None:
         self.q: 'queue.Queue' = queue.Queue()
         self.future: Optional['Future'] = None  # set right after submit
         self.t0 = time.monotonic()
         self.first_token_s: Optional[float] = None
+        self._metrics = metrics
+        self._last_token_t: Optional[float] = None
 
     def on_token(self, tok: int) -> None:
+        now = time.monotonic()
         if self.first_token_s is None:
-            self.first_token_s = time.monotonic() - self.t0
+            self.first_token_s = now - self.t0
+        elif self._metrics is not None:
+            self._metrics.record_inter_token(now - self._last_token_t)
+        self._last_token_t = now
         self.q.put(tok)
 
 
@@ -170,7 +184,10 @@ class InferenceRuntime:
                  speculative: int, engine=None,
                  engine_total: Optional[int] = None,
                  tokenizer_dir: Optional[str] = None,
-                 stream_slots: int = 2) -> None:
+                 stream_slots: int = 2,
+                 prefill_chunk: int = 0,
+                 prefill_budget: int = 0,
+                 pipeline_decode: Optional[bool] = None) -> None:
         import jax
         self.model = model
         self.params = params
@@ -197,6 +214,11 @@ class InferenceRuntime:
         self._stream_engine = None
         self._stream_engine_lock = threading.Lock()
         self._stream_slots = stream_slots
+        # Stall-free-scheduler knobs, reused by the lazy stream
+        # engine so one-shot-mode streaming gets the same behavior.
+        self._prefill_chunk = prefill_chunk
+        self._prefill_budget = prefill_budget
+        self._pipeline_decode = pipeline_decode
 
     # -- capacity -----------------------------------------------------------
     def limit_for(self, temperature: float,
@@ -325,7 +347,11 @@ class InferenceRuntime:
                     self.model, self.params,
                     num_slots=self._stream_slots,
                     max_total_len=self.engine_total,
-                    speculative_k=self.speculative)
+                    speculative_k=self.speculative,
+                    prefill_chunk=self._prefill_chunk,
+                    prefill_budget=self._prefill_budget,
+                    pipeline_decode=(None if self.speculative
+                                     else self._pipeline_decode))
             return self._stream_engine
 
     def submit_stream(self, ids: List[int], max_new: int,
@@ -334,7 +360,9 @@ class InferenceRuntime:
                       stop_token_ids: Optional[List[int]] = None
                       ) -> StreamHandle:
         eng = self.stream_engine()
-        handle = StreamHandle()  # queue must exist before submit
+        # Queue must exist before submit; commit-time ITL recording
+        # rides the same callback.
+        handle = StreamHandle(metrics=self.metrics)
         handle.future = eng.submit(
             ids, max_new_tokens=max_new, temperature=temperature,
             top_k=top_k, top_p=top_p, stop_token_ids=stop_token_ids,
@@ -466,6 +494,10 @@ def build_runtime(args) -> InferenceRuntime:
     engine_total = (spec_total if args.speculative > 0
                     else args.max_total_len)
     engine = None
+    prefill_chunk = getattr(args, 'prefill_chunk', 0)
+    prefill_budget = getattr(args, 'prefill_budget', 0)
+    pipeline_decode = (False if getattr(args, 'no_pipeline_decode',
+                                        False) else None)
     if args.continuous_batching:
         from skypilot_tpu.models.batching import ContinuousBatchingEngine
         decode_chunk = getattr(args, 'decode_chunk', 1)
@@ -488,7 +520,12 @@ def build_runtime(args) -> InferenceRuntime:
             max_total_len=engine_total,
             prefix_caching=not args.no_prefix_caching,
             speculative_k=args.speculative,
-            decode_chunk=decode_chunk)
+            decode_chunk=decode_chunk,
+            prefill_chunk=prefill_chunk,
+            prefill_budget=prefill_budget,
+            # Auto (None) keeps pipelining off for spec/decode-chunk
+            # engines; --no-pipeline-decode forces it off everywhere.
+            pipeline_decode=pipeline_decode)
 
     return InferenceRuntime(
         model=model, params=params, vocab_size=vocab_size,
@@ -497,4 +534,6 @@ def build_runtime(args) -> InferenceRuntime:
         max_total_len=args.max_total_len, spec_total=spec_total,
         speculative=args.speculative, engine=engine,
         engine_total=engine_total if engine is not None else None,
-        tokenizer_dir=tokenizer_dir)
+        tokenizer_dir=tokenizer_dir,
+        prefill_chunk=prefill_chunk, prefill_budget=prefill_budget,
+        pipeline_decode=pipeline_decode)

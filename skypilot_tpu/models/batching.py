@@ -13,6 +13,23 @@ prompt-lookup verify chunks instead of single tokens: every slot
 (greedy and sampled, paged and dense) commits 1..K+1 tokens per model
 call, exactly preserving the non-speculative output distribution.
 
+Two stall-free-scheduler mechanisms (Sarathi/vLLM split-fuse style):
+
+  - CHUNKED PREFILL (`prefill_chunk=C`): an admitted prompt's suffix
+    prefills in fixed C-token chunks (one compiled shape, plus small
+    power-of-two tails) under a per-iteration token budget, with
+    decode steps interleaved between chunks — one 4k-token prompt no
+    longer stalls every active decode slot for a whole forward pass,
+    and padding waste is bounded by the chunk, not a log2 bucket.
+  - PIPELINED DECODE (`pipeline_decode`): decode round N+1 is
+    dispatched (JAX async dispatch) BEFORE round N's tokens are
+    fetched and committed, so host-side stop-detection/streaming
+    overlaps device compute and the accelerator's dispatch queue
+    stays non-empty. Greedy outputs are token-for-token identical to
+    the unpipelined loop; lanes that finish mid-pipeline leave one
+    junk write past their last committed position (the same
+    write-before-read contract speculation relies on).
+
 Use via `ContinuousBatchingEngine.submit(prompt) -> Future`, or the
 HTTP server in recipes/serve_lm.py (--continuous-batching).
 """
@@ -31,6 +48,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from skypilot_tpu.models.generate import sample_tokens
 from skypilot_tpu.observability import catalog as _obs
 
 
@@ -146,7 +164,10 @@ class ContinuousBatchingEngine:
                  prefix_caching: bool = True,
                  speculative_k: int = 0, spec_ngram: int = 2,
                  spec_lookback: int = 512,
-                 decode_chunk: int = 1) -> None:
+                 decode_chunk: int = 1,
+                 prefill_chunk: int = 0,
+                 prefill_budget: int = 0,
+                 pipeline_decode: Optional[bool] = None) -> None:
         assert max_total_len <= model.config.max_seq_len
         # Chunked decode: N single-token steps in ONE jitted lax.scan
         # dispatch (the serving analog of the trainer's multi-step) —
@@ -180,6 +201,41 @@ class ContinuousBatchingEngine:
                     f'speculative_k={speculative_k} needs headroom: '
                     f'max_total_len({max_total_len}) + K must be <= '
                     f'max_seq_len({model.config.max_seq_len})')
+        # Chunked prefill: the admitted prompt's suffix runs in
+        # fixed-size chunks under a per-iteration token budget, with
+        # decode steps interleaved — instead of one whole-prompt
+        # forward pass that stalls every active decode slot.
+        # prefill_chunk=0 keeps the single-shot path (whole suffix in
+        # one log2-bucketed dispatch, budget unbounded).
+        if prefill_chunk < 0:
+            raise ValueError(
+                f'prefill_chunk must be >= 0, got {prefill_chunk}')
+        self.prefill_chunk = prefill_chunk
+        if prefill_chunk and 0 < prefill_budget < prefill_chunk:
+            raise ValueError(
+                f'prefill_budget={prefill_budget} < prefill_chunk='
+                f'{prefill_chunk}: the budget is spent in whole '
+                f'chunks, so no chunk could ever be issued')
+        # Effective tokens-per-iteration cap; default = one chunk per
+        # loop iteration (maximal decode interleaving).
+        self.prefill_budget = ((prefill_budget or prefill_chunk)
+                               if prefill_chunk else 0)
+        # One-step host/device pipelining: dispatch decode round N+1
+        # before committing round N, so stop-detection/streaming
+        # overlaps device compute. Composes with the PLAIN decode loop
+        # only — verify chunks and decode chunks already amortize
+        # dispatches and fetch multi-token results the host must
+        # reconcile synchronously. Auto mode (None) enables it exactly
+        # when the plain loop runs.
+        if pipeline_decode and (speculative_k or decode_chunk > 1):
+            raise ValueError(
+                'pipeline_decode composes with the plain decode loop '
+                'only; speculative_k and decode_chunk dispatch '
+                'multi-token rounds that are committed synchronously '
+                '(set pipeline_decode=None/False with those modes)')
+        self.pipeline_decode = (not speculative_k and decode_chunk == 1
+                                if pipeline_decode is None
+                                else bool(pipeline_decode))
         self.model = model
         self.params = params
         self.num_slots = num_slots
@@ -241,9 +297,19 @@ class ContinuousBatchingEngine:
         self.cache = self._fresh_cache()
 
         # Host-side slot bookkeeping (device work stays fixed-shape).
+        # A slot is OCCUPIED when `prefilling` (admitted, prompt
+        # suffix still being written into the cache chunk by chunk)
+        # or `active` (prefilled, riding the shared decode loop).
         self.cur_token = np.zeros((num_slots,), np.int32)
         self.pos = np.zeros((num_slots,), np.int32)
         self.active = np.zeros((num_slots,), bool)
+        self.prefilling = np.zeros((num_slots,), bool)
+        # Next prompt position the slot's prefill will write. While a
+        # slot prefills, `pos` tracks this frontier too, so the decode
+        # loop's junk write for the (inactive) lane lands at a
+        # position the NEXT chunk overwrites before attending.
+        self.prefill_frontier = np.zeros((num_slots,), np.int32)
+        self.prompt_len = np.zeros((num_slots,), np.int32)
         self.outputs: List[List[int]] = [[] for _ in range(num_slots)]
         self.futures: List[Optional[Future]] = [None] * num_slots
         self.limits = np.zeros((num_slots,), np.int32)
@@ -253,6 +319,11 @@ class ContinuousBatchingEngine:
         self.stop_ids: List[frozenset] = [frozenset()] * num_slots
         self.on_tokens: List[Optional[Callable[[int], None]]] = \
             [None] * num_slots
+        # Prefilling slots in admission order: the scheduler finishes
+        # the oldest admission's prefill first (FCFS — completing one
+        # prompt starts its decode sooner than round-robining all).
+        self._prefill_order: 'collections.deque' = collections.deque()
+        self._prefill_t0 = [0.0] * num_slots
 
         # Observability: model calls vs tokens committed (speculation
         # quality = tokens_committed / decode_calls, 1.0..K+1), and
@@ -261,6 +332,9 @@ class ContinuousBatchingEngine:
         self.decode_calls = 0
         self.tokens_committed = 0
         self.preemptions = 0
+        self.prefill_chunks_run = 0
+        self.decode_stall_s = 0.0        # host blocked on device_get
+        self.last_prefill_tokens = 0     # budget spent, last iteration
 
         self._chunk_decode = (self._make_chunk_decode_fn()
                               if self.decode_chunk > 1 else None)
@@ -275,9 +349,12 @@ class ContinuousBatchingEngine:
         # starve it (vLLM-style head-of-line blocking).
         self._ready: 'collections.deque' = collections.deque()
         self._rng = jax.random.PRNGKey(0)
-        self._prefill_fns: Dict[int, Any] = {}
+        self._prefill_fns: Dict[Any, Any] = {}
         self._decode = (self._make_spec_decode_fn() if self.spec_k
                         else self._make_decode_fn())
+        # Pipelined decode: the dispatched-but-not-committed round
+        # (device token array + the host state it was built from).
+        self._inflight: Optional[Dict[str, Any]] = None
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
@@ -336,7 +413,6 @@ class ContinuousBatchingEngine:
         @functools.partial(jax.jit, donate_argnums=(1,))
         def decode(params, cache, cur_token, pos, temps, top_ks,
                    top_ps, rng, page_indices=None):
-            from skypilot_tpu.models.generate import sample_tokens
             extra = {'page_indices': page_indices} if paged else {}
             logits, mutated = model.apply(
                 {'params': params, 'cache': cache},
@@ -365,7 +441,6 @@ class ContinuousBatchingEngine:
         @functools.partial(jax.jit, donate_argnums=(1,))
         def chunk_decode(params, cache, cur_token, pos, temps, top_ks,
                          top_ps, rng, page_indices=None):
-            from skypilot_tpu.models.generate import sample_tokens
             extra = {'page_indices': page_indices} if paged else {}
 
             def step(carry, _):
@@ -413,7 +488,6 @@ class ContinuousBatchingEngine:
                 {'params': params, 'cache': cache}, chunk,
                 positions=positions, decode=True, mutable=['cache'],
                 **extra)                                   # [B, K+1, V]
-            from skypilot_tpu.models.generate import sample_tokens
             out = sample_tokens(rng, logits, temps, top_ks, top_ps)
             return mutated['cache'], out
 
@@ -557,6 +631,50 @@ class ContinuousBatchingEngine:
         self._prefill_fns[key] = prefill_suffix
         return prefill_suffix
 
+    def _dense_suffix_fn(self, bucket_len: int):
+        """fn(params, cache, slot, suffix[P], suffix_len, offset)
+        -> (cache, last_logits): the dense-cache analog of
+        `_prefill_suffix_fn` for chunked prefill. Runs the chunk on
+        the slot's batch-1 cache row WITHOUT zeroing it (earlier
+        chunks' K/V are the history), prefill=False so attention
+        covers the full row through `offset` + the chunk itself
+        (the chunked-cache-attention path speculation uses), then
+        scatters the row back. Padded-tail writes land past the real
+        suffix and are overwritten before any later step attends them
+        (write-before-read)."""
+        key = ('dense_suffix', bucket_len)
+        if key in self._prefill_fns:
+            return self._prefill_fns[key]
+        model = self.model
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def dense_suffix(params, cache, slot, suffix, suffix_len,
+                         offset):
+            row = jax.tree.map(
+                lambda c: jax.lax.dynamic_slice_in_dim(c, slot, 1,
+                                                       axis=0)
+                if c.ndim else c, cache)
+            positions = (offset +
+                         jnp.arange(bucket_len,
+                                    dtype=jnp.int32))[None, :]
+            logits, mutated = model.apply(
+                {'params': params, 'cache': row},
+                suffix[None, :], positions=positions,
+                decode=True, mutable=['cache'], prefill=False)
+            row = mutated['cache']
+            last = jax.lax.dynamic_index_in_dim(
+                logits[0].astype(jnp.float32), suffix_len - 1, axis=0,
+                keepdims=False)
+            cache = jax.tree.map(
+                lambda big, small:
+                jax.lax.dynamic_update_slice_in_dim(big, small, slot,
+                                                    axis=0)
+                if big.ndim else small, cache, row)
+            return cache, last
+
+        self._prefill_fns[key] = dense_suffix
+        return dense_suffix
+
     # -- public API ---------------------------------------------------------
     def submit(self, prompt: List[int],
                max_new_tokens: int = 64,
@@ -609,7 +727,8 @@ class ContinuousBatchingEngine:
             cancels = self._cancel_requests
             self._cancel_requests = set()
         for slot in range(self.num_slots):
-            if self.active[slot] and self.futures[slot] in cancels:
+            if (self.active[slot] or self.prefilling[slot]) and \
+                    self.futures[slot] in cancels:
                 self._finish_slot(slot)
         # Requests still sitting in _queue (submitted after the last
         # _admit drain) must be swept too, or a disconnected client's
@@ -643,6 +762,7 @@ class ContinuousBatchingEngine:
                                      len(self._ready))
         self.metrics.active_slots.set(int(self.active.sum()))
         self.metrics.num_slots.set(self.num_slots)
+        self.metrics.prefill_backlog.set(self.prefill_backlog_tokens())
         if self.paged:
             free = int(self.allocator.free_pages)
             self.metrics.pages_free.set(free)
@@ -650,11 +770,20 @@ class ContinuousBatchingEngine:
 
     # -- scheduler loop -----------------------------------------------------
     def _loop(self) -> None:
+        """One iteration = admit (host-only) -> apply cancellations ->
+        up to `prefill_budget` tokens of chunked prefill -> one decode
+        round for the active slots. Long prompts therefore interleave
+        with decoding instead of stalling it; with pipelining the
+        decode round's host commit overlaps the NEXT round's device
+        compute."""
         while not self._stop.is_set():
             try:
                 progressed = self._admit()
                 self._apply_cancellations()
-                if self.active.any():
+                if self._prefill_order:
+                    self._prefill_work()
+                    progressed = True
+                if self.active.any() or self._inflight is not None:
                     t_step = time.perf_counter()
                     self._decode_step()
                     self.metrics.decode_step_seconds.observe(
@@ -677,6 +806,7 @@ class ContinuousBatchingEngine:
                 # serving.
                 import traceback
                 traceback.print_exc()
+                self._inflight = None
                 try:
                     self.cache = self._fresh_cache()
                 except Exception:  # pylint: disable=broad-except
@@ -685,9 +815,13 @@ class ContinuousBatchingEngine:
                     fut = self.futures[slot]
                     self.futures[slot] = None
                     self.active[slot] = False
+                    self.prefilling[slot] = False
                     self.on_tokens[slot] = None
                     if fut is not None:
                         fut.set_exception(e)
+                self._prefill_order.clear()
+                self.prefill_frontier[:] = 0
+                self.prompt_len[:] = 0
                 self.pos[:] = 0
                 self.cur_token[:] = 0
                 self.temps[:] = 0
@@ -703,20 +837,28 @@ class ContinuousBatchingEngine:
                     except queue.Empty:
                         break
 
+    def _occupied(self) -> 'np.ndarray':
+        return self.active | self.prefilling
+
     def _admit(self) -> bool:
+        """Drain ready requests into free slots: prefix-cache lookup +
+        page allocation + slot bookkeeping only — NO device work. The
+        prompt suffix is prefilled by `_prefill_work` (chunked, under
+        the token budget), which flips the slot PREFILLING -> active.
+        """
         admitted = False
         while True:
             try:
                 self._ready.append(self._queue.get_nowait())
             except queue.Empty:
                 break
-        while self._ready and not self.active.all():
+        while self._ready and not self._occupied().all():
             (prompt, max_new, temp, top_k, top_p, stops, on_token,
              fut) = self._ready.popleft()
             if max_new <= 0:
                 fut.set_result(list(prompt))  # nothing to generate
                 continue
-            slot = int(np.argmin(self.active))  # first free slot
+            slot = int(np.argmin(self._occupied()))  # first free slot
             plen = len(prompt)
             shared: List[int] = []
             keys: List[bytes] = []
@@ -767,59 +909,18 @@ class ContinuousBatchingEngine:
                     self.page_size
             else:
                 n_cached = 0
-            suffix_len = plen - n_cached
-            bucket = _bucket(suffix_len, self.max_total_len)
-            if self.paged and n_cached:
-                # The suffix chunk writes positions [n_cached,
-                # n_cached + bucket): cap the bucket so the padded
-                # tail cannot run past the page-table row —
-                # take_along_axis CLAMPS an out-of-range logical page
-                # to the last column, which is a REAL page holding the
-                # prompt tail, and the scatter would shred it.
-                bucket = min(bucket,
-                             self.pages_per_seq * self.page_size -
-                             n_cached)
-                assert bucket >= suffix_len
             # Claim the slot BEFORE any device work: if prefill raises,
             # the loop's exception handler finds (and fails) this
             # future instead of leaving the client hanging.
             self.futures[slot] = fut
-            suffix = prompt[n_cached:]
-            t_prefill = time.perf_counter()
-            padded = jnp.asarray(
-                suffix + [0] * (bucket - suffix_len), jnp.int32)
-            if self.paged and n_cached:
-                prefill = self._prefill_suffix_fn(bucket)
-                self.cache, last_logits = prefill(
-                    self.params, self.cache, padded,
-                    jnp.int32(suffix_len), jnp.int32(n_cached),
-                    jnp.asarray(self.page_table[slot:slot + 1]))
-            elif self.paged:
-                prefill = self._prefill_fn(bucket)
-                self.cache, last_logits = prefill(
-                    self.params, self.cache, padded, jnp.int32(plen),
-                    jnp.asarray(self.page_table[slot:slot + 1]))
-            else:
-                prefill = self._prefill_fn(bucket)
-                self.cache, last_logits = prefill(
-                    self.params, self.cache, jnp.int32(slot), padded,
-                    jnp.int32(plen))
-            if temp > 0:
-                from skypilot_tpu.models.generate import sample_tokens
-                self._rng, sub = jax.random.split(self._rng)
-                first = sample_tokens(
-                    sub, last_logits[None, :],
-                    jnp.full((1,), temp, jnp.float32),
-                    jnp.full((1,), top_k, jnp.int32),
-                    jnp.full((1,), top_p, jnp.float32))[0]
-            else:
-                first = jnp.argmax(last_logits)
-            self.cur_token[slot] = int(jax.device_get(first))
-            self.metrics.prefill_seconds.observe(
-                time.perf_counter() - t_prefill)
-            self.metrics.admissions.inc()
-            self.pos[slot] = plen
             self.outputs[slot] = list(prompt)
+            self.prompt_len[slot] = plen
+            self.prefill_frontier[slot] = n_cached
+            # While prefilling, `pos` rides the frontier: the decode
+            # loop's junk write for this inactive lane lands exactly
+            # where the NEXT prefill chunk writes (before attending).
+            self.pos[slot] = n_cached
+            self.cur_token[slot] = 0
             limit = min(plen + max_new, self.max_total_len)
             if self.paged:
                 # The pool bounds the deepest any sequence can get
@@ -834,9 +935,136 @@ class ContinuousBatchingEngine:
             self.top_ps[slot] = top_p
             self.stop_ids[slot] = stops
             self.on_tokens[slot] = on_token
-            self.active[slot] = True
+            self.prefilling[slot] = True
+            self._prefill_order.append(slot)
+            self._prefill_t0[slot] = time.perf_counter()
+            self.metrics.admissions.inc()
             admitted = True
         return admitted
+
+    # -- chunked prefill ----------------------------------------------------
+    def _chunk_shape(self, n: int, offset: int) -> int:
+        """Compiled shape for an n-real-token prefill chunk at
+        `offset`. Full chunks reuse the ONE prefill_chunk shape; the
+        final partial chunk (and the whole suffix when chunking is
+        off) buckets to a power of two, capped by the chunk size —
+        so the compile ladder is log2(prefill_chunk) shapes, not
+        log2(max_total_len)."""
+        cap = self.prefill_chunk or self.max_total_len
+        shape = min(_bucket(n, cap), cap)
+        if self.paged and offset:
+            # The chunk writes positions [offset, offset + shape):
+            # cap the shape so the padded tail cannot run past the
+            # page-table row — take_along_axis CLAMPS an out-of-range
+            # logical page to the last column, which is a REAL page
+            # holding the prompt tail, and the scatter would shred it.
+            shape = min(shape,
+                        self.pages_per_seq * self.page_size - offset)
+            assert shape >= n
+        return shape
+
+    def _run_prefill_chunk(self, slot: int, offset: int, n: int):
+        """Dispatch ONE prefill chunk: n real tokens of slot's prompt
+        at absolute position `offset`. Returns the (device) logits of
+        the chunk's last real token — the continuation samples from
+        them when this was the final chunk."""
+        shape = self._chunk_shape(n, offset)
+        chunk = self.outputs[slot][offset:offset + n]
+        padded = jnp.asarray(chunk + [0] * (shape - n), jnp.int32)
+        if self.paged and offset:
+            fn = self._prefill_suffix_fn(shape)
+            self.cache, last = fn(
+                self.params, self.cache, padded, jnp.int32(n),
+                jnp.int32(offset),
+                jnp.asarray(self.page_table[slot:slot + 1]))
+        elif self.paged:
+            fn = self._prefill_fn(shape)
+            self.cache, last = fn(
+                self.params, self.cache, padded, jnp.int32(n),
+                jnp.asarray(self.page_table[slot:slot + 1]))
+        elif offset:
+            fn = self._dense_suffix_fn(shape)
+            self.cache, last = fn(
+                self.params, self.cache, jnp.int32(slot), padded,
+                jnp.int32(n), jnp.int32(offset))
+        else:
+            fn = self._prefill_fn(shape)
+            self.cache, last = fn(
+                self.params, self.cache, jnp.int32(slot), padded,
+                jnp.int32(n))
+        self.prefill_chunks_run += 1
+        return last
+
+    def _sample_first(self, slot: int, last_logits):
+        """The continuation token from the final chunk's last-position
+        logits (device value; fetched in one batched device_get per
+        round by _prefill_work)."""
+        temp = float(self.temps[slot])
+        if temp > 0:
+            self._rng, sub = jax.random.split(self._rng)
+            return sample_tokens(
+                sub, last_logits[None, :],
+                jnp.full((1,), temp, jnp.float32),
+                jnp.full((1,), int(self.top_ks[slot]), jnp.int32),
+                jnp.full((1,), float(self.top_ps[slot]),
+                         jnp.float32))[0]
+        return jnp.argmax(last_logits)
+
+    def _prefill_work(self) -> None:
+        """Run at most `prefill_budget` suffix tokens of prefill, in
+        prefill_chunk-sized dispatches, oldest admission first. Slots
+        whose prompt completes sample their first token and join the
+        decode loop; the budget bounds how long any single iteration
+        defers the shared decode step (the anti-stall contract:
+        chunked prefill never runs a dispatch longer than one chunk).
+        With prefill_chunk=0 the whole suffix runs as ONE dispatch per
+        slot (the legacy path) and the budget is unbounded."""
+        budget = self.prefill_budget if self.prefill_chunk else None
+        spent = 0
+        done: List[Any] = []    # (slot, first-token device scalar)
+        while self._prefill_order:
+            slot = self._prefill_order[0]
+            plen = int(self.prompt_len[slot])
+            offset = int(self.prefill_frontier[slot])
+            n = plen - offset
+            if self.prefill_chunk:
+                n = min(n, self.prefill_chunk)
+            if budget is not None and spent + n > budget:
+                break   # budget spent: decode steps run first
+            t0 = time.perf_counter()
+            last = self._run_prefill_chunk(slot, offset, n)
+            self.metrics.prefill_chunk_seconds.observe(
+                time.perf_counter() - t0)
+            spent += n
+            offset += n
+            self.prefill_frontier[slot] = offset
+            self.pos[slot] = offset
+            if offset >= plen:
+                self._prefill_order.popleft()
+                done.append((slot, self._sample_first(slot, last)))
+        self.last_prefill_tokens = spent
+        if budget:
+            self.metrics.prefill_budget_utilization.set(
+                spent / budget)
+        if not done:
+            return
+        # ONE host/device sync for every prompt that completed this
+        # round (not one per admission).
+        firsts = jax.device_get([first for _, first in done])
+        for (slot, _), first in zip(done, firsts):
+            self.cur_token[slot] = int(first)
+            self.pos[slot] = int(self.prompt_len[slot])
+            self.prefilling[slot] = False
+            self.active[slot] = True
+            self.metrics.prefill_seconds.observe(
+                time.perf_counter() - self._prefill_t0[slot])
+
+    def prefill_backlog_tokens(self) -> int:
+        """Prompt-suffix tokens admitted but not yet prefilled (the
+        chunked-prefill backlog; racy-but-harmless numpy reads, like
+        the other scrape-time snapshots)."""
+        return int(((self.prompt_len - self.prefill_frontier) *
+                    self.prefilling).sum())
 
     def _grow_pages(self, lookahead: int = 1) -> None:
         """Before a decode step: every active slot about to write past
@@ -853,7 +1081,14 @@ class ContinuousBatchingEngine:
         for slot in range(self.num_slots):
             if not self.active[slot]:
                 continue
-            need_tokens = int(self.pos[slot]) + lookahead
+            # Clamp to the page-table row's capacity: the pipelined
+            # loop's trailing round can write ONE position past a
+            # finishing lane's limit, and legit writes never exceed
+            # the table (construction headroom) — an out-of-capacity
+            # junk write clamps into the lane's own released pages,
+            # which every next owner rewrites before attending.
+            need_tokens = min(int(self.pos[slot]) + lookahead,
+                              self.pages_per_seq * self.page_size)
             exhausted = False
             while int(self.allocated_tokens[slot]) < need_tokens:
                 # Allocation is logically contiguous: the next logical
@@ -948,8 +1183,20 @@ class ContinuousBatchingEngine:
         self.futures[slot] = None
         self.active[slot] = False
         self.on_tokens[slot] = None
+        was_prefilling = bool(self.prefilling[slot])
+        if was_prefilling:
+            # Cancelled mid-prefill: resolve with the prompt as-is
+            # (nothing was generated) and drop the pending chunks.
+            self.prefilling[slot] = False
+            try:
+                self._prefill_order.remove(slot)
+            except ValueError:
+                pass
         if self.paged:
-            self._release_slot_pages(slot, promote=True)
+            # Never promote a half-prefilled prompt's pages: pages
+            # past the frontier were not written yet and would poison
+            # the prefix cache.
+            self._release_slot_pages(slot, promote=not was_prefilling)
         if fut is not None:
             fut.set_result(list(self.outputs[slot]))
 
@@ -982,6 +1229,9 @@ class ContinuousBatchingEngine:
         if self.decode_chunk > 1:
             self._chunk_decode_step()
             return
+        if self.pipeline_decode:
+            self._pipelined_decode_step()
+            return
         self._rng, sub = jax.random.split(self._rng)
         extra = ()
         if self.paged:
@@ -991,19 +1241,100 @@ class ContinuousBatchingEngine:
             extra = (jnp.asarray(self.page_table),)
         # Inactive slots decode at position 0 as a no-op: dense caches
         # get their row scribbled at position 0 (zeroed on prefill);
-        # paged writes land in the trash page.
+        # paged writes land in the trash page. PREFILLING slots ride
+        # at their frontier, which the next chunk overwrites before
+        # attending.
         self.cache, sampled = self._decode(
             self.params, self.cache,
             jnp.asarray(self.cur_token), jnp.asarray(self.pos),
             jnp.asarray(self.temps), jnp.asarray(self.top_ks),
             jnp.asarray(self.top_ps), sub, *extra)
-        sampled = np.asarray(jax.device_get(sampled))
+        sampled = self._fetch_tokens(sampled)
         self.decode_calls += 1
         self.metrics.decode_steps.inc()
         for slot in range(self.num_slots):
             if not self.active[slot]:
                 continue
             self._commit_token(slot, int(sampled[slot]))
+
+    def _fetch_tokens(self, dev) -> 'np.ndarray':
+        """device_get with decode-stall accounting: the wall time the
+        host spends blocked here is exactly the serial host/device
+        bubble pipelining exists to hide."""
+        t0 = time.perf_counter()
+        out = np.asarray(jax.device_get(dev))
+        stall = time.perf_counter() - t0
+        self.decode_stall_s += stall
+        self.metrics.decode_stall_seconds.inc(stall)
+        return out
+
+    # -- pipelined decode ---------------------------------------------------
+    def _dispatch_round(self, inflight: Optional[Dict[str, Any]]
+                        ) -> Optional[Dict[str, Any]]:
+        """Dispatch the next decode round WITHOUT waiting for the
+        in-flight one: continuing lanes feed the in-flight round's
+        (device-resident) sampled tokens straight back as inputs —
+        no host round-trip — at position +1; lanes that joined since
+        (fresh prefills) take their host-side first token. A lane the
+        pending commit will retire gets a junk write one past its
+        last position (write-before-read keeps it harmless)."""
+        if self.paged:
+            # +1 lookahead when a round is still uncommitted: this
+            # dispatch writes at pos+1 for continuing lanes.
+            self._grow_pages(lookahead=2 if inflight is not None
+                             else 1)
+            if not self.active.any():
+                return None
+        if inflight is None:
+            cur = jnp.asarray(self.cur_token)
+            pos = self.pos.copy()
+        else:
+            cont = np.array(
+                [bool(inflight['mask'][s]) and bool(self.active[s])
+                 and self.futures[s] is inflight['futs'][s]
+                 for s in range(self.num_slots)])
+            pos = np.where(cont, inflight['pos'] + 1,
+                           self.pos).astype(np.int32)
+            cur = jnp.where(jnp.asarray(cont), inflight['sampled'],
+                            jnp.asarray(self.cur_token))
+        extra = (jnp.asarray(self.page_table),) if self.paged else ()
+        self._rng, sub = jax.random.split(self._rng)
+        self.cache, sampled = self._decode(
+            self.params, self.cache, cur, jnp.asarray(pos),
+            jnp.asarray(self.temps), jnp.asarray(self.top_ks),
+            jnp.asarray(self.top_ps), sub, *extra)
+        self.decode_calls += 1
+        self.metrics.decode_steps.inc()
+        return {'sampled': sampled, 'mask': self.active.copy(),
+                'pos': pos, 'futs': list(self.futures)}
+
+    def _commit_round(self, inflight: Dict[str, Any]) -> None:
+        """Fetch + commit a dispatched round. Lanes whose request
+        finished, was preempted, or was replaced since dispatch are
+        discarded (their round-N+1 token belongs to nobody)."""
+        sampled = self._fetch_tokens(inflight['sampled'])
+        for slot in range(self.num_slots):
+            if not inflight['mask'][slot]:
+                continue
+            if not self.active[slot] or \
+                    self.futures[slot] is not inflight['futs'][slot]:
+                continue
+            self._commit_token(slot, int(sampled[slot]))
+
+    def _pipelined_decode_step(self) -> None:
+        """One pipelined iteration: dispatch round N+1 FIRST (device
+        starts computing), then fetch + commit round N while N+1 runs
+        — stop-detection, streaming callbacks, and future resolution
+        all overlap device compute. Greedy outputs are token-for-token
+        the unpipelined loop's: committed tokens come from the same
+        round sequence; only the trailing round after a drain is
+        speculative waste."""
+        inflight = self._inflight
+        nxt = self._dispatch_round(inflight) if self.active.any() \
+            else None
+        if inflight is not None:
+            self._commit_round(inflight)
+        self._inflight = nxt
 
     def _chunk_decode_step(self) -> None:
         """One chunked round: decode_chunk tokens for every active
@@ -1025,7 +1356,7 @@ class ContinuousBatchingEngine:
             jnp.asarray(self.pos), jnp.asarray(self.temps),
             jnp.asarray(self.top_ks), jnp.asarray(self.top_ps),
             self._rng, *extra)
-        toks = np.asarray(jax.device_get(toks))       # [n, slots]
+        toks = self._fetch_tokens(toks)               # [n, slots]
         self.decode_calls += 1
         self.metrics.decode_steps.inc()
         for slot in range(self.num_slots):
@@ -1058,7 +1389,7 @@ class ContinuousBatchingEngine:
             jnp.asarray(self.pos), jnp.asarray(self.temps),
             jnp.asarray(self.top_ks), jnp.asarray(self.top_ps), sub,
             *extra)
-        y = np.asarray(jax.device_get(y))              # [slots, K+1]
+        y = self._fetch_tokens(y)                      # [slots, K+1]
         self.decode_calls += 1
         self.metrics.decode_steps.inc()
         for slot in range(self.num_slots):

@@ -56,9 +56,27 @@ SPECS: Dict[str, Tuple] = {
                      'host commit)', ('engine',),
         {'buckets': STEP_BUCKETS}),
     'skypilot_serving_prefill_seconds': (
-        'histogram', 'Wall time of one admission prefill (bucketed '
-                     'prompt forward pass)', ('engine',),
+        'histogram', 'Wall time from a request\'s first prefill '
+                     'chunk dispatch to its first token (whole-prompt '
+                     'prefill when chunking is off)', ('engine',),
         {'buckets': STEP_BUCKETS}),
+    'skypilot_serving_prefill_chunk_seconds': (
+        'histogram', 'Wall time of one chunked-prefill dispatch '
+                     '(async dispatch cost, not device compute — the '
+                     'stall-free scheduler never waits on prefill)',
+        ('engine',), {'buckets': STEP_BUCKETS}),
+    'skypilot_serving_prefill_backlog_tokens': (
+        'gauge', 'Prompt-suffix tokens admitted into a slot but not '
+                 'yet prefilled (chunked-prefill backlog)',
+        ('engine',)),
+    'skypilot_serving_prefill_budget_utilization': (
+        'gauge', 'Prefill tokens run last iteration / per-iteration '
+                 'token budget (0..1)', ('engine',)),
+    'skypilot_serving_decode_stall_seconds_total': (
+        'counter', 'Cumulative wall time the scheduler host blocked '
+                   'on fetching decode tokens from the device '
+                   '(pipelining hides this behind the next dispatch)',
+        ('engine',)),
     'skypilot_serving_pages_free': (
         'gauge', 'Free pages in the shared KV page pool', ('engine',)),
     'skypilot_serving_pages_used': (
@@ -175,6 +193,16 @@ class EngineMetrics:
             'skypilot_serving_decode_step_seconds').labels(**lab)
         self.prefill_seconds = histogram(
             'skypilot_serving_prefill_seconds').labels(**lab)
+        self.prefill_chunk_seconds = histogram(
+            'skypilot_serving_prefill_chunk_seconds').labels(**lab)
+        self.prefill_backlog = gauge(
+            'skypilot_serving_prefill_backlog_tokens').labels(**lab)
+        self.prefill_budget_utilization = gauge(
+            'skypilot_serving_prefill_budget_utilization').labels(
+                **lab)
+        self.decode_stall_seconds = counter(
+            'skypilot_serving_decode_stall_seconds_total').labels(
+                **lab)
         self.pages_free = gauge(
             'skypilot_serving_pages_free').labels(**lab)
         self.pages_used = gauge(

@@ -58,6 +58,30 @@ def main() -> None:
                              'Trade-off: up to N-1 wasted steps per '
                              'finishing request, admission at chunk '
                              'boundaries. Exclusive with --speculative')
+    parser.add_argument('--prefill-chunk', type=int, default=256,
+                        metavar='C',
+                        help='continuous engine: chunked prefill — '
+                             'admitted prompts prefill in C-token '
+                             'chunks interleaved with decode steps '
+                             '(one compiled shape instead of a log2 '
+                             'bucket ladder), so one long prompt '
+                             'cannot stall every active decode slot. '
+                             '0 = whole-prompt prefill (the legacy '
+                             'synchronous path)')
+    parser.add_argument('--prefill-budget', type=int, default=0,
+                        metavar='T',
+                        help='max prefill tokens run per scheduler '
+                             'iteration (chunked prefill only). '
+                             'Default 0 = one chunk per iteration — '
+                             'maximal decode interleaving; raise it '
+                             'to favor time-to-first-token over '
+                             'inter-token latency')
+    parser.add_argument('--no-pipeline-decode', action='store_true',
+                        help='disable one-step host/device decode '
+                             'pipelining (dispatch round N+1 before '
+                             'committing round N). On by default for '
+                             'the plain decode loop; greedy outputs '
+                             'are identical either way')
     parser.add_argument('--speculative', type=int, default=0,
                         metavar='K',
                         help='prompt-lookup speculative decoding with K '

@@ -47,6 +47,17 @@ def _build_model(name: str, seq: int, remat: bool):
     if name == 'llama-tiny':
         from skypilot_tpu.models.llama import Llama, LlamaConfig
         cfg = LlamaConfig.tiny(remat=remat)
+        if seq > cfg.max_seq_len:
+            # Long-context runs on the tiny model (serving benchmarks
+            # exercising long-prompt regimes): params are seq-length
+            # independent (RoPE is computed from positions), so grow
+            # the context and scale the KV page pool to keep the same
+            # full-depth slot coverage.
+            import dataclasses
+            grow = -(-seq // cfg.max_seq_len)
+            cfg = dataclasses.replace(
+                cfg, max_seq_len=seq,
+                kv_total_pages=cfg.kv_total_pages * grow)
         return Llama(cfg), cfg.vocab_size, None
     if name == 'mixtral-8x7b':
         from skypilot_tpu.models.mixtral import (Mixtral, MixtralConfig,
