@@ -79,6 +79,22 @@ def main() -> None:
     parser.add_argument('--no-pipeline-decode', action='store_true',
                         help='forwarded to serve_lm (disables '
                              'host/device decode pipelining)')
+    parser.add_argument('--fault-plan', default=None, metavar='JSON',
+                        help='forwarded to serve_lm --fault-plan '
+                             '(inline JSON or a file path): run the '
+                             'workload under injected faults and A/B '
+                             'the JSON line against a clean run')
+    parser.add_argument('--request-timeout', type=float, default=None,
+                        help='forwarded to serve_lm '
+                             '--request-timeout')
+    parser.add_argument('--max-queue-requests', type=int, default=None,
+                        help='forwarded to serve_lm '
+                             '--max-queue-requests (shed + 429 when '
+                             'saturated; shed count lands in the '
+                             'JSON line)')
+    parser.add_argument('--max-queue-tokens', type=int, default=None,
+                        help='forwarded to serve_lm '
+                             '--max-queue-tokens')
     parser.add_argument('--repetitive', action='store_true',
                         help='structured (repeated-trigram) prompts — '
                              'the regime speculation accelerates')
@@ -119,6 +135,14 @@ def main() -> None:
         cmd += ['--prefill-budget', str(args.prefill_budget)]
     if args.no_pipeline_decode:
         cmd += ['--no-pipeline-decode']
+    if args.fault_plan:
+        cmd += ['--fault-plan', args.fault_plan]
+    if args.request_timeout is not None:
+        cmd += ['--request-timeout', str(args.request_timeout)]
+    if args.max_queue_requests is not None:
+        cmd += ['--max-queue-requests', str(args.max_queue_requests)]
+    if args.max_queue_tokens is not None:
+        cmd += ['--max-queue-tokens', str(args.max_queue_tokens)]
     if args.hf:
         cmd += ['--hf', args.hf]
     if args.ckpt_dir:
@@ -198,6 +222,7 @@ def main() -> None:
 
         latencies = []
         itl_gaps = []    # inter-token gaps across ALL requests (s)
+        shed = [0]       # client-observed 429s (admission control)
         lock = threading.Lock()
         queue = list(enumerate(prompts))
 
@@ -221,6 +246,14 @@ def main() -> None:
                         'max_new_tokens': args.max_new_tokens,
                         'stream': True}, timeout=600,
                         stream=True) as resp:
+                    if resp.status_code == 429:
+                        # Shed by admission control: count it and move
+                        # on (a production client would honor
+                        # Retry-After; the bench measures degradation,
+                        # not retries).
+                        with lock:
+                            shed[0] += 1
+                        continue
                     resp.raise_for_status()
                     for raw in resp.iter_lines():
                         if not raw.startswith(b'data: '):
@@ -255,8 +288,8 @@ def main() -> None:
         # engine's token COMMIT, the signal chunked prefill targets —
         # client-side SSE gap timing rides TCP flush batching and
         # client GIL scheduling, which can swamp ms-scale effects.
-        serving = requests.get(f'{url}/stats',
-                               timeout=30).json()['serving']
+        stats = requests.get(f'{url}/stats', timeout=30).json()
+        serving = stats['serving']
 
         def pct(sorted_vals, q):
             if not sorted_vals:
@@ -278,15 +311,24 @@ def main() -> None:
             'requests': len(latencies),
             'concurrency': args.concurrency,
             'req_per_sec': round(len(latencies) / elapsed, 2),
-            'p50_ttft_ms': round(
-                1000 * statistics.median(ttfts), 1),
-            'p95_ttft_ms': round(
-                1000 * ttfts[int(0.95 * (len(ttfts) - 1))], 1),
+            'p50_ttft_ms': (round(1000 * statistics.median(ttfts), 1)
+                            if ttfts else None),
+            'p95_ttft_ms': (round(
+                1000 * ttfts[int(0.95 * (len(ttfts) - 1))], 1)
+                if ttfts else None),
             'p99_ttft_ms': pct(ttfts, 0.99),
             'itl_ms_p50': serving.get('itl_ms_p50'),
             'itl_ms_p99': serving.get('itl_ms_p99'),
             'sse_itl_ms_p50': pct(gaps, 0.50),
             'sse_itl_ms_p99': pct(gaps, 0.99),
+            # Robustness plane: degradation under --fault-plan /
+            # admission control is A/B-able from the same JSON line.
+            'fault_plan': bool(args.fault_plan),
+            'shed_requests': shed[0],
+            'server_requests_shed': serving.get('requests_shed'),
+            'server_deadline_exceeded':
+                serving.get('deadline_exceeded'),
+            'engine_restarts': stats.get('engine_restarts'),
         }))
     finally:
         server.terminate()

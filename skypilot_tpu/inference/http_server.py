@@ -39,6 +39,38 @@ from skypilot_tpu.inference.runtime import (InferenceRuntime,
                                             iter_interleaved)
 from skypilot_tpu.observability import REGISTRY
 from skypilot_tpu.observability import catalog as obs_catalog
+from skypilot_tpu.robustness import faults
+from skypilot_tpu.robustness.errors import (DeadlineExceededError,
+                                            EngineDeadError,
+                                            QueueSaturatedError)
+
+
+def classify_error(e: Exception):
+    """(http_status, retry_after_s) for a request-path exception: the
+    robustness taxonomy (429 shed / 504 deadline / 503 engine dead)
+    ahead of the 400 catch-all."""
+    if isinstance(e, QueueSaturatedError):
+        return 429, e.retry_after_s
+    if isinstance(e, DeadlineExceededError):
+        return 504, None
+    if isinstance(e, EngineDeadError):
+        return 503, None
+    return 400, None
+
+
+def _submit_all(engine, rows: List[List[int]], **kw):
+    """Submit one request's rows; if submission k is shed (bounded
+    queue filled mid-batch), cancel the k-1 already-submitted rows —
+    they would decode for a client that is getting a 429."""
+    futs = []
+    try:
+        for row in rows:
+            futs.append(engine.submit(row, **kw))
+    except Exception:
+        if futs:
+            engine.cancel(futs)
+        raise
+    return futs
 
 
 def make_server(rt: InferenceRuntime,
@@ -53,6 +85,10 @@ def make_server(rt: InferenceRuntime,
     # between accept and engine submit and the one-shot engine).
     _inflight = {'n': 0}
     _inflight_lock = threading.Lock()
+    # Rolling-update drain: set before the accept loop stops, so
+    # /readyz flips to 503 while in-flight requests finish (k8s
+    # readiness probes pull the replica out of rotation first).
+    _draining = threading.Event()
 
     class Handler(BaseHTTPRequestHandler):
 
@@ -60,11 +96,13 @@ def make_server(rt: InferenceRuntime,
             pass
 
         # -- writer surface (also used by openai_compat) ------------
-        def _json(self, obj, code=200):
+        def _json(self, obj, code=200, headers=None):
             body = json.dumps(obj).encode()
             self.send_response(code)
             self.send_header('Content-Type', 'application/json')
             self.send_header('Content-Length', str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
@@ -90,6 +128,16 @@ def make_server(rt: InferenceRuntime,
 
         # -- GET ----------------------------------------------------
         def do_GET(self):  # noqa: N802
+            if self.path == '/healthz':
+                # Liveness: the process is up and serving HTTP. Never
+                # reflects load or drains — k8s restarts on liveness
+                # failure, and restarting a merely-busy replica is
+                # how overload cascades start.
+                self._json({'status': 'alive'})
+                return
+            if self.path == '/readyz':
+                self._readyz()
+                return
             if self.path in ('/stats', '/v1/stats'):
                 self._stats()
                 return
@@ -112,6 +160,23 @@ def make_server(rt: InferenceRuntime,
                         'vocab_size': rt.vocab_size,
                         'max_total_len': min(rt.limit_for(0.0),
                                              rt.limit_for(1.0))})
+
+        def _readyz(self):
+            """Readiness: should this replica receive NEW traffic?
+            503 while draining (SIGTERM received), when an engine's
+            scheduler thread died, or when the bounded queue is
+            saturated — each with the reason, so `kubectl describe`
+            (or a curl) says WHY the replica left rotation."""
+            reasons = []
+            if _draining.is_set():
+                reasons.append('draining')
+            for eng in rt.live_engines():
+                if not eng.healthy():
+                    reasons.append('engine dead')
+                if eng.saturated():
+                    reasons.append('queue saturated')
+            self._json({'ready': not reasons, 'reasons': reasons},
+                       200 if not reasons else 503)
 
         def _prometheus_metrics(self):
             """Prometheus text exposition of the process registry.
@@ -162,6 +227,15 @@ def make_server(rt: InferenceRuntime,
                 'prefill_backlog_tokens':
                     engine.prefill_backlog_tokens(),
                 'decode_stall_s': round(engine.decode_stall_s, 4),
+                # Robustness plane (docs/guides.md serving-robustness
+                # section): shedding, deadlines, crash containment.
+                'healthy': engine.healthy(),
+                'requests_shed': engine.requests_shed,
+                'deadline_exceeded': engine.deadline_exceeded,
+                'engine_restarts': engine.engine_restarts,
+                'queued_tokens': engine.queued_tokens(),
+                'max_queue_requests': engine.max_queue_requests,
+                'max_queue_tokens': engine.max_queue_tokens,
             })
             if engine.paged:
                 free = int(engine.allocator.free_pages)
@@ -200,6 +274,8 @@ def make_server(rt: InferenceRuntime,
             return json.loads(self.rfile.read(length))
 
         def _do_post(self):
+            if faults.point('http.handler') is faults.DROP:
+                return  # injected blackhole: client sees a hang/reset
             if self.path == '/v1/completions':
                 self._openai_completions()
                 return
@@ -225,6 +301,7 @@ def make_server(rt: InferenceRuntime,
                 stop_ids = [int(t) for t in
                             req.get('stop_token_ids', [])]
                 stream = bool(req.get('stream'))
+                deadline_s = rt.deadline_for(req)
                 limit = rt.limit_for(temperature, streaming=stream)
                 for row in tokens:
                     if len(row) >= limit:
@@ -235,7 +312,8 @@ def make_server(rt: InferenceRuntime,
                                       rt.engine_total))
                 if stream:
                     self._generate_stream(tokens, max_new, temperature,
-                                          top_k, top_p, stop_ids)
+                                          top_k, top_p, stop_ids,
+                                          deadline_s)
                     return
                 t0 = time.monotonic()
                 ttft = None
@@ -246,13 +324,18 @@ def make_server(rt: InferenceRuntime,
                     # token (any row) — non-streaming requests get
                     # real TTFT too, not just streamed ones.
                     latch = obs_catalog.FirstTokenLatch()
-                    futs = [rt.engine.submit(
-                        [int(t) for t in row], max_new_tokens=max_new,
+                    futs = _submit_all(
+                        rt.engine,
+                        [[int(t) for t in row] for row in tokens],
+                        max_new_tokens=max_new,
                         temperature=temperature, top_k=top_k,
                         top_p=top_p, stop_token_ids=stop_ids,
-                        on_token=latch)
-                        for row in tokens]
-                    rows = [f.result(timeout=600) for f in futs]
+                        on_token=latch, deadline_s=deadline_s)
+                    # The engine's deadline sweep resolves expired
+                    # futures with DeadlineExceededError (-> 504); the
+                    # host-side timeout is only a backstop.
+                    rows = [f.result(timeout=deadline_s + 30.0)
+                            for f in futs]
                     ttft = latch.first_token_s
                 else:
                     import jax
@@ -277,7 +360,20 @@ def make_server(rt: InferenceRuntime,
             except Exception as e:  # pylint: disable=broad-except
                 self._plain_error(e)
 
+        def _robustness_accounting(self, e: Exception):
+            """(code, headers) for a failed request + the shed /
+            deadline counters (window stats + Prometheus)."""
+            code, retry_after = classify_error(e)
+            if code == 429:
+                rt.metrics.record_shed()
+            elif code == 504:
+                rt.metrics.record_deadline_exceeded()
+            headers = ({'Retry-After': str(max(1, int(retry_after)))}
+                       if retry_after is not None else None)
+            return code, headers
+
         def _plain_error(self, e: Exception):
+            code, headers = self._robustness_accounting(e)
             if getattr(self, '_sse_open', False):
                 # Mid-stream failure: headers are out; close the
                 # stream (the client sees truncation, not a reset).
@@ -286,16 +382,18 @@ def make_server(rt: InferenceRuntime,
                 except Exception:  # pylint: disable=broad-except  # stpu: ignore[SKY005] — closing an already-broken stream; client is gone
                     pass
                 return
-            self._json({'error': f'{type(e).__name__}: {e}'}, 400)
+            self._json({'error': f'{type(e).__name__}: {e}'}, code,
+                       headers=headers)
 
         def _generate_stream(self, tokens, max_new, temperature,
-                             top_k, top_p, stop_ids):
+                             top_k, top_p, stop_ids, deadline_s):
             """SSE of {"index": row, "token": id} events, one per
             committed token across all rows, interleaved by arrival."""
             t0 = time.monotonic()
             handles = [rt.submit_stream(
                 [int(t) for t in row], max_new, temperature,
-                top_k=top_k, top_p=top_p, stop_token_ids=stop_ids)
+                top_k=top_k, top_p=top_p, stop_token_ids=stop_ids,
+                deadline_s=deadline_s)
                 for row in tokens]
             self.sse_start()
             n_gen = 0
@@ -336,7 +434,8 @@ def make_server(rt: InferenceRuntime,
                     n=int(body.get('n', 1)),
                     stream=bool(body.get('stream')),
                     logprobs=body.get('logprobs'),
-                    echo=bool(body.get('echo')))
+                    echo=bool(body.get('echo')),
+                    deadline_s=rt.deadline_for(body))
                 if req.stream:
                     oai.stream_completion(rt, req, self)
                 else:
@@ -361,7 +460,8 @@ def make_server(rt: InferenceRuntime,
                     stop_strings=body.get('stop') or [],
                     n=int(body.get('n', 1)),
                     stream=bool(body.get('stream')),
-                    logprobs=chat_lp)
+                    logprobs=chat_lp,
+                    deadline_s=rt.deadline_for(body))
                 if req.stream:
                     oai.stream_completion(rt, req, self, chat=True)
                 else:
@@ -371,6 +471,7 @@ def make_server(rt: InferenceRuntime,
                 self._oai_error(e)
 
         def _oai_error(self, e: Exception):
+            code, headers = self._robustness_accounting(e)
             if getattr(self, '_sse_open', False):
                 # Headers already sent: the OpenAI stream contract has
                 # no in-band error frame; close the stream.
@@ -379,9 +480,13 @@ def make_server(rt: InferenceRuntime,
                 except Exception:  # pylint: disable=broad-except  # stpu: ignore[SKY005] — closing an already-broken stream; client is gone
                     pass
                 return
+            err_type = {429: 'rate_limit_exceeded',
+                        503: 'service_unavailable',
+                        504: 'timeout'}.get(code,
+                                            'invalid_request_error')
             self._json({'error': {
                 'message': f'{type(e).__name__}: {e}',
-                'type': 'invalid_request_error'}}, 400)
+                'type': err_type}}, code, headers=headers)
 
         def _generate_text(self):
             try:
@@ -398,6 +503,7 @@ def make_server(rt: InferenceRuntime,
                     stop_strings = [stop_strings]
                 max_new = int(req.get('max_new_tokens', 64))
                 stream = bool(req.get('stream'))
+                deadline_s = rt.deadline_for(req)
                 encoded = [tok(p)['input_ids'] for p in prompts]
                 limit = rt.limit_for(temperature, streaming=stream)
                 for ids in encoded:
@@ -408,18 +514,19 @@ def make_server(rt: InferenceRuntime,
                 if stream:
                     self._generate_text_stream(
                         encoded, max_new, temperature, top_k, top_p,
-                        stop_strings)
+                        stop_strings, deadline_s)
                     return
                 t0 = time.monotonic()
                 ttft = None
                 if rt.engine is not None:
                     latch = obs_catalog.FirstTokenLatch()
-                    futs = [rt.engine.submit(
-                        ids, max_new_tokens=max_new,
+                    futs = _submit_all(
+                        rt.engine, encoded, max_new_tokens=max_new,
                         temperature=temperature, top_k=top_k,
-                        top_p=top_p, on_token=latch)
-                        for ids in encoded]
-                    rows = [f.result(timeout=600) for f in futs]
+                        top_p=top_p, on_token=latch,
+                        deadline_s=deadline_s)
+                    rows = [f.result(timeout=deadline_s + 30.0)
+                            for f in futs]
                     ttft = latch.first_token_s
                 else:
                     rows = rt.one_shot_rows(encoded, max_new,
@@ -441,13 +548,14 @@ def make_server(rt: InferenceRuntime,
 
         def _generate_text_stream(self, encoded: List[List[int]],
                                   max_new, temperature, top_k, top_p,
-                                  stop_strings):
+                                  stop_strings, deadline_s):
             """SSE of {"index": i, "delta": text} events (incremental
             detokenization + stop-string holdback per row)."""
             tok = rt.get_tokenizer()
             t0 = time.monotonic()
             handles = [rt.submit_stream(ids, max_new, temperature,
-                                        top_k=top_k, top_p=top_p)
+                                        top_k=top_k, top_p=top_p,
+                                        deadline_s=deadline_s)
                        for ids in encoded]
             self.sse_start()
             decs = [oai.IncrementalDecoder(tok) for _ in encoded]
@@ -482,44 +590,56 @@ def make_server(rt: InferenceRuntime,
     server = ThreadingHTTPServer(('0.0.0.0', port), Handler)
     server.inflight = _inflight            # type: ignore[attr-defined]
     server.inflight_lock = _inflight_lock  # type: ignore[attr-defined]
+    server.draining = _draining            # type: ignore[attr-defined]
     return server
+
+
+def drain(server: ThreadingHTTPServer, rt: InferenceRuntime,
+          drain_grace: float, straggler_grace: float = 0.5,
+          exit_fn=os._exit) -> None:
+    """Graceful drain: flip /readyz to 503 (readiness probes pull the
+    replica out of rotation), let the accept loop pick up stragglers
+    for `straggler_grace`, stop accepting, wait for in-flight POSTs
+    (bounded by `drain_grace`), exit 0 — a mid-generation client must
+    not see a reset because the controller culled this replica.
+    `exit_fn` is injectable so the drain contract is testable without
+    killing the test process."""
+    server.draining.set()
+    print('serve_lm: SIGTERM — draining in-flight requests',
+          flush=True)
+    time.sleep(straggler_grace)  # stragglers: accept loop gets them
+    server.shutdown()   # stops accepting; handlers keep running
+    deadline = time.monotonic() + drain_grace
+    while time.monotonic() < deadline:
+        with server.inflight_lock:
+            if server.inflight['n'] == 0:
+                break
+        time.sleep(0.05)
+    rt.stop()
+    # exit_fn defaults to os._exit: skip the XLA C++ teardown
+    # entirely — destructor ordering under an in-flight device stream
+    # SIGABRTs nondeterministically (the drain is complete; there is
+    # nothing left to clean up).
+    exit_fn(0)
 
 
 def serve(rt: InferenceRuntime, port: int,
           drain_grace: float = 630.0) -> None:
     """Run the HTTP server until killed. `drain_grace` bounds the
-    SIGTERM drain wait; it defaults ABOVE the 600s request future
-    timeout so a worst-case in-flight generation still completes —
+    SIGTERM drain wait; it defaults ABOVE the 600s request-timeout
+    default so a worst-case in-flight generation still completes —
     requests longer than the grace window are dropped at exit."""
     server = make_server(rt, port)
 
     _term = threading.Event()
 
     def _drain_loop():
-        """Graceful drain on SIGTERM: let the accept loop pick up
-        stragglers briefly, stop accepting, wait for in-flight POSTs
-        (bounded by drain_grace), exit 0 — a mid-generation client
-        must not see a reset because the controller culled this
-        replica. All work happens on this pre-started thread; the
+        """All drain work happens on this pre-started thread; the
         signal handler only sets an event (anything heavier in the
         signal frame proved crash-prone against the XLA runtime's own
         thread machinery)."""
         _term.wait()
-        print('serve_lm: SIGTERM — draining in-flight requests',
-              flush=True)
-        time.sleep(0.5)     # stragglers: normal accept loop gets them
-        server.shutdown()   # stops accepting; handlers keep running
-        deadline = time.time() + drain_grace
-        while time.time() < deadline:
-            with server.inflight_lock:
-                if server.inflight['n'] == 0:
-                    break
-            time.sleep(0.2)
-        rt.stop()
-        # Skip the XLA C++ teardown entirely: destructor ordering
-        # under an in-flight device stream SIGABRTs nondeterministically
-        # (the drain is complete; there is nothing left to clean up).
-        os._exit(0)
+        drain(server, rt, drain_grace)
 
     threading.Thread(target=_drain_loop, daemon=True).start()
     signal.signal(signal.SIGTERM, lambda *_: _term.set())

@@ -131,7 +131,8 @@ class CompletionRequest:
                  temperature: float, top_p: float,
                  stop_strings, n: int, stream: bool,
                  logprobs: Optional[int] = None,
-                 echo: bool = False) -> None:
+                 echo: bool = False,
+                 deadline_s: float = 600.0) -> None:
         if isinstance(stop_strings, str):
             stop_strings = [stop_strings]
         if n < 1 or n > 16:
@@ -158,6 +159,10 @@ class CompletionRequest:
         self.stream = stream
         self.logprobs = logprobs
         self.echo = echo
+        # Per-request deadline, seconds (the server clamps the body's
+        # `timeout` field into (0, --request-timeout]); propagated to
+        # engine slots so an expired request is reaped mid-decode.
+        self.deadline_s = float(deadline_s)
 
 
 def _logprobs_block(rt: InferenceRuntime, tok, row: List[int],
@@ -221,14 +226,23 @@ def run_completion(rt: InferenceRuntime, req: CompletionRequest
         from skypilot_tpu.observability.catalog import FirstTokenLatch
         latch = FirstTokenLatch()  # non-streaming TTFT: first commit
         futs = []
-        for ids in encoded:
-            for _ in range(req.n):
-                futs.append(rt.engine.submit(
-                    ids, max_new_tokens=req.max_new,
-                    temperature=req.temperature, top_p=req.top_p,
-                    on_token=latch))
-                row_prompt.append(ids)
-        rows = [f.result(timeout=600) for f in futs]
+        try:
+            for ids in encoded:
+                for _ in range(req.n):
+                    futs.append(rt.engine.submit(
+                        ids, max_new_tokens=req.max_new,
+                        temperature=req.temperature, top_p=req.top_p,
+                        on_token=latch, deadline_s=req.deadline_s))
+                    row_prompt.append(ids)
+        except Exception:
+            # A shed submission mid-fan-out: cancel the admitted
+            # siblings (they would decode for a 429'd client).
+            if futs:
+                rt.engine.cancel(futs)
+            raise
+        # Expired requests resolve with DeadlineExceededError from the
+        # engine's reaper; the host timeout is only a backstop.
+        rows = [f.result(timeout=req.deadline_s + 30.0) for f in futs]
         ttft = latch.first_token_s
     else:
         import jax
@@ -307,7 +321,8 @@ def stream_completion(rt: InferenceRuntime, req: CompletionRequest,
                          f'max_total_len {limit}')
     t0 = time.monotonic()
     handles = [rt.submit_stream(ids, req.max_new, req.temperature,
-                                top_p=req.top_p)
+                                top_p=req.top_p,
+                                deadline_s=req.deadline_s)
                for _ in range(req.n)]
     writer.sse_start()
     obj = 'chat.completion.chunk' if chat else 'text_completion'

@@ -48,6 +48,8 @@ class ServingMetrics:
         self.completion_tokens: 'collections.deque' = collections.deque(
             maxlen=window)
         self.requests = 0
+        self.requests_shed = 0
+        self.deadline_exceeded = 0
         self.prom = obs_catalog.RequestMetrics()
 
     def record(self, latency_s: float, n_tokens: int,
@@ -65,6 +67,18 @@ class ServingMetrics:
         self.prom.prompt_tokens.inc(max(n_prompt_tokens, 0))
         if ttft_s is not None:
             self.prom.ttft_seconds.observe(ttft_s)
+
+    def record_shed(self) -> None:
+        """One request rejected 429 by admission control."""
+        with self._lock:
+            self.requests_shed += 1
+        self.prom.requests_shed.inc()
+
+    def record_deadline_exceeded(self) -> None:
+        """One request answered 504 (expired queued or mid-decode)."""
+        with self._lock:
+            self.deadline_exceeded += 1
+        self.prom.deadline_exceeded.inc()
 
     def record_inter_token(self, gap_s: float) -> None:
         """One gap between consecutive streamed tokens of a request
@@ -96,9 +110,13 @@ class ServingMetrics:
             itl = list(self.itl_ms)
             toks = list(self.completion_tokens)
             n = self.requests
+            shed = self.requests_shed
+            expired = self.deadline_exceeded
         total_s = sum(lat) / 1000.0
         return {
             'requests': n,
+            'requests_shed': shed,
+            'deadline_exceeded': expired,
             'window': self.window,
             'ttft_ms_p50': self._pct(ttft, 0.50),
             'ttft_ms_p95': self._pct(ttft, 0.95),
@@ -187,7 +205,10 @@ class InferenceRuntime:
                  stream_slots: int = 2,
                  prefill_chunk: int = 0,
                  prefill_budget: int = 0,
-                 pipeline_decode: Optional[bool] = None) -> None:
+                 pipeline_decode: Optional[bool] = None,
+                 request_timeout: float = 600.0,
+                 max_queue_requests: int = 0,
+                 max_queue_tokens: int = 0) -> None:
         import jax
         self.model = model
         self.params = params
@@ -219,6 +240,12 @@ class InferenceRuntime:
         self._prefill_chunk = prefill_chunk
         self._prefill_budget = prefill_budget
         self._pipeline_decode = pipeline_decode
+        # Robustness knobs: the server-wide request-deadline ceiling
+        # (per-request `timeout` fields clamp to it) and the bounded
+        # queue the lazy stream engine shares with the main one.
+        self.request_timeout = float(request_timeout)
+        self._max_queue_requests = max_queue_requests
+        self._max_queue_tokens = max_queue_tokens
 
     # -- capacity -----------------------------------------------------------
     def limit_for(self, temperature: float,
@@ -351,13 +378,27 @@ class InferenceRuntime:
                     prefill_chunk=self._prefill_chunk,
                     prefill_budget=self._prefill_budget,
                     pipeline_decode=(None if self.speculative
-                                     else self._pipeline_decode))
+                                     else self._pipeline_decode),
+                    max_queue_requests=self._max_queue_requests,
+                    max_queue_tokens=self._max_queue_tokens)
             return self._stream_engine
+
+    def deadline_for(self, req: dict) -> float:
+        """Effective per-request deadline, seconds: the request's own
+        `timeout` field clamped into (0, --request-timeout]."""
+        try:
+            t = float(req.get('timeout', self.request_timeout))
+        except (TypeError, ValueError) as e:
+            raise ValueError(f'invalid timeout field: {e}') from e
+        if t <= 0:
+            raise ValueError(f'timeout must be > 0, got {t}')
+        return min(t, self.request_timeout)
 
     def submit_stream(self, ids: List[int], max_new: int,
                       temperature: float, top_k: int = 0,
                       top_p: float = 1.0,
-                      stop_token_ids: Optional[List[int]] = None
+                      stop_token_ids: Optional[List[int]] = None,
+                      deadline_s: Optional[float] = None
                       ) -> StreamHandle:
         eng = self.stream_engine()
         # Queue must exist before submit; commit-time ITL recording
@@ -366,7 +407,9 @@ class InferenceRuntime:
         handle.future = eng.submit(
             ids, max_new_tokens=max_new, temperature=temperature,
             top_k=top_k, top_p=top_p, stop_token_ids=stop_token_ids,
-            on_token=handle.on_token)
+            on_token=handle.on_token,
+            deadline_s=(self.request_timeout if deadline_s is None
+                        else deadline_s))
         return handle
 
     def live_engines(self) -> List[object]:
@@ -498,6 +541,9 @@ def build_runtime(args) -> InferenceRuntime:
     prefill_budget = getattr(args, 'prefill_budget', 0)
     pipeline_decode = (False if getattr(args, 'no_pipeline_decode',
                                         False) else None)
+    request_timeout = getattr(args, 'request_timeout', 600.0)
+    max_queue_requests = getattr(args, 'max_queue_requests', 0)
+    max_queue_tokens = getattr(args, 'max_queue_tokens', 0)
     if args.continuous_batching:
         from skypilot_tpu.models.batching import ContinuousBatchingEngine
         decode_chunk = getattr(args, 'decode_chunk', 1)
@@ -525,7 +571,9 @@ def build_runtime(args) -> InferenceRuntime:
             prefill_budget=prefill_budget,
             # Auto (None) keeps pipelining off for spec/decode-chunk
             # engines; --no-pipeline-decode forces it off everywhere.
-            pipeline_decode=pipeline_decode)
+            pipeline_decode=pipeline_decode,
+            max_queue_requests=max_queue_requests,
+            max_queue_tokens=max_queue_tokens)
 
     return InferenceRuntime(
         model=model, params=params, vocab_size=vocab_size,
@@ -536,4 +584,7 @@ def build_runtime(args) -> InferenceRuntime:
         engine_total=engine_total if engine is not None else None,
         tokenizer_dir=tokenizer_dir,
         prefill_chunk=prefill_chunk, prefill_budget=prefill_budget,
-        pipeline_decode=pipeline_decode)
+        pipeline_decode=pipeline_decode,
+        request_timeout=request_timeout,
+        max_queue_requests=max_queue_requests,
+        max_queue_tokens=max_queue_tokens)

@@ -27,6 +27,7 @@ from skypilot_tpu import task as task_lib
 from skypilot_tpu.agent import job_lib as agent_job_lib
 from skypilot_tpu.jobs import recovery_strategy
 from skypilot_tpu.jobs import state
+from skypilot_tpu.robustness import faults
 from skypilot_tpu.utils import common_utils
 from skypilot_tpu.utils import ux_utils
 
@@ -298,6 +299,14 @@ class JobController:
             status: Optional[agent_job_lib.JobStatus] = None
             if agent is not None:
                 try:
+                    # Chaos: a DROP (or injected RequestException)
+                    # here is a synthetic preemption — the probe
+                    # counts as unreachable, and after the grace
+                    # window the normal recovery path runs.
+                    if faults.point('jobs.monitor_probe') is \
+                            faults.DROP:
+                        raise requests.RequestException(
+                            'injected monitor-probe drop')
                     job = agent.get_job(agent_job_id)
                     status = job['status'] if job else None
                     unreachable_since = None

@@ -302,13 +302,20 @@ def install_hosts_entries(handle, group_name: str,
     landing = hosts_file_path(group_name)
 
     def _one(runner) -> None:
+        # Jittered backoff PER HOST: after a zone-wide preemption
+        # every relaunching member retries hosts injection at once,
+        # and linear lockstep sleeps re-collide the whole herd on the
+        # shared /etc/hosts lock each round.
+        from skypilot_tpu.utils import common_utils
+        backoff = common_utils.Backoff(1.0, max_backoff=8.0,
+                                       jitter=True)
         last_err = ''
         for attempt in range(max_attempts):
             rc, _, err = runner.run(script, require_outputs=True)
             if rc == 0:
                 return
             last_err = err[-300:]
-            time.sleep(1.0 * (attempt + 1))
+            time.sleep(backoff.current_backoff())
         raise exceptions.SkyError(
             f'Job group {group_name!r}: hosts injection failed on '
             f'{runner!r} after {max_attempts} attempts: {last_err}')

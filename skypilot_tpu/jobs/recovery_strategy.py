@@ -18,6 +18,8 @@ from typing import Any, Dict, Optional, Set
 
 from skypilot_tpu import exceptions
 from skypilot_tpu import execution
+from skypilot_tpu.observability import catalog as obs_catalog
+from skypilot_tpu.robustness import faults
 from skypilot_tpu.utils import common_utils
 from skypilot_tpu.utils import ux_utils
 from skypilot_tpu.utils.registry import JOBS_RECOVERY_STRATEGY_REGISTRY
@@ -32,6 +34,10 @@ _RETRY_GAP_SECONDS = 5
 
 class StrategyExecutor:
     """Launch/recover a managed job's cluster under a strategy."""
+
+    # Registry name; also the `strategy` label on
+    # skypilot_jobs_recovery_attempts_total.
+    NAME = 'base'
 
     def __init__(self, cluster_name: str, task: 'task_lib.Task') -> None:
         self.cluster_name = cluster_name
@@ -80,10 +86,14 @@ class StrategyExecutor:
     def _launch_with_retries(self, first_launch: bool,
                              max_attempts: int = _MAX_LAUNCH_ATTEMPTS
                              ) -> int:
-        backoff = common_utils.Backoff(_RETRY_GAP_SECONDS)
+        # Decorrelated jitter: after a zone-wide preemption, every
+        # affected controller relaunches at once — jitter-free
+        # exponential backoff keeps them colliding in lockstep.
+        backoff = common_utils.Backoff(_RETRY_GAP_SECONDS, jitter=True)
         last_exc: Optional[Exception] = None
         for attempt in range(max_attempts):
             try:
+                faults.point('jobs.launch')
                 job_id, handle = execution.launch(
                     self.task,
                     cluster_name=self.cluster_name,
@@ -129,6 +139,15 @@ class StrategyExecutor:
         ) if last_exc is None else last_exc
 
 
+def _count_recovery_attempt(strategy: str) -> None:
+    """Tick skypilot_jobs_recovery_attempts_total{strategy} — the
+    fleet-level preemption-churn signal (a spiking rate on one
+    strategy label means a zone is melting)."""
+    obs_catalog.counter(
+        'skypilot_jobs_recovery_attempts_total').labels(
+            strategy=strategy).inc()
+
+
 @JOBS_RECOVERY_STRATEGY_REGISTRY.register(name='failover', default=True)
 class FailoverStrategyExecutor(StrategyExecutor):
     """Retry the same location first, then fail over elsewhere.
@@ -136,7 +155,10 @@ class FailoverStrategyExecutor(StrategyExecutor):
     Reference: recovery_strategy.py:896.
     """
 
+    NAME = 'failover'
+
     def recover(self) -> int:
+        _count_recovery_attempt(self.NAME)
         self.terminate_cluster()
         # Same resources, same preference order: the retrying
         # provisioner already walks zones/regions in order.
@@ -152,7 +174,10 @@ class EagerNextRegionStrategyExecutor(StrategyExecutor):
     region's capacity is likely still tight; block it and move on.
     """
 
+    NAME = 'eager_next_region'
+
     def recover(self) -> int:
+        _count_recovery_attempt(self.NAME)
         from skypilot_tpu import global_state
         record = global_state.get_cluster(self.cluster_name)
         if record is not None:

@@ -50,6 +50,10 @@ import numpy as np
 
 from skypilot_tpu.models.generate import sample_tokens
 from skypilot_tpu.observability import catalog as _obs
+from skypilot_tpu.robustness import faults
+from skypilot_tpu.robustness.errors import (DeadlineExceededError,
+                                            EngineDeadError,
+                                            QueueSaturatedError)
 
 
 def _bucket(n: int, cap: int) -> int:
@@ -167,7 +171,9 @@ class ContinuousBatchingEngine:
                  decode_chunk: int = 1,
                  prefill_chunk: int = 0,
                  prefill_budget: int = 0,
-                 pipeline_decode: Optional[bool] = None) -> None:
+                 pipeline_decode: Optional[bool] = None,
+                 max_queue_requests: int = 0,
+                 max_queue_tokens: int = 0) -> None:
         assert max_total_len <= model.config.max_seq_len
         # Chunked decode: N single-token steps in ONE jitted lax.scan
         # dispatch (the serving analog of the trainer's multi-step) —
@@ -319,6 +325,10 @@ class ContinuousBatchingEngine:
         self.stop_ids: List[frozenset] = [frozenset()] * num_slots
         self.on_tokens: List[Optional[Callable[[int], None]]] = \
             [None] * num_slots
+        # Per-slot absolute (monotonic) deadline; 0 = none. The
+        # scheduler reaps expired slots between rounds so a
+        # deadline-bearing request cannot hold a slot past it.
+        self.deadlines = np.zeros((num_slots,), np.float64)
         # Prefilling slots in admission order: the scheduler finishes
         # the oldest admission's prefill first (FCFS — completing one
         # prompt starts its decode sooner than round-robining all).
@@ -335,6 +345,22 @@ class ContinuousBatchingEngine:
         self.prefill_chunks_run = 0
         self.decode_stall_s = 0.0        # host blocked on device_get
         self.last_prefill_tokens = 0     # budget spent, last iteration
+
+        # Admission control (load shedding): 0 = unbounded. submit()
+        # raises QueueSaturatedError instead of queueing past these —
+        # a saturated replica answers 429 in microseconds rather than
+        # parking requests it will serve after their callers gave up.
+        self.max_queue_requests = int(max_queue_requests)
+        self.max_queue_tokens = int(max_queue_tokens)
+        self._shed_lock = threading.Lock()
+        self._queued_tokens_n = 0   # prompt tokens in _queue + _ready
+        self.requests_shed = 0
+        self.deadline_exceeded = 0
+        self.engine_restarts = 0
+        self._soft_errors = 0       # consecutive cache-intact errors
+        # Crash-only: a dead scheduler thread flips this instead of
+        # hanging clients (submit fails fast; /readyz reports 503).
+        self._dead = threading.Event()
 
         self._chunk_decode = (self._make_chunk_decode_fn()
                               if self.decode_chunk > 1 else None)
@@ -681,7 +707,8 @@ class ContinuousBatchingEngine:
                temperature: Optional[float] = None,
                top_k: int = 0, top_p: float = 1.0,
                stop_token_ids: Optional[List[int]] = None,
-               on_token: Optional[Callable[[int], None]] = None
+               on_token: Optional[Callable[[int], None]] = None,
+               deadline_s: Optional[float] = None
                ) -> 'Future':
         """Queue a request; the Future resolves to the full token list
         (prompt ++ generated). `temperature` overrides the engine
@@ -690,11 +717,23 @@ class ContinuousBatchingEngine:
         THIS request on any listed token (in addition to the engine's
         eos_id), with the stop token included in the output.
 
+        `deadline_s` bounds the request's WHOLE life (queue wait +
+        decode), in seconds from now: an expired request is reaped
+        between decode rounds — whether still queued or mid-decode —
+        and its Future raises DeadlineExceededError.
+
+        Raises QueueSaturatedError (shed: the bounded queue is full)
+        and EngineDeadError (the scheduler thread died) instead of
+        queueing work that cannot be served.
+
         `on_token` streams: called once per COMMITTED generated token,
         in order, on the scheduler thread — before the Future resolves
         — so it must be fast and non-blocking (push to a queue; don't
         do I/O). Tokens regenerated after a page-pressure preemption
         are not re-delivered (they became prompt on re-admission)."""
+        if self._dead.is_set():
+            raise EngineDeadError(
+                'engine scheduler thread is dead; restart the server')
         if len(prompt) >= self.max_total_len:
             raise ValueError(
                 f'prompt len {len(prompt)} >= max_total_len '
@@ -703,12 +742,30 @@ class ContinuousBatchingEngine:
             raise ValueError(f'top_p must be in (0, 1], got {top_p}')
         if top_k < 0:
             raise ValueError(f'top_k must be >= 0, got {top_k}')
+        with self._shed_lock:
+            if self.max_queue_requests and \
+                    self._queue.qsize() + len(self._ready) >= \
+                    self.max_queue_requests:
+                self.requests_shed += 1
+                raise QueueSaturatedError(
+                    f'queue full ({self.max_queue_requests} requests '
+                    f'waiting); retry later')
+            if self.max_queue_tokens and \
+                    self._queued_tokens_n + len(prompt) > \
+                    self.max_queue_tokens:
+                self.requests_shed += 1
+                raise QueueSaturatedError(
+                    f'queued prompt tokens would exceed '
+                    f'{self.max_queue_tokens}; retry later')
+            self._queued_tokens_n += len(prompt)
         temp = self.temperature if temperature is None else temperature
+        deadline = (time.monotonic() + float(deadline_s)
+                    if deadline_s is not None else 0.0)
         fut: Future = Future()
         self._queue.put((list(prompt), int(max_new_tokens),
                          float(temp), int(top_k), float(top_p),
                          frozenset(stop_token_ids or ()), on_token,
-                         fut))
+                         deadline, fut))
         return fut
 
     def cancel(self, futs) -> None:
@@ -744,6 +801,7 @@ class ContinuousBatchingEngine:
         while self._ready:
             item = self._ready.popleft()
             if item[-1] in cancels:
+                self._queued_tokens_sub(len(item[0]))
                 item[-1].set_result(list(item[0]))  # prompt only
             else:
                 keep.append(item)
@@ -770,72 +828,222 @@ class ContinuousBatchingEngine:
 
     # -- scheduler loop -----------------------------------------------------
     def _loop(self) -> None:
-        """One iteration = admit (host-only) -> apply cancellations ->
-        up to `prefill_budget` tokens of chunked prefill -> one decode
-        round for the active slots. Long prompts therefore interleave
-        with decoding instead of stalling it; with pipelining the
-        decode round's host commit overlaps the NEXT round's device
-        compute."""
-        while not self._stop.is_set():
-            try:
-                progressed = self._admit()
-                self._apply_cancellations()
-                if self._prefill_order:
-                    self._prefill_work()
-                    progressed = True
-                if self.active.any() or self._inflight is not None:
-                    t_step = time.perf_counter()
-                    self._decode_step()
-                    self.metrics.decode_step_seconds.observe(
-                        time.perf_counter() - t_step)
-                    progressed = True
-                if not progressed and self._queue.empty() and \
-                        not self._ready:
-                    # Idle: block briefly for the next request. The
-                    # item goes straight into _ready — a get+put-back
-                    # would rotate the queue head to the TAIL,
-                    # inverting FCFS admission order.
-                    try:
-                        self._ready.append(self._queue.get(timeout=0.05))
-                    except queue.Empty:
-                        pass
-            except Exception as e:  # pylint: disable=broad-except
-                # A device error must not wedge every future forever:
-                # fail the in-flight and queued requests loudly, reset
-                # the slots AND the (donated, now-invalid) cache, keep
-                # serving.
-                import traceback
-                traceback.print_exc()
-                self._inflight = None
+        """Run iterations until stopped. Crash-only: if the thread is
+        about to die for any reason other than stop() — including a
+        non-Exception like an injected SystemExit — it first flips the
+        dead flag and fails every pending future, so clients see
+        EngineDeadError immediately instead of hanging on a silently
+        absent scheduler (and /readyz reports 503)."""
+        try:
+            while not self._stop.is_set():
                 try:
-                    self.cache = self._fresh_cache()
-                except Exception:  # pylint: disable=broad-except
-                    traceback.print_exc()  # device truly gone
+                    self._iterate()
+                    self._soft_errors = 0
+                except Exception as e:  # pylint: disable=broad-except
+                    self._recover_from_error(e)
+        finally:
+            if not self._stop.is_set():
+                self._dead.set()
+                died = EngineDeadError('engine scheduler thread died')
                 for slot in range(self.num_slots):
                     fut = self.futures[slot]
                     self.futures[slot] = None
                     self.active[slot] = False
                     self.prefilling[slot] = False
                     self.on_tokens[slot] = None
-                    if fut is not None:
-                        fut.set_exception(e)
-                self._prefill_order.clear()
-                self.prefill_frontier[:] = 0
-                self.prompt_len[:] = 0
-                self.pos[:] = 0
-                self.cur_token[:] = 0
-                self.temps[:] = 0
-                self.top_ks[:] = 0
-                self.top_ps[:] = 1.0
-                while self._ready:
-                    *_rest, fut = self._ready.popleft()
-                    fut.set_exception(e)
-                while not self._queue.empty():
-                    try:
-                        *_rest, fut = self._queue.get_nowait()
-                        fut.set_exception(e)
-                    except queue.Empty:
-                        break
+                    if fut is not None and not fut.done():
+                        fut.set_exception(died)
+                self._fail_all_pending(died)
+
+    def _iterate(self) -> None:
+        """One iteration = admit (host-only) -> apply cancellations ->
+        reap expired deadlines -> up to `prefill_budget` tokens of
+        chunked prefill -> one decode round for the active slots. Long
+        prompts therefore interleave with decoding instead of stalling
+        it; with pipelining the decode round's host commit overlaps
+        the NEXT round's device compute."""
+        progressed = self._admit()
+        self._apply_cancellations()
+        self._reap_deadlines()
+        if self._prefill_order:
+            self._prefill_work()
+            progressed = True
+        if self.active.any() or self._inflight is not None:
+            t_step = time.perf_counter()
+            self._decode_step()
+            self.metrics.decode_step_seconds.observe(
+                time.perf_counter() - t_step)
+            progressed = True
+        if not progressed and self._queue.empty() and \
+                not self._ready:
+            # Idle: block briefly for the next request. The
+            # item goes straight into _ready — a get+put-back
+            # would rotate the queue head to the TAIL,
+            # inverting FCFS admission order.
+            try:
+                self._ready.append(self._queue.get(timeout=0.05))
+            except queue.Empty:
+                pass
+
+    def _cache_lost(self) -> bool:
+        """True when the donated KV cache buffer is gone (the device
+        execution consumed it before failing): every slot's history is
+        unrecoverable and only a full reset can continue. False means
+        the exception fired BEFORE any device work touched the cache —
+        state is consistent and serving can continue."""
+        try:
+            for leaf in jax.tree_util.tree_leaves(self.cache):
+                deleted = getattr(leaf, 'is_deleted', None)
+                if deleted is not None and deleted():
+                    return True
+            return False
+        except Exception:  # pylint: disable=broad-except
+            return True  # can't even inspect it: treat as lost
+
+    def _recover_from_error(self, e: Exception) -> None:
+        """Crash-only error containment, two tiers:
+
+        CACHE INTACT (e.g. an injected fault or host-side error raised
+        before the device dispatch): state is consistent — log, count,
+        keep serving every slot; nothing is failed. A short fuse
+        escalates repeated soft errors so a deterministic pre-dispatch
+        failure cannot spin the loop forever.
+
+        CACHE LOST (the donated buffer died inside the device call):
+        fail the in-flight and queued requests loudly, reset the slots
+        AND the cache, keep serving (the restart is counted in
+        engine_restarts / skypilot_serving_engine_restarts_total)."""
+        import traceback
+        traceback.print_exc()
+        self._soft_errors += 1
+        if not self._cache_lost() and self._soft_errors < 3:
+            print(f'engine {self.engine_id}: transient scheduler error '
+                  f'({type(e).__name__}: {e}); state intact, '
+                  f'continuing', flush=True)
+            return
+        self.engine_restarts += 1
+        self.metrics.engine_restarts.inc()
+        self._soft_errors = 0
+        self._inflight = None
+        try:
+            self.cache = self._fresh_cache()
+        except Exception:  # pylint: disable=broad-except
+            traceback.print_exc()  # device truly gone
+        for slot in range(self.num_slots):
+            fut = self.futures[slot]
+            self.futures[slot] = None
+            self.active[slot] = False
+            self.prefilling[slot] = False
+            self.on_tokens[slot] = None
+            if fut is not None:
+                fut.set_exception(e)
+        self._prefill_order.clear()
+        self.prefill_frontier[:] = 0
+        self.prompt_len[:] = 0
+        self.pos[:] = 0
+        self.cur_token[:] = 0
+        self.temps[:] = 0
+        self.top_ks[:] = 0
+        self.top_ps[:] = 1.0
+        self.deadlines[:] = 0.0
+        self._fail_all_pending(e)
+
+    def _fail_all_pending(self, e: Exception) -> None:
+        """Resolve every queued (not-yet-admitted) future with `e`."""
+        while self._ready:
+            prompt, *_rest, fut = self._ready.popleft()
+            self._queued_tokens_sub(len(prompt))
+            fut.set_exception(e)
+        while not self._queue.empty():
+            try:
+                prompt, *_rest, fut = self._queue.get_nowait()
+                self._queued_tokens_sub(len(prompt))
+                fut.set_exception(e)
+            except queue.Empty:
+                break
+
+    # -- deadlines / health / admission control -----------------------------
+    def _queued_tokens_sub(self, n: int) -> None:
+        with self._shed_lock:
+            self._queued_tokens_n -= n
+
+    def _queued_tokens_add(self, n: int) -> None:
+        with self._shed_lock:
+            self._queued_tokens_n += n
+
+    def queued_requests(self) -> int:
+        return self._queue.qsize() + len(self._ready)
+
+    def queued_tokens(self) -> int:
+        with self._shed_lock:
+            return self._queued_tokens_n
+
+    def healthy(self) -> bool:
+        """Scheduler thread alive and processing (the /readyz
+        signal)."""
+        return not self._dead.is_set() and self._thread.is_alive()
+
+    def saturated(self) -> bool:
+        """Admission control would shed an (average-sized) request
+        right now — surfaced by /readyz so load balancers steer
+        traffic away BEFORE clients start eating 429s."""
+        if self.max_queue_requests and \
+                self.queued_requests() >= self.max_queue_requests:
+            return True
+        if self.max_queue_tokens and \
+                self.queued_tokens() >= self.max_queue_tokens:
+            return True
+        return False
+
+    def _fail_slot(self, slot: int, e: Exception) -> None:
+        """Fail ONE slot's request (crash-only isolation): release its
+        resources, resolve its future with `e`, keep every other slot
+        running. Mid-prefill pages are never promoted (half-written)."""
+        fut = self.futures[slot]
+        self.futures[slot] = None
+        self.active[slot] = False
+        self.on_tokens[slot] = None
+        self.deadlines[slot] = 0.0
+        if self.prefilling[slot]:
+            self.prefilling[slot] = False
+            try:
+                self._prefill_order.remove(slot)
+            except ValueError:
+                pass
+        if self.paged:
+            self._release_slot_pages(slot, promote=False)
+        if fut is not None:
+            fut.set_exception(e)
+
+    def _reap_deadlines(self) -> None:
+        """Fail every expired request — queued or mid-decode — with
+        DeadlineExceededError. Runs between rounds on the scheduler
+        thread, so a reaped slot frees its pages before the next
+        dispatch and an abandoned request never decodes to its limit."""
+        now = time.monotonic()
+        for slot in range(self.num_slots):
+            dl = float(self.deadlines[slot])
+            if dl and now > dl and (self.active[slot] or
+                                    self.prefilling[slot]):
+                self.deadline_exceeded += 1
+                self._fail_slot(slot, DeadlineExceededError(
+                    f'request deadline exceeded after '
+                    f'{len(self.outputs[slot]) - int(self.prompt_len[slot])} '
+                    f'generated tokens'))
+        if not self._ready:
+            return
+        keep: 'collections.deque' = collections.deque()
+        while self._ready:
+            item = self._ready.popleft()
+            deadline = item[-2]
+            if deadline and now > deadline:
+                self.deadline_exceeded += 1
+                self._queued_tokens_sub(len(item[0]))
+                item[-1].set_exception(DeadlineExceededError(
+                    'request deadline exceeded while queued'))
+            else:
+                keep.append(item)
+        self._ready = keep
 
     def _occupied(self) -> 'np.ndarray':
         return self.active | self.prefilling
@@ -854,7 +1062,15 @@ class ContinuousBatchingEngine:
                 break
         while self._ready and not self._occupied().all():
             (prompt, max_new, temp, top_k, top_p, stops, on_token,
-             fut) = self._ready.popleft()
+             deadline, fut) = self._ready.popleft()
+            self._queued_tokens_sub(len(prompt))
+            if deadline and time.monotonic() > deadline:
+                # Expired while queued: prefilling it would only delay
+                # live requests further.
+                self.deadline_exceeded += 1
+                fut.set_exception(DeadlineExceededError(
+                    'request deadline exceeded while queued'))
+                continue
             if max_new <= 0:
                 fut.set_result(list(prompt))  # nothing to generate
                 continue
@@ -893,9 +1109,10 @@ class ContinuousBatchingEngine:
                     # later arrivals must not starve this one.
                     if self.prefix_cache is not None:
                         self.prefix_cache.release(shared)
+                    self._queued_tokens_add(len(prompt))
                     self._ready.appendleft(
                         (prompt, max_new, temp, top_k, top_p, stops,
-                         on_token, fut))
+                         on_token, deadline, fut))
                     break
                 pages = self.allocator.allocate(need)
                 self.owned_pages[slot] = pages
@@ -935,6 +1152,7 @@ class ContinuousBatchingEngine:
             self.top_ps[slot] = top_p
             self.stop_ids[slot] = stops
             self.on_tokens[slot] = on_token
+            self.deadlines[slot] = deadline
             self.prefilling[slot] = True
             self._prefill_order.append(slot)
             self._prefill_t0[slot] = time.perf_counter()
@@ -968,6 +1186,7 @@ class ContinuousBatchingEngine:
         at absolute position `offset`. Returns the (device) logits of
         the chunk's last real token — the continuation samples from
         them when this was the final chunk."""
+        faults.point('engine.prefill_chunk')
         shape = self._chunk_shape(n, offset)
         chunk = self.outputs[slot][offset:offset + n]
         padded = jnp.asarray(chunk + [0] * (shape - n), jnp.int32)
@@ -1032,7 +1251,20 @@ class ContinuousBatchingEngine:
             if budget is not None and spent + n > budget:
                 break   # budget spent: decode steps run first
             t0 = time.perf_counter()
-            last = self._run_prefill_chunk(slot, offset, n)
+            try:
+                last = self._run_prefill_chunk(slot, offset, n)
+            except Exception as e:  # pylint: disable=broad-except
+                if self._cache_lost():
+                    raise  # every slot's history died with the cache
+                # Crash-only isolation: the fault fired before the
+                # device touched the cache (e.g. an injected
+                # engine.prefill_chunk fault) — only THIS slot's
+                # request fails; the rest keep decoding untouched.
+                print(f'engine {self.engine_id}: prefill chunk for '
+                      f'slot {slot} failed ({type(e).__name__}: {e}); '
+                      f'failing only that request', flush=True)
+                self._fail_slot(slot, e)
+                continue
             self.metrics.prefill_chunk_seconds.observe(
                 time.perf_counter() - t0)
             spent += n
@@ -1125,7 +1357,9 @@ class ContinuousBatchingEngine:
                                   int(self.top_ks[slot]),
                                   float(self.top_ps[slot]),
                                   self.stop_ids[slot],
-                                  self.on_tokens[slot], fut))
+                                  self.on_tokens[slot],
+                                  float(self.deadlines[slot]), fut))
+                self._queued_tokens_add(len(self.outputs[slot]))
         # Back to the HEAD preserving pass order (repeated appendleft
         # would reverse it — an FCFS fairness inversion).
         self._ready.extendleft(reversed(preempted))
@@ -1183,6 +1417,7 @@ class ContinuousBatchingEngine:
         self.futures[slot] = None
         self.active[slot] = False
         self.on_tokens[slot] = None
+        self.deadlines[slot] = 0.0
         was_prefilling = bool(self.prefilling[slot])
         if was_prefilling:
             # Cancelled mid-prefill: resolve with the prompt as-is
@@ -1223,6 +1458,12 @@ class ContinuousBatchingEngine:
         return done
 
     def _decode_step(self) -> None:
+        # Injection point BEFORE any dispatch and before the round
+        # consumes RNG: a raised fault leaves state untouched, so the
+        # retried round produces bit-identical tokens (greedy AND
+        # sampled) — the crash-only containment contract the chaos
+        # suite locks in.
+        faults.point('engine.decode_step')
         if self.spec_k:
             self._spec_decode_step()
             return
@@ -1261,6 +1502,7 @@ class ContinuousBatchingEngine:
         """device_get with decode-stall accounting: the wall time the
         host spends blocked here is exactly the serial host/device
         bubble pipelining exists to hide."""
+        faults.point('engine.device_get')
         t0 = time.perf_counter()
         out = np.asarray(jax.device_get(dev))
         stall = time.perf_counter() - t0

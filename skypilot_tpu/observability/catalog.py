@@ -91,6 +91,10 @@ SPECS: Dict[str, Tuple] = {
     'skypilot_serving_prefix_cache_evictions_total': (
         'counter', 'Cached pages evicted back to the allocator under '
                    'pool pressure', ('engine',)),
+    'skypilot_serving_engine_restarts_total': (
+        'counter', 'Full engine resets after an unrecoverable '
+                   'scheduler error (KV cache lost; in-flight '
+                   'requests failed, slots rebuilt)', ('engine',)),
     # -- serving request path (inference/runtime.py + http_server.py)
     'skypilot_serving_requests_total': (
         'counter', 'Completed generation requests', ()),
@@ -109,6 +113,17 @@ SPECS: Dict[str, Tuple] = {
     'skypilot_serving_e2e_latency_seconds': (
         'histogram', 'End-to-end request latency', (),
         {'buckets': REQUEST_BUCKETS}),
+    'skypilot_serving_requests_shed_total': (
+        'counter', 'Requests rejected 429 by admission control '
+                   '(bounded queue full)', ()),
+    'skypilot_serving_deadline_exceeded_total': (
+        'counter', 'Requests answered 504: deadline expired while '
+                   'queued or mid-decode', ()),
+    # -- managed jobs (jobs/controller.py + recovery_strategy.py)
+    'skypilot_jobs_recovery_attempts_total': (
+        'counter', 'Managed-job recovery attempts (cluster lost or '
+                   'reported failed), by recovery strategy',
+        ('strategy',)),
     # -- API server (server/server.py)
     'skypilot_api_requests_total': (
         'counter', 'API server HTTP requests', ('route', 'method',
@@ -214,6 +229,8 @@ class EngineMetrics:
         self.prefix_evictions = counter(
             'skypilot_serving_prefix_cache_evictions_total').labels(
                 **lab)
+        self.engine_restarts = counter(
+            'skypilot_serving_engine_restarts_total').labels(**lab)
 
 
 class RequestMetrics:
@@ -231,6 +248,10 @@ class RequestMetrics:
             'skypilot_serving_inter_token_seconds')
         self.e2e_latency_seconds = histogram(
             'skypilot_serving_e2e_latency_seconds')
+        self.requests_shed = counter(
+            'skypilot_serving_requests_shed_total')
+        self.deadline_exceeded = counter(
+            'skypilot_serving_deadline_exceeded_total')
 
 
 class FirstTokenLatch:

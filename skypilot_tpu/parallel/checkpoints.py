@@ -34,6 +34,8 @@ class CheckpointManager:
     def save(self, step: int, state: Any, force: bool = False) -> bool:
         """Async save; returns whether a save was started. Saving a step
         that already exists is a no-op (resume-safe)."""
+        from skypilot_tpu.robustness import faults
+        faults.point('checkpoint.save')  # chaos: lost/failed saves
         try:
             return self._manager.save(
                 step, args=ocp.args.StandardSave(state), force=force)

@@ -116,10 +116,37 @@ def main() -> None:
     parser.add_argument('--drain-grace', type=float, default=630.0,
                         help='SIGTERM drain: seconds to wait for '
                              'in-flight requests before exiting. The '
-                             'default exceeds the 600s request future '
-                             'timeout so a worst-case generation still '
+                             'default exceeds the request-timeout '
+                             'default so a worst-case generation still '
                              'completes; requests outliving the grace '
                              'window are dropped at exit')
+    parser.add_argument('--request-timeout', type=float, default=600.0,
+                        help='per-request deadline ceiling, seconds: '
+                             'requests carrying a smaller `timeout` '
+                             'body field use that, anything else (and '
+                             'anything larger) is clamped here. '
+                             'Expired requests are reaped mid-decode '
+                             'and answered 504')
+    parser.add_argument('--max-queue-requests', type=int, default=0,
+                        metavar='N',
+                        help='admission control: shed (429 + '
+                             'Retry-After) once N requests are '
+                             'waiting for a decode slot. 0 = '
+                             'unbounded (the pre-hardening behavior)')
+    parser.add_argument('--max-queue-tokens', type=int, default=0,
+                        metavar='T',
+                        help='admission control: shed once the queued '
+                             'prompts hold T tokens (a token-aware '
+                             'bound sheds one 4k-prompt instead of '
+                             'forty short ones). 0 = unbounded')
+    parser.add_argument('--fault-plan', default=None, metavar='JSON',
+                        help='chaos testing: a fault plan (inline '
+                             'JSON or a path to a JSON file) arming '
+                             'the skypilot_tpu.robustness.faults '
+                             'injection points in this process; see '
+                             'docs/guides.md "Serving robustness". '
+                             'Equivalent to the STPU_FAULT_PLAN env '
+                             'var. Never set this in production')
     parser.add_argument('--cpu', action='store_true',
                         help='pin the CPU backend (smoke/dev runs; the '
                              'JAX_PLATFORMS env var is overridden by '
@@ -129,6 +156,12 @@ def main() -> None:
         parser.error('--decode-chunk is a continuous-engine knob; '
                      'add --continuous-batching (the one-shot engine '
                      'would silently ignore it)')
+
+    if args.fault_plan:
+        from skypilot_tpu.robustness import faults
+        faults.install_plan(args.fault_plan)
+        print(f'serve_lm: FAULT PLAN ARMED '
+              f'({sorted(faults.stats())}) — chaos mode', flush=True)
 
     from skypilot_tpu.inference.http_server import serve
     from skypilot_tpu.inference.runtime import build_runtime

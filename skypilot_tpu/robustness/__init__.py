@@ -1,0 +1,11 @@
+"""Robustness toolkit: deterministic fault injection + the error
+types the hardened serving/jobs paths raise (deadlines, load
+shedding, engine death). See docs/internals.md for the
+injection-point catalog and docs/guides.md for the operator knobs."""
+from skypilot_tpu.robustness import faults
+from skypilot_tpu.robustness.errors import (DeadlineExceededError,
+                                            EngineDeadError,
+                                            QueueSaturatedError)
+
+__all__ = ['faults', 'DeadlineExceededError', 'EngineDeadError',
+           'QueueSaturatedError']

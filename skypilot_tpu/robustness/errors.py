@@ -1,0 +1,31 @@
+"""Robustness error types shared across the serving and jobs planes.
+
+Dependency-free on purpose: `models/batching.py` (the engine),
+`inference/http_server.py` (the HTTP status mapping), and the chaos
+tests all import these, and none of them should pull in the other
+layers to do so.
+"""
+from __future__ import annotations
+
+
+class DeadlineExceededError(Exception):
+    """A request outlived its deadline: expired while queued, or
+    reaped mid-decode by the engine's deadline sweep. The HTTP layer
+    maps this to 504."""
+
+
+class QueueSaturatedError(Exception):
+    """Admission control shed this request: the engine's bounded
+    queue (`max_queue_requests` / `max_queue_tokens`) is full. The
+    HTTP layer maps this to 429 with a Retry-After hint."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0
+                 ) -> None:
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+class EngineDeadError(Exception):
+    """The engine's scheduler thread died: the engine fails fast
+    (submit raises, pending futures resolve with this) instead of
+    hanging clients; `/readyz` flips to 503."""

@@ -1,0 +1,276 @@
+"""Deterministic fault injection: named points in live code paths.
+
+The chaos-test backbone: production code declares *injection points*
+(`faults.point('engine.decode_step')`) that are no-ops by default —
+one module-global read per call, no plan parsing, no locking. Under a
+*fault plan* (JSON via the `STPU_FAULT_PLAN` env var, a `--fault-plan`
+CLI flag, or `install_plan()` from tests) a point deterministically
+perturbs the code path: raise an exception, delay, or report `DROP`
+so the site can skip the guarded operation (e.g. treat a monitor
+probe as lost).
+
+Plans are SEEDED: probabilistic triggers draw from per-rule
+`random.Random` instances derived from the plan seed, so a chaos run
+replays bit-identically. Counting triggers (`every_nth`, `at`,
+`after`, `times`) need no randomness at all.
+
+Plan format (see docs/guides.md "Serving robustness"):
+
+    {
+      "seed": 42,
+      "rules": [
+        {"point": "engine.decode_step", "action": "raise",
+         "exc": "RuntimeError", "message": "injected poison step",
+         "after": 3, "times": 1},
+        {"point": "jobs.monitor_probe", "action": "drop",
+         "times": 8},
+        {"point": "http.handler", "action": "delay",
+         "delay_s": 0.05, "prob": 0.25}
+      ]
+    }
+
+Rule semantics: every `point(name)` call increments each matching
+rule's hit counter (first call = hit 1). A rule fires when hits >
+`after` (default 0), its trigger matches (`every_nth`: every Nth
+eligible hit; `at`: exact hit numbers; `prob`: seeded coin flip;
+none: every eligible hit), and it has fired fewer than `times`
+(default unlimited) times. Rules evaluate in plan order: `delay`
+fires and evaluation continues, `drop` and `raise` end it.
+
+The point-name catalog is closed (`KNOWN_POINTS`): a plan naming an
+unknown point fails at install, not by silently never firing.
+"""
+from __future__ import annotations
+
+import builtins
+import importlib
+import json
+import os
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional, Union
+
+# Every injection point wired into the codebase, with the behavior a
+# firing rule perturbs (also rendered in docs/internals.md).
+KNOWN_POINTS: Dict[str, str] = {
+    'engine.decode_step':
+        'continuous-batching engine, start of one decode round '
+        '(before the device dispatch and before the round consumes '
+        'RNG — a raised fault retries the round with identical '
+        'outputs)',
+    'engine.prefill_chunk':
+        'engine, start of one prefill-chunk dispatch for one slot (a '
+        'raised fault fails only that slot\'s request)',
+    'engine.device_get':
+        'engine, before fetching sampled tokens from the device '
+        '(delay here models host/device interconnect stalls)',
+    'jobs.monitor_probe':
+        'managed-job controller, before each agent liveness probe '
+        '(DROP makes the probe count as unreachable — a synthetic '
+        'preemption)',
+    'jobs.launch':
+        'recovery-strategy executor, before each cluster launch '
+        'attempt (raise ResourcesUnavailableError to exercise '
+        'retry/backoff/failover)',
+    'http.handler':
+        'inference HTTP server, start of each POST handler',
+    'checkpoint.save':
+        'CheckpointManager.save, before the orbax save is issued',
+}
+
+#: Sentinel returned by `point()` when a drop rule fires; sites that
+#: support dropping compare with `is`.
+DROP = object()
+
+
+class InjectedFault(Exception):
+    """Default exception type raised by `action: raise` rules."""
+
+
+def _resolve_exc(name: Optional[str]):
+    """Exception class from a builtin name or dotted path."""
+    if not name:
+        return InjectedFault
+    if '.' in name:
+        module_name, attr = name.rsplit('.', 1)
+        cls = getattr(importlib.import_module(module_name), attr)
+    else:
+        cls = getattr(builtins, name, None)
+    if not (isinstance(cls, type) and
+            issubclass(cls, BaseException)):
+        raise ValueError(f'fault plan: exc {name!r} is not an '
+                         f'exception type')
+    return cls
+
+
+class FaultRule:
+    """One parsed rule; owns its hit/fired counters and seeded rng."""
+
+    _ACTIONS = ('raise', 'delay', 'drop')
+
+    def __init__(self, spec: Dict[str, Any], index: int,
+                 seed: int) -> None:
+        self.point = spec.get('point')
+        if self.point not in KNOWN_POINTS:
+            raise ValueError(
+                f'fault plan: unknown point {self.point!r}; known '
+                f'points: {sorted(KNOWN_POINTS)}')
+        self.action = spec.get('action', 'raise')
+        if self.action not in self._ACTIONS:
+            raise ValueError(f'fault plan: unknown action '
+                             f'{self.action!r} (use one of '
+                             f'{self._ACTIONS})')
+        self.exc = _resolve_exc(spec.get('exc'))
+        self.message = str(spec.get('message', f'injected fault at '
+                                               f'{self.point}'))
+        self.delay_s = float(spec.get('delay_s', 0.0))
+        self.every_nth = spec.get('every_nth')
+        self.at = [int(x) for x in spec.get('at', [])]
+        self.after = int(spec.get('after', 0))
+        self.times = spec.get('times')
+        self.prob = spec.get('prob')
+        # Per-rule deterministic stream: same plan -> same firings.
+        self._rng = random.Random(f'{seed}:{index}:{self.point}')
+        self.hits = 0
+        self.fired = 0
+
+    def check(self) -> bool:
+        """Register one hit; True when the rule fires this hit.
+        Caller holds the plan lock."""
+        self.hits += 1
+        if self.times is not None and self.fired >= int(self.times):
+            return False
+        if self.hits <= self.after:
+            return False
+        eligible = self.hits - self.after
+        if self.at:
+            fire = self.hits in self.at
+        elif self.every_nth:
+            fire = eligible % int(self.every_nth) == 0
+        elif self.prob is not None:
+            fire = self._rng.random() < float(self.prob)
+        else:
+            fire = True
+        if fire:
+            self.fired += 1
+        return fire
+
+
+class FaultPlan:
+    """A parsed plan: rules indexed by point, thread-safe firing."""
+
+    def __init__(self, spec: Dict[str, Any]) -> None:
+        self.seed = int(spec.get('seed', 0))
+        rules = spec.get('rules')
+        if not isinstance(rules, list) or not rules:
+            raise ValueError('fault plan: "rules" must be a '
+                             'non-empty list')
+        self._lock = threading.Lock()
+        self._by_point: Dict[str, List[FaultRule]] = {}
+        for i, rule_spec in enumerate(rules):
+            rule = FaultRule(rule_spec, i, self.seed)
+            self._by_point.setdefault(rule.point, []).append(rule)
+
+    def fire(self, name: str) -> Optional[object]:
+        rules = self._by_point.get(name)
+        if not rules:
+            return None
+        delay = 0.0
+        outcome: Optional[object] = None
+        raise_rule: Optional[FaultRule] = None
+        with self._lock:
+            for rule in rules:
+                if not rule.check():
+                    continue
+                if rule.action == 'delay':
+                    delay += rule.delay_s
+                    continue
+                if rule.action == 'drop':
+                    outcome = DROP
+                    break
+                raise_rule = rule
+                break
+        # Sleep/raise outside the lock: a delayed point must not
+        # serialize every other thread's injection checks.
+        if delay > 0.0:
+            time.sleep(delay)
+        if raise_rule is not None:
+            raise raise_rule.exc(raise_rule.message)
+        return outcome
+
+    def stats(self) -> Dict[str, Dict[str, int]]:
+        """{point: {hits, fired}} aggregated over the point's rules."""
+        out: Dict[str, Dict[str, int]] = {}
+        with self._lock:
+            for name, rules in self._by_point.items():
+                out[name] = {'hits': max(r.hits for r in rules),
+                             'fired': sum(r.fired for r in rules)}
+        return out
+
+
+_plan: Optional[FaultPlan] = None
+_install_lock = threading.Lock()
+
+
+def install_plan(spec: Union[None, str, Dict[str, Any], FaultPlan]
+                 ) -> Optional[FaultPlan]:
+    """Install the process-wide plan. `spec` is a dict, a JSON string,
+    a path to a JSON file, an already-built FaultPlan, or None
+    (clears). Returns the installed plan."""
+    global _plan
+    if spec is None:
+        with _install_lock:
+            _plan = None
+        return None
+    if isinstance(spec, FaultPlan):
+        plan = spec
+    else:
+        if isinstance(spec, str):
+            text = spec
+            if not spec.lstrip().startswith('{'):
+                with open(spec, 'r', encoding='utf-8') as f:
+                    text = f.read()
+            try:
+                spec = json.loads(text)
+            except json.JSONDecodeError as e:
+                raise ValueError(f'fault plan: invalid JSON: {e}') \
+                    from e
+        plan = FaultPlan(spec)
+    with _install_lock:
+        _plan = plan
+    return plan
+
+
+def clear() -> None:
+    install_plan(None)
+
+
+def get_plan() -> Optional[FaultPlan]:
+    return _plan
+
+
+def active() -> bool:
+    return _plan is not None
+
+
+def point(name: str) -> Optional[object]:
+    """THE injection point. No plan installed: returns None after one
+    global read (the zero-cost default every production call site
+    pays). With a plan: may raise, sleep, or return `DROP`."""
+    plan = _plan
+    if plan is None:
+        return None
+    return plan.fire(name)
+
+
+def stats() -> Dict[str, Dict[str, int]]:
+    plan = _plan
+    return plan.stats() if plan is not None else {}
+
+
+# Operators enable chaos on a live process tree via the environment
+# (serve replicas, spawned job controllers); loaded once at import.
+_env_spec = os.environ.get('STPU_FAULT_PLAN')
+if _env_spec:
+    install_plan(_env_spec)

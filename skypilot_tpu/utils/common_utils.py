@@ -8,6 +8,7 @@ import getpass
 import hashlib
 import json
 import os
+import random
 import re
 import socket
 import time
@@ -174,16 +175,32 @@ def json_dumps_compact(obj: Any) -> str:
 
 
 class Backoff:
-    """Exponential backoff with jitter-free cap (reference: common_utils.Backoff)."""
+    """Exponential backoff (reference: common_utils.Backoff).
+
+    With `jitter=True`, uses DECORRELATED jitter (sleep_n =
+    min(cap, U(initial, 3 * sleep_{n-1}))): retriers that failed
+    together spread out instead of re-colliding every multiplier
+    period — the thundering-herd shape of zone-wide preemption
+    relaunches. Pass a seeded `rng` for reproducible schedules
+    (chaos tests)."""
 
     def __init__(self, initial: float = 5.0, max_backoff: float = 60.0,
-                 multiplier: float = 1.6):
+                 multiplier: float = 1.6, jitter: bool = False,
+                 rng: Optional[Any] = None):
         self._initial = initial
         self._max = max_backoff
         self._mult = multiplier
         self._current = initial
+        self._jitter = jitter
+        self._rng = rng if rng is not None else random.Random()
 
     def current_backoff(self) -> float:
+        if self._jitter:
+            cur = min(self._max,
+                      self._rng.uniform(self._initial,
+                                        self._current * 3.0))
+            self._current = cur
+            return cur
         cur = self._current
         self._current = min(self._current * self._mult, self._max)
         return cur

@@ -159,6 +159,67 @@ def test_executor_restart_fails_inflight_requests(chaos_server,
 
 
 @pytest.mark.slow
+def test_managed_job_chaos_preemption_checkpoint_resume(
+        isolated_state, monkeypatch):
+    """End-to-end chaos: a fault plan (inherited via STPU_FAULT_PLAN
+    by the spawned controller) DROPS the controller's agent probes
+    mid-run — a synthetic preemption. The controller must walk its
+    real unreachable-grace machinery into recovery (terminate +
+    relaunch), and the job must RESUME from its checkpoint file
+    rather than restart from scratch (the SURVEY §2.6 contract the
+    reference can only smoke-test on real spot instances)."""
+    from skypilot_tpu import check
+    from skypilot_tpu.jobs import core as jobs_core
+    from skypilot_tpu.jobs import state
+
+    monkeypatch.setenv('SKYPILOT_JOBS_POLL_SECONDS', '1')
+    monkeypatch.setenv('SKYPILOT_JOBS_UNREACHABLE_GRACE_SECONDS', '3')
+    # Probes 1-2 succeed (the job gets running time), then every
+    # probe drops until the recovery relaunch consumes the budget.
+    monkeypatch.setenv('STPU_FAULT_PLAN', json.dumps({'rules': [
+        {'point': 'jobs.monitor_probe', 'action': 'drop',
+         'after': 2, 'times': 8}]}))
+    check.check(quiet=True)
+
+    ckpt = os.path.join(isolated_state, 'chaos-ckpt')
+    log = os.path.join(isolated_state, 'chaos-steps')
+    # Checkpoint-resume workload: every (re)start continues from the
+    # last checkpointed step; log BEFORE checkpointing so a kill
+    # between the two at worst repeats one boundary step.
+    run = (f'c=$(cat {ckpt} 2>/dev/null || echo 0); '
+           f'for i in $(seq $((c+1)) 6); do '
+           f'echo step-$i >> {log}; echo $i > {ckpt}; sleep 1; done')
+    result = jobs_core.launch(
+        {'name': 'chaos-mj', 'resources': {'infra': 'local'},
+         'run': run}, user='t')
+    job_id = result['job_id']
+
+    deadline = time.time() + 300
+    final = None
+    while time.time() < deadline:
+        job = state.get_job(job_id)
+        if job['status'].is_terminal():
+            final = job['status']
+            break
+        time.sleep(1)
+    job = state.get_job(job_id)
+    assert final == state.ManagedJobStatus.SUCCEEDED, job
+    # The synthetic preemption really drove recovery...
+    assert job['recovery_count'] >= 1, job
+    # ...and the workload RESUMED from its checkpoint: all six steps
+    # ran, in non-decreasing order (a from-scratch restart would
+    # rewind the sequence), ending at the checkpointed step 6.
+    with open(log, 'r', encoding='utf-8') as f:
+        steps = [int(line.split('-')[1]) for line in f
+                 if line.startswith('step-')]
+    assert steps == sorted(steps), steps
+    assert set(steps) == set(range(1, 7)), steps
+    with open(ckpt, 'r', encoding='utf-8') as f:
+        assert f.read().strip() == '6'
+    jobs_core.cancel([job_id])
+
+
+@pytest.mark.slow
 def test_api_version_negotiation(chaos_server, monkeypatch):
     """Version skew contract (reference: sky/server/versions.py):
     in-range versions negotiate, below-minimum clients get an
