@@ -1,0 +1,151 @@
+#!/usr/bin/env python
+"""Fleet-scale spot-orchestration bench: a preemption storm over N
+concurrent managed jobs (ROADMAP open item 5).
+
+Runs N simulated managed jobs through the REAL
+JobController/StrategyExecutor recovery path (stubbed cloud, virtual
+time — see skypilot_tpu/robustness/fleet_sim.py) under a zone-storm
+fault plan, three times:
+
+  1. jittered backoff, the shipped configuration;
+  2. jittered again with the same seed — the two summaries must be
+     BYTE-IDENTICAL (the determinism contract);
+  3. jitter disabled — the thundering-herd control arm.
+
+and asserts the acceptance invariants before writing the JSON:
+
+  - every storm-hit job recovered through the checkpoint-resume
+    path (status SUCCEEDED, all recovery events closed);
+  - max concurrent relaunch attempts with jitter is strictly below
+    the no-jitter herd peak (both read from the emitted
+    relaunch-concurrency histogram).
+
+Usage:
+
+  python benchmarks/fleet_bench.py --jobs 500 --seed 2026 \
+      --plan examples/fault_plans/zone_storm.json \
+      --out BENCH_fleet_r06.json
+
+The output JSON is a pure function of (args, plan): re-running with
+the same seed and plan reproduces it byte for byte.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument('--jobs', type=int, default=500)
+    parser.add_argument('--seed', type=int, default=2026)
+    parser.add_argument('--plan', default=None, metavar='JSON',
+                        help='storm fault plan (inline JSON or a '
+                             'file path); default: the canonical '
+                             'zone-storm scenario '
+                             '(examples/fault_plans/zone_storm.json)')
+    parser.add_argument('--accelerator', default='tpu-v5e-16')
+    parser.add_argument('--storm-frac', type=float, default=0.6,
+                        help='fraction of the fleet initially '
+                             'placed in the storm zone')
+    parser.add_argument('--work-s', type=float, default=120.0,
+                        help='virtual seconds of useful work per job')
+    parser.add_argument('--ckpt-every-s', type=float, default=30.0,
+                        help='checkpoint cadence (lost-work '
+                             'granularity on preemption)')
+    parser.add_argument('--launch-duration-s', type=float,
+                        default=4.0,
+                        help='virtual provisioning time per launch '
+                             '(what makes concurrent attempts '
+                             'overlap)')
+    parser.add_argument('--out', default=None, metavar='PATH',
+                        help='write the JSON here (default: stdout '
+                             'only)')
+    parser.add_argument('--no-assert', action='store_true',
+                        help='emit the JSON even when the '
+                             'acceptance invariants fail (debugging '
+                             'new scenarios)')
+    args = parser.parse_args()
+
+    from skypilot_tpu.robustness import fleet_sim
+
+    if args.plan is None:
+        plan_spec = fleet_sim.default_storm_plan()
+    elif args.plan.lstrip().startswith('{'):
+        plan_spec = json.loads(args.plan)
+    else:
+        with open(args.plan, 'r', encoding='utf-8') as f:
+            plan_spec = json.load(f)
+
+    def run(jitter: bool):
+        return fleet_sim.FleetSim(
+            num_jobs=args.jobs, plan_spec=plan_spec, seed=args.seed,
+            accelerator=args.accelerator, work_s=args.work_s,
+            ckpt_every_s=args.ckpt_every_s,
+            launch_duration_s=args.launch_duration_s,
+            storm_frac=args.storm_frac, jitter=jitter).run()
+
+    print(f'# fleet_bench: {args.jobs} jobs, seed {args.seed} '
+          f'(jittered run)', file=sys.stderr)
+    jittered = run(jitter=True)
+    print('# fleet_bench: replay (determinism check)',
+          file=sys.stderr)
+    replay = run(jitter=True)
+    print('# fleet_bench: no-jitter control arm', file=sys.stderr)
+    no_jitter = run(jitter=False)
+
+    deterministic = (json.dumps(jittered, sort_keys=True) ==
+                     json.dumps(replay, sort_keys=True))
+    jitter_peak = jittered['relaunch_concurrency']['max']
+    herd_peak = no_jitter['relaunch_concurrency']['max']
+    checks = {
+        'deterministic_replay': deterministic,
+        'all_jobs_succeeded': (
+            jittered['final_statuses'] ==
+            {'SUCCEEDED': args.jobs}),
+        'storm_hit_all_recovered': (
+            jittered['storm_hit_jobs'] > 0 and
+            jittered['storm_hit_recovered'] ==
+            jittered['storm_hit_jobs'] and
+            jittered['recovery_events_open'] == 0),
+        'jitter_bounds_herd': jitter_peak < herd_peak,
+    }
+
+    result = {
+        'bench': 'fleet_storm',
+        'jobs': args.jobs,
+        'seed': args.seed,
+        'plan': plan_spec,
+        'checks': checks,
+        'jittered': jittered,
+        'no_jitter': {
+            'relaunch_concurrency':
+                no_jitter['relaunch_concurrency'],
+            'final_statuses': no_jitter['final_statuses'],
+            'recovery_latency_s': no_jitter['recovery_latency_s'],
+        },
+        'herd_peak_ratio': (round(herd_peak / jitter_peak, 3)
+                            if jitter_peak else None),
+    }
+    text = json.dumps(result, indent=1, sort_keys=True)
+    print(text)
+    if args.out:
+        with open(args.out, 'w', encoding='utf-8') as f:
+            f.write(text + '\n')
+        print(f'# wrote {args.out}', file=sys.stderr)
+    if not all(checks.values()) and not args.no_assert:
+        print(f'# FAILED checks: '
+              f'{[k for k, v in checks.items() if not v]}',
+              file=sys.stderr)
+        sys.exit(1)
+
+
+if __name__ == '__main__':
+    main()

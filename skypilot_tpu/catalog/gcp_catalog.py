@@ -33,6 +33,32 @@ _TPU_PRICING: Dict[str, Tuple[float, float, List[str]]] = {
                          'asia-northeast1-b', 'us-south1-a']),
 }
 
+# Spot preemption rate snapshot, preemptions per instance-hour per
+# zone. Approximation of observed churn: big-pod zones under heavy
+# reservation pressure (us-central2-b, us-east5-a) preempt spot
+# capacity far more often than the quieter regional zones. This is
+# the `PreemptionRate` column of the bundled TPU catalog; the
+# optimizer turns it into a risk-adjusted effective price
+# (jobs/policy.py) so spot placement stops chasing list price into
+# the stormiest zones.
+_ZONE_PREEMPTION_RATE: Dict[str, float] = {
+    'us-central2-b': 0.55,
+    'us-east5-a': 0.45,
+    'us-east5-b': 0.30,
+    'us-central1-a': 0.20,
+    'us-central1-b': 0.25,
+    'us-central1-c': 0.25,
+    'us-west4-a': 0.15,
+    'us-east1-d': 0.18,
+    'us-south1-a': 0.10,
+    'europe-west4-a': 0.12,
+    'europe-west4-b': 0.16,
+    'asia-east1-c': 0.22,
+    'asia-northeast1-b': 0.14,
+    'asia-southeast1-b': 0.08,
+}
+_DEFAULT_PREEMPTION_RATE = 0.25
+
 # Max slice size available per zone (chips) — models that only a few
 # zones carry the biggest pods.
 _ZONE_MAX_CHIPS: Dict[str, int] = {
@@ -76,6 +102,8 @@ def _generate_tpu_df() -> pd.DataFrame:
                     'NumChips': spec.num_chips,
                     'NumHosts': spec.num_hosts,
                     'Topology': spec.topology_str,
+                    'PreemptionRate': _ZONE_PREEMPTION_RATE.get(
+                        zone, _DEFAULT_PREEMPTION_RATE),
                 })
     return pd.DataFrame(rows)
 
@@ -248,6 +276,59 @@ def validate_region_zone(region: Optional[str], zone: Optional[str]):
 def regions() -> List[str]:
     df = pd.concat([_tpu_df()[['Region']], _vm_df()[['Region']]])
     return sorted(df['Region'].unique())
+
+
+def get_preemption_rate(acc_name: str,
+                        region: Optional[str] = None,
+                        zone: Optional[str] = None) -> Optional[float]:
+    """Spot preemption rate (preemptions/hour) for a TPU offering,
+    minimized over the matching zones (the zone spot placement would
+    prefer). None when the catalog carries no rate data (e.g. a
+    mirror-refreshed copy predating the column)."""
+    if not tpu_utils.is_tpu(acc_name):
+        return None
+    df = _tpu_df()
+    if 'PreemptionRate' not in df.columns:
+        return None
+    df = df[df['AcceleratorName'] == acc_name]
+    if region is not None:
+        df = df[df['Region'] == region]
+    if zone is not None:
+        df = df[df['AvailabilityZone'] == zone]
+    rates = df['PreemptionRate'].dropna()
+    if rates.empty:
+        return None
+    return float(rates.min())
+
+
+def spot_zone_economics(
+        acc_name: str,
+        region: Optional[str] = None,
+        zone: Optional[str] = None) -> List[Tuple[str, float, float]]:
+    """(zone, spot_price, preemption_rate) per matching zone, sorted
+    by RISK-ADJUSTED price (price x effective_cost_multiplier(rate),
+    ties by zone name) — the order spot placement should walk.
+    Zones without rate data rank by raw price (rate treated as 0).
+    """
+    from skypilot_tpu.jobs import policy
+    if not tpu_utils.is_tpu(acc_name):
+        return []
+    df = _tpu_df()
+    df = df[df['AcceleratorName'] == acc_name]
+    if region is not None:
+        df = df[df['Region'] == region]
+    if zone is not None:
+        df = df[df['AvailabilityZone'] == zone]
+    df = df.dropna(subset=['SpotPrice'])
+    out: List[Tuple[str, float, float]] = []
+    for _, row in df.iterrows():
+        rate = row.get('PreemptionRate')
+        rate = float(rate) if pd.notna(rate) else 0.0
+        out.append((str(row['AvailabilityZone']),
+                    float(row['SpotPrice']), rate))
+    out.sort(key=lambda zpr: (
+        zpr[1] * policy.effective_cost_multiplier(zpr[2]), zpr[0]))
+    return out
 
 
 def get_tpu_slice_meta(acc_name: str) -> Dict[str, object]:

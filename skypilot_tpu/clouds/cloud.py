@@ -122,6 +122,17 @@ class Cloud:
     def get_egress_cost(self, num_gigabytes: float) -> float:
         return 0.0
 
+    def spot_zone_economics(
+            self, resources: 'resources_lib.Resources'
+    ) -> Optional[List[Tuple[str, float, float]]]:
+        """(zone, hourly_spot_price, preemption_rate/hour) triples
+        for a spot request, sorted by risk-adjusted price — the
+        order the optimizer should prefer zones in. None when this
+        cloud has no preemption-rate data (the optimizer then scores
+        on raw price, the pre-catalog behavior)."""
+        del resources
+        return None
+
     @classmethod
     def get_default_instance_type(cls, cpus: Optional[str] = None,
                                   memory: Optional[str] = None

@@ -82,6 +82,16 @@ class GCP(cloud.Cloud):
             resources.instance_type, resources.use_spot, resources.region,
             resources.zone)
 
+    def spot_zone_economics(self, resources: 'resources_lib.Resources'):
+        # Rate data exists for TPU slices only; spot VMs score on raw
+        # price like before.
+        if not (resources.use_spot and resources.is_tpu_slice):
+            return None
+        econ = gcp_catalog.spot_zone_economics(
+            resources.tpu_accelerator_name, resources.region,
+            resources.zone)
+        return econ or None
+
     def get_egress_cost(self, num_gigabytes: float) -> float:
         # Tiered internet egress (reference: sky/clouds/gcp.py egress table).
         if num_gigabytes <= 0:

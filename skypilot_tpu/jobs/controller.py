@@ -274,6 +274,17 @@ class JobController:
             return None
         return record['handle'].agent()
 
+    def _zone(self) -> Optional[str]:
+        """Zone the job's cluster is (or was last) placed in — the
+        scope key storm fault plans match on, and the label on
+        skypilot_jobs_preemptions_total."""
+        record = global_state.get_cluster(self.cluster_name)
+        if record is None:
+            return None
+        launched = getattr(record['handle'], 'launched_resources',
+                           None)
+        return getattr(launched, 'zone', None)
+
     def _monitor_loop(self, agent_job_id: int) -> state.ManagedJobStatus:
         job_id = self.job_id
         unreachable_since: Optional[float] = None
@@ -302,8 +313,14 @@ class JobController:
                     # Chaos: a DROP (or injected RequestException)
                     # here is a synthetic preemption — the probe
                     # counts as unreachable, and after the grace
-                    # window the normal recovery path runs.
-                    if faults.point('jobs.monitor_probe') is \
+                    # window the normal recovery path runs. The
+                    # zone/job context lets SCOPED rules (e.g. a
+                    # jobs.preempt_storm rule with scope
+                    # {"zone": ...}) take down exactly the jobs a
+                    # real zone-wide spot storm would.
+                    if faults.point('jobs.monitor_probe',
+                                    zone=self._zone() or '',
+                                    job=str(job_id)) is \
                             faults.DROP:
                         raise requests.RequestException(
                             'injected monitor-probe drop')
@@ -357,11 +374,20 @@ class JobController:
 
     def _recover(self) -> int:
         job_id = self.job_id
+        zone = self._zone()
         state.set_status(job_id, state.ManagedJobStatus.RECOVERING)
         state.bump_recovery(job_id)
+        # Fleet-level preemption signals: the zone-labeled counter
+        # (a spiking label = a zone melting down) and the per-event
+        # timestamps recovery latency is computed from.
+        from skypilot_tpu.observability import catalog as obs_catalog
+        obs_catalog.counter('skypilot_jobs_preemptions_total').labels(
+            zone=zone or 'unknown').inc()
+        state.record_preemption(job_id, zone)
         ux_utils.log(f'Managed job {job_id}: cluster lost; recovering.')
         agent_job_id = self.executor.recover()
         state.set_agent_job_id(job_id, agent_job_id)
+        state.record_recovered(job_id)
         if self.group:
             # Own publish + own-cluster hosts install already happened
             # pre-submit (the executor's _group_pre_exec hook). Here:

@@ -12,6 +12,7 @@ same zone first — best for reserved capacity) otherwise.
 """
 from __future__ import annotations
 
+import os
 import time
 import typing
 from typing import Any, Dict, Optional, Set
@@ -30,6 +31,13 @@ if typing.TYPE_CHECKING:
 
 _MAX_LAUNCH_ATTEMPTS = 3
 _RETRY_GAP_SECONDS = 5
+# Overall retry-deadline default: per-attempt backoff alone lets a
+# permanently failing launch spin forever (10 attempts with a 60s
+# backoff cap is minutes, but recover() is itself retried by the
+# monitor loop). One hour of failed (re)launching means the request
+# is not going to be satisfied — surface FAILED instead.
+_DEFAULT_LAUNCH_DEADLINE_SECONDS = float(
+    os.environ.get('SKYPILOT_JOBS_LAUNCH_DEADLINE_SECONDS', '3600'))
 
 
 class StrategyExecutor:
@@ -47,6 +55,23 @@ class StrategyExecutor:
         # handle between provision/setup and job submission, so peer
         # hostname injection precedes the user job even on recovery.
         self.pre_exec_hook = None
+        # Herd knobs. `jitter` exists for A/B benching only (the
+        # fleet bench proves the no-jitter herd is worse); `rng`
+        # makes the jittered schedule reproducible (fleet sim seeds
+        # one per job). `launch_deadline_s` caps TOTAL elapsed
+        # (re)launch time across all attempts of one launch/recover
+        # call — overridable per job via
+        # `job_recovery.launch_deadline_seconds`.
+        self.jitter = True
+        self.rng: Optional[Any] = None
+        self.launch_deadline_s = _DEFAULT_LAUNCH_DEADLINE_SECONDS
+        for r in task.resources:
+            if r.job_recovery and \
+                    r.job_recovery.get('launch_deadline_seconds') \
+                    is not None:
+                self.launch_deadline_s = float(
+                    r.job_recovery['launch_deadline_seconds'])
+                break
 
     @classmethod
     def make(cls, cluster_name: str,
@@ -89,19 +114,28 @@ class StrategyExecutor:
         # Decorrelated jitter: after a zone-wide preemption, every
         # affected controller relaunches at once — jitter-free
         # exponential backoff keeps them colliding in lockstep.
-        backoff = common_utils.Backoff(_RETRY_GAP_SECONDS, jitter=True)
+        backoff = common_utils.Backoff(_RETRY_GAP_SECONDS,
+                                       jitter=self.jitter,
+                                       rng=self.rng)
+        inflight = obs_catalog.gauge('skypilot_jobs_relaunch_inflight')
+        start = time.monotonic()
         last_exc: Optional[Exception] = None
         for attempt in range(max_attempts):
             try:
-                faults.point('jobs.launch')
-                job_id, handle = execution.launch(
-                    self.task,
-                    cluster_name=self.cluster_name,
-                    detach_run=True,
-                    _quiet_optimizer=True,
-                    _is_launched_by_jobs_controller=True,
-                    _blocked_resources=self.blocked_resources or None,
-                    _pre_exec_hook=self.pre_exec_hook)
+                faults.point('jobs.launch', cluster=self.cluster_name)
+                inflight.inc()
+                try:
+                    job_id, handle = execution.launch(
+                        self.task,
+                        cluster_name=self.cluster_name,
+                        detach_run=True,
+                        _quiet_optimizer=True,
+                        _is_launched_by_jobs_controller=True,
+                        _blocked_resources=self.blocked_resources or
+                        None,
+                        _pre_exec_hook=self.pre_exec_hook)
+                finally:
+                    inflight.dec()
                 assert handle is not None and job_id is not None
                 return job_id
             except (exceptions.ResourcesUnavailableError,
@@ -132,7 +166,18 @@ class StrategyExecutor:
                     f'Launch attempt {attempt + 1}/{max_attempts} for '
                     f'{self.cluster_name} failed: '
                     f'{common_utils.format_exception(e)}')
-                time.sleep(backoff.current_backoff())
+                gap = backoff.current_backoff()
+                # Overall retry deadline: a permanently failing
+                # launch must surface as FAILED, not retry forever
+                # (the per-attempt backoff bounds nothing by itself).
+                if time.monotonic() - start + gap > \
+                        self.launch_deadline_s:
+                    raise exceptions.ResourcesUnavailableError(
+                        f'Launch retry deadline '
+                        f'({self.launch_deadline_s:.0f}s) exceeded '
+                        f'for {self.cluster_name} after '
+                        f'{attempt + 1} attempts; giving up.') from e
+                time.sleep(gap)
         raise exceptions.ResourcesUnavailableError(
             f'Failed to launch cluster {self.cluster_name} after '
             f'{max_attempts} attempts.',

@@ -16,8 +16,13 @@ from skypilot_tpu.jobs import state
 from skypilot_tpu.utils import locks
 from skypilot_tpu.utils import subprocess_utils
 
-MAX_STARTING_JOBS = 4
-MAX_RUNNING_JOBS = 200
+# Env-overridable for fleet-scale deployments (the defaults assume a
+# laptop-class controller host; a dedicated controller VM happily
+# runs hundreds of monitor processes).
+MAX_STARTING_JOBS = int(
+    os.environ.get('SKYPILOT_JOBS_MAX_STARTING', '4'))
+MAX_RUNNING_JOBS = int(
+    os.environ.get('SKYPILOT_JOBS_MAX_RUNNING', '200'))
 
 
 _MAX_ADOPT_ATTEMPTS = 3

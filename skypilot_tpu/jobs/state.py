@@ -95,6 +95,19 @@ def _migrated_db_for(path: str) -> db_utils.SQLiteDB:
             ('pool', 'TEXT'),
             ('pool_worker', 'TEXT')):
         db.add_column_if_missing('managed_jobs', column, decl)
+    # Per-recovery-event timestamps: the fleet bench and the
+    # dashboard compute recovery latency from these instead of
+    # scraping controller logs. One row per detected preemption;
+    # recovered_at stays NULL while recovery is in flight (or if it
+    # never completes).
+    db.execute("""\
+CREATE TABLE IF NOT EXISTS recovery_events (
+    event_id INTEGER PRIMARY KEY AUTOINCREMENT,
+    job_id INTEGER,
+    zone TEXT,
+    preempted_at REAL,
+    recovered_at REAL
+)""")
     return db
 
 
@@ -220,6 +233,41 @@ def bump_recovery(job_id: int) -> int:
     row = _db().query_one('SELECT recovery_count FROM managed_jobs '
                           'WHERE job_id=?', (job_id,))
     return int(row['recovery_count']) if row else 0
+
+
+def record_preemption(job_id: int, zone: Optional[str]) -> int:
+    """Open a recovery event at detection time (the controller's
+    grace window just expired, or an external source reported the
+    cluster failed). Returns the event id."""
+    db = _db()
+    with db.conn() as conn:
+        cur = conn.execute(
+            'INSERT INTO recovery_events (job_id, zone, preempted_at) '
+            'VALUES (?,?,?)', (job_id, zone, time.time()))
+        return int(cur.lastrowid)
+
+
+def record_recovered(job_id: int) -> None:
+    """Close the job's most recent open recovery event (the relaunch
+    succeeded and the job is RUNNING again)."""
+    _db().execute(
+        'UPDATE recovery_events SET recovered_at=? WHERE event_id='
+        '(SELECT event_id FROM recovery_events WHERE job_id=? AND '
+        'recovered_at IS NULL ORDER BY event_id DESC LIMIT 1)',
+        (time.time(), job_id))
+
+
+def get_recovery_events(job_id: Optional[int] = None
+                        ) -> List[Dict[str, Any]]:
+    """Recovery events, oldest first ({event_id, job_id, zone,
+    preempted_at, recovered_at}); all jobs when job_id is None."""
+    sql = 'SELECT * FROM recovery_events'
+    params: tuple = ()
+    if job_id is not None:
+        sql += ' WHERE job_id=?'
+        params = (job_id,)
+    sql += ' ORDER BY event_id'
+    return [dict(r) for r in _db().query(sql, params)]
 
 
 def status_counts() -> Dict[str, int]:

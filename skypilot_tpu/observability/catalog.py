@@ -124,6 +124,18 @@ SPECS: Dict[str, Tuple] = {
         'counter', 'Managed-job recovery attempts (cluster lost or '
                    'reported failed), by recovery strategy',
         ('strategy',)),
+    'skypilot_jobs_preemptions_total': (
+        'counter', 'Managed-job cluster preemptions detected '
+                   '(probes unreachable past the grace window, or '
+                   'an external failure source), by zone the lost '
+                   'cluster was placed in — a spiking zone label is '
+                   'a spot storm', ('zone',)),
+    'skypilot_jobs_relaunch_inflight': (
+        'gauge', 'Cluster (re)launch attempts currently in flight '
+                 'for managed jobs in this process (fleet-wide in '
+                 'the fleet simulator; per-controller in '
+                 'production) — the thundering-herd signal jittered '
+                 'backoff keeps bounded', ()),
     # -- API server (server/server.py)
     'skypilot_api_requests_total': (
         'counter', 'API server HTTP requests', ('route', 'method',

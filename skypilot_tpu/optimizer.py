@@ -68,7 +68,8 @@ class Optimizer:
                     f'{sorted(str(r) for r in task.resources)}.{hint}')
             per_task[task] = candidates
 
-        choice = cls._optimize_exact(dag, per_task, minimize)
+        choice = cls._optimize_exact(dag, per_task, minimize,
+                                     blocked_resources)
 
         for task, (resources, cost) in choice.items():
             task.best_resources = resources
@@ -236,11 +237,55 @@ class Optimizer:
 
     # ------------------------------------------------------------------
     @classmethod
+    def _spot_effective(
+        cls, task: task_lib.Task, cand: resources_lib.Resources,
+        cost: float, seconds: float,
+        blocked: Optional[Set[resources_lib.Resources]],
+    ) -> Optional[Tuple[resources_lib.Resources, float, float]]:
+        """Risk-adjust + zone-pin one spot candidate.
+
+        Spot capacity is not fungible across zones: the catalog's
+        `PreemptionRate` column says how often each zone actually
+        takes the capacity back, and jobs/policy.py turns that rate
+        into an effective-cost multiplier (checkpoint tax + expected
+        lost progress + relaunch time, at the Young-optimal cadence).
+        Walk the cloud's risk-ranked zones, skip blocked ones, and
+        return the candidate PINNED to the first surviving zone with
+        its cost scored on `price x multiplier` — so placement stops
+        chasing list price into the stormiest zone and the launch
+        actually targets the zone the score assumed. Returns None
+        when every zone with the offering is blocked; non-spot (or
+        rate-less) candidates pass through untouched.
+        """
+        # getattr guards: the solver is also exercised with abstract
+        # (non-Resources) candidates in the brute-force fuzz tests.
+        if not getattr(cand, 'use_spot', False) or \
+                getattr(cand, 'cloud', None) is None:
+            return (cand, cost, seconds)
+        econ = cand.cloud.spot_zone_economics(cand)
+        if not econ:
+            return (cand, cost, seconds)
+        from skypilot_tpu.jobs import policy
+        for zone, hourly, rate in econ:
+            pinned = (cand if cand.zone is not None else
+                      cand.copy(zone=zone))
+            if cls._is_blocked(pinned, blocked):
+                continue
+            eff = (hourly * policy.effective_cost_multiplier(rate) *
+                   task.num_nodes * seconds / 3600.0)
+            if cand.priority:
+                eff *= 1.0 - 1e-6 * cand.priority
+            return (pinned, eff, seconds)
+        return None
+
+    @classmethod
     def _optimize_exact(
         cls, dag: dag_lib.Dag,
         per_task: Dict[task_lib.Task,
                        List[Tuple[resources_lib.Resources, float, float]]],
         minimize: OptimizeTarget,
+        blocked_resources: Optional[
+            Set[resources_lib.Resources]] = None,
     ) -> Dict[task_lib.Task, Tuple[resources_lib.Resources, float]]:
         """Exact joint placement by min-sum variable elimination.
 
@@ -250,7 +295,27 @@ class Optimizer:
         treewidth-1 case, and general DAGs get the exact answer the
         reference needs CBC ILP for (sky/optimizer.py:490). Runtime is
         O(n * d^(w+1)) for treewidth w — microseconds for pipelines.
+
+        Spot candidates are first risk-adjusted + zone-pinned via
+        `_spot_effective` (the COST objective ranks them on
+        preemption-aware effective price); `per_task` is updated IN
+        PLACE so callers displaying the candidate table see the
+        pinned zones and the chosen entry by identity.
         """
+        for t, cands in per_task.items():
+            adjusted = [
+                entry for entry in
+                (cls._spot_effective(t, cand, cost, seconds,
+                                     blocked_resources)
+                 for cand, cost, seconds in cands)
+                if entry is not None
+            ]
+            if not adjusted:
+                raise exceptions.ResourcesUnavailableError(
+                    f'All zones carrying the requested spot '
+                    f'resources for task {t.name or "<unnamed>"} '
+                    f'are blocked.')
+            per_task[t] = adjusted
         tasks = dag.get_sorted_tasks()
         tid = {t: i for i, t in enumerate(tasks)}
         use_time = minimize == OptimizeTarget.TIME
@@ -359,7 +424,8 @@ class Optimizer:
             table = Table(title=f'Optimizer: task '
                                 f'{task.name or "<unnamed>"} '
                                 f'(x{task.num_nodes} nodes)')
-            for col in ('infra', 'hardware', 'spot', '$/hr', 'chosen'):
+            for col in ('infra', 'hardware', 'spot', '$/hr', 'λ/hr',
+                        'chosen'):
                 table.add_column(col)
             best = choice[task][0]
             seen = set()
@@ -373,10 +439,16 @@ class Optimizer:
                 hw = (f'{cand.tpu_accelerator_name} '
                       f'[{spec.num_hosts}h {spec.topology_str}]'
                       if spec else (cand.instance_type or '-'))
+                rate = ''
+                if cand.use_spot and cand.cloud is not None:
+                    econ = cand.cloud.spot_zone_economics(cand)
+                    if econ:
+                        rate = f'{econ[0][2]:.2f}'
                 table.add_row(
                     cand.infra.formatted_str(), hw,
                     'yes' if cand.use_spot else '',
                     f'{cand.get_hourly_cost() * task.num_nodes:.2f}',
+                    rate,
                     '✓' if cand == best else '')
             console.print(table)
 

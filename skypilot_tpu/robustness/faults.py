@@ -25,17 +25,38 @@ Plan format (see docs/guides.md "Serving robustness"):
         {"point": "jobs.monitor_probe", "action": "drop",
          "times": 8},
         {"point": "http.handler", "action": "delay",
-         "delay_s": 0.05, "prob": 0.25}
+         "delay_s": 0.05, "prob": 0.25},
+        {"point": "jobs.preempt_storm",
+         "scope": {"zone": "us-east5-b"},
+         "start_range": [40.0, 60.0], "duration_s": 120.0}
       ]
     }
 
-Rule semantics: every `point(name)` call increments each matching
-rule's hit counter (first call = hit 1). A rule fires when hits >
-`after` (default 0), its trigger matches (`every_nth`: every Nth
-eligible hit; `at`: exact hit numbers; `prob`: seeded coin flip;
-none: every eligible hit), and it has fired fewer than `times`
-(default unlimited) times. Rules evaluate in plan order: `delay`
-fires and evaluation continues, `drop` and `raise` end it.
+Rule semantics: every `point(name, **ctx)` call increments each
+matching rule's hit counter (first call = hit 1). A rule with a
+`scope` (e.g. `{"zone": "us-east5-b"}`) only matches calls whose
+fire-site context carries every scoped key with that exact value —
+scope-mismatched calls do not count as hits, so a scoped rule's
+counters see only its own stream. A rule with a *window* (`start_s`
+or seeded `start_range: [lo, hi]`, plus `duration_s`) only matches
+inside `[start, start + duration)` seconds after plan install
+(measured on the plan's clock — `install_plan(..., clock=...)` lets
+a simulator drive virtual time). Within its matching stream a rule
+fires when hits > `after` (default 0), its trigger matches
+(`every_nth`: every Nth eligible hit; `at`: exact hit numbers;
+`prob`: seeded coin flip; none: every eligible hit), and it has
+fired fewer than `times` (default unlimited) times. Rules evaluate
+in plan order: `delay` fires and evaluation continues, `drop` and
+`raise` end it.
+
+`jobs.preempt_storm` is a *derived* point — no production code calls
+it. A rule naming it models a zone-wide spot preemption storm: it is
+installed against `jobs.monitor_probe` with `action: drop` and a
+REQUIRED window, so every matching job's liveness probes vanish for
+the (seeded) storm window and the whole fleet walks the real
+grace -> recover path at once. `windows()` exposes the resolved
+storm geometry so a fleet simulator can align cluster death with
+probe loss.
 
 The point-name catalog is closed (`KNOWN_POINTS`): a plan naming an
 unknown point fails at install, not by silently never firing.
@@ -68,7 +89,13 @@ KNOWN_POINTS: Dict[str, str] = {
     'jobs.monitor_probe':
         'managed-job controller, before each agent liveness probe '
         '(DROP makes the probe count as unreachable — a synthetic '
-        'preemption)',
+        'preemption; fire-site context carries zone=<zone> and '
+        'job=<job id> for scoped rules)',
+    'jobs.preempt_storm':
+        'derived point: a windowed drop rule on jobs.monitor_probe '
+        'scoped to a set of jobs (e.g. {"zone": ...}) — one rule '
+        'models a zone-wide spot storm hitting every job placed '
+        'there during a seeded time window',
     'jobs.launch':
         'recovery-strategy executor, before each cluster launch '
         'attempt (raise ResourcesUnavailableError to exercise '
@@ -104,6 +131,18 @@ def _resolve_exc(name: Optional[str]):
     return cls
 
 
+#: Derived points: not called by production code; a rule naming one
+#: is rewritten at parse time onto the real point it perturbs, with
+#: the listed defaults forced.
+_DERIVED_POINTS: Dict[str, Dict[str, Any]] = {
+    'jobs.preempt_storm': {
+        'target': 'jobs.monitor_probe',
+        'action': 'drop',
+        'window_required': True,
+    },
+}
+
+
 class FaultRule:
     """One parsed rule; owns its hit/fired counters and seeded rng."""
 
@@ -116,7 +155,11 @@ class FaultRule:
             raise ValueError(
                 f'fault plan: unknown point {self.point!r}; known '
                 f'points: {sorted(KNOWN_POINTS)}')
-        self.action = spec.get('action', 'raise')
+        derived = _DERIVED_POINTS.get(self.point, {})
+        #: The point this rule is evaluated at (== `point` unless
+        #: derived); plans index rules by target, stats by `point`.
+        self.target = derived.get('target', self.point)
+        self.action = spec.get('action', derived.get('action', 'raise'))
         if self.action not in self._ACTIONS:
             raise ValueError(f'fault plan: unknown action '
                              f'{self.action!r} (use one of '
@@ -130,10 +173,49 @@ class FaultRule:
         self.after = int(spec.get('after', 0))
         self.times = spec.get('times')
         self.prob = spec.get('prob')
+        self.scope = dict(spec.get('scope') or {})
+        for key, value in self.scope.items():
+            if not isinstance(key, str) or not isinstance(value, str):
+                raise ValueError(
+                    f'fault plan: scope must map string keys to '
+                    f'string values, got {self.scope!r}')
         # Per-rule deterministic stream: same plan -> same firings.
+        # The window draw (if any) consumes the first value, so
+        # `prob` streams stay aligned whether or not a range is set.
         self._rng = random.Random(f'{seed}:{index}:{self.point}')
+        self.start_s: Optional[float] = None
+        self.duration_s: Optional[float] = None
+        start_range = spec.get('start_range')
+        if start_range is not None:
+            lo, hi = (float(start_range[0]), float(start_range[1]))
+            self.start_s = self._rng.uniform(lo, hi)
+        elif spec.get('start_s') is not None:
+            self.start_s = float(spec['start_s'])
+        if spec.get('duration_s') is not None:
+            self.duration_s = float(spec['duration_s'])
+        if (self.start_s is None) != (self.duration_s is None):
+            raise ValueError(
+                f'fault plan: rule {index} ({self.point}) has a '
+                f'partial window — set both start_s/start_range and '
+                f'duration_s, or neither')
+        if derived.get('window_required') and self.start_s is None:
+            raise ValueError(
+                f'fault plan: {self.point} requires a window '
+                f'(start_s or start_range, plus duration_s)')
         self.hits = 0
         self.fired = 0
+
+    def matches(self, ctx: Dict[str, str], elapsed_s: float) -> bool:
+        """Eligibility filters that precede hit counting: a call
+        outside the rule's scope or time window is invisible to it."""
+        if self.start_s is not None and not (
+                self.start_s <= elapsed_s <
+                self.start_s + self.duration_s):
+            return False
+        for key, value in self.scope.items():
+            if ctx.get(key) != value:
+                return False
+        return True
 
     def check(self) -> bool:
         """Register one hit; True when the rule fires this hit.
@@ -158,29 +240,58 @@ class FaultRule:
 
 
 class FaultPlan:
-    """A parsed plan: rules indexed by point, thread-safe firing."""
+    """A parsed plan: rules indexed by target point, thread-safe
+    firing. `clock` (default `time.monotonic`) anchors rule windows:
+    elapsed time is measured from plan construction, so a fleet
+    simulator can pass its virtual clock and replay storms in
+    virtual seconds."""
 
-    def __init__(self, spec: Dict[str, Any]) -> None:
+    def __init__(self, spec: Dict[str, Any], clock=None) -> None:
         self.seed = int(spec.get('seed', 0))
         rules = spec.get('rules')
         if not isinstance(rules, list) or not rules:
             raise ValueError('fault plan: "rules" must be a '
                              'non-empty list')
+        self._clock = clock if clock is not None else time.monotonic
+        self._epoch = self._clock()
         self._lock = threading.Lock()
         self._by_point: Dict[str, List[FaultRule]] = {}
         for i, rule_spec in enumerate(rules):
             rule = FaultRule(rule_spec, i, self.seed)
-            self._by_point.setdefault(rule.point, []).append(rule)
+            self._by_point.setdefault(rule.target, []).append(rule)
 
-    def fire(self, name: str) -> Optional[object]:
+    def elapsed(self) -> float:
+        return self._clock() - self._epoch
+
+    def windows(self, point_name: str) -> List[Dict[str, Any]]:
+        """Resolved {scope, start_s, end_s, action} for every
+        windowed rule evaluated at `point_name` (storm geometry —
+        the fleet simulator aligns cluster death with probe loss
+        from this)."""
+        out = []
+        for rule in self._by_point.get(point_name, []):
+            if rule.start_s is None:
+                continue
+            out.append({'scope': dict(rule.scope),
+                        'start_s': rule.start_s,
+                        'end_s': rule.start_s + rule.duration_s,
+                        'action': rule.action})
+        return out
+
+    def fire(self, name: str,
+             ctx: Optional[Dict[str, str]] = None) -> Optional[object]:
         rules = self._by_point.get(name)
         if not rules:
             return None
+        ctx = ctx or {}
+        elapsed = self.elapsed()
         delay = 0.0
         outcome: Optional[object] = None
         raise_rule: Optional[FaultRule] = None
         with self._lock:
             for rule in rules:
+                if not rule.matches(ctx, elapsed):
+                    continue
                 if not rule.check():
                     continue
                 if rule.action == 'delay':
@@ -200,24 +311,29 @@ class FaultPlan:
         return outcome
 
     def stats(self) -> Dict[str, Dict[str, int]]:
-        """{point: {hits, fired}} aggregated over the point's rules."""
-        out: Dict[str, Dict[str, int]] = {}
+        """{point: {hits, fired}} aggregated over the point's rules.
+        Derived rules (jobs.preempt_storm) report under their OWN
+        name, not the target they were installed against."""
+        grouped: Dict[str, List[FaultRule]] = {}
         with self._lock:
-            for name, rules in self._by_point.items():
-                out[name] = {'hits': max(r.hits for r in rules),
-                             'fired': sum(r.fired for r in rules)}
-        return out
+            for rules in self._by_point.values():
+                for r in rules:
+                    grouped.setdefault(r.point, []).append(r)
+            return {name: {'hits': max(r.hits for r in rules),
+                           'fired': sum(r.fired for r in rules)}
+                    for name, rules in grouped.items()}
 
 
 _plan: Optional[FaultPlan] = None
 _install_lock = threading.Lock()
 
 
-def install_plan(spec: Union[None, str, Dict[str, Any], FaultPlan]
-                 ) -> Optional[FaultPlan]:
+def install_plan(spec: Union[None, str, Dict[str, Any], FaultPlan],
+                 clock=None) -> Optional[FaultPlan]:
     """Install the process-wide plan. `spec` is a dict, a JSON string,
     a path to a JSON file, an already-built FaultPlan, or None
-    (clears). Returns the installed plan."""
+    (clears). `clock` (ignored for a pre-built FaultPlan) anchors
+    rule windows — see FaultPlan. Returns the installed plan."""
     global _plan
     if spec is None:
         with _install_lock:
@@ -236,7 +352,7 @@ def install_plan(spec: Union[None, str, Dict[str, Any], FaultPlan]
             except json.JSONDecodeError as e:
                 raise ValueError(f'fault plan: invalid JSON: {e}') \
                     from e
-        plan = FaultPlan(spec)
+        plan = FaultPlan(spec, clock=clock)
     with _install_lock:
         _plan = plan
     return plan
@@ -254,14 +370,23 @@ def active() -> bool:
     return _plan is not None
 
 
-def point(name: str) -> Optional[object]:
+def point(name: str, **ctx: str) -> Optional[object]:
     """THE injection point. No plan installed: returns None after one
     global read (the zero-cost default every production call site
-    pays). With a plan: may raise, sleep, or return `DROP`."""
+    pays). With a plan: may raise, sleep, or return `DROP`. Keyword
+    args are the fire-site context scoped rules match against (e.g.
+    `point('jobs.monitor_probe', zone='us-east5-b', job='12')`)."""
     plan = _plan
     if plan is None:
         return None
-    return plan.fire(name)
+    return plan.fire(name, ctx)
+
+
+def windows(point_name: str) -> List[Dict[str, Any]]:
+    """Resolved windows of the installed plan's rules at
+    `point_name`; empty with no plan."""
+    plan = _plan
+    return plan.windows(point_name) if plan is not None else []
 
 
 def stats() -> Dict[str, Dict[str, int]]:

@@ -1,0 +1,584 @@
+"""Fleet-scale managed-jobs simulator: N controllers, virtual time.
+
+The missing half of the chaos story: PR 5 proved ONE job survives
+ONE injected preemption; this proves the CONTROL PLANE survives a
+zone-wide spot storm hitting hundreds of concurrent jobs — through
+the REAL code: each simulated job runs an actual
+`jobs.controller.JobController` monitor loop driving an actual
+`recovery_strategy.StrategyExecutor` (grace windows, zone-labeled
+preemption counters, recovery-event timestamps, jittered launch
+backoff, retry deadlines), with only the cloud stubbed out.
+
+Three substitutions make N=500 tractable, deterministic, and
+cloud-free:
+
+  1. VIRTUAL TIME. A lockstep scheduler runs every controller on
+     its own thread but releases exactly ONE at a time; `time.time`
+     / `time.monotonic` / `time.sleep` inside the jobs modules are
+     rerouted to the `SimClock`, which jumps straight to the next
+     earliest wake-up. 500 jobs x minutes of polling simulate in
+     seconds of wall time, and the interleaving is a pure function
+     of (seed, plan) — the property the fleet bench's byte-identical
+     JSON contract rests on.
+
+  2. A STUB LAUNCH BACKEND. `execution.launch` is replaced by a
+     placement stub that holds the (virtual) launch duration, tracks
+     relaunch concurrency (the thundering-herd signal), assigns
+     zones from a seeded distribution, and books cluster segments
+     for cost accounting. Everything ABOVE it — retry loops,
+     backoff, deadlines, blocked-resource bookkeeping — is the
+     production path.
+
+  3. A STUB AGENT. Probes hit an in-memory agent that models a
+     checkpointed training workload: progress accrues while the
+     cluster is up, rolls back to the last checkpoint on preemption
+     (the lost steps/tokens the bench reports), and reports
+     SUCCEEDED when the work is done. Cluster death follows the
+     installed fault plan's storm windows
+     (`faults.windows('jobs.monitor_probe')`), so probe loss and
+     capacity loss agree by construction.
+
+Used by `benchmarks/fleet_bench.py` (the N=500 storm bench emitting
+`BENCH_fleet_*.json`) and the tier-1 N=20 smoke test.
+"""
+from __future__ import annotations
+
+import json
+import os
+import random
+import tempfile
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import requests
+
+from skypilot_tpu.robustness import faults
+
+_DEFAULT_HORIZON_S = 4 * 3600.0
+
+
+class SimTimeout(Exception):
+    """Virtual time ran past the horizon — a job is stuck in a
+    recover/poll loop the scenario never lets finish. Raised inside
+    the worker so the controller's own containment turns it into
+    FAILED_CONTROLLER instead of hanging the simulation."""
+
+
+class _Worker:
+    __slots__ = ('wid', 'go', 'yielded', 'wake_at', 'done', 'thread',
+                 'error')
+
+    def __init__(self, wid: int, wake_at: float) -> None:
+        self.wid = wid
+        self.go = threading.Event()
+        self.yielded = threading.Event()
+        self.wake_at = wake_at
+        self.done = False
+        self.thread: Optional[threading.Thread] = None
+        self.error: Optional[BaseException] = None
+
+
+class SimClock:
+    """Deterministic lockstep virtual clock.
+
+    Worker threads call `sleep`, which parks the thread and hands
+    control back to the coordinator; the coordinator releases the
+    worker with the earliest wake-up (ties by worker id) and
+    advances `now` to it. Exactly one worker runs at any instant, so
+    shared state needs no locking and the schedule is reproducible.
+    """
+
+    def __init__(self, horizon_s: float = _DEFAULT_HORIZON_S) -> None:
+        self.now = 0.0
+        self.horizon_s = horizon_s
+        self._by_ident: Dict[int, _Worker] = {}
+
+    # -- the time.* surface rerouted into the sim ----------------------
+    def time(self) -> float:
+        return self.now
+
+    def monotonic(self) -> float:
+        return self.now
+
+    def sleep(self, seconds: float) -> None:
+        worker = self._by_ident[threading.get_ident()]
+        wake_at = self.now + max(float(seconds), 0.0)
+        if wake_at > self.horizon_s:
+            raise SimTimeout(
+                f'virtual time {wake_at:.0f}s past the '
+                f'{self.horizon_s:.0f}s horizon')
+        worker.wake_at = wake_at
+        worker.yielded.set()
+        worker.go.wait()
+        worker.go.clear()
+
+    # -- coordinator ---------------------------------------------------
+    def register(self, worker: _Worker) -> None:
+        """Called on the WORKER's thread before it first runs, so the
+        ident mapping exists before any sleep()."""
+        self._by_ident[threading.get_ident()] = worker
+
+    def run_all(self, workers: List[_Worker]) -> None:
+        live = [w for w in workers if not w.done]
+        while live:
+            nxt = min(live, key=lambda w: (w.wake_at, w.wid))
+            self.now = max(self.now, nxt.wake_at)
+            nxt.go.set()
+            if not nxt.yielded.wait(timeout=300):
+                raise RuntimeError(
+                    f'fleet sim wedged: worker {nxt.wid} neither '
+                    f'slept nor finished within 300s of wall time')
+            nxt.yielded.clear()
+            live = [w for w in workers if not w.done]
+
+
+class _TimeShim:
+    """Drop-in for the `time` module inside the jobs modules."""
+
+    def __init__(self, clock: SimClock) -> None:
+        self._clock = clock
+
+    def time(self) -> float:
+        return self._clock.time()
+
+    def monotonic(self) -> float:
+        return self._clock.monotonic()
+
+    def sleep(self, seconds: float) -> None:
+        self._clock.sleep(seconds)
+
+
+class _SimJob:
+    """Bookkeeping for one simulated managed job's cluster + work."""
+
+    def __init__(self, job_id: int, cluster_name: str, work_s: float,
+                 ckpt_every_s: float, rng: random.Random) -> None:
+        self.job_id = job_id
+        self.cluster_name = cluster_name
+        self.work_s = work_s
+        self.ckpt_every_s = ckpt_every_s
+        self.rng = rng
+        # Cluster segment currently billed/running.
+        self.zone: Optional[str] = None
+        self.seg_start = 0.0
+        self.dead_at: Optional[float] = None
+        self.base = 0.0          # checkpointed progress (seconds)
+        self.launches = 0
+        self.lost_s = 0.0
+        self.preemptions = 0
+        self.segments: List[Tuple[str, float, float]] = []
+        self.completed_at: Optional[float] = None
+
+    def progress(self, now: float) -> float:
+        if self.zone is None:
+            return self.base
+        end = now if self.dead_at is None else min(now, self.dead_at)
+        return min(self.work_s,
+                   self.base + max(0.0, end - self.seg_start))
+
+    def cluster_dead(self, now: float) -> bool:
+        return self.dead_at is not None and now >= self.dead_at
+
+
+class FleetSim:
+    """One reproducible fleet run; see module docstring.
+
+    Determinism contract: `run()` output is a pure function of the
+    constructor arguments. Every random draw (placement, jittered
+    backoff, storm start, probabilistic launch failures) comes from
+    a rng seeded by (seed, purpose, job) — no wall clock, no global
+    RNG.
+    """
+
+    def __init__(self,
+                 num_jobs: int,
+                 plan_spec: Dict[str, Any],
+                 seed: int = 0,
+                 accelerator: str = 'tpu-v5e-16',
+                 work_s: float = 120.0,
+                 ckpt_every_s: float = 30.0,
+                 launch_duration_s: float = 4.0,
+                 storm_frac: float = 0.6,
+                 jitter: bool = True,
+                 step_time_s: float = 1.0,
+                 tokens_per_step: float = 8192.0,
+                 horizon_s: float = _DEFAULT_HORIZON_S,
+                 launch_deadline_s: float = 1800.0) -> None:
+        self.num_jobs = int(num_jobs)
+        self.plan_spec = plan_spec
+        self.seed = int(seed)
+        self.accelerator = accelerator
+        self.work_s = float(work_s)
+        self.ckpt_every_s = float(ckpt_every_s)
+        self.launch_duration_s = float(launch_duration_s)
+        self.storm_frac = float(storm_frac)
+        self.jitter = bool(jitter)
+        self.step_time_s = float(step_time_s)
+        self.tokens_per_step = float(tokens_per_step)
+        self.horizon_s = float(horizon_s)
+        self.launch_deadline_s = float(launch_deadline_s)
+
+        from skypilot_tpu.catalog import gcp_catalog
+        self.zones = gcp_catalog.get_tpu_zones(accelerator)
+        if not self.zones:
+            raise ValueError(f'no catalog zones for {accelerator!r}')
+
+        self.clock = SimClock(horizon_s=self.horizon_s)
+        self._jobs: Dict[str, _SimJob] = {}
+        # Relaunch-concurrency timeline: (virtual_t, +1/-1) deltas
+        # for launches that FOLLOW a preemption (initial placement
+        # excluded — the herd under test is the recovery herd).
+        self._relaunch_deltas: List[Tuple[float, int]] = []
+        self._agent_ids = 0
+
+    # -- stubbed cloud --------------------------------------------------
+    def _storm_windows(self) -> List[Dict[str, Any]]:
+        return [w for w in faults.windows('jobs.monitor_probe')
+                if w['action'] == 'drop']
+
+    def _death_time(self, zone: str, up_since: float
+                    ) -> Optional[float]:
+        """When a cluster in `zone` (up from `up_since`) gets
+        preempted, per the installed plan's storm windows; None =
+        survives. A window scoped to another zone is ignored; an
+        unscoped window hits every zone."""
+        deaths = []
+        for w in self._storm_windows():
+            scoped = w['scope'].get('zone')
+            if scoped is not None and scoped != zone:
+                continue
+            if w['end_s'] <= up_since:
+                continue
+            deaths.append(max(w['start_s'], up_since))
+        return min(deaths) if deaths else None
+
+    def _place(self, job: _SimJob, relaunch: bool) -> str:
+        storm_zones = {w['scope'].get('zone')
+                       for w in self._storm_windows()}
+        storm_zones.discard(None)
+        if not relaunch and storm_zones:
+            # Seeded initial skew toward the storm zone(s): the bench
+            # controls how much of the fleet the storm hits.
+            if job.rng.random() < self.storm_frac:
+                return job.rng.choice(sorted(storm_zones))
+            pool = [z for z in self.zones if z not in storm_zones]
+            return job.rng.choice(pool or self.zones)
+        if relaunch:
+            # Preemptions cluster by zone capacity: recovery avoids
+            # the zone that just died (the strategy layer's
+            # eager-next-region intuition, applied by the stub
+            # provisioner's zone picker).
+            pool = [z for z in self.zones if z != job.zone]
+            return job.rng.choice(pool or self.zones)
+        return job.rng.choice(self.zones)
+
+    def _sim_launch(self, task, cluster_name=None, **kwargs):
+        """Stands in for `execution.launch` under the real
+        `_launch_with_retries`."""
+        del task, kwargs
+        job = self._jobs[cluster_name]
+        now = self.clock.now
+        relaunch = job.launches > 0
+        if relaunch:
+            self._relaunch_deltas.append((now, +1))
+        try:
+            # Provisioning occupies virtual time — this is what makes
+            # concurrent attempts OVERLAP and the herd measurable.
+            self.clock.sleep(self.launch_duration_s)
+        finally:
+            if relaunch:
+                self._relaunch_deltas.append((self.clock.now, -1))
+        now = self.clock.now
+        if relaunch and job.zone is not None and \
+                job.dead_at is not None:
+            # Close out the lost cluster: bill it up to its death,
+            # roll progress back to the last checkpoint.
+            self.segments_close(job, job.dead_at)
+            at_death = job.progress(job.dead_at)
+            rolled = (at_death // self.ckpt_every_s) * \
+                self.ckpt_every_s
+            job.lost_s += at_death - rolled
+            job.base = rolled
+            job.preemptions += 1
+        job.zone = self._place(job, relaunch=relaunch)
+        job.seg_start = now
+        job.dead_at = self._death_time(job.zone, now)
+        job.launches += 1
+        self._agent_ids += 1
+        return self._agent_ids, object()
+
+    @staticmethod
+    def segments_close(job: _SimJob, end: float) -> None:
+        job.segments.append((job.zone, job.seg_start, end))
+
+    def _make_agent(self, job: _SimJob):
+        sim = self
+
+        class _Agent:
+
+            def get_job(self, agent_job_id):
+                del agent_job_id
+                now = sim.clock.now
+                if job.cluster_dead(now):
+                    raise requests.RequestException(
+                        'simulated preemption: cluster gone')
+                if job.progress(now) >= job.work_s:
+                    if job.completed_at is None:
+                        job.completed_at = (job.seg_start +
+                                            (job.work_s - job.base))
+                        sim.segments_close(job, job.completed_at)
+                    from skypilot_tpu.agent import job_lib
+                    return {'status': job_lib.JobStatus.SUCCEEDED}
+                from skypilot_tpu.agent import job_lib
+                return {'status': job_lib.JobStatus.RUNNING}
+
+        return _Agent()
+
+    # -- run ------------------------------------------------------------
+    def run(self) -> Dict[str, Any]:
+        from skypilot_tpu.jobs import controller as ctrl_mod
+        from skypilot_tpu.jobs import recovery_strategy as rs
+        from skypilot_tpu.jobs import state
+        from skypilot_tpu import execution
+        from skypilot_tpu.utils import ux_utils
+
+        shim = _TimeShim(self.clock)
+        home = tempfile.mkdtemp(prefix='fleet-sim-')
+        saved_env = os.environ.get('SKYPILOT_TPU_HOME')
+        saved = {
+            'ctrl_time': ctrl_mod.time, 'rs_time': rs.time,
+            'state_time': state.time, 'launch': execution.launch,
+            'quiet': ux_utils._QUIET, 'plan': faults.get_plan(),
+        }
+        os.environ['SKYPILOT_TPU_HOME'] = home
+        ctrl_mod.time = shim
+        rs.time = shim
+        state.time = shim
+        execution.launch = self._sim_launch
+        ux_utils._QUIET = True
+        faults.install_plan(
+            faults.FaultPlan(self.plan_spec, clock=self.clock.time))
+        try:
+            return self._run_inner(ctrl_mod, state)
+        finally:
+            faults.install_plan(saved['plan'])
+            ctrl_mod.time = saved['ctrl_time']
+            rs.time = saved['rs_time']
+            state.time = saved['state_time']
+            execution.launch = saved['launch']
+            ux_utils._QUIET = saved['quiet']
+            if saved_env is None:
+                os.environ.pop('SKYPILOT_TPU_HOME', None)
+            else:
+                os.environ['SKYPILOT_TPU_HOME'] = saved_env
+
+    def _run_inner(self, ctrl_mod, state) -> Dict[str, Any]:
+        stagger_rng = random.Random(f'{self.seed}:stagger')
+        poll_s = ctrl_mod._POLL_SECONDS
+        workers: List[_Worker] = []
+        controllers = []
+        task_config = {
+            'name': 'fleet-sim',
+            'run': 'true',
+            'resources': {
+                'cloud': 'gcp',
+                'accelerators': self.accelerator,
+                'use_spot': True,
+                'job_recovery': {
+                    'strategy': 'failover',
+                    'launch_deadline_seconds': self.launch_deadline_s,
+                },
+            },
+        }
+        for i in range(self.num_jobs):
+            job_id = state.submit_job(
+                name=f'fleet-{i}', task_config=task_config,
+                strategy='failover', max_restarts_on_errors=0,
+                user='fleet-sim')
+            record = state.get_job(job_id)
+            sim_job = _SimJob(
+                job_id, record['cluster_name'], self.work_s,
+                self.ckpt_every_s,
+                rng=random.Random(f'{self.seed}:job:{i}'))
+            self._jobs[record['cluster_name']] = sim_job
+            ctrl = ctrl_mod.JobController(job_id)
+            ctrl.executor.jitter = self.jitter
+            # String seeds everywhere: random.Random(str) hashes via
+            # sha512 (stable across processes), while tuple seeds
+            # fall back to the per-process salted hash() and would
+            # silently break the byte-identical-JSON contract.
+            ctrl.executor.rng = random.Random(
+                f'{self.seed}:backoff:{i}')
+            ctrl._agent = (lambda j=sim_job: self._make_agent(j))
+            ctrl._zone = (lambda j=sim_job: j.zone)
+            controllers.append(ctrl)
+            workers.append(_Worker(
+                i, wake_at=stagger_rng.uniform(0.0, poll_s)))
+
+        def _body(worker: _Worker, ctrl) -> None:
+            self.clock.register(worker)
+            worker.go.wait()
+            worker.go.clear()
+            try:
+                ctrl.run()
+            except BaseException as e:  # noqa: BLE001
+                worker.error = e
+            finally:
+                worker.done = True
+                worker.yielded.set()
+
+        for worker, ctrl in zip(workers, controllers):
+            worker.thread = threading.Thread(
+                target=_body, args=(worker, ctrl), daemon=True)
+            worker.thread.start()
+        self.clock.run_all(workers)
+        for worker in workers:
+            worker.thread.join(timeout=60)
+        errors = [w.error for w in workers if w.error is not None]
+        if errors:
+            raise RuntimeError(
+                f'{len(errors)} fleet-sim workers crashed outside '
+                f'the controller: {errors[:3]!r}')
+        return self._summarize(state)
+
+    # -- reporting ------------------------------------------------------
+    def _summarize(self, state) -> Dict[str, Any]:
+        from skypilot_tpu.catalog import gcp_catalog
+        jobs = state.get_jobs()
+        statuses: Dict[str, int] = {}
+        for rec in jobs:
+            key = rec['status'].value
+            statuses[key] = statuses.get(key, 0) + 1
+        events = state.get_recovery_events()
+        latencies = sorted(
+            e['recovered_at'] - e['preempted_at'] for e in events
+            if e['recovered_at'] is not None)
+        open_events = sum(1 for e in events
+                          if e['recovered_at'] is None)
+        by_id = {j.job_id: j for j in self._jobs.values()}
+        hit = [j for j in by_id.values() if j.preemptions > 0]
+        hit_recovered = [
+            j for j in hit
+            if state.get_job(j.job_id)['status'] ==
+            state.ManagedJobStatus.SUCCEEDED]
+        zone_preemptions: Dict[str, int] = {}
+        for e in events:
+            z = e['zone'] or 'unknown'
+            zone_preemptions[z] = zone_preemptions.get(z, 0) + 1
+
+        cost = 0.0
+        for j in by_id.values():
+            for zone, start, end in j.segments:
+                hourly = gcp_catalog.get_accelerator_hourly_cost(
+                    self.accelerator, 1, use_spot=True, zone=zone)
+                cost += hourly * max(0.0, end - start) / 3600.0
+
+        hist, max_inflight = self._concurrency_histogram()
+        steps_lost = sum(j.lost_s for j in by_id.values()) / \
+            self.step_time_s
+        summary = {
+            'num_jobs': self.num_jobs,
+            'seed': self.seed,
+            'jitter': self.jitter,
+            'accelerator': self.accelerator,
+            'work_s': self.work_s,
+            'ckpt_every_s': self.ckpt_every_s,
+            'launch_duration_s': self.launch_duration_s,
+            'storm_windows': self._storm_windows(),
+            'final_statuses': dict(sorted(statuses.items())),
+            'storm_hit_jobs': len(hit),
+            'storm_hit_recovered': len(hit_recovered),
+            'preemptions_total': sum(j.preemptions
+                                     for j in by_id.values()),
+            'preemptions_by_zone': dict(
+                sorted(zone_preemptions.items())),
+            'recovery_events': len(events),
+            'recovery_events_open': open_events,
+            'recovery_latency_s': {
+                'p50': _pct(latencies, 50.0),
+                'p95': _pct(latencies, 95.0),
+                'p99': _pct(latencies, 99.0),
+                'max': latencies[-1] if latencies else None,
+            },
+            'steps_lost': steps_lost,
+            'tokens_lost': steps_lost * self.tokens_per_step,
+            'relaunch_concurrency': {
+                'max': max_inflight,
+                'histogram': hist,
+            },
+            'sim_cost_usd': cost,
+            'virtual_duration_s': self.clock.now,
+        }
+        return _round_floats(summary)
+
+    def _concurrency_histogram(self
+                               ) -> Tuple[Dict[str, float], int]:
+        """{inflight_level: virtual seconds spent there} over the
+        relaunch timeline, plus the peak level."""
+        deltas = sorted(self._relaunch_deltas)
+        hist: Dict[str, float] = {}
+        level = 0
+        peak = 0
+        prev_t: Optional[float] = None
+        for t, d in deltas:
+            if prev_t is not None and level > 0 and t > prev_t:
+                key = str(level)
+                hist[key] = hist.get(key, 0.0) + (t - prev_t)
+            level += d
+            peak = max(peak, level)
+            prev_t = t
+        return ({k: hist[k] for k in sorted(hist, key=int)}, peak)
+
+
+def _pct(sorted_vals: List[float], q: float) -> Optional[float]:
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def _round_floats(obj, ndigits: int = 3):
+    """Stable presentation (and a visual guard against wall-clock
+    values leaking in: every float in the summary is virtual-time or
+    catalog-derived, so rounding loses nothing that matters)."""
+    if isinstance(obj, float):
+        return round(obj, ndigits)
+    if isinstance(obj, dict):
+        return {k: _round_floats(v, ndigits) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_round_floats(v, ndigits) for v in obj]
+    return obj
+
+
+def default_storm_plan(zone: str = 'us-east5-b',
+                       seed: int = 2026) -> Dict[str, Any]:
+    """The canonical fleet-bench scenario (also committed as
+    examples/fault_plans/zone_storm.json): a zone-wide spot storm in
+    a seeded window, under a hard capacity crunch — EVERY launch
+    attempt inside the crunch window fails (a melting zone's
+    replacement capacity takes minutes to free up across the fleet),
+    so when capacity returns, every affected controller's retry
+    timer is what decides whether the relaunches arrive as a
+    thundering herd or a spread-out trickle. That is exactly the
+    regime `Backoff(jitter=True)` exists for, and what the fleet
+    bench's relaunch-concurrency histogram measures. The crunch
+    window [40, 150] covers any storm start drawn from [40, 60]
+    plus its 90s duration, and is comfortably shorter than the
+    backoff ladder's 10-attempt span, so no job can exhaust its
+    retry budget inside it."""
+    return {
+        'seed': seed,
+        'rules': [
+            {'point': 'jobs.preempt_storm',
+             'scope': {'zone': zone},
+             'start_range': [40.0, 60.0],
+             'duration_s': 90.0},
+            {'point': 'jobs.launch', 'action': 'raise',
+             'exc': 'skypilot_tpu.exceptions.'
+                    'ResourcesUnavailableError',
+             'message': 'spot capacity crunch after zone storm',
+             'start_s': 40.0,
+             'duration_s': 110.0},
+        ],
+    }

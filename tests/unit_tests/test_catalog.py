@@ -212,3 +212,58 @@ def test_newer_bundled_snapshot_beats_stale_cache(mirror):
     row = df[(df['InstanceType'] == 'n2-standard-8')
              & (df['AvailabilityZone'] == 'us-central1-a')]
     assert float(row['Price'].iloc[0]) == pytest.approx(0.388)  # bundled
+
+
+# ---------------------------------------------------------------------------
+# PreemptionRate column + spot-zone economics
+# ---------------------------------------------------------------------------
+def test_bundled_tpu_catalog_carries_preemption_rate():
+    df = gcp_catalog._tpu_df()
+    assert 'PreemptionRate' in df.columns
+    assert (df['PreemptionRate'] > 0).all()
+    # Bundled snapshot agrees with the generator (the CSV is the
+    # generator's frozen output; regenerating must not drift).
+    gen = gcp_catalog._generate_tpu_df()
+    assert set(gen.columns) == set(df.columns)
+    assert len(gen) == len(df)
+
+
+def test_get_preemption_rate_scopes_by_region_and_zone():
+    rate = gcp_catalog.get_preemption_rate('tpu-v5e-16')
+    assert rate is not None and rate > 0
+    pinned = gcp_catalog.get_preemption_rate('tpu-v5e-16',
+                                             zone='us-east5-b')
+    assert pinned == pytest.approx(
+        gcp_catalog._ZONE_PREEMPTION_RATE['us-east5-b'])
+    # Unpinned returns the best (min) matching zone's rate.
+    assert rate <= pinned
+    assert gcp_catalog.get_preemption_rate('a100') is None  # not TPU
+
+
+def test_spot_zone_economics_orders_by_risk_adjusted_price():
+    import pandas as pd
+    from skypilot_tpu.jobs import policy
+    econ = gcp_catalog.spot_zone_economics('tpu-v5e-16')
+    assert len(econ) >= 2
+    keys = [p * policy.effective_cost_multiplier(r)
+            for _, p, r in econ]
+    assert keys == sorted(keys)
+    # The flip the column exists for: a CHEAPER but stormier zone
+    # loses to a pricier stable one once risk is priced in.
+    synthetic = pd.DataFrame([
+        {'AcceleratorName': 'tpu-v5e-16', 'Region': 'r1',
+         'AvailabilityZone': 'r1-a', 'SpotPrice': 10.0,
+         'PreemptionRate': 2.0},
+        {'AcceleratorName': 'tpu-v5e-16', 'Region': 'r2',
+         'AvailabilityZone': 'r2-a', 'SpotPrice': 11.0,
+         'PreemptionRate': 0.05},
+    ])
+    assert (10.0 < 11.0 <
+            10.0 * policy.effective_cost_multiplier(2.0))
+    orig = gcp_catalog._tpu_df
+    gcp_catalog._tpu_df = lambda: synthetic
+    try:
+        flipped = gcp_catalog.spot_zone_economics('tpu-v5e-16')
+    finally:
+        gcp_catalog._tpu_df = orig
+    assert [z for z, _, _ in flipped] == ['r2-a', 'r1-a']

@@ -142,6 +142,119 @@ def test_delay_action_sleeps():
     assert time.monotonic() - t0 >= 0.025
 
 
+def test_scoped_rule_matches_only_its_context():
+    """A scope is an eligibility filter BEFORE hit counting: calls
+    outside the scope are invisible to the rule, so counters see
+    only the matching stream."""
+    faults.install_plan({'rules': [
+        {'point': 'jobs.monitor_probe', 'action': 'drop',
+         'scope': {'zone': 'us-east5-b'}, 'after': 1}]})
+    # No context / wrong zone: never matches, never counts.
+    assert faults.point('jobs.monitor_probe') is None
+    assert faults.point('jobs.monitor_probe',
+                        zone='us-west4-a') is None
+    # First in-zone hit is eligible but after=1 defers it; second
+    # fires — proving the wrong-zone calls above did not count.
+    assert faults.point('jobs.monitor_probe',
+                        zone='us-east5-b') is None
+    assert faults.point('jobs.monitor_probe',
+                        zone='us-east5-b') is faults.DROP
+    assert faults.stats()['jobs.monitor_probe'] == {'hits': 2,
+                                                    'fired': 1}
+
+
+def test_scope_multi_key_and_validation():
+    faults.install_plan({'rules': [
+        {'point': 'jobs.monitor_probe', 'action': 'drop',
+         'scope': {'zone': 'z1', 'job': '7'}}]})
+    assert faults.point('jobs.monitor_probe', zone='z1') is None
+    assert faults.point('jobs.monitor_probe', zone='z1',
+                        job='8') is None
+    assert faults.point('jobs.monitor_probe', zone='z1',
+                        job='7') is faults.DROP
+    with pytest.raises(ValueError, match='scope'):
+        faults.install_plan({'rules': [
+            {'point': 'jobs.monitor_probe',
+             'scope': {'zone': 1}}]})
+
+
+def test_windowed_rule_fires_only_inside_window():
+    t = {'now': 0.0}
+    faults.install_plan({'rules': [
+        {'point': 'jobs.launch', 'action': 'raise',
+         'exc': 'RuntimeError', 'start_s': 10.0,
+         'duration_s': 5.0}]}, clock=lambda: t['now'])
+    assert faults.point('jobs.launch') is None       # before
+    t['now'] = 12.0
+    with pytest.raises(RuntimeError):
+        faults.point('jobs.launch')
+    t['now'] = 15.0                                  # end exclusive
+    assert faults.point('jobs.launch') is None
+    with pytest.raises(ValueError, match='partial window'):
+        faults.install_plan({'rules': [
+            {'point': 'jobs.launch', 'start_s': 1.0}]})
+
+
+def test_preempt_storm_drops_probes_for_scoped_jobs_in_window():
+    """The derived point: one jobs.preempt_storm rule == a windowed,
+    zone-scoped drop on jobs.monitor_probe, with a SEEDED start."""
+    t = {'now': 0.0}
+    plan = faults.install_plan({'seed': 11, 'rules': [
+        {'point': 'jobs.preempt_storm',
+         'scope': {'zone': 'us-east5-b'},
+         'start_range': [20.0, 40.0], 'duration_s': 30.0}]},
+        clock=lambda: t['now'])
+    (window,) = plan.windows('jobs.monitor_probe')
+    assert 20.0 <= window['start_s'] < 40.0
+    assert window['end_s'] == pytest.approx(window['start_s'] + 30.0)
+    assert window['scope'] == {'zone': 'us-east5-b'}
+    # Same seed -> same storm start; different seed -> different.
+    again = faults.FaultPlan(
+        {'seed': 11, 'rules': [
+            {'point': 'jobs.preempt_storm',
+             'scope': {'zone': 'us-east5-b'},
+             'start_range': [20.0, 40.0], 'duration_s': 30.0}]},
+        clock=lambda: 0.0)
+    assert again.windows('jobs.monitor_probe')[0]['start_s'] == \
+        window['start_s']
+    other = faults.FaultPlan(
+        {'seed': 12, 'rules': [
+            {'point': 'jobs.preempt_storm',
+             'scope': {'zone': 'us-east5-b'},
+             'start_range': [20.0, 40.0], 'duration_s': 30.0}]},
+        clock=lambda: 0.0)
+    assert other.windows('jobs.monitor_probe')[0]['start_s'] != \
+        window['start_s']
+
+    t['now'] = window['start_s'] + 1.0
+    assert faults.point('jobs.monitor_probe',
+                        zone='us-east5-b', job='1') is faults.DROP
+    assert faults.point('jobs.monitor_probe',
+                        zone='us-east5-b', job='2') is faults.DROP
+    assert faults.point('jobs.monitor_probe',
+                        zone='us-west4-a', job='3') is None
+    t['now'] = window['end_s'] + 1.0
+    assert faults.point('jobs.monitor_probe',
+                        zone='us-east5-b', job='1') is None
+    # Stats report under the derived point's own name.
+    assert faults.stats()['jobs.preempt_storm']['fired'] == 2
+    # A storm without a window fails at install, not silently.
+    with pytest.raises(ValueError, match='requires a window'):
+        faults.install_plan({'rules': [
+            {'point': 'jobs.preempt_storm',
+             'scope': {'zone': 'z'}}]})
+
+
+def test_committed_example_storm_plan_installs():
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))),
+        'examples', 'fault_plans', 'zone_storm.json')
+    plan = faults.install_plan(path)
+    assert plan.windows('jobs.monitor_probe')
+    assert plan.windows('jobs.launch')
+
+
 # ---------------------------------------------------------------------------
 # Backoff jitter (satellite)
 # ---------------------------------------------------------------------------

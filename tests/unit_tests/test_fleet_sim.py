@@ -1,0 +1,231 @@
+"""Fleet-scale spot orchestration: storm simulation, herd-free
+relaunch, recovery-event timestamps, launch retry deadline.
+
+The tier-1 smoke runs N=20 real JobControllers through a zone-storm
+fault plan in virtual time (wall time: a few seconds); the N=500
+acceptance run lives in the slow tier and must reproduce the
+committed BENCH_fleet JSON's invariants.
+"""
+import json
+import os
+import random
+
+import pytest
+
+from skypilot_tpu.robustness import faults
+from skypilot_tpu.robustness import fleet_sim
+
+SEED = 7
+N_SMOKE = 20
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _run(n=N_SMOKE, seed=SEED, jitter=True, **kw):
+    return fleet_sim.FleetSim(
+        num_jobs=n, plan_spec=fleet_sim.default_storm_plan(),
+        seed=seed, jitter=jitter, **kw).run()
+
+
+# ---------------------------------------------------------------------------
+# tier-1 smoke: the whole tentpole at N=20
+# ---------------------------------------------------------------------------
+def test_storm_hits_fleet_and_every_job_recovers():
+    """A zone-wide probe-drop storm takes down a majority of a
+    20-job fleet; every hit job walks the real grace -> recover ->
+    relaunch path back to SUCCEEDED, with its lost work rolled back
+    to the last checkpoint."""
+    s = _run()
+    assert s['final_statuses'] == {'SUCCEEDED': N_SMOKE}
+    assert 0 < s['storm_hit_jobs'] <= N_SMOKE
+    assert s['storm_hit_recovered'] == s['storm_hit_jobs']
+    assert s['recovery_events'] >= s['storm_hit_jobs']
+    assert s['recovery_events_open'] == 0
+    # Storm scoping: every preemption happened in the storm zone.
+    assert set(s['preemptions_by_zone']) == {'us-east5-b'}
+    # Recovery latency comes from the recorded preempted_at /
+    # recovered_at pairs: detection needs the 30s grace window, so
+    # the floor is well above the poll interval.
+    assert s['recovery_latency_s']['p50'] > 5.0
+    assert s['recovery_latency_s']['max'] < 600.0
+    # Checkpoint rollback lost work, but bounded by
+    # preemptions x ckpt_every.
+    assert 0.0 < s['steps_lost'] <= \
+        s['preemptions_total'] * s['ckpt_every_s']
+    assert s['tokens_lost'] > 0
+    assert s['sim_cost_usd'] > 0
+
+
+def test_same_seed_same_plan_reproduces_identical_summary():
+    a, b = _run(), _run()
+    assert json.dumps(a, sort_keys=True) == \
+        json.dumps(b, sort_keys=True)
+    c = _run(seed=SEED + 1)
+    assert json.dumps(c, sort_keys=True) != \
+        json.dumps(a, sort_keys=True)
+
+
+def test_jittered_relaunch_bounds_the_herd():
+    """The acceptance invariant at smoke scale: with the capacity
+    crunch forcing every storm victim onto its retry timer, jittered
+    backoff keeps peak relaunch concurrency strictly below the
+    lockstep no-jitter herd."""
+    jit = _run()
+    herd = _run(jitter=False)
+    assert herd['final_statuses'] == {'SUCCEEDED': N_SMOKE}
+    assert 0 < jit['relaunch_concurrency']['max'] < \
+        herd['relaunch_concurrency']['max']
+    # The histogram's time-weighted levels are what the assertion
+    # reads from — sanity-check its integrity: levels are positive
+    # durations and the peak level appears in it.
+    hist = herd['relaunch_concurrency']['histogram']
+    assert all(v > 0 for v in hist.values())
+    assert str(herd['relaunch_concurrency']['max']) in hist
+
+
+def test_fleet_metrics_flow_through_observability_catalog():
+    from skypilot_tpu.observability import catalog as obs_catalog
+    zone_counter = obs_catalog.counter(
+        'skypilot_jobs_preemptions_total').labels(zone='us-east5-b')
+    before = zone_counter.value
+    s = _run()
+    assert zone_counter.value == before + s['preemptions_total']
+    # The in-flight gauge went up and came back down.
+    assert obs_catalog.gauge(
+        'skypilot_jobs_relaunch_inflight').value == 0
+
+
+# ---------------------------------------------------------------------------
+# recovery-event timestamps (jobs/state.py satellite)
+# ---------------------------------------------------------------------------
+def test_recovery_event_round_trip(isolated_state):
+    from skypilot_tpu.jobs import state
+    job_id = state.submit_job('evt', {'run': 'true'}, 'failover', 0,
+                              'tester')
+    state.record_preemption(job_id, 'us-east5-b')
+    events = state.get_recovery_events(job_id)
+    assert len(events) == 1
+    assert events[0]['zone'] == 'us-east5-b'
+    assert events[0]['preempted_at'] is not None
+    assert events[0]['recovered_at'] is None
+    state.record_recovered(job_id)
+    events = state.get_recovery_events(job_id)
+    assert events[0]['recovered_at'] >= events[0]['preempted_at']
+    # A second event closes independently of the first.
+    state.record_preemption(job_id, 'us-west4-a')
+    state.record_recovered(job_id)
+    events = state.get_recovery_events(job_id)
+    assert len(events) == 2
+    assert all(e['recovered_at'] is not None for e in events)
+    assert state.get_recovery_events() == events
+
+
+# ---------------------------------------------------------------------------
+# launch retry deadline (recovery_strategy satellite)
+# ---------------------------------------------------------------------------
+class _Task:
+    resources = ()
+
+
+def test_launch_retry_deadline_surfaces_failure(monkeypatch):
+    """A permanently failing launch stops retrying once the overall
+    deadline would be crossed, raising ResourcesUnavailableError
+    (-> FAILED_NO_RESOURCE at the controller) instead of spinning."""
+    from skypilot_tpu import exceptions
+    from skypilot_tpu.jobs import recovery_strategy as rs
+    monkeypatch.setattr(rs.time, 'sleep', lambda s: None)
+    ex = rs.StrategyExecutor('deadline-cluster', _Task())
+    ex.launch_deadline_s = 0.0     # first backoff already crosses it
+    faults.install_plan({'rules': [
+        {'point': 'jobs.launch', 'action': 'raise',
+         'exc': 'skypilot_tpu.exceptions.ResourcesUnavailableError',
+         'message': 'zone is gone'}]})
+    with pytest.raises(exceptions.ResourcesUnavailableError,
+                       match='retry deadline'):
+        ex._launch_with_retries(first_launch=False, max_attempts=10)
+    # Only ONE attempt was made: the deadline check runs before the
+    # backoff sleep, not after another futile round.
+    assert faults.stats()['jobs.launch']['hits'] == 1
+
+
+def test_launch_deadline_configurable_via_job_recovery():
+    from skypilot_tpu.jobs import recovery_strategy as rs
+
+    class _Res:
+        job_recovery = {'strategy': 'failover',
+                        'launch_deadline_seconds': 123.0}
+
+    class _TaskWithRecovery:
+        resources = (_Res(),)
+
+    ex = rs.StrategyExecutor('c', _TaskWithRecovery())
+    assert ex.launch_deadline_s == 123.0
+    default = rs.StrategyExecutor('c', _Task())
+    assert default.launch_deadline_s == \
+        rs._DEFAULT_LAUNCH_DEADLINE_SECONDS
+
+
+def test_seeded_backoff_rng_reproduces_schedule(monkeypatch):
+    """The fleet sim's determinism hook: an executor with a seeded
+    rng produces the same jittered retry schedule every time."""
+    from skypilot_tpu.jobs import recovery_strategy as rs
+
+    def schedule():
+        sleeps = []
+        monkeypatch.setattr(rs.time, 'sleep', sleeps.append)
+        ex = rs.StrategyExecutor('sched-cluster', _Task())
+        ex.rng = random.Random('42:backoff:0')
+        faults.install_plan({'rules': [
+            {'point': 'jobs.launch', 'action': 'raise',
+             'exc':
+             'skypilot_tpu.exceptions.ResourcesUnavailableError',
+             'times': 4}]})
+        monkeypatch.setattr(
+            rs.execution, 'launch', lambda task, **kw: (1, object()))
+        ex._launch_with_retries(first_launch=False, max_attempts=10)
+        return sleeps
+
+    a, b = schedule(), schedule()
+    assert a == b
+    assert len(a) == 4 and len(set(a)) > 1   # jittered, seeded
+
+
+# ---------------------------------------------------------------------------
+# N=500 acceptance run (slow tier) — must match the committed JSON
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+@pytest.mark.e2e
+def test_fleet_bench_n500_matches_committed_json(tmp_path):
+    """Re-runs the exact committed configuration and requires the
+    byte-identical BENCH_fleet JSON plus all acceptance checks: 100%
+    of storm-hit jobs recover, jitter peak strictly below the
+    no-jitter herd peak, deterministic replay."""
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    out = tmp_path / 'fleet.json'
+    subprocess.run(
+        [sys.executable,
+         os.path.join(repo, 'benchmarks', 'fleet_bench.py'),
+         '--jobs', '500', '--seed', '2026',
+         '--plan',
+         os.path.join(repo, 'examples', 'fault_plans',
+                      'zone_storm.json'),
+         '--out', str(out)],
+        check=True, capture_output=True, timeout=560)
+    got = json.loads(out.read_text())
+    assert all(got['checks'].values()), got['checks']
+    committed_path = os.path.join(repo, 'BENCH_fleet_r06.json')
+    committed = json.loads(open(committed_path).read())
+    assert got == committed, (
+        'N=500 storm run no longer reproduces BENCH_fleet_r06.json '
+        '— regenerate it (benchmarks/fleet_bench.py --jobs 500 '
+        '--seed 2026 --plan examples/fault_plans/zone_storm.json '
+        '--out BENCH_fleet_r06.json) and justify the behavior '
+        'change in the PR')

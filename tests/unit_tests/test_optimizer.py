@@ -80,6 +80,93 @@ def test_ordered_preference_beats_cost(all_clouds):
     assert task.best_resources.tpu_accelerator_name == 'tpu-v5e-64'
 
 
+def test_spot_pins_lowest_effective_risk_zone(all_clouds):
+    """The spot branch of _optimize_exact: equal list prices across
+    zones, so the catalog's PreemptionRate column decides — the
+    chosen candidate comes back PINNED to the zone minimizing
+    price x effective_cost_multiplier(rate), and its estimated cost
+    carries the risk multiplier."""
+    from skypilot_tpu.catalog import gcp_catalog
+    from skypilot_tpu.jobs import policy
+    task = sky.Task(run='true')
+    task.set_resources(sky.Resources(cloud='gcp',
+                                     accelerators='tpu-v5e-16',
+                                     use_spot=True))
+    Optimizer.optimize(_dag(task), quiet=True)
+    best = task.best_resources
+    econ = gcp_catalog.spot_zone_economics('tpu-v5e-16')
+    assert best.zone == econ[0][0]          # risk-ranked winner
+    zone, hourly, rate = econ[0]
+    assert rate == min(r for _, _, r in econ)  # equal prices here
+    expected = hourly * policy.effective_cost_multiplier(rate)
+    assert task.estimated_cost == pytest.approx(expected, rel=1e-6)
+    assert task.estimated_cost > hourly     # risk made it pricier
+
+
+def test_spot_blocked_zone_skips_to_next_effective(all_clouds):
+    """Blocked-candidate skip inside the spot branch: blocking the
+    risk-ranked best zone moves the pin to the runner-up; blocking
+    every zone surfaces ResourcesUnavailableError."""
+    from skypilot_tpu.catalog import gcp_catalog
+    econ = gcp_catalog.spot_zone_economics('tpu-v5e-16')
+
+    def optimize_with_blocked(zones):
+        task = sky.Task(run='true')
+        task.set_resources(sky.Resources(cloud='gcp',
+                                         accelerators='tpu-v5e-16',
+                                         use_spot=True))
+        blocked = {sky.Resources(cloud='gcp',
+                                 accelerators='tpu-v5e-16', zone=z)
+                   for z in zones}
+        Optimizer.optimize(_dag(task), blocked_resources=blocked,
+                           quiet=True)
+        return task.best_resources
+
+    assert optimize_with_blocked([econ[0][0]]).zone == econ[1][0]
+    with pytest.raises(exceptions.ResourcesUnavailableError,
+                       match='blocked'):
+        optimize_with_blocked([z for z, _, _ in econ])
+
+
+def test_on_demand_candidates_not_risk_adjusted(all_clouds):
+    """Non-spot candidates pass through untouched: no zone pin, raw
+    hourly cost."""
+    task = sky.Task(run='true')
+    task.set_resources(sky.Resources(cloud='gcp',
+                                     accelerators='tpu-v5e-16'))
+    Optimizer.optimize(_dag(task), quiet=True)
+    assert task.best_resources.zone is None
+    assert task.estimated_cost == pytest.approx(
+        task.best_resources.get_hourly_cost(), rel=1e-6)
+
+
+def test_checkpoint_cadence_policy_model():
+    """The Young/Daly helper the effective-cost score rests on."""
+    from skypilot_tpu.jobs import policy
+    # Optimum shrinks as zones get stormier...
+    calm = policy.optimal_checkpoint_interval(0.05)
+    stormy = policy.optimal_checkpoint_interval(0.5)
+    assert calm > stormy > policy.MIN_INTERVAL_S
+    # ...matches the closed form within the clamp...
+    import math
+    assert stormy == pytest.approx(
+        math.sqrt(2 * 60.0 / (0.5 / 3600.0)))
+    # ...and rate 0 (reserved capacity) costs nothing extra.
+    assert policy.optimal_checkpoint_interval(0.0) == \
+        policy.MAX_INTERVAL_S
+    assert policy.effective_cost_multiplier(0.0) == 1.0
+    m = [policy.effective_cost_multiplier(r)
+         for r in (0.05, 0.2, 0.5, 1.0)]
+    assert m == sorted(m) and m[0] > 1.0    # monotone in risk
+    # Deviating from the optimal cadence only raises overhead.
+    at_opt = policy.spot_overhead_fraction(0.5)
+    assert policy.spot_overhead_fraction(0.5, interval_s=30.0) > \
+        at_opt
+    assert policy.spot_overhead_fraction(0.5, interval_s=7200.0) > \
+        at_opt
+    assert policy.expected_restarts(0.5, 10.0) == pytest.approx(5.0)
+
+
 def test_blocked_region_excluded(all_clouds):
     task = sky.Task(run='true')
     task.set_resources(sky.Resources(cloud='gcp',
