@@ -34,12 +34,254 @@ import time
 import requests
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)  # fleet mode imports skypilot_tpu in-process
 
 
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(('127.0.0.1', 0))
         return s.getsockname()[1]
+
+
+def _build_server_cmd(args) -> list:
+    """serve_lm command line WITHOUT --port (single-server mode
+    appends one; fleet mode lets the replica manager assign them)."""
+    cmd = [sys.executable, '-m', 'skypilot_tpu.recipes.serve_lm',
+           '--model', args.model,
+           '--max-total-len', str(args.max_total_len)]
+    if args.engine == 'continuous':
+        cmd += ['--continuous-batching', '--num-slots',
+                str(args.num_slots)]
+    if args.no_prefix_caching:
+        cmd += ['--no-prefix-caching']
+    if args.speculative:
+        cmd += ['--speculative', str(args.speculative)]
+    if args.decode_chunk > 1:
+        cmd += ['--decode-chunk', str(args.decode_chunk)]
+    if args.prefill_chunk is not None:
+        cmd += ['--prefill-chunk', str(args.prefill_chunk)]
+    if args.prefill_budget is not None:
+        cmd += ['--prefill-budget', str(args.prefill_budget)]
+    if args.no_pipeline_decode:
+        cmd += ['--no-pipeline-decode']
+    if args.fault_plan:
+        cmd += ['--fault-plan', args.fault_plan]
+    if args.request_timeout is not None:
+        cmd += ['--request-timeout', str(args.request_timeout)]
+    if args.max_queue_requests is not None:
+        cmd += ['--max-queue-requests', str(args.max_queue_requests)]
+    if args.max_queue_tokens is not None:
+        cmd += ['--max-queue-tokens', str(args.max_queue_tokens)]
+    if args.hf:
+        cmd += ['--hf', args.hf]
+    if args.ckpt_dir:
+        cmd += ['--ckpt-dir', args.ckpt_dir]
+    if args.cpu:
+        cmd += ['--cpu']
+    return cmd
+
+
+def _fleet_prompts(args, vocab: int, rng) -> list:
+    """The fleet workload: random short prompts, each carrying one of
+    `--prefix-groups` distinct shared system prefixes (group = request
+    index mod groups — deterministic, interleaved). Multiple groups
+    are what separates the policies: under affinity each group pins to
+    one replica (its pages cached once); under round-robin every
+    replica pays and caches every group's pages."""
+    prompts = [[rng.randrange(1, vocab)
+                for _ in range(rng.randrange(4, 16))]
+               for _ in range(args.requests)]
+    if args.shared_prefix:
+        groups = max(1, args.prefix_groups)
+        systems = [[rng.randrange(1, vocab)
+                    for _ in range(args.shared_prefix)]
+                   for _ in range(groups)]
+        # Seeded-random group per request, NOT i % groups: a modulo
+        # assignment correlates with round-robin's i % replicas and
+        # accidentally pins groups under the control policy.
+        prompts = [systems[rng.randrange(groups)] + p
+                   for p in prompts]
+    return prompts
+
+
+def _run_fleet_once(args, policy_name: str) -> dict:
+    """One fleet run under one LB policy: spawn --replicas servers
+    behind the replica-plane LB, drive the workload through it,
+    report per-replica breakdown + affinity ratio."""
+    from skypilot_tpu.serve import autoscalers
+    from skypilot_tpu.serve import \
+        load_balancing_policies  # noqa: F401 (registers policies)
+    from skypilot_tpu.serve import service_spec as spec_lib
+    from skypilot_tpu.serve.replica_plane import (FleetController,
+                                                  ReplicaManager,
+                                                  make_lb_server)
+    from skypilot_tpu.serve.replica_plane import replica_manager as rm
+    from skypilot_tpu.utils.registry import LB_POLICY_REGISTRY
+
+    env = dict(os.environ)
+    env['PYTHONPATH'] = f"{REPO}:{env.get('PYTHONPATH', '')}"
+    if args.stub_replicas:
+        factory = rm.stub_factory(
+            extra_args=['--cache-pages', str(args.stub_cache_pages),
+                        '--token-sleep-ms', '1'],
+            env=env)
+    else:
+        factory = rm.serve_lm_factory(_build_server_cmd(args),
+                                      env=env)
+    spec = spec_lib.SkyServiceSpec(min_replicas=args.replicas,
+                                   max_replicas=args.replicas)
+    autoscaler = autoscalers.EngineMetricsAutoscaler(spec)
+    policy = LB_POLICY_REGISTRY.from_str(policy_name)()
+    manager = ReplicaManager(factory, drain_grace_s=30.0)
+    controller = FleetController(manager, policy, autoscaler,
+                                 interval_s=0.5)
+    lb_port = _free_port()
+    lb = make_lb_server(policy, lb_port, policy_name=policy_name,
+                        manager=manager)
+    lb_thread = threading.Thread(target=lb.serve_forever, daemon=True)
+    lb_thread.start()
+    url = f'http://127.0.0.1:{lb_port}'
+    try:
+        for _ in range(args.replicas):
+            manager.spawn()
+        if not controller.wait_ready(args.replicas, timeout_s=300):
+            raise RuntimeError(
+                f'fleet of {args.replicas} not ready within 300s')
+        info = requests.get(url, timeout=10).json()  # via LB
+        vocab = int(info['vocab_size'])
+
+        rng = random.Random(0)
+        prompts = _fleet_prompts(args, vocab, rng)
+        if not args.stub_replicas:
+            # Warm every replica's compile caches directly (through
+            # the LB, affinity would warm only each prompt's target).
+            warm = [min(prompts, key=len), max(prompts, key=len)]
+            for view in manager.views():
+                for p in warm:
+                    for _ in range(2):
+                        requests.post(
+                            f'http://{view.endpoint}/generate',
+                            json={'tokens': [p],
+                                  'max_new_tokens': 2}, timeout=600)
+
+        ticker = threading.Thread(target=controller.run, daemon=True)
+        ticker.start()
+
+        latencies = []
+        errors = [0]
+        shed = [0]
+        lock = threading.Lock()
+        queue = list(enumerate(prompts))
+
+        def client() -> None:
+            while True:
+                with lock:
+                    if not queue:
+                        return
+                    _idx, prompt = queue.pop()
+                t0 = time.perf_counter()
+                ttft = None
+                try:
+                    with requests.post(f'{url}/generate', json={
+                            'tokens': [prompt],
+                            'max_new_tokens': args.max_new_tokens,
+                            'stream': True}, timeout=600,
+                            stream=True) as resp:
+                        if resp.status_code == 429:
+                            with lock:
+                                shed[0] += 1
+                            continue
+                        if resp.status_code >= 500:
+                            with lock:
+                                errors[0] += 1
+                            continue
+                        for raw in resp.iter_lines():
+                            if not raw.startswith(b'data: '):
+                                continue
+                            if b'"token"' in raw and ttft is None:
+                                ttft = time.perf_counter() - t0
+                            if raw == b'data: [DONE]':
+                                break
+                except requests.RequestException:
+                    with lock:
+                        errors[0] += 1
+                    continue
+                total = time.perf_counter() - t0
+                with lock:
+                    latencies.append((ttft if ttft is not None
+                                      else total, total))
+
+        start = time.perf_counter()
+        threads = [threading.Thread(target=client)
+                   for _ in range(args.concurrency)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - start
+
+        manager.scrape_once()  # final per-replica stats
+        snap = lb.lb_metrics.snapshot()
+        views = sorted(manager.views(), key=lambda v: v.replica_id)
+        total_hits = sum(v.prefix_hits for v in views)
+        total_misses = sum(v.prefix_misses for v in views)
+        ttfts = sorted(l[0] for l in latencies)
+
+        def pct(sorted_vals, q):
+            if not sorted_vals:
+                return None
+            return round(1000 * sorted_vals[
+                int(q * (len(sorted_vals) - 1))], 2)
+
+        return {
+            'lb_policy': policy_name,
+            'replicas': args.replicas,
+            'requests': len(latencies),
+            'client_errors': errors[0],
+            'shed_requests': shed[0],
+            'req_per_sec': round(len(latencies) / elapsed, 2),
+            'p50_ttft_ms': pct(ttfts, 0.50),
+            'p95_ttft_ms': pct(ttfts, 0.95),
+            'affinity_hit_ratio': snap['affinity_hit_ratio'],
+            'lb_routed': snap['routed'],
+            'lb_retried': snap['retried'],
+            'fleet_prefix_hit_rate': round(
+                total_hits / max(total_hits + total_misses, 1), 4),
+            'per_replica': [{
+                'replica_id': v.replica_id,
+                'routed': snap['routed_per_replica'].get(
+                    v.endpoint, 0),
+                'prefix_hits': v.prefix_hits,
+                'prefix_misses': v.prefix_misses,
+                'prefix_hit_rate': round(v.prefix_hit_rate, 4),
+            } for v in views],
+        }
+    finally:
+        controller.shutdown()
+        lb.shutdown()
+
+
+def run_fleet(args) -> dict:
+    """The --replicas N mode: one run per policy (--ab-policies runs
+    prefix_affinity AND round_robin over the identical workload — the
+    committed BENCH_serve_fleet JSON)."""
+    policies = (['prefix_affinity', 'round_robin']
+                if args.ab_policies else [args.lb_policy])
+    runs = {name: _run_fleet_once(args, name) for name in policies}
+    if not args.ab_policies:
+        return runs[args.lb_policy]
+    return {
+        'bench': 'serve_fleet',
+        'engine': args.engine,
+        'model': args.model,
+        'replicas': args.replicas,
+        'requests': args.requests,
+        'concurrency': args.concurrency,
+        'shared_prefix': args.shared_prefix,
+        'prefix_groups': args.prefix_groups,
+        'stub_replicas': bool(args.stub_replicas),
+        'runs': runs,
+    }
 
 
 def main() -> None:
@@ -95,6 +337,39 @@ def main() -> None:
     parser.add_argument('--max-queue-tokens', type=int, default=None,
                         help='forwarded to serve_lm '
                              '--max-queue-tokens')
+    parser.add_argument('--replicas', type=int, default=0,
+                        metavar='N',
+                        help='multi-replica mode: N serve_lm '
+                             'processes behind the replica-plane LB '
+                             '(serve/replica_plane/); the JSON line '
+                             'gains a per-replica breakdown + '
+                             'affinity hit ratio. 0 = single server')
+    parser.add_argument('--lb-policy', default='prefix_affinity',
+                        help='replica-plane LB policy '
+                             '(prefix_affinity | round_robin | '
+                             'least_load)')
+    parser.add_argument('--ab-policies', action='store_true',
+                        help='run the identical fleet workload under '
+                             'prefix_affinity AND round_robin and '
+                             'emit one combined JSON object (the '
+                             'committed BENCH_serve_fleet record)')
+    parser.add_argument('--prefix-groups', type=int, default=8,
+                        metavar='G',
+                        help='fleet mode: number of DISTINCT shared '
+                             'system prompts (sessions); affinity '
+                             'pins each group to one replica while '
+                             'round-robin makes every replica cache '
+                             'every group')
+    parser.add_argument('--stub-replicas', action='store_true',
+                        help='fleet mode with model-free stub '
+                             'replicas (replica_plane/stub.py): '
+                             'deterministic control-plane smoke, no '
+                             'XLA — the tier-1 CI mode')
+    parser.add_argument('--stub-cache-pages', type=int, default=64,
+                        help='stub replica prefix-cache capacity '
+                             '(pages); bound it below the working '
+                             'set to make prefix duplication '
+                             'measurable')
     parser.add_argument('--repetitive', action='store_true',
                         help='structured (repeated-trigram) prompts — '
                              'the regime speculation accelerates')
@@ -115,40 +390,15 @@ def main() -> None:
         parser.error('--decode-chunk is a continuous-engine knob; '
                      'the one-shot engine would silently ignore it '
                      '(and the A/B record would lie)')
+    if args.stub_replicas and not args.replicas:
+        parser.error('--stub-replicas needs --replicas N')
+
+    if args.replicas:
+        print(json.dumps(run_fleet(args)))
+        return
 
     port = _free_port()
-    cmd = [sys.executable, '-m', 'skypilot_tpu.recipes.serve_lm',
-           '--model', args.model, '--port', str(port),
-           '--max-total-len', str(args.max_total_len)]
-    if args.engine == 'continuous':
-        cmd += ['--continuous-batching', '--num-slots',
-                str(args.num_slots)]
-    if args.no_prefix_caching:
-        cmd += ['--no-prefix-caching']
-    if args.speculative:
-        cmd += ['--speculative', str(args.speculative)]
-    if args.decode_chunk > 1:
-        cmd += ['--decode-chunk', str(args.decode_chunk)]
-    if args.prefill_chunk is not None:
-        cmd += ['--prefill-chunk', str(args.prefill_chunk)]
-    if args.prefill_budget is not None:
-        cmd += ['--prefill-budget', str(args.prefill_budget)]
-    if args.no_pipeline_decode:
-        cmd += ['--no-pipeline-decode']
-    if args.fault_plan:
-        cmd += ['--fault-plan', args.fault_plan]
-    if args.request_timeout is not None:
-        cmd += ['--request-timeout', str(args.request_timeout)]
-    if args.max_queue_requests is not None:
-        cmd += ['--max-queue-requests', str(args.max_queue_requests)]
-    if args.max_queue_tokens is not None:
-        cmd += ['--max-queue-tokens', str(args.max_queue_tokens)]
-    if args.hf:
-        cmd += ['--hf', args.hf]
-    if args.ckpt_dir:
-        cmd += ['--ckpt-dir', args.ckpt_dir]
-    if args.cpu:
-        cmd += ['--cpu']
+    cmd = _build_server_cmd(args) + ['--port', str(port)]
     env = dict(os.environ)
     env['PYTHONPATH'] = f"{REPO}:{env.get('PYTHONPATH', '')}"
     server = subprocess.Popen(cmd, env=env, stdout=subprocess.DEVNULL,

@@ -1,0 +1,112 @@
+"""Routing-affinity keys for the multi-replica LB.
+
+The prefix cache (models/batching.py PrefixCache) keys KV pages by a
+chain hash: key_i = sha256 of ALL prompt tokens through full page i.
+Two requests sharing a system prompt therefore share their leading
+chain keys — and the replica that served one of them already holds
+those KV pages. The replica-plane load balancer hashes the FIRST
+full-page chain key into its consistent-hash ring so such requests
+land on the same replica (serve/load_balancing_policies.py
+PrefixAffinityPolicy).
+
+This module re-derives the chain hash with numpy + hashlib only — an
+LB process must not pay a JAX import to route a request. Parity with
+`PrefixCache.chain_keys` is pinned by a unit test; if the page-hash
+scheme ever changes there, change it here too.
+
+Text endpoints (/generate_text, /v1/*) have no token ids at the LB
+(tokenization happens on the replica), so their key is a hash of the
+leading characters — an approximation of "same system prompt" that
+is exact for the dominant case (identical template prefixes).
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+#: Must match the engine's KV page size (models/batching.py default).
+DEFAULT_PAGE_SIZE = 16
+
+#: Leading characters hashed for text-prompt affinity. Long enough to
+#: span a realistic system prompt's distinctive part, short enough
+#: that per-user suffixes (appended after the template) don't split
+#: the group.
+TEXT_PREFIX_CHARS = 256
+
+
+def chain_keys(tokens: List[int], page_size: int) -> List[bytes]:
+    """One key per FULL page; identical to
+    models/batching.PrefixCache.chain_keys (parity-tested) without
+    importing the engine (and its JAX dependency)."""
+    keys = []
+    h = hashlib.sha256()
+    for i in range(len(tokens) // page_size):
+        chunk = tokens[i * page_size:(i + 1) * page_size]
+        h.update(np.asarray(chunk, np.int32).tobytes())
+        keys.append(h.digest())
+    return keys
+
+
+def token_affinity_key(tokens: List[int],
+                       page_size: int = DEFAULT_PAGE_SIZE
+                       ) -> Optional[str]:
+    """Affinity key for a token prompt: the FIRST full-page chain key
+    (hex). The first page commits to the first `page_size` tokens —
+    the shared-system-prompt signature — while later keys diverge as
+    soon as user content does. Prompts shorter than one page have no
+    cacheable full page, hence no key (caller falls back to
+    least-load)."""
+    keys = chain_keys(tokens, page_size)
+    if not keys:
+        return None
+    return keys[0].hex()
+
+
+def text_affinity_key(text: str) -> Optional[str]:
+    if not text:
+        return None
+    return hashlib.sha256(
+        text[:TEXT_PREFIX_CHARS].encode('utf-8', 'replace')).hexdigest()
+
+
+def request_affinity_key(path: str, body: Dict[str, Any],
+                         page_size: int = DEFAULT_PAGE_SIZE
+                         ) -> Optional[str]:
+    """Extract the routing key from a generation request body, by
+    endpoint shape. Returns None for anything unrecognized — the LB
+    then routes by load, never errors."""
+    try:
+        if path in ('/generate', '/v1/generate'):
+            tokens = body.get('tokens') or []
+            if tokens and isinstance(tokens[0], list):
+                tokens = tokens[0]
+            return token_affinity_key([int(t) for t in tokens],
+                                      page_size)
+        if path in ('/generate_text', '/v1/generate_text'):
+            prompts = body.get('prompts', '')
+            if isinstance(prompts, list):
+                prompts = prompts[0] if prompts else ''
+            return text_affinity_key(str(prompts))
+        if path == '/v1/completions':
+            prompt = body.get('prompt', '')
+            if isinstance(prompt, list):
+                prompt = prompt[0] if prompt else ''
+            return text_affinity_key(str(prompt))
+        if path == '/v1/chat/completions':
+            messages = body.get('messages') or []
+            # The system message IS the shared prefix; chats without
+            # one key on their first message (session affinity).
+            for message in messages:
+                if message.get('role') == 'system':
+                    return text_affinity_key(str(message.get('content',
+                                                             '')))
+            if messages:
+                return text_affinity_key(
+                    str(messages[0].get('content', '')))
+    except (TypeError, ValueError, KeyError, IndexError):
+        # Malformed bodies are the replica's 400 to give, not the
+        # LB's 500: route keyless.
+        return None
+    return None

@@ -119,6 +119,29 @@ SPECS: Dict[str, Tuple] = {
     'skypilot_serving_deadline_exceeded_total': (
         'counter', 'Requests answered 504: deadline expired while '
                    'queued or mid-decode', ()),
+    # -- replica plane (serve/replica_plane/: manager + LB front-end)
+    'skypilot_lb_requests_routed_total': (
+        'counter', 'Requests the replica-plane LB routed to a '
+                   'replica, by load-balancing policy (retries count '
+                   'once per attempt)', ('policy',)),
+    'skypilot_lb_requests_retried_total': (
+        'counter', 'Idempotent (not-yet-streamed) requests the LB '
+                   'retried on another replica after a replica died '
+                   'or refused, by policy', ('policy',)),
+    'skypilot_lb_affinity_requests_total': (
+        'counter', 'LB requests that carried a prefix-affinity '
+                   'routing key (a full prompt page)', ()),
+    'skypilot_lb_affinity_hits_total': (
+        'counter', 'Keyed LB requests routed to their affinity '
+                   'target (the replica already holding the prefix '
+                   'KV pages); hits/requests is the affinity hit '
+                   'ratio', ()),
+    'skypilot_replica_plane_replicas': (
+        'gauge', 'Local serve_lm replicas managed by the replica '
+                 'plane, by lifecycle state', ('state',)),
+    'skypilot_replica_plane_scrape_errors_total': (
+        'counter', 'Replica /stats-/readyz scrapes that failed '
+                   '(replica dead, hung, or malformed response)', ()),
     # -- managed jobs (jobs/controller.py + recovery_strategy.py)
     'skypilot_jobs_recovery_attempts_total': (
         'counter', 'Managed-job recovery attempts (cluster lost or '

@@ -1,0 +1,147 @@
+"""Multi-replica LM serving fleet on one host: N real `serve_lm`
+processes behind the replica-plane load balancer, autoscaled from
+scraped engine metrics.
+
+  python -m skypilot_tpu.recipes.serve_fleet \
+      --model llama-tiny --cpu --replicas 2 --max-replicas 4 \
+      --lb-port 9000 --lb-policy prefix_affinity
+
+The LB serves /generate, /generate_text and /v1/* on --lb-port with
+prefix-cache-affinity routing (requests sharing a system prompt land
+on the replica already holding those KV pages), /fleet/status with
+per-replica scraped state + LB counters, and /metrics. Scale-up
+triggers on engine pressure (prefill backlog tokens, queue depth,
+shed rate); scale-down always drains: the victim leaves the routing
+set, gets SIGTERM, finishes its in-flight requests, and only then
+exits. SIGTERM to THIS process drains the whole fleet.
+
+Chaos: --fault-plan is forwarded to every replica (the plan arms
+inside each serve_lm process; see docs/guides.md "Serving
+robustness"). Never in production.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import threading
+
+
+def build_replica_cmd(args: argparse.Namespace) -> list:
+    """The serve_lm command line shared by every replica (no --port:
+    the manager appends one per replica)."""
+    cmd = [sys.executable, '-m', 'skypilot_tpu.recipes.serve_lm',
+           '--model', args.model,
+           '--max-total-len', str(args.max_total_len),
+           '--continuous-batching',
+           '--num-slots', str(args.num_slots)]
+    if args.hf:
+        cmd += ['--hf', args.hf]
+    if args.ckpt_dir:
+        cmd += ['--ckpt-dir', args.ckpt_dir]
+    if args.prefill_chunk is not None:
+        cmd += ['--prefill-chunk', str(args.prefill_chunk)]
+    if args.max_queue_requests:
+        cmd += ['--max-queue-requests', str(args.max_queue_requests)]
+    if args.max_queue_tokens:
+        cmd += ['--max-queue-tokens', str(args.max_queue_tokens)]
+    if args.fault_plan:
+        cmd += ['--fault-plan', args.fault_plan]
+    if args.cpu:
+        cmd += ['--cpu']
+    return cmd
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--model', default='llama-tiny')
+    parser.add_argument('--hf', default=None)
+    parser.add_argument('--ckpt-dir', default=None)
+    parser.add_argument('--max-total-len', type=int, default=256)
+    parser.add_argument('--num-slots', type=int, default=8)
+    parser.add_argument('--prefill-chunk', type=int, default=None)
+    parser.add_argument('--max-queue-requests', type=int, default=0)
+    parser.add_argument('--max-queue-tokens', type=int, default=0)
+    parser.add_argument('--fault-plan', default=None, metavar='JSON')
+    parser.add_argument('--cpu', action='store_true')
+    parser.add_argument('--replicas', type=int, default=2,
+                        help='initial + minimum replica count')
+    parser.add_argument('--max-replicas', type=int, default=None,
+                        help='autoscaler ceiling (default: --replicas '
+                             '— fixed-size fleet)')
+    parser.add_argument('--lb-port', type=int,
+                        default=int(os.environ.get(
+                            'SKYPILOT_SERVE_PORT', 9000)))
+    parser.add_argument('--lb-policy', default='prefix_affinity',
+                        help='round_robin | least_load | '
+                             'prefix_affinity')
+    parser.add_argument('--page-size', type=int, default=16,
+                        help='affinity hashing page size; must match '
+                             'the engine KV page size')
+    parser.add_argument('--scrape-interval', type=float, default=1.0)
+    parser.add_argument('--drain-grace', type=float, default=630.0,
+                        help='seconds a draining replica gets to '
+                             'finish in-flight requests before '
+                             'SIGKILL')
+    parser.add_argument('--target-queue-per-replica', type=float,
+                        default=4.0)
+    parser.add_argument('--target-backlog-per-replica', type=float,
+                        default=4096.0)
+    parser.add_argument('--upscale-delay', type=float, default=10.0)
+    parser.add_argument('--downscale-delay', type=float, default=60.0)
+    args = parser.parse_args()
+
+    from skypilot_tpu.serve import autoscalers
+    from skypilot_tpu.serve import load_balancing_policies as lb_policies
+    from skypilot_tpu.serve import service_spec as spec_lib
+    from skypilot_tpu.serve.replica_plane import (FleetController,
+                                                  ReplicaManager,
+                                                  make_lb_server,
+                                                  serve_lm_factory)
+    from skypilot_tpu.utils.registry import LB_POLICY_REGISTRY
+
+    max_replicas = args.max_replicas or args.replicas
+    spec = spec_lib.SkyServiceSpec(
+        min_replicas=args.replicas, max_replicas=max_replicas,
+        upscale_delay_seconds=args.upscale_delay,
+        downscale_delay_seconds=args.downscale_delay)
+    autoscaler = autoscalers.EngineMetricsAutoscaler(
+        spec,
+        target_queue_per_replica=args.target_queue_per_replica,
+        target_backlog_per_replica=args.target_backlog_per_replica)
+    policy_cls = LB_POLICY_REGISTRY.from_str(args.lb_policy)
+    policy: lb_policies.LoadBalancingPolicy = policy_cls()
+
+    env = dict(os.environ)
+    manager = ReplicaManager(
+        serve_lm_factory(build_replica_cmd(args), env=env),
+        drain_grace_s=args.drain_grace)
+    controller = FleetController(manager, policy, autoscaler,
+                                 interval_s=args.scrape_interval)
+    lb = make_lb_server(policy, args.lb_port,
+                        policy_name=args.lb_policy, manager=manager,
+                        page_size=args.page_size)
+
+    def handle_term(signum, frame):  # noqa: ARG001
+        def _shutdown():
+            controller.shutdown()
+            lb.shutdown()
+        threading.Thread(target=_shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, handle_term)
+    for _ in range(args.replicas):
+        manager.spawn()
+    loop = threading.Thread(target=controller.run, daemon=True)
+    loop.start()
+    print(f'serve_fleet: LB on :{args.lb_port} '
+          f'policy={args.lb_policy} replicas={args.replicas}..'
+          f'{max_replicas} model={args.model}', flush=True)
+    try:
+        lb.serve_forever()
+    finally:
+        controller.shutdown()
+
+
+if __name__ == '__main__':
+    main()

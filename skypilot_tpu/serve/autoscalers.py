@@ -11,7 +11,7 @@ import dataclasses
 import enum
 import math
 import time
-from typing import List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from skypilot_tpu.serve import service_spec as spec_lib
 from skypilot_tpu.utils.registry import AUTOSCALER_REGISTRY
@@ -30,11 +30,57 @@ class AutoscalerDecision:
 
 
 class Autoscaler:
-    """Base: fixed replica count (no autoscaling)."""
+    """Base: fixed replica count (no autoscaling).
 
-    def __init__(self, spec: 'spec_lib.SkyServiceSpec') -> None:
+    Every scaler is clock-injectable: pass `clock` (a `time.time`-like
+    callable) and/or per-call `now`/`timestamp` values and decisions
+    become pure functions of (spec, signal history, time) — unit tests
+    and virtual-time simulators never sleep.
+    """
+
+    def __init__(self, spec: 'spec_lib.SkyServiceSpec',
+                 clock: Optional[Callable[[], float]] = None) -> None:
         self.spec = spec
         self.target_num_replicas = spec.min_replicas
+        self._clock = clock if clock is not None else time.time
+        self._upscale_candidate_since: Optional[float] = None
+        self._downscale_candidate_since: Optional[float] = None
+
+    def _now(self, now: Optional[float]) -> float:
+        return now if now is not None else self._clock()
+
+    # -- shared hysteresis + decision (used by every signal scaler) -----
+    def _apply_hysteresis(self, desired: int, now: float) -> None:
+        """Commit a target move only after it persisted for the
+        upscale/downscale delay."""
+        if desired > self.target_num_replicas:
+            self._downscale_candidate_since = None
+            if self._upscale_candidate_since is None:
+                self._upscale_candidate_since = now
+            if now - self._upscale_candidate_since >= \
+                    self.spec.upscale_delay_seconds:
+                self.target_num_replicas = desired
+                self._upscale_candidate_since = None
+        elif desired < self.target_num_replicas:
+            self._upscale_candidate_since = None
+            if self._downscale_candidate_since is None:
+                self._downscale_candidate_since = now
+            if now - self._downscale_candidate_since >= \
+                    self.spec.downscale_delay_seconds:
+                self.target_num_replicas = desired
+                self._downscale_candidate_since = None
+        else:
+            self._upscale_candidate_since = None
+            self._downscale_candidate_since = None
+
+    def _decide(self, total: int) -> AutoscalerDecision:
+        if total < self.target_num_replicas:
+            return AutoscalerDecision(AutoscalerDecisionOperator.SCALE_UP,
+                                      self.target_num_replicas)
+        if total > self.target_num_replicas:
+            return AutoscalerDecision(AutoscalerDecisionOperator.SCALE_DOWN,
+                                      self.target_num_replicas)
+        return AutoscalerDecision(AutoscalerDecisionOperator.NO_OP, total)
 
     @classmethod
     def make(cls, spec: 'spec_lib.SkyServiceSpec') -> 'Autoscaler':
@@ -49,6 +95,12 @@ class Autoscaler:
         # carries the spot floor/backfill mix.
         if isinstance(spec.target_qps_per_replica, dict):
             return InstanceAwareRequestRateAutoscaler(spec)
+        # Engine-metrics scaling needs no target_qps (its signals are
+        # scraped from the replicas), so it bypasses the
+        # autoscaling_enabled gate that requires one.
+        if getattr(spec, 'autoscaler', None) == 'engine_metrics' and \
+                spec.max_replicas > spec.min_replicas:
+            return EngineMetricsAutoscaler(spec)
         if spec.autoscaling_enabled:
             chosen = AUTOSCALER_REGISTRY.get(
                 getattr(spec, 'autoscaler', 'request_rate'))
@@ -96,17 +148,16 @@ class RequestRateAutoscaler(Autoscaler):
 
     _QPS_WINDOW_SECONDS = 60.0
 
-    def __init__(self, spec: 'spec_lib.SkyServiceSpec') -> None:
-        super().__init__(spec)
+    def __init__(self, spec: 'spec_lib.SkyServiceSpec',
+                 clock: Optional[Callable[[], float]] = None) -> None:
+        super().__init__(spec, clock)
         self._request_timestamps: List[float] = []
-        self._upscale_candidate_since: Optional[float] = None
-        self._downscale_candidate_since: Optional[float] = None
 
     # -- signal -----------------------------------------------------------
     def collect_request_information(self, num_requests: int,
                                     timestamp: Optional[float] = None
                                     ) -> None:
-        now = timestamp if timestamp is not None else time.time()
+        now = self._now(timestamp)
         self._request_timestamps.extend([now] * num_requests)
         self._trim(now)
 
@@ -116,49 +167,16 @@ class RequestRateAutoscaler(Autoscaler):
                                     if t >= cutoff]
 
     def current_qps(self, now: Optional[float] = None) -> float:
-        now = now if now is not None else time.time()
+        now = self._now(now)
         self._trim(now)
         return len(self._request_timestamps) / self._QPS_WINDOW_SECONDS
-
-    # -- decision ----------------------------------------------------------
-    def _apply_hysteresis(self, desired: int, now: float) -> None:
-        """Commit a target move only after it persisted for the
-        upscale/downscale delay (shared by every rate scaler)."""
-        if desired > self.target_num_replicas:
-            self._downscale_candidate_since = None
-            if self._upscale_candidate_since is None:
-                self._upscale_candidate_since = now
-            if now - self._upscale_candidate_since >= \
-                    self.spec.upscale_delay_seconds:
-                self.target_num_replicas = desired
-                self._upscale_candidate_since = None
-        elif desired < self.target_num_replicas:
-            self._upscale_candidate_since = None
-            if self._downscale_candidate_since is None:
-                self._downscale_candidate_since = now
-            if now - self._downscale_candidate_since >= \
-                    self.spec.downscale_delay_seconds:
-                self.target_num_replicas = desired
-                self._downscale_candidate_since = None
-        else:
-            self._upscale_candidate_since = None
-            self._downscale_candidate_since = None
-
-    def _decide(self, total: int) -> AutoscalerDecision:
-        if total < self.target_num_replicas:
-            return AutoscalerDecision(AutoscalerDecisionOperator.SCALE_UP,
-                                      self.target_num_replicas)
-        if total > self.target_num_replicas:
-            return AutoscalerDecision(AutoscalerDecisionOperator.SCALE_DOWN,
-                                      self.target_num_replicas)
-        return AutoscalerDecision(AutoscalerDecisionOperator.NO_OP, total)
 
     def evaluate(self, num_ready: int, num_launching: int,
                  now: Optional[float] = None,
                  ready_capacities: Optional[List[float]] = None
                  ) -> AutoscalerDecision:
         del ready_capacities  # uniform fleet: every replica equal
-        now = now if now is not None else time.time()
+        now = self._now(now)
         qps = self.current_qps(now)
         assert self.spec.target_qps_per_replica is not None
         desired = math.ceil(qps / self.spec.target_qps_per_replica)
@@ -177,15 +195,14 @@ class QueueLengthAutoscaler(Autoscaler):
     """
 
     def __init__(self, spec: 'spec_lib.SkyServiceSpec',
-                 target_queue_per_replica: Optional[float] = None) -> None:
-        super().__init__(spec)
+                 target_queue_per_replica: Optional[float] = None,
+                 clock: Optional[Callable[[], float]] = None) -> None:
+        super().__init__(spec, clock)
         self.target_queue_per_replica = (
             target_queue_per_replica if target_queue_per_replica
             is not None else getattr(spec, 'target_queue_per_replica',
                                      4.0))
         self._in_flight = 0
-        self._upscale_since: Optional[float] = None
-        self._downscale_since: Optional[float] = None
 
     def collect_request_information(self, num_requests: int,
                                     timestamp: Optional[float] = None
@@ -201,33 +218,12 @@ class QueueLengthAutoscaler(Autoscaler):
                  ready_capacities: Optional[List[float]] = None
                  ) -> AutoscalerDecision:
         del ready_capacities
-        now = now if now is not None else time.time()
+        now = self._now(now)
         desired = math.ceil(self._in_flight / self.target_queue_per_replica)
         desired = max(self.spec.min_replicas,
                       min(self.spec.max_replicas, desired))
-        total = num_ready + num_launching
-        if desired > self.target_num_replicas:
-            self._downscale_since = None
-            self._upscale_since = self._upscale_since or now
-            if now - self._upscale_since >= self.spec.upscale_delay_seconds:
-                self.target_num_replicas = desired
-                self._upscale_since = None
-        elif desired < self.target_num_replicas:
-            self._upscale_since = None
-            self._downscale_since = self._downscale_since or now
-            if now - self._downscale_since >= \
-                    self.spec.downscale_delay_seconds:
-                self.target_num_replicas = desired
-                self._downscale_since = None
-        else:
-            self._upscale_since = self._downscale_since = None
-        if total < self.target_num_replicas:
-            return AutoscalerDecision(AutoscalerDecisionOperator.SCALE_UP,
-                                      self.target_num_replicas)
-        if total > self.target_num_replicas:
-            return AutoscalerDecision(AutoscalerDecisionOperator.SCALE_DOWN,
-                                      self.target_num_replicas)
-        return AutoscalerDecision(AutoscalerDecisionOperator.NO_OP, total)
+        self._apply_hysteresis(desired, now)
+        return self._decide(num_ready + num_launching)
 
 
 @dataclasses.dataclass
@@ -296,8 +292,9 @@ class InstanceAwareRequestRateAutoscaler(SpotRequestRateAutoscaler):
     Hysteresis delays apply as in the base scaler.
     """
 
-    def __init__(self, spec: 'spec_lib.SkyServiceSpec') -> None:
-        super().__init__(spec)
+    def __init__(self, spec: 'spec_lib.SkyServiceSpec',
+                 clock: Optional[Callable[[], float]] = None) -> None:
+        super().__init__(spec, clock)
         assert isinstance(spec.target_qps_per_replica, dict), (
             'instance_aware autoscaler needs a {accelerator: qps} dict')
         self.qps_map = {str(k): float(v)
@@ -315,7 +312,7 @@ class InstanceAwareRequestRateAutoscaler(SpotRequestRateAutoscaler):
                  now: Optional[float] = None,
                  ready_capacities: Optional[List[float]] = None
                  ) -> AutoscalerDecision:
-        now = now if now is not None else time.time()
+        now = self._now(now)
         qps = self.current_qps(now)
         max_cap = max(self.qps_map.values())
         # Launching replicas are CREDITED at the largest-class capacity
@@ -349,3 +346,134 @@ class InstanceAwareRequestRateAutoscaler(SpotRequestRateAutoscaler):
                       min(self.spec.max_replicas, desired))
         self._apply_hysteresis(desired, now)
         return self._decide(num_ready + num_launching)
+
+
+@dataclasses.dataclass
+class EngineSignal:
+    """One replica's scraped engine pressure signals (from its JSON
+    `/stats`): what the inference engine actually knows about load,
+    as opposed to what the front-end counted arriving."""
+    queue_depth: int = 0
+    prefill_backlog_tokens: int = 0
+    requests_shed_total: int = 0  # lifetime counter as scraped
+
+
+@AUTOSCALER_REGISTRY.register(name='engine_metrics')
+class EngineMetricsAutoscaler(Autoscaler):
+    """Scale replica count from scraped ENGINE metrics, not request
+    counts.
+
+    The request-rate scalers model load as arrivals/sec, which is
+    blind to request cost: forty 16-token prompts and one 4k-token
+    prefill read identically. The serving engine already exports the
+    real pressure signals (PRs 2/4/5): queue depth (requests waiting
+    for a decode slot), prefill backlog tokens (admitted prompt
+    suffix not yet prefilled — the chunked-prefill scheduler's own
+    work queue), and the shed counter (admission control actively
+    answering 429). A replica-plane scraper feeds them in via
+    `observe()`; `evaluate()` is a pure function of (signals, time).
+
+    Scaling rule:
+      desired = max(ceil(total_queue / target_queue_per_replica),
+                    ceil(total_backlog / target_backlog_per_replica))
+    and while sheds are occurring within the shed window, at least
+    one replica above the current fleet (a bounded queue caps the
+    depth signal exactly when pressure is worst — the shed counter is
+    the overflow indicator). Hysteresis delays apply as in the rate
+    scalers; scale-down decisions are executed by the replica plane
+    through the drain contract (mark not-ready -> stop routing ->
+    SIGTERM -> wait drain), never kill-then-reroute.
+    """
+
+    _SHED_WINDOW_SECONDS = 60.0
+
+    def __init__(self, spec: 'spec_lib.SkyServiceSpec',
+                 clock: Optional[Callable[[], float]] = None,
+                 target_queue_per_replica: Optional[float] = None,
+                 target_backlog_per_replica: Optional[float] = None
+                 ) -> None:
+        super().__init__(spec, clock)
+        self.target_queue_per_replica = (
+            target_queue_per_replica if target_queue_per_replica
+            is not None else getattr(spec, 'target_queue_per_replica',
+                                     4.0))
+        self.target_backlog_per_replica = (
+            target_backlog_per_replica if target_backlog_per_replica
+            is not None else getattr(spec,
+                                     'target_backlog_per_replica',
+                                     4096.0))
+        self._signals: Dict[str, EngineSignal] = {}
+        self._last_shed_total: Dict[str, int] = {}
+        self._shed_events: List[Tuple[float, int]] = []
+
+    # -- signal ----------------------------------------------------------
+    def observe(self, replica: str, *, queue_depth: int = 0,
+                prefill_backlog_tokens: int = 0,
+                requests_shed_total: int = 0,
+                now: Optional[float] = None) -> None:
+        """One scrape of one replica. `requests_shed_total` is the
+        replica's lifetime counter; deltas between scrapes become
+        timestamped shed events for the rate window."""
+        now = self._now(now)
+        prev = self._last_shed_total.get(replica)
+        if prev is not None:
+            delta = requests_shed_total - prev
+            if delta > 0:
+                self._shed_events.append((now, delta))
+        self._last_shed_total[replica] = requests_shed_total
+        self._signals[replica] = EngineSignal(
+            queue_depth=queue_depth,
+            prefill_backlog_tokens=prefill_backlog_tokens,
+            requests_shed_total=requests_shed_total)
+        self._trim_sheds(now)
+
+    def forget(self, replica: str) -> None:
+        """Replica left the fleet (drained or died): drop its signals
+        so a dead replica's last-known backlog cannot hold the target
+        up forever. Shed events already recorded stay — the overload
+        they witnessed was real."""
+        self._signals.pop(replica, None)
+        self._last_shed_total.pop(replica, None)
+
+    def _trim_sheds(self, now: float) -> None:
+        cutoff = now - self._SHED_WINDOW_SECONDS
+        self._shed_events = [(t, n) for t, n in self._shed_events
+                             if t >= cutoff]
+
+    def shed_rate(self, now: Optional[float] = None) -> float:
+        """Sheds per second over the shed window."""
+        now = self._now(now)
+        self._trim_sheds(now)
+        return (sum(n for _, n in self._shed_events) /
+                self._SHED_WINDOW_SECONDS)
+
+    def total_queue_depth(self) -> int:
+        return sum(s.queue_depth for s in self._signals.values())
+
+    def total_backlog_tokens(self) -> int:
+        return sum(s.prefill_backlog_tokens
+                   for s in self._signals.values())
+
+    # -- decision --------------------------------------------------------
+    def evaluate(self, num_ready: int, num_launching: int,
+                 now: Optional[float] = None,
+                 ready_capacities: Optional[List[float]] = None
+                 ) -> AutoscalerDecision:
+        del ready_capacities  # engine signals already absorb capacity
+        now = self._now(now)
+        desired = max(
+            math.ceil(self.total_queue_depth() /
+                      self.target_queue_per_replica),
+            math.ceil(self.total_backlog_tokens() /
+                      self.target_backlog_per_replica))
+        total = num_ready + num_launching
+        if self.shed_rate(now) > 0:
+            # Admission control is rejecting traffic: the bounded
+            # queue caps queue_depth at its limit, so depth alone
+            # under-reads pressure exactly when it is worst. Grow
+            # beyond the live fleet until sheds stop.
+            desired = max(desired, total + 1)
+        desired = max(self.spec.min_replicas,
+                      min(self.spec.max_replicas, desired))
+        self._apply_hysteresis(desired, now)
+        return self._decide(total)

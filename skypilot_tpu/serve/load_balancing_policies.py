@@ -1,13 +1,20 @@
 """Load balancing policies.
 
 Reference: sky/serve/load_balancing_policies.py — RoundRobin (:88),
-LeastLoad (:114).
+LeastLoad (:114). The replica-plane LB (serve/replica_plane/lb.py)
+calls `select_replica(key=..., exclude=...)`: `key` is an optional
+routing key (the prefix-cache chain-key hash of the request, see
+inference/affinity.py) and `exclude` removes replicas that already
+failed this request (retry-on-death). Policies that ignore keys
+simply route as before.
 """
 from __future__ import annotations
 
+import bisect
 import collections
+import hashlib
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, Iterable, List, Optional, Set
 
 from skypilot_tpu.utils.registry import LB_POLICY_REGISTRY
 
@@ -27,7 +34,16 @@ class LoadBalancingPolicy:
     def _on_replicas_changed(self, replicas: List[str]) -> None:
         pass
 
-    def select_replica(self) -> Optional[str]:
+    def _candidates(self, exclude: Optional[Set[str]]) -> List[str]:
+        """Ready replicas minus the caller's exclusion set (replicas
+        that already failed this request). Callers hold `self._lock`."""
+        if not exclude:
+            return self.ready_replicas
+        return [r for r in self.ready_replicas if r not in exclude]
+
+    def select_replica(self, key: Optional[str] = None,
+                       exclude: Optional[Set[str]] = None
+                       ) -> Optional[str]:
         raise NotImplementedError
 
     def request_done(self, replica: str) -> None:
@@ -44,12 +60,15 @@ class RoundRobinPolicy(LoadBalancingPolicy):
     def _on_replicas_changed(self, replicas: List[str]) -> None:
         self._index = 0
 
-    def select_replica(self) -> Optional[str]:
+    def select_replica(self, key: Optional[str] = None,
+                       exclude: Optional[Set[str]] = None
+                       ) -> Optional[str]:
+        del key  # round-robin ignores routing keys
         with self._lock:
-            if not self.ready_replicas:
+            candidates = self._candidates(exclude)
+            if not candidates:
                 return None
-            replica = self.ready_replicas[self._index %
-                                          len(self.ready_replicas)]
+            replica = candidates[self._index % len(candidates)]
             self._index += 1
             return replica
 
@@ -62,11 +81,15 @@ class LeastLoadPolicy(LoadBalancingPolicy):
         super().__init__()
         self._in_flight: Dict[str, int] = collections.defaultdict(int)
 
-    def select_replica(self) -> Optional[str]:
+    def select_replica(self, key: Optional[str] = None,
+                       exclude: Optional[Set[str]] = None
+                       ) -> Optional[str]:
+        del key  # least-load ignores routing keys
         with self._lock:
-            if not self.ready_replicas:
+            candidates = self._candidates(exclude)
+            if not candidates:
                 return None
-            replica = min(self.ready_replicas,
+            replica = min(candidates,
                           key=lambda r: self._in_flight[r])
             self._in_flight[replica] += 1
             return replica
@@ -96,12 +119,134 @@ class InstanceAwareLeastLoadPolicy(LeastLoadPolicy):
         with self._lock:
             self._weights = {k: max(v, 1e-6) for k, v in weights.items()}
 
-    def select_replica(self) -> Optional[str]:
+    def select_replica(self, key: Optional[str] = None,
+                       exclude: Optional[Set[str]] = None
+                       ) -> Optional[str]:
+        del key
         with self._lock:
-            if not self.ready_replicas:
+            candidates = self._candidates(exclude)
+            if not candidates:
                 return None
             replica = min(
-                self.ready_replicas,
+                candidates,
                 key=lambda r: self._in_flight[r] / self._weights.get(r, 1.0))
+            self._in_flight[replica] += 1
+            return replica
+
+
+def _hash64(data: str) -> int:
+    """Stable 64-bit ring position (sha256 prefix — NOT Python's
+    salted hash(), which changes per process and would remap every
+    key on restart)."""
+    return int.from_bytes(
+        hashlib.sha256(data.encode()).digest()[:8], 'big')
+
+
+@LB_POLICY_REGISTRY.register(name='prefix_affinity')
+class PrefixAffinityPolicy(LeastLoadPolicy):
+    """Prefix-cache / session affinity via consistent hashing.
+
+    Requests sharing a system prompt carry the same routing key (the
+    PrefixCache chain-key hash of the prompt's first full KV page,
+    inference/affinity.py), and the key maps through a consistent-hash
+    ring to the replica that already holds those KV pages — prefill
+    skips recomputation there and the fleet stores one copy per
+    prefix instead of one per replica.
+
+    Properties the tests pin down:
+      - stability: while the ready set is unchanged, the same key
+        always routes to the same replica;
+      - minimal remap on death: the ring is per-replica virtual
+        nodes, so removing a replica moves ONLY its keys (survivors
+        keep theirs — their vnodes did not move);
+      - saturation fallback: when the affinity target is saturated
+        (in-flight cap or reported engine backlog over the threshold)
+        or not ready, the request falls back to the least-loaded
+        ready replica instead of queueing behind its favorite;
+      - keyless requests (no full prompt page, non-generation routes)
+        use plain least-load.
+    """
+
+    _VNODES = 64  # virtual nodes per replica: evens out key spread
+
+    def __init__(self, saturation_inflight: int = 32,
+                 saturation_backlog: Optional[float] = None) -> None:
+        super().__init__()
+        self.saturation_inflight = saturation_inflight
+        self.saturation_backlog = saturation_backlog
+        self._backlog: Dict[str, float] = {}
+        self._ring_points: List[int] = []
+        self._ring_owners: List[str] = []
+
+    # -- ring ------------------------------------------------------------
+    def _on_replicas_changed(self, replicas: List[str]) -> None:
+        ring = []
+        for replica in set(replicas):
+            for i in range(self._VNODES):
+                ring.append((_hash64(f'{replica}#{i}'), replica))
+        ring.sort()
+        self._ring_points = [p for p, _ in ring]
+        self._ring_owners = [r for _, r in ring]
+
+    def _ring_lookup(self, key: str,
+                     live: Iterable[str]) -> Optional[str]:
+        """First live owner clockwise from the key's ring position.
+        Walking (rather than filtering the ring) is what makes
+        exclusion minimal-movement too: keys whose owner is live
+        never move."""
+        if not self._ring_points:
+            return None
+        live_set = set(live)
+        if not live_set:
+            return None
+        start = bisect.bisect_left(self._ring_points, _hash64(key))
+        n = len(self._ring_owners)
+        for step in range(n):
+            owner = self._ring_owners[(start + step) % n]
+            if owner in live_set:
+                return owner
+        return None
+
+    # -- load signals ----------------------------------------------------
+    def set_replica_load(self, loads: Dict[str, float]) -> None:
+        """Scraped engine load per endpoint (prefill backlog tokens +
+        queue depth) — the saturation + fallback signal."""
+        with self._lock:
+            self._backlog = dict(loads)
+
+    def _load(self, replica: str) -> float:
+        return self._backlog.get(replica, 0.0) + self._in_flight[replica]
+
+    def _saturated(self, replica: str) -> bool:
+        if self._in_flight[replica] >= self.saturation_inflight:
+            return True
+        return (self.saturation_backlog is not None and
+                self._backlog.get(replica, 0.0) >=
+                self.saturation_backlog)
+
+    # -- selection -------------------------------------------------------
+    def affinity_target(self, key: Optional[str]) -> Optional[str]:
+        """The pure ring mapping for `key` over the current ready set
+        (no saturation, no exclusion) — what the LB compares the
+        routed replica against for the affinity-hit ratio."""
+        if key is None:
+            return None
+        with self._lock:
+            return self._ring_lookup(key, self.ready_replicas)
+
+    def select_replica(self, key: Optional[str] = None,
+                       exclude: Optional[Set[str]] = None
+                       ) -> Optional[str]:
+        with self._lock:
+            candidates = self._candidates(exclude)
+            if not candidates:
+                return None
+            replica = None
+            if key is not None:
+                replica = self._ring_lookup(key, candidates)
+                if replica is not None and self._saturated(replica):
+                    replica = None  # fall back below
+            if replica is None:
+                replica = min(candidates, key=self._load)
             self._in_flight[replica] += 1
             return replica

@@ -1,0 +1,36 @@
+"""Multi-replica serving plane: local replica manager, engine-metrics
+autoscaling, prefix-affinity load balancing, drain-before-kill.
+
+The serve controller (serve/service.py) orchestrates replicas as
+CLUSTERS — launch/terminate through the provisioning stack, probe
+readiness over HTTP. This package is the layer below it for the
+single-host / local-fleet case the paper's serving benchmarks run:
+REAL `serve_lm` server processes on distinct ports of this machine,
+scraped and routed directly:
+
+  - replica_manager.py: spawns/terminates serve_lm processes, scrapes
+    each replica's `/stats` + `/readyz` on an interval into shared
+    `ReplicaView`s, and executes the drain-before-kill contract
+    (mark not-ready -> stop routing -> SIGTERM -> wait for the
+    replica's own /readyz drain -> only then kill);
+  - fleet.py: the control loop wiring scraped engine signals into an
+    `EngineMetricsAutoscaler` (serve/autoscalers.py) and the routing
+    set into a load-balancing policy;
+  - lb.py: a streaming HTTP front-end routing /generate* and /v1/*
+    by prefix-cache chain-key affinity
+    (serve/load_balancing_policies.py PrefixAffinityPolicy,
+    inference/affinity.py), retrying idempotent not-yet-streamed
+    requests on replica death;
+  - stub.py: a model-free replica speaking the same control surface
+    (readyz/stats/generate+SSE, SIGTERM drain, prefix-cache
+    accounting) for deterministic tier-1 tests and bench smokes.
+
+Entry point: `python -m skypilot_tpu.recipes.serve_fleet`.
+"""
+from skypilot_tpu.serve.replica_plane.fleet import FleetController
+from skypilot_tpu.serve.replica_plane.lb import make_lb_server
+from skypilot_tpu.serve.replica_plane.replica_manager import (
+    ReplicaManager, ReplicaView, serve_lm_factory)
+
+__all__ = ['FleetController', 'ReplicaManager', 'ReplicaView',
+           'make_lb_server', 'serve_lm_factory']

@@ -1,0 +1,185 @@
+"""Fleet controller: scraped engine signals -> autoscaler -> replica
+manager, with every scale-down going through the drain contract.
+
+One `tick()`:
+  1. scrape every replica (/readyz + /stats into ReplicaViews);
+  2. cull replicas whose process or engine scheduler died (FAILED;
+     the only non-drain teardown — nothing left to drain);
+  3. push the ready set + load map into the LB policy (a draining or
+     dead replica stops receiving traffic HERE, before any signal is
+     sent to it);
+  4. feed engine signals to the autoscaler and evaluate;
+  5. SCALE_UP -> spawn; SCALE_DOWN -> drain-before-kill the
+     least-loaded victims in a background thread.
+
+Deterministic by injection: the manager's clock/http_get and the
+autoscaler's clock are injectable, so unit tests drive ticks with a
+virtual clock and stub scrapes — no sleeps.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional
+
+from skypilot_tpu.serve import autoscalers
+from skypilot_tpu.serve.replica_plane.replica_manager import (
+    ReplicaManager, ReplicaView)
+from skypilot_tpu.serve.serve_state import ReplicaStatus
+from skypilot_tpu.utils import ux_utils
+
+
+class FleetController:
+
+    def __init__(self, manager: ReplicaManager,
+                 policy, autoscaler: 'autoscalers.Autoscaler', *,
+                 interval_s: float = 1.0,
+                 clock: Optional[Callable[[], float]] = None,
+                 drain_in_thread: bool = True) -> None:
+        self.manager = manager
+        self.policy = policy
+        self.autoscaler = autoscaler
+        self.interval_s = interval_s
+        self._clock = clock if clock is not None else time.time
+        # Tests flip this off to make drains synchronous (ordering
+        # assertions without joins).
+        self._drain_in_thread = drain_in_thread
+        self._drain_threads: List[threading.Thread] = []
+        self._shutdown = threading.Event()
+
+    # -- scaling actions -------------------------------------------------
+    def _push_routing(self) -> None:
+        """Ready set + load map into the policy. The load map is the
+        affinity policy's saturation/fallback signal: engine-reported
+        prefill backlog tokens plus queue depth (token-dominated on
+        purpose — a 4k-token backlog is heavier than 4 queued short
+        requests)."""
+        ready = self.manager.ready_endpoints()
+        self.policy.set_ready_replicas(ready)
+        if hasattr(self.policy, 'set_replica_load'):
+            self.policy.set_replica_load({
+                v.endpoint:
+                    v.prefill_backlog_tokens + v.queue_depth
+                for v in self.manager.views()
+                if v.endpoint in ready})
+
+    def drain_replica(self, view: ReplicaView) -> None:
+        """THE drain contract, in order: mark not-ready -> stop
+        routing -> SIGTERM -> wait for the replica's own drain.
+        Never kill-then-reroute."""
+        self.manager.mark_draining(view.replica_id)
+        self._push_routing()  # routing stops BEFORE any signal
+        if hasattr(self.autoscaler, 'forget'):
+            self.autoscaler.forget(view.endpoint)
+        if self._drain_in_thread:
+            thread = threading.Thread(
+                target=self.manager.drain, args=(view.replica_id,),
+                daemon=True)
+            thread.start()
+            self._drain_threads.append(thread)
+        else:
+            self.manager.drain(view.replica_id)
+
+    def _pick_victims(self, candidates: List[ReplicaView],
+                      count: int) -> List[ReplicaView]:
+        """Least-valuable first: replicas still starting (nothing
+        in-flight, no hot KV pages), then the lowest engine load,
+        newest id as the tie-break."""
+        ordered = sorted(
+            candidates,
+            key=lambda v: (v.state != ReplicaStatus.STARTING,
+                           v.prefill_backlog_tokens + v.queue_depth,
+                           -v.replica_id))
+        return ordered[:max(0, count)]
+
+    # -- control loop ----------------------------------------------------
+    def tick(self, now: Optional[float] = None) -> None:
+        now = now if now is not None else self._clock()
+        self.manager.scrape_once()
+
+        # Cull replicas whose engine scheduler died: /readyz says 503
+        # forever, the process idles. Replace, don't drain — the
+        # in-flight work is already lost (crash-only containment).
+        for view in self.manager.views():
+            if view.state in (ReplicaStatus.READY,
+                              ReplicaStatus.NOT_READY) and \
+                    not view.engine_healthy:
+                ux_utils.error(f'replica {view.replica_id}: engine '
+                               'dead; replacing.')
+                self.manager.fail(view.replica_id)
+
+        self._push_routing()
+
+        views = self.manager.views()
+        ready = [v for v in views
+                 if v.state == ReplicaStatus.READY and v.ready]
+        launching = [v for v in views
+                     if v.state == ReplicaStatus.STARTING]
+
+        if isinstance(self.autoscaler,
+                      autoscalers.EngineMetricsAutoscaler):
+            for view in ready:
+                self.autoscaler.observe(
+                    view.endpoint,
+                    queue_depth=view.queue_depth,
+                    prefill_backlog_tokens=view.prefill_backlog_tokens,
+                    requests_shed_total=view.requests_shed_total,
+                    now=now)
+            for view in views:
+                if view.state.is_terminal():
+                    self.autoscaler.forget(view.endpoint)
+
+        decision = self.autoscaler.evaluate(len(ready), len(launching),
+                                            now=now)
+        op = autoscalers.AutoscalerDecisionOperator
+        if decision.operator == op.SCALE_UP:
+            want = (decision.target_num_replicas - len(ready) -
+                    len(launching))
+            for _ in range(max(0, want)):
+                view = self.manager.spawn()
+                ux_utils.log(f'fleet: scale-up -> replica '
+                             f'{view.replica_id} on :{view.port} '
+                             f'(target '
+                             f'{decision.target_num_replicas}).')
+        elif decision.operator == op.SCALE_DOWN:
+            excess = (len(ready) + len(launching) -
+                      decision.target_num_replicas)
+            for view in self._pick_victims(launching + ready, excess):
+                ux_utils.log(f'fleet: scale-down -> draining replica '
+                             f'{view.replica_id} (target '
+                             f'{decision.target_num_replicas}).')
+                self.drain_replica(view)
+
+        # Forget terminal views so `views()` stays bounded.
+        for view in views:
+            if view.state.is_terminal():
+                self.manager.remove(view.replica_id)
+
+    def run(self) -> None:
+        """Tick until shutdown() (the serve_fleet entrypoint's main
+        loop)."""
+        while not self._shutdown.is_set():
+            try:
+                self.tick()
+            except Exception as e:  # pylint: disable=broad-except
+                ux_utils.error(f'fleet tick failed: {e}')
+            self._shutdown.wait(self.interval_s)
+
+    def wait_ready(self, count: int, timeout_s: float = 300.0,
+                   poll_s: float = 0.2) -> bool:
+        """Block until `count` replicas are READY (spawn-time helper
+        for benches and the entrypoint)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            self.tick()
+            if len(self.manager.ready_endpoints()) >= count:
+                return True
+            if self._shutdown.wait(poll_s):
+                return False
+        return False
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        for thread in self._drain_threads:
+            thread.join(self.manager.drain_grace_s + 5.0)
+        self.manager.shutdown()

@@ -1,0 +1,272 @@
+"""Streaming HTTP load balancer for the replica plane.
+
+Routes `/generate*` and `/v1/*` POSTs across the fleet:
+
+  - prefix-cache / session affinity: the request body's chain-key
+    hash (inference/affinity.py — the PrefixCache page hash of the
+    prompt's first full KV page) is passed to the policy as the
+    routing key; under PrefixAffinityPolicy, requests sharing a
+    system prompt land on the replica already holding those pages,
+    falling back to least-backlog when the target is saturated or
+    not ready;
+  - retry-on-death: a replica that refuses the connection, drops it
+    before responding, or answers 503 (draining / engine dead) gets
+    the request retried on another replica — but ONLY while nothing
+    has been streamed to the client (once response headers are out,
+    a retry would corrupt the stream; the client sees truncation
+    instead, bounded to the dead replica's in-flight requests);
+  - streaming pass-through: SSE responses are forwarded chunk by
+    chunk as they arrive (TTFT through the LB is TTFT of the
+    replica, not of the full generation).
+
+Deliberately synchronous (ThreadingHTTPServer + requests), matching
+the replica's own server: one OS thread per in-flight proxied
+request is the honest cost model at local-fleet scale, and it keeps
+the hot path out of the async-blocking lint's reach by construction.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+from skypilot_tpu.inference import affinity
+from skypilot_tpu.observability import REGISTRY
+from skypilot_tpu.observability import catalog as obs_catalog
+from skypilot_tpu.utils import ux_utils
+
+#: Hop-by-hop headers never forwarded in either direction.
+_HOP_HEADERS = frozenset((
+    'connection', 'keep-alive', 'proxy-authenticate',
+    'proxy-authorization', 'te', 'trailers', 'transfer-encoding',
+    'upgrade', 'host', 'content-length'))
+
+#: Upstream statuses that mean "this replica cannot take the request
+#: right now" (draining, engine dead) rather than "the request is
+#: bad" — safe to retry elsewhere before anything was streamed.
+_RETRYABLE_STATUS = frozenset((502, 503))
+
+
+class LBMetrics:
+    """The LB's instrument bundle (one per policy label)."""
+
+    def __init__(self, policy_name: str) -> None:
+        self.routed = obs_catalog.counter(
+            'skypilot_lb_requests_routed_total').labels(
+                policy=policy_name)
+        self.retried = obs_catalog.counter(
+            'skypilot_lb_requests_retried_total').labels(
+                policy=policy_name)
+        self.affinity_requests = obs_catalog.counter(
+            'skypilot_lb_affinity_requests_total')
+        self.affinity_hits = obs_catalog.counter(
+            'skypilot_lb_affinity_hits_total')
+        # Window counters for /fleet/status (Prometheus children keep
+        # lifetime process totals across LB instances; these are THIS
+        # LB's, so the bench's affinity ratio is per-run).
+        self._lock = threading.Lock()
+        self.n_routed = 0
+        self.n_retried = 0
+        self.n_affinity = 0
+        self.n_affinity_hits = 0
+        self.routed_per_replica: Dict[str, int] = {}
+
+    def record_routed(self, replica: str) -> None:
+        self.routed.inc()
+        with self._lock:
+            self.n_routed += 1
+            self.routed_per_replica[replica] = \
+                self.routed_per_replica.get(replica, 0) + 1
+
+    def record_retried(self) -> None:
+        self.retried.inc()
+        with self._lock:
+            self.n_retried += 1
+
+    def record_affinity(self, hit: bool) -> None:
+        self.affinity_requests.inc()
+        with self._lock:
+            self.n_affinity += 1
+            if hit:
+                self.n_affinity_hits += 1
+        if hit:
+            self.affinity_hits.inc()
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                'routed': self.n_routed,
+                'retried': self.n_retried,
+                'affinity_requests': self.n_affinity,
+                'affinity_hits': self.n_affinity_hits,
+                'affinity_hit_ratio': round(
+                    self.n_affinity_hits / max(self.n_affinity, 1), 4),
+                'routed_per_replica': dict(self.routed_per_replica),
+            }
+
+
+def make_lb_server(policy, port: int, *, policy_name: str,
+                   manager=None, page_size: int = 16,
+                   max_retries: int = 2,
+                   upstream_timeout_s: float = 660.0,
+                   connect_timeout_s: float = 3.0
+                   ) -> ThreadingHTTPServer:
+    """Build (not yet serving) the LB. `policy` is a
+    LoadBalancingPolicy whose ready set the fleet controller keeps
+    current; `manager` (optional) feeds the /fleet/status surface.
+    The server exposes `.lb_metrics` for the bench harness."""
+    import requests as requests_lib
+
+    metrics = LBMetrics(policy_name)
+
+    class Handler(BaseHTTPRequestHandler):
+
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _json(self, obj, code=200):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header('Content-Type', 'application/json')
+            self.send_header('Content-Length', str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        # -- GET: plane surfaces + pass-through -------------------------
+        def do_GET(self):  # noqa: N802
+            if self.path == '/readyz':
+                ready = bool(policy.ready_replicas)
+                self._json({'ready': ready,
+                            'reasons': [] if ready
+                            else ['no ready replicas']},
+                           200 if ready else 503)
+                return
+            if self.path == '/fleet/status':
+                views = ([v.to_dict() for v in manager.views()]
+                         if manager is not None else [])
+                self._json({'replicas': views,
+                            'policy': policy_name,
+                            'lb': metrics.snapshot()})
+                return
+            if self.path == '/metrics':
+                body = REGISTRY.render().encode()
+                self.send_response(200)
+                self.send_header('Content-Type',
+                                 REGISTRY.CONTENT_TYPE)
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            # Anything else (/, /stats, /v1/models): pass through to
+            # one ready replica — fleet replicas are homogeneous.
+            self._proxy(body_bytes=None, key=None)
+
+        # -- POST: routed generation requests ---------------------------
+        def do_POST(self):  # noqa: N802
+            length = int(self.headers.get('Content-Length', 0))
+            body_bytes = self.rfile.read(length) if length else b''
+            key = None
+            try:
+                parsed = json.loads(body_bytes) if body_bytes else {}
+            except ValueError:
+                parsed = None  # replica's 400 to give; route keyless
+            if isinstance(parsed, dict):
+                key = affinity.request_affinity_key(
+                    self.path, parsed, page_size=page_size)
+            self._proxy(body_bytes=body_bytes, key=key)
+
+        def _proxy(self, body_bytes: Optional[bytes],
+                   key: Optional[str]) -> None:
+            tried = set()
+            for attempt in range(max_retries + 1):
+                replica = policy.select_replica(key=key,
+                                                exclude=tried)
+                if replica is None:
+                    self._json({'error': 'no ready replicas'}, 503)
+                    return
+                if attempt == 0 and key is not None and \
+                        hasattr(policy, 'affinity_target'):
+                    target = policy.affinity_target(key)
+                    metrics.record_affinity(hit=replica == target)
+                metrics.record_routed(replica)
+                try:
+                    done = self._forward(replica, body_bytes)
+                finally:
+                    policy.request_done(replica)
+                if done:
+                    return
+                # Not-yet-streamed failure: safe to retry elsewhere.
+                tried.add(replica)
+                metrics.record_retried()
+                ux_utils.log(f'LB: replica {replica} failed before '
+                             f'streaming; retrying '
+                             f'({attempt + 1}/{max_retries}).')
+            self._json({'error': 'all replicas failed'}, 502)
+
+        def _forward(self, replica: str,
+                     body_bytes: Optional[bytes]) -> bool:
+            """Proxy one attempt. True = the client got an answer
+            (including a truncated stream — headers are out); False =
+            nothing reached the client, retry is safe."""
+            url = f'http://{replica}{self.path}'
+            headers = {k: v for k, v in self.headers.items()
+                       if k.lower() not in _HOP_HEADERS}
+            try:
+                if body_bytes is None:
+                    upstream = requests_lib.get(
+                        url, headers=headers,
+                        timeout=(connect_timeout_s,
+                                 upstream_timeout_s), stream=True)
+                else:
+                    upstream = requests_lib.post(
+                        url, data=body_bytes, headers=headers,
+                        timeout=(connect_timeout_s,
+                                 upstream_timeout_s), stream=True)
+            except requests_lib.RequestException as e:
+                ux_utils.log(f'LB: upstream {replica} unreachable '
+                             f'({type(e).__name__}: {e}).')
+                return False
+            with upstream:
+                if upstream.status_code in _RETRYABLE_STATUS:
+                    return False
+                is_stream = 'text/event-stream' in \
+                    upstream.headers.get('Content-Type', '')
+                if not is_stream:
+                    try:
+                        content = upstream.content
+                    except requests_lib.RequestException as e:
+                        ux_utils.log(f'LB: upstream {replica} died '
+                                     f'mid-response ({e}).')
+                        return False
+                    self.send_response(upstream.status_code)
+                    for k, v in upstream.headers.items():
+                        if k.lower() not in _HOP_HEADERS:
+                            self.send_header(k, v)
+                    self.send_header('Content-Length',
+                                     str(len(content)))
+                    self.end_headers()
+                    self.wfile.write(content)
+                    return True
+                # SSE: headers out first, then chunks as they arrive.
+                self.send_response(upstream.status_code)
+                for k, v in upstream.headers.items():
+                    if k.lower() not in _HOP_HEADERS:
+                        self.send_header(k, v)
+                self.end_headers()
+                try:
+                    for chunk in upstream.iter_content(8192):
+                        if chunk:
+                            self.wfile.write(chunk)
+                            self.wfile.flush()
+                except (requests_lib.RequestException, OSError) as e:
+                    # Mid-stream replica death: the stream truncates
+                    # (bounded blast radius — exactly the in-flight
+                    # requests of the dead replica); never re-spliced.
+                    ux_utils.log(f'LB: stream from {replica} '
+                                 f'truncated ({type(e).__name__}).')
+                return True
+
+    server = ThreadingHTTPServer(('0.0.0.0', port), Handler)
+    server.lb_metrics = metrics  # type: ignore[attr-defined]
+    return server

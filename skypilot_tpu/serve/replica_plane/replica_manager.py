@@ -1,0 +1,364 @@
+"""Replica manager: real local serve_lm processes + engine scraping.
+
+Each replica is one `serve_lm` HTTP server process on its own port
+(spawned by an injectable factory, so tests substitute stub replicas
+or in-process handles). A scrape pass reads every live replica's
+`/readyz` and JSON `/stats` into its `ReplicaView` — queue depth,
+prefill backlog tokens, shed counter, prefix-cache hits — which the
+fleet controller feeds to the EngineMetricsAutoscaler and the LB
+policy's load map.
+
+Termination ALWAYS goes through the drain contract (`drain()`):
+  1. the view is marked DRAINING (the caller removes it from the
+     routing set before calling — see FleetController.drain_replica);
+  2. SIGTERM — the replica's own drain (inference/http_server.py)
+     flips its /readyz to 503 and finishes in-flight requests;
+  3. the manager waits for the process to exit on its own (bounded
+     by `drain_grace_s`); only on timeout does it SIGKILL.
+Never kill-then-reroute: a killed replica resets every in-flight
+stream; a drained one finishes them.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import signal as signal_lib
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from skypilot_tpu.observability import catalog as obs_catalog
+from skypilot_tpu.serve.serve_state import ReplicaStatus
+from skypilot_tpu.utils import ux_utils
+
+#: States a replica can occupy in the local plane (subset of the
+#: serve-state enum: there is no PROVISIONING — process spawn is
+#: instant — and no PREEMPTED).
+_LIVE_STATES = (ReplicaStatus.STARTING, ReplicaStatus.READY,
+                ReplicaStatus.NOT_READY)
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
+@dataclasses.dataclass
+class ReplicaView:
+    """One replica's last-scraped state, shared between the manager,
+    the autoscaler feed, and the LB status surface."""
+    replica_id: int
+    port: int
+    endpoint: str                      # '127.0.0.1:<port>'
+    state: ReplicaStatus
+    spawned_at: float
+    proc: Any = None                   # Popen-shaped handle
+    ready: bool = False
+    engine_healthy: bool = True
+    scrape_failures: int = 0           # consecutive
+    queue_depth: int = 0
+    prefill_backlog_tokens: int = 0
+    requests_shed_total: int = 0
+    prefix_hits: int = 0
+    prefix_misses: int = 0
+    last_stats: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def prefix_hit_rate(self) -> float:
+        return self.prefix_hits / max(self.prefix_hits +
+                                      self.prefix_misses, 1)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            'replica_id': self.replica_id,
+            'endpoint': self.endpoint,
+            'state': self.state.value,
+            'ready': self.ready,
+            'engine_healthy': self.engine_healthy,
+            'queue_depth': self.queue_depth,
+            'prefill_backlog_tokens': self.prefill_backlog_tokens,
+            'requests_shed_total': self.requests_shed_total,
+            'prefix_hits': self.prefix_hits,
+            'prefix_misses': self.prefix_misses,
+            'prefix_hit_rate': round(self.prefix_hit_rate, 4),
+        }
+
+
+def serve_lm_factory(base_cmd: List[str],
+                     env: Optional[Dict[str, str]] = None,
+                     quiet: bool = True
+                     ) -> Callable[[int, int], 'subprocess.Popen']:
+    """Factory spawning `serve_lm` subprocesses: `base_cmd` is the
+    full command line WITHOUT `--port` (appended per replica).
+    `python -m skypilot_tpu.recipes.serve_lm --model ... --cpu` is
+    the usual shape (recipes/serve_fleet.py builds it)."""
+
+    def spawn(replica_id: int, port: int) -> 'subprocess.Popen':
+        del replica_id
+        out = subprocess.DEVNULL if quiet else None
+        return subprocess.Popen(
+            base_cmd + ['--port', str(port)], env=env,
+            stdout=out, stderr=subprocess.STDOUT if quiet else None)
+
+    return spawn
+
+
+def stub_factory(extra_args: Optional[List[str]] = None,
+                 env: Optional[Dict[str, str]] = None
+                 ) -> Callable[[int, int], 'subprocess.Popen']:
+    """Factory spawning model-free stub replicas (stub.py) — the
+    deterministic fleet for bench smokes."""
+
+    def spawn(replica_id: int, port: int) -> 'subprocess.Popen':
+        cmd = [sys.executable, '-m',
+               'skypilot_tpu.serve.replica_plane.stub',
+               '--port', str(port), '--seed', str(replica_id)]
+        cmd += list(extra_args or [])
+        return subprocess.Popen(cmd, env=env)
+
+    return spawn
+
+
+def _default_http_get(url: str, timeout: float
+                      ) -> Tuple[int, Dict[str, Any]]:
+    import requests as requests_lib
+    resp = requests_lib.get(url, timeout=timeout)
+    try:
+        body = resp.json()
+    except ValueError:
+        body = {}
+    return resp.status_code, body
+
+
+class ReplicaManager:
+    """Owns the replica processes and their scraped views.
+
+    Injectables (all defaulted for production):
+      factory(replica_id, port) -> Popen-shaped handle
+          (.poll/.send_signal/.terminate/.kill/.wait);
+      http_get(url, timeout) -> (status_code, json_dict);
+      clock  -> monotonic seconds (virtual in tests);
+      on_event(name, view) -> lifecycle hook; tests assert ordering
+          of ('spawned','ready','not_ready','draining','sigterm',
+          'drained','killed','dead') events — in particular that
+          'draining' precedes 'sigterm' for every voluntary
+          termination.
+    """
+
+    def __init__(self, factory: Callable[[int, int], Any], *,
+                 startup_grace_s: float = 180.0,
+                 drain_grace_s: float = 30.0,
+                 scrape_timeout_s: float = 3.0,
+                 max_scrape_failures: int = 3,
+                 http_get: Optional[Callable] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 on_event: Optional[Callable] = None) -> None:
+        self._factory = factory
+        self.startup_grace_s = startup_grace_s
+        self.drain_grace_s = drain_grace_s
+        self.scrape_timeout_s = scrape_timeout_s
+        self.max_scrape_failures = max_scrape_failures
+        self._http_get = http_get or _default_http_get
+        self._clock = clock
+        self._on_event = on_event or (lambda name, view: None)
+        self._lock = threading.Lock()
+        self._replicas: Dict[int, ReplicaView] = {}
+        self._ids = itertools.count(1)
+        self._gauge = obs_catalog.gauge('skypilot_replica_plane_replicas')
+        self._scrape_errors = obs_catalog.counter(
+            'skypilot_replica_plane_scrape_errors_total')
+
+    # -- lifecycle -------------------------------------------------------
+    def spawn(self) -> ReplicaView:
+        with self._lock:
+            rid = next(self._ids)
+        port = free_port()
+        proc = self._factory(rid, port)
+        view = ReplicaView(replica_id=rid, port=port,
+                           endpoint=f'127.0.0.1:{port}',
+                           state=ReplicaStatus.STARTING,
+                           spawned_at=self._clock(), proc=proc)
+        with self._lock:
+            self._replicas[rid] = view
+        self._on_event('spawned', view)
+        return view
+
+    def views(self) -> List[ReplicaView]:
+        with self._lock:
+            return list(self._replicas.values())
+
+    def view(self, replica_id: int) -> Optional[ReplicaView]:
+        with self._lock:
+            return self._replicas.get(replica_id)
+
+    def ready_endpoints(self) -> List[str]:
+        with self._lock:
+            return [v.endpoint for v in self._replicas.values()
+                    if v.state == ReplicaStatus.READY and v.ready]
+
+    def mark_draining(self, replica_id: int) -> None:
+        """Step 1 of the drain contract: the replica leaves the
+        routing set (the caller pushes the shrunken ready set to the
+        LB policy before SIGTERM is sent)."""
+        view = self.view(replica_id)
+        if view is None or view.state not in _LIVE_STATES:
+            return
+        view.state = ReplicaStatus.DRAINING
+        view.ready = False
+        self._on_event('draining', view)
+
+    def drain(self, replica_id: int) -> None:
+        """Steps 2-3: SIGTERM, then wait for the replica's own drain
+        to finish (process exits 0 by itself); SIGKILL only past the
+        grace window. Blocking — callers wanting async run it in a
+        thread (FleetController does)."""
+        view = self.view(replica_id)
+        if view is None or view.proc is None:
+            return
+        if view.state != ReplicaStatus.DRAINING:
+            self.mark_draining(replica_id)
+        try:
+            view.proc.send_signal(signal_lib.SIGTERM)
+        except (OSError, ValueError) as e:
+            ux_utils.log(f'replica {replica_id}: SIGTERM failed '
+                         f'({e}); process likely already gone.')
+        self._on_event('sigterm', view)
+        deadline = self._clock() + self.drain_grace_s
+        while self._clock() < deadline:
+            if view.proc.poll() is not None:
+                view.state = ReplicaStatus.SHUTDOWN
+                self._on_event('drained', view)
+                return
+            time.sleep(0.05)
+        ux_utils.error(f'replica {replica_id}: drain grace '
+                       f'({self.drain_grace_s}s) expired; killing.')
+        try:
+            view.proc.kill()
+        except OSError as e:
+            ux_utils.log(f'replica {replica_id}: kill failed ({e}).')
+        view.state = ReplicaStatus.SHUTDOWN
+        self._on_event('killed', view)
+
+    def fail(self, replica_id: int) -> None:
+        """Involuntary teardown of a replica already observed dead
+        (process exited, engine scheduler died): make sure the
+        process is gone and mark FAILED so the controller replaces
+        it. This is the ONE path that skips the drain — there is
+        nothing left to drain."""
+        view = self.view(replica_id)
+        if view is None:
+            return
+        if view.proc is not None and view.proc.poll() is None:
+            try:
+                view.proc.kill()
+            except OSError as e:
+                ux_utils.log(f'replica {replica_id}: kill failed '
+                             f'({e}).')
+        view.state = ReplicaStatus.FAILED
+        view.ready = False
+        self._on_event('dead', view)
+
+    def remove(self, replica_id: int) -> None:
+        """Forget a terminal replica's view (keeps `views()` bounded
+        in long-running fleets)."""
+        with self._lock:
+            view = self._replicas.get(replica_id)
+            if view is not None and view.state.is_terminal():
+                del self._replicas[replica_id]
+
+    def shutdown(self) -> None:
+        """Drain every live replica, in parallel."""
+        live = [v for v in self.views() if v.state in _LIVE_STATES or
+                v.state == ReplicaStatus.DRAINING]
+        for view in live:
+            self.mark_draining(view.replica_id)
+        threads = [threading.Thread(target=self.drain,
+                                    args=(v.replica_id,), daemon=True)
+                   for v in live]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(self.drain_grace_s + 5.0)
+
+    # -- scraping --------------------------------------------------------
+    def scrape_once(self) -> None:
+        """One pass over live replicas: process liveness, /readyz,
+        /stats. HTTP happens outside the manager lock (a hung replica
+        must not block spawns)."""
+        for view in self.views():
+            if view.state not in _LIVE_STATES:
+                continue
+            if view.proc is not None and view.proc.poll() is not None:
+                # Exited without being asked: crashed or killed.
+                ux_utils.error(
+                    f'replica {view.replica_id} process exited '
+                    f'(rc={view.proc.poll()}); marking FAILED.')
+                view.state = ReplicaStatus.FAILED
+                view.ready = False
+                self._on_event('dead', view)
+                continue
+            self._scrape_replica(view)
+        self._update_gauges()
+
+    def _scrape_replica(self, view: ReplicaView) -> None:
+        base = f'http://{view.endpoint}'
+        try:
+            code, _body = self._http_get(f'{base}/readyz',
+                                         self.scrape_timeout_s)
+            ready = code == 200
+            _code, stats = self._http_get(f'{base}/stats',
+                                          self.scrape_timeout_s)
+        except Exception as e:  # pylint: disable=broad-except
+            view.scrape_failures += 1
+            self._scrape_errors.inc()
+            age = self._clock() - view.spawned_at
+            if view.state == ReplicaStatus.STARTING:
+                if age > self.startup_grace_s:
+                    ux_utils.error(
+                        f'replica {view.replica_id} not scrapeable '
+                        f'within {self.startup_grace_s}s ({e}); '
+                        f'failing it.')
+                    self.fail(view.replica_id)
+                return
+            if view.scrape_failures >= self.max_scrape_failures:
+                if view.ready or view.state == ReplicaStatus.READY:
+                    ux_utils.log(
+                        f'replica {view.replica_id}: '
+                        f'{view.scrape_failures} consecutive scrape '
+                        f'failures ({e}); marking NOT_READY.')
+                view.ready = False
+                view.state = ReplicaStatus.NOT_READY
+                self._on_event('not_ready', view)
+            return
+        view.scrape_failures = 0
+        view.ready = ready
+        view.last_stats = stats
+        view.queue_depth = int(stats.get('queued', 0) or 0)
+        view.prefill_backlog_tokens = int(
+            stats.get('prefill_backlog_tokens', 0) or 0)
+        view.requests_shed_total = int(
+            stats.get('requests_shed', 0) or 0)
+        view.engine_healthy = bool(stats.get('healthy', True))
+        prefix = stats.get('prefix_cache') or {}
+        view.prefix_hits = int(prefix.get('hits', 0) or 0)
+        view.prefix_misses = int(prefix.get('misses', 0) or 0)
+        if ready and view.state in (ReplicaStatus.STARTING,
+                                    ReplicaStatus.NOT_READY):
+            view.state = ReplicaStatus.READY
+            self._on_event('ready', view)
+        elif not ready and view.state == ReplicaStatus.READY:
+            view.state = ReplicaStatus.NOT_READY
+            self._on_event('not_ready', view)
+
+    def _update_gauges(self) -> None:
+        counts: Dict[str, int] = {}
+        for view in self.views():
+            counts[view.state.value] = counts.get(view.state.value,
+                                                  0) + 1
+        for status in ReplicaStatus:
+            self._gauge.labels(state=status.value).set(
+                counts.get(status.value, 0))

@@ -1,0 +1,380 @@
+"""Stub replica: serve_lm's control surface without the model.
+
+Speaks exactly the subset of the inference server the replica plane
+depends on — `GET /readyz` (503 while draining), `GET /healthz`,
+`GET /stats` (queued / prefill_backlog_tokens / requests_shed /
+prefix_cache), `POST /generate` with SSE streaming, and the SIGTERM
+drain contract (readyz flips 503, in-flight requests finish, process
+exits 0) — with real prefix-cache accounting: prompts are paged with
+the SAME chain-key hash the engine uses (inference/affinity.py), hit
+against a bounded per-replica LRU. Affinity routing therefore wins
+measurably on stubs for the same reason it wins on real replicas:
+pinning a prefix group to one replica stops every replica from
+paying (and caching) the same pages.
+
+Chaos knob: `--die-after-tokens K` crashes the process (exit 1) the
+moment its K-th token is emitted — a replica death mid-stream, with
+deterministic timing. Tier-1 chaos tests run the whole
+kill -> reroute -> replace -> no-extra-5xx loop on stubs; the slow
+e2e repeats it on real serve_lm processes.
+
+Run as a process: `python -m skypilot_tpu.serve.replica_plane.stub
+--port 0 --seed 3`. In-process (tests): `in_process_stub_factory()`
+returns a ReplicaManager-compatible factory whose handles expose
+`poll/send_signal/kill/wait` plus a `.die()` crash helper.
+"""
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import os
+import signal
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional
+
+from skypilot_tpu.inference import affinity
+
+
+class _StubDied(Exception):
+    """Raised inside a handler to abort its stream when the stub
+    'crashes' (in-process mode; subprocess mode just _exits)."""
+
+
+class StubState:
+    """Shared state of one stub replica (thread-safe via `lock`)."""
+
+    def __init__(self, *, seed: int, page_size: int, cache_pages: int,
+                 token_sleep_s: float, die_after_tokens: int,
+                 on_die: Optional[Callable[[], None]]) -> None:
+        self.seed = seed
+        self.page_size = page_size
+        self.cache_pages = cache_pages
+        self.token_sleep_s = token_sleep_s
+        self.die_after_tokens = die_after_tokens
+        self.on_die = on_die
+        self.lock = threading.Lock()
+        self.draining = threading.Event()
+        self.aborted = threading.Event()
+        self.inflight = 0
+        self.tokens_emitted = 0
+        self.requests_served = 0
+        # Prefix "page cache": chain key -> None, LRU order, bounded
+        # like the real page pool (evictions make duplicated prefixes
+        # expensive, exactly the pressure affinity routing removes).
+        self.cache: 'collections.OrderedDict[bytes, None]' = \
+            collections.OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        # Tests inject autoscaler pressure here (merged last into
+        # /stats): e.g. {'prefill_backlog_tokens': 99999}.
+        self.stats_overrides: Dict[str, Any] = {}
+
+    def account_pages(self, tokens: List[int]) -> None:
+        keys = affinity.chain_keys(tokens, self.page_size)
+        with self.lock:
+            for key in keys:
+                if key in self.cache:
+                    self.cache.move_to_end(key)
+                    self.hits += 1
+                else:
+                    self.cache[key] = None
+                    self.misses += 1
+                    while len(self.cache) > self.cache_pages:
+                        self.cache.popitem(last=False)
+                        self.evictions += 1
+
+    def emit_token(self) -> None:
+        """One token committed; fires the crash knob exactly at the
+        configured count."""
+        if self.aborted.is_set():
+            raise _StubDied()
+        with self.lock:
+            self.tokens_emitted += 1
+            fire = (self.die_after_tokens > 0 and
+                    self.tokens_emitted == self.die_after_tokens)
+        if fire:
+            self.aborted.set()
+            if self.on_die is not None:
+                self.on_die()
+                raise _StubDied()
+            os._exit(1)
+        if self.token_sleep_s > 0:
+            time.sleep(self.token_sleep_s)
+
+    def stats(self) -> Dict[str, Any]:
+        with self.lock:
+            body = {
+                'engine': 'stub',
+                'healthy': not self.aborted.is_set(),
+                'queued': self.inflight,
+                'prefill_backlog_tokens': 0,
+                'requests_shed': 0,
+                'requests_served': self.requests_served,
+                'tokens_emitted': self.tokens_emitted,
+                'prefix_cache': {
+                    'hits': self.hits,
+                    'misses': self.misses,
+                    'hit_rate': round(
+                        self.hits / max(self.hits + self.misses, 1),
+                        4),
+                    'evictions': self.evictions,
+                },
+            }
+            body.update(self.stats_overrides)
+        return body
+
+
+def make_stub_server(port: int, *, seed: int = 0, page_size: int = 16,
+                     cache_pages: int = 64,
+                     token_sleep_s: float = 0.0,
+                     die_after_tokens: int = 0,
+                     on_die: Optional[Callable[[], None]] = None
+                     ) -> ThreadingHTTPServer:
+    state = StubState(seed=seed, page_size=page_size,
+                      cache_pages=cache_pages,
+                      token_sleep_s=token_sleep_s,
+                      die_after_tokens=die_after_tokens,
+                      on_die=on_die)
+
+    class Handler(BaseHTTPRequestHandler):
+
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _json(self, obj, code=200):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header('Content-Type', 'application/json')
+            self.send_header('Content-Length', str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802
+            if self.path == '/healthz':
+                self._json({'status': 'alive'})
+                return
+            if self.path == '/readyz':
+                reasons = []
+                if state.draining.is_set():
+                    reasons.append('draining')
+                if state.aborted.is_set():
+                    reasons.append('engine dead')
+                self._json({'ready': not reasons, 'reasons': reasons},
+                           200 if not reasons else 503)
+                return
+            if self.path in ('/stats', '/v1/stats'):
+                self._json(state.stats())
+                return
+            self._json({'status': 'ok', 'model': 'stub',
+                        'vocab_size': 50000, 'max_total_len': 4096})
+
+        def do_POST(self):  # noqa: N802
+            if self.path not in ('/generate', '/v1/generate'):
+                self._json({'error': 'stub serves POST /generate'},
+                           404)
+                return
+            with state.lock:
+                state.inflight += 1
+            try:
+                self._generate()
+            except _StubDied:
+                # Crash simulation: the connection just breaks —
+                # the client sees a reset/truncation, as with a
+                # killed process.
+                self.close_connection = True
+            finally:
+                with state.lock:
+                    state.inflight -= 1
+                    state.requests_served += 1
+
+        def _generate(self):
+            length = int(self.headers.get('Content-Length', 0))
+            req = json.loads(self.rfile.read(length))
+            rows = req.get('tokens') or [[]]
+            if rows and not isinstance(rows[0], list):
+                rows = [rows]
+            max_new = int(req.get('max_new_tokens', 8))
+            stream = bool(req.get('stream'))
+            for row in rows:
+                state.account_pages([int(t) for t in row])
+            out_rows = []
+            if stream:
+                self.send_response(200)
+                self.send_header('Content-Type', 'text/event-stream')
+                self.send_header('Cache-Control', 'no-cache')
+                self.send_header('Connection', 'close')
+                self.end_headers()
+            for i, row in enumerate(rows):
+                produced = list(row)
+                for j in range(max_new):
+                    tok = (state.seed * 1000003 + len(row) * 31 +
+                           j) % 50000
+                    state.emit_token()
+                    produced.append(tok)
+                    if stream:
+                        self.wfile.write(
+                            b'data: ' +
+                            json.dumps({'index': i,
+                                        'token': tok}).encode() +
+                            b'\n\n')
+                        self.wfile.flush()
+                out_rows.append(produced)
+            if stream:
+                self.wfile.write(
+                    b'data: ' + json.dumps(
+                        {'done': True, 'tokens': out_rows}).encode() +
+                    b'\n\n')
+                self.wfile.write(b'data: [DONE]\n\n')
+                self.wfile.flush()
+            else:
+                self._json({'tokens': out_rows})
+
+    server = ThreadingHTTPServer(('127.0.0.1', port), Handler)
+    server.stub = state  # type: ignore[attr-defined]
+    return server
+
+
+class InProcessStubReplica:
+    """Popen-shaped handle over a threaded stub server, so
+    ReplicaManager drives in-process stubs exactly like subprocesses
+    — deterministically and without per-process interpreter costs in
+    tier-1."""
+
+    def __init__(self, port: int, **stub_kwargs: Any) -> None:
+        stub_kwargs.setdefault('on_die', self._die_from_handler)
+        self.server = make_stub_server(port, **stub_kwargs)
+        self.state: StubState = self.server.stub
+        self.port = self.server.server_address[1]
+        self._rc: Optional[int] = None
+        self._rc_lock = threading.Lock()
+        self._thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True)
+        self._thread.start()
+
+    # -- Popen surface ---------------------------------------------------
+    def poll(self) -> Optional[int]:
+        with self._rc_lock:
+            return self._rc
+
+    def send_signal(self, sig: int) -> None:
+        if sig != signal.SIGTERM:
+            self.kill()
+            return
+        if self.poll() is not None:
+            return
+        threading.Thread(target=self._drain, daemon=True).start()
+
+    def terminate(self) -> None:
+        self.send_signal(signal.SIGTERM)
+
+    def kill(self) -> None:
+        self._stop(-9)
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[int]:
+        deadline = (time.monotonic() + timeout
+                    if timeout is not None else None)
+        while self.poll() is None:
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError('stub did not exit')
+            time.sleep(0.01)
+        return self.poll()
+
+    # -- crash + drain helpers -------------------------------------------
+    def die(self, rc: int = 1) -> None:
+        """Abrupt death (test chaos helper): in-flight streams break,
+        new connections are refused."""
+        self.state.aborted.set()
+        self._stop(rc)
+
+    def _die_from_handler(self) -> None:
+        # Called from inside a handler thread when die_after_tokens
+        # fires: stop the server from ANOTHER thread (shutdown()
+        # joins the serve loop) and let the handler raise.
+        threading.Thread(target=self._stop, args=(1,),
+                         daemon=True).start()
+
+    def _drain(self) -> None:
+        """The serve_lm SIGTERM contract: readyz flips 503, in-flight
+        requests finish, then exit 0."""
+        self.state.draining.set()
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            with self.state.lock:
+                if self.state.inflight == 0:
+                    break
+            time.sleep(0.02)
+        self._stop(0)
+
+    def _stop(self, rc: int) -> None:
+        with self._rc_lock:
+            if self._rc is not None:
+                return
+            self._rc = rc
+        try:
+            self.server.shutdown()
+            self.server.server_close()
+        except OSError:
+            pass  # already closed
+
+
+def in_process_stub_factory(**stub_kwargs: Any
+                            ) -> Callable[[int, int],
+                                          InProcessStubReplica]:
+    """ReplicaManager factory for in-process stubs.
+    `per_replica` (optional: {replica_id: {kwargs}}) overrides knobs
+    for specific replicas — e.g. give replica 2 a die_after_tokens."""
+    per_replica = stub_kwargs.pop('per_replica', {})
+
+    def spawn(replica_id: int, port: int) -> InProcessStubReplica:
+        kwargs = dict(stub_kwargs)
+        kwargs.update(per_replica.get(replica_id, {}))
+        kwargs.setdefault('seed', replica_id)
+        return InProcessStubReplica(port, **kwargs)
+
+    return spawn
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--port', type=int, default=0)
+    parser.add_argument('--seed', type=int, default=0)
+    parser.add_argument('--page-size', type=int, default=16)
+    parser.add_argument('--cache-pages', type=int, default=64)
+    parser.add_argument('--token-sleep-ms', type=float, default=1.0)
+    parser.add_argument('--die-after-tokens', type=int, default=0)
+    args = parser.parse_args()
+
+    server = make_stub_server(
+        args.port, seed=args.seed, page_size=args.page_size,
+        cache_pages=args.cache_pages,
+        token_sleep_s=args.token_sleep_ms / 1000.0,
+        die_after_tokens=args.die_after_tokens, on_die=None)
+    state: StubState = server.stub
+
+    def _drain_loop():
+        state.draining.set()
+        time.sleep(0.2)  # stragglers
+        server.shutdown()
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            with state.lock:
+                if state.inflight == 0:
+                    break
+            time.sleep(0.02)
+        os._exit(0)
+
+    _term = threading.Event()
+    threading.Thread(target=lambda: (_term.wait(), _drain_loop()),
+                     daemon=True).start()
+    signal.signal(signal.SIGTERM, lambda *_: _term.set())
+    print(f'stub replica listening on '
+          f':{server.server_address[1]} seed={args.seed}', flush=True)
+    server.serve_forever()
+
+
+if __name__ == '__main__':
+    main()

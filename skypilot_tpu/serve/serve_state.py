@@ -33,6 +33,12 @@ class ReplicaStatus(enum.Enum):
     STARTING = 'STARTING'
     READY = 'READY'
     NOT_READY = 'NOT_READY'
+    # Drain-before-kill: the replica is out of the routing set and
+    # finishing its in-flight requests (its /readyz answers 503), but
+    # the process is still alive — the dashboard/status surfaces must
+    # distinguish this from SHUTTING_DOWN (teardown issued) and the
+    # terminal states.
+    DRAINING = 'DRAINING'
     SHUTTING_DOWN = 'SHUTTING_DOWN'
     PREEMPTED = 'PREEMPTED'
     FAILED = 'FAILED'

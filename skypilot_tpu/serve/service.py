@@ -238,8 +238,28 @@ class ServeController:
                 self._spot_placer is not None and not preempted:
             self._spot_placer.handle_release(meta['location'])
         cluster = self._replica_cluster(replica_id)
+        # Drain-before-kill: DRAINING marks the replica out of the
+        # routing set while its in-flight requests finish (the
+        # replica's own SIGTERM drain flips /readyz to 503); only
+        # then does teardown start. Status surfaces distinguish
+        # "draining" (still completing requests) from "shutting
+        # down" (teardown issued) and the terminal states.
         serve_state.set_replica_status(
-            self.name, replica_id, serve_state.ReplicaStatus.SHUTTING_DOWN)
+            self.name, replica_id, serve_state.ReplicaStatus.DRAINING)
+        endpoint = None
+        for replica in serve_state.get_replicas(self.name):
+            if replica['replica_id'] == replica_id:
+                endpoint = replica.get('endpoint')
+        if endpoint is not None:
+            # Stop routing NOW, not at the next reconcile: a request
+            # proxied to a replica whose cluster teardown has started
+            # is a guaranteed 502.
+            self.policy.set_ready_replicas(
+                [r for r in self.policy.ready_replicas
+                 if r != endpoint])
+        # The replica stays DRAINING through the teardown call: that
+        # is the window where its serve_lm process is finishing
+        # in-flight requests under its SIGTERM drain grace.
         from skypilot_tpu import core
         try:
             core.down(cluster)
@@ -272,7 +292,10 @@ class ServeController:
             return False
 
     # -- reconcile loop ----------------------------------------------------------
-    def reconcile_once(self) -> None:
+    def reconcile_once(self, now: Optional[float] = None) -> None:
+        # `now` is injectable (virtual-clock tests / simulators);
+        # defaults to the wall clock.
+        now = now if now is not None else time.time()
         self._refresh_service_record()
         replicas = serve_state.get_replicas(self.name)
         S = serve_state.ReplicaStatus
@@ -287,7 +310,8 @@ class ServeController:
         for replica in replicas:
             rid = replica['replica_id']
             status: serve_state.ReplicaStatus = replica['status']
-            if status in (S.SHUTTING_DOWN, S.SHUTDOWN, S.FAILED):
+            if status in (S.DRAINING, S.SHUTTING_DOWN, S.SHUTDOWN,
+                          S.FAILED):
                 continue
             if status in (S.PENDING, S.PROVISIONING):
                 launching += 1
@@ -316,7 +340,7 @@ class ServeController:
                         meta['counted_active'] = True
                 ready.append(replica)
             else:
-                age = time.time() - (replica.get('launched_at') or 0)
+                age = now - (replica.get('launched_at') or 0)
                 if status == S.READY:
                     serve_state.set_replica_status(self.name, rid,
                                                    S.NOT_READY)
@@ -337,12 +361,12 @@ class ServeController:
         old_active = [r for r in replicas
                       if r['version'] != self.version and
                       not r['status'].is_terminal() and
-                      r['status'] != S.SHUTTING_DOWN]
+                      r['status'] not in (S.DRAINING, S.SHUTTING_DOWN)]
         launching_new = sum(
             1 for r in replicas
             if r['version'] == self.version and
             not r['status'].is_terminal() and
-            r['status'] != S.SHUTTING_DOWN and
+            r['status'] not in (S.DRAINING, S.SHUTTING_DOWN) and
             r['replica_id'] not in ready_ids)
 
         # Autoscale against the current version only. Mixed fleets
@@ -422,7 +446,7 @@ class ServeController:
                 (r for r in replicas
                  if r['version'] == self.version and
                  not r['status'].is_terminal() and
-                 r['status'] != S.SHUTTING_DOWN),
+                 r['status'] not in (S.DRAINING, S.SHUTTING_DOWN)),
                 key=lambda r: (r['replica_id'] not in surplus_od_ids,
                                r['status'] == S.READY, _cap(r),
                                -r['replica_id']))

@@ -25,7 +25,8 @@ class SpotPlacer:
     def select(self) -> Location:
         raise NotImplementedError
 
-    def handle_preemption(self, location: Location) -> None:
+    def handle_preemption(self, location: Location,
+                          now: Optional[float] = None) -> None:
         pass
 
     def handle_active(self, location: Location) -> None:
@@ -65,8 +66,12 @@ class DynamicFallbackSpotPlacer(SpotPlacer):
         now = now if now is not None else time.time()
         return not any(self._is_cold(c, now) for c in self.candidates)
 
-    def handle_preemption(self, location: Location) -> None:
-        self._last_preempted[location] = time.time()
+    def handle_preemption(self, location: Location,
+                          now: Optional[float] = None) -> None:
+        # `now` is injectable like select()/all_hot() so virtual-clock
+        # tests and the fleet simulator stay deterministic.
+        self._last_preempted[location] = (now if now is not None
+                                          else time.time())
         self._active_counts[location] = max(
             0, self._active_counts[location] - 1)
 

@@ -65,10 +65,17 @@ def _summary() -> Dict[str, Any]:
     for s in serve_state.get_services():
         replicas = serve_state.get_replicas(s['name'])
         ready = sum(1 for r in replicas if r['status'].is_serving)
+        # Draining is surfaced separately from dead/shutting-down:
+        # "finishing in-flight requests, out of rotation" is routine
+        # scale-down, not an incident.
+        draining = sum(
+            1 for r in replicas
+            if r['status'] == serve_state.ReplicaStatus.DRAINING)
         services.append({
             'name': s['name'],
             'version': s['version'],
             'ready': ready,
+            'draining': draining,
             'total': len(replicas),
             'endpoint': (f'127.0.0.1:{s["lb_port"]}'
                          if s.get('lb_port') else None),
