@@ -132,7 +132,14 @@ def _run_fleet_once(args, policy_name: str) -> dict:
                                    max_replicas=args.replicas)
     autoscaler = autoscalers.EngineMetricsAutoscaler(spec)
     policy = LB_POLICY_REGISTRY.from_str(policy_name)()
-    manager = ReplicaManager(factory, drain_grace_s=30.0)
+    # --state-dir journals the bench fleet too (the per-policy
+    # subdir keeps the A/B arms' journals separate): benches double
+    # as adoption drills — SIGKILL the bench and the replicas can be
+    # adopted or reaped by a serve_fleet pointed at the same dir.
+    state_dir = (os.path.join(args.state_dir, policy_name)
+                 if args.state_dir else None)
+    manager = ReplicaManager(factory, drain_grace_s=30.0,
+                             state_dir=state_dir)
     controller = FleetController(manager, policy, autoscaler,
                                  interval_s=0.5)
     lb_port = _free_port()
@@ -370,6 +377,11 @@ def main() -> None:
                              '(pages); bound it below the working '
                              'set to make prefix duplication '
                              'measurable')
+    parser.add_argument('--state-dir', default=None, metavar='DIR',
+                        help='fleet mode: journal replica lifecycle '
+                             'to DIR/<policy>/fleet.journal (the '
+                             'crash-only controller contract; see '
+                             'serve_fleet --state-dir)')
     parser.add_argument('--repetitive', action='store_true',
                         help='structured (repeated-trigram) prompts — '
                              'the regime speculation accelerates')

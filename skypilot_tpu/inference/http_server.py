@@ -31,6 +31,7 @@ import os
 import signal
 import threading
 import time
+import uuid as uuid_lib
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List
 
@@ -43,6 +44,17 @@ from skypilot_tpu.robustness import faults
 from skypilot_tpu.robustness.errors import (DeadlineExceededError,
                                             EngineDeadError,
                                             QueueSaturatedError)
+
+
+#: This process's replica instance identity, echoed in `GET /stats`.
+#: The replica plane's manager journals the UUID it handed the
+#: process at spawn (STPU_REPLICA_INSTANCE_UUID) and, on controller
+#: restart, adopts a pid/port only if the echo matches — a recycled
+#: pid or a stranger's server on the old port fails the check.
+#: Standalone servers mint their own (adoption simply never matches
+#: a replica the journal does not know).
+INSTANCE_UUID = (os.environ.get('STPU_REPLICA_INSTANCE_UUID') or
+                 uuid_lib.uuid4().hex)
 
 
 def classify_error(e: Exception):
@@ -200,7 +212,9 @@ def make_server(rt: InferenceRuntime,
             (GET /metrics carries the same signals as lifetime
             Prometheus series)."""
             engine = rt.engine
-            body = {'serving': rt.metrics.snapshot()}
+            body = {'serving': rt.metrics.snapshot(),
+                    'instance_uuid': INSTANCE_UUID,
+                    'pid': os.getpid()}
             if engine is None:
                 body['engine'] = 'simple'
                 self._json(body)

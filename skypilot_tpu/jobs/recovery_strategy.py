@@ -98,6 +98,36 @@ class StrategyExecutor:
         id (strategy-specific)."""
         raise NotImplementedError
 
+    def _checkpoint_preflight(self) -> Optional[Dict[str, Any]]:
+        """Controller-side dry run of the job's restore fallback:
+        when `job_recovery.checkpoint_dir` names a LOCAL checkpoint
+        directory, verify its sha256 manifests before relaunching so
+        the operator learns up front which step the relaunched job
+        will actually resume from (the recipe's CheckpointManager
+        falls back past corrupt steps on its own — this is the
+        early-warning surface, not a gate; remote gs://-s3:// dirs
+        are left to the object store's checksums). Never raises."""
+        ckpt_dir = None
+        for r in self.task.resources:
+            if r.job_recovery and r.job_recovery.get('checkpoint_dir'):
+                ckpt_dir = str(r.job_recovery['checkpoint_dir'])
+                break
+        if not ckpt_dir or ckpt_dir.startswith(('gs://', 's3://')):
+            return None
+        from skypilot_tpu.parallel import ckpt_integrity
+        report = ckpt_integrity.preflight(os.path.expanduser(ckpt_dir))
+        if report['corrupt_steps']:
+            ux_utils.error(
+                f'{self.cluster_name}: checkpoint step(s) '
+                f'{report["corrupt_steps"]} in {ckpt_dir} failed '
+                f'integrity verification; the relaunched job will '
+                f'fall back to step {report["newest_verifying"]}.')
+        elif report['steps']:
+            ux_utils.log(
+                f'{self.cluster_name}: checkpoint preflight clean — '
+                f'resuming from step {report["newest_verifying"]}.')
+        return report
+
     def terminate_cluster(self) -> None:
         from skypilot_tpu import core
         try:
@@ -204,6 +234,7 @@ class FailoverStrategyExecutor(StrategyExecutor):
 
     def recover(self) -> int:
         _count_recovery_attempt(self.NAME)
+        self._checkpoint_preflight()
         self.terminate_cluster()
         # Same resources, same preference order: the retrying
         # provisioner already walks zones/regions in order.
@@ -223,6 +254,7 @@ class EagerNextRegionStrategyExecutor(StrategyExecutor):
 
     def recover(self) -> int:
         _count_recovery_attempt(self.NAME)
+        self._checkpoint_preflight()
         from skypilot_tpu import global_state
         record = global_state.get_cluster(self.cluster_name)
         if record is not None:

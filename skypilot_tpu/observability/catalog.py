@@ -142,6 +142,34 @@ SPECS: Dict[str, Tuple] = {
     'skypilot_replica_plane_scrape_errors_total': (
         'counter', 'Replica /stats-/readyz scrapes that failed '
                    '(replica dead, hung, or malformed response)', ()),
+    # -- crash-only fleet controller (replica_plane/journal.py,
+    #    fleet.py): restart adoption + tick-failure fuse
+    'skypilot_fleet_adoptions_total': (
+        'counter', 'Replicas a restarted fleet controller verified '
+                   '(pid alive + /stats echoing the journaled '
+                   'instance UUID) and reattached as live handles '
+                   'instead of killing or orphaning them', ()),
+    'skypilot_fleet_orphans_reaped_total': (
+        'counter', 'Journaled replicas a restarted controller could '
+                   'NOT verify (dead pid, unreachable port, or '
+                   'instance-UUID mismatch from pid/port reuse) — '
+                   'asked to drain via SIGTERM (never SIGKILL) and '
+                   'dropped from the journal', ()),
+    'skypilot_fleet_tick_errors_total': (
+        'counter', 'Fleet-controller ticks that raised; 3 '
+                   'consecutive failures flip the degraded gauge',
+        ()),
+    'skypilot_fleet_controller_degraded': (
+        'gauge', '1 while the fleet controller has failed 3+ '
+                 'consecutive ticks (replicas keep serving, but '
+                 'scaling and routing updates are stalled); back to '
+                 '0 on the first successful tick', ()),
+    # -- checkpoint integrity (parallel/checkpoints.py + manifests)
+    'skypilot_checkpoint_integrity_failures_total': (
+        'counter', 'Checkpoint steps that failed sha256 manifest '
+                   'verification at restore (torn/corrupt writes); '
+                   'each one triggers fallback to the newest '
+                   'verifying step', ()),
     # -- managed jobs (jobs/controller.py + recovery_strategy.py)
     'skypilot_jobs_recovery_attempts_total': (
         'counter', 'Managed-job recovery attempts (cluster lost or '

@@ -4,38 +4,95 @@ The training half of the managed-jobs recovery contract (SURVEY §2.6):
 the job writes checkpoints to a GCS bucket mounted/addressed at
 `ckpt_dir` (orbax/tensorstore writes gs:// URIs directly); after a
 preemption the controller re-launches the cluster and the recipe
-resumes from `latest_step()`. Async saves overlap the device→storage
-copy with the next training steps (HBM is snapshotted synchronously,
-upload happens in the background).
+resumes from the newest checkpoint. Async saves overlap the
+device→storage copy with the next training steps (HBM is snapshotted
+synchronously, upload happens in the background).
+
+Integrity: local checkpoint dirs get a sha256 manifest per finalized
+step (`parallel/ckpt_integrity.py`, written next to the step dir the
+first save/wait after the step finalizes). `restore()` verifies the
+candidate step against its manifest and automatically falls back to
+the newest step that verifies — a torn or corrupt checkpoint write
+costs one checkpoint interval of progress, never the job. Failures
+are typed (`CheckpointNotFoundError` / `CheckpointCorruptionError`
+from `robustness/errors.py`) and counted
+(`skypilot_checkpoint_integrity_failures_total`).
 """
 from __future__ import annotations
 
 import os
-from typing import Any, Optional
+from typing import Any, List, Optional
 
 import jax
 import orbax.checkpoint as ocp
+
+from skypilot_tpu.parallel import ckpt_integrity
+from skypilot_tpu.robustness.errors import (CheckpointCorruptionError,
+                                            CheckpointNotFoundError)
+from skypilot_tpu.utils import ux_utils
 
 
 class CheckpointManager:
 
     def __init__(self, ckpt_dir: str, max_to_keep: int = 3,
                  save_interval_steps: int = 1) -> None:
-        if not ckpt_dir.startswith(('gs://', 's3://')):
+        # Manifests hash local files; remote URIs are left to the
+        # object store's own integrity (GCS/S3 checksum uploads).
+        self._local = not ckpt_dir.startswith(('gs://', 's3://'))
+        if self._local:
             ckpt_dir = os.path.abspath(os.path.expanduser(ckpt_dir))
             os.makedirs(ckpt_dir, exist_ok=True)
         self.ckpt_dir = ckpt_dir
+        #: Step the last `restore()` actually read (after any
+        #: integrity fallback) — callers report resume progress
+        #: from this, not from the step they asked for.
+        self.last_restored_step: Optional[int] = None
         options = ocp.CheckpointManagerOptions(
             max_to_keep=max_to_keep,
             save_interval_steps=save_interval_steps,
             enable_async_checkpointing=True)
         self._manager = ocp.CheckpointManager(ckpt_dir, options=options)
 
+    # -- integrity manifests ---------------------------------------------
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.ckpt_dir, str(step))
+
+    def _finalize_manifests(self) -> None:
+        """Write manifests for finalized steps that lack one, and
+        prune manifests whose step was GC'd (max_to_keep). Async
+        saves finalize in the background; `all_steps()` lists only
+        finalized steps, so hashing here never races a write."""
+        if not self._local:
+            return
+        steps = set(self._manager.all_steps())
+        for step in sorted(steps):
+            step_dir = self._step_dir(step)
+            # isdir guard: an unexpected orbax step-dir layout must
+            # degrade to "unverified legacy" (no manifest), never to
+            # an empty manifest that would verify anything.
+            if os.path.isdir(step_dir) and not os.path.exists(
+                    ckpt_integrity.manifest_path(self.ckpt_dir, step)):
+                ckpt_integrity.write_manifest(
+                    self.ckpt_dir, step, step_dir)
+        ckpt_integrity.prune_manifests(self.ckpt_dir, steps)
+
+    def verify_step(self, step: int) -> bool:
+        """True = manifest verified; False = no manifest (legacy
+        checkpoint); raises CheckpointCorruptionError on mismatch."""
+        if not self._local:
+            return False
+        return ckpt_integrity.verify_step(self.ckpt_dir, step,
+                                          self._step_dir(step))
+
+    # -- save/restore ----------------------------------------------------
     def save(self, step: int, state: Any, force: bool = False) -> bool:
         """Async save; returns whether a save was started. Saving a step
         that already exists is a no-op (resume-safe)."""
         from skypilot_tpu.robustness import faults
         faults.point('checkpoint.save')  # chaos: lost/failed saves
+        # Previous steps have finalized by now (or will by the next
+        # call): give them manifests before starting new work.
+        self._finalize_manifests()
         try:
             return self._manager.save(
                 step, args=ocp.args.StandardSave(state), force=force)
@@ -44,20 +101,62 @@ class CheckpointManager:
 
     def restore(self, state_template: Any,
                 step: Optional[int] = None) -> Any:
-        """Restore into the template's shardings (abstract or concrete).
-
-        Sharding-agnostic: orbax reshards on read, so a checkpoint
-        written with one optimizer-state layout restores into another
-        (e.g. a replicated-moments checkpoint into a ZeRO-1 trainer's
-        data-sharded template after flipping `--zero1`, or vice
-        versa). If the direct sharded read still fails — layout
-        metadata mismatches across orbax versions — fall back to an
-        unconstrained read followed by a device_put onto the
-        template's shardings.
-        """
+        """Restore into the template's shardings, with integrity
+        fallback: the requested step (default: newest) is verified
+        against its sha256 manifest first; a corrupt step is logged,
+        counted, and skipped in favor of the next-newest step that
+        verifies. Raises `CheckpointNotFoundError` when there is
+        nothing to restore and `CheckpointCorruptionError` when
+        every candidate is corrupt."""
+        from skypilot_tpu.observability import catalog as obs_catalog
+        from skypilot_tpu.robustness import faults
+        faults.point('checkpoint.restore')  # chaos: unreadable store
+        steps = sorted(self._manager.all_steps(), reverse=True)
         if step is None:
-            step = self.latest_step()
-        assert step is not None, 'no checkpoint to restore'
+            candidates = steps
+        else:
+            candidates = [step] + [s for s in steps if s < step]
+        if not candidates:
+            raise CheckpointNotFoundError(
+                f'no checkpoint to restore in {self.ckpt_dir}')
+        corrupt: List[int] = []
+        for candidate in candidates:
+            try:
+                verified = self.verify_step(candidate)
+            except CheckpointCorruptionError as e:
+                obs_catalog.counter(
+                    'skypilot_checkpoint_integrity_failures_total'
+                ).inc()
+                ux_utils.error(
+                    f'checkpoint step {candidate} failed integrity '
+                    f'verification ({e}); falling back to the '
+                    f'previous step.')
+                corrupt.append(candidate)
+                continue
+            if self._local and not verified:
+                ux_utils.log(f'checkpoint step {candidate} has no '
+                             f'integrity manifest (pre-manifest '
+                             f'checkpoint); restoring unverified.')
+            if corrupt:
+                ux_utils.log(f'checkpoint restore: fell back from '
+                             f'corrupt step(s) {corrupt} to step '
+                             f'{candidate}.')
+            self.last_restored_step = candidate
+            return self._restore_step(state_template, candidate)
+        raise CheckpointCorruptionError(
+            f'every restore candidate failed integrity '
+            f'verification (steps {corrupt}) in {self.ckpt_dir} — '
+            f'no uncorrupted checkpoint left to fall back to')
+
+    def _restore_step(self, state_template: Any, step: int) -> Any:
+        """Sharding-agnostic single-step restore: orbax reshards on
+        read, so a checkpoint written with one optimizer-state
+        layout restores into another (e.g. a replicated-moments
+        checkpoint into a ZeRO-1 trainer's data-sharded template
+        after flipping `--zero1`, or vice versa). If the direct
+        sharded read still fails — layout metadata mismatches across
+        orbax versions — fall back to an unconstrained read followed
+        by a device_put onto the template's shardings."""
         abstract = jax.tree.map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=getattr(
                 x, 'sharding', None)) if hasattr(x, 'shape') else x,
@@ -65,7 +164,11 @@ class CheckpointManager:
         try:
             return self._manager.restore(
                 step, args=ocp.args.StandardRestore(abstract))
-        except Exception:  # pylint: disable=broad-except
+        except Exception as e:  # pylint: disable=broad-except
+            ux_utils.log(
+                f'checkpoint step {step}: direct sharded restore '
+                f'failed ({type(e).__name__}: {e}); retrying with '
+                f'an unconstrained read + device_put resharding.')
             plain = jax.tree.map(
                 lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
                 if hasattr(x, 'shape') else x,
@@ -80,8 +183,14 @@ class CheckpointManager:
     def latest_step(self) -> Optional[int]:
         return self._manager.latest_step()
 
+    def all_steps(self) -> List[int]:
+        return list(self._manager.all_steps())
+
     def wait_until_finished(self) -> None:
         self._manager.wait_until_finished()
+        self._finalize_manifests()
 
     def close(self) -> None:
+        self._manager.wait_until_finished()
+        self._finalize_manifests()
         self._manager.close()

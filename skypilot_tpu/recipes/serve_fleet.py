@@ -15,9 +15,21 @@ shed rate); scale-down always drains: the victim leaves the routing
 set, gets SIGTERM, finishes its in-flight requests, and only then
 exits. SIGTERM to THIS process drains the whole fleet.
 
+Crash-only restart: with `--state-dir DIR` the replica manager
+journals every replica lifecycle change to DIR/fleet.journal
+(fsync'd JSONL). Killing THIS process — even SIGKILL — orphans
+nothing: restart with the same --state-dir and the controller
+replays the journal, verifies each journaled replica (pid alive,
+/stats echoing the journaled instance UUID), adopts the survivors
+back into the routing ring (prefix-affinity keys land back on the
+replicas still holding their KV pages), resumes interrupted drains,
+and politely SIGTERMs (never SIGKILLs) anything it cannot verify.
+
 Chaos: --fault-plan is forwarded to every replica (the plan arms
 inside each serve_lm process; see docs/guides.md "Serving
-robustness"). Never in production.
+robustness"). --stub-replicas swaps serve_lm for the model-free
+stub replica (chaos drills and the controller-restart e2e). Never
+in production.
 """
 from __future__ import annotations
 
@@ -65,6 +77,15 @@ def main() -> None:
     parser.add_argument('--max-queue-tokens', type=int, default=0)
     parser.add_argument('--fault-plan', default=None, metavar='JSON')
     parser.add_argument('--cpu', action='store_true')
+    parser.add_argument('--state-dir', default=None, metavar='DIR',
+                        help='durable fleet journal directory: '
+                             'restarting with the same DIR adopts '
+                             'surviving replicas instead of '
+                             'orphaning them')
+    parser.add_argument('--stub-replicas', action='store_true',
+                        help='model-free stub replicas '
+                             '(replica_plane/stub.py) instead of '
+                             'serve_lm — chaos drills only')
     parser.add_argument('--replicas', type=int, default=2,
                         help='initial + minimum replica count')
     parser.add_argument('--max-replicas', type=int, default=None,
@@ -98,7 +119,8 @@ def main() -> None:
     from skypilot_tpu.serve.replica_plane import (FleetController,
                                                   ReplicaManager,
                                                   make_lb_server,
-                                                  serve_lm_factory)
+                                                  serve_lm_factory,
+                                                  stub_factory)
     from skypilot_tpu.utils.registry import LB_POLICY_REGISTRY
 
     max_replicas = args.max_replicas or args.replicas
@@ -114,9 +136,13 @@ def main() -> None:
     policy: lb_policies.LoadBalancingPolicy = policy_cls()
 
     env = dict(os.environ)
-    manager = ReplicaManager(
-        serve_lm_factory(build_replica_cmd(args), env=env),
-        drain_grace_s=args.drain_grace)
+    if args.stub_replicas:
+        factory = stub_factory(env=env)
+    else:
+        factory = serve_lm_factory(build_replica_cmd(args), env=env)
+    manager = ReplicaManager(factory,
+                             drain_grace_s=args.drain_grace,
+                             state_dir=args.state_dir)
     controller = FleetController(manager, policy, autoscaler,
                                  interval_s=args.scrape_interval)
     lb = make_lb_server(policy, args.lb_port,
@@ -130,7 +156,16 @@ def main() -> None:
         threading.Thread(target=_shutdown, daemon=True).start()
 
     signal.signal(signal.SIGTERM, handle_term)
-    for _ in range(args.replicas):
+    adopted = 0
+    if args.state_dir:
+        summary = manager.adopt()
+        adopted = len(summary['adopted'])
+        if any(summary.values()):
+            print(f'serve_fleet: adopted {summary["adopted"]} from '
+                  f'{args.state_dir}, resumed drains '
+                  f'{summary["resumed_drains"]}, reaped orphans '
+                  f'{summary["orphans"]}', flush=True)
+    for _ in range(max(0, args.replicas - adopted)):
         manager.spawn()
     loop = threading.Thread(target=controller.run, daemon=True)
     loop.start()

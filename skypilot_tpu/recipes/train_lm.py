@@ -312,8 +312,17 @@ def main() -> None:
                                 save_interval_steps=args.ckpt_every)
         latest = mgr.latest_step()
         if latest is not None:
+            # restore() verifies sha256 manifests and falls back to
+            # the newest verifying step if the latest is corrupt —
+            # report the step actually read, not the one asked for.
             state = mgr.restore(state, latest)
-            print(f'resumed from checkpoint step {latest}', flush=True)
+            restored = mgr.last_restored_step
+            if restored != latest:
+                print(f'checkpoint step {latest} corrupt; resumed '
+                      f'from step {restored} instead', flush=True)
+            else:
+                print(f'resumed from checkpoint step {restored}',
+                      flush=True)
 
     # Data.
     loader = None

@@ -29,3 +29,19 @@ class EngineDeadError(Exception):
     """The engine's scheduler thread died: the engine fails fast
     (submit raises, pending futures resolve with this) instead of
     hanging clients; `/readyz` flips to 503."""
+
+
+class CheckpointNotFoundError(Exception):
+    """No checkpoint exists to restore (empty/absent directory, or
+    an explicitly requested step that was never written). Typed —
+    never an `assert`, which vanishes under `python -O` — so the
+    recovery path can distinguish "start fresh" from "data lost"."""
+
+
+class CheckpointCorruptionError(Exception):
+    """A checkpoint failed its sha256 manifest verification (torn
+    write, truncated upload, bit rot). Raised per-step during
+    restore; the manager falls back to the newest step that DOES
+    verify, and raises this only when every candidate is corrupt —
+    one bad write must cost one checkpoint interval of progress,
+    not the job."""

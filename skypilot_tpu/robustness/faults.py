@@ -102,8 +102,19 @@ KNOWN_POINTS: Dict[str, str] = {
         'retry/backoff/failover)',
     'http.handler':
         'inference HTTP server, start of each POST handler',
+    'fleet.tick':
+        'replica-plane fleet controller, start of each control-loop '
+        'tick (a raised fault exercises the tick-error fuse: 3 '
+        'consecutive failures flip the controller-degraded gauge; '
+        'a SIGKILL-shaped chaos run restarts the controller and '
+        'adopts the fleet from the journal)',
     'checkpoint.save':
         'CheckpointManager.save, before the orbax save is issued',
+    'checkpoint.restore':
+        'CheckpointManager.restore, before integrity verification '
+        'and the orbax read (raise to model unreadable checkpoint '
+        'storage; manifest-verification fallback is separate and '
+        'driven by on-disk corruption)',
 }
 
 #: Sentinel returned by `point()` when a drop rule fires; sites that

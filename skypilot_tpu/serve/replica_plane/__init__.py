@@ -23,14 +23,23 @@ scraped and routed directly:
     requests on replica death;
   - stub.py: a model-free replica speaking the same control surface
     (readyz/stats/generate+SSE, SIGTERM drain, prefix-cache
-    accounting) for deterministic tier-1 tests and bench smokes.
+    accounting) for deterministic tier-1 tests and bench smokes;
+  - journal.py: the durable fleet journal (fsync'd JSONL of replica
+    lifecycle events, atomic-rename compaction) that makes the
+    control plane crash-only — a restarted controller replays it
+    and `ReplicaManager.adopt()` reattaches every replica it can
+    verify (pid alive + /stats echoing the journaled instance UUID)
+    instead of orphaning or killing them.
 
 Entry point: `python -m skypilot_tpu.recipes.serve_fleet`.
 """
 from skypilot_tpu.serve.replica_plane.fleet import FleetController
+from skypilot_tpu.serve.replica_plane.journal import (FleetJournal,
+                                                      ReplicaRecord)
 from skypilot_tpu.serve.replica_plane.lb import make_lb_server
 from skypilot_tpu.serve.replica_plane.replica_manager import (
-    ReplicaManager, ReplicaView, serve_lm_factory)
+    ReplicaManager, ReplicaView, serve_lm_factory, stub_factory)
 
-__all__ = ['FleetController', 'ReplicaManager', 'ReplicaView',
-           'make_lb_server', 'serve_lm_factory']
+__all__ = ['FleetController', 'FleetJournal', 'ReplicaManager',
+           'ReplicaRecord', 'ReplicaView', 'make_lb_server',
+           'serve_lm_factory', 'stub_factory']

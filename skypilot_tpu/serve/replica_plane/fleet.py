@@ -22,11 +22,19 @@ import threading
 import time
 from typing import Callable, List, Optional
 
+from skypilot_tpu.observability import catalog as obs_catalog
+from skypilot_tpu.robustness import faults
 from skypilot_tpu.serve import autoscalers
 from skypilot_tpu.serve.replica_plane.replica_manager import (
     ReplicaManager, ReplicaView)
 from skypilot_tpu.serve.serve_state import ReplicaStatus
 from skypilot_tpu.utils import ux_utils
+
+#: Consecutive tick failures before the controller declares itself
+#: degraded (error log + `skypilot_fleet_controller_degraded` gauge)
+#: — the same 3-strike fuse the engine scheduler uses: one failure
+#: is noise, three in a row is a condition.
+_TICK_FAILURE_STRIKES = 3
 
 
 class FleetController:
@@ -46,6 +54,12 @@ class FleetController:
         self._drain_in_thread = drain_in_thread
         self._drain_threads: List[threading.Thread] = []
         self._shutdown = threading.Event()
+        self.consecutive_tick_failures = 0
+        self._tick_errors = obs_catalog.counter(
+            'skypilot_fleet_tick_errors_total')
+        self._degraded = obs_catalog.gauge(
+            'skypilot_fleet_controller_degraded')
+        self._degraded.set(0)
 
     # -- scaling actions -------------------------------------------------
     def _push_routing(self) -> None:
@@ -72,6 +86,11 @@ class FleetController:
         if hasattr(self.autoscaler, 'forget'):
             self.autoscaler.forget(view.endpoint)
         if self._drain_in_thread:
+            # Prune finished drains first: over a long-running fleet
+            # the list would otherwise grow one dead Thread per
+            # scale-down, forever.
+            self._drain_threads = [t for t in self._drain_threads
+                                   if t.is_alive()]
             thread = threading.Thread(
                 target=self.manager.drain, args=(view.replica_id,),
                 daemon=True)
@@ -94,6 +113,7 @@ class FleetController:
 
     # -- control loop ----------------------------------------------------
     def tick(self, now: Optional[float] = None) -> None:
+        faults.point('fleet.tick')  # chaos: controller-loop failures
         now = now if now is not None else self._clock()
         self.manager.scrape_once()
 
@@ -155,22 +175,51 @@ class FleetController:
             if view.state.is_terminal():
                 self.manager.remove(view.replica_id)
 
+    def safe_tick(self) -> bool:
+        """One guarded tick for the control loop: failures are
+        counted (`skypilot_fleet_tick_errors_total`) and escalated
+        after 3 consecutive strikes (error log + the
+        controller-degraded gauge) instead of one forever-identical
+        log line per failure. A success resets the fuse. Returns
+        whether the tick succeeded."""
+        try:
+            self.tick()
+        except Exception as e:  # pylint: disable=broad-except
+            self.consecutive_tick_failures += 1
+            self._tick_errors.inc()
+            if self.consecutive_tick_failures >= \
+                    _TICK_FAILURE_STRIKES:
+                self._degraded.set(1)
+                ux_utils.error(
+                    f'fleet: {self.consecutive_tick_failures} '
+                    f'consecutive tick failures (latest: {e}); '
+                    f'controller DEGRADED — replicas keep serving '
+                    f'but scaling/routing updates are stalled.')
+            else:
+                ux_utils.log(f'fleet tick failed: {e}')
+            return False
+        if self.consecutive_tick_failures >= _TICK_FAILURE_STRIKES:
+            ux_utils.log('fleet: tick recovered; controller no '
+                         'longer degraded.')
+            self._degraded.set(0)
+        self.consecutive_tick_failures = 0
+        return True
+
     def run(self) -> None:
         """Tick until shutdown() (the serve_fleet entrypoint's main
         loop)."""
         while not self._shutdown.is_set():
-            try:
-                self.tick()
-            except Exception as e:  # pylint: disable=broad-except
-                ux_utils.error(f'fleet tick failed: {e}')
+            self.safe_tick()
             self._shutdown.wait(self.interval_s)
 
     def wait_ready(self, count: int, timeout_s: float = 300.0,
                    poll_s: float = 0.2) -> bool:
         """Block until `count` replicas are READY (spawn-time helper
-        for benches and the entrypoint)."""
-        deadline = time.monotonic() + timeout_s
-        while time.monotonic() < deadline:
+        for benches and the entrypoint). Runs on the injected clock
+        like every other controller path (virtual-clock tests drive
+        it without sleeping)."""
+        deadline = self._clock() + timeout_s
+        while self._clock() < deadline:
             self.tick()
             if len(self.manager.ready_endpoints()) >= count:
                 return True

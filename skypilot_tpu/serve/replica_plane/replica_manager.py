@@ -21,16 +21,22 @@ stream; a drained one finishes them.
 from __future__ import annotations
 
 import dataclasses
+import inspect
 import itertools
+import os
 import signal as signal_lib
 import socket
 import subprocess
 import sys
 import threading
 import time
+import uuid as uuid_lib
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from skypilot_tpu.observability import catalog as obs_catalog
+from skypilot_tpu.serve.replica_plane.journal import (FleetJournal,
+                                                      ReplicaRecord,
+                                                      max_journaled_id)
 from skypilot_tpu.serve.serve_state import ReplicaStatus
 from skypilot_tpu.utils import ux_utils
 
@@ -47,6 +53,72 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
+#: Env var carrying a replica's instance UUID into its process; the
+#: replica echoes it in `GET /stats` (`instance_uuid`), which is how
+#: adoption proves a pid/port still belongs to the journaled replica
+#: rather than to whatever reused them after a crash.
+INSTANCE_UUID_ENV = 'STPU_REPLICA_INSTANCE_UUID'
+
+
+def pid_alive(pid: Optional[int]) -> bool:
+    """Is `pid` a live (non-zombie) process? Zombies matter: an
+    adopted replica that exited before we could wait() on it must
+    read as dead, or the drain path would wait a full grace window
+    on a corpse."""
+    if pid is None or pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True  # alive, owned by someone else
+    try:
+        with open(f'/proc/{pid}/stat', 'r', encoding='utf-8') as f:
+            # Field 3 (after the parenthesized comm) is the state.
+            return f.read().rsplit(')', 1)[-1].split()[0] != 'Z'
+    except (OSError, IndexError):
+        return True  # no /proc (non-Linux): kill(0) said alive
+
+
+class AdoptedProcess:
+    """Popen-shaped handle over a process we did NOT spawn (a
+    verified adoption candidate from the journal). `poll()` can only
+    report liveness, never the real exit code — the original parent
+    (the dead controller) owned wait(); we report 0 once the pid is
+    gone, which is correct for every decision this plane makes
+    (drain completion, crash detection runs through /stats)."""
+
+    def __init__(self, pid: int,
+                 probe: Callable[[Optional[int]], bool] = pid_alive,
+                 signal_fn: Callable[[int, int], None] = os.kill
+                 ) -> None:
+        self.pid = pid
+        self._probe = probe
+        self._signal = signal_fn
+
+    def poll(self) -> Optional[int]:
+        return None if self._probe(self.pid) else 0
+
+    def send_signal(self, sig: int) -> None:
+        self._signal(self.pid, sig)
+
+    def terminate(self) -> None:
+        self.send_signal(signal_lib.SIGTERM)
+
+    def kill(self) -> None:
+        self.send_signal(signal_lib.SIGKILL)
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[int]:
+        deadline = (time.monotonic() + timeout
+                    if timeout is not None else None)
+        while self.poll() is None:
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(f'pid {self.pid} did not exit')
+            time.sleep(0.05)
+        return 0
+
+
 @dataclasses.dataclass
 class ReplicaView:
     """One replica's last-scraped state, shared between the manager,
@@ -57,6 +129,8 @@ class ReplicaView:
     state: ReplicaStatus
     spawned_at: float
     proc: Any = None                   # Popen-shaped handle
+    instance_uuid: str = ''            # journaled; echoed by /stats
+    adopted: bool = False              # reattached after a restart
     ready: bool = False
     engine_healthy: bool = True
     scrape_failures: int = 0           # consecutive
@@ -77,6 +151,7 @@ class ReplicaView:
             'replica_id': self.replica_id,
             'endpoint': self.endpoint,
             'state': self.state.value,
+            'adopted': self.adopted,
             'ready': self.ready,
             'engine_healthy': self.engine_healthy,
             'queue_depth': self.queue_depth,
@@ -97,11 +172,15 @@ def serve_lm_factory(base_cmd: List[str],
     `python -m skypilot_tpu.recipes.serve_lm --model ... --cpu` is
     the usual shape (recipes/serve_fleet.py builds it)."""
 
-    def spawn(replica_id: int, port: int) -> 'subprocess.Popen':
+    def spawn(replica_id: int, port: int,
+              instance_uuid: str = '') -> 'subprocess.Popen':
         del replica_id
         out = subprocess.DEVNULL if quiet else None
+        child_env = dict(env if env is not None else os.environ)
+        if instance_uuid:
+            child_env[INSTANCE_UUID_ENV] = instance_uuid
         return subprocess.Popen(
-            base_cmd + ['--port', str(port)], env=env,
+            base_cmd + ['--port', str(port)], env=child_env,
             stdout=out, stderr=subprocess.STDOUT if quiet else None)
 
     return spawn
@@ -109,16 +188,20 @@ def serve_lm_factory(base_cmd: List[str],
 
 def stub_factory(extra_args: Optional[List[str]] = None,
                  env: Optional[Dict[str, str]] = None
-                 ) -> Callable[[int, int], 'subprocess.Popen']:
+                 ) -> Callable[..., 'subprocess.Popen']:
     """Factory spawning model-free stub replicas (stub.py) — the
     deterministic fleet for bench smokes."""
 
-    def spawn(replica_id: int, port: int) -> 'subprocess.Popen':
+    def spawn(replica_id: int, port: int,
+              instance_uuid: str = '') -> 'subprocess.Popen':
         cmd = [sys.executable, '-m',
                'skypilot_tpu.serve.replica_plane.stub',
                '--port', str(port), '--seed', str(replica_id)]
         cmd += list(extra_args or [])
-        return subprocess.Popen(cmd, env=env)
+        child_env = dict(env if env is not None else os.environ)
+        if instance_uuid:
+            child_env[INSTANCE_UUID_ENV] = instance_uuid
+        return subprocess.Popen(cmd, env=child_env)
 
     return spawn
 
@@ -149,15 +232,30 @@ class ReplicaManager:
           termination.
     """
 
-    def __init__(self, factory: Callable[[int, int], Any], *,
+    def __init__(self, factory: Callable[..., Any], *,
                  startup_grace_s: float = 180.0,
                  drain_grace_s: float = 30.0,
                  scrape_timeout_s: float = 3.0,
                  max_scrape_failures: int = 3,
                  http_get: Optional[Callable] = None,
                  clock: Callable[[], float] = time.monotonic,
-                 on_event: Optional[Callable] = None) -> None:
+                 on_event: Optional[Callable] = None,
+                 state_dir: Optional[str] = None,
+                 pid_probe: Callable[[Optional[int]], bool] = pid_alive,
+                 signal_pid: Callable[[int, int], None] = os.kill,
+                 reattach: Optional[Callable] = None) -> None:
         self._factory = factory
+        # Factories that accept `instance_uuid` (all in-repo ones)
+        # get the per-replica UUID; bare (rid, port) test lambdas
+        # keep working, their replicas just never verify on adopt.
+        try:
+            params = inspect.signature(factory).parameters
+            self._factory_takes_uuid = (
+                'instance_uuid' in params or
+                any(p.kind == p.VAR_KEYWORD
+                    for p in params.values()))
+        except (TypeError, ValueError):
+            self._factory_takes_uuid = False
         self.startup_grace_s = startup_grace_s
         self.drain_grace_s = drain_grace_s
         self.scrape_timeout_s = scrape_timeout_s
@@ -165,27 +263,191 @@ class ReplicaManager:
         self._http_get = http_get or _default_http_get
         self._clock = clock
         self._on_event = on_event or (lambda name, view: None)
+        self._pid_probe = pid_probe
+        self._signal_pid = signal_pid
+        self._reattach = reattach or (
+            lambda rec: AdoptedProcess(rec.pid, probe=pid_probe,
+                                       signal_fn=signal_pid))
         self._lock = threading.Lock()
         self._replicas: Dict[int, ReplicaView] = {}
         self._ids = itertools.count(1)
+        self._journal: Optional[FleetJournal] = None
+        if state_dir is not None:
+            self._journal = FleetJournal(
+                os.path.join(state_dir, 'fleet.journal'))
         self._gauge = obs_catalog.gauge('skypilot_replica_plane_replicas')
         self._scrape_errors = obs_catalog.counter(
             'skypilot_replica_plane_scrape_errors_total')
+        self._adoptions = obs_catalog.counter(
+            'skypilot_fleet_adoptions_total')
+        self._orphans_reaped = obs_catalog.counter(
+            'skypilot_fleet_orphans_reaped_total')
+
+    # -- journal write-through -------------------------------------------
+    # (FleetJournal serializes appends under its own lock; taking the
+    # manager lock here too would hold it across an fsync.)
+    def _journal_spawn(self, view: ReplicaView) -> None:
+        if self._journal is None:
+            return
+        self._journal.append(  # stpu: ignore[SKY003]
+            'spawn', **ReplicaRecord(
+                replica_id=view.replica_id, port=view.port,
+                endpoint=view.endpoint,
+                instance_uuid=view.instance_uuid,
+                state=view.state.value,
+                pid=getattr(view.proc, 'pid', None)).to_fields())
+
+    def _journal_state(self, view: ReplicaView) -> None:
+        if self._journal is None:
+            return
+        self._journal.append(  # stpu: ignore[SKY003]
+            'state', replica_id=view.replica_id,
+            state=view.state.value)
+
+    def _journal_terminate(self, replica_id: int) -> None:
+        if self._journal is None:
+            return
+        self._journal.append(  # stpu: ignore[SKY003]
+            'terminate', replica_id=replica_id)
 
     # -- lifecycle -------------------------------------------------------
     def spawn(self) -> ReplicaView:
         with self._lock:
             rid = next(self._ids)
         port = free_port()
-        proc = self._factory(rid, port)
+        instance_uuid = uuid_lib.uuid4().hex
+        if self._factory_takes_uuid:
+            proc = self._factory(rid, port,
+                                 instance_uuid=instance_uuid)
+        else:
+            proc = self._factory(rid, port)
         view = ReplicaView(replica_id=rid, port=port,
                            endpoint=f'127.0.0.1:{port}',
                            state=ReplicaStatus.STARTING,
-                           spawned_at=self._clock(), proc=proc)
+                           spawned_at=self._clock(), proc=proc,
+                           instance_uuid=instance_uuid)
         with self._lock:
             self._replicas[rid] = view
+        self._journal_spawn(view)
         self._on_event('spawned', view)
         return view
+
+    # -- adoption (controller restart) -----------------------------------
+    def _verify_candidate(self, rec: ReplicaRecord) -> bool:
+        """Is the journaled process still OUR replica? Two proofs,
+        both required: the journaled pid is a live process, and the
+        journaled port's `/stats` echoes the journaled instance
+        UUID. The UUID check is what defeats pid/port reuse — a
+        recycled pid or a stranger's server on the old port fails
+        it, and we must never route to (or signal) a process we
+        cannot prove is ours."""
+        if not rec.instance_uuid or not self._pid_probe(rec.pid):
+            return False
+        try:
+            code, stats = self._http_get(
+                f'http://{rec.endpoint}/stats', self.scrape_timeout_s)
+        except Exception as e:  # pylint: disable=broad-except
+            ux_utils.log(f'adopt: replica {rec.replica_id} at '
+                         f'{rec.endpoint} not scrapeable ({e}).')
+            return False
+        return (code == 200 and
+                stats.get('instance_uuid') == rec.instance_uuid)
+
+    def adopt(self, block_drains: bool = False) -> Dict[str, Any]:
+        """Crash recovery: replay the journal of the previous
+        controller generation and reattach what survived it.
+
+        Per journaled live record:
+          - VERIFIED (pid alive + /stats echoes the instance UUID)
+            and not mid-drain: reattach as a live STARTING view —
+            the next scrape pass re-earns READY and the controller
+            pushes it back into the LB ring (same endpoint string,
+            so consistent-hash affinity keys land exactly where
+            their KV pages still live);
+          - VERIFIED but journaled DRAINING: the crash interrupted a
+            scale-down — resume the drain (SIGTERM -> wait), never
+            readmit to routing;
+          - UNVERIFIABLE (dead pid, unreachable port, UUID mismatch
+            from pid/port reuse): an orphan. If the journaled pid is
+            still a live process we ask it to drain with SIGTERM —
+            never SIGKILL: a reused pid belongs to someone else, and
+            SIGTERM is the only signal an innocent process gets to
+            decline — then drop the record.
+
+        Returns {'adopted': [...], 'resumed_drains': [...],
+        'orphans': [...]} (replica ids). `block_drains` makes the
+        resumed drains synchronous (tests); by default they run in
+        daemon threads so a restart is not gated on a full drain
+        grace window."""
+        if self._journal is None:
+            return {'adopted': [], 'resumed_drains': [], 'orphans': []}
+        records = self._journal.replay()
+        highest = max_journaled_id(self._journal.path)
+        if highest:
+            with self._lock:
+                self._ids = itertools.count(highest + 1)
+        adopted: List[int] = []
+        resumed: List[int] = []
+        orphans: List[int] = []
+        for rid in sorted(records):
+            rec = records[rid]
+            if self._verify_candidate(rec):
+                view = ReplicaView(
+                    replica_id=rid, port=rec.port,
+                    endpoint=rec.endpoint,
+                    state=(ReplicaStatus.DRAINING
+                           if rec.state == ReplicaStatus.DRAINING.value
+                           else ReplicaStatus.STARTING),
+                    spawned_at=self._clock(),
+                    proc=self._reattach(rec),
+                    instance_uuid=rec.instance_uuid, adopted=True)
+                with self._lock:
+                    self._replicas[rid] = view
+                if view.state == ReplicaStatus.DRAINING:
+                    ux_utils.log(f'adopt: replica {rid} was '
+                                 f'mid-drain; resuming the drain.')
+                    self._journal_state(view)
+                    self._on_event('adopt_resume_drain', view)
+                    resumed.append(rid)
+                    if block_drains:
+                        self.drain(rid)
+                    else:
+                        threading.Thread(target=self.drain,
+                                         args=(rid,),
+                                         daemon=True).start()
+                else:
+                    ux_utils.log(
+                        f'adopt: replica {rid} verified alive at '
+                        f'{rec.endpoint} (pid {rec.pid}); '
+                        f'reattached.')
+                    self._adoptions.inc()
+                    self._journal_spawn(view)
+                    self._on_event('adopted', view)
+                    adopted.append(rid)
+                continue
+            # Orphan: stale or unverifiable. Politely ask a
+            # still-live pid to drain; never SIGKILL (the pid may
+            # have been reused by an innocent process that is free
+            # to ignore SIGTERM — SIGKILL would not be).
+            if self._pid_probe(rec.pid):
+                ux_utils.error(
+                    f'adopt: replica {rid} (pid {rec.pid}, '
+                    f'{rec.endpoint}) is unverifiable; sending '
+                    f'SIGTERM and dropping it.')
+                try:
+                    self._signal_pid(rec.pid, signal_lib.SIGTERM)
+                except OSError as e:
+                    ux_utils.log(f'adopt: SIGTERM to orphan pid '
+                                 f'{rec.pid} failed ({e}).')
+            else:
+                ux_utils.log(f'adopt: replica {rid} (pid {rec.pid}) '
+                             f'is gone; dropping its record.')
+            self._orphans_reaped.inc()
+            self._journal_terminate(rid)
+            orphans.append(rid)
+        self._update_gauges()
+        return {'adopted': adopted, 'resumed_drains': resumed,
+                'orphans': orphans}
 
     def views(self) -> List[ReplicaView]:
         with self._lock:
@@ -209,6 +471,7 @@ class ReplicaManager:
             return
         view.state = ReplicaStatus.DRAINING
         view.ready = False
+        self._journal_state(view)
         self._on_event('draining', view)
 
     def drain(self, replica_id: int) -> None:
@@ -231,6 +494,7 @@ class ReplicaManager:
         while self._clock() < deadline:
             if view.proc.poll() is not None:
                 view.state = ReplicaStatus.SHUTDOWN
+                self._journal_state(view)
                 self._on_event('drained', view)
                 return
             time.sleep(0.05)
@@ -241,6 +505,7 @@ class ReplicaManager:
         except OSError as e:
             ux_utils.log(f'replica {replica_id}: kill failed ({e}).')
         view.state = ReplicaStatus.SHUTDOWN
+        self._journal_state(view)
         self._on_event('killed', view)
 
     def fail(self, replica_id: int) -> None:
@@ -260,6 +525,7 @@ class ReplicaManager:
                              f'({e}).')
         view.state = ReplicaStatus.FAILED
         view.ready = False
+        self._journal_state(view)
         self._on_event('dead', view)
 
     def remove(self, replica_id: int) -> None:
@@ -269,6 +535,9 @@ class ReplicaManager:
             view = self._replicas.get(replica_id)
             if view is not None and view.state.is_terminal():
                 del self._replicas[replica_id]
+            else:
+                return
+        self._journal_terminate(replica_id)
 
     def shutdown(self) -> None:
         """Drain every live replica, in parallel."""
@@ -299,6 +568,7 @@ class ReplicaManager:
                     f'(rc={view.proc.poll()}); marking FAILED.')
                 view.state = ReplicaStatus.FAILED
                 view.ready = False
+                self._journal_state(view)
                 self._on_event('dead', view)
                 continue
             self._scrape_replica(view)
@@ -330,8 +600,11 @@ class ReplicaManager:
                         f'replica {view.replica_id}: '
                         f'{view.scrape_failures} consecutive scrape '
                         f'failures ({e}); marking NOT_READY.')
+                transitioned = view.state != ReplicaStatus.NOT_READY
                 view.ready = False
                 view.state = ReplicaStatus.NOT_READY
+                if transitioned:
+                    self._journal_state(view)
                 self._on_event('not_ready', view)
             return
         view.scrape_failures = 0
@@ -349,9 +622,11 @@ class ReplicaManager:
         if ready and view.state in (ReplicaStatus.STARTING,
                                     ReplicaStatus.NOT_READY):
             view.state = ReplicaStatus.READY
+            self._journal_state(view)
             self._on_event('ready', view)
         elif not ready and view.state == ReplicaStatus.READY:
             view.state = ReplicaStatus.NOT_READY
+            self._journal_state(view)
             self._on_event('not_ready', view)
 
     def _update_gauges(self) -> None:

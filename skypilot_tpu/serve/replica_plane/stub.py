@@ -32,6 +32,7 @@ import os
 import signal
 import threading
 import time
+import uuid as uuid_lib
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional
 
@@ -48,8 +49,16 @@ class StubState:
 
     def __init__(self, *, seed: int, page_size: int, cache_pages: int,
                  token_sleep_s: float, die_after_tokens: int,
-                 on_die: Optional[Callable[[], None]]) -> None:
+                 on_die: Optional[Callable[[], None]],
+                 instance_uuid: Optional[str] = None) -> None:
         self.seed = seed
+        # Identity echoed in /stats; the replica plane's adoption
+        # path matches it against the journaled UUID (same contract
+        # as the real serve_lm server).
+        self.instance_uuid = (
+            instance_uuid or
+            os.environ.get('STPU_REPLICA_INSTANCE_UUID') or
+            uuid_lib.uuid4().hex)
         self.page_size = page_size
         self.cache_pages = cache_pages
         self.token_sleep_s = token_sleep_s
@@ -109,6 +118,8 @@ class StubState:
         with self.lock:
             body = {
                 'engine': 'stub',
+                'instance_uuid': self.instance_uuid,
+                'pid': os.getpid(),
                 'healthy': not self.aborted.is_set(),
                 'queued': self.inflight,
                 'prefill_backlog_tokens': 0,
@@ -132,13 +143,14 @@ def make_stub_server(port: int, *, seed: int = 0, page_size: int = 16,
                      cache_pages: int = 64,
                      token_sleep_s: float = 0.0,
                      die_after_tokens: int = 0,
-                     on_die: Optional[Callable[[], None]] = None
+                     on_die: Optional[Callable[[], None]] = None,
+                     instance_uuid: Optional[str] = None
                      ) -> ThreadingHTTPServer:
     state = StubState(seed=seed, page_size=page_size,
                       cache_pages=cache_pages,
                       token_sleep_s=token_sleep_s,
                       die_after_tokens=die_after_tokens,
-                      on_die=on_die)
+                      on_die=on_die, instance_uuid=instance_uuid)
 
     class Handler(BaseHTTPRequestHandler):
 
@@ -329,10 +341,13 @@ def in_process_stub_factory(**stub_kwargs: Any
     for specific replicas — e.g. give replica 2 a die_after_tokens."""
     per_replica = stub_kwargs.pop('per_replica', {})
 
-    def spawn(replica_id: int, port: int) -> InProcessStubReplica:
+    def spawn(replica_id: int, port: int,
+              instance_uuid: str = '') -> InProcessStubReplica:
         kwargs = dict(stub_kwargs)
         kwargs.update(per_replica.get(replica_id, {}))
         kwargs.setdefault('seed', replica_id)
+        if instance_uuid:
+            kwargs.setdefault('instance_uuid', instance_uuid)
         return InProcessStubReplica(port, **kwargs)
 
     return spawn

@@ -531,3 +531,115 @@ def test_replica_plane_chaos_real_serve_lm():
     finally:
         ctl.shutdown()
         lb_server.shutdown()
+
+
+@pytest.mark.slow
+def test_fleet_controller_sigkill_restart_adopts_state_dir(tmp_path):
+    """Crash-only control plane, end to end through the serve_fleet
+    ENTRYPOINT: a stub fleet runs with --state-dir, the controller
+    process is SIGKILL'd (the journal's fsync-per-event is the only
+    thing that survives), and a restarted serve_fleet with the same
+    --state-dir adopts every replica — same pids, same ports, zero
+    healthy replicas killed, zero extra 5xx for clients, zero leaked
+    processes after shutdown."""
+    import json as json_lib
+    import os
+    import signal as signal_lib
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env['PYTHONPATH'] = f"{repo}:{env.get('PYTHONPATH', '')}"
+    state_dir = str(tmp_path / 'fleet-state')
+    from skypilot_tpu.serve.replica_plane import replica_manager as rm
+    lb_port = rm.free_port()
+    cmd = [sys.executable, '-m', 'skypilot_tpu.recipes.serve_fleet',
+           '--stub-replicas', '--replicas', '2',
+           '--lb-port', str(lb_port), '--state-dir', state_dir,
+           '--scrape-interval', '0.2']
+    url = f'http://127.0.0.1:{lb_port}'
+
+    def wait_fleet_ready(n, timeout=60):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                status = requests.get(f'{url}/fleet/status',
+                                      timeout=5).json()
+                ready = [r for r in status['replicas']
+                         if r['state'] == 'READY' and r['ready']]
+                if len(ready) >= n:
+                    return status
+            except requests.RequestException:
+                pass
+            time.sleep(0.2)
+        raise AssertionError(f'fleet not ready within {timeout}s')
+
+    def post_ok():
+        r = requests.post(f'{url}/generate', json={
+            'tokens': [list(range(16)) + [1]], 'max_new_tokens': 3},
+            timeout=30)
+        return r.status_code
+
+    ctl1 = subprocess.Popen(cmd, env=env,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.STDOUT)
+    stub_pids = []
+    try:
+        status = wait_fleet_ready(2)
+        assert post_ok() == 200
+        # The stub pids live in the journal — they must survive the
+        # controller's death.
+        with open(os.path.join(state_dir, 'fleet.journal'), 'r',
+                  encoding='utf-8') as f:
+            for line in f:
+                ev = json_lib.loads(line)
+                if ev.get('event') == 'spawn':
+                    stub_pids.append(ev['pid'])
+        stub_pids = sorted(set(stub_pids))
+        assert len(stub_pids) == 2
+        pre_endpoints = sorted(r['endpoint']
+                               for r in status['replicas'])
+
+        # SIGKILL the controller: no drain, no cleanup, nothing.
+        ctl1.kill()
+        ctl1.wait(timeout=30)
+        # The replicas are orphans now — but alive and serving.
+        for pid in stub_pids:
+            os.kill(pid, 0)  # raises if gone
+
+        # Restart with the SAME state dir: the new controller must
+        # adopt, not respawn (same endpoints = same pids).
+        ctl2 = subprocess.Popen(cmd, env=env,
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.STDOUT)
+        try:
+            status = wait_fleet_ready(2)
+            post_endpoints = sorted(r['endpoint']
+                                    for r in status['replicas'])
+            assert post_endpoints == pre_endpoints  # adopted, not new
+            assert all(r['adopted'] for r in status['replicas'])
+            for pid in stub_pids:
+                os.kill(pid, 0)  # zero healthy replicas killed
+            # Zero extra 5xx: clients are served by the adopted fleet.
+            codes = [post_ok() for _ in range(6)]
+            assert codes == [200] * 6
+        finally:
+            ctl2.terminate()
+            ctl2.wait(timeout=60)
+        # Graceful shutdown drained the fleet: zero leaked processes.
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if not any(rm.pid_alive(pid) for pid in stub_pids):
+                break
+            time.sleep(0.2)
+        assert not any(rm.pid_alive(pid) for pid in stub_pids)
+    finally:
+        if ctl1.poll() is None:
+            ctl1.kill()
+            ctl1.wait(timeout=30)
+        for pid in stub_pids:
+            try:
+                os.kill(pid, signal_lib.SIGKILL)
+            except (OSError, TypeError):
+                pass

@@ -1,0 +1,285 @@
+"""Checkpoint integrity: sha256 manifests, typed restore errors,
+and fall-back to the newest verifying step.
+
+Pure manifest machinery (parallel/ckpt_integrity.py) is stdlib-only
+and tested without orbax; the CheckpointManager round trips run
+under orbax on the CPU backend (importorskip'd, matching the other
+checkpoint tests). The torn-write chaos drill corrupts a finalized
+step's bytes directly — exactly what a crash mid-upload leaves — and
+asserts the restore lands on the previous step instead of failing
+the job.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from skypilot_tpu.observability import catalog as obs_catalog
+from skypilot_tpu.parallel import ckpt_integrity
+from skypilot_tpu.robustness import faults
+from skypilot_tpu.robustness.errors import (CheckpointCorruptionError,
+                                            CheckpointNotFoundError)
+
+
+# ---------------------------------------------------------------------------
+# manifest machinery (no orbax)
+# ---------------------------------------------------------------------------
+def _make_step(tmp_path, step, payload=b'weights-bytes'):
+    step_dir = tmp_path / str(step)
+    (step_dir / 'sub').mkdir(parents=True)
+    (step_dir / 'array.bin').write_bytes(payload)
+    (step_dir / 'sub' / 'meta.json').write_text('{"ok": true}')
+    return str(step_dir)
+
+
+def test_manifest_write_verify_roundtrip(tmp_path):
+    _make_step(tmp_path, 4)
+    path = ckpt_integrity.write_manifest(str(tmp_path), 4)
+    assert os.path.exists(path)
+    with open(path, 'r', encoding='utf-8') as f:
+        manifest = json.load(f)
+    assert manifest['step'] == 4
+    assert sorted(manifest['files']) == [
+        'array.bin', os.path.join('sub', 'meta.json')]
+    assert manifest['total_bytes'] > 0
+    assert ckpt_integrity.verify_step(str(tmp_path), 4) is True
+    assert ckpt_integrity.manifest_steps(str(tmp_path)) == [4]
+
+
+def test_verify_detects_corruption_and_missing_file(tmp_path):
+    step_dir = _make_step(tmp_path, 7)
+    ckpt_integrity.write_manifest(str(tmp_path), 7)
+    # Torn write: the file exists but its bytes changed/truncated.
+    with open(os.path.join(step_dir, 'array.bin'), 'wb') as f:
+        f.write(b'torn')
+    with pytest.raises(CheckpointCorruptionError, match='mismatch'):
+        ckpt_integrity.verify_step(str(tmp_path), 7)
+    os.remove(os.path.join(step_dir, 'array.bin'))
+    with pytest.raises(CheckpointCorruptionError, match='missing'):
+        ckpt_integrity.verify_step(str(tmp_path), 7)
+
+
+def test_verify_without_manifest_is_unverified_not_corrupt(tmp_path):
+    _make_step(tmp_path, 3)
+    assert ckpt_integrity.verify_step(str(tmp_path), 3) is False
+
+
+def test_unreadable_manifest_is_corruption(tmp_path):
+    _make_step(tmp_path, 5)
+    with open(ckpt_integrity.manifest_path(str(tmp_path), 5), 'w',
+              encoding='utf-8') as f:
+        f.write('{not json')
+    with pytest.raises(CheckpointCorruptionError, match='manifest'):
+        ckpt_integrity.verify_step(str(tmp_path), 5)
+
+
+def test_prune_manifests_tracks_gc(tmp_path):
+    for step in (1, 2, 3):
+        _make_step(tmp_path, step)
+        ckpt_integrity.write_manifest(str(tmp_path), step)
+    ckpt_integrity.prune_manifests(str(tmp_path), [2, 3])
+    assert ckpt_integrity.manifest_steps(str(tmp_path)) == [2, 3]
+
+
+def test_preflight_reports_fallback_step(tmp_path):
+    for step in (10, 20, 30):
+        _make_step(tmp_path, step, payload=f'w{step}'.encode())
+        ckpt_integrity.write_manifest(str(tmp_path), step)
+    # Newest step torn; 20 intact; 10 intact.
+    with open(tmp_path / '30' / 'array.bin', 'wb') as f:
+        f.write(b'zzz')
+    report = ckpt_integrity.preflight(str(tmp_path))
+    assert report['steps'] == [10, 20, 30]
+    assert report['corrupt_steps'] == [30]
+    assert report['unverified_steps'] == []
+    assert report['newest_verifying'] == 20
+
+
+def test_preflight_never_raises_on_garbage_dir(tmp_path):
+    report = ckpt_integrity.preflight(str(tmp_path / 'nope'))
+    assert report == {'steps': [], 'corrupt_steps': [],
+                      'unverified_steps': [],
+                      'newest_verifying': None}
+
+
+# ---------------------------------------------------------------------------
+# recovery-strategy preflight (controller-side restore fallback)
+# ---------------------------------------------------------------------------
+class _FakeResource:
+
+    def __init__(self, job_recovery=None):
+        self.job_recovery = job_recovery
+        self.use_spot = False
+        self.is_tpu_slice = False
+
+
+class _FakeTask:
+
+    def __init__(self, resources):
+        self.resources = resources
+
+
+def test_recovery_strategy_checkpoint_preflight(tmp_path):
+    from skypilot_tpu.jobs import recovery_strategy as rs
+    for step in (1, 2):
+        _make_step(tmp_path, step, payload=f's{step}'.encode())
+        ckpt_integrity.write_manifest(str(tmp_path), step)
+    with open(tmp_path / '2' / 'array.bin', 'wb') as f:
+        f.write(b'corrupt')
+    task = _FakeTask([_FakeResource(
+        {'checkpoint_dir': str(tmp_path)})])
+    ex = rs.FailoverStrategyExecutor('c-test', task)
+    report = ex._checkpoint_preflight()
+    assert report['corrupt_steps'] == [2]
+    assert report['newest_verifying'] == 1
+    # No checkpoint_dir configured / remote dir: preflight is a
+    # no-op, never an error.
+    assert rs.FailoverStrategyExecutor(
+        'c2', _FakeTask([_FakeResource()]))._checkpoint_preflight() \
+        is None
+    assert rs.FailoverStrategyExecutor(
+        'c3', _FakeTask([_FakeResource(
+            {'checkpoint_dir': 'gs://bucket/ckpt'})])
+    )._checkpoint_preflight() is None
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager: manifests + typed errors + fallback (orbax)
+# ---------------------------------------------------------------------------
+def _manager(tmp_path, **kw):
+    pytest.importorskip('orbax.checkpoint')
+    from skypilot_tpu.parallel.checkpoints import CheckpointManager
+    return CheckpointManager(str(tmp_path / 'ckpt'), **kw)
+
+
+def _template():
+    return {'x': np.zeros(8, np.float32)}
+
+
+def _save_steps(mgr, steps):
+    for step in steps:
+        assert mgr.save(step, {'x': np.full(8, float(step),
+                                            np.float32)})
+    mgr.wait_until_finished()
+
+
+def test_manager_writes_and_prunes_manifests(tmp_path):
+    mgr = _manager(tmp_path, max_to_keep=2)
+    _save_steps(mgr, [1, 2])
+    assert ckpt_integrity.manifest_steps(mgr.ckpt_dir) == [1, 2]
+    assert mgr.verify_step(1) and mgr.verify_step(2)
+    # max_to_keep=2: saving step 3 GCs step 1; its manifest follows.
+    _save_steps(mgr, [3])
+    assert ckpt_integrity.manifest_steps(mgr.ckpt_dir) == [2, 3]
+    mgr.close()
+
+
+def test_restore_not_found_is_typed_not_assert(tmp_path):
+    mgr = _manager(tmp_path)
+    with pytest.raises(CheckpointNotFoundError,
+                       match='no checkpoint'):
+        mgr.restore(_template())
+    mgr.close()
+
+
+def _corrupt_step(ckpt_dir, step):
+    """Flip bytes in one data file of a finalized step (a torn
+    write): the manifest no longer matches."""
+    step_dir = os.path.join(ckpt_dir, str(step))
+    for root, _dirs, names in os.walk(step_dir):
+        for name in names:
+            path = os.path.join(root, name)
+            if os.path.getsize(path) > 0:
+                with open(path, 'r+b') as f:
+                    data = f.read()
+                    f.seek(0)
+                    f.write(bytes(b ^ 0xFF for b in data[:16]) +
+                            data[16:])
+                return path
+    raise AssertionError(f'no non-empty file under {step_dir}')
+
+
+def test_restore_falls_back_past_corrupt_latest(tmp_path):
+    mgr = _manager(tmp_path)
+    _save_steps(mgr, [1, 2])
+    failures = obs_catalog.counter(
+        'skypilot_checkpoint_integrity_failures_total')
+    before = failures.value
+    _corrupt_step(mgr.ckpt_dir, 2)
+    restored = mgr.restore(_template())
+    # Fell back to step 1 and restored ITS payload.
+    assert mgr.last_restored_step == 1
+    np.testing.assert_array_equal(np.asarray(restored['x']),
+                                  np.full(8, 1.0, np.float32))
+    assert failures.value == before + 1
+    mgr.close()
+
+
+def test_restore_explicit_step_also_falls_back(tmp_path):
+    """train_lm passes latest_step() explicitly; corruption there
+    must fall back the same way, and last_restored_step reports the
+    step actually read."""
+    mgr = _manager(tmp_path)
+    _save_steps(mgr, [5, 9])
+    _corrupt_step(mgr.ckpt_dir, 9)
+    restored = mgr.restore(_template(), step=9)
+    assert mgr.last_restored_step == 5
+    np.testing.assert_array_equal(np.asarray(restored['x']),
+                                  np.full(8, 5.0, np.float32))
+    mgr.close()
+
+
+def test_restore_all_corrupt_raises_corruption(tmp_path):
+    mgr = _manager(tmp_path)
+    _save_steps(mgr, [1, 2])
+    _corrupt_step(mgr.ckpt_dir, 1)
+    _corrupt_step(mgr.ckpt_dir, 2)
+    with pytest.raises(CheckpointCorruptionError,
+                       match='no uncorrupted checkpoint'):
+        mgr.restore(_template())
+    mgr.close()
+
+
+def test_failed_save_leaves_no_manifest_and_restore_skips_it(
+        tmp_path):
+    """checkpoint.save chaos (the torn-save drill): an injected
+    save failure means orbax never finalizes the step, no manifest
+    is written, and restore serves the previous good step."""
+    mgr = _manager(tmp_path)
+    _save_steps(mgr, [1])
+    faults.install_plan({'rules': [{
+        'point': 'checkpoint.save', 'action': 'raise',
+        'exc': 'OSError', 'message': 'bucket gone', 'times': 1}]})
+    try:
+        with pytest.raises(OSError, match='bucket gone'):
+            mgr.save(2, {'x': np.full(8, 2.0, np.float32)})
+    finally:
+        faults.clear()
+    mgr.wait_until_finished()
+    assert ckpt_integrity.manifest_steps(mgr.ckpt_dir) == [1]
+    restored = mgr.restore(_template())
+    assert mgr.last_restored_step == 1
+    np.testing.assert_array_equal(np.asarray(restored['x']),
+                                  np.full(8, 1.0, np.float32))
+    mgr.close()
+
+
+def test_checkpoint_restore_fault_point_fires(tmp_path):
+    mgr = _manager(tmp_path)
+    _save_steps(mgr, [1])
+    faults.install_plan({'rules': [{
+        'point': 'checkpoint.restore', 'action': 'raise',
+        'exc': 'OSError', 'message': 'store unreadable',
+        'times': 1}]})
+    try:
+        with pytest.raises(OSError, match='store unreadable'):
+            mgr.restore(_template())
+        # The plan exhausted: the next restore succeeds normally.
+        restored = mgr.restore(_template())
+        assert mgr.last_restored_step == 1
+        np.testing.assert_array_equal(
+            np.asarray(restored['x']), np.full(8, 1.0, np.float32))
+    finally:
+        faults.clear()
+    mgr.close()

@@ -1,0 +1,657 @@
+"""Crash-only control plane: durable fleet journal, orphan adoption
+on restart, and the controller tick-failure fuse.
+
+Everything tier-1. The journal/adoption machinery is exercised three
+ways: pure journal unit tests (replay determinism, torn-tail
+tolerance, compaction), the adoption verification matrix on fake
+handles with injected pid probes and scrapes (live+match /
+live+UUID-mismatch / dead pid / port reused), and chaos runs on REAL
+stub subprocesses where the controller "crashes" (its in-memory
+state is abandoned) mid-scale-down or mid-drain and a fresh
+manager+controller adopts the fleet from the same state dir — zero
+healthy replicas killed, zero leaked processes, affinity routing
+preserved. The end-to-end SIGKILL-the-entrypoint version lives in
+tests/test_serve.py.
+"""
+import json
+import os
+import sys
+import threading
+import time
+
+import pytest
+import requests
+
+from skypilot_tpu.observability import catalog as obs_catalog
+from skypilot_tpu.robustness import faults
+from skypilot_tpu.serve import autoscalers
+from skypilot_tpu.serve import load_balancing_policies as lbp
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.replica_plane import (FleetController,
+                                              FleetJournal,
+                                              ReplicaManager,
+                                              make_lb_server)
+from skypilot_tpu.serve.replica_plane import journal as journal_lib
+from skypilot_tpu.serve.replica_plane import replica_manager as rm
+from skypilot_tpu.serve.service_spec import SkyServiceSpec
+
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+def _spec(**kw):
+    kw.setdefault('min_replicas', 1)
+    kw.setdefault('max_replicas', 5)
+    kw.setdefault('upscale_delay_seconds', 10)
+    kw.setdefault('downscale_delay_seconds', 20)
+    return SkyServiceSpec(**kw)
+
+
+class _FakeClock:
+
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# journal: append/replay/compaction
+# ---------------------------------------------------------------------------
+def _record(rid, port=7000, state='READY', uuid='u', pid=None):
+    return dict(replica_id=rid, port=port,
+                endpoint=f'127.0.0.1:{port}', instance_uuid=uuid,
+                state=state, pid=pid)
+
+
+def test_journal_append_replay_roundtrip(tmp_path):
+    j = FleetJournal(str(tmp_path / 'fleet.journal'))
+    j.append('spawn', **_record(1, 7001, 'STARTING', 'aaa', 101))
+    j.append('spawn', **_record(2, 7002, 'STARTING', 'bbb', 102))
+    j.append('state', replica_id=1, state='READY')
+    j.append('state', replica_id=2, state='FAILED')
+    j.append('spawn', **_record(3, 7003, 'STARTING', 'ccc', 103))
+    j.append('terminate', replica_id=3)
+    live = j.replay()
+    # 2 is terminal (FAILED), 3 terminated: only 1 survives, with
+    # its LAST state folded in.
+    assert sorted(live) == [1]
+    assert live[1].state == 'READY'
+    assert live[1].port == 7001
+    assert live[1].instance_uuid == 'aaa'
+    assert live[1].pid == 101
+    assert journal_lib.max_journaled_id(j.path) == 3
+
+
+def test_journal_replay_tolerates_torn_tail(tmp_path):
+    path = str(tmp_path / 'fleet.journal')
+    j = FleetJournal(path)
+    j.append('spawn', **_record(1, 7001, 'READY', 'aaa', 101))
+    j.append('spawn', **_record(2, 7002, 'READY', 'bbb', 102))
+    j.close()
+    # The controller died mid-append: a torn, non-JSON final line.
+    with open(path, 'a', encoding='utf-8') as f:
+        f.write('{"event": "state", "replica_id": 2, "sta')
+    live = journal_lib.replay_journal(path)
+    assert sorted(live) == [1, 2]  # every COMPLETE line intact
+    assert live[2].state == 'READY'  # torn update ignored
+
+
+def test_journal_compaction_state_identical_and_file_shrinks(tmp_path):
+    j = FleetJournal(str(tmp_path / 'fleet.journal'))
+    j.append('spawn', **_record(1, 7001, 'STARTING', 'aaa', 101))
+    for _ in range(30):
+        j.append('state', replica_id=1, state='NOT_READY')
+        j.append('state', replica_id=1, state='READY')
+    j.append('spawn', **_record(2, 7002, 'STARTING', 'bbb', 102))
+    j.append('state', replica_id=2, state='SHUTDOWN')
+    before = j.replay()
+    size_before = os.path.getsize(j.path)
+    j.compact()
+    after = j.replay()
+    assert before == after  # replayed state is identical
+    assert os.path.getsize(j.path) < size_before
+    with open(j.path, 'r', encoding='utf-8') as f:
+        lines = [json.loads(l) for l in f]
+    # One snapshot line per LIVE record; terminal ones dropped.
+    assert [l['event'] for l in lines] == ['snapshot']
+    assert lines[0]['replica_id'] == 1
+    # The journal keeps accepting appends after compaction.
+    j.append('state', replica_id=1, state='DRAINING')
+    assert j.replay()[1].state == 'DRAINING'
+
+
+def test_journal_auto_compacts_on_threshold(tmp_path):
+    j = FleetJournal(str(tmp_path / 'fleet.journal'),
+                     compact_every=10)
+    j.append('spawn', **_record(1, 7001, 'READY', 'aaa', 101))
+    for i in range(25):
+        j.append('state', replica_id=1, state='READY')
+    with open(j.path, 'r', encoding='utf-8') as f:
+        n_lines = sum(1 for _ in f)
+    # 26 appends with compact_every=10: compacted at least twice,
+    # so the file holds far fewer lines than events appended.
+    assert n_lines <= 10
+    assert j.replay()[1].state == 'READY'
+
+
+def test_journal_skips_malformed_interior_line(tmp_path):
+    path = str(tmp_path / 'fleet.journal')
+    with open(path, 'w', encoding='utf-8') as f:
+        f.write(json.dumps({'event': 'spawn', **_record(
+            1, 7001, 'READY', 'aaa', 101)}) + '\n')
+        f.write('not json at all\n')
+        f.write(json.dumps({'event': 'spawn', **_record(
+            2, 7002, 'READY', 'bbb', 102)}) + '\n')
+    live = journal_lib.replay_journal(path)
+    assert sorted(live) == [1, 2]
+
+
+# ---------------------------------------------------------------------------
+# manager write-through journaling
+# ---------------------------------------------------------------------------
+class FakeProc:
+
+    def __init__(self, pid=None, on_sigterm=None):
+        self.pid = pid
+        self.rc = None
+        self.signals = []
+        self._on_sigterm = on_sigterm
+
+    def poll(self):
+        return self.rc
+
+    def send_signal(self, sig):
+        self.signals.append(sig)
+        if self._on_sigterm is not None:
+            self._on_sigterm(self)
+
+    def terminate(self):
+        self.send_signal(15)
+
+    def kill(self):
+        self.rc = -9
+
+    def wait(self, timeout=None):
+        return self.rc
+
+
+class FakeScrapes:
+    """endpoint -> (ready, stats); unknown endpoints raise."""
+
+    def __init__(self):
+        self.table = {}
+
+    def set(self, endpoint, ready=True, **stats):
+        self.table[endpoint] = (ready, stats)
+
+    def __call__(self, url, timeout):
+        host = url.split('//')[1].split('/')[0]
+        if host not in self.table:
+            raise ConnectionError(f'unreachable {host}')
+        ready, stats = self.table[host]
+        if url.endswith('/readyz'):
+            return (200 if ready else 503), {'ready': ready}
+        return 200, stats
+
+
+def test_manager_journals_every_lifecycle_change(tmp_path):
+    scrapes = FakeScrapes()
+    pids = iter([501, 502])
+    mgr = ReplicaManager(
+        lambda rid, port: FakeProc(pid=next(pids),
+                                   on_sigterm=lambda p: setattr(
+                                       p, 'rc', 0)),
+        http_get=scrapes, state_dir=str(tmp_path),
+        drain_grace_s=5.0)
+    v1 = mgr.spawn()
+    v2 = mgr.spawn()
+    scrapes.set(v1.endpoint, ready=True,
+                instance_uuid=v1.instance_uuid)
+    scrapes.set(v2.endpoint, ready=True,
+                instance_uuid=v2.instance_uuid)
+    mgr.scrape_once()
+    path = os.path.join(str(tmp_path), 'fleet.journal')
+    live = journal_lib.replay_journal(path)
+    assert sorted(live) == [1, 2]
+    assert live[1].state == 'READY'
+    assert live[1].pid == 501
+    assert live[1].instance_uuid == v1.instance_uuid
+    assert live[1].instance_uuid != live[2].instance_uuid  # per spawn
+    # Drain 2: DRAINING then SHUTDOWN journaled; after remove() the
+    # record is terminated — replay shows only replica 1.
+    mgr.mark_draining(2)
+    assert journal_lib.replay_journal(path)[2].state == 'DRAINING'
+    mgr.drain(2)
+    assert 2 not in journal_lib.replay_journal(path)  # SHUTDOWN
+    mgr.remove(2)
+    live = journal_lib.replay_journal(path)
+    assert sorted(live) == [1]
+    # Crash detection journals FAILED.
+    v1.proc.rc = 1
+    mgr.scrape_once()
+    assert 1 not in journal_lib.replay_journal(path)
+
+
+def test_manager_without_state_dir_journals_nothing(tmp_path):
+    mgr = ReplicaManager(lambda rid, port: FakeProc(),
+                         http_get=FakeScrapes())
+    mgr.spawn()
+    assert os.listdir(str(tmp_path)) == []
+    assert mgr.adopt() == {'adopted': [], 'resumed_drains': [],
+                           'orphans': []}
+
+
+# ---------------------------------------------------------------------------
+# adoption verification matrix
+# ---------------------------------------------------------------------------
+def _seed_journal(tmp_path, rows):
+    """rows: list of (rid, port, uuid, pid, state)."""
+    j = FleetJournal(os.path.join(str(tmp_path), 'fleet.journal'))
+    for rid, port, uuid, pid, state in rows:
+        j.append('spawn', **_record(rid, port, state, uuid, pid))
+    j.close()
+
+
+def test_adopt_verification_matrix(tmp_path):
+    """One journaled replica per verification outcome:
+      1: pid alive + /stats echoes the journaled UUID -> ADOPTED
+      2: pid alive + /stats echoes a DIFFERENT UUID    -> orphan
+      3: pid dead, port unreachable                    -> orphan
+      4: pid dead, port answers with a foreign UUID
+         (port reused by a stranger)                   -> orphan
+    Orphans with a live pid get SIGTERM — never SIGKILL; dead pids
+    are never signaled at all."""
+    _seed_journal(tmp_path, [
+        (1, 7101, 'uuid-1', 201, 'READY'),
+        (2, 7102, 'uuid-2', 202, 'READY'),
+        (3, 7103, 'uuid-3', 203, 'NOT_READY'),
+        (4, 7104, 'uuid-4', 204, 'READY'),
+    ])
+    scrapes = FakeScrapes()
+    scrapes.set('127.0.0.1:7101', instance_uuid='uuid-1')
+    scrapes.set('127.0.0.1:7102', instance_uuid='uuid-OTHER')
+    scrapes.set('127.0.0.1:7104', instance_uuid='uuid-STRANGER')
+    alive = {201, 202}
+    signals = []
+    mgr = ReplicaManager(
+        lambda rid, port: FakeProc(), http_get=scrapes,
+        state_dir=str(tmp_path),
+        pid_probe=lambda pid: pid in alive,
+        signal_pid=lambda pid, sig: signals.append((pid, sig)))
+    adoptions_before = obs_catalog.counter(
+        'skypilot_fleet_adoptions_total').value
+    orphans_before = obs_catalog.counter(
+        'skypilot_fleet_orphans_reaped_total').value
+    summary = mgr.adopt()
+    assert summary == {'adopted': [1], 'resumed_drains': [],
+                       'orphans': [2, 3, 4]}
+    # Only the live unverifiable pid was signaled, with SIGTERM.
+    assert signals == [(202, 15)]
+    view = mgr.view(1)
+    assert view.adopted
+    assert view.state == serve_state.ReplicaStatus.STARTING
+    assert view.instance_uuid == 'uuid-1'
+    assert view.endpoint == '127.0.0.1:7101'
+    assert obs_catalog.counter(
+        'skypilot_fleet_adoptions_total').value == \
+        adoptions_before + 1
+    assert obs_catalog.counter(
+        'skypilot_fleet_orphans_reaped_total').value == \
+        orphans_before + 3
+    # The journal now only knows the adopted replica.
+    live = journal_lib.replay_journal(
+        os.path.join(str(tmp_path), 'fleet.journal'))
+    assert sorted(live) == [1]
+    # A scrape pass re-earns READY and routing.
+    mgr.scrape_once()
+    assert mgr.ready_endpoints() == ['127.0.0.1:7101']
+
+
+def test_adopt_resumes_interrupted_drain(tmp_path):
+    """A replica journaled DRAINING was mid-scale-down when the
+    controller died: adoption resumes the drain (SIGTERM -> wait for
+    self-exit) and never readmits it to routing."""
+    _seed_journal(tmp_path, [(1, 7201, 'uuid-1', 301, 'DRAINING')])
+    scrapes = FakeScrapes()
+    scrapes.set('127.0.0.1:7201', ready=False,
+                instance_uuid='uuid-1')
+    alive = {301}
+    signals = []
+
+    def signal_pid(pid, sig):
+        signals.append((pid, sig))
+        if sig == 15:
+            alive.discard(pid)  # drains and exits by itself
+
+    mgr = ReplicaManager(
+        lambda rid, port: FakeProc(), http_get=scrapes,
+        state_dir=str(tmp_path), drain_grace_s=5.0,
+        pid_probe=lambda pid: pid in alive, signal_pid=signal_pid)
+    summary = mgr.adopt(block_drains=True)
+    assert summary == {'adopted': [], 'resumed_drains': [1],
+                       'orphans': []}
+    assert signals == [(301, 15)]  # SIGTERM only, no SIGKILL
+    view = mgr.view(1)
+    assert view.state == serve_state.ReplicaStatus.SHUTDOWN
+    assert mgr.ready_endpoints() == []
+
+
+def test_adopt_resumes_id_counter_above_journal(tmp_path):
+    """Replica ids stay unique across controller generations — even
+    past terminated records (id reuse would alias journal replay)."""
+    _seed_journal(tmp_path, [(7, 7301, 'uuid-7', None, 'READY')])
+    j = FleetJournal(os.path.join(str(tmp_path), 'fleet.journal'))
+    j.append('terminate', replica_id=7)
+    j.close()
+    mgr = ReplicaManager(lambda rid, port: FakeProc(),
+                         http_get=FakeScrapes(),
+                         state_dir=str(tmp_path))
+    assert mgr.adopt() == {'adopted': [], 'resumed_drains': [],
+                           'orphans': []}
+    view = mgr.spawn()
+    assert view.replica_id == 8
+
+
+def test_adopt_requires_uuid_and_pid(tmp_path):
+    """A record with no instance UUID or no pid can never verify
+    (legacy or fake-handle fleets): it is an orphan, and with no pid
+    there is nothing to signal."""
+    _seed_journal(tmp_path, [
+        (1, 7401, '', 401, 'READY'),      # no uuid
+        (2, 7402, 'uuid-2', None, 'READY'),  # no pid
+    ])
+    scrapes = FakeScrapes()
+    scrapes.set('127.0.0.1:7401', instance_uuid='')
+    scrapes.set('127.0.0.1:7402', instance_uuid='uuid-2')
+    signals = []
+    mgr = ReplicaManager(
+        lambda rid, port: FakeProc(), http_get=scrapes,
+        state_dir=str(tmp_path),
+        pid_probe=lambda pid: pid == 401,
+        signal_pid=lambda pid, sig: signals.append((pid, sig)))
+    summary = mgr.adopt()
+    assert summary['adopted'] == []
+    assert summary['orphans'] == [1, 2]
+    assert signals == [(401, 15)]
+
+
+# ---------------------------------------------------------------------------
+# controller: tick fuse, drain-thread pruning, clocked wait_ready
+# ---------------------------------------------------------------------------
+def _controller(tmp_path=None, **mgr_kw):
+    scrapes = FakeScrapes()
+    mgr = ReplicaManager(lambda rid, port: FakeProc(),
+                         http_get=scrapes, **mgr_kw)
+    auto = autoscalers.EngineMetricsAutoscaler(_spec())
+    ctl = FleetController(mgr, lbp.RoundRobinPolicy(), auto)
+    return ctl, mgr, scrapes
+
+
+def test_tick_error_fuse_three_strikes_and_recovery():
+    ctl, _mgr, _scrapes = _controller()
+    errors = obs_catalog.counter('skypilot_fleet_tick_errors_total')
+    degraded = obs_catalog.gauge(
+        'skypilot_fleet_controller_degraded')
+    before = errors.value
+    faults.install_plan({'rules': [{
+        'point': 'fleet.tick', 'action': 'raise',
+        'exc': 'RuntimeError', 'message': 'injected tick failure',
+        'times': 3}]})
+    try:
+        assert not ctl.safe_tick()
+        assert not ctl.safe_tick()
+        assert degraded.value == 0  # two strikes: not degraded yet
+        assert not ctl.safe_tick()
+        assert degraded.value == 1  # third consecutive: degraded
+        assert ctl.consecutive_tick_failures == 3
+        assert errors.value == before + 3
+        # Plan exhausted (times=3): the next tick succeeds and
+        # resets the fuse.
+        assert ctl.safe_tick()
+        assert degraded.value == 0
+        assert ctl.consecutive_tick_failures == 0
+    finally:
+        faults.clear()
+
+
+def test_tick_fault_point_reaches_plain_tick():
+    ctl, _mgr, _scrapes = _controller()
+    faults.install_plan({'rules': [{
+        'point': 'fleet.tick', 'action': 'raise',
+        'exc': 'ValueError', 'message': 'tick poisoned',
+        'times': 1}]})
+    try:
+        with pytest.raises(ValueError, match='tick poisoned'):
+            ctl.tick()
+    finally:
+        faults.clear()
+
+
+def test_drain_threads_pruned():
+    """Long-running fleets must not accumulate one dead Thread per
+    scale-down forever."""
+    scrapes = FakeScrapes()
+    mgr = ReplicaManager(
+        lambda rid, port: FakeProc(
+            on_sigterm=lambda p: setattr(p, 'rc', 0)),
+        http_get=scrapes, drain_grace_s=5.0)
+    auto = autoscalers.EngineMetricsAutoscaler(_spec())
+    ctl = FleetController(mgr, lbp.RoundRobinPolicy(), auto)
+    for _ in range(6):
+        view = mgr.spawn()
+        ctl.drain_replica(view)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if view.state == serve_state.ReplicaStatus.SHUTDOWN:
+                break
+            time.sleep(0.01)
+    # Let the last drain thread finish, then one more drain prunes.
+    for t in list(ctl._drain_threads):
+        t.join(5)
+    view = mgr.spawn()
+    ctl.drain_replica(view)
+    assert len(ctl._drain_threads) <= 2
+
+
+def test_wait_ready_runs_on_injected_clock():
+    """wait_ready's deadline moves only when the injected clock
+    does: with a frozen clock it would loop forever, with a jumped
+    clock it returns immediately — no wall-clock reads."""
+    clock = _FakeClock()
+    scrapes = FakeScrapes()
+    mgr = ReplicaManager(lambda rid, port: FakeProc(),
+                         http_get=scrapes, clock=clock)
+    auto = autoscalers.EngineMetricsAutoscaler(_spec(), clock)
+    ctl = FleetController(mgr, lbp.RoundRobinPolicy(), auto,
+                          clock=clock)
+    ticks = {'n': 0}
+    orig_tick = ctl.tick
+
+    def counting_tick(now=None):
+        ticks['n'] += 1
+        clock.t += 100.0  # each tick advances virtual time
+        orig_tick(now=clock.t)
+
+    ctl.tick = counting_tick
+    assert not ctl.wait_ready(1, timeout_s=250.0, poll_s=0.0)
+    # 250 virtual seconds at 100 per tick: exactly 3 ticks ran —
+    # the loop consulted the INJECTED clock, not the wall clock.
+    assert ticks['n'] == 3
+
+
+# ---------------------------------------------------------------------------
+# chaos: controller crash + restart over REAL stub subprocesses
+# ---------------------------------------------------------------------------
+def _stub_env():
+    env = dict(os.environ)
+    env['PYTHONPATH'] = f"{REPO}:{env.get('PYTHONPATH', '')}"
+    return env
+
+
+def _wait_ready(ctl, mgr, n, timeout=30):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        ctl.tick()
+        if len(mgr.ready_endpoints()) >= n:
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def _reap(procs, timeout=10):
+    for p in procs:
+        try:
+            if p.poll() is None:
+                p.terminate()
+            p.wait(timeout=timeout)
+        except Exception:  # pylint: disable=broad-except
+            p.kill()
+
+
+def test_chaos_controller_crash_midscaledown_adopts_fleet(tmp_path):
+    """SIGKILL-shaped controller death mid-scale-down: replica 3 is
+    journaled DRAINING (routing already stopped) but the controller
+    dies before SIGTERM is sent. A NEW manager+controller on the
+    same state dir adopts the two healthy replicas (zero healthy
+    replicas killed — they never see a signal), resumes the
+    interrupted drain (the victim exits 0, not killed), and the LB
+    ring rebuilt from the adopted set routes affinity keys exactly
+    as before the crash. Zero leaked processes at the end."""
+    state_dir = str(tmp_path)
+    spawned = []
+
+    def tracking_factory(env):
+        inner = rm.stub_factory(
+            extra_args=['--token-sleep-ms', '0'], env=env)
+
+        def spawn(rid, port, instance_uuid=''):
+            proc = inner(rid, port, instance_uuid=instance_uuid)
+            spawned.append(proc)
+            return proc
+
+        return spawn
+
+    policy1 = lbp.PrefixAffinityPolicy()
+    mgr1 = ReplicaManager(tracking_factory(_stub_env()),
+                          state_dir=state_dir, drain_grace_s=10.0)
+    auto1 = autoscalers.EngineMetricsAutoscaler(
+        _spec(min_replicas=3, max_replicas=3))
+    ctl1 = FleetController(mgr1, policy1, auto1)
+    try:
+        for _ in range(3):
+            mgr1.spawn()
+        assert _wait_ready(ctl1, mgr1, 3), \
+            [v.to_dict() for v in mgr1.views()]
+        endpoints = sorted(mgr1.ready_endpoints())
+        victim = mgr1.view(3)
+        survivors = [v for v in mgr1.views() if v.replica_id != 3]
+        # Affinity snapshot: where 20 keys route pre-crash.
+        keys = [f'key-{i}' for i in range(20)]
+        pre = {k: policy1.affinity_target(k) for k in keys}
+
+        # Scale-down begins: DRAINING journaled, routing stopped...
+        mgr1.mark_draining(3)
+        # ...and the controller DIES here (before SIGTERM). All its
+        # in-memory state is gone; the stub processes live on.
+        del ctl1, mgr1, auto1, policy1
+
+        # --- restart: fresh control plane, same state dir --------------
+        policy2 = lbp.PrefixAffinityPolicy()
+        mgr2 = ReplicaManager(tracking_factory(_stub_env()),
+                              state_dir=state_dir,
+                              drain_grace_s=10.0)
+        auto2 = autoscalers.EngineMetricsAutoscaler(
+            _spec(min_replicas=2, max_replicas=2))
+        ctl2 = FleetController(mgr2, policy2, auto2)
+        summary = mgr2.adopt(block_drains=True)
+        assert sorted(summary['adopted']) == [1, 2]
+        assert summary['resumed_drains'] == [3]
+        assert summary['orphans'] == []
+        # The interrupted drain finished: the victim exited 0 on its
+        # own (SIGTERM drain), it was NOT killed.
+        assert spawned[2].wait(timeout=10) == 0
+        # The healthy replicas were never signaled and still serve.
+        assert _wait_ready(ctl2, mgr2, 2)
+        adopted_eps = sorted(mgr2.ready_endpoints())
+        assert adopted_eps == sorted(
+            v.endpoint for v in survivors)
+        assert victim.endpoint not in adopted_eps
+        for ep in adopted_eps:
+            assert requests.get(f'http://{ep}/stats',
+                                timeout=5).status_code == 200
+        # Ring rebuilt from the adopted set: every key that routed
+        # to a SURVIVOR pre-crash routes to the same replica now
+        # (its KV pages are still there), and the dead replica's
+        # keys remapped onto live ones.
+        for k in keys:
+            if pre[k] in adopted_eps:
+                assert policy2.affinity_target(k) == pre[k]
+            else:
+                assert policy2.affinity_target(k) in adopted_eps
+        # New generation spawns do not collide with journaled ids.
+        assert next(mgr2._ids) == 4
+        ctl2.shutdown()
+    finally:
+        _reap(spawned)
+    # Zero leaked processes: every stub we ever spawned has exited.
+    assert all(p.poll() is not None for p in spawned)
+
+
+def test_chaos_restarted_fleet_serves_through_lb(tmp_path):
+    """After adoption the full serving path works end to end: the
+    restarted controller's LB answers keyed POSTs from the adopted
+    replicas with zero 5xx."""
+    state_dir = str(tmp_path)
+    spawned = []
+    env = _stub_env()
+    inner = rm.stub_factory(extra_args=['--token-sleep-ms', '0'],
+                            env=env)
+
+    def factory(rid, port, instance_uuid=''):
+        proc = inner(rid, port, instance_uuid=instance_uuid)
+        spawned.append(proc)
+        return proc
+
+    mgr1 = ReplicaManager(factory, state_dir=state_dir,
+                          drain_grace_s=10.0)
+    ctl1 = FleetController(
+        mgr1, lbp.PrefixAffinityPolicy(),
+        autoscalers.EngineMetricsAutoscaler(
+            _spec(min_replicas=2, max_replicas=2)))
+    try:
+        mgr1.spawn()
+        mgr1.spawn()
+        assert _wait_ready(ctl1, mgr1, 2)
+        del ctl1, mgr1  # controller crash
+
+        policy = lbp.PrefixAffinityPolicy()
+        mgr2 = ReplicaManager(factory, state_dir=state_dir,
+                              drain_grace_s=10.0)
+        ctl2 = FleetController(
+            mgr2, policy, autoscalers.EngineMetricsAutoscaler(
+                _spec(min_replicas=2, max_replicas=2)))
+        assert sorted(mgr2.adopt()['adopted']) == [1, 2]
+        assert _wait_ready(ctl2, mgr2, 2)
+        lb_port = rm.free_port()
+        lb = make_lb_server(policy, lb_port,
+                            policy_name='prefix_affinity',
+                            manager=mgr2)
+        threading.Thread(target=lb.serve_forever,
+                         daemon=True).start()
+        url = f'http://127.0.0.1:{lb_port}'
+        try:
+            for i in range(8):
+                r = requests.post(f'{url}/generate', json={
+                    'tokens': [[100 + i] * 16 + [1, 2]],
+                    'max_new_tokens': 3}, timeout=30)
+                assert r.status_code == 200
+            snap = lb.lb_metrics.snapshot()
+            assert snap['routed'] >= 8 and snap['retried'] == 0
+        finally:
+            ctl2.shutdown()
+            lb.shutdown()
+    finally:
+        _reap(spawned)
+    assert all(p.poll() is not None for p in spawned)
