@@ -183,7 +183,21 @@ def run_job(home: str, job_id: int) -> job_lib.JobStatus:
                         f'[driver] rank {ex.rank} exited rc={ex.rc}\n'
                         .encode())
                 if ex.rc != 0:
-                    final = job_lib.JobStatus.FAILED
+                    # First terminal cause wins: a typed trainer exit
+                    # (graceful preemption checkpoint, watchdog abort
+                    # — train_guard.py) maps to its typed status so
+                    # the managed-jobs controller recovers instead of
+                    # failing; the SIGTERM rcs of the siblings this
+                    # kill-all cancels must not overwrite it.
+                    if final == job_lib.JobStatus.SUCCEEDED:
+                        typed = job_lib.status_for_exit_code(ex.rc)
+                        final = typed or job_lib.JobStatus.FAILED
+                        if typed is not None:
+                            with lock:
+                                combined.write(
+                                    f'[driver] rank {ex.rank} exit '
+                                    f'code {ex.rc} is typed: job '
+                                    f'status {typed.value}\n'.encode())
                     for other in execs:
                         if other is not ex and other.poll() is None:
                             other.cancel()

@@ -17,6 +17,7 @@ import os
 import time
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu.robustness import train_guard
 from skypilot_tpu.utils import db_utils
 from skypilot_tpu.utils import subprocess_utils
 
@@ -30,14 +31,39 @@ class JobStatus(enum.Enum):
     FAILED = 'FAILED'
     FAILED_SETUP = 'FAILED_SETUP'
     CANCELLED = 'CANCELLED'
+    # Typed trainer exits (robustness/train_guard.py): terminal for
+    # the ON-CLUSTER job, but the managed-jobs controller maps them
+    # to its recovery path (relaunch) instead of user failure.
+    PREEMPTED = 'PREEMPTED'            # graceful preemption-notice exit
+    WATCHDOG_ABORT = 'WATCHDOG_ABORT'  # hung step/loader, aborted
 
     def is_terminal(self) -> bool:
         return self in (JobStatus.SUCCEEDED, JobStatus.FAILED,
-                        JobStatus.FAILED_SETUP, JobStatus.CANCELLED)
+                        JobStatus.FAILED_SETUP, JobStatus.CANCELLED,
+                        JobStatus.PREEMPTED, JobStatus.WATCHDOG_ABORT)
+
+    def is_recoverable(self) -> bool:
+        """Terminal exits the managed-jobs controller should answer
+        with a PREEMPTING -> RECOVERING relaunch, NOT count against
+        the user-failure restart budget."""
+        return self in (JobStatus.PREEMPTED, JobStatus.WATCHDOG_ABORT)
 
     @classmethod
     def terminal_statuses(cls) -> List['JobStatus']:
         return [s for s in cls if s.is_terminal()]
+
+
+#: Typed rank exit code -> job status (the trainer's side of the
+#: contract; anything unlisted stays a plain FAILED).
+_EXIT_CODE_STATUS = {
+    train_guard.EXIT_PREEMPTED_GRACEFUL: JobStatus.PREEMPTED,
+    train_guard.EXIT_WATCHDOG_ABORT: JobStatus.WATCHDOG_ABORT,
+}
+
+
+def status_for_exit_code(rc: int) -> Optional[JobStatus]:
+    """Typed status for a rank's exit code, or None for untyped."""
+    return _EXIT_CODE_STATUS.get(rc)
 
 
 _CREATE_SQL = """\

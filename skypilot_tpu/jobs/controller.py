@@ -343,6 +343,33 @@ class JobController:
 
             if status is None or not status.is_terminal():
                 continue
+            if status.is_recoverable():
+                # Typed trainer exits (train_guard.py): a graceful
+                # preemption-notice checkpoint (rc 83) or a watchdog
+                # abort of a hung step (rc 84). Both take the
+                # PREEMPTING -> RECOVERING relaunch path and do NOT
+                # consume the user-failure restart budget — the
+                # checkpoint contract makes the relaunch resume
+                # where the trainer stopped.
+                from skypilot_tpu.observability import (catalog as
+                                                        obs_catalog)
+                preempted = (status ==
+                             agent_job_lib.JobStatus.PREEMPTED)
+                if preempted:
+                    obs_catalog.counter(
+                        'skypilot_train_preempt_notices_total').inc()
+                else:
+                    obs_catalog.counter(
+                        'skypilot_train_watchdog_aborts_total').inc()
+                ux_utils.log(
+                    f'Managed job {job_id}: trainer exited '
+                    f'{status.value} (typed recoverable exit); '
+                    f'recovering.')
+                state.set_status(job_id,
+                                 state.ManagedJobStatus.PREEMPTING)
+                agent_job_id = self._recover(preemption=preempted)
+                unreachable_since = None
+                continue
             if status == agent_job_lib.JobStatus.SUCCEEDED:
                 # Pipelines: persist the advance BEFORE cleanup — a
                 # controller crash in between must make the adopted
@@ -372,7 +399,11 @@ class JobController:
                     if status == agent_job_lib.JobStatus.FAILED_SETUP
                     else state.ManagedJobStatus.FAILED)
 
-    def _recover(self) -> int:
+    def _recover(self, preemption: bool = True) -> int:
+        """Relaunch + resubmit. `preemption=False` (watchdog aborts)
+        skips the zone-preemption counter — a hang is not a spot
+        reclaim — but still records the recovery event the latency
+        accounting is computed from."""
         job_id = self.job_id
         zone = self._zone()
         state.set_status(job_id, state.ManagedJobStatus.RECOVERING)
@@ -380,9 +411,12 @@ class JobController:
         # Fleet-level preemption signals: the zone-labeled counter
         # (a spiking label = a zone melting down) and the per-event
         # timestamps recovery latency is computed from.
-        from skypilot_tpu.observability import catalog as obs_catalog
-        obs_catalog.counter('skypilot_jobs_preemptions_total').labels(
-            zone=zone or 'unknown').inc()
+        if preemption:
+            from skypilot_tpu.observability import (catalog as
+                                                    obs_catalog)
+            obs_catalog.counter(
+                'skypilot_jobs_preemptions_total').labels(
+                    zone=zone or 'unknown').inc()
         state.record_preemption(job_id, zone)
         ux_utils.log(f'Managed job {job_id}: cluster lost; recovering.')
         agent_job_id = self.executor.recover()

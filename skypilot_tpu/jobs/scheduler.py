@@ -45,6 +45,7 @@ def maybe_schedule_next_jobs() -> None:
         starting = len(state.get_jobs(status=[
             state.ManagedJobStatus.SUBMITTED,
             state.ManagedJobStatus.STARTING,
+            state.ManagedJobStatus.PREEMPTING,
             state.ManagedJobStatus.RECOVERING]))
         running = len(state.get_jobs(status=[
             state.ManagedJobStatus.RUNNING]))
@@ -108,7 +109,8 @@ def _reconcile_dead_controllers() -> None:
     """
     active = state.get_jobs(status=[
         state.ManagedJobStatus.SUBMITTED, state.ManagedJobStatus.STARTING,
-        state.ManagedJobStatus.RUNNING, state.ManagedJobStatus.RECOVERING,
+        state.ManagedJobStatus.RUNNING, state.ManagedJobStatus.PREEMPTING,
+        state.ManagedJobStatus.RECOVERING,
         state.ManagedJobStatus.CANCELLING])
     for job in active:
         pid = job.get('controller_pid') or -1

@@ -23,6 +23,11 @@ class ManagedJobStatus(enum.Enum):
     SUBMITTED = 'SUBMITTED'
     STARTING = 'STARTING'
     RUNNING = 'RUNNING'
+    # The trainer announced a typed recoverable exit (graceful
+    # preemption checkpoint or watchdog abort): transitional state
+    # between the typed agent-job status landing and recovery
+    # starting — PREEMPTING -> RECOVERING -> RUNNING.
+    PREEMPTING = 'PREEMPTING'
     RECOVERING = 'RECOVERING'
     CANCELLING = 'CANCELLING'
     SUCCEEDED = 'SUCCEEDED'

@@ -170,6 +170,26 @@ SPECS: Dict[str, Tuple] = {
                    'verification at restore (torn/corrupt writes); '
                    'each one triggers fallback to the newest '
                    'verifying step', ()),
+    # -- self-supervising trainer (robustness/train_guard.py; the
+    #    controller-side increments live in jobs/controller.py when a
+    #    typed trainer exit lands)
+    'skypilot_train_preempt_notices_total': (
+        'counter', 'Preemption notices observed (GCE metadata, '
+                   'SIGTERM, or injected): each one is a graceful '
+                   'checkpoint-now-then-exit the controller answers '
+                   'with recovery instead of FAILED', ()),
+    'skypilot_train_guard_skipped_steps_total': (
+        'counter', 'Optimizer steps the on-device NaN/spike guard '
+                   'skipped (non-finite loss/grad norm, or norm '
+                   'above the EMA spike threshold); K consecutive '
+                   'skips trigger rollback to the last verified '
+                   'checkpoint', ()),
+    'skypilot_train_watchdog_aborts_total': (
+        'counter', 'Hung trainers the step watchdog aborted (stuck '
+                   'collective or stalled data loader past the '
+                   'per-phase deadline), with all thread stacks '
+                   'dumped; the controller relaunches instead of '
+                   'waiting forever', ()),
     # -- managed jobs (jobs/controller.py + recovery_strategy.py)
     'skypilot_jobs_recovery_attempts_total': (
         'counter', 'Managed-job recovery attempts (cluster lost or '

@@ -104,6 +104,19 @@ class ShardedTrainer:
     keeps 1/data of the Adam m/v state, GSPMD reduce-scatters the
     grads into the shards and all-gathers the updated params — the
     step math (and loss curve) is unchanged.
+
+    `guard` arms the self-supervising bad-step guard
+    (robustness/train_guard.py): the train step takes an extra
+    `ctl = [max_grad_norm, loss_scale]` array, flags the step bad ON
+    DEVICE when the loss or global grad norm is non-finite or the
+    norm exceeds `max_grad_norm`, and SKIPS the update by selecting
+    the old params/opt_state — no host round-trip sits between a NaN
+    and the optimizer. The step counter still advances (a skipped
+    batch is consumed), aux becomes `(loss, grad_norm, bad)`, and
+    `loss_scale` exists so a fault plan can poison one step's loss
+    with NaN through the real isfinite path. Guarding implies grad-
+    norm collection; the norm is computed ONCE and shared by the
+    guard predicate and the metrics aux.
     """
 
     def __init__(self, model: nn.Module, mesh: Mesh,
@@ -113,18 +126,20 @@ class ShardedTrainer:
                                    jax.Array] = next_token_loss,
                  fused_xent: Optional[bool] = None,
                  zero1: bool = False,
-                 collect_grad_norm: bool = False) -> None:
+                 collect_grad_norm: bool = False,
+                 guard: bool = False) -> None:
         self.model = model
         self.mesh = mesh
         self.tx = tx if tx is not None else default_optimizer()
         self.rules = rules
         self.loss_fn = loss_fn
         self.zero1 = zero1
+        self.guard = guard
         # Step metrics (`train_lm --metrics-file`): the step returns
         # (loss, grad_norm) instead of a bare loss. The norm is
         # computed from grads already in registers — free next to the
-        # step itself.
-        self.collect_grad_norm = collect_grad_norm
+        # step itself. The guard needs it unconditionally.
+        self.collect_grad_norm = collect_grad_norm or guard
         supported = _supports_fused(model, loss_fn)
         if fused_xent and not supported:
             raise ValueError(
@@ -227,12 +242,22 @@ class ShardedTrainer:
         outputs = self.model.apply({'params': params}, tokens)
         return self.loss_fn(outputs, tokens)
 
-    def _step_body(self, state: TrainState, tokens: jax.Array
+    def _step_body(self, state: TrainState, tokens: jax.Array,
+                   ctl: Optional[jax.Array] = None
                    ) -> Tuple[TrainState, Any]:
-        loss, grads = jax.value_and_grad(self._compute_loss)(
-            state.params, tokens)
-        aux = (loss if not self.collect_grad_norm
-               else (loss, optax.global_norm(grads)))
+        if ctl is None:
+            loss, grads = jax.value_and_grad(self._compute_loss)(
+                state.params, tokens)
+        else:
+            # Guarded step: ctl = [max_grad_norm, loss_scale]. The
+            # scale rides INSIDE value_and_grad so an injected NaN
+            # poisons loss AND grads — exactly the bf16-overflow
+            # shape the isfinite predicate exists for.
+            loss, grads = jax.value_and_grad(
+                lambda p: self._compute_loss(p, tokens) * ctl[1])(
+                    state.params)
+        gnorm = (optax.global_norm(grads) if self.collect_grad_norm
+                 else None)
         updates, opt_state = self.tx.update(grads, state.opt_state,
                                             state.params)
         if self.zero1 and self._state_sharding is not None:
@@ -245,28 +270,64 @@ class ShardedTrainer:
             opt_state = jax.lax.with_sharding_constraint(
                 opt_state, self._state_sharding.opt_state)
         params = optax.apply_updates(state.params, updates)
+        if ctl is None:
+            aux = loss if gnorm is None else (loss, gnorm)
+            return state.replace(step=state.step + 1, params=params,
+                                 opt_state=opt_state), aux
+        # Bad step — non-finite loss/norm, or a norm spike past the
+        # host-supplied ceiling: select the OLD params and opt_state
+        # (the update never happens), but still consume the step.
+        bad = jnp.logical_or(
+            jnp.logical_or(~jnp.isfinite(loss), ~jnp.isfinite(gnorm)),
+            gnorm > ctl[0])
+        params = jax.tree.map(
+            lambda new, old: jnp.where(bad, old, new),
+            params, state.params)
+        opt_state = jax.tree.map(
+            lambda new, old: jnp.where(bad, old, new),
+            opt_state, state.opt_state)
         return state.replace(step=state.step + 1, params=params,
-                             opt_state=opt_state), aux
+                             opt_state=opt_state), (loss, gnorm, bad)
 
     def _wrap(self, step: Callable) -> Callable:
-        def wrapped(state, tokens):
+        def wrapped(state, tokens, *extra):
             from skypilot_tpu.parallel import context as cp_context
             with self.mesh, cp_context.context_parallel(self.mesh):
                 with nn.logical_axis_rules(self.rules):
-                    return step(state, tokens)
+                    return step(state, tokens, *extra)
 
         wrapped.lower = lambda s, t: step.lower(s, t)  # type: ignore
         return wrapped
 
     def make_train_step(self, example_tokens: jax.Array,
                         donate: bool = True) -> Callable:
+        """The per-step train fn. Unguarded: `(state, tokens) ->
+        (state, aux)`. With `guard=True`: `(state, tokens,
+        max_grad_norm, loss_scale) -> (state, (loss, gnorm, bad))` —
+        the two guard scalars ride one replicated f32 array."""
         sharding = self.state_sharding(example_tokens)
+        scalar = NamedSharding(self.mesh, P())
+        if not self.guard:
+            step = jax.jit(
+                self._step_body,
+                in_shardings=(sharding, self.batch_sharding),
+                out_shardings=(sharding, scalar),
+                donate_argnums=(0,) if donate else ())
+            return self._wrap(step)
         step = jax.jit(
             self._step_body,
-            in_shardings=(sharding, self.batch_sharding),
-            out_shardings=(sharding, NamedSharding(self.mesh, P())),
+            in_shardings=(sharding, self.batch_sharding, scalar),
+            out_shardings=(sharding, scalar),
             donate_argnums=(0,) if donate else ())
-        return self._wrap(step)
+        wrapped = self._wrap(step)
+
+        def guarded(state, tokens, max_grad_norm=float('inf'),
+                    loss_scale=1.0):
+            ctl = jnp.asarray([max_grad_norm, loss_scale],
+                              dtype=jnp.float32)
+            return wrapped(state, tokens, ctl)
+
+        return guarded
 
     def make_multi_step(self, example_tokens: jax.Array,
                         inner_steps: int,

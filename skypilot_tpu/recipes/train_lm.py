@@ -16,7 +16,11 @@ from __future__ import annotations
 
 import argparse
 import os
+import sys
 import time
+
+from skypilot_tpu.robustness import faults
+from skypilot_tpu.robustness import train_guard
 
 
 def _maybe_init_distributed() -> None:
@@ -114,6 +118,68 @@ def main() -> None:
                              "checkpoint's config.json "
                              '(models/hf_import.py)')
     parser.add_argument('--ckpt-every', type=int, default=50)
+    parser.add_argument('--ckpt-interval', default=None,
+                        metavar='auto|SECONDS',
+                        help='checkpoint cadence as WALL TIME instead '
+                             'of --ckpt-every steps: a number of '
+                             'seconds, or "auto" to solve the '
+                             'Young/Daly optimum tau* = sqrt(2*delta/'
+                             'lambda) from the zone preemption rate '
+                             '(--preemption-rate) and the checkpoint '
+                             'overhead (--ckpt-overhead) — '
+                             'jobs/policy.py. The cadence in steps is '
+                             'fixed from the measured mean step time '
+                             'of the first logged window and printed')
+    parser.add_argument('--preemption-rate', type=float, default=None,
+                        metavar='PER_HOUR',
+                        help='zone spot preemption rate lambda '
+                             '(preemptions/hour) for --ckpt-interval '
+                             'auto; default: the '
+                             'SKYPILOT_PREEMPTION_RATE_PER_HOUR env '
+                             'var (set it in the task env, e.g. from '
+                             'the catalog\'s per-zone PreemptionRate '
+                             'column)')
+    parser.add_argument('--ckpt-overhead', type=float, default=None,
+                        metavar='SECONDS',
+                        help='checkpoint write overhead delta for '
+                             '--ckpt-interval auto (default: '
+                             'jobs/policy.DEFAULT_CKPT_OVERHEAD_S, '
+                             '60s)')
+    parser.add_argument('--guard', action='store_true',
+                        help='arm the self-supervising trainer '
+                             '(robustness/train_guard.py): preemption'
+                             '-notice watcher (GCE metadata + '
+                             'SIGTERM) checkpoints NOW and exits '
+                             'with the typed code 83 the managed-'
+                             'jobs controller maps to recovery; '
+                             'on-device NaN/spike guard skips bad '
+                             'optimizer steps and rolls back to the '
+                             'last checkpoint after --rollback-after '
+                             'consecutive ones; a step watchdog '
+                             'dumps all thread stacks and aborts '
+                             'with code 84 on a hung collective or '
+                             'stalled data loader')
+    parser.add_argument('--spike-factor', type=float, default=10.0,
+                        help='grad-norm spike threshold as a '
+                             'multiple of its EMA (guard)')
+    parser.add_argument('--guard-warmup', type=int, default=10,
+                        help='good steps of EMA warmup before spike '
+                             'detection arms (guard)')
+    parser.add_argument('--rollback-after', type=int, default=3,
+                        help='consecutive bad steps before rolling '
+                             'back to the last checkpoint (guard)')
+    parser.add_argument('--watchdog-deadline', type=float,
+                        default=300.0, metavar='SECONDS',
+                        help='per-phase step-watchdog deadline; 0 '
+                             'disables the watchdog (guard)')
+    parser.add_argument('--watchdog-compile-deadline', type=float,
+                        default=1800.0, metavar='SECONDS',
+                        help='watchdog deadline for the first step '
+                             '(covers XLA compilation)')
+    parser.add_argument('--preempt-poll', type=float, default=5.0,
+                        metavar='SECONDS',
+                        help='preemption-notice metadata poll '
+                             'interval (guard)')
     parser.add_argument('--lr', type=float, default=3e-4)
     parser.add_argument('--tensor', type=int, default=1,
                         help='tensor-parallel mesh axis size')
@@ -200,6 +266,27 @@ def main() -> None:
     if args.microbatches and args.pipeline_stages <= 1:
         raise SystemExit('--microbatches only applies with '
                          '--pipeline-stages > 1')
+    if args.guard and args.pipeline_stages > 1:
+        raise SystemExit('--guard needs the sharded trainer (the '
+                         'GPipe path computes per-stage losses with '
+                         'no global grad norm); drop one')
+    if args.ckpt_interval is not None:
+        if not args.ckpt_dir:
+            raise SystemExit('--ckpt-interval needs --ckpt-dir')
+        if args.ckpt_interval != 'auto':
+            try:
+                if float(args.ckpt_interval) <= 0:
+                    raise ValueError
+            except ValueError:
+                raise SystemExit('--ckpt-interval takes "auto" or a '
+                                 'positive number of seconds') \
+                    from None
+        elif args.preemption_rate is None and not os.environ.get(
+                'SKYPILOT_PREEMPTION_RATE_PER_HOUR'):
+            raise SystemExit(
+                '--ckpt-interval auto needs the zone preemption '
+                'rate: pass --preemption-rate or set '
+                'SKYPILOT_PREEMPTION_RATE_PER_HOUR')
     if args.pipeline_stages > 1:
         # v2: tensor and expert shard WITHIN each pipeline stage
         # (shard_map auto axes — GSPMD inserts the within-stage
@@ -281,8 +368,11 @@ def main() -> None:
             # without return_hidden falls back to the naive path).
             fused_xent=False if args.no_fused_xent else None,
             zero1=args.zero1,
-            # --metrics-file wants grad_norm in every record.
+            # --metrics-file wants grad_norm in every record; --guard
+            # needs it unconditionally (the trainer forces it on and
+            # computes the norm once for both consumers).
             collect_grad_norm=args.metrics_file is not None,
+            guard=args.guard,
             **kwargs)
         if proc_id == 0:
             print(f'fused_xent={trainer.fused_xent} zero1={args.zero1}',
@@ -308,8 +398,13 @@ def main() -> None:
     mgr = None
     if args.ckpt_dir:
         from skypilot_tpu.parallel.checkpoints import CheckpointManager
-        mgr = CheckpointManager(args.ckpt_dir,
-                                save_interval_steps=args.ckpt_every)
+        # Interval mode gates the cadence host-side (it can change
+        # once the step cost is measured), so orbax itself must not
+        # filter steps.
+        mgr = CheckpointManager(
+            args.ckpt_dir,
+            save_interval_steps=(1 if args.ckpt_interval is not None
+                                 else args.ckpt_every))
         latest = mgr.latest_step()
         if latest is not None:
             # restore() verifies sha256 manifests and falls back to
@@ -335,8 +430,16 @@ def main() -> None:
                              rank=proc_id, world=jax.process_count())
 
     rng = np.random.default_rng(0)
+    start_step = int(state.step)
+    # Fire-site context for the train.* fault points: scoped rules
+    # can target the first launch ({"resume": "0"}) and leave the
+    # checkpoint-resumed run alone.
+    resume_ctx = {'resume': '1' if start_step > 0 else '0'}
 
     def next_tokens():
+        # Chaos: a delay rule here is a stalled data loader — the
+        # step watchdog must abort past its deadline.
+        faults.point('train.data_next', **resume_ctx)
         if loader is not None:
             arr = loader.next_batch()[:, :-1].astype(np.int32)
         else:
@@ -352,7 +455,8 @@ def main() -> None:
 
     # Step telemetry (--metrics-file): one JSONL record per logged
     # window. The GPipe path keeps its per-stage step fn (no grad
-    # norm); the sharded trainer returns (loss, grad_norm).
+    # norm); the sharded trainer returns (loss, grad_norm) — and with
+    # --guard, (loss, grad_norm, bad).
     has_gnorm = (args.metrics_file is not None and
                  args.pipeline_stages <= 1)
     emitter = None
@@ -365,21 +469,113 @@ def main() -> None:
         print(f'step metrics -> {args.metrics_file} '
               f'(n_params={n_params:,})', flush=True)
 
-    start_step = int(state.step)
+    # Self-supervising guards (--guard): preemption-notice watcher,
+    # on-device NaN/spike skip + rollback, step watchdog.
+    sup = None
+    if args.guard:
+        sup = train_guard.TrainSupervisor(
+            spike_factor=args.spike_factor,
+            warmup_steps=args.guard_warmup,
+            rollback_after=args.rollback_after,
+            watchdog_deadline_s=args.watchdog_deadline,
+            compile_deadline_s=args.watchdog_compile_deadline,
+            notice_poll_s=args.preempt_poll,
+            ctx=resume_ctx)
+        sup.start()
+        if proc_id == 0:
+            wd = (f'{args.watchdog_deadline:.0f}s'
+                  if args.watchdog_deadline > 0 else 'off')
+            print(f'train-guard armed: spike_factor='
+                  f'{args.spike_factor} warmup={args.guard_warmup} '
+                  f'rollback_after={args.rollback_after} '
+                  f'watchdog={wd} preempt_poll='
+                  f'{args.preempt_poll:.1f}s', flush=True)
+
+    # Checkpoint cadence: steps (--ckpt-every) or wall time
+    # (--ckpt-interval SECONDS | auto). Auto solves the Young/Daly
+    # optimum from the zone preemption rate; either interval form is
+    # converted to steps from the measured mean step time of the
+    # first logged window (compile inflates that window, so the
+    # first estimate errs toward checkpointing too OFTEN — the safe
+    # side).
+    ckpt_every_steps = args.ckpt_every
+    ckpt_interval_s = None
+    if args.ckpt_interval == 'auto':
+        from skypilot_tpu.jobs import policy as jobs_policy
+        rate = (args.preemption_rate
+                if args.preemption_rate is not None else
+                float(os.environ['SKYPILOT_PREEMPTION_RATE_PER_HOUR']))
+        overhead = (args.ckpt_overhead
+                    if args.ckpt_overhead is not None else
+                    jobs_policy.DEFAULT_CKPT_OVERHEAD_S)
+        ckpt_interval_s = jobs_policy.optimal_checkpoint_interval(
+            rate, overhead)
+        if proc_id == 0:
+            print(f'ckpt-interval auto: lambda={rate}/hr '
+                  f'delta={overhead:.0f}s -> tau*='
+                  f'{ckpt_interval_s:.0f}s (step cadence fixed after '
+                  f'the first logged window)', flush=True)
+    elif args.ckpt_interval is not None:
+        ckpt_interval_s = float(args.ckpt_interval)
+    cadence_fixed = ckpt_interval_s is None
+
     t0 = time.perf_counter()
     window_tokens = 0
     window_steps = 0
-    for step in range(start_step, args.steps):
+    step = start_step
+    pending = None  # guard: last dispatched step's un-fetched aux
+    while step < args.steps:
+        if sup is not None and sup.preempted:
+            # Preemption notice (metadata, SIGTERM, or injected):
+            # checkpoint NOW and exit with the typed code the
+            # managed-jobs controller maps to recovery — the resumed
+            # run loses at most the step currently in flight.
+            if sup.watchdog is not None:
+                sup.watchdog.stop()  # a slow save must not trip it
+            if proc_id == 0:
+                print(f'preemption notice ({sup.preempt_reason}) at '
+                      f'step {step}: checkpointing and exiting '
+                      f'rc={train_guard.EXIT_PREEMPTED_GRACEFUL}',
+                      flush=True)
+            if mgr is not None:
+                with timeline.Event('train/checkpoint', 'preempt'):
+                    mgr.save(step, state, force=True)
+                    mgr.wait_until_finished()
+                    mgr.close()
+            if emitter is not None:
+                emitter.close()
+            if args.trace_file:
+                timeline.save()
+            sup.stop()
+            sys.exit(train_guard.EXIT_PREEMPTED_GRACEFUL)
         # >= not ==: a checkpoint resume may land past prof_start.
         if not tracing and prof_start >= 0 and \
                 prof_start <= step < prof_stop:
             jax.profiler.start_trace(args.profile)
             tracing = True
+        first = step == start_step
+        if sup is not None:
+            sup.beat('data', first_step=first)
         with timeline.Event('train/data'):
             tokens = next_tokens()
+        if sup is not None:
+            sup.beat('step', first_step=first)
         with timeline.Event('train/step', f'step {step}'):
-            state, aux = step_fn(state, tokens)
-        loss, gnorm = aux if has_gnorm else (aux, None)
+            if sup is not None:
+                max_gnorm, loss_scale = sup.step_ctl(step)
+                state, aux = step_fn(state, tokens, max_gnorm,
+                                     loss_scale)
+            else:
+                faults.point('train.step', step=str(step),
+                             **resume_ctx)
+                state, aux = step_fn(state, tokens)
+        if sup is not None:
+            loss, gnorm, bad_flag = aux
+        elif has_gnorm:
+            loss, gnorm = aux
+            bad_flag = None
+        else:
+            loss, gnorm, bad_flag = aux, None, None
         if tracing and step + 1 >= prof_stop:
             # Block so the trace holds COMPLETE device timelines for
             # the window, not just dispatches.
@@ -390,7 +586,48 @@ def main() -> None:
                   f'to {args.profile}', flush=True)
         window_tokens += batch * args.seq
         window_steps += 1
-        if mgr is not None:
+        if sup is not None:
+            # Lagged observation: fetch the PREVIOUS step's verdict
+            # while this one computes (one-step pipelining keeps the
+            # device busy; a rollback discards at most the one step
+            # dispatched since).
+            if pending is not None:
+                p_step, p_loss, p_gnorm, p_bad = pending
+                pending = None
+                verdict = sup.observe(p_step, float(p_loss),
+                                      float(p_gnorm), bool(p_bad))
+                if verdict == 'rollback':
+                    from skypilot_tpu.robustness.errors import (
+                        CheckpointNotFoundError)
+                    restored = False
+                    if mgr is not None:
+                        try:
+                            state = mgr.restore(state)
+                            restored = True
+                        except CheckpointNotFoundError:
+                            pass
+                    if restored:
+                        sup.guard.reset_after_rollback()
+                        step = int(state.step)
+                        t0 = time.perf_counter()
+                        window_tokens = 0
+                        window_steps = 0
+                        if proc_id == 0:
+                            print(f'train-guard: rolled back to '
+                                  f'last checkpoint (step {step})',
+                                  flush=True)
+                        continue
+                    # Nothing to roll back to. The params are still
+                    # clean (every bad step was skipped on device):
+                    # reset the escalation counter and keep skipping.
+                    sup.guard.consecutive_bad = 0
+                    if proc_id == 0:
+                        print('train-guard: rollback requested but '
+                              'no checkpoint available; continuing '
+                              'with per-step skips', flush=True)
+            pending = (step, loss, gnorm, bad_flag)
+        if mgr is not None and (ckpt_interval_s is None or
+                                (step + 1) % ckpt_every_steps == 0):
             with timeline.Event('train/checkpoint', f'step {step + 1}'):
                 mgr.save(step + 1, state)
         if tracing and step + 1 >= args.steps:
@@ -400,7 +637,32 @@ def main() -> None:
             tracing = False
             print(f'profile: traced through final step {step + 1} '
                   f'to {args.profile}', flush=True)
-        if (step + 1) % args.log_every == 0 and proc_id == 0:
+        boundary = (step + 1) % args.log_every == 0
+        if boundary and not cadence_fixed:
+            # Every process fixes the cadence (checkpoint saves are
+            # collective); proc 0's value is broadcast so clock skew
+            # cannot desynchronize the save schedule.
+            if sup is not None:
+                sup.beat('commit')
+            jax.block_until_ready(loss)
+            mean_step = ((time.perf_counter() - t0) /
+                         max(window_steps, 1))
+            cadence = max(1, round(ckpt_interval_s /
+                                   max(mean_step, 1e-9)))
+            if jax.process_count() > 1:
+                from jax.experimental import multihost_utils
+                cadence = int(multihost_utils.broadcast_one_to_all(
+                    np.int32(cadence)))
+            ckpt_every_steps = cadence
+            cadence_fixed = True
+            if proc_id == 0:
+                print(f'ckpt cadence: interval '
+                      f'{ckpt_interval_s:.0f}s / measured step '
+                      f'{mean_step:.3f}s -> checkpoint every '
+                      f'{ckpt_every_steps} steps', flush=True)
+        if boundary and proc_id == 0:
+            if sup is not None:
+                sup.beat('commit')
             jax.block_until_ready(loss)
             dt = time.perf_counter() - t0
             print(f'step {step + 1}/{args.steps} '
@@ -417,6 +679,15 @@ def main() -> None:
             t0 = time.perf_counter()
             window_tokens = 0
             window_steps = 0
+        step += 1
+    if sup is not None:
+        if pending is not None:
+            p_step, p_loss, p_gnorm, p_bad = pending
+            sup.observe(p_step, float(p_loss), float(p_gnorm),
+                        bool(p_bad))
+        sup.stop()  # before the final save: it can be slow
+        if proc_id == 0:
+            print(f'train-guard summary: {sup.summary()}', flush=True)
     if mgr is not None:
         with timeline.Event('train/checkpoint', 'final'):
             mgr.save(args.steps, state, force=True)

@@ -115,6 +115,23 @@ KNOWN_POINTS: Dict[str, str] = {
         'and the orbax read (raise to model unreadable checkpoint '
         'storage; manifest-verification fallback is separate and '
         'driven by on-disk corruption)',
+    'train.preempt_notice':
+        'trainer preemption-notice poll loop (train_guard.py) — a '
+        'DROP is a synthetic preemption notice: the trainer '
+        'checkpoints NOW and exits with the typed code 83 the '
+        'managed-jobs controller maps to recovery; fire-site '
+        'context carries resume=<0|1> so a scoped rule can preempt '
+        'only the first launch',
+    'train.step':
+        'train loop, before each optimizer-step dispatch — a DROP '
+        'poisons that step\'s loss with NaN (through the REAL '
+        'on-device isfinite guard: update skipped, rollback after K '
+        'consecutive); context: step=<n>, resume=<0|1>',
+    'train.data_next':
+        'train loop, start of each batch fetch — a delay models a '
+        'stalled data loader: the step watchdog dumps all thread '
+        'stacks and aborts with the typed code 84 past its '
+        'deadline; context: resume=<0|1>',
 }
 
 #: Sentinel returned by `point()` when a drop rule fires; sites that

@@ -219,6 +219,121 @@ def test_managed_job_chaos_preemption_checkpoint_resume(
     jobs_core.cancel([job_id])
 
 
+def _run_train_guard_managed_job(isolated_state, monkeypatch, *,
+                                 fault_rules, steps, log_marker,
+                                 extra_flags=''):
+    """Launch a guarded train_lm as a managed job under a fault plan,
+    wait for SUCCEEDED, and return (job record, metric steps,
+    controller log). Shared by the preemption-notice and watchdog
+    chaos runs: both must end in SUCCESS via the typed-exit recovery
+    path, with the step log proving <=1 optimizer step lost."""
+    import glob
+
+    from skypilot_tpu import check, constants
+    from skypilot_tpu.jobs import core as jobs_core
+    from skypilot_tpu.jobs import state
+    from skypilot_tpu.observability.step_metrics import read_jsonl
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    monkeypatch.setenv('SKYPILOT_JOBS_POLL_SECONDS', '1')
+    monkeypatch.setenv('STPU_FAULT_PLAN',
+                       json.dumps({'rules': fault_rules}))
+    check.check(quiet=True)
+
+    ckpt = os.path.join(isolated_state, 'guard-ckpt')
+    metrics = os.path.join(isolated_state, 'guard-steps.jsonl')
+    # XLA_FLAGS= : the pytest process forces 8 host devices for mesh
+    # tests; the in-job trainer must see the real (1-device) CPU.
+    run = (f'cd {repo} && env PYTHONPATH={repo} JAX_PLATFORMS=cpu '
+           f'XLA_FLAGS= '
+           f'python -m skypilot_tpu.recipes.train_lm --cpu '
+           f'--model tiny --steps {steps} --seq 16 --global-batch 2 '
+           f'--log-every 1 --guard --preempt-poll 0.5 '
+           f'--ckpt-dir {ckpt} --metrics-file {metrics} '
+           f'{extra_flags}')
+    result = jobs_core.launch(
+        {'name': 'guard-mj', 'resources': {'infra': 'local'},
+         'run': run}, user='t')
+    job_id = result['job_id']
+
+    deadline = time.time() + 420
+    final = None
+    while time.time() < deadline:
+        job = state.get_job(job_id)
+        if job['status'].is_terminal():
+            final = job['status']
+            break
+        time.sleep(1)
+    job = state.get_job(job_id)
+    assert final == state.ManagedJobStatus.SUCCEEDED, job
+    # The typed exit really drove the recovery...
+    assert job['recovery_count'] >= 1, job
+    log_path = job.get('log_path') or os.path.join(
+        constants.sky_home(), f'managed-{job_id}.log')
+    candidates = [log_path] if os.path.exists(log_path) else \
+        glob.glob(os.path.join(constants.sky_home(), 'managed-*.log'))
+    ctrl_log = ''
+    for path in candidates:
+        with open(path, 'r', encoding='utf-8') as f:
+            ctrl_log += f.read()
+    assert log_marker in ctrl_log, ctrl_log[-2000:]
+    # ...and the step log proves <=1 optimizer step lost: every step
+    # ran exactly once, in order, through the final step (a
+    # from-scratch restart would rewind; an untyped FAILED would
+    # never finish with max_restarts_on_errors=0).
+    steps_logged = [r['step'] for r in read_jsonl(metrics)]
+    assert steps_logged[-1] == steps, steps_logged
+    assert steps_logged == sorted(steps_logged), steps_logged
+    assert len(steps_logged) == len(set(steps_logged)), steps_logged
+    jobs_core.cancel([job_id])
+    return job, steps_logged, ctrl_log
+
+
+@pytest.mark.slow
+def test_managed_job_preempt_notice_graceful_recovery(
+        isolated_state, monkeypatch):
+    """End-to-end tentpole chaos: a fault plan injects a preemption
+    notice (scoped to the FIRST launch) mid-run. The trainer
+    checkpoints inside the notice window and exits rc 83; the driver
+    maps it to agent status PREEMPTED; the controller answers with
+    PREEMPTING -> RECOVERING (never FAILED) and relaunches; the
+    resumed run (scope resume=1 exempts it) finishes every step with
+    none lost or repeated — all replayable from the plan alone."""
+    # Pace steps (~0.4s each) so the notice lands mid-run, after the
+    # compile window; the notice rule ignores the resumed process.
+    rules = [
+        {'point': 'train.data_next', 'action': 'delay',
+         'delay_s': 0.4},
+        {'point': 'train.preempt_notice', 'action': 'drop',
+         'scope': {'resume': '0'}, 'after': 30}]
+    _run_train_guard_managed_job(
+        isolated_state, monkeypatch, fault_rules=rules, steps=30,
+        log_marker='trainer exited PREEMPTED (typed recoverable '
+                   'exit)')
+
+
+@pytest.mark.slow
+def test_managed_job_watchdog_abort_recovery(isolated_state,
+                                             monkeypatch):
+    """End-to-end watchdog chaos: a 300s stall injected into the
+    first launch's data loader trips the 3s step watchdog (stack
+    dump + rc 84); the controller maps WATCHDOG_ABORT to recovery
+    and the relaunched run (resume-scoped out of the stall) resumes
+    from the per-step checkpoint and completes."""
+    rules = [
+        {'point': 'train.data_next', 'action': 'delay',
+         'delay_s': 300, 'scope': {'resume': '0'}, 'after': 3,
+         'times': 1}]
+    # --ckpt-every 1: a checkpoint exists before the stall, so the
+    # relaunch resumes (resume=1) clear of the scoped stall rule.
+    _run_train_guard_managed_job(
+        isolated_state, monkeypatch, fault_rules=rules, steps=8,
+        log_marker='trainer exited WATCHDOG_ABORT (typed '
+                   'recoverable exit)',
+        extra_flags='--ckpt-every 1 --watchdog-deadline 3 '
+                    '--watchdog-compile-deadline 120')
+
+
 @pytest.mark.slow
 def test_api_version_negotiation(chaos_server, monkeypatch):
     """Version skew contract (reference: sky/server/versions.py):

@@ -1,0 +1,578 @@
+"""Self-supervising trainer guards (robustness/train_guard.py).
+
+Tier-1 coverage of the three guard paths and the typed-exit
+contract:
+
+  - preemption-notice watcher: fake metadata server, SIGTERM, and
+    fault-injected notices (incl. resume-scoped rules);
+  - on-device NaN/spike guard: a tiny guarded ShardedTrainer really
+    skips the poisoned update (params/opt_state unchanged) while the
+    host-side SpikeGuard escalates to rollback after K;
+  - step watchdog: stack dump + typed abort code, beats keep it
+    quiet;
+  - exit-code mapping: rc 83/84 -> PREEMPTED/WATCHDOG_ABORT agent
+    statuses -> the controller's PREEMPTING -> RECOVERING path
+    WITHOUT consuming the user-failure restart budget;
+  - train_lm CLI: injected-NaN skip + rollback-after-K end-to-end in
+    a subprocess, exiting rc 0.
+
+The full managed-job chaos runs (notice mid-run -> graceful
+checkpoint -> controller recovery with <=1 step lost; watchdog rc 84
+through a real process) live in tests/test_chaos.py (slow tier).
+"""
+import http.server
+import io
+import json
+import math
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from skypilot_tpu.robustness import faults
+from skypilot_tpu.robustness import train_guard
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# SpikeGuard (host-side policy)
+# ---------------------------------------------------------------------------
+def test_spike_guard_warmup_then_ema_threshold():
+    g = train_guard.SpikeGuard(spike_factor=4.0, warmup_steps=3,
+                               rollback_after=2, ema_beta=0.5)
+    assert g.threshold() == math.inf
+    for step, gnorm in enumerate((1.0, 1.0, 1.0)):
+        assert g.observe(step, 2.0, gnorm, False) == 'ok'
+    # EMA of all-1.0 norms is 1.0 -> threshold = factor * 1.0.
+    assert g.threshold() == pytest.approx(4.0)
+    # A good step with a larger norm moves the EMA up.
+    g.observe(3, 2.0, 3.0, False)
+    assert g.threshold() == pytest.approx(4.0 * 2.0)
+
+
+def test_spike_guard_rollback_after_k_and_reset():
+    g = train_guard.SpikeGuard(spike_factor=4.0, warmup_steps=1,
+                               rollback_after=3)
+    assert g.observe(0, 2.0, 1.0, False) == 'ok'
+    assert g.observe(1, math.nan, math.nan, True) == 'skipped'
+    assert g.observe(2, math.nan, math.nan, True) == 'skipped'
+    assert g.observe(3, math.nan, math.nan, True) == 'rollback'
+    assert g.skipped_total == 3
+    # A good step in between resets the consecutive counter.
+    g2 = train_guard.SpikeGuard(rollback_after=2)
+    assert g2.observe(0, math.nan, math.nan, True) == 'skipped'
+    assert g2.observe(1, 2.0, 1.0, False) == 'ok'
+    assert g2.observe(2, math.nan, math.nan, True) == 'skipped'
+    assert g2.consecutive_bad == 1
+    # Rollback re-warms the EMA (restored params may grad on a
+    # different scale than the one the threshold latched onto).
+    g.reset_after_rollback()
+    assert g.rollbacks == 1
+    assert g.consecutive_bad == 0
+    assert g.threshold() == math.inf
+
+
+def test_spike_guard_skip_counter_metric():
+    from skypilot_tpu.observability import catalog
+    child = catalog.counter('skypilot_train_guard_skipped_steps_total')
+    before = child.value
+    g = train_guard.SpikeGuard(rollback_after=5)
+    g.observe(0, math.nan, math.nan, True)
+    assert child.value == before + 1
+
+
+# ---------------------------------------------------------------------------
+# StepWatchdog
+# ---------------------------------------------------------------------------
+def test_watchdog_abort_dumps_stacks_and_exits_typed(tmp_path):
+    from skypilot_tpu.observability import catalog
+    counter = catalog.counter('skypilot_train_watchdog_aborts_total')
+    before = counter.value
+    codes = []
+    # faulthandler writes through a REAL fd, not a StringIO.
+    with open(tmp_path / 'wd.log', 'w+', encoding='utf-8') as stream:
+        wd = train_guard.StepWatchdog(deadline_s=0.15,
+                                      poll_interval_s=0.02,
+                                      exit_fn=codes.append,
+                                      stream=stream)
+        wd.beat('data')
+        wd.start()
+        deadline = time.time() + 5
+        while not codes and time.time() < deadline:
+            time.sleep(0.02)
+        wd.stop()
+        stream.seek(0)
+        out = stream.read()
+    assert codes == [train_guard.EXIT_WATCHDOG_ABORT]
+    assert wd.fired
+    assert "phase 'data' stalled" in out
+    assert 'File "' in out  # faulthandler stack frames
+    assert counter.value == before + 1
+
+
+def test_watchdog_beats_prevent_abort_and_override_deadline():
+    codes = []
+    wd = train_guard.StepWatchdog(deadline_s=0.1,
+                                  poll_interval_s=0.02,
+                                  exit_fn=codes.append,
+                                  stream=io.StringIO())
+    wd.start()
+    for _ in range(10):
+        wd.beat('step')
+        time.sleep(0.03)
+    assert not codes
+    # A per-beat override (the compile-grace path) holds past the
+    # base deadline.
+    wd.beat('step', deadline_s=5.0)
+    time.sleep(0.3)
+    assert not codes
+    wd.stop()
+
+
+# ---------------------------------------------------------------------------
+# PreemptionNotice
+# ---------------------------------------------------------------------------
+def test_preempt_notice_injected_and_resume_scoped():
+    from skypilot_tpu.observability import catalog
+    counter = catalog.counter('skypilot_train_preempt_notices_total')
+    before = counter.value
+    faults.install_plan({'rules': [
+        {'point': 'train.preempt_notice', 'action': 'drop',
+         'scope': {'resume': '0'}, 'after': 1}]})
+    # A resumed run (resume=1) is scoped OUT: no notice, no hits.
+    resumed = train_guard.PreemptionNotice(
+        poll_interval_s=0.01, metadata_url='http://127.0.0.1:9/x',
+        install_sigterm=False, ctx={'resume': '1'})
+    resumed.start()
+    time.sleep(0.15)
+    resumed.stop()
+    assert not resumed.notice.is_set()
+    assert faults.stats()['train.preempt_notice']['hits'] == 0
+    # The first launch (resume=0) gets the notice on poll 2.
+    fresh = train_guard.PreemptionNotice(
+        poll_interval_s=0.01, metadata_url='http://127.0.0.1:9/x',
+        install_sigterm=False, ctx={'resume': '0'})
+    fresh.start()
+    assert fresh.notice.wait(timeout=5)
+    fresh.stop()
+    assert fresh.reason == 'injected'
+    assert counter.value == before + 1
+
+
+def test_preempt_notice_sigterm():
+    notice = train_guard.PreemptionNotice(poll_interval_s=30.0,
+                                          install_sigterm=True)
+    notice.start()
+    try:
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert notice.notice.wait(timeout=5)
+        assert notice.reason == 'sigterm'
+    finally:
+        notice.stop()  # restores the previous SIGTERM handler
+    assert signal.getsignal(signal.SIGTERM) is not \
+        notice._handle_sigterm
+
+
+def test_preempt_notice_fake_metadata_server():
+    """The GCE poll path: FALSE answers keep training; the first
+    TRUE latches the notice with reason 'metadata'."""
+    answers = ['FALSE', 'FALSE', 'TRUE']
+
+    class _Meta(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802
+            assert self.headers.get('Metadata-Flavor') == 'Google'
+            body = (answers.pop(0) if answers else 'TRUE').encode()
+            self.send_response(200)
+            self.send_header('Content-Length', str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):  # quiet
+            pass
+
+    server = http.server.ThreadingHTTPServer(('127.0.0.1', 0), _Meta)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    url = f'http://127.0.0.1:{server.server_address[1]}/preempted'
+    notice = train_guard.PreemptionNotice(poll_interval_s=0.02,
+                                          metadata_url=url,
+                                          install_sigterm=False)
+    notice.start()
+    try:
+        assert notice.notice.wait(timeout=10)
+        assert notice.reason == 'metadata'
+        assert notice.polls >= 3
+    finally:
+        notice.stop()
+        server.shutdown()
+
+
+def test_preempt_notice_metadata_unreachable_disables_polling():
+    """Off-GCE (nothing listens): the poller gives up on the
+    endpoint after a few strikes instead of spamming forever, but
+    keeps polling the fault point."""
+    notice = train_guard.PreemptionNotice(
+        poll_interval_s=0.01,
+        metadata_url='http://127.0.0.1:9/preempted',  # discard port
+        install_sigterm=False)
+    notice.start()
+    time.sleep(0.3)
+    notice.stop()
+    assert not notice.notice.is_set()
+    assert notice._metadata_failures >= train_guard._METADATA_MAX_FAILURES \
+        or notice._metadata_failures == train_guard._METADATA_MAX_FAILURES
+    assert notice.polls > train_guard._METADATA_MAX_FAILURES
+
+
+# ---------------------------------------------------------------------------
+# Guarded device step (parallel/train.py)
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope='module')
+def guarded_trainer():
+    import jax
+    import jax.numpy as jnp
+    from skypilot_tpu.models.gpt import GPT, GPTConfig
+    from skypilot_tpu.parallel import mesh as mesh_lib
+    from skypilot_tpu.parallel.train import ShardedTrainer
+
+    cfg = GPTConfig.tiny()
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshConfig.auto())
+    trainer = ShardedTrainer(GPT(cfg), mesh, guard=True)
+    example = jnp.zeros((8, 16), jnp.int32)
+    state = trainer.init(jax.random.PRNGKey(0), example)
+    step_fn = trainer.make_train_step(example, donate=False)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0,
+                                cfg.vocab_size)
+    return trainer, state, step_fn, tokens
+
+
+def _leaves_equal(a, b):
+    import jax
+    import numpy as np
+    return all(np.array_equal(x, y) for x, y in
+               zip(jax.tree.leaves(a), jax.tree.leaves(b)))
+
+
+def test_guard_forces_grad_norm_collection(guarded_trainer):
+    trainer = guarded_trainer[0]
+    assert trainer.guard and trainer.collect_grad_norm
+
+
+def test_guarded_step_good_step_applies_update(guarded_trainer):
+    import numpy as np
+    _, state, step_fn, tokens = guarded_trainer
+    new_state, (loss, gnorm, bad) = step_fn(state, tokens)
+    assert not bool(bad)
+    assert np.isfinite(float(loss)) and float(gnorm) > 0
+    assert int(new_state.step) == int(state.step) + 1
+    assert not _leaves_equal(new_state.params, state.params)
+
+
+def test_guarded_step_skips_nan_loss(guarded_trainer):
+    """loss_scale=NaN poisons loss AND grads through the real
+    value_and_grad — the on-device isfinite guard must select the
+    old params/opt_state while still consuming the step."""
+    _, state, step_fn, tokens = guarded_trainer
+    new_state, (loss, gnorm, bad) = step_fn(state, tokens,
+                                            loss_scale=float('nan'))
+    assert bool(bad)
+    assert math.isnan(float(loss)) and math.isnan(float(gnorm))
+    assert int(new_state.step) == int(state.step) + 1
+    assert _leaves_equal(new_state.params, state.params)
+    assert _leaves_equal(new_state.opt_state, state.opt_state)
+
+
+def test_guarded_step_skips_grad_norm_spike(guarded_trainer):
+    """A finite step whose global norm exceeds the host threshold is
+    a spike: skipped exactly like a NaN."""
+    _, state, step_fn, tokens = guarded_trainer
+    new_state, (loss, gnorm, bad) = step_fn(state, tokens,
+                                            max_grad_norm=1e-9)
+    assert bool(bad)
+    assert math.isfinite(float(loss)) and float(gnorm) > 1e-9
+    assert _leaves_equal(new_state.params, state.params)
+
+
+def test_unguarded_trainer_signature_unchanged():
+    """No guard: the step fn keeps its (state, tokens) -> (state,
+    loss) contract — existing callers (multi-step, pipeline tests)
+    see no difference."""
+    import jax
+    import jax.numpy as jnp
+    from skypilot_tpu.models.gpt import GPT, GPTConfig
+    from skypilot_tpu.parallel import mesh as mesh_lib
+    from skypilot_tpu.parallel.train import ShardedTrainer
+    trainer = ShardedTrainer(GPT(GPTConfig.tiny()),
+                             mesh_lib.make_mesh(
+                                 mesh_lib.MeshConfig.auto()))
+    assert not trainer.guard and not trainer.collect_grad_norm
+    example = jnp.zeros((8, 16), jnp.int32)
+    state = trainer.init(jax.random.PRNGKey(0), example)
+    step_fn = trainer.make_train_step(example, donate=False)
+    _, aux = step_fn(state, example)
+    assert aux.shape == ()  # bare loss, no tuple
+
+
+def test_committed_example_train_guard_plan_installs():
+    """The shipped chaos plan names only known points and installs
+    cleanly (an unknown point would fail at install, not by silently
+    never firing)."""
+    path = os.path.join(REPO, 'examples', 'fault_plans',
+                        'train_guard_chaos.json')
+    plan = faults.install_plan(path)
+    assert plan is not None
+    stats = faults.stats()
+    assert {'train.step', 'train.data_next',
+            'train.preempt_notice'} <= set(stats)
+
+
+# ---------------------------------------------------------------------------
+# Typed exit codes: agent status + controller recovery mapping
+# ---------------------------------------------------------------------------
+def test_exit_code_status_mapping():
+    from skypilot_tpu.agent import job_lib
+    assert job_lib.status_for_exit_code(
+        train_guard.EXIT_PREEMPTED_GRACEFUL) == \
+        job_lib.JobStatus.PREEMPTED
+    assert job_lib.status_for_exit_code(
+        train_guard.EXIT_WATCHDOG_ABORT) == \
+        job_lib.JobStatus.WATCHDOG_ABORT
+    assert job_lib.status_for_exit_code(1) is None
+    assert job_lib.status_for_exit_code(0) is None
+    for st in (job_lib.JobStatus.PREEMPTED,
+               job_lib.JobStatus.WATCHDOG_ABORT):
+        assert st.is_terminal()
+        assert st.is_recoverable()
+        assert st in job_lib.JobStatus.terminal_statuses()
+    assert not job_lib.JobStatus.FAILED.is_recoverable()
+
+
+def test_managed_status_preempting_not_terminal():
+    from skypilot_tpu.jobs import state
+    assert not state.ManagedJobStatus.PREEMPTING.is_terminal()
+    assert not state.ManagedJobStatus.PREEMPTING.is_failed()
+
+
+@pytest.mark.parametrize('typed_status,metric,expect_preemption', [
+    ('PREEMPTED', 'skypilot_train_preempt_notices_total', True),
+    ('WATCHDOG_ABORT', 'skypilot_train_watchdog_aborts_total', False),
+])
+def test_controller_typed_exit_takes_recovery_path(
+        monkeypatch, typed_status, metric, expect_preemption):
+    """A typed trainer exit must drive PREEMPTING -> _recover()
+    (counted in its own catalog row) WITHOUT consuming the
+    user-failure restart budget: stage_max_restarts=0 here, so the
+    old FAILED mapping would have ended the job instead."""
+    from skypilot_tpu.agent import job_lib as agent_job_lib
+    from skypilot_tpu.jobs import controller as ctrl_mod
+    from skypilot_tpu.jobs import failure_sources
+    from skypilot_tpu.jobs import state
+    from skypilot_tpu.observability import catalog
+
+    monkeypatch.setattr(ctrl_mod, '_POLL_SECONDS', 0.005)
+    monkeypatch.setattr(failure_sources, 'check_failed',
+                        lambda name: None)
+    status_log = []
+    monkeypatch.setattr(state, 'set_status',
+                        lambda jid, st, **kw: status_log.append(st))
+    monkeypatch.setattr(state, 'set_stage', lambda jid, s: None)
+    monkeypatch.setattr(state, 'set_agent_job_id', lambda jid, a: None)
+
+    ctrl = ctrl_mod.JobController.__new__(ctrl_mod.JobController)
+    ctrl.job_id = 1
+    ctrl.cluster_name = 'typed-exit-c'
+    ctrl.group = None
+    ctrl.pooled = False
+    ctrl.stage = 0
+    ctrl.stage_configs = [{}]
+    ctrl.stage_max_restarts = 0
+    ctrl._stage_restarts = 0
+    ctrl._cancelled = False
+
+    recovered = []
+
+    class _Agent:
+        def get_job(self, agent_job_id):
+            st = (agent_job_lib.JobStatus.SUCCEEDED if recovered
+                  else agent_job_lib.JobStatus[typed_status])
+            return {'status': st}
+
+    ctrl._agent = lambda: _Agent()
+    ctrl._cleanup = lambda cancel_job: None
+
+    def _recover(preemption=True):
+        recovered.append(preemption)
+        return 2
+
+    ctrl._recover = _recover
+    child = catalog.counter(metric)
+    before = child.value
+    final = ctrl._monitor_loop(agent_job_id=1)
+    assert final == state.ManagedJobStatus.SUCCEEDED
+    assert recovered == [expect_preemption]
+    assert state.ManagedJobStatus.PREEMPTING in status_log
+    assert child.value == before + 1
+    # The typed exit never touched the user-failure restart budget.
+    assert ctrl._stage_restarts == 0
+
+
+def test_recover_skips_zone_preemption_counter_for_watchdog(
+        monkeypatch):
+    """_recover(preemption=False) still records the recovery event
+    (latency accounting) but must not inflate the zone spot-storm
+    signal."""
+    from skypilot_tpu.jobs import controller as ctrl_mod
+    from skypilot_tpu.jobs import state
+    from skypilot_tpu.observability import catalog
+
+    events = []
+    monkeypatch.setattr(state, 'set_status',
+                        lambda jid, st, **kw: None)
+    monkeypatch.setattr(state, 'bump_recovery', lambda jid: None)
+    monkeypatch.setattr(state, 'record_preemption',
+                        lambda jid, z: events.append(('pre', z)))
+    monkeypatch.setattr(state, 'record_recovered',
+                        lambda jid: events.append(('rec', None)))
+    monkeypatch.setattr(state, 'set_agent_job_id',
+                        lambda jid, a: None)
+
+    ctrl = ctrl_mod.JobController.__new__(ctrl_mod.JobController)
+    ctrl.job_id = 7
+    ctrl.cluster_name = 'wd-c'
+    ctrl.group = None
+    ctrl._zone = lambda: 'test-zone-wd'
+
+    class _Exec:
+        def recover(self):
+            return 3
+
+    ctrl.executor = _Exec()
+    zone_child = catalog.counter(
+        'skypilot_jobs_preemptions_total').labels(zone='test-zone-wd')
+    before = zone_child.value
+    assert ctrl._recover(preemption=False) == 3
+    assert zone_child.value == before
+    assert ('pre', 'test-zone-wd') in events and ('rec', None) in events
+    assert ctrl._recover(preemption=True) == 3
+    assert zone_child.value == before + 1
+
+
+# ---------------------------------------------------------------------------
+# train_lm CLI: injected NaN -> skip -> rollback-after-K, rc 0
+# ---------------------------------------------------------------------------
+def test_train_lm_nan_skip_and_rollback_e2e(tmp_path):
+    """Chaos acceptance: a fault plan poisons steps 4-6 with NaN; the
+    guard skips each (loss=nan printed, params protected), the third
+    consecutive skip rolls back to the last checkpoint — step 4,
+    BEFORE the streak, so the step sequence really rewinds — and the
+    run still completes rc=0 with every step covered."""
+    from skypilot_tpu.observability.step_metrics import read_jsonl
+    ckpt = tmp_path / 'ckpt'
+    metrics = tmp_path / 'steps.jsonl'
+    env = {k: v for k, v in os.environ.items() if k != 'XLA_FLAGS'}
+    env['STPU_FAULT_PLAN'] = json.dumps({'rules': [
+        {'point': 'train.step', 'action': 'drop', 'at': [5, 6, 7]}]})
+    proc = subprocess.run(
+        [sys.executable, '-m', 'skypilot_tpu.recipes.train_lm',
+         '--cpu', '--model', 'tiny', '--steps', '10', '--seq', '16',
+         '--global-batch', '4', '--log-every', '1', '--guard',
+         '--guard-warmup', '1', '--rollback-after', '3',
+         '--ckpt-dir', str(ckpt), '--ckpt-every', '4',
+         '--metrics-file', str(metrics)],
+        cwd=REPO, env=env, capture_output=True, text=True,
+        timeout=420)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out
+    assert out.count('injected NaN into step') == 3
+    assert 'update skipped' in out
+    assert 'rolling back' in out
+    assert 'rolled back to last checkpoint (step 4)' in out
+    assert "'skipped_steps': 3, 'rollbacks': 1" in out
+    records = read_jsonl(str(metrics))
+    steps = [r['step'] for r in records]
+    # The rollback rewinds the step sequence once (back to the
+    # checkpoint at step 4), then the rerun covers everything
+    # through the final step.
+    assert steps[-1] == 10
+    assert any(b <= a for a, b in zip(steps, steps[1:])), steps
+    assert set(steps) >= set(range(1, 11)), steps
+    # Post-rollback steps are clean: the last record's loss is finite.
+    assert math.isfinite(records[-1]['loss'])
+
+
+def test_train_lm_watchdog_stall_aborts_rc84(tmp_path):
+    """Chaos acceptance: a delayed train.data_next (stalled loader)
+    trips the step watchdog within its deadline — thread stacks are
+    dumped and the process exits with the typed code 84."""
+    env = {k: v for k, v in os.environ.items() if k != 'XLA_FLAGS'}
+    env['STPU_FAULT_PLAN'] = json.dumps({'rules': [
+        {'point': 'train.data_next', 'action': 'delay',
+         'delay_s': 300, 'after': 2, 'times': 1}]})
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, '-m', 'skypilot_tpu.recipes.train_lm',
+         '--cpu', '--model', 'tiny', '--steps', '6', '--seq', '16',
+         '--global-batch', '4', '--guard',
+         '--watchdog-deadline', '3',
+         '--watchdog-compile-deadline', '120'],
+        cwd=REPO, env=env, capture_output=True, text=True,
+        timeout=420)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == train_guard.EXIT_WATCHDOG_ABORT, out
+    # Aborted within the deadline (+ compile + slack), not the 300s
+    # injected stall.
+    assert time.time() - t0 < 200
+    assert "step-watchdog: phase 'data' stalled" in out
+    assert 'next_tokens' in out  # the stalled frame is in the dump
+
+
+def test_train_lm_preempt_notice_rc83_then_resume(tmp_path):
+    """Chaos acceptance: an injected preemption notice (scoped to
+    resume=0) makes the trainer checkpoint NOW and exit rc 83; the
+    SAME command relaunched resumes from that checkpoint, survives
+    (the scoped rule ignores resume=1), and finishes every step."""
+    from skypilot_tpu.observability.step_metrics import read_jsonl
+    ckpt = tmp_path / 'ckpt'
+    metrics = tmp_path / 'steps.jsonl'
+    env = {k: v for k, v in os.environ.items() if k != 'XLA_FLAGS'}
+    env['STPU_FAULT_PLAN'] = json.dumps({'rules': [
+        {'point': 'train.preempt_notice', 'action': 'drop',
+         'scope': {'resume': '0'}, 'after': 1}]})
+    cmd = [sys.executable, '-m', 'skypilot_tpu.recipes.train_lm',
+           '--cpu', '--model', 'tiny', '--steps', '6', '--seq', '16',
+           '--global-batch', '4', '--log-every', '1', '--guard',
+           '--preempt-poll', '0.3', '--ckpt-dir', str(ckpt),
+           '--ckpt-every', '100', '--metrics-file', str(metrics)]
+    first = subprocess.run(cmd, cwd=REPO, env=env,
+                           capture_output=True, text=True,
+                           timeout=420)
+    out = first.stdout + first.stderr
+    assert first.returncode == train_guard.EXIT_PREEMPTED_GRACEFUL, out
+    assert 'preemption notice (injected)' in out
+    saved = [int(d) for d in os.listdir(ckpt) if d.isdigit()]
+    assert saved, 'graceful exit must leave a checkpoint behind'
+    second = subprocess.run(cmd, cwd=REPO, env=env,
+                            capture_output=True, text=True,
+                            timeout=420)
+    out2 = second.stdout + second.stderr
+    assert second.returncode == 0, out2
+    assert f'resumed from checkpoint step {max(saved)}' in out2
+    assert 'training done' in out2
+    # <=1 optimizer step lost: the resumed run's first logged step
+    # continues at (or past) the last step logged before the exit.
+    steps = [r['step'] for r in read_jsonl(str(metrics))]
+    assert steps[-1] == 6
+    assert steps == sorted(steps), steps  # no rewound work
+    assert len(steps) == len(set(steps)), steps  # no step run twice
