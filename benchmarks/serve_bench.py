@@ -43,12 +43,16 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _build_server_cmd(args) -> list:
+def _build_server_cmd(args, adapter_dir=None) -> list:
     """serve_lm command line WITHOUT --port (single-server mode
     appends one; fleet mode lets the replica manager assign them)."""
     cmd = [sys.executable, '-m', 'skypilot_tpu.recipes.serve_lm',
            '--model', args.model,
            '--max-total-len', str(args.max_total_len)]
+    if adapter_dir:
+        cmd += ['--adapter-dir', adapter_dir,
+                '--max-adapters', str(max(args.max_adapters,
+                                          args.adapters))]
     if args.engine == 'continuous':
         cmd += ['--continuous-batching', '--num-slots',
                 str(args.num_slots)]
@@ -79,6 +83,50 @@ def _build_server_cmd(args) -> list:
     if args.cpu:
         cmd += ['--cpu']
     return cmd
+
+
+def _make_adapter_artifacts(args, out_dir: str) -> list:
+    """Generate --adapters N random adapter artifacts for the bench
+    model (deterministic: adapter i is seeded with i). Imports the
+    model registry in-process only for the config geometry."""
+    from skypilot_tpu.models import lora as lora_lib
+    from skypilot_tpu.recipes.train_lm import _build_model
+    model, _, _ = _build_model(args.model, args.max_total_len,
+                               remat=False)
+    spec = lora_lib.LoraSpec(rank=args.adapter_rank,
+                             alpha=2.0 * args.adapter_rank)
+    names = []
+    for i in range(args.adapters):
+        name = f'ad{i:03d}'
+        params = lora_lib.random_adapter_params(i, model.config, spec)
+        lora_lib.save_adapter(os.path.join(out_dir, name), params,
+                              spec, base_model=args.model)
+        names.append(name)
+    return names
+
+
+def _adapter_assignment(args, names: list) -> list:
+    """Deterministic per-request adapter assignment. `uniform` draws
+    each adapter equally; `zipf` draws adapter k with weight
+    1/(k+1) — the few-hot-tenants regime that exercises the LRU
+    (cold adapters keep evicting and reloading)."""
+    rng = random.Random(1)
+    if args.adapter_mix == 'uniform':
+        return [names[rng.randrange(len(names))]
+                for _ in range(args.requests)]
+    weights = [1.0 / (k + 1) for k in range(len(names))]
+    total = sum(weights)
+    cum = []
+    acc = 0.0
+    for w in weights:
+        acc += w / total
+        cum.append(acc)
+    out = []
+    for _ in range(args.requests):
+        r = rng.random()
+        idx = next(i for i, c in enumerate(cum) if r <= c)
+        out.append(names[idx])
+    return out
 
 
 def _fleet_prompts(args, vocab: int, rng) -> list:
@@ -291,6 +339,236 @@ def run_fleet(args) -> dict:
     }
 
 
+def _run_single(args, adapter_dir=None, assignment=None) -> dict:
+    """One single-server run (the non-fleet mode), returning the JSON
+    record. `adapter_dir` arms serve_lm's adapter registry;
+    `assignment` (list of adapter names per request index, None
+    entries = base) drives the multi-LoRA workload."""
+    port = _free_port()
+    cmd = _build_server_cmd(args, adapter_dir) + ['--port', str(port)]
+    env = dict(os.environ)
+    env['PYTHONPATH'] = f"{REPO}:{env.get('PYTHONPATH', '')}"
+    server = subprocess.Popen(cmd, env=env, stdout=subprocess.DEVNULL,
+                              stderr=subprocess.STDOUT)
+    url = f'http://127.0.0.1:{port}'
+    try:
+        deadline = time.time() + 300
+        info = None
+        while time.time() < deadline:
+            try:
+                info = requests.get(url, timeout=2).json()
+                break
+            except requests.RequestException:
+                time.sleep(1)
+                if server.poll() is not None:
+                    raise RuntimeError('serve_lm died')
+        if info is None:
+            raise RuntimeError('serve_lm not ready within 300s')
+        vocab = int(info['vocab_size'])
+
+        rng = random.Random(0)
+        if args.repetitive:
+            # Structured prompts (repeated trigrams): the shape
+            # prompt-lookup speculation exploits — code, templated
+            # text, retrieval contexts.
+            def rep_prompt():
+                gram = [rng.randrange(1, vocab) for _ in range(3)]
+                n = rng.randrange(4, 16)
+                return (gram * ((n + 2) // 3))[:n]
+            prompts = [rep_prompt() for _ in range(args.requests)]
+        else:
+            prompts = [[rng.randrange(1, vocab)
+                        for _ in range(rng.randrange(4, 16))]
+                       for _ in range(args.requests)]
+        if args.long_prompt_frac > 0:
+            # Long prompts leave room to generate the full
+            # max_new_tokens below max_total_len (submit requires
+            # prompt_len < max_total_len).
+            long_len = max(16, args.max_total_len -
+                           args.max_new_tokens - 2)
+            n_long = int(round(args.long_prompt_frac * len(prompts)))
+            # Deterministic spread through the workload (not a
+            # front-loaded burst).
+            for i in range(n_long):
+                idx = (i * len(prompts)) // max(n_long, 1)
+                prompts[idx] = [rng.randrange(1, vocab)
+                                for _ in range(long_len)]
+        if args.shared_prefix:
+            system = [rng.randrange(1, vocab)
+                      for _ in range(args.shared_prefix)]
+            prompts = [system + p for p in prompts]
+        # Warm the compile caches (prefill buckets + decode). With
+        # prefix caching the SECOND pass over a prompt takes the
+        # suffix-prefill path (different bucket shapes) — warm the
+        # shortest and longest so the timed section measures serving,
+        # not XLA compiles.
+        warm = [prompts[0]]
+        if args.shared_prefix or args.long_prompt_frac > 0:
+            warm.append(min(prompts, key=len))
+            warm.append(max(prompts, key=len))
+        for p in warm:
+            for _ in range(2):
+                requests.post(f'{url}/generate', json={
+                    'tokens': [p], 'max_new_tokens': 2}, timeout=600)
+        # Streaming warm-up: in simple mode the first streamed request
+        # builds the lazy stream engine + its compiles (the timed
+        # section must measure serving, not XLA).
+        requests.post(f'{url}/generate', json={
+            'tokens': [prompts[0]], 'max_new_tokens': 2,
+            'stream': True}, timeout=600)
+        if assignment:
+            # LoRA-variant traces compile on the first adapter lane
+            # (shared decode + prefill); one warm request covers them.
+            requests.post(f'{url}/generate', json={
+                'tokens': [prompts[0]], 'max_new_tokens': 2,
+                'stream': True, 'model': assignment[0]}, timeout=600)
+
+        latencies = []
+        itl_gaps = []    # inter-token gaps across ALL requests (s)
+        shed = [0]       # client-observed 429s (admission control)
+        adapter_counts: dict = {}
+        lock = threading.Lock()
+        queue = list(enumerate(prompts))
+
+        def client() -> None:
+            while True:
+                with lock:
+                    if not queue:
+                        return
+                    idx, prompt = queue.pop()
+                body = {'tokens': [prompt],
+                        'max_new_tokens': args.max_new_tokens,
+                        'stream': True}
+                if assignment and assignment[idx] is not None:
+                    body['model'] = assignment[idx]
+                t0 = time.perf_counter()
+                # REAL TTFT + ITL: stream the request (SSE), stamp the
+                # first token frame and every gap between consecutive
+                # token frames — one request measures TTFT, ITL, and
+                # completion latency, exactly what a streaming client
+                # experiences.
+                ttft = None
+                last_tok_t = None
+                gaps = []
+                with requests.post(f'{url}/generate', json=body,
+                                   timeout=600, stream=True) as resp:
+                    if resp.status_code == 429:
+                        # Shed by admission control: count it and move
+                        # on (a production client would honor
+                        # Retry-After; the bench measures degradation,
+                        # not retries).
+                        with lock:
+                            shed[0] += 1
+                        continue
+                    resp.raise_for_status()
+                    for raw in resp.iter_lines():
+                        if not raw.startswith(b'data: '):
+                            continue
+                        if b'"token"' in raw:
+                            now = time.perf_counter()
+                            if ttft is None:
+                                ttft = now - t0
+                            if last_tok_t is not None:
+                                gaps.append(now - last_tok_t)
+                            last_tok_t = now
+                        if raw == b'data: [DONE]':
+                            break
+                total = time.perf_counter() - t0
+                with lock:
+                    latencies.append((ttft if ttft is not None
+                                      else total, total))
+                    itl_gaps.extend(gaps)
+                    name = (assignment[idx] if assignment else None) \
+                        or '<base>'
+                    adapter_counts[name] = \
+                        adapter_counts.get(name, 0) + 1
+
+        start = time.perf_counter()
+        threads = [threading.Thread(target=client)
+                   for _ in range(args.concurrency)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - start
+
+        ttfts = sorted(l[0] for l in latencies)
+        gaps = sorted(itl_gaps)
+        # Server-side ITL percentiles (/stats): gaps measured at the
+        # engine's token COMMIT, the signal chunked prefill targets —
+        # client-side SSE gap timing rides TCP flush batching and
+        # client GIL scheduling, which can swamp ms-scale effects.
+        stats = requests.get(f'{url}/stats', timeout=30).json()
+        serving = stats['serving']
+
+        def pct(sorted_vals, q):
+            if not sorted_vals:
+                return None
+            return round(1000 * sorted_vals[
+                int(q * (len(sorted_vals) - 1))], 2)
+
+        record = {
+            'engine': args.engine,
+            'speculative': args.speculative,
+            'decode_chunk': args.decode_chunk,
+            'prefill_chunk': args.prefill_chunk,
+            'prefill_budget': args.prefill_budget,
+            'pipeline_decode': not args.no_pipeline_decode,
+            'shared_prefix': args.shared_prefix,
+            'long_prompt_frac': args.long_prompt_frac,
+            'prefix_caching': not args.no_prefix_caching,
+            'model': info['model'],   # server-reported (handles --hf)
+            'requests': len(latencies),
+            'concurrency': args.concurrency,
+            'req_per_sec': round(len(latencies) / elapsed, 2),
+            'p50_ttft_ms': (round(1000 * statistics.median(ttfts), 1)
+                            if ttfts else None),
+            'p95_ttft_ms': (round(
+                1000 * ttfts[int(0.95 * (len(ttfts) - 1))], 1)
+                if ttfts else None),
+            'p99_ttft_ms': pct(ttfts, 0.99),
+            'itl_ms_p50': serving.get('itl_ms_p50'),
+            'itl_ms_p99': serving.get('itl_ms_p99'),
+            'sse_itl_ms_p50': pct(gaps, 0.50),
+            'sse_itl_ms_p99': pct(gaps, 0.99),
+            # Robustness plane: degradation under --fault-plan /
+            # admission control is A/B-able from the same JSON line.
+            'fault_plan': bool(args.fault_plan),
+            'shed_requests': shed[0],
+            'server_requests_shed': serving.get('requests_shed'),
+            'server_deadline_exceeded':
+                serving.get('deadline_exceeded'),
+            'engine_restarts': stats.get('engine_restarts'),
+        }
+        if adapter_dir:
+            # Per-adapter req/s (client-side) + the registry's own
+            # residency/eviction accounting (server-side).
+            server_ad = stats.get('adapters') or {}
+            record['adapters'] = {
+                'n': args.adapters,
+                'mix': args.adapter_mix if assignment else 'base-only',
+                'rank': args.adapter_rank,
+                'per_adapter': {
+                    name: {'requests': n,
+                           'req_per_sec': round(n / elapsed, 3)}
+                    for name, n in sorted(adapter_counts.items())},
+                'server_loads': server_ad.get('loads'),
+                'server_evictions': server_ad.get('evictions'),
+                'server_load_failures': server_ad.get('load_failures'),
+                'server_requests': server_ad.get('requests'),
+                'loaded_at_end': server_ad.get('loaded'),
+                'bytes_per_adapter': server_ad.get(
+                    'bytes_per_adapter'),
+            }
+        return record
+    finally:
+        server.terminate()
+        try:
+            server.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            server.kill()
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument('--engine', choices=['continuous', 'simple'],
@@ -382,6 +660,32 @@ def main() -> None:
                              'to DIR/<policy>/fleet.journal (the '
                              'crash-only controller contract; see '
                              'serve_fleet --state-dir)')
+    parser.add_argument('--adapters', type=int, default=0,
+                        metavar='N',
+                        help='multi-LoRA mode (single-server): '
+                             'generate N random adapter artifacts, '
+                             'start serve_lm with --adapter-dir, and '
+                             'target adapters per request via the '
+                             '`model` field (assignment from '
+                             '--adapter-mix, deterministic)')
+    parser.add_argument('--adapter-mix', default='zipf',
+                        choices=['zipf', 'uniform'],
+                        help='per-request adapter assignment: zipf '
+                             '(weight 1/(k+1) — few hot tenants, '
+                             'exercises LRU churn) or uniform')
+    parser.add_argument('--adapter-rank', type=int, default=8,
+                        help='rank of the generated bench adapters')
+    parser.add_argument('--max-adapters', type=int, default=8,
+                        help='forwarded to serve_lm --max-adapters '
+                             '(clamped up to --adapters)')
+    parser.add_argument('--adapter-ab', action='store_true',
+                        help='run the adapter-mix workload AND an '
+                             'all-base workload against identically '
+                             'configured servers (adapters loaded '
+                             'but untargeted = the zero-overhead '
+                             'fast path) and emit one combined JSON '
+                             'object (the committed BENCH_lora '
+                             'record)')
     parser.add_argument('--repetitive', action='store_true',
                         help='structured (repeated-trigram) prompts — '
                              'the regime speculation accelerates')
@@ -404,200 +708,54 @@ def main() -> None:
                      '(and the A/B record would lie)')
     if args.stub_replicas and not args.replicas:
         parser.error('--stub-replicas needs --replicas N')
+    if args.adapter_ab and not args.adapters:
+        parser.error('--adapter-ab needs --adapters N')
+    if args.adapters and args.replicas:
+        parser.error('--adapters is a single-server mode (fleet '
+                     'replicas share no adapter workload assignment)')
+    if args.adapters and args.engine != 'continuous':
+        parser.error('--adapters needs --engine continuous (batched '
+                     'per-slot LoRA lives in the slot engine)')
 
     if args.replicas:
         print(json.dumps(run_fleet(args)))
         return
 
-    port = _free_port()
-    cmd = _build_server_cmd(args) + ['--port', str(port)]
-    env = dict(os.environ)
-    env['PYTHONPATH'] = f"{REPO}:{env.get('PYTHONPATH', '')}"
-    server = subprocess.Popen(cmd, env=env, stdout=subprocess.DEVNULL,
-                              stderr=subprocess.STDOUT)
-    url = f'http://127.0.0.1:{port}'
-    try:
-        deadline = time.time() + 300
-        info = None
-        while time.time() < deadline:
-            try:
-                info = requests.get(url, timeout=2).json()
-                break
-            except requests.RequestException:
-                time.sleep(1)
-                if server.poll() is not None:
-                    raise RuntimeError('serve_lm died')
-        if info is None:
-            raise RuntimeError('serve_lm not ready within 300s')
-        vocab = int(info['vocab_size'])
-
-        rng = random.Random(0)
-        if args.repetitive:
-            # Structured prompts (repeated trigrams): the shape
-            # prompt-lookup speculation exploits — code, templated
-            # text, retrieval contexts.
-            def rep_prompt():
-                gram = [rng.randrange(1, vocab) for _ in range(3)]
-                n = rng.randrange(4, 16)
-                return (gram * ((n + 2) // 3))[:n]
-            prompts = [rep_prompt() for _ in range(args.requests)]
+    if args.adapters:
+        import tempfile
+        adapter_dir = tempfile.mkdtemp(prefix='serve_bench_lora_')
+        names = _make_adapter_artifacts(args, adapter_dir)
+        assignment = _adapter_assignment(args, names)
+        if args.adapter_ab:
+            print(json.dumps({
+                'bench': 'serve_lora',
+                'engine': args.engine,
+                'model': args.model,
+                'adapters': args.adapters,
+                'adapter_mix': args.adapter_mix,
+                'adapter_rank': args.adapter_rank,
+                'max_adapters': max(args.max_adapters, args.adapters),
+                'requests': args.requests,
+                'concurrency': args.concurrency,
+                'runs': {
+                    # adapters loaded AND targeted (the LoRA lanes)
+                    'lora_mix': _run_single(args, adapter_dir,
+                                            assignment),
+                    # adapters configured, every request hits base:
+                    # the zero-overhead fast path...
+                    'base_only': _run_single(args, adapter_dir, None),
+                    # ...measured against a server with no adapter
+                    # registry at all (the pre-LoRA control arm).
+                    'no_adapters': _run_single(args),
+                },
+            }))
         else:
-            prompts = [[rng.randrange(1, vocab)
-                        for _ in range(rng.randrange(4, 16))]
-                       for _ in range(args.requests)]
-        if args.long_prompt_frac > 0:
-            # Long prompts leave room to generate the full
-            # max_new_tokens below max_total_len (submit requires
-            # prompt_len < max_total_len).
-            long_len = max(16, args.max_total_len -
-                           args.max_new_tokens - 2)
-            n_long = int(round(args.long_prompt_frac * len(prompts)))
-            # Deterministic spread through the workload (not a
-            # front-loaded burst).
-            for i in range(n_long):
-                idx = (i * len(prompts)) // max(n_long, 1)
-                prompts[idx] = [rng.randrange(1, vocab)
-                                for _ in range(long_len)]
-        if args.shared_prefix:
-            system = [rng.randrange(1, vocab)
-                      for _ in range(args.shared_prefix)]
-            prompts = [system + p for p in prompts]
-        # Warm the compile caches (prefill buckets + decode). With
-        # prefix caching the SECOND pass over a prompt takes the
-        # suffix-prefill path (different bucket shapes) — warm the
-        # shortest and longest so the timed section measures serving,
-        # not XLA compiles.
-        warm = [prompts[0]]
-        if args.shared_prefix or args.long_prompt_frac > 0:
-            warm.append(min(prompts, key=len))
-            warm.append(max(prompts, key=len))
-        for p in warm:
-            for _ in range(2):
-                requests.post(f'{url}/generate', json={
-                    'tokens': [p], 'max_new_tokens': 2}, timeout=600)
-        # Streaming warm-up: in simple mode the first streamed request
-        # builds the lazy stream engine + its compiles (the timed
-        # section must measure serving, not XLA).
-        requests.post(f'{url}/generate', json={
-            'tokens': [prompts[0]], 'max_new_tokens': 2,
-            'stream': True}, timeout=600)
+            print(json.dumps(_run_single(args, adapter_dir,
+                                         assignment)))
+        return
 
-        latencies = []
-        itl_gaps = []    # inter-token gaps across ALL requests (s)
-        shed = [0]       # client-observed 429s (admission control)
-        lock = threading.Lock()
-        queue = list(enumerate(prompts))
+    print(json.dumps(_run_single(args)))
 
-        def client() -> None:
-            while True:
-                with lock:
-                    if not queue:
-                        return
-                    _idx, prompt = queue.pop()
-                t0 = time.perf_counter()
-                # REAL TTFT + ITL: stream the request (SSE), stamp the
-                # first token frame and every gap between consecutive
-                # token frames — one request measures TTFT, ITL, and
-                # completion latency, exactly what a streaming client
-                # experiences.
-                ttft = None
-                last_tok_t = None
-                gaps = []
-                with requests.post(f'{url}/generate', json={
-                        'tokens': [prompt],
-                        'max_new_tokens': args.max_new_tokens,
-                        'stream': True}, timeout=600,
-                        stream=True) as resp:
-                    if resp.status_code == 429:
-                        # Shed by admission control: count it and move
-                        # on (a production client would honor
-                        # Retry-After; the bench measures degradation,
-                        # not retries).
-                        with lock:
-                            shed[0] += 1
-                        continue
-                    resp.raise_for_status()
-                    for raw in resp.iter_lines():
-                        if not raw.startswith(b'data: '):
-                            continue
-                        if b'"token"' in raw:
-                            now = time.perf_counter()
-                            if ttft is None:
-                                ttft = now - t0
-                            if last_tok_t is not None:
-                                gaps.append(now - last_tok_t)
-                            last_tok_t = now
-                        if raw == b'data: [DONE]':
-                            break
-                total = time.perf_counter() - t0
-                with lock:
-                    latencies.append((ttft if ttft is not None
-                                      else total, total))
-                    itl_gaps.extend(gaps)
-
-        start = time.perf_counter()
-        threads = [threading.Thread(target=client)
-                   for _ in range(args.concurrency)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        elapsed = time.perf_counter() - start
-
-        ttfts = sorted(l[0] for l in latencies)
-        gaps = sorted(itl_gaps)
-        # Server-side ITL percentiles (/stats): gaps measured at the
-        # engine's token COMMIT, the signal chunked prefill targets —
-        # client-side SSE gap timing rides TCP flush batching and
-        # client GIL scheduling, which can swamp ms-scale effects.
-        stats = requests.get(f'{url}/stats', timeout=30).json()
-        serving = stats['serving']
-
-        def pct(sorted_vals, q):
-            if not sorted_vals:
-                return None
-            return round(1000 * sorted_vals[
-                int(q * (len(sorted_vals) - 1))], 2)
-
-        print(json.dumps({
-            'engine': args.engine,
-            'speculative': args.speculative,
-            'decode_chunk': args.decode_chunk,
-            'prefill_chunk': args.prefill_chunk,
-            'prefill_budget': args.prefill_budget,
-            'pipeline_decode': not args.no_pipeline_decode,
-            'shared_prefix': args.shared_prefix,
-            'long_prompt_frac': args.long_prompt_frac,
-            'prefix_caching': not args.no_prefix_caching,
-            'model': info['model'],   # server-reported (handles --hf)
-            'requests': len(latencies),
-            'concurrency': args.concurrency,
-            'req_per_sec': round(len(latencies) / elapsed, 2),
-            'p50_ttft_ms': (round(1000 * statistics.median(ttfts), 1)
-                            if ttfts else None),
-            'p95_ttft_ms': (round(
-                1000 * ttfts[int(0.95 * (len(ttfts) - 1))], 1)
-                if ttfts else None),
-            'p99_ttft_ms': pct(ttfts, 0.99),
-            'itl_ms_p50': serving.get('itl_ms_p50'),
-            'itl_ms_p99': serving.get('itl_ms_p99'),
-            'sse_itl_ms_p50': pct(gaps, 0.50),
-            'sse_itl_ms_p99': pct(gaps, 0.99),
-            # Robustness plane: degradation under --fault-plan /
-            # admission control is A/B-able from the same JSON line.
-            'fault_plan': bool(args.fault_plan),
-            'shed_requests': shed[0],
-            'server_requests_shed': serving.get('requests_shed'),
-            'server_deadline_exceeded':
-                serving.get('deadline_exceeded'),
-            'engine_restarts': stats.get('engine_restarts'),
-        }))
-    finally:
-        server.terminate()
-        try:
-            server.wait(timeout=10)
-        except subprocess.TimeoutExpired:
-            server.kill()
 
 
 if __name__ == '__main__':

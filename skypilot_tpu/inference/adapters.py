@@ -1,0 +1,397 @@
+"""Adapter registry: hot-loadable multi-LoRA serving state.
+
+One registry per serving process, shared by every engine in it. Two
+halves under one lock:
+
+  - INVENTORY: the artifact directory (`serve_lm --adapter-dir`) is
+    scanned for `<name>/adapter_config.json` subdirectories (the
+    `train_lm --lora` output format, models/lora.py). A lookup miss
+    rescans, so dropping a new artifact into the directory makes it
+    servable without a restart (hot-load). `gs://` dirs are synced
+    to a local cache via gsutil once per (re)scan.
+  - DEVICE STORE: `--max-adapters` stacked slots of A/B factors,
+    `{'layer_i': {target: {'a': [N+1, d_in, R], 'b': [N+1, R,
+    d_out]}}}` — row 0 is all-zeros (the base model), rows 1..N hold
+    loaded adapters. The engine passes the WHOLE stack plus per-slot
+    `adapter_ids` into its jitted decode/prefill fns; the model
+    gathers each row's factors (models/lora.py `apply_delta`), so
+    one dispatch serves many adapters. Loading writes one row
+    in-place (donated `.at[slot].set`), never reshapes — no
+    recompiles as adapters come and go.
+
+Residency: `acquire()` pins (refcounts) an adapter while any engine
+slot decodes with it; refcount-0 adapters stay resident (LRU) and
+are evicted only when a load needs their device slot. A pinned
+adapter is NEVER evicted — `acquire` returns None instead and the
+engine re-queues the request (the same back-pressure contract as KV
+page exhaustion). Artifacts with rank < the store rank are zero-
+padded; `alpha/rank` is folded into the loaded B factors so the
+engine always applies scale 1.
+
+Chaos: the `adapters.load` fault point fires inside every artifact
+load — a raised/dropped rule turns into AdapterLoadError (HTTP 503)
+for that request only; the engine, the other adapters, and the base
+model keep serving.
+"""
+from __future__ import annotations
+
+import collections
+import functools
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from skypilot_tpu.inference import affinity
+from skypilot_tpu.models import lora as lora_lib
+from skypilot_tpu.observability import catalog as _obs
+from skypilot_tpu.robustness import faults
+from skypilot_tpu.robustness.errors import (AdapterLoadError,
+                                            AdapterNotFoundError)
+
+
+_SET_ROW = None
+
+
+def _write_rows(stack, row, idx):
+    """One adapter's factors into stack row `idx`, in place (donated:
+    XLA updates the resident buffers instead of copying the store).
+    The jitted writer is cached module-wide so repeated hot-loads
+    reuse one executable per stack geometry."""
+    global _SET_ROW
+    import jax
+    import jax.numpy as jnp
+    if _SET_ROW is None:
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def _set(stack, row, idx):
+            return jax.tree.map(
+                lambda s, r: s.at[idx].set(r.astype(s.dtype)),
+                stack, row)
+
+        _SET_ROW = _set
+    return _SET_ROW(stack, row, jnp.asarray(idx, jnp.int32))
+
+
+class AdapterRegistry:
+    """Registry + device store. Thread-safe: engine scheduler threads
+    acquire/release, HTTP threads read inventory/stats."""
+
+    def __init__(self, adapter_dir: str, model, *,
+                 max_adapters: int = 8, max_rank: int = 0) -> None:
+        if not lora_lib.supports(model):
+            raise ValueError(
+                f'{type(model).__name__} has no LoRA forward path; '
+                f'multi-LoRA serving supports the Llama family '
+                f'(models/lora.py)')
+        if max_adapters < 1:
+            raise ValueError(
+                f'max_adapters must be >= 1, got {max_adapters}')
+        self.model = model
+        self.cfg = model.config
+        self.max_adapters = int(max_adapters)
+        self._dir = adapter_dir
+        self._local_dir = adapter_dir  # set by _sync_remote for gs://
+        self._lock = threading.Lock()
+        # Inventory (disk): name -> adapter_config dict.
+        self._inventory: Dict[str, Dict[str, Any]] = {}
+        # Device store bookkeeping. Slots are 1-based (row 0 = base).
+        self._loaded: Dict[str, int] = {}
+        self._slot_name: Dict[int, str] = {}
+        self._refs: Dict[int, int] = {}
+        self._lru: 'collections.OrderedDict[str, None]' = \
+            collections.OrderedDict()
+        self._free: List[int] = list(range(self.max_adapters, 0, -1))
+        self._stack = None           # built on first load
+        self._model_lora = None
+        self._rank = int(max_rank)   # 0 = fixed by the scanned max
+        self._targets: Tuple[str, ...] = ()
+        # Counters (mirrored as Prometheus series; see stats()).
+        self.loads = 0
+        self.evictions = 0
+        self.load_failures = 0
+        self.requests: Dict[str, int] = {}
+        self.tokens: Dict[str, int] = {}
+        self._m_loaded = _obs.gauge('skypilot_serving_adapters_loaded')
+        self._m_load_failures = _obs.counter(
+            'skypilot_serving_adapter_load_failures_total')
+        with self._lock:
+            self._scan_locked()
+
+    # -- inventory ----------------------------------------------------------
+    def _sync_remote_locked(self) -> None:
+        """gs:// artifact dirs sync into a content-addressed local
+        cache; local dirs are used as-is."""
+        if not self._dir.startswith('gs://'):
+            return
+        cache = os.path.join(
+            os.path.expanduser('~/.cache/skypilot_tpu/adapters'),
+            hashlib.sha256(self._dir.encode()).hexdigest()[:16])
+        os.makedirs(cache, exist_ok=True)
+        try:
+            subprocess.run(
+                ['gsutil', '-m', 'rsync', '-r', self._dir, cache],
+                check=True, capture_output=True, timeout=600)
+        except (OSError, subprocess.SubprocessError) as e:
+            raise AdapterLoadError(
+                f'cannot sync adapter dir {self._dir}: '
+                f'{type(e).__name__}: {e}') from e
+        self._local_dir = cache
+
+    def _scan_locked(self) -> None:
+        self._sync_remote_locked()
+        for name in lora_lib.list_adapter_dirs(self._local_dir):
+            if name in self._inventory:
+                continue
+            try:
+                config, _ = self._read_config(name)
+            except (OSError, ValueError, KeyError):
+                continue  # half-written artifact: picked up next scan
+            self._inventory[name] = config
+            if self._stack is None:
+                # The store geometry is fixed by what the scan saw
+                # before the first load (or --max-lora-rank).
+                self._rank = max(self._rank, int(config['rank']))
+                merged = dict.fromkeys(self._targets)
+                merged.update(dict.fromkeys(config['targets']))
+                self._targets = tuple(
+                    t for t in lora_lib.ALL_TARGETS if t in merged)
+
+    def _read_config(self, name: str) -> Tuple[Dict[str, Any], str]:
+        path = os.path.join(self._local_dir, name)
+        import json
+        with open(os.path.join(path, lora_lib.CONFIG_FILE),
+                  encoding='utf-8') as f:
+            config = json.load(f)
+        if 'rank' not in config or 'targets' not in config:
+            raise ValueError(f'malformed adapter config for {name!r}')
+        return config, path
+
+    def inventory(self) -> List[str]:
+        with self._lock:
+            return sorted(self._inventory)
+
+    def exists(self, name: str) -> bool:
+        with self._lock:
+            if name not in self._inventory:
+                self._scan_locked()   # hot-load: new artifacts appear
+            return name in self._inventory
+
+    def resolve(self, name: str) -> None:
+        """Raise AdapterNotFoundError unless `name` is servable."""
+        if not self.exists(name):
+            raise AdapterNotFoundError(
+                f'adapter {name!r} not found in {self._dir} '
+                f'(known: {self.inventory()})')
+
+    def cache_salt(self, name: str) -> bytes:
+        """Prefix-cache chain-key salt: KV pages are adapter-dependent
+        once LoRA touches k/v projections, so the engine keys them per
+        adapter (same constant the LB affinity keys use)."""
+        return affinity.adapter_salt(name)
+
+    # -- device store -------------------------------------------------------
+    def _ensure_stack_locked(self) -> None:
+        if self._stack is not None:
+            return
+        if self._rank < 1 or not self._targets:
+            raise AdapterLoadError(
+                'adapter store geometry unknown: no adapters scanned '
+                'and no --max-lora-rank given')
+        import jax.numpy as jnp
+        shapes = lora_lib.projection_shapes(self.cfg)
+        n = self.max_adapters + 1
+        stack: Dict[str, Any] = {}
+        for i in range(self.cfg.num_layers):
+            layer: Dict[str, Any] = {}
+            for t in self._targets:
+                d_in, d_out = shapes[t]
+                layer[t] = {
+                    'a': jnp.zeros((n, d_in, self._rank),
+                                   self.cfg.dtype),
+                    'b': jnp.zeros((n, self._rank, d_out),
+                                   self.cfg.dtype),
+                }
+            stack[f'layer_{i}'] = layer
+        self._stack = stack
+        self._refresh_model_lora_locked()
+
+    def _refresh_model_lora_locked(self) -> None:
+        import jax.numpy as jnp
+        self._model_lora = {'scale': jnp.float32(1.0),
+                            'layers': self._stack}
+
+    def model_lora(self):
+        """The pytree the engine passes into its jitted fns (scale is
+        1.0: per-adapter alpha/rank is folded into B at load)."""
+        with self._lock:
+            return self._model_lora
+
+    def _load_locked(self, name: str, slot: int) -> None:
+        """Read the artifact and write stack row `slot`. Any failure
+        (including an injected `adapters.load` fault) surfaces as
+        AdapterLoadError without touching the other rows."""
+        try:
+            if faults.point('adapters.load', adapter=name) is \
+                    faults.DROP:
+                raise AdapterLoadError(
+                    f'injected adapters.load drop for {name!r}')
+            config, path = self._read_config(name)
+            spec = lora_lib.load_spec(config)
+            self._ensure_stack_locked()
+            if spec.rank > self._rank:
+                raise AdapterLoadError(
+                    f'adapter {name!r} has rank {spec.rank} > store '
+                    f'rank {self._rank}; restart with --max-lora-rank '
+                    f'{spec.rank}')
+            missing = [t for t in spec.targets
+                       if t not in self._targets]
+            if missing:
+                raise AdapterLoadError(
+                    f'adapter {name!r} adapts {missing}, not in the '
+                    f'store target set {list(self._targets)} (fixed '
+                    f'at startup); restart to widen it')
+            _, weights = lora_lib.load_adapter(path)
+            shapes = lora_lib.projection_shapes(self.cfg)
+            row: Dict[str, Any] = {}
+            for i in range(self.cfg.num_layers):
+                lname = f'layer_{i}'
+                layer: Dict[str, Any] = {}
+                for t in self._targets:
+                    d_in, d_out = shapes[t]
+                    factors = weights.get(lname, {}).get(t)
+                    a = np.zeros((d_in, self._rank), np.float32)
+                    b = np.zeros((self._rank, d_out), np.float32)
+                    if factors is not None:
+                        fa = np.asarray(factors['a'], np.float32)
+                        fb = np.asarray(factors['b'], np.float32)
+                        if fa.shape != (d_in, spec.rank) or \
+                                fb.shape != (spec.rank, d_out):
+                            raise AdapterLoadError(
+                                f'adapter {name!r} {lname}/{t} shape '
+                                f'{fa.shape}x{fb.shape} does not '
+                                f'match the serving model '
+                                f'({d_in},{spec.rank})x'
+                                f'({spec.rank},{d_out})')
+                        a[:, :spec.rank] = fa
+                        # alpha/rank folds into B: the engine applies
+                        # scale 1 for every adapter in the stack.
+                        b[:spec.rank, :] = fb * spec.scale
+                    layer[t] = {'a': a, 'b': b}
+                row[lname] = layer
+            self._stack = _write_rows(self._stack, row, slot)
+            self._refresh_model_lora_locked()
+        except AdapterLoadError:
+            self.load_failures += 1
+            self._m_load_failures.inc()
+            raise
+        except Exception as e:
+            self.load_failures += 1
+            self._m_load_failures.inc()
+            raise AdapterLoadError(
+                f'loading adapter {name!r} failed: '
+                f'{type(e).__name__}: {e}') from e
+        self.loads += 1
+        _obs.counter(
+            'skypilot_serving_adapter_loads_total').labels(
+                adapter=name).inc()
+
+    def acquire(self, name: str) -> Optional[int]:
+        """Pin `name` and return its device slot id (1-based; 0 is
+        the base model and never returned). Loads — evicting the LRU
+        unpinned adapter if the store is full — when not resident.
+        Returns None when every slot is pinned by a running request
+        (the caller re-queues); raises AdapterNotFoundError /
+        AdapterLoadError for missing / unloadable artifacts."""
+        with self._lock:
+            if name not in self._inventory:
+                self._scan_locked()
+            if name not in self._inventory:
+                raise AdapterNotFoundError(
+                    f'adapter {name!r} not found in {self._dir} '
+                    f'(known: {sorted(self._inventory)})')
+            slot = self._loaded.get(name)
+            if slot is not None:
+                self._refs[slot] = self._refs.get(slot, 0) + 1
+                self._lru.pop(name, None)
+                self._count_request_locked(name)
+                return slot
+            if not self._free:
+                if not self._lru:
+                    return None   # every slot pinned: back-pressure
+                evictee, _ = self._lru.popitem(last=False)
+                freed = self._loaded.pop(evictee)
+                del self._slot_name[freed]
+                self._free.append(freed)
+                self.evictions += 1
+                _obs.counter(
+                    'skypilot_serving_adapter_evictions_total').labels(
+                        adapter=evictee).inc()
+            slot = self._free[-1]
+            self._load_locked(name, slot)   # raises on failure
+            self._free.pop()
+            self._loaded[name] = slot
+            self._slot_name[slot] = name
+            self._refs[slot] = 1
+            self._count_request_locked(name)
+            self._m_loaded.set(len(self._loaded))
+            return slot
+
+    def release(self, slot: int, tokens: int = 0) -> None:
+        """Unpin one acquire(); refcount 0 makes the adapter LRU-
+        evictable (it stays resident until a load needs the slot).
+        `tokens` adds the request's committed tokens to the
+        per-adapter counter."""
+        with self._lock:
+            name = self._slot_name.get(slot)
+            if name is None:
+                return
+            self._refs[slot] = self._refs.get(slot, 1) - 1
+            if self._refs[slot] <= 0:
+                self._refs.pop(slot, None)
+                self._lru[name] = None
+            if tokens > 0:
+                self.tokens[name] = self.tokens.get(name, 0) + tokens
+                _obs.counter(
+                    'skypilot_serving_adapter_tokens_total').labels(
+                        adapter=name).inc(tokens)
+
+    def _count_request_locked(self, name: str) -> None:
+        self.requests[name] = self.requests.get(name, 0) + 1
+        _obs.counter(
+            'skypilot_serving_adapter_requests_total').labels(
+                adapter=name).inc()
+
+    # -- observability ------------------------------------------------------
+    def loaded_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._loaded)
+
+    def stats(self) -> Dict[str, Any]:
+        """The `/stats` adapters section (also scraped into the
+        replica plane's /fleet/status views)."""
+        with self._lock:
+            bytes_per = (lora_lib.adapter_num_bytes(
+                self.cfg, self._rank,
+                self._targets or lora_lib.ATTN_TARGETS,
+                bytes_per_elem=np.dtype(self.cfg.dtype).itemsize)
+                if self._rank else 0)
+            return {
+                'inventory': sorted(self._inventory),
+                'loaded': sorted(self._loaded),
+                'pinned': sorted(self._slot_name[s]
+                                 for s, r in self._refs.items()
+                                 if r > 0),
+                'max_adapters': self.max_adapters,
+                'rank': self._rank,
+                'targets': list(self._targets),
+                'loads': self.loads,
+                'evictions': self.evictions,
+                'load_failures': self.load_failures,
+                'requests': dict(self.requests),
+                'tokens': dict(self.tokens),
+                'bytes_per_adapter': bytes_per,
+                'device_bytes': bytes_per * len(self._loaded),
+            }

@@ -36,12 +36,28 @@ DEFAULT_PAGE_SIZE = 16
 TEXT_PREFIX_CHARS = 256
 
 
-def chain_keys(tokens: List[int], page_size: int) -> List[bytes]:
+def adapter_salt(model: Optional[str]) -> bytes:
+    """Chain-key salt for a LoRA adapter request. KV pages are
+    adapter-dependent once LoRA touches the k/v projections, so both
+    the engine's PrefixCache keys AND the LB affinity keys fold the
+    adapter identity in — same prompt under two adapters must never
+    share pages (tenant isolation) or an affinity group. Empty salt
+    (base model) keeps keys byte-identical to the pre-LoRA scheme."""
+    if not model:
+        return b''
+    return b'lora\x00' + str(model).encode('utf-8', 'replace')
+
+
+def chain_keys(tokens: List[int], page_size: int,
+               salt: bytes = b'') -> List[bytes]:
     """One key per FULL page; identical to
     models/batching.PrefixCache.chain_keys (parity-tested) without
-    importing the engine (and its JAX dependency)."""
+    importing the engine (and its JAX dependency). `salt` prefixes
+    the hash chain (adapter identity)."""
     keys = []
     h = hashlib.sha256()
+    if salt:
+        h.update(salt)
     for i in range(len(tokens) // page_size):
         chunk = tokens[i * page_size:(i + 1) * page_size]
         h.update(np.asarray(chunk, np.int32).tobytes())
@@ -50,61 +66,67 @@ def chain_keys(tokens: List[int], page_size: int) -> List[bytes]:
 
 
 def token_affinity_key(tokens: List[int],
-                       page_size: int = DEFAULT_PAGE_SIZE
-                       ) -> Optional[str]:
+                       page_size: int = DEFAULT_PAGE_SIZE,
+                       salt: bytes = b'') -> Optional[str]:
     """Affinity key for a token prompt: the FIRST full-page chain key
     (hex). The first page commits to the first `page_size` tokens —
     the shared-system-prompt signature — while later keys diverge as
     soon as user content does. Prompts shorter than one page have no
     cacheable full page, hence no key (caller falls back to
     least-load)."""
-    keys = chain_keys(tokens, page_size)
+    keys = chain_keys(tokens, page_size, salt=salt)
     if not keys:
         return None
     return keys[0].hex()
 
 
-def text_affinity_key(text: str) -> Optional[str]:
+def text_affinity_key(text: str, salt: bytes = b'') -> Optional[str]:
     if not text:
         return None
     return hashlib.sha256(
-        text[:TEXT_PREFIX_CHARS].encode('utf-8', 'replace')).hexdigest()
+        salt + text[:TEXT_PREFIX_CHARS].encode('utf-8',
+                                               'replace')).hexdigest()
 
 
 def request_affinity_key(path: str, body: Dict[str, Any],
                          page_size: int = DEFAULT_PAGE_SIZE
                          ) -> Optional[str]:
     """Extract the routing key from a generation request body, by
-    endpoint shape. Returns None for anything unrecognized — the LB
-    then routes by load, never errors."""
+    endpoint shape. The body's `model` field (adapter selection)
+    salts the key, so a tenant's requests pin to the replica holding
+    both its KV pages AND its hot-loaded adapter — and never share an
+    affinity group with another tenant's identical prompt. Returns
+    None for anything unrecognized — the LB then routes by load,
+    never errors."""
     try:
+        salt = adapter_salt(body.get('model'))
         if path in ('/generate', '/v1/generate'):
             tokens = body.get('tokens') or []
             if tokens and isinstance(tokens[0], list):
                 tokens = tokens[0]
             return token_affinity_key([int(t) for t in tokens],
-                                      page_size)
+                                      page_size, salt=salt)
         if path in ('/generate_text', '/v1/generate_text'):
             prompts = body.get('prompts', '')
             if isinstance(prompts, list):
                 prompts = prompts[0] if prompts else ''
-            return text_affinity_key(str(prompts))
+            return text_affinity_key(str(prompts), salt=salt)
         if path == '/v1/completions':
             prompt = body.get('prompt', '')
             if isinstance(prompt, list):
                 prompt = prompt[0] if prompt else ''
-            return text_affinity_key(str(prompt))
+            return text_affinity_key(str(prompt), salt=salt)
         if path == '/v1/chat/completions':
             messages = body.get('messages') or []
             # The system message IS the shared prefix; chats without
             # one key on their first message (session affinity).
             for message in messages:
                 if message.get('role') == 'system':
-                    return text_affinity_key(str(message.get('content',
-                                                             '')))
+                    return text_affinity_key(
+                        str(message.get('content', '')), salt=salt)
             if messages:
                 return text_affinity_key(
-                    str(messages[0].get('content', '')))
+                    str(messages[0].get('content', '')), salt=salt)
     except (TypeError, ValueError, KeyError, IndexError):
         # Malformed bodies are the replica's 400 to give, not the
         # LB's 500: route keyless.

@@ -41,7 +41,9 @@ from skypilot_tpu.inference.runtime import (InferenceRuntime,
 from skypilot_tpu.observability import REGISTRY
 from skypilot_tpu.observability import catalog as obs_catalog
 from skypilot_tpu.robustness import faults
-from skypilot_tpu.robustness.errors import (DeadlineExceededError,
+from skypilot_tpu.robustness.errors import (AdapterLoadError,
+                                            AdapterNotFoundError,
+                                            DeadlineExceededError,
                                             EngineDeadError,
                                             QueueSaturatedError)
 
@@ -59,14 +61,17 @@ INSTANCE_UUID = (os.environ.get('STPU_REPLICA_INSTANCE_UUID') or
 
 def classify_error(e: Exception):
     """(http_status, retry_after_s) for a request-path exception: the
-    robustness taxonomy (429 shed / 504 deadline / 503 engine dead)
-    ahead of the 400 catch-all."""
+    robustness taxonomy (429 shed / 504 deadline / 503 engine dead or
+    adapter load failure / 404 unknown model) ahead of the 400
+    catch-all."""
     if isinstance(e, QueueSaturatedError):
         return 429, e.retry_after_s
     if isinstance(e, DeadlineExceededError):
         return 504, None
-    if isinstance(e, EngineDeadError):
+    if isinstance(e, (EngineDeadError, AdapterLoadError)):
         return 503, None
+    if isinstance(e, AdapterNotFoundError):
+        return 404, None
     return 400, None
 
 
@@ -158,11 +163,16 @@ def make_server(rt: InferenceRuntime,
                 return
             if self.path == '/v1/models':
                 # OpenAI client bootstrap: most SDKs list models
-                # before first use.
+                # before first use. Adapters are models: the `model`
+                # field on /v1/* selects one (base name = base model).
+                names = [rt.model_name]
+                if rt.adapters is not None:
+                    names += rt.adapters.inventory()
                 self._json({'object': 'list',
-                            'data': [{'id': rt.model_name,
+                            'data': [{'id': name,
                                       'object': 'model',
-                                      'owned_by': 'skypilot-tpu'}]})
+                                      'owned_by': 'skypilot-tpu'}
+                                     for name in names]})
                 return
             # Advertise the MINIMUM capacity across request classes
             # (speculative clamp, decode-chunk clamp) — clients sizing
@@ -215,6 +225,8 @@ def make_server(rt: InferenceRuntime,
             body = {'serving': rt.metrics.snapshot(),
                     'instance_uuid': INSTANCE_UUID,
                     'pid': os.getpid()}
+            if rt.adapters is not None:
+                body['adapters'] = rt.adapters.stats()
             if engine is None:
                 body['engine'] = 'simple'
                 self._json(body)
@@ -315,6 +327,9 @@ def make_server(rt: InferenceRuntime,
                 stop_ids = [int(t) for t in
                             req.get('stop_token_ids', [])]
                 stream = bool(req.get('stream'))
+                # `model` selects a LoRA adapter (unknown -> 404; base
+                # name / absent -> base model).
+                adapter = rt.resolve_model(req.get('model'))
                 deadline_s = rt.deadline_for(req)
                 limit = rt.limit_for(temperature, streaming=stream)
                 for row in tokens:
@@ -327,11 +342,12 @@ def make_server(rt: InferenceRuntime,
                 if stream:
                     self._generate_stream(tokens, max_new, temperature,
                                           top_k, top_p, stop_ids,
-                                          deadline_s)
+                                          deadline_s, adapter)
                     return
                 t0 = time.monotonic()
                 ttft = None
-                if rt.engine is not None:
+                eng = rt.engine_for(adapter)
+                if eng is not None:
                     # Ragged rows welcome: each joins the shared
                     # decode loop independently. The shared latch
                     # records TTFT at the request's FIRST committed
@@ -339,12 +355,13 @@ def make_server(rt: InferenceRuntime,
                     # real TTFT too, not just streamed ones.
                     latch = obs_catalog.FirstTokenLatch()
                     futs = _submit_all(
-                        rt.engine,
+                        eng,
                         [[int(t) for t in row] for row in tokens],
                         max_new_tokens=max_new,
                         temperature=temperature, top_k=top_k,
                         top_p=top_p, stop_token_ids=stop_ids,
-                        on_token=latch, deadline_s=deadline_s)
+                        on_token=latch, deadline_s=deadline_s,
+                        adapter=adapter)
                     # The engine's deadline sweep resolves expired
                     # futures with DeadlineExceededError (-> 504); the
                     # host-side timeout is only a backstop.
@@ -400,14 +417,15 @@ def make_server(rt: InferenceRuntime,
                        headers=headers)
 
         def _generate_stream(self, tokens, max_new, temperature,
-                             top_k, top_p, stop_ids, deadline_s):
+                             top_k, top_p, stop_ids, deadline_s,
+                             adapter=None):
             """SSE of {"index": row, "token": id} events, one per
             committed token across all rows, interleaved by arrival."""
             t0 = time.monotonic()
             handles = [rt.submit_stream(
                 [int(t) for t in row], max_new, temperature,
                 top_k=top_k, top_p=top_p, stop_token_ids=stop_ids,
-                deadline_s=deadline_s)
+                deadline_s=deadline_s, adapter=adapter)
                 for row in tokens]
             self.sse_start()
             n_gen = 0
@@ -449,7 +467,9 @@ def make_server(rt: InferenceRuntime,
                     stream=bool(body.get('stream')),
                     logprobs=body.get('logprobs'),
                     echo=bool(body.get('echo')),
-                    deadline_s=rt.deadline_for(body))
+                    deadline_s=rt.deadline_for(body),
+                    adapter=rt.resolve_model(body.get('model')),
+                    model=body.get('model'))
                 if req.stream:
                     oai.stream_completion(rt, req, self)
                 else:
@@ -460,6 +480,10 @@ def make_server(rt: InferenceRuntime,
         def _openai_chat(self):
             try:
                 body = self._read_body()
+                # Model validation FIRST: an unknown model must 404
+                # before prompt rendering can fail 400 on tokenizer
+                # details.
+                adapter = rt.resolve_model(body.get('model'))
                 prompt = oai.render_chat_prompt(rt, body['messages'])
                 # Modern chat knobs: logprobs is a bool +
                 # top_logprobs count (clamped to the engine's 5).
@@ -475,7 +499,9 @@ def make_server(rt: InferenceRuntime,
                     n=int(body.get('n', 1)),
                     stream=bool(body.get('stream')),
                     logprobs=chat_lp,
-                    deadline_s=rt.deadline_for(body))
+                    deadline_s=rt.deadline_for(body),
+                    adapter=adapter,
+                    model=body.get('model'))
                 if req.stream:
                     oai.stream_completion(rt, req, self, chat=True)
                 else:
@@ -498,9 +524,12 @@ def make_server(rt: InferenceRuntime,
                         503: 'service_unavailable',
                         504: 'timeout'}.get(code,
                                             'invalid_request_error')
-            self._json({'error': {
-                'message': f'{type(e).__name__}: {e}',
-                'type': err_type}}, code, headers=headers)
+            err = {'message': f'{type(e).__name__}: {e}',
+                   'type': err_type}
+            if code == 404:
+                # The OpenAI unknown-model error object.
+                err['code'] = 'model_not_found'
+            self._json({'error': err}, code, headers=headers)
 
         def _generate_text(self):
             try:
@@ -517,6 +546,7 @@ def make_server(rt: InferenceRuntime,
                     stop_strings = [stop_strings]
                 max_new = int(req.get('max_new_tokens', 64))
                 stream = bool(req.get('stream'))
+                adapter = rt.resolve_model(req.get('model'))
                 deadline_s = rt.deadline_for(req)
                 encoded = [tok(p)['input_ids'] for p in prompts]
                 limit = rt.limit_for(temperature, streaming=stream)
@@ -528,17 +558,18 @@ def make_server(rt: InferenceRuntime,
                 if stream:
                     self._generate_text_stream(
                         encoded, max_new, temperature, top_k, top_p,
-                        stop_strings, deadline_s)
+                        stop_strings, deadline_s, adapter)
                     return
                 t0 = time.monotonic()
                 ttft = None
-                if rt.engine is not None:
+                eng = rt.engine_for(adapter)
+                if eng is not None:
                     latch = obs_catalog.FirstTokenLatch()
                     futs = _submit_all(
-                        rt.engine, encoded, max_new_tokens=max_new,
+                        eng, encoded, max_new_tokens=max_new,
                         temperature=temperature, top_k=top_k,
                         top_p=top_p, on_token=latch,
-                        deadline_s=deadline_s)
+                        deadline_s=deadline_s, adapter=adapter)
                     rows = [f.result(timeout=deadline_s + 30.0)
                             for f in futs]
                     ttft = latch.first_token_s
@@ -562,14 +593,16 @@ def make_server(rt: InferenceRuntime,
 
         def _generate_text_stream(self, encoded: List[List[int]],
                                   max_new, temperature, top_k, top_p,
-                                  stop_strings, deadline_s):
+                                  stop_strings, deadline_s,
+                                  adapter=None):
             """SSE of {"index": i, "delta": text} events (incremental
             detokenization + stop-string holdback per row)."""
             tok = rt.get_tokenizer()
             t0 = time.monotonic()
             handles = [rt.submit_stream(ids, max_new, temperature,
                                         top_k=top_k, top_p=top_p,
-                                        deadline_s=deadline_s)
+                                        deadline_s=deadline_s,
+                                        adapter=adapter)
                        for ids in encoded]
             self.sse_start()
             decs = [oai.IncrementalDecoder(tok) for _ in encoded]

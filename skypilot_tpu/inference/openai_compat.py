@@ -132,9 +132,15 @@ class CompletionRequest:
                  stop_strings, n: int, stream: bool,
                  logprobs: Optional[int] = None,
                  echo: bool = False,
-                 deadline_s: float = 600.0) -> None:
+                 deadline_s: float = 600.0,
+                 adapter: Optional[str] = None,
+                 model: Optional[str] = None) -> None:
         if isinstance(stop_strings, str):
             stop_strings = [stop_strings]
+        if logprobs is not None and adapter is not None:
+            raise ValueError(
+                'logprobs with an adapter model is not supported '
+                '(the scoring pass runs base weights)')
         if n < 1 or n > 16:
             raise ValueError(f'n must be in [1, 16], got {n}')
         if stream and len(prompts) != 1:
@@ -163,6 +169,11 @@ class CompletionRequest:
         # `timeout` field into (0, --request-timeout]); propagated to
         # engine slots so an expired request is reaped mid-decode.
         self.deadline_s = float(deadline_s)
+        # `model` field: the resolved adapter (None = base) and the
+        # name to echo in responses (the OpenAI contract reports the
+        # REQUESTED model, not always the base).
+        self.adapter = adapter
+        self.model = model
 
 
 def _logprobs_block(rt: InferenceRuntime, tok, row: List[int],
@@ -215,6 +226,7 @@ def run_completion(rt: InferenceRuntime, req: CompletionRequest
     rows: List[List[int]] = []
     row_prompt: List[List[int]] = []  # prompt ids per output row
     ttft: Optional[float] = None      # engine path latches first commit
+    engine = rt.engine_for(req.adapter)
     if req.max_new <= 0:
         # Scoring mode (echo + logprobs + max_tokens=0 — the eval-
         # harness contract): no generation at all.
@@ -222,23 +234,24 @@ def run_completion(rt: InferenceRuntime, req: CompletionRequest
             for _ in range(req.n):
                 rows.append(list(ids))
                 row_prompt.append(ids)
-    elif rt.engine is not None:
+    elif engine is not None:
         from skypilot_tpu.observability.catalog import FirstTokenLatch
         latch = FirstTokenLatch()  # non-streaming TTFT: first commit
         futs = []
         try:
             for ids in encoded:
                 for _ in range(req.n):
-                    futs.append(rt.engine.submit(
+                    futs.append(engine.submit(
                         ids, max_new_tokens=req.max_new,
                         temperature=req.temperature, top_p=req.top_p,
-                        on_token=latch, deadline_s=req.deadline_s))
+                        on_token=latch, deadline_s=req.deadline_s,
+                        adapter=req.adapter))
                     row_prompt.append(ids)
         except Exception:
             # A shed submission mid-fan-out: cancel the admitted
             # siblings (they would decode for a 429'd client).
             if futs:
-                rt.engine.cancel(futs)
+                engine.cancel(futs)
             raise
         # Expired requests resolve with DeadlineExceededError from the
         # engine's reaper; the host timeout is only a backstop.
@@ -293,7 +306,7 @@ def run_completion(rt: InferenceRuntime, req: CompletionRequest
                       ttft_s=ttft, n_prompt_tokens=total_prompt)
     return {
         'object': 'text_completion',
-        'model': rt.model_name,
+        'model': req.model or rt.model_name,
         'choices': choices,
         'usage': {
             'prompt_tokens': total_prompt,
@@ -322,10 +335,12 @@ def stream_completion(rt: InferenceRuntime, req: CompletionRequest,
     t0 = time.monotonic()
     handles = [rt.submit_stream(ids, req.max_new, req.temperature,
                                 top_p=req.top_p,
-                                deadline_s=req.deadline_s)
+                                deadline_s=req.deadline_s,
+                                adapter=req.adapter)
                for _ in range(req.n)]
     writer.sse_start()
     obj = 'chat.completion.chunk' if chat else 'text_completion'
+    model_name = req.model or rt.model_name
 
     def chunk(index: int, text: Optional[str],
               finish: Optional[str] = None) -> Dict[str, object]:
@@ -336,12 +351,12 @@ def stream_completion(rt: InferenceRuntime, req: CompletionRequest,
         else:
             c['text'] = text or ''
             c['logprobs'] = None
-        return {'object': obj, 'model': rt.model_name,
+        return {'object': obj, 'model': model_name,
                 'choices': [c]}
 
     if chat:
         for i in range(req.n):
-            writer.sse_send({'object': obj, 'model': rt.model_name,
+            writer.sse_send({'object': obj, 'model': model_name,
                              'choices': [{'index': i,
                                           'delta': {'role': 'assistant'},
                                           'finish_reason': None}]})

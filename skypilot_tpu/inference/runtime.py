@@ -208,10 +208,14 @@ class InferenceRuntime:
                  pipeline_decode: Optional[bool] = None,
                  request_timeout: float = 600.0,
                  max_queue_requests: int = 0,
-                 max_queue_tokens: int = 0) -> None:
+                 max_queue_tokens: int = 0,
+                 adapters=None) -> None:
         import jax
         self.model = model
         self.params = params
+        # Multi-LoRA adapter registry (inference/adapters.py) shared
+        # by every engine in this runtime; None = base model only.
+        self.adapters = adapters
         self.vocab_size = vocab_size
         self.model_name = model_name
         self.max_total_len = max_total_len
@@ -259,6 +263,38 @@ class InferenceRuntime:
         if self.speculative > 0 and temperature == 0.0:
             return self.spec_total
         return self.max_total_len
+
+    # -- model / adapter resolution -----------------------------------------
+    def resolve_model(self, model_field) -> Optional[str]:
+        """Map a request's `model` field to an adapter name (None =
+        the base model). The OpenAI 404 contract is honored even with
+        no adapters configured: an unknown model raises
+        AdapterNotFoundError instead of being silently served by the
+        base model (the pre-LoRA behavior)."""
+        if model_field is None or model_field == '':
+            return None
+        name = str(model_field)
+        if name in (self.model_name, 'base', 'default'):
+            return None
+        if self.adapters is not None and self.adapters.exists(name):
+            return name
+        from skypilot_tpu.robustness.errors import AdapterNotFoundError
+        known = ([self.model_name] +
+                 (self.adapters.inventory()
+                  if self.adapters is not None else []))
+        raise AdapterNotFoundError(
+            f'model {name!r} does not exist (known models: {known})')
+
+    def engine_for(self, adapter: Optional[str] = None):
+        """Engine that can run this request: the main engine, or —
+        for adapter requests in one-shot mode — the lazy stream
+        engine (the one-shot jit buckets have no per-slot LoRA
+        path). None = use the one-shot path."""
+        if self.engine is not None:
+            return self.engine
+        if adapter is not None:
+            return self.stream_engine()
+        return None
 
     # -- tokenizer ----------------------------------------------------------
     def get_tokenizer(self):
@@ -380,7 +416,8 @@ class InferenceRuntime:
                     pipeline_decode=(None if self.speculative
                                      else self._pipeline_decode),
                     max_queue_requests=self._max_queue_requests,
-                    max_queue_tokens=self._max_queue_tokens)
+                    max_queue_tokens=self._max_queue_tokens,
+                    adapter_store=self.adapters)
             return self._stream_engine
 
     def deadline_for(self, req: dict) -> float:
@@ -398,7 +435,8 @@ class InferenceRuntime:
                       temperature: float, top_k: int = 0,
                       top_p: float = 1.0,
                       stop_token_ids: Optional[List[int]] = None,
-                      deadline_s: Optional[float] = None
+                      deadline_s: Optional[float] = None,
+                      adapter: Optional[str] = None
                       ) -> StreamHandle:
         eng = self.stream_engine()
         # Queue must exist before submit; commit-time ITL recording
@@ -409,7 +447,8 @@ class InferenceRuntime:
             top_k=top_k, top_p=top_p, stop_token_ids=stop_token_ids,
             on_token=handle.on_token,
             deadline_s=(self.request_timeout if deadline_s is None
-                        else deadline_s))
+                        else deadline_s),
+            adapter=adapter)
         return handle
 
     def live_engines(self) -> List[object]:
@@ -534,6 +573,22 @@ def build_runtime(args) -> InferenceRuntime:
             print(f'loaded checkpoint step {mgr.latest_step()}',
                   flush=True)
 
+    # Multi-LoRA adapter registry (serve_lm --adapter-dir): scanned
+    # at startup, hot-loaded on demand; every engine in the process
+    # shares the one device store.
+    adapters = None
+    adapter_dir = getattr(args, 'adapter_dir', None)
+    if adapter_dir:
+        from skypilot_tpu.inference.adapters import AdapterRegistry
+        adapters = AdapterRegistry(
+            adapter_dir, model,
+            max_adapters=getattr(args, 'max_adapters', 8),
+            max_rank=getattr(args, 'max_lora_rank', 0))
+        inv = adapters.inventory()
+        print(f'adapter registry: {len(inv)} adapters in '
+              f'{adapter_dir} (max {adapters.max_adapters} '
+              f'device-resident): {inv}', flush=True)
+
     engine_total = (spec_total if args.speculative > 0
                     else args.max_total_len)
     engine = None
@@ -573,7 +628,8 @@ def build_runtime(args) -> InferenceRuntime:
             # engines; --no-pipeline-decode forces it off everywhere.
             pipeline_decode=pipeline_decode,
             max_queue_requests=max_queue_requests,
-            max_queue_tokens=max_queue_tokens)
+            max_queue_tokens=max_queue_tokens,
+            adapter_store=adapters)
 
     return InferenceRuntime(
         model=model, params=params, vocab_size=vocab_size,
@@ -587,4 +643,5 @@ def build_runtime(args) -> InferenceRuntime:
         pipeline_decode=pipeline_decode,
         request_timeout=request_timeout,
         max_queue_requests=max_queue_requests,
-        max_queue_tokens=max_queue_tokens)
+        max_queue_tokens=max_queue_tokens,
+        adapters=adapters)

@@ -48,10 +48,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from skypilot_tpu.models import lora as lora_lib
 from skypilot_tpu.models.generate import sample_tokens
 from skypilot_tpu.observability import catalog as _obs
 from skypilot_tpu.robustness import faults
-from skypilot_tpu.robustness.errors import (DeadlineExceededError,
+from skypilot_tpu.robustness.errors import (AdapterNotFoundError,
+                                            DeadlineExceededError,
                                             EngineDeadError,
                                             QueueSaturatedError)
 
@@ -95,12 +97,20 @@ class PrefixCache:
         self._metrics = metrics  # owning engine's Prometheus bundle
 
     @staticmethod
-    def chain_keys(tokens, page_size: int) -> List[bytes]:
+    def chain_keys(tokens, page_size: int,
+                   salt: bytes = b'') -> List[bytes]:
         """One key per FULL page; key_i commits to ALL tokens through
-        page i, so equal keys imply equal attention history."""
+        page i, so equal keys imply equal attention history. `salt`
+        prefixes the chain (the adapter identity): once LoRA touches
+        the k/v projections, a page's contents depend on WHICH
+        adapter computed it — un-salted keys would serve one tenant's
+        KV pages to another (inference/affinity.py re-derives the
+        same salted keys for LB routing)."""
         import hashlib
         keys = []
         h = hashlib.sha256()
+        if salt:
+            h.update(salt)
         for i in range(len(tokens) // page_size):
             chunk = tokens[i * page_size:(i + 1) * page_size]
             h.update(np.asarray(chunk, np.int32).tobytes())
@@ -173,8 +183,19 @@ class ContinuousBatchingEngine:
                  prefill_budget: int = 0,
                  pipeline_decode: Optional[bool] = None,
                  max_queue_requests: int = 0,
-                 max_queue_tokens: int = 0) -> None:
+                 max_queue_tokens: int = 0,
+                 adapter_store=None) -> None:
         assert max_total_len <= model.config.max_seq_len
+        # Multi-LoRA serving (inference/adapters.py): each slot may
+        # carry an adapter id into the shared dispatch; the model
+        # gathers per-slot A/B factors from the store's stacked
+        # tensors. None = base-model-only engine (no LoRA code runs).
+        if adapter_store is not None and not lora_lib.supports(model):
+            raise ValueError(
+                f'{type(model).__name__} has no LoRA forward path; '
+                f'serve adapters with a Llama-family model or drop '
+                f'--adapter-dir')
+        self.adapter_store = adapter_store
         # Chunked decode: N single-token steps in ONE jitted lax.scan
         # dispatch (the serving analog of the trainer's multi-step) —
         # outputs are BIT-IDENTICAL to step-by-step because the rng
@@ -329,6 +350,10 @@ class ContinuousBatchingEngine:
         # scheduler reaps expired slots between rounds so a
         # deadline-bearing request cannot hold a slot past it.
         self.deadlines = np.zeros((num_slots,), np.float64)
+        # Per-slot adapter: device-store row id (0 = base model) and
+        # the registry name (for refcount release + token metrics).
+        self.slot_adapter = np.zeros((num_slots,), np.int32)
+        self.slot_adapter_name: List[Optional[str]] = [None] * num_slots
         # Prefilling slots in admission order: the scheduler finishes
         # the oldest admission's prefill first (FCFS — completing one
         # prompt starts its decode sooner than round-robining all).
@@ -438,8 +463,11 @@ class ContinuousBatchingEngine:
 
         @functools.partial(jax.jit, donate_argnums=(1,))
         def decode(params, cache, cur_token, pos, temps, top_ks,
-                   top_ps, rng, page_indices=None):
+                   top_ps, rng, page_indices=None, lora=None,
+                   adapter_ids=None):
             extra = {'page_indices': page_indices} if paged else {}
+            if lora is not None:
+                extra.update(lora=lora, adapter_ids=adapter_ids)
             logits, mutated = model.apply(
                 {'params': params, 'cache': cache},
                 cur_token[:, None], positions=pos[:, None], decode=True,
@@ -466,8 +494,11 @@ class ContinuousBatchingEngine:
 
         @functools.partial(jax.jit, donate_argnums=(1,))
         def chunk_decode(params, cache, cur_token, pos, temps, top_ks,
-                         top_ps, rng, page_indices=None):
+                         top_ps, rng, page_indices=None, lora=None,
+                         adapter_ids=None):
             extra = {'page_indices': page_indices} if paged else {}
+            if lora is not None:
+                extra.update(lora=lora, adapter_ids=adapter_ids)
 
             def step(carry, _):
                 cache, tok, pos, rng = carry
@@ -507,9 +538,12 @@ class ContinuousBatchingEngine:
 
         @functools.partial(jax.jit, donate_argnums=(1,))
         def spec_decode(params, cache, chunk, pos, temps, top_ks,
-                        top_ps, rng, page_indices=None):
+                        top_ps, rng, page_indices=None, lora=None,
+                        adapter_ids=None):
             positions = pos[:, None] + jnp.arange(k + 1)[None, :]
             extra = {'page_indices': page_indices} if paged else {}
+            if lora is not None:
+                extra.update(lora=lora, adapter_ids=adapter_ids)
             logits, mutated = model.apply(
                 {'params': params, 'cache': cache}, chunk,
                 positions=positions, decode=True, mutable=['cache'],
@@ -574,18 +608,21 @@ class ContinuousBatchingEngine:
         if self.paged:
 
             @functools.partial(jax.jit, donate_argnums=(1,))
-            def prefill_paged(params, cache, prompt, plen, page_row):
+            def prefill_paged(params, cache, prompt, plen, page_row,
+                              lora=None, adapter_ids=None):
                 # CHUNKED prefill: the whole (padded) prompt in ONE
                 # forward pass; the model writes K/V for every
                 # position (write_kv_chunk). Junk past plen lands in
                 # allocated-but-masked slots or the trash page.
                 # prefill=True: the sequence starts empty, attention
                 # stays chunk-local.
+                extra = ({'lora': lora, 'adapter_ids': adapter_ids}
+                         if lora is not None else {})
                 logits, mutated = model.apply(
                     {'params': params, 'cache': cache},
                     prompt[None, :], positions=positions,
                     decode=True, mutable=['cache'],
-                    page_indices=page_row, prefill=True)
+                    page_indices=page_row, prefill=True, **extra)
                 # The continuation samples from the LAST REAL prompt
                 # position, not the padded tail.
                 last = jax.lax.dynamic_index_in_dim(
@@ -597,7 +634,10 @@ class ContinuousBatchingEngine:
             return prefill_paged
 
         @functools.partial(jax.jit, donate_argnums=(1,))
-        def prefill(params, cache, slot, prompt, plen):
+        def prefill(params, cache, slot, prompt, plen, lora=None,
+                    adapter_ids=None):
+            extra = ({'lora': lora, 'adapter_ids': adapter_ids}
+                     if lora is not None else {})
             row = jax.tree.map(
                 lambda c: jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=0)
                 if c.ndim else c, cache)
@@ -611,7 +651,7 @@ class ContinuousBatchingEngine:
             logits, mutated = model.apply(
                 {'params': params, 'cache': row},
                 prompt[None, :], positions=positions,
-                decode=True, mutable=['cache'], prefill=True)
+                decode=True, mutable=['cache'], prefill=True, **extra)
             row = mutated['cache']
             last = jax.lax.dynamic_index_in_dim(
                 logits[0].astype(jnp.float32), plen - 1, axis=0,
@@ -641,14 +681,16 @@ class ContinuousBatchingEngine:
 
         @functools.partial(jax.jit, donate_argnums=(1,))
         def prefill_suffix(params, cache, suffix, suffix_len, offset,
-                           page_row):
+                           page_row, lora=None, adapter_ids=None):
+            extra = ({'lora': lora, 'adapter_ids': adapter_ids}
+                     if lora is not None else {})
             positions = (offset +
                          jnp.arange(bucket_len, dtype=jnp.int32))[None, :]
             logits, mutated = model.apply(
                 {'params': params, 'cache': cache},
                 suffix[None, :], positions=positions,
                 decode=True, mutable=['cache'],
-                page_indices=page_row, prefill=False)
+                page_indices=page_row, prefill=False, **extra)
             last = jax.lax.dynamic_index_in_dim(
                 logits[0].astype(jnp.float32), suffix_len - 1, axis=0,
                 keepdims=False)
@@ -675,7 +717,9 @@ class ContinuousBatchingEngine:
 
         @functools.partial(jax.jit, donate_argnums=(1,))
         def dense_suffix(params, cache, slot, suffix, suffix_len,
-                         offset):
+                         offset, lora=None, adapter_ids=None):
+            extra = ({'lora': lora, 'adapter_ids': adapter_ids}
+                     if lora is not None else {})
             row = jax.tree.map(
                 lambda c: jax.lax.dynamic_slice_in_dim(c, slot, 1,
                                                        axis=0)
@@ -686,7 +730,7 @@ class ContinuousBatchingEngine:
             logits, mutated = model.apply(
                 {'params': params, 'cache': row},
                 suffix[None, :], positions=positions,
-                decode=True, mutable=['cache'], prefill=False)
+                decode=True, mutable=['cache'], prefill=False, **extra)
             row = mutated['cache']
             last = jax.lax.dynamic_index_in_dim(
                 logits[0].astype(jnp.float32), suffix_len - 1, axis=0,
@@ -708,7 +752,8 @@ class ContinuousBatchingEngine:
                top_k: int = 0, top_p: float = 1.0,
                stop_token_ids: Optional[List[int]] = None,
                on_token: Optional[Callable[[int], None]] = None,
-               deadline_s: Optional[float] = None
+               deadline_s: Optional[float] = None,
+               adapter: Optional[str] = None
                ) -> 'Future':
         """Queue a request; the Future resolves to the full token list
         (prompt ++ generated). `temperature` overrides the engine
@@ -726,6 +771,14 @@ class ContinuousBatchingEngine:
         and EngineDeadError (the scheduler thread died) instead of
         queueing work that cannot be served.
 
+        `adapter` names a LoRA adapter from the engine's adapter
+        store (None = base model): the slot decodes with that
+        adapter's factors gathered into the shared dispatch, its KV
+        pages are keyed per-adapter in the prefix cache, and the
+        adapter stays pinned in the device store until the request
+        leaves its slot. Unknown names raise AdapterNotFoundError
+        here (before queueing).
+
         `on_token` streams: called once per COMMITTED generated token,
         in order, on the scheduler thread — before the Future resolves
         — so it must be fast and non-blocking (push to a queue; don't
@@ -742,6 +795,14 @@ class ContinuousBatchingEngine:
             raise ValueError(f'top_p must be in (0, 1], got {top_p}')
         if top_k < 0:
             raise ValueError(f'top_k must be >= 0, got {top_k}')
+        if adapter is not None:
+            if self.adapter_store is None:
+                raise AdapterNotFoundError(
+                    f'adapter {adapter!r} requested but this engine '
+                    f'has no adapter store (serve_lm --adapter-dir)')
+            # Inventory check only (404 fast); the load happens at
+            # admission on the scheduler thread.
+            self.adapter_store.resolve(adapter)
         with self._shed_lock:
             if self.max_queue_requests and \
                     self._queue.qsize() + len(self._ready) >= \
@@ -764,8 +825,8 @@ class ContinuousBatchingEngine:
         fut: Future = Future()
         self._queue.put((list(prompt), int(max_new_tokens),
                          float(temp), int(top_k), float(top_p),
-                         frozenset(stop_token_ids or ()), on_token,
-                         deadline, fut))
+                         frozenset(stop_token_ids or ()), adapter,
+                         on_token, deadline, fut))
         return fut
 
     def cancel(self, futs) -> None:
@@ -851,6 +912,7 @@ class ContinuousBatchingEngine:
                     self.active[slot] = False
                     self.prefilling[slot] = False
                     self.on_tokens[slot] = None
+                    self._release_adapter(slot)
                     if fut is not None and not fut.done():
                         fut.set_exception(died)
                 self._fail_all_pending(died)
@@ -935,6 +997,7 @@ class ContinuousBatchingEngine:
             self.active[slot] = False
             self.prefilling[slot] = False
             self.on_tokens[slot] = None
+            self._release_adapter(slot)
             if fut is not None:
                 fut.set_exception(e)
         self._prefill_order.clear()
@@ -995,6 +1058,20 @@ class ContinuousBatchingEngine:
             return True
         return False
 
+    def _release_adapter(self, slot: int) -> None:
+        """Unpin the slot's adapter (if any) in the device store and
+        account its committed tokens. Idempotent: the slot's adapter
+        id is cleared on the first call."""
+        aid = int(self.slot_adapter[slot])
+        if not aid:
+            return
+        self.slot_adapter[slot] = 0
+        self.slot_adapter_name[slot] = None
+        if self.adapter_store is not None:
+            n_gen = max(len(self.outputs[slot]) -
+                        int(self.prompt_len[slot]), 0)
+            self.adapter_store.release(aid, tokens=n_gen)
+
     def _fail_slot(self, slot: int, e: Exception) -> None:
         """Fail ONE slot's request (crash-only isolation): release its
         resources, resolve its future with `e`, keep every other slot
@@ -1004,6 +1081,7 @@ class ContinuousBatchingEngine:
         self.active[slot] = False
         self.on_tokens[slot] = None
         self.deadlines[slot] = 0.0
+        self._release_adapter(slot)
         if self.prefilling[slot]:
             self.prefilling[slot] = False
             try:
@@ -1061,8 +1139,8 @@ class ContinuousBatchingEngine:
             except queue.Empty:
                 break
         while self._ready and not self._occupied().all():
-            (prompt, max_new, temp, top_k, top_p, stops, on_token,
-             deadline, fut) = self._ready.popleft()
+            (prompt, max_new, temp, top_k, top_p, stops, adapter,
+             on_token, deadline, fut) = self._ready.popleft()
             self._queued_tokens_sub(len(prompt))
             if deadline and time.monotonic() > deadline:
                 # Expired while queued: prefilling it would only delay
@@ -1075,6 +1153,30 @@ class ContinuousBatchingEngine:
                 fut.set_result(list(prompt))  # nothing to generate
                 continue
             slot = int(np.argmin(self._occupied()))  # first free slot
+            # Adapter resolution BEFORE page work: the store pins
+            # (refcounts) the adapter for this slot's lifetime and
+            # the prefix-cache keys below are salted with it.
+            aid = 0
+            salt = b''
+            if adapter is not None:
+                try:
+                    aid = self.adapter_store.acquire(adapter)
+                except Exception as e:  # pylint: disable=broad-except
+                    # Missing/corrupt artifact or an injected
+                    # adapters.load fault: fail THIS request (404/503
+                    # at the HTTP layer); the engine keeps serving.
+                    fut.set_exception(e)
+                    continue
+                if aid is None:
+                    # Every device adapter slot is pinned by a running
+                    # request: back to the HEAD (the page-pressure
+                    # back-pressure contract) until one frees.
+                    self._queued_tokens_add(len(prompt))
+                    self._ready.appendleft(
+                        (prompt, max_new, temp, top_k, top_p, stops,
+                         adapter, on_token, deadline, fut))
+                    break
+                salt = self.adapter_store.cache_salt(adapter)
             plen = len(prompt)
             shared: List[int] = []
             keys: List[bytes] = []
@@ -1085,7 +1187,9 @@ class ContinuousBatchingEngine:
                 # continuation samples from its logits), so a fully
                 # cached prompt drops its last shared page.
                 if self.prefix_cache is not None:
-                    keys = PrefixCache.chain_keys(prompt, self.page_size)
+                    keys = PrefixCache.chain_keys(prompt,
+                                                  self.page_size,
+                                                  salt=salt)
                     shared = self.prefix_cache.lookup_acquire(keys)
                     if len(shared) * self.page_size >= plen:
                         self.prefix_cache.release([shared.pop()])
@@ -1109,10 +1213,12 @@ class ContinuousBatchingEngine:
                     # later arrivals must not starve this one.
                     if self.prefix_cache is not None:
                         self.prefix_cache.release(shared)
+                    if aid:
+                        self.adapter_store.release(aid)
                     self._queued_tokens_add(len(prompt))
                     self._ready.appendleft(
                         (prompt, max_new, temp, top_k, top_p, stops,
-                         on_token, deadline, fut))
+                         adapter, on_token, deadline, fut))
                     break
                 pages = self.allocator.allocate(need)
                 self.owned_pages[slot] = pages
@@ -1153,6 +1259,8 @@ class ContinuousBatchingEngine:
             self.stop_ids[slot] = stops
             self.on_tokens[slot] = on_token
             self.deadlines[slot] = deadline
+            self.slot_adapter[slot] = aid
+            self.slot_adapter_name[slot] = adapter if aid else None
             self.prefilling[slot] = True
             self._prefill_order.append(slot)
             self._prefill_t0[slot] = time.perf_counter()
@@ -1190,27 +1298,28 @@ class ContinuousBatchingEngine:
         shape = self._chunk_shape(n, offset)
         chunk = self.outputs[slot][offset:offset + n]
         padded = jnp.asarray(chunk + [0] * (shape - n), jnp.int32)
+        lora_kw = self._slot_lora_args(slot)
         if self.paged and offset:
             fn = self._prefill_suffix_fn(shape)
             self.cache, last = fn(
                 self.params, self.cache, padded, jnp.int32(n),
                 jnp.int32(offset),
-                jnp.asarray(self.page_table[slot:slot + 1]))
+                jnp.asarray(self.page_table[slot:slot + 1]), **lora_kw)
         elif self.paged:
             fn = self._prefill_fn(shape)
             self.cache, last = fn(
                 self.params, self.cache, padded, jnp.int32(n),
-                jnp.asarray(self.page_table[slot:slot + 1]))
+                jnp.asarray(self.page_table[slot:slot + 1]), **lora_kw)
         elif offset:
             fn = self._dense_suffix_fn(shape)
             self.cache, last = fn(
                 self.params, self.cache, jnp.int32(slot), padded,
-                jnp.int32(n), jnp.int32(offset))
+                jnp.int32(n), jnp.int32(offset), **lora_kw)
         else:
             fn = self._prefill_fn(shape)
             self.cache, last = fn(
                 self.params, self.cache, jnp.int32(slot), padded,
-                jnp.int32(n))
+                jnp.int32(n), **lora_kw)
         self.prefill_chunks_run += 1
         return last
 
@@ -1342,13 +1451,17 @@ class ContinuousBatchingEngine:
             if not exhausted:
                 continue
             # Preempt: outputs-so-far become the prompt; the pending
-            # cur_token is regenerated by the re-prefill.
+            # cur_token is regenerated by the re-prefill. The adapter
+            # ref drops with the slot (re-acquired — and reloaded if
+            # evicted meanwhile — at re-admission).
             fut = self.futures[slot]
+            adapter_name = self.slot_adapter_name[slot]
             remaining = int(self.limits[slot]) - len(self.outputs[slot])
             self.futures[slot] = None
             self.active[slot] = False
             self.preemptions += 1
             self.metrics.preemptions.inc()
+            self._release_adapter(slot)
             self._release_slot_pages(slot, promote=False)
             if fut is not None:
                 preempted.append((list(self.outputs[slot]),
@@ -1357,6 +1470,7 @@ class ContinuousBatchingEngine:
                                   int(self.top_ks[slot]),
                                   float(self.top_ps[slot]),
                                   self.stop_ids[slot],
+                                  adapter_name,
                                   self.on_tokens[slot],
                                   float(self.deadlines[slot]), fut))
                 self._queued_tokens_add(len(self.outputs[slot]))
@@ -1418,6 +1532,7 @@ class ContinuousBatchingEngine:
         self.active[slot] = False
         self.on_tokens[slot] = None
         self.deadlines[slot] = 0.0
+        self._release_adapter(slot)
         was_prefilling = bool(self.prefilling[slot])
         if was_prefilling:
             # Cancelled mid-prefill: resolve with the prompt as-is
@@ -1457,6 +1572,26 @@ class ContinuousBatchingEngine:
             self._finish_slot(slot)
         return done
 
+    def _lora_args(self) -> Dict[str, Any]:
+        """Extra kwargs for a SHARED decode dispatch: the stacked
+        adapter factors + per-slot adapter ids. {} when every lane is
+        the base model — the zero-overhead fast path (the compiled
+        base-only executables run untouched; the first adapter lane
+        traces a second variant once)."""
+        if self.adapter_store is None or not self.slot_adapter.any():
+            return {}
+        return {'lora': self.adapter_store.model_lora(),
+                'adapter_ids': jnp.asarray(self.slot_adapter,
+                                           jnp.int32)}
+
+    def _slot_lora_args(self, slot: int) -> Dict[str, Any]:
+        """Extra kwargs for a batch-1 prefill dispatch of `slot`."""
+        aid = int(self.slot_adapter[slot])
+        if not aid:
+            return {}
+        return {'lora': self.adapter_store.model_lora(),
+                'adapter_ids': jnp.asarray([aid], jnp.int32)}
+
     def _decode_step(self) -> None:
         # Injection point BEFORE any dispatch and before the round
         # consumes RNG: a raised fault leaves state untouched, so the
@@ -1489,7 +1624,8 @@ class ContinuousBatchingEngine:
             self.params, self.cache,
             jnp.asarray(self.cur_token), jnp.asarray(self.pos),
             jnp.asarray(self.temps), jnp.asarray(self.top_ks),
-            jnp.asarray(self.top_ps), sub, *extra)
+            jnp.asarray(self.top_ps), sub, *extra,
+            **self._lora_args())
         sampled = self._fetch_tokens(sampled)
         self.decode_calls += 1
         self.metrics.decode_steps.inc()
@@ -1544,7 +1680,8 @@ class ContinuousBatchingEngine:
         self.cache, sampled = self._decode(
             self.params, self.cache, cur, jnp.asarray(pos),
             jnp.asarray(self.temps), jnp.asarray(self.top_ks),
-            jnp.asarray(self.top_ps), sub, *extra)
+            jnp.asarray(self.top_ps), sub, *extra,
+            **self._lora_args())
         self.decode_calls += 1
         self.metrics.decode_steps.inc()
         return {'sampled': sampled, 'mask': self.active.copy(),
@@ -1597,7 +1734,7 @@ class ContinuousBatchingEngine:
             self.params, self.cache, jnp.asarray(self.cur_token),
             jnp.asarray(self.pos), jnp.asarray(self.temps),
             jnp.asarray(self.top_ks), jnp.asarray(self.top_ps),
-            self._rng, *extra)
+            self._rng, *extra, **self._lora_args())
         toks = self._fetch_tokens(toks)               # [n, slots]
         self.decode_calls += 1
         self.metrics.decode_steps.inc()
@@ -1630,7 +1767,7 @@ class ContinuousBatchingEngine:
             self.params, self.cache, jnp.asarray(chunk),
             jnp.asarray(self.pos), jnp.asarray(self.temps),
             jnp.asarray(self.top_ks), jnp.asarray(self.top_ps), sub,
-            *extra)
+            *extra, **self._lora_args())
         y = self._fetch_tokens(y)                      # [slots, K+1]
         self.decode_calls += 1
         self.metrics.decode_steps.inc()

@@ -14,6 +14,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from skypilot_tpu.models import lora as lora_lib
 from skypilot_tpu.ops import attention as attention_ops
 
 Dtype = Any
@@ -153,19 +154,33 @@ class Attention(nn.Module):
     def __call__(self, x: jax.Array, positions: jax.Array,
                  decode: bool = False,
                  page_indices: Optional[jax.Array] = None,
-                 prefill: bool = False) -> jax.Array:
+                 prefill: bool = False,
+                 lora: Optional[dict] = None,
+                 adapter_ids: Optional[jax.Array] = None,
+                 lora_scale: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.config
         batch, seq, _ = x.shape
         hd = cfg.head_dim
-        q = _proj(cfg.num_heads * hd, ('embed', 'heads'), cfg.dtype,
-                  'wq', cfg.qkv_bias)(x).reshape(
-                      batch, seq, cfg.num_heads, hd)
-        k = _proj(cfg.num_kv_heads * hd, ('embed', 'heads'), cfg.dtype,
-                  'wk', cfg.qkv_bias)(x).reshape(
-                      batch, seq, cfg.num_kv_heads, hd)
-        v = _proj(cfg.num_kv_heads * hd, ('embed', 'heads'), cfg.dtype,
-                  'wv', cfg.qkv_bias)(x).reshape(
-                      batch, seq, cfg.num_kv_heads, hd)
+
+        def _lora(name, y, inp):
+            # LoRA delta on a projection output (models/lora.py):
+            # single-adapter in training, per-row adapter gather in
+            # the serving engine. No-op (and no extra compute) when
+            # this layer/projection carries no adapter factors.
+            if lora is None or name not in lora:
+                return y
+            return lora_lib.apply_delta(y, inp, lora[name],
+                                        adapter_ids, lora_scale)
+
+        q = _lora('wq', _proj(cfg.num_heads * hd, ('embed', 'heads'),
+                              cfg.dtype, 'wq', cfg.qkv_bias)(x),
+                  x).reshape(batch, seq, cfg.num_heads, hd)
+        k = _lora('wk', _proj(cfg.num_kv_heads * hd, ('embed', 'heads'),
+                              cfg.dtype, 'wk', cfg.qkv_bias)(x),
+                  x).reshape(batch, seq, cfg.num_kv_heads, hd)
+        v = _lora('wv', _proj(cfg.num_kv_heads * hd, ('embed', 'heads'),
+                              cfg.dtype, 'wv', cfg.qkv_bias)(x),
+                  x).reshape(batch, seq, cfg.num_kv_heads, hd)
         q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
         k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
 
@@ -257,20 +272,38 @@ class Attention(nn.Module):
                                            ('batch', 'seq', 'heads', 'kv'))
             out = attention_ops.dot_product_attention(q, k, v, causal=True)
         out = out.reshape(batch, seq, cfg.num_heads * hd)
-        return _proj(cfg.embed_dim, ('heads', 'embed'), cfg.dtype, 'wo')(out)
+        return _lora('wo',
+                     _proj(cfg.embed_dim, ('heads', 'embed'), cfg.dtype,
+                           'wo')(out), out)
 
 
 class FeedForward(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array,
+                 lora: Optional[dict] = None,
+                 adapter_ids: Optional[jax.Array] = None,
+                 lora_scale: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.config
-        gate = _proj(cfg.mlp_dim, ('embed', 'mlp'), cfg.dtype, 'w_gate')(x)
-        up = _proj(cfg.mlp_dim, ('embed', 'mlp'), cfg.dtype, 'w_up')(x)
+
+        def _lora(name, y, inp):
+            if lora is None or name not in lora:
+                return y
+            return lora_lib.apply_delta(y, inp, lora[name],
+                                        adapter_ids, lora_scale)
+
+        gate = _lora('w_gate',
+                     _proj(cfg.mlp_dim, ('embed', 'mlp'), cfg.dtype,
+                           'w_gate')(x), x)
+        up = _lora('w_up',
+                   _proj(cfg.mlp_dim, ('embed', 'mlp'), cfg.dtype,
+                         'w_up')(x), x)
         h = nn.silu(gate) * up
         h = nn.with_logical_constraint(h, ('batch', 'seq', 'mlp'))
-        return _proj(cfg.embed_dim, ('mlp', 'embed'), cfg.dtype, 'w_down')(h)
+        return _lora('w_down',
+                     _proj(cfg.embed_dim, ('mlp', 'embed'), cfg.dtype,
+                           'w_down')(h), h)
 
 
 class Block(nn.Module):
@@ -280,13 +313,17 @@ class Block(nn.Module):
     def __call__(self, x: jax.Array, positions: jax.Array,
                  decode: bool = False,
                  page_indices: Optional[jax.Array] = None,
-                 prefill: bool = False) -> jax.Array:
+                 prefill: bool = False,
+                 lora: Optional[dict] = None,
+                 adapter_ids: Optional[jax.Array] = None,
+                 lora_scale: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.config
         x = x + Attention(cfg, name='attn')(
             RMSNorm(cfg.norm_eps, cfg.dtype, name='attn_norm')(x), positions,
-            decode, page_indices, prefill)
+            decode, page_indices, prefill, lora, adapter_ids, lora_scale)
         x = x + FeedForward(cfg, name='mlp')(
-            RMSNorm(cfg.norm_eps, cfg.dtype, name='mlp_norm')(x))
+            RMSNorm(cfg.norm_eps, cfg.dtype, name='mlp_norm')(x),
+            lora, adapter_ids, lora_scale)
         return nn.with_logical_constraint(x, ('batch', 'seq', 'act_embed'))
 
 
@@ -327,9 +364,17 @@ class Llama(nn.Module):
                  decode: bool = False,
                  page_indices: Optional[jax.Array] = None,
                  prefill: bool = False,
-                 return_hidden: bool = False) -> jax.Array:
+                 return_hidden: bool = False,
+                 lora: Optional[dict] = None,
+                 adapter_ids: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.config
         batch, seq = tokens.shape
+        # `lora` = {'scale': f32, 'layers': {'layer_i': {target:
+        # {'a', 'b'}}}} (models/lora.py). Per-layer factors thread
+        # into each block; `adapter_ids` [batch] selects each row's
+        # adapter from stacked factors (None = single-adapter mode).
+        lora_scale = lora['scale'] if lora is not None else None
+        lora_layers = lora['layers'] if lora is not None else {}
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(seq), (batch, seq))
         embed = self.param(
@@ -346,7 +391,9 @@ class Llama(nn.Module):
                              static_argnums=(3, 5))
         for i in range(cfg.num_layers):
             x = block(cfg, name=f'layer_{i}')(x, positions, decode,
-                                              page_indices, prefill)
+                                              page_indices, prefill,
+                                              lora_layers.get(f'layer_{i}'),
+                                              adapter_ids, lora_scale)
         x = RMSNorm(cfg.norm_eps, cfg.dtype, name='final_norm')(x)
         head = self.param(
             'lm_head',

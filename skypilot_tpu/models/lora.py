@@ -1,0 +1,283 @@
+"""LoRA (low-rank adaptation) for the Llama-family models.
+
+One module, three consumers:
+
+  - TRAINING (`train_lm --lora RANK`, parallel/train.py): the base
+    params are frozen and only the per-projection A/B factors train.
+    The model applies `y = Wx + (alpha/rank) * B^T A^T x` inside the
+    forward pass (single-adapter mode: 2-D factors, no per-row
+    gather), so the guard / checkpoint / ZeRO machinery sees a
+    normal params pytree `{'base': ..., 'lora': ...}`.
+  - SERVING (inference/adapters.py + models/batching.py): adapters
+    live device-resident as STACKED `[n_slots_of_adapters, d, r]`
+    factors; every engine decode slot carries an `adapter_id` row
+    index and the forward gathers each row's factors into a batched
+    matmul — one dispatch serves many adapters. Row 0 is all-zeros
+    (the base model), so base and adapter requests share a round.
+  - ARTIFACTS: `save_adapter`/`load_adapter` write and read the
+    on-disk format (`adapter_config.json` + `adapter_weights.npz`)
+    that `train_lm --lora` produces and the serving registry loads
+    unmodified — the produce-then-serve loop.
+
+Factor orientation matches the flax Dense kernels they adapt:
+`a: [d_in, rank]`, `b: [rank, d_out]`, delta `W' = W + a @ b * scale`
+with `scale = alpha / rank`. `a` initializes from a small normal and
+`b` from zeros, so step 0 is exactly the base model.
+"""
+from __future__ import annotations
+
+import dataclasses
+import inspect
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+ATTN_TARGETS: Tuple[str, ...] = ('wq', 'wk', 'wv', 'wo')
+MLP_TARGETS: Tuple[str, ...] = ('w_gate', 'w_up', 'w_down')
+ALL_TARGETS: Tuple[str, ...] = ATTN_TARGETS + MLP_TARGETS
+
+#: Which Block submodule owns each projection (merge_lora walks the
+#: real param tree with this).
+_TARGET_MODULE = {t: 'attn' for t in ATTN_TARGETS}
+_TARGET_MODULE.update({t: 'mlp' for t in MLP_TARGETS})
+
+CONFIG_FILE = 'adapter_config.json'
+WEIGHTS_FILE = 'adapter_weights.npz'
+
+
+@dataclasses.dataclass(frozen=True)
+class LoraSpec:
+    """Rank/alpha/target-set of one adapter (or one training run)."""
+    rank: int
+    alpha: float
+    targets: Tuple[str, ...] = ATTN_TARGETS
+
+    def __post_init__(self):
+        if self.rank < 1:
+            raise ValueError(f'lora rank must be >= 1, got {self.rank}')
+        unknown = [t for t in self.targets if t not in ALL_TARGETS]
+        if unknown:
+            raise ValueError(
+                f'unknown lora targets {unknown}; valid: {ALL_TARGETS}')
+
+    @property
+    def scale(self) -> float:
+        return float(self.alpha) / float(self.rank)
+
+
+def targets_from_name(name: str) -> Tuple[str, ...]:
+    """CLI sugar: 'attn' | 'attn-mlp'/'all' -> target tuple."""
+    if name == 'attn':
+        return ATTN_TARGETS
+    if name in ('attn-mlp', 'all'):
+        return ALL_TARGETS
+    if name == 'mlp':
+        return MLP_TARGETS
+    raise ValueError(f'unknown lora target set {name!r} '
+                     f'(use attn | mlp | attn-mlp)')
+
+
+def supports(model) -> bool:
+    """True when `model` threads the `lora` kwarg through its forward
+    pass AND its config exposes the Llama-family projection geometry
+    (`projection_shapes` below)."""
+    try:
+        sig = inspect.signature(type(model).__call__)
+    except (TypeError, ValueError):
+        return False
+    if 'lora' not in sig.parameters:
+        return False
+    try:
+        projection_shapes(model.config)
+    except (AttributeError, ValueError):
+        return False
+    return True
+
+
+def projection_shapes(cfg) -> Dict[str, Tuple[int, int]]:
+    """(d_in, d_out) per adaptable projection for a Llama-family
+    config (llama / qwen tiers share the geometry)."""
+    hd = cfg.embed_dim // cfg.num_heads
+    return {
+        'wq': (cfg.embed_dim, cfg.num_heads * hd),
+        'wk': (cfg.embed_dim, cfg.num_kv_heads * hd),
+        'wv': (cfg.embed_dim, cfg.num_kv_heads * hd),
+        'wo': (cfg.num_heads * hd, cfg.embed_dim),
+        'w_gate': (cfg.embed_dim, cfg.mlp_dim),
+        'w_up': (cfg.embed_dim, cfg.mlp_dim),
+        'w_down': (cfg.mlp_dim, cfg.embed_dim),
+    }
+
+
+def adapter_num_bytes(cfg, rank: int, targets: Tuple[str, ...],
+                      bytes_per_elem: int = 4) -> int:
+    """Device bytes ONE adapter occupies in the stacked store — the
+    memory-budget math behind `--max-adapters` (docs/guides.md)."""
+    shapes = projection_shapes(cfg)
+    per_layer = sum((d_in + d_out) * rank
+                    for t, (d_in, d_out) in shapes.items()
+                    if t in targets)
+    return per_layer * cfg.num_layers * bytes_per_elem
+
+
+# -- parameter construction -------------------------------------------------
+def init_lora_params(rng, cfg, spec: LoraSpec):
+    """Fresh trainable factors: a ~ N(0, 0.02), b = 0 (step 0 == base
+    model). Layout: {'layer_i': {target: {'a': [d_in, r],
+    'b': [r, d_out]}}} in f32 (the trained dtype)."""
+    import jax
+    import jax.numpy as jnp
+    shapes = projection_shapes(cfg)
+    params: Dict[str, Any] = {}
+    for i in range(cfg.num_layers):
+        layer: Dict[str, Any] = {}
+        for t in spec.targets:
+            d_in, d_out = shapes[t]
+            rng, sub = jax.random.split(rng)
+            layer[t] = {
+                'a': jax.random.normal(sub, (d_in, spec.rank),
+                                       jnp.float32) * 0.02,
+                'b': jnp.zeros((spec.rank, d_out), jnp.float32),
+            }
+        params[f'layer_{i}'] = layer
+    return params
+
+
+def random_adapter_params(seed: int, cfg, spec: LoraSpec
+                          ) -> Dict[str, Any]:
+    """Numpy-only random adapter (BOTH factors non-zero, so the delta
+    is non-trivial) — benchmark/test artifact generation without
+    touching the training path."""
+    rng = np.random.default_rng(seed)
+    shapes = projection_shapes(cfg)
+    params: Dict[str, Any] = {}
+    for i in range(cfg.num_layers):
+        layer: Dict[str, Any] = {}
+        for t in spec.targets:
+            d_in, d_out = shapes[t]
+            layer[t] = {
+                'a': rng.normal(0, 0.02, (d_in, spec.rank)
+                                ).astype(np.float32),
+                'b': rng.normal(0, 0.02, (spec.rank, d_out)
+                                ).astype(np.float32),
+            }
+        params[f'layer_{i}'] = layer
+    return params
+
+
+def as_model_lora(lora_params, scale):
+    """Wrap raw per-layer factors into the pytree the model forward
+    consumes: {'scale': f32 scalar, 'layers': {...}}."""
+    import jax.numpy as jnp
+    return {'scale': jnp.asarray(scale, jnp.float32),
+            'layers': lora_params}
+
+
+def apply_delta(y, x, factors, adapter_ids, scale):
+    """y + scale * ((x @ a) @ b), computed in f32.
+
+    Single-adapter mode (`adapter_ids is None`): `a: [d_in, r]`,
+    `b: [r, d_out]` apply to every row — the training path.
+
+    Batched mode: `a: [N, d_in, r]`, `b: [N, r, d_out]` stacked per
+    device adapter slot; `adapter_ids: [batch]` gathers each row's
+    factors into a batched matmul, so one dispatch serves many
+    adapters (row 0 is all-zeros = the base model).
+    """
+    import jax.numpy as jnp
+    a, b = factors['a'], factors['b']
+    xf = x.astype(jnp.float32)
+    if adapter_ids is None:
+        h = jnp.einsum('bsd,dr->bsr', xf, a.astype(jnp.float32))
+        delta = jnp.einsum('bsr,ro->bso', h, b.astype(jnp.float32))
+    else:
+        ai = a[adapter_ids].astype(jnp.float32)     # [B, d_in, r]
+        bi = b[adapter_ids].astype(jnp.float32)     # [B, r, d_out]
+        h = jnp.einsum('bsd,bdr->bsr', xf, ai)
+        delta = jnp.einsum('bsr,bro->bso', h, bi)
+    return y + (scale * delta).astype(y.dtype)
+
+
+def merge_lora(params, lora_params, spec: LoraSpec):
+    """Merged-weights copy of `params`: every adapted kernel becomes
+    W + a @ b * scale. The parity oracle — batched per-slot LoRA in
+    the engine must reproduce this forward exactly (fp32 tolerance);
+    also the zero-serving-overhead deployment form for ONE adapter."""
+    import jax
+    import jax.numpy as jnp
+    merged = jax.tree.map(lambda x: x, params)  # shallow-ish copy
+    for layer_name, layer in lora_params.items():
+        for t, factors in layer.items():
+            module = _TARGET_MODULE[t]
+            kern = merged[layer_name][module][t]['kernel']
+            delta = (jnp.asarray(factors['a'], jnp.float32) @
+                     jnp.asarray(factors['b'], jnp.float32)) * spec.scale
+            merged[layer_name][module][t]['kernel'] = (
+                kern.astype(jnp.float32) + delta).astype(kern.dtype)
+    return merged
+
+
+# -- artifacts --------------------------------------------------------------
+def save_adapter(out_dir: str, lora_params, spec: LoraSpec, *,
+                 base_model: str, step: Optional[int] = None) -> str:
+    """Write the adapter artifact the serving registry loads
+    unmodified: `adapter_config.json` + `adapter_weights.npz`
+    (flattened `layer_i/target/a|b` keys)."""
+    os.makedirs(out_dir, exist_ok=True)
+    flat: Dict[str, np.ndarray] = {}
+    for layer_name, layer in lora_params.items():
+        for t, factors in layer.items():
+            flat[f'{layer_name}/{t}/a'] = np.asarray(factors['a'],
+                                                     np.float32)
+            flat[f'{layer_name}/{t}/b'] = np.asarray(factors['b'],
+                                                     np.float32)
+    np.savez(os.path.join(out_dir, WEIGHTS_FILE), **flat)
+    config = {
+        'format': 'skypilot-tpu-lora-v1',
+        'base_model': base_model,
+        'rank': spec.rank,
+        'alpha': spec.alpha,
+        'targets': list(spec.targets),
+        'num_layers': len(lora_params),
+    }
+    if step is not None:
+        config['step'] = int(step)
+    # Atomic-ish: weights land before the config that announces them
+    # (a scanner never sees a config without loadable weights).
+    with open(os.path.join(out_dir, CONFIG_FILE), 'w',
+              encoding='utf-8') as f:
+        json.dump(config, f, indent=2)
+    return out_dir
+
+
+def load_adapter(path: str) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """(config, per-layer factors) from an artifact directory."""
+    with open(os.path.join(path, CONFIG_FILE), encoding='utf-8') as f:
+        config = json.load(f)
+    params: Dict[str, Any] = {}
+    with np.load(os.path.join(path, WEIGHTS_FILE)) as z:
+        for key in z.files:
+            layer_name, t, which = key.split('/')
+            params.setdefault(layer_name, {}).setdefault(t, {})[which] \
+                = z[key]
+    return config, params
+
+
+def load_spec(config: Dict[str, Any]) -> LoraSpec:
+    return LoraSpec(rank=int(config['rank']),
+                    alpha=float(config['alpha']),
+                    targets=tuple(config['targets']))
+
+
+def list_adapter_dirs(adapter_dir: str) -> List[str]:
+    """Subdirectories of `adapter_dir` that hold an adapter artifact
+    (name = directory basename)."""
+    if not os.path.isdir(adapter_dir):
+        return []
+    out = []
+    for name in sorted(os.listdir(adapter_dir)):
+        if os.path.isfile(os.path.join(adapter_dir, name, CONFIG_FILE)):
+            out.append(name)
+    return out

@@ -95,6 +95,27 @@ SPECS: Dict[str, Tuple] = {
         'counter', 'Full engine resets after an unrecoverable '
                    'scheduler error (KV cache lost; in-flight '
                    'requests failed, slots rebuilt)', ('engine',)),
+    # -- multi-LoRA adapter registry (inference/adapters.py)
+    'skypilot_serving_adapters_loaded': (
+        'gauge', 'Adapters resident in the device store (loaded '
+                 'stack rows, pinned or LRU-evictable)', ()),
+    'skypilot_serving_adapter_requests_total': (
+        'counter', 'Requests admitted per adapter (the `model` field '
+                   'routed to a LoRA adapter)', ('adapter',)),
+    'skypilot_serving_adapter_tokens_total': (
+        'counter', 'Generated tokens committed per adapter',
+        ('adapter',)),
+    'skypilot_serving_adapter_loads_total': (
+        'counter', 'Adapter artifacts loaded into the device store '
+                   '(cold or re-load after eviction)', ('adapter',)),
+    'skypilot_serving_adapter_evictions_total': (
+        'counter', 'Unpinned adapters LRU-evicted from the device '
+                   'store to make room for a load', ('adapter',)),
+    'skypilot_serving_adapter_load_failures_total': (
+        'counter', 'Adapter loads that failed (corrupt artifact, '
+                   'rank/shape mismatch, or injected adapters.load '
+                   'fault); the request fails 503, the engine keeps '
+                   'serving', ()),
     # -- serving request path (inference/runtime.py + http_server.py)
     'skypilot_serving_requests_total': (
         'counter', 'Completed generation requests', ()),

@@ -18,6 +18,7 @@ import optax
 from flax import struct
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from skypilot_tpu.models import lora as lora_lib
 from skypilot_tpu.ops import fused_xent
 from skypilot_tpu.parallel import mesh as mesh_lib
 
@@ -105,6 +106,15 @@ class ShardedTrainer:
     grads into the shards and all-gathers the updated params — the
     step math (and loss curve) is unchanged.
 
+    `lora` (models/lora.py LoraSpec) turns the run into a LoRA
+    finetune: the params pytree becomes `{'base': ..., 'lora': ...}`,
+    the base half is frozen (stop_gradient in the loss + a zeroed
+    optimizer partition with NO Adam moments allocated for it), and
+    only the per-projection A/B factors train. Guard, checkpoint,
+    multi-step, and ZeRO-1 paths see an ordinary params pytree and
+    work unchanged; `train_lm --lora` saves the trained factors as a
+    serving-ready adapter artifact.
+
     `guard` arms the self-supervising bad-step guard
     (robustness/train_guard.py): the train step takes an extra
     `ctl = [max_grad_norm, loss_scale]` array, flags the step bad ON
@@ -127,10 +137,33 @@ class ShardedTrainer:
                  fused_xent: Optional[bool] = None,
                  zero1: bool = False,
                  collect_grad_norm: bool = False,
-                 guard: bool = False) -> None:
+                 guard: bool = False,
+                 lora: Optional[lora_lib.LoraSpec] = None) -> None:
         self.model = model
         self.mesh = mesh
         self.tx = tx if tx is not None else default_optimizer()
+        self.lora = lora
+        if lora is not None:
+            if not lora_lib.supports(model):
+                raise ValueError(
+                    f'{type(model).__name__} has no LoRA forward '
+                    f'path; --lora supports the Llama family '
+                    f'(models/lora.py)')
+            # Freeze the base: its partition of the optimizer emits
+            # zero updates and allocates NO moments (optax.masked
+            # replaces frozen leaves with MaskedNode at init), so
+            # checkpoints and ZeRO-1 sharding cover only what trains.
+            base_tx = self.tx
+
+            def _labels(params):
+                return {'base': jax.tree.map(lambda _: 'base',
+                                             params['base']),
+                        'lora': jax.tree.map(lambda _: 'lora',
+                                             params['lora'])}
+
+            self.tx = optax.multi_transform(
+                {'lora': base_tx, 'base': optax.set_to_zero()},
+                _labels)
         self.rules = rules
         self.loss_fn = loss_fn
         self.zero1 = zero1
@@ -151,13 +184,28 @@ class ShardedTrainer:
         self.batch_sharding = mesh_lib.batch_sharding(mesh)
         self._state_sharding: Optional[Any] = None
 
+    def _full_params(self, rng: jax.Array, example_tokens: jax.Array
+                     ) -> Any:
+        """The trainable params pytree: the model's init, wrapped as
+        {'base', 'lora'} when LoRA-finetuning (fresh factors: a ~
+        N(0, .02), b = 0, so step 0 is exactly the base model)."""
+        params = self.model.init(rng, example_tokens)['params']
+        if self.lora is not None:
+            params = {
+                'base': params,
+                'lora': lora_lib.init_lora_params(
+                    jax.random.fold_in(rng, 7), self.model.config,
+                    self.lora),
+            }
+        return params
+
     # -- sharding inference -------------------------------------------------
     def state_sharding(self, example_tokens: jax.Array) -> Any:
         if self._state_sharding is None:
             abstract = jax.eval_shape(
                 lambda: TrainState.create(
-                    self.model.init(jax.random.PRNGKey(0), example_tokens)
-                    ['params'],
+                    self._full_params(jax.random.PRNGKey(0),
+                                      example_tokens),
                     self.tx))
             specs = nn.get_partition_spec(abstract)
             sharding = nn.logical_to_mesh_sharding(
@@ -215,7 +263,7 @@ class ShardedTrainer:
         sharding = self.state_sharding(example_tokens)
 
         def _init() -> TrainState:
-            params = self.model.init(rng, example_tokens)['params']
+            params = self._full_params(rng, example_tokens)
             params = jax.tree.map(
                 lambda x: x.unbox() if isinstance(x, nn.Partitioned) else x,
                 params,
@@ -229,17 +277,27 @@ class ShardedTrainer:
 
     # -- step ---------------------------------------------------------------
     def _compute_loss(self, params: Any, tokens: jax.Array) -> jax.Array:
+        extra = {}
+        model_params = params
+        if self.lora is not None:
+            # Frozen base: stop_gradient prunes the base backward
+            # pass entirely — grads flow only into the A/B factors
+            # applied inside the forward (models/lora.py).
+            model_params = jax.lax.stop_gradient(params['base'])
+            extra = {'lora': lora_lib.as_model_lora(params['lora'],
+                                                    self.lora.scale)}
         if self.fused_xent:
-            out = self.model.apply({'params': params}, tokens,
-                                   return_hidden=True)
+            out = self.model.apply({'params': model_params}, tokens,
+                                   return_hidden=True, **extra)
             aux = None
             if isinstance(out, (tuple, list)):
                 out, aux = out
-            head, vocab_in_rows = fused_xent.find_lm_head(params)
+            head, vocab_in_rows = fused_xent.find_lm_head(model_params)
             loss = fused_xent.fused_next_token_loss(
                 out, head, tokens, vocab_in_rows=vocab_in_rows)
             return loss if aux is None else loss + aux
-        outputs = self.model.apply({'params': params}, tokens)
+        outputs = self.model.apply({'params': model_params}, tokens,
+                                   **extra)
         return self.loss_fn(outputs, tokens)
 
     def _step_body(self, state: TrainState, tokens: jax.Array,

@@ -52,6 +52,9 @@ def build_replica_cmd(args: argparse.Namespace) -> list:
         cmd += ['--hf', args.hf]
     if args.ckpt_dir:
         cmd += ['--ckpt-dir', args.ckpt_dir]
+    if args.adapter_dir:
+        cmd += ['--adapter-dir', args.adapter_dir,
+                '--max-adapters', str(args.max_adapters)]
     if args.prefill_chunk is not None:
         cmd += ['--prefill-chunk', str(args.prefill_chunk)]
     if args.max_queue_requests:
@@ -75,6 +78,15 @@ def main() -> None:
     parser.add_argument('--prefill-chunk', type=int, default=None)
     parser.add_argument('--max-queue-requests', type=int, default=0)
     parser.add_argument('--max-queue-tokens', type=int, default=0)
+    parser.add_argument('--adapter-dir', default=None, metavar='DIR',
+                        help='multi-LoRA serving: forwarded to every '
+                             'replica; a shared artifact dir means '
+                             'any replica can hot-load any tenant '
+                             'adapter (the LB affinity key pins a '
+                             'tenant to the replica already holding '
+                             'its pages + adapter)')
+    parser.add_argument('--max-adapters', type=int, default=8,
+                        help='forwarded to serve_lm --max-adapters')
     parser.add_argument('--fault-plan', default=None, metavar='JSON')
     parser.add_argument('--cpu', action='store_true')
     parser.add_argument('--state-dir', default=None, metavar='DIR',

@@ -100,6 +100,28 @@ def main() -> None:
                              'and XLA propagates the sharding through '
                              'every serving fn — models bigger than '
                              'one chip serve across the slice')
+    parser.add_argument('--adapter-dir', default=None, metavar='DIR',
+                        help='multi-LoRA serving: a local or gs:// '
+                             'directory of adapter artifacts '
+                             '(<name>/adapter_config.json + weights, '
+                             'the train_lm --lora output). The '
+                             '`model` field on /v1/* and /generate* '
+                             'selects an adapter by name; adapters '
+                             'hot-load on first use and LRU-evict '
+                             'under the --max-adapters device budget')
+    parser.add_argument('--max-adapters', type=int, default=8,
+                        metavar='N',
+                        help='device-resident adapter slots in the '
+                             'stacked LoRA store (memory = N x '
+                             'per-adapter factor bytes; see '
+                             'docs/guides.md "Multi-LoRA serving")')
+    parser.add_argument('--max-lora-rank', type=int, default=0,
+                        metavar='R',
+                        help='store rank ceiling (smaller-rank '
+                             'adapters zero-pad). 0 = the max rank '
+                             'seen in --adapter-dir at startup; set '
+                             'it explicitly if bigger-rank adapters '
+                             'will be hot-dropped in later')
     parser.add_argument('--no-prefix-caching', action='store_true',
                         help='disable shared-prefix KV page reuse '
                              '(vLLM-style APC; on by default with the '

@@ -180,6 +180,29 @@ def main() -> None:
                         metavar='SECONDS',
                         help='preemption-notice metadata poll '
                              'interval (guard)')
+    parser.add_argument('--lora', type=int, default=0, metavar='RANK',
+                        help='LoRA finetune: freeze the base params '
+                             'and train rank-RANK A/B factors on the '
+                             'attention (and optionally MLP) '
+                             'projections (models/lora.py). The '
+                             'trained factors are saved as a serving '
+                             'adapter artifact (--adapter-out) that '
+                             'serve_lm --adapter-dir loads '
+                             'unmodified. Llama-family models only')
+    parser.add_argument('--lora-alpha', type=float, default=0.0,
+                        help='LoRA alpha (delta scale = alpha/rank); '
+                             '0 = alpha = rank (scale 1.0)')
+    parser.add_argument('--lora-targets', default='attn',
+                        choices=['attn', 'mlp', 'attn-mlp'],
+                        help='projections the adapter touches: attn '
+                             '(q/k/v/o, the default), mlp '
+                             '(gate/up/down), or both')
+    parser.add_argument('--adapter-out', default=None, metavar='DIR',
+                        help='where --lora writes the adapter '
+                             'artifact (adapter_config.json + '
+                             'adapter_weights.npz). Default: '
+                             '<--ckpt-dir>/adapter, or ./adapter_out '
+                             'without a checkpoint dir')
     parser.add_argument('--lr', type=float, default=3e-4)
     parser.add_argument('--tensor', type=int, default=1,
                         help='tensor-parallel mesh axis size')
@@ -270,6 +293,10 @@ def main() -> None:
         raise SystemExit('--guard needs the sharded trainer (the '
                          'GPipe path computes per-stage losses with '
                          'no global grad norm); drop one')
+    if args.lora and args.pipeline_stages > 1:
+        raise SystemExit('--lora needs the sharded trainer (the '
+                         'GPipe path splits params per stage); '
+                         'drop one')
     if args.ckpt_interval is not None:
         if not args.ckpt_dir:
             raise SystemExit('--ckpt-interval needs --ckpt-dir')
@@ -331,6 +358,13 @@ def main() -> None:
         model, vocab_size, loss_fn = _build_model(args.model, args.seq,
                                                   args.remat)
     batch = args.global_batch or 8 * n_dev
+    lora_spec = None
+    if args.lora:
+        from skypilot_tpu.models import lora as lora_lib
+        lora_spec = lora_lib.LoraSpec(
+            rank=args.lora,
+            alpha=args.lora_alpha or float(args.lora),
+            targets=lora_lib.targets_from_name(args.lora_targets))
     tx = default_optimizer(learning_rate=args.lr, warmup_steps=10,
                            total_steps=max(args.steps, 20))
     if args.pipeline_stages > 1:
@@ -373,10 +407,12 @@ def main() -> None:
             # computes the norm once for both consumers).
             collect_grad_norm=args.metrics_file is not None,
             guard=args.guard,
+            lora=lora_spec,
             **kwargs)
         if proc_id == 0:
-            print(f'fused_xent={trainer.fused_xent} zero1={args.zero1}',
-                  flush=True)
+            print(f'fused_xent={trainer.fused_xent} '
+                  f'zero1={args.zero1} lora='
+                  f'{args.lora or "off"}', flush=True)
 
         example = jnp.zeros((batch, args.seq), jnp.int32)
         with timeline.Event('train/init'):
@@ -387,11 +423,18 @@ def main() -> None:
         # with the SAME shardings the trainer chose (device_put
         # against the initialized leaves' shardings — fsdp/tp/stage-
         # safe). Fresh optimizer moments are correct for a finetune
-        # start.
-        state = state.replace(params=jax.tree.map(
-            lambda init_leaf, w: jax.device_put(
-                jnp.asarray(w, init_leaf.dtype), init_leaf.sharding),
-            state.params, hf_params))
+        # start. With --lora only the frozen base half is replaced
+        # (the fresh factors ARE the finetune).
+        place = lambda init_leaf, w: jax.device_put(  # noqa: E731
+            jnp.asarray(w, init_leaf.dtype), init_leaf.sharding)
+        if args.lora:
+            state = state.replace(params={
+                'base': jax.tree.map(place, state.params['base'],
+                                     hf_params),
+                'lora': state.params['lora']})
+        else:
+            state = state.replace(params=jax.tree.map(
+                place, state.params, hf_params))
         del hf_params
 
     # Checkpoint resume (preemption recovery path).
@@ -693,6 +736,22 @@ def main() -> None:
             mgr.save(args.steps, state, force=True)
             mgr.wait_until_finished()
             mgr.close()
+    if lora_spec is not None and proc_id == 0:
+        # The produce half of the fine-tune-and-serve loop: the
+        # trained factors become a registry-loadable artifact
+        # (serve_lm --adapter-dir <parent>, model field = dir name).
+        from skypilot_tpu.models import lora as lora_lib
+        out_dir = args.adapter_out or (
+            os.path.join(args.ckpt_dir, 'adapter') if args.ckpt_dir
+            else 'adapter_out')
+        lora_np = jax.device_get(state.params['lora'])
+        lora_lib.save_adapter(
+            out_dir, lora_np, lora_spec,
+            base_model=args.init_from_hf or args.model,
+            step=int(state.step))
+        print(f'adapter artifact -> {out_dir} (rank={lora_spec.rank} '
+              f'alpha={lora_spec.alpha} '
+              f'targets={list(lora_spec.targets)})', flush=True)
     if emitter is not None:
         emitter.close()
     if proc_id == 0:

@@ -31,6 +31,20 @@ class EngineDeadError(Exception):
     hanging clients; `/readyz` flips to 503."""
 
 
+class AdapterNotFoundError(Exception):
+    """The request named a model/adapter the serving process does not
+    have: not the base model and not in the adapter registry's
+    inventory. The HTTP layer maps this to the OpenAI-style 404
+    error object (code `model_not_found`)."""
+
+
+class AdapterLoadError(Exception):
+    """A registered adapter failed to load onto the device (corrupt
+    artifact, shape/rank mismatch with the serving store, or an
+    injected `adapters.load` fault). The request fails 503 — the
+    engine and every other adapter keep serving."""
+
+
 class CheckpointNotFoundError(Exception):
     """No checkpoint exists to restore (empty/absent directory, or
     an explicitly requested step that was never written). Typed —

@@ -102,6 +102,13 @@ KNOWN_POINTS: Dict[str, str] = {
         'retry/backoff/failover)',
     'http.handler':
         'inference HTTP server, start of each POST handler',
+    'adapters.load':
+        'adapter registry (inference/adapters.py), inside each LoRA '
+        'artifact load into the device store — raise OR drop makes '
+        'the load fail as AdapterLoadError (HTTP 503) for that '
+        'request only; the engine, the base model, and every other '
+        'adapter keep serving; fire-site context carries '
+        'adapter=<name> for scoped rules',
     'fleet.tick':
         'replica-plane fleet controller, start of each control-loop '
         'tick (a raised fault exercises the tick-error fuse: 3 '

@@ -139,6 +139,11 @@ class ReplicaView:
     requests_shed_total: int = 0
     prefix_hits: int = 0
     prefix_misses: int = 0
+    # Multi-LoRA inventory scraped from /stats `adapters` (empty for
+    # base-only replicas): which adapters this replica has device-
+    # resident right now, and how many artifacts it can serve.
+    adapters_loaded: List[str] = dataclasses.field(default_factory=list)
+    adapters_inventory: int = 0
     last_stats: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     @property
@@ -160,6 +165,8 @@ class ReplicaView:
             'prefix_hits': self.prefix_hits,
             'prefix_misses': self.prefix_misses,
             'prefix_hit_rate': round(self.prefix_hit_rate, 4),
+            'adapters_loaded': list(self.adapters_loaded),
+            'adapters_inventory': self.adapters_inventory,
         }
 
 
@@ -619,6 +626,9 @@ class ReplicaManager:
         prefix = stats.get('prefix_cache') or {}
         view.prefix_hits = int(prefix.get('hits', 0) or 0)
         view.prefix_misses = int(prefix.get('misses', 0) or 0)
+        adapters = stats.get('adapters') or {}
+        view.adapters_loaded = list(adapters.get('loaded') or [])
+        view.adapters_inventory = len(adapters.get('inventory') or [])
         if ready and view.state in (ReplicaStatus.STARTING,
                                     ReplicaStatus.NOT_READY):
             view.state = ReplicaStatus.READY

@@ -1,0 +1,433 @@
+"""Multi-LoRA serving + LoRA finetuning (models/lora.py,
+inference/adapters.py, the engine's batched per-slot application, and
+the train_lm --lora produce-then-serve loop).
+
+The parity contract under test: batched per-slot LoRA in the engine
+must reproduce the merged-weights (W + a@b·alpha/rank) forward
+exactly for greedy decode, paged AND dense; a mixed round (base +
+several adapters in one dispatch) must equal running each adapter
+alone; and KV prefix-cache pages must never cross adapter
+boundaries (chain keys are adapter-salted).
+"""
+import os
+import subprocess
+import sys
+import tempfile
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.inference import affinity
+from skypilot_tpu.inference.adapters import AdapterRegistry
+from skypilot_tpu.models import lora as lora_lib
+from skypilot_tpu.models.batching import (ContinuousBatchingEngine,
+                                          PrefixCache)
+from skypilot_tpu.models.llama import Llama, LlamaConfig
+from skypilot_tpu.robustness import faults
+from skypilot_tpu.robustness.errors import (AdapterLoadError,
+                                            AdapterNotFoundError)
+
+SPEC = lora_lib.LoraSpec(rank=4, alpha=8.0)
+
+
+def _tiny(**kw):
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, kv_page_size=8,
+                           kv_total_pages=40, **kw)
+    model = Llama(cfg)
+    params = nn.meta.unbox(model.init(
+        jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))['params'])
+    return model, params
+
+
+@pytest.fixture(scope='module')
+def base():
+    return _tiny()
+
+
+@pytest.fixture(scope='module')
+def artifact_dir(base):
+    """Three saved adapters + their raw factors."""
+    model, _ = base
+    tmp = tempfile.mkdtemp(prefix='lora_artifacts_')
+    raw = {}
+    for i in range(3):
+        lp = lora_lib.random_adapter_params(i, model.config, SPEC)
+        lora_lib.save_adapter(os.path.join(tmp, f'ad{i}'), lp, SPEC,
+                              base_model='llama-tiny')
+        raw[f'ad{i}'] = lp
+    return tmp, raw
+
+
+@pytest.fixture(scope='module')
+def store_engine(base, artifact_dir):
+    """ONE paged engine + registry shared by the serving tests (each
+    test uses its own prompt range so prefix-cache state composes)."""
+    model, params = base
+    adir, _ = artifact_dir
+    reg = AdapterRegistry(adir, model, max_adapters=4)
+    eng = ContinuousBatchingEngine(model, params, num_slots=4,
+                                   max_total_len=64,
+                                   adapter_store=reg)
+    assert eng.paged
+    yield eng, reg
+    eng.stop()
+
+
+# -- artifact format --------------------------------------------------------
+def test_artifact_roundtrip(base, artifact_dir):
+    adir, raw = artifact_dir
+    config, loaded = lora_lib.load_adapter(os.path.join(adir, 'ad0'))
+    assert config['rank'] == SPEC.rank
+    assert tuple(config['targets']) == SPEC.targets
+    for layer, targets in raw['ad0'].items():
+        for t, factors in targets.items():
+            np.testing.assert_array_equal(factors['a'],
+                                          loaded[layer][t]['a'])
+            np.testing.assert_array_equal(factors['b'],
+                                          loaded[layer][t]['b'])
+
+
+def test_single_adapter_forward_matches_merged(base, artifact_dir):
+    """The model-level oracle: lora kwargs == merged-weights forward
+    (fp32 tolerance), and batched row 0 is exactly the base model."""
+    model, params = base
+    _, raw = artifact_dir
+    toks = jnp.asarray(
+        np.random.default_rng(3).integers(
+            1, model.config.vocab_size, (2, 12)), jnp.int32)
+    out_lora = model.apply(
+        {'params': params}, toks,
+        lora=lora_lib.as_model_lora(raw['ad0'], SPEC.scale))
+    merged = lora_lib.merge_lora(params, raw['ad0'], SPEC)
+    out_merged = model.apply({'params': merged}, toks)
+    np.testing.assert_allclose(np.asarray(out_lora),
+                               np.asarray(out_merged),
+                               rtol=1e-5, atol=1e-5)
+
+
+# -- engine parity ----------------------------------------------------------
+def test_mixed_round_matches_each_alone_and_merged(base, artifact_dir,
+                                                   store_engine):
+    """base + 3 adapters in ONE dispatch round == each run alone ==
+    (for ad1) a merged-weights engine, greedy, paged."""
+    model, params = base
+    _, raw = artifact_dir
+    eng, _reg = store_engine
+    prompt = list(range(2, 22))
+    futs = [eng.submit(prompt, max_new_tokens=8)]
+    futs += [eng.submit(prompt, max_new_tokens=8, adapter=f'ad{i}')
+             for i in range(3)]
+    mixed = [f.result(timeout=180) for f in futs]
+    alone = [eng.submit(prompt, max_new_tokens=8).result(timeout=180)]
+    alone += [eng.submit(prompt, max_new_tokens=8,
+                         adapter=f'ad{i}').result(timeout=180)
+              for i in range(3)]
+    assert mixed == alone
+    # 4 genuinely different models in one round.
+    assert len({tuple(r) for r in mixed}) == 4
+    # Merged-weights parity for one of them.
+    merged = lora_lib.merge_lora(params, raw['ad1'], SPEC)
+    ref_eng = ContinuousBatchingEngine(model, merged, num_slots=2,
+                                       max_total_len=64)
+    try:
+        ref = ref_eng.submit(prompt,
+                             max_new_tokens=8).result(timeout=180)
+    finally:
+        ref_eng.stop()
+    assert ref == mixed[2]
+
+
+def test_dense_engine_adapter_matches_merged(base, artifact_dir):
+    """The same parity on the DENSE (non-paged) cache path."""
+    model, params = base
+    adir, raw = artifact_dir
+    reg = AdapterRegistry(adir, model, max_adapters=2)
+    prompt = list(range(40, 58))
+    eng = ContinuousBatchingEngine(model, params, num_slots=2,
+                                   max_total_len=64, paged=False,
+                                   adapter_store=reg)
+    merged = lora_lib.merge_lora(params, raw['ad2'], SPEC)
+    ref_eng = ContinuousBatchingEngine(model, merged, num_slots=2,
+                                       max_total_len=64, paged=False)
+    try:
+        assert not eng.paged and not ref_eng.paged
+        got = eng.submit(prompt, max_new_tokens=8,
+                         adapter='ad2').result(timeout=180)
+        base_out = eng.submit(prompt,
+                              max_new_tokens=8).result(timeout=180)
+        ref = ref_eng.submit(prompt,
+                             max_new_tokens=8).result(timeout=180)
+    finally:
+        eng.stop()
+        ref_eng.stop()
+    assert got == ref
+    assert got != base_out  # the adapter actually changed the model
+
+
+def test_fast_path_skips_lora_dispatch(store_engine):
+    """No active adapter lane -> the dispatch kwargs are empty (the
+    compiled base-only executables run untouched)."""
+    eng, _reg = store_engine
+    assert not eng.slot_adapter.any()
+    assert eng._lora_args() == {}
+    assert eng._slot_lora_args(0) == {}
+
+
+# -- prefix-cache tenant isolation ------------------------------------------
+def test_chain_key_isolation_across_adapters(store_engine):
+    """Same prompt, two adapters -> NO prefix-cache hit (KV pages are
+    adapter-dependent); same prompt + same adapter -> full hit."""
+    eng, _reg = store_engine
+    prompt = list(range(100, 125))  # 3 full 8-token pages
+    pc = eng.prefix_cache
+
+    eng.submit(prompt, max_new_tokens=4,
+               adapter='ad0').result(timeout=180)
+    h0 = pc.hits
+    eng.submit(prompt, max_new_tokens=4,
+               adapter='ad0').result(timeout=180)
+    assert pc.hits == h0 + 3        # same tenant: all 3 pages hit
+    h1 = pc.hits
+    eng.submit(prompt, max_new_tokens=4,
+               adapter='ad1').result(timeout=180)
+    assert pc.hits == h1            # other tenant: zero hits
+    h2 = pc.hits
+    eng.submit(prompt, max_new_tokens=4).result(timeout=180)
+    assert pc.hits == h2            # base model: zero hits too
+
+
+def test_chain_key_salt_parity_with_affinity():
+    """The LB re-derives the engine's salted chain keys without JAX —
+    byte-identical, and the salt actually separates tenants."""
+    tokens = list(range(1, 40))
+    salt = affinity.adapter_salt('alice')
+    assert PrefixCache.chain_keys(tokens, 8, salt=salt) == \
+        affinity.chain_keys(tokens, 8, salt=salt)
+    assert PrefixCache.chain_keys(tokens, 8) == \
+        affinity.chain_keys(tokens, 8)
+    assert affinity.chain_keys(tokens, 8, salt=salt) != \
+        affinity.chain_keys(tokens, 8)
+    # request_affinity_key folds the model field in.
+    body = {'tokens': [tokens]}
+    k_base = affinity.request_affinity_key('/generate', body, 8)
+    k_alice = affinity.request_affinity_key(
+        '/generate', dict(body, model='alice'), 8)
+    k_bob = affinity.request_affinity_key(
+        '/generate', dict(body, model='bob'), 8)
+    assert len({k_base, k_alice, k_bob}) == 3
+    assert k_alice == affinity.request_affinity_key(
+        '/generate', dict(body, model='alice'), 8)
+
+
+# -- registry residency -----------------------------------------------------
+def test_registry_lru_evicts_unpinned_never_pinned(base, artifact_dir):
+    model, _ = base
+    adir, _ = artifact_dir
+    reg = AdapterRegistry(adir, model, max_adapters=2)
+    s0 = reg.acquire('ad0')            # pinned (ref 1)
+    s1 = reg.acquire('ad1')
+    reg.release(s1)                    # resident, evictable
+    s2 = reg.acquire('ad2')            # evicts ad1, never ad0
+    assert reg.stats()['evictions'] == 1
+    assert sorted(reg.loaded_names()) == ['ad0', 'ad2']
+    # Both slots pinned now: acquiring the third is back-pressure,
+    # not an eviction of someone's running adapter.
+    assert reg.acquire('ad1') is None
+    reg.release(s2)
+    s1b = reg.acquire('ad1')           # reloads over ad2's slot
+    assert s1b == s2
+    assert reg.stats()['evictions'] == 2
+    assert reg.stats()['loads'] == 4
+    reg.release(s1b)
+    reg.release(s0)
+    with pytest.raises(AdapterNotFoundError):
+        reg.acquire('nope')
+
+
+def test_registry_rank_ceiling_rejected(base, artifact_dir):
+    """A hot-dropped artifact with rank > the store geometry fails as
+    a load error (503), not silently wrong math."""
+    model, _ = base
+    adir, _ = artifact_dir
+    reg = AdapterRegistry(adir, model, max_adapters=2)
+    reg.acquire('ad0')                 # fixes the stack geometry
+    big = lora_lib.LoraSpec(rank=16, alpha=16.0)
+    lora_lib.save_adapter(
+        os.path.join(adir, 'too-big'),
+        lora_lib.random_adapter_params(9, model.config, big), big,
+        base_model='llama-tiny')
+    try:
+        assert reg.exists('too-big')   # hot-load rescan finds it
+        with pytest.raises(AdapterLoadError):
+            reg.acquire('too-big')
+        assert reg.stats()['load_failures'] == 1
+    finally:
+        import shutil
+        shutil.rmtree(os.path.join(adir, 'too-big'))
+
+
+def test_adapters_load_fault_fails_only_that_request(base,
+                                                     artifact_dir):
+    """An injected adapters.load fault -> AdapterLoadError (503) for
+    the requesting client; the registry (and a later clean load)
+    keep working — the chaos contract."""
+    from skypilot_tpu.inference.http_server import classify_error
+    model, _ = base
+    adir, _ = artifact_dir
+    reg = AdapterRegistry(adir, model, max_adapters=2)
+    faults.install_plan({'rules': [{'point': 'adapters.load',
+                                    'action': 'raise', 'times': 1}]})
+    try:
+        with pytest.raises(AdapterLoadError) as ei:
+            reg.acquire('ad0')
+        assert classify_error(ei.value)[0] == 503
+        assert classify_error(AdapterNotFoundError('x'))[0] == 404
+        # The injected failure consumed its one firing: the next
+        # acquire loads cleanly.
+        slot = reg.acquire('ad0')
+        assert slot is not None
+        reg.release(slot)
+        assert reg.stats()['load_failures'] == 1
+        assert reg.stats()['loads'] == 1
+    finally:
+        faults.clear()
+
+
+# -- OpenAI model-field contract --------------------------------------------
+def test_unknown_model_404_even_without_adapters(base):
+    """The /v1 endpoints must validate `model` (and /generate too):
+    unknown -> the OpenAI 404 error object, even when no adapters are
+    configured (they used to silently serve the base model)."""
+    import json
+    import threading
+    import urllib.request
+
+    from skypilot_tpu.inference.http_server import make_server
+    from skypilot_tpu.inference.runtime import InferenceRuntime
+    model, params = base
+    rt = InferenceRuntime(model=model, params=params,
+                          vocab_size=model.config.vocab_size,
+                          model_name='llama-tiny', max_total_len=48,
+                          spec_total=48, speculative=0)
+    server = make_server(rt, 0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+
+    def post(path, body):
+        req = urllib.request.Request(
+            f'http://127.0.0.1:{port}{path}',
+            data=json.dumps(body).encode(),
+            headers={'Content-Type': 'application/json'})
+        try:
+            with urllib.request.urlopen(req) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    try:
+        code, body = post('/v1/completions',
+                          {'model': 'nope', 'prompt': 'x'})
+        assert code == 404
+        assert body['error']['code'] == 'model_not_found'
+        assert body['error']['type'] == 'invalid_request_error'
+        code, body = post('/v1/chat/completions',
+                          {'model': 'nope',
+                           'messages': [{'role': 'user',
+                                         'content': 'x'}]})
+        assert code == 404
+        assert body['error']['code'] == 'model_not_found'
+        code, body = post('/generate',
+                          {'tokens': [[1, 2, 3]], 'model': 'nope'})
+        assert code == 404
+        # The base name resolves (no 404): it fails later on the
+        # missing tokenizer instead — proving validation is about
+        # the model field, not a blanket rejection.
+        code, body = post('/v1/completions',
+                          {'model': 'llama-tiny', 'prompt': 'x'})
+        assert code == 400
+        assert 'tokenizer' in body['error']['message']
+        # /v1/models lists the base model.
+        with urllib.request.urlopen(
+                f'http://127.0.0.1:{port}/v1/models') as resp:
+            models = json.loads(resp.read())
+        assert [m['id'] for m in models['data']] == ['llama-tiny']
+    finally:
+        server.shutdown()
+
+
+# -- trainer ----------------------------------------------------------------
+def test_trainer_freezes_base_and_trains_factors(base):
+    """ShardedTrainer(lora=...): base params bit-identical after
+    steps, A/B factors move, loss finite — and the optimizer holds
+    NO moments for the frozen base."""
+    import optax
+
+    from skypilot_tpu.parallel import mesh as mesh_lib
+    from skypilot_tpu.parallel.train import ShardedTrainer
+    model, _ = base
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshConfig(data=1),
+                              devices=jax.devices()[:1])
+    trainer = ShardedTrainer(model, mesh,
+                             tx=optax.adam(1e-2),
+                             lora=SPEC)
+    example = jnp.zeros((2, 16), jnp.int32)
+    state = trainer.init(jax.random.PRNGKey(0), example)
+    assert set(state.params) == {'base', 'lora'}
+    base_before = jax.device_get(state.params['base'])
+    step = trainer.make_train_step(example, donate=False)
+    tokens = jnp.asarray(np.random.default_rng(0).integers(
+        1, model.config.vocab_size, (2, 16)), jnp.int32)
+    for _ in range(2):
+        state, loss = step(state, tokens)
+    assert np.isfinite(float(loss))
+    base_after = jax.device_get(state.params['base'])
+    jax.tree.map(np.testing.assert_array_equal, base_before,
+                 base_after)
+    lora_after = jax.device_get(state.params['lora'])
+    moved = jax.tree.leaves(jax.tree.map(
+        lambda x: float(np.abs(x).sum()), lora_after))
+    assert any(m > 0 for m in moved)
+    # No Adam moments for the frozen base partition: masked leaves
+    # are MaskedNode (zero-size), so total moment leaves track only
+    # the lora tree.
+    n_lora_leaves = len(jax.tree.leaves(state.params['lora']))
+    n_base_leaves = len(jax.tree.leaves(state.params['base']))
+    n_moment_leaves = len(jax.tree.leaves(state.opt_state))
+    assert n_moment_leaves < 2 * (n_base_leaves + n_lora_leaves)
+
+
+def test_train_lm_lora_artifact_hot_loads_into_registry(
+        base, artifact_dir, store_engine):
+    """The full produce-then-serve loop: `train_lm --lora` writes an
+    artifact; dropping it into a LIVE registry's dir makes it
+    servable with no restart and no conversion step."""
+    model, _ = base
+    adir, _ = artifact_dir
+    eng, reg = store_engine
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    out = os.path.join(adir, 'tuned')
+    proc = subprocess.run(
+        [sys.executable, '-m', 'skypilot_tpu.recipes.train_lm',
+         '--model', 'llama-tiny', '--cpu', '--steps', '2',
+         '--seq', '32', '--global-batch', '8', '--log-every', '1',
+         '--lora', '4', '--lora-alpha', '8',
+         '--adapter-out', out],
+        env=env, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert 'adapter artifact ->' in proc.stdout
+    config, weights = lora_lib.load_adapter(out)
+    assert config['base_model'] == 'llama-tiny'
+    assert config['step'] == 2
+    # Trained: the zero-init B factors moved.
+    b_mass = sum(float(np.abs(t['b']).sum())
+                 for layer in weights.values() for t in layer.values())
+    assert b_mass > 0
+    # Hot-load into the live engine (rescan on miss) and serve.
+    assert reg.exists('tuned')
+    row = eng.submit(list(range(200, 212)), max_new_tokens=4,
+                     adapter='tuned').result(timeout=180)
+    assert len(row) == 16
+    assert 'tuned' in reg.loaded_names()

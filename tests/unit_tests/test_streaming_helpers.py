@@ -108,6 +108,9 @@ def test_usage_counts_prompt_once_for_n():
         def limit_for(self, temperature, streaming=False):
             return 64
 
+        def engine_for(self, adapter=None):
+            return self.engine
+
     # max_new=0 scoring mode: no generation, usage still reported.
     req = CompletionRequest(prompts=['hello'], max_new=0,
                             temperature=0.0, top_p=1.0,
