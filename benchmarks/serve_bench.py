@@ -43,12 +43,35 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _server_env(args) -> dict:
+    """Environment for a spawned serve_lm: repo on PYTHONPATH, and —
+    for --tensor N on CPU — N virtual host devices (the ROADMAP
+    multi-device-without-TPUs harness)."""
+    env = dict(os.environ)
+    env['PYTHONPATH'] = f"{REPO}:{env.get('PYTHONPATH', '')}"
+    if args.tensor > 1:
+        flags = env.get('XLA_FLAGS', '')
+        if '--xla_force_host_platform_device_count' not in flags:
+            env['XLA_FLAGS'] = (
+                f'{flags} --xla_force_host_platform_device_count='
+                f'{args.tensor}').strip()
+    return env
+
+
 def _build_server_cmd(args, adapter_dir=None) -> list:
     """serve_lm command line WITHOUT --port (single-server mode
     appends one; fleet mode lets the replica manager assign them)."""
     cmd = [sys.executable, '-m', 'skypilot_tpu.recipes.serve_lm',
            '--model', args.model,
            '--max-total-len', str(args.max_total_len)]
+    if args.kv_dtype:
+        cmd += ['--kv-dtype', args.kv_dtype]
+    if args.kv_pool_bytes:
+        cmd += ['--kv-pool-bytes', str(args.kv_pool_bytes)]
+    if args.weight_dtype:
+        cmd += ['--weight-dtype', args.weight_dtype]
+    if args.tensor > 1:
+        cmd += ['--tensor', str(args.tensor)]
     if adapter_dir:
         cmd += ['--adapter-dir', adapter_dir,
                 '--max-adapters', str(max(args.max_adapters,
@@ -140,7 +163,7 @@ def _fleet_prompts(args, vocab: int, rng) -> list:
                 for _ in range(rng.randrange(4, 16))]
                for _ in range(args.requests)]
     if args.shared_prefix:
-        groups = max(1, args.prefix_groups)
+        groups = max(1, args.prefix_groups or 8)
         systems = [[rng.randrange(1, vocab)
                     for _ in range(args.shared_prefix)]
                    for _ in range(groups)]
@@ -166,8 +189,7 @@ def _run_fleet_once(args, policy_name: str) -> dict:
     from skypilot_tpu.serve.replica_plane import replica_manager as rm
     from skypilot_tpu.utils.registry import LB_POLICY_REGISTRY
 
-    env = dict(os.environ)
-    env['PYTHONPATH'] = f"{REPO}:{env.get('PYTHONPATH', '')}"
+    env = _server_env(args)
     if args.stub_replicas:
         factory = rm.stub_factory(
             extra_args=['--cache-pages', str(args.stub_cache_pages),
@@ -346,8 +368,7 @@ def _run_single(args, adapter_dir=None, assignment=None) -> dict:
     entries = base) drives the multi-LoRA workload."""
     port = _free_port()
     cmd = _build_server_cmd(args, adapter_dir) + ['--port', str(port)]
-    env = dict(os.environ)
-    env['PYTHONPATH'] = f"{REPO}:{env.get('PYTHONPATH', '')}"
+    env = _server_env(args)
     server = subprocess.Popen(cmd, env=env, stdout=subprocess.DEVNULL,
                               stderr=subprocess.STDOUT)
     url = f'http://127.0.0.1:{port}'
@@ -394,9 +415,17 @@ def _run_single(args, adapter_dir=None, assignment=None) -> dict:
                 prompts[idx] = [rng.randrange(1, vocab)
                                 for _ in range(long_len)]
         if args.shared_prefix:
-            system = [rng.randrange(1, vocab)
-                      for _ in range(args.shared_prefix)]
-            prompts = [system + p for p in prompts]
+            # --prefix-groups G > 1: G distinct shared prefixes with
+            # seeded-random assignment (the multi-session residency
+            # regime the quant A/B measures — more pool pages keep
+            # more groups' pages resident). Default 1 = the classic
+            # one-system-prompt workload.
+            groups = max(1, args.prefix_groups or 1)
+            systems = [[rng.randrange(1, vocab)
+                        for _ in range(args.shared_prefix)]
+                       for _ in range(groups)]
+            prompts = [systems[rng.randrange(groups)] + p
+                       for p in prompts]
         # Warm the compile caches (prefill buckets + decode). With
         # prefix caching the SECOND pass over a prompt takes the
         # suffix-prefill path (different bucket shapes) — warm the
@@ -520,7 +549,32 @@ def _run_single(args, adapter_dir=None, assignment=None) -> dict:
             'model': info['model'],   # server-reported (handles --hf)
             'requests': len(latencies),
             'concurrency': args.concurrency,
+            # Quantized-serving + tensor-parallel arms: storage
+            # formats, the pool geometry the byte budget bought, and
+            # req/s normalized per chip (the ROADMAP item-1 scaling
+            # scoreboard — on CPU a "chip" is a virtual host device).
+            'kv_dtype': (stats.get('storage') or {}).get('kv_dtype',
+                                                         'bf16'),
+            'weight_dtype': (stats.get('storage') or {}).get(
+                'weight_dtype', 'bf16'),
+            'weight_bytes': (stats.get('storage') or {}).get(
+                'weight_bytes'),
+            'kv_pages_total': (stats.get('page_pool') or {}).get(
+                'total'),
+            'kv_pool_bytes': (stats.get('page_pool') or {}).get(
+                'pool_bytes'),
+            'prefix_hit_rate': (stats.get('prefix_cache') or {}).get(
+                'hit_rate'),
+            'prefix_evictions': (stats.get('prefix_cache') or {}).get(
+                'evictions'),
+            # Page-pressure preemptions: >0 means the pool could NOT
+            # sustain the offered concurrency at this byte budget —
+            # the "int8 sustains slots bf16 cannot" signal.
+            'preemptions': stats.get('preemptions'),
+            'tensor': args.tensor,
             'req_per_sec': round(len(latencies) / elapsed, 2),
+            'per_chip_req_per_sec': round(
+                len(latencies) / elapsed / max(args.tensor, 1), 2),
             'p50_ttft_ms': (round(1000 * statistics.median(ttfts), 1)
                             if ttfts else None),
             'p95_ttft_ms': (round(
@@ -567,6 +621,76 @@ def _run_single(args, adapter_dir=None, assignment=None) -> dict:
             server.wait(timeout=10)
         except subprocess.TimeoutExpired:
             server.kill()
+
+
+def _with(args, **over) -> argparse.Namespace:
+    """A shallow copy of the parsed args with fields overridden (the
+    A/B arms vary one knob over an otherwise identical workload)."""
+    import copy
+    arm = copy.copy(args)
+    for key, val in over.items():
+        setattr(arm, key, val)
+    return arm
+
+
+def run_quant_ab(args) -> dict:
+    """The quantized-serving A/B (the committed BENCH_quant record):
+    bf16 KV vs int8 KV at the SAME --kv-pool-bytes (int8 buys ~2x
+    the pages — more slots / prefix residency per HBM byte), plus an
+    int8-KV + int8-weights arm. Identical workload per arm."""
+    runs = {
+        'kv_bf16': _run_single(_with(args, kv_dtype='bf16',
+                                     weight_dtype=None)),
+        'kv_int8': _run_single(_with(args, kv_dtype='int8',
+                                     weight_dtype=None)),
+        'kv_int8_w_int8': _run_single(_with(args, kv_dtype='int8',
+                                            weight_dtype='int8')),
+    }
+    base, q = runs['kv_bf16'], runs['kv_int8']
+    return {
+        'bench': 'serve_quant',
+        'engine': args.engine,
+        'model': args.model,
+        'kv_pool_bytes': args.kv_pool_bytes,
+        'requests': args.requests,
+        'concurrency': args.concurrency,
+        'num_slots': args.num_slots,
+        'shared_prefix': args.shared_prefix,
+        'prefix_groups': max(1, args.prefix_groups or 1),
+        # Same pool bytes -> int8 holds ~2x the pages: the
+        # slots/residency headline (>= 1.8 is the acceptance gate).
+        'kv_pages_ratio_int8_vs_bf16': round(
+            q['kv_pages_total'] / max(base['kv_pages_total'], 1), 3),
+        'req_per_sec_ratio_int8_vs_bf16': round(
+            q['req_per_sec'] / max(base['req_per_sec'], 1e-9), 3),
+        'runs': runs,
+    }
+
+
+def run_tensor_ab(args) -> dict:
+    """--tensor 1 vs --tensor N over the identical workload: the
+    per-chip decode-throughput scaling record (ROADMAP item 1's
+    still-missing serve_bench deliverable; CPU runs fake the chips
+    with XLA host devices)."""
+    n = max(2, args.tensor)
+    runs = {
+        'tensor_1': _run_single(_with(args, tensor=1)),
+        f'tensor_{n}': _run_single(_with(args, tensor=n)),
+    }
+    return {
+        'bench': 'serve_tensor',
+        'engine': args.engine,
+        'model': args.model,
+        'tensor': n,
+        'requests': args.requests,
+        'concurrency': args.concurrency,
+        'kv_dtype': args.kv_dtype or 'bf16',
+        'weight_dtype': args.weight_dtype or 'bf16',
+        'per_chip_ratio': round(
+            runs[f'tensor_{n}']['per_chip_req_per_sec'] /
+            max(runs['tensor_1']['per_chip_req_per_sec'], 1e-9), 3),
+        'runs': runs,
+    }
 
 
 def main() -> None:
@@ -638,13 +762,17 @@ def main() -> None:
                              'prefix_affinity AND round_robin and '
                              'emit one combined JSON object (the '
                              'committed BENCH_serve_fleet record)')
-    parser.add_argument('--prefix-groups', type=int, default=8,
+    parser.add_argument('--prefix-groups', type=int, default=None,
                         metavar='G',
-                        help='fleet mode: number of DISTINCT shared '
-                             'system prompts (sessions); affinity '
-                             'pins each group to one replica while '
-                             'round-robin makes every replica cache '
-                             'every group')
+                        help='number of DISTINCT shared system '
+                             'prompts (sessions) under '
+                             '--shared-prefix. Fleet mode (default '
+                             '8): affinity pins each group to one '
+                             'replica while round-robin caches every '
+                             'group everywhere. Single-server mode '
+                             '(default 1): >1 exercises prefix-cache '
+                             'RESIDENCY — the regime int8 KV pages '
+                             'double')
     parser.add_argument('--stub-replicas', action='store_true',
                         help='fleet mode with model-free stub '
                              'replicas (replica_plane/stub.py): '
@@ -696,6 +824,39 @@ def main() -> None:
                              'prefix caching accelerates (chatbots, '
                              'few-shot templates)')
     parser.add_argument('--no-prefix-caching', action='store_true')
+    parser.add_argument('--kv-dtype', choices=['bf16', 'int8'],
+                        default=None,
+                        help='forwarded to serve_lm --kv-dtype '
+                             '(int8 KV pages; default: server '
+                             'default bf16)')
+    parser.add_argument('--kv-pool-bytes', type=int, default=0,
+                        metavar='B',
+                        help='forwarded to serve_lm --kv-pool-bytes: '
+                             'size the KV pool by DEVICE BYTES so '
+                             'bf16/int8 arms spend the same HBM')
+    parser.add_argument('--weight-dtype', choices=['bf16', 'int8'],
+                        default=None,
+                        help='forwarded to serve_lm --weight-dtype '
+                             '(int8 per-channel projection weights)')
+    parser.add_argument('--tensor', type=int, default=1,
+                        help='forwarded to serve_lm --tensor N '
+                             '(tensor-parallel serving); on CPU the '
+                             'bench arms the server with '
+                             'XLA_FLAGS=--xla_force_host_platform_'
+                             'device_count=N. The JSON line gains '
+                             'per_chip_req_per_sec')
+    parser.add_argument('--quant-ab', action='store_true',
+                        help='run bf16-KV vs int8-KV (same '
+                             '--kv-pool-bytes) vs int8-KV+int8-'
+                             'weights over the identical workload '
+                             'and emit one combined JSON object '
+                             '(the committed BENCH_quant record). '
+                             'Requires --kv-pool-bytes')
+    parser.add_argument('--tensor-ab', action='store_true',
+                        help='run --tensor 1 vs --tensor N over the '
+                             'identical workload and emit one '
+                             'combined JSON object (per-chip req/s '
+                             'scaling)')
     parser.add_argument('--hf', default=None,
                         help='serve a local HF checkpoint directory')
     parser.add_argument('--ckpt-dir', default=None)
@@ -716,6 +877,25 @@ def main() -> None:
     if args.adapters and args.engine != 'continuous':
         parser.error('--adapters needs --engine continuous (batched '
                      'per-slot LoRA lives in the slot engine)')
+    if args.quant_ab and not args.kv_pool_bytes:
+        parser.error('--quant-ab needs --kv-pool-bytes B (the A/B '
+                     'holds pool BYTES constant; page counts follow '
+                     'the storage format)')
+    if (args.kv_dtype == 'int8' or args.quant_ab) and \
+            args.engine != 'continuous':
+        parser.error('--kv-dtype int8 needs --engine continuous '
+                     '(int8 pages live in the paged slot engine)')
+    if args.quant_ab and (args.replicas or args.adapters):
+        parser.error('--quant-ab is a single-server mode')
+    if args.tensor_ab and (args.replicas or args.adapters):
+        parser.error('--tensor-ab is a single-server mode')
+
+    if args.quant_ab:
+        print(json.dumps(run_quant_ab(args)))
+        return
+    if args.tensor_ab:
+        print(json.dumps(run_tensor_ab(args)))
+        return
 
     if args.replicas:
         print(json.dumps(run_fleet(args)))

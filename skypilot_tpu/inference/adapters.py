@@ -80,7 +80,8 @@ class AdapterRegistry:
     acquire/release, HTTP threads read inventory/stats."""
 
     def __init__(self, adapter_dir: str, model, *,
-                 max_adapters: int = 8, max_rank: int = 0) -> None:
+                 max_adapters: int = 8, max_rank: int = 0,
+                 mesh=None) -> None:
         if not lora_lib.supports(model):
             raise ValueError(
                 f'{type(model).__name__} has no LoRA forward path; '
@@ -92,6 +93,14 @@ class AdapterRegistry:
         self.model = model
         self.cfg = model.config
         self.max_adapters = int(max_adapters)
+        # Tensor-parallel serving (--tensor N): the stacked factor
+        # store is EXPLICITLY replicated over the mesh rather than
+        # left to single-device default placement — the engine's
+        # sharded dispatches then gather per-slot rows without a
+        # cross-device fetch, and the donated row writes keep the
+        # replicated layout. Factors are small (rank-r strips), so
+        # replication costs ~nothing next to the sharded base.
+        self._mesh = mesh
         self._dir = adapter_dir
         self._local_dir = adapter_dir  # set by _sync_remote for gs://
         self._lock = threading.Lock()
@@ -200,6 +209,7 @@ class AdapterRegistry:
             raise AdapterLoadError(
                 'adapter store geometry unknown: no adapters scanned '
                 'and no --max-lora-rank given')
+        import jax
         import jax.numpy as jnp
         shapes = lora_lib.projection_shapes(self.cfg)
         n = self.max_adapters + 1
@@ -215,6 +225,11 @@ class AdapterRegistry:
                                    self.cfg.dtype),
                 }
             stack[f'layer_{i}'] = layer
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            replicated = NamedSharding(self._mesh, PartitionSpec())
+            stack = jax.tree.map(
+                lambda x: jax.device_put(x, replicated), stack)
         self._stack = stack
         self._refresh_model_lora_locked()
 

@@ -224,7 +224,14 @@ def make_server(rt: InferenceRuntime,
             engine = rt.engine
             body = {'serving': rt.metrics.snapshot(),
                     'instance_uuid': INSTANCE_UUID,
-                    'pid': os.getpid()}
+                    'pid': os.getpid(),
+                    # Quantized-serving storage formats + weight
+                    # footprint (docs/guides.md "Quantized serving").
+                    'storage': {
+                        'kv_dtype': rt.kv_dtype,
+                        'weight_dtype': rt.weight_dtype,
+                        'weight_bytes': rt.weight_bytes,
+                    }}
             if rt.adapters is not None:
                 body['adapters'] = rt.adapters.stats()
             if engine is None:
@@ -272,6 +279,8 @@ def make_server(rt: InferenceRuntime,
                     'utilization': round(
                         (engine.total_pages - free) /
                         max(engine.total_pages, 1), 3),
+                    'kv_dtype': engine.kv_dtype,
+                    'pool_bytes': engine.kv_cache_bytes(),
                 }
                 if engine.prefix_cache is not None:
                     pc = engine.prefix_cache

@@ -209,10 +209,19 @@ class InferenceRuntime:
                  request_timeout: float = 600.0,
                  max_queue_requests: int = 0,
                  max_queue_tokens: int = 0,
-                 adapters=None) -> None:
+                 adapters=None,
+                 kv_dtype: str = 'bf16',
+                 weight_dtype: str = 'bf16') -> None:
         import jax
         self.model = model
         self.params = params
+        # Quantized-serving storage formats (inference/quant.py +
+        # the model config's kv_dtype) — /stats and the
+        # skypilot_serving_storage_info series report them.
+        self.kv_dtype = kv_dtype
+        self.weight_dtype = weight_dtype
+        from skypilot_tpu.inference import quant as quant_lib
+        self.weight_bytes = quant_lib.weight_num_bytes(params)
         # Multi-LoRA adapter registry (inference/adapters.py) shared
         # by every engine in this runtime; None = base model only.
         self.adapters = adapters
@@ -517,6 +526,38 @@ def build_runtime(args) -> InferenceRuntime:
                                             args.max_total_len,
                                             remat=False)
 
+    # Quantized serving knobs (inference/quant.py): KV page storage
+    # format + pool sizing in BYTES (so bf16/int8 A/B runs spend the
+    # same HBM — int8 buys ~2x the pages), and int8 projection
+    # weights below.
+    from skypilot_tpu.inference import quant as quant_lib
+    kv_dtype = getattr(args, 'kv_dtype', 'bf16') or 'bf16'
+    weight_dtype = getattr(args, 'weight_dtype', 'bf16') or 'bf16'
+    kv_pool_bytes = int(getattr(args, 'kv_pool_bytes', 0) or 0)
+    if kv_dtype != 'bf16' or kv_pool_bytes:
+        cfg = model.config
+        if getattr(cfg, 'kv_dtype', None) is None or \
+                getattr(cfg, 'kv_total_pages', 0) <= 0:
+            raise SystemExit(
+                f'--kv-dtype/--kv-pool-bytes need a paged-KV model '
+                f'config with a kv_dtype field (the Llama family); '
+                f'{type(cfg).__name__} has none')
+        if kv_dtype == 'int8' and not args.continuous_batching:
+            raise SystemExit(
+                '--kv-dtype int8 requires --continuous-batching: the '
+                'one-shot engine decodes through the dense per-slot '
+                'cache, which has no scale storage')
+        import dataclasses
+        pages = (quant_lib.pool_pages_for_bytes(cfg, kv_dtype,
+                                                kv_pool_bytes)
+                 if kv_pool_bytes else cfg.kv_total_pages)
+        cfg = dataclasses.replace(cfg, kv_dtype=kv_dtype,
+                                  kv_total_pages=pages)
+        model = type(model)(cfg)
+        print(f'kv cache: dtype={kv_dtype} pages={pages} '
+              f'({quant_lib.kv_page_bytes(cfg, kv_dtype)} bytes/page '
+              f'across layers)', flush=True)
+
     # Speculative decoding writes its verify chunk up to K tokens past
     # the last kept one; fail fast / clamp at STARTUP instead of
     # erroring inside every request handler.
@@ -544,19 +585,59 @@ def build_runtime(args) -> InferenceRuntime:
         params = nn.meta.unbox(model.init(
             jax.random.PRNGKey(0),
             jnp.ones((1, 8), jnp.int32))['params'])
+    # int8 projection weights: quantize HOST-SIDE from the f32/bf16
+    # tree, then wrap the model so every jitted serving fn
+    # dequantizes on read (inference/quant.py).
+    if weight_dtype == 'int8':
+        if args.ckpt_dir:
+            raise SystemExit(
+                '--weight-dtype int8 does not compose with '
+                '--ckpt-dir (the restore template predates '
+                'quantization); restore bf16 or convert first')
+        qparams = quant_lib.quantize_params(params)
+        if not quant_lib.is_quantized(qparams):
+            raise SystemExit(
+                f'--weight-dtype int8 found no quantizable '
+                f'projection kernels ({quant_lib.WEIGHT_TARGETS}) in '
+                f'this model; the Llama family is supported')
+        params = qparams
+        model = quant_lib.QuantizedModel(model)
+        print('weights: int8 per-output-channel projections '
+              '(dequant-on-read)', flush=True)
+    elif weight_dtype != 'bf16':
+        raise SystemExit(f'unsupported --weight-dtype {weight_dtype}')
     # ONE placement block for both param sources: TP-shard over the
     # mesh (per-leaf cast, shard-only transfers) or single-device.
+    mesh = None
     if args.tensor > 1:
         from skypilot_tpu.parallel import mesh as mesh_lib
-        from skypilot_tpu.parallel.serving import \
-            shard_params_for_serving
         mesh = mesh_lib.make_mesh(
             mesh_lib.MeshConfig(tensor=args.tensor),
             devices=jax.devices()[:args.tensor])
-        params = shard_params_for_serving(model, params, mesh,
-                                          dtype=serve_cast)
+        if weight_dtype == 'int8':
+            params = quant_lib.shard_quantized_for_serving(
+                model, params, mesh, dtype=serve_cast)
+        else:
+            from skypilot_tpu.parallel.serving import \
+                shard_params_for_serving
+            params = shard_params_for_serving(model, params, mesh,
+                                              dtype=serve_cast)
         print(f'tensor-parallel serving over {args.tensor} devices',
               flush=True)
+    elif weight_dtype == 'int8':
+        # Quantized leaves keep their int8/f32 dtypes; serve_cast
+        # applies to the surviving dense leaves (embeddings, norms,
+        # head) exactly as the bf16 path does.
+        import numpy as _np
+
+        def _place(x):
+            x = _np.asarray(x)
+            if serve_cast is not None and x.dtype == _np.float32 \
+                    and x.ndim > 1:
+                x = x.astype(serve_cast)
+            return jnp.asarray(x)
+
+        params = jax.tree.map(_place, params)
     elif serve_cast is not None:
         import numpy as _np
         params = jax.tree.map(
@@ -583,7 +664,8 @@ def build_runtime(args) -> InferenceRuntime:
         adapters = AdapterRegistry(
             adapter_dir, model,
             max_adapters=getattr(args, 'max_adapters', 8),
-            max_rank=getattr(args, 'max_lora_rank', 0))
+            max_rank=getattr(args, 'max_lora_rank', 0),
+            mesh=mesh)
         inv = adapters.inventory()
         print(f'adapter registry: {len(inv)} adapters in '
               f'{adapter_dir} (max {adapters.max_adapters} '
@@ -631,7 +713,7 @@ def build_runtime(args) -> InferenceRuntime:
             max_queue_tokens=max_queue_tokens,
             adapter_store=adapters)
 
-    return InferenceRuntime(
+    rt = InferenceRuntime(
         model=model, params=params, vocab_size=vocab_size,
         model_name=(f'hf:{os.path.basename(args.hf)}'
                     if args.hf else args.model),
@@ -644,4 +726,11 @@ def build_runtime(args) -> InferenceRuntime:
         request_timeout=request_timeout,
         max_queue_requests=max_queue_requests,
         max_queue_tokens=max_queue_tokens,
-        adapters=adapters)
+        adapters=adapters,
+        kv_dtype=kv_dtype, weight_dtype=weight_dtype)
+    from skypilot_tpu.observability import catalog as _obs_catalog
+    _obs_catalog.gauge('skypilot_serving_weight_bytes').set(
+        rt.weight_bytes)
+    _obs_catalog.gauge('skypilot_serving_storage_info').labels(
+        kv_dtype=kv_dtype, weight_dtype=weight_dtype).set(1)
+    return rt

@@ -303,6 +303,21 @@ class ContinuousBatchingEngine:
                 f'usable {(max(cfg_pool - 1, 0)) * cfg_page} tokens; '
                 f'page 0 is reserved).')
         self.paged = paged
+        # KV storage format (models/llama.py LlamaConfig.kv_dtype):
+        # int8 pages + parallel scale arrays. Quantization lives
+        # entirely inside the model's cache variables and the
+        # paged-attention ops — the scheduler's page bookkeeping
+        # (alloc/free/prefix sharing/chain keys) is format-blind.
+        self.kv_dtype = getattr(model.config, 'kv_dtype', 'bf16')
+        if self.kv_dtype not in ('bf16', 'int8'):
+            raise ValueError(
+                f'unsupported kv_dtype {self.kv_dtype!r} '
+                f"(choices: 'bf16', 'int8')")
+        if self.kv_dtype == 'int8' and not self.paged:
+            raise ValueError(
+                'kv_dtype=int8 requires the paged KV cache: the '
+                'dense per-slot cache has no scale storage (size the '
+                'kv page pool to hold max_total_len, or serve bf16)')
         if self.paged:
             self.page_size = cfg_page
             self.total_pages = cfg_pool
@@ -872,6 +887,15 @@ class ContinuousBatchingEngine:
         self._stop.set()
         self._thread.join(timeout=10)
 
+    def kv_cache_bytes(self) -> int:
+        """Device bytes of the slot pool's KV cache (paged pools:
+        pages + scale arrays; dense: the per-slot rows) — the
+        denominator of the quantized-serving memory math
+        (skypilot_serving_kv_pool_bytes)."""
+        return int(sum(
+            leaf.size * jnp.dtype(leaf.dtype).itemsize
+            for leaf in jax.tree_util.tree_leaves(self.cache)))
+
     def update_metric_gauges(self) -> None:
         """Refresh the snapshot-style Prometheus gauges from live
         engine state. Called by the scrape handlers (/metrics and
@@ -882,6 +906,7 @@ class ContinuousBatchingEngine:
         self.metrics.active_slots.set(int(self.active.sum()))
         self.metrics.num_slots.set(self.num_slots)
         self.metrics.prefill_backlog.set(self.prefill_backlog_tokens())
+        self.metrics.kv_pool_bytes.set(self.kv_cache_bytes())
         if self.paged:
             free = int(self.allocator.free_pages)
             self.metrics.pages_free.set(free)

@@ -61,6 +61,13 @@ class LlamaConfig:
     # page 0 is the engine's trash page for unallocated table entries.
     kv_page_size: int = 16
     kv_total_pages: int = 128
+    # KV page storage format: 'bf16' stores pages in `dtype`; 'int8'
+    # stores int8 pages plus parallel f32 per-page-slot scale arrays
+    # (quantize on write, dequantize inside the attention gather —
+    # ops/paged_attention.py). Roughly halves pool bytes per token,
+    # i.e. ~2x slots / prefix-cache residency at the same HBM.
+    # Requires the paged cache (serve_lm --continuous-batching).
+    kv_dtype: str = 'bf16'
     # Qwen2-family variant: biases on the q/k/v projections (the only
     # architectural delta from Llama; o_proj and the MLP stay
     # bias-free).
@@ -184,13 +191,37 @@ class Attention(nn.Module):
         q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
         k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
 
+        kv_quant = cfg.kv_dtype == 'int8'
+        if cfg.kv_dtype not in ('bf16', 'int8'):
+            raise ValueError(f'unsupported kv_dtype {cfg.kv_dtype!r} '
+                             f"(choices: 'bf16', 'int8')")
+        if kv_quant and decode and page_indices is None:
+            raise ValueError(
+                'kv_dtype=int8 requires the paged KV cache (the dense '
+                'per-slot cache has no scale storage); serve with '
+                '--continuous-batching and a paged-capable pool')
+
         def _page_vars():
             shape = (cfg.num_kv_heads, cfg.kv_total_pages,
                      cfg.kv_page_size, hd)
-            return (self.variable('cache', 'k_pages', jnp.zeros, shape,
-                                  cfg.dtype),
-                    self.variable('cache', 'v_pages', jnp.zeros, shape,
-                                  cfg.dtype))
+            k_pages = self.variable(
+                'cache', 'k_pages', jnp.zeros, shape,
+                jnp.int8 if kv_quant else cfg.dtype)
+            v_pages = self.variable(
+                'cache', 'v_pages', jnp.zeros, shape,
+                jnp.int8 if kv_quant else cfg.dtype)
+            if not kv_quant:
+                return k_pages, v_pages, None, None
+            # Parallel scale pages: one f32 per cached token (page
+            # slot), shared across KV heads — scales travel with
+            # their physical page so alloc/free/prefix-sharing need
+            # no storage-format awareness.
+            sshape = (cfg.kv_total_pages, cfg.kv_page_size)
+            return (k_pages, v_pages,
+                    self.variable('cache', 'k_scales', jnp.zeros,
+                                  sshape, jnp.float32),
+                    self.variable('cache', 'v_scales', jnp.zeros,
+                                  sshape, jnp.float32))
 
         if decode and seq > 1:
             # CHUNKED decode: many tokens in one forward pass, both
@@ -200,17 +231,30 @@ class Attention(nn.Module):
             # verification chunks at arbitrary per-row offsets).
             if page_indices is not None:
                 from skypilot_tpu.ops import paged_attention as paged_ops
-                k_pages, v_pages = _page_vars()
-                k_pages.value, v_pages.value = paged_ops.write_kv_chunk(
-                    k_pages.value, v_pages.value, k, v, positions,
-                    page_indices)
+                k_pages, v_pages, k_sc, v_sc = _page_vars()
+                if kv_quant:
+                    (k_pages.value, v_pages.value, k_sc.value,
+                     v_sc.value) = paged_ops.write_kv_chunk_quant(
+                        k_pages.value, v_pages.value, k_sc.value,
+                        v_sc.value, k, v, positions, page_indices)
+                else:
+                    k_pages.value, v_pages.value = \
+                        paged_ops.write_kv_chunk(
+                            k_pages.value, v_pages.value, k, v,
+                            positions, page_indices)
                 if prefill:
+                    # Chunk-local attention reads the chunk's own
+                    # bf16 K/V (exact); later chunks/decodes read the
+                    # quantized pages — the storage contract.
                     out = attention_ops.dot_product_attention(
                         q, k, v, causal=True)
                 else:
                     out = paged_ops.paged_chunk_attention(
                         q, k_pages.value, v_pages.value, positions,
-                        page_indices).astype(cfg.dtype)
+                        page_indices,
+                        k_scales=k_sc.value if kv_quant else None,
+                        v_scales=v_sc.value if kv_quant else None,
+                        ).astype(cfg.dtype)
             else:
                 cached_k = self.variable(
                     'cache', 'cached_key', jnp.zeros,
@@ -240,14 +284,23 @@ class Attention(nn.Module):
                 # page pool; this sequence's pages come from the
                 # engine-provided table (ops/paged_attention.py).
                 from skypilot_tpu.ops import paged_attention as paged_ops
-                k_pages, v_pages = _page_vars()
-                k_pages.value, v_pages.value = paged_ops.write_kv(
-                    k_pages.value, v_pages.value, k[:, 0], v[:, 0],
-                    positions[:, 0], page_indices)
+                k_pages, v_pages, k_sc, v_sc = _page_vars()
+                if kv_quant:
+                    (k_pages.value, v_pages.value, k_sc.value,
+                     v_sc.value) = paged_ops.write_kv_quant(
+                        k_pages.value, v_pages.value, k_sc.value,
+                        v_sc.value, k[:, 0], v[:, 0],
+                        positions[:, 0], page_indices)
+                else:
+                    k_pages.value, v_pages.value = paged_ops.write_kv(
+                        k_pages.value, v_pages.value, k[:, 0], v[:, 0],
+                        positions[:, 0], page_indices)
                 out = paged_ops.paged_decode_attention(
                     q[:, 0], k_pages.value, v_pages.value,
                     lengths=positions[:, 0] + 1,
-                    page_indices=page_indices)
+                    page_indices=page_indices,
+                    k_scales=k_sc.value if kv_quant else None,
+                    v_scales=v_sc.value if kv_quant else None)
                 out = out[:, None].astype(cfg.dtype)
             else:
                 cached_k = self.variable(

@@ -82,7 +82,11 @@ def targets_from_name(name: str) -> Tuple[str, ...]:
 def supports(model) -> bool:
     """True when `model` threads the `lora` kwarg through its forward
     pass AND its config exposes the Llama-family projection geometry
-    (`projection_shapes` below)."""
+    (`projection_shapes` below). Dequant-on-read wrappers
+    (inference/quant.py QuantizedModel) are unwrapped: LoRA deltas
+    apply to projection OUTPUTS, so they ride the dequantized base
+    unchanged."""
+    model = getattr(model, 'base_model', model)
     try:
         sig = inspect.signature(type(model).__call__)
     except (TypeError, ValueError):

@@ -77,6 +77,19 @@ SPECS: Dict[str, Tuple] = {
                    'on fetching decode tokens from the device '
                    '(pipelining hides this behind the next dispatch)',
         ('engine',)),
+    'skypilot_serving_kv_pool_bytes': (
+        'gauge', 'Device bytes of the engine\'s KV cache (paged: '
+                 'int8/bf16 pages + scale arrays; dense: per-slot '
+                 'rows) — the quantized-serving memory denominator',
+        ('engine',)),
+    'skypilot_serving_weight_bytes': (
+        'gauge', 'Device bytes of the served model weights '
+                 '(quantized projections count their int8 + scale '
+                 'footprint)', ()),
+    'skypilot_serving_storage_info': (
+        'gauge', 'Serving storage formats in effect (always 1; read '
+                 'the kv_dtype/weight_dtype labels)',
+        ('kv_dtype', 'weight_dtype')),
     'skypilot_serving_pages_free': (
         'gauge', 'Free pages in the shared KV page pool', ('engine',)),
     'skypilot_serving_pages_used': (
@@ -322,6 +335,8 @@ class EngineMetrics:
         self.decode_stall_seconds = counter(
             'skypilot_serving_decode_stall_seconds_total').labels(
                 **lab)
+        self.kv_pool_bytes = gauge(
+            'skypilot_serving_kv_pool_bytes').labels(**lab)
         self.pages_free = gauge(
             'skypilot_serving_pages_free').labels(**lab)
         self.pages_used = gauge(

@@ -22,6 +22,21 @@ Layouts (matching the pallas kernel):
 Page allocation is host-side (`PageAllocator`): XLA needs static
 shapes, so the device arrays are fixed-size and the allocator only
 decides which physical pages a sequence uses.
+
+INT8 KV PAGES (kv_dtype='int8' on the model config): the page pool
+stores int8 with one f32 scale per page SLOT (i.e. per cached token,
+shared across KV heads) living in a parallel scale-page array
+  k/v_scales   f32[total_pages, page_size]
+Quantization is symmetric absmax over that token's (Hkv, head_dim)
+values, applied on every cache write (`write_kv_quant` /
+`write_kv_chunk_quant`); the attention reads dequantize right after
+the page gather so every matmul stays bf16/f32. Scales travel with
+their physical page, so allocation, free-lists, prefix sharing and
+chain keys are untouched — a shared prefix page is one int8 copy
+plus its scales, refcounted exactly like a bf16 page. Per-slot
+scales (rather than one scale per whole page) keep single-token
+decode writes requantization-free: a write never touches another
+token's already-quantized values.
 """
 from __future__ import annotations
 
@@ -44,18 +59,46 @@ def _pallas_paged_available() -> bool:
         return False
 
 
+def quantize_kv_rows(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric absmax int8 quantization of per-token KV rows.
+
+    x: [..., num_kv_heads, head_dim] (one leading index per cached
+    token). Returns (q int8 same shape, scale f32[...]) with the
+    scale taken over each token's (Hkv, D) values. An all-zero token
+    quantizes to scale 0 / values 0 (dequant is exactly zero)."""
+    x32 = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x32), axis=(-2, -1))
+    scale = amax / 127.0
+    safe = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.clip(jnp.round(x32 / safe[..., None, None]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kv(q: jax.Array, scale: jax.Array) -> jax.Array:
+    """Inverse of `quantize_kv_rows`: q [..., Hkv-or-Hq, D] int8,
+    scale [...] f32 broadcast over the trailing two dims."""
+    return q.astype(jnp.float32) * scale[..., None, None]
+
+
 def paged_decode_attention(q: jax.Array, k_pages: jax.Array,
                            v_pages: jax.Array, lengths: jax.Array,
                            page_indices: jax.Array,
-                           *, impl: str = 'auto') -> jax.Array:
+                           *, k_scales: Optional[jax.Array] = None,
+                           v_scales: Optional[jax.Array] = None,
+                           impl: str = 'auto') -> jax.Array:
     """Attention of one query token per row over its paged KV history.
 
     Returns [B, num_q_heads, head_dim] (q.dtype). GQA: num_q_heads may
-    be a multiple of num_kv_heads.
+    be a multiple of num_kv_heads. `k_scales`/`v_scales`
+    (f32[total_pages, page_size]) mark int8 pages: the gather
+    dequantizes before any matmul (the pallas kernel path is bf16-only,
+    so quantized pools take the XLA reference path).
     """
     assert q.ndim == 3 and k_pages.ndim == 4, (q.shape, k_pages.shape)
-    use_kernel = (impl == 'kernel' or
-                  (impl == 'auto' and _pallas_paged_available()))
+    use_kernel = (k_scales is None and
+                  (impl == 'kernel' or
+                   (impl == 'auto' and _pallas_paged_available())))
     if use_kernel:
         from jax.experimental.pallas.ops.tpu.paged_attention import (
             paged_attention)
@@ -72,15 +115,21 @@ def paged_decode_attention(q: jax.Array, k_pages: jax.Array,
                                page_indices,
                                pages_per_compute_block=block)
     return _reference_paged_attention(q, k_pages, v_pages, lengths,
-                                      page_indices)
+                                      page_indices,
+                                      k_scales=k_scales,
+                                      v_scales=v_scales)
 
 
 def _gather_kv(q_heads: int, k_pages: jax.Array, v_pages: jax.Array,
-               page_indices: jax.Array
+               page_indices: jax.Array,
+               k_scales: Optional[jax.Array] = None,
+               v_scales: Optional[jax.Array] = None
                ) -> Tuple[jax.Array, jax.Array]:
     """Per-row page gather + GQA head expansion: the shared read side
     of every XLA paged-attention path. Returns k/v as [B, T, Hq, D]
-    where T = pages_per_seq * page_size."""
+    where T = pages_per_seq * page_size. With scale pages the gather
+    DEQUANTIZES (int8 * per-slot f32 scale) before head expansion —
+    the one place quantized storage meets the math."""
     num_kv_heads, _, page_size, head_dim = k_pages.shape
     max_len = page_indices.shape[1] * page_size
 
@@ -91,8 +140,18 @@ def _gather_kv(q_heads: int, k_pages: jax.Array, v_pages: jax.Array,
         g = jnp.swapaxes(g, 1, 2)               # [pages, page, Hkv, D]
         return g.reshape(max_len, num_kv_heads, head_dim)
 
+    def gather_scale_row(scales, idx):
+        return scales[idx].reshape(max_len)     # [pages, page] -> [T]
+
     k_all = jax.vmap(gather_row, in_axes=(None, 0))(k_pages, page_indices)
     v_all = jax.vmap(gather_row, in_axes=(None, 0))(v_pages, page_indices)
+    if k_scales is not None:
+        k_s = jax.vmap(gather_scale_row,
+                       in_axes=(None, 0))(k_scales, page_indices)
+        v_s = jax.vmap(gather_scale_row,
+                       in_axes=(None, 0))(v_scales, page_indices)
+        k_all = k_all.astype(jnp.float32) * k_s[:, :, None, None]
+        v_all = v_all.astype(jnp.float32) * v_s[:, :, None, None]
     if q_heads != num_kv_heads:
         rep = q_heads // num_kv_heads
         k_all = jnp.repeat(k_all, rep, axis=2)
@@ -102,11 +161,15 @@ def _gather_kv(q_heads: int, k_pages: jax.Array, v_pages: jax.Array,
 
 def _reference_paged_attention(q: jax.Array, k_pages: jax.Array,
                                v_pages: jax.Array, lengths: jax.Array,
-                               page_indices: jax.Array) -> jax.Array:
+                               page_indices: jax.Array,
+                               k_scales: Optional[jax.Array] = None,
+                               v_scales: Optional[jax.Array] = None
+                               ) -> jax.Array:
     """Pure-XLA semantics: gather each row's pages, masked softmax."""
     head_dim = k_pages.shape[-1]
     max_len = page_indices.shape[1] * k_pages.shape[2]
-    k_all, v_all = _gather_kv(q.shape[1], k_pages, v_pages, page_indices)
+    k_all, v_all = _gather_kv(q.shape[1], k_pages, v_pages,
+                              page_indices, k_scales, v_scales)
 
     scale = 1.0 / (head_dim ** 0.5)
     s = jnp.einsum('bhd,bkhd->bhk', q.astype(jnp.float32),
@@ -171,6 +234,63 @@ def write_kv_chunk(k_pages: jax.Array, v_pages: jax.Array,
     return write_one(k_pages, k_new), write_one(v_pages, v_new)
 
 
+def write_kv_quant(k_pages: jax.Array, v_pages: jax.Array,
+                   k_scales: jax.Array, v_scales: jax.Array,
+                   k_new: jax.Array, v_new: jax.Array,
+                   positions: jax.Array, page_indices: jax.Array
+                   ) -> Tuple[jax.Array, jax.Array, jax.Array,
+                              jax.Array]:
+    """`write_kv` for an int8 pool: quantize the token's K/V rows and
+    scatter values + per-slot scales in one pass. Same race-freedom
+    argument (rows own distinct physical pages; trash-page collisions
+    write junk over junk)."""
+    page_size = k_pages.shape[2]
+    logical_page = positions // page_size
+    slot = positions % page_size
+    batch = positions.shape[0]
+    physical = page_indices[jnp.arange(batch), logical_page]  # [B]
+    qk, sk = quantize_kv_rows(k_new)
+    qv, sv = quantize_kv_rows(v_new)
+
+    def write_one(pages, new):
+        return pages.at[:, physical, slot, :].set(
+            jnp.swapaxes(new, 0, 1))
+
+    return (write_one(k_pages, qk), write_one(v_pages, qv),
+            k_scales.at[physical, slot].set(sk),
+            v_scales.at[physical, slot].set(sv))
+
+
+def write_kv_chunk_quant(k_pages: jax.Array, v_pages: jax.Array,
+                         k_scales: jax.Array, v_scales: jax.Array,
+                         k_new: jax.Array, v_new: jax.Array,
+                         positions: jax.Array,
+                         page_indices: jax.Array
+                         ) -> Tuple[jax.Array, jax.Array, jax.Array,
+                                    jax.Array]:
+    """`write_kv_chunk` for an int8 pool: S tokens per row quantized
+    (one scale per (row, position) token) and scattered with their
+    scales. Padded-tail positions land in the trash page exactly as
+    the bf16 write does."""
+    batch, chunk = positions.shape
+    page_size = k_pages.shape[2]
+    logical = positions // page_size                       # [B, S]
+    slot = (positions % page_size).reshape(-1)             # [B*S]
+    physical = jnp.take_along_axis(page_indices, logical,
+                                   axis=1).reshape(-1)     # [B*S]
+    qk, sk = quantize_kv_rows(k_new)                       # sk: [B, S]
+    qv, sv = quantize_kv_rows(v_new)
+
+    def write_one(pages, new):
+        flat = new.reshape(batch * chunk, *new.shape[2:])
+        return pages.at[:, physical, slot, :].set(
+            jnp.swapaxes(flat, 0, 1))
+
+    return (write_one(k_pages, qk), write_one(v_pages, qv),
+            k_scales.at[physical, slot].set(sk.reshape(-1)),
+            v_scales.at[physical, slot].set(sv.reshape(-1)))
+
+
 class PageAllocator:
     """Host-side free-list over the fixed physical page pool.
 
@@ -215,7 +335,10 @@ def init_pages(num_kv_heads: int, total_pages: int, page_size: int,
 
 def paged_chunk_attention(q: jax.Array, k_pages: jax.Array,
                           v_pages: jax.Array, positions: jax.Array,
-                          page_indices: jax.Array) -> jax.Array:
+                          page_indices: jax.Array,
+                          k_scales: Optional[jax.Array] = None,
+                          v_scales: Optional[jax.Array] = None
+                          ) -> jax.Array:
     """S queries per row over the row's FULL paged history.
 
     The paged analog of ops.attention.chunked_cache_attention's read
@@ -230,7 +353,8 @@ def paged_chunk_attention(q: jax.Array, k_pages: jax.Array,
     """
     head_dim = k_pages.shape[-1]
     max_len = page_indices.shape[1] * k_pages.shape[2]
-    k_all, v_all = _gather_kv(q.shape[2], k_pages, v_pages, page_indices)
+    k_all, v_all = _gather_kv(q.shape[2], k_pages, v_pages,
+                              page_indices, k_scales, v_scales)
 
     scale = 1.0 / (head_dim ** 0.5)
     s = jnp.einsum('bshd,bthd->bhst', q.astype(jnp.float32),

@@ -61,6 +61,12 @@ def build_replica_cmd(args: argparse.Namespace) -> list:
         cmd += ['--max-queue-requests', str(args.max_queue_requests)]
     if args.max_queue_tokens:
         cmd += ['--max-queue-tokens', str(args.max_queue_tokens)]
+    if args.kv_dtype:
+        cmd += ['--kv-dtype', args.kv_dtype]
+    if args.kv_pool_bytes:
+        cmd += ['--kv-pool-bytes', str(args.kv_pool_bytes)]
+    if args.weight_dtype:
+        cmd += ['--weight-dtype', args.weight_dtype]
     if args.fault_plan:
         cmd += ['--fault-plan', args.fault_plan]
     if args.cpu:
@@ -87,6 +93,19 @@ def main() -> None:
                              'its pages + adapter)')
     parser.add_argument('--max-adapters', type=int, default=8,
                         help='forwarded to serve_lm --max-adapters')
+    parser.add_argument('--kv-dtype', choices=['bf16', 'int8'],
+                        default=None,
+                        help='forwarded to every replica: int8 KV '
+                             'pages (~2x slots / prefix residency '
+                             'per HBM byte; docs/guides.md '
+                             '"Quantized serving")')
+    parser.add_argument('--kv-pool-bytes', type=int, default=0,
+                        metavar='B',
+                        help='forwarded to serve_lm --kv-pool-bytes')
+    parser.add_argument('--weight-dtype', choices=['bf16', 'int8'],
+                        default=None,
+                        help='forwarded to every replica: int8 '
+                             'per-channel projection weights')
     parser.add_argument('--fault-plan', default=None, metavar='JSON')
     parser.add_argument('--cpu', action='store_true')
     parser.add_argument('--state-dir', default=None, metavar='DIR',

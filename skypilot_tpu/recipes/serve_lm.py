@@ -127,6 +127,37 @@ def main() -> None:
                              '(vLLM-style APC; on by default with the '
                              'paged cache — repeated system prompts '
                              'skip recomputation and share pool pages)')
+    parser.add_argument('--kv-dtype', choices=['bf16', 'int8'],
+                        default='bf16',
+                        help='KV page-pool storage format. int8 '
+                             'stores quantized pages + per-page-slot '
+                             'f32 scales (quantize on write, dequant '
+                             'inside attention): ~2x decode slots and '
+                             'prefix-cache residency per HBM byte, '
+                             'quality pinned by the logprob-tolerance '
+                             'contract (docs/guides.md "Quantized '
+                             'serving"). Needs --continuous-batching')
+    parser.add_argument('--kv-pool-bytes', type=int, default=0,
+                        metavar='B',
+                        help='size the KV page pool by DEVICE BYTES '
+                             'instead of the model default page '
+                             'count: kv_total_pages = B // per-page '
+                             'bytes under --kv-dtype, so a bf16 vs '
+                             'int8 A/B at the same B spends the same '
+                             'HBM (int8 buys ~2x the pages). 0 = '
+                             'model-default page count')
+    parser.add_argument('--weight-dtype', choices=['bf16', 'int8'],
+                        default='bf16',
+                        help='serving storage for the projection '
+                             'weights (wq/wk/wv/wo, w_gate/w_up/'
+                             'w_down). int8 = per-output-channel '
+                             'symmetric quantization, dequantized on '
+                             'read inside the jitted fns — halves '
+                             'weight-streaming HBM bandwidth; '
+                             'embeddings/norms/head stay bf16. '
+                             'Composes with --tensor (scales shard '
+                             'with their channel) and LoRA (deltas '
+                             'ride the dequantized base)')
     parser.add_argument('--param-dtype', choices=['bf16', 'f32'],
                         default='bf16',
                         help='on-device dtype for --hf weights. bf16 '
@@ -178,6 +209,10 @@ def main() -> None:
         parser.error('--decode-chunk is a continuous-engine knob; '
                      'add --continuous-batching (the one-shot engine '
                      'would silently ignore it)')
+    if args.kv_dtype == 'int8' and not args.continuous_batching:
+        parser.error('--kv-dtype int8 requires --continuous-batching '
+                     '(the one-shot engine decodes through the dense '
+                     'per-slot cache, which has no scale storage)')
 
     if args.fault_plan:
         from skypilot_tpu.robustness import faults
