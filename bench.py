@@ -78,6 +78,20 @@ def main() -> None:
                         help='run the headline trainer with the naive '
                              'dense LM-head loss instead of the fused '
                              'blockwise path (A/B escape hatch)')
+    parser.add_argument('--sweep-pipeline', action='store_true',
+                        help='sweep pipeline schedule x microbatches '
+                             '(gpipe/1f1b/interleaved over a stage=4 '
+                             'mesh, fixed global batch): step time, '
+                             'bubble fraction, peak live activations '
+                             'and the activation-memory budget '
+                             'verdict per arm; results go to stderr '
+                             'and --sweep-pipeline-out, the headline '
+                             'JSON line is unchanged')
+    parser.add_argument('--sweep-pipeline-out', default=None,
+                        metavar='PATH',
+                        help='write the --sweep-pipeline arms as one '
+                             'JSON artifact (the committed '
+                             'BENCH_pipe_* files)')
     parser.add_argument('--profile', default=None, metavar='DIR',
                         help='jax.profiler trace of the FIRST timed '
                              'repeat into DIR (TensorBoard/Perfetto) — '
@@ -91,8 +105,11 @@ def main() -> None:
     args = parser.parse_args()
 
     if args.smoke:
+        # The pipeline sweep needs a stage axis: 4 virtual devices.
+        count = 4 if args.sweep_pipeline else 1
         os.environ.setdefault(
-            'XLA_FLAGS', '--xla_force_host_platform_device_count=1')
+            'XLA_FLAGS',
+            f'--xla_force_host_platform_device_count={count}')
 
     import jax
     if args.smoke:
@@ -299,6 +316,115 @@ def main() -> None:
                    / sweep_elapsed)
             print(f'# sweep inner={inner_v}: {tps / n_dev:.1f} '
                   f'tokens/s/chip', file=sys.stderr)
+
+    if args.sweep_pipeline:
+        # Schedule x microbatch sweep at FIXED global batch: the
+        # schedule picker evidence. Bubble fraction and peak live
+        # activations come from the schedule object (exact, platform-
+        # independent); step time is measured on whatever devices are
+        # present; MFU stays null off-TPU. The budget model: a stage
+        # can afford S live chunk inputs — exactly what 1F1B
+        # guarantees — so GPipe arms with M > S exceed it and their
+        # bubble floor is pinned at M = S, while 1f1b/interleaved
+        # keep raising M (shrinking the bubble) inside the same
+        # memory.
+        from skypilot_tpu.parallel.pipeline import PipelinedLM
+        from skypilot_tpu.parallel import pipeline_schedule as psched
+        pstages = min(4, n_dev)
+        psweep_cfg = GPTConfig(
+            vocab_size=512, block_size=128, num_layers=8,
+            num_heads=4, embed_dim=128, dtype=jnp.float32,
+            logits_dtype=jnp.float32)
+        pmodel = GPT(psweep_cfg)
+        pseq, pbatch = 64, 16
+        pmesh = mesh_lib.make_mesh(mesh_lib.MeshConfig(
+            stage=pstages, data=n_dev // pstages))
+        ptok = jax.random.randint(jax.random.PRNGKey(3),
+                                  (pbatch, pseq), 0,
+                                  psweep_cfg.vocab_size, jnp.int32)
+        arms = []
+        for style, vstages in (('gpipe', 1), ('1f1b', 1),
+                               ('interleaved', 2)):
+            for mcount in (4, 8, 16):
+                try:
+                    pp = PipelinedLM(pmodel, pmesh,
+                                     num_microbatches=mcount,
+                                     schedule=style,
+                                     virtual_stages=vstages)
+                    ptx = default_optimizer()
+                    pstate = pp.init(jax.random.PRNGKey(0), ptok, ptx)
+                    pstep = pp.make_train_step(ptx)
+                    pstate, ploss = pstep(pstate, ptok)  # compile
+                    jax.block_until_ready(ploss)
+                    pt0 = time.perf_counter()
+                    for _ in range(max(2, args.steps // 2)):
+                        pstate, ploss = pstep(pstate, ptok)
+                    jax.block_until_ready(ploss)
+                    pdt = (time.perf_counter() - pt0) / max(
+                        2, args.steps // 2)
+                except Exception as e:  # pylint: disable=broad-except
+                    print(f'# sweep-pipeline {style} M={mcount}: '
+                          f'skipped ({type(e).__name__}: {e})',
+                          file=sys.stderr)
+                    continue
+                sch = pp.schedule
+                mb_tokens = pbatch // (mcount *
+                                       pmesh.shape['data']) * pseq
+                arm = {
+                    'style': style,
+                    'virtual_stages': vstages,
+                    'microbatches': mcount,
+                    'ticks': sch.num_ticks,
+                    'bubble_frac': round(sch.bubble_fraction, 4),
+                    'peak_live_activations':
+                        sch.peak_live_activations,
+                    'act_bytes_proxy': sch.activation_bytes(
+                        mb_tokens, psweep_cfg.embed_dim),
+                    'fits_budget':
+                        sch.peak_live_activations <= pstages,
+                    'step_time_s': round(pdt, 4),
+                    'tokens_per_sec': round(pbatch * pseq / pdt, 1),
+                    'loss': round(float(ploss), 4),
+                }
+                arms.append(arm)
+                print(f'# sweep-pipeline {style} v={vstages} '
+                      f'M={mcount}: {pdt * 1e3:.0f} ms/step '
+                      f'bubble={arm["bubble_frac"]:.1%} '
+                      f'peak_live={arm["peak_live_activations"]} '
+                      f'fits_budget={arm["fits_budget"]}',
+                      file=sys.stderr)
+        # The scoreboard claim, machine-checkable: best in-budget
+        # bubble per style family vs gpipe's in-budget floor.
+        def best_frac(pred):
+            fit = [a for a in arms if a['fits_budget'] and pred(a)]
+            return min((a['bubble_frac'] for a in fit), default=None)
+        summary = {
+            'budget_live_activations': pstages,
+            'gpipe_bubble_at_budget':
+                best_frac(lambda a: a['style'] == 'gpipe'),
+            'best_bubble_at_budget':
+                best_frac(lambda a: a['style'] != 'gpipe'),
+        }
+        artifact = {
+            'metric': 'pipeline_schedule_sweep',
+            'platform': platform,
+            'n_dev': n_dev,
+            'stages': pstages,
+            'seq': pseq,
+            'global_batch': pbatch,
+            'model': 'gpt-8l-128d',
+            'mfu': None if platform != 'tpu' else 'see-arms',
+            'closed_form': 'ticks = 2(M*v + S - 1); '
+                           'bubble_frac = (S-1)/(M*v + S - 1)',
+            'summary': summary,
+            'arms': arms,
+        }
+        if args.sweep_pipeline_out:
+            with open(args.sweep_pipeline_out, 'w',
+                      encoding='utf-8') as f:
+                json.dump(artifact, f, indent=1)
+            print(f'# sweep-pipeline artifact -> '
+                  f'{args.sweep_pipeline_out}', file=sys.stderr)
 
     # OOM-resilient warmup: halve the batch until the step fits (the
     # driver runs this unattended on whatever chip is present).

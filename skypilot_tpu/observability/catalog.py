@@ -224,6 +224,22 @@ SPECS: Dict[str, Tuple] = {
                    'per-phase deadline), with all thread stacks '
                    'dumped; the controller relaunches instead of '
                    'waiting forever', ()),
+    # -- pipeline schedule + collective overlap (parallel/pipeline.py
+    #    + recipes/train_lm.py)
+    'skypilot_train_pipeline_bubble_fraction': (
+        'gauge', 'Idle fraction of the active pipeline schedule '
+                 '(bubble slots / stage-tick slots, '
+                 '(S-1)/(M*v+S-1) for every style): drive it down '
+                 'by raising microbatches (1f1b frees the '
+                 'activation memory to do so) or virtual stages '
+                 '(interleaved)', ()),
+    'skypilot_train_collective_wait_seconds_total': (
+        'counter', 'Host-observed drain wait at step-window '
+                   'boundaries: the un-overlapped tail of the '
+                   'device critical path (compute + serialized '
+                   'collectives). --overlap should shrink it '
+                   'run-over-run; the --profile trace names the '
+                   'collectives in the gap', ()),
     # -- managed jobs (jobs/controller.py + recovery_strategy.py)
     'skypilot_jobs_recovery_attempts_total': (
         'counter', 'Managed-job recovery attempts (cluster lost or '

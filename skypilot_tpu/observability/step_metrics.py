@@ -76,10 +76,16 @@ class StepMetrics:
 
     def log(self, step: int, *, step_time_s: float, tokens: int,
             loss: float, grad_norm: Optional[float] = None,
+            bubble_frac: Optional[float] = None,
+            collective_wait_s: Optional[float] = None,
             extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
         """Write one record covering a window that ended at `step`:
         `step_time_s` is the mean per-step wall time over the window,
-        `tokens` the tokens consumed by ONE step."""
+        `tokens` the tokens consumed by ONE step. `bubble_frac` is
+        the pipeline schedule's idle fraction (null for non-pipeline
+        runs); `collective_wait_s` the host-observed drain wait at
+        the window boundary — the un-overlapped remainder of the
+        device critical path the --overlap knob exists to shrink."""
         tokens_per_sec = (tokens / step_time_s if step_time_s > 0
                           else 0.0)
         record: Dict[str, Any] = {
@@ -91,6 +97,11 @@ class StepMetrics:
             'grad_norm': (None if grad_norm is None
                           else float(grad_norm)),
             'mfu': self.mfu(tokens_per_sec),
+            'bubble_frac': (None if bubble_frac is None
+                            else round(float(bubble_frac), 6)),
+            'collective_wait_s': (
+                None if collective_wait_s is None
+                else round(float(collective_wait_s), 6)),
         }
         if extra:
             record.update(extra)

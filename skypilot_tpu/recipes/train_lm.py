@@ -218,6 +218,32 @@ def main() -> None:
     parser.add_argument('--microbatches', type=int, default=0,
                         help='pipeline microbatches (0 = 4 x stages; '
                              'utilization = M / (M + stages - 1))')
+    parser.add_argument('--pipeline-schedule', default='gpipe',
+                        choices=['gpipe', '1f1b', 'interleaved'],
+                        help='pipeline execution schedule (parallel/'
+                             'pipeline_schedule.py): gpipe = fused '
+                             'fill/drain scan (activation memory '
+                             'O(microbatches)); 1f1b = one-forward-'
+                             'one-backward, caps live activations at '
+                             'O(stages) so microbatches — and with '
+                             'them the bubble fraction — can scale; '
+                             'interleaved = 1f1b over --virtual-'
+                             'stages layer chunks per device, '
+                             'dividing the bubble fraction by v')
+    parser.add_argument('--virtual-stages', type=int, default=0,
+                        help='layer chunks per device for '
+                             '--pipeline-schedule interleaved '
+                             '(0 = auto: 2 for interleaved, 1 '
+                             'otherwise)')
+    parser.add_argument('--overlap', action='store_true',
+                        help='overlap collectives with compute: adds '
+                             "XLA's async-collective latency-hiding "
+                             'flags to XLA_FLAGS (TPU; no-op on '
+                             '--cpu) and, with --zero1, buckets the '
+                             'grad reduce-scatter per parameter leaf '
+                             'so it issues as backward produces each '
+                             'leaf instead of one fused update after '
+                             'the full backward')
     parser.add_argument('--seq-parallel', type=int, default=1,
                         help='context-parallel mesh axis size '
                              '(ring attention)')
@@ -268,6 +294,20 @@ def main() -> None:
                              'some TPU plugins, jax.config is not)')
     args = parser.parse_args()
 
+    if args.overlap:
+        # XLA reads XLA_FLAGS at backend init — extend it before any
+        # device access. CPU adds none: that build aborts on unknown
+        # --xla_tpu_* flags (and its collectives hide nothing).
+        from skypilot_tpu.parallel.train import overlap_xla_flags
+        flags = overlap_xla_flags('cpu' if args.cpu else None)
+        existing = os.environ.get('XLA_FLAGS', '')
+        add = [f for f in flags if f.split('=')[0] not in existing]
+        if add:
+            os.environ['XLA_FLAGS'] = (existing + ' ' +
+                                       ' '.join(add)).strip()
+            print(f'overlap: XLA_FLAGS += {" ".join(add)}',
+                  flush=True)
+
     if args.cpu:
         import jax
         jax.config.update('jax_platforms', 'cpu')
@@ -289,10 +329,17 @@ def main() -> None:
     if args.microbatches and args.pipeline_stages <= 1:
         raise SystemExit('--microbatches only applies with '
                          '--pipeline-stages > 1')
-    if args.guard and args.pipeline_stages > 1:
-        raise SystemExit('--guard needs the sharded trainer (the '
-                         'GPipe path computes per-stage losses with '
-                         'no global grad norm); drop one')
+    if args.overlap and not args.zero1 and args.pipeline_stages <= 1:
+        raise SystemExit('--overlap buckets the grad reduce-scatter '
+                         'onto the ZeRO-1 moment layout; add --zero1 '
+                         '(under --pipeline-stages it only sets the '
+                         'XLA latency-hiding flags)')
+    if args.virtual_stages and args.pipeline_schedule != 'interleaved':
+        raise SystemExit('--virtual-stages only applies with '
+                         '--pipeline-schedule interleaved')
+    if args.pipeline_schedule != 'gpipe' and args.pipeline_stages <= 1:
+        raise SystemExit('--pipeline-schedule needs '
+                         '--pipeline-stages > 1')
     if args.lora and args.pipeline_stages > 1:
         raise SystemExit('--lora needs the sharded trainer (the '
                          'GPipe path splits params per stage); '
@@ -385,14 +432,31 @@ def main() -> None:
                       f'data={mesh_cfg.data})', flush=True)
         if (args.no_fused_xent or args.zero1) and proc_id == 0:
             print('pipeline trainer: --no-fused-xent/--zero1 ignored '
-                  '(the GPipe path computes its head per-stage and '
-                  'keeps per-stage opt state)', flush=True)
-        pp = PipelinedLM(model, mesh, num_microbatches=microbatches)
+                  '(the pipeline path computes its head per-stage '
+                  'and keeps per-stage opt state)', flush=True)
+        virtual = args.virtual_stages or (
+            2 if args.pipeline_schedule == 'interleaved' else 1)
+        try:
+            pp = PipelinedLM(model, mesh,
+                             num_microbatches=microbatches,
+                             schedule=args.pipeline_schedule,
+                             virtual_stages=virtual)
+        except ValueError as e:
+            raise SystemExit(f'--pipeline-schedule: {e}') from None
+        if proc_id == 0:
+            print(f'pipeline schedule: {pp.schedule.describe()}',
+                  flush=True)
         example = jnp.zeros((batch, args.seq), jnp.int32)
         state = pp.init(jax.random.PRNGKey(0), example, tx)
         if hf_params is not None:
             hf_params = pp.split_params(hf_params)
-        step_fn = pp.make_train_step(tx)
+        step_fn = pp.make_train_step(
+            tx, guard=args.guard,
+            collect_grad_norm=args.metrics_file is not None)
+        pipeline_bubble_frac = pp.schedule.bubble_fraction
+        from skypilot_tpu.observability import catalog
+        catalog.gauge('skypilot_train_pipeline_bubble_fraction').set(
+            pipeline_bubble_frac)
     else:
         kwargs = {} if loss_fn is None else {'loss_fn': loss_fn}
         trainer = ShardedTrainer(
@@ -402,6 +466,7 @@ def main() -> None:
             # without return_hidden falls back to the naive path).
             fused_xent=False if args.no_fused_xent else None,
             zero1=args.zero1,
+            overlap=args.overlap,
             # --metrics-file wants grad_norm in every record; --guard
             # needs it unconditionally (the trainer forces it on and
             # computes the norm once for both consumers).
@@ -411,13 +476,14 @@ def main() -> None:
             **kwargs)
         if proc_id == 0:
             print(f'fused_xent={trainer.fused_xent} '
-                  f'zero1={args.zero1} lora='
+                  f'zero1={args.zero1} overlap={args.overlap} lora='
                   f'{args.lora or "off"}', flush=True)
 
         example = jnp.zeros((batch, args.seq), jnp.int32)
         with timeline.Event('train/init'):
             state = trainer.init(jax.random.PRNGKey(0), example)
         step_fn = trainer.make_train_step(example)
+        pipeline_bubble_frac = None
     if hf_params is not None:
         # Replace the random init with the imported weights, placed
         # with the SAME shardings the trainer chose (device_put
@@ -497,11 +563,9 @@ def main() -> None:
     tracing = False
 
     # Step telemetry (--metrics-file): one JSONL record per logged
-    # window. The GPipe path keeps its per-stage step fn (no grad
-    # norm); the sharded trainer returns (loss, grad_norm) — and with
-    # --guard, (loss, grad_norm, bad).
-    has_gnorm = (args.metrics_file is not None and
-                 args.pipeline_stages <= 1)
+    # window. Both trainers return (loss, grad_norm) when metrics are
+    # on — and with --guard, (loss, grad_norm, bad).
+    has_gnorm = args.metrics_file is not None
     emitter = None
     if args.metrics_file and proc_id == 0:
         from skypilot_tpu.observability.step_metrics import StepMetrics
@@ -706,7 +770,19 @@ def main() -> None:
         if boundary and proc_id == 0:
             if sup is not None:
                 sup.beat('commit')
+            # Host-observed drain wait for the in-flight step: the
+            # device's critical path (compute + any un-overlapped
+            # collectives) still outstanding at the window boundary.
+            # On TPU the --profile trace shows WHICH collectives the
+            # gap is; this counter tracks whether --overlap shrinks
+            # it run-over-run.
+            wait0 = time.perf_counter()
             jax.block_until_ready(loss)
+            collective_wait_s = time.perf_counter() - wait0
+            from skypilot_tpu.observability import catalog
+            catalog.counter(
+                'skypilot_train_collective_wait_seconds_total').inc(
+                    collective_wait_s)
             dt = time.perf_counter() - t0
             print(f'step {step + 1}/{args.steps} '
                   f'loss={float(loss):.4f} '
@@ -718,7 +794,9 @@ def main() -> None:
                     tokens=batch * args.seq,
                     loss=float(loss),
                     grad_norm=(float(gnorm) if gnorm is not None
-                               else None))
+                               else None),
+                    bubble_frac=pipeline_bubble_frac,
+                    collective_wait_s=collective_wait_s)
             t0 = time.perf_counter()
             window_tokens = 0
             window_steps = 0

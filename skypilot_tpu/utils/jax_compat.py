@@ -46,7 +46,56 @@ def supports_partial_manual_axes() -> bool:
     ("PartitionId instruction is not supported for SPMD
     partitioning"), so partial-manual callers — pipeline-with-tensor-
     within-stages — must gate on this and fall back or skip."""
-    return hasattr(jax, 'shard_map')
+    return partial_manual_unsupported_reason() is None
+
+
+_PM_REASON: Optional[list] = None
+
+
+def partial_manual_unsupported_reason() -> Optional[str]:
+    """None when partial-manual shard_map works on this jax/XLA, else
+    the exact missing feature, probed (and cached) by compiling the
+    failing ingredient: `lax.axis_index` over a manual axis while
+    another mesh axis stays auto lowers to a PartitionId HLO that
+    jax 0.4.x's bundled XLA SPMD partitioner rejects with
+    "PartitionId instruction is not supported for SPMD partitioning".
+    jax >= 0.5 (top-level `jax.shard_map`) ships an XLA that
+    partitions it. The probe needs >= 4 devices (a 2x2 manual x auto
+    mesh); with fewer it falls back to the version answer."""
+    global _PM_REASON
+    if _PM_REASON is not None:
+        return _PM_REASON[0]
+    if hasattr(jax, 'shard_map'):
+        _PM_REASON = [None]
+        return None
+    devices = jax.devices()
+    if len(devices) < 4:
+        _PM_REASON = [
+            'partial-manual shard_map needs jax >= 0.5 (top-level '
+            'jax.shard_map); the jax 0.4.x experimental `auto=` path '
+            'lowers axis_index to a PartitionId HLO its bundled XLA '
+            'rejects under SPMD partitioning (probe skipped: < 4 '
+            'devices)']
+        return _PM_REASON[0]
+    import numpy as np
+    from jax import numpy as jnp
+    from jax.experimental.shard_map import shard_map as old_sm
+    from jax.sharding import Mesh, PartitionSpec as P
+    mesh = Mesh(np.asarray(devices[:4]).reshape(2, 2),
+                ('_pm_manual', '_pm_auto'))
+
+    def probe(x):
+        return x + jax.lax.axis_index('_pm_manual')
+
+    try:
+        fn = old_sm(probe, mesh=mesh, in_specs=P('_pm_manual'),
+                    out_specs=P('_pm_manual'), check_rep=False,
+                    auto=frozenset({'_pm_auto'}))
+        jax.jit(fn)(jnp.arange(2, dtype=jnp.int32))
+        _PM_REASON = [None]
+    except Exception as e:  # pylint: disable=broad-except
+        _PM_REASON = [f'{type(e).__name__}: {str(e).splitlines()[0]}']
+    return _PM_REASON[0]
 
 
 def axis_size(axis_name) -> 'jax.Array':

@@ -49,6 +49,7 @@ _COMPILE_FILES = {
     'test_spec_batching.py', 'test_generate.py', 'test_hf_import.py',
     'test_paged_attention.py', 'test_flash_dispatch.py',
     'test_multislice.py', 'test_prefix_caching.py', 'test_pipeline.py',
+    'test_pipeline_schedule.py',
     'test_tp_serving.py', 'test_profile_trace.py', 'test_fused_xent.py',
 }
 
