@@ -25,7 +25,6 @@ import json
 import os
 import random
 import socket
-import statistics
 import subprocess
 import sys
 import threading
@@ -41,6 +40,22 @@ def _free_port() -> int:
     with socket.socket() as s:
         s.bind(('127.0.0.1', 0))
         return s.getsockname()[1]
+
+
+def pct_ms(sorted_vals, q):
+    """Linear-interpolated percentile of sorted SECONDS, in ms.
+    Nearest-rank at bench-sized N collapsed distinct percentiles
+    onto one sample (BENCH_lora_r10's p95_ttft 1480.4 vs p99 1482.62
+    were the same observation); interpolation keeps them honest —
+    always read them next to the block's n_samples."""
+    if not sorted_vals:
+        return None
+    pos = q * (len(sorted_vals) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    frac = pos - lo
+    return round(1000.0 * (sorted_vals[lo] * (1.0 - frac) +
+                           sorted_vals[hi] * frac), 2)
 
 
 def _server_env(args) -> dict:
@@ -70,6 +85,10 @@ def _build_server_cmd(args, adapter_dir=None) -> list:
         cmd += ['--kv-pool-bytes', str(args.kv_pool_bytes)]
     if args.weight_dtype:
         cmd += ['--weight-dtype', args.weight_dtype]
+    if args.kv_spill_bytes:
+        cmd += ['--kv-spill-bytes', str(args.kv_spill_bytes)]
+    if args.kv_cold_dir:
+        cmd += ['--kv-cold-dir', args.kv_cold_dir]
     if args.tensor > 1:
         cmd += ['--tensor', str(args.tensor)]
     if adapter_dir:
@@ -172,6 +191,17 @@ def _fleet_prompts(args, vocab: int, rng) -> list:
         # accidentally pins groups under the control policy.
         prompts = [systems[rng.randrange(groups)] + p
                    for p in prompts]
+    if args.long_prompt_frac > 0:
+        # Unique (uncached) long prompts spread through the workload:
+        # the compute-bound prefill traffic the disaggregated arm
+        # moves off the decode pool.
+        long_len = args.long_prompt_len or max(
+            16, args.max_total_len - args.max_new_tokens - 2)
+        n_long = int(round(args.long_prompt_frac * len(prompts)))
+        for i in range(n_long):
+            idx = (i * len(prompts)) // max(n_long, 1)
+            prompts[idx] = [rng.randrange(1, vocab)
+                            for _ in range(long_len)]
     return prompts
 
 
@@ -193,7 +223,10 @@ def _run_fleet_once(args, policy_name: str) -> dict:
     if args.stub_replicas:
         factory = rm.stub_factory(
             extra_args=['--cache-pages', str(args.stub_cache_pages),
-                        '--token-sleep-ms', '1'],
+                        '--token-sleep-ms',
+                        str(args.stub_token_sleep_ms),
+                        '--prefill-ms-per-token',
+                        str(args.stub_prefill_ms_per_token)],
             env=env)
     else:
         factory = rm.serve_lm_factory(_build_server_cmd(args),
@@ -202,28 +235,59 @@ def _run_fleet_once(args, policy_name: str) -> dict:
                                    max_replicas=args.replicas)
     autoscaler = autoscalers.EngineMetricsAutoscaler(spec)
     policy = LB_POLICY_REGISTRY.from_str(policy_name)()
+    # Disaggregated arm: a prefill pool of --prefill-replicas behind
+    # the LB's prompt-length threshold, handing KV chains to the
+    # decode pool.
+    disagg = args.prefill_replicas > 0
+    prefill_autoscaler = None
+    prefill_pool = None
+    if disagg:
+        from skypilot_tpu.serve.replica_plane import PrefillPool
+        pspec = spec_lib.SkyServiceSpec(
+            min_replicas=args.prefill_replicas,
+            max_replicas=args.prefill_replicas)
+        prefill_autoscaler = autoscalers.EngineMetricsAutoscaler(
+            pspec)
+        prefill_pool = PrefillPool()
     # --state-dir journals the bench fleet too (the per-policy
     # subdir keeps the A/B arms' journals separate): benches double
     # as adoption drills — SIGKILL the bench and the replicas can be
     # adopted or reaped by a serve_fleet pointed at the same dir.
     state_dir = (os.path.join(args.state_dir, policy_name)
                  if args.state_dir else None)
+    # Generous scrape tolerance: on a saturated 1-core bench host a
+    # slow /stats answer is load, not death — flapping NOT_READY
+    # would make the fixed-size autoscaler spawn replacement
+    # interpreters mid-run, which worsens the very contention that
+    # slowed the scrape (a spawn spiral the 30s-timeout fleet
+    # defaults are not tuned against).
     manager = ReplicaManager(factory, drain_grace_s=30.0,
+                             scrape_timeout_s=20.0,
+                             max_scrape_failures=1000,
                              state_dir=state_dir)
-    controller = FleetController(manager, policy, autoscaler,
-                                 interval_s=0.5)
+    controller = FleetController(
+        manager, policy, autoscaler, interval_s=1.0,
+        prefill_autoscaler=prefill_autoscaler,
+        prefill_pool=prefill_pool)
     lb_port = _free_port()
-    lb = make_lb_server(policy, lb_port, policy_name=policy_name,
-                        manager=manager)
+    lb = make_lb_server(
+        policy, lb_port, policy_name=policy_name, manager=manager,
+        disagg_threshold=(args.disagg_prompt_threshold
+                          if disagg else 0),
+        prefill_pool=prefill_pool)
     lb_thread = threading.Thread(target=lb.serve_forever, daemon=True)
     lb_thread.start()
     url = f'http://127.0.0.1:{lb_port}'
     try:
         for _ in range(args.replicas):
-            manager.spawn()
-        if not controller.wait_ready(args.replicas, timeout_s=300):
+            manager.spawn(role='decode' if disagg else '')
+        for _ in range(args.prefill_replicas):
+            manager.spawn(role='prefill')
+        total = args.replicas + args.prefill_replicas
+        if not controller.wait_ready(total, timeout_s=300):
             raise RuntimeError(
-                f'fleet of {args.replicas} not ready within 300s')
+                f'fleet of {total} not ready within 300s')
+        controller.tick()  # push roles/peers before traffic
         info = requests.get(url, timeout=10).json()  # via LB
         vocab = int(info['vocab_size'])
 
@@ -245,6 +309,7 @@ def _run_fleet_once(args, policy_name: str) -> dict:
         ticker.start()
 
         latencies = []
+        itl_gaps = []    # SSE inter-token gaps across ALL requests
         errors = [0]
         shed = [0]
         lock = threading.Lock()
@@ -258,6 +323,8 @@ def _run_fleet_once(args, policy_name: str) -> dict:
                     _idx, prompt = queue.pop()
                 t0 = time.perf_counter()
                 ttft = None
+                last_tok_t = None
+                gaps = []
                 try:
                     with requests.post(f'{url}/generate', json={
                             'tokens': [prompt],
@@ -275,8 +342,13 @@ def _run_fleet_once(args, policy_name: str) -> dict:
                         for raw in resp.iter_lines():
                             if not raw.startswith(b'data: '):
                                 continue
-                            if b'"token"' in raw and ttft is None:
-                                ttft = time.perf_counter() - t0
+                            if b'"token"' in raw:
+                                now = time.perf_counter()
+                                if ttft is None:
+                                    ttft = now - t0
+                                if last_tok_t is not None:
+                                    gaps.append(now - last_tok_t)
+                                last_tok_t = now
                             if raw == b'data: [DONE]':
                                 break
                 except requests.RequestException:
@@ -287,6 +359,7 @@ def _run_fleet_once(args, policy_name: str) -> dict:
                 with lock:
                     latencies.append((ttft if ttft is not None
                                       else total, total))
+                    itl_gaps.extend(gaps)
 
         start = time.perf_counter()
         threads = [threading.Thread(target=client)
@@ -303,34 +376,62 @@ def _run_fleet_once(args, policy_name: str) -> dict:
         total_hits = sum(v.prefix_hits for v in views)
         total_misses = sum(v.prefix_misses for v in views)
         ttfts = sorted(l[0] for l in latencies)
-
-        def pct(sorted_vals, q):
-            if not sorted_vals:
-                return None
-            return round(1000 * sorted_vals[
-                int(q * (len(sorted_vals) - 1))], 2)
+        gaps_sorted = sorted(itl_gaps)
+        handoffs = {'handoffs': 0, 'failures': 0, 'kv_imports': 0}
+        # DECODE-pool engine-side ITL: token-commit gaps scraped from
+        # the replicas themselves (stub /stats ships the raw recent
+        # gaps) — client SSE timing rides TCP buffering and misses
+        # ms-scale engine contention. This is the number the disagg
+        # sweep's acceptance gate reads.
+        engine_gaps = []
+        for v in views:
+            h = (v.last_stats or {}).get('handoff') or {}
+            for k in handoffs:
+                handoffs[k] += int(h.get(k, 0) or 0)
+            if disagg and v.role == 'prefill':
+                continue
+            engine_gaps.extend(
+                float(g) / 1000.0 for g in
+                ((v.last_stats or {}).get('itl_gaps_ms') or []))
+        engine_gaps.sort()
 
         return {
             'lb_policy': policy_name,
             'replicas': args.replicas,
+            'prefill_replicas': args.prefill_replicas,
+            'disagg_prompt_threshold': (args.disagg_prompt_threshold
+                                        if disagg else 0),
+            'long_prompt_frac': args.long_prompt_frac,
             'requests': len(latencies),
             'client_errors': errors[0],
             'shed_requests': shed[0],
             'req_per_sec': round(len(latencies) / elapsed, 2),
-            'p50_ttft_ms': pct(ttfts, 0.50),
-            'p95_ttft_ms': pct(ttfts, 0.95),
+            'ttft_n_samples': len(ttfts),
+            'p50_ttft_ms': pct_ms(ttfts, 0.50),
+            'p95_ttft_ms': pct_ms(ttfts, 0.95),
+            'p99_ttft_ms': pct_ms(ttfts, 0.99),
+            'sse_itl_n_samples': len(gaps_sorted),
+            'sse_itl_ms_p50': pct_ms(gaps_sorted, 0.50),
+            'sse_itl_ms_p99': pct_ms(gaps_sorted, 0.99),
+            'decode_itl_n_samples': len(engine_gaps),
+            'decode_itl_ms_p50': pct_ms(engine_gaps, 0.50),
+            'decode_itl_ms_p99': pct_ms(engine_gaps, 0.99),
             'affinity_hit_ratio': snap['affinity_hit_ratio'],
             'lb_routed': snap['routed'],
             'lb_retried': snap['retried'],
+            'handoffs': handoffs,
             'fleet_prefix_hit_rate': round(
                 total_hits / max(total_hits + total_misses, 1), 4),
             'per_replica': [{
                 'replica_id': v.replica_id,
+                'role': v.role,
                 'routed': snap['routed_per_replica'].get(
                     v.endpoint, 0),
                 'prefix_hits': v.prefix_hits,
                 'prefix_misses': v.prefix_misses,
                 'prefix_hit_rate': round(v.prefix_hit_rate, 4),
+                'kv_spill_bytes': v.kv_spill_bytes,
+                'kv_restored_pages': v.kv_restored_pages,
             } for v in views],
         }
     finally:
@@ -530,12 +631,6 @@ def _run_single(args, adapter_dir=None, assignment=None) -> dict:
         stats = requests.get(f'{url}/stats', timeout=30).json()
         serving = stats['serving']
 
-        def pct(sorted_vals, q):
-            if not sorted_vals:
-                return None
-            return round(1000 * sorted_vals[
-                int(q * (len(sorted_vals) - 1))], 2)
-
         record = {
             'engine': args.engine,
             'speculative': args.speculative,
@@ -571,20 +666,23 @@ def _run_single(args, adapter_dir=None, assignment=None) -> dict:
             # sustain the offered concurrency at this byte budget —
             # the "int8 sustains slots bf16 cannot" signal.
             'preemptions': stats.get('preemptions'),
+            # Tiered cache: the spill tier's accounting (None when
+            # the server runs without --kv-spill-bytes).
+            'kv_spill': stats.get('kv_spill'),
             'tensor': args.tensor,
             'req_per_sec': round(len(latencies) / elapsed, 2),
             'per_chip_req_per_sec': round(
                 len(latencies) / elapsed / max(args.tensor, 1), 2),
-            'p50_ttft_ms': (round(1000 * statistics.median(ttfts), 1)
-                            if ttfts else None),
-            'p95_ttft_ms': (round(
-                1000 * ttfts[int(0.95 * (len(ttfts) - 1))], 1)
-                if ttfts else None),
-            'p99_ttft_ms': pct(ttfts, 0.99),
+            'ttft_n_samples': len(ttfts),
+            'p50_ttft_ms': pct_ms(ttfts, 0.50),
+            'p95_ttft_ms': pct_ms(ttfts, 0.95),
+            'p99_ttft_ms': pct_ms(ttfts, 0.99),
+            'itl_ms_n': serving.get('itl_ms_n'),
             'itl_ms_p50': serving.get('itl_ms_p50'),
             'itl_ms_p99': serving.get('itl_ms_p99'),
-            'sse_itl_ms_p50': pct(gaps, 0.50),
-            'sse_itl_ms_p99': pct(gaps, 0.99),
+            'sse_itl_n_samples': len(gaps),
+            'sse_itl_ms_p50': pct_ms(gaps, 0.50),
+            'sse_itl_ms_p99': pct_ms(gaps, 0.99),
             # Robustness plane: degradation under --fault-plan /
             # admission control is A/B-able from the same JSON line.
             'fault_plan': bool(args.fault_plan),
@@ -693,6 +791,96 @@ def run_tensor_ab(args) -> dict:
     }
 
 
+def run_disagg_ab(args) -> dict:
+    """The disaggregation scoreboard (the committed BENCH_disagg
+    record's `sweep` half): a long-prompt-fraction sweep over TWO
+    stub fleets of equal total size — UNIFIED (every replica
+    prefills its own prompts; long prefills hold the engine lock and
+    stretch co-resident streams' inter-token gaps) vs DISAGGREGATED
+    (long prompts route to a prefill pool that hands the KV chain to
+    the decode pool; decode replicas never pay the prefill). Stub
+    replicas make the engine-contention model deterministic on a
+    1-core bench host; the real-engine bit-identity of the handoff
+    and spill paths is pinned by tier-1 (test_kv_transfer.py)."""
+    total = args.replicas + max(args.prefill_replicas, 1)
+    fracs = [0.0, 0.25, 0.5]
+    sweep = {'unified': {}, 'disagg': {}}
+    for frac in fracs:
+        unified = _run_fleet_once(
+            _with(args, long_prompt_frac=frac, prefill_replicas=0,
+                  replicas=total),
+            args.lb_policy)
+        disagg = _run_fleet_once(
+            _with(args, long_prompt_frac=frac,
+                  prefill_replicas=max(args.prefill_replicas, 1),
+                  replicas=total - max(args.prefill_replicas, 1)),
+            args.lb_policy)
+        sweep['unified'][str(frac)] = unified
+        sweep['disagg'][str(frac)] = disagg
+
+    def ratio(runs):
+        base = runs['0.0']['decode_itl_ms_p99'] or 1e-9
+        return {frac: round((runs[frac]['decode_itl_ms_p99'] or 0.0)
+                            / base, 3)
+                for frac in runs}
+
+    return {
+        'bench': 'serve_disagg_sweep',
+        'stub_replicas': True,
+        'total_replicas': total,
+        'prefill_replicas': max(args.prefill_replicas, 1),
+        'disagg_prompt_threshold': args.disagg_prompt_threshold,
+        'long_prompt_len': args.long_prompt_len,
+        'long_prompt_fracs': fracs,
+        'requests': args.requests,
+        'concurrency': args.concurrency,
+        'stub_token_sleep_ms': args.stub_token_sleep_ms,
+        'stub_prefill_ms_per_token': args.stub_prefill_ms_per_token,
+        # p99 ITL at each fraction relative to that arm's frac=0
+        # value: the acceptance gate is disagg <= 1.25 at every
+        # fraction while unified degrades.
+        'p99_itl_vs_frac0': {'unified': ratio(sweep['unified']),
+                             'disagg': ratio(sweep['disagg'])},
+        'sweep': sweep,
+    }
+
+
+def run_spill_ab(args) -> dict:
+    """The tiered-cache A/B (the committed BENCH_disagg record's
+    `spill` half): the SAME multi-session workload against a
+    pool-pressured llama-tiny server with and without the host-RAM
+    spill tier. Without it, every pool-pressure eviction recomputes
+    the prefix on the next hit; with it, the pages restore
+    bit-identically (tier-1 pins the bit-identity) — the prefix hit
+    rate must be strictly higher."""
+    runs = {
+        'no_spill': _run_single(_with(args, kv_spill_bytes=0)),
+        'spill': _run_single(_with(
+            args,
+            kv_spill_bytes=args.kv_spill_bytes or 256 * 1024 * 1024)),
+    }
+    base = runs['no_spill']
+    tier = runs['spill']
+    return {
+        'bench': 'serve_spill',
+        'engine': args.engine,
+        'model': args.model,
+        'kv_pool_bytes': args.kv_pool_bytes,
+        'kv_spill_bytes': (args.kv_spill_bytes or
+                           256 * 1024 * 1024),
+        'requests': args.requests,
+        'concurrency': args.concurrency,
+        'shared_prefix': args.shared_prefix,
+        'prefix_groups': max(1, args.prefix_groups or 1),
+        'prefix_hit_rate_no_spill': base.get('prefix_hit_rate'),
+        'prefix_hit_rate_spill': tier.get('prefix_hit_rate'),
+        'evictions_no_spill': base.get('prefix_evictions'),
+        'restored_pages': ((tier.get('kv_spill') or {})
+                           .get('restored_pages')),
+        'runs': runs,
+    }
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument('--engine', choices=['continuous', 'simple'],
@@ -783,6 +971,58 @@ def main() -> None:
                              '(pages); bound it below the working '
                              'set to make prefix duplication '
                              'measurable')
+    parser.add_argument('--stub-token-sleep-ms', type=float,
+                        default=1.0,
+                        help='stub replica per-token engine-lock '
+                             'hold (the decode cadence)')
+    parser.add_argument('--stub-prefill-ms-per-token', type=float,
+                        default=0.0,
+                        help='stub replica simulated prefill cost '
+                             'per missed prompt token (held in '
+                             'page-sized engine-lock chunks — the '
+                             'contention long prompts inflict on '
+                             'co-resident decode streams)')
+    parser.add_argument('--prefill-replicas', type=int, default=0,
+                        metavar='N',
+                        help='fleet mode: N additional prefill-role '
+                             'replicas (disaggregated serving); '
+                             'long prompts route to them and hand '
+                             'their KV chains to the decode pool')
+    parser.add_argument('--disagg-prompt-threshold', type=int,
+                        default=256, metavar='T',
+                        help='LB prompt-length threshold (tokens) '
+                             'for routing to the prefill pool')
+    parser.add_argument('--long-prompt-len', type=int, default=0,
+                        metavar='L',
+                        help='token length of --long-prompt-frac '
+                             'prompts (0 = derived from '
+                             '--max-total-len; set explicitly for '
+                             'stub fleets, which have no real '
+                             'context limit)')
+    parser.add_argument('--kv-spill-bytes', type=int, default=0,
+                        metavar='B',
+                        help='forwarded to serve_lm '
+                             '--kv-spill-bytes (tiered prefix '
+                             'cache: evicted pages spill to host '
+                             'RAM and restore on hit)')
+    parser.add_argument('--kv-cold-dir', default=None, metavar='DIR',
+                        help='forwarded to serve_lm --kv-cold-dir')
+    parser.add_argument('--disagg-ab', action='store_true',
+                        help='run the long-prompt-fraction sweep '
+                             '{0, 0.25, 0.5} over equal-size '
+                             'UNIFIED vs DISAGGREGATED stub fleets '
+                             'and emit one combined JSON object '
+                             '(the committed BENCH_disagg sweep). '
+                             'Implies --stub-replicas')
+    parser.add_argument('--spill-ab', action='store_true',
+                        help='run the identical pool-pressured '
+                             'workload with and without the '
+                             'host-RAM spill tier and emit one '
+                             'combined JSON object (the committed '
+                             'BENCH_disagg spill record). '
+                             'Single-server llama-tiny mode; use '
+                             'with --kv-pool-bytes + '
+                             '--shared-prefix + --prefix-groups')
     parser.add_argument('--state-dir', default=None, metavar='DIR',
                         help='fleet mode: journal replica lifecycle '
                              'to DIR/<policy>/fleet.journal (the '
@@ -889,6 +1129,27 @@ def main() -> None:
         parser.error('--quant-ab is a single-server mode')
     if args.tensor_ab and (args.replicas or args.adapters):
         parser.error('--tensor-ab is a single-server mode')
+
+    if args.disagg_ab:
+        if args.spill_ab or args.adapters or args.quant_ab:
+            parser.error('--disagg-ab composes only with fleet '
+                         'knobs (it runs its own stub fleets)')
+        args.stub_replicas = True
+        if not args.replicas:
+            args.replicas = 2
+        if not args.long_prompt_len:
+            args.long_prompt_len = 512
+        print(json.dumps(run_disagg_ab(args)))
+        return
+    if args.spill_ab:
+        if args.replicas or args.adapters:
+            parser.error('--spill-ab is a single-server mode')
+        if args.engine != 'continuous':
+            parser.error('--spill-ab needs --engine continuous (the '
+                         'spill tier lives in the paged slot '
+                         'engine)')
+        print(json.dumps(run_spill_ab(args)))
+        return
 
     if args.quant_ab:
         print(json.dumps(run_quant_ab(args)))

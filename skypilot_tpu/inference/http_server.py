@@ -225,6 +225,8 @@ def make_server(rt: InferenceRuntime,
             body = {'serving': rt.metrics.snapshot(),
                     'instance_uuid': INSTANCE_UUID,
                     'pid': os.getpid(),
+                    # Disaggregated serving: '' = unified replica.
+                    'role': rt.role,
                     # Quantized-serving storage formats + weight
                     # footprint (docs/guides.md "Quantized serving").
                     'storage': {
@@ -232,6 +234,8 @@ def make_server(rt: InferenceRuntime,
                         'weight_dtype': rt.weight_dtype,
                         'weight_bytes': rt.weight_bytes,
                     }}
+            if rt.role or rt.handoffs_total or rt.kv_imports_total:
+                body['handoff'] = rt.handoff_stats()
             if rt.adapters is not None:
                 body['adapters'] = rt.adapters.stats()
             if engine is None:
@@ -292,6 +296,19 @@ def make_server(rt: InferenceRuntime,
                         'evictions': pc.evictions,
                         'resident_unreferenced': len(pc.lru),
                     }
+                if engine.spill_tier is not None:
+                    # Tiered cache: the host/cold spill tier's own
+                    # accounting + the engine-level restore outcome
+                    # (docs/guides.md "Disaggregated serving & cache
+                    # tiering").
+                    spill = engine.spill_tier.stats()
+                    spill.update({
+                        'restore_lookups': engine.kv_restore_lookups,
+                        'restore_hits': engine.kv_restore_hits,
+                        'restored_into_pool':
+                            engine.kv_restored_pages,
+                    })
+                    body['kv_spill'] = spill
             self._json(body)
 
         # -- POST ---------------------------------------------------
@@ -305,26 +322,203 @@ def make_server(rt: InferenceRuntime,
                     _inflight['n'] -= 1
 
         def _read_body(self):
+            # The KV-handoff paths re-dispatch an embedded request
+            # into the normal handlers; the injected body stands in
+            # for the (already consumed) socket payload.
+            injected = getattr(self, '_injected_body', None)
+            if injected is not None:
+                self._injected_body = None
+                return injected
             length = int(self.headers.get('Content-Length', 0))
             return json.loads(self.rfile.read(length))
+
+        def _route_generation(self, path):
+            """Generation-path handler for `path`, or None. Shared by
+            the normal POST dispatch and the /kv/import embedded-
+            request re-dispatch (the decode side of a handoff)."""
+            if path == '/v1/completions':
+                return self._openai_completions
+            if path == '/v1/chat/completions':
+                return self._openai_chat
+            if path in ('/generate_text', '/v1/generate_text'):
+                return self._generate_text
+            if path in ('/generate', '/v1/generate'):
+                return self._generate
+            return None
 
         def _do_post(self):
             if faults.point('http.handler') is faults.DROP:
                 return  # injected blackhole: client sees a hang/reset
-            if self.path == '/v1/completions':
-                self._openai_completions()
+            if self.path == '/kv/import':
+                self._kv_import()
                 return
-            if self.path == '/v1/chat/completions':
-                self._openai_chat()
+            if self.path == '/kv/peers':
+                self._kv_peers()
                 return
-            if self.path in ('/generate_text', '/v1/generate_text'):
-                self._generate_text()
-                return
-            if self.path not in ('/generate', '/v1/generate'):
+            handler = self._route_generation(self.path)
+            if handler is None:
                 self._json({'error': 'POST /generate, /generate_text, '
                                      'or /v1/completions'}, 404)
                 return
-            self._generate()
+            if rt.role == 'prefill':
+                try:
+                    body = self._read_body()
+                except (ValueError, OSError):
+                    body = None  # malformed: the handler's 400 to give
+                if body is not None:
+                    if self._maybe_handoff(self.path, body):
+                        return
+                    self._injected_body = body
+            handler()
+
+        # -- disaggregated prefill/decode handoff -------------------
+        def _kv_peers(self):
+            """Fleet-controller push of the decode pool this prefill
+            replica hands off to."""
+            try:
+                req = self._read_body()
+                peers = [str(p) for p in (req.get('decode') or [])]
+                rt.set_decode_peers(peers)
+                self._json({'decode': peers})
+            except Exception as e:  # pylint: disable=broad-except
+                self._json({'error': f'{type(e).__name__}: {e}'}, 400)
+
+        def _kv_import(self):
+            """Decode side of a handoff: scatter the POSTed page
+            chain into the pool + prefix cache and — when the body
+            embeds the original request — serve it immediately: the
+            admission finds every full prompt page already resident,
+            so the request enters decoding with only the sub-page
+            prompt tail recomputed (no re-prefill)."""
+            import base64
+            try:
+                req = self._read_body()
+                data = base64.b64decode(req['payload'])
+                eng = rt.engine if rt.engine is not None \
+                    else rt.stream_engine()
+                summary = eng.import_chain(data)
+                rt.record_kv_import(summary)
+            except Exception as e:  # pylint: disable=broad-except
+                self._plain_error(e)
+                return
+            inner = req.get('request')
+            if not inner:
+                self._json({'imported': summary})
+                return
+            inner_path = str(req.get('path') or '/generate')
+            handler = self._route_generation(inner_path)
+            if handler is None:
+                self._json({'error': f'unroutable handoff path '
+                                     f'{inner_path!r}'}, 400)
+                return
+            self.path = inner_path
+            self._injected_body = inner
+            handler()
+
+        def _maybe_handoff(self, path, req) -> bool:
+            """Prefill-role disaggregation: prefill the prompt
+            locally (1-token generation — its pages promote into the
+            prefix cache), export the page chain, POST it with the
+            original request to the affinity-assigned decode peer,
+            and proxy that peer's response back. True = the client
+            was fully answered from the decode pool. ANY failure —
+            injected kv.handoff fault, unreachable peer, decode-side
+            shed (429/503) — returns False and the caller serves the
+            request locally from the already-warm pages (graceful
+            fallback, never a client-visible error)."""
+            peers = rt.decode_peers()
+            eng = rt.engine
+            if not peers or eng is None or \
+                    not getattr(eng, 'prefix_caching', False):
+                return False
+            if path not in ('/generate', '/v1/generate'):
+                # Text endpoints have no token ids here; they serve
+                # locally on the prefill replica (the LB's length
+                # threshold only routes token requests this way).
+                return False
+            rows = req.get('tokens') or []
+            if not rows or not isinstance(rows[0], list) or \
+                    len(rows) != 1:
+                return False  # batch rows: local (no chain per row)
+            import base64
+
+            import requests as requests_lib
+
+            from skypilot_tpu.inference import affinity
+            t0 = time.monotonic()
+            nbytes = 0
+            try:
+                if faults.point('kv.handoff') is faults.DROP:
+                    raise RuntimeError('injected kv.handoff drop')
+                row = [int(t) for t in rows[0]]
+                adapter = rt.resolve_model(req.get('model'))
+                deadline_s = rt.deadline_for(req)
+                limit = rt.limit_for(0.0, streaming=True)
+                if len(row) >= limit:
+                    return False  # the handler's 400 to give
+                # Local prefill: ONE generated token forces the
+                # prompt through the (chunked) prefill path and
+                # promotes its full pages into the prefix cache.
+                eng.submit(row, max_new_tokens=1, temperature=0.0,
+                           deadline_s=deadline_s,
+                           adapter=adapter).result(
+                               timeout=deadline_s + 30.0)
+                data = eng.export_chain(row, adapter=adapter)
+                if not data:
+                    return False  # sub-page prompt: nothing to ship
+                key = affinity.token_affinity_key(
+                    row, eng.page_size,
+                    salt=affinity.adapter_salt(req.get('model')))
+                peer = rt.pick_decode_peer(key)
+                if peer is None:
+                    return False
+                nbytes = len(data)
+                upstream = requests_lib.post(
+                    f'http://{peer}/kv/import',
+                    json={'payload':
+                          base64.b64encode(data).decode(),
+                          'path': path, 'request': req},
+                    stream=True,
+                    timeout=(3.0, deadline_s + 60.0))
+                if upstream.status_code in (429, 500, 502, 503):
+                    code = upstream.status_code
+                    upstream.close()
+                    raise RuntimeError(
+                        f'decode replica {peer} answered {code}')
+            except Exception as e:  # pylint: disable=broad-except
+                rt.record_handoff(time.monotonic() - t0, nbytes,
+                                  ok=False)
+                print(f'kv handoff failed ({type(e).__name__}: {e}); '
+                      f'serving locally', flush=True)
+                return False
+            # Stream the decode replica's response through. Headers
+            # out = the handoff is committed; a mid-stream death
+            # truncates exactly like a direct replica death would.
+            rt.record_handoff(time.monotonic() - t0, nbytes, ok=True)
+            with upstream:
+                self.send_response(upstream.status_code)
+                ctype = upstream.headers.get('Content-Type',
+                                             'application/json')
+                self.send_header('Content-Type', ctype)
+                body_bytes = None
+                if 'text/event-stream' not in ctype:
+                    body_bytes = upstream.content
+                    self.send_header('Content-Length',
+                                     str(len(body_bytes)))
+                self.end_headers()
+                if body_bytes is not None:
+                    self.wfile.write(body_bytes)
+                    return True
+                self._sse_open = True
+                try:
+                    for chunk in upstream.iter_content(8192):
+                        if chunk:
+                            self.wfile.write(chunk)
+                            self.wfile.flush()
+                except (requests_lib.RequestException, OSError) as e:
+                    print(f'kv handoff stream truncated '
+                          f'({type(e).__name__})', flush=True)
+            return True
 
         def _generate(self):
             try:

@@ -1,0 +1,335 @@
+"""KV-page wire format + tiered prefix-cache spill storage.
+
+Two consumers, one page encoding:
+
+  - DISAGGREGATED PREFILL/DECODE HANDOFF: a prefill replica finishes
+    a prompt, exports the prompt's full-page KV chain from its prefix
+    cache (`ContinuousBatchingEngine.export_chain`) and POSTs the
+    packed bytes to the assigned decode replica's `/kv/import`, which
+    scatters them into its own page pool and admits the request with
+    the prompt's pages already resident — decode never pays the
+    compute-bound prefill (only the sub-page prompt tail, < one page,
+    is recomputed locally, which is what keeps the existing
+    at-least-one-token admission contract intact).
+  - TIERED PREFIX CACHE: pages the cache would drop under pool
+    pressure (`PrefixCache.evict_into`) spill — payload + scales +
+    chain key — into a bounded host-RAM LRU (`HostSpillTier`), with
+    an optional cold tier (`ColdTier`: a local directory or gs://
+    prefix) behind it; a later chain-key hit restores the exact bytes
+    instead of recomputing the prefill.
+
+The encoding is FORMAT-BLIND by construction: it serializes whatever
+leaves the paged cache holds — bf16 (or f32) k/v page arrays, or
+int8 pages plus their parallel f32 scale arrays — as raw bytes with
+dtype/shape metadata. int8 pages travel as int8 (no dequantize on
+the wire), so export -> import round trips are bit-identical and a
+restored page is indistinguishable from a freshly computed one.
+
+Wire layout: MAGIC ++ u64 header length ++ header JSON ++ payload.
+The header carries the chain keys (hex), the adapter salt, the page
+geometry (kv_dtype, page_size) and one (path, dtype, shape) record
+per cache leaf; the payload is each leaf's page-major array bytes in
+header order. Everything is numpy + stdlib — the packing side runs
+on the engine scheduler thread, the unpacking side may run anywhere.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import subprocess
+import tempfile
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from skypilot_tpu.utils import ux_utils
+
+#: Wire magic + version. Bump on any layout change: an importer must
+#: never guess at bytes from a different build.
+MAGIC = b'STPUKV1\n'
+
+
+def _dtype_of(name: str) -> np.dtype:
+    """Resolve a dtype name, including the ml_dtypes extended set
+    (bfloat16 is the serving default page dtype)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def pack_pages(blobs: Dict[str, np.ndarray],
+               meta: Dict[str, Any]) -> bytes:
+    """Serialize page-major per-leaf arrays + metadata. `blobs` maps
+    a cache leaf path to an array whose LEADING axis is the page
+    index (gather_page_rows layout); every leaf must agree on the
+    page count. `meta` must already carry kv_dtype/page_size/keys/
+    salt — this function only adds the leaf table."""
+    leaves = []
+    payload = []
+    n_pages = None
+    for path in sorted(blobs):
+        arr = np.ascontiguousarray(blobs[path])
+        if n_pages is None:
+            n_pages = arr.shape[0]
+        elif arr.shape[0] != n_pages:
+            raise ValueError(
+                f'leaf {path} has {arr.shape[0]} pages, expected '
+                f'{n_pages} (all leaves must cover the same chain)')
+        leaves.append({'path': path, 'dtype': arr.dtype.name,
+                       'shape': list(arr.shape)})
+        payload.append(arr.tobytes())
+    header = dict(meta)
+    header['version'] = 1
+    header['n_pages'] = int(n_pages or 0)
+    header['leaves'] = leaves
+    hjson = json.dumps(header, sort_keys=True).encode()
+    return (MAGIC + len(hjson).to_bytes(8, 'big') + hjson +
+            b''.join(payload))
+
+
+def unpack_pages(data: bytes
+                 ) -> Tuple[Dict[str, Any], Dict[str, np.ndarray]]:
+    """Inverse of `pack_pages`. Raises ValueError on anything that is
+    not a well-formed chain of the advertised geometry — the caller
+    (HTTP import, cold-tier read) treats that as a failed transfer
+    and falls back, never as a crash."""
+    if not data.startswith(MAGIC):
+        raise ValueError('not a KV page chain (bad magic)')
+    off = len(MAGIC)
+    hlen = int.from_bytes(data[off:off + 8], 'big')
+    off += 8
+    try:
+        meta = json.loads(data[off:off + hlen])
+    except ValueError as e:
+        raise ValueError(f'corrupt KV chain header: {e}') from e
+    off += hlen
+    if meta.get('version') != 1:
+        raise ValueError(
+            f'unsupported KV chain version {meta.get("version")!r}')
+    blobs: Dict[str, np.ndarray] = {}
+    for leaf in meta.get('leaves', []):
+        dtype = _dtype_of(leaf['dtype'])
+        shape = tuple(int(s) for s in leaf['shape'])
+        nbytes = dtype.itemsize * int(np.prod(shape, dtype=np.int64))
+        raw = data[off:off + nbytes]
+        if len(raw) != nbytes:
+            raise ValueError(
+                f'truncated KV chain payload at leaf {leaf["path"]}')
+        blobs[leaf['path']] = np.frombuffer(
+            raw, dtype=dtype).reshape(shape)
+        off += nbytes
+    if off != len(data):
+        raise ValueError(
+            f'{len(data) - off} trailing bytes after KV chain payload')
+    return meta, blobs
+
+
+def split_pages(blobs: Dict[str, np.ndarray], n_pages: int
+                ) -> List[Dict[str, np.ndarray]]:
+    """Page-major chain arrays -> one {path: row} blob per page (the
+    spill tier's unit)."""
+    return [{path: arr[i] for path, arr in blobs.items()}
+            for i in range(n_pages)]
+
+
+def join_pages(page_blobs: List[Dict[str, np.ndarray]]
+               ) -> Dict[str, np.ndarray]:
+    """Inverse of `split_pages`: stack per-page blobs back into the
+    page-major chain layout (restore/import scatter input)."""
+    assert page_blobs
+    return {path: np.stack([blob[path] for blob in page_blobs])
+            for path in page_blobs[0]}
+
+
+def page_blob_nbytes(blob: Dict[str, np.ndarray]) -> int:
+    return int(sum(arr.nbytes for arr in blob.values()))
+
+
+class ColdTier:
+    """Content-addressed page blobs in a directory — the cache's
+    coldest tier, for giant shared system prompts that should survive
+    process restarts (and, under the crash-only controller, replica
+    replacement). `root` is a local directory or a gs:// prefix
+    (gs:// objects move via gsutil; failures are logged and the page
+    is simply treated as not-cold-cached — the tier is an
+    optimization, never a correctness dependency)."""
+
+    def __init__(self, root: str) -> None:
+        self.root = root.rstrip('/')
+        self.is_gs = self.root.startswith('gs://')
+        if not self.is_gs:
+            os.makedirs(self.root, exist_ok=True)
+        self.writes = 0
+        self.reads = 0
+        self.errors = 0
+
+    def _path(self, key: bytes) -> str:
+        return f'{self.root}/{key.hex()}.kvpage'
+
+    def put(self, key: bytes, blob: Dict[str, np.ndarray]) -> None:
+        data = pack_pages(join_pages([blob]), {'kind': 'cold_page'})
+        try:
+            if self.is_gs:
+                with tempfile.NamedTemporaryFile(delete=False) as f:
+                    f.write(data)
+                    tmp = f.name
+                try:
+                    subprocess.run(['gsutil', '-q', 'cp', tmp,
+                                    self._path(key)], check=True,
+                                   capture_output=True)
+                finally:
+                    os.unlink(tmp)
+            else:
+                tmp = f'{self._path(key)}.tmp.{os.getpid()}'
+                with open(tmp, 'wb') as f:
+                    f.write(data)
+                os.replace(tmp, self._path(key))
+            self.writes += 1
+        except (OSError, subprocess.SubprocessError) as e:
+            self.errors += 1
+            ux_utils.log(f'kv cold tier: write of page '
+                         f'{key.hex()[:12]} failed ({e}); dropping.')
+
+    def get(self, key: bytes) -> Optional[Dict[str, np.ndarray]]:
+        try:
+            if self.is_gs:
+                with tempfile.NamedTemporaryFile(delete=False) as f:
+                    tmp = f.name
+                try:
+                    subprocess.run(['gsutil', '-q', 'cp',
+                                    self._path(key), tmp], check=True,
+                                   capture_output=True)
+                    with open(tmp, 'rb') as f:
+                        data = f.read()
+                finally:
+                    os.unlink(tmp)
+            else:
+                try:
+                    with open(self._path(key), 'rb') as f:
+                        data = f.read()
+                except FileNotFoundError:
+                    return None
+            _meta, blobs = unpack_pages(data)
+            self.reads += 1
+            return split_pages(blobs, 1)[0]
+        except (OSError, ValueError, subprocess.SubprocessError) as e:
+            self.errors += 1
+            ux_utils.log(f'kv cold tier: read of page '
+                         f'{key.hex()[:12]} failed ({e}); treating as '
+                         f'a miss.')
+            return None
+
+    def stats(self) -> Dict[str, Any]:
+        return {'root': self.root, 'writes': self.writes,
+                'reads': self.reads, 'errors': self.errors}
+
+
+class HostSpillTier:
+    """Bounded host-RAM LRU of evicted prefix-cache pages, keyed by
+    chain key. `put` is called by `PrefixCache.evict_into` on the
+    engine scheduler thread with the page's exact device bytes; `get`
+    restores them on a later chain hit (restore == fresh compute,
+    bit-identical). Pages LRU-evicted from host RAM fall through to
+    the cold tier when one is configured, otherwise they are dropped
+    (back to the pre-tier recompute behavior).
+
+    Thread-safe: puts/gets run on the scheduler thread, but /stats
+    scrapes the counters from HTTP threads."""
+
+    def __init__(self, capacity_bytes: int,
+                 cold: Optional[ColdTier] = None) -> None:
+        self.capacity_bytes = int(capacity_bytes)
+        self.cold = cold
+        self._lock = threading.Lock()
+        self._pages: 'collections.OrderedDict[bytes, Dict[str, np.ndarray]]' = \
+            collections.OrderedDict()
+        self.bytes = 0
+        self.spilled_pages = 0      # puts (from evictions)
+        self.restored_pages = 0     # gets that hit (host or cold)
+        self.cold_demotions = 0     # host LRU -> cold tier
+        self.dropped_pages = 0      # host LRU -> nowhere
+        self.lookups = 0
+        self.hits = 0
+
+    def put(self, key: bytes, blob: Dict[str, np.ndarray]) -> None:
+        nbytes = page_blob_nbytes(blob)
+        with self._lock:
+            old = self._pages.pop(key, None)
+            if old is not None:
+                self.bytes -= page_blob_nbytes(old)
+            self._pages[key] = blob
+            self.bytes += nbytes
+            self.spilled_pages += 1
+            demote = []
+            while self.bytes > self.capacity_bytes and \
+                    len(self._pages) > 1:
+                victim_key, victim = self._pages.popitem(last=False)
+                self.bytes -= page_blob_nbytes(victim)
+                demote.append((victim_key, victim))
+        for victim_key, victim in demote:
+            if self.cold is not None:
+                self.cold_demotions += 1
+                self.cold.put(victim_key, victim)
+            else:
+                self.dropped_pages += 1
+
+    def get(self, key: bytes) -> Optional[Dict[str, np.ndarray]]:
+        with self._lock:
+            self.lookups += 1
+            blob = self._pages.get(key)
+            if blob is not None:
+                self._pages.move_to_end(key)
+                self.hits += 1
+                self.restored_pages += 1
+                return blob
+        if self.cold is None:
+            return None
+        blob = self.cold.get(key)
+        if blob is None:
+            return None
+        with self._lock:
+            self.hits += 1
+            self.restored_pages += 1
+        # Promote back to the host tier (it is hot again).
+        self.put(key, blob)
+        with self._lock:
+            self.spilled_pages -= 1  # the promotion is not a spill
+        return blob
+
+    def resident_pages(self) -> int:
+        with self._lock:
+            return len(self._pages)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            body = {
+                'capacity_bytes': self.capacity_bytes,
+                'bytes': self.bytes,
+                'resident_pages': len(self._pages),
+                'spilled_pages': self.spilled_pages,
+                'restored_pages': self.restored_pages,
+                'lookups': self.lookups,
+                'hits': self.hits,
+                'hit_rate': round(self.hits / max(self.lookups, 1), 4),
+                'cold_demotions': self.cold_demotions,
+                'dropped_pages': self.dropped_pages,
+            }
+        if self.cold is not None:
+            body['cold'] = self.cold.stats()
+        return body
+
+
+def make_spill_tier(spill_bytes: int,
+                    cold_dir: Optional[str] = None
+                    ) -> Optional[HostSpillTier]:
+    """The serve_lm --kv-spill-bytes/--kv-cold-dir wiring: a cold dir
+    without a host budget still gets a small host tier in front (the
+    cold tier alone would make every restore a file read)."""
+    if not spill_bytes and not cold_dir:
+        return None
+    cold = ColdTier(cold_dir) if cold_dir else None
+    return HostSpillTier(spill_bytes or 64 * 1024 * 1024, cold=cold)

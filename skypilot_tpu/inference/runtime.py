@@ -92,11 +92,19 @@ class ServingMetrics:
 
     @staticmethod
     def _pct(values: List[float], q: float) -> Optional[float]:
+        """Linear-interpolated percentile (numpy's default method).
+        Nearest-rank reporting at small N made distinct percentiles
+        collapse onto the same sample (p95 == p99 with 60 requests),
+        which misreads as a flat tail; interpolation keeps them
+        distinct and converges to the same values at large N."""
         if not values:
             return None
         s = sorted(values)
-        idx = min(len(s) - 1, int(round(q * (len(s) - 1))))
-        return round(s[idx], 2)
+        pos = q * (len(s) - 1)
+        lo = int(pos)
+        hi = min(lo + 1, len(s) - 1)
+        frac = pos - lo
+        return round(s[lo] * (1.0 - frac) + s[hi] * frac, 2)
 
     def snapshot(self) -> Dict[str, object]:
         """JSON stats. Window semantics: every `*_p50`/`*_p95` key and
@@ -118,6 +126,12 @@ class ServingMetrics:
             'requests_shed': shed,
             'deadline_exceeded': expired,
             'window': self.window,
+            # Sample counts per latency block: percentiles over a
+            # handful of samples are noise — consumers (benches,
+            # dashboards) can qualify them.
+            'ttft_ms_n': len(ttft),
+            'itl_ms_n': len(itl),
+            'latency_ms_n': len(lat),
             'ttft_ms_p50': self._pct(ttft, 0.50),
             'ttft_ms_p95': self._pct(ttft, 0.95),
             'ttft_ms_p99': self._pct(ttft, 0.99),
@@ -211,10 +225,36 @@ class InferenceRuntime:
                  max_queue_tokens: int = 0,
                  adapters=None,
                  kv_dtype: str = 'bf16',
-                 weight_dtype: str = 'bf16') -> None:
+                 weight_dtype: str = 'bf16',
+                 role: str = '',
+                 decode_peers: Optional[List[str]] = None) -> None:
         import jax
         self.model = model
         self.params = params
+        # Disaggregated serving (docs/guides.md "Disaggregated
+        # serving & cache tiering"): '' = unified replica (the
+        # classic mode), 'decode' labels a decode-pool member,
+        # 'prefill' additionally hands finished prompts' KV page
+        # chains off to a decode peer instead of decoding locally.
+        if role not in ('', 'unified', 'prefill', 'decode'):
+            raise ValueError(f'unknown serving role {role!r}')
+        self.role = '' if role == 'unified' else role
+        self._peers_lock = threading.Lock()
+        self._decode_peers: List[str] = []
+        self._peer_ring = None
+        self._handoff_lock = threading.Lock()
+        self.handoffs_total = 0
+        self.handoff_failures = 0
+        self.handoff_bytes_total = 0
+        self.kv_imports_total = 0
+        self.kv_imported_pages_total = 0
+        from skypilot_tpu.observability import catalog as _obs
+        self._handoff_seconds = _obs.histogram(
+            'skypilot_serving_kv_handoff_seconds')
+        self._handoff_bytes = _obs.counter(
+            'skypilot_serving_kv_handoff_bytes_total')
+        if decode_peers:
+            self.set_decode_peers(decode_peers)
         # Quantized-serving storage formats (inference/quant.py +
         # the model config's kv_dtype) — /stats and the
         # skypilot_serving_storage_info series report them.
@@ -272,6 +312,75 @@ class InferenceRuntime:
         if self.speculative > 0 and temperature == 0.0:
             return self.spec_total
         return self.max_total_len
+
+    # -- disaggregated prefill/decode ---------------------------------------
+    def set_decode_peers(self, peers: List[str]) -> None:
+        """Install the decode pool this prefill replica hands off to
+        (endpoint strings 'host:port'). Pushed by the fleet
+        controller via POST /kv/peers whenever the decode ready set
+        changes; also settable statically with --decode-peers. The
+        peer ring is the SAME consistent-hash mapping the LB's
+        prefix-affinity policy uses over the same endpoint strings,
+        so a handed-off session's follow-up requests (routed by the
+        LB directly to the decode pool) land on the replica that
+        already holds the imported pages."""
+        from skypilot_tpu.serve import \
+            load_balancing_policies as lb_policies
+        peers = list(dict.fromkeys(str(p) for p in peers if p))
+        ring = None
+        if peers:
+            ring = lb_policies.PrefixAffinityPolicy()
+            ring.set_ready_replicas(peers)
+        with self._peers_lock:
+            self._decode_peers = peers
+            self._peer_ring = ring
+
+    def decode_peers(self) -> List[str]:
+        with self._peers_lock:
+            return list(self._decode_peers)
+
+    def pick_decode_peer(self, key: Optional[str]) -> Optional[str]:
+        """Handoff target for an affinity key: the ring's owner (the
+        replica the LB would also pick for this session), else the
+        first peer for keyless prompts."""
+        with self._peers_lock:
+            peers = list(self._decode_peers)
+            ring = self._peer_ring
+        if not peers:
+            return None
+        if key is not None and ring is not None:
+            target = ring.affinity_target(key)
+            if target is not None:
+                return target
+        return peers[0]
+
+    def record_handoff(self, seconds: float, nbytes: int,
+                       ok: bool) -> None:
+        with self._handoff_lock:
+            self.handoffs_total += 1
+            if not ok:
+                self.handoff_failures += 1
+            self.handoff_bytes_total += nbytes
+        self._handoff_seconds.observe(seconds)
+        if nbytes:
+            self._handoff_bytes.inc(nbytes)
+
+    def record_kv_import(self, summary: Dict[str, int]) -> None:
+        with self._handoff_lock:
+            self.kv_imports_total += 1
+            self.kv_imported_pages_total += int(
+                summary.get('imported', 0))
+
+    def handoff_stats(self) -> Dict[str, object]:
+        with self._handoff_lock:
+            return {
+                'decode_peers': self.decode_peers(),
+                'handoffs': self.handoffs_total,
+                'failures': self.handoff_failures,
+                'bytes': self.handoff_bytes_total,
+                'kv_imports': self.kv_imports_total,
+                'kv_imported_pages': self.kv_imported_pages_total,
+            }
 
     # -- model / adapter resolution -----------------------------------------
     def resolve_model(self, model_field) -> Optional[str]:
@@ -681,6 +790,26 @@ def build_runtime(args) -> InferenceRuntime:
     request_timeout = getattr(args, 'request_timeout', 600.0)
     max_queue_requests = getattr(args, 'max_queue_requests', 0)
     max_queue_tokens = getattr(args, 'max_queue_tokens', 0)
+    # Disaggregation + tiered-cache knobs. Both need the paged slot
+    # engine: the spill tier stores prefix-cache pages, and a prefill
+    # role without an exportable prefix cache has nothing to hand off.
+    role = getattr(args, 'role', '') or ''
+    kv_spill_bytes = int(getattr(args, 'kv_spill_bytes', 0) or 0)
+    kv_cold_dir = getattr(args, 'kv_cold_dir', None)
+    decode_peers = [p for p in
+                    (getattr(args, 'decode_peers', None) or ''
+                     ).split(',') if p]
+    if (kv_spill_bytes or kv_cold_dir) and \
+            not args.continuous_batching:
+        raise SystemExit(
+            '--kv-spill-bytes/--kv-cold-dir need '
+            '--continuous-batching (the spill tier stores evicted '
+            'prefix-cache pages of the paged slot engine)')
+    if role == 'prefill' and not args.continuous_batching:
+        raise SystemExit(
+            '--role prefill needs --continuous-batching (the handoff '
+            'exports KV page chains from the slot engine\'s prefix '
+            'cache)')
     if args.continuous_batching:
         from skypilot_tpu.models.batching import ContinuousBatchingEngine
         decode_chunk = getattr(args, 'decode_chunk', 1)
@@ -711,7 +840,9 @@ def build_runtime(args) -> InferenceRuntime:
             pipeline_decode=pipeline_decode,
             max_queue_requests=max_queue_requests,
             max_queue_tokens=max_queue_tokens,
-            adapter_store=adapters)
+            adapter_store=adapters,
+            kv_spill_bytes=kv_spill_bytes,
+            kv_cold_dir=kv_cold_dir)
 
     rt = InferenceRuntime(
         model=model, params=params, vocab_size=vocab_size,
@@ -727,7 +858,8 @@ def build_runtime(args) -> InferenceRuntime:
         max_queue_requests=max_queue_requests,
         max_queue_tokens=max_queue_tokens,
         adapters=adapters,
-        kv_dtype=kv_dtype, weight_dtype=weight_dtype)
+        kv_dtype=kv_dtype, weight_dtype=weight_dtype,
+        role=role, decode_peers=decode_peers)
     from skypilot_tpu.observability import catalog as _obs_catalog
     _obs_catalog.gauge('skypilot_serving_weight_bytes').set(
         rt.weight_bytes)

@@ -83,7 +83,8 @@ class PrefixCache:
     """
 
     def __init__(self, page_size: int,
-                 metrics: Optional['_obs.EngineMetrics'] = None) -> None:
+                 metrics: Optional['_obs.EngineMetrics'] = None,
+                 spill=None, fetch_pages=None) -> None:
         self.page_size = page_size
         self.by_key: Dict[bytes, int] = {}
         self.key_of: Dict[int, bytes] = {}
@@ -95,6 +96,14 @@ class PrefixCache:
         self.misses = 0     # full prompt pages that had to be computed
         self.evictions = 0  # cached pages returned under pool pressure
         self._metrics = metrics  # owning engine's Prometheus bundle
+        # Tiered cache (inference/kv_transfer.HostSpillTier): evicted
+        # pages spill — exact device bytes, fetched by the engine's
+        # `fetch_pages(pages) -> {leaf_path: page-major array}` — and
+        # are restored on a later chain-key hit instead of recomputed.
+        # None keeps the classic drop-on-evict behavior.
+        self.spill = spill
+        self._fetch_pages = fetch_pages
+        self.spilled_pages = 0
 
     @staticmethod
     def chain_keys(tokens, page_size: int,
@@ -117,9 +126,14 @@ class PrefixCache:
             keys.append(h.digest())
         return keys
 
-    def lookup_acquire(self, keys: List[bytes]) -> List[int]:
+    def lookup_acquire(self, keys: List[bytes],
+                       record: bool = True) -> List[int]:
         """Longest cached prefix of `keys`; takes a reference on each
-        returned page (pinned against eviction)."""
+        returned page (pinned against eviction). `record=False`
+        defers the hit/miss accounting to the caller (the engine's
+        spill-restore path extends the prefix first, then records the
+        post-restore truth — a restored page avoided the recompute
+        exactly like a resident hit)."""
         pages = []
         for key in keys:
             page = self.by_key.get(key)
@@ -128,12 +142,25 @@ class PrefixCache:
             pages.append(page)
             self.refs[page] = self.refs.get(page, 0) + 1
             self.lru.pop(page, None)
-        self.hits += len(pages)
-        self.misses += len(keys) - len(pages)
-        if self._metrics is not None:
-            self._metrics.prefix_hits.inc(len(pages))
-            self._metrics.prefix_misses.inc(len(keys) - len(pages))
+        if record:
+            self.record_lookup(len(pages), len(keys) - len(pages))
         return pages
+
+    def record_lookup(self, n_hits: int, n_misses: int) -> None:
+        self.hits += n_hits
+        self.misses += n_misses
+        if self._metrics is not None:
+            self._metrics.prefix_hits.inc(n_hits)
+            self._metrics.prefix_misses.inc(n_misses)
+
+    def acquire_page(self, key: bytes, page: int) -> None:
+        """Adopt + immediately reference a page the engine just
+        restored/imported into the pool under `key` (the
+        insert-then-acquire composition, minus the LRU round trip)."""
+        if not self.insert(key, page):
+            raise ValueError(f'key already cached: {key.hex()[:12]}')
+        self.lru.pop(page, None)
+        self.refs[page] = self.refs.get(page, 0) + 1
 
     def release(self, pages: List[int]) -> None:
         for page in pages:
@@ -154,10 +181,40 @@ class PrefixCache:
 
     def evict_into(self, allocator, need: int) -> None:
         """Return unreferenced cached pages to the allocator until it
-        can serve `need` pages (or the evictable set is dry)."""
-        while not allocator.can_allocate(need) and self.lru:
+        can serve `need` pages (or the evictable set is dry). With a
+        spill tier the victims' device bytes are fetched in ONE
+        batched gather and spilled (payload + scales + chain key)
+        before their pages are released — restore on a later hit is
+        bit-identical to the fresh compute."""
+        deficit = need - allocator.free_pages
+        if deficit <= 0:
+            return
+        victims: List[tuple] = []
+        while len(victims) < deficit and self.lru:
             page, _ = self.lru.popitem(last=False)
-            del self.by_key[self.key_of.pop(page)]
+            key = self.key_of.pop(page)
+            del self.by_key[key]
+            victims.append((key, page))
+        if not victims:
+            return
+        if self.spill is not None and self._fetch_pages is not None:
+            from skypilot_tpu.inference import kv_transfer
+            try:
+                blobs = self._fetch_pages([p for _, p in victims])
+                per_page = kv_transfer.split_pages(blobs, len(victims))
+                for (key, _page), blob in zip(victims, per_page):
+                    self.spill.put(key, blob)
+                    self.spilled_pages += 1
+                    if self._metrics is not None:
+                        self._metrics.kv_spill_pages.inc()
+            except Exception as e:  # pylint: disable=broad-except
+                # Spilling is an optimization: a failed gather must
+                # degrade to the classic drop-on-evict, never block
+                # the admission that triggered the eviction.
+                print(f'prefix cache: spill of {len(victims)} pages '
+                      f'failed ({type(e).__name__}: {e}); dropping '
+                      f'them instead', flush=True)
+        for _, page in victims:
             allocator.release([page])
             self.evictions += 1
             if self._metrics is not None:
@@ -184,7 +241,9 @@ class ContinuousBatchingEngine:
                  pipeline_decode: Optional[bool] = None,
                  max_queue_requests: int = 0,
                  max_queue_tokens: int = 0,
-                 adapter_store=None) -> None:
+                 adapter_store=None,
+                 kv_spill_bytes: int = 0,
+                 kv_cold_dir: Optional[str] = None) -> None:
         assert max_total_len <= model.config.max_seq_len
         # Multi-LoRA serving (inference/adapters.py): each slot may
         # carry an adapter id into the shared dispatch; the model
@@ -326,6 +385,24 @@ class ContinuousBatchingEngine:
                 // self.page_size)
         self.prefix_caching = bool(prefix_caching and self.paged)
         self.prefix_cache: Optional[PrefixCache] = None  # set per reset
+        # Tiered prefix cache: evicted pages spill to a bounded
+        # host-RAM LRU (optionally backed by a cold directory / gs://
+        # prefix) and restore bit-identically on a chain-key hit.
+        # The tier OUTLIVES engine resets (content-addressed host
+        # bytes stay valid across a crash-only cache rebuild).
+        if (kv_spill_bytes or kv_cold_dir) and not self.prefix_caching:
+            raise ValueError(
+                'kv_spill_bytes/kv_cold_dir need the paged engine '
+                'with prefix caching enabled (the spill tier stores '
+                'evicted prefix-cache pages)')
+        from skypilot_tpu.inference import kv_transfer as _kvt
+        self.spill_tier = _kvt.make_spill_tier(kv_spill_bytes,
+                                               kv_cold_dir)
+        # Restore accounting (the spill tier's own stats count host
+        # lookups; these count the engine-level outcome).
+        self.kv_restored_pages = 0
+        self.kv_restore_lookups = 0
+        self.kv_restore_hits = 0
 
         # Prometheus instruments (observability/catalog.py), labeled
         # by engine instance; counters tick at the event sites below,
@@ -409,6 +486,14 @@ class ContinuousBatchingEngine:
         self._cancel_requests: set = set()
         self._cancel_lock = threading.Lock()
         self._queue: 'queue.Queue' = queue.Queue()
+        # Control operations (KV chain export/import) hop onto the
+        # scheduler thread here: ALL device work — including page
+        # gather/scatter — runs between decode rounds on the one
+        # thread that owns self.cache (touching a donated buffer from
+        # an HTTP thread would race the dispatch that consumes it).
+        self._control: 'queue.Queue' = queue.Queue()
+        # Jitted page-scatter fns keyed by (padded) chain length.
+        self._scatter_fns: Dict[int, Any] = {}
         # FCFS admission order, owned by the scheduler thread: requests
         # drain from _queue into _ready; a stalled (page-pressure) or
         # preempted request returns to the HEAD so later arrivals can't
@@ -442,7 +527,10 @@ class ContinuousBatchingEngine:
         # Prefix caching (vLLM APC): per-slot shared (read-only) page
         # refs + the prompt's chain keys for promotion on completion.
         self.prefix_cache = (PrefixCache(self.page_size,
-                                         metrics=self.metrics)
+                                         metrics=self.metrics,
+                                         spill=self.spill_tier,
+                                         fetch_pages=self
+                                         ._gather_page_blobs)
                              if self.prefix_caching else None)
         self.shared_pages: List[List[int]] = [
             [] for _ in range(self.num_slots)]
@@ -911,6 +999,263 @@ class ContinuousBatchingEngine:
             free = int(self.allocator.free_pages)
             self.metrics.pages_free.set(free)
             self.metrics.pages_used.set(self.total_pages - free)
+        if self.kv_restore_lookups:
+            self.metrics.kv_restore_hit_ratio.set(
+                self.kv_restore_hits / self.kv_restore_lookups)
+
+    # -- KV page transfer + tiered cache ------------------------------------
+    def run_on_scheduler(self, fn, timeout: float = 120.0):
+        """Run `fn()` on the scheduler thread between rounds and
+        return its result (exceptions re-raise here). The ONLY safe
+        way to touch `self.cache` from another thread: every dispatch
+        donates the cache buffer, so a concurrent gather/scatter from
+        an HTTP thread would race the dispatch that consumes it.
+        Calls made ON the scheduler thread run inline (control ops
+        compose)."""
+        if threading.current_thread() is self._thread:
+            return fn()
+        if self._dead.is_set():
+            raise EngineDeadError(
+                'engine scheduler thread is dead; restart the server')
+        fut: Future = Future()
+        self._control.put((fn, fut))
+        return fut.result(timeout=timeout)
+
+    def _run_control_ops(self) -> bool:
+        """Drain pending control operations (start of each scheduler
+        iteration). An op's failure resolves only ITS caller's future
+        — unless it consumed the donated cache, which is the same
+        unrecoverable condition as a failed dispatch and takes the
+        full reset path."""
+        ran = False
+        while True:
+            try:
+                fn, fut = self._control.get_nowait()
+            except queue.Empty:
+                return ran
+            ran = True
+            try:
+                fut.set_result(fn())
+            except Exception as e:  # pylint: disable=broad-except
+                fut.set_exception(e)
+                if self._cache_lost():
+                    raise
+
+    def _gather_page_blobs(self, pages: List[int]
+                           ) -> Dict[str, 'np.ndarray']:
+        """Exact device bytes of physical pages `pages`, as
+        {cache-leaf path: page-major host array} — the export side of
+        handoff and spill. int8 pools gather int8 payload AND the f32
+        scale rows; no dequantization anywhere (bit-identical round
+        trip). Scheduler thread only."""
+        from skypilot_tpu.ops import paged_attention as paged_ops
+        idx = jnp.asarray(pages, jnp.int32)
+        flat, _ = jax.tree_util.tree_flatten_with_path(self.cache)
+        gathered = [paged_ops.gather_page_rows(leaf, idx)
+                    for _path, leaf in flat]
+        fetched = jax.device_get(gathered)
+        return {jax.tree_util.keystr(path): np.asarray(arr)
+                for (path, _), arr in zip(flat, fetched)}
+
+    def _scatter_fn(self, m: int):
+        if m not in self._scatter_fns:
+            from skypilot_tpu.ops import paged_attention as paged_ops
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def scatter(cache, idx, rows):
+                return jax.tree.map(
+                    lambda a, r: paged_ops.scatter_page_rows(a, idx,
+                                                             r),
+                    cache, rows)
+
+            self._scatter_fns[m] = scatter
+        return self._scatter_fns[m]
+
+    def _scatter_page_blobs(self, pages: List[int],
+                            blobs: Dict[str, 'np.ndarray']) -> None:
+        """Write page-major host blobs into physical pages `pages`
+        (import/restore). Chain lengths pad to a power of two so the
+        jitted donating scatter compiles a log2 ladder, not one
+        executable per length; pad rows target physical page 0 — the
+        trash page, junk over junk. Scheduler thread only."""
+        flat, treedef = jax.tree_util.tree_flatten_with_path(
+            self.cache)
+        paths = [jax.tree_util.keystr(p) for p, _ in flat]
+        if sorted(paths) != sorted(blobs):
+            raise ValueError(
+                f'KV chain leaves do not match this engine\'s cache '
+                f'layout (chain: {sorted(blobs)[:3]}..., cache: '
+                f'{sorted(paths)[:3]}...)')
+        n = len(pages)
+        m = 1
+        while m < n:
+            m *= 2
+        idx = np.zeros((m,), np.int32)
+        idx[:n] = pages
+        rows = []
+        for (_p, leaf), path in zip(flat, paths):
+            arr = np.asarray(blobs[path])
+            if leaf.ndim == 4:
+                want = (n, leaf.shape[0], leaf.shape[2], leaf.shape[3])
+            else:
+                want = (n, leaf.shape[1])
+            if tuple(arr.shape) != want or \
+                    arr.dtype != np.dtype(leaf.dtype):
+                raise ValueError(
+                    f'KV chain leaf {path} is {arr.dtype}{arr.shape}, '
+                    f'pool expects {np.dtype(leaf.dtype)}{want}')
+            if m != n:
+                arr = np.concatenate(
+                    [arr, np.zeros((m - n,) + arr.shape[1:],
+                                   arr.dtype)], axis=0)
+            rows.append(arr)
+        rows_tree = jax.tree_util.tree_unflatten(treedef, rows)
+        self.cache = self._scatter_fn(m)(self.cache,
+                                         jnp.asarray(idx), rows_tree)
+
+    def export_chain(self, tokens: List[int],
+                     adapter: Optional[str] = None
+                     ) -> Optional[bytes]:
+        """Serialize the prompt's cached full-page KV chain (payload
+        + scales + adapter-salted chain keys + geometry) for handoff
+        to another replica. Returns packed bytes covering the longest
+        cached chain prefix, or None when nothing is cached (or
+        prefix caching is off). Thread-safe: hops onto the scheduler
+        thread; the chain is reference-pinned during the gather."""
+        if not self.prefix_caching:
+            return None
+        toks = [int(t) for t in tokens]
+
+        def op():
+            from skypilot_tpu.inference import kv_transfer
+            cache = self.prefix_cache
+            salt = b''
+            if adapter is not None:
+                if self.adapter_store is None:
+                    raise AdapterNotFoundError(
+                        f'adapter {adapter!r} requested for export '
+                        f'but this engine has no adapter store')
+                salt = self.adapter_store.cache_salt(adapter)
+            keys = PrefixCache.chain_keys(toks, self.page_size,
+                                          salt=salt)
+            if not keys:
+                return None
+            pages = cache.lookup_acquire(keys, record=False)
+            try:
+                if not pages:
+                    return None
+                blobs = self._gather_page_blobs(pages)
+            finally:
+                cache.release(pages)
+            meta = {'kind': 'kv_chain',
+                    'kv_dtype': self.kv_dtype,
+                    'page_size': self.page_size,
+                    'keys': [k.hex() for k in keys[:len(pages)]],
+                    'salt': salt.hex()}
+            return kv_transfer.pack_pages(blobs, meta)
+
+        return self.run_on_scheduler(op)
+
+    def import_chain(self, data: bytes) -> Dict[str, int]:
+        """Scatter a packed page chain into this pool and register it
+        in the prefix cache: the next submit of the same prompt (same
+        adapter salt) admits against the imported pages instead of
+        re-running prefill. Pages whose keys are already cached are
+        skipped; pages that cannot fit even after spill-eviction are
+        dropped (chain order — a dropped page also drops its
+        suffix's usefulness, counted for the caller). Raises
+        ValueError on any geometry/dtype mismatch. Thread-safe."""
+        if not self.prefix_caching:
+            raise ValueError(
+                'import_chain needs the paged engine with prefix '
+                'caching enabled')
+
+        def op():
+            from skypilot_tpu.inference import kv_transfer
+            meta, blobs = kv_transfer.unpack_pages(data)
+            if meta.get('kind') != 'kv_chain':
+                raise ValueError('not a KV chain payload')
+            if meta.get('kv_dtype') != self.kv_dtype:
+                raise ValueError(
+                    f'kv_dtype mismatch: chain is '
+                    f'{meta.get("kv_dtype")!r}, pool is '
+                    f'{self.kv_dtype!r}')
+            if int(meta.get('page_size', 0)) != self.page_size:
+                raise ValueError(
+                    f'page_size mismatch: chain is '
+                    f'{meta.get("page_size")}, pool is '
+                    f'{self.page_size}')
+            keys = [bytes.fromhex(k) for k in meta.get('keys', [])]
+            if len(keys) != int(meta.get('n_pages', -1)):
+                raise ValueError('chain key count != page count')
+            cache = self.prefix_cache
+            todo = [(i, key) for i, key in enumerate(keys)
+                    if key not in cache.by_key]
+            already = len(keys) - len(todo)
+            if todo:
+                cache.evict_into(self.allocator, len(todo))
+            fit = todo[:self.allocator.free_pages]
+            dropped = len(todo) - len(fit)
+            if fit:
+                pages = self.allocator.allocate(len(fit))
+                rows = {path: arr[[i for i, _ in fit]]
+                        for path, arr in blobs.items()}
+                try:
+                    self._scatter_page_blobs(pages, rows)
+                except Exception:
+                    self.allocator.release(pages)
+                    raise
+                for (_i, key), page in zip(fit, pages):
+                    cache.insert(key, page)
+            return {'pages': len(keys), 'imported': len(fit),
+                    'already_cached': already, 'dropped': dropped}
+
+        return self.run_on_scheduler(op)
+
+    def _restore_from_spill(self, keys: List[bytes],
+                            shared: List[int]) -> None:
+        """Extend the device-resident chain prefix from the spill
+        tier, in place: for each key past the cached prefix (in chain
+        order, stopping at the first miss), allocate a page, scatter
+        the spilled bytes back, and acquire it exactly like a
+        resident hit. Restored pages are bit-identical to the
+        original compute — greedy continuations cannot tell."""
+        from skypilot_tpu.inference import kv_transfer
+        cache = self.prefix_cache
+        # Restore only what can actually land: free pages plus the
+        # evictable LRU. Fetching a chain the pool cannot hold wastes
+        # host DMA AND churns the tier's own LRU for nothing.
+        budget = self.allocator.free_pages + len(cache.lru)
+        found_blobs = []
+        found_keys = []
+        for key in keys[len(shared):]:
+            if len(found_blobs) >= budget:
+                break
+            self.kv_restore_lookups += 1
+            blob = self.spill_tier.get(key)
+            if blob is None:
+                break
+            self.kv_restore_hits += 1
+            found_blobs.append(blob)
+            found_keys.append(key)
+        if not found_blobs:
+            return
+        cache.evict_into(self.allocator, len(found_blobs))
+        n_fit = min(len(found_blobs), self.allocator.free_pages)
+        if n_fit <= 0:
+            return
+        pages = self.allocator.allocate(n_fit)
+        try:
+            self._scatter_page_blobs(
+                pages, kv_transfer.join_pages(found_blobs[:n_fit]))
+        except Exception:
+            self.allocator.release(pages)
+            raise
+        for key, page in zip(found_keys[:n_fit], pages):
+            cache.acquire_page(key, page)
+        shared.extend(pages)
+        self.kv_restored_pages += n_fit
+        self.metrics.kv_restore_pages.inc(n_fit)
 
     # -- scheduler loop -----------------------------------------------------
     def _loop(self) -> None:
@@ -941,6 +1286,12 @@ class ContinuousBatchingEngine:
                     if fut is not None and not fut.done():
                         fut.set_exception(died)
                 self._fail_all_pending(died)
+                while not self._control.empty():
+                    try:
+                        _fn, cfut = self._control.get_nowait()
+                        cfut.set_exception(died)
+                    except queue.Empty:
+                        break
 
     def _iterate(self) -> None:
         """One iteration = admit (host-only) -> apply cancellations ->
@@ -949,7 +1300,8 @@ class ContinuousBatchingEngine:
         prompts therefore interleave with decoding instead of stalling
         it; with pipelining the decode round's host commit overlaps
         the NEXT round's device compute."""
-        progressed = self._admit()
+        progressed = self._run_control_ops()
+        progressed = self._admit() or progressed
         self._apply_cancellations()
         self._reap_deadlines()
         if self._prefill_order:
@@ -1215,7 +1567,18 @@ class ContinuousBatchingEngine:
                     keys = PrefixCache.chain_keys(prompt,
                                                   self.page_size,
                                                   salt=salt)
-                    shared = self.prefix_cache.lookup_acquire(keys)
+                    shared = self.prefix_cache.lookup_acquire(
+                        keys, record=False)
+                    # Tiered cache: evicted-then-spilled pages extend
+                    # the resident prefix (restore == fresh compute,
+                    # bit-identical) before the hit/miss accounting —
+                    # a restored page avoided the recompute exactly
+                    # like a resident hit.
+                    if self.spill_tier is not None and \
+                            len(shared) < len(keys):
+                        self._restore_from_spill(keys, shared)
+                    self.prefix_cache.record_lookup(
+                        len(shared), len(keys) - len(shared))
                     if len(shared) * self.page_size >= plen:
                         self.prefix_cache.release([shared.pop()])
                 n_cached = len(shared) * self.page_size

@@ -108,6 +108,30 @@ SPECS: Dict[str, Tuple] = {
         'counter', 'Full engine resets after an unrecoverable '
                    'scheduler error (KV cache lost; in-flight '
                    'requests failed, slots rebuilt)', ('engine',)),
+    # -- tiered prefix cache + disaggregated prefill/decode handoff
+    #    (inference/kv_transfer.py + models/batching.py)
+    'skypilot_serving_kv_spill_pages_total': (
+        'counter', 'Prefix-cache pages spilled to the host-RAM tier '
+                   'on pool-pressure eviction (payload + scales + '
+                   'chain key) instead of being dropped', ('engine',)),
+    'skypilot_serving_kv_restore_pages_total': (
+        'counter', 'Spilled pages restored into the page pool on a '
+                   'chain-key hit (bit-identical to the original '
+                   'compute; the prefill those pages would have '
+                   'cost was skipped)', ('engine',)),
+    'skypilot_serving_kv_restore_hit_ratio': (
+        'gauge', 'Spill-tier lookups that restored a page / all '
+                 'spill-tier lookups (0..1; lookups happen only for '
+                 'chain keys past the device-resident prefix)',
+        ('engine',)),
+    'skypilot_serving_kv_handoff_seconds': (
+        'histogram', 'Wall time of one prefill->decode KV page-chain '
+                     'handoff (export + POST /kv/import + decode-'
+                     'side scatter), success or failure',
+        (), {'buckets': REQUEST_BUCKETS}),
+    'skypilot_serving_kv_handoff_bytes_total': (
+        'counter', 'Packed KV chain bytes shipped to decode replicas '
+                   'by this prefill replica', ()),
     # -- multi-LoRA adapter registry (inference/adapters.py)
     'skypilot_serving_adapters_loaded': (
         'gauge', 'Adapters resident in the device store (loaded '
@@ -366,6 +390,12 @@ class EngineMetrics:
                 **lab)
         self.engine_restarts = counter(
             'skypilot_serving_engine_restarts_total').labels(**lab)
+        self.kv_spill_pages = counter(
+            'skypilot_serving_kv_spill_pages_total').labels(**lab)
+        self.kv_restore_pages = counter(
+            'skypilot_serving_kv_restore_pages_total').labels(**lab)
+        self.kv_restore_hit_ratio = gauge(
+            'skypilot_serving_kv_restore_hit_ratio').labels(**lab)
 
 
 class RequestMetrics:

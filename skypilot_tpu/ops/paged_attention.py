@@ -291,6 +291,34 @@ def write_kv_chunk_quant(k_pages: jax.Array, v_pages: jax.Array,
             v_scales.at[physical, slot].set(sv.reshape(-1)))
 
 
+def gather_page_rows(arr: jax.Array, idx: jax.Array) -> jax.Array:
+    """Gather whole physical pages out of a pool-shaped cache leaf
+    (the export side of KV-page handoff / spill).
+
+    Page arrays [Hkv, total_pages, page_size, D] gather along axis 1
+    and come back page-major ([n, Hkv, page_size, D] — one leading
+    row per page, the wire/spill layout); scale arrays
+    [total_pages, page_size] gather along axis 0 ([n, page_size]).
+    Pure indexing: int8 pages stay int8, bf16 stays bf16 — the
+    gathered bytes ARE the pool's bytes (bit-identical round trip).
+    """
+    if arr.ndim == 4:
+        return jnp.swapaxes(arr[:, idx], 0, 1)
+    assert arr.ndim == 2, arr.shape
+    return arr[idx]
+
+
+def scatter_page_rows(arr: jax.Array, idx: jax.Array,
+                      rows: jax.Array) -> jax.Array:
+    """Inverse of `gather_page_rows`: write page-major rows back into
+    a pool-shaped leaf at physical pages `idx` (the import/restore
+    side). Same dtype-preserving contract."""
+    if arr.ndim == 4:
+        return arr.at[:, idx].set(jnp.swapaxes(rows, 0, 1))
+    assert arr.ndim == 2, arr.shape
+    return arr.at[idx].set(rows)
+
+
 class PageAllocator:
     """Host-side free-list over the fixed physical page pool.
 

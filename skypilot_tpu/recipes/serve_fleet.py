@@ -67,6 +67,10 @@ def build_replica_cmd(args: argparse.Namespace) -> list:
         cmd += ['--kv-pool-bytes', str(args.kv_pool_bytes)]
     if args.weight_dtype:
         cmd += ['--weight-dtype', args.weight_dtype]
+    if args.kv_spill_bytes:
+        cmd += ['--kv-spill-bytes', str(args.kv_spill_bytes)]
+    if args.kv_cold_dir:
+        cmd += ['--kv-cold-dir', args.kv_cold_dir]
     if args.fault_plan:
         cmd += ['--fault-plan', args.fault_plan]
     if args.cpu:
@@ -118,7 +122,38 @@ def main() -> None:
                              '(replica_plane/stub.py) instead of '
                              'serve_lm — chaos drills only')
     parser.add_argument('--replicas', type=int, default=2,
-                        help='initial + minimum replica count')
+                        help='initial + minimum replica count (the '
+                             'DECODE pool when --prefill-replicas '
+                             'is set)')
+    parser.add_argument('--prefill-replicas', type=int, default=0,
+                        metavar='N',
+                        help='disaggregated serving: N additional '
+                             'replicas spawned with --role prefill. '
+                             'Long prompts (>= --disagg-prompt-'
+                             'threshold) route to them; they prefill '
+                             'and hand the KV page chain to a decode '
+                             'replica (POST /kv/import), which '
+                             'serves the decode phase — decode-pool '
+                             'ITL stays flat as long-prompt traffic '
+                             'rises. 0 = unified fleet')
+    parser.add_argument('--disagg-prompt-threshold', type=int,
+                        default=256, metavar='T',
+                        help='LB routing threshold, prompt tokens '
+                             '(text endpoints estimate chars/4): '
+                             'requests at or above it go to the '
+                             'prefill pool (when --prefill-replicas '
+                             '> 0)')
+    parser.add_argument('--kv-spill-bytes', type=int, default=0,
+                        metavar='B',
+                        help='forwarded to every replica: tiered '
+                             'prefix cache — evicted KV pages spill '
+                             'to a host-RAM LRU of B bytes and '
+                             'restore bit-identically on a later '
+                             'chain-key hit')
+    parser.add_argument('--kv-cold-dir', default=None, metavar='DIR',
+                        help='forwarded to every replica: cold tier '
+                             'behind the host spill (local dir or '
+                             'gs:// prefix)')
     parser.add_argument('--max-replicas', type=int, default=None,
                         help='autoscaler ceiling (default: --replicas '
                              '— fixed-size fleet)')
@@ -148,6 +183,7 @@ def main() -> None:
     from skypilot_tpu.serve import load_balancing_policies as lb_policies
     from skypilot_tpu.serve import service_spec as spec_lib
     from skypilot_tpu.serve.replica_plane import (FleetController,
+                                                  PrefillPool,
                                                   ReplicaManager,
                                                   make_lb_server,
                                                   serve_lm_factory,
@@ -166,6 +202,23 @@ def main() -> None:
     policy_cls = LB_POLICY_REGISTRY.from_str(args.lb_policy)
     policy: lb_policies.LoadBalancingPolicy = policy_cls()
 
+    # Disaggregated mode: a fixed-size (min==max) prefill pool with
+    # its own backlog-driven autoscaler, and the LB routing long
+    # prompts to it.
+    prefill_autoscaler = None
+    prefill_pool = None
+    if args.prefill_replicas > 0:
+        prefill_spec = spec_lib.SkyServiceSpec(
+            min_replicas=args.prefill_replicas,
+            max_replicas=args.prefill_replicas,
+            upscale_delay_seconds=args.upscale_delay,
+            downscale_delay_seconds=args.downscale_delay)
+        prefill_autoscaler = autoscalers.EngineMetricsAutoscaler(
+            prefill_spec,
+            target_queue_per_replica=args.target_queue_per_replica,
+            target_backlog_per_replica=args.target_backlog_per_replica)
+        prefill_pool = PrefillPool()
+
     env = dict(os.environ)
     if args.stub_replicas:
         factory = stub_factory(env=env)
@@ -174,11 +227,18 @@ def main() -> None:
     manager = ReplicaManager(factory,
                              drain_grace_s=args.drain_grace,
                              state_dir=args.state_dir)
-    controller = FleetController(manager, policy, autoscaler,
-                                 interval_s=args.scrape_interval)
-    lb = make_lb_server(policy, args.lb_port,
-                        policy_name=args.lb_policy, manager=manager,
-                        page_size=args.page_size)
+    controller = FleetController(
+        manager, policy, autoscaler,
+        interval_s=args.scrape_interval,
+        prefill_autoscaler=prefill_autoscaler,
+        prefill_pool=prefill_pool)
+    lb = make_lb_server(
+        policy, args.lb_port,
+        policy_name=args.lb_policy, manager=manager,
+        page_size=args.page_size,
+        disagg_threshold=(args.disagg_prompt_threshold
+                          if args.prefill_replicas > 0 else 0),
+        prefill_pool=prefill_pool)
 
     def handle_term(signum, frame):  # noqa: ARG001
         def _shutdown():
@@ -196,8 +256,13 @@ def main() -> None:
                   f'{args.state_dir}, resumed drains '
                   f'{summary["resumed_drains"]}, reaped orphans '
                   f'{summary["orphans"]}', flush=True)
-    for _ in range(max(0, args.replicas - adopted)):
-        manager.spawn()
+    adopted_prefill = sum(
+        1 for v in manager.views() if v.role == 'prefill')
+    for _ in range(max(0, args.replicas -
+                       (adopted - adopted_prefill))):
+        manager.spawn(role='decode' if args.prefill_replicas else '')
+    for _ in range(max(0, args.prefill_replicas - adopted_prefill)):
+        manager.spawn(role='prefill')
     loop = threading.Thread(target=controller.run, daemon=True)
     loop.start()
     print(f'serve_fleet: LB on :{args.lb_port} '

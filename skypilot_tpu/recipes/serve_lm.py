@@ -166,6 +166,38 @@ def main() -> None:
                              'bigger than one chip serve with '
                              '--tensor N (sharded across the slice). '
                              'f32 is for CPU parity runs')
+    parser.add_argument('--role', choices=['', 'prefill', 'decode'],
+                        default='',
+                        help='disaggregated serving role. "prefill": '
+                             'this replica prefills prompts and hands '
+                             'the KV page chain off to a decode peer '
+                             '(POST /kv/import) instead of decoding '
+                             'locally, falling back to local serving '
+                             'when the transfer fails; "decode": '
+                             'label only (pool membership for the '
+                             'fleet controller / LB). Default: '
+                             'unified replica. prefill needs '
+                             '--continuous-batching')
+    parser.add_argument('--decode-peers', default=None,
+                        metavar='HOST:PORT,...',
+                        help='static decode pool for --role prefill '
+                             '(the fleet controller pushes the live '
+                             'set via POST /kv/peers instead)')
+    parser.add_argument('--kv-spill-bytes', type=int, default=0,
+                        metavar='B',
+                        help='tiered prefix cache: spill evicted KV '
+                             'pages (payload + scales + chain key) '
+                             'into a host-RAM LRU of at most B bytes '
+                             'instead of dropping them; a later '
+                             'chain-key hit restores the exact bytes '
+                             '(bit-identical to fresh compute). 0 = '
+                             'off. Needs --continuous-batching')
+    parser.add_argument('--kv-cold-dir', default=None, metavar='DIR',
+                        help='cold tier behind --kv-spill-bytes: '
+                             'pages LRU-evicted from host RAM land '
+                             'in DIR (local path or gs:// prefix) '
+                             'and survive process restarts — meant '
+                             'for giant shared system prompts')
     parser.add_argument('--drain-grace', type=float, default=630.0,
                         help='SIGTERM drain: seconds to wait for '
                              'in-flight requests before exiting. The '
@@ -213,6 +245,16 @@ def main() -> None:
         parser.error('--kv-dtype int8 requires --continuous-batching '
                      '(the one-shot engine decodes through the dense '
                      'per-slot cache, which has no scale storage)')
+    if (args.kv_spill_bytes or args.kv_cold_dir) and \
+            not args.continuous_batching:
+        parser.error('--kv-spill-bytes/--kv-cold-dir require '
+                     '--continuous-batching (the spill tier stores '
+                     'evicted prefix-cache pages of the paged slot '
+                     'engine)')
+    if args.role == 'prefill' and not args.continuous_batching:
+        parser.error('--role prefill requires --continuous-batching '
+                     '(the handoff exports KV page chains from the '
+                     'slot engine\'s prefix cache)')
 
     if args.fault_plan:
         from skypilot_tpu.robustness import faults

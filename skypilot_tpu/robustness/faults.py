@@ -102,6 +102,13 @@ KNOWN_POINTS: Dict[str, str] = {
         'retry/backoff/failover)',
     'http.handler':
         'inference HTTP server, start of each POST handler',
+    'kv.handoff':
+        'prefill-role inference server, start of each prefill->'
+        'decode KV page-chain handoff (raise OR drop fails the '
+        'transfer: the prefill replica falls back to serving the '
+        'request locally from its already-warm pages — the '
+        'disaggregation degradation path, never an error to the '
+        'client)',
     'adapters.load':
         'adapter registry (inference/adapters.py), inside each LoRA '
         'artifact load into the device store — raise OR drop makes '

@@ -36,10 +36,11 @@ Entry point: `python -m skypilot_tpu.recipes.serve_fleet`.
 from skypilot_tpu.serve.replica_plane.fleet import FleetController
 from skypilot_tpu.serve.replica_plane.journal import (FleetJournal,
                                                       ReplicaRecord)
-from skypilot_tpu.serve.replica_plane.lb import make_lb_server
+from skypilot_tpu.serve.replica_plane.lb import (PrefillPool,
+                                                 make_lb_server)
 from skypilot_tpu.serve.replica_plane.replica_manager import (
     ReplicaManager, ReplicaView, serve_lm_factory, stub_factory)
 
-__all__ = ['FleetController', 'FleetJournal', 'ReplicaManager',
-           'ReplicaRecord', 'ReplicaView', 'make_lb_server',
-           'serve_lm_factory', 'stub_factory']
+__all__ = ['FleetController', 'FleetJournal', 'PrefillPool',
+           'ReplicaManager', 'ReplicaRecord', 'ReplicaView',
+           'make_lb_server', 'serve_lm_factory', 'stub_factory']

@@ -37,16 +37,41 @@ from skypilot_tpu.utils import ux_utils
 _TICK_FAILURE_STRIKES = 3
 
 
+def _default_http_post(url: str, body: dict,
+                       timeout: float = 3.0) -> int:
+    import requests as requests_lib
+    return requests_lib.post(url, json=body,
+                             timeout=timeout).status_code
+
+
 class FleetController:
 
     def __init__(self, manager: ReplicaManager,
                  policy, autoscaler: 'autoscalers.Autoscaler', *,
                  interval_s: float = 1.0,
                  clock: Optional[Callable[[], float]] = None,
-                 drain_in_thread: bool = True) -> None:
+                 drain_in_thread: bool = True,
+                 prefill_autoscaler:
+                 Optional['autoscalers.Autoscaler'] = None,
+                 prefill_pool=None,
+                 http_post: Optional[Callable] = None) -> None:
         self.manager = manager
         self.policy = policy
         self.autoscaler = autoscaler
+        # Disaggregated mode (prefill_autoscaler set): the fleet is
+        # TWO pools. `policy` routes the decode pool (prefix affinity
+        # keys point at the replicas holding the pages);
+        # `prefill_pool` (lb.PrefillPool) receives the prefill-role
+        # ready set; the prefill autoscaler runs on prefill backlog
+        # tokens while the decode autoscaler keeps its queue/shed
+        # signals. The controller also pushes the live decode set to
+        # every prefill replica (POST /kv/peers) so handoffs target
+        # replicas that exist.
+        self.prefill_autoscaler = prefill_autoscaler
+        self.prefill_pool = prefill_pool
+        self.disagg = prefill_autoscaler is not None
+        self._http_post = http_post or _default_http_post
+        self._pushed_peers: dict = {}   # prefill endpoint -> set sent
         self.interval_s = interval_s
         self._clock = clock if clock is not None else time.time
         # Tests flip this off to make drains synchronous (ordering
@@ -67,8 +92,13 @@ class FleetController:
         affinity policy's saturation/fallback signal: engine-reported
         prefill backlog tokens plus queue depth (token-dominated on
         purpose — a 4k-token backlog is heavier than 4 queued short
-        requests)."""
-        ready = self.manager.ready_endpoints()
+        requests). Disaggregated fleets split the ready set: the
+        routing policy sees the DECODE pool (affinity keys must point
+        at the pool holding the pages), the LB's PrefillPool gets the
+        prefill-role set, and every prefill replica learns the live
+        decode set via POST /kv/peers."""
+        ready = self.manager.ready_endpoints(
+            'decode' if self.disagg else None)
         self.policy.set_ready_replicas(ready)
         if hasattr(self.policy, 'set_replica_load'):
             self.policy.set_replica_load({
@@ -76,6 +106,39 @@ class FleetController:
                     v.prefill_backlog_tokens + v.queue_depth
                 for v in self.manager.views()
                 if v.endpoint in ready})
+        if not self.disagg:
+            return
+        prefill_ready = self.manager.ready_endpoints('prefill')
+        if self.prefill_pool is not None:
+            self.prefill_pool.set_ready_replicas(prefill_ready)
+        self._push_decode_peers(prefill_ready, ready)
+
+    def _push_decode_peers(self, prefill_ready, decode_ready) -> None:
+        """Tell each prefill replica where the decode pool is (only
+        when its view changed — the push is per-tick otherwise). A
+        failed push is logged and retried next tick; the replica
+        keeps its last set and falls back to local serving if every
+        peer in it died."""
+        want = sorted(decode_ready)
+        for endpoint in prefill_ready:
+            if self._pushed_peers.get(endpoint) == want:
+                continue
+            try:
+                code = self._http_post(
+                    f'http://{endpoint}/kv/peers', {'decode': want})
+            except Exception as e:  # pylint: disable=broad-except
+                ux_utils.log(f'fleet: /kv/peers push to {endpoint} '
+                             f'failed ({e}); will retry next tick.')
+                continue
+            if code == 200:
+                self._pushed_peers[endpoint] = want
+            else:
+                ux_utils.log(f'fleet: /kv/peers push to {endpoint} '
+                             f'answered {code}; will retry.')
+        # Forget pushes to replicas that left the prefill pool.
+        for endpoint in list(self._pushed_peers):
+            if endpoint not in prefill_ready:
+                del self._pushed_peers[endpoint]
 
     def drain_replica(self, view: ReplicaView) -> None:
         """THE drain contract, in order: mark not-ready -> stop
@@ -83,8 +146,9 @@ class FleetController:
         Never kill-then-reroute."""
         self.manager.mark_draining(view.replica_id)
         self._push_routing()  # routing stops BEFORE any signal
-        if hasattr(self.autoscaler, 'forget'):
-            self.autoscaler.forget(view.endpoint)
+        for scaler in (self.autoscaler, self.prefill_autoscaler):
+            if scaler is not None and hasattr(scaler, 'forget'):
+                scaler.forget(view.endpoint)
         if self._drain_in_thread:
             # Prune finished drains first: over a long-running fleet
             # the list would otherwise grow one dead Thread per
@@ -131,15 +195,39 @@ class FleetController:
         self._push_routing()
 
         views = self.manager.views()
+        if self.disagg:
+            # Two pools, two autoscalers: the decode pool scales on
+            # queue/shed pressure, the prefill pool on its own
+            # signals (prefill backlog tokens). Victims are picked
+            # within their pool — a decode scale-down can never
+            # drain a prefill replica.
+            self._scale_pool(
+                [v for v in views if v.role in ('decode', '')],
+                self.autoscaler, 'decode', now)
+            self._scale_pool(
+                [v for v in views if v.role == 'prefill'],
+                self.prefill_autoscaler, 'prefill', now)
+        else:
+            self._scale_pool(views, self.autoscaler, '', now)
+
+        # Forget terminal views so `views()` stays bounded.
+        for view in views:
+            if view.state.is_terminal():
+                self.manager.remove(view.replica_id)
+
+    def _scale_pool(self, views: List[ReplicaView], autoscaler,
+                    role: str, now: float) -> None:
+        """Feed one pool's scraped signals to its autoscaler and act
+        on the decision (spawn carries the pool's role)."""
         ready = [v for v in views
                  if v.state == ReplicaStatus.READY and v.ready]
         launching = [v for v in views
                      if v.state == ReplicaStatus.STARTING]
 
-        if isinstance(self.autoscaler,
+        if isinstance(autoscaler,
                       autoscalers.EngineMetricsAutoscaler):
             for view in ready:
-                self.autoscaler.observe(
+                autoscaler.observe(
                     view.endpoint,
                     queue_depth=view.queue_depth,
                     prefill_backlog_tokens=view.prefill_backlog_tokens,
@@ -147,17 +235,19 @@ class FleetController:
                     now=now)
             for view in views:
                 if view.state.is_terminal():
-                    self.autoscaler.forget(view.endpoint)
+                    autoscaler.forget(view.endpoint)
 
-        decision = self.autoscaler.evaluate(len(ready), len(launching),
-                                            now=now)
+        decision = autoscaler.evaluate(len(ready), len(launching),
+                                       now=now)
         op = autoscalers.AutoscalerDecisionOperator
+        pool = f' [{role}]' if role else ''
         if decision.operator == op.SCALE_UP:
             want = (decision.target_num_replicas - len(ready) -
                     len(launching))
             for _ in range(max(0, want)):
-                view = self.manager.spawn()
-                ux_utils.log(f'fleet: scale-up -> replica '
+                view = self.manager.spawn(
+                    role=role if self.disagg else '')
+                ux_utils.log(f'fleet: scale-up{pool} -> replica '
                              f'{view.replica_id} on :{view.port} '
                              f'(target '
                              f'{decision.target_num_replicas}).')
@@ -165,15 +255,10 @@ class FleetController:
             excess = (len(ready) + len(launching) -
                       decision.target_num_replicas)
             for view in self._pick_victims(launching + ready, excess):
-                ux_utils.log(f'fleet: scale-down -> draining replica '
-                             f'{view.replica_id} (target '
+                ux_utils.log(f'fleet: scale-down{pool} -> draining '
+                             f'replica {view.replica_id} (target '
                              f'{decision.target_num_replicas}).')
                 self.drain_replica(view)
-
-        # Forget terminal views so `views()` stays bounded.
-        for view in views:
-            if view.state.is_terminal():
-                self.manager.remove(view.replica_id)
 
     def safe_tick(self) -> bool:
         """One guarded tick for the control loop: failures are

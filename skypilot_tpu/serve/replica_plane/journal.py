@@ -64,6 +64,9 @@ class ReplicaRecord:
     instance_uuid: str
     state: str
     pid: Optional[int] = None
+    # Disaggregated pool membership ('' = unified/decode-only fleet;
+    # pre-role journals replay with the default).
+    role: str = ''
 
     def to_fields(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -76,7 +79,8 @@ class ReplicaRecord:
                    instance_uuid=str(fields.get('instance_uuid', '')),
                    state=str(fields.get('state', 'STARTING')),
                    pid=(int(fields['pid'])
-                        if fields.get('pid') is not None else None))
+                        if fields.get('pid') is not None else None),
+                   role=str(fields.get('role', '')))
 
 
 class FleetJournal:

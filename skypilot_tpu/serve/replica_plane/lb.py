@@ -106,16 +106,86 @@ class LBMetrics:
             }
 
 
+class PrefillPool:
+    """The LB's view of the prefill pool (disaggregated serving):
+    a round-robin rotation over the prefill-role ready set. Long
+    prompts route here; everything else rides the decode-pool policy
+    — including long prompts when this pool is empty or exhausted
+    (the LB never fails a request because disaggregation is down)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._replicas: list = []
+        self._i = 0
+
+    def set_ready_replicas(self, replicas) -> None:
+        with self._lock:
+            self._replicas = list(replicas)
+
+    @property
+    def ready_replicas(self) -> list:
+        with self._lock:
+            return list(self._replicas)
+
+    def select(self, exclude=None) -> Optional[str]:
+        with self._lock:
+            cands = [r for r in self._replicas
+                     if not exclude or r not in exclude]
+            if not cands:
+                return None
+            self._i += 1
+            return cands[self._i % len(cands)]
+
+
+def estimate_prompt_tokens(path: str, body: Dict[str, Any]) -> int:
+    """Request prompt length in tokens, as well as the LB can know
+    it: exact for token endpoints, chars/4 for text (the routing
+    threshold only needs long-vs-short, not a tokenizer)."""
+    try:
+        if path in ('/generate', '/v1/generate'):
+            rows = body.get('tokens') or []
+            if rows and not isinstance(rows[0], list):
+                rows = [rows]
+            return max((len(r) for r in rows), default=0)
+        if path in ('/generate_text', '/v1/generate_text'):
+            prompts = body.get('prompts', '')
+            if isinstance(prompts, list):
+                prompts = max((str(p) for p in prompts), key=len,
+                              default='')
+            return len(str(prompts)) // 4
+        if path == '/v1/completions':
+            prompt = body.get('prompt', '')
+            if isinstance(prompt, list):
+                prompt = max((str(p) for p in prompt), key=len,
+                             default='')
+            return len(str(prompt)) // 4
+        if path == '/v1/chat/completions':
+            return sum(len(str(m.get('content', '')))
+                       for m in (body.get('messages') or [])) // 4
+    except (TypeError, ValueError, AttributeError):
+        return 0
+    return 0
+
+
 def make_lb_server(policy, port: int, *, policy_name: str,
                    manager=None, page_size: int = 16,
                    max_retries: int = 2,
                    upstream_timeout_s: float = 660.0,
-                   connect_timeout_s: float = 3.0
+                   connect_timeout_s: float = 3.0,
+                   disagg_threshold: int = 0,
+                   prefill_pool: Optional[PrefillPool] = None
                    ) -> ThreadingHTTPServer:
     """Build (not yet serving) the LB. `policy` is a
     LoadBalancingPolicy whose ready set the fleet controller keeps
     current; `manager` (optional) feeds the /fleet/status surface.
-    The server exposes `.lb_metrics` for the bench harness."""
+    The server exposes `.lb_metrics` for the bench harness.
+
+    Disaggregated routing: with `disagg_threshold` > 0 and a
+    `prefill_pool`, generation requests whose estimated prompt
+    length is >= the threshold route to the prefill pool (whose
+    replicas prefill and hand the KV chain to a decode replica);
+    shorter requests keep prefix-affinity routing over the decode
+    pool — the pool that actually holds the pages."""
     import requests as requests_lib
 
     metrics = LBMetrics(policy_name)
@@ -145,9 +215,17 @@ def make_lb_server(policy, port: int, *, policy_name: str,
             if self.path == '/fleet/status':
                 views = ([v.to_dict() for v in manager.views()]
                          if manager is not None else [])
-                self._json({'replicas': views,
-                            'policy': policy_name,
-                            'lb': metrics.snapshot()})
+                body = {'replicas': views,
+                        'policy': policy_name,
+                        'lb': metrics.snapshot()}
+                if disagg_threshold > 0:
+                    body['disagg'] = {
+                        'prompt_threshold': disagg_threshold,
+                        'prefill_pool':
+                            (prefill_pool.ready_replicas
+                             if prefill_pool is not None else []),
+                    }
+                self._json(body)
                 return
             if self.path == '/metrics':
                 body = REGISTRY.render().encode()
@@ -171,21 +249,39 @@ def make_lb_server(policy, port: int, *, policy_name: str,
                 parsed = json.loads(body_bytes) if body_bytes else {}
             except ValueError:
                 parsed = None  # replica's 400 to give; route keyless
+            long_prompt = False
             if isinstance(parsed, dict):
                 key = affinity.request_affinity_key(
                     self.path, parsed, page_size=page_size)
-            self._proxy(body_bytes=body_bytes, key=key)
+                if disagg_threshold > 0 and prefill_pool is not None:
+                    long_prompt = estimate_prompt_tokens(
+                        self.path, parsed) >= disagg_threshold
+            self._proxy(body_bytes=body_bytes, key=key,
+                        long_prompt=long_prompt)
 
         def _proxy(self, body_bytes: Optional[bytes],
-                   key: Optional[str]) -> None:
+                   key: Optional[str],
+                   long_prompt: bool = False) -> None:
             tried = set()
             for attempt in range(max_retries + 1):
-                replica = policy.select_replica(key=key,
-                                                exclude=tried)
+                from_prefill = False
+                replica = None
+                if long_prompt and prefill_pool is not None:
+                    # Long prompts go to the prefill pool (their
+                    # replicas hand the KV chain to the decode pool);
+                    # an empty/exhausted pool falls back to normal
+                    # decode routing — disaggregation being down
+                    # degrades, it never 5xxes.
+                    replica = prefill_pool.select(exclude=tried)
+                    from_prefill = replica is not None
+                if replica is None:
+                    replica = policy.select_replica(key=key,
+                                                    exclude=tried)
                 if replica is None:
                     self._json({'error': 'no ready replicas'}, 503)
                     return
                 if attempt == 0 and key is not None and \
+                        not from_prefill and \
                         hasattr(policy, 'affinity_target'):
                     target = policy.affinity_target(key)
                     metrics.record_affinity(hit=replica == target)
@@ -193,7 +289,8 @@ def make_lb_server(policy, port: int, *, policy_name: str,
                 try:
                     done = self._forward(replica, body_bytes)
                 finally:
-                    policy.request_done(replica)
+                    if not from_prefill:
+                        policy.request_done(replica)
                 if done:
                     return
                 # Not-yet-streamed failure: safe to retry elsewhere.

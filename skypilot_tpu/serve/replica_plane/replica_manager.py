@@ -134,11 +134,20 @@ class ReplicaView:
     ready: bool = False
     engine_healthy: bool = True
     scrape_failures: int = 0           # consecutive
+    # Disaggregated pool membership: '' (unified), 'prefill', or
+    # 'decode' — assigned at spawn, confirmed by the /stats echo.
+    role: str = ''
     queue_depth: int = 0
     prefill_backlog_tokens: int = 0
     requests_shed_total: int = 0
     prefix_hits: int = 0
     prefix_misses: int = 0
+    # Tiered-cache state scraped from /stats `kv_spill` (zero when
+    # the replica runs without a spill tier) — the fleet dashboard's
+    # cache-residency signal next to the prefix hit rate.
+    kv_spill_bytes: int = 0
+    kv_spilled_pages: int = 0
+    kv_restored_pages: int = 0
     # Multi-LoRA inventory scraped from /stats `adapters` (empty for
     # base-only replicas): which adapters this replica has device-
     # resident right now, and how many artifacts it can serve.
@@ -159,12 +168,16 @@ class ReplicaView:
             'adopted': self.adopted,
             'ready': self.ready,
             'engine_healthy': self.engine_healthy,
+            'role': self.role,
             'queue_depth': self.queue_depth,
             'prefill_backlog_tokens': self.prefill_backlog_tokens,
             'requests_shed_total': self.requests_shed_total,
             'prefix_hits': self.prefix_hits,
             'prefix_misses': self.prefix_misses,
             'prefix_hit_rate': round(self.prefix_hit_rate, 4),
+            'kv_spill_bytes': self.kv_spill_bytes,
+            'kv_spilled_pages': self.kv_spilled_pages,
+            'kv_restored_pages': self.kv_restored_pages,
             'adapters_loaded': list(self.adapters_loaded),
             'adapters_inventory': self.adapters_inventory,
         }
@@ -180,14 +193,18 @@ def serve_lm_factory(base_cmd: List[str],
     the usual shape (recipes/serve_fleet.py builds it)."""
 
     def spawn(replica_id: int, port: int,
-              instance_uuid: str = '') -> 'subprocess.Popen':
+              instance_uuid: str = '',
+              role: str = '') -> 'subprocess.Popen':
         del replica_id
         out = subprocess.DEVNULL if quiet else None
         child_env = dict(env if env is not None else os.environ)
         if instance_uuid:
             child_env[INSTANCE_UUID_ENV] = instance_uuid
+        cmd = base_cmd + ['--port', str(port)]
+        if role:
+            cmd += ['--role', role]
         return subprocess.Popen(
-            base_cmd + ['--port', str(port)], env=child_env,
+            cmd, env=child_env,
             stdout=out, stderr=subprocess.STDOUT if quiet else None)
 
     return spawn
@@ -200,10 +217,13 @@ def stub_factory(extra_args: Optional[List[str]] = None,
     deterministic fleet for bench smokes."""
 
     def spawn(replica_id: int, port: int,
-              instance_uuid: str = '') -> 'subprocess.Popen':
+              instance_uuid: str = '',
+              role: str = '') -> 'subprocess.Popen':
         cmd = [sys.executable, '-m',
                'skypilot_tpu.serve.replica_plane.stub',
                '--port', str(port), '--seed', str(replica_id)]
+        if role:
+            cmd += ['--role', role]
         cmd += list(extra_args or [])
         child_env = dict(env if env is not None else os.environ)
         if instance_uuid:
@@ -257,12 +277,14 @@ class ReplicaManager:
         # keep working, their replicas just never verify on adopt.
         try:
             params = inspect.signature(factory).parameters
-            self._factory_takes_uuid = (
-                'instance_uuid' in params or
-                any(p.kind == p.VAR_KEYWORD
-                    for p in params.values()))
+            var_kw = any(p.kind == p.VAR_KEYWORD
+                         for p in params.values())
+            self._factory_takes_uuid = ('instance_uuid' in params or
+                                        var_kw)
+            self._factory_takes_role = 'role' in params or var_kw
         except (TypeError, ValueError):
             self._factory_takes_uuid = False
+            self._factory_takes_role = False
         self.startup_grace_s = startup_grace_s
         self.drain_grace_s = drain_grace_s
         self.scrape_timeout_s = scrape_timeout_s
@@ -302,7 +324,8 @@ class ReplicaManager:
                 endpoint=view.endpoint,
                 instance_uuid=view.instance_uuid,
                 state=view.state.value,
-                pid=getattr(view.proc, 'pid', None)).to_fields())
+                pid=getattr(view.proc, 'pid', None),
+                role=view.role).to_fields())
 
     def _journal_state(self, view: ReplicaView) -> None:
         if self._journal is None:
@@ -318,21 +341,25 @@ class ReplicaManager:
             'terminate', replica_id=replica_id)
 
     # -- lifecycle -------------------------------------------------------
-    def spawn(self) -> ReplicaView:
+    def spawn(self, role: str = '') -> ReplicaView:
+        """Spawn a replica; `role` ('' | 'prefill' | 'decode')
+        selects its disaggregated pool and is forwarded to factories
+        that accept it (serve_lm/stub factories pass --role)."""
         with self._lock:
             rid = next(self._ids)
         port = free_port()
         instance_uuid = uuid_lib.uuid4().hex
+        kwargs = {}
         if self._factory_takes_uuid:
-            proc = self._factory(rid, port,
-                                 instance_uuid=instance_uuid)
-        else:
-            proc = self._factory(rid, port)
+            kwargs['instance_uuid'] = instance_uuid
+        if role and self._factory_takes_role:
+            kwargs['role'] = role
+        proc = self._factory(rid, port, **kwargs)
         view = ReplicaView(replica_id=rid, port=port,
                            endpoint=f'127.0.0.1:{port}',
                            state=ReplicaStatus.STARTING,
                            spawned_at=self._clock(), proc=proc,
-                           instance_uuid=instance_uuid)
+                           instance_uuid=instance_uuid, role=role)
         with self._lock:
             self._replicas[rid] = view
         self._journal_spawn(view)
@@ -407,7 +434,8 @@ class ReplicaManager:
                            else ReplicaStatus.STARTING),
                     spawned_at=self._clock(),
                     proc=self._reattach(rec),
-                    instance_uuid=rec.instance_uuid, adopted=True)
+                    instance_uuid=rec.instance_uuid, adopted=True,
+                    role=rec.role)
                 with self._lock:
                     self._replicas[rid] = view
                 if view.state == ReplicaStatus.DRAINING:
@@ -464,10 +492,21 @@ class ReplicaManager:
         with self._lock:
             return self._replicas.get(replica_id)
 
-    def ready_endpoints(self) -> List[str]:
+    def ready_endpoints(self,
+                        role: Optional[str] = None) -> List[str]:
+        """READY endpoints, optionally filtered by pool. `role=None`
+        returns every ready replica (the unified-fleet behavior);
+        'decode' additionally matches role-less replicas so a mixed
+        fleet keeps its unified members serving decode traffic."""
         with self._lock:
-            return [v.endpoint for v in self._replicas.values()
-                    if v.state == ReplicaStatus.READY and v.ready]
+            views = [v for v in self._replicas.values()
+                     if v.state == ReplicaStatus.READY and v.ready]
+        if role is None:
+            return [v.endpoint for v in views]
+        if role == 'decode':
+            return [v.endpoint for v in views
+                    if v.role in ('decode', '')]
+        return [v.endpoint for v in views if v.role == role]
 
     def mark_draining(self, replica_id: int) -> None:
         """Step 1 of the drain contract: the replica leaves the
@@ -623,9 +662,19 @@ class ReplicaManager:
         view.requests_shed_total = int(
             stats.get('requests_shed', 0) or 0)
         view.engine_healthy = bool(stats.get('healthy', True))
+        # The replica's own role echo wins over the spawn-time label
+        # (an adopted replica's journaled role may predate a config
+        # change; the process knows what it is actually running).
+        view.role = str(stats.get('role', view.role) or view.role)
         prefix = stats.get('prefix_cache') or {}
         view.prefix_hits = int(prefix.get('hits', 0) or 0)
         view.prefix_misses = int(prefix.get('misses', 0) or 0)
+        spill = stats.get('kv_spill') or {}
+        view.kv_spill_bytes = int(spill.get('bytes', 0) or 0)
+        view.kv_spilled_pages = int(spill.get('spilled_pages', 0)
+                                    or 0)
+        view.kv_restored_pages = int(spill.get('restored_pages', 0)
+                                     or 0)
         adapters = stats.get('adapters') or {}
         view.adapters_loaded = list(adapters.get('loaded') or [])
         view.adapters_inventory = len(adapters.get('inventory') or [])

@@ -50,8 +50,24 @@ class StubState:
     def __init__(self, *, seed: int, page_size: int, cache_pages: int,
                  token_sleep_s: float, die_after_tokens: int,
                  on_die: Optional[Callable[[], None]],
-                 instance_uuid: Optional[str] = None) -> None:
+                 instance_uuid: Optional[str] = None,
+                 role: str = '',
+                 prefill_ms_per_token: float = 0.0) -> None:
         self.seed = seed
+        # Disaggregation model (mirrors serve_lm --role): one
+        # "engine" lock serializes prefill chunks and token emission
+        # — a long prompt's simulated prefill delays every other
+        # stream's tokens exactly like the real single-engine
+        # replica, UNLESS the pages arrived via /kv/import (cache
+        # hits cost no prefill). prefill stubs hand the chain keys
+        # off to a decode peer and proxy its response.
+        self.role = role
+        self.prefill_ms_per_token = prefill_ms_per_token
+        self.engine_lock = threading.Lock()
+        self.decode_peers: List[str] = []
+        self.handoffs = 0
+        self.handoff_failures = 0
+        self.kv_imports = 0
         # Identity echoed in /stats; the replica plane's adoption
         # path matches it against the journaled UUID (same contract
         # as the real serve_lm server).
@@ -78,12 +94,24 @@ class StubState:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        # Engine-side inter-token gaps (seconds), per stream row —
+        # the commit-time ITL signal the real engine reports; client
+        # SSE timing rides TCP buffering and can't see ms-scale
+        # contention. /stats ships the recent raw gaps so a bench
+        # can compute true fleet-wide percentiles.
+        self.itl_gaps: 'collections.deque' = collections.deque(
+            maxlen=4096)
         # Tests inject autoscaler pressure here (merged last into
         # /stats): e.g. {'prefill_backlog_tokens': 99999}.
         self.stats_overrides: Dict[str, Any] = {}
 
-    def account_pages(self, tokens: List[int]) -> None:
+    def account_pages(self, tokens: List[int]) -> int:
+        """Record the prompt's chain keys against the bounded page
+        cache; returns the number of MISSED pages (the pages this
+        replica would have to prefill — imported/cached pages cost
+        nothing, which is exactly the disaggregation win)."""
         keys = affinity.chain_keys(tokens, self.page_size)
+        n_miss = 0
         with self.lock:
             for key in keys:
                 if key in self.cache:
@@ -92,9 +120,40 @@ class StubState:
                 else:
                     self.cache[key] = None
                     self.misses += 1
+                    n_miss += 1
                     while len(self.cache) > self.cache_pages:
                         self.cache.popitem(last=False)
                         self.evictions += 1
+        return n_miss
+
+    def import_keys(self, keys: List[bytes]) -> int:
+        """Decode side of a stub handoff: adopt the chain keys as
+        resident pages (no hit/miss accounting — the import is the
+        transfer, not a lookup)."""
+        n = 0
+        with self.lock:
+            for key in keys:
+                if key not in self.cache:
+                    self.cache[key] = None
+                    n += 1
+                self.cache.move_to_end(key)
+                while len(self.cache) > self.cache_pages:
+                    self.cache.popitem(last=False)
+                    self.evictions += 1
+            self.kv_imports += 1
+        return n
+
+    def simulate_prefill(self, n_miss_pages: int) -> None:
+        """Model compute-bound prefill: one engine-lock hold per
+        missed page (chunked prefill — decode tokens of OTHER
+        streams interleave between chunks but wait out the chunk in
+        flight, like the real scheduler)."""
+        if self.prefill_ms_per_token <= 0:
+            return
+        per_page_s = self.prefill_ms_per_token * self.page_size / 1e3
+        for _ in range(n_miss_pages):
+            with self.engine_lock:
+                time.sleep(per_page_s)
 
     def emit_token(self) -> None:
         """One token committed; fires the crash knob exactly at the
@@ -112,7 +171,12 @@ class StubState:
                 raise _StubDied()
             os._exit(1)
         if self.token_sleep_s > 0:
-            time.sleep(self.token_sleep_s)
+            # Decode rides the same engine lock as prefill chunks:
+            # a concurrent long prefill stretches THIS stream's
+            # inter-token gaps (the unified-replica tail damage the
+            # disaggregated arm removes).
+            with self.engine_lock:
+                time.sleep(self.token_sleep_s)
 
     def stats(self) -> Dict[str, Any]:
         with self.lock:
@@ -121,11 +185,20 @@ class StubState:
                 'instance_uuid': self.instance_uuid,
                 'pid': os.getpid(),
                 'healthy': not self.aborted.is_set(),
+                'role': self.role,
                 'queued': self.inflight,
                 'prefill_backlog_tokens': 0,
                 'requests_shed': 0,
                 'requests_served': self.requests_served,
                 'tokens_emitted': self.tokens_emitted,
+                'handoff': {
+                    'decode_peers': list(self.decode_peers),
+                    'handoffs': self.handoffs,
+                    'failures': self.handoff_failures,
+                    'kv_imports': self.kv_imports,
+                },
+                'itl_gaps_ms': [round(g * 1000.0, 3)
+                                for g in self.itl_gaps],
                 'prefix_cache': {
                     'hits': self.hits,
                     'misses': self.misses,
@@ -144,13 +217,17 @@ def make_stub_server(port: int, *, seed: int = 0, page_size: int = 16,
                      token_sleep_s: float = 0.0,
                      die_after_tokens: int = 0,
                      on_die: Optional[Callable[[], None]] = None,
-                     instance_uuid: Optional[str] = None
+                     instance_uuid: Optional[str] = None,
+                     role: str = '',
+                     prefill_ms_per_token: float = 0.0
                      ) -> ThreadingHTTPServer:
     state = StubState(seed=seed, page_size=page_size,
                       cache_pages=cache_pages,
                       token_sleep_s=token_sleep_s,
                       die_after_tokens=die_after_tokens,
-                      on_die=on_die, instance_uuid=instance_uuid)
+                      on_die=on_die, instance_uuid=instance_uuid,
+                      role=role,
+                      prefill_ms_per_token=prefill_ms_per_token)
 
     class Handler(BaseHTTPRequestHandler):
 
@@ -185,14 +262,26 @@ def make_stub_server(port: int, *, seed: int = 0, page_size: int = 16,
                         'vocab_size': 50000, 'max_total_len': 4096})
 
         def do_POST(self):  # noqa: N802
-            if self.path not in ('/generate', '/v1/generate'):
+            if self.path == '/kv/peers':
+                length = int(self.headers.get('Content-Length', 0))
+                req = json.loads(self.rfile.read(length))
+                with state.lock:
+                    state.decode_peers = [
+                        str(p) for p in (req.get('decode') or [])]
+                self._json({'decode': state.decode_peers})
+                return
+            if self.path not in ('/generate', '/v1/generate',
+                                 '/kv/import'):
                 self._json({'error': 'stub serves POST /generate'},
                            404)
                 return
             with state.lock:
                 state.inflight += 1
             try:
-                self._generate()
+                if self.path == '/kv/import':
+                    self._kv_import()
+                else:
+                    self._generate()
             except _StubDied:
                 # Crash simulation: the connection just breaks —
                 # the client sees a reset/truncation, as with a
@@ -203,16 +292,98 @@ def make_stub_server(port: int, *, seed: int = 0, page_size: int = 16,
                     state.inflight -= 1
                     state.requests_served += 1
 
-        def _generate(self):
+        def _kv_import(self):
+            """Decode side of a stub handoff: adopt the chain keys
+            (imported pages = resident pages, no prefill cost), then
+            serve the embedded request like a direct /generate."""
             length = int(self.headers.get('Content-Length', 0))
             req = json.loads(self.rfile.read(length))
+            keys = [bytes.fromhex(k) for k in (req.get('keys') or [])]
+            state.import_keys(keys)
+            inner = req.get('request')
+            if not inner:
+                self._json({'imported': len(keys)})
+                return
+            self._generate(inner)
+
+        def _handoff(self, req, rows) -> bool:
+            """Prefill-role stub: pay the prefill locally, ship the
+            chain keys to the first decode peer, proxy its response.
+            False on any failure — the caller serves locally (same
+            graceful-fallback contract as the real server)."""
+            with state.lock:
+                peers = list(state.decode_peers)
+            if not peers or len(rows) != 1:
+                return False
+            row = [int(t) for t in rows[0]]
+            n_miss = state.account_pages(row)
+            state.simulate_prefill(n_miss)
+            keys = affinity.chain_keys(row, state.page_size)
+            import requests as requests_lib
+            key = affinity.token_affinity_key(row, state.page_size)
+            peer = peers[0]
+            if key is not None and len(peers) > 1:
+                idx = int.from_bytes(bytes.fromhex(key)[:4], 'big')
+                peer = peers[idx % len(peers)]
+            try:
+                upstream = requests_lib.post(
+                    f'http://{peer}/kv/import',
+                    json={'keys': [k.hex() for k in keys],
+                          'request': req},
+                    stream=True, timeout=(2.0, 600.0))
+                if upstream.status_code >= 429:
+                    upstream.close()
+                    raise RuntimeError(
+                        f'decode stub answered '
+                        f'{upstream.status_code}')
+            except (requests_lib.RequestException,
+                    RuntimeError) as e:
+                with state.lock:
+                    state.handoffs += 1
+                    state.handoff_failures += 1
+                print(f'stub handoff failed ({e}); serving locally',
+                      flush=True)
+                return False
+            with state.lock:
+                state.handoffs += 1
+            with upstream:
+                self.send_response(upstream.status_code)
+                ctype = upstream.headers.get('Content-Type',
+                                             'application/json')
+                self.send_header('Content-Type', ctype)
+                body_bytes = None
+                if 'text/event-stream' not in ctype:
+                    body_bytes = upstream.content
+                    self.send_header('Content-Length',
+                                     str(len(body_bytes)))
+                self.end_headers()
+                if body_bytes is not None:
+                    self.wfile.write(body_bytes)
+                    return True
+                try:
+                    for chunk in upstream.iter_content(2048):
+                        if chunk:
+                            self.wfile.write(chunk)
+                            self.wfile.flush()
+                except (requests_lib.RequestException, OSError):
+                    pass  # truncation: same as a replica death
+            return True
+
+        def _generate(self, req=None):
+            if req is None:
+                length = int(self.headers.get('Content-Length', 0))
+                req = json.loads(self.rfile.read(length))
             rows = req.get('tokens') or [[]]
             if rows and not isinstance(rows[0], list):
                 rows = [rows]
             max_new = int(req.get('max_new_tokens', 8))
             stream = bool(req.get('stream'))
+            if state.role == 'prefill' and self.path != '/kv/import':
+                if self._handoff(req, rows):
+                    return
             for row in rows:
-                state.account_pages([int(t) for t in row])
+                n_miss = state.account_pages([int(t) for t in row])
+                state.simulate_prefill(n_miss)
             out_rows = []
             if stream:
                 self.send_response(200)
@@ -222,10 +393,16 @@ def make_stub_server(port: int, *, seed: int = 0, page_size: int = 16,
                 self.end_headers()
             for i, row in enumerate(rows):
                 produced = list(row)
+                last_t = None
                 for j in range(max_new):
                     tok = (state.seed * 1000003 + len(row) * 31 +
                            j) % 50000
                     state.emit_token()
+                    now = time.monotonic()
+                    if last_t is not None:
+                        with state.lock:
+                            state.itl_gaps.append(now - last_t)
+                    last_t = now
                     produced.append(tok)
                     if stream:
                         self.wfile.write(
@@ -342,12 +519,15 @@ def in_process_stub_factory(**stub_kwargs: Any
     per_replica = stub_kwargs.pop('per_replica', {})
 
     def spawn(replica_id: int, port: int,
-              instance_uuid: str = '') -> InProcessStubReplica:
+              instance_uuid: str = '',
+              role: str = '') -> InProcessStubReplica:
         kwargs = dict(stub_kwargs)
         kwargs.update(per_replica.get(replica_id, {}))
         kwargs.setdefault('seed', replica_id)
         if instance_uuid:
             kwargs.setdefault('instance_uuid', instance_uuid)
+        if role:
+            kwargs.setdefault('role', role)
         return InProcessStubReplica(port, **kwargs)
 
     return spawn
@@ -361,13 +541,24 @@ def main() -> None:
     parser.add_argument('--cache-pages', type=int, default=64)
     parser.add_argument('--token-sleep-ms', type=float, default=1.0)
     parser.add_argument('--die-after-tokens', type=int, default=0)
+    parser.add_argument('--role', choices=['', 'prefill', 'decode'],
+                        default='')
+    parser.add_argument('--prefill-ms-per-token', type=float,
+                        default=0.0,
+                        help='simulated compute-bound prefill: each '
+                             'missed prompt page holds the engine '
+                             'lock page_size*this ms (decode tokens '
+                             'of other streams wait it out, like the '
+                             'real chunked-prefill scheduler)')
     args = parser.parse_args()
 
     server = make_stub_server(
         args.port, seed=args.seed, page_size=args.page_size,
         cache_pages=args.cache_pages,
         token_sleep_s=args.token_sleep_ms / 1000.0,
-        die_after_tokens=args.die_after_tokens, on_die=None)
+        die_after_tokens=args.die_after_tokens, on_die=None,
+        role=args.role,
+        prefill_ms_per_token=args.prefill_ms_per_token)
     state: StubState = server.stub
 
     def _drain_loop():
