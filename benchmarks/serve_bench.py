@@ -658,6 +658,17 @@ def _run_single(args, adapter_dir=None, assignment=None) -> dict:
                 'total'),
             'kv_pool_bytes': (stats.get('page_pool') or {}).get(
                 'pool_bytes'),
+            # Sharded-pool geometry (PR 15): chips in the mesh, how
+            # many ways the pool's kv-heads axis shards, and the
+            # per-chip resident bytes (--kv-pool-bytes budgets the
+            # LATTER — N sharded chips hold ~Nx kv_pages_total).
+            'mesh_devices': (stats.get('storage') or {}).get(
+                'mesh_devices'),
+            'kv_shard_ways': (stats.get('page_pool') or {}).get(
+                'shard_ways'),
+            'kv_pool_bytes_per_device': (stats.get('page_pool')
+                                         or {}).get(
+                'pool_bytes_per_device'),
             'prefix_hit_rate': (stats.get('prefix_cache') or {}).get(
                 'hit_rate'),
             'prefix_evictions': (stats.get('prefix_cache') or {}).get(
@@ -769,13 +780,21 @@ def run_tensor_ab(args) -> dict:
     """--tensor 1 vs --tensor N over the identical workload: the
     per-chip decode-throughput scaling record (ROADMAP item 1's
     still-missing serve_bench deliverable; CPU runs fake the chips
-    with XLA host devices)."""
+    with XLA host devices).
+
+    With --kv-pool-bytes set the A/B grows a POOL-CAPACITY axis
+    (PR 15): the flag is per-chip, so both arms spend the same HBM
+    per chip, and the sharded-pool arm should report ~Nx the TOTAL
+    pages — the headline `pool_pages_ratio` — with fewer
+    page-pressure preemptions and better prefix-cache residency at
+    the same offered load."""
     n = max(2, args.tensor)
     runs = {
         'tensor_1': _run_single(_with(args, tensor=1)),
         f'tensor_{n}': _run_single(_with(args, tensor=n)),
     }
-    return {
+    base, tp = runs['tensor_1'], runs[f'tensor_{n}']
+    out = {
         'bench': 'serve_tensor',
         'engine': args.engine,
         'model': args.model,
@@ -785,10 +804,24 @@ def run_tensor_ab(args) -> dict:
         'kv_dtype': args.kv_dtype or 'bf16',
         'weight_dtype': args.weight_dtype or 'bf16',
         'per_chip_ratio': round(
-            runs[f'tensor_{n}']['per_chip_req_per_sec'] /
-            max(runs['tensor_1']['per_chip_req_per_sec'], 1e-9), 3),
+            tp['per_chip_req_per_sec'] /
+            max(base['per_chip_req_per_sec'], 1e-9), 3),
         'runs': runs,
     }
+    if args.kv_pool_bytes:
+        out['kv_pool_bytes_per_chip'] = args.kv_pool_bytes
+        out['pool_pages_ratio'] = round(
+            (tp['kv_pages_total'] or 0) /
+            max(base['kv_pages_total'] or 0, 1), 3)
+        out['pool_capacity'] = {
+            arm: {'kv_pages_total': rec['kv_pages_total'],
+                  'kv_shard_ways': rec['kv_shard_ways'],
+                  'kv_pool_bytes_per_device':
+                      rec['kv_pool_bytes_per_device'],
+                  'preemptions': rec['preemptions'],
+                  'prefix_hit_rate': rec['prefix_hit_rate']}
+            for arm, rec in runs.items()}
+    return out
 
 
 def run_disagg_ab(args) -> dict:

@@ -233,6 +233,10 @@ def make_server(rt: InferenceRuntime,
                         'kv_dtype': rt.kv_dtype,
                         'weight_dtype': rt.weight_dtype,
                         'weight_bytes': rt.weight_bytes,
+                        # Mesh-sharded serving (docs/guides.md
+                        # "Sharded serving"): devices the engines'
+                        # state spans (1 = single device).
+                        'mesh_devices': rt.mesh_devices,
                     }}
             if rt.role or rt.handoffs_total or rt.kv_imports_total:
                 body['handoff'] = rt.handoff_stats()
@@ -285,6 +289,13 @@ def make_server(rt: InferenceRuntime,
                         max(engine.total_pages, 1), 3),
                     'kv_dtype': engine.kv_dtype,
                     'pool_bytes': engine.kv_cache_bytes(),
+                    # Per-chip view of the sharded pool: bytes ONE
+                    # device holds and how many ways the kv-heads
+                    # axis actually split (1 = replicated — single
+                    # device or the GQA remainder rule fired).
+                    'pool_bytes_per_device':
+                        engine.kv_cache_bytes_per_device(),
+                    'shard_ways': engine.kv_shard_ways,
                 }
                 if engine.prefix_cache is not None:
                     pc = engine.prefix_cache

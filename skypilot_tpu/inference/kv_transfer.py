@@ -27,10 +27,19 @@ restored page is indistinguishable from a freshly computed one.
 
 Wire layout: MAGIC ++ u64 header length ++ header JSON ++ payload.
 The header carries the chain keys (hex), the adapter salt, the page
-geometry (kv_dtype, page_size) and one (path, dtype, shape) record
-per cache leaf; the payload is each leaf's page-major array bytes in
-header order. Everything is numpy + stdlib — the packing side runs
-on the engine scheduler thread, the unpacking side may run anywhere.
+geometry (kv_dtype, page_size, and — since PR 15 — num_kv_heads /
+head_dim) and one (path, dtype, shape) record per cache leaf; the
+payload is each leaf's page-major array bytes in header order.
+Everything is numpy + stdlib — the packing side runs on the engine
+scheduler thread, the unpacking side may run anywhere.
+
+MESH-AGNOSTIC BY CONSTRUCTION: exported blobs hold GLOBAL page rows
+— the engine's gather device_gets the sharded pool, which assembles
+the kv-head shards — so a chain exported from a tensor-N prefill
+mesh imports into a decode mesh of any size; the importer's own
+cache shardings re-scatter on write. The header geometry lets the
+importer reject a genuinely different model loudly instead of
+scattering garbage.
 """
 from __future__ import annotations
 

@@ -147,33 +147,49 @@ class QuantizedModel:
         return getattr(self.base_model, name)
 
 
-def kv_page_bytes(cfg, kv_dtype: str) -> int:
-    """Device bytes ONE physical KV page costs across all layers
-    (K + V values, plus scale slots for int8) — the unit the
+def kv_page_bytes(cfg, kv_dtype: str, shard_ways: int = 1) -> int:
+    """Device bytes ONE physical KV page costs across all layers ON
+    ONE CHIP (K + V values, plus scale slots for int8) — the unit the
     --kv-pool-bytes knob divides by, so a byte budget maps to the
-    same HBM spend for either storage format."""
+    same HBM spend for either storage format.
+
+    `shard_ways` is how many ways the pool's kv-heads axis shards
+    over the mesh (parallel/serving.py kv_shard_ways): each chip then
+    stores 1/shard_ways of the VALUE bytes but the FULL scale rows
+    (per-token scales replicate — every head shard quantizes against
+    the same scale), so an N-way pool's per-chip page is cheaper and
+    the same per-chip budget buys ~N x the pages."""
     import jax.numpy as jnp
     per_layer = 2 * cfg.num_kv_heads * cfg.kv_page_size * cfg.head_dim
+    if cfg.num_kv_heads % shard_ways:
+        raise ValueError(
+            f'shard_ways={shard_ways} does not divide num_kv_heads='
+            f'{cfg.num_kv_heads} (the GQA remainder rule replicates '
+            f'instead — pass shard_ways=1)')
     if kv_dtype == 'int8':
-        value_bytes = per_layer * 1
+        value_bytes = per_layer // shard_ways
         scale_bytes = 2 * cfg.kv_page_size * 4
     else:
-        value_bytes = per_layer * jnp.dtype(cfg.dtype).itemsize
+        value_bytes = (per_layer // shard_ways *
+                       jnp.dtype(cfg.dtype).itemsize)
         scale_bytes = 0
     return cfg.num_layers * (value_bytes + scale_bytes)
 
 
-def pool_pages_for_bytes(cfg, kv_dtype: str, pool_bytes: int) -> int:
-    """Physical pages a byte budget buys under `kv_dtype` — how
-    serve_lm --kv-pool-bytes sizes kv_total_pages (int8 fits ~2x the
-    pages of bf16 in the same bytes)."""
-    pages = pool_bytes // kv_page_bytes(cfg, kv_dtype)
+def pool_pages_for_bytes(cfg, kv_dtype: str, pool_bytes: int,
+                         shard_ways: int = 1) -> int:
+    """Physical pages a PER-CHIP byte budget buys under `kv_dtype` —
+    how serve_lm --kv-pool-bytes sizes kv_total_pages (int8 fits ~2x
+    the pages of bf16 in the same bytes; a pool head-sharded
+    `shard_ways` ways fits ~shard_ways more again at the same
+    per-chip HBM)."""
+    pages = pool_bytes // kv_page_bytes(cfg, kv_dtype, shard_ways)
     if pages < 2:
         raise ValueError(
             f'--kv-pool-bytes {pool_bytes} buys {pages} pages '
-            f'({kv_page_bytes(cfg, kv_dtype)} bytes/page, '
-            f'kv_dtype={kv_dtype}); need >= 2 (page 0 is the trash '
-            f'page)')
+            f'({kv_page_bytes(cfg, kv_dtype, shard_ways)} bytes/page '
+            f'across layers, kv_dtype={kv_dtype}); need >= 2 (page 0 '
+            f'is the trash page)')
     return int(pages)
 
 

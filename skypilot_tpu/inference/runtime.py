@@ -227,10 +227,17 @@ class InferenceRuntime:
                  kv_dtype: str = 'bf16',
                  weight_dtype: str = 'bf16',
                  role: str = '',
-                 decode_peers: Optional[List[str]] = None) -> None:
+                 decode_peers: Optional[List[str]] = None,
+                 mesh=None) -> None:
         import jax
         self.model = model
         self.params = params
+        # Tensor-parallel serving mesh (None = single device): the
+        # engines' KV pools shard over it; /stats `storage` reports
+        # mesh_devices so operators can audit per-chip pool math.
+        self.mesh = mesh
+        self.mesh_devices = (int(mesh.devices.size)
+                             if mesh is not None else 1)
         # Disaggregated serving (docs/guides.md "Disaggregated
         # serving & cache tiering"): '' = unified replica (the
         # classic mode), 'decode' labels a decode-pool member,
@@ -535,7 +542,8 @@ class InferenceRuntime:
                                      else self._pipeline_decode),
                     max_queue_requests=self._max_queue_requests,
                     max_queue_tokens=self._max_queue_tokens,
-                    adapter_store=self.adapters)
+                    adapter_store=self.adapters,
+                    mesh=self.mesh)
             return self._stream_engine
 
     def deadline_for(self, req: dict) -> float:
@@ -657,15 +665,28 @@ def build_runtime(args) -> InferenceRuntime:
                 'one-shot engine decodes through the dense per-slot '
                 'cache, which has no scale storage')
         import dataclasses
+        # --kv-pool-bytes is PER-CHIP HBM: under --tensor the pool's
+        # kv-heads axis shards (parallel/serving.py GQA remainder
+        # rule), one page costs 1/shard_ways the value bytes per
+        # chip, and the same per-chip budget buys ~shard_ways x the
+        # pages — an N-chip mesh holds ~N x the decode capacity at
+        # fixed per-chip memory.
+        from skypilot_tpu.parallel.serving import kv_shard_ways
+        shard_ways = kv_shard_ways(
+            int(getattr(cfg, 'num_kv_heads', 0) or 0),
+            int(getattr(args, 'tensor', 1) or 1))
         pages = (quant_lib.pool_pages_for_bytes(cfg, kv_dtype,
-                                                kv_pool_bytes)
+                                                kv_pool_bytes,
+                                                shard_ways)
                  if kv_pool_bytes else cfg.kv_total_pages)
         cfg = dataclasses.replace(cfg, kv_dtype=kv_dtype,
                                   kv_total_pages=pages)
         model = type(model)(cfg)
+        sharded = (f', kv heads sharded {shard_ways}-way'
+                   if shard_ways > 1 else '')
         print(f'kv cache: dtype={kv_dtype} pages={pages} '
-              f'({quant_lib.kv_page_bytes(cfg, kv_dtype)} bytes/page '
-              f'across layers)', flush=True)
+              f'({quant_lib.kv_page_bytes(cfg, kv_dtype, shard_ways)} '
+              f'bytes/page/chip across layers{sharded})', flush=True)
 
     # Speculative decoding writes its verify chunk up to K tokens past
     # the last kept one; fail fast / clamp at STARTUP instead of
@@ -842,7 +863,8 @@ def build_runtime(args) -> InferenceRuntime:
             max_queue_tokens=max_queue_tokens,
             adapter_store=adapters,
             kv_spill_bytes=kv_spill_bytes,
-            kv_cold_dir=kv_cold_dir)
+            kv_cold_dir=kv_cold_dir,
+            mesh=mesh)
 
     rt = InferenceRuntime(
         model=model, params=params, vocab_size=vocab_size,
@@ -859,7 +881,7 @@ def build_runtime(args) -> InferenceRuntime:
         max_queue_tokens=max_queue_tokens,
         adapters=adapters,
         kv_dtype=kv_dtype, weight_dtype=weight_dtype,
-        role=role, decode_peers=decode_peers)
+        role=role, decode_peers=decode_peers, mesh=mesh)
     from skypilot_tpu.observability import catalog as _obs_catalog
     _obs_catalog.gauge('skypilot_serving_weight_bytes').set(
         rt.weight_bytes)

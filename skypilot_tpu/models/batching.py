@@ -243,8 +243,20 @@ class ContinuousBatchingEngine:
                  max_queue_tokens: int = 0,
                  adapter_store=None,
                  kv_spill_bytes: int = 0,
-                 kv_cold_dir: Optional[str] = None) -> None:
+                 kv_cold_dir: Optional[str] = None,
+                 mesh=None) -> None:
         assert max_total_len <= model.config.max_seq_len
+        # Mesh-sharded device state (parallel/serving.py): with a
+        # mesh, the KV cache is EXPLICITLY placed — paged pool values
+        # shard their kv-heads axis over `tensor` (GQA remainder
+        # rule: replicate when heads don't divide), scale pages
+        # replicate — and every jitted dispatch pins the donated
+        # cache's out_sharding, so an N-chip mesh holds ~N x the
+        # pages at fixed per-chip HBM with zero per-step resharding.
+        self.mesh = mesh
+        self.mesh_devices = (int(mesh.devices.size)
+                             if mesh is not None else 1)
+        self._cache_shardings = None
         # Multi-LoRA serving (inference/adapters.py): each slot may
         # carry an adapter id into the shared dispatch; the model
         # gathers per-slot A/B factors from the store's stacked
@@ -383,6 +395,16 @@ class ContinuousBatchingEngine:
             self.pages_per_seq = -(
                 -(max_total_len + self._write_lookahead)
                 // self.page_size)
+        # Ways the KV-heads axis actually shards (1 = replicated
+        # pool — single device, or the GQA remainder rule fired).
+        # Surfaced in /stats `page_pool.shard_ways` so operators can
+        # see whether the mesh is buying pool capacity.
+        self.kv_shard_ways = 1
+        if mesh is not None:
+            from skypilot_tpu.parallel import serving as _tp_serving
+            self.kv_shard_ways = _tp_serving.kv_shard_ways(
+                int(getattr(model.config, 'num_kv_heads', 0) or 0),
+                int(mesh.shape.get('tensor', 1)))
         self.prefix_caching = bool(prefix_caching and self.paged)
         self.prefix_cache: Optional[PrefixCache] = None  # set per reset
         # Tiered prefix cache: evicted pages spill to a bounded
@@ -553,7 +575,35 @@ class ContinuousBatchingEngine:
             positions=jnp.zeros((self.num_slots, 1), jnp.int32),
             decode=True, **kwargs)['cache']
         # init *ran* a step; zero it (same contract as generate.py).
-        return jax.tree.map(jnp.zeros_like, nn.meta.unbox(cache))
+        cache = jax.tree.map(jnp.zeros_like, nn.meta.unbox(cache))
+        if self.mesh is not None:
+            # Explicit placement: the pool starts on its declared
+            # shardings and every dispatch's out_shardings keeps the
+            # donated buffer there — the layout survives resets too.
+            from skypilot_tpu.parallel import serving as _tp_serving
+            if self._cache_shardings is None:
+                self._cache_shardings = \
+                    _tp_serving.serving_cache_shardings(cache,
+                                                        self.mesh)
+            cache = jax.device_put(cache, self._cache_shardings)
+        return cache
+
+    def _pin_cache_out(self, *tail):
+        """jit kwargs pinning a dispatch's donated-cache OUTPUT to
+        the engine's explicit cache shardings (mesh engines; {} on
+        single-device). Inputs arrive committed — the cache via
+        _fresh_cache's device_put, params via
+        shard_params_for_serving — so in_shardings are inferred from
+        the operands; pinning the output closes the loop: the
+        donated pool keeps its layout step over step and GSPMD never
+        inserts a resharding collective on it (asserted by the
+        pool_collective_lines guard test). `tail` holds one None per
+        non-cache output — unconstrained, XLA places them."""
+        if self._cache_shardings is None:
+            return {}
+        if tail:
+            return {'out_shardings': (self._cache_shardings, *tail)}
+        return {'out_shardings': self._cache_shardings}
 
     # -- jitted device fns --------------------------------------------------
     def _make_decode_fn(self):
@@ -564,7 +614,8 @@ class ContinuousBatchingEngine:
         # full KV cache every token (no-op on CPU, vital on TPU).
         paged = self.paged
 
-        @functools.partial(jax.jit, donate_argnums=(1,))
+        @functools.partial(jax.jit, donate_argnums=(1,),
+                           **self._pin_cache_out(None))
         def decode(params, cache, cur_token, pos, temps, top_ks,
                    top_ps, rng, page_indices=None, lora=None,
                    adapter_ids=None):
@@ -595,7 +646,8 @@ class ContinuousBatchingEngine:
         paged = self.paged
         n = self.decode_chunk
 
-        @functools.partial(jax.jit, donate_argnums=(1,))
+        @functools.partial(jax.jit, donate_argnums=(1,),
+                           **self._pin_cache_out(None, None))
         def chunk_decode(params, cache, cur_token, pos, temps, top_ks,
                          top_ps, rng, page_indices=None, lora=None,
                          adapter_ids=None):
@@ -639,7 +691,8 @@ class ContinuousBatchingEngine:
         paged = self.paged
         k = self.spec_k
 
-        @functools.partial(jax.jit, donate_argnums=(1,))
+        @functools.partial(jax.jit, donate_argnums=(1,),
+                           **self._pin_cache_out(None))
         def spec_decode(params, cache, chunk, pos, temps, top_ks,
                         top_ps, rng, page_indices=None, lora=None,
                         adapter_ids=None):
@@ -710,7 +763,8 @@ class ContinuousBatchingEngine:
         positions = jnp.arange(bucket_len, dtype=jnp.int32)[None, :]
         if self.paged:
 
-            @functools.partial(jax.jit, donate_argnums=(1,))
+            @functools.partial(jax.jit, donate_argnums=(1,),
+                               **self._pin_cache_out(None))
             def prefill_paged(params, cache, prompt, plen, page_row,
                               lora=None, adapter_ids=None):
                 # CHUNKED prefill: the whole (padded) prompt in ONE
@@ -736,7 +790,8 @@ class ContinuousBatchingEngine:
             self._prefill_fns[bucket_len] = prefill_paged
             return prefill_paged
 
-        @functools.partial(jax.jit, donate_argnums=(1,))
+        @functools.partial(jax.jit, donate_argnums=(1,),
+                           **self._pin_cache_out(None))
         def prefill(params, cache, slot, prompt, plen, lora=None,
                     adapter_ids=None):
             extra = ({'lora': lora, 'adapter_ids': adapter_ids}
@@ -782,7 +837,8 @@ class ContinuousBatchingEngine:
             return self._prefill_fns[key]
         model = self.model
 
-        @functools.partial(jax.jit, donate_argnums=(1,))
+        @functools.partial(jax.jit, donate_argnums=(1,),
+                           **self._pin_cache_out(None))
         def prefill_suffix(params, cache, suffix, suffix_len, offset,
                            page_row, lora=None, adapter_ids=None):
             extra = ({'lora': lora, 'adapter_ids': adapter_ids}
@@ -818,7 +874,8 @@ class ContinuousBatchingEngine:
             return self._prefill_fns[key]
         model = self.model
 
-        @functools.partial(jax.jit, donate_argnums=(1,))
+        @functools.partial(jax.jit, donate_argnums=(1,),
+                           **self._pin_cache_out(None))
         def dense_suffix(params, cache, slot, suffix, suffix_len,
                          offset, lora=None, adapter_ids=None):
             extra = ({'lora': lora, 'adapter_ids': adapter_ids}
@@ -984,6 +1041,24 @@ class ContinuousBatchingEngine:
             leaf.size * jnp.dtype(leaf.dtype).itemsize
             for leaf in jax.tree_util.tree_leaves(self.cache)))
 
+    def kv_cache_bytes_per_device(self) -> int:
+        """Bytes of the KV cache resident on ONE device: sharded pool
+        values count a single shard, replicated leaves (scale pages,
+        bookkeeping) count in full. Equals kv_cache_bytes() on a
+        single device; ~1/mesh_devices of it when the kv-heads axis
+        shards — the per-chip HBM figure --kv-pool-bytes budgets
+        (skypilot_serving_kv_pool_bytes_per_device)."""
+        total = 0
+        for leaf in jax.tree_util.tree_leaves(self.cache):
+            sharding = getattr(leaf, 'sharding', None)
+            shape = (sharding.shard_shape(leaf.shape)
+                     if sharding is not None else leaf.shape)
+            n = 1
+            for d in shape:
+                n *= int(d)
+            total += n * jnp.dtype(leaf.dtype).itemsize
+        return int(total)
+
     def update_metric_gauges(self) -> None:
         """Refresh the snapshot-style Prometheus gauges from live
         engine state. Called by the scrape handlers (/metrics and
@@ -995,6 +1070,8 @@ class ContinuousBatchingEngine:
         self.metrics.num_slots.set(self.num_slots)
         self.metrics.prefill_backlog.set(self.prefill_backlog_tokens())
         self.metrics.kv_pool_bytes.set(self.kv_cache_bytes())
+        self.metrics.kv_pool_bytes_per_device.set(
+            self.kv_cache_bytes_per_device())
         if self.paged:
             free = int(self.allocator.free_pages)
             self.metrics.pages_free.set(free)
@@ -1047,7 +1124,11 @@ class ContinuousBatchingEngine:
         {cache-leaf path: page-major host array} — the export side of
         handoff and spill. int8 pools gather int8 payload AND the f32
         scale rows; no dequantization anywhere (bit-identical round
-        trip). Scheduler thread only."""
+        trip). Sharded pools gather per shard — the eager row gather
+        runs on each device's own heads slice and the device_get
+        assembles GLOBAL rows (the one place the export path pays a
+        cross-device fetch; the decode path never does). Scheduler
+        thread only."""
         from skypilot_tpu.ops import paged_attention as paged_ops
         idx = jnp.asarray(pages, jnp.int32)
         flat, _ = jax.tree_util.tree_flatten_with_path(self.cache)
@@ -1061,7 +1142,8 @@ class ContinuousBatchingEngine:
         if m not in self._scatter_fns:
             from skypilot_tpu.ops import paged_attention as paged_ops
 
-            @functools.partial(jax.jit, donate_argnums=(0,))
+            @functools.partial(jax.jit, donate_argnums=(0,),
+                               **self._pin_cache_out())
             def scatter(cache, idx, rows):
                 return jax.tree.map(
                     lambda a, r: paged_ops.scatter_page_rows(a, idx,
@@ -1147,9 +1229,19 @@ class ContinuousBatchingEngine:
                 blobs = self._gather_page_blobs(pages)
             finally:
                 cache.release(pages)
+            # kv-head geometry rides the header (PR 15): blobs hold
+            # GLOBAL page rows — _gather_page_blobs's device_get
+            # assembles the shards — so a pool sharded a DIFFERENT
+            # number of ways (or not at all) can validate and
+            # rescatter them; the importing engine's own
+            # out_shardings re-split the heads axis on its mesh.
+            cfg = self.model.config
             meta = {'kind': 'kv_chain',
                     'kv_dtype': self.kv_dtype,
                     'page_size': self.page_size,
+                    'num_kv_heads': int(getattr(cfg, 'num_kv_heads',
+                                                0) or 0),
+                    'head_dim': int(getattr(cfg, 'head_dim', 0) or 0),
                     'keys': [k.hex() for k in keys[:len(pages)]],
                     'salt': salt.hex()}
             return kv_transfer.pack_pages(blobs, meta)
@@ -1185,6 +1277,23 @@ class ContinuousBatchingEngine:
                     f'page_size mismatch: chain is '
                     f'{meta.get("page_size")}, pool is '
                     f'{self.page_size}')
+            # kv-head geometry (headers from PR-13 exporters lack it;
+            # leaf-shape validation in _scatter_page_blobs still
+            # catches those mismatches). Mesh SIZE is deliberately
+            # not compared: chains carry global rows, so a tensor-2
+            # export imports into a tensor-1 pool and back.
+            cfg = self.model.config
+            for field, want in (
+                    ('num_kv_heads',
+                     int(getattr(cfg, 'num_kv_heads', 0) or 0)),
+                    ('head_dim',
+                     int(getattr(cfg, 'head_dim', 0) or 0))):
+                got = meta.get(field)
+                if got is not None and int(got) and want and \
+                        int(got) != want:
+                    raise ValueError(
+                        f'{field} mismatch: chain is {got}, pool '
+                        f'is {want}')
             keys = [bytes.fromhex(k) for k in meta.get('keys', [])]
             if len(keys) != int(meta.get('n_pages', -1)):
                 raise ValueError('chain key count != page count')

@@ -82,6 +82,13 @@ SPECS: Dict[str, Tuple] = {
                  'int8/bf16 pages + scale arrays; dense: per-slot '
                  'rows) — the quantized-serving memory denominator',
         ('engine',)),
+    'skypilot_serving_kv_pool_bytes_per_device': (
+        'gauge', 'KV cache bytes resident on ONE device: sharded '
+                 'pool values count a single kv-heads shard, '
+                 'replicated leaves in full — the per-chip HBM '
+                 'figure --kv-pool-bytes budgets under --tensor '
+                 '(equals kv_pool_bytes on a single device)',
+        ('engine',)),
     'skypilot_serving_weight_bytes': (
         'gauge', 'Device bytes of the served model weights '
                  '(quantized projections count their int8 + scale '
@@ -377,6 +384,8 @@ class EngineMetrics:
                 **lab)
         self.kv_pool_bytes = gauge(
             'skypilot_serving_kv_pool_bytes').labels(**lab)
+        self.kv_pool_bytes_per_device = gauge(
+            'skypilot_serving_kv_pool_bytes_per_device').labels(**lab)
         self.pages_free = gauge(
             'skypilot_serving_pages_free').labels(**lab)
         self.pages_used = gauge(

@@ -23,6 +23,16 @@ Page allocation is host-side (`PageAllocator`): XLA needs static
 shapes, so the device arrays are fixed-size and the allocator only
 decides which physical pages a sequence uses.
 
+TENSOR-PARALLEL POOLS (parallel/serving.py, PR 15): under a mesh the
+pool's LEADING kv-heads axis is sharded over `tensor`, so each chip
+holds a head-slice of every page. These ops are sharding-transparent
+— the page gather indexes the pages axis (axis 1) and every
+per-token compute is elementwise over heads — so GSPMD partitions
+them without inserting pool-shaped collectives (asserted by the
+pool_collective_lines guard). Page ids, lengths, and page tables are
+replicated host-side values; the scale arrays (below) have no heads
+axis and replicate.
+
 INT8 KV PAGES (kv_dtype='int8' on the model config): the page pool
 stores int8 with one f32 scale per page SLOT (i.e. per cached token,
 shared across KV heads) living in a parallel scale-page array

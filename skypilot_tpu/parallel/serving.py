@@ -16,16 +16,21 @@ hints, placement comes from the params).
     params = shard_params_for_serving(model, params, mesh)
     engine = ContinuousBatchingEngine(model, params, ...)
 
-The KV cache is created eagerly by the engine (small next to the
-params) and adopts a propagated sharding after the first jitted step.
+The KV cache is placed EXPLICITLY (PR 15): `serving_cache_shardings`
+pins the paged pool's kv-heads axis over `tensor` (GQA remainder
+rule: shard only when the head count divides evenly, else replicate)
+and the engine declares those shardings on every jitted dispatch's
+donated cache output — zero per-step resharding of the pool, which
+`pool_collective_lines` lets tests assert from the compiled HLO.
 """
 from __future__ import annotations
 
-from typing import Any
+import re
+from typing import Any, Dict, List
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from skypilot_tpu.parallel import mesh as mesh_lib
 
@@ -63,3 +68,111 @@ def shard_params_for_serving(model, params: Any, mesh: Mesh,
         return jax.device_put(w, s)
 
     return jax.tree.map(_place, params, shardings)
+
+
+# -- KV cache placement (PR 15) ---------------------------------------------
+#: Cache-collection leaf names with a kv-heads axis. Paged pool
+#: values are [num_kv_heads, total_pages, page_size, head_dim]
+#: (ops/paged_attention.py); dense per-slot rows are
+#: [slots, max_seq, num_kv_heads, head_dim] (models/llama.py).
+_PAGED_VALUE_LEAVES = ('k_pages', 'v_pages')
+#: Parallel int8 scale pages [total_pages, page_size]: ONE f32 scale
+#: per token slot, shared by every kv head — always replicated (a
+#: head-sharded device still needs the whole scale row to
+#: quantize/dequantize its own heads).
+_PAGED_SCALE_LEAVES = ('k_scales', 'v_scales')
+_DENSE_LEAVES = ('cached_key', 'cached_value')
+
+
+def kv_shard_ways(num_kv_heads: int, tensor_size: int) -> int:
+    """How many ways the KV-heads axis shards over a `tensor` axis of
+    `tensor_size` devices. The GQA remainder rule: a NamedSharding
+    splits an axis all-or-nothing, so the pool shards as far as heads
+    allow — `tensor_size` ways when the head count divides evenly,
+    else it REPLICATES (e.g. 8 kv heads over tensor=2 shard 2-way;
+    2 kv heads over tensor=4 replicate; attention q-heads still shard
+    because the weights do — only the KV pool pays the remainder)."""
+    if tensor_size > 1 and num_kv_heads > 0 and \
+            num_kv_heads % tensor_size == 0:
+        return int(tensor_size)
+    return 1
+
+
+def _leaf_name(path) -> str:
+    for entry in reversed(path):
+        key = getattr(entry, 'key', None)
+        if isinstance(key, str):
+            return key
+    return ''
+
+
+def serving_cache_shardings(cache: Any, mesh: Mesh) -> Any:
+    """NamedShardings for an engine's cache collection: paged pool
+    values shard their kv-heads axis (axis 0) over `tensor`, dense
+    rows shard theirs (axis 2), scale pages and every other leaf
+    (MLA latents, bookkeeping scalars) replicate. The engine pins
+    these on the donated cache of every jitted dispatch, so an
+    N-chip mesh stores 1/N of each value page per chip and never
+    reshards the pool between steps."""
+    tensor = int(mesh.shape.get('tensor', 1))
+    replicated = NamedSharding(mesh, PartitionSpec())
+
+    def spec_for(path, leaf):
+        name = _leaf_name(path)
+        if name in _PAGED_VALUE_LEAVES and leaf.ndim == 4 and \
+                kv_shard_ways(leaf.shape[0], tensor) > 1:
+            return NamedSharding(mesh, PartitionSpec('tensor'))
+        if name in _DENSE_LEAVES and leaf.ndim == 4 and \
+                kv_shard_ways(leaf.shape[2], tensor) > 1:
+            return NamedSharding(mesh,
+                                 PartitionSpec(None, None, 'tensor'))
+        return replicated
+
+    return jax.tree_util.tree_map_with_path(spec_for, cache)
+
+
+def pool_collective_lines(compiled: Any, cache: Any,
+                          mesh: Mesh) -> List[str]:
+    """HLO lines of a compiled serving module where a resharding
+    collective (all-gather / all-to-all) touches a POOL-SHAPED
+    operand — the zero-resharding guard for the sharded KV cache.
+
+    `cache` supplies the KV leaves' global shapes; the match set
+    holds their element counts at every way the mesh could split
+    them, so both a gather OF a shard and a gather INTO the full
+    pool trip it. TP's legitimate collectives (the all-reduce after
+    wo/w_down, logit gathers) have activation-sized operands and
+    pass. Returns the offending lines (empty = guard green)."""
+    # Candidate split factors: 1 (full pool), one mesh axis (a
+    # shard — what an all-gather consumes), and products of two
+    # (the chunk an all-to-all splits a shard into).
+    axes = [int(v) for v in mesh.shape.values()]
+    ways = {1}
+    for a in axes + axes:
+        ways |= {w * a for w in ways}
+    kv_names = (_PAGED_VALUE_LEAVES + _PAGED_SCALE_LEAVES +
+                _DENSE_LEAVES)
+    sizes = set()
+    flat, _ = jax.tree_util.tree_flatten_with_path(cache)
+    for path, leaf in flat:
+        if _leaf_name(path) not in kv_names:
+            continue
+        size = int(leaf.size)
+        for w in ways:
+            if size % w == 0:
+                sizes.add(size // w)
+    sizes.discard(0)
+    text = compiled.as_text() if hasattr(compiled, 'as_text') \
+        else str(compiled)
+    hits = []
+    for line in text.splitlines():
+        if 'all-gather' not in line and 'all-to-all' not in line:
+            continue
+        for m in re.finditer(r'\[([0-9,]+)\]', line):
+            n = 1
+            for d in m.group(1).split(','):
+                n *= int(d)
+            if n in sizes:
+                hits.append(line.strip())
+                break
+    return hits

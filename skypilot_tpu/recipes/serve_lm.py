@@ -99,7 +99,11 @@ def main() -> None:
                              '(heads/mlp/vocab over the tensor axis) '
                              'and XLA propagates the sharding through '
                              'every serving fn — models bigger than '
-                             'one chip serve across the slice')
+                             'one chip serve across the slice. The '
+                             'KV page pool shards its kv-heads axis '
+                             'too (when N divides the head count), '
+                             'so N chips hold ~Nx the pages at fixed '
+                             'per-chip --kv-pool-bytes')
     parser.add_argument('--adapter-dir', default=None, metavar='DIR',
                         help='multi-LoRA serving: a local or gs:// '
                              'directory of adapter artifacts '
@@ -139,12 +143,16 @@ def main() -> None:
                              'serving"). Needs --continuous-batching')
     parser.add_argument('--kv-pool-bytes', type=int, default=0,
                         metavar='B',
-                        help='size the KV page pool by DEVICE BYTES '
-                             'instead of the model default page '
-                             'count: kv_total_pages = B // per-page '
-                             'bytes under --kv-dtype, so a bf16 vs '
+                        help='size the KV page pool by PER-CHIP '
+                             'device bytes instead of the model '
+                             'default page count: kv_total_pages = '
+                             'B // per-page-per-chip bytes under '
+                             '--kv-dtype and --tensor, so a bf16 vs '
                              'int8 A/B at the same B spends the same '
-                             'HBM (int8 buys ~2x the pages). 0 = '
+                             'HBM (int8 buys ~2x the pages) and an '
+                             'N-chip mesh with the kv-heads axis '
+                             'sharded holds ~Nx the TOTAL pages at '
+                             'the same per-chip spend. 0 = '
                              'model-default page count')
     parser.add_argument('--weight-dtype', choices=['bf16', 'int8'],
                         default='bf16',

@@ -50,7 +50,8 @@ _COMPILE_FILES = {
     'test_paged_attention.py', 'test_flash_dispatch.py',
     'test_multislice.py', 'test_prefix_caching.py', 'test_pipeline.py',
     'test_pipeline_schedule.py',
-    'test_tp_serving.py', 'test_profile_trace.py', 'test_fused_xent.py',
+    'test_tp_serving.py', 'test_tp_sharded_pool.py',
+    'test_profile_trace.py', 'test_fused_xent.py',
 }
 
 
