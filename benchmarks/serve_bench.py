@@ -64,6 +64,11 @@ def _server_env(args) -> dict:
     multi-device-without-TPUs harness)."""
     env = dict(os.environ)
     env['PYTHONPATH'] = f"{REPO}:{env.get('PYTHONPATH', '')}"
+    if getattr(args, 'paged_impl', None):
+        # The paged-attention impl is resolved at trace time from
+        # this env var (ops/pallas_paged.resolve_impl) — serve_lm
+        # needs no flag of its own.
+        env['SKYPILOT_TPU_PAGED_IMPL'] = args.paged_impl
     if args.tensor > 1:
         flags = env.get('XLA_FLAGS', '')
         if '--xla_force_host_platform_device_count' not in flags:
@@ -553,6 +558,15 @@ def _run_single(args, adapter_dir=None, assignment=None) -> dict:
                 'tokens': [prompts[0]], 'max_new_tokens': 2,
                 'stream': True, 'model': assignment[0]}, timeout=600)
 
+        # Window baseline for the engine's CUMULATIVE counters
+        # (decode_stall_s, prefill_chunks_run, tokens_committed):
+        # deltas over the timed section become honest rates — the
+        # lifetime values fold warm-up compiles into the quotient.
+        try:
+            stats0 = requests.get(f'{url}/stats', timeout=30).json()
+        except requests.RequestException:
+            stats0 = {}
+
         latencies = []
         itl_gaps = []    # inter-token gaps across ALL requests (s)
         shed = [0]       # client-observed 429s (admission control)
@@ -703,6 +717,45 @@ def _run_single(args, adapter_dir=None, assignment=None) -> dict:
                 serving.get('deadline_exceeded'),
             'engine_restarts': stats.get('engine_restarts'),
         }
+        d_tokens = ((stats.get('tokens_committed') or 0) -
+                    (stats0.get('tokens_committed') or 0))
+        if stats.get('engine') == 'continuous':
+            # Window-normalized scheduler health: stall seconds per
+            # wall second / per generated token, and chunked-prefill
+            # cadence — comparable across runs of different lengths.
+            d_stall = ((stats.get('decode_stall_s') or 0.0) -
+                       (stats0.get('decode_stall_s') or 0.0))
+            d_chunks = ((stats.get('prefill_chunks_run') or 0) -
+                        (stats0.get('prefill_chunks_run') or 0))
+            record['decode_stall_s_window'] = round(d_stall, 4)
+            record['decode_stall_s_per_s'] = round(
+                d_stall / elapsed, 5)
+            record['decode_stall_ms_per_token'] = round(
+                1000.0 * d_stall / max(d_tokens, 1), 4)
+            record['prefill_chunks_per_s'] = round(
+                d_chunks / elapsed, 3)
+        bpt = stats.get('attention_bytes_per_token')
+        if bpt:
+            # Roofline scoreboard: achieved per-chip tokens/s against
+            # the analytic HBM bytes/token model the server exports
+            # (ops/pallas_paged.bytes_per_token_model via /stats).
+            # fraction_of_hbm_peak ~= how much of the memory roof the
+            # decode loop actually sustains; on CPU it is a sanity
+            # denominator, on TPU the tuning target.
+            tokens_per_s = d_tokens / elapsed
+            per_chip = tokens_per_s / max(args.tensor, 1)
+            bytes_per_s = per_chip * bpt['total_bytes_per_token']
+            record['roofline'] = {
+                'attention_impl': stats.get('attention_impl'),
+                'bytes_per_token_model': bpt,
+                'tokens_per_s': round(tokens_per_s, 2),
+                'per_chip_tokens_per_s': round(per_chip, 2),
+                'modeled_hbm_bytes_per_s_per_chip': round(
+                    bytes_per_s, 1),
+                'hbm_peak_gbps': args.hbm_peak_gbps,
+                'fraction_of_hbm_peak': round(
+                    bytes_per_s / (args.hbm_peak_gbps * 1e9), 8),
+            }
         if adapter_dir:
             # Per-adapter req/s (client-side) + the registry's own
             # residency/eviction accounting (server-side).
@@ -911,6 +964,135 @@ def run_spill_ab(args) -> dict:
         'restored_pages': ((tier.get('kv_spill') or {})
                            .get('restored_pages')),
         'runs': runs,
+    }
+
+
+def _run_kernel_arm(args, impl, adapter_dir, names) -> dict:
+    """One --kernel-ab arm: boot serve_lm pinned to `impl` (via
+    SKYPILOT_TPU_PAGED_IMPL), run the deterministic greedy workload
+    NON-streamed (exact token rows back), return tokens + the
+    server's resolved impl and bytes/token model."""
+    arm = _with(args, paged_impl=impl)
+    port = _free_port()
+    cmd = _build_server_cmd(arm, adapter_dir) + ['--port', str(port)]
+    server = subprocess.Popen(cmd, env=_server_env(arm),
+                              stdout=subprocess.DEVNULL,
+                              stderr=subprocess.STDOUT)
+    url = f'http://127.0.0.1:{port}'
+    try:
+        deadline = time.time() + 300
+        info = None
+        while time.time() < deadline:
+            try:
+                info = requests.get(url, timeout=2).json()
+                break
+            except requests.RequestException:
+                time.sleep(1)
+                if server.poll() is not None:
+                    raise RuntimeError('serve_lm died')
+        if info is None:
+            raise RuntimeError('serve_lm not ready within 300s')
+        vocab = int(info['vocab_size'])
+        rng = random.Random(0)
+        prompts = [[rng.randrange(1, vocab)
+                    for _ in range(rng.randrange(4, 16))]
+                   for _ in range(args.requests)]
+        # Round-robin over base + every adapter: the fused QKV LoRA
+        # path and the base fast path both sit in the comparison.
+        targets = [None] + list(names)
+        t0 = time.perf_counter()
+        token_rows = []
+        for i, p in enumerate(prompts):
+            body = {'tokens': [p],
+                    'max_new_tokens': args.max_new_tokens}
+            tgt = targets[i % len(targets)]
+            if tgt:
+                body['model'] = tgt
+            resp = requests.post(f'{url}/generate', json=body,
+                                 timeout=600)
+            resp.raise_for_status()
+            token_rows.append(resp.json()['tokens'][0])
+        elapsed = time.perf_counter() - t0
+        stats = requests.get(f'{url}/stats', timeout=30).json()
+        return {
+            'impl_requested': impl,
+            'impl_resolved': stats.get('attention_impl'),
+            'kv_dtype': (stats.get('storage') or {}).get('kv_dtype'),
+            'requests': len(token_rows),
+            'elapsed_s': round(elapsed, 2),
+            'bytes_per_token_model':
+                stats.get('attention_bytes_per_token'),
+            'tokens': token_rows,
+        }
+    finally:
+        server.terminate()
+        try:
+            server.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            server.kill()
+
+
+def run_kernel_ab(args) -> dict:
+    """The fused-kernel A/B (the committed BENCH_kernel record): the
+    IDENTICAL int8-KV + multi-LoRA greedy workload against a server
+    on the fused interpret-mode Pallas path vs the XLA
+    dequantize-and-gather path. The record asserts the acceptance
+    gates itself: byte-identical greedy tokens, strictly fewer
+    modeled HBM bytes/token on the fused path (the dequantized
+    [T,Hq,D] materialization it deletes), and the 3->1 QKV LoRA
+    dispatch fusion."""
+    import hashlib
+    import tempfile
+    from skypilot_tpu.ops import pallas_paged as pp
+
+    adapter_dir = tempfile.mkdtemp(prefix='serve_bench_kernel_')
+    names = _make_adapter_artifacts(args, adapter_dir)
+    arms = {impl: _run_kernel_arm(args, impl, adapter_dir, names)
+            for impl in ('fused_interpret', 'xla')}
+    fused, xla = arms['fused_interpret'], arms['xla']
+
+    identical = fused['tokens'] == xla['tokens']
+    assert identical, (
+        'fused kernel diverged from the XLA reference on greedy '
+        'tokens — the bit-identity acceptance gate failed')
+    fb = fused['bytes_per_token_model']
+    xb = xla['bytes_per_token_model']
+    assert (fb['total_bytes_per_token'] <
+            xb['total_bytes_per_token']), (
+        'fused path must model strictly fewer HBM bytes/token than '
+        'the XLA dequantize route at int8')
+    digest = hashlib.sha256(
+        json.dumps(fused['tokens']).encode()).hexdigest()[:16]
+    for rec in arms.values():
+        rec['tokens_sha256_16'] = digest
+        rec['tokens_sample'] = rec['tokens'][0]
+        del rec['tokens']      # the digest pins identity; keep the
+        #                        committed record readable
+    return {
+        'bench': 'serve_kernel',
+        'engine': args.engine,
+        'model': args.model,
+        'kv_dtype': 'int8',
+        'adapters': args.adapters,
+        'adapter_rank': args.adapter_rank,
+        'requests': args.requests,
+        'max_new_tokens': args.max_new_tokens,
+        'greedy_tokens_bit_identical': identical,
+        'modeled_bytes_per_token': {
+            'fused_interpret': fb['total_bytes_per_token'],
+            'xla': xb['total_bytes_per_token'],
+        },
+        'hbm_bytes_per_token_saved_frac': round(
+            1.0 - fb['total_bytes_per_token'] /
+            xb['total_bytes_per_token'], 4),
+        'dequant_materialize_bytes_deleted':
+            xb['dequant_materialize_bytes'],
+        'qkv_lora_dispatches_per_layer': {
+            'fused_interpret':
+                pp.qkv_lora_dispatches_per_layer('fused_interpret'),
+            'xla': pp.qkv_lora_dispatches_per_layer('xla'),
+        },
+        'runs': arms,
     }
 
 
@@ -1125,6 +1307,28 @@ def main() -> None:
                              'and emit one combined JSON object '
                              '(the committed BENCH_quant record). '
                              'Requires --kv-pool-bytes')
+    parser.add_argument('--paged-impl', default=None,
+                        choices=['auto', 'xla', 'kernel', 'fused',
+                                 'fused_interpret'],
+                        help='pin the server\'s paged-attention '
+                             'implementation (exported as '
+                             'SKYPILOT_TPU_PAGED_IMPL; see '
+                             'ops/pallas_paged.py)')
+    parser.add_argument('--hbm-peak-gbps', type=float, default=2765.0,
+                        metavar='GBPS',
+                        help='per-chip HBM peak bandwidth for the '
+                             'roofline block (default: TPU v5p '
+                             '2765 GB/s; on CPU the fraction is a '
+                             'sanity denominator only)')
+    parser.add_argument('--kernel-ab', action='store_true',
+                        help='run the identical int8-KV + multi-LoRA '
+                             'greedy workload on the fused '
+                             'interpret-mode Pallas path AND the XLA '
+                             'path, assert byte-identical tokens + '
+                             'the modeled HBM and dispatch deltas, '
+                             'and emit one combined JSON object (the '
+                             'committed BENCH_kernel record). '
+                             'Requires --adapters N')
     parser.add_argument('--tensor-ab', action='store_true',
                         help='run --tensor 1 vs --tensor N over the '
                              'identical workload and emit one '
@@ -1182,6 +1386,18 @@ def main() -> None:
                          'spill tier lives in the paged slot '
                          'engine)')
         print(json.dumps(run_spill_ab(args)))
+        return
+
+    if args.kernel_ab:
+        if args.replicas or args.quant_ab or args.tensor_ab:
+            parser.error('--kernel-ab is a single-server mode')
+        if not args.adapters:
+            parser.error('--kernel-ab needs --adapters N (the fused '
+                         'QKV LoRA path must sit in the comparison)')
+        if args.engine != 'continuous':
+            parser.error('--kernel-ab needs --engine continuous')
+        print(json.dumps(run_kernel_ab(
+            _with(args, kv_dtype='int8'))))
         return
 
     if args.quant_ab:

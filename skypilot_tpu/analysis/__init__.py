@@ -6,6 +6,7 @@ Rules:
   SKY003  lock discipline: unlocked mutation of shared instance state
   SKY004  metric-name hygiene: names must come from the catalog
   SKY005  swallowed exceptions in control planes
+  SKY006  pallas_call must be reachable with interpret=True
 
 See docs/internals.md §10 for the rule book and suppression story.
 """

@@ -1,0 +1,58 @@
+"""SKY006: every pallas_call must be reachable in interpret mode.
+
+TPU Pallas kernels only compile on real TPU backends, so the ONLY way
+tier-1 (CPU) tests can pin their numerics is `interpret=True`. A
+`pl.pallas_call(...)` that hard-codes `interpret=False` — or omits the
+kwarg entirely — is a kernel that cannot be A/B-tested off-TPU: its
+first execution ever is in production. The repo contract (see
+ops/pallas_paged.py) is that library kernels thread an `interpret`
+flag from the caller:
+
+    pl.pallas_call(kernel, grid_spec=..., interpret=interpret)(...)
+
+Flagged: a call whose dotted callee ends in `pallas_call` where the
+`interpret` keyword is missing or is the constant `False`. Any other
+value (a plumbed variable, `True`, an expression) passes — the rule
+checks reachability, not which mode a given call site runs in. Test
+files are exempt (a test may legitimately pin compiled-only
+behaviour behind a TPU-gated skip).
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from skypilot_tpu.analysis import core
+
+
+@core.register
+class PallasInterpretChecker(core.Checker):
+    rule = 'SKY006'
+    name = 'pallas-interpret'
+    description = ('pallas_call outside tests must be reachable with '
+                   'interpret=True (kwarg present and not constant '
+                   'False).')
+
+    @classmethod
+    def applies_to(cls, path: str) -> bool:
+        return not (path.startswith('tests/') or '/tests/' in path)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = core.dotted_name(node.func)
+        if name is not None and name.split('.')[-1] == 'pallas_call':
+            kw = next((k for k in node.keywords
+                       if k.arg == 'interpret'), None)
+            has_splat = any(k.arg is None for k in node.keywords)
+            if kw is None and not has_splat:
+                self.add(node,
+                         'pallas_call without an interpret= kwarg: '
+                         'kernel is untestable on CPU; thread an '
+                         'interpret flag through (interpret=interpret)')
+            elif kw is not None and (
+                    isinstance(kw.value, ast.Constant) and
+                    kw.value.value is False):
+                self.add(kw.value,
+                         'pallas_call with hard-coded interpret=False '
+                         'can never run in interpret mode; plumb the '
+                         'flag from the caller instead')
+        self.generic_visit(node)

@@ -439,9 +439,9 @@ def check(targets, fmt, select, baseline_path, no_baseline,
 
     With PATH arguments — or any of --select/--format/--baseline —
     runs the SKY static-analysis suite (async-safety, jit-purity,
-    lock discipline, metric hygiene, exception hygiene; see
-    docs/internals.md) and exits non-zero on any non-baselined
-    finding. With cloud-name arguments (or none), probes cloud
+    lock discipline, metric hygiene, exception hygiene,
+    pallas-interpret reachability; see docs/internals.md) and exits
+    non-zero on any non-baselined finding. With cloud-name arguments (or none), probes cloud
     credentials and caches enabled clouds (the original behavior).
     """
     static_flags = (fmt != 'text' or select or baseline_path or

@@ -40,6 +40,7 @@ from skypilot_tpu.inference.runtime import (InferenceRuntime,
                                             iter_interleaved)
 from skypilot_tpu.observability import REGISTRY
 from skypilot_tpu.observability import catalog as obs_catalog
+from skypilot_tpu.ops import pallas_paged as _pallas_paged
 from skypilot_tpu.robustness import faults
 from skypilot_tpu.robustness.errors import (AdapterLoadError,
                                             AdapterNotFoundError,
@@ -237,6 +238,12 @@ def make_server(rt: InferenceRuntime,
                         # "Sharded serving"): devices the engines'
                         # state spans (1 = single device).
                         'mesh_devices': rt.mesh_devices,
+                        # Fused kernel path (docs/guides.md "Fused
+                        # kernel path & roofline"): why the COMPILED
+                        # pallas route is unavailable here, or null
+                        # when it can run (interpret mode always can).
+                        'attention_kernel_unavailable_reason':
+                            _pallas_paged.unavailable_reason(),
                     }}
             if rt.role or rt.handoffs_total or rt.kv_imports_total:
                 body['handoff'] = rt.handoff_stats()
@@ -268,6 +275,12 @@ def make_server(rt: InferenceRuntime,
                 'prefill_backlog_tokens':
                     engine.prefill_backlog_tokens(),
                 'decode_stall_s': round(engine.decode_stall_s, 4),
+                # Fused kernel path + analytic HBM roofline inputs
+                # (ops/pallas_paged.py; serve_bench scores achieved
+                # tokens/s against bytes_per_token * HBM peak).
+                'attention_impl': engine.attention_impl(),
+                'attention_bytes_per_token':
+                    engine.attention_bytes_per_token(),
                 # Robustness plane (docs/guides.md serving-robustness
                 # section): shedding, deadlines, crash containment.
                 'healthy': engine.healthy(),

@@ -432,6 +432,7 @@ class ContinuousBatchingEngine:
         self.engine_id = str(next(self._instance_ids))
         self.metrics = _obs.EngineMetrics(self.engine_id)
         self.metrics.num_slots.set(num_slots)
+        self._weight_bytes: Optional[int] = None  # lazy (roofline)
 
         # _fresh_cache is the single paging-reset point (also the
         # error-recovery path).
@@ -1059,6 +1060,58 @@ class ContinuousBatchingEngine:
             total += n * jnp.dtype(leaf.dtype).itemsize
         return int(total)
 
+    def attention_impl(self) -> str:
+        """Resolved paged-attention implementation this engine's traced
+        forwards dispatch to (ops/pallas_paged.resolve_impl under the
+        current process-wide dispatch state), or 'dense' when the
+        engine runs the dense per-slot cache — no paged kernel in
+        play. Surfaced via the attention_impl_info gauge and /stats."""
+        if not self.paged:
+            return 'dense'
+        from skypilot_tpu.ops import pallas_paged
+        return pallas_paged.resolve_impl(
+            'auto', quantized=self.kv_dtype == 'int8')
+
+    def attention_bytes_per_token(self) -> Dict[str, Any]:
+        """Analytic HBM bytes one decode step moves per generated
+        token at the CURRENT decode batch — the serve_bench roofline
+        denominator (ops/pallas_paged.bytes_per_token_model, fed the
+        engine's real page geometry, dtypes and adapter store). Dense
+        engines model their full-cache walk with no dequant term."""
+        from skypilot_tpu.ops import pallas_paged
+        cfg = self.model.config
+        if self._weight_bytes is None:
+            from skypilot_tpu.inference import quant as quant_lib
+            self._weight_bytes = quant_lib.weight_num_bytes(self.params)
+        lora_bytes = 0
+        if self.adapter_store is not None:
+            rank = int(getattr(self.adapter_store, '_rank', 0) or 0)
+            targets = tuple(
+                getattr(self.adapter_store, '_targets', ()) or ())
+            if rank > 0 and targets:
+                lora_bytes = lora_lib.adapter_num_bytes(cfg, rank,
+                                                        targets)
+        quantized = self.paged and self.kv_dtype == 'int8'
+        elem = (1 if quantized else
+                jnp.dtype(getattr(cfg, 'dtype', jnp.bfloat16)).itemsize)
+        if self.paged:
+            page_size, pages_per_seq = self.page_size, self.pages_per_seq
+        else:
+            page_size, pages_per_seq = 1, self.max_total_len
+        return pallas_paged.bytes_per_token_model(
+            num_layers=cfg.num_layers,
+            num_kv_heads=getattr(cfg, 'num_kv_heads', cfg.num_heads),
+            num_q_heads=cfg.num_heads,
+            head_dim=cfg.head_dim,
+            page_size=page_size,
+            pages_per_seq=pages_per_seq,
+            kv_elem_bytes=elem,
+            quantized=quantized,
+            impl=self.attention_impl(),
+            weight_bytes=self._weight_bytes,
+            batch=max(int(self.active.sum()), 1),
+            lora_bytes_per_row=lora_bytes)
+
     def update_metric_gauges(self) -> None:
         """Refresh the snapshot-style Prometheus gauges from live
         engine state. Called by the scrape handlers (/metrics and
@@ -1079,6 +1132,10 @@ class ContinuousBatchingEngine:
         if self.kv_restore_lookups:
             self.metrics.kv_restore_hit_ratio.set(
                 self.kv_restore_hits / self.kv_restore_lookups)
+        self.metrics.set_attention_info(self.attention_impl(),
+                                        self.kv_dtype)
+        self.metrics.attention_bytes_per_token.set(
+            self.attention_bytes_per_token()['total_bytes_per_token'])
 
     # -- KV page transfer + tiered cache ------------------------------------
     def run_on_scheduler(self, fn, timeout: float = 120.0):

@@ -179,15 +179,40 @@ class Attention(nn.Module):
             return lora_lib.apply_delta(y, inp, lora[name],
                                         adapter_ids, lora_scale)
 
-        q = _lora('wq', _proj(cfg.num_heads * hd, ('embed', 'heads'),
-                              cfg.dtype, 'wq', cfg.qkv_bias)(x),
-                  x).reshape(batch, seq, cfg.num_heads, hd)
-        k = _lora('wk', _proj(cfg.num_kv_heads * hd, ('embed', 'heads'),
-                              cfg.dtype, 'wk', cfg.qkv_bias)(x),
-                  x).reshape(batch, seq, cfg.num_kv_heads, hd)
-        v = _lora('wv', _proj(cfg.num_kv_heads * hd, ('embed', 'heads'),
-                              cfg.dtype, 'wv', cfg.qkv_bias)(x),
-                  x).reshape(batch, seq, cfg.num_kv_heads, hd)
+        q = _proj(cfg.num_heads * hd, ('embed', 'heads'),
+                  cfg.dtype, 'wq', cfg.qkv_bias)(x)
+        k = _proj(cfg.num_kv_heads * hd, ('embed', 'heads'),
+                  cfg.dtype, 'wk', cfg.qkv_bias)(x)
+        v = _proj(cfg.num_kv_heads * hd, ('embed', 'heads'),
+                  cfg.dtype, 'wv', cfg.qkv_bias)(x)
+        # Multi-tenant QKV LoRA: when the fused kernel path is active
+        # (ops/pallas_paged.py dispatch state, resolved at trace time)
+        # and all three projections carry stacked per-slot factors, the
+        # three gather+matmul chains collapse into ONE pallas dispatch.
+        # The caller-side scale/cast below matches lora.apply_delta
+        # numerics exactly; wq/wk/wv fall back to per-projection
+        # apply_delta otherwise (training, single-adapter, XLA impl).
+        fused_lora = None
+        if (lora is not None and adapter_ids is not None
+                and all(t in lora for t in ('wq', 'wk', 'wv'))):
+            from skypilot_tpu.ops import pallas_paged
+            fused_lora = pallas_paged.lora_fusion_impl(
+                cfg.kv_dtype == 'int8')
+        if fused_lora is not None:
+            from skypilot_tpu.ops import pallas_paged
+            dq, dk, dv = pallas_paged.fused_qkv_lora_delta(
+                x, lora['wq'], lora['wk'], lora['wv'], adapter_ids,
+                interpret=fused_lora == 'fused_interpret')
+            q = q + (lora_scale * dq).astype(q.dtype)
+            k = k + (lora_scale * dk).astype(k.dtype)
+            v = v + (lora_scale * dv).astype(v.dtype)
+        else:
+            q = _lora('wq', q, x)
+            k = _lora('wk', k, x)
+            v = _lora('wv', v, x)
+        q = q.reshape(batch, seq, cfg.num_heads, hd)
+        k = k.reshape(batch, seq, cfg.num_kv_heads, hd)
+        v = v.reshape(batch, seq, cfg.num_kv_heads, hd)
         q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
         k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
 

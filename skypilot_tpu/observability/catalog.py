@@ -97,6 +97,21 @@ SPECS: Dict[str, Tuple] = {
         'gauge', 'Serving storage formats in effect (always 1; read '
                  'the kv_dtype/weight_dtype labels)',
         ('kv_dtype', 'weight_dtype')),
+    'skypilot_serving_attention_impl_info': (
+        'gauge', 'Resolved paged-attention implementation in effect '
+                 '(always 1; read the labels — impl is xla | kernel | '
+                 'fused | fused_interpret, or dense when the engine '
+                 'runs the dense KV cache; ops/pallas_paged.py '
+                 'dispatch rules)',
+        ('engine', 'impl', 'kv_dtype')),
+    'skypilot_serving_attention_bytes_per_token': (
+        'gauge', 'Modeled HBM bytes one decode step moves per '
+                 'generated token at the current decode batch: pool '
+                 'reads + scale rows + the XLA route\'s dequantize '
+                 'materialization + amortized weight reads + LoRA '
+                 'factor rows (ops/pallas_paged.bytes_per_token_model '
+                 '— the serve_bench roofline denominator)',
+        ('engine',)),
     'skypilot_serving_pages_free': (
         'gauge', 'Free pages in the shared KV page pool', ('engine',)),
     'skypilot_serving_pages_used': (
@@ -354,6 +369,7 @@ class EngineMetrics:
 
     def __init__(self, engine_label: str) -> None:
         lab = {'engine': engine_label}
+        self._engine_label = engine_label
         self.queue_depth = gauge(
             'skypilot_serving_queue_depth').labels(**lab)
         self.active_slots = gauge(
@@ -405,6 +421,16 @@ class EngineMetrics:
             'skypilot_serving_kv_restore_pages_total').labels(**lab)
         self.kv_restore_hit_ratio = gauge(
             'skypilot_serving_kv_restore_hit_ratio').labels(**lab)
+        self.attention_bytes_per_token = gauge(
+            'skypilot_serving_attention_bytes_per_token').labels(**lab)
+
+    def set_attention_info(self, impl: str, kv_dtype: str) -> None:
+        """Info-style gauge (always 1): the resolved paged-attention
+        impl and KV storage dtype ride the labels, so a dashboard can
+        tell WHICH kernel path an engine is on without parsing logs."""
+        gauge('skypilot_serving_attention_impl_info').labels(
+            engine=self._engine_label, impl=impl,
+            kv_dtype=kv_dtype).set(1)
 
 
 class RequestMetrics:

@@ -50,23 +50,19 @@ token's already-quantized values.
 """
 from __future__ import annotations
 
-import functools
 from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 
-@functools.lru_cache(maxsize=1)
 def _pallas_paged_available() -> bool:
-    if jax.default_backend() != 'tpu':
-        return False
-    try:
-        from jax.experimental.pallas.ops.tpu.paged_attention import (  # noqa: F401
-            paged_attention)
-        return True
-    except ImportError:
-        return False
+    """Upstream bf16 pallas kernel usable here. Probe result (and the
+    failure REASON, for /stats and skip messages) is cached at module
+    level in ops/pallas_paged.py — see `pallas_paged.available()` /
+    `unavailable_reason()` for the in-repo fused kernel's probe."""
+    from skypilot_tpu.ops import pallas_paged
+    return pallas_paged.upstream_available()
 
 
 def quantize_kv_rows(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
@@ -101,15 +97,25 @@ def paged_decode_attention(q: jax.Array, k_pages: jax.Array,
 
     Returns [B, num_q_heads, head_dim] (q.dtype). GQA: num_q_heads may
     be a multiple of num_kv_heads. `k_scales`/`v_scales`
-    (f32[total_pages, page_size]) mark int8 pages: the gather
-    dequantizes before any matmul (the pallas kernel path is bf16-only,
-    so quantized pools take the XLA reference path).
+    (f32[total_pages, page_size]) mark int8 pages.
+
+    `impl` resolves through `pallas_paged.resolve_impl` (overridable
+    process-wide via $SKYPILOT_TPU_PAGED_IMPL / `impl_scope`):
+    'kernel' is the upstream bf16 pallas kernel, 'fused' /
+    'fused_interpret' the in-repo kernel that dequantizes int8 pages
+    in-register (ops/pallas_paged.py), 'xla' the gather reference —
+    which dequantizes in HBM, the traffic the fused path deletes.
     """
     assert q.ndim == 3 and k_pages.ndim == 4, (q.shape, k_pages.shape)
-    use_kernel = (k_scales is None and
-                  (impl == 'kernel' or
-                   (impl == 'auto' and _pallas_paged_available())))
-    if use_kernel:
+    from skypilot_tpu.ops import pallas_paged
+    impl = pallas_paged.resolve_impl(impl, quantized=k_scales is not None)
+    if impl in ('fused', 'fused_interpret'):
+        out = pallas_paged.fused_paged_attention(
+            q[:, None], k_pages, v_pages, (lengths - 1)[:, None],
+            page_indices, k_scales=k_scales, v_scales=v_scales,
+            interpret=impl == 'fused_interpret')
+        return out[:, 0]
+    if impl == 'kernel':
         from jax.experimental.pallas.ops.tpu.paged_attention import (
             paged_attention)
         pages_per_seq = page_indices.shape[1]
@@ -375,20 +381,30 @@ def paged_chunk_attention(q: jax.Array, k_pages: jax.Array,
                           v_pages: jax.Array, positions: jax.Array,
                           page_indices: jax.Array,
                           k_scales: Optional[jax.Array] = None,
-                          v_scales: Optional[jax.Array] = None
-                          ) -> jax.Array:
+                          v_scales: Optional[jax.Array] = None,
+                          impl: str = 'auto') -> jax.Array:
     """S queries per row over the row's FULL paged history.
 
     The paged analog of ops.attention.chunked_cache_attention's read
     side: query s of row b attends every cache index <= positions[b, s]
     — what speculative-decoding verification chunks need (the chunk's
     K/V must already be written via `write_kv_chunk`). Chunk sizes are
-    small (draft_k + 1), so the gather-based XLA path is the right
-    shape everywhere; the pallas decode kernel stays the S=1 fast path.
+    small (draft_k + 1), so the gather-based XLA path is a fine shape;
+    the fused kernel (ops/pallas_paged.py) handles S>1 blocks natively
+    and takes over when `impl` resolves to it — on int8 pools that
+    again skips the HBM dequantize-materialize step.
 
     q: [B, S, num_q_heads, head_dim]; positions: i32[B, S].
     Returns [B, S, num_q_heads, head_dim] (q.dtype).
     """
+    from skypilot_tpu.ops import pallas_paged
+    resolved = pallas_paged.resolve_impl(impl,
+                                         quantized=k_scales is not None)
+    if resolved in ('fused', 'fused_interpret'):
+        return pallas_paged.fused_paged_attention(
+            q, k_pages, v_pages, positions, page_indices,
+            k_scales=k_scales, v_scales=v_scales,
+            interpret=resolved == 'fused_interpret')
     head_dim = k_pages.shape[-1]
     max_len = page_indices.shape[1] * k_pages.shape[2]
     k_all, v_all = _gather_kv(q.shape[2], k_pages, v_pages,

@@ -1,0 +1,498 @@
+"""In-repo fused Pallas paged-attention + LoRA kernels (the int8 fast
+path) and the impl-dispatch plumbing that selects between them.
+
+WHY. Decode is memory-bound: per-chip tokens/s is HBM bytes/token or
+nothing. The upstream pallas paged-attention kernel
+(jax.experimental.pallas.ops.tpu.paged_attention) is bf16-only, so the
+int8 KV pool — the config that doubled pool capacity — used to fall
+back to the XLA gather route, which DEQUANTIZES IN HBM: it
+materializes f32 copies of every gathered page (then GQA-expands
+them) each step. Per-slot LoRA likewise paid one batched
+gather+matmul chain per projection. The two kernels here close both
+gaps:
+
+  fused_paged_attention   reads int8 k/v pages plus their parallel
+                          f32 scale rows straight from the pool and
+                          dequantizes IN-REGISTER inside the kernel
+                          body — HBM sees only the int8 bytes and the
+                          scales, never a dequantized page. One grid
+                          (batch, kv_heads, pages_per_seq) walks each
+                          row's page table via scalar prefetch; online
+                          softmax accumulates across the page walk in
+                          VMEM scratch. Handles bf16 pools too, and
+                          both block shapes the engine issues: S=1
+                          decode and S>1 chunked prefill / speculative
+                          verification chunks (`positions[b, s]` is the
+                          per-query causal bound, exactly the XLA
+                          reference's mask).
+  fused_qkv_lora_delta    ONE pallas dispatch for the wq/wk/wv LoRA
+                          deltas of a multi-tenant batch: adapter ids
+                          ride scalar prefetch, each row's a/b factors
+                          are gathered by BlockSpec index_maps, and the
+                          three (x @ a) @ b chains run in one kernel
+                          body instead of three separate gather+matmul
+                          dispatches per layer.
+
+DISPATCH. `resolve_impl(impl, quantized=...)` maps a requested impl to
+the concrete route; 'auto' consults, in order: an explicit
+`set_default_impl()` / `impl_scope()` override, the
+SKYPILOT_TPU_PAGED_IMPL environment variable, then backend defaults
+(TPU quantized -> 'fused'; TPU bf16 -> upstream 'kernel'; anything
+else -> 'xla'). Unavailable routes degrade silently to 'xla' — the
+reference path is always correct, just slower. `unavailable_reason()`
+records WHY the compiled kernel path is off (mirroring
+data/token_loader.native_unavailable_reason) so /stats and test skip
+messages can say so.
+
+INTERPRET-MODE CONTRACT. Every pallas_call here takes
+`interpret=<kwarg>` (enforced repo-wide by `stpu check` rule SKY006),
+so the kernels run on CPU under `impl='fused_interpret'` —
+bit-tolerance pinned against the XLA reference in
+tests/unit_tests/test_pallas_paged.py, with a deliberately perturbed
+kernel (the `perturb` hook below) proving the pins are non-vacuous.
+
+SHARDING. Under an active `with mesh:` context the attention wrapper
+shard_maps over the PR 15 pool layout: kv-heads (and the grouped q
+heads) ride `tensor` when divisible, everything else replicates; the
+GQA-remainder rule (kv-heads not divisible by tensor -> replicated
+pool) falls out as the unsharded call. Without a mesh context (the
+GSPMD-propagation serving path) the call runs as a single program —
+correct everywhere, though GSPMD treats it as an opaque replicated
+region, so sharded-pool TPU deployments should enter the mesh context
+before forcing 'fused'.
+
+ROOFLINE. `bytes_per_token_model()` is the analytic HBM-traffic model
+(pool reads + scale rows + XLA dequant materialization + amortized
+weight reads + LoRA factor rows) that benchmarks/serve_bench.py emits
+next to achieved tokens/s, scoring runs as a fraction of the modeled
+HBM limit rather than vs yesterday's number.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+import os
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+ENV_VAR = 'SKYPILOT_TPU_PAGED_IMPL'
+
+#: Accepted impl names: 'auto' resolves per backend/config; 'xla' is
+#: the gather reference; 'kernel' the upstream bf16 pallas kernel;
+#: 'fused' this module's compiled kernels; 'fused_interpret' the same
+#: kernels in pallas interpret mode (runs anywhere, CPU included).
+IMPLS: Tuple[str, ...] = ('auto', 'xla', 'kernel', 'fused',
+                          'fused_interpret')
+
+# -- availability probes (module-level cache + recorded reason) -------------
+_probed = False
+_import_error: Optional[str] = None
+
+
+def _probe() -> None:
+    global _probed, _import_error
+    if _probed:
+        return
+    _probed = True
+    try:
+        from jax.experimental import pallas  # noqa: F401
+        from jax.experimental.pallas import tpu  # noqa: F401
+    except ImportError as e:  # no pallas in this jax build
+        _import_error = f'pallas import failed: {e}'
+
+
+def pallas_importable() -> bool:
+    """True when the pallas + pallas-TPU modules import here (the
+    floor for `fused_interpret`, which needs no TPU)."""
+    _probe()
+    return _import_error is None
+
+
+def available() -> bool:
+    """True when the COMPILED fused kernel path can run here (pallas
+    imports and the default backend is TPU)."""
+    return pallas_importable() and jax.default_backend() == 'tpu'
+
+
+def unavailable_reason() -> Optional[str]:
+    """None when `available()`; otherwise why the compiled kernel path
+    is off — surfaced in /stats' storage section and test skips."""
+    _probe()
+    if _import_error is not None:
+        return _import_error
+    backend = jax.default_backend()
+    if backend != 'tpu':
+        return (f"backend is {backend!r}: the fused kernel compiles on "
+                f"TPU only (impl='fused_interpret' still runs here)")
+    return None
+
+
+@functools.lru_cache(maxsize=1)
+def upstream_available() -> bool:
+    """Upstream bf16 pallas paged-attention kernel (`impl='kernel'`)."""
+    if jax.default_backend() != 'tpu':
+        return False
+    try:
+        from jax.experimental.pallas.ops.tpu.paged_attention import (  # noqa: F401
+            paged_attention)
+        return True
+    except ImportError:
+        return False
+
+
+# -- impl selection ---------------------------------------------------------
+_default_impl: Optional[str] = None
+
+
+def _validate(impl: str) -> None:
+    if impl not in IMPLS:
+        raise ValueError(
+            f'unknown paged-attention impl {impl!r} (choices: '
+            f'{", ".join(IMPLS)}; also accepted via ${ENV_VAR})')
+
+
+def default_impl() -> str:
+    """The impl 'auto' resolves through: the `set_default_impl()`
+    override, else $SKYPILOT_TPU_PAGED_IMPL, else 'auto' itself."""
+    if _default_impl is not None:
+        return _default_impl
+    env = os.environ.get(ENV_VAR, '').strip()
+    if env:
+        _validate(env)
+        return env
+    return 'auto'
+
+
+def set_default_impl(impl: Optional[str]) -> None:
+    """Process-wide impl override (None clears it). Set BEFORE the
+    first traced forward pass: dispatch resolves at trace time, so a
+    change after jit caches are warm does not retrace."""
+    if impl is not None:
+        _validate(impl)
+    global _default_impl
+    _default_impl = impl
+
+
+@contextlib.contextmanager
+def impl_scope(impl: str):
+    """Scoped `set_default_impl` — the test/bench A/B hook."""
+    prev = _default_impl
+    set_default_impl(impl)
+    try:
+        yield
+    finally:
+        set_default_impl(prev)
+
+
+def resolve_impl(impl: str = 'auto', *, quantized: bool = False) -> str:
+    """Concrete route for a requested impl: one of 'xla' | 'kernel' |
+    'fused' | 'fused_interpret'.
+
+    'auto' prefers the fused kernel for quantized pools on TPU and the
+    upstream kernel for bf16 (matching the pre-fused fast path);
+    unavailable routes degrade to 'xla', and 'kernel' degrades for
+    quantized pools (the upstream kernel is bf16-only)."""
+    _validate(impl)
+    if impl == 'auto':
+        impl = default_impl()
+    if impl == 'auto':
+        if not available():
+            return 'xla'
+        if quantized:
+            return 'fused'
+        return 'kernel' if upstream_available() else 'fused'
+    if impl == 'kernel' and (quantized or not upstream_available()):
+        return 'xla'
+    if impl == 'fused' and not available():
+        return 'xla'
+    if impl == 'fused_interpret' and not pallas_importable():
+        return 'xla'
+    return impl
+
+
+def lora_fusion_impl(quantized: bool = False) -> Optional[str]:
+    """'fused' / 'fused_interpret' when the QKV LoRA fusion should
+    engage under the current dispatch state, else None (models call
+    this at trace time next to the attention dispatch)."""
+    impl = resolve_impl('auto', quantized=quantized)
+    return impl if impl in ('fused', 'fused_interpret') else None
+
+
+# -- fused paged attention --------------------------------------------------
+def _attention_kernel(quantized, sm_scale, page_size, pages_per_seq,
+                      perturb, tbl_ref, pos_ref, q_ref, k_ref, v_ref,
+                      *rest):
+    """Grid (batch, kv_heads, pages_per_seq): one physical page of one
+    kv head per step, online-softmax state in VMEM scratch."""
+    import jax.experimental.pallas as pl
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
+    b = pl.program_id(0)
+    p = pl.program_id(2)
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[...] = jnp.full(m_ref.shape, -jnp.inf, jnp.float32)
+        l_ref[...] = jnp.zeros(l_ref.shape, jnp.float32)
+        acc_ref[...] = jnp.zeros(acc_ref.shape, jnp.float32)
+
+    q = q_ref[0].astype(jnp.float32)            # [S, G, D]
+    k = k_ref[0, 0].astype(jnp.float32)         # [page, D]
+    v = v_ref[0, 0].astype(jnp.float32)
+    if quantized:
+        # In-register dequant: int8 page values * the page's f32
+        # per-slot scale rows. No dequantized page ever exists in HBM.
+        k = k * ks_ref[0][:, None]
+        v = v * vs_ref[0][:, None]
+    s = jnp.einsum('sgd,td->sgt', q, k) * sm_scale
+    if perturb:
+        # Non-vacuity hook: a deliberately wrong kernel for tests to
+        # prove the parity pins actually bite. Scores are SCALED (a
+        # temperature error) — an additive constant would be invisible
+        # under softmax's shift invariance.
+        s = s * (1.0 + perturb)
+    t_idx = (p * page_size +
+             jax.lax.broadcasted_iota(jnp.int32, s.shape, 2))
+    pos = pos_ref[b]                            # [S] causal bounds
+    s = jnp.where(t_idx <= pos[:, None, None], s, -jnp.inf)
+
+    m_prev = m_ref[...]                         # [S, G]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+    # All-masked rows keep m == -inf; shifting by 0 there keeps every
+    # exp() argument finite-or--inf (exp(-inf) == 0, never a nan).
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    alpha = jnp.exp(m_prev - m_safe)
+    w = jnp.exp(s - m_safe[..., None])
+    l_ref[...] = l_ref[...] * alpha + jnp.sum(w, axis=-1)
+    acc_ref[...] = (acc_ref[...] * alpha[..., None] +
+                    jnp.einsum('sgt,td->sgd', w, v))
+    m_ref[...] = m_new
+
+    @pl.when(p == pages_per_seq - 1)
+    def _finish():
+        l = l_ref[...]
+        l = jnp.where(l > 0, l, 1.0)            # fully-masked rows -> 0
+        o_ref[0] = (acc_ref[...] / l[..., None]).astype(o_ref.dtype)
+
+
+def _fused_call(q, k_pages, v_pages, positions, page_indices,
+                k_scales, v_scales, *, interpret, perturb):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    batch, chunk, num_q_heads, head_dim = q.shape
+    num_kv_heads, _, page_size, _ = k_pages.shape
+    pages_per_seq = page_indices.shape[1]
+    group = num_q_heads // num_kv_heads
+    quantized = k_scales is not None
+    sm_scale = 1.0 / (head_dim ** 0.5)
+    kernel = functools.partial(_attention_kernel, quantized, sm_scale,
+                               page_size, pages_per_seq, perturb)
+
+    # Index maps see the scalar-prefetch refs (page table, positions):
+    # the page walk gathers SCATTERED physical pages into VMEM blocks.
+    def q_map(b, h, p, tbl, pos):
+        return (b, 0, h, 0)
+
+    def kv_map(b, h, p, tbl, pos):
+        return (h, tbl[b, p], 0, 0)
+
+    def scale_map(b, h, p, tbl, pos):
+        return (tbl[b, p], 0)
+
+    in_specs = [
+        pl.BlockSpec((1, chunk, group, head_dim), q_map),
+        pl.BlockSpec((1, 1, page_size, head_dim), kv_map),
+        pl.BlockSpec((1, 1, page_size, head_dim), kv_map),
+    ]
+    operands = [q, k_pages, v_pages]
+    if quantized:
+        in_specs += [pl.BlockSpec((1, page_size), scale_map),
+                     pl.BlockSpec((1, page_size), scale_map)]
+        operands += [k_scales, v_scales]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(batch, num_kv_heads, pages_per_seq),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, chunk, group, head_dim), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((chunk, group), jnp.float32),
+            pltpu.VMEM((chunk, group), jnp.float32),
+            pltpu.VMEM((chunk, group, head_dim), jnp.float32),
+        ])
+    return pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(page_indices, positions.astype(jnp.int32), *operands)
+
+
+def fused_paged_attention(q: jax.Array, k_pages: jax.Array,
+                          v_pages: jax.Array, positions: jax.Array,
+                          page_indices: jax.Array, *,
+                          k_scales: Optional[jax.Array] = None,
+                          v_scales: Optional[jax.Array] = None,
+                          interpret: bool = False,
+                          perturb: float = 0.0) -> jax.Array:
+    """Fused paged attention over int8 or bf16 pools.
+
+    q: [B, S, Hq, D]; positions: i32[B, S] — query s of row b attends
+    every cache index <= positions[b, s] (decode is S=1 with
+    positions = lengths - 1; chunks pass their absolute positions).
+    k/v_pages: [Hkv, total_pages, page_size, D]; k/v_scales
+    (f32[total_pages, page_size]) mark an int8 pool and are
+    dequantized in-register. Returns [B, S, Hq, D] in q.dtype,
+    matching `_reference_paged_attention` semantics.
+
+    Under an active mesh context with a divisible kv-heads axis the
+    call shard_maps over `tensor` (pool sharded, tables/scales
+    replicated); otherwise — including the PR 15 GQA-remainder
+    replicated-pool layout — it runs unsharded.
+    """
+    assert q.ndim == 4 and k_pages.ndim == 4, (q.shape, k_pages.shape)
+    num_kv_heads = k_pages.shape[0]
+    assert q.shape[2] % num_kv_heads == 0, (q.shape, k_pages.shape)
+    call = functools.partial(_fused_call, interpret=interpret,
+                             perturb=perturb)
+    from skypilot_tpu.ops.attention import _active_mesh
+    mesh = _active_mesh()
+    tensor = mesh.shape.get('tensor', 1) if mesh is not None else 1
+    if tensor <= 1 or num_kv_heads % tensor != 0:
+        return call(q, k_pages, v_pages, positions, page_indices,
+                    k_scales, v_scales)
+    from jax.sharding import PartitionSpec as P
+    from skypilot_tpu.utils.jax_compat import shard_map
+    qspec = P(None, None, 'tensor', None)       # grouped q heads
+    pool = P('tensor', None, None, None)        # kv-heads axis
+    rep = P(None, None)
+    if k_scales is None:
+        fn = lambda q_, kp, vp, pos, tbl: call(q_, kp, vp, pos, tbl,
+                                               None, None)
+        in_specs = (qspec, pool, pool, rep, rep)
+        args = (q, k_pages, v_pages, positions, page_indices)
+    else:
+        fn = call
+        in_specs = (qspec, pool, pool, rep, rep, rep, rep)
+        args = (q, k_pages, v_pages, positions, page_indices,
+                k_scales, v_scales)
+    return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                     out_specs=qspec, check_vma=False)(*args)
+
+
+# -- fused QKV LoRA ---------------------------------------------------------
+def _qkv_lora_kernel(ids_ref, x_ref, aq_ref, bq_ref, ak_ref, bk_ref,
+                     av_ref, bv_ref, dq_ref, dk_ref, dv_ref):
+    x = x_ref[0].astype(jnp.float32)            # [S, d_model]
+    for a_ref, b_ref, o_ref in ((aq_ref, bq_ref, dq_ref),
+                                (ak_ref, bk_ref, dk_ref),
+                                (av_ref, bv_ref, dv_ref)):
+        h = x @ a_ref[0].astype(jnp.float32)    # [S, r]
+        o_ref[0] = h @ b_ref[0].astype(jnp.float32)
+
+
+def fused_qkv_lora_delta(x: jax.Array, wq_factors: Dict,
+                         wk_factors: Dict, wv_factors: Dict,
+                         adapter_ids: jax.Array, *,
+                         interpret: bool = False
+                         ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """UNSCALED f32 LoRA deltas for wq/wk/wv in ONE pallas dispatch.
+
+    x: [B, S, d_model]; each factors dict holds stacked
+    a [N, d_in, r] / b [N, r, d_out]; adapter_ids i32[B] selects each
+    row's adapter via scalar-prefetch index_maps (no gathered factor
+    copies in HBM). Returns (dq, dk, dv) as f32 [B, S, d_out]; the
+    caller applies `y + (scale * d).astype(y.dtype)` so numerics match
+    `lora.apply_delta` — same (x @ a) @ b contraction order in f32.
+    """
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    batch, chunk, d_model = x.shape
+
+    def x_map(b, ids):
+        return (b, 0, 0)
+
+    def factor_map(b, ids):
+        return (ids[b], 0, 0)
+
+    in_specs = [pl.BlockSpec((1, chunk, d_model), x_map)]
+    operands = [x]
+    out_shapes = []
+    out_specs = []
+    for f in (wq_factors, wk_factors, wv_factors):
+        a, b_fac = f['a'], f['b']
+        _, d_in, rank = a.shape
+        d_out = b_fac.shape[-1]
+        in_specs += [pl.BlockSpec((1, d_in, rank), factor_map),
+                     pl.BlockSpec((1, rank, d_out), factor_map)]
+        operands += [a, b_fac]
+        out_shapes.append(
+            jax.ShapeDtypeStruct((batch, chunk, d_out), jnp.float32))
+        out_specs.append(pl.BlockSpec((1, chunk, d_out), x_map))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1, grid=(batch,),
+        in_specs=in_specs, out_specs=out_specs)
+    return pl.pallas_call(
+        _qkv_lora_kernel, grid_spec=grid_spec, out_shape=out_shapes,
+        interpret=interpret,
+    )(adapter_ids.astype(jnp.int32), *operands)
+
+
+def qkv_lora_dispatches_per_layer(impl: str) -> int:
+    """Batched-LoRA dispatch count for the three QKV projections of
+    one layer: the fused kernel folds them into ONE call; the unfused
+    route issues one gather+matmul chain per projection."""
+    return 1 if impl in ('fused', 'fused_interpret') else 3
+
+
+# -- analytic HBM roofline --------------------------------------------------
+def bytes_per_token_model(*, num_layers: int, num_kv_heads: int,
+                          num_q_heads: int, head_dim: int,
+                          page_size: int, pages_per_seq: int,
+                          kv_elem_bytes: int, quantized: bool,
+                          impl: str, weight_bytes: int = 0,
+                          batch: int = 1,
+                          lora_bytes_per_row: int = 0
+                          ) -> Dict[str, float]:
+    """Modeled HBM bytes one decode step moves PER SEQUENCE (= per
+    generated token), from the engine's actual page geometry.
+
+    Both routes walk the row's FULL page table every step (the length
+    mask shapes the math, not the reads), so context traffic is
+    static per config. Per layer:
+
+      pool reads    2 * pages_per_seq * page_size * Hkv * D * elem
+      scale rows    2 * pages_per_seq * page_size * 4        (int8)
+      xla dequant   the gather route additionally materializes
+                    dequantized + GQA-expanded [T, Hq, D] copies of k
+                    and v in HBM — one write + one read each. This is
+                    the term the fused kernel deletes.
+
+    Whole-model terms: weight reads amortize over the decode batch
+    (weights stream once per step); each row re-reads its adapter's
+    LoRA factor rows (`lora_bytes_per_row` — identical bytes fused or
+    not, the fusion saves dispatches, not factor traffic).
+    """
+    tokens_walked = pages_per_seq * page_size
+    pool = (2 * tokens_walked * num_kv_heads * head_dim
+            * kv_elem_bytes * num_layers)
+    scales = (2 * tokens_walked * 4 * num_layers) if quantized else 0
+    dequant = 0
+    if impl == 'xla':
+        elem = 4 if quantized else kv_elem_bytes
+        dequant = (2 * 2 * tokens_walked * num_q_heads * head_dim
+                   * elem * num_layers)
+    weights = weight_bytes / max(batch, 1)
+    total = pool + scales + dequant + weights + lora_bytes_per_row
+    return {
+        'impl': impl,
+        'context_tokens_walked': tokens_walked,
+        'kv_pool_bytes': pool,
+        'kv_scale_bytes': scales,
+        'dequant_materialize_bytes': dequant,
+        'weight_bytes_amortized': round(weights, 1),
+        'lora_bytes': lora_bytes_per_row,
+        'total_bytes_per_token': round(total + 0.0, 1),
+    }

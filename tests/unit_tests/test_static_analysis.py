@@ -306,6 +306,51 @@ def f():
 
 
 # ---------------------------------------------------------------------------
+# SKY006: pallas_call interpret-mode reachability
+# ---------------------------------------------------------------------------
+def test_sky006_missing_or_false_interpret_flagged():
+    src = '''\
+import jax.experimental.pallas as pl
+
+def run(x):
+    out = pl.pallas_call(kernel, grid=(4,))(x)
+    out = pl.pallas_call(kernel, grid=(4,), interpret=False)(x)
+    return out
+'''
+    assert rules_lines(src, 'ops/k.py', ['SKY006']) == [
+        ('SKY006', 4), ('SKY006', 5)]
+
+
+def test_sky006_plumbed_flag_and_true_are_clean():
+    src = '''\
+import jax.experimental.pallas as pl
+
+def run(x, interpret=False):
+    a = pl.pallas_call(kernel, grid=(4,), interpret=interpret)(x)
+    b = pl.pallas_call(kernel, interpret=True)(x)
+    c = pl.pallas_call(kernel, **opts)(x)
+    return a, b, c
+'''
+    assert rules_lines(src, 'ops/k.py', ['SKY006']) == []
+
+
+def test_sky006_tests_are_exempt():
+    src = 'pl.pallas_call(kernel, grid=(1,))(x)\n'
+    assert rules_lines(src, 'tests/unit_tests/t.py', ['SKY006']) == []
+    assert rules_lines(src, 'pkg/tests/t.py', ['SKY006']) == []
+    assert rules_lines(src, 'ops/k.py', ['SKY006']) == [('SKY006', 1)]
+
+
+def test_sky006_repo_kernels_thread_interpret():
+    """The in-repo fused kernels (ops/pallas_paged.py) must satisfy
+    their own rule — zero SKY006 findings across the package."""
+    from skypilot_tpu import analysis
+    findings = analysis.run_paths(
+        [os.path.join(REPO_ROOT, 'skypilot_tpu')], ['SKY006'])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
 # framework: suppressions, baseline, select, reporters
 # ---------------------------------------------------------------------------
 def test_suppression_comment_exact_rule():
@@ -324,7 +369,7 @@ def test_select_unknown_rule_raises():
     with pytest.raises(ValueError, match='SKY999'):
         analysis.resolve_select('SKY999')
     assert analysis.resolve_select('sky001') == {'SKY001'}
-    assert len(analysis.resolve_select(None)) == 5
+    assert len(analysis.resolve_select(None)) == 6
 
 
 def test_syntax_error_reported_not_crashed():
