@@ -58,6 +58,60 @@ def pct_ms(sorted_vals, q):
                            sorted_vals[hi] * frac), 2)
 
 
+def _slo_observed(record: dict) -> dict:
+    """Map a bench record's measured keys onto SLO dimensions.
+    Engine-side ITL (decode_itl_ms_p99 / server itl_ms_p99) beats the
+    SSE-timing fallback — wire jitter is not a scheduler promise.
+    Errors fold in server-reported 504s so single-server and fleet
+    records score the same promise."""
+    requests = record.get('requests') or 0
+    itl = record.get('decode_itl_ms_p99')
+    if itl is None:
+        itl = record.get('itl_ms_p99')
+    if itl is None:
+        itl = record.get('sse_itl_ms_p99')
+    errors = record.get('client_errors')
+    deadline = record.get('server_deadline_exceeded')
+    error_rate = None
+    if requests and (errors is not None or deadline is not None):
+        error_rate = ((errors or 0) + (deadline or 0)) / float(requests)
+    shed = record.get('shed_requests')
+    shed_rate = None
+    if shed is not None and (requests + shed) > 0:
+        shed_rate = shed / float(requests + shed)
+    return {
+        'p99_ttft_ms': record.get('p99_ttft_ms'),
+        'p99_itl_ms': itl,
+        'error_rate': error_rate,
+        'shed_rate': shed_rate,
+    }
+
+
+def attach_slo(record: dict, targets: dict) -> dict:
+    """Score a bench record (or each entry of an A/B `runs` map)
+    against `targets` and attach the machine-checkable `slo` block —
+    only the targeted dimensions are scored; an unmeasured targeted
+    dimension fails (slo.evaluate's contract)."""
+    from skypilot_tpu.observability import slo as slo_lib
+    if not isinstance(record, dict):
+        return record
+    runs = record.get('runs')
+    if isinstance(runs, dict):
+        for run in runs.values():
+            attach_slo(run, targets)
+        record['slo'] = {
+            'ok': all(bool((r or {}).get('slo', {}).get('ok'))
+                      for r in runs.values()),
+            'runs': {name: (r or {}).get('slo', {}).get('ok')
+                     for name, r in runs.items()},
+        }
+        return record
+    observed = {dim: val for dim, val in _slo_observed(record).items()
+                if dim in targets}
+    record['slo'] = slo_lib.evaluate(targets, observed)
+    return record
+
+
 def _server_env(args) -> dict:
     """Environment for a spawned serve_lm: repo on PYTHONPATH, and —
     for --tensor N on CPU — N virtual host devices (the ROADMAP
@@ -1337,9 +1391,30 @@ def main() -> None:
     parser.add_argument('--hf', default=None,
                         help='serve a local HF checkpoint directory')
     parser.add_argument('--ckpt-dir', default=None)
+    parser.add_argument('--slo', default=None, metavar='SPEC',
+                        help='score the run against declarative SLO '
+                             'targets (dim=target,... over '
+                             'p99_ttft_ms / p99_itl_ms / error_rate '
+                             '/ shed_rate) and attach a machine-'
+                             'checkable `slo` block: per-dimension '
+                             'pass/fail + budget_consumed '
+                             '(observed/target)')
     parser.add_argument('--cpu', action='store_true',
                         help='pin the server to the CPU backend')
     args = parser.parse_args()
+    slo_targets = None
+    if args.slo:
+        from skypilot_tpu.observability import slo as slo_lib
+        try:
+            slo_targets = slo_lib.parse_slo(args.slo)
+        except ValueError as exc:
+            parser.error(str(exc))
+
+    def _emit(record: dict) -> None:
+        if slo_targets:
+            attach_slo(record, slo_targets)
+        print(json.dumps(record))
+
     if args.decode_chunk > 1 and args.engine != 'continuous':
         parser.error('--decode-chunk is a continuous-engine knob; '
                      'the one-shot engine would silently ignore it '
@@ -1376,7 +1451,7 @@ def main() -> None:
             args.replicas = 2
         if not args.long_prompt_len:
             args.long_prompt_len = 512
-        print(json.dumps(run_disagg_ab(args)))
+        _emit(run_disagg_ab(args))
         return
     if args.spill_ab:
         if args.replicas or args.adapters:
@@ -1385,7 +1460,7 @@ def main() -> None:
             parser.error('--spill-ab needs --engine continuous (the '
                          'spill tier lives in the paged slot '
                          'engine)')
-        print(json.dumps(run_spill_ab(args)))
+        _emit(run_spill_ab(args))
         return
 
     if args.kernel_ab:
@@ -1396,19 +1471,18 @@ def main() -> None:
                          'QKV LoRA path must sit in the comparison)')
         if args.engine != 'continuous':
             parser.error('--kernel-ab needs --engine continuous')
-        print(json.dumps(run_kernel_ab(
-            _with(args, kv_dtype='int8'))))
+        _emit(run_kernel_ab(_with(args, kv_dtype='int8')))
         return
 
     if args.quant_ab:
-        print(json.dumps(run_quant_ab(args)))
+        _emit(run_quant_ab(args))
         return
     if args.tensor_ab:
-        print(json.dumps(run_tensor_ab(args)))
+        _emit(run_tensor_ab(args))
         return
 
     if args.replicas:
-        print(json.dumps(run_fleet(args)))
+        _emit(run_fleet(args))
         return
 
     if args.adapters:
@@ -1417,7 +1491,7 @@ def main() -> None:
         names = _make_adapter_artifacts(args, adapter_dir)
         assignment = _adapter_assignment(args, names)
         if args.adapter_ab:
-            print(json.dumps({
+            _emit({
                 'bench': 'serve_lora',
                 'engine': args.engine,
                 'model': args.model,
@@ -1438,13 +1512,12 @@ def main() -> None:
                     # registry at all (the pre-LoRA control arm).
                     'no_adapters': _run_single(args),
                 },
-            }))
+            })
         else:
-            print(json.dumps(_run_single(args, adapter_dir,
-                                         assignment)))
+            _emit(_run_single(args, adapter_dir, assignment))
         return
 
-    print(json.dumps(_run_single(args)))
+    _emit(_run_single(args))
 
 
 

@@ -5,3 +5,4 @@ from skypilot_tpu.analysis.checkers import jit_purity  # noqa: F401
 from skypilot_tpu.analysis.checkers import lock_discipline  # noqa: F401
 from skypilot_tpu.analysis.checkers import metric_names  # noqa: F401
 from skypilot_tpu.analysis.checkers import pallas_interpret  # noqa: F401
+from skypilot_tpu.analysis.checkers import span_discipline  # noqa: F401

@@ -440,7 +440,8 @@ def check(targets, fmt, select, baseline_path, no_baseline,
     With PATH arguments — or any of --select/--format/--baseline —
     runs the SKY static-analysis suite (async-safety, jit-purity,
     lock discipline, metric hygiene, exception hygiene,
-    pallas-interpret reachability; see docs/internals.md) and exits
+    pallas-interpret reachability, span discipline; see
+    docs/internals.md) and exits
     non-zero on any non-baselined finding. With cloud-name arguments (or none), probes cloud
     credentials and caches enabled clouds (the original behavior).
     """
@@ -1139,6 +1140,66 @@ def debug_dump(output) -> None:
             if os.path.exists(path):
                 tar.add(path, arcname=sub)
     click.echo(f'Wrote {output}.')
+
+
+@cli.command(name='trace')
+@click.argument('trace_id')
+@click.option('--endpoint', '-e', 'endpoints', multiple=True,
+              required=True, metavar='HOST:PORT',
+              help='A serving process to query (repeat for each: '
+                   'the LB, the prefill replica, the decode peer). '
+                   'Each answers GET /debug/trace/<id> with its own '
+                   'spans of the trace.')
+@click.option('--output', '-o', default=None, metavar='FILE',
+              help='Write the merged Chrome-trace JSON here '
+                   '(default: stdout).')
+@click.option('--timeout', type=float, default=5.0,
+              help='Per-endpoint HTTP timeout, seconds.')
+def trace_cmd(trace_id, endpoints, output, timeout) -> None:
+    """Merge one request's spans across serving processes.
+
+    A request traced at --trace-sample crosses up to three processes
+    (LB route -> prefill replica -> decode peer), each recording its
+    own spans under the shared trace id from the x-skypilot-trace
+    header. This fetches every process's view, de-duplicates, sorts
+    by wall clock, and emits ONE Chrome-trace JSON — load it in
+    chrome://tracing or ui.perfetto.dev (`pid` rows = processes).
+    """
+    import json as json_lib
+
+    import requests as requests_lib
+
+    from skypilot_tpu.observability import tracing
+    bodies = []
+    misses = []
+    for ep in endpoints:
+        base = ep if '://' in ep else f'http://{ep}'
+        url = f'{base.rstrip("/")}/debug/trace/{trace_id}'
+        try:
+            resp = requests_lib.get(url, timeout=timeout)
+        except requests_lib.RequestException as e:
+            misses.append(f'{ep}: {type(e).__name__}')
+            continue
+        if resp.status_code == 200:
+            bodies.append(resp.json())
+        else:
+            # 404 is normal: a process the trace never crossed.
+            misses.append(f'{ep}: HTTP {resp.status_code}')
+    if not bodies:
+        _err(f'trace {trace_id} not found on any endpoint'
+             f'{" (" + "; ".join(misses) + ")" if misses else ""}')
+    merged = tracing.merge_traces(bodies)
+    text = json_lib.dumps(merged, indent=2)
+    n = len(merged['traceEvents'])
+    if output:
+        with open(output, 'w', encoding='utf-8') as f:
+            f.write(text)
+        click.echo(f'Wrote {n} spans from {len(bodies)}/'
+                   f'{len(endpoints)} endpoints to {output}.')
+    else:
+        click.echo(text)
+    if misses:
+        click.secho('; '.join(misses), fg='yellow', err=True)
 
 
 @cli.group()

@@ -40,6 +40,7 @@ from skypilot_tpu.inference.runtime import (InferenceRuntime,
                                             iter_interleaved)
 from skypilot_tpu.observability import REGISTRY
 from skypilot_tpu.observability import catalog as obs_catalog
+from skypilot_tpu.observability import tracing
 from skypilot_tpu.ops import pallas_paged as _pallas_paged
 from skypilot_tpu.robustness import faults
 from skypilot_tpu.robustness.errors import (AdapterLoadError,
@@ -162,6 +163,13 @@ def make_server(rt: InferenceRuntime,
             if self.path in ('/metrics', '/v1/metrics'):
                 self._prometheus_metrics()
                 return
+            if self.path.startswith('/debug/trace/'):
+                self._debug_trace(
+                    self.path[len('/debug/trace/'):].strip('/'))
+                return
+            if self.path == '/debug/flight':
+                self._debug_flight()
+                return
             if self.path == '/v1/models':
                 # OpenAI client bootstrap: most SDKs list models
                 # before first use. Adapters are models: the `model`
@@ -200,6 +208,32 @@ def make_server(rt: InferenceRuntime,
                     reasons.append('queue saturated')
             self._json({'ready': not reasons, 'reasons': reasons},
                        200 if not reasons else 503)
+
+        def _debug_trace(self, trace_id):
+            """Completed spans THIS process recorded for one trace,
+            as a Chrome-trace JSON body. `stpu trace` fetches this
+            from every fleet process and merges on the shared
+            trace_id."""
+            body = tracing.get_trace(trace_id)
+            if body is None:
+                self._json({'error': f'unknown trace {trace_id!r}',
+                            'known': tracing.trace_ids()[-16:]}, 404)
+                return
+            self._json(body)
+
+        def _debug_flight(self):
+            """Flight-recorder dump of every live engine: the last N
+            scheduler events (admit, chunk dispatch, round commit,
+            preemption, eviction, spill, restore, handoff, soft
+            error, reset), recorded unconditionally — the post-mortem
+            readout when a replica wedges or dies."""
+            self._json({
+                'instance_uuid': INSTANCE_UUID,
+                'pid': os.getpid(),
+                'role': rt.role,
+                'engines': [eng.flight.dump()
+                            for eng in rt.live_engines()],
+            })
 
         def _prometheus_metrics(self):
             """Prometheus text exposition of the process registry.
@@ -249,6 +283,8 @@ def make_server(rt: InferenceRuntime,
                 body['handoff'] = rt.handoff_stats()
             if rt.adapters is not None:
                 body['adapters'] = rt.adapters.stats()
+            if rt.slo_tracker is not None:
+                body['slo'] = rt.slo_tracker.snapshot()
             if engine is None:
                 body['engine'] = 'simple'
                 self._json(body)
@@ -373,6 +409,26 @@ def make_server(rt: InferenceRuntime,
         def _do_post(self):
             if faults.point('http.handler') is faults.DROP:
                 return  # injected blackhole: client sees a hang/reset
+            # Adopt the caller's trace (LB or prefill peer sent the
+            # x-skypilot-trace header) or make the head-sampling
+            # decision here; unsampled = one float compare, no span.
+            ctx = tracing.parse_header(
+                self.headers.get(tracing.HEADER))
+            if ctx is None:
+                ctx = tracing.new_ctx()
+            if ctx is None:
+                self._trace_ctx = None
+                self._dispatch_post()
+                return
+            with tracing.span('replica.request', ctx,
+                              process=rt.role or 'replica',
+                              path=self.path) as root:
+                # Children (engine spans, handoff spans) parent to
+                # this request root, not to the wire parent.
+                self._trace_ctx = root.ctx
+                self._dispatch_post()
+
+        def _dispatch_post(self):
             if self.path == '/kv/import':
                 self._kv_import()
                 return
@@ -420,7 +476,10 @@ def make_server(rt: InferenceRuntime,
                 data = base64.b64decode(req['payload'])
                 eng = rt.engine if rt.engine is not None \
                     else rt.stream_engine()
-                summary = eng.import_chain(data)
+                with tracing.span('kv.import',
+                                  getattr(self, '_trace_ctx', None),
+                                  bytes=len(data)):
+                    summary = eng.import_chain(data)
                 rt.record_kv_import(summary)
             except Exception as e:  # pylint: disable=broad-except
                 self._plain_error(e)
@@ -469,6 +528,7 @@ def make_server(rt: InferenceRuntime,
             import requests as requests_lib
 
             from skypilot_tpu.inference import affinity
+            ctx = getattr(self, '_trace_ctx', None)
             t0 = time.monotonic()
             nbytes = 0
             try:
@@ -485,9 +545,12 @@ def make_server(rt: InferenceRuntime,
                 # promotes its full pages into the prefix cache.
                 eng.submit(row, max_new_tokens=1, temperature=0.0,
                            deadline_s=deadline_s,
-                           adapter=adapter).result(
+                           adapter=adapter,
+                           trace_ctx=ctx).result(
                                timeout=deadline_s + 30.0)
-                data = eng.export_chain(row, adapter=adapter)
+                with tracing.span('kv.export', ctx) as sp:
+                    data = eng.export_chain(row, adapter=adapter)
+                    sp.add(bytes=len(data))
                 if not data:
                     return False  # sub-page prompt: nothing to ship
                 key = affinity.token_affinity_key(
@@ -497,13 +560,21 @@ def make_server(rt: InferenceRuntime,
                 if peer is None:
                     return False
                 nbytes = len(data)
-                upstream = requests_lib.post(
-                    f'http://{peer}/kv/import',
-                    json={'payload':
-                          base64.b64encode(data).decode(),
-                          'path': path, 'request': req},
-                    stream=True,
-                    timeout=(3.0, deadline_s + 60.0))
+                # The trace rides the handoff: the decode peer's
+                # root span adopts this trace_id, completing the
+                # LB -> prefill -> decode chain.
+                hdrs = ({tracing.HEADER: tracing.format_header(ctx)}
+                        if ctx is not None else None)
+                with tracing.span('kv.post', ctx, peer=peer,
+                                  bytes=nbytes):
+                    upstream = requests_lib.post(
+                        f'http://{peer}/kv/import',
+                        json={'payload':
+                              base64.b64encode(data).decode(),
+                              'path': path, 'request': req},
+                        headers=hdrs,
+                        stream=True,
+                        timeout=(3.0, deadline_s + 60.0))
                 if upstream.status_code in (429, 500, 502, 503):
                     code = upstream.status_code
                     upstream.close()
@@ -588,7 +659,8 @@ def make_server(rt: InferenceRuntime,
                         temperature=temperature, top_k=top_k,
                         top_p=top_p, stop_token_ids=stop_ids,
                         on_token=latch, deadline_s=deadline_s,
-                        adapter=adapter)
+                        adapter=adapter,
+                        trace_ctx=getattr(self, '_trace_ctx', None))
                     # The engine's deadline sweep resolves expired
                     # futures with DeadlineExceededError (-> 504); the
                     # host-side timeout is only a backstop.
@@ -626,6 +698,12 @@ def make_server(rt: InferenceRuntime,
                 rt.metrics.record_shed()
             elif code == 504:
                 rt.metrics.record_deadline_exceeded()
+            elif code == 503 and rt.metrics.slo is not None:
+                # Engine-dead / adapter-load failures are server
+                # errors: they burn error budget (429/504 already
+                # burn through their own hooks; 4xx client errors
+                # never do).
+                rt.metrics.slo.record_request(error=True)
             headers = ({'Retry-After': str(max(1, int(retry_after)))}
                        if retry_after is not None else None)
             return code, headers
@@ -652,7 +730,8 @@ def make_server(rt: InferenceRuntime,
             handles = [rt.submit_stream(
                 [int(t) for t in row], max_new, temperature,
                 top_k=top_k, top_p=top_p, stop_token_ids=stop_ids,
-                deadline_s=deadline_s, adapter=adapter)
+                deadline_s=deadline_s, adapter=adapter,
+                trace_ctx=getattr(self, '_trace_ctx', None))
                 for row in tokens]
             self.sse_start()
             n_gen = 0
@@ -796,7 +875,8 @@ def make_server(rt: InferenceRuntime,
                         eng, encoded, max_new_tokens=max_new,
                         temperature=temperature, top_k=top_k,
                         top_p=top_p, on_token=latch,
-                        deadline_s=deadline_s, adapter=adapter)
+                        deadline_s=deadline_s, adapter=adapter,
+                        trace_ctx=getattr(self, '_trace_ctx', None))
                     rows = [f.result(timeout=deadline_s + 30.0)
                             for f in futs]
                     ttft = latch.first_token_s
@@ -826,10 +906,10 @@ def make_server(rt: InferenceRuntime,
             detokenization + stop-string holdback per row)."""
             tok = rt.get_tokenizer()
             t0 = time.monotonic()
-            handles = [rt.submit_stream(ids, max_new, temperature,
-                                        top_k=top_k, top_p=top_p,
-                                        deadline_s=deadline_s,
-                                        adapter=adapter)
+            handles = [rt.submit_stream(
+                ids, max_new, temperature, top_k=top_k, top_p=top_p,
+                deadline_s=deadline_s, adapter=adapter,
+                trace_ctx=getattr(self, '_trace_ctx', None))
                        for ids in encoded]
             self.sse_start()
             decs = [oai.IncrementalDecoder(tok) for _ in encoded]

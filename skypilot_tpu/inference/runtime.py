@@ -51,6 +51,11 @@ class ServingMetrics:
         self.requests_shed = 0
         self.deadline_exceeded = 0
         self.prom = obs_catalog.RequestMetrics()
+        # Declarative SLO accounting (observability/slo.py), attached
+        # by build_runtime when --slo is set: every record()/
+        # record_shed()/record_deadline_exceeded()/record_inter_token()
+        # also feeds the burn-rate tracker. None = no SLO declared.
+        self.slo = None
 
     def record(self, latency_s: float, n_tokens: int,
                ttft_s: Optional[float] = None,
@@ -67,18 +72,26 @@ class ServingMetrics:
         self.prom.prompt_tokens.inc(max(n_prompt_tokens, 0))
         if ttft_s is not None:
             self.prom.ttft_seconds.observe(ttft_s)
+        if self.slo is not None:
+            self.slo.record_request(
+                ttft_ms=(ttft_s * 1000.0 if ttft_s is not None
+                         else None))
 
     def record_shed(self) -> None:
         """One request rejected 429 by admission control."""
         with self._lock:
             self.requests_shed += 1
         self.prom.requests_shed.inc()
+        if self.slo is not None:
+            self.slo.record_request(shed=True)
 
     def record_deadline_exceeded(self) -> None:
         """One request answered 504 (expired queued or mid-decode)."""
         with self._lock:
             self.deadline_exceeded += 1
         self.prom.deadline_exceeded.inc()
+        if self.slo is not None:
+            self.slo.record_request(error=True)
 
     def record_inter_token(self, gap_s: float) -> None:
         """One gap between consecutive streamed tokens of a request
@@ -89,6 +102,8 @@ class ServingMetrics:
         with self._lock:
             self.itl_ms.append(gap_s * 1000.0)
         self.prom.inter_token_seconds.observe(gap_s)
+        if self.slo is not None:
+            self.slo.record_itl(gap_s * 1000.0)
 
     @staticmethod
     def _pct(values: List[float], q: float) -> Optional[float]:
@@ -286,6 +301,10 @@ class InferenceRuntime:
             else (spec_total if speculative > 0 else max_total_len)
         self.tokenizer_dir = tokenizer_dir
         self.metrics = ServingMetrics()
+        # Declared serving SLO (observability/slo.py), attached by
+        # build_runtime when --slo is set; /stats renders its
+        # burn-rate snapshot. None = no SLO declared.
+        self.slo_tracker = None
 
         self._fns: Dict[Tuple[int, float, int], object] = {}
         self._lock = threading.Lock()
@@ -562,7 +581,8 @@ class InferenceRuntime:
                       top_p: float = 1.0,
                       stop_token_ids: Optional[List[int]] = None,
                       deadline_s: Optional[float] = None,
-                      adapter: Optional[str] = None
+                      adapter: Optional[str] = None,
+                      trace_ctx: Optional[object] = None
                       ) -> StreamHandle:
         eng = self.stream_engine()
         # Queue must exist before submit; commit-time ITL recording
@@ -574,7 +594,7 @@ class InferenceRuntime:
             on_token=handle.on_token,
             deadline_s=(self.request_timeout if deadline_s is None
                         else deadline_s),
-            adapter=adapter)
+            adapter=adapter, trace_ctx=trace_ctx)
         return handle
 
     def live_engines(self) -> List[object]:
@@ -887,4 +907,22 @@ def build_runtime(args) -> InferenceRuntime:
         rt.weight_bytes)
     _obs_catalog.gauge('skypilot_serving_storage_info').labels(
         kv_dtype=kv_dtype, weight_dtype=weight_dtype).set(1)
+    # Distributed tracing: head-sample at the configured rate; the
+    # process tag makes this node's spans a distinct pid row in the
+    # merged Chrome trace.
+    trace_sample = float(getattr(args, 'trace_sample', 0.0) or 0.0)
+    if trace_sample > 0.0:
+        from skypilot_tpu.observability import tracing
+        tracing.configure(sample=trace_sample,
+                          seed=getattr(args, 'trace_seed', None),
+                          process=role or 'replica')
+    # Declarative SLO targets: one tracker feeds both the /stats slo
+    # section and the skypilot_serving_slo_* gauges, recorded through
+    # the ServingMetrics hooks.
+    slo_spec = getattr(args, 'slo', None)
+    if slo_spec:
+        from skypilot_tpu.observability import slo as slo_lib
+        rt.slo_tracker = slo_lib.SloTracker(
+            slo_lib.parse_slo(slo_spec))
+        rt.metrics.slo = rt.slo_tracker
     return rt

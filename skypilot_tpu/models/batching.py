@@ -51,6 +51,8 @@ import numpy as np
 from skypilot_tpu.models import lora as lora_lib
 from skypilot_tpu.models.generate import sample_tokens
 from skypilot_tpu.observability import catalog as _obs
+from skypilot_tpu.observability import flight as flight_lib
+from skypilot_tpu.observability import tracing
 from skypilot_tpu.robustness import faults
 from skypilot_tpu.robustness.errors import (AdapterNotFoundError,
                                             DeadlineExceededError,
@@ -84,7 +86,7 @@ class PrefixCache:
 
     def __init__(self, page_size: int,
                  metrics: Optional['_obs.EngineMetrics'] = None,
-                 spill=None, fetch_pages=None) -> None:
+                 spill=None, fetch_pages=None, flight=None) -> None:
         self.page_size = page_size
         self.by_key: Dict[bytes, int] = {}
         self.key_of: Dict[int, bytes] = {}
@@ -104,6 +106,9 @@ class PrefixCache:
         self.spill = spill
         self._fetch_pages = fetch_pages
         self.spilled_pages = 0
+        # Owning engine's flight recorder (observability/flight.py):
+        # evict/spill decisions land in its ring. None = standalone.
+        self._flight = flight
 
     @staticmethod
     def chain_keys(tokens, page_size: int,
@@ -207,6 +212,8 @@ class PrefixCache:
                     self.spilled_pages += 1
                     if self._metrics is not None:
                         self._metrics.kv_spill_pages.inc()
+                if self._flight is not None:
+                    self._flight.record('spill', pages=len(per_page))
             except Exception as e:  # pylint: disable=broad-except
                 # Spilling is an optimization: a failed gather must
                 # degrade to the classic drop-on-evict, never block
@@ -219,6 +226,8 @@ class PrefixCache:
             self.evictions += 1
             if self._metrics is not None:
                 self._metrics.prefix_evictions.inc()
+        if self._flight is not None:
+            self._flight.record('evict', pages=len(victims))
 
 
 class ContinuousBatchingEngine:
@@ -433,6 +442,13 @@ class ContinuousBatchingEngine:
         self.metrics = _obs.EngineMetrics(self.engine_id)
         self.metrics.num_slots.set(num_slots)
         self._weight_bytes: Optional[int] = None  # lazy (roofline)
+        # Flight recorder (observability/flight.py): every scheduler
+        # decision lands in this bounded ring, unconditionally —
+        # served at /debug/flight and snapshotted to a file on
+        # reset/death. Single-writer (the scheduler thread);
+        # deliberately lock-free, so SKY003 does not apply to it.
+        self.flight = flight_lib.FlightRecorder(
+            name=f'engine{self.engine_id}')
 
         # _fresh_cache is the single paging-reset point (also the
         # error-recovery path).
@@ -474,6 +490,10 @@ class ContinuousBatchingEngine:
         # prompt starts its decode sooner than round-robining all).
         self._prefill_order: 'collections.deque' = collections.deque()
         self._prefill_t0 = [0.0] * num_slots
+        # Per-slot distributed-tracing context
+        # (observability/tracing.py); None = request not sampled.
+        # Scheduler-thread owned, like the other slot arrays.
+        self._slot_ctx: List[Optional[Any]] = [None] * num_slots
 
         # Observability: model calls vs tokens committed (speculation
         # quality = tokens_committed / decode_calls, 1.0..K+1), and
@@ -553,7 +573,8 @@ class ContinuousBatchingEngine:
                                          metrics=self.metrics,
                                          spill=self.spill_tier,
                                          fetch_pages=self
-                                         ._gather_page_blobs)
+                                         ._gather_page_blobs,
+                                         flight=self.flight)
                              if self.prefix_caching else None)
         self.shared_pages: List[List[int]] = [
             [] for _ in range(self.num_slots)]
@@ -914,7 +935,8 @@ class ContinuousBatchingEngine:
                stop_token_ids: Optional[List[int]] = None,
                on_token: Optional[Callable[[int], None]] = None,
                deadline_s: Optional[float] = None,
-               adapter: Optional[str] = None
+               adapter: Optional[str] = None,
+               trace_ctx: Optional['tracing.Ctx'] = None
                ) -> 'Future':
         """Queue a request; the Future resolves to the full token list
         (prompt ++ generated). `temperature` overrides the engine
@@ -944,7 +966,12 @@ class ContinuousBatchingEngine:
         in order, on the scheduler thread — before the Future resolves
         — so it must be fast and non-blocking (push to a queue; don't
         do I/O). Tokens regenerated after a page-pressure preemption
-        are not re-delivered (they became prompt on re-admission)."""
+        are not re-delivered (they became prompt on re-admission).
+
+        `trace_ctx` attaches a distributed-tracing context
+        (observability/tracing.py): the scheduler emits queue-wait /
+        admission / prefill-chunk / decode-round spans under it. None
+        (unsampled, the default) adds zero per-request work."""
         if self._dead.is_set():
             raise EngineDeadError(
                 'engine scheduler thread is dead; restart the server')
@@ -984,10 +1011,17 @@ class ContinuousBatchingEngine:
         deadline = (time.monotonic() + float(deadline_s)
                     if deadline_s is not None else 0.0)
         fut: Future = Future()
+        # `tref` carries (ctx, enqueue perf_counter) so admission can
+        # emit the queue-wait span; None for unsampled requests (no
+        # clock read). Positional invariants the rest of the
+        # scheduler relies on survive: item[0] is the prompt,
+        # item[-2] the deadline, item[-1] the future.
+        tref = ((trace_ctx, time.perf_counter())
+                if trace_ctx is not None else None)
         self._queue.put((list(prompt), int(max_new_tokens),
                          float(temp), int(top_k), float(top_p),
                          frozenset(stop_token_ids or ()), adapter,
-                         on_token, deadline, fut))
+                         tref, on_token, deadline, fut))
         return fut
 
     def cancel(self, futs) -> None:
@@ -1301,7 +1335,10 @@ class ContinuousBatchingEngine:
                     'head_dim': int(getattr(cfg, 'head_dim', 0) or 0),
                     'keys': [k.hex() for k in keys[:len(pages)]],
                     'salt': salt.hex()}
-            return kv_transfer.pack_pages(blobs, meta)
+            packed = kv_transfer.pack_pages(blobs, meta)
+            self.flight.record('handoff_export', pages=len(pages),
+                               bytes=len(packed))
+            return packed
 
         return self.run_on_scheduler(op)
 
@@ -1373,6 +1410,10 @@ class ContinuousBatchingEngine:
                     raise
                 for (_i, key), page in zip(fit, pages):
                     cache.insert(key, page)
+            self.flight.record('kv_import', pages=len(keys),
+                               imported=len(fit),
+                               already_cached=already,
+                               dropped=dropped)
             return {'pages': len(keys), 'imported': len(fit),
                     'already_cached': already, 'dropped': dropped}
 
@@ -1422,6 +1463,7 @@ class ContinuousBatchingEngine:
         shared.extend(pages)
         self.kv_restored_pages += n_fit
         self.metrics.kv_restore_pages.inc(n_fit)
+        self.flight.record('restore', pages=n_fit)
 
     # -- scheduler loop -----------------------------------------------------
     def _loop(self) -> None:
@@ -1441,6 +1483,8 @@ class ContinuousBatchingEngine:
         finally:
             if not self._stop.is_set():
                 self._dead.set()
+                self.flight.record('death')
+                self.flight.snapshot('death')
                 died = EngineDeadError('engine scheduler thread died')
                 for slot in range(self.num_slots):
                     fut = self.futures[slot]
@@ -1475,9 +1519,16 @@ class ContinuousBatchingEngine:
             progressed = True
         if self.active.any() or self._inflight is not None:
             t_step = time.perf_counter()
+            committed0 = self.tokens_committed
             self._decode_step()
-            self.metrics.decode_step_seconds.observe(
-                time.perf_counter() - t_step)
+            dt_step = time.perf_counter() - t_step
+            self.metrics.decode_step_seconds.observe(dt_step)
+            self.flight.record(
+                'round_commit',
+                tokens=self.tokens_committed - committed0,
+                active=int(self.active.sum()))
+            if tracing.enabled():
+                self._trace_decode_round(dt_step)
             progressed = True
         if not progressed and self._queue.empty() and \
                 not self._ready:
@@ -1521,11 +1572,20 @@ class ContinuousBatchingEngine:
         import traceback
         traceback.print_exc()
         self._soft_errors += 1
+        victims = [s for s in range(self.num_slots)
+                   if self.active[s] or self.prefilling[s]]
+        self.flight.record('soft_error', error=type(e).__name__,
+                           message=str(e)[:200],
+                           strikes=self._soft_errors, slots=victims)
         if not self._cache_lost() and self._soft_errors < 3:
             print(f'engine {self.engine_id}: transient scheduler error '
                   f'({type(e).__name__}: {e}); state intact, '
                   f'continuing', flush=True)
             return
+        self.flight.record('reset', error=type(e).__name__,
+                           strikes=self._soft_errors, slots=victims,
+                           restarts=self.engine_restarts + 1)
+        self.flight.snapshot('reset')
         self.engine_restarts += 1
         self.metrics.engine_restarts.inc()
         self._soft_errors = 0
@@ -1540,6 +1600,7 @@ class ContinuousBatchingEngine:
             self.active[slot] = False
             self.prefilling[slot] = False
             self.on_tokens[slot] = None
+            self._slot_ctx[slot] = None
             self._release_adapter(slot)
             if fut is not None:
                 fut.set_exception(e)
@@ -1624,6 +1685,7 @@ class ContinuousBatchingEngine:
         self.active[slot] = False
         self.on_tokens[slot] = None
         self.deadlines[slot] = 0.0
+        self._slot_ctx[slot] = None
         self._release_adapter(slot)
         if self.prefilling[slot]:
             self.prefilling[slot] = False
@@ -1683,7 +1745,8 @@ class ContinuousBatchingEngine:
                 break
         while self._ready and not self._occupied().all():
             (prompt, max_new, temp, top_k, top_p, stops, adapter,
-             on_token, deadline, fut) = self._ready.popleft()
+             tref, on_token, deadline, fut) = self._ready.popleft()
+            t_adm = time.perf_counter() if tref is not None else 0.0
             self._queued_tokens_sub(len(prompt))
             if deadline and time.monotonic() > deadline:
                 # Expired while queued: prefilling it would only delay
@@ -1717,7 +1780,7 @@ class ContinuousBatchingEngine:
                     self._queued_tokens_add(len(prompt))
                     self._ready.appendleft(
                         (prompt, max_new, temp, top_k, top_p, stops,
-                         adapter, on_token, deadline, fut))
+                         adapter, tref, on_token, deadline, fut))
                     break
                 salt = self.adapter_store.cache_salt(adapter)
             plen = len(prompt)
@@ -1742,7 +1805,14 @@ class ContinuousBatchingEngine:
                     # like a resident hit.
                     if self.spill_tier is not None and \
                             len(shared) < len(keys):
+                        n_res0 = len(shared)
+                        t_res = time.perf_counter()
                         self._restore_from_spill(keys, shared)
+                        if tref is not None and len(shared) > n_res0:
+                            tracing.record_span(
+                                'engine.kv_restore', tref[0],
+                                time.perf_counter() - t_res,
+                                pages=len(shared) - n_res0)
                     self.prefix_cache.record_lookup(
                         len(shared), len(keys) - len(shared))
                     if len(shared) * self.page_size >= plen:
@@ -1760,7 +1830,7 @@ class ContinuousBatchingEngine:
                 # max_total_len, so a lone sequence always fits.
                 assert plen + 1 <= (self.total_pages - 1) * self.page_size
                 if self.prefix_cache is not None:
-                    self.prefix_cache.evict_into(self.allocator, need)
+                    self._evict_for(need, tref)
                 if not self.allocator.can_allocate(need):
                     # Pool exhausted: back to the HEAD and stop
                     # admitting until a sequence releases pages —
@@ -1772,7 +1842,7 @@ class ContinuousBatchingEngine:
                     self._queued_tokens_add(len(prompt))
                     self._ready.appendleft(
                         (prompt, max_new, temp, top_k, top_p, stops,
-                         adapter, on_token, deadline, fut))
+                         adapter, tref, on_token, deadline, fut))
                     break
                 pages = self.allocator.allocate(need)
                 self.owned_pages[slot] = pages
@@ -1818,9 +1888,39 @@ class ContinuousBatchingEngine:
             self.prefilling[slot] = True
             self._prefill_order.append(slot)
             self._prefill_t0[slot] = time.perf_counter()
+            self._slot_ctx[slot] = tref[0] if tref is not None else None
+            if tref is not None:
+                tracing.record_span('engine.queue_wait', tref[0],
+                                    t_adm - tref[1], slot=slot)
+                tracing.record_span('engine.admit', tref[0],
+                                    time.perf_counter() - t_adm,
+                                    slot=slot, prompt_len=plen,
+                                    cached_tokens=n_cached)
+            self.flight.record('admit', slot=slot, prompt_len=plen,
+                               cached_tokens=n_cached,
+                               queued=len(self._ready))
             self.metrics.admissions.inc()
             admitted = True
         return admitted
+
+    def _evict_for(self, need: int, tref) -> None:
+        """Prefix-cache eviction for an admission, with an
+        'engine.kv_spill' span when the admitting request is traced
+        and the eviction actually ran (untraced requests call
+        straight through: no clock reads)."""
+        cache = self.prefix_cache
+        if tref is None:
+            cache.evict_into(self.allocator, need)
+            return
+        ev0, sp0 = cache.evictions, cache.spilled_pages
+        t0 = time.perf_counter()
+        cache.evict_into(self.allocator, need)
+        if cache.evictions > ev0:
+            tracing.record_span(
+                'engine.kv_spill', tref[0],
+                time.perf_counter() - t0,
+                evicted=cache.evictions - ev0,
+                spilled=cache.spilled_pages - sp0)
 
     # -- chunked prefill ----------------------------------------------------
     def _chunk_shape(self, n: int, offset: int) -> int:
@@ -1913,6 +2013,8 @@ class ContinuousBatchingEngine:
                 n = min(n, self.prefill_chunk)
             if budget is not None and spent + n > budget:
                 break   # budget spent: decode steps run first
+            self.flight.record('chunk_dispatch', slot=slot,
+                               offset=offset, n=n)
             t0 = time.perf_counter()
             try:
                 last = self._run_prefill_chunk(slot, offset, n)
@@ -1930,6 +2032,10 @@ class ContinuousBatchingEngine:
                 continue
             self.metrics.prefill_chunk_seconds.observe(
                 time.perf_counter() - t0)
+            tracing.record_span('engine.prefill_chunk',
+                                self._slot_ctx[slot],
+                                time.perf_counter() - t0,
+                                slot=slot, offset=offset, n=n)
             spent += n
             offset += n
             self.prefill_frontier[slot] = offset
@@ -2015,16 +2121,26 @@ class ContinuousBatchingEngine:
             self.active[slot] = False
             self.preemptions += 1
             self.metrics.preemptions.inc()
+            self.flight.record(
+                'preempt', slot=slot,
+                generated=len(self.outputs[slot]) -
+                int(self.prompt_len[slot]))
+            ctx = self._slot_ctx[slot]
+            self._slot_ctx[slot] = None
             self._release_adapter(slot)
             self._release_slot_pages(slot, promote=False)
             if fut is not None:
+                # The trace ctx rides the re-queued request: its
+                # re-admission emits a second queue-wait span.
+                tref = ((ctx, time.perf_counter())
+                        if ctx is not None else None)
                 preempted.append((list(self.outputs[slot]),
                                   max(remaining, 1),
                                   float(self.temps[slot]),
                                   int(self.top_ks[slot]),
                                   float(self.top_ps[slot]),
                                   self.stop_ids[slot],
-                                  adapter_name,
+                                  adapter_name, tref,
                                   self.on_tokens[slot],
                                   float(self.deadlines[slot]), fut))
                 self._queued_tokens_add(len(self.outputs[slot]))
@@ -2086,6 +2202,7 @@ class ContinuousBatchingEngine:
         self.active[slot] = False
         self.on_tokens[slot] = None
         self.deadlines[slot] = 0.0
+        self._slot_ctx[slot] = None
         self._release_adapter(slot)
         was_prefilling = bool(self.prefilling[slot])
         if was_prefilling:
@@ -2188,6 +2305,22 @@ class ContinuousBatchingEngine:
                 continue
             self._commit_token(slot, int(sampled[slot]))
 
+    def _trace_decode_round(self, dur: float) -> None:
+        """One 'engine.decode_round' span per traced slot riding this
+        round — the request's occupancy of the shared dispatch. Slots
+        that finished inside the round already cleared their ctx (the
+        final round is not attributed; the one-round skew is
+        harmless)."""
+        batch = int(self.active.sum())
+        for slot in range(self.num_slots):
+            ctx = self._slot_ctx[slot]
+            if ctx is None or not (self.active[slot] or
+                                   self.prefilling[slot]):
+                continue
+            tracing.record_span('engine.decode_round', ctx, dur,
+                                slot=slot, pos=int(self.pos[slot]),
+                                batch=batch)
+
     def _fetch_tokens(self, dev) -> 'np.ndarray':
         """device_get with decode-stall accounting: the wall time the
         host spends blocked here is exactly the serial host/device
@@ -2198,6 +2331,17 @@ class ContinuousBatchingEngine:
         stall = time.perf_counter() - t0
         self.decode_stall_s += stall
         self.metrics.decode_stall_seconds.inc(stall)
+        if tracing.enabled():
+            # The stall is shared by the whole round: attribute ONE
+            # span to the first traced active slot (a representative,
+            # not a per-slot fan-out).
+            for slot in range(self.num_slots):
+                ctx = self._slot_ctx[slot]
+                if ctx is not None and self.active[slot]:
+                    tracing.record_span(
+                        'engine.device_get', ctx, stall,
+                        stall_ms=round(stall * 1e3, 3))
+                    break
         return out
 
     # -- pipelined decode ---------------------------------------------------

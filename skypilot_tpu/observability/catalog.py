@@ -199,6 +199,27 @@ SPECS: Dict[str, Tuple] = {
     'skypilot_serving_deadline_exceeded_total': (
         'counter', 'Requests answered 504: deadline expired while '
                    'queued or mid-decode', ()),
+    # -- SLO / error-budget accounting (observability/slo.py; fed by
+    #    http_server + LB per finished/shed request)
+    'skypilot_serving_slo_target': (
+        'gauge', 'Declared SLO target per dimension (p99_ttft_ms, '
+                 'p99_itl_ms, error_rate, shed_rate) as passed to '
+                 '--slo; absent dimensions are not promised',
+        ('dimension',)),
+    'skypilot_serving_slo_burn_rate': (
+        'gauge', 'Error-budget burn rate per dimension and window: '
+                 '(bad/total)/budget over the window, where budget '
+                 'is the rate target itself or 1% for p99 latency '
+                 'dimensions; 1.0 = consuming budget exactly at the '
+                 'allowed pace', ('dimension', 'window')),
+    'skypilot_serving_slo_budget_remaining': (
+        'gauge', 'max(0, 1 - slow-window burn rate) per dimension: '
+                 'the fraction of error budget left if the current '
+                 'pace holds', ('dimension',)),
+    'skypilot_serving_slo_bad_total': (
+        'counter', 'Requests that violated an SLO dimension (errored, '
+                   'shed, or over the latency target), cumulative '
+                   'since process start', ('dimension',)),
     # -- replica plane (serve/replica_plane/: manager + LB front-end)
     'skypilot_lb_requests_routed_total': (
         'counter', 'Requests the replica-plane LB routed to a '
@@ -216,6 +237,16 @@ SPECS: Dict[str, Tuple] = {
                    'target (the replica already holding the prefix '
                    'KV pages); hits/requests is the affinity hit '
                    'ratio', ()),
+    'skypilot_lb_ttft_seconds': (
+        'histogram', 'LB-side time to first response byte, anchored '
+                     'at the FIRST attempt (a retry after a replica '
+                     'death still counts the dead attempt: this is '
+                     'user-perceived TTFT)', (),
+        {'buckets': REQUEST_BUCKETS}),
+    'skypilot_lb_request_seconds': (
+        'histogram', 'LB-side end-to-end proxy latency across all '
+                     'retry attempts, anchored at the first attempt',
+        (), {'buckets': REQUEST_BUCKETS}),
     'skypilot_replica_plane_replicas': (
         'gauge', 'Local serve_lm replicas managed by the replica '
                  'plane, by lifecycle state', ('state',)),

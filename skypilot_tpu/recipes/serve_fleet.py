@@ -73,6 +73,15 @@ def build_replica_cmd(args: argparse.Namespace) -> list:
         cmd += ['--kv-cold-dir', args.kv_cold_dir]
     if args.fault_plan:
         cmd += ['--fault-plan', args.fault_plan]
+    if args.trace_sample:
+        # Replicas never head-sample in a fleet (the LB owns the
+        # decision and propagates it via the trace header); the flag
+        # still turns their span recording on.
+        cmd += ['--trace-sample', str(args.trace_sample)]
+        if args.trace_seed is not None:
+            cmd += ['--trace-seed', str(args.trace_seed)]
+    if args.slo:
+        cmd += ['--slo', args.slo]
     if args.cpu:
         cmd += ['--cpu']
     return cmd
@@ -177,7 +186,31 @@ def main() -> None:
                         default=4096.0)
     parser.add_argument('--upscale-delay', type=float, default=10.0)
     parser.add_argument('--downscale-delay', type=float, default=60.0)
+    parser.add_argument('--trace-sample', type=float, default=0.0,
+                        metavar='P',
+                        help='distributed tracing: the LB samples '
+                             'this fraction of requests and '
+                             'propagates the decision to replicas '
+                             'over the x-skypilot-trace header; '
+                             '`stpu trace <id>` merges the per-'
+                             'process spans into one Chrome trace')
+    parser.add_argument('--trace-seed', type=int, default=None,
+                        help='seed the LB trace sampler '
+                             '(reproducible sampled set + ids)')
+    parser.add_argument('--slo', default=None, metavar='SPEC',
+                        help='fleet SLO targets (e.g. "p99_ttft_ms='
+                             '500,error_rate=0.01"): the LB tracks '
+                             'user-perceived burn rates in '
+                             '/fleet/status and each replica tracks '
+                             'its own in /stats')
     args = parser.parse_args()
+    slo_targets = None
+    if args.slo:
+        from skypilot_tpu.observability import slo as slo_lib
+        try:
+            slo_targets = slo_lib.parse_slo(args.slo)
+        except ValueError as e:
+            parser.error(str(e))
 
     from skypilot_tpu.serve import autoscalers
     from skypilot_tpu.serve import load_balancing_policies as lb_policies
@@ -238,7 +271,10 @@ def main() -> None:
         page_size=args.page_size,
         disagg_threshold=(args.disagg_prompt_threshold
                           if args.prefill_replicas > 0 else 0),
-        prefill_pool=prefill_pool)
+        prefill_pool=prefill_pool,
+        trace_sample=args.trace_sample,
+        trace_seed=args.trace_seed,
+        slo_targets=slo_targets)
 
     def handle_term(signum, frame):  # noqa: ARG001
         def _shutdown():

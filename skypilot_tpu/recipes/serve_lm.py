@@ -240,11 +240,40 @@ def main() -> None:
                              'docs/guides.md "Serving robustness". '
                              'Equivalent to the STPU_FAULT_PLAN env '
                              'var. Never set this in production')
+    parser.add_argument('--trace-sample', type=float, default=0.0,
+                        metavar='P',
+                        help='distributed request tracing: sample '
+                             'this fraction of requests (0..1) into '
+                             'Chrome-trace spans, served at GET '
+                             '/debug/trace/<id> and merged across '
+                             'processes by `stpu trace`. Requests '
+                             'arriving with an x-skypilot-trace '
+                             'header are always traced (the caller '
+                             'already paid the sampling decision). '
+                             '0 = off (zero overhead)')
+    parser.add_argument('--trace-seed', type=int, default=None,
+                        help='seed the trace sampler: the sampled '
+                             'set and its ids become reproducible')
+    parser.add_argument('--slo', default=None, metavar='SPEC',
+                        help='declarative serving SLOs, e.g. '
+                             '"p99_ttft_ms=500,p99_itl_ms=100,'
+                             'error_rate=0.01,shed_rate=0.05": '
+                             '/stats grows an `slo` section with '
+                             'multi-window burn rates and the '
+                             'skypilot_serving_slo_* gauges go live '
+                             '(docs/guides.md "Tracing & SLOs")')
     parser.add_argument('--cpu', action='store_true',
                         help='pin the CPU backend (smoke/dev runs; the '
                              'JAX_PLATFORMS env var is overridden by '
                              'some TPU plugins, jax.config is not)')
     args = parser.parse_args()
+    if args.slo:
+        # Fail fast at startup, not at first scrape.
+        from skypilot_tpu.observability import slo as slo_lib
+        try:
+            slo_lib.parse_slo(args.slo)
+        except ValueError as e:
+            parser.error(str(e))
     if args.decode_chunk > 1 and not args.continuous_batching:
         parser.error('--decode-chunk is a continuous-engine knob; '
                      'add --continuous-batching (the one-shot engine '

@@ -28,12 +28,14 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
 from skypilot_tpu.inference import affinity
 from skypilot_tpu.observability import REGISTRY
 from skypilot_tpu.observability import catalog as obs_catalog
+from skypilot_tpu.observability import tracing
 from skypilot_tpu.utils import ux_utils
 
 #: Hop-by-hop headers never forwarded in either direction.
@@ -62,6 +64,13 @@ class LBMetrics:
             'skypilot_lb_affinity_requests_total')
         self.affinity_hits = obs_catalog.counter(
             'skypilot_lb_affinity_hits_total')
+        # User-perceived latency, anchored at the FIRST attempt: a
+        # retry after a replica death keeps the original clock, so
+        # these reflect what the client waited, not the last hop.
+        self.ttft_seconds = obs_catalog.histogram(
+            'skypilot_lb_ttft_seconds')
+        self.request_seconds = obs_catalog.histogram(
+            'skypilot_lb_request_seconds')
         # Window counters for /fleet/status (Prometheus children keep
         # lifetime process totals across LB instances; these are THIS
         # LB's, so the bench's affinity ratio is per-run).
@@ -173,7 +182,10 @@ def make_lb_server(policy, port: int, *, policy_name: str,
                    upstream_timeout_s: float = 660.0,
                    connect_timeout_s: float = 3.0,
                    disagg_threshold: int = 0,
-                   prefill_pool: Optional[PrefillPool] = None
+                   prefill_pool: Optional[PrefillPool] = None,
+                   trace_sample: float = 0.0,
+                   trace_seed: Optional[int] = None,
+                   slo_targets: Optional[Dict[str, float]] = None
                    ) -> ThreadingHTTPServer:
     """Build (not yet serving) the LB. `policy` is a
     LoadBalancingPolicy whose ready set the fleet controller keeps
@@ -189,6 +201,15 @@ def make_lb_server(policy, port: int, *, policy_name: str,
     import requests as requests_lib
 
     metrics = LBMetrics(policy_name)
+    if trace_sample > 0:
+        # The LB is the trace head: it makes the sampling decision
+        # for headerless requests. Replicas inherit the decision via
+        # the propagated header, whatever their own sample rate.
+        tracing.configure(sample=trace_sample, seed=trace_seed)
+    slo_tracker = None
+    if slo_targets:
+        from skypilot_tpu.observability import slo as slo_mod
+        slo_tracker = slo_mod.SloTracker(slo_targets)
 
     class Handler(BaseHTTPRequestHandler):
 
@@ -212,12 +233,23 @@ def make_lb_server(policy, port: int, *, policy_name: str,
                             else ['no ready replicas']},
                            200 if ready else 503)
                 return
+            if self.path.startswith('/debug/trace/'):
+                trace_id = self.path.rsplit('/', 1)[-1]
+                trace = tracing.get_trace(trace_id)
+                if trace is None:
+                    self._json({'error': f'unknown trace {trace_id}'},
+                               404)
+                else:
+                    self._json(trace)
+                return
             if self.path == '/fleet/status':
                 views = ([v.to_dict() for v in manager.views()]
                          if manager is not None else [])
                 body = {'replicas': views,
                         'policy': policy_name,
                         'lb': metrics.snapshot()}
+                if slo_tracker is not None:
+                    body['slo'] = slo_tracker.snapshot()
                 if disagg_threshold > 0:
                     body['disagg'] = {
                         'prompt_threshold': disagg_threshold,
@@ -262,53 +294,100 @@ def make_lb_server(policy, port: int, *, policy_name: str,
         def _proxy(self, body_bytes: Optional[bytes],
                    key: Optional[str],
                    long_prompt: bool = False) -> None:
-            tried = set()
-            for attempt in range(max_retries + 1):
-                from_prefill = False
-                replica = None
-                if long_prompt and prefill_pool is not None:
-                    # Long prompts go to the prefill pool (their
-                    # replicas hand the KV chain to the decode pool);
-                    # an empty/exhausted pool falls back to normal
-                    # decode routing — disaggregation being down
-                    # degrades, it never 5xxes.
-                    replica = prefill_pool.select(exclude=tried)
-                    from_prefill = replica is not None
-                if replica is None:
-                    replica = policy.select_replica(key=key,
-                                                    exclude=tried)
-                if replica is None:
-                    self._json({'error': 'no ready replicas'}, 503)
-                    return
-                if attempt == 0 and key is not None and \
-                        not from_prefill and \
-                        hasattr(policy, 'affinity_target'):
-                    target = policy.affinity_target(key)
-                    metrics.record_affinity(hit=replica == target)
-                metrics.record_routed(replica)
-                try:
-                    done = self._forward(replica, body_bytes)
-                finally:
-                    if not from_prefill:
-                        policy.request_done(replica)
-                if done:
-                    return
-                # Not-yet-streamed failure: safe to retry elsewhere.
-                tried.add(replica)
-                metrics.record_retried()
-                ux_utils.log(f'LB: replica {replica} failed before '
-                             f'streaming; retrying '
-                             f'({attempt + 1}/{max_retries}).')
-            self._json({'error': 'all replicas failed'}, 502)
+            # First-attempt anchor: every retry after a replica death
+            # keeps this clock, so LB-side TTFT/latency is what the
+            # CLIENT waited, not the last attempt's slice of it.
+            t0 = time.monotonic()
+            ctx = tracing.parse_header(
+                self.headers.get(tracing.HEADER))
+            if ctx is None:
+                ctx = tracing.new_ctx()
+            root = tracing.start_span('lb.request', ctx,
+                                      process='lb', path=self.path)
+            status: Optional[int] = None
+            ttft_s: Optional[float] = None
+            try:
+                tried = set()
+                for attempt in range(max_retries + 1):
+                    from_prefill = False
+                    replica = None
+                    with tracing.span('lb.route', root.ctx,
+                                      process='lb') as route_span:
+                        if long_prompt and prefill_pool is not None:
+                            # Long prompts go to the prefill pool
+                            # (their replicas hand the KV chain to
+                            # the decode pool); an empty/exhausted
+                            # pool falls back to normal decode
+                            # routing — disaggregation being down
+                            # degrades, it never 5xxes.
+                            replica = prefill_pool.select(
+                                exclude=tried)
+                            from_prefill = replica is not None
+                        if replica is None:
+                            replica = policy.select_replica(
+                                key=key, exclude=tried)
+                        route_span.add(attempt=attempt,
+                                       replica=replica or '',
+                                       prefill=from_prefill)
+                    if replica is None:
+                        status = 503
+                        self._json({'error': 'no ready replicas'},
+                                   503)
+                        return
+                    if attempt == 0 and key is not None and \
+                            not from_prefill and \
+                            hasattr(policy, 'affinity_target'):
+                        target = policy.affinity_target(key)
+                        metrics.record_affinity(hit=replica == target)
+                    metrics.record_routed(replica)
+                    try:
+                        done, status, ttft_s = self._forward(
+                            replica, body_bytes, t0, root)
+                    finally:
+                        if not from_prefill:
+                            policy.request_done(replica)
+                    if done:
+                        root.add(replica=replica,
+                                 attempts=attempt + 1)
+                        return
+                    # Not-yet-streamed failure: retry elsewhere.
+                    tried.add(replica)
+                    metrics.record_retried()
+                    ux_utils.log(f'LB: replica {replica} failed '
+                                 f'before streaming; retrying '
+                                 f'({attempt + 1}/{max_retries}).')
+                status = 502
+                self._json({'error': 'all replicas failed'}, 502)
+            finally:
+                root.end(status=status if status is not None else -1)
+                if body_bytes is not None:
+                    # Routed generation POSTs only — GET pass-through
+                    # would pollute the latency distributions.
+                    metrics.request_seconds.observe(
+                        time.monotonic() - t0)
+                    if ttft_s is not None:
+                        metrics.ttft_seconds.observe(ttft_s)
+                    if slo_tracker is not None:
+                        slo_tracker.record_request(
+                            error=(status is None or status >= 500),
+                            shed=(status == 429),
+                            ttft_ms=(ttft_s * 1000.0
+                                     if ttft_s is not None else None))
 
         def _forward(self, replica: str,
-                     body_bytes: Optional[bytes]) -> bool:
-            """Proxy one attempt. True = the client got an answer
-            (including a truncated stream — headers are out); False =
-            nothing reached the client, retry is safe."""
+                     body_bytes: Optional[bytes], t0: float, root
+                     ) -> tuple:
+            """Proxy one attempt. Returns (done, status, ttft_s):
+            done = the client got an answer (including a truncated
+            stream — headers are out) so no retry; status is the
+            upstream code when one arrived; ttft_s is first response
+            byte relative to `t0` (the FIRST attempt's start)."""
             url = f'http://{replica}{self.path}'
             headers = {k: v for k, v in self.headers.items()
                        if k.lower() not in _HOP_HEADERS}
+            if root.ctx is not None:
+                headers[tracing.HEADER] = tracing.format_header(
+                    root.ctx)
             try:
                 if body_bytes is None:
                     upstream = requests_lib.get(
@@ -323,10 +402,10 @@ def make_lb_server(policy, port: int, *, policy_name: str,
             except requests_lib.RequestException as e:
                 ux_utils.log(f'LB: upstream {replica} unreachable '
                              f'({type(e).__name__}: {e}).')
-                return False
+                return False, None, None
             with upstream:
                 if upstream.status_code in _RETRYABLE_STATUS:
-                    return False
+                    return False, upstream.status_code, None
                 is_stream = 'text/event-stream' in \
                     upstream.headers.get('Content-Type', '')
                 if not is_stream:
@@ -335,7 +414,8 @@ def make_lb_server(policy, port: int, *, policy_name: str,
                     except requests_lib.RequestException as e:
                         ux_utils.log(f'LB: upstream {replica} died '
                                      f'mid-response ({e}).')
-                        return False
+                        return False, None, None
+                    ttft_s = time.monotonic() - t0
                     self.send_response(upstream.status_code)
                     for k, v in upstream.headers.items():
                         if k.lower() not in _HOP_HEADERS:
@@ -344,16 +424,19 @@ def make_lb_server(policy, port: int, *, policy_name: str,
                                      str(len(content)))
                     self.end_headers()
                     self.wfile.write(content)
-                    return True
+                    return True, upstream.status_code, ttft_s
                 # SSE: headers out first, then chunks as they arrive.
                 self.send_response(upstream.status_code)
                 for k, v in upstream.headers.items():
                     if k.lower() not in _HOP_HEADERS:
                         self.send_header(k, v)
                 self.end_headers()
+                ttft_s = None
                 try:
                     for chunk in upstream.iter_content(8192):
                         if chunk:
+                            if ttft_s is None:
+                                ttft_s = time.monotonic() - t0
                             self.wfile.write(chunk)
                             self.wfile.flush()
                 except (requests_lib.RequestException, OSError) as e:
@@ -362,8 +445,9 @@ def make_lb_server(policy, port: int, *, policy_name: str,
                     # requests of the dead replica); never re-spliced.
                     ux_utils.log(f'LB: stream from {replica} '
                                  f'truncated ({type(e).__name__}).')
-                return True
+                return True, upstream.status_code, ttft_s
 
     server = ThreadingHTTPServer(('0.0.0.0', port), Handler)
     server.lb_metrics = metrics  # type: ignore[attr-defined]
+    server.slo_tracker = slo_tracker  # type: ignore[attr-defined]
     return server

@@ -37,6 +37,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional
 
 from skypilot_tpu.inference import affinity
+from skypilot_tpu.observability import tracing
 
 
 class _StubDied(Exception):
@@ -258,6 +259,15 @@ def make_stub_server(port: int, *, seed: int = 0, page_size: int = 16,
             if self.path in ('/stats', '/v1/stats'):
                 self._json(state.stats())
                 return
+            if self.path.startswith('/debug/trace/'):
+                trace_id = self.path.rsplit('/', 1)[-1]
+                trace = tracing.get_trace(trace_id)
+                if trace is None:
+                    self._json({'error': f'unknown trace {trace_id}'},
+                               404)
+                else:
+                    self._json(trace)
+                return
             self._json({'status': 'ok', 'model': 'stub',
                         'vocab_size': 50000, 'max_total_len': 4096})
 
@@ -278,10 +288,21 @@ def make_stub_server(port: int, *, seed: int = 0, page_size: int = 16,
             with state.lock:
                 state.inflight += 1
             try:
-                if self.path == '/kv/import':
-                    self._kv_import()
-                else:
-                    self._generate()
+                # Adopt the caller's trace (LB header or a prefill
+                # peer's handoff POST): the stub never head-samples —
+                # in a fleet the LB owns that decision. Role-tagged
+                # process rows make the merged trace read
+                # lb -> prefill -> decode.
+                ctx = tracing.parse_header(
+                    self.headers.get(tracing.HEADER))
+                with tracing.span('replica.request', ctx,
+                                  process=state.role or 'replica',
+                                  path=self.path) as root:
+                    self._trace_ctx = root.ctx
+                    if self.path == '/kv/import':
+                        self._kv_import()
+                    else:
+                        self._generate()
             except _StubDied:
                 # Crash simulation: the connection just breaks —
                 # the client sees a reset/truncation, as with a
@@ -325,12 +346,18 @@ def make_stub_server(port: int, *, seed: int = 0, page_size: int = 16,
             if key is not None and len(peers) > 1:
                 idx = int.from_bytes(bytes.fromhex(key)[:4], 'big')
                 peer = peers[idx % len(peers)]
+            ctx = getattr(self, '_trace_ctx', None)
+            hdrs = ({tracing.HEADER: tracing.format_header(ctx)}
+                    if ctx is not None else None)
             try:
-                upstream = requests_lib.post(
-                    f'http://{peer}/kv/import',
-                    json={'keys': [k.hex() for k in keys],
-                          'request': req},
-                    stream=True, timeout=(2.0, 600.0))
+                with tracing.span('kv.post', ctx, peer=peer,
+                                  pages=len(keys)):
+                    upstream = requests_lib.post(
+                        f'http://{peer}/kv/import',
+                        json={'keys': [k.hex() for k in keys],
+                              'request': req},
+                        headers=hdrs,
+                        stream=True, timeout=(2.0, 600.0))
                 if upstream.status_code >= 429:
                     upstream.close()
                     raise RuntimeError(

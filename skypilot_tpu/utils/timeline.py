@@ -17,6 +17,7 @@ from typing import Any, Callable, List, Optional, Union
 _events: List[dict] = []
 _lock = threading.Lock()
 _enabled_path: Optional[str] = None
+_saved = False  # a save() happened and no event has landed since
 
 
 def _init() -> None:
@@ -54,10 +55,24 @@ class Event:
         return self
 
     def __exit__(self, *args) -> None:
+        global _saved
         if _enabled_path is None:
             return
         end = time.perf_counter()
         with _lock:
+            if _saved:
+                # The buffer was flushed by an explicit save(); keep
+                # collecting into a fresh trace (a later save()
+                # rewrites the file) but say so once — callers that
+                # meant to stop tracing should have cleared the env /
+                # not re-entered Event.
+                _saved = False
+                from skypilot_tpu.utils import ux_utils
+                ux_utils.log(
+                    f'timeline: events recorded after save(); '
+                    f'starting a fresh trace buffer for '
+                    f'{_enabled_path} (the next save() overwrites '
+                    f'it).')
             _events.append({
                 'name': self._name,
                 'cat': 'skypilot_tpu',
@@ -91,12 +106,20 @@ def event(fn_or_name: Union[Callable, str]) -> Callable:
 
 
 def save() -> None:
+    """Flush collected events to the trace file and clear the
+    buffer, so the module is cleanly reusable (a second enable()/
+    save() cycle writes a fresh trace instead of duplicating the
+    first one). Events recorded after a save() log one warning and
+    start the next buffer — they are no longer silently stranded."""
+    global _saved
     if _enabled_path is None or not _events:
         return
     path = os.path.expanduser(_enabled_path)
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     with _lock:
         payload = {'traceEvents': list(_events)}
+        _events.clear()
+        _saved = True
     with open(path, 'w', encoding='utf-8') as f:
         json.dump(payload, f)
 

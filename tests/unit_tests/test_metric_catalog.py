@@ -59,6 +59,34 @@ def test_label_names_are_snake_case():
             assert _SNAKE.match(label), f'{name} label {label!r}'
 
 
+def test_slo_metrics_documented_and_set_in_tree():
+    """The `skypilot_serving_slo_*` family must be real: every row is
+    in the docs table (the generic check covers that too, but a
+    missing row should name THIS family) and every row is actually
+    set/incremented by non-catalog code — a catalog-only orphan gauge
+    would scrape as permanently absent."""
+    slo_rows = sorted(n for n in catalog.SPECS
+                      if n.startswith('skypilot_serving_slo_'))
+    assert slo_rows, 'the SLO metric family is gone from the catalog'
+    documented = _docs_table_names()
+    missing = [n for n in slo_rows if n not in documented]
+    assert not missing, (
+        f'SLO metrics missing from the docs table: {missing}')
+    pkg = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       '..', '..', 'skypilot_tpu')
+    sources = []
+    for dirpath, _dirnames, filenames in os.walk(pkg):
+        for fn in filenames:
+            if fn.endswith('.py') and fn != 'catalog.py':
+                with open(os.path.join(dirpath, fn), 'r',
+                          encoding='utf-8') as f:
+                    sources.append(f.read())
+    tree = '\n'.join(sources)
+    orphans = [n for n in slo_rows if n not in tree]
+    assert not orphans, (
+        f'cataloged SLO metrics never set by any code: {orphans}')
+
+
 def test_registry_contains_only_cataloged_skypilot_metrics():
     """Ad-hoc families must not sneak into the default registry under
     the skypilot_ prefix without a catalog row (test-local registries

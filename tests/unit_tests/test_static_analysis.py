@@ -1,4 +1,4 @@
-"""`stpu check` static-analysis suite: framework + the five SKY rules.
+"""`stpu check` static-analysis suite: framework + the SKY rules.
 
 Three layers:
   1. fixture snippets asserting EXACT (rule, line) findings per rule;
@@ -351,6 +351,94 @@ def test_sky006_repo_kernels_thread_interpret():
 
 
 # ---------------------------------------------------------------------------
+# SKY007: span discipline
+# ---------------------------------------------------------------------------
+def test_sky007_flags_leaked_spans():
+    src = '''\
+from skypilot_tpu.observability import tracing
+
+def leak(ctx):
+    tracing.span('a', ctx)
+    sp = tracing.start_span('b', ctx)
+    sp.end()
+
+def attr(self, ctx):
+    self.sp = tracing.span('c', ctx)
+'''
+    # line 4: result discarded; line 5: .end() not under a finally;
+    # line 9: stored onto an object (close unverifiable).
+    assert rules_lines(src, select=['SKY007']) == [
+        ('SKY007', 4), ('SKY007', 5), ('SKY007', 9)]
+
+
+def test_sky007_clean_forms():
+    src = '''\
+from skypilot_tpu.observability import tracing
+
+def ok(ctx):
+    with tracing.span('a', ctx):
+        pass
+    sp = tracing.start_span('b', ctx)
+    try:
+        pass
+    finally:
+        sp.end(status=1)
+    tracing.record_span('c', ctx, 0.1)
+
+def factory(ctx):
+    sp = tracing.start_span('d', ctx)
+    return sp
+
+def handoff(ctx, consume):
+    sp = tracing.span('e', ctx)
+    consume(sp)
+'''
+    assert rules_lines(src, select=['SKY007']) == []
+
+
+def test_sky007_direct_imports_and_aliases():
+    src = '''\
+from skypilot_tpu.observability.tracing import span, start_span
+
+def leak(ctx):
+    span('a', ctx)
+    s2 = start_span('b', ctx)
+'''
+    assert rules_lines(src, select=['SKY007']) == [
+        ('SKY007', 4), ('SKY007', 5)]
+    # Unrelated functions that happen to be named `span` are not
+    # the tracing API.
+    clean = '''\
+def span(x):
+    return x
+
+def f():
+    span(1)
+'''
+    assert rules_lines(clean, select=['SKY007']) == []
+
+
+def test_sky007_tests_are_exempt():
+    src = '''\
+from skypilot_tpu.observability import tracing
+
+def leak(ctx):
+    tracing.span('a', ctx)
+'''
+    assert rules_lines(src, 'tests/unit_tests/t.py',
+                       ['SKY007']) == []
+    assert rules_lines(src, 'pkg/test_x.py', ['SKY007']) == []
+
+
+def test_sky007_serving_plane_is_clean():
+    """The tracing wiring this rule polices (LB, HTTP server, engine,
+    stub) must satisfy its own contract — zero SKY007 findings."""
+    findings = analysis.run_paths(
+        [os.path.join(REPO_ROOT, 'skypilot_tpu')], ['SKY007'])
+    assert findings == [], '\n'.join(f.render() for f in findings)
+
+
+# ---------------------------------------------------------------------------
 # framework: suppressions, baseline, select, reporters
 # ---------------------------------------------------------------------------
 def test_suppression_comment_exact_rule():
@@ -369,7 +457,7 @@ def test_select_unknown_rule_raises():
     with pytest.raises(ValueError, match='SKY999'):
         analysis.resolve_select('SKY999')
     assert analysis.resolve_select('sky001') == {'SKY001'}
-    assert len(analysis.resolve_select(None)) == 6
+    assert len(analysis.resolve_select(None)) == 7
 
 
 def test_syntax_error_reported_not_crashed():

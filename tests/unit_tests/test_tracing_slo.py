@@ -1,0 +1,580 @@
+"""Tier-1 coverage for the serving-plane observability layer (PR 17):
+
+  - distributed tracing (observability/tracing.py): header
+    round-trip, deterministic head sampling under a fixed seed, span
+    parenting, Chrome-trace shape, and the real propagation chain —
+    an in-process disaggregated stub fleet where one request's
+    trace_id crosses LB -> prefill -> decode over `x-skypilot-trace`
+    and merges into one timeline with per-role process rows;
+  - the engine flight recorder (observability/flight.py): ring
+    wraparound with absolute sequence numbers, snapshot files, and
+    the injected decode-poison -> 3-strike -> reset escalation
+    appearing in the dump with victim slots;
+  - SLO accounting (observability/slo.py): burn-rate window edges,
+    per-dimension denominators, clock restarts, and the bench-side
+    `evaluate` contract (an unmeasured targeted dimension fails).
+
+Everything runs on CPU with stubs or the tiny llama engine.
+"""
+import glob
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from skypilot_tpu.observability import flight as flight_lib
+from skypilot_tpu.observability import slo as slo_lib
+from skypilot_tpu.observability import tracing
+
+
+@pytest.fixture()
+def clean_tracing():
+    """Tracing state is module-global (it models a process); reset
+    around every test so sampling/config never leaks."""
+    tracing.reset()
+    yield
+    tracing.reset()
+
+
+# ---------------------------------------------------------------------------
+# tracing core: header, sampling, spans
+# ---------------------------------------------------------------------------
+def test_header_roundtrip_and_malformed(clean_tracing):
+    ctx = tracing.Ctx('00ff00ff00ff00ff', 'abcd1234')
+    parsed = tracing.parse_header(tracing.format_header(ctx))
+    assert (parsed.trace_id, parsed.span_id) == \
+        (ctx.trace_id, ctx.span_id)
+    for bad in (None, '', 'garbage', 'a:b', 'a:b:c:d',
+                'tid:sid:0',   # unsampled flag -> no tracing
+                ':sid:1', 'tid:sid:x'):
+        assert tracing.parse_header(bad) is None, bad
+
+
+def test_head_sampling_deterministic_under_fixed_seed(clean_tracing):
+    def draw(n=64):
+        tracing.configure(sample=0.5, seed=1234, process='t')
+        out = []
+        for _ in range(n):
+            ctx = tracing.new_ctx()
+            out.append(ctx.trace_id if ctx is not None else None)
+        return out
+
+    a, b = draw(), draw()
+    assert a == b                       # decisions AND ids reproduce
+    sampled = [x for x in a if x is not None]
+    assert sampled and len(sampled) < len(a)  # neither 0% nor 100%
+    tracing.configure(sample=0.5, seed=4321)  # different seed
+    c = [getattr(tracing.new_ctx(), 'trace_id', None)
+         for _ in range(64)]
+    assert c != a
+
+
+def test_sampling_off_is_noop_and_free(clean_tracing):
+    tracing.configure(sample=0.0)
+    assert tracing.new_ctx() is None
+    assert not tracing.enabled()
+    sp = tracing.span('x', None)
+    assert sp is tracing.NOOP and sp.ctx is None
+    sp.add(k=1)
+    sp.end()
+    tracing.record_span('x', None, 0.5)
+    assert tracing.trace_ids() == []
+
+
+def test_span_parenting_shape_and_record_span(clean_tracing):
+    tracing.configure(sample=1.0, seed=0, process='proc0')
+    ctx = tracing.new_ctx()
+    with tracing.span('root', ctx, path='/x') as root:
+        assert root.ctx.trace_id == ctx.trace_id
+        assert root.ctx.span_id != ctx.span_id
+        with tracing.span('child', root.ctx, process='proc1'):
+            pass
+        tracing.record_span('measured', root.ctx, dur_s=0.25,
+                            slot=3)
+    body = tracing.get_trace(ctx.trace_id)
+    by_name = {e['name']: e for e in body['traceEvents']}
+    assert set(by_name) == {'root', 'child', 'measured'}
+    for ev in by_name.values():   # timeline.py-compatible shape
+        assert ev['ph'] == 'X' and ev['cat'] == 'skypilot_tpu'
+        assert ev['dur'] >= 0 and ev['ts'] > 0
+        assert ev['args']['trace_id'] == ctx.trace_id
+    assert by_name['child']['args']['parent_id'] == \
+        by_name['root']['args']['span_id']
+    assert by_name['measured']['args']['parent_id'] == \
+        by_name['root']['args']['span_id']
+    # per-span process override beats the configured default
+    assert by_name['root']['pid'] == 'proc0'
+    assert by_name['child']['pid'] == 'proc1'
+    # record_span backdates: ~0.25s duration, ends ~now
+    assert by_name['measured']['dur'] == pytest.approx(0.25e6,
+                                                       rel=0.05)
+    assert by_name['measured']['args']['slot'] == 3
+
+
+def test_span_exit_records_error_name(clean_tracing):
+    tracing.configure(sample=1.0, seed=0)
+    ctx = tracing.new_ctx()
+    with pytest.raises(ValueError):
+        with tracing.span('boom', ctx):
+            raise ValueError('nope')
+    ev = tracing.get_trace(ctx.trace_id)['traceEvents'][0]
+    assert ev['args']['error'] == 'ValueError'
+
+
+def test_merge_traces_dedups_and_sorts(clean_tracing):
+    def ev(sid, ts, name='n'):
+        return {'name': name, 'ts': ts, 'ph': 'X',
+                'args': {'span_id': sid}}
+
+    a = {'traceEvents': [ev('s1', 30.0), ev('s2', 10.0)]}
+    b = {'traceEvents': [ev('s1', 30.0), ev('s3', 20.0)]}
+    merged = tracing.merge_traces([a, b, None])
+    assert [e['args']['span_id'] for e in merged['traceEvents']] == \
+        ['s2', 's3', 's1']
+
+
+def test_trace_store_is_lru_bounded(clean_tracing):
+    tracing.configure(sample=1.0, seed=0)
+    first = tracing.new_ctx()
+    tracing.span('s', first).end()
+    for _ in range(tracing.MAX_TRACES):
+        tracing.span('s', tracing.new_ctx()).end()
+    ids = tracing.trace_ids()
+    assert len(ids) == tracing.MAX_TRACES
+    assert first.trace_id not in ids  # oldest evicted
+
+
+# ---------------------------------------------------------------------------
+# one request, one trace_id, three process rows (LB/prefill/decode)
+# ---------------------------------------------------------------------------
+def _disagg_stub_fleet(trace_sample=1.0, slo_targets=None,
+                       threshold=64):
+    from skypilot_tpu.serve import autoscalers
+    from skypilot_tpu.serve import load_balancing_policies as lbp
+    from skypilot_tpu.serve import service_spec as spec_lib
+    from skypilot_tpu.serve.replica_plane import (FleetController,
+                                                  PrefillPool,
+                                                  ReplicaManager,
+                                                  make_lb_server)
+    from skypilot_tpu.serve.replica_plane.stub import \
+        in_process_stub_factory
+    factory = in_process_stub_factory(cache_pages=512,
+                                      token_sleep_s=0.0)
+    policy = lbp.PrefixAffinityPolicy()
+    pool = PrefillPool()
+    manager = ReplicaManager(factory, drain_grace_s=5.0)
+    controller = FleetController(
+        manager, policy,
+        autoscalers.EngineMetricsAutoscaler(
+            spec_lib.SkyServiceSpec(min_replicas=2, max_replicas=2)),
+        interval_s=0.2,
+        prefill_autoscaler=autoscalers.EngineMetricsAutoscaler(
+            spec_lib.SkyServiceSpec(min_replicas=1, max_replicas=1)),
+        prefill_pool=pool)
+    lb = make_lb_server(policy, 0, policy_name='prefix_affinity',
+                        manager=manager, disagg_threshold=threshold,
+                        prefill_pool=pool, trace_sample=trace_sample,
+                        trace_seed=7, slo_targets=slo_targets)
+    threading.Thread(target=lb.serve_forever, daemon=True).start()
+    for _ in range(2):
+        manager.spawn(role='decode')
+    manager.spawn(role='prefill')
+    assert controller.wait_ready(3, timeout_s=60)
+    controller.tick()   # push roles + decode peers
+    url = f'http://127.0.0.1:{lb.server_address[1]}'
+    return url, controller, manager, lb
+
+
+def _post(url, path, body, timeout=60):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(body).encode(),
+        headers={'Content-Type': 'application/json'})
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def test_disagg_fleet_one_trace_id_across_three_processes(
+        clean_tracing):
+    """The acceptance path: a long-prompt request through the
+    disaggregated stub fleet produces ONE trace whose spans carry
+    lb, prefill, and decode process rows — propagated over the
+    `x-skypilot-trace` header at both hops (LB->prefill and the
+    prefill stub's handoff POST to its decode peer), fetched back
+    via each node's /debug/trace, and merged `stpu trace`-style."""
+    url, controller, manager, lb = _disagg_stub_fleet()
+    try:
+        long_prompt = list(range(2, 202))   # >= threshold -> disagg
+        assert _post(url, '/generate',
+                     {'tokens': [long_prompt],
+                      'max_new_tokens': 4}).status == 200
+        ids = tracing.trace_ids()
+        assert len(ids) == 1    # sample=1.0: exactly this request
+        tid = ids[0]
+
+        # Per-node /debug/trace (the in-process fleet shares one
+        # store; the endpoint surface is what `stpu trace` scrapes).
+        bodies = []
+        endpoints = [url] + [f'http://{v.endpoint}'
+                             for v in manager.views()]
+        for base in endpoints:
+            bodies.append(json.loads(urllib.request.urlopen(
+                f'{base}/debug/trace/{tid}', timeout=10).read()))
+        merged = tracing.merge_traces(bodies)
+        events = merged['traceEvents']
+        assert events and all(
+            e['args']['trace_id'] == tid for e in events)
+        # dedup worked: merging N identical bodies adds nothing
+        assert len(events) == len(bodies[0]['traceEvents'])
+        names = {e['name'] for e in events}
+        assert {'lb.request', 'lb.route', 'replica.request',
+                'kv.post'} <= names
+        procs = {e['pid'] for e in events}
+        assert {'lb', 'prefill', 'decode'} <= procs
+        # the merge is a timeline: sorted by wall-clock ts
+        ts = [e['ts'] for e in events]
+        assert ts == sorted(ts)
+        # child spans point back into the same trace
+        roots = [e for e in events if e['name'] == 'lb.request']
+        assert len(roots) == 1
+        # unknown id -> 404 with the known-ids hint
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f'{url}/debug/trace/deadbeef',
+                                   timeout=10)
+        assert err.value.code == 404
+
+        # `stpu trace` does the same fetch+merge end to end.
+        import tempfile
+
+        from click.testing import CliRunner
+
+        from skypilot_tpu.client.cli import cli as stpu_cli
+        with tempfile.TemporaryDirectory() as td:
+            out = os.path.join(td, 'merged.json')
+            argv = ['trace', tid, '-o', out]
+            for base in endpoints:
+                argv += ['-e', base]
+            res = CliRunner().invoke(stpu_cli, argv)
+            assert res.exit_code == 0, res.output
+            saved = json.loads(open(out, encoding='utf-8').read())
+            assert len(saved['traceEvents']) == len(events)
+    finally:
+        controller.shutdown()
+        lb.shutdown()
+
+
+def test_fleet_unsampled_requests_trace_nothing(clean_tracing):
+    url, controller, manager, lb = _disagg_stub_fleet(
+        trace_sample=0.0,
+        slo_targets={'p99_ttft_ms': 5000.0, 'error_rate': 0.1})
+    try:
+        assert _post(url, '/generate',
+                     {'tokens': [list(range(2, 202))],
+                      'max_new_tokens': 2}).status == 200
+        assert tracing.trace_ids() == []
+        # ... but the SLO section still accounts the request
+        status = json.loads(urllib.request.urlopen(
+            url + '/fleet/status', timeout=10).read())
+        slo = status['slo']
+        assert slo['targets'] == {'p99_ttft_ms': 5000.0,
+                                  'error_rate': 0.1}
+        windows = slo['windows']
+        assert any(w['requests'] >= 1 for w in windows.values())
+        assert slo['ok'] is True
+    finally:
+        controller.shutdown()
+        lb.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# flight recorder ring
+# ---------------------------------------------------------------------------
+def test_flight_ring_wraparound_keeps_absolute_seq():
+    fr = flight_lib.FlightRecorder(capacity=8, name='t')
+    for i in range(20):
+        fr.record('tick', i=i)
+    events = fr.events()
+    assert len(events) == 8
+    assert [e['seq'] for e in events] == list(range(12, 20))
+    assert [e['i'] for e in events] == list(range(12, 20))
+    dump = fr.dump()
+    assert dump['recorded'] == 20
+    assert dump['dropped'] == 12
+    assert dump['capacity'] == 8
+    assert dump['name'] == 't'
+
+
+def test_flight_under_capacity_drops_nothing():
+    fr = flight_lib.FlightRecorder(capacity=8)
+    fr.record('a')
+    fr.record('b', slot=1)
+    dump = fr.dump()
+    assert dump['dropped'] == 0
+    assert [e['kind'] for e in dump['events']] == ['a', 'b']
+    assert dump['events'][1]['slot'] == 1
+    with pytest.raises(ValueError):
+        flight_lib.FlightRecorder(capacity=0)
+
+
+def test_flight_snapshot_writes_json(tmp_path, monkeypatch):
+    monkeypatch.setenv('STPU_FLIGHT_DIR', str(tmp_path))
+    fr = flight_lib.FlightRecorder(capacity=4, name='snap')
+    for i in range(6):
+        fr.record('ev', i=i)
+    path = fr.snapshot('reset')
+    assert path and os.path.exists(path)
+    body = json.loads(open(path, encoding='utf-8').read())
+    assert body['reason'] == 'reset'
+    assert body['dropped'] == 2
+    assert [e['seq'] for e in body['events']] == [2, 3, 4, 5]
+
+
+# ---------------------------------------------------------------------------
+# engine: injected decode poison -> 3-strike escalation in the dump
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope='module')
+def tiny_model():
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    from skypilot_tpu.models.llama import Llama, LlamaConfig
+    model = Llama(LlamaConfig.tiny(kv_page_size=8, kv_total_pages=40))
+    params = nn.meta.unbox(model.init(
+        jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))['params'])
+    return model, params
+
+
+def test_decode_poison_three_strikes_escalate_in_flight_dump(
+        tiny_model, tmp_path, monkeypatch):
+    """ISSUE acceptance: a fault-plan decode poison shows up in
+    /debug/flight's dump — per-strike soft_error events naming the
+    victim slot, then the strike-3 reset — and the reset snapshots
+    the ring to a postmortem file."""
+    from skypilot_tpu.models.batching import ContinuousBatchingEngine
+    from skypilot_tpu.robustness import faults
+    monkeypatch.setenv('STPU_FLIGHT_DIR', str(tmp_path))
+    model, params = tiny_model
+    eng = ContinuousBatchingEngine(model, params, num_slots=2,
+                                   max_total_len=64)
+    try:
+        # A clean request first: the recorder is always on, so the
+        # ordinary admit/chunk_dispatch/round_commit lifecycle lands.
+        eng.submit([5, 6, 7], max_new_tokens=4).result(timeout=120)
+        kinds = [e['kind'] for e in eng.flight.events()]
+        assert 'admit' in kinds
+        assert 'round_commit' in kinds
+
+        faults.install_plan({'rules': [
+            {'point': 'engine.decode_step', 'action': 'raise',
+             'exc': 'RuntimeError', 'message': 'poison step',
+             'times': 3}]})
+        doomed = eng.submit([1, 2, 3, 4], max_new_tokens=8)
+        with pytest.raises(Exception):
+            doomed.result(timeout=120)
+        faults.clear()
+
+        events = eng.flight.events()
+        softs = [e for e in events if e['kind'] == 'soft_error']
+        assert [e['strikes'] for e in softs] == [1, 2, 3]
+        victim_slots = set()
+        for e in softs:
+            assert e['error'] == 'RuntimeError'
+            assert e['slots'], 'soft_error must name victim slots'
+            victim_slots.update(e['slots'])
+        resets = [e for e in events if e['kind'] == 'reset']
+        assert len(resets) == 1
+        assert resets[0]['strikes'] == 3
+        assert set(resets[0]['slots']) == victim_slots
+        assert eng.engine_restarts == 1
+
+        # The reset snapshotted the ring to STPU_FLIGHT_DIR.
+        files = glob.glob(str(tmp_path / 'stpu-flight-*reset*.json'))
+        assert files, 'reset must write a flight snapshot file'
+        body = json.loads(open(files[0], encoding='utf-8').read())
+        assert body['reason'] == 'reset'
+        assert any(e['kind'] == 'soft_error'
+                   for e in body['events'])
+
+        # Crash-only: the engine keeps serving after the reset.
+        assert eng.healthy()
+        out = eng.submit([5, 6, 7], max_new_tokens=4).result(
+            timeout=120)
+        assert out
+    finally:
+        faults.clear()
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate math
+# ---------------------------------------------------------------------------
+def _tracker(targets, **kw):
+    clock = {'t': 10_000.0}
+    kw.setdefault('windows', (60.0, 600.0))
+    kw.setdefault('publish', False)
+    tr = slo_lib.SloTracker(targets, clock=lambda: clock['t'], **kw)
+    return tr, clock
+
+
+def test_parse_slo_spec_and_errors():
+    assert slo_lib.parse_slo(' p99_ttft_ms=500, error_rate=0.01 ') \
+        == {'p99_ttft_ms': 500.0, 'error_rate': 0.01}
+    for bad in ('', 'p99_ttft_ms', 'nope=1', 'p99_ttft_ms=x',
+                'p99_ttft_ms=-1', 'error_rate=2'):
+        with pytest.raises(ValueError):
+            slo_lib.parse_slo(bad)
+
+
+def test_burn_rate_window_edges():
+    """Bucket inclusion is `lo < idx <= hi`: an event exactly
+    window seconds ago is OUT, one bucket later is IN."""
+    tr, clock = _tracker({'error_rate': 0.01}, bucket_s=5.0)
+    tr.record_request(error=True)            # t=10_000, idx=2000
+    # 9 good requests in the same bucket -> 10% bad, burn 10x
+    for _ in range(9):
+        tr.record_request()
+    assert tr.burn_rate('error_rate', 60.0) == pytest.approx(10.0)
+    # Advance so the bad bucket sits exactly at the 60s edge:
+    # hi = idx+12, lo = idx, lo < idx is False -> excluded.
+    clock['t'] = 10_000.0 + 60.0
+    assert tr.burn_rate('error_rate', 60.0) == 0.0
+    # One bucket earlier it was still included.
+    clock['t'] = 10_000.0 + 55.0
+    assert tr.burn_rate('error_rate', 60.0) == pytest.approx(10.0)
+    # The slow window still sees it either way.
+    clock['t'] = 10_000.0 + 60.0
+    assert tr.burn_rate('error_rate', 600.0) == pytest.approx(10.0)
+
+
+def test_burn_rate_denominators_per_dimension():
+    targets = {'shed_rate': 0.1, 'error_rate': 0.1,
+               'p99_itl_ms': 50.0}
+    tr, _ = _tracker(targets)
+    # 8 completed (1 error) + 2 shed = 10 offered
+    tr.record_request(error=True)
+    for _ in range(7):
+        tr.record_request()
+    tr.record_request(shed=True)
+    tr.record_request(shed=True)
+    # 5 ITL gaps, 1 over target
+    for gap in (10.0, 10.0, 10.0, 10.0, 80.0):
+        tr.record_itl(gap)
+    # shed: 2/10 offered / 0.1 budget = 2x
+    assert tr.burn_rate('shed_rate', 60.0) == pytest.approx(2.0)
+    # error: 1/8 completed / 0.1 = 1.25x (shed not in denominator)
+    assert tr.burn_rate('error_rate', 60.0) == pytest.approx(1.25)
+    # itl: 1/5 gaps / 0.01 p99 budget = 20x (gap count, not requests)
+    assert tr.burn_rate('p99_itl_ms', 60.0) == pytest.approx(20.0)
+
+
+def test_ttft_burns_against_p99_budget():
+    tr, _ = _tracker({'p99_ttft_ms': 100.0})
+    for _ in range(99):
+        tr.record_request(ttft_ms=50.0)
+    tr.record_request(ttft_ms=500.0)
+    # 1/100 slow at a 1% budget: exactly on budget.
+    assert tr.burn_rate('p99_ttft_ms', 60.0) == pytest.approx(1.0)
+    snap = tr.snapshot()
+    assert snap['ok'] is True          # burn > 1.0 flips it, not ==
+    assert snap['budget_remaining']['p99_ttft_ms'] == \
+        pytest.approx(0.0)
+
+
+def test_clock_restart_and_empty_windows_are_safe():
+    """A monotonic-clock restart (process restart reusing the
+    tracker's math) or long idle gap must never produce negative
+    burn or resurrect stale buckets."""
+    tr, clock = _tracker({'error_rate': 0.01}, bucket_s=5.0)
+    tr.record_request(error=True)
+    # Clock jumps far forward: every bucket falls out of range and
+    # its ring slot is lazily reused; totals stay untouched.
+    clock['t'] = 10_000.0 + 7 * 24 * 3600.0
+    assert tr.burn_rate('error_rate', 60.0) == 0.0
+    assert tr.burn_rate('error_rate', 600.0) == 0.0
+    tr.record_request(error=True)
+    assert tr.burn_rate('error_rate', 60.0) == pytest.approx(100.0)
+    # Clock jumps BACKWARD (restart at 0): writes land in fresh
+    # buckets; nothing crashes, windows read consistently.
+    clock['t'] = 3.0
+    tr.record_request()
+    assert tr.burn_rate('error_rate', 60.0) == 0.0
+    snap = tr.snapshot()
+    assert snap['bad_total']['error_rate'] == 2  # lifetime counter
+
+
+def test_snapshot_shape_ok_flag_and_gauges():
+    tr, _ = _tracker({'error_rate': 0.01})
+    for _ in range(4):
+        tr.record_request(error=True)
+    snap = tr.snapshot()
+    assert snap['ok'] is False      # 100% errors >> 1% budget
+    assert set(snap['windows']) == {'60s', '600s'}
+    w = snap['windows']['600s']
+    assert w['requests'] == 4 and w['offered'] == 4
+    assert w['dimensions']['error_rate']['bad'] == 4
+    assert snap['budget_remaining']['error_rate'] == 0.0
+    assert snap['targets'] == {'error_rate': 0.01}
+
+
+def test_evaluate_scores_and_missing_observation_fails():
+    targets = {'p99_ttft_ms': 500.0, 'error_rate': 0.01}
+    out = slo_lib.evaluate(targets, {'p99_ttft_ms': 250.0,
+                                     'error_rate': 0.02})
+    by_dim = {r['dimension']: r for r in out['results']}
+    assert by_dim['p99_ttft_ms']['ok'] is True
+    assert by_dim['p99_ttft_ms']['budget_consumed'] == 0.5
+    assert by_dim['error_rate']['ok'] is False
+    assert out['ok'] is False
+    assert out['budget_consumed'] == 2.0    # worst dimension
+    # Unmeasured targeted dimension: a broken promise, not a pass.
+    out = slo_lib.evaluate(targets, {'p99_ttft_ms': 250.0})
+    assert out['ok'] is False
+    by_dim = {r['dimension']: r for r in out['results']}
+    assert by_dim['error_rate']['observed'] is None
+    assert by_dim['error_rate']['ok'] is False
+
+
+def test_serve_bench_attach_slo_maps_record_keys():
+    """The bench-side mapping: engine ITL beats SSE fallback, 504s
+    fold into the error rate, shed_rate uses offered, and A/B `runs`
+    maps get per-run verdicts plus a rollup."""
+    import importlib.util
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    spec = importlib.util.spec_from_file_location(
+        'serve_bench_for_test',
+        os.path.join(repo, 'benchmarks', 'serve_bench.py'))
+    sb = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sb)
+
+    targets = slo_lib.parse_slo(
+        'p99_ttft_ms=500,p99_itl_ms=80,error_rate=0.01,'
+        'shed_rate=0.05')
+    fleet = {'requests': 100, 'client_errors': 1, 'shed_requests': 5,
+             'p99_ttft_ms': 450.0, 'decode_itl_ms_p99': 60.0,
+             'sse_itl_ms_p99': 999.0}
+    sb.attach_slo(fleet, targets)
+    by_dim = {r['dimension']: r for r in fleet['slo']['results']}
+    assert by_dim['p99_itl_ms']['observed'] == 60.0  # engine-side
+    assert by_dim['error_rate']['observed'] == 0.01
+    assert by_dim['shed_rate']['observed'] == \
+        pytest.approx(5 / 105, abs=1e-4)
+    assert fleet['slo']['ok'] is True
+
+    single = {'requests': 64, 'shed_requests': 0,
+              'server_deadline_exceeded': 2, 'p99_ttft_ms': 700.0,
+              'itl_ms_p99': 90.0}
+    sb.attach_slo(single, targets)
+    by_dim = {r['dimension']: r for r in single['slo']['results']}
+    assert by_dim['error_rate']['observed'] == \
+        pytest.approx(2 / 64, abs=1e-4)
+    assert by_dim['p99_itl_ms']['observed'] == 90.0
+    assert single['slo']['ok'] is False
+
+    ab = {'runs': {'good': dict(fleet), 'bad': dict(single)}}
+    sb.attach_slo(ab, targets)
+    assert ab['slo']['ok'] is False
+    assert ab['slo']['runs'] == {'good': True, 'bad': False}
