@@ -7,6 +7,13 @@ Rules:
   SKY004  metric-name hygiene: names must come from the catalog
   SKY005  swallowed exceptions in control planes
   SKY006  pallas_call must be reachable with interpret=True
+  SKY007  span discipline on traced control planes
+  SKY008  thread ownership: role-owned state touched cross-thread
+          (call-graph verified; grammar in analysis/callgraph.py)
+  SKY009  donation discipline: donated args referenced after
+          dispatch; unpinned donating engine jits
+  SKY010  fault-point drift: fire sites vs KNOWN_POINTS vs the
+          internals §11 table
 
 See docs/internals.md §10 for the rule book and suppression story.
 """
@@ -16,6 +23,7 @@ from skypilot_tpu.analysis.core import (
     DEFAULT_BASELINE,
     Finding,
     all_checkers,
+    checker_versions,
     register,
     render_json,
     render_text,
@@ -27,6 +35,6 @@ from skypilot_tpu.analysis.core import (
 
 __all__ = [
     'Baseline', 'Checker', 'DEFAULT_BASELINE', 'Finding', 'all_checkers',
-    'register', 'render_json', 'render_text', 'resolve_select',
-    'run_file', 'run_paths', 'run_source',
+    'checker_versions', 'register', 'render_json', 'render_text',
+    'resolve_select', 'run_file', 'run_paths', 'run_source',
 ]

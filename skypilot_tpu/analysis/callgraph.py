@@ -1,0 +1,451 @@
+"""Module-level call graph + thread-ownership symbol table.
+
+The serving engine's correctness story rests on OWNERSHIP, not locks:
+"only the scheduler thread touches the donated cache and the slot
+arrays" used to be 74 hand-justified baseline rows. This module turns
+it into a machine-checked property. It builds an AST call graph for
+one module (methods, nested functions, thread targets, executor
+submits, HTTP handlers), seeds each entry point with a *role*, and
+propagates roles to every reachable function — so a checker can ask
+"which threads can execute this statement?".
+
+Roles are small strings naming a thread class (the repo's canon:
+``scheduler``, ``http``, ``control-queue``, ``watcher``, ``lb``).
+Two pseudo-roles exist:
+
+  ``init``  construction (`__init__`/`__new__`/`__del__`): runs
+            happens-before sharing, exempt from ownership checks.
+  ``*``     ANY — the conservative unknown. A public function with no
+            annotated entry role, an unreached private function, or a
+            function whose reference ESCAPES (passed to an
+            unresolvable callee, stored on an object) is callable
+            from any thread.
+
+The ownership grammar (all machine-read, all grep-able):
+
+  class-level map     ``_STPU_OWNERS = {'cache': 'scheduler!', ...}``
+                      attribute -> owning role; a trailing ``!``
+                      makes ownership STRICT (cross-role READS are
+                      violations too — the donated-cache case, where
+                      even a read races the dispatch that consumes
+                      the buffer).
+  owner comment       ``self.x = ...  # stpu: owner[scheduler]`` on
+                      an ``__init__`` assignment (same meaning,
+                      per-attribute form).
+  thread role         ``threading.Thread(target=self._loop)
+                      # stpu: thread[scheduler]`` names the role of
+                      the spawned thread; unannotated targets get the
+                      anonymous role ``thread:<name>``.
+  entry role          ``def record(...):  # stpu: entry[scheduler]``
+                      declares a cross-module contract: "callers
+                      invoke this on the scheduler thread only".
+  hop                 ``def run_on_scheduler(self, fn):
+                      # stpu: hop[scheduler]`` — a function passed TO
+                      a hop executes under the hop's role (the
+                      control-queue pattern: the op runs between
+                      decode rounds on the owner thread, regardless
+                      of which thread enqueued it).
+  role comment        ``cb=self._fetch  # stpu: role[scheduler]`` —
+                      a function reference consumed on this line runs
+                      under the named role (callback registrations
+                      whose consumer is known to be role-bound),
+                      instead of escaping to ANY.
+
+Unknown-callee conservatism: a call that cannot be resolved taints
+every known-function argument to ANY — `helper(self._m)` means `_m`
+may run anywhere, so ownership violations inside it fire unless a
+``role[...]`` comment pins the registration.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+ANY = '*'
+INIT_ROLE = 'init'
+
+_CONSTRUCTORS = {'__init__', '__new__', '__del__', '__post_init__'}
+
+_ANN_RE = re.compile(
+    r'#\s*stpu:\s*(owner|thread|entry|hop|role)\['
+    r'\s*([A-Za-z0-9_:!\-]+)\s*\]')
+
+# HTTP handler conventions: http.server-style do_VERB methods, and
+# decorator-registered aiohttp/flask-style routes.
+_DO_VERB_RE = re.compile(r'^do_[A-Z]+$')
+_ROUTE_DECORATORS = {'get', 'post', 'put', 'delete', 'patch', 'head',
+                     'route', 'view'}
+
+
+@dataclasses.dataclass(frozen=True)
+class OwnerSpec:
+    """One owned attribute: role + whether reads are policed too."""
+    attr: str
+    role: str
+    strict: bool
+    line: int
+
+
+def parse_role(spec: str) -> Tuple[str, bool]:
+    """'scheduler!' -> ('scheduler', strict=True)."""
+    if spec.endswith('!'):
+        return spec[:-1], True
+    return spec, False
+
+
+def _annotations_on(lines: Sequence[str], lo: int,
+                    hi: Optional[int]) -> List[Tuple[str, str]]:
+    """(kind, value) for every `# stpu: kind[value]` on source lines
+    lo..hi (1-based, inclusive; hi None = lo)."""
+    out: List[Tuple[str, str]] = []
+    for i in range(lo, (hi or lo) + 1):
+        if 1 <= i <= len(lines):
+            out.extend(_ANN_RE.findall(lines[i - 1]))
+    return out
+
+
+def _annotation(lines: Sequence[str], node: ast.AST,
+                kind: str) -> Optional[str]:
+    for k, v in _annotations_on(lines, node.lineno,
+                                getattr(node, 'end_lineno', None)):
+        if k == kind:
+            return v
+    return None
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return '.'.join(reversed(parts))
+    return None
+
+
+def class_owned_attrs(node: ast.ClassDef,
+                      lines: Sequence[str]) -> Dict[str, OwnerSpec]:
+    """Ownership declarations of one class: the `_STPU_OWNERS` map
+    plus `# stpu: owner[...]` comments on `__init__` assignments.
+    Shared with SKY003, which exempts owner-declared attributes from
+    lock discipline (ownership IS the synchronization story)."""
+    out: Dict[str, OwnerSpec] = {}
+    for stmt in node.body:
+        if (isinstance(stmt, ast.Assign) and
+                len(stmt.targets) == 1 and
+                isinstance(stmt.targets[0], ast.Name) and
+                stmt.targets[0].id == '_STPU_OWNERS' and
+                isinstance(stmt.value, ast.Dict)):
+            for k, v in zip(stmt.value.keys, stmt.value.values):
+                if (isinstance(k, ast.Constant) and
+                        isinstance(k.value, str) and
+                        isinstance(v, ast.Constant) and
+                        isinstance(v.value, str)):
+                    role, strict = parse_role(v.value)
+                    out[k.value] = OwnerSpec(k.value, role, strict,
+                                             k.lineno)
+    for stmt in node.body:
+        if isinstance(stmt, ast.FunctionDef) and \
+                stmt.name == '__init__':
+            for sub in ast.walk(stmt):
+                if not isinstance(sub, ast.Assign):
+                    continue
+                spec = _annotation(lines, sub, 'owner')
+                if spec is None:
+                    continue
+                for target in sub.targets:
+                    if (isinstance(target, ast.Attribute) and
+                            isinstance(target.value, ast.Name) and
+                            target.value.id == 'self'):
+                        role, strict = parse_role(spec)
+                        out[target.attr] = OwnerSpec(
+                            target.attr, role, strict, sub.lineno)
+    return out
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    qualname: str
+    name: str
+    node: ast.AST                     # FunctionDef / AsyncFunctionDef
+    cls: Optional[str]                # enclosing class qualname
+    parent: Optional[str]             # enclosing function qualname
+
+
+class ModuleGraph:
+    """Call graph + role assignment for one parsed module."""
+
+    def __init__(self, tree: ast.Module,
+                 lines: Sequence[str]) -> None:
+        self.lines = lines
+        self.functions: Dict[str, FuncInfo] = {}
+        # class qualname -> {method name -> qualname}
+        self.class_methods: Dict[str, Dict[str, str]] = {}
+        # class qualname -> ClassDef node
+        self.classes: Dict[str, ast.ClassDef] = {}
+        # bare class name -> qualname (for instantiation edges)
+        self._class_names: Dict[str, str] = {}
+        self.module_funcs: Dict[str, str] = {}
+        self.edges: Dict[str, Set[str]] = {}
+        self.seeds: Dict[str, Set[str]] = {}
+        self.hops: Dict[str, str] = {}           # qualname -> role
+        self.escaped: Set[str] = set()
+        self.owners: Dict[str, Dict[str, OwnerSpec]] = {}  # cls -> map
+        self._roles: Optional[Dict[str, Set[str]]] = None
+        self._collect(tree)
+        for cls in self.classes:
+            self.owners[cls] = self._parse_owners(cls)
+        for info in self.functions.values():
+            self._scan_body(info)
+        self._seed_defaults()
+
+    # -- pass 1: the symbol table -------------------------------------------
+    def _collect(self, tree: ast.Module) -> None:
+        def walk(node: ast.AST, cls: Optional[str],
+                 func: Optional[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    qual = (f'{cls}.{child.name}' if cls
+                            else child.name)
+                    self.classes[qual] = child
+                    self.class_methods.setdefault(qual, {})
+                    self._class_names.setdefault(child.name, qual)
+                    walk(child, qual, None)
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    if func is not None:
+                        qual = f'{func}.<locals>.{child.name}'
+                    elif cls is not None:
+                        qual = f'{cls}.{child.name}'
+                        self.class_methods[cls][child.name] = qual
+                    else:
+                        qual = child.name
+                        self.module_funcs[child.name] = qual
+                    self.functions[qual] = FuncInfo(
+                        qual, child.name, child, cls, func)
+                    walk(child, cls, qual)
+                else:
+                    walk(child, cls, func)
+        walk(tree, None, None)
+
+    def _parse_owners(self, cls: str) -> Dict[str, OwnerSpec]:
+        return class_owned_attrs(self.classes[cls], self.lines)
+
+    # -- pass 2: edges, entries, escapes ------------------------------------
+    def _scan_body(self, info: FuncInfo) -> None:
+        self.edges.setdefault(info.qualname, set())
+        node = info.node
+        # Entry annotations on the def line / decorators.
+        entry = _annotation(
+            self.lines, node,
+            'entry') or self._decorator_entry(node)
+        if entry is None and info.cls is not None and \
+                _DO_VERB_RE.match(info.name):
+            entry = 'http'
+        if entry is not None:
+            self.seeds.setdefault(info.qualname, set()).add(entry)
+        hop = _annotation(self.lines, node, 'hop')
+        if hop is not None:
+            self.hops[info.qualname] = hop
+        if info.name in _CONSTRUCTORS:
+            self.seeds.setdefault(info.qualname, set()).add(INIT_ROLE)
+        consumed: Set[int] = set()
+        for sub in self.own_nodes(node):
+            if isinstance(sub, ast.Call):
+                self._scan_call(info, sub, consumed)
+        # Escape analysis: function references in value position that
+        # no thread/submit/hop/call construct consumed.
+        for sub in self.own_nodes(node):
+            if id(sub) in consumed:
+                continue
+            if not isinstance(sub, (ast.Name, ast.Attribute)):
+                continue
+            if not isinstance(getattr(sub, 'ctx', None), ast.Load):
+                continue
+            target = self.resolve_ref(info, sub)
+            if target is None:
+                continue
+            role = _annotation(self.lines, sub, 'role')
+            if role is not None:
+                self.seeds.setdefault(target, set()).add(role)
+            else:
+                self.escaped.add(target)
+
+    def _scan_call(self, info: FuncInfo, call: ast.Call,
+                   consumed: Set[int]) -> None:
+        callee = self.resolve_callee(info, call.func)
+        consumed.add(id(call.func))
+        name = _dotted(call.func) or ''
+        leaf = name.split('.')[-1]
+        # threading.Thread(target=...) / executor.submit(fn, ...) /
+        # loop.run_in_executor(None, fn, ...): the referenced function
+        # becomes a thread entry point, not an escape.
+        target_refs: List[ast.AST] = []
+        if leaf == 'Thread':
+            target_refs = [kw.value for kw in call.keywords
+                           if kw.arg == 'target']
+        elif leaf == 'submit' and call.args:
+            target_refs = [call.args[0]]
+        elif leaf == 'run_in_executor' and len(call.args) >= 2:
+            target_refs = [call.args[1]]
+        for ref in target_refs:
+            fn = self.resolve_ref(info, ref)
+            consumed.add(id(ref))
+            if fn is None:
+                continue
+            role = (_annotation(self.lines, call, 'thread') or
+                    f'thread:{self.functions[fn].name}')
+            self.seeds.setdefault(fn, set()).add(role)
+        if target_refs:
+            return
+        if callee is not None:
+            hop_role = self.hops.get(callee)
+            if hop_role is None and callee in self.functions:
+                # Hop annotations are parsed lazily per callee (the
+                # callee may not have been body-scanned yet).
+                ann = _annotation(self.lines,
+                                  self.functions[callee].node, 'hop')
+                if ann is not None:
+                    self.hops[callee] = ann
+                    hop_role = ann
+            self.edges.setdefault(info.qualname, set()).add(callee)
+            if hop_role is not None:
+                # Function arguments to a hop run under the hop role.
+                for arg in list(call.args) + \
+                        [kw.value for kw in call.keywords]:
+                    fn = self.resolve_ref(info, arg)
+                    if fn is not None:
+                        consumed.add(id(arg))
+                        self.seeds.setdefault(fn, set()).add(hop_role)
+            return
+        # Unknown callee: every known-function argument is tainted to
+        # ANY (it may be stored and invoked from any thread) — unless
+        # a `# stpu: role[...]` comment on the line pins it.
+        for arg in list(call.args) + \
+                [kw.value for kw in call.keywords]:
+            fn = self.resolve_ref(info, arg)
+            if fn is None:
+                continue
+            consumed.add(id(arg))
+            role = _annotation(self.lines, arg, 'role')
+            if role is not None:
+                self.seeds.setdefault(fn, set()).add(role)
+            else:
+                self.escaped.add(fn)
+
+    def _decorator_entry(self, node: ast.AST) -> Optional[str]:
+        """`@routes.get('/x')`-style registration -> role 'http'."""
+        for dec in getattr(node, 'decorator_list', ()):
+            if isinstance(dec, ast.Call):
+                name = _dotted(dec.func)
+                if name is not None and \
+                        name.split('.')[-1] in _ROUTE_DECORATORS:
+                    return 'http'
+        return None
+
+    # -- resolution ----------------------------------------------------------
+    @staticmethod
+    def _self_attr(node: ast.AST) -> Optional[str]:
+        if (isinstance(node, ast.Attribute) and
+                isinstance(node.value, ast.Name) and
+                node.value.id == 'self'):
+            return node.attr
+        return None
+
+    def resolve_callee(self, info: FuncInfo,
+                        func: ast.AST) -> Optional[str]:
+        """Qualname the call dispatches to, or None (unknown)."""
+        if isinstance(func, ast.Name):
+            # Innermost first: local nested defs up the lexical chain.
+            scope: Optional[str] = info.qualname
+            while scope is not None:
+                local = f'{scope}.<locals>.{func.id}'
+                if local in self.functions:
+                    return local
+                scope = self.functions[scope].parent
+            if func.id in self.module_funcs:
+                return self.module_funcs[func.id]
+            if func.id in self._class_names:
+                cls = self._class_names[func.id]
+                return self.class_methods.get(cls, {}).get('__init__')
+            return None
+        attr = self._self_attr(func)
+        if attr is not None and info.cls is not None:
+            return self.class_methods.get(info.cls, {}).get(attr)
+        name = _dotted(func)
+        if name is not None and '.' in name:
+            head, leaf = name.rsplit('.', 1)
+            if head in self._class_names:
+                cls = self._class_names[head]
+                return self.class_methods.get(cls, {}).get(leaf)
+        return None
+
+    def resolve_ref(self, info: FuncInfo,
+                     node: ast.AST) -> Optional[str]:
+        """Qualname for a *reference* to a known function (a value,
+        not a call)."""
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            return self.resolve_callee(info, node)
+        return None
+
+    def own_nodes(self, func: ast.AST):
+        """Nodes of `func`'s own body, excluding nested def/class
+        bodies (those are separate graph nodes)."""
+        stack = list(ast.iter_child_nodes(func))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    # -- role propagation -----------------------------------------------------
+    def _seed_defaults(self) -> None:
+        """Public functions with no explicit contract are callable
+        from anywhere (the conservative cross-module default)."""
+        for qual, info in self.functions.items():
+            if qual in self.seeds or qual in self.escaped:
+                continue
+            public = not info.name.startswith('_')
+            if public and info.name not in _CONSTRUCTORS and \
+                    '<locals>' not in qual:
+                self.escaped.add(qual)
+
+    def roles(self, qualname: str) -> Set[str]:
+        """Roles whose threads may execute `qualname` (fixpoint over
+        call edges; `{ANY}` = unknown/any)."""
+        if self._roles is None:
+            self._roles = self._propagate()
+        return self._roles.get(qualname, {ANY})
+
+    def _propagate(self) -> Dict[str, Set[str]]:
+        roles: Dict[str, Set[str]] = {
+            q: set(s) for q, s in self.seeds.items()}
+        for q in self.escaped:
+            roles.setdefault(q, set()).add(ANY)
+        changed = True
+        while changed:
+            changed = False
+            for caller, callees in self.edges.items():
+                src = roles.get(caller)
+                if not src:
+                    continue
+                for callee in callees:
+                    dst = roles.setdefault(callee, set())
+                    add = src - dst
+                    if add:
+                        dst.update(add)
+                        changed = True
+        # Unreached functions are unknown: any thread may call them.
+        for q in self.functions:
+            if not roles.get(q):
+                roles[q] = {ANY}
+        return roles
+
+
+def build(tree: ast.Module, source_lines: Sequence[str]) -> ModuleGraph:
+    return ModuleGraph(tree, source_lines)

@@ -1,8 +1,11 @@
 """Built-in checkers. Importing this package registers every rule."""
 from skypilot_tpu.analysis.checkers import async_blocking  # noqa: F401
+from skypilot_tpu.analysis.checkers import donation  # noqa: F401
 from skypilot_tpu.analysis.checkers import exception_hygiene  # noqa: F401
+from skypilot_tpu.analysis.checkers import fault_points  # noqa: F401
 from skypilot_tpu.analysis.checkers import jit_purity  # noqa: F401
 from skypilot_tpu.analysis.checkers import lock_discipline  # noqa: F401
 from skypilot_tpu.analysis.checkers import metric_names  # noqa: F401
 from skypilot_tpu.analysis.checkers import pallas_interpret  # noqa: F401
 from skypilot_tpu.analysis.checkers import span_discipline  # noqa: F401
+from skypilot_tpu.analysis.checkers import thread_ownership  # noqa: F401

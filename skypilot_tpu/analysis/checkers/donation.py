@@ -1,0 +1,380 @@
+"""SKY009: donation discipline on jitted dispatches.
+
+`donate_argnums` hands a buffer to XLA: after the dispatch the
+caller's array is INVALID (its memory backs the output). The engine
+leans on this everywhere — every decode/prefill dispatch donates the
+KV cache so XLA updates in place instead of copying gigabytes per
+token — which makes two mistakes easy and catastrophic:
+
+  1. USE AFTER DONATION: referencing the donated argument after the
+     dispatch instead of rebinding the result in the same statement
+     (`self.cache, out = fn(self.params, self.cache, ...)` is the
+     contract; a later `self.cache` load on the old binding reads
+     freed memory or a deleted-buffer error, but only on real TPUs —
+     CPU tests never catch it because donation is a no-op there).
+  2. UNPINNED DONATING DISPATCH: inside the engine (any class that
+     defines `_pin_cache_out`), a new donating jit that omits the
+     `**self._pin_cache_out(...)` splat (or an explicit
+     `out_shardings=`) lets GSPMD reshard the donated pool, silently
+     inserting a collective on the hot path (the exact drift the
+     PR 15 compiled-HLO guard pinned down).
+
+The checker tracks donating callables interprocedurally within the
+module: decorated defs (`@functools.partial(jax.jit,
+donate_argnums=...)`), `jax.jit(f, donate_argnums=...)` assignments,
+factory methods that RETURN a donating function (directly, via a
+cached `self._fns[key]` dict, or by calling another factory), and
+instance attributes bound to factory results (including the
+`a if cond else b` form). A dispatch through any of these is checked:
+each donated positional argument must be rebound by the dispatch
+statement itself, or never referenced afterwards in that function.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from skypilot_tpu.analysis import callgraph, core
+
+Positions = FrozenSet[int]
+
+
+def _jit_call_info(call: ast.Call) -> Optional[Tuple[Positions, bool]]:
+    """(donated positions, has out-sharding pin) if `call` creates a
+    jitted function with donate_argnums, else None.
+
+    Handles `jax.jit(...)` and `functools.partial(jax.jit, ...)`.
+    `donate_argnums=(0,) if donate else ()` counts as donating (the
+    True branch is the shipped configuration)."""
+    name = core.dotted_name(call.func)
+    if name is None:
+        return None
+    leaf = name.split('.')[-1]
+    if leaf == 'partial':
+        if not call.args:
+            return None
+        inner = core.dotted_name(call.args[0])
+        if inner is None or inner.split('.')[-1] != 'jit':
+            return None
+    elif leaf != 'jit':
+        return None
+    donated: Set[int] = set()
+    pinned = False
+    for kw in call.keywords:
+        if kw.arg == 'donate_argnums':
+            donated |= _argnums(kw.value)
+        elif kw.arg == 'out_shardings':
+            pinned = True
+        elif kw.arg is None:
+            # **self._pin_cache_out(...) splat.
+            if (isinstance(kw.value, ast.Call) and
+                    isinstance(kw.value.func, ast.Attribute) and
+                    kw.value.func.attr == '_pin_cache_out'):
+                pinned = True
+    if not donated:
+        return None
+    return frozenset(donated), pinned
+
+
+def _argnums(node: ast.AST) -> Set[int]:
+    if isinstance(node, ast.IfExp):
+        return _argnums(node.body) | _argnums(node.orelse)
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: Set[int] = set()
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and \
+                    isinstance(elt.value, int):
+                out.add(elt.value)
+        return out
+    return set()
+
+
+def _ref(node: ast.AST) -> Optional[str]:
+    """Stable dotted form of a rebindable reference (`self.cache`,
+    `cache`); None for arbitrary expressions."""
+    return core.dotted_name(node)
+
+
+@core.register
+class DonationChecker(core.Checker):
+    rule = 'SKY009'
+    name = 'donation-discipline'
+    description = ('Arguments donated to a jitted dispatch must be '
+                   'rebound, not referenced after; engine donating '
+                   'jits must pin out-shardings.')
+    version = 1
+
+    @classmethod
+    def applies_to(cls, path: str) -> bool:
+        return not path.startswith('tests/')
+
+    def check(self, tree: ast.Module) -> List[core.Finding]:
+        graph = callgraph.build(tree, self.ctx.lines)
+        self._graph = graph
+        # Classes with a _pin_cache_out helper opt into the pin rule.
+        self._pin_classes = {
+            cls for cls, methods in graph.class_methods.items()
+            if '_pin_cache_out' in methods}
+        # qualname -> donated positions, for donating function DEFS.
+        self._donating_defs: Dict[str, Positions] = {}
+        # method qualname -> positions its return value donates.
+        self._factories: Dict[str, Positions] = {}
+        # (cls, attr) -> positions (instance attr bound to a factory
+        # result, or a dict of donating fns: self._fns[k] = fn).
+        self._attrs: Dict[Tuple[str, str], Positions] = {}
+        self._collect_defs(graph)
+        self._fixpoint(graph)
+        for qual, info in graph.functions.items():
+            self._check_function(graph, qual, info)
+            self._check_pins(graph, info)
+        return self.findings
+
+    # -- collection -----------------------------------------------------------
+    def _collect_defs(self, graph: callgraph.ModuleGraph) -> None:
+        for qual, info in graph.functions.items():
+            for dec in getattr(info.node, 'decorator_list', ()):
+                if not isinstance(dec, ast.Call):
+                    continue
+                jit = _jit_call_info(dec)
+                if jit is None:
+                    continue
+                positions, pinned = jit
+                self._donating_defs[qual] = positions
+                if info.cls in self._pin_classes and not pinned:
+                    self.add(dec,
+                             f'donating jit {info.name!r} omits the '
+                             f'_pin_cache_out out-sharding pin; the '
+                             f'donated pool layout can drift and '
+                             f'GSPMD may insert a resharding '
+                             f'collective on the dispatch')
+
+    def _check_pins(self, graph: callgraph.ModuleGraph,
+                    info: callgraph.FuncInfo) -> None:
+        """The assignment-form counterpart of the decorator pin check:
+        `self._fn = jax.jit(f, donate_argnums=...)` inside a pin-aware
+        class needs the out-sharding pin too."""
+        if info.cls not in self._pin_classes:
+            return
+        decs = {id(d) for d in getattr(info.node, 'decorator_list', ())}
+        for node in graph.own_nodes(info.node):
+            if not isinstance(node, ast.Call) or id(node) in decs:
+                continue
+            jit = _jit_call_info(node)
+            if jit is None or jit[1]:
+                continue
+            self.add(node,
+                     f'donating jit created in {info.qualname!r} '
+                     f'omits the _pin_cache_out out-sharding pin; '
+                     f'the donated pool layout can drift and GSPMD '
+                     f'may insert a resharding collective on the '
+                     f'dispatch')
+
+    def _fixpoint(self, graph: callgraph.ModuleGraph) -> None:
+        """Propagate donating-ness through factories, cached-fn dict
+        attrs, and instance attributes until stable."""
+        for _ in range(10):
+            changed = False
+            for qual, info in graph.functions.items():
+                local = self._locals_of(graph, qual, info)
+                # self._fns[key] = <donating local> / factory attr.
+                for node in graph.own_nodes(info.node):
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    positions = self._value_positions(
+                        graph, info, local, node.value)
+                    if positions is None:
+                        continue
+                    for target in node.targets:
+                        key = self._attr_key(info, target)
+                        if key is not None and \
+                                self._attrs.get(key) != positions:
+                            self._attrs[key] = positions
+                            changed = True
+                # return <donating thing> -> factory.
+                for node in graph.own_nodes(info.node):
+                    if not isinstance(node, ast.Return) or \
+                            node.value is None:
+                        continue
+                    positions = self._value_positions(
+                        graph, info, local, node.value)
+                    if positions is not None and \
+                            self._factories.get(qual) != positions:
+                        self._factories[qual] = positions
+                        changed = True
+            if not changed:
+                return
+
+    def _attr_key(self, info: callgraph.FuncInfo,
+                  target: ast.AST) -> Optional[Tuple[str, str]]:
+        """(cls, attr) for `self.x = ...` / `self.x[k] = ...`."""
+        if info.cls is None:
+            return None
+        if isinstance(target, ast.Subscript):
+            target = target.value
+        if (isinstance(target, ast.Attribute) and
+                isinstance(target.value, ast.Name) and
+                target.value.id == 'self'):
+            return (info.cls, target.attr)
+        return None
+
+    def _value_positions(self, graph: callgraph.ModuleGraph,
+                         info: callgraph.FuncInfo,
+                         local: Dict[str, Positions],
+                         value: ast.AST) -> Optional[Positions]:
+        """Donated positions of the callable `value` evaluates to,
+        or None if it is not a known donating callable."""
+        if isinstance(value, ast.IfExp):
+            a = self._value_positions(graph, info, local, value.body)
+            b = self._value_positions(graph, info, local, value.orelse)
+            if a is None and b is None:
+                return None
+            return (a or frozenset()) | (b or frozenset())
+        if isinstance(value, ast.Name):
+            if value.id in local:
+                return local[value.id]
+            qual = graph.resolve_callee(info, value)
+            if qual is not None:
+                return self._donating_defs.get(qual)
+            return None
+        if isinstance(value, ast.Call):
+            jit = _jit_call_info(value)
+            if jit is not None:
+                return jit[0]
+            qual = graph.resolve_callee(info, value.func)
+            if qual is not None:
+                return self._factories.get(qual)
+            return None
+        if isinstance(value, ast.Subscript):
+            key = self._attr_key(info, value)
+            if key is not None:
+                return self._attrs.get(key)
+            return None
+        if isinstance(value, ast.Attribute):
+            key = self._attr_key(info, value)
+            if key is not None:
+                return self._attrs.get(key)
+        return None
+
+    def _locals_of(self, graph: callgraph.ModuleGraph, qual: str,
+                   info: callgraph.FuncInfo) -> Dict[str, Positions]:
+        """Local names bound to donating callables in `qual`'s body:
+        nested donating defs, `x = jax.jit(...)`, `fn =
+        self._factory(...)`, `fn = self._fns[k]`."""
+        local: Dict[str, Positions] = {}
+        for child_qual, child in graph.functions.items():
+            if child.parent == qual and \
+                    child_qual in self._donating_defs:
+                local[child.name] = self._donating_defs[child_qual]
+        # Two passes so `a = jit(...); b = a` resolves.
+        for _ in range(2):
+            for node in graph.own_nodes(info.node):
+                if not isinstance(node, ast.Assign):
+                    continue
+                positions = self._value_positions(
+                    graph, info, local, node.value)
+                if positions is None:
+                    continue
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        local[target.id] = positions
+        return local
+
+    # -- dispatch checking ----------------------------------------------------
+    def _check_function(self, graph: callgraph.ModuleGraph, qual: str,
+                        info: callgraph.FuncInfo) -> None:
+        local = self._locals_of(graph, qual, info)
+        for stmt in graph.own_nodes(info.node):
+            if not isinstance(stmt, (ast.Assign, ast.AugAssign,
+                                     ast.Expr)):
+                continue
+            for call in ast.walk(stmt):
+                if not isinstance(call, ast.Call):
+                    continue
+                positions = self._dispatch_positions(
+                    graph, info, local, call)
+                if positions is None:
+                    continue
+                self._check_dispatch(graph, info, stmt, call,
+                                     positions)
+
+    def _dispatch_positions(self, graph: callgraph.ModuleGraph,
+                            info: callgraph.FuncInfo,
+                            local: Dict[str, Positions],
+                            call: ast.Call) -> Optional[Positions]:
+        func = call.func
+        if isinstance(func, ast.Name):
+            if func.id in local:
+                return local[func.id]
+            qual = graph.resolve_callee(info, func)
+            if qual is not None:
+                return self._donating_defs.get(qual)
+            return None
+        if isinstance(func, ast.Attribute):
+            key = self._attr_key(info, func)
+            if key is not None:
+                return self._attrs.get(key)
+        if isinstance(func, ast.Subscript):
+            key = self._attr_key(info, func)
+            if key is not None:
+                return self._attrs.get(key)
+        return None
+
+    def _check_dispatch(self, graph: callgraph.ModuleGraph,
+                        info: callgraph.FuncInfo, stmt: ast.stmt,
+                        call: ast.Call,
+                        positions: Positions) -> None:
+        fn_name = core.dotted_name(call.func) or '<fn>'
+        rebound: Set[str] = set()
+        if isinstance(stmt, ast.Assign):
+            for target in stmt.targets:
+                self._collect_refs(target, rebound)
+        elif isinstance(stmt, ast.AugAssign):
+            self._collect_refs(stmt.target, rebound)
+        for pos in sorted(positions):
+            if pos >= len(call.args):
+                continue
+            ref = _ref(call.args[pos])
+            if ref is None or ref in rebound:
+                continue
+            use = self._first_later_use(graph, info, ref,
+                                        stmt.end_lineno or stmt.lineno)
+            if use is not None:
+                self.add(use,
+                         f'{ref} is referenced after being donated '
+                         f'to {fn_name} (donate_argnums position '
+                         f'{pos}, dispatched at line {call.lineno}); '
+                         f'rebind the dispatch result in the same '
+                         f'statement — the donated buffer is invalid '
+                         f'after dispatch')
+
+    @staticmethod
+    def _collect_refs(target: ast.AST, out: Set[str]) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                DonationChecker._collect_refs(elt, out)
+            return
+        if isinstance(target, ast.Starred):
+            target = target.value
+        ref = _ref(target)
+        if ref is not None:
+            out.add(ref)
+
+    def _first_later_use(self, graph: callgraph.ModuleGraph,
+                         info: callgraph.FuncInfo, ref: str,
+                         after_line: int) -> Optional[ast.AST]:
+        best: Optional[ast.AST] = None
+        for node in graph.own_nodes(info.node):
+            if not isinstance(node, (ast.Name, ast.Attribute)):
+                continue
+            if not isinstance(getattr(node, 'ctx', None), ast.Load):
+                continue
+            if node.lineno <= after_line:
+                continue
+            if core.dotted_name(node) != ref:
+                continue
+            if best is None or (node.lineno, node.col_offset) < \
+                    (best.lineno, best.col_offset):
+                best = node
+        return best

@@ -1,0 +1,214 @@
+"""SKY010: fault-point drift — fire sites vs catalog vs docs.
+
+The fault-injection registry (robustness/faults.py) is a CLOSED
+catalog: `install_plan` rejects plans naming unknown points, and the
+operator-facing point table in docs/internals.md §11 is the contract
+chaos plans are written against. That closure only holds if the three
+surfaces stay in sync, so this rule (the SKY004 catalog pattern
+promoted to the robustness layer) checks:
+
+  - every `faults.point(name, ...)` fire site names a KNOWN_POINTS
+    entry — a typo'd point silently never fires, which is the worst
+    failure mode a chaos harness can have;
+  - every fire-site name appears in the internals §11 table — an
+    undocumented point can't be targeted by anyone reading the docs;
+  - when visiting faults.py itself: KNOWN_POINTS and the §11 table
+    agree in BOTH directions (catalog entry missing from the docs,
+    or a documented point the catalog no longer declares);
+  - fire-site names must be string literals — a dynamic name defeats
+    the closed-catalog property.
+
+Coverage (every non-derived point has at least one live fire site)
+is asserted by tests/unit_tests/test_static_analysis.py rather than
+here, because it is a whole-repo property, not a per-file one.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Optional, Set
+
+from skypilot_tpu.analysis import core
+
+_FAULTS_REL = 'skypilot_tpu/robustness/faults.py'
+_DOCS_REL = 'docs/internals.md'
+
+# Derived points are plan-level sugar with, by design, no call site.
+DERIVED_POINTS = {'jobs.preempt_storm'}
+
+_ROW_RE = re.compile(r'^\|\s*`([A-Za-z0-9_.]+)`\s*\|')
+
+_known_cache: Optional[Dict[str, int]] = None
+_docs_cache: object = False           # False = not loaded yet
+
+
+def known_points() -> Dict[str, int]:
+    """KNOWN_POINTS keys -> declaration line, parsed from faults.py
+    WITHOUT importing it (same trick as SKY004's catalog_names)."""
+    global _known_cache
+    if _known_cache is not None:
+        return _known_cache
+    out: Dict[str, int] = {}
+    path = os.path.join(core.REPO_ROOT, _FAULTS_REL)
+    try:
+        with open(path, 'r', encoding='utf-8') as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError):
+        _known_cache = {}
+        return _known_cache
+    for node in ast.walk(tree):
+        if (isinstance(node, (ast.Assign, ast.AnnAssign)) and
+                isinstance(node.value, ast.Dict)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            if not any(isinstance(t, ast.Name) and
+                       t.id == 'KNOWN_POINTS' for t in targets):
+                continue
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant) and \
+                        isinstance(k.value, str):
+                    out[k.value] = k.lineno
+    _known_cache = out
+    return out
+
+
+def documented_points() -> Optional[Set[str]]:
+    """Point names in the internals.md §11 table (None if the docs
+    file is missing — doc checks are skipped, not spammed)."""
+    global _docs_cache
+    if _docs_cache is not False:
+        return _docs_cache
+    path = os.path.join(core.REPO_ROOT, _DOCS_REL)
+    if not os.path.exists(path):
+        _docs_cache = None
+        return None
+    out: Set[str] = set()
+    in_section = False
+    with open(path, 'r', encoding='utf-8') as f:
+        for line in f:
+            if line.startswith('## '):
+                in_section = 'Fault injection' in line
+                continue
+            if not in_section:
+                continue
+            m = _ROW_RE.match(line)
+            if m:
+                out.add(m.group(1))
+    _docs_cache = out
+    return out
+
+
+def reset_caches() -> None:
+    """Test hook."""
+    global _known_cache, _docs_cache
+    _known_cache = None
+    _docs_cache = False
+
+
+@core.register
+class FaultPointChecker(core.Checker):
+    rule = 'SKY010'
+    name = 'fault-point-drift'
+    description = ('faults.point() fire sites, KNOWN_POINTS, and the '
+                   'internals §11 table must agree.')
+    version = 1
+
+    @classmethod
+    def applies_to(cls, path: str) -> bool:
+        return not path.startswith('tests/')
+
+    def __init__(self, ctx: core.FileContext) -> None:
+        super().__init__(ctx)
+        self._module_aliases: Set[str] = set()
+        self._func_aliases: Set[str] = set()
+
+    # -- import tracking (mirrors SKY004) ------------------------------------
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            if alias.name.endswith('faults'):
+                self._module_aliases.add(
+                    alias.asname or alias.name.split('.')[0])
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        mod = node.module or ''
+        for alias in node.names:
+            if alias.name == 'faults' and mod.endswith('robustness'):
+                self._module_aliases.add(alias.asname or 'faults')
+            elif alias.name == 'point' and mod.endswith('faults'):
+                self._func_aliases.add(alias.asname or 'point')
+        self.generic_visit(node)
+
+    # -- fire sites -----------------------------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._is_point_call(node) and node.args:
+            arg = node.args[0]
+            if not (isinstance(arg, ast.Constant) and
+                    isinstance(arg.value, str)):
+                self.add(node,
+                         'faults.point() name must be a string '
+                         'literal — a dynamic name defeats the '
+                         'closed catalog (install_plan validation '
+                         'and the internals §11 table)')
+            else:
+                self._check_name(node, arg.value)
+        self.generic_visit(node)
+
+    def _is_point_call(self, node: ast.Call) -> bool:
+        func = node.func
+        if isinstance(func, ast.Name):
+            return func.id in self._func_aliases
+        if isinstance(func, ast.Attribute) and func.attr == 'point':
+            base = core.dotted_name(func.value)
+            return base in self._module_aliases
+        return False
+
+    def _check_name(self, node: ast.AST, name: str) -> None:
+        known = known_points()
+        if known and name not in known:
+            self.add(node,
+                     f'fault point {name!r} is not declared in '
+                     f'KNOWN_POINTS ({_FAULTS_REL}) — this fire site '
+                     f'can never be targeted by a plan')
+            return
+        docs = documented_points()
+        if docs is not None and name not in docs:
+            self.add(node,
+                     f'fault point {name!r} is missing from the '
+                     f'{_DOCS_REL} §11 point table — document the '
+                     f'site and what a firing rule perturbs')
+
+    # -- the declaration file itself -----------------------------------------
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self._check_declaration(node, node.targets)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        # `KNOWN_POINTS: Dict[str, str] = {...}` is the real form.
+        self._check_declaration(node, [node.target])
+        self.generic_visit(node)
+
+    def _check_declaration(self, node, targets) -> None:
+        if (self.ctx.path == _FAULTS_REL and
+                any(isinstance(t, ast.Name) and t.id == 'KNOWN_POINTS'
+                    for t in targets) and
+                isinstance(node.value, ast.Dict)):
+            docs = documented_points()
+            declared: Set[str] = set()
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant) and \
+                        isinstance(k.value, str):
+                    declared.add(k.value)
+                    if docs is not None and k.value not in docs:
+                        self.add(k,
+                                 f'KNOWN_POINTS entry {k.value!r} is '
+                                 f'missing from the {_DOCS_REL} §11 '
+                                 f'point table')
+            if docs is not None:
+                for name in sorted(docs - declared):
+                    self.add(node,
+                             f'{_DOCS_REL} §11 documents fault point '
+                             f'{name!r} that KNOWN_POINTS no longer '
+                             f'declares — delete the stale row or '
+                             f'restore the point')

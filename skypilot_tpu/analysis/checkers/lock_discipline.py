@@ -16,13 +16,17 @@ Conventions honored to keep noise down:
     considered disciplined (granularity is method-level on purpose —
     the goal is catching methods nobody ever thought about locking).
   - only attributes assigned in `__init__` count as shared state.
+  - attributes with a declared thread OWNER (`_STPU_OWNERS` /
+    `# stpu: owner[...]` — see analysis/callgraph.py) are exempt:
+    ownership is their synchronization story, and SKY008 verifies it
+    against the call graph instead of asking for a lock.
 """
 from __future__ import annotations
 
 import ast
 from typing import List, Optional, Set
 
-from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis import callgraph, core
 
 _LOCK_TYPES = {'Lock', 'RLock', 'Condition', 'Semaphore',
                'BoundedSemaphore'}
@@ -59,6 +63,10 @@ class _ClassScan:
         if not self.locks:
             return
         self.shared -= self.locks
+        # Owner-declared attrs answer to SKY008's call-graph check,
+        # not lock discipline.
+        self.shared -= set(callgraph.class_owned_attrs(
+            self.node, self.checker.ctx.lines))
         for m in methods:
             if (m.name in _EXEMPT_METHODS or
                     m.name.endswith('_locked')):

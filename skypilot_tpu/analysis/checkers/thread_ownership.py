@@ -1,0 +1,235 @@
+"""SKY008: cross-thread access to role-owned state.
+
+A class that declares thread ownership (a `_STPU_OWNERS` map or
+`# stpu: owner[role]` comments — see analysis/callgraph.py) has made
+a machine-checkable claim: "attribute X is touched only by the ROLE
+thread". This checker builds the module call graph, assigns each
+function the set of roles whose threads can reach it, and flags:
+
+  - a WRITE to an owned attribute from a method reachable by a role
+    other than the owner (and not `init` — construction happens-before
+    sharing), unless the method holds one of the class's declared
+    locks (lock-protected cross-thread access is SKY003's domain, not
+    a race);
+  - a READ of a STRICT (`role!`) attribute under the same conditions
+    — the donated-cache case, where even observing the buffer races
+    the dispatch that consumes it;
+  - an owner declaration for an attribute the class never assigns
+    (ownership drift: the attribute was renamed but the declaration
+    was not).
+
+The safe cross-thread patterns are all visible to the call graph: hop
+through a `# stpu: hop[role]` function (`run_on_scheduler` — the
+closure runs on the owner thread), hold a declared lock, or pin a
+callback registration with `# stpu: role[...]`. Everything else needs
+an inline `# stpu: ignore[SKY008]` with a comment saying why the race
+is benign.
+
+Classes that declare no owners are untouched — this rule is opt-in
+per class, by design: the grammar is the contract.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from skypilot_tpu.analysis import callgraph, core
+
+_LOCK_TYPES = {'Lock', 'RLock', 'Condition', 'Semaphore',
+               'BoundedSemaphore'}
+_MUTATORS = {'append', 'appendleft', 'extend', 'extendleft', 'insert',
+             'pop', 'popleft', 'popitem', 'remove', 'discard', 'clear',
+             'add', 'update', 'setdefault', 'sort', 'reverse'}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute) and
+            isinstance(node.value, ast.Name) and
+            node.value.id == 'self'):
+        return node.attr
+    return None
+
+
+def _class_locks(node: ast.ClassDef) -> Set[str]:
+    """Attrs assigned a Lock/RLock/Condition/Semaphore anywhere in
+    the class body (mirrors SKY003's collection)."""
+    locks: Set[str] = set()
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Assign):
+            continue
+        if not isinstance(sub.value, ast.Call):
+            continue
+        name = core.dotted_name(sub.value.func)
+        if name is not None and name.split('.')[-1] in _LOCK_TYPES:
+            for target in sub.targets:
+                attr = _self_attr(target)
+                if attr is not None:
+                    locks.add(attr)
+    return locks
+
+
+def _acquires_lock(method: ast.AST, locks: Set[str]) -> bool:
+    for node in ast.walk(method):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                expr = item.context_expr
+                if _self_attr(expr) in locks:
+                    return True
+                if (isinstance(expr, ast.Call) and
+                        isinstance(expr.func, ast.Attribute) and
+                        _self_attr(expr.func.value) in locks):
+                    return True
+        if isinstance(node, ast.Call):
+            if (isinstance(node.func, ast.Attribute) and
+                    node.func.attr in ('acquire', 'wait', 'notify',
+                                       'notify_all') and
+                    _self_attr(node.func.value) in locks):
+                return True
+    return False
+
+
+@core.register
+class ThreadOwnershipChecker(core.Checker):
+    rule = 'SKY008'
+    name = 'thread-ownership'
+    description = ('Role-owned attributes must only be touched from '
+                   'the owning thread role (call-graph verified).')
+    version = 1
+
+    @classmethod
+    def applies_to(cls, path: str) -> bool:
+        return not path.startswith('tests/')
+
+    def check(self, tree: ast.Module) -> List[core.Finding]:
+        graph = callgraph.build(tree, self.ctx.lines)
+        for cls_qual, owners in graph.owners.items():
+            if owners:
+                self._check_class(graph, cls_qual, owners)
+        return self.findings
+
+    def _check_class(self, graph: callgraph.ModuleGraph,
+                     cls_qual: str,
+                     owners: Dict[str, callgraph.OwnerSpec]) -> None:
+        node = graph.classes[cls_qual]
+        locks = _class_locks(node)
+        assigned: Set[str] = set()
+        # Methods AND their nested functions (both carry cls).
+        methods = [(q, info) for q, info in graph.functions.items()
+                   if info.cls == cls_qual]
+        for qual, info in methods:
+            for sub in ast.walk(info.node):
+                if isinstance(sub, ast.Assign):
+                    for target in sub.targets:
+                        self._collect_assigned(target, assigned)
+                elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)):
+                    self._collect_assigned(sub.target, assigned)
+        for spec in owners.values():
+            if spec.attr not in assigned:
+                self.findings.append(core.Finding(
+                    self.rule, self.ctx.path, spec.line, 0,
+                    f'{node.name} declares owner[{spec.role}] for '
+                    f'attribute {spec.attr!r} that is never assigned '
+                    f'in the class (ownership drift)'))
+        for qual, info in methods:
+            root = graph.functions[self._root(graph, info)]
+            if root.name in ('__init__', '__new__', '__del__',
+                             '__post_init__'):
+                continue
+            roles = graph.roles(qual) - {callgraph.INIT_ROLE}
+            if not roles:
+                continue
+            if _acquires_lock(info.node, locks):
+                continue
+            self._flag_accesses(graph, info, owners, roles)
+
+    @staticmethod
+    def _root(graph: callgraph.ModuleGraph,
+              info: callgraph.FuncInfo) -> str:
+        """Qualname of the outermost enclosing function (nested defs
+        inherit their method's exemptions)."""
+        qual = info.qualname
+        while graph.functions[qual].parent is not None:
+            qual = graph.functions[qual].parent
+        return qual
+
+    @staticmethod
+    def _collect_assigned(target: ast.AST, out: Set[str]) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                ThreadOwnershipChecker._collect_assigned(elt, out)
+            return
+        if isinstance(target, (ast.Subscript, ast.Starred)):
+            target = target.value
+        attr = _self_attr(target)
+        if attr is not None:
+            out.add(attr)
+
+    def _flag_accesses(self, graph: callgraph.ModuleGraph,
+                       info: callgraph.FuncInfo,
+                       owners: Dict[str, callgraph.OwnerSpec],
+                       roles: Set[str]) -> None:
+        flagged: Set[Tuple[int, int]] = set()
+        for node in graph.own_nodes(info.node):
+            attr = None
+            if isinstance(node, ast.Assign):
+                for target in node.targets:
+                    attr = attr or self._store_attr(target, owners)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                attr = self._store_attr(node.target, owners)
+            elif isinstance(node, ast.Call):
+                if (isinstance(node.func, ast.Attribute) and
+                        node.func.attr in _MUTATORS):
+                    cand = _self_attr(node.func.value)
+                    if cand in owners:
+                        attr = cand
+            if attr is None:
+                continue
+            spec = owners[attr]
+            foreign = roles - {spec.role}
+            if foreign:
+                flagged.add((node.lineno, node.col_offset))
+                self._violation(node, info, spec, foreign, 'writes')
+        # Strict owners police reads too.
+        for node in graph.own_nodes(info.node):
+            if not isinstance(node, ast.Attribute):
+                continue
+            if not isinstance(node.ctx, ast.Load):
+                continue
+            attr = _self_attr(node)
+            if attr is None or attr not in owners:
+                continue
+            spec = owners[attr]
+            if not spec.strict:
+                continue
+            if (node.lineno, node.col_offset) in flagged:
+                continue
+            foreign = roles - {spec.role}
+            if foreign:
+                self._violation(node, info, spec, foreign, 'reads')
+
+    def _store_attr(self, target: ast.AST,
+                    owners: Dict[str, callgraph.OwnerSpec]
+                    ) -> Optional[str]:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                attr = self._store_attr(elt, owners)
+                if attr is not None:
+                    return attr
+            return None
+        if isinstance(target, (ast.Subscript, ast.Starred)):
+            target = target.value
+        attr = _self_attr(target)
+        if attr is not None and attr in owners:
+            return attr
+        return None
+
+    def _violation(self, node: ast.AST, info: callgraph.FuncInfo,
+                   spec: callgraph.OwnerSpec, foreign: Set[str],
+                   verb: str) -> None:
+        roles = ', '.join(sorted(foreign))
+        self.add(node,
+                 f'{info.qualname} {verb} self.{spec.attr} (owned by '
+                 f'{spec.role}{"!" if spec.strict else ""}) but is '
+                 f'reachable from role(s) {roles}; hop through a '
+                 f'stpu:hop function, hold a declared lock, or pin '
+                 f'the caller with stpu:role[...]')

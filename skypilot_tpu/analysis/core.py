@@ -14,8 +14,13 @@ Pieces:
   run_file/run_paths per-file runner: parse once, run every selected
                      checker, drop `# stpu: ignore[SKYxxx]` lines
   Baseline           committed grandfather list (analysis/baseline.json)
-                     keyed (path, rule, line), each entry justified
-  render_text/json   reporters for the CLI and the CI gate
+                     v2: keyed (path, rule, qualified symbol) so line
+                     churn no longer invalidates rows; v1 line-keyed
+                     entries still load. Every entry justified; a
+                     `rule_versions` map invalidates a rule's rows
+                     when the checker's logic version bumps.
+  render_text/json   reporters for the CLI and the CI gate (JSON
+                     carries per-rule wall-clock timings)
 
 Suppression: append `# stpu: ignore[SKY001]` (or a bare
 `# stpu: ignore` for every rule) to the flagged line.
@@ -27,6 +32,7 @@ import dataclasses
 import json
 import os
 import re
+import time
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple, Type
 
 # Repo root = the directory holding the `skypilot_tpu` package; paths
@@ -51,9 +57,16 @@ class Finding:
     line: int
     col: int
     message: str
+    # Qualified name of the enclosing def ('Cls.method.inner'),
+    # '<module>' at top level. Stamped by run_source; the v2 baseline
+    # keys on it so findings survive line churn.
+    symbol: str = '<module>'
 
     def key(self) -> Tuple[str, str, int]:
         return (self.path, self.rule, self.line)
+
+    def symbol_key(self) -> Tuple[str, str, str]:
+        return (self.path, self.rule, self.symbol)
 
     def to_dict(self) -> Dict[str, object]:
         return dataclasses.asdict(self)
@@ -80,6 +93,10 @@ class Checker(ast.NodeVisitor):
     rule: str = 'SKY000'
     name: str = 'base'
     description: str = ''
+    # Bump when the rule's LOGIC changes enough that old baseline
+    # rows must be re-triaged; the baseline stores the version it was
+    # written against and drops rows whose rule has moved on.
+    version: int = 1
 
     def __init__(self, ctx: FileContext) -> None:
         self.ctx = ctx
@@ -114,6 +131,12 @@ def register(cls: Type[Checker]) -> Type[Checker]:
 def all_checkers() -> Dict[str, Type[Checker]]:
     _load_builtin_checkers()
     return dict(_CHECKERS)
+
+
+def checker_versions() -> Dict[str, int]:
+    """rule -> current logic version, for the baseline's
+    `rule_versions` gate."""
+    return {rule: cls.version for rule, cls in all_checkers().items()}
 
 
 def _load_builtin_checkers() -> None:
@@ -170,9 +193,49 @@ def suppressed_lines(source: str) -> Dict[int, Optional[Set[str]]]:
     return out
 
 
+def symbol_spans(tree: ast.Module) -> List[Tuple[int, int, str]]:
+    """(start, end, qualname) for every def, innermost-resolvable:
+    the v2 baseline's symbol key. Classes contribute to the dotted
+    prefix but are not spans themselves (a finding on a class-body
+    line outside any method is effectively module-level churn-wise).
+    """
+    spans: List[Tuple[int, int, str]] = []
+
+    def walk(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                qual = prefix + child.name
+                spans.append((child.lineno,
+                              child.end_lineno or child.lineno, qual))
+                walk(child, qual + '.')
+            elif isinstance(child, ast.ClassDef):
+                walk(child, prefix + child.name + '.')
+            else:
+                walk(child, prefix)
+
+    walk(tree, '')
+    return spans
+
+
+def _symbol_for(spans: List[Tuple[int, int, str]], line: int) -> str:
+    best: Optional[Tuple[int, int, str]] = None
+    for span in spans:
+        if span[0] <= line <= span[1]:
+            if best is None or span[0] > best[0]:
+                best = span
+    return best[2] if best is not None else '<module>'
+
+
 def run_source(source: str, path: str,
-               select: Optional[Iterable[str]] = None) -> List[Finding]:
-    """Run the (selected) checkers over one file's source text."""
+               select: Optional[Iterable[str]] = None,
+               timings: Optional[Dict[str, float]] = None
+               ) -> List[Finding]:
+    """Run the (selected) checkers over one file's source text.
+
+    `timings` (if given) accumulates per-rule wall-clock seconds
+    across calls — the CLI surfaces it so a slow checker is visible.
+    """
     checkers = all_checkers()
     rules = set(select) if select is not None else set(checkers)
     rel = display_path(path)
@@ -186,23 +249,31 @@ def run_source(source: str, path: str,
         cls = checkers[rule]
         if not cls.applies_to(rel):
             continue
+        start = time.perf_counter()
         findings.extend(cls(FileContext(path, source)).check(tree))
+        if timings is not None:
+            timings[rule] = timings.get(rule, 0.0) + \
+                (time.perf_counter() - start)
     suppressed = suppressed_lines(source)
+    spans = symbol_spans(tree)
     kept = []
     for f in sorted(findings, key=lambda f: (f.line, f.col, f.rule)):
         rules_here = suppressed.get(f.line, ...)
         if rules_here is None or (rules_here is not ... and
                                   f.rule in rules_here):
             continue
-        kept.append(f)
+        kept.append(dataclasses.replace(
+            f, symbol=_symbol_for(spans, f.line)))
     return kept
 
 
 def run_file(path: str,
-             select: Optional[Iterable[str]] = None) -> List[Finding]:
+             select: Optional[Iterable[str]] = None,
+             timings: Optional[Dict[str, float]] = None
+             ) -> List[Finding]:
     with open(path, 'r', encoding='utf-8') as f:
         source = f.read()
-    return run_source(source, path, select)
+    return run_source(source, path, select, timings)
 
 
 def iter_python_files(paths: Sequence[str]) -> List[str]:
@@ -222,31 +293,50 @@ def iter_python_files(paths: Sequence[str]) -> List[str]:
 
 
 def run_paths(paths: Sequence[str],
-              select: Optional[Iterable[str]] = None) -> List[Finding]:
+              select: Optional[Iterable[str]] = None,
+              timings: Optional[Dict[str, float]] = None
+              ) -> List[Finding]:
     findings: List[Finding] = []
     for path in iter_python_files(paths):
-        findings.extend(run_file(path, select))
+        findings.extend(run_file(path, select, timings))
     return sorted(findings,
                   key=lambda f: (f.path, f.line, f.col, f.rule))
 
 
 # -- baseline ---------------------------------------------------------------
 class Baseline:
-    """Grandfathered findings: (path, rule, line) -> justification.
+    """Grandfathered findings, keyed (path, rule, qualified symbol).
+
+    v2 entries carry `symbol` (the enclosing def's dotted name) so a
+    triaged row survives line churn; v1 entries carry `line` and
+    still load and match — `--migrate-baseline` converts them. The
+    file also records `rule_versions`: when a checker's `version`
+    class attr is bumped (its logic changed), every row for that rule
+    stops matching and must be re-triaged against the new logic.
 
     Every entry must carry a non-empty justification — the baseline
     is for triaged FALSE positives, not a mute button."""
 
-    def __init__(self, entries: Optional[List[Dict]] = None) -> None:
+    def __init__(self, entries: Optional[List[Dict]] = None,
+                 rule_versions: Optional[Dict[str, int]] = None
+                 ) -> None:
         self.entries = entries or []
-        self._index: Dict[Tuple[str, str, int], Dict] = {}
+        self.rule_versions = dict(rule_versions or {})
+        self._line_index: Dict[Tuple[str, str, int], Dict] = {}
+        self._symbol_index: Dict[Tuple[str, str, str], Dict] = {}
         for e in self.entries:
             just = str(e.get('justification') or '').strip()
             if not just:
                 raise ValueError(
-                    f'baseline entry {e.get("path")}:{e.get("line")} '
+                    f'baseline entry {e.get("path")}:'
+                    f'{e.get("symbol", e.get("line"))} '
                     f'{e.get("rule")} lacks a justification')
-            self._index[(e['path'], e['rule'], int(e['line']))] = e
+            if 'symbol' in e:
+                self._symbol_index[
+                    (e['path'], e['rule'], str(e['symbol']))] = e
+            else:
+                self._line_index[
+                    (e['path'], e['rule'], int(e['line']))] = e
 
     @classmethod
     def load(cls, path: str) -> 'Baseline':
@@ -254,16 +344,35 @@ class Baseline:
             return cls([])
         with open(path, 'r', encoding='utf-8') as f:
             data = json.load(f)
-        return cls(data.get('entries', []))
+        return cls(data.get('entries', []),
+                   data.get('rule_versions', {}))
 
     def save(self, path: str) -> None:
+        version = 2 if not self._line_index else 1
+        doc: Dict[str, object] = {'version': version}
+        if version == 2:
+            doc['rule_versions'] = {
+                rule: self.rule_versions.get(
+                    rule, checker_versions().get(rule, 1))
+                for rule in sorted({e['rule'] for e in self.entries})}
+        doc['entries'] = self.entries
         with open(path, 'w', encoding='utf-8') as f:
-            json.dump({'version': 1, 'entries': self.entries}, f,
-                      indent=2, sort_keys=False)
+            json.dump(doc, f, indent=2, sort_keys=False)
             f.write('\n')
 
+    def _rule_current(self, rule: str) -> bool:
+        """False when the checker's logic version moved past the one
+        this baseline was written against (rows need re-triage)."""
+        stored = self.rule_versions.get(rule)
+        if stored is None:
+            return True
+        return checker_versions().get(rule, 1) == int(stored)
+
     def contains(self, finding: Finding) -> bool:
-        return finding.key() in self._index
+        if not self._rule_current(finding.rule):
+            return False
+        return (finding.symbol_key() in self._symbol_index or
+                finding.key() in self._line_index)
 
     def split(self, findings: Sequence[Finding]
               ) -> Tuple[List[Finding], List[Finding]]:
@@ -276,17 +385,56 @@ class Baseline:
     def stale_entries(self, findings: Sequence[Finding]) -> List[Dict]:
         """Entries matching no current finding — fixed code whose
         baseline row should be deleted."""
-        live = {f.key() for f in findings}
-        return [e for key, e in sorted(self._index.items())
-                if key not in live]
+        live_lines = {f.key() for f in findings}
+        live_symbols = {f.symbol_key() for f in findings}
+        stale = [e for key, e in sorted(self._line_index.items())
+                 if key not in live_lines]
+        stale += [e for key, e in sorted(self._symbol_index.items())
+                  if key not in live_symbols]
+        return stale
+
+    def migrated(self, findings: Sequence[Finding]) -> 'Baseline':
+        """v1 -> v2: rekey every line-keyed entry by the symbol of
+        the current finding it matches; entries matching nothing are
+        dropped (they were stale anyway). Symbol-keyed entries pass
+        through; duplicates collapse to one row per symbol key."""
+        by_line = {f.key(): f for f in findings}
+        entries: List[Dict] = []
+        seen: Set[Tuple[str, str, str]] = set()
+
+        def emit(entry: Dict, symbol: str) -> None:
+            key = (entry['path'], entry['rule'], symbol)
+            if key in seen:
+                return
+            seen.add(key)
+            entries.append({
+                'rule': entry['rule'], 'path': entry['path'],
+                'symbol': symbol,
+                'message': entry.get('message', ''),
+                'justification': entry['justification']})
+
+        for e in self.entries:
+            if 'symbol' in e:
+                emit(e, str(e['symbol']))
+                continue
+            f = by_line.get((e['path'], e['rule'], int(e['line'])))
+            if f is not None:
+                emit(e, f.symbol)
+        return Baseline(entries, checker_versions())
 
     @classmethod
     def from_findings(cls, findings: Sequence[Finding],
                       justification: str) -> 'Baseline':
-        return cls([{'rule': f.rule, 'path': f.path, 'line': f.line,
-                     'message': f.message,
-                     'justification': justification}
-                    for f in findings])
+        entries: List[Dict] = []
+        seen: Set[Tuple[str, str, str]] = set()
+        for f in findings:
+            if f.symbol_key() in seen:
+                continue
+            seen.add(f.symbol_key())
+            entries.append({'rule': f.rule, 'path': f.path,
+                            'symbol': f.symbol, 'message': f.message,
+                            'justification': justification})
+        return cls(entries, checker_versions())
 
 
 # -- reporters --------------------------------------------------------------
@@ -302,10 +450,15 @@ def render_text(findings: Sequence[Finding],
 
 
 def render_json(findings: Sequence[Finding],
-                baselined: Sequence[Finding] = ()) -> str:
-    return json.dumps({
+                baselined: Sequence[Finding] = (),
+                timings: Optional[Dict[str, float]] = None) -> str:
+    doc: Dict[str, object] = {
         'version': 1,
         'count': len(findings),
         'baselined_count': len(baselined),
         'findings': [f.to_dict() for f in findings],
-    }, indent=2)
+    }
+    if timings is not None:
+        doc['timings_ms'] = {rule: round(sec * 1000.0, 3)
+                             for rule, sec in sorted(timings.items())}
+    return json.dumps(doc, indent=2)

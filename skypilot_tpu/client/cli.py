@@ -433,20 +433,35 @@ def attach(cluster, node) -> None:
 @click.option('--justification', default=None,
               help='One-line reason recorded on entries written by '
                    '--write-baseline.')
+@click.option('--changed', is_flag=True, default=False,
+              help='Analyze only files changed vs --base (fast '
+                   'pre-commit iteration; uses `git diff '
+                   '--name-only`).')
+@click.option('--base', default='HEAD', metavar='REF',
+              help='Git ref --changed diffs against (default HEAD: '
+                   'uncommitted work).')
+@click.option('--migrate-baseline', 'migrate_baseline', is_flag=True,
+              default=False,
+              help='One-shot: rewrite a v1 (line-keyed) baseline as '
+                   'v2 (symbol-keyed), preserving justifications; '
+                   'stale rows are dropped.')
 def check(targets, fmt, select, baseline_path, no_baseline,
-          write_baseline, justification) -> None:
+          write_baseline, justification, changed, base,
+          migrate_baseline) -> None:
     """Static analysis (`stpu check skypilot_tpu/`) or cloud probe.
 
-    With PATH arguments — or any of --select/--format/--baseline —
-    runs the SKY static-analysis suite (async-safety, jit-purity,
-    lock discipline, metric hygiene, exception hygiene,
-    pallas-interpret reachability, span discipline; see
+    With PATH arguments — or any of --select/--format/--baseline/
+    --changed — runs the SKY static-analysis suite (async-safety,
+    jit-purity, lock discipline, metric hygiene, exception hygiene,
+    pallas-interpret reachability, span discipline, thread
+    ownership, donation discipline, fault-point drift; see
     docs/internals.md) and exits
     non-zero on any non-baselined finding. With cloud-name arguments (or none), probes cloud
     credentials and caches enabled clouds (the original behavior).
     """
     static_flags = (fmt != 'text' or select or baseline_path or
-                    no_baseline or write_baseline)
+                    no_baseline or write_baseline or changed or
+                    migrate_baseline)
     path_args = any(os.path.exists(t) or t.endswith('.py') or
                     os.sep in t for t in targets)
     if not static_flags and not path_args:
@@ -465,6 +480,11 @@ def check(targets, fmt, select, baseline_path, no_baseline,
     if not paths:
         # Default target: the installed package tree.
         paths = [analysis_core._PKG_DIR]
+    if changed:
+        paths = _changed_python_files(paths, base)
+        if not paths:
+            click.echo(f'no changed .py files vs {base}')
+            sys.exit(0)
     missing = [p for p in paths if not os.path.exists(p)]
     if missing:
         _err(f'no such path(s): {", ".join(missing)}')
@@ -472,7 +492,8 @@ def check(targets, fmt, select, baseline_path, no_baseline,
         rules = analysis.resolve_select(select)
     except ValueError as e:
         _err(str(e))
-    findings = analysis.run_paths(paths, rules)
+    timings: dict = {}
+    findings = analysis.run_paths(paths, rules, timings)
     if write_baseline:
         if not justification:
             _err('--write-baseline requires --justification '
@@ -486,15 +507,54 @@ def check(targets, fmt, select, baseline_path, no_baseline,
         return
     baseline = analysis_core.Baseline.load(
         baseline_path or analysis_core.DEFAULT_BASELINE)
+    if migrate_baseline:
+        out = baseline_path or analysis_core.DEFAULT_BASELINE
+        migrated = baseline.migrated(findings)
+        dropped = len(baseline.entries) - len(migrated.entries)
+        migrated.save(out)
+        click.echo(f'Migrated {out} to v2: {len(migrated.entries)} '
+                   f'symbol-keyed entr'
+                   f'{"y" if len(migrated.entries) == 1 else "ies"}'
+                   f'{f", {dropped} stale dropped" if dropped else ""}')
+        return
     if no_baseline:
         new, baselined = list(findings), []
     else:
         new, baselined = baseline.split(findings)
     if fmt == 'json':
-        click.echo(analysis.render_json(new, baselined))
+        click.echo(analysis.render_json(new, baselined, timings))
     else:
         click.echo(analysis.render_text(new, baselined))
     sys.exit(1 if new else 0)
+
+
+def _changed_python_files(scope_paths, base: str):
+    """`.py` files changed vs git ref `base`, intersected with the
+    requested scope — `stpu check --changed` pre-commit mode."""
+    import subprocess
+    from skypilot_tpu.analysis import core as analysis_core
+    try:
+        out = subprocess.run(
+            ['git', 'diff', '--name-only', base, '--'],
+            capture_output=True, text=True, check=True,
+            cwd=analysis_core.REPO_ROOT)
+    except (OSError, subprocess.CalledProcessError) as e:
+        detail = getattr(e, 'stderr', '') or str(e)
+        _err(f'--changed: git diff --name-only {base} failed: '
+             f'{detail.strip()}')
+    scope = [os.path.abspath(p) for p in scope_paths]
+    files = []
+    for rel in out.stdout.splitlines():
+        if not rel.endswith('.py'):
+            continue
+        path = os.path.join(analysis_core.REPO_ROOT, rel)
+        if not os.path.exists(path):
+            continue  # deleted in the diff
+        abs_path = os.path.abspath(path)
+        if any(abs_path == s or abs_path.startswith(s + os.sep)
+               for s in scope):
+            files.append(path)
+    return files
 
 
 @cli.command(name='gpus')

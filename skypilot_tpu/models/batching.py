@@ -237,6 +237,50 @@ class ContinuousBatchingEngine:
     # plus the lazy stream engine).
     _instance_ids = itertools.count()
 
+    # Thread-ownership contract, machine-checked by SKY008 (see
+    # analysis/callgraph.py for the grammar and docs/internals.md
+    # "Thread-ownership model"). Everything below is touched only by
+    # the scheduler thread (_loop); cross-thread work hops through
+    # run_on_scheduler. `cache` is STRICT ('scheduler!'): every
+    # dispatch DONATES it, so even a read from another thread races
+    # the dispatch that consumes the buffer. The scrape/HTTP threads'
+    # racy snapshot reads of the non-strict counters and slot arrays
+    # are deliberate (stale-but-consistent-enough stats) — reads of
+    # non-strict attrs are allowed; writes are not.
+    _STPU_OWNERS = {
+        'cache': 'scheduler!',
+        # slot arrays + per-slot bookkeeping
+        'cur_token': 'scheduler', 'pos': 'scheduler',
+        'active': 'scheduler', 'prefilling': 'scheduler',
+        'prefill_frontier': 'scheduler', 'prompt_len': 'scheduler',
+        'outputs': 'scheduler', 'limits': 'scheduler',
+        'temps': 'scheduler', 'top_ks': 'scheduler',
+        'top_ps': 'scheduler', 'stop_ids': 'scheduler',
+        'on_tokens': 'scheduler', 'deadlines': 'scheduler',
+        'slot_adapter': 'scheduler', 'slot_adapter_name': 'scheduler',
+        '_prefill_order': 'scheduler', '_prefill_t0': 'scheduler',
+        '_slot_ctx': 'scheduler',
+        # paged-KV state (rebuilt by _reset_paging on the scheduler)
+        'allocator': 'scheduler', 'page_table': 'scheduler',
+        'owned_pages': 'scheduler', 'allocated_tokens': 'scheduler',
+        'prefix_cache': 'scheduler', 'shared_pages': 'scheduler',
+        'slot_keys': 'scheduler',
+        # dispatch plumbing
+        '_rng': 'scheduler', '_inflight': 'scheduler',
+        '_prefill_fns': 'scheduler', '_scatter_fns': 'scheduler',
+        '_cache_shardings': 'scheduler',
+        # counters (scrape threads read these racily, on purpose)
+        'decode_calls': 'scheduler', 'tokens_committed': 'scheduler',
+        'preemptions': 'scheduler', 'prefill_chunks_run': 'scheduler',
+        'decode_stall_s': 'scheduler',
+        'last_prefill_tokens': 'scheduler',
+        'kv_restored_pages': 'scheduler',
+        'kv_restore_lookups': 'scheduler',
+        'kv_restore_hits': 'scheduler',
+        'deadline_exceeded': 'scheduler', 'engine_restarts': 'scheduler',
+        '_soft_errors': 'scheduler',
+    }
+
     def __init__(self, model, params, *, num_slots: int = 8,
                  max_total_len: int = 256, temperature: float = 0.0,
                  eos_id: Optional[int] = None,
@@ -550,7 +594,8 @@ class ContinuousBatchingEngine:
         # (device token array + the host state it was built from).
         self._inflight: Optional[Dict[str, Any]] = None
         self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread = threading.Thread(  # stpu: thread[scheduler]
+            target=self._loop, daemon=True)
         self._thread.start()
 
     def _reset_paging(self) -> None:
@@ -569,13 +614,14 @@ class ContinuousBatchingEngine:
         self.allocated_tokens = np.zeros((self.num_slots,), np.int32)
         # Prefix caching (vLLM APC): per-slot shared (read-only) page
         # refs + the prompt's chain keys for promotion on completion.
-        self.prefix_cache = (PrefixCache(self.page_size,
-                                         metrics=self.metrics,
-                                         spill=self.spill_tier,
-                                         fetch_pages=self
-                                         ._gather_page_blobs,
-                                         flight=self.flight)
-                             if self.prefix_caching else None)
+        # PrefixCache invokes fetch_pages only from restore paths that
+        # run on the engine thread, hence the role pin.
+        self.prefix_cache = (PrefixCache(
+            self.page_size, metrics=self.metrics,
+            spill=self.spill_tier,
+            fetch_pages=self._gather_page_blobs,  # stpu: role[scheduler]
+            flight=self.flight)
+            if self.prefix_caching else None)
         self.shared_pages: List[List[int]] = [
             [] for _ in range(self.num_slots)]
         self.slot_keys: List[List[bytes]] = [
@@ -1072,9 +1118,11 @@ class ContinuousBatchingEngine:
         pages + scale arrays; dense: the per-slot rows) — the
         denominator of the quantized-serving memory math
         (skypilot_serving_kv_pool_bytes)."""
+        # Metadata-only read (shape/dtype, never buffer contents):
+        # safe from scrape threads even though the cache is donated.
         return int(sum(
             leaf.size * jnp.dtype(leaf.dtype).itemsize
-            for leaf in jax.tree_util.tree_leaves(self.cache)))
+            for leaf in jax.tree_util.tree_leaves(self.cache)))  # stpu: ignore[SKY008]
 
     def kv_cache_bytes_per_device(self) -> int:
         """Bytes of the KV cache resident on ONE device: sharded pool
@@ -1084,7 +1132,8 @@ class ContinuousBatchingEngine:
         shards — the per-chip HBM figure --kv-pool-bytes budgets
         (skypilot_serving_kv_pool_bytes_per_device)."""
         total = 0
-        for leaf in jax.tree_util.tree_leaves(self.cache):
+        # Metadata-only read, same story as kv_cache_bytes.
+        for leaf in jax.tree_util.tree_leaves(self.cache):  # stpu: ignore[SKY008]
             sharding = getattr(leaf, 'sharding', None)
             shape = (sharding.shard_shape(leaf.shape)
                      if sharding is not None else leaf.shape)
@@ -1172,7 +1221,7 @@ class ContinuousBatchingEngine:
             self.attention_bytes_per_token()['total_bytes_per_token'])
 
     # -- KV page transfer + tiered cache ------------------------------------
-    def run_on_scheduler(self, fn, timeout: float = 120.0):
+    def run_on_scheduler(self, fn, timeout: float = 120.0):  # stpu: hop[scheduler]
         """Run `fn()` on the scheduler thread between rounds and
         return its result (exceptions re-raise here). The ONLY safe
         way to touch `self.cache` from another thread: every dispatch

@@ -44,10 +44,13 @@ class FlightRecorder:
             raise ValueError('flight recorder capacity must be >= 1')
         self.capacity = int(capacity)
         self.name = name
-        self._buf: List[Optional[tuple]] = [None] * self.capacity
-        self._n = 0  # total events ever recorded
+        # Single-writer ring: only the engine scheduler thread writes
+        # (SKY008-verified via the entry contract on record()); scrape
+        # threads take racy snapshot READS, which ownership permits.
+        self._buf: List[Optional[tuple]] = [None] * self.capacity  # stpu: owner[scheduler]
+        self._n = 0  # total events ever recorded  # stpu: owner[scheduler]
 
-    def record(self, kind: str, **fields: Any) -> None:
+    def record(self, kind: str, **fields: Any) -> None:  # stpu: entry[scheduler]
         """Append one event. ~Zero cost: a clock read, a tuple, one
         list slot write. Safe to call at every scheduler decision."""
         i = self._n

@@ -46,6 +46,18 @@ def _default_http_post(url: str, body: dict,
 
 class FleetController:
 
+    # The control loop is single-threaded by design: run()/tick()/
+    # safe_tick()/wait_ready() all execute on the one 'watcher'
+    # thread (the serve_fleet entrypoint's main loop), so controller
+    # state needs no locks — SKY008 verifies nothing else touches it.
+    # Drain worker threads only call manager.drain; they never write
+    # controller state.
+    _STPU_OWNERS = {
+        '_pushed_peers': 'watcher',
+        '_drain_threads': 'watcher',
+        'consecutive_tick_failures': 'watcher',
+    }
+
     def __init__(self, manager: ReplicaManager,
                  policy, autoscaler: 'autoscalers.Autoscaler', *,
                  interval_s: float = 1.0,
@@ -140,7 +152,7 @@ class FleetController:
             if endpoint not in prefill_ready:
                 del self._pushed_peers[endpoint]
 
-    def drain_replica(self, view: ReplicaView) -> None:
+    def drain_replica(self, view: ReplicaView) -> None:  # stpu: entry[watcher]
         """THE drain contract, in order: mark not-ready -> stop
         routing -> SIGTERM -> wait for the replica's own drain.
         Never kill-then-reroute."""
@@ -176,7 +188,7 @@ class FleetController:
         return ordered[:max(0, count)]
 
     # -- control loop ----------------------------------------------------
-    def tick(self, now: Optional[float] = None) -> None:
+    def tick(self, now: Optional[float] = None) -> None:  # stpu: entry[watcher]
         faults.point('fleet.tick')  # chaos: controller-loop failures
         now = now if now is not None else self._clock()
         self.manager.scrape_once()
@@ -260,7 +272,7 @@ class FleetController:
                              f'{decision.target_num_replicas}).')
                 self.drain_replica(view)
 
-    def safe_tick(self) -> bool:
+    def safe_tick(self) -> bool:  # stpu: entry[watcher]
         """One guarded tick for the control loop: failures are
         counted (`skypilot_fleet_tick_errors_total`) and escalated
         after 3 consecutive strikes (error log + the
@@ -290,14 +302,14 @@ class FleetController:
         self.consecutive_tick_failures = 0
         return True
 
-    def run(self) -> None:
+    def run(self) -> None:  # stpu: entry[watcher]
         """Tick until shutdown() (the serve_fleet entrypoint's main
         loop)."""
         while not self._shutdown.is_set():
             self.safe_tick()
             self._shutdown.wait(self.interval_s)
 
-    def wait_ready(self, count: int, timeout_s: float = 300.0,
+    def wait_ready(self, count: int, timeout_s: float = 300.0,  # stpu: entry[watcher]
                    poll_s: float = 0.2) -> bool:
         """Block until `count` replicas are READY (spawn-time helper
         for benches and the entrypoint). Runs on the injected clock

@@ -212,6 +212,12 @@ def make_lb_server(policy, port: int, *, policy_name: str,
         slo_tracker = slo_mod.SloTracker(slo_targets)
 
     class Handler(BaseHTTPRequestHandler):
+        # Runs on ThreadingHTTPServer worker threads: SKY008 assigns
+        # every do_* method the 'http' role automatically (no
+        # annotation needed). LB shared state (LBMetrics, PrefillPool,
+        # the policy's ready set) is lock-disciplined — SKY003's
+        # domain — rather than ownership-declared: many http threads
+        # legitimately write it.
 
         def log_message(self, *a):  # quiet
             pass

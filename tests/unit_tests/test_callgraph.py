@@ -1,0 +1,286 @@
+"""Call-graph builder tests: the ownership analysis' foundation.
+
+SKY008's verdicts are only as good as the graph they are computed
+over, so the graph is pinned down directly: thread-target resolution,
+self-method call chains, decorator-registered handlers, hop
+semantics, escape analysis, and — most importantly — unknown-callee
+conservatism (an unresolvable call taints its function arguments to
+ANY rather than silently dropping them).
+"""
+import ast
+
+from skypilot_tpu.analysis import callgraph
+
+
+def graph_of(src):
+    return callgraph.build(ast.parse(src), src.splitlines())
+
+
+# ---------------------------------------------------------------------------
+# thread targets + self-method chains
+# ---------------------------------------------------------------------------
+def test_thread_target_and_self_method_chain():
+    src = '''\
+import threading
+
+class Engine:
+    def __init__(self):
+        self._thread = threading.Thread(  # stpu: thread[scheduler]
+            target=self._loop, daemon=True)
+
+    def _loop(self):
+        while True:
+            self._step()
+
+    def _step(self):
+        self._commit()
+
+    def _commit(self):
+        pass
+'''
+    g = graph_of(src)
+    assert g.roles('Engine._loop') == {'scheduler'}
+    # Roles flow through self.method() chains to the leaves.
+    assert g.roles('Engine._step') == {'scheduler'}
+    assert g.roles('Engine._commit') == {'scheduler'}
+    assert g.roles('Engine.__init__') == {callgraph.INIT_ROLE}
+
+
+def test_unannotated_thread_target_gets_anonymous_role():
+    src = '''\
+import threading
+
+class C:
+    def __init__(self):
+        threading.Thread(target=self._bg).start()
+
+    def _bg(self):
+        pass
+'''
+    g = graph_of(src)
+    assert g.roles('C._bg') == {'thread:_bg'}
+
+
+def test_executor_submit_and_run_in_executor_seed_entries():
+    src = '''\
+class C:
+    def __init__(self, pool, loop):
+        pool.submit(self._work)  # stpu: thread[watcher]
+        loop.run_in_executor(None, self._aux)  # stpu: thread[lb]
+
+    def _work(self):
+        pass
+
+    def _aux(self):
+        pass
+'''
+    g = graph_of(src)
+    assert g.roles('C._work') == {'watcher'}
+    assert g.roles('C._aux') == {'lb'}
+
+
+# ---------------------------------------------------------------------------
+# handler conventions
+# ---------------------------------------------------------------------------
+def test_do_verb_methods_are_http_entries():
+    src = '''\
+class Handler:
+    def do_GET(self):
+        self._render()
+
+    def _render(self):
+        pass
+'''
+    g = graph_of(src)
+    assert g.roles('Handler.do_GET') == {'http'}
+    assert g.roles('Handler._render') == {'http'}
+
+
+def test_decorator_registered_routes_are_http_entries():
+    src = '''\
+@routes.get('/status')
+async def status(request):
+    return _body()
+
+def _body():
+    return {}
+'''
+    g = graph_of(src)
+    assert g.roles('status') == {'http'}
+    assert g.roles('_body') == {'http'}
+
+
+# ---------------------------------------------------------------------------
+# entry / hop / role annotations
+# ---------------------------------------------------------------------------
+def test_entry_annotation_seeds_role():
+    src = '''\
+class R:
+    def record(self, kind):  # stpu: entry[scheduler]
+        self._push(kind)
+
+    def _push(self, kind):
+        pass
+'''
+    g = graph_of(src)
+    assert g.roles('R.record') == {'scheduler'}
+    assert g.roles('R._push') == {'scheduler'}
+
+
+def test_hop_pins_function_arguments_to_hop_role():
+    src = '''\
+class Engine:
+    def run_on_scheduler(self, fn):  # stpu: hop[scheduler]
+        self._queue.append(fn)
+
+    def export(self):  # stpu: entry[http]
+        self.run_on_scheduler(self._do_export)
+
+    def _do_export(self):
+        pass
+'''
+    g = graph_of(src)
+    assert g.hops['Engine.run_on_scheduler'] == 'scheduler'
+    # The hopped fn runs under the hop role, NOT the caller's role —
+    # the PR-13 control-queue pattern, machine-verified.
+    assert g.roles('Engine._do_export') == {'scheduler'}
+    # The hop itself is still reachable from its callers.
+    assert 'http' in g.roles('Engine.run_on_scheduler')
+
+
+def test_role_comment_pins_escaping_reference():
+    src = '''\
+class C:
+    def __init__(self):
+        self.cache = make_cache(
+            fetch=self._fetch)  # stpu: role[scheduler]
+
+    def _fetch(self):
+        pass
+'''
+    g = graph_of(src)
+    assert g.roles('C._fetch') == {'scheduler'}
+    assert 'C._fetch' not in g.escaped
+
+
+# ---------------------------------------------------------------------------
+# unknown-callee conservatism + escapes
+# ---------------------------------------------------------------------------
+def test_unknown_callee_taints_function_args_to_any():
+    src = '''\
+class C:
+    def __init__(self):
+        register_somewhere(self._cb)
+
+    def _cb(self):
+        pass
+'''
+    g = graph_of(src)
+    # `register_somewhere` is unresolvable: `_cb` may be invoked from
+    # any thread, so it must carry ANY.
+    assert callgraph.ANY in g.roles('C._cb')
+
+
+def test_bare_reference_in_value_position_escapes():
+    src = '''\
+class C:
+    def __init__(self):
+        self.handler = self._on_event
+
+    def _on_event(self):
+        pass
+'''
+    g = graph_of(src)
+    assert callgraph.ANY in g.roles('C._on_event')
+
+
+def test_public_unannotated_method_defaults_to_any():
+    src = '''\
+class C:
+    def poke(self):
+        self._inner()
+
+    def _inner(self):
+        pass
+'''
+    g = graph_of(src)
+    assert callgraph.ANY in g.roles('C.poke')
+    assert callgraph.ANY in g.roles('C._inner')
+
+
+def test_unreached_private_function_is_any():
+    src = '''\
+def _orphan():
+    pass
+'''
+    g = graph_of(src)
+    assert graph_of(src).roles('_orphan') == {callgraph.ANY}
+    assert g.roles('no_such_function') == {callgraph.ANY}
+
+
+# ---------------------------------------------------------------------------
+# resolution details
+# ---------------------------------------------------------------------------
+def test_nested_function_resolution_prefers_innermost():
+    src = '''\
+def helper():
+    pass
+
+class C:
+    def outer(self):  # stpu: entry[watcher]
+        def helper():
+            inner_leaf()
+        helper()
+
+def inner_leaf():
+    pass
+'''
+    g = graph_of(src)
+    # The call inside `outer` hits the nested def, not the module fn.
+    assert g.roles('C.outer.<locals>.helper') == {'watcher'}
+    assert 'watcher' in g.roles('inner_leaf')
+    assert 'watcher' not in g.roles('helper')
+
+
+def test_class_instantiation_edges_reach_init():
+    src = '''\
+class Worker:
+    def __init__(self):
+        pass
+
+def spawn():  # stpu: entry[watcher]
+    return Worker()
+'''
+    g = graph_of(src)
+    assert 'watcher' in g.roles('Worker.__init__')
+
+
+# ---------------------------------------------------------------------------
+# ownership grammar parsing
+# ---------------------------------------------------------------------------
+def test_class_owned_attrs_map_and_comments():
+    src = '''\
+class Engine:
+    _STPU_OWNERS = {
+        'cache': 'scheduler!',
+        'slots': 'scheduler',
+    }
+
+    def __init__(self):
+        self.ring = []  # stpu: owner[scheduler]
+        self.free = 0
+'''
+    tree = ast.parse(src)
+    cls = tree.body[0]
+    owners = callgraph.class_owned_attrs(cls, src.splitlines())
+    assert set(owners) == {'cache', 'slots', 'ring'}
+    assert owners['cache'].role == 'scheduler'
+    assert owners['cache'].strict
+    assert not owners['slots'].strict
+    assert owners['ring'].role == 'scheduler'
+    assert not owners['ring'].strict
+
+
+def test_parse_role_strict_suffix():
+    assert callgraph.parse_role('scheduler!') == ('scheduler', True)
+    assert callgraph.parse_role('watcher') == ('watcher', False)

@@ -7,7 +7,8 @@ Three layers:
   3. the tier-1 GATE: zero non-baselined findings across
      `skypilot_tpu/` (and no stale baseline rows), so a regression in
      async-safety / jit-purity / lock discipline / metric hygiene /
-     exception hygiene fails CI the moment it lands.
+     exception hygiene / thread ownership / donation discipline /
+     fault-point drift fails CI the moment it lands.
 """
 import asyncio
 import json
@@ -439,6 +440,287 @@ def test_sky007_serving_plane_is_clean():
 
 
 # ---------------------------------------------------------------------------
+# SKY008: thread ownership
+# ---------------------------------------------------------------------------
+_OWNED_ENGINE = '''\
+import threading
+
+class Engine:
+    _STPU_OWNERS = {
+        'cache': 'scheduler!',
+        'slots': 'scheduler',
+    }
+
+    def __init__(self):
+        self.cache = {}
+        self.slots = []
+        self._thread = threading.Thread(  # stpu: thread[scheduler]
+            target=self._loop, daemon=True)
+
+    def _loop(self):
+        self.slots.append(1)
+        return len(self.cache)
+
+    def do_GET(self):
+        self.slots.append(2)
+        return len(self.cache)
+'''
+
+
+def test_sky008_cross_thread_write_and_strict_read_flagged():
+    findings = analysis.run_source(_OWNED_ENGINE, 'm.py', ['SKY008'])
+    # do_GET runs on http: the write to `slots` and the READ of the
+    # strict-owned `cache` are both violations; the non-strict read
+    # of `slots`' owner is fine, and `_loop` (scheduler) is clean.
+    assert [(f.rule, f.line, f.symbol) for f in findings] == [
+        ('SKY008', 20, 'Engine.do_GET'),
+        ('SKY008', 21, 'Engine.do_GET')]
+    assert 'owned by scheduler' in findings[0].message
+    assert 'http' in findings[0].message
+
+
+def test_sky008_is_the_pr13_control_queue_detector():
+    """Non-vacuity: the exact bug class the control queue fixed —
+    an HTTP export touching scheduler-owned state directly — is
+    caught, and hopping through `run_on_scheduler` clears it."""
+    buggy = '''\
+import threading
+
+class Engine:
+    _STPU_OWNERS = {'cache': 'scheduler!'}
+
+    def __init__(self):
+        self.cache = {}
+        threading.Thread(  # stpu: thread[scheduler]
+            target=self._loop).start()
+
+    def _loop(self):
+        self.cache['k'] = 1
+
+    def export(self):  # stpu: entry[http]
+        return dict(self.cache)
+'''
+    assert rules_lines(buggy, select=['SKY008']) == [('SKY008', 15)]
+    hopped = buggy.replace(
+        "        return dict(self.cache)",
+        "        return self.run_on_scheduler(self._do_export)\n"
+        "\n"
+        "    def run_on_scheduler(self, fn):  # stpu: hop[scheduler]\n"
+        "        return fn()\n"
+        "\n"
+        "    def _do_export(self):\n"
+        "        return dict(self.cache)")
+    assert rules_lines(hopped, select=['SKY008']) == []
+
+
+def test_sky008_lock_holders_and_unowned_classes_exempt():
+    src = '''\
+import threading
+
+class Engine:
+    _STPU_OWNERS = {'slots': 'scheduler'}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.slots = []
+        threading.Thread(  # stpu: thread[scheduler]
+            target=self._loop).start()
+
+    def _loop(self):
+        self.slots.append(1)
+
+    def poke(self):
+        with self._lock:
+            self.slots.append(2)
+
+class Plain:
+    def __init__(self):
+        self.slots = []
+
+    def poke(self):
+        self.slots.append(1)
+'''
+    assert rules_lines(src, select=['SKY008']) == []
+
+
+def test_sky008_ownership_drift_declared_but_never_assigned():
+    src = '''\
+class Engine:
+    _STPU_OWNERS = {'ghost': 'scheduler'}
+
+    def __init__(self):
+        self.real = 1
+'''
+    findings = analysis.run_source(src, 'm.py', ['SKY008'])
+    assert [(f.rule, f.line) for f in findings] == [('SKY008', 2)]
+    assert 'ghost' in findings[0].message
+
+
+def test_sky008_owner_declared_attrs_leave_sky003():
+    """The migration contract: ownership replaces lock discipline
+    for declared attributes — SKY003 no longer fires on them."""
+    src = '''\
+import threading
+
+class Engine:
+    _STPU_OWNERS = {'slots': 'scheduler'}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.slots = []
+        self.other = 0
+
+    def mutate(self):
+        self.slots.append(1)
+        self.other += 1
+'''
+    assert rules_lines(src, select=['SKY003']) == [('SKY003', 13)]
+
+
+# ---------------------------------------------------------------------------
+# SKY009: donation discipline
+# ---------------------------------------------------------------------------
+def test_sky009_use_after_donation_flagged_rebind_clean():
+    src = '''\
+import jax
+from functools import partial
+
+@partial(jax.jit, donate_argnums=(0,))
+def step(cache, x):
+    return cache
+
+def bad(cache, x):
+    out = step(cache, x)
+    return cache.shape
+
+def good(cache, x):
+    cache = step(cache, x)
+    return cache.shape
+'''
+    findings = analysis.run_source(src, 'm.py', ['SKY009'])
+    assert [(f.rule, f.line, f.symbol) for f in findings] == [
+        ('SKY009', 10, 'bad')]
+    assert 'donated' in findings[0].message
+
+
+def test_sky009_tracks_jit_assignments_and_self_attrs():
+    src = '''\
+import jax
+
+class Engine:
+    def _pin_cache_out(self):
+        return {}
+
+    def __init__(self, f):
+        self._fn = jax.jit(f, donate_argnums=(0,),
+                           out_shardings=None)
+
+    def drive(self, cache, x):
+        y = self._fn(cache, x)
+        return cache.sum()
+'''
+    findings = analysis.run_source(src, 'm.py', ['SKY009'])
+    assert [(f.rule, f.line, f.symbol) for f in findings] == [
+        ('SKY009', 13, 'Engine.drive')]
+    assert 'self.cache' not in findings[0].message  # local, not attr
+
+
+def test_sky009_missing_cache_pin_flagged_in_pin_classes():
+    src = '''\
+import jax
+
+class Engine:
+    def _pin_cache_out(self):
+        return {}
+
+    def __init__(self, f, g):
+        self._a = jax.jit(f, donate_argnums=(0,))
+        self._b = jax.jit(g, donate_argnums=(0,),
+                          **self._pin_cache_out(0))
+'''
+    findings = analysis.run_source(src, 'm.py', ['SKY009'])
+    assert [(f.rule, f.line) for f in findings] == [('SKY009', 8)]
+    assert '_pin_cache_out' in findings[0].message
+    # Outside a pin-aware class the pin rule does not apply.
+    free = '''\
+import jax
+
+def make(f):
+    return jax.jit(f, donate_argnums=(0,))
+'''
+    assert rules_lines(free, select=['SKY009']) == []
+
+
+# ---------------------------------------------------------------------------
+# SKY010: fault-point drift
+# ---------------------------------------------------------------------------
+def test_sky010_unknown_and_dynamic_point_names():
+    from skypilot_tpu.analysis.checkers import fault_points
+    fault_points.reset_caches()
+    src = '''\
+from skypilot_tpu.robustness import faults
+
+def f(name):
+    faults.point('engine.decode_step')
+    faults.point('engine.nope')
+    faults.point(name)
+'''
+    findings = analysis.run_source(src, 'm.py', ['SKY010'])
+    assert [(f.rule, f.line) for f in findings] == [
+        ('SKY010', 5), ('SKY010', 6)]
+    assert 'engine.nope' in findings[0].message
+
+
+def test_sky010_direct_import_and_unrelated_point_fns():
+    src = '''\
+from skypilot_tpu.robustness.faults import point
+
+def f():
+    point('engine.bogus')
+'''
+    assert rules_lines(src, select=['SKY010']) == [('SKY010', 4)]
+    clean = '''\
+def point(name):
+    return name
+
+def f():
+    point('whatever.name')
+'''
+    assert rules_lines(clean, select=['SKY010']) == []
+
+
+def test_sky010_catalog_matches_docs_table():
+    """KNOWN_POINTS <-> internals.md section 11 must agree exactly
+    (that IS the rule); checked here directly so a drift shows up
+    even if someone disables the checker."""
+    from skypilot_tpu.analysis.checkers import fault_points
+    fault_points.reset_caches()
+    known = set(fault_points.known_points())
+    documented = fault_points.documented_points()
+    assert documented is not None, 'docs/internals.md table missing'
+    assert known == set(documented)
+    assert len(known) >= 10
+
+
+def test_sky010_every_point_has_a_fire_site():
+    """Reverse direction of drift: a cataloged point that nothing
+    fires is dead weight. Every KNOWN_POINTS name (minus derived
+    rule-only points) must appear at a `faults.point(...)` call
+    site somewhere in the package."""
+    import re
+    from skypilot_tpu.analysis.checkers import fault_points
+    fired = set()
+    pat = re.compile(r'''\bpoint\(\s*['"]([A-Za-z0-9_.]+)['"]''')
+    for path in acore.iter_python_files([PKG]):
+        with open(path, 'r', encoding='utf-8') as f:
+            fired.update(pat.findall(f.read()))
+    needed = (set(fault_points.known_points()) -
+              fault_points.DERIVED_POINTS)
+    missing = needed - fired
+    assert not missing, f'cataloged but never fired: {sorted(missing)}'
+
+
+# ---------------------------------------------------------------------------
 # framework: suppressions, baseline, select, reporters
 # ---------------------------------------------------------------------------
 def test_suppression_comment_exact_rule():
@@ -457,7 +739,7 @@ def test_select_unknown_rule_raises():
     with pytest.raises(ValueError, match='SKY999'):
         analysis.resolve_select('SKY999')
     assert analysis.resolve_select('sky001') == {'SKY001'}
-    assert len(analysis.resolve_select(None)) == 7
+    assert len(analysis.resolve_select(None)) == 10
 
 
 def test_syntax_error_reported_not_crashed():
@@ -481,6 +763,93 @@ def test_baseline_round_trip(tmp_path):
     with pytest.raises(ValueError, match='justification'):
         acore.Baseline([{'rule': 'SKY001', 'path': 'a.py', 'line': 3,
                          'justification': ''}])
+
+
+def test_findings_carry_enclosing_symbol():
+    src = '''\
+import time
+
+class Svc:
+    async def handler(self):
+        time.sleep(1)
+
+async def top():
+    time.sleep(1)
+'''
+    findings = analysis.run_source(src, 'a.py', ['SKY001'])
+    assert [f.symbol for f in findings] == ['Svc.handler', 'top']
+
+
+def test_baseline_v2_symbol_match_survives_line_shift(tmp_path):
+    src = 'import time\nasync def f():\n    time.sleep(1)\n'
+    findings = analysis.run_source(src, 'a.py')
+    b = acore.Baseline.from_findings(findings, 'triaged')
+    path = tmp_path / 'baseline.json'
+    b.save(str(path))
+    data = json.loads(path.read_text())
+    assert data['version'] == 2
+    assert 'rule_versions' in data
+    assert data['entries'][0]['symbol'] == 'f'
+    assert 'line' not in data['entries'][0]
+    # The same finding three lines further down still matches: v2
+    # keys on (rule, path, symbol), not line numbers.
+    shifted = analysis.run_source('\n\n\n' + src, 'a.py')
+    loaded = acore.Baseline.load(str(path))
+    new, old = loaded.split(shifted)
+    assert new == [] and len(old) == 1
+    assert loaded.stale_entries(shifted) == []
+
+
+def test_baseline_v1_line_keyed_rows_still_match(tmp_path):
+    path = tmp_path / 'baseline.json'
+    path.write_text(json.dumps({'version': 1, 'entries': [
+        {'rule': 'SKY001', 'path': 'a.py', 'line': 3,
+         'justification': 'legacy row'}]}))
+    loaded = acore.Baseline.load(str(path))
+    findings = analysis.run_source(
+        'import time\nasync def f():\n    time.sleep(1)\n', 'a.py')
+    new, old = loaded.split(findings)
+    assert new == [] and len(old) == 1
+    # ...but a line shift breaks a v1 row (the reason v2 exists).
+    shifted = analysis.run_source(
+        '\nimport time\nasync def f():\n    time.sleep(1)\n', 'a.py')
+    new2, _ = loaded.split(shifted)
+    assert len(new2) == 1
+
+
+def test_baseline_migrate_v1_to_v2(tmp_path):
+    findings = analysis.run_source(
+        'import time\nasync def f():\n    time.sleep(1)\n', 'a.py')
+    v1 = acore.Baseline([
+        {'rule': 'SKY001', 'path': 'a.py', 'line': 3,
+         'justification': 'keep me'},
+        {'rule': 'SKY001', 'path': 'gone.py', 'line': 9,
+         'justification': 'stale: file deleted'}])
+    migrated = v1.migrated(findings)
+    # The matching row is rekeyed by symbol (justification intact);
+    # the unmatched row is dropped as stale.
+    assert [e['symbol'] for e in migrated.entries] == ['f']
+    assert migrated.entries[0]['justification'] == 'keep me'
+    path = tmp_path / 'baseline.json'
+    migrated.save(str(path))
+    assert json.loads(path.read_text())['version'] == 2
+    new, old = acore.Baseline.load(str(path)).split(findings)
+    assert new == [] and len(old) == 1
+
+
+def test_baseline_rule_version_bump_invalidates_rows():
+    findings = analysis.run_source(
+        'import time\nasync def f():\n    time.sleep(1)\n', 'a.py')
+    entry = {'rule': 'SKY001', 'path': 'a.py', 'symbol': 'f',
+             'message': findings[0].message, 'justification': 'j'}
+    current = acore.Baseline([dict(entry)],
+                             acore.checker_versions())
+    assert current.split(findings)[0] == []
+    # A stored version behind the checker's current one means the
+    # row was triaged against old logic: it no longer matches.
+    outdated = acore.Baseline([dict(entry)], {'SKY001': 0})
+    new, old = outdated.split(findings)
+    assert len(new) == 1 and old == []
 
 
 def test_reporters():
@@ -517,6 +886,27 @@ def test_tier1_gate_zero_non_baselined_findings():
     assert stale == [], ('baseline rows no longer matching any finding '
                          '(delete them):\n' +
                          '\n'.join(str(e) for e in stale))
+
+
+def test_new_rules_clean_repo_wide_without_baseline():
+    """SKY008/SKY009/SKY010 repo-wide, no baseline: the ownership
+    migration left the package fully clean — violations of the new
+    rules are fixed (or inline-justified), never grandfathered."""
+    findings = analysis.run_paths([PKG],
+                                  ['SKY008', 'SKY009', 'SKY010'])
+    assert findings == [], '\n'.join(f.render() for f in findings)
+
+
+def test_committed_baseline_is_v2_and_nearly_empty():
+    """The 74 SKY003 rows batching.py used to carry are gone: the
+    scheduler-ownership declarations replaced them. The committed
+    baseline must stay v2 and small (<= 10 rows) so it never again
+    becomes a dumping ground."""
+    with open(acore.DEFAULT_BASELINE, 'r', encoding='utf-8') as f:
+        data = json.load(f)
+    assert data['version'] == 2
+    assert len(data['entries']) <= 10
+    assert all('symbol' in e for e in data['entries'])
 
 
 def test_dashboard_sky001_findings_fixed_not_baselined():
@@ -585,6 +975,80 @@ def test_cli_check_select_filters(tmp_path):
                            ['check', '--select', 'SKY005', str(bad)])
     assert r.exit_code == 1
     assert 'SKY005' in r.output and 'SKY001' not in r.output
+
+
+def test_cli_check_json_reports_per_rule_timings(tmp_path):
+    from click.testing import CliRunner
+    from skypilot_tpu.client import cli
+    clean = tmp_path / 'clean.py'
+    clean.write_text('def f():\n    return 1\n')
+    r = CliRunner().invoke(cli.cli,
+                           ['check', '--format', 'json', str(clean)])
+    assert r.exit_code == 0, r.output
+    timings = json.loads(r.output)['timings_ms']
+    for rule in ('SKY001', 'SKY008', 'SKY009', 'SKY010'):
+        assert rule in timings
+        assert timings[rule] >= 0
+
+
+def test_cli_check_changed_empty_scope_exits_zero(tmp_path):
+    # Nothing in the repo's `git diff` intersects a tmp scope, so
+    # --changed short-circuits cleanly without analyzing anything.
+    from click.testing import CliRunner
+    from skypilot_tpu.client import cli
+    r = CliRunner().invoke(
+        cli.cli, ['check', '--changed', str(tmp_path)])
+    assert r.exit_code == 0, r.output
+    assert 'no changed .py files' in r.output
+
+
+def test_cli_check_changed_analyzes_diffed_files(tmp_path,
+                                                 monkeypatch):
+    from click.testing import CliRunner
+    from skypilot_tpu.client import cli
+    bad = tmp_path / 'server'
+    bad.mkdir()
+    f = bad / 'handler.py'
+    f.write_text('import time\nasync def h():\n    time.sleep(1)\n')
+    seen = {}
+
+    def fake_changed(scope, base):
+        seen['base'] = base
+        return [str(f)]
+
+    monkeypatch.setattr(cli, '_changed_python_files', fake_changed)
+    r = CliRunner().invoke(
+        cli.cli, ['check', '--changed', '--base', 'main~1',
+                  str(tmp_path)])
+    assert seen['base'] == 'main~1'
+    assert r.exit_code == 1
+    assert 'SKY001' in r.output
+
+
+def test_cli_check_migrate_baseline(tmp_path):
+    from click.testing import CliRunner
+    from skypilot_tpu.client import cli
+    target = tmp_path / 'a.py'
+    target.write_text(
+        'import time\nasync def f():\n    time.sleep(1)\n')
+    bpath = tmp_path / 'baseline.json'
+    bpath.write_text(json.dumps({'version': 1, 'entries': [
+        {'rule': 'SKY001', 'path': str(target), 'line': 3,
+         'justification': 'legacy'},
+        {'rule': 'SKY001', 'path': str(target), 'line': 99,
+         'justification': 'stale'}]}))
+    r = CliRunner().invoke(
+        cli.cli, ['check', '--migrate-baseline',
+                  '--baseline', str(bpath), str(target)])
+    assert r.exit_code == 0, r.output
+    assert 'Migrated' in r.output and '1 stale dropped' in r.output
+    data = json.loads(bpath.read_text())
+    assert data['version'] == 2
+    assert [e['symbol'] for e in data['entries']] == ['f']
+    # Post-migration the check is clean against the new baseline.
+    r2 = CliRunner().invoke(
+        cli.cli, ['check', '--baseline', str(bpath), str(target)])
+    assert r2.exit_code == 0, r2.output
 
 
 def test_cli_check_cloud_mode_still_works(monkeypatch):
