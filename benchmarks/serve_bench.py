@@ -123,12 +123,13 @@ def _server_env(args) -> dict:
         # this env var (ops/pallas_paged.resolve_impl) — serve_lm
         # needs no flag of its own.
         env['SKYPILOT_TPU_PAGED_IMPL'] = args.paged_impl
-    if args.tensor > 1:
+    chips = max(args.tensor, 1) * max(getattr(args, 'stages', 1), 1)
+    if chips > 1:
         flags = env.get('XLA_FLAGS', '')
         if '--xla_force_host_platform_device_count' not in flags:
             env['XLA_FLAGS'] = (
                 f'{flags} --xla_force_host_platform_device_count='
-                f'{args.tensor}').strip()
+                f'{chips}').strip()
     return env
 
 
@@ -150,6 +151,8 @@ def _build_server_cmd(args, adapter_dir=None) -> list:
         cmd += ['--kv-cold-dir', args.kv_cold_dir]
     if args.tensor > 1:
         cmd += ['--tensor', str(args.tensor)]
+    if getattr(args, 'stages', 1) > 1:
+        cmd += ['--stages', str(args.stages)]
     if adapter_dir:
         cmd += ['--adapter-dir', adapter_dir,
                 '--max-adapters', str(max(args.max_adapters,
@@ -737,6 +740,15 @@ def _run_single(args, adapter_dir=None, assignment=None) -> dict:
             'kv_pool_bytes_per_device': (stats.get('page_pool')
                                          or {}).get(
                 'pool_bytes_per_device'),
+            # Pipeline-parallel serving (PR 19): per-stage pool split
+            # (each stage owns only its layer range's bytes) and the
+            # engine's closed-form (S-1)/(M+S-1) bubble of the last
+            # prefill burst.
+            'kv_pool_stages': (stats.get('page_pool') or {}).get(
+                'stages'),
+            'pipeline_stages': stats.get('pipeline_stages'),
+            'prefill_bubble_fraction': stats.get(
+                'prefill_bubble_fraction'),
             'prefix_hit_rate': (stats.get('prefix_cache') or {}).get(
                 'hit_rate'),
             'prefix_evictions': (stats.get('prefix_cache') or {}).get(
@@ -749,9 +761,15 @@ def _run_single(args, adapter_dir=None, assignment=None) -> dict:
             # the server runs without --kv-spill-bytes).
             'kv_spill': stats.get('kv_spill'),
             'tensor': args.tensor,
+            'stages': max(getattr(args, 'stages', 1), 1),
             'req_per_sec': round(len(latencies) / elapsed, 2),
+            # "chips" = the full (stage, tensor) mesh: per-chip
+            # numbers stay comparable between TP-only and TPxPP arms
+            # at equal device count.
             'per_chip_req_per_sec': round(
-                len(latencies) / elapsed / max(args.tensor, 1), 2),
+                len(latencies) / elapsed /
+                (max(args.tensor, 1) *
+                 max(getattr(args, 'stages', 1), 1)), 2),
             'ttft_n_samples': len(ttfts),
             'p50_ttft_ms': pct_ms(ttfts, 0.50),
             'p95_ttft_ms': pct_ms(ttfts, 0.95),
@@ -796,8 +814,14 @@ def _run_single(args, adapter_dir=None, assignment=None) -> dict:
             # fraction_of_hbm_peak ~= how much of the memory roof the
             # decode loop actually sustains; on CPU it is a sanity
             # denominator, on TPU the tuning target.
+            # bytes_per_token_model is already per-chip under stage
+            # and tensor splits (each chip walks only its own stage's
+            # layers / kv-head shard), so dividing tokens/s by the
+            # full chip count keeps the roofline product honest.
             tokens_per_s = d_tokens / elapsed
-            per_chip = tokens_per_s / max(args.tensor, 1)
+            per_chip = tokens_per_s / (
+                max(args.tensor, 1) *
+                max(getattr(args, 'stages', 1), 1))
             bytes_per_s = per_chip * bpt['total_bytes_per_token']
             record['roofline'] = {
                 'attention_impl': stats.get('attention_impl'),
@@ -925,6 +949,80 @@ def run_tensor_ab(args) -> dict:
                   'kv_shard_ways': rec['kv_shard_ways'],
                   'kv_pool_bytes_per_device':
                       rec['kv_pool_bytes_per_device'],
+                  'preemptions': rec['preemptions'],
+                  'prefix_hit_rate': rec['prefix_hit_rate']}
+            for arm, rec in runs.items()}
+    return out
+
+
+def run_pp_ab(args) -> dict:
+    """TP-only vs TP x PP at EQUAL chip count over the identical
+    greedy workload (the committed BENCH_tp_pp record): with
+    --tensor T --stages S the arms are tensor=T*S/stages=1 and
+    tensor=T/stages=S on the same T*S virtual chips. The staged arm
+    splits the KV pool by LAYER RANGE on top of the kv-heads shard —
+    --kv-pool-bytes is per chip, so at fixed per-chip HBM the staged
+    pool holds ~S x the pages per shard group (`pool_pages_ratio`)
+    — while the pipelined chunk stream prices prefill at the
+    closed-form (S-1)/(M+S-1) fill/drain bubble and the S-deep
+    decode ring keeps p99 ITL within a small factor of TP-only
+    (`decode_itl_p99_ratio`; the acceptance gate is <= 1.25)."""
+    s = max(2, args.stages)
+    t = max(1, args.tensor)
+    chips = s * t
+    tp_arm, pp_arm = f'tp{chips}', f'tp{t}_pp{s}'
+    runs = {
+        tp_arm: _run_single(_with(args, tensor=chips, stages=1)),
+        pp_arm: _run_single(_with(args, tensor=t, stages=s)),
+    }
+    base, pp = runs[tp_arm], runs[pp_arm]
+    from skypilot_tpu.parallel.pipeline_schedule import (
+        make_inference_schedule)
+    base_roof = base.get('roofline') or {}
+    pp_roof = pp.get('roofline') or {}
+    out = {
+        'bench': 'serve_tp_pp',
+        'engine': args.engine,
+        'model': args.model,
+        'chips': chips,
+        'tensor': t,
+        'stages': s,
+        'requests': args.requests,
+        'concurrency': args.concurrency,
+        'kv_dtype': args.kv_dtype or 'bf16',
+        # Headlines: per-chip decode throughput and tail ITL of the
+        # staged arm relative to TP-only at the same chip count.
+        'per_chip_req_ratio': round(
+            pp['per_chip_req_per_sec'] /
+            max(base['per_chip_req_per_sec'], 1e-9), 3),
+        'per_chip_decode_tokens_ratio': round(
+            (pp_roof.get('per_chip_tokens_per_s') or 0.0) /
+            max(base_roof.get('per_chip_tokens_per_s') or 0.0, 1e-9),
+            3),
+        'decode_itl_p99_ratio': round(
+            (pp['itl_ms_p99'] or 0.0) /
+            max(base['itl_ms_p99'] or 0.0, 1e-9), 3),
+        # The staged arm's measured last-burst bubble plus the
+        # analytic (S-1)/(M+S-1) table it must sit in — read from
+        # the schedule object, not re-derived here.
+        'prefill_bubble_fraction': pp['prefill_bubble_fraction'],
+        'prefill_bubble_closed_form': {
+            f'microbatches_{m}': round(
+                make_inference_schedule(s, m).bubble_fraction, 6)
+            for m in (1, 2, 4, 8)},
+        'runs': runs,
+    }
+    if args.kv_pool_bytes:
+        out['kv_pool_bytes_per_chip'] = args.kv_pool_bytes
+        out['pool_pages_ratio'] = round(
+            (pp['kv_pages_total'] or 0) /
+            max(base['kv_pages_total'] or 0, 1), 3)
+        out['pool_capacity'] = {
+            arm: {'kv_pages_total': rec['kv_pages_total'],
+                  'kv_shard_ways': rec['kv_shard_ways'],
+                  'kv_pool_bytes_per_device':
+                      rec['kv_pool_bytes_per_device'],
+                  'kv_pool_stages': rec['kv_pool_stages'],
                   'preemptions': rec['preemptions'],
                   'prefix_hit_rate': rec['prefix_hit_rate']}
             for arm, rec in runs.items()}
@@ -1354,6 +1452,13 @@ def main() -> None:
                              'XLA_FLAGS=--xla_force_host_platform_'
                              'device_count=N. The JSON line gains '
                              'per_chip_req_per_sec')
+    parser.add_argument('--stages', type=int, default=1,
+                        help='forwarded to serve_lm --stages S '
+                             '(pipeline-parallel serving over S '
+                             'stages; total chips = S x --tensor). '
+                             'Needs --engine continuous; per-chip '
+                             'normalization divides by the full '
+                             '(stage, tensor) mesh')
     parser.add_argument('--quant-ab', action='store_true',
                         help='run bf16-KV vs int8-KV (same '
                              '--kv-pool-bytes) vs int8-KV+int8-'
@@ -1388,6 +1493,16 @@ def main() -> None:
                              'identical workload and emit one '
                              'combined JSON object (per-chip req/s '
                              'scaling)')
+    parser.add_argument('--pp-ab', action='store_true',
+                        help='run TP-only (tensor=T*S) vs TP x PP '
+                             '(tensor=T, stages=S) at EQUAL chip '
+                             'count over the identical greedy '
+                             'workload and emit one combined JSON '
+                             'object (the committed BENCH_tp_pp '
+                             'record: per-chip decode tokens/s, '
+                             'TTFT, closed-form prefill bubble, '
+                             'per-stage pool capacity). Requires '
+                             '--stages >= 2')
     parser.add_argument('--hf', default=None,
                         help='serve a local HF checkpoint directory')
     parser.add_argument('--ckpt-dir', default=None)
@@ -1441,6 +1556,20 @@ def main() -> None:
         parser.error('--quant-ab is a single-server mode')
     if args.tensor_ab and (args.replicas or args.adapters):
         parser.error('--tensor-ab is a single-server mode')
+    if args.pp_ab:
+        if args.replicas or args.adapters:
+            parser.error('--pp-ab is a single-server mode')
+        if args.stages < 2:
+            parser.error('--pp-ab needs --stages >= 2 (the staged '
+                         'arm runs tensor x stages; the TP-only arm '
+                         'spends the same chips on tensor alone)')
+        if args.engine != 'continuous':
+            parser.error('--pp-ab needs --engine continuous '
+                         '(pipeline-stage dispatch lives in the '
+                         'paged slot engine)')
+    if args.stages > 1 and args.engine != 'continuous':
+        parser.error('--stages needs --engine continuous (serve_lm '
+                     '--stages requires --continuous-batching)')
 
     if args.disagg_ab:
         if args.spill_ab or args.adapters or args.quant_ab:
@@ -1479,6 +1608,9 @@ def main() -> None:
         return
     if args.tensor_ab:
         _emit(run_tensor_ab(args))
+        return
+    if args.pp_ab:
+        _emit(run_pp_ab(args))
         return
 
     if args.replicas:

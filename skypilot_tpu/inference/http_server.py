@@ -272,6 +272,11 @@ def make_server(rt: InferenceRuntime,
                         # "Sharded serving"): devices the engines'
                         # state spans (1 = single device).
                         'mesh_devices': rt.mesh_devices,
+                        # Pipeline-parallel serving (--stages): stage
+                        # count of the (stage, tensor) mesh (1 = no
+                        # stage split; tensor ways = mesh_devices /
+                        # stages).
+                        'stages': rt.stages,
                         # Fused kernel path (docs/guides.md "Fused
                         # kernel path & roofline"): why the COMPILED
                         # pallas route is unavailable here, or null
@@ -311,6 +316,12 @@ def make_server(rt: InferenceRuntime,
                 'prefill_backlog_tokens':
                     engine.prefill_backlog_tokens(),
                 'decode_stall_s': round(engine.decode_stall_s, 4),
+                # Pipeline-parallel serving (--stages): stage count
+                # and the closed-form (S-1)/(M+S-1) fill/drain bubble
+                # of the last prefill burst (0.0 when unstaged).
+                'pipeline_stages': engine.stages,
+                'prefill_bubble_fraction': round(
+                    engine._prefill_bubble, 6),
                 # Fused kernel path + analytic HBM roofline inputs
                 # (ops/pallas_paged.py; serve_bench scores achieved
                 # tokens/s against bytes_per_token * HBM peak).
@@ -346,6 +357,12 @@ def make_server(rt: InferenceRuntime,
                         engine.kv_cache_bytes_per_device(),
                     'shard_ways': engine.kv_shard_ways,
                 }
+                if engine.stages > 1:
+                    # Staged pool split: every stage stores the same
+                    # page indices (one shared allocator) but only
+                    # its own layer range's bytes.
+                    body['page_pool']['stages'] = \
+                        engine.stage_pool_stats()
                 if engine.prefix_cache is not None:
                     pc = engine.prefix_cache
                     body['prefix_cache'] = {

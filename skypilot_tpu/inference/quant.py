@@ -147,7 +147,8 @@ class QuantizedModel:
         return getattr(self.base_model, name)
 
 
-def kv_page_bytes(cfg, kv_dtype: str, shard_ways: int = 1) -> int:
+def kv_page_bytes(cfg, kv_dtype: str, shard_ways: int = 1,
+                  stages: int = 1) -> int:
     """Device bytes ONE physical KV page costs across all layers ON
     ONE CHIP (K + V values, plus scale slots for int8) — the unit the
     --kv-pool-bytes knob divides by, so a byte budget maps to the
@@ -158,7 +159,13 @@ def kv_page_bytes(cfg, kv_dtype: str, shard_ways: int = 1) -> int:
     stores 1/shard_ways of the VALUE bytes but the FULL scale rows
     (per-token scales replicate — every head shard quantizes against
     the same scale), so an N-way pool's per-chip page is cheaper and
-    the same per-chip budget buys ~N x the pages."""
+    the same per-chip budget buys ~N x the pages.
+
+    `stages` is the pipeline-stage count (PR 19): each stage's chips
+    hold pages for only that stage's layers — the WIDEST stage
+    (ceil(num_layers / stages), stage_layer_ranges front-loads the
+    remainder) bounds the per-chip cost, so an S-stage T-way mesh
+    holds ~S·T x the pages at the same per-chip budget."""
     import jax.numpy as jnp
     per_layer = 2 * cfg.num_kv_heads * cfg.kv_page_size * cfg.head_dim
     if cfg.num_kv_heads % shard_ways:
@@ -166,6 +173,10 @@ def kv_page_bytes(cfg, kv_dtype: str, shard_ways: int = 1) -> int:
             f'shard_ways={shard_ways} does not divide num_kv_heads='
             f'{cfg.num_kv_heads} (the GQA remainder rule replicates '
             f'instead — pass shard_ways=1)')
+    if stages < 1 or stages > cfg.num_layers:
+        raise ValueError(
+            f'stages={stages} must be in [1, num_layers='
+            f'{cfg.num_layers}]')
     if kv_dtype == 'int8':
         value_bytes = per_layer // shard_ways
         scale_bytes = 2 * cfg.kv_page_size * 4
@@ -173,23 +184,27 @@ def kv_page_bytes(cfg, kv_dtype: str, shard_ways: int = 1) -> int:
         value_bytes = (per_layer // shard_ways *
                        jnp.dtype(cfg.dtype).itemsize)
         scale_bytes = 0
-    return cfg.num_layers * (value_bytes + scale_bytes)
+    stage_layers = -(-cfg.num_layers // stages)  # ceil: widest stage
+    return stage_layers * (value_bytes + scale_bytes)
 
 
 def pool_pages_for_bytes(cfg, kv_dtype: str, pool_bytes: int,
-                         shard_ways: int = 1) -> int:
+                         shard_ways: int = 1, stages: int = 1) -> int:
     """Physical pages a PER-CHIP byte budget buys under `kv_dtype` —
     how serve_lm --kv-pool-bytes sizes kv_total_pages (int8 fits ~2x
     the pages of bf16 in the same bytes; a pool head-sharded
     `shard_ways` ways fits ~shard_ways more again at the same
-    per-chip HBM)."""
-    pages = pool_bytes // kv_page_bytes(cfg, kv_dtype, shard_ways)
+    per-chip HBM, and splitting layers over `stages` pipeline stages
+    multiplies by ~stages on top — each stage stores only its own
+    layers' pages)."""
+    pages = pool_bytes // kv_page_bytes(cfg, kv_dtype, shard_ways,
+                                        stages)
     if pages < 2:
         raise ValueError(
             f'--kv-pool-bytes {pool_bytes} buys {pages} pages '
-            f'({kv_page_bytes(cfg, kv_dtype, shard_ways)} bytes/page '
-            f'across layers, kv_dtype={kv_dtype}); need >= 2 (page 0 '
-            f'is the trash page)')
+            f'({kv_page_bytes(cfg, kv_dtype, shard_ways, stages)} '
+            f'bytes/page across layers, kv_dtype={kv_dtype}); need '
+            f'>= 2 (page 0 is the trash page)')
     return int(pages)
 
 

@@ -253,6 +253,11 @@ class InferenceRuntime:
         self.mesh = mesh
         self.mesh_devices = (int(mesh.devices.size)
                              if mesh is not None else 1)
+        # Pipeline-parallel stage count (--stages; 1 = no split):
+        # /stats `storage.stages` alongside mesh_devices, so
+        # tensor_ways = mesh_devices / stages.
+        self.stages = (int(mesh.shape.get('stage', 1))
+                       if mesh is not None else 1)
         # Disaggregated serving (docs/guides.md "Disaggregated
         # serving & cache tiering"): '' = unified replica (the
         # classic mode), 'decode' labels a decode-pool member,
@@ -695,18 +700,27 @@ def build_runtime(args) -> InferenceRuntime:
         shard_ways = kv_shard_ways(
             int(getattr(cfg, 'num_kv_heads', 0) or 0),
             int(getattr(args, 'tensor', 1) or 1))
+        # Under --stages each stage's pool stores only its own
+        # [lo, hi) layer range, so a page costs ~1/S the bytes per
+        # chip ON TOP of the tensor split — the same per-chip budget
+        # buys ~S*shard_ways x the pages.
+        stages = int(getattr(args, 'stages', 1) or 1)
         pages = (quant_lib.pool_pages_for_bytes(cfg, kv_dtype,
                                                 kv_pool_bytes,
-                                                shard_ways)
+                                                shard_ways,
+                                                stages=stages)
                  if kv_pool_bytes else cfg.kv_total_pages)
         cfg = dataclasses.replace(cfg, kv_dtype=kv_dtype,
                                   kv_total_pages=pages)
         model = type(model)(cfg)
         sharded = (f', kv heads sharded {shard_ways}-way'
                    if shard_ways > 1 else '')
+        staged = (f', split over {stages} stages' if stages > 1
+                  else '')
         print(f'kv cache: dtype={kv_dtype} pages={pages} '
-              f'({quant_lib.kv_page_bytes(cfg, kv_dtype, shard_ways)} '
-              f'bytes/page/chip across layers{sharded})', flush=True)
+              f'({quant_lib.kv_page_bytes(cfg, kv_dtype, shard_ways, stages=stages)} '
+              f'bytes/page/chip across layers{sharded}{staged})',
+              flush=True)
 
     # Speculative decoding writes its verify chunk up to K tokens past
     # the last kept one; fail fast / clamp at STARTUP instead of
@@ -757,9 +771,34 @@ def build_runtime(args) -> InferenceRuntime:
     elif weight_dtype != 'bf16':
         raise SystemExit(f'unsupported --weight-dtype {weight_dtype}')
     # ONE placement block for both param sources: TP-shard over the
-    # mesh (per-leaf cast, shard-only transfers) or single-device.
+    # mesh (per-leaf cast, shard-only transfers), stage×tensor split,
+    # or single-device.
     mesh = None
-    if args.tensor > 1:
+    num_stages = int(getattr(args, 'stages', 1) or 1)
+    if num_stages > 1:
+        if weight_dtype == 'int8':
+            raise SystemExit(
+                '--stages does not compose with --weight-dtype int8 '
+                '(the quantized wrapper has no per-stage split)')
+        from skypilot_tpu.parallel import mesh as mesh_lib
+        from skypilot_tpu.parallel.serving import build_staged_serving
+        mesh = mesh_lib.make_mesh(
+            mesh_lib.MeshConfig(stage=num_stages, tensor=args.tensor),
+            devices=jax.devices()[:num_stages * args.tensor])
+        # Place per stage HERE (per-leaf cast, shard-only transfers
+        # onto each stage's tensor submesh) and hand the engine the
+        # re-merged tree: stage key sets are disjoint top-level
+        # partitions, so the engine's own build_staged_serving split
+        # re-places each already-resident leaf as a no-op.
+        _, stage_params, _, _ = build_staged_serving(
+            model, params, mesh, dtype=serve_cast)
+        params = {}
+        for sp in stage_params:
+            params.update(sp)
+        print(f'pipeline serving: {num_stages} stages x '
+              f'{args.tensor}-way tensor over '
+              f'{num_stages * args.tensor} devices', flush=True)
+    elif args.tensor > 1:
         from skypilot_tpu.parallel import mesh as mesh_lib
         mesh = mesh_lib.make_mesh(
             mesh_lib.MeshConfig(tensor=args.tensor),
@@ -813,9 +852,12 @@ def build_runtime(args) -> InferenceRuntime:
         from skypilot_tpu.inference.adapters import AdapterRegistry
         adapters = AdapterRegistry(
             adapter_dir, model,
+            # Staged engines keep the adapter stacks UNCOMMITTED
+            # (host-backed): each per-stage jitted fn pulls them onto
+            # its own submesh, which a mesh-committed stack can't do.
             max_adapters=getattr(args, 'max_adapters', 8),
             max_rank=getattr(args, 'max_lora_rank', 0),
-            mesh=mesh)
+            mesh=None if num_stages > 1 else mesh)
         inv = adapters.inventory()
         print(f'adapter registry: {len(inv)} adapters in '
               f'{adapter_dir} (max {adapters.max_adapters} '

@@ -269,6 +269,12 @@ class ContinuousBatchingEngine:
         '_rng': 'scheduler', '_inflight': 'scheduler',
         '_prefill_fns': 'scheduler', '_scatter_fns': 'scheduler',
         '_cache_shardings': 'scheduler',
+        # pipeline-stage dispatch state (PR 19): the per-group
+        # in-flight ring, the per-stage jitted-fn cache, and the last
+        # prefill pass's schedule bubble (scrape threads read the
+        # float racily, like the counters).
+        '_group_inflight': 'scheduler', '_stage_fns': 'scheduler',
+        '_prefill_bubble': 'scheduler',
         # counters (scrape threads read these racily, on purpose)
         'decode_calls': 'scheduler', 'tokens_committed': 'scheduler',
         'preemptions': 'scheduler', 'prefill_chunks_run': 'scheduler',
@@ -310,6 +316,13 @@ class ContinuousBatchingEngine:
         self.mesh_devices = (int(mesh.devices.size)
                              if mesh is not None else 1)
         self._cache_shardings = None
+        # Pipeline stages (PR 19): a (stage, tensor) mesh splits the
+        # model's layers into contiguous per-stage ranges; each stage
+        # is a tensor-parallel submesh with its OWN params, cache and
+        # jitted dispatches, chained host-side per round. 1 = the
+        # classic single-program engine (tensor-only or one device).
+        self.stages = (int(mesh.shape.get('stage', 1))
+                       if mesh is not None else 1)
         # Multi-LoRA serving (inference/adapters.py): each slot may
         # carry an adapter id into the shared dispatch; the model
         # gathers per-slot A/B factors from the store's stacked
@@ -458,6 +471,50 @@ class ContinuousBatchingEngine:
             self.kv_shard_ways = _tp_serving.kv_shard_ways(
                 int(getattr(model.config, 'num_kv_heads', 0) or 0),
                 int(mesh.shape.get('tensor', 1)))
+        # Staged build: split the param tree by stage and place each
+        # stage on its tensor submesh (parallel/serving.py
+        # build_staged_serving). From here on `self.params` and
+        # `self.cache` are LISTS of per-stage trees — a list of
+        # pytrees is itself a pytree, so the tree-walking helpers
+        # (kv_cache_bytes, _cache_lost, weight accounting) apply
+        # unchanged.
+        self._stage_models: List[Any] = []
+        self._stage_submeshes: List[Any] = []
+        self._stage_ranges: List[Any] = []
+        self._stage_replicated: List[Any] = []
+        self._stage_fns: Dict[Any, Any] = {}
+        if self.stages > 1:
+            if not self.paged:
+                raise ValueError(
+                    'stages > 1 requires the paged KV cache: the '
+                    'per-stage pool split is a split of the page '
+                    'pool (declare kv_page_size/kv_total_pages)')
+            if self.decode_chunk > 1:
+                raise ValueError(
+                    'decode_chunk > 1 does not compose with stages: '
+                    'the chunk lax.scan would cross submeshes inside '
+                    'one jit (use pipeline_decode, the staged engine '
+                    'overlaps rounds across stages instead)')
+            if self.num_slots % self.stages:
+                raise ValueError(
+                    f'num_slots={self.num_slots} must divide evenly '
+                    f'into stages={self.stages} slot groups (the '
+                    f'S-deep decode ring partitions slots per stage)')
+            from skypilot_tpu.inference import quant as quant_lib
+            if isinstance(model, quant_lib.QuantizedModel) or \
+                    quant_lib.is_quantized(params):
+                raise ValueError(
+                    'int8 WEIGHTS do not compose with stages yet '
+                    '(int8 KV pages do): serve quantized weights '
+                    'tensor-only, or bf16 weights staged')
+            from jax.sharding import NamedSharding, PartitionSpec
+            (self._stage_models, params, self._stage_submeshes,
+             self._stage_ranges) = _tp_serving.build_staged_serving(
+                 model, params, mesh)
+            self._stage_replicated = [
+                NamedSharding(sub, PartitionSpec())
+                for sub in self._stage_submeshes]
+            self.params = params
         self.prefix_caching = bool(prefix_caching and self.paged)
         self.prefix_cache: Optional[PrefixCache] = None  # set per reset
         # Tiered prefix cache: evicted pages spill to a bounded
@@ -593,6 +650,15 @@ class ContinuousBatchingEngine:
         # Pipelined decode: the dispatched-but-not-committed round
         # (device token array + the host state it was built from).
         self._inflight: Optional[Dict[str, Any]] = None
+        # Staged decode ring: one in-flight round per slot GROUP
+        # (contiguous num_slots/stages slice) — up to S rounds in
+        # flight, each occupying a different stage of the chain.
+        self._group_inflight: List[Optional[Dict[str, Any]]] = \
+            [None] * self.stages
+        # Closed-form bubble fraction of the last staged prefill
+        # pass's chunk-microbatch schedule ((S-1)/(M+S-1); 0.0 for
+        # unstaged engines) — the prefill_bubble_fraction gauge.
+        self._prefill_bubble = 0.0
         self._stop = threading.Event()
         self._thread = threading.Thread(  # stpu: thread[scheduler]
             target=self._loop, daemon=True)
@@ -632,6 +698,8 @@ class ContinuousBatchingEngine:
         prefill/decode DONATE the cache buffer, so after a failed
         device execution the old buffer is gone and must be rebuilt."""
         import flax.linen as nn
+        if self.stages > 1:
+            return self._fresh_staged_cache()
         kwargs = {}
         if self.paged:
             self._reset_paging()
@@ -656,7 +724,7 @@ class ContinuousBatchingEngine:
             cache = jax.device_put(cache, self._cache_shardings)
         return cache
 
-    def _pin_cache_out(self, *tail):
+    def _pin_cache_out(self, *tail, stage=None):
         """jit kwargs pinning a dispatch's donated-cache OUTPUT to
         the engine's explicit cache shardings (mesh engines; {} on
         single-device). Inputs arrive committed — the cache via
@@ -666,15 +734,242 @@ class ContinuousBatchingEngine:
         donated pool keeps its layout step over step and GSPMD never
         inserts a resharding collective on it (asserted by the
         pool_collective_lines guard test). `tail` holds one None per
-        non-cache output — unconstrained, XLA places them."""
+        non-cache output — unconstrained, XLA places them. `stage`
+        selects ONE stage's shardings for a staged engine's
+        per-stage dispatch (the same zero-resharding pin, applied on
+        that stage's submesh)."""
         if self._cache_shardings is None:
             return {}
+        sh = (self._cache_shardings if stage is None
+              else self._cache_shardings[stage])
         if tail:
-            return {'out_shardings': (self._cache_shardings, *tail)}
-        return {'out_shardings': self._cache_shardings}
+            return {'out_shardings': (sh, *tail)}
+        return {'out_shardings': sh}
+
+    # -- staged (tensor x pipeline) engine ----------------------------------
+    def _fresh_staged_cache(self):
+        """Per-stage zeroed caches, one tree per stage submesh. Each
+        stage's model owns only its [lo, hi) layers, so its cache tree
+        holds the FULL page pool for just those layers — the per-stage
+        pool split that lets an S-stage T-way mesh hold ~S·T x the
+        pages at fixed per-chip HBM. Within a stage the placement is
+        exactly the PR 15 tensor-parallel layout on the submesh."""
+        import flax.linen as nn
+        from skypilot_tpu.parallel import serving as _tp_serving
+        self._reset_paging()
+        cfg = self.model.config
+        page_kw = {'page_indices': jnp.zeros(
+            (self.num_slots, self.pages_per_seq), jnp.int32)}
+        first_shardings = self._cache_shardings is None
+        if first_shardings:
+            self._cache_shardings = []
+        caches = []
+        for s, sm in enumerate(self._stage_models):
+            x = (jnp.zeros((self.num_slots, 1), jnp.int32) if s == 0
+                 else jnp.zeros((self.num_slots, 1, cfg.embed_dim),
+                                cfg.dtype))
+            cache = sm.init(
+                jax.random.PRNGKey(0), x,
+                positions=jnp.zeros((self.num_slots, 1), jnp.int32),
+                decode=True, **page_kw)['cache']
+            cache = jax.tree.map(jnp.zeros_like, nn.meta.unbox(cache))
+            if first_shardings:
+                self._cache_shardings.append(
+                    _tp_serving.serving_cache_shardings(
+                        cache, self._stage_submeshes[s]))
+            caches.append(jax.device_put(cache,
+                                         self._cache_shardings[s]))
+        return caches
+
+    def _stage_decode_fn(self, s: int):
+        """One stage's jitted decode dispatch: stage 0 maps tokens ->
+        hidden, middle stages hidden -> hidden, the last stage samples
+        tokens from its logits. Shape-polymorphic through retracing —
+        the plain loop calls with seq=1, the speculative verify chunk
+        with seq=K+1, the group ring with batch=num_slots/stages."""
+        key = ('decode', s)
+        if key in self._stage_fns:
+            return self._stage_fns[key]
+        sm = self._stage_models[s]
+        if s == self.stages - 1:
+
+            @functools.partial(jax.jit, donate_argnums=(1,),
+                               **self._pin_cache_out(None, stage=s))
+            def stage_fn(params, cache, x, positions, temps, top_ks,
+                         top_ps, rng, page_indices, lora=None,
+                         adapter_ids=None):
+                extra = ({'lora': lora, 'adapter_ids': adapter_ids}
+                         if lora is not None else {})
+                logits, mutated = sm.apply(
+                    {'params': params, 'cache': cache}, x,
+                    positions=positions, decode=True,
+                    mutable=['cache'], page_indices=page_indices,
+                    **extra)
+                if logits.shape[1] == 1:
+                    out = sample_tokens(rng, logits[:, 0], temps,
+                                        top_ks, top_ps)
+                else:           # verify chunk: [B, K+1, V]
+                    out = sample_tokens(rng, logits, temps, top_ks,
+                                        top_ps)
+                return mutated['cache'], out
+        else:
+
+            @functools.partial(jax.jit, donate_argnums=(1,),
+                               **self._pin_cache_out(None, stage=s))
+            def stage_fn(params, cache, x, positions, page_indices,
+                         lora=None, adapter_ids=None):
+                extra = ({'lora': lora, 'adapter_ids': adapter_ids}
+                         if lora is not None else {})
+                hidden, mutated = sm.apply(
+                    {'params': params, 'cache': cache}, x,
+                    positions=positions, decode=True,
+                    mutable=['cache'], page_indices=page_indices,
+                    **extra)
+                return mutated['cache'], hidden
+
+        self._stage_fns[key] = stage_fn
+        return stage_fn
+
+    def _make_staged_decode_chain(self):
+        """Host-side stage chain with the SAME signature as the
+        single-mesh jitted decode/spec fns, so every dispatch call
+        site works unchanged. Each stage's dispatch is async; the
+        activation hops submeshes through an explicit device_put (the
+        ONLY cross-stage traffic — per-stage pools never exchange a
+        byte), and the ring-fed token array hops back to stage 0 the
+        same way. The host never blocks inside the chain."""
+
+        def decode_chain(params, cache, cur, pos, temps, top_ks,
+                         top_ps, rng, page_indices=None, lora=None,
+                         adapter_ids=None):
+            cur = jnp.asarray(cur)
+            pos = jnp.asarray(pos)
+            if cur.ndim == 1:           # plain decode: seq=1
+                x = cur[:, None]
+                positions = pos[:, None]
+            else:                       # speculative verify chunk
+                x = cur
+                positions = (pos[:, None] +
+                             jnp.arange(cur.shape[1],
+                                        dtype=jnp.int32)[None, :])
+            lora_kw = ({'lora': lora, 'adapter_ids': adapter_ids}
+                       if lora is not None else {})
+            caches = []
+            out = None
+            for s in range(self.stages):
+                x = jax.device_put(x, self._stage_replicated[s])
+                fn = self._stage_decode_fn(s)
+                if s < self.stages - 1:
+                    new_cache, x = fn(params[s], cache[s], x,
+                                      positions, page_indices,
+                                      **lora_kw)
+                else:
+                    new_cache, out = fn(params[s], cache[s], x,
+                                        positions, temps, top_ks,
+                                        top_ps, rng, page_indices,
+                                        **lora_kw)
+                caches.append(new_cache)
+            return caches, out
+
+        # The chain only ever runs inside scheduler-thread dispatch
+        # paths (it IS self._decode); pin the escape so the per-stage
+        # fn cache's ownership holds.
+        return decode_chain  # stpu: role[scheduler]
+
+    def _stage_prefill_fn(self, s: int, bucket_len: int, fresh: bool):
+        """One stage's jitted prefill-chunk dispatch (batch 1, a
+        log2-bucketed chunk). `fresh` distinguishes a from-empty
+        prefill (chunk-local attention) from a suffix chunk that
+        attends the full resident history through the page table —
+        the same prefill=True/False split as the single-mesh fns."""
+        key = ('prefill', s, bucket_len, fresh)
+        if key in self._stage_fns:
+            return self._stage_fns[key]
+        sm = self._stage_models[s]
+        if s == self.stages - 1:
+
+            @functools.partial(jax.jit, donate_argnums=(1,),
+                               **self._pin_cache_out(None, stage=s))
+            def stage_fn(params, cache, x, positions, plen, page_row,
+                         lora=None, adapter_ids=None):
+                extra = ({'lora': lora, 'adapter_ids': adapter_ids}
+                         if lora is not None else {})
+                logits, mutated = sm.apply(
+                    {'params': params, 'cache': cache}, x,
+                    positions=positions, decode=True,
+                    mutable=['cache'], page_indices=page_row,
+                    prefill=fresh, **extra)
+                # The continuation samples from the LAST REAL chunk
+                # position, not the padded tail.
+                last = jax.lax.dynamic_index_in_dim(
+                    logits[0].astype(jnp.float32), plen - 1, axis=0,
+                    keepdims=False)
+                return mutated['cache'], last
+        else:
+
+            @functools.partial(jax.jit, donate_argnums=(1,),
+                               **self._pin_cache_out(None, stage=s))
+            def stage_fn(params, cache, x, positions, page_row,
+                         lora=None, adapter_ids=None):
+                extra = ({'lora': lora, 'adapter_ids': adapter_ids}
+                         if lora is not None else {})
+                hidden, mutated = sm.apply(
+                    {'params': params, 'cache': cache}, x,
+                    positions=positions, decode=True,
+                    mutable=['cache'], page_indices=page_row,
+                    prefill=fresh, **extra)
+                return mutated['cache'], hidden
+
+        self._stage_fns[key] = stage_fn
+        return stage_fn
+
+    def _staged_prefill_chain(self, bucket_len: int, fresh: bool):
+        """Host-side prefill chain matching the single-mesh
+        `_prefill_fn` (fresh=True) / `_prefill_suffix_fn`
+        (fresh=False) signatures. Dispatches are async, so
+        successive chunk microbatches PIPELINE across stages: chunk
+        c+1's stage-0 pass runs while chunk c occupies stage 1 — the
+        chunked-prefill stream is the microbatch stream, no separate
+        schedule executor needed (the schedule's closed form only
+        prices the bubble, see _prefill_work)."""
+
+        def chain(params, cache, x_tokens, plen, *rest, lora=None,
+                  adapter_ids=None):
+            if fresh:
+                (page_row,) = rest
+                positions = jnp.arange(bucket_len,
+                                       dtype=jnp.int32)[None, :]
+            else:
+                offset, page_row = rest
+                positions = (offset +
+                             jnp.arange(bucket_len,
+                                        dtype=jnp.int32))[None, :]
+            lora_kw = ({'lora': lora, 'adapter_ids': adapter_ids}
+                       if lora is not None else {})
+            x = jnp.asarray(x_tokens)[None, :]
+            caches = []
+            last = None
+            for s in range(self.stages):
+                x = jax.device_put(x, self._stage_replicated[s])
+                fn = self._stage_prefill_fn(s, bucket_len, fresh)
+                if s < self.stages - 1:
+                    new_cache, x = fn(params[s], cache[s], x,
+                                      positions, page_row, **lora_kw)
+                else:
+                    new_cache, last = fn(params[s], cache[s], x,
+                                         positions, plen, page_row,
+                                         **lora_kw)
+                caches.append(new_cache)
+            return caches, last
+
+        # Same story as the decode chain: prefill chunks dispatch
+        # only from the scheduler loop.
+        return chain  # stpu: role[scheduler]
 
     # -- jitted device fns --------------------------------------------------
     def _make_decode_fn(self):
+        if self.stages > 1:
+            return self._make_staged_decode_chain()
         model = self.model
 
         # Donate the cache: the caller always replaces self.cache with
@@ -755,6 +1050,12 @@ class ContinuousBatchingEngine:
         committed token was sampled from the true conditional of the
         committed prefix (greedy is the temperature-0 special case).
         """
+        if self.stages > 1:
+            # The staged chain is shape-polymorphic: a [B, K+1] chunk
+            # retraces the per-stage fns at seq=K+1 and the last
+            # stage samples the whole chunk, exactly like the
+            # single-mesh verify dispatch below.
+            return self._make_staged_decode_chain()
         model = self.model
         paged = self.paged
         k = self.spec_k
@@ -827,6 +1128,10 @@ class ContinuousBatchingEngine:
         """
         if bucket_len in self._prefill_fns:
             return self._prefill_fns[bucket_len]
+        if self.stages > 1:
+            fn = self._staged_prefill_chain(bucket_len, fresh=True)
+            self._prefill_fns[bucket_len] = fn
+            return fn
         model = self.model
         positions = jnp.arange(bucket_len, dtype=jnp.int32)[None, :]
         if self.paged:
@@ -903,6 +1208,10 @@ class ContinuousBatchingEngine:
         key = ('suffix', bucket_len)
         if key in self._prefill_fns:
             return self._prefill_fns[key]
+        if self.stages > 1:
+            fn = self._staged_prefill_chain(bucket_len, fresh=False)
+            self._prefill_fns[key] = fn
+            return fn
         model = self.model
 
         @functools.partial(jax.jit, donate_argnums=(1,),
@@ -1131,17 +1440,49 @@ class ContinuousBatchingEngine:
         single device; ~1/mesh_devices of it when the kv-heads axis
         shards — the per-chip HBM figure --kv-pool-bytes budgets
         (skypilot_serving_kv_pool_bytes_per_device)."""
-        total = 0
-        # Metadata-only read, same story as kv_cache_bytes.
-        for leaf in jax.tree_util.tree_leaves(self.cache):  # stpu: ignore[SKY008]
-            sharding = getattr(leaf, 'sharding', None)
-            shape = (sharding.shard_shape(leaf.shape)
-                     if sharding is not None else leaf.shape)
-            n = 1
-            for d in shape:
-                n *= int(d)
-            total += n * jnp.dtype(leaf.dtype).itemsize
-        return int(total)
+        # Staged engines: a chip belongs to exactly ONE stage, so the
+        # per-chip figure is the WIDEST stage's per-device sum (the
+        # layer remainder is front-loaded; other stages hold less).
+        trees = self.cache if self.stages > 1 else [self.cache]  # stpu: ignore[SKY008]
+        per_stage = []
+        for tree in trees:
+            total = 0
+            # Metadata-only read, same story as kv_cache_bytes.
+            for leaf in jax.tree_util.tree_leaves(tree):  # stpu: ignore[SKY008]
+                sharding = getattr(leaf, 'sharding', None)
+                shape = (sharding.shard_shape(leaf.shape)
+                         if sharding is not None else leaf.shape)
+                n = 1
+                for d in shape:
+                    n *= int(d)
+                total += n * jnp.dtype(leaf.dtype).itemsize
+            per_stage.append(total)
+        return int(max(per_stage))
+
+    def stage_pool_stats(self) -> List[Dict[str, Any]]:
+        """Per-stage view of the staged KV pool for /stats: ONE
+        shared allocator drives the whole stage chain, so every
+        stage stores the SAME page indices (counts match), but each
+        stage's pool materializes only its own [lo, hi) layer range
+        — bytes track the layer split. Empty when stages == 1."""
+        if self.stages <= 1:
+            return []
+        out: List[Dict[str, Any]] = []
+        for s, (lo, hi) in enumerate(self._stage_ranges):
+            total = 0
+            # Metadata-only read, same story as kv_cache_bytes.
+            for leaf in jax.tree_util.tree_leaves(self.cache[s]):  # stpu: ignore[SKY008]
+                sharding = getattr(leaf, 'sharding', None)
+                shape = (sharding.shard_shape(leaf.shape)
+                         if sharding is not None else leaf.shape)
+                n = 1
+                for d in shape:
+                    n *= int(d)
+                total += n * jnp.dtype(leaf.dtype).itemsize
+            out.append({'stage': s, 'layers': [lo, hi],
+                        'pages': self.total_pages,
+                        'pool_bytes_per_device': int(total)})
+        return out
 
     def attention_impl(self) -> str:
         """Resolved paged-attention implementation this engine's traced
@@ -1165,7 +1506,12 @@ class ContinuousBatchingEngine:
         cfg = self.model.config
         if self._weight_bytes is None:
             from skypilot_tpu.inference import quant as quant_lib
-            self._weight_bytes = quant_lib.weight_num_bytes(self.params)
+            # Staged engines stream only ONE stage's weights per chip
+            # per token: the widest stage bounds the roofline.
+            self._weight_bytes = (
+                max(quant_lib.weight_num_bytes(p) for p in self.params)
+                if self.stages > 1
+                else quant_lib.weight_num_bytes(self.params))
         lora_bytes = 0
         if self.adapter_store is not None:
             rank = int(getattr(self.adapter_store, '_rank', 0) or 0)
@@ -1181,8 +1527,12 @@ class ContinuousBatchingEngine:
             page_size, pages_per_seq = self.page_size, self.pages_per_seq
         else:
             page_size, pages_per_seq = 1, self.max_total_len
+        # Per-stage layer split: a chip walks only its stage's layers'
+        # KV pages (ceil — the widest stage, matching the weight term).
+        num_layers = (-(-cfg.num_layers // self.stages)
+                      if self.stages > 1 else cfg.num_layers)
         return pallas_paged.bytes_per_token_model(
-            num_layers=cfg.num_layers,
+            num_layers=num_layers,
             num_kv_heads=getattr(cfg, 'num_kv_heads', cfg.num_heads),
             num_q_heads=cfg.num_heads,
             head_dim=cfg.head_dim,
@@ -1215,6 +1565,8 @@ class ContinuousBatchingEngine:
         if self.kv_restore_lookups:
             self.metrics.kv_restore_hit_ratio.set(
                 self.kv_restore_hits / self.kv_restore_lookups)
+        self.metrics.pipeline_stages.set(self.stages)
+        self.metrics.prefill_bubble_fraction.set(self._prefill_bubble)
         self.metrics.set_attention_info(self.attention_impl(),
                                         self.kv_dtype)
         self.metrics.attention_bytes_per_token.set(
@@ -1271,27 +1623,35 @@ class ContinuousBatchingEngine:
         thread only."""
         from skypilot_tpu.ops import paged_attention as paged_ops
         idx = jnp.asarray(pages, jnp.int32)
-        flat, _ = jax.tree_util.tree_flatten_with_path(self.cache)
+        # Staged engines: the per-stage trees use ABSOLUTE layer
+        # names, so the union of their leaf paths IS the single-mesh
+        # path set — the wire format is mesh-agnostic across stage
+        # splits (stage-S exports import into stage-1 and back).
+        trees = self.cache if self.stages > 1 else [self.cache]
+        flat = []
+        for tree in trees:
+            flat.extend(jax.tree_util.tree_flatten_with_path(tree)[0])
         gathered = [paged_ops.gather_page_rows(leaf, idx)
                     for _path, leaf in flat]
         fetched = jax.device_get(gathered)
         return {jax.tree_util.keystr(path): np.asarray(arr)
                 for (path, _), arr in zip(flat, fetched)}
 
-    def _scatter_fn(self, m: int):
-        if m not in self._scatter_fns:
+    def _scatter_fn(self, m: int, stage: Optional[int] = None):
+        key = m if stage is None else (m, stage)
+        if key not in self._scatter_fns:
             from skypilot_tpu.ops import paged_attention as paged_ops
 
             @functools.partial(jax.jit, donate_argnums=(0,),
-                               **self._pin_cache_out())
+                               **self._pin_cache_out(stage=stage))
             def scatter(cache, idx, rows):
                 return jax.tree.map(
                     lambda a, r: paged_ops.scatter_page_rows(a, idx,
                                                              r),
                     cache, rows)
 
-            self._scatter_fns[m] = scatter
-        return self._scatter_fns[m]
+            self._scatter_fns[key] = scatter
+        return self._scatter_fns[key]
 
     def _scatter_page_blobs(self, pages: List[int],
                             blobs: Dict[str, 'np.ndarray']) -> None:
@@ -1299,41 +1659,57 @@ class ContinuousBatchingEngine:
         (import/restore). Chain lengths pad to a power of two so the
         jitted donating scatter compiles a log2 ladder, not one
         executable per length; pad rows target physical page 0 — the
-        trash page, junk over junk. Scheduler thread only."""
-        flat, treedef = jax.tree_util.tree_flatten_with_path(
-            self.cache)
-        paths = [jax.tree_util.keystr(p) for p, _ in flat]
-        if sorted(paths) != sorted(blobs):
+        trash page, junk over junk. Staged engines route each leaf to
+        its owning stage's pool (absolute layer names make the union
+        of the stage trees the full single-mesh leaf set) and scatter
+        per stage with that stage's donating pinned dispatch.
+        Scheduler thread only."""
+        staged = self.stages > 1
+        trees = self.cache if staged else [self.cache]
+        per_stage = []
+        all_paths: List[str] = []
+        for tree in trees:
+            flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+            paths = [jax.tree_util.keystr(p) for p, _ in flat]
+            per_stage.append((flat, treedef, paths))
+            all_paths.extend(paths)
+        if sorted(all_paths) != sorted(blobs):
             raise ValueError(
                 f'KV chain leaves do not match this engine\'s cache '
                 f'layout (chain: {sorted(blobs)[:3]}..., cache: '
-                f'{sorted(paths)[:3]}...)')
+                f'{sorted(all_paths)[:3]}...)')
         n = len(pages)
         m = 1
         while m < n:
             m *= 2
         idx = np.zeros((m,), np.int32)
         idx[:n] = pages
-        rows = []
-        for (_p, leaf), path in zip(flat, paths):
-            arr = np.asarray(blobs[path])
-            if leaf.ndim == 4:
-                want = (n, leaf.shape[0], leaf.shape[2], leaf.shape[3])
-            else:
-                want = (n, leaf.shape[1])
-            if tuple(arr.shape) != want or \
-                    arr.dtype != np.dtype(leaf.dtype):
-                raise ValueError(
-                    f'KV chain leaf {path} is {arr.dtype}{arr.shape}, '
-                    f'pool expects {np.dtype(leaf.dtype)}{want}')
-            if m != n:
-                arr = np.concatenate(
-                    [arr, np.zeros((m - n,) + arr.shape[1:],
-                                   arr.dtype)], axis=0)
-            rows.append(arr)
-        rows_tree = jax.tree_util.tree_unflatten(treedef, rows)
-        self.cache = self._scatter_fn(m)(self.cache,
-                                         jnp.asarray(idx), rows_tree)
+        new_trees = []
+        for s, (flat, treedef, paths) in enumerate(per_stage):
+            rows = []
+            for (_p, leaf), path in zip(flat, paths):
+                arr = np.asarray(blobs[path])
+                if leaf.ndim == 4:
+                    want = (n, leaf.shape[0], leaf.shape[2],
+                            leaf.shape[3])
+                else:
+                    want = (n, leaf.shape[1])
+                if tuple(arr.shape) != want or \
+                        arr.dtype != np.dtype(leaf.dtype):
+                    raise ValueError(
+                        f'KV chain leaf {path} is '
+                        f'{arr.dtype}{arr.shape}, pool expects '
+                        f'{np.dtype(leaf.dtype)}{want}')
+                if m != n:
+                    arr = np.concatenate(
+                        [arr, np.zeros((m - n,) + arr.shape[1:],
+                                       arr.dtype)], axis=0)
+                rows.append(arr)
+            rows_tree = jax.tree_util.tree_unflatten(treedef, rows)
+            fn = self._scatter_fn(m, s if staged else None)
+            new_trees.append(fn(trees[s], jnp.asarray(idx),
+                                rows_tree))
+        self.cache = new_trees if staged else new_trees[0]
 
     def export_chain(self, tokens: List[int],
                      adapter: Optional[str] = None
@@ -1382,6 +1758,8 @@ class ContinuousBatchingEngine:
                     'num_kv_heads': int(getattr(cfg, 'num_kv_heads',
                                                 0) or 0),
                     'head_dim': int(getattr(cfg, 'head_dim', 0) or 0),
+                    'num_layers': int(getattr(cfg, 'num_layers',
+                                              0) or 0),
                     'keys': [k.hex() for k in keys[:len(pages)]],
                     'salt': salt.hex()}
             packed = kv_transfer.pack_pages(blobs, meta)
@@ -1430,7 +1808,14 @@ class ContinuousBatchingEngine:
                     ('num_kv_heads',
                      int(getattr(cfg, 'num_kv_heads', 0) or 0)),
                     ('head_dim',
-                     int(getattr(cfg, 'head_dim', 0) or 0))):
+                     int(getattr(cfg, 'head_dim', 0) or 0)),
+                    # Layer count (PR 19): blobs carry one row per
+                    # layer, so a layer-count mismatch would scatter
+                    # rows into the wrong layers' pools. Stage SPLIT
+                    # is deliberately not compared — blobs are keyed
+                    # by absolute layer names, mesh-agnostic.
+                    ('num_layers',
+                     int(getattr(cfg, 'num_layers', 0) or 0))):
                 got = meta.get(field)
                 if got is not None and int(got) and want and \
                         int(got) != want:
@@ -1566,7 +1951,8 @@ class ContinuousBatchingEngine:
         if self._prefill_order:
             self._prefill_work()
             progressed = True
-        if self.active.any() or self._inflight is not None:
+        if self.active.any() or self._inflight is not None or \
+                any(f is not None for f in self._group_inflight):
             t_step = time.perf_counter()
             committed0 = self.tokens_committed
             self._decode_step()
@@ -1639,6 +2025,7 @@ class ContinuousBatchingEngine:
         self.metrics.engine_restarts.inc()
         self._soft_errors = 0
         self._inflight = None
+        self._group_inflight = [None] * self.stages
         try:
             self.cache = self._fresh_cache()
         except Exception:  # pylint: disable=broad-except
@@ -2052,6 +2439,7 @@ class ContinuousBatchingEngine:
         slot (the legacy path) and the budget is unbounded."""
         budget = self.prefill_budget if self.prefill_chunk else None
         spent = 0
+        chunks0 = self.prefill_chunks_run
         done: List[Any] = []    # (slot, first-token device scalar)
         while self._prefill_order:
             slot = self._prefill_order[0]
@@ -2096,6 +2484,16 @@ class ContinuousBatchingEngine:
         if budget:
             self.metrics.prefill_budget_utilization.set(
                 spent / budget)
+        if self.stages > 1 and self.prefill_chunks_run > chunks0:
+            # Closed-form bubble of this pass's chunk-microbatch
+            # stream over the stage chain: M chunks through S stages
+            # fill/drain (S-1)/(M+S-1) of the slot grid
+            # (parallel/pipeline_schedule.make_inference_schedule —
+            # the same span math the trainer's schedule asserts).
+            from skypilot_tpu.parallel import pipeline_schedule
+            sched = pipeline_schedule.make_inference_schedule(
+                self.stages, self.prefill_chunks_run - chunks0)
+            self._prefill_bubble = sched.bubble_fraction
         if not done:
             return
         # ONE host/device sync for every prompt that completed this
@@ -2326,7 +2724,10 @@ class ContinuousBatchingEngine:
             self._chunk_decode_step()
             return
         if self.pipeline_decode:
-            self._pipelined_decode_step()
+            if self.stages > 1:
+                self._staged_pipelined_decode_step()
+            else:
+                self._pipelined_decode_step()
             return
         self._rng, sub = jax.random.split(self._rng)
         extra = ()
@@ -2461,6 +2862,91 @@ class ContinuousBatchingEngine:
         if inflight is not None:
             self._commit_round(inflight)
         self._inflight = nxt
+
+    # -- staged pipelined decode (the S-deep ring) --------------------------
+    def _group_slice(self, g: int) -> slice:
+        width = self.num_slots // self.stages
+        return slice(g * width, (g + 1) * width)
+
+    def _dispatch_group_round(self, g: int,
+                              inflight: Optional[Dict[str, Any]]
+                              ) -> Optional[Dict[str, Any]]:
+        """_dispatch_round on one slot GROUP: the width-W slice of
+        the slot arrays rides the S-stage chain while the other
+        groups' rounds occupy other stages. Same ring-feedback
+        contract as the unstaged path — continuing lanes feed the
+        in-flight round's device-resident tokens straight back."""
+        sl = self._group_slice(g)
+        if not self.active[sl].any():
+            return None
+        if inflight is None:
+            cur = jnp.asarray(self.cur_token[sl])
+            pos = self.pos[sl].copy()
+        else:
+            base = sl.start
+            cont = np.array(
+                [bool(inflight['mask'][i]) and
+                 bool(self.active[base + i]) and
+                 self.futures[base + i] is inflight['futs'][i]
+                 for i in range(sl.stop - sl.start)])
+            pos = np.where(cont, inflight['pos'] + 1,
+                           self.pos[sl]).astype(np.int32)
+            cur = jnp.where(jnp.asarray(cont), inflight['sampled'],
+                            jnp.asarray(self.cur_token[sl]))
+        self._rng, sub = jax.random.split(self._rng)
+        self.cache, sampled = self._decode(
+            self.params, self.cache, cur, jnp.asarray(pos),
+            jnp.asarray(self.temps[sl]), jnp.asarray(self.top_ks[sl]),
+            jnp.asarray(self.top_ps[sl]), sub,
+            jnp.asarray(self.page_table[sl]),
+            **self._group_lora_args(sl))
+        self.decode_calls += 1
+        self.metrics.decode_steps.inc()
+        return {'sampled': sampled, 'mask': self.active[sl].copy(),
+                'pos': pos, 'futs': list(self.futures[sl])}
+
+    def _group_lora_args(self, sl: slice) -> Dict[str, Any]:
+        """_lora_args for one slot group's width-W dispatch."""
+        if self.adapter_store is None or \
+                not self.slot_adapter[sl].any():
+            return {}
+        return {'lora': self.adapter_store.model_lora(),
+                'adapter_ids': jnp.asarray(self.slot_adapter[sl],
+                                           jnp.int32)}
+
+    def _commit_group_round(self, g: int,
+                            inflight: Dict[str, Any]) -> None:
+        """Fetch + commit one group's dispatched round (lane i is
+        slot g*W + i); discard rules match _commit_round."""
+        sampled = self._fetch_tokens(inflight['sampled'])
+        base = self._group_slice(g).start
+        for i in range(len(sampled)):
+            slot = base + i
+            if not inflight['mask'][i]:
+                continue
+            if not self.active[slot] or \
+                    self.futures[slot] is not inflight['futs'][i]:
+                continue
+            self._commit_token(slot, int(sampled[i]))
+
+    def _staged_pipelined_decode_step(self) -> None:
+        """One iteration of the S-deep decode ring: slots partition
+        into `stages` contiguous groups; dispatch EVERY group's next
+        round through the stage chain first (async — group g+1's
+        stage-0 pass overlaps group g's stage-1 pass, so the S
+        in-flight rounds occupy different stages simultaneously),
+        then fetch + commit each group's previous round. Greedy
+        outputs are token-for-token the unpipelined loop's: each
+        lane's successive rounds are still sequential."""
+        self._grow_pages(lookahead=2)
+        nxt: List[Optional[Dict[str, Any]]] = []
+        for g in range(self.stages):
+            nxt.append(self._dispatch_group_round(
+                g, self._group_inflight[g]))
+        for g in range(self.stages):
+            if self._group_inflight[g] is not None:
+                self._commit_group_round(g, self._group_inflight[g])
+        self._group_inflight = nxt
 
     def _chunk_decode_step(self) -> None:
         """One chunked round: decode_chunk tokens for every active

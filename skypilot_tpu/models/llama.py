@@ -490,3 +490,81 @@ class Llama(nn.Module):
                             preferred_element_type=(cfg.logits_dtype or
                                                     jnp.float32))
         return nn.with_logical_constraint(logits, ('batch', 'seq', 'vocab'))
+
+
+class LlamaStage(nn.Module):
+    """One pipeline stage of the Llama decoder (staged serving).
+
+    Runs layers [lo, hi) with ABSOLUTE layer names (`layer_{i}`), so a
+    full `Llama` param/cache tree splits into per-stage trees by key
+    and the wire-format keys of the paged KV pool (kv_transfer chain
+    export) are the union of the stage trees — identical to the
+    unstaged layout. The first stage owns `tok_embed` and maps tokens
+    [B, S] -> hidden [B, S, embed]; the last stage owns `final_norm` +
+    `lm_head` and maps hidden -> logits [B, S, vocab]; interior stages
+    are hidden -> hidden. Layer application is sequential and
+    dtype-identical to `Llama.__call__`, so chaining the S stages on
+    the same weights reproduces the full model bit-for-bit.
+    """
+    config: LlamaConfig
+    lo: int
+    hi: int
+    first: bool
+    last: bool
+
+    @nn.compact
+    def __call__(self, x: jax.Array,
+                 positions: Optional[jax.Array] = None,
+                 decode: bool = False,
+                 page_indices: Optional[jax.Array] = None,
+                 prefill: bool = False,
+                 lora: Optional[dict] = None,
+                 adapter_ids: Optional[jax.Array] = None) -> jax.Array:
+        cfg = self.config
+        # The WHOLE lora stack threads through every stage; each stage
+        # gathers only its own layers' factors below (the rest are
+        # dead inputs XLA drops), so the engine passes one pytree.
+        lora_scale = lora['scale'] if lora is not None else None
+        lora_layers = lora['layers'] if lora is not None else {}
+        if self.first:
+            tokens = x
+            batch, seq = tokens.shape
+            if positions is None:
+                positions = jnp.broadcast_to(jnp.arange(seq),
+                                             (batch, seq))
+            embed = self.param(
+                'tok_embed',
+                nn.with_logical_partitioning(
+                    nn.initializers.normal(stddev=0.02),
+                    ('vocab', 'table_embed')),
+                (cfg.vocab_size, cfg.embed_dim), jnp.float32)
+            x = embed.astype(cfg.dtype)[tokens]
+        else:
+            batch, seq = x.shape[:2]
+            if positions is None:
+                positions = jnp.broadcast_to(jnp.arange(seq),
+                                             (batch, seq))
+        x = nn.with_logical_constraint(x, ('batch', 'seq', 'act_embed'))
+
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block, prevent_cse=False,
+                             static_argnums=(3, 5))
+        for i in range(self.lo, self.hi):
+            x = block(cfg, name=f'layer_{i}')(x, positions, decode,
+                                              page_indices, prefill,
+                                              lora_layers.get(f'layer_{i}'),
+                                              adapter_ids, lora_scale)
+        if not self.last:
+            return x
+        x = RMSNorm(cfg.norm_eps, cfg.dtype, name='final_norm')(x)
+        head = self.param(
+            'lm_head',
+            nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ('embed', 'vocab')),
+            (cfg.embed_dim, cfg.vocab_size), jnp.float32)
+        logits = jnp.einsum('bse,ev->bsv', x.astype(cfg.dtype),
+                            head.astype(cfg.dtype),
+                            preferred_element_type=(cfg.logits_dtype or
+                                                    jnp.float32))
+        return nn.with_logical_constraint(logits, ('batch', 'seq', 'vocab'))

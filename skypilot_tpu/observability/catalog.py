@@ -112,6 +112,16 @@ SPECS: Dict[str, Tuple] = {
                  'factor rows (ops/pallas_paged.bytes_per_token_model '
                  '— the serve_bench roofline denominator)',
         ('engine',)),
+    'skypilot_serving_pipeline_stages': (
+        'gauge', 'Pipeline-parallel stages the engine serves over '
+                 '(--stages; 1 = no stage split). Each stage owns a '
+                 'contiguous layer range on its own tensor submesh '
+                 'and stores only its layers\' KV pages', ('engine',)),
+    'skypilot_serving_prefill_bubble_fraction': (
+        'gauge', 'Closed-form pipeline fill/drain bubble of the last '
+                 'prefill burst: (S-1)/(M+S-1) for S stages and M '
+                 'chunk microbatches (0 when S=1 or no prefill has '
+                 'run)', ('engine',)),
     'skypilot_serving_pages_free': (
         'gauge', 'Free pages in the shared KV page pool', ('engine',)),
     'skypilot_serving_pages_used': (
@@ -433,6 +443,10 @@ class EngineMetrics:
             'skypilot_serving_kv_pool_bytes').labels(**lab)
         self.kv_pool_bytes_per_device = gauge(
             'skypilot_serving_kv_pool_bytes_per_device').labels(**lab)
+        self.pipeline_stages = gauge(
+            'skypilot_serving_pipeline_stages').labels(**lab)
+        self.prefill_bubble_fraction = gauge(
+            'skypilot_serving_prefill_bubble_fraction').labels(**lab)
         self.pages_free = gauge(
             'skypilot_serving_pages_free').labels(**lab)
         self.pages_used = gauge(

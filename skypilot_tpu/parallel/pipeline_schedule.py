@@ -436,6 +436,73 @@ def _validate(sched: PipelineSchedule, fwd_tick: Dict, bwd_tick: Dict
                 assert bwd_tick[(vs + 1, m)] < b, 'bwd chain order'
 
 
+# -- Inference (serving) schedules: forward-only (PR 19) --------------------
+@dataclasses.dataclass(frozen=True)
+class InferenceSchedule:
+    """The forward-only op stream of staged serving: no backwards, no
+    flush, no activation stash — a microbatch (one prefill chunk in
+    the serving engine; the chunked-prefill fixed-shape chunk IS the
+    natural microbatch) enters stage 0 and ripples through the S
+    stages, one stage per tick. Span is M + S - 1 ticks and the only
+    idle slots are the fill/drain triangles: bubble fraction
+    (S - 1)·S / ((M + S - 1)·S) = (S - 1)/(M + S - 1) — half the
+    training closed form because there is no backward wave. Build
+    with `make_inference_schedule`; the engine's prefill-bubble gauge
+    and serve_bench's `--pp-ab` report read `bubble_fraction` from
+    here rather than re-deriving it."""
+    stages: int
+    microbatches: int
+    ops: Tuple[PipelineOp, ...]
+    num_ticks: int
+
+    @property
+    def total_slots(self) -> int:
+        return self.num_ticks * self.stages
+
+    @property
+    def busy_slots(self) -> int:
+        return len(self.ops)
+
+    @property
+    def bubble_slots(self) -> int:
+        return self.total_slots - self.busy_slots
+
+    @property
+    def bubble_fraction(self) -> float:
+        return self.bubble_slots / self.total_slots
+
+    def describe(self) -> str:
+        return (f'inference(S={self.stages}, M={self.microbatches}): '
+                f'{self.num_ticks} ticks, bubble '
+                f'{self.bubble_slots}/{self.total_slots} '
+                f'({self.bubble_fraction:.1%})')
+
+
+def make_inference_schedule(stages: int,
+                            microbatches: int) -> InferenceSchedule:
+    """Forward-only schedule: microbatch m runs on stage s at tick
+    m + s. Pure; allows stages == 1 (span M, zero bubble) so the
+    engine's accounting degenerates cleanly for unstaged serving."""
+    if stages < 1:
+        raise ValueError(f'stages must be >= 1; got {stages}')
+    if microbatches < 1:
+        raise ValueError('microbatches must be >= 1')
+    ops = tuple(PipelineOp(m + s, s, m, s, FWD)
+                for m in range(microbatches) for s in range(stages))
+    sched = InferenceSchedule(stages=stages, microbatches=microbatches,
+                              ops=ops,
+                              num_ticks=microbatches + stages - 1)
+    assert sched.num_ticks == closed_form_inference_span(
+        stages, microbatches), 'inference span'
+    return sched
+
+
+def closed_form_inference_span(stages: int, microbatches: int) -> int:
+    """Analytic tick count of the forward-only stream: M + S - 1
+    (bubble fraction (S - 1)/(M + S - 1))."""
+    return microbatches + stages - 1
+
+
 def closed_form_span(stages: int, microbatches: int, style: str,
                      virtual_stages: int = 1) -> int:
     """Analytic tick count: every style spans exactly

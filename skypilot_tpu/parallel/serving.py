@@ -26,10 +26,11 @@ donated cache output — zero per-step resharding of the pool, which
 from __future__ import annotations
 
 import re
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from skypilot_tpu.parallel import mesh as mesh_lib
@@ -129,6 +130,110 @@ def serving_cache_shardings(cache: Any, mesh: Mesh) -> Any:
         return replicated
 
     return jax.tree_util.tree_map_with_path(spec_for, cache)
+
+
+# -- Pipeline stages (PR 19) ------------------------------------------------
+def stage_layer_ranges(num_layers: int,
+                       stages: int) -> List[Tuple[int, int]]:
+    """Contiguous [lo, hi) layer ranges per stage. Earlier stages take
+    the remainder layers (stage 0 also owns the embedding table, but
+    the KV pool only materializes transformer layers, so front-loading
+    keeps the per-stage POOL split as even as the layer count
+    allows)."""
+    if stages < 1:
+        raise ValueError(f'stages must be >= 1, got {stages}')
+    if stages > num_layers:
+        raise ValueError(
+            f'cannot split {num_layers} layers over {stages} stages '
+            f'(at least one layer per stage)')
+    base, rem = divmod(num_layers, stages)
+    ranges: List[Tuple[int, int]] = []
+    lo = 0
+    for s in range(stages):
+        hi = lo + base + (1 if s < rem else 0)
+        ranges.append((lo, hi))
+        lo = hi
+    return ranges
+
+
+def stage_submeshes(mesh: Mesh) -> List[Mesh]:
+    """One tensor-only `Mesh` per stage row of a `(stage, tensor)`
+    mesh. Every existing TP machine — `serving_param_shardings`,
+    `serving_cache_shardings`, `kv_shard_ways`,
+    `pool_collective_lines` — applies per stage on its submesh
+    unchanged: within a stage the layout IS the PR 15 tensor-parallel
+    layout, and the only cross-stage traffic is the activation
+    handoff between stages (host-driven `device_put`, never a pool
+    collective)."""
+    stages = int(mesh.shape.get('stage', 1))
+    tensor = int(mesh.shape.get('tensor', 1))
+    devices = np.asarray(mesh.devices).reshape(stages, tensor)
+    # Full six-axis meshes (size-1 everywhere but tensor) so the
+    # training rules table resolves every logical axis on a submesh
+    # exactly like it does on a plain --tensor mesh.
+    return [mesh_lib.make_mesh(mesh_lib.MeshConfig(tensor=tensor),
+                               devices=list(devices[s]))
+            for s in range(stages)]
+
+
+def build_staged_serving(model, params: Any, mesh: Mesh,
+                         rules=mesh_lib.DEFAULT_RULES,
+                         dtype=None) -> Tuple[List[Any], List[Any],
+                                              List[Mesh],
+                                              List[Tuple[int, int]]]:
+    """Split a full Llama param tree into per-stage trees and place
+    each on its stage's tensor submesh.
+
+    Stage modules use ABSOLUTE layer names (`models/llama.py
+    LlamaStage`), so the split is a top-level dict partition:
+    `layer_i` goes to the stage whose [lo, hi) holds i, `tok_embed`
+    to stage 0, `final_norm`/`lm_head` to the last stage. Shardings
+    come from the FULL model's logical annotations evaluated on each
+    submesh — per-stage placement is therefore leaf-for-leaf
+    identical to what single-stage TP serving would pin, which is
+    what keeps staged outputs bit-identical.
+
+    Returns (stage_models, stage_params, submeshes, layer_ranges).
+    """
+    from skypilot_tpu.models import llama as llama_lib
+    base = getattr(model, 'base_model', model)
+    if not isinstance(base, llama_lib.Llama):
+        raise ValueError(
+            f'staged serving supports the Llama family; '
+            f'{type(base).__name__} has no stage split')
+    cfg = model.config
+    stages = int(mesh.shape.get('stage', 1))
+    ranges = stage_layer_ranges(cfg.num_layers, stages)
+    submeshes = stage_submeshes(mesh)
+    stage_models: List[Any] = []
+    stage_params: List[Any] = []
+    for s, (lo, hi) in enumerate(ranges):
+        first, last = s == 0, s == stages - 1
+        stage_model = llama_lib.LlamaStage(
+            cfg, lo=lo, hi=hi, first=first, last=last)
+        keys = {f'layer_{i}' for i in range(lo, hi)}
+        if first:
+            keys.add('tok_embed')
+        if last:
+            keys |= {'final_norm', 'lm_head'}
+        missing = keys - set(params)
+        if missing:
+            raise ValueError(
+                f'stage {s} needs params {sorted(missing)} not in '
+                f'the provided tree (keys: {sorted(params)[:8]}...)')
+        shardings = serving_param_shardings(model, submeshes[s],
+                                            rules)
+        sub = {k: params[k] for k in keys}
+        sub_shardings = {k: shardings[k] for k in keys}
+
+        def _place(w, sh):
+            if dtype is not None:
+                w = np.asarray(w).astype(dtype)
+            return jax.device_put(w, sh)
+
+        stage_models.append(stage_model)
+        stage_params.append(jax.tree.map(_place, sub, sub_shardings))
+    return stage_models, stage_params, submeshes, ranges
 
 
 def pool_collective_lines(compiled: Any, cache: Any,

@@ -67,6 +67,10 @@ def build_replica_cmd(args: argparse.Namespace) -> list:
         cmd += ['--kv-pool-bytes', str(args.kv_pool_bytes)]
     if args.weight_dtype:
         cmd += ['--weight-dtype', args.weight_dtype]
+    if args.tensor > 1:
+        cmd += ['--tensor', str(args.tensor)]
+    if args.stages > 1:
+        cmd += ['--stages', str(args.stages)]
     if args.kv_spill_bytes:
         cmd += ['--kv-spill-bytes', str(args.kv_spill_bytes)]
     if args.kv_cold_dir:
@@ -119,6 +123,16 @@ def main() -> None:
                         default=None,
                         help='forwarded to every replica: int8 '
                              'per-channel projection weights')
+    parser.add_argument('--tensor', type=int, default=1,
+                        help='forwarded to every replica: tensor-'
+                             'parallel serving over N devices '
+                             '(serve_lm --tensor). Each replica '
+                             'claims its own N chips')
+    parser.add_argument('--stages', type=int, default=1,
+                        help='forwarded to every replica: pipeline-'
+                             'parallel serving over S stages '
+                             '(serve_lm --stages); composes with '
+                             '--tensor for S x N chips per replica')
     parser.add_argument('--fault-plan', default=None, metavar='JSON')
     parser.add_argument('--cpu', action='store_true')
     parser.add_argument('--state-dir', default=None, metavar='DIR',

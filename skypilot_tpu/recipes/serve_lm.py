@@ -104,6 +104,22 @@ def main() -> None:
                              'too (when N divides the head count), '
                              'so N chips hold ~Nx the pages at fixed '
                              'per-chip --kv-pool-bytes')
+    parser.add_argument('--stages', type=int, default=1,
+                        help='pipeline-parallel serving over S stages: '
+                             'the layer stack splits into S contiguous '
+                             'ranges, each placed on its own tensor '
+                             'submesh of a (stage, tensor) mesh — '
+                             'total chips = S x --tensor. Prefill '
+                             'streams chunk microbatches through the '
+                             'stage chain; decode keeps S slot groups '
+                             'in flight so every stage works each '
+                             'step. Each stage\'s KV pool holds only '
+                             'its own layers\' pages, so the pool '
+                             'scales ~S x --tensor ways at fixed '
+                             'per-chip --kv-pool-bytes. Needs '
+                             '--continuous-batching; does not compose '
+                             'with --weight-dtype int8 or '
+                             '--decode-chunk > 1')
     parser.add_argument('--adapter-dir', default=None, metavar='DIR',
                         help='multi-LoRA serving: a local or gs:// '
                              'directory of adapter artifacts '
@@ -292,6 +308,26 @@ def main() -> None:
         parser.error('--role prefill requires --continuous-batching '
                      '(the handoff exports KV page chains from the '
                      'slot engine\'s prefix cache)')
+    if args.stages > 1:
+        if not args.continuous_batching:
+            parser.error('--stages requires --continuous-batching '
+                         '(pipeline serving runs the paged slot '
+                         'engine; the one-shot path has no microbatch '
+                         'stream to fill the stage bubble)')
+        if args.weight_dtype == 'int8':
+            parser.error('--stages does not compose with '
+                         '--weight-dtype int8 (the quantized wrapper '
+                         'has no per-stage split; use int8 KV pages '
+                         'via --kv-dtype int8 instead)')
+        if args.decode_chunk > 1:
+            parser.error('--stages does not compose with '
+                         '--decode-chunk > 1 (the in-flight group '
+                         'ring feeds one token per slot per round)')
+        if args.num_slots % args.stages != 0:
+            parser.error(f'--num-slots {args.num_slots} must divide '
+                         f'evenly into --stages {args.stages} slot '
+                         f'groups (the decode ring assigns '
+                         f'num_slots/stages slots per group)')
 
     if args.fault_plan:
         from skypilot_tpu.robustness import faults

@@ -51,6 +51,7 @@ _COMPILE_FILES = {
     'test_multislice.py', 'test_prefix_caching.py', 'test_pipeline.py',
     'test_pipeline_schedule.py',
     'test_tp_serving.py', 'test_tp_sharded_pool.py',
+    'test_pp_serving.py',
     'test_profile_trace.py', 'test_fused_xent.py',
 }
 
