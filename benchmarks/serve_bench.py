@@ -1083,6 +1083,290 @@ def run_disagg_ab(args) -> dict:
     }
 
 
+def _storm_expected_tokens(seed: int, prompt_len: int,
+                           max_new: int) -> list:
+    """The stub's deterministic token row for a prompt of
+    `prompt_len` under a FLEET-SHARED seed: the unmigrated control
+    an evacuated stream must match bit-for-bit (stub.py's formula —
+    tokens depend only on seed, prompt length, and position, never
+    on which replica generates them)."""
+    return [(seed * 1000003 + prompt_len * 31 + j) % 50000
+            for j in range(max_new)]
+
+
+def _run_storm_once(args, arm: str) -> dict:
+    """One storm arm over a stub fleet: `control` (no fault plan),
+    `migrate` (zone storm; preempted replicas evacuate KV chains to
+    survivors inside the grace window), or `replay` (zone storm with
+    --no-migrate: preemption aborts the replica mid-stream and the
+    client retries from the full prompt). All replicas share one
+    seed so a migrated continuation is bit-comparable against the
+    client-side expected row."""
+    from skypilot_tpu.serve import autoscalers
+    from skypilot_tpu.serve import \
+        load_balancing_policies  # noqa: F401 (registers policies)
+    from skypilot_tpu.serve import service_spec as spec_lib
+    from skypilot_tpu.serve.replica_plane import (FleetController,
+                                                  ReplicaManager,
+                                                  make_lb_server)
+    from skypilot_tpu.serve.replica_plane import lb as lb_mod
+    from skypilot_tpu.serve.replica_plane import replica_manager as rm
+    from skypilot_tpu.utils.registry import LB_POLICY_REGISTRY
+
+    env = _server_env(args)
+    if arm != 'control':
+        # Stubs take no --fault-plan flag; the plan arms from the
+        # child environment at import. The bench process itself
+        # never sees it (os.environ is untouched).
+        env['STPU_FAULT_PLAN'] = args.fault_plan
+    extra = ['--cache-pages', str(args.stub_cache_pages),
+             '--token-sleep-ms', str(args.stub_token_sleep_ms),
+             # Fleet-shared seed (last --seed wins over the
+             # factory's per-replica one): bit-identity across
+             # migration is checkable against a closed form.
+             '--seed', str(args.storm_seed)]
+    if arm == 'replay':
+        extra += ['--no-migrate']
+    factory = rm.stub_factory(extra_args=extra, env=env)
+    spec = spec_lib.SkyServiceSpec(min_replicas=args.replicas,
+                                   max_replicas=args.replicas)
+    autoscaler = autoscalers.EngineMetricsAutoscaler(spec)
+    policy = LB_POLICY_REGISTRY.from_str(args.lb_policy)()
+    # Preempted replicas are FAILED and then forgotten by the next
+    # controller tick (terminal views are removed) — count them at
+    # the lifecycle event, not from the end-of-run view list.
+    preempted = [0]
+
+    def on_event(name: str, view) -> None:
+        if name == 'dead' and getattr(view, 'zone', '') == \
+                args.storm_zone:
+            preempted[0] += 1
+
+    manager = ReplicaManager(factory, drain_grace_s=30.0,
+                             scrape_timeout_s=20.0,
+                             max_scrape_failures=1000,
+                             on_event=on_event)
+    # Tight tick: a preempted replica must leave the routing set
+    # (and its replacement arrive) within a fraction of the storm.
+    controller = FleetController(manager, policy, autoscaler,
+                                 interval_s=0.5)
+    lb_port = _free_port()
+    lb = make_lb_server(policy, lb_port, policy_name=args.lb_policy,
+                        manager=manager)
+    lb_thread = threading.Thread(target=lb.serve_forever, daemon=True)
+    lb_thread.start()
+    url = f'http://127.0.0.1:{lb_port}'
+    try:
+        # First --storm-spot replicas carry the storm zone; the rest
+        # are the on-demand survivors chains evacuate to.
+        for i in range(args.replicas):
+            zone = args.storm_zone if i < args.storm_spot else ''
+            manager.spawn(zone=zone)
+        if not controller.wait_ready(args.replicas, timeout_s=120):
+            raise RuntimeError(
+                f'storm fleet of {args.replicas} not ready')
+        controller.tick()  # push peer sets before traffic
+        ticker = threading.Thread(target=controller.run, daemon=True)
+        ticker.start()
+
+        rng = random.Random(0)
+        prompts = [[rng.randrange(1, 50000)
+                    for _ in range(rng.randrange(4, 16))]
+                   for _ in range(args.requests)]
+        latencies = []
+        itl_gaps = []
+        errors = [0]        # final (unrecovered) 5xx / transport
+        retries = [0]       # replay-arm full-prompt resubmissions
+        recomputed = [0]    # client-visible recompute: prompt +
+        #                     already-received tokens per retry
+        mismatches = [0]    # completed rows != closed-form control
+        shed = [0]
+        lock = threading.Lock()
+        queue = list(enumerate(prompts))
+
+        def client() -> None:
+            while True:
+                with lock:
+                    if not queue:
+                        return
+                    _idx, prompt = queue.pop()
+                expected = _storm_expected_tokens(
+                    args.storm_seed, len(prompt),
+                    args.max_new_tokens)
+                t0 = time.perf_counter()
+                attempt = 0
+                while True:
+                    attempt += 1
+                    ttft = None
+                    last_t = None
+                    gaps = []
+                    toks = []
+                    failed = False
+                    try:
+                        with requests.post(f'{url}/generate', json={
+                                'tokens': [prompt],
+                                'max_new_tokens':
+                                    args.max_new_tokens,
+                                'stream': True}, timeout=600,
+                                stream=True) as resp:
+                            if resp.status_code == 429:
+                                with lock:
+                                    shed[0] += 1
+                                break
+                            if resp.status_code >= 500:
+                                failed = True
+                            else:
+                                done = False
+                                # chunk_size=1: SSE frames are a
+                                # few dozen bytes; default chunking
+                                # batches whole bursts into one
+                                # read and flattens every gap to 0.
+                                for raw in resp.iter_lines(
+                                        chunk_size=1):
+                                    if not raw.startswith(b'data: '):
+                                        continue
+                                    if raw == b'data: [DONE]':
+                                        done = True
+                                        break
+                                    frame = json.loads(raw[6:])
+                                    if 'token' in frame:
+                                        now = time.perf_counter()
+                                        if ttft is None:
+                                            ttft = now - t0
+                                        if last_t is not None:
+                                            gaps.append(now - last_t)
+                                        last_t = now
+                                        toks.append(
+                                            int(frame['token']))
+                                if not done:
+                                    # Connection died mid-stream
+                                    # (preempted replica).
+                                    failed = True
+                    except requests.RequestException:
+                        failed = True
+                    if not failed:
+                        total = time.perf_counter() - t0
+                        with lock:
+                            latencies.append(
+                                (ttft if ttft is not None else total,
+                                 total))
+                            itl_gaps.extend(gaps)
+                            if toks != expected:
+                                mismatches[0] += 1
+                        break
+                    # A failed attempt restarts from the raw prompt:
+                    # the server must re-prefill it AND regenerate
+                    # every token the client already held — the
+                    # replay arm's whole cost model.
+                    with lock:
+                        recomputed[0] += len(prompt) + len(toks)
+                    if attempt > 5:
+                        with lock:
+                            errors[0] += 1
+                        break
+                    with lock:
+                        retries[0] += 1
+                    time.sleep(0.5)
+
+        start = time.perf_counter()
+        threads = [threading.Thread(target=client)
+                   for _ in range(args.concurrency)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - start
+
+        manager.scrape_once()
+        views = sorted(manager.views(), key=lambda v: v.replica_id)
+        migration = lb_mod.merge_migration_stats(views)
+        # The sender's evacuation counters die with its process (it
+        # exits after the grace window, before a final scrape);
+        # receivers' migrations_in is the durable session count.
+        sessions_evac = max(
+            int(migration.get('sessions_evacuated', 0) or 0),
+            int(migration.get('migrations_in', 0) or 0))
+        server_recomputed = int(migration.get('tokens_recomputed', 0)
+                                or 0)
+        # Per-disrupted-session recompute: the migrate arm pays the
+        # sub-page remainder the chain keys could not cover; the
+        # replay arm pays the full prompt + lost tokens per retry.
+        if arm == 'replay':
+            per_session = (recomputed[0] / retries[0]
+                           if retries[0] else 0.0)
+        else:
+            per_session = (server_recomputed / sessions_evac
+                           if sessions_evac else 0.0)
+        ttfts = sorted(l[0] for l in latencies)
+        gaps_sorted = sorted(itl_gaps)
+        return {
+            'arm': arm,
+            'replicas': args.replicas,
+            'spot_replicas': args.storm_spot,
+            'storm_zone': args.storm_zone,
+            'requests': len(latencies),
+            'client_errors': errors[0],
+            'client_retries': retries[0],
+            'shed_requests': shed[0],
+            'token_mismatches': mismatches[0],
+            'replicas_preempted': preempted[0],
+            'sessions_migrated': sessions_evac,
+            'req_per_sec': round(len(latencies) / elapsed, 2),
+            'p50_ttft_ms': pct_ms(ttfts, 0.50),
+            'p99_ttft_ms': pct_ms(ttfts, 0.99),
+            'sse_itl_ms_p50': pct_ms(gaps_sorted, 0.50),
+            'sse_itl_ms_p99': pct_ms(gaps_sorted, 0.99),
+            'migration': migration,
+            'tokens_recomputed_client': recomputed[0],
+            'tokens_recomputed_server': server_recomputed,
+            'tokens_recomputed_per_preempted_session': round(
+                per_session, 2),
+        }
+    finally:
+        controller.shutdown()
+        lb.shutdown()
+
+
+def run_storm_ab(args) -> dict:
+    """The spot-storm A/B (the committed BENCH_migrate record):
+    the IDENTICAL workload through three stub fleets — no storm
+    (control), a zone storm answered by live KV-chain migration,
+    and the same storm with migration disabled (full replay from
+    the prompt). Headlines: tokens recomputed per preempted
+    session (~0 for migration vs prompt+lost-tokens for replay),
+    zero client 5xx in the migration arm, and every completed row
+    bit-identical to the closed-form unmigrated control."""
+    runs = {
+        'control': _run_storm_once(args, 'control'),
+        'migrate': _run_storm_once(args, 'migrate'),
+        'replay': _run_storm_once(args, 'replay'),
+    }
+    mig, rep = runs['migrate'], runs['replay']
+    return {
+        'bench': 'serve_storm',
+        'stub_replicas': True,
+        'replicas': args.replicas,
+        'spot_replicas': args.storm_spot,
+        'storm_zone': args.storm_zone,
+        'fault_plan': args.fault_plan,
+        'requests': args.requests,
+        'concurrency': args.concurrency,
+        'max_new_tokens': args.max_new_tokens,
+        'stub_token_sleep_ms': args.stub_token_sleep_ms,
+        'storm_seed': args.storm_seed,
+        'migrate_zero_5xx': mig['client_errors'] == 0,
+        'migrate_outputs_bit_identical':
+            mig['token_mismatches'] == 0,
+        'tokens_recomputed_per_preempted_session': {
+            'migrate': mig['tokens_recomputed_per_preempted_session'],
+            'replay': rep['tokens_recomputed_per_preempted_session'],
+        },
+        'p99_itl_ms': {name: r['sse_itl_ms_p99']
+                       for name, r in runs.items()},
+        'runs': runs,
+    }
+
+
 def run_spill_ab(args) -> dict:
     """The tiered-cache A/B (the committed BENCH_disagg record's
     `spill` half): the SAME multi-session workload against a
@@ -1381,6 +1665,27 @@ def main() -> None:
                              'and emit one combined JSON object '
                              '(the committed BENCH_disagg sweep). '
                              'Implies --stub-replicas')
+    parser.add_argument('--storm-ab', action='store_true',
+                        help='run the identical workload through a '
+                             'control fleet, a zone-storm fleet '
+                             'answering preemptions with live '
+                             'KV-chain migration, and a --no-migrate '
+                             'full-replay fleet, and emit one '
+                             'combined JSON object (the committed '
+                             'BENCH_migrate record). Implies '
+                             '--stub-replicas; needs --fault-plan '
+                             '(default: examples/fault_plans/'
+                             'decode_zone_storm.json)')
+    parser.add_argument('--storm-zone', default='us-east5-b',
+                        help='zone the storm plan scopes to; the '
+                             'first --storm-spot replicas carry it')
+    parser.add_argument('--storm-spot', type=int, default=1,
+                        help='how many replicas are spot (zoned) — '
+                             'the preemption victims')
+    parser.add_argument('--storm-seed', type=int, default=2026,
+                        help='FLEET-SHARED stub seed: migrated '
+                             'outputs are checked bit-for-bit '
+                             'against the closed-form control row')
     parser.add_argument('--spill-ab', action='store_true',
                         help='run the identical pool-pressured '
                              'workload with and without the '
@@ -1581,6 +1886,23 @@ def main() -> None:
         if not args.long_prompt_len:
             args.long_prompt_len = 512
         _emit(run_disagg_ab(args))
+        return
+    if args.storm_ab:
+        if args.adapters or args.quant_ab or args.disagg_ab:
+            parser.error('--storm-ab composes only with fleet '
+                         'knobs (it runs its own stub fleets)')
+        args.stub_replicas = True
+        if not args.replicas:
+            args.replicas = 3
+        if args.replicas < 2:
+            parser.error('--storm-ab needs --replicas >= 2 (the '
+                         'storm victims must have survivors to '
+                         'evacuate to)')
+        if not args.fault_plan:
+            args.fault_plan = os.path.join(
+                REPO, 'examples', 'fault_plans',
+                'decode_zone_storm.json')
+        _emit(run_storm_ab(args))
         return
     if args.spill_ab:
         if args.replicas or args.adapters:

@@ -36,6 +36,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List
 
 from skypilot_tpu.inference import openai_compat as oai
+from skypilot_tpu.inference import sse
 from skypilot_tpu.inference.runtime import (InferenceRuntime,
                                             iter_interleaved)
 from skypilot_tpu.observability import REGISTRY
@@ -43,11 +44,13 @@ from skypilot_tpu.observability import catalog as obs_catalog
 from skypilot_tpu.observability import tracing
 from skypilot_tpu.ops import pallas_paged as _pallas_paged
 from skypilot_tpu.robustness import faults
+from skypilot_tpu.robustness import train_guard
 from skypilot_tpu.robustness.errors import (AdapterLoadError,
                                             AdapterNotFoundError,
                                             DeadlineExceededError,
                                             EngineDeadError,
-                                            QueueSaturatedError)
+                                            QueueSaturatedError,
+                                            SessionMigratedError)
 
 
 #: This process's replica instance identity, echoed in `GET /stats`.
@@ -70,6 +73,11 @@ def classify_error(e: Exception):
         return 429, e.retry_after_s
     if isinstance(e, DeadlineExceededError):
         return 504, None
+    if isinstance(e, SessionMigratedError):
+        # Resume failed end to end (peer ship AND local replay): 503
+        # is retryable — the LB resubmits on another replica instead
+        # of surfacing the evacuation to the client.
+        return 503, 0.5
     if isinstance(e, (EngineDeadError, AdapterLoadError)):
         return 503, None
     if isinstance(e, AdapterNotFoundError):
@@ -286,6 +294,13 @@ def make_server(rt: InferenceRuntime,
                     }}
             if rt.role or rt.handoffs_total or rt.kv_imports_total:
                 body['handoff'] = rt.handoff_stats()
+            mig = rt.migration_stats()
+            if mig['sessions_evacuated'] or mig['migrations'] or \
+                    mig['migrations_in']:
+                # Live migration: out/in counts, recompute cost, and
+                # the migrated-in affinity keys the fleet controller
+                # pins at the LB so follow-ups land on the warm pages.
+                body['migration'] = mig
             if rt.adapters is not None:
                 body['adapters'] = rt.adapters.stats()
             if rt.slo_tracker is not None:
@@ -452,6 +467,12 @@ def make_server(rt: InferenceRuntime,
             if self.path == '/kv/peers':
                 self._kv_peers()
                 return
+            if self.path == '/kv/evacuate':
+                self._kv_evacuate()
+                return
+            if self.path == '/kv/migrate':
+                self._kv_migrate()
+                return
             handler = self._route_generation(self.path)
             if handler is None:
                 self._json({'error': 'POST /generate, /generate_text, '
@@ -514,6 +535,230 @@ def make_server(rt: InferenceRuntime,
             self.path = inner_path
             self._injected_body = inner
             handler()
+
+        # -- live KV-chain migration --------------------------------
+        def _kv_evacuate(self):
+            """Controller-initiated evacuation: a scale-down drain
+            POSTs {reason: 'drain'} before SIGTERM, a rebalance POSTs
+            {reason: 'rebalance', target, max_sessions}. Every
+            evacuated session's future resolves with
+            SessionMigratedError; the owning HTTP threads ship the
+            chains (to `target` when given, else the peer ring picks)
+            and proxy the tails. Responds with the evacuation count —
+            the migrations themselves complete asynchronously on
+            those threads."""
+            try:
+                req = self._read_body()
+            except (ValueError, OSError):
+                req = {}
+            reason = str(req.get('reason') or 'drain')
+            target = req.get('target') or None
+            max_sessions = req.get('max_sessions')
+            if max_sessions is not None:
+                max_sessions = int(max_sessions)
+            rt.set_evacuation_hint(reason, target)
+            total = {'evacuated': 0, 'chains': 0, 'queued': 0}
+            try:
+                for eng in rt.live_engines():
+                    fn = getattr(eng, 'evacuate_chains', None)
+                    if fn is None:
+                        continue
+                    s = fn(max_sessions=max_sessions, reason=reason)
+                    for k in total:
+                        total[k] += int(s.get(k, 0))
+                rt.record_evacuation(total)
+            except Exception as e:  # pylint: disable=broad-except
+                self._json({'error': f'{type(e).__name__}: {e}'}, 500)
+                return
+            self._json(dict(total, reason=reason))
+
+        def _kv_migrate(self):
+            """Receiving side of a live migration: import the packed
+            committed-token chain (when one shipped), account the
+            re-prefill cost and the session's affinity key (the ring
+            /stats exposes for LB pinning), then serve the embedded
+            continuation request — admission finds the committed full
+            pages resident, so only the sub-page tail recomputes and
+            greedy decoding continues bit-identically."""
+            import base64
+            try:
+                req = self._read_body()
+                inner = req.get('request') or {}
+                rows = inner.get('tokens') or []
+                row = ([int(t) for t in rows[0]]
+                       if rows and isinstance(rows[0], list) else [])
+                eng = rt.engine if rt.engine is not None \
+                    else rt.stream_engine()
+                summary = {'pages': 0, 'imported': 0,
+                           'already_cached': 0, 'dropped': 0}
+                if req.get('payload'):
+                    data = base64.b64decode(req['payload'])
+                    with tracing.span('kv.import',
+                                      getattr(self, '_trace_ctx',
+                                              None),
+                                      bytes=len(data)):
+                        summary = eng.import_chain(data)
+                    rt.record_kv_import(summary)
+                page_size = int(getattr(eng, 'page_size', 0) or 0)
+                covered = (summary['imported'] +
+                           summary['already_cached']) * page_size
+                recomputed = max(0, len(row) - covered) if row else 0
+                key = None
+                if row and getattr(eng, 'paged', False):
+                    from skypilot_tpu.inference import affinity
+                    key = affinity.token_affinity_key(
+                        row, page_size,
+                        salt=affinity.adapter_salt(inner.get('model')))
+                rt.record_migrated_in(key, recomputed)
+            except Exception as e:  # pylint: disable=broad-except
+                self._plain_error(e)
+                return
+            if not inner:
+                self._json({'imported': summary})
+                return
+            inner_path = str(req.get('path') or '/generate')
+            handler = self._route_generation(inner_path)
+            if handler is None:
+                self._json({'error': f'unroutable migration path '
+                                     f'{inner_path!r}'}, 400)
+                return
+            self.path = inner_path
+            self._injected_body = inner
+            handler()
+
+        def _migrate_record(self, rec, stream):
+            """Ship one evacuated session to a peer: POST the chain +
+            continuation request to /kv/migrate and return the open
+            upstream response (the caller proxies body or SSE tail).
+            None on ANY failure — injected kv.migrate fault, no peer,
+            peer refused — and the caller resumes locally on the
+            promoted warm pages."""
+            import base64
+
+            import requests as requests_lib
+            reason = str(rec.get('reason') or 'drain')
+            _hint_reason, target = rt.evacuation_hint()
+            t0 = time.monotonic()
+            try:
+                if faults.point('kv.migrate',
+                                reason=reason) is faults.DROP:
+                    raise RuntimeError('injected kv.migrate drop')
+                tokens = [int(t) for t in rec.get('tokens') or []]
+                if not tokens:
+                    raise RuntimeError('empty migration record')
+                remaining = int(rec.get('limit', 0)) - len(tokens)
+                if remaining <= 0:
+                    raise RuntimeError('no generation budget left')
+                peer = target
+                if peer is None:
+                    from skypilot_tpu.inference import affinity
+                    eng = next(iter(rt.live_engines()), None)
+                    key = None
+                    if eng is not None and getattr(eng, 'paged',
+                                                   False):
+                        key = affinity.token_affinity_key(
+                            tokens, eng.page_size,
+                            salt=affinity.adapter_salt(
+                                rec.get('adapter')))
+                    peer = rt.pick_decode_peer(key)
+                if not peer:
+                    raise RuntimeError('no migration peer available')
+                inner = {'tokens': [tokens],
+                         'max_new_tokens': remaining,
+                         'temperature': rec.get('temperature', 0.0),
+                         'top_k': rec.get('top_k', 0),
+                         'top_p': rec.get('top_p', 1.0),
+                         'stop_token_ids':
+                             rec.get('stop_token_ids') or [],
+                         'stream': bool(stream)}
+                if rec.get('adapter'):
+                    inner['model'] = rec['adapter']
+                if rec.get('deadline_s'):
+                    inner['timeout'] = rec['deadline_s']
+                body = {'path': '/generate', 'request': inner,
+                        'reason': reason}
+                if rec.get('payload'):
+                    body['payload'] = base64.b64encode(
+                        rec['payload']).decode()
+                ctx = getattr(self, '_trace_ctx', None)
+                hdrs = ({tracing.HEADER: tracing.format_header(ctx)}
+                        if ctx is not None else None)
+                read_timeout = float(rec.get('deadline_s') or
+                                     rt.request_timeout) + 60.0
+                with tracing.span('kv.migrate', ctx, peer=peer,
+                                  reason=reason):
+                    upstream = requests_lib.post(
+                        f'http://{peer}/kv/migrate', json=body,
+                        headers=hdrs, stream=True,
+                        timeout=(3.0, read_timeout))
+                if upstream.status_code != 200:
+                    code = upstream.status_code
+                    upstream.close()
+                    raise RuntimeError(
+                        f'migration peer {peer} answered {code}')
+            except Exception as e:  # pylint: disable=broad-except
+                rt.record_migration(reason, time.monotonic() - t0,
+                                    ok=False)
+                print(f'kv migrate failed ({type(e).__name__}: {e}); '
+                      f'resuming locally', flush=True)
+                return None
+            rt.record_migration(reason, time.monotonic() - t0,
+                                ok=True)
+            return upstream
+
+        def _resume_record(self, rec, depth: int = 0):
+            """Finish one evacuated (non-streaming) session: try the
+            peer ship, fall back to a local warm resume. Returns the
+            full token row (prompt + all generated)."""
+            upstream = self._migrate_record(rec, stream=False)
+            if upstream is not None:
+                try:
+                    with upstream:
+                        out = upstream.json()
+                    rows = out.get('tokens') or []
+                    if rows and isinstance(rows[0], list):
+                        return [int(t) for t in rows[0]]
+                except Exception as e:  # pylint: disable=broad-except
+                    print(f'kv migrate response unusable '
+                          f'({type(e).__name__}: {e}); resuming '
+                          f'locally', flush=True)
+            return self._resume_locally(rec, depth=depth)
+
+        def _resume_locally(self, rec, depth: int = 0):
+            """Local warm resume of an evacuated session: resubmit
+            the committed tokens — their full pages were promoted
+            into the prefix cache at evacuation, so admission is a
+            prefix-cache hit and only the sub-page tail recomputes.
+            A second evacuation mid-resume retries the whole ladder
+            (bounded); success counts as a 'local_fallback'
+            migration."""
+            tokens = [int(t) for t in rec.get('tokens') or []]
+            remaining = max(int(rec.get('limit', 0)) - len(tokens), 1)
+            adapter = rec.get('adapter')
+            eng = rt.engine_for(adapter)
+            if eng is None:
+                return tokens  # one-shot runtime: nothing to resume
+            deadline_s = (float(rec.get('deadline_s') or 0)
+                          or rt.request_timeout)
+            t0 = time.monotonic()
+            try:
+                fut = eng.submit(
+                    tokens, max_new_tokens=remaining,
+                    temperature=rec.get('temperature', 0.0),
+                    top_k=rec.get('top_k', 0),
+                    top_p=rec.get('top_p', 1.0),
+                    stop_token_ids=list(
+                        rec.get('stop_token_ids') or []),
+                    deadline_s=deadline_s, adapter=adapter,
+                    trace_ctx=getattr(self, '_trace_ctx', None))
+                row = fut.result(timeout=deadline_s + 30.0)
+            except SessionMigratedError as me:
+                if depth >= 2:
+                    raise
+                return self._resume_record(me.record, depth=depth + 1)
+            rt.record_migration('local_fallback',
+                                time.monotonic() - t0, ok=True)
+            return row
 
         def _maybe_handoff(self, path, req) -> bool:
             """Prefill-role disaggregation: prefill the prompt
@@ -622,14 +867,9 @@ def make_server(rt: InferenceRuntime,
                     self.wfile.write(body_bytes)
                     return True
                 self._sse_open = True
-                try:
-                    for chunk in upstream.iter_content(8192):
-                        if chunk:
-                            self.wfile.write(chunk)
-                            self.wfile.flush()
-                except (requests_lib.RequestException, OSError) as e:
-                    print(f'kv handoff stream truncated '
-                          f'({type(e).__name__})', flush=True)
+                eof, _first = sse.pipe(upstream, self.wfile)
+                if not eof:
+                    print('kv handoff stream truncated', flush=True)
             return True
 
         def _generate(self):
@@ -680,9 +920,19 @@ def make_server(rt: InferenceRuntime,
                         trace_ctx=getattr(self, '_trace_ctx', None))
                     # The engine's deadline sweep resolves expired
                     # futures with DeadlineExceededError (-> 504); the
-                    # host-side timeout is only a backstop.
-                    rows = [f.result(timeout=deadline_s + 30.0)
-                            for f in futs]
+                    # host-side timeout is only a backstop. A future
+                    # resolving with SessionMigratedError means the
+                    # engine evacuated the slot (drain / preemption /
+                    # rebalance): finish that row on a peer, or
+                    # locally on the promoted warm pages.
+                    rows = []
+                    for f in futs:
+                        try:
+                            rows.append(f.result(
+                                timeout=deadline_s + 30.0))
+                        except SessionMigratedError as me:
+                            rows.append(self._resume_record(
+                                me.record))
                     ttft = latch.first_token_s
                 else:
                     import jax
@@ -753,26 +1003,122 @@ def make_server(rt: InferenceRuntime,
             self.sse_start()
             n_gen = 0
             ttft = None
+            migrated = False
             # ITL is recorded at engine commit time by the handles'
             # on_token (StreamHandle), not at SSE delivery.
             try:
-                for i, t in iter_interleaved(handles):
-                    if ttft is None:
-                        ttft = time.monotonic() - t0
-                    n_gen += 1
-                    self.sse_send({'index': i, 'token': t})
+                try:
+                    for i, t in iter_interleaved(handles):
+                        if ttft is None:
+                            ttft = time.monotonic() - t0
+                        n_gen += 1
+                        self.sse_send({'index': i, 'token': t})
+                except SessionMigratedError:
+                    # The engine evacuated the slots mid-stream. The
+                    # interleaver drained every already-committed
+                    # token first, so the client is exactly caught up
+                    # with the committed sequence — finish the tail
+                    # from a peer (or locally) below.
+                    migrated = True
             finally:
                 rt.cancel_streams(handles)  # no-op when completed
+            if migrated:
+                final_rows = self._finish_migrated_stream(handles)
+                if final_rows is None:
+                    # Fully proxied: the peer's SSE tail (terminal
+                    # event + [DONE] included) already went out.
+                    rt.metrics.record(time.monotonic() - t0, n_gen,
+                                      ttft_s=ttft,
+                                      n_prompt_tokens=sum(
+                                          len(row) for row in tokens))
+                    return
+            else:
+                final_rows = [h.future.result() for h in handles]
             # Full rows in the terminal event: stream consumers get
             # the same payload the non-streaming endpoint returns.
-            self.sse_send({'done': True,
-                           'tokens': [h.future.result()
-                                      for h in handles]})
+            self.sse_send({'done': True, 'tokens': final_rows})
             self.sse_done()
             rt.metrics.record(time.monotonic() - t0, n_gen,
                               ttft_s=ttft,
                               n_prompt_tokens=sum(
                                   len(row) for row in tokens))
+
+        def _finish_migrated_stream(self, handles):
+            """Finish an SSE /generate stream whose slots were
+            evacuated mid-flight. Single-row streams proxy the peer's
+            SSE tail straight through (same {'index': 0, ...} frame
+            shape, terminal event included) — returns None. Multi-row
+            streams, and any ship failure, resume locally: the
+            continuation tokens keep streaming under their original
+            row indices and the full rows come back for the terminal
+            event."""
+            outcomes = []
+            for h in handles:
+                try:
+                    outcomes.append(('done',
+                                     h.future.result(timeout=0.001)))
+                except SessionMigratedError as me:
+                    outcomes.append(('rec', me.record))
+            recs = [(i, o[1]) for i, o in enumerate(outcomes)
+                    if o[0] == 'rec']
+            if len(handles) == 1 and recs:
+                upstream = self._migrate_record(recs[0][1],
+                                                stream=True)
+                if upstream is not None:
+                    with upstream:
+                        eof, _first = sse.pipe(upstream, self.wfile)
+                        if not eof:
+                            print('migration stream truncated',
+                                  flush=True)
+                    return None
+            rows = [o[1] if o[0] == 'done' else None
+                    for o in outcomes]
+            for i, rec in recs:
+                rows[i] = self._resume_stream_locally(i, rec)
+            return rows
+
+        def _resume_stream_locally(self, index, rec):
+            """Local warm resume of one evacuated streaming row:
+            resubmit the committed tokens (prefix-cache hit on the
+            promoted pages) and keep streaming the NEW tokens under
+            the row's original index. Returns the full row; a repeat
+            evacuation or failure returns the committed row as-is
+            (the stream truncates at the committed point, exactly
+            like a replica death would)."""
+            tokens = [int(t) for t in rec.get('tokens') or []]
+            remaining = max(int(rec.get('limit', 0)) - len(tokens), 1)
+            deadline_s = (float(rec.get('deadline_s') or 0)
+                          or rt.request_timeout)
+            t0 = time.monotonic()
+            try:
+                h = rt.submit_stream(
+                    tokens, remaining,
+                    rec.get('temperature', 0.0),
+                    top_k=rec.get('top_k', 0),
+                    top_p=rec.get('top_p', 1.0),
+                    stop_token_ids=list(
+                        rec.get('stop_token_ids') or []),
+                    deadline_s=deadline_s,
+                    adapter=rec.get('adapter'),
+                    trace_ctx=getattr(self, '_trace_ctx', None))
+            except Exception as e:  # pylint: disable=broad-except
+                print(f'local stream resume failed to submit '
+                      f'({type(e).__name__}: {e}); stream truncates '
+                      f'at the committed point', flush=True)
+                return tokens
+            try:
+                for _j, t in iter_interleaved([h]):
+                    self.sse_send({'index': index, 'token': t})
+                row = h.future.result(timeout=deadline_s + 30.0)
+            except Exception as e:  # pylint: disable=broad-except
+                print(f'local stream resume failed '
+                      f'({type(e).__name__}: {e}); stream truncates '
+                      f'at the committed point', flush=True)
+                rt.cancel_streams([h])
+                return tokens
+            rt.record_migration('local_fallback',
+                                time.monotonic() - t0, ok=True)
+            return row
 
         def _openai_completions(self):
             try:
@@ -793,10 +1139,22 @@ def make_server(rt: InferenceRuntime,
                     deadline_s=rt.deadline_for(body),
                     adapter=rt.resolve_model(body.get('model')),
                     model=body.get('model'))
-                if req.stream:
-                    oai.stream_completion(rt, req, self)
-                else:
-                    self._json(oai.run_completion(rt, req))
+                try:
+                    if req.stream:
+                        oai.stream_completion(rt, req, self)
+                    else:
+                        self._json(oai.run_completion(rt, req))
+                except SessionMigratedError:
+                    # Evacuated mid-request: replay on the promoted
+                    # warm pages (the prompt prefill is a prefix-cache
+                    # hit). Mid-stream there is no replay — headers
+                    # are out; _oai_error truncates the stream.
+                    if getattr(self, '_sse_open', False):
+                        raise
+                    if req.stream:
+                        oai.stream_completion(rt, req, self)
+                    else:
+                        self._json(oai.run_completion(rt, req))
             except Exception as e:  # pylint: disable=broad-except
                 self._oai_error(e)
 
@@ -825,11 +1183,23 @@ def make_server(rt: InferenceRuntime,
                     deadline_s=rt.deadline_for(body),
                     adapter=adapter,
                     model=body.get('model'))
-                if req.stream:
-                    oai.stream_completion(rt, req, self, chat=True)
-                else:
-                    self._json(oai.to_chat_response(
-                        oai.run_completion(rt, req)))
+                try:
+                    if req.stream:
+                        oai.stream_completion(rt, req, self,
+                                              chat=True)
+                    else:
+                        self._json(oai.to_chat_response(
+                            oai.run_completion(rt, req)))
+                except SessionMigratedError:
+                    # Same warm-replay contract as /v1/completions.
+                    if getattr(self, '_sse_open', False):
+                        raise
+                    if req.stream:
+                        oai.stream_completion(rt, req, self,
+                                              chat=True)
+                    else:
+                        self._json(oai.to_chat_response(
+                            oai.run_completion(rt, req)))
             except Exception as e:  # pylint: disable=broad-except
                 self._oai_error(e)
 
@@ -894,8 +1264,14 @@ def make_server(rt: InferenceRuntime,
                         top_p=top_p, on_token=latch,
                         deadline_s=deadline_s, adapter=adapter,
                         trace_ctx=getattr(self, '_trace_ctx', None))
-                    rows = [f.result(timeout=deadline_s + 30.0)
-                            for f in futs]
+                    rows = []
+                    for f in futs:
+                        try:
+                            rows.append(f.result(
+                                timeout=deadline_s + 30.0))
+                        except SessionMigratedError as me:
+                            rows.append(self._resume_record(
+                                me.record))
                     ttft = latch.first_token_s
                 else:
                     rows = rt.one_shot_rows(encoded, max_new,
@@ -934,18 +1310,40 @@ def make_server(rt: InferenceRuntime,
                      for _ in encoded]
             n_gen = 0
             ttft = None
+            migrated = False
             try:
-                for i, t in iter_interleaved(handles):
-                    if ttft is None:
-                        ttft = time.monotonic() - t0
-                    n_gen += 1
-                    if scans[i].hit:
-                        continue
-                    out = scans[i].push(decs[i].push(t))
-                    if out:
-                        self.sse_send({'index': i, 'delta': out})
+                try:
+                    for i, t in iter_interleaved(handles):
+                        if ttft is None:
+                            ttft = time.monotonic() - t0
+                        n_gen += 1
+                        if scans[i].hit:
+                            continue
+                        out = scans[i].push(decs[i].push(t))
+                        if out:
+                            self.sse_send({'index': i, 'delta': out})
+                except SessionMigratedError:
+                    migrated = True
             finally:
                 rt.cancel_streams(handles)  # no-op when completed
+            if migrated:
+                # Evacuated mid-stream: the committed deltas already
+                # went out; finish each migrated row locally on the
+                # promoted warm pages (text endpoints never ship —
+                # the peer path is token-request only).
+                for i, h in enumerate(handles):
+                    try:
+                        h.future.result(timeout=0.001)
+                    except SessionMigratedError as me:
+                        self._resume_text_stream_locally(
+                            i, me.record, decs, scans)
+                    except Exception as e:  # pylint: disable=broad-except
+                        # Row failed for a non-migration reason: the
+                        # stream truncates for it, like the pre-
+                        # migration behavior.
+                        print(f'text stream row {i} failed during '
+                              f'evacuation ({type(e).__name__}: {e})',
+                              flush=True)
             for i in range(len(handles)):
                 if not scans[i].hit:
                     out = (scans[i].push(decs[i].flush()) +
@@ -958,6 +1356,47 @@ def make_server(rt: InferenceRuntime,
                               n_prompt_tokens=sum(
                                   len(ids) for ids in encoded))
 
+        def _resume_text_stream_locally(self, index, rec, decs,
+                                        scans):
+            """Local warm resume of one evacuated text-stream row:
+            continuation tokens run through the row's incremental
+            decoder + stop scanner so the delta stream picks up
+            exactly where it left off."""
+            tokens = [int(t) for t in rec.get('tokens') or []]
+            remaining = max(int(rec.get('limit', 0)) - len(tokens), 1)
+            deadline_s = (float(rec.get('deadline_s') or 0)
+                          or rt.request_timeout)
+            t0 = time.monotonic()
+            try:
+                h = rt.submit_stream(
+                    tokens, remaining,
+                    rec.get('temperature', 0.0),
+                    top_k=rec.get('top_k', 0),
+                    top_p=rec.get('top_p', 1.0),
+                    deadline_s=deadline_s,
+                    adapter=rec.get('adapter'),
+                    trace_ctx=getattr(self, '_trace_ctx', None))
+            except Exception as e:  # pylint: disable=broad-except
+                print(f'local text-stream resume failed to submit '
+                      f'({type(e).__name__}: {e}); row {index} '
+                      f'truncates at the committed point', flush=True)
+                return
+            try:
+                for _j, t in iter_interleaved([h]):
+                    if scans[index].hit:
+                        continue
+                    out = scans[index].push(decs[index].push(t))
+                    if out:
+                        self.sse_send({'index': index, 'delta': out})
+            except Exception as e:  # pylint: disable=broad-except
+                print(f'local text-stream resume failed '
+                      f'({type(e).__name__}: {e}); row {index} '
+                      f'truncates at the committed point', flush=True)
+                rt.cancel_streams([h])
+                return
+            rt.record_migration('local_fallback',
+                                time.monotonic() - t0, ok=True)
+
     server = ThreadingHTTPServer(('0.0.0.0', port), Handler)
     server.inflight = _inflight            # type: ignore[attr-defined]
     server.inflight_lock = _inflight_lock  # type: ignore[attr-defined]
@@ -965,19 +1404,87 @@ def make_server(rt: InferenceRuntime,
     return server
 
 
+class ServePreemptionNotice(train_guard.PreemptionNotice):
+    """Serving-side preemption watcher: the trainer's GCE-metadata
+    poll + injectable notice (robustness/train_guard.py), firing the
+    `serve.preempt_notice` fault point instead of the trainer's —
+    zone-scoped drop rules are how decode_zone_storm.json preempts
+    one spot pool without touching the rest of the fleet. SIGTERM
+    stays with serve()'s own drain handler (install_sigterm=False),
+    which evacuates too; this watcher covers the ~30s metadata notice
+    that arrives BEFORE the SIGTERM on GCE spot VMs."""
+
+    def trigger(self, reason: str) -> None:
+        # Latch only: the train-plane notice counter stays a train
+        # metric; serving preemptions are visible through the
+        # migration counters the evacuation path ticks.
+        if not self.notice.is_set():
+            self.reason = reason
+            self.notice.set()
+
+    def _poll_loop(self) -> None:
+        while not self._stop.is_set() and not self.notice.is_set():
+            self.polls += 1
+            if faults.point('serve.preempt_notice',
+                            **self.ctx) is faults.DROP:
+                self.trigger('injected')
+                break
+            if self._probe_metadata():
+                self.trigger('metadata')
+                break
+            self._stop.wait(self.poll_interval_s)
+
+
+def evacuate_for_exit(rt: InferenceRuntime,
+                      reason: str = 'drain') -> dict:
+    """Mass chain evacuation ahead of process exit (SIGTERM drain or
+    preemption notice): every live engine's active sessions resolve
+    with SessionMigratedError, and their owning HTTP threads ship the
+    chains to peers / finish locally on the promoted pages. Failures
+    are logged, never raised — a broken engine must not stop the
+    drain from completing."""
+    total = {'evacuated': 0, 'chains': 0, 'queued': 0}
+    for eng in rt.live_engines():
+        fn = getattr(eng, 'evacuate_chains', None)
+        if fn is None:
+            continue
+        try:
+            s = fn(reason=reason)
+        except Exception as e:  # pylint: disable=broad-except
+            print(f'evacuation failed on an engine '
+                  f'({type(e).__name__}: {e}); its sessions finish '
+                  f'locally', flush=True)
+            continue
+        for k in total:
+            total[k] += int(s.get(k, 0))
+    if total['evacuated'] or total['queued']:
+        rt.record_evacuation(total)
+        print(f'serve_lm: evacuated {total["evacuated"]} active + '
+              f'{total["queued"]} queued sessions '
+              f'({total["chains"]} KV chains packed, '
+              f'reason={reason})', flush=True)
+    return total
+
+
 def drain(server: ThreadingHTTPServer, rt: InferenceRuntime,
           drain_grace: float, straggler_grace: float = 0.5,
           exit_fn=os._exit) -> None:
     """Graceful drain: flip /readyz to 503 (readiness probes pull the
-    replica out of rotation), let the accept loop pick up stragglers
-    for `straggler_grace`, stop accepting, wait for in-flight POSTs
-    (bounded by `drain_grace`), exit 0 — a mid-generation client must
-    not see a reset because the controller culled this replica.
-    `exit_fn` is injectable so the drain contract is testable without
-    killing the test process."""
+    replica out of rotation), evacuate every active KV chain (the
+    owning HTTP threads migrate the sessions to peers — in-flight
+    POSTs the wait below covers — or finish them locally), let the
+    accept loop pick up stragglers for `straggler_grace`, stop
+    accepting, wait for in-flight POSTs (bounded by `drain_grace`),
+    exit 0 — a mid-generation client must not see a reset because the
+    controller culled this replica. `exit_fn` is injectable so the
+    drain contract is testable without killing the test process."""
     server.draining.set()
     print('serve_lm: SIGTERM — draining in-flight requests',
           flush=True)
+    # Drain-by-migration (idempotent: a controller that already
+    # POSTed /kv/evacuate left the engines empty, and this finds
+    # nothing). Failure falls back to the classic local-finish drain.
+    evacuate_for_exit(rt, reason='drain')
     time.sleep(straggler_grace)  # stragglers: accept loop gets them
     server.shutdown()   # stops accepting; handlers keep running
     deadline = time.monotonic() + drain_grace
@@ -995,11 +1502,17 @@ def drain(server: ThreadingHTTPServer, rt: InferenceRuntime,
 
 
 def serve(rt: InferenceRuntime, port: int,
-          drain_grace: float = 630.0) -> None:
+          drain_grace: float = 630.0, zone: str = '',
+          watch_preemption: bool = True) -> None:
     """Run the HTTP server until killed. `drain_grace` bounds the
     SIGTERM drain wait; it defaults ABOVE the 600s request-timeout
     default so a worst-case in-flight generation still completes —
-    requests longer than the grace window are dropped at exit."""
+    requests longer than the grace window are dropped at exit.
+    `zone` labels the replica's placement (spot decode pools) and
+    scopes the preemption watcher's fault context; the watcher turns
+    a GCE preemption notice — or an injected `serve.preempt_notice`
+    drop — into mass chain evacuation followed by the normal drain,
+    all inside the ~30s grace window."""
     server = make_server(rt, port)
 
     _term = threading.Event()
@@ -1014,6 +1527,22 @@ def serve(rt: InferenceRuntime, port: int,
 
     threading.Thread(target=_drain_loop, daemon=True).start()
     signal.signal(signal.SIGTERM, lambda *_: _term.set())
+    if watch_preemption:
+        ctx = {'zone': zone} if zone else {}
+        notice = ServePreemptionNotice(poll_interval_s=2.0,
+                                       install_sigterm=False,
+                                       ctx=ctx)
+        notice.start()
+
+        def _preempt_watch():
+            notice.notice.wait()
+            print(f'serve_lm: preemption notice ({notice.reason}) — '
+                  f'evacuating active sessions', flush=True)
+            rt.set_evacuation_hint('preempt', None)
+            evacuate_for_exit(rt, reason='preempt')
+            _term.set()  # the drain loop finishes the exit
+
+        threading.Thread(target=_preempt_watch, daemon=True).start()
     print(f'serve_lm listening on :{port} model={rt.model_name}',
           flush=True)
     server.serve_forever()

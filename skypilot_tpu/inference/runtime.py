@@ -280,6 +280,31 @@ class InferenceRuntime:
             'skypilot_serving_kv_handoff_seconds')
         self._handoff_bytes = _obs.counter(
             'skypilot_serving_kv_handoff_bytes_total')
+        # Live KV-chain migration (PR 20): out-migration counts by
+        # trigger reason, evacuation totals, and the bounded ring of
+        # affinity keys migrated IN — /stats exposes the ring so the
+        # fleet controller can pin those sessions' follow-ups to this
+        # replica at the LB.
+        self._migration_lock = threading.Lock()
+        self.migrations_by_reason: Dict[str, int] = {}
+        self.migration_failures = 0
+        self.sessions_evacuated_total = 0
+        self.chains_evacuated_total = 0
+        self.tokens_recomputed_total = 0
+        self.migrations_in_total = 0
+        self._migrated_in_keys: 'collections.OrderedDict[str, None]' \
+            = collections.OrderedDict()
+        # Evacuation hint: set by /kv/evacuate (controller-supplied
+        # target + reason), read by the HTTP threads whose futures
+        # resolve with SessionMigratedError moments later. Expires so
+        # a stale rebalance hint can't redirect a later drain.
+        self._evac_hint: Optional[Dict[str, object]] = None
+        self._migration_seconds = _obs.histogram(
+            'skypilot_serving_migration_seconds')
+        self._chains_evacuated = _obs.counter(
+            'skypilot_serving_chains_evacuated_total')
+        self._tokens_recomputed = _obs.counter(
+            'skypilot_serving_tokens_recomputed_total')
         if decode_peers:
             self.set_decode_peers(decode_peers)
         # Quantized-serving storage formats (inference/quant.py +
@@ -411,6 +436,92 @@ class InferenceRuntime:
                 'bytes': self.handoff_bytes_total,
                 'kv_imports': self.kv_imports_total,
                 'kv_imported_pages': self.kv_imported_pages_total,
+            }
+
+    # -- live KV-chain migration --------------------------------------------
+    #: migrated-in affinity keys retained for controller pinning
+    _MIGRATED_KEYS_MAX = 1024
+    #: how long an evacuation hint stays actionable
+    _EVAC_HINT_TTL_S = 30.0
+
+    def set_evacuation_hint(self, reason: str,
+                            target: Optional[str]) -> None:
+        """Remember why the engine is about to evacuate (and where the
+        controller wants the chains to go). Read by the HTTP threads
+        whose futures resolve with SessionMigratedError; expires after
+        a grace-window's worth of seconds so a stale rebalance target
+        cannot redirect a later drain."""
+        with self._migration_lock:
+            self._evac_hint = {'reason': str(reason or 'drain'),
+                               'target': target or None,
+                               'expires': time.monotonic() +
+                               self._EVAC_HINT_TTL_S}
+
+    def evacuation_hint(self) -> Tuple[str, Optional[str]]:
+        """(reason, target) of the live evacuation hint; defaults to
+        ('drain', None) — ring-chosen target — when none is set."""
+        with self._migration_lock:
+            hint = self._evac_hint
+            if hint and time.monotonic() < float(hint['expires']):
+                return str(hint['reason']), hint['target']  # type: ignore[return-value]
+        return 'drain', None
+
+    def record_evacuation(self, summary: Dict[str, int]) -> None:
+        """Account one engine evacuate_chains() result."""
+        n_sessions = int(summary.get('evacuated', 0)) + \
+            int(summary.get('queued', 0))
+        n_chains = int(summary.get('chains', 0))
+        with self._migration_lock:
+            self.sessions_evacuated_total += n_sessions
+            self.chains_evacuated_total += n_chains
+        if n_chains:
+            self._chains_evacuated.inc(n_chains)
+
+    def record_migration(self, reason: str, seconds: float,
+                         ok: bool) -> None:
+        """Account one out-migration attempt (chain POST + tail
+        proxy). Failed ships count under their own reason AND bump
+        migration_failures; the session then finishes locally and the
+        fallback is recorded separately as 'local_fallback'."""
+        from skypilot_tpu.observability import catalog as _obs
+        with self._migration_lock:
+            self.migrations_by_reason[reason] = \
+                self.migrations_by_reason.get(reason, 0) + 1
+            if not ok:
+                self.migration_failures += 1
+        if ok:
+            _obs.counter('skypilot_serving_migrations_total').labels(
+                reason=reason).inc()
+        self._migration_seconds.observe(seconds)
+
+    def record_migrated_in(self, affinity_key: Optional[str],
+                           tokens_recomputed: int) -> None:
+        """Account one migrated-in session on the receiving side: the
+        re-prefill cost (committed tokens not covered by imported
+        pages) and the session's affinity key, kept in a bounded ring
+        /stats exposes for LB pinning."""
+        with self._migration_lock:
+            self.migrations_in_total += 1
+            self.tokens_recomputed_total += int(tokens_recomputed)
+            if affinity_key:
+                self._migrated_in_keys.pop(affinity_key, None)
+                self._migrated_in_keys[affinity_key] = None
+                while len(self._migrated_in_keys) > \
+                        self._MIGRATED_KEYS_MAX:
+                    self._migrated_in_keys.popitem(last=False)
+        if tokens_recomputed:
+            self._tokens_recomputed.inc(int(tokens_recomputed))
+
+    def migration_stats(self) -> Dict[str, object]:
+        with self._migration_lock:
+            return {
+                'migrations': dict(self.migrations_by_reason),
+                'failures': self.migration_failures,
+                'sessions_evacuated': self.sessions_evacuated_total,
+                'chains_evacuated': self.chains_evacuated_total,
+                'migrations_in': self.migrations_in_total,
+                'tokens_recomputed': self.tokens_recomputed_total,
+                'migrated_in_keys': list(self._migrated_in_keys),
             }
 
     # -- model / adapter resolution -----------------------------------------

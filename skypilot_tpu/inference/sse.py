@@ -1,0 +1,65 @@
+"""SSE pass-through piping for serving-plane proxies.
+
+Three proxies forward a replica's `text/event-stream` body to a
+waiting client: the LB's streaming pass-through, a prefill replica's
+handoff proxy, and a migration sender piping its session's tail
+through from the new owner. All of them used to loop over requests'
+`iter_content(N)` — which BLOCKS until N bytes or EOF. Token frames
+are a few dozen bytes, so any stream shorter than N was forwarded in
+one burst at EOF: the proxy silently destroyed streaming latency
+(TTFT through the LB was the END of the stream) while every timing
+metric on the replica itself looked healthy.
+
+`pipe()` forwards bytes as they ARRIVE: urllib3's `read1(n)` returns
+whatever the socket currently has (blocking only when there is
+nothing), falling back to byte-granular reads on clients without
+`read1`. Truncation — the upstream dying or the downstream client
+going away — ends the pipe and is reported in the result, never
+raised: a proxied stream that breaks mid-flight must look to the
+client exactly like a direct replica death, not become a proxy
+error after headers are already out.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Optional, Tuple
+
+#: read1 budget per syscall — an upper bound, not a wait target.
+_CHUNK = 8192
+
+
+def pipe(upstream: Any, wfile: Any) -> Tuple[bool, Optional[float]]:
+    """Pipe `upstream` (a `requests` streamed response) to `wfile`
+    with arrival granularity. Returns `(reached_eof, first_at)`;
+    `first_at` is the `time.monotonic()` instant the first body
+    bytes arrived (None for an empty body), so callers can compute
+    TTFT against their own request start."""
+    first_at: Optional[float] = None
+    raw = getattr(upstream, 'raw', None)
+    read1 = getattr(raw, 'read1', None)
+    try:
+        if read1 is not None:
+            while True:
+                chunk = read1(_CHUNK)
+                if not chunk:
+                    return True, first_at
+                if first_at is None:
+                    first_at = time.monotonic()
+                wfile.write(chunk)
+                wfile.flush()
+        # No read1 on this urllib3: byte-granular reads keep frames
+        # flowing at arrival time (CPU-heavier, never buffering).
+        for chunk in upstream.iter_content(1):
+            if not chunk:
+                continue
+            if first_at is None:
+                first_at = time.monotonic()
+            wfile.write(chunk)
+            wfile.flush()
+        return True, first_at
+    except Exception as e:  # pylint: disable=broad-except
+        # Upstream death or client disconnect mid-stream: bounded
+        # truncation; the caller decides whether and how to log.
+        print(f'sse: pipe truncated ({type(e).__name__}: {e})',
+              flush=True)
+        return False, first_at

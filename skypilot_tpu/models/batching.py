@@ -57,7 +57,8 @@ from skypilot_tpu.robustness import faults
 from skypilot_tpu.robustness.errors import (AdapterNotFoundError,
                                             DeadlineExceededError,
                                             EngineDeadError,
-                                            QueueSaturatedError)
+                                            QueueSaturatedError,
+                                            SessionMigratedError)
 
 
 def _bucket(n: int, cap: int) -> int:
@@ -285,6 +286,10 @@ class ContinuousBatchingEngine:
         'kv_restore_hits': 'scheduler',
         'deadline_exceeded': 'scheduler', 'engine_restarts': 'scheduler',
         '_soft_errors': 'scheduler',
+        # live-migration counters (PR 20): evacuated sessions and the
+        # subset that shipped a packed KV chain with them
+        'sessions_evacuated': 'scheduler',
+        'chains_evacuated': 'scheduler',
     }
 
     def __init__(self, model, params, *, num_slots: int = 8,
@@ -606,6 +611,11 @@ class ContinuousBatchingEngine:
         self.prefill_chunks_run = 0
         self.decode_stall_s = 0.0        # host blocked on device_get
         self.last_prefill_tokens = 0     # budget spent, last iteration
+        # Live migration (PR 20): sessions evacuated off this engine
+        # (drain / preemption notice / rebalance) and the subset whose
+        # committed KV chain was packed for shipment.
+        self.sessions_evacuated = 0
+        self.chains_evacuated = 0
 
         # Admission control (load shedding): 0 = unbounded. submit()
         # raises QueueSaturatedError instead of queueing past these —
@@ -1850,6 +1860,172 @@ class ContinuousBatchingEngine:
                                dropped=dropped)
             return {'pages': len(keys), 'imported': len(fit),
                     'already_cached': already, 'dropped': dropped}
+
+        return self.run_on_scheduler(op)
+
+    def _evacuate_slot(self, slot: int, reason: str) -> Dict[str, Any]:
+        """Evacuate ONE occupied slot (scheduler thread only): pack
+        the committed-token KV chain, tear the slot down, and resolve
+        its future with SessionMigratedError carrying everything a
+        peer needs to finish the session. Mirrors _fail_slot's
+        teardown order, with two migration twists: (1) the chain is
+        gathered from the slot's LIVE page table (prefix+generated,
+        not just the prompt chain export_chain covers); (2) before
+        release, `slot_keys` is rewritten to the FULL committed chain
+        so promote=True parks every exported page in the local prefix
+        cache too — a failed ship falls back to warm local pages, not
+        a cold replay. Mid-prefill slots ship no payload and never
+        promote (pages past the frontier are unwritten junk)."""
+        committed = [int(t) for t in self.outputs[slot]]
+        adapter = self.slot_adapter_name[slot]
+        was_prefilling = bool(self.prefilling[slot])
+        payload = None
+        n_chain = 0
+        if self.paged and self.prefix_cache is not None and \
+                not was_prefilling:
+            salt = b''
+            if adapter is not None and self.adapter_store is not None:
+                salt = self.adapter_store.cache_salt(adapter)
+            keys = PrefixCache.chain_keys(committed, self.page_size,
+                                          salt=salt)
+            if keys:
+                try:
+                    from skypilot_tpu.inference import kv_transfer
+                    phys = [int(p) for p in
+                            self.page_table[slot, :len(keys)]]
+                    blobs = self._gather_page_blobs(phys)
+                    cfg = self.model.config
+                    meta = {'kind': 'kv_chain',
+                            'kv_dtype': self.kv_dtype,
+                            'page_size': self.page_size,
+                            'num_kv_heads': int(getattr(
+                                cfg, 'num_kv_heads', 0) or 0),
+                            'head_dim': int(getattr(
+                                cfg, 'head_dim', 0) or 0),
+                            'num_layers': int(getattr(
+                                cfg, 'num_layers', 0) or 0),
+                            'keys': [k.hex() for k in keys],
+                            'salt': salt.hex()}
+                    payload = kv_transfer.pack_pages(blobs, meta)
+                    n_chain = len(keys)
+                except Exception:  # pylint: disable=broad-except
+                    payload = None  # ship nothing; peer re-prefills
+                # Full-chain promotion on teardown (see docstring).
+                self.slot_keys[slot] = keys
+        deadline = float(self.deadlines[slot])
+        record = {
+            'reason': reason,
+            'tokens': committed,
+            'prompt_len': int(self.prompt_len[slot]),
+            'limit': int(self.limits[slot]),
+            'temperature': float(self.temps[slot]),
+            'top_k': int(self.top_ks[slot]),
+            'top_p': float(self.top_ps[slot]),
+            'stop_token_ids': sorted(self.stop_ids[slot]),
+            'adapter': adapter,
+            'deadline_s': (max(deadline - time.monotonic(), 0.5)
+                           if deadline else 0.0),
+            'payload': payload,
+            'pages': n_chain,
+        }
+        fut = self.futures[slot]
+        self.futures[slot] = None
+        self.active[slot] = False
+        self.on_tokens[slot] = None
+        self.deadlines[slot] = 0.0
+        self._slot_ctx[slot] = None
+        self._release_adapter(slot)
+        if was_prefilling:
+            self.prefilling[slot] = False
+            try:
+                self._prefill_order.remove(slot)
+            except ValueError:
+                pass
+        if self.paged:
+            self._release_slot_pages(slot,
+                                     promote=not was_prefilling)
+        self.sessions_evacuated += 1
+        if payload is not None:
+            self.chains_evacuated += 1
+        self.flight.record('evacuate', slot=slot, reason=reason,
+                           pages=n_chain,
+                           bytes=len(payload) if payload else 0)
+        if fut is not None:
+            fut.set_exception(SessionMigratedError(record))
+        return record
+
+    def _evacuate_queued(self, reason: str) -> int:
+        """Fail every queued (not-yet-admitted) request with a
+        payload-less SessionMigratedError: nothing should sit waiting
+        on a dying replica when its caller can resubmit elsewhere
+        immediately. Scheduler thread only."""
+        while True:
+            try:
+                self._ready.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        n = 0
+        while self._ready:
+            (prompt, max_new, temp, top_k, top_p, stops, _adapter,
+             _tref, _on_token, deadline, fut) = self._ready.popleft()
+            self._queued_tokens_sub(len(prompt))
+            record = {
+                'reason': reason,
+                'tokens': [int(t) for t in prompt],
+                'prompt_len': len(prompt),
+                'limit': min(len(prompt) + int(max_new),
+                             self.max_total_len),
+                'temperature': float(temp),
+                'top_k': int(top_k),
+                'top_p': float(top_p),
+                'stop_token_ids': sorted(stops),
+                'adapter': _adapter,
+                'deadline_s': (max(deadline - time.monotonic(), 0.5)
+                               if deadline else 0.0),
+                'payload': None,
+                'pages': 0,
+            }
+            fut.set_exception(SessionMigratedError(record))
+            n += 1
+        return n
+
+    def evacuate_chains(self, max_sessions: Optional[int] = None,
+                        reason: str = 'drain') -> Dict[str, int]:
+        """Evacuate active sessions for live migration (drain,
+        preemption notice, or rebalance): each occupied slot's
+        committed tokens + packed KV chain come back to its waiting
+        HTTP thread as a SessionMigratedError record, and the pages
+        stay promoted in the LOCAL prefix cache as the warm fallback.
+        `max_sessions=None` evacuates everything INCLUDING the queue
+        (full drain); a bounded count (rebalance) takes the
+        deepest-chain sessions first — most recompute saved per
+        migration — and leaves the queue alone. Thread-safe: hops
+        onto the scheduler thread. Returns
+        {'evacuated', 'chains', 'queued'}."""
+
+        def op():
+            evacuated = 0
+            chains = 0
+            limit_n = (self.num_slots if max_sessions is None
+                       else max(int(max_sessions), 0))
+            # Deepest committed sequence first: those chains cost the
+            # most to recompute, so under a bounded budget they are
+            # the ones worth shipping.
+            order = sorted(
+                (s for s in range(self.num_slots)
+                 if self.active[s] or self.prefilling[s]),
+                key=lambda s: -len(self.outputs[s]))
+            for slot in order:
+                if evacuated >= limit_n:
+                    break
+                rec = self._evacuate_slot(slot, reason)
+                evacuated += 1
+                if rec.get('payload') is not None:
+                    chains += 1
+            queued = (self._evacuate_queued(reason)
+                      if max_sessions is None else 0)
+            return {'evacuated': evacuated, 'chains': chains,
+                    'queued': queued}
 
         return self.run_on_scheduler(op)
 

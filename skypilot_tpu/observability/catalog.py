@@ -164,6 +164,32 @@ SPECS: Dict[str, Tuple] = {
     'skypilot_serving_kv_handoff_bytes_total': (
         'counter', 'Packed KV chain bytes shipped to decode replicas '
                    'by this prefill replica', ()),
+    # -- live KV-chain migration (models/batching.evacuate_chains +
+    #    http_server /kv/evacuate + /kv/migrate)
+    'skypilot_serving_migrations_total': (
+        'counter', 'Sessions this replica migrated OUT to a peer '
+                   '(chain shipped + tail proxied), by trigger: '
+                   'drain (scale-down victim / SIGTERM), preempt '
+                   '(preemption notice), rebalance (hot-spot '
+                   'migration), or local_fallback (peer ship failed; '
+                   'finished locally on the promoted warm pages)',
+        ('reason',)),
+    'skypilot_serving_chains_evacuated_total': (
+        'counter', 'Active KV chains the engine evacuated (packed '
+                   'committed-token pages + SessionMigratedError to '
+                   'the owning HTTP thread); >= migrations_total '
+                   'because failed ships fall back locally', ()),
+    'skypilot_serving_migration_seconds': (
+        'histogram', 'Wall time of one session migration: chain POST '
+                     'to /kv/migrate through the peer\'s first '
+                     'response byte (success or failure)',
+        (), {'buckets': REQUEST_BUCKETS}),
+    'skypilot_serving_tokens_recomputed_total': (
+        'counter', 'Committed tokens a migrated-in session had to '
+                   're-prefill on this replica (committed length '
+                   'minus imported/cached full-page coverage): the '
+                   'migration-vs-full-replay recompute cost, ~0 when '
+                   'the chain shipped intact', ()),
     # -- multi-LoRA adapter registry (inference/adapters.py)
     'skypilot_serving_adapters_loaded': (
         'gauge', 'Adapters resident in the device store (loaded '

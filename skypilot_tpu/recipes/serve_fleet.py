@@ -148,6 +148,43 @@ def main() -> None:
                         help='initial + minimum replica count (the '
                              'DECODE pool when --prefill-replicas '
                              'is set)')
+    parser.add_argument('--spot-decode', type=int, default=0,
+                        metavar='N',
+                        help='spot decode pool: N additional decode '
+                             'replicas labeled with zones walked in '
+                             'the catalog\'s RISK-ADJUSTED spot '
+                             'order (spot_zone_economics: price x '
+                             'preemption-rate multiplier) for '
+                             '--spot-accelerator. A PreemptionNotice '
+                             '(or a serve.preempt_notice fault rule '
+                             'scoped to the zone) makes the replica '
+                             'evacuate every KV chain to on-demand '
+                             'survivors inside the ~30s grace '
+                             'window instead of dropping sessions')
+    parser.add_argument('--spot-accelerator', default='tpu-v5e-16',
+                        metavar='ACC',
+                        help='TPU type whose catalog rows price the '
+                             'spot decode pool (zone labels + '
+                             '$/hour in /fleet/status and the '
+                             'journal)')
+    parser.add_argument('--rebalance-skew', type=float, default=0.0,
+                        metavar='R',
+                        help='hot-spot rebalancing: when one ready '
+                             'replica\'s load (prefill backlog '
+                             'tokens + queue depth) exceeds R x the '
+                             'pool median for --rebalance-ticks '
+                             'consecutive scrapes, the controller '
+                             'migrates its hottest sessions\' KV '
+                             'chains to the coldest replica between '
+                             'requests. 0 disables (default)')
+    parser.add_argument('--rebalance-ticks', type=int, default=3,
+                        help='consecutive skewed scrapes (same '
+                             'hottest replica) before a rebalance '
+                             'fires')
+    parser.add_argument('--rebalance-sessions', type=int, default=2,
+                        help='sessions migrated per rebalance step '
+                             '(small on purpose: each step is '
+                             're-evaluated against fresh load)')
     parser.add_argument('--prefill-replicas', type=int, default=0,
                         metavar='N',
                         help='disaggregated serving: N additional '
@@ -237,9 +274,14 @@ def main() -> None:
                                                   stub_factory)
     from skypilot_tpu.utils.registry import LB_POLICY_REGISTRY
 
-    max_replicas = args.max_replicas or args.replicas
+    # The spot decode pool is part of the serving floor: the
+    # autoscaler must not read the extra spot replicas as surplus
+    # and drain them right back down.
+    total_decode = args.replicas + max(args.spot_decode, 0)
+    max_replicas = max(args.max_replicas or total_decode,
+                       total_decode)
     spec = spec_lib.SkyServiceSpec(
-        min_replicas=args.replicas, max_replicas=max_replicas,
+        min_replicas=total_decode, max_replicas=max_replicas,
         upscale_delay_seconds=args.upscale_delay,
         downscale_delay_seconds=args.downscale_delay)
     autoscaler = autoscalers.EngineMetricsAutoscaler(
@@ -268,6 +310,10 @@ def main() -> None:
 
     env = dict(os.environ)
     if args.stub_replicas:
+        if args.fault_plan:
+            # Stubs take no --fault-plan flag; the plan arms from
+            # the environment at import (robustness/faults.py).
+            env['STPU_FAULT_PLAN'] = args.fault_plan
         factory = stub_factory(env=env)
     else:
         factory = serve_lm_factory(build_replica_cmd(args), env=env)
@@ -278,7 +324,10 @@ def main() -> None:
         manager, policy, autoscaler,
         interval_s=args.scrape_interval,
         prefill_autoscaler=prefill_autoscaler,
-        prefill_pool=prefill_pool)
+        prefill_pool=prefill_pool,
+        rebalance_skew=args.rebalance_skew,
+        rebalance_ticks=args.rebalance_ticks,
+        rebalance_sessions=args.rebalance_sessions)
     lb = make_lb_server(
         policy, args.lb_port,
         policy_name=args.lb_policy, manager=manager,
@@ -308,9 +357,36 @@ def main() -> None:
                   f'{summary["orphans"]}', flush=True)
     adopted_prefill = sum(
         1 for v in manager.views() if v.role == 'prefill')
+    adopted_spot = sum(
+        1 for v in manager.views()
+        if v.role != 'prefill' and v.zone)
+    decode_role = 'decode' if args.prefill_replicas else ''
     for _ in range(max(0, args.replicas -
-                       (adopted - adopted_prefill))):
-        manager.spawn(role='decode' if args.prefill_replicas else '')
+                       (adopted - adopted_prefill - adopted_spot))):
+        manager.spawn(role=decode_role)
+    if args.spot_decode > 0:
+        # Walk the catalog's risk-adjusted spot order (cheapest
+        # effective $/hour first, preemption risk priced in) and
+        # label each spot replica with its zone + price — the zone
+        # is what a PreemptionNotice (or a zone-scoped
+        # serve.preempt_notice fault rule) later targets, and the
+        # price feeds the $/1M-token accounting in /fleet/status.
+        from skypilot_tpu.catalog import gcp_catalog
+        try:
+            econ = gcp_catalog.spot_zone_economics(
+                args.spot_accelerator)
+        except Exception as e:
+            print(f'serve_fleet: spot catalog lookup for '
+                  f'{args.spot_accelerator} failed ({e}); spot '
+                  f'replicas spawn zoneless.', flush=True)
+            econ = []
+        for i in range(max(0, args.spot_decode - adopted_spot)):
+            if econ:
+                zone, price, _rate = econ[i % len(econ)]
+            else:
+                zone, price = f'spot-zone-{i}', 0.0
+            manager.spawn(role=decode_role, zone=zone,
+                          price_per_hour=price)
     for _ in range(max(0, args.prefill_replicas - adopted_prefill)):
         manager.spawn(role='prefill')
     loop = threading.Thread(target=controller.run, daemon=True)

@@ -93,6 +93,13 @@ def main() -> None:
     parser.add_argument('--port', type=int,
                         default=int(os.environ.get('SKYPILOT_SERVE_PORT',
                                                    8000)))
+    parser.add_argument('--zone', default='',
+                        help='placement zone label (spot decode '
+                             'pools): scoped into the preemption '
+                             'watcher\'s serve.preempt_notice fault '
+                             'point, echoed in /stats — a zone-'
+                             'scoped storm plan preempts only the '
+                             'replicas carrying the zone')
     parser.add_argument('--tensor', type=int, default=1,
                         help='tensor-parallel serving over N devices: '
                              'params shard per the training rules '
@@ -338,7 +345,7 @@ def main() -> None:
     from skypilot_tpu.inference.http_server import serve
     from skypilot_tpu.inference.runtime import build_runtime
     serve(build_runtime(args), args.port,
-          drain_grace=args.drain_grace)
+          drain_grace=args.drain_grace, zone=args.zone)
 
 
 if __name__ == '__main__':

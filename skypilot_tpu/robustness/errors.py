@@ -45,6 +45,26 @@ class AdapterLoadError(Exception):
     engine and every other adapter keep serving."""
 
 
+class SessionMigratedError(Exception):
+    """The engine evacuated this request's slot (drain, preemption
+    notice, or rebalancing): the future resolves with this instead of
+    a result, carrying everything the HTTP layer needs to finish the
+    session elsewhere — the committed token sequence (prompt +
+    generated so far), the remaining generation budget, the sampling
+    knobs, and the packed KV page chain covering the committed full
+    pages. The HTTP thread that owns the client connection ships the
+    chain to a peer and proxies the response tail; any failure falls
+    back to resubmitting locally against the promoted (still-warm)
+    pages — never a client-visible error."""
+
+    def __init__(self, record: dict) -> None:
+        super().__init__(
+            f'session migrated after '
+            f'{len(record.get("tokens") or []) - int(record.get("prompt_len", 0))}'
+            f' generated tokens (reason: {record.get("reason", "")})')
+        self.record = record
+
+
 class CheckpointNotFoundError(Exception):
     """No checkpoint exists to restore (empty/absent directory, or
     an explicitly requested step that was never written). Typed —

@@ -146,6 +146,21 @@ KNOWN_POINTS: Dict[str, str] = {
         'stalled data loader: the step watchdog dumps all thread '
         'stacks and aborts with the typed code 84 past its '
         'deadline; context: resume=<0|1>',
+    'serve.preempt_notice':
+        'serving preemption-notice poll loop (http_server.'
+        'ServePreemptionNotice + the stub replica) — a DROP is a '
+        'synthetic spot preemption: the replica mass-evacuates every '
+        'active KV chain to peers and drains inside the grace '
+        'window; fire-site context carries zone=<zone>, so a '
+        'windowed scoped rule is a zone-wide decode-pool storm '
+        '(examples/fault_plans/decode_zone_storm.json)',
+    'kv.migrate':
+        'inference server, start of each live session migration '
+        'ship (the /kv/migrate POST of an evacuated chain + '
+        'continuation request) — raise OR drop fails the ship: the '
+        'session finishes locally on the pages the evacuation '
+        'promoted into the prefix cache, never an error to the '
+        'client; context carries reason=<drain|preempt|rebalance>',
 }
 
 #: Sentinel returned by `point()` when a drop rule fires; sites that

@@ -168,6 +168,7 @@ class PrefixAffinityPolicy(LeastLoadPolicy):
     """
 
     _VNODES = 64  # virtual nodes per replica: evens out key spread
+    _PIN_MAX = 4096  # migrated-session pins kept (bounded LRU)
 
     def __init__(self, saturation_inflight: int = 32,
                  saturation_backlog: Optional[float] = None) -> None:
@@ -177,6 +178,34 @@ class PrefixAffinityPolicy(LeastLoadPolicy):
         self._backlog: Dict[str, float] = {}
         self._ring_points: List[int] = []
         self._ring_owners: List[str] = []
+        # Session pins (live migration): affinity key -> the endpoint
+        # whose pool now holds that session's migrated KV chain. A
+        # pin overrides the ring — the chain moved, the ring did not
+        # — until it LRU-evicts or its endpoint leaves the ready set.
+        self._pins: 'collections.OrderedDict[str, str]' = \
+            collections.OrderedDict()
+
+    # -- session pins (live migration) -----------------------------------
+    def pin_key(self, key: str, endpoint: str) -> None:
+        """Pin `key`'s sessions to `endpoint`: the fleet controller
+        calls this for every migrated-in affinity key it scrapes, so
+        follow-up requests land on the replica holding the warm
+        pages instead of the ring's (now-stale) owner."""
+        with self._lock:
+            self._pins.pop(key, None)
+            self._pins[key] = endpoint
+            while len(self._pins) > self._PIN_MAX:
+                self._pins.popitem(last=False)
+
+    def _pinned(self, key: str,
+                live: Iterable[str]) -> Optional[str]:
+        """The pin's endpoint when it is in the live set (a pin to a
+        dead or excluded replica is ignored, not dropped — scrape
+        blips must not unpin a warm session). Callers hold _lock."""
+        pinned = self._pins.get(key)
+        if pinned is not None and pinned in live:
+            return pinned
+        return None
 
     # -- ring ------------------------------------------------------------
     def _on_replicas_changed(self, replicas: List[str]) -> None:
@@ -232,6 +261,9 @@ class PrefixAffinityPolicy(LeastLoadPolicy):
         if key is None:
             return None
         with self._lock:
+            pinned = self._pinned(key, self.ready_replicas)
+            if pinned is not None:
+                return pinned
             return self._ring_lookup(key, self.ready_replicas)
 
     def select_replica(self, key: Optional[str] = None,
@@ -243,7 +275,9 @@ class PrefixAffinityPolicy(LeastLoadPolicy):
                 return None
             replica = None
             if key is not None:
-                replica = self._ring_lookup(key, candidates)
+                replica = self._pinned(key, candidates)
+                if replica is None:
+                    replica = self._ring_lookup(key, candidates)
                 if replica is not None and self._saturated(replica):
                     replica = None  # fall back below
             if replica is None:

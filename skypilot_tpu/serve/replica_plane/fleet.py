@@ -18,13 +18,16 @@ virtual clock and stub scrapes — no sleeps.
 """
 from __future__ import annotations
 
+import random
 import threading
 import time
-from typing import Callable, List, Optional
+import zlib
+from typing import Callable, Dict, List, Optional, Set
 
 from skypilot_tpu.observability import catalog as obs_catalog
 from skypilot_tpu.robustness import faults
 from skypilot_tpu.serve import autoscalers
+from skypilot_tpu.utils import common_utils
 from skypilot_tpu.serve.replica_plane.replica_manager import (
     ReplicaManager, ReplicaView)
 from skypilot_tpu.serve.serve_state import ReplicaStatus
@@ -54,6 +57,11 @@ class FleetController:
     # controller state.
     _STPU_OWNERS = {
         '_pushed_peers': 'watcher',
+        '_peer_backoff': 'watcher',
+        '_peer_retry_at': 'watcher',
+        '_pinned_keys': 'watcher',
+        '_rebalance_hot': 'watcher',
+        '_rebalance_streak': 'watcher',
         '_drain_threads': 'watcher',
         'consecutive_tick_failures': 'watcher',
     }
@@ -66,7 +74,10 @@ class FleetController:
                  prefill_autoscaler:
                  Optional['autoscalers.Autoscaler'] = None,
                  prefill_pool=None,
-                 http_post: Optional[Callable] = None) -> None:
+                 http_post: Optional[Callable] = None,
+                 rebalance_skew: float = 0.0,
+                 rebalance_ticks: int = 3,
+                 rebalance_sessions: int = 2) -> None:
         self.manager = manager
         self.policy = policy
         self.autoscaler = autoscaler
@@ -83,7 +94,26 @@ class FleetController:
         self.prefill_pool = prefill_pool
         self.disagg = prefill_autoscaler is not None
         self._http_post = http_post or _default_http_post
-        self._pushed_peers: dict = {}   # prefill endpoint -> set sent
+        self._pushed_peers: dict = {}   # endpoint -> peer set sent
+        # Failed peer pushes retry on a per-endpoint decorrelated
+        # backoff (seeded from the endpoint string, so schedules are
+        # reproducible), not every tick.
+        self._peer_backoff: Dict[str, common_utils.Backoff] = {}
+        self._peer_retry_at: Dict[str, float] = {}
+        # Migrated-in affinity keys already pinned per endpoint (the
+        # replica's /stats list is a bounded ring; pinning only new
+        # keys avoids churning the policy's pin LRU every tick).
+        self._pinned_keys: Dict[str, Set[str]] = {}
+        # Hot-spot rebalancing: when one replica's engine load stays
+        # above `rebalance_skew` x the pool median for
+        # `rebalance_ticks` consecutive ticks, ask it to migrate up
+        # to `rebalance_sessions` of its deepest sessions to the
+        # coldest replica. skew <= 0 disables.
+        self.rebalance_skew = rebalance_skew
+        self.rebalance_ticks = rebalance_ticks
+        self.rebalance_sessions = rebalance_sessions
+        self._rebalance_hot = ''
+        self._rebalance_streak = 0
         self.interval_s = interval_s
         self._clock = clock if clock is not None else time.time
         # Tests flip this off to make drains synchronous (ordering
@@ -118,49 +148,126 @@ class FleetController:
                     v.prefill_backlog_tokens + v.queue_depth
                 for v in self.manager.views()
                 if v.endpoint in ready})
-        if not self.disagg:
-            return
-        prefill_ready = self.manager.ready_endpoints('prefill')
-        if self.prefill_pool is not None:
-            self.prefill_pool.set_ready_replicas(prefill_ready)
-        self._push_decode_peers(prefill_ready, ready)
+        self._sync_session_pins()
+        # Every serving replica learns the rest of its pool (minus
+        # itself) so evacuations — drain, preemption, rebalance —
+        # have affinity-chosen targets; prefill replicas additionally
+        # learn the full decode set for KV handoffs.
+        pushes = {endpoint: sorted(set(ready) - {endpoint})
+                  for endpoint in ready}
+        if self.disagg:
+            prefill_ready = self.manager.ready_endpoints('prefill')
+            if self.prefill_pool is not None:
+                self.prefill_pool.set_ready_replicas(prefill_ready)
+            want = sorted(ready)
+            for endpoint in prefill_ready:
+                pushes[endpoint] = want
+        self._push_decode_peers(pushes)
 
-    def _push_decode_peers(self, prefill_ready, decode_ready) -> None:
-        """Tell each prefill replica where the decode pool is (only
-        when its view changed — the push is per-tick otherwise). A
-        failed push is logged and retried next tick; the replica
-        keeps its last set and falls back to local serving if every
-        peer in it died."""
-        want = sorted(decode_ready)
-        for endpoint in prefill_ready:
+    def _push_decode_peers(self,
+                           pushes: Dict[str, List[str]]) -> None:
+        """Tell each replica where its decode peers are (only when
+        its view changed — the push is a no-op per-tick otherwise).
+        A failed push is retried on that endpoint's decorrelated
+        backoff schedule (a down replica must not eat one connect
+        timeout per tick forever); the replica keeps its last set
+        and falls back to local serving if every peer in it died."""
+        now = self._clock()
+        for endpoint, want in pushes.items():
             if self._pushed_peers.get(endpoint) == want:
                 continue
+            if not want and endpoint not in self._pushed_peers:
+                continue  # nothing to tell a single-replica pool
+            if now < self._peer_retry_at.get(endpoint, 0.0):
+                continue  # backing off this endpoint
             try:
                 code = self._http_post(
                     f'http://{endpoint}/kv/peers', {'decode': want})
             except Exception as e:  # pylint: disable=broad-except
-                ux_utils.log(f'fleet: /kv/peers push to {endpoint} '
-                             f'failed ({e}); will retry next tick.')
+                self._defer_peer_push(endpoint, now, f'failed ({e})')
                 continue
             if code == 200:
                 self._pushed_peers[endpoint] = want
+                self._peer_backoff.pop(endpoint, None)
+                self._peer_retry_at.pop(endpoint, None)
             else:
-                ux_utils.log(f'fleet: /kv/peers push to {endpoint} '
-                             f'answered {code}; will retry.')
-        # Forget pushes to replicas that left the prefill pool.
+                self._defer_peer_push(endpoint, now,
+                                      f'answered {code}')
+        # Forget pushes to replicas that left the fleet.
         for endpoint in list(self._pushed_peers):
-            if endpoint not in prefill_ready:
+            if endpoint not in pushes:
                 del self._pushed_peers[endpoint]
+        for endpoint in list(self._peer_retry_at):
+            if endpoint not in pushes:
+                self._peer_retry_at.pop(endpoint, None)
+                self._peer_backoff.pop(endpoint, None)
+
+    def _defer_peer_push(self, endpoint: str, now: float,
+                         why: str) -> None:
+        """Schedule the next /kv/peers attempt for `endpoint` on its
+        decorrelated backoff (seeded from the endpoint string so the
+        schedule is reproducible across controller restarts)."""
+        backoff = self._peer_backoff.get(endpoint)
+        if backoff is None:
+            backoff = common_utils.Backoff(
+                initial=max(self.interval_s, 0.5), max_backoff=30.0,
+                jitter=True,
+                rng=random.Random(zlib.crc32(endpoint.encode())))
+            self._peer_backoff[endpoint] = backoff
+        delay = backoff.current_backoff()
+        self._peer_retry_at[endpoint] = now + delay
+        ux_utils.log(f'fleet: /kv/peers push to {endpoint} {why}; '
+                     f'retrying in {delay:.1f}s.')
+
+    def _sync_session_pins(self) -> None:
+        """Scraped migrated-in affinity keys -> policy session pins,
+        so follow-up requests for a migrated session land on the
+        replica now holding its warm pages instead of the ring's
+        stale owner. Only keys not yet pinned are pushed (the
+        replica reports a bounded ring of recent keys)."""
+        if not hasattr(self.policy, 'pin_key'):
+            return
+        live = set()
+        for view in self.manager.views():
+            live.add(view.endpoint)
+            keys = (view.migration or {}).get('migrated_in_keys')
+            if not keys:
+                continue
+            seen = self._pinned_keys.setdefault(view.endpoint, set())
+            for key in keys:
+                if key not in seen:
+                    seen.add(key)
+                    self.policy.pin_key(key, view.endpoint)
+            if len(seen) > 4096:
+                # The replica's ring evicted most of these long ago;
+                # restart tracking from what it still reports.
+                self._pinned_keys[view.endpoint] = set(keys)
+        for endpoint in list(self._pinned_keys):
+            if endpoint not in live:
+                del self._pinned_keys[endpoint]
 
     def drain_replica(self, view: ReplicaView) -> None:  # stpu: entry[watcher]
         """THE drain contract, in order: mark not-ready -> stop
-        routing -> SIGTERM -> wait for the replica's own drain.
-        Never kill-then-reroute."""
+        routing -> evacuate KV chains to survivors -> SIGTERM ->
+        wait for the replica's own drain. Never kill-then-reroute."""
         self.manager.mark_draining(view.replica_id)
         self._push_routing()  # routing stops BEFORE any signal
         for scaler in (self.autoscaler, self.prefill_autoscaler):
             if scaler is not None and hasattr(scaler, 'forget'):
                 scaler.forget(view.endpoint)
+        # Drain-by-migration: ask the victim to ship its active KV
+        # chains to affinity-chosen survivors while routing is
+        # already off. The POST returns once sessions are detached
+        # (the ships ride the in-flight handler threads, which the
+        # replica's own drain waits out); a failed POST is fine —
+        # SIGTERM triggers the same evacuation replica-side.
+        try:
+            self._http_post(f'http://{view.endpoint}/kv/evacuate',
+                            {'reason': 'drain'})
+        except Exception as e:  # pylint: disable=broad-except
+            ux_utils.log(f'fleet: /kv/evacuate to draining replica '
+                         f'{view.replica_id} failed ({e}); it will '
+                         f'evacuate on SIGTERM.')
         if self._drain_in_thread:
             # Prune finished drains first: over a long-running fleet
             # the list would otherwise grow one dead Thread per
@@ -174,6 +281,53 @@ class FleetController:
             self._drain_threads.append(thread)
         else:
             self.manager.drain(view.replica_id)
+
+    def _maybe_rebalance(self) -> None:
+        """Hot-spot rebalancing: sustained per-replica load skew
+        (one replica's engine load above `rebalance_skew` x the pool
+        median for `rebalance_ticks` consecutive ticks, same replica
+        throughout) triggers a bounded evacuation — the hottest
+        replica ships up to `rebalance_sessions` of its deepest
+        sessions' chains to the coldest replica between requests.
+        One detection, one POST: the streak resets after firing so a
+        persistent imbalance re-arms rather than machine-gunning."""
+        if self.rebalance_skew <= 0:
+            return
+        ready = set(self.manager.ready_endpoints(
+            'decode' if self.disagg else None))
+        loads = {v.endpoint: v.prefill_backlog_tokens + v.queue_depth
+                 for v in self.manager.views()
+                 if v.endpoint in ready}
+        if len(loads) < 2:
+            self._rebalance_streak = 0
+            return
+        ordered = sorted(loads.values())
+        median = ordered[len(ordered) // 2]
+        hottest = max(loads, key=lambda e: loads[e])
+        coldest = min(loads, key=lambda e: loads[e])
+        if loads[hottest] <= self.rebalance_skew * max(median, 1.0):
+            self._rebalance_streak = 0
+            return
+        if hottest != self._rebalance_hot:
+            self._rebalance_hot = hottest
+            self._rebalance_streak = 0
+        self._rebalance_streak += 1
+        if self._rebalance_streak < self.rebalance_ticks:
+            return
+        self._rebalance_streak = 0
+        ux_utils.log(f'fleet: rebalance — {hottest} load '
+                     f'{loads[hottest]} > {self.rebalance_skew}x '
+                     f'pool median {median}; migrating up to '
+                     f'{self.rebalance_sessions} sessions to '
+                     f'{coldest}.')
+        try:
+            self._http_post(
+                f'http://{hottest}/kv/evacuate',
+                {'reason': 'rebalance', 'target': coldest,
+                 'max_sessions': self.rebalance_sessions})
+        except Exception as e:  # pylint: disable=broad-except
+            ux_utils.log(f'fleet: rebalance /kv/evacuate to '
+                         f'{hottest} failed ({e}); will re-detect.')
 
     def _pick_victims(self, candidates: List[ReplicaView],
                       count: int) -> List[ReplicaView]:
@@ -205,6 +359,7 @@ class FleetController:
                 self.manager.fail(view.replica_id)
 
         self._push_routing()
+        self._maybe_rebalance()
 
         views = self.manager.views()
         if self.disagg:

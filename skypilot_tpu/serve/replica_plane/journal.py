@@ -67,6 +67,13 @@ class ReplicaRecord:
     # Disaggregated pool membership ('' = unified/decode-only fleet;
     # pre-role journals replay with the default).
     role: str = ''
+    # Spot placement: the zone this replica models and its hourly
+    # price (zero for on-demand / zoneless fleets). Journals written
+    # before these fields replay with the defaults — a restarted
+    # controller adopts old replicas as zoneless rather than
+    # refusing the journal.
+    zone: str = ''
+    price_per_hour: float = 0.0
 
     def to_fields(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -80,7 +87,10 @@ class ReplicaRecord:
                    state=str(fields.get('state', 'STARTING')),
                    pid=(int(fields['pid'])
                         if fields.get('pid') is not None else None),
-                   role=str(fields.get('role', '')))
+                   role=str(fields.get('role', '')),
+                   zone=str(fields.get('zone', '')),
+                   price_per_hour=float(
+                       fields.get('price_per_hour', 0.0) or 0.0))
 
 
 class FleetJournal:

@@ -33,6 +33,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
 from skypilot_tpu.inference import affinity
+from skypilot_tpu.inference import sse
 from skypilot_tpu.observability import REGISTRY
 from skypilot_tpu.observability import catalog as obs_catalog
 from skypilot_tpu.observability import tracing
@@ -146,6 +147,28 @@ class PrefillPool:
             return cands[self._i % len(cands)]
 
 
+def merge_migration_stats(views) -> Dict[str, Any]:
+    """Fleet-level live-migration rollup for /fleet/status: sum the
+    numeric counters (and the per-reason `migrations` dict) scraped
+    from every replica's /stats `migration` block. Key lists
+    (`migrated_in_keys`) are routing state, not dashboard material —
+    skipped."""
+    total: Dict[str, Any] = {}
+    for view in views:
+        part = getattr(view, 'migration', None) or {}
+        for key, value in part.items():
+            if isinstance(value, dict):
+                sub = total.setdefault(key, {})
+                for reason, count in value.items():
+                    try:
+                        sub[reason] = sub.get(reason, 0) + int(count)
+                    except (TypeError, ValueError):
+                        continue
+            elif isinstance(value, (int, float)):
+                total[key] = total.get(key, 0) + int(value)
+    return total
+
+
 def estimate_prompt_tokens(path: str, body: Dict[str, Any]) -> int:
     """Request prompt length in tokens, as well as the LB can know
     it: exact for token endpoints, chars/4 for text (the routing
@@ -254,6 +277,11 @@ def make_lb_server(policy, port: int, *, policy_name: str,
                 body = {'replicas': views,
                         'policy': policy_name,
                         'lb': metrics.snapshot()}
+                if manager is not None:
+                    migration = merge_migration_stats(
+                        manager.views())
+                    if migration:
+                        body['migration'] = migration
                 if slo_tracker is not None:
                     body['slo'] = slo_tracker.snapshot()
                 if disagg_threshold > 0:
@@ -431,26 +459,23 @@ def make_lb_server(policy, port: int, *, policy_name: str,
                     self.end_headers()
                     self.wfile.write(content)
                     return True, upstream.status_code, ttft_s
-                # SSE: headers out first, then chunks as they arrive.
+                # SSE: headers out first, then bytes as they ARRIVE
+                # (sse.pipe — iter_content would buffer whole short
+                # streams to EOF and flatten TTFT/ITL through the LB).
                 self.send_response(upstream.status_code)
                 for k, v in upstream.headers.items():
                     if k.lower() not in _HOP_HEADERS:
                         self.send_header(k, v)
                 self.end_headers()
-                ttft_s = None
-                try:
-                    for chunk in upstream.iter_content(8192):
-                        if chunk:
-                            if ttft_s is None:
-                                ttft_s = time.monotonic() - t0
-                            self.wfile.write(chunk)
-                            self.wfile.flush()
-                except (requests_lib.RequestException, OSError) as e:
+                eof, first_at = sse.pipe(upstream, self.wfile)
+                if not eof:
                     # Mid-stream replica death: the stream truncates
                     # (bounded blast radius — exactly the in-flight
                     # requests of the dead replica); never re-spliced.
                     ux_utils.log(f'LB: stream from {replica} '
-                                 f'truncated ({type(e).__name__}).')
+                                 f'truncated.')
+                ttft_s = (first_at - t0
+                          if first_at is not None else None)
                 return True, upstream.status_code, ttft_s
 
     server = ThreadingHTTPServer(('0.0.0.0', port), Handler)

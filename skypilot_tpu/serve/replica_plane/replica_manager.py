@@ -137,6 +137,12 @@ class ReplicaView:
     # Disaggregated pool membership: '' (unified), 'prefill', or
     # 'decode' — assigned at spawn, confirmed by the /stats echo.
     role: str = ''
+    # Spot placement: the zone this replica models ('' = on-demand /
+    # zoneless) and its hourly price — what /fleet/status needs for
+    # the $/hour rollup and what the zone-scoped preemption storm
+    # selects its victims by.
+    zone: str = ''
+    price_per_hour: float = 0.0
     queue_depth: int = 0
     prefill_backlog_tokens: int = 0
     requests_shed_total: int = 0
@@ -153,6 +159,10 @@ class ReplicaView:
     # resident right now, and how many artifacts it can serve.
     adapters_loaded: List[str] = dataclasses.field(default_factory=list)
     adapters_inventory: int = 0
+    # Live-migration counters scraped from /stats `migration` (empty
+    # until the replica migrates or receives a chain) — the fleet
+    # rollup in /fleet/status sums these across views.
+    migration: Dict[str, Any] = dataclasses.field(default_factory=dict)
     last_stats: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     @property
@@ -169,6 +179,8 @@ class ReplicaView:
             'ready': self.ready,
             'engine_healthy': self.engine_healthy,
             'role': self.role,
+            'zone': self.zone,
+            'price_per_hour': self.price_per_hour,
             'queue_depth': self.queue_depth,
             'prefill_backlog_tokens': self.prefill_backlog_tokens,
             'requests_shed_total': self.requests_shed_total,
@@ -194,7 +206,8 @@ def serve_lm_factory(base_cmd: List[str],
 
     def spawn(replica_id: int, port: int,
               instance_uuid: str = '',
-              role: str = '') -> 'subprocess.Popen':
+              role: str = '',
+              zone: str = '') -> 'subprocess.Popen':
         del replica_id
         out = subprocess.DEVNULL if quiet else None
         child_env = dict(env if env is not None else os.environ)
@@ -203,6 +216,8 @@ def serve_lm_factory(base_cmd: List[str],
         cmd = base_cmd + ['--port', str(port)]
         if role:
             cmd += ['--role', role]
+        if zone:
+            cmd += ['--zone', zone]
         return subprocess.Popen(
             cmd, env=child_env,
             stdout=out, stderr=subprocess.STDOUT if quiet else None)
@@ -218,12 +233,15 @@ def stub_factory(extra_args: Optional[List[str]] = None,
 
     def spawn(replica_id: int, port: int,
               instance_uuid: str = '',
-              role: str = '') -> 'subprocess.Popen':
+              role: str = '',
+              zone: str = '') -> 'subprocess.Popen':
         cmd = [sys.executable, '-m',
                'skypilot_tpu.serve.replica_plane.stub',
                '--port', str(port), '--seed', str(replica_id)]
         if role:
             cmd += ['--role', role]
+        if zone:
+            cmd += ['--zone', zone]
         cmd += list(extra_args or [])
         child_env = dict(env if env is not None else os.environ)
         if instance_uuid:
@@ -282,9 +300,11 @@ class ReplicaManager:
             self._factory_takes_uuid = ('instance_uuid' in params or
                                         var_kw)
             self._factory_takes_role = 'role' in params or var_kw
+            self._factory_takes_zone = 'zone' in params or var_kw
         except (TypeError, ValueError):
             self._factory_takes_uuid = False
             self._factory_takes_role = False
+            self._factory_takes_zone = False
         self.startup_grace_s = startup_grace_s
         self.drain_grace_s = drain_grace_s
         self.scrape_timeout_s = scrape_timeout_s
@@ -325,7 +345,8 @@ class ReplicaManager:
                 instance_uuid=view.instance_uuid,
                 state=view.state.value,
                 pid=getattr(view.proc, 'pid', None),
-                role=view.role).to_fields())
+                role=view.role, zone=view.zone,
+                price_per_hour=view.price_per_hour).to_fields())
 
     def _journal_state(self, view: ReplicaView) -> None:
         if self._journal is None:
@@ -341,10 +362,15 @@ class ReplicaManager:
             'terminate', replica_id=replica_id)
 
     # -- lifecycle -------------------------------------------------------
-    def spawn(self, role: str = '') -> ReplicaView:
+    def spawn(self, role: str = '', zone: str = '',
+              price_per_hour: float = 0.0) -> ReplicaView:
         """Spawn a replica; `role` ('' | 'prefill' | 'decode')
         selects its disaggregated pool and is forwarded to factories
-        that accept it (serve_lm/stub factories pass --role)."""
+        that accept it (serve_lm/stub factories pass --role).
+        `zone`/`price_per_hour` label a spot replica with its
+        placement (journaled; `zone` is forwarded to factories that
+        accept it, so the replica can answer zone-scoped preemption
+        storms)."""
         with self._lock:
             rid = next(self._ids)
         port = free_port()
@@ -354,12 +380,15 @@ class ReplicaManager:
             kwargs['instance_uuid'] = instance_uuid
         if role and self._factory_takes_role:
             kwargs['role'] = role
+        if zone and self._factory_takes_zone:
+            kwargs['zone'] = zone
         proc = self._factory(rid, port, **kwargs)
         view = ReplicaView(replica_id=rid, port=port,
                            endpoint=f'127.0.0.1:{port}',
                            state=ReplicaStatus.STARTING,
                            spawned_at=self._clock(), proc=proc,
-                           instance_uuid=instance_uuid, role=role)
+                           instance_uuid=instance_uuid, role=role,
+                           zone=zone, price_per_hour=price_per_hour)
         with self._lock:
             self._replicas[rid] = view
         self._journal_spawn(view)
@@ -435,7 +464,8 @@ class ReplicaManager:
                     spawned_at=self._clock(),
                     proc=self._reattach(rec),
                     instance_uuid=rec.instance_uuid, adopted=True,
-                    role=rec.role)
+                    role=rec.role, zone=rec.zone,
+                    price_per_hour=rec.price_per_hour)
                 with self._lock:
                     self._replicas[rid] = view
                 if view.state == ReplicaStatus.DRAINING:
@@ -678,6 +708,7 @@ class ReplicaManager:
         adapters = stats.get('adapters') or {}
         view.adapters_loaded = list(adapters.get('loaded') or [])
         view.adapters_inventory = len(adapters.get('inventory') or [])
+        view.migration = dict(stats.get('migration') or {})
         if ready and view.state in (ReplicaStatus.STARTING,
                                     ReplicaStatus.NOT_READY):
             view.state = ReplicaStatus.READY

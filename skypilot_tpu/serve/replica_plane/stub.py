@@ -37,7 +37,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional
 
 from skypilot_tpu.inference import affinity
+from skypilot_tpu.inference import sse
 from skypilot_tpu.observability import tracing
+from skypilot_tpu.robustness import faults
 
 
 class _StubDied(Exception):
@@ -53,8 +55,29 @@ class StubState:
                  on_die: Optional[Callable[[], None]],
                  instance_uuid: Optional[str] = None,
                  role: str = '',
-                 prefill_ms_per_token: float = 0.0) -> None:
+                 prefill_ms_per_token: float = 0.0,
+                 zone: str = '',
+                 migrate: bool = True) -> None:
         self.seed = seed
+        # Spot placement label: echoed in /stats and matched against
+        # zone-scoped `serve.preempt_notice` fault rules (the
+        # decode_zone_storm plan preempts exactly one zone's pool).
+        self.zone = zone
+        # Live migration (tentpole): with `migrate` off this stub is
+        # the full-replay A/B arm — a preemption just kills it and
+        # the client replays the whole prompt elsewhere.
+        self.migrate_enabled = migrate
+        self.evacuate = threading.Event()
+        self.evac_reason = 'drain'
+        self.evac_target: Optional[str] = None
+        self.evac_budget: Optional[int] = None  # None = all sessions
+        self.migrations: Dict[str, int] = {}
+        self.migration_failures = 0
+        self.sessions_evacuated = 0
+        self.chains_evacuated = 0
+        self.migrations_in = 0
+        self.tokens_recomputed = 0
+        self.migrated_in_keys: List[str] = []
         # Disaggregation model (mirrors serve_lm --role): one
         # "engine" lock serializes prefill chunks and token emission
         # — a long prompt's simulated prefill delays every other
@@ -127,6 +150,43 @@ class StubState:
                         self.evictions += 1
         return n_miss
 
+    def begin_evacuation(self, reason: str,
+                         target: Optional[str] = None,
+                         max_sessions: Optional[int] = None) -> None:
+        """Arm evacuation: in-flight streams start migrating out at
+        their next token boundary. `max_sessions` bounds how many
+        (rebalance); None evacuates everything (drain/preempt)."""
+        with self.lock:
+            self.evac_reason = reason or 'drain'
+            self.evac_target = target or None
+            self.evac_budget = (int(max_sessions)
+                                if max_sessions is not None else None)
+        self.evacuate.set()
+
+    def take_evac_slot(self) -> Optional[tuple]:
+        """Claim one evacuation slot: (reason, target) when this
+        stream should migrate out now, else None. Bounded
+        evacuations hand out `max_sessions` slots then disarm."""
+        with self.lock:
+            if not self.evacuate.is_set():
+                return None
+            if self.evac_budget is not None:
+                if self.evac_budget <= 0:
+                    self.evacuate.clear()
+                    return None
+                self.evac_budget -= 1
+                if self.evac_budget == 0:
+                    self.evacuate.clear()
+            return self.evac_reason, self.evac_target
+
+    def fully_evacuating(self) -> bool:
+        """An unbounded evacuation is in progress (drain/preempt):
+        readyz flips 503 so the LB stops sending fresh sessions to a
+        replica that is emptying itself."""
+        with self.lock:
+            return (self.evacuate.is_set() and
+                    self.evac_budget is None)
+
     def import_keys(self, keys: List[bytes]) -> int:
         """Decode side of a stub handoff: adopt the chain keys as
         resident pages (no hit/miss accounting — the import is the
@@ -187,6 +247,7 @@ class StubState:
                 'pid': os.getpid(),
                 'healthy': not self.aborted.is_set(),
                 'role': self.role,
+                'zone': self.zone,
                 'queued': self.inflight,
                 'prefill_backlog_tokens': 0,
                 'requests_shed': 0,
@@ -209,6 +270,17 @@ class StubState:
                     'evictions': self.evictions,
                 },
             }
+            if (self.migrations or self.sessions_evacuated or
+                    self.migrations_in or self.migration_failures):
+                body['migration'] = {
+                    'migrations': dict(self.migrations),
+                    'failures': self.migration_failures,
+                    'sessions_evacuated': self.sessions_evacuated,
+                    'chains_evacuated': self.chains_evacuated,
+                    'migrations_in': self.migrations_in,
+                    'tokens_recomputed': self.tokens_recomputed,
+                    'migrated_in_keys': list(self.migrated_in_keys),
+                }
             body.update(self.stats_overrides)
         return body
 
@@ -220,7 +292,9 @@ def make_stub_server(port: int, *, seed: int = 0, page_size: int = 16,
                      on_die: Optional[Callable[[], None]] = None,
                      instance_uuid: Optional[str] = None,
                      role: str = '',
-                     prefill_ms_per_token: float = 0.0
+                     prefill_ms_per_token: float = 0.0,
+                     zone: str = '',
+                     migrate: bool = True
                      ) -> ThreadingHTTPServer:
     state = StubState(seed=seed, page_size=page_size,
                       cache_pages=cache_pages,
@@ -228,7 +302,8 @@ def make_stub_server(port: int, *, seed: int = 0, page_size: int = 16,
                       die_after_tokens=die_after_tokens,
                       on_die=on_die, instance_uuid=instance_uuid,
                       role=role,
-                      prefill_ms_per_token=prefill_ms_per_token)
+                      prefill_ms_per_token=prefill_ms_per_token,
+                      zone=zone, migrate=migrate)
 
     class Handler(BaseHTTPRequestHandler):
 
@@ -253,6 +328,8 @@ def make_stub_server(port: int, *, seed: int = 0, page_size: int = 16,
                     reasons.append('draining')
                 if state.aborted.is_set():
                     reasons.append('engine dead')
+                if state.fully_evacuating():
+                    reasons.append('evacuating')
                 self._json({'ready': not reasons, 'reasons': reasons},
                            200 if not reasons else 503)
                 return
@@ -280,8 +357,22 @@ def make_stub_server(port: int, *, seed: int = 0, page_size: int = 16,
                         str(p) for p in (req.get('decode') or [])]
                 self._json({'decode': state.decode_peers})
                 return
+            if self.path == '/kv/evacuate':
+                length = int(self.headers.get('Content-Length', 0))
+                req = json.loads(self.rfile.read(length)) \
+                    if length else {}
+                reason = str(req.get('reason') or 'drain')
+                state.begin_evacuation(
+                    reason, req.get('target'),
+                    req.get('max_sessions'))
+                with state.lock:
+                    inflight = state.inflight
+                self._json({'evacuated': inflight,
+                            'chains': inflight, 'queued': 0,
+                            'reason': reason})
+                return
             if self.path not in ('/generate', '/v1/generate',
-                                 '/kv/import'):
+                                 '/kv/import', '/kv/migrate'):
                 self._json({'error': 'stub serves POST /generate'},
                            404)
                 return
@@ -301,6 +392,8 @@ def make_stub_server(port: int, *, seed: int = 0, page_size: int = 16,
                     self._trace_ctx = root.ctx
                     if self.path == '/kv/import':
                         self._kv_import()
+                    elif self.path == '/kv/migrate':
+                        self._kv_migrate()
                     else:
                         self._generate()
             except _StubDied:
@@ -326,6 +419,115 @@ def make_stub_server(port: int, *, seed: int = 0, page_size: int = 16,
                 self._json({'imported': len(keys)})
                 return
             self._generate(inner)
+
+        def _kv_migrate(self):
+            """Receiving side of a live session migration: adopt the
+            shipped chain keys (warm pages — the continuation prefill
+            costs only the uncovered tail), account the tokens this
+            replica did NOT have to recompute, then continue the
+            embedded request exactly where the sender stopped."""
+            length = int(self.headers.get('Content-Length', 0))
+            req = json.loads(self.rfile.read(length))
+            keys = [bytes.fromhex(k) for k in (req.get('keys') or [])]
+            state.import_keys(keys)
+            inner = req.get('request') or {}
+            rows = inner.get('tokens') or [[]]
+            row = [int(t) for t in (rows[0] if rows else [])]
+            covered = len(keys) * state.page_size
+            recomputed = max(0, len(row) - covered)
+            key = affinity.token_affinity_key(row, state.page_size)
+            with state.lock:
+                state.migrations_in += 1
+                state.tokens_recomputed += recomputed
+                if key is not None:
+                    state.migrated_in_keys.append(key)
+                    del state.migrated_in_keys[:-1024]
+            self._generate(inner)
+
+        def _migrate_out(self, reason: str, target: Optional[str],
+                         produced: List[int], base_len: int,
+                         gen_seed: int, j_next: int, j_end: int,
+                         stream: bool) -> Optional[List[int]]:
+            """Ship this stream's committed tokens + chain keys to a
+            peer and take over its response: stream mode pipes the
+            peer's SSE tail through verbatim (returns []), non-stream
+            returns the peer's final full row. None on any failure —
+            the caller finishes locally (a migration must never
+            become a client error)."""
+            with state.lock:
+                peers = list(state.decode_peers)
+            peer = target
+            if peer is None:
+                if not peers:
+                    return None
+                key = affinity.token_affinity_key(produced,
+                                                  state.page_size)
+                peer = peers[0]
+                if key is not None and len(peers) > 1:
+                    idx = int.from_bytes(bytes.fromhex(key)[:4],
+                                         'big')
+                    peer = peers[idx % len(peers)]
+            keys = affinity.chain_keys(produced, state.page_size)
+            body = {
+                'keys': [k.hex() for k in keys],
+                'reason': reason,
+                'request': {
+                    'tokens': [list(produced)],
+                    'max_new_tokens': j_end - j_next,
+                    'stream': stream,
+                    # The receiver re-derives the SAME greedy token
+                    # sequence the origin would have produced: token
+                    # j of a prompt of base_len under gen_seed, not
+                    # its own seed over the longer committed row.
+                    '_continuation': {'prompt_len': base_len,
+                                      'j_start': j_next,
+                                      'seed': gen_seed},
+                },
+            }
+            import requests as requests_lib
+            ctx = getattr(self, '_trace_ctx', None)
+            hdrs = ({tracing.HEADER: tracing.format_header(ctx)}
+                    if ctx is not None else None)
+            try:
+                with tracing.span('kv.migrate', ctx, peer=peer,
+                                  reason=reason, pages=len(keys)):
+                    upstream = requests_lib.post(
+                        f'http://{peer}/kv/migrate', json=body,
+                        headers=hdrs, stream=True,
+                        timeout=(2.0, 600.0))
+                if upstream.status_code != 200:
+                    upstream.close()
+                    raise RuntimeError(
+                        f'peer answered {upstream.status_code}')
+            except (requests_lib.RequestException,
+                    RuntimeError) as e:
+                with state.lock:
+                    state.migration_failures += 1
+                print(f'stub: migration to {peer} failed ({e}); '
+                      f'finishing locally', flush=True)
+                return None
+            with state.lock:
+                state.migrations[reason] = \
+                    state.migrations.get(reason, 0) + 1
+                state.sessions_evacuated += 1
+                state.chains_evacuated += 1
+            with upstream:
+                if stream:
+                    # Arrival-granular tail piping (sse.pipe): the
+                    # client keeps seeing tokens the moment the new
+                    # owner emits them; truncation looks like a
+                    # replica death and is already logged there.
+                    sse.pipe(upstream, self.wfile)
+                    return []
+                try:
+                    rows = upstream.json().get('tokens') or [[]]
+                    return [int(t) for t in rows[0]]
+                except (ValueError, IndexError) as e:
+                    print(f'stub: migrated response unparsable '
+                          f'({e}); finishing locally', flush=True)
+                    with state.lock:
+                        state.migration_failures += 1
+                    return None
 
         def _handoff(self, req, rows) -> bool:
             """Prefill-role stub: pay the prefill locally, ship the
@@ -387,13 +589,9 @@ def make_stub_server(port: int, *, seed: int = 0, page_size: int = 16,
                 if body_bytes is not None:
                     self.wfile.write(body_bytes)
                     return True
-                try:
-                    for chunk in upstream.iter_content(2048):
-                        if chunk:
-                            self.wfile.write(chunk)
-                            self.wfile.flush()
-                except (requests_lib.RequestException, OSError):
-                    pass  # truncation: same as a replica death
+                # Arrival-granular SSE pass-through; truncation is
+                # bounded and logged by the pipe itself.
+                sse.pipe(upstream, self.wfile)
             return True
 
         def _generate(self, req=None):
@@ -405,7 +603,8 @@ def make_stub_server(port: int, *, seed: int = 0, page_size: int = 16,
                 rows = [rows]
             max_new = int(req.get('max_new_tokens', 8))
             stream = bool(req.get('stream'))
-            if state.role == 'prefill' and self.path != '/kv/import':
+            if state.role == 'prefill' and self.path not in (
+                    '/kv/import', '/kv/migrate'):
                 if self._handoff(req, rows):
                     return
             for row in rows:
@@ -418,11 +617,35 @@ def make_stub_server(port: int, *, seed: int = 0, page_size: int = 16,
                 self.send_header('Cache-Control', 'no-cache')
                 self.send_header('Connection', 'close')
                 self.end_headers()
+            # Migration continuations re-derive the origin's token
+            # stream: token j of a base_len prompt under the ORIGIN
+            # replica's seed (bit-identity across the migration).
+            cont = req.get('_continuation') or {}
             for i, row in enumerate(rows):
                 produced = list(row)
+                base_len = int(cont.get('prompt_len', len(row)))
+                j_start = int(cont.get('j_start', 0))
+                gen_seed = int(cont.get('seed', state.seed))
+                j_end = j_start + max_new
                 last_t = None
-                for j in range(max_new):
-                    tok = (state.seed * 1000003 + len(row) * 31 +
+                migrate_tried = False
+                for j in range(j_start, j_end):
+                    if (state.migrate_enabled and len(rows) == 1 and
+                            not migrate_tried and
+                            state.evacuate.is_set()):
+                        slot = state.take_evac_slot()
+                        if slot is not None:
+                            migrate_tried = True
+                            result = self._migrate_out(
+                                slot[0], slot[1], produced,
+                                base_len, gen_seed, j, j_end,
+                                stream)
+                            if result is not None:
+                                if stream:
+                                    return  # peer piped the tail
+                                self._json({'tokens': [result]})
+                                return
+                    tok = (gen_seed * 1000003 + base_len * 31 +
                            j) % 50000
                     state.emit_token()
                     now = time.monotonic()
@@ -515,7 +738,9 @@ class InProcessStubReplica:
 
     def _drain(self) -> None:
         """The serve_lm SIGTERM contract: readyz flips 503, in-flight
-        requests finish, then exit 0."""
+        requests finish (migrating out when the controller armed
+        evacuation or peers are known), then exit 0."""
+        self.state.begin_evacuation('drain')
         self.state.draining.set()
         deadline = time.monotonic() + 30.0
         while time.monotonic() < deadline:
@@ -547,7 +772,8 @@ def in_process_stub_factory(**stub_kwargs: Any
 
     def spawn(replica_id: int, port: int,
               instance_uuid: str = '',
-              role: str = '') -> InProcessStubReplica:
+              role: str = '',
+              zone: str = '') -> InProcessStubReplica:
         kwargs = dict(stub_kwargs)
         kwargs.update(per_replica.get(replica_id, {}))
         kwargs.setdefault('seed', replica_id)
@@ -555,6 +781,8 @@ def in_process_stub_factory(**stub_kwargs: Any
             kwargs.setdefault('instance_uuid', instance_uuid)
         if role:
             kwargs.setdefault('role', role)
+        if zone:
+            kwargs.setdefault('zone', zone)
         return InProcessStubReplica(port, **kwargs)
 
     return spawn
@@ -570,6 +798,14 @@ def main() -> None:
     parser.add_argument('--die-after-tokens', type=int, default=0)
     parser.add_argument('--role', choices=['', 'prefill', 'decode'],
                         default='')
+    parser.add_argument('--zone', default='',
+                        help='spot placement label: echoed in /stats '
+                             'and matched against zone-scoped '
+                             'serve.preempt_notice fault rules')
+    parser.add_argument('--no-migrate', action='store_true',
+                        help='full-replay A/B arm: a preemption '
+                             'kills this stub instead of migrating '
+                             'its sessions out')
     parser.add_argument('--prefill-ms-per-token', type=float,
                         default=0.0,
                         help='simulated compute-bound prefill: each '
@@ -585,10 +821,12 @@ def main() -> None:
         token_sleep_s=args.token_sleep_ms / 1000.0,
         die_after_tokens=args.die_after_tokens, on_die=None,
         role=args.role,
-        prefill_ms_per_token=args.prefill_ms_per_token)
+        prefill_ms_per_token=args.prefill_ms_per_token,
+        zone=args.zone, migrate=not args.no_migrate)
     state: StubState = server.stub
 
     def _drain_loop():
+        state.begin_evacuation('drain')
         state.draining.set()
         time.sleep(0.2)  # stragglers
         server.shutdown()
@@ -604,6 +842,44 @@ def main() -> None:
     threading.Thread(target=lambda: (_term.wait(), _drain_loop()),
                      daemon=True).start()
     signal.signal(signal.SIGTERM, lambda *_: _term.set())
+
+    def _preempt_watch():
+        """Spot preemption watcher: an injected zone-scoped notice
+        (the decode_zone_storm plan) gives this replica its ~30s
+        grace window. Migration arm: evacuate every live session to
+        peers, then exit. Full-replay arm (--no-migrate): streams
+        break and the process dies, like a kill without notice."""
+        while not _term.is_set():
+            outcome = None
+            try:
+                outcome = faults.point('serve.preempt_notice',
+                                       zone=args.zone)
+            except faults.InjectedFault:
+                outcome = faults.DROP
+            if outcome is not faults.DROP:
+                if _term.wait(0.25):
+                    return
+                continue
+            if args.no_migrate:
+                print(f'stub: preemption notice (zone={args.zone}); '
+                      f'no-migrate arm — dying.', flush=True)
+                state.aborted.set()
+                time.sleep(0.5)
+                os._exit(1)
+            print(f'stub: preemption notice (zone={args.zone}); '
+                  f'evacuating sessions to peers.', flush=True)
+            state.begin_evacuation('preempt')
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                with state.lock:
+                    if state.inflight == 0:
+                        break
+                time.sleep(0.05)
+            os._exit(0)
+
+    if faults.active():
+        threading.Thread(target=_preempt_watch,
+                         daemon=True).start()
     print(f'stub replica listening on '
           f':{server.server_address[1]} seed={args.seed}', flush=True)
     server.serve_forever()

@@ -644,3 +644,194 @@ def test_new_catalog_rows_render():
                  'skypilot_serving_kv_handoff_seconds_bucket',
                  'skypilot_serving_kv_handoff_bytes_total'):
         assert name in text
+
+
+# ---------------------------------------------------------------------------
+# Live migration: engine evacuation + fleet drain-by-migration
+# ---------------------------------------------------------------------------
+def test_adapter_salted_migration_stays_isolated(tmp_path):
+    """Evacuating a mid-generation LoRA session ships an adapter-
+    salted chain: the record names the tenant, the payload imports
+    under the salted keys (a base-model request on the receiver gets
+    ZERO hits), and the tenant's continuation on the receiver rides
+    the warm pages to a bit-identical finish."""
+    from skypilot_tpu.inference.adapters import AdapterRegistry
+    from skypilot_tpu.models import lora as lora_lib
+    from skypilot_tpu.robustness.errors import SessionMigratedError
+    model, params = _build(total_pages=48)
+    spec = lora_lib.LoraSpec(rank=4, alpha=8.0)
+    ad_params = lora_lib.random_adapter_params(7, model.config, spec)
+    lora_lib.save_adapter(str(tmp_path / 'ten_a'), ad_params, spec,
+                          base_model='llama-tiny')
+    prompt = SYS_PROMPT + [40]
+    regs = [AdapterRegistry(str(tmp_path), model, max_adapters=2)
+            for _ in range(3)]
+    ctrl = _engine(model, params, adapter_store=regs[0])
+    src = _engine(model, params, adapter_store=regs[1])
+    dst = _engine(model, params, adapter_store=regs[2])
+    try:
+        ref = ctrl.submit(prompt, max_new_tokens=48,
+                          adapter='ten_a').result(timeout=300)
+        got = threading.Event()
+        fut = src.submit(prompt, max_new_tokens=48, adapter='ten_a',
+                         on_token=lambda t: got.set())
+        assert got.wait(timeout=300)
+        res = src.evacuate_chains(reason='drain')
+        assert res['evacuated'] == 1
+        with pytest.raises(SessionMigratedError) as exc_info:
+            fut.result(timeout=300)
+        rec = exc_info.value.record
+        assert rec['reason'] == 'drain'
+        assert rec['adapter'] == 'ten_a'
+        committed = rec['tokens']
+        # Mid-generation: prompt plus at least one committed token,
+        # and a strict prefix of the undisturbed control run.
+        assert len(prompt) < len(committed) < rec['limit']
+        assert committed == ref[:len(committed)]
+        assert rec['payload'] is not None
+        assert rec['pages'] == len(committed) // 8
+        meta, _ = kv_transfer.unpack_pages(rec['payload'])
+        assert meta['salt'] != ''
+        assert dst.import_chain(rec['payload'])['imported'] == \
+            rec['pages']
+        # Base-model probe on the receiver: same tokens, different
+        # salt -> the migrated tenant pages are invisible.
+        h0 = dst.prefix_cache.hits
+        dst.submit(list(committed),
+                   max_new_tokens=2).result(timeout=300)
+        assert dst.prefix_cache.hits == h0
+        # Tenant continuation: warm imported pages + bit-identical
+        # finish (exactly what the record tells a peer to run).
+        h1 = dst.prefix_cache.hits
+        out = dst.submit(list(committed),
+                         max_new_tokens=rec['limit'] - len(committed),
+                         adapter='ten_a').result(timeout=300)
+        assert out == ref
+        assert dst.prefix_cache.hits - h1 >= rec['pages']
+    finally:
+        ctrl.stop()
+        src.stop()
+        dst.stop()
+
+
+def _migration_fleet(n=2, **stub_kw):
+    """A unified (decode-only) in-process stub fleet behind a
+    prefix-affinity LB; every replica learns its peers via the
+    controller's /kv/peers push, so evacuations have targets."""
+    from skypilot_tpu.serve import autoscalers
+    from skypilot_tpu.serve import load_balancing_policies as lbp
+    from skypilot_tpu.serve import service_spec as spec_lib
+    from skypilot_tpu.serve.replica_plane import (FleetController,
+                                                  ReplicaManager,
+                                                  make_lb_server)
+    from skypilot_tpu.serve.replica_plane.stub import \
+        in_process_stub_factory
+    factory = in_process_stub_factory(cache_pages=512, **stub_kw)
+    spec = spec_lib.SkyServiceSpec(min_replicas=n, max_replicas=n)
+    policy = lbp.PrefixAffinityPolicy()
+    manager = ReplicaManager(factory, drain_grace_s=10.0)
+    controller = FleetController(
+        manager, policy, autoscalers.EngineMetricsAutoscaler(spec),
+        interval_s=0.2)
+    lb = make_lb_server(policy, 0, policy_name='prefix_affinity',
+                        manager=manager)
+    threading.Thread(target=lb.serve_forever, daemon=True).start()
+    for _ in range(n):
+        manager.spawn()
+    assert controller.wait_ready(n, timeout_s=60)
+    controller.tick()   # push peers
+    url = f'http://127.0.0.1:{lb.server_address[1]}'
+    return url, controller, manager, lb, policy
+
+
+def test_drain_by_migration_finishes_stream_on_survivor():
+    """THE drain chaos contract: drain the replica that owns an
+    in-flight stream; its chain migrates to a survivor mid-stream,
+    the client's token row stays bit-identical (the receiver
+    re-derives the origin's sequence via _continuation), the victim
+    exits 0 with migrations{drain} > 0, and the controller pins the
+    migrated session key to the new owner."""
+    import requests as requests_lib
+    url, controller, manager, lb, policy = _migration_fleet(
+        n=2, seed=2026, token_sleep_s=0.05)
+    try:
+        prompt = list(range(2, 26))   # 24 tokens
+        max_new = 40
+        expected = [(2026 * 1000003 + len(prompt) * 31 + j) % 50000
+                    for j in range(max_new)]
+        toks = []
+        first = threading.Event()
+        err = []
+
+        def client():
+            try:
+                with requests_lib.post(
+                        url + '/generate',
+                        json={'tokens': [prompt],
+                              'max_new_tokens': max_new,
+                              'stream': True},
+                        stream=True, timeout=(5, 120)) as resp:
+                    assert resp.status_code == 200
+                    for line in resp.iter_lines(chunk_size=1):
+                        if not line.startswith(b'data: '):
+                            continue
+                        payload = line[len(b'data: '):]
+                        if payload == b'[DONE]':
+                            return
+                        frame = json.loads(payload)
+                        if 'token' in frame:
+                            toks.append(int(frame['token']))
+                            first.set()
+            except Exception as e:  # pylint: disable=broad-except
+                err.append(e)
+            finally:
+                first.set()
+
+        t = threading.Thread(target=client, daemon=True)
+        t.start()
+        assert first.wait(timeout=60)
+        assert not err, f'client failed early: {err[0]!r}'
+        victim = None
+        deadline = time.monotonic() + 30
+        while victim is None and time.monotonic() < deadline:
+            for v in manager.views():
+                if v.proc.state.inflight > 0:
+                    victim = v
+                    break
+            else:
+                time.sleep(0.01)
+        assert victim is not None, \
+            'no replica owns the in-flight stream'
+        controller.drain_replica(victim)
+        t.join(timeout=120)
+        assert not t.is_alive()
+        assert not err, f'client saw {err[0]!r}'
+        # Bit-identical across the migration: every token equals the
+        # closed-form stub sequence an undisturbed replica emits.
+        assert toks == expected
+        vstate = victim.proc.state
+        assert vstate.migrations.get('drain', 0) >= 1
+        assert vstate.sessions_evacuated >= 1
+        # The victim's own drain finishes cleanly once the tail has
+        # been piped through (drain runs in a controller thread).
+        deadline = time.monotonic() + 30
+        while victim.proc.poll() is None and \
+                time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert victim.proc.poll() == 0
+        survivors = [v for v in manager.views()
+                     if v.replica_id != victim.replica_id]
+        adopted = [v for v in survivors
+                   if v.proc.state.migrations_in > 0]
+        assert adopted, 'no survivor adopted the migrated chain'
+        keys = list(adopted[0].proc.state.migrated_in_keys)
+        assert keys
+        # Scrape -> tick turns the receiver's migrated-in keys into
+        # LB session pins: follow-ups land on the warm pages.
+        manager.scrape_once()
+        controller.tick()
+        assert policy.select_replica(keys[-1]) == \
+            adopted[0].endpoint
+    finally:
+        controller.shutdown()
+        lb.shutdown()
